@@ -1,0 +1,30 @@
+"""edgemesh — TPU-native distributed multi-agent LLM inference framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+``parthabp55/LLM-for-Distributed-Egde-Devices`` (see SURVEY.md):
+
+- multi-agent LLM ensembling (QA agents + refiner) — ``edgemesh.agents``
+- bf16/fp16/int8 inference with Pallas int8 kernels — ``edgemesh.ops``
+- mesh distribution (DP/TP/PP/SP) over ICI/DCN collectives — ``edgemesh.parallel``
+- decoder-only model families (Llama / GPT-NeoX(Pythia) / Phi-2) — ``edgemesh.models``
+- eight-metric evaluation harness over Natural Questions — ``edgemesh.eval``
+- serving front door + CLI — ``edgemesh.serve``, ``edgemesh.cli``
+
+Where the reference moved tensors between Jetson edge devices over
+gRPC/protobuf (reference ``Code/gRPC/server.py``), edgemesh maps each "edge
+node" to a TPU chip on a pod slice and lets XLA emit ICI/DCN collectives from
+``jax.sharding`` annotations. Heavy top-level imports are deferred: importing
+``edgemesh`` itself does not import jax.
+"""
+
+__version__ = "0.1.0"
+
+from edgemesh.config import (  # noqa: F401
+    AgentSpec,
+    EdgeMeshConfig,
+    EvalSpec,
+    MeshSpec,
+    ModelSpec,
+    SamplingParams,
+    load_config,
+)

@@ -1,0 +1,249 @@
+"""Dataclass configuration system: YAML file + CLI mirror with explicit-None merge.
+
+Capability parity notes (reference = parthabp55/LLM-for-Distributed-Egde-Devices):
+
+- The reference replicates a YAML-load + per-key argparse override block in every
+  runner (``Code/C-DAC Server/combiner_fp.py:380-410``). It has two override
+  idioms: the correct ``if args.x is not None`` merge (combiner_fp.py:404-410)
+  and a buggy ``args.x or cfg[x]`` variant that silently drops falsy CLI values
+  (``Code/Base Models/Llama_bf16_updated.py:154-161``). edgemesh keeps ONLY the
+  ``is not None`` semantics, implemented once.
+- The reference's sampling knob set (max_new_tokens / temperature / top_k /
+  top_p / repetition_penalty, ``Code/C-DAC Server/config_2.yaml:11-14``) is
+  preserved verbatim in :class:`SamplingParams`.
+- The reference hardcodes three roles (phi / pythia / refiner + an embedder,
+  combiner_fp.py:413-421); edgemesh generalizes them to a list of
+  :class:`AgentSpec`.
+- New (TPU-native, no reference analog): :class:`MeshSpec` — the
+  ``jax.sharding.Mesh`` axis sizes that replace the reference's static-IP
+  Jetson cluster map (``Code/gRPC/README.md:9-14``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+# ---------------------------------------------------------------------------
+# Leaf config dataclasses
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Generation knobs — the exact set the reference exposes in YAML+CLI.
+
+    Frozen (hashable) so a SamplingParams can be a jit static argument: the
+    decode loop specializes on it at trace time and the knobs cost nothing at
+    runtime.
+    """
+
+    max_new_tokens: int = 100
+    temperature: float = 0.7
+    top_k: int = 50
+    top_p: float = 0.9
+    repetition_penalty: float = 1.2
+    do_sample: bool = True
+    seed: int = 0
+
+    def greedy(self) -> "SamplingParams":
+        return dataclasses.replace(self, do_sample=False)
+
+
+@dataclass
+class ModelSpec:
+    """One model to materialize on the mesh.
+
+    ``family`` selects the architecture dialect (llama / neox / phi2); ``auto``
+    sniffs it from the checkpoint's HF config.json. ``precision`` mirrors the
+    reference's base-vs-quant runner pairs (fp16/bf16 loaders in
+    ``Code/Base Models``, int8 in ``Code/Quantised Models``).
+    """
+
+    path: str = ""
+    family: str = "auto"  # auto | llama | neox | phi2
+    precision: str = "bf16"  # bf16 | fp16 | fp32 | int8
+    # Architecture overrides for synthetic (random-init) models; ignored when
+    # loading a real checkpoint.
+    vocab_size: int | None = None
+    num_layers: int | None = None
+    hidden_size: int | None = None
+    num_heads: int | None = None
+    num_kv_heads: int | None = None
+    intermediate_size: int | None = None
+    max_seq_len: int | None = None
+
+
+@dataclass
+class AgentSpec:
+    """Role → model binding in the multi-agent ensemble.
+
+    Generalizes the reference's fixed phi/pythia/refiner trio
+    (combiner_fp.py:413-418). ``role`` is free-form; the orchestrator treats
+    ``refiner`` specially (it merges the other agents' answers, mirroring
+    refine_summary, combiner_fp.py:355-377).
+    """
+
+    role: str = "qa"
+    model: ModelSpec = field(default_factory=ModelSpec)
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    prompt_template: str = "Question: {question}\nAnswer:"
+
+
+@dataclass
+class MeshSpec:
+    """Device-mesh axis sizes: the TPU-native replacement for the reference's
+    per-device gRPC stub map. Axes: data / model(tensor) / pipeline / sequence.
+    """
+
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp * self.pp * self.sp
+
+
+@dataclass
+class EvalSpec:
+    """Evaluation harness settings (reference L5; combiner_fp.py:429-474)."""
+
+    dataset_path: str = (
+        "/root/reference/Code/Dataset/natural_questions_1000.csv"
+    )
+    dataset_split: str = "train[:1000]"
+    num_samples: int = 1000
+    batch_size: int = 1
+    output_jsonl: str = "results.jsonl"
+    resume: bool = True
+    metrics: list[str] = field(
+        default_factory=lambda: [
+            "rouge1", "rouge2", "rougeL", "avg_rouge",
+            "bleu", "cosine", "confidence", "bertscore", "tps",
+        ]
+    )
+
+
+@dataclass
+class EdgeMeshConfig:
+    """Top-level run config."""
+
+    agents: list[AgentSpec] = field(default_factory=list)
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+    eval: EvalSpec = field(default_factory=EvalSpec)
+    embedder: str = ""  # sentence-embedding model path for cosine metric
+    log_level: str = "INFO"
+    seed: int = 0
+
+
+# ---------------------------------------------------------------------------
+# YAML <-> dataclass plumbing
+# ---------------------------------------------------------------------------
+
+
+# Nested-dataclass fields, dispatched by name (annotations are strings under
+# `from __future__ import annotations`, so name dispatch is the reliable path;
+# add an entry when adding a nested spec field).
+_NESTED_FIELDS: dict[str, type] = {}
+
+
+def _from_dict(cls, data: dict[str, Any]):
+    """Recursively build a dataclass from a plain dict; unknown keys raise."""
+    if not dataclasses.is_dataclass(cls):
+        return data
+    kwargs: dict[str, Any] = {}
+    hints = {f.name: f for f in dataclasses.fields(cls)}
+    for key, value in (data or {}).items():
+        if key not in hints:
+            raise KeyError(f"unknown config key {key!r} for {cls.__name__}")
+        if key == "agents":
+            kwargs[key] = [_from_dict(AgentSpec, v) for v in value]
+        elif key in _NESTED_FIELDS and isinstance(value, dict):
+            kwargs[key] = _from_dict(_NESTED_FIELDS[key], value)
+        else:
+            kwargs[key] = value
+    return cls(**kwargs)
+
+
+_NESTED_FIELDS.update(
+    model=ModelSpec, sampling=SamplingParams, mesh=MeshSpec, eval=EvalSpec
+)
+
+
+def to_dict(cfg) -> dict[str, Any]:
+    return dataclasses.asdict(cfg)
+
+
+def _flatten(d: dict[str, Any], prefix: str = "") -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def _set_dotted(cfg, dotted: str, value: Any) -> None:
+    parts = dotted.split(".")
+    obj = cfg
+    for p in parts[:-1]:
+        obj = getattr(obj, p)
+    leaf = parts[-1]
+    current = getattr(obj, leaf)
+    if current is not None and value is not None:
+        value = type(current)(value) if not isinstance(value, type(current)) else value
+    # object.__setattr__ so overrides also reach frozen leaves (SamplingParams).
+    object.__setattr__(obj, leaf, value)
+
+
+def load_config(path: str | Path | None = None, overrides: dict[str, Any] | None = None) -> EdgeMeshConfig:
+    """Load YAML (optional) and apply dotted-key overrides with ``is not None``
+    merge semantics (the correct reference idiom, combiner_fp.py:404-410)."""
+    cfg = EdgeMeshConfig()
+    if path is not None:
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        cfg = _from_dict(EdgeMeshConfig, raw)
+    for key, value in (overrides or {}).items():
+        if value is not None:  # None == "not given on CLI" → keep YAML value
+            _set_dotted(cfg, key, value)
+    return cfg
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    """CLI mirror of every scalar config key, as dotted flags.
+
+    The reference re-declares ~15 argparse flags in each of its eight runner
+    mains (combiner_fp.py:381-396); here the parser is generated from the
+    dataclass tree once.
+    """
+    parser = argparse.ArgumentParser(prog="edgemesh")
+    parser.add_argument("--config", type=str, default=None, help="YAML config path")
+    flat = _flatten(to_dict(EdgeMeshConfig()))
+    for key, default in flat.items():
+        if key.startswith("agents."):
+            continue  # list-valued; configure agents via YAML
+        argtype = type(default) if default is not None else str
+        if argtype is bool:
+            parser.add_argument(f"--{key}", type=lambda s: s.lower() in ("1", "true", "yes"), default=None)
+        elif argtype is list:
+            continue
+        else:
+            parser.add_argument(f"--{key}", type=argtype, default=None)
+    return parser
+
+
+def config_from_cli(argv: list[str] | None = None) -> EdgeMeshConfig:
+    parser = build_arg_parser()
+    args, _ = parser.parse_known_args(argv)
+    overrides = {k: v for k, v in vars(args).items() if k != "config"}
+    return load_config(args.config, overrides)

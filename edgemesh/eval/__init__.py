@@ -1,0 +1,12 @@
+"""Evaluation harness: the eight-metric suite + golden-dataset loop.
+
+This is the reference's acceptance harness (its L5 layer, SURVEY.md §1) as a
+proper module instead of ~40 lines copy-pasted into eight runners (C9 in
+SURVEY.md §2.1).
+"""
+
+from edgemesh.eval.metrics import (  # noqa: F401
+    bleu,
+    cosine_similarity,
+    rouge_scores,
+)

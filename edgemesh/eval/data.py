@@ -1,0 +1,36 @@
+"""Dataset loading for the golden-metric harness.
+
+The reference evaluates on a fixed 1,000-pair Natural Questions snapshot,
+loaded either via HF datasets (``combiner_fp.py:413``) or raw CSV
+(``try.py:292``). Here the CSV path is primary (no network): columns
+``query,answer``, as in ``Code/Dataset/natural_questions_1000.csv``.
+"""
+
+from __future__ import annotations
+
+import csv
+from dataclasses import dataclass
+from pathlib import Path
+
+
+@dataclass
+class QASample:
+    index: int
+    question: str
+    answer: str
+
+
+def load_qa_csv(path: str | Path, limit: int | None = None) -> list[QASample]:
+    samples: list[QASample] = []
+    with open(path, newline="", encoding="utf-8") as f:
+        reader = csv.DictReader(f)
+        cols = {c.lower(): c for c in reader.fieldnames or []}
+        qcol = cols.get("query") or cols.get("question")
+        acol = cols.get("answer") or cols.get("answers")
+        if not qcol or not acol:
+            raise ValueError(f"expected query/answer columns, got {reader.fieldnames}")
+        for i, row in enumerate(reader):
+            if limit is not None and i >= limit:
+                break
+            samples.append(QASample(i, row[qcol], row[acol]))
+    return samples

@@ -1,0 +1,136 @@
+"""Per-sample evaluation loop with zero-fill error policy, JSONL persistence,
+resume, and aggregate report.
+
+Mirrors the reference's L5 loop (``combiner_fp.py:429-474``) with the two
+upgrades SURVEY.md §5.4 calls for: per-sample results are persisted
+incrementally (an interrupted 1,000-sample run resumes instead of restarting
+from zero — the reference restarts) and the error policy (metric failure →
+zero-filled row, run continues; combiner_fp.py:448-454) is explicit instead of
+a bare ``except:``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from collections.abc import Callable
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from edgemesh.eval.data import QASample
+from edgemesh.eval.metrics import (
+    HashingEmbedder,
+    bertscore,
+    bleu,
+    cosine_similarity,
+    rouge_scores,
+)
+
+log = logging.getLogger("edgemesh.eval")
+
+# answer_fn: question -> dict with at least {"answer": str}; optionally
+# {"tps": float, "confidence": float, "ttft_s": float, ...} merged into the row.
+AnswerFn = Callable[[str], dict[str, Any]]
+
+METRIC_KEYS = [
+    "rouge1", "rouge2", "rougeL", "avg_rouge",
+    "bertscore", "bleu", "cosine", "confidence", "tps",
+]
+
+
+def score_sample(prediction: str, reference: str, embedder=None) -> dict[str, float]:
+    embedder = embedder or _default_embedder()
+    row: dict[str, float] = {}
+    row.update(rouge_scores(prediction, reference))
+    row["bleu"] = bleu(prediction, reference)
+    row["cosine"] = cosine_similarity(prediction, reference, embedder)
+    row["bertscore"] = bertscore(prediction, reference, getattr(embedder, "embed_tokens", None))["f1"]
+    return row
+
+
+_EMBEDDER = None
+
+
+def _default_embedder():
+    global _EMBEDDER
+    if _EMBEDDER is None:
+        _EMBEDDER = HashingEmbedder()
+    return _EMBEDDER
+
+
+def _load_done(jsonl_path: Path) -> dict[int, dict]:
+    done: dict[int, dict] = {}
+    if jsonl_path.exists():
+        with open(jsonl_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    row = json.loads(line)
+                    done[row["index"]] = row
+    return done
+
+
+def run_eval(
+    samples: list[QASample],
+    answer_fn: AnswerFn,
+    output_jsonl: str | Path = "results.jsonl",
+    resume: bool = True,
+    embedder=None,
+    log_every: int = 25,
+) -> dict[str, float]:
+    """Evaluate ``answer_fn`` over ``samples``; returns the aggregate-mean
+    report (the analog of the reference's final np.mean block,
+    combiner_fp.py:465-474)."""
+    out_path = Path(output_jsonl)
+    done = _load_done(out_path) if resume else {}
+    if done:
+        log.info("resuming: %d/%d samples already scored", len(done), len(samples))
+
+    t_start = time.perf_counter()
+    with open(out_path, "a" if resume else "w") as sink:
+        for sample in samples:
+            if sample.index in done:
+                continue
+            row: dict[str, Any] = {"index": sample.index, "question": sample.question}
+            try:
+                result = answer_fn(sample.question)
+                row["answer"] = result.get("answer", "")
+                for k in ("tps", "confidence", "ttft_s"):
+                    if k in result:
+                        row[k] = result[k]
+                row.update(
+                    {
+                        k: v
+                        for k, v in score_sample(
+                            row["answer"], sample.answer, embedder
+                        ).items()
+                        if k not in row
+                    }
+                )
+            except Exception as exc:  # zero-fill policy (combiner_fp.py:448-454)
+                log.warning("sample %d failed: %s", sample.index, exc)
+                row.update({k: 0.0 for k in METRIC_KEYS})
+                row.setdefault("answer", "")
+                row["error"] = str(exc)
+            sink.write(json.dumps(row) + "\n")
+            sink.flush()
+            done[sample.index] = row
+            if (len(done) % log_every) == 0:
+                log.info("scored %d/%d", len(done), len(samples))
+
+    report = aggregate(list(done.values()))
+    report["wall_time_s"] = time.perf_counter() - t_start
+    report["num_samples"] = len(done)
+    return report
+
+
+def aggregate(rows: list[dict]) -> dict[str, float]:
+    report: dict[str, float] = {}
+    for key in METRIC_KEYS:
+        vals = [r[key] for r in rows if key in r and r[key] is not None]
+        if vals:
+            report[key] = float(np.mean(vals))
+    return report

@@ -1,0 +1,319 @@
+"""Quality metrics, implemented from scratch in numpy/python (no network, no
+GPU): ROUGE-1/2/L, BLEU, embedding cosine similarity, BERTScore-style greedy
+token matching, and helpers for confidence / tokens-per-sec.
+
+Parity map against the reference's metric suite (C9, SURVEY.md §2.1 — the same
+~40 lines appear in every runner, e.g. ``Code/C-DAC Server/combiner_fp.py:288-325``):
+
+- ``evaluate_rouge``/``mean_rouge`` (rouge_score pkg)  → :func:`rouge_scores`
+- ``evaluate_bleu`` (HF evaluate "bleu")               → :func:`bleu`
+- ``cosine_similarity`` (sentence-transformers)        → :func:`cosine_similarity`
+  over any embedder callable; :class:`HashingEmbedder` is the no-download
+  fallback.
+- ``evaluate_bertscore`` (bert-score pkg)              → :func:`bertscore`
+  (greedy max-sim token matching, Zhang et al. 2020) over any token-embedding
+  callable.
+- ``confidence_score`` (mean per-token max softmax)    → computed inside the
+  decode loop (edgemesh/runtime/generate.py) — no second forward pass.
+- tokens/sec → ``GenerateResult.tokens_per_sec`` (generated-only convention,
+  combiner_fp.py:349).
+
+ROUGE follows the rouge_score package's definition (F1 of n-gram overlap /
+LCS, with Porter stemming like its ``use_stemmer=True`` default in the
+reference) so aggregate numbers are comparable to BASELINE.md Tables 1–2.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from collections import Counter
+from collections.abc import Callable, Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Tokenization + Porter stemmer (compact standard implementation)
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+
+def _porter_stem(w: str) -> str:
+    """Compact Porter stemmer (1980 algorithm, steps 1a-5b)."""
+    if len(w) <= 2:
+        return w
+
+    def cons(word, i):
+        c = word[i]
+        if c in "aeiou":
+            return False
+        if c == "y":
+            return i == 0 or not cons(word, i - 1)
+        return True
+
+    def measure(stem):
+        m, prev_vowel = 0, False
+        for i in range(len(stem)):
+            v = not cons(stem, i)
+            if not v and prev_vowel:
+                m += 1
+            prev_vowel = v
+        return m
+
+    def has_vowel(stem):
+        return any(not cons(stem, i) for i in range(len(stem)))
+
+    def ends_double_cons(word):
+        return len(word) >= 2 and word[-1] == word[-2] and cons(word, len(word) - 1)
+
+    def cvc(word):
+        if len(word) < 3:
+            return False
+        return (
+            cons(word, len(word) - 3)
+            and not cons(word, len(word) - 2)
+            and cons(word, len(word) - 1)
+            and word[-1] not in "wxy"
+        )
+
+    # Step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif w.endswith("ss"):
+        pass
+    elif w.endswith("s"):
+        w = w[:-1]
+    # Step 1b
+    flag = False
+    if w.endswith("eed"):
+        if measure(w[:-3]) > 0:
+            w = w[:-1]
+    elif w.endswith("ed") and has_vowel(w[:-2]):
+        w, flag = w[:-2], True
+    elif w.endswith("ing") and has_vowel(w[:-3]):
+        w, flag = w[:-3], True
+    if flag:
+        if w.endswith(("at", "bl", "iz")):
+            w += "e"
+        elif ends_double_cons(w) and not w.endswith(("l", "s", "z")):
+            w = w[:-1]
+        elif measure(w) == 1 and cvc(w):
+            w += "e"
+    # Step 1c
+    if w.endswith("y") and has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+    # Step 2
+    for suf, rep in (
+        ("ational", "ate"), ("tional", "tion"), ("enci", "ence"), ("anci", "ance"),
+        ("izer", "ize"), ("abli", "able"), ("alli", "al"), ("entli", "ent"),
+        ("eli", "e"), ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
+        ("ator", "ate"), ("alism", "al"), ("iveness", "ive"), ("fulness", "ful"),
+        ("ousness", "ous"), ("aliti", "al"), ("iviti", "ive"), ("biliti", "ble"),
+    ):
+        if w.endswith(suf):
+            if measure(w[: -len(suf)]) > 0:
+                w = w[: -len(suf)] + rep
+            break
+    # Step 3
+    for suf, rep in (
+        ("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
+        ("ical", "ic"), ("ful", ""), ("ness", ""),
+    ):
+        if w.endswith(suf):
+            if measure(w[: -len(suf)]) > 0:
+                w = w[: -len(suf)] + rep
+            break
+    # Step 4
+    for suf in (
+        "al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement",
+        "ment", "ent", "ou", "ism", "ate", "iti", "ous", "ive", "ize",
+    ):
+        if w.endswith(suf):
+            stem = w[: -len(suf)]
+            if measure(stem) > 1:
+                w = stem
+            break
+    else:
+        if w.endswith("ion") and measure(w[:-3]) > 1 and w[:-3].endswith(("s", "t")):
+            w = w[:-3]
+    # Step 5a
+    if w.endswith("e"):
+        stem = w[:-1]
+        m = measure(stem)
+        if m > 1 or (m == 1 and not cvc(stem)):
+            w = stem
+    # Step 5b
+    if ends_double_cons(w) and w.endswith("l") and measure(w) > 1:
+        w = w[:-1]
+    return w
+
+
+def tokenize(text: str, stem: bool = True) -> list[str]:
+    toks = _TOKEN_RE.findall(text.lower())
+    return [_porter_stem(t) for t in toks] if stem else toks
+
+
+# ---------------------------------------------------------------------------
+# ROUGE
+# ---------------------------------------------------------------------------
+
+
+def _f1(matches: float, pred_total: float, ref_total: float) -> float:
+    if pred_total == 0 or ref_total == 0 or matches == 0:
+        return 0.0
+    p = matches / pred_total
+    r = matches / ref_total
+    return 2 * p * r / (p + r)
+
+
+def _ngrams(tokens: Sequence[str], n: int) -> Counter:
+    return Counter(tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1))
+
+
+def _lcs_len(a: Sequence[str], b: Sequence[str]) -> int:
+    if not a or not b:
+        return 0
+    prev = [0] * (len(b) + 1)
+    for x in a:
+        cur = [0]
+        for j, y in enumerate(b, 1):
+            cur.append(prev[j - 1] + 1 if x == y else max(prev[j], cur[-1]))
+        prev = cur
+    return prev[-1]
+
+
+def rouge_scores(prediction: str, reference: str, stem: bool = True) -> dict[str, float]:
+    """ROUGE-1/2/L F1 + their mean (the reference's ``mean_rouge``,
+    combiner_fp.py:298-299)."""
+    pred = tokenize(prediction, stem)
+    ref = tokenize(reference, stem)
+    out: dict[str, float] = {}
+    for n, name in ((1, "rouge1"), (2, "rouge2")):
+        pc, rc = _ngrams(pred, n), _ngrams(ref, n)
+        matches = sum((pc & rc).values())
+        out[name] = _f1(matches, max(sum(pc.values()), 0), max(sum(rc.values()), 0))
+    lcs = _lcs_len(pred, ref)
+    out["rougeL"] = _f1(lcs, len(pred), len(ref))
+    out["avg_rouge"] = (out["rouge1"] + out["rouge2"] + out["rougeL"]) / 3
+    return out
+
+
+# ---------------------------------------------------------------------------
+# BLEU (Papineni et al. 2002, matching HF evaluate's defaults: max_order=4,
+# no smoothing — the reference's evaluate_bleu, combiner_fp.py:307-310)
+# ---------------------------------------------------------------------------
+
+
+def bleu(
+    prediction: str,
+    references: str | Sequence[str],
+    max_order: int = 4,
+    smooth: bool = False,
+) -> float:
+    if isinstance(references, str):
+        references = [references]
+    pred = tokenize(prediction, stem=False)
+    refs = [tokenize(r, stem=False) for r in references]
+    if not pred:
+        return 0.0
+
+    precisions = []
+    for n in range(1, max_order + 1):
+        pc = _ngrams(pred, n)
+        max_ref: Counter = Counter()
+        for r in refs:
+            rc = _ngrams(r, n)
+            for g, c in rc.items():
+                max_ref[g] = max(max_ref[g], c)
+        matches = sum(min(c, max_ref[g]) for g, c in pc.items())
+        total = max(len(pred) - n + 1, 0)
+        if smooth:
+            precisions.append((matches + 1) / (total + 1))
+        else:
+            precisions.append(matches / total if total > 0 else 0.0)
+
+    if min(precisions) <= 0:
+        return 0.0
+    log_avg = sum(math.log(p) for p in precisions) / max_order
+    ref_len = min(refs, key=lambda r: abs(len(r) - len(pred)))
+    bp = 1.0 if len(pred) > len(ref_len) else math.exp(1 - len(ref_len) / max(len(pred), 1))
+    return bp * math.exp(log_avg)
+
+
+# ---------------------------------------------------------------------------
+# Embedding-based metrics
+# ---------------------------------------------------------------------------
+
+Embedder = Callable[[list[str]], np.ndarray]  # texts -> [n, d]
+TokenEmbedder = Callable[[str], tuple[list[str], np.ndarray]]  # text -> (tokens, [t, d])
+
+
+class HashingEmbedder:
+    """Deterministic no-download embedder: L2-normalized char-ngram hashing TF
+    vectors. Stands in for the reference's sentence-transformers MiniLM
+    (combiner_fp.py:421) when no local model is available; any callable with
+    the same signature (e.g. a JAX/torch encoder) drops in."""
+
+    def __init__(self, dim: int = 512, ngram: tuple[int, int] = (3, 5)):
+        self.dim = dim
+        self.ngram = ngram
+
+    def _vector(self, text: str) -> np.ndarray:
+        # crc32, not builtin hash(): stable across processes (PYTHONHASHSEED).
+        from zlib import crc32
+
+        v = np.zeros(self.dim, dtype=np.float64)
+        s = " ".join(tokenize(text, stem=False))
+        for n in range(self.ngram[0], self.ngram[1] + 1):
+            for i in range(len(s) - n + 1):
+                v[crc32(s[i : i + n].encode()) % self.dim] += 1.0
+        norm = np.linalg.norm(v)
+        return v / norm if norm > 0 else v
+
+    def __call__(self, texts: list[str]) -> np.ndarray:
+        return np.stack([self._vector(t) for t in texts])
+
+    def embed_tokens(self, text: str) -> tuple[list[str], np.ndarray]:
+        toks = tokenize(text, stem=False)
+        if not toks:
+            return [], np.zeros((0, self.dim))
+        return toks, np.stack([self._vector(t) for t in toks])
+
+
+def cosine_similarity(
+    prediction: str, reference: str, embedder: Embedder | None = None
+) -> float:
+    embedder = embedder or HashingEmbedder()
+    vecs = embedder([prediction, reference])
+    a, b = vecs[0], vecs[1]
+    denom = np.linalg.norm(a) * np.linalg.norm(b)
+    if denom == 0:
+        return 0.0
+    return float(np.dot(a, b) / denom)
+
+
+def bertscore(
+    prediction: str,
+    reference: str,
+    token_embedder: TokenEmbedder | None = None,
+) -> dict[str, float]:
+    """BERTScore-style greedy matching (Zhang et al., ICLR 2020): recall =
+    mean over reference tokens of max cosine sim to any candidate token;
+    precision symmetric; F1 harmonic mean. The reference calls the bert-score
+    package with a roberta model (combiner_fp.py:302-305); here the contextual
+    encoder is pluggable and defaults to the hashing embedder."""
+    token_embedder = token_embedder or HashingEmbedder().embed_tokens
+    _, pe = token_embedder(prediction)
+    _, re_ = token_embedder(reference)
+    if pe.shape[0] == 0 or re_.shape[0] == 0:
+        return {"precision": 0.0, "recall": 0.0, "f1": 0.0}
+    pe = pe / np.clip(np.linalg.norm(pe, axis=1, keepdims=True), 1e-9, None)
+    re_ = re_ / np.clip(np.linalg.norm(re_, axis=1, keepdims=True), 1e-9, None)
+    sim = pe @ re_.T  # [p, r]
+    precision = float(np.mean(np.max(sim, axis=1)))
+    recall = float(np.mean(np.max(sim, axis=0)))
+    f1 = 0.0 if precision + recall == 0 else 2 * precision * recall / (precision + recall)
+    return {"precision": precision, "recall": recall, "f1": f1}

@@ -1,0 +1,11 @@
+"""Decoder-only model families: generic transformer + Llama/NeoX(Pythia)/Phi-2 presets."""
+
+from edgemesh.models.transformer import (  # noqa: F401
+    KVCache,
+    ModelConfig,
+    forward_decode,
+    forward_prefill,
+    init_kv_cache,
+    init_params,
+)
+from edgemesh.models.families import FAMILY_PRESETS, config_for_family  # noqa: F401

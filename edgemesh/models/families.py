@@ -1,0 +1,122 @@
+"""Model family presets and HF config sniffing.
+
+The three families mirror the models the reference evaluates (ACL paper §4.2;
+loaders at ``Code/C-DAC Server/combiner_fp.py:274-284``): Phi-2, Pythia-1B,
+Llama-3.2-1B-Instruct. Each preset fixes the architecture dials of
+:class:`~edgemesh.models.transformer.ModelConfig`; size fields come from the
+checkpoint's config.json (hf_ingest) or from :class:`~edgemesh.config.ModelSpec`
+overrides for synthetic models.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from edgemesh.models.transformer import ModelConfig
+
+# Architecture dials only — size fields filled per checkpoint.
+FAMILY_PRESETS: dict[str, dict] = {
+    # Llama 2/3 lineage: RMSNorm, SwiGLU, GQA, full rotary, no biases.
+    "llama": dict(
+        norm="rms",
+        activation="silu",
+        parallel_block=False,
+        shared_input_norm=False,
+        rotary_fraction=1.0,
+        qkv_bias=False,
+        out_bias=False,
+        lm_head_bias=False,
+        tie_embeddings=True,  # Llama-3.2-1B ties; larger Llamas override to False
+    ),
+    # Pythia / GPT-NeoX: LayerNorm+bias, GELU, parallel residual with TWO input
+    # norms, rotary_pct=0.25, biases everywhere, untied embed_out.
+    "neox": dict(
+        norm="ln",
+        activation="gelu",
+        parallel_block=True,
+        shared_input_norm=False,
+        rotary_fraction=0.25,
+        qkv_bias=True,
+        out_bias=True,
+        lm_head_bias=False,
+        tie_embeddings=False,
+    ),
+    # Phi-2: LayerNorm+bias, GELU(tanh), parallel block with ONE shared input
+    # norm, partial rotary (32 of 80 dims = 0.4), biases incl. lm_head.
+    "phi2": dict(
+        norm="ln",
+        activation="gelu_tanh",
+        parallel_block=True,
+        shared_input_norm=True,
+        rotary_fraction=0.4,
+        qkv_bias=True,
+        out_bias=True,
+        lm_head_bias=True,
+        tie_embeddings=False,
+    ),
+}
+
+_HF_MODEL_TYPE_TO_FAMILY = {
+    "llama": "llama",
+    "gpt_neox": "neox",
+    "phi": "phi2",
+}
+
+
+def sniff_family(checkpoint_dir: str | Path) -> str:
+    """Read the HF config.json ``model_type`` and map to an edgemesh family."""
+    cfg_path = Path(checkpoint_dir) / "config.json"
+    with open(cfg_path) as f:
+        model_type = json.load(f).get("model_type", "")
+    try:
+        return _HF_MODEL_TYPE_TO_FAMILY[model_type]
+    except KeyError:
+        raise ValueError(
+            f"unsupported HF model_type {model_type!r} in {cfg_path}; "
+            f"supported: {sorted(_HF_MODEL_TYPE_TO_FAMILY)}"
+        ) from None
+
+
+def config_for_family(
+    family: str,
+    *,
+    vocab_size: int,
+    hidden_size: int,
+    num_layers: int,
+    num_heads: int,
+    num_kv_heads: int | None = None,
+    intermediate_size: int | None = None,
+    max_seq_len: int = 2048,
+    **overrides,
+) -> ModelConfig:
+    if family not in FAMILY_PRESETS:
+        raise ValueError(f"unknown family {family!r}; supported: {sorted(FAMILY_PRESETS)}")
+    preset = dict(FAMILY_PRESETS[family])
+    preset.update(overrides)
+    return ModelConfig(
+        vocab_size=vocab_size,
+        hidden_size=hidden_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads or num_heads,
+        intermediate_size=intermediate_size or 4 * hidden_size,
+        max_seq_len=max_seq_len,
+        **preset,
+    )
+
+
+def tiny_config(family: str = "llama", **overrides) -> ModelConfig:
+    """A minutes-not-hours config for tests and CPU smoke runs."""
+    defaults = dict(
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2 if family == "llama" else 4,
+        intermediate_size=128,
+        max_seq_len=128,
+        dtype="float32",
+    )
+    defaults.update(overrides)
+    return config_for_family(family, **defaults)

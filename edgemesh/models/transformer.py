@@ -1,0 +1,338 @@
+"""Generic decoder-only transformer as pure functions over a param pytree.
+
+One implementation covers the reference's three model families
+(SURVEY.md §7 hard part (c)): Llama-3.2 (RMSNorm/SwiGLU/GQA/full rotary),
+Pythia/GPT-NeoX (LayerNorm/GELU/parallel-residual/rotary_pct=0.25), and
+Phi-2 (LayerNorm/GELU-tanh/parallel-block/rotary fraction 0.4). The reference
+loaded these via HF ``from_pretrained`` (``Code/C-DAC Server/combiner_fp.py:274-284``);
+here the architecture is expressed natively so XLA sees one traced program.
+
+TPU-first choices:
+- Layers are STACKED (every param leaf carries a leading ``num_layers`` axis)
+  and the forward runs ``lax.scan`` over them: one layer's HLO compiled once,
+  not ``L`` inlined copies — fast compiles, and the natural substrate for
+  pipeline-stage splitting (scan over per-stage layer blocks).
+- All shapes static; the decode loop (runtime/generate.py) jits once.
+- Matmuls run in bf16 on the MXU with fp32 softmax/norm islands.
+- Params are a plain dict pytree → ``jax.sharding.NamedSharding`` trees map
+  directly onto it (edgemesh/parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from edgemesh.ops.attention import LayerKV, attend, write_decode, write_prefill
+from edgemesh.ops.norms import layer_norm, rms_norm
+from edgemesh.ops.rope import apply_rope
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    num_layers: int = 16
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    intermediate_size: int = 8192
+    max_seq_len: int = 2048
+    head_dim: int | None = None  # defaults to hidden_size // num_heads
+
+    # Family dials
+    norm: str = "rms"  # rms | ln
+    norm_eps: float = 1e-5
+    activation: str = "silu"  # silu (SwiGLU) | gelu | gelu_tanh
+    parallel_block: bool = False  # Phi-2/NeoX style: attn & mlp from one input
+    shared_input_norm: bool = False  # Phi-2: ONE norm feeds both attn and mlp
+    rotary_fraction: float = 1.0
+    rope_theta: float = 10000.0
+    qkv_bias: bool = False
+    out_bias: bool = False  # attn output proj + mlp projections
+    lm_head_bias: bool = False
+    tie_embeddings: bool = False
+    logit_soft_cap: float = 0.0
+
+    # Precision
+    dtype: str = "bfloat16"
+    remat: bool = False
+
+    @property
+    def head_size(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        # Round to even; HF families use even rotary dims (e.g. Phi-2: 32).
+        rd = int(self.head_size * self.rotary_fraction)
+        return rd - (rd % 2)
+
+    @property
+    def activation_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+class KVCache(NamedTuple):
+    """Whole-model cache: k/v are [num_layers, batch, max_seq, kv_heads, head_dim];
+    ``lengths`` is the per-row filled length [batch]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    lengths: jnp.ndarray
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int | None = None, dtype=None) -> KVCache:
+    max_seq = max_seq or cfg.max_seq_len
+    dtype = dtype or cfg.activation_dtype
+    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_size)
+    return KVCache(
+        k=jnp.zeros(shape, dtype=dtype),
+        v=jnp.zeros(shape, dtype=dtype),
+        lengths=jnp.zeros((batch,), dtype=jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Initialization (random weights; HF checkpoint ingest lives in hf_ingest.py)
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(key, in_dim: int, out_dim: int, dtype, bias: bool) -> Params:
+    scale = in_dim**-0.5
+    p: Params = {
+        "kernel": (jax.random.normal(key, (in_dim, out_dim), jnp.float32) * scale).astype(dtype)
+    }
+    if bias:
+        p["bias"] = jnp.zeros((out_dim,), dtype)
+    return p
+
+
+def _norm_init(cfg: ModelConfig, dtype) -> Params:
+    p: Params = {"scale": jnp.ones((cfg.hidden_size,), dtype)}
+    if cfg.norm == "ln":
+        p["bias"] = jnp.zeros((cfg.hidden_size,), dtype)
+    return p
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
+    """Random init with every layer leaf stacked along a leading L axis."""
+    dtype = cfg.activation_dtype
+    h, nh, kh, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    inter = cfg.intermediate_size
+    keys = jax.random.split(rng, 16)
+
+    def stack_layers(make_one):
+        layer_keys = jax.random.split(keys[0], cfg.num_layers)
+        return jax.vmap(make_one)(layer_keys)
+
+    def one_layer(key) -> Params:
+        ks = jax.random.split(key, 8)
+        layer: Params = {
+            "attn_norm": _norm_init(cfg, dtype),
+            "q": _dense_init(ks[0], h, nh * hd, dtype, cfg.qkv_bias),
+            "k": _dense_init(ks[1], h, kh * hd, dtype, cfg.qkv_bias),
+            "v": _dense_init(ks[2], h, kh * hd, dtype, cfg.qkv_bias),
+            "o": _dense_init(ks[3], nh * hd, h, dtype, cfg.out_bias),
+        }
+        if not cfg.shared_input_norm:
+            layer["mlp_norm"] = _norm_init(cfg, dtype)
+        if cfg.activation == "silu":
+            layer["gate"] = _dense_init(ks[4], h, inter, dtype, cfg.out_bias)
+            layer["up"] = _dense_init(ks[5], h, inter, dtype, cfg.out_bias)
+        else:
+            layer["up"] = _dense_init(ks[5], h, inter, dtype, cfg.out_bias)
+        layer["down"] = _dense_init(ks[6], inter, h, dtype, cfg.out_bias)
+        return layer
+
+    params: Params = {
+        "embed": {
+            "weight": (jax.random.normal(keys[1], (cfg.vocab_size, h), jnp.float32) * 0.02).astype(dtype)
+        },
+        "layers": stack_layers(one_layer),
+        "final_norm": _norm_init(cfg, dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense_init(keys[2], h, cfg.vocab_size, dtype, cfg.lm_head_bias)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def dense(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Linear layer; dispatches to the int8 path when the param leaf is
+    quantized (edgemesh/ops/int8.py stores {"kernel_q", "scales"}) and applies
+    the SmoothQuant activation division when a "smooth" leaf is present."""
+    if "kernel_q" in p:
+        from edgemesh.ops.int8 import int8_matmul
+
+        if "smooth" in p:
+            x = x / p["smooth"].astype(x.dtype)
+        y = int8_matmul(x, p["kernel_q"], p["scales"])
+    else:
+        y = x @ p["kernel"]
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def _apply_norm(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.norm == "rms":
+        return rms_norm(x, p["scale"], cfg.norm_eps)
+    return layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
+
+
+def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.activation == "silu":
+        return dense(layer["down"], jax.nn.silu(dense(layer["gate"], x)) * dense(layer["up"], x))
+    hidden = dense(layer["up"], x)
+    if cfg.activation == "gelu_tanh":
+        hidden = jax.nn.gelu(hidden, approximate=True)
+    else:
+        hidden = jax.nn.gelu(hidden, approximate=False)
+    return dense(layer["down"], hidden)
+
+
+def _attention(
+    cfg: ModelConfig,
+    layer: Params,
+    x: jnp.ndarray,  # [b, s, h]
+    positions: jnp.ndarray,  # [b, s]
+    cache: LayerKV,
+    kv_valid: jnp.ndarray,  # [b, max_seq]
+    lengths: jnp.ndarray,  # [b] (write offsets for decode)
+    is_decode: bool,
+) -> tuple[jnp.ndarray, LayerKV]:
+    b, s, _ = x.shape
+    nh, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+
+    q = dense(layer["q"], x).reshape(b, s, nh, hd)
+    k = dense(layer["k"], x).reshape(b, s, kh, hd)
+    v = dense(layer["v"], x).reshape(b, s, kh, hd)
+
+    if cfg.rotary_dim > 0:
+        q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta)
+
+    if is_decode:
+        cache = write_decode(cache, k, v, lengths)
+    else:
+        cache = write_prefill(cache, k, v)
+
+    out = attend(q, cache, positions, kv_valid)
+    return dense(layer["o"], out.reshape(b, s, nh * hd)), cache
+
+
+def _layer_fn(
+    cfg: ModelConfig,
+    x: jnp.ndarray,
+    layer: Params,
+    layer_kv: LayerKV,
+    positions: jnp.ndarray,
+    kv_valid: jnp.ndarray,
+    lengths: jnp.ndarray,
+    is_decode: bool,
+) -> tuple[jnp.ndarray, LayerKV]:
+    if cfg.parallel_block:
+        # Phi-2 (shared_input_norm=True): y = x + attn(ln(x)) + mlp(ln(x))
+        # NeoX parallel residual:         y = x + attn(ln1(x)) + mlp(ln2(x))
+        attn_in = _apply_norm(cfg, layer["attn_norm"], x)
+        mlp_in = attn_in if cfg.shared_input_norm else _apply_norm(cfg, layer["mlp_norm"], x)
+        attn_out, layer_kv = _attention(cfg, layer, attn_in, positions, cache=layer_kv,
+                                        kv_valid=kv_valid, lengths=lengths, is_decode=is_decode)
+        return x + attn_out + _mlp(cfg, layer, mlp_in), layer_kv
+    # Sequential (Llama): x += attn(norm(x)); x += mlp(norm(x))
+    attn_out, layer_kv = _attention(
+        cfg, layer, _apply_norm(cfg, layer["attn_norm"], x), positions,
+        cache=layer_kv, kv_valid=kv_valid, lengths=lengths, is_decode=is_decode,
+    )
+    x = x + attn_out
+    return x + _mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x)), layer_kv
+
+
+def _forward(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [b, s]
+    positions: jnp.ndarray,  # [b, s]
+    cache: KVCache,
+    kv_valid: jnp.ndarray,  # [b, max_seq]
+    is_decode: bool,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Shared prefill/decode body: scan one compiled layer over stacked params."""
+    x = params["embed"]["weight"][tokens].astype(cfg.activation_dtype)
+
+    def body(carry, scanned):
+        h = carry
+        layer, k_l, v_l = scanned
+        fn = _layer_fn
+        if cfg.remat:
+            fn = jax.checkpoint(fn, static_argnums=(0, 7))
+        h, new_kv = fn(cfg, h, layer, LayerKV(k_l, v_l), positions, kv_valid,
+                       cache.lengths, is_decode)
+        return h, (new_kv.k, new_kv.v)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+
+    x = _apply_norm(cfg, params["final_norm"], x)
+    if cfg.tie_embeddings or "lm_head" not in params:
+        logits = x @ params["embed"]["weight"].T.astype(cfg.activation_dtype)
+    else:
+        logits = dense(params["lm_head"], x)
+    if cfg.logit_soft_cap > 0:
+        logits = cfg.logit_soft_cap * jnp.tanh(logits / cfg.logit_soft_cap)
+
+    new_lengths = jnp.max(positions, axis=1) + 1
+    return logits, KVCache(new_k, new_v, new_lengths)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def forward_prefill(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [b, s] right-padded prompts
+    lengths: jnp.ndarray,  # [b] true prompt lengths
+    cache: KVCache,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Run the full prompt; returns logits at the LAST REAL token [b, vocab]
+    and the filled cache."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    max_seq = cache.k.shape[2]
+    kv_valid = jnp.arange(max_seq)[None, :] < lengths[:, None]
+    # Clamp padded positions to the last real position so their (ignored)
+    # rope/mask values stay in range.
+    positions = jnp.minimum(positions, (lengths - 1)[:, None])
+    logits, cache = _forward(cfg, params, tokens, positions, cache, kv_valid, is_decode=False)
+    last = logits[jnp.arange(b), lengths - 1]
+    return last, KVCache(cache.k, cache.v, lengths)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def forward_decode(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [b] one new token per row
+    cache: KVCache,
+) -> tuple[jnp.ndarray, KVCache]:
+    """One autoregressive step. Returns next-token logits [b, vocab]."""
+    b = tokens.shape[0]
+    positions = cache.lengths[:, None]  # [b, 1] — position of the new token
+    max_seq = cache.k.shape[2]
+    kv_valid = jnp.arange(max_seq)[None, :] <= cache.lengths[:, None]
+    logits, new_cache = _forward(
+        cfg, params, tokens[:, None], positions, cache, kv_valid, is_decode=True
+    )
+    return logits[:, 0], KVCache(new_cache.k, new_cache.v, cache.lengths + 1)

@@ -1,0 +1,1 @@
+"""TPU compute ops: norms, rotary embeddings, attention, sampling, int8 kernels."""

@@ -1,0 +1,91 @@
+"""Attention against a fixed-capacity HBM-resident KV cache, with GQA.
+
+TPU design notes:
+- The cache is a statically-shaped [batch, max_seq, kv_heads, head_dim] array
+  per layer, preallocated in HBM. Every decode step attends over the full
+  capacity with a validity mask — static shapes keep one compiled XLA program
+  for the whole autoregressive loop (no recompiles, the analog of the
+  reference's per-call ``model.generate`` that re-enters Python each sample,
+  ``Code/C-DAC Server/combiner_fp.py:338-347``).
+- Scores/softmax run in fp32 on the MXU/VPU; activations stay bf16.
+- GQA is expressed as a 5-D einsum (query heads grouped over kv heads) so XLA
+  never materializes repeated K/V.
+- Head-wise sharding of the cache over the mesh's model axis is the
+  HeadInfer-analog (BASELINE.json configs[3]): instead of offloading KV heads
+  to host DRAM like HeadInfer does on small GPUs, each chip keeps only its
+  heads' cache slices in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import nn
+
+NEG_INF = -1e30
+
+
+class LayerKV(NamedTuple):
+    """Single layer's cache slices: k/v are [batch, max_seq, kv_heads, head_dim]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+
+def write_prefill(cache: LayerKV, k: jnp.ndarray, v: jnp.ndarray) -> LayerKV:
+    """Write a right-padded prompt's K/V at offset 0. k/v: [b, s, kh, d]."""
+    seq = k.shape[1]
+    return LayerKV(
+        cache.k.at[:, :seq].set(k.astype(cache.k.dtype)),
+        cache.v.at[:, :seq].set(v.astype(cache.v.dtype)),
+    )
+
+
+def write_decode(cache: LayerKV, k: jnp.ndarray, v: jnp.ndarray, lengths: jnp.ndarray) -> LayerKV:
+    """Scatter one new K/V row per batch element at its current length.
+
+    k/v: [b, 1, kh, d]; lengths: [b] int32 (pre-increment write index).
+    """
+    batch = k.shape[0]
+    b_idx = jnp.arange(batch)
+    return LayerKV(
+        cache.k.at[b_idx, lengths].set(k[:, 0].astype(cache.k.dtype)),
+        cache.v.at[b_idx, lengths].set(v[:, 0].astype(cache.v.dtype)),
+    )
+
+
+def attend(
+    q: jnp.ndarray,  # [b, s, num_heads, head_dim]
+    cache: LayerKV,  # k/v [b, max_seq, kv_heads, head_dim]
+    q_positions: jnp.ndarray,  # [b, s] int32 — absolute position of each query
+    kv_valid: jnp.ndarray,  # [b, max_seq] bool — slots containing real tokens
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Causal attention of queries against the full cache.
+
+    Returns [b, s, num_heads, head_dim] in q's dtype. A cache slot j is visible
+    to query at position p iff it holds a real token and j <= p.
+    """
+    b, s, num_heads, head_dim = q.shape
+    kv_heads = cache.k.shape[2]
+    groups = num_heads // kv_heads
+    scale = scale if scale is not None else head_dim**-0.5
+
+    # Keep q/k/v in their storage dtype (bf16 on TPU → MXU path, no fp32 copy
+    # of the cache in HBM); accumulate the matmuls in fp32 via
+    # preferred_element_type, and do mask/softmax in fp32.
+    qg = q.reshape(b, s, kv_heads, groups, head_dim)
+    scores = jnp.einsum(
+        "bskgd,bmkd->bskgm", qg, cache.k, preferred_element_type=jnp.float32
+    ) * scale
+    max_seq = cache.k.shape[1]
+    slot_pos = jnp.arange(max_seq)[None, None, :]  # [1, 1, m]
+    causal = slot_pos <= q_positions[:, :, None]  # [b, s, m]
+    mask = causal & kv_valid[:, None, :]  # [b, s, m]
+    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
+    weights = nn.softmax(scores, axis=-1).astype(cache.v.dtype)
+    out = jnp.einsum(
+        "bskgm,bmkd->bskgd", weights, cache.v, preferred_element_type=jnp.float32
+    )
+    return out.reshape(b, s, num_heads, head_dim).astype(q.dtype)

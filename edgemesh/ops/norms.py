@@ -1,0 +1,36 @@
+"""Normalization layers as pure functions.
+
+The three supported families split on norm type: Llama uses RMSNorm, Pythia
+(GPT-NeoX) and Phi-2 use LayerNorm with bias. Reductions are done in fp32 and
+cast back, which XLA fuses into the surrounding elementwise chain — one of the
+HBM-bandwidth wins over the reference's eager torch path (which materializes
+each intermediate; reference forward is plain HF ``model.generate``,
+``Code/C-DAC Server/combiner_fp.py:338-347``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    bias: jnp.ndarray,
+    eps: float = 1e-5,
+) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    return (y * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,54 @@
+"""Rotary position embeddings with partial-rotary support.
+
+Family coverage (SURVEY.md §7 hard part (c) — attention layouts differ):
+- Llama: full rotary (fraction 1.0), interleaved GPT-NeoX "half-split" layout.
+- Pythia / GPT-NeoX: rotary_pct 0.25 — only the first quarter of each head dim
+  is rotated.
+- Phi-2: partial rotary (fraction 0.4 of head_dim).
+
+Computed in fp32 for numerical parity with HF, applied in the activation dtype.
+Sin/cos tables are built once per call from positions — under jit this is a
+cheap fused gather, not a host round-trip.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    rotary_dim: int, theta: float = 10000.0
+) -> jnp.ndarray:
+    """Inverse frequencies for the rotated sub-dimension. Shape [rotary_dim//2]."""
+    exponent = jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim
+    return 1.0 / (theta ** exponent)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [batch, seq, heads, head_dim]
+    positions: jnp.ndarray,  # [batch, seq] int32
+    rotary_dim: int,
+    theta: float = 10000.0,
+) -> jnp.ndarray:
+    """Rotate the first ``rotary_dim`` channels of each head; pass the rest through.
+
+    Uses the half-split (NeoX) convention shared by Llama/Pythia/Phi-2 in HF:
+    the rotated block is split into two halves [x1, x2] and mapped to
+    [x1*cos - x2*sin, x2*cos + x1*sin].
+    """
+    dtype = x.dtype
+    inv_freq = rope_frequencies(rotary_dim, theta)  # [rd/2]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [b, s, rd/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [b, s, 1, rd/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    x_rot = x[..., :rotary_dim].astype(jnp.float32)
+    x_pass = x[..., rotary_dim:]
+    half = rotary_dim // 2
+    x1, x2 = x_rot[..., :half], x_rot[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(dtype)
+    if x_pass.shape[-1] == 0:
+        return rotated
+    return jnp.concatenate([rotated, x_pass], axis=-1)

@@ -1,0 +1,110 @@
+"""On-device token sampling: temperature / top-k / top-p / repetition penalty.
+
+Parity target: the exact sampling knob set every reference runner forwards to
+HF ``model.generate`` (``Code/C-DAC Server/combiner_fp.py:338-347``;
+defaults in ``config_2.yaml:11-14``). Unlike the reference — where sampling
+runs inside torch on GPU but the loop returns to Python every call — the whole
+transform here is jit-compatible and lives inside the decode ``lax.scan``/
+``while_loop``, so the token loop never leaves the device.
+
+Repetition penalty follows the CTRL/HF convention: positive logits are divided
+by the penalty, negative multiplied, for every token present in the context.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from edgemesh.config import SamplingParams
+
+NEG_INF = jnp.finfo(jnp.float32).min
+
+
+def apply_repetition_penalty(
+    logits: jnp.ndarray,  # [batch, vocab] float32
+    token_mask: jnp.ndarray,  # [batch, vocab] bool — tokens seen in context
+    penalty: float,
+) -> jnp.ndarray:
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(token_mask, penalized, logits)
+
+
+def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    if k <= 0:
+        return logits
+    vocab = logits.shape[-1]
+    k = min(k, vocab)
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]  # [batch, 1]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering. Keeps the smallest prefix of the sorted distribution
+    whose cumulative probability exceeds ``p`` (always keeping the top token)."""
+    if p >= 1.0:
+        return logits
+    if p <= 0.0:  # degenerate nucleus: keep only the argmax token
+        top = jnp.max(logits, axis=-1, keepdims=True)
+        return jnp.where(logits < top, NEG_INF, logits)
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(probs, axis=-1)
+    # mask sorted positions whose cumulative prob (exclusive) already >= p
+    exclusive = cumprobs - probs
+    sorted_keep = exclusive < p
+    # threshold logit = smallest kept logit
+    threshold = jnp.min(
+        jnp.where(sorted_keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < threshold, NEG_INF, logits)
+
+
+def sample_token(
+    rng: jax.Array,
+    logits: jnp.ndarray,  # [batch, vocab]
+    params: SamplingParams,
+    token_mask: jnp.ndarray | None = None,  # [batch, vocab] bool
+) -> jnp.ndarray:
+    """One sampling step. ``params`` fields are Python scalars → static under jit."""
+    logits = logits.astype(jnp.float32)
+    if params.repetition_penalty != 1.0 and token_mask is not None:
+        logits = apply_repetition_penalty(logits, token_mask, params.repetition_penalty)
+    if not params.do_sample:
+        return jnp.argmax(logits, axis=-1)
+    if params.temperature != 1.0:
+        logits = logits / max(params.temperature, 1e-6)
+    logits = apply_top_k(logits, params.top_k)
+    logits = apply_top_p(logits, params.top_p)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+class TokenMaskState(NamedTuple):
+    """Running [batch, vocab] presence mask for repetition penalty, updated
+    on-device as tokens are emitted."""
+
+    mask: jnp.ndarray
+
+    @staticmethod
+    def init(batch: int, vocab: int) -> "TokenMaskState":
+        return TokenMaskState(jnp.zeros((batch, vocab), dtype=bool))
+
+    def add(self, tokens: jnp.ndarray) -> "TokenMaskState":
+        """tokens: [batch] int32 — mark as seen."""
+        mask = self.mask.at[jnp.arange(tokens.shape[0]), tokens].set(True)
+        return TokenMaskState(mask)
+
+    def add_sequence(self, tokens: jnp.ndarray, valid: jnp.ndarray) -> "TokenMaskState":
+        """tokens: [batch, seq]; valid: [batch, seq] bool — bulk prompt ingest.
+
+        Uses a max-scatter (bool OR) so duplicate (batch, token) indices can
+        only turn the bit ON: with .set, a pad slot sharing its id with a real
+        prompt token could race the True update and drop it (scatter order is
+        unspecified for conflicting indices).
+        """
+        batch, seq = tokens.shape
+        b_idx = jnp.broadcast_to(jnp.arange(batch)[:, None], (batch, seq))
+        mask = self.mask.at[b_idx, tokens].max(valid)
+        return TokenMaskState(mask)

@@ -1,0 +1,3 @@
+"""Inference runtime: generation engine, batching, timing."""
+
+from edgemesh.runtime.generate import GenerateResult, generate  # noqa: F401

@@ -1,0 +1,170 @@
+"""Autoregressive generation: one jitted prefill + one jitted decode loop.
+
+The reference's hot loop re-enters Python for every sample and every token
+(HF ``model.generate`` per question, ``Code/C-DAC Server/combiner_fp.py:338-347``).
+Here the entire token loop is a ``lax.while_loop`` compiled once per
+(model config, sampling config, shapes) triple: the host submits two XLA
+programs per batch — prefill, then the whole decode loop — and only reads back
+the finished token buffer. Early exit when every row has emitted EOS.
+
+Timing: prefill wall time is TTFT (the BASELINE.json latency metric); decode
+wall time / generated tokens is tokens-per-sec, counted over GENERATED tokens
+only — the combiner-runner convention (combiner_fp.py:349), not the
+prompt-inclusive variant of the single-model runners
+(``Code/Base Models/Llama_bf16_updated.py:89-90``, a known reference
+inconsistency recorded in BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from edgemesh.config import SamplingParams
+from edgemesh.models.transformer import (
+    KVCache,
+    ModelConfig,
+    forward_decode,
+    forward_prefill,
+    init_kv_cache,
+)
+from edgemesh.ops.sampling import TokenMaskState, sample_token
+
+
+class GenerateResult(NamedTuple):
+    tokens: jax.Array  # [b, max_new_tokens] int32; padded with pad_id after EOS
+    num_generated: jax.Array  # [b] int32 (includes the EOS token if emitted)
+    prefill_time_s: float
+    decode_time_s: float
+    tokens_per_sec: float  # generated tokens only, whole batch aggregate
+    confidence: jax.Array = None  # [b] mean per-token max softmax prob
+    # (the reference's confidence_score metric, combiner_fp.py:318-325 — there
+    # it needs a SECOND forward pass over the generated text; here it falls out
+    # of the decode loop for free)
+
+
+class _LoopState(NamedTuple):
+    step: jax.Array
+    logits: jax.Array  # [b, vocab] — logits for the NEXT token
+    cache: KVCache
+    rng: jax.Array
+    out: jax.Array  # [b, max_new]
+    finished: jax.Array  # [b] bool
+    num_generated: jax.Array  # [b]
+    token_mask: jax.Array  # [b, vocab] repetition-penalty presence mask
+    conf_sum: jax.Array  # [b] running sum of per-step max softmax prob
+
+
+@partial(jax.jit, static_argnums=(0, 2, 3, 4))
+def _decode_loop(
+    cfg: ModelConfig,
+    params,
+    sampling: SamplingParams,
+    max_new: int,
+    eos_id: int,
+    first_logits: jax.Array,
+    cache: KVCache,
+    token_mask: jax.Array,
+    rng: jax.Array,
+) -> tuple[jax.Array, jax.Array, KVCache]:
+    batch, vocab = first_logits.shape
+
+    def cond(s: _LoopState):
+        return (s.step < max_new) & ~jnp.all(s.finished)
+
+    def body(s: _LoopState):
+        rng, step_rng = jax.random.split(s.rng)
+        mask_state = TokenMaskState(s.token_mask)
+        token = sample_token(step_rng, s.logits, sampling, s.token_mask)
+        token = jnp.where(s.finished, eos_id, token).astype(jnp.int32)
+        out = s.out.at[:, s.step].set(jnp.where(s.finished, s.out[:, s.step], token))
+        step_conf = jnp.max(jax.nn.softmax(s.logits.astype(jnp.float32), axis=-1), axis=-1)
+        conf_sum = s.conf_sum + jnp.where(s.finished, 0.0, step_conf)
+        newly_done = token == eos_id
+        num_generated = s.num_generated + jnp.where(s.finished, 0, 1)
+        finished = s.finished | newly_done
+        token_mask = mask_state.add(token).mask
+        logits, cache = forward_decode(cfg, params, token, s.cache)
+        return _LoopState(
+            s.step + 1, logits, cache, rng, out, finished, num_generated,
+            token_mask, conf_sum,
+        )
+
+    init = _LoopState(
+        step=jnp.array(0, jnp.int32),
+        logits=first_logits,
+        cache=cache,
+        rng=rng,
+        out=jnp.full((batch, max_new), eos_id, jnp.int32),
+        finished=jnp.zeros((batch,), bool),
+        num_generated=jnp.zeros((batch,), jnp.int32),
+        token_mask=token_mask,
+        conf_sum=jnp.zeros((batch,), jnp.float32),
+    )
+    final = jax.lax.while_loop(cond, body, init)
+    confidence = final.conf_sum / jnp.maximum(final.num_generated, 1)
+    return final.out, final.num_generated, final.cache, confidence
+
+
+def generate(
+    cfg: ModelConfig,
+    params,
+    tokens: jax.Array,  # [b, s] right-padded prompts
+    lengths: jax.Array,  # [b] true prompt lengths
+    sampling: SamplingParams,
+    eos_id: int = -1,  # -1 → never matches: generate exactly max_new_tokens
+    rng: jax.Array | None = None,
+    cache: KVCache | None = None,
+) -> GenerateResult:
+    """Generate up to ``sampling.max_new_tokens`` per row.
+
+    Device work is two compiled programs (prefill; whole decode loop). All
+    sampling knobs (temperature/top_k/top_p/repetition_penalty — the reference's
+    full set, config_2.yaml:11-14) execute on device.
+    """
+    batch, prompt_len = tokens.shape
+    max_new = int(sampling.max_new_tokens)
+    needed = prompt_len + max_new
+    if needed > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {prompt_len} + max_new {max_new} exceeds max_seq_len {cfg.max_seq_len}"
+        )
+    if cache is None:
+        cache = init_kv_cache(cfg, batch, needed)
+    elif cache.k.shape[2] < needed:
+        # Out-of-capacity scatter writes would be silently DROPPED under jit
+        # (XLA out-of-bounds scatter semantics) — fail loudly instead.
+        raise ValueError(
+            f"KV cache capacity {cache.k.shape[2]} < prompt {prompt_len} + max_new {max_new}"
+        )
+    rng = rng if rng is not None else jax.random.PRNGKey(sampling.seed)
+
+    t0 = time.perf_counter()
+    first_logits, cache = forward_prefill(cfg, params, tokens, lengths, cache)
+    first_logits.block_until_ready()
+    t1 = time.perf_counter()
+
+    valid = jnp.arange(prompt_len)[None, :] < lengths[:, None]
+    token_mask = (
+        TokenMaskState.init(batch, cfg.vocab_size).add_sequence(tokens, valid).mask
+    )
+    out, num_generated, cache, confidence = _decode_loop(
+        cfg, params, sampling, max_new, int(eos_id), first_logits, cache, token_mask, rng
+    )
+    out.block_until_ready()
+    t2 = time.perf_counter()
+
+    total_generated = int(jnp.sum(num_generated))
+    decode_s = t2 - t1
+    return GenerateResult(
+        tokens=out,
+        num_generated=num_generated,
+        prefill_time_s=t1 - t0,
+        decode_time_s=decode_s,
+        tokens_per_sec=total_generated / decode_s if decode_s > 0 else 0.0,
+        confidence=confidence,
+    )

@@ -1,0 +1,46 @@
+"""Test harness bootstrap: force an 8-device virtual CPU platform BEFORE jax loads.
+
+The reference repo has no test suite at all (SURVEY.md §4); its stand-in was a
+1,000-sample golden-metric sweep on real hardware. Here every distributed code
+path (DP/TP/PP/SP collectives over a Mesh) runs in CI on emulated devices, per
+the strategy in SURVEY.md §4/§7.8.
+"""
+
+import os
+
+# Must happen before the first jax BACKEND INIT anywhere in the test process.
+# The session image's sitecustomize registers the axon (remote-TPU-tunnel) PJRT
+# plugin and force-updates jax_platforms to "axon,cpu" — overriding the
+# JAX_PLATFORMS env var — so the env alone is not enough: any jax op would
+# dial the TPU pool and block. Reset the config to cpu AFTER import (backends
+# initialize lazily, so this wins as long as it runs before the first op).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from edgemesh.parallel.mesh import build_mesh
+
+    return build_mesh(dp=2, tp=4)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,58 @@
+"""Config system: YAML round-trip and the `is not None` override semantics
+(the correct reference idiom, combiner_fp.py:404-410 — NOT the falsy-dropping
+`or` variant of Llama_bf16_updated.py:154-161)."""
+
+import textwrap
+
+from edgemesh.config import EdgeMeshConfig, load_config
+
+
+def test_defaults_match_reference_sampling_knobs():
+    cfg = EdgeMeshConfig()
+    # config_2.yaml:11-14
+    s = cfg.agents[0].sampling if cfg.agents else __import__("edgemesh.config", fromlist=["SamplingParams"]).SamplingParams()
+    assert s.max_new_tokens == 100
+    assert s.temperature == 0.7
+    assert s.top_k == 50
+    assert s.top_p == 0.9
+    assert s.repetition_penalty == 1.2
+
+
+def test_yaml_load_and_agents(tmp_path):
+    yaml_text = textwrap.dedent(
+        """
+        seed: 7
+        mesh: {dp: 2, tp: 4}
+        agents:
+          - role: qa
+            model: {path: /m/phi, family: phi2, precision: int8}
+            sampling: {max_new_tokens: 64, temperature: 0.5}
+          - role: refiner
+            model: {path: /m/llama, family: llama}
+        """
+    )
+    p = tmp_path / "c.yaml"
+    p.write_text(yaml_text)
+    cfg = load_config(p)
+    assert cfg.seed == 7
+    assert cfg.mesh.dp == 2 and cfg.mesh.tp == 4 and cfg.mesh.num_devices == 8
+    assert len(cfg.agents) == 2
+    assert cfg.agents[0].model.family == "phi2"
+    assert cfg.agents[0].model.precision == "int8"
+    assert cfg.agents[0].sampling.max_new_tokens == 64
+    assert cfg.agents[1].role == "refiner"
+
+
+def test_override_semantics_none_vs_falsy(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("seed: 5\nmesh: {tp: 4}\n")
+    # None → YAML value kept
+    cfg = load_config(p, {"seed": None})
+    assert cfg.seed == 5
+    # Falsy-but-not-None MUST override (the reference's `or` idiom loses this)
+    cfg = load_config(p, {"seed": 0})
+    assert cfg.seed == 0
+    # dotted path into nested dataclass
+    cfg = load_config(p, {"mesh.tp": 2, "eval.num_samples": 10})
+    assert cfg.mesh.tp == 2
+    assert cfg.eval.num_samples == 10

@@ -1,0 +1,66 @@
+"""Eval harness: zero-fill policy, JSONL persistence, resume, aggregation."""
+
+import json
+
+from edgemesh.eval.data import QASample, load_qa_csv
+from edgemesh.eval.harness import aggregate, run_eval
+
+
+def _samples(n=4):
+    return [QASample(i, f"question {i}?", f"answer {i}") for i in range(n)]
+
+
+def test_run_eval_aggregates_and_persists(tmp_path):
+    out = tmp_path / "r.jsonl"
+
+    def answer_fn(q):
+        return {"answer": q.replace("question", "answer").rstrip("?"), "tps": 10.0}
+
+    report = run_eval(_samples(), answer_fn, out, resume=False)
+    assert report["num_samples"] == 4
+    assert report["rouge1"] > 0.5  # "answer i" vs "answer i"
+    assert report["tps"] == 10.0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(rows) == 4
+
+
+def test_zero_fill_on_error(tmp_path):
+    def answer_fn(q):
+        if "2" in q:
+            raise RuntimeError("boom")
+        return {"answer": "answer"}
+
+    report = run_eval(_samples(), answer_fn, tmp_path / "r.jsonl", resume=False)
+    assert report["num_samples"] == 4  # failed sample zero-filled, run continued
+    rows = [json.loads(l) for l in (tmp_path / "r.jsonl").read_text().splitlines()]
+    bad = [r for r in rows if "error" in r]
+    assert len(bad) == 1 and bad[0]["rouge1"] == 0.0
+
+
+def test_resume_skips_done(tmp_path):
+    out = tmp_path / "r.jsonl"
+    calls = []
+
+    def answer_fn(q):
+        calls.append(q)
+        return {"answer": "a"}
+
+    run_eval(_samples(2), answer_fn, out, resume=True)
+    assert len(calls) == 2
+    run_eval(_samples(4), answer_fn, out, resume=True)
+    assert len(calls) == 4  # only the 2 new samples were answered
+
+
+def test_aggregate_ignores_missing_keys():
+    rows = [{"rouge1": 1.0, "bleu": 0.5}, {"rouge1": 0.0}]
+    rep = aggregate(rows)
+    assert rep["rouge1"] == 0.5
+    assert rep["bleu"] == 0.5
+
+
+def test_load_reference_csv():
+    samples = load_qa_csv(
+        "/root/reference/Code/Dataset/natural_questions_1000.csv", limit=5
+    )
+    assert len(samples) == 5
+    assert samples[0].question and samples[0].answer

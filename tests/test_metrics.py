@@ -1,0 +1,86 @@
+"""Metric suite correctness on hand-computable cases."""
+
+import numpy as np
+import pytest
+
+from edgemesh.eval.metrics import (
+    HashingEmbedder,
+    bertscore,
+    bleu,
+    cosine_similarity,
+    rouge_scores,
+    tokenize,
+)
+
+
+def test_tokenize_and_stem():
+    assert tokenize("The Cats are running!", stem=False) == ["the", "cats", "are", "running"]
+    toks = tokenize("running runs", stem=True)
+    assert toks[0] == toks[1] == "run"
+
+
+def test_rouge_identical():
+    s = rouge_scores("the cat sat on the mat", "the cat sat on the mat")
+    assert s["rouge1"] == pytest.approx(1.0)
+    assert s["rouge2"] == pytest.approx(1.0)
+    assert s["rougeL"] == pytest.approx(1.0)
+    assert s["avg_rouge"] == pytest.approx(1.0)
+
+
+def test_rouge_disjoint():
+    s = rouge_scores("alpha beta gamma", "delta epsilon zeta")
+    assert s["rouge1"] == 0.0 and s["rouge2"] == 0.0 and s["rougeL"] == 0.0
+
+
+def test_rouge1_hand_computed():
+    # pred: "a b c"  ref: "a b d"  → unigram matches 2; P=R=2/3 → F1=2/3
+    s = rouge_scores("a b c", "a b d", stem=False)
+    assert s["rouge1"] == pytest.approx(2 / 3)
+    # bigrams: pred {ab, bc}, ref {ab, bd} → 1 match; P=R=1/2
+    assert s["rouge2"] == pytest.approx(1 / 2)
+    # LCS "a b" len 2 → F1 = 2/3
+    assert s["rougeL"] == pytest.approx(2 / 3)
+
+
+def test_rougeL_subsequence_not_substring():
+    # LCS of "a x b y c" vs "a b c" is "a b c" (len 3): P=3/5, R=1 → F1=0.75
+    s = rouge_scores("a x b y c", "a b c", stem=False)
+    assert s["rougeL"] == pytest.approx(2 * (3 / 5) * 1.0 / (3 / 5 + 1.0))
+
+
+def test_bleu_identical_and_disjoint():
+    assert bleu("the cat sat on the mat down", "the cat sat on the mat down") == pytest.approx(1.0)
+    assert bleu("alpha beta gamma delta", "epsilon zeta eta theta") == 0.0
+
+
+def test_bleu_brevity_penalty():
+    # prediction shorter than reference → BP < 1 even with perfect precision
+    full = "a b c d e f g h"
+    short = "a b c d e f"
+    assert 0 < bleu(short, full) < 1.0
+
+
+def test_cosine_bounds_and_symmetry():
+    emb = HashingEmbedder()
+    same = cosine_similarity("hello world", "hello world", emb)
+    diff = cosine_similarity("hello world", "quantum flapjacks", emb)
+    assert same == pytest.approx(1.0, abs=1e-9)
+    assert -1.0 <= diff < same
+
+
+def test_bertscore_identical_is_one():
+    s = bertscore("the cat sat", "the cat sat")
+    assert s["f1"] == pytest.approx(1.0, abs=1e-9)
+    assert s["precision"] == pytest.approx(1.0, abs=1e-9)
+
+
+def test_bertscore_partial():
+    s = bertscore("the cat sat", "the dog sat")
+    assert 0.0 < s["f1"] < 1.0
+
+
+def test_hashing_embedder_deterministic():
+    e1, e2 = HashingEmbedder(), HashingEmbedder()
+    v1 = e1(["some text here"])
+    v2 = e2(["some text here"])
+    np.testing.assert_array_equal(v1, v2)

@@ -1,0 +1,100 @@
+"""Model forward correctness: shapes, per-family dialects, and the load-bearing
+invariant that incremental decode through the KV cache reproduces full-prompt
+prefill logits (this is what the reference never tests — SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edgemesh.config import SamplingParams
+from edgemesh.models import init_kv_cache, init_params
+from edgemesh.models.families import tiny_config
+from edgemesh.models.transformer import forward_decode, forward_prefill
+from edgemesh.runtime import generate
+
+FAMILIES = ["llama", "neox", "phi2"]
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_prefill_shapes(family):
+    cfg = tiny_config(family)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    batch, seq = 2, 10
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+    lengths = jnp.array([10, 7])
+    cache = init_kv_cache(cfg, batch, 32)
+    logits, cache = forward_prefill(cfg, params, tokens, lengths, cache)
+    assert logits.shape == (batch, cfg.vocab_size)
+    assert jnp.all(jnp.isfinite(logits))
+    assert cache.lengths.tolist() == [10, 7]
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_incremental_decode_matches_prefill(family):
+    """Prefill logits at position t must equal decode-step logits after feeding
+    tokens 0..t-1 one at a time through the cache."""
+    cfg = tiny_config(family)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    seq = 9
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, seq), 0, cfg.vocab_size)
+
+    # Ground truth: full prefill over the first t tokens, for each t.
+    full_cache = init_kv_cache(cfg, 1, 32)
+    ref_logits, _ = forward_prefill(
+        cfg, params, tokens, jnp.array([seq]), full_cache
+    )
+
+    # Incremental: prefill 1 token, then decode the rest.
+    cache = init_kv_cache(cfg, 1, 32)
+    logits, cache = forward_prefill(cfg, params, tokens[:, :1], jnp.array([1]), cache)
+    for t in range(1, seq):
+        logits, cache = forward_decode(cfg, params, tokens[:, t], cache)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_right_padding_invariance():
+    """Rows padded to different amounts must produce identical last-token logits."""
+    cfg = tiny_config("llama")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(3), (1, 6), 0, cfg.vocab_size)
+    short = forward_prefill(
+        cfg, params, toks, jnp.array([6]), init_kv_cache(cfg, 1, 32)
+    )[0]
+    padded = jnp.pad(toks, ((0, 0), (0, 4)))  # pad with zeros to length 10
+    long = forward_prefill(
+        cfg, params, padded, jnp.array([6]), init_kv_cache(cfg, 1, 32)
+    )[0]
+    np.testing.assert_allclose(np.asarray(short), np.asarray(long), rtol=1e-5, atol=1e-5)
+
+
+def test_generate_greedy_deterministic_and_eos():
+    cfg = tiny_config("llama")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 5), 0, cfg.vocab_size)
+    lengths = jnp.array([5, 3])
+    sampling = SamplingParams(max_new_tokens=8, do_sample=False, repetition_penalty=1.0)
+    r1 = generate(cfg, params, tokens, lengths, sampling)
+    r2 = generate(cfg, params, tokens, lengths, sampling)
+    assert r1.tokens.shape == (2, 8)
+    np.testing.assert_array_equal(np.asarray(r1.tokens), np.asarray(r2.tokens))
+    assert int(jnp.sum(r1.num_generated)) == 16
+    assert r1.tokens_per_sec > 0
+
+    # With eos_id = the model's first greedy token, generation stops after 1.
+    first = int(r1.tokens[0, 0])
+    r3 = generate(cfg, params, tokens, lengths, sampling, eos_id=first)
+    assert int(r3.num_generated[0]) == 1
+
+
+def test_generate_sampled_reproducible_with_seed():
+    cfg = tiny_config("neox")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (1, 4), 0, cfg.vocab_size)
+    lengths = jnp.array([4])
+    sampling = SamplingParams(max_new_tokens=6, do_sample=True, seed=42)
+    r1 = generate(cfg, params, tokens, lengths, sampling)
+    r2 = generate(cfg, params, tokens, lengths, sampling)
+    np.testing.assert_array_equal(np.asarray(r1.tokens), np.asarray(r2.tokens))

@@ -1,0 +1,61 @@
+"""Sampling transforms: top-k, top-p, repetition penalty, greedy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from edgemesh.config import SamplingParams
+from edgemesh.ops.sampling import (
+    NEG_INF,
+    apply_repetition_penalty,
+    apply_top_k,
+    apply_top_p,
+    sample_token,
+)
+
+
+def test_top_k_keeps_exactly_k():
+    logits = jnp.array([[1.0, 5.0, 3.0, 2.0, 4.0]])
+    out = apply_top_k(logits, 2)
+    kept = np.asarray(out[0] > NEG_INF / 2)
+    assert kept.tolist() == [False, True, False, False, True]
+
+
+def test_top_p_keeps_minimal_nucleus():
+    # probs ~ [0.6, 0.3, 0.1] → p=0.8 keeps the first two
+    logits = jnp.log(jnp.array([[0.6, 0.3, 0.1]]))
+    out = apply_top_p(logits, 0.8)
+    kept = np.asarray(out[0] > NEG_INF / 2)
+    assert kept.tolist() == [True, True, False]
+
+
+def test_top_p_always_keeps_top_token():
+    logits = jnp.log(jnp.array([[0.97, 0.02, 0.01]]))
+    out = apply_top_p(logits, 0.5)
+    kept = np.asarray(out[0] > NEG_INF / 2)
+    assert kept.tolist() == [True, False, False]
+
+
+def test_repetition_penalty_sign_convention():
+    # HF/CTRL convention: positive logits divided, negative multiplied.
+    logits = jnp.array([[2.0, -2.0, 2.0]])
+    mask = jnp.array([[True, True, False]])
+    out = apply_repetition_penalty(logits, mask, 2.0)
+    np.testing.assert_allclose(np.asarray(out[0]), [1.0, -4.0, 2.0])
+
+
+def test_greedy_ignores_rng():
+    logits = jnp.array([[0.1, 9.0, 0.2]])
+    p = SamplingParams(do_sample=False, repetition_penalty=1.0)
+    t1 = sample_token(jax.random.PRNGKey(0), logits, p)
+    t2 = sample_token(jax.random.PRNGKey(1), logits, p)
+    assert int(t1[0]) == int(t2[0]) == 1
+
+
+def test_sampled_respects_top_k1():
+    # top_k=1 == greedy regardless of temperature.
+    logits = jnp.array([[0.1, 9.0, 0.2, 3.0]])
+    p = SamplingParams(do_sample=True, top_k=1, temperature=5.0, top_p=1.0, repetition_penalty=1.0)
+    for seed in range(5):
+        t = sample_token(jax.random.PRNGKey(seed), logits, p)
+        assert int(t[0]) == 1
