@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Driver benchmark entry: prints ONE JSON line
+{"metric", "value", "unit", "vs_baseline"}.
+
+Runs on the real TPU chip (axon platform — do NOT force cpu here). Measures
+int8 decode tokens/sec on a Llama-3.2-1B-shaped model, compared against the
+reference's published 25.83 tok/s for the same model quantized on A100
+(BASELINE.md Table 3).
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    from edgemesh.benchmarks import decode_benchmark
+
+    result = decode_benchmark()
+    print(
+        json.dumps(
+            {
+                "metric": result["metric"],
+                "value": result["value"],
+                "unit": result["unit"],
+                "vs_baseline": result["vs_baseline"],
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+"""Multi-agent orchestration (the reference's L4 / core contribution)."""
+
+from edgemesh.agents.orchestrator import (  # noqa: F401
+    Agent,
+    Ensemble,
+    build_agent,
+    build_ensemble,
+)

@@ -1,0 +1,92 @@
+"""Decode-throughput microbenchmark.
+
+Measures the BASELINE.json headline (decode tokens/sec/chip) on a
+Llama-3.2-1B-shaped model — the same architecture the reference benchmarks on
+A100 (BASELINE.md Table 3: bf16 51.84 tok/s, int8 25.83 tok/s — int8 2×
+SLOWER there; the bar this module exists to beat is int8 ≥ bf16 on TPU).
+
+Random weights: throughput is weight-value-independent; quality numbers come
+from the eval harness with real checkpoints, never from here.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from edgemesh.config import SamplingParams
+from edgemesh.models.families import config_for_family
+from edgemesh.models.transformer import init_params
+from edgemesh.ops.int8 import quantize_params
+from edgemesh.runtime import generate
+
+# Reference numbers (BASELINE.md Table 3, A100 40GB, generated-tokens/sec).
+REFERENCE_TOK_S = {"bf16": 51.84, "int8": 25.83}
+
+PRESETS = {
+    # Llama-3.2-1B-Instruct architecture (HF config) — the reference's refiner
+    # model and its published single-model rows.
+    "llama1b": dict(
+        vocab_size=128256, hidden_size=2048, num_layers=16, num_heads=32,
+        num_kv_heads=8, intermediate_size=8192, max_seq_len=2048,
+        tie_embeddings=True,
+    ),
+    # CI-sized smoke preset.
+    "tiny": dict(
+        vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=256, max_seq_len=512, dtype="float32",
+    ),
+}
+
+
+def decode_benchmark(
+    preset: str | None = None,
+    precision: str | None = None,
+    batch: int = 8,
+    prompt_len: int = 32,
+    decode_steps: int = 128,
+    repeats: int = 3,
+) -> dict[str, Any]:
+    preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
+    precision = precision or os.environ.get("EDGEMESH_BENCH_PRECISION", "int8")
+    cfg = config_for_family("llama", **PRESETS[preset])
+    if preset != "tiny":
+        cfg = cfg.replace(dtype="bfloat16")
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    if precision == "int8":
+        params = quantize_params(params)
+        params = jax.tree.map(lambda x: jax.device_put(x), params)
+
+    sampling = SamplingParams(
+        max_new_tokens=decode_steps, temperature=0.7, top_k=50, top_p=0.9,
+        repetition_penalty=1.2, do_sample=True,
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size, jnp.int32
+    )
+    lengths = jnp.full((batch,), prompt_len, jnp.int32)
+
+    # Warmup compiles prefill + decode loop; then take the best of `repeats`.
+    generate(cfg, params, tokens, lengths, sampling)
+    best_tps, best_ttft = 0.0, float("inf")
+    for _ in range(repeats):
+        r = generate(cfg, params, tokens, lengths, sampling)
+        total = int(jnp.sum(r.num_generated))
+        tps = total / r.decode_time_s
+        best_tps = max(best_tps, tps)
+        best_ttft = min(best_ttft, r.prefill_time_s)
+
+    baseline = REFERENCE_TOK_S.get(precision, REFERENCE_TOK_S["bf16"])
+    return {
+        "metric": f"decode_tok_s_llama3.2-1b_{precision}_b{batch}",
+        "value": round(best_tps, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(best_tps / baseline, 3),
+        "ttft_s": round(best_ttft, 4),
+        "decode_steps": decode_steps,
+    }

@@ -1,0 +1,110 @@
+"""edgemesh CLI: one entry point replacing the reference's eight copy-pasted
+runner mains (C1-C8 in SURVEY.md §2.1).
+
+Subcommands:
+- ``eval``     — golden-dataset evaluation (the combiner/single-model runners)
+- ``serve``    — REST front door (rest_api.py parity)
+- ``bench``    — decode-throughput microbenchmark (prints one JSON line)
+- ``download`` — checkpoint inventory check (downloader parity, offline-gated)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from edgemesh.config import EdgeMeshConfig, build_arg_parser, load_config
+
+
+def _setup_logging(cfg: EdgeMeshConfig):
+    logging.basicConfig(
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+
+def cmd_eval(cfg: EdgeMeshConfig) -> int:
+    from edgemesh.agents import build_ensemble
+    from edgemesh.eval.data import load_qa_csv
+    from edgemesh.eval.harness import run_eval
+
+    ensemble = build_ensemble(cfg)
+    samples = load_qa_csv(cfg.eval.dataset_path, limit=cfg.eval.num_samples)
+    report = run_eval(
+        samples,
+        ensemble.answer,
+        output_jsonl=cfg.eval.output_jsonl,
+        resume=cfg.eval.resume,
+        metrics=cfg.eval.metrics,
+    )
+    print(json.dumps(report))
+    return 0
+
+
+def cmd_serve(cfg: EdgeMeshConfig, port: int) -> int:
+    from edgemesh.agents import build_ensemble
+    from edgemesh.serve import serve_rest
+
+    ensemble = build_ensemble(cfg)
+    serve_rest(ensemble, port=port)
+    return 0
+
+
+def cmd_bench(cfg: EdgeMeshConfig) -> int:
+    from edgemesh.benchmarks import decode_benchmark
+
+    print(json.dumps(decode_benchmark()))
+    return 0
+
+
+def cmd_download(cfg: EdgeMeshConfig) -> int:
+    """Offline analog of the reference's downloaders (download.py:20-47):
+    verifies each configured checkpoint directory is complete."""
+    from pathlib import Path
+
+    ok = True
+    for agent in cfg.agents:
+        path = agent.model.path
+        if not path:
+            print(f"{agent.role}: synthetic model (no checkpoint)")
+            continue
+        p = Path(path)
+        has_cfg = (p / "config.json").exists()
+        has_weights = any(p.glob("*.safetensors")) or (p / "pytorch_model.bin").exists()
+        status = "ok" if (has_cfg and has_weights) else "MISSING"
+        ok &= status == "ok"
+        print(f"{agent.role}: {path} [{status}]")
+    if not ok:
+        print(
+            "note: this environment has no network egress; place HF checkpoints "
+            "locally (save_pretrained format) and point agents[].model.path at them."
+        )
+    return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    top = argparse.ArgumentParser(prog="edgemesh")
+    top.add_argument("command", choices=["eval", "serve", "bench", "download"])
+    top.add_argument("--port", type=int, default=8000)
+    cmd_args, rest = top.parse_known_args(argv)
+
+    parser = build_arg_parser()
+    args, _ = parser.parse_known_args(rest)
+    overrides = {k: v for k, v in vars(args).items() if k != "config"}
+    cfg = load_config(args.config, overrides)
+    _setup_logging(cfg)
+
+    if cmd_args.command == "eval":
+        return cmd_eval(cfg)
+    if cmd_args.command == "serve":
+        return cmd_serve(cfg, cmd_args.port)
+    if cmd_args.command == "bench":
+        return cmd_bench(cfg)
+    return cmd_download(cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,13 +41,25 @@ METRIC_KEYS = [
 ]
 
 
-def score_sample(prediction: str, reference: str, embedder=None) -> dict[str, float]:
+def score_sample(
+    prediction: str, reference: str, embedder=None, metrics: list[str] | None = None
+) -> dict[str, float]:
+    """Score one prediction. ``metrics`` (None = all) selects which metric
+    families actually run, so e.g. dropping bertscore/cosine skips the
+    embedding work entirely."""
+    want = set(metrics) if metrics is not None else set(METRIC_KEYS)
     embedder = embedder or _default_embedder()
     row: dict[str, float] = {}
-    row.update(rouge_scores(prediction, reference))
-    row["bleu"] = bleu(prediction, reference)
-    row["cosine"] = cosine_similarity(prediction, reference, embedder)
-    row["bertscore"] = bertscore(prediction, reference, getattr(embedder, "embed_tokens", None))["f1"]
+    if want & {"rouge1", "rouge2", "rougeL", "avg_rouge"}:
+        row.update(rouge_scores(prediction, reference))
+    if "bleu" in want:
+        row["bleu"] = bleu(prediction, reference)
+    if "cosine" in want:
+        row["cosine"] = cosine_similarity(prediction, reference, embedder)
+    if "bertscore" in want:
+        row["bertscore"] = bertscore(
+            prediction, reference, getattr(embedder, "embed_tokens", None)
+        )["f1"]
     return row
 
 
@@ -80,19 +92,38 @@ def run_eval(
     resume: bool = True,
     embedder=None,
     log_every: int = 25,
+    metrics: list[str] | None = None,
 ) -> dict[str, float]:
     """Evaluate ``answer_fn`` over ``samples``; returns the aggregate-mean
     report (the analog of the reference's final np.mean block,
-    combiner_fp.py:465-474)."""
+    combiner_fp.py:465-474).
+
+    Resume only reuses a persisted row when its question matches the current
+    sample (a results.jsonl left over from a DIFFERENT dataset/run is
+    re-answered, not silently merged), and the report aggregates exactly the
+    rows of THIS sample list.
+    """
     out_path = Path(output_jsonl)
     done = _load_done(out_path) if resume else {}
-    if done:
-        log.info("resuming: %d/%d samples already scored", len(done), len(samples))
+    reused = {
+        s.index
+        for s in samples
+        if s.index in done and done[s.index].get("question") == s.question
+    }
+    if done and len(reused) < len(done):
+        log.warning(
+            "%d persisted rows do not match the current dataset and will be re-answered",
+            len(done) - len(reused),
+        )
+    if reused:
+        log.info("resuming: %d/%d samples already scored", len(reused), len(samples))
 
     t_start = time.perf_counter()
+    rows: dict[int, dict] = {i: done[i] for i in reused}
+    n_scored = len(rows)
     with open(out_path, "a" if resume else "w") as sink:
         for sample in samples:
-            if sample.index in done:
+            if sample.index in reused:
                 continue
             row: dict[str, Any] = {"index": sample.index, "question": sample.question}
             try:
@@ -105,25 +136,26 @@ def run_eval(
                     {
                         k: v
                         for k, v in score_sample(
-                            row["answer"], sample.answer, embedder
+                            row["answer"], sample.answer, embedder, metrics
                         ).items()
                         if k not in row
                     }
                 )
             except Exception as exc:  # zero-fill policy (combiner_fp.py:448-454)
                 log.warning("sample %d failed: %s", sample.index, exc)
-                row.update({k: 0.0 for k in METRIC_KEYS})
+                row.update({k: 0.0 for k in (metrics or METRIC_KEYS)})
                 row.setdefault("answer", "")
                 row["error"] = str(exc)
             sink.write(json.dumps(row) + "\n")
             sink.flush()
-            done[sample.index] = row
-            if (len(done) % log_every) == 0:
-                log.info("scored %d/%d", len(done), len(samples))
+            rows[sample.index] = row
+            n_scored += 1
+            if (n_scored % log_every) == 0:
+                log.info("scored %d/%d", n_scored, len(samples))
 
-    report = aggregate(list(done.values()))
+    report = aggregate(list(rows.values()))
     report["wall_time_s"] = time.perf_counter() - t_start
-    report["num_samples"] = len(done)
+    report["num_samples"] = len(rows)
     return report
 
 

@@ -1,0 +1,273 @@
+"""HF-checkpoint → edgemesh param pytree ingestion (host-side, no torch on TPU).
+
+Replaces the reference's ``AutoModelForCausalLM.from_pretrained(...,
+device_map="auto")`` loaders (``Code/C-DAC Server/combiner_fp.py:274-284``)
+with: read safetensors straight into numpy, remap names per family, stack the
+layer axis, and ``jax.device_put`` the tree into (sharded) HBM
+(edgemesh.parallel.sharding.shard_params — the BASELINE.json north star's
+"materialises weights directly into HBM via jax.device_put").
+
+Name maps cover the reference's three model families (ACL paper §4.2):
+Llama (Llama-3.2-1B-Instruct), GPT-NeoX (Pythia-1B), Phi (Phi-2).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from edgemesh.models.families import sniff_family
+from edgemesh.models.transformer import ModelConfig
+from edgemesh.models.families import config_for_family
+
+Params = dict[str, Any]
+
+
+def _load_raw_tensors(ckpt: Path) -> dict[str, np.ndarray]:
+    """Read all tensors from safetensors (single or index-sharded) or a
+    pytorch_model.bin fallback, as numpy."""
+    from safetensors import safe_open
+
+    files: list[Path]
+    index = ckpt / "model.safetensors.index.json"
+    single = ckpt / "model.safetensors"
+    if index.exists():
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        files = sorted({ckpt / fname for fname in weight_map.values()})
+    elif single.exists():
+        files = [single]
+    else:
+        st_files = sorted(ckpt.glob("*.safetensors"))
+        if st_files:
+            files = st_files
+        else:
+            bin_path = ckpt / "pytorch_model.bin"
+            if bin_path.exists():
+                import torch
+
+                sd = torch.load(bin_path, map_location="cpu", weights_only=True)
+                return {k: v.float().numpy() if v.dtype == torch.bfloat16 else v.numpy() for k, v in sd.items()}
+            raise FileNotFoundError(f"no safetensors/bin weights under {ckpt}")
+
+    out: dict[str, np.ndarray] = {}
+    for fpath in files:
+        with safe_open(fpath, framework="np") as f:
+            for key in f.keys():
+                out[key] = f.get_tensor(key)
+    return out
+
+
+def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
+    """Build a ModelConfig from the checkpoint's HF config.json."""
+    ckpt = Path(ckpt)
+    family = sniff_family(ckpt)
+    with open(ckpt / "config.json") as f:
+        hf = json.load(f)
+
+    if family == "llama":
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            intermediate_size=hf["intermediate_size"],
+            max_seq_len=min(hf.get("max_position_embeddings", 4096), 8192),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=hf.get("rms_norm_eps", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+        )
+    elif family == "neox":
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf["num_attention_heads"],
+            intermediate_size=hf["intermediate_size"],
+            max_seq_len=min(hf.get("max_position_embeddings", 2048), 8192),
+            rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
+            rotary_fraction=float(hf.get("rotary_pct", 0.25)),
+            norm_eps=hf.get("layer_norm_eps", 1e-5),
+            parallel_block=bool(hf.get("use_parallel_residual", True)),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+        )
+    elif family == "phi2":
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads") or hf["num_attention_heads"],
+            intermediate_size=hf["intermediate_size"],
+            max_seq_len=min(hf.get("max_position_embeddings", 2048), 8192),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rotary_fraction=float(hf.get("partial_rotary_factor", 0.4)),
+            norm_eps=hf.get("layer_norm_eps", 1e-5),
+        )
+    else:  # pragma: no cover
+        raise ValueError(family)
+    kw.update(overrides)
+    return config_for_family(family, **kw)
+
+
+def _stack(arrs: list[np.ndarray], dtype) -> jnp.ndarray:
+    return jnp.asarray(np.stack(arrs), dtype=dtype)
+
+
+def _dense_from_torch(w: np.ndarray, b: np.ndarray | None) -> tuple[np.ndarray, np.ndarray | None]:
+    """torch nn.Linear stores [out, in]; edgemesh kernels are [in, out]."""
+    return np.ascontiguousarray(w.T), b
+
+
+def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) -> tuple[ModelConfig, Params]:
+    """Load an HF checkpoint directory into (ModelConfig, stacked param tree)."""
+    ckpt = Path(ckpt)
+    family = sniff_family(ckpt)
+    cfg = cfg or config_from_checkpoint(ckpt)
+    dtype = dtype or cfg.activation_dtype
+    raw = _load_raw_tensors(ckpt)
+
+    if family == "llama":
+        params = _map_llama(raw, cfg, dtype)
+    elif family == "neox":
+        params = _map_neox(raw, cfg, dtype)
+    else:
+        params = _map_phi2(raw, cfg, dtype)
+    return cfg, params
+
+
+# -- per-family name maps ----------------------------------------------------
+
+
+def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
+    L = cfg.num_layers
+
+    def layer_stack(fmt: str, transpose: bool) -> jnp.ndarray:
+        mats = [raw[fmt.format(i)] for i in range(L)]
+        if transpose:
+            mats = [np.ascontiguousarray(m.T) for m in mats]
+        return _stack(mats, dtype)
+
+    layers: Params = {
+        "attn_norm": {"scale": layer_stack("model.layers.{}.input_layernorm.weight", False)},
+        "mlp_norm": {"scale": layer_stack("model.layers.{}.post_attention_layernorm.weight", False)},
+        "q": {"kernel": layer_stack("model.layers.{}.self_attn.q_proj.weight", True)},
+        "k": {"kernel": layer_stack("model.layers.{}.self_attn.k_proj.weight", True)},
+        "v": {"kernel": layer_stack("model.layers.{}.self_attn.v_proj.weight", True)},
+        "o": {"kernel": layer_stack("model.layers.{}.self_attn.o_proj.weight", True)},
+        "gate": {"kernel": layer_stack("model.layers.{}.mlp.gate_proj.weight", True)},
+        "up": {"kernel": layer_stack("model.layers.{}.mlp.up_proj.weight", True)},
+        "down": {"kernel": layer_stack("model.layers.{}.mlp.down_proj.weight", True)},
+    }
+    params: Params = {
+        "embed": {"weight": jnp.asarray(raw["model.embed_tokens.weight"], dtype)},
+        "layers": layers,
+        "final_norm": {"scale": jnp.asarray(raw["model.norm.weight"], dtype)},
+    }
+    if not cfg.tie_embeddings and "lm_head.weight" in raw:
+        params["lm_head"] = {"kernel": jnp.asarray(np.ascontiguousarray(raw["lm_head.weight"].T), dtype)}
+    return params
+
+
+def _map_neox(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
+    L, nh, hd, h = cfg.num_layers, cfg.num_heads, cfg.head_size, cfg.hidden_size
+
+    def split_qkv(i: int) -> tuple[np.ndarray, ...]:
+        """NeoX fuses qkv head-major: rows are [head0: q|k|v, head1: q|k|v, …]."""
+        w = raw[f"gpt_neox.layers.{i}.attention.query_key_value.weight"]  # [3*h, h]
+        b = raw[f"gpt_neox.layers.{i}.attention.query_key_value.bias"]  # [3*h]
+        w = w.reshape(nh, 3, hd, h)
+        b = b.reshape(nh, 3, hd)
+        qw, kw, vw = (np.ascontiguousarray(w[:, j].reshape(nh * hd, h).T) for j in range(3))
+        qb, kb, vb = (np.ascontiguousarray(b[:, j].reshape(nh * hd)) for j in range(3))
+        return qw, kw, vw, qb, kb, vb
+
+    qkv = [split_qkv(i) for i in range(L)]
+
+    def layer_stack(fmt: str, transpose: bool) -> jnp.ndarray:
+        mats = [raw[fmt.format(i)] for i in range(L)]
+        if transpose:
+            mats = [np.ascontiguousarray(m.T) for m in mats]
+        return _stack(mats, dtype)
+
+    layers: Params = {
+        "attn_norm": {
+            "scale": layer_stack("gpt_neox.layers.{}.input_layernorm.weight", False),
+            "bias": layer_stack("gpt_neox.layers.{}.input_layernorm.bias", False),
+        },
+        "mlp_norm": {
+            "scale": layer_stack("gpt_neox.layers.{}.post_attention_layernorm.weight", False),
+            "bias": layer_stack("gpt_neox.layers.{}.post_attention_layernorm.bias", False),
+        },
+        "q": {"kernel": _stack([t[0] for t in qkv], dtype), "bias": _stack([t[3] for t in qkv], dtype)},
+        "k": {"kernel": _stack([t[1] for t in qkv], dtype), "bias": _stack([t[4] for t in qkv], dtype)},
+        "v": {"kernel": _stack([t[2] for t in qkv], dtype), "bias": _stack([t[5] for t in qkv], dtype)},
+        "o": {
+            "kernel": layer_stack("gpt_neox.layers.{}.attention.dense.weight", True),
+            "bias": layer_stack("gpt_neox.layers.{}.attention.dense.bias", False),
+        },
+        "up": {
+            "kernel": layer_stack("gpt_neox.layers.{}.mlp.dense_h_to_4h.weight", True),
+            "bias": layer_stack("gpt_neox.layers.{}.mlp.dense_h_to_4h.bias", False),
+        },
+        "down": {
+            "kernel": layer_stack("gpt_neox.layers.{}.mlp.dense_4h_to_h.weight", True),
+            "bias": layer_stack("gpt_neox.layers.{}.mlp.dense_4h_to_h.bias", False),
+        },
+    }
+    return {
+        "embed": {"weight": jnp.asarray(raw["gpt_neox.embed_in.weight"], dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": jnp.asarray(raw["gpt_neox.final_layer_norm.weight"], dtype),
+            "bias": jnp.asarray(raw["gpt_neox.final_layer_norm.bias"], dtype),
+        },
+        "lm_head": {"kernel": jnp.asarray(np.ascontiguousarray(raw["embed_out.weight"].T), dtype)},
+    }
+
+
+def _map_phi2(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
+    L = cfg.num_layers
+
+    def layer_stack(fmt: str, transpose: bool) -> jnp.ndarray:
+        mats = [raw[fmt.format(i)] for i in range(L)]
+        if transpose:
+            mats = [np.ascontiguousarray(m.T) for m in mats]
+        return _stack(mats, dtype)
+
+    def dense(name: str) -> Params:
+        return {
+            "kernel": layer_stack("model.layers.{}." + name + ".weight", True),
+            "bias": layer_stack("model.layers.{}." + name + ".bias", False),
+        }
+
+    layers: Params = {
+        "attn_norm": {
+            "scale": layer_stack("model.layers.{}.input_layernorm.weight", False),
+            "bias": layer_stack("model.layers.{}.input_layernorm.bias", False),
+        },
+        "q": dense("self_attn.q_proj"),
+        "k": dense("self_attn.k_proj"),
+        "v": dense("self_attn.v_proj"),
+        "o": dense("self_attn.dense"),
+        "up": dense("mlp.fc1"),
+        "down": dense("mlp.fc2"),
+    }
+    return {
+        "embed": {"weight": jnp.asarray(raw["model.embed_tokens.weight"], dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": jnp.asarray(raw["model.final_layernorm.weight"], dtype),
+            "bias": jnp.asarray(raw["model.final_layernorm.bias"], dtype),
+        },
+        "lm_head": {
+            "kernel": jnp.asarray(np.ascontiguousarray(raw["lm_head.weight"].T), dtype),
+            "bias": jnp.asarray(raw["lm_head.bias"], dtype),
+        },
+    }

@@ -1,0 +1,66 @@
+"""Tokenizers: HF wrapper (host-side, the explicitly-allowed Rust tokenizers)
+plus a dependency-free byte tokenizer for synthetic models and tests.
+
+The reference tokenizes via each model's HF tokenizer
+(``Code/C-DAC Server/combiner_fp.py:276``). Per BASELINE.json's north star,
+tokenization stays host-side HF — it is not a device concern.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+
+class ByteTokenizer:
+    """Deterministic byte-level tokenizer (vocab 256 + BOS/EOS/PAD) for
+    synthetic models, tests, and CLI smoke runs — no files needed."""
+
+    vocab_size = 259
+    bos_id = 256
+    eos_id = 257
+    pad_id = 258
+
+    def encode(self, text: str, max_len: int | None = None) -> list[int]:
+        ids = [self.bos_id] + list(text.encode("utf-8", errors="replace"))
+        return ids[:max_len] if max_len else ids
+
+    def decode(self, ids) -> str:
+        data = bytes(int(i) for i in ids if 0 <= int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Thin wrapper over a local HF tokenizer directory (no hub access)."""
+
+    def __init__(self, path: str | Path):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(str(path), local_files_only=True)
+        if self._tok.pad_token_id is None:
+            self._tok.pad_token = self._tok.eos_token
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    @property
+    def eos_id(self) -> int:
+        return self._tok.eos_token_id
+
+    @property
+    def pad_id(self) -> int:
+        return self._tok.pad_token_id
+
+    def encode(self, text: str, max_len: int | None = None) -> list[int]:
+        ids = self._tok.encode(text, truncation=max_len is not None, max_length=max_len)
+        return ids
+
+    def decode(self, ids) -> str:
+        ids = [int(i) for i in ids]
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(path: str | Path | None):
+    if path:
+        return HFTokenizer(path)
+    return ByteTokenizer()

@@ -1,0 +1,235 @@
+"""Int8 quantization: per-channel weight quant + MXU-native int8 matmul.
+
+Parity + perf target (SURVEY.md §6): the reference quantizes with
+bitsandbytes ``load_in_8bit`` (``Code/Quantised Models/models_quant_updated.py:30-38``)
+and pays a 2.5× THROUGHPUT REGRESSION for it on A100 (Combo 67.2 → 26.39
+tok/s, paper Table 3) because the CUDA path dequantizes on the fly in
+separate kernels. The TPU design avoids that by feeding the MXU int8×int8 →
+int32 directly (both operands quantized), so int8 is FASTER than bf16, not
+slower — the BASELINE.json headline (decode tok/s at int8 ≥ bf16).
+
+Three execution paths, one numerical contract:
+- ``int8_matmul`` (w8a16): weight-only — dequant folds into the matmul's
+  epilogue. Used where activation range is hostile (small batch decode).
+- ``int8_matmul_dynamic`` (w8a8): dynamic per-row activation quant; the MXU
+  sees int8×int8. XLA path via ``lax.dot_general(..., preferred_element_type=int32)``.
+- ``pallas_int8_matmul``: fused Pallas kernel (quantize + int8 dot + rescale
+  in one VMEM round-trip), grid-tiled for the 128×128 MXU. Off by default on
+  CPU (tests run it with interpret=True).
+
+SmoothQuant-style activation smoothing (the reference's missing blob
+``2211.10438v7.pdf`` is the SmoothQuant paper, ``.MISSING_LARGE_BLOBS:3``) is
+applied at quantization time when calibration scales are provided:
+W' = W * s, x' = x / s migrates activation outliers into weights.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict[str, Any]
+
+INT8_MAX = 127.0
+
+
+# ---------------------------------------------------------------------------
+# Weight quantization (load-time transform over the param pytree)
+# ---------------------------------------------------------------------------
+
+
+def quantize_weight(kernel: jnp.ndarray, axis: int = -2) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-output-channel int8 quantization of a [in, out] (or
+    [L, in, out]) kernel. Returns (int8 kernel, fp32 scales broadcastable over
+    the contraction axis)."""
+    kf = kernel.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(kf), axis=axis, keepdims=True)
+    scales = jnp.maximum(absmax / INT8_MAX, 1e-8)
+    q = jnp.clip(jnp.round(kf / scales), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(scales, axis=axis)
+
+
+def quantize_params(
+    params: Params,
+    smooth_scales: Params | None = None,
+    alpha: float = 0.5,
+) -> Params:
+    """Walk the param pytree; replace every dense {kernel[, bias]} with
+    {kernel_q, scales[, bias]}. Embeddings and norms stay high-precision
+    (matching the reference runners, where bitsandbytes also only hits
+    nn.Linear — same boundary as try.py:205's quantize_dynamic({nn.Linear}))."""
+
+    def walk(node, path=()):
+        if isinstance(node, dict):
+            if "kernel" in node:
+                kernel = node["kernel"]
+                if smooth_scales is not None:
+                    s = _lookup(smooth_scales, path)
+                    if s is not None:
+                        s = jnp.power(jnp.maximum(s, 1e-5), alpha)
+                        kernel = kernel * s[..., :, None]
+                q, scales = quantize_weight(kernel)
+                out: Params = {"kernel_q": q, "scales": scales}
+                if "bias" in node:
+                    out["bias"] = node["bias"]
+                if smooth_scales is not None and s is not None:
+                    out["smooth"] = s
+                return out
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        return node
+
+    return walk(params)
+
+
+def _lookup(tree: Params, path: tuple) -> jnp.ndarray | None:
+    node = tree
+    for p in path:
+        if not isinstance(node, dict) or p not in node:
+            return None
+        node = node[p]
+    return node if not isinstance(node, dict) else None
+
+
+def is_quantized(params: Params) -> bool:
+    """True if any dense leaf in the pytree carries an int8 kernel."""
+    found = False
+
+    def walk(node):
+        nonlocal found
+        if isinstance(node, dict):
+            if "kernel_q" in node:
+                found = True
+            else:
+                for v in node.values():
+                    walk(v)
+
+    walk(params)
+    return found
+
+
+def dequantize_weight(q: jnp.ndarray, scales: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return (q.astype(jnp.float32) * scales[..., None, :]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Matmul paths
+# ---------------------------------------------------------------------------
+
+
+def int8_matmul(x: jnp.ndarray, w_q: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
+    """w8a16: y = (x @ w_q) * scales with the dequant folded into the epilogue.
+
+    The int8→activation-dtype convert feeds the MXU directly; XLA fuses the
+    per-column scale multiply into the matmul output, so no dequantized weight
+    copy ever lands in HBM (the reference's bitsandbytes path materializes
+    exactly that copy per layer — its Table 3 regression)."""
+    y = jnp.matmul(x, w_q.astype(x.dtype), preferred_element_type=jnp.float32)
+    return (y * scales.astype(jnp.float32)).astype(x.dtype)
+
+
+def quantize_activations(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Dynamic symmetric per-row (per-token) int8 quantization."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax / INT8_MAX, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def int8_matmul_dynamic(x: jnp.ndarray, w_q: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
+    """w8a8: dynamic activation quant + native int8×int8→int32 MXU matmul."""
+    x_q, x_scale = quantize_activations(x)
+    acc = lax.dot_general(
+        x_q,
+        w_q,
+        (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc.astype(jnp.float32) * x_scale * scales.astype(jnp.float32)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused w8a8 kernel
+# ---------------------------------------------------------------------------
+
+
+def _int8_matmul_kernel(x_ref, w_ref, wscale_ref, out_ref, acc_ref):
+    """One (TM, TN) output tile; grid walks (M/TM, N/TN, K/TK) with K minor.
+
+    Per K-step: quantize the x tile to int8 on the VPU, int8×int8 dot on the
+    MXU into the int32-ish fp32 accumulator; on the last K step apply the
+    per-column weight scale and write out. Activation scale is per-row within
+    the tile (computed per K-block, folded immediately — block-local dynamic
+    quantization)."""
+    k_step = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(k_step == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x_blk = x_ref[:].astype(jnp.float32)  # [TM, TK]
+    absmax = jnp.max(jnp.abs(x_blk), axis=1, keepdims=True)
+    x_scale = jnp.maximum(absmax / INT8_MAX, 1e-8)
+    x_q = jnp.clip(jnp.round(x_blk / x_scale), -127, 127).astype(jnp.int8)
+    prod = jax.lax.dot_general(
+        x_q, w_ref[:], (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    acc_ref[:] += prod.astype(jnp.float32) * x_scale
+
+    @pl.when(k_step == nk - 1)
+    def _finish():
+        out_ref[:] = (acc_ref[:] * wscale_ref[0, :].astype(jnp.float32)).astype(out_ref.dtype)
+
+
+try:  # Pallas import is TPU/CPU-interpret only; keep module importable anywhere
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+
+def pallas_int8_matmul(
+    x: jnp.ndarray,  # [M, K] activation (any float dtype)
+    w_q: jnp.ndarray,  # [K, N] int8
+    scales: jnp.ndarray,  # [N] fp32 per-column
+    *,
+    tile_m: int = 128,
+    tile_n: int = 128,
+    tile_k: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused dynamic-quant int8 matmul as a Pallas TPU kernel.
+
+    Shapes must tile evenly (callers pad); tiles default to MXU-friendly
+    128×128 output blocks with a 512-deep K stripe (int8 min tile is (32,128),
+    pallas_guide.md Tiling Constraints)."""
+    if pl is None:
+        raise RuntimeError("pallas unavailable")
+    m, k = x.shape
+    k2, n = w_q.shape
+    assert k == k2, (k, k2)
+    tile_m = min(tile_m, m)
+    tile_n = min(tile_n, n)
+    tile_k = min(tile_k, k)
+    assert m % tile_m == 0 and n % tile_n == 0 and k % tile_k == 0, (m, n, k)
+
+    grid = (m // tile_m, n // tile_n, k // tile_k)
+    return pl.pallas_call(
+        _int8_matmul_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_m, tile_k), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((tile_k, tile_n), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, tile_n), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((tile_m, tile_n), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((tile_m, tile_n), jnp.float32)],
+        interpret=interpret,
+    )(x, w_q, scales.reshape(1, -1))

@@ -1,0 +1,18 @@
+"""Distribution layer: device mesh, sharding rules, pipeline, collectives.
+
+This package is the TPU-native replacement for the reference's entire
+``Code/gRPC`` communication fabric (SURVEY.md §2.3, §3.4): where the reference
+wires Jetson edge nodes together with gRPC/protobuf over static-IP TCP
+(``server.py:16``, ``client.py:8``, ``gRPC/README.md:9-14``), edgemesh maps
+each "edge node" to a TPU chip in a ``jax.sharding.Mesh`` and lets XLA emit
+ICI/DCN collectives from sharding annotations — no serialization, no sockets
+in the data plane.
+"""
+
+from edgemesh.parallel.mesh import AXES, build_mesh, submeshes  # noqa: F401
+from edgemesh.parallel.sharding import (  # noqa: F401
+    cache_pspecs,
+    param_pspecs,
+    shard_cache,
+    shard_params,
+)

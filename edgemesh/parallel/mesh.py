@@ -1,0 +1,98 @@
+"""Device mesh construction and multi-host initialization.
+
+Replaces the reference's static-IP cluster map (``Code/gRPC/README.md:9-14``:
+J1=192.168.1.100, J3=192.168.1.101, hand-configured netplan) with
+``jax.sharding.Mesh`` axis algebra. Axis order puts ``tp`` innermost so
+tensor-parallel collectives ride neighboring ICI links; ``dp`` is outermost so
+data-parallel traffic (none at inference) would cross DCN last.
+
+Axes:
+- ``dp``: data parallel (batch)
+- ``pp``: pipeline stages (layer split — the TPU analog of the reference's
+  intended cross-Jetson model split, ``server.py:1``)
+- ``sp``: sequence/context parallel (ring attention)
+- ``tp``: tensor parallel (attention heads / MLP columns)
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "pp", "sp", "tp")
+
+
+def build_mesh(
+    dp: int = 1,
+    pp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a 4-axis mesh over ``dp*pp*sp*tp`` devices (defaults: all)."""
+    devices = devices if devices is not None else jax.devices()
+    need = dp * pp * sp * tp
+    if need > len(devices):
+        raise ValueError(f"mesh {dp}x{pp}x{sp}x{tp} needs {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(dp, pp, sp, tp)
+    return Mesh(arr, AXES)
+
+
+def auto_mesh(tp: int | None = None, devices: list | None = None) -> Mesh:
+    """All devices on the ``tp`` axis by default — the right shape for
+    single-model inference on one slice."""
+    devices = devices if devices is not None else jax.devices()
+    tp = tp or len(devices)
+    return build_mesh(tp=tp, devices=devices)
+
+
+def submeshes(n_groups: int, devices: list | None = None, tp: int | None = None) -> list[Mesh]:
+    """Partition the slice into ``n_groups`` disjoint single-axis (tp) meshes —
+    one per ensemble agent, so QA agents run CONCURRENTLY on their own chips
+    (fixing the reference's sequential agent calls, combiner_fp.py:436-439)."""
+    devices = devices if devices is not None else jax.devices()
+    if n_groups <= 0:
+        raise ValueError("n_groups must be positive")
+    per = len(devices) // n_groups
+    if per == 0:
+        raise ValueError(f"{n_groups} groups need at least {n_groups} devices, have {len(devices)}")
+    tp = tp or per
+    if tp > per:
+        raise ValueError(
+            f"tp={tp} exceeds the {per}-device share of each of {n_groups} groups; "
+            f"submeshes must be disjoint"
+        )
+    return [
+        build_mesh(tp=tp, devices=devices[i * per : i * per + tp])
+        for i in range(n_groups)
+    ]
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host (DCN-spanning) initialization via ``jax.distributed``.
+
+    The reference's analog is the hand-run server/client pair on each Jetson
+    (``gRPC/README.md:31-44``); here one call per host wires the DCN fabric
+    and jax.devices() becomes the global device list. No-ops when
+    single-process (e.g. env vars absent)."""
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("EDGEMESH_COORDINATOR")
+    if coordinator_address is None:
+        return  # single-host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def largest_power_of_two_leq(n: int) -> int:
+    return 1 << (int(math.log2(n)) if n > 0 else 0)

@@ -1,0 +1,163 @@
+"""Sharding rules: PartitionSpec trees for params and KV cache.
+
+The scaling-book recipe: annotate the param pytree with NamedShardings over
+the mesh, hand jit sharded inputs, and let XLA insert the collectives —
+an all-reduce (psum over ``tp``) after every row-sharded matmul, all-gather
+where vocab-sharded logits meet sampling. Nothing here opens a socket; this
+file IS the replacement for the reference's per-device gRPC stub map
+(``Code/gRPC/client.py:7-11``).
+
+Tensor-parallel layout (Megatron-style, per layer, over axis ``tp``):
+- q/k/v kernels column-sharded (heads split across chips),
+- attention output kernel row-sharded (psum joins head groups),
+- MLP gate/up column-sharded, down row-sharded,
+- norms replicated, embedding replicated,
+- lm_head vocab-sharded (logits come out vocab-sharded; sampling reductions
+  all-gather only the [batch, vocab] slice, never activations).
+
+KV cache is kv-head-sharded over ``tp`` (the HeadInfer-analog of
+BASELINE.json configs[3]: each chip's HBM holds only its heads' cache) and
+batch-sharded over ``dp``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from edgemesh.models.transformer import KVCache, ModelConfig
+
+Params = dict[str, Any]
+
+
+def _dense_pspec(col_shard: bool, has_bias: bool, tp_ok: bool) -> Params:
+    """PartitionSpecs for one stacked dense layer {kernel: [L, in, out], bias?}."""
+    tp = "tp" if tp_ok else None
+    if col_shard:
+        spec: Params = {"kernel": P(None, None, tp)}
+        if has_bias:
+            spec["bias"] = P(None, tp)
+    else:  # row-sharded: in-dim split, output summed by XLA via psum
+        spec = {"kernel": P(None, tp, None)}
+        if has_bias:
+            spec["bias"] = P(None, None)  # bias added once, replicated
+    return spec
+
+
+def _norm_pspec(cfg: ModelConfig, stacked: bool = True) -> Params:
+    # Stacked layer norms are [L, H] (rank 2); the final norm is [H] (rank 1).
+    p = P(None, None) if stacked else P(None)
+    spec: Params = {"scale": p}
+    if cfg.norm == "ln":
+        spec["bias"] = p
+    return spec
+
+
+def param_pspecs(cfg: ModelConfig, mesh: Mesh) -> Params:
+    """PartitionSpec tree matching init_params() structure exactly."""
+    tp_size = mesh.shape["tp"]
+    heads_ok = cfg.num_heads % tp_size == 0
+    kv_ok = cfg.num_kv_heads % tp_size == 0
+    inter_ok = cfg.intermediate_size % tp_size == 0
+    vocab_ok = cfg.vocab_size % tp_size == 0
+
+    layer: Params = {
+        "attn_norm": _norm_pspec(cfg),
+        "q": _dense_pspec(True, cfg.qkv_bias, heads_ok),
+        "k": _dense_pspec(True, cfg.qkv_bias, kv_ok),
+        "v": _dense_pspec(True, cfg.qkv_bias, kv_ok),
+        "o": _dense_pspec(False, cfg.out_bias, heads_ok),
+        "down": _dense_pspec(False, cfg.out_bias, inter_ok),
+    }
+    if not cfg.shared_input_norm:
+        layer["mlp_norm"] = _norm_pspec(cfg)
+    if cfg.activation == "silu":
+        layer["gate"] = _dense_pspec(True, cfg.out_bias, inter_ok)
+    layer["up"] = _dense_pspec(True, cfg.out_bias, inter_ok)
+
+    specs: Params = {
+        "embed": {"weight": P(None, None)},
+        "layers": layer,
+        "final_norm": _norm_pspec(cfg, stacked=False),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = {
+            "kernel": P(None, "tp" if vocab_ok else None),
+        }
+        if cfg.lm_head_bias:
+            specs["lm_head"]["bias"] = P("tp" if vocab_ok else None)
+    return specs
+
+
+def quantized_pspecs(specs: Params) -> Params:
+    """Map a pspec tree over the int8 param layout: each dense {kernel} becomes
+    {kernel_q (same sharding), scales (sharded like the kernel's out dim)}."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            if "kernel" in node:
+                kernel_spec = node["kernel"]
+                out: Params = {
+                    "kernel_q": kernel_spec,
+                    # per-out-channel scales: kernel spec minus the in dim
+                    "scales": P(*kernel_spec[:-2], kernel_spec[-1]),
+                    # per-in-channel smoothing vector: kernel spec minus the out dim
+                    "smooth": P(*kernel_spec[:-1]),
+                }
+                if "bias" in node:
+                    out["bias"] = node["bias"]
+                return out
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(specs)
+
+
+def cache_pspecs(cfg: ModelConfig, mesh: Mesh) -> KVCache:
+    """KVCache sharding: [L, batch(dp), max_seq, kv_heads(tp), head_dim]."""
+    kv_ok = cfg.num_kv_heads % mesh.shape["tp"] == 0
+    kv = P(None, "dp", None, "tp" if kv_ok else None, None)
+    return KVCache(k=kv, v=kv, lengths=P("dp"))
+
+
+def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    """Materialize params onto the mesh (jax.device_put with NamedShardings —
+    the north star's replacement for the reference's ``device_map="auto"``
+    accelerate placement, combiner_fp.py:282).
+
+    Spec lookup is structural: any param leaf without an explicit spec (e.g.
+    the optional SmoothQuant "smooth" vector when smoothing was skipped, or
+    future extras) is placed fully replicated rather than crashing tree.map.
+    """
+    from edgemesh.ops.int8 import is_quantized
+
+    specs = param_pspecs(cfg, mesh)
+    if is_quantized(params):
+        specs = quantized_pspecs(specs)
+
+    def walk(p_node, s_node):
+        if isinstance(p_node, dict):
+            return {
+                k: walk(v, s_node.get(k) if isinstance(s_node, dict) else None)
+                for k, v in p_node.items()
+            }
+        spec = s_node if isinstance(s_node, P) else P()
+        return jax.device_put(p_node, NamedSharding(mesh, spec))
+
+    return walk(params, specs)
+
+
+def shard_cache(cache: KVCache, cfg: ModelConfig, mesh: Mesh) -> KVCache:
+    specs = cache_pspecs(cfg, mesh)
+    return KVCache(
+        k=jax.device_put(cache.k, NamedSharding(mesh, specs.k)),
+        v=jax.device_put(cache.v, NamedSharding(mesh, specs.v)),
+        lengths=jax.device_put(cache.lengths, NamedSharding(mesh, specs.lengths)),
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Tokens/lengths: batch over dp, replicated over tp."""
+    return NamedSharding(mesh, P("dp", None))

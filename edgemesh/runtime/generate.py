@@ -48,8 +48,8 @@ class GenerateResult(NamedTuple):
 
 
 class _LoopState(NamedTuple):
-    step: jax.Array
-    logits: jax.Array  # [b, vocab] — logits for the NEXT token
+    step: jax.Array  # index of the NEXT output slot to fill
+    prev_token: jax.Array  # [b] — last sampled token (input to next forward)
     cache: KVCache
     rng: jax.Array
     out: jax.Array  # [b, max_new]
@@ -70,40 +70,58 @@ def _decode_loop(
     cache: KVCache,
     token_mask: jax.Array,
     rng: jax.Array,
-) -> tuple[jax.Array, jax.Array, KVCache]:
+) -> tuple[jax.Array, jax.Array, KVCache, jax.Array]:
+    """Carries the last TOKEN (not logits): the model forward for output slot
+    ``i`` runs at the top of iteration ``i``, so when the loop exits (EOS
+    everywhere or budget reached) no trailing forward is wasted — the naive
+    sample-then-forward ordering burns one full transformer step per call."""
     batch, vocab = first_logits.shape
+
+    def sample_and_record(logits, step_rng, s_out, idx, finished, num_generated, token_mask, conf_sum):
+        token = sample_token(step_rng, logits, sampling, token_mask)
+        token = jnp.where(finished, eos_id, token).astype(jnp.int32)
+        s_out = s_out.at[:, idx].set(jnp.where(finished, s_out[:, idx], token))
+        step_conf = jnp.max(jax.nn.softmax(logits.astype(jnp.float32), axis=-1), axis=-1)
+        conf_sum = conf_sum + jnp.where(finished, 0.0, step_conf)
+        num_generated = num_generated + jnp.where(finished, 0, 1)
+        finished = finished | (token == eos_id)
+        token_mask = TokenMaskState(token_mask).add(token).mask
+        return token, s_out, finished, num_generated, token_mask, conf_sum
+
+    # Slot 0 comes straight from the prefill logits — no decode forward yet.
+    rng, step_rng = jax.random.split(rng)
+    out = jnp.full((batch, max_new), eos_id, jnp.int32)
+    token0, out, finished, num_generated, token_mask, conf_sum = sample_and_record(
+        first_logits, step_rng, out, 0,
+        jnp.zeros((batch,), bool), jnp.zeros((batch,), jnp.int32),
+        token_mask, jnp.zeros((batch,), jnp.float32),
+    )
 
     def cond(s: _LoopState):
         return (s.step < max_new) & ~jnp.all(s.finished)
 
     def body(s: _LoopState):
+        logits, cache = forward_decode(cfg, params, s.prev_token, s.cache)
         rng, step_rng = jax.random.split(s.rng)
-        mask_state = TokenMaskState(s.token_mask)
-        token = sample_token(step_rng, s.logits, sampling, s.token_mask)
-        token = jnp.where(s.finished, eos_id, token).astype(jnp.int32)
-        out = s.out.at[:, s.step].set(jnp.where(s.finished, s.out[:, s.step], token))
-        step_conf = jnp.max(jax.nn.softmax(s.logits.astype(jnp.float32), axis=-1), axis=-1)
-        conf_sum = s.conf_sum + jnp.where(s.finished, 0.0, step_conf)
-        newly_done = token == eos_id
-        num_generated = s.num_generated + jnp.where(s.finished, 0, 1)
-        finished = s.finished | newly_done
-        token_mask = mask_state.add(token).mask
-        logits, cache = forward_decode(cfg, params, token, s.cache)
+        token, out, finished, num_generated, token_mask, conf_sum = sample_and_record(
+            logits, step_rng, s.out, s.step, s.finished, s.num_generated,
+            s.token_mask, s.conf_sum,
+        )
         return _LoopState(
-            s.step + 1, logits, cache, rng, out, finished, num_generated,
+            s.step + 1, token, cache, rng, out, finished, num_generated,
             token_mask, conf_sum,
         )
 
     init = _LoopState(
-        step=jnp.array(0, jnp.int32),
-        logits=first_logits,
+        step=jnp.array(1, jnp.int32),
+        prev_token=token0,
         cache=cache,
         rng=rng,
-        out=jnp.full((batch, max_new), eos_id, jnp.int32),
-        finished=jnp.zeros((batch,), bool),
-        num_generated=jnp.zeros((batch,), jnp.int32),
+        out=out,
+        finished=finished,
+        num_generated=num_generated,
         token_mask=token_mask,
-        conf_sum=jnp.zeros((batch,), jnp.float32),
+        conf_sum=conf_sum,
     )
     final = jax.lax.while_loop(cond, body, init)
     confidence = final.conf_sum / jnp.maximum(final.num_generated, 1)
@@ -125,9 +143,15 @@ def generate(
     Device work is two compiled programs (prefill; whole decode loop). All
     sampling knobs (temperature/top_k/top_p/repetition_penalty — the reference's
     full set, config_2.yaml:11-14) execute on device.
+
+    Note: the returned cache holds K/V for the prompt and all generated tokens
+    EXCEPT the final one (its forward pass never runs — it would be wasted
+    compute unless generation continues from it).
     """
     batch, prompt_len = tokens.shape
     max_new = int(sampling.max_new_tokens)
+    if max_new < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
     needed = prompt_len + max_new
     if needed > cfg.max_seq_len:
         raise ValueError(

@@ -1,0 +1,87 @@
+"""REST gateway — the surviving host-side front door.
+
+Parity: the reference exposes a FastAPI/uvicorn hello endpoint on port 8000
+(``Code/gRPC/rest_api.py:9-15``) next to its gRPC fabric. In edgemesh the
+data plane is XLA collectives (SURVEY.md §5.8), so REST remains only as the
+human/programmatic entry point: health, one-question generate, batch eval
+kick-off. Stdlib ``http.server`` — zero extra dependencies, threaded.
+
+Endpoints:
+- ``GET  /``          → health + device inventory (the "edge cluster map")
+- ``POST /generate``  → {"question": str} → ensemble answer JSON
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("edgemesh.serve")
+
+
+def _make_handler(ensemble):
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ("/", "/health"):
+                import jax
+
+                self._send(
+                    200,
+                    {
+                        "status": "ok",
+                        "service": "edgemesh",
+                        "time": time.strftime("%Y-%m-%d %H:%M:%S"),
+                        "devices": [str(d) for d in jax.devices()],
+                        "agents": [a.role for a in ensemble.qa_agents]
+                        + ([ensemble.refiner.role] if ensemble.refiner else []),
+                    },
+                )
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                question = payload.get("question")
+                if not question:
+                    self._send(400, {"error": "missing 'question' field"})
+                    return
+                result = ensemble.answer(question)
+                self._send(200, result)
+            except json.JSONDecodeError:
+                self._send(400, {"error": "invalid JSON body"})
+            except Exception as exc:  # serving loop must survive bad requests
+                log.exception("generate failed")
+                self._send(500, {"error": str(exc)})
+
+        def log_message(self, fmt, *args):  # route through logging, not stderr
+            log.info("%s %s", self.address_string(), fmt % args)
+
+    return Handler
+
+
+def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = True):
+    """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15)."""
+    server = ThreadingHTTPServer((host, port), _make_handler(ensemble))
+    log.info("edgemesh REST gateway on %s:%d", host, port)
+    if block:
+        server.serve_forever()
+        return server
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server

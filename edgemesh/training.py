@@ -1,0 +1,82 @@
+"""Training: causal-LM loss + sharded train step.
+
+The reference is inference-only (finetuning was left unstarted on its roadmap,
+SURVEY.md §7 "out of scope"), but edgemesh ships a mesh-sharded training step
+so the framework is complete on TPU terms: same model code, same sharding
+rules, optax optimizer, gradients and optimizer state sharded like the params
+(scaling-book recipe — XLA inserts the psums for the dp-axis gradient
+reduction and the tp-axis activation collectives from the shardings alone).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from edgemesh.models.transformer import (
+    ModelConfig,
+    _forward,
+    init_kv_cache,
+)
+
+Params = dict[str, Any]
+
+
+class TrainState(NamedTuple):
+    params: Params
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def forward_train(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Full-sequence logits [b, s, vocab] (cache written then discarded)."""
+    b, s = tokens.shape
+    cache = init_kv_cache(cfg, b, s)
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    kv_valid = jnp.arange(s)[None, :] < lengths[:, None]
+    logits, _ = _forward(cfg, params, tokens, positions, cache, kv_valid, is_decode=False)
+    return logits
+
+
+def causal_lm_loss(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross-entropy over real (unpadded) positions."""
+    logits = forward_train(cfg, params, tokens, lengths)[:, :-1]
+    targets = tokens[:, 1:]
+    b, s = targets.shape
+    mask = (jnp.arange(s)[None, :] < (lengths - 1)[:, None]).astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
+    return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def make_optimizer(lr: float = 1e-4, weight_decay: float = 0.01) -> optax.GradientTransformation:
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def init_train_state(cfg: ModelConfig, params: Params, optimizer) -> TrainState:
+    return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+
+def make_train_step(cfg: ModelConfig, optimizer):
+    """Returns a jittable (state, tokens, lengths) -> (state, loss) step.
+
+    Under a mesh, callers place params/opt_state with
+    edgemesh.parallel.sharding.param_pspecs and the batch with
+    batch_sharding; jit propagates the shardings through grads and updates.
+    """
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, tokens: jnp.ndarray, lengths: jnp.ndarray):
+        loss, grads = jax.value_and_grad(
+            lambda p: causal_lm_loss(cfg, p, tokens, lengths)
+        )(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return train_step

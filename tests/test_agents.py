@@ -1,0 +1,68 @@
+"""Multi-agent ensemble: concurrent QA agents + refiner merge."""
+
+import jax.numpy as jnp
+import pytest
+
+from edgemesh.config import AgentSpec, EdgeMeshConfig, ModelSpec, SamplingParams
+from edgemesh.agents import build_agent, build_ensemble
+
+
+def _tiny_spec(role="qa", **model_kw):
+    model_kw.setdefault("num_layers", 2)
+    model_kw.setdefault("hidden_size", 32)
+    model_kw.setdefault("num_heads", 4)
+    model_kw.setdefault("num_kv_heads", 4)
+    model_kw.setdefault("intermediate_size", 64)
+    return AgentSpec(
+        role=role,
+        model=ModelSpec(family="llama", **model_kw),
+        sampling=SamplingParams(max_new_tokens=6, do_sample=False, repetition_penalty=1.0),
+    )
+
+
+def test_single_agent_answer():
+    agent = build_agent(_tiny_spec())
+    out = agent.answer("What color is the sky?")
+    assert set(out) >= {"answer", "tps", "confidence", "ttft_s", "role"}
+    assert isinstance(out["answer"], str)
+    assert out["tps"] > 0
+
+
+def test_ensemble_with_refiner(devices):
+    cfg = EdgeMeshConfig(
+        agents=[_tiny_spec("qa"), _tiny_spec("qa2"), _tiny_spec("refiner")]
+    )
+    ens = build_ensemble(cfg)
+    assert len(ens.qa_agents) == 2
+    assert ens.refiner is not None
+    # QA agents landed on disjoint submeshes
+    m0, m1 = ens.qa_agents[0].mesh, ens.qa_agents[1].mesh
+    assert m0 is not None and m1 is not None
+    ids0 = {d.id for d in m0.devices.flat}
+    ids1 = {d.id for d in m1.devices.flat}
+    assert ids0.isdisjoint(ids1)
+
+    out = ens.answer("What is the capital of France?")
+    assert "answer" in out and len(out["drafts"]) == 2
+    assert {d["role"] for d in out["drafts"]} == {"qa", "qa2"}
+    # refiner prompt template wired in
+    assert "Merge" in ens.refiner.prompt_template
+
+
+def test_ensemble_without_refiner_picks_most_confident():
+    cfg = EdgeMeshConfig(agents=[_tiny_spec("qa"), _tiny_spec("qa2")])
+    ens = build_ensemble(cfg, use_submeshes=False)
+    out = ens.answer("test?")
+    confidences = [d["confidence"] for d in out["drafts"]]
+    assert out["confidence"] == max(confidences)
+
+
+def test_int8_agent():
+    spec = _tiny_spec()
+    spec.model.precision = "int8"
+    agent = build_agent(spec)
+    from edgemesh.ops.int8 import is_quantized
+
+    assert is_quantized(agent.params)
+    out = agent.answer("quantized?")
+    assert isinstance(out["answer"], str)

@@ -51,6 +51,33 @@ def test_resume_skips_done(tmp_path):
     assert len(calls) == 4  # only the 2 new samples were answered
 
 
+def test_resume_reanswers_on_question_mismatch(tmp_path):
+    """A results.jsonl from a DIFFERENT dataset must not be silently merged."""
+    out = tmp_path / "r.jsonl"
+    run_eval([QASample(0, "old question?", "old")], lambda q: {"answer": "x"}, out)
+    calls = []
+
+    def answer_fn(q):
+        calls.append(q)
+        return {"answer": "y"}
+
+    report = run_eval([QASample(0, "NEW question?", "new")], answer_fn, out, resume=True)
+    assert calls == ["NEW question?"]  # re-answered despite same index
+    assert report["num_samples"] == 1
+
+
+def test_metrics_selection_skips_unrequested(tmp_path):
+    report = run_eval(
+        _samples(2),
+        lambda q: {"answer": "answer"},
+        tmp_path / "r.jsonl",
+        resume=False,
+        metrics=["rouge1", "bleu"],
+    )
+    assert "rouge1" in report and "bleu" in report
+    assert "bertscore" not in report and "cosine" not in report
+
+
 def test_aggregate_ignores_missing_keys():
     rows = [{"rouge1": 1.0, "bleu": 0.5}, {"rouge1": 0.0}]
     rep = aggregate(rows)

@@ -1,0 +1,103 @@
+"""Numerical parity vs HuggingFace reference implementations.
+
+The reference trusts HF transformers for the model math
+(``Code/C-DAC Server/combiner_fp.py:274-284``); edgemesh reimplements it
+natively in JAX. These tests pin the ingest + forward against HF's own
+output for each family: tiny random-init HF models are saved to disk,
+ingested via edgemesh.models.hf_ingest, and full-sequence logits must agree
+to fp32 tolerance. This is the test that catches RoPE-convention, qkv-fusion
+and parallel-block mistakes (SURVEY.md §7 hard part (c)).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from edgemesh.models.hf_ingest import config_from_checkpoint, load_params  # noqa: E402
+from edgemesh.models.transformer import forward_prefill, init_kv_cache  # noqa: E402
+
+
+def _compare(ckpt_dir, hf_model, seq=12, atol=2e-3):
+    cfg = config_from_checkpoint(ckpt_dir, dtype="float32", max_seq_len=64)
+    cfg2, params = load_params(ckpt_dir, cfg)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, seq))
+
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(tokens)).logits.float().numpy()
+
+    cache = init_kv_cache(cfg, 1, 32)
+    # forward_prefill returns last-token logits; compare full sequence by
+    # calling the underlying forward through prefill at each prefix length.
+    from edgemesh.models.transformer import _forward
+
+    positions = jnp.broadcast_to(jnp.arange(seq)[None, :], (1, seq))
+    kv_valid = jnp.arange(32)[None, :] < seq
+    ours, _ = _forward(
+        cfg, params, jnp.asarray(tokens), positions, cache, kv_valid, is_decode=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours[0]), hf_logits[0], atol=atol, rtol=1e-3
+    )
+
+
+def test_llama_parity(tmp_path):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    _compare(tmp_path, model)
+
+
+def test_llama_tied_embeddings_parity(tmp_path):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, tie_word_embeddings=True,
+    )
+    torch.manual_seed(1)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    _compare(tmp_path, model)
+
+
+def test_pythia_neox_parity(tmp_path):
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    hf_cfg = GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=True, layer_norm_eps=1e-5,
+    )
+    torch.manual_seed(2)
+    model = GPTNeoXForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    _compare(tmp_path, model)
+
+
+def test_phi2_parity(tmp_path):
+    from transformers import PhiConfig, PhiForCausalLM
+
+    hf_cfg = PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        layer_norm_eps=1e-5,
+    )
+    torch.manual_seed(3)
+    model = PhiForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    _compare(tmp_path, model)

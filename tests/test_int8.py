@@ -1,0 +1,125 @@
+"""Int8 quantization: numerics of all three matmul paths + end-to-end quality.
+
+The acceptance bar mirrors BASELINE.md: int8 must preserve quality (the
+reference's Combo quant deltas were ≤0.0002 absolute) — here pinned as logits
+closeness and end-to-end greedy-token agreement on a tiny model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edgemesh.config import SamplingParams
+from edgemesh.models import init_params
+from edgemesh.models.families import tiny_config
+from edgemesh.ops.int8 import (
+    dequantize_weight,
+    int8_matmul,
+    int8_matmul_dynamic,
+    is_quantized,
+    pallas_int8_matmul,
+    quantize_activations,
+    quantize_params,
+    quantize_weight,
+)
+from edgemesh.runtime import generate
+
+
+def test_quantize_weight_roundtrip():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
+    q, scales = quantize_weight(w)
+    assert q.dtype == jnp.int8
+    assert scales.shape == (32,)
+    w2 = dequantize_weight(q, scales, jnp.float32)
+    # per-channel symmetric quant: max error is scale/2 per element
+    max_err = np.max(np.abs(np.asarray(w2) - np.asarray(w)))
+    assert max_err <= float(jnp.max(scales)) * 0.51
+
+
+def test_quantize_activations_range():
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 64)) * 3.0
+    q, scale = quantize_activations(x)
+    assert q.dtype == jnp.int8
+    assert int(jnp.max(jnp.abs(q))) <= 127
+    x2 = np.asarray(q, np.float32) * np.asarray(scale)
+    np.testing.assert_allclose(x2, np.asarray(x), atol=float(scale.max()) * 0.51)
+
+
+def test_int8_matmul_close_to_fp():
+    k = jax.random.PRNGKey(2)
+    x = jax.random.normal(k, (8, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(3), (64, 32), jnp.float32) * 0.05
+    ref = x @ w
+    q, scales = quantize_weight(w)
+    got = int8_matmul(x, q, scales)
+    rel = np.linalg.norm(np.asarray(got) - np.asarray(ref)) / np.linalg.norm(np.asarray(ref))
+    assert rel < 0.01, rel
+
+
+def test_int8_matmul_dynamic_close_to_fp():
+    x = jax.random.normal(jax.random.PRNGKey(4), (8, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(5), (64, 32), jnp.float32) * 0.05
+    ref = x @ w
+    q, scales = quantize_weight(w)
+    got = int8_matmul_dynamic(x, q, scales)
+    rel = np.linalg.norm(np.asarray(got) - np.asarray(ref)) / np.linalg.norm(np.asarray(ref))
+    assert rel < 0.02, rel
+
+
+def test_pallas_int8_matmul_interpret_matches_xla():
+    """The Pallas kernel (interpret mode on CPU) must match the XLA w8a8 path
+    tile-for-tile. Uses multi-tile shapes to exercise the K-loop accumulator."""
+    x = jax.random.normal(jax.random.PRNGKey(6), (256, 256), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(7), (256, 256), jnp.float32) * 0.05
+    q, scales = quantize_weight(w)
+    got = pallas_int8_matmul(x, q, scales, tile_m=128, tile_n=128, tile_k=128, interpret=True)
+    ref = x @ w
+    rel = np.linalg.norm(np.asarray(got) - np.asarray(ref)) / np.linalg.norm(np.asarray(ref))
+    assert rel < 0.02, rel
+
+
+def test_quantize_params_structure_and_generate():
+    cfg = tiny_config("llama")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_params(params)
+    assert is_quantized(qparams) and not is_quantized(params)
+    # embeddings/norms untouched, dense leaves transformed
+    assert "weight" in qparams["embed"]
+    assert "kernel_q" in qparams["layers"]["q"]
+    assert "kernel" not in qparams["layers"]["q"]
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 6), 0, cfg.vocab_size)
+    lengths = jnp.array([6])
+    # int8 quality bar: prefill logits stay close to fp (random-init tiny
+    # models have near-flat logits, so token-level agreement is chaotic — the
+    # right signal is logit closeness; end-to-end ROUGE deltas are checked on
+    # real weights in the integration path).
+    from edgemesh.models.transformer import forward_prefill, init_kv_cache
+
+    ref, _ = forward_prefill(cfg, params, tokens, lengths, init_kv_cache(cfg, 1, 16))
+    got, _ = forward_prefill(cfg, qparams, tokens, lengths, init_kv_cache(cfg, 1, 16))
+    rel = np.linalg.norm(np.asarray(got) - np.asarray(ref)) / np.linalg.norm(np.asarray(ref))
+    assert rel < 0.05, rel
+    # and the quantized model still generates cleanly
+    sp = SamplingParams(max_new_tokens=8, do_sample=False, repetition_penalty=1.0)
+    r_q = generate(cfg, qparams, tokens, lengths, sp)
+    assert int(jnp.sum(r_q.num_generated)) == 8
+
+
+def test_smoothquant_scales_applied():
+    cfg = tiny_config("llama", num_layers=1)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    h = cfg.hidden_size
+    smooth = {"layers": {"q": jnp.full((1, h), 2.0), "gate": jnp.full((1, h), 4.0)}}
+    qparams = quantize_params(params, smooth_scales=smooth, alpha=0.5)
+    assert "smooth" in qparams["layers"]["q"]
+    assert "smooth" not in qparams["layers"]["o"]
+    # numerics: dense(smooth) ≈ dense(fp) since W*s then x/s cancels
+    from edgemesh.models.transformer import dense
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, h), jnp.float32)
+    y_fp = x @ params["layers"]["q"]["kernel"][0]
+    y_q = dense(jax.tree.map(lambda a: a[0], qparams["layers"]["q"]), x)
+    rel = np.linalg.norm(np.asarray(y_q) - np.asarray(y_fp)) / np.linalg.norm(np.asarray(y_fp))
+    assert rel < 0.02, rel

@@ -1,0 +1,112 @@
+"""REST gateway + CLI end-to-end (loopback HTTP, real sockets)."""
+
+import json
+import urllib.request
+import urllib.error
+
+import pytest
+
+from edgemesh.config import AgentSpec, EdgeMeshConfig, ModelSpec, SamplingParams
+from edgemesh.agents import build_ensemble
+from edgemesh.serve import serve_rest
+
+
+def _tiny_cfg():
+    def spec(role):
+        return AgentSpec(
+            role=role,
+            model=ModelSpec(family="llama", num_layers=1, hidden_size=32,
+                            num_heads=4, num_kv_heads=4, intermediate_size=64),
+            sampling=SamplingParams(max_new_tokens=4, do_sample=False, repetition_penalty=1.0),
+        )
+
+    return EdgeMeshConfig(agents=[spec("qa"), spec("refiner")])
+
+
+@pytest.fixture(scope="module")
+def server():
+    ens = build_ensemble(_tiny_cfg(), use_submeshes=False)
+    srv = serve_rest(ens, host="127.0.0.1", port=0, block=False)
+    yield srv
+    srv.shutdown()
+
+
+def _url(server, path):
+    return f"http://127.0.0.1:{server.server_address[1]}{path}"
+
+
+def test_health(server):
+    with urllib.request.urlopen(_url(server, "/")) as r:
+        body = json.load(r)
+    assert body["status"] == "ok"
+    assert body["agents"] == ["qa", "refiner"]
+    assert len(body["devices"]) == 8
+
+
+def test_generate(server):
+    req = urllib.request.Request(
+        _url(server, "/generate"),
+        data=json.dumps({"question": "hello?"}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        body = json.load(r)
+    assert "answer" in body and "drafts" in body
+
+
+def test_generate_missing_question(server):
+    req = urllib.request.Request(_url(server, "/generate"), data=b"{}")
+    try:
+        urllib.request.urlopen(req)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "question" in json.load(e)["error"]
+
+
+def test_generate_bad_json(server):
+    req = urllib.request.Request(_url(server, "/generate"), data=b"not json")
+    try:
+        urllib.request.urlopen(req)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_unknown_path(server):
+    try:
+        urllib.request.urlopen(_url(server, "/nope"))
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_cli_eval_tiny(tmp_path, capsys):
+    from edgemesh.cli import main
+
+    cfg_yaml = tmp_path / "c.yaml"
+    cfg_yaml.write_text(
+        """
+agents:
+  - role: qa
+    model: {family: llama, num_layers: 1, hidden_size: 32, num_heads: 4, num_kv_heads: 4, intermediate_size: 64}
+    sampling: {max_new_tokens: 4, do_sample: false}
+eval:
+  num_samples: 2
+"""
+    )
+    rc = main([
+        "eval", "--config", str(cfg_yaml),
+        "--eval.output_jsonl", str(tmp_path / "r.jsonl"),
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["num_samples"] == 2
+    assert "rouge1" in report and "tps" in report
+
+
+def test_cli_download_reports_synthetic(capsys):
+    from edgemesh.cli import main
+
+    rc = main(["download"])
+    assert rc == 0
