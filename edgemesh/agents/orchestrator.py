@@ -70,6 +70,11 @@ class Agent:
     def answer(self, question: str, prompt: str | None = None) -> dict[str, Any]:
         prompt = prompt if prompt is not None else self.format_prompt(question)
         max_prompt = self.cfg.max_seq_len - self.sampling.max_new_tokens
+        if max_prompt < 1:
+            raise ValueError(
+                f"max_new_tokens {self.sampling.max_new_tokens} leaves no room "
+                f"for a prompt within max_seq_len {self.cfg.max_seq_len}"
+            )
         ids = self.tokenizer.encode(prompt, max_len=max_prompt)
         tokens = jnp.asarray([ids], dtype=jnp.int32)
         lengths = jnp.asarray([len(ids)], dtype=jnp.int32)
@@ -176,13 +181,9 @@ def build_agent(spec: AgentSpec, mesh=None) -> Agent:
             )
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
-    # Custom template wins; otherwise role picks the default.
+    # Custom template wins; "" (unset) resolves by role.
     default_template = REFINER_TEMPLATE if spec.role == REFINER_ROLE else DEFAULT_QA_TEMPLATE
-    template = (
-        spec.prompt_template
-        if spec.prompt_template != AgentSpec().prompt_template
-        else default_template
-    )
+    template = spec.prompt_template or default_template
     return Agent(
         role=spec.role,
         cfg=cfg,
@@ -215,5 +216,14 @@ def build_ensemble(config: EdgeMeshConfig, use_submeshes: bool = True) -> Ensemb
             meshes = [None] * len(qa_specs)
 
     qa_agents = [build_agent(s, m) for s, m in zip(qa_specs, meshes)]
-    refiner = build_agent(refiner_spec) if refiner_spec else None
+    refiner = None
+    if refiner_spec:
+        # The refiner runs AFTER the drafts are in, so it gets the whole
+        # device set (tensor-parallel over every chip) rather than a submesh.
+        refiner_mesh = None
+        if use_submeshes:
+            from edgemesh.parallel.mesh import auto_mesh
+
+            refiner_mesh = auto_mesh()
+        refiner = build_agent(refiner_spec, refiner_mesh)
     return Ensemble(qa_agents=qa_agents, refiner=refiner)

@@ -52,10 +52,10 @@ def cmd_serve(cfg: EdgeMeshConfig, port: int) -> int:
     return 0
 
 
-def cmd_bench(cfg: EdgeMeshConfig) -> int:
+def cmd_bench(cfg: EdgeMeshConfig, preset: str | None, precision: str | None) -> int:
     from edgemesh.benchmarks import decode_benchmark
 
-    print(json.dumps(decode_benchmark()))
+    print(json.dumps(decode_benchmark(preset=preset, precision=precision)))
     return 0
 
 
@@ -89,6 +89,8 @@ def main(argv: list[str] | None = None) -> int:
     top = argparse.ArgumentParser(prog="edgemesh")
     top.add_argument("command", choices=["eval", "serve", "bench", "download"])
     top.add_argument("--port", type=int, default=8000)
+    top.add_argument("--preset", type=str, default=None, help="bench: model preset")
+    top.add_argument("--precision", type=str, default=None, help="bench: bf16|int8")
     cmd_args, rest = top.parse_known_args(argv)
 
     parser = build_arg_parser()
@@ -102,7 +104,7 @@ def main(argv: list[str] | None = None) -> int:
     if cmd_args.command == "serve":
         return cmd_serve(cfg, cmd_args.port)
     if cmd_args.command == "bench":
-        return cmd_bench(cfg)
+        return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     return cmd_download(cfg)
 
 

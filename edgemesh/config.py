@@ -92,7 +92,9 @@ class AgentSpec:
     role: str = "qa"
     model: ModelSpec = field(default_factory=ModelSpec)
     sampling: SamplingParams = field(default_factory=SamplingParams)
-    prompt_template: str = "Question: {question}\nAnswer:"
+    # "" means "unset": the orchestrator resolves a role-appropriate default
+    # (QA vs refiner). Any non-empty string is used verbatim.
+    prompt_template: str = ""
 
 
 @dataclass

@@ -105,16 +105,24 @@ def run_eval(
     """
     out_path = Path(output_jsonl)
     done = _load_done(out_path) if resume else {}
+    # A persisted row is reusable only if it is for the SAME question, is not
+    # a zero-filled error row (transient failures get retried on resume), and
+    # was scored with at least the metrics requested now.
+    want_scored = (set(metrics) if metrics is not None else set(METRIC_KEYS)) & {
+        "rouge1", "rouge2", "rougeL", "avg_rouge", "bleu", "cosine", "bertscore"
+    }
     reused = {
         s.index
         for s in samples
-        if s.index in done and done[s.index].get("question") == s.question
+        if s.index in done
+        and done[s.index].get("question") == s.question
+        and "error" not in done[s.index]
+        and want_scored <= set(done[s.index])
     }
-    if done and len(reused) < len(done):
-        log.warning(
-            "%d persisted rows do not match the current dataset and will be re-answered",
-            len(done) - len(reused),
-        )
+    stale = sum(1 for s in samples if s.index in done and s.index not in reused)
+    if stale:
+        log.warning("%d persisted rows are unusable (mismatched question, error "
+                    "row, or missing metrics) and will be re-answered", stale)
     if reused:
         log.info("resuming: %d/%d samples already scored", len(reused), len(samples))
 

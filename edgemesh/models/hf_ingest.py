@@ -120,9 +120,16 @@ def _stack(arrs: list[np.ndarray], dtype) -> jnp.ndarray:
     return jnp.asarray(np.stack(arrs), dtype=dtype)
 
 
-def _dense_from_torch(w: np.ndarray, b: np.ndarray | None) -> tuple[np.ndarray, np.ndarray | None]:
-    """torch nn.Linear stores [out, in]; edgemesh kernels are [in, out]."""
-    return np.ascontiguousarray(w.T), b
+def _layer_stack(raw: dict[str, np.ndarray], fmt: str, num_layers: int, dtype, transpose: bool) -> jnp.ndarray:
+    """Stack one per-layer tensor family along a new leading L axis.
+
+    ``transpose`` converts torch nn.Linear's [out, in] storage into edgemesh's
+    [in, out] kernels.
+    """
+    mats = [raw[fmt.format(i)] for i in range(num_layers)]
+    if transpose:
+        mats = [np.ascontiguousarray(m.T) for m in mats]
+    return _stack(mats, dtype)
 
 
 def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) -> tuple[ModelConfig, Params]:
@@ -149,10 +156,7 @@ def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
     L = cfg.num_layers
 
     def layer_stack(fmt: str, transpose: bool) -> jnp.ndarray:
-        mats = [raw[fmt.format(i)] for i in range(L)]
-        if transpose:
-            mats = [np.ascontiguousarray(m.T) for m in mats]
-        return _stack(mats, dtype)
+        return _layer_stack(raw, fmt, L, dtype, transpose)
 
     layers: Params = {
         "attn_norm": {"scale": layer_stack("model.layers.{}.input_layernorm.weight", False)},
@@ -191,10 +195,7 @@ def _map_neox(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
     qkv = [split_qkv(i) for i in range(L)]
 
     def layer_stack(fmt: str, transpose: bool) -> jnp.ndarray:
-        mats = [raw[fmt.format(i)] for i in range(L)]
-        if transpose:
-            mats = [np.ascontiguousarray(m.T) for m in mats]
-        return _stack(mats, dtype)
+        return _layer_stack(raw, fmt, L, dtype, transpose)
 
     layers: Params = {
         "attn_norm": {
@@ -236,10 +237,7 @@ def _map_phi2(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
     L = cfg.num_layers
 
     def layer_stack(fmt: str, transpose: bool) -> jnp.ndarray:
-        mats = [raw[fmt.format(i)] for i in range(L)]
-        if transpose:
-            mats = [np.ascontiguousarray(m.T) for m in mats]
-        return _stack(mats, dtype)
+        return _layer_stack(raw, fmt, L, dtype, transpose)
 
     def dense(name: str) -> Params:
         return {

@@ -22,7 +22,9 @@ class ByteTokenizer:
 
     def encode(self, text: str, max_len: int | None = None) -> list[int]:
         ids = [self.bos_id] + list(text.encode("utf-8", errors="replace"))
-        return ids[:max_len] if max_len else ids
+        if max_len is not None:  # `is not None`, so max_len=0 truncates to []
+            ids = ids[: max(0, max_len)]
+        return ids
 
     def decode(self, ids) -> str:
         data = bytes(int(i) for i in ids if 0 <= int(i) < 256)

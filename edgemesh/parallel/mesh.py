@@ -16,7 +16,6 @@ Axes:
 
 from __future__ import annotations
 
-import math
 import os
 
 import jax
@@ -92,7 +91,3 @@ def initialize_multihost(
         num_processes=num_processes,
         process_id=process_id,
     )
-
-
-def largest_power_of_two_leq(n: int) -> int:
-    return 1 << (int(math.log2(n)) if n > 0 else 0)

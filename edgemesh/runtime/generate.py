@@ -40,7 +40,14 @@ class GenerateResult(NamedTuple):
     num_generated: jax.Array  # [b] int32 (includes the EOS token if emitted)
     prefill_time_s: float
     decode_time_s: float
-    tokens_per_sec: float  # generated tokens only, whole batch aggregate
+    # Reference convention (combiner_fp.py:349): generated tokens over the
+    # FULL generate() wall time (prefill + decode). Used by the eval harness.
+    tokens_per_sec: float
+    # Pure decode throughput: tokens produced BY decode forwards over decode
+    # time. The first token per row comes from prefill logits, so the decode
+    # window runs (total - batch) forwards; dividing total tokens by it would
+    # overcount. Used by bench.py.
+    decode_tok_s: float = 0.0
     confidence: jax.Array = None  # [b] mean per-token max softmax prob
     # (the reference's confidence_score metric, combiner_fp.py:318-325 — there
     # it needs a SECOND forward pass over the generated text; here it falls out
@@ -184,11 +191,14 @@ def generate(
 
     total_generated = int(jnp.sum(num_generated))
     decode_s = t2 - t1
+    wall_s = t2 - t0
+    decode_forward_tokens = max(total_generated - batch, 0)
     return GenerateResult(
         tokens=out,
         num_generated=num_generated,
         prefill_time_s=t1 - t0,
         decode_time_s=decode_s,
-        tokens_per_sec=total_generated / decode_s if decode_s > 0 else 0.0,
+        tokens_per_sec=total_generated / wall_s if wall_s > 0 else 0.0,
+        decode_tok_s=decode_forward_tokens / decode_s if decode_s > 0 else 0.0,
         confidence=confidence,
     )
