@@ -89,8 +89,16 @@ def main(argv: list[str] | None = None) -> int:
     top = argparse.ArgumentParser(prog="edgemesh")
     top.add_argument("command", choices=["eval", "serve", "bench", "download"])
     top.add_argument("--port", type=int, default=8000)
-    top.add_argument("--preset", type=str, default=None, help="bench: model preset")
-    top.add_argument("--precision", type=str, default=None, help="bench: bf16|int8")
+    from edgemesh.benchmarks import PRESETS
+
+    top.add_argument(
+        "--preset", type=str, default=None, choices=sorted(PRESETS),
+        help="bench: model preset",
+    )
+    top.add_argument(
+        "--precision", type=str, default=None, choices=["bf16", "int8"],
+        help="bench: numeric precision",
+    )
     cmd_args, rest = top.parse_known_args(argv)
 
     parser = build_arg_parser()

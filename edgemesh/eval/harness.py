@@ -111,18 +111,26 @@ def run_eval(
     want_scored = (set(metrics) if metrics is not None else set(METRIC_KEYS)) & {
         "rouge1", "rouge2", "rougeL", "avg_rouge", "bleu", "cosine", "bertscore"
     }
-    reused = {
+    usable = {
         s.index
         for s in samples
         if s.index in done
         and done[s.index].get("question") == s.question
         and "error" not in done[s.index]
-        and want_scored <= set(done[s.index])
+        and "answer" in done[s.index]
     }
-    stale = sum(1 for s in samples if s.index in done and s.index not in reused)
+    reused = {i for i in usable if want_scored <= set(done[i])}
+    # Rows whose answer is valid but were scored with FEWER metrics than now
+    # requested: re-score the persisted answer — never re-run the model (the
+    # expensive step) just to add a metric column.
+    rescore = usable - reused
+    stale = sum(1 for s in samples if s.index in done and s.index not in usable)
     if stale:
-        log.warning("%d persisted rows are unusable (mismatched question, error "
-                    "row, or missing metrics) and will be re-answered", stale)
+        log.warning("%d persisted rows are unusable (mismatched question or "
+                    "error row) and will be re-answered", stale)
+    if rescore:
+        log.info("resuming: %d persisted answers re-scored for newly requested "
+                 "metrics (no regeneration)", len(rescore))
     if reused:
         log.info("resuming: %d/%d samples already scored", len(reused), len(samples))
 
@@ -132,6 +140,14 @@ def run_eval(
     with open(out_path, "a" if resume else "w") as sink:
         for sample in samples:
             if sample.index in reused:
+                continue
+            if sample.index in rescore:
+                row = dict(done[sample.index])
+                row.update(score_sample(row["answer"], sample.answer, embedder, metrics))
+                sink.write(json.dumps(row) + "\n")
+                sink.flush()
+                rows[sample.index] = row
+                n_scored += 1
                 continue
             row: dict[str, Any] = {"index": sample.index, "question": sample.question}
             try:

@@ -262,6 +262,22 @@ def _layer_fn(
     return x + _mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x)), layer_kv
 
 
+def lm_head_logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """final_norm → (tied or untied) LM head → optional logit soft-cap.
+
+    The single definition shared by the single-chip forward, the pipeline
+    engine, and the 4D SPMD train step — head handling changes land in all
+    three at once."""
+    x = _apply_norm(cfg, params["final_norm"], x)
+    if cfg.tie_embeddings or "lm_head" not in params:
+        logits = x @ params["embed"]["weight"].T.astype(cfg.activation_dtype)
+    else:
+        logits = dense(params["lm_head"], x)
+    if cfg.logit_soft_cap > 0:
+        logits = cfg.logit_soft_cap * jnp.tanh(logits / cfg.logit_soft_cap)
+    return logits
+
+
 def _forward(
     cfg: ModelConfig,
     params: Params,
@@ -286,13 +302,7 @@ def _forward(
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
 
-    x = _apply_norm(cfg, params["final_norm"], x)
-    if cfg.tie_embeddings or "lm_head" not in params:
-        logits = x @ params["embed"]["weight"].T.astype(cfg.activation_dtype)
-    else:
-        logits = dense(params["lm_head"], x)
-    if cfg.logit_soft_cap > 0:
-        logits = cfg.logit_soft_cap * jnp.tanh(logits / cfg.logit_soft_cap)
+    logits = lm_head_logits(cfg, params, x)
 
     new_lengths = jnp.max(positions, axis=1) + 1
     return logits, KVCache(new_k, new_v, new_lengths)

@@ -28,9 +28,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from edgemesh.models.transformer import (
     KVCache,
     ModelConfig,
-    _apply_norm,
     _layer_fn,
-    dense,
+    lm_head_logits,
 )
 from edgemesh.ops.attention import LayerKV
 
@@ -204,11 +203,7 @@ class PipelineEngine:
         return out, KVCache(k, v, cache.lengths)
 
     def _logits(self, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
-        cfg = self.cfg
-        hidden = _apply_norm(cfg, params["final_norm"], hidden)
-        if cfg.tie_embeddings or "lm_head" not in params:
-            return hidden @ params["embed"]["weight"].T.astype(cfg.activation_dtype)
-        return dense(params["lm_head"], hidden)
+        return lm_head_logits(self.cfg, params, hidden)
 
     def _prefill_impl(self, params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray, cache: KVCache):
         cfg = self.cfg

@@ -52,6 +52,66 @@ def _block_attend_accumulate(
     return new_m, new_l, new_o
 
 
+def ring_attend_block(
+    q_blk: jnp.ndarray,  # [b, sq, num_heads, head_dim] local query block
+    k_blk: jnp.ndarray,  # [b, sq, kv_heads, head_dim] local key block
+    v_blk: jnp.ndarray,
+    pos_blk: jnp.ndarray,  # [b, sq] global positions of the local block
+    valid_blk: jnp.ndarray,  # [b, sq] real-token mask of the local block
+    *,
+    axis: str = "sp",
+    sp: int,
+    scale: float | None = None,
+    pcast_accumulators: bool = True,
+) -> jnp.ndarray:
+    """Per-device body of ring attention — callable inside ANY enclosing
+    shard_map that carries the ``axis`` mesh axis (the 4D SPMD train step in
+    edgemesh/parallel/spmd.py nests this inside its pp/tp program).
+
+    ``pcast_accumulators=False`` skips the varying-manual-axes cast for
+    enclosing shard_maps running with check_vma=False."""
+    b, sq, num_heads, head_dim = q_blk.shape
+    kv_heads = k_blk.shape[2]
+    groups = num_heads // kv_heads
+    scale = scale if scale is not None else head_dim**-0.5
+    qg = q_blk.reshape(b, sq, kv_heads, groups, head_dim).astype(jnp.float32) * scale
+
+    # pcast: the m/l/o accumulators become device-varying once they mix
+    # with ring-permuted K/V; their zero inits must carry the same
+    # varying-manual-axes type for the scan carry to typecheck.
+    m0 = jnp.full((b, sq, kv_heads, groups), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, sq, kv_heads, groups), jnp.float32)
+    o0 = jnp.zeros((b, sq, kv_heads, groups, head_dim), jnp.float32)
+    if pcast_accumulators:
+        m0 = lax.pcast(m0, axis, to="varying")
+        l0 = lax.pcast(l0, axis, to="varying")
+        o0 = lax.pcast(o0, axis, to="varying")
+
+    right = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def ring_step(carry, _):
+        k_c, v_c, kpos_c, kval_c, m, l, o = carry
+        m, l, o = _block_attend_accumulate(
+            qg, k_c.astype(jnp.float32), v_c.astype(jnp.float32),
+            pos_blk, kpos_c, kval_c, m, l, o,
+        )
+        # rotate K/V blocks one hop around the ring (ICI neighbor traffic)
+        k_c = lax.ppermute(k_c, axis, right)
+        v_c = lax.ppermute(v_c, axis, right)
+        kpos_c = lax.ppermute(kpos_c, axis, right)
+        kval_c = lax.ppermute(kval_c, axis, right)
+        return (k_c, v_c, kpos_c, kval_c, m, l, o), None
+
+    (k_c, v_c, kpos_c, kval_c, m, l, o), _ = lax.scan(
+        ring_step,
+        (k_blk, v_blk, pos_blk, valid_blk, m0, l0, o0),
+        None,
+        length=sp,
+    )
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.reshape(b, sq, num_heads, head_dim).astype(q_blk.dtype)
+
+
 def ring_attention(
     q: jnp.ndarray,  # [b, seq, num_heads, head_dim] — seq sharded over "sp"
     k: jnp.ndarray,  # [b, seq, kv_heads, head_dim] — seq sharded over "sp"
@@ -66,49 +126,11 @@ def ring_attention(
     Returns [b, seq, num_heads, head_dim], sharded like ``q``.
     """
     sp = mesh.shape["sp"]
-    num_heads, head_dim = q.shape[2], q.shape[3]
-    kv_heads = k.shape[2]
-    groups = num_heads // kv_heads
-    scale = scale if scale is not None else head_dim**-0.5
 
     def local_fn(q_blk, k_blk, v_blk, pos_blk, valid_blk):
-        b, sq = q_blk.shape[0], q_blk.shape[1]
-        qg = q_blk.reshape(b, sq, kv_heads, groups, head_dim).astype(jnp.float32) * scale
-
-        # pcast: the m/l/o accumulators become device-varying once they mix
-        # with ring-permuted K/V; their zero inits must carry the same
-        # varying-manual-axes type for the scan carry to typecheck.
-        m0 = lax.pcast(
-            jnp.full((b, sq, kv_heads, groups), NEG_INF, jnp.float32), "sp", to="varying"
+        return ring_attend_block(
+            q_blk, k_blk, v_blk, pos_blk, valid_blk, axis="sp", sp=sp, scale=scale
         )
-        l0 = lax.pcast(jnp.zeros((b, sq, kv_heads, groups), jnp.float32), "sp", to="varying")
-        o0 = lax.pcast(
-            jnp.zeros((b, sq, kv_heads, groups, head_dim), jnp.float32), "sp", to="varying"
-        )
-
-        right = [(i, (i + 1) % sp) for i in range(sp)]
-
-        def ring_step(carry, _):
-            k_c, v_c, kpos_c, kval_c, m, l, o = carry
-            m, l, o = _block_attend_accumulate(
-                qg, k_c.astype(jnp.float32), v_c.astype(jnp.float32),
-                pos_blk, kpos_c, kval_c, m, l, o,
-            )
-            # rotate K/V blocks one hop around the ring (ICI neighbor traffic)
-            k_c = lax.ppermute(k_c, "sp", right)
-            v_c = lax.ppermute(v_c, "sp", right)
-            kpos_c = lax.ppermute(kpos_c, "sp", right)
-            kval_c = lax.ppermute(kval_c, "sp", right)
-            return (k_c, v_c, kpos_c, kval_c, m, l, o), None
-
-        (k_c, v_c, kpos_c, kval_c, m, l, o), _ = lax.scan(
-            ring_step,
-            (k_blk, v_blk, pos_blk, valid_blk, m0, l0, o0),
-            None,
-            length=sp,
-        )
-        out = o / jnp.maximum(l[..., None], 1e-30)
-        return out.reshape(b, sq, num_heads, head_dim).astype(q_blk.dtype)
 
     seq_spec = P(None, "sp")
     return jax.shard_map(

@@ -1,0 +1,326 @@
+"""4D-parallel training step: dp x pp x sp x tp in ONE shard_map program.
+
+This is the full scaling-book composition, written manually so every
+collective is explicit and rides the intended fabric:
+
+- ``dp``  (data):     batch split; gradient reduction is the final psum.
+- ``pp``  (pipeline): contiguous layer blocks per stage; GPipe fill-drain
+                      with ``lax.ppermute`` activation hops (the TPU-native
+                      realization of the reference's intended cross-Jetson
+                      model split, ``Code/gRPC/server.py:1`` — see
+                      edgemesh/parallel/pipeline.py for the inference engine).
+- ``sp``  (sequence): ring attention (edgemesh/parallel/ring_attention.py);
+                      K/V blocks rotate around the ``sp`` ring inside every
+                      attention layer.
+- ``tp``  (tensor):   Megatron layout — q/k/v/gate/up column-sharded (heads
+                      and MLP columns local), o/down row-sharded with an
+                      explicit ``psum`` join.
+
+The reference has NONE of these strategies (SURVEY.md §2.3: its only
+parallelism is the model-level ensemble, and its "distribution" is a gRPC
+timestamp PoC between Jetsons) — this module is where the TPU build goes
+beyond parity to an actual 4D-parallel framework.
+
+Differentiability: the whole per-device program (GPipe scan + ring scans +
+psums) is transposed by JAX; ``jax.value_and_grad`` around the shard_map
+yields gradients laid out exactly like the params, so the optax update runs
+on sharded arrays without any reshard.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from edgemesh.models.transformer import ModelConfig, _apply_norm, lm_head_logits
+from edgemesh.ops.rope import apply_rope
+from edgemesh.parallel.ring_attention import ring_attend_block
+from edgemesh.training import TrainState
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Param placement
+# ---------------------------------------------------------------------------
+
+
+def _dense_spec(col_shard: bool, has_bias: bool) -> Params:
+    """Specs for one stacked dense {kernel: [L, in, out], bias?}: the layer
+    axis is always split over pp; the tp split follows the Megatron role."""
+    if col_shard:
+        spec: Params = {"kernel": P("pp", None, "tp")}
+        if has_bias:
+            spec["bias"] = P("pp", "tp")
+    else:
+        spec = {"kernel": P("pp", "tp", None)}
+        if has_bias:
+            spec["bias"] = P("pp", None)
+    return spec
+
+
+def spmd_param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpec tree (matching init_params structure) for the 4D layout."""
+    layer: Params = {
+        "attn_norm": {"scale": P("pp", None)},
+        "q": _dense_spec(True, cfg.qkv_bias),
+        "k": _dense_spec(True, cfg.qkv_bias),
+        "v": _dense_spec(True, cfg.qkv_bias),
+        "o": _dense_spec(False, cfg.out_bias),
+        "down": _dense_spec(False, cfg.out_bias),
+    }
+    if cfg.norm == "ln":
+        layer["attn_norm"]["bias"] = P("pp", None)
+    if not cfg.shared_input_norm:
+        layer["mlp_norm"] = dict(layer["attn_norm"])
+    if cfg.activation == "silu":
+        layer["gate"] = _dense_spec(True, cfg.out_bias)
+    layer["up"] = _dense_spec(True, cfg.out_bias)
+
+    specs: Params = {
+        "embed": {"weight": P()},
+        "layers": layer,
+        "final_norm": {"scale": P()},
+    }
+    if cfg.norm == "ln":
+        specs["final_norm"]["bias"] = P()
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = {"kernel": P()}
+        if cfg.lm_head_bias:
+            specs["lm_head"]["bias"] = P()
+    return specs
+
+
+def place_spmd(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    """Materialize a (host or single-device) param tree onto the 4D mesh."""
+    specs = spmd_param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _check_divisibility(cfg: ModelConfig, mesh: Mesh) -> None:
+    pp, tp = mesh.shape["pp"], mesh.shape["tp"]
+    if cfg.num_layers % pp:
+        raise ValueError(f"num_layers {cfg.num_layers} % pp {pp} != 0")
+    if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+        raise ValueError(
+            f"heads ({cfg.num_heads}/{cfg.num_kv_heads}) must divide by tp {tp}"
+        )
+    if cfg.intermediate_size % tp:
+        raise ValueError(f"intermediate {cfg.intermediate_size} % tp {tp} != 0")
+
+
+# ---------------------------------------------------------------------------
+# Per-device layer (manual tensor parallel + ring attention)
+# ---------------------------------------------------------------------------
+
+
+def _col_dense(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Column-sharded dense: kernel/bias hold only this device's columns."""
+    y = x @ p["kernel"]
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def _row_dense(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Row-sharded dense: psum joins the partial products over tp; the
+    (replicated) bias is added once, after the reduction."""
+    y = lax.psum(x @ p["kernel"], "tp")
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def _spmd_attention(
+    cfg: ModelConfig,
+    layer: Params,
+    x: jnp.ndarray,  # [b, s_local, H] (tp-invariant)
+    positions: jnp.ndarray,  # [b, s_local] global positions
+    valid: jnp.ndarray,  # [b, s_local]
+    sp: int,
+    tp: int,
+) -> jnp.ndarray:
+    b, s, _ = x.shape
+    nh_l = cfg.num_heads // tp
+    kh_l = cfg.num_kv_heads // tp
+    hd = cfg.head_size
+
+    q = _col_dense(layer["q"], x).reshape(b, s, nh_l, hd)
+    k = _col_dense(layer["k"], x).reshape(b, s, kh_l, hd)
+    v = _col_dense(layer["v"], x).reshape(b, s, kh_l, hd)
+    if cfg.rotary_dim > 0:
+        q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta)
+
+    out = ring_attend_block(
+        q, k, v, positions, valid, axis="sp", sp=sp, pcast_accumulators=False
+    )
+    return _row_dense(layer["o"], out.reshape(b, s, nh_l * hd))
+
+
+def _spmd_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.activation == "silu":
+        hidden = jax.nn.silu(_col_dense(layer["gate"], x)) * _col_dense(layer["up"], x)
+    else:
+        hidden = _col_dense(layer["up"], x)
+        hidden = jax.nn.gelu(hidden, approximate=cfg.activation == "gelu_tanh")
+    return _row_dense(layer["down"], hidden)
+
+
+def _spmd_layer(
+    cfg: ModelConfig,
+    layer: Params,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    valid: jnp.ndarray,
+    sp: int,
+    tp: int,
+) -> jnp.ndarray:
+    """One transformer layer, all family dials (mirrors transformer._layer_fn)."""
+    if cfg.parallel_block:
+        attn_in = _apply_norm(cfg, layer["attn_norm"], x)
+        mlp_in = attn_in if cfg.shared_input_norm else _apply_norm(cfg, layer["mlp_norm"], x)
+        return (
+            x
+            + _spmd_attention(cfg, layer, attn_in, positions, valid, sp, tp)
+            + _spmd_mlp(cfg, layer, mlp_in)
+        )
+    x = x + _spmd_attention(
+        cfg, layer, _apply_norm(cfg, layer["attn_norm"], x), positions, valid, sp, tp
+    )
+    return x + _spmd_mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x))
+
+
+# ---------------------------------------------------------------------------
+# The 4D program
+# ---------------------------------------------------------------------------
+
+
+def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int):
+    pp = mesh.shape["pp"]
+    sp = mesh.shape["sp"]
+    tp = mesh.shape["tp"]
+
+    def device_fn(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray):
+        # tokens: [b_local, s_local] (dp x sp shard); lengths: [b_local].
+        stage = lax.axis_index("pp")
+        sp_idx = lax.axis_index("sp")
+        b_l, s_l = tokens.shape
+        if b_l % num_micro:
+            raise ValueError(f"local batch {b_l} % num_micro {num_micro} != 0")
+        mbs = b_l // num_micro
+
+        block_start = sp_idx * s_l
+        positions = block_start + jnp.broadcast_to(jnp.arange(s_l)[None, :], (b_l, s_l))
+        valid = positions < lengths[:, None]
+        # Next-token targets: shift left within the block; the last column is
+        # the FIRST token of the next sp block, fetched with one ppermute hop.
+        nxt_first = lax.ppermute(
+            tokens[:, :1], "sp", [((i + 1) % sp, i) for i in range(sp)]
+        )
+        targets = jnp.concatenate([tokens[:, 1:], nxt_first], axis=1)
+        # A position p predicts p+1; valid iff p+1 < length. (The wrapped
+        # garbage target at the global last column is always masked by this.)
+        tmask = ((positions + 1) < lengths[:, None]).astype(jnp.float32)
+
+        x = params["embed"]["weight"][tokens].astype(cfg.activation_dtype)
+
+        def to_mb(a):
+            return a.reshape(num_micro, mbs, *a.shape[1:])
+
+        x_mb, pos_mb, valid_mb = to_mb(x), to_mb(positions), to_mb(valid)
+        tgt_mb, tmask_mb = to_mb(targets), to_mb(tmask)
+        stage_layers = params["layers"]  # leaves already [L/pp, ...] per stage
+
+        steps = num_micro + pp - 1
+        is_last_stage = stage == pp - 1
+
+        def one_step(carry, t):
+            recv, loss_sum, cnt_sum = carry
+            mb_idx = t - stage
+            active = (mb_idx >= 0) & (mb_idx < num_micro)
+            idx = jnp.clip(mb_idx, 0, num_micro - 1)
+
+            h = jnp.where(stage == 0, x_mb[idx], recv)
+            pos, kvv = pos_mb[idx], valid_mb[idx]
+
+            def layer_step(h, layer):
+                return _spmd_layer(cfg, layer, h, pos, kvv, sp, tp), None
+
+            h, _ = lax.scan(layer_step, h, stage_layers)
+            send = lax.ppermute(h, "pp", [(i, i + 1) for i in range(pp - 1)])
+
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                lm_head_logits(cfg, params, h).astype(jnp.float32), tgt_mb[idx]
+            )
+            take = (active & is_last_stage).astype(jnp.float32)
+            loss_sum = loss_sum + take * jnp.sum(ce * tmask_mb[idx])
+            cnt_sum = cnt_sum + take * jnp.sum(tmask_mb[idx])
+            return (send, loss_sum, cnt_sum), None
+
+        init = (
+            jnp.zeros((mbs, s_l, cfg.hidden_size), cfg.activation_dtype),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, loss_sum, cnt_sum), _ = lax.scan(one_step, init, jnp.arange(steps))
+
+        # Loss lives on the last pp stage, sharded over dp x sp; tp members
+        # already agree (activations are tp-invariant after every row psum).
+        total = lax.psum(loss_sum, ("dp", "pp", "sp"))
+        count = lax.psum(cnt_sum, ("dp", "pp", "sp"))
+        return total / jnp.maximum(count, 1.0)
+
+    return device_fn
+
+
+def make_spmd_loss(cfg: ModelConfig, mesh: Mesh, num_micro: int = 2):
+    """Returns loss(params, tokens, lengths) -> scalar, where params follow
+    spmd_param_specs layout and tokens are [B, S] split dp x sp."""
+    _check_divisibility(cfg, mesh)
+    device_fn = _make_device_fn(cfg, mesh, num_micro)
+    specs = spmd_param_specs(cfg)
+
+    def loss_fn(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray):
+        return jax.shard_map(
+            device_fn,
+            mesh=mesh,
+            in_specs=(specs, P("dp", "sp"), P("dp")),
+            out_specs=P(),
+            check_vma=False,
+        )(params, tokens, lengths)
+
+    return loss_fn
+
+
+def make_spmd_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    num_micro: int = 2,
+):
+    """Jitted 4D train step: (state, tokens, lengths) -> (state, loss).
+
+    ``state.params`` must be placed with :func:`place_spmd`; gradients and
+    optimizer state inherit the same shardings through jit."""
+    loss_fn = make_spmd_loss(cfg, mesh, num_micro)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, tokens: jnp.ndarray, lengths: jnp.ndarray):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, lengths)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return step
