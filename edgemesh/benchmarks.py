@@ -53,6 +53,8 @@ def decode_benchmark(
 ) -> dict[str, Any]:
     preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
     precision = precision or os.environ.get("EDGEMESH_BENCH_PRECISION", "int8")
+    if preset not in PRESETS:
+        raise ValueError(f"unknown preset {preset!r}; choose from {sorted(PRESETS)}")
     cfg = config_for_family("llama", **PRESETS[preset])
     if preset != "tiny":
         cfg = cfg.replace(dtype="bfloat16")

@@ -143,7 +143,12 @@ def run_eval(
                 continue
             if sample.index in rescore:
                 row = dict(done[sample.index])
-                row.update(score_sample(row["answer"], sample.answer, embedder, metrics))
+                try:
+                    row.update(score_sample(row["answer"], sample.answer, embedder, metrics))
+                except Exception as exc:  # zero-fill policy: combiner_fp.py:448-454
+                    log.warning("rescore failed on sample %d: %s", sample.index, exc)
+                    row.update({m: 0.0 for m in metrics if m not in row})
+                    row["error"] = str(exc)
                 sink.write(json.dumps(row) + "\n")
                 sink.flush()
                 rows[sample.index] = row

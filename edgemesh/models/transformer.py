@@ -64,6 +64,11 @@ class ModelConfig:
     dtype: str = "bfloat16"
     remat: bool = False
 
+    # Attention backend: "auto" = Pallas flash kernel for prefill on TPU,
+    # XLA einsum elsewhere; "flash" forces the kernel (interpreted off-TPU);
+    # "xla" forces the einsum path.
+    attention_impl: str = "auto"
+
     @property
     def head_size(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
@@ -205,6 +210,15 @@ def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
     return dense(layer["down"], hidden)
 
 
+def _use_flash(cfg: ModelConfig) -> bool:
+    """Trace-time choice of prefill attention backend (cfg is a static jit arg)."""
+    if cfg.attention_impl == "xla":
+        return False
+    if cfg.attention_impl == "flash":
+        return True
+    return jax.default_backend() == "tpu"
+
+
 def _attention(
     cfg: ModelConfig,
     layer: Params,
@@ -231,7 +245,20 @@ def _attention(
     else:
         cache = write_prefill(cache, k, v)
 
-    out = attend(q, cache, positions, kv_valid)
+    if not is_decode and _use_flash(cfg):
+        # Prefill starts from an empty cache (write_prefill writes at offset
+        # 0), so the freshly computed k/v ARE the full visible prefix — the
+        # flash kernel attends over them without re-reading the cache, and
+        # the [s, s] score matrix never hits HBM.
+        from edgemesh.ops.flash_attention import flash_attention
+
+        kv_lens = jnp.sum(kv_valid, axis=1).astype(jnp.int32)
+        out = flash_attention(
+            q, k, v, kv_lens, causal=True,
+            interpret=cfg.attention_impl == "flash" and jax.default_backend() != "tpu",
+        )
+    else:
+        out = attend(q, cache, positions, kv_valid)
     return dense(layer["o"], out.reshape(b, s, nh * hd)), cache
 
 

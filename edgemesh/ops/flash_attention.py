@@ -1,0 +1,219 @@
+"""Blockwise causal flash attention as a Pallas TPU kernel.
+
+The reference computes attention inside HF ``model.generate``
+(``Code/C-DAC Server/combiner_fp.py:338-347``) and has no long-context
+support (SURVEY.md §5.7). Here prefill attention is a single Pallas kernel
+with online softmax so the [s, s] score matrix never materializes in HBM —
+the hook that makes long-context (ring attention over the sp axis) cheap.
+
+Kernel design (pallas_guide.md):
+- Grid ``(batch, kv_heads, q_blocks, kv_blocks)``; the kv axis is innermost
+  and sequential, accumulating the online-softmax state (running max ``m``,
+  normalizer ``l``, unnormalized output ``acc``) in VMEM scratch across grid
+  steps — same accumulate-across-grid idiom as ops/int8.py's matmul.
+- GQA is grouped INSIDE the kernel: one invocation handles all ``groups``
+  query heads of its kv head, so each K/V block is DMA'd once per kv head
+  (not once per query head) and the Q·Kᵀ matmul has an MXU-friendly
+  ``groups*block_q`` row dimension.
+- Query positions are never shipped as a tensor: under ``causal=True`` the
+  position of row ``r`` is ``q_offset + r`` (offset is one SMEM scalar per
+  batch row — ring-attention shards pass their global offset); under
+  ``causal=False`` every query sees the whole valid prefix (the decode /
+  cross-shard case).
+- Scores/softmax in fp32 (VPU), QK^T and PV on the MXU via
+  ``preferred_element_type``; inputs stay bf16.
+- head_dim stays unpadded when it is a clean lane count (64/128/256...);
+  odd sizes (Phi-2's 80) pad to 128. Seq dims pad to block multiples; padded
+  kv columns are masked via ``kv_lens``, padded q rows are sliced off host-side.
+- Fully-masked kv blocks (beyond the causal frontier or past ``kv_lens``)
+  skip their compute via ``@pl.when`` — ~2x fewer MXU ops for causal.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+try:  # pallas import is deferred-safe: CPU wheels ship it, interpret mode runs it
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _flash_kernel(
+    qoff_ref,  # SMEM [b, 1] int32 — global position of each row's query 0
+    kvlen_ref,  # SMEM [b, 1] int32 — valid kv prefix length per batch row
+    q_ref,  # VMEM [1, 1, groups, block_q, hd]
+    k_ref,  # VMEM [1, 1, block_k, hd]
+    v_ref,  # VMEM [1, 1, block_k, hd]
+    o_ref,  # VMEM [1, 1, groups, block_q, hd]
+    m_scr,  # VMEM [groups*block_q, 128] f32 — running row max (lane-broadcast)
+    l_scr,  # VMEM [groups*block_q, 128] f32 — running normalizer
+    acc_scr,  # VMEM [groups*block_q, hd] f32 — unnormalized output
+    *,
+    scale: float,
+    groups: int,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+):
+    bb = pl.program_id(0)
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kvlen = kvlen_ref[bb, 0]
+    block_start = j * block_k
+    if causal:
+        # Query row r (within the block) sits at position qoff + i*block_q + r.
+        row_pos0 = qoff_ref[bb, 0] + i * block_q
+        live = jnp.logical_and(
+            block_start <= row_pos0 + block_q - 1, block_start < kvlen
+        )
+    else:
+        live = block_start < kvlen
+
+    @pl.when(live)
+    def _update():
+        hd = q_ref.shape[-1]
+        q = q_ref[0, 0].reshape(groups * block_q, hd)
+        k = k_ref[0, 0]  # [block_k, hd]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [groups*block_q, block_k]
+        col = block_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = col < kvlen
+        if causal:
+            # Row r of the flattened (group, q) dim is query row r % block_q.
+            qpos = row_pos0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % block_q
+            mask = jnp.logical_and(mask, col <= qpos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [groups*block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # Masked entries give exp(NEG_INF - m); when m itself is NEG_INF the
+        # difference is 0 → exp=1, so mask p explicitly.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = alpha * acc_scr[:] + pv
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        # Every real query row sees at least slot 0 (kv_lens >= 1), so l > 0;
+        # rows that are entirely padding are sliced off host-side.
+        hd = o_ref.shape[-1]
+        out = acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = out.reshape(groups, block_q, hd).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "causal", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jnp.ndarray,  # [b, s, num_heads, head_dim]
+    k: jnp.ndarray,  # [b, skv, kv_heads, head_dim]
+    v: jnp.ndarray,  # [b, skv, kv_heads, head_dim]
+    kv_lens: jnp.ndarray,  # [b] int32 — valid kv prefix per row
+    q_offsets: jnp.ndarray | None = None,  # [b] int32 — position of query row 0
+    scale: float | None = None,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal flash attention; numerics match ops.attention.attend.
+
+    Under ``causal=True`` query row ``r`` of batch row ``b`` sits at absolute
+    position ``q_offsets[b] + r`` and sees kv slot ``j`` iff
+    ``j <= position and j < kv_lens[b]``. Under ``causal=False`` every query
+    sees the full valid prefix ``j < kv_lens[b]`` (decode: the new token's
+    position is ``kv_lens-1``, so its causal window IS the valid prefix).
+    Returns [b, s, num_heads, head_dim] in q's dtype.
+    """
+    if not HAVE_PALLAS:  # pragma: no cover
+        raise RuntimeError("pallas unavailable")
+    b, s, nh, hd = q.shape
+    skv, kh = k.shape[1], k.shape[2]
+    groups = nh // kh
+    scale = scale if scale is not None else hd**-0.5
+    if q_offsets is None:
+        q_offsets = jnp.zeros((b,), jnp.int32)
+
+    block_q = min(block_q, _round_up(s, 16))
+    block_k = min(block_k, _round_up(skv, 16))
+    sp = _round_up(s, block_q)
+    mp = _round_up(skv, block_k)
+    # Lane dim: keep as-is when already a clean lane count, else pad to 128.
+    hp = hd if hd % 64 == 0 else _round_up(hd, 128)
+
+    # Head-major 5D layout [b, kh, groups, s, hd]: each (kv-head, q-block)
+    # tile is a clean stack of `groups` 2D matrices.
+    qt = jnp.pad(
+        q.transpose(0, 2, 1, 3).reshape(b, kh, groups, s, hd),
+        ((0, 0), (0, 0), (0, 0), (0, sp - s), (0, hp - hd)),
+    )
+    kt = jnp.pad(
+        k.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, mp - skv), (0, hp - hd))
+    )
+    vt = jnp.pad(
+        v.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, mp - skv), (0, hp - hd))
+    )
+    qoff2d = q_offsets.astype(jnp.int32)[:, None]  # [b, 1] full-array SMEM blocks
+    kvlen2d = kv_lens.astype(jnp.int32)[:, None]
+
+    grid = (b, kh, sp // block_q, mp // block_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, groups=groups, block_q=block_q,
+        block_k=block_k, causal=causal,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((b, 1), lambda bb, h, i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((b, 1), lambda bb, h, i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (1, 1, groups, block_q, hp), lambda bb, h, i, j: (bb, h, 0, i, 0)
+            ),
+            pl.BlockSpec((1, 1, block_k, hp), lambda bb, h, i, j: (bb, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hp), lambda bb, h, i, j: (bb, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, groups, block_q, hp), lambda bb, h, i, j: (bb, h, 0, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kh, groups, sp, hp), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((groups * block_q, 128), jnp.float32),
+            pltpu.VMEM((groups * block_q, 128), jnp.float32),
+            pltpu.VMEM((groups * block_q, hp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qoff2d, kvlen2d, qt, kt, vt)
+    out = out.reshape(b, nh, sp, hp)[:, :, :s, :hd]
+    return out.transpose(0, 2, 1, 3)

@@ -261,12 +261,22 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int):
             h, _ = lax.scan(layer_step, h, stage_layers)
             send = lax.ppermute(h, "pp", [(i, i + 1) for i in range(pp - 1)])
 
-            ce = optax.softmax_cross_entropy_with_integer_labels(
-                lm_head_logits(cfg, params, h).astype(jnp.float32), tgt_mb[idx]
-            )
-            take = (active & is_last_stage).astype(jnp.float32)
-            loss_sum = loss_sum + take * jnp.sum(ce * tmask_mb[idx])
-            cnt_sum = cnt_sum + take * jnp.sum(tmask_mb[idx])
+            # The LM-head matmul ([*, vocab] — the largest in the program) and
+            # its CE only matter on the last stage's active steps; lax.cond
+            # skips it (forward AND backward) on the other pp-1 stages and in
+            # the fill/drain bubble instead of multiplying by zero.
+            def ce_branch(h_in):
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    lm_head_logits(cfg, params, h_in).astype(jnp.float32), tgt_mb[idx]
+                )
+                return jnp.sum(ce * tmask_mb[idx]), jnp.sum(tmask_mb[idx])
+
+            def skip_branch(h_in):
+                return jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)
+
+            dl, dc = lax.cond(active & is_last_stage, ce_branch, skip_branch, h)
+            loss_sum = loss_sum + dl
+            cnt_sum = cnt_sum + dc
             return (send, loss_sum, cnt_sum), None
 
         init = (

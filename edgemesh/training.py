@@ -34,6 +34,11 @@ class TrainState(NamedTuple):
 
 def forward_train(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
     """Full-sequence logits [b, s, vocab] (cache written then discarded)."""
+    # The Pallas flash kernel has no VJP (scratch-mutating online softmax);
+    # training differentiates this forward, so pin the XLA attention path.
+    # Inference prefill (runtime/generate.py) keeps cfg's choice.
+    if cfg.attention_impl != "xla":
+        cfg = cfg.replace(attention_impl="xla")
     b, s = tokens.shape
     cache = init_kv_cache(cfg, b, s)
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))

@@ -1,0 +1,116 @@
+"""Flash-attention kernel numerics vs the XLA reference path.
+
+Runs the Pallas kernel in interpret mode on the CPU test mesh (conftest pins
+JAX_PLATFORMS=cpu) and checks it against ops.attention.attend, which the rest
+of the stack already validates against HF torch outputs (test_hf_parity.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edgemesh.ops.attention import LayerKV, attend
+from edgemesh.ops.flash_attention import flash_attention
+
+
+def _reference(q, k, v, q_positions, kv_lens):
+    max_seq = k.shape[1]
+    cache = LayerKV(k, v)
+    kv_valid = jnp.arange(max_seq)[None, :] < kv_lens[:, None]
+    return attend(q, cache, q_positions, kv_valid)
+
+
+def _random_case(key, b, s, skv, nh, kh, hd, dtype=jnp.float32):
+    ks = jax.random.split(key, 5)
+    q = jax.random.normal(ks[0], (b, s, nh, hd), dtype)
+    k = jax.random.normal(ks[1], (b, skv, kh, hd), dtype)
+    v = jax.random.normal(ks[2], (b, skv, kh, hd), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize(
+    "b,s,nh,kh,hd",
+    [
+        (2, 64, 4, 4, 64),  # MHA, hd below lane width (pad path)
+        (2, 64, 8, 2, 64),  # GQA groups=4
+        (1, 100, 4, 2, 128),  # s not a block multiple
+        (2, 16, 4, 1, 80),  # MQA, odd head_dim (Phi-2 style)
+    ],
+)
+def test_prefill_matches_reference(b, s, nh, kh, hd):
+    q, k, v = _random_case(jax.random.PRNGKey(0), b, s, s, nh, kh, hd)
+    lengths = jnp.array([s] * b).at[0].set(max(1, s - 7))
+    # Prefill: positions clamped to the last real token, kv valid below length.
+    positions = jnp.minimum(
+        jnp.broadcast_to(jnp.arange(s)[None, :], (b, s)), (lengths - 1)[:, None]
+    )
+    got = flash_attention(q, k, v, lengths, interpret=True)
+    want = _reference(q, k, v, positions, lengths)
+    valid = np.arange(s)[None, :] < np.asarray(lengths)[:, None]
+    np.testing.assert_allclose(
+        np.asarray(got)[valid], np.asarray(want)[valid], atol=2e-5, rtol=2e-5
+    )
+
+
+def test_decode_shape_matches_reference():
+    """Decode-as-flash: one query row per head group against a long cache."""
+    b, nh, kh, hd, m = 2, 8, 2, 64, 96
+    q, k, v = _random_case(jax.random.PRNGKey(1), b, 1, m, nh, kh, hd)
+    lengths = jnp.array([37, 96], jnp.int32)  # cache fill levels
+    positions = (lengths - 1)[:, None]  # new token's position
+    got = flash_attention(q, k, v, lengths, causal=False, interpret=True)
+    want = _reference(q, k, v, positions, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_inputs_close_to_fp32_reference():
+    b, s, nh, kh, hd = 1, 64, 4, 2, 64
+    q, k, v = _random_case(jax.random.PRNGKey(2), b, s, s, nh, kh, hd)
+    lengths = jnp.full((b,), s, jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    got = flash_attention(
+        q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+        lengths, interpret=True,
+    )
+    want = _reference(q, k, v, positions, lengths)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), atol=0.06, rtol=0.06
+    )
+
+
+def test_small_blocks_exercise_multiblock_accumulation():
+    b, s, nh, kh, hd = 1, 64, 2, 2, 64
+    q, k, v = _random_case(jax.random.PRNGKey(3), b, s, s, nh, kh, hd)
+    lengths = jnp.array([50], jnp.int32)
+    positions = jnp.minimum(
+        jnp.broadcast_to(jnp.arange(s)[None, :], (b, s)), (lengths - 1)[:, None]
+    )
+    got = flash_attention(
+        q, k, v, lengths, block_q=16, block_k=16, interpret=True
+    )
+    want = _reference(q, k, v, positions, lengths)
+    valid = np.arange(s)[None, :] < np.asarray(lengths)[:, None]
+    np.testing.assert_allclose(
+        np.asarray(got)[valid], np.asarray(want)[valid], atol=2e-5, rtol=2e-5
+    )
+
+
+def test_full_model_prefill_flash_vs_xla():
+    """attention_impl='flash' (interpreted on CPU) matches 'xla' end-to-end."""
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import forward_prefill, init_kv_cache, init_params
+
+    cfg = tiny_config("llama", num_heads=4, num_kv_heads=2, hidden_size=64,
+                      intermediate_size=128, num_layers=2, vocab_size=128,
+                      max_seq_len=64).replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, 128, jnp.int32)
+    lengths = jnp.array([24, 17], jnp.int32)
+    cache = init_kv_cache(cfg, 2)
+    logits_flash, _ = forward_prefill(
+        cfg.replace(attention_impl="flash"), params, tokens, lengths, cache)
+    logits_xla, _ = forward_prefill(
+        cfg.replace(attention_impl="xla"), params, tokens, lengths, cache)
+    np.testing.assert_allclose(
+        np.asarray(logits_flash), np.asarray(logits_xla), atol=1e-4, rtol=1e-4)
