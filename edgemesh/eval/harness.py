@@ -147,7 +147,7 @@ def run_eval(
                     row.update(score_sample(row["answer"], sample.answer, embedder, metrics))
                 except Exception as exc:  # zero-fill policy: combiner_fp.py:448-454
                     log.warning("rescore failed on sample %d: %s", sample.index, exc)
-                    row.update({m: 0.0 for m in metrics if m not in row})
+                    row.update({m: 0.0 for m in (metrics or METRIC_KEYS) if m not in row})
                     row["error"] = str(exc)
                 sink.write(json.dumps(row) + "\n")
                 sink.flush()

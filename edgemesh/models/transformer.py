@@ -211,12 +211,36 @@ def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _use_flash(cfg: ModelConfig) -> bool:
-    """Trace-time choice of prefill attention backend (cfg is a static jit arg)."""
+    """Trace-time choice of prefill attention backend (cfg is a static jit arg).
+
+    "auto" only picks the Pallas kernel on a single-device TPU process:
+    under multi-chip GSPMD (plain jit over NamedSharding arrays) XLA cannot
+    auto-partition a pallas_call, so the einsum path — which partitions
+    cleanly — stays the default there. Distribution code that runs per-shard
+    (shard_map bodies, where pallas sees local arrays) opts in explicitly
+    with attention_impl="flash".
+    """
     if cfg.attention_impl == "xla":
         return False
     if cfg.attention_impl == "flash":
         return True
-    return jax.default_backend() == "tpu"
+    return jax.default_backend() == "tpu" and jax.device_count() == 1
+
+
+def qkv_proj(
+    cfg: ModelConfig, layer: Params, x: jnp.ndarray, positions: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Projected + roped q/k/v heads — the single source of truth shared by
+    the dense-cache path below and the paged path (runtime/paged_generate.py)."""
+    b, s, _ = x.shape
+    nh, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    q = dense(layer["q"], x).reshape(b, s, nh, hd)
+    k = dense(layer["k"], x).reshape(b, s, kh, hd)
+    v = dense(layer["v"], x).reshape(b, s, kh, hd)
+    if cfg.rotary_dim > 0:
+        q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta)
+    return q, k, v
 
 
 def _attention(
@@ -231,14 +255,7 @@ def _attention(
 ) -> tuple[jnp.ndarray, LayerKV]:
     b, s, _ = x.shape
     nh, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
-
-    q = dense(layer["q"], x).reshape(b, s, nh, hd)
-    k = dense(layer["k"], x).reshape(b, s, kh, hd)
-    v = dense(layer["v"], x).reshape(b, s, kh, hd)
-
-    if cfg.rotary_dim > 0:
-        q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta)
+    q, k, v = qkv_proj(cfg, layer, x, positions)
 
     if is_decode:
         cache = write_decode(cache, k, v, lengths)
@@ -266,22 +283,28 @@ def _layer_fn(
     cfg: ModelConfig,
     x: jnp.ndarray,
     layer: Params,
-    layer_kv: LayerKV,
+    layer_kv,
     positions: jnp.ndarray,
     kv_valid: jnp.ndarray,
     lengths: jnp.ndarray,
     is_decode: bool,
-) -> tuple[jnp.ndarray, LayerKV]:
+    attention=_attention,
+) -> tuple[jnp.ndarray, Any]:
+    """One transformer block. ``attention`` is a pluggable module-level
+    callable with _attention's signature so alternate KV backends (the paged
+    cache, runtime/paged_generate.py) reuse the exact residual wiring of all
+    three families; ``layer_kv`` is whatever state pytree that backend carries.
+    """
     if cfg.parallel_block:
         # Phi-2 (shared_input_norm=True): y = x + attn(ln(x)) + mlp(ln(x))
         # NeoX parallel residual:         y = x + attn(ln1(x)) + mlp(ln2(x))
         attn_in = _apply_norm(cfg, layer["attn_norm"], x)
         mlp_in = attn_in if cfg.shared_input_norm else _apply_norm(cfg, layer["mlp_norm"], x)
-        attn_out, layer_kv = _attention(cfg, layer, attn_in, positions, cache=layer_kv,
-                                        kv_valid=kv_valid, lengths=lengths, is_decode=is_decode)
+        attn_out, layer_kv = attention(cfg, layer, attn_in, positions, cache=layer_kv,
+                                       kv_valid=kv_valid, lengths=lengths, is_decode=is_decode)
         return x + attn_out + _mlp(cfg, layer, mlp_in), layer_kv
     # Sequential (Llama): x += attn(norm(x)); x += mlp(norm(x))
-    attn_out, layer_kv = _attention(
+    attn_out, layer_kv = attention(
         cfg, layer, _apply_norm(cfg, layer["attn_norm"], x), positions,
         cache=layer_kv, kv_valid=kv_valid, lengths=lengths, is_decode=is_decode,
     )
@@ -322,9 +345,9 @@ def _forward(
         layer, k_l, v_l = scanned
         fn = _layer_fn
         if cfg.remat:
-            fn = jax.checkpoint(fn, static_argnums=(0, 7))
+            fn = jax.checkpoint(fn, static_argnums=(0, 7, 8))
         h, new_kv = fn(cfg, h, layer, LayerKV(k_l, v_l), positions, kv_valid,
-                       cache.lengths, is_decode)
+                       cache.lengths, is_decode, _attention)
         return h, (new_kv.k, new_kv.v)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))

@@ -1,0 +1,174 @@
+"""Paged decode attention: one Pallas kernel walks each sequence's page table.
+
+Companion to runtime/paged_kv.py (the HeadInfer-analog paged KV cache,
+BASELINE.json configs[3]). Dense decode attention reads a ``[b, max_seq]``
+HBM slab per layer whatever the actual lengths; this kernel reads only the
+pages a sequence owns, discovered through the page table at DMA-issue time
+via scalar prefetch (pallas_guide.md §PrefetchScalarGridSpec — the index_map
+of K/V blocks dereferences the prefetched table, so the DMA engine fetches
+physical page ``table[b, p]`` directly; no gather materializes).
+
+Grid ``(batch, kv_heads, max_pages)``; pages are innermost/sequential and
+accumulate online-softmax state in VMEM scratch, exactly like
+ops/flash_attention.py. GQA: the ``groups`` query heads of one kv head ride
+the sublane dim of a single ``[groups, head_dim]`` q block.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from edgemesh.ops.flash_attention import NEG_INF, _round_up
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _paged_kernel(
+    table_ref,  # SMEM [b, max_pages] int32 (scalar prefetch)
+    len_ref,  # SMEM [b] int32 (scalar prefetch)
+    q_ref,  # VMEM [1, 1, gp, hd]
+    k_ref,  # VMEM [1, 1, ps, hd] — physical page table[b, p]
+    v_ref,  # VMEM [1, 1, ps, hd]
+    o_ref,  # VMEM [1, 1, gp, hd]
+    m_scr,  # VMEM [gp, 128] f32
+    l_scr,  # VMEM [gp, 128] f32
+    acc_scr,  # VMEM [gp, hd] f32
+    *,
+    page_size: int,
+    scale: float,
+):
+    bb = pl.program_id(0)
+    p = pl.program_id(2)
+    npg = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kvlen = len_ref[bb]
+
+    @pl.when(p * page_size < kvlen)
+    def _update():
+        q = q_ref[0, 0]  # [gp, hd]
+        k = k_ref[0, 0]  # [ps, hd]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [gp, ps]
+        col = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = col < kvlen
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        pr = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(pr, axis=1, keepdims=True)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        pv = jax.lax.dot_general(
+            pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = alpha * acc_scr[:] + pv
+
+    @pl.when(p == npg - 1)
+    def _finish():
+        out = acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_decode_attention(
+    q: jnp.ndarray,  # [b, num_heads, head_dim] — one query token per row
+    k_pages: jnp.ndarray,  # [kv_heads, total_pages, page_size, head_dim]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [b, max_pages] int32
+    kv_lens: jnp.ndarray,  # [b] int32 — valid tokens per row (incl. current)
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Attention of one decode token per row over its paged KV prefix.
+
+    Returns [b, num_heads, head_dim] in q's dtype. Unallocated table slots
+    point at the trash page (physical 0); they are DMA'd but fully masked.
+    """
+    if not HAVE_PALLAS:  # pragma: no cover
+        raise RuntimeError("pallas unavailable")
+    b, nh, hd = q.shape
+    kh, _, ps, _ = k_pages.shape
+    groups = nh // kh
+    max_pages = page_table.shape[1]
+    scale = scale if scale is not None else hd**-0.5
+
+    gp = _round_up(groups, 8)  # sublane-align the q rows
+    hp = hd if hd % 64 == 0 else _round_up(hd, 128)
+    qg = q.reshape(b, kh, groups, hd)
+    qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - groups), (0, hp - hd)))
+    if hp != hd:
+        k_pages = jnp.pad(k_pages, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
+        v_pages = jnp.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
+
+    grid = (b, kh, max_pages)
+    kernel = functools.partial(_paged_kernel, page_size=ps, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, gp, hp), lambda bb, h, p, table, lens: (bb, h, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, ps, hp), lambda bb, h, p, table, lens: (h, table[bb, p], 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, ps, hp), lambda bb, h, p, table, lens: (h, table[bb, p], 0, 0)
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, gp, hp), lambda bb, h, p, table, lens: (bb, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((gp, 128), jnp.float32),
+                pltpu.VMEM((gp, hp), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kh, gp, hp), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), qg, k_pages, v_pages)
+    return out[:, :, :groups, :hd].reshape(b, nh, hd)
+
+
+def paged_decode_attention_xla(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lens: jnp.ndarray,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """XLA fallback / oracle: gather the dense view, then masked attention."""
+    from edgemesh.ops.attention import LayerKV, attend
+    from edgemesh.runtime.paged_kv import gather_dense
+
+    b, nh, hd = q.shape
+    dense_k = gather_dense(k_pages, page_table)
+    dense_v = gather_dense(v_pages, page_table)
+    max_seq = dense_k.shape[1]
+    kv_valid = jnp.arange(max_seq)[None, :] < kv_lens[:, None]
+    positions = (kv_lens - 1)[:, None]
+    out = attend(q[:, None], LayerKV(dense_k, dense_v), positions, kv_valid, scale)
+    return out[:, 0]

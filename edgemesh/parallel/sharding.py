@@ -161,3 +161,27 @@ def shard_cache(cache: KVCache, cfg: ModelConfig, mesh: Mesh) -> KVCache:
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Tokens/lengths: batch over dp, replicated over tp."""
     return NamedSharding(mesh, P("dp", None))
+
+
+def paged_cache_pspecs(cfg: ModelConfig, mesh: Mesh):
+    """Head-wise sharding of the paged KV pool (the HeadInfer analog,
+    BASELINE.json configs[3]): page arrays are [L, kv_heads, pages, page_size,
+    head_dim] (runtime/paged_kv.py), so P(None, "tp") slices each chip's HBM
+    down to its own heads' pages — contiguous, no resharding on attention.
+    The page table, lengths, and free list are tiny and replicated (every
+    chip walks the same table for its local heads)."""
+    from edgemesh.runtime.paged_kv import PagedKVCache
+
+    kv_ok = cfg.num_kv_heads % mesh.shape["tp"] == 0
+    kv = P(None, "tp" if kv_ok else None, None, None, None)
+    return PagedKVCache(
+        k=kv, v=kv, page_table=P(), lengths=P(), free_stack=P(), free_top=P()
+    )
+
+
+def shard_paged_cache(cache, cfg: ModelConfig, mesh: Mesh):
+    specs = paged_cache_pspecs(cfg, mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        cache, specs, is_leaf=lambda x: isinstance(x, P),
+    )

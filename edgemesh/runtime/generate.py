@@ -66,7 +66,7 @@ class _LoopState(NamedTuple):
     conf_sum: jax.Array  # [b] running sum of per-step max softmax prob
 
 
-@partial(jax.jit, static_argnums=(0, 2, 3, 4))
+@partial(jax.jit, static_argnums=(0, 2, 3, 4, 9))
 def _decode_loop(
     cfg: ModelConfig,
     params,
@@ -74,15 +74,17 @@ def _decode_loop(
     max_new: int,
     eos_id: int,
     first_logits: jax.Array,
-    cache: KVCache,
+    cache,  # any cache pytree understood by decode_fn
     token_mask: jax.Array,
     rng: jax.Array,
+    decode_fn=None,  # static: (cfg, params, tokens[b], cache) -> (logits, cache)
 ) -> tuple[jax.Array, jax.Array, KVCache, jax.Array]:
     """Carries the last TOKEN (not logits): the model forward for output slot
     ``i`` runs at the top of iteration ``i``, so when the loop exits (EOS
     everywhere or budget reached) no trailing forward is wasted — the naive
     sample-then-forward ordering burns one full transformer step per call."""
     batch, vocab = first_logits.shape
+    decode_fn = decode_fn or forward_decode
 
     def sample_and_record(logits, step_rng, s_out, idx, finished, num_generated, token_mask, conf_sum):
         token = sample_token(step_rng, logits, sampling, token_mask)
@@ -108,7 +110,7 @@ def _decode_loop(
         return (s.step < max_new) & ~jnp.all(s.finished)
 
     def body(s: _LoopState):
-        logits, cache = forward_decode(cfg, params, s.prev_token, s.cache)
+        logits, cache = decode_fn(cfg, params, s.prev_token, s.cache)
         rng, step_rng = jax.random.split(s.rng)
         token, out, finished, num_generated, token_mask, conf_sum = sample_and_record(
             logits, step_rng, s.out, s.step, s.finished, s.num_generated,
@@ -144,6 +146,10 @@ def generate(
     eos_id: int = -1,  # -1 → never matches: generate exactly max_new_tokens
     rng: jax.Array | None = None,
     cache: KVCache | None = None,
+    prefill_fn=None,  # (cfg, params, tokens, lengths, cache) -> (logits, cache)
+    decode_fn=None,  # (cfg, params, token[b], cache) -> (logits, cache)
+    make_cache=None,  # (cfg, batch, needed_tokens) -> cache
+    check_cache=None,  # (cache, needed_tokens) -> None, raises on undercapacity
 ) -> GenerateResult:
     """Generate up to ``sampling.max_new_tokens`` per row.
 
@@ -151,10 +157,16 @@ def generate(
     sampling knobs (temperature/top_k/top_p/repetition_penalty — the reference's
     full set, config_2.yaml:11-14) execute on device.
 
+    The four ``*_fn`` hooks default to the dense-cache forwards; alternate KV
+    backends (the paged cache, runtime/paged_generate.py) pass their own and
+    inherit this function's validation, timing, and throughput conventions
+    unchanged.
+
     Note: the returned cache holds K/V for the prompt and all generated tokens
     EXCEPT the final one (its forward pass never runs — it would be wasted
     compute unless generation continues from it).
     """
+    prefill_fn = prefill_fn or forward_prefill
     batch, prompt_len = tokens.shape
     max_new = int(sampling.max_new_tokens)
     if max_new < 1:
@@ -165,7 +177,9 @@ def generate(
             f"prompt {prompt_len} + max_new {max_new} exceeds max_seq_len {cfg.max_seq_len}"
         )
     if cache is None:
-        cache = init_kv_cache(cfg, batch, needed)
+        cache = (make_cache or (lambda c, b, n: init_kv_cache(c, b, n)))(cfg, batch, needed)
+    elif check_cache is not None:
+        check_cache(cache, needed)
     elif cache.k.shape[2] < needed:
         # Out-of-capacity scatter writes would be silently DROPPED under jit
         # (XLA out-of-bounds scatter semantics) — fail loudly instead.
@@ -175,7 +189,7 @@ def generate(
     rng = rng if rng is not None else jax.random.PRNGKey(sampling.seed)
 
     t0 = time.perf_counter()
-    first_logits, cache = forward_prefill(cfg, params, tokens, lengths, cache)
+    first_logits, cache = prefill_fn(cfg, params, tokens, lengths, cache)
     first_logits.block_until_ready()
     t1 = time.perf_counter()
 
@@ -184,7 +198,8 @@ def generate(
         TokenMaskState.init(batch, cfg.vocab_size).add_sequence(tokens, valid).mask
     )
     out, num_generated, cache, confidence = _decode_loop(
-        cfg, params, sampling, max_new, int(eos_id), first_logits, cache, token_mask, rng
+        cfg, params, sampling, max_new, int(eos_id), first_logits, cache,
+        token_mask, rng, decode_fn,
     )
     out.block_until_ready()
     t2 = time.perf_counter()

@@ -1,0 +1,210 @@
+"""Generation over the paged KV cache (HeadInfer analog, runtime/paged_kv.py).
+
+Same two-program structure as runtime/generate.py — one jitted prefill, one
+jitted whole-token-loop decode — but the cache is a shared page pool instead
+of a dense ``[b, max_seq]`` slab, so one preallocated HBM region serves many
+variable-length sequences (the serving memory model the reference lacks; its
+HF ``generate`` reallocates per call, combiner_fp.py:338-347).
+
+The transformer layer wiring is NOT duplicated: models/transformer._layer_fn
+takes the attention backend as a parameter, and this module supplies
+``_paged_attention`` (write into pages + Pallas page-table-walking kernel on
+TPU, gather fallback elsewhere). Page allocation happens once per decode step
+— before the layer scan — because the page table is shared by all layers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from edgemesh.config import SamplingParams
+from edgemesh.models.transformer import (
+    ModelConfig,
+    _layer_fn,
+    _use_flash,
+    dense,
+    lm_head_logits,
+    qkv_proj,
+)
+from edgemesh.ops.attention import LayerKV, attend
+from edgemesh.ops.paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_xla,
+)
+from edgemesh.runtime.generate import GenerateResult, generate
+from edgemesh.runtime.paged_kv import (
+    PagedKVCache,
+    allocate,
+    init_paged_cache,
+    pages_needed,
+    write_tokens,
+)
+
+
+def _paged_attention(
+    cfg: ModelConfig,
+    layer,
+    x: jnp.ndarray,  # [b, s, h]
+    positions: jnp.ndarray,  # [b, s]
+    cache,  # (k_pages, v_pages, page_table, kv_lens) for ONE layer
+    kv_valid,  # unused (validity is kv_lens in the paged world)
+    lengths: jnp.ndarray,  # [b] write offset (0 for prefill, cur len for decode)
+    is_decode: bool,
+):
+    """Drop-in attention backend for _layer_fn over one layer's page arrays."""
+    k_pages, v_pages, table, kv_lens = cache
+    b, s, _ = x.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    q, k, v = qkv_proj(cfg, layer, x, positions)
+
+    if is_decode:
+        k_pages, v_pages = write_tokens(
+            k_pages, v_pages, k, v, table, start=lengths,
+            valid_len=jnp.ones((b,), jnp.int32),
+        )
+        if _use_flash(cfg):
+            out = paged_decode_attention(
+                q[:, 0], k_pages, v_pages, table, kv_lens,
+                interpret=cfg.attention_impl == "flash"
+                and jax.default_backend() != "tpu",
+            )
+        else:
+            out = paged_decode_attention_xla(q[:, 0], k_pages, v_pages, table, kv_lens)
+        out = out[:, None]
+    else:
+        # Prefill: pages start empty, so the fresh k/v are the whole visible
+        # prefix — attend over them directly (flash kernel on TPU), then
+        # scatter them into the pages for the decode loop to extend.
+        k_pages, v_pages = write_tokens(
+            k_pages, v_pages, k, v, table, start=jnp.zeros((b,), jnp.int32),
+            valid_len=kv_lens,
+        )
+        if _use_flash(cfg):
+            from edgemesh.ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q, k, v, kv_lens, causal=True,
+                interpret=cfg.attention_impl == "flash"
+                and jax.default_backend() != "tpu",
+            )
+        else:
+            prompt_valid = jnp.arange(s)[None, :] < kv_lens[:, None]
+            out = attend(q, LayerKV(k, v), positions, prompt_valid)
+    proj = dense(layer["o"], out.reshape(b, s, nh * hd))
+    return proj, (k_pages, v_pages, table, kv_lens)
+
+
+def _paged_forward(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b, s]
+    positions: jnp.ndarray,
+    cache: PagedKVCache,
+    kv_lens: jnp.ndarray,  # [b] valid tokens AFTER this call's writes
+    is_decode: bool,
+):
+    x = params["embed"]["weight"][tokens].astype(cfg.activation_dtype)
+
+    def body(h, scanned):
+        layer, k_l, v_l = scanned
+        state = (k_l, v_l, cache.page_table, kv_lens)
+        h, (k_l, v_l, _, _) = _layer_fn(
+            cfg, h, layer, state, positions, None, cache.lengths, is_decode,
+            _paged_attention,
+        )
+        return h, (k_l, v_l)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    return lm_head_logits(cfg, params, x), cache._replace(k=new_k, v=new_v)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def forward_prefill_paged(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b, s] right-padded prompts
+    lengths: jnp.ndarray,  # [b] true prompt lengths
+    cache: PagedKVCache,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Allocate prompt pages, run the prompt, return last-real-token logits."""
+    b, s = tokens.shape
+    cache = allocate(cache, pages_needed(cache.lengths, lengths, cache.page_size))
+    positions = jnp.minimum(
+        jnp.broadcast_to(jnp.arange(s)[None, :], (b, s)), (lengths - 1)[:, None]
+    )
+    logits, cache = _paged_forward(
+        cfg, params, tokens, positions, cache, lengths, is_decode=False
+    )
+    last = logits[jnp.arange(b), lengths - 1]
+    return last, cache._replace(lengths=lengths)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def forward_decode_paged(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b] one new token per row
+    cache: PagedKVCache,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """One autoregressive step; grows each row's table when it crosses a page
+    boundary (pure array ops — safe inside the scanned decode loop)."""
+    cache = allocate(
+        cache, pages_needed(cache.lengths, jnp.ones_like(cache.lengths), cache.page_size)
+    )
+    positions = cache.lengths[:, None]
+    logits, cache = _paged_forward(
+        cfg, params, tokens[:, None], positions, cache, cache.lengths + 1,
+        is_decode=True,
+    )
+    return logits[:, 0], cache._replace(lengths=cache.lengths + 1)
+
+
+def generate_paged(
+    cfg: ModelConfig,
+    params,
+    tokens: jax.Array,  # [b, s] right-padded prompts
+    lengths: jax.Array,  # [b] true prompt lengths
+    sampling: SamplingParams,
+    eos_id: int = -1,
+    rng: jax.Array | None = None,
+    cache: PagedKVCache | None = None,
+    page_size: int = 64,
+) -> GenerateResult:
+    """generate() over the paged cache: delegates to runtime.generate.generate
+    with the paged forwards plugged in, so validation, timing, and the
+    throughput conventions live in exactly one place."""
+
+    def make_cache(cfg, batch, needed):
+        per_row = (needed + page_size - 1) // page_size
+        return init_paged_cache(
+            cfg, batch, total_pages=1 + batch * per_row, page_size=page_size,
+            max_pages=per_row,
+        )
+
+    def check_cache(cache, needed):
+        batch = cache.page_table.shape[0]
+        capacity = cache.max_pages * cache.page_size
+        if capacity < needed:
+            raise ValueError(
+                f"paged cache capacity {capacity} (max_pages x page_size) < "
+                f"prompt + max_new = {needed}"
+            )
+        free = cache.free_stack.shape[0] - int(cache.free_top)
+        want = int(jnp.sum(pages_needed(
+            cache.lengths, jnp.full((batch,), needed, jnp.int32), cache.page_size
+        )))
+        if want > free:
+            raise ValueError(
+                f"page pool exhausted: need {want} pages, {free} free — "
+                "size total_pages for prompt+max_new across the batch"
+            )
+
+    return generate(
+        cfg, params, tokens, lengths, sampling, eos_id=eos_id, rng=rng,
+        cache=cache, prefill_fn=forward_prefill_paged,
+        decode_fn=forward_decode_paged, make_cache=make_cache,
+        check_cache=check_cache,
+    )

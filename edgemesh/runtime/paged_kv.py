@@ -1,0 +1,173 @@
+"""Block-paged HBM-resident KV cache — the HeadInfer analog (BASELINE.json
+configs[3], SURVEY.md §5.7).
+
+HeadInfer scales context on small GPUs by offloading KV heads to host DRAM;
+the TPU reinterpretation keeps the cache HBM-resident, paged, and head-wise
+sharded: page arrays are laid out head-major ``[layers, kv_heads, pages,
+page_size, head_dim]`` so a ``P(None, "tp")`` sharding slices contiguous
+memory per chip, and the paged-attention kernel walks each sequence's page
+table instead of a dense ``[b, max_seq]`` slab.
+
+Everything here is functional and statically shaped so the decode loop jits
+once (the design rule the whole runtime follows, models/transformer.py):
+
+- ``PagedKVCache`` carries the page arrays, one page table shared by all
+  layers, per-row lengths, and the free-page stack.
+- Physical page 0 is the TRASH page: writes for padded/invalid positions land
+  there, reads of unallocated table slots DMA it harmlessly (always masked).
+- ``allocate`` pops pages for rows that need them — callable INSIDE a scanned
+  decode step (pure array ops, no data-dependent shapes).
+
+The reference has no cache management at all — HF ``generate`` reallocates
+per call (``Code/C-DAC Server/combiner_fp.py:338-347``); this module is what
+lets one preallocated HBM pool serve many variable-length sequences.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from edgemesh.models.transformer import ModelConfig
+
+
+class PagedKVCache(NamedTuple):
+    """k/v: [L, kv_heads, total_pages, page_size, head_dim].
+
+    ``page_table``: [b, max_pages] int32 — physical page of each logical page
+    (0 = unallocated → trash page). ``lengths``: [b] tokens written per row.
+    ``free_stack``: [total_pages] int32 physical page ids; ``free_top`` is the
+    next unpopped stack index (monotone within one batch's lifetime; the host
+    rebuilds the stack between serving batches).
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    page_table: jnp.ndarray
+    lengths: jnp.ndarray
+    free_stack: jnp.ndarray
+    free_top: jnp.ndarray
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def max_pages(self) -> int:
+        return self.page_table.shape[1]
+
+
+def init_paged_cache(
+    cfg: ModelConfig,
+    batch: int,
+    total_pages: int,
+    page_size: int = 64,
+    max_pages: int | None = None,
+    dtype=None,
+) -> PagedKVCache:
+    """Preallocate the page pool. ``total_pages`` includes the trash page."""
+    dtype = dtype or cfg.activation_dtype
+    max_pages = max_pages or (cfg.max_seq_len + page_size - 1) // page_size
+    shape = (cfg.num_layers, cfg.num_kv_heads, total_pages, page_size, cfg.head_size)
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        page_table=jnp.zeros((batch, max_pages), jnp.int32),
+        lengths=jnp.zeros((batch,), jnp.int32),
+        free_stack=jnp.arange(total_pages, dtype=jnp.int32),  # entry 0 = trash
+        free_top=jnp.asarray(1, jnp.int32),  # skip the trash page
+    )
+
+
+def pages_needed(lengths: jnp.ndarray, new_tokens: jnp.ndarray, page_size: int) -> jnp.ndarray:
+    """How many fresh pages each row needs to hold ``new_tokens`` more tokens."""
+    have = (lengths + page_size - 1) // page_size
+    want = (lengths + new_tokens + page_size - 1) // page_size
+    return want - have
+
+
+def allocate(cache: PagedKVCache, n_pages: jnp.ndarray) -> PagedKVCache:
+    """Pop ``n_pages[i]`` pages for row i and append them to its table.
+
+    Statically bounded by ``max_pages`` logical slots per row; pure gathers
+    and scatters, so it runs inside a jitted/scanned decode step. Exhausting
+    the pool silently hands out trash pages (callers bound capacity up front
+    — generate() validates prompt+max_new against the pool, mirroring its
+    max_seq_len check).
+    """
+    b, max_pages = cache.page_table.shape
+    n_pages = n_pages.astype(jnp.int32)
+    # Row i draws stack entries free_top + offset[i] .. + n[i]-1.
+    offset = jnp.cumsum(n_pages) - n_pages  # exclusive prefix sum
+    have = (cache.lengths + cache.page_size - 1) // cache.page_size  # filled slots
+
+    j = jnp.arange(max_pages)[None, :]  # candidate new logical slot index
+    take = j < n_pages[:, None]  # [b, max_pages]
+    src = cache.free_top + offset[:, None] + j  # stack position per slot
+    total = cache.free_stack.shape[0]
+    pages = jnp.where(
+        (src < total) & take, cache.free_stack[jnp.minimum(src, total - 1)], 0
+    )
+    slots = have[:, None] + j  # target logical slot
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, max_pages))
+    # Non-taken entries scatter out of bounds and are dropped (XLA OOB-scatter
+    # semantics made explicit) — they must not touch any real table slot.
+    table = cache.page_table.at[jnp.where(take, rows, b), slots].set(
+        pages, mode="drop"
+    )
+    return cache._replace(
+        page_table=table, free_top=cache.free_top + jnp.sum(n_pages)
+    )
+
+
+def _flat_scatter(pages: jnp.ndarray, flat_pos: jnp.ndarray, values: jnp.ndarray) -> jnp.ndarray:
+    """Scatter values[kh, n, hd] into pages[kh, P, ps, hd] at flat token
+    positions flat_pos[n] (page*page_size + slot)."""
+    kh, P, ps, hd = pages.shape
+    flat = pages.reshape(kh, P * ps, hd)
+    flat = flat.at[:, flat_pos, :].set(values)
+    return flat.reshape(kh, P, ps, hd)
+
+
+def write_tokens(
+    k_pages: jnp.ndarray,  # [kh, P, ps, hd] one layer's pages
+    v_pages: jnp.ndarray,
+    k: jnp.ndarray,  # [b, s, kh, hd] new keys (roped)
+    v: jnp.ndarray,
+    page_table: jnp.ndarray,  # [b, max_pages]
+    start: jnp.ndarray,  # [b] first token position to write
+    valid_len: jnp.ndarray,  # [b] number of real tokens in k/v per row
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter s tokens per row into their pages; invalid tokens → trash page."""
+    b, s, kh, hd = k.shape
+    ps = k_pages.shape[2]
+    t = jnp.arange(s)[None, :]  # [1, s]
+    pos = start[:, None] + t  # absolute position [b, s]
+    logical = pos // ps
+    slot = pos % ps
+    valid = t < valid_len[:, None]
+    max_pages = page_table.shape[1]
+    phys = jnp.take_along_axis(
+        page_table, jnp.minimum(logical, max_pages - 1), axis=1
+    )  # [b, s]
+    flat_pos = jnp.where(valid, phys * ps + slot, 0)  # 0.. = trash page slots
+    flat_pos = flat_pos.reshape(b * s)
+    kv_kh_first = k.transpose(2, 0, 1, 3).reshape(kh, b * s, hd)
+    vv_kh_first = v.transpose(2, 0, 1, 3).reshape(kh, b * s, hd)
+    return (
+        _flat_scatter(k_pages, flat_pos, kv_kh_first.astype(k_pages.dtype)),
+        _flat_scatter(v_pages, flat_pos, vv_kh_first.astype(v_pages.dtype)),
+    )
+
+
+def gather_dense(
+    pages: jnp.ndarray,  # [kh, P, ps, hd]
+    page_table: jnp.ndarray,  # [b, max_pages]
+) -> jnp.ndarray:
+    """Materialize the dense [b, max_pages*ps, kh, hd] view (XLA fallback /
+    test oracle; the Pallas kernel never does this)."""
+    kh, P, ps, hd = pages.shape
+    picked = pages[:, page_table, :, :]  # [kh, b, max_pages, ps, hd]
+    b, mp = page_table.shape
+    return picked.transpose(1, 2, 3, 0, 4).reshape(b, mp * ps, kh, hd)
