@@ -21,6 +21,41 @@ class QASample:
 
 
 def load_qa_csv(path: str | Path, limit: int | None = None) -> list[QASample]:
+    """Load query/answer pairs; native C++ parser when built, stdlib fallback
+    (both RFC 4180 — parity covered by tests/test_native.py)."""
+    try:
+        return _load_qa_csv_native(path, limit)
+    except (RuntimeError, FileNotFoundError):
+        pass
+    return _load_qa_csv_py(path, limit)
+
+
+def _load_qa_csv_native(path: str | Path, limit: int | None) -> list[QASample]:
+    from edgemesh.runtime.native import NativeCSV
+
+    table = NativeCSV(path)  # raises RuntimeError when the lib is unavailable
+    try:
+        header = [h.lower() for h in table.header()]
+        qcol = next((i for i, h in enumerate(header) if h in ("query", "question")), None)
+        acol = next((i for i, h in enumerate(header) if h in ("answer", "answers")), None)
+        if qcol is None or acol is None:
+            raise ValueError(f"expected query/answer columns, got {header}")
+        samples = []
+        for r in range(1, table.num_rows):
+            ncols = table.num_cols(r)
+            if ncols == 0:  # blank line (csv.reader's [] row) — skip like DictReader
+                continue
+            if limit is not None and len(samples) >= limit:
+                break
+            q = table.cell(r, qcol) if qcol < ncols else ""
+            a = table.cell(r, acol) if acol < ncols else ""
+            samples.append(QASample(len(samples), q, a))
+        return samples
+    finally:
+        table.close()
+
+
+def _load_qa_csv_py(path: str | Path, limit: int | None = None) -> list[QASample]:
     samples: list[QASample] = []
     with open(path, newline="", encoding="utf-8") as f:
         reader = csv.DictReader(f)

@@ -48,6 +48,9 @@ def score_sample(
     families actually run, so e.g. dropping bertscore/cosine skips the
     embedding work entirely."""
     want = set(metrics) if metrics is not None else set(METRIC_KEYS)
+    unknown = want - set(METRIC_KEYS)
+    if unknown:  # a typo here would otherwise silently drop the metric
+        raise ValueError(f"unknown metrics {sorted(unknown)}; choose from {METRIC_KEYS}")
     embedder = embedder or _default_embedder()
     row: dict[str, float] = {}
     if want & {"rouge1", "rouge2", "rougeL", "avg_rouge"}:

@@ -1,0 +1,194 @@
+"""ctypes bindings for the native runtime library (native/edgemesh_native.cpp).
+
+Provides the framework's own native data loader (RFC-4180 CSV) and byte-level
+BPE tokenizer — the capabilities the reference outsources to pandas' C engine
+(``Code/C-DAC Server/try.py:292``) and HF's Rust tokenizers
+(``combiner_fp.py:276``). The library is built lazily with ``make -C native``
+on first use; every entry point degrades gracefully to pure Python when no
+compiler or library is available, so nothing here is a hard dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import threading
+from pathlib import Path
+
+log = logging.getLogger("edgemesh.native")
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libedgemesh_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_tried = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.em_csv_open.restype = ctypes.c_void_p
+    lib.em_csv_open.argtypes = [ctypes.c_char_p]
+    lib.em_csv_rows.restype = ctypes.c_long
+    lib.em_csv_rows.argtypes = [ctypes.c_void_p]
+    lib.em_csv_cols.restype = ctypes.c_long
+    lib.em_csv_cols.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.em_csv_cell.restype = ctypes.c_void_p  # char*; sliced via ctypes.string_at
+    lib.em_csv_cell.argtypes = [
+        ctypes.c_void_p, ctypes.c_long, ctypes.c_long, ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.em_csv_close.argtypes = [ctypes.c_void_p]
+
+    lib.em_bpe_open.restype = ctypes.c_void_p
+    lib.em_bpe_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.em_bpe_vocab_size.restype = ctypes.c_long
+    lib.em_bpe_vocab_size.argtypes = [ctypes.c_void_p]
+    lib.em_bpe_token_id.restype = ctypes.c_long
+    lib.em_bpe_token_id.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.em_bpe_encode.restype = ctypes.c_long
+    lib.em_bpe_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_long,
+    ]
+    lib.em_bpe_decode.restype = ctypes.c_long
+    lib.em_bpe_decode.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_long,
+        ctypes.c_char_p, ctypes.c_long,
+    ]
+    lib.em_bpe_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load_native() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _lib_tried
+    with _lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not _LIB_PATH.exists() and (_NATIVE_DIR / "Makefile").exists():
+            try:
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR)], check=True,
+                    capture_output=True, timeout=120,
+                )
+            except Exception as exc:  # no compiler / make failure → fallback
+                log.info("native build unavailable (%s); using pure Python", exc)
+                return None
+        if not _LIB_PATH.exists():
+            return None
+        try:
+            _lib = _configure(ctypes.CDLL(str(_LIB_PATH)))
+        except OSError as exc:  # wrong arch, truncated build, ...
+            log.warning("failed to load %s: %s", _LIB_PATH, exc)
+            _lib = None
+        return _lib
+
+
+class NativeCSV:
+    """Parsed CSV file held in native memory; cells decoded on access."""
+
+    def __init__(self, path: str | Path):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.em_csv_open(str(path).encode())
+        if not self._h:
+            raise FileNotFoundError(path)
+
+    @property
+    def num_rows(self) -> int:
+        return int(self._lib.em_csv_rows(self._h))
+
+    def num_cols(self, row: int) -> int:
+        return int(self._lib.em_csv_cols(self._h, row))
+
+    def cell(self, row: int, col: int) -> str:
+        ln = ctypes.c_long()
+        ptr = self._lib.em_csv_cell(self._h, row, col, ctypes.byref(ln))
+        if not ptr:
+            raise IndexError((row, col))
+        return ctypes.string_at(ptr, ln.value).decode("utf-8", errors="replace")
+
+    def header(self) -> list[str]:
+        return [self.cell(0, c) for c in range(self.num_cols(0))]
+
+    def close(self):
+        if self._h:
+            self._lib.em_csv_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeBPE:
+    """GPT-2-format byte-level BPE tokenizer backed by the C++ engine.
+
+    Satisfies the same protocol as models.tokenizer.HFTokenizer
+    (vocab_size / eos_id / pad_id / encode / decode), loading the standard
+    ``vocab.json`` + ``merges.txt`` pair from a checkpoint directory.
+    """
+
+    def __init__(self, path: str | Path, eos_token: str = "<|endoftext|>"):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        p = Path(path)
+        vocab = p / "vocab.json" if p.is_dir() else p
+        merges = p / "merges.txt" if p.is_dir() else p.parent / "merges.txt"
+        self._h = lib.em_bpe_open(str(vocab).encode(), str(merges).encode())
+        if not self._h:
+            raise FileNotFoundError(f"vocab/merges not loadable under {path}")
+        eos = int(lib.em_bpe_token_id(self._h, eos_token.encode()))
+        self._eos = eos if eos >= 0 else int(lib.em_bpe_vocab_size(self._h)) - 1
+
+    @property
+    def vocab_size(self) -> int:
+        return int(self._lib.em_bpe_vocab_size(self._h))
+
+    @property
+    def eos_id(self) -> int:
+        return self._eos
+
+    @property
+    def pad_id(self) -> int:
+        return self._eos  # GPT-2-family convention: pad with EOS
+
+    def encode(self, text: str, max_len: int | None = None) -> list[int]:
+        data = text.encode("utf-8")
+        cap = max(len(data) + 8, 16)
+        buf = (ctypes.c_int32 * cap)()
+        n = int(self._lib.em_bpe_encode(self._h, data, len(data), buf, cap))
+        ids = list(buf[: min(n, cap)])
+        if max_len is not None:
+            ids = ids[: max(0, max_len)]
+        return ids
+
+    def decode(self, ids) -> str:
+        ids = [int(i) for i in ids]
+        arr = (ctypes.c_int32 * max(len(ids), 1))(*ids)
+        cap = 16 * len(ids) + 16
+        out = ctypes.create_string_buffer(cap)
+        n = int(self._lib.em_bpe_decode(self._h, arr, len(ids), out, cap))
+        if n > cap:  # retry with the exact size the library reported
+            cap = n
+            out = ctypes.create_string_buffer(cap)
+            n = int(self._lib.em_bpe_decode(self._h, arr, len(ids), out, cap))
+        return out.raw[: min(n, cap)].decode("utf-8", errors="replace")
+
+    def close(self):
+        if self._h:
+            self._lib.em_bpe_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

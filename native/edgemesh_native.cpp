@@ -1,0 +1,493 @@
+// edgemesh native runtime: CSV dataset loader + byte-level BPE tokenizer.
+//
+// The reference delegates these to native code in third-party wheels —
+// pandas' C CSV engine (Code/C-DAC Server/try.py:292) and HuggingFace's Rust
+// tokenizers (every loader, e.g. combiner_fp.py:276). This library is the
+// framework's own native provider for both, exposed through a plain C ABI
+// (ctypes-friendly; no pybind11 in the image).
+//
+// Build: `make -C native` → libedgemesh_native.so. Python side:
+// edgemesh/runtime/native.py (graceful fallback to pure-Python when absent).
+//
+// CSV: full RFC 4180 — quoted fields, escaped quotes ("") and embedded
+// newlines/commas (the Natural Questions dump uses all of them).
+//
+// BPE: GPT-2 style byte-level BPE (vocab.json + merges.txt, the format the
+// Pythia/GPT-NeoX family ships). The pre-tokenizer reproduces the GPT-2
+// pattern ('s|'t|'re|'ve|'m|'ll|'d| ?L+| ?N+| ?other+|ws(?!\S)|ws) with a
+// hand-rolled UTF-8 state machine; letter/number classes cover ASCII plus
+// common BMP ranges (full Unicode property tables are out of scope — parity
+// is asserted against HF tokenizers on the English eval corpus in tests).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// CSV loader
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Csv {
+  std::string data;                              // parsed cell bytes, concatenated
+  std::vector<std::pair<size_t, size_t>> cells;  // (offset, len) per cell
+  std::vector<size_t> row_start;                 // index into cells per row
+  size_t ncols = 0;
+};
+
+}  // namespace
+
+extern "C" void* em_csv_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::string raw;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  raw.resize(sz < 0 ? 0 : static_cast<size_t>(sz));
+  if (sz > 0 && std::fread(&raw[0], 1, raw.size(), f) != raw.size()) {
+    std::fclose(f);
+    return nullptr;
+  }
+  std::fclose(f);
+
+  Csv* csv = new Csv();
+  csv->data.reserve(raw.size());
+  std::string cell;
+  std::vector<std::pair<size_t, size_t>> row;
+  bool in_quotes = false;
+  bool line_has_content = false;  // blank lines become ZERO-cell rows,
+                                  // matching Python csv.reader's [] rows
+  auto push_cell = [&]() {
+    row.emplace_back(csv->data.size(), cell.size());
+    csv->data += cell;
+    cell.clear();
+  };
+  auto push_row = [&]() {
+    csv->row_start.push_back(csv->cells.size());
+    for (auto& c : row) csv->cells.push_back(c);
+    if (row.size() > csv->ncols) csv->ncols = row.size();
+    row.clear();
+  };
+  size_t i = 0, n = raw.size();
+  while (i < n) {
+    char c = raw[i];
+    if (in_quotes) {
+      if (c == '"') {
+        if (i + 1 < n && raw[i + 1] == '"') { cell += '"'; i += 2; continue; }
+        in_quotes = false;
+        i++;
+      } else {
+        cell += c;
+        i++;
+      }
+    } else if (c == '"') {
+      in_quotes = true;
+      line_has_content = true;
+      i++;
+    } else if (c == ',') {
+      push_cell();
+      line_has_content = true;
+      i++;
+    } else if (c == '\r') {
+      i++;  // swallow; \r\n handled at \n
+    } else if (c == '\n') {
+      if (line_has_content) push_cell();
+      push_row();
+      line_has_content = false;
+      i++;
+    } else {
+      cell += c;
+      line_has_content = true;
+      i++;
+    }
+  }
+  if (line_has_content) {  // last line without trailing newline
+    push_cell();
+    push_row();
+  }
+  csv->row_start.push_back(csv->cells.size());  // sentinel
+  return csv;
+}
+
+extern "C" long em_csv_rows(void* h) {
+  return h ? static_cast<long>(static_cast<Csv*>(h)->row_start.size()) - 1 : 0;
+}
+
+extern "C" long em_csv_cols(void* h, long row) {
+  if (!h) return 0;
+  Csv* csv = static_cast<Csv*>(h);
+  if (row < 0 || row + 1 >= static_cast<long>(csv->row_start.size())) return 0;
+  return static_cast<long>(csv->row_start[row + 1] - csv->row_start[row]);
+}
+
+extern "C" const char* em_csv_cell(void* h, long row, long col, long* len) {
+  *len = 0;
+  if (!h) return nullptr;
+  Csv* csv = static_cast<Csv*>(h);
+  if (row < 0 || row + 1 >= static_cast<long>(csv->row_start.size())) return nullptr;
+  size_t base = csv->row_start[row];
+  if (col < 0 || base + col >= csv->row_start[row + 1]) return nullptr;
+  auto& cell = csv->cells[base + col];
+  *len = static_cast<long>(cell.second);
+  return csv->data.data() + cell.first;
+}
+
+extern "C" void em_csv_close(void* h) { delete static_cast<Csv*>(h); }
+
+// ---------------------------------------------------------------------------
+// Byte-level BPE (GPT-2 / GPT-NeoX format)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+void append_utf8(std::string& out, uint32_t cp) {
+  if (cp < 0x80) {
+    out += static_cast<char>(cp);
+  } else if (cp < 0x800) {
+    out += static_cast<char>(0xC0 | (cp >> 6));
+    out += static_cast<char>(0x80 | (cp & 0x3F));
+  } else if (cp < 0x10000) {
+    out += static_cast<char>(0xE0 | (cp >> 12));
+    out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+    out += static_cast<char>(0x80 | (cp & 0x3F));
+  } else {
+    out += static_cast<char>(0xF0 | (cp >> 18));
+    out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+    out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+    out += static_cast<char>(0x80 | (cp & 0x3F));
+  }
+}
+
+// GPT-2 byte<->unicode bijection: printable latin bytes map to themselves,
+// the rest shift into 256+k so every byte is a printable codepoint.
+void byte_unicode_tables(std::vector<uint32_t>& b2u,
+                         std::unordered_map<uint32_t, uint8_t>& u2b) {
+  b2u.assign(256, 0);
+  int k = 0;
+  for (int b = 0; b < 256; ++b) {
+    bool printable =
+        (b >= '!' && b <= '~') || (b >= 0xA1 && b <= 0xAC) || (b >= 0xAE && b <= 0xFF);
+    b2u[b] = printable ? static_cast<uint32_t>(b) : 256 + k++;
+  }
+  for (int b = 0; b < 256; ++b) u2b[b2u[b]] = static_cast<uint8_t>(b);
+}
+
+uint32_t next_cp(const std::string& s, size_t& i) {
+  uint8_t c = s[i];
+  uint32_t cp;
+  int extra;
+  if (c < 0x80) { cp = c; extra = 0; }
+  else if ((c >> 5) == 0x6) { cp = c & 0x1F; extra = 1; }
+  else if ((c >> 4) == 0xE) { cp = c & 0x0F; extra = 2; }
+  else { cp = c & 0x07; extra = 3; }
+  i++;
+  for (int k = 0; k < extra && i < s.size(); ++k, ++i) cp = (cp << 6) | (s[i] & 0x3F);
+  return cp;
+}
+
+bool is_letter(uint32_t cp) {
+  if ((cp >= 'a' && cp <= 'z') || (cp >= 'A' && cp <= 'Z')) return true;
+  if (cp >= 0xC0 && cp <= 0xFF && cp != 0xD7 && cp != 0xF7) return true;  // Latin-1
+  if (cp == 0xAA || cp == 0xB5 || cp == 0xBA) return true;
+  if (cp >= 0x100 && cp <= 0x2AF) return true;  // Latin extended
+  if (cp >= 0x370 && cp <= 0x3FF && cp != 0x374 && cp != 0x375 && cp != 0x384 && cp != 0x385 && cp != 0x387) return true;  // Greek
+  if (cp >= 0x400 && cp <= 0x4FF) return true;  // Cyrillic
+  if (cp >= 0x4E00 && cp <= 0x9FFF) return true;  // CJK unified
+  if (cp >= 0x3040 && cp <= 0x30FF && cp != 0x3097 && cp != 0x3098) return true;  // kana
+  return false;
+}
+
+bool is_number(uint32_t cp) {
+  if (cp >= '0' && cp <= '9') return true;
+  if (cp == 0xB2 || cp == 0xB3 || cp == 0xB9 || (cp >= 0xBC && cp <= 0xBE)) return true;
+  return false;
+}
+
+bool is_space(uint32_t cp) {
+  return cp == ' ' || cp == '\t' || cp == '\n' || cp == '\r' || cp == 0x0B ||
+         cp == 0x0C || cp == 0x85 || cp == 0xA0 || cp == 0x2028 || cp == 0x2029 ||
+         (cp >= 0x2000 && cp <= 0x200A) || cp == 0x202F || cp == 0x205F || cp == 0x3000;
+}
+
+bool is_other(uint32_t cp) { return !is_space(cp) && !is_letter(cp) && !is_number(cp); }
+
+struct Bpe {
+  std::unordered_map<std::string, int> vocab;       // token string -> id
+  std::vector<std::string> id_to_tok;
+  std::unordered_map<std::string, int> merge_rank;  // "left right" -> rank
+  std::vector<uint32_t> b2u;
+  std::unordered_map<uint32_t, uint8_t> u2b;
+};
+
+// Minimal JSON {string: int} parser with \uXXXX (incl. surrogate pairs).
+bool parse_vocab_json(const std::string& text, std::unordered_map<std::string, int>& out) {
+  size_t i = 0, n = text.size();
+  auto skip_ws = [&]() {
+    while (i < n && (text[i] == ' ' || text[i] == '\n' || text[i] == '\t' || text[i] == '\r')) i++;
+  };
+  skip_ws();
+  if (i >= n || text[i] != '{') return false;
+  i++;
+  while (true) {
+    skip_ws();
+    if (i < n && text[i] == '}') return true;
+    if (i >= n || text[i] != '"') return false;
+    i++;
+    std::string key;
+    while (i < n && text[i] != '"') {
+      char c = text[i];
+      if (c == '\\') {
+        i++;
+        if (i >= n) return false;
+        char e = text[i];
+        if (e == 'u') {
+          if (i + 4 >= n) return false;
+          uint32_t cp = static_cast<uint32_t>(std::stoul(text.substr(i + 1, 4), nullptr, 16));
+          i += 5;
+          if (cp >= 0xD800 && cp <= 0xDBFF && i + 5 < n && text[i] == '\\' && text[i + 1] == 'u') {
+            uint32_t lo = static_cast<uint32_t>(std::stoul(text.substr(i + 2, 4), nullptr, 16));
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            i += 6;
+          }
+          append_utf8(key, cp);
+          continue;
+        }
+        switch (e) {
+          case 'n': key += '\n'; break;
+          case 't': key += '\t'; break;
+          case 'r': key += '\r'; break;
+          case 'b': key += '\b'; break;
+          case 'f': key += '\f'; break;
+          case '/': key += '/'; break;
+          case '\\': key += '\\'; break;
+          case '"': key += '"'; break;
+          default: key += e;
+        }
+        i++;
+      } else {
+        key += c;
+        i++;
+      }
+    }
+    i++;  // closing quote
+    skip_ws();
+    if (i >= n || text[i] != ':') return false;
+    i++;
+    skip_ws();
+    size_t start = i;
+    while (i < n && (isdigit(static_cast<unsigned char>(text[i])) || text[i] == '-')) i++;
+    if (start == i) return false;
+    out[key] = std::stoi(text.substr(start, i - start));
+    skip_ws();
+    if (i < n && text[i] == ',') { i++; continue; }
+    if (i < n && text[i] == '}') return true;
+    return false;
+  }
+}
+
+// GPT-2 pre-tokenizer over UTF-8 input; emits byte-span (start, len) pieces.
+// Ordered alternation of the GPT-2 pattern:
+//   's 't 're 've 'm 'll 'd           (case-sensitive, as in the original)
+//   " ?\p{L}+" | " ?\p{N}+" | " ?[^\s L N]+"
+//   "\s+(?!\S)" | "\s+"  — a whitespace run followed by more text yields its
+//   LAST char to the next piece (it becomes the " ?" prefix if it is a plain
+//   space, else it stands alone).
+void pretokenize(const std::string& s, std::vector<std::pair<size_t, size_t>>& pieces) {
+  size_t n = s.size();
+  auto class_run = [&](size_t from, bool (*pred)(uint32_t)) {
+    size_t j = from;
+    while (j < n) {
+      size_t t = j;
+      uint32_t c = next_cp(s, t);
+      if (!pred(c)) break;
+      j = t;
+    }
+    return j;
+  };
+  size_t i = 0;
+  while (i < n) {
+    size_t start = i;
+    size_t j = i;
+    uint32_t cp = next_cp(s, j);
+
+    if (cp == '\'' && j < n) {  // contractions (lowercase only, like GPT-2)
+      size_t k = j;
+      uint32_t c1 = next_cp(s, k);
+      if (c1 == 's' || c1 == 't' || c1 == 'm' || c1 == 'd') {
+        pieces.emplace_back(start, k - start);
+        i = k;
+        continue;
+      }
+      if (k < n && (c1 == 'r' || c1 == 'v' || c1 == 'l')) {
+        size_t k2 = k;
+        uint32_t c2 = next_cp(s, k2);
+        if ((c1 == 'r' && c2 == 'e') || (c1 == 'v' && c2 == 'e') ||
+            (c1 == 'l' && c2 == 'l')) {
+          pieces.emplace_back(start, k2 - start);
+          i = k2;
+          continue;
+        }
+      }
+    }
+
+    // " ?X+" — optional single literal-space prefix before a class run.
+    size_t body = start;
+    uint32_t head = cp;
+    if (cp == ' ' && j < n) {
+      size_t k = j;
+      uint32_t c1 = next_cp(s, k);
+      if (!is_space(c1)) { body = j; head = c1; }
+    }
+    if (!is_space(head)) {
+      size_t end_;
+      if (is_letter(head)) end_ = class_run(body, is_letter);
+      else if (is_number(head)) end_ = class_run(body, is_number);
+      else end_ = class_run(body, is_other);
+      pieces.emplace_back(start, end_ - start);
+      i = end_;
+      continue;
+    }
+
+    // Whitespace run [start, k); `prev` is the offset of its last char.
+    size_t k = start;
+    size_t prev = start;
+    while (k < n) {
+      size_t t = k;
+      uint32_t c = next_cp(s, t);
+      if (!is_space(c)) break;
+      prev = k;
+      k = t;
+    }
+    if (k >= n || prev == start) {
+      // Trailing run, or a single non-' ' whitespace char before text.
+      pieces.emplace_back(start, k - start);
+      i = k;
+    } else {
+      // Run followed by text: keep the last whitespace char for the next
+      // piece ("\s+(?!\S)" semantics).
+      pieces.emplace_back(start, prev - start);
+      i = prev;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" void* em_bpe_open(const char* vocab_path, const char* merges_path) {
+  FILE* vf = std::fopen(vocab_path, "rb");
+  if (!vf) return nullptr;
+  std::string vtext;
+  std::fseek(vf, 0, SEEK_END);
+  long vs = std::ftell(vf);
+  std::fseek(vf, 0, SEEK_SET);
+  vtext.resize(vs < 0 ? 0 : static_cast<size_t>(vs));
+  if (vs > 0 && std::fread(&vtext[0], 1, vtext.size(), vf) != vtext.size()) {
+    std::fclose(vf);
+    return nullptr;
+  }
+  std::fclose(vf);
+
+  Bpe* bpe = new Bpe();
+  byte_unicode_tables(bpe->b2u, bpe->u2b);
+  if (!parse_vocab_json(vtext, bpe->vocab)) { delete bpe; return nullptr; }
+  int max_id = -1;
+  for (auto& kv : bpe->vocab) max_id = kv.second > max_id ? kv.second : max_id;
+  bpe->id_to_tok.assign(max_id + 1, "");
+  for (auto& kv : bpe->vocab) bpe->id_to_tok[kv.second] = kv.first;
+
+  FILE* mf = std::fopen(merges_path, "rb");
+  if (!mf) { delete bpe; return nullptr; }
+  char line[4096];
+  int rank = 0;
+  bool first = true;
+  while (std::fgets(line, sizeof(line), mf)) {
+    std::string l(line);
+    while (!l.empty() && (l.back() == '\n' || l.back() == '\r')) l.pop_back();
+    if (first && l.rfind("#version", 0) == 0) { first = false; continue; }
+    first = false;
+    if (l.empty()) continue;
+    bpe->merge_rank[l] = rank++;
+  }
+  std::fclose(mf);
+  return bpe;
+}
+
+extern "C" long em_bpe_vocab_size(void* h) {
+  return h ? static_cast<long>(static_cast<Bpe*>(h)->id_to_tok.size()) : 0;
+}
+
+extern "C" long em_bpe_token_id(void* h, const char* tok) {
+  if (!h) return -1;
+  Bpe* bpe = static_cast<Bpe*>(h);
+  auto it = bpe->vocab.find(tok);
+  return it == bpe->vocab.end() ? -1 : it->second;
+}
+
+extern "C" long em_bpe_encode(void* h, const char* text, long text_len, int32_t* out,
+                              long max_out) {
+  if (!h) return -1;
+  Bpe* bpe = static_cast<Bpe*>(h);
+  std::string s(text, text_len);
+  std::vector<std::pair<size_t, size_t>> pieces;
+  pretokenize(s, pieces);
+
+  long count = 0;
+  std::vector<std::string> parts;
+  for (auto& piece : pieces) {
+    parts.clear();  // bytes -> unicode symbols (one string per byte)
+    for (size_t b = 0; b < piece.second; ++b) {
+      uint8_t byte = static_cast<uint8_t>(s[piece.first + b]);
+      std::string sym;
+      append_utf8(sym, bpe->b2u[byte]);
+      parts.push_back(sym);
+    }
+    while (parts.size() > 1) {  // greedy lowest-rank merging
+      int best_rank = INT32_MAX;
+      size_t best_i = 0;
+      for (size_t k = 0; k + 1 < parts.size(); ++k) {
+        auto it = bpe->merge_rank.find(parts[k] + " " + parts[k + 1]);
+        if (it != bpe->merge_rank.end() && it->second < best_rank) {
+          best_rank = it->second;
+          best_i = k;
+        }
+      }
+      if (best_rank == INT32_MAX) break;
+      parts[best_i] = parts[best_i] + parts[best_i + 1];
+      parts.erase(parts.begin() + best_i + 1);
+    }
+    for (auto& p : parts) {
+      auto it = bpe->vocab.find(p);
+      if (it == bpe->vocab.end()) continue;  // GPT-2 vocabs are byte-complete
+      if (count < max_out) out[count] = it->second;
+      count++;
+    }
+  }
+  return count;
+}
+
+extern "C" long em_bpe_decode(void* h, const int32_t* ids, long n, char* out, long max_out) {
+  if (!h) return -1;
+  Bpe* bpe = static_cast<Bpe*>(h);
+  std::string text;
+  for (long k = 0; k < n; ++k) {
+    if (ids[k] < 0 || ids[k] >= static_cast<long>(bpe->id_to_tok.size())) continue;
+    const std::string& tok = bpe->id_to_tok[ids[k]];
+    size_t i = 0;
+    while (i < tok.size()) {
+      uint32_t cp = next_cp(tok, i);
+      auto it = bpe->u2b.find(cp);
+      if (it != bpe->u2b.end()) text += static_cast<char>(it->second);
+    }
+  }
+  long sz = static_cast<long>(text.size());
+  if (sz > max_out) sz = max_out;
+  std::memcpy(out, text.data(), sz);
+  return static_cast<long>(text.size());
+}
+
+extern "C" void em_bpe_close(void* h) { delete static_cast<Bpe*>(h); }

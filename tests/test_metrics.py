@@ -84,3 +84,11 @@ def test_hashing_embedder_deterministic():
     v1 = e1(["some text here"])
     v2 = e2(["some text here"])
     np.testing.assert_array_equal(v1, v2)
+
+
+def test_unknown_metric_name_rejected():
+    import pytest
+    from edgemesh.eval.harness import score_sample
+
+    with pytest.raises(ValueError, match="unknown metrics"):
+        score_sample("a", "b", metrics=["rouge"])  # the real keys are rouge1/2/L

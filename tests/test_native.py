@@ -1,0 +1,183 @@
+"""Native C++ runtime (CSV loader + BPE tokenizer) vs pure-Python oracles.
+
+Skips cleanly when no C++ toolchain is available — the native layer is an
+accelerator, never a hard dependency.
+"""
+
+import csv
+import json
+from pathlib import Path
+
+import pytest
+
+from edgemesh.runtime.native import load_native
+
+pytestmark = pytest.mark.skipif(load_native() is None, reason="no native toolchain")
+
+NQ_CSV = Path("/root/reference/Code/Dataset/natural_questions_1000.csv")
+
+
+# ---------------------------------------------------------------------------
+# CSV
+# ---------------------------------------------------------------------------
+
+
+def test_csv_matches_stdlib_on_tricky_file(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(
+        'query,answer\n'
+        '"hello, world","line1\nline2"\n'
+        '\n'
+        'plain,"embedded ""quotes"" here"\n'
+        'trailing,empty\n'
+        '\n'
+        '"final, no newline","ok"',
+        encoding="utf-8",
+    )
+    from edgemesh.runtime.native import NativeCSV
+
+    table = NativeCSV(p)
+    with open(p, newline="", encoding="utf-8") as f:
+        rows = list(csv.reader(f))
+    assert table.num_rows == len(rows)
+    for r, row in enumerate(rows):
+        assert table.num_cols(r) == len(row)
+        for c, want in enumerate(row):
+            assert table.cell(r, c) == want, (r, c)
+    table.close()
+
+
+@pytest.mark.skipif(not NQ_CSV.exists(), reason="reference dataset not mounted")
+def test_csv_loader_parity_on_reference_dataset():
+    from edgemesh.eval.data import _load_qa_csv_native, _load_qa_csv_py
+
+    native = _load_qa_csv_native(NQ_CSV, None)
+    python = _load_qa_csv_py(NQ_CSV, None)
+    assert len(native) == len(python) == 1000
+    for a, b in zip(native, python):
+        assert (a.index, a.question, a.answer) == (b.index, b.question, b.answer)
+
+
+# ---------------------------------------------------------------------------
+# BPE tokenizer
+# ---------------------------------------------------------------------------
+
+
+def _tiny_gpt2_files(tmp_path: Path) -> Path:
+    """Build a small but real GPT-2-format vocab: all 256 byte symbols plus
+    merges learned for common English fragments."""
+    # GPT-2 byte->unicode map (mirrors the C++ table).
+    printable = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(0xA1, 0xAD))
+        + list(range(0xAE, 0x100))
+    )
+    b2u = {}
+    k = 0
+    for b in range(256):
+        if b in printable:
+            b2u[b] = chr(b)
+        else:
+            b2u[b] = chr(256 + k)
+            k += 1
+    vocab = {}
+    for b in range(256):
+        vocab[b2u[b]] = len(vocab)
+    merges = []
+
+    def add_merge(a, b):
+        merges.append(f"{a} {b}")
+        tok = a + b
+        if tok not in vocab:
+            vocab[tok] = len(vocab)
+
+    sp = b2u[ord(" ")]  # 'Ġ'
+    for pair in [
+        ("t", "h"), ("th", "e"), (sp, "th"), (sp + "th", "e"),
+        ("i", "n"), ("a", "n"), ("an", "d"), (sp, "an"), (sp + "an", "d"),
+        ("e", "r"), ("o", "n"), (sp, "w"), (sp + "w", "h"),
+        ("1", "9"), ("19", "9"), ("'", "s"),
+    ]:
+        add_merge(*pair)
+    vocab["<|endoftext|>"] = len(vocab)
+    (tmp_path / "vocab.json").write_text(json.dumps(vocab), encoding="utf-8")
+    (tmp_path / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(merges) + "\n", encoding="utf-8"
+    )
+    return tmp_path
+
+
+CASES = [
+    "the cat sat on the mat",
+    "What's the airspeed? I'll check — they've asked 1999 times!",
+    "  leading and   multiple   spaces  ",
+    "line one\nline two\n\n  indented",
+    "don't stop, can't won't SHOULDN'T",
+    "numbers 123 and 456,789.0 mixed2with3words",
+    "tabs\there\tand trailing spaces   ",
+    "punctuation!!! ... ??? ((nested))",
+    "",
+    "unicode café naïve — em—dash",
+]
+
+
+def test_bpe_matches_hf_tokenizers(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    d = _tiny_gpt2_files(tmp_path)
+    hf = transformers.GPT2TokenizerFast(
+        vocab_file=str(d / "vocab.json"), merges_file=str(d / "merges.txt")
+    )
+    from edgemesh.runtime.native import NativeBPE
+
+    tok = NativeBPE(d)
+    assert tok.vocab_size == len(hf)
+    for text in CASES:
+        got = tok.encode(text)
+        want = hf.encode(text)
+        assert got == want, f"{text!r}: {got} != {want}"
+        assert tok.decode(got) == hf.decode(want)
+    tok.close()
+
+
+def test_csv_blank_lines_skipped_like_dictreader(tmp_path):
+    from edgemesh.eval.data import _load_qa_csv_native, _load_qa_csv_py
+
+    p = tmp_path / "blank.csv"
+    p.write_text("query,answer\nq1,a1\n\nq2,a2\n\n", encoding="utf-8")
+    native = _load_qa_csv_native(p, None)
+    python = _load_qa_csv_py(p, None)
+    assert [(s.question, s.answer) for s in native] == \
+        [(s.question, s.answer) for s in python] == [("q1", "a1"), ("q2", "a2")]
+
+
+def test_bpe_decode_of_long_tokens_not_truncated(tmp_path):
+    import json as _json
+    d = _tiny_gpt2_files(tmp_path)
+    vocab = _json.loads((d / "vocab.json").read_text())
+    vocab["a" * 40] = len(vocab)  # longer than decode's initial 16-bytes/id guess
+    (d / "vocab.json").write_text(_json.dumps(vocab), encoding="utf-8")
+    from edgemesh.runtime.native import NativeBPE
+
+    tok = NativeBPE(d)
+    assert tok.decode([vocab["a" * 40]]) == "a" * 40
+    tok.close()
+
+
+def test_bpe_roundtrips_arbitrary_bytes(tmp_path):
+    d = _tiny_gpt2_files(tmp_path)
+    from edgemesh.runtime.native import NativeBPE
+
+    tok = NativeBPE(d)
+    for text in CASES + ["emoji 🎉 and ünïcödé ẽverywhere"]:
+        assert tok.decode(tok.encode(text)) == text
+    tok.close()
+
+
+def test_bpe_eos_and_protocol(tmp_path):
+    d = _tiny_gpt2_files(tmp_path)
+    from edgemesh.runtime.native import NativeBPE
+
+    tok = NativeBPE(d)
+    assert tok.eos_id == tok.pad_id == tok.vocab_size - 1  # <|endoftext|> last
+    assert tok.encode("the", max_len=1) == tok.encode("the")[:1]
+    tok.close()
