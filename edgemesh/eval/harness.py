@@ -41,16 +41,26 @@ METRIC_KEYS = [
 ]
 
 
+def _validate_metrics(metrics: list[str] | None) -> None:
+    """Reject typo'd metric names. Called at run_eval ENTRY (outside the
+    per-sample zero-fill try/except — a bad name must fail fast, not burn
+    1000 generate calls producing all-zero rows) and in score_sample for
+    direct callers."""
+    if metrics is None:
+        return
+    unknown = set(metrics) - set(METRIC_KEYS)
+    if unknown:
+        raise ValueError(f"unknown metrics {sorted(unknown)}; choose from {METRIC_KEYS}")
+
+
 def score_sample(
     prediction: str, reference: str, embedder=None, metrics: list[str] | None = None
 ) -> dict[str, float]:
     """Score one prediction. ``metrics`` (None = all) selects which metric
     families actually run, so e.g. dropping bertscore/cosine skips the
     embedding work entirely."""
+    _validate_metrics(metrics)
     want = set(metrics) if metrics is not None else set(METRIC_KEYS)
-    unknown = want - set(METRIC_KEYS)
-    if unknown:  # a typo here would otherwise silently drop the metric
-        raise ValueError(f"unknown metrics {sorted(unknown)}; choose from {METRIC_KEYS}")
     embedder = embedder or _default_embedder()
     row: dict[str, float] = {}
     if want & {"rouge1", "rouge2", "rougeL", "avg_rouge"}:
@@ -106,6 +116,7 @@ def run_eval(
     re-answered, not silently merged), and the report aggregates exactly the
     rows of THIS sample list.
     """
+    _validate_metrics(metrics)  # fail fast — not inside the zero-fill loop
     out_path = Path(output_jsonl)
     done = _load_done(out_path) if resume else {}
     # A persisted row is reusable only if it is for the SAME question, is not

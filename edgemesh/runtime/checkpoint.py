@@ -1,0 +1,137 @@
+"""Checkpoint / resume via orbax — the subsystem the reference lacks entirely
+(SURVEY.md §5.4: an evaluation interrupted at sample 999 restarts from zero;
+weights only exist as HF ``save_pretrained`` snapshots, download.py:20-24).
+
+Three layers of durability here:
+
+- **Weights / train state** (this module): orbax PyTree checkpoints. Sharded
+  arrays save and restore with their ``NamedSharding`` preserved; restoring
+  onto a DIFFERENT mesh layout just needs the target sharding tree
+  (``restore(..., template=...)`` with device_put'd leaves or abstract
+  shapes), which is how a training run moves between chip counts.
+- **Eval progress**: already durable — the harness appends one JSON line per
+  sample and resumes by replay (eval/harness.py).
+- **Serving**: ``snapshot_for_serving``/``restore_for_serving`` give the
+  health-checked REST loop (serve/rest.py) a deterministic restart point
+  (SURVEY.md §5.3's failure-recovery requirement; inference-only, so params
+  + config are the whole state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from edgemesh.models.transformer import ModelConfig
+
+
+def _as_path(path: str | Path) -> Path:
+    return Path(path).expanduser().resolve()
+
+
+def _as_abstract(template: Any) -> Any:
+    """Template pytree → jax.ShapeDtypeStruct leaves (shardings preserved);
+    leaves that are already abstract pass through."""
+    return jax.tree.map(
+        lambda x: x
+        if isinstance(x, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+        ),
+        template,
+    )
+
+
+def save_pytree(path: str | Path, tree: Any) -> None:
+    """Write one pytree (params or full train state) as an orbax checkpoint.
+    Overwrites any existing checkpoint at ``path``."""
+    path = _as_path(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def restore_pytree(path: str | Path, template: Any | None = None) -> Any:
+    """Restore a pytree. With ``template`` (a pytree of arrays or
+    jax.ShapeDtypeStruct with shardings), leaves land directly in the target
+    placement/dtype; without it, leaves restore host-resident as saved."""
+    path = _as_path(path)
+    ckptr = ocp.StandardCheckpointer()
+    if template is None:
+        return ckptr.restore(path)
+    return ckptr.restore(path, _as_abstract(template))
+
+
+class TrainCheckpointManager:
+    """Rotating step checkpoints for training loops (keep the latest N).
+
+    Thin wrapper over ocp.CheckpointManager so training code stays one-call:
+    ``mgr.save(step, state)`` / ``state, step = mgr.restore_latest(state)``.
+    """
+
+    def __init__(self, directory: str | Path, max_to_keep: int = 3):
+        self._mgr = ocp.CheckpointManager(
+            _as_path(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, template: Any) -> tuple[Any, int] | None:
+        """Restore the newest checkpoint into ``template``'s placements, or
+        None when the directory has no checkpoints (fresh run)."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        state = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(_as_abstract(template))
+        )
+        return state, step
+
+    def close(self):
+        self._mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Serving snapshots: params + the exact ModelConfig, restartable in one call
+# ---------------------------------------------------------------------------
+
+
+def snapshot_for_serving(directory: str | Path, cfg: ModelConfig, params: Any) -> None:
+    """Persist everything a serving process needs to come back identically."""
+    directory = _as_path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    (directory / "model_config.json").write_text(
+        json.dumps(dataclasses.asdict(cfg), indent=2)
+    )
+    save_pytree(directory / "params", params)
+
+
+def restore_for_serving(
+    directory: str | Path, mesh=None
+) -> tuple[ModelConfig, Any]:
+    """Load (cfg, params) from a serving snapshot. With ``mesh``, params are
+    placed straight onto it via the standard param shardings."""
+    directory = _as_path(directory)
+    cfg_path = directory / "model_config.json"
+    if not cfg_path.exists():
+        raise FileNotFoundError(f"no serving snapshot at {directory}")
+    cfg = ModelConfig(**json.loads(cfg_path.read_text()))
+    params = restore_pytree(directory / "params")
+    if mesh is not None:
+        from edgemesh.parallel.sharding import shard_params
+
+        params = shard_params(params, cfg, mesh)
+    return cfg, params

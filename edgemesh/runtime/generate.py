@@ -188,20 +188,24 @@ def generate(
         )
     rng = rng if rng is not None else jax.random.PRNGKey(sampling.seed)
 
+    from edgemesh.utils.tracing import trace
+
     t0 = time.perf_counter()
-    first_logits, cache = prefill_fn(cfg, params, tokens, lengths, cache)
-    first_logits.block_until_ready()
+    with trace("edgemesh/prefill"):
+        first_logits, cache = prefill_fn(cfg, params, tokens, lengths, cache)
+        first_logits.block_until_ready()
     t1 = time.perf_counter()
 
     valid = jnp.arange(prompt_len)[None, :] < lengths[:, None]
     token_mask = (
         TokenMaskState.init(batch, cfg.vocab_size).add_sequence(tokens, valid).mask
     )
-    out, num_generated, cache, confidence = _decode_loop(
-        cfg, params, sampling, max_new, int(eos_id), first_logits, cache,
-        token_mask, rng, decode_fn,
-    )
-    out.block_until_ready()
+    with trace("edgemesh/decode"):
+        out, num_generated, cache, confidence = _decode_loop(
+            cfg, params, sampling, max_new, int(eos_id), first_logits, cache,
+            token_mask, rng, decode_fn,
+        )
+        out.block_until_ready()
     t2 = time.perf_counter()
 
     total_generated = int(jnp.sum(num_generated))

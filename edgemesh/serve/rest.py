@@ -22,7 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 log = logging.getLogger("edgemesh.serve")
 
 
-def _make_handler(ensemble):
+def _make_handler(ensemble, supervisor=None):
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict):
             body = json.dumps(payload).encode()
@@ -47,6 +47,13 @@ def _make_handler(ensemble):
                         + ([ensemble.refiner.role] if ensemble.refiner else []),
                     },
                 )
+            elif self.path == "/metrics":
+                from edgemesh.utils.tracing import phase_report
+
+                payload = {"phases": phase_report()}
+                if supervisor is not None:
+                    payload["supervisor"] = supervisor.health()
+                self._send(200, payload)
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -61,7 +68,10 @@ def _make_handler(ensemble):
                 if not question:
                     self._send(400, {"error": "missing 'question' field"})
                     return
-                result = ensemble.answer(question)
+                if supervisor is not None:
+                    result = supervisor.call(question)
+                else:
+                    result = ensemble.answer(question)
                 self._send(200, result)
             except json.JSONDecodeError:
                 self._send(400, {"error": "invalid JSON body"})
@@ -75,9 +85,14 @@ def _make_handler(ensemble):
     return Handler
 
 
-def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = True):
-    """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15)."""
-    server = ThreadingHTTPServer((host, port), _make_handler(ensemble))
+def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = True,
+               supervisor=None):
+    """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
+
+    With a ``supervisor`` (serve/supervisor.py), /generate routes through its
+    failure-tracked call path and /metrics exposes its health, giving the
+    gateway crash-recovery the reference's fabric never had."""
+    server = ThreadingHTTPServer((host, port), _make_handler(ensemble, supervisor))
     log.info("edgemesh REST gateway on %s:%d", host, port)
     if block:
         server.serve_forever()

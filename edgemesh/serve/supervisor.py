@@ -1,0 +1,158 @@
+"""Failure detection + deterministic restart for the serving loop.
+
+The reference's failure story is a troubleshooting table in a README
+(``Code/gRPC/README.md:59-66``) and per-sample try/except zero-fill
+(``combiner_fp.py:448-454``); a crashed model process stays crashed
+(SURVEY.md §5.3). Here the serving path gets a real supervisor:
+
+- every request is health-tracked (consecutive-failure counter, last
+  success/failure timestamps, rolling latency);
+- after ``max_consecutive_failures`` the supervisor declares the backend
+  unhealthy and rebuilds it from its factory — for model backends that means
+  re-materializing params from the serving snapshot
+  (runtime/checkpoint.snapshot_for_serving), which is deterministic:
+  inference-only state is params + config, nothing else to lose;
+- restarts are bounded (``max_restarts``) so a poisoned snapshot cannot
+  flap forever; past the budget the supervisor reports permanently degraded
+  and surfaces the last error instead of looping.
+
+Events are appended to a JSONL log (one object per line — the same
+structured-log convention as the eval harness) for offline inspection.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from edgemesh.utils.tracing import JsonlLogger
+
+log = logging.getLogger("edgemesh.supervisor")
+
+
+class Supervisor:
+    """Wraps a request handler with health tracking and restart-from-factory.
+
+    ``factory`` builds (or rebuilds) the backend; ``handler(backend, request)``
+    serves one request. The supervisor owns the backend instance.
+    """
+
+    def __init__(
+        self,
+        factory: Callable[[], Any],
+        handler: Callable[[Any, Any], Any],
+        max_consecutive_failures: int = 3,
+        max_restarts: int = 5,
+        event_log: str | Path | None = None,
+        latency_window: int = 100,
+    ):
+        self._factory = factory
+        self._handler = handler
+        self._max_fail = max_consecutive_failures
+        self._max_restarts = max_restarts
+        self._logger = JsonlLogger(event_log) if event_log else None
+        self._lock = threading.Lock()
+        self._restart_in_progress = False
+
+        self.backend = factory()
+        self.consecutive_failures = 0
+        self.total_failures = 0
+        self.total_requests = 0
+        self.restarts = 0
+        self.degraded = False
+        self.last_error: str | None = None
+        self.last_success_ts: float | None = None
+        self.last_failure_ts: float | None = None
+        self._latencies: deque[float] = deque(maxlen=latency_window)
+        self._event("start")
+
+    # -- health ------------------------------------------------------------
+
+    def health(self) -> dict[str, Any]:
+        with self._lock:
+            lat = sorted(self._latencies)
+            p50 = lat[len(lat) // 2] if lat else None
+            return {
+                "healthy": not self.degraded,
+                "degraded": self.degraded,
+                "total_requests": self.total_requests,
+                "total_failures": self.total_failures,
+                "consecutive_failures": self.consecutive_failures,
+                "restarts": self.restarts,
+                "last_error": self.last_error,
+                "last_success_ts": self.last_success_ts,
+                "last_failure_ts": self.last_failure_ts,
+                "p50_latency_s": p50,
+            }
+
+    def _event(self, kind: str, **extra):
+        if self._logger is not None:
+            self._logger.log(kind, **extra)
+
+    # -- serving -----------------------------------------------------------
+
+    def call(self, request: Any) -> Any:
+        """Serve one request; raises the backend's exception to the caller
+        after recording it (the HTTP layer turns it into a 5xx)."""
+        with self._lock:
+            self.total_requests += 1
+        t0 = time.perf_counter()
+        try:
+            result = self._handler(self.backend, request)
+        except Exception as exc:
+            with self._lock:
+                self.total_failures += 1
+                self.consecutive_failures += 1
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                self.last_failure_ts = time.time()
+                # One restart per incident: the thread that trips the
+                # threshold claims the restart; concurrent failures while it
+                # is rebuilding must not burn extra budget.
+                need_restart = (
+                    self.consecutive_failures >= self._max_fail
+                    and not self.degraded
+                    and not self._restart_in_progress
+                )
+                if need_restart:
+                    self._restart_in_progress = True
+            self._event("request_failed", error=self.last_error)
+            if need_restart:
+                try:
+                    self.restart(reason=self.last_error)
+                finally:
+                    with self._lock:
+                        self._restart_in_progress = False
+            raise
+        with self._lock:
+            self.consecutive_failures = 0
+            self.last_success_ts = time.time()
+            self._latencies.append(time.perf_counter() - t0)
+        return result
+
+    def restart(self, reason: str = "manual") -> bool:
+        """Rebuild the backend from the factory. Returns True on success."""
+        with self._lock:
+            if self.restarts >= self._max_restarts:
+                self.degraded = True
+                self._event("degraded", reason=reason)
+                log.error("supervisor degraded (restart budget spent): %s", reason)
+                return False
+            self.restarts += 1
+        log.warning("restarting backend (restart %d): %s", self.restarts, reason)
+        self._event("restart", reason=reason, attempt=self.restarts)
+        try:
+            new_backend = self._factory()
+        except Exception as exc:
+            with self._lock:
+                self.last_error = f"restart failed: {type(exc).__name__}: {exc}"
+                self.degraded = self.restarts >= self._max_restarts
+            self._event("restart_failed", error=self.last_error)
+            return False
+        with self._lock:
+            self.backend = new_backend
+            self.consecutive_failures = 0
+        self._event("restart_ok", attempt=self.restarts)
+        return True

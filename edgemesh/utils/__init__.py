@@ -1,0 +1,7 @@
+from edgemesh.utils.tracing import (  # noqa: F401
+    JsonlLogger,
+    capture_profile,
+    phase_report,
+    reset_phases,
+    trace,
+)

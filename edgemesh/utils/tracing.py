@@ -1,0 +1,101 @@
+"""Tracing / profiling / structured logs (SURVEY.md §5.1, §5.5).
+
+The reference's observability is ``time.time()`` deltas around ``generate``
+and log lines pasted into a spreadsheet (``combiner_fp.py:336-350``,
+``try.py:309-337``). Here:
+
+- ``trace(name)``: context manager that both stamps a ``jax.profiler``
+  TraceAnnotation (visible in TensorBoard/XProf timelines when a profile is
+  being captured) and accumulates wall time into a process-local registry.
+- ``phase_report()`` / ``reset_phases()``: read/clear the accumulated
+  per-phase totals — how prefill vs decode split is measured without a
+  profiler attached.
+- ``capture_profile(dir)``: whole-program XLA profile capture
+  (jax.profiler.start_trace/stop_trace) for the real deep-dives.
+- ``JsonlLogger``: one-JSON-object-per-line run logs, the same convention
+  as the eval harness's results.jsonl and the supervisor's event log.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Any
+
+_lock = threading.Lock()
+_phase_totals: dict[str, float] = defaultdict(float)
+_phase_counts: dict[str, int] = defaultdict(int)
+
+
+@contextmanager
+def trace(name: str):
+    """Annotate a region for the JAX profiler AND accumulate its wall time."""
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with _lock:
+                _phase_totals[name] += dt
+                _phase_counts[name] += 1
+
+
+def phase_report() -> dict[str, dict[str, float]]:
+    """{name: {total_s, count, mean_s}} for every traced region so far."""
+    with _lock:
+        return {
+            name: {
+                "total_s": _phase_totals[name],
+                "count": _phase_counts[name],
+                "mean_s": _phase_totals[name] / max(_phase_counts[name], 1),
+            }
+            for name in _phase_totals
+        }
+
+
+def reset_phases() -> None:
+    with _lock:
+        _phase_totals.clear()
+        _phase_counts.clear()
+
+
+@contextmanager
+def capture_profile(log_dir: str | Path):
+    """Capture a full device/host profile under ``log_dir`` (TensorBoard
+    'profile' plugin format). Wrap ONE representative region — traces are
+    large."""
+    import jax
+
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class JsonlLogger:
+    """Append-only structured run log; every record gets a timestamp."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def log(self, event: str, **fields: Any) -> None:
+        record = {"ts": time.time(), "event": event, **fields}
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+
+    def read(self) -> list[dict]:
+        if not self.path.exists():
+            return []
+        with open(self.path) as f:
+            return [json.loads(line) for line in f if line.strip()]

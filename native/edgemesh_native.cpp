@@ -20,6 +20,7 @@
 // is asserted against HF tokenizers on the English eval corpus in tests).
 
 #include <cstdint>
+#include <memory>
 #include <cstdio>
 #include <cstring>
 #include <string>
@@ -94,7 +95,13 @@ extern "C" void* em_csv_open(const char* path) {
       line_has_content = true;
       i++;
     } else if (c == '\r') {
-      i++;  // swallow; \r\n handled at \n
+      // Row terminator, like csv.reader: lone CR (classic-Mac) ends the
+      // record; CRLF consumes the LF too.
+      if (line_has_content) push_cell();
+      push_row();
+      line_has_content = false;
+      i++;
+      if (i < n && raw[i] == '\n') i++;
     } else if (c == '\n') {
       if (line_has_content) push_cell();
       push_row();
@@ -378,7 +385,11 @@ void pretokenize(const std::string& s, std::vector<std::pair<size_t, size_t>>& p
 
 }  // namespace
 
-extern "C" void* em_bpe_open(const char* vocab_path, const char* merges_path) {
+// C++ exceptions must never cross the C ABI into ctypes (std::terminate →
+// SIGABRT kills the Python process). A corrupt vocab (bad \u escape → stoul
+// throws, id beyond int → stoi throws, OOM) returns nullptr like a missing
+// file — the Python layer's documented graceful-fallback contract.
+extern "C" void* em_bpe_open(const char* vocab_path, const char* merges_path) try {
   FILE* vf = std::fopen(vocab_path, "rb");
   if (!vf) return nullptr;
   std::string vtext;
@@ -392,16 +403,17 @@ extern "C" void* em_bpe_open(const char* vocab_path, const char* merges_path) {
   }
   std::fclose(vf);
 
-  Bpe* bpe = new Bpe();
+  std::unique_ptr<Bpe> bpe(new Bpe());
   byte_unicode_tables(bpe->b2u, bpe->u2b);
-  if (!parse_vocab_json(vtext, bpe->vocab)) { delete bpe; return nullptr; }
+  if (!parse_vocab_json(vtext, bpe->vocab)) return nullptr;
   int max_id = -1;
   for (auto& kv : bpe->vocab) max_id = kv.second > max_id ? kv.second : max_id;
+  if (max_id < 0) return nullptr;
   bpe->id_to_tok.assign(max_id + 1, "");
   for (auto& kv : bpe->vocab) bpe->id_to_tok[kv.second] = kv.first;
 
   FILE* mf = std::fopen(merges_path, "rb");
-  if (!mf) { delete bpe; return nullptr; }
+  if (!mf) return nullptr;
   char line[4096];
   int rank = 0;
   bool first = true;
@@ -414,7 +426,9 @@ extern "C" void* em_bpe_open(const char* vocab_path, const char* merges_path) {
     bpe->merge_rank[l] = rank++;
   }
   std::fclose(mf);
-  return bpe;
+  return bpe.release();
+} catch (...) {
+  return nullptr;  // corrupt input or OOM — same contract as a missing file
 }
 
 extern "C" long em_bpe_vocab_size(void* h) {
@@ -429,7 +443,7 @@ extern "C" long em_bpe_token_id(void* h, const char* tok) {
 }
 
 extern "C" long em_bpe_encode(void* h, const char* text, long text_len, int32_t* out,
-                              long max_out) {
+                              long max_out) try {
   if (!h) return -1;
   Bpe* bpe = static_cast<Bpe*>(h);
   std::string s(text, text_len);
@@ -468,9 +482,11 @@ extern "C" long em_bpe_encode(void* h, const char* text, long text_len, int32_t*
     }
   }
   return count;
+} catch (...) {
+  return -1;
 }
 
-extern "C" long em_bpe_decode(void* h, const int32_t* ids, long n, char* out, long max_out) {
+extern "C" long em_bpe_decode(void* h, const int32_t* ids, long n, char* out, long max_out) try {
   if (!h) return -1;
   Bpe* bpe = static_cast<Bpe*>(h);
   std::string text;
@@ -488,6 +504,8 @@ extern "C" long em_bpe_decode(void* h, const int32_t* ids, long n, char* out, lo
   if (sz > max_out) sz = max_out;
   std::memcpy(out, text.data(), sz);
   return static_cast<long>(text.size());
+} catch (...) {
+  return -1;
 }
 
 extern "C" void em_bpe_close(void* h) { delete static_cast<Bpe*>(h); }

@@ -181,3 +181,26 @@ def test_bpe_eos_and_protocol(tmp_path):
     assert tok.eos_id == tok.pad_id == tok.vocab_size - 1  # <|endoftext|> last
     assert tok.encode("the", max_len=1) == tok.encode("the")[:1]
     tok.close()
+
+
+def test_csv_lone_cr_is_row_terminator(tmp_path):
+    p = tmp_path / "mac.csv"
+    p.write_bytes(b"query,answer\rq1,a1\rq2,a2")
+    from edgemesh.runtime.native import NativeCSV
+
+    table = NativeCSV(p)
+    with open(p, newline="", encoding="utf-8") as f:
+        rows = list(csv.reader(f))
+    assert table.num_rows == len(rows) == 3
+    for r, row in enumerate(rows):
+        assert [table.cell(r, c) for c in range(table.num_cols(r))] == row
+    table.close()
+
+
+def test_corrupt_vocab_returns_error_not_crash(tmp_path):
+    (tmp_path / "vocab.json").write_text('{"bad\\uZZ12": 1}', encoding="utf-8")
+    (tmp_path / "merges.txt").write_text("#version: 0.2\n", encoding="utf-8")
+    from edgemesh.runtime.native import NativeBPE
+
+    with pytest.raises(FileNotFoundError):  # graceful: nullptr -> raise, no SIGABRT
+        NativeBPE(tmp_path)

@@ -1,0 +1,127 @@
+"""Supervisor failure detection / restart, tracing registry, and the
+/metrics + supervised-generate REST surface."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from edgemesh.serve.supervisor import Supervisor
+from edgemesh.utils.tracing import JsonlLogger, phase_report, reset_phases, trace
+
+
+class FlakyBackend:
+    """Fails `fail_first` calls after each construction, then succeeds."""
+
+    built = 0
+
+    def __init__(self, fail_first: int):
+        type(self).built += 1
+        self.remaining_failures = fail_first
+
+    def answer(self, q):
+        if self.remaining_failures > 0:
+            self.remaining_failures -= 1
+            raise RuntimeError("backend exploded")
+        return {"answer": f"ok:{q}"}
+
+
+def _mk_supervisor(fail_first=0, **kw):
+    FlakyBackend.built = 0
+    # Only the FIRST instance is flaky — a rebuild comes back healthy.
+    return Supervisor(
+        factory=lambda: FlakyBackend(fail_first if FlakyBackend.built == 0 else 0),
+        handler=lambda b, q: b.answer(q),
+        **kw,
+    )
+
+
+def test_healthy_path_counts_requests(tmp_path):
+    sup = _mk_supervisor(0, event_log=tmp_path / "ev.jsonl")
+    assert sup.call("q1") == {"answer": "ok:q1"}
+    h = sup.health()
+    assert h["healthy"] and h["total_requests"] == 1 and h["total_failures"] == 0
+    assert h["p50_latency_s"] is not None
+
+
+def test_restart_after_consecutive_failures(tmp_path):
+    sup = _mk_supervisor(3, max_consecutive_failures=3, event_log=tmp_path / "ev.jsonl")
+    for _ in range(3):
+        with pytest.raises(RuntimeError):
+            sup.call("q")
+    # Third failure tripped the restart: a fresh backend was built.
+    assert FlakyBackend.built == 2
+    assert sup.health()["restarts"] == 1
+    assert sup.call("q2")["answer"] == "ok:q2"  # recovered
+    events = [json.loads(line)["event"] for line in open(tmp_path / "ev.jsonl")]
+    assert "restart" in events and "restart_ok" in events
+
+
+def test_restart_budget_degrades_not_flaps():
+    # Backend that ALWAYS fails: every rebuild starts broken.
+    sup = Supervisor(
+        factory=lambda: FlakyBackend(10**9),
+        handler=lambda b, q: b.answer(q),
+        max_consecutive_failures=1,
+        max_restarts=2,
+    )
+    for _ in range(5):
+        with pytest.raises(RuntimeError):
+            sup.call("q")
+    h = sup.health()
+    assert h["degraded"] and not h["healthy"]
+    assert h["restarts"] == 2  # budget respected, no infinite flapping
+    assert "backend exploded" in h["last_error"]
+
+
+def test_trace_accumulates_phases():
+    reset_phases()
+    with trace("unit/test-phase"):
+        time.sleep(0.01)
+    with trace("unit/test-phase"):
+        time.sleep(0.01)
+    rep = phase_report()["unit/test-phase"]
+    assert rep["count"] == 2 and rep["total_s"] >= 0.02
+    reset_phases()
+
+
+def test_jsonl_logger_roundtrip(tmp_path):
+    lg = JsonlLogger(tmp_path / "runs" / "log.jsonl")
+    lg.log("begin", run=1)
+    lg.log("end", run=1, ok=True)
+    records = lg.read()
+    assert [r["event"] for r in records] == ["begin", "end"]
+    assert all("ts" in r for r in records)
+
+
+def test_rest_metrics_and_supervised_generate(tmp_path):
+    from edgemesh.serve.rest import serve_rest
+
+    class FakeEnsemble:
+        qa_agents = []
+        refiner = None
+
+        def answer(self, q):
+            raise AssertionError("should route through supervisor")
+
+    sup = _mk_supervisor(0)
+    server = serve_rest(FakeEnsemble(), host="127.0.0.1", port=0, block=False,
+                        supervisor=sup)
+    port = server.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"question": "hi"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.load(resp)["answer"] == "ok:hi"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as resp:
+            payload = json.load(resp)
+        assert payload["supervisor"]["total_requests"] == 1
+        assert "phases" in payload
+    finally:
+        server.shutdown()
