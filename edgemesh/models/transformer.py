@@ -60,6 +60,12 @@ class ModelConfig:
     tie_embeddings: bool = False
     logit_soft_cap: float = 0.0
 
+    # Mixture of Experts (0 experts = dense MLP). The expert dim shards over
+    # the mesh's "ep" axis; see ops/moe.py.
+    num_experts: int = 0
+    experts_per_token: int = 2
+    expert_capacity_factor: float = 1.25
+
     # Precision
     dtype: str = "bfloat16"
     remat: bool = False
@@ -151,6 +157,11 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
         }
         if not cfg.shared_input_norm:
             layer["mlp_norm"] = _norm_init(cfg, dtype)
+        if cfg.num_experts > 0:
+            from edgemesh.ops.moe import init_moe_layer
+
+            layer["moe"] = init_moe_layer(cfg, ks[4])
+            return layer
         if cfg.activation == "silu":
             layer["gate"] = _dense_init(ks[4], h, inter, dtype, cfg.out_bias)
             layer["up"] = _dense_init(ks[5], h, inter, dtype, cfg.out_bias)
@@ -199,15 +210,22 @@ def _apply_norm(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     return layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
 
 
-def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """FFN block → (y, aux). ``aux`` is the MoE load-balance loss (0 for the
+    dense path) so the training loss can see it without re-running routers."""
+    if cfg.num_experts > 0:
+        from edgemesh.ops.moe import moe_mlp
+
+        return moe_mlp(cfg, layer["moe"], x)
+    zero = jnp.zeros((), jnp.float32)
     if cfg.activation == "silu":
-        return dense(layer["down"], jax.nn.silu(dense(layer["gate"], x)) * dense(layer["up"], x))
+        return dense(layer["down"], jax.nn.silu(dense(layer["gate"], x)) * dense(layer["up"], x)), zero
     hidden = dense(layer["up"], x)
     if cfg.activation == "gelu_tanh":
         hidden = jax.nn.gelu(hidden, approximate=True)
     else:
         hidden = jax.nn.gelu(hidden, approximate=False)
-    return dense(layer["down"], hidden)
+    return dense(layer["down"], hidden), zero
 
 
 def _use_flash(cfg: ModelConfig) -> bool:
@@ -289,11 +307,13 @@ def _layer_fn(
     lengths: jnp.ndarray,
     is_decode: bool,
     attention=_attention,
-) -> tuple[jnp.ndarray, Any]:
-    """One transformer block. ``attention`` is a pluggable module-level
-    callable with _attention's signature so alternate KV backends (the paged
-    cache, runtime/paged_generate.py) reuse the exact residual wiring of all
-    three families; ``layer_kv`` is whatever state pytree that backend carries.
+) -> tuple[jnp.ndarray, Any, jnp.ndarray]:
+    """One transformer block → (x, kv_state, moe_aux). ``attention`` is a
+    pluggable module-level callable with _attention's signature so alternate
+    KV backends (the paged cache, runtime/paged_generate.py) reuse the exact
+    residual wiring of all three families; ``layer_kv`` is whatever state
+    pytree that backend carries. ``moe_aux`` is the layer's load-balance loss
+    (0 for dense MLPs).
     """
     if cfg.parallel_block:
         # Phi-2 (shared_input_norm=True): y = x + attn(ln(x)) + mlp(ln(x))
@@ -302,14 +322,16 @@ def _layer_fn(
         mlp_in = attn_in if cfg.shared_input_norm else _apply_norm(cfg, layer["mlp_norm"], x)
         attn_out, layer_kv = attention(cfg, layer, attn_in, positions, cache=layer_kv,
                                        kv_valid=kv_valid, lengths=lengths, is_decode=is_decode)
-        return x + attn_out + _mlp(cfg, layer, mlp_in), layer_kv
+        mlp_out, aux = _mlp(cfg, layer, mlp_in)
+        return x + attn_out + mlp_out, layer_kv, aux
     # Sequential (Llama): x += attn(norm(x)); x += mlp(norm(x))
     attn_out, layer_kv = attention(
         cfg, layer, _apply_norm(cfg, layer["attn_norm"], x), positions,
         cache=layer_kv, kv_valid=kv_valid, lengths=lengths, is_decode=is_decode,
     )
     x = x + attn_out
-    return x + _mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x)), layer_kv
+    mlp_out, aux = _mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x))
+    return x + mlp_out, layer_kv, aux
 
 
 def lm_head_logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -336,26 +358,29 @@ def _forward(
     cache: KVCache,
     kv_valid: jnp.ndarray,  # [b, max_seq]
     is_decode: bool,
-) -> tuple[jnp.ndarray, KVCache]:
-    """Shared prefill/decode body: scan one compiled layer over stacked params."""
+) -> tuple[jnp.ndarray, KVCache, jnp.ndarray]:
+    """Shared prefill/decode body: scan one compiled layer over stacked
+    params. Returns (logits, cache, summed moe aux loss)."""
     x = params["embed"]["weight"][tokens].astype(cfg.activation_dtype)
 
     def body(carry, scanned):
-        h = carry
+        h, aux_sum = carry
         layer, k_l, v_l = scanned
         fn = _layer_fn
         if cfg.remat:
             fn = jax.checkpoint(fn, static_argnums=(0, 7, 8))
-        h, new_kv = fn(cfg, h, layer, LayerKV(k_l, v_l), positions, kv_valid,
-                       cache.lengths, is_decode, _attention)
-        return h, (new_kv.k, new_kv.v)
+        h, new_kv, aux = fn(cfg, h, layer, LayerKV(k_l, v_l), positions, kv_valid,
+                            cache.lengths, is_decode, _attention)
+        return (h, aux_sum + aux), (new_kv.k, new_kv.v)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    (x, aux_sum), (new_k, new_v) = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), (params["layers"], cache.k, cache.v)
+    )
 
     logits = lm_head_logits(cfg, params, x)
 
     new_lengths = jnp.max(positions, axis=1) + 1
-    return logits, KVCache(new_k, new_v, new_lengths)
+    return logits, KVCache(new_k, new_v, new_lengths), aux_sum
 
 
 @partial(jax.jit, static_argnums=(0,))
@@ -375,7 +400,7 @@ def forward_prefill(
     # Clamp padded positions to the last real position so their (ignored)
     # rope/mask values stay in range.
     positions = jnp.minimum(positions, (lengths - 1)[:, None])
-    logits, cache = _forward(cfg, params, tokens, positions, cache, kv_valid, is_decode=False)
+    logits, cache, _ = _forward(cfg, params, tokens, positions, cache, kv_valid, is_decode=False)
     last = logits[jnp.arange(b), lengths - 1]
     return last, KVCache(cache.k, cache.v, lengths)
 
@@ -392,7 +417,7 @@ def forward_decode(
     positions = cache.lengths[:, None]  # [b, 1] — position of the new token
     max_seq = cache.k.shape[2]
     kv_valid = jnp.arange(max_seq)[None, :] <= cache.lengths[:, None]
-    logits, new_cache = _forward(
+    logits, new_cache, _ = _forward(
         cfg, params, tokens[:, None], positions, cache, kv_valid, is_decode=True
     )
     return logits[:, 0], KVCache(new_cache.k, new_cache.v, cache.lengths + 1)

@@ -1,0 +1,116 @@
+"""Mixture-of-Experts MLP with top-k capacity routing (GShard/Switch style).
+
+The reference PLANNED expert models but never built them: the results
+workbook's ``Expert Models`` sheet lays out 13 text-expert domains x
+quant/base x routing mode = 52 configs (SURVEY.md §2.3, EP row). This module
+is the device-level half of that plan — a routed MoE FFN whose expert dim
+shards over the mesh's ``ep`` axis. (The request-level half — routing whole
+questions to expert *agents* — is agents/experts.py.)
+
+TPU-first design:
+- Everything is dense one-hot einsum algebra (dispatch [T, E, C] tensors), no
+  data-dependent shapes: the MXU sees three big matmuls per expert layer and
+  XLA inserts the all-to-alls when the expert dim is sharded over ``ep``.
+- Static capacity ``C = ceil(T/E * k * capacity_factor)``: overflowed tokens
+  fall back to the residual stream (combine weight 0), the standard
+  drop-token policy.
+- Router math in fp32 (softmax islands), expert FFN in the model dtype.
+- Aux load-balance loss (Switch eq. 4: E * Σ_e fraction_e · meanprob_e) is
+  returned alongside so the training loss can penalize routing collapse.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from edgemesh.models.transformer import ModelConfig, Params
+
+
+def init_moe_layer(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Per-layer MoE params: router + E stacked expert FFNs.
+
+    Shapes (within one layer; init_params stacks a leading num_layers axis):
+    router.kernel [h, E]; gate/up [E, h, inter]; down [E, inter, h].
+    """
+    h, inter, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+    dtype = cfg.activation_dtype
+    ks = jax.random.split(key, 4)
+    scale_in = h**-0.5
+    scale_out = inter**-0.5
+    p: Params = {
+        "router": {"kernel": (jax.random.normal(ks[0], (h, E), jnp.float32) * scale_in).astype(jnp.float32)},
+        "up": (jax.random.normal(ks[1], (E, h, inter), jnp.float32) * scale_in).astype(dtype),
+        "down": (jax.random.normal(ks[2], (E, inter, h), jnp.float32) * scale_out).astype(dtype),
+    }
+    if cfg.activation == "silu":
+        p["gate"] = (jax.random.normal(ks[3], (E, h, inter), jnp.float32) * scale_in).astype(dtype)
+    return p
+
+
+def expert_capacity(cfg: ModelConfig, num_tokens: int) -> int:
+    return max(
+        1,
+        int(
+            math.ceil(
+                num_tokens / cfg.num_experts
+                * cfg.experts_per_token
+                * cfg.expert_capacity_factor
+            )
+        ),
+    )
+
+
+def moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Routed FFN. x: [b, s, h] → ([b, s, h], scalar aux load-balance loss)."""
+    E, k = cfg.num_experts, cfg.experts_per_token
+    b, s, h = x.shape
+    T = b * s
+    C = expert_capacity(cfg, T)
+    xt = x.reshape(T, h)
+
+    logits = xt.astype(jnp.float32) @ moe["router"]["kernel"]  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [T, k]
+    gate_vals = gate_vals / jnp.maximum(jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+
+    # Slot-by-slot position assignment (k is a small static int): a token's
+    # position inside its expert counts all prior-slot dispatches first, the
+    # GShard discipline that makes capacity deterministic.
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    counts = jnp.zeros((E,), jnp.float32)  # tokens already placed per expert
+    for slot in range(k):
+        m = jax.nn.one_hot(expert_idx[:, slot], E, dtype=jnp.float32)  # [T, E]
+        pos = jnp.cumsum(m, axis=0) - 1.0 + counts[None, :]  # [T, E]
+        keep = (pos < C) * m  # dropped tokens lose this slot
+        pos_oh = jax.nn.one_hot(
+            jnp.clip(pos, 0, C - 1).astype(jnp.int32), C, dtype=jnp.float32
+        )  # [T, E, C]
+        combine = combine + gate_vals[:, slot, None, None] * keep[:, :, None] * pos_oh
+        counts = counts + jnp.sum(m, axis=0)
+
+    dispatch = (combine > 0).astype(cfg.activation_dtype)  # [T, E, C]
+    expert_in = jnp.einsum(
+        "tec,th->ech", dispatch, xt.astype(cfg.activation_dtype)
+    )  # [E, C, h]
+
+    if cfg.activation == "silu":
+        hidden = jax.nn.silu(
+            jnp.einsum("ech,ehi->eci", expert_in, moe["gate"])
+        ) * jnp.einsum("ech,ehi->eci", expert_in, moe["up"])
+    else:
+        hidden = jnp.einsum("ech,ehi->eci", expert_in, moe["up"])
+        hidden = jax.nn.gelu(hidden, approximate=cfg.activation == "gelu_tanh")
+    expert_out = jnp.einsum("eci,eih->ech", hidden, moe["down"])  # [E, C, h]
+
+    y = jnp.einsum(
+        "tec,ech->th", combine.astype(cfg.activation_dtype), expert_out
+    ).reshape(b, s, h)
+
+    # Switch-Transformer load-balance loss over slot-0 assignments.
+    frac = jnp.mean(jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0)
+    meanprob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * meanprob)
+    return y.astype(x.dtype), aux

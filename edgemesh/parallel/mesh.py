@@ -11,6 +11,8 @@ Axes:
 - ``pp``: pipeline stages (layer split — the TPU analog of the reference's
   intended cross-Jetson model split, ``server.py:1``)
 - ``sp``: sequence/context parallel (ring attention)
+- ``ep``: expert parallel (MoE expert dim, ops/moe.py — the device-level
+  realization of the reference's planned Expert Models sheet, SURVEY.md §2.3)
 - ``tp``: tensor parallel (attention heads / MLP columns)
 """
 
@@ -22,7 +24,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "pp", "sp", "tp")
+AXES = ("dp", "pp", "sp", "ep", "tp")
 
 
 def build_mesh(
@@ -30,14 +32,17 @@ def build_mesh(
     pp: int = 1,
     sp: int = 1,
     tp: int = 1,
+    ep: int = 1,
     devices: list | None = None,
 ) -> Mesh:
-    """Build a 4-axis mesh over ``dp*pp*sp*tp`` devices (defaults: all)."""
+    """Build a 5-axis mesh over ``dp*pp*sp*ep*tp`` devices (defaults: all)."""
     devices = devices if devices is not None else jax.devices()
-    need = dp * pp * sp * tp
+    need = dp * pp * sp * ep * tp
     if need > len(devices):
-        raise ValueError(f"mesh {dp}x{pp}x{sp}x{tp} needs {need} devices, have {len(devices)}")
-    arr = np.array(devices[:need]).reshape(dp, pp, sp, tp)
+        raise ValueError(
+            f"mesh {dp}x{pp}x{sp}x{ep}x{tp} needs {need} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[:need]).reshape(dp, pp, sp, ep, tp)
     return Mesh(arr, AXES)
 
 

@@ -100,7 +100,7 @@ def _stage_pipeline_fn(
 
             def layer_step(h, scanned):
                 layer, k_l, v_l = scanned
-                h, new_kv = _layer_fn(
+                h, new_kv, _ = _layer_fn(
                     cfg, h, layer, LayerKV(k_l, v_l), pos, kvv, lens, is_decode
                 )
                 return h, (new_kv.k, new_kv.v)

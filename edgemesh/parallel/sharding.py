@@ -69,13 +69,27 @@ def param_pspecs(cfg: ModelConfig, mesh: Mesh) -> Params:
         "k": _dense_pspec(True, cfg.qkv_bias, kv_ok),
         "v": _dense_pspec(True, cfg.qkv_bias, kv_ok),
         "o": _dense_pspec(False, cfg.out_bias, heads_ok),
-        "down": _dense_pspec(False, cfg.out_bias, inter_ok),
     }
     if not cfg.shared_input_norm:
         layer["mlp_norm"] = _norm_pspec(cfg)
-    if cfg.activation == "silu":
-        layer["gate"] = _dense_pspec(True, cfg.out_bias, inter_ok)
-    layer["up"] = _dense_pspec(True, cfg.out_bias, inter_ok)
+    if cfg.num_experts > 0:
+        # MoE (stacked [L, E, ...]): expert dim on "ep", FFN width on "tp";
+        # the fp32 router stays replicated (it is tiny and fully data-parallel).
+        ep_ok = cfg.num_experts % mesh.shape.get("ep", 1) == 0
+        e_ax = "ep" if ep_ok else None
+        t_ax = "tp" if inter_ok else None
+        layer["moe"] = {
+            "router": {"kernel": P(None, None, None)},
+            "up": P(None, e_ax, None, t_ax),
+            "down": P(None, e_ax, t_ax, None),
+        }
+        if cfg.activation == "silu":
+            layer["moe"]["gate"] = P(None, e_ax, None, t_ax)
+    else:
+        layer["down"] = _dense_pspec(False, cfg.out_bias, inter_ok)
+        if cfg.activation == "silu":
+            layer["gate"] = _dense_pspec(True, cfg.out_bias, inter_ok)
+        layer["up"] = _dense_pspec(True, cfg.out_bias, inter_ok)
 
     specs: Params = {
         "embed": {"weight": P(None, None)},

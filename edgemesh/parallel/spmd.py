@@ -170,6 +170,11 @@ def _spmd_attention(
 
 
 def _spmd_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "MoE runs under the auto-sharded path (ep axis in param_pspecs); "
+            "the manual 4D SPMD program does not route experts yet"
+        )
     if cfg.activation == "silu":
         hidden = jax.nn.silu(_col_dense(layer["gate"], x)) * _col_dense(layer["up"], x)
     else:

@@ -111,7 +111,7 @@ def _paged_forward(
     def body(h, scanned):
         layer, k_l, v_l = scanned
         state = (k_l, v_l, cache.page_table, kv_lens)
-        h, (k_l, v_l, _, _) = _layer_fn(
+        h, (k_l, v_l, _, _), _aux = _layer_fn(
             cfg, h, layer, state, positions, None, cache.lengths, is_decode,
             _paged_attention,
         )

@@ -32,8 +32,11 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
-def forward_train(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
-    """Full-sequence logits [b, s, vocab] (cache written then discarded)."""
+def forward_train_aux(
+    cfg: ModelConfig, params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Full-sequence logits [b, s, vocab] plus the summed MoE load-balance
+    aux loss (0 for dense models); cache written then discarded."""
     # The Pallas flash kernel has no VJP (scratch-mutating online softmax);
     # training differentiates this forward, so pin the XLA attention path.
     # Inference prefill (runtime/generate.py) keeps cfg's choice.
@@ -43,20 +46,35 @@ def forward_train(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, lengths
     cache = init_kv_cache(cfg, b, s)
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     kv_valid = jnp.arange(s)[None, :] < lengths[:, None]
-    logits, _ = _forward(cfg, params, tokens, positions, cache, kv_valid, is_decode=False)
-    return logits
+    logits, _, aux = _forward(cfg, params, tokens, positions, cache, kv_valid, is_decode=False)
+    return logits, aux
 
 
-def causal_lm_loss(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
-    """Mean next-token cross-entropy over real (unpadded) positions."""
-    logits = forward_train(cfg, params, tokens, lengths)[:, :-1]
+def forward_train(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    return forward_train_aux(cfg, params, tokens, lengths)[0]
+
+
+def causal_lm_loss(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    moe_aux_weight: float = 0.01,
+) -> jnp.ndarray:
+    """Mean next-token cross-entropy over real (unpadded) positions, plus the
+    weighted MoE load-balance term when the model is routed (Switch eq. 4)."""
+    logits, aux = forward_train_aux(cfg, params, tokens, lengths)
+    logits = logits[:, :-1]
     targets = tokens[:, 1:]
     b, s = targets.shape
     mask = (jnp.arange(s)[None, :] < (lengths - 1)[:, None]).astype(jnp.float32)
     ce = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), targets
     )
-    return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if cfg.num_experts > 0:
+        loss = loss + moe_aux_weight * aux
+    return loss
 
 
 def make_optimizer(lr: float = 1e-4, weight_decay: float = 0.01) -> optax.GradientTransformation:

@@ -37,7 +37,7 @@ def _compare(ckpt_dir, hf_model, seq=12, atol=2e-3):
 
     positions = jnp.broadcast_to(jnp.arange(seq)[None, :], (1, seq))
     kv_valid = jnp.arange(32)[None, :] < seq
-    ours, _ = _forward(
+    ours, _, _ = _forward(
         cfg, params, jnp.asarray(tokens), positions, cache, kv_valid, is_decode=False
     )
     np.testing.assert_allclose(

@@ -31,7 +31,7 @@ from edgemesh.runtime import generate
 
 def test_build_mesh_axes(devices):
     mesh = build_mesh(dp=2, tp=4)
-    assert mesh.shape == {"dp": 2, "pp": 1, "sp": 1, "tp": 4}
+    assert mesh.shape == {"dp": 2, "pp": 1, "sp": 1, "ep": 1, "tp": 4}
     with pytest.raises(ValueError):
         build_mesh(dp=4, tp=4)  # 16 > 8 devices
 
