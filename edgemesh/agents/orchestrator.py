@@ -169,8 +169,12 @@ def build_agent(spec: AgentSpec, mesh=None) -> Agent:
 
         params = init_params(cfg, jax.random.PRNGKey(crc32(spec.role.encode()) % (2**31)))
 
-    if ms.precision == "int8":
+    if ms.precision in ("int8", "int8_w8a8", "int8_w8a8_pallas"):
         params = quantize_params(params)
+        # "int8" = weight-only (w8a16); the suffixed variants run activations
+        # in int8 too — XLA dynamic quant or the fused Pallas kernel.
+        if ms.precision != "int8":
+            cfg = cfg.replace(quant_mode=ms.precision.removeprefix("int8_"))
     elif ms.precision in ("bf16", "fp16", "fp32"):
         dtype = {"bf16": jnp.bfloat16, "fp16": jnp.float16, "fp32": jnp.float32}[ms.precision]
         if cfg.activation_dtype != dtype:

@@ -55,7 +55,10 @@ def cmd_serve(cfg: EdgeMeshConfig, port: int) -> int:
 def cmd_bench(cfg: EdgeMeshConfig, preset: str | None, precision: str | None) -> int:
     from edgemesh.benchmarks import decode_benchmark
 
-    print(json.dumps(decode_benchmark(preset=preset, precision=precision)))
+    quant_mode = "w8a16"
+    if precision and precision.startswith("int8_"):
+        precision, quant_mode = "int8", precision.removeprefix("int8_")
+    print(json.dumps(decode_benchmark(preset=preset, precision=precision, quant_mode=quant_mode)))
     return 0
 
 
@@ -94,7 +97,8 @@ def main(argv: list[str] | None = None) -> int:
         help="bench: model preset (validated by the bench command)",
     )
     top.add_argument(
-        "--precision", type=str, default=None, choices=["bf16", "int8"],
+        "--precision", type=str, default=None,
+        choices=["bf16", "int8", "int8_w8a8", "int8_w8a8_pallas"],
         help="bench: numeric precision",
     )
     cmd_args, rest = top.parse_known_args(argv)

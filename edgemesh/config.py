@@ -67,7 +67,9 @@ class ModelSpec:
 
     path: str = ""
     family: str = "auto"  # auto | llama | neox | phi2
-    precision: str = "bf16"  # bf16 | fp16 | fp32 | int8
+    # bf16 | fp16 | fp32 | int8 (weight-only w8a16) | int8_w8a8 (dynamic
+    # activation quant, int8xint8 MXU) | int8_w8a8_pallas (fused kernel)
+    precision: str = "bf16"
     # Architecture overrides for synthetic (random-init) models; ignored when
     # loading a real checkpoint.
     vocab_size: int | None = None

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from edgemesh.ops.attention import LayerKV, attend, write_decode, write_prefill
 from edgemesh.ops.norms import layer_norm, rms_norm
 from edgemesh.ops.rope import apply_rope
+from edgemesh.utils.platform import on_tpu
 
 Params = dict[str, Any]
 
@@ -69,6 +70,12 @@ class ModelConfig:
     # Precision
     dtype: str = "bfloat16"
     remat: bool = False
+    # Int8 execution path once params are quantized (ops/int8.py):
+    #   w8a16       — weight-only; dequant folded into the matmul epilogue.
+    #   w8a8        — dynamic activation quant, int8xint8->int32 MXU via XLA.
+    #   w8a8_pallas — fused Pallas kernel (quantize + dot + rescale in VMEM);
+    #                 falls back to w8a8 where shapes don't tile.
+    quant_mode: str = "w8a16"
 
     # Attention backend: "auto" = Pallas flash kernel for prefill on TPU,
     # XLA einsum elsewhere; "flash" forces the kernel (interpreted off-TPU);
@@ -187,16 +194,29 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def dense(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+def dense(p: Params, x: jnp.ndarray, quant_mode: str = "w8a16") -> jnp.ndarray:
     """Linear layer; dispatches to the int8 path when the param leaf is
     quantized (edgemesh/ops/int8.py stores {"kernel_q", "scales"}) and applies
-    the SmoothQuant activation division when a "smooth" leaf is present."""
+    the SmoothQuant activation division when a "smooth" leaf is present.
+    ``quant_mode`` (a trace-time constant from ModelConfig) selects between
+    the w8a16 epilogue-dequant matmul, the XLA w8a8 dynamic-quant matmul, and
+    the fused Pallas w8a8 kernel."""
     if "kernel_q" in p:
-        from edgemesh.ops.int8 import int8_matmul
+        from edgemesh.ops import int8 as int8_ops
 
         if "smooth" in p:
             x = x / p["smooth"].astype(x.dtype)
-        y = int8_matmul(x, p["kernel_q"], p["scales"])
+        if quant_mode == "w8a8":
+            y = int8_ops.int8_matmul_dynamic(x, p["kernel_q"], p["scales"])
+        elif quant_mode == "w8a8_pallas":
+            y = int8_ops.int8_matmul_fused(
+                x, p["kernel_q"], p["scales"],
+                interpret=not on_tpu(),
+            )
+        elif quant_mode == "w8a16":
+            y = int8_ops.int8_matmul(x, p["kernel_q"], p["scales"])
+        else:
+            raise ValueError(f"unknown quant_mode {quant_mode!r}")
     else:
         y = x @ p["kernel"]
     if "bias" in p:
@@ -218,14 +238,22 @@ def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> tuple[jnp.ndarray, 
 
         return moe_mlp(cfg, layer["moe"], x)
     zero = jnp.zeros((), jnp.float32)
+    qm = cfg.quant_mode
     if cfg.activation == "silu":
-        return dense(layer["down"], jax.nn.silu(dense(layer["gate"], x)) * dense(layer["up"], x)), zero
-    hidden = dense(layer["up"], x)
+        return (
+            dense(
+                layer["down"],
+                jax.nn.silu(dense(layer["gate"], x, qm)) * dense(layer["up"], x, qm),
+                qm,
+            ),
+            zero,
+        )
+    hidden = dense(layer["up"], x, qm)
     if cfg.activation == "gelu_tanh":
         hidden = jax.nn.gelu(hidden, approximate=True)
     else:
         hidden = jax.nn.gelu(hidden, approximate=False)
-    return dense(layer["down"], hidden), zero
+    return dense(layer["down"], hidden, qm), zero
 
 
 def _use_flash(cfg: ModelConfig) -> bool:
@@ -242,7 +270,7 @@ def _use_flash(cfg: ModelConfig) -> bool:
         return False
     if cfg.attention_impl == "flash":
         return True
-    return jax.default_backend() == "tpu" and jax.device_count() == 1
+    return on_tpu() and jax.device_count() == 1
 
 
 def qkv_proj(
@@ -252,9 +280,9 @@ def qkv_proj(
     the dense-cache path below and the paged path (runtime/paged_generate.py)."""
     b, s, _ = x.shape
     nh, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
-    q = dense(layer["q"], x).reshape(b, s, nh, hd)
-    k = dense(layer["k"], x).reshape(b, s, kh, hd)
-    v = dense(layer["v"], x).reshape(b, s, kh, hd)
+    q = dense(layer["q"], x, cfg.quant_mode).reshape(b, s, nh, hd)
+    k = dense(layer["k"], x, cfg.quant_mode).reshape(b, s, kh, hd)
+    v = dense(layer["v"], x, cfg.quant_mode).reshape(b, s, kh, hd)
     if cfg.rotary_dim > 0:
         q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta)
@@ -290,11 +318,11 @@ def _attention(
         kv_lens = jnp.sum(kv_valid, axis=1).astype(jnp.int32)
         out = flash_attention(
             q, k, v, kv_lens, causal=True,
-            interpret=cfg.attention_impl == "flash" and jax.default_backend() != "tpu",
+            interpret=cfg.attention_impl == "flash" and not on_tpu(),
         )
     else:
         out = attend(q, cache, positions, kv_valid)
-    return dense(layer["o"], out.reshape(b, s, nh * hd)), cache
+    return dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode), cache
 
 
 def _layer_fn(
@@ -307,12 +335,16 @@ def _layer_fn(
     lengths: jnp.ndarray,
     is_decode: bool,
     attention=_attention,
+    mlp=_mlp,
 ) -> tuple[jnp.ndarray, Any, jnp.ndarray]:
-    """One transformer block → (x, kv_state, moe_aux). ``attention`` is a
-    pluggable module-level callable with _attention's signature so alternate
-    KV backends (the paged cache, runtime/paged_generate.py) reuse the exact
-    residual wiring of all three families; ``layer_kv`` is whatever state
-    pytree that backend carries. ``moe_aux`` is the layer's load-balance loss
+    """One transformer block → (x, kv_state, moe_aux). ``attention`` and
+    ``mlp`` are pluggable module-level callables with _attention's/_mlp's
+    signatures so alternate backends reuse the exact residual wiring of all
+    three families: the paged KV cache (runtime/paged_generate.py) swaps
+    ``attention``; the tensor-parallel shard_map engine
+    (parallel/tp_infer.py) swaps both to psum partial outputs over ``tp``
+    before the residual add. ``layer_kv`` is whatever state pytree the
+    attention backend carries. ``moe_aux`` is the layer's load-balance loss
     (0 for dense MLPs).
     """
     if cfg.parallel_block:
@@ -322,7 +354,7 @@ def _layer_fn(
         mlp_in = attn_in if cfg.shared_input_norm else _apply_norm(cfg, layer["mlp_norm"], x)
         attn_out, layer_kv = attention(cfg, layer, attn_in, positions, cache=layer_kv,
                                        kv_valid=kv_valid, lengths=lengths, is_decode=is_decode)
-        mlp_out, aux = _mlp(cfg, layer, mlp_in)
+        mlp_out, aux = mlp(cfg, layer, mlp_in)
         return x + attn_out + mlp_out, layer_kv, aux
     # Sequential (Llama): x += attn(norm(x)); x += mlp(norm(x))
     attn_out, layer_kv = attention(
@@ -330,7 +362,7 @@ def _layer_fn(
         cache=layer_kv, kv_valid=kv_valid, lengths=lengths, is_decode=is_decode,
     )
     x = x + attn_out
-    mlp_out, aux = _mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x))
+    mlp_out, aux = mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x))
     return x + mlp_out, layer_kv, aux
 
 
@@ -344,7 +376,7 @@ def lm_head_logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndar
     if cfg.tie_embeddings or "lm_head" not in params:
         logits = x @ params["embed"]["weight"].T.astype(cfg.activation_dtype)
     else:
-        logits = dense(params["lm_head"], x)
+        logits = dense(params["lm_head"], x, cfg.quant_mode)
     if cfg.logit_soft_cap > 0:
         logits = cfg.logit_soft_cap * jnp.tanh(logits / cfg.logit_soft_cap)
     return logits
@@ -358,6 +390,8 @@ def _forward(
     cache: KVCache,
     kv_valid: jnp.ndarray,  # [b, max_seq]
     is_decode: bool,
+    attention=_attention,
+    mlp=_mlp,
 ) -> tuple[jnp.ndarray, KVCache, jnp.ndarray]:
     """Shared prefill/decode body: scan one compiled layer over stacked
     params. Returns (logits, cache, summed moe aux loss)."""
@@ -368,9 +402,9 @@ def _forward(
         layer, k_l, v_l = scanned
         fn = _layer_fn
         if cfg.remat:
-            fn = jax.checkpoint(fn, static_argnums=(0, 7, 8))
+            fn = jax.checkpoint(fn, static_argnums=(0, 7, 8, 9))
         h, new_kv, aux = fn(cfg, h, layer, LayerKV(k_l, v_l), positions, kv_valid,
-                            cache.lengths, is_decode, _attention)
+                            cache.lengths, is_decode, attention, mlp)
         return (h, aux_sum + aux), (new_kv.k, new_kv.v)
 
     (x, aux_sum), (new_k, new_v) = jax.lax.scan(

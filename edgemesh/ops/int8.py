@@ -233,3 +233,45 @@ def pallas_int8_matmul(
         scratch_shapes=[pltpu.VMEM((tile_m, tile_n), jnp.float32)],
         interpret=interpret,
     )(x, w_q, scales.reshape(1, -1))
+
+
+def int8_matmul_fused(
+    x: jnp.ndarray,  # [..., K] activation
+    w_q: jnp.ndarray,  # [K, N] int8
+    scales: jnp.ndarray,  # [N]
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Model-facing entry for the fused Pallas w8a8 kernel.
+
+    Handles what the raw kernel cannot: ND activations (collapsed to [M, K]),
+    M padded up to the kernel's sublane tiling, and a tile-compatibility
+    check — when K/N do not tile onto the MXU grid (or Pallas is
+    unavailable), falls back to the XLA ``int8_matmul_dynamic`` path, which
+    computes the same w8a8 contraction with whole-row activation scales.
+
+    Numerics note: the kernel quantizes activations per (row, K-block) while
+    the XLA path quantizes per whole row, so the two differ by normal int8
+    rounding, not bit-exactly.
+    """
+    *lead, k = x.shape
+    n = w_q.shape[1]
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    tile_k = next((t for t in (512, 256, 128) if k % t == 0), None)
+    if pl is None or tile_k is None or n % 128 != 0 or m == 0:
+        y = int8_matmul_dynamic(x2, w_q, scales)
+        return y.reshape(*lead, n)
+    # Pad M to the bf16 sublane multiple (16) — 32 for headroom on small
+    # decode batches, 128 once a full MXU tile is available.
+    pad_to = 128 if m > 32 else 32
+    m_pad = -m % pad_to
+    if m_pad:
+        x2 = jnp.pad(x2, ((0, m_pad), (0, 0)))
+    tile_m = min(128, x2.shape[0])
+    y = pallas_int8_matmul(
+        x2, w_q, scales, tile_m=tile_m, tile_k=tile_k, interpret=interpret
+    )
+    if m_pad:
+        y = y[:m]
+    return y.reshape(*lead, n)

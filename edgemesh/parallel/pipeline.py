@@ -32,6 +32,7 @@ from edgemesh.models.transformer import (
     lm_head_logits,
 )
 from edgemesh.ops.attention import LayerKV
+from edgemesh.utils.platform import on_tpu
 
 Params = dict[str, Any]
 
@@ -152,10 +153,26 @@ class PipelineEngine:
     KV blocks of its own layers — the ``pp``-way analog of kv-head sharding.
     """
 
-    def __init__(self, cfg: ModelConfig, params: Params, mesh: Mesh, num_micro: int = 4):
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Params,
+        mesh: Mesh,
+        num_micro: int = 4,
+        attention_impl: str | None = None,
+    ):
         pp = mesh.shape["pp"]
         if pp < 2:
             raise ValueError("PipelineEngine needs a pp axis of size >= 2")
+        # The stage body runs per-shard under shard_map, so Pallas kernels see
+        # local arrays and apply directly — default to the flash kernel on
+        # real TPU; pass "flash" explicitly to run it in interpret mode on a
+        # CPU mesh, or "xla" to force the einsum attention.
+        if attention_impl is None:
+            attention_impl = (
+                "flash" if on_tpu() else cfg.attention_impl
+            )
+        cfg = cfg.replace(attention_impl=attention_impl)
         self.cfg = cfg
         self.mesh = mesh
         self.pp = pp
@@ -194,6 +211,10 @@ class PipelineEngine:
             mesh=self.mesh,
             in_specs=(P("pp"), P("pp"), P("pp"), P(), P(), P(), P()),
             out_specs=(P("pp"), P("pp"), P()),
+            # pallas_call outputs don't carry varying-manual-axes types, so
+            # the vma checker rejects any stage body that runs the flash
+            # kernel; the pcast inits degrade to no-ops with it off.
+            check_vma=cfg.attention_impl != "flash",
         )
         k, v, out_mb = mapped(
             params["layers"], cache.k, cache.v,

@@ -30,6 +30,7 @@ from edgemesh.models.transformer import (
     qkv_proj,
 )
 from edgemesh.ops.attention import LayerKV, attend
+from edgemesh.utils.platform import on_tpu
 from edgemesh.ops.paged_attention import (
     paged_decode_attention,
     paged_decode_attention_xla,
@@ -69,7 +70,7 @@ def _paged_attention(
             out = paged_decode_attention(
                 q[:, 0], k_pages, v_pages, table, kv_lens,
                 interpret=cfg.attention_impl == "flash"
-                and jax.default_backend() != "tpu",
+                and not on_tpu(),
             )
         else:
             out = paged_decode_attention_xla(q[:, 0], k_pages, v_pages, table, kv_lens)
@@ -88,12 +89,12 @@ def _paged_attention(
             out = flash_attention(
                 q, k, v, kv_lens, causal=True,
                 interpret=cfg.attention_impl == "flash"
-                and jax.default_backend() != "tpu",
+                and not on_tpu(),
             )
         else:
             prompt_valid = jnp.arange(s)[None, :] < kv_lens[:, None]
             out = attend(q, LayerKV(k, v), positions, prompt_valid)
-    proj = dense(layer["o"], out.reshape(b, s, nh * hd))
+    proj = dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode)
     return proj, (k_pages, v_pages, table, kv_lens)
 
 
