@@ -1,0 +1,193 @@
+"""Request-level expert routing: domain experts behind a question classifier.
+
+The reference PLANNED this and never built it: the ``Expert Models`` sheet of
+``Others/Distributed LLM Evaluations and Results - Partha.xlsx`` lays out 13
+text-expert domains x {base, quant} x {summarizer, classifier} routing = 52
+configs (SURVEY.md §2.3, EP row). This module is the working half the sheet
+describes at the REQUEST level — each incoming question is classified into a
+domain and dispatched to that domain's expert agent — complementing the
+device-level token-routed MoE in ops/moe.py (the two halves of "expert
+parallelism": per-request expert agents on submeshes, per-token experts over
+the ``ep`` mesh axis).
+
+Routing strategies (the sheet's "classifier vs summarizer" axis):
+- ``KeywordClassifier``: deterministic host-side scoring — zero model cost,
+  the right default for the 1-chip serving path.
+- ``EmbeddingClassifier``: cosine similarity between the question embedding
+  and each domain's descriptor embedding, through the SAME pluggable embedder
+  the metrics suite uses (eval/metrics.py) — model-based when a model
+  embedder is configured, hashing fallback otherwise.
+- ``summarizer`` mode: skip classification, ask EVERY expert, merge with a
+  refiner — exactly the ensemble path (agents/orchestrator.py), provided
+  here as ``route_all``.
+
+TPU mapping: each expert is an ordinary Agent bound to its own submesh
+(parallel/mesh.submeshes), so concurrent questions to different experts run
+on disjoint chips.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+# The 13 text domains of the reference's Expert Models sheet.
+DEFAULT_DOMAINS: tuple[str, ...] = (
+    "science", "history", "geography", "sports", "politics",
+    "entertainment", "technology", "health", "finance", "literature",
+    "law", "religion", "general",
+)
+
+_DOMAIN_KEYWORDS: dict[str, tuple[str, ...]] = {
+    "science": ("atom", "chemical", "physics", "biology", "species", "planet",
+                "energy", "cell", "theory", "experiment", "element", "gene"),
+    "history": ("war", "empire", "century", "ancient", "revolution", "king",
+                "queen", "dynasty", "historical", "founded", "battle"),
+    "geography": ("country", "river", "mountain", "capital", "ocean", "city",
+                  "continent", "island", "border", "population", "located"),
+    "sports": ("team", "league", "championship", "player", "game", "season",
+               "olympic", "cup", "score", "coach", "tournament"),
+    "politics": ("president", "election", "government", "parliament", "senate",
+                 "minister", "law", "policy", "vote", "party", "congress"),
+    "entertainment": ("movie", "film", "song", "album", "actor", "actress",
+                      "band", "show", "series", "director", "singer", "tv"),
+    "technology": ("computer", "software", "internet", "phone", "digital",
+                   "robot", "code", "website", "app", "device", "network"),
+    "health": ("disease", "medicine", "doctor", "symptom", "treatment",
+               "vaccine", "virus", "body", "blood", "cancer", "drug"),
+    "finance": ("money", "bank", "stock", "market", "currency", "economy",
+                "tax", "price", "dollar", "investment", "company"),
+    "literature": ("book", "novel", "author", "poem", "wrote", "writer",
+                   "published", "character", "story", "play", "shakespeare"),
+    "law": ("court", "judge", "legal", "crime", "trial", "constitution",
+            "rights", "lawyer", "supreme", "justice", "amendment"),
+    "religion": ("church", "god", "bible", "religion", "prayer", "temple",
+                 "holy", "faith", "pope", "mosque", "worship"),
+    "general": (),
+}
+
+
+@dataclass
+class ExpertSpec:
+    """One domain expert: a domain name, the agent serving it, and the
+    keyword/descriptor vocabulary the classifiers route on."""
+
+    domain: str
+    agent: Any  # agents.orchestrator.Agent (duck-typed: .answer(question))
+    keywords: tuple[str, ...] = ()
+    descriptor: str = ""
+
+    def __post_init__(self):
+        if not self.keywords:
+            self.keywords = _DOMAIN_KEYWORDS.get(self.domain, ())
+        if not self.descriptor:
+            self.descriptor = f"{self.domain}: " + " ".join(self.keywords[:8])
+
+
+class KeywordClassifier:
+    """Deterministic domain scoring: count keyword hits, ties broken by
+    domain order; no hits -> fallback domain."""
+
+    def __init__(self, experts: Sequence[ExpertSpec], fallback: str = "general"):
+        self.experts = list(experts)
+        self.fallback = fallback
+
+    def __call__(self, question: str) -> str:
+        words = set(question.lower().replace("?", " ").replace(",", " ").split())
+        best, best_score = self.fallback, 0
+        for spec in self.experts:
+            score = sum(1 for k in spec.keywords if k in words)
+            if score > best_score:
+                best, best_score = spec.domain, score
+        return best
+
+
+class EmbeddingClassifier:
+    """Route by cosine similarity of question vs domain-descriptor embeddings
+    (the model-based classifier of the Expert Models sheet). ``embedder`` is
+    any eval.metrics-compatible embedder: a callable ``[texts] -> [n, d]``."""
+
+    def __init__(
+        self,
+        experts: Sequence[ExpertSpec],
+        embedder: Any,
+        fallback: str = "general",
+        min_sim: float = 0.0,
+    ):
+        self.experts = list(experts)
+        self.embedder = embedder
+        self.fallback = fallback
+        self.min_sim = min_sim
+        self._domain_vecs = np.asarray(
+            embedder([s.descriptor for s in self.experts]), np.float32
+        )
+
+    def __call__(self, question: str) -> str:
+        q = np.asarray(self.embedder([question]), np.float32)[0]
+        dv = self._domain_vecs
+        denom = np.linalg.norm(dv, axis=1) * (np.linalg.norm(q) + 1e-8) + 1e-8
+        sims = dv @ q / denom
+        i = int(np.argmax(sims))
+        if sims[i] <= self.min_sim:
+            return self.fallback
+        return self.experts[i].domain
+
+
+@dataclass
+class ExpertRouter:
+    """Registry + dispatch. ``classifier`` maps question -> domain name;
+    unknown domains fall back to ``fallback`` (or the first expert)."""
+
+    experts: list[ExpertSpec]
+    classifier: Callable[[str], str] | None = None
+    fallback: str = "general"
+    _by_domain: dict[str, ExpertSpec] = field(default_factory=dict, repr=False)
+
+    def __post_init__(self):
+        if not self.experts:
+            raise ValueError("ExpertRouter needs at least one expert")
+        self._by_domain = {s.domain: s for s in self.experts}
+        if self.classifier is None:
+            self.classifier = KeywordClassifier(self.experts, self.fallback)
+
+    def route(self, question: str) -> ExpertSpec:
+        domain = self.classifier(question)
+        spec = self._by_domain.get(domain) or self._by_domain.get(self.fallback)
+        return spec if spec is not None else self.experts[0]
+
+    def answer(self, question: str) -> dict[str, Any]:
+        """Classifier mode: one expert serves the question."""
+        spec = self.route(question)
+        out = spec.agent.answer(question)
+        out["domain"] = spec.domain
+        return out
+
+    def route_all(self, question: str, refiner: Any | None = None) -> dict[str, Any]:
+        """Summarizer mode: every expert answers; a refiner (or best
+        confidence) merges — the sheet's alternative routing axis, sharing
+        the ensemble merge semantics (orchestrator.Ensemble.answer)."""
+        from edgemesh.agents.orchestrator import Ensemble
+
+        ens = Ensemble(qa_agents=[s.agent for s in self.experts], refiner=refiner)
+        return ens.answer(question)
+
+
+def build_expert_router(
+    specs_by_domain: dict[str, Any],
+    classifier: str = "keyword",
+    embedder: Any | None = None,
+) -> ExpertRouter:
+    """Assemble a router from {domain: Agent}. ``classifier``: "keyword" or
+    "embedding" (requires ``embedder``)."""
+    experts = [ExpertSpec(domain=d, agent=a) for d, a in specs_by_domain.items()]
+    if classifier == "embedding":
+        if embedder is None:
+            raise ValueError("embedding classifier needs an embedder")
+        clf: Callable[[str], str] | None = EmbeddingClassifier(experts, embedder)
+    elif classifier == "keyword":
+        clf = None  # router defaults to KeywordClassifier
+    else:
+        raise ValueError(f"unknown classifier {classifier!r}")
+    return ExpertRouter(experts=experts, classifier=clf)

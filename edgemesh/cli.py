@@ -27,11 +27,11 @@ def _setup_logging(cfg: EdgeMeshConfig):
 
 def cmd_eval(cfg: EdgeMeshConfig) -> int:
     from edgemesh.agents import build_ensemble
-    from edgemesh.eval.data import load_qa_csv
+    from edgemesh.eval.data import load_qa_csv, resolve_dataset_path
     from edgemesh.eval.harness import run_eval
 
     ensemble = build_ensemble(cfg)
-    samples = load_qa_csv(cfg.eval.dataset_path, limit=cfg.eval.num_samples)
+    samples = load_qa_csv(resolve_dataset_path(cfg.eval.dataset_path), limit=cfg.eval.num_samples)
     report = run_eval(
         samples,
         ensemble.answer,

@@ -119,9 +119,11 @@ class MeshSpec:
 class EvalSpec:
     """Evaluation harness settings (reference L5; combiner_fp.py:429-474)."""
 
-    dataset_path: str = (
-        "/root/reference/Code/Dataset/natural_questions_1000.csv"
-    )
+    # Resolution order (first hit wins): this field if non-empty, else the
+    # EDGEMESH_DATASET env var, else the known local snapshot locations.
+    # Empty default keeps the config portable across machines instead of
+    # baking one host's filesystem layout into the dataclass.
+    dataset_path: str = ""
     dataset_split: str = "train[:1000]"
     num_samples: int = 1000
     batch_size: int = 1

@@ -9,8 +9,33 @@ loaded either via HF datasets (``combiner_fp.py:413``) or raw CSV
 from __future__ import annotations
 
 import csv
+import os
 from dataclasses import dataclass
 from pathlib import Path
+
+# Known snapshot locations, probed in order when no explicit path is given
+# (reference layout first: Code/Dataset/natural_questions_1000.csv and its
+# byte-identical C-DAC Server copy).
+_DEFAULT_DATASET_CANDIDATES = (
+    "data/natural_questions_1000.csv",
+    "/root/reference/Code/Dataset/natural_questions_1000.csv",
+    "/root/reference/Code/C-DAC Server/natural_questions_1000.csv",
+)
+
+
+def resolve_dataset_path(configured: str = "") -> str:
+    """Resolve the eval CSV: explicit config wins, then $EDGEMESH_DATASET,
+    then the known local snapshot locations."""
+    for cand in (configured, os.environ.get("EDGEMESH_DATASET", "")):
+        if cand:
+            return cand
+    for cand in _DEFAULT_DATASET_CANDIDATES:
+        if Path(cand).exists():
+            return cand
+    raise FileNotFoundError(
+        "no QA dataset found: set eval.dataset_path, $EDGEMESH_DATASET, or "
+        f"place the CSV at one of {_DEFAULT_DATASET_CANDIDATES}"
+    )
 
 
 @dataclass

@@ -109,8 +109,11 @@ def moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.ndarray,
         "tec,ech->th", combine.astype(cfg.activation_dtype), expert_out
     ).reshape(b, s, h)
 
-    # Switch-Transformer load-balance loss over slot-0 assignments.
-    frac = jnp.mean(jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0)
+    # Load-balance loss over ALL k routing slots (GShard-style mean of
+    # one-hots across slots; Switch eq. 4 is the k=1 special case). Counting
+    # only slot 0 would leave routing collapse in later slots invisible to
+    # the penalty when experts_per_token > 1.
+    frac = jnp.mean(jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=(0, 1))
     meanprob = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac * meanprob)
     return y.astype(x.dtype), aux

@@ -165,6 +165,8 @@ class NativeBPE:
         cap = max(len(data) + 8, 16)
         buf = (ctypes.c_int32 * cap)()
         n = int(self._lib.em_bpe_encode(self._h, data, len(data), buf, cap))
+        if n < 0:
+            raise RuntimeError(f"native BPE encode failed (rc={n}) for {len(data)}-byte input")
         ids = list(buf[: min(n, cap)])
         if max_len is not None:
             ids = ids[: max(0, max_len)]
@@ -180,6 +182,8 @@ class NativeBPE:
             cap = n
             out = ctypes.create_string_buffer(cap)
             n = int(self._lib.em_bpe_decode(self._h, arr, len(ids), out, cap))
+        if n < 0:
+            raise RuntimeError(f"native BPE decode failed (rc={n}) for {len(ids)} ids")
         return out.raw[: min(n, cap)].decode("utf-8", errors="replace")
 
     def close(self):

@@ -80,6 +80,13 @@ def init_paged_cache(
     )
 
 
+def pool_overflowed(cache: PagedKVCache) -> bool:
+    """Host-side overflow check: True if any allocate() ran past the free
+    stack. Those rows were handed the trash page — their KV beyond the
+    overflow point is invalid and results must be discarded."""
+    return int(cache.free_top) > cache.free_stack.shape[0]
+
+
 def pages_needed(lengths: jnp.ndarray, new_tokens: jnp.ndarray, page_size: int) -> jnp.ndarray:
     """How many fresh pages each row needs to hold ``new_tokens`` more tokens."""
     have = (lengths + page_size - 1) // page_size
@@ -92,9 +99,12 @@ def allocate(cache: PagedKVCache, n_pages: jnp.ndarray) -> PagedKVCache:
 
     Statically bounded by ``max_pages`` logical slots per row; pure gathers
     and scatters, so it runs inside a jitted/scanned decode step. Exhausting
-    the pool silently hands out trash pages (callers bound capacity up front
-    — generate() validates prompt+max_new against the pool, mirroring its
-    max_seq_len check).
+    the pool hands out the trash page (physical 0) for the overflowing rows —
+    jit-compatible, no branch — but the overflow is RECORDED: ``free_top``
+    keeps advancing past the stack size, so ``pool_overflowed(cache)`` is
+    True afterwards. Callers either bound capacity up front (generate()
+    validates prompt+max_new against the pool) or assert ``pool_overflowed``
+    host-side after their loop.
     """
     b, max_pages = cache.page_table.shape
     n_pages = n_pages.astype(jnp.int32)

@@ -26,6 +26,7 @@ import logging
 import threading
 import time
 from collections import deque
+from pathlib import Path
 from typing import Any, Callable
 
 from edgemesh.utils.tracing import JsonlLogger
@@ -106,7 +107,10 @@ class Supervisor:
             with self._lock:
                 self.total_failures += 1
                 self.consecutive_failures += 1
-                self.last_error = f"{type(exc).__name__}: {exc}"
+                # Local copy: the post-lock _event/restart below must log THIS
+                # request's error even if a concurrent failure overwrites
+                # self.last_error in the meantime.
+                error = self.last_error = f"{type(exc).__name__}: {exc}"
                 self.last_failure_ts = time.time()
                 # One restart per incident: the thread that trips the
                 # threshold claims the restart; concurrent failures while it
@@ -118,10 +122,10 @@ class Supervisor:
                 )
                 if need_restart:
                     self._restart_in_progress = True
-            self._event("request_failed", error=self.last_error)
+            self._event("request_failed", error=error)
             if need_restart:
                 try:
-                    self.restart(reason=self.last_error)
+                    self.restart(reason=error)
                 finally:
                     with self._lock:
                         self._restart_in_progress = False

@@ -1,0 +1,81 @@
+"""Request-level expert routing (agents/experts.py) — the working realization
+of the reference's planned Expert Models registry (13 domains, classifier vs
+summarizer routing)."""
+
+import numpy as np
+import pytest
+
+from edgemesh.agents.experts import (
+    DEFAULT_DOMAINS,
+    EmbeddingClassifier,
+    ExpertRouter,
+    ExpertSpec,
+    KeywordClassifier,
+    build_expert_router,
+)
+from edgemesh.eval.metrics import HashingEmbedder
+
+
+class FakeAgent:
+    def __init__(self, domain):
+        self.domain = domain
+        self.calls = []
+
+    def answer(self, question, prompt=None):
+        self.calls.append(question)
+        return {"answer": f"{self.domain}-answer", "role": "qa",
+                "confidence": 0.5, "tps": 1.0, "ttft_s": 0.0}
+
+
+def _router(domains=("science", "sports", "general"), **kw):
+    agents = {d: FakeAgent(d) for d in domains}
+    return build_expert_router(agents, **kw), agents
+
+
+def test_thirteen_default_domains():
+    assert len(DEFAULT_DOMAINS) == 13  # the Expert Models sheet's count
+    assert "general" in DEFAULT_DOMAINS
+
+
+def test_keyword_routing_dispatches_to_domain_expert():
+    router, agents = _router()
+    out = router.answer("Which team won the championship game last season?")
+    assert out["domain"] == "sports"
+    assert out["answer"] == "sports-answer"
+    assert agents["sports"].calls and not agents["science"].calls
+
+
+def test_keyword_fallback_to_general():
+    router, agents = _router()
+    out = router.answer("What is the airspeed velocity of an unladen swallow?")
+    assert out["domain"] == "general"
+
+
+def test_embedding_classifier_routes_by_descriptor_similarity():
+    specs = [ExpertSpec(domain=d, agent=FakeAgent(d)) for d in ("science", "sports")]
+    clf = EmbeddingClassifier(specs, HashingEmbedder())
+    # The hashing embedder sees heavy ngram overlap with the sports descriptor.
+    assert clf("championship league player game season") == "sports"
+
+
+def test_router_requires_experts():
+    with pytest.raises(ValueError, match="at least one"):
+        ExpertRouter(experts=[])
+
+
+def test_route_all_merges_without_refiner():
+    router, agents = _router(domains=("science", "sports"))
+    out = router.route_all("Any question at all?")
+    # best-confidence draft wins; both experts were consulted
+    assert len(out["drafts"]) == 2
+    assert agents["science"].calls and agents["sports"].calls
+
+
+def test_unknown_classifier_rejected():
+    with pytest.raises(ValueError, match="unknown classifier"):
+        _router(classifier="nope")
+
+
+def test_embedding_classifier_requires_embedder():
+    with pytest.raises(ValueError, match="needs an embedder"):
+        _router(classifier="embedding")

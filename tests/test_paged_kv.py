@@ -144,3 +144,17 @@ def test_paged_cache_head_sharding_on_mesh():
     sharded = shard_paged_cache(plain, cfg, mesh)
     got, out_cache = forward_prefill_paged(cfg, params, tokens, lengths, sharded)
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=1e-5, rtol=1e-5)
+
+
+def test_pool_overflow_recorded():
+    """Exhausting the free stack hands out trash pages but records it:
+    pool_overflowed() flips True (ADVICE r1: silent corruption guard)."""
+    from edgemesh.runtime.paged_kv import allocate, init_paged_cache, pool_overflowed
+
+    cfg = tiny_config("llama", num_layers=1)
+    cache = init_paged_cache(cfg, batch=2, total_pages=3, page_size=4, max_pages=4)
+    assert not pool_overflowed(cache)
+    cache = allocate(cache, jnp.array([1, 1]))  # 2 of 2 free pages used
+    assert not pool_overflowed(cache)
+    cache = allocate(cache, jnp.array([1, 0]))  # pool exhausted -> overflow
+    assert pool_overflowed(cache)
