@@ -167,11 +167,16 @@ class ExpertRouter:
     def route_all(self, question: str, refiner: Any | None = None) -> dict[str, Any]:
         """Summarizer mode: every expert answers; a refiner (or best
         confidence) merges — the sheet's alternative routing axis, sharing
-        the ensemble merge semantics (orchestrator.Ensemble.answer)."""
+        the ensemble merge semantics (orchestrator.Ensemble.answer). The
+        Ensemble (and its thread pool) is built once per (router, refiner)
+        and reused across questions."""
         from edgemesh.agents.orchestrator import Ensemble
 
-        ens = Ensemble(qa_agents=[s.agent for s in self.experts], refiner=refiner)
-        return ens.answer(question)
+        cached = getattr(self, "_route_all_ensemble", None)
+        if cached is None or cached.refiner is not refiner:
+            cached = Ensemble(qa_agents=[s.agent for s in self.experts], refiner=refiner)
+            self._route_all_ensemble = cached
+        return cached.answer(question)
 
 
 def build_expert_router(

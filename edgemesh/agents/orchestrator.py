@@ -21,6 +21,7 @@ answers: :356-363) with original wording.
 from __future__ import annotations
 
 import logging
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any
@@ -68,6 +69,7 @@ class Agent:
         return self.prompt_template.format(question=question, **extra)
 
     def answer(self, question: str, prompt: str | None = None) -> dict[str, Any]:
+        t_start = time.perf_counter()
         prompt = prompt if prompt is not None else self.format_prompt(question)
         max_prompt = self.cfg.max_seq_len - self.sampling.max_new_tokens
         if max_prompt < 1:
@@ -94,6 +96,11 @@ class Agent:
             "tps": result.tokens_per_sec,
             "ttft_s": result.prefill_time_s,
             "confidence": float(result.confidence[0]),
+            # Wall-clock span of this agent's work — lets callers verify that
+            # ensemble agents actually overlapped (tests/benchmarks assert
+            # interval overlap / concurrent-vs-serial ratio).
+            "t_start": t_start,
+            "t_end": time.perf_counter(),
         }
 
 

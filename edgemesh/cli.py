@@ -13,9 +13,22 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 
 from edgemesh.config import EdgeMeshConfig, build_arg_parser, load_config
+
+
+def _honor_platform_env() -> None:
+    """Make JAX_PLATFORMS work as documented even where a sitecustomize
+    force-registers another platform and overrides the env var after import
+    (this session's axon remote-TPU plugin does exactly that — without this,
+    `JAX_PLATFORMS=cpu edgemesh eval` silently dials the TPU pool)."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
 
 
 def _setup_logging(cfg: EdgeMeshConfig):
@@ -28,16 +41,20 @@ def _setup_logging(cfg: EdgeMeshConfig):
 def cmd_eval(cfg: EdgeMeshConfig) -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.eval.data import load_qa_csv, resolve_dataset_path
+    from edgemesh.eval.embedder import build_embedder
     from edgemesh.eval.harness import run_eval
 
     ensemble = build_ensemble(cfg)
     samples = load_qa_csv(resolve_dataset_path(cfg.eval.dataset_path), limit=cfg.eval.num_samples)
+    # Only pay for an embedding model when an embedding metric is requested.
+    needs_embedder = bool({"cosine", "bertscore"} & set(cfg.eval.metrics))
     report = run_eval(
         samples,
         ensemble.answer,
         output_jsonl=cfg.eval.output_jsonl,
         resume=cfg.eval.resume,
         metrics=cfg.eval.metrics,
+        embedder=build_embedder(cfg.embedder) if needs_embedder else None,
     )
     print(json.dumps(report))
     return 0
@@ -88,6 +105,7 @@ def cmd_download(cfg: EdgeMeshConfig) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    _honor_platform_env()
     argv = sys.argv[1:] if argv is None else argv
     top = argparse.ArgumentParser(prog="edgemesh")
     top.add_argument("command", choices=["eval", "serve", "bench", "download"])

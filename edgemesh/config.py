@@ -144,7 +144,10 @@ class EdgeMeshConfig:
     agents: list[AgentSpec] = field(default_factory=list)
     mesh: MeshSpec = field(default_factory=MeshSpec)
     eval: EvalSpec = field(default_factory=EvalSpec)
-    embedder: str = ""  # sentence-embedding model path for cosine metric
+    # Embedder for the cosine/bertscore metrics: "" = deterministic hashing
+    # fallback; "synthetic" = pinned tiny model through the JAX stack;
+    # a path = ingested HF checkpoint (MiniLM-analog). eval/embedder.py.
+    embedder: str = ""
     log_level: str = "INFO"
     seed: int = 0
 

@@ -1,0 +1,143 @@
+"""Model-based text embeddings through the edgemesh JAX stack.
+
+The reference scores semantic metrics with two downloaded encoders: a
+sentence-transformer MiniLM for cosine similarity (combiner_fp.py:312-316,
+:421) and a roberta-backed BERTScore (:302-305). This module provides the
+same capability through edgemesh's OWN model runtime — any ingested
+checkpoint (or a pinned synthetic model) yields sentence vectors and
+contextual token vectors from its final-norm hidden states
+(models/transformer.forward_hidden). The deterministic HashingEmbedder
+(eval/metrics.py) remains the explicit no-model fallback.
+
+Caveat recorded for honesty: absolute metric values from a decoder's hidden
+states (or a synthetic model) are NOT numerically comparable to the
+reference's MiniLM/roberta numbers in BASELINE.md Tables 1-2 — they are a
+consistent relative signal (same embedder across all systems under eval).
+Ingesting an actual MiniLM-class encoder checkpoint via hf_ingest closes
+that gap when one is present locally.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def _pad_bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class ModelEmbedder:
+    """Sentence + token embeddings from any edgemesh model.
+
+    Implements the metrics-suite embedder protocol:
+    - ``__call__(texts) -> [n, d]`` L2-normalized sentence vectors
+      (mean-pooled over valid positions);
+    - ``embed_tokens(text) -> (tokens, [t, d])`` contextual per-token
+      vectors for BERTScore greedy matching.
+
+    Sequences pad to a small set of static buckets so jit compiles once per
+    bucket, not per length (XLA static-shape discipline).
+    """
+
+    def __init__(
+        self,
+        cfg: Any,
+        params: Any,
+        tokenizer: Any,
+        max_len: int = 128,
+        buckets: tuple[int, ...] = (16, 32, 64, 128),
+    ):
+        from edgemesh.models.transformer import forward_hidden
+
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_len = min(max_len, cfg.max_seq_len)
+        kept = tuple(b for b in buckets if b < self.max_len)
+        # The top bucket is always exactly max_len, so no text the tokenizer
+        # kept gets silently truncated by bucket rounding.
+        self.buckets = kept + (self.max_len,)
+        self._forward = forward_hidden
+        self.dim = cfg.hidden_size
+
+    # -- internals ---------------------------------------------------------
+
+    def _encode(self, text: str) -> list[int]:
+        ids = self.tokenizer.encode(text, max_len=self.max_len)
+        return ids if ids else [getattr(self.tokenizer, "pad_id", 0)]
+
+    def _hidden(self, ids_batch: list[list[int]]) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (hidden [n, s, d] fp32, lengths [n])."""
+        pad = getattr(self.tokenizer, "pad_id", 0)
+        lengths = np.array([len(ids) for ids in ids_batch], np.int32)
+        s = _pad_bucket(int(lengths.max()), self.buckets)
+        tokens = np.full((len(ids_batch), s), pad, np.int32)
+        for i, ids in enumerate(ids_batch):
+            tokens[i, : min(len(ids), s)] = ids[:s]
+        lengths = np.minimum(lengths, s)
+        hid = self._forward(
+            self.cfg, self.params, jnp.asarray(tokens), jnp.asarray(lengths)
+        )
+        return np.asarray(hid, np.float32), lengths
+
+    # -- protocol ----------------------------------------------------------
+
+    def __call__(self, texts: list[str]) -> np.ndarray:
+        ids = [self._encode(t) for t in texts]
+        hid, lengths = self._hidden(ids)
+        s = hid.shape[1]
+        mask = (np.arange(s)[None, :] < lengths[:, None]).astype(np.float32)
+        pooled = (hid * mask[:, :, None]).sum(axis=1) / np.maximum(
+            mask.sum(axis=1, keepdims=True), 1.0
+        )
+        norm = np.linalg.norm(pooled, axis=1, keepdims=True)
+        return pooled / np.clip(norm, 1e-9, None)
+
+    def embed_tokens(self, text: str) -> tuple[list[str], np.ndarray]:
+        ids = self._encode(text)
+        hid, lengths = self._hidden([ids])
+        n = int(lengths[0])
+        toks = [self.tokenizer.decode([i]) for i in ids[:n]]
+        return toks, hid[0, :n]
+
+
+def build_embedder(spec: str = "", max_len: int = 128):
+    """Resolve the config's ``embedder`` key:
+
+    - ""            → HashingEmbedder (deterministic no-model fallback)
+    - "synthetic"   → ModelEmbedder over a pinned tiny random-init model
+                      (stable across runs/processes; relative signal only)
+    - anything else → ModelEmbedder over the HF checkpoint at that path
+    """
+    from edgemesh.eval.metrics import HashingEmbedder
+
+    if not spec:
+        return HashingEmbedder()
+    if spec == "synthetic":
+        import jax
+
+        from edgemesh.models.families import tiny_config
+        from edgemesh.models.tokenizer import load_tokenizer
+        from edgemesh.models.transformer import init_params
+
+        tokenizer = load_tokenizer(None)
+        cfg = tiny_config(
+            "llama", vocab_size=tokenizer.vocab_size + 1, hidden_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=4, intermediate_size=256,
+            max_seq_len=max(max_len, 128), dtype="float32",
+        )
+        params = init_params(cfg, jax.random.PRNGKey(1234))
+        return ModelEmbedder(cfg, params, tokenizer, max_len=max_len)
+    from edgemesh.models.hf_ingest import load_params
+    from edgemesh.models.tokenizer import load_tokenizer
+
+    cfg, params = load_params(spec)
+    tokenizer = load_tokenizer(spec)
+    return ModelEmbedder(cfg, params, tokenizer, max_len=max_len)

@@ -395,6 +395,26 @@ def _forward(
 ) -> tuple[jnp.ndarray, KVCache, jnp.ndarray]:
     """Shared prefill/decode body: scan one compiled layer over stacked
     params. Returns (logits, cache, summed moe aux loss)."""
+    x, new_cache, aux_sum = _scan_layers(
+        cfg, params, tokens, positions, cache, kv_valid, is_decode, attention, mlp
+    )
+    logits = lm_head_logits(cfg, params, x)
+    return logits, new_cache, aux_sum
+
+
+def _scan_layers(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache: KVCache,
+    kv_valid: jnp.ndarray,
+    is_decode: bool,
+    attention=_attention,
+    mlp=_mlp,
+) -> tuple[jnp.ndarray, KVCache, jnp.ndarray]:
+    """embed → layer scan; returns PRE-final-norm hidden states [b, s, h]
+    (lm_head_logits applies the final norm) plus cache and moe aux."""
     x = params["embed"]["weight"][tokens].astype(cfg.activation_dtype)
 
     def body(carry, scanned):
@@ -410,11 +430,29 @@ def _forward(
     (x, aux_sum), (new_k, new_v) = jax.lax.scan(
         body, (x, jnp.zeros((), jnp.float32)), (params["layers"], cache.k, cache.v)
     )
-
-    logits = lm_head_logits(cfg, params, x)
-
     new_lengths = jnp.max(positions, axis=1) + 1
-    return logits, KVCache(new_k, new_v, new_lengths), aux_sum
+    return x, KVCache(new_k, new_v, new_lengths), aux_sum
+
+
+@partial(jax.jit, static_argnums=(0,))
+def forward_hidden(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [b, s] right-padded
+    lengths: jnp.ndarray,  # [b] true lengths
+) -> jnp.ndarray:
+    """Final-norm contextual hidden states [b, s, hidden] — the encoder view
+    of a decoder model, used by the model-based embedding metrics
+    (eval/embedder.py): mean-pooled for sentence cosine, per-position for
+    BERTScore token matching (reference analog: the sentence-transformer +
+    roberta encoders, combiner_fp.py:302-316,421)."""
+    b, s = tokens.shape
+    cache = init_kv_cache(cfg, b, s)
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    positions = jnp.minimum(positions, (jnp.maximum(lengths, 1) - 1)[:, None])
+    kv_valid = jnp.arange(s)[None, :] < lengths[:, None]
+    x, _, _ = _scan_layers(cfg, params, tokens, positions, cache, kv_valid, is_decode=False)
+    return _apply_norm(cfg, params["final_norm"], x)
 
 
 @partial(jax.jit, static_argnums=(0,))

@@ -1,0 +1,81 @@
+"""Model-based embedding metrics (eval/embedder.py): the metrics-suite
+embedder protocol served by a real model forward instead of the hashing
+stand-in (VERDICT r1 missing #2)."""
+
+import numpy as np
+import pytest
+
+from edgemesh.eval.embedder import ModelEmbedder, build_embedder
+from edgemesh.eval.harness import score_sample
+from edgemesh.eval.metrics import HashingEmbedder, bertscore, cosine_similarity
+
+
+@pytest.fixture(scope="module")
+def model_embedder():
+    emb = build_embedder("synthetic")
+    assert isinstance(emb, ModelEmbedder)
+    return emb
+
+
+def test_build_embedder_fallbacks():
+    assert isinstance(build_embedder(""), HashingEmbedder)
+
+
+def test_sentence_vectors_shape_and_norm(model_embedder):
+    vecs = model_embedder(["what is the capital of france", "unrelated text"])
+    assert vecs.shape == (2, model_embedder.dim)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, atol=1e-5)
+
+
+def test_identical_texts_cosine_one(model_embedder):
+    assert cosine_similarity("the same text", "the same text", model_embedder) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_related_beats_unrelated(model_embedder):
+    """Contextual embeddings must rank a near-duplicate above an unrelated
+    string — the minimum semantic-signal bar."""
+    a = "the capital of france is paris"
+    near = "the capital city of france is paris"
+    far = "zxqv jkwp mmnb ttyy"
+    sim_near = cosine_similarity(a, near, model_embedder)
+    sim_far = cosine_similarity(a, far, model_embedder)
+    assert sim_near > sim_far
+
+
+def test_token_embeddings_interface(model_embedder):
+    toks, vecs = model_embedder.embed_tokens("hello world")
+    assert len(toks) == vecs.shape[0] > 0
+    assert vecs.shape[1] == model_embedder.dim
+    bs = bertscore("hello world", "hello world", model_embedder.embed_tokens)
+    assert bs["f1"] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_empty_text_does_not_crash(model_embedder):
+    vecs = model_embedder(["", "x"])
+    assert np.all(np.isfinite(vecs))
+    bs = bertscore("", "reference", model_embedder.embed_tokens)
+    assert bs["f1"] >= 0.0
+
+
+def test_score_sample_accepts_model_embedder(model_embedder):
+    row = score_sample("paris is the capital", "paris", embedder=model_embedder)
+    for key in ("rouge1", "bleu", "cosine", "bertscore"):
+        assert key in row and np.isfinite(row[key]), key
+
+
+def test_deterministic_across_instances():
+    """'synthetic' pins the init seed: two builds embed identically (resume
+    safety — a resumed eval scores with the same embedder)."""
+    a = build_embedder("synthetic")(["determinism check"])
+    b = build_embedder("synthetic")(["determinism check"])
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_bucket_padding_consistency(model_embedder):
+    """The same short text embeds (nearly) identically whether alone or next
+    to a long neighbor that forces a bigger bucket — pooling must mask pads."""
+    short = "short question"
+    alone = model_embedder([short])
+    longer = "w " * 100
+    together = model_embedder([short, longer])
+    np.testing.assert_allclose(alone[0], together[0], atol=1e-4)
