@@ -78,7 +78,17 @@ class Agent:
                 f"for a prompt within max_seq_len {self.cfg.max_seq_len}"
             )
         ids = self.tokenizer.encode(prompt, max_len=max_prompt)
-        tokens = jnp.asarray([ids], dtype=jnp.int32)
+        # Pad the prompt up to a static bucket: jit specializes on shapes, so
+        # raw per-question lengths would compile a fresh prefill per unique
+        # length — unbounded compile-cache growth that OOMs a small host over
+        # a 1,000-sample sweep. Buckets bound it to a handful of programs.
+        bucket = 16
+        while bucket < len(ids) and bucket < max_prompt:
+            bucket *= 2
+        bucket = min(bucket, max_prompt)
+        pad = getattr(self.tokenizer, "pad_id", 0)
+        padded = ids + [pad] * (bucket - len(ids))
+        tokens = jnp.asarray([padded], dtype=jnp.int32)
         lengths = jnp.asarray([len(ids)], dtype=jnp.int32)
         result = generate(
             self.cfg,

@@ -82,6 +82,20 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
             norm_eps=hf.get("rms_norm_eps", 1e-5),
             tie_embeddings=hf.get("tie_word_embeddings", False),
         )
+        rs = hf.get("rope_scaling") or {}
+        if rs:
+            # Llama-3.2 ships {"rope_type": "llama3", factor, low_freq_factor,
+            # high_freq_factor, original_max_position_embeddings}; older
+            # checkpoints use {"type": "linear", factor}.
+            kw.update(
+                rope_scaling_type=rs.get("rope_type", rs.get("type", "linear")),
+                rope_scaling_factor=float(rs.get("factor", 1.0)),
+                rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+                rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+                rope_original_max_position=int(
+                    rs.get("original_max_position_embeddings", 8192)
+                ),
+            )
     elif family == "neox":
         kw = dict(
             vocab_size=hf["vocab_size"],

@@ -55,6 +55,12 @@ class ModelConfig:
     shared_input_norm: bool = False  # Phi-2: ONE norm feeds both attn and mlp
     rotary_fraction: float = 1.0
     rope_theta: float = 10000.0
+    # HF rope_scaling block (Llama-3.x context extension): "" = none.
+    rope_scaling_type: str = ""  # "" | linear | llama3
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
     qkv_bias: bool = False
     out_bias: bool = False  # attn output proj + mlp projections
     lm_head_bias: bool = False
@@ -85,6 +91,19 @@ class ModelConfig:
     @property
     def head_size(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def rope_scaling(self):
+        """Hashable scaling tuple for ops.rope, or None when unscaled."""
+        if not self.rope_scaling_type:
+            return None
+        return (
+            self.rope_scaling_type,
+            self.rope_scaling_factor,
+            self.rope_low_freq_factor,
+            self.rope_high_freq_factor,
+            self.rope_original_max_position,
+        )
 
     @property
     def rotary_dim(self) -> int:
@@ -284,8 +303,8 @@ def qkv_proj(
     k = dense(layer["k"], x, cfg.quant_mode).reshape(b, s, kh, hd)
     v = dense(layer["v"], x, cfg.quant_mode).reshape(b, s, kh, hd)
     if cfg.rotary_dim > 0:
-        q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta, cfg.rope_scaling)
     return q, k, v
 
 

@@ -13,15 +13,48 @@ cheap fused gather, not a host round-trip.
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
+
+# RopeScaling = (type, factor, low_freq_factor, high_freq_factor,
+#                original_max_position) — a plain hashable tuple so it can
+# ride a frozen ModelConfig into jit static args. type: "linear" | "llama3".
+RopeScaling = tuple[str, float, float, float, int]
 
 
 def rope_frequencies(
-    rotary_dim: int, theta: float = 10000.0
+    rotary_dim: int,
+    theta: float = 10000.0,
+    scaling: RopeScaling | None = None,
 ) -> jnp.ndarray:
-    """Inverse frequencies for the rotated sub-dimension. Shape [rotary_dim//2]."""
+    """Inverse frequencies for the rotated sub-dimension. Shape [rotary_dim//2].
+
+    ``scaling`` applies HF-style context extension: "linear" divides all
+    frequencies by the factor; "llama3" (Llama-3.x checkpoints' rope_scaling
+    block) rescales only wavelengths past the original context — long
+    wavelengths divide by the factor, short ones pass through, mid-band
+    interpolates smoothly between the two.
+    """
     exponent = jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim
-    return 1.0 / (theta ** exponent)
+    inv_freq = 1.0 / (theta ** exponent)
+    if scaling is None or scaling[0] in ("", "none", "default"):
+        return inv_freq
+    kind, factor, low_ff, high_ff, orig_max = scaling
+    if kind == "linear":
+        return inv_freq / factor
+    if kind == "llama3":
+        low_wavelen = orig_max / low_ff
+        high_wavelen = orig_max / high_ff
+        wavelen = 2.0 * math.pi / inv_freq
+        smooth = (orig_max / wavelen - low_ff) / (high_ff - low_ff)
+        mid = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        return jnp.where(
+            wavelen > low_wavelen,
+            inv_freq / factor,
+            jnp.where(wavelen < high_wavelen, inv_freq, mid),
+        )
+    raise ValueError(f"unknown rope scaling type {kind!r}")
 
 
 def apply_rope(
@@ -29,6 +62,7 @@ def apply_rope(
     positions: jnp.ndarray,  # [batch, seq] int32
     rotary_dim: int,
     theta: float = 10000.0,
+    scaling: RopeScaling | None = None,
 ) -> jnp.ndarray:
     """Rotate the first ``rotary_dim`` channels of each head; pass the rest through.
 
@@ -37,7 +71,7 @@ def apply_rope(
     [x1*cos - x2*sin, x2*cos + x1*sin].
     """
     dtype = x.dtype
-    inv_freq = rope_frequencies(rotary_dim, theta)  # [rd/2]
+    inv_freq = rope_frequencies(rotary_dim, theta, scaling)  # [rd/2]
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [b, s, rd/2]
     cos = jnp.cos(angles)[:, :, None, :]  # [b, s, 1, rd/2]
     sin = jnp.sin(angles)[:, :, None, :]

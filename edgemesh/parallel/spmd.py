@@ -160,8 +160,8 @@ def _spmd_attention(
     k = _col_dense(layer["k"], x).reshape(b, s, kh_l, hd)
     v = _col_dense(layer["v"], x).reshape(b, s, kh_l, hd)
     if cfg.rotary_dim > 0:
-        q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta, cfg.rope_scaling)
 
     out = ring_attend_block(
         q, k, v, positions, valid, axis="sp", sp=sp, pcast_accumulators=False

@@ -30,13 +30,14 @@ def _compare(ckpt_dir, hf_model, seq=12, atol=2e-3):
     with torch.no_grad():
         hf_logits = hf_model(torch.tensor(tokens)).logits.float().numpy()
 
-    cache = init_kv_cache(cfg, 1, 32)
+    max_seq = max(32, seq + 8)
+    cache = init_kv_cache(cfg, 1, max_seq)
     # forward_prefill returns last-token logits; compare full sequence by
     # calling the underlying forward through prefill at each prefix length.
     from edgemesh.models.transformer import _forward
 
     positions = jnp.broadcast_to(jnp.arange(seq)[None, :], (1, seq))
-    kv_valid = jnp.arange(32)[None, :] < seq
+    kv_valid = jnp.arange(max_seq)[None, :] < seq
     ours, _, _ = _forward(
         cfg, params, jnp.asarray(tokens), positions, cache, kv_valid, is_decode=False
     )
@@ -100,4 +101,73 @@ def test_phi2_parity(tmp_path):
     torch.manual_seed(3)
     model = PhiForCausalLM(hf_cfg).eval()
     model.save_pretrained(tmp_path)
+    _compare(tmp_path, model)
+
+
+def test_llama3_rope_scaling_parity(tmp_path):
+    """Llama-3.2-style rope_scaling (rope_type=llama3): positions past the
+    'original' context exercise all three wavelength bands. Catches
+    frequency-rescale mistakes that plain short-context parity cannot."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, tie_word_embeddings=False,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 4.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 16,
+        },
+    )
+    torch.manual_seed(4)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path, dtype="float32")
+    assert cfg.rope_scaling_type == "llama3" and cfg.rope_scaling_factor == 4.0
+    _compare(tmp_path, model, seq=40)  # spans wavelengths beyond orig_max=16
+
+
+def test_sharded_safetensors_ingest(tmp_path):
+    """Real 1B+ checkpoints ship sharded safetensors with an index json;
+    ingest must reassemble them identically to a single-file save."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(5)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    single, sharded = tmp_path / "single", tmp_path / "sharded"
+    model.save_pretrained(single)
+    model.save_pretrained(sharded, max_shard_size="50KB")
+    assert (sharded / "model.safetensors.index.json").exists(), "test setup: not sharded"
+    _, p1 = load_params(single)
+    _, p2 = load_params(sharded)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        p1, p2,
+    )
+    _compare(sharded, model)
+
+
+def test_phi2_head_dim_80_parity(tmp_path):
+    """The real Phi-2's head_dim is 80 (2560/32) — not a lane multiple; the
+    XLA attention path must stay exact there (the TPU kernel paths pad or
+    fall back; this pins the numerics)."""
+    from transformers import PhiConfig, PhiForCausalLM
+
+    hf_cfg = PhiConfig(
+        vocab_size=128, hidden_size=160, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=2,  # head_dim = 80
+        max_position_embeddings=64, partial_rotary_factor=0.4,
+        layer_norm_eps=1e-5,
+    )
+    torch.manual_seed(6)
+    model = PhiForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path, dtype="float32")
+    assert cfg.head_size == 80 and cfg.rotary_dim == 32
     _compare(tmp_path, model)
