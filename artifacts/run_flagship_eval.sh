@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Flagship 1,000-sample ensemble evaluation (the reference's headline artifact:
+# Code/C-DAC Server/combiner_fp.py:429-474 over natural_questions_1000.csv).
+#
+# Runs in 100-sample segments, each a FRESH process that RESUMES from
+# artifacts/results_synthetic.jsonl — this both exercises the harness's
+# resume path (SURVEY.md §5.4) for real and bounds per-process compile-cache
+# growth (a prior single-process run died at row ~152 with an LLVM
+# "Cannot allocate memory" during a late compile; see eval_seg1.log history).
+#
+# Models are SYNTHETIC (random-init tiny transformers, one per role) because
+# this environment ships no trained checkpoints and has no network egress —
+# the artifact demonstrates the full harness machinery (3-agent ensemble,
+# 9 metrics incl. model-based embeddings, JSONL persistence, resume,
+# zero-fill policy, aggregate report), NOT quality parity with BASELINE.md
+# Tables 1-2. See README.md "Flagship evaluation artifact" for the honest
+# comparison.
+set -u
+cd "$(dirname "$0")/.."
+OUT=artifacts/results_synthetic.jsonl
+LOG=artifacts/eval_flagship.log
+REPORT=artifacts/report_synthetic.json
+: > "$LOG"
+for seg in $(seq 1 10); do
+  n=$((seg * 100))
+  echo "=== segment $seg (samples <= $n) $(date -u +%FT%TZ) ===" >> "$LOG"
+  JAX_PLATFORMS=cpu python -m edgemesh.cli eval \
+    --config examples/ensemble_synthetic.yaml \
+    --embedder synthetic \
+    --eval.num_samples "$n" \
+    --eval.output_jsonl "$OUT" >> "$LOG" 2>&1
+  rc=$?
+  echo "segment $seg rc=$rc" >> "$LOG"
+done
+# The last segment's printed report aggregates all 1,000 rows.
+grep -E '^\{' "$LOG" | tail -1 > "$REPORT"
+echo "done: $(wc -l < "$OUT") rows; report -> $REPORT"

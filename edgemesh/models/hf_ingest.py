@@ -87,8 +87,16 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
             # Llama-3.2 ships {"rope_type": "llama3", factor, low_freq_factor,
             # high_freq_factor, original_max_position_embeddings}; older
             # checkpoints use {"type": "linear", factor}.
+            rs_type = rs.get("rope_type", rs.get("type", "linear"))
+            if rs_type not in ("linear", "llama3", "default", "none", ""):
+                # Fail at ingest, not from inside the first jitted forward
+                # (ops/rope.py would raise there, far from the cause).
+                raise ValueError(
+                    f"unsupported rope_scaling type {rs_type!r} in "
+                    f"{ckpt / 'config.json'}; supported: linear, llama3"
+                )
             kw.update(
-                rope_scaling_type=rs.get("rope_type", rs.get("type", "linear")),
+                rope_scaling_type=rs_type,
                 rope_scaling_factor=float(rs.get("factor", 1.0)),
                 rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
                 rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
@@ -126,6 +134,13 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
         )
     else:  # pragma: no cover
         raise ValueError(family)
+    if family != "llama" and hf.get("rope_scaling"):
+        # The neox/phi2 forward paths don't consume a scaling block; ignoring
+        # it would silently produce wrong logits for a long-context variant.
+        raise ValueError(
+            f"rope_scaling in {ckpt / 'config.json'} is not supported for the "
+            f"{family} family"
+        )
     kw.update(overrides)
     return config_for_family(family, **kw)
 

@@ -1,0 +1,98 @@
+"""Kernel-contract asserts via checkify (SURVEY.md §5.2).
+
+JAX's functional purity supplies the race-freedom the reference never had to
+think about (its only concurrency is a gRPC thread pool,
+``Code/gRPC/server.py:14``), but the Pallas kernels still carry
+data-dependent contracts a caller can violate under jit with NO error —
+only silently wrong numbers:
+
+- paged attention: a page-table entry outside the physical pool makes the
+  DMA engine fetch whatever lives at that block index (ops/paged_attention.py
+  dereferences ``table[b, p]`` at DMA-issue time); an oversized ``kv_lens``
+  un-masks trash-page columns.
+- flash attention: ``kv_lens`` beyond the padded kv extent un-masks padding;
+  non-finite Q/K poisons the online-softmax running max forever.
+- fused int8 matmul: non-positive / non-finite weight scales turn the
+  epilogue rescale into NaN/garbage amplification.
+
+Each kernel wrapper takes ``check=True`` (static) to emit these as
+``checkify.check`` assertions. They are free when off (the default), and
+when on they raise precise errors through ``checked()``:
+
+    from edgemesh.ops.checks import checked
+    out = checked(lambda q, t: paged_decode_attention(q, ..., check=True))(q, t)
+
+Under eager execution ``check=True`` raises directly; under jit the caller
+wraps with ``checked``/``checkify.checkify`` (checkify functionalizes the
+checks; an unwrapped jitted call with checks on fails at trace time with a
+clear checkify error rather than running unvalidated).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+
+def checked(fn):
+    """Run ``fn`` with its checkify.check assertions live: functionalize,
+    call, and re-raise any tripped check host-side. Composes with jit —
+    ``checked(jitted_fn)`` is the debug entry point for every kernel here."""
+    cfn = checkify.checkify(fn, errors=checkify.user_checks)
+
+    def run(*args, **kwargs):
+        err, out = cfn(*args, **kwargs)
+        err.throw()
+        return out
+
+    return run
+
+
+def check_flash_inputs(q, k, kv_lens, q_offsets) -> None:
+    skv = k.shape[1]
+    checkify.check(jnp.all(kv_lens >= 0), "flash_attention: negative kv_lens")
+    checkify.check(
+        jnp.all(kv_lens <= skv),
+        "flash_attention: kv_lens exceeds kv extent {s} (padding would be "
+        "un-masked)", s=jnp.int32(skv),
+    )
+    checkify.check(jnp.all(q_offsets >= 0), "flash_attention: negative q_offsets")
+    checkify.check(
+        jnp.all(jnp.isfinite(q.astype(jnp.float32))),
+        "flash_attention: non-finite query activations",
+    )
+    checkify.check(
+        jnp.all(jnp.isfinite(k.astype(jnp.float32))),
+        "flash_attention: non-finite key activations",
+    )
+
+
+def check_paged_inputs(q, k_pages, page_table, kv_lens) -> None:
+    total_pages = k_pages.shape[1]
+    page_size = k_pages.shape[2]
+    max_tokens = page_table.shape[1] * page_size
+    checkify.check(
+        jnp.all((page_table >= 0) & (page_table < total_pages)),
+        "paged_attention: page-table entry outside the {n}-page physical pool "
+        "(the DMA would fetch unrelated memory)", n=jnp.int32(total_pages),
+    )
+    checkify.check(
+        jnp.all((kv_lens >= 1) & (kv_lens <= max_tokens)),
+        "paged_attention: kv_lens outside [1, {m}] (table capacity)",
+        m=jnp.int32(max_tokens),
+    )
+    checkify.check(
+        jnp.all(jnp.isfinite(q.astype(jnp.float32))),
+        "paged_attention: non-finite query activations",
+    )
+
+
+def check_int8_inputs(x, w_q, scales) -> None:
+    checkify.check(
+        jnp.all(jnp.isfinite(scales) & (scales > 0)),
+        "int8_matmul: weight scales must be finite and positive",
+    )
+    checkify.check(
+        jnp.all(jnp.isfinite(x.astype(jnp.float32))),
+        "int8_matmul: non-finite activations",
+    )

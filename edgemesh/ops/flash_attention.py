@@ -133,7 +133,7 @@ def _flash_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "causal", "block_q", "block_k", "interpret"),
+    static_argnames=("scale", "causal", "block_q", "block_k", "interpret", "check"),
 )
 def flash_attention(
     q: jnp.ndarray,  # [b, s, num_heads, head_dim]
@@ -146,6 +146,7 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 512,
     interpret: bool = False,
+    check: bool = False,
 ) -> jnp.ndarray:
     """Causal flash attention; numerics match ops.attention.attend.
 
@@ -155,6 +156,9 @@ def flash_attention(
     sees the full valid prefix ``j < kv_lens[b]`` (decode: the new token's
     position is ``kv_lens-1``, so its causal window IS the valid prefix).
     Returns [b, s, num_heads, head_dim] in q's dtype.
+
+    ``check=True`` emits checkify contract asserts on kv_lens/q_offsets
+    bounds and Q/K finiteness — run through ops.checks.checked (§5.2).
     """
     if not HAVE_PALLAS:  # pragma: no cover
         raise RuntimeError("pallas unavailable")
@@ -164,6 +168,10 @@ def flash_attention(
     scale = scale if scale is not None else hd**-0.5
     if q_offsets is None:
         q_offsets = jnp.zeros((b,), jnp.int32)
+    if check:
+        from edgemesh.ops.checks import check_flash_inputs
+
+        check_flash_inputs(q, k, kv_lens, q_offsets)
 
     block_q = min(block_q, _round_up(s, 16))
     block_k = min(block_k, _round_up(skv, 16))

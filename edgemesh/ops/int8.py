@@ -241,6 +241,7 @@ def int8_matmul_fused(
     scales: jnp.ndarray,  # [N]
     *,
     interpret: bool = False,
+    check: bool = False,
 ) -> jnp.ndarray:
     """Model-facing entry for the fused Pallas w8a8 kernel.
 
@@ -253,7 +254,14 @@ def int8_matmul_fused(
     Numerics note: the kernel quantizes activations per (row, K-block) while
     the XLA path quantizes per whole row, so the two differ by normal int8
     rounding, not bit-exactly.
+
+    ``check=True`` emits checkify contract asserts (positive finite scales,
+    finite activations) — run through ops.checks.checked (§5.2).
     """
+    if check:
+        from edgemesh.ops.checks import check_int8_inputs
+
+        check_int8_inputs(x, w_q, scales)
     *lead, k = x.shape
     n = w_q.shape[1]
     x2 = x.reshape(-1, k)

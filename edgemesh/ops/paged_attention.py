@@ -88,7 +88,7 @@ def _paged_kernel(
         o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=("scale", "interpret", "check"))
 def paged_decode_attention(
     q: jnp.ndarray,  # [b, num_heads, head_dim] — one query token per row
     k_pages: jnp.ndarray,  # [kv_heads, total_pages, page_size, head_dim]
@@ -97,14 +97,23 @@ def paged_decode_attention(
     kv_lens: jnp.ndarray,  # [b] int32 — valid tokens per row (incl. current)
     scale: float | None = None,
     interpret: bool = False,
+    check: bool = False,
 ) -> jnp.ndarray:
     """Attention of one decode token per row over its paged KV prefix.
 
     Returns [b, num_heads, head_dim] in q's dtype. Unallocated table slots
     point at the trash page (physical 0); they are DMA'd but fully masked.
+
+    ``check=True`` emits checkify contract asserts (page-table entries inside
+    the physical pool, kv_lens within table capacity, finite queries) — run
+    through ops.checks.checked (§5.2).
     """
     if not HAVE_PALLAS:  # pragma: no cover
         raise RuntimeError("pallas unavailable")
+    if check:
+        from edgemesh.ops.checks import check_paged_inputs
+
+        check_paged_inputs(q, k_pages, page_table, kv_lens)
     b, nh, hd = q.shape
     kh, _, ps, _ = k_pages.shape
     groups = nh // kh

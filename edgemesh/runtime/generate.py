@@ -188,12 +188,16 @@ def generate(
         )
     rng = rng if rng is not None else jax.random.PRNGKey(sampling.seed)
 
+    from edgemesh.utils.platform import device_sync
     from edgemesh.utils.tracing import trace
 
     t0 = time.perf_counter()
     with trace("edgemesh/prefill"):
         first_logits, cache = prefill_fn(cfg, params, tokens, lengths, cache)
-        first_logits.block_until_ready()
+        # NOT block_until_ready: on the tunneled TPU platform that returns
+        # before the program finishes, silently shrinking the timed window
+        # (utils/platform.device_sync). A 1-element readback is a real fence.
+        device_sync(first_logits)
     t1 = time.perf_counter()
 
     valid = jnp.arange(prompt_len)[None, :] < lengths[:, None]
@@ -205,7 +209,7 @@ def generate(
             cfg, params, sampling, max_new, int(eos_id), first_logits, cache,
             token_mask, rng, decode_fn,
         )
-        out.block_until_ready()
+        device_sync(out)
     t2 = time.perf_counter()
 
     total_generated = int(jnp.sum(num_generated))

@@ -15,6 +15,25 @@ import functools
 import jax
 
 
+def device_sync(x) -> None:
+    """Reliable completion barrier for timing.
+
+    On the tunneled "axon" platform, ``Array.block_until_ready()`` returns
+    before the producing program has finished (measured: ~0.7 ms for a
+    program whose results take ~900 ms to materialize), so wall-clock
+    windows closed with it can exclude nearly all device work. A 1-element
+    device→host copy cannot complete early — the bytes don't exist until
+    the producing executable has run — so force one on a single leaf.
+    All outputs of one XLA executable materialize together, hence syncing
+    any element of any output leaf fences the whole program.
+    """
+    import numpy as np
+
+    leaves = jax.tree.leaves(x)
+    if leaves:
+        np.asarray(jax.numpy.ravel(leaves[0])[:1])
+
+
 @functools.cache
 def on_tpu() -> bool:
     """True when the default JAX backend drives real TPU hardware (including

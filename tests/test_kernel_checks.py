@@ -1,0 +1,97 @@
+"""checkify kernel-contract asserts (ops/checks.py, SURVEY.md §5.2).
+
+Every kernel runs in interpret mode on CPU; the checks live in the JAX-level
+wrappers so they functionalize identically on real TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import checkify
+
+from edgemesh.ops.checks import checked
+from edgemesh.ops.flash_attention import flash_attention
+from edgemesh.ops.int8 import int8_matmul_fused, quantize_weight
+from edgemesh.ops.paged_attention import paged_decode_attention
+
+
+def _paged_inputs(bad_table=False, bad_lens=False):
+    b, kh, nh, hd, ps, pages, maxp = 2, 2, 4, 64, 8, 6, 3
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (b, nh, hd), jnp.float32)
+    k_pages = jax.random.normal(rng, (kh, pages, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(jax.random.PRNGKey(1), (kh, pages, ps, hd), jnp.float32)
+    table = jnp.array([[1, 2, 0], [3, 4, 5]], jnp.int32)
+    if bad_table:
+        table = table.at[0, 1].set(pages + 7)  # outside the physical pool
+    lens = jnp.array([12, 20], jnp.int32)
+    if bad_lens:
+        lens = lens.at[1].set(maxp * ps + 1)  # beyond table capacity
+    return q, k_pages, v_pages, table, lens
+
+
+def test_paged_check_passes_on_valid_inputs():
+    q, kp, vp, table, lens = _paged_inputs()
+    fn = checked(
+        lambda *a: paged_decode_attention(*a, interpret=True, check=True)
+    )
+    out = fn(q, kp, vp, table, lens)
+    ref = paged_decode_attention(q, kp, vp, table, lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_paged_check_catches_out_of_pool_page():
+    q, kp, vp, table, lens = _paged_inputs(bad_table=True)
+    fn = checked(
+        lambda *a: paged_decode_attention(*a, interpret=True, check=True)
+    )
+    with pytest.raises(checkify.JaxRuntimeError, match="page-table entry"):
+        fn(q, kp, vp, table, lens)
+
+
+def test_paged_check_catches_overlong_kv_lens():
+    q, kp, vp, table, lens = _paged_inputs(bad_lens=True)
+    fn = checked(
+        lambda *a: paged_decode_attention(*a, interpret=True, check=True)
+    )
+    with pytest.raises(checkify.JaxRuntimeError, match="kv_lens"):
+        fn(q, kp, vp, table, lens)
+
+
+def test_flash_check_catches_overlong_kv_lens():
+    b, s, nh, hd = 1, 16, 4, 64
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (b, s, nh, hd), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, nh, hd), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, nh, hd), jnp.float32)
+    fn = checked(
+        lambda *a: flash_attention(*a, interpret=True, check=True)
+    )
+    out = fn(q, k, v, jnp.array([s], jnp.int32))  # valid: passes
+    assert out.shape == q.shape
+    with pytest.raises(checkify.JaxRuntimeError, match="kv_lens exceeds"):
+        fn(q, k, v, jnp.array([s + 1], jnp.int32))
+
+
+def test_flash_check_catches_nan_query():
+    b, s, nh, hd = 1, 8, 2, 64
+    q = jnp.full((b, s, nh, hd), jnp.nan, jnp.float32)
+    k = jnp.ones((b, s, nh, hd), jnp.float32)
+    fn = checked(
+        lambda *a: flash_attention(*a, interpret=True, check=True)
+    )
+    with pytest.raises(checkify.JaxRuntimeError, match="non-finite query"):
+        fn(q, k, k, jnp.array([s], jnp.int32))
+
+
+def test_int8_check_catches_bad_scales():
+    x = jnp.ones((4, 128), jnp.float32)
+    w_q, scales = quantize_weight(jax.random.normal(jax.random.PRNGKey(0), (128, 128)))
+    fn = checked(
+        lambda *a: int8_matmul_fused(*a, interpret=True, check=True)
+    )
+    out = fn(x, w_q, scales)  # valid: passes
+    assert out.shape == (4, 128)
+    with pytest.raises(checkify.JaxRuntimeError, match="scales"):
+        fn(x, w_q, scales.at[0].set(jnp.nan))

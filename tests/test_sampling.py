@@ -59,3 +59,39 @@ def test_sampled_respects_top_k1():
     for seed in range(5):
         t = sample_token(jax.random.PRNGKey(seed), logits, p)
         assert int(t[0]) == 1
+
+
+def test_candidate_path_stays_inside_filtered_set():
+    # The top-k candidate-set fast path must only ever emit tokens the
+    # reference filter chain (apply_top_k then apply_top_p) would keep.
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(rng, (4, 64))
+    p = SamplingParams(do_sample=True, top_k=8, top_p=0.7, temperature=0.9, repetition_penalty=1.0)
+    ref = apply_top_p(apply_top_k(logits / p.temperature, p.top_k), p.top_p)
+    allowed = np.asarray(ref > NEG_INF / 2)
+    for seed in range(20):
+        t = np.asarray(sample_token(jax.random.PRNGKey(seed), logits, p))
+        assert all(allowed[b, t[b]] for b in range(4))
+
+
+def test_candidate_path_matches_full_vocab_distribution():
+    # Empirical frequencies from the [batch, k] candidate draw match the
+    # softmax of the filtered full-vocab logits (same distribution, cheaper).
+    logits = jnp.log(jnp.array([[0.45, 0.35, 0.15, 0.04, 0.01]]))
+    p = SamplingParams(do_sample=True, top_k=3, top_p=1.0, temperature=1.0, repetition_penalty=1.0)
+    draws = 4000
+    keys = jax.random.split(jax.random.PRNGKey(1), draws)
+    toks = np.asarray(
+        jax.vmap(lambda k: sample_token(k, logits, p))(keys)
+    ).ravel()
+    freq = np.bincount(toks, minlength=5) / draws
+    expect = np.array([0.45, 0.35, 0.15, 0.0, 0.0])
+    expect = expect / expect.sum()
+    np.testing.assert_allclose(freq, expect, atol=0.03)
+
+
+def test_top_p_zero_degenerates_to_argmax_with_top_k():
+    logits = jnp.array([[0.1, 9.0, 0.2, 3.0]])
+    p = SamplingParams(do_sample=True, top_k=3, top_p=0.0, temperature=1.0, repetition_penalty=1.0)
+    for seed in range(5):
+        assert int(sample_token(jax.random.PRNGKey(seed), logits, p)[0]) == 1
