@@ -19,6 +19,7 @@ from the eval harness with real checkpoints, never from here.
 from __future__ import annotations
 
 import os
+import sys
 import time
 from typing import Any
 
@@ -54,19 +55,35 @@ PRESETS = {
 }
 
 
+_T0 = time.perf_counter()
+
+
+def _progress(msg: str) -> None:
+    """Stderr breadcrumbs so a hung run (e.g. an unresponsive TPU tunnel —
+    observed mid-round-2: even trivial dispatches blocked forever) shows
+    WHERE it stopped in the driver's captured tail."""
+    print(f"[bench +{time.perf_counter() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
 def _tree_bytes(params) -> int:
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
 
 
 def _build(preset: str, precision: str, quant_mode: str):
+    from edgemesh.utils.platform import tree_sync
+
+    _progress(f"build {preset}/{precision}: init_params")
     cfg = config_for_family("llama", **PRESETS[preset])
     if preset != "tiny":
         cfg = cfg.replace(dtype="bfloat16")
     params = init_params(cfg, jax.random.PRNGKey(0))
     if precision == "int8":
+        _progress("quantize_params")
         params = quantize_params(params)
         params = jax.tree.map(lambda x: jax.device_put(x), params)
         cfg = cfg.replace(quant_mode=quant_mode)
+    tree_sync(params)
+    _progress("params resident on device")
     return cfg, params
 
 
@@ -79,11 +96,15 @@ def decode_benchmark(
     decode_steps: int = 128,
     repeats: int = 3,
     built: tuple | None = None,
+    kv_backend: str = "dense",
 ) -> dict[str, Any]:
-    """One (precision, quant_mode, batch) point: best-of-`repeats` decode
-    tok/s with TTFT and bandwidth-utilization accounting. ``built`` reuses a
-    (cfg, params) pair from a previous call (headline_benchmark builds each
-    precision once — a 1B init+quantize+transfer is not free)."""
+    """One (precision, quant_mode, batch, kv_backend) point: best-of-`repeats`
+    decode tok/s with TTFT and bandwidth-utilization accounting. ``built``
+    reuses a (cfg, params) pair from a previous call (headline_benchmark
+    builds each precision once — a 1B init+quantize+transfer is not free).
+    ``kv_backend="paged"`` runs the paged KV cache + page-table-walking Pallas
+    kernel (runtime/paged_generate.py, the HeadInfer-analog config of
+    BASELINE.json)."""
     preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
     precision = precision or os.environ.get("EDGEMESH_BENCH_PRECISION", "int8")
     if preset not in PRESETS:
@@ -103,14 +124,34 @@ def decode_benchmark(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size, jnp.int32
     )
     lengths = jnp.full((batch,), prompt_len, jnp.int32)
+    if kv_backend == "paged":
+        from edgemesh.runtime.paged_generate import generate_paged
+
+        run = generate_paged
+    elif kv_backend == "dense":
+        run = generate
+    else:
+        raise ValueError(f"unknown kv_backend {kv_backend!r}")
 
     # Warmup compiles prefill + decode loop; then take the best of `repeats`.
-    generate(cfg, params, tokens, lengths, sampling)
+    _progress(f"{precision}/{quant_mode}/{kv_backend} b{batch}: warmup compile")
+    run(cfg, params, tokens, lengths, sampling)
+    _progress("warmup done; timing")
     best_tps, best_ttft = 0.0, float("inf")
     for _ in range(repeats):
-        r = generate(cfg, params, tokens, lengths, sampling)
+        r = run(cfg, params, tokens, lengths, sampling)
         best_tps = max(best_tps, r.decode_tok_s)
         best_ttft = min(best_ttft, r.prefill_time_s)
+    # Pop (not get): a headline run hits this 7+ times and traces are large —
+    # capture exactly one representative decode (tracing.py's own contract).
+    profile_dir = os.environ.pop("EDGEMESH_BENCH_PROFILE", None)
+    if profile_dir:
+        from edgemesh.utils.tracing import capture_profile
+
+        with capture_profile(profile_dir):
+            run(cfg, params, tokens, lengths, sampling)
+        _progress(f"profile captured -> {profile_dir}")
+    _progress(f"{precision}/{quant_mode}/{kv_backend} b{batch}: {best_tps:.1f} tok/s")
 
     # Roofline: each decode step streams the full weight set from HBM once
     # (batch rides in the MXU's other operand dim), so steps/sec x
@@ -215,6 +256,13 @@ def headline_benchmark(
                                decode_steps=decode_steps, built=int8_built)
         for mode in ("w8a16", "w8a8", "w8a8_pallas")
     }
+    # Paged KV backend on the fastest dense mode so far (the HeadInfer-analog
+    # serving path; page-table-walking Pallas kernel on TPU).
+    dense_best = max(int8_runs, key=lambda m: int8_runs[m]["value"])
+    int8_runs[dense_best + "+paged"] = decode_benchmark(
+        preset, "int8", quant_mode=dense_best, batch=batch,
+        decode_steps=decode_steps, built=int8_built, kv_backend="paged",
+    )
     best_mode = max(int8_runs, key=lambda m: int8_runs[m]["value"])
     best = int8_runs[best_mode]
 
@@ -222,8 +270,11 @@ def headline_benchmark(
     for b in sweep_batches:
         if b == batch:
             continue
-        r = decode_benchmark(preset, "int8", quant_mode=best_mode, batch=b,
-                             decode_steps=decode_steps, repeats=2, built=int8_built)
+        r = decode_benchmark(
+            preset, "int8", quant_mode=best_mode.removesuffix("+paged"), batch=b,
+            decode_steps=decode_steps, repeats=2, built=int8_built,
+            kv_backend="paged" if best_mode.endswith("+paged") else "dense",
+        )
         sweep[f"int8_b{b}_tok_s"] = r["value"]
 
     out = dict(best)

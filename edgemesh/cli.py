@@ -79,9 +79,47 @@ def cmd_bench(cfg: EdgeMeshConfig, preset: str | None, precision: str | None) ->
     return 0
 
 
-def cmd_download(cfg: EdgeMeshConfig) -> int:
+def _materialize_from_hub_cache(src_root, model_id: str, dest) -> bool:
+    """Copy a checkpoint out of a local HF hub cache
+    (``models--org--name/snapshots/<rev>/``) into the flat save_pretrained
+    layout edgemesh ingests. The offline analog of the reference's
+    ``save_transformer_model`` (download.py:20-24): same end state, no
+    network. Returns True if a snapshot was found and materialized."""
+    import shutil
+    from pathlib import Path
+
+    src_root = Path(src_root)
+    cache_name = "models--" + model_id.replace("/", "--")
+    candidates = [src_root / cache_name, src_root / "hub" / cache_name]
+    snap_root = next((c / "snapshots" for c in candidates if (c / "snapshots").is_dir()), None)
+    if snap_root is None:
+        return False
+    snaps = sorted(snap_root.iterdir(), key=lambda p: p.stat().st_mtime)
+    if not snaps:
+        return False
+    snap = snaps[-1]  # most recent revision
+    dest = Path(dest)
+    dest.mkdir(parents=True, exist_ok=True)
+    for f in snap.iterdir():
+        # Skip hidden entries and subdirectories (e.g. Llama's original/
+        # consolidated-PT folder — not part of the save_pretrained layout).
+        if f.name.startswith(".") or not f.resolve().is_file():
+            continue
+        target = dest / f.name
+        if target.exists():
+            continue
+        # Hub caches store files as symlinks into blobs/ — resolve and copy
+        # so the materialized checkpoint is self-contained.
+        shutil.copyfile(f.resolve(), target)
+    return True
+
+
+def cmd_download(cfg: EdgeMeshConfig, src: str | None = None) -> int:
     """Offline analog of the reference's downloaders (download.py:20-47):
-    verifies each configured checkpoint directory is complete."""
+    verifies each configured checkpoint directory is complete, and with
+    ``--src <hub-cache-dir>`` first materializes missing checkpoints from a
+    local HF hub cache (model id taken from the agent's ``model.hub_id``, or
+    the checkpoint directory's basename)."""
     from pathlib import Path
 
     ok = True
@@ -91,15 +129,24 @@ def cmd_download(cfg: EdgeMeshConfig) -> int:
             print(f"{agent.role}: synthetic model (no checkpoint)")
             continue
         p = Path(path)
-        has_cfg = (p / "config.json").exists()
-        has_weights = any(p.glob("*.safetensors")) or (p / "pytorch_model.bin").exists()
-        status = "ok" if (has_cfg and has_weights) else "MISSING"
+
+        def complete(p=p):
+            return (p / "config.json").exists() and (
+                any(p.glob("*.safetensors")) or (p / "pytorch_model.bin").exists()
+            )
+
+        if not complete() and src:
+            hub_id = getattr(agent.model, "hub_id", "") or p.name
+            if _materialize_from_hub_cache(src, hub_id, p):
+                print(f"{agent.role}: materialized {hub_id} from {src}")
+        status = "ok" if complete() else "MISSING"
         ok &= status == "ok"
         print(f"{agent.role}: {path} [{status}]")
     if not ok:
         print(
             "note: this environment has no network egress; place HF checkpoints "
-            "locally (save_pretrained format) and point agents[].model.path at them."
+            "locally (save_pretrained format, or a hub cache via --src) and "
+            "point agents[].model.path at them."
         )
     return 0 if ok else 1
 
@@ -119,6 +166,10 @@ def main(argv: list[str] | None = None) -> int:
         choices=["bf16", "int8", "int8_w8a8", "int8_w8a8_pallas"],
         help="bench: numeric precision",
     )
+    top.add_argument(
+        "--src", type=str, default=None,
+        help="download: local HF hub cache to materialize checkpoints from",
+    )
     cmd_args, rest = top.parse_known_args(argv)
 
     parser = build_arg_parser()
@@ -133,7 +184,7 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_serve(cfg, cmd_args.port)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
-    return cmd_download(cfg)
+    return cmd_download(cfg, cmd_args.src)
 
 
 if __name__ == "__main__":

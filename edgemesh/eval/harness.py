@@ -203,9 +203,18 @@ def run_eval(
 
 
 def aggregate(rows: list[dict]) -> dict[str, float]:
+    """Mean of every metric column (the reference's np.mean block,
+    combiner_fp.py:465-474) plus p50/p95 latency percentiles for the
+    throughput columns — the BASELINE.json latency metric is p50 TTFT, which
+    a bare mean can't report."""
     report: dict[str, float] = {}
     for key in METRIC_KEYS:
         vals = [r[key] for r in rows if key in r and r[key] is not None]
         if vals:
             report[key] = float(np.mean(vals))
+    for key in ("tps", "ttft_s"):
+        vals = [r[key] for r in rows if key in r and r[key] is not None]
+        if vals:
+            report[f"{key}_p50"] = float(np.percentile(vals, 50))
+            report[f"{key}_p95"] = float(np.percentile(vals, 95))
     return report

@@ -134,12 +134,16 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
         )
     else:  # pragma: no cover
         raise ValueError(family)
-    if family != "llama" and hf.get("rope_scaling"):
+    rs = hf.get("rope_scaling") or {}
+    rs_type = rs.get("rope_type", rs.get("type", ""))
+    if family != "llama" and rs and rs_type not in ("default", "none", ""):
         # The neox/phi2 forward paths don't consume a scaling block; ignoring
-        # it would silently produce wrong logits for a long-context variant.
+        # a frequency-changing one would silently produce wrong logits for a
+        # long-context variant. No-op types (newer HF configs emit
+        # {"rope_type": "default"}) load fine.
         raise ValueError(
-            f"rope_scaling in {ckpt / 'config.json'} is not supported for the "
-            f"{family} family"
+            f"rope_scaling type {rs_type!r} in {ckpt / 'config.json'} is not "
+            f"supported for the {family} family"
         )
     kw.update(overrides)
     return config_for_family(family, **kw)

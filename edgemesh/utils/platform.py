@@ -34,6 +34,23 @@ def device_sync(x) -> None:
         np.asarray(jax.numpy.ravel(leaves[0])[:1])
 
 
+def tree_sync(tree) -> None:
+    """``device_sync`` for a whole pytree whose leaves may come from many
+    independent transfers (e.g. per-leaf ``device_put``): one jitted
+    reduction consumes every leaf, so its single-scalar readback can't
+    complete until all of them are resident. Syncing leaf-by-leaf instead
+    would pay one tunnel round-trip per leaf."""
+    import numpy as np
+
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return
+    total = jax.jit(
+        lambda xs: sum(jax.numpy.ravel(x)[0].astype(jax.numpy.float32) for x in xs)
+    )(leaves)
+    np.asarray(total)
+
+
 @functools.cache
 def on_tpu() -> bool:
     """True when the default JAX backend drives real TPU hardware (including

@@ -110,3 +110,48 @@ def test_cli_download_reports_synthetic(capsys):
 
     rc = main(["download"])
     assert rc == 0
+
+
+def test_cli_download_materializes_from_hub_cache(tmp_path, capsys):
+    """--src resolves a hub-cache snapshot (symlinked blobs and all) into the
+    flat save_pretrained layout the ingest expects — the offline analog of the
+    reference's save_transformer_model (download.py:20-24)."""
+    from edgemesh.cli import main
+
+    # Fake hub cache: blobs/ holds content, snapshots/<rev>/ symlinks into it.
+    cache = tmp_path / "hub_cache"
+    model = cache / "models--acme--tiny-lm"
+    blobs = model / "blobs"
+    snap = model / "snapshots" / "abc123"
+    blobs.mkdir(parents=True)
+    snap.mkdir(parents=True)
+    (blobs / "b1").write_text('{"model_type": "llama"}')
+    (blobs / "b2").write_bytes(b"\x00weights")
+    (snap / "config.json").symlink_to(blobs / "b1")
+    (snap / "model.safetensors").symlink_to(blobs / "b2")
+    # Snapshots can carry subdirectories (e.g. Llama's original/ PT folder);
+    # materialization must skip them, not crash.
+    (snap / "original").mkdir()
+    (snap / "original" / "consolidated.00.pth").write_bytes(b"x")
+
+    dest = tmp_path / "checkpoints" / "tiny-lm"
+    cfg_yaml = tmp_path / "cfg.yaml"
+    cfg_yaml.write_text(
+        f"""
+agents:
+  - role: qa
+    model:
+      path: {dest}
+      hub_id: acme/tiny-lm
+"""
+    )
+    rc = main(["download", "--src", str(cache), "--config", str(cfg_yaml)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "materialized acme/tiny-lm" in out and "[ok]" in out
+    assert (dest / "config.json").read_text() == '{"model_type": "llama"}'
+    assert not (dest / "config.json").is_symlink()  # self-contained copy
+    # Second run: already complete, verify-only.
+    rc = main(["download", "--src", str(cache), "--config", str(cfg_yaml)])
+    assert rc == 0
+    assert "[ok]" in capsys.readouterr().out
