@@ -55,7 +55,12 @@ REFINER_TEMPLATE = (
 
 @dataclass
 class Agent:
-    """One model bound to a role, a (sub)mesh, and sampling params."""
+    """One model bound to a role, a (sub)mesh, and sampling params.
+
+    With a ``draft_cfg``/``draft_params`` pair set, generation runs
+    speculative decoding (runtime/speculative.py): the draft proposes
+    ``spec_gamma`` tokens per round, the main model verifies them in one
+    chunk — same output distribution, fewer full-model steps."""
 
     role: str
     cfg: ModelConfig
@@ -64,6 +69,9 @@ class Agent:
     sampling: SamplingParams
     prompt_template: str = DEFAULT_QA_TEMPLATE
     mesh: Any = None
+    draft_cfg: ModelConfig | None = None
+    draft_params: Any = None
+    spec_gamma: int = 4
 
     def format_prompt(self, question: str, **extra) -> str:
         return self.prompt_template.format(question=question, **extra)
@@ -71,7 +79,12 @@ class Agent:
     def answer(self, question: str, prompt: str | None = None) -> dict[str, Any]:
         t_start = time.perf_counter()
         prompt = prompt if prompt is not None else self.format_prompt(question)
-        max_prompt = self.cfg.max_seq_len - self.sampling.max_new_tokens
+        max_ctx = self.cfg.max_seq_len
+        if self.draft_cfg is not None:
+            # Both caches hold the full sequence; budget against the smaller
+            # context, plus the speculative chunk's overshoot headroom.
+            max_ctx = min(max_ctx, self.draft_cfg.max_seq_len) - (self.spec_gamma + 1)
+        max_prompt = max_ctx - self.sampling.max_new_tokens
         if max_prompt < 1:
             raise ValueError(
                 f"max_new_tokens {self.sampling.max_new_tokens} leaves no room "
@@ -90,14 +103,20 @@ class Agent:
         padded = ids + [pad] * (bucket - len(ids))
         tokens = jnp.asarray([padded], dtype=jnp.int32)
         lengths = jnp.asarray([len(ids)], dtype=jnp.int32)
-        result = generate(
-            self.cfg,
-            self.params,
-            tokens,
-            lengths,
-            self.sampling,
-            eos_id=getattr(self.tokenizer, "eos_id", -1),
-        )
+        eos_id = getattr(self.tokenizer, "eos_id", -1)
+        if self.draft_cfg is not None:
+            from edgemesh.runtime.speculative import generate_speculative
+
+            result, _ = generate_speculative(
+                self.cfg, self.params, self.draft_cfg, self.draft_params,
+                tokens, lengths, self.sampling, gamma=self.spec_gamma,
+                eos_id=eos_id,
+            )
+        else:
+            result = generate(
+                self.cfg, self.params, tokens, lengths, self.sampling,
+                eos_id=eos_id,
+            )
         n = int(result.num_generated[0])
         text = self.tokenizer.decode(result.tokens[0][:n])
         return {
@@ -153,10 +172,9 @@ class Ensemble:
         }
 
 
-def build_agent(spec: AgentSpec, mesh=None) -> Agent:
-    """Materialize one agent: HF checkpoint if ``spec.model.path`` is set,
-    otherwise a synthetic random-init model with the byte tokenizer."""
-    ms: ModelSpec = spec.model
+def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig, Any, Any]:
+    """(cfg, params, tokenizer) for one ModelSpec: HF checkpoint if ``path``
+    is set, otherwise a synthetic random-init model with the byte tokenizer."""
     if ms.path:
         cfg, params = load_params(ms.path)
         tokenizer = load_tokenizer(ms.path)
@@ -184,9 +202,13 @@ def build_agent(spec: AgentSpec, mesh=None) -> Agent:
         # one that produced the already-persisted rows.
         from zlib import crc32
 
-        params = init_params(cfg, jax.random.PRNGKey(crc32(spec.role.encode()) % (2**31)))
+        params = init_params(cfg, jax.random.PRNGKey(crc32(role_seed.encode()) % (2**31)))
 
-    if ms.precision in ("int8", "int8_w8a8", "int8_w8a8_pallas"):
+    if ms.precision == "int4":
+        from edgemesh.ops.int4 import quantize_params_int4
+
+        params = quantize_params_int4(params)
+    elif ms.precision in ("int8", "int8_w8a8", "int8_w8a8_pallas"):
         params = quantize_params(params)
         # "int8" = weight-only (w8a16); the suffixed variants run activations
         # in int8 too — XLA dynamic quant or the fused Pallas kernel.
@@ -202,6 +224,24 @@ def build_agent(spec: AgentSpec, mesh=None) -> Agent:
             )
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
+    return cfg, params, tokenizer
+
+
+def build_agent(spec: AgentSpec, mesh=None) -> Agent:
+    """Materialize one agent (plus its speculative draft model when
+    ``spec.draft`` is set — same materialization path, shared tokenizer)."""
+    cfg, params, tokenizer = _materialize(spec.model, spec.role, mesh)
+    draft_cfg = draft_params = None
+    if spec.draft is not None:
+        draft_cfg, draft_params, _ = _materialize(
+            spec.draft, spec.role + "/draft", mesh
+        )
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"agent {spec.role!r}: draft vocab {draft_cfg.vocab_size} != "
+                f"model vocab {cfg.vocab_size}; speculative decoding needs a "
+                "shared tokenizer"
+            )
     # Custom template wins; "" (unset) resolves by role.
     default_template = REFINER_TEMPLATE if spec.role == REFINER_ROLE else DEFAULT_QA_TEMPLATE
     template = spec.prompt_template or default_template
@@ -213,6 +253,9 @@ def build_agent(spec: AgentSpec, mesh=None) -> Agent:
         sampling=spec.sampling,
         prompt_template=template,
         mesh=mesh,
+        draft_cfg=draft_cfg,
+        draft_params=draft_params,
+        spec_gamma=spec.spec_gamma,
     )
 
 

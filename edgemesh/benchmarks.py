@@ -66,7 +66,12 @@ def _progress(msg: str) -> None:
 
 
 def _tree_bytes(params) -> int:
-    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    def nbytes(x):
+        if "int4" in str(x.dtype):  # s4 packs two elements per byte in HBM
+            return x.size // 2
+        return x.size * x.dtype.itemsize
+
+    return sum(nbytes(x) for x in jax.tree.leaves(params))
 
 
 def _build(preset: str, precision: str, quant_mode: str):
@@ -82,6 +87,12 @@ def _build(preset: str, precision: str, quant_mode: str):
         params = quantize_params(params)
         params = jax.tree.map(lambda x: jax.device_put(x), params)
         cfg = cfg.replace(quant_mode=quant_mode)
+    elif precision == "int4":
+        from edgemesh.ops.int4 import quantize_params_int4
+
+        _progress("quantize_params_int4")
+        params = quantize_params_int4(params)
+        params = jax.tree.map(lambda x: jax.device_put(x), params)
     tree_sync(params)
     _progress("params resident on device")
     return cfg, params
@@ -277,6 +288,12 @@ def headline_benchmark(
         )
         sweep[f"int8_b{b}_tok_s"] = r["value"]
 
+    # Int4 (w4a16, grouped scales): half int8's weight bytes — the memory
+    # headline beyond the reference's 38% int8 cut (BASELINE.md Table 3).
+    del int8_built
+    int4 = decode_benchmark(preset, "int4", batch=batch, decode_steps=decode_steps,
+                            built=_build(preset, "int4", "w8a16"))
+
     out = dict(best)
     out["metric"] = f"decode_tok_s_llama3.2-1b_int8_b{batch}"
     out.update(
@@ -288,6 +305,8 @@ def headline_benchmark(
             if bf16["value"]
             else 0.0,
             **{f"int8_{m}_tok_s": r["value"] for m, r in int8_runs.items()},
+            "int4_w4a16_tok_s": int4["value"],
+            "int4_weight_gb": int4["weight_gb"],
             **sweep,
         }
     )

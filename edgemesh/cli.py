@@ -163,7 +163,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     top.add_argument(
         "--precision", type=str, default=None,
-        choices=["bf16", "int8", "int8_w8a8", "int8_w8a8_pallas"],
+        choices=["bf16", "int8", "int8_w8a8", "int8_w8a8_pallas", "int4"],
         help="bench: numeric precision",
     )
     top.add_argument(

@@ -100,6 +100,11 @@ class AgentSpec:
     # "" means "unset": the orchestrator resolves a role-appropriate default
     # (QA vs refiner). Any non-empty string is used verbatim.
     prompt_template: str = ""
+    # Optional draft model for speculative decoding (runtime/speculative.py):
+    # must share the main model's tokenizer/vocab. None = plain decode.
+    draft: ModelSpec | None = None
+    # Draft tokens proposed per verify chunk when ``draft`` is set.
+    spec_gamma: int = 4
 
 
 @dataclass
@@ -185,7 +190,8 @@ def _from_dict(cls, data: dict[str, Any]):
 
 
 _NESTED_FIELDS.update(
-    model=ModelSpec, sampling=SamplingParams, mesh=MeshSpec, eval=EvalSpec
+    model=ModelSpec, sampling=SamplingParams, mesh=MeshSpec, eval=EvalSpec,
+    draft=ModelSpec,
 )
 
 

@@ -214,18 +214,23 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
 
 
 def dense(p: Params, x: jnp.ndarray, quant_mode: str = "w8a16") -> jnp.ndarray:
-    """Linear layer; dispatches to the int8 path when the param leaf is
-    quantized (edgemesh/ops/int8.py stores {"kernel_q", "scales"}) and applies
-    the SmoothQuant activation division when a "smooth" leaf is present.
-    ``quant_mode`` (a trace-time constant from ModelConfig) selects between
-    the w8a16 epilogue-dequant matmul, the XLA w8a8 dynamic-quant matmul, and
-    the fused Pallas w8a8 kernel."""
+    """Linear layer; dispatches to the int8/int4 path when the param leaf is
+    quantized ({"kernel_q", "scales"} from ops/int8.py or ops/int4.py —
+    int4 kernels are recognized by dtype) and applies the SmoothQuant
+    activation division when a "smooth" leaf is present. ``quant_mode`` (a
+    trace-time constant from ModelConfig) selects between the w8a16
+    epilogue-dequant matmul, the XLA w8a8 dynamic-quant matmul, and the
+    fused Pallas w8a8 kernel; int4 is always weight-only (w4a16)."""
     if "kernel_q" in p:
         from edgemesh.ops import int8 as int8_ops
 
         if "smooth" in p:
             x = x / p["smooth"].astype(x.dtype)
-        if quant_mode == "w8a8":
+        if p["kernel_q"].dtype == jnp.int4:
+            from edgemesh.ops.int4 import int4_matmul
+
+            y = int4_matmul(x, p["kernel_q"], p["scales"])
+        elif quant_mode == "w8a8":
             y = int8_ops.int8_matmul_dynamic(x, p["kernel_q"], p["scales"])
         elif quant_mode == "w8a8_pallas":
             y = int8_ops.int8_matmul_fused(
@@ -512,3 +517,28 @@ def forward_decode(
         cfg, params, tokens[:, None], positions, cache, kv_valid, is_decode=True
     )
     return logits[:, 0], KVCache(new_cache.k, new_cache.v, cache.lengths + 1)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def forward_verify(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [b, s] chunk of already-chosen tokens per row
+    cache: KVCache,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Chunk-append decode: process ``s`` tokens per row in ONE forward,
+    writing their K/V at each row's current length and attending causally
+    within the chunk + over the cached prefix. The target-model verification
+    step of speculative decoding (runtime/speculative.py) — one MXU-friendly
+    [b*s] matmul instead of s sequential decode steps. Returns logits for
+    every chunk position [b, s, vocab] and the cache advanced by s (callers
+    rewind rejected suffixes by lowering ``lengths``; stale slots are
+    re-written by the next chunk and masked by kv_valid meanwhile)."""
+    b, s = tokens.shape
+    positions = cache.lengths[:, None] + jnp.arange(s)[None, :]  # [b, s]
+    max_seq = cache.k.shape[2]
+    kv_valid = jnp.arange(max_seq)[None, :] < (cache.lengths + s)[:, None]
+    logits, new_cache, _ = _forward(
+        cfg, params, tokens, positions, cache, kv_valid, is_decode=True
+    )
+    return logits, KVCache(new_cache.k, new_cache.v, cache.lengths + s)

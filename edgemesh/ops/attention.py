@@ -43,15 +43,19 @@ def write_prefill(cache: LayerKV, k: jnp.ndarray, v: jnp.ndarray) -> LayerKV:
 
 
 def write_decode(cache: LayerKV, k: jnp.ndarray, v: jnp.ndarray, lengths: jnp.ndarray) -> LayerKV:
-    """Scatter one new K/V row per batch element at its current length.
+    """Scatter ``s`` new K/V rows per batch element starting at its current
+    length — s=1 is the autoregressive step, s>1 the speculative-verify
+    chunk append (runtime/speculative.py).
 
-    k/v: [b, 1, kh, d]; lengths: [b] int32 (pre-increment write index).
+    k/v: [b, s, kh, d]; lengths: [b] int32 (pre-increment write offset; row
+    ``b`` writes slots ``lengths[b] .. lengths[b]+s-1``).
     """
-    batch = k.shape[0]
-    b_idx = jnp.arange(batch)
+    batch, s = k.shape[:2]
+    b_idx = jnp.arange(batch)[:, None]  # [b, 1]
+    pos = lengths[:, None] + jnp.arange(s)[None, :]  # [b, s]
     return LayerKV(
-        cache.k.at[b_idx, lengths].set(k[:, 0].astype(cache.k.dtype)),
-        cache.v.at[b_idx, lengths].set(v[:, 0].astype(cache.v.dtype)),
+        cache.k.at[b_idx, pos].set(k.astype(cache.k.dtype)),
+        cache.v.at[b_idx, pos].set(v.astype(cache.v.dtype)),
     )
 
 

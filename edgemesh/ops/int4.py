@@ -1,0 +1,112 @@
+"""Int4 weight-only quantization (w4a16) with group-wise scales.
+
+Beyond the reference's int8 (bitsandbytes ``load_in_8bit``,
+``Code/Quantised Models/models_quant_updated.py:30-38``): int4 halves the
+weight bytes AGAIN (vs int8) — decode is HBM-bandwidth-bound, so weight bytes
+are the throughput ceiling, and int4's ~4x memory cut vs fp16 more than
+doubles the reference's published ~38% (Table 3, 14.8→9.19 GB).
+
+Two scale granularities, selected by ``group_size``:
+- 0 (per-channel): one scale per output column — the dequant folds into the
+  matmul epilogue exactly like ops/int8.py's w8a16 path. Fastest; coarsest.
+- g>0 (grouped): one scale per (g-sized input slice, output column) — the
+  standard int4 quality remedy (GPTQ/AWQ-style grouping). The contraction is
+  segmented per group (einsum over a G axis) because a scale that varies
+  along the contraction dim cannot fold into the epilogue.
+
+Storage is JAX's native ``int4`` dtype (XLA s4) — no hand-rolled nibble
+packing; TPU HBM stores s4 packed. Weights quantize at load time via
+``quantize_params_int4``; ``models/transformer.dense`` dispatches on the
+kernel dtype, so int4 composes with every decode path (dense KV, paged,
+speculative, TP engine).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from edgemesh.ops.int8 import Params
+
+INT4_MAX = 7.0
+
+
+def quantize_weight_int4(
+    kernel: jnp.ndarray, group_size: int = 64
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int4 quantization of a [in, out] kernel.
+
+    Returns (int4 kernel [in, out], fp32 scales [G, out]) with
+    G = in/group_size (G=1 when group_size=0 → per-channel)."""
+    kf = kernel.astype(jnp.float32)
+    in_dim, out = kf.shape[-2], kf.shape[-1]
+    if kf.ndim != 2:
+        raise ValueError(f"int4 quantization expects a 2D kernel, got {kf.shape}")
+    if group_size <= 0:
+        groups = 1
+    else:
+        if in_dim % group_size:
+            raise ValueError(f"in_dim {in_dim} not divisible by group_size {group_size}")
+        groups = in_dim // group_size
+    kg = kf.reshape(groups, in_dim // groups, out)
+    absmax = jnp.max(jnp.abs(kg), axis=1, keepdims=True)  # [G, 1, out]
+    scales = jnp.maximum(absmax / INT4_MAX, 1e-8)
+    q = jnp.clip(jnp.round(kg / scales), -7, 7).astype(jnp.int4)
+    return q.reshape(in_dim, out), jnp.squeeze(scales, axis=1)
+
+
+def dequantize_weight_int4(
+    q: jnp.ndarray, scales: jnp.ndarray, dtype=jnp.bfloat16
+) -> jnp.ndarray:
+    in_dim, out = q.shape
+    groups = scales.shape[0]
+    qg = q.astype(jnp.float32).reshape(groups, in_dim // groups, out)
+    return (qg * scales[:, None, :]).reshape(in_dim, out).astype(dtype)
+
+
+def int4_matmul(x: jnp.ndarray, w_q: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
+    """w4a16: y = x @ dequant(w_q) without materializing the dequantized
+    weight in HBM. Per-channel (G=1) folds the scale into the epilogue;
+    grouped segments the contraction over G."""
+    in_dim, out = w_q.shape
+    groups = scales.shape[0]
+    if groups == 1:
+        y = jnp.matmul(x, w_q.astype(x.dtype), preferred_element_type=jnp.float32)
+        return (y * scales[0].astype(jnp.float32)).astype(x.dtype)
+    gs = in_dim // groups
+    *lead, _ = x.shape
+    xg = x.reshape(*lead, groups, gs)
+    wg = w_q.reshape(groups, gs, out).astype(x.dtype)
+    part = jnp.einsum(
+        "...gi,gio->...go", xg, wg, preferred_element_type=jnp.float32
+    )  # [..., G, out]
+    y = jnp.sum(part * scales.astype(jnp.float32), axis=-2)
+    return y.astype(x.dtype)
+
+
+def quantize_params_int4(params: Params, group_size: int = 64) -> Params:
+    """Walk the param pytree; replace every dense {kernel[, bias]} with
+    {kernel_q (int4), scales [G, out][, bias]}. Same nn.Linear boundary as
+    the int8 walk (embeddings/norms stay high-precision); dense() dispatches
+    on the kernel dtype. Layer-stacked [L, in, out] kernels quantize per
+    layer via vmap."""
+
+    def quant(kernel):
+        if kernel.ndim == 3:  # [L, in, out] scan-stacked
+            gs = group_size if kernel.shape[1] % max(group_size, 1) == 0 else 0
+            return jax.vmap(lambda k: quantize_weight_int4(k, gs))(kernel)
+        gs = group_size if kernel.shape[0] % max(group_size, 1) == 0 else 0
+        return quantize_weight_int4(kernel, gs)
+
+    def walk(node):
+        if isinstance(node, dict):
+            if "kernel" in node:
+                q, scales = quant(node["kernel"])
+                out: Params = {"kernel_q": q, "scales": scales}
+                if "bias" in node:
+                    out["bias"] = node["bias"]
+                return out
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)

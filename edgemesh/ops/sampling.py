@@ -93,20 +93,59 @@ def sample_token(
     half the round-1 decode step time.
     """
     logits = logits.astype(jnp.float32)
+    if params.do_sample and 0 < params.top_k < logits.shape[-1]:
+        # Candidate-set fast path: draw from the SAME (idx, probs) view that
+        # speculative decoding scores against (filtered_candidates), so the
+        # two can never drift apart.
+        idx, probs = filtered_candidates(logits, params, token_mask)
+        choice = jax.random.categorical(rng, jnp.log(jnp.maximum(probs, 1e-30)), axis=-1)
+        return jnp.take_along_axis(idx, choice[..., None], axis=-1)[..., 0]
     if params.repetition_penalty != 1.0 and token_mask is not None:
         logits = apply_repetition_penalty(logits, token_mask, params.repetition_penalty)
     if not params.do_sample:
         return jnp.argmax(logits, axis=-1)
     if params.temperature != 1.0:
         logits = logits / max(params.temperature, 1e-6)
-    k = params.top_k
-    if 0 < k < logits.shape[-1]:
-        vals, idx = jax.lax.top_k(logits, k)  # vals descending along -1
-        vals = _top_p_on_sorted(vals, params.top_p)
-        choice = jax.random.categorical(rng, vals, axis=-1)
-        return jnp.take_along_axis(idx, choice[..., None], axis=-1)[..., 0]
     logits = apply_top_p(logits, params.top_p)  # no top-k: vocab-wide nucleus
     return jax.random.categorical(rng, logits, axis=-1)
+
+
+def filtered_candidates(
+    logits: jnp.ndarray,  # [..., vocab]
+    params: SamplingParams,
+    token_mask: jnp.ndarray | None = None,  # [..., vocab] bool
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sparse candidate view of the post-filter sampling distribution:
+    ``(idx, probs)`` of shape [..., k], probs normalized over the kept
+    nucleus and zero outside it. ``sample_token`` draws from exactly this
+    distribution; speculative decoding (runtime/speculative.py) needs the
+    distribution itself — for acceptance ratios and residual resampling —
+    and the ≤k-sized support keeps all of that off the full vocab.
+
+    Greedy (``do_sample=False``) degenerates to k=1 with probability 1 on
+    the argmax, which makes speculative acceptance exact token equality.
+    Sampled mode requires ``top_k > 0`` (bounded support); the reference
+    always samples with top_k=50 (config_2.yaml:11-14).
+    """
+    logits = logits.astype(jnp.float32)
+    if params.repetition_penalty != 1.0 and token_mask is not None:
+        logits = apply_repetition_penalty(logits, token_mask, params.repetition_penalty)
+    if not params.do_sample:
+        idx = jnp.argmax(logits, axis=-1)[..., None]
+        return idx, jnp.ones_like(idx, jnp.float32)
+    if not 0 < params.top_k < logits.shape[-1]:
+        raise ValueError(
+            "filtered_candidates needs bounded support: set top_k in "
+            f"[1, vocab) (got {params.top_k})"
+        )
+    if params.temperature != 1.0:
+        logits = logits / max(params.temperature, 1e-6)
+    vals, idx = jax.lax.top_k(logits, params.top_k)
+    vals = _top_p_on_sorted(vals, params.top_p)
+    probs = jax.nn.softmax(vals, axis=-1)
+    probs = jnp.where(vals > NEG_INF / 2, probs, 0.0)
+    probs = probs / jnp.maximum(jnp.sum(probs, axis=-1, keepdims=True), 1e-30)
+    return idx, probs
 
 
 class TokenMaskState(NamedTuple):

@@ -151,13 +151,18 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
     if is_quantized(params):
         specs = quantized_pspecs(specs)
 
-    def walk(p_node, s_node):
+    def walk(p_node, s_node, key=None):
         if isinstance(p_node, dict):
             return {
-                k: walk(v, s_node.get(k) if isinstance(s_node, dict) else None)
+                k: walk(v, s_node.get(k) if isinstance(s_node, dict) else None, k)
                 for k, v in p_node.items()
             }
         spec = s_node if isinstance(s_node, P) else P()
+        if key == "scales" and getattr(p_node, "ndim", 0) == len(spec) + 1:
+            # Grouped int4 scales carry an extra G axis before the out dim
+            # ([L, G, out] vs int8's [L, out]); keep the out-dim sharding on
+            # the last axis and leave the group axis unsharded.
+            spec = P(*spec[:-1], None, spec[-1])
         return jax.device_put(p_node, NamedSharding(mesh, spec))
 
     return walk(params, specs)

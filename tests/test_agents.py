@@ -110,3 +110,34 @@ def test_real_agent_intervals_overlap_on_submeshes(devices):
     r = ensemble_overlap_benchmark(n_agents=2, questions=2)
     assert r["intervals_overlapped"] >= 1, r
     assert r["serial_s"] > 0 and r["concurrent_s"] > 0
+
+
+def test_agent_with_draft_runs_speculative():
+    """An AgentSpec with a draft model answers through speculative decoding;
+    greedy output must equal the same agent without a draft (exactness)."""
+    from edgemesh.agents.orchestrator import build_agent
+    from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
+
+    sampling = SamplingParams(max_new_tokens=12, do_sample=False, repetition_penalty=1.0)
+    plain = build_agent(AgentSpec(role="qa", model=ModelSpec(), sampling=sampling))
+    spec = build_agent(
+        AgentSpec(
+            role="qa", model=ModelSpec(), sampling=sampling,
+            draft=ModelSpec(num_layers=1, hidden_size=32), spec_gamma=3,
+        )
+    )
+    q = "where is the eiffel tower located"
+    assert spec.draft_cfg is not None
+    assert spec.answer(q)["answer"] == plain.answer(q)["answer"]
+
+
+def test_agent_draft_vocab_mismatch_rejected():
+    from edgemesh.agents.orchestrator import build_agent
+    from edgemesh.config import AgentSpec, ModelSpec
+
+    import pytest
+
+    with pytest.raises(ValueError, match="shared tokenizer"):
+        build_agent(
+            AgentSpec(role="qa", model=ModelSpec(), draft=ModelSpec(vocab_size=32))
+        )
