@@ -15,8 +15,9 @@ import sys
 
 
 def main() -> int:
-    from edgemesh.benchmarks import headline_benchmark
+    from edgemesh.benchmarks import headline_benchmark, start_stall_watchdog
 
+    start_stall_watchdog()
     result = headline_benchmark()
     print(json.dumps(result))
     return 0

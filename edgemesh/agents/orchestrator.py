@@ -76,9 +76,7 @@ class Agent:
     def format_prompt(self, question: str, **extra) -> str:
         return self.prompt_template.format(question=question, **extra)
 
-    def answer(self, question: str, prompt: str | None = None) -> dict[str, Any]:
-        t_start = time.perf_counter()
-        prompt = prompt if prompt is not None else self.format_prompt(question)
+    def _max_prompt(self) -> int:
         max_ctx = self.cfg.max_seq_len
         if self.draft_cfg is not None:
             # Both caches hold the full sequence; budget against the smaller
@@ -90,19 +88,47 @@ class Agent:
                 f"max_new_tokens {self.sampling.max_new_tokens} leaves no room "
                 f"for a prompt within max_seq_len {self.cfg.max_seq_len}"
             )
-        ids = self.tokenizer.encode(prompt, max_len=max_prompt)
-        # Pad the prompt up to a static bucket: jit specializes on shapes, so
-        # raw per-question lengths would compile a fresh prefill per unique
-        # length — unbounded compile-cache growth that OOMs a small host over
-        # a 1,000-sample sweep. Buckets bound it to a handful of programs.
+        return max_prompt
+
+    def answer(self, question: str, prompt: str | None = None) -> dict[str, Any]:
+        prompts = None if prompt is None else [prompt]
+        return self.answer_batch([question], prompts=prompts)[0]
+
+    def answer_batch(
+        self, questions: list[str], prompts: list[str] | None = None
+    ) -> list[dict[str, Any]]:
+        """Answer several questions in ONE batched generate — the decode
+        loop's weight reads amortize over the whole batch (decode is
+        HBM-bound, so n questions cost barely more than one). Row count pads
+        to a power-of-2 bucket and prompt length to the usual length bucket,
+        so jit compiles stay bounded at (log batch x log length) programs."""
+        t_start = time.perf_counter()
+        prompts = prompts if prompts is not None else [
+            self.format_prompt(q) for q in questions
+        ]
+        max_prompt = self._max_prompt()
+        ids_list = [self.tokenizer.encode(p, max_len=max_prompt) for p in prompts]
+        # Shared prompt-length bucket: jit specializes on shapes, so raw
+        # per-question lengths would compile a fresh prefill per unique
+        # length — unbounded compile-cache growth that OOMs a small host
+        # over a 1,000-sample sweep.
+        longest = max(len(ids) for ids in ids_list)
         bucket = 16
-        while bucket < len(ids) and bucket < max_prompt:
+        while bucket < longest and bucket < max_prompt:
             bucket *= 2
         bucket = min(bucket, max_prompt)
+        n = len(ids_list)
+        rows = 1
+        while rows < n:
+            rows *= 2
         pad = getattr(self.tokenizer, "pad_id", 0)
-        padded = ids + [pad] * (bucket - len(ids))
-        tokens = jnp.asarray([padded], dtype=jnp.int32)
-        lengths = jnp.asarray([len(ids)], dtype=jnp.int32)
+        padded = [ids + [pad] * (bucket - len(ids)) for ids in ids_list]
+        padded += [padded[-1]] * (rows - n)  # dummy rows fill the batch bucket
+        tokens = jnp.asarray(padded, dtype=jnp.int32)
+        lengths = jnp.asarray(
+            [len(ids) for ids in ids_list] + [len(ids_list[-1])] * (rows - n),
+            dtype=jnp.int32,
+        )
         eos_id = getattr(self.tokenizer, "eos_id", -1)
         if self.draft_cfg is not None:
             from edgemesh.runtime.speculative import generate_speculative
@@ -117,20 +143,28 @@ class Agent:
                 self.cfg, self.params, tokens, lengths, self.sampling,
                 eos_id=eos_id,
             )
-        n = int(result.num_generated[0])
-        text = self.tokenizer.decode(result.tokens[0][:n])
-        return {
-            "answer": text.strip(),
-            "role": self.role,
-            "tps": result.tokens_per_sec,
-            "ttft_s": result.prefill_time_s,
-            "confidence": float(result.confidence[0]),
-            # Wall-clock span of this agent's work — lets callers verify that
-            # ensemble agents actually overlapped (tests/benchmarks assert
-            # interval overlap / concurrent-vs-serial ratio).
-            "t_start": t_start,
-            "t_end": time.perf_counter(),
-        }
+        t_end = time.perf_counter()
+        out = []
+        for i in range(n):
+            n_tok = int(result.num_generated[i])
+            text = self.tokenizer.decode(result.tokens[i][:n_tok])
+            out.append(
+                {
+                    "answer": text.strip(),
+                    "role": self.role,
+                    # Whole-batch throughput; per-request share is tps/batch.
+                    "tps": result.tokens_per_sec,
+                    "batch_size": n,
+                    "ttft_s": result.prefill_time_s,
+                    "confidence": float(result.confidence[i]),
+                    # Wall-clock span of this agent's work — lets callers
+                    # verify ensemble agents actually overlapped (tests /
+                    # benchmarks assert interval overlap).
+                    "t_start": t_start,
+                    "t_end": t_end,
+                }
+            )
+        return out
 
 
 @dataclass
@@ -146,30 +180,49 @@ class Ensemble:
         self._pool = ThreadPoolExecutor(max_workers=max(1, len(self.qa_agents)))
 
     def answer(self, question: str) -> dict[str, Any]:
+        return self.answer_batch([question])[0]
+
+    def answer_batch(self, questions: list[str]) -> list[dict[str, Any]]:
+        """The reference's per-question block (combiner_fp.py:436-442) over a
+        whole request batch: QA agents run concurrently (disjoint submeshes)
+        AND each agent batches all questions into one generate."""
         futures = [
-            self._pool.submit(agent.answer, question) for agent in self.qa_agents
+            self._pool.submit(agent.answer_batch, questions)
+            for agent in self.qa_agents
         ]
-        drafts = [f.result() for f in futures]
+        per_agent = [f.result() for f in futures]  # [n_agents][n_questions]
+        by_question = list(zip(*per_agent))
 
         if self.refiner is None:
-            best = max(drafts, key=lambda d: d["confidence"])
-            return {**best, "drafts": drafts}
+            return [
+                {**max(drafts, key=lambda d: d["confidence"]), "drafts": list(drafts)}
+                for drafts in by_question
+            ]
 
-        candidates = "".join(
-            f"Answer {i + 1}: {d['answer']}\n" for i, d in enumerate(drafts)
-        )
-        prompt = self.refiner.prompt_template.format(
-            question=question, candidates=candidates
-        )
-        refined = self.refiner.answer(question, prompt=prompt)
-        tps_values = [d["tps"] for d in drafts] + [refined["tps"]]
-        return {
-            "answer": refined["answer"],
-            "confidence": refined["confidence"],
-            "tps": sum(tps_values) / len(tps_values),  # mean-of-models, try.py:317-326
-            "ttft_s": drafts[0]["ttft_s"],
-            "drafts": drafts,
-        }
+        prompts = []
+        for question, drafts in zip(questions, by_question):
+            candidates = "".join(
+                f"Answer {i + 1}: {d['answer']}\n" for i, d in enumerate(drafts)
+            )
+            prompts.append(
+                self.refiner.prompt_template.format(
+                    question=question, candidates=candidates
+                )
+            )
+        refined = self.refiner.answer_batch(questions, prompts=prompts)
+        out = []
+        for drafts, ref in zip(by_question, refined):
+            tps_values = [d["tps"] for d in drafts] + [ref["tps"]]
+            out.append(
+                {
+                    "answer": ref["answer"],
+                    "confidence": ref["confidence"],
+                    "tps": sum(tps_values) / len(tps_values),  # mean-of-models, try.py:317-326
+                    "ttft_s": drafts[0]["ttft_s"],
+                    "drafts": list(drafts),
+                }
+            )
+        return out
 
 
 def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig, Any, Any]:

@@ -56,13 +56,40 @@ PRESETS = {
 
 
 _T0 = time.perf_counter()
+LAST_PROGRESS = time.monotonic()
 
 
 def _progress(msg: str) -> None:
     """Stderr breadcrumbs so a hung run (e.g. an unresponsive TPU tunnel —
     observed mid-round-2: even trivial dispatches blocked forever) shows
     WHERE it stopped in the driver's captured tail."""
+    global LAST_PROGRESS
+    LAST_PROGRESS = time.monotonic()
     print(f"[bench +{time.perf_counter() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def start_stall_watchdog(timeout_s: float | None = None) -> None:
+    """Daemon thread that hard-exits (rc=3) if no benchmark stage completes
+    for ``timeout_s`` seconds. The axon TPU tunnel has been observed to
+    block forever on a single dispatch; without this a driver-run bench
+    hangs until an external kill with no diagnostic at all."""
+    import threading
+
+    timeout_s = timeout_s or float(os.environ.get("EDGEMESH_BENCH_STALL_TIMEOUT", "900"))
+
+    def watch():
+        while True:
+            time.sleep(30)
+            stalled = time.monotonic() - LAST_PROGRESS
+            if stalled > timeout_s:
+                print(
+                    f"[bench] STALLED: no stage progress for {stalled:.0f}s "
+                    "(device tunnel unresponsive?) — aborting",
+                    file=sys.stderr, flush=True,
+                )
+                os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
 
 
 def _tree_bytes(params) -> int:

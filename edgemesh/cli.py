@@ -60,12 +60,12 @@ def cmd_eval(cfg: EdgeMeshConfig) -> int:
     return 0
 
 
-def cmd_serve(cfg: EdgeMeshConfig, port: int) -> int:
+def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0) -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.serve import serve_rest
 
     ensemble = build_ensemble(cfg)
-    serve_rest(ensemble, port=port)
+    serve_rest(ensemble, port=port, batch=batch)
     return 0
 
 
@@ -158,6 +158,10 @@ def main(argv: list[str] | None = None) -> int:
     top.add_argument("command", choices=["eval", "serve", "bench", "download"])
     top.add_argument("--port", type=int, default=8000)
     top.add_argument(
+        "--batch", type=int, default=0,
+        help="serve: coalesce up to N concurrent requests into one decode",
+    )
+    top.add_argument(
         "--preset", type=str, default=None,
         help="bench: model preset (validated by the bench command)",
     )
@@ -181,7 +185,7 @@ def main(argv: list[str] | None = None) -> int:
     if cmd_args.command == "eval":
         return cmd_eval(cfg)
     if cmd_args.command == "serve":
-        return cmd_serve(cfg, cmd_args.port)
+        return cmd_serve(cfg, cmd_args.port, cmd_args.batch)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     return cmd_download(cfg, cmd_args.src)

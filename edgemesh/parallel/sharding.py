@@ -158,7 +158,7 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
                 for k, v in p_node.items()
             }
         spec = s_node if isinstance(s_node, P) else P()
-        if key == "scales" and getattr(p_node, "ndim", 0) == len(spec) + 1:
+        if key == "scales" and len(spec) >= 1 and getattr(p_node, "ndim", 0) == len(spec) + 1:
             # Grouped int4 scales carry an extra G axis before the out dim
             # ([L, G, out] vs int8's [L, out]); keep the out-dim sharding on
             # the last axis and leave the group axis unsharded.

@@ -1,0 +1,128 @@
+"""Dynamic request batching for the serving front door.
+
+The reference serves strictly one question at a time (each runner's loop,
+and the REST PoC, ``Code/gRPC/rest_api.py:9-15``). On TPU that wastes the
+decode loop's defining property: it is HBM-bandwidth-bound, so a batch of 8
+concurrent requests costs barely more wall time than 1 — the weight stream
+amortizes. ``DynamicBatcher`` converts concurrent REST requests into batched
+``answer_batch`` calls:
+
+- ``submit()`` enqueues a question and returns a Future.
+- A worker drains the queue: while the pending set is smaller than
+  ``max_batch`` it lingers up to ``max_wait_s`` (a fixed batch-formation
+  window — late arrivals inside the window join THIS batch) before
+  dispatching whatever is waiting. Under load, batches form naturally
+  (requests that arrive mid-dispatch wait for the next batch — classic
+  continuous-batching-lite without mid-flight joins, which a static-shape
+  decode loop cannot accept anyway).
+- Per-request order within a batch is preserved; errors fail only the
+  affected batch's futures, the worker survives.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable
+
+log = logging.getLogger("edgemesh.serve")
+
+
+class DynamicBatcher:
+    def __init__(
+        self,
+        answer_batch: Callable[[list[str]], list[dict[str, Any]]],
+        max_batch: int = 8,
+        max_wait_s: float = 0.02,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._answer_batch = answer_batch
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self._queue: deque[tuple[str, Future]] = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        # Stats for /metrics and tests.
+        self.requests = 0
+        self.batches = 0
+        self.largest_batch = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def submit(self, question: str) -> Future:
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._queue.append((question, fut))
+            self.requests += 1
+            self._cond.notify()
+        return fut
+
+    def answer(self, question: str) -> dict[str, Any]:
+        """Blocking drop-in for Ensemble.answer — what the REST handler calls."""
+        return self.submit(question).result()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._worker.join(timeout=5)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "requests": self.requests,
+            "batches": self.batches,
+            "largest_batch": self.largest_batch,
+            "mean_batch": round(self.requests / self.batches, 2) if self.batches else 0.0,
+        }
+
+    def _take_batch(self) -> list[tuple[str, Future]]:
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait()
+            if not self._queue:
+                return []
+            # Linger briefly for stragglers when under-filled; requests that
+            # arrive during the linger join THIS batch.
+            deadline = time.monotonic() + self.max_wait_s
+            while len(self._queue) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(timeout=remaining)
+            batch = [
+                self._queue.popleft()
+                for _ in range(min(self.max_batch, len(self._queue)))
+            ]
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                if self._closed:
+                    return
+                continue
+            questions = [q for q, _ in batch]
+            with self._cond:
+                self.batches += 1
+                self.largest_batch = max(self.largest_batch, len(batch))
+            try:
+                results = self._answer_batch(questions)
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"answer_batch returned {len(results)} results for "
+                        f"{len(batch)} questions"
+                    )
+                for (_, fut), res in zip(batch, results):
+                    fut.set_result(res)
+            except Exception as exc:  # fail this batch only; worker survives
+                log.exception("batched answer failed")
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)

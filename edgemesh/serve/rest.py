@@ -22,7 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 log = logging.getLogger("edgemesh.serve")
 
 
-def _make_handler(ensemble, supervisor=None):
+def _make_handler(ensemble, supervisor=None, batcher=None):
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict):
             body = json.dumps(payload).encode()
@@ -53,6 +53,8 @@ def _make_handler(ensemble, supervisor=None):
                 payload = {"phases": phase_report()}
                 if supervisor is not None:
                     payload["supervisor"] = supervisor.health()
+                if batcher is not None:
+                    payload["batcher"] = batcher.stats()
                 self._send(200, payload)
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
@@ -68,7 +70,13 @@ def _make_handler(ensemble, supervisor=None):
                 if not question:
                     self._send(400, {"error": "missing 'question' field"})
                     return
-                if supervisor is not None:
+                if batcher is not None:
+                    # Concurrent requests coalesce into one batched decode
+                    # (serve/batcher.py) — the ThreadingHTTPServer gives each
+                    # request its own thread, so under load the batcher sees
+                    # them simultaneously.
+                    result = batcher.answer(question)
+                elif supervisor is not None:
                     result = supervisor.call(question)
                 else:
                     result = ensemble.answer(question)
@@ -86,13 +94,24 @@ def _make_handler(ensemble, supervisor=None):
 
 
 def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = True,
-               supervisor=None):
+               supervisor=None, batch: int = 0, batch_wait_s: float = 0.02):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
     failure-tracked call path and /metrics exposes its health, giving the
-    gateway crash-recovery the reference's fabric never had."""
-    server = ThreadingHTTPServer((host, port), _make_handler(ensemble, supervisor))
+    gateway crash-recovery the reference's fabric never had. ``batch > 1``
+    adds a DynamicBatcher: concurrent /generate requests coalesce into one
+    batched decode (serve/batcher.py). With BOTH, each coalesced batch routes
+    through ``supervisor.call`` as one request (failure tracking and restarts
+    stay engaged) — the supervisor's handler must accept a list of questions
+    and return a list of results."""
+    batcher = None
+    if batch > 1:
+        from edgemesh.serve.batcher import DynamicBatcher
+
+        backend = ensemble.answer_batch if supervisor is None else supervisor.call
+        batcher = DynamicBatcher(backend, max_batch=batch, max_wait_s=batch_wait_s)
+    server = ThreadingHTTPServer((host, port), _make_handler(ensemble, supervisor, batcher))
     log.info("edgemesh REST gateway on %s:%d", host, port)
     if block:
         server.serve_forever()

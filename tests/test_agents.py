@@ -86,6 +86,9 @@ def test_ensemble_threadpool_overlaps_agents():
             return {"answer": "x", "role": "qa", "confidence": 0.5, "tps": 1.0,
                     "ttft_s": 0.0, "t_start": t0, "t_end": _time.perf_counter()}
 
+        def answer_batch(self, questions, prompts=None):
+            return [self.answer(q) for q in questions]
+
     from edgemesh.agents.orchestrator import Ensemble
 
     delay = 0.15

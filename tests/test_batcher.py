@@ -1,0 +1,165 @@
+"""Dynamic request batching (serve/batcher.py, Agent/Ensemble.answer_batch)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from edgemesh.agents.orchestrator import build_agent, build_ensemble
+from edgemesh.config import AgentSpec, EdgeMeshConfig, ModelSpec, SamplingParams
+from edgemesh.serve.batcher import DynamicBatcher
+
+GREEDY = SamplingParams(max_new_tokens=8, do_sample=False, repetition_penalty=1.0)
+
+
+def _agent():
+    return build_agent(AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY))
+
+
+def test_answer_batch_matches_single_answers():
+    # Greedy batched decode must produce exactly the per-question answers
+    # (padding rows/columns are masked, per-row state is independent).
+    agent = _agent()
+    qs = ["where is the eiffel tower", "who wrote hamlet", "what is jax"]
+    singles = [agent.answer(q)["answer"] for q in qs]
+    batched = [r["answer"] for r in agent.answer_batch(qs)]
+    assert batched == singles
+
+
+def test_ensemble_answer_batch_matches_single():
+    cfg = EdgeMeshConfig(
+        agents=[
+            AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY),
+            AgentSpec(role="qa2", model=ModelSpec(family="neox"), sampling=GREEDY),
+            AgentSpec(role="refiner", model=ModelSpec(), sampling=GREEDY),
+        ]
+    )
+    ens = build_ensemble(cfg, use_submeshes=False)
+    qs = ["where is the eiffel tower", "who wrote hamlet"]
+    singles = [ens.answer(q)["answer"] for q in qs]
+    batched = [r["answer"] for r in ens.answer_batch(qs)]
+    assert batched == singles
+
+
+def test_batcher_coalesces_concurrent_requests():
+    agent = _agent()
+    agent.answer("warmup")  # compile outside the timed window
+    batcher = DynamicBatcher(agent.answer_batch, max_batch=4, max_wait_s=0.25)
+    qs = [f"question number {i}" for i in range(4)]
+    results = {}
+
+    def call(q):
+        results[q] = batcher.answer(q)
+
+    threads = [threading.Thread(target=call, args=(q,)) for q in qs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    batcher.close()
+    assert len(results) == 4
+    for q in qs:
+        assert isinstance(results[q]["answer"], str)
+    stats = batcher.stats()
+    assert stats["requests"] == 4
+    assert stats["largest_batch"] >= 2, stats  # real coalescing happened
+    # Order-preservation: each future got ITS question's answer.
+    direct = {q: agent.answer(q)["answer"] for q in qs}
+    assert {q: r["answer"] for q, r in results.items()} == direct
+
+
+def test_batcher_error_fails_batch_but_worker_survives():
+    calls = []
+
+    def flaky(questions):
+        calls.append(list(questions))
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        return [{"answer": f"ok:{q}"} for q in questions]
+
+    batcher = DynamicBatcher(flaky, max_batch=2, max_wait_s=0.01)
+    with pytest.raises(RuntimeError, match="boom"):
+        batcher.answer("a")
+    assert batcher.answer("b")["answer"] == "ok:b"
+    batcher.close()
+
+
+def test_batcher_rejects_after_close():
+    batcher = DynamicBatcher(lambda qs: [{"answer": q} for q in qs], max_batch=2)
+    batcher.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        batcher.submit("x")
+
+
+def test_batcher_composes_with_supervisor():
+    """With both configured, each coalesced batch routes through
+    supervisor.call — failure tracking and restart stay engaged."""
+    from edgemesh.serve.rest import serve_rest
+    from edgemesh.serve.supervisor import Supervisor
+
+    state = {"fail_next": True}
+
+    def factory():
+        return object()
+
+    def handler(backend, questions):
+        assert isinstance(questions, list)
+        if state.pop("fail_next", False):
+            raise RuntimeError("backend down")
+        return [{"answer": f"ok:{q}"} for q in questions]
+
+    sup = Supervisor(factory, handler, max_consecutive_failures=1)
+    cfg = EdgeMeshConfig(agents=[AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY)])
+    ens = build_ensemble(cfg, use_submeshes=False)
+    server = serve_rest(ens, host="127.0.0.1", port=0, block=False,
+                        supervisor=sup, batch=4)
+    import json
+    import urllib.request
+
+    port = server.server_address[1]
+    try:
+        def post(q):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"question": q}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return urllib.request.urlopen(req, timeout=60)
+
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            post("a")
+        assert exc_info.value.code == 500
+        with post("b") as resp:
+            assert json.loads(resp.read())["answer"] == "ok:b"
+        health = sup.health()
+        assert health["total_failures"] == 1 and health["total_requests"] == 2
+        assert health["restarts"] == 1  # max_consecutive_failures=1 tripped it
+    finally:
+        server.shutdown()
+
+
+def test_rest_generate_through_batcher():
+    import json
+    import urllib.request
+
+    from edgemesh.serve.rest import serve_rest
+
+    cfg = EdgeMeshConfig(agents=[AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY)])
+    ens = build_ensemble(cfg, use_submeshes=False)
+    server = serve_rest(ens, host="127.0.0.1", port=0, block=False, batch=4)
+    port = server.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"question": "where is the eiffel tower"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            body = json.loads(resp.read())
+        assert isinstance(body["answer"], str)
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+            metrics = json.loads(resp.read())
+        assert metrics["batcher"]["requests"] == 1
+    finally:
+        server.shutdown()
