@@ -5,7 +5,9 @@ Subcommands:
 - ``eval``     — golden-dataset evaluation (the combiner/single-model runners)
 - ``serve``    — REST front door (rest_api.py parity)
 - ``bench``    — decode-throughput microbenchmark (prints one JSON line)
-- ``download`` — checkpoint inventory check (downloader parity, offline-gated)
+- ``download`` — checkpoint verify/materialize (downloader parity, offline)
+- ``train``    — finetuning loop over the QA corpus (beyond reference parity:
+                 its roadmap's "After Finetuning" rows were never started)
 """
 
 from __future__ import annotations
@@ -151,11 +153,19 @@ def cmd_download(cfg: EdgeMeshConfig, src: str | None = None) -> int:
     return 0 if ok else 1
 
 
+def cmd_train(cfg: EdgeMeshConfig) -> int:
+    from edgemesh.training import run_training
+
+    report = run_training(cfg)
+    print(json.dumps(report))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     _honor_platform_env()
     argv = sys.argv[1:] if argv is None else argv
     top = argparse.ArgumentParser(prog="edgemesh")
-    top.add_argument("command", choices=["eval", "serve", "bench", "download"])
+    top.add_argument("command", choices=["eval", "serve", "bench", "download", "train"])
     top.add_argument("--port", type=int, default=8000)
     top.add_argument(
         "--batch", type=int, default=0,
@@ -188,6 +198,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_serve(cfg, cmd_args.port, cmd_args.batch)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
+    if cmd_args.command == "train":
+        return cmd_train(cfg)
     return cmd_download(cfg, cmd_args.src)
 
 

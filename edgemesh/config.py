@@ -146,12 +146,35 @@ class EvalSpec:
 
 
 @dataclass
+class TrainSpec:
+    """Finetuning loop settings (edgemesh.training.run_training).
+
+    The reference never started finetuning (its roadmap's "After Finetuning"
+    rows are empty — SURVEY.md §7 out-of-scope note); edgemesh ships the
+    loop so the framework is complete on TPU terms: same model code, mesh
+    shardings from MeshSpec, optax adamw, rotating orbax checkpoints."""
+
+    steps: int = 100
+    batch_size: int = 8
+    seq_len: int = 128
+    lr: float = 1e-4
+    weight_decay: float = 0.01
+    # "" disables checkpointing; otherwise rotating step checkpoints land
+    # here and a rerun resumes from the latest.
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 50
+    log_every: int = 10
+    resume: bool = True
+
+
+@dataclass
 class EdgeMeshConfig:
     """Top-level run config."""
 
     agents: list[AgentSpec] = field(default_factory=list)
     mesh: MeshSpec = field(default_factory=MeshSpec)
     eval: EvalSpec = field(default_factory=EvalSpec)
+    train: TrainSpec = field(default_factory=TrainSpec)
     # Embedder for the cosine/bertscore metrics: "" = deterministic hashing
     # fallback; "synthetic" = pinned tiny model through the JAX stack;
     # a path = ingested HF checkpoint (MiniLM-analog). eval/embedder.py.
@@ -191,7 +214,7 @@ def _from_dict(cls, data: dict[str, Any]):
 
 _NESTED_FIELDS.update(
     model=ModelSpec, sampling=SamplingParams, mesh=MeshSpec, eval=EvalSpec,
-    draft=ModelSpec,
+    draft=ModelSpec, train=TrainSpec,
 )
 
 

@@ -10,6 +10,7 @@ reduction and the tp-axis activation collectives from the shardings alone).
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 from typing import Any, NamedTuple
 
@@ -22,6 +23,8 @@ from edgemesh.models.transformer import (
     _forward,
     init_kv_cache,
 )
+
+log = logging.getLogger("edgemesh.training")
 
 Params = dict[str, Any]
 
@@ -83,6 +86,118 @@ def make_optimizer(lr: float = 1e-4, weight_decay: float = 0.01) -> optax.Gradie
 
 def init_train_state(cfg: ModelConfig, params: Params, optimizer) -> TrainState:
     return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+
+def run_training(run_cfg) -> dict[str, Any]:
+    """Config-driven finetuning loop: ``edgemesh train`` (cli.py).
+
+    The model comes from ``agents[0].model`` (synthetic random-init or HF
+    checkpoint), the corpus from the Natural Questions CSV (each row becomes
+    one "Question/Answer" LM sequence through the agent's tokenizer), the
+    mesh from ``mesh:`` (dp x tp auto-sharded placement), checkpoints rotate
+    under ``train.checkpoint_dir`` and a rerun resumes from the latest.
+    Returns {first_loss, final_loss, steps_run, resumed_from}.
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from edgemesh.agents.orchestrator import _materialize
+    from edgemesh.config import AgentSpec
+    from edgemesh.eval.data import load_qa_csv, resolve_dataset_path
+    from edgemesh.parallel.mesh import build_mesh
+    from edgemesh.parallel.sharding import batch_sharding, shard_params
+    from edgemesh.utils.tracing import trace
+
+    ts = run_cfg.train
+    spec = run_cfg.agents[0] if run_cfg.agents else AgentSpec()
+    if spec.model.precision not in ("bf16", "fp16", "fp32"):
+        raise ValueError(
+            f"training needs a float precision, got {spec.model.precision!r} "
+            "(quantized weights are an inference-time transform)"
+        )
+    cfg, params, tokenizer = _materialize(spec.model, spec.role)
+    if ts.seq_len > cfg.max_seq_len:
+        raise ValueError(f"train.seq_len {ts.seq_len} > max_seq_len {cfg.max_seq_len}")
+
+    # Corpus: Q/A rows → fixed-length LM sequences.
+    samples = load_qa_csv(resolve_dataset_path(run_cfg.eval.dataset_path))
+    pad = getattr(tokenizer, "pad_id", 0)
+    rows, lens = [], []
+    for s in samples:
+        ids = tokenizer.encode(
+            f"Question: {s.question}\nAnswer: {s.answer}", max_len=ts.seq_len
+        )
+        rows.append(ids + [pad] * (ts.seq_len - len(ids)))
+        lens.append(len(ids))
+    rows_np = np.asarray(rows, np.int32)
+    lens_np = np.asarray(lens, np.int32)
+
+    mesh = None
+    ms = run_cfg.mesh
+    if ms.dp * ms.tp > 1:
+        mesh = build_mesh(dp=ms.dp, tp=ms.tp)
+    optimizer = make_optimizer(ts.lr, ts.weight_decay)
+    if mesh is not None:
+        params = shard_params(params, cfg, mesh)
+    state = init_train_state(cfg, params, optimizer)
+    if mesh is not None:
+
+        def place(x):
+            # optimizer.init's mu/nu inherit the params' shardings; fresh
+            # leaves (step counters) land on one device — on a sub-mesh that
+            # mixes device sets inside one jit ("incompatible devices").
+            # Replicate anything not already on THIS mesh.
+            s = getattr(x, "sharding", None)
+            if isinstance(s, NamedSharding) and s.mesh.devices.tolist() == mesh.devices.tolist():
+                return x
+            return jax.device_put(x, NamedSharding(mesh, P()))
+
+        state = jax.tree.map(place, state)
+    step_fn = make_train_step(cfg, optimizer)
+
+    mgr = resumed_from = None
+    if ts.checkpoint_dir:
+        from edgemesh.runtime.checkpoint import TrainCheckpointManager
+
+        mgr = TrainCheckpointManager(ts.checkpoint_dir)
+        restored = mgr.restore_latest(state) if ts.resume else None
+        if restored is not None:
+            state, resumed_from = restored
+            log.info("resumed from step %d", resumed_from)
+
+    first_loss = final_loss = None
+    start = min(int(state.step), ts.steps)  # resume at/past steps: no-op run
+    for step in range(start, ts.steps):
+        # Per-step seeded draw (not one sequential stream): a resumed run
+        # continues the batch sequence instead of replaying it from draw 0.
+        idx = np.random.default_rng((run_cfg.seed, step)).integers(
+            0, len(rows_np), ts.batch_size
+        )
+        tokens = jnp.asarray(rows_np[idx])
+        lengths = jnp.asarray(lens_np[idx])
+        if mesh is not None:
+            tokens = jax.device_put(tokens, batch_sharding(mesh))
+            lengths = jax.device_put(lengths, NamedSharding(mesh, P("dp")))
+        with trace("edgemesh/train_step"):
+            state, loss = step_fn(state, tokens, lengths)
+        # Keep loss on device in the hot loop — float() would force a
+        # host sync per step and defeat async dispatch.
+        if first_loss is None:
+            first_loss = loss
+        final_loss = loss
+        if (step + 1) % ts.log_every == 0 or step + 1 == ts.steps:
+            log.info("step %d/%d loss %.4f", step + 1, ts.steps, float(loss))
+        if mgr is not None and ((step + 1) % ts.checkpoint_every == 0 or step + 1 == ts.steps):
+            mgr.save(step + 1, state)
+    if mgr is not None:
+        mgr.close()
+    return {
+        "first_loss": None if first_loss is None else float(first_loss),
+        "final_loss": None if final_loss is None else float(final_loss),
+        "steps_run": ts.steps - start,
+        "resumed_from": resumed_from,
+    }
 
 
 def make_train_step(cfg: ModelConfig, optimizer):
