@@ -94,6 +94,56 @@ class Agent:
         prompts = None if prompt is None else [prompt]
         return self.answer_batch([question], prompts=prompts)[0]
 
+    def answer_stream(self, question: str, prompt: str | None = None, chunk: int = 16):
+        """Yield ``{"delta": str}`` increments as the answer decodes, then a
+        final ``{"answer": full_text, "done": True, ...}`` record. Text
+        deltas re-decode the cumulative token prefix each chunk so
+        multi-byte/multi-token characters split across a chunk boundary
+        never emit garbage halves.
+
+        Streams the PLAIN decode loop: a configured speculative draft model
+        is not used here (the speculative loop emits variable-size rounds;
+        chunked streaming of it is future work) — non-streamed answers keep
+        the acceleration."""
+        from edgemesh.runtime.stream import generate_stream
+
+        if self.draft_cfg is not None:
+            log.warning(
+                "agent %r: streaming uses the plain decode loop; the "
+                "speculative draft model only accelerates non-streamed answers",
+                self.role,
+            )
+        prompt = prompt if prompt is not None else self.format_prompt(question)
+        ids = self.tokenizer.encode(prompt, max_len=self._max_prompt())
+        bucket = 16
+        while bucket < len(ids) and bucket < self._max_prompt():
+            bucket *= 2
+        pad = getattr(self.tokenizer, "pad_id", 0)
+        tokens = jnp.asarray([ids + [pad] * (min(bucket, self._max_prompt()) - len(ids))], jnp.int32)
+        lengths = jnp.asarray([len(ids)], jnp.int32)
+        all_ids: list[int] = []
+        text = ""
+        t_start = time.perf_counter()
+        for seg in generate_stream(
+            self.cfg, self.params, tokens, lengths, self.sampling,
+            eos_id=getattr(self.tokenizer, "eos_id", -1), chunk=chunk,
+        ):
+            n = int(seg.counts[0])
+            all_ids.extend(int(t) for t in seg.tokens[0][:n])
+            new_text = self.tokenizer.decode(jnp.asarray(all_ids, jnp.int32))
+            delta, text = new_text[len(text):], new_text
+            if delta:
+                yield {"delta": delta}
+        wall = time.perf_counter() - t_start
+        yield {
+            "answer": text.strip(),
+            "role": self.role,
+            "done": True,
+            "tps": len(all_ids) / wall if wall > 0 else 0.0,
+            "t_start": t_start,
+            "t_end": time.perf_counter(),
+        }
+
     def answer_batch(
         self, questions: list[str], prompts: list[str] | None = None
     ) -> list[dict[str, Any]]:
@@ -182,6 +232,47 @@ class Ensemble:
     def answer(self, question: str) -> dict[str, Any]:
         return self.answer_batch([question])[0]
 
+    def _refiner_prompt(self, question: str, drafts) -> str:
+        candidates = "".join(
+            f"Answer {i + 1}: {d['answer']}\n" for i, d in enumerate(drafts)
+        )
+        return self.refiner.prompt_template.format(
+            question=question, candidates=candidates
+        )
+
+    def answer_stream(self, question: str, chunk: int = 16):
+        """Stream the user-visible final answer, matching ``answer``'s
+        selection semantics: with a refiner, QA drafts complete first (they
+        feed the refiner's prompt, so they cannot stream) and the refiner's
+        generation streams chunk by chunk; with exactly one QA agent it
+        streams directly; with several QA agents and no refiner the
+        max-confidence draft is only known after all finish, so the result
+        arrives as a single ``done`` event."""
+        if self.refiner is None:
+            if len(self.qa_agents) == 1:
+                final = None
+                for item in self.qa_agents[0].answer_stream(question, chunk=chunk):
+                    if item.get("done"):
+                        final = item
+                    else:
+                        yield item
+                yield {**final, "drafts": [final]}
+                return
+            yield {**self.answer(question), "done": True}
+            return
+        drafts = self.answer_drafts(question)
+        prompt = self._refiner_prompt(question, drafts)
+        for item in self.refiner.answer_stream(question, prompt=prompt, chunk=chunk):
+            if item.get("done"):
+                item = {**item, "drafts": drafts}
+            yield item
+
+    def answer_drafts(self, question: str) -> list[dict[str, Any]]:
+        futures = [
+            self._pool.submit(agent.answer, question) for agent in self.qa_agents
+        ]
+        return [f.result() for f in futures]
+
     def answer_batch(self, questions: list[str]) -> list[dict[str, Any]]:
         """The reference's per-question block (combiner_fp.py:436-442) over a
         whole request batch: QA agents run concurrently (disjoint submeshes)
@@ -199,16 +290,10 @@ class Ensemble:
                 for drafts in by_question
             ]
 
-        prompts = []
-        for question, drafts in zip(questions, by_question):
-            candidates = "".join(
-                f"Answer {i + 1}: {d['answer']}\n" for i, d in enumerate(drafts)
-            )
-            prompts.append(
-                self.refiner.prompt_template.format(
-                    question=question, candidates=candidates
-                )
-            )
+        prompts = [
+            self._refiner_prompt(question, drafts)
+            for question, drafts in zip(questions, by_question)
+        ]
         refined = self.refiner.answer_batch(questions, prompts=prompts)
         out = []
         for drafts, ref in zip(by_question, refined):

@@ -78,11 +78,16 @@ def _decode_loop(
     token_mask: jax.Array,
     rng: jax.Array,
     decode_fn=None,  # static: (cfg, params, tokens[b], cache) -> (logits, cache)
-) -> tuple[jax.Array, jax.Array, KVCache, jax.Array]:
+    finished0: jax.Array | None = None,  # [b] rows already done (streaming)
+) -> tuple[jax.Array, jax.Array, KVCache, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Carries the last TOKEN (not logits): the model forward for output slot
     ``i`` runs at the top of iteration ``i``, so when the loop exits (EOS
     everywhere or budget reached) no trailing forward is wasted — the naive
-    sample-then-forward ordering burns one full transformer step per call."""
+    sample-then-forward ordering burns one full transformer step per call.
+
+    Returns (out, num_generated, cache, confidence, token_mask, prev_token,
+    finished) — the trailing three let ``generate_stream`` continue decoding
+    in a later segment exactly where this one stopped."""
     batch, vocab = first_logits.shape
     decode_fn = decode_fn or forward_decode
 
@@ -100,9 +105,12 @@ def _decode_loop(
     # Slot 0 comes straight from the prefill logits — no decode forward yet.
     rng, step_rng = jax.random.split(rng)
     out = jnp.full((batch, max_new), eos_id, jnp.int32)
+    finished_init = (
+        jnp.zeros((batch,), bool) if finished0 is None else finished0
+    )
     token0, out, finished, num_generated, token_mask, conf_sum = sample_and_record(
         first_logits, step_rng, out, 0,
-        jnp.zeros((batch,), bool), jnp.zeros((batch,), jnp.int32),
+        finished_init, jnp.zeros((batch,), jnp.int32),
         token_mask, jnp.zeros((batch,), jnp.float32),
     )
 
@@ -134,7 +142,10 @@ def _decode_loop(
     )
     final = jax.lax.while_loop(cond, body, init)
     confidence = final.conf_sum / jnp.maximum(final.num_generated, 1)
-    return final.out, final.num_generated, final.cache, confidence
+    return (
+        final.out, final.num_generated, final.cache, confidence,
+        final.token_mask, final.prev_token, final.finished,
+    )
 
 
 def generate(
@@ -205,7 +216,7 @@ def generate(
         TokenMaskState.init(batch, cfg.vocab_size).add_sequence(tokens, valid).mask
     )
     with trace("edgemesh/decode"):
-        out, num_generated, cache, confidence = _decode_loop(
+        out, num_generated, cache, confidence, _, _, _ = _decode_loop(
             cfg, params, sampling, max_new, int(eos_id), first_logits, cache,
             token_mask, rng, decode_fn,
         )

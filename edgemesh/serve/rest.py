@@ -9,6 +9,8 @@ kick-off. Stdlib ``http.server`` — zero extra dependencies, threaded.
 Endpoints:
 - ``GET  /``          → health + device inventory (the "edge cluster map")
 - ``POST /generate``  → {"question": str} → ensemble answer JSON
+- ``POST /generate_stream`` → Server-Sent Events: ``data: {"delta": ...}``
+  per decoded chunk, then ``data: {"answer": ..., "done": true}``
 """
 
 from __future__ import annotations
@@ -59,8 +61,45 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
+        def _stream(self, question: str):
+            """SSE: one `data:` line per streamed item (text/event-stream).
+
+            Owns ALL error handling past this point — once the 200 header is
+            out, do_POST's JSON _send(500) would corrupt the event stream.
+            Client disconnects stop the stream quietly (not a backend
+            failure); generation errors surface as a final ``error`` event
+            and count against the supervisor's failure budget."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+
+            def produce():
+                for item in ensemble.answer_stream(question):
+                    try:
+                        self.wfile.write(f"data: {json.dumps(item)}\n\n".encode())
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionError):
+                        log.info("stream client disconnected")
+                        return
+
+            try:
+                if supervisor is not None:
+                    supervisor.track(produce)
+                else:
+                    produce()
+            except Exception as exc:
+                log.exception("stream generation failed")
+                try:
+                    self.wfile.write(
+                        f"data: {json.dumps({'error': str(exc), 'done': True})}\n\n".encode()
+                    )
+                    self.wfile.flush()
+                except OSError:
+                    pass
+
         def do_POST(self):
-            if self.path != "/generate":
+            if self.path not in ("/generate", "/generate_stream"):
                 self._send(404, {"error": f"unknown path {self.path}"})
                 return
             try:
@@ -69,6 +108,9 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
                 question = payload.get("question")
                 if not question:
                     self._send(400, {"error": "missing 'question' field"})
+                    return
+                if self.path == "/generate_stream":
+                    self._stream(question)
                     return
                 if batcher is not None:
                     # Concurrent requests coalesce into one batched decode

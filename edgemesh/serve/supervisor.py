@@ -98,11 +98,17 @@ class Supervisor:
     def call(self, request: Any) -> Any:
         """Serve one request; raises the backend's exception to the caller
         after recording it (the HTTP layer turns it into a 5xx)."""
+        return self.track(lambda: self._handler(self.backend, request))
+
+    def track(self, fn: Callable[[], Any]) -> Any:
+        """Run one unit of serving work under the same failure tracking and
+        restart policy as ``call`` — for work that doesn't fit the
+        one-request handler shape (e.g. consuming a whole SSE stream)."""
         with self._lock:
             self.total_requests += 1
         t0 = time.perf_counter()
         try:
-            result = self._handler(self.backend, request)
+            result = fn()
         except Exception as exc:
             with self._lock:
                 self.total_failures += 1

@@ -1,0 +1,146 @@
+"""Streaming generation (runtime/stream.py, Agent/Ensemble.answer_stream, SSE)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edgemesh.agents.orchestrator import build_agent, build_ensemble
+from edgemesh.config import AgentSpec, EdgeMeshConfig, ModelSpec, SamplingParams
+from edgemesh.models.families import tiny_config
+from edgemesh.models.transformer import init_params
+from edgemesh.runtime import generate
+from edgemesh.runtime.stream import generate_stream
+
+GREEDY = SamplingParams(max_new_tokens=24, do_sample=False, repetition_penalty=1.0)
+
+
+def _model(vocab=64):
+    cfg = tiny_config("llama", vocab_size=vocab, max_seq_len=128)
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _collect(cfg, params, tokens, lengths, sampling, chunk, eos_id=-1):
+    toks = [[] for _ in range(tokens.shape[0])]
+    n_chunks = 0
+    for seg in generate_stream(cfg, params, tokens, lengths, sampling,
+                               chunk=chunk, eos_id=eos_id):
+        n_chunks += 1
+        for b in range(tokens.shape[0]):
+            toks[b].extend(int(t) for t in seg.tokens[b][: int(seg.counts[b])])
+    return toks, n_chunks
+
+
+@pytest.mark.parametrize("chunk", [5, 8, 24])
+def test_greedy_stream_matches_dense(chunk):
+    cfg, params = _model()
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size, jnp.int32)
+    lengths = jnp.full((2,), 8, jnp.int32)
+    ref = generate(cfg, params, tokens, lengths, GREEDY)
+    toks, n_chunks = _collect(cfg, params, tokens, lengths, GREEDY, chunk)
+    assert n_chunks == -(-GREEDY.max_new_tokens // chunk)
+    for b in range(2):
+        n = int(ref.num_generated[b])
+        assert toks[b] == [int(t) for t in ref.tokens[b][:n]]
+
+
+def test_stream_stops_at_eos():
+    cfg, params = _model()
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, cfg.vocab_size, jnp.int32)
+    lengths = jnp.full((2,), 8, jnp.int32)
+    eos = 5
+    ref = generate(cfg, params, tokens, lengths, GREEDY, eos_id=eos)
+    toks, n_chunks = _collect(cfg, params, tokens, lengths, GREEDY, chunk=4, eos_id=eos)
+    for b in range(2):
+        n = int(ref.num_generated[b])
+        assert toks[b] == [int(t) for t in ref.tokens[b][:n]]
+    # If every row finished early, fewer chunks than the full budget's worth.
+    if all(int(ref.num_generated[b]) < GREEDY.max_new_tokens for b in range(2)):
+        assert n_chunks <= -(-max(int(x) for x in ref.num_generated) // 4) + 1
+
+
+def test_agent_stream_deltas_concatenate_to_answer():
+    agent = build_agent(AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY))
+    q = "where is the eiffel tower"
+    items = list(agent.answer_stream(q, chunk=6))
+    assert items[-1]["done"] is True
+    deltas = "".join(i["delta"] for i in items[:-1])
+    assert deltas.strip() == items[-1]["answer"]
+    assert items[-1]["answer"] == agent.answer(q)["answer"]
+    assert len(items) >= 3  # actually streamed, not one blob
+
+
+def test_ensemble_stream_through_refiner():
+    cfg = EdgeMeshConfig(
+        agents=[
+            AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY),
+            AgentSpec(role="refiner", model=ModelSpec(), sampling=GREEDY),
+        ]
+    )
+    ens = build_ensemble(cfg, use_submeshes=False)
+    items = list(ens.answer_stream("who wrote hamlet", chunk=8))
+    final = items[-1]
+    assert final["done"] and "drafts" in final and len(final["drafts"]) == 1
+    assert final["answer"] == ens.answer("who wrote hamlet")["answer"]
+
+
+def test_ensemble_stream_multi_qa_no_refiner_matches_answer():
+    # Max-confidence selection can't stream; the result must still MATCH
+    # the non-streamed endpoint (one done event, same answer + drafts).
+    cfg = EdgeMeshConfig(
+        agents=[
+            AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY),
+            AgentSpec(role="qa2", model=ModelSpec(family="neox"), sampling=GREEDY),
+        ]
+    )
+    ens = build_ensemble(cfg, use_submeshes=False)
+    items = list(ens.answer_stream("who wrote hamlet"))
+    assert len(items) == 1 and items[0]["done"]
+    ref = ens.answer("who wrote hamlet")
+    assert items[0]["answer"] == ref["answer"]
+    assert len(items[0]["drafts"]) == 2
+
+
+def test_stream_failure_counts_against_supervisor():
+    from edgemesh.serve.supervisor import Supervisor
+
+    sup = Supervisor(lambda: object(), lambda b, r: r, max_consecutive_failures=2)
+
+    def boom():
+        raise RuntimeError("generation exploded")
+
+    with pytest.raises(RuntimeError):
+        sup.track(boom)
+    h = sup.health()
+    assert h["total_failures"] == 1 and h["consecutive_failures"] == 1
+    assert sup.track(lambda: "ok") == "ok"
+    assert sup.health()["consecutive_failures"] == 0
+
+
+def test_rest_sse_endpoint_streams():
+    import json
+    import urllib.request
+
+    from edgemesh.serve.rest import serve_rest
+
+    cfg = EdgeMeshConfig(agents=[AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY)])
+    ens = build_ensemble(cfg, use_submeshes=False)
+    server = serve_rest(ens, host="127.0.0.1", port=0, block=False)
+    port = server.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate_stream",
+            data=json.dumps({"question": "where is the eiffel tower"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            events = [
+                json.loads(line[len("data: "):])
+                for line in resp.read().decode().splitlines()
+                if line.startswith("data: ")
+            ]
+        assert events[-1]["done"] is True
+        assert "".join(e.get("delta", "") for e in events[:-1]).strip() == events[-1]["answer"]
+    finally:
+        server.shutdown()

@@ -53,16 +53,30 @@ def test_resume_at_or_past_target_is_noop(tmp_path):
     assert report["resumed_from"] == 12
 
 
-def test_resume_continues_batch_stream(tmp_path):
-    # The per-step seeded draw must give a resumed run the SAME batches an
-    # uninterrupted run would have seen for those steps.
+def test_resume_continues_batch_stream(tmp_path, monkeypatch):
+    # A resumed run must draw the CONTINUATION of the batch stream (seeds
+    # (seed, start..steps)), not replay draws 0..N. Record the seeds
+    # run_training actually feeds the generator.
     import numpy as np
 
-    seed = 0
-    draws_a = [np.random.default_rng((seed, s)).integers(0, 100, 4).tolist() for s in range(6, 12)]
-    draws_b = [np.random.default_rng((seed, s)).integers(0, 100, 4).tolist() for s in range(6, 12)]
-    assert draws_a == draws_b
-    assert draws_a[0] != np.random.default_rng((seed, 0)).integers(0, 100, 4).tolist()
+    seen: list = []
+    real = np.random.default_rng
+
+    def recording(seed=None):
+        if isinstance(seed, tuple):
+            seen.append(seed)
+        return real(seed)
+
+    monkeypatch.setattr(np.random, "default_rng", recording)
+    ckpt = str(tmp_path / "ck")
+    run_training(_cfg(checkpoint_dir=ckpt, checkpoint_every=6))  # steps 0..11
+    fresh = list(seen)
+    assert [s for _, s in fresh] == list(range(12))
+    seen.clear()
+    cfg2 = _cfg(checkpoint_dir=ckpt, checkpoint_every=6)
+    cfg2.train.steps = 18
+    run_training(cfg2)  # resumes at 12
+    assert [s for _, s in seen] == list(range(12, 18)), seen
 
 
 def test_sharded_training_on_mesh():
