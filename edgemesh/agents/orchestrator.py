@@ -90,6 +90,33 @@ class Agent:
             )
         return max_prompt
 
+    def _prepare_batch(self, prompts: list[str]):
+        """Tokenize + bucket a prompt batch: shared prompt-length bucket
+        (jit specializes on shapes — raw per-question lengths would compile
+        a fresh prefill per unique length, unbounded compile-cache growth
+        that OOMs a small host over a 1,000-sample sweep) and power-of-2 row
+        count with dummy fill rows. Returns (tokens, lengths, n_real)."""
+        max_prompt = self._max_prompt()
+        ids_list = [self.tokenizer.encode(p, max_len=max_prompt) for p in prompts]
+        longest = max(len(ids) for ids in ids_list)
+        bucket = 16
+        while bucket < longest and bucket < max_prompt:
+            bucket *= 2
+        bucket = min(bucket, max_prompt)
+        n = len(ids_list)
+        rows = 1
+        while rows < n:
+            rows *= 2
+        pad = getattr(self.tokenizer, "pad_id", 0)
+        padded = [ids + [pad] * (bucket - len(ids)) for ids in ids_list]
+        padded += [padded[-1]] * (rows - n)  # dummy rows fill the batch bucket
+        tokens = jnp.asarray(padded, dtype=jnp.int32)
+        lengths = jnp.asarray(
+            [len(ids) for ids in ids_list] + [len(ids_list[-1])] * (rows - n),
+            dtype=jnp.int32,
+        )
+        return tokens, lengths, n
+
     def answer(self, question: str, prompt: str | None = None) -> dict[str, Any]:
         prompts = None if prompt is None else [prompt]
         return self.answer_batch([question], prompts=prompts)[0]
@@ -114,13 +141,7 @@ class Agent:
                 self.role,
             )
         prompt = prompt if prompt is not None else self.format_prompt(question)
-        ids = self.tokenizer.encode(prompt, max_len=self._max_prompt())
-        bucket = 16
-        while bucket < len(ids) and bucket < self._max_prompt():
-            bucket *= 2
-        pad = getattr(self.tokenizer, "pad_id", 0)
-        tokens = jnp.asarray([ids + [pad] * (min(bucket, self._max_prompt()) - len(ids))], jnp.int32)
-        lengths = jnp.asarray([len(ids)], jnp.int32)
+        tokens, lengths, _ = self._prepare_batch([prompt])
         all_ids: list[int] = []
         text = ""
         t_start = time.perf_counter()
@@ -131,12 +152,24 @@ class Agent:
             n = int(seg.counts[0])
             all_ids.extend(int(t) for t in seg.tokens[0][:n])
             new_text = self.tokenizer.decode(jnp.asarray(all_ids, jnp.int32))
-            delta, text = new_text[len(text):], new_text
-            if delta:
-                yield {"delta": delta}
+            # Hold back trailing replacement chars (a multi-byte character
+            # split across the chunk boundary decodes as U+FFFD until its
+            # remaining bytes arrive) and anything after a prefix mismatch —
+            # only stream text that can no longer change.
+            stable_end = len(new_text)
+            while stable_end > 0 and new_text[stable_end - 1] == "�":
+                stable_end -= 1
+            stable = new_text[:stable_end]
+            if stable.startswith(text):
+                delta, text = stable[len(text):], stable
+                if delta:
+                    yield {"delta": delta}
+        final_text = self.tokenizer.decode(jnp.asarray(all_ids, jnp.int32))
+        if final_text.startswith(text) and final_text[len(text):]:
+            yield {"delta": final_text[len(text):]}
         wall = time.perf_counter() - t_start
         yield {
-            "answer": text.strip(),
+            "answer": final_text.strip(),
             "role": self.role,
             "done": True,
             "tps": len(all_ids) / wall if wall > 0 else 0.0,
@@ -156,29 +189,7 @@ class Agent:
         prompts = prompts if prompts is not None else [
             self.format_prompt(q) for q in questions
         ]
-        max_prompt = self._max_prompt()
-        ids_list = [self.tokenizer.encode(p, max_len=max_prompt) for p in prompts]
-        # Shared prompt-length bucket: jit specializes on shapes, so raw
-        # per-question lengths would compile a fresh prefill per unique
-        # length — unbounded compile-cache growth that OOMs a small host
-        # over a 1,000-sample sweep.
-        longest = max(len(ids) for ids in ids_list)
-        bucket = 16
-        while bucket < longest and bucket < max_prompt:
-            bucket *= 2
-        bucket = min(bucket, max_prompt)
-        n = len(ids_list)
-        rows = 1
-        while rows < n:
-            rows *= 2
-        pad = getattr(self.tokenizer, "pad_id", 0)
-        padded = [ids + [pad] * (bucket - len(ids)) for ids in ids_list]
-        padded += [padded[-1]] * (rows - n)  # dummy rows fill the batch bucket
-        tokens = jnp.asarray(padded, dtype=jnp.int32)
-        lengths = jnp.asarray(
-            [len(ids) for ids in ids_list] + [len(ids_list[-1])] * (rows - n),
-            dtype=jnp.int32,
-        )
+        tokens, lengths, n = self._prepare_batch(prompts)
         eos_id = getattr(self.tokenizer, "eos_id", -1)
         if self.draft_cfg is not None:
             from edgemesh.runtime.speculative import generate_speculative
@@ -327,6 +338,7 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
                 num_kv_heads=ms.num_kv_heads,
                 intermediate_size=ms.intermediate_size,
                 max_seq_len=ms.max_seq_len,
+                sliding_window=ms.sliding_window,
             ).items()
             if v is not None
         }

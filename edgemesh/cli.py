@@ -57,6 +57,8 @@ def cmd_eval(cfg: EdgeMeshConfig) -> int:
         resume=cfg.eval.resume,
         metrics=cfg.eval.metrics,
         embedder=build_embedder(cfg.embedder) if needs_embedder else None,
+        answer_batch_fn=ensemble.answer_batch,
+        batch_size=cfg.eval.batch_size,
     )
     print(json.dumps(report))
     return 0

@@ -82,6 +82,8 @@ class ModelSpec:
     num_kv_heads: int | None = None
     intermediate_size: int | None = None
     max_seq_len: int | None = None
+    # Sliding-window attention (Mistral); None = family/checkpoint default.
+    sliding_window: int | None = None
 
 
 @dataclass

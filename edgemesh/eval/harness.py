@@ -106,6 +106,8 @@ def run_eval(
     embedder=None,
     log_every: int = 25,
     metrics: list[str] | None = None,
+    answer_batch_fn=None,  # list[str] -> list[dict]; enables batch_size > 1
+    batch_size: int = 1,
 ) -> dict[str, float]:
     """Evaluate ``answer_fn`` over ``samples``; returns the aggregate-mean
     report (the analog of the reference's final np.mean block,
@@ -115,6 +117,12 @@ def run_eval(
     sample (a results.jsonl left over from a DIFFERENT dataset/run is
     re-answered, not silently merged), and the report aggregates exactly the
     rows of THIS sample list.
+
+    With ``answer_batch_fn`` and ``batch_size > 1``, pending samples are
+    answered ``batch_size`` at a time in one batched generate (decode is
+    HBM-bound, so the whole batch costs barely more than one sample);
+    scoring, persistence order, resume, and the zero-fill policy are
+    unchanged (a failed batch call zero-fills exactly its samples).
     """
     _validate_metrics(metrics)  # fail fast — not inside the zero-fill loop
     out_path = Path(output_jsonl)
@@ -151,28 +159,26 @@ def run_eval(
     t_start = time.perf_counter()
     rows: dict[int, dict] = {i: done[i] for i in reused}
     n_scored = len(rows)
+    use_batch = answer_batch_fn is not None and batch_size > 1
+
     with open(out_path, "a" if resume else "w") as sink:
-        for sample in samples:
-            if sample.index in reused:
-                continue
-            if sample.index in rescore:
-                row = dict(done[sample.index])
-                try:
-                    row.update(score_sample(row["answer"], sample.answer, embedder, metrics))
-                except Exception as exc:  # zero-fill policy: combiner_fp.py:448-454
-                    log.warning("rescore failed on sample %d: %s", sample.index, exc)
-                    row.update({m: 0.0 for m in (metrics or METRIC_KEYS) if m not in row})
-                    row["error"] = str(exc)
-                sink.write(json.dumps(row) + "\n")
-                sink.flush()
-                rows[sample.index] = row
-                n_scored += 1
-                continue
+
+        def emit(row: dict) -> None:
+            nonlocal n_scored
+            sink.write(json.dumps(row) + "\n")
+            sink.flush()
+            rows[row["index"]] = row
+            n_scored += 1
+            if (n_scored % log_every) == 0:
+                log.info("scored %d/%d", n_scored, len(samples))
+
+        def score_and_emit(sample: QASample, result: dict | None, error=None) -> None:
             row: dict[str, Any] = {"index": sample.index, "question": sample.question}
             try:
-                result = answer_fn(sample.question)
+                if error is not None:
+                    raise error
                 row["answer"] = result.get("answer", "")
-                for k in ("tps", "confidence", "ttft_s"):
+                for k in ("tps", "confidence", "ttft_s", "batch_size"):
                     if k in result:
                         row[k] = result[k]
                 row.update(
@@ -189,12 +195,53 @@ def run_eval(
                 row.update({k: 0.0 for k in (metrics or METRIC_KEYS)})
                 row.setdefault("answer", "")
                 row["error"] = str(exc)
-            sink.write(json.dumps(row) + "\n")
-            sink.flush()
-            rows[sample.index] = row
-            n_scored += 1
-            if (n_scored % log_every) == 0:
-                log.info("scored %d/%d", n_scored, len(samples))
+            emit(row)
+
+        pending: list[QASample] = []
+
+        def flush_pending() -> None:
+            if not pending:
+                return
+            batch = list(pending)
+            pending.clear()
+            try:
+                results = answer_batch_fn([s.question for s in batch])
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"answer_batch returned {len(results)} results "
+                        f"for {len(batch)} questions"
+                    )
+            except Exception as exc:  # zero-fill exactly this batch
+                for s in batch:
+                    score_and_emit(s, None, error=exc)
+                return
+            for s, result in zip(batch, results):
+                score_and_emit(s, result)
+
+        for sample in samples:
+            if sample.index in reused:
+                continue
+            if sample.index in rescore:
+                row = dict(done[sample.index])
+                try:
+                    row.update(score_sample(row["answer"], sample.answer, embedder, metrics))
+                except Exception as exc:  # zero-fill policy: combiner_fp.py:448-454
+                    log.warning("rescore failed on sample %d: %s", sample.index, exc)
+                    row.update({m: 0.0 for m in (metrics or METRIC_KEYS) if m not in row})
+                    row["error"] = str(exc)
+                emit(row)
+                continue
+            if use_batch:
+                pending.append(sample)
+                if len(pending) >= batch_size:
+                    flush_pending()
+                continue
+            try:
+                result = answer_fn(sample.question)
+                score_and_emit(sample, result)
+            except Exception as exc:
+                score_and_emit(sample, None, error=exc)
+        flush_pending()
 
     report = aggregate(list(rows.values()))
     report["wall_time_s"] = time.perf_counter() - t_start

@@ -55,12 +55,27 @@ FAMILY_PRESETS: dict[str, dict] = {
         lm_head_bias=True,
         tie_embeddings=False,
     ),
+    # Mistral: the llama dialect plus sliding-window attention (the 7B's
+    # window is 4096). BASELINE.json's HeadInfer-analog config names
+    # Mistral-7B; size/window fields come from the checkpoint.
+    "mistral": dict(
+        norm="rms",
+        activation="silu",
+        parallel_block=False,
+        shared_input_norm=False,
+        rotary_fraction=1.0,
+        qkv_bias=False,
+        out_bias=False,
+        lm_head_bias=False,
+        tie_embeddings=False,
+    ),
 }
 
 _HF_MODEL_TYPE_TO_FAMILY = {
     "llama": "llama",
     "gpt_neox": "neox",
     "phi": "phi2",
+    "mistral": "mistral",
 }
 
 

@@ -62,6 +62,34 @@ def _load_raw_tensors(ckpt: Path) -> dict[str, np.ndarray]:
     return out
 
 
+def _rope_scaling_kw(hf: dict, ckpt: Path) -> dict:
+    """Parse an HF rope_scaling block into ModelConfig fields (rope-consuming
+    families: llama, mistral). Llama-3.2 ships {"rope_type": "llama3",
+    factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings}; older checkpoints use
+    {"type": "linear", factor}."""
+    rs = hf.get("rope_scaling") or {}
+    if not rs:
+        return {}
+    rs_type = rs.get("rope_type", rs.get("type", "linear"))
+    if rs_type not in ("linear", "llama3", "default", "none", ""):
+        # Fail at ingest, not from inside the first jitted forward
+        # (ops/rope.py would raise there, far from the cause).
+        raise ValueError(
+            f"unsupported rope_scaling type {rs_type!r} in "
+            f"{ckpt / 'config.json'}; supported: linear, llama3"
+        )
+    return dict(
+        rope_scaling_type=rs_type,
+        rope_scaling_factor=float(rs.get("factor", 1.0)),
+        rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+        rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+        rope_original_max_position=int(
+            rs.get("original_max_position_embeddings", 8192)
+        ),
+    )
+
+
 def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
     """Build a ModelConfig from the checkpoint's HF config.json."""
     ckpt = Path(ckpt)
@@ -69,7 +97,8 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
     with open(ckpt / "config.json") as f:
         hf = json.load(f)
 
-    if family == "llama":
+    if family in ("llama", "mistral"):
+        # Mistral is the llama config dialect plus sliding-window attention.
         kw = dict(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -82,28 +111,10 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
             norm_eps=hf.get("rms_norm_eps", 1e-5),
             tie_embeddings=hf.get("tie_word_embeddings", False),
         )
-        rs = hf.get("rope_scaling") or {}
-        if rs:
-            # Llama-3.2 ships {"rope_type": "llama3", factor, low_freq_factor,
-            # high_freq_factor, original_max_position_embeddings}; older
-            # checkpoints use {"type": "linear", factor}.
-            rs_type = rs.get("rope_type", rs.get("type", "linear"))
-            if rs_type not in ("linear", "llama3", "default", "none", ""):
-                # Fail at ingest, not from inside the first jitted forward
-                # (ops/rope.py would raise there, far from the cause).
-                raise ValueError(
-                    f"unsupported rope_scaling type {rs_type!r} in "
-                    f"{ckpt / 'config.json'}; supported: linear, llama3"
-                )
-            kw.update(
-                rope_scaling_type=rs_type,
-                rope_scaling_factor=float(rs.get("factor", 1.0)),
-                rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
-                rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
-                rope_original_max_position=int(
-                    rs.get("original_max_position_embeddings", 8192)
-                ),
-            )
+        if family == "mistral":
+            # null in newer configs (full attention); 4096 on the 7B v0.1.
+            kw["sliding_window"] = int(hf.get("sliding_window") or 0)
+        kw.update(_rope_scaling_kw(hf, ckpt))
     elif family == "neox":
         kw = dict(
             vocab_size=hf["vocab_size"],
@@ -136,7 +147,7 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
         raise ValueError(family)
     rs = hf.get("rope_scaling") or {}
     rs_type = rs.get("rope_type", rs.get("type", ""))
-    if family != "llama" and rs and rs_type not in ("default", "none", ""):
+    if family not in ("llama", "mistral") and rs and rs_type not in ("default", "none", ""):
         # The neox/phi2 forward paths don't consume a scaling block; ignoring
         # a frequency-changing one would silently produce wrong logits for a
         # long-context variant. No-op types (newer HF configs emit
@@ -173,7 +184,7 @@ def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) ->
     dtype = dtype or cfg.activation_dtype
     raw = _load_raw_tensors(ckpt)
 
-    if family == "llama":
+    if family in ("llama", "mistral"):  # identical weight naming
         params = _map_llama(raw, cfg, dtype)
     elif family == "neox":
         params = _map_neox(raw, cfg, dtype)

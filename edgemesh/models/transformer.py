@@ -66,6 +66,10 @@ class ModelConfig:
     lm_head_bias: bool = False
     tie_embeddings: bool = False
     logit_soft_cap: float = 0.0
+    # Sliding-window attention (Mistral): each query sees at most the last
+    # ``sliding_window`` positions. 0 = full causal attention. Runs on the
+    # XLA attend path (_use_flash turns the prefill kernel off when set).
+    sliding_window: int = 0
 
     # Mixture of Experts (0 experts = dense MLP). The expert dim shards over
     # the mesh's "ep" axis; see ops/moe.py.
@@ -290,6 +294,10 @@ def _use_flash(cfg: ModelConfig) -> bool:
     (shard_map bodies, where pallas sees local arrays) opts in explicitly
     with attention_impl="flash".
     """
+    if cfg.sliding_window > 0:
+        # Windowed attention runs on the XLA path; the flash kernel has no
+        # window lower-bound yet.
+        return False
     if cfg.attention_impl == "xla":
         return False
     if cfg.attention_impl == "flash":
@@ -345,7 +353,7 @@ def _attention(
             interpret=cfg.attention_impl == "flash" and not on_tpu(),
         )
     else:
-        out = attend(q, cache, positions, kv_valid)
+        out = attend(q, cache, positions, kv_valid, sliding_window=cfg.sliding_window)
     return dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode), cache
 
 

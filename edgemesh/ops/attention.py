@@ -65,11 +65,13 @@ def attend(
     q_positions: jnp.ndarray,  # [b, s] int32 — absolute position of each query
     kv_valid: jnp.ndarray,  # [b, max_seq] bool — slots containing real tokens
     scale: float | None = None,
+    sliding_window: int = 0,
 ) -> jnp.ndarray:
     """Causal attention of queries against the full cache.
 
     Returns [b, s, num_heads, head_dim] in q's dtype. A cache slot j is visible
-    to query at position p iff it holds a real token and j <= p.
+    to query at position p iff it holds a real token and j <= p — and, with
+    ``sliding_window`` w > 0 (Mistral), additionally j > p - w.
     """
     b, s, num_heads, head_dim = q.shape
     kv_heads = cache.k.shape[2]
@@ -87,6 +89,8 @@ def attend(
     slot_pos = jnp.arange(max_seq)[None, None, :]  # [1, 1, m]
     causal = slot_pos <= q_positions[:, :, None]  # [b, s, m]
     mask = causal & kv_valid[:, None, :]  # [b, s, m]
+    if sliding_window > 0:
+        mask = mask & (slot_pos > q_positions[:, :, None] - sliding_window)
     scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
     weights = nn.softmax(scores, axis=-1).astype(cache.v.dtype)
     out = jnp.einsum(

@@ -177,6 +177,11 @@ def generate_paged(
     """generate() over the paged cache: delegates to runtime.generate.generate
     with the paged forwards plugged in, so validation, timing, and the
     throughput conventions live in exactly one place."""
+    if cfg.sliding_window > 0:
+        raise ValueError(
+            "paged attention does not implement sliding-window masking yet; "
+            "use the dense path (runtime.generate) for Mistral-style windows"
+        )
 
     def make_cache(cfg, batch, needed):
         per_row = (needed + page_size - 1) // page_size

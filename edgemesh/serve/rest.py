@@ -75,7 +75,16 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
             self.end_headers()
 
             def produce():
-                for item in ensemble.answer_stream(question):
+                # Stream from the supervisor's (restartable) backend when it
+                # can stream — after a restart this picks up the REBUILT
+                # ensemble, so restarts triggered by stream failures actually
+                # heal the stream path too.
+                source = ensemble
+                if supervisor is not None and hasattr(
+                    getattr(supervisor, "backend", None), "answer_stream"
+                ):
+                    source = supervisor.backend
+                for item in source.answer_stream(question):
                     try:
                         self.wfile.write(f"data: {json.dumps(item)}\n\n".encode())
                         self.wfile.flush()

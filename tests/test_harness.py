@@ -91,3 +91,66 @@ def test_load_reference_csv():
     )
     assert len(samples) == 5
     assert samples[0].question and samples[0].answer
+
+
+def test_batched_eval_matches_sequential(tmp_path):
+    """batch_size>1 answers through answer_batch_fn; rows, order, scores and
+    resume behavior are identical to the sequential path."""
+    from edgemesh.eval.data import QASample
+    from edgemesh.eval.harness import run_eval
+
+    samples = [QASample(i, f"q{i}", f"answer {i}") for i in range(7)]
+
+    def answer(q):
+        return {"answer": f"answer {q[1:]}", "tps": 1.0}
+
+    calls = []
+
+    def answer_batch(questions):
+        calls.append(len(questions))
+        return [answer(q) for q in questions]
+
+    seq = run_eval(samples, answer, output_jsonl=tmp_path / "a.jsonl", resume=False)
+    bat = run_eval(
+        samples, answer, output_jsonl=tmp_path / "b.jsonl", resume=False,
+        answer_batch_fn=answer_batch, batch_size=3,
+    )
+    assert calls == [3, 3, 1]  # 7 samples in batches of 3
+    for key in ("rouge1", "bleu", "num_samples"):
+        assert seq[key] == bat[key]
+    import json
+
+    rows = [json.loads(l) for l in open(tmp_path / "b.jsonl")]
+    assert [r["index"] for r in rows] == list(range(7))  # order preserved
+
+
+def test_batched_eval_zero_fills_failed_batch(tmp_path):
+    from edgemesh.eval.data import QASample
+    from edgemesh.eval.harness import run_eval
+
+    samples = [QASample(i, f"q{i}", "a") for i in range(4)]
+    calls = []
+
+    def answer_batch(questions):
+        calls.append(list(questions))
+        if len(calls) == 1:
+            raise RuntimeError("device fell over")
+        return [{"answer": "a"} for _ in questions]
+
+    report = run_eval(
+        samples, lambda q: {"answer": "a"}, output_jsonl=tmp_path / "r.jsonl",
+        resume=False, answer_batch_fn=answer_batch, batch_size=2,
+    )
+    assert report["num_samples"] == 4
+    import json
+
+    rows = [json.loads(l) for l in open(tmp_path / "r.jsonl")]
+    assert [("error" in r) for r in rows] == [True, True, False, False]
+    # Resume retries exactly the zero-filled rows.
+    calls.clear()
+    report2 = run_eval(
+        samples, lambda q: {"answer": "a"}, output_jsonl=tmp_path / "r.jsonl",
+        resume=True, answer_batch_fn=answer_batch, batch_size=2,
+    )
+    assert calls == [["q0", "q1"]]
+    assert report2["num_samples"] == 4

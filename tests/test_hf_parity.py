@@ -171,3 +171,45 @@ def test_phi2_head_dim_80_parity(tmp_path):
     cfg = config_from_checkpoint(tmp_path, dtype="float32")
     assert cfg.head_size == 80 and cfg.rotary_dim == 32
     _compare(tmp_path, model)
+
+
+def test_mistral_sliding_window_parity(tmp_path):
+    """Mistral = llama dialect + sliding-window attention. window < seq makes
+    the window mask load-bearing: full-causal attention would diverge."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    hf_cfg = MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, sliding_window=8,
+        attn_implementation="eager",  # sdpa ignores sliding_window in some versions
+    )
+    torch.manual_seed(7)
+    model = MistralForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path, dtype="float32")
+    assert cfg.sliding_window == 8
+    _compare(tmp_path, model, seq=24)  # 24 > window: windowed rows differ
+
+    # And the window must MATTER: the same checkpoint forced to full
+    # attention diverges from HF on the windowed rows.
+    cfg_full = config_from_checkpoint(
+        tmp_path, dtype="float32", max_seq_len=64, sliding_window=0
+    )
+    from edgemesh.models.hf_ingest import load_params as _lp
+
+    _, params = _lp(tmp_path, cfg_full)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg_full.vocab_size, size=(1, 24))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.float().numpy()
+    from edgemesh.models.transformer import _forward
+
+    cache = init_kv_cache(cfg_full, 1, 32)
+    positions = jnp.broadcast_to(jnp.arange(24)[None, :], (1, 24))
+    kv_valid = jnp.arange(32)[None, :] < 24
+    ours, _, _ = _forward(
+        cfg_full, params, jnp.asarray(tokens), positions, cache, kv_valid,
+        is_decode=False,
+    )
+    assert not np.allclose(np.asarray(ours[0, -1]), hf_logits[0, -1], atol=2e-3)

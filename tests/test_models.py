@@ -98,3 +98,49 @@ def test_generate_sampled_reproducible_with_seed():
     r1 = generate(cfg, params, tokens, lengths, sampling)
     r2 = generate(cfg, params, tokens, lengths, sampling)
     np.testing.assert_array_equal(np.asarray(r1.tokens), np.asarray(r2.tokens))
+
+
+def test_sliding_window_attend_masks_old_positions():
+    """attend with sliding_window w: slot j visible to query p iff
+    p-w < j <= p. Pinned against an explicit mask computation."""
+    import numpy as np
+
+    from edgemesh.ops.attention import LayerKV, attend
+
+    b, s, h, d, w = 1, 10, 2, 16, 4
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d), jnp.float32)
+    positions = jnp.arange(s)[None, :]
+    kv_valid = jnp.ones((b, s), bool)
+    out = attend(q, LayerKV(k, v), positions, kv_valid, sliding_window=w)
+
+    # Reference: full-window attend over the explicitly windowed slice.
+    for p in (5, 9):
+        lo = max(0, p - w + 1)
+        ref = attend(
+            q[:, p:p+1],
+            LayerKV(k[:, lo:p+1], v[:, lo:p+1]),
+            jnp.asarray([[p - lo]]),
+            jnp.ones((b, p + 1 - lo), bool),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, p]), np.asarray(ref[:, 0]), rtol=1e-5, atol=1e-5
+        )
+
+
+def test_mistral_family_generates():
+    from edgemesh.config import SamplingParams
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_params
+    from edgemesh.runtime import generate
+
+    cfg = tiny_config("mistral", vocab_size=64, sliding_window=6, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64, jnp.int32)
+    r = generate(
+        cfg, params, tokens, jnp.full((2,), 8, jnp.int32),
+        SamplingParams(max_new_tokens=12, do_sample=False, repetition_penalty=1.0),
+    )
+    assert int(r.num_generated.sum()) == 24

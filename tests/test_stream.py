@@ -70,6 +70,36 @@ def test_agent_stream_deltas_concatenate_to_answer():
     assert len(items) >= 3  # actually streamed, not one blob
 
 
+def test_stream_deltas_hold_multibyte_chars_at_chunk_boundary(monkeypatch):
+    """A UTF-8 char split across segments must not stream a U+FFFD half; the
+    delta is held back until the remaining bytes arrive."""
+    from types import SimpleNamespace
+
+    import edgemesh.runtime.stream as stream_mod
+
+    agent = build_agent(AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY))
+    ids = agent.tokenizer.encode("a€b")  # '€' is 3 bytes (+ a BOS id)
+    ids = [i for i in ids if i < 256]  # keep raw byte ids only
+    assert len(ids) == 5, ids
+    split = [ids[:2], ids[2:]]  # cut mid-'€'
+
+    def fake_stream(cfg, params, tokens, lengths, sampling, eos_id=-1, rng=None, chunk=16):
+        for part in split:
+            yield SimpleNamespace(
+                tokens=jnp.asarray([part + [0] * (8 - len(part))], jnp.int32),
+                counts=jnp.asarray([len(part)], jnp.int32),
+                finished=jnp.asarray([False]),
+                elapsed_s=0.0,
+            )
+
+    monkeypatch.setattr(stream_mod, "generate_stream", fake_stream)
+    items = list(agent.answer_stream("q"))
+    deltas = [i["delta"] for i in items if "delta" in i]
+    assert all("�" not in d for d in deltas), deltas
+    assert "".join(deltas) == "a€b"
+    assert items[-1]["answer"] == "a€b"
+
+
 def test_ensemble_stream_through_refiner():
     cfg = EdgeMeshConfig(
         agents=[
