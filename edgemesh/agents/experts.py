@@ -179,6 +179,39 @@ class ExpertRouter:
         return cached.answer(question)
 
 
+def router_from_config(
+    config, classifier: str = "keyword", embedder: Any | None = None
+) -> ExpertRouter:
+    """Build a router straight from an EdgeMeshConfig: each ``agents`` entry
+    becomes one expert with its ``role`` as the domain name
+    (examples/experts.yaml). Submeshes are assigned like the ensemble's —
+    disjoint per expert when the device count allows."""
+    from edgemesh.agents.orchestrator import build_agent
+    from edgemesh.parallel.mesh import submeshes
+
+    specs = config.agents
+    if not specs:
+        raise ValueError("router_from_config needs at least one agent entry")
+    roles = [s.role for s in specs]
+    dupes = {r for r in roles if roles.count(r) > 1}
+    if dupes:
+        raise ValueError(
+            f"duplicate expert domains {sorted(dupes)}: each agents[] entry's "
+            "role names one domain (an ensemble config with repeated 'qa' "
+            "roles is not an expert registry)"
+        )
+    meshes: list = [None] * len(specs)
+    if len(specs) > 1:
+        try:
+            meshes = submeshes(len(specs))
+        except ValueError:
+            pass  # fewer devices than experts: share
+    agents = {
+        s.role: build_agent(s, mesh=m) for s, m in zip(specs, meshes)
+    }
+    return build_expert_router(agents, classifier=classifier, embedder=embedder)
+
+
 def build_expert_router(
     specs_by_domain: dict[str, Any],
     classifier: str = "keyword",

@@ -160,8 +160,16 @@ class Agent:
             while stable_end > 0 and new_text[stable_end - 1] == "�":
                 stable_end -= 1
             stable = new_text[:stable_end]
-            if stable.startswith(text):
-                delta, text = stable[len(text):], stable
+            # Emit from the common prefix: normally stable extends text and
+            # this is the plain suffix; if a re-decode REWROTE earlier output
+            # (e.g. tokenizer cleanup joining across the boundary), emit the
+            # corrected tail and re-sync instead of wedging the stream.
+            cp = 0
+            limit = min(len(stable), len(text))
+            while cp < limit and stable[cp] == text[cp]:
+                cp += 1
+            if cp == len(text) or len(stable) > len(text):
+                delta, text = stable[cp:], stable
                 if delta:
                     yield {"delta": delta}
         final_text = self.tokenizer.decode(jnp.asarray(all_ids, jnp.int32))
@@ -205,6 +213,7 @@ class Agent:
                 eos_id=eos_id,
             )
         t_end = time.perf_counter()
+        wall = max(t_end - t_start, 1e-9)
         out = []
         for i in range(n):
             n_tok = int(result.num_generated[i])
@@ -213,8 +222,11 @@ class Agent:
                 {
                     "answer": text.strip(),
                     "role": self.role,
-                    # Whole-batch throughput; per-request share is tps/batch.
-                    "tps": result.tokens_per_sec,
+                    # THIS row's tokens over the batch wall time — the honest
+                    # per-request rate (sums to batch_tps across rows), so
+                    # batched and sequential eval reports stay comparable.
+                    "tps": n_tok / wall,
+                    "batch_tps": result.tokens_per_sec,
                     "batch_size": n,
                     "ttft_s": result.prefill_time_s,
                     "confidence": float(result.confidence[i]),

@@ -67,8 +67,9 @@ class ModelConfig:
     tie_embeddings: bool = False
     logit_soft_cap: float = 0.0
     # Sliding-window attention (Mistral): each query sees at most the last
-    # ``sliding_window`` positions. 0 = full causal attention. Runs on the
-    # XLA attend path (_use_flash turns the prefill kernel off when set).
+    # ``sliding_window`` positions. 0 = full causal attention. Both prefill
+    # paths honor it — XLA attend masks, the flash kernel additionally SKIPS
+    # kv blocks wholly outside the window (O(s*w) prefill).
     sliding_window: int = 0
 
     # Mixture of Experts (0 experts = dense MLP). The expert dim shards over
@@ -294,10 +295,6 @@ def _use_flash(cfg: ModelConfig) -> bool:
     (shard_map bodies, where pallas sees local arrays) opts in explicitly
     with attention_impl="flash".
     """
-    if cfg.sliding_window > 0:
-        # Windowed attention runs on the XLA path; the flash kernel has no
-        # window lower-bound yet.
-        return False
     if cfg.attention_impl == "xla":
         return False
     if cfg.attention_impl == "flash":
@@ -351,6 +348,7 @@ def _attention(
         out = flash_attention(
             q, k, v, kv_lens, causal=True,
             interpret=cfg.attention_impl == "flash" and not on_tpu(),
+            sliding_window=cfg.sliding_window,
         )
     else:
         out = attend(q, cache, positions, kv_valid, sliding_window=cfg.sliding_window)

@@ -67,6 +67,7 @@ def _flash_kernel(
     block_q: int,
     block_k: int,
     causal: bool,
+    window: int,
 ):
     bb = pl.program_id(0)
     i = pl.program_id(2)
@@ -87,6 +88,11 @@ def _flash_kernel(
         live = jnp.logical_and(
             block_start <= row_pos0 + block_q - 1, block_start < kvlen
         )
+        if window > 0:
+            # Sliding window: the earliest column any row of this q block can
+            # see is row_pos0 - window + 1; kv blocks entirely before it are
+            # dead — the skip is what makes long windowed prefill O(s*w).
+            live = jnp.logical_and(live, block_start + block_k > row_pos0 - window + 1)
     else:
         live = block_start < kvlen
 
@@ -105,6 +111,8 @@ def _flash_kernel(
             # Row r of the flattened (group, q) dim is query row r % block_q.
             qpos = row_pos0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % block_q
             mask = jnp.logical_and(mask, col <= qpos)
+            if window > 0:
+                mask = jnp.logical_and(mask, col > qpos - window)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]  # [groups*block_q, 1]
@@ -133,7 +141,10 @@ def _flash_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "causal", "block_q", "block_k", "interpret", "check"),
+    static_argnames=(
+        "scale", "causal", "block_q", "block_k", "interpret", "check",
+        "sliding_window",
+    ),
 )
 def flash_attention(
     q: jnp.ndarray,  # [b, s, num_heads, head_dim]
@@ -147,6 +158,7 @@ def flash_attention(
     block_k: int = 512,
     interpret: bool = False,
     check: bool = False,
+    sliding_window: int = 0,
 ) -> jnp.ndarray:
     """Causal flash attention; numerics match ops.attention.attend.
 
@@ -157,11 +169,17 @@ def flash_attention(
     position is ``kv_lens-1``, so its causal window IS the valid prefix).
     Returns [b, s, num_heads, head_dim] in q's dtype.
 
+    ``sliding_window`` w > 0 (Mistral; causal only) restricts each query to
+    its last w positions; kv blocks wholly outside the window are skipped,
+    so windowed prefill compute is O(s·w) instead of O(s²).
+
     ``check=True`` emits checkify contract asserts on kv_lens/q_offsets
     bounds and Q/K finiteness — run through ops.checks.checked (§5.2).
     """
     if not HAVE_PALLAS:  # pragma: no cover
         raise RuntimeError("pallas unavailable")
+    if sliding_window > 0 and not causal:
+        raise ValueError("sliding_window requires causal=True")
     b, s, nh, hd = q.shape
     skv, kh = k.shape[1], k.shape[2]
     groups = nh // kh
@@ -198,7 +216,7 @@ def flash_attention(
     grid = (b, kh, sp // block_q, mp // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, groups=groups, block_q=block_q,
-        block_k=block_k, causal=causal,
+        block_k=block_k, causal=causal, window=sliding_window,
     )
     out = pl.pallas_call(
         kernel,

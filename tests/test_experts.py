@@ -26,6 +26,9 @@ class FakeAgent:
         return {"answer": f"{self.domain}-answer", "role": "qa",
                 "confidence": 0.5, "tps": 1.0, "ttft_s": 0.0}
 
+    def answer_batch(self, questions, prompts=None):
+        return [self.answer(q) for q in questions]
+
 
 def _router(domains=("science", "sports", "general"), **kw):
     agents = {d: FakeAgent(d) for d in domains}
@@ -79,3 +82,19 @@ def test_unknown_classifier_rejected():
 def test_embedding_classifier_requires_embedder():
     with pytest.raises(ValueError, match="needs an embedder"):
         _router(classifier="embedding")
+
+
+def test_router_from_config_example_yaml():
+    """The shipped examples/experts.yaml builds a working router whose
+    documented usage snippet is true."""
+    from pathlib import Path
+
+    from edgemesh.agents.experts import router_from_config
+    from edgemesh.config import load_config
+
+    path = Path(__file__).resolve().parent.parent / "examples" / "experts.yaml"
+    router = router_from_config(load_config(path))
+    assert router.route("who won the world cup final").domain == "sports"
+    assert router.route("what is the chemical formula of water").domain == "science"
+    out = router.answer("who won the world cup final")
+    assert out["domain"] == "sports" and isinstance(out["answer"], str)

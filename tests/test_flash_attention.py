@@ -114,3 +114,50 @@ def test_full_model_prefill_flash_vs_xla():
         cfg.replace(attention_impl="xla"), params, tokens, lengths, cache)
     np.testing.assert_allclose(
         np.asarray(logits_flash), np.asarray(logits_xla), atol=1e-4, rtol=1e-4)
+
+
+def test_flash_sliding_window_matches_attend():
+    """Windowed flash (interpret) == windowed XLA attend, across window sizes
+    including ones smaller than / equal to / spanning the block size."""
+    from edgemesh.ops.attention import LayerKV, attend
+
+    b, s, nh, kh, hd = 2, 48, 4, 2, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, nh, hd), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, kh, hd), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, kh, hd), jnp.float32)
+    lens = jnp.asarray([s, s - 7], jnp.int32)
+    kv_valid = jnp.arange(s)[None, :] < lens[:, None]
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+
+    for w in (4, 16, 48):
+        ref = attend(q, LayerKV(k, v), positions, kv_valid, sliding_window=w)
+        out = flash_attention(
+            q, k, v, lens, causal=True, block_q=16, block_k=16,
+            interpret=True, sliding_window=w,
+        )
+        # Compare only real rows (flash computes padded rows too).
+        for bb in range(b):
+            n = int(lens[bb])
+            np.testing.assert_allclose(
+                np.asarray(out[bb, :n]), np.asarray(ref[bb, :n]),
+                rtol=2e-5, atol=2e-5, err_msg=f"window={w} row={bb}",
+            )
+
+
+def test_windowed_model_flash_matches_xla():
+    """A Mistral-style model forced onto the flash kernel must match its own
+    XLA attend path exactly (prefill logits)."""
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import forward_prefill, init_kv_cache, init_params
+
+    cfg_x = tiny_config("mistral", vocab_size=64, sliding_window=6,
+                        max_seq_len=64, attention_impl="xla")
+    cfg_f = cfg_x.replace(attention_impl="flash")
+    params = init_params(cfg_x, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 20), 0, 64, jnp.int32)
+    lengths = jnp.asarray([20, 13], jnp.int32)
+    cache_x = init_kv_cache(cfg_x, 2, 40)
+    cache_f = init_kv_cache(cfg_f, 2, 40)
+    lx, _ = forward_prefill(cfg_x, params, tokens, lengths, cache_x)
+    lf, _ = forward_prefill(cfg_f, params, tokens, lengths, cache_f)
+    np.testing.assert_allclose(np.asarray(lx), np.asarray(lf), rtol=2e-4, atol=2e-4)
