@@ -28,6 +28,7 @@ for seg in $(seq 1 10); do
     --config examples/ensemble_synthetic.yaml \
     --embedder synthetic \
     --eval.num_samples "$n" \
+    --eval.batch_size 8 \
     --eval.output_jsonl "$OUT" >> "$LOG" 2>&1
   rc=$?
   echo "segment $seg rc=$rc" >> "$LOG"

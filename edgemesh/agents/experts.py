@@ -28,10 +28,13 @@ on disjoint chips.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
 import numpy as np
+
+log = logging.getLogger("edgemesh.agents")
 
 # The 13 text domains of the reference's Expert Models sheet.
 DEFAULT_DOMAINS: tuple[str, ...] = (
@@ -205,7 +208,10 @@ def router_from_config(
         try:
             meshes = submeshes(len(specs))
         except ValueError:
-            pass  # fewer devices than experts: share
+            log.warning(
+                "not enough devices for %d expert submeshes; experts share "
+                "devices (throughput serializes)", len(specs),
+            )
     agents = {
         s.role: build_agent(s, mesh=m) for s, m in zip(specs, meshes)
     }

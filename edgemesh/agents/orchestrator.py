@@ -161,17 +161,22 @@ class Agent:
                 stable_end -= 1
             stable = new_text[:stable_end]
             # Emit from the common prefix: normally stable extends text and
-            # this is the plain suffix; if a re-decode REWROTE earlier output
-            # (e.g. tokenizer cleanup joining across the boundary), emit the
-            # corrected tail and re-sync instead of wedging the stream.
+            # this is the plain suffix. If a re-decode REWROTE earlier output
+            # (e.g. tokenizer cleanup joining across the boundary), emit a
+            # rewind marker with the corrected tail — aware clients drop the
+            # last ``rewind`` chars first; unaware ones show a small
+            # artifact and the final ``answer`` stays authoritative.
             cp = 0
             limit = min(len(stable), len(text))
             while cp < limit and stable[cp] == text[cp]:
                 cp += 1
             if cp == len(text) or len(stable) > len(text):
-                delta, text = stable[cp:], stable
-                if delta:
-                    yield {"delta": delta}
+                item = {"delta": stable[cp:]}
+                if cp < len(text):
+                    item["rewind"] = len(text) - cp
+                text = stable
+                if item["delta"] or "rewind" in item:
+                    yield item
         final_text = self.tokenizer.decode(jnp.asarray(all_ids, jnp.int32))
         if final_text.startswith(text) and final_text[len(text):]:
             yield {"delta": final_text[len(text):]}
@@ -223,8 +228,10 @@ class Agent:
                     "answer": text.strip(),
                     "role": self.role,
                     # THIS row's tokens over the batch wall time — the honest
-                    # per-request rate (sums to batch_tps across rows), so
-                    # batched and sequential eval reports stay comparable.
+                    # per-request rate, so batched and sequential eval
+                    # reports stay comparable. (batch_tps uses generate()'s
+                    # inner wall and counts dummy fill rows; the two are
+                    # different bases, not a sum identity.)
                     "tps": n_tok / wall,
                     "batch_tps": result.tokens_per_sec,
                     "batch_size": n,
@@ -327,6 +334,7 @@ class Ensemble:
                     "confidence": ref["confidence"],
                     "tps": sum(tps_values) / len(tps_values),  # mean-of-models, try.py:317-326
                     "ttft_s": drafts[0]["ttft_s"],
+                    "batch_size": ref.get("batch_size", 1),
                     "drafts": list(drafts),
                 }
             )

@@ -272,6 +272,59 @@ def ensemble_overlap_benchmark(n_agents: int = 2, questions: int = 3) -> dict[st
     }
 
 
+def speculative_benchmark(
+    preset: str | None = None,
+    batch: int = 1,
+    decode_steps: int = 128,
+    gamma: int = 4,
+    draft_layers_frac: float = 0.25,
+) -> dict[str, Any]:
+    """Speculative vs plain decode at batch 1 (the latency regime speculative
+    decoding targets). The draft is a depth-truncated random-init copy —
+    with RANDOM weights draft/target agreement is near-chance, so the
+    measured speedup is a LOWER bound and the acceptance rate is reported
+    for context (trained draft/target pairs accept far more). Enabled in the
+    headline via EDGEMESH_BENCH_SPEC=1."""
+    from edgemesh.runtime.speculative import generate_speculative
+
+    preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
+    cfg, params = _build(preset, "bf16", "w8a16")
+    d_layers = max(1, int(cfg.num_layers * draft_layers_frac))
+    d_cfg = cfg.replace(num_layers=d_layers)
+    d_params = init_params(d_cfg, jax.random.PRNGKey(7))
+    sampling = SamplingParams(
+        max_new_tokens=decode_steps, temperature=0.7, top_k=50, top_p=0.9,
+        repetition_penalty=1.2, do_sample=True,
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, 32), 0, cfg.vocab_size, jnp.int32
+    )
+    lengths = jnp.full((batch,), 32, jnp.int32)
+    _progress(f"spec b{batch} gamma={gamma}: warmup")
+    generate_speculative(cfg, params, d_cfg, d_params, tokens, lengths, sampling, gamma)
+    plain = generate(cfg, params, tokens, lengths, sampling)
+    best_spec, stats = 0.0, None
+    for _ in range(2):
+        r, s = generate_speculative(
+            cfg, params, d_cfg, d_params, tokens, lengths, sampling, gamma
+        )
+        if r.decode_tok_s > best_spec:
+            best_spec, stats = r.decode_tok_s, s
+    plain_best = plain.decode_tok_s
+    for _ in range(2):
+        plain_best = max(plain_best, generate(cfg, params, tokens, lengths, sampling).decode_tok_s)
+    _progress(f"spec {best_spec:.1f} vs plain {plain_best:.1f} tok/s, "
+              f"accept {stats.accept_rate:.2f}")
+    return {
+        "spec_tok_s": round(best_spec, 2),
+        "plain_tok_s": round(plain_best, 2),
+        "spec_speedup": round(best_spec / plain_best, 3) if plain_best else 0.0,
+        "accept_rate": round(stats.accept_rate, 3),
+        "gamma": gamma,
+        "draft_layers": d_layers,
+    }
+
+
 def headline_benchmark(
     preset: str | None = None,
     batch: int = 8,
@@ -321,6 +374,11 @@ def headline_benchmark(
     int4 = decode_benchmark(preset, "int4", batch=batch, decode_steps=decode_steps,
                             built=_build(preset, "int4", "w8a16"))
 
+    spec = {}
+    if os.environ.get("EDGEMESH_BENCH_SPEC") == "1":
+        spec = {f"spec_{k}" if not k.startswith("spec") else k: v
+                for k, v in speculative_benchmark(preset).items()}
+
     out = dict(best)
     out["metric"] = f"decode_tok_s_llama3.2-1b_int8_b{batch}"
     out.update(
@@ -335,6 +393,7 @@ def headline_benchmark(
             "int4_w4a16_tok_s": int4["value"],
             "int4_weight_gb": int4["weight_gb"],
             **sweep,
+            **spec,
         }
     )
     return out
