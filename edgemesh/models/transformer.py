@@ -67,9 +67,11 @@ class ModelConfig:
     tie_embeddings: bool = False
     logit_soft_cap: float = 0.0
     # Sliding-window attention (Mistral): each query sees at most the last
-    # ``sliding_window`` positions. 0 = full causal attention. Both prefill
-    # paths honor it — XLA attend masks, the flash kernel additionally SKIPS
-    # kv blocks wholly outside the window (O(s*w) prefill).
+    # ``sliding_window`` positions. 0 = full causal attention. Every
+    # attention path honors it — XLA attend masks; the flash and paged
+    # kernels additionally skip COMPUTE for blocks/pages wholly outside the
+    # window (O(s*w) prefill MXU work; paged-page DMAs still walk the whole
+    # table — the grid is static).
     sliding_window: int = 0
 
     # Mixture of Experts (0 experts = dense MLP). The expert dim shards over

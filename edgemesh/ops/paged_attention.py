@@ -45,6 +45,7 @@ def _paged_kernel(
     *,
     page_size: int,
     scale: float,
+    window: int,
 ):
     bb = pl.program_id(0)
     p = pl.program_id(2)
@@ -58,7 +59,15 @@ def _paged_kernel(
 
     kvlen = len_ref[bb]
 
-    @pl.when(p * page_size < kvlen)
+    live = p * page_size < kvlen
+    if window > 0:
+        # Sliding window: the decode query sits at kvlen-1 and sees cols
+        # [kvlen-window, kvlen-1]; pages wholly before the window are dead
+        # (their DMA still runs — the grid is static — but the MXU work and
+        # softmax update are skipped).
+        live = jnp.logical_and(live, (p + 1) * page_size > kvlen - window)
+
+    @pl.when(live)
     def _update():
         q = q_ref[0, 0]  # [gp, hd]
         k = k_ref[0, 0]  # [ps, hd]
@@ -68,6 +77,8 @@ def _paged_kernel(
         ) * scale  # [gp, ps]
         col = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = col < kvlen
+        if window > 0:
+            mask = jnp.logical_and(mask, col >= kvlen - window)
         s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -88,7 +99,9 @@ def _paged_kernel(
         o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret", "check"))
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret", "check", "sliding_window")
+)
 def paged_decode_attention(
     q: jnp.ndarray,  # [b, num_heads, head_dim] — one query token per row
     k_pages: jnp.ndarray,  # [kv_heads, total_pages, page_size, head_dim]
@@ -98,11 +111,14 @@ def paged_decode_attention(
     scale: float | None = None,
     interpret: bool = False,
     check: bool = False,
+    sliding_window: int = 0,
 ) -> jnp.ndarray:
     """Attention of one decode token per row over its paged KV prefix.
 
     Returns [b, num_heads, head_dim] in q's dtype. Unallocated table slots
     point at the trash page (physical 0); they are DMA'd but fully masked.
+    ``sliding_window`` w > 0 (Mistral) restricts the query to its last w
+    positions; pages wholly outside the window skip their compute.
 
     ``check=True`` emits checkify contract asserts (page-table entries inside
     the physical pool, kv_lens within table capacity, finite queries) — run
@@ -129,7 +145,9 @@ def paged_decode_attention(
         v_pages = jnp.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
 
     grid = (b, kh, max_pages)
-    kernel = functools.partial(_paged_kernel, page_size=ps, scale=scale)
+    kernel = functools.partial(
+        _paged_kernel, page_size=ps, scale=scale, window=sliding_window
+    )
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -168,6 +186,7 @@ def paged_decode_attention_xla(
     page_table: jnp.ndarray,
     kv_lens: jnp.ndarray,
     scale: float | None = None,
+    sliding_window: int = 0,
 ) -> jnp.ndarray:
     """XLA fallback / oracle: gather the dense view, then masked attention."""
     from edgemesh.ops.attention import LayerKV, attend
@@ -179,5 +198,8 @@ def paged_decode_attention_xla(
     max_seq = dense_k.shape[1]
     kv_valid = jnp.arange(max_seq)[None, :] < kv_lens[:, None]
     positions = (kv_lens - 1)[:, None]
-    out = attend(q[:, None], LayerKV(dense_k, dense_v), positions, kv_valid, scale)
+    out = attend(
+        q[:, None], LayerKV(dense_k, dense_v), positions, kv_valid, scale,
+        sliding_window=sliding_window,
+    )
     return out[:, 0]

@@ -71,9 +71,13 @@ def _paged_attention(
                 q[:, 0], k_pages, v_pages, table, kv_lens,
                 interpret=cfg.attention_impl == "flash"
                 and not on_tpu(),
+                sliding_window=cfg.sliding_window,
             )
         else:
-            out = paged_decode_attention_xla(q[:, 0], k_pages, v_pages, table, kv_lens)
+            out = paged_decode_attention_xla(
+                q[:, 0], k_pages, v_pages, table, kv_lens,
+                sliding_window=cfg.sliding_window,
+            )
         out = out[:, None]
     else:
         # Prefill: pages start empty, so the fresh k/v are the whole visible
@@ -90,10 +94,14 @@ def _paged_attention(
                 q, k, v, kv_lens, causal=True,
                 interpret=cfg.attention_impl == "flash"
                 and not on_tpu(),
+                sliding_window=cfg.sliding_window,
             )
         else:
             prompt_valid = jnp.arange(s)[None, :] < kv_lens[:, None]
-            out = attend(q, LayerKV(k, v), positions, prompt_valid)
+            out = attend(
+                q, LayerKV(k, v), positions, prompt_valid,
+                sliding_window=cfg.sliding_window,
+            )
     proj = dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode)
     return proj, (k_pages, v_pages, table, kv_lens)
 
@@ -176,12 +184,9 @@ def generate_paged(
 ) -> GenerateResult:
     """generate() over the paged cache: delegates to runtime.generate.generate
     with the paged forwards plugged in, so validation, timing, and the
-    throughput conventions live in exactly one place."""
-    if cfg.sliding_window > 0:
-        raise ValueError(
-            "paged attention does not implement sliding-window masking yet; "
-            "use the dense path (runtime.generate) for Mistral-style windows"
-        )
+    throughput conventions live in exactly one place. Sliding-window configs
+    (Mistral) work end-to-end: the page-table kernel masks and skips pages
+    outside each row's window."""
 
     def make_cache(cfg, batch, needed):
         per_row = (needed + page_size - 1) // page_size
