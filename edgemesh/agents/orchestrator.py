@@ -377,7 +377,7 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
     if ms.precision == "int4":
         from edgemesh.ops.int4 import quantize_params_int4
 
-        params = quantize_params_int4(params)
+        params = quantize_params_int4(params, group_size=ms.int4_group_size)
     elif ms.precision in ("int8", "int8_w8a8", "int8_w8a8_pallas"):
         params = quantize_params(params)
         # "int8" = weight-only (w8a16); the suffixed variants run activations
@@ -392,6 +392,10 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
                 lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
                 params,
             )
+    if ms.quantize_embed and ms.precision.startswith("int"):
+        from edgemesh.ops.int8 import quantize_embedding
+
+        params = quantize_embedding(params)
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
     return cfg, params, tokenizer

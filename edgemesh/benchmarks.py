@@ -93,12 +93,9 @@ def start_stall_watchdog(timeout_s: float | None = None) -> None:
 
 
 def _tree_bytes(params) -> int:
-    def nbytes(x):
-        if "int4" in str(x.dtype):  # s4 packs two elements per byte in HBM
-            return x.size // 2
-        return x.size * x.dtype.itemsize
-
-    return sum(nbytes(x) for x in jax.tree.leaves(params))
+    # int4 kernels are nibble-packed into int8 (ops/int4.py), so itemsize
+    # accounting is already honest for every dtype in the tree.
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
 
 
 def _build(preset: str, precision: str, quant_mode: str):
@@ -110,15 +107,24 @@ def _build(preset: str, precision: str, quant_mode: str):
         cfg = cfg.replace(dtype="bfloat16")
     params = init_params(cfg, jax.random.PRNGKey(0))
     if precision == "int8":
+        from edgemesh.ops.int8 import quantize_embedding
+
         _progress("quantize_params")
-        params = quantize_params(params)
+        params = quantize_embedding(quantize_params(params))
         params = jax.tree.map(lambda x: jax.device_put(x), params)
         cfg = cfg.replace(quant_mode=quant_mode)
-    elif precision == "int4":
+    elif precision in ("int4", "int4_g64"):
         from edgemesh.ops.int4 import quantize_params_int4
+        from edgemesh.ops.int8 import quantize_embedding
 
-        _progress("quantize_params_int4")
-        params = quantize_params_int4(params)
+        _progress(f"quantize_params_{precision}")
+        # "int4" = per-channel scales (fastest: fused unpack, one epilogue
+        # scale); "int4_g64" = 64-wide grouped scales — the product default
+        # (ModelSpec.int4_group_size), whose segmented contraction measures
+        # slower. The headline reports BOTH so the shipped configuration is
+        # never an unmeasured one.
+        g = 64 if precision == "int4_g64" else 0
+        params = quantize_embedding(quantize_params_int4(params, group_size=g))
         params = jax.tree.map(lambda x: jax.device_put(x), params)
     tree_sync(params)
     _progress("params resident on device")
@@ -368,11 +374,14 @@ def headline_benchmark(
         )
         sweep[f"int8_b{b}_tok_s"] = r["value"]
 
-    # Int4 (w4a16, grouped scales): half int8's weight bytes — the memory
-    # headline beyond the reference's 38% int8 cut (BASELINE.md Table 3).
+    # Int4 (w4a16): half int8's weight bytes — the memory headline beyond the
+    # reference's 38% int8 cut (BASELINE.md Table 3). Both scale
+    # granularities: per-channel (fastest) and the grouped product default.
     del int8_built
     int4 = decode_benchmark(preset, "int4", batch=batch, decode_steps=decode_steps,
                             built=_build(preset, "int4", "w8a16"))
+    int4_g = decode_benchmark(preset, "int4_g64", batch=batch, decode_steps=decode_steps,
+                              repeats=2, built=_build(preset, "int4_g64", "w8a16"))
 
     spec = {}
     if os.environ.get("EDGEMESH_BENCH_SPEC") == "1":
@@ -391,6 +400,7 @@ def headline_benchmark(
             else 0.0,
             **{f"int8_{m}_tok_s": r["value"] for m, r in int8_runs.items()},
             "int4_w4a16_tok_s": int4["value"],
+            "int4_g64_tok_s": int4_g["value"],
             "int4_weight_gb": int4["weight_gb"],
             **sweep,
             **spec,

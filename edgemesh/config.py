@@ -84,6 +84,15 @@ class ModelSpec:
     max_seq_len: int | None = None
     # Sliding-window attention (Mistral); None = family/checkpoint default.
     sliding_window: int | None = None
+    # Int4 scale granularity: 0 = per-channel (fastest), g>0 = grouped
+    # (GPTQ/AWQ-style quality remedy; must be even). See ops/int4.py.
+    int4_group_size: int = 64
+    # Quantize the token embedding to int8 alongside int8/int4 precisions
+    # (ops/int8.quantize_embedding). With tied embeddings the LM head reads
+    # the whole table every decode step, so this halves that stream; off by
+    # default nowhere that matters — set False to keep the reference's exact
+    # nn.Linear-only quantization boundary (try.py:205).
+    quantize_embed: bool = True
 
 
 @dataclass

@@ -220,24 +220,39 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
 # ---------------------------------------------------------------------------
 
 
+def embed_tokens(cfg: ModelConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token-embedding lookup, quantization-aware.
+
+    With an int8 embedding (ops/int8.quantize_embedding) the gather reads
+    int8 rows + one fp32 scale per row and dequantizes on the VPU — b·s rows
+    of traffic either way, but the table held in HBM at half size. The single
+    entry point for every forward path (single-chip scan, pipeline stages,
+    4D SPMD, paged decode)."""
+    embed = params["embed"]
+    if "weight_q" in embed:
+        rows = embed["weight_q"][tokens].astype(jnp.float32)
+        return (rows * embed["scales"][tokens][..., None]).astype(cfg.activation_dtype)
+    return embed["weight"][tokens].astype(cfg.activation_dtype)
+
+
 def dense(p: Params, x: jnp.ndarray, quant_mode: str = "w8a16") -> jnp.ndarray:
     """Linear layer; dispatches to the int8/int4 path when the param leaf is
-    quantized ({"kernel_q", "scales"} from ops/int8.py or ops/int4.py —
-    int4 kernels are recognized by dtype) and applies the SmoothQuant
-    activation division when a "smooth" leaf is present. ``quant_mode`` (a
-    trace-time constant from ModelConfig) selects between the w8a16
-    epilogue-dequant matmul, the XLA w8a8 dynamic-quant matmul, and the
-    fused Pallas w8a8 kernel; int4 is always weight-only (w4a16)."""
-    if "kernel_q" in p:
+    quantized ({"kernel_q", "scales"} from ops/int8.py; {"kernel_q4", …}
+    from ops/int4.py) and applies the SmoothQuant activation division when a
+    "smooth" leaf is present. ``quant_mode`` (a trace-time constant from
+    ModelConfig) selects between the w8a16 epilogue-dequant matmul, the XLA
+    w8a8 dynamic-quant matmul, and the fused Pallas w8a8 kernel; int4 is
+    always weight-only (w4a16)."""
+    if "kernel_q4" in p:
+        from edgemesh.ops.int4 import int4_matmul
+
+        y = int4_matmul(x, p["kernel_q4"], p["scales"])
+    elif "kernel_q" in p:
         from edgemesh.ops import int8 as int8_ops
 
         if "smooth" in p:
             x = x / p["smooth"].astype(x.dtype)
-        if p["kernel_q"].dtype == jnp.int4:
-            from edgemesh.ops.int4 import int4_matmul
-
-            y = int4_matmul(x, p["kernel_q"], p["scales"])
-        elif quant_mode == "w8a8":
+        if quant_mode == "w8a8":
             y = int8_ops.int8_matmul_dynamic(x, p["kernel_q"], p["scales"])
         elif quant_mode == "w8a8_pallas":
             y = int8_ops.int8_matmul_fused(
@@ -406,7 +421,18 @@ def lm_head_logits(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndar
     three at once."""
     x = _apply_norm(cfg, params["final_norm"], x)
     if cfg.tie_embeddings or "lm_head" not in params:
-        logits = x @ params["embed"]["weight"].T.astype(cfg.activation_dtype)
+        embed = params["embed"]
+        if "weight_q" in embed:
+            # Tied int8 head: w8a16 epilogue over the int8 rows — the dequant
+            # (per-vocab-row scale) folds into the matmul output, halving the
+            # head's HBM read vs the bf16 table.
+            y = jnp.matmul(
+                x, embed["weight_q"].T.astype(cfg.activation_dtype),
+                preferred_element_type=jnp.float32,
+            )
+            logits = (y * embed["scales"].astype(jnp.float32)).astype(cfg.activation_dtype)
+        else:
+            logits = x @ embed["weight"].T.astype(cfg.activation_dtype)
     else:
         logits = dense(params["lm_head"], x, cfg.quant_mode)
     if cfg.logit_soft_cap > 0:
@@ -447,7 +473,7 @@ def _scan_layers(
 ) -> tuple[jnp.ndarray, KVCache, jnp.ndarray]:
     """embed → layer scan; returns PRE-final-norm hidden states [b, s, h]
     (lm_head_logits applies the final norm) plus cache and moe aux."""
-    x = params["embed"]["weight"][tokens].astype(cfg.activation_dtype)
+    x = embed_tokens(cfg, params, tokens)
 
     def body(carry, scanned):
         h, aux_sum = carry

@@ -85,6 +85,39 @@ def quantize_params(
     return walk(params)
 
 
+def quantize_embedding(params: Params) -> Params:
+    """Quantize the token embedding to int8 with per-vocab-row scales.
+
+    Separate from :func:`quantize_params` (which matches the reference's
+    nn.Linear-only boundary, ``try.py:205``) because it changes BOTH ends of
+    the model: the input lookup becomes a gather-dequant (reads b·s rows —
+    negligible), and the TIED lm head (``transformer.lm_head_logits``) becomes
+    a w8a16 epilogue matmul over the int8 rows. On Llama-3.2-1B the tied bf16
+    embedding is 525 MB read once per decode step — ~35% of all weight
+    traffic in an otherwise-int8 model — so quantizing it is the single
+    largest decode-bandwidth lever after quantize_params. Per-row scales make
+    the gather and the head matmul see bit-identical dequantized values.
+    """
+    embed = params.get("embed", {})
+    if "weight" not in embed:
+        return params
+    # [V, H] reduced over H → one scale per vocab row; the same axis serves
+    # the tied head matmul (out-channel = vocab row).
+    q, scales = quantize_weight(embed["weight"], axis=-1)
+    out = dict(params)
+    out["embed"] = {"weight_q": q, "scales": scales}
+    return out
+
+
+def embedding_table(embed: Params, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Dense [V, H] view of a (possibly quantized) embedding subtree."""
+    if "weight_q" in embed:
+        return (
+            embed["weight_q"].astype(jnp.float32) * embed["scales"][:, None]
+        ).astype(dtype)
+    return embed["weight"]
+
+
 def _lookup(tree: Params, path: tuple) -> jnp.ndarray | None:
     node = tree
     for p in path:
@@ -101,7 +134,7 @@ def is_quantized(params: Params) -> bool:
     def walk(node):
         nonlocal found
         if isinstance(node, dict):
-            if "kernel_q" in node:
+            if "kernel_q" in node or "kernel_q4" in node:
                 found = True
             else:
                 for v in node.values():

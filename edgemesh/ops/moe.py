@@ -63,15 +63,19 @@ def expert_capacity(cfg: ModelConfig, num_tokens: int) -> int:
     )
 
 
-def moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Routed FFN. x: [b, s, h] → ([b, s, h], scalar aux load-balance loss)."""
+def route_tokens(
+    cfg: ModelConfig, router_kernel: jnp.ndarray, xt: jnp.ndarray, capacity: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k capacity routing for ``xt`` [T, h] → (combine [T, E, C] fp32,
+    aux scalar). Shared by the single-program MoE below and the manual 4D
+    SPMD path (parallel/spmd.py), which slices the combine tensor down to
+    its ``ep``-local experts. Deterministic in T-order (GShard slot-by-slot
+    position assignment)."""
     E, k = cfg.num_experts, cfg.experts_per_token
-    b, s, h = x.shape
-    T = b * s
-    C = expert_capacity(cfg, T)
-    xt = x.reshape(T, h)
+    T = xt.shape[0]
+    C = capacity
 
-    logits = xt.astype(jnp.float32) @ moe["router"]["kernel"]  # [T, E]
+    logits = xt.astype(jnp.float32) @ router_kernel  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
     gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [T, k]
     gate_vals = gate_vals / jnp.maximum(jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
@@ -91,6 +95,24 @@ def moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.ndarray,
         combine = combine + gate_vals[:, slot, None, None] * keep[:, :, None] * pos_oh
         counts = counts + jnp.sum(m, axis=0)
 
+    # Load-balance loss over ALL k routing slots (GShard-style mean of
+    # one-hots across slots; Switch eq. 4 is the k=1 special case). Counting
+    # only slot 0 would leave routing collapse in later slots invisible to
+    # the penalty when experts_per_token > 1.
+    frac = jnp.mean(jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=(0, 1))
+    meanprob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * meanprob)
+    return combine, aux
+
+
+def moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Routed FFN. x: [b, s, h] → ([b, s, h], scalar aux load-balance loss)."""
+    b, s, h = x.shape
+    T = b * s
+    C = expert_capacity(cfg, T)
+    xt = x.reshape(T, h)
+
+    combine, aux = route_tokens(cfg, moe["router"]["kernel"], xt, C)
     dispatch = (combine > 0).astype(cfg.activation_dtype)  # [T, E, C]
     expert_in = jnp.einsum(
         "tec,th->ech", dispatch, xt.astype(cfg.activation_dtype)
@@ -108,12 +130,4 @@ def moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.ndarray,
     y = jnp.einsum(
         "tec,ech->th", combine.astype(cfg.activation_dtype), expert_out
     ).reshape(b, s, h)
-
-    # Load-balance loss over ALL k routing slots (GShard-style mean of
-    # one-hots across slots; Switch eq. 4 is the k=1 special case). Counting
-    # only slot 0 would leave routing collapse in later slots invisible to
-    # the penalty when experts_per_token > 1.
-    frac = jnp.mean(jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=(0, 1))
-    meanprob = jnp.mean(probs, axis=0)
-    aux = E * jnp.sum(frac * meanprob)
     return y.astype(x.dtype), aux

@@ -29,6 +29,7 @@ from edgemesh.models.transformer import (
     KVCache,
     ModelConfig,
     _layer_fn,
+    embed_tokens,
     lm_head_logits,
 )
 from edgemesh.ops.attention import LayerKV
@@ -233,7 +234,7 @@ class PipelineEngine:
         positions = jnp.minimum(positions, (lengths - 1)[:, None])
         max_seq = cache.k.shape[2]
         kv_valid = jnp.arange(max_seq)[None, :] < lengths[:, None]
-        x = params["embed"]["weight"][tokens].astype(cfg.activation_dtype)
+        x = embed_tokens(cfg, params, tokens)
         hidden, cache = self._run_layers(
             params, x, positions, kv_valid, cache, is_decode=False, num_micro=self.num_micro
         )
@@ -245,7 +246,7 @@ class PipelineEngine:
         max_seq = cache.k.shape[2]
         positions = cache.lengths[:, None]
         kv_valid = jnp.arange(max_seq)[None, :] <= cache.lengths[:, None]
-        x = params["embed"]["weight"][tokens[:, None]].astype(cfg.activation_dtype)
+        x = embed_tokens(cfg, params, tokens[:, None])
         hidden, cache = self._run_layers(
             params, x, positions, kv_valid, cache, is_decode=True, num_micro=1
         )
@@ -279,7 +280,7 @@ class PipelineEngine:
         cache = self.init_cache(b, s)
         positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
         kv_valid = jnp.arange(s)[None, :] < lengths[:, None]
-        x = self.params["embed"]["weight"][tokens].astype(cfg.activation_dtype)
+        x = embed_tokens(cfg, self.params, tokens)
         hidden, _ = self._run_layers(
             self.params, x, positions, kv_valid, cache, is_decode=False, num_micro=self.num_micro
         )

@@ -115,8 +115,17 @@ def quantized_pspecs(specs: Params) -> Params:
                 kernel_spec = node["kernel"]
                 out: Params = {
                     "kernel_q": kernel_spec,
+                    # int4 nibble-packed kernel: same axes (adjacent-pair
+                    # packing keeps a contiguous packed-row shard == a
+                    # contiguous global-row shard, so the in-dim split is
+                    # valid even in the per-shard shard_map engines)
+                    "kernel_q4": kernel_spec,
                     # per-out-channel scales: kernel spec minus the in dim
                     "scales": P(*kernel_spec[:-2], kernel_spec[-1]),
+                    # grouped int4 scales [.., G, out]: the G axis subdivides
+                    # the contraction dim, so it inherits the kernel's in-dim
+                    # sharding (keeps local group_size correct per shard)
+                    "scales4": P(*kernel_spec[:-2], kernel_spec[-2], kernel_spec[-1]),
                     # per-in-channel smoothing vector: kernel spec minus the out dim
                     "smooth": P(*kernel_spec[:-1]),
                 }
@@ -127,6 +136,28 @@ def quantized_pspecs(specs: Params) -> Params:
         return node
 
     return walk(specs)
+
+
+def pick_grouped_scales_spec(
+    s_dict: Params, v, mesh: Mesh
+) -> tuple[P, bool]:
+    """Spec for a grouped int4 ``scales`` leaf ([.., G, out] — one rank above
+    the int8 [.., out] spec in ``s_dict["scales"]``).
+
+    Prefers ``scales4`` (G sharded with the kernel's in dim — required for
+    the per-shard shard_map engines to see a consistent local group_size);
+    when G does not divide the mesh axis (e.g. per-channel G=1), falls back
+    to an unsharded G axis. Returns (spec, used_scales4)."""
+    s = s_dict["scales"]
+    s4 = s_dict.get("scales4")
+    if isinstance(s4, P) and len(s4) <= getattr(v, "ndim", 0):
+        ok = all(
+            ax is None or v.shape[i] % mesh.shape[ax] == 0
+            for i, ax in enumerate(s4)
+        )
+        if ok:
+            return s4, True
+    return P(*s[:-1], None, s[-1]), False
 
 
 def cache_pspecs(cfg: ModelConfig, mesh: Mesh) -> KVCache:
@@ -151,18 +182,27 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
     if is_quantized(params):
         specs = quantized_pspecs(specs)
 
-    def walk(p_node, s_node, key=None):
+    def walk(p_node, s_node):
         if isinstance(p_node, dict):
-            return {
-                k: walk(v, s_node.get(k) if isinstance(s_node, dict) else None, k)
-                for k, v in p_node.items()
-            }
+            s_dict = s_node if isinstance(s_node, dict) else {}
+            out = {}
+            for k, v in p_node.items():
+                s = s_dict.get(k)
+                if (
+                    k == "scales"
+                    and isinstance(s, P)
+                    and getattr(v, "ndim", 0) == len(s) + 1
+                ):
+                    # Grouped int4 scales carry an extra G axis before the
+                    # out dim ([L, G, out] vs int8's [L, out]): shard G like
+                    # the kernel's in dim where divisibility allows. (Under
+                    # GSPMD any valid placement is correct; the consistency
+                    # requirement bites only in the shard_map engines, which
+                    # do their own strict check in tp_infer._specs.)
+                    s, _ = pick_grouped_scales_spec(s_dict, v, mesh)
+                out[k] = walk(v, s)
+            return out
         spec = s_node if isinstance(s_node, P) else P()
-        if key == "scales" and len(spec) >= 1 and getattr(p_node, "ndim", 0) == len(spec) + 1:
-            # Grouped int4 scales carry an extra G axis before the out dim
-            # ([L, G, out] vs int8's [L, out]); keep the out-dim sharding on
-            # the last axis and leave the group axis unsharded.
-            spec = P(*spec[:-1], None, spec[-1])
         return jax.device_put(p_node, NamedSharding(mesh, spec))
 
     return walk(params, specs)

@@ -38,7 +38,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from edgemesh.models.transformer import ModelConfig, _apply_norm, lm_head_logits
+from edgemesh.models.transformer import ModelConfig, _apply_norm, embed_tokens, lm_head_logits
 from edgemesh.ops.rope import apply_rope
 from edgemesh.parallel.ring_attention import ring_attend_block
 from edgemesh.training import TrainState
@@ -73,15 +73,27 @@ def spmd_param_specs(cfg: ModelConfig) -> Params:
         "k": _dense_spec(True, cfg.qkv_bias),
         "v": _dense_spec(True, cfg.qkv_bias),
         "o": _dense_spec(False, cfg.out_bias),
-        "down": _dense_spec(False, cfg.out_bias),
     }
     if cfg.norm == "ln":
         layer["attn_norm"]["bias"] = P("pp", None)
     if not cfg.shared_input_norm:
         layer["mlp_norm"] = dict(layer["attn_norm"])
-    if cfg.activation == "silu":
-        layer["gate"] = _dense_spec(True, cfg.out_bias)
-    layer["up"] = _dense_spec(True, cfg.out_bias)
+    if cfg.num_experts > 0:
+        # Stacked MoE leaves [L, E, ...]: expert dim over ep, FFN width over
+        # tp (same Megatron roles as the dense MLP); fp32 router replicated —
+        # every ep member routes identically and slices out its own experts.
+        layer["moe"] = {
+            "router": {"kernel": P("pp", None, None)},
+            "up": P("pp", "ep", None, "tp"),
+            "down": P("pp", "ep", "tp", None),
+        }
+        if cfg.activation == "silu":
+            layer["moe"]["gate"] = P("pp", "ep", None, "tp")
+    else:
+        layer["down"] = _dense_spec(False, cfg.out_bias)
+        if cfg.activation == "silu":
+            layer["gate"] = _dense_spec(True, cfg.out_bias)
+        layer["up"] = _dense_spec(True, cfg.out_bias)
 
     specs: Params = {
         "embed": {"weight": P()},
@@ -118,6 +130,9 @@ def _check_divisibility(cfg: ModelConfig, mesh: Mesh) -> None:
         )
     if cfg.intermediate_size % tp:
         raise ValueError(f"intermediate {cfg.intermediate_size} % tp {tp} != 0")
+    ep = mesh.shape.get("ep", 1)
+    if cfg.num_experts > 0 and cfg.num_experts % ep:
+        raise ValueError(f"num_experts {cfg.num_experts} % ep {ep} != 0")
 
 
 # ---------------------------------------------------------------------------
@@ -169,18 +184,58 @@ def _spmd_attention(
     return _row_dense(layer["o"], out.reshape(b, s, nh_l * hd))
 
 
-def _spmd_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _spmd_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """FFN under manual tp (and ep for MoE) → (y, aux load-balance loss)."""
     if cfg.num_experts > 0:
-        raise NotImplementedError(
-            "MoE runs under the auto-sharded path (ep axis in param_pspecs); "
-            "the manual 4D SPMD program does not route experts yet"
-        )
+        return _spmd_moe_mlp(cfg, layer["moe"], x)
     if cfg.activation == "silu":
         hidden = jax.nn.silu(_col_dense(layer["gate"], x)) * _col_dense(layer["up"], x)
     else:
         hidden = _col_dense(layer["up"], x)
         hidden = jax.nn.gelu(hidden, approximate=cfg.activation == "gelu_tanh")
-    return _row_dense(layer["down"], hidden)
+    return _row_dense(layer["down"], hidden), jnp.zeros((), jnp.float32)
+
+
+def _spmd_moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel MoE inside the manual 4D program.
+
+    Token-replicated EP: activations are already replicated over ``ep`` (no
+    batch/seq axis maps to it), so every ep member runs the identical fp32
+    router (replicated kernel → identical top-k), slices the [T, E, C]
+    combine tensor down to its OWN E/ep experts, runs only those FFNs
+    (columns further split over ``tp``), and one psum over (ep, tp) joins
+    expert groups and row-shards in a single reduction. Versus the
+    auto-sharded path (ops/moe.py under param_pspecs) this trades the
+    all-to-all dispatch for a [T, h] psum — the right trade at these T
+    (GShard-style a2a wins only when T·h outgrows the expert weights).
+    """
+    from edgemesh.ops.moe import expert_capacity, route_tokens
+
+    b, s, h = x.shape
+    T = b * s
+    C = expert_capacity(cfg, T)
+    xt = x.reshape(T, h)
+    ep = lax.axis_size("ep")
+    e_local = cfg.num_experts // ep
+    e0 = lax.axis_index("ep") * e_local
+
+    combine, aux = route_tokens(cfg, moe["router"]["kernel"], xt, C)
+    combine_l = lax.dynamic_slice_in_dim(combine, e0, e_local, axis=1)  # [T, El, C]
+    dispatch_l = (combine_l > 0).astype(cfg.activation_dtype)
+    expert_in = jnp.einsum("tec,th->ech", dispatch_l, xt.astype(cfg.activation_dtype))
+
+    if cfg.activation == "silu":
+        hidden = jax.nn.silu(
+            jnp.einsum("ech,ehi->eci", expert_in, moe["gate"])
+        ) * jnp.einsum("ech,ehi->eci", expert_in, moe["up"])
+    else:
+        hidden = jnp.einsum("ech,ehi->eci", expert_in, moe["up"])
+        hidden = jax.nn.gelu(hidden, approximate=cfg.activation == "gelu_tanh")
+    expert_out = jnp.einsum("eci,eih->ech", hidden, moe["down"])  # [El, C, h] tp-partial
+
+    y = jnp.einsum("tec,ech->th", combine_l.astype(cfg.activation_dtype), expert_out)
+    y = lax.psum(y, ("ep", "tp"))  # join expert groups AND the tp row split
+    return y.reshape(b, s, h).astype(x.dtype), aux
 
 
 def _spmd_layer(
@@ -191,20 +246,23 @@ def _spmd_layer(
     valid: jnp.ndarray,
     sp: int,
     tp: int,
-) -> jnp.ndarray:
-    """One transformer layer, all family dials (mirrors transformer._layer_fn)."""
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One transformer layer → (x, moe aux), all family dials (mirrors
+    transformer._layer_fn)."""
     if cfg.parallel_block:
         attn_in = _apply_norm(cfg, layer["attn_norm"], x)
         mlp_in = attn_in if cfg.shared_input_norm else _apply_norm(cfg, layer["mlp_norm"], x)
+        mlp_out, aux = _spmd_mlp(cfg, layer, mlp_in)
         return (
             x
             + _spmd_attention(cfg, layer, attn_in, positions, valid, sp, tp)
-            + _spmd_mlp(cfg, layer, mlp_in)
-        )
+            + mlp_out
+        ), aux
     x = x + _spmd_attention(
         cfg, layer, _apply_norm(cfg, layer["attn_norm"], x), positions, valid, sp, tp
     )
-    return x + _spmd_mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x))
+    mlp_out, aux = _spmd_mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x))
+    return x + mlp_out, aux
 
 
 # ---------------------------------------------------------------------------
@@ -212,7 +270,7 @@ def _spmd_layer(
 # ---------------------------------------------------------------------------
 
 
-def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int):
+def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int, moe_aux_weight: float = 0.01):
     pp = mesh.shape["pp"]
     sp = mesh.shape["sp"]
     tp = mesh.shape["tp"]
@@ -239,7 +297,7 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int):
         # garbage target at the global last column is always masked by this.)
         tmask = ((positions + 1) < lengths[:, None]).astype(jnp.float32)
 
-        x = params["embed"]["weight"][tokens].astype(cfg.activation_dtype)
+        x = embed_tokens(cfg, params, tokens)
 
         def to_mb(a):
             return a.reshape(num_micro, mbs, *a.shape[1:])
@@ -252,7 +310,7 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int):
         is_last_stage = stage == pp - 1
 
         def one_step(carry, t):
-            recv, loss_sum, cnt_sum = carry
+            recv, loss_sum, cnt_sum, aux_sum = carry
             mb_idx = t - stage
             active = (mb_idx >= 0) & (mb_idx < num_micro)
             idx = jnp.clip(mb_idx, 0, num_micro - 1)
@@ -260,10 +318,17 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int):
             h = jnp.where(stage == 0, x_mb[idx], recv)
             pos, kvv = pos_mb[idx], valid_mb[idx]
 
-            def layer_step(h, layer):
-                return _spmd_layer(cfg, layer, h, pos, kvv, sp, tp), None
+            def layer_step(carry_l, layer):
+                h, aux = carry_l
+                h, a = _spmd_layer(cfg, layer, h, pos, kvv, sp, tp)
+                return (h, aux + a), None
 
-            h, _ = lax.scan(layer_step, h, stage_layers)
+            (h, aux_mb), _ = lax.scan(
+                layer_step, (h, jnp.zeros((), jnp.float32)), stage_layers
+            )
+            # Bubble (fill/drain) steps run the layers on a clipped microbatch
+            # index; their routing stats must not leak into the aux loss.
+            aux_sum = aux_sum + jnp.where(active, aux_mb, 0.0)
             send = lax.ppermute(h, "pp", [(i, i + 1) for i in range(pp - 1)])
 
             # The LM-head matmul ([*, vocab] — the largest in the program) and
@@ -282,29 +347,43 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int):
             dl, dc = lax.cond(active & is_last_stage, ce_branch, skip_branch, h)
             loss_sum = loss_sum + dl
             cnt_sum = cnt_sum + dc
-            return (send, loss_sum, cnt_sum), None
+            return (send, loss_sum, cnt_sum, aux_sum), None
 
         init = (
             jnp.zeros((mbs, s_l, cfg.hidden_size), cfg.activation_dtype),
             jnp.zeros((), jnp.float32),
             jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
         )
-        (_, loss_sum, cnt_sum), _ = lax.scan(one_step, init, jnp.arange(steps))
+        (_, loss_sum, cnt_sum, aux_sum), _ = lax.scan(one_step, init, jnp.arange(steps))
 
         # Loss lives on the last pp stage, sharded over dp x sp; tp members
         # already agree (activations are tp-invariant after every row psum).
         total = lax.psum(loss_sum, ("dp", "pp", "sp"))
         count = lax.psum(cnt_sum, ("dp", "pp", "sp"))
-        return total / jnp.maximum(count, 1.0)
+        loss = total / jnp.maximum(count, 1.0)
+        if cfg.num_experts > 0:
+            # psum over pp sums the per-stage LAYER blocks (correct: aux is a
+            # per-layer sum, matching transformer._scan_layers); dp/sp shards
+            # and microbatches routed DIFFERENT tokens, so those reduce as a
+            # mean. ep/tp members compute identical aux — excluded from psum.
+            dp_n, sp_n = mesh.shape["dp"], mesh.shape["sp"]
+            aux = lax.psum(aux_sum, ("dp", "pp", "sp")) / (dp_n * sp_n * num_micro)
+            loss = loss + moe_aux_weight * aux
+        return loss
 
     return device_fn
 
 
-def make_spmd_loss(cfg: ModelConfig, mesh: Mesh, num_micro: int = 2):
+def make_spmd_loss(
+    cfg: ModelConfig, mesh: Mesh, num_micro: int = 2, moe_aux_weight: float = 0.01
+):
     """Returns loss(params, tokens, lengths) -> scalar, where params follow
-    spmd_param_specs layout and tokens are [B, S] split dp x sp."""
+    spmd_param_specs layout and tokens are [B, S] split dp x sp. For MoE
+    configs the scalar includes ``moe_aux_weight`` x the load-balance aux
+    (same coefficient convention as training.make_train_step)."""
     _check_divisibility(cfg, mesh)
-    device_fn = _make_device_fn(cfg, mesh, num_micro)
+    device_fn = _make_device_fn(cfg, mesh, num_micro, moe_aux_weight)
     specs = spmd_param_specs(cfg)
 
     def loss_fn(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray):

@@ -129,10 +129,36 @@ class TPInferenceEngine:
         # shard_map in_specs must mirror the param pytree EXACTLY — prune
         # spec-only keys (e.g. the optional SmoothQuant "smooth" leaf when
         # smoothing was skipped) and replicate any param key without a spec.
+        # Grouped int4 scales ([L, G, out], one rank above int8's) take the
+        # scales4 spec so the G axis follows the kernel's in-dim sharding —
+        # the per-shard group_size stays correct inside shard_map.
+        from edgemesh.parallel.sharding import pick_grouped_scales_spec
+
         def align(p_node, s_node):
             if isinstance(p_node, dict):
-                s_node = s_node if isinstance(s_node, dict) else {}
-                return {k: align(v, s_node.get(k)) for k, v in p_node.items()}
+                s_dict = s_node if isinstance(s_node, dict) else {}
+                out = {}
+                for k, v in p_node.items():
+                    s = s_dict.get(k)
+                    if (
+                        k == "scales"
+                        and isinstance(s, P)
+                        and getattr(v, "ndim", 0) == len(s) + 1
+                    ):
+                        s, used4 = pick_grouped_scales_spec(s_dict, v, self.mesh)
+                        kernel_spec = s_dict.get("kernel_q4", P())
+                        in_sharded = len(kernel_spec) >= 2 and kernel_spec[-2] is not None
+                        if not used4 and in_sharded and v.shape[-2] > 1:
+                            # This engine computes per-shard: a row-sharded
+                            # packed kernel with replicated grouped scales
+                            # would miscompute the local group_size.
+                            raise ValueError(
+                                f"int4 group count {v.shape[-2]} does not divide "
+                                f"tp={self.tp}; use a group_size giving G % tp == 0 "
+                                "or per-channel scales (group_size=0)"
+                            )
+                    out[k] = align(v, s)
+                return out
             return s_node if isinstance(s_node, P) else P()
 
         return align(params, specs)

