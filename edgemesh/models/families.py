@@ -69,6 +69,36 @@ FAMILY_PRESETS: dict[str, dict] = {
         lm_head_bias=False,
         tie_embeddings=False,
     ),
+    # Qwen2/2.5: the llama dialect plus attention qkv biases; small variants
+    # (0.5B/1.5B) tie embeddings (checkpoint's tie_word_embeddings decides).
+    "qwen2": dict(
+        norm="rms",
+        activation="silu",
+        parallel_block=False,
+        shared_input_norm=False,
+        rotary_fraction=1.0,
+        qkv_bias=True,
+        out_bias=False,
+        lm_head_bias=False,
+        tie_embeddings=True,
+    ),
+    # Gemma (v1): RMSNorm with unit offset (weights store scale-1), GeGLU
+    # (gated gelu_tanh MLP), embeddings scaled by sqrt(hidden), wide fixed
+    # head_dim (256 — NOT hidden/heads), always-tied LM head.
+    "gemma": dict(
+        norm="rms",
+        norm_unit_offset=True,
+        activation="gelu_tanh",
+        gated_mlp=True,
+        embed_scale=True,
+        parallel_block=False,
+        shared_input_norm=False,
+        rotary_fraction=1.0,
+        qkv_bias=False,
+        out_bias=False,
+        lm_head_bias=False,
+        tie_embeddings=True,
+    ),
 }
 
 _HF_MODEL_TYPE_TO_FAMILY = {
@@ -76,6 +106,8 @@ _HF_MODEL_TYPE_TO_FAMILY = {
     "gpt_neox": "neox",
     "phi": "phi2",
     "mistral": "mistral",
+    "qwen2": "qwen2",
+    "gemma": "gemma",
 }
 
 

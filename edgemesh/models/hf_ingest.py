@@ -97,8 +97,10 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
     with open(ckpt / "config.json") as f:
         hf = json.load(f)
 
-    if family in ("llama", "mistral"):
-        # Mistral is the llama config dialect plus sliding-window attention.
+    if family in ("llama", "mistral", "qwen2", "gemma"):
+        # One config dialect: mistral adds sliding-window attention, qwen2
+        # adds qkv biases (preset), gemma adds unit-offset norms / GeGLU /
+        # embed scaling (preset) and a wide fixed head_dim.
         kw = dict(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -109,11 +111,27 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
             max_seq_len=min(hf.get("max_position_embeddings", 4096), 8192),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             norm_eps=hf.get("rms_norm_eps", 1e-5),
-            tie_embeddings=hf.get("tie_word_embeddings", False),
+            tie_embeddings=hf.get("tie_word_embeddings", family == "gemma"),
         )
         if family == "mistral":
             # null in newer configs (full attention); 4096 on the 7B v0.1.
             kw["sliding_window"] = int(hf.get("sliding_window") or 0)
+        elif family == "qwen2":
+            # Qwen2's use_sliding_window applies the window only to layers
+            # >= max_window_layers (lower layers attend fully); this runtime
+            # has one window for all layers, so approximating would silently
+            # truncate the lower layers' context — same fail-at-ingest policy
+            # as unconsumed rope_scaling below. Production Qwen2 configs ship
+            # it disabled.
+            if hf.get("use_sliding_window"):
+                raise ValueError(
+                    f"use_sliding_window=true in {ckpt / 'config.json'} is not "
+                    "supported (per-layer windowing, max_window_layers="
+                    f"{hf.get('max_window_layers')}); disable it or use a "
+                    "full-attention checkpoint"
+                )
+        elif family == "gemma":
+            kw["head_dim"] = int(hf.get("head_dim", 256))
         kw.update(_rope_scaling_kw(hf, ckpt))
     elif family == "neox":
         kw = dict(
@@ -147,7 +165,7 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
         raise ValueError(family)
     rs = hf.get("rope_scaling") or {}
     rs_type = rs.get("rope_type", rs.get("type", ""))
-    if family not in ("llama", "mistral") and rs and rs_type not in ("default", "none", ""):
+    if family not in ("llama", "mistral", "qwen2", "gemma") and rs and rs_type not in ("default", "none", ""):
         # The neox/phi2 forward paths don't consume a scaling block; ignoring
         # a frequency-changing one would silently produce wrong logits for a
         # long-context variant. No-op types (newer HF configs emit
@@ -184,7 +202,7 @@ def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) ->
     dtype = dtype or cfg.activation_dtype
     raw = _load_raw_tensors(ckpt)
 
-    if family in ("llama", "mistral"):  # identical weight naming
+    if family in ("llama", "mistral", "qwen2", "gemma"):  # identical weight naming
         params = _map_llama(raw, cfg, dtype)
     elif family == "neox":
         params = _map_neox(raw, cfg, dtype)
@@ -213,6 +231,11 @@ def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
         "up": {"kernel": layer_stack("model.layers.{}.mlp.up_proj.weight", True)},
         "down": {"kernel": layer_stack("model.layers.{}.mlp.down_proj.weight", True)},
     }
+    if "model.layers.0.self_attn.q_proj.bias" in raw:  # Qwen2 qkv biases
+        for name, proj in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj")):
+            layers[name]["bias"] = layer_stack(
+                "model.layers.{}.self_attn." + proj + ".bias", False
+            )
     params: Params = {
         "embed": {"weight": jnp.asarray(raw["model.embed_tokens.weight"], dtype)},
         "layers": layers,

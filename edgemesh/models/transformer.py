@@ -50,7 +50,17 @@ class ModelConfig:
     # Family dials
     norm: str = "rms"  # rms | ln
     norm_eps: float = 1e-5
+    # Gemma: RMSNorm scales by (1 + weight) — weights store the DELTA from
+    # identity (HF GemmaRMSNorm).
+    norm_unit_offset: bool = False
     activation: str = "silu"  # silu (SwiGLU) | gelu | gelu_tanh
+    # Gated (GLU-style) MLP: gate/up/down instead of up/down. None = derive
+    # from activation (silu → gated, the Llama convention); Gemma sets True
+    # with gelu_tanh (GeGLU).
+    gated_mlp: bool | None = None
+    # Gemma: embedding output multiplied by sqrt(hidden_size) (the tied LM
+    # head is NOT scaled).
+    embed_scale: bool = False
     parallel_block: bool = False  # Phi-2/NeoX style: attn & mlp from one input
     shared_input_norm: bool = False  # Phi-2: ONE norm feeds both attn and mlp
     rotary_fraction: float = 1.0
@@ -117,6 +127,13 @@ class ModelConfig:
         # Round to even; HF families use even rotary dims (e.g. Phi-2: 32).
         rd = int(self.head_size * self.rotary_fraction)
         return rd - (rd % 2)
+
+    @property
+    def gated(self) -> bool:
+        """Whether the MLP is gated (gate/up/down); see ``gated_mlp``."""
+        if self.gated_mlp is not None:
+            return self.gated_mlp
+        return self.activation == "silu"
 
     @property
     def activation_dtype(self):
@@ -195,11 +212,9 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
 
             layer["moe"] = init_moe_layer(cfg, ks[4])
             return layer
-        if cfg.activation == "silu":
+        if cfg.gated:
             layer["gate"] = _dense_init(ks[4], h, inter, dtype, cfg.out_bias)
-            layer["up"] = _dense_init(ks[5], h, inter, dtype, cfg.out_bias)
-        else:
-            layer["up"] = _dense_init(ks[5], h, inter, dtype, cfg.out_bias)
+        layer["up"] = _dense_init(ks[5], h, inter, dtype, cfg.out_bias)
         layer["down"] = _dense_init(ks[6], inter, h, dtype, cfg.out_bias)
         return layer
 
@@ -231,8 +246,14 @@ def embed_tokens(cfg: ModelConfig, params: Params, tokens: jnp.ndarray) -> jnp.n
     embed = params["embed"]
     if "weight_q" in embed:
         rows = embed["weight_q"][tokens].astype(jnp.float32)
-        return (rows * embed["scales"][tokens][..., None]).astype(cfg.activation_dtype)
-    return embed["weight"][tokens].astype(cfg.activation_dtype)
+        x = (rows * embed["scales"][tokens][..., None]).astype(cfg.activation_dtype)
+    else:
+        x = embed["weight"][tokens].astype(cfg.activation_dtype)
+    if cfg.embed_scale:
+        # Gemma: sqrt(h) cast through the model dtype first (HF multiplies by
+        # a bf16 normalizer tensor — matching the rounding keeps logit parity).
+        x = x * jnp.asarray(cfg.hidden_size**0.5, cfg.activation_dtype)
+    return x
 
 
 def dense(p: Params, x: jnp.ndarray, quant_mode: str = "w8a16") -> jnp.ndarray:
@@ -272,7 +293,10 @@ def dense(p: Params, x: jnp.ndarray, quant_mode: str = "w8a16") -> jnp.ndarray:
 
 def _apply_norm(cfg: ModelConfig, p: Params, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.norm == "rms":
-        return rms_norm(x, p["scale"], cfg.norm_eps)
+        scale = p["scale"]
+        if cfg.norm_unit_offset:  # Gemma stores the delta from identity
+            scale = scale.astype(jnp.float32) + 1.0
+        return rms_norm(x, scale, cfg.norm_eps)
     return layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
 
 
@@ -285,21 +309,16 @@ def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> tuple[jnp.ndarray, 
         return moe_mlp(cfg, layer["moe"], x)
     zero = jnp.zeros((), jnp.float32)
     qm = cfg.quant_mode
+    if cfg.gated:
+        gate = _activate(cfg, dense(layer["gate"], x, qm))
+        return dense(layer["down"], gate * dense(layer["up"], x, qm), qm), zero
+    return dense(layer["down"], _activate(cfg, dense(layer["up"], x, qm)), qm), zero
+
+
+def _activate(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.activation == "silu":
-        return (
-            dense(
-                layer["down"],
-                jax.nn.silu(dense(layer["gate"], x, qm)) * dense(layer["up"], x, qm),
-                qm,
-            ),
-            zero,
-        )
-    hidden = dense(layer["up"], x, qm)
-    if cfg.activation == "gelu_tanh":
-        hidden = jax.nn.gelu(hidden, approximate=True)
-    else:
-        hidden = jax.nn.gelu(hidden, approximate=False)
-    return dense(layer["down"], hidden, qm), zero
+        return jax.nn.silu(x)
+    return jax.nn.gelu(x, approximate=cfg.activation == "gelu_tanh")
 
 
 def _use_flash(cfg: ModelConfig) -> bool:

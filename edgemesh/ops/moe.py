@@ -26,7 +26,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from edgemesh.models.transformer import ModelConfig, Params
+from edgemesh.models.transformer import ModelConfig, Params, _activate
 
 
 def init_moe_layer(cfg: ModelConfig, key: jax.Array) -> Params:
@@ -45,7 +45,7 @@ def init_moe_layer(cfg: ModelConfig, key: jax.Array) -> Params:
         "up": (jax.random.normal(ks[1], (E, h, inter), jnp.float32) * scale_in).astype(dtype),
         "down": (jax.random.normal(ks[2], (E, inter, h), jnp.float32) * scale_out).astype(dtype),
     }
-    if cfg.activation == "silu":
+    if cfg.gated:
         p["gate"] = (jax.random.normal(ks[3], (E, h, inter), jnp.float32) * scale_in).astype(dtype)
     return p
 
@@ -118,13 +118,12 @@ def moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.ndarray,
         "tec,th->ech", dispatch, xt.astype(cfg.activation_dtype)
     )  # [E, C, h]
 
-    if cfg.activation == "silu":
-        hidden = jax.nn.silu(
-            jnp.einsum("ech,ehi->eci", expert_in, moe["gate"])
+    if cfg.gated:
+        hidden = _activate(
+            cfg, jnp.einsum("ech,ehi->eci", expert_in, moe["gate"])
         ) * jnp.einsum("ech,ehi->eci", expert_in, moe["up"])
     else:
-        hidden = jnp.einsum("ech,ehi->eci", expert_in, moe["up"])
-        hidden = jax.nn.gelu(hidden, approximate=cfg.activation == "gelu_tanh")
+        hidden = _activate(cfg, jnp.einsum("ech,ehi->eci", expert_in, moe["up"]))
     expert_out = jnp.einsum("eci,eih->ech", hidden, moe["down"])  # [E, C, h]
 
     y = jnp.einsum(

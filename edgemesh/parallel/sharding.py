@@ -83,11 +83,11 @@ def param_pspecs(cfg: ModelConfig, mesh: Mesh) -> Params:
             "up": P(None, e_ax, None, t_ax),
             "down": P(None, e_ax, t_ax, None),
         }
-        if cfg.activation == "silu":
+        if cfg.gated:
             layer["moe"]["gate"] = P(None, e_ax, None, t_ax)
     else:
         layer["down"] = _dense_pspec(False, cfg.out_bias, inter_ok)
-        if cfg.activation == "silu":
+        if cfg.gated:
             layer["gate"] = _dense_pspec(True, cfg.out_bias, inter_ok)
         layer["up"] = _dense_pspec(True, cfg.out_bias, inter_ok)
 

@@ -38,7 +38,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from edgemesh.models.transformer import ModelConfig, _apply_norm, embed_tokens, lm_head_logits
+from edgemesh.models.transformer import ModelConfig, _activate, _apply_norm, embed_tokens, lm_head_logits
 from edgemesh.ops.rope import apply_rope
 from edgemesh.parallel.ring_attention import ring_attend_block
 from edgemesh.training import TrainState
@@ -87,11 +87,11 @@ def spmd_param_specs(cfg: ModelConfig) -> Params:
             "up": P("pp", "ep", None, "tp"),
             "down": P("pp", "ep", "tp", None),
         }
-        if cfg.activation == "silu":
+        if cfg.gated:
             layer["moe"]["gate"] = P("pp", "ep", None, "tp")
     else:
         layer["down"] = _dense_spec(False, cfg.out_bias)
-        if cfg.activation == "silu":
+        if cfg.gated:
             layer["gate"] = _dense_spec(True, cfg.out_bias)
         layer["up"] = _dense_spec(True, cfg.out_bias)
 
@@ -188,11 +188,10 @@ def _spmd_mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> tuple[jnp.ndar
     """FFN under manual tp (and ep for MoE) → (y, aux load-balance loss)."""
     if cfg.num_experts > 0:
         return _spmd_moe_mlp(cfg, layer["moe"], x)
-    if cfg.activation == "silu":
-        hidden = jax.nn.silu(_col_dense(layer["gate"], x)) * _col_dense(layer["up"], x)
+    if cfg.gated:
+        hidden = _activate(cfg, _col_dense(layer["gate"], x)) * _col_dense(layer["up"], x)
     else:
-        hidden = _col_dense(layer["up"], x)
-        hidden = jax.nn.gelu(hidden, approximate=cfg.activation == "gelu_tanh")
+        hidden = _activate(cfg, _col_dense(layer["up"], x))
     return _row_dense(layer["down"], hidden), jnp.zeros((), jnp.float32)
 
 
@@ -224,13 +223,12 @@ def _spmd_moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.nd
     dispatch_l = (combine_l > 0).astype(cfg.activation_dtype)
     expert_in = jnp.einsum("tec,th->ech", dispatch_l, xt.astype(cfg.activation_dtype))
 
-    if cfg.activation == "silu":
-        hidden = jax.nn.silu(
-            jnp.einsum("ech,ehi->eci", expert_in, moe["gate"])
+    if cfg.gated:
+        hidden = _activate(
+            cfg, jnp.einsum("ech,ehi->eci", expert_in, moe["gate"])
         ) * jnp.einsum("ech,ehi->eci", expert_in, moe["up"])
     else:
-        hidden = jnp.einsum("ech,ehi->eci", expert_in, moe["up"])
-        hidden = jax.nn.gelu(hidden, approximate=cfg.activation == "gelu_tanh")
+        hidden = _activate(cfg, jnp.einsum("ech,ehi->eci", expert_in, moe["up"]))
     expert_out = jnp.einsum("eci,eih->ech", hidden, moe["down"])  # [El, C, h] tp-partial
 
     y = jnp.einsum("tec,ech->th", combine_l.astype(cfg.activation_dtype), expert_out)

@@ -1,0 +1,219 @@
+"""Int8-quantized dense KV cache: half the KV bytes per decode step.
+
+Long-context decode reads the whole cache every step, so KV bytes become the
+bandwidth floor once contexts outgrow the weight set (SURVEY.md §5.7's
+long-context mandate; the reference's HeadInfer paper attacks the same
+problem by offloading heads). Here K/V rows quantize to int8 on write with
+one fp32 scale per (position, kv-head) — absmax over head_dim, the axis
+read back as a contiguous vector — and dequantize inside the attention
+einsum's operand read (the same fuse-into-the-matmul trick as the w8a16
+weight path, ops/int8.py). Accuracy: per-row symmetric int8 on K/V is the
+standard serving configuration (~0.4% relative error per element); the
+parity test pins generated tokens against the bf16 cache on a tiny model.
+
+Same two-program structure as runtime/generate.py, cache threaded through
+``models/transformer._layer_fn``'s pluggable attention hook exactly like the
+paged backend (runtime/paged_generate.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from edgemesh.config import SamplingParams
+from edgemesh.models.transformer import (
+    ModelConfig,
+    _layer_fn,
+    dense,
+    embed_tokens,
+    lm_head_logits,
+    qkv_proj,
+)
+from edgemesh.ops.attention import LayerKV, attend
+from edgemesh.runtime.generate import GenerateResult, generate
+
+INT8_MAX = 127.0
+
+
+class QuantKVCache(NamedTuple):
+    """Whole-model int8 cache: k/v are int8 [L, b, max_seq, kh, hd];
+    k_scale/v_scale fp32 [L, b, max_seq, kh]; lengths [b]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    k_scale: jnp.ndarray
+    v_scale: jnp.ndarray
+    lengths: jnp.ndarray
+
+
+def init_quant_kv_cache(cfg: ModelConfig, batch: int, max_seq: int | None = None) -> QuantKVCache:
+    max_seq = max_seq or cfg.max_seq_len
+    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_size)
+    return QuantKVCache(
+        k=jnp.zeros(shape, jnp.int8),
+        v=jnp.zeros(shape, jnp.int8),
+        k_scale=jnp.zeros(shape[:-1], jnp.float32),
+        v_scale=jnp.zeros(shape[:-1], jnp.float32),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., hd] → (int8 [..., hd], fp32 scale [...]): symmetric absmax over
+    the head_dim vector."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(absmax / INT8_MAX, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequant(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    # Elementwise convert+mul: fuses into the attention einsum's operand
+    # stream, so HBM only ever holds the int8 copy.
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+class _QuantLayerKV(NamedTuple):
+    k: jnp.ndarray
+    v: jnp.ndarray
+    k_scale: jnp.ndarray
+    v_scale: jnp.ndarray
+
+
+def _quant_attention(
+    cfg: ModelConfig,
+    layer,
+    x: jnp.ndarray,  # [b, s, h]
+    positions: jnp.ndarray,  # [b, s]
+    cache: _QuantLayerKV,
+    kv_valid: jnp.ndarray,  # [b, max_seq]
+    lengths: jnp.ndarray,  # [b] decode write offsets
+    is_decode: bool,
+):
+    """Drop-in attention backend for _layer_fn over one layer's int8 cache."""
+    b, s, _ = x.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    q, k, v = qkv_proj(cfg, layer, x, positions)
+    k_q, k_s = quantize_kv(k)
+    v_q, v_s = quantize_kv(v)
+
+    if is_decode:
+        b_idx = jnp.arange(b)[:, None]
+        pos = lengths[:, None] + jnp.arange(s)[None, :]
+        cache = _QuantLayerKV(
+            cache.k.at[b_idx, pos].set(k_q),
+            cache.v.at[b_idx, pos].set(v_q),
+            cache.k_scale.at[b_idx, pos].set(k_s),
+            cache.v_scale.at[b_idx, pos].set(v_s),
+        )
+    else:
+        cache = _QuantLayerKV(
+            cache.k.at[:, :s].set(k_q),
+            cache.v.at[:, :s].set(v_q),
+            cache.k_scale.at[:, :s].set(k_s),
+            cache.v_scale.at[:, :s].set(v_s),
+        )
+
+    dtype = cfg.activation_dtype
+    layer_kv = LayerKV(
+        _dequant(cache.k, cache.k_scale, dtype),
+        _dequant(cache.v, cache.v_scale, dtype),
+    )
+    out = attend(q, layer_kv, positions, kv_valid, sliding_window=cfg.sliding_window)
+    return dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode), cache
+
+
+def _quant_forward(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b, s]
+    positions: jnp.ndarray,
+    cache: QuantKVCache,
+    kv_valid: jnp.ndarray,
+    is_decode: bool,
+):
+    x = embed_tokens(cfg, params, tokens)
+
+    def body(h, scanned):
+        layer, k_l, v_l, ks_l, vs_l = scanned
+        h, new_kv, _aux = _layer_fn(
+            cfg, h, layer, _QuantLayerKV(k_l, v_l, ks_l, vs_l), positions,
+            kv_valid, cache.lengths, is_decode, _quant_attention,
+        )
+        return h, tuple(new_kv)
+
+    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v, cache.k_scale, cache.v_scale)
+    )
+    logits = lm_head_logits(cfg, params, x)
+    return logits, cache._replace(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def forward_prefill_quant(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b, s] right-padded prompts
+    lengths: jnp.ndarray,  # [b]
+    cache: QuantKVCache,
+) -> tuple[jnp.ndarray, QuantKVCache]:
+    b, s = tokens.shape
+    max_seq = cache.k.shape[2]
+    positions = jnp.minimum(
+        jnp.broadcast_to(jnp.arange(s)[None, :], (b, s)), (lengths - 1)[:, None]
+    )
+    kv_valid = jnp.arange(max_seq)[None, :] < lengths[:, None]
+    logits, cache = _quant_forward(
+        cfg, params, tokens, positions, cache, kv_valid, is_decode=False
+    )
+    last = logits[jnp.arange(b), lengths - 1]
+    return last, cache._replace(lengths=lengths)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def forward_decode_quant(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b]
+    cache: QuantKVCache,
+) -> tuple[jnp.ndarray, QuantKVCache]:
+    max_seq = cache.k.shape[2]
+    positions = cache.lengths[:, None]
+    kv_valid = jnp.arange(max_seq)[None, :] <= cache.lengths[:, None]
+    logits, cache = _quant_forward(
+        cfg, params, tokens[:, None], positions, cache, kv_valid, is_decode=True
+    )
+    return logits[:, 0], cache._replace(lengths=cache.lengths + 1)
+
+
+def generate_quant_kv(
+    cfg: ModelConfig,
+    params,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    sampling: SamplingParams,
+    eos_id: int = -1,
+    rng: jax.Array | None = None,
+    cache: QuantKVCache | None = None,
+) -> GenerateResult:
+    """generate() with the int8 KV cache plugged in — validation, timing,
+    and throughput conventions all inherited from runtime.generate."""
+
+    def check_cache(cache, needed):
+        if cache.k.shape[2] < needed:
+            raise ValueError(
+                f"quant KV cache capacity {cache.k.shape[2]} < prompt + max_new = {needed}"
+            )
+
+    return generate(
+        cfg, params, tokens, lengths, sampling, eos_id=eos_id, rng=rng,
+        cache=cache, prefill_fn=forward_prefill_quant,
+        decode_fn=forward_decode_quant,
+        make_cache=lambda c, b, n: init_quant_kv_cache(c, b, n),
+        check_cache=check_cache,
+    )

@@ -213,3 +213,41 @@ def test_mistral_sliding_window_parity(tmp_path):
         is_decode=False,
     )
     assert not np.allclose(np.asarray(ours[0, -1]), hf_logits[0, -1], atol=2e-3)
+
+
+def test_qwen2_parity(tmp_path):
+    """Qwen2: llama dialect + attention qkv biases (+ tied embeddings on the
+    small variants)."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    hf_cfg = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, tie_word_embeddings=True,
+    )
+    torch.manual_seed(5)
+    model = Qwen2ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path)
+    assert cfg.qkv_bias and cfg.tie_embeddings
+    _compare(tmp_path, model)
+
+
+def test_gemma_parity(tmp_path):
+    """Gemma: unit-offset RMSNorm, GeGLU (gated gelu_tanh), sqrt(h)-scaled
+    embeddings, wide fixed head_dim, always-tied head — every dial differs
+    from llama, so this pins all four at once."""
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    hf_cfg = GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=64, rms_norm_eps=1e-5,
+    )
+    torch.manual_seed(6)
+    model = GemmaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path)
+    assert cfg.norm_unit_offset and cfg.gated and cfg.embed_scale
+    assert cfg.head_size == 32 and cfg.tie_embeddings
+    _compare(tmp_path, model)

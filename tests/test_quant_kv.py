@@ -1,0 +1,85 @@
+"""Int8-quantized KV cache backend (runtime/quant_kv.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from edgemesh.config import SamplingParams
+from edgemesh.models.families import tiny_config
+from edgemesh.models.transformer import forward_prefill, init_kv_cache, init_params
+from edgemesh.runtime import generate
+from edgemesh.runtime.quant_kv import (
+    forward_prefill_quant,
+    generate_quant_kv,
+    init_quant_kv_cache,
+    quantize_kv,
+)
+
+
+def test_quantize_kv_roundtrip_bounded():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 4, 16))
+    q, scale = quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.shape == (2, 8, 4)
+    deq = q.astype(jnp.float32) * scale[..., None]
+    err = np.abs(np.asarray(deq - x))
+    # Symmetric absmax quantization: error <= half a step per row.
+    assert (err <= 0.5 * np.asarray(scale)[..., None] + 1e-6).all()
+
+
+def test_prefill_logits_close_to_dense():
+    cfg = tiny_config("llama", vocab_size=128, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray([[5, 9, 2, 7, 11, 3]], jnp.int32)
+    lengths = jnp.asarray([6], jnp.int32)
+
+    ref, _ = forward_prefill(cfg, params, tokens, lengths, init_kv_cache(cfg, 1, 32))
+    got, cache = forward_prefill_quant(
+        cfg, params, tokens, lengths, init_quant_kv_cache(cfg, 1, 32)
+    )
+    assert int(cache.lengths[0]) == 6
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=3e-2, atol=3e-2)
+
+
+def test_generate_matches_dense_greedy():
+    """Greedy decode over the int8 cache reproduces the bf16-cache tokens on
+    the tiny model (deterministic; per-element cache error ~0.4% is far under
+    the typical top-1/top-2 logit gap)."""
+    cfg = tiny_config("llama", vocab_size=128, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray([[5, 9, 2, 7], [3, 1, 0, 0]], jnp.int32)
+    lengths = jnp.asarray([4, 2], jnp.int32)
+    sampling = SamplingParams(max_new_tokens=10, do_sample=False, repetition_penalty=1.0)
+
+    ref = generate(cfg, params, tokens, lengths, sampling)
+    got = generate_quant_kv(cfg, params, tokens, lengths, sampling)
+    np.testing.assert_array_equal(np.asarray(got.tokens), np.asarray(ref.tokens))
+    assert got.decode_tok_s > 0
+
+
+def test_cache_capacity_check():
+    cfg = tiny_config("llama", vocab_size=128, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    small = init_quant_kv_cache(cfg, 1, 8)
+    try:
+        generate_quant_kv(
+            cfg, params, jnp.zeros((1, 6), jnp.int32), jnp.asarray([6], jnp.int32),
+            SamplingParams(max_new_tokens=8, do_sample=False, repetition_penalty=1.0),
+            cache=small,
+        )
+        raise AssertionError("expected capacity ValueError")
+    except ValueError as e:
+        assert "capacity" in str(e)
+
+
+def test_kv_bytes_halved():
+    """The int8 cache (with fp32 scales) stores ~9/16 of the bf16 cache's
+    bytes per slot at head_dim 16 — the point of the backend."""
+    cfg = tiny_config("llama")
+    dense_c = init_kv_cache(cfg, 2, 64)
+    quant_c = init_quant_kv_cache(cfg, 2, 64)
+    dense_bytes = dense_c.k.nbytes + dense_c.v.nbytes
+    quant_bytes = (
+        quant_c.k.nbytes + quant_c.v.nbytes
+        + quant_c.k_scale.nbytes + quant_c.v_scale.nbytes
+    )
+    assert quant_bytes < 0.65 * dense_bytes, (quant_bytes, dense_bytes)
