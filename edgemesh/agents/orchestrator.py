@@ -21,6 +21,7 @@ answers: :356-363) with original wording.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -72,6 +73,12 @@ class Agent:
     draft_cfg: ModelConfig | None = None
     draft_params: Any = None
     spec_gamma: int = 4
+    # Reuse the prompt template's KV across requests (runtime/prefix_cache.py):
+    # single-request answers chunk-append only the question suffix. Exact —
+    # matching is on token ids.
+    prefix_cache: bool = True
+    _prefix: Any = field(default=None, repr=False)
+    _prefix_lock: Any = field(default_factory=threading.Lock, repr=False)
 
     def format_prompt(self, question: str, **extra) -> str:
         return self.prompt_template.format(question=question, **extra)
@@ -120,6 +127,27 @@ class Agent:
     def answer(self, question: str, prompt: str | None = None) -> dict[str, Any]:
         prompts = None if prompt is None else [prompt]
         return self.answer_batch([question], prompts=prompts)[0]
+
+    def _template_prefix(self):
+        """Lazily-built KV cache of the prompt template's static prefix
+        (text before the first placeholder); None when disabled or the
+        prefix is too short to pay for the seeding copy."""
+        if not self.prefix_cache:
+            return None
+        if self._prefix is None:
+            # The REST server answers concurrently (ThreadingHTTPServer);
+            # confine the one-time prefill+compile to a single thread.
+            with self._prefix_lock:
+                if self._prefix is None and self.prefix_cache:
+                    from edgemesh.runtime.prefix_cache import build_prefix_cache
+
+                    static = self.prompt_template.split("{", 1)[0]
+                    ids = self.tokenizer.encode(static) if static else []
+                    if len(ids) < 8:
+                        self.prefix_cache = False
+                        return None
+                    self._prefix = build_prefix_cache(self.cfg, self.params, ids)
+        return self._prefix
 
     def answer_stream(self, question: str, prompt: str | None = None, chunk: int = 16):
         """Yield ``{"delta": str}`` increments as the answer decodes, then a
@@ -213,10 +241,19 @@ class Agent:
                 eos_id=eos_id,
             )
         else:
-            result = generate(
-                self.cfg, self.params, tokens, lengths, self.sampling,
-                eos_id=eos_id,
-            )
+            prefix = self._template_prefix() if n == 1 and tokens.shape[0] == 1 else None
+            if prefix is not None:
+                from edgemesh.runtime.prefix_cache import generate_with_prefix
+
+                result = generate_with_prefix(
+                    self.cfg, self.params, tokens, lengths, self.sampling,
+                    prefix, eos_id=eos_id,
+                )
+            else:
+                result = generate(
+                    self.cfg, self.params, tokens, lengths, self.sampling,
+                    eos_id=eos_id,
+                )
         t_end = time.perf_counter()
         wall = max(t_end - t_start, 1e-9)
         out = []

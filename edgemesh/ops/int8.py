@@ -299,8 +299,13 @@ def int8_matmul_fused(
     n = w_q.shape[1]
     x2 = x.reshape(-1, k)
     m = x2.shape[0]
-    tile_k = next((t for t in (512, 256, 128) if k % t == 0), None)
-    if pl is None or tile_k is None or n % 128 != 0 or m == 0:
+    # Large tiles cut HBM re-reads (each x row band is re-read N/tile_n
+    # times, each w stripe M/tile_m times): measured on-chip at M=2048
+    # (K=2048, N=8192), 128/128/512 tiles ran 22 TF vs 41 TF with
+    # 128/512/2048 — within 10% of the XLA w8a8 path.
+    tile_k = next((t for t in (2048, 1024, 512, 256, 128) if k % t == 0), None)
+    tile_n = next((t for t in (512, 256, 128) if n % t == 0), None)
+    if pl is None or tile_k is None or tile_n is None or m == 0:
         y = int8_matmul_dynamic(x2, w_q, scales)
         return y.reshape(*lead, n)
     # Pad M to the bf16 sublane multiple (16) — 32 for headroom on small
@@ -311,7 +316,8 @@ def int8_matmul_fused(
         x2 = jnp.pad(x2, ((0, m_pad), (0, 0)))
     tile_m = min(128, x2.shape[0])
     y = pallas_int8_matmul(
-        x2, w_q, scales, tile_m=tile_m, tile_k=tile_k, interpret=interpret
+        x2, w_q, scales, tile_m=tile_m, tile_n=tile_n, tile_k=tile_k,
+        interpret=interpret,
     )
     if m_pad:
         y = y[:m]

@@ -165,6 +165,7 @@ def _spmd_attention(
     valid: jnp.ndarray,  # [b, s_local]
     sp: int,
     tp: int,
+    sp_impl: str = "ring",
 ) -> jnp.ndarray:
     b, s, _ = x.shape
     nh_l = cfg.num_heads // tp
@@ -178,9 +179,16 @@ def _spmd_attention(
         q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta, cfg.rope_scaling)
 
-    out = ring_attend_block(
-        q, k, v, positions, valid, axis="sp", sp=sp, pcast_accumulators=False
-    )
+    if sp_impl == "ulysses":
+        from edgemesh.parallel.ulysses import ulysses_attend_block
+
+        out = ulysses_attend_block(q, k, v, positions, valid, axis="sp", sp=sp)
+    elif sp_impl == "ring":
+        out = ring_attend_block(
+            q, k, v, positions, valid, axis="sp", sp=sp, pcast_accumulators=False
+        )
+    else:
+        raise ValueError(f"unknown sp_impl {sp_impl!r}; choose ring or ulysses")
     return _row_dense(layer["o"], out.reshape(b, s, nh_l * hd))
 
 
@@ -244,6 +252,7 @@ def _spmd_layer(
     valid: jnp.ndarray,
     sp: int,
     tp: int,
+    sp_impl: str = "ring",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """One transformer layer → (x, moe aux), all family dials (mirrors
     transformer._layer_fn)."""
@@ -253,11 +262,11 @@ def _spmd_layer(
         mlp_out, aux = _spmd_mlp(cfg, layer, mlp_in)
         return (
             x
-            + _spmd_attention(cfg, layer, attn_in, positions, valid, sp, tp)
+            + _spmd_attention(cfg, layer, attn_in, positions, valid, sp, tp, sp_impl)
             + mlp_out
         ), aux
     x = x + _spmd_attention(
-        cfg, layer, _apply_norm(cfg, layer["attn_norm"], x), positions, valid, sp, tp
+        cfg, layer, _apply_norm(cfg, layer["attn_norm"], x), positions, valid, sp, tp, sp_impl
     )
     mlp_out, aux = _spmd_mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x))
     return x + mlp_out, aux
@@ -268,7 +277,7 @@ def _spmd_layer(
 # ---------------------------------------------------------------------------
 
 
-def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int, moe_aux_weight: float = 0.01):
+def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int, moe_aux_weight: float = 0.01, sp_impl: str = "ring"):
     pp = mesh.shape["pp"]
     sp = mesh.shape["sp"]
     tp = mesh.shape["tp"]
@@ -318,7 +327,7 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int, moe_aux_weight
 
             def layer_step(carry_l, layer):
                 h, aux = carry_l
-                h, a = _spmd_layer(cfg, layer, h, pos, kvv, sp, tp)
+                h, a = _spmd_layer(cfg, layer, h, pos, kvv, sp, tp, sp_impl)
                 return (h, aux + a), None
 
             (h, aux_mb), _ = lax.scan(
@@ -374,14 +383,18 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int, moe_aux_weight
 
 
 def make_spmd_loss(
-    cfg: ModelConfig, mesh: Mesh, num_micro: int = 2, moe_aux_weight: float = 0.01
+    cfg: ModelConfig, mesh: Mesh, num_micro: int = 2, moe_aux_weight: float = 0.01,
+    sp_impl: str = "ring",
 ):
     """Returns loss(params, tokens, lengths) -> scalar, where params follow
     spmd_param_specs layout and tokens are [B, S] split dp x sp. For MoE
     configs the scalar includes ``moe_aux_weight`` x the load-balance aux
-    (same coefficient convention as training.make_train_step)."""
+    (same coefficient convention as training.make_train_step). ``sp_impl``
+    picks the sequence-parallel scheme: "ring" (K/V rotation,
+    parallel/ring_attention.py) or "ulysses" (all-to-all head↔seq exchange,
+    parallel/ulysses.py) — both exact."""
     _check_divisibility(cfg, mesh)
-    device_fn = _make_device_fn(cfg, mesh, num_micro, moe_aux_weight)
+    device_fn = _make_device_fn(cfg, mesh, num_micro, moe_aux_weight, sp_impl)
     specs = spmd_param_specs(cfg)
 
     def loss_fn(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray):

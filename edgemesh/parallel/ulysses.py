@@ -1,0 +1,140 @@
+"""Ulysses-style sequence parallelism: all-to-all head↔sequence exchange.
+
+The second of the two standard sequence/context-parallel schemes (DeepSpeed
+Ulysses; the other — ring attention — is edgemesh/parallel/ring_attention.py).
+Where the ring rotates K/V blocks ``sp`` hops around the mesh and accumulates
+an online softmax, Ulysses performs ONE ``lax.all_to_all`` that re-shards
+activations from sequence-split [b, s/sp, nh, hd] to head-split
+[b, s, nh/sp, hd], runs ordinary full-sequence attention on the local head
+group, and all-to-alls back. Communication volume is O(s·h/sp) per device
+versus the ring's sp hops of O(s/sp·h_kv) — Ulysses wins when heads divide
+cleanly and the interconnect favors fewer, larger transfers; the ring wins
+at very long sequences (K/V blocks stream through VMEM-sized working sets)
+and when num_heads < sp. Both are exact: pinned against the dense op in
+tests/test_ulysses.py.
+
+GQA note: the K/V head exchange needs ``kv_heads % sp == 0``; otherwise K/V
+fall back to an all-gather over the sequence axis (queries still split their
+heads — the common small-GQA regime where replicating the few KV heads is
+cheaper than padding them).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _full_seq_attend(
+    q: jnp.ndarray,  # [b, s, nh_local, hd]
+    k: jnp.ndarray,  # [b, s, kh_local, hd]
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,  # [b, s] global positions
+    k_valid: jnp.ndarray,  # [b, s]
+    scale: float,
+) -> jnp.ndarray:
+    """Ordinary causal attention with explicit key positions (= q_pos: after
+    the all-to-all the local arrays hold the FULL sequence in global order)."""
+    b, s, nh, hd = q.shape
+    kh = k.shape[2]
+    g = nh // kh
+    qg = q.reshape(b, s, kh, g, hd).astype(jnp.float32) * scale
+    scores = jnp.einsum(
+        "bqkgd,bskd->bqkgs", qg, k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    mask = (q_pos[:, None, :] <= q_pos[:, :, None]) & k_valid[:, None, :]  # [b, q, s]
+    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bqkgs,bskd->bqkgd", w, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, s, nh, hd).astype(q.dtype)
+
+
+def ulysses_attend_block(
+    q_blk: jnp.ndarray,  # [b, s/sp, num_heads, head_dim] local seq block
+    k_blk: jnp.ndarray,  # [b, s/sp, kv_heads, head_dim]
+    v_blk: jnp.ndarray,
+    pos_blk: jnp.ndarray,  # [b, s/sp] global positions of the local block
+    valid_blk: jnp.ndarray,  # [b, s/sp]
+    *,
+    axis: str = "sp",
+    sp: int,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Per-device body — callable inside ANY enclosing shard_map carrying the
+    ``axis`` mesh axis (drop-in alternative to ring_attend_block; the 4D SPMD
+    program selects between them via ``sp_impl``)."""
+    b, sq, num_heads, head_dim = q_blk.shape
+    kv_heads = k_blk.shape[2]
+    scale = scale if scale is not None else head_dim**-0.5
+    if sp == 1:
+        return _full_seq_attend(q_blk, k_blk, v_blk, pos_blk, valid_blk, scale)
+    if num_heads % sp:
+        raise ValueError(f"ulysses needs num_heads {num_heads} % sp {sp} == 0")
+
+    # seq-split → head-split: send each head group to its owner; receive the
+    # full sequence (sender order == global block order) for the local group.
+    q_g = lax.all_to_all(q_blk, axis, split_axis=2, concat_axis=1, tiled=True)
+    if kv_heads % sp == 0:
+        # Contiguous alignment: device d's q heads [d·nh/sp, (d+1)·nh/sp)
+        # map onto exactly its kv heads [d·kh/sp, (d+1)·kh/sp) (global head
+        # order is grouped by kv head), so local grouped pairing holds.
+        k_g = lax.all_to_all(k_blk, axis, split_axis=2, concat_axis=1, tiled=True)
+        v_g = lax.all_to_all(v_blk, axis, split_axis=2, concat_axis=1, tiled=True)
+    else:  # small-GQA fallback: replicate the few KV heads across the axis
+        k_all = lax.all_gather(k_blk, axis, axis=1, tiled=True)  # [b, s, kh, hd]
+        v_all = lax.all_gather(v_blk, axis, axis=1, tiled=True)
+        # Select each LOCAL q head's kv head from the full set (the local
+        # block of q heads need not align with a kv-head boundary here).
+        nh_local = num_heads // sp
+        g_global = num_heads // kv_heads
+        head0 = lax.axis_index(axis) * nh_local
+        kv_idx = (head0 + jnp.arange(nh_local)) // g_global  # [nh_local]
+        k_g = jnp.take(k_all, kv_idx, axis=2)  # [b, s, nh_local, hd] (g=1)
+        v_g = jnp.take(v_all, kv_idx, axis=2)
+    pos_g = lax.all_gather(pos_blk, axis, axis=1, tiled=True)  # [b, s]
+    val_g = lax.all_gather(valid_blk, axis, axis=1, tiled=True)
+
+    out = _full_seq_attend(q_g, k_g, v_g, pos_g, val_g, scale)
+    # head-split → seq-split: the inverse exchange.
+    return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [b, seq, num_heads, head_dim] — seq sharded over "sp"
+    k: jnp.ndarray,  # [b, seq, kv_heads, head_dim]
+    v: jnp.ndarray,
+    positions: jnp.ndarray,  # [b, seq] global positions
+    valid: jnp.ndarray,  # [b, seq]
+    mesh: Mesh,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Exact causal attention with the sequence axis sharded over ``sp`` —
+    same contract as ring_attention.ring_attention."""
+    sp = mesh.shape["sp"]
+
+    def local_fn(q_blk, k_blk, v_blk, pos_blk, valid_blk):
+        return ulysses_attend_block(
+            q_blk, k_blk, v_blk, pos_blk, valid_blk, axis="sp", sp=sp, scale=scale
+        )
+
+    seq_spec = P(None, "sp")
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, "sp", None, None),
+            P(None, "sp", None, None),
+            P(None, "sp", None, None),
+            seq_spec,
+            seq_spec,
+        ),
+        out_specs=P(None, "sp", None, None),
+    )(q, k, v, positions, valid)

@@ -1,0 +1,152 @@
+"""Prompt-prefix KV reuse: skip re-prefilling the shared template prefix.
+
+Every QA request in the reference re-runs the full prompt through the model
+(HF ``generate`` per question, ``combiner_fp.py:328-352``), yet the prompt
+template's prefix — everything before the question text — is identical
+across requests. Here the prefix's KV state is computed once and each
+request chunk-appends only its suffix (``transformer.forward_verify``, the
+same one-forward append the speculative decoder uses), cutting TTFT by the
+prefix share of the prompt.
+
+Exactness: matching is on TOKEN ids (longest common prefix between the
+request's tokens and the cached prefix tokens), so byte-level BPE merges
+across the template/question boundary simply shorten the match — the reused
+KV always corresponds to the request's own tokens, and in fp32 the warm
+path's greedy output is bit-identical to the cold path (pinned in tests).
+In bf16 the chunked append reorders reductions relative to the one-shot
+prefill (exactly like chunked prefill in any serving stack), so greedy
+tokens can occasionally flip where top-1/top-2 logits are within rounding —
+semantically equivalent, not bit-equal. Suffixes pad to power-of-two
+buckets to bound jit specializations; padded slots either sit beyond every
+real query's causal horizon during the append or are overwritten by the
+first decode steps, and ``kv_valid`` masks them meanwhile (same argument as
+the speculative rewind protocol).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from edgemesh.config import SamplingParams
+from edgemesh.models.transformer import (
+    KVCache,
+    ModelConfig,
+    forward_prefill,
+    forward_verify,
+    init_kv_cache,
+)
+from edgemesh.runtime.generate import GenerateResult, generate
+
+
+class PrefixCache(NamedTuple):
+    """Cached KV for one token prefix (batch 1, capacity = prefix length)."""
+
+    tokens: np.ndarray  # [L] int32 — the exact prefix token ids
+    k: jnp.ndarray  # [num_layers, 1, L, kv_heads, head_dim]
+    v: jnp.ndarray
+
+    @property
+    def length(self) -> int:
+        return int(self.tokens.shape[0])
+
+
+def build_prefix_cache(cfg: ModelConfig, params, prefix_tokens) -> PrefixCache:
+    """One-time prefill of the shared prefix. ``prefix_tokens``: 1-D ids."""
+    ids = np.asarray(prefix_tokens, np.int32).reshape(-1)
+    L = int(ids.shape[0])
+    if L < 1:
+        raise ValueError("prefix must contain at least one token")
+    cache = init_kv_cache(cfg, 1, L)
+    _, cache = forward_prefill(
+        cfg, params, jnp.asarray(ids)[None, :], jnp.asarray([L], jnp.int32), cache
+    )
+    return PrefixCache(tokens=ids, k=cache.k, v=cache.v)
+
+
+def match_length(prefix: PrefixCache, tokens) -> int:
+    """Longest common TOKEN prefix between the cache and one prompt row,
+    capped so at least one suffix token remains to prefill (forward_verify
+    needs a chunk, and generate needs last-token logits)."""
+    row = np.asarray(tokens, np.int32).reshape(-1)
+    limit = min(prefix.length, row.shape[0] - 1)
+    if limit <= 0:
+        return 0
+    neq = np.nonzero(row[:limit] != prefix.tokens[:limit])[0]
+    return int(neq[0]) if neq.size else int(limit)
+
+
+def _bucket(n: int) -> int:
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+def generate_with_prefix(
+    cfg: ModelConfig,
+    params,
+    tokens: jax.Array,  # [1, s] right-padded prompt (single request)
+    lengths: jax.Array,  # [1]
+    sampling: SamplingParams,
+    prefix: PrefixCache,
+    eos_id: int = -1,
+    rng: jax.Array | None = None,
+    min_match: int = 8,
+) -> GenerateResult:
+    """generate() that warm-starts from the cached prefix KV.
+
+    Single-request path (batch 1 — the Agent.answer shape); falls back to the
+    plain prefill when the prompt shares fewer than ``min_match`` tokens with
+    the cached prefix. Greedy output is token-identical to the cold path."""
+    if tokens.shape[0] != 1:
+        raise ValueError("generate_with_prefix is a single-request (batch 1) path")
+    true_len = int(lengths[0])
+    L = match_length(prefix, np.asarray(tokens[0, :true_len]))
+    if L < min_match:
+        return generate(cfg, params, tokens, lengths, sampling, eos_id=eos_id, rng=rng)
+
+    suffix_len = true_len - L
+    pad_len = _bucket(suffix_len)
+    needed = true_len + int(sampling.max_new_tokens)
+    # generate() validates capacity against the PADDED prompt width
+    # (tokens.shape[1] may exceed true_len under the caller's length
+    # bucketing), so cover whichever is larger.
+    capacity = max(L + pad_len, int(tokens.shape[1])) + int(sampling.max_new_tokens)
+
+    # Seed a right-sized cache with the prefix rows.
+    cache = init_kv_cache(cfg, 1, capacity)
+    cache = KVCache(
+        k=cache.k.at[:, :, :L].set(prefix.k[:, :, :L]),
+        v=cache.v.at[:, :, :L].set(prefix.v[:, :, :L]),
+        lengths=jnp.asarray([L], jnp.int32),
+    )
+    suffix = jnp.zeros((1, pad_len), jnp.int32)
+    suffix = jax.lax.dynamic_update_slice(suffix, tokens[:, L:true_len], (0, 0))
+
+    def prefill_fn(cfg, params, _tokens, _lengths, cache):
+        # Chunk-append the suffix at the prefix boundary; logits at the last
+        # REAL suffix position seed the decode loop. Padded slots beyond it
+        # are invisible (causality) and the decode loop overwrites them.
+        logits_all, cache = forward_verify(cfg, params, suffix, cache)
+        last = logits_all[jnp.arange(1), suffix_len - 1]
+        return last, KVCache(cache.k, cache.v, jnp.asarray([true_len], jnp.int32))
+
+    def check_cache(cache, needed_tokens):
+        if cache.k.shape[2] < needed_tokens:
+            raise ValueError(
+                f"prefix-seeded cache capacity {cache.k.shape[2]} < {needed_tokens}"
+            )
+
+    if needed > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {true_len} + max_new {sampling.max_new_tokens} exceeds "
+            f"max_seq_len {cfg.max_seq_len}"
+        )
+    return generate(
+        cfg, params, tokens, lengths, sampling, eos_id=eos_id, rng=rng,
+        cache=cache, prefill_fn=prefill_fn, check_cache=check_cache,
+    )

@@ -102,22 +102,19 @@ def _quant_attention(
     k_q, k_s = quantize_kv(k)
     v_q, v_s = quantize_kv(v)
 
-    if is_decode:
-        b_idx = jnp.arange(b)[:, None]
-        pos = lengths[:, None] + jnp.arange(s)[None, :]
-        cache = _QuantLayerKV(
-            cache.k.at[b_idx, pos].set(k_q),
-            cache.v.at[b_idx, pos].set(v_q),
-            cache.k_scale.at[b_idx, pos].set(k_s),
-            cache.v_scale.at[b_idx, pos].set(v_s),
-        )
-    else:
-        cache = _QuantLayerKV(
-            cache.k.at[:, :s].set(k_q),
-            cache.v.at[:, :s].set(v_q),
-            cache.k_scale.at[:, :s].set(k_s),
-            cache.v_scale.at[:, :s].set(v_s),
-        )
+    # write_prefill/write_decode centralize the scatter index arithmetic; the
+    # indexing is agnostic to trailing dims, so the [.., kh] scale arrays ride
+    # the same helpers as the [.., kh, hd] data arrays.
+    from edgemesh.ops.attention import write_decode, write_prefill
+
+    write = (
+        (lambda c, a, b2: write_decode(c, a, b2, lengths))
+        if is_decode
+        else write_prefill
+    )
+    data = write(LayerKV(cache.k, cache.v), k_q, v_q)
+    scale = write(LayerKV(cache.k_scale, cache.v_scale), k_s, v_s)
+    cache = _QuantLayerKV(data.k, data.v, scale.k, scale.v)
 
     dtype = cfg.activation_dtype
     layer_kv = LayerKV(
@@ -214,6 +211,6 @@ def generate_quant_kv(
         cfg, params, tokens, lengths, sampling, eos_id=eos_id, rng=rng,
         cache=cache, prefill_fn=forward_prefill_quant,
         decode_fn=forward_decode_quant,
-        make_cache=lambda c, b, n: init_quant_kv_cache(c, b, n),
+        make_cache=init_quant_kv_cache,
         check_cache=check_cache,
     )
