@@ -64,12 +64,12 @@ def cmd_eval(cfg: EdgeMeshConfig) -> int:
     return 0
 
 
-def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0) -> int:
+def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool = False) -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.serve import serve_rest
 
     ensemble = build_ensemble(cfg)
-    serve_rest(ensemble, port=port, batch=batch)
+    serve_rest(ensemble, port=port, batch=batch, continuous=continuous)
     return 0
 
 
@@ -174,6 +174,11 @@ def main(argv: list[str] | None = None) -> int:
         help="serve: coalesce up to N concurrent requests into one decode",
     )
     top.add_argument(
+        "--continuous", action="store_true",
+        help="serve: chunk-granular continuous batching (single-agent "
+        "ensembles; --batch sizes the slot pool)",
+    )
+    top.add_argument(
         "--preset", type=str, default=None,
         help="bench: model preset (validated by the bench command)",
     )
@@ -197,7 +202,7 @@ def main(argv: list[str] | None = None) -> int:
     if cmd_args.command == "eval":
         return cmd_eval(cfg)
     if cmd_args.command == "serve":
-        return cmd_serve(cfg, cmd_args.port, cmd_args.batch)
+        return cmd_serve(cfg, cmd_args.port, cmd_args.batch, cmd_args.continuous)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     if cmd_args.command == "train":

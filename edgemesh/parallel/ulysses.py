@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-NEG_INF = -1e30
+from edgemesh.ops.attention import LayerKV, attend
 
 
 def _full_seq_attend(
@@ -37,24 +37,15 @@ def _full_seq_attend(
     k_valid: jnp.ndarray,  # [b, s]
     scale: float,
 ) -> jnp.ndarray:
-    """Ordinary causal attention with explicit key positions (= q_pos: after
-    the all-to-all the local arrays hold the FULL sequence in global order)."""
-    b, s, nh, hd = q.shape
-    kh = k.shape[2]
-    g = nh // kh
-    qg = q.reshape(b, s, kh, g, hd).astype(jnp.float32) * scale
-    scores = jnp.einsum(
-        "bqkgd,bskd->bqkgs", qg, k.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
-    mask = (q_pos[:, None, :] <= q_pos[:, :, None]) & k_valid[:, None, :]  # [b, q, s]
-    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
-    w = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum(
-        "bqkgs,bskd->bqkgd", w, v.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
-    return out.reshape(b, s, nh, hd).astype(q.dtype)
+    """Full-sequence causal attention on the local head group — the dense op
+    (ops/attention.attend) applied to the gathered arrays.
+
+    Contract: after the all-to-all the local K/V hold the FULL sequence in
+    global slot order, and the sequence-split layout puts position ``j`` in
+    slot ``j`` (positions are ``block_start + arange`` per shard — true for
+    every consumer: the 4D SPMD program and the top-level wrapper below), so
+    attend's slot-index causal mask is exactly the position mask."""
+    return attend(q, LayerKV(k, v), q_pos, k_valid, scale=scale)
 
 
 def ulysses_attend_block(

@@ -1,0 +1,245 @@
+"""Continuous batching: requests join and leave the decode loop mid-flight.
+
+The DynamicBatcher (serve/batcher.py) forms a batch, runs it to COMPLETION,
+then forms the next — a request arriving one token after dispatch waits out
+the whole previous batch. Real serving engines instead keep one resident
+decode loop whose batch composition changes as requests arrive/finish
+(vLLM-style continuous batching). A statically-shaped jitted TPU loop cannot
+admit rows mid-program, but the segmented decode (runtime/stream.py) already
+re-enters the host every ``chunk`` tokens — so edgemesh does continuous
+batching at CHUNK granularity:
+
+- A fixed pool of ``slots`` rows shares one KV cache and one compiled
+  ``_decode_loop`` program (static shapes: one compile, reused forever).
+- Between segments, free slots admit queued requests: the prompt prefills
+  as a batch-of-1 (its own small compiled program) and its cache rows /
+  logits / repetition mask SPLICE into the shared state at the slot index.
+- Rows that hit EOS or their token budget retire at the segment boundary:
+  their text resolves the caller's Future and the slot frees. Inactive
+  slots ride along masked as ``finished`` (the loop writes nothing for
+  them) — the standard static-shape tax.
+
+Worst-case admission latency is one segment (``chunk`` tokens ≈ tens of ms)
+instead of a full answer (hundreds of tokens).
+
+Interface-compatible with DynamicBatcher (submit/answer/close/stats), so
+``serve_rest`` takes either.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from edgemesh.models.transformer import KVCache, forward_decode, forward_prefill, init_kv_cache
+from edgemesh.ops.sampling import TokenMaskState
+from edgemesh.runtime.generate import _decode_loop
+
+log = logging.getLogger("edgemesh.serve")
+
+
+@dataclass
+class _Slot:
+    future: Future | None = None
+    question: str = ""
+    emitted: list[int] = field(default_factory=list)
+    remaining: int = 0
+    t_submit: float = 0.0
+    t_start: float = 0.0
+
+    @property
+    def active(self) -> bool:
+        return self.future is not None
+
+
+class ContinuousEngine:
+    """Chunk-granular continuous batcher over one Agent's model."""
+
+    def __init__(self, agent, slots: int = 8, chunk: int = 16, idle_wait_s: float = 0.005):
+        self.agent = agent
+        self.cfg = agent.cfg
+        self.chunk = int(chunk)
+        self.n_slots = int(slots)
+        if self.chunk < 1 or self.n_slots < 1:
+            raise ValueError("slots and chunk must be >= 1")
+        self._queue: deque[tuple[str, Future, float]] = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._slots = [_Slot() for _ in range(self.n_slots)]
+        cap = self.cfg.max_seq_len
+        self._cache = init_kv_cache(self.cfg, self.n_slots, cap)
+        # fp32, NOT activation dtype: sampling must see the same logits the
+        # solo decode path sees, or bf16 rounding flips near-tied greedy
+        # tokens versus agent.answer.
+        self._logits = jnp.zeros((self.n_slots, self.cfg.vocab_size), jnp.float32)
+        self._mask = TokenMaskState.init(self.n_slots, self.cfg.vocab_size).mask
+        self._finished = jnp.ones((self.n_slots,), bool)  # all slots idle
+        self._rng = jax.random.PRNGKey(agent.sampling.seed)
+        # Stats for /metrics and tests.
+        self.requests = 0
+        self.segments = 0
+        self.admitted_mid_flight = 0
+        self.max_concurrent = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # -- public interface (DynamicBatcher-compatible) -----------------------
+
+    def submit(self, question: str) -> Future:
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            self._queue.append((question, fut, time.perf_counter()))
+            self.requests += 1
+            self._cond.notify()
+        return fut
+
+    def answer(self, question: str) -> dict[str, Any]:
+        return self.submit(question).result()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._worker.join(timeout=10)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "requests": self.requests,
+            "segments": self.segments,
+            "admitted_mid_flight": self.admitted_mid_flight,
+            "max_concurrent": self.max_concurrent,
+            "slots": self.n_slots,
+            "chunk": self.chunk,
+        }
+
+    # -- engine loop --------------------------------------------------------
+
+    def _admit(self, idx: int, question: str, fut: Future, t_submit: float, mid_flight: bool):
+        """Prefill one request and splice its state into slot ``idx``."""
+        agent = self.agent
+        prompt = agent.format_prompt(question)
+        tokens, lengths, _ = agent._prepare_batch([prompt])
+        cap = self._cache.k.shape[2]
+        row_cache = init_kv_cache(self.cfg, 1, cap)
+        logits1, row_cache = forward_prefill(self.cfg, agent.params, tokens, lengths, row_cache)
+        valid = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
+        mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
+
+        self._cache = KVCache(
+            k=self._cache.k.at[:, idx].set(row_cache.k[:, 0]),
+            v=self._cache.v.at[:, idx].set(row_cache.v[:, 0]),
+            lengths=self._cache.lengths.at[idx].set(lengths[0]),
+        )
+        self._logits = self._logits.at[idx].set(logits1[0].astype(self._logits.dtype))
+        self._mask = self._mask.at[idx].set(mask1[0])
+        self._finished = self._finished.at[idx].set(False)
+        budget = int(agent.sampling.max_new_tokens)
+        budget = min(budget, int(self.cfg.max_seq_len) - int(lengths[0]))
+        self._slots[idx] = _Slot(
+            future=fut, question=question, emitted=[], remaining=budget,
+            t_submit=t_submit, t_start=time.perf_counter(),
+        )
+        if mid_flight:
+            self.admitted_mid_flight += 1
+
+    def _retire(self, idx: int):
+        slot = self._slots[idx]
+        tokenizer = self.agent.tokenizer
+        text = tokenizer.decode(jnp.asarray(slot.emitted, jnp.int32)) if slot.emitted else ""
+        now = time.perf_counter()
+        wall = max(now - slot.t_start, 1e-9)
+        slot.future.set_result(
+            {
+                "answer": text.strip(),
+                "role": self.agent.role,
+                "tps": len(slot.emitted) / wall,
+                "queue_s": slot.t_start - slot.t_submit,
+                "t_start": slot.t_start,
+                "t_end": now,
+            }
+        )
+        self._slots[idx] = _Slot()
+        self._finished = self._finished.at[idx].set(True)
+
+    def _run(self) -> None:
+        agent = self.agent
+        eos_id = int(getattr(agent.tokenizer, "eos_id", -1))
+        any_active_before = False
+        while True:
+            # Admit as many queued requests as there are free slots.
+            with self._cond:
+                while not self._queue and not any(s.active for s in self._slots):
+                    if self._closed:
+                        return
+                    self._cond.wait()
+                pending: list[tuple[str, Future, float]] = []
+                free = [i for i, s in enumerate(self._slots) if not s.active]
+                while self._queue and free and len(pending) < len(free):
+                    pending.append(self._queue.popleft())
+            for (q, fut, ts), idx in zip(
+                pending, [i for i, s in enumerate(self._slots) if not s.active]
+            ):
+                try:
+                    self._admit(idx, q, fut, ts, mid_flight=any_active_before)
+                except Exception as exc:
+                    # Fail only THIS request: already-admitted slots keep
+                    # their pending futures (poisoning them would make the
+                    # later _retire set_result raise InvalidStateError and
+                    # kill the worker).
+                    log.exception("admission failed for %r", q[:80])
+                    if not fut.done():
+                        fut.set_exception(exc)
+
+            active = [i for i, s in enumerate(self._slots) if s.active]
+            self.max_concurrent = max(self.max_concurrent, len(active))
+            any_active_before = bool(active)
+            if not active:
+                continue
+
+            # One decode segment over the whole pool; idle rows are finished.
+            # Segment length is ALWAYS ``chunk`` so _decode_loop compiles
+            # exactly once; a row whose budget ends mid-segment overshoots by
+            # < chunk forwards and the extras are trimmed host-side.
+            self._rng, seg_rng = jax.random.split(self._rng)
+            out, counts, self._cache, _, self._mask, prev, fin = _decode_loop(
+                self.cfg, agent.params, agent.sampling, self.chunk, eos_id,
+                self._logits, self._cache, self._mask, seg_rng, None,
+                self._finished,
+            )
+            self.segments += 1
+            counts_h = jax.device_get(counts)
+            out_h = jax.device_get(out)
+            fin_h = jax.device_get(fin)
+            self._finished = fin
+            for i in active:
+                slot = self._slots[i]
+                n = min(int(counts_h[i]), max(slot.remaining, 0))
+                toks = [int(t) for t in out_h[i][:n]]
+                if toks and toks[-1] == eos_id:
+                    toks = toks[:-1]
+                slot.emitted.extend(toks)
+                slot.remaining -= n
+                if bool(fin_h[i]) or slot.remaining <= 0:
+                    self._retire(i)
+
+            # Bridge into the next segment for rows still going (the loop
+            # stops before a wasted trailing forward; run it for the batch).
+            if any(s.active for s in self._slots):
+                logits, self._cache = forward_decode(self.cfg, agent.params, prev, self._cache)
+                self._logits = logits.astype(self._logits.dtype)
+
+            # Give stragglers a brief window to queue before the next segment
+            # (they join at the boundary either way; this just batches admits).
+            with self._cond:
+                if not self._queue and any(s.active for s in self._slots):
+                    self._cond.wait(timeout=0.001)

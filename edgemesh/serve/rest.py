@@ -145,7 +145,8 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
 
 
 def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = True,
-               supervisor=None, batch: int = 0, batch_wait_s: float = 0.02):
+               supervisor=None, batch: int = 0, batch_wait_s: float = 0.02,
+               continuous: bool = False):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
@@ -155,9 +156,31 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     batched decode (serve/batcher.py). With BOTH, each coalesced batch routes
     through ``supervisor.call`` as one request (failure tracking and restarts
     stay engaged) — the supervisor's handler must accept a list of questions
-    and return a list of results."""
+    and return a list of results.
+
+    ``continuous=True`` (single-QA-agent ensembles only) swaps the batch-
+    then-drain batcher for the chunk-granular ContinuousEngine
+    (serve/continuous.py): requests join/leave the resident decode loop at
+    segment boundaries; ``batch`` sizes the slot pool."""
     batcher = None
-    if batch > 1:
+    if continuous:
+        from edgemesh.serve.continuous import ContinuousEngine
+
+        if supervisor is not None:
+            raise ValueError(
+                "continuous batching does not route through the supervisor "
+                "(its failure tracking would be silently bypassed); use "
+                "--batch with a supervisor, or continuous without one"
+            )
+        if len(ensemble.qa_agents) != 1 or ensemble.refiner is not None:
+            raise ValueError(
+                "continuous batching serves a single-QA-agent ensemble "
+                f"(got {len(ensemble.qa_agents)} agents"
+                f"{' + refiner' if ensemble.refiner else ''}); use --batch "
+                "for multi-agent ensembles"
+            )
+        batcher = ContinuousEngine(ensemble.qa_agents[0], slots=batch or 8)
+    elif batch > 1:
         from edgemesh.serve.batcher import DynamicBatcher
 
         backend = ensemble.answer_batch if supervisor is None else supervisor.call
