@@ -82,8 +82,6 @@ class TPInferenceEngine:
                 f"heads {cfg.num_heads}/{cfg.num_kv_heads} and FFN "
                 f"{cfg.intermediate_size} must divide tp={tp}"
             )
-        if cfg.num_experts > 0:
-            raise NotImplementedError("MoE rides the ep axis (ops/moe.py), not this engine")
         if attention_impl is None:
             attention_impl = (
                 "flash" if on_tpu() else cfg.attention_impl

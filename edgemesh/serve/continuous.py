@@ -39,11 +39,31 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from functools import partial
+
 from edgemesh.models.transformer import KVCache, forward_decode, forward_prefill, init_kv_cache
 from edgemesh.ops.sampling import TokenMaskState
 from edgemesh.runtime.generate import _decode_loop
 
 log = logging.getLogger("edgemesh.serve")
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def _splice_slot(
+    pool_k, pool_v, pool_len, pool_logits, pool_mask, pool_finished,
+    row_k, row_v, row_len, row_logits, row_mask, idx,
+):
+    """In-place (donated) insertion of one prefilled request into the shared
+    pool state at slot ``idx`` — an eager .at[].set here would copy the whole
+    multi-GB pool per admission."""
+    return (
+        pool_k.at[:, idx].set(row_k[:, 0]),
+        pool_v.at[:, idx].set(row_v[:, 0]),
+        pool_len.at[idx].set(row_len),
+        pool_logits.at[idx].set(row_logits.astype(pool_logits.dtype)),
+        pool_mask.at[idx].set(row_mask),
+        pool_finished.at[idx].set(False),
+    )
 
 
 @dataclass
@@ -135,14 +155,13 @@ class ContinuousEngine:
         valid = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
         mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
 
-        self._cache = KVCache(
-            k=self._cache.k.at[:, idx].set(row_cache.k[:, 0]),
-            v=self._cache.v.at[:, idx].set(row_cache.v[:, 0]),
-            lengths=self._cache.lengths.at[idx].set(lengths[0]),
+        k, v, ln, self._logits, self._mask, self._finished = _splice_slot(
+            self._cache.k, self._cache.v, self._cache.lengths,
+            self._logits, self._mask, self._finished,
+            row_cache.k, row_cache.v, lengths[0], logits1[0], mask1[0],
+            jnp.asarray(idx, jnp.int32),
         )
-        self._logits = self._logits.at[idx].set(logits1[0].astype(self._logits.dtype))
-        self._mask = self._mask.at[idx].set(mask1[0])
-        self._finished = self._finished.at[idx].set(False)
+        self._cache = KVCache(k=k, v=v, lengths=ln)
         budget = int(agent.sampling.max_new_tokens)
         budget = min(budget, int(self.cfg.max_seq_len) - int(lengths[0]))
         self._slots[idx] = _Slot(
@@ -209,34 +228,45 @@ class ContinuousEngine:
             # One decode segment over the whole pool; idle rows are finished.
             # Segment length is ALWAYS ``chunk`` so _decode_loop compiles
             # exactly once; a row whose budget ends mid-segment overshoots by
-            # < chunk forwards and the extras are trimmed host-side.
-            self._rng, seg_rng = jax.random.split(self._rng)
-            out, counts, self._cache, _, self._mask, prev, fin = _decode_loop(
-                self.cfg, agent.params, agent.sampling, self.chunk, eos_id,
-                self._logits, self._cache, self._mask, seg_rng, None,
-                self._finished,
-            )
-            self.segments += 1
-            counts_h = jax.device_get(counts)
-            out_h = jax.device_get(out)
-            fin_h = jax.device_get(fin)
-            self._finished = fin
-            for i in active:
-                slot = self._slots[i]
-                n = min(int(counts_h[i]), max(slot.remaining, 0))
-                toks = [int(t) for t in out_h[i][:n]]
-                if toks and toks[-1] == eos_id:
-                    toks = toks[:-1]
-                slot.emitted.extend(toks)
-                slot.remaining -= n
-                if bool(fin_h[i]) or slot.remaining <= 0:
-                    self._retire(i)
+            # < chunk forwards and the extras are trimmed host-side. A
+            # failure anywhere in the segment must not kill the worker —
+            # fail the in-flight futures, reset the pool, keep serving.
+            try:
+                self._rng, seg_rng = jax.random.split(self._rng)
+                out, counts, self._cache, _, self._mask, prev, fin = _decode_loop(
+                    self.cfg, agent.params, agent.sampling, self.chunk, eos_id,
+                    self._logits, self._cache, self._mask, seg_rng, None,
+                    self._finished,
+                )
+                self.segments += 1
+                counts_h = jax.device_get(counts)
+                out_h = jax.device_get(out)
+                fin_h = jax.device_get(fin)
+                self._finished = fin
+                for i in active:
+                    slot = self._slots[i]
+                    n = min(int(counts_h[i]), max(slot.remaining, 0))
+                    toks = [int(t) for t in out_h[i][:n]]
+                    if toks and toks[-1] == eos_id:
+                        toks = toks[:-1]
+                    slot.emitted.extend(toks)
+                    slot.remaining -= n
+                    if bool(fin_h[i]) or slot.remaining <= 0:
+                        self._retire(i)
 
-            # Bridge into the next segment for rows still going (the loop
-            # stops before a wasted trailing forward; run it for the batch).
-            if any(s.active for s in self._slots):
-                logits, self._cache = forward_decode(self.cfg, agent.params, prev, self._cache)
-                self._logits = logits.astype(self._logits.dtype)
+                # Bridge into the next segment for rows still going (the loop
+                # stops before a wasted trailing forward; run it for the batch).
+                if any(s.active for s in self._slots):
+                    logits, self._cache = forward_decode(self.cfg, agent.params, prev, self._cache)
+                    self._logits = logits.astype(self._logits.dtype)
+            except Exception as exc:
+                log.exception("decode segment failed; failing %d in-flight requests", len(active))
+                for i in active:
+                    fut = self._slots[i].future
+                    if fut is not None and not fut.done():
+                        fut.set_exception(exc)
+                    self._slots[i] = _Slot()
+                self._finished = jnp.ones((self.n_slots,), bool)
 
             # Give stragglers a brief window to queue before the next segment
             # (they join at the boundary either way; this just batches admits).

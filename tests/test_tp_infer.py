@@ -148,3 +148,23 @@ def test_tp_int4(devices, group_size):
     cache = eng.init_cache(2, 16)
     got, _ = eng.prefill(tokens, lengths, cache)
     np.testing.assert_allclose(np.asarray(got, np.float32), ref, rtol=2e-2, atol=2e-2)
+
+
+def test_tp_moe(devices):
+    """MoE under the per-shard TP engine: the router is replicated (identical
+    top-k on every shard), expert FFN widths split over tp, and the
+    down-projection partials psum-join — prefill must match the single-device
+    MoE forward."""
+    cfg = _cfg("llama", hidden_size=32, intermediate_size=64, dtype="float32").replace(
+        num_experts=4, experts_per_token=2, expert_capacity_factor=4.0
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, cfg.vocab_size)
+    lengths = jnp.array([6, 4])
+    ref = _ref_last_logits(cfg, params, tokens, lengths, 16)
+
+    mesh = build_mesh(dp=1, tp=4)
+    eng = TPInferenceEngine(cfg, params, mesh, attention_impl="xla")
+    cache = eng.init_cache(2, 16)
+    got, _ = eng.prefill(tokens, lengths, cache)
+    np.testing.assert_allclose(np.asarray(got, np.float32), ref, rtol=2e-2, atol=2e-2)
