@@ -416,7 +416,27 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
 
         params = quantize_params_int4(params, group_size=ms.int4_group_size)
     elif ms.precision in ("int8", "int8_w8a8", "int8_w8a8_pallas"):
-        params = quantize_params(params)
+        if ms.calibration:
+            if ms.precision == "int8":
+                # Weight-only (w8a16) keeps activations in fp: smoothing has
+                # no activation quantization to help and the W*s inflation
+                # coarsens the WEIGHT quantization — strictly worse. Refuse
+                # rather than silently degrade.
+                raise ValueError(
+                    "calibration (SmoothQuant) only benefits the w8a8 "
+                    "precisions; use precision: int8_w8a8 or int8_w8a8_pallas"
+                )
+            from edgemesh.models.tokenizer import encode_batch
+            from edgemesh.ops.smoothquant import calibrate_and_quantize
+
+            with open(ms.calibration) as f:
+                prompts = [line.strip() for line in f if line.strip()]
+            if not prompts:
+                raise ValueError(f"calibration file {ms.calibration!r} has no prompts")
+            ctoks, clens = encode_batch(tokenizer, prompts, max_len=cfg.max_seq_len)
+            params = calibrate_and_quantize(cfg, params, ctoks, clens)
+        else:
+            params = quantize_params(params)
         # "int8" = weight-only (w8a16); the suffixed variants run activations
         # in int8 too — XLA dynamic quant or the fused Pallas kernel.
         if ms.precision != "int8":

@@ -88,6 +88,11 @@ class ModelSpec:
     # Int4 scale granularity: 0 = per-channel (fastest), g>0 = grouped
     # (GPTQ/AWQ-style quality remedy; must be even). See ops/int4.py.
     int4_group_size: int = 64
+    # SmoothQuant calibration for int8 precisions: path to a text file of
+    # calibration prompts (one per line). When set, quantization smooths
+    # activation outliers into the weights using these prompts' statistics
+    # (ops/smoothquant.py). Empty = plain quantization.
+    calibration: str = ""
     # Quantize the token embedding to int8 alongside int8/int4 precisions
     # (ops/int8.quantize_embedding). With tied embeddings the LM head reads
     # the whole table every decode step, so this halves that stream; off by

@@ -66,3 +66,19 @@ def load_tokenizer(path: str | Path | None):
     if path:
         return HFTokenizer(path)
     return ByteTokenizer()
+
+
+def encode_batch(tokenizer, texts: list[str], max_len: int | None = None):
+    """Tokenize + right-pad a text batch → (tokens [n, width] int32,
+    lengths [n] int32). The shared encode/pad idiom of the agent batcher,
+    the training corpus builder, and SmoothQuant calibration."""
+    import jax.numpy as jnp
+
+    ids_list = [tokenizer.encode(t, max_len=max_len) for t in texts]
+    width = max(len(ids) for ids in ids_list)
+    pad = getattr(tokenizer, "pad_id", 0)
+    tokens = jnp.asarray(
+        [ids + [pad] * (width - len(ids)) for ids in ids_list], jnp.int32
+    )
+    lengths = jnp.asarray([len(ids) for ids in ids_list], jnp.int32)
+    return tokens, lengths
