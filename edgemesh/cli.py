@@ -8,6 +8,8 @@ Subcommands:
 - ``download`` — checkpoint verify/materialize (downloader parity, offline)
 - ``train``    — finetuning loop over the QA corpus (beyond reference parity:
                  its roadmap's "After Finetuning" rows were never started)
+- ``compare``  — paired bootstrap comparison of two eval runs (the
+                 spreadsheet the reference eyeballed, with error bars)
 """
 
 from __future__ import annotations
@@ -166,6 +168,15 @@ def cmd_train(cfg: EdgeMeshConfig) -> int:
 def main(argv: list[str] | None = None) -> int:
     _honor_platform_env()
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "compare":
+        # Own argument shape (two positional JSONL paths) — handled before
+        # the shared parser, whose config-mirror options don't apply.
+        from edgemesh.eval.compare import compare_runs
+
+        if len(argv) != 3:
+            raise SystemExit("usage: edgemesh compare <runA.jsonl> <runB.jsonl>")
+        print(json.dumps(compare_runs(argv[1], argv[2])))
+        return 0
     top = argparse.ArgumentParser(prog="edgemesh")
     top.add_argument("command", choices=["eval", "serve", "bench", "download", "train"])
     top.add_argument("--port", type=int, default=8000)

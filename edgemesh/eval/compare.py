@@ -1,0 +1,93 @@
+"""Paired comparison of two evaluation runs with bootstrap confidence bands.
+
+The reference compared configurations by pasting aggregate logs into a
+spreadsheet and eyeballing deltas (``Others/Distributed LLM Evaluations and
+Results - Partha.xlsx``, the system of record for its Tables 1–3) — no
+per-sample pairing, no uncertainty. This module does the comparison
+properly: rows pair by sample ``index`` (both runs score the SAME
+questions), the per-metric delta is the mean of per-sample differences, and
+a paired bootstrap over samples gives a 95% interval — so "ensemble beats
+single" or "int8 preserves quality" (the paper's Tables 1–2 claims) become
+statements with error bars instead of bare means.
+
+``python -m edgemesh.cli compare a.jsonl b.jsonl`` prints one JSON report.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+# Quality/latency metrics eligible for comparison (numeric row fields).
+METRICS = (
+    "rouge1", "rouge2", "rougeL", "avg_rouge",
+    "bertscore", "bleu", "cosine", "confidence", "tps",
+)
+
+
+def load_rows(path: str | Path) -> dict[int, dict]:
+    rows: dict[int, dict] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                row = json.loads(line)
+                rows[int(row["index"])] = row
+    if not rows:
+        raise ValueError(f"{path} contains no result rows")
+    return rows
+
+
+def compare_runs(
+    path_a: str | Path,
+    path_b: str | Path,
+    metrics: tuple[str, ...] = METRICS,
+    n_boot: int = 2000,
+    seed: int = 0,
+) -> dict:
+    """Paired per-metric comparison of run B against run A (delta = B − A).
+
+    Returns {metric: {a, b, delta, ci95: [lo, hi], better}} over the common
+    sample indices, plus pairing bookkeeping. ``better`` is True when the
+    95% interval clears zero in B's favor, False when it clears in A's,
+    None when the interval spans zero (no significant difference)."""
+    rows_a = load_rows(path_a)
+    rows_b = load_rows(path_b)
+    common = sorted(set(rows_a) & set(rows_b))
+    if not common:
+        raise ValueError("runs share no sample indices — nothing to pair")
+    rng = np.random.default_rng(seed)
+    out: dict = {
+        "n_common": len(common),
+        "only_a": len(rows_a) - len(common),
+        "only_b": len(rows_b) - len(common),
+        "metrics": {},
+    }
+    for m in metrics:
+        # Rows are allowed to be heterogeneous (the harness only writes tps/
+        # confidence when the answer_fn reports them, while zero-filled error
+        # rows carry every key) — pair only indices where BOTH runs have the
+        # metric instead of trusting the first row.
+        paired = [
+            i for i in common if m in rows_a[i] and m in rows_b[i]
+        ]
+        if not paired:
+            continue
+        a = np.asarray([float(rows_a[i][m]) for i in paired])
+        b = np.asarray([float(rows_b[i][m]) for i in paired])
+        d = b - a
+        boot_idx = rng.integers(0, len(paired), size=(n_boot, len(paired)))
+        boots = d[boot_idx].mean(axis=1)
+        lo, hi = float(np.quantile(boots, 0.025)), float(np.quantile(boots, 0.975))
+        better = True if lo > 0 else False if hi < 0 else None
+        out["metrics"][m] = {
+            "a": round(float(a.mean()), 6),
+            "b": round(float(b.mean()), 6),
+            "delta": round(float(d.mean()), 6),
+            "ci95": [round(lo, 6), round(hi, 6)],
+            "better": better,
+            "n": len(paired),
+        }
+    return out

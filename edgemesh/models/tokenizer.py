@@ -69,9 +69,10 @@ def load_tokenizer(path: str | Path | None):
 
 
 def encode_batch(tokenizer, texts: list[str], max_len: int | None = None):
-    """Tokenize + right-pad a text batch → (tokens [n, width] int32,
-    lengths [n] int32). The shared encode/pad idiom of the agent batcher,
-    the training corpus builder, and SmoothQuant calibration."""
+    """Tokenize + right-pad a text batch to the batch max → (tokens
+    [n, width] int32, lengths [n] int32). Used by SmoothQuant calibration;
+    the agent batcher and training builder keep their own padding (they pad
+    to shape BUCKETS, not the batch max, to bound jit specializations)."""
     import jax.numpy as jnp
 
     ids_list = [tokenizer.encode(t, max_len=max_len) for t in texts]

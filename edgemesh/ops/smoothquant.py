@@ -53,28 +53,27 @@ def collect_activation_scales(
     kv_valid = jnp.arange(s)[None, :] < lengths[:, None]
     token_valid = kv_valid[..., None]  # [b, s, 1] — exclude pad rows from stats
 
+    from edgemesh.models.transformer import _mlp
+
+    # One pass: attention inputs read from the norm directly; MLP inputs
+    # captured via _layer_fn's pluggable mlp hook, which sees the exact
+    # tensor the gate/up denses consume — including the sequential
+    # families' norm(x + attn_out), which only exists mid-layer.
+    mlp_stats: list[jnp.ndarray] = []
+
+    def capturing_mlp(cfg_, layer_, x_):
+        mlp_stats.append(_channel_absmax(x_, token_valid))
+        return _mlp(cfg_, layer_, x_)
+
     x = embed_tokens(cfg, params, tokens)
-    attn_stats, mlp_stats = [], []
+    attn_stats = []
     for i in range(L):
         layer = jax.tree.map(lambda a: a[i], params["layers"])
         attn_in = _apply_norm(cfg, layer["attn_norm"], x)
         attn_stats.append(_channel_absmax(attn_in, token_valid))
-        if cfg.parallel_block:
-            mlp_in = attn_in if cfg.shared_input_norm else _apply_norm(
-                cfg, layer["mlp_norm"], x
-            )
-            mlp_stats.append(_channel_absmax(mlp_in, token_valid))
         x, _, _ = _layer_fn(
             cfg, x, layer, LayerKV(cache.k[i], cache.v[i]), positions,
-            kv_valid, cache.lengths, False,
-        )
-
-    if not cfg.parallel_block:
-        # Sequential families norm the POST-attention residual, which only
-        # exists mid-layer — a second pass with a capturing mlp hook records
-        # the exact inputs (cheap; calibration is offline).
-        mlp_stats = _collect_sequential_mlp_inputs(
-            cfg, params, tokens, positions, kv_valid, token_valid
+            kv_valid, cache.lengths, False, mlp=capturing_mlp,
         )
 
     out: Params = {
@@ -90,29 +89,6 @@ def collect_activation_scales(
 
 def _channel_absmax(x: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     return jnp.max(jnp.abs(x.astype(jnp.float32)) * valid, axis=(0, 1))
-
-
-def _collect_sequential_mlp_inputs(cfg, params, tokens, positions, kv_valid, token_valid):
-    """Second pass with a capturing mlp hook: records norm(x + attn_out) —
-    the exact input the MLP denses see in sequential (Llama-style) blocks."""
-    from edgemesh.models.transformer import _mlp
-
-    b, s = tokens.shape
-    cache = init_kv_cache(cfg, b, s)
-    captured: list[jnp.ndarray] = []
-
-    def capturing_mlp(cfg_, layer_, x_):
-        captured.append(_channel_absmax(x_, token_valid))
-        return _mlp(cfg_, layer_, x_)
-
-    x = embed_tokens(cfg, params, tokens)
-    for i in range(cfg.num_layers):
-        layer = jax.tree.map(lambda a: a[i], params["layers"])
-        x, _, _ = _layer_fn(
-            cfg, x, layer, LayerKV(cache.k[i], cache.v[i]), positions,
-            kv_valid, cache.lengths, False, mlp=capturing_mlp,
-        )
-    return captured
 
 
 def calibrate_and_quantize(

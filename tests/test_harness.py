@@ -154,3 +154,45 @@ def test_batched_eval_zero_fills_failed_batch(tmp_path):
     )
     assert calls == [["q0", "q1"]]
     assert report2["num_samples"] == 4
+
+
+def test_compare_runs(tmp_path):
+    """Paired bootstrap comparison (eval/compare.py): a uniformly-better run
+    B clears the interval; identical runs show no significant difference."""
+    import json
+
+    import numpy as np
+
+    from edgemesh.eval.compare import compare_runs
+
+    rng = np.random.default_rng(0)
+    a_path, b_path = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    with open(a_path, "w") as fa, open(b_path, "w") as fb:
+        for i in range(100):
+            base = float(rng.uniform(0.2, 0.4))
+            row = {"index": i, "rouge1": base, "bleu": base / 2, "tps": 100.0}
+            fa.write(json.dumps(row) + "\n")
+            fb.write(json.dumps({**row, "rouge1": base + 0.05}) + "\n")
+    rep = compare_runs(a_path, b_path)
+    assert rep["n_common"] == 100
+    r1 = rep["metrics"]["rouge1"]
+    assert r1["better"] is True and r1["ci95"][0] > 0
+    assert abs(r1["delta"] - 0.05) < 1e-9
+    assert rep["metrics"]["bleu"]["better"] is None  # identical
+    assert rep["metrics"]["tps"]["better"] is None
+
+
+def test_compare_cli(tmp_path, capsys):
+    import json
+
+    from edgemesh.cli import main
+
+    p1, p2 = tmp_path / "r1.jsonl", tmp_path / "r2.jsonl"
+    for p in (p1, p2):
+        with open(p, "w") as f:
+            for i in range(5):
+                f.write(json.dumps({"index": i, "rouge1": 0.3, "bleu": 0.1}) + "\n")
+    rc = main(["compare", str(p1), str(p2)])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["n_common"] == 5
