@@ -178,7 +178,7 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(compare_runs(argv[1], argv[2])))
         return 0
     top = argparse.ArgumentParser(prog="edgemesh")
-    top.add_argument("command", choices=["eval", "serve", "bench", "download", "train"])
+    top.add_argument("command", choices=["eval", "serve", "bench", "download", "train", "compare"])
     top.add_argument("--port", type=int, default=8000)
     top.add_argument(
         "--batch", type=int, default=0,
@@ -210,6 +210,13 @@ def main(argv: list[str] | None = None) -> int:
     cfg = load_config(args.config, overrides)
     _setup_logging(cfg)
 
+    if cmd_args.command == "compare":
+        # Normally intercepted before the parser (its args are two plain
+        # paths); reaching here means flags preceded the command.
+        raise SystemExit(
+            "usage: edgemesh compare <runA.jsonl> <runB.jsonl> "
+            "(compare must be the first argument)"
+        )
     if cmd_args.command == "eval":
         return cmd_eval(cfg)
     if cmd_args.command == "serve":

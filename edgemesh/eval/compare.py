@@ -67,11 +67,17 @@ def compare_runs(
     }
     for m in metrics:
         # Rows are allowed to be heterogeneous (the harness only writes tps/
-        # confidence when the answer_fn reports them, while zero-filled error
-        # rows carry every key) — pair only indices where BOTH runs have the
-        # metric instead of trusting the first row.
+        # confidence when the answer_fn reports them) — pair only indices
+        # where BOTH runs have the metric instead of trusting the first row.
+        # Zero-filled ERROR rows are excluded outright: their 0.0 "scores"
+        # are infra failures, and pairing them against real scores would
+        # report a significant quality delta that is actually an OOM (the
+        # harness likewise refuses to resume from error rows).
         paired = [
-            i for i in common if m in rows_a[i] and m in rows_b[i]
+            i
+            for i in common
+            if m in rows_a[i] and m in rows_b[i]
+            and "error" not in rows_a[i] and "error" not in rows_b[i]
         ]
         if not paired:
             continue

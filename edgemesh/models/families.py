@@ -82,6 +82,20 @@ FAMILY_PRESETS: dict[str, dict] = {
         lm_head_bias=False,
         tie_embeddings=True,
     ),
+    # Phi-3: the llama dialect (RMSNorm/SwiGLU/GQA/full rotary, no biases,
+    # untied head) with FUSED qkv_proj and gate_up_proj checkpoint weights
+    # (split at ingest) and an always-on sliding window (mini-4k: 2047).
+    "phi3": dict(
+        norm="rms",
+        activation="silu",
+        parallel_block=False,
+        shared_input_norm=False,
+        rotary_fraction=1.0,
+        qkv_bias=False,
+        out_bias=False,
+        lm_head_bias=False,
+        tie_embeddings=False,
+    ),
     # Gemma (v1): RMSNorm with unit offset (weights store scale-1), GeGLU
     # (gated gelu_tanh MLP), embeddings scaled by sqrt(hidden), wide fixed
     # head_dim (256 — NOT hidden/heads), always-tied LM head.
@@ -108,6 +122,7 @@ _HF_MODEL_TYPE_TO_FAMILY = {
     "mistral": "mistral",
     "qwen2": "qwen2",
     "gemma": "gemma",
+    "phi3": "phi3",
 }
 
 

@@ -97,10 +97,11 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
     with open(ckpt / "config.json") as f:
         hf = json.load(f)
 
-    if family in ("llama", "mistral", "qwen2", "gemma"):
+    if family in ("llama", "mistral", "qwen2", "gemma", "phi3"):
         # One config dialect: mistral adds sliding-window attention, qwen2
         # adds qkv biases (preset), gemma adds unit-offset norms / GeGLU /
-        # embed scaling (preset) and a wide fixed head_dim.
+        # embed scaling (preset) and a wide fixed head_dim, phi3 adds fused
+        # checkpoint weights (split at load) + an always-on sliding window.
         kw = dict(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -132,6 +133,8 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
                 )
         elif family == "gemma":
             kw["head_dim"] = int(hf.get("head_dim", 256))
+        elif family == "phi3":
+            kw["sliding_window"] = int(hf.get("sliding_window") or 0)
         kw.update(_rope_scaling_kw(hf, ckpt))
     elif family == "neox":
         kw = dict(
@@ -165,7 +168,7 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
         raise ValueError(family)
     rs = hf.get("rope_scaling") or {}
     rs_type = rs.get("rope_type", rs.get("type", ""))
-    if family not in ("llama", "mistral", "qwen2", "gemma") and rs and rs_type not in ("default", "none", ""):
+    if family not in ("llama", "mistral", "qwen2", "gemma", "phi3") and rs and rs_type not in ("default", "none", ""):
         # The neox/phi2 forward paths don't consume a scaling block; ignoring
         # a frequency-changing one would silently produce wrong logits for a
         # long-context variant. No-op types (newer HF configs emit
@@ -202,7 +205,9 @@ def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) ->
     dtype = dtype or cfg.activation_dtype
     raw = _load_raw_tensors(ckpt)
 
-    if family in ("llama", "mistral", "qwen2", "gemma"):  # identical weight naming
+    if family == "phi3":
+        params = _map_llama(raw, cfg, dtype, presplit=_split_phi3_fused)
+    elif family in ("llama", "mistral", "qwen2", "gemma"):  # identical weight naming
         params = _map_llama(raw, cfg, dtype)
     elif family == "neox":
         params = _map_neox(raw, cfg, dtype)
@@ -214,7 +219,28 @@ def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) ->
 # -- per-family name maps ----------------------------------------------------
 
 
-def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
+def _split_phi3_fused(raw: dict[str, np.ndarray], cfg: ModelConfig) -> dict[str, np.ndarray]:
+    """Phi-3 fuses attention qkv and MLP gate/up in the checkpoint
+    (``qkv_proj.weight`` rows [q; k; v], ``gate_up_proj.weight`` rows
+    [gate; up]); split them into the llama naming so one map serves both."""
+    nh, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    inter = cfg.intermediate_size
+    out = dict(raw)
+    for i in range(cfg.num_layers):
+        qkv = out.pop(f"model.layers.{i}.self_attn.qkv_proj.weight")
+        q_rows, k_rows = nh * hd, kh * hd
+        out[f"model.layers.{i}.self_attn.q_proj.weight"] = qkv[:q_rows]
+        out[f"model.layers.{i}.self_attn.k_proj.weight"] = qkv[q_rows : q_rows + k_rows]
+        out[f"model.layers.{i}.self_attn.v_proj.weight"] = qkv[q_rows + k_rows :]
+        gu = out.pop(f"model.layers.{i}.mlp.gate_up_proj.weight")
+        out[f"model.layers.{i}.mlp.gate_proj.weight"] = gu[:inter]
+        out[f"model.layers.{i}.mlp.up_proj.weight"] = gu[inter:]
+    return out
+
+
+def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype, presplit=None) -> Params:
+    if presplit is not None:
+        raw = presplit(raw, cfg)
     L = cfg.num_layers
 
     def layer_stack(fmt: str, transpose: bool) -> jnp.ndarray:

@@ -251,3 +251,23 @@ def test_gemma_parity(tmp_path):
     assert cfg.norm_unit_offset and cfg.gated and cfg.embed_scale
     assert cfg.head_size == 32 and cfg.tie_embeddings
     _compare(tmp_path, model)
+
+
+def test_phi3_parity(tmp_path):
+    """Phi-3: llama dialect with FUSED checkpoint weights (qkv_proj,
+    gate_up_proj — split at ingest) and an always-on sliding window."""
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    hf_cfg = Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, sliding_window=8,
+        pad_token_id=0,  # default 32000 asserts against tiny vocabs
+    )
+    torch.manual_seed(7)
+    model = Phi3ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path)
+    assert cfg.sliding_window == 8 and not cfg.tie_embeddings
+    # seq=12 > window=8 so the window actually masks history.
+    _compare(tmp_path, model, seq=12)
