@@ -203,6 +203,14 @@ def main(argv: list[str] | None = None) -> int:
         help="download: local HF hub cache to materialize checkpoints from",
     )
     cmd_args, rest = top.parse_known_args(argv)
+    if cmd_args.command == "compare":
+        # Normally intercepted before the parser (its args are two plain
+        # paths); reaching here means flags preceded the command — reject
+        # BEFORE config loading so a bad --config cannot mask the message.
+        raise SystemExit(
+            "usage: edgemesh compare <runA.jsonl> <runB.jsonl> "
+            "(compare must be the first argument)"
+        )
 
     parser = build_arg_parser()
     args, _ = parser.parse_known_args(rest)
@@ -210,13 +218,6 @@ def main(argv: list[str] | None = None) -> int:
     cfg = load_config(args.config, overrides)
     _setup_logging(cfg)
 
-    if cmd_args.command == "compare":
-        # Normally intercepted before the parser (its args are two plain
-        # paths); reaching here means flags preceded the command.
-        raise SystemExit(
-            "usage: edgemesh compare <runA.jsonl> <runB.jsonl> "
-            "(compare must be the first argument)"
-        )
     if cmd_args.command == "eval":
         return cmd_eval(cfg)
     if cmd_args.command == "serve":

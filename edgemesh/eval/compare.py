@@ -58,9 +58,24 @@ def compare_runs(
     common = sorted(set(rows_a) & set(rows_b))
     if not common:
         raise ValueError("runs share no sample indices — nothing to pair")
+    # Zero-filled ERROR rows are excluded outright: their 0.0 "scores" are
+    # infra failures, and pairing them against real scores would report a
+    # significant quality delta that is actually an OOM (the harness
+    # likewise refuses to resume from error rows). The exclusion is COUNTED
+    # so a mostly-failed run cannot masquerade as a clean comparison.
+    clean = [
+        i for i in common
+        if "error" not in rows_a[i] and "error" not in rows_b[i]
+    ]
+    if not clean:
+        raise ValueError(
+            f"all {len(common)} paired rows carry errors in at least one "
+            "run — nothing comparable; re-run the evals"
+        )
     rng = np.random.default_rng(seed)
     out: dict = {
         "n_common": len(common),
+        "excluded_error_rows": len(common) - len(clean),
         "only_a": len(rows_a) - len(common),
         "only_b": len(rows_b) - len(common),
         "metrics": {},
@@ -69,16 +84,7 @@ def compare_runs(
         # Rows are allowed to be heterogeneous (the harness only writes tps/
         # confidence when the answer_fn reports them) — pair only indices
         # where BOTH runs have the metric instead of trusting the first row.
-        # Zero-filled ERROR rows are excluded outright: their 0.0 "scores"
-        # are infra failures, and pairing them against real scores would
-        # report a significant quality delta that is actually an OOM (the
-        # harness likewise refuses to resume from error rows).
-        paired = [
-            i
-            for i in common
-            if m in rows_a[i] and m in rows_b[i]
-            and "error" not in rows_a[i] and "error" not in rows_b[i]
-        ]
+        paired = [i for i in clean if m in rows_a[i] and m in rows_b[i]]
         if not paired:
             continue
         a = np.asarray([float(rows_a[i][m]) for i in paired])

@@ -120,20 +120,21 @@ def test_missing_snapshot_raises(tmp_path):
 
 
 def test_quantized_params_roundtrip(tmp_path):
-    """Serving restarts restore quantized trees byte-exactly — int8 AND the
-    narrower int4 (XLA s4) dtype survive the orbax roundtrip."""
+    """Serving restarts restore quantized trees byte-exactly — int8, the
+    nibble-packed int4 layout, and the int8 embedding all survive the orbax
+    roundtrip."""
     import numpy as np
 
     from edgemesh.models.families import tiny_config
     from edgemesh.models.transformer import init_params
     from edgemesh.ops.int4 import quantize_params_int4
-    from edgemesh.ops.int8 import quantize_params
+    from edgemesh.ops.int8 import quantize_embedding, quantize_params
     from edgemesh.runtime.checkpoint import restore_pytree, save_pytree
 
     cfg = tiny_config("llama", vocab_size=64)
     params = init_params(cfg, jax.random.PRNGKey(0))
     for name, q in (
-        ("int8", quantize_params(params)),
+        ("int8", quantize_embedding(quantize_params(params))),
         ("int4", quantize_params_int4(params, group_size=32)),
     ):
         path = tmp_path / name

@@ -196,3 +196,34 @@ def test_compare_cli(tmp_path, capsys):
     assert rc == 0
     rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rep["n_common"] == 5
+
+
+def test_compare_excludes_error_rows(tmp_path):
+    """Zero-filled error rows (infra failures) must not read as quality
+    deltas: they are excluded per-row and COUNTED in the report."""
+    import json
+
+    from edgemesh.eval.compare import compare_runs
+
+    a_path, b_path = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    with open(a_path, "w") as fa, open(b_path, "w") as fb:
+        for i in range(20):
+            row_a = {"index": i, "rouge1": 0.3}
+            if i < 5:  # run A failed on the first five samples
+                row_a = {"index": i, "rouge1": 0.0, "error": "OOM"}
+            fa.write(json.dumps(row_a) + "\n")
+            fb.write(json.dumps({"index": i, "rouge1": 0.3}) + "\n")
+    rep = compare_runs(a_path, b_path)
+    assert rep["excluded_error_rows"] == 5
+    r1 = rep["metrics"]["rouge1"]
+    assert r1["n"] == 15 and r1["better"] is None  # clean rows are identical
+
+    # All-error pairing refuses outright.
+    allerr = tmp_path / "err.jsonl"
+    with open(allerr, "w") as f:
+        for i in range(20):
+            f.write(json.dumps({"index": i, "rouge1": 0.0, "error": "OOM"}) + "\n")
+    import pytest
+
+    with pytest.raises(ValueError, match="carry errors"):
+        compare_runs(allerr, allerr)

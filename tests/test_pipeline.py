@@ -88,3 +88,33 @@ def test_uneven_layer_split_rejected():
     mesh = build_mesh(pp=4, tp=2)
     with pytest.raises(ValueError, match="divisible"):
         PipelineEngine(cfg, params, mesh)
+
+
+def test_pipeline_int8_quantized(devices):
+    """Quantized trees (int8 + int8 embedding) ride the pp layer split: the
+    stacked kernel_q/scales leaves shard over pp like their bf16 kernels and
+    greedy output matches the single-device quantized model."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from edgemesh.config import SamplingParams
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_params
+    from edgemesh.ops.int8 import quantize_embedding, quantize_params
+    from edgemesh.parallel.mesh import build_mesh
+    from edgemesh.parallel.pipeline import PipelineEngine
+    from edgemesh.runtime import generate
+
+    cfg = tiny_config("llama", num_layers=4, vocab_size=128, dtype="float32",
+                      tie_embeddings=True)
+    params = quantize_embedding(quantize_params(init_params(cfg, jax.random.PRNGKey(0))))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, cfg.vocab_size)
+    lengths = jnp.array([5, 5])
+
+    ref = generate(cfg, params, tokens, lengths,
+                   SamplingParams(max_new_tokens=6, do_sample=False, repetition_penalty=1.0))
+    mesh = build_mesh(pp=2)
+    eng = PipelineEngine(cfg, params, mesh, num_micro=2, attention_impl="xla")
+    got = eng.generate_greedy(tokens, lengths, max_new=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref.tokens))
