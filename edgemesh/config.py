@@ -60,7 +60,7 @@ class ModelSpec:
     """One model to materialize on the mesh.
 
     ``family`` selects the architecture dialect (llama / neox / phi2 / mistral /
-    qwen2 / gemma / phi3); ``auto``
+    qwen2 / gemma / gemma2 / phi3); ``auto``
     sniffs it from the checkpoint's HF config.json. ``precision`` mirrors the
     reference's base-vs-quant runner pairs (fp16/bf16 loaders in
     ``Code/Base Models``, int8 in ``Code/Quantised Models``).
@@ -70,7 +70,7 @@ class ModelSpec:
     # HF hub id for `edgemesh download --src <hub-cache>` materialization
     # (e.g. "microsoft/phi-2"); defaults to the basename of ``path``.
     hub_id: str = ""
-    family: str = "auto"  # auto | llama | neox | phi2 | mistral | qwen2 | gemma | phi3
+    family: str = "auto"  # auto | llama | neox | phi2 | mistral | qwen2 | gemma | gemma2 | phi3
     # bf16 | fp16 | fp32 | int8 (weight-only w8a16) | int8_w8a8 (dynamic
     # activation quant, int8xint8 MXU) | int8_w8a8_pallas (fused kernel)
     precision: str = "bf16"

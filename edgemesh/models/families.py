@@ -113,6 +113,28 @@ FAMILY_PRESETS: dict[str, dict] = {
         lm_head_bias=False,
         tie_embeddings=True,
     ),
+    # Gemma 2: gemma's dials PLUS post-sublayer norms, attention-score and
+    # final-logit soft caps, a fixed query scale, and sliding windows on
+    # alternate (even) layers only. The flash kernel stays off (the score
+    # soft-cap only exists in the XLA attend).
+    "gemma2": dict(
+        norm="rms",
+        norm_unit_offset=True,
+        activation="gelu_tanh",
+        gated_mlp=True,
+        embed_scale=True,
+        post_block_norms=True,
+        parallel_block=False,
+        shared_input_norm=False,
+        rotary_fraction=1.0,
+        qkv_bias=False,
+        out_bias=False,
+        lm_head_bias=False,
+        tie_embeddings=True,
+        alt_sliding_window=True,
+        attn_soft_cap=50.0,
+        logit_soft_cap=30.0,
+    ),
 }
 
 _HF_MODEL_TYPE_TO_FAMILY = {
@@ -122,6 +144,7 @@ _HF_MODEL_TYPE_TO_FAMILY = {
     "mistral": "mistral",
     "qwen2": "qwen2",
     "gemma": "gemma",
+    "gemma2": "gemma2",
     "phi3": "phi3",
 }
 

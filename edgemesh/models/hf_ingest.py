@@ -97,7 +97,7 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
     with open(ckpt / "config.json") as f:
         hf = json.load(f)
 
-    if family in ("llama", "mistral", "qwen2", "gemma", "phi3"):
+    if family in ("llama", "mistral", "qwen2", "gemma", "gemma2", "phi3"):
         # One config dialect: mistral adds sliding-window attention, qwen2
         # adds qkv biases (preset), gemma adds unit-offset norms / GeGLU /
         # embed scaling (preset) and a wide fixed head_dim, phi3 adds fused
@@ -112,7 +112,7 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
             max_seq_len=min(hf.get("max_position_embeddings", 4096), 8192),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             norm_eps=hf.get("rms_norm_eps", 1e-5),
-            tie_embeddings=hf.get("tie_word_embeddings", family == "gemma"),
+            tie_embeddings=hf.get("tie_word_embeddings", family in ("gemma", "gemma2")),
         )
         if family == "mistral":
             # null in newer configs (full attention); 4096 on the 7B v0.1.
@@ -133,6 +133,12 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
                 )
         elif family == "gemma":
             kw["head_dim"] = int(hf.get("head_dim", 256))
+        elif family == "gemma2":
+            kw["head_dim"] = int(hf.get("head_dim", 256))
+            kw["sliding_window"] = int(hf.get("sliding_window") or 0)
+            kw["query_pre_attn_scalar"] = float(hf.get("query_pre_attn_scalar", 256))
+            kw["attn_soft_cap"] = float(hf.get("attn_logit_softcapping") or 0.0)
+            kw["logit_soft_cap"] = float(hf.get("final_logit_softcapping") or 0.0)
         elif family == "phi3":
             kw["sliding_window"] = int(hf.get("sliding_window") or 0)
         kw.update(_rope_scaling_kw(hf, ckpt))
@@ -168,7 +174,7 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
         raise ValueError(family)
     rs = hf.get("rope_scaling") or {}
     rs_type = rs.get("rope_type", rs.get("type", ""))
-    if family not in ("llama", "mistral", "qwen2", "gemma", "phi3") and rs and rs_type not in ("default", "none", ""):
+    if family not in ("llama", "mistral", "qwen2", "gemma", "gemma2", "phi3") and rs and rs_type not in ("default", "none", ""):
         # The neox/phi2 forward paths don't consume a scaling block; ignoring
         # a frequency-changing one would silently produce wrong logits for a
         # long-context variant. No-op types (newer HF configs emit
@@ -207,7 +213,7 @@ def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) ->
 
     if family == "phi3":
         params = _map_llama(raw, cfg, dtype, presplit=_split_phi3_fused)
-    elif family in ("llama", "mistral", "qwen2", "gemma"):  # identical weight naming
+    elif family in ("llama", "mistral", "qwen2", "gemma", "gemma2"):  # identical weight naming
         params = _map_llama(raw, cfg, dtype)
     elif family == "neox":
         params = _map_neox(raw, cfg, dtype)
@@ -257,6 +263,16 @@ def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype, presplit=Non
         "up": {"kernel": layer_stack("model.layers.{}.mlp.up_proj.weight", True)},
         "down": {"kernel": layer_stack("model.layers.{}.mlp.down_proj.weight", True)},
     }
+    if "model.layers.0.post_feedforward_layernorm.weight" in raw:  # Gemma-2
+        layers["mlp_norm"] = {
+            "scale": layer_stack("model.layers.{}.pre_feedforward_layernorm.weight", False)
+        }
+        layers["attn_post_norm"] = {
+            "scale": layer_stack("model.layers.{}.post_attention_layernorm.weight", False)
+        }
+        layers["mlp_post_norm"] = {
+            "scale": layer_stack("model.layers.{}.post_feedforward_layernorm.weight", False)
+        }
     if "model.layers.0.self_attn.q_proj.bias" in raw:  # Qwen2 qkv biases
         for name, proj in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj")):
             layers[name]["bias"] = layer_stack(

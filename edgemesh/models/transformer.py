@@ -63,6 +63,16 @@ class ModelConfig:
     embed_scale: bool = False
     parallel_block: bool = False  # Phi-2/NeoX style: attn & mlp from one input
     shared_input_norm: bool = False  # Phi-2: ONE norm feeds both attn and mlp
+    # Gemma-2: extra norms on the SUBLAYER OUTPUTS before the residual adds
+    # (post_attention_layernorm / post_feedforward_layernorm, with the MLP
+    # input normed by pre_feedforward_layernorm) — params attn_post_norm /
+    # mlp_post_norm alongside attn_norm / mlp_norm.
+    post_block_norms: bool = False
+    # Gemma-2: attention-score soft cap (attn_logit_softcapping, 50.0) and a
+    # fixed query scale (query_pre_attn_scalar^-0.5 instead of head_dim^-0.5;
+    # 0 = default head_dim scaling).
+    attn_soft_cap: float = 0.0
+    query_pre_attn_scalar: float = 0.0
     rotary_fraction: float = 1.0
     rope_theta: float = 10000.0
     # HF rope_scaling block (Llama-3.x context extension): "" = none.
@@ -83,6 +93,10 @@ class ModelConfig:
     # window (O(s*w) prefill MXU work; paged-page DMAs still walk the whole
     # table — the grid is static).
     sliding_window: int = 0
+    # Gemma-2: the window applies only to ALTERNATE layers (even layers
+    # sliding, odd layers full attention). The layer scan runs over PAIRS so
+    # each half keeps a STATIC window. Requires even num_layers.
+    alt_sliding_window: bool = False
 
     # Mixture of Experts (0 experts = dense MLP). The expert dim shards over
     # the mesh's "ep" axis; see ops/moe.py.
@@ -127,6 +141,16 @@ class ModelConfig:
         # Round to even; HF families use even rotary dims (e.g. Phi-2: 32).
         rd = int(self.head_size * self.rotary_fraction)
         return rd - (rd % 2)
+
+    @property
+    def query_scale(self) -> float | None:
+        """Attention score scale: Gemma-2's fixed query_pre_attn_scalar^-0.5
+        when set, else None (attend defaults to head_dim^-0.5). EVERY attend
+        caller must consume this — a backend using the default scale on a
+        fixed-scale config produces silently wrong logits."""
+        if self.query_pre_attn_scalar > 0:
+            return self.query_pre_attn_scalar**-0.5
+        return None
 
     @property
     def gated(self) -> bool:
@@ -207,6 +231,9 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
         }
         if not cfg.shared_input_norm:
             layer["mlp_norm"] = _norm_init(cfg, dtype)
+        if cfg.post_block_norms:
+            layer["attn_post_norm"] = _norm_init(cfg, dtype)
+            layer["mlp_post_norm"] = _norm_init(cfg, dtype)
         if cfg.num_experts > 0:
             from edgemesh.ops.moe import init_moe_layer
 
@@ -331,6 +358,10 @@ def _use_flash(cfg: ModelConfig) -> bool:
     (shard_map bodies, where pallas sees local arrays) opts in explicitly
     with attention_impl="flash".
     """
+    if cfg.attn_soft_cap > 0 or cfg.query_pre_attn_scalar > 0:
+        # Gemma-2 score soft-cap / fixed query scale: only the XLA attend
+        # implements them; the flash kernel would silently skip the cap.
+        return False
     if cfg.attention_impl == "xla":
         return False
     if cfg.attention_impl == "flash":
@@ -387,7 +418,10 @@ def _attention(
             sliding_window=cfg.sliding_window,
         )
     else:
-        out = attend(q, cache, positions, kv_valid, sliding_window=cfg.sliding_window)
+        out = attend(
+            q, cache, positions, kv_valid, scale=cfg.query_scale,
+            sliding_window=cfg.sliding_window, soft_cap=cfg.attn_soft_cap,
+        )
     return dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode), cache
 
 
@@ -422,13 +456,19 @@ def _layer_fn(
                                        kv_valid=kv_valid, lengths=lengths, is_decode=is_decode)
         mlp_out, aux = mlp(cfg, layer, mlp_in)
         return x + attn_out + mlp_out, layer_kv, aux
-    # Sequential (Llama): x += attn(norm(x)); x += mlp(norm(x))
+    # Sequential (Llama): x += attn(norm(x)); x += mlp(norm(x)).
+    # Gemma-2 (post_block_norms) additionally norms each sublayer OUTPUT
+    # before its residual add: x += post_norm(attn(norm(x))) etc.
     attn_out, layer_kv = attention(
         cfg, layer, _apply_norm(cfg, layer["attn_norm"], x), positions,
         cache=layer_kv, kv_valid=kv_valid, lengths=lengths, is_decode=is_decode,
     )
+    if cfg.post_block_norms:
+        attn_out = _apply_norm(cfg, layer["attn_post_norm"], attn_out)
     x = x + attn_out
     mlp_out, aux = mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x))
+    if cfg.post_block_norms:
+        mlp_out = _apply_norm(cfg, layer["mlp_post_norm"], mlp_out)
     return x + mlp_out, layer_kv, aux
 
 
@@ -494,19 +534,54 @@ def _scan_layers(
     (lm_head_logits applies the final norm) plus cache and moe aux."""
     x = embed_tokens(cfg, params, tokens)
 
-    def body(carry, scanned):
-        h, aux_sum = carry
-        layer, k_l, v_l = scanned
+    def one_layer(fn_cfg, h, layer, k_l, v_l):
         fn = _layer_fn
         if cfg.remat:
             fn = jax.checkpoint(fn, static_argnums=(0, 7, 8, 9))
-        h, new_kv, aux = fn(cfg, h, layer, LayerKV(k_l, v_l), positions, kv_valid,
-                            cache.lengths, is_decode, attention, mlp)
-        return (h, aux_sum + aux), (new_kv.k, new_kv.v)
+        return fn(fn_cfg, h, layer, LayerKV(k_l, v_l), positions, kv_valid,
+                  cache.lengths, is_decode, attention, mlp)
 
-    (x, aux_sum), (new_k, new_v) = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), (params["layers"], cache.k, cache.v)
-    )
+    if cfg.alt_sliding_window and cfg.sliding_window > 0:
+        # Gemma-2: even layers sliding, odd layers full attention. Scanning
+        # PAIRS keeps the window a STATIC per-call constant (one compiled
+        # pair body) instead of a traced per-layer value.
+        if cfg.num_layers % 2:
+            raise ValueError(
+                f"alt_sliding_window needs even num_layers, got {cfg.num_layers}"
+            )
+        full_cfg = cfg.replace(sliding_window=0)
+
+        def pair(a):
+            return a.reshape(cfg.num_layers // 2, 2, *a.shape[1:])
+
+        def body(carry, scanned):
+            h, aux_sum = carry
+            layer2, k2, v2 = scanned  # leaves [2, ...]
+            even = jax.tree.map(lambda a: a[0], layer2)
+            odd = jax.tree.map(lambda a: a[1], layer2)
+            h, kv_e, aux_e = one_layer(cfg, h, even, k2[0], v2[0])
+            h, kv_o, aux_o = one_layer(full_cfg, h, odd, k2[1], v2[1])
+            return (h, aux_sum + aux_e + aux_o), (
+                jnp.stack([kv_e.k, kv_o.k]), jnp.stack([kv_e.v, kv_o.v])
+            )
+
+        (x, aux_sum), (new_k, new_v) = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)),
+            (jax.tree.map(pair, params["layers"]), pair(cache.k), pair(cache.v)),
+        )
+        new_k = new_k.reshape(cfg.num_layers, *new_k.shape[2:])
+        new_v = new_v.reshape(cfg.num_layers, *new_v.shape[2:])
+    else:
+
+        def body(carry, scanned):
+            h, aux_sum = carry
+            layer, k_l, v_l = scanned
+            h, new_kv, aux = one_layer(cfg, h, layer, k_l, v_l)
+            return (h, aux_sum + aux), (new_kv.k, new_kv.v)
+
+        (x, aux_sum), (new_k, new_v) = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), (params["layers"], cache.k, cache.v)
+        )
     new_lengths = jnp.max(positions, axis=1) + 1
     return x, KVCache(new_k, new_v, new_lengths), aux_sum
 

@@ -66,12 +66,15 @@ def attend(
     kv_valid: jnp.ndarray,  # [b, max_seq] bool — slots containing real tokens
     scale: float | None = None,
     sliding_window: int = 0,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Causal attention of queries against the full cache.
 
     Returns [b, s, num_heads, head_dim] in q's dtype. A cache slot j is visible
     to query at position p iff it holds a real token and j <= p — and, with
     ``sliding_window`` w > 0 (Mistral), additionally j > p - w.
+    ``soft_cap`` > 0 (Gemma-2) squashes scores to cap·tanh(score/cap) before
+    masking.
     """
     b, s, num_heads, head_dim = q.shape
     kv_heads = cache.k.shape[2]
@@ -85,6 +88,8 @@ def attend(
     scores = jnp.einsum(
         "bskgd,bmkd->bskgm", qg, cache.k, preferred_element_type=jnp.float32
     ) * scale
+    if soft_cap > 0:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
     max_seq = cache.k.shape[1]
     slot_pos = jnp.arange(max_seq)[None, None, :]  # [1, 1, m]
     causal = slot_pos <= q_positions[:, :, None]  # [b, s, m]

@@ -165,6 +165,11 @@ class PipelineEngine:
         pp = mesh.shape["pp"]
         if pp < 2:
             raise ValueError("PipelineEngine needs a pp axis of size >= 2")
+        if cfg.alt_sliding_window and cfg.sliding_window > 0:
+            raise NotImplementedError(
+                "PipelineEngine's stage scan applies one window to all its "
+                "layers; Gemma-2's alternating windows are not supported here"
+            )
         # The stage body runs per-shard under shard_map, so Pallas kernels see
         # local arrays and apply directly — default to the flash kernel on
         # real TPU; pass "flash" explicitly to run it in interpret mode on a

@@ -133,6 +133,13 @@ def _check_divisibility(cfg: ModelConfig, mesh: Mesh) -> None:
     ep = mesh.shape.get("ep", 1)
     if cfg.num_experts > 0 and cfg.num_experts % ep:
         raise ValueError(f"num_experts {cfg.num_experts} % ep {ep} != 0")
+    if (cfg.post_block_norms or cfg.alt_sliding_window or cfg.attn_soft_cap > 0
+            or cfg.query_pre_attn_scalar > 0):
+        raise NotImplementedError(
+            "the manual 4D program does not implement the Gemma-2 dials "
+            "(post-sublayer norms / alternating windows / attention soft cap); "
+            "use the auto-sharded path"
+        )
 
 
 # ---------------------------------------------------------------------------

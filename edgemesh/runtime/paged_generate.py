@@ -189,6 +189,17 @@ def generate_paged(
     (Mistral) work end-to-end: the page-table kernel masks and skips pages
     outside each row's window."""
 
+    if (
+        (cfg.alt_sliding_window and cfg.sliding_window > 0)
+        or cfg.attn_soft_cap > 0
+        or cfg.query_pre_attn_scalar > 0
+    ):
+        raise NotImplementedError(
+            "the paged decode kernels apply one window, default query "
+            "scaling, and no score soft cap; Gemma-2 models use the dense "
+            "KV backend"
+        )
+
     def make_cache(cfg, batch, needed):
         per_row = (needed + page_size - 1) // page_size
         return init_paged_cache(

@@ -121,7 +121,10 @@ def _quant_attention(
         _dequant(cache.k, cache.k_scale, dtype),
         _dequant(cache.v, cache.v_scale, dtype),
     )
-    out = attend(q, layer_kv, positions, kv_valid, sliding_window=cfg.sliding_window)
+    out = attend(
+        q, layer_kv, positions, kv_valid, scale=cfg.query_scale,
+        sliding_window=cfg.sliding_window, soft_cap=cfg.attn_soft_cap,
+    )
     return dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode), cache
 
 
@@ -200,6 +203,12 @@ def generate_quant_kv(
 ) -> GenerateResult:
     """generate() with the int8 KV cache plugged in — validation, timing,
     and throughput conventions all inherited from runtime.generate."""
+
+    if cfg.alt_sliding_window and cfg.sliding_window > 0:
+        raise NotImplementedError(
+            "the int8 KV scan applies one window to every layer; Gemma-2's "
+            "alternating windows are not supported here yet"
+        )
 
     def check_cache(cache, needed):
         if cache.k.shape[2] < needed:

@@ -271,3 +271,27 @@ def test_phi3_parity(tmp_path):
     assert cfg.sliding_window == 8 and not cfg.tie_embeddings
     # seq=12 > window=8 so the window actually masks history.
     _compare(tmp_path, model, seq=12)
+
+
+def test_gemma2_parity(tmp_path):
+    """Gemma-2: gemma's dials plus post-sublayer norms, attention-score and
+    final-logit soft caps, fixed query scale, and ALTERNATING sliding
+    windows (even layers windowed, odd layers full). window < seq and the
+    soft caps at their real defaults, so every new dial shapes the logits."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    hf_cfg = Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-5,
+        sliding_window=8, query_pre_attn_scalar=16,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+    )
+    torch.manual_seed(8)
+    model = Gemma2ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path)
+    assert cfg.post_block_norms and cfg.alt_sliding_window
+    assert cfg.attn_soft_cap == 50.0 and cfg.logit_soft_cap == 30.0
+    assert cfg.sliding_window == 8 and cfg.query_pre_attn_scalar == 16
+    _compare(tmp_path, model, seq=12)  # seq > window: the window binds

@@ -32,7 +32,7 @@ def _ref_last_logits(cfg, params, tokens, lengths, max_seq):
     return np.asarray(last, np.float32)
 
 
-@pytest.mark.parametrize("family", ["llama", "phi2"])
+@pytest.mark.parametrize("family", ["llama", "phi2", "gemma2"])
 def test_tp_prefill_matches_single_device(devices, family):
     cfg = _cfg(family)
     params = init_params(cfg, jax.random.PRNGKey(0))
