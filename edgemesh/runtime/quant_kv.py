@@ -139,17 +139,59 @@ def _quant_forward(
 ):
     x = embed_tokens(cfg, params, tokens)
 
-    def body(h, scanned):
-        layer, k_l, v_l, ks_l, vs_l = scanned
-        h, new_kv, _aux = _layer_fn(
-            cfg, h, layer, _QuantLayerKV(k_l, v_l, ks_l, vs_l), positions,
-            kv_valid, cache.lengths, is_decode, _quant_attention,
+    # NOTE: this scan intentionally mirrors transformer._scan_layers' pair
+    # trick (generalizing that scan over an opaque KV pytree is the cleaner
+    # end state — deferred; keep the two in sync meanwhile).
+    def one_layer(fn_cfg, h, layer, kv4):
+        fn = _layer_fn
+        if cfg.remat:
+            fn = jax.checkpoint(fn, static_argnums=(0, 7, 8, 9))
+        return fn(
+            fn_cfg, h, layer, _QuantLayerKV(*kv4), positions, kv_valid,
+            cache.lengths, is_decode, _quant_attention,
         )
-        return h, tuple(new_kv)
 
-    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
-        body, x, (params["layers"], cache.k, cache.v, cache.k_scale, cache.v_scale)
-    )
+    xs_cache = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    if cfg.alt_sliding_window and cfg.sliding_window > 0:
+        # Gemma-2: pair-wise scan keeps each half's window static — the same
+        # trick as transformer._scan_layers.
+        if cfg.num_layers % 2:
+            raise ValueError(
+                f"alt_sliding_window needs even num_layers, got {cfg.num_layers}"
+            )
+        full_cfg = cfg.replace(sliding_window=0)
+
+        def pair(a):
+            return a.reshape(cfg.num_layers // 2, 2, *a.shape[1:])
+
+        def body(h, scanned):
+            layer2 = scanned[0]
+            kv2 = scanned[1:]
+            even = jax.tree.map(lambda a: a[0], layer2)
+            odd = jax.tree.map(lambda a: a[1], layer2)
+            h, kv_e, _ = one_layer(cfg, h, even, tuple(a[0] for a in kv2))
+            h, kv_o, _ = one_layer(full_cfg, h, odd, tuple(a[1] for a in kv2))
+            return h, tuple(
+                jnp.stack([e, o]) for e, o in zip(tuple(kv_e), tuple(kv_o))
+            )
+
+        x, new4 = jax.lax.scan(
+            body, x,
+            (jax.tree.map(pair, params["layers"]), *map(pair, xs_cache)),
+        )
+        new_k, new_v, new_ks, new_vs = (
+            a.reshape(cfg.num_layers, *a.shape[2:]) for a in new4
+        )
+    else:
+
+        def body(h, scanned):
+            layer, *kv4 = scanned
+            h, new_kv, _aux = one_layer(cfg, h, layer, tuple(kv4))
+            return h, tuple(new_kv)
+
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            body, x, (params["layers"], *xs_cache)
+        )
     logits = lm_head_logits(cfg, params, x)
     return logits, cache._replace(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
 
@@ -203,12 +245,6 @@ def generate_quant_kv(
 ) -> GenerateResult:
     """generate() with the int8 KV cache plugged in — validation, timing,
     and throughput conventions all inherited from runtime.generate."""
-
-    if cfg.alt_sliding_window and cfg.sliding_window > 0:
-        raise NotImplementedError(
-            "the int8 KV scan applies one window to every layer; Gemma-2's "
-            "alternating windows are not supported here yet"
-        )
 
     def check_cache(cache, needed):
         if cache.k.shape[2] < needed:
