@@ -115,8 +115,8 @@ FAMILY_PRESETS: dict[str, dict] = {
     ),
     # Gemma 2: gemma's dials PLUS post-sublayer norms, attention-score and
     # final-logit soft caps, a fixed query scale, and sliding windows on
-    # alternate (even) layers only. The flash kernel stays off (the score
-    # soft-cap only exists in the XLA attend).
+    # alternate (even) layers only. The flash prefill kernel honors all
+    # three attention dials (soft cap / query scale / per-half window).
     "gemma2": dict(
         norm="rms",
         norm_unit_offset=True,

@@ -358,10 +358,6 @@ def _use_flash(cfg: ModelConfig) -> bool:
     (shard_map bodies, where pallas sees local arrays) opts in explicitly
     with attention_impl="flash".
     """
-    if cfg.attn_soft_cap > 0 or cfg.query_pre_attn_scalar > 0:
-        # Gemma-2 score soft-cap / fixed query scale: only the XLA attend
-        # implements them; the flash kernel would silently skip the cap.
-        return False
     if cfg.attention_impl == "xla":
         return False
     if cfg.attention_impl == "flash":
@@ -413,9 +409,9 @@ def _attention(
 
         kv_lens = jnp.sum(kv_valid, axis=1).astype(jnp.int32)
         out = flash_attention(
-            q, k, v, kv_lens, causal=True,
+            q, k, v, kv_lens, causal=True, scale=cfg.query_scale,
             interpret=cfg.attention_impl == "flash" and not on_tpu(),
-            sliding_window=cfg.sliding_window,
+            sliding_window=cfg.sliding_window, soft_cap=cfg.attn_soft_cap,
         )
     else:
         out = attend(

@@ -68,6 +68,7 @@ def _flash_kernel(
     block_k: int,
     causal: bool,
     window: int,
+    soft_cap: float,
 ):
     bb = pl.program_id(0)
     i = pl.program_id(2)
@@ -105,6 +106,8 @@ def _flash_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [groups*block_q, block_k]
+        if soft_cap > 0:  # Gemma-2: squash scores before masking/softmax
+            s = soft_cap * jnp.tanh(s / soft_cap)
         col = block_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = col < kvlen
         if causal:
@@ -143,7 +146,7 @@ def _flash_kernel(
     jax.jit,
     static_argnames=(
         "scale", "causal", "block_q", "block_k", "interpret", "check",
-        "sliding_window",
+        "sliding_window", "soft_cap",
     ),
 )
 def flash_attention(
@@ -157,6 +160,7 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 512,
     interpret: bool = False,
+    soft_cap: float = 0.0,
     check: bool = False,
     sliding_window: int = 0,
 ) -> jnp.ndarray:
@@ -217,6 +221,7 @@ def flash_attention(
     kernel = functools.partial(
         _flash_kernel, scale=scale, groups=groups, block_q=block_q,
         block_k=block_k, causal=causal, window=sliding_window,
+        soft_cap=soft_cap,
     )
     out = pl.pallas_call(
         kernel,

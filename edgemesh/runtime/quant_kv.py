@@ -28,6 +28,7 @@ from edgemesh.config import SamplingParams
 from edgemesh.models.transformer import (
     ModelConfig,
     _layer_fn,
+    _mlp,
     dense,
     embed_tokens,
     lm_head_logits,
@@ -148,7 +149,7 @@ def _quant_forward(
             fn = jax.checkpoint(fn, static_argnums=(0, 7, 8, 9))
         return fn(
             fn_cfg, h, layer, _QuantLayerKV(*kv4), positions, kv_valid,
-            cache.lengths, is_decode, _quant_attention,
+            cache.lengths, is_decode, _quant_attention, _mlp,
         )
 
     xs_cache = (cache.k, cache.v, cache.k_scale, cache.v_scale)

@@ -161,3 +161,55 @@ def test_windowed_model_flash_matches_xla():
     lx, _ = forward_prefill(cfg_x, params, tokens, lengths, cache_x)
     lf, _ = forward_prefill(cfg_f, params, tokens, lengths, cache_f)
     np.testing.assert_allclose(np.asarray(lx), np.asarray(lf), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_soft_cap_and_query_scale_match_attend():
+    """Gemma-2's score soft cap and fixed query scale inside the kernel:
+    interpret-mode flash must match the XLA attend with the same dials."""
+    import jax
+
+    from edgemesh.ops.attention import LayerKV, attend
+    from edgemesh.ops.flash_attention import flash_attention
+
+    b, s, nh, kh, hd = 2, 24, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, nh, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, kh, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, kh, hd), jnp.float32)
+    kv_lens = jnp.asarray([s, s - 5], jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    valid = jnp.arange(s)[None, :] < kv_lens[:, None]
+
+    scale = 25.0**-0.5  # fixed query_pre_attn_scalar, != hd^-0.5
+    for window in (0, 7):
+        ref = attend(q, LayerKV(k, v), positions, valid, scale=scale,
+                     sliding_window=window, soft_cap=50.0)
+        got = flash_attention(
+            q, k, v, kv_lens, causal=True, scale=scale, interpret=True,
+            sliding_window=window, soft_cap=50.0,
+        )
+        rows = np.asarray(valid)
+        np.testing.assert_allclose(
+            np.asarray(got)[rows], np.asarray(ref)[rows], rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_gemma2_prefill_flash_matches_xla():
+    """End-to-end: gemma-2 prefill with attention_impl='flash' (interpret on
+    CPU) equals the XLA path — the kernel honors all three attention dials."""
+    import jax
+
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import forward_prefill, init_kv_cache, init_params
+
+    cfg = tiny_config("gemma2", vocab_size=128, max_seq_len=64,
+                      dtype="float32").replace(sliding_window=6)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 20), 0, 128, jnp.int32)
+    lengths = jnp.asarray([20, 14], jnp.int32)
+
+    ref, _ = forward_prefill(cfg.replace(attention_impl="xla"), params, tokens,
+                             lengths, init_kv_cache(cfg, 2, 32))
+    got, _ = forward_prefill(cfg.replace(attention_impl="flash"), params, tokens,
+                             lengths, init_kv_cache(cfg, 2, 32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)

@@ -131,3 +131,15 @@ def test_gemma2_alternating_window_assignment():
         bad_cfg, params, tokens, lengths, init_quant_kv_cache(bad_cfg, 2, 32)
     )
     assert rel(bad, ref) > 5 * good, (rel(bad, ref), good)
+
+
+def test_remat_quant_path_traces():
+    """cfg.remat=True must work on the quant scan (regression: checkpoint's
+    static_argnums once pointed past the passed args and crashed at trace)."""
+    cfg = tiny_config("llama", vocab_size=128, dtype="float32").replace(remat=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    out = generate_quant_kv(
+        cfg, params, jnp.asarray([[5, 9, 2, 7]], jnp.int32), jnp.asarray([4], jnp.int32),
+        SamplingParams(max_new_tokens=4, do_sample=False, repetition_penalty=1.0),
+    )
+    assert int(out.num_generated[0]) == 4
