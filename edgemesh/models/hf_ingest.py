@@ -7,8 +7,10 @@ layer axis, and ``jax.device_put`` the tree into (sharded) HBM
 (edgemesh.parallel.sharding.shard_params — the BASELINE.json north star's
 "materialises weights directly into HBM via jax.device_put").
 
-Name maps cover the reference's three model families (ACL paper §4.2):
-Llama (Llama-3.2-1B-Instruct), GPT-NeoX (Pythia-1B), Phi (Phi-2).
+Name maps cover the reference's three model families (ACL paper §4.2) —
+Llama (Llama-3.2-1B-Instruct), GPT-NeoX (Pythia-1B), Phi (Phi-2) — plus
+Mistral, Qwen2, Gemma, Gemma-2, and Phi-3 (families.py registry; each
+pinned against HF logits in tests/test_hf_parity.py).
 """
 
 from __future__ import annotations
