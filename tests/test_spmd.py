@@ -166,3 +166,11 @@ def test_spmd_moe_train_step_learns(devices):
         state, loss = step(state, tokens, lengths)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_spmd_rejects_gemma2_dials(mesh4d):
+    """The manual 4D program refuses Gemma-2 configs loudly (its ring/ulysses
+    attention has no soft cap / fixed scale / alternating windows) instead of
+    training on silently wrong logits."""
+    with pytest.raises(NotImplementedError, match="Gemma-2"):
+        make_spmd_loss(_tiny("gemma2"), mesh4d)
