@@ -537,49 +537,54 @@ def _scan_layers(
         return fn(fn_cfg, h, layer, LayerKV(k_l, v_l), positions, kv_valid,
                   cache.lengths, is_decode, attention, mlp)
 
-    if cfg.alt_sliding_window and cfg.sliding_window > 0:
-        # Gemma-2: even layers sliding, odd layers full attention. Scanning
-        # PAIRS keeps the window a STATIC per-call constant (one compiled
-        # pair body) instead of a traced per-layer value.
-        if cfg.num_layers % 2:
-            raise ValueError(
-                f"alt_sliding_window needs even num_layers, got {cfg.num_layers}"
-            )
-        full_cfg = cfg.replace(sliding_window=0)
+    def body(layer_cfg, carry, scanned):
+        h, aux_sum = carry
+        layer, k_l, v_l = scanned
+        h, new_kv, aux = one_layer(layer_cfg, h, layer, k_l, v_l)
+        return (h, aux_sum + aux), (new_kv.k, new_kv.v)
 
-        def pair(a):
-            return a.reshape(cfg.num_layers // 2, 2, *a.shape[1:])
-
-        def body(carry, scanned):
-            h, aux_sum = carry
-            layer2, k2, v2 = scanned  # leaves [2, ...]
-            even = jax.tree.map(lambda a: a[0], layer2)
-            odd = jax.tree.map(lambda a: a[1], layer2)
-            h, kv_e, aux_e = one_layer(cfg, h, even, k2[0], v2[0])
-            h, kv_o, aux_o = one_layer(full_cfg, h, odd, k2[1], v2[1])
-            return (h, aux_sum + aux_e + aux_o), (
-                jnp.stack([kv_e.k, kv_o.k]), jnp.stack([kv_e.v, kv_o.v])
-            )
-
-        (x, aux_sum), (new_k, new_v) = jax.lax.scan(
-            body, (x, jnp.zeros((), jnp.float32)),
-            (jax.tree.map(pair, params["layers"]), pair(cache.k), pair(cache.v)),
-        )
-        new_k = new_k.reshape(cfg.num_layers, *new_k.shape[2:])
-        new_v = new_v.reshape(cfg.num_layers, *new_v.shape[2:])
-    else:
-
-        def body(carry, scanned):
-            h, aux_sum = carry
-            layer, k_l, v_l = scanned
-            h, new_kv, aux = one_layer(cfg, h, layer, k_l, v_l)
-            return (h, aux_sum + aux), (new_kv.k, new_kv.v)
-
-        (x, aux_sum), (new_k, new_v) = jax.lax.scan(
-            body, (x, jnp.zeros((), jnp.float32)), (params["layers"], cache.k, cache.v)
-        )
+    (x, aux_sum), (new_k, new_v) = layer_scan_alt_windows(
+        cfg, body, (x, jnp.zeros((), jnp.float32)),
+        (params["layers"], cache.k, cache.v),
+    )
     new_lengths = jnp.max(positions, axis=1) + 1
     return x, KVCache(new_k, new_v, new_lengths), aux_sum
+
+
+def layer_scan_alt_windows(cfg: ModelConfig, body, init_carry, xs):
+    """``lax.scan`` over the stacked layer axis, honoring Gemma-2's
+    alternating sliding windows when configured.
+
+    ``body(layer_cfg, carry, xs_slice) -> (carry, outs)`` with ``outs`` a
+    tuple of per-layer arrays; ``xs`` is a tuple of pytrees whose leaves
+    carry a leading layer axis. Without alternation this is a plain scan
+    with ``layer_cfg = cfg``. With it, layers scan in PAIRS — the even
+    member keeps ``cfg`` (windowed), the odd runs ``sliding_window=0`` — so
+    each half's window stays a STATIC per-call constant (one compiled pair
+    body, no traced windows). The single source of the pair trick for the
+    dense scan, the int8-KV scan (runtime/quant_kv.py), and the pipeline
+    stage scan (parallel/pipeline.py); callers whose leading axis is a
+    stage-local slice must start on an even global layer (the pipeline
+    engine enforces even layers-per-stage)."""
+    n = jax.tree.leaves(xs)[0].shape[0]
+    if not (cfg.alt_sliding_window and cfg.sliding_window > 0):
+        return jax.lax.scan(lambda c, sl: body(cfg, c, sl), init_carry, xs)
+    if n % 2:
+        raise ValueError(f"alt_sliding_window needs an even layer count, got {n}")
+    full_cfg = cfg.replace(sliding_window=0)
+
+    def pair(a):
+        return a.reshape(n // 2, 2, *a.shape[1:])
+
+    def pair_body(carry, scanned):
+        even = jax.tree.map(lambda a: a[0], scanned)
+        odd = jax.tree.map(lambda a: a[1], scanned)
+        carry, outs_e = body(cfg, carry, even)
+        carry, outs_o = body(full_cfg, carry, odd)
+        return carry, tuple(jnp.stack([e, o]) for e, o in zip(outs_e, outs_o))
+
+    carry, outs = jax.lax.scan(pair_body, init_carry, jax.tree.map(pair, xs))
+    return carry, tuple(a.reshape(n, *a.shape[2:]) for a in outs)
 
 
 @partial(jax.jit, static_argnums=(0,))

@@ -30,6 +30,7 @@ from edgemesh.models.transformer import (
     ModelConfig,
     _layer_fn,
     embed_tokens,
+    layer_scan_alt_windows,
     lm_head_logits,
 )
 from edgemesh.ops.attention import LayerKV
@@ -100,14 +101,16 @@ def _stage_pipeline_fn(
             k_rows = lax.dynamic_slice_in_dim(k_blk, row0, mb_size, axis=1)
             v_rows = lax.dynamic_slice_in_dim(v_blk, row0, mb_size, axis=1)
 
-            def layer_step(h, scanned):
+            def layer_body(layer_cfg, h, scanned):
                 layer, k_l, v_l = scanned
                 h, new_kv, _ = _layer_fn(
-                    cfg, h, layer, LayerKV(k_l, v_l), pos, kvv, lens, is_decode
+                    layer_cfg, h, layer, LayerKV(k_l, v_l), pos, kvv, lens, is_decode
                 )
                 return h, (new_kv.k, new_kv.v)
 
-            h, (nk, nv) = lax.scan(layer_step, x_in, (stage_layers, k_rows, v_rows))
+            h, (nk, nv) = layer_scan_alt_windows(
+                cfg, layer_body, x_in, (stage_layers, k_rows, v_rows)
+            )
 
             # Only commit cache rows for genuinely active steps.
             nk = jnp.where(active, nk, k_rows)
@@ -166,10 +169,15 @@ class PipelineEngine:
         if pp < 2:
             raise ValueError("PipelineEngine needs a pp axis of size >= 2")
         if cfg.alt_sliding_window and cfg.sliding_window > 0:
-            raise NotImplementedError(
-                "PipelineEngine's stage scan applies one window to all its "
-                "layers; Gemma-2's alternating windows are not supported here"
-            )
+            # Each stage's pair scan needs to START on an even global layer
+            # and hold whole pairs: layers-per-stage must be even. (An
+            # indivisible num_layers/pp falls through to the divisibility
+            # error below — the accurate diagnostic.)
+            if cfg.num_layers % pp == 0 and (cfg.num_layers // pp) % 2:
+                raise ValueError(
+                    "alternating sliding windows need an even number of "
+                    f"layers per stage (num_layers {cfg.num_layers} / pp {pp})"
+                )
         # The stage body runs per-shard under shard_map, so Pallas kernels see
         # local arrays and apply directly — default to the flash kernel on
         # real TPU; pass "flash" explicitly to run it in interpret mode on a

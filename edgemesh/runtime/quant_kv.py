@@ -31,6 +31,7 @@ from edgemesh.models.transformer import (
     _mlp,
     dense,
     embed_tokens,
+    layer_scan_alt_windows,
     lm_head_logits,
     qkv_proj,
 )
@@ -140,9 +141,6 @@ def _quant_forward(
 ):
     x = embed_tokens(cfg, params, tokens)
 
-    # NOTE: this scan intentionally mirrors transformer._scan_layers' pair
-    # trick (generalizing that scan over an opaque KV pytree is the cleaner
-    # end state — deferred; keep the two in sync meanwhile).
     def one_layer(fn_cfg, h, layer, kv4):
         fn = _layer_fn
         if cfg.remat:
@@ -152,47 +150,15 @@ def _quant_forward(
             cache.lengths, is_decode, _quant_attention, _mlp,
         )
 
-    xs_cache = (cache.k, cache.v, cache.k_scale, cache.v_scale)
-    if cfg.alt_sliding_window and cfg.sliding_window > 0:
-        # Gemma-2: pair-wise scan keeps each half's window static — the same
-        # trick as transformer._scan_layers.
-        if cfg.num_layers % 2:
-            raise ValueError(
-                f"alt_sliding_window needs even num_layers, got {cfg.num_layers}"
-            )
-        full_cfg = cfg.replace(sliding_window=0)
+    def body(layer_cfg, h, scanned):
+        layer = scanned[0]
+        h, new_kv, _aux = one_layer(layer_cfg, h, layer, tuple(scanned[1:]))
+        return h, tuple(new_kv)
 
-        def pair(a):
-            return a.reshape(cfg.num_layers // 2, 2, *a.shape[1:])
-
-        def body(h, scanned):
-            layer2 = scanned[0]
-            kv2 = scanned[1:]
-            even = jax.tree.map(lambda a: a[0], layer2)
-            odd = jax.tree.map(lambda a: a[1], layer2)
-            h, kv_e, _ = one_layer(cfg, h, even, tuple(a[0] for a in kv2))
-            h, kv_o, _ = one_layer(full_cfg, h, odd, tuple(a[1] for a in kv2))
-            return h, tuple(
-                jnp.stack([e, o]) for e, o in zip(tuple(kv_e), tuple(kv_o))
-            )
-
-        x, new4 = jax.lax.scan(
-            body, x,
-            (jax.tree.map(pair, params["layers"]), *map(pair, xs_cache)),
-        )
-        new_k, new_v, new_ks, new_vs = (
-            a.reshape(cfg.num_layers, *a.shape[2:]) for a in new4
-        )
-    else:
-
-        def body(h, scanned):
-            layer, *kv4 = scanned
-            h, new_kv, _aux = one_layer(cfg, h, layer, tuple(kv4))
-            return h, tuple(new_kv)
-
-        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
-            body, x, (params["layers"], *xs_cache)
-        )
+    x, (new_k, new_v, new_ks, new_vs) = layer_scan_alt_windows(
+        cfg, body, x,
+        (params["layers"], cache.k, cache.v, cache.k_scale, cache.v_scale),
+    )
     logits = lm_head_logits(cfg, params, x)
     return logits, cache._replace(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
 

@@ -118,3 +118,47 @@ def test_pipeline_int8_quantized(devices):
     eng = PipelineEngine(cfg, params, mesh, num_micro=2, attention_impl="xla")
     got = eng.generate_greedy(tokens, lengths, max_new=6)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref.tokens))
+
+
+def test_pipeline_gemma2_alternating_windows(devices):
+    """Gemma-2 through the pipeline engine: each stage's pair scan keeps the
+    global even-windowed/odd-full alternation, so greedy output matches the
+    single-device path (fp32 — no quantization noise here)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from edgemesh.config import SamplingParams
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_params
+    from edgemesh.parallel.mesh import build_mesh
+    from edgemesh.parallel.pipeline import PipelineEngine
+    from edgemesh.runtime import generate
+
+    cfg = tiny_config("gemma2", num_layers=4, vocab_size=128, max_seq_len=64,
+                      dtype="float32").replace(sliding_window=6)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 20), 0, 128, jnp.int32)
+    lengths = jnp.asarray([20, 14], jnp.int32)
+
+    ref = generate(cfg, params, tokens, lengths,
+                   SamplingParams(max_new_tokens=6, do_sample=False, repetition_penalty=1.0))
+    eng = PipelineEngine(cfg, params, build_mesh(pp=2), num_micro=2, attention_impl="xla")
+    got = eng.generate_greedy(tokens, lengths, max_new=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref.tokens))
+
+
+def test_pipeline_rejects_odd_layers_per_stage_for_alt_windows(devices):
+    import jax
+    import pytest
+
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_params
+    from edgemesh.parallel.mesh import build_mesh
+    from edgemesh.parallel.pipeline import PipelineEngine
+
+    cfg = tiny_config("gemma2", num_layers=2, vocab_size=128,
+                      dtype="float32").replace(sliding_window=6)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="even number of layers per stage"):
+        PipelineEngine(cfg, params, build_mesh(pp=2), num_micro=2)
