@@ -1,13 +1,17 @@
 #!/usr/bin/env python
-"""Driver benchmark entry: prints ONE JSON line
-{"metric", "value", "unit", "vs_baseline"}.
+"""Driver benchmark entry: prints the result JSON line
+{"metric", "value", "unit", "vs_baseline"} — re-printed refreshed after
+every completed stage, so the LAST JSON line on stdout is always the most
+complete result even if a later stage stalls (the watchdog then exits rc=3
+after re-printing the partial line, instead of losing the run).
 
-Runs on the real TPU chip (axon platform — do NOT force cpu here). Measures
-bf16 AND all int8 decode paths on a Llama-3.2-1B-shaped model; the primary
-metric is the fastest int8 path's tokens/sec, compared against the
-reference's published 25.83 tok/s for the same model quantized on A100
-(BASELINE.md Table 3). Extra keys record bf16 vs int8, per-path numbers,
-batch sweep, TTFT, and HBM-bandwidth utilization.
+Runs on the real TPU chip (axon platform — do NOT force cpu here). The
+headline int8 decode stage runs FIRST; bf16 and the remaining paths
+(w8a8, fused Pallas w8a8, paged KV, batch sweep, long context, int4,
+Llama-3-8B) follow, each fenced so one failure cannot discard the rest.
+The primary metric is the fastest int8 path's tokens/sec, compared against
+the reference's published 25.83 tok/s for the same model quantized on A100
+(BASELINE.md Table 3).
 """
 
 import json

@@ -1,0 +1,72 @@
+"""Stall-resilience contract of the driver bench (edgemesh/benchmarks.py).
+
+Round 2's bench printed its JSON only at the finish line; a TPU-tunnel wedge
+mid-run left the driver with rc=3 and nothing parseable (VERDICT r2 weak #1).
+The contract now: the headline int8 stage runs first, every completed stage
+re-emits the refreshed result line, and the stall watchdog re-prints the
+partial before exiting — so the LAST JSON line on stdout is always the most
+complete measurement.
+"""
+
+import json
+
+from edgemesh import benchmarks
+
+
+def test_emit_partial_prints_and_records(capsys):
+    r = {"metric": "decode_tok_s_x", "value": 1.0, "unit": "tok/s/chip",
+         "vs_baseline": 0.1}
+    benchmarks.emit_partial(r)
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line) == r
+    assert benchmarks._PARTIAL == r
+    # A refresh replaces, never merges stale keys.
+    r2 = {"metric": "decode_tok_s_x", "value": 2.0, "unit": "tok/s/chip",
+          "vs_baseline": 0.2}
+    benchmarks.emit_partial(r2)
+    assert benchmarks._PARTIAL == r2
+    assert "1.0" not in capsys.readouterr().out
+
+
+def test_emit_partial_without_metric_is_silent(capsys):
+    benchmarks.emit_partial({"incomplete": True})
+    assert capsys.readouterr().out == ""
+
+
+def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
+    """The headline int8 stage must produce a parseable driver line BEFORE
+    any other stage runs, and later-stage failures must keep earlier keys."""
+    calls = []
+
+    def fake_build(preset, precision, quant_mode):
+        calls.append(("build", precision))
+        if precision == "bf16":
+            raise RuntimeError("tunnel wedged")  # bf16 stage dies
+        return ("cfg", "params")
+
+    def fake_decode(preset, precision, quant_mode="w8a16", batch=8, **kw):
+        calls.append(("decode", precision, quant_mode, kw.get("kv_backend", "dense")))
+        if precision != "int8" or quant_mode != "w8a16":
+            raise RuntimeError("only stage 1 succeeds in this fake")
+        return {"metric": "m", "value": 100.0, "unit": "tok/s/chip",
+                "vs_baseline": 3.9, "ttft_s": 0.01, "hbm_eff_gbs": 1.0,
+                "hbm_util": 0.1, "weight_gb": 1.0, "batch": batch,
+                "decode_steps": 8}
+
+    monkeypatch.setattr(benchmarks, "_build", fake_build)
+    monkeypatch.setattr(benchmarks, "decode_benchmark", fake_decode)
+    monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2, decode_steps=8,
+                                        sweep_batches=())
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    # First emitted line is the pure stage-1 headline (int8 w8a16, pre-bf16).
+    assert lines[0]["value"] == 100.0
+    assert lines[0]["int8_mode"] == "w8a16"
+    assert "bf16_tok_s" not in lines[0]
+    # stage ordering: the int8 build+decode strictly precede the bf16 build.
+    assert calls.index(("decode", "int8", "w8a16", "dense")) < calls.index(("build", "bf16"))
+    # bf16 death did not kill the run; the error is recorded, headline kept.
+    assert out["value"] == 100.0
+    assert "tunnel wedged" in out["bf16_error"]
+    assert "int8_w8a8_error" in out  # later fenced stages also recorded
