@@ -20,7 +20,12 @@ import sys
 
 def main() -> int:
     from edgemesh.benchmarks import headline_benchmark, start_stall_watchdog
+    from edgemesh.utils.platform import ensure_device_ready
 
+    # A wedged tunnel at first contact fails in minutes with a clear message
+    # (no partial result exists yet to protect); mid-run stalls are the
+    # watchdog's job, which re-prints the partial JSON before exiting rc=3.
+    ensure_device_ready()
     start_stall_watchdog()
     result = headline_benchmark()
     print(json.dumps(result))

@@ -218,6 +218,13 @@ def main(argv: list[str] | None = None) -> int:
     cfg = load_config(args.config, overrides)
     _setup_logging(cfg)
 
+    if cmd_args.command in ("eval", "serve", "bench", "train"):
+        # Fail fast (with a pin-CPU hint) instead of hanging forever when
+        # the device tunnel is wedged — observed >600s silent hangs here.
+        from edgemesh.utils.platform import ensure_device_ready
+
+        ensure_device_ready()
+
     if cmd_args.command == "eval":
         return cmd_eval(cfg)
     if cmd_args.command == "serve":
