@@ -9,12 +9,12 @@ contextual token vectors from its final-norm hidden states
 (models/transformer.forward_hidden). The deterministic HashingEmbedder
 (eval/metrics.py) remains the explicit no-model fallback.
 
-Caveat recorded for honesty: absolute metric values from a decoder's hidden
-states (or a synthetic model) are NOT numerically comparable to the
-reference's MiniLM/roberta numbers in BASELINE.md Tables 1-2 — they are a
-consistent relative signal (same embedder across all systems under eval).
-Ingesting an actual MiniLM-class encoder checkpoint via hf_ingest closes
-that gap when one is present locally.
+Pointing the config's ``embedder:`` at a bert-family checkpoint (MiniLM /
+BERT / sentence-BERT — models/encoder.py, sniffed by model_type) hosts the
+reference's actual encoder class, making cosine/BERTScore numerically
+comparable to BASELINE.md Tables 1-2. Decoder checkpoints and the pinned
+synthetic model also work but yield a RELATIVE signal only (same embedder
+across all systems under eval, not MiniLM-comparable values).
 """
 
 from __future__ import annotations
@@ -53,9 +53,15 @@ class ModelEmbedder:
         tokenizer: Any,
         max_len: int = 128,
         buckets: tuple[int, ...] = (16, 32, 64, 128),
+        forward_fn: Any = None,
     ):
-        from edgemesh.models.transformer import forward_hidden
+        """``forward_fn(cfg, params, tokens, lengths) -> [b, s, d]`` defaults
+        to the decoder's forward_hidden; the bert-family encoder passes its
+        own (models/encoder.forward_hidden) — same protocol, bidirectional."""
+        if forward_fn is None:
+            from edgemesh.models.transformer import forward_hidden
 
+            forward_fn = forward_hidden
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -64,7 +70,7 @@ class ModelEmbedder:
         # The top bucket is always exactly max_len, so no text the tokenizer
         # kept gets silently truncated by bucket rounding.
         self.buckets = kept + (self.max_len,)
-        self._forward = forward_hidden
+        self._forward = forward_fn
         self.dim = cfg.hidden_size
 
     # -- internals ---------------------------------------------------------
@@ -114,7 +120,10 @@ def build_embedder(spec: str = "", max_len: int = 128):
     - ""            → HashingEmbedder (deterministic no-model fallback)
     - "synthetic"   → ModelEmbedder over a pinned tiny random-init model
                       (stable across runs/processes; relative signal only)
-    - anything else → ModelEmbedder over the HF checkpoint at that path
+    - anything else → ModelEmbedder over the HF checkpoint at that path;
+                      bert-family checkpoints (MiniLM et al., sniffed by
+                      model_type) load through the bidirectional encoder,
+                      decoder families through the decoder runtime
     """
     from edgemesh.eval.metrics import HashingEmbedder
 
@@ -135,9 +144,17 @@ def build_embedder(spec: str = "", max_len: int = 128):
         )
         params = init_params(cfg, jax.random.PRNGKey(1234))
         return ModelEmbedder(cfg, params, tokenizer, max_len=max_len)
-    from edgemesh.models.hf_ingest import load_params
+    from edgemesh.models.families import sniff_family
     from edgemesh.models.tokenizer import load_tokenizer
 
-    cfg, params = load_params(spec)
     tokenizer = load_tokenizer(spec)
+    if sniff_family(spec) == "bert":
+        from edgemesh.models import encoder
+
+        cfg, params = encoder.load_encoder(spec)
+        return ModelEmbedder(cfg, params, tokenizer, max_len=max_len,
+                             forward_fn=encoder.forward_hidden)
+    from edgemesh.models.hf_ingest import load_params
+
+    cfg, params = load_params(spec)
     return ModelEmbedder(cfg, params, tokenizer, max_len=max_len)

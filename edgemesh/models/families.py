@@ -146,6 +146,11 @@ _HF_MODEL_TYPE_TO_FAMILY = {
     "gemma": "gemma",
     "gemma2": "gemma2",
     "phi3": "phi3",
+    # Encoder family (BERT/MiniLM/sentence-BERT): bidirectional, post-LN,
+    # learned positions — its own forward in models/encoder.py, NOT a
+    # decoder preset. sniff_family recognizes it so ingest dispatches (or
+    # refuses) with a precise message instead of a KeyError.
+    "bert": "bert",
 }
 
 

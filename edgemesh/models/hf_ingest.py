@@ -96,6 +96,12 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
     """Build a ModelConfig from the checkpoint's HF config.json."""
     ckpt = Path(ckpt)
     family = sniff_family(ckpt)
+    if family == "bert":
+        raise ValueError(
+            f"{ckpt} is a bert-family ENCODER checkpoint (no LM head / decode "
+            "path); load it via models.encoder.load_encoder — e.g. point the "
+            "eval config's `embedder:` at it for cosine/BERTScore"
+        )
     with open(ckpt / "config.json") as f:
         hf = json.load(f)
 
@@ -209,6 +215,11 @@ def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) ->
     """Load an HF checkpoint directory into (ModelConfig, stacked param tree)."""
     ckpt = Path(ckpt)
     family = sniff_family(ckpt)
+    if family == "bert":
+        raise ValueError(
+            f"{ckpt} is a bert-family ENCODER checkpoint; use "
+            "models.encoder.load_encoder (decoder runtime cannot host it)"
+        )
     cfg = cfg or config_from_checkpoint(ckpt)
     dtype = dtype or cfg.activation_dtype
     raw = _load_raw_tensors(ckpt)

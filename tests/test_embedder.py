@@ -79,3 +79,48 @@ def test_bucket_padding_consistency(model_embedder):
     longer = "w " * 100
     together = model_embedder([short, longer])
     np.testing.assert_allclose(alone[0], together[0], atol=1e-4)
+
+
+def test_build_embedder_hosts_bert_checkpoint(tmp_path):
+    """A MiniLM-class (bert model_type) checkpoint routes through the
+    bidirectional encoder, and sentence vectors agree with mean-pooled HF
+    BertModel states — the reference's actual cosine-metric recipe
+    (combiner_fp.py:312-316)."""
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers import BertConfig, BertModel, BertTokenizerFast
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "the", "eiffel", "tower", "is", "in", "paris", "where", "##s"]
+    (tmp_path / "vocab.txt").write_text("\n".join(vocab))
+    tok = BertTokenizerFast(vocab_file=str(tmp_path / "vocab.txt"))
+    tok.save_pretrained(tmp_path)
+
+    hf_cfg = BertConfig(
+        vocab_size=len(vocab), hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64, max_position_embeddings=32,
+    )
+    torch.manual_seed(11)
+    model = BertModel(hf_cfg, add_pooling_layer=False).eval()
+    model.save_pretrained(tmp_path)
+
+    emb = build_embedder(str(tmp_path), max_len=16)
+    texts = ["the eiffel tower is in paris", "where is paris"]
+    vecs = emb(texts)
+    assert vecs.shape == (2, 32)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, atol=1e-5)
+
+    # HF reference: mean-pool last_hidden_state over the attention mask.
+    enc = tok(texts, return_tensors="pt", padding=True)
+    with torch.no_grad():
+        hid = model(**enc).last_hidden_state.numpy()
+    mask = enc["attention_mask"].numpy().astype(np.float32)
+    pooled = (hid * mask[:, :, None]).sum(1) / mask.sum(1, keepdims=True)
+    pooled /= np.linalg.norm(pooled, axis=1, keepdims=True)
+    np.testing.assert_allclose(vecs, pooled, atol=2e-3)
+
+    # Token-level protocol for BERTScore greedy matching works too.
+    toks, tvecs = emb.embed_tokens("eiffel tower")
+    assert len(toks) == tvecs.shape[0] > 0

@@ -295,3 +295,72 @@ def test_gemma2_parity(tmp_path):
     assert cfg.attn_soft_cap == 50.0 and cfg.logit_soft_cap == 30.0
     assert cfg.sliding_window == 8 and cfg.query_pre_attn_scalar == 16
     _compare(tmp_path, model, seq=12)  # seq > window: the window binds
+
+
+def test_bert_encoder_parity(tmp_path):
+    """Encoder family (MiniLM-class) hidden-state parity vs HF BertModel,
+    including right-padded rows: the bidirectional mask must exclude padding
+    as both query context and key (reference analog: the MiniLM/roberta
+    scorers, combiner_fp.py:302-316,421)."""
+    from transformers import BertConfig, BertModel
+
+    from edgemesh.models import encoder
+
+    hf_cfg = BertConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=96,
+        max_position_embeddings=32, type_vocab_size=2, layer_norm_eps=1e-12,
+    )
+    torch.manual_seed(7)
+    model = BertModel(hf_cfg, add_pooling_layer=False).eval()
+    model.save_pretrained(tmp_path)
+
+    cfg, params = encoder.load_encoder(tmp_path)
+    assert cfg.num_layers == 2 and cfg.hidden_size == 48
+
+    rng = np.random.default_rng(0)
+    lengths = np.array([12, 7], np.int32)  # second row right-padded
+    tokens = rng.integers(0, 96, size=(2, 12))
+    tokens[1, 7:] = 0  # pad id — must not influence row 1's states
+    attn = (np.arange(12)[None, :] < lengths[:, None]).astype(np.int64)
+
+    with torch.no_grad():
+        hf_hidden = model(
+            torch.tensor(tokens), attention_mask=torch.tensor(attn)
+        ).last_hidden_state.numpy()
+
+    ours = np.asarray(
+        encoder.forward_hidden(cfg, params, jnp.asarray(tokens), jnp.asarray(lengths))
+    )
+    for row, n in enumerate(lengths):
+        np.testing.assert_allclose(
+            ours[row, :n], hf_hidden[row, :n], atol=2e-3, rtol=1e-3
+        )
+
+
+def test_bert_prefixed_checkpoint_and_decoder_refusal(tmp_path):
+    """Task-head checkpoints carry a ``bert.`` key prefix — ingest strips
+    it; the decoder runtime refuses bert checkpoints with a pointer at the
+    encoder (it has no LM head/decode semantics for them)."""
+    from transformers import BertConfig, BertForMaskedLM
+
+    from edgemesh.models import encoder
+
+    hf_cfg = BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=16,
+    )
+    torch.manual_seed(8)
+    BertForMaskedLM(hf_cfg).eval().save_pretrained(tmp_path)
+
+    cfg, params = encoder.load_encoder(tmp_path)
+    out = encoder.forward_hidden(
+        cfg, params, jnp.zeros((1, 4), jnp.int32), jnp.array([4], jnp.int32)
+    )
+    assert np.all(np.isfinite(np.asarray(out)))
+
+    with pytest.raises(ValueError, match="encoder.load_encoder"):
+        load_params(tmp_path)
+    with pytest.raises(ValueError, match="ENCODER"):
+        config_from_checkpoint(tmp_path)
