@@ -34,10 +34,19 @@ def _block_attend_accumulate(
     m: jnp.ndarray,  # [b, sq, kh, g] running max
     l: jnp.ndarray,  # [b, sq, kh, g] running denominator
     o: jnp.ndarray,  # [b, sq, kh, g, d] running numerator
+    sliding_window: int = 0,
+    soft_cap: float = 0.0,
 ):
-    """One online-softmax accumulation step (the flash-attention recurrence)."""
+    """One online-softmax accumulation step (the flash-attention recurrence).
+    Window/soft-cap semantics match the dense op (ops/attention.attend):
+    key j visible to query p iff j <= p (and j > p - w); the cap squashes
+    the scaled scores before masking."""
     scores = jnp.einsum("bqkgd,bskd->bqkgs", q, k, preferred_element_type=jnp.float32)
+    if soft_cap > 0:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
     mask = (k_pos[:, None, :] <= q_pos[:, :, None]) & k_valid[:, None, :]  # [b, sq, sk]
+    if sliding_window > 0:
+        mask = mask & (k_pos[:, None, :] > q_pos[:, :, None] - sliding_window)
     scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
 
     block_max = jnp.max(scores, axis=-1)  # [b, sq, kh, g]
@@ -62,11 +71,19 @@ def ring_attend_block(
     axis: str = "sp",
     sp: int,
     scale: float | None = None,
+    sliding_window: int = 0,
+    soft_cap: float = 0.0,
     pcast_accumulators: bool = True,
 ) -> jnp.ndarray:
     """Per-device body of ring attention — callable inside ANY enclosing
     shard_map that carries the ``axis`` mesh axis (the 4D SPMD train step in
     edgemesh/parallel/spmd.py nests this inside its pp/tp program).
+
+    ``sliding_window``/``soft_cap`` follow ops/attention.attend semantics
+    (Mistral windows, Gemma-2 score caps). A window does not shorten the
+    ring — every K/V block still makes all ``sp`` hops (the schedule is
+    static) — but out-of-window blocks contribute exactly zero through the
+    mask, preserving exactness.
 
     ``pcast_accumulators=False`` skips the varying-manual-axes cast for
     enclosing shard_maps running with check_vma=False."""
@@ -94,6 +111,7 @@ def ring_attend_block(
         m, l, o = _block_attend_accumulate(
             qg, k_c.astype(jnp.float32), v_c.astype(jnp.float32),
             pos_blk, kpos_c, kval_c, m, l, o,
+            sliding_window=sliding_window, soft_cap=soft_cap,
         )
         # rotate K/V blocks one hop around the ring (ICI neighbor traffic)
         k_c = lax.ppermute(k_c, axis, right)
@@ -120,6 +138,8 @@ def ring_attention(
     valid: jnp.ndarray,  # [b, seq] real-token mask — sharded over "sp"
     mesh: Mesh,
     scale: float | None = None,
+    sliding_window: int = 0,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Exact causal attention with the sequence axis sharded over ``sp``.
 
@@ -129,7 +149,8 @@ def ring_attention(
 
     def local_fn(q_blk, k_blk, v_blk, pos_blk, valid_blk):
         return ring_attend_block(
-            q_blk, k_blk, v_blk, pos_blk, valid_blk, axis="sp", sp=sp, scale=scale
+            q_blk, k_blk, v_blk, pos_blk, valid_blk, axis="sp", sp=sp, scale=scale,
+            sliding_window=sliding_window, soft_cap=soft_cap,
         )
 
     seq_spec = P(None, "sp")

@@ -38,7 +38,10 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from edgemesh.models.transformer import ModelConfig, _activate, _apply_norm, embed_tokens, lm_head_logits
+from edgemesh.models.transformer import (
+    ModelConfig, _activate, _apply_norm, embed_tokens, layer_scan_alt_windows,
+    lm_head_logits,
+)
 from edgemesh.ops.rope import apply_rope
 from edgemesh.parallel.ring_attention import ring_attend_block
 from edgemesh.training import TrainState
@@ -78,6 +81,9 @@ def spmd_param_specs(cfg: ModelConfig) -> Params:
         layer["attn_norm"]["bias"] = P("pp", None)
     if not cfg.shared_input_norm:
         layer["mlp_norm"] = dict(layer["attn_norm"])
+    if cfg.post_block_norms:  # Gemma-2 post-sublayer norms
+        layer["attn_post_norm"] = dict(layer["attn_norm"])
+        layer["mlp_post_norm"] = dict(layer["attn_norm"])
     if cfg.num_experts > 0:
         # Stacked MoE leaves [L, E, ...]: expert dim over ep, FFN width over
         # tp (same Megatron roles as the dense MLP); fp32 router replicated —
@@ -133,12 +139,13 @@ def _check_divisibility(cfg: ModelConfig, mesh: Mesh) -> None:
     ep = mesh.shape.get("ep", 1)
     if cfg.num_experts > 0 and cfg.num_experts % ep:
         raise ValueError(f"num_experts {cfg.num_experts} % ep {ep} != 0")
-    if (cfg.post_block_norms or cfg.alt_sliding_window or cfg.attn_soft_cap > 0
-            or cfg.query_pre_attn_scalar > 0):
-        raise NotImplementedError(
-            "the manual 4D program does not implement the Gemma-2 dials "
-            "(post-sublayer norms / alternating windows / attention soft cap); "
-            "use the auto-sharded path"
+    if cfg.alt_sliding_window and cfg.sliding_window > 0 and (cfg.num_layers // pp) % 2:
+        # The pair scan keeps each half's window static; a stage must start
+        # on an even GLOBAL layer, which even layers-per-stage guarantees
+        # (same constraint as the pipeline inference engine).
+        raise ValueError(
+            f"alt_sliding_window needs an even layer count per pp stage, got "
+            f"{cfg.num_layers}/{pp} = {cfg.num_layers // pp}"
         )
 
 
@@ -189,10 +196,15 @@ def _spmd_attention(
     if sp_impl == "ulysses":
         from edgemesh.parallel.ulysses import ulysses_attend_block
 
-        out = ulysses_attend_block(q, k, v, positions, valid, axis="sp", sp=sp)
+        out = ulysses_attend_block(
+            q, k, v, positions, valid, axis="sp", sp=sp, scale=cfg.query_scale,
+            sliding_window=cfg.sliding_window, soft_cap=cfg.attn_soft_cap,
+        )
     elif sp_impl == "ring":
         out = ring_attend_block(
-            q, k, v, positions, valid, axis="sp", sp=sp, pcast_accumulators=False
+            q, k, v, positions, valid, axis="sp", sp=sp, scale=cfg.query_scale,
+            sliding_window=cfg.sliding_window, soft_cap=cfg.attn_soft_cap,
+            pcast_accumulators=False,
         )
     else:
         raise ValueError(f"unknown sp_impl {sp_impl!r}; choose ring or ulysses")
@@ -272,10 +284,15 @@ def _spmd_layer(
             + _spmd_attention(cfg, layer, attn_in, positions, valid, sp, tp, sp_impl)
             + mlp_out
         ), aux
-    x = x + _spmd_attention(
+    attn_out = _spmd_attention(
         cfg, layer, _apply_norm(cfg, layer["attn_norm"], x), positions, valid, sp, tp, sp_impl
     )
+    if cfg.post_block_norms:  # Gemma-2: norm each sublayer OUTPUT pre-residual
+        attn_out = _apply_norm(cfg, layer["attn_post_norm"], attn_out)
+    x = x + attn_out
     mlp_out, aux = _spmd_mlp(cfg, layer, _apply_norm(cfg, layer["mlp_norm"], x))
+    if cfg.post_block_norms:
+        mlp_out = _apply_norm(cfg, layer["mlp_post_norm"], mlp_out)
     return x + mlp_out, aux
 
 
@@ -332,13 +349,17 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int, moe_aux_weight
             h = jnp.where(stage == 0, x_mb[idx], recv)
             pos, kvv = pos_mb[idx], valid_mb[idx]
 
-            def layer_step(carry_l, layer):
+            def layer_step(layer_cfg, carry_l, layer):
                 h, aux = carry_l
-                h, a = _spmd_layer(cfg, layer, h, pos, kvv, sp, tp, sp_impl)
-                return (h, aux + a), None
+                h, a = _spmd_layer(layer_cfg, layer, h, pos, kvv, sp, tp, sp_impl)
+                return (h, aux + a), ()
 
-            (h, aux_mb), _ = lax.scan(
-                layer_step, (h, jnp.zeros((), jnp.float32)), stage_layers
+            # Gemma-2's alternating windows ride the shared pair scan (each
+            # half's window a static constant); plain configs take the
+            # ordinary one-layer scan inside the same helper. Stage layer
+            # blocks start on even global layers (_check_divisibility).
+            (h, aux_mb), _ = layer_scan_alt_windows(
+                cfg, layer_step, (h, jnp.zeros((), jnp.float32)), stage_layers
             )
             # Bubble (fill/drain) steps run the layers on a clipped microbatch
             # index; their routing stats must not leak into the aux loss.

@@ -36,16 +36,20 @@ def _full_seq_attend(
     q_pos: jnp.ndarray,  # [b, s] global positions
     k_valid: jnp.ndarray,  # [b, s]
     scale: float,
+    sliding_window: int = 0,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Full-sequence causal attention on the local head group — the dense op
-    (ops/attention.attend) applied to the gathered arrays.
+    (ops/attention.attend) applied to the gathered arrays, window/soft-cap
+    dials included.
 
     Contract: after the all-to-all the local K/V hold the FULL sequence in
     global slot order, and the sequence-split layout puts position ``j`` in
     slot ``j`` (positions are ``block_start + arange`` per shard — true for
     every consumer: the 4D SPMD program and the top-level wrapper below), so
     attend's slot-index causal mask is exactly the position mask."""
-    return attend(q, LayerKV(k, v), q_pos, k_valid, scale=scale)
+    return attend(q, LayerKV(k, v), q_pos, k_valid, scale=scale,
+                  sliding_window=sliding_window, soft_cap=soft_cap)
 
 
 def ulysses_attend_block(
@@ -58,15 +62,19 @@ def ulysses_attend_block(
     axis: str = "sp",
     sp: int,
     scale: float | None = None,
+    sliding_window: int = 0,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Per-device body — callable inside ANY enclosing shard_map carrying the
     ``axis`` mesh axis (drop-in alternative to ring_attend_block; the 4D SPMD
-    program selects between them via ``sp_impl``)."""
+    program selects between them via ``sp_impl``). Window/soft-cap semantics
+    follow ops/attention.attend (Mistral windows, Gemma-2 caps)."""
     b, sq, num_heads, head_dim = q_blk.shape
     kv_heads = k_blk.shape[2]
     scale = scale if scale is not None else head_dim**-0.5
     if sp == 1:
-        return _full_seq_attend(q_blk, k_blk, v_blk, pos_blk, valid_blk, scale)
+        return _full_seq_attend(q_blk, k_blk, v_blk, pos_blk, valid_blk, scale,
+                                sliding_window, soft_cap)
     if num_heads % sp:
         raise ValueError(f"ulysses needs num_heads {num_heads} % sp {sp} == 0")
 
@@ -93,7 +101,8 @@ def ulysses_attend_block(
     pos_g = lax.all_gather(pos_blk, axis, axis=1, tiled=True)  # [b, s]
     val_g = lax.all_gather(valid_blk, axis, axis=1, tiled=True)
 
-    out = _full_seq_attend(q_g, k_g, v_g, pos_g, val_g, scale)
+    out = _full_seq_attend(q_g, k_g, v_g, pos_g, val_g, scale,
+                           sliding_window, soft_cap)
     # head-split → seq-split: the inverse exchange.
     return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
 
@@ -106,6 +115,8 @@ def ulysses_attention(
     valid: jnp.ndarray,  # [b, seq]
     mesh: Mesh,
     scale: float | None = None,
+    sliding_window: int = 0,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Exact causal attention with the sequence axis sharded over ``sp`` —
     same contract as ring_attention.ring_attention."""
@@ -113,7 +124,8 @@ def ulysses_attention(
 
     def local_fn(q_blk, k_blk, v_blk, pos_blk, valid_blk):
         return ulysses_attend_block(
-            q_blk, k_blk, v_blk, pos_blk, valid_blk, axis="sp", sp=sp, scale=scale
+            q_blk, k_blk, v_blk, pos_blk, valid_blk, axis="sp", sp=sp, scale=scale,
+            sliding_window=sliding_window, soft_cap=soft_cap,
         )
 
     seq_spec = P(None, "sp")

@@ -66,3 +66,24 @@ def test_ring_first_token_sees_only_itself(devices):
     valid = jnp.ones((b, seq), bool)
     got = ring_attention(q, k, v, positions, valid, mesh)
     np.testing.assert_allclose(np.asarray(got)[0, 0], np.asarray(v)[0, 0], rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("window,cap", [(5, 0.0), (0, 4.0), (5, 4.0)])
+def test_ring_window_and_soft_cap_match_dense(devices, window, cap):
+    """Sliding window and score soft cap (Mistral / Gemma-2 dials) must match
+    the dense op exactly — these previously silently fell back to full
+    uncapped attention in the sequence-parallel schemes."""
+    mesh = build_mesh(sp=8)
+    b, seq, heads, d = 2, 32, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, seq, heads, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, seq, 2, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, seq, 2, d), jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(seq)[None, :], (b, seq))
+    valid = positions < jnp.array([seq, seq - 5])[:, None]
+
+    ref = attend(q, LayerKV(k, v), positions, valid,
+                 sliding_window=window, soft_cap=cap)
+    got = ring_attention(q, k, v, positions, valid, mesh,
+                         sliding_window=window, soft_cap=cap)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)

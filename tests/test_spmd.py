@@ -168,9 +168,36 @@ def test_spmd_moe_train_step_learns(devices):
     assert losses[-1] < losses[0], losses
 
 
-def test_spmd_rejects_gemma2_dials(mesh4d):
-    """The manual 4D program refuses Gemma-2 configs loudly (its ring/ulysses
-    attention has no soft cap / fixed scale / alternating windows) instead of
-    training on silently wrong logits."""
-    with pytest.raises(NotImplementedError, match="Gemma-2"):
-        make_spmd_loss(_tiny("gemma2"), mesh4d)
+@pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+@pytest.mark.parametrize(
+    "family,extra",
+    [
+        # Mistral-class plain sliding window — previously silently DROPPED by
+        # both sp schemes (round-2 advisor finding): full attention in the 4D
+        # program vs windowed everywhere else.
+        ("mistral", dict(sliding_window=7)),
+        # Gemma-2: post-sublayer norms, score soft cap, fixed query scale,
+        # alternating windows via the shared pair scan (was a refusal).
+        ("gemma2", dict(sliding_window=8, query_pre_attn_scalar=32.0)),
+    ],
+)
+def test_spmd_windowed_families_match_single_device(family, extra, sp_impl, mesh4d):
+    cfg = _tiny(family).replace(**extra)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens, lengths = _batch(cfg)
+
+    ref = causal_lm_loss(cfg, params, tokens, lengths)
+
+    sharded = place_spmd(params, cfg, mesh4d)
+    loss_fn = make_spmd_loss(cfg, mesh4d, num_micro=2, sp_impl=sp_impl)
+    got = jax.jit(loss_fn)(sharded, tokens, lengths)
+    np.testing.assert_allclose(float(got), float(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_spmd_alt_window_needs_even_layers_per_stage(devices):
+    """Alternating windows require stages to start on even global layers;
+    an odd layers-per-stage split is refused at build time."""
+    cfg = _tiny("gemma2").replace(sliding_window=8, num_layers=4)
+    mesh = build_mesh(dp=1, pp=4, sp=1, tp=2, devices=devices)
+    with pytest.raises(ValueError, match="even layer count per pp stage"):
+        make_spmd_loss(cfg, mesh)

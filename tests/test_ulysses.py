@@ -62,3 +62,23 @@ def test_spmd_4d_with_ulysses_matches_single_device(devices):
     loss_fn = make_spmd_loss(cfg, mesh, num_micro=2, sp_impl="ulysses")
     got = jax.jit(loss_fn)(sharded, tokens, lengths)
     np.testing.assert_allclose(float(got), float(ref), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("window,cap", [(5, 0.0), (0, 4.0), (5, 4.0)])
+def test_ulysses_window_and_soft_cap_match_dense(devices, window, cap):
+    """Same window/soft-cap pin as the ring scheme: the dials must survive
+    the head<->sequence all-to-all exchange."""
+    mesh = build_mesh(sp=4)
+    b, seq, heads, d = 2, 32, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, seq, heads, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, seq, 2, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, seq, 2, d), jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(seq)[None, :], (b, seq))
+    valid = positions < jnp.array([seq, seq - 5])[:, None]
+
+    ref = attend(q, LayerKV(k, v), positions, valid,
+                 sliding_window=window, soft_cap=cap)
+    got = ulysses_attention(q, k, v, positions, valid, mesh,
+                            sliding_window=window, soft_cap=cap)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
