@@ -556,6 +556,15 @@ def headline_benchmark(
         lc_quant = decode_benchmark(preset, "int8", quant_mode="w8a16",
                                     kv_backend="quant", **lc_kw)
         out[f"longctx{lc_prompt}_int8kv_tok_s"] = lc_quant["value"]
+        emit_partial(out)
+        # Windowed paged decode: the page-table kernel's grid only visits
+        # pages intersecting the window, so long-context decode stops paying
+        # for the whole table (sliding-window serving à la Mistral/Gemma-2).
+        win_cfg = int8_built[0].replace(sliding_window=1024)
+        lc_win = decode_benchmark(preset, "int8", quant_mode="w8a16",
+                                  kv_backend="paged",
+                                  **{**lc_kw, "built": (win_cfg, int8_built[1])})
+        out[f"longctx{lc_prompt}_paged_win1024_tok_s"] = lc_win["value"]
 
     _stage("longctx", _longctx)
 

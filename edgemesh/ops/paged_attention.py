@@ -46,6 +46,7 @@ def _paged_kernel(
     page_size: int,
     scale: float,
     window: int,
+    soft_cap: float,
 ):
     bb = pl.program_id(0)
     p = pl.program_id(2)
@@ -59,13 +60,17 @@ def _paged_kernel(
 
     kvlen = len_ref[bb]
 
-    live = p * page_size < kvlen
     if window > 0:
-        # Sliding window: the decode query sits at kvlen-1 and sees cols
-        # [kvlen-window, kvlen-1]; pages wholly before the window are dead
-        # (their DMA still runs — the grid is static — but the MXU work and
-        # softmax update are skipped).
-        live = jnp.logical_and(live, (p + 1) * page_size > kvlen - window)
+        # Windowed grid: the host shrank the page axis to the slots that can
+        # intersect the window, and the K/V index_map walks LOGICAL page
+        # first_live + p — recompute that logical index here so the column
+        # numbers match what the DMA fetched. Out-of-window pages are never
+        # DMA'd at all (the grid doesn't visit them), unlike the pre-r3
+        # kernel which fetched the whole table and only skipped compute.
+        lp = jnp.maximum(kvlen - window, 0) // page_size + p
+    else:
+        lp = p
+    live = lp * page_size < kvlen
 
     @pl.when(live)
     def _update():
@@ -75,7 +80,9 @@ def _paged_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [gp, ps]
-        col = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if soft_cap > 0:  # Gemma-2 score squashing, pre-mask (attend parity)
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        col = lp * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = col < kvlen
         if window > 0:
             mask = jnp.logical_and(mask, col >= kvlen - window)
@@ -100,7 +107,8 @@ def _paged_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "interpret", "check", "sliding_window")
+    jax.jit,
+    static_argnames=("scale", "interpret", "check", "sliding_window", "soft_cap"),
 )
 def paged_decode_attention(
     q: jnp.ndarray,  # [b, num_heads, head_dim] — one query token per row
@@ -112,13 +120,19 @@ def paged_decode_attention(
     interpret: bool = False,
     check: bool = False,
     sliding_window: int = 0,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """Attention of one decode token per row over its paged KV prefix.
 
     Returns [b, num_heads, head_dim] in q's dtype. Unallocated table slots
     point at the trash page (physical 0); they are DMA'd but fully masked.
-    ``sliding_window`` w > 0 (Mistral) restricts the query to its last w
-    positions; pages wholly outside the window skip their compute.
+    ``sliding_window`` w > 0 (Mistral/Gemma-2) restricts the query to its
+    last w positions — AND shrinks the page grid to the ceil(w/ps)+1 slots
+    that can intersect the window, so out-of-window pages are never DMA'd
+    (the index_map dereferences logical page first_live + p per row).
+    ``soft_cap`` > 0 squashes scaled scores to cap·tanh(s/cap) pre-mask,
+    and a non-None ``scale`` carries Gemma-2's fixed query scale — both
+    matching ops/attention.attend exactly.
 
     ``check=True`` emits checkify contract asserts (page-table entries inside
     the physical pool, kv_lens within table capacity, finite queries) — run
@@ -144,9 +158,28 @@ def paged_decode_attention(
         k_pages = jnp.pad(k_pages, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
         v_pages = jnp.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
 
-    grid = (b, kh, max_pages)
+    if sliding_window > 0:
+        # Only pages intersecting [kvlen-w, kvlen) can contribute: the first
+        # may be partial (+1) and the last may be partial (+1) → w//ps + 2
+        # slots bound the live span for every row.
+        npages = min(max_pages, sliding_window // ps + 2)
+
+        def kv_map(bb, h, p, table, lens):
+            first_live = jnp.maximum(lens[bb] - sliding_window, 0) // ps
+            # Clamp: near capacity first_live+p can step past the table; the
+            # clamped duplicate fetch is masked dead in the kernel (live=False
+            # once lp*ps >= kvlen).
+            return (h, table[bb, jnp.minimum(first_live + p, max_pages - 1)], 0, 0)
+    else:
+        npages = max_pages
+
+        def kv_map(bb, h, p, table, lens):
+            return (h, table[bb, p], 0, 0)
+
+    grid = (b, kh, npages)
     kernel = functools.partial(
-        _paged_kernel, page_size=ps, scale=scale, window=sliding_window
+        _paged_kernel, page_size=ps, scale=scale, window=sliding_window,
+        soft_cap=soft_cap,
     )
     out = pl.pallas_call(
         kernel,
@@ -157,12 +190,8 @@ def paged_decode_attention(
                 pl.BlockSpec(
                     (1, 1, gp, hp), lambda bb, h, p, table, lens: (bb, h, 0, 0)
                 ),
-                pl.BlockSpec(
-                    (1, 1, ps, hp), lambda bb, h, p, table, lens: (h, table[bb, p], 0, 0)
-                ),
-                pl.BlockSpec(
-                    (1, 1, ps, hp), lambda bb, h, p, table, lens: (h, table[bb, p], 0, 0)
-                ),
+                pl.BlockSpec((1, 1, ps, hp), kv_map),
+                pl.BlockSpec((1, 1, ps, hp), kv_map),
             ],
             out_specs=pl.BlockSpec(
                 (1, 1, gp, hp), lambda bb, h, p, table, lens: (bb, h, 0, 0)
@@ -187,6 +216,7 @@ def paged_decode_attention_xla(
     kv_lens: jnp.ndarray,
     scale: float | None = None,
     sliding_window: int = 0,
+    soft_cap: float = 0.0,
 ) -> jnp.ndarray:
     """XLA fallback / oracle: gather the dense view, then masked attention."""
     from edgemesh.ops.attention import LayerKV, attend
@@ -200,6 +230,6 @@ def paged_decode_attention_xla(
     positions = (kv_lens - 1)[:, None]
     out = attend(
         q[:, None], LayerKV(dense_k, dense_v), positions, kv_valid, scale,
-        sliding_window=sliding_window,
+        sliding_window=sliding_window, soft_cap=soft_cap,
     )
     return out[:, 0]

@@ -27,6 +27,7 @@ from edgemesh.models.transformer import (
     _use_flash,
     dense,
     embed_tokens,
+    layer_scan_alt_windows,
     lm_head_logits,
     qkv_proj,
 )
@@ -70,14 +71,18 @@ def _paged_attention(
         if _use_flash(cfg):
             out = paged_decode_attention(
                 q[:, 0], k_pages, v_pages, table, kv_lens,
+                scale=cfg.query_scale,
                 interpret=cfg.attention_impl == "flash"
                 and not on_tpu(),
                 sliding_window=cfg.sliding_window,
+                soft_cap=cfg.attn_soft_cap,
             )
         else:
             out = paged_decode_attention_xla(
                 q[:, 0], k_pages, v_pages, table, kv_lens,
+                scale=cfg.query_scale,
                 sliding_window=cfg.sliding_window,
+                soft_cap=cfg.attn_soft_cap,
             )
         out = out[:, None]
     else:
@@ -92,16 +97,19 @@ def _paged_attention(
             from edgemesh.ops.flash_attention import flash_attention
 
             out = flash_attention(
-                q, k, v, kv_lens, causal=True,
+                q, k, v, kv_lens, causal=True, scale=cfg.query_scale,
                 interpret=cfg.attention_impl == "flash"
                 and not on_tpu(),
                 sliding_window=cfg.sliding_window,
+                soft_cap=cfg.attn_soft_cap,
             )
         else:
             prompt_valid = jnp.arange(s)[None, :] < kv_lens[:, None]
             out = attend(
                 q, LayerKV(k, v), positions, prompt_valid,
+                scale=cfg.query_scale,
                 sliding_window=cfg.sliding_window,
+                soft_cap=cfg.attn_soft_cap,
             )
     proj = dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode)
     return proj, (k_pages, v_pages, table, kv_lens)
@@ -118,16 +126,20 @@ def _paged_forward(
 ):
     x = embed_tokens(cfg, params, tokens)
 
-    def body(h, scanned):
+    def body(layer_cfg, h, scanned):
         layer, k_l, v_l = scanned
         state = (k_l, v_l, cache.page_table, kv_lens)
         h, (k_l, v_l, _, _), _aux = _layer_fn(
-            cfg, h, layer, state, positions, None, cache.lengths, is_decode,
+            layer_cfg, h, layer, state, positions, None, cache.lengths, is_decode,
             _paged_attention,
         )
         return h, (k_l, v_l)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    # Gemma-2's alternating windows ride the shared pair scan (each half's
+    # window a static constant); plain configs take the ordinary scan.
+    x, (new_k, new_v) = layer_scan_alt_windows(
+        cfg, body, x, (params["layers"], cache.k, cache.v)
+    )
     return lm_head_logits(cfg, params, x), cache._replace(k=new_k, v=new_v)
 
 
@@ -186,19 +198,10 @@ def generate_paged(
     """generate() over the paged cache: delegates to runtime.generate.generate
     with the paged forwards plugged in, so validation, timing, and the
     throughput conventions live in exactly one place. Sliding-window configs
-    (Mistral) work end-to-end: the page-table kernel masks and skips pages
-    outside each row's window."""
-
-    if (
-        (cfg.alt_sliding_window and cfg.sliding_window > 0)
-        or cfg.attn_soft_cap > 0
-        or cfg.query_pre_attn_scalar > 0
-    ):
-        raise NotImplementedError(
-            "the paged decode kernels apply one window, default query "
-            "scaling, and no score soft cap; Gemma-2 models use the dense "
-            "KV backend"
-        )
+    (Mistral) work end-to-end — the page-table kernel never DMAs pages
+    outside a row's window — and Gemma-2's full dial set (score soft cap,
+    fixed query scale, ALTERNATING windows via the shared pair scan) runs
+    here too, pinned against the dense backend in tests/test_paged_kv.py."""
 
     def make_cache(cfg, batch, needed):
         per_row = (needed + page_size - 1) // page_size
