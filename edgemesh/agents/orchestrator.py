@@ -411,11 +411,32 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
 
         params = init_params(cfg, jax.random.PRNGKey(crc32(role_seed.encode()) % (2**31)))
 
+    if ms.train_checkpoint:
+        # Swap in finetuned weights from an `edgemesh train` run BEFORE any
+        # precision transform below, so int8/int4 rows quantize the TRAINED
+        # weights. The synthetic/HF init above is the restore template —
+        # architecture fields must match the training run's spec.
+        from edgemesh.runtime.checkpoint import TrainCheckpointManager
+        from edgemesh.training import init_train_state, make_optimizer
+
+        mgr = TrainCheckpointManager(ms.train_checkpoint)
+        template = init_train_state(cfg, params, make_optimizer())
+        restored = mgr.restore_latest(template)
+        mgr.close()
+        if restored is None:
+            raise ValueError(
+                f"no training checkpoint found under {ms.train_checkpoint!r} "
+                "(run `edgemesh train` with train.checkpoint_dir first)"
+            )
+        params = restored[0].params
+        log.info("%s: restored trained params from %s (step %d)",
+                 role_seed, ms.train_checkpoint, restored[1])
+
     if ms.precision == "int4":
         from edgemesh.ops.int4 import quantize_params_int4
 
         params = quantize_params_int4(params, group_size=ms.int4_group_size)
-    elif ms.precision in ("int8", "int8_w8a8", "int8_w8a8_pallas"):
+    elif ms.precision in ("int8", "int8_w8a8", "int8_w8a8_pallas", "int8_w8a8_auto"):
         if ms.calibration:
             if ms.precision == "int8":
                 # Weight-only (w8a16) keeps activations in fp: smoothing has
@@ -438,8 +459,16 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
         else:
             params = quantize_params(params)
         # "int8" = weight-only (w8a16); the suffixed variants run activations
-        # in int8 too — XLA dynamic quant or the fused Pallas kernel.
-        if ms.precision != "int8":
+        # in int8 too — XLA dynamic quant, the fused Pallas kernel, or
+        # "_auto": measure both on this model's shapes and take the winner
+        # (ops/int8.measure_w8a8_mode; off-TPU resolves to the XLA path).
+        if ms.precision == "int8_w8a8_auto":
+            from edgemesh.ops.int8 import measure_w8a8_mode
+
+            mode = measure_w8a8_mode(params)
+            log.info("%s: w8a8 auto-pick -> %s", role_seed, mode)
+            cfg = cfg.replace(quant_mode=mode)
+        elif ms.precision != "int8":
             cfg = cfg.replace(quant_mode=ms.precision.removeprefix("int8_"))
     elif ms.precision in ("bf16", "fp16", "fp32"):
         dtype = {"bf16": jnp.bfloat16, "fp16": jnp.float16, "fp32": jnp.float32}[ms.precision]

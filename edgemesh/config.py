@@ -72,7 +72,9 @@ class ModelSpec:
     hub_id: str = ""
     family: str = "auto"  # auto | llama | neox | phi2 | mistral | qwen2 | gemma | gemma2 | phi3
     # bf16 | fp16 | fp32 | int8 (weight-only w8a16) | int8_w8a8 (dynamic
-    # activation quant, int8xint8 MXU) | int8_w8a8_pallas (fused kernel)
+    # activation quant, int8xint8 MXU) | int8_w8a8_pallas (fused kernel) |
+    # int8_w8a8_auto (measure both w8a8 paths on this model's shapes at
+    # build and run the winner — ops/int8.measure_w8a8_mode)
     precision: str = "bf16"
     # Architecture overrides for synthetic (random-init) models; ignored when
     # loading a real checkpoint.
@@ -88,6 +90,12 @@ class ModelSpec:
     # Int4 scale granularity: 0 = per-channel (fastest), g>0 = grouped
     # (GPTQ/AWQ-style quality remedy; must be even). See ops/int4.py.
     int4_group_size: int = 64
+    # Load finetuned weights from an `edgemesh train` checkpoint directory
+    # (train.checkpoint_dir): the latest step's params replace the
+    # synthetic/HF init BEFORE any precision transform, so int8/int4 rows
+    # quantize the TRAINED weights. Architecture fields must match the
+    # training run's model spec.
+    train_checkpoint: str = ""
     # SmoothQuant calibration for int8 precisions: path to a text file of
     # calibration prompts (one per line). When set, quantization smooths
     # activation outliers into the weights using these prompts' statistics
@@ -176,6 +184,12 @@ class TrainSpec:
     seq_len: int = 128
     lr: float = 1e-4
     weight_decay: float = 0.01
+    # Train-split selection over the QA corpus: skip the first
+    # ``skip_samples`` rows, then take ``num_samples`` (0 = the rest).
+    # Disjoint splits per model are the complementary-knowledge setup of
+    # the quality experiment (docs/QUALITY.md).
+    num_samples: int = 0
+    skip_samples: int = 0
     # "" disables checkpointing; otherwise rotating step checkpoints land
     # here and a rerun resumes from the latest.
     checkpoint_dir: str = ""

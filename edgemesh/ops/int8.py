@@ -197,13 +197,11 @@ def _int8_matmul_kernel(x_ref, w_ref, wscale_ref, out_ref, acc_ref):
     MXU into the int32-ish fp32 accumulator; on the last K step apply the
     per-column weight scale and write out. Activation scale is per-row within
     the tile (computed per K-block, folded immediately — block-local dynamic
-    quantization)."""
+    quantization). When the whole contraction fits one K stripe (nk == 1,
+    the common decode case: tile_k == K) the accumulator round-trip is
+    skipped entirely — quantize → dot → scale → store."""
     k_step = pl.program_id(2)
     nk = pl.num_programs(2)
-
-    @pl.when(k_step == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
 
     x_blk = x_ref[:].astype(jnp.float32)  # [TM, TK]
     absmax = jnp.max(jnp.abs(x_blk), axis=1, keepdims=True)
@@ -212,6 +210,18 @@ def _int8_matmul_kernel(x_ref, w_ref, wscale_ref, out_ref, acc_ref):
     prod = jax.lax.dot_general(
         x_q, w_ref[:], (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
     )
+
+    if nk == 1:  # single K stripe: no scratch init/read/write
+        out_ref[:] = (
+            prod.astype(jnp.float32) * x_scale
+            * wscale_ref[0, :].astype(jnp.float32)
+        ).astype(out_ref.dtype)
+        return
+
+    @pl.when(k_step == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
     acc_ref[:] += prod.astype(jnp.float32) * x_scale
 
     @pl.when(k_step == nk - 1)
@@ -253,6 +263,13 @@ def pallas_int8_matmul(
     assert m % tile_m == 0 and n % tile_n == 0 and k % tile_k == 0, (m, n, k)
 
     grid = (m // tile_m, n // tile_n, k // tile_k)
+    kwargs = {}
+    if not interpret:
+        # M/N tiles are independent (parallel); K carries the accumulator
+        # (arbitrary) — lets Mosaic pipeline the weight-stripe DMAs.
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
     return pl.pallas_call(
         _int8_matmul_kernel,
         grid=grid,
@@ -265,7 +282,70 @@ def pallas_int8_matmul(
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((tile_m, tile_n), jnp.float32)],
         interpret=interpret,
+        **kwargs,
     )(x, w_q, scales.reshape(1, -1))
+
+
+def measure_w8a8_mode(params: Params, batch: int = 8, repeats: int = 3) -> str:
+    """Measurement-driven w8a8 path selection (ADR in docs/PERFORMANCE.md).
+
+    Times the XLA dynamic-quant path against the fused Pallas kernel on THIS
+    param tree's actual dense shapes at decode-like batch, and returns the
+    faster ``quant_mode`` ("w8a8" or "w8a8_pallas"). Rationale: at decode
+    sizes both paths stream the same int8 weight bytes from HBM — fusion can
+    only match, not beat, the XLA path's bandwidth bound, and round-2
+    on-chip measurement had the kernel ~19% behind (2102 vs 2580 tok/s,
+    artifacts/bench_2026-07-30_r2.json) — so the shipped default for
+    ``precision: int8_w8a8_auto`` is whatever wins on the deployed shapes,
+    never an unmeasured path. Off-TPU this returns "w8a8" without measuring
+    (interpret-mode timings are meaningless).
+    """
+    import time
+
+    from edgemesh.utils.platform import device_sync, on_tpu
+
+    if not on_tpu() or pl is None:
+        return "w8a8"
+
+    shapes: dict[tuple, tuple] = {}
+
+    def walk(node):
+        if isinstance(node, dict):
+            if "kernel_q" in node:
+                wq = node["kernel_q"]
+                w = wq[0] if wq.ndim == 3 else wq
+                s = node["scales"][0] if node["scales"].ndim == 2 else node["scales"]
+                shapes.setdefault(tuple(w.shape), (w, s))
+            else:
+                for v in node.values():
+                    walk(v)
+
+    walk(params)
+    if not shapes:
+        return "w8a8"
+    mats = list(shapes.values())
+    xs = [
+        jax.random.normal(jax.random.PRNGKey(0), (batch, w.shape[0]), jnp.bfloat16)
+        for w, _ in mats
+    ]
+
+    def run_xla(xs):
+        return [int8_matmul_dynamic(x, w, s) for x, (w, s) in zip(xs, mats)]
+
+    def run_pallas(xs):
+        return [int8_matmul_fused(x, w, s) for x, (w, s) in zip(xs, mats)]
+
+    timings: dict[str, float] = {}
+    for name, fn in (("w8a8", run_xla), ("w8a8_pallas", run_pallas)):
+        f = jax.jit(fn)
+        device_sync(f(xs))  # compile + warm
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            device_sync(f(xs))
+            best = min(best, time.perf_counter() - t0)
+        timings[name] = best
+    return min(timings, key=timings.__getitem__)
 
 
 def int8_matmul_fused(
@@ -304,7 +384,13 @@ def int8_matmul_fused(
     # (K=2048, N=8192), 128/128/512 tiles ran 22 TF vs 41 TF with
     # 128/512/2048 — within 10% of the XLA w8a8 path.
     tile_k = next((t for t in (2048, 1024, 512, 256, 128) if k % t == 0), None)
-    tile_n = next((t for t in (512, 256, 128) if n % t == 0), None)
+    # Decode-shaped calls (tiny M) amortize per-grid-step overhead over few
+    # output rows, so wider N tiles (fewer steps, larger weight-stripe DMAs)
+    # help; 2 MB per int8 stripe keeps double-buffering within VMEM.
+    n_opts = (1024, 512, 256, 128) if m <= 32 else (512, 256, 128)
+    tile_n = next(
+        (t for t in n_opts if n % t == 0 and (tile_k or 0) * t <= 2**21), None
+    )
     if pl is None or tile_k is None or tile_n is None or m == 0:
         y = int8_matmul_dynamic(x2, w_q, scales)
         return y.reshape(*lead, n)

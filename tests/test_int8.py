@@ -219,3 +219,44 @@ def test_generate_with_quantized_embedding_runs():
     )
     assert out.tokens.shape == (1, 8)
     assert int(out.num_generated[0]) == 8
+
+
+def test_fused_single_k_stripe_matches_dynamic():
+    """The nk==1 fast path (tile_k == K, no scratch accumulator) must agree
+    with the XLA dynamic path to block-quantization tolerance."""
+    import numpy as np
+
+    from edgemesh.ops.int8 import int8_matmul_dynamic, int8_matmul_fused, quantize_weight
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 256), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (256, 512), jnp.float32)
+    w_q, scales = quantize_weight(w)
+    got = int8_matmul_fused(x, w_q, scales, interpret=True)
+    ref = int8_matmul_dynamic(x, w_q, scales)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=0.05, atol=0.05)
+
+
+def test_measure_w8a8_mode_off_tpu_is_xla():
+    """Off-TPU the auto-pick must resolve to the XLA path without running
+    interpret-mode timings."""
+    from edgemesh.ops.int8 import measure_w8a8_mode, quantize_params
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_params
+
+    cfg = tiny_config("llama")
+    params = quantize_params(init_params(cfg, jax.random.PRNGKey(0)))
+    assert measure_w8a8_mode(params) == "w8a8"
+
+
+def test_w8a8_auto_precision_builds_agent():
+    """precision int8_w8a8_auto materializes with the measured quant_mode
+    (w8a8 on CPU) and generates."""
+    from edgemesh.agents.orchestrator import build_agent
+    from edgemesh.config import AgentSpec, ModelSpec
+
+    agent = build_agent(AgentSpec(role="qa", model=ModelSpec(
+        precision="int8_w8a8_auto", num_layers=2, hidden_size=64)))
+    assert agent.cfg.quant_mode == "w8a8"
+    assert "kernel_q" in agent.params["layers"]["q"]
+    out = agent.answer("Where is the Louvre?")
+    assert isinstance(out["answer"], str)

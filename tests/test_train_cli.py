@@ -124,3 +124,44 @@ train:
     assert rc == 0
     report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert report["steps_run"] == 4 and report["final_loss"] > 0
+
+
+def test_train_split_selection():
+    """skip_samples/num_samples carve disjoint train splits; an empty split
+    is refused."""
+    r = run_training(_cfg(num_samples=5, skip_samples=3))
+    assert r["steps_run"] == 12
+    with pytest.raises(ValueError, match="empty train split"):
+        run_training(_cfg(skip_samples=10**9))
+
+
+def test_agent_loads_train_checkpoint(tmp_path):
+    """ModelSpec.train_checkpoint swaps finetuned weights into an agent
+    before precision transforms — int8 rows quantize the TRAINED weights."""
+    import numpy as np
+
+    from edgemesh.agents.orchestrator import build_agent
+
+    ckpt = str(tmp_path / "ck")
+    run_training(_cfg(checkpoint_dir=ckpt, checkpoint_every=6))
+
+    spec = ModelSpec(num_layers=2, hidden_size=64)  # same arch as training
+    fresh = build_agent(AgentSpec(role="qa", model=spec))
+    spec_t = ModelSpec(num_layers=2, hidden_size=64, train_checkpoint=ckpt)
+    trained = build_agent(AgentSpec(role="qa", model=spec_t))
+    # Trained weights differ from the random init...
+    assert not np.allclose(
+        np.asarray(fresh.params["embed"]["weight"], np.float32),
+        np.asarray(trained.params["embed"]["weight"], np.float32),
+    )
+    # ...and the quantized variant carries them too (int8 leaves present).
+    spec_q = ModelSpec(num_layers=2, hidden_size=64, train_checkpoint=ckpt,
+                       precision="int8")
+    quant = build_agent(AgentSpec(role="qa", model=spec_q))
+    assert "kernel_q" in quant.params["layers"]["q"]
+    ans = quant.answer("What is the capital of France?")
+    assert isinstance(ans["answer"], str)
+
+    with pytest.raises(ValueError, match="no training checkpoint"):
+        build_agent(AgentSpec(role="qa", model=ModelSpec(
+            num_layers=2, hidden_size=64, train_checkpoint=str(tmp_path / "none"))))
