@@ -79,6 +79,12 @@ class Agent:
     prefix_cache: bool = True
     _prefix: Any = field(default=None, repr=False)
     _prefix_lock: Any = field(default_factory=threading.Lock, repr=False)
+    # Shape signatures this agent has already generated with. The first call
+    # at a new (rows, bucket) pays the XLA compile inside its measured
+    # prefill window; results from such calls carry ``compiled: True`` so
+    # latency consumers (eval/harness.aggregate) can report steady-state
+    # serving percentiles separately from compile events.
+    _seen_shapes: set = field(default_factory=set, repr=False)
 
     def format_prompt(self, question: str, **extra) -> str:
         return self.prompt_template.format(question=question, **extra)
@@ -231,6 +237,9 @@ class Agent:
             self.format_prompt(q) for q in questions
         ]
         tokens, lengths, n = self._prepare_batch(prompts)
+        sig = tokens.shape
+        first_compile = sig not in self._seen_shapes
+        self._seen_shapes.add(sig)
         eos_id = getattr(self.tokenizer, "eos_id", -1)
         if self.draft_cfg is not None:
             from edgemesh.runtime.speculative import generate_speculative
@@ -273,6 +282,10 @@ class Agent:
                     "batch_tps": result.tokens_per_sec,
                     "batch_size": n,
                     "ttft_s": result.prefill_time_s,
+                    # First call at this shape: the measured window includes
+                    # the XLA compile — flagged so latency aggregation can
+                    # split compile events from steady-state serving.
+                    "compiled": first_compile,
                     "confidence": float(result.confidence[i]),
                     # Wall-clock span of this agent's work — lets callers
                     # verify ensemble agents actually overlapped (tests /
@@ -371,6 +384,8 @@ class Ensemble:
                     "confidence": ref["confidence"],
                     "tps": sum(tps_values) / len(tps_values),  # mean-of-models, try.py:317-326
                     "ttft_s": drafts[0]["ttft_s"],
+                    "compiled": any(d.get("compiled") for d in drafts)
+                    or bool(ref.get("compiled")),
                     "batch_size": ref.get("batch_size", 1),
                     "drafts": list(drafts),
                 }

@@ -44,12 +44,13 @@ def _setup_logging(cfg: EdgeMeshConfig):
 
 def cmd_eval(cfg: EdgeMeshConfig) -> int:
     from edgemesh.agents import build_ensemble
-    from edgemesh.eval.data import load_qa_csv, resolve_dataset_path
+    from edgemesh.eval.data import load_qa, resolve_dataset_path
     from edgemesh.eval.embedder import build_embedder
     from edgemesh.eval.harness import run_eval
 
     ensemble = build_ensemble(cfg)
-    samples = load_qa_csv(resolve_dataset_path(cfg.eval.dataset_path), limit=cfg.eval.num_samples)
+    samples = load_qa(resolve_dataset_path(cfg.eval.dataset_path),
+                      split=cfg.eval.dataset_split, limit=cfg.eval.num_samples)
     # Only pay for an embedding model when an embedding metric is requested.
     needs_embedder = bool({"cosine", "bertscore"} & set(cfg.eval.metrics))
     report = run_eval(

@@ -190,6 +190,12 @@ class TrainSpec:
     # the quality experiment (docs/QUALITY.md).
     num_samples: int = 0
     skip_samples: int = 0
+    # Alternate corpus: a JSONL of {"text": ...} rows trained as plain LM
+    # sequences instead of the QA CSV's Question/Answer format. Used e.g.
+    # to train a refiner on refiner-formatted prompts built from the QA
+    # models' own drafts (docs/QUALITY.md stage 2). Split selection above
+    # applies to these rows too.
+    corpus_jsonl: str = ""
     # "" disables checkpointing; otherwise rotating step checkpoints land
     # here and a rerun resumes from the latest.
     checkpoint_dir: str = ""

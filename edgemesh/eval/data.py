@@ -94,3 +94,52 @@ def _load_qa_csv_py(path: str | Path, limit: int | None = None) -> list[QASample
                 break
             samples.append(QASample(i, row[qcol], row[acol]))
     return samples
+
+
+def load_qa(
+    path: str | Path, split: str = "train", limit: int | None = None
+) -> list[QASample]:
+    """Unified loader for both of the reference's dataset dialects: raw CSV
+    (``try.py:292``) and HF datasets (``combiner_fp.py:413``). A ``.csv``
+    path takes the native/stdlib CSV parser; anything else — a
+    ``save_to_disk`` directory or a locally-cached hub id like
+    ``sentence-transformers/natural-questions`` — goes through HF datasets
+    in OFFLINE mode (this environment has no egress; a cache miss raises
+    rather than dials out)."""
+    if str(path).endswith(".csv"):
+        return load_qa_csv(path, limit)
+    return load_qa_hf(path, split, limit)
+
+
+def load_qa_hf(
+    name_or_dir: str | Path, split: str = "train", limit: int | None = None
+) -> list[QASample]:
+    """HF-datasets loading from LOCAL storage only (combiner_fp.py:413
+    parity — the reference calls load_dataset over the network; here
+    HF_DATASETS_OFFLINE pins the lookup to the on-disk cache)."""
+    os.environ.setdefault("HF_DATASETS_OFFLINE", "1")
+    from datasets import load_dataset, load_from_disk
+
+    p = Path(str(name_or_dir))
+    base_split = split.split("[", 1)[0] if split else "train"
+    if p.is_dir() and (
+        (p / "dataset_info.json").exists() or (p / "dataset_dict.json").exists()
+    ):
+        ds = load_from_disk(str(p))
+        if not hasattr(ds, "features"):  # DatasetDict: pick the split
+            ds = ds[base_split]
+    else:
+        ds = load_dataset(str(name_or_dir), split=split)
+    cols = set(ds.column_names)
+    qcol = next((c for c in ("query", "question") if c in cols), None)
+    acol = "answer" if "answer" in cols else None
+    if qcol is None or acol is None:
+        raise ValueError(
+            f"dataset {name_or_dir} needs query/question + answer columns, "
+            f"got {sorted(cols)}"
+        )
+    n = len(ds) if limit is None else min(limit, len(ds))
+    return [
+        QASample(index=i, question=str(ds[i][qcol]), answer=str(ds[i][acol]))
+        for i in range(n)
+    ]

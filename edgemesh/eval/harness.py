@@ -178,7 +178,7 @@ def run_eval(
                 if error is not None:
                     raise error
                 row["answer"] = result.get("answer", "")
-                for k in ("tps", "confidence", "ttft_s", "batch_size"):
+                for k in ("tps", "confidence", "ttft_s", "batch_size", "compiled"):
                     if k in result:
                         row[k] = result[k]
                 row.update(
@@ -253,15 +253,30 @@ def aggregate(rows: list[dict]) -> dict[str, float]:
     """Mean of every metric column (the reference's np.mean block,
     combiner_fp.py:465-474) plus p50/p95 latency percentiles for the
     throughput columns — the BASELINE.json latency metric is p50 TTFT, which
-    a bare mean can't report."""
+    a bare mean can't report.
+
+    Latency percentiles cover STEADY-STATE rows only: calls whose measured
+    window included an XLA compile (the agent flags them ``compiled``) are
+    excluded and reported separately as ``ttft_s_compile_max`` /
+    ``num_compile_rows`` — otherwise segment-initial compiles masquerade as
+    a serving tail (round-2 flagship artifact: p95 6.7s vs p50 0.09s, all
+    of it compile time). If every row compiled (tiny smoke runs), the full
+    pool is used so percentiles don't vanish."""
     report: dict[str, float] = {}
     for key in METRIC_KEYS:
         vals = [r[key] for r in rows if key in r and r[key] is not None]
         if vals:
             report[key] = float(np.mean(vals))
+    steady = [r for r in rows if not r.get("compiled")]
+    pool = steady or rows
     for key in ("tps", "ttft_s"):
-        vals = [r[key] for r in rows if key in r and r[key] is not None]
+        vals = [r[key] for r in pool if key in r and r[key] is not None]
         if vals:
             report[f"{key}_p50"] = float(np.percentile(vals, 50))
             report[f"{key}_p95"] = float(np.percentile(vals, 95))
+    compile_ttfts = [r["ttft_s"] for r in rows
+                     if r.get("compiled") and r.get("ttft_s") is not None]
+    if compile_ttfts:
+        report["ttft_s_compile_max"] = float(max(compile_ttfts))
+        report["num_compile_rows"] = float(len(compile_ttfts))
     return report

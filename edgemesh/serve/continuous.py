@@ -256,6 +256,11 @@ class ContinuousEngine:
 
                 # Bridge into the next segment for rows still going (the loop
                 # stops before a wasted trailing forward; run it for the batch).
+                # This whole-batch step also advances lengths / writes one KV
+                # row for retired and idle slots — garbage BY DESIGN: idle-slot
+                # state is meaningless until _splice_slot resets lengths on
+                # admission, and writes clamp at capacity. Do not read idle
+                # rows' lengths as if they tracked anything.
                 if any(s.active for s in self._slots):
                     logits, self._cache = forward_decode(self.cfg, agent.params, prev, self._cache)
                     self._logits = logits.astype(self._logits.dtype)

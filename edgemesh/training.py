@@ -120,14 +120,22 @@ def run_training(run_cfg) -> dict[str, Any]:
     if ts.seq_len > cfg.max_seq_len:
         raise ValueError(f"train.seq_len {ts.seq_len} > max_seq_len {cfg.max_seq_len}")
 
-    # Corpus: Q/A rows → fixed-length LM sequences. Split selection
-    # (skip_samples/num_samples) lets each model train on its own rows —
-    # the complementary-knowledge setup of docs/QUALITY.md.
-    samples = load_qa_csv(resolve_dataset_path(run_cfg.eval.dataset_path))
-    samples = samples[ts.skip_samples:]
+    # Corpus: Q/A rows (or a {"text": ...} JSONL via train.corpus_jsonl) →
+    # fixed-length LM sequences. Split selection (skip_samples/num_samples)
+    # lets each model train on its own rows — the complementary-knowledge
+    # setup of docs/QUALITY.md.
+    if ts.corpus_jsonl:
+        import json as _json
+
+        with open(ts.corpus_jsonl) as f:
+            texts = [_json.loads(line)["text"] for line in f if line.strip()]
+    else:
+        samples = load_qa_csv(resolve_dataset_path(run_cfg.eval.dataset_path))
+        texts = [f"Question: {s.question}\nAnswer: {s.answer}" for s in samples]
+    texts = texts[ts.skip_samples:]
     if ts.num_samples:
-        samples = samples[: ts.num_samples]
-    if not samples:
+        texts = texts[: ts.num_samples]
+    if not texts:
         raise ValueError(
             f"empty train split (skip_samples={ts.skip_samples}, "
             f"num_samples={ts.num_samples})"
@@ -135,14 +143,11 @@ def run_training(run_cfg) -> dict[str, Any]:
     pad = getattr(tokenizer, "pad_id", 0)
     eos = getattr(tokenizer, "eos_id", None)
     rows, lens = [], []
-    for s in samples:
+    for text in texts:
         # Reserve one slot for EOS so the model learns to STOP after the
         # answer — without it generation always runs to max_new_tokens and
         # trailing babble wrecks precision-style metrics.
-        ids = tokenizer.encode(
-            f"Question: {s.question}\nAnswer: {s.answer}",
-            max_len=ts.seq_len - (eos is not None),
-        )
+        ids = tokenizer.encode(text, max_len=ts.seq_len - (eos is not None))
         if eos is not None:
             ids = ids + [eos]
         rows.append(ids + [pad] * (ts.seq_len - len(ids)))

@@ -227,3 +227,34 @@ def test_compare_excludes_error_rows(tmp_path):
 
     with pytest.raises(ValueError, match="carry errors"):
         compare_runs(allerr, allerr)
+
+
+def test_load_qa_hf_from_disk(tmp_path):
+    """HF-datasets dialect (combiner_fp.py:413 parity): a save_to_disk
+    dataset loads offline through the unified load_qa entry; CSV paths keep
+    the CSV parser."""
+    import datasets as hfd
+
+    from edgemesh.eval.data import load_qa
+
+    ds = hfd.Dataset.from_dict({
+        "query": ["q one", "q two", "q three"],
+        "answer": ["a one", "a two", "a three"],
+    })
+    d = tmp_path / "nq_tiny"
+    ds.save_to_disk(str(d))
+    samples = load_qa(d, split="train", limit=2)
+    assert [s.question for s in samples] == ["q one", "q two"]
+    assert samples[1].answer == "a two"
+
+    dd = tmp_path / "nq_dict"
+    hfd.DatasetDict({"train": ds}).save_to_disk(str(dd))
+    samples = load_qa(dd, split="train[:1000]")
+    assert len(samples) == 3
+
+    import pytest
+
+    with pytest.raises(ValueError, match="columns"):
+        bad = tmp_path / "bad"
+        hfd.Dataset.from_dict({"x": ["1"]}).save_to_disk(str(bad))
+        load_qa(bad)
