@@ -34,8 +34,8 @@ use the pinned synthetic ModelEmbedder (no MiniLM checkpoint on disk; the
 bert-family ingest exists for when one is).
 
 Run: JAX_PLATFORMS=cpu python artifacts/quality/run_quality.py
-Env: EDGEMESH_QUALITY_STEPS (default 3500), EDGEMESH_QUALITY_REFINER_STEPS
-     (default 2500), EDGEMESH_QUALITY_ROWS (1000),
+Env: EDGEMESH_QUALITY_STEPS (default 2200), EDGEMESH_QUALITY_REFINER_STEPS
+     (default 800), EDGEMESH_QUALITY_ROWS (1000),
      EDGEMESH_QUALITY_DIR (artifacts/quality).
 """
 
@@ -166,7 +166,10 @@ def main() -> None:
     half = max(1, len(samples) // 2)
 
     ck_a = train("qa_a", 0, half, STEPS, seq_len=128)
-    ck_b = train("qa_b", half, 0, STEPS, seq_len=128)
+    # take exactly the second half of the EVAL window — take=0 ("the rest")
+    # would spill past ROWS whenever ROWS < the CSV size and break the
+    # disjoint-half symmetry the ensemble claim rests on.
+    ck_b = train("qa_b", half, len(samples) - half, STEPS, seq_len=128)
 
     a_fp = agent("qa_a", ck_a)
     b_fp = agent("qa_b", ck_b)

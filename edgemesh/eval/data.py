@@ -117,17 +117,30 @@ def load_qa_hf(
     """HF-datasets loading from LOCAL storage only (combiner_fp.py:413
     parity — the reference calls load_dataset over the network; here
     HF_DATASETS_OFFLINE pins the lookup to the on-disk cache)."""
+    import re
+
     os.environ.setdefault("HF_DATASETS_OFFLINE", "1")
     from datasets import load_dataset, load_from_disk
 
     p = Path(str(name_or_dir))
-    base_split = split.split("[", 1)[0] if split else "train"
     if p.is_dir() and (
         (p / "dataset_info.json").exists() or (p / "dataset_dict.json").exists()
     ):
+        # save_to_disk layout: apply the split's [a:b] slice OURSELVES so a
+        # spec like "train[500:]" means the same rows here as it does on the
+        # load_dataset branch (silently dropping it would eval wrong rows).
+        m = re.fullmatch(r"(\w+)(?:\[(-?\d*):(-?\d*)\])?", split or "train")
+        if m is None:
+            raise ValueError(f"unsupported split spec {split!r} for a "
+                             "save_to_disk dataset (use name[a:b])")
+        base_split, start, stop = m.group(1), m.group(2), m.group(3)
         ds = load_from_disk(str(p))
         if not hasattr(ds, "features"):  # DatasetDict: pick the split
             ds = ds[base_split]
+        if start or stop:
+            idx = range(len(ds))[slice(int(start) if start else None,
+                                       int(stop) if stop else None)]
+            ds = ds.select(idx)
     else:
         ds = load_dataset(str(name_or_dir), split=split)
     cols = set(ds.column_names)
@@ -139,7 +152,9 @@ def load_qa_hf(
             f"got {sorted(cols)}"
         )
     n = len(ds) if limit is None else min(limit, len(ds))
+    ds = ds.select(range(n))
+    questions, answers = ds[qcol], ds[acol]  # bulk column reads (Arrow-fast)
     return [
-        QASample(index=i, question=str(ds[i][qcol]), answer=str(ds[i][acol]))
-        for i in range(n)
+        QASample(index=i, question=str(q), answer=str(a))
+        for i, (q, a) in enumerate(zip(questions, answers))
     ]

@@ -251,6 +251,11 @@ def test_load_qa_hf_from_disk(tmp_path):
     hfd.DatasetDict({"train": ds}).save_to_disk(str(dd))
     samples = load_qa(dd, split="train[:1000]")
     assert len(samples) == 3
+    # Slices APPLY on the save_to_disk branch too (same rows as a hub id).
+    samples = load_qa(dd, split="train[1:]")
+    assert [s.question for s in samples] == ["q two", "q three"]
+    samples = load_qa(dd, split="train[:2]")
+    assert len(samples) == 2
 
     import pytest
 
