@@ -162,27 +162,32 @@ class Agent:
         multi-byte/multi-token characters split across a chunk boundary
         never emit garbage halves.
 
-        Streams the PLAIN decode loop: a configured speculative draft model
-        is not used here (the speculative loop emits variable-size rounds;
-        chunked streaming of it is future work) — non-streamed answers keep
-        the acceleration."""
+        With a speculative draft configured, streaming rides the segmented
+        speculative loop (runtime/speculative.generate_speculative_stream):
+        deltas arrive per verify-round segment and keep the draft-model
+        acceleration — the two marquee decode features compose."""
         from edgemesh.runtime.stream import generate_stream
 
-        if self.draft_cfg is not None:
-            log.warning(
-                "agent %r: streaming uses the plain decode loop; the "
-                "speculative draft model only accelerates non-streamed answers",
-                self.role,
-            )
         prompt = prompt if prompt is not None else self.format_prompt(question)
         tokens, lengths, _ = self._prepare_batch([prompt])
+        eos = getattr(self.tokenizer, "eos_id", -1)
+        if self.draft_cfg is not None:
+            from edgemesh.runtime.speculative import generate_speculative_stream
+
+            segments = generate_speculative_stream(
+                self.cfg, self.params, self.draft_cfg, self.draft_params,
+                tokens, lengths, self.sampling, gamma=self.spec_gamma,
+                eos_id=eos,
+            )
+        else:
+            segments = generate_stream(
+                self.cfg, self.params, tokens, lengths, self.sampling,
+                eos_id=eos, chunk=chunk,
+            )
         all_ids: list[int] = []
         text = ""
         t_start = time.perf_counter()
-        for seg in generate_stream(
-            self.cfg, self.params, tokens, lengths, self.sampling,
-            eos_id=getattr(self.tokenizer, "eos_id", -1), chunk=chunk,
-        ):
+        for seg in segments:
             n = int(seg.counts[0])
             all_ids.extend(int(t) for t in seg.tokens[0][:n])
             new_text = self.tokenizer.decode(jnp.asarray(all_ids, jnp.int32))

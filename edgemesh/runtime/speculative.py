@@ -80,7 +80,7 @@ def _match_prob(idx: jnp.ndarray, probs: jnp.ndarray, token: jnp.ndarray) -> jnp
 
 
 @partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 7))
-def _spec_loop(
+def _spec_init(
     cfg_t: ModelConfig,
     cfg_d: ModelConfig,
     params_t,
@@ -94,22 +94,50 @@ def _spec_loop(
     d_cache: KVCache,
     mask: jax.Array,
     rng: jax.Array,
-):
-    batch, vocab = first_logits.shape
+) -> _SpecState:
+    """Initial loop state: slot 0 sampled from the TARGET's prefill logits —
+    same as the dense path."""
+    batch, _ = first_logits.shape
     cap = max_new + gamma + 1
-
-    # Slot 0 from the TARGET's prefill logits — same as the dense path.
     rng, r0 = jax.random.split(rng)
     token0 = sample_token(r0, first_logits, sampling, mask).astype(jnp.int32)
     out = jnp.full((batch, cap), eos_id, jnp.int32).at[:, 0].set(token0)
     conf0 = jnp.max(jax.nn.softmax(first_logits.astype(jnp.float32), axis=-1), axis=-1)
     finished = token0 == eos_id
     mask = TokenMaskState(mask).add(token0).mask
+    return _SpecState(
+        pending=token0,
+        t_cache=t_cache,
+        d_cache=d_cache,
+        out=out,
+        n_emit=jnp.ones((batch,), jnp.int32),
+        finished=finished,
+        mask=mask,
+        rng=rng,
+        conf_sum=conf0,
+        accepted=jnp.zeros((), jnp.int32),
+        proposed=jnp.zeros((), jnp.int32),
+        rounds=jnp.zeros((), jnp.int32),
+    )
 
-    def cond(s: _SpecState):
-        return ~jnp.all(s.finished | (s.n_emit >= max_new))
+
+def _make_spec_body(
+    cfg_t: ModelConfig,
+    cfg_d: ModelConfig,
+    params_t,
+    params_d,
+    sampling: SamplingParams,
+    gamma: int,
+    max_new: int,
+    eos_id: int,
+    vocab: int,
+    cap: int,
+):
+    """One draft→verify→accept→commit round as a while_loop body — shared by
+    the run-to-completion loop and the segmented streaming loop."""
 
     def body(s: _SpecState):
+        batch = s.pending.shape[0]
         active = ~s.finished & (s.n_emit < max_new)
         L_t, L_d = s.t_cache.lengths, s.d_cache.lengths
         rng, r_draft, r_acc, r_res = jax.random.split(s.rng, 4)
@@ -250,27 +278,38 @@ def _spec_loop(
             rounds=s.rounds + 1,
         )
 
-    init = _SpecState(
-        pending=token0,
-        t_cache=t_cache,
-        d_cache=d_cache,
-        out=out,
-        n_emit=jnp.ones((batch,), jnp.int32),
-        finished=finished,
-        mask=mask,
-        rng=rng,
-        conf_sum=conf0,
-        accepted=jnp.zeros((), jnp.int32),
-        proposed=jnp.zeros((), jnp.int32),
-        rounds=jnp.zeros((), jnp.int32),
+    return body
+
+
+@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 7, 8, 9))
+def _spec_rounds(
+    cfg_t: ModelConfig,
+    cfg_d: ModelConfig,
+    params_t,
+    params_d,
+    sampling: SamplingParams,
+    gamma: int,
+    max_new: int,
+    eos_id: int,
+    vocab: int,
+    cap: int,
+    state: _SpecState,
+    budget: jax.Array,  # [] int32 — run at most this many MORE rounds
+) -> _SpecState:
+    """Advance the acceptance loop until every row is done or ``budget``
+    additional rounds have run. ``budget = max_new`` runs to completion (a
+    round always commits ≥1 token per active row); small budgets are the
+    streaming segments."""
+    body = _make_spec_body(
+        cfg_t, cfg_d, params_t, params_d, sampling, gamma, max_new, eos_id,
+        vocab, cap,
     )
-    final = jax.lax.while_loop(cond, body, init)
-    n_gen = jnp.minimum(final.n_emit, max_new)
-    confidence = final.conf_sum / jnp.maximum(final.n_emit, 1)
-    return (
-        final.out[:, :max_new], n_gen, confidence,
-        final.accepted, final.proposed, final.rounds,
-    )
+    until = state.rounds + budget
+
+    def cond(s: _SpecState):
+        return (~jnp.all(s.finished | (s.n_emit >= max_new))) & (s.rounds < until)
+
+    return jax.lax.while_loop(cond, body, state)
 
 
 def generate_speculative(
@@ -288,6 +327,56 @@ def generate_speculative(
     """Speculative decode: emits the target's distribution exactly, several
     tokens per verify chunk when the draft agrees. Both models must share a
     tokenizer/vocab (standard speculative constraint)."""
+    state, t0, t1 = _spec_prefill(
+        cfg_target, params_target, cfg_draft, params_draft, tokens, lengths,
+        sampling, gamma, eos_id, rng,
+    )
+    from edgemesh.utils.platform import device_sync
+    from edgemesh.utils.tracing import trace
+
+    batch, prompt_len = tokens.shape
+    max_new = int(sampling.max_new_tokens)
+    cap = max_new + gamma + 1
+    with trace("edgemesh/spec_decode"):
+        # A round commits >=1 token per active row, so max_new rounds always
+        # run to completion.
+        final = _spec_rounds(
+            cfg_target, cfg_draft, params_target, params_draft, sampling,
+            int(gamma), max_new, int(eos_id), cfg_target.vocab_size, cap,
+            state, jnp.asarray(max_new, jnp.int32),
+        )
+        device_sync(final.out)
+    t2 = time.perf_counter()
+
+    n_gen = jnp.minimum(final.n_emit, max_new)
+    confidence = final.conf_sum / jnp.maximum(final.n_emit, 1)
+    total = int(jnp.sum(n_gen))
+    decode_s = t2 - t1
+    wall = t2 - t0
+    stats = SpecStats(
+        proposed=int(final.proposed), accepted=int(final.accepted),
+        rounds=int(final.rounds),
+    )
+    return (
+        GenerateResult(
+            tokens=final.out[:, :max_new],
+            num_generated=n_gen,
+            prefill_time_s=t1 - t0,
+            decode_time_s=decode_s,
+            tokens_per_sec=total / wall if wall > 0 else 0.0,
+            decode_tok_s=(total - batch) / decode_s if decode_s > 0 else 0.0,
+            confidence=confidence,
+        ),
+        stats,
+    )
+
+
+def _spec_prefill(
+    cfg_target, params_target, cfg_draft, params_draft, tokens, lengths,
+    sampling, gamma, eos_id, rng,
+) -> tuple[_SpecState, float, float]:
+    """Validation + both prefills + initial loop state (shared by the
+    run-to-completion and streaming entries). Returns (state, t0, t1)."""
     if cfg_target.vocab_size != cfg_draft.vocab_size:
         raise ValueError(
             f"draft vocab {cfg_draft.vocab_size} != target vocab "
@@ -324,24 +413,86 @@ def generate_speculative(
 
     valid = jnp.arange(prompt_len)[None, :] < lengths[:, None]
     mask = TokenMaskState.init(batch, cfg_target.vocab_size).add_sequence(tokens, valid).mask
-    with trace("edgemesh/spec_decode"):
-        out, n_gen, confidence, accepted, proposed, rounds = _spec_loop(
-            cfg_target, cfg_draft, params_target, params_draft, sampling,
-            int(gamma), max_new, int(eos_id), first_logits, t_cache, d_cache,
-            mask, rng,
-        )
-        device_sync(out)
-    t2 = time.perf_counter()
+    state = _spec_init(
+        cfg_target, cfg_draft, params_target, params_draft, sampling,
+        int(gamma), max_new, int(eos_id), first_logits, t_cache, d_cache,
+        mask, rng,
+    )
+    return state, t0, t1
 
-    total = int(jnp.sum(n_gen))
+
+def generate_speculative_stream(
+    cfg_target: ModelConfig,
+    params_target,
+    cfg_draft: ModelConfig,
+    params_draft,
+    tokens: jax.Array,  # [b, s] right-padded prompts
+    lengths: jax.Array,  # [b]
+    sampling: SamplingParams,
+    gamma: int = 4,
+    eos_id: int = -1,
+    rng: jax.Array | None = None,
+    rounds_per_segment: int = 4,
+):
+    """Streaming speculative decode: yields ``runtime.stream.StreamChunk``
+    records as verify rounds commit tokens, then a final ``(GenerateResult,
+    SpecStats)`` is available via the generator's ``value`` (StopIteration)
+    — or use :func:`edgemesh.agents.Agent.answer_stream`, which consumes
+    this and yields text deltas.
+
+    Each segment runs up to ``rounds_per_segment`` draft→verify rounds in
+    ONE jitted program (the same ``_spec_rounds`` while_loop as the
+    non-streamed path, budget-bounded), so acceptance-dependent variable
+    emission arrives chunk by chunk with one host round-trip per segment.
+    The emitted sequence is the target's distribution exactly; under greedy
+    decoding it is token-for-token the plain greedy output."""
+    import numpy as np
+
+    from edgemesh.runtime.stream import StreamChunk
+    from edgemesh.utils.platform import device_sync
+
+    state, t0, t1 = _spec_prefill(
+        cfg_target, params_target, cfg_draft, params_draft, tokens, lengths,
+        sampling, gamma, eos_id, rng,
+    )
+    batch, _ = tokens.shape
+    max_new = int(sampling.max_new_tokens)
+    cap = max_new + gamma + 1
+    emitted = np.zeros((batch,), np.int32)
+    while True:
+        state = _spec_rounds(
+            cfg_target, cfg_draft, params_target, params_draft, sampling,
+            int(gamma), max_new, int(eos_id), cfg_target.vocab_size, cap,
+            state, jnp.asarray(int(rounds_per_segment), jnp.int32),
+        )
+        device_sync(state.out)
+        n_emit = np.minimum(np.asarray(state.n_emit), max_new)
+        out = np.asarray(state.out)
+        new = n_emit - emitted
+        width = int(new.max()) if new.size else 0
+        seg = np.full((batch, max(width, 1)), eos_id, np.int32)
+        for b in range(batch):
+            seg[b, : new[b]] = out[b, emitted[b] : n_emit[b]]
+        finished = np.asarray(state.finished) | (n_emit >= max_new)
+        yield StreamChunk(
+            tokens=jnp.asarray(seg),
+            counts=jnp.asarray(new),
+            finished=jnp.asarray(finished),
+            elapsed_s=time.perf_counter() - t0,
+        )
+        emitted = n_emit
+        if bool(finished.all()):
+            break
+
+    t2 = time.perf_counter()
+    n_gen = jnp.minimum(state.n_emit, max_new)
+    confidence = state.conf_sum / jnp.maximum(state.n_emit, 1)
+    total = int(np.sum(np.asarray(n_gen)))
     decode_s = t2 - t1
     wall = t2 - t0
-    stats = SpecStats(
-        proposed=int(proposed), accepted=int(accepted), rounds=int(rounds)
-    )
     return (
         GenerateResult(
-            tokens=out,
+            tokens=state.out[:, :max_new],
             num_generated=n_gen,
             prefill_time_s=t1 - t0,
             decode_time_s=decode_s,
@@ -349,5 +500,6 @@ def generate_speculative(
             decode_tok_s=(total - batch) / decode_s if decode_s > 0 else 0.0,
             confidence=confidence,
         ),
-        stats,
+        SpecStats(proposed=int(state.proposed), accepted=int(state.accepted),
+                  rounds=int(state.rounds)),
     )
