@@ -247,3 +247,32 @@ def test_agent_answer_stream_uses_draft():
             text = text[: len(text) - item.get("rewind", 0)] + item["delta"]
     assert final is not None and final["answer"] == plain
     assert text == plain or plain.startswith(text)
+
+
+def test_streaming_speculative_sampled_matches_nonstreamed():
+    """Sampled mode: same rng seed → the segmented stream commits exactly
+    the non-streamed speculative tokens (both run the same jitted rounds;
+    segmentation must not perturb the rng path)."""
+    from edgemesh.runtime.speculative import generate_speculative_stream
+
+    cfg, pt, pd = _models()
+    tokens, lengths = _prompt()
+    s = SamplingParams(max_new_tokens=20, do_sample=True, temperature=0.9,
+                       top_k=8, top_p=1.0, repetition_penalty=1.1, seed=5)
+
+    ref, _ = generate_speculative(cfg, pt, cfg, pd, tokens, lengths, s, gamma=3,
+                                  rng=jax.random.PRNGKey(5))
+    gen = generate_speculative_stream(cfg, pt, cfg, pd, tokens, lengths, s,
+                                      gamma=3, rng=jax.random.PRNGKey(5),
+                                      rounds_per_segment=2)
+    per_row = [[], []]
+    while True:
+        try:
+            seg = next(gen)
+        except StopIteration:
+            break
+        for b in range(2):
+            per_row[b].extend(int(t) for t in seg.tokens[b][: int(seg.counts[b])])
+    for b in range(2):
+        n = int(ref.num_generated[b])
+        assert per_row[b][:n] == [int(t) for t in ref.tokens[b][:n]]
