@@ -165,7 +165,9 @@ class Agent:
         With a speculative draft configured, streaming rides the segmented
         speculative loop (runtime/speculative.generate_speculative_stream):
         deltas arrive per verify-round segment and keep the draft-model
-        acceleration — the two marquee decode features compose."""
+        acceleration — the two marquee decode features compose. ``chunk``
+        maps onto the segment budget (a round emits up to gamma+1 tokens),
+        so chunk=1 streams every round and larger chunks batch rounds."""
         from edgemesh.runtime.stream import generate_stream
 
         prompt = prompt if prompt is not None else self.format_prompt(question)
@@ -178,6 +180,7 @@ class Agent:
                 self.cfg, self.params, self.draft_cfg, self.draft_params,
                 tokens, lengths, self.sampling, gamma=self.spec_gamma,
                 eos_id=eos,
+                rounds_per_segment=max(1, chunk // (self.spec_gamma + 1)),
             )
         else:
             segments = generate_stream(

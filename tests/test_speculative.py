@@ -276,3 +276,14 @@ def test_streaming_speculative_sampled_matches_nonstreamed():
     for b in range(2):
         n = int(ref.num_generated[b])
         assert per_row[b][:n] == [int(t) for t in ref.tokens[b][:n]]
+
+
+def test_streaming_speculative_rejects_bad_segment_budget():
+    from edgemesh.runtime.speculative import generate_speculative_stream
+
+    cfg, pt, pd = _models()
+    tokens, lengths = _prompt()
+    s = SamplingParams(max_new_tokens=8, do_sample=False, repetition_penalty=1.0)
+    with pytest.raises(ValueError, match="rounds_per_segment"):
+        next(generate_speculative_stream(cfg, pt, cfg, pd, tokens, lengths, s,
+                                         rounds_per_segment=0))
