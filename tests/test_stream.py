@@ -174,3 +174,47 @@ def test_rest_sse_endpoint_streams():
         assert "".join(e.get("delta", "") for e in events[:-1]).strip() == events[-1]["answer"]
     finally:
         server.shutdown()
+
+
+def test_rest_sse_endpoint_streams_with_draft():
+    """SSE over a DRAFT-configured agent rides the segmented speculative
+    loop end-to-end: deltas reassemble to the final answer, and the answer
+    equals the non-streamed /generate answer (greedy)."""
+    import json
+    import urllib.request
+
+    from edgemesh.serve.rest import serve_rest
+
+    cfg = EdgeMeshConfig(agents=[AgentSpec(
+        role="qa",
+        model=ModelSpec(num_layers=2, hidden_size=64, max_seq_len=256),
+        draft=ModelSpec(num_layers=1, hidden_size=64, max_seq_len=256),
+        spec_gamma=3,
+        sampling=GREEDY,
+    )])
+    ens = build_ensemble(cfg, use_submeshes=False)
+    assert ens.qa_agents[0].draft_cfg is not None
+    server = serve_rest(ens, host="127.0.0.1", port=0, block=False)
+    port = server.server_address[1]
+    try:
+        def post(path):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps({"question": "where is the eiffel tower"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return urllib.request.urlopen(req, timeout=300)
+
+        with post("/generate_stream") as resp:
+            events = [
+                json.loads(line[len("data: "):])
+                for line in resp.read().decode().splitlines()
+                if line.startswith("data: ")
+            ]
+        assert events[-1]["done"] is True
+        assert "".join(e.get("delta", "") for e in events[:-1]).strip() == events[-1]["answer"]
+        with post("/generate") as resp:
+            plain = json.loads(resp.read())
+        assert plain["answer"] == events[-1]["answer"]
+    finally:
+        server.shutdown()
