@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import sys
 import time
+from functools import partial
 from typing import Any
 
 import jax
@@ -266,10 +267,13 @@ def decode_benchmark(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size, jnp.int32
     )
     lengths = jnp.full((batch,), prompt_len, jnp.int32)
-    if kv_backend == "paged":
+    if kv_backend in ("paged", "paged_int8"):
         from edgemesh.runtime.paged_generate import generate_paged
 
-        run = generate_paged
+        if kv_backend == "paged_int8":
+            run = partial(generate_paged, kv_quant=True)
+        else:
+            run = generate_paged
     elif kv_backend == "quant":
         from edgemesh.runtime.quant_kv import generate_quant_kv
 
@@ -565,6 +569,12 @@ def headline_benchmark(
                                   kv_backend="paged",
                                   **{**lc_kw, "built": (win_cfg, int8_built[1])})
         out[f"longctx{lc_prompt}_paged_win1024_tok_s"] = lc_win["value"]
+        emit_partial(out)
+        # Int8 page pool: the two long-context levers composed — paged table
+        # walk AND half the KV bytes (runtime/paged_kv.QuantPagedKVCache).
+        lc_pq = decode_benchmark(preset, "int8", quant_mode="w8a16",
+                                 kv_backend="paged_int8", **lc_kw)
+        out[f"longctx{lc_prompt}_paged_int8_tok_s"] = lc_pq["value"]
 
     _stage("longctx", _longctx)
 

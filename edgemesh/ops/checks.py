@@ -68,7 +68,7 @@ def check_flash_inputs(q, k, kv_lens, q_offsets) -> None:
 
 
 def check_paged_inputs(q, k_pages, page_table, kv_lens) -> None:
-    total_pages = k_pages.shape[1]
+    total_pages = k_pages.shape[0]  # page-major pool [P, kh, ps, hd]
     page_size = k_pages.shape[2]
     max_tokens = page_table.shape[1] * page_size
     checkify.check(

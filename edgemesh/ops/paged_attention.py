@@ -8,10 +8,16 @@ via scalar prefetch (pallas_guide.md §PrefetchScalarGridSpec — the index_map
 of K/V blocks dereferences the prefetched table, so the DMA engine fetches
 physical page ``table[b, p]`` directly; no gather materializes).
 
-Grid ``(batch, kv_heads, max_pages)``; pages are innermost/sequential and
-accumulate online-softmax state in VMEM scratch, exactly like
-ops/flash_attention.py. GQA: the ``groups`` query heads of one kv head ride
-the sublane dim of a single ``[groups, head_dim]`` q block.
+Grid ``(batch, max_pages)``; pages are innermost/sequential and accumulate
+online-softmax state in VMEM scratch, exactly like ops/flash_attention.py.
+The page pool is page-major ``[total_pages, kv_heads, page_size, head_dim]``
+so ONE grid step fetches every kv head's slice of a page in a single
+contiguous DMA (kh·ps·hd elements — 64 KB for a Llama-1B bf16 page of 64
+tokens) instead of the pre-r3 head-major walk whose ``(b, kh, pages)`` grid
+issued kh× as many DMAs of ps·hd (8 KB) each — too small to reach HBM
+bandwidth, which measured the paged path at half the dense backend's
+throughput. GQA rides inside the step: a static loop over kv heads does the
+``groups``-row flash update per head against its slice of the page block.
 """
 
 from __future__ import annotations
@@ -35,22 +41,27 @@ except Exception:  # pragma: no cover
 def _paged_kernel(
     table_ref,  # SMEM [b, max_pages] int32 (scalar prefetch)
     len_ref,  # SMEM [b] int32 (scalar prefetch)
-    q_ref,  # VMEM [1, 1, gp, hd]
-    k_ref,  # VMEM [1, 1, ps, hd] — physical page table[b, p]
-    v_ref,  # VMEM [1, 1, ps, hd]
-    o_ref,  # VMEM [1, 1, gp, hd]
-    m_scr,  # VMEM [gp, 128] f32
-    l_scr,  # VMEM [gp, 128] f32
-    acc_scr,  # VMEM [gp, hd] f32
-    *,
+    *refs,  # q, k, v, [k_scale, v_scale,] o, m_scr, l_scr, acc_scr
     page_size: int,
     scale: float,
     window: int,
     soft_cap: float,
+    kv_heads: int,
+    gp: int,
+    quantized: bool,
 ):
+    # q_ref   VMEM [1, kh, gp, hd]
+    # k_ref   VMEM [1, kh, ps, hd] — physical page table[b, p], all kv heads
+    #         (int8 when quantized, with ks/vs VMEM [1, kh, 1, ps] f32 scales)
+    # o_ref   VMEM [1, kh, gp, hd]
+    # scratch VMEM [kh*gp, 128] f32 ×2 (m, l) + [kh*gp, hd] f32 (acc)
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
     bb = pl.program_id(0)
-    p = pl.program_id(2)
-    npg = pl.num_programs(2)
+    p = pl.program_id(1)
+    npg = pl.num_programs(1)
 
     @pl.when(p == 0)
     def _init():
@@ -65,8 +76,7 @@ def _paged_kernel(
         # intersect the window, and the K/V index_map walks LOGICAL page
         # first_live + p — recompute that logical index here so the column
         # numbers match what the DMA fetched. Out-of-window pages are never
-        # DMA'd at all (the grid doesn't visit them), unlike the pre-r3
-        # kernel which fetched the whole table and only skipped compute.
+        # DMA'd at all (the grid doesn't visit them).
         lp = jnp.maximum(kvlen - window, 0) // page_size + p
     else:
         lp = p
@@ -74,36 +84,62 @@ def _paged_kernel(
 
     @pl.when(live)
     def _update():
-        q = q_ref[0, 0]  # [gp, hd]
-        k = k_ref[0, 0]  # [ps, hd]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [gp, ps]
-        if soft_cap > 0:  # Gemma-2 score squashing, pre-mask (attend parity)
-            s = soft_cap * jnp.tanh(s / soft_cap)
-        col = lp * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        col = lp * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (gp, page_size), 1
+        )
         mask = col < kvlen
         if window > 0:
             mask = jnp.logical_and(mask, col >= kvlen - window)
-        s = jnp.where(mask, s, NEG_INF)
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        pr = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_new = alpha * l_scr[:, :1] + jnp.sum(pr, axis=1, keepdims=True)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
-        pv = jax.lax.dot_general(
-            pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_scr[:] = alpha * acc_scr[:] + pv
+        # Static loop over kv heads: each head's groups query rows flash-update
+        # against that head's [ps, hd] slice of the page block. 2D ops only —
+        # the same shapes the head-major kernel lowered — sliced out of the
+        # shared scratch at static offsets.
+        for h in range(kv_heads):
+            rows = slice(h * gp, (h + 1) * gp)
+            q = q_ref[0, h]  # [gp, hd]
+            k = k_ref[0, h]  # [ps, hd]
+            v = v_ref[0, h]
+            if quantized:
+                # Per-row scales fold in AFTER the int8 matmuls (s_ij carries
+                # k's row-j scale; v's scale rides the probability operand) —
+                # HBM only ever holds the int8 pages. int8→f32 converts fuse
+                # into the MXU operand read.
+                q = q.astype(jnp.float32)
+                k = k.astype(jnp.float32)
+                v = v.astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # [gp, ps]
+            if quantized:
+                s = s * ks_ref[0, h]  # [1, ps] k row scales
+            if soft_cap > 0:  # Gemma-2 score squashing, pre-mask (attend parity)
+                s = soft_cap * jnp.tanh(s / soft_cap)
+            s = jnp.where(mask, s, NEG_INF)
+            m_prev = m_scr[rows, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            pr = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            m_scr[rows, :] = jnp.broadcast_to(m_new, (gp, 128))
+            l_new = alpha * l_scr[rows, :1] + jnp.sum(pr, axis=1, keepdims=True)
+            l_scr[rows, :] = jnp.broadcast_to(l_new, (gp, 128))
+            if quantized:
+                pv = jax.lax.dot_general(
+                    pr * vs_ref[0, h], v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                pv = jax.lax.dot_general(
+                    pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            acc_scr[rows, :] = alpha * acc_scr[rows, :] + pv
 
     @pl.when(p == npg - 1)
     def _finish():
-        out = acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0, 0] = out.astype(o_ref.dtype)
+        for h in range(kv_heads):
+            rows = slice(h * gp, (h + 1) * gp)
+            out = acc_scr[rows, :] / jnp.maximum(l_scr[rows, :1], 1e-30)
+            o_ref[0, h] = out.astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -112,7 +148,7 @@ def _paged_kernel(
 )
 def paged_decode_attention(
     q: jnp.ndarray,  # [b, num_heads, head_dim] — one query token per row
-    k_pages: jnp.ndarray,  # [kv_heads, total_pages, page_size, head_dim]
+    k_pages: jnp.ndarray,  # [total_pages, kv_heads, page_size, head_dim]
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,  # [b, max_pages] int32
     kv_lens: jnp.ndarray,  # [b] int32 — valid tokens per row (incl. current)
@@ -121,6 +157,8 @@ def paged_decode_attention(
     check: bool = False,
     sliding_window: int = 0,
     soft_cap: float = 0.0,
+    k_scales: jnp.ndarray | None = None,  # [P, kh, 1, ps] f32 (int8 pool)
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Attention of one decode token per row over its paged KV prefix.
 
@@ -134,6 +172,11 @@ def paged_decode_attention(
     and a non-None ``scale`` carries Gemma-2's fixed query scale — both
     matching ops/attention.attend exactly.
 
+    ``k_scales``/``v_scales`` (both or neither) mark the pool as int8
+    (runtime/paged_kv.QuantPagedKVCache): pages dequantize inside the
+    kernel via per-token-row scales folded in after each matmul, so the
+    page walk streams half the bytes.
+
     ``check=True`` emits checkify contract asserts (page-table entries inside
     the physical pool, kv_lens within table capacity, finite queries) — run
     through ops.checks.checked (§5.2).
@@ -144,8 +187,9 @@ def paged_decode_attention(
         from edgemesh.ops.checks import check_paged_inputs
 
         check_paged_inputs(q, k_pages, page_table, kv_lens)
+    quantized = k_scales is not None
     b, nh, hd = q.shape
-    kh, _, ps, _ = k_pages.shape
+    _, kh, ps, _ = k_pages.shape
     groups = nh // kh
     max_pages = page_table.shape[1]
     scale = scale if scale is not None else hd**-0.5
@@ -164,47 +208,54 @@ def paged_decode_attention(
         # slots bound the live span for every row.
         npages = min(max_pages, sliding_window // ps + 2)
 
-        def kv_map(bb, h, p, table, lens):
+        def kv_map(bb, p, table, lens):
             first_live = jnp.maximum(lens[bb] - sliding_window, 0) // ps
             # Clamp: near capacity first_live+p can step past the table; the
             # clamped duplicate fetch is masked dead in the kernel (live=False
             # once lp*ps >= kvlen).
-            return (h, table[bb, jnp.minimum(first_live + p, max_pages - 1)], 0, 0)
+            return (table[bb, jnp.minimum(first_live + p, max_pages - 1)], 0, 0, 0)
     else:
         npages = max_pages
 
-        def kv_map(bb, h, p, table, lens):
-            return (h, table[bb, p], 0, 0)
+        def kv_map(bb, p, table, lens):
+            return (table[bb, p], 0, 0, 0)
 
-    grid = (b, kh, npages)
+    grid = (b, npages)
     kernel = functools.partial(
         _paged_kernel, page_size=ps, scale=scale, window=sliding_window,
-        soft_cap=soft_cap,
+        soft_cap=soft_cap, kv_heads=kh, gp=gp, quantized=quantized,
     )
+    in_specs = [
+        pl.BlockSpec((1, kh, gp, hp), lambda bb, p, table, lens: (bb, 0, 0, 0)),
+        pl.BlockSpec((1, kh, ps, hp), kv_map),
+        pl.BlockSpec((1, kh, ps, hp), kv_map),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quantized:
+        # Scale blocks ride the same page index_map; [1, ps] per head.
+        in_specs += [
+            pl.BlockSpec((1, kh, 1, ps), kv_map),
+            pl.BlockSpec((1, kh, 1, ps), kv_map),
+        ]
+        operands += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, gp, hp), lambda bb, h, p, table, lens: (bb, h, 0, 0)
-                ),
-                pl.BlockSpec((1, 1, ps, hp), kv_map),
-                pl.BlockSpec((1, 1, ps, hp), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, 1, gp, hp), lambda bb, h, p, table, lens: (bb, h, 0, 0)
+                (1, kh, gp, hp), lambda bb, p, table, lens: (bb, 0, 0, 0)
             ),
             scratch_shapes=[
-                pltpu.VMEM((gp, 128), jnp.float32),
-                pltpu.VMEM((gp, 128), jnp.float32),
-                pltpu.VMEM((gp, hp), jnp.float32),
+                pltpu.VMEM((kh * gp, 128), jnp.float32),
+                pltpu.VMEM((kh * gp, 128), jnp.float32),
+                pltpu.VMEM((kh * gp, hp), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, kh, gp, hp), q.dtype),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), qg, k_pages, v_pages)
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), *operands)
     return out[:, :, :groups, :hd].reshape(b, nh, hd)
 
 
@@ -217,14 +268,21 @@ def paged_decode_attention_xla(
     scale: float | None = None,
     sliding_window: int = 0,
     soft_cap: float = 0.0,
+    k_scales: jnp.ndarray | None = None,
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """XLA fallback / oracle: gather the dense view, then masked attention."""
     from edgemesh.ops.attention import LayerKV, attend
-    from edgemesh.runtime.paged_kv import gather_dense
+    from edgemesh.runtime.paged_kv import gather_dense, gather_dense_scales
 
     b, nh, hd = q.shape
     dense_k = gather_dense(k_pages, page_table)
     dense_v = gather_dense(v_pages, page_table)
+    if k_scales is not None:
+        ks = gather_dense_scales(k_scales, page_table)  # [b, max_seq, kh]
+        vs = gather_dense_scales(v_scales, page_table)
+        dense_k = (dense_k.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
+        dense_v = (dense_v.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
     max_seq = dense_k.shape[1]
     kv_valid = jnp.arange(max_seq)[None, :] < kv_lens[:, None]
     positions = (kv_lens - 1)[:, None]

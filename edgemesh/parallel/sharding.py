@@ -222,24 +222,33 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp", None))
 
 
-def paged_cache_pspecs(cfg: ModelConfig, mesh: Mesh):
+def paged_cache_pspecs(cfg: ModelConfig, mesh: Mesh, quant: bool = False):
     """Head-wise sharding of the paged KV pool (the HeadInfer analog,
-    BASELINE.json configs[3]): page arrays are [L, kv_heads, pages, page_size,
-    head_dim] (runtime/paged_kv.py), so P(None, "tp") slices each chip's HBM
-    down to its own heads' pages — contiguous, no resharding on attention.
-    The page table, lengths, and free list are tiny and replicated (every
-    chip walks the same table for its local heads)."""
-    from edgemesh.runtime.paged_kv import PagedKVCache
+    BASELINE.json configs[3]): page arrays are [L, pages, kv_heads, page_size,
+    head_dim] (runtime/paged_kv.py), so P(None, None, "tp") slices each
+    chip's HBM down to its own heads' stripe of every page — no resharding
+    on attention. The page table, lengths, and free list are tiny and
+    replicated (every chip walks the same table for its local heads).
+    ``quant=True`` covers the int8 pool (QuantPagedKVCache): the per-token
+    scale arrays [L, P, kh, 1, ps] shard on the same kh axis."""
+    from edgemesh.runtime.paged_kv import PagedKVCache, QuantPagedKVCache
 
     kv_ok = cfg.num_kv_heads % mesh.shape["tp"] == 0
-    kv = P(None, "tp" if kv_ok else None, None, None, None)
+    kv = P(None, None, "tp" if kv_ok else None, None, None)
+    if quant:
+        return QuantPagedKVCache(
+            k=kv, v=kv, k_scale=kv, v_scale=kv,
+            page_table=P(), lengths=P(), free_stack=P(), free_top=P(),
+        )
     return PagedKVCache(
         k=kv, v=kv, page_table=P(), lengths=P(), free_stack=P(), free_top=P()
     )
 
 
 def shard_paged_cache(cache, cfg: ModelConfig, mesh: Mesh):
-    specs = paged_cache_pspecs(cfg, mesh)
+    from edgemesh.runtime.paged_kv import QuantPagedKVCache
+
+    specs = paged_cache_pspecs(cfg, mesh, quant=isinstance(cache, QuantPagedKVCache))
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         cache, specs, is_leaf=lambda x: isinstance(x, P),

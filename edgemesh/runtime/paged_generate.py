@@ -40,10 +40,13 @@ from edgemesh.ops.paged_attention import (
 from edgemesh.runtime.generate import GenerateResult, generate
 from edgemesh.runtime.paged_kv import (
     PagedKVCache,
+    QuantPagedKVCache,
     allocate,
     init_paged_cache,
+    init_quant_paged_cache,
     pages_needed,
     write_tokens,
+    write_tokens_quant,
 )
 
 
@@ -52,22 +55,42 @@ def _paged_attention(
     layer,
     x: jnp.ndarray,  # [b, s, h]
     positions: jnp.ndarray,  # [b, s]
-    cache,  # (k_pages, v_pages, page_table, kv_lens) for ONE layer
+    cache,  # (k_pages, v_pages, [k_scales, v_scales,] page_table, kv_lens)
     kv_valid,  # unused (validity is kv_lens in the paged world)
     lengths: jnp.ndarray,  # [b] write offset (0 for prefill, cur len for decode)
     is_decode: bool,
 ):
-    """Drop-in attention backend for _layer_fn over one layer's page arrays."""
-    k_pages, v_pages, table, kv_lens = cache
+    """Drop-in attention backend for _layer_fn over one layer's page arrays.
+
+    A 6-tuple cache marks the int8 pool (QuantPagedKVCache): writes quantize
+    per token row, the decode kernel dequantizes in-page, and prefill attends
+    over the quantize→dequantize roundtrip of the fresh k/v so its logits
+    match the dense int8-KV backend (runtime/quant_kv.py) exactly."""
+    quant = len(cache) == 6
+    if quant:
+        k_pages, v_pages, k_sc, v_sc, table, kv_lens = cache
+    else:
+        k_pages, v_pages, table, kv_lens = cache
     b, s, _ = x.shape
     nh, hd = cfg.num_heads, cfg.head_size
     q, k, v = qkv_proj(cfg, layer, x, positions)
 
     if is_decode:
-        k_pages, v_pages = write_tokens(
-            k_pages, v_pages, k, v, table, start=lengths,
-            valid_len=jnp.ones((b,), jnp.int32),
-        )
+        if quant:
+            from edgemesh.runtime.quant_kv import quantize_kv
+
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            k_pages, v_pages, k_sc, v_sc = write_tokens_quant(
+                k_pages, v_pages, k_sc, v_sc, kq, ks, vq, vs, table,
+                start=lengths, valid_len=jnp.ones((b,), jnp.int32),
+            )
+        else:
+            k_pages, v_pages = write_tokens(
+                k_pages, v_pages, k, v, table, start=lengths,
+                valid_len=jnp.ones((b,), jnp.int32),
+            )
+        scales = dict(k_scales=k_sc, v_scales=v_sc) if quant else {}
         if _use_flash(cfg):
             out = paged_decode_attention(
                 q[:, 0], k_pages, v_pages, table, kv_lens,
@@ -76,6 +99,7 @@ def _paged_attention(
                 and not on_tpu(),
                 sliding_window=cfg.sliding_window,
                 soft_cap=cfg.attn_soft_cap,
+                **scales,
             )
         else:
             out = paged_decode_attention_xla(
@@ -83,16 +107,31 @@ def _paged_attention(
                 scale=cfg.query_scale,
                 sliding_window=cfg.sliding_window,
                 soft_cap=cfg.attn_soft_cap,
+                **scales,
             )
         out = out[:, None]
     else:
         # Prefill: pages start empty, so the fresh k/v are the whole visible
         # prefix — attend over them directly (flash kernel on TPU), then
         # scatter them into the pages for the decode loop to extend.
-        k_pages, v_pages = write_tokens(
-            k_pages, v_pages, k, v, table, start=jnp.zeros((b,), jnp.int32),
-            valid_len=kv_lens,
-        )
+        if quant:
+            from edgemesh.runtime.quant_kv import quantize_kv
+
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            k_pages, v_pages, k_sc, v_sc = write_tokens_quant(
+                k_pages, v_pages, k_sc, v_sc, kq, ks, vq, vs, table,
+                start=jnp.zeros((b,), jnp.int32), valid_len=kv_lens,
+            )
+            # Attend over the same values decode will read back: the int8
+            # roundtrip of the fresh k/v (dense quant-KV backend parity).
+            k = (kq.astype(jnp.float32) * ks[..., None]).astype(k.dtype)
+            v = (vq.astype(jnp.float32) * vs[..., None]).astype(v.dtype)
+        else:
+            k_pages, v_pages = write_tokens(
+                k_pages, v_pages, k, v, table, start=jnp.zeros((b,), jnp.int32),
+                valid_len=kv_lens,
+            )
         if _use_flash(cfg):
             from edgemesh.ops.flash_attention import flash_attention
 
@@ -112,6 +151,8 @@ def _paged_attention(
                 soft_cap=cfg.attn_soft_cap,
             )
     proj = dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode)
+    if quant:
+        return proj, (k_pages, v_pages, k_sc, v_sc, table, kv_lens)
     return proj, (k_pages, v_pages, table, kv_lens)
 
 
@@ -125,22 +166,30 @@ def _paged_forward(
     is_decode: bool,
 ):
     x = embed_tokens(cfg, params, tokens)
+    quant = isinstance(cache, QuantPagedKVCache)
 
     def body(layer_cfg, h, scanned):
-        layer, k_l, v_l = scanned
-        state = (k_l, v_l, cache.page_table, kv_lens)
-        h, (k_l, v_l, _, _), _aux = _layer_fn(
+        layer, *kv = scanned
+        state = (*kv, cache.page_table, kv_lens)
+        h, new_state, _aux = _layer_fn(
             layer_cfg, h, layer, state, positions, None, cache.lengths, is_decode,
             _paged_attention,
         )
-        return h, (k_l, v_l)
+        return h, tuple(new_state[:-2])  # drop table/kv_lens (not scanned)
 
     # Gemma-2's alternating windows ride the shared pair scan (each half's
     # window a static constant); plain configs take the ordinary scan.
-    x, (new_k, new_v) = layer_scan_alt_windows(
-        cfg, body, x, (params["layers"], cache.k, cache.v)
-    )
-    return lm_head_logits(cfg, params, x), cache._replace(k=new_k, v=new_v)
+    scanned = (params["layers"], cache.k, cache.v)
+    if quant:
+        scanned += (cache.k_scale, cache.v_scale)
+    x, new_kv = layer_scan_alt_windows(cfg, body, x, scanned)
+    if quant:
+        new_k, new_v, new_ks, new_vs = new_kv
+        cache = cache._replace(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
+    else:
+        new_k, new_v = new_kv
+        cache = cache._replace(k=new_k, v=new_v)
+    return lm_head_logits(cfg, params, x), cache
 
 
 @partial(jax.jit, static_argnums=(0,))
@@ -192,8 +241,9 @@ def generate_paged(
     sampling: SamplingParams,
     eos_id: int = -1,
     rng: jax.Array | None = None,
-    cache: PagedKVCache | None = None,
+    cache: PagedKVCache | QuantPagedKVCache | None = None,
     page_size: int = 64,
+    kv_quant: bool = False,
 ) -> GenerateResult:
     """generate() over the paged cache: delegates to runtime.generate.generate
     with the paged forwards plugged in, so validation, timing, and the
@@ -201,11 +251,14 @@ def generate_paged(
     (Mistral) work end-to-end — the page-table kernel never DMAs pages
     outside a row's window — and Gemma-2's full dial set (score soft cap,
     fixed query scale, ALTERNATING windows via the shared pair scan) runs
-    here too, pinned against the dense backend in tests/test_paged_kv.py."""
+    here too, pinned against the dense backend in tests/test_paged_kv.py.
+    ``kv_quant=True`` (or passing a QuantPagedKVCache) stores pages as int8
+    with per-token scales — half the page-walk bytes, same table machinery."""
 
     def make_cache(cfg, batch, needed):
         per_row = (needed + page_size - 1) // page_size
-        return init_paged_cache(
+        init = init_quant_paged_cache if kv_quant else init_paged_cache
+        return init(
             cfg, batch, total_pages=1 + batch * per_row, page_size=page_size,
             max_pages=per_row,
         )

@@ -3,10 +3,14 @@ configs[3], SURVEY.md §5.7).
 
 HeadInfer scales context on small GPUs by offloading KV heads to host DRAM;
 the TPU reinterpretation keeps the cache HBM-resident, paged, and head-wise
-sharded: page arrays are laid out head-major ``[layers, kv_heads, pages,
-page_size, head_dim]`` so a ``P(None, "tp")`` sharding slices contiguous
-memory per chip, and the paged-attention kernel walks each sequence's page
-table instead of a dense ``[b, max_seq]`` slab.
+sharded: page arrays are laid out page-major ``[layers, pages, kv_heads,
+page_size, head_dim]`` so one physical page holds every kv head's slice
+contiguously — the paged-attention kernel then fetches a whole page in ONE
+kh·ps·hd DMA per grid step (the r2 head-major layout forced kh separate
+ps·hd DMAs, ~8 KB each, too small for HBM bandwidth) — and a
+``P(None, None, "tp")`` sharding still slices the pool head-wise per chip.
+The kernel walks each sequence's page table instead of a dense
+``[b, max_seq]`` slab.
 
 Everything here is functional and statically shaped so the decode loop jits
 once (the design rule the whole runtime follows, models/transformer.py):
@@ -33,7 +37,7 @@ from edgemesh.models.transformer import ModelConfig
 
 
 class PagedKVCache(NamedTuple):
-    """k/v: [L, kv_heads, total_pages, page_size, head_dim].
+    """k/v: [L, total_pages, kv_heads, page_size, head_dim].
 
     ``page_table``: [b, max_pages] int32 — physical page of each logical page
     (0 = unallocated → trash page). ``lengths``: [b] tokens written per row.
@@ -69,7 +73,7 @@ def init_paged_cache(
     """Preallocate the page pool. ``total_pages`` includes the trash page."""
     dtype = dtype or cfg.activation_dtype
     max_pages = max_pages or (cfg.max_seq_len + page_size - 1) // page_size
-    shape = (cfg.num_layers, cfg.num_kv_heads, total_pages, page_size, cfg.head_size)
+    shape = (cfg.num_layers, total_pages, cfg.num_kv_heads, page_size, cfg.head_size)
     return PagedKVCache(
         k=jnp.zeros(shape, dtype),
         v=jnp.zeros(shape, dtype),
@@ -77,6 +81,57 @@ def init_paged_cache(
         lengths=jnp.zeros((batch,), jnp.int32),
         free_stack=jnp.arange(total_pages, dtype=jnp.int32),  # entry 0 = trash
         free_top=jnp.asarray(1, jnp.int32),  # skip the trash page
+    )
+
+
+class QuantPagedKVCache(NamedTuple):
+    """Int8 page pool: k/v int8 [L, P, kh, ps, hd]; k_scale/v_scale fp32
+    [L, P, kh, 1, ps] (one symmetric absmax scale per written token row,
+    runtime/quant_kv.quantize_kv — the [·, 1, ps] shape keeps the kernel's
+    per-page scale read a 2D [1, ps] vector). Halves the page-walk DMA bytes
+    on top of the page-major layout, marrying the two long-context levers
+    (SURVEY.md §5.7: HeadInfer-analog paging + int8 KV). Table/length/free
+    bookkeeping is identical to PagedKVCache, so allocate()/pages_needed()
+    serve both."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    k_scale: jnp.ndarray
+    v_scale: jnp.ndarray
+    page_table: jnp.ndarray
+    lengths: jnp.ndarray
+    free_stack: jnp.ndarray
+    free_top: jnp.ndarray
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def max_pages(self) -> int:
+        return self.page_table.shape[1]
+
+
+def init_quant_paged_cache(
+    cfg: ModelConfig,
+    batch: int,
+    total_pages: int,
+    page_size: int = 64,
+    max_pages: int | None = None,
+) -> QuantPagedKVCache:
+    """Preallocate the int8 page pool. ``total_pages`` includes the trash page."""
+    max_pages = max_pages or (cfg.max_seq_len + page_size - 1) // page_size
+    shape = (cfg.num_layers, total_pages, cfg.num_kv_heads, page_size, cfg.head_size)
+    sshape = (cfg.num_layers, total_pages, cfg.num_kv_heads, 1, page_size)
+    return QuantPagedKVCache(
+        k=jnp.zeros(shape, jnp.int8),
+        v=jnp.zeros(shape, jnp.int8),
+        k_scale=jnp.zeros(sshape, jnp.float32),
+        v_scale=jnp.zeros(sshape, jnp.float32),
+        page_table=jnp.zeros((batch, max_pages), jnp.int32),
+        lengths=jnp.zeros((batch,), jnp.int32),
+        free_stack=jnp.arange(total_pages, dtype=jnp.int32),
+        free_top=jnp.asarray(1, jnp.int32),
     )
 
 
@@ -131,27 +186,9 @@ def allocate(cache: PagedKVCache, n_pages: jnp.ndarray) -> PagedKVCache:
     )
 
 
-def _flat_scatter(pages: jnp.ndarray, flat_pos: jnp.ndarray, values: jnp.ndarray) -> jnp.ndarray:
-    """Scatter values[kh, n, hd] into pages[kh, P, ps, hd] at flat token
-    positions flat_pos[n] (page*page_size + slot)."""
-    kh, P, ps, hd = pages.shape
-    flat = pages.reshape(kh, P * ps, hd)
-    flat = flat.at[:, flat_pos, :].set(values)
-    return flat.reshape(kh, P, ps, hd)
-
-
-def write_tokens(
-    k_pages: jnp.ndarray,  # [kh, P, ps, hd] one layer's pages
-    v_pages: jnp.ndarray,
-    k: jnp.ndarray,  # [b, s, kh, hd] new keys (roped)
-    v: jnp.ndarray,
-    page_table: jnp.ndarray,  # [b, max_pages]
-    start: jnp.ndarray,  # [b] first token position to write
-    valid_len: jnp.ndarray,  # [b] number of real tokens in k/v per row
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Scatter s tokens per row into their pages; invalid tokens → trash page."""
-    b, s, kh, hd = k.shape
-    ps = k_pages.shape[2]
+def _token_slots(page_table, start, valid_len, s, ps):
+    """(pp, ss) [b·s] physical page / in-page slot per token; invalid → trash."""
+    b = page_table.shape[0]
     t = jnp.arange(s)[None, :]  # [1, s]
     pos = start[:, None] + t  # absolute position [b, s]
     logical = pos // ps
@@ -161,23 +198,83 @@ def write_tokens(
     phys = jnp.take_along_axis(
         page_table, jnp.minimum(logical, max_pages - 1), axis=1
     )  # [b, s]
-    flat_pos = jnp.where(valid, phys * ps + slot, 0)  # 0.. = trash page slots
-    flat_pos = flat_pos.reshape(b * s)
-    kv_kh_first = k.transpose(2, 0, 1, 3).reshape(kh, b * s, hd)
-    vv_kh_first = v.transpose(2, 0, 1, 3).reshape(kh, b * s, hd)
+    pp = jnp.where(valid, phys, 0).reshape(b * s)  # invalid → trash page
+    ss = slot.reshape(b * s)
+    return pp, ss
+
+
+def write_tokens(
+    k_pages: jnp.ndarray,  # [P, kh, ps, hd] one layer's pages
+    v_pages: jnp.ndarray,
+    k: jnp.ndarray,  # [b, s, kh, hd] new keys (roped)
+    v: jnp.ndarray,
+    page_table: jnp.ndarray,  # [b, max_pages]
+    start: jnp.ndarray,  # [b] first token position to write
+    valid_len: jnp.ndarray,  # [b] number of real tokens in k/v per row
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter s tokens per row into their pages; invalid tokens → trash page.
+
+    One scatter per array, token-indexed on (page, slot): all kv heads of a
+    token land in its page's contiguous [kh, ·, hd] stripe (page-major
+    layout, module docstring)."""
+    b, s, kh, hd = k.shape
+    ps = k_pages.shape[2]
+    pp, ss = _token_slots(page_table, start, valid_len, s, ps)
     return (
-        _flat_scatter(k_pages, flat_pos, kv_kh_first.astype(k_pages.dtype)),
-        _flat_scatter(v_pages, flat_pos, vv_kh_first.astype(v_pages.dtype)),
+        k_pages.at[pp, :, ss, :].set(k.reshape(b * s, kh, hd).astype(k_pages.dtype)),
+        v_pages.at[pp, :, ss, :].set(v.reshape(b * s, kh, hd).astype(v_pages.dtype)),
+    )
+
+
+def write_tokens_quant(
+    k_pages: jnp.ndarray,  # [P, kh, ps, hd] int8, one layer's pages
+    v_pages: jnp.ndarray,
+    k_scales: jnp.ndarray,  # [P, kh, 1, ps] f32
+    v_scales: jnp.ndarray,
+    k_q: jnp.ndarray,  # [b, s, kh, hd] int8 new keys (quantize_kv output)
+    k_s: jnp.ndarray,  # [b, s, kh] f32 per-row scales
+    v_q: jnp.ndarray,
+    v_s: jnp.ndarray,
+    page_table: jnp.ndarray,
+    start: jnp.ndarray,
+    valid_len: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Scatter pre-quantized token rows + scales into the int8 page pool.
+
+    Takes runtime/quant_kv.quantize_kv outputs rather than quantizing here:
+    the prefill path also needs the int8 roundtrip of the fresh k/v for its
+    attend (paged_generate._paged_attention), so quantization happens exactly
+    once at the call site."""
+    b, s, kh, hd = k_q.shape
+    ps = k_pages.shape[2]
+    pp, ss = _token_slots(page_table, start, valid_len, s, ps)
+    return (
+        k_pages.at[pp, :, ss, :].set(k_q.reshape(b * s, kh, hd)),
+        v_pages.at[pp, :, ss, :].set(v_q.reshape(b * s, kh, hd)),
+        k_scales.at[pp, :, 0, ss].set(k_s.reshape(b * s, kh).astype(k_scales.dtype)),
+        v_scales.at[pp, :, 0, ss].set(v_s.reshape(b * s, kh).astype(v_scales.dtype)),
     )
 
 
 def gather_dense(
-    pages: jnp.ndarray,  # [kh, P, ps, hd]
+    pages: jnp.ndarray,  # [P, kh, ps, hd]
     page_table: jnp.ndarray,  # [b, max_pages]
 ) -> jnp.ndarray:
     """Materialize the dense [b, max_pages*ps, kh, hd] view (XLA fallback /
     test oracle; the Pallas kernel never does this)."""
-    kh, P, ps, hd = pages.shape
-    picked = pages[:, page_table, :, :]  # [kh, b, max_pages, ps, hd]
+    P, kh, ps, hd = pages.shape
+    picked = pages[page_table]  # [b, max_pages, kh, ps, hd]
     b, mp = page_table.shape
-    return picked.transpose(1, 2, 3, 0, 4).reshape(b, mp * ps, kh, hd)
+    return picked.transpose(0, 1, 3, 2, 4).reshape(b, mp * ps, kh, hd)
+
+
+def gather_dense_scales(
+    scales: jnp.ndarray,  # [P, kh, 1, ps]
+    page_table: jnp.ndarray,  # [b, max_pages]
+) -> jnp.ndarray:
+    """Dense [b, max_pages*ps, kh] view of the per-token quant scales
+    (oracle/fallback companion of gather_dense for the int8 pool)."""
+    P, kh, _, ps = scales.shape
+    picked = scales[page_table]  # [b, mp, kh, 1, ps]
+    b, mp = page_table.shape
+    return picked[:, :, :, 0, :].transpose(0, 1, 3, 2).reshape(b, mp * ps, kh)

@@ -20,8 +20,8 @@ def _paged_inputs(bad_table=False, bad_lens=False):
     b, kh, nh, hd, ps, pages, maxp = 2, 2, 4, 64, 8, 6, 3
     rng = jax.random.PRNGKey(0)
     q = jax.random.normal(rng, (b, nh, hd), jnp.float32)
-    k_pages = jax.random.normal(rng, (kh, pages, ps, hd), jnp.float32)
-    v_pages = jax.random.normal(jax.random.PRNGKey(1), (kh, pages, ps, hd), jnp.float32)
+    k_pages = jax.random.normal(rng, (pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(jax.random.PRNGKey(1), (pages, kh, ps, hd), jnp.float32)
     table = jnp.array([[1, 2, 0], [3, 4, 5]], jnp.int32)
     if bad_table:
         table = table.at[0, 1].set(pages + 7)  # outside the physical pool

@@ -140,10 +140,23 @@ def test_paged_cache_head_sharding_on_mesh():
 
     mesh = build_mesh(dp=2, tp=4)
     specs = paged_cache_pspecs(cfg, mesh)
-    assert specs.k == jax.sharding.PartitionSpec(None, "tp", None, None, None)
+    assert specs.k == jax.sharding.PartitionSpec(None, None, "tp", None, None)
     sharded = shard_paged_cache(plain, cfg, mesh)
     got, out_cache = forward_prefill_paged(cfg, params, tokens, lengths, sharded)
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=1e-5, rtol=1e-5)
+
+    # Int8 pool: same head-wise sharding covers the scale arrays too.
+    from edgemesh.runtime.paged_kv import init_quant_paged_cache
+
+    qplain = init_quant_paged_cache(cfg, batch=2, total_pages=9, page_size=4,
+                                    max_pages=4)
+    qwant, _ = forward_prefill_paged(cfg, params, tokens, lengths, qplain)
+    qspecs = paged_cache_pspecs(cfg, mesh, quant=True)
+    assert qspecs.k_scale == jax.sharding.PartitionSpec(None, None, "tp", None, None)
+    qsharded = shard_paged_cache(qplain, cfg, mesh)
+    qgot, _ = forward_prefill_paged(cfg, params, tokens, lengths, qsharded)
+    np.testing.assert_allclose(np.asarray(qwant), np.asarray(qgot), atol=1e-5,
+                               rtol=1e-5)
 
 
 def test_pool_overflow_recorded():
@@ -172,8 +185,8 @@ def test_paged_kernel_sliding_window_matches_oracle():
 
     b, kh, nh, hd, ps, pages, maxp = 2, 2, 4, 64, 8, 10, 4
     q = jax.random.normal(jax.random.PRNGKey(0), (b, nh, hd), jnp.float32)
-    kp = jax.random.normal(jax.random.PRNGKey(1), (kh, pages, ps, hd), jnp.float32)
-    vp = jax.random.normal(jax.random.PRNGKey(2), (kh, pages, ps, hd), jnp.float32)
+    kp = jax.random.normal(jax.random.PRNGKey(1), (pages, kh, ps, hd), jnp.float32)
+    vp = jax.random.normal(jax.random.PRNGKey(2), (pages, kh, ps, hd), jnp.float32)
     table = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 0]], jnp.int32)
     lens = jnp.asarray([29, 17], jnp.int32)
     for w in (3, 10, 100):
@@ -220,8 +233,8 @@ def test_paged_kernel_soft_cap_and_scale_match_oracle():
 
     b, kh, nh, hd, ps, pages, maxp = 2, 2, 4, 64, 8, 10, 4
     q = jax.random.normal(jax.random.PRNGKey(0), (b, nh, hd), jnp.float32)
-    kp = jax.random.normal(jax.random.PRNGKey(1), (kh, pages, ps, hd), jnp.float32)
-    vp = jax.random.normal(jax.random.PRNGKey(2), (kh, pages, ps, hd), jnp.float32)
+    kp = jax.random.normal(jax.random.PRNGKey(1), (pages, kh, ps, hd), jnp.float32)
+    vp = jax.random.normal(jax.random.PRNGKey(2), (pages, kh, ps, hd), jnp.float32)
     table = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 0]], jnp.int32)
     lens = jnp.asarray([29, 17], jnp.int32)
     for w, cap, scale in ((0, 4.0, None), (6, 4.0, 0.25), (0, 0.0, 0.25)):
@@ -264,3 +277,67 @@ def test_paged_generate_gemma2_matches_dense():
     np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(ref.tokens))
     np.testing.assert_allclose(np.asarray(out.confidence),
                                np.asarray(ref.confidence), atol=1e-5)
+
+
+def test_quant_paged_kernel_matches_xla_oracle():
+    """Int8 page pool: kernel (interpret) == dequantize-then-attend oracle,
+    windowed and not."""
+    from edgemesh.runtime.paged_kv import (
+        allocate,
+        init_quant_paged_cache,
+        pages_needed,
+        write_tokens_quant,
+    )
+
+    b, nh, kh, hd, ps, mp = 2, 8, 2, 64, 16, 4
+    cfg = _cfg(num_heads=nh, num_kv_heads=kh, head_dim=hd)
+    cache = init_quant_paged_cache(cfg, batch=b, total_pages=12, page_size=ps,
+                                   max_pages=mp)
+    kv_lens = jnp.array([50, 17], jnp.int32)
+    cache = allocate(cache, pages_needed(cache.lengths, kv_lens, ps))
+    from edgemesh.runtime.quant_kv import quantize_kv
+
+    k = jax.random.normal(jax.random.PRNGKey(0), (b, 50, kh, hd))
+    v = jax.random.normal(jax.random.PRNGKey(1), (b, 50, kh, hd))
+    kq, ksc = quantize_kv(k)
+    vq, vsc = quantize_kv(v)
+    kp, vp, ks, vs = write_tokens_quant(
+        cache.k[0], cache.v[0], cache.k_scale[0], cache.v_scale[0],
+        kq, ksc, vq, vsc, cache.page_table,
+        start=jnp.zeros((b,), jnp.int32), valid_len=kv_lens,
+    )
+    q = jax.random.normal(jax.random.PRNGKey(2), (b, nh, hd))
+    for w in (0, 21):
+        got = paged_decode_attention(
+            q, kp, vp, cache.page_table, kv_lens, interpret=True,
+            sliding_window=w, k_scales=ks, v_scales=vs,
+        )
+        want = paged_decode_attention_xla(
+            q, kp, vp, cache.page_table, kv_lens, sliding_window=w,
+            k_scales=ks, v_scales=vs,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5, err_msg=f"window={w}")
+
+
+def test_generate_paged_quant_matches_dense_quant_kv():
+    """generate_paged(kv_quant=True) == the dense int8-KV backend
+    (runtime/quant_kv.py), greedy, token for token — the two long-context
+    levers (paging + int8 KV) compose without changing the numerics."""
+    from edgemesh.runtime.quant_kv import generate_quant_kv
+
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompts = jnp.array([[5, 9, 11, 42, 7, 0, 0], [17, 3, 50, 8, 33, 21, 2]],
+                        jnp.int32)
+    lengths = jnp.array([5, 7], jnp.int32)
+    sp = SamplingParams(max_new_tokens=14, temperature=0.0)
+    dense = generate_quant_kv(cfg, params, prompts, lengths, sp,
+                              rng=jax.random.PRNGKey(7))
+    paged = generate_paged(cfg, params, prompts, lengths, sp,
+                           rng=jax.random.PRNGKey(7), page_size=4,
+                           kv_quant=True)
+    np.testing.assert_array_equal(np.asarray(dense.tokens),
+                                  np.asarray(paged.tokens))
+    np.testing.assert_allclose(np.asarray(dense.confidence),
+                               np.asarray(paged.confidence), atol=1e-5)
