@@ -135,6 +135,21 @@ FAMILY_PRESETS: dict[str, dict] = {
         attn_soft_cap=50.0,
         logit_soft_cap=30.0,
     ),
+    # GPT-2: pre-LN LayerNorm+bias, gelu_new (tanh), LEARNED absolute
+    # position embeddings (no rotary), fused c_attn qkv with biases
+    # (Conv1D [in, out] storage — no transpose at ingest), always-tied head.
+    "gpt2": dict(
+        norm="ln",
+        activation="gelu_tanh",
+        parallel_block=False,
+        shared_input_norm=False,
+        rotary_fraction=0.0,
+        learned_positions=True,
+        qkv_bias=True,
+        out_bias=True,
+        lm_head_bias=False,
+        tie_embeddings=True,
+    ),
 }
 
 _HF_MODEL_TYPE_TO_FAMILY = {
@@ -146,6 +161,7 @@ _HF_MODEL_TYPE_TO_FAMILY = {
     "gemma": "gemma",
     "gemma2": "gemma2",
     "phi3": "phi3",
+    "gpt2": "gpt2",
     # Encoder family (BERT/MiniLM/sentence-BERT): bidirectional, post-LN,
     # learned positions — its own forward in models/encoder.py, NOT a
     # decoder preset. sniff_family recognizes it so ingest dispatches (or

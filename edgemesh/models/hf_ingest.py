@@ -9,8 +9,8 @@ layer axis, and ``jax.device_put`` the tree into (sharded) HBM
 
 Name maps cover the reference's three model families (ACL paper §4.2) —
 Llama (Llama-3.2-1B-Instruct), GPT-NeoX (Pythia-1B), Phi (Phi-2) — plus
-Mistral, Qwen2, Gemma, Gemma-2, and Phi-3 (families.py registry; each
-pinned against HF logits in tests/test_hf_parity.py).
+Mistral, Qwen2, Gemma, Gemma-2, Phi-3, and GPT-2 (families.py registry;
+each pinned against HF logits in tests/test_hf_parity.py).
 """
 
 from __future__ import annotations
@@ -178,6 +178,40 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
             rotary_fraction=float(hf.get("partial_rotary_factor", 0.4)),
             norm_eps=hf.get("layer_norm_eps", 1e-5),
         )
+    elif family == "gpt2":
+        # GPT2Config dials: n_embd/n_layer/n_head/n_positions; the wpe table
+        # bounds max_seq_len (learned positions cannot extrapolate). Every
+        # score-scaling / activation variant the runtime does not implement
+        # fails HERE, not as silently wrong logits (same policy as the
+        # qwen2 use_sliding_window and rope_scaling guards).
+        if hf.get("scale_attn_by_inverse_layer_idx"):
+            raise ValueError(
+                f"scale_attn_by_inverse_layer_idx=true in {ckpt / 'config.json'}"
+                " is not supported (per-layer score scaling)"
+            )
+        if not hf.get("scale_attn_weights", True):
+            raise ValueError(
+                f"scale_attn_weights=false in {ckpt / 'config.json'} is not "
+                "supported (unscaled attention scores)"
+            )
+        act = hf.get("activation_function", "gelu_new")
+        act_map = {"gelu_new": "gelu_tanh", "gelu_pytorch_tanh": "gelu_tanh", "gelu": "gelu"}
+        if act not in act_map:
+            raise ValueError(
+                f"activation_function {act!r} in {ckpt / 'config.json'} is not "
+                f"supported for gpt2; supported: {sorted(act_map)}"
+            )
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["n_embd"],
+            num_layers=hf["n_layer"],
+            num_heads=hf["n_head"],
+            num_kv_heads=hf["n_head"],
+            intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+            max_seq_len=int(hf.get("n_positions", hf.get("n_ctx", 1024))),
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            activation=act_map[act],
+        )
     else:  # pragma: no cover
         raise ValueError(family)
     rs = hf.get("rope_scaling") or {}
@@ -230,6 +264,8 @@ def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) ->
         params = _map_llama(raw, cfg, dtype)
     elif family == "neox":
         params = _map_neox(raw, cfg, dtype)
+    elif family == "gpt2":
+        params = _map_gpt2(raw, cfg, dtype)
     else:
         params = _map_phi2(raw, cfg, dtype)
     return cfg, params
@@ -352,6 +388,65 @@ def _map_neox(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
             "bias": jnp.asarray(raw["gpt_neox.final_layer_norm.bias"], dtype),
         },
         "lm_head": {"kernel": jnp.asarray(np.ascontiguousarray(raw["embed_out.weight"].T), dtype)},
+    }
+
+
+def _map_gpt2(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
+    """GPT-2 name map. Two checkpoint quirks: (1) tensors may or may not carry
+    a ``transformer.`` prefix (GPT2LMHeadModel state_dict does, the hub's
+    bare safetensors don't); (2) Conv1D stores weights [in, out] — already
+    edgemesh's kernel layout, so unlike the nn.Linear families there is NO
+    transpose. The fused c_attn columns split [q | k | v]."""
+    if "transformer.wte.weight" in raw:
+        raw = {
+            k[len("transformer."):]: v
+            for k, v in raw.items()
+            if k.startswith("transformer.")
+        }
+    L, h = cfg.num_layers, cfg.hidden_size
+
+    def split_cols(fmt: str, j: int, width: int) -> list[np.ndarray]:
+        return [
+            np.ascontiguousarray(raw[fmt.format(i)][..., j * width : (j + 1) * width])
+            for i in range(L)
+        ]
+
+    def qkv(j: int) -> Params:
+        return {
+            "kernel": _stack(split_cols("h.{}.attn.c_attn.weight", j, h), dtype),
+            "bias": _stack(split_cols("h.{}.attn.c_attn.bias", j, h), dtype),
+        }
+
+    def conv1d(name: str) -> Params:
+        return {
+            "kernel": _layer_stack(raw, "h.{}." + name + ".weight", L, dtype, False),
+            "bias": _layer_stack(raw, "h.{}." + name + ".bias", L, dtype, False),
+        }
+
+    layers: Params = {
+        "attn_norm": {
+            "scale": _layer_stack(raw, "h.{}.ln_1.weight", L, dtype, False),
+            "bias": _layer_stack(raw, "h.{}.ln_1.bias", L, dtype, False),
+        },
+        "mlp_norm": {
+            "scale": _layer_stack(raw, "h.{}.ln_2.weight", L, dtype, False),
+            "bias": _layer_stack(raw, "h.{}.ln_2.bias", L, dtype, False),
+        },
+        "q": qkv(0),
+        "k": qkv(1),
+        "v": qkv(2),
+        "o": conv1d("attn.c_proj"),
+        "up": conv1d("mlp.c_fc"),
+        "down": conv1d("mlp.c_proj"),
+    }
+    return {
+        "embed": {"weight": jnp.asarray(raw["wte.weight"], dtype)},
+        "pos_embed": {"weight": jnp.asarray(raw["wpe.weight"], dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": jnp.asarray(raw["ln_f.weight"], dtype),
+            "bias": jnp.asarray(raw["ln_f.bias"], dtype),
+        },
     }
 
 

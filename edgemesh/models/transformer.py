@@ -74,6 +74,9 @@ class ModelConfig:
     attn_soft_cap: float = 0.0
     query_pre_attn_scalar: float = 0.0
     rotary_fraction: float = 1.0
+    # GPT-2: learned absolute position embeddings (wpe table added to the
+    # token embedding) instead of rotary — set with rotary_fraction=0.0.
+    learned_positions: bool = False
     rope_theta: float = 10000.0
     # HF rope_scaling block (Llama-3.x context extension): "" = none.
     rope_scaling_type: str = ""  # "" | linear | llama3
@@ -252,6 +255,10 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
         "layers": stack_layers(one_layer),
         "final_norm": _norm_init(cfg, dtype),
     }
+    if cfg.learned_positions:
+        params["pos_embed"] = {
+            "weight": (jax.random.normal(keys[3], (cfg.max_seq_len, h), jnp.float32) * 0.02).astype(dtype)
+        }
     if not cfg.tie_embeddings:
         params["lm_head"] = _dense_init(keys[2], h, cfg.vocab_size, dtype, cfg.lm_head_bias)
     return params
@@ -262,14 +269,23 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def embed_tokens(cfg: ModelConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+def embed_tokens(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray | None = None,
+) -> jnp.ndarray:
     """Token-embedding lookup, quantization-aware.
 
     With an int8 embedding (ops/int8.quantize_embedding) the gather reads
     int8 rows + one fp32 scale per row and dequantizes on the VPU — b·s rows
     of traffic either way, but the table held in HBM at half size. The single
     entry point for every forward path (single-chip scan, pipeline stages,
-    4D SPMD, paged decode)."""
+    4D SPMD, paged decode).
+
+    ``positions`` is required for learned-position families (GPT-2): the
+    wpe row is added to the token row here so the rest of the stack stays
+    position-mechanism-agnostic (rotary families ignore it)."""
     embed = params["embed"]
     if "weight_q" in embed:
         rows = embed["weight_q"][tokens].astype(jnp.float32)
@@ -280,6 +296,12 @@ def embed_tokens(cfg: ModelConfig, params: Params, tokens: jnp.ndarray) -> jnp.n
         # Gemma: sqrt(h) cast through the model dtype first (HF multiplies by
         # a bf16 normalizer tensor — matching the rounding keeps logit parity).
         x = x * jnp.asarray(cfg.hidden_size**0.5, cfg.activation_dtype)
+    if cfg.learned_positions:
+        if positions is None:
+            raise ValueError(
+                "cfg.learned_positions requires embed_tokens(..., positions=...)"
+            )
+        x = x + params["pos_embed"]["weight"][positions].astype(cfg.activation_dtype)
     return x
 
 
@@ -528,7 +550,7 @@ def _scan_layers(
 ) -> tuple[jnp.ndarray, KVCache, jnp.ndarray]:
     """embed → layer scan; returns PRE-final-norm hidden states [b, s, h]
     (lm_head_logits applies the final norm) plus cache and moe aux."""
-    x = embed_tokens(cfg, params, tokens)
+    x = embed_tokens(cfg, params, tokens, positions)
 
     def one_layer(fn_cfg, h, layer, k_l, v_l):
         fn = _layer_fn

@@ -65,7 +65,7 @@ def collect_activation_scales(
         mlp_stats.append(_channel_absmax(x_, token_valid))
         return _mlp(cfg_, layer_, x_)
 
-    x = embed_tokens(cfg, params, tokens)
+    x = embed_tokens(cfg, params, tokens, positions)
     attn_stats = []
     for i in range(L):
         layer = jax.tree.map(lambda a: a[i], params["layers"])

@@ -54,6 +54,8 @@ def shard_params_pipelined(params: Params, cfg: ModelConfig, mesh: Mesh) -> Para
         "final_norm": jax.tree.map(lambda x: place(x, P()), params["final_norm"]),
         "layers": jax.tree.map(lambda x: place(x, P("pp")), params["layers"]),
     }
+    if "pos_embed" in params:
+        out["pos_embed"] = jax.tree.map(lambda x: place(x, P()), params["pos_embed"])
     if "lm_head" in params:
         out["lm_head"] = jax.tree.map(lambda x: place(x, P()), params["lm_head"])
     return out
@@ -247,7 +249,7 @@ class PipelineEngine:
         positions = jnp.minimum(positions, (lengths - 1)[:, None])
         max_seq = cache.k.shape[2]
         kv_valid = jnp.arange(max_seq)[None, :] < lengths[:, None]
-        x = embed_tokens(cfg, params, tokens)
+        x = embed_tokens(cfg, params, tokens, positions)
         hidden, cache = self._run_layers(
             params, x, positions, kv_valid, cache, is_decode=False, num_micro=self.num_micro
         )
@@ -259,7 +261,7 @@ class PipelineEngine:
         max_seq = cache.k.shape[2]
         positions = cache.lengths[:, None]
         kv_valid = jnp.arange(max_seq)[None, :] <= cache.lengths[:, None]
-        x = embed_tokens(cfg, params, tokens[:, None])
+        x = embed_tokens(cfg, params, tokens[:, None], positions)
         hidden, cache = self._run_layers(
             params, x, positions, kv_valid, cache, is_decode=True, num_micro=1
         )
@@ -293,7 +295,7 @@ class PipelineEngine:
         cache = self.init_cache(b, s)
         positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
         kv_valid = jnp.arange(s)[None, :] < lengths[:, None]
-        x = embed_tokens(cfg, self.params, tokens)
+        x = embed_tokens(cfg, self.params, tokens, positions)
         hidden, _ = self._run_layers(
             self.params, x, positions, kv_valid, cache, is_decode=False, num_micro=self.num_micro
         )

@@ -96,6 +96,8 @@ def param_pspecs(cfg: ModelConfig, mesh: Mesh) -> Params:
         "layers": layer,
         "final_norm": _norm_pspec(cfg, stacked=False),
     }
+    if cfg.learned_positions:
+        specs["pos_embed"] = {"weight": P(None, None)}
     if not cfg.tie_embeddings:
         specs["lm_head"] = {
             "kernel": P(None, "tp" if vocab_ok else None),

@@ -106,6 +106,8 @@ def spmd_param_specs(cfg: ModelConfig) -> Params:
         "layers": layer,
         "final_norm": {"scale": P()},
     }
+    if cfg.learned_positions:
+        specs["pos_embed"] = {"weight": P()}
     if cfg.norm == "ln":
         specs["final_norm"]["bias"] = P()
     if not cfg.tie_embeddings:
@@ -328,7 +330,7 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int, moe_aux_weight
         # garbage target at the global last column is always masked by this.)
         tmask = ((positions + 1) < lengths[:, None]).astype(jnp.float32)
 
-        x = embed_tokens(cfg, params, tokens)
+        x = embed_tokens(cfg, params, tokens, positions)
 
         def to_mb(a):
             return a.reshape(num_micro, mbs, *a.shape[1:])

@@ -165,7 +165,7 @@ def _paged_forward(
     kv_lens: jnp.ndarray,  # [b] valid tokens AFTER this call's writes
     is_decode: bool,
 ):
-    x = embed_tokens(cfg, params, tokens)
+    x = embed_tokens(cfg, params, tokens, positions)
     quant = isinstance(cache, QuantPagedKVCache)
 
     def body(layer_cfg, h, scanned):

@@ -139,7 +139,7 @@ def _quant_forward(
     kv_valid: jnp.ndarray,
     is_decode: bool,
 ):
-    x = embed_tokens(cfg, params, tokens)
+    x = embed_tokens(cfg, params, tokens, positions)
 
     def one_layer(fn_cfg, h, layer, kv4):
         fn = _layer_fn

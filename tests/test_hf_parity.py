@@ -297,6 +297,25 @@ def test_gemma2_parity(tmp_path):
     _compare(tmp_path, model, seq=12)  # seq > window: the window binds
 
 
+def test_gpt2_parity(tmp_path):
+    """GPT-2: learned absolute positions (wpe added to wte — no rotary),
+    pre-LN with biases, fused c_attn split on COLUMNS (Conv1D [in, out]
+    storage, no transpose at ingest), gelu_new MLP, tied head."""
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    hf_cfg = GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        n_inner=None, activation_function="gelu_new",
+    )
+    torch.manual_seed(3)
+    model = GPT2LMHeadModel(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path)
+    assert cfg.learned_positions and cfg.rotary_dim == 0
+    assert cfg.tie_embeddings and cfg.intermediate_size == 256
+    _compare(tmp_path, model, seq=12)
+
+
 def test_bert_encoder_parity(tmp_path):
     """Encoder family (MiniLM-class) hidden-state parity vs HF BertModel,
     including right-padded rows: the bidirectional mask must exclude padding

@@ -341,3 +341,17 @@ def test_generate_paged_quant_matches_dense_quant_kv():
                                   np.asarray(paged.tokens))
     np.testing.assert_allclose(np.asarray(dense.confidence),
                                np.asarray(paged.confidence), atol=1e-5)
+
+
+def test_generate_paged_gpt2_matches_dense():
+    """Learned-position family (GPT-2) over the paged cache: the wpe row is
+    added at embed via explicit positions on BOTH paths — token-exact."""
+    cfg = tiny_config("gpt2", vocab_size=64, max_seq_len=64)
+    assert cfg.learned_positions
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, 64, jnp.int32)
+    lengths = jnp.asarray([9, 6], jnp.int32)
+    s = SamplingParams(max_new_tokens=12, do_sample=False, repetition_penalty=1.0)
+    ref = generate(cfg, params, tokens, lengths, s)
+    out = generate_paged(cfg, params, tokens, lengths, s, page_size=4)
+    np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(ref.tokens))
