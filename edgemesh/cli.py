@@ -67,12 +67,14 @@ def cmd_eval(cfg: EdgeMeshConfig) -> int:
     return 0
 
 
-def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool = False) -> int:
+def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool = False,
+              kv_backend: str = "dense") -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.serve import serve_rest
 
     ensemble = build_ensemble(cfg)
-    serve_rest(ensemble, port=port, batch=batch, continuous=continuous)
+    serve_rest(ensemble, port=port, batch=batch, continuous=continuous,
+               kv_backend=kv_backend)
     return 0
 
 
@@ -196,6 +198,12 @@ def main(argv: list[str] | None = None) -> int:
         "ensembles; --batch sizes the slot pool)",
     )
     top.add_argument(
+        "--kv-backend", default="dense",
+        choices=["dense", "paged", "paged_int8"],
+        help="serve --continuous: KV memory model (paged = shared page pool "
+        "with zero-copy admission + reclamation; paged_int8 halves KV bytes)",
+    )
+    top.add_argument(
         "--preset", type=str, default=None,
         help="bench: model preset (validated by the bench command)",
     )
@@ -236,7 +244,8 @@ def main(argv: list[str] | None = None) -> int:
     if cmd_args.command == "eval":
         return cmd_eval(cfg)
     if cmd_args.command == "serve":
-        return cmd_serve(cfg, cmd_args.port, cmd_args.batch, cmd_args.continuous)
+        return cmd_serve(cfg, cmd_args.port, cmd_args.batch, cmd_args.continuous,
+                         cmd_args.kv_backend)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     if cmd_args.command == "train":

@@ -22,6 +22,22 @@ batching at CHUNK granularity:
 Worst-case admission latency is one segment (``chunk`` tokens ≈ tens of ms)
 instead of a full answer (hundreds of tokens).
 
+``kv_backend="paged"`` (or ``"paged_int8"``) runs the pool over the paged KV
+cache (runtime/paged_kv.py) — the vLLM-style serving memory model on TPU:
+
+- Pages are BATCH-AGNOSTIC, so admission is zero-copy for KV: the request
+  prefills through a one-row VIEW of the shared pool (its slot's page-table
+  row + the shared page arrays, donated in place); no multi-GB row splice.
+- Retirement RECLAIMS pages: at the segment boundary (host re-entry) the
+  slot's physical pages push back onto the free stack and its table row
+  resets to trash — one preallocated pool serves an unbounded request
+  stream.
+- Admission control is reservation-based: a request is admitted only when
+  its worst-case page count (ceil((prompt+budget)/page_size)) fits beside
+  the reservations of every in-flight request, so mid-decode pool overflow
+  cannot happen; ``total_pages`` below the slots×max_seq worst case trades
+  HBM for queueing instead of crashing.
+
 Interface-compatible with DynamicBatcher (submit/answer/close/stats), so
 ``serve_rest`` takes either.
 """
@@ -41,11 +57,22 @@ import jax.numpy as jnp
 
 from functools import partial
 
+import numpy as np
+
 from edgemesh.models.transformer import KVCache, forward_decode, forward_prefill, init_kv_cache
 from edgemesh.ops.sampling import TokenMaskState
 from edgemesh.runtime.generate import _decode_loop
+from edgemesh.runtime.paged_generate import forward_decode_paged, forward_prefill_paged
+from edgemesh.runtime.paged_kv import init_paged_cache, init_quant_paged_cache
 
 log = logging.getLogger("edgemesh.serve")
+
+# Donated variant of the paged prefill: admission runs it on a one-row view
+# of the SHARED page pool, so without donation every admission would copy the
+# whole pool to apply a few page writes.
+_prefill_paged_donated = partial(
+    jax.jit, static_argnums=(0,), donate_argnums=(4,)
+)(forward_prefill_paged.__wrapped__)
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
@@ -74,6 +101,7 @@ class _Slot:
     remaining: int = 0
     t_submit: float = 0.0
     t_start: float = 0.0
+    pages_reserved: int = 0  # paged backends: worst-case pages held
 
     @property
     def active(self) -> bool:
@@ -83,19 +111,50 @@ class _Slot:
 class ContinuousEngine:
     """Chunk-granular continuous batcher over one Agent's model."""
 
-    def __init__(self, agent, slots: int = 8, chunk: int = 16, idle_wait_s: float = 0.005):
+    def __init__(
+        self,
+        agent,
+        slots: int = 8,
+        chunk: int = 16,
+        idle_wait_s: float = 0.005,
+        kv_backend: str = "dense",
+        page_size: int = 64,
+        total_pages: int | None = None,
+    ):
         self.agent = agent
         self.cfg = agent.cfg
         self.chunk = int(chunk)
         self.n_slots = int(slots)
         if self.chunk < 1 or self.n_slots < 1:
             raise ValueError("slots and chunk must be >= 1")
+        if kv_backend not in ("dense", "paged", "paged_int8"):
+            raise ValueError(f"unknown kv_backend {kv_backend!r}")
+        self.kv_backend = kv_backend
         self._queue: deque[tuple[str, Future, float]] = deque()
         self._cond = threading.Condition()
         self._closed = False
         self._slots = [_Slot() for _ in range(self.n_slots)]
         cap = self.cfg.max_seq_len
-        self._cache = init_kv_cache(self.cfg, self.n_slots, cap)
+        if kv_backend == "dense":
+            self._cache = init_kv_cache(self.cfg, self.n_slots, cap)
+            self._decode_fn = None  # _decode_loop default (forward_decode)
+        else:
+            self.page_size = int(page_size)
+            per_row = -(-cap // self.page_size)  # ceil: table slots per row
+            # Default sizing covers every slot's worst-case RESERVATION (max
+            # context + segment overshoot, _admit), not just its table
+            # capacity — overshoot pops are transient but real until the
+            # boundary rebuild reclaims them.
+            per_row_worst = -(-(cap + self.chunk) // self.page_size) + 1
+            self.total_pages = int(total_pages or 1 + self.n_slots * per_row_worst)
+            init = init_quant_paged_cache if kv_backend == "paged_int8" else init_paged_cache
+            self._init_pool = lambda: init(
+                self.cfg, self.n_slots, total_pages=self.total_pages,
+                page_size=self.page_size, max_pages=per_row,
+            )
+            self._cache = self._init_pool()
+            self._decode_fn = forward_decode_paged
+            self._reserved_pages = 0
         # fp32, NOT activation dtype: sampling must see the same logits the
         # solo decode path sees, or bf16 rounding flips near-tied greedy
         # tokens versus agent.answer.
@@ -133,43 +192,169 @@ class ContinuousEngine:
         self._worker.join(timeout=10)
 
     def stats(self) -> dict[str, Any]:
-        return {
+        out = {
             "requests": self.requests,
             "segments": self.segments,
             "admitted_mid_flight": self.admitted_mid_flight,
             "max_concurrent": self.max_concurrent,
             "slots": self.n_slots,
             "chunk": self.chunk,
+            "kv_backend": self.kv_backend,
         }
+        if self.kv_backend != "dense":
+            out["total_pages"] = self.total_pages
+            out["reserved_pages"] = self._reserved_pages
+        return out
 
     # -- engine loop --------------------------------------------------------
 
-    def _admit(self, idx: int, question: str, fut: Future, t_submit: float, mid_flight: bool):
-        """Prefill one request and splice its state into slot ``idx``."""
+    def _admit(self, idx: int, question: str, fut: Future, t_submit: float, mid_flight: bool) -> bool:
+        """Prefill one request and splice its state into slot ``idx``.
+
+        Returns False when a paged backend lacks free pages for the request's
+        worst case (the caller re-queues it — capacity, not failure)."""
         agent = self.agent
         prompt = agent.format_prompt(question)
         tokens, lengths, _ = agent._prepare_batch([prompt])
-        cap = self._cache.k.shape[2]
-        row_cache = init_kv_cache(self.cfg, 1, cap)
-        logits1, row_cache = forward_prefill(self.cfg, agent.params, tokens, lengths, row_cache)
-        valid = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
-        mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
-
-        k, v, ln, self._logits, self._mask, self._finished = _splice_slot(
-            self._cache.k, self._cache.v, self._cache.lengths,
-            self._logits, self._mask, self._finished,
-            row_cache.k, row_cache.v, lengths[0], logits1[0], mask1[0],
-            jnp.asarray(idx, jnp.int32),
-        )
-        self._cache = KVCache(k=k, v=v, lengths=ln)
+        plen = int(lengths[0])
         budget = int(agent.sampling.max_new_tokens)
-        budget = min(budget, int(self.cfg.max_seq_len) - int(lengths[0]))
+        budget = min(budget, int(self.cfg.max_seq_len) - plen)
+
+        if self.kv_backend == "dense":
+            cap = self._cache.k.shape[2]
+            row_cache = init_kv_cache(self.cfg, 1, cap)
+            logits1, row_cache = forward_prefill(self.cfg, agent.params, tokens, lengths, row_cache)
+            valid = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
+            mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
+
+            k, v, ln, self._logits, self._mask, self._finished = _splice_slot(
+                self._cache.k, self._cache.v, self._cache.lengths,
+                self._logits, self._mask, self._finished,
+                row_cache.k, row_cache.v, lengths[0], logits1[0], mask1[0],
+                jnp.asarray(idx, jnp.int32),
+            )
+            self._cache = KVCache(k=k, v=v, lengths=ln)
+            reserved = 0
+        else:
+            # Worst-case pages this row can ever hold: the loop advances EVERY
+            # row to the segment boundary, so a row that EOSes or exhausts its
+            # budget mid-segment overshoots by < chunk tokens, + 1 bridge
+            # token (the overshoot tokens are garbage, trimmed host-side, but
+            # their page allocations are real until retirement reclaims them).
+            need = -(-(plen + budget + self.chunk) // self.page_size) + 1
+            idle_after = sum(1 for s in self._slots if not s.active) - 1
+            headroom = idle_after * self._segment_pages
+            if need + (self.n_slots - 1) * self._segment_pages > self.total_pages - 1:
+                raise ValueError(
+                    f"request needs {need} pages (prompt {plen} + budget "
+                    f"{budget} + segment overshoot); the pool holds "
+                    f"{self.total_pages - 1} minus idle-slot headroom"
+                )
+            if self._reserved_pages + need + headroom > self.total_pages - 1:
+                return False  # capacity — re-queue, admit at a later boundary
+            # Zero-copy KV admission: prefill through a one-row VIEW of the
+            # shared pool (slot's table row + shared pages, donated). Only
+            # the slot's own page-table/length entries change host-side; no
+            # KV row splice exists in the paged world.
+            row_view = self._cache._replace(
+                page_table=self._cache.page_table[idx : idx + 1],
+                lengths=jnp.zeros((1,), jnp.int32),
+            )
+            try:
+                logits1, row = _prefill_paged_donated(
+                    self.cfg, agent.params, tokens, lengths, row_view
+                )
+            except Exception:
+                # The donated pool buffers may already be invalidated — a
+                # fail-only-this-request recovery is impossible. Rebuild the
+                # pool and fail the in-flight rows (their KV lived in it),
+                # then re-raise so the caller fails THIS request too.
+                self._reset_pool(
+                    RuntimeError("page pool reset after a failed admission prefill")
+                )
+                raise
+            self._cache = row._replace(
+                page_table=self._cache.page_table.at[idx].set(row.page_table[0]),
+                lengths=self._cache.lengths.at[idx].set(row.lengths[0]),
+            )
+            valid = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
+            mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
+            self._logits = self._logits.at[idx].set(logits1[0].astype(self._logits.dtype))
+            self._mask = self._mask.at[idx].set(mask1[0])
+            self._finished = self._finished.at[idx].set(False)
+            self._reserved_pages += need
+            reserved = need
+
         self._slots[idx] = _Slot(
             future=fut, question=question, emitted=[], remaining=budget,
             t_submit=t_submit, t_start=time.perf_counter(),
+            pages_reserved=reserved,
         )
         if mid_flight:
             self.admitted_mid_flight += 1
+        return True
+
+    @property
+    def _segment_pages(self) -> int:
+        """Worst-case pages ONE IDLE slot can allocate across a segment +
+        bridge: idle rows always restart from length 0 (reset at retire /
+        sweep), so chunk + 1 garbage tokens need exactly this many pages."""
+        return -(-(self.chunk + 1) // self.page_size)
+
+    def _reclaim_pages(self, idx: int, pages_reserved: int = 0) -> None:
+        """Reset slot ``idx``'s table row and release its reservation. The
+        free stack itself is REBUILT from the table at the segment boundary
+        (_rebuild_free_stack) — the stack is derivable state, and rebuilding
+        also recovers pages the masked loop popped but whose table writes
+        clamped/dropped at capacity (they are referenced by no row)."""
+        self._cache = self._cache._replace(
+            page_table=self._cache.page_table.at[idx].set(0),
+            lengths=self._cache.lengths.at[idx].set(0),
+        )
+        self._reserved_pages -= pages_reserved
+
+    def _rebuild_free_stack(self) -> None:
+        """Host half of the allocator contract (runtime/paged_kv.PagedKVCache
+        docstring: 'the host rebuilds the stack between serving batches'):
+        free = every physical page no table row references. Runs at every
+        segment boundary — O(total_pages) numpy work."""
+        table = np.asarray(self._cache.page_table)
+        used = np.unique(table[table > 0])
+        free = np.setdiff1d(
+            np.arange(1, self.total_pages, dtype=np.int32), used.astype(np.int32)
+        )
+        stack = np.zeros((self.total_pages,), np.int32)
+        top = self.total_pages - free.size
+        stack[top:] = free
+        self._cache = self._cache._replace(
+            free_stack=jnp.asarray(stack),
+            free_top=jnp.asarray(top, jnp.int32),
+        )
+
+    def _reset_pool(self, exc: Exception) -> None:
+        """Fail every in-flight request and rebuild the paged pool from
+        scratch (fresh zeroed arrays — safe even when the old buffers were
+        invalidated by a failed donated prefill)."""
+        for i, s in enumerate(self._slots):
+            if s.active:
+                if not s.future.done():
+                    s.future.set_exception(exc)
+                self._slots[i] = _Slot()
+        self._finished = jnp.ones((self.n_slots,), bool)
+        self._cache = self._init_pool()
+        self._reserved_pages = 0
+
+    def _sweep_idle_pages(self) -> None:
+        """Idle slots ride the static-shape decode loop masked, but their
+        garbage lengths still cross page boundaries and ALLOCATE — reset
+        their table rows after every segment (their count is bounded by
+        ``_segment_pages`` per idle slot, which admission holds as headroom),
+        then rebuild the free stack from the table."""
+        table = np.asarray(self._cache.page_table)
+        for i, s in enumerate(self._slots):
+            if not s.active and (table[i] > 0).any():
+                self._reclaim_pages(i)
+        self._rebuild_free_stack()
 
     def _retire(self, idx: int):
         slot = self._slots[idx]
@@ -187,6 +372,8 @@ class ContinuousEngine:
                 "t_end": now,
             }
         )
+        if self.kv_backend != "dense":
+            self._reclaim_pages(idx, slot.pages_reserved)
         self._slots[idx] = _Slot()
         self._finished = self._finished.at[idx].set(True)
 
@@ -205,11 +392,10 @@ class ContinuousEngine:
                 free = [i for i, s in enumerate(self._slots) if not s.active]
                 while self._queue and free and len(pending) < len(free):
                     pending.append(self._queue.popleft())
-            for (q, fut, ts), idx in zip(
-                pending, [i for i, s in enumerate(self._slots) if not s.active]
-            ):
+            free_now = [i for i, s in enumerate(self._slots) if not s.active]
+            for pos, ((q, fut, ts), idx) in enumerate(zip(pending, free_now)):
                 try:
-                    self._admit(idx, q, fut, ts, mid_flight=any_active_before)
+                    ok = self._admit(idx, q, fut, ts, mid_flight=any_active_before)
                 except Exception as exc:
                     # Fail only THIS request: already-admitted slots keep
                     # their pending futures (poisoning them would make the
@@ -218,6 +404,16 @@ class ContinuousEngine:
                     log.exception("admission failed for %r", q[:80])
                     if not fut.done():
                         fut.set_exception(exc)
+                    continue
+                if not ok:
+                    # Page-pool capacity: re-queue this and the rest of the
+                    # batch (order preserved); they admit at a later segment
+                    # boundary once retirements reclaim pages. Reservations
+                    # imply active rows exist, so the loop cannot spin.
+                    with self._cond:
+                        for item in reversed(pending[pos:]):
+                            self._queue.appendleft(item)
+                    break
 
             active = [i for i, s in enumerate(self._slots) if s.active]
             self.max_concurrent = max(self.max_concurrent, len(active))
@@ -235,8 +431,8 @@ class ContinuousEngine:
                 self._rng, seg_rng = jax.random.split(self._rng)
                 out, counts, self._cache, _, self._mask, prev, fin = _decode_loop(
                     self.cfg, agent.params, agent.sampling, self.chunk, eos_id,
-                    self._logits, self._cache, self._mask, seg_rng, None,
-                    self._finished,
+                    self._logits, self._cache, self._mask, seg_rng,
+                    self._decode_fn, self._finished,
                 )
                 self.segments += 1
                 counts_h = jax.device_get(counts)
@@ -262,16 +458,22 @@ class ContinuousEngine:
                 # admission, and writes clamp at capacity. Do not read idle
                 # rows' lengths as if they tracked anything.
                 if any(s.active for s in self._slots):
-                    logits, self._cache = forward_decode(self.cfg, agent.params, prev, self._cache)
+                    decode_fn = self._decode_fn or forward_decode
+                    logits, self._cache = decode_fn(self.cfg, agent.params, prev, self._cache)
                     self._logits = logits.astype(self._logits.dtype)
+                if self.kv_backend != "dense":
+                    self._sweep_idle_pages()
             except Exception as exc:
                 log.exception("decode segment failed; failing %d in-flight requests", len(active))
-                for i in active:
-                    fut = self._slots[i].future
-                    if fut is not None and not fut.done():
-                        fut.set_exception(exc)
-                    self._slots[i] = _Slot()
-                self._finished = jnp.ones((self.n_slots,), bool)
+                if self.kv_backend != "dense":
+                    self._reset_pool(exc)
+                else:
+                    for i in active:
+                        fut = self._slots[i].future
+                        if fut is not None and not fut.done():
+                            fut.set_exception(exc)
+                        self._slots[i] = _Slot()
+                    self._finished = jnp.ones((self.n_slots,), bool)
 
             # Give stragglers a brief window to queue before the next segment
             # (they join at the boundary either way; this just batches admits).

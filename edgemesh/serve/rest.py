@@ -146,7 +146,7 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
 
 def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = True,
                supervisor=None, batch: int = 0, batch_wait_s: float = 0.02,
-               continuous: bool = False):
+               continuous: bool = False, kv_backend: str = "dense"):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
@@ -161,8 +161,17 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     ``continuous=True`` (single-QA-agent ensembles only) swaps the batch-
     then-drain batcher for the chunk-granular ContinuousEngine
     (serve/continuous.py): requests join/leave the resident decode loop at
-    segment boundaries; ``batch`` sizes the slot pool."""
+    segment boundaries; ``batch`` sizes the slot pool. ``kv_backend``
+    ("dense" | "paged" | "paged_int8") picks the engine's KV memory model —
+    the paged pool gives zero-copy admission and page reclamation
+    (serve/continuous.py module docstring)."""
     batcher = None
+    if kv_backend != "dense" and not continuous:
+        raise ValueError(
+            f"kv_backend={kv_backend!r} requires continuous=True (the paged "
+            "pool lives in the ContinuousEngine); add --continuous, or drop "
+            "the flag for the dense batched paths"
+        )
     if continuous:
         from edgemesh.serve.continuous import ContinuousEngine
 
@@ -179,7 +188,9 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
                 f"{' + refiner' if ensemble.refiner else ''}); use --batch "
                 "for multi-agent ensembles"
             )
-        batcher = ContinuousEngine(ensemble.qa_agents[0], slots=batch or 8)
+        batcher = ContinuousEngine(
+            ensemble.qa_agents[0], slots=batch or 8, kv_backend=kv_backend
+        )
     elif batch > 1:
         from edgemesh.serve.batcher import DynamicBatcher
 

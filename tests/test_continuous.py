@@ -90,3 +90,72 @@ def test_closed_engine_rejects():
     eng.close()
     with pytest.raises(RuntimeError):
         eng.submit("too late")
+
+
+def _wait_drained(eng, timeout=30.0):
+    """reserved_pages drains moments AFTER the last future resolves (set_result
+    precedes the reclaim inside _retire) — poll instead of racing the worker."""
+    deadline = time.time() + timeout
+    while eng.stats()["reserved_pages"] != 0 and time.time() < deadline:
+        time.sleep(0.02)
+    return eng.stats()["reserved_pages"]
+
+
+def test_paged_single_request_matches_direct_answer():
+    """Paged pool (bf16 pages): same greedy tokens as the solo decode path —
+    zero-copy admission and the page-table kernel change nothing numeric."""
+    agent = _agent()
+    eng = ContinuousEngine(agent, slots=4, chunk=8, kv_backend="paged", page_size=8)
+    try:
+        got = eng.answer("where is the eiffel tower?")
+        direct = agent.answer("where is the eiffel tower?")
+        assert got["answer"] == direct["answer"]
+        assert eng.stats()["kv_backend"] == "paged"
+    finally:
+        eng.close()
+
+
+@pytest.mark.parametrize("backend", ["paged", "paged_int8"])
+def test_paged_engine_overcommit_reclaims_pages(backend):
+    """More requests than slots: retirements push pages back onto the free
+    stack, queued requests admit at later boundaries, reservations drain to
+    zero when the stream ends."""
+    agent = _agent(max_new=12)
+    eng = ContinuousEngine(agent, slots=2, chunk=8, kv_backend=backend, page_size=8)
+    try:
+        futs = [eng.submit(f"q {i}?") for i in range(5)]
+        results = [f.result(timeout=600) for f in futs]
+        assert len(results) == 5
+        assert all(isinstance(r["answer"], str) for r in results)
+        assert _wait_drained(eng) == 0
+        assert eng.stats()["requests"] == 5
+    finally:
+        eng.close()
+
+
+def test_paged_capacity_queues_requests_instead_of_crashing():
+    """A pool sized below the all-slots worst case serializes admissions via
+    the reservation check — every request still completes."""
+    agent = _agent(max_new=12)
+    eng = ContinuousEngine(
+        agent, slots=2, chunk=8, kv_backend="paged", page_size=8, total_pages=16
+    )
+    try:
+        futs = [eng.submit(f"question {i}?") for i in range(3)]
+        results = [f.result(timeout=600) for f in futs]
+        assert all(isinstance(r["answer"], str) for r in results)
+        assert _wait_drained(eng) == 0
+    finally:
+        eng.close()
+
+
+def test_paged_request_too_big_for_pool_fails_cleanly():
+    agent = _agent(max_new=64)
+    eng = ContinuousEngine(
+        agent, slots=2, chunk=8, kv_backend="paged", page_size=8, total_pages=4
+    )
+    try:
+        with pytest.raises(ValueError, match="pool holds"):
+            eng.answer("this request cannot ever fit?")
+    finally:
+        eng.close()
