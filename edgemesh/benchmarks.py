@@ -83,10 +83,17 @@ def fabricate_int8_params(cfg) -> dict:
         # process and would make the fabricated tree non-reproducible.
         import zlib
 
+        # Per-leaf progress: each leaf is its own device dispatch (up to
+        # ~1.9 GB for the 8B mlp), and the r3 tunnel wedge hit exactly here
+        # with nothing logged for 900s — feed the stall watchdog per leaf so
+        # a slow-but-alive fabricate isn't killed and a wedge names its leaf.
+        _progress(f"fabricate leaf {key} {tuple(shape)}")
         ki = jax.random.fold_in(jax.random.PRNGKey(0), zlib.crc32(key.encode()) % (2**31))
-        return jax.jit(
+        out = jax.jit(
             lambda: jax.random.randint(ki, shape, -127, 128, jnp.int32).astype(jnp.int8)
         )()
+        out.block_until_ready()
+        return out
 
     def dense_q(key, i, o):
         return {"kernel_q": q(key, L, i, o), "scales": jnp.full((L, o), 0.01, jnp.float32)}
@@ -120,6 +127,76 @@ def fabricate_int8_params(cfg) -> dict:
             "scales": jnp.full((V,), 0.01, jnp.float32),
         }
     return params
+
+
+def serving_benchmark(
+    preset: str | None = None,
+    precision: str = "int8",
+    quant_mode: str = "w8a16",
+    slots: int = 8,
+    chunk: int = 32,
+    kv_backend: str = "paged",
+    n_requests: int = 24,
+    max_new: int = 64,
+    built: tuple | None = None,
+) -> dict[str, Any]:
+    """Continuous-batching serving throughput (serve/continuous.py): N
+    concurrent requests stream through the resident decode loop; reports
+    aggregate generated tok/s, completed requests/s, and end-to-end request
+    latency percentiles (queue + decode). The reference has no serving path
+    at all — its fabric never carried model traffic (SURVEY.md §2.3)."""
+    from edgemesh.agents.orchestrator import Agent
+    from edgemesh.models.tokenizer import ByteTokenizer
+    from edgemesh.serve.continuous import ContinuousEngine
+
+    preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
+    if built is not None:
+        cfg, params = built
+        if precision == "int8":
+            cfg = cfg.replace(quant_mode=quant_mode)
+    else:
+        cfg, params = _build(preset, precision, quant_mode)
+    agent = Agent(
+        role="qa", cfg=cfg, params=params, tokenizer=ByteTokenizer(),
+        sampling=SamplingParams(
+            max_new_tokens=max_new, temperature=0.7, top_k=50, top_p=0.9,
+            repetition_penalty=1.2, do_sample=True,
+        ),
+        prefix_cache=False,
+    )
+    eng = ContinuousEngine(agent, slots=slots, chunk=chunk, kv_backend=kv_backend)
+    try:
+        _progress(f"serving/{kv_backend} slots={slots}: warmup compile")
+        eng.answer("warm up the resident decode loop?")
+        _progress(f"serving/{kv_backend}: {n_requests} requests x {max_new} new tokens")
+        t0 = time.perf_counter()
+        futs = [
+            eng.submit(f"benchmark question number {i}, please answer at length?")
+            for i in range(n_requests)
+        ]
+        results = [f.result() for f in futs]
+        wall = time.perf_counter() - t0
+        import numpy as np
+
+        generated = sum(r["generated"] for r in results)
+        lats = [r["t_end"] - r["t_start"] + r["queue_s"] for r in results]
+        tok_s = generated / wall
+        _progress(
+            f"serving/{kv_backend}: {tok_s:.1f} tok/s aggregate, "
+            f"{n_requests / wall:.2f} req/s"
+        )
+        return {
+            "metric": f"serving_tok_s_{preset}_{precision}_{kv_backend}",
+            "value": round(tok_s, 2),
+            "unit": "tok/s/chip",
+            "req_s": round(n_requests / wall, 3),
+            "generated": generated,
+            "latency_s_p50": round(float(np.percentile(lats, 50)), 4),
+            "latency_s_p95": round(float(np.percentile(lats, 95)), 4),
+            "stats": eng.stats(),
+        }
+    finally:
+        eng.close()
 
 
 _T0 = time.perf_counter()
@@ -578,7 +655,21 @@ def headline_benchmark(
 
     _stage("longctx", _longctx)
 
-    # ---- Stage 7: int4 (w4a16): half int8's weight bytes — the memory
+    # ---- Stage 7: continuous-batching serving throughput over the paged
+    # pool — the serving-path headline (requests stream through the resident
+    # decode loop; zero-copy paged admission). Skippable via
+    # EDGEMESH_BENCH_SERVE=0.
+    def _serving():
+        r = serving_benchmark(preset, built=int8_built, kv_backend="paged")
+        out["serving_paged_tok_s"] = r["value"]
+        out["serving_paged_req_s"] = r["req_s"]
+        out["serving_latency_s_p50"] = r["latency_s_p50"]
+        out["serving_latency_s_p95"] = r["latency_s_p95"]
+
+    if os.environ.get("EDGEMESH_BENCH_SERVE", "1") == "1":
+        _stage("serving", _serving)
+
+    # ---- Stage 8: int4 (w4a16): half int8's weight bytes — the memory
     # headline beyond the reference's 38% int8 cut. Both scale granularities:
     # per-channel (fastest) and the grouped product default.
     def _int4():
@@ -596,7 +687,7 @@ def headline_benchmark(
 
     _stage("int4", _int4)
 
-    # ---- Stage 8: north-star scale — Llama-3-8B int8 decode on ONE chip
+    # ---- Stage 9: north-star scale — Llama-3-8B int8 decode on ONE chip
     # (~8.9 GB weights, fabricated directly at int8). EDGEMESH_BENCH_8B=0 skips.
     if os.environ.get("EDGEMESH_BENCH_8B", "1") == "1" and preset == "llama1b":
         def _big():

@@ -367,6 +367,7 @@ class ContinuousEngine:
                 "answer": text.strip(),
                 "role": self.agent.role,
                 "tps": len(slot.emitted) / wall,
+                "generated": len(slot.emitted),
                 "queue_s": slot.t_start - slot.t_submit,
                 "t_start": slot.t_start,
                 "t_end": now,

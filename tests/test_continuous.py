@@ -159,3 +159,15 @@ def test_paged_request_too_big_for_pool_fails_cleanly():
             eng.answer("this request cannot ever fit?")
     finally:
         eng.close()
+
+
+def test_serving_benchmark_reports_throughput():
+    """The bench's serving stage end-to-end on the tiny preset: aggregate
+    tok/s, req/s, and latency percentiles from real engine futures."""
+    from edgemesh.benchmarks import serving_benchmark
+
+    r = serving_benchmark("tiny", "bf16", slots=2, chunk=8, n_requests=3,
+                          max_new=8)
+    assert r["value"] > 0 and r["generated"] >= 3 * 1
+    assert r["latency_s_p95"] >= r["latency_s_p50"] > 0
+    assert r["stats"]["kv_backend"] == "paged"
