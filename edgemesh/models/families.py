@@ -135,6 +135,22 @@ FAMILY_PRESETS: dict[str, dict] = {
         attn_soft_cap=50.0,
         logit_soft_cap=30.0,
     ),
+    # Falcon (7B dialect): LayerNorm+bias norms, gelu MLP, PARALLEL block
+    # with one shared input norm (like phi2), full rotary, MULTI-QUERY
+    # attention (num_kv_heads=1), no linear biases, tied head. The
+    # new-decoder variants (40B / Falcon2) switch to dual input norms
+    # (shared_input_norm=False) + GQA via config_from_checkpoint.
+    "falcon": dict(
+        norm="ln",
+        activation="gelu",
+        parallel_block=True,
+        shared_input_norm=True,
+        rotary_fraction=1.0,
+        qkv_bias=False,
+        out_bias=False,
+        lm_head_bias=False,
+        tie_embeddings=True,
+    ),
     # GPT-2: pre-LN LayerNorm+bias, gelu_new (tanh), LEARNED absolute
     # position embeddings (no rotary), fused c_attn qkv with biases
     # (Conv1D [in, out] storage — no transpose at ingest), always-tied head.
@@ -162,6 +178,7 @@ _HF_MODEL_TYPE_TO_FAMILY = {
     "gemma2": "gemma2",
     "phi3": "phi3",
     "gpt2": "gpt2",
+    "falcon": "falcon",
     # Encoder family (BERT/MiniLM/sentence-BERT): bidirectional, post-LN,
     # learned positions — its own forward in models/encoder.py, NOT a
     # decoder preset. sniff_family recognizes it so ingest dispatches (or
@@ -219,7 +236,7 @@ def tiny_config(family: str = "llama", **overrides) -> ModelConfig:
         hidden_size=64,
         num_layers=2,
         num_heads=4,
-        num_kv_heads=2 if family == "llama" else 4,
+        num_kv_heads=2 if family == "llama" else (1 if family == "falcon" else 4),
         intermediate_size=128,
         max_seq_len=128,
         dtype="float32",

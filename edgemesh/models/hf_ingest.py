@@ -9,8 +9,8 @@ layer axis, and ``jax.device_put`` the tree into (sharded) HBM
 
 Name maps cover the reference's three model families (ACL paper §4.2) —
 Llama (Llama-3.2-1B-Instruct), GPT-NeoX (Pythia-1B), Phi (Phi-2) — plus
-Mistral, Qwen2, Gemma, Gemma-2, Phi-3, and GPT-2 (families.py registry;
-each pinned against HF logits in tests/test_hf_parity.py).
+Mistral, Qwen2, Gemma, Gemma-2, Phi-3, GPT-2, and Falcon (families.py
+registry; each pinned against HF logits in tests/test_hf_parity.py).
 """
 
 from __future__ import annotations
@@ -178,6 +178,50 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
             rotary_fraction=float(hf.get("partial_rotary_factor", 0.4)),
             norm_eps=hf.get("layer_norm_eps", 1e-5),
         )
+    elif family == "falcon":
+        if hf.get("alibi"):
+            raise ValueError(
+                f"alibi=true in {ckpt / 'config.json'} is not supported "
+                "(rotary-position falcon checkpoints only)"
+            )
+        new_dec = bool(hf.get("new_decoder_architecture"))
+        if new_dec:
+            kv = int(hf.get("num_kv_heads") or hf["num_attention_heads"])
+        elif hf.get("multi_query", True):
+            kv = 1
+        else:
+            kv = hf["num_attention_heads"]
+        parallel = bool(hf.get("parallel_attn", True))
+        f_act = hf.get("activation", "gelu")
+        f_act_map = {"gelu": "gelu", "gelu_new": "gelu_tanh", "gelu_pytorch_tanh": "gelu_tanh"}
+        if f_act not in f_act_map:
+            raise ValueError(
+                f"activation {f_act!r} in {ckpt / 'config.json'} is not "
+                f"supported for falcon; supported: {sorted(f_act_map)}"
+            )
+        # Norm arrangement varies by lineage: 7B = ONE shared input norm;
+        # 40B-style new-decoder = dual ln_attn/ln_mlp; rw (parallel_attn
+        # false) = sequential pre-norms; Falcon2-11B = new-decoder with
+        # num_ln_in_parallel_attn=1 (shared again).
+        dual_ln = new_dec and int(hf.get("num_ln_in_parallel_attn") or 2) == 2
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=kv,
+            intermediate_size=hf.get("ffn_hidden_size") or 4 * hf["hidden_size"],
+            max_seq_len=min(hf.get("max_position_embeddings", 2048), 8192),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            parallel_block=parallel,
+            shared_input_norm=parallel and not dual_ln,
+            qkv_bias=bool(hf.get("bias", False)),
+            out_bias=bool(hf.get("bias", False)),
+            tie_embeddings=hf.get("tie_word_embeddings", True),
+            activation=f_act_map[f_act],
+        )
+        kw.update(_rope_scaling_kw(hf, ckpt))
     elif family == "gpt2":
         # GPT2Config dials: n_embd/n_layer/n_head/n_positions; the wpe table
         # bounds max_seq_len (learned positions cannot extrapolate). Every
@@ -216,7 +260,7 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
         raise ValueError(family)
     rs = hf.get("rope_scaling") or {}
     rs_type = rs.get("rope_type", rs.get("type", ""))
-    if family not in ("llama", "mistral", "qwen2", "gemma", "gemma2", "phi3") and rs and rs_type not in ("default", "none", ""):
+    if family not in ("llama", "mistral", "qwen2", "gemma", "gemma2", "phi3", "falcon") and rs and rs_type not in ("default", "none", ""):
         # The neox/phi2 forward paths don't consume a scaling block; ignoring
         # a frequency-changing one would silently produce wrong logits for a
         # long-context variant. No-op types (newer HF configs emit
@@ -266,6 +310,8 @@ def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) ->
         params = _map_neox(raw, cfg, dtype)
     elif family == "gpt2":
         params = _map_gpt2(raw, cfg, dtype)
+    elif family == "falcon":
+        params = _map_falcon(raw, cfg, dtype)
     else:
         params = _map_phi2(raw, cfg, dtype)
     return cfg, params
@@ -389,6 +435,93 @@ def _map_neox(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
         },
         "lm_head": {"kernel": jnp.asarray(np.ascontiguousarray(raw["embed_out.weight"].T), dtype)},
     }
+
+
+def _map_falcon(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
+    """Falcon name map. The fused query_key_value rows are GROUPED per kv
+    head — ``(kh, groups+2, hd)`` blocks of [q…q, k, v] — which covers all
+    three lineages with one reshape: multi-query 7B is kh=1 (one group of
+    [q×nh, k, v]), new-decoder 40B/Falcon2 is true GQA, and the kh==nh
+    checkpoints (rw / MHA new-decoder) degenerate to per-head [q, k, v]
+    interleave. Norm names pick the lineage: ln_attn/ln_mlp (dual),
+    input_layernorm alone (shared, 7B), or input_layernorm +
+    post_attention_layernorm (sequential rw)."""
+    if "transformer.word_embeddings.weight" in raw:
+        raw = {
+            (k[len("transformer."):] if k.startswith("transformer.") else k): v
+            for k, v in raw.items()
+        }
+    L, nh, kh, hd, h = (
+        cfg.num_layers, cfg.num_heads, cfg.num_kv_heads, cfg.head_size,
+        cfg.hidden_size,
+    )
+    gq = nh // kh
+    has_qkv_bias = "h.0.self_attention.query_key_value.bias" in raw
+
+    def split_qkv(i: int):
+        w = raw[f"h.{i}.self_attention.query_key_value.weight"]  # [(gq+2)*kh*hd, h]
+        w = w.reshape(kh, gq + 2, hd, h)
+        qw = np.ascontiguousarray(w[:, :gq].reshape(kh * gq * hd, h).T)
+        kw_ = np.ascontiguousarray(w[:, gq].reshape(kh * hd, h).T)
+        vw = np.ascontiguousarray(w[:, gq + 1].reshape(kh * hd, h).T)
+        if has_qkv_bias:
+            b = raw[f"h.{i}.self_attention.query_key_value.bias"].reshape(kh, gq + 2, hd)
+            return qw, kw_, vw, (
+                np.ascontiguousarray(b[:, :gq].reshape(kh * gq * hd)),
+                np.ascontiguousarray(b[:, gq].reshape(kh * hd)),
+                np.ascontiguousarray(b[:, gq + 1].reshape(kh * hd)),
+            )
+        return qw, kw_, vw, None
+
+    qkv = [split_qkv(i) for i in range(L)]
+
+    def layer_stack(fmt: str, transpose: bool) -> jnp.ndarray:
+        return _layer_stack(raw, fmt, L, dtype, transpose)
+
+    def norm(fmt_base: str) -> Params:
+        return {
+            "scale": layer_stack(fmt_base + ".weight", False),
+            "bias": layer_stack(fmt_base + ".bias", False),
+        }
+
+    def dense_maybe_bias(name: str) -> Params:
+        out: Params = {"kernel": layer_stack("h.{}." + name + ".weight", True)}
+        if f"h.0.{name}.bias" in raw:
+            out["bias"] = layer_stack("h.{}." + name + ".bias", False)
+        return out
+
+    layers: Params = {
+        "q": {"kernel": _stack([t[0] for t in qkv], dtype)},
+        "k": {"kernel": _stack([t[1] for t in qkv], dtype)},
+        "v": {"kernel": _stack([t[2] for t in qkv], dtype)},
+        "o": dense_maybe_bias("self_attention.dense"),
+        "up": dense_maybe_bias("mlp.dense_h_to_4h"),
+        "down": dense_maybe_bias("mlp.dense_4h_to_h"),
+    }
+    if has_qkv_bias:
+        for j, name in enumerate(("q", "k", "v")):
+            layers[name]["bias"] = _stack([t[3][j] for t in qkv], dtype)
+    if "h.0.ln_attn.weight" in raw:  # dual input norms (parallel, 40B-style)
+        layers["attn_norm"] = norm("h.{}.ln_attn")
+        layers["mlp_norm"] = norm("h.{}.ln_mlp")
+    elif cfg.shared_input_norm:  # 7B: one norm feeds attn AND mlp
+        layers["attn_norm"] = norm("h.{}.input_layernorm")
+    else:  # sequential rw lineage
+        layers["attn_norm"] = norm("h.{}.input_layernorm")
+        layers["mlp_norm"] = norm("h.{}.post_attention_layernorm")
+    params: Params = {
+        "embed": {"weight": jnp.asarray(raw["word_embeddings.weight"], dtype)},
+        "layers": layers,
+        "final_norm": {
+            "scale": jnp.asarray(raw["ln_f.weight"], dtype),
+            "bias": jnp.asarray(raw["ln_f.bias"], dtype),
+        },
+    }
+    if not cfg.tie_embeddings and "lm_head.weight" in raw:
+        params["lm_head"] = {
+            "kernel": jnp.asarray(np.ascontiguousarray(raw["lm_head.weight"].T), dtype)
+        }
+    return params
 
 
 def _map_gpt2(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:

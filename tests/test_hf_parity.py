@@ -316,6 +316,43 @@ def test_gpt2_parity(tmp_path):
     _compare(tmp_path, model, seq=12)
 
 
+def test_falcon_multiquery_parity(tmp_path):
+    """Falcon 7B dialect: MULTI-QUERY attention (one kv head), parallel block
+    with a single shared input norm, gelu MLP, full rotary, no biases."""
+    from transformers import FalconConfig, FalconForCausalLM
+
+    hf_cfg = FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, bias=False, alibi=False,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(5)
+    model = FalconForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path)
+    assert cfg.num_kv_heads == 1 and cfg.parallel_block and cfg.shared_input_norm
+    _compare(tmp_path, model, seq=12)
+
+
+def test_falcon_new_decoder_gqa_parity(tmp_path):
+    """Falcon 40B/Falcon2 dialect: new-decoder GQA (grouped fused qkv rows),
+    dual ln_attn/ln_mlp input norms."""
+    from transformers import FalconConfig, FalconForCausalLM
+
+    hf_cfg = FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2, new_decoder_architecture=True,
+        bias=False, alibi=False, tie_word_embeddings=True,
+    )
+    torch.manual_seed(6)
+    model = FalconForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path)
+    assert cfg.num_kv_heads == 2 and not cfg.shared_input_norm
+    _compare(tmp_path, model, seq=12)
+
+
 def test_bert_encoder_parity(tmp_path):
     """Encoder family (MiniLM-class) hidden-state parity vs HF BertModel,
     including right-padded rows: the bidirectional mask must exclude padding

@@ -13,7 +13,7 @@ from edgemesh.models.families import tiny_config
 from edgemesh.models.transformer import forward_decode, forward_prefill
 from edgemesh.runtime import generate
 
-FAMILIES = ["llama", "neox", "phi2", "mistral", "qwen2", "gemma", "phi3", "gemma2", "gpt2"]
+FAMILIES = ["llama", "neox", "phi2", "mistral", "qwen2", "gemma", "phi3", "gemma2", "gpt2", "falcon"]
 
 
 @pytest.mark.parametrize("family", FAMILIES)
