@@ -66,7 +66,7 @@ class _LoopState(NamedTuple):
     conf_sum: jax.Array  # [b] running sum of per-step max softmax prob
 
 
-@partial(jax.jit, static_argnums=(0, 2, 3, 4, 9))
+@partial(jax.jit, static_argnums=(0, 2, 3, 4, 9), donate_argnums=(6, 7))
 def _decode_loop(
     cfg: ModelConfig,
     params,
@@ -87,7 +87,13 @@ def _decode_loop(
 
     Returns (out, num_generated, cache, confidence, token_mask, prev_token,
     finished) — the trailing three let ``generate_stream`` continue decoding
-    in a later segment exactly where this one stopped."""
+    in a later segment exactly where this one stopped.
+
+    ``cache`` and ``token_mask`` are DONATED: the loop-carry copy at entry
+    (the whole multi-GB cache, once per serving segment) reuses the input
+    buffers instead. Callers must treat the passed-in arrays as dead and
+    use the returned ones — every current caller already reassigns; the
+    continuous engine additionally re-inits both on a failed segment."""
     batch, vocab = first_logits.shape
     decode_fn = decode_fn or forward_decode
 

@@ -332,17 +332,22 @@ class ContinuousEngine:
         )
 
     def _reset_pool(self, exc: Exception) -> None:
-        """Fail every in-flight request and rebuild the paged pool from
-        scratch (fresh zeroed arrays — safe even when the old buffers were
-        invalidated by a failed donated prefill)."""
+        """Fail every in-flight request and rebuild the KV state from scratch
+        — fresh zeroed arrays for EVERY donated buffer (cache + repetition
+        mask), safe even when the old ones were invalidated by a failed
+        donated prefill or segment. One recovery path for both backends."""
         for i, s in enumerate(self._slots):
             if s.active:
                 if not s.future.done():
                     s.future.set_exception(exc)
                 self._slots[i] = _Slot()
         self._finished = jnp.ones((self.n_slots,), bool)
-        self._cache = self._init_pool()
-        self._reserved_pages = 0
+        if self.kv_backend == "dense":
+            self._cache = init_kv_cache(self.cfg, self.n_slots, self.cfg.max_seq_len)
+        else:
+            self._cache = self._init_pool()
+            self._reserved_pages = 0
+        self._mask = TokenMaskState.init(self.n_slots, self.cfg.vocab_size).mask
 
     def _sweep_idle_pages(self) -> None:
         """Idle slots ride the static-shape decode loop masked, but their
@@ -466,15 +471,7 @@ class ContinuousEngine:
                     self._sweep_idle_pages()
             except Exception as exc:
                 log.exception("decode segment failed; failing %d in-flight requests", len(active))
-                if self.kv_backend != "dense":
-                    self._reset_pool(exc)
-                else:
-                    for i in active:
-                        fut = self._slots[i].future
-                        if fut is not None and not fut.done():
-                            fut.set_exception(exc)
-                        self._slots[i] = _Slot()
-                    self._finished = jnp.ones((self.n_slots,), bool)
+                self._reset_pool(exc)
 
             # Give stragglers a brief window to queue before the next segment
             # (they join at the boundary either way; this just batches admits).
