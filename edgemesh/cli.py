@@ -68,13 +68,13 @@ def cmd_eval(cfg: EdgeMeshConfig) -> int:
 
 
 def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool = False,
-              kv_backend: str = "dense") -> int:
+              kv_backend: str = "dense", kv_page_size: int = 64) -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.serve import serve_rest
 
     ensemble = build_ensemble(cfg)
     serve_rest(ensemble, port=port, batch=batch, continuous=continuous,
-               kv_backend=kv_backend)
+               kv_backend=kv_backend, kv_page_size=kv_page_size)
     return 0
 
 
@@ -204,6 +204,12 @@ def main(argv: list[str] | None = None) -> int:
         "with zero-copy admission + reclamation; paged_int8 halves KV bytes)",
     )
     top.add_argument(
+        "--kv-page-size", type=int, default=64,
+        help="serve --continuous --kv-backend paged*: tokens per KV page "
+        "(smaller pages = finer reclamation + template prefix sharing kicks "
+        "in once the template spans a full page)",
+    )
+    top.add_argument(
         "--preset", type=str, default=None,
         help="bench: model preset (validated by the bench command)",
     )
@@ -245,7 +251,7 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_eval(cfg)
     if cmd_args.command == "serve":
         return cmd_serve(cfg, cmd_args.port, cmd_args.batch, cmd_args.continuous,
-                         cmd_args.kv_backend)
+                         cmd_args.kv_backend, cmd_args.kv_page_size)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     if cmd_args.command == "train":

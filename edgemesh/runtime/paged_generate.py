@@ -156,6 +156,66 @@ def _paged_attention(
     return proj, (k_pages, v_pages, table, kv_lens)
 
 
+def _paged_suffix_attention(
+    cfg: ModelConfig,
+    layer,
+    x: jnp.ndarray,  # [b, s, h] suffix chunk
+    positions: jnp.ndarray,  # [b, s] ABSOLUTE positions (start + offset)
+    cache,  # (k_pages, v_pages, [k_scales, v_scales,] page_table, kv_lens)
+    kv_valid,  # [b, max_pages*page_size] — col < final kv_lens
+    lengths: jnp.ndarray,  # [b] tokens ALREADY in the pages (suffix start)
+    is_decode: bool,
+):
+    """Chunk-append attention over pages: write the suffix into its rows'
+    pages, then attend over the GATHERED dense view (existing prefix pages +
+    the fresh writes, read back exactly as decode will read them — int8
+    roundtrip included for the quant pool). Admission-path only (batch-1,
+    once per request): the gather is the dense-oracle path, the hot decode
+    loop keeps the page-walking kernel. This is what lets rows warm-start
+    from SHARED template pages (serve/continuous.py prefix sharing)."""
+    from edgemesh.runtime.paged_kv import gather_dense, gather_dense_scales
+
+    quant = len(cache) == 6
+    if quant:
+        k_pages, v_pages, k_sc, v_sc, table, kv_lens = cache
+    else:
+        k_pages, v_pages, table, kv_lens = cache
+    b, s, _ = x.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    q, k, v = qkv_proj(cfg, layer, x, positions)
+    suffix_len = kv_lens - lengths
+    if quant:
+        from edgemesh.runtime.quant_kv import quantize_kv
+
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        k_pages, v_pages, k_sc, v_sc = write_tokens_quant(
+            k_pages, v_pages, k_sc, v_sc, kq, ks, vq, vs, table,
+            start=lengths, valid_len=suffix_len,
+        )
+        dense_k = gather_dense(k_pages, table).astype(jnp.float32)
+        dense_v = gather_dense(v_pages, table).astype(jnp.float32)
+        dks = gather_dense_scales(k_sc, table)
+        dvs = gather_dense_scales(v_sc, table)
+        dense_k = (dense_k * dks[..., None]).astype(x.dtype)
+        dense_v = (dense_v * dvs[..., None]).astype(x.dtype)
+    else:
+        k_pages, v_pages = write_tokens(
+            k_pages, v_pages, k, v, table, start=lengths, valid_len=suffix_len,
+        )
+        dense_k = gather_dense(k_pages, table)
+        dense_v = gather_dense(v_pages, table)
+    out = attend(
+        q, LayerKV(dense_k, dense_v), positions, kv_valid,
+        scale=cfg.query_scale, sliding_window=cfg.sliding_window,
+        soft_cap=cfg.attn_soft_cap,
+    )
+    proj = dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode)
+    if quant:
+        return proj, (k_pages, v_pages, k_sc, v_sc, table, kv_lens)
+    return proj, (k_pages, v_pages, table, kv_lens)
+
+
 def _paged_forward(
     cfg: ModelConfig,
     params,
@@ -164,6 +224,8 @@ def _paged_forward(
     cache: PagedKVCache,
     kv_lens: jnp.ndarray,  # [b] valid tokens AFTER this call's writes
     is_decode: bool,
+    attention=_paged_attention,
+    kv_valid=None,
 ):
     x = embed_tokens(cfg, params, tokens, positions)
     quant = isinstance(cache, QuantPagedKVCache)
@@ -172,8 +234,8 @@ def _paged_forward(
         layer, *kv = scanned
         state = (*kv, cache.page_table, kv_lens)
         h, new_state, _aux = _layer_fn(
-            layer_cfg, h, layer, state, positions, None, cache.lengths, is_decode,
-            _paged_attention,
+            layer_cfg, h, layer, state, positions, kv_valid, cache.lengths,
+            is_decode, attention,
         )
         return h, tuple(new_state[:-2])  # drop table/kv_lens (not scanned)
 
@@ -211,6 +273,35 @@ def forward_prefill_paged(
     )
     last = logits[jnp.arange(b), lengths - 1]
     return last, cache._replace(lengths=lengths)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def forward_prefill_paged_at(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b, s] right-padded SUFFIX tokens
+    lengths: jnp.ndarray,  # [b] true suffix lengths
+    cache: PagedKVCache,
+    start: jnp.ndarray,  # [b] tokens already present in each row's pages
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Suffix prefill: append ``tokens`` at position ``start`` per row and
+    attend over the full (existing pages + suffix) prefix. The warm half of
+    paged prefix sharing — rows whose tables already map shared template
+    pages prefill only their question suffix (serve/continuous.py)."""
+    b, s = tokens.shape
+    cache = cache._replace(lengths=start)
+    cache = allocate(cache, pages_needed(start, lengths, cache.page_size))
+    offsets = jnp.minimum(jnp.arange(s)[None, :], (lengths - 1)[:, None])
+    positions = start[:, None] + offsets
+    kv_lens = start + lengths
+    max_cols = cache.max_pages * cache.page_size
+    kv_valid = jnp.arange(max_cols)[None, :] < kv_lens[:, None]
+    logits, cache = _paged_forward(
+        cfg, params, tokens, positions, cache, kv_lens, is_decode=False,
+        attention=_paged_suffix_attention, kv_valid=kv_valid,
+    )
+    last = logits[jnp.arange(b), lengths - 1]
+    return last, cache._replace(lengths=kv_lens)
 
 
 @partial(jax.jit, static_argnums=(0,))

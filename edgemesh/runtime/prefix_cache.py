@@ -67,16 +67,24 @@ def build_prefix_cache(cfg: ModelConfig, params, prefix_tokens) -> PrefixCache:
     return PrefixCache(tokens=ids, k=cache.k, v=cache.v)
 
 
-def match_length(prefix: PrefixCache, tokens) -> int:
-    """Longest common TOKEN prefix between the cache and one prompt row,
-    capped so at least one suffix token remains to prefill (forward_verify
-    needs a chunk, and generate needs last-token logits)."""
+def common_token_prefix(prefix_ids, tokens) -> int:
+    """Longest common TOKEN prefix between ``prefix_ids`` and one prompt row,
+    capped so at least one suffix token remains to prefill (chunk appends
+    need a chunk; generate needs last-token logits). Shared by the dense
+    warm path below and the paged serving engine's template sharing
+    (serve/continuous.py)."""
+    ids = np.asarray(prefix_ids, np.int32).reshape(-1)
     row = np.asarray(tokens, np.int32).reshape(-1)
-    limit = min(prefix.length, row.shape[0] - 1)
+    limit = min(ids.shape[0], row.shape[0] - 1)
     if limit <= 0:
         return 0
-    neq = np.nonzero(row[:limit] != prefix.tokens[:limit])[0]
+    neq = np.nonzero(row[:limit] != ids[:limit])[0]
     return int(neq[0]) if neq.size else int(limit)
+
+
+def match_length(prefix: PrefixCache, tokens) -> int:
+    """Longest common TOKEN prefix between the cache and one prompt row."""
+    return common_token_prefix(prefix.tokens, tokens)
 
 
 def _bucket(n: int) -> int:

@@ -37,6 +37,12 @@ cache (runtime/paged_kv.py) — the vLLM-style serving memory model on TPU:
   the reservations of every in-flight request, so mid-decode pool overflow
   cannot happen; ``total_pages`` below the slots×max_seq worst case trades
   HBM for queueing instead of crashing.
+- The prompt template's prefix is SHARED across rows (vLLM/RadixAttention
+  style, natural on a paged design): its KV prefills into pool pages once,
+  each admitted row's table maps those pages read-only (the partial
+  boundary page copies on write), and only the question suffix prefills
+  (runtime/paged_generate.forward_prefill_paged_at). Matching is on token
+  ids; sub-page matches fall back to the cold path.
 
 Interface-compatible with DynamicBatcher (submit/answer/close/stats), so
 ``serve_rest`` takes either.
@@ -62,17 +68,30 @@ import numpy as np
 from edgemesh.models.transformer import KVCache, forward_decode, forward_prefill, init_kv_cache
 from edgemesh.ops.sampling import TokenMaskState
 from edgemesh.runtime.generate import _decode_loop
-from edgemesh.runtime.paged_generate import forward_decode_paged, forward_prefill_paged
+from edgemesh.runtime.paged_generate import (
+    forward_decode_paged,
+    forward_prefill_paged,
+    forward_prefill_paged_at,
+)
 from edgemesh.runtime.paged_kv import init_paged_cache, init_quant_paged_cache
 
 log = logging.getLogger("edgemesh.serve")
 
-# Donated variant of the paged prefill: admission runs it on a one-row view
-# of the SHARED page pool, so without donation every admission would copy the
-# whole pool to apply a few page writes.
+# Donated variants of the paged prefills: admission runs them on a one-row
+# view of the SHARED page pool, so without donation every admission would
+# copy the whole pool to apply a few page writes.
 _prefill_paged_donated = partial(
     jax.jit, static_argnums=(0,), donate_argnums=(4,)
 )(forward_prefill_paged.__wrapped__)
+_prefill_paged_at_donated = partial(
+    jax.jit, static_argnums=(0,), donate_argnums=(4,)
+)(forward_prefill_paged_at.__wrapped__)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _copy_page(pages, src, dst):
+    """In-place physical-page copy inside a [L, P, ...] pool array."""
+    return pages.at[:, dst].set(pages[:, src])
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
@@ -129,6 +148,8 @@ class ContinuousEngine:
             raise ValueError("slots and chunk must be >= 1")
         if kv_backend not in ("dense", "paged", "paged_int8"):
             raise ValueError(f"unknown kv_backend {kv_backend!r}")
+        if kv_backend != "dense" and int(page_size) < 1:
+            raise ValueError("page_size must be >= 1")
         self.kv_backend = kv_backend
         self._queue: deque[tuple[str, Future, float]] = deque()
         self._cond = threading.Condition()
@@ -155,6 +176,15 @@ class ContinuousEngine:
             self._cache = self._init_pool()
             self._decode_fn = forward_decode_paged
             self._reserved_pages = 0
+            self._auto_sized = total_pages is None
+            # Prefix sharing (lazy, _ensure_template): the prompt template's
+            # KV prefilled ONCE into pool pages that every admitted row's
+            # table maps read-only (vLLM-style prefix caching on the paged
+            # design — sharing is just table entries).
+            self._template_ids: np.ndarray | None = None
+            self._template_pages: list[int] = []
+            self._template_capacity_added = False
+            self.shared_prefix_hits = 0
         # fp32, NOT activation dtype: sampling must see the same logits the
         # solo decode path sees, or bf16 rounding flips near-tied greedy
         # tokens versus agent.answer.
@@ -204,6 +234,8 @@ class ContinuousEngine:
         if self.kv_backend != "dense":
             out["total_pages"] = self.total_pages
             out["reserved_pages"] = self._reserved_pages
+            out["template_pages"] = len(self._template_pages)
+            out["shared_prefix_hits"] = self.shared_prefix_hits
         return out
 
     # -- engine loop --------------------------------------------------------
@@ -236,34 +268,71 @@ class ContinuousEngine:
             self._cache = KVCache(k=k, v=v, lengths=ln)
             reserved = 0
         else:
-            # Worst-case pages this row can ever hold: the loop advances EVERY
-            # row to the segment boundary, so a row that EOSes or exhausts its
-            # budget mid-segment overshoots by < chunk tokens, + 1 bridge
-            # token (the overshoot tokens are garbage, trimmed host-side, but
-            # their page allocations are real until retirement reclaims them).
-            need = -(-(plen + budget + self.chunk) // self.page_size) + 1
+            self._ensure_template()
+            # Shared-prefix match: longest common token prefix with the
+            # template pages, leaving at least one suffix token to prefill
+            # (same matcher as the dense warm path, runtime/prefix_cache.py).
+            from edgemesh.runtime.prefix_cache import common_token_prefix
+
+            match = 0
+            if self._template_ids is not None and self._template_ids.size:
+                match = common_token_prefix(self._template_ids, tokens[0, :plen])
+            shared_full = match // self.page_size  # read-only shared pages
+            if shared_full == 0:
+                match = 0  # below one page: sharing buys nothing, go cold
+
+            # Worst-case PRIVATE pages this row can ever hold (shared pages
+            # are permanent pool residents, not per-request consumption): the
+            # loop advances EVERY row to the segment boundary, so a row that
+            # EOSes or exhausts its budget mid-segment overshoots by < chunk
+            # tokens, + 1 bridge token (the overshoot tokens are garbage,
+            # trimmed host-side, but their page allocations are real until
+            # retirement reclaims them).
+            need = -(-(plen + budget + self.chunk) // self.page_size) + 1 - shared_full
             idle_after = sum(1 for s in self._slots if not s.active) - 1
             headroom = idle_after * self._segment_pages
-            if need + (self.n_slots - 1) * self._segment_pages > self.total_pages - 1:
+            avail = self.total_pages - 1 - len(self._template_pages)
+            if need + (self.n_slots - 1) * self._segment_pages > avail:
                 raise ValueError(
                     f"request needs {need} pages (prompt {plen} + budget "
                     f"{budget} + segment overshoot); the pool holds "
-                    f"{self.total_pages - 1} minus idle-slot headroom"
+                    f"{avail} minus idle-slot headroom"
                 )
-            if self._reserved_pages + need + headroom > self.total_pages - 1:
+            if self._reserved_pages + need + headroom > avail:
                 return False  # capacity — re-queue, admit at a later boundary
             # Zero-copy KV admission: prefill through a one-row VIEW of the
             # shared pool (slot's table row + shared pages, donated). Only
             # the slot's own page-table/length entries change host-side; no
-            # KV row splice exists in the paged world.
-            row_view = self._cache._replace(
-                page_table=self._cache.page_table[idx : idx + 1],
-                lengths=jnp.zeros((1,), jnp.int32),
-            )
+            # KV row splice exists in the paged world. With a template match,
+            # the row warm-starts: its table maps the shared pages read-only
+            # (boundary page copy-on-write) and only the suffix prefills.
             try:
-                logits1, row = _prefill_paged_donated(
-                    self.cfg, agent.params, tokens, lengths, row_view
-                )
+                if match:
+                    row_table = np.zeros((self._cache.max_pages,), np.int32)
+                    row_table[:shared_full] = self._template_pages[:shared_full]
+                    if match % self.page_size:
+                        fresh = self._pop_page()
+                        self._cow_copy(self._template_pages[shared_full], fresh)
+                        row_table[shared_full] = fresh
+                    row_view = self._cache._replace(
+                        page_table=jnp.asarray(row_table)[None, :],
+                        lengths=jnp.zeros((1,), jnp.int32),
+                    )
+                    suffix = tokens[:, match:]
+                    logits1, row = _prefill_paged_at_donated(
+                        self.cfg, agent.params, suffix,
+                        jnp.asarray([plen - match], jnp.int32), row_view,
+                        jnp.asarray([match], jnp.int32),
+                    )
+                    self.shared_prefix_hits += 1
+                else:
+                    row_view = self._cache._replace(
+                        page_table=self._cache.page_table[idx : idx + 1],
+                        lengths=jnp.zeros((1,), jnp.int32),
+                    )
+                    logits1, row = _prefill_paged_donated(
+                        self.cfg, agent.params, tokens, lengths, row_view
+                    )
             except Exception:
                 # The donated pool buffers may already be invalidated — a
                 # fail-only-this-request recovery is impossible. Rebuild the
@@ -294,6 +363,96 @@ class ContinuousEngine:
             self.admitted_mid_flight += 1
         return True
 
+    def _ensure_template(self) -> None:
+        """Lazily prefill the prompt template's shared prefix into pool pages
+        (once per pool lifetime). Sharing is pure table bookkeeping: admitted
+        rows map these pages read-only; the boundary page copies on write."""
+        if self._template_ids is not None:
+            return
+        self._template_ids = np.zeros((0,), np.int32)  # default: no sharing
+        if not getattr(self.agent, "prefix_cache", True):
+            return
+        tpl = self.agent.prompt_template
+        if "{question}" not in tpl:
+            return
+        ids = np.asarray(
+            self.agent.tokenizer.encode(tpl.split("{question}")[0]), np.int32
+        )
+        if ids.size < self.page_size or ids.size > self.cfg.max_seq_len - 8:
+            return
+        n_pages = -(-int(ids.size) // self.page_size)
+        if self._auto_sized and not self._template_capacity_added:
+            # Grow the (still-empty) pool so the permanent template pages
+            # don't eat the per-slot reservation margin the default sizing
+            # guarantees. Runs before any admission; one-time.
+            self.total_pages += n_pages
+            self._template_capacity_added = True
+            self._cache = self._init_pool()
+        # A user-sized pool must still be able to SERVE after the template
+        # moves in permanently — including a max-context COLD request (no
+        # template match gets no page discount), the same hard bound the
+        # admission path enforces. Otherwise sharing is a net loss (or,
+        # worse, allocate() would overflow onto the trash page and every
+        # warm row would read garbage). Skip sharing, don't fail: it is an
+        # optimization.
+        per_row_worst = -(-(self.cfg.max_seq_len + self.chunk) // self.page_size) + 1
+        post_avail = self.total_pages - 1 - n_pages
+        if per_row_worst + (self.n_slots - 1) * self._segment_pages > post_avail:
+            log.warning(
+                "prefix sharing disabled: installing the %d-page template "
+                "would leave %d pages, below the max-request bound %d",
+                n_pages, post_avail,
+                per_row_worst + (self.n_slots - 1) * self._segment_pages,
+            )
+            return
+        row_view = self._cache._replace(
+            page_table=jnp.zeros((1, self._cache.max_pages), jnp.int32),
+            lengths=jnp.zeros((1,), jnp.int32),
+        )
+        try:
+            _, row = _prefill_paged_donated(
+                self.cfg, self.agent.params, jnp.asarray(ids)[None, :],
+                jnp.asarray([int(ids.size)], jnp.int32), row_view,
+            )
+        except Exception:
+            # Donated pool buffers may be invalidated — same recovery as a
+            # failed admission prefill (template retried after the reset).
+            self._reset_pool(
+                RuntimeError("page pool reset after a failed template prefill")
+            )
+            raise
+        from edgemesh.runtime.paged_kv import pool_overflowed
+
+        if pool_overflowed(row):  # pragma: no cover — pre-checked above
+            raise RuntimeError("template prefill overflowed the page pool")
+        self._cache = row._replace(
+            page_table=self._cache.page_table, lengths=self._cache.lengths
+        )
+        self._template_pages = [int(p) for p in np.asarray(row.page_table[0])[:n_pages]]
+        self._template_ids = ids
+
+    def _pop_page(self) -> int:
+        """Host-side single-page pop (copy-on-write boundary allocation)."""
+        top = int(self._cache.free_top)
+        if top >= self.total_pages:
+            raise RuntimeError("page pool exhausted during COW admission")
+        page = int(self._cache.free_stack[top])
+        self._cache = self._cache._replace(free_top=jnp.asarray(top + 1, jnp.int32))
+        return page
+
+    def _cow_copy(self, src: int, dst: int) -> None:
+        """Copy physical page src → dst across all layers (donated, in
+        place): the suffix will overwrite dst's tail slots, so the shared
+        original stays pristine for other rows."""
+        c = self._cache
+        upd = dict(
+            k=_copy_page(c.k, src, dst), v=_copy_page(c.v, src, dst)
+        )
+        if hasattr(c, "k_scale"):
+            upd["k_scale"] = _copy_page(c.k_scale, src, dst)
+            upd["v_scale"] = _copy_page(c.v_scale, src, dst)
+        self._cache = c._replace(**upd)
+
     @property
     def _segment_pages(self) -> int:
         """Worst-case pages ONE IDLE slot can allocate across a segment +
@@ -319,9 +478,12 @@ class ContinuousEngine:
         free = every physical page no table row references. Runs at every
         segment boundary — O(total_pages) numpy work."""
         table = np.asarray(self._cache.page_table)
-        used = np.unique(table[table > 0])
+        used = np.unique(np.concatenate([
+            table[table > 0].astype(np.int32),
+            np.asarray(self._template_pages, np.int32),  # permanent
+        ]))
         free = np.setdiff1d(
-            np.arange(1, self.total_pages, dtype=np.int32), used.astype(np.int32)
+            np.arange(1, self.total_pages, dtype=np.int32), used
         )
         stack = np.zeros((self.total_pages,), np.int32)
         top = self.total_pages - free.size
@@ -347,6 +509,10 @@ class ContinuousEngine:
         else:
             self._cache = self._init_pool()
             self._reserved_pages = 0
+            # Template pages died with the pool; rebuild lazily on the next
+            # admission (the capacity bump is one-time and survives).
+            self._template_ids = None
+            self._template_pages = []
         self._mask = TokenMaskState.init(self.n_slots, self.cfg.vocab_size).mask
 
     def _sweep_idle_pages(self) -> None:

@@ -146,7 +146,8 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
 
 def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = True,
                supervisor=None, batch: int = 0, batch_wait_s: float = 0.02,
-               continuous: bool = False, kv_backend: str = "dense"):
+               continuous: bool = False, kv_backend: str = "dense",
+               kv_page_size: int = 64):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
@@ -189,7 +190,8 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
                 "for multi-agent ensembles"
             )
         batcher = ContinuousEngine(
-            ensemble.qa_agents[0], slots=batch or 8, kv_backend=kv_backend
+            ensemble.qa_agents[0], slots=batch or 8, kv_backend=kv_backend,
+            page_size=kv_page_size,
         )
     elif batch > 1:
         from edgemesh.serve.batcher import DynamicBatcher

@@ -355,3 +355,39 @@ def test_generate_paged_gpt2_matches_dense():
     ref = generate(cfg, params, tokens, lengths, s)
     out = generate_paged(cfg, params, tokens, lengths, s, page_size=4)
     np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(ref.tokens))
+
+
+def test_suffix_prefill_matches_full_prefill():
+    """forward_prefill_paged_at: (template prefill) + (suffix append) must
+    match the one-shot full prefill — logits and subsequent greedy decode —
+    including a split that cuts MID-page. Both pools."""
+    from edgemesh.runtime.paged_generate import (
+        forward_prefill_paged,
+        forward_prefill_paged_at,
+    )
+    from edgemesh.runtime.paged_kv import init_quant_paged_cache
+
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    full = jnp.array([[5, 9, 11, 42, 7, 33, 21, 2, 17, 3]], jnp.int32)
+    n = full.shape[1]
+    for quant in (False, True):
+        init = init_quant_paged_cache if quant else init_paged_cache
+        for split in (4, 6, 8):  # page_size=4: on-boundary and mid-page cuts
+            ref_cache = init(cfg, batch=1, total_pages=8, page_size=4, max_pages=5)
+            want, _ = forward_prefill_paged(
+                cfg, params, full, jnp.asarray([n], jnp.int32), ref_cache
+            )
+            cache = init(cfg, batch=1, total_pages=8, page_size=4, max_pages=5)
+            _, cache = forward_prefill_paged(
+                cfg, params, full[:, :split], jnp.asarray([split], jnp.int32), cache
+            )
+            got, cache = forward_prefill_paged_at(
+                cfg, params, full[:, split:], jnp.asarray([n - split], jnp.int32),
+                cache, jnp.asarray([split], jnp.int32),
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=3e-5, rtol=3e-5,
+                err_msg=f"quant={quant} split={split}",
+            )
+            assert int(cache.lengths[0]) == n
