@@ -16,16 +16,56 @@ the reference's published 25.83 tok/s for the same model quantized on A100
 
 import json
 import sys
+from pathlib import Path
+
+def _stale_fallback(err: BaseException) -> int:
+    """The device tunnel is unreachable RIGHT NOW (it has wedged for hours at
+    a stretch this round, entirely outside this process's control). Rather
+    than hand the driver nothing, emit the most recent result measured on
+    this same chip — explicitly labeled: ``stale`` is true, the measurement
+    timestamp rides along, and the exit code is 4 (not 0) so a stale echo
+    can never masquerade as a fresh run."""
+    # Dated names sort chronologically; newest first. A corrupt file (these
+    # get written during the very outages this fallback exists for) skips to
+    # the next candidate.
+    for path in sorted(
+        (Path(__file__).parent / "artifacts").glob("bench_*.json"), reverse=True
+    ):
+        try:
+            with open(path) as f:
+                result = json.load(f)
+            if not isinstance(result, dict) or "metric" not in result:
+                continue
+        except (OSError, json.JSONDecodeError):
+            continue
+        # Date from the filename when the artifact predates the field.
+        result.setdefault("measured_at_utc", path.name.split("_")[1])
+        result["stale"] = True
+        result["stale_reason"] = (
+            f"device unreachable at bench time ({err}); value was "
+            f"measured on this session's chip earlier — see artifacts/{path.name}"
+        )
+        print(json.dumps(result))
+        return 4
+    print(json.dumps({"error": f"device unreachable and no prior artifact: {err}"}))
+    return 1
 
 
 def main() -> int:
     from edgemesh.benchmarks import headline_benchmark, start_stall_watchdog
-    from edgemesh.utils.platform import ensure_device_ready
+    from edgemesh.utils.platform import DeviceUnavailableError, ensure_device_ready
 
     # A wedged tunnel at first contact fails in minutes with a clear message
     # (no partial result exists yet to protect); mid-run stalls are the
     # watchdog's job, which re-prints the partial JSON before exiting rc=3.
-    ensure_device_ready()
+    try:
+        ensure_device_ready()
+    except (DeviceUnavailableError, Exception) as err:
+        # Timeout (wedged tunnel) raises DeviceUnavailableError; a FAST
+        # failure (tunnel process down → immediate backend-init error)
+        # surfaces as an ordinary exception — both are "device dead at
+        # start" and both fall back to the stale echo.
+        return _stale_fallback(err)
     start_stall_watchdog()
     result = headline_benchmark()
     print(json.dumps(result))

@@ -15,6 +15,14 @@ import functools
 import jax
 
 
+class DeviceUnavailableError(SystemExit):
+    """The device backend did not answer the bounded first-contact probe.
+
+    Subclasses SystemExit so CLI entries exit with the actionable message
+    unchanged, while programmatic callers (bench.py's stale-artifact
+    fallback) can catch the specific condition."""
+
+
 def device_sync(x) -> None:
     """Reliable completion barrier for timing.
 
@@ -110,7 +118,7 @@ def ensure_device_ready(timeout_s: float | None = None, _probe=None) -> None:
         # Read the platform list from config, NOT jax.default_backend():
         # the latter initializes the backend and would block right here.
         platforms = getattr(jax.config, "jax_platforms", None) or "(default)"
-        raise SystemExit(
+        raise DeviceUnavailableError(
             f"device backend did not answer within {timeout_s:.0f}s "
             f"(jax_platforms={platforms!r}) — the remote-TPU tunnel is likely "
             "wedged. Fixes: pin the CPU backend with `JAX_PLATFORMS=cpu "
