@@ -169,10 +169,17 @@ def _paged_suffix_attention(
     """Chunk-append attention over pages: write the suffix into its rows'
     pages, then attend over the GATHERED dense view (existing prefix pages +
     the fresh writes, read back exactly as decode will read them — int8
-    roundtrip included for the quant pool). Admission-path only (batch-1,
-    once per request): the gather is the dense-oracle path, the hot decode
-    loop keeps the page-walking kernel. This is what lets rows warm-start
-    from SHARED template pages (serve/continuous.py prefix sharing)."""
+    roundtrip included for the quant pool). This is what lets rows
+    warm-start from SHARED template pages (serve/continuous.py prefix
+    sharing) and what backs the speculative verify chunk
+    (forward_verify_paged).
+
+    The gather is the dense-oracle path: fine where appends are rare
+    (admission: batch-1, once per request) and an accepted BANDWIDTH
+    tradeoff where they are per-round (speculative verify gathers each
+    row's full KV every round — the single-token decode loop keeps the
+    page-walking kernel; a chunk-query page-walk kernel is the future
+    upgrade path if paged-spec becomes a hot configuration)."""
     from edgemesh.runtime.paged_kv import gather_dense, gather_dense_scales
 
     quant = len(cache) == 6
@@ -275,6 +282,32 @@ def forward_prefill_paged(
     return last, cache._replace(lengths=lengths)
 
 
+def _paged_append(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b, s] right-padded chunk
+    lengths: jnp.ndarray,  # [b] true chunk lengths
+    cache: PagedKVCache,
+    start: jnp.ndarray,  # [b] tokens already present in each row's pages
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Append a chunk at position ``start`` per row and attend over the full
+    (existing pages + chunk) prefix; returns ALL chunk logits [b, s, vocab]
+    and the cache advanced to start + lengths."""
+    b, s = tokens.shape
+    cache = cache._replace(lengths=start)
+    cache = allocate(cache, pages_needed(start, lengths, cache.page_size))
+    offsets = jnp.minimum(jnp.arange(s)[None, :], (lengths - 1)[:, None])
+    positions = start[:, None] + offsets
+    kv_lens = start + lengths
+    max_cols = cache.max_pages * cache.page_size
+    kv_valid = jnp.arange(max_cols)[None, :] < kv_lens[:, None]
+    logits, cache = _paged_forward(
+        cfg, params, tokens, positions, cache, kv_lens, is_decode=False,
+        attention=_paged_suffix_attention, kv_valid=kv_valid,
+    )
+    return logits, cache._replace(lengths=kv_lens)
+
+
 @partial(jax.jit, static_argnums=(0,))
 def forward_prefill_paged_at(
     cfg: ModelConfig,
@@ -289,19 +322,31 @@ def forward_prefill_paged_at(
     paged prefix sharing — rows whose tables already map shared template
     pages prefill only their question suffix (serve/continuous.py)."""
     b, s = tokens.shape
-    cache = cache._replace(lengths=start)
-    cache = allocate(cache, pages_needed(start, lengths, cache.page_size))
-    offsets = jnp.minimum(jnp.arange(s)[None, :], (lengths - 1)[:, None])
-    positions = start[:, None] + offsets
-    kv_lens = start + lengths
-    max_cols = cache.max_pages * cache.page_size
-    kv_valid = jnp.arange(max_cols)[None, :] < kv_lens[:, None]
-    logits, cache = _paged_forward(
-        cfg, params, tokens, positions, cache, kv_lens, is_decode=False,
-        attention=_paged_suffix_attention, kv_valid=kv_valid,
-    )
+    logits, cache = _paged_append(cfg, params, tokens, lengths, cache, start)
     last = logits[jnp.arange(b), lengths - 1]
-    return last, cache._replace(lengths=kv_lens)
+    return last, cache
+
+
+@partial(jax.jit, static_argnums=(0,))
+def forward_verify_paged(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b, s] chunk of already-chosen tokens per row
+    cache: PagedKVCache,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Chunk-append decode over the paged cache — the speculative verify
+    step (models/transformer.forward_verify's paged twin): s tokens per row
+    in ONE forward, logits for every position, cache advanced by s. Callers
+    rewind rejected suffixes by lowering ``lengths``; the rewind-idempotent
+    allocator reuses the slots' pages when decoding re-advances.
+
+    Attention rides the gather-based append hook — each verify round reads
+    the row's full KV through a dense gather rather than the page-walk
+    kernel (see _paged_suffix_attention's contract note): exact tokens,
+    bandwidth traded for composition."""
+    b, s = tokens.shape
+    full = jnp.full((b,), s, jnp.int32)
+    return _paged_append(cfg, params, tokens, full, cache, cache.lengths)
 
 
 @partial(jax.jit, static_argnums=(0,))

@@ -150,11 +150,15 @@ def pages_needed(lengths: jnp.ndarray, new_tokens: jnp.ndarray, page_size: int) 
 
 
 def allocate(cache: PagedKVCache, n_pages: jnp.ndarray) -> PagedKVCache:
-    """Pop ``n_pages[i]`` pages for row i and append them to its table.
+    """Pop pages so row i's next ``n_pages[i]`` logical slots are backed.
 
-    Statically bounded by ``max_pages`` logical slots per row; pure gathers
-    and scatters, so it runs inside a jitted/scanned decode step. Exhausting
-    the pool hands out the trash page (physical 0) for the overflowing rows —
+    Statically bounded by ``max_pages`` logical slots per row; pure
+    elementwise ops, so it runs inside a jitted/scanned decode step. A
+    target slot that ALREADY maps a physical page keeps it and pops nothing
+    — this makes allocation idempotent under REWIND (speculative decoding
+    lowers ``lengths`` past pages it already owns; re-advancing must reuse
+    them, not leak them and orphan stack entries). Exhausting the pool hands
+    out the trash page (physical 0) for the overflowing slots —
     jit-compatible, no branch — but the overflow is RECORDED: ``free_top``
     keeps advancing past the stack size, so ``pool_overflowed(cache)`` is
     True afterwards. Callers either bound capacity up front (generate()
@@ -163,26 +167,22 @@ def allocate(cache: PagedKVCache, n_pages: jnp.ndarray) -> PagedKVCache:
     """
     b, max_pages = cache.page_table.shape
     n_pages = n_pages.astype(jnp.int32)
-    # Row i draws stack entries free_top + offset[i] .. + n[i]-1.
-    offset = jnp.cumsum(n_pages) - n_pages  # exclusive prefix sum
     have = (cache.lengths + cache.page_size - 1) // cache.page_size  # filled slots
 
-    j = jnp.arange(max_pages)[None, :]  # candidate new logical slot index
-    take = j < n_pages[:, None]  # [b, max_pages]
-    src = cache.free_top + offset[:, None] + j  # stack position per slot
+    j = jnp.arange(max_pages)[None, :]  # logical slot index
+    target = (j >= have[:, None]) & (j < (have + n_pages)[:, None])
+    need = target & (cache.page_table == 0)  # skip slots that kept a page
+    # Pop order: row-major over needed slots.
+    flat = need.reshape(-1)
+    order = jnp.cumsum(flat.astype(jnp.int32)) - 1  # pop index per needed slot
+    src = (cache.free_top + order).reshape(b, max_pages)
     total = cache.free_stack.shape[0]
     pages = jnp.where(
-        (src < total) & take, cache.free_stack[jnp.minimum(src, total - 1)], 0
+        need & (src < total), cache.free_stack[jnp.minimum(src, total - 1)], 0
     )
-    slots = have[:, None] + j  # target logical slot
-    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, max_pages))
-    # Non-taken entries scatter out of bounds and are dropped (XLA OOB-scatter
-    # semantics made explicit) — they must not touch any real table slot.
-    table = cache.page_table.at[jnp.where(take, rows, b), slots].set(
-        pages, mode="drop"
-    )
+    table = jnp.where(need, pages, cache.page_table)
     return cache._replace(
-        page_table=table, free_top=cache.free_top + jnp.sum(n_pages)
+        page_table=table, free_top=cache.free_top + jnp.sum(need)
     )
 
 

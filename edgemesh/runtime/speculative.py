@@ -132,9 +132,16 @@ def _make_spec_body(
     eos_id: int,
     vocab: int,
     cap: int,
+    verify_fn=forward_verify,
+    decode_fn=forward_decode,
 ):
     """One draft→verify→accept→commit round as a while_loop body — shared by
-    the run-to-completion loop and the segmented streaming loop."""
+    the run-to-completion loop and the segmented streaming loop. The cache
+    backend is pluggable: (verify_fn, decode_fn) default to the dense pair;
+    the paged pair (runtime/paged_generate.forward_verify_paged /
+    forward_decode_paged) rides the same body — the rewind (a lengths
+    rollback) is safe on pages because the allocator reuses slots that kept
+    their pages."""
 
     def body(s: _SpecState):
         batch = s.pending.shape[0]
@@ -145,7 +152,7 @@ def _make_spec_body(
         # --- draft: gamma proposals + one cache-fill step -----------------
         def draft_step(j, carry):
             d_cache, cur, dmask, d_toks, q_sel, q_idx, q_probs = carry
-            logits, d_cache = forward_decode(cfg_d, params_d, cur, d_cache)
+            logits, d_cache = decode_fn(cfg_d, params_d, cur, d_cache)
             idx, probs = filtered_candidates(logits, sampling, dmask)
             if sampling.do_sample:
                 choice = jax.random.categorical(
@@ -176,11 +183,11 @@ def _make_spec_body(
         )
         # Extra draft forward so the draft cache also holds d_gamma's KV
         # (needed when every proposal is accepted; logits unused).
-        _, d_cache = forward_decode(cfg_d, params_d, last_d, d_cache)
+        _, d_cache = decode_fn(cfg_d, params_d, last_d, d_cache)
 
         # --- target: one verify chunk over [pending, d_1..d_gamma] --------
         chunk = jnp.concatenate([s.pending[:, None], d_toks], axis=1)  # [b, g+1]
-        t_logits, t_cache = forward_verify(cfg_t, params_t, chunk, s.t_cache)
+        t_logits, t_cache = verify_fn(cfg_t, params_t, chunk, s.t_cache)
 
         # Per-position penalty masks: position j's mask includes d_1..d_j.
         d_onehots = jnp.cumsum(
@@ -281,7 +288,7 @@ def _make_spec_body(
     return body
 
 
-@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 7, 8, 9))
+@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 7, 8, 9, 12, 13))
 def _spec_rounds(
     cfg_t: ModelConfig,
     cfg_d: ModelConfig,
@@ -295,6 +302,8 @@ def _spec_rounds(
     cap: int,
     state: _SpecState,
     budget: jax.Array,  # [] int32 — run at most this many MORE rounds
+    verify_fn=forward_verify,
+    decode_fn=forward_decode,
 ) -> _SpecState:
     """Advance the acceptance loop until every row is done or ``budget``
     additional rounds have run. ``budget = max_new`` runs to completion (a
@@ -302,7 +311,7 @@ def _spec_rounds(
     streaming segments."""
     body = _make_spec_body(
         cfg_t, cfg_d, params_t, params_d, sampling, gamma, max_new, eos_id,
-        vocab, cap,
+        vocab, cap, verify_fn, decode_fn,
     )
     until = state.rounds + budget
 
@@ -323,13 +332,17 @@ def generate_speculative(
     gamma: int = 4,
     eos_id: int = -1,
     rng: jax.Array | None = None,
+    kv_backend: str = "dense",
+    page_size: int = 64,
 ) -> tuple[GenerateResult, SpecStats]:
     """Speculative decode: emits the target's distribution exactly, several
     tokens per verify chunk when the draft agrees. Both models must share a
-    tokenizer/vocab (standard speculative constraint)."""
+    tokenizer/vocab (standard speculative constraint). ``kv_backend="paged"``
+    runs both caches as page pools (serving memory model; same tokens)."""
+    verify_fn, decode_fn = _spec_fns(kv_backend)
     state, t0, t1 = _spec_prefill(
         cfg_target, params_target, cfg_draft, params_draft, tokens, lengths,
-        sampling, gamma, eos_id, rng,
+        sampling, gamma, eos_id, rng, kv_backend, page_size,
     )
     from edgemesh.utils.platform import device_sync
     from edgemesh.utils.tracing import trace
@@ -343,7 +356,7 @@ def generate_speculative(
         final = _spec_rounds(
             cfg_target, cfg_draft, params_target, params_draft, sampling,
             int(gamma), max_new, int(eos_id), cfg_target.vocab_size, cap,
-            state, jnp.asarray(max_new, jnp.int32),
+            state, jnp.asarray(max_new, jnp.int32), verify_fn, decode_fn,
         )
         device_sync(final.out)
     t2 = time.perf_counter()
@@ -371,12 +384,29 @@ def generate_speculative(
     )
 
 
+def _spec_fns(kv_backend: str):
+    """(verify_fn, decode_fn) for a cache backend."""
+    if kv_backend == "dense":
+        return forward_verify, forward_decode
+    if kv_backend == "paged":
+        from edgemesh.runtime.paged_generate import (
+            forward_decode_paged,
+            forward_verify_paged,
+        )
+
+        return forward_verify_paged, forward_decode_paged
+    raise ValueError(f"unknown kv_backend {kv_backend!r} (dense | paged)")
+
+
 def _spec_prefill(
     cfg_target, params_target, cfg_draft, params_draft, tokens, lengths,
-    sampling, gamma, eos_id, rng,
+    sampling, gamma, eos_id, rng, kv_backend="dense", page_size=64,
 ) -> tuple[_SpecState, float, float]:
     """Validation + both prefills + initial loop state (shared by the
-    run-to-completion and streaming entries). Returns (state, t0, t1)."""
+    run-to-completion and streaming entries). Returns (state, t0, t1).
+    ``kv_backend="paged"`` holds BOTH models' caches as page pools
+    (runtime/paged_kv.py) — the serving memory model under speculative
+    decoding."""
     if cfg_target.vocab_size != cfg_draft.vocab_size:
         raise ValueError(
             f"draft vocab {cfg_draft.vocab_size} != target vocab "
@@ -404,10 +434,31 @@ def _spec_prefill(
 
     t0 = time.perf_counter()
     with trace("edgemesh/spec_prefill"):
-        t_cache = init_kv_cache(cfg_target, batch, needed)
-        d_cache = init_kv_cache(cfg_draft, batch, needed)
-        first_logits, t_cache = forward_prefill(cfg_target, params_target, tokens, lengths, t_cache)
-        _, d_cache = forward_prefill(cfg_draft, params_draft, tokens, lengths, d_cache)
+        if kv_backend == "paged":
+            from edgemesh.runtime.paged_generate import forward_prefill_paged
+            from edgemesh.runtime.paged_kv import init_paged_cache
+
+            per_row = -(-needed // page_size)
+
+            def make(cfg):
+                return init_paged_cache(
+                    cfg, batch, total_pages=1 + batch * per_row,
+                    page_size=page_size, max_pages=per_row,
+                )
+
+            t_cache = make(cfg_target)
+            d_cache = make(cfg_draft)
+            first_logits, t_cache = forward_prefill_paged(
+                cfg_target, params_target, tokens, lengths, t_cache
+            )
+            _, d_cache = forward_prefill_paged(
+                cfg_draft, params_draft, tokens, lengths, d_cache
+            )
+        else:
+            t_cache = init_kv_cache(cfg_target, batch, needed)
+            d_cache = init_kv_cache(cfg_draft, batch, needed)
+            first_logits, t_cache = forward_prefill(cfg_target, params_target, tokens, lengths, t_cache)
+            _, d_cache = forward_prefill(cfg_draft, params_draft, tokens, lengths, d_cache)
         device_sync(first_logits)
     t1 = time.perf_counter()
 
@@ -433,6 +484,8 @@ def generate_speculative_stream(
     eos_id: int = -1,
     rng: jax.Array | None = None,
     rounds_per_segment: int = 4,
+    kv_backend: str = "dense",
+    page_size: int = 64,
 ):
     """Streaming speculative decode: yields ``runtime.stream.StreamChunk``
     records as verify rounds commit tokens, then a final ``(GenerateResult,
@@ -457,9 +510,10 @@ def generate_speculative_stream(
 
     if rounds_per_segment < 1:
         raise ValueError(f"rounds_per_segment must be >= 1, got {rounds_per_segment}")
+    verify_fn, decode_fn = _spec_fns(kv_backend)
     state, t0, t1 = _spec_prefill(
         cfg_target, params_target, cfg_draft, params_draft, tokens, lengths,
-        sampling, gamma, eos_id, rng,
+        sampling, gamma, eos_id, rng, kv_backend, page_size,
     )
     batch, _ = tokens.shape
     max_new = int(sampling.max_new_tokens)
@@ -472,6 +526,7 @@ def generate_speculative_stream(
             cfg_target, cfg_draft, params_target, params_draft, sampling,
             int(gamma), max_new, int(eos_id), cfg_target.vocab_size, cap,
             state, jnp.asarray(int(rounds_per_segment), jnp.int32),
+            verify_fn, decode_fn,
         )
         device_sync(state.out)
         decode_s += time.perf_counter() - seg_t0

@@ -169,7 +169,9 @@ def test_pool_overflow_recorded():
     assert not pool_overflowed(cache)
     cache = allocate(cache, jnp.array([1, 1]))  # 2 of 2 free pages used
     assert not pool_overflowed(cache)
-    cache = allocate(cache, jnp.array([1, 0]))  # pool exhausted -> overflow
+    # Fill slot 0 and demand a NEW slot with the stack empty -> overflow.
+    cache = cache._replace(lengths=jnp.array([4, 4], jnp.int32))
+    cache = allocate(cache, jnp.array([1, 0]))
     assert pool_overflowed(cache)
 
 
@@ -391,3 +393,27 @@ def test_suffix_prefill_matches_full_prefill():
                 err_msg=f"quant={quant} split={split}",
             )
             assert int(cache.lengths[0]) == n
+
+
+def test_allocate_rewind_idempotent():
+    """Re-allocating slots that kept their pages after a REWIND (speculative
+    decoding lowers lengths) reuses them — no fresh pops, no orphaned stack
+    entries, table unchanged."""
+    cfg = _cfg()
+    cache = init_paged_cache(cfg, batch=2, total_pages=16, page_size=4, max_pages=4)
+    cache = cache._replace(lengths=jnp.array([0, 0], jnp.int32))
+    cache = allocate(cache, jnp.array([3, 2], jnp.int32))
+    table0 = np.asarray(cache.page_table).copy()
+    top0 = int(cache.free_top)
+    # Rewind row 0 to 5 tokens (2 pages' worth) then re-advance over the
+    # SAME slots: ceil(5/4)=2 filled, next alloc targets slot 2 — which
+    # still maps a page.
+    cache = cache._replace(lengths=jnp.array([5, 8], jnp.int32))
+    cache = allocate(cache, jnp.array([1, 0], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(cache.page_table), table0)
+    assert int(cache.free_top) == top0  # nothing popped
+    # A genuinely new slot still pops.
+    cache = cache._replace(lengths=jnp.array([12, 8], jnp.int32))
+    cache = allocate(cache, jnp.array([1, 0], jnp.int32))
+    assert int(cache.free_top) == top0 + 1
+    assert np.asarray(cache.page_table)[0, 3] > 0

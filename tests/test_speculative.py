@@ -287,3 +287,45 @@ def test_streaming_speculative_rejects_bad_segment_budget():
     with pytest.raises(ValueError, match="rounds_per_segment"):
         next(generate_speculative_stream(cfg, pt, cfg, pd, tokens, lengths, s,
                                          rounds_per_segment=0))
+
+
+def test_speculative_paged_matches_dense():
+    """Speculative decoding over the paged pools == the dense-cache spec
+    path, greedy, token for token — the rewind (lengths rollback) is safe on
+    pages because the allocator reuses slots that kept their pages."""
+    cfg, params_t, params_d = _models()
+    cfg_t = cfg_d = cfg
+    tokens = jnp.array([[5, 9, 11, 42, 7], [17, 3, 50, 8, 0]], jnp.int32)
+    lengths = jnp.array([5, 4], jnp.int32)
+    s = SamplingParams(max_new_tokens=16, do_sample=False, repetition_penalty=1.0)
+    dense, st_dense = generate_speculative(
+        cfg_t, params_t, cfg_d, params_d, tokens, lengths, s,
+        gamma=3, rng=jax.random.PRNGKey(3),
+    )
+    paged, st_paged = generate_speculative(
+        cfg_t, params_t, cfg_d, params_d, tokens, lengths, s,
+        gamma=3, rng=jax.random.PRNGKey(3), kv_backend="paged", page_size=4,
+    )
+    np.testing.assert_array_equal(np.asarray(dense.tokens), np.asarray(paged.tokens))
+    assert st_paged.accepted == st_dense.accepted
+    assert st_paged.rounds == st_dense.rounds
+
+
+def test_speculative_paged_sampled_matches_dense():
+    """Sampled mode: identical rng → identical tokens across cache backends
+    (the acceptance/residual math never touches the cache layout)."""
+    cfg, params_t, params_d = _models()
+    cfg_t = cfg_d = cfg
+    tokens = jnp.array([[5, 9, 11, 42, 7]], jnp.int32)
+    lengths = jnp.array([5], jnp.int32)
+    s = SamplingParams(max_new_tokens=12, do_sample=True, temperature=0.9,
+                      top_k=20, top_p=0.95, repetition_penalty=1.1)
+    dense, _ = generate_speculative(
+        cfg_t, params_t, cfg_d, params_d, tokens, lengths, s,
+        gamma=3, rng=jax.random.PRNGKey(11),
+    )
+    paged, _ = generate_speculative(
+        cfg_t, params_t, cfg_d, params_d, tokens, lengths, s,
+        gamma=3, rng=jax.random.PRNGKey(11), kv_backend="paged", page_size=4,
+    )
+    np.testing.assert_array_equal(np.asarray(dense.tokens), np.asarray(paged.tokens))
