@@ -38,6 +38,47 @@ except Exception:  # pragma: no cover
     HAVE_PALLAS = False
 
 
+def _flash_page_update(
+    q, k, v, mask, scale, soft_cap, m_scr, l_scr, acc_scr, rows, nrows,
+    ks_row=None, vs_row=None,
+):
+    """One page's online-softmax update for ``nrows`` query rows against a
+    [ps, hd] K/V slice — THE shared body of the decode and chunk kernels
+    (their grids and masks differ; this must not). ``ks_row``/``vs_row``
+    ([1, ps] f32) mark int8 pages: scales fold in after each matmul."""
+    quant = ks_row is not None
+    if quant:
+        q = q.astype(jnp.float32)
+        k = k.astype(jnp.float32)
+        v = v.astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if quant:
+        s = s * ks_row
+    if soft_cap > 0:  # Gemma-2 score squashing, pre-mask (attend parity)
+        s = soft_cap * jnp.tanh(s / soft_cap)
+    s = jnp.where(mask, s, NEG_INF)
+    m_prev = m_scr[rows, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    pr = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    m_scr[rows, :] = jnp.broadcast_to(m_new, (nrows, 128))
+    l_new = alpha * l_scr[rows, :1] + jnp.sum(pr, axis=1, keepdims=True)
+    l_scr[rows, :] = jnp.broadcast_to(l_new, (nrows, 128))
+    if quant:
+        pv = jax.lax.dot_general(
+            pr * vs_row, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        pv = jax.lax.dot_general(
+            pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    acc_scr[rows, :] = alpha * acc_scr[rows, :] + pv
+
+
 def _paged_kernel(
     table_ref,  # SMEM [b, max_pages] int32 (scalar prefetch)
     len_ref,  # SMEM [b] int32 (scalar prefetch)
@@ -93,46 +134,16 @@ def _paged_kernel(
         # Static loop over kv heads: each head's groups query rows flash-update
         # against that head's [ps, hd] slice of the page block. 2D ops only —
         # the same shapes the head-major kernel lowered — sliced out of the
-        # shared scratch at static offsets.
+        # shared scratch at static offsets. For int8 pages the per-row scales
+        # fold in after each matmul (HBM only ever holds the int8 copy; the
+        # int8→f32 converts fuse into the MXU operand read).
         for h in range(kv_heads):
-            rows = slice(h * gp, (h + 1) * gp)
-            q = q_ref[0, h]  # [gp, hd]
-            k = k_ref[0, h]  # [ps, hd]
-            v = v_ref[0, h]
-            if quantized:
-                # Per-row scales fold in AFTER the int8 matmuls (s_ij carries
-                # k's row-j scale; v's scale rides the probability operand) —
-                # HBM only ever holds the int8 pages. int8→f32 converts fuse
-                # into the MXU operand read.
-                q = q.astype(jnp.float32)
-                k = k.astype(jnp.float32)
-                v = v.astype(jnp.float32)
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            ) * scale  # [gp, ps]
-            if quantized:
-                s = s * ks_ref[0, h]  # [1, ps] k row scales
-            if soft_cap > 0:  # Gemma-2 score squashing, pre-mask (attend parity)
-                s = soft_cap * jnp.tanh(s / soft_cap)
-            s = jnp.where(mask, s, NEG_INF)
-            m_prev = m_scr[rows, :1]
-            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-            pr = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-            alpha = jnp.exp(m_prev - m_new)
-            m_scr[rows, :] = jnp.broadcast_to(m_new, (gp, 128))
-            l_new = alpha * l_scr[rows, :1] + jnp.sum(pr, axis=1, keepdims=True)
-            l_scr[rows, :] = jnp.broadcast_to(l_new, (gp, 128))
-            if quantized:
-                pv = jax.lax.dot_general(
-                    pr * vs_ref[0, h], v, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            else:
-                pv = jax.lax.dot_general(
-                    pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            acc_scr[rows, :] = alpha * acc_scr[rows, :] + pv
+            _flash_page_update(
+                q_ref[0, h], k_ref[0, h], v_ref[0, h], mask, scale, soft_cap,
+                m_scr, l_scr, acc_scr, slice(h * gp, (h + 1) * gp), gp,
+                ks_row=ks_ref[0, h] if quantized else None,
+                vs_row=vs_ref[0, h] if quantized else None,
+            )
 
     @pl.when(p == npg - 1)
     def _finish():
@@ -257,6 +268,140 @@ def paged_decode_attention(
         interpret=interpret,
     )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), *operands)
     return out[:, :, :groups, :hd].reshape(b, nh, hd)
+
+
+def _paged_chunk_kernel(
+    table_ref,  # SMEM [b, max_pages] int32 (scalar prefetch)
+    start_ref,  # SMEM [b] int32 — tokens in pages BEFORE this chunk
+    len_ref,  # SMEM [b] int32 — final tokens incl. the chunk
+    q_ref,  # VMEM [1, kh, rq, hd] — rq = cq*groups query rows (padded)
+    k_ref,  # VMEM [1, kh, ps, hd] — physical page table[b, p]
+    v_ref,
+    o_ref,  # VMEM [1, kh, rq, hd]
+    m_scr,  # VMEM [kh*rq, 128] f32
+    l_scr,
+    acc_scr,  # VMEM [kh*rq, hd] f32
+    *,
+    page_size: int,
+    scale: float,
+    soft_cap: float,
+    kv_heads: int,
+    rq: int,
+    groups: int,
+):
+    bb = pl.program_id(0)
+    p = pl.program_id(1)
+    npg = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    start = start_ref[bb]
+    kvlen = len_ref[bb]
+    live = p * page_size < kvlen
+
+    @pl.when(live)
+    def _update():
+        col = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rq, page_size), 1
+        )
+        # Query row r is chunk token r // groups: causal over the prefix +
+        # its own position (the chunk's K/V are already in the pages).
+        c = jax.lax.broadcasted_iota(jnp.int32, (rq, page_size), 0) // groups
+        mask = col < jnp.minimum(start + c + 1, kvlen)
+        for h in range(kv_heads):
+            _flash_page_update(
+                q_ref[0, h], k_ref[0, h], v_ref[0, h], mask, scale, soft_cap,
+                m_scr, l_scr, acc_scr, slice(h * rq, (h + 1) * rq), rq,
+            )
+
+    @pl.when(p == npg - 1)
+    def _finish():
+        for h in range(kv_heads):
+            rows = slice(h * rq, (h + 1) * rq)
+            out = acc_scr[rows, :] / jnp.maximum(l_scr[rows, :1], 1e-30)
+            o_ref[0, h] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret", "soft_cap"))
+def paged_chunk_attention(
+    q: jnp.ndarray,  # [b, cq, num_heads, head_dim] — chunk queries per row
+    k_pages: jnp.ndarray,  # [total_pages, kv_heads, page_size, head_dim]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [b, max_pages] int32
+    start: jnp.ndarray,  # [b] tokens in pages before the chunk
+    kv_lens: jnp.ndarray,  # [b] final tokens incl. the chunk
+    scale: float | None = None,
+    interpret: bool = False,
+    soft_cap: float = 0.0,
+) -> jnp.ndarray:
+    """Chunk-query page walk: ``cq`` query tokens per row attend over the
+    row's paged prefix + the chunk's own (already-written) K/V, causally.
+    The kernel-grade path for chunk appends (speculative verify, suffix
+    prefill) that the gather-based oracle otherwise serves — same
+    ``(b, pages)`` grid as decode, query rows = chunk×groups per kv head.
+    Full-causal only (no sliding window; callers fall back to the gather
+    path for windowed configs). Padded chunk rows compute garbage that
+    callers discard — their columns stay masked within kv_lens, so no NaNs
+    propagate. OPT-IN until measured on hardware
+    (EDGEMESH_PAGED_CHUNK_KERNEL=1, runtime/paged_generate.py)."""
+    if not HAVE_PALLAS:  # pragma: no cover
+        raise RuntimeError("pallas unavailable")
+    b, cq, nh, hd = q.shape
+    _, kh, ps, _ = k_pages.shape
+    groups = nh // kh
+    max_pages = page_table.shape[1]
+    scale = scale if scale is not None else hd**-0.5
+
+    rq = _round_up(cq * groups, 8)
+    hp = hd if hd % 64 == 0 else _round_up(hd, 128)
+    # [b, cq, kh, groups, hd] → [b, kh, cq*groups, hd]: row r = token r//groups.
+    qg = q.reshape(b, cq, kh, groups, hd).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, kh, cq * groups, hd)
+    qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rq - cq * groups), (0, hp - hd)))
+    if hp != hd:
+        k_pages = jnp.pad(k_pages, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
+        v_pages = jnp.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
+
+    def kv_map(bb, p, table, start, lens):
+        return (table[bb, p], 0, 0, 0)
+
+    kernel = functools.partial(
+        _paged_chunk_kernel, page_size=ps, scale=scale, soft_cap=soft_cap,
+        kv_heads=kh, rq=rq, groups=groups,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, max_pages),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, kh, rq, hp), lambda bb, p, table, start, lens: (bb, 0, 0, 0)
+                ),
+                pl.BlockSpec((1, kh, ps, hp), kv_map),
+                pl.BlockSpec((1, kh, ps, hp), kv_map),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, kh, rq, hp), lambda bb, p, table, start, lens: (bb, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((kh * rq, 128), jnp.float32),
+                pltpu.VMEM((kh * rq, 128), jnp.float32),
+                pltpu.VMEM((kh * rq, hp), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kh, rq, hp), q.dtype),
+        interpret=interpret,
+    )(
+        page_table.astype(jnp.int32), start.astype(jnp.int32),
+        kv_lens.astype(jnp.int32), qg, k_pages, v_pages,
+    )
+    out = out[:, :, : cq * groups, :hd].reshape(b, kh, cq, groups, hd)
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, cq, nh, hd)
 
 
 def paged_decode_attention_xla(

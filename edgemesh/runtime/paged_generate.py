@@ -156,6 +156,32 @@ def _paged_attention(
     return proj, (k_pages, v_pages, table, kv_lens)
 
 
+# Captured ONCE at import: the flag participates in jitted forwards as a
+# trace-time constant, so a mid-process env change would otherwise create a
+# silent shape-dependent mix of cached gather-path and kernel-path
+# executables. Set it before the process starts; tests monkeypatch this
+# module attribute and clear jit caches.
+_CHUNK_KERNEL_OPTIN = __import__("os").environ.get("EDGEMESH_PAGED_CHUNK_KERNEL") == "1"
+
+
+def _use_chunk_kernel(cfg: ModelConfig, quant: bool) -> bool:
+    """Route chunk appends through the page-walking chunk kernel
+    (ops/paged_attention.paged_chunk_attention) instead of the dense-gather
+    oracle. OPT-IN via EDGEMESH_PAGED_CHUNK_KERNEL=1 (at process start)
+    until it has been measured on hardware (the repo's measure-don't-assume
+    rule — the gather's cost is known, the kernel's isn't yet); full-causal
+    bf16/fp32 pools only (no window, no quant scales in the chunk kernel
+    v1), and only where the repo runs Pallas at all (_use_flash: respects
+    attention_impl="xla" and the GSPMD multi-chip opt-out)."""
+    return (
+        _CHUNK_KERNEL_OPTIN
+        and not quant
+        and cfg.sliding_window == 0
+        and not cfg.alt_sliding_window
+        and _use_flash(cfg)
+    )
+
+
 def _paged_suffix_attention(
     cfg: ModelConfig,
     layer,
@@ -174,12 +200,13 @@ def _paged_suffix_attention(
     sharing) and what backs the speculative verify chunk
     (forward_verify_paged).
 
-    The gather is the dense-oracle path: fine where appends are rare
+    The gather is the dense-oracle DEFAULT: fine where appends are rare
     (admission: batch-1, once per request) and an accepted BANDWIDTH
     tradeoff where they are per-round (speculative verify gathers each
     row's full KV every round — the single-token decode loop keeps the
-    page-walking kernel; a chunk-query page-walk kernel is the future
-    upgrade path if paged-spec becomes a hot configuration)."""
+    page-walking kernel). A chunk-query page-walk kernel exists behind
+    EDGEMESH_PAGED_CHUNK_KERNEL=1 (_use_chunk_kernel; parity-pinned,
+    unmeasured on hardware yet)."""
     from edgemesh.runtime.paged_kv import gather_dense, gather_dense_scales
 
     quant = len(cache) == 6
@@ -200,23 +227,35 @@ def _paged_suffix_attention(
             k_pages, v_pages, k_sc, v_sc, kq, ks, vq, vs, table,
             start=lengths, valid_len=suffix_len,
         )
-        dense_k = gather_dense(k_pages, table).astype(jnp.float32)
-        dense_v = gather_dense(v_pages, table).astype(jnp.float32)
-        dks = gather_dense_scales(k_sc, table)
-        dvs = gather_dense_scales(v_sc, table)
-        dense_k = (dense_k * dks[..., None]).astype(x.dtype)
-        dense_v = (dense_v * dvs[..., None]).astype(x.dtype)
     else:
         k_pages, v_pages = write_tokens(
             k_pages, v_pages, k, v, table, start=lengths, valid_len=suffix_len,
         )
-        dense_k = gather_dense(k_pages, table)
-        dense_v = gather_dense(v_pages, table)
-    out = attend(
-        q, LayerKV(dense_k, dense_v), positions, kv_valid,
-        scale=cfg.query_scale, sliding_window=cfg.sliding_window,
-        soft_cap=cfg.attn_soft_cap,
-    )
+    if _use_chunk_kernel(cfg, quant):
+        from edgemesh.ops.paged_attention import paged_chunk_attention
+
+        out = paged_chunk_attention(
+            q, k_pages, v_pages, table, lengths, kv_lens,
+            scale=cfg.query_scale,
+            interpret=cfg.attention_impl == "flash" and not on_tpu(),
+            soft_cap=cfg.attn_soft_cap,
+        )
+    else:
+        if quant:
+            dense_k = gather_dense(k_pages, table).astype(jnp.float32)
+            dense_v = gather_dense(v_pages, table).astype(jnp.float32)
+            dks = gather_dense_scales(k_sc, table)
+            dvs = gather_dense_scales(v_sc, table)
+            dense_k = (dense_k * dks[..., None]).astype(x.dtype)
+            dense_v = (dense_v * dvs[..., None]).astype(x.dtype)
+        else:
+            dense_k = gather_dense(k_pages, table)
+            dense_v = gather_dense(v_pages, table)
+        out = attend(
+            q, LayerKV(dense_k, dense_v), positions, kv_valid,
+            scale=cfg.query_scale, sliding_window=cfg.sliding_window,
+            soft_cap=cfg.attn_soft_cap,
+        )
     proj = dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode)
     if quant:
         return proj, (k_pages, v_pages, k_sc, v_sc, table, kv_lens)
@@ -340,10 +379,10 @@ def forward_verify_paged(
     rewind rejected suffixes by lowering ``lengths``; the rewind-idempotent
     allocator reuses the slots' pages when decoding re-advances.
 
-    Attention rides the gather-based append hook — each verify round reads
-    the row's full KV through a dense gather rather than the page-walk
-    kernel (see _paged_suffix_attention's contract note): exact tokens,
-    bandwidth traded for composition."""
+    Attention rides the chunk-append hook — by default each verify round
+    reads the row's full KV through a dense gather rather than a page walk
+    (see _paged_suffix_attention's contract note; the opt-in chunk kernel
+    changes that): exact tokens, bandwidth traded for composition."""
     b, s = tokens.shape
     full = jnp.full((b,), s, jnp.int32)
     return _paged_append(cfg, params, tokens, full, cache, cache.lengths)

@@ -417,3 +417,57 @@ def test_allocate_rewind_idempotent():
     cache = allocate(cache, jnp.array([1, 0], jnp.int32))
     assert int(cache.free_top) == top0 + 1
     assert np.asarray(cache.page_table)[0, 3] > 0
+
+
+def test_paged_chunk_kernel_matches_gather_oracle():
+    """Chunk-query page walk (interpret) == the gather-based append path:
+    suffix prefill and verify-style full-width chunks, page-crossing starts,
+    ragged suffix lengths, and an active score soft cap. The kernel flag is
+    a module attribute captured at import (trace-time constant), so the
+    test patches it AND clears jit caches — otherwise the second run would
+    reuse the first run's cached executables and compare the gather path
+    against itself."""
+    import edgemesh.runtime.paged_generate as pg
+    from edgemesh.runtime.paged_generate import (
+        forward_prefill_paged,
+        forward_prefill_paged_at,
+        forward_verify_paged,
+    )
+
+    for cap in (0.0, 4.0):
+        cfg = _cfg(num_heads=4, num_kv_heads=2, head_dim=64,
+                   hidden_size=64, intermediate_size=96).replace(
+            attention_impl="flash", attn_soft_cap=cap)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        full = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64, jnp.int32)
+        lens = jnp.asarray([12, 9], jnp.int32)
+
+        def run(use_kernel):
+            jax.clear_caches()
+            saved = pg._CHUNK_KERNEL_OPTIN
+            pg._CHUNK_KERNEL_OPTIN = use_kernel
+            try:
+                assert pg._use_chunk_kernel(cfg, quant=False) == use_kernel
+                cache = init_paged_cache(cfg, batch=2, total_pages=16,
+                                         page_size=4, max_pages=8)
+                _, cache = forward_prefill_paged(
+                    cfg, params, full[:, :6], jnp.asarray([6, 6], jnp.int32), cache
+                )
+                last, cache = forward_prefill_paged_at(
+                    cfg, params, full[:, 6:], lens - 6, cache,
+                    jnp.asarray([6, 6], jnp.int32),
+                )
+                vlog, cache = forward_verify_paged(
+                    cfg, params, full[:, :3] + 1, cache
+                )
+                return np.asarray(last), np.asarray(vlog)
+            finally:
+                pg._CHUNK_KERNEL_OPTIN = saved
+                jax.clear_caches()
+
+        last_g, ver_g = run(use_kernel=False)
+        last_k, ver_k = run(use_kernel=True)
+        np.testing.assert_allclose(last_k, last_g, atol=3e-5, rtol=3e-5,
+                                   err_msg=f"cap={cap}")
+        np.testing.assert_allclose(ver_k, ver_g, atol=3e-5, rtol=3e-5,
+                                   err_msg=f"cap={cap}")
