@@ -274,21 +274,24 @@ def _paged_chunk_kernel(
     table_ref,  # SMEM [b, max_pages] int32 (scalar prefetch)
     start_ref,  # SMEM [b] int32 — tokens in pages BEFORE this chunk
     len_ref,  # SMEM [b] int32 — final tokens incl. the chunk
-    q_ref,  # VMEM [1, kh, rq, hd] — rq = cq*groups query rows (padded)
-    k_ref,  # VMEM [1, kh, ps, hd] — physical page table[b, p]
-    v_ref,
-    o_ref,  # VMEM [1, kh, rq, hd]
-    m_scr,  # VMEM [kh*rq, 128] f32
-    l_scr,
-    acc_scr,  # VMEM [kh*rq, hd] f32
-    *,
+    *refs,  # q, k, v, [k_scale, v_scale,] o, m_scr, l_scr, acc_scr
     page_size: int,
     scale: float,
     soft_cap: float,
     kv_heads: int,
     rq: int,
     groups: int,
+    quantized: bool,
 ):
+    # q_ref   VMEM [1, kh, rq, hd] — rq = cq*groups query rows (padded)
+    # k_ref   VMEM [1, kh, ps, hd] — physical page table[b, p]
+    #         (int8 when quantized, with ks/vs VMEM [1, kh, 1, ps] f32)
+    # o_ref   VMEM [1, kh, rq, hd]
+    # scratch VMEM [kh*rq, 128] f32 ×2 (m, l) + [kh*rq, hd] f32 (acc)
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
     bb = pl.program_id(0)
     p = pl.program_id(1)
     npg = pl.num_programs(1)
@@ -316,6 +319,8 @@ def _paged_chunk_kernel(
             _flash_page_update(
                 q_ref[0, h], k_ref[0, h], v_ref[0, h], mask, scale, soft_cap,
                 m_scr, l_scr, acc_scr, slice(h * rq, (h + 1) * rq), rq,
+                ks_row=ks_ref[0, h] if quantized else None,
+                vs_row=vs_ref[0, h] if quantized else None,
             )
 
     @pl.when(p == npg - 1)
@@ -337,6 +342,8 @@ def paged_chunk_attention(
     scale: float | None = None,
     interpret: bool = False,
     soft_cap: float = 0.0,
+    k_scales: jnp.ndarray | None = None,  # [P, kh, 1, ps] f32 (int8 pool)
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Chunk-query page walk: ``cq`` query tokens per row attend over the
     row's paged prefix + the chunk's own (already-written) K/V, causally.
@@ -344,12 +351,14 @@ def paged_chunk_attention(
     prefill) that the gather-based oracle otherwise serves — same
     ``(b, pages)`` grid as decode, query rows = chunk×groups per kv head.
     Full-causal only (no sliding window; callers fall back to the gather
-    path for windowed configs). Padded chunk rows compute garbage that
-    callers discard — their columns stay masked within kv_lens, so no NaNs
-    propagate. OPT-IN until measured on hardware
-    (EDGEMESH_PAGED_CHUNK_KERNEL=1, runtime/paged_generate.py)."""
+    path for windowed configs); ``k_scales``/``v_scales`` mark an int8
+    pool, dequantized in-kernel exactly like decode. Padded chunk rows
+    compute garbage that callers discard — their columns stay masked
+    within kv_lens, so no NaNs propagate. OPT-IN until measured on
+    hardware (EDGEMESH_PAGED_CHUNK_KERNEL=1, runtime/paged_generate.py)."""
     if not HAVE_PALLAS:  # pragma: no cover
         raise RuntimeError("pallas unavailable")
+    quantized = k_scales is not None
     b, cq, nh, hd = q.shape
     _, kh, ps, _ = k_pages.shape
     groups = nh // kh
@@ -371,20 +380,26 @@ def paged_chunk_attention(
 
     kernel = functools.partial(
         _paged_chunk_kernel, page_size=ps, scale=scale, soft_cap=soft_cap,
-        kv_heads=kh, rq=rq, groups=groups,
+        kv_heads=kh, rq=rq, groups=groups, quantized=quantized,
     )
+    in_specs = [
+        pl.BlockSpec((1, kh, rq, hp), lambda bb, p, table, start, lens: (bb, 0, 0, 0)),
+        pl.BlockSpec((1, kh, ps, hp), kv_map),
+        pl.BlockSpec((1, kh, ps, hp), kv_map),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, kh, 1, ps), kv_map),
+            pl.BlockSpec((1, kh, 1, ps), kv_map),
+        ]
+        operands += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(b, max_pages),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, kh, rq, hp), lambda bb, p, table, start, lens: (bb, 0, 0, 0)
-                ),
-                pl.BlockSpec((1, kh, ps, hp), kv_map),
-                pl.BlockSpec((1, kh, ps, hp), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, kh, rq, hp), lambda bb, p, table, start, lens: (bb, 0, 0, 0)
             ),
@@ -398,7 +413,7 @@ def paged_chunk_attention(
         interpret=interpret,
     )(
         page_table.astype(jnp.int32), start.astype(jnp.int32),
-        kv_lens.astype(jnp.int32), qg, k_pages, v_pages,
+        kv_lens.astype(jnp.int32), *operands,
     )
     out = out[:, :, : cq * groups, :hd].reshape(b, kh, cq, groups, hd)
     return out.transpose(0, 2, 1, 3, 4).reshape(b, cq, nh, hd)

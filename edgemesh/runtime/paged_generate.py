@@ -170,12 +170,12 @@ def _use_chunk_kernel(cfg: ModelConfig, quant: bool) -> bool:
     oracle. OPT-IN via EDGEMESH_PAGED_CHUNK_KERNEL=1 (at process start)
     until it has been measured on hardware (the repo's measure-don't-assume
     rule — the gather's cost is known, the kernel's isn't yet); full-causal
-    bf16/fp32 pools only (no window, no quant scales in the chunk kernel
-    v1), and only where the repo runs Pallas at all (_use_flash: respects
+    configs only (no window in the chunk kernel; both bf16 and int8 pools),
+    and only where the repo runs Pallas at all (_use_flash: respects
     attention_impl="xla" and the GSPMD multi-chip opt-out)."""
+    del quant  # int8 pools take the kernel too (scales fold in like decode)
     return (
         _CHUNK_KERNEL_OPTIN
-        and not quant
         and cfg.sliding_window == 0
         and not cfg.alt_sliding_window
         and _use_flash(cfg)
@@ -234,11 +234,13 @@ def _paged_suffix_attention(
     if _use_chunk_kernel(cfg, quant):
         from edgemesh.ops.paged_attention import paged_chunk_attention
 
+        scales = dict(k_scales=k_sc, v_scales=v_sc) if quant else {}
         out = paged_chunk_attention(
             q, k_pages, v_pages, table, lengths, kv_lens,
             scale=cfg.query_scale,
             interpret=cfg.attention_impl == "flash" and not on_tpu(),
             soft_cap=cfg.attn_soft_cap,
+            **scales,
         )
     else:
         if quant:

@@ -434,7 +434,9 @@ def test_paged_chunk_kernel_matches_gather_oracle():
         forward_verify_paged,
     )
 
-    for cap in (0.0, 4.0):
+    from edgemesh.runtime.paged_kv import init_quant_paged_cache
+
+    for cap, quant in ((0.0, False), (4.0, False), (0.0, True), (4.0, True)):
         cfg = _cfg(num_heads=4, num_kv_heads=2, head_dim=64,
                    hidden_size=64, intermediate_size=96).replace(
             attention_impl="flash", attn_soft_cap=cap)
@@ -447,9 +449,10 @@ def test_paged_chunk_kernel_matches_gather_oracle():
             saved = pg._CHUNK_KERNEL_OPTIN
             pg._CHUNK_KERNEL_OPTIN = use_kernel
             try:
-                assert pg._use_chunk_kernel(cfg, quant=False) == use_kernel
-                cache = init_paged_cache(cfg, batch=2, total_pages=16,
-                                         page_size=4, max_pages=8)
+                assert pg._use_chunk_kernel(cfg, quant=quant) == use_kernel
+                init = init_quant_paged_cache if quant else init_paged_cache
+                cache = init(cfg, batch=2, total_pages=16,
+                             page_size=4, max_pages=8)
                 _, cache = forward_prefill_paged(
                     cfg, params, full[:, :6], jnp.asarray([6, 6], jnp.int32), cache
                 )
@@ -468,6 +471,6 @@ def test_paged_chunk_kernel_matches_gather_oracle():
         last_g, ver_g = run(use_kernel=False)
         last_k, ver_k = run(use_kernel=True)
         np.testing.assert_allclose(last_k, last_g, atol=3e-5, rtol=3e-5,
-                                   err_msg=f"cap={cap}")
+                                   err_msg=f"cap={cap} quant={quant}")
         np.testing.assert_allclose(ver_k, ver_g, atol=3e-5, rtol=3e-5,
-                                   err_msg=f"cap={cap}")
+                                   err_msg=f"cap={cap} quant={quant}")
