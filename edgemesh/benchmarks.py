@@ -582,8 +582,9 @@ def headline_benchmark(
     _stage("bf16", _bf16)
 
     # ---- Stage 3: remaining int8 activation paths (XLA w8a8, fused Pallas
-    # w8a8); the headline re-points itself if one beats w8a16.
-    for mode in ("w8a8", "w8a8_pallas"):
+    # w8a8, pre-quantized Pallas); the headline re-points itself if one
+    # beats w8a16.
+    for mode in ("w8a8", "w8a8_pallas", "w8a8_pallas_pre"):
         def _mode(mode=mode):
             int8_runs[mode] = decode_benchmark(
                 preset, "int8", quant_mode=mode, batch=batch,

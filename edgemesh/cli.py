@@ -216,9 +216,9 @@ def main(argv: list[str] | None = None) -> int:
     top.add_argument(
         "--precision", type=str, default=None,
         choices=["bf16", "int8", "int8_w8a8", "int8_w8a8_pallas",
-                 "int8_w8a8_auto", "int4"],
-        help="bench: numeric precision (w8a8_auto measures both w8a8 "
-        "paths and benches the winner)",
+                 "int8_w8a8_pallas_pre", "int8_w8a8_auto", "int4"],
+        help="bench: numeric precision (w8a8_auto measures every w8a8 "
+        "path and benches the winner)",
     )
     top.add_argument(
         "--src", type=str, default=None,

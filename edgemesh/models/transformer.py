@@ -115,6 +115,9 @@ class ModelConfig:
     #   w8a8        — dynamic activation quant, int8xint8->int32 MXU via XLA.
     #   w8a8_pallas — fused Pallas kernel (quantize + dot + rescale in VMEM);
     #                 falls back to w8a8 where shapes don't tile.
+    #   w8a8_pallas_pre — activations quantized once in XLA (fused into the
+    #                 producing op); Pallas kernel streams int8 on both sides
+    #                 and accumulates natively in int32.
     quant_mode: str = "w8a16"
 
     # Attention backend: "auto" = Pallas flash kernel for prefill on TPU,
@@ -329,6 +332,11 @@ def dense(p: Params, x: jnp.ndarray, quant_mode: str = "w8a16") -> jnp.ndarray:
                 x, p["kernel_q"], p["scales"],
                 interpret=not on_tpu(),
             )
+        elif quant_mode == "w8a8_pallas_pre":
+            y = int8_ops.int8_matmul_prequant(
+                x, p["kernel_q"], p["scales"],
+                interpret=not on_tpu(),
+            )
         elif quant_mode == "w8a16":
             y = int8_ops.int8_matmul(x, p["kernel_q"], p["scales"])
         else:
@@ -337,6 +345,12 @@ def dense(p: Params, x: jnp.ndarray, quant_mode: str = "w8a16") -> jnp.ndarray:
         y = x @ p["kernel"]
     if "bias" in p:
         y = y + p["bias"]
+    if "lora_a" in p:
+        # LoRA finetuning forward (ops/lora.py): activation-side low-rank
+        # delta over the frozen kernel. Inference merges instead (zero cost).
+        from edgemesh.ops.lora import apply_lora_dense
+
+        y = apply_lora_dense(p, x, y)
     return y
 
 

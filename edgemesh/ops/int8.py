@@ -286,12 +286,160 @@ def pallas_int8_matmul(
     )(x, w_q, scales.reshape(1, -1))
 
 
+def _int8_prequant_kernel(xq_ref, xs_ref, w_ref, wscale_ref, out_ref, acc_ref):
+    """Pre-quantized w8a8 tile: both operands arrive int8; the MXU dot
+    accumulates natively in int32 (no per-step float work at all), and the
+    single epilogue fold applies per-row activation scale × per-column weight
+    scale. Compared to :func:`_int8_matmul_kernel` this moves the activation
+    quantization OUT of the kernel (XLA fuses it into the producing op), so:
+    (a) x tiles stream as int8 — 2-4× less activation DMA than bf16/f32,
+    (b) no VPU quantize repeated per N-tile × K-step,
+    (c) the accumulator round-trips VMEM as int32, matching the XLA
+    ``int8_matmul_dynamic`` numerics exactly (whole-row scales, int32 sum)."""
+    k_step = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    prod = jax.lax.dot_general(
+        xq_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+    if nk == 1:  # single K stripe: dot → scale → store, no scratch at all
+        out_ref[:] = (
+            prod.astype(jnp.float32)
+            * xs_ref[:].astype(jnp.float32)
+            * wscale_ref[0, :].astype(jnp.float32)
+        ).astype(out_ref.dtype)
+        return
+
+    @pl.when(k_step == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += prod
+
+    @pl.when(k_step == nk - 1)
+    def _finish():
+        out_ref[:] = (
+            acc_ref[:].astype(jnp.float32)
+            * xs_ref[:].astype(jnp.float32)
+            * wscale_ref[0, :].astype(jnp.float32)
+        ).astype(out_ref.dtype)
+
+
+def pallas_int8_prequant_matmul(
+    x_q: jnp.ndarray,  # [M, K] int8 (already quantized)
+    x_scale: jnp.ndarray,  # [M, 1] fp32 per-row
+    w_q: jnp.ndarray,  # [K, N] int8
+    scales: jnp.ndarray,  # [N] fp32 per-column
+    out_dtype=jnp.bfloat16,
+    *,
+    tile_m: int = 128,
+    tile_n: int = 128,
+    tile_k: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """int8×int8→int32 Pallas matmul over pre-quantized operands."""
+    if pl is None:
+        raise RuntimeError("pallas unavailable")
+    m, k = x_q.shape
+    k2, n = w_q.shape
+    assert k == k2, (k, k2)
+    tile_m = min(tile_m, m)
+    tile_n = min(tile_n, n)
+    tile_k = min(tile_k, k)
+    assert m % tile_m == 0 and n % tile_n == 0 and k % tile_k == 0, (m, n, k)
+
+    grid = (m // tile_m, n // tile_n, k // tile_k)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    return pl.pallas_call(
+        _int8_prequant_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_m, tile_k), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((tile_m, 1), lambda i, j, kk: (i, 0)),
+            pl.BlockSpec((tile_k, tile_n), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, tile_n), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((tile_m, tile_n), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((tile_m, tile_n), jnp.int32)],
+        interpret=interpret,
+        **kwargs,
+    )(x_q, x_scale, w_q, scales.reshape(1, -1))
+
+
+def _select_tiles(m: int, k: int, n: int) -> tuple[int | None, int | None, int]:
+    """Shared tile-selection policy for both Pallas w8a8 wrappers — these
+    constants are tuned from on-chip measurement (see the comments in
+    :func:`int8_matmul_fused`), so keeping one copy means a retune can
+    never leave the measured-auto-pick comparing a tuned kernel against a
+    stale one. Returns (tile_k, tile_n, pad_to); tile_k/tile_n are None
+    when the shape does not tile onto the MXU grid."""
+    tile_k = next((t for t in (2048, 1024, 512, 256, 128) if k % t == 0), None)
+    # Decode-shaped calls (tiny M) amortize per-grid-step overhead over few
+    # output rows, so wider N tiles (fewer steps, larger weight-stripe DMAs)
+    # help; 2 MB per int8 stripe keeps double-buffering within VMEM.
+    n_opts = (1024, 512, 256, 128) if m <= 32 else (512, 256, 128)
+    tile_n = next(
+        (t for t in n_opts if n % t == 0 and (tile_k or 0) * t <= 2**21), None
+    )
+    # Pad M to the sublane multiple: 32 for headroom on small decode
+    # batches, 128 once a full MXU tile's worth of rows exists.
+    pad_to = 128 if m > 32 else 32
+    return tile_k, tile_n, pad_to
+
+
+def int8_matmul_prequant(
+    x: jnp.ndarray,  # [..., K] activation
+    w_q: jnp.ndarray,  # [K, N] int8
+    scales: jnp.ndarray,  # [N]
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Model-facing entry for the pre-quantized Pallas w8a8 path
+    (``quant_mode="w8a8_pallas_pre"``).
+
+    The per-row activation quantization happens here in XLA-land — the
+    compiler fuses the absmax/round/clip into the producing op's epilogue —
+    and the kernel consumes int8 on both sides. Numerics match the XLA
+    ``int8_matmul_dynamic`` path exactly (same whole-row scales, same int32
+    accumulation), unlike the block-local-quant ``int8_matmul_fused``.
+    Falls back to the XLA path when shapes do not tile onto the MXU grid."""
+    *lead, k = x.shape
+    n = w_q.shape[1]
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    tile_k, tile_n, pad_to = _select_tiles(m, k, n)
+    if pl is None or tile_k is None or tile_n is None or m == 0:
+        y = int8_matmul_dynamic(x2, w_q, scales)
+        return y.reshape(*lead, n)
+    x_q, x_scale = quantize_activations(x2)
+    m_pad = -m % pad_to
+    if m_pad:
+        x_q = jnp.pad(x_q, ((0, m_pad), (0, 0)))
+        x_scale = jnp.pad(x_scale, ((0, m_pad), (0, 0)), constant_values=1.0)
+    tile_m = min(128, x_q.shape[0])
+    y = pallas_int8_prequant_matmul(
+        x_q, x_scale, w_q, scales, out_dtype=x.dtype,
+        tile_m=tile_m, tile_n=tile_n, tile_k=tile_k, interpret=interpret,
+    )
+    if m_pad:
+        y = y[:m]
+    return y.reshape(*lead, n)
+
+
 def measure_w8a8_mode(params: Params, batch: int = 8, repeats: int = 3) -> str:
     """Measurement-driven w8a8 path selection (ADR in docs/PERFORMANCE.md).
 
-    Times the XLA dynamic-quant path against the fused Pallas kernel on THIS
-    param tree's actual dense shapes at decode-like batch, and returns the
-    faster ``quant_mode`` ("w8a8" or "w8a8_pallas"). Rationale: at decode
+    Times the XLA dynamic-quant path against both Pallas kernels (block-local
+    fused quant, and pre-quantized int8-in) on THIS param tree's actual dense
+    shapes at decode-like batch, and returns the fastest ``quant_mode``
+    ("w8a8", "w8a8_pallas", or "w8a8_pallas_pre"). Rationale: at decode
     sizes both paths stream the same int8 weight bytes from HBM — fusion can
     only match, not beat, the XLA path's bandwidth bound, and round-2
     on-chip measurement had the kernel ~19% behind (2102 vs 2580 tok/s,
@@ -335,8 +483,15 @@ def measure_w8a8_mode(params: Params, batch: int = 8, repeats: int = 3) -> str:
     def run_pallas(xs):
         return [int8_matmul_fused(x, w, s) for x, (w, s) in zip(xs, mats)]
 
+    def run_pallas_pre(xs):
+        return [int8_matmul_prequant(x, w, s) for x, (w, s) in zip(xs, mats)]
+
     timings: dict[str, float] = {}
-    for name, fn in (("w8a8", run_xla), ("w8a8_pallas", run_pallas)):
+    for name, fn in (
+        ("w8a8", run_xla),
+        ("w8a8_pallas", run_pallas),
+        ("w8a8_pallas_pre", run_pallas_pre),
+    ):
         f = jax.jit(fn)
         device_sync(f(xs))  # compile + warm
         best = float("inf")
@@ -383,20 +538,10 @@ def int8_matmul_fused(
     # times, each w stripe M/tile_m times): measured on-chip at M=2048
     # (K=2048, N=8192), 128/128/512 tiles ran 22 TF vs 41 TF with
     # 128/512/2048 — within 10% of the XLA w8a8 path.
-    tile_k = next((t for t in (2048, 1024, 512, 256, 128) if k % t == 0), None)
-    # Decode-shaped calls (tiny M) amortize per-grid-step overhead over few
-    # output rows, so wider N tiles (fewer steps, larger weight-stripe DMAs)
-    # help; 2 MB per int8 stripe keeps double-buffering within VMEM.
-    n_opts = (1024, 512, 256, 128) if m <= 32 else (512, 256, 128)
-    tile_n = next(
-        (t for t in n_opts if n % t == 0 and (tile_k or 0) * t <= 2**21), None
-    )
+    tile_k, tile_n, pad_to = _select_tiles(m, k, n)
     if pl is None or tile_k is None or tile_n is None or m == 0:
         y = int8_matmul_dynamic(x2, w_q, scales)
         return y.reshape(*lead, n)
-    # Pad M to the bf16 sublane multiple (16) — 32 for headroom on small
-    # decode batches, 128 once a full MXU tile is available.
-    pad_to = 128 if m > 32 else 32
     m_pad = -m % pad_to
     if m_pad:
         x2 = jnp.pad(x2, ((0, m_pad), (0, 0)))

@@ -147,7 +147,49 @@ def test_int8_matmul_fused_matches_dynamic():
     np.testing.assert_allclose(np.asarray(got2), np.asarray(ref2), rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("quant_mode", ["w8a8", "w8a8_pallas"])
+def test_int8_matmul_prequant_matches_dynamic_exact():
+    """The pre-quantized Pallas path computes the SAME contraction as the XLA
+    w8a8 path — identical whole-row activation scales, identical int32
+    accumulation — so outputs must agree to float rounding, not just int8
+    tolerance (unlike the block-local-quant fused kernel)."""
+    from edgemesh.ops.int8 import int8_matmul_prequant
+
+    w = jax.random.normal(jax.random.PRNGKey(7), (128, 128), jnp.float32) * 0.05
+    q, scales = quantize_weight(w)
+    x = jax.random.normal(jax.random.PRNGKey(8), (1, 3, 128), jnp.float32)
+    got = int8_matmul_prequant(x, q, scales, interpret=True)
+    ref = int8_matmul_dynamic(x.reshape(3, 128), q, scales).reshape(1, 3, 128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-6)
+    # N not tileable -> routes to the XLA dynamic path.
+    w2 = jax.random.normal(jax.random.PRNGKey(9), (128, 96), jnp.float32) * 0.05
+    q2, s2 = quantize_weight(w2)
+    got2 = int8_matmul_prequant(x, q2, s2, interpret=True)
+    ref2 = int8_matmul_dynamic(x.reshape(3, 128), q2, s2).reshape(1, 3, 96)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(ref2), rtol=1e-5, atol=1e-5)
+
+
+def test_prequant_multi_k_stripe_int32_accumulator():
+    """Multi-K-stripe grid: the int32 scratch accumulator across K steps must
+    reproduce the single-pass contraction exactly (int32 addition is
+    associative — no float accumulation drift by construction)."""
+    from edgemesh.ops.int8 import (
+        pallas_int8_prequant_matmul,
+        quantize_activations,
+    )
+
+    w = jax.random.normal(jax.random.PRNGKey(10), (256, 128), jnp.float32) * 0.05
+    q, scales = quantize_weight(w)
+    x = jax.random.normal(jax.random.PRNGKey(11), (32, 256), jnp.float32)
+    x_q, x_scale = quantize_activations(x)
+    got = pallas_int8_prequant_matmul(
+        x_q, x_scale, q, scales, out_dtype=jnp.float32,
+        tile_m=32, tile_n=128, tile_k=128, interpret=True,
+    )
+    ref = int8_matmul_dynamic(x, q, scales)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("quant_mode", ["w8a8", "w8a8_pallas", "w8a8_pallas_pre"])
 def test_w8a8_model_forward_close_to_fp(quant_mode):
     """Model-level parity for the activation-quantized paths (the headline
     int8 execution modes): quantized prefill logits stay close to fp."""
