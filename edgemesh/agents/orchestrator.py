@@ -426,6 +426,17 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
         tokenizer = load_tokenizer(None)
         overrides.setdefault("vocab_size", tokenizer.vocab_size + 1)
         overrides.setdefault("max_seq_len", 512)
+        if overrides["vocab_size"] < tokenizer.vocab_size:
+            # A model vocab smaller than the tokenizer's id range makes
+            # EOS/PAD ids index past the embedding — XLA's clamped gathers
+            # turn that into silent garbage (NaN losses in training, junk
+            # samples at decode), so refuse loudly instead.
+            raise ValueError(
+                f"model vocab_size {overrides['vocab_size']} < tokenizer "
+                f"vocab {tokenizer.vocab_size} (byte tokenizer ids run to "
+                f"{tokenizer.vocab_size - 1}); set vocab_size >= "
+                f"{tokenizer.vocab_size} or leave it unset"
+            )
         cfg = tiny_config(family, **overrides)
         # crc32, not builtin hash(): PYTHONHASHSEED randomizes hash() per
         # process, which would give a resumed eval a different model than the
@@ -443,7 +454,25 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
         from edgemesh.training import init_train_state, make_optimizer
 
         mgr = TrainCheckpointManager(ms.train_checkpoint)
-        template = init_train_state(cfg, params, make_optimizer())
+        if ms.lora_rank > 0:
+            # LoRA checkpoints hold only the adapter tree; rebuild its
+            # structure from the spec (rank/alpha/targets must match the
+            # training run), restore, and MERGE into the base kernels so
+            # inference — and the precision transform below — see the
+            # finetuned weights at zero serving cost (ops/lora.py).
+            from edgemesh.ops.lora import (
+                init_lora_params,
+                make_lora_optimizer,
+                merge_lora,
+            )
+
+            template = init_train_state(
+                cfg,
+                init_lora_params(params, ms.lora_rank, ms.lora_alpha, ms.lora_targets),
+                make_lora_optimizer(),
+            )
+        else:
+            template = init_train_state(cfg, params, make_optimizer())
         restored = mgr.restore_latest(template)
         mgr.close()
         if restored is None:
@@ -451,7 +480,10 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
                 f"no training checkpoint found under {ms.train_checkpoint!r} "
                 "(run `edgemesh train` with train.checkpoint_dir first)"
             )
-        params = restored[0].params
+        if ms.lora_rank > 0:
+            params = merge_lora(params, restored[0].params)
+        else:
+            params = restored[0].params
         log.info("%s: restored trained params from %s (step %d)",
                  role_seed, ms.train_checkpoint, restored[1])
 
@@ -459,7 +491,8 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
         from edgemesh.ops.int4 import quantize_params_int4
 
         params = quantize_params_int4(params, group_size=ms.int4_group_size)
-    elif ms.precision in ("int8", "int8_w8a8", "int8_w8a8_pallas", "int8_w8a8_auto"):
+    elif ms.precision in ("int8", "int8_w8a8", "int8_w8a8_pallas",
+                          "int8_w8a8_pallas_pre", "int8_w8a8_auto"):
         if ms.calibration:
             if ms.precision == "int8":
                 # Weight-only (w8a16) keeps activations in fp: smoothing has
@@ -468,7 +501,8 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
                 # rather than silently degrade.
                 raise ValueError(
                     "calibration (SmoothQuant) only benefits the w8a8 "
-                    "precisions; use precision: int8_w8a8 or int8_w8a8_pallas"
+                    "precisions; use precision: int8_w8a8, int8_w8a8_pallas, "
+                    "int8_w8a8_pallas_pre, or int8_w8a8_auto"
                 )
             from edgemesh.models.tokenizer import encode_batch
             from edgemesh.ops.smoothquant import calibrate_and_quantize

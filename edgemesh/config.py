@@ -73,8 +73,9 @@ class ModelSpec:
     family: str = "auto"  # auto | llama | neox | phi2 | mistral | qwen2 | gemma | gemma2 | phi3
     # bf16 | fp16 | fp32 | int8 (weight-only w8a16) | int8_w8a8 (dynamic
     # activation quant, int8xint8 MXU) | int8_w8a8_pallas (fused kernel) |
-    # int8_w8a8_auto (measure both w8a8 paths on this model's shapes at
-    # build and run the winner — ops/int8.measure_w8a8_mode)
+    # int8_w8a8_pallas_pre (activations pre-quantized in XLA, int8-in
+    # kernel) | int8_w8a8_auto (measure the w8a8 paths on this model's
+    # shapes at build and run the winner — ops/int8.measure_w8a8_mode)
     precision: str = "bf16"
     # Architecture overrides for synthetic (random-init) models; ignored when
     # loading a real checkpoint.
@@ -96,6 +97,15 @@ class ModelSpec:
     # quantize the TRAINED weights. Architecture fields must match the
     # training run's model spec.
     train_checkpoint: str = ""
+    # LoRA finetuning (ops/lora.py). rank > 0 switches `edgemesh train` to
+    # adapter training (base frozen, checkpoints hold only the adapters) and
+    # tells inference restore to rebuild + MERGE the adapters from
+    # ``train_checkpoint`` before any precision transform. alpha/targets
+    # must match between the training run and the serving spec.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    # Comma-separated dense projections to adapt (q/k/v/o/gate/up/down).
+    lora_targets: str = "q,k,v,o"
     # SmoothQuant calibration for int8 precisions: path to a text file of
     # calibration prompts (one per line). When set, quantization smooths
     # activation outliers into the weights using these prompts' statistics

@@ -1,0 +1,171 @@
+"""LoRA: low-rank adapter finetuning over frozen base weights.
+
+The reference's roadmap left finetuning unstarted (the xlsx "After
+Finetuning" rows are empty — SURVEY.md §7), and its target hardware is
+memory-starved edge devices (Jetson Orin Nano 8GB, paper §4.4). LoRA is the
+finetuning method that actually fits that envelope: train two rank-r
+factors per projection (~0.5% of the weights), keep the base frozen, merge
+for inference at zero serving cost.
+
+TPU-first design decisions:
+- **Split trees, not masked optimizers.** The adapter pytree is separate
+  from the base params. ``jax.value_and_grad`` runs over the adapter tree
+  only, so XLA dead-code-eliminates every frozen dW computation in the
+  backward — the FLOP/memory win that is LoRA's point — and optimizer
+  state (adamw mu/nu) exists only for adapter leaves. Checkpoints are the
+  adapter tree alone: kilobytes, the portable finetuning artifact.
+- **Adapters ride the stacked-layer layout.** Model layers are stacked
+  ``[L, in, out]`` for ``lax.scan`` (models/transformer.py); adapters
+  follow as ``lora_a [L, in, r]`` / ``lora_b [L, r, out]`` / per-layer
+  ``lora_scale [L]``, so the same scan slices them with zero special
+  cases. ``dense()`` applies ``y += (x @ A) @ B * scale`` whenever the
+  leaves are present — the activation-side form is O(tokens·(in+out)·r),
+  never materializing the [in, out] delta.
+- **Merge before quantize.** For inference the adapters fold into the
+  base kernel (``W + scale·A@B``) BEFORE any int8/int4 transform
+  (agents/orchestrator.py does precision transforms after checkpoint
+  restore), so quantization sees the finetuned weights and serving runs
+  the unmodified fast paths.
+
+The frozen ``lora_scale`` leaf (alpha/rank, stored so checkpoints are
+self-describing) is excluded from updates via ``optax.multi_transform``
+with ``set_to_zero`` — see :func:`make_lora_optimizer`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Params = dict[str, Any]
+
+DEFAULT_TARGETS = ("q", "k", "v", "o")
+
+
+def parse_targets(targets: str | tuple[str, ...] | list[str]) -> tuple[str, ...]:
+    if isinstance(targets, str):
+        targets = tuple(t.strip() for t in targets.split(",") if t.strip())
+    return tuple(targets)
+
+
+def init_lora_params(
+    params: Params,
+    rank: int,
+    alpha: float,
+    targets: str | tuple[str, ...] = DEFAULT_TARGETS,
+    key: jax.Array | None = None,
+) -> Params:
+    """Build the adapter pytree for the dense layer projections in
+    ``targets`` (names under params["layers"]: q/k/v/o/gate/up/down).
+
+    ``lora_a`` is gaussian (std 1/rank), ``lora_b`` zeros — the adapted
+    model starts exactly at the base model. MoE expert weights are not
+    adapted (routed [L, E, in, out] experts would need per-expert factors;
+    the dense projections are where LoRA earns its keep).
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if rank <= 0:
+        raise ValueError(f"lora rank must be positive, got {rank}")
+    targets = parse_targets(targets)
+    layers = params.get("layers", {})
+    out: Params = {}
+    for i, name in enumerate(sorted(targets)):
+        leaf = layers.get(name)
+        if not isinstance(leaf, dict) or "kernel" not in leaf:
+            available = sorted(
+                k for k, v in layers.items()
+                if isinstance(v, dict) and "kernel" in v
+            )
+            raise ValueError(
+                f"lora target {name!r} is not a dense layer leaf; "
+                f"available: {available}"
+            )
+        kernel = leaf["kernel"]  # [L, in, out] stacked (or [in, out])
+        stacked = kernel.ndim == 3
+        lead = (kernel.shape[0],) if stacked else ()
+        d_in, d_out = kernel.shape[-2], kernel.shape[-1]
+        a = jax.random.normal(
+            jax.random.fold_in(key, i), (*lead, d_in, rank), jnp.float32
+        ) * (1.0 / rank)
+        out[name] = {
+            "lora_a": a.astype(kernel.dtype),
+            "lora_b": jnp.zeros((*lead, rank, d_out), kernel.dtype),
+            "lora_scale": jnp.full(lead or (), alpha / rank, jnp.float32),
+        }
+    return {"layers": out}
+
+
+def attach_lora(params: Params, lora: Params) -> Params:
+    """Merge the adapter leaves into the param tree structurally (no
+    arithmetic): each targeted layer leaf gains lora_a/lora_b/lora_scale,
+    which ``models.transformer.dense`` applies on the activation side.
+    Used inside the training loss so gradients flow only through ``lora``."""
+    layers = dict(params["layers"])
+    for name, leaves in lora["layers"].items():
+        layers[name] = {**layers[name], **leaves}
+    return {**params, "layers": layers}
+
+
+def merge_lora(params: Params, lora: Params) -> Params:
+    """Fold adapters into the base kernels: W' = W + scale · A @ B.
+
+    The returned tree has the original structure (no adapter leaves) — the
+    zero-serving-cost form. Precision transforms (int8/int4) quantize W'
+    downstream, so the finetuned delta survives quantization."""
+    layers = dict(params["layers"])
+    for name, leaves in lora["layers"].items():
+        base = layers[name]
+        kernel = base["kernel"]
+        a = leaves["lora_a"].astype(jnp.float32)
+        b = leaves["lora_b"].astype(jnp.float32)
+        scale = leaves["lora_scale"].astype(jnp.float32)
+        delta = jnp.einsum("...ir,...ro->...io", a, b)
+        if delta.ndim == 3:  # stacked layers: per-layer scale [L]
+            delta = delta * scale[:, None, None]
+        else:
+            delta = delta * scale
+        merged = (kernel.astype(jnp.float32) + delta).astype(kernel.dtype)
+        layers[name] = {**base, "kernel": merged}
+    return {**params, "layers": layers}
+
+
+def apply_lora_dense(p: Params, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Activation-side adapter: y + (x @ A) @ B · scale. Called from
+    ``dense()`` when the (scan-sliced) layer leaf carries adapter leaves."""
+    a = p["lora_a"].astype(x.dtype)
+    b = p["lora_b"].astype(x.dtype)
+    return y + ((x @ a) @ b) * p["lora_scale"].astype(x.dtype)
+
+
+def make_lora_optimizer(
+    lr: float = 1e-4, weight_decay: float = 0.01
+) -> optax.GradientTransformation:
+    """adamw over lora_a/lora_b; ``lora_scale`` is frozen (set_to_zero) so
+    the recorded alpha/rank can never drift from what the forward used."""
+
+    def labels(tree: Params) -> Params:
+        def walk(node, name=""):
+            if isinstance(node, dict):
+                return {k: walk(v, k) for k, v in node.items()}
+            return "freeze" if name == "lora_scale" else "train"
+
+        return walk(tree)
+
+    return optax.multi_transform(
+        {
+            "train": optax.adamw(lr, weight_decay=weight_decay),
+            "freeze": optax.set_to_zero(),
+        },
+        labels,
+    )
+
+
+def lora_num_params(lora: Params) -> int:
+    return sum(
+        leaf.size
+        for leaf in jax.tree.leaves(lora)
+    )

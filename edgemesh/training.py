@@ -159,10 +159,31 @@ def run_training(run_cfg) -> dict[str, Any]:
     ms = run_cfg.mesh
     if ms.dp * ms.tp > 1:
         mesh = build_mesh(dp=ms.dp, tp=ms.tp)
-    optimizer = make_optimizer(ts.lr, ts.weight_decay)
+    lora_rank = spec.model.lora_rank
+    if lora_rank > 0:
+        from edgemesh.ops.lora import (
+            init_lora_params,
+            lora_num_params,
+            make_lora_optimizer,
+        )
+
+        optimizer = make_lora_optimizer(ts.lr, ts.weight_decay)
+        lora = init_lora_params(
+            params, lora_rank, spec.model.lora_alpha,
+            spec.model.lora_targets, jax.random.PRNGKey(run_cfg.seed),
+        )
+        log.info(
+            "lora: rank %d over %s (%d adapter params; base frozen)",
+            lora_rank, spec.model.lora_targets, lora_num_params(lora),
+        )
+    else:
+        optimizer = make_optimizer(ts.lr, ts.weight_decay)
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
-    state = init_train_state(cfg, params, optimizer)
+    # With LoRA the TrainState carries ONLY the adapter tree (checkpoints
+    # are the kilobyte-scale adapters; base weights come from the model
+    # spec at restore time — orchestrator._materialize merges them).
+    state = init_train_state(cfg, lora if lora_rank > 0 else params, optimizer)
     if mesh is not None:
 
         def place(x):
@@ -176,7 +197,13 @@ def run_training(run_cfg) -> dict[str, Any]:
             return jax.device_put(x, NamedSharding(mesh, P()))
 
         state = jax.tree.map(place, state)
-    step_fn = make_train_step(cfg, optimizer)
+    if lora_rank > 0:
+        lora_step = make_lora_train_step(cfg, optimizer)
+
+        def step_fn(st, tokens, lengths):
+            return lora_step(st, params, tokens, lengths)
+    else:
+        step_fn = make_train_step(cfg, optimizer)
 
     mgr = resumed_from = None
     if ts.checkpoint_dir:
@@ -219,7 +246,32 @@ def run_training(run_cfg) -> dict[str, Any]:
         "final_loss": None if final_loss is None else float(final_loss),
         "steps_run": ts.steps - start,
         "resumed_from": resumed_from,
+        "lora_rank": lora_rank,
     }
+
+
+def make_lora_train_step(cfg: ModelConfig, optimizer):
+    """(state, base_params, tokens, lengths) -> (state, loss) where
+    ``state.params`` is the ADAPTER tree only (ops/lora.py split design).
+
+    The base params enter as a plain argument — never differentiated, so
+    XLA prunes every frozen-weight gradient from the backward; adamw state
+    exists only for the adapters. ``attach_lora`` grafts the adapter leaves
+    into the forward tree structurally; gradients flow back through the
+    activation-side ``(x @ A) @ B`` term alone."""
+    from edgemesh.ops.lora import attach_lora
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, base_params: Params, tokens, lengths):
+        def loss_fn(lora):
+            return causal_lm_loss(cfg, attach_lora(base_params, lora), tokens, lengths)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        lora = optax.apply_updates(state.params, updates)
+        return TrainState(lora, opt_state, state.step + 1), loss
+
+    return train_step
 
 
 def make_train_step(cfg: ModelConfig, optimizer):

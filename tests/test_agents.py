@@ -140,7 +140,9 @@ def test_agent_draft_vocab_mismatch_rejected():
 
     import pytest
 
+    # 300 clears the tokenizer-range guard (>= 259) but differs from the
+    # main model's 260 — the speculative contract needs identical vocabs.
     with pytest.raises(ValueError, match="shared tokenizer"):
         build_agent(
-            AgentSpec(role="qa", model=ModelSpec(), draft=ModelSpec(vocab_size=32))
+            AgentSpec(role="qa", model=ModelSpec(), draft=ModelSpec(vocab_size=300))
         )
