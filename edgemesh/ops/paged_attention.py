@@ -354,8 +354,10 @@ def paged_chunk_attention(
     path for windowed configs); ``k_scales``/``v_scales`` mark an int8
     pool, dequantized in-kernel exactly like decode. Padded chunk rows
     compute garbage that callers discard — their columns stay masked
-    within kv_lens, so no NaNs propagate. OPT-IN until measured on
-    hardware (EDGEMESH_PAGED_CHUNK_KERNEL=1, runtime/paged_generate.py)."""
+    within kv_lens, so no NaNs propagate. OPT-IN
+    (EDGEMESH_PAGED_CHUNK_KERNEL=1): on-chip measurement found it slower
+    than the gather oracle at verify-chunk shapes — numbers in
+    runtime/paged_generate._use_chunk_kernel."""
     if not HAVE_PALLAS:  # pragma: no cover
         raise RuntimeError("pallas unavailable")
     quantized = k_scales is not None
