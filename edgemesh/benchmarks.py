@@ -165,13 +165,20 @@ def serving_benchmark(
         prefix_cache=False,
     )
     eng = ContinuousEngine(agent, slots=slots, chunk=chunk, kv_backend=kv_backend)
+    question = "benchmark question number {i:02d}, please answer at length?"
     try:
+        # Warm with the SAME prompt shape the timed requests use: admission
+        # prefill programs compile per length bucket, and a fresh compile on
+        # this platform's tunnel costs 20-40s — a warmup in a different
+        # bucket would bleed that compile into the first timed admission
+        # (the compile-vs-steady-state split the eval harness also makes).
         _progress(f"serving/{kv_backend} slots={slots}: warmup compile")
-        eng.answer("warm up the resident decode loop?")
+        eng.answer(question.format(i=99))
+        warm_stats = eng.stats()
         _progress(f"serving/{kv_backend}: {n_requests} requests x {max_new} new tokens")
         t0 = time.perf_counter()
         futs = [
-            eng.submit(f"benchmark question number {i}, please answer at length?")
+            eng.submit(question.format(i=i))
             for i in range(n_requests)
         ]
         results = [f.result() for f in futs]
@@ -181,6 +188,11 @@ def serving_benchmark(
         generated = sum(r["generated"] for r in results)
         lats = [r["t_end"] - r["t_start"] + r["queue_s"] for r in results]
         tok_s = generated / wall
+        # Engine counters accumulate from start; report the timed window's
+        # delta so the warmup request doesn't skew the diagnosis keys.
+        stats = eng.stats()
+        for k in ("requests", "segments", "admitted_mid_flight"):
+            stats[k] -= warm_stats[k]
         _progress(
             f"serving/{kv_backend}: {tok_s:.1f} tok/s aggregate, "
             f"{n_requests / wall:.2f} req/s"
@@ -193,7 +205,7 @@ def serving_benchmark(
             "generated": generated,
             "latency_s_p50": round(float(np.percentile(lats, 50)), 4),
             "latency_s_p95": round(float(np.percentile(lats, 95)), 4),
-            "stats": eng.stats(),
+            "stats": stats,
         }
     finally:
         eng.close()
@@ -666,6 +678,13 @@ def headline_benchmark(
         out["serving_paged_req_s"] = r["req_s"]
         out["serving_latency_s_p50"] = r["latency_s_p50"]
         out["serving_latency_s_p95"] = r["latency_s_p95"]
+        # Diagnosis keys: segments/concurrency separate engine anomalies
+        # from device slowness without rerunning (r3's first measurement
+        # was 15x slow from per-token host readbacks in the retire path —
+        # found only by profiling; these keys make the segment math
+        # checkable from the artifact alone).
+        out["serving_segments"] = r["stats"]["segments"]
+        out["serving_max_concurrent"] = r["stats"]["max_concurrent"]
 
     if os.environ.get("EDGEMESH_BENCH_SERVE", "1") == "1":
         _stage("serving", _serving)

@@ -10,6 +10,18 @@ from __future__ import annotations
 
 from pathlib import Path
 
+import numpy as np
+
+
+def _host_ids(ids) -> list[int]:
+    """One bulk device→host transfer, then plain Python ints.
+
+    Iterating a jax device array directly makes every ``int(i)`` its own
+    readback — ~0.13s EACH over the tunneled TPU (measured: retiring one
+    32-token serving request cost ~4s in decode alone). Every decode path
+    funnels through here so no caller can reintroduce that."""
+    return np.asarray(ids).tolist()
+
 
 class ByteTokenizer:
     """Deterministic byte-level tokenizer (vocab 256 + BOS/EOS/PAD) for
@@ -27,7 +39,7 @@ class ByteTokenizer:
         return ids
 
     def decode(self, ids) -> str:
-        data = bytes(int(i) for i in ids if 0 <= int(i) < 256)
+        data = bytes(i for i in _host_ids(ids) if 0 <= i < 256)
         return data.decode("utf-8", errors="replace")
 
 
@@ -58,8 +70,7 @@ class HFTokenizer:
         return ids
 
     def decode(self, ids) -> str:
-        ids = [int(i) for i in ids]
-        return self._tok.decode(ids, skip_special_tokens=True)
+        return self._tok.decode(_host_ids(ids), skip_special_tokens=True)
 
 
 def load_tokenizer(path: str | Path | None):

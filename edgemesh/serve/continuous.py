@@ -530,7 +530,11 @@ class ContinuousEngine:
     def _retire(self, idx: int):
         slot = self._slots[idx]
         tokenizer = self.agent.tokenizer
-        text = tokenizer.decode(jnp.asarray(slot.emitted, jnp.int32)) if slot.emitted else ""
+        # slot.emitted is already a host-side list of ints — hand it to the
+        # tokenizer as-is. Round-tripping it through a device array made
+        # decode's per-element int() a device readback EACH (~0.13s over the
+        # tunnel): ~4s per retired request, 33s of a 36s serving wave.
+        text = tokenizer.decode(slot.emitted) if slot.emitted else ""
         now = time.perf_counter()
         wall = max(now - slot.t_start, 1e-9)
         slot.future.set_result(
@@ -607,9 +611,9 @@ class ContinuousEngine:
                     self._decode_fn, self._finished,
                 )
                 self.segments += 1
-                counts_h = jax.device_get(counts)
-                out_h = jax.device_get(out)
-                fin_h = jax.device_get(fin)
+                # Single pytree fetch: one blocking round trip per segment
+                # instead of three (each ~0.13s on the tunneled platform).
+                counts_h, out_h, fin_h = jax.device_get((counts, out, fin))
                 self._finished = fin
                 for i in active:
                     slot = self._slots[i]
