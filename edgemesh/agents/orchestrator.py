@@ -125,9 +125,13 @@ class Agent:
         padded = [ids + [pad] * (bucket - len(ids)) for ids in ids_list]
         padded += [padded[-1]] * (rows - n)  # dummy rows fill the batch bucket
         tokens = jnp.asarray(padded, dtype=jnp.int32)
-        lengths = jnp.asarray(
+        # lengths stay HOST-side (numpy): every consumer either passes them
+        # into a jit call (auto-transferred) or reads them as ints — and a
+        # device-resident lengths made serving admission pay one blocking
+        # ~0.13s tunnel readback per request just for `int(lengths[0])`.
+        lengths = np.asarray(
             [len(ids) for ids in ids_list] + [len(ids_list[-1])] * (rows - n),
-            dtype=jnp.int32,
+            dtype=np.int32,
         )
         return tokens, lengths, n
 
