@@ -433,6 +433,8 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
                 intermediate_size=ms.intermediate_size,
                 max_seq_len=ms.max_seq_len,
                 sliding_window=ms.sliding_window,
+                num_experts=ms.num_experts,
+                experts_per_token=ms.experts_per_token,
             ).items()
             if v is not None
         }

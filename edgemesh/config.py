@@ -70,7 +70,7 @@ class ModelSpec:
     # HF hub id for `edgemesh download --src <hub-cache>` materialization
     # (e.g. "microsoft/phi-2"); defaults to the basename of ``path``.
     hub_id: str = ""
-    family: str = "auto"  # auto | llama | neox | phi2 | mistral | qwen2 | gemma | gemma2 | phi3
+    family: str = "auto"  # auto | llama | neox | phi2 | mistral | mixtral | qwen2 | gemma | gemma2 | phi3 | falcon | gpt2
     # bf16 | fp16 | fp32 | int8 (weight-only w8a16) | int8_w8a8 (dynamic
     # activation quant, int8xint8 MXU) | int8_w8a8_pallas (fused kernel) |
     # int8_w8a8_pallas_pre (activations pre-quantized in XLA, int8-in
@@ -88,6 +88,11 @@ class ModelSpec:
     max_seq_len: int | None = None
     # Sliding-window attention (Mistral); None = family/checkpoint default.
     sliding_window: int | None = None
+    # Routed-MoE dials for synthetic models (mixtral family or any preset
+    # with experts); real checkpoints read num_local_experts /
+    # num_experts_per_tok from config.json and ignore these.
+    num_experts: int | None = None
+    experts_per_token: int | None = None
     # Int4 scale granularity: 0 = per-channel (fastest), g>0 = grouped
     # (GPTQ/AWQ-style quality remedy; must be even). See ops/int4.py.
     int4_group_size: int = 64

@@ -69,6 +69,22 @@ FAMILY_PRESETS: dict[str, dict] = {
         lm_head_bias=False,
         tie_embeddings=False,
     ),
+    # Mixtral (8x7B / 8x22B): the mistral dialect with the dense SwiGLU MLP
+    # replaced by a top-k routed MoE (ops/moe.py) — num_experts /
+    # experts_per_token come from the checkpoint (num_local_experts /
+    # num_experts_per_tok). Routing math matches HF exactly: softmax over
+    # ALL experts, top-k, renormalize over the selected k.
+    "mixtral": dict(
+        norm="rms",
+        activation="silu",
+        parallel_block=False,
+        shared_input_norm=False,
+        rotary_fraction=1.0,
+        qkv_bias=False,
+        out_bias=False,
+        lm_head_bias=False,
+        tie_embeddings=False,
+    ),
     # Qwen2/2.5: the llama dialect plus attention qkv biases; small variants
     # (0.5B/1.5B) tie embeddings (checkpoint's tie_word_embeddings decides).
     "qwen2": dict(
@@ -173,6 +189,7 @@ _HF_MODEL_TYPE_TO_FAMILY = {
     "gpt_neox": "neox",
     "phi": "phi2",
     "mistral": "mistral",
+    "mixtral": "mixtral",
     "qwen2": "qwen2",
     "gemma": "gemma",
     "gemma2": "gemma2",

@@ -9,8 +9,9 @@ layer axis, and ``jax.device_put`` the tree into (sharded) HBM
 
 Name maps cover the reference's three model families (ACL paper §4.2) —
 Llama (Llama-3.2-1B-Instruct), GPT-NeoX (Pythia-1B), Phi (Phi-2) — plus
-Mistral, Qwen2, Gemma, Gemma-2, Phi-3, GPT-2, and Falcon (families.py
-registry; each pinned against HF logits in tests/test_hf_parity.py).
+Mistral, Mixtral (routed MoE), Qwen2, Gemma, Gemma-2, Phi-3, GPT-2, and
+Falcon (families.py registry; each pinned against HF logits in
+tests/test_hf_parity.py).
 """
 
 from __future__ import annotations
@@ -105,11 +106,12 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
     with open(ckpt / "config.json") as f:
         hf = json.load(f)
 
-    if family in ("llama", "mistral", "qwen2", "gemma", "gemma2", "phi3"):
-        # One config dialect: mistral adds sliding-window attention, qwen2
-        # adds qkv biases (preset), gemma adds unit-offset norms / GeGLU /
-        # embed scaling (preset) and a wide fixed head_dim, phi3 adds fused
-        # checkpoint weights (split at load) + an always-on sliding window.
+    if family in ("llama", "mistral", "mixtral", "qwen2", "gemma", "gemma2", "phi3"):
+        # One config dialect: mistral adds sliding-window attention, mixtral
+        # adds routed experts on top of that, qwen2 adds qkv biases (preset),
+        # gemma adds unit-offset norms / GeGLU / embed scaling (preset) and a
+        # wide fixed head_dim, phi3 adds fused checkpoint weights (split at
+        # load) + an always-on sliding window.
         kw = dict(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -125,6 +127,21 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
         if family == "mistral":
             # null in newer configs (full attention); 4096 on the 7B v0.1.
             kw["sliding_window"] = int(hf.get("sliding_window") or 0)
+        elif family == "mixtral":
+            kw["sliding_window"] = int(hf.get("sliding_window") or 0)
+            E = int(hf["num_local_experts"])
+            k = int(hf["num_experts_per_tok"])
+            kw["num_experts"] = E
+            kw["experts_per_token"] = k
+            # HF's MixtralSparseMoeBlock never drops tokens; the GShard
+            # default factor (1.25) WOULD under routing imbalance, silently
+            # diverging from the checkpoint's own behavior. E/k makes
+            # capacity = num_tokens — mathematically dropless — at the cost
+            # of a [T, E, T] dispatch tensor (fine to ~2k-token prefills;
+            # long-prompt serving chunks prefill anyway). Override via
+            # config_from_checkpoint(..., expert_capacity_factor=...) to
+            # trade exactness for dispatch memory.
+            kw["expert_capacity_factor"] = float(E) / k
         elif family == "qwen2":
             # Qwen2's use_sliding_window applies the window only to layers
             # >= max_window_layers (lower layers attend fully); this runtime
@@ -260,7 +277,7 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
         raise ValueError(family)
     rs = hf.get("rope_scaling") or {}
     rs_type = rs.get("rope_type", rs.get("type", ""))
-    if family not in ("llama", "mistral", "qwen2", "gemma", "gemma2", "phi3", "falcon") and rs and rs_type not in ("default", "none", ""):
+    if family not in ("llama", "mistral", "mixtral", "qwen2", "gemma", "gemma2", "phi3", "falcon") and rs and rs_type not in ("default", "none", ""):
         # The neox/phi2 forward paths don't consume a scaling block; ignoring
         # a frequency-changing one would silently produce wrong logits for a
         # long-context variant. No-op types (newer HF configs emit
@@ -304,6 +321,8 @@ def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) ->
 
     if family == "phi3":
         params = _map_llama(raw, cfg, dtype, presplit=_split_phi3_fused)
+    elif family == "mixtral":
+        params = _map_llama(raw, cfg, dtype, ffn=_moe_ffn)
     elif family in ("llama", "mistral", "qwen2", "gemma", "gemma2"):  # identical weight naming
         params = _map_llama(raw, cfg, dtype)
     elif family == "neox":
@@ -339,7 +358,17 @@ def _split_phi3_fused(raw: dict[str, np.ndarray], cfg: ModelConfig) -> dict[str,
     return out
 
 
-def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype, presplit=None) -> Params:
+def _dense_ffn(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
+    """The llama-dialect dense SwiGLU FFN entries (default ``ffn`` hook)."""
+    L = cfg.num_layers
+    return {
+        "gate": {"kernel": _layer_stack(raw, "model.layers.{}.mlp.gate_proj.weight", L, dtype, True)},
+        "up": {"kernel": _layer_stack(raw, "model.layers.{}.mlp.up_proj.weight", L, dtype, True)},
+        "down": {"kernel": _layer_stack(raw, "model.layers.{}.mlp.down_proj.weight", L, dtype, True)},
+    }
+
+
+def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype, presplit=None, ffn=_dense_ffn) -> Params:
     if presplit is not None:
         raw = presplit(raw, cfg)
     L = cfg.num_layers
@@ -354,9 +383,7 @@ def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype, presplit=Non
         "k": {"kernel": layer_stack("model.layers.{}.self_attn.k_proj.weight", True)},
         "v": {"kernel": layer_stack("model.layers.{}.self_attn.v_proj.weight", True)},
         "o": {"kernel": layer_stack("model.layers.{}.self_attn.o_proj.weight", True)},
-        "gate": {"kernel": layer_stack("model.layers.{}.mlp.gate_proj.weight", True)},
-        "up": {"kernel": layer_stack("model.layers.{}.mlp.up_proj.weight", True)},
-        "down": {"kernel": layer_stack("model.layers.{}.mlp.down_proj.weight", True)},
+        **ffn(raw, cfg, dtype),
     }
     if "model.layers.0.post_feedforward_layernorm.weight" in raw:  # Gemma-2
         layers["mlp_norm"] = {
@@ -381,6 +408,42 @@ def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype, presplit=Non
     if not cfg.tie_embeddings and "lm_head.weight" in raw:
         params["lm_head"] = {"kernel": jnp.asarray(np.ascontiguousarray(raw["lm_head.weight"].T), dtype)}
     return params
+
+
+def _moe_ffn(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:
+    """Mixtral's routed-MoE FFN entries (``ffn`` hook for _map_llama). HF
+    stores per-layer ``block_sparse_moe.gate`` (the router, a Linear [E, h])
+    and per-expert ``experts.{e}.{w1,w3,w2}`` (gate/up/down in llama terms,
+    each nn.Linear [out, in]); edgemesh stacks them to router [L, h, E]
+    (fp32 — routing softmax islands stay fp32, ops/moe.py) and gate/up
+    [L, E, h, inter], down [L, E, inter, h]."""
+    L, E = cfg.num_layers, cfg.num_experts
+
+    def expert_stack(w: str) -> jnp.ndarray:
+        mats = [
+            [
+                np.ascontiguousarray(
+                    raw[f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"].T
+                )
+                for e in range(E)
+            ]
+            for i in range(L)
+        ]
+        return jnp.asarray(np.stack([np.stack(row) for row in mats]), dtype)
+
+    return {
+        "moe": {
+            "router": {
+                "kernel": _layer_stack(
+                    raw, "model.layers.{}.block_sparse_moe.gate.weight", L,
+                    jnp.float32, True,
+                )
+            },
+            "gate": expert_stack("w1"),
+            "up": expert_stack("w3"),
+            "down": expert_stack("w2"),
+        }
+    }
 
 
 def _map_neox(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype) -> Params:

@@ -154,15 +154,18 @@ def quantize_params_int4(params: Params, group_size: int = 64) -> Params:
         gs = group_size if kernel.shape[0] % max(group_size, 1) == 0 else 0
         return quantize_weight_int4(kernel, gs)
 
-    def walk(node):
+    def walk(node, path=()):
         if isinstance(node, dict):
+            if path[-1:] == ("router",):
+                # MoE router stays fp32 (same rationale as the int8 walk).
+                return node
             if "kernel" in node:
                 q, scales = quant(node["kernel"])
                 out: Params = {"kernel_q4": q, "scales": scales}
                 if "bias" in node:
                     out["bias"] = node["bias"]
                 return out
-            return {k: walk(v) for k, v in node.items()}
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
         return node
 
     # One jitted program for the whole pytree keeps every intermediate inside

@@ -65,6 +65,25 @@ def quantize_params(
 
     def walk(node, path=()):
         if isinstance(node, dict):
+            if path[-1:] == ("router",):
+                # MoE router stays fp32: routing softmax islands need full
+                # precision (ops/moe.py reads router.kernel directly, and a
+                # quantized argmax over near-tied experts flips routes).
+                return node
+            if path[-1:] == ("moe",):
+                # Expert FFN weights are the bulk of an MoE model (~96% of
+                # Mixtral-8x7B); they store as raw [L, E, in, out] arrays,
+                # not {"kernel"} dicts, so quantize them here. Same
+                # nn.Linear boundary as everywhere else — HF's experts ARE
+                # nn.Linear (w1/w2/w3). moe_mlp dequantizes in the expert
+                # matmul epilogue (w8a16 style).
+                out = {"router": node["router"]}
+                for name in ("gate", "up", "down"):
+                    if name in node:
+                        q, scales = quantize_weight(node[name])
+                        out[f"{name}_q"] = q
+                        out[f"{name}_scales"] = scales
+                return out
             if "kernel" in node:
                 kernel = node["kernel"]
                 if smooth_scales is not None:

@@ -105,6 +105,25 @@ def route_tokens(
     return combine, aux
 
 
+def _expert_mm(moe: Params, name: str, spec: str, x: jnp.ndarray) -> jnp.ndarray:
+    """Per-expert matmul over a (possibly int8-quantized) stacked weight.
+
+    Quantized experts ({name}_q int8 + {name}_scales [E, out], written by
+    ops/int8.quantize_params' moe branch) dequantize in the epilogue —
+    w8a16 style, same contract as int8.int8_matmul: the int8→dtype convert
+    feeds the MXU and the per-out-channel scale folds into the product."""
+    if f"{name}_q" in moe:
+        w_q = moe[f"{name}_q"]
+        # fp32 accumulate + fp32 scale fold, single cast at the end — the
+        # same numerics as int8.int8_matmul's epilogue (accumulating in
+        # bf16 would stack rounding on top of the int8 noise).
+        y = jnp.einsum(
+            spec, x, w_q.astype(x.dtype), preferred_element_type=jnp.float32
+        )
+        return (y * moe[f"{name}_scales"][:, None, :]).astype(x.dtype)
+    return jnp.einsum(spec, x, moe[name])
+
+
 def moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Routed FFN. x: [b, s, h] → ([b, s, h], scalar aux load-balance loss)."""
     b, s, h = x.shape
@@ -120,11 +139,11 @@ def moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.ndarray,
 
     if cfg.gated:
         hidden = _activate(
-            cfg, jnp.einsum("ech,ehi->eci", expert_in, moe["gate"])
-        ) * jnp.einsum("ech,ehi->eci", expert_in, moe["up"])
+            cfg, _expert_mm(moe, "gate", "ech,ehi->eci", expert_in)
+        ) * _expert_mm(moe, "up", "ech,ehi->eci", expert_in)
     else:
-        hidden = _activate(cfg, jnp.einsum("ech,ehi->eci", expert_in, moe["up"]))
-    expert_out = jnp.einsum("eci,eih->ech", hidden, moe["down"])  # [E, C, h]
+        hidden = _activate(cfg, _expert_mm(moe, "up", "ech,ehi->eci", expert_in))
+    expert_out = _expert_mm(moe, "down", "eci,eih->ech", hidden)  # [E, C, h]
 
     y = jnp.einsum(
         "tec,ech->th", combine.astype(cfg.activation_dtype), expert_out

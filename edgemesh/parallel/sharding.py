@@ -134,6 +134,16 @@ def quantized_pspecs(specs: Params) -> Params:
                 if "bias" in node:
                     out["bias"] = node["bias"]
                 return out
+            if "router" in node:  # MoE subtree (experts quantize in-place)
+                out = {"router": node["router"]}
+                for name in ("gate", "up", "down"):
+                    if name in node:
+                        spec = node[name]  # [L, E, in, out]
+                        out[name] = spec  # float experts (int4 path)
+                        out[f"{name}_q"] = spec
+                        # per-out-channel scales: spec minus the in dim
+                        out[f"{name}_scales"] = P(*spec[:-2], spec[-1])
+                return out
             return {k: walk(v) for k, v in node.items()}
         return node
 

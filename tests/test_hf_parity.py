@@ -21,8 +21,10 @@ from edgemesh.models.hf_ingest import config_from_checkpoint, load_params  # noq
 from edgemesh.models.transformer import forward_prefill, init_kv_cache  # noqa: E402
 
 
-def _compare(ckpt_dir, hf_model, seq=12, atol=2e-3):
-    cfg = config_from_checkpoint(ckpt_dir, dtype="float32", max_seq_len=64)
+def _compare(ckpt_dir, hf_model, seq=12, atol=2e-3, **cfg_overrides):
+    cfg = config_from_checkpoint(
+        ckpt_dir, dtype="float32", max_seq_len=64, **cfg_overrides
+    )
     cfg2, params = load_params(ckpt_dir, cfg)
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, size=(1, seq))
@@ -213,6 +215,33 @@ def test_mistral_sliding_window_parity(tmp_path):
         is_decode=False,
     )
     assert not np.allclose(np.asarray(ours[0, -1]), hf_logits[0, -1], atol=2e-3)
+
+
+def test_mixtral_moe_parity(tmp_path):
+    """Mixtral = mistral dialect with a routed-MoE FFN. Parity pins BOTH the
+    weight map (router transpose, per-expert w1/w3/w2 stacking) and the
+    routing math (softmax over all experts → top-k → renormalize, exactly
+    HF's MixtralSparseMoeBlock). Runs with the ingest-computed DEFAULT
+    capacity factor (E/k → capacity = num_tokens, dropless): HF drops no
+    tokens, so a regression that reintroduces GShard capacity drops fails
+    parity here."""
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    hf_cfg = MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, sliding_window=None,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(11)
+    model = MixtralForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path, dtype="float32")
+    assert cfg.num_experts == 4 and cfg.experts_per_token == 2
+    assert cfg.expert_capacity_factor == 2.0  # E/k: C = ceil(T/E*k*E/k) = T
+    _compare(tmp_path, model)
 
 
 def test_qwen2_parity(tmp_path):

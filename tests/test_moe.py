@@ -124,3 +124,93 @@ def test_expert_parallel_sharding_parity():
     )
     got, _ = forward_prefill(cfg, sharded, tokens, lengths, init_kv_cache(cfg, 1))
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=1e-5, rtol=1e-5)
+
+
+def test_moe_int8_quantization_experts_quantized_router_fp32():
+    """quantize_params on an MoE model must quantize the EXPERT weights
+    (they are ~96% of a Mixtral's parameters — leaving them float would
+    void the int8 memory story) while keeping the router fp32 (routing
+    softmax islands; moe_mlp reads router.kernel directly). The quantized
+    model's logits must stay close to float and it must generate."""
+    from edgemesh.config import SamplingParams
+    from edgemesh.ops.int8 import quantize_params
+    from edgemesh.runtime.generate import generate
+
+    cfg = _cfg(quant_mode="w8a16")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    q = quantize_params(params)
+    moe = q["layers"]["moe"]
+    assert "kernel" in moe["router"] and moe["router"]["kernel"].dtype == jnp.float32
+    assert "kernel_q" not in moe["router"]
+    for name in ("gate", "up", "down"):
+        assert name not in moe, f"float {name} left behind"
+        assert moe[f"{name}_q"].dtype == jnp.int8
+        # scales: [L, E, out] — kernel shape minus the contraction dim
+        assert moe[f"{name}_scales"].shape == (
+            params["layers"]["moe"][name].shape[0],
+            params["layers"]["moe"][name].shape[1],
+            params["layers"]["moe"][name].shape[3],
+        )
+    # Attention projections quantize as before.
+    assert "kernel_q" in q["layers"]["q"]
+    # Quantized logits stay close to float logits (w8a16 epilogue dequant).
+    tokens = jnp.array([[5, 9, 11, 42, 7]], jnp.int32)
+    lengths = jnp.array([5], jnp.int32)
+    ref, _ = forward_prefill(cfg, params, tokens, lengths, init_kv_cache(cfg, 1))
+    got, _ = forward_prefill(cfg, q, tokens, lengths, init_kv_cache(cfg, 1))
+    rel = np.linalg.norm(np.asarray(got) - np.asarray(ref)) / np.linalg.norm(np.asarray(ref))
+    assert rel < 0.05, rel
+    r = generate(cfg, q, tokens, lengths,
+                 SamplingParams(max_new_tokens=4, temperature=0.0))
+    assert np.isfinite(np.asarray(r.confidence)).all()
+
+    from edgemesh.ops.int4 import quantize_params_int4
+
+    q4 = quantize_params_int4(params)
+    assert "kernel" in q4["layers"]["moe"]["router"]
+    assert "kernel_q4" not in q4["layers"]["moe"]["router"]
+    # int4 keeps experts float (int8 is the MoE quant path).
+    assert "up" in q4["layers"]["moe"]
+
+
+def test_moe_int8_sharded_placement():
+    """shard_params on a quantized MoE tree: expert int8 kernels keep the
+    ep/tp expert sharding, scales drop the contraction axis, router stays
+    replicated."""
+    from edgemesh.ops.int8 import quantize_params
+    from edgemesh.parallel.mesh import build_mesh
+    from edgemesh.parallel.sharding import shard_params
+
+    cfg = _cfg()
+    params = quantize_params(init_params(cfg, jax.random.PRNGKey(0)))
+    mesh = build_mesh(dp=1, tp=2, ep=2)
+    sharded = shard_params(params, cfg, mesh)
+    moe = sharded["layers"]["moe"]
+    up_spec = moe["up_q"].sharding.spec
+    assert up_spec[1] == "ep", up_spec  # expert axis sharded
+    assert moe["up_scales"].sharding.spec[1] == "ep"
+
+
+def test_mixtral_tiny_generates_dense_and_paged():
+    """The mixtral family preset end-to-end: dense decode and the paged
+    backend produce finite outputs from the same MoE config."""
+    from edgemesh.config import SamplingParams
+    from edgemesh.runtime.generate import generate
+    from edgemesh.runtime.paged_generate import generate_paged
+
+    cfg = tiny_config(
+        "mixtral", num_heads=4, num_kv_heads=2, hidden_size=32,
+        intermediate_size=64, num_layers=2, vocab_size=64, max_seq_len=64,
+        num_experts=4, experts_per_token=2,
+    ).replace(dtype="float32")
+    assert cfg.gated and cfg.num_experts == 4
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    tokens = jnp.array([[5, 9, 11, 42]], jnp.int32)
+    lengths = jnp.array([4], jnp.int32)
+    sp = SamplingParams(max_new_tokens=5, temperature=0.0)
+    r_dense = generate(cfg, params, tokens, lengths, sp)
+    r_paged = generate_paged(cfg, params, tokens, lengths, sp, page_size=8)
+    assert np.isfinite(np.asarray(r_dense.confidence)).all()
+    np.testing.assert_array_equal(
+        np.asarray(r_dense.tokens), np.asarray(r_paged.tokens)
+    )
