@@ -47,9 +47,21 @@ class SamplingParams:
     temperature: float = 0.7
     top_k: int = 50
     top_p: float = 0.9
+    # min-p filtering (arXiv:2407.01082): drop tokens whose probability is
+    # below min_p x the top token's probability — a confidence-relative
+    # cutoff that adapts where fixed top-k/top-p over- or under-prune.
+    # 0 disables (the reference predates the technique).
+    min_p: float = 0.0
     repetition_penalty: float = 1.2
     do_sample: bool = True
     seed: int = 0
+
+    def __post_init__(self):
+        if not 0.0 <= self.min_p <= 1.0:
+            # min_p > 1 would mask even the argmax: every row goes NEG_INF
+            # and categorical degrades to a uniform draw over the vocab —
+            # silent garbage, so fail fast (HF's MinPLogitsWarper does too).
+            raise ValueError(f"min_p must be in [0, 1], got {self.min_p}")
 
     def greedy(self) -> "SamplingParams":
         return dataclasses.replace(self, do_sample=False)

@@ -62,6 +62,21 @@ def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     return jnp.where(logits < threshold, NEG_INF, logits)
 
 
+def apply_min_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """min-p filtering: keep tokens with prob >= p * max_prob. Scale-relative
+    (unlike top-p's mass-cumulative cutoff), so a confident distribution
+    prunes aggressively and a flat one keeps many candidates. Row order is
+    irrelevant — works on raw logits or a sorted candidate set alike."""
+    if p <= 0.0:
+        return logits
+    # prob_i >= p·max_prob  ⇔  logit_i >= max_logit + log(p): one
+    # max-reduce instead of a vocab-wide softmax on the decode hot path.
+    import math
+
+    threshold = jnp.max(logits, axis=-1, keepdims=True) + math.log(p)
+    return jnp.where(logits < threshold, NEG_INF, logits)
+
+
 def _top_p_on_sorted(sorted_logits: jnp.ndarray, p: float) -> jnp.ndarray:
     """Nucleus mask over an already descending-sorted candidate row: identical
     maths to ``apply_top_p`` minus the vocab-wide sort."""
@@ -106,6 +121,7 @@ def sample_token(
         return jnp.argmax(logits, axis=-1)
     if params.temperature != 1.0:
         logits = logits / max(params.temperature, 1e-6)
+    logits = apply_min_p(logits, params.min_p)
     logits = apply_top_p(logits, params.top_p)  # no top-k: vocab-wide nucleus
     return jax.random.categorical(rng, logits, axis=-1)
 
@@ -141,6 +157,7 @@ def filtered_candidates(
     if params.temperature != 1.0:
         logits = logits / max(params.temperature, 1e-6)
     vals, idx = jax.lax.top_k(logits, params.top_k)
+    vals = apply_min_p(vals, params.min_p)  # row-order-free: sorted view ok
     vals = _top_p_on_sorted(vals, params.top_p)
     probs = jax.nn.softmax(vals, axis=-1)
     probs = jnp.where(vals > NEG_INF / 2, probs, 0.0)

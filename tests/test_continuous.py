@@ -2,6 +2,8 @@
 
 import time
 
+import jax
+
 import pytest
 
 from edgemesh.agents.orchestrator import build_agent
@@ -220,3 +222,40 @@ def test_paged_prefix_sharing_maps_template_pages():
         assert _wait_drained(eng) == 0
     finally:
         eng.close()
+
+
+def test_engine_over_tp_sharded_params_matches_single_device():
+    """The continuous engine over TP-sharded params: the jitted segment and
+    admission programs ride GSPMD transparently (params carry
+    NamedShardings; XLA inserts the collectives), and greedy tokens match
+    the unsharded engine exactly."""
+    from edgemesh.parallel.mesh import build_mesh
+
+    spec = AgentSpec(
+        role="qa",
+        model=ModelSpec(
+            family="llama", vocab_size=260, num_layers=2, hidden_size=64,
+            num_heads=4, num_kv_heads=2, intermediate_size=128, max_seq_len=128,
+        ),
+        sampling=SamplingParams(max_new_tokens=8, do_sample=False,
+                                repetition_penalty=1.0),
+    )
+    plain = build_agent(spec)
+    mesh = build_mesh(dp=1, tp=2)
+    sharded = build_agent(spec, mesh=mesh)
+    assert any(
+        getattr(leaf, "sharding", None) is not None
+        and getattr(leaf.sharding, "spec", None) is not None
+        for leaf in jax.tree.leaves(sharded.params)
+    )
+    q = "what color is the sky on a clear day?"
+    eng_a = ContinuousEngine(plain, slots=2, chunk=4, kv_backend="dense")
+    eng_b = ContinuousEngine(sharded, slots=2, chunk=4, kv_backend="dense")
+    try:
+        a = eng_a.answer(q)
+        b = eng_b.answer(q)
+        assert a["answer"] == b["answer"]
+        assert a["generated"] == b["generated"] > 0
+    finally:
+        eng_a.close()
+        eng_b.close()

@@ -95,3 +95,46 @@ def test_top_p_zero_degenerates_to_argmax_with_top_k():
     p = SamplingParams(do_sample=True, top_k=3, top_p=0.0, temperature=1.0, repetition_penalty=1.0)
     for seed in range(5):
         assert int(sample_token(jax.random.PRNGKey(seed), logits, p)[0]) == 1
+
+
+def test_min_p_filters_relative_to_top():
+    """min-p keeps tokens with prob >= p * max_prob; softmax RATIOS are
+    invariant to support restriction (exp of logit differences), so the
+    candidate-set path and the vocab-wide path must agree exactly."""
+    from edgemesh.ops.sampling import NEG_INF, apply_min_p, filtered_candidates
+
+    logits = jnp.log(jnp.array([[0.5, 0.25, 0.2, 0.04, 0.01]]))
+    out = apply_min_p(logits, 0.1)  # threshold 0.05: keeps 0.5/0.25/0.2
+    kept = np.asarray(out[0]) > NEG_INF / 2
+    np.testing.assert_array_equal(kept, [True, True, True, False, False])
+    # p=0 disables
+    np.testing.assert_array_equal(np.asarray(apply_min_p(logits, 0.0)), np.asarray(logits))
+
+    # Candidate path: same keep set inside the top-k view.
+    sp = SamplingParams(do_sample=True, top_k=4, top_p=1.0, min_p=0.1,
+                        temperature=1.0, repetition_penalty=1.0)
+    idx, probs = filtered_candidates(logits, sp)
+    p = np.asarray(probs[0])
+    assert (p[:3] > 0).all() and p[3] == 0.0  # 0.04 filtered within top-4
+    np.testing.assert_allclose(p[:3], [0.5/0.95, 0.25/0.95, 0.2/0.95], rtol=1e-5)
+
+
+def test_min_p_generate_end_to_end():
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_params
+    from edgemesh.runtime.generate import generate
+
+    cfg = tiny_config("llama")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.array([[5, 9, 11]], jnp.int32)
+    sp = SamplingParams(max_new_tokens=5, do_sample=True, min_p=0.2,
+                        temperature=0.8)
+    r = generate(cfg, params, tokens, jnp.array([3]), sp)
+    assert int(jnp.sum(r.num_generated)) == 5
+
+
+def test_min_p_out_of_range_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="min_p"):
+        SamplingParams(min_p=1.5)
