@@ -87,6 +87,34 @@ _prefill_paged_at_donated = partial(
     jax.jit, static_argnums=(0,), donate_argnums=(4,)
 )(forward_prefill_paged_at.__wrapped__)
 
+# Donated variant of the speculative round loop for the speculative engine:
+# the _SpecState carry holds BOTH page pools — without donation every
+# segment would copy them. Same static args as the original jit
+# (runtime/speculative._spec_rounds); arg 10 is the state.
+from edgemesh.runtime.speculative import _spec_rounds  # noqa: E402
+
+_spec_rounds_donated = partial(
+    jax.jit, static_argnums=(0, 1, 4, 5, 6, 7, 8, 9, 12, 13),
+    donate_argnums=(10,),
+)(_spec_rounds.__wrapped__)
+
+
+def _prefill_into_row(cfg, params, tokens, lengths, cache, idx: int):
+    """Cold zero-copy paged admission: prefill through a donated one-row
+    VIEW of the shared pool (slot ``idx``'s page-table row + the shared
+    pages) and splice the resulting table/length entries back. Used by the
+    base engine's cold path and by BOTH of the speculative engine's pools —
+    one definition of the donation/splice contract."""
+    row_view = cache._replace(
+        page_table=cache.page_table[idx : idx + 1],
+        lengths=jnp.zeros((1,), jnp.int32),
+    )
+    logits1, row = _prefill_paged_donated(cfg, params, tokens, lengths, row_view)
+    return logits1, row._replace(
+        page_table=cache.page_table.at[idx].set(row.page_table[0]),
+        lengths=cache.lengths.at[idx].set(row.lengths[0]),
+    )
+
 
 @partial(jax.jit, donate_argnums=(0,))
 def _copy_page(pages, src, dst):
@@ -121,6 +149,10 @@ class _Slot:
     t_submit: float = 0.0
     t_start: float = 0.0
     pages_reserved: int = 0  # paged backends: worst-case pages held
+    # Speculative engine: how many of the row's accumulated out-tokens have
+    # already been emitted (the spec state's `out` grows in place; the
+    # dense loop's per-segment buffers need no such cursor).
+    taken: int = 0
 
     @property
     def active(self) -> bool:
@@ -325,13 +357,13 @@ class ContinuousEngine:
                         jnp.asarray([match], jnp.int32),
                     )
                     self.shared_prefix_hits += 1
-                else:
-                    row_view = self._cache._replace(
-                        page_table=self._cache.page_table[idx : idx + 1],
-                        lengths=jnp.zeros((1,), jnp.int32),
+                    cache = row._replace(
+                        page_table=self._cache.page_table.at[idx].set(row.page_table[0]),
+                        lengths=self._cache.lengths.at[idx].set(row.lengths[0]),
                     )
-                    logits1, row = _prefill_paged_donated(
-                        self.cfg, agent.params, tokens, lengths, row_view
+                else:
+                    logits1, cache = _prefill_into_row(
+                        self.cfg, agent.params, tokens, lengths, self._cache, idx
                     )
             except Exception:
                 # The donated pool buffers may already be invalidated — a
@@ -342,10 +374,7 @@ class ContinuousEngine:
                     RuntimeError("page pool reset after a failed admission prefill")
                 )
                 raise
-            self._cache = row._replace(
-                page_table=self._cache.page_table.at[idx].set(row.page_table[0]),
-                lengths=self._cache.lengths.at[idx].set(row.lengths[0]),
-            )
+            self._cache = cache
             valid = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
             mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
             self._logits = self._logits.at[idx].set(logits1[0].astype(self._logits.dtype))
@@ -477,20 +506,8 @@ class ContinuousEngine:
         docstring: 'the host rebuilds the stack between serving batches'):
         free = every physical page no table row references. Runs at every
         segment boundary — O(total_pages) numpy work."""
-        table = np.asarray(self._cache.page_table)
-        used = np.unique(np.concatenate([
-            table[table > 0].astype(np.int32),
-            np.asarray(self._template_pages, np.int32),  # permanent
-        ]))
-        free = np.setdiff1d(
-            np.arange(1, self.total_pages, dtype=np.int32), used
-        )
-        stack = np.zeros((self.total_pages,), np.int32)
-        top = self.total_pages - free.size
-        stack[top:] = free
-        self._cache = self._cache._replace(
-            free_stack=jnp.asarray(stack),
-            free_top=jnp.asarray(top, jnp.int32),
+        self._cache = _with_rebuilt_stack(
+            self._cache, self.total_pages, self._template_pages
         )
 
     def _reset_pool(self, exc: Exception) -> None:
@@ -553,6 +570,49 @@ class ContinuousEngine:
         self._slots[idx] = _Slot()
         self._finished = self._finished.at[idx].set(True)
 
+    def _run_segment(self, active: list[int], eos_id: int) -> None:
+        """One pool-wide decode segment + emit/retire bookkeeping. Segment
+        length is ALWAYS ``chunk`` so _decode_loop compiles exactly once; a
+        row whose budget ends mid-segment overshoots by < chunk forwards
+        and the extras are trimmed host-side. Overridden by the speculative
+        engine with draft→verify rounds."""
+        agent = self.agent
+        self._rng, seg_rng = jax.random.split(self._rng)
+        out, counts, self._cache, _, self._mask, prev, fin = _decode_loop(
+            self.cfg, agent.params, agent.sampling, self.chunk, eos_id,
+            self._logits, self._cache, self._mask, seg_rng,
+            self._decode_fn, self._finished,
+        )
+        self.segments += 1
+        # Single pytree fetch: one blocking round trip per segment
+        # instead of three (each ~0.13s on the tunneled platform).
+        counts_h, out_h, fin_h = jax.device_get((counts, out, fin))
+        self._finished = fin
+        for i in active:
+            slot = self._slots[i]
+            n = min(int(counts_h[i]), max(slot.remaining, 0))
+            toks = [int(t) for t in out_h[i][:n]]
+            if toks and toks[-1] == eos_id:
+                toks = toks[:-1]
+            slot.emitted.extend(toks)
+            slot.remaining -= n
+            if bool(fin_h[i]) or slot.remaining <= 0:
+                self._retire(i)
+
+        # Bridge into the next segment for rows still going (the loop
+        # stops before a wasted trailing forward; run it for the batch).
+        # This whole-batch step also advances lengths / writes one KV
+        # row for retired and idle slots — garbage BY DESIGN: idle-slot
+        # state is meaningless until _splice_slot resets lengths on
+        # admission, and writes clamp at capacity. Do not read idle
+        # rows' lengths as if they tracked anything.
+        if any(s.active for s in self._slots):
+            decode_fn = self._decode_fn or forward_decode
+            logits, self._cache = decode_fn(self.cfg, agent.params, prev, self._cache)
+            self._logits = logits.astype(self._logits.dtype)
+        if self.kv_backend != "dense":
+            self._sweep_idle_pages()
+
     def _run(self) -> None:
         agent = self.agent
         eos_id = int(getattr(agent.tokenizer, "eos_id", -1))
@@ -598,47 +658,10 @@ class ContinuousEngine:
                 continue
 
             # One decode segment over the whole pool; idle rows are finished.
-            # Segment length is ALWAYS ``chunk`` so _decode_loop compiles
-            # exactly once; a row whose budget ends mid-segment overshoots by
-            # < chunk forwards and the extras are trimmed host-side. A
-            # failure anywhere in the segment must not kill the worker —
+            # A failure anywhere in the segment must not kill the worker —
             # fail the in-flight futures, reset the pool, keep serving.
             try:
-                self._rng, seg_rng = jax.random.split(self._rng)
-                out, counts, self._cache, _, self._mask, prev, fin = _decode_loop(
-                    self.cfg, agent.params, agent.sampling, self.chunk, eos_id,
-                    self._logits, self._cache, self._mask, seg_rng,
-                    self._decode_fn, self._finished,
-                )
-                self.segments += 1
-                # Single pytree fetch: one blocking round trip per segment
-                # instead of three (each ~0.13s on the tunneled platform).
-                counts_h, out_h, fin_h = jax.device_get((counts, out, fin))
-                self._finished = fin
-                for i in active:
-                    slot = self._slots[i]
-                    n = min(int(counts_h[i]), max(slot.remaining, 0))
-                    toks = [int(t) for t in out_h[i][:n]]
-                    if toks and toks[-1] == eos_id:
-                        toks = toks[:-1]
-                    slot.emitted.extend(toks)
-                    slot.remaining -= n
-                    if bool(fin_h[i]) or slot.remaining <= 0:
-                        self._retire(i)
-
-                # Bridge into the next segment for rows still going (the loop
-                # stops before a wasted trailing forward; run it for the batch).
-                # This whole-batch step also advances lengths / writes one KV
-                # row for retired and idle slots — garbage BY DESIGN: idle-slot
-                # state is meaningless until _splice_slot resets lengths on
-                # admission, and writes clamp at capacity. Do not read idle
-                # rows' lengths as if they tracked anything.
-                if any(s.active for s in self._slots):
-                    decode_fn = self._decode_fn or forward_decode
-                    logits, self._cache = decode_fn(self.cfg, agent.params, prev, self._cache)
-                    self._logits = logits.astype(self._logits.dtype)
-                if self.kv_backend != "dense":
-                    self._sweep_idle_pages()
+                self._run_segment(active, eos_id)
             except Exception as exc:
                 log.exception("decode segment failed; failing %d in-flight requests", len(active))
                 self._reset_pool(exc)
@@ -648,3 +671,316 @@ class ContinuousEngine:
             with self._cond:
                 if not self._queue and any(s.active for s in self._slots):
                     self._cond.wait(timeout=0.001)
+
+
+def _with_rebuilt_stack(cache, total_pages: int, permanent, table=None) -> "PagedKVCache":
+    """free = every physical page referenced by no table row (and not
+    permanent, e.g. template pages). Shared by the target and draft pools.
+    ``table`` lets a caller that already fetched (and host-side mutated)
+    the page table skip a second blocking device readback."""
+    if table is None:
+        table = np.asarray(cache.page_table)
+    used = np.unique(np.concatenate([
+        table[table > 0].astype(np.int32),
+        np.asarray(list(permanent), np.int32),
+    ]))
+    free = np.setdiff1d(np.arange(1, total_pages, dtype=np.int32), used)
+    stack = np.zeros((total_pages,), np.int32)
+    top = total_pages - free.size
+    stack[top:] = free
+    return cache._replace(
+        free_stack=jnp.asarray(stack),
+        free_top=jnp.asarray(top, jnp.int32),
+    )
+
+
+class SpeculativeContinuousEngine(ContinuousEngine):
+    """Continuous batching WITH speculative decoding over the paged pool.
+
+    Each segment runs up to ``chunk // (gamma+1)`` pool-wide draft→verify
+    rounds in ONE jitted program (``runtime.speculative._spec_rounds`` — the
+    same body the standalone and streaming speculative paths use), so every
+    request in flight gets draft acceleration while requests still join and
+    leave at segment boundaries. Both models' KV live as page pools; the
+    verify rewind is a lengths rollback, safe on pages because the allocator
+    reuses table entries on re-advance (rewind-idempotent).
+
+    Contracts beyond the base engine:
+    - paged backend only, and the agent must carry a draft
+      (``AgentSpec.draft``) sharing the target's tokenizer/vocab.
+    - uniform budget: every request decodes up to
+      ``sampling.max_new_tokens``; a prompt too long for
+      prompt + budget + gamma + 1 tokens in one table row is refused at
+      admission (the dense engine clamps instead — spec rounds share one
+      static max_new).
+    - admissions are always cold (no template prefix sharing: the draft
+      pool holds no template KV, and a warm target + cold draft would
+      desynchronize the verify positions).
+    - emitted text is the target distribution exactly — greedy spec serving
+      is token-identical to the plain engine (pinned in tests).
+    """
+
+    def __init__(
+        self,
+        agent,
+        slots: int = 8,
+        chunk: int = 16,
+        idle_wait_s: float = 0.005,
+        kv_backend: str = "paged",
+        page_size: int = 64,
+        total_pages: int | None = None,
+        draft_total_pages: int | None = None,
+    ):
+        if getattr(agent, "draft_cfg", None) is None:
+            raise ValueError(
+                "SpeculativeContinuousEngine needs an agent with a draft "
+                "model (AgentSpec.draft)"
+            )
+        if kv_backend != "paged":
+            raise ValueError(
+                f"speculative continuous batching runs on kv_backend='paged' "
+                f"(got {kv_backend!r})"
+            )
+        sp = agent.sampling
+        if sp.do_sample and not 0 < sp.top_k < agent.cfg.vocab_size:
+            # The standalone spec path validates this up front
+            # (runtime/speculative._spec_prefill); without the check here,
+            # the FIRST segment would hit filtered_candidates' error inside
+            # the worker, reset the pool, and fail every admitted request —
+            # forever, batch after batch.
+            raise ValueError(
+                "speculative sampling needs bounded support: set top_k in "
+                f"[1, vocab) (got {sp.top_k})"
+            )
+        if int(agent.spec_gamma) < 1:
+            raise ValueError(f"spec_gamma must be >= 1, got {agent.spec_gamma}")
+        super().__init__(
+            agent, slots=slots, chunk=chunk, idle_wait_s=idle_wait_s,
+            kv_backend=kv_backend, page_size=page_size, total_pages=total_pages,
+        )
+        from edgemesh.runtime.speculative import _spec_fns
+
+        self.gamma = int(agent.spec_gamma)
+        self.max_new = int(agent.sampling.max_new_tokens)
+        self.cap = self.max_new + self.gamma + 1
+        self.rounds_per_segment = max(1, self.chunk // (self.gamma + 1))
+        self._verify_fn, self._spec_decode_fn = _spec_fns("paged")
+        per_row = self._cache.page_table.shape[1]
+        self._d_total = int(draft_total_pages or self.total_pages)
+        d_cfg = agent.draft_cfg
+        self._init_dpool = lambda: init_paged_cache(
+            d_cfg, self.n_slots, total_pages=self._d_total,
+            page_size=self.page_size, max_pages=per_row,
+        )
+        self._dcache = self._init_dpool()
+        self._dreserved = 0
+        self._spec_reset_arrays()
+
+    def _spec_reset_arrays(self) -> None:
+        b = self.n_slots
+        self._pending = jnp.zeros((b,), jnp.int32)
+        self._out = jnp.zeros((b, self.cap), jnp.int32)
+        self._nemit = jnp.zeros((b,), jnp.int32)
+        self._conf = jnp.zeros((b,), jnp.float32)
+        self._acc = jnp.zeros((), jnp.int32)
+        self._prop = jnp.zeros((), jnp.int32)
+        self._rnds = jnp.zeros((), jnp.int32)
+        # Host mirror of (accepted, proposed, rounds), refreshed by the
+        # worker inside each segment's bulk fetch. stats() reads ONLY this:
+        # the device counters are donated every segment, so touching them
+        # from another thread (REST /metrics) races use-after-donate.
+        self._spec_counters_host = (0, 0, 0)
+
+    # Spec admissions are always cold — see the class docstring.
+    def _ensure_template(self) -> None:
+        return
+
+    @property
+    def _segment_pages(self) -> int:
+        """Idle rows never ADVANCE lengths in spec rounds (the body masks
+        inactive rows' commits), but the draft step writes one position and
+        the verify chunk writes gamma+1 at the row's frozen position —
+        rewind-idempotent table entries, so the bound is one chunk's pages
+        + a boundary page, reclaimed by the sweep each segment."""
+        return -(-(self.gamma + 2) // self.page_size) + 1
+
+    def _admit(self, idx: int, question: str, fut: Future, t_submit: float,
+               mid_flight: bool) -> bool:
+        from edgemesh.ops.sampling import sample_token
+
+        agent = self.agent
+        eos_id = int(getattr(agent.tokenizer, "eos_id", -1))
+        prompt = agent.format_prompt(question)
+        tokens, lengths, _ = agent._prepare_batch([prompt])
+        plen = int(lengths[0])
+        row_cap = self._cache.page_table.shape[1] * self.page_size
+        if plen + self.max_new + self.gamma + 1 > row_cap:
+            raise ValueError(
+                f"prompt ({plen} tokens) + budget ({self.max_new}) + "
+                f"gamma+1 ({self.gamma + 1}) exceeds the row capacity "
+                f"({row_cap}); the speculative engine keeps one uniform "
+                "budget per pool"
+            )
+        # Worst-case pages per pool: the verify chunk transiently writes
+        # gamma+1 tokens past the committed length before the rewind.
+        need = -(-(plen + self.max_new + self.gamma + 1) // self.page_size) + 1
+        idle_after = sum(1 for s in self._slots if not s.active) - 1
+        headroom = idle_after * self._segment_pages
+        slack = (self.n_slots - 1) * self._segment_pages
+        avail_t = self.total_pages - 1
+        avail_d = self._d_total - 1
+        if need + slack > min(avail_t, avail_d):
+            raise ValueError(
+                f"request needs {need} pages (prompt {plen} + budget "
+                f"{self.max_new} + gamma overshoot); the pools hold "
+                f"{min(avail_t, avail_d)} minus idle-slot headroom"
+            )
+        if (self._reserved_pages + need + headroom > avail_t
+                or self._dreserved + need + headroom > avail_d):
+            return False  # capacity — re-queue, admit at a later boundary
+
+        try:
+            logits1, self._cache = _prefill_into_row(
+                self.cfg, agent.params, tokens, lengths, self._cache, idx
+            )
+            _, self._dcache = _prefill_into_row(
+                agent.draft_cfg, agent.draft_params, tokens, lengths,
+                self._dcache, idx,
+            )
+        except Exception:
+            self._reset_pool(
+                RuntimeError("page pools reset after a failed speculative admission")
+            )
+            raise
+
+        valid = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
+        mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
+        self._rng, r0 = jax.random.split(self._rng)
+        token0 = sample_token(r0, logits1, agent.sampling, mask1).astype(jnp.int32)
+        mask1 = TokenMaskState(mask1).add(token0).mask
+        conf0 = jnp.max(jax.nn.softmax(logits1.astype(jnp.float32), axis=-1), axis=-1)
+        out_row = jnp.full((self.cap,), eos_id, jnp.int32).at[0].set(token0[0])
+        self._pending = self._pending.at[idx].set(token0[0])
+        self._out = self._out.at[idx].set(out_row)
+        self._nemit = self._nemit.at[idx].set(1)
+        self._conf = self._conf.at[idx].set(conf0[0])
+        self._mask = self._mask.at[idx].set(mask1[0])
+        self._finished = self._finished.at[idx].set(token0[0] == eos_id)
+        self._reserved_pages += need
+        self._dreserved += need
+        self._slots[idx] = _Slot(
+            future=fut, question=question, emitted=[], remaining=self.max_new,
+            t_submit=t_submit, t_start=time.perf_counter(),
+            pages_reserved=need,
+        )
+        if mid_flight:
+            self.admitted_mid_flight += 1
+        return True
+
+    def _run_segment(self, active: list[int], eos_id: int) -> None:
+        from edgemesh.runtime.speculative import _SpecState
+
+        agent = self.agent
+        self._rng, seg_rng = jax.random.split(self._rng)
+        state = _SpecState(
+            pending=self._pending, t_cache=self._cache, d_cache=self._dcache,
+            out=self._out, n_emit=self._nemit, finished=self._finished,
+            mask=self._mask, rng=seg_rng, conf_sum=self._conf,
+            accepted=self._acc, proposed=self._prop, rounds=self._rnds,
+        )
+        state = _spec_rounds_donated(
+            self.cfg, agent.draft_cfg, agent.params, agent.draft_params,
+            agent.sampling, self.gamma, self.max_new, eos_id,
+            self.cfg.vocab_size, self.cap, state,
+            jnp.asarray(self.rounds_per_segment, jnp.int32),
+            self._verify_fn, self._spec_decode_fn,
+        )
+        (self._pending, self._cache, self._dcache, self._out, self._nemit,
+         self._finished, self._mask, _, self._conf, self._acc, self._prop,
+         self._rnds) = state
+        self.segments += 1
+        nemit_h, out_h, fin_h, acc_h, prop_h, rnds_h = jax.device_get(
+            (state.n_emit, state.out, state.finished,
+             state.accepted, state.proposed, state.rounds)
+        )
+        self._spec_counters_host = (int(acc_h), int(prop_h), int(rnds_h))
+        for i in active:
+            slot = self._slots[i]
+            total = min(int(nemit_h[i]), self.max_new)
+            toks = [int(t) for t in out_h[i][slot.taken : total]]
+            if toks and toks[-1] == eos_id:
+                toks = toks[:-1]
+            slot.emitted.extend(toks)
+            slot.taken = total
+            slot.remaining = self.max_new - total
+            if bool(fin_h[i]) or total >= self.max_new:
+                self._retire(i)
+        self._sweep_idle_pages()
+
+    def _retire(self, idx: int) -> None:
+        reserved = self._slots[idx].pages_reserved  # same need in both pools
+        super()._retire(idx)
+        self._dreserved -= reserved
+        self._dcache = self._dcache._replace(
+            page_table=self._dcache.page_table.at[idx].set(0),
+            lengths=self._dcache.lengths.at[idx].set(0),
+        )
+
+    def _sweep_idle_pages(self) -> None:
+        # ONE bulk fetch for both tables; the reclaim loop mirrors its
+        # zeroing onto the host copies so the rebuilds can reuse them
+        # instead of re-reading the device (each readback ~0.13s tunneled).
+        table, dtable = jax.device_get(
+            (self._cache.page_table, self._dcache.page_table)
+        )
+        # device_get hands back read-only views; the loop mutates them.
+        table, dtable = np.array(table), np.array(dtable)
+        for i, s in enumerate(self._slots):
+            if not s.active:
+                if (table[i] > 0).any():
+                    self._reclaim_pages(i)
+                    table[i] = 0
+                if (dtable[i] > 0).any():
+                    self._dcache = self._dcache._replace(
+                        page_table=self._dcache.page_table.at[i].set(0),
+                        lengths=self._dcache.lengths.at[i].set(0),
+                    )
+                    dtable[i] = 0
+        self._cache = _with_rebuilt_stack(
+            self._cache, self.total_pages, self._template_pages, table=table
+        )
+        self._dcache = _with_rebuilt_stack(
+            self._dcache, self._d_total, (), table=dtable
+        )
+
+    def _reset_pool(self, exc: Exception) -> None:
+        super()._reset_pool(exc)
+        # Every donated spec buffer may be invalid; rebuild them all (the
+        # cumulative accept/propose counters reset with the pool).
+        self._dcache = self._init_dpool()
+        self._dreserved = 0
+        self._spec_reset_arrays()
+
+    def stats(self) -> dict:
+        out = super().stats()
+        acc, prop, rnds = self._spec_counters_host
+        out["gamma"] = self.gamma
+        out["rounds_per_segment"] = self.rounds_per_segment
+        out["spec_proposed"] = prop
+        out["spec_accepted"] = acc
+        out["spec_rounds"] = rnds
+        out["draft_total_pages"] = self._d_total
+        return out
+
+
+def make_engine(agent, **kwargs):
+    """Engine factory: a draft-carrying agent on the paged backend gets the
+    speculative engine; everything else gets the plain one. (An explicit
+    class choice always works too — this is the convenience entry the REST
+    server uses.)"""
+    if (
+        getattr(agent, "draft_cfg", None) is not None
+        and kwargs.get("kv_backend", "dense") == "paged"
+    ):
+        return SpeculativeContinuousEngine(agent, **kwargs)
+    return ContinuousEngine(agent, **kwargs)

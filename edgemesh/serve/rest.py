@@ -174,7 +174,7 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
             "the flag for the dense batched paths"
         )
     if continuous:
-        from edgemesh.serve.continuous import ContinuousEngine
+        from edgemesh.serve.continuous import make_engine
 
         if supervisor is not None:
             raise ValueError(
@@ -189,7 +189,9 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
                 f"{' + refiner' if ensemble.refiner else ''}); use --batch "
                 "for multi-agent ensembles"
             )
-        batcher = ContinuousEngine(
+        # A draft-carrying agent on the paged backend gets the speculative
+        # engine (pool-wide draft→verify rounds); otherwise the plain one.
+        batcher = make_engine(
             ensemble.qa_agents[0], slots=batch or 8, kv_backend=kv_backend,
             page_size=kv_page_size,
         )

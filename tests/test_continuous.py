@@ -259,3 +259,81 @@ def test_engine_over_tp_sharded_params_matches_single_device():
     finally:
         eng_a.close()
         eng_b.close()
+
+
+def _spec_agent(max_new=8, gamma=2):
+    return build_agent(AgentSpec(
+        role="qa",
+        model=ModelSpec(family="llama", vocab_size=260, num_layers=2,
+                        hidden_size=64, num_heads=4, num_kv_heads=2,
+                        intermediate_size=128, max_seq_len=128),
+        draft=ModelSpec(family="llama", vocab_size=260, num_layers=1,
+                        hidden_size=64, num_heads=4, num_kv_heads=2,
+                        intermediate_size=128, max_seq_len=128),
+        spec_gamma=gamma,
+        sampling=SamplingParams(max_new_tokens=max_new, do_sample=False,
+                                repetition_penalty=1.0),
+    ))
+
+
+def test_speculative_engine_greedy_matches_plain_engine():
+    """Speculative continuous batching emits the target's distribution
+    exactly: under greedy decoding the spec engine's answers are
+    token-identical to the plain paged engine's, including concurrent
+    requests joining mid-flight."""
+    from edgemesh.serve.continuous import (
+        ContinuousEngine,
+        SpeculativeContinuousEngine,
+    )
+
+    agent = _spec_agent()
+    plain = ContinuousEngine(agent, slots=4, chunk=4, kv_backend="paged",
+                             page_size=16)
+    spec = SpeculativeContinuousEngine(agent, slots=4, chunk=6,
+                                       kv_backend="paged", page_size=16)
+    qs = [f"question number {i}: where is the eiffel tower?" for i in range(6)]
+    try:
+        ref = [f.result() for f in [plain.submit(q) for q in qs]]
+        got = [f.result() for f in [spec.submit(q) for q in qs]]
+        for r, g in zip(ref, got):
+            assert g["answer"] == r["answer"], (g["answer"], r["answer"])
+            assert g["generated"] == r["generated"]
+        st = spec.stats()
+        assert st["spec_rounds"] > 0 and st["spec_proposed"] > 0
+        assert st["gamma"] == 2 and st["kv_backend"] == "paged"
+    finally:
+        plain.close()
+        spec.close()
+
+
+def test_speculative_engine_guards_and_factory():
+    from edgemesh.serve.continuous import (
+        ContinuousEngine,
+        SpeculativeContinuousEngine,
+        make_engine,
+    )
+
+    agent = _spec_agent()
+    with pytest.raises(ValueError, match="kv_backend='paged'"):
+        SpeculativeContinuousEngine(agent, kv_backend="dense")
+    plain_agent = build_agent(AgentSpec(
+        role="qa",
+        model=ModelSpec(family="llama", vocab_size=260, num_layers=2,
+                        hidden_size=64, num_heads=4, num_kv_heads=2,
+                        intermediate_size=128, max_seq_len=128),
+        sampling=SamplingParams(max_new_tokens=8, do_sample=False,
+                                repetition_penalty=1.0),
+    ))
+    with pytest.raises(ValueError, match="draft"):
+        SpeculativeContinuousEngine(plain_agent)
+    eng = make_engine(agent, kv_backend="paged", slots=2, chunk=4, page_size=16)
+    try:
+        assert isinstance(eng, SpeculativeContinuousEngine)
+    finally:
+        eng.close()
+    eng2 = make_engine(plain_agent, kv_backend="paged", slots=2, chunk=4,
+                       page_size=16)
+    try:
+        assert type(eng2) is ContinuousEngine
+    finally:
+        eng2.close()
