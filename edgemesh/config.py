@@ -82,7 +82,7 @@ class ModelSpec:
     # HF hub id for `edgemesh download --src <hub-cache>` materialization
     # (e.g. "microsoft/phi-2"); defaults to the basename of ``path``.
     hub_id: str = ""
-    family: str = "auto"  # auto | llama | neox | phi2 | mistral | mixtral | qwen2 | gemma | gemma2 | phi3 | falcon | gpt2
+    family: str = "auto"  # auto | llama | neox | phi2 | mistral | mixtral | qwen2 | qwen3 | gemma | gemma2 | phi3 | falcon | gpt2
     # bf16 | fp16 | fp32 | int8 (weight-only w8a16) | int8_w8a8 (dynamic
     # activation quant, int8xint8 MXU) | int8_w8a8_pallas (fused kernel) |
     # int8_w8a8_pallas_pre (activations pre-quantized in XLA, int8-in

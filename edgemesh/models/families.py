@@ -98,6 +98,21 @@ FAMILY_PRESETS: dict[str, dict] = {
         lm_head_bias=False,
         tie_embeddings=True,
     ),
+    # Qwen3: the llama dialect with per-head QK-RMSNorm (before RoPE)
+    # replacing qwen2's qkv biases; explicit head_dim; small variants tie
+    # embeddings (checkpoint's tie_word_embeddings decides).
+    "qwen3": dict(
+        norm="rms",
+        activation="silu",
+        parallel_block=False,
+        shared_input_norm=False,
+        rotary_fraction=1.0,
+        qkv_bias=False,
+        out_bias=False,
+        lm_head_bias=False,
+        tie_embeddings=True,
+        qk_norm=True,
+    ),
     # Phi-3: the llama dialect (RMSNorm/SwiGLU/GQA/full rotary, no biases,
     # untied head) with FUSED qkv_proj and gate_up_proj checkpoint weights
     # (split at ingest) and an always-on sliding window (mini-4k: 2047).
@@ -191,6 +206,7 @@ _HF_MODEL_TYPE_TO_FAMILY = {
     "mistral": "mistral",
     "mixtral": "mixtral",
     "qwen2": "qwen2",
+    "qwen3": "qwen3",
     "gemma": "gemma",
     "gemma2": "gemma2",
     "phi3": "phi3",

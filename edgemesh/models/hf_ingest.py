@@ -9,9 +9,9 @@ layer axis, and ``jax.device_put`` the tree into (sharded) HBM
 
 Name maps cover the reference's three model families (ACL paper §4.2) —
 Llama (Llama-3.2-1B-Instruct), GPT-NeoX (Pythia-1B), Phi (Phi-2) — plus
-Mistral, Mixtral (routed MoE), Qwen2, Gemma, Gemma-2, Phi-3, GPT-2, and
-Falcon (families.py registry; each pinned against HF logits in
-tests/test_hf_parity.py).
+Mistral, Mixtral (routed MoE), Qwen2, Qwen3 (QK-norm), Gemma, Gemma-2,
+Phi-3, GPT-2, and Falcon (families.py registry; each pinned against HF
+logits in tests/test_hf_parity.py).
 """
 
 from __future__ import annotations
@@ -106,7 +106,7 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
     with open(ckpt / "config.json") as f:
         hf = json.load(f)
 
-    if family in ("llama", "mistral", "mixtral", "qwen2", "gemma", "gemma2", "phi3"):
+    if family in ("llama", "mistral", "mixtral", "qwen2", "qwen3", "gemma", "gemma2", "phi3"):
         # One config dialect: mistral adds sliding-window attention, mixtral
         # adds routed experts on top of that, qwen2 adds qkv biases (preset),
         # gemma adds unit-offset norms / GeGLU / embed scaling (preset) and a
@@ -154,6 +154,18 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
                     f"use_sliding_window=true in {ckpt / 'config.json'} is not "
                     "supported (per-layer windowing, max_window_layers="
                     f"{hf.get('max_window_layers')}); disable it or use a "
+                    "full-attention checkpoint"
+                )
+        elif family == "qwen3":
+            # Explicit head_dim (may differ from hidden/heads); same
+            # per-layer-window refusal policy as qwen2.
+            kw["head_dim"] = int(
+                hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+            )
+            if hf.get("use_sliding_window"):
+                raise ValueError(
+                    f"use_sliding_window=true in {ckpt / 'config.json'} is not "
+                    "supported (per-layer windowing); disable it or use a "
                     "full-attention checkpoint"
                 )
         elif family == "gemma":
@@ -277,7 +289,7 @@ def config_from_checkpoint(ckpt: str | Path, **overrides) -> ModelConfig:
         raise ValueError(family)
     rs = hf.get("rope_scaling") or {}
     rs_type = rs.get("rope_type", rs.get("type", ""))
-    if family not in ("llama", "mistral", "mixtral", "qwen2", "gemma", "gemma2", "phi3", "falcon") and rs and rs_type not in ("default", "none", ""):
+    if family not in ("llama", "mistral", "mixtral", "qwen2", "qwen3", "gemma", "gemma2", "phi3", "falcon") and rs and rs_type not in ("default", "none", ""):
         # The neox/phi2 forward paths don't consume a scaling block; ignoring
         # a frequency-changing one would silently produce wrong logits for a
         # long-context variant. No-op types (newer HF configs emit
@@ -323,7 +335,7 @@ def load_params(ckpt: str | Path, cfg: ModelConfig | None = None, dtype=None) ->
         params = _map_llama(raw, cfg, dtype, presplit=_split_phi3_fused)
     elif family == "mixtral":
         params = _map_llama(raw, cfg, dtype, ffn=_moe_ffn)
-    elif family in ("llama", "mistral", "qwen2", "gemma", "gemma2"):  # identical weight naming
+    elif family in ("llama", "mistral", "qwen2", "qwen3", "gemma", "gemma2"):  # identical weight naming
         params = _map_llama(raw, cfg, dtype)
     elif family == "neox":
         params = _map_neox(raw, cfg, dtype)
@@ -394,6 +406,13 @@ def _map_llama(raw: dict[str, np.ndarray], cfg: ModelConfig, dtype, presplit=Non
         }
         layers["mlp_post_norm"] = {
             "scale": layer_stack("model.layers.{}.post_feedforward_layernorm.weight", False)
+        }
+    if "model.layers.0.self_attn.q_norm.weight" in raw:  # Qwen3 QK-norm
+        layers["q_norm"] = {
+            "scale": layer_stack("model.layers.{}.self_attn.q_norm.weight", False)
+        }
+        layers["k_norm"] = {
+            "scale": layer_stack("model.layers.{}.self_attn.k_norm.weight", False)
         }
     if "model.layers.0.self_attn.q_proj.bias" in raw:  # Qwen2 qkv biases
         for name, proj in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj")):

@@ -73,6 +73,10 @@ class ModelConfig:
     # 0 = default head_dim scaling).
     attn_soft_cap: float = 0.0
     query_pre_attn_scalar: float = 0.0
+    # Per-head RMSNorm on q and k (over head_dim, before RoPE) — the
+    # Qwen3/Olmo2-generation stabilization. Weights: q_norm/k_norm scale
+    # leaves of shape [head_dim] per layer.
+    qk_norm: bool = False
     rotary_fraction: float = 1.0
     # GPT-2: learned absolute position embeddings (wpe table added to the
     # token embedding) instead of rotary — set with rotary_fraction=0.0.
@@ -235,6 +239,9 @@ def init_params(cfg: ModelConfig, rng: jax.Array) -> Params:
             "v": _dense_init(ks[2], h, kh * hd, dtype, cfg.qkv_bias),
             "o": _dense_init(ks[3], nh * hd, h, dtype, cfg.out_bias),
         }
+        if cfg.qk_norm:
+            layer["q_norm"] = {"scale": jnp.ones((hd,), dtype)}
+            layer["k_norm"] = {"scale": jnp.ones((hd,), dtype)}
         if not cfg.shared_input_norm:
             layer["mlp_norm"] = _norm_init(cfg, dtype)
         if cfg.post_block_norms:
@@ -411,6 +418,9 @@ def qkv_proj(
     q = dense(layer["q"], x, cfg.quant_mode).reshape(b, s, nh, hd)
     k = dense(layer["k"], x, cfg.quant_mode).reshape(b, s, kh, hd)
     v = dense(layer["v"], x, cfg.quant_mode).reshape(b, s, kh, hd)
+    if cfg.qk_norm:  # Qwen3-style per-head RMSNorm, before RoPE
+        q = rms_norm(q, layer["q_norm"]["scale"], cfg.norm_eps)
+        k = rms_norm(k, layer["k_norm"]["scale"], cfg.norm_eps)
     if cfg.rotary_dim > 0:
         q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta, cfg.rope_scaling)

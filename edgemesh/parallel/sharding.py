@@ -70,6 +70,11 @@ def param_pspecs(cfg: ModelConfig, mesh: Mesh) -> Params:
         "v": _dense_pspec(True, cfg.qkv_bias, kv_ok),
         "o": _dense_pspec(False, cfg.out_bias, heads_ok),
     }
+    if cfg.qk_norm:
+        # [L, head_dim] per-head norm scales: head-count-independent, so
+        # they replicate under tp (each shard normalizes its own heads).
+        layer["q_norm"] = {"scale": P(None, None)}
+        layer["k_norm"] = {"scale": P(None, None)}
     if not cfg.shared_input_norm:
         layer["mlp_norm"] = _norm_pspec(cfg)
     if cfg.num_experts > 0:

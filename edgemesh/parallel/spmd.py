@@ -77,6 +77,9 @@ def spmd_param_specs(cfg: ModelConfig) -> Params:
         "v": _dense_spec(True, cfg.qkv_bias),
         "o": _dense_spec(False, cfg.out_bias),
     }
+    if cfg.qk_norm:  # [L, head_dim] per-head norm scales, tp-replicated
+        layer["q_norm"] = {"scale": P("pp", None)}
+        layer["k_norm"] = {"scale": P("pp", None)}
     if cfg.norm == "ln":
         layer["attn_norm"]["bias"] = P("pp", None)
     if not cfg.shared_input_norm:
@@ -191,6 +194,11 @@ def _spmd_attention(
     q = _col_dense(layer["q"], x).reshape(b, s, nh_l, hd)
     k = _col_dense(layer["k"], x).reshape(b, s, kh_l, hd)
     v = _col_dense(layer["v"], x).reshape(b, s, kh_l, hd)
+    if cfg.qk_norm:  # Qwen3-style per-head RMSNorm, before RoPE
+        from edgemesh.ops.norms import rms_norm
+
+        q = rms_norm(q, layer["q_norm"]["scale"], cfg.norm_eps)
+        k = rms_norm(k, layer["k_norm"]["scale"], cfg.norm_eps)
     if cfg.rotary_dim > 0:
         q = apply_rope(q, positions, cfg.rotary_dim, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rotary_dim, cfg.rope_theta, cfg.rope_scaling)

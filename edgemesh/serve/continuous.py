@@ -99,6 +99,16 @@ _spec_rounds_donated = partial(
 )(_spec_rounds.__wrapped__)
 
 
+def _splice_row_entries(cache, row, idx: int):
+    """Graft a one-row prefill result's table/length entries back into the
+    shared pool at slot ``idx`` — THE definition of the splice half of the
+    donation contract (cold and warm admissions, both spec pools)."""
+    return row._replace(
+        page_table=cache.page_table.at[idx].set(row.page_table[0]),
+        lengths=cache.lengths.at[idx].set(row.lengths[0]),
+    )
+
+
 def _prefill_into_row(cfg, params, tokens, lengths, cache, idx: int):
     """Cold zero-copy paged admission: prefill through a donated one-row
     VIEW of the shared pool (slot ``idx``'s page-table row + the shared
@@ -110,10 +120,7 @@ def _prefill_into_row(cfg, params, tokens, lengths, cache, idx: int):
         lengths=jnp.zeros((1,), jnp.int32),
     )
     logits1, row = _prefill_paged_donated(cfg, params, tokens, lengths, row_view)
-    return logits1, row._replace(
-        page_table=cache.page_table.at[idx].set(row.page_table[0]),
-        lengths=cache.lengths.at[idx].set(row.lengths[0]),
-    )
+    return logits1, _splice_row_entries(cache, row, idx)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -357,10 +364,7 @@ class ContinuousEngine:
                         jnp.asarray([match], jnp.int32),
                     )
                     self.shared_prefix_hits += 1
-                    cache = row._replace(
-                        page_table=self._cache.page_table.at[idx].set(row.page_table[0]),
-                        lengths=self._cache.lengths.at[idx].set(row.lengths[0]),
-                    )
+                    cache = _splice_row_entries(self._cache, row, idx)
                 else:
                     logits1, cache = _prefill_into_row(
                         self.cfg, agent.params, tokens, lengths, self._cache, idx
@@ -806,8 +810,6 @@ class SpeculativeContinuousEngine(ContinuousEngine):
 
     def _admit(self, idx: int, question: str, fut: Future, t_submit: float,
                mid_flight: bool) -> bool:
-        from edgemesh.ops.sampling import sample_token
-
         agent = self.agent
         eos_id = int(getattr(agent.tokenizer, "eos_id", -1))
         prompt = agent.format_prompt(question)
@@ -853,19 +855,26 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             )
             raise
 
+        # First-token bootstrap: run the SAME _spec_init the standalone path
+        # uses (batch-of-1, caches pass through untouched as None) so the
+        # "emits the target distribution exactly" guarantee cannot drift
+        # between serving and standalone speculative decoding.
+        from edgemesh.runtime.speculative import _spec_init
+
         valid = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
         mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
         self._rng, r0 = jax.random.split(self._rng)
-        token0 = sample_token(r0, logits1, agent.sampling, mask1).astype(jnp.int32)
-        mask1 = TokenMaskState(mask1).add(token0).mask
-        conf0 = jnp.max(jax.nn.softmax(logits1.astype(jnp.float32), axis=-1), axis=-1)
-        out_row = jnp.full((self.cap,), eos_id, jnp.int32).at[0].set(token0[0])
-        self._pending = self._pending.at[idx].set(token0[0])
-        self._out = self._out.at[idx].set(out_row)
+        row = _spec_init(
+            self.cfg, agent.draft_cfg, agent.params, agent.draft_params,
+            agent.sampling, self.gamma, self.max_new, eos_id,
+            logits1, None, None, mask1, r0,
+        )
+        self._pending = self._pending.at[idx].set(row.pending[0])
+        self._out = self._out.at[idx].set(row.out[0])
         self._nemit = self._nemit.at[idx].set(1)
-        self._conf = self._conf.at[idx].set(conf0[0])
-        self._mask = self._mask.at[idx].set(mask1[0])
-        self._finished = self._finished.at[idx].set(token0[0] == eos_id)
+        self._conf = self._conf.at[idx].set(row.conf_sum[0])
+        self._mask = self._mask.at[idx].set(row.mask[0])
+        self._finished = self._finished.at[idx].set(row.finished[0])
         self._reserved_pages += need
         self._dreserved += need
         self._slots[idx] = _Slot(

@@ -217,6 +217,28 @@ def test_mistral_sliding_window_parity(tmp_path):
     assert not np.allclose(np.asarray(ours[0, -1]), hf_logits[0, -1], atol=2e-3)
 
 
+def test_qwen3_qk_norm_parity(tmp_path):
+    """Qwen3 = llama dialect + per-head QK-RMSNorm before RoPE (replacing
+    qwen2's qkv biases) + explicit head_dim. Parity pins the norm placement
+    — applying it after RoPE, or over the full projection instead of per
+    head, diverges immediately."""
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    hf_cfg = Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24,  # != hidden/heads: pins explicit-head_dim handling
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(13)
+    model = Qwen3ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path)
+    cfg = config_from_checkpoint(tmp_path, dtype="float32")
+    assert cfg.qk_norm and cfg.head_size == 24
+    _compare(tmp_path, model)
+
+
 def test_mixtral_moe_parity(tmp_path):
     """Mixtral = mistral dialect with a routed-MoE FFN. Parity pins BOTH the
     weight map (router transpose, per-expert w1/w3/w2 stacking) and the

@@ -144,3 +144,28 @@ def test_mistral_family_generates():
         SamplingParams(max_new_tokens=12, do_sample=False, repetition_penalty=1.0),
     )
     assert int(r.num_generated.sum()) == 24
+
+
+def test_qwen3_qk_norm_paged_matches_dense():
+    """QK-norm rides the shared qkv_proj, so the paged backend must be
+    token-identical to dense for a qwen3-family config."""
+    import numpy as np
+
+    from edgemesh.config import SamplingParams
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_params
+    from edgemesh.runtime.generate import generate
+    from edgemesh.runtime.paged_generate import generate_paged
+
+    cfg = tiny_config("qwen3").replace(dtype="float32")
+    assert cfg.qk_norm
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    assert "q_norm" in params["layers"] and "k_norm" in params["layers"]
+    tokens = jnp.array([[5, 9, 11, 42]], jnp.int32)
+    lengths = jnp.array([4], jnp.int32)
+    sp = SamplingParams(max_new_tokens=5, do_sample=False, repetition_penalty=1.0)
+    r_dense = generate(cfg, params, tokens, lengths, sp)
+    r_paged = generate_paged(cfg, params, tokens, lengths, sp, page_size=8)
+    np.testing.assert_array_equal(
+        np.asarray(r_dense.tokens), np.asarray(r_paged.tokens)
+    )

@@ -51,7 +51,7 @@ def mesh4d(devices):
     return build_mesh(dp=1, pp=2, sp=2, tp=2, devices=devices)
 
 
-@pytest.mark.parametrize("family", ["llama", "neox", "phi2", "qwen2", "gemma"])
+@pytest.mark.parametrize("family", ["llama", "neox", "phi2", "qwen2", "qwen3", "gemma"])
 def test_spmd_loss_matches_single_device(family, mesh4d):
     cfg = _tiny(family)
     params = init_params(cfg, jax.random.PRNGKey(0))
