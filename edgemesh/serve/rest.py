@@ -201,6 +201,10 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
         backend = ensemble.answer_batch if supervisor is None else supervisor.call
         batcher = DynamicBatcher(backend, max_batch=batch, max_wait_s=batch_wait_s)
     server = ThreadingHTTPServer((host, port), _make_handler(ensemble, supervisor, batcher))
+    # Expose the batcher/engine for lifecycle management: srv.shutdown()
+    # stops only the HTTP loop — an engine's resident worker thread and
+    # KV pools need srv.batcher.close() (tests and embedders rely on it).
+    server.batcher = batcher
     log.info("edgemesh REST gateway on %s:%d", host, port)
     if block:
         server.serve_forever()

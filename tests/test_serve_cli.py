@@ -155,3 +155,41 @@ agents:
     rc = main(["download", "--src", str(cache), "--config", str(cfg_yaml)])
     assert rc == 0
     assert "[ok]" in capsys.readouterr().out
+
+
+def test_rest_continuous_speculative_end_to_end():
+    """The REST --continuous path auto-selects the speculative engine for a
+    draft-carrying agent on the paged backend; /generate answers through
+    pool-wide draft→verify rounds and /metrics carries acceptance counters."""
+    from edgemesh.agents.orchestrator import Ensemble, build_agent
+
+    base = dict(family="llama", vocab_size=260, num_layers=1, hidden_size=32,
+                num_heads=4, num_kv_heads=4, intermediate_size=64,
+                max_seq_len=128)
+    agent = build_agent(AgentSpec(
+        role="qa", model=ModelSpec(**base),
+        draft=ModelSpec(**base), spec_gamma=2,
+        sampling=SamplingParams(max_new_tokens=6, do_sample=False,
+                                repetition_penalty=1.0),
+    ))
+    srv = serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1", port=0,
+                     block=False, continuous=True, kv_backend="paged", batch=2)
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        body = json.dumps({"question": "where is the eiffel tower?"}).encode()
+        req = urllib.request.Request(
+            f"{url}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            resp = json.load(r)
+        assert "answer" in resp and resp["generated"] > 0
+        with urllib.request.urlopen(f"{url}/metrics", timeout=60) as r:
+            metrics = json.load(r)
+        stats = metrics["batcher"]
+        assert stats["gamma"] == 2
+        assert stats["spec_rounds"] > 0 and stats["spec_proposed"] > 0
+    finally:
+        srv.shutdown()
+        if srv.batcher is not None:
+            srv.batcher.close()
