@@ -536,12 +536,27 @@ class ContinuousEngine:
             self._template_pages = []
         self._mask = TokenMaskState.init(self.n_slots, self.cfg.vocab_size).mask
 
+    def _maybe_sweep(self, active: list[int], retired: bool) -> None:
+        """Run the page sweep only when page garbage can exist: an idle row
+        rode this segment (its masked advance allocates up to
+        ``_segment_pages``, which admission holds as headroom) or a
+        retirement just freed pages the stack doesn't know about. The
+        steady-state full-pool segment (all slots active, none finished)
+        creates neither, and the sweep's bulk table fetch + stack rebuild
+        are pure host-round-trip cost on the tunneled platform. ONE
+        definition of the invariant — the speculative engine calls this
+        too (its sweep covers both pools)."""
+        if self.kv_backend != "dense" and (retired or len(active) < self.n_slots):
+            self._sweep_idle_pages()
+
     def _sweep_idle_pages(self) -> None:
         """Idle slots ride the static-shape decode loop masked, but their
         garbage lengths still cross page boundaries and ALLOCATE — reset
-        their table rows after every segment (their count is bounded by
-        ``_segment_pages`` per idle slot, which admission holds as headroom),
-        then rebuild the free stack from the table."""
+        their table rows (their count is bounded by ``_segment_pages`` per
+        idle slot, which admission holds as headroom), then rebuild the
+        free stack from the table. Runs at every segment boundary where an
+        idle row rode the segment or a retirement occurred (_maybe_sweep);
+        full-pool no-retirement segments skip it."""
         table = np.asarray(self._cache.page_table)
         for i, s in enumerate(self._slots):
             if not s.active and (table[i] > 0).any():
@@ -592,6 +607,7 @@ class ContinuousEngine:
         # instead of three (each ~0.13s on the tunneled platform).
         counts_h, out_h, fin_h = jax.device_get((counts, out, fin))
         self._finished = fin
+        retired = False
         for i in active:
             slot = self._slots[i]
             n = min(int(counts_h[i]), max(slot.remaining, 0))
@@ -602,6 +618,7 @@ class ContinuousEngine:
             slot.remaining -= n
             if bool(fin_h[i]) or slot.remaining <= 0:
                 self._retire(i)
+                retired = True
 
         # Bridge into the next segment for rows still going (the loop
         # stops before a wasted trailing forward; run it for the batch).
@@ -614,8 +631,7 @@ class ContinuousEngine:
             decode_fn = self._decode_fn or forward_decode
             logits, self._cache = decode_fn(self.cfg, agent.params, prev, self._cache)
             self._logits = logits.astype(self._logits.dtype)
-        if self.kv_backend != "dense":
-            self._sweep_idle_pages()
+        self._maybe_sweep(active, retired)
 
     def _run(self) -> None:
         agent = self.agent
@@ -805,7 +821,8 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         inactive rows' commits), but the draft step writes one position and
         the verify chunk writes gamma+1 at the row's frozen position —
         rewind-idempotent table entries, so the bound is one chunk's pages
-        + a boundary page, reclaimed by the sweep each segment."""
+        + a boundary page, reclaimed by the sweep at every boundary where
+        idle rows exist (_maybe_sweep)."""
         return -(-(self.gamma + 2) // self.page_size) + 1
 
     def _admit(self, idx: int, question: str, fut: Future, t_submit: float,
@@ -913,6 +930,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
              state.accepted, state.proposed, state.rounds)
         )
         self._spec_counters_host = (int(acc_h), int(prop_h), int(rnds_h))
+        retired = False
         for i in active:
             slot = self._slots[i]
             total = min(int(nemit_h[i]), self.max_new)
@@ -924,7 +942,8 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             slot.remaining = self.max_new - total
             if bool(fin_h[i]) or total >= self.max_new:
                 self._retire(i)
-        self._sweep_idle_pages()
+                retired = True
+        self._maybe_sweep(active, retired)
 
     def _retire(self, idx: int) -> None:
         reserved = self._slots[idx].pages_reserved  # same need in both pools
