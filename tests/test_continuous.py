@@ -337,3 +337,32 @@ def test_speculative_engine_guards_and_factory():
         assert type(eng2) is ContinuousEngine
     finally:
         eng2.close()
+
+
+def test_batched_admission_mixed_widths_matches_sequential():
+    """One admission wave with prompts in different length buckets: the
+    batched path groups by width (one padded prefill per group) and must
+    produce the same answers as the dense engine's sequential admissions."""
+    agent = _agent(max_new=6)
+    qs = [
+        "hi?",
+        "a much longer question padded out well beyond the small bucket "
+        "so it lands in a different prompt-width group entirely?",
+        "mid-size question that is moderately long?",
+        "hm?",
+        "another long one that should share the second width bucket with "
+        "the earlier long question in this very admission wave, yes?",
+    ]
+    ref_eng = ContinuousEngine(agent, slots=4, chunk=8, kv_backend="dense")
+    try:
+        ref = [f.result(timeout=600) for f in [ref_eng.submit(q) for q in qs]]
+    finally:
+        ref_eng.close()
+    eng = ContinuousEngine(agent, slots=4, chunk=8, kv_backend="paged",
+                           page_size=8)
+    try:
+        got = [f.result(timeout=600) for f in [eng.submit(q) for q in qs]]
+        for r, g in zip(ref, got):
+            assert g["answer"] == r["answer"], (g["answer"], r["answer"])
+    finally:
+        eng.close()
