@@ -80,9 +80,9 @@ def _flash_page_update(
 
 
 def _paged_kernel(
-    table_ref,  # SMEM [b, max_pages] int32 (scalar prefetch)
-    len_ref,  # SMEM [b] int32 (scalar prefetch)
-    *refs,  # q, k, v, [k_scale, v_scale,] o, m_scr, l_scr, acc_scr
+    *refs,  # table, len, [layer,] (scalar prefetch) then
+    # q, k, v, [k_scale, v_scale,] [fk, fv, [fks, fvs],] o, m, l, acc
+    n_scalars: int,
     page_size: int,
     scale: float,
     window: int,
@@ -90,16 +90,32 @@ def _paged_kernel(
     kv_heads: int,
     gp: int,
     quantized: bool,
+    fold_fresh: bool,
 ):
     # q_ref   VMEM [1, kh, gp, hd]
     # k_ref   VMEM [1, kh, ps, hd] — physical page table[b, p], all kv heads
     #         (int8 when quantized, with ks/vs VMEM [1, kh, 1, ps] f32 scales)
+    # fk_ref  VMEM [1, kh, 1, hd] — current token's K, not yet in any page
+    #         (fold_fresh mode: the hoisted-write decode path, see
+    #         runtime/paged_generate + ops/paged_write)
     # o_ref   VMEM [1, kh, gp, hd]
     # scratch VMEM [kh*gp, 128] f32 ×2 (m, l) + [kh*gp, hd] f32 (acc)
+    refs = list(refs)
+    table_ref, len_ref = refs[0], refs[1]  # layer scalar (if any) only
+    refs = refs[n_scalars:]  # feeds the index maps — skip it here
+    q_ref, k_ref, v_ref = refs[:3]
+    refs = refs[3:]
+    ks_ref = vs_ref = fk_ref = fv_ref = fks_ref = fvs_ref = None
     if quantized:
-        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref, vs_ref = refs[:2]
+        refs = refs[2:]
+    if fold_fresh:
+        fk_ref, fv_ref = refs[:2]
+        refs = refs[2:]
+        if quantized:
+            fks_ref, fvs_ref = refs[:2]
+            refs = refs[2:]
+    o_ref, m_scr, l_scr, acc_scr = refs
     bb = pl.program_id(0)
     p = pl.program_id(1)
     npg = pl.num_programs(1)
@@ -128,7 +144,11 @@ def _paged_kernel(
         col = lp * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (gp, page_size), 1
         )
-        mask = col < kvlen
+        # fold_fresh: the current token (position kvlen-1) lives in fk/fv,
+        # not the pages — its page slot is stale garbage, mask it out here
+        # and fold it in at the last grid step instead. Same math, same
+        # normalization; only the accumulation order differs.
+        mask = col < (kvlen - 1 if fold_fresh else kvlen)
         if window > 0:
             mask = jnp.logical_and(mask, col >= kvlen - window)
         # Static loop over kv heads: each head's groups query rows flash-update
@@ -147,6 +167,20 @@ def _paged_kernel(
 
     @pl.when(p == npg - 1)
     def _finish():
+        if fold_fresh:
+            # Virtual page: one more flash update against the current
+            # token's own K/V (always visible to its query — the window
+            # trivially contains position kvlen-1). The token is padded to
+            # 8 slots (Mosaic can't lower K=1 dots); slots 1.. are masked.
+            first = jax.lax.broadcasted_iota(jnp.int32, (gp, 8), 1) == 0
+            for h in range(kv_heads):
+                _flash_page_update(
+                    q_ref[0, h], fk_ref[0, h], fv_ref[0, h], first, scale,
+                    soft_cap, m_scr, l_scr, acc_scr,
+                    slice(h * gp, (h + 1) * gp), gp,
+                    ks_row=fks_ref[0, h] if quantized else None,
+                    vs_row=fvs_ref[0, h] if quantized else None,
+                )
         for h in range(kv_heads):
             rows = slice(h * gp, (h + 1) * gp)
             out = acc_scr[rows, :] / jnp.maximum(l_scr[rows, :1], 1e-30)
@@ -160,7 +194,7 @@ def _paged_kernel(
 def paged_decode_attention(
     q: jnp.ndarray,  # [b, num_heads, head_dim] — one query token per row
     k_pages: jnp.ndarray,  # [total_pages, kv_heads, page_size, head_dim]
-    v_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,  # (or [L, P, kh, ps, hd] with ``layer`` set)
     page_table: jnp.ndarray,  # [b, max_pages] int32
     kv_lens: jnp.ndarray,  # [b] int32 — valid tokens per row (incl. current)
     scale: float | None = None,
@@ -170,6 +204,11 @@ def paged_decode_attention(
     soft_cap: float = 0.0,
     k_scales: jnp.ndarray | None = None,  # [P, kh, 1, ps] f32 (int8 pool)
     v_scales: jnp.ndarray | None = None,
+    layer: jnp.ndarray | None = None,  # scalar int32: 5D full-pool mode
+    fresh_k: jnp.ndarray | None = None,  # [b, kh, hd] — current token's K/V,
+    fresh_v: jnp.ndarray | None = None,  # NOT yet written to any page
+    fresh_ks: jnp.ndarray | None = None,  # [b, kh] f32 (quant pool fresh)
+    fresh_vs: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Attention of one decode token per row over its paged KV prefix.
 
@@ -188,19 +227,44 @@ def paged_decode_attention(
     kernel via per-token-row scales folded in after each matmul, so the
     page walk streams half the bytes.
 
+    ``layer`` (with 5D ``k_pages`` [L, P, kh, ps, hd]) addresses one layer
+    of the full stacked pool directly in the block index_map — the layer
+    scan then never materializes an 18 MB pool slice per layer (the
+    hoisted-write decode path, ops/paged_write.py docstring).
+
+    ``fresh_k``/``fresh_v`` carry the CURRENT token's K/V when the caller
+    has not yet written it to the pages (hoisted-write mode): the kernel
+    masks the current position out of the page walk and folds these in as
+    a virtual single-token page at the last grid step. ``kv_lens`` still
+    counts the current token. Identical math to attending over the written
+    page; only the flash accumulation order differs.
+
     ``check=True`` emits checkify contract asserts (page-table entries inside
     the physical pool, kv_lens within table capacity, finite queries) — run
     through ops.checks.checked (§5.2).
     """
     if not HAVE_PALLAS:  # pragma: no cover
         raise RuntimeError("pallas unavailable")
+    quantized = k_scales is not None
+    fold_fresh = fresh_k is not None
+    full_pool = k_pages.ndim == 5
+    if full_pool and layer is None:
+        raise ValueError("5D page pools need the `layer` index")
+    if not full_pool and layer is not None:
+        raise ValueError(
+            "`layer` only applies to 5D [L, P, kh, ps, hd] pools; a 4D pool "
+            "would silently misread table entries as absolute flat indices"
+        )
     if check:
         from edgemesh.ops.checks import check_paged_inputs
 
-        check_paged_inputs(q, k_pages, page_table, kv_lens)
-    quantized = k_scales is not None
+        # For stacked pools validate against one layer's [P, kh, ps, hd]
+        # view — table entries and kv_lens bounds are per-layer quantities.
+        check_paged_inputs(
+            q, k_pages[0] if full_pool else k_pages, page_table, kv_lens
+        )
     b, nh, hd = q.shape
-    _, kh, ps, _ = k_pages.shape
+    kh, ps = k_pages.shape[-3], k_pages.shape[-2]
     groups = nh // kh
     max_pages = page_table.shape[1]
     scale = scale if scale is not None else hd**-0.5
@@ -210,8 +274,24 @@ def paged_decode_attention(
     qg = q.reshape(b, kh, groups, hd)
     qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - groups), (0, hp - hd)))
     if hp != hd:
-        k_pages = jnp.pad(k_pages, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
-        v_pages = jnp.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
+        pad = [(0, 0)] * (k_pages.ndim - 1) + [(0, hp - hd)]
+        k_pages = jnp.pad(k_pages, pad)
+        v_pages = jnp.pad(v_pages, pad)
+
+    # 5D pools collapse to 4D [L*P, kh, ps, hd] (a free leading-dim merge —
+    # a true 5D operand cost a full-pool relayout copy per call on this
+    # backend, measured +0.6 ms at 0.57 GB) and the layer becomes a page
+    # offset: physical block index = layer * P + table[bb, p].
+    if full_pool:
+        P = k_pages.shape[1]
+        k_pages = k_pages.reshape((-1,) + k_pages.shape[2:])
+        v_pages = v_pages.reshape((-1,) + v_pages.shape[2:])
+        if quantized:
+            k_scales = k_scales.reshape((-1,) + k_scales.shape[2:])
+            v_scales = v_scales.reshape((-1,) + v_scales.shape[2:])
+        off = lambda scalars: scalars[2][0] * P
+    else:
+        off = lambda scalars: 0
 
     if sliding_window > 0:
         # Only pages intersecting [kvlen-w, kvlen) can contribute: the first
@@ -219,45 +299,72 @@ def paged_decode_attention(
         # slots bound the live span for every row.
         npages = min(max_pages, sliding_window // ps + 2)
 
-        def kv_map(bb, p, table, lens):
+        def kv_map(bb, p, *scalars):
+            table, lens = scalars[0], scalars[1]
             first_live = jnp.maximum(lens[bb] - sliding_window, 0) // ps
             # Clamp: near capacity first_live+p can step past the table; the
             # clamped duplicate fetch is masked dead in the kernel (live=False
             # once lp*ps >= kvlen).
-            return (table[bb, jnp.minimum(first_live + p, max_pages - 1)], 0, 0, 0)
+            return (off(scalars)
+                    + table[bb, jnp.minimum(first_live + p, max_pages - 1)],
+                    0, 0, 0)
     else:
         npages = max_pages
 
-        def kv_map(bb, p, table, lens):
-            return (table[bb, p], 0, 0, 0)
+        def kv_map(bb, p, *scalars):
+            return (off(scalars) + scalars[0][bb, p], 0, 0, 0)
+
+    def q_map(bb, p, *scalars):
+        return (bb, 0, 0, 0)
 
     grid = (b, npages)
     kernel = functools.partial(
-        _paged_kernel, page_size=ps, scale=scale, window=sliding_window,
-        soft_cap=soft_cap, kv_heads=kh, gp=gp, quantized=quantized,
+        _paged_kernel, n_scalars=3 if full_pool else 2, page_size=ps,
+        scale=scale, window=sliding_window, soft_cap=soft_cap, kv_heads=kh,
+        gp=gp, quantized=quantized, fold_fresh=fold_fresh,
     )
+    kv_block = (1, kh, ps, hp)
+    sc_block = (1, kh, 1, ps)
     in_specs = [
-        pl.BlockSpec((1, kh, gp, hp), lambda bb, p, table, lens: (bb, 0, 0, 0)),
-        pl.BlockSpec((1, kh, ps, hp), kv_map),
-        pl.BlockSpec((1, kh, ps, hp), kv_map),
+        pl.BlockSpec((1, kh, gp, hp), q_map),
+        pl.BlockSpec(kv_block, kv_map),
+        pl.BlockSpec(kv_block, kv_map),
     ]
     operands = [qg, k_pages, v_pages]
     if quantized:
         # Scale blocks ride the same page index_map; [1, ps] per head.
-        in_specs += [
-            pl.BlockSpec((1, kh, 1, ps), kv_map),
-            pl.BlockSpec((1, kh, 1, ps), kv_map),
-        ]
+        in_specs += [pl.BlockSpec(sc_block, kv_map), pl.BlockSpec(sc_block, kv_map)]
         operands += [k_scales, v_scales]
+    if fold_fresh:
+        # 8 virtual slots (only slot 0 real — K=1 dots don't lower).
+        fkp = jnp.pad(fresh_k.reshape(b, kh, 1, hd),
+                      ((0, 0), (0, 0), (0, 7), (0, hp - hd)))
+        fvp = jnp.pad(fresh_v.reshape(b, kh, 1, hd),
+                      ((0, 0), (0, 0), (0, 7), (0, hp - hd)))
+        in_specs += [
+            pl.BlockSpec((1, kh, 8, hp), q_map),
+            pl.BlockSpec((1, kh, 8, hp), q_map),
+        ]
+        operands += [fkp.astype(k_pages.dtype), fvp.astype(v_pages.dtype)]
+        if quantized:
+            in_specs += [
+                pl.BlockSpec((1, kh, 1, 8), q_map),
+                pl.BlockSpec((1, kh, 1, 8), q_map),
+            ]
+            operands += [
+                jnp.pad(fresh_ks.reshape(b, kh, 1, 1), ((0, 0), (0, 0), (0, 0), (0, 7))).astype(jnp.float32),
+                jnp.pad(fresh_vs.reshape(b, kh, 1, 1), ((0, 0), (0, 0), (0, 0), (0, 7))).astype(jnp.float32),
+            ]
+    scalars = [page_table.astype(jnp.int32), kv_lens.astype(jnp.int32)]
+    if full_pool:
+        scalars.append(jnp.reshape(layer, (1,)).astype(jnp.int32))
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=len(scalars),
             grid=grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec(
-                (1, kh, gp, hp), lambda bb, p, table, lens: (bb, 0, 0, 0)
-            ),
+            out_specs=pl.BlockSpec((1, kh, gp, hp), q_map),
             scratch_shapes=[
                 pltpu.VMEM((kh * gp, 128), jnp.float32),
                 pltpu.VMEM((kh * gp, 128), jnp.float32),
@@ -266,7 +373,7 @@ def paged_decode_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((b, kh, gp, hp), q.dtype),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), *operands)
+    )(*scalars, *operands)
     return out[:, :, :groups, :hd].reshape(b, nh, hd)
 
 

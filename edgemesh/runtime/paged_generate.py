@@ -397,6 +397,82 @@ def forward_verify_paged(
     return _paged_append(cfg, params, tokens, full, cache, cache.lengths)
 
 
+def _paged_forward_decode_hoisted(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b, 1]
+    positions: jnp.ndarray,  # [b, 1]
+    cache,
+    kv_lens: jnp.ndarray,  # [b] valid tokens INCLUDING the current one
+):
+    """Hoisted-write decode forward — the TPU kernel path.
+
+    The original decode scattered each layer's fresh K/V into its page slice
+    INSIDE the layer scan; XLA:TPU lowers that data-dependent scatter so
+    badly the paged backend paid ~8 ms/step extra at Llama-1B serving shapes
+    (the whole round-3 paged tax — measurement in ops/paged_write.py). Here
+    the scan only READS the pool (the attention kernel addresses layer
+    blocks of the full stacked array directly, so no per-layer slice ever
+    materializes) and folds the current token in as a virtual page; the
+    scan's ys are the tiny per-layer fresh K/V, and ONE aliased RMW kernel
+    (ops/paged_write.write_decode_all_layers) commits them after the scan.
+    Same numerics as write-then-attend — only the flash accumulation order
+    differs."""
+    from edgemesh.ops.paged_write import write_decode_all_layers
+
+    pool = cache
+    x = embed_tokens(cfg, params, tokens, positions)
+    quant = isinstance(pool, QuantPagedKVCache)
+    interp = cfg.attention_impl == "flash" and not on_tpu()
+    b = tokens.shape[0]
+    nh, hd = cfg.num_heads, cfg.head_size
+
+    def attention(acfg, layer, ax, apos, cache, kv_valid, lengths, is_decode):
+        l = cache  # scalar layer index (scanned); the pool rides the closure
+        q, k, v = qkv_proj(acfg, layer, ax, apos)
+        if quant:
+            from edgemesh.runtime.quant_kv import quantize_kv
+
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            fresh = (kq[:, 0], vq[:, 0], ks[:, 0], vs[:, 0])
+            kwargs = dict(
+                zip(("fresh_k", "fresh_v", "fresh_ks", "fresh_vs"), fresh),
+                k_scales=pool.k_scale, v_scales=pool.v_scale,
+            )
+        else:
+            fresh = (k[:, 0], v[:, 0])
+            kwargs = dict(zip(("fresh_k", "fresh_v"), fresh))
+        out = paged_decode_attention(
+            q[:, 0], pool.k, pool.v, pool.page_table, kv_lens,
+            scale=acfg.query_scale, interpret=interp,
+            sliding_window=acfg.sliding_window, soft_cap=acfg.attn_soft_cap,
+            layer=l, **kwargs,
+        )
+        proj = dense(layer["o"], out[:, None].reshape(b, 1, nh * hd), acfg.quant_mode)
+        return proj, (l, fresh)
+
+    def body(layer_cfg, h, scanned):
+        layer, l = scanned
+        h, state, _aux = _layer_fn(
+            layer_cfg, h, layer, l, positions, None, pool.lengths,
+            True, attention,
+        )
+        return h, state[1]  # ys = the fresh K/V tuple
+
+    n_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+    x, fresh = layer_scan_alt_windows(
+        cfg, body, x, (params["layers"], jnp.arange(n_layers, dtype=jnp.int32))
+    )
+    if quant:
+        fk, fv, fks, fvs = fresh
+        pool = write_decode_all_layers(pool, fk, fv, fks, fvs, interpret=interp)
+    else:
+        fk, fv = fresh
+        pool = write_decode_all_layers(pool, fk, fv, interpret=interp)
+    return lm_head_logits(cfg, params, x), pool
+
+
 @partial(jax.jit, static_argnums=(0,))
 def forward_decode_paged(
     cfg: ModelConfig,
@@ -410,10 +486,15 @@ def forward_decode_paged(
         cache, pages_needed(cache.lengths, jnp.ones_like(cache.lengths), cache.page_size)
     )
     positions = cache.lengths[:, None]
-    logits, cache = _paged_forward(
-        cfg, params, tokens[:, None], positions, cache, cache.lengths + 1,
-        is_decode=True,
-    )
+    if _use_flash(cfg):
+        logits, cache = _paged_forward_decode_hoisted(
+            cfg, params, tokens[:, None], positions, cache, cache.lengths + 1
+        )
+    else:
+        logits, cache = _paged_forward(
+            cfg, params, tokens[:, None], positions, cache, cache.lengths + 1,
+            is_decode=True,
+        )
     return logits[:, 0], cache._replace(lengths=cache.lengths + 1)
 
 

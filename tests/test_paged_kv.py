@@ -474,3 +474,72 @@ def test_paged_chunk_kernel_matches_gather_oracle():
                                    err_msg=f"cap={cap} quant={quant}")
         np.testing.assert_allclose(ver_k, ver_g, atol=3e-5, rtol=3e-5,
                                    err_msg=f"cap={cap} quant={quant}")
+
+
+def test_hoisted_decode_matches_xla_path():
+    """The TPU decode path (attention_impl="flash" → interpret on CPU) runs
+    the hoisted-write design: the layer scan never writes pages (the kernel
+    folds the current token as a virtual page), and ONE aliased RMW kernel
+    (ops/paged_write.write_decode_all_layers) commits every layer's fresh
+    K/V after the scan. Pin it token-exact against the write-then-attend
+    XLA path for the bf16 pool, the int8 pool, and a sliding window."""
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompts = jnp.array(
+        [[5, 9, 11, 42, 7, 0, 0], [17, 3, 50, 8, 33, 21, 2]], jnp.int32
+    )
+    lengths = jnp.array([5, 7], jnp.int32)
+    sp = SamplingParams(max_new_tokens=14, temperature=0.0)
+
+    for kw, quant in [({}, False), ({}, True), (dict(sliding_window=8), False)]:
+        cfg_x = _cfg(**kw)
+        cfg_f = cfg_x.replace(attention_impl="flash")
+        ref = generate_paged(cfg_x, params, prompts, lengths, sp,
+                             rng=jax.random.PRNGKey(7), page_size=4,
+                             kv_quant=quant)
+        got = generate_paged(cfg_f, params, prompts, lengths, sp,
+                             rng=jax.random.PRNGKey(7), page_size=4,
+                             kv_quant=quant)
+        np.testing.assert_array_equal(
+            np.asarray(ref.tokens), np.asarray(got.tokens),
+            err_msg=f"kw={kw} quant={quant}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref.confidence), np.asarray(got.confidence),
+            atol=2e-5, err_msg=f"kw={kw} quant={quant}",
+        )
+
+
+def test_write_decode_all_layers_matches_scatter():
+    """The RMW write kernel == write_tokens(start=lengths, valid_len=1) on
+    every layer, including table-unallocated rows landing on the trash
+    page."""
+    from edgemesh.ops.paged_write import write_decode_all_layers
+
+    cfg = _cfg()
+    L, kh, hd, ps, b = cfg.num_layers, cfg.num_kv_heads, cfg.head_size, 4, 3
+    cache = init_paged_cache(cfg, b, total_pages=12, page_size=ps, max_pages=6)
+    # Rows at assorted positions; row 2 left unallocated (trash-page write).
+    cache = cache._replace(
+        page_table=jnp.asarray([[3, 5, 0, 0, 0, 0],
+                                [7, 0, 0, 0, 0, 0],
+                                [0, 0, 0, 0, 0, 0]], jnp.int32),
+        lengths=jnp.asarray([5, 2, 1], jnp.int32),
+    )
+    key = jax.random.PRNGKey(1)
+    fk = jax.random.normal(key, (L, b, kh, hd), jnp.float32)
+    fv = jax.random.normal(jax.random.fold_in(key, 1), (L, b, kh, hd), jnp.float32)
+
+    got = write_decode_all_layers(cache, fk, fv, interpret=True)
+    want_k, want_v = cache.k, cache.v
+    for l in range(L):
+        want_k = want_k.at[l].set(write_tokens(
+            want_k[l], cache.v[l], fk[l][:, None], fv[l][:, None],
+            cache.page_table, cache.lengths, jnp.ones((b,), jnp.int32),
+        )[0])
+        want_v = want_v.at[l].set(write_tokens(
+            cache.k[l], want_v[l], fk[l][:, None], fv[l][:, None],
+            cache.page_table, cache.lengths, jnp.ones((b,), jnp.int32),
+        )[1])
+    np.testing.assert_allclose(np.asarray(got.k), np.asarray(want_k), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.v), np.asarray(want_v), atol=1e-6)
