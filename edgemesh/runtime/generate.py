@@ -125,6 +125,15 @@ def _decode_loop(
 
     def body(s: _LoopState):
         logits, cache = decode_fn(cfg, params, s.prev_token, s.cache)
+        # Freeze finished rows' lengths: their forward still runs (static
+        # shapes) but the garbage write stays AT the frozen position instead
+        # of marching on. Keeps finished rows' cache state exact, and — the
+        # serving engine's whole page-accounting story — idle pool rows
+        # never cross page boundaries, so they never allocate pages
+        # (serve/continuous.py keeps idle rows parked at length 1).
+        cache = cache._replace(
+            lengths=jnp.where(s.finished, s.cache.lengths, cache.lengths)
+        )
         rng, step_rng = jax.random.split(s.rng)
         token, out, finished, num_generated, token_mask, conf_sum = sample_and_record(
             logits, step_rng, s.out, s.step, s.finished, s.num_generated,

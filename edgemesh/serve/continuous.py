@@ -14,35 +14,45 @@ batching at CHUNK granularity:
 - Between segments, free slots admit queued requests: the prompt prefills
   as a batch-of-1 (its own small compiled program) and its cache rows /
   logits / repetition mask SPLICE into the shared state at the slot index.
-- Rows that hit EOS or their token budget retire at the segment boundary:
+- Rows that hit EOS or their token budget retire at a segment boundary:
   their text resolves the caller's Future and the slot frees. Inactive
   slots ride along masked as ``finished`` (the loop writes nothing for
   them) — the standard static-shape tax.
 
-Worst-case admission latency is one segment (``chunk`` tokens ≈ tens of ms)
-instead of a full answer (hundreds of tokens).
+**Pipelined segments (round 4).** The worker runs DEPTH-2: it dispatches
+segment N+1 from segment N's device output handles BEFORE draining segment
+N's results, so the device never idles on the host's ~0.1 s tunneled
+readback + bookkeeping. The only blocking fetch per segment lands while the
+NEXT segment is already executing. Consequences the code must own:
 
-``kv_backend="paged"`` (or ``"paged_int8"``) runs the pool over the paged KV
-cache (runtime/paged_kv.py) — the vLLM-style serving memory model on TPU:
+- A row whose budget ran out in segment N still rides segment N+1 (its
+  retirement is only discovered while N+1 executes). EOS rows self-mask on
+  device; budget overshoot is trimmed host-side as always — the page
+  reservation just covers one extra segment of garbage.
+- Slot bookkeeping is guarded by per-slot admission GENERATIONS: segment
+  N's fetched counts must not credit tokens to a request admitted into the
+  same slot afterwards.
 
-- Pages are BATCH-AGNOSTIC, so admission is zero-copy for KV: the request
-  prefills through a one-row VIEW of the shared pool (its slot's page-table
-  row + the shared page arrays, donated in place); no multi-GB row splice.
-- Retirement RECLAIMS pages: at the segment boundary (host re-entry) the
-  slot's physical pages push back onto the free stack and its table row
-  resets to trash — one preallocated pool serves an unbounded request
-  stream.
-- Admission control is reservation-based: a request is admitted only when
-  its worst-case page count (ceil((prompt+budget)/page_size)) fits beside
-  the reservations of every in-flight request, so mid-decode pool overflow
-  cannot happen; ``total_pages`` below the slots×max_seq worst case trades
-  HBM for queueing instead of crashing.
+**Host-owned paging (round 4).** ``kv_backend="paged"``/``"paged_int8"``
+runs the pool over the paged KV cache (runtime/paged_kv.py) with the free
+list owned ENTIRELY by the host:
+
+- Admission pre-maps the request's worst-case pages into its table row
+  from a host-side free list (the device allocator sees every slot mapped
+  and never pops — ``free_top`` stays at 1 as a tripwire, checked from the
+  segment fetch). Admission is still zero-copy for KV: the prompt prefills
+  through a one-row VIEW of the shared pool (donated in place).
+- Retirement pushes the row's pages straight back onto the host free list
+  and parks the slot: table row zeroed, length set to 1. Parked rows are
+  ``finished`` so the decode loop FREEZES their length (runtime/generate
+  ``_decode_loop``) — they never cross a page boundary, never allocate,
+  and their masked garbage write lands on the trash page. This deletes
+  the round-3 machinery wholesale: no idle-slot page sweeps, no free-stack
+  rebuilds from the table, no per-segment reservation headroom.
 - The prompt template's prefix is SHARED across rows (vLLM/RadixAttention
-  style, natural on a paged design): its KV prefills into pool pages once,
-  each admitted row's table maps those pages read-only (the partial
-  boundary page copies on write), and only the question suffix prefills
-  (runtime/paged_generate.forward_prefill_paged_at). Matching is on token
-  ids; sub-page matches fall back to the cold path.
+  style): its KV prefills into permanent pool pages once, each admitted
+  row's table maps those pages read-only (the partial boundary page copies
+  on write), and only the question suffix prefills.
 
 Interface-compatible with DynamicBatcher (submit/answer/close/stats), so
 ``serve_rest`` takes either.
@@ -56,7 +66,7 @@ import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -99,6 +109,25 @@ _spec_rounds_donated = partial(
 )(_spec_rounds.__wrapped__)
 
 
+def _make_bridge(decode_fn):
+    """Finished-aware bridge step: runs the whole-batch decode forward that
+    seeds the next segment's logits, but FREEZES finished rows' lengths (the
+    host-owned paging contract — parked rows must never advance). The cache
+    is donated: the bridge consumes the segment's dead output handle."""
+    fn = decode_fn or forward_decode
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+    def bridge(cfg, params, prev, cache, fin):
+        old = cache.lengths
+        logits, cache = fn(cfg, params, prev, cache)
+        return (
+            logits.astype(jnp.float32),
+            cache._replace(lengths=jnp.where(fin, old, cache.lengths)),
+        )
+
+    return bridge
+
+
 def _splice_row_entries(cache, row, idx: int):
     """Graft a one-row prefill result's table/length entries back into the
     shared pool at slot ``idx`` — THE definition of the splice half of the
@@ -109,14 +138,16 @@ def _splice_row_entries(cache, row, idx: int):
     )
 
 
-def _prefill_into_row(cfg, params, tokens, lengths, cache, idx: int):
+def _prefill_into_row(cfg, params, tokens, lengths, cache, idx: int, row_table):
     """Cold zero-copy paged admission: prefill through a donated one-row
-    VIEW of the shared pool (slot ``idx``'s page-table row + the shared
-    pages) and splice the resulting table/length entries back. Used by the
-    base engine's cold path and by BOTH of the speculative engine's pools —
-    one definition of the donation/splice contract."""
+    VIEW of the shared pool (the host-built pre-mapped table row + the
+    shared pages, donated in place) and splice the resulting table/length
+    entries back. Used by the base engine's cold path and by BOTH of the
+    speculative engine's pools — one definition of the donation/splice
+    contract. Every page the prompt touches is already mapped in
+    ``row_table``, so the in-program allocator pops nothing."""
     row_view = cache._replace(
-        page_table=cache.page_table[idx : idx + 1],
+        page_table=jnp.asarray(row_table, jnp.int32)[None, :],
         lengths=jnp.zeros((1,), jnp.int32),
     )
     logits1, row = _prefill_paged_donated(cfg, params, tokens, lengths, row_view)
@@ -129,14 +160,18 @@ def _copy_page(pages, src, dst):
     return pages.at[:, dst].set(pages[:, src])
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+# `pool_finished` (arg 5) is NOT donated: it is [slots] bool — nothing to
+# save — and the pipelined worker holds the previous segment's `fin` output
+# (the same buffer) in its in-flight fetch set; donating it here deleted
+# that handle mid-fetch.
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
 def _splice_slot(
     pool_k, pool_v, pool_len, pool_logits, pool_mask, pool_finished,
     row_k, row_v, row_len, row_logits, row_mask, idx,
 ):
     """In-place (donated) insertion of one prefilled request into the shared
     pool state at slot ``idx`` — an eager .at[].set here would copy the whole
-    multi-GB pool per admission."""
+    multi-GB pool per admission (dense backend)."""
     return (
         pool_k.at[:, idx].set(row_k[:, 0]),
         pool_v.at[:, idx].set(row_v[:, 0]),
@@ -147,6 +182,18 @@ def _splice_slot(
     )
 
 
+def _parked_pool(init_fn, n_slots: int, total_pages: int):
+    """Fresh page pool with every slot PARKED at length 1, plus its matching
+    host free list. ONE definition of the load-bearing convention: a frozen
+    idle row at length 1 never sits on a page boundary, so the in-program
+    allocator never pops and the host-owned free list stays authoritative
+    (length 0 would pop on the first masked step and silently corrupt it).
+    Used everywhere a pool is (re)built: engine init, template resize,
+    reset-after-failure, and both of the speculative engine's pools."""
+    cache = init_fn()._replace(lengths=jnp.ones((n_slots,), jnp.int32))
+    return cache, list(range(1, total_pages))
+
+
 @dataclass
 class _Slot:
     future: Future | None = None
@@ -155,7 +202,7 @@ class _Slot:
     remaining: int = 0
     t_submit: float = 0.0
     t_start: float = 0.0
-    pages_reserved: int = 0  # paged backends: worst-case pages held
+    pages: list[int] = field(default_factory=list)  # paged: private pages held
     # Speculative engine: how many of the row's accumulated out-tokens have
     # already been emitted (the spec state's `out` grows in place; the
     # dense loop's per-segment buffers need no such cursor).
@@ -164,6 +211,25 @@ class _Slot:
     @property
     def active(self) -> bool:
         return self.future is not None
+
+
+class _Inflight(NamedTuple):
+    """One dispatched-but-undrained segment: the slot generations it was
+    dispatched against plus the device handles of its outputs (async host
+    copies already started)."""
+
+    rows: list[tuple[int, int]]  # (slot index, generation at dispatch)
+    handles: tuple  # device arrays to fetch; engine-specific layout
+
+
+def _start_host_copy(handles) -> None:
+    """Kick off device→host transfers so the blocking fetch in
+    _process_segment mostly finds the bytes already landed."""
+    for h in handles:
+        try:
+            h.copy_to_host_async()
+        except Exception:  # pragma: no cover — platform-dependent
+            pass
 
 
 class ContinuousEngine:
@@ -194,6 +260,7 @@ class ContinuousEngine:
         self._cond = threading.Condition()
         self._closed = False
         self._slots = [_Slot() for _ in range(self.n_slots)]
+        self._gen = [0] * self.n_slots  # admission generation per slot
         cap = self.cfg.max_seq_len
         if kv_backend == "dense":
             self._cache = init_kv_cache(self.cfg, self.n_slots, cap)
@@ -201,18 +268,22 @@ class ContinuousEngine:
         else:
             self.page_size = int(page_size)
             per_row = -(-cap // self.page_size)  # ceil: table slots per row
-            # Default sizing covers every slot's worst-case RESERVATION (max
-            # context + segment overshoot, _admit), not just its table
-            # capacity — overshoot pops are transient but real until the
-            # boundary rebuild reclaims them.
-            per_row_worst = -(-(cap + self.chunk) // self.page_size) + 1
-            self.total_pages = int(total_pages or 1 + self.n_slots * per_row_worst)
+            # Worst-case private pages one request can hold: full context
+            # plus TWO segments of overshoot (mid-segment budget end + the
+            # pipeline's one-segment retirement lag, each with its bridge
+            # token) plus a COW boundary page for warm starts.
+            self._per_row_worst = (
+                -(-(cap + 2 * (self.chunk + 1)) // self.page_size) + 1
+            )
+            self.total_pages = int(total_pages or 1 + self.n_slots * self._per_row_worst)
             init = init_quant_paged_cache if kv_backend == "paged_int8" else init_paged_cache
             self._init_pool = lambda: init(
                 self.cfg, self.n_slots, total_pages=self.total_pages,
                 page_size=self.page_size, max_pages=per_row,
             )
-            self._cache = self._init_pool()
+            self._cache, self._free_pages = _parked_pool(
+                self._init_pool, self.n_slots, self.total_pages
+            )
             self._decode_fn = forward_decode_paged
             self._reserved_pages = 0
             self._auto_sized = total_pages is None
@@ -231,11 +302,13 @@ class ContinuousEngine:
         self._mask = TokenMaskState.init(self.n_slots, self.cfg.vocab_size).mask
         self._finished = jnp.ones((self.n_slots,), bool)  # all slots idle
         self._rng = jax.random.PRNGKey(agent.sampling.seed)
+        self._bridge = _make_bridge(self._decode_fn)
         # Stats for /metrics and tests.
         self.requests = 0
         self.segments = 0
         self.admitted_mid_flight = 0
         self.max_concurrent = 0
+        self._pool_tripwire_logged = False
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -273,9 +346,35 @@ class ContinuousEngine:
         if self.kv_backend != "dense":
             out["total_pages"] = self.total_pages
             out["reserved_pages"] = self._reserved_pages
+            out["free_pages"] = len(self._free_pages)
             out["template_pages"] = len(self._template_pages)
             out["shared_prefix_hits"] = self.shared_prefix_hits
         return out
+
+    # -- host-owned page accounting -----------------------------------------
+
+    def _pop_pages(self, n: int) -> list[int]:
+        taken = [self._free_pages.pop() for _ in range(n)]
+        self._reserved_pages += n
+        return taken
+
+    def _push_pages(self, pages: list[int]) -> None:
+        self._free_pages.extend(pages)
+        self._reserved_pages -= len(pages)
+
+    def _build_row_table(self, shared: list[int], private: list[int]) -> np.ndarray:
+        """Pre-mapped table row: shared (template) pages first, then the
+        request's private pages. Slots beyond stay 0 — the request's page
+        budget guarantees it never reaches them."""
+        row = np.zeros((self._cache.max_pages,), np.int32)
+        n = len(shared) + len(private)
+        if n > row.size:
+            raise ValueError(
+                f"request needs {n} table slots, row has {row.size}"
+            )
+        row[: len(shared)] = shared
+        row[len(shared) : n] = private
+        return row
 
     # -- engine loop --------------------------------------------------------
 
@@ -305,7 +404,7 @@ class ContinuousEngine:
                 jnp.asarray(idx, jnp.int32),
             )
             self._cache = KVCache(k=k, v=v, lengths=ln)
-            reserved = 0
+            pages: list[int] = []
         else:
             self._ensure_template()
             # Shared-prefix match: longest common token prefix with the
@@ -320,39 +419,45 @@ class ContinuousEngine:
             if shared_full == 0:
                 match = 0  # below one page: sharing buys nothing, go cold
 
-            # Worst-case PRIVATE pages this row can ever hold (shared pages
-            # are permanent pool residents, not per-request consumption): the
-            # loop advances EVERY row to the segment boundary, so a row that
-            # EOSes or exhausts its budget mid-segment overshoots by < chunk
-            # tokens, + 1 bridge token (the overshoot tokens are garbage,
-            # trimmed host-side, but their page allocations are real until
-            # retirement reclaims them).
-            need = -(-(plen + budget + self.chunk) // self.page_size) + 1 - shared_full
-            idle_after = sum(1 for s in self._slots if not s.active) - 1
-            headroom = idle_after * self._segment_pages
-            avail = self.total_pages - 1 - len(self._template_pages)
-            if need + (self.n_slots - 1) * self._segment_pages > avail:
+            # Worst-case PRIVATE pages (shared pages are permanent pool
+            # residents): prompt + budget + one segment of mid-flight
+            # overshoot + one segment of pipeline retirement lag (each with
+            # its bridge token). Capped at the table row's slot count —
+            # writes past the last logical slot clamp onto the row's own
+            # final (garbage-region) page or the trash page, never another
+            # row's (paged_kv._token_slots).
+            over = 2 * (self.chunk + 1)
+            mapped = min(
+                -(-(plen + budget + over) // self.page_size),
+                int(self._cache.max_pages),
+            )
+            need = max(mapped - shared_full, 1)
+            if need > len(self._free_pages) + self._reserved_pages:
                 raise ValueError(
                     f"request needs {need} pages (prompt {plen} + budget "
                     f"{budget} + segment overshoot); the pool holds "
-                    f"{avail} minus idle-slot headroom"
+                    f"{len(self._free_pages) + self._reserved_pages} beyond "
+                    "the template"
                 )
-            if self._reserved_pages + need + headroom > avail:
+            if need > len(self._free_pages):
                 return False  # capacity — re-queue, admit at a later boundary
+            pages = self._pop_pages(need)
             # Zero-copy KV admission: prefill through a one-row VIEW of the
-            # shared pool (slot's table row + shared pages, donated). Only
-            # the slot's own page-table/length entries change host-side; no
-            # KV row splice exists in the paged world. With a template match,
-            # the row warm-starts: its table maps the shared pages read-only
-            # (boundary page copy-on-write) and only the suffix prefills.
+            # shared pool (the host-built pre-mapped table + shared pages,
+            # donated). Only the slot's own page-table/length entries change
+            # host-side; no KV row splice exists in the paged world. With a
+            # template match, the row warm-starts: its table maps the shared
+            # pages read-only (boundary page copy-on-write) and only the
+            # suffix prefills.
             try:
                 if match:
-                    row_table = np.zeros((self._cache.max_pages,), np.int32)
-                    row_table[:shared_full] = self._template_pages[:shared_full]
+                    shared = list(self._template_pages[:shared_full])
+                    private = list(pages)
                     if match % self.page_size:
-                        fresh = self._pop_page()
-                        self._cow_copy(self._template_pages[shared_full], fresh)
-                        row_table[shared_full] = fresh
+                        # The partially-shared boundary page copies on
+                        # write: the suffix overwrites its tail slots.
+                        self._cow_copy(self._template_pages[shared_full], private[0])
+                    row_table = self._build_row_table(shared, private)
                     row_view = self._cache._replace(
                         page_table=jnp.asarray(row_table)[None, :],
                         lengths=jnp.zeros((1,), jnp.int32),
@@ -366,8 +471,10 @@ class ContinuousEngine:
                     self.shared_prefix_hits += 1
                     cache = _splice_row_entries(self._cache, row, idx)
                 else:
+                    row_table = self._build_row_table([], pages)
                     logits1, cache = _prefill_into_row(
-                        self.cfg, agent.params, tokens, lengths, self._cache, idx
+                        self.cfg, agent.params, tokens, lengths, self._cache,
+                        idx, row_table,
                     )
             except Exception:
                 # The donated pool buffers may already be invalidated — a
@@ -384,22 +491,21 @@ class ContinuousEngine:
             self._logits = self._logits.at[idx].set(logits1[0].astype(self._logits.dtype))
             self._mask = self._mask.at[idx].set(mask1[0])
             self._finished = self._finished.at[idx].set(False)
-            self._reserved_pages += need
-            reserved = need
 
         self._slots[idx] = _Slot(
             future=fut, question=question, emitted=[], remaining=budget,
-            t_submit=t_submit, t_start=time.perf_counter(),
-            pages_reserved=reserved,
+            t_submit=t_submit, t_start=time.perf_counter(), pages=pages,
         )
+        self._gen[idx] += 1
         if mid_flight:
             self.admitted_mid_flight += 1
         return True
 
     def _ensure_template(self) -> None:
-        """Lazily prefill the prompt template's shared prefix into pool pages
-        (once per pool lifetime). Sharing is pure table bookkeeping: admitted
-        rows map these pages read-only; the boundary page copies on write."""
+        """Lazily prefill the prompt template's shared prefix into
+        host-assigned permanent pool pages (once per pool lifetime).
+        Sharing is pure table bookkeeping afterwards: admitted rows map
+        these pages read-only; the boundary page copies on write."""
         if self._template_ids is not None:
             return
         self._template_ids = np.zeros((0,), np.int32)  # default: no sharing
@@ -416,30 +522,28 @@ class ContinuousEngine:
         n_pages = -(-int(ids.size) // self.page_size)
         if self._auto_sized and not self._template_capacity_added:
             # Grow the (still-empty) pool so the permanent template pages
-            # don't eat the per-slot reservation margin the default sizing
+            # don't eat the per-request margin the default sizing
             # guarantees. Runs before any admission; one-time.
             self.total_pages += n_pages
             self._template_capacity_added = True
-            self._cache = self._init_pool()
+            self._cache, self._free_pages = _parked_pool(
+                self._init_pool, self.n_slots, self.total_pages
+            )
         # A user-sized pool must still be able to SERVE after the template
         # moves in permanently — including a max-context COLD request (no
-        # template match gets no page discount), the same hard bound the
-        # admission path enforces. Otherwise sharing is a net loss (or,
-        # worse, allocate() would overflow onto the trash page and every
-        # warm row would read garbage). Skip sharing, don't fail: it is an
-        # optimization.
-        per_row_worst = -(-(self.cfg.max_seq_len + self.chunk) // self.page_size) + 1
-        post_avail = self.total_pages - 1 - n_pages
-        if per_row_worst + (self.n_slots - 1) * self._segment_pages > post_avail:
+        # template match gets no page discount). Otherwise sharing is a net
+        # loss. Skip sharing, don't fail: it is an optimization.
+        if len(self._free_pages) - n_pages < self._per_row_worst:
             log.warning(
                 "prefix sharing disabled: installing the %d-page template "
                 "would leave %d pages, below the max-request bound %d",
-                n_pages, post_avail,
-                per_row_worst + (self.n_slots - 1) * self._segment_pages,
+                n_pages, len(self._free_pages) - n_pages, self._per_row_worst,
             )
             return
+        tpl_pages = [self._free_pages.pop() for _ in range(n_pages)]
         row_view = self._cache._replace(
-            page_table=jnp.zeros((1, self._cache.max_pages), jnp.int32),
+            page_table=jnp.asarray(
+                self._build_row_table(tpl_pages, []))[None, :],
             lengths=jnp.zeros((1,), jnp.int32),
         )
         try:
@@ -454,24 +558,11 @@ class ContinuousEngine:
                 RuntimeError("page pool reset after a failed template prefill")
             )
             raise
-        from edgemesh.runtime.paged_kv import pool_overflowed
-
-        if pool_overflowed(row):  # pragma: no cover — pre-checked above
-            raise RuntimeError("template prefill overflowed the page pool")
         self._cache = row._replace(
             page_table=self._cache.page_table, lengths=self._cache.lengths
         )
-        self._template_pages = [int(p) for p in np.asarray(row.page_table[0])[:n_pages]]
+        self._template_pages = tpl_pages
         self._template_ids = ids
-
-    def _pop_page(self) -> int:
-        """Host-side single-page pop (copy-on-write boundary allocation)."""
-        top = int(self._cache.free_top)
-        if top >= self.total_pages:
-            raise RuntimeError("page pool exhausted during COW admission")
-        page = int(self._cache.free_stack[top])
-        self._cache = self._cache._replace(free_top=jnp.asarray(top + 1, jnp.int32))
-        return page
 
     def _cow_copy(self, src: int, dst: int) -> None:
         """Copy physical page src → dst across all layers (donated, in
@@ -486,32 +577,15 @@ class ContinuousEngine:
             upd["v_scale"] = _copy_page(c.v_scale, src, dst)
         self._cache = c._replace(**upd)
 
-    @property
-    def _segment_pages(self) -> int:
-        """Worst-case pages ONE IDLE slot can allocate across a segment +
-        bridge: idle rows always restart from length 0 (reset at retire /
-        sweep), so chunk + 1 garbage tokens need exactly this many pages."""
-        return -(-(self.chunk + 1) // self.page_size)
-
-    def _reclaim_pages(self, idx: int, pages_reserved: int = 0) -> None:
-        """Reset slot ``idx``'s table row and release its reservation. The
-        free stack itself is REBUILT from the table at the segment boundary
-        (_rebuild_free_stack) — the stack is derivable state, and rebuilding
-        also recovers pages the masked loop popped but whose table writes
-        clamped/dropped at capacity (they are referenced by no row)."""
+    def _park_slot_device(self, idx: int) -> None:
+        """Device half of retirement for paged backends: zero the table row
+        and park the length at 1, so the frozen idle row never allocates and
+        its masked garbage write lands on the trash page. These updates
+        queue AFTER any in-flight segment — which may still advance the
+        retired row for one lag segment, covered by the page reservation."""
         self._cache = self._cache._replace(
             page_table=self._cache.page_table.at[idx].set(0),
-            lengths=self._cache.lengths.at[idx].set(0),
-        )
-        self._reserved_pages -= pages_reserved
-
-    def _rebuild_free_stack(self) -> None:
-        """Host half of the allocator contract (runtime/paged_kv.PagedKVCache
-        docstring: 'the host rebuilds the stack between serving batches'):
-        free = every physical page no table row references. Runs at every
-        segment boundary — O(total_pages) numpy work."""
-        self._cache = _with_rebuilt_stack(
-            self._cache, self.total_pages, self._template_pages
+            lengths=self._cache.lengths.at[idx].set(1),
         )
 
     def _reset_pool(self, exc: Exception) -> None:
@@ -524,44 +598,20 @@ class ContinuousEngine:
                 if not s.future.done():
                     s.future.set_exception(exc)
                 self._slots[i] = _Slot()
+                self._gen[i] += 1
         self._finished = jnp.ones((self.n_slots,), bool)
         if self.kv_backend == "dense":
             self._cache = init_kv_cache(self.cfg, self.n_slots, self.cfg.max_seq_len)
         else:
-            self._cache = self._init_pool()
+            self._cache, self._free_pages = _parked_pool(
+                self._init_pool, self.n_slots, self.total_pages
+            )
             self._reserved_pages = 0
             # Template pages died with the pool; rebuild lazily on the next
             # admission (the capacity bump is one-time and survives).
             self._template_ids = None
             self._template_pages = []
         self._mask = TokenMaskState.init(self.n_slots, self.cfg.vocab_size).mask
-
-    def _maybe_sweep(self, active: list[int], retired: bool) -> None:
-        """Run the page sweep only when page garbage can exist: an idle row
-        rode this segment (its masked advance allocates up to
-        ``_segment_pages``, which admission holds as headroom) or a
-        retirement just freed pages the stack doesn't know about. The
-        steady-state full-pool segment (all slots active, none finished)
-        creates neither, and the sweep's bulk table fetch + stack rebuild
-        are pure host-round-trip cost on the tunneled platform. ONE
-        definition of the invariant — the speculative engine calls this
-        too (its sweep covers both pools)."""
-        if self.kv_backend != "dense" and (retired or len(active) < self.n_slots):
-            self._sweep_idle_pages()
-
-    def _sweep_idle_pages(self) -> None:
-        """Idle slots ride the static-shape decode loop masked, but their
-        garbage lengths still cross page boundaries and ALLOCATE — reset
-        their table rows (their count is bounded by ``_segment_pages`` per
-        idle slot, which admission holds as headroom), then rebuild the
-        free stack from the table. Runs at every segment boundary where an
-        idle row rode the segment or a retirement occurred (_maybe_sweep);
-        full-pool no-retirement segments skip it."""
-        table = np.asarray(self._cache.page_table)
-        for i, s in enumerate(self._slots):
-            if not s.active and (table[i] > 0).any():
-                self._reclaim_pages(i)
-        self._rebuild_free_stack()
 
     def _retire(self, idx: int):
         slot = self._slots[idx]
@@ -585,31 +635,66 @@ class ContinuousEngine:
             }
         )
         if self.kv_backend != "dense":
-            self._reclaim_pages(idx, slot.pages_reserved)
+            self._push_pages(slot.pages)
+            self._park_slot_device(idx)
         self._slots[idx] = _Slot()
+        self._gen[idx] += 1
         self._finished = self._finished.at[idx].set(True)
 
-    def _run_segment(self, active: list[int], eos_id: int) -> None:
-        """One pool-wide decode segment + emit/retire bookkeeping. Segment
-        length is ALWAYS ``chunk`` so _decode_loop compiles exactly once; a
-        row whose budget ends mid-segment overshoots by < chunk forwards
-        and the extras are trimmed host-side. Overridden by the speculative
-        engine with draft→verify rounds."""
+    def _dispatch_segment(self, active: list[int], eos_id: int) -> _Inflight:
+        """Queue one pool-wide decode segment + its bridge on the device and
+        return the output handles WITHOUT waiting. Segment length is ALWAYS
+        ``chunk`` so _decode_loop compiles exactly once; a row whose budget
+        ends mid-segment overshoots by < chunk forwards and the extras are
+        trimmed host-side. Overridden by the speculative engine with
+        draft→verify rounds."""
         agent = self.agent
         self._rng, seg_rng = jax.random.split(self._rng)
-        out, counts, self._cache, _, self._mask, prev, fin = _decode_loop(
+        out, counts, cache, _, mask, prev, fin = _decode_loop(
             self.cfg, agent.params, agent.sampling, self.chunk, eos_id,
             self._logits, self._cache, self._mask, seg_rng,
             self._decode_fn, self._finished,
         )
+        self._mask, self._finished = mask, fin
         self.segments += 1
-        # Single pytree fetch: one blocking round trip per segment
-        # instead of three (each ~0.13s on the tunneled platform).
-        counts_h, out_h, fin_h = jax.device_get((counts, out, fin))
-        self._finished = fin
-        retired = False
-        for i in active:
+        # Bridge into the next segment unconditionally: rows that turn out
+        # to have finished get frozen lengths (finished-aware bridge) and a
+        # masked garbage write. The alternative — waiting to know whether
+        # anyone survives — is exactly the sync this pipeline removes.
+        self._logits, self._cache = self._bridge(
+            self.cfg, agent.params, prev, cache, fin
+        )
+        if self.kv_backend != "dense":
+            # +0 detaches the tripwire snapshot from the cache buffer — the
+            # cache itself is donated into the next segment/admission while
+            # this handle is still awaiting its host fetch.
+            handles = (counts, out, fin, self._cache.free_top + 0)
+        else:
+            handles = (counts, out, fin)
+        _start_host_copy(handles)
+        return _Inflight([(i, self._gen[i]) for i in active], handles)
+
+    def _process_segment(self, seg: _Inflight, eos_id: int) -> None:
+        """Drain one segment's results (its successor is already executing)
+        and run the host-side emit/retire bookkeeping."""
+        fetched = jax.device_get(seg.handles)
+        counts_h, out_h, fin_h = fetched[:3]
+        if self.kv_backend != "dense" and int(fetched[3]) != 1:
+            # Host-owned-allocator tripwire: the device popped pages. A bug,
+            # not a capacity event — pages it handed out are ALSO on the
+            # host free list. Loud log once; the reservation margins keep
+            # rows from touching each other until the pool resets.
+            if not self._pool_tripwire_logged:  # pragma: no cover
+                self._pool_tripwire_logged = True
+                log.error(
+                    "paged-pool tripwire: device allocator popped pages "
+                    "(free_top=%d) despite host-owned pre-mapping",
+                    int(fetched[3]),
+                )
+        for i, gen in seg.rows:
             slot = self._slots[i]
+            if not slot.active or self._gen[i] != gen:
+                continue  # retired earlier and possibly re-admitted
             n = min(int(counts_h[i]), max(slot.remaining, 0))
             toks = [int(t) for t in out_h[i][:n]]
             if toks and toks[-1] == eos_id:
@@ -618,40 +703,31 @@ class ContinuousEngine:
             slot.remaining -= n
             if bool(fin_h[i]) or slot.remaining <= 0:
                 self._retire(i)
-                retired = True
-
-        # Bridge into the next segment for rows still going (the loop
-        # stops before a wasted trailing forward; run it for the batch).
-        # This whole-batch step also advances lengths / writes one KV
-        # row for retired and idle slots — garbage BY DESIGN: idle-slot
-        # state is meaningless until _splice_slot resets lengths on
-        # admission, and writes clamp at capacity. Do not read idle
-        # rows' lengths as if they tracked anything.
-        if any(s.active for s in self._slots):
-            decode_fn = self._decode_fn or forward_decode
-            logits, self._cache = decode_fn(self.cfg, agent.params, prev, self._cache)
-            self._logits = logits.astype(self._logits.dtype)
-        self._maybe_sweep(active, retired)
 
     def _run(self) -> None:
         agent = self.agent
         eos_id = int(getattr(agent.tokenizer, "eos_id", -1))
-        any_active_before = False
+        inflight: _Inflight | None = None
         while True:
             # Admit as many queued requests as there are free slots.
             with self._cond:
-                while not self._queue and not any(s.active for s in self._slots):
+                while (
+                    not self._queue
+                    and not any(s.active for s in self._slots)
+                    and inflight is None
+                ):
                     if self._closed:
                         return
                     self._cond.wait()
                 pending: list[tuple[str, Future, float]] = []
                 free = [i for i, s in enumerate(self._slots) if not s.active]
-                while self._queue and free and len(pending) < len(free):
+                while self._queue and len(pending) < len(free):
                     pending.append(self._queue.popleft())
             free_now = [i for i, s in enumerate(self._slots) if not s.active]
+            mid = any(s.active for s in self._slots) or inflight is not None
             for pos, ((q, fut, ts), idx) in enumerate(zip(pending, free_now)):
                 try:
-                    ok = self._admit(idx, q, fut, ts, mid_flight=any_active_before)
+                    ok = self._admit(idx, q, fut, ts, mid_flight=mid)
                 except Exception as exc:
                     # Fail only THIS request: already-admitted slots keep
                     # their pending futures (poisoning them would make the
@@ -664,7 +740,7 @@ class ContinuousEngine:
                 if not ok:
                     # Page-pool capacity: re-queue this and the rest of the
                     # batch (order preserved); they admit at a later segment
-                    # boundary once retirements reclaim pages. Reservations
+                    # boundary once retirements reclaim pages. Held pages
                     # imply active rows exist, so the loop cannot spin.
                     with self._cond:
                         for item in reversed(pending[pos:]):
@@ -673,45 +749,31 @@ class ContinuousEngine:
 
             active = [i for i, s in enumerate(self._slots) if s.active]
             self.max_concurrent = max(self.max_concurrent, len(active))
-            any_active_before = bool(active)
-            if not active:
-                continue
 
-            # One decode segment over the whole pool; idle rows are finished.
-            # A failure anywhere in the segment must not kill the worker —
-            # fail the in-flight futures, reset the pool, keep serving.
-            try:
-                self._run_segment(active, eos_id)
-            except Exception as exc:
-                log.exception("decode segment failed; failing %d in-flight requests", len(active))
-                self._reset_pool(exc)
-
-            # Give stragglers a brief window to queue before the next segment
-            # (they join at the boundary either way; this just batches admits).
-            with self._cond:
-                if not self._queue and any(s.active for s in self._slots):
-                    self._cond.wait(timeout=0.001)
-
-
-def _with_rebuilt_stack(cache, total_pages: int, permanent, table=None) -> "PagedKVCache":
-    """free = every physical page referenced by no table row (and not
-    permanent, e.g. template pages). Shared by the target and draft pools.
-    ``table`` lets a caller that already fetched (and host-side mutated)
-    the page table skip a second blocking device readback."""
-    if table is None:
-        table = np.asarray(cache.page_table)
-    used = np.unique(np.concatenate([
-        table[table > 0].astype(np.int32),
-        np.asarray(list(permanent), np.int32),
-    ]))
-    free = np.setdiff1d(np.arange(1, total_pages, dtype=np.int32), used)
-    stack = np.zeros((total_pages,), np.int32)
-    top = total_pages - free.size
-    stack[top:] = free
-    return cache._replace(
-        free_stack=jnp.asarray(stack),
-        free_top=jnp.asarray(top, jnp.int32),
-    )
+            # Depth-2 pipeline: dispatch the next segment BEFORE draining the
+            # previous one — the fetch + bookkeeping below overlap with the
+            # device executing this dispatch. A failure anywhere must not
+            # kill the worker: fail the in-flight futures, reset, continue.
+            cur: _Inflight | None = None
+            if active:
+                try:
+                    cur = self._dispatch_segment(active, eos_id)
+                except Exception as exc:
+                    log.exception(
+                        "segment dispatch failed; failing %d in-flight requests",
+                        len(active),
+                    )
+                    self._reset_pool(exc)
+            if inflight is not None:
+                try:
+                    self._process_segment(inflight, eos_id)
+                except Exception as exc:
+                    log.exception(
+                        "segment processing failed; failing in-flight requests"
+                    )
+                    self._reset_pool(exc)
+                    cur = None  # its handles died with the pool
+            inflight = cur
 
 
 class SpeculativeContinuousEngine(ContinuousEngine):
@@ -723,16 +785,19 @@ class SpeculativeContinuousEngine(ContinuousEngine):
     request in flight gets draft acceleration while requests still join and
     leave at segment boundaries. Both models' KV live as page pools; the
     verify rewind is a lengths rollback, safe on pages because the allocator
-    reuses table entries on re-advance (rewind-idempotent).
+    reuses table entries on re-advance (rewind-idempotent). Pages for BOTH
+    pools are host-owned and pre-mapped at admission, exactly like the base
+    engine; segments pipeline depth-2 the same way (the spec body freezes
+    inactive rows itself, so the retirement lag costs nothing here).
 
     Contracts beyond the base engine:
     - paged backend only, and the agent must carry a draft
       (``AgentSpec.draft``) sharing the target's tokenizer/vocab.
     - uniform budget: every request decodes up to
       ``sampling.max_new_tokens``; a prompt too long for
-      prompt + budget + gamma + 1 tokens in one table row is refused at
-      admission (the dense engine clamps instead — spec rounds share one
-      static max_new).
+      prompt + budget + gamma + 1 tokens in the model context (or one table
+      row) is refused at admission (the dense engine clamps instead — spec
+      rounds share one static max_new).
     - admissions are always cold (no template prefix sharing: the draft
       pool holds no template KV, and a warm target + cold draft would
       desynchronize the verify positions).
@@ -774,27 +839,44 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             )
         if int(agent.spec_gamma) < 1:
             raise ValueError(f"spec_gamma must be >= 1, got {agent.spec_gamma}")
+        if int(page_size) < int(agent.spec_gamma) + 3:
+            # Parked rows sit at length 1; a verify chunk writes gamma+1
+            # rewind-idempotent positions there, which must stay inside
+            # logical page 0 or idle rows would allocate.
+            raise ValueError(
+                f"page_size must be >= spec_gamma + 3 "
+                f"(got {page_size} vs gamma {agent.spec_gamma})"
+            )
         super().__init__(
             agent, slots=slots, chunk=chunk, idle_wait_s=idle_wait_s,
             kv_backend=kv_backend, page_size=page_size, total_pages=total_pages,
         )
-        from edgemesh.runtime.speculative import _spec_fns
+        # The worker thread is live from here on: a failure below would
+        # orphan it blocked on the condition with a half-built engine —
+        # close it on the way out (round-3 advisor finding).
+        try:
+            from edgemesh.runtime.speculative import _spec_fns
 
-        self.gamma = int(agent.spec_gamma)
-        self.max_new = int(agent.sampling.max_new_tokens)
-        self.cap = self.max_new + self.gamma + 1
-        self.rounds_per_segment = max(1, self.chunk // (self.gamma + 1))
-        self._verify_fn, self._spec_decode_fn = _spec_fns("paged")
-        per_row = self._cache.page_table.shape[1]
-        self._d_total = int(draft_total_pages or self.total_pages)
-        d_cfg = agent.draft_cfg
-        self._init_dpool = lambda: init_paged_cache(
-            d_cfg, self.n_slots, total_pages=self._d_total,
-            page_size=self.page_size, max_pages=per_row,
-        )
-        self._dcache = self._init_dpool()
-        self._dreserved = 0
-        self._spec_reset_arrays()
+            self.gamma = int(agent.spec_gamma)
+            self.max_new = int(agent.sampling.max_new_tokens)
+            self.cap = self.max_new + self.gamma + 1
+            self.rounds_per_segment = max(1, self.chunk // (self.gamma + 1))
+            self._verify_fn, self._spec_decode_fn = _spec_fns("paged")
+            per_row = self._cache.page_table.shape[1]
+            self._d_total = int(draft_total_pages or self.total_pages)
+            d_cfg = agent.draft_cfg
+            self._init_dpool = lambda: init_paged_cache(
+                d_cfg, self.n_slots, total_pages=self._d_total,
+                page_size=self.page_size, max_pages=per_row,
+            )
+            self._dcache, self._dfree = _parked_pool(
+                self._init_dpool, self.n_slots, self._d_total
+            )
+            self._dslot_pages: dict[int, list[int]] = {}
+            self._spec_reset_arrays()
+        except Exception:
+            self.close()
+            raise
 
     def _spec_reset_arrays(self) -> None:
         b = self.n_slots
@@ -815,16 +897,6 @@ class SpeculativeContinuousEngine(ContinuousEngine):
     def _ensure_template(self) -> None:
         return
 
-    @property
-    def _segment_pages(self) -> int:
-        """Idle rows never ADVANCE lengths in spec rounds (the body masks
-        inactive rows' commits), but the draft step writes one position and
-        the verify chunk writes gamma+1 at the row's frozen position —
-        rewind-idempotent table entries, so the bound is one chunk's pages
-        + a boundary page, reclaimed by the sweep at every boundary where
-        idle rows exist (_maybe_sweep)."""
-        return -(-(self.gamma + 2) // self.page_size) + 1
-
     def _admit(self, idx: int, question: str, fut: Future, t_submit: float,
                mid_flight: bool) -> bool:
         agent = self.agent
@@ -833,38 +905,45 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         tokens, lengths, _ = agent._prepare_batch([prompt])
         plen = int(lengths[0])
         row_cap = self._cache.page_table.shape[1] * self.page_size
-        if plen + self.max_new + self.gamma + 1 > row_cap:
+        # One uniform static budget per pool: refuse (don't clamp) prompts
+        # that cannot hold prompt + budget + the verify chunk's gamma+1
+        # transient — against BOTH the table row and the model context
+        # (positions past max_seq_len would feed RoPE/attention out of the
+        # model's declared range; round-3 advisor finding).
+        limit = min(row_cap, int(self.cfg.max_seq_len))
+        if plen + self.max_new + self.gamma + 1 > limit:
             raise ValueError(
                 f"prompt ({plen} tokens) + budget ({self.max_new}) + "
-                f"gamma+1 ({self.gamma + 1}) exceeds the row capacity "
-                f"({row_cap}); the speculative engine keeps one uniform "
+                f"gamma+1 ({self.gamma + 1}) exceeds the usable context "
+                f"({limit}); the speculative engine keeps one uniform "
                 "budget per pool"
             )
         # Worst-case pages per pool: the verify chunk transiently writes
-        # gamma+1 tokens past the committed length before the rewind.
-        need = -(-(plen + self.max_new + self.gamma + 1) // self.page_size) + 1
-        idle_after = sum(1 for s in self._slots if not s.active) - 1
-        headroom = idle_after * self._segment_pages
-        slack = (self.n_slots - 1) * self._segment_pages
-        avail_t = self.total_pages - 1
-        avail_d = self._d_total - 1
-        if need + slack > min(avail_t, avail_d):
+        # gamma+1 tokens past the committed length before the rewind. (No
+        # pipeline-lag margin: the spec body freezes budget-complete rows
+        # itself.) Fits the table row by the admission check above.
+        need = -(-(plen + self.max_new + self.gamma + 1) // self.page_size)
+        cap_both = min(self.total_pages - 1, self._d_total - 1)
+        if need > cap_both:
             raise ValueError(
                 f"request needs {need} pages (prompt {plen} + budget "
-                f"{self.max_new} + gamma overshoot); the pools hold "
-                f"{min(avail_t, avail_d)} minus idle-slot headroom"
+                f"{self.max_new} + gamma overshoot); the pool holds {cap_both}"
             )
-        if (self._reserved_pages + need + headroom > avail_t
-                or self._dreserved + need + headroom > avail_d):
+        if need > len(self._free_pages) or need > len(self._dfree):
             return False  # capacity — re-queue, admit at a later boundary
 
+        pages = self._pop_pages(need)
+        dpages = [self._dfree.pop() for _ in range(need)]
+        row_table = self._build_row_table([], pages)
+        drow_table = self._build_row_table([], dpages)
         try:
             logits1, self._cache = _prefill_into_row(
-                self.cfg, agent.params, tokens, lengths, self._cache, idx
+                self.cfg, agent.params, tokens, lengths, self._cache, idx,
+                row_table,
             )
             _, self._dcache = _prefill_into_row(
                 agent.draft_cfg, agent.draft_params, tokens, lengths,
-                self._dcache, idx,
+                self._dcache, idx, drow_table,
             )
         except Exception:
             self._reset_pool(
@@ -892,18 +971,18 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         self._conf = self._conf.at[idx].set(row.conf_sum[0])
         self._mask = self._mask.at[idx].set(row.mask[0])
         self._finished = self._finished.at[idx].set(row.finished[0])
-        self._reserved_pages += need
-        self._dreserved += need
         self._slots[idx] = _Slot(
             future=fut, question=question, emitted=[], remaining=self.max_new,
             t_submit=t_submit, t_start=time.perf_counter(),
-            pages_reserved=need,
+            pages=pages, taken=0,
         )
+        self._dslot_pages[idx] = dpages
+        self._gen[idx] += 1
         if mid_flight:
             self.admitted_mid_flight += 1
         return True
 
-    def _run_segment(self, active: list[int], eos_id: int) -> None:
+    def _dispatch_segment(self, active: list[int], eos_id: int) -> _Inflight:
         from edgemesh.runtime.speculative import _SpecState
 
         agent = self.agent
@@ -925,14 +1004,31 @@ class SpeculativeContinuousEngine(ContinuousEngine):
          self._finished, self._mask, _, self._conf, self._acc, self._prop,
          self._rnds) = state
         self.segments += 1
-        nemit_h, out_h, fin_h, acc_h, prop_h, rnds_h = jax.device_get(
-            (state.n_emit, state.out, state.finished,
-             state.accepted, state.proposed, state.rounds)
+        # Detach every fetched handle from the state buffers: the NEXT
+        # segment's _spec_rounds_donated donates the whole state, which
+        # would delete these mid-fetch (+0 / double-not copy).
+        handles = (
+            state.n_emit + 0, state.out + 0, ~~state.finished,
+            state.accepted + 0, state.proposed + 0, state.rounds + 0,
+            self._cache.free_top + 0, self._dcache.free_top + 0,
         )
+        _start_host_copy(handles)
+        return _Inflight([(i, self._gen[i]) for i in active], handles)
+
+    def _process_segment(self, seg: _Inflight, eos_id: int) -> None:
+        fetched = jax.device_get(seg.handles)
+        nemit_h, out_h, fin_h, acc_h, prop_h, rnds_h, ft_t, ft_d = fetched
         self._spec_counters_host = (int(acc_h), int(prop_h), int(rnds_h))
-        retired = False
-        for i in active:
+        if (int(ft_t) != 1 or int(ft_d) != 1) and not self._pool_tripwire_logged:
+            self._pool_tripwire_logged = True  # pragma: no cover
+            log.error(
+                "spec paged-pool tripwire: device allocator popped pages "
+                "(target free_top=%d, draft free_top=%d)", int(ft_t), int(ft_d),
+            )
+        for i, gen in seg.rows:
             slot = self._slots[i]
+            if not slot.active or self._gen[i] != gen:
+                continue
             total = min(int(nemit_h[i]), self.max_new)
             toks = [int(t) for t in out_h[i][slot.taken : total]]
             if toks and toks[-1] == eos_id:
@@ -942,52 +1038,25 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             slot.remaining = self.max_new - total
             if bool(fin_h[i]) or total >= self.max_new:
                 self._retire(i)
-                retired = True
-        self._maybe_sweep(active, retired)
 
     def _retire(self, idx: int) -> None:
-        reserved = self._slots[idx].pages_reserved  # same need in both pools
         super()._retire(idx)
-        self._dreserved -= reserved
+        self._dfree.extend(self._dslot_pages.pop(idx, []))
         self._dcache = self._dcache._replace(
             page_table=self._dcache.page_table.at[idx].set(0),
-            lengths=self._dcache.lengths.at[idx].set(0),
-        )
-
-    def _sweep_idle_pages(self) -> None:
-        # ONE bulk fetch for both tables; the reclaim loop mirrors its
-        # zeroing onto the host copies so the rebuilds can reuse them
-        # instead of re-reading the device (each readback ~0.13s tunneled).
-        table, dtable = jax.device_get(
-            (self._cache.page_table, self._dcache.page_table)
-        )
-        # device_get hands back read-only views; the loop mutates them.
-        table, dtable = np.array(table), np.array(dtable)
-        for i, s in enumerate(self._slots):
-            if not s.active:
-                if (table[i] > 0).any():
-                    self._reclaim_pages(i)
-                    table[i] = 0
-                if (dtable[i] > 0).any():
-                    self._dcache = self._dcache._replace(
-                        page_table=self._dcache.page_table.at[i].set(0),
-                        lengths=self._dcache.lengths.at[i].set(0),
-                    )
-                    dtable[i] = 0
-        self._cache = _with_rebuilt_stack(
-            self._cache, self.total_pages, self._template_pages, table=table
-        )
-        self._dcache = _with_rebuilt_stack(
-            self._dcache, self._d_total, (), table=dtable
+            lengths=self._dcache.lengths.at[idx].set(1),
         )
 
     def _reset_pool(self, exc: Exception) -> None:
         super()._reset_pool(exc)
         # Every donated spec buffer may be invalid; rebuild them all (the
         # cumulative accept/propose counters reset with the pool).
-        self._dcache = self._init_dpool()
-        self._dreserved = 0
-        self._spec_reset_arrays()
+        if hasattr(self, "_init_dpool"):
+            self._dcache, self._dfree = _parked_pool(
+                self._init_dpool, self.n_slots, self._d_total
+            )
+            self._dslot_pages = {}
+            self._spec_reset_arrays()
 
     def stats(self) -> dict:
         out = super().stats()
