@@ -90,6 +90,173 @@ def _rmw_scale_kernel(
     vs_out[0] = jnp.where(iot == slot, vt, vs_in[0])
 
 
+def _rmw_chunk_kernel(
+    pages_ref,  # SMEM [b, npg] int32 — physical page per (row, chunk page)
+    off_ref,  # SMEM [b] int32 — start % ps per row
+    vlen_ref,  # SMEM [b] int32 — valid chunk tokens per row
+    kf_ref,  # VMEM block [1, 1, 1, kh, ps, hd] — page-aligned fresh K
+    vf_ref,
+    k_in,  # block [1, kh, ps, hd] (aliased in/out)
+    v_in,
+    k_out,
+    v_out,
+    *,
+    page_size: int,
+):
+    i = pl.program_id(0)
+    p = pl.program_id(2)
+    shape = k_in.shape[1:]  # [kh, ps, hd]
+    j = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    t = p * page_size + j - off_ref[i]  # chunk-token index at each slot
+    hit = (t >= 0) & (t < vlen_ref[i])
+    k_out[0] = jnp.where(hit, kf_ref[0, 0, 0].astype(k_out.dtype), k_in[0])
+    v_out[0] = jnp.where(hit, vf_ref[0, 0, 0].astype(v_out.dtype), v_in[0])
+
+
+def _rmw_chunk_scale_kernel(
+    pages_ref,
+    off_ref,
+    vlen_ref,
+    ksf_ref,  # VMEM block [1, 1, 1, kh, 1, ps] f32
+    vsf_ref,
+    ks_in,  # block [1, kh, 1, ps] f32 (aliased in/out)
+    vs_in,
+    ks_out,
+    vs_out,
+    *,
+    page_size: int,
+):
+    i = pl.program_id(0)
+    p = pl.program_id(2)
+    shape = ks_in.shape[1:]  # [kh, 1, ps]
+    j = jax.lax.broadcasted_iota(jnp.int32, shape, 2)
+    t = p * page_size + j - off_ref[i]
+    hit = (t >= 0) & (t < vlen_ref[i])
+    ks_out[0] = jnp.where(hit, ksf_ref[0, 0, 0], ks_in[0])
+    vs_out[0] = jnp.where(hit, vsf_ref[0, 0, 0], vs_in[0])
+
+
+def _align_chunk(fresh: jnp.ndarray, off: jnp.ndarray, npg: int, ps: int):
+    """[L, b, s, kh, hd] → [L, b, npg, kh, ps, hd]: slot (p, j) of row i
+    holds chunk token ``p*ps + j - off[i]`` (clamped; the kernel masks
+    out-of-range slots), so kernel blocks never need unaligned fresh
+    reads."""
+    L, b, s, kh, hd = fresh.shape
+    t = jnp.arange(npg * ps)[None, :] - off[:, None]  # [b, npg*ps]
+    tc = jnp.clip(t, 0, s - 1)
+    g = jnp.take_along_axis(fresh, tc[None, :, :, None, None], axis=2)
+    return g.reshape(L, b, npg, ps, kh, hd).transpose(0, 1, 2, 4, 3, 5)
+
+
+def _align_chunk_scales(scales: jnp.ndarray, off: jnp.ndarray, npg: int, ps: int):
+    """[L, b, s, kh] f32 → [L, b, npg, kh, 1, ps]."""
+    L, b, s, kh = scales.shape
+    t = jnp.arange(npg * ps)[None, :] - off[:, None]
+    tc = jnp.clip(t, 0, s - 1)
+    g = jnp.take_along_axis(scales, tc[None, :, :, None], axis=2)
+    return g.reshape(L, b, npg, ps, kh).transpose(0, 1, 2, 4, 3)[:, :, :, :, None, :]
+
+
+def write_chunk_all_layers(
+    cache,
+    fresh_k: jnp.ndarray,  # [L, b, s, kh, hd] (int8 for the quant pool)
+    fresh_v: jnp.ndarray,
+    start: jnp.ndarray,  # [b] tokens already present per row
+    valid_len: jnp.ndarray,  # [b] real chunk tokens per row (≤ s)
+    fresh_ks: jnp.ndarray | None = None,  # [L, b, s, kh] f32 (quant pool)
+    fresh_vs: jnp.ndarray | None = None,
+    interpret: bool = False,
+):
+    """Write an s-token chunk per row into its pages, every layer at once,
+    in place — the prefill/suffix/verify twin of write_decode_all_layers
+    (identical indexing to write_tokens(start, valid_len), minus the
+    scatter). Each (row, layer, chunk-page) grid step read-modify-writes one
+    page block; a chunk straddles at most ceil(s/ps)+1 pages."""
+    if not HAVE_PALLAS:  # pragma: no cover
+        raise RuntimeError("pallas unavailable")
+    L, P, kh, ps, hd = cache.k.shape
+    b, s = fresh_k.shape[1], fresh_k.shape[2]
+    quant = fresh_ks is not None
+    npg = -(-s // ps) + 1
+    lp0 = (start // ps).astype(jnp.int32)
+    off = (start % ps).astype(jnp.int32)
+    pidx = jnp.minimum(
+        lp0[:, None] + jnp.arange(npg, dtype=jnp.int32)[None, :],
+        cache.page_table.shape[1] - 1,
+    )
+    pages = jnp.take_along_axis(cache.page_table, pidx, axis=1).astype(jnp.int32)
+
+    def pool_map(i, l, p, pages, off, vlen):
+        return (l * P + pages[i, p], 0, 0, 0)
+
+    def fresh_map(i, l, p, pages, off, vlen):
+        return (l, i, p, 0, 0, 0)
+
+    k4 = cache.k.reshape(L * P, kh, ps, hd)
+    v4 = cache.v.reshape(L * P, kh, ps, hd)
+    kf = _align_chunk(fresh_k.astype(cache.k.dtype), off, npg, ps)
+    vf = _align_chunk(fresh_v.astype(cache.v.dtype), off, npg, ps)
+
+    new_k, new_v = pl.pallas_call(
+        functools.partial(_rmw_chunk_kernel, page_size=ps),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, L, npg),
+            in_specs=[
+                pl.BlockSpec((1, 1, 1, kh, ps, hd), fresh_map),
+                pl.BlockSpec((1, 1, 1, kh, ps, hd), fresh_map),
+                pl.BlockSpec((1, kh, ps, hd), pool_map),
+                pl.BlockSpec((1, kh, ps, hd), pool_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, kh, ps, hd), pool_map),
+                pl.BlockSpec((1, kh, ps, hd), pool_map),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(k4.shape, k4.dtype),
+            jax.ShapeDtypeStruct(v4.shape, v4.dtype),
+        ],
+        input_output_aliases={5: 0, 6: 1},
+        interpret=interpret,
+    )(pages, off, valid_len.astype(jnp.int32), kf, vf, k4, v4)
+    upd = dict(
+        k=new_k.reshape(L, P, kh, ps, hd), v=new_v.reshape(L, P, kh, ps, hd)
+    )
+
+    if quant:
+        ks4 = cache.k_scale.reshape(L * P, kh, 1, ps)
+        vs4 = cache.v_scale.reshape(L * P, kh, 1, ps)
+        ksf = _align_chunk_scales(fresh_ks.astype(jnp.float32), off, npg, ps)
+        vsf = _align_chunk_scales(fresh_vs.astype(jnp.float32), off, npg, ps)
+        new_ks, new_vs = pl.pallas_call(
+            functools.partial(_rmw_chunk_scale_kernel, page_size=ps),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=3,
+                grid=(b, L, npg),
+                in_specs=[
+                    pl.BlockSpec((1, 1, 1, kh, 1, ps), fresh_map),
+                    pl.BlockSpec((1, 1, 1, kh, 1, ps), fresh_map),
+                    pl.BlockSpec((1, kh, 1, ps), pool_map),
+                    pl.BlockSpec((1, kh, 1, ps), pool_map),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, kh, 1, ps), pool_map),
+                    pl.BlockSpec((1, kh, 1, ps), pool_map),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct(ks4.shape, jnp.float32),
+                jax.ShapeDtypeStruct(vs4.shape, jnp.float32),
+            ],
+            input_output_aliases={5: 0, 6: 1},
+            interpret=interpret,
+        )(pages, off, valid_len.astype(jnp.int32), ksf, vsf, ks4, vs4)
+        upd["k_scale"] = new_ks.reshape(L, P, kh, 1, ps)
+        upd["v_scale"] = new_vs.reshape(L, P, kh, 1, ps)
+    return cache._replace(**upd)
+
+
 def write_decode_all_layers(
     cache,
     fresh_k: jnp.ndarray,  # [L, b, kh, hd] (int8 for the quant pool)

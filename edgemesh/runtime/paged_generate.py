@@ -323,9 +323,14 @@ def forward_prefill_paged(
     positions = jnp.minimum(
         jnp.broadcast_to(jnp.arange(s)[None, :], (b, s)), (lengths - 1)[:, None]
     )
-    logits, cache = _paged_forward(
-        cfg, params, tokens, positions, cache, lengths, is_decode=False
-    )
+    if _use_flash(cfg):
+        logits, cache = _paged_forward_prefill_hoisted(
+            cfg, params, tokens, positions, cache, lengths
+        )
+    else:
+        logits, cache = _paged_forward(
+            cfg, params, tokens, positions, cache, lengths, is_decode=False
+        )
     last = logits[jnp.arange(b), lengths - 1]
     return last, cache._replace(lengths=lengths)
 
@@ -349,10 +354,19 @@ def _paged_append(
     kv_lens = start + lengths
     max_cols = cache.max_pages * cache.page_size
     kv_valid = jnp.arange(max_cols)[None, :] < kv_lens[:, None]
-    logits, cache = _paged_forward(
-        cfg, params, tokens, positions, cache, kv_lens, is_decode=False,
-        attention=_paged_suffix_attention, kv_valid=kv_valid,
-    )
+    quant = isinstance(cache, QuantPagedKVCache)
+    if _use_flash(cfg) and not _use_chunk_kernel(cfg, quant):
+        # Hoisted-write path (default on TPU): gather-overlay attention +
+        # one chunk-RMW kernel. The opt-in chunk kernel reads pages
+        # directly, so it keeps the write-in-scan semantics.
+        logits, cache = _paged_forward_suffix_hoisted(
+            cfg, params, tokens, positions, cache, kv_lens, start, kv_valid
+        )
+    else:
+        logits, cache = _paged_forward(
+            cfg, params, tokens, positions, cache, kv_lens, is_decode=False,
+            attention=_paged_suffix_attention, kv_valid=kv_valid,
+        )
     return logits, cache._replace(lengths=kv_lens)
 
 
@@ -395,6 +409,174 @@ def forward_verify_paged(
     b, s = tokens.shape
     full = jnp.full((b,), s, jnp.int32)
     return _paged_append(cfg, params, tokens, full, cache, cache.lengths)
+
+
+def _paged_forward_prefill_hoisted(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b, s] right-padded chunk
+    positions: jnp.ndarray,
+    cache,
+    kv_lens: jnp.ndarray,  # [b] valid tokens AFTER this call's writes
+):
+    """Cold prefill with hoisted page writes (the chunk twin of
+    _paged_forward_decode_hoisted): pages start empty for these rows, so
+    attention runs over the fresh prompt K/V alone — the pool is never
+    read OR written inside the scan. The scan emits per-layer fresh K/V and
+    ONE aliased chunk-RMW kernel (ops/paged_write.write_chunk_all_layers)
+    commits them, replacing the per-layer scatter whose cost scaled with
+    pool bytes × layers (~8 ms per admission at serving shapes)."""
+    from edgemesh.ops.paged_write import write_chunk_all_layers
+
+    pool = cache
+    x = embed_tokens(cfg, params, tokens, positions)
+    quant = isinstance(pool, QuantPagedKVCache)
+    interp = cfg.attention_impl == "flash" and not on_tpu()
+    b, s = tokens.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+
+    def attention(acfg, layer, ax, apos, cache, kv_valid, lengths, is_decode):
+        q, k, v = qkv_proj(acfg, layer, ax, apos)
+        if quant:
+            from edgemesh.runtime.quant_kv import quantize_kv
+
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            fresh = (kq, vq, ks, vs)
+            # Attend over the values decode will read back: the int8
+            # roundtrip (dense quant-KV backend parity).
+            k = (kq.astype(jnp.float32) * ks[..., None]).astype(k.dtype)
+            v = (vq.astype(jnp.float32) * vs[..., None]).astype(v.dtype)
+        else:
+            fresh = (k, v)
+        if _use_flash(acfg):
+            from edgemesh.ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q, k, v, kv_lens, causal=True, scale=acfg.query_scale,
+                interpret=interp, sliding_window=acfg.sliding_window,
+                soft_cap=acfg.attn_soft_cap,
+            )
+        else:
+            prompt_valid = jnp.arange(s)[None, :] < kv_lens[:, None]
+            out = attend(
+                q, LayerKV(k, v), apos, prompt_valid, scale=acfg.query_scale,
+                sliding_window=acfg.sliding_window, soft_cap=acfg.attn_soft_cap,
+            )
+        proj = dense(layer["o"], out.reshape(b, s, nh * hd), acfg.quant_mode)
+        return proj, fresh
+
+    def body(layer_cfg, h, layer):
+        h, fresh, _aux = _layer_fn(
+            layer_cfg, h, layer, None, positions, None, None, False, attention
+        )
+        return h, fresh
+
+    x, fresh = layer_scan_alt_windows(cfg, body, x, params["layers"])
+    zeros = jnp.zeros_like(kv_lens)
+    if quant:
+        fk, fv, fks, fvs = fresh
+        pool = write_chunk_all_layers(
+            pool, fk, fv, zeros, kv_lens, fks, fvs, interpret=interp
+        )
+    else:
+        fk, fv = fresh
+        pool = write_chunk_all_layers(pool, fk, fv, zeros, kv_lens, interpret=interp)
+    return lm_head_logits(cfg, params, x), pool
+
+
+def _paged_forward_suffix_hoisted(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [b, s] right-padded suffix chunk
+    positions: jnp.ndarray,  # [b, s] absolute positions
+    cache,
+    kv_lens: jnp.ndarray,  # [b] valid tokens AFTER this call's writes
+    start: jnp.ndarray,  # [b] tokens already present per row
+    kv_valid: jnp.ndarray,  # [b, max_pages*ps]
+):
+    """Suffix/verify chunk append with hoisted page writes: the scan READS
+    the old pages (dense gather, as the oracle path always has) and overlays
+    the fresh chunk onto the gathered view with a masked where — never
+    writing pages in-scan. One chunk-RMW kernel commits all layers after.
+    This is what the speculative verify step pays every round, so the
+    scatter's pool-sized cost mattered even more here than at admission."""
+    from edgemesh.ops.paged_write import write_chunk_all_layers
+    from edgemesh.runtime.paged_kv import gather_dense, gather_dense_scales
+
+    pool = cache
+    x = embed_tokens(cfg, params, tokens, positions)
+    quant = isinstance(pool, QuantPagedKVCache)
+    interp = cfg.attention_impl == "flash" and not on_tpu()
+    b, s = tokens.shape
+    nh, hd = cfg.num_heads, cfg.head_size
+    max_cols = pool.max_pages * pool.page_size
+    cols = jnp.arange(max_cols)[None, :]
+    in_chunk = (cols >= start[:, None]) & (cols < kv_lens[:, None])
+    tidx = jnp.clip(cols - start[:, None], 0, s - 1)  # [b, max_cols]
+
+    def overlay(dense_view, fresh_chunk):
+        full = jnp.take_along_axis(
+            fresh_chunk.astype(dense_view.dtype),
+            tidx[..., None, None], axis=1,
+        )
+        return jnp.where(in_chunk[..., None, None], full, dense_view)
+
+    def attention(acfg, layer, ax, apos, cache, kv_valid, lengths, is_decode):
+        kv = cache  # per-layer page slices from the scan xs (read-only)
+        q, k, v = qkv_proj(acfg, layer, ax, apos)
+        if quant:
+            from edgemesh.runtime.quant_kv import quantize_kv
+
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            fresh = (kq, vq, ks, vs)
+            k_r = (kq.astype(jnp.float32) * ks[..., None]).astype(k.dtype)
+            v_r = (vq.astype(jnp.float32) * vs[..., None]).astype(v.dtype)
+            dense_k = gather_dense(kv[0], pool.page_table).astype(jnp.float32)
+            dense_v = gather_dense(kv[1], pool.page_table).astype(jnp.float32)
+            dks = gather_dense_scales(kv[2], pool.page_table)
+            dvs = gather_dense_scales(kv[3], pool.page_table)
+            dense_k = (dense_k * dks[..., None]).astype(ax.dtype)
+            dense_v = (dense_v * dvs[..., None]).astype(ax.dtype)
+        else:
+            fresh = (k, v)
+            k_r, v_r = k, v
+            dense_k = gather_dense(kv[0], pool.page_table)
+            dense_v = gather_dense(kv[1], pool.page_table)
+        dense_k = overlay(dense_k, k_r)
+        dense_v = overlay(dense_v, v_r)
+        out = attend(
+            q, LayerKV(dense_k, dense_v), apos, kv_valid,
+            scale=acfg.query_scale, sliding_window=acfg.sliding_window,
+            soft_cap=acfg.attn_soft_cap,
+        )
+        proj = dense(layer["o"], out.reshape(b, s, nh * hd), acfg.quant_mode)
+        return proj, fresh
+
+    def body(layer_cfg, h, scanned):
+        layer, *kv = scanned
+        h, fresh, _aux = _layer_fn(
+            layer_cfg, h, layer, tuple(kv), positions, kv_valid, start,
+            False, attention,
+        )
+        return h, fresh
+
+    xs = (params["layers"], pool.k, pool.v)
+    if quant:
+        xs += (pool.k_scale, pool.v_scale)
+    x, fresh = layer_scan_alt_windows(cfg, body, x, xs)
+    if quant:
+        fk, fv, fks, fvs = fresh
+        pool = write_chunk_all_layers(
+            pool, fk, fv, start, kv_lens - start, fks, fvs, interpret=interp
+        )
+    else:
+        fk, fv = fresh
+        pool = write_chunk_all_layers(
+            pool, fk, fv, start, kv_lens - start, interpret=interp
+        )
+    return lm_head_logits(cfg, params, x), pool
 
 
 def _paged_forward_decode_hoisted(
