@@ -461,6 +461,35 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
 
         params = init_params(cfg, jax.random.PRNGKey(crc32(role_seed.encode()) % (2**31)))
 
+    if ms.lora_base:
+        # LoRA-over-a-trained-model: restore a FULL checkpoint as the frozen
+        # base FIRST. With lora_rank > 0, ``train_checkpoint`` then stays
+        # the ADAPTER tree trained on top of exactly this base — without
+        # this field, finetuning a previously trained model was
+        # inexpressible (train_checkpoint can only mean one of the two).
+        from edgemesh.runtime.checkpoint import TrainCheckpointManager
+        from edgemesh.training import init_train_state, make_optimizer
+
+        if ms.lora_rank <= 0 and ms.train_checkpoint:
+            raise ValueError(
+                "lora_base with lora_rank == 0 AND train_checkpoint is "
+                "ambiguous (two full checkpoints); point train_checkpoint "
+                "at the adapter run and set lora_rank, or drop lora_base"
+            )
+        mgr = TrainCheckpointManager(ms.lora_base)
+        restored = mgr.restore_latest(
+            init_train_state(cfg, params, make_optimizer())
+        )
+        mgr.close()
+        if restored is None:
+            raise ValueError(
+                f"no full checkpoint found under lora_base={ms.lora_base!r} "
+                "(expected an `edgemesh train` run with lora_rank 0)"
+            )
+        params = restored[0].params
+        log.info("%s: restored lora_base weights from %s (step %d)",
+                 role_seed, ms.lora_base, restored[1])
+
     if ms.train_checkpoint:
         # Swap in finetuned weights from an `edgemesh train` run BEFORE any
         # precision transform below, so int8/int4 rows quantize the TRAINED

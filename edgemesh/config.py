@@ -123,6 +123,13 @@ class ModelSpec:
     lora_alpha: float = 16.0
     # Comma-separated dense projections to adapt (q/k/v/o/gate/up/down).
     lora_targets: str = "q,k,v,o"
+    # Path to a FULL train checkpoint (an `edgemesh train` run with
+    # lora_rank 0) restored as the FROZEN BASE before anything else — the
+    # LoRA-finetune-a-trained-model flow: train with lora_rank > 0 +
+    # lora_base to adapt that model, then serve with the same lora_base +
+    # train_checkpoint pointed at the ADAPTER run. Without it, adapters
+    # train/merge over the spec's synthetic or HF init.
+    lora_base: str = ""
     # SmoothQuant calibration for int8 precisions: path to a text file of
     # calibration prompts (one per line). When set, quantization smooths
     # activation outliers into the weights using these prompts' statistics

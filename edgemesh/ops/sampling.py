@@ -121,6 +121,13 @@ def sample_token(
         return jnp.argmax(logits, axis=-1)
     if params.temperature != 1.0:
         logits = logits / max(params.temperature, 1e-6)
+    # Ordering choice: min-p BEFORE top-p. Min-p's keep-set is
+    # order-invariant (it thresholds against the max), so applying it first
+    # lets top-p's cumulative mass run over an already-denoised tail —
+    # arguably the more principled composition. HF's warper chain applies
+    # them the other way (TopP then MinP), so combined min_p+top_p settings
+    # can keep a slightly different candidate set than transformers; only
+    # the combination differs, each filter alone matches HF exactly.
     logits = apply_min_p(logits, params.min_p)
     logits = apply_top_p(logits, params.top_p)  # no top-k: vocab-wide nucleus
     return jax.random.categorical(rng, logits, axis=-1)
