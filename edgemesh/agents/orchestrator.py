@@ -568,8 +568,13 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
             from edgemesh.ops.int8 import measure_w8a8_mode
 
             mode = measure_w8a8_mode(params)
-            log.info("%s: w8a8 auto-pick -> %s", role_seed, mode)
-            cfg = cfg.replace(quant_mode=mode)
+            # Prefill compiles separately, so it gets its own measured
+            # winner at prefill-like shapes (M = 8 x 512 rows) — the fused
+            # Pallas kernel's big-tile regime (docs/PERFORMANCE.md ADR).
+            pmode = measure_w8a8_mode(params, seq=512)
+            log.info("%s: w8a8 auto-pick -> decode %s / prefill %s",
+                     role_seed, mode, pmode)
+            cfg = cfg.replace(quant_mode=mode, prefill_quant_mode=pmode)
         elif ms.precision != "int8":
             cfg = cfg.replace(quant_mode=ms.precision.removeprefix("int8_"))
     elif ms.precision in ("bf16", "fp16", "fp32"):

@@ -630,6 +630,9 @@ def headline_benchmark(
                 preset, "int8", quant_mode=mode, batch=batch,
                 decode_steps=decode_steps, built=int8_built)
             out[f"int8_{mode}_tok_s"] = int8_runs[mode]["value"]
+            # Per-mode TTFT: the per-PHASE selection evidence (prefill can
+            # run a different path than decode — prefill_quant_mode).
+            out[f"int8_{mode}_ttft_s"] = int8_runs[mode]["ttft_s"]
             _rebest()
 
         _stage(f"int8_{mode}", _mode)

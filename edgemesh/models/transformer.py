@@ -123,6 +123,14 @@ class ModelConfig:
     #                 producing op); Pallas kernel streams int8 on both sides
     #                 and accumulates natively in int32.
     quant_mode: str = "w8a16"
+    # Per-PHASE override: prefill compiles as its own program, so it can run
+    # a different int8 path than decode ("" = same as quant_mode). Decode is
+    # HBM-bound (the XLA dynamic path measured fastest there); prefill is
+    # MXU-bound at large M, where the fused Pallas kernel's big tiles win —
+    # runtime/generate swaps the cfg between the two programs, and
+    # precision "int8_w8a8_auto" measures BOTH phases and sets each to its
+    # winner (ops/int8.measure_w8a8_mode).
+    prefill_quant_mode: str = ""
 
     # Attention backend: "auto" = Pallas flash kernel for prefill on TPU,
     # XLA einsum elsewhere; "flash" forces the kernel (interpreted off-TPU);

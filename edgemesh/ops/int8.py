@@ -452,12 +452,16 @@ def int8_matmul_prequant(
     return y.reshape(*lead, n)
 
 
-def measure_w8a8_mode(params: Params, batch: int = 8, repeats: int = 3) -> str:
+def measure_w8a8_mode(
+    params: Params, batch: int = 8, repeats: int = 3, seq: int = 1
+) -> str:
     """Measurement-driven w8a8 path selection (ADR in docs/PERFORMANCE.md).
 
     Times the XLA dynamic-quant path against both Pallas kernels (block-local
     fused quant, and pre-quantized int8-in) on THIS param tree's actual dense
-    shapes at decode-like batch, and returns the fastest ``quant_mode``
+    shapes at decode-like batch (``seq`` > 1 measures the PREFILL regime:
+    M = batch*seq rows — the per-phase selection of
+    ModelConfig.prefill_quant_mode), and returns the fastest ``quant_mode``
     ("w8a8", "w8a8_pallas", or "w8a8_pallas_pre"). Rationale: at decode
     sizes both paths stream the same int8 weight bytes from HBM — fusion can
     only match, not beat, the XLA path's bandwidth bound, and round-2
@@ -492,7 +496,9 @@ def measure_w8a8_mode(params: Params, batch: int = 8, repeats: int = 3) -> str:
         return "w8a8"
     mats = list(shapes.values())
     xs = [
-        jax.random.normal(jax.random.PRNGKey(0), (batch, w.shape[0]), jnp.bfloat16)
+        jax.random.normal(
+            jax.random.PRNGKey(0), (batch * seq, w.shape[0]), jnp.bfloat16
+        )
         for w, _ in mats
     ]
 

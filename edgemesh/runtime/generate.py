@@ -217,9 +217,18 @@ def generate(
     from edgemesh.utils.platform import device_sync
     from edgemesh.utils.tracing import trace
 
+    # Per-phase int8 path: prefill is its own compiled program, so it may
+    # run a different quant_mode than decode (ModelConfig.prefill_quant_mode
+    # — e.g. the fused Pallas w8a8 kernel at prefill's MXU-bound tiles, XLA
+    # dynamic quant at decode's bandwidth-bound ones).
+    pcfg = (
+        cfg.replace(quant_mode=cfg.prefill_quant_mode)
+        if cfg.prefill_quant_mode and cfg.prefill_quant_mode != cfg.quant_mode
+        else cfg
+    )
     t0 = time.perf_counter()
     with trace("edgemesh/prefill"):
-        first_logits, cache = prefill_fn(cfg, params, tokens, lengths, cache)
+        first_logits, cache = prefill_fn(pcfg, params, tokens, lengths, cache)
         # NOT block_until_ready: on the tunneled TPU platform that returns
         # before the program finishes, silently shrinking the timed window
         # (utils/platform.device_sync). A 1-element readback is a real fence.

@@ -302,3 +302,35 @@ def test_w8a8_auto_precision_builds_agent():
     assert "kernel_q" in agent.params["layers"]["q"]
     out = agent.answer("Where is the Louvre?")
     assert isinstance(out["answer"], str)
+
+
+def test_prefill_quant_mode_runs_per_phase():
+    """prefill_quant_mode compiles prefill as a different int8 path than
+    decode; generation stays finite and deterministic under greedy."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from edgemesh.config import SamplingParams
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_params
+    from edgemesh.ops.int8 import quantize_params
+    from edgemesh.runtime.generate import generate
+
+    cfg = tiny_config("llama", num_layers=2, vocab_size=64,
+                      hidden_size=32, num_heads=4, num_kv_heads=2,
+                      intermediate_size=64).replace(dtype="float32")
+    params = quantize_params(init_params(cfg, jax.random.PRNGKey(0)))
+    tokens = jnp.array([[5, 9, 11, 42, 7]], jnp.int32)
+    lengths = jnp.array([5], jnp.int32)
+    sp = SamplingParams(max_new_tokens=6, temperature=0.0)
+    mixed = cfg.replace(quant_mode="w8a8", prefill_quant_mode="w8a16")
+    r = generate(mixed, params, tokens, lengths, sp, rng=jax.random.PRNGKey(1))
+    assert np.isfinite(np.asarray(r.confidence)).all()
+    assert int(r.num_generated[0]) == 6
+    # Same-mode override is a no-op vs the plain config.
+    same = cfg.replace(quant_mode="w8a8", prefill_quant_mode="w8a8")
+    plain = cfg.replace(quant_mode="w8a8")
+    a = generate(same, params, tokens, lengths, sp, rng=jax.random.PRNGKey(1))
+    b = generate(plain, params, tokens, lengths, sp, rng=jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(a.tokens), np.asarray(b.tokens))
