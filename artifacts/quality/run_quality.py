@@ -216,6 +216,36 @@ def main() -> None:
     b_q8 = agent("qa_b", ck_b, precision="int8")
     reports["ensemble_select_int8"] = evaluate(
         "ensemble_select_int8", ens(a_q8, b_q8), samples, embedder)
+    del a_q8, b_q8
+
+    # LoRA arm (round 4): adapt qa_a — trained on the FIRST half — to the
+    # SECOND half with rank-8 adapters over its frozen trained base
+    # (ModelSpec.lora_base + train_checkpoint = the adapter run), the
+    # finetune-a-trained-model flow the xlsx roadmap planned and round 3
+    # could not express. The kilobyte adapter should recover cross-split
+    # quality the frozen base never saw.
+    lora_steps = max(STEPS // 2, 1)
+    ck_lora = str(OUT / "ckpt_qa_a_lora_b")
+    lora_fields = dict(precision="fp32", lora_rank=8, lora_alpha=16.0,
+                       lora_targets="q,k,v,o", lora_base=ck_a, **ARCH)
+    lcfg = EdgeMeshConfig(
+        agents=[AgentSpec(role="qa_a", model=ModelSpec(**lora_fields))],
+        train=TrainSpec(steps=lora_steps, batch_size=32, seq_len=128, lr=3e-3,
+                        num_samples=len(samples) - half, skip_samples=half,
+                        checkpoint_dir=ck_lora,
+                        checkpoint_every=max(lora_steps // 3, 1),
+                        log_every=max(lora_steps // 10, 1)),
+    )
+    rl = run_training(lcfg)
+    log(f"lora-adapted qa_a -> split b: loss {rl['first_loss']} -> "
+        f"{rl['final_loss']} ({rl['lora_rank']=} adapters only)")
+    a_lora = build_agent(AgentSpec(
+        role="qa_a",
+        model=ModelSpec(train_checkpoint=ck_lora, **lora_fields),
+        sampling=SAMPLING, prompt_template=QA_TEMPLATE))
+    reports["single_a_lora_to_b"] = evaluate(
+        "single_a_lora_to_b", ens(a_lora), samples, embedder)
+    del a_lora
 
     summary = {
         "steps": STEPS, "refiner_steps": R_STEPS, "rows": ROWS, "arch": ARCH,

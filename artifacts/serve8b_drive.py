@@ -1,7 +1,8 @@
 """Llama-3-8B continuous-serving drive (single chip): fabricated int8
-weights, 4-slot paged engine, 12 requests. Measured 2026-07-31: 130.2 tok/s
-aggregate, 2.7 req/s, p50 3.0s, p95 4.4s (artifacts/serving8b_2026-07-31.json).
-Run from the repo root on a healthy tunnel: python artifacts/serve8b_drive.py"""
+weights, 4-slot paged engine, 3 waves x 16 requests (median-of-waves, the
+round-4 variance protocol). Round-3 baseline on the synchronous engine:
+130.2 tok/s aggregate (artifacts/serving8b_2026-07-31.json). Run from the
+repo root on a healthy tunnel: python artifacts/serve8b_drive.py"""
 import json, time
 from edgemesh.utils.platform import ensure_device_ready, tree_sync
 ensure_device_ready()
@@ -23,20 +24,30 @@ agent = Agent(role="qa", cfg=cfg, params=params, tokenizer=ByteTokenizer(),
               prefix_cache=False)
 eng = ContinuousEngine(agent, slots=4, chunk=24, kv_backend="paged",
                        page_size=64, total_pages=96)
-q = "benchmark question number {i:02d}, please answer at length?"
+q = "benchmark question number {i:03d}, please answer at length?"
 try:
-    eng.answer(q.format(i=99))
-    n = 12
-    t0 = time.perf_counter()
-    futs = [eng.submit(q.format(i=i)) for i in range(n)]
-    results = [f.result() for f in futs]
-    wall = time.perf_counter() - t0
+    eng.answer(q.format(i=999))  # warmup, same length bucket as timed
+    n, waves = 16, 3
+    wave_tok_s, results = [], []
+    t0_all = time.perf_counter()
+    for w in range(waves):
+        t0 = time.perf_counter()
+        futs = [eng.submit(q.format(i=w * n + i)) for i in range(n)]
+        wave = [f.result() for f in futs]
+        wall = time.perf_counter() - t0
+        wave_tok_s.append(sum(r["generated"] for r in wave) / wall)
+        results.extend(wave)
+    wall_all = time.perf_counter() - t0_all
     gen = sum(r["generated"] for r in results)
     lats = [r["t_end"] - r["t_start"] + r["queue_s"] for r in results]
+    med = float(np.median(wave_tok_s))
     print(json.dumps({
         "metric": "serving_tok_s_llama8b_int8_paged",
-        "value": round(gen / wall, 2), "generated": gen,
-        "req_s": round(n / wall, 3),
+        "value": round(med, 2),
+        "wave_tok_s": [round(t, 2) for t in wave_tok_s],
+        "spread_pct": round(100 * (max(wave_tok_s) - min(wave_tok_s)) / med, 1),
+        "generated": gen,
+        "req_s": round(len(results) / wall_all, 3),
         "latency_s_p50": round(float(np.percentile(lats, 50)), 3),
         "latency_s_p95": round(float(np.percentile(lats, 95)), 3),
         "stats": eng.stats(),
