@@ -1,0 +1,9 @@
+#!/bin/sh
+# Round-4 measurement sequence — run on a HEALTHY tunnel, one process at a
+# time (never two TPU processes). Each stage appends to r4_measurements.log.
+set -x
+cd "$(dirname "$0")/.." || exit 1
+date >> artifacts/r4_measurements.log
+python bench.py 2>>artifacts/r4_measurements.log | tee -a artifacts/r4_measurements.log
+python artifacts/serve8b_drive.py 2>>artifacts/r4_measurements.log | tee -a artifacts/r4_measurements.log
+python artifacts/profile_1b_decode.py 2>>artifacts/r4_measurements.log | tee -a artifacts/r4_measurements.log
