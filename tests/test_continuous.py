@@ -366,3 +366,33 @@ def test_batched_admission_mixed_widths_matches_sequential():
             assert g["answer"] == r["answer"], (g["answer"], r["answer"])
     finally:
         eng.close()
+
+
+def test_host_owned_paging_never_pops_device_pages():
+    """The round-4 allocator contract: admission pre-maps every page a row
+    can touch and parked rows are frozen at length 1, so the in-program
+    allocator must never pop — free_top stays at 1 (the tripwire the worker
+    checks each segment) and the host free list returns to full size."""
+    import time as _t
+
+    agent = _agent(max_new=12)
+    eng = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged",
+                           page_size=8)
+    try:
+        futs = [eng.submit(f"q {i}?") for i in range(5)]
+        [f.result(timeout=600) for f in futs]
+        assert _wait_drained(eng) == 0
+        deadline = _t.time() + 60
+        free_top = None
+        while _t.time() < deadline:
+            try:
+                free_top = int(eng._cache.free_top)
+                break
+            except RuntimeError:  # donated mid-poll; engine still settling
+                _t.sleep(0.02)
+        assert free_top == 1, f"device allocator popped pages (free_top={free_top})"
+        assert len(eng._free_pages) == (
+            eng.total_pages - 1 - len(eng._template_pages)
+        )
+    finally:
+        eng.close()
