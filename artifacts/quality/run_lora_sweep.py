@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""LoRA calibration sweep (round 5): turn round 4's single-point negative
+into a measured result.
+
+Round 4's arm reported single_a_lora_to_b avg_rouge 0.0555 vs frozen base
+0.1226 — but that aggregate conflates two effects. Decomposed by training
+split (index < 500 = split a = the base's training half):
+
+    single_a_fp32        split_a 0.2090   split_b 0.0362
+    single_a_lora_to_b   split_a 0.0556   split_b 0.0555
+
+On the ADAPTATION TARGET the rank-8 adapter beat its frozen base by +53%
+(0.0362 -> 0.0555); the aggregate fell because adapting through q/k/v/o
+destroyed the base's split-a knowledge (0.2090 -> 0.0556, catastrophic
+interference — the adapter output is added on every input, split-a prompts
+included). This sweep measures both axes properly:
+
+- rank sweep {8, 32, 128} x steps (env) on the full split-b adaptation,
+  reporting split_a (forgetting) and split_b (gain) separately;
+- a capacity-matched positive control: rank 8 on a 100-row subset of
+  split b, evaluated on those 100 rows — can a ~100KB adapter memorize a
+  workload sized to its capacity?
+
+Reference tie-in: the reference roadmap's unstarted finetuning rows
+(Others/.xlsx "QA and Tasks to Do") planned exactly this adapt-a-trained-
+model flow; the reference never measured it.
+
+Run:   JAX_PLATFORMS=cpu python artifacts/quality/run_lora_sweep.py
+Env:   EDGEMESH_LORA_RANKS   (default "8,32,128")
+       EDGEMESH_LORA_STEPS   (default 2200)
+       EDGEMESH_LORA_CONTROL (default 1 — run the 100-row positive control)
+       EDGEMESH_QUALITY_DIR  (default artifacts/quality; must hold ckpt_qa_a)
+Writes report_lora_r{rank}_s{steps}.json (+ _control) and lora_sweep.json.
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+
+from edgemesh.agents.orchestrator import build_agent  # noqa: E402
+from edgemesh.config import (  # noqa: E402
+    AgentSpec,
+    EdgeMeshConfig,
+    ModelSpec,
+    SamplingParams,
+    TrainSpec,
+)
+from edgemesh.eval.data import load_qa_csv, resolve_dataset_path  # noqa: E402
+from edgemesh.eval.embedder import build_embedder  # noqa: E402
+from edgemesh.eval.harness import run_eval  # noqa: E402
+from edgemesh.training import run_training  # noqa: E402
+
+RANKS = [int(r) for r in os.environ.get("EDGEMESH_LORA_RANKS", "8,32,128").split(",")]
+STEPS = int(os.environ.get("EDGEMESH_LORA_STEPS", "2200"))
+CONTROL = os.environ.get("EDGEMESH_LORA_CONTROL", "1") == "1"
+OUT = Path(os.environ.get("EDGEMESH_QUALITY_DIR", str(REPO / "artifacts/quality")))
+CONTROL_ROWS = 100
+
+# Must match run_quality.py exactly: same arch, same frozen base, same
+# greedy sampling, same training prompt format.
+ARCH = dict(num_layers=4, hidden_size=128, num_heads=4, num_kv_heads=4,
+            intermediate_size=256, max_seq_len=384)
+QA_TEMPLATE = "Question: {question}\nAnswer:"
+SAMPLING = SamplingParams(max_new_tokens=64, do_sample=False,
+                          repetition_penalty=1.0)
+METRICS = ["rouge1", "rouge2", "rougeL", "avg_rouge", "bleu", "cosine",
+           "confidence", "bertscore", "tps"]
+T0 = time.perf_counter()
+
+
+def log(msg: str) -> None:
+    print(f"[lora-sweep +{time.perf_counter() - T0:7.1f}s] {msg}", flush=True)
+
+
+def train_adapter(name: str, rank: int, steps: int, skip: int, take: int,
+                  base_ckpt: str) -> tuple[str, dict]:
+    ckpt = str(OUT / f"ckpt_{name}")
+    fields = dict(precision="fp32", lora_rank=rank, lora_alpha=2.0 * rank,
+                  lora_targets="q,k,v,o", lora_base=base_ckpt, **ARCH)
+    cfg = EdgeMeshConfig(
+        agents=[AgentSpec(role="qa_a", model=ModelSpec(**fields))],
+        train=TrainSpec(steps=steps, batch_size=32, seq_len=128, lr=3e-3,
+                        num_samples=take, skip_samples=skip,
+                        checkpoint_dir=ckpt,
+                        checkpoint_every=max(steps // 3, 1),
+                        log_every=max(steps // 10, 1)),
+    )
+    r = run_training(cfg)
+    log(f"{name}: rank={rank} steps={steps} skip={skip} take={take} "
+        f"loss {r['first_loss']:.3f} -> {r['final_loss']:.4f}")
+    return ckpt, fields
+
+
+def eval_split(name: str, agent, samples, embedder, boundary: int) -> dict:
+    out_jsonl = OUT / f"results_{name}.jsonl"
+    if out_jsonl.exists():
+        out_jsonl.unlink()
+    report = run_eval(
+        samples, agent.answer, output_jsonl=str(out_jsonl), resume=True,
+        metrics=METRICS, embedder=embedder,
+        answer_batch_fn=agent.answer_batch, batch_size=16,
+    )
+    # Per-split decomposition straight from the per-sample rows.
+    rows = [json.loads(line) for line in open(out_jsonl)]
+    seg_a = [r["avg_rouge"] for r in rows if r["index"] < boundary]
+    seg_b = [r["avg_rouge"] for r in rows if r["index"] >= boundary]
+    report["avg_rouge_split_a"] = sum(seg_a) / len(seg_a) if seg_a else None
+    report["avg_rouge_split_b"] = sum(seg_b) / len(seg_b) if seg_b else None
+    (OUT / f"report_{name}.json").write_text(json.dumps(report, indent=2))
+    log(f"eval {name}: overall={report['avg_rouge']:.4f} "
+        f"split_a={report['avg_rouge_split_a']} "
+        f"split_b={report['avg_rouge_split_b']}")
+    return report
+
+
+def main() -> None:
+    base_ckpt = str(OUT / "ckpt_qa_a")
+    if not Path(base_ckpt).exists():
+        raise SystemExit(f"frozen base {base_ckpt} missing — run run_quality.py first")
+    samples = load_qa_csv(resolve_dataset_path(""), limit=1000)
+    half = len(samples) // 2
+    embedder = build_embedder("synthetic")
+    sweep: dict[str, dict] = {}
+
+    for rank in RANKS:
+        name = f"lora_r{rank}_s{STEPS}"
+        ckpt, fields = train_adapter(name, rank, STEPS, skip=half,
+                                     take=len(samples) - half, base_ckpt=base_ckpt)
+        agent = build_agent(AgentSpec(
+            role="qa_a", model=ModelSpec(train_checkpoint=ckpt, **fields),
+            sampling=SAMPLING, prompt_template=QA_TEMPLATE))
+        sweep[name] = eval_split(name, agent, samples, embedder, half)
+        del agent
+
+    if CONTROL:
+        # Capacity-matched positive control: rank 8, 100 rows, evaluated on
+        # exactly those rows (plus split a for the forgetting axis).
+        name = f"lora_r8_control{CONTROL_ROWS}_s{STEPS}"
+        ckpt, fields = train_adapter(name, 8, STEPS, skip=half,
+                                     take=CONTROL_ROWS, base_ckpt=base_ckpt)
+        agent = build_agent(AgentSpec(
+            role="qa_a", model=ModelSpec(train_checkpoint=ckpt, **fields),
+            sampling=SAMPLING, prompt_template=QA_TEMPLATE))
+        subset = samples[:half] + samples[half : half + CONTROL_ROWS]
+        rep = eval_split(name, agent, subset, embedder, half)
+        # here split_b == the 100 adaptation rows
+        sweep[name] = rep
+        del agent
+
+    (OUT / "lora_sweep.json").write_text(json.dumps(
+        {"ranks": RANKS, "steps": STEPS,
+         "baseline_split_decomposition": {
+             "single_a_fp32": {"split_a": 0.2090, "split_b": 0.0362},
+             "single_a_lora_to_b_r4": {"split_a": 0.0556, "split_b": 0.0555},
+         },
+         "reports": {k: {m: v.get(m) for m in
+                         ("avg_rouge", "avg_rouge_split_a", "avg_rouge_split_b",
+                          "bleu", "bertscore", "confidence", "num_samples",
+                          "wall_time_s")}
+                     for k, v in sweep.items()}}, indent=2))
+    log("DONE")
+
+
+if __name__ == "__main__":
+    main()

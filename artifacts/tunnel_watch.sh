@@ -1,0 +1,27 @@
+#!/bin/sh
+# Round-5 tunnel watcher: probe until the TPU answers, then run the queued
+# measurement sequence exactly once. Never leaves two TPU processes running
+# (each probe is `timeout`-killed before the next; the measurement script
+# runs stages sequentially).
+cd "$(dirname "$0")/.." || exit 1
+LOG=artifacts/tunnel_watch.log
+MARKER=artifacts/tunnel_healthy.marker
+: > "$LOG"
+while true; do
+  date >> "$LOG"
+  if timeout 150 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+print('probe ok', float((x @ x).sum()))
+" >> "$LOG" 2>&1; then
+    echo "TUNNEL HEALTHY $(date)" >> "$LOG"
+    touch "$MARKER"
+    sh artifacts/run_r4_measurements.sh >> "$LOG" 2>&1
+    echo "MEASUREMENTS DONE rc=$? $(date)" >> "$LOG"
+    exit 0
+  fi
+  echo "probe failed/wedged $(date)" >> "$LOG"
+  sleep 240
+done

@@ -239,6 +239,95 @@ def serving_benchmark(
         eng.close()
 
 
+def admission_policy_benchmark(
+    preset: str | None = None,
+    precision: str = "int8",
+    quant_mode: str = "w8a16",
+    slots: int = 8,
+    chunk: int = 32,
+    kv_backend: str = "paged",
+    n_requests: int = 36,
+    built: tuple | None = None,
+    waves: int = 3,
+    budgets: tuple[int, ...] = (16, 64, 128),
+) -> dict[str, Any]:
+    """FIFO vs SJF admission on a MIXED workload (VERDICT r4 item 6): each
+    wave cycles per-request budgets through ``budgets``, so short jobs queue
+    behind long ones under FIFO. SJF orders admission by the known
+    ``max_new`` — on this workload the 16-token jobs stop paying the
+    128-token jobs' decode time in queue, which is where the serving p50
+    (3.59 s against a 0.078 s TTFT in BENCH_r03) actually lives. Reports
+    per-policy median-wave tok/s plus overall AND short-job latency
+    percentiles — the SLO table in docs/SERVING.md reads straight from
+    these keys."""
+    from edgemesh.agents.orchestrator import Agent
+    from edgemesh.models.tokenizer import ByteTokenizer
+    from edgemesh.serve.continuous import ContinuousEngine
+
+    preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
+    if built is not None:
+        cfg, params = built
+        if precision == "int8":
+            cfg = cfg.replace(quant_mode=quant_mode)
+    else:
+        cfg, params = _build(preset, precision, quant_mode)
+    question = "benchmark question number {i:03d}, please answer at length?"
+    out: dict[str, Any] = {
+        "budgets": list(budgets), "n_requests": n_requests, "waves": waves,
+    }
+    import numpy as np
+
+    for policy in ("fifo", "sjf"):
+        agent = Agent(
+            role="qa", cfg=cfg, params=params, tokenizer=ByteTokenizer(),
+            sampling=SamplingParams(
+                max_new_tokens=max(budgets), temperature=0.7, top_k=50,
+                top_p=0.9, repetition_penalty=1.2, do_sample=True,
+            ),
+            prefix_cache=False,
+        )
+        eng = ContinuousEngine(agent, slots=slots, chunk=chunk,
+                               kv_backend=kv_backend, admission=policy)
+        try:
+            _progress(f"admission/{policy}: warmup compile")
+            eng.answer(question.format(i=999), max_new=min(budgets))
+            wave_tok_s: list[float] = []
+            lat_all: list[float] = []
+            lat_short: list[float] = []
+            for w in range(waves):
+                _progress(f"admission/{policy} wave {w + 1}/{waves}")
+                t0 = time.perf_counter()
+                futs = [
+                    (budgets[i % len(budgets)],
+                     eng.submit(question.format(i=w * n_requests + i),
+                                max_new=budgets[i % len(budgets)]))
+                    for i in range(n_requests)
+                ]
+                wave = [(b, f.result()) for b, f in futs]
+                wall = time.perf_counter() - t0
+                wave_tok_s.append(
+                    sum(r["generated"] for _, r in wave) / wall
+                )
+                for b, r in wave:
+                    lat = r["t_end"] - r["t_start"] + r["queue_s"]
+                    lat_all.append(lat)
+                    if b == min(budgets):
+                        lat_short.append(lat)
+            out[f"{policy}_tok_s"] = round(float(np.median(wave_tok_s)), 2)
+            out[f"{policy}_latency_s_p50"] = round(float(np.percentile(lat_all, 50)), 4)
+            out[f"{policy}_latency_s_p95"] = round(float(np.percentile(lat_all, 95)), 4)
+            out[f"{policy}_short_latency_s_p50"] = round(float(np.percentile(lat_short, 50)), 4)
+            out[f"{policy}_short_latency_s_p95"] = round(float(np.percentile(lat_short, 95)), 4)
+            _progress(
+                f"admission/{policy}: {out[f'{policy}_tok_s']} tok/s, "
+                f"p50 {out[f'{policy}_latency_s_p50']}s "
+                f"(short p50 {out[f'{policy}_short_latency_s_p50']}s)"
+            )
+        finally:
+            eng.close()
+    return out
+
+
 _T0 = time.perf_counter()
 LAST_PROGRESS = time.monotonic()
 
@@ -507,13 +596,19 @@ def speculative_benchmark(
     decode_steps: int = 128,
     gamma: int = 4,
     draft_layers_frac: float = 0.25,
+    kv_backend: str = "dense",
 ) -> dict[str, Any]:
     """Speculative vs plain decode at batch 1 (the latency regime speculative
     decoding targets). The draft is a depth-truncated random-init copy —
     with RANDOM weights draft/target agreement is near-chance, so the
     measured speedup is a LOWER bound and the acceptance rate is reported
     for context (trained draft/target pairs accept far more). On by default
-    in the headline since round 4 (EDGEMESH_BENCH_SPEC=0 skips)."""
+    in the headline since round 4 (EDGEMESH_BENCH_SPEC=0 skips).
+
+    ``kv_backend="paged_int8"`` runs BOTH arms over int8 page pools (plain =
+    generate_paged kv_quant; spec = int8 target+draft pools) — the memory
+    backend composed with the marquee latency feature (SERVING.md matrix)."""
+    from edgemesh.runtime.paged_generate import generate_paged
     from edgemesh.runtime.speculative import generate_speculative
 
     preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
@@ -529,21 +624,32 @@ def speculative_benchmark(
         jax.random.PRNGKey(1), (batch, 32), 0, cfg.vocab_size, jnp.int32
     )
     lengths = jnp.full((batch,), 32, jnp.int32)
-    _progress(f"spec b{batch} gamma={gamma}: warmup")
-    generate_speculative(cfg, params, d_cfg, d_params, tokens, lengths, sampling, gamma)
-    plain = generate(cfg, params, tokens, lengths, sampling)
+
+    def spec_once():
+        return generate_speculative(
+            cfg, params, d_cfg, d_params, tokens, lengths, sampling, gamma,
+            kv_backend=kv_backend,
+        )
+
+    def plain_once():
+        if kv_backend == "dense":
+            return generate(cfg, params, tokens, lengths, sampling)
+        return generate_paged(cfg, params, tokens, lengths, sampling,
+                              kv_quant=kv_backend == "paged_int8")
+
+    _progress(f"spec b{batch} gamma={gamma} kv={kv_backend}: warmup")
+    spec_once()
+    plain = plain_once()
     best_spec, stats = 0.0, None
     for _ in range(2):
-        r, s = generate_speculative(
-            cfg, params, d_cfg, d_params, tokens, lengths, sampling, gamma
-        )
+        r, s = spec_once()
         if r.decode_tok_s > best_spec:
             best_spec, stats = r.decode_tok_s, s
     plain_best = plain.decode_tok_s
     for _ in range(2):
-        plain_best = max(plain_best, generate(cfg, params, tokens, lengths, sampling).decode_tok_s)
-    _progress(f"spec {best_spec:.1f} vs plain {plain_best:.1f} tok/s, "
-              f"accept {stats.accept_rate:.2f}")
+        plain_best = max(plain_best, plain_once().decode_tok_s)
+    _progress(f"spec/{kv_backend} {best_spec:.1f} vs plain {plain_best:.1f} "
+              f"tok/s, accept {stats.accept_rate:.2f}")
     return {
         "spec_tok_s": round(best_spec, 2),
         "plain_tok_s": round(plain_best, 2),
@@ -551,6 +657,7 @@ def speculative_benchmark(
         "accept_rate": round(stats.accept_rate, 3),
         "gamma": gamma,
         "draft_layers": d_layers,
+        "kv_backend": kv_backend,
     }
 
 
@@ -722,6 +829,20 @@ def headline_benchmark(
     if os.environ.get("EDGEMESH_BENCH_SERVE", "1") == "1":
         _stage("serving", _serving)
 
+    # ---- Stage 7b: admission-policy A/B on a mixed-budget wave — FIFO vs
+    # SJF end-to-end latency at matched throughput (docs/SERVING.md SLO
+    # table). EDGEMESH_BENCH_ADMIT=0 skips.
+    def _admission():
+        r = admission_policy_benchmark(preset, built=int8_built)
+        for k, v in r.items():
+            out[f"admit_{k}"] = v
+
+    if (
+        os.environ.get("EDGEMESH_BENCH_ADMIT", "1") == "1"
+        and os.environ.get("EDGEMESH_BENCH_SERVE", "1") == "1"
+    ):
+        _stage("admission", _admission)
+
     # ---- Stage 8: speculative decoding at b1 (the latency regime) — on by
     # default since round 4 (EDGEMESH_BENCH_SPEC=0 skips): the reference
     # published a number for every shipped config (Table 3), so the marquee
@@ -735,6 +856,12 @@ def headline_benchmark(
         out["spec_speedup"] = r["spec_speedup"]
         out["spec_accept_rate"] = r["accept_rate"]
         out["spec_gamma"] = r["gamma"]
+        emit_partial(out)
+        # Composed cell: speculative over int8 page pools (both arms int8).
+        r2 = speculative_benchmark(preset, kv_backend="paged_int8")
+        out["spec_paged_int8_b1_tok_s"] = r2["spec_tok_s"]
+        out["spec_paged_int8_plain_b1_tok_s"] = r2["plain_tok_s"]
+        out["spec_paged_int8_speedup"] = r2["spec_speedup"]
 
     if os.environ.get("EDGEMESH_BENCH_SPEC", "1") == "1" and preset == "llama1b":
         _stage("spec", _spec)

@@ -121,15 +121,14 @@ def sample_token(
         return jnp.argmax(logits, axis=-1)
     if params.temperature != 1.0:
         logits = logits / max(params.temperature, 1e-6)
-    # Ordering choice: min-p BEFORE top-p. Min-p's keep-set is
-    # order-invariant (it thresholds against the max), so applying it first
-    # lets top-p's cumulative mass run over an already-denoised tail —
-    # arguably the more principled composition. HF's warper chain applies
-    # them the other way (TopP then MinP), so combined min_p+top_p settings
-    # can keep a slightly different candidate set than transformers; only
-    # the combination differs, each filter alone matches HF exactly.
-    logits = apply_min_p(logits, params.min_p)
+    # HF warper order: TopP then MinP. Min-p's keep-set after top-p equals
+    # HF's exactly — softmax renormalization over the top-p survivors scales
+    # every prob by the same factor, so prob_i/max_prob (what min-p
+    # thresholds) depends only on logit differences, and top-p always keeps
+    # the argmax, so the max-reduce in apply_min_p is unchanged by the
+    # NEG_INF-masked tail.
     logits = apply_top_p(logits, params.top_p)  # no top-k: vocab-wide nucleus
+    logits = apply_min_p(logits, params.min_p)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
@@ -164,8 +163,8 @@ def filtered_candidates(
     if params.temperature != 1.0:
         logits = logits / max(params.temperature, 1e-6)
     vals, idx = jax.lax.top_k(logits, params.top_k)
-    vals = apply_min_p(vals, params.min_p)  # row-order-free: sorted view ok
     vals = _top_p_on_sorted(vals, params.top_p)
+    vals = apply_min_p(vals, params.min_p)  # row-order-free: sorted view ok
     probs = jax.nn.softmax(vals, axis=-1)
     probs = jnp.where(vals > NEG_INF / 2, probs, 0.0)
     probs = probs / jnp.maximum(jnp.sum(probs, axis=-1, keepdims=True), 1e-30)

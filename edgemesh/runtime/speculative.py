@@ -385,17 +385,22 @@ def generate_speculative(
 
 
 def _spec_fns(kv_backend: str):
-    """(verify_fn, decode_fn) for a cache backend."""
+    """(verify_fn, decode_fn) for a cache backend. The paged pair serves
+    both fp and int8 pools — forward_verify_paged/forward_decode_paged
+    dispatch on the cache pytree type at trace time, so ``paged_int8``
+    needs no separate functions, only an int8 pool from the caller."""
     if kv_backend == "dense":
         return forward_verify, forward_decode
-    if kv_backend == "paged":
+    if kv_backend in ("paged", "paged_int8"):
         from edgemesh.runtime.paged_generate import (
             forward_decode_paged,
             forward_verify_paged,
         )
 
         return forward_verify_paged, forward_decode_paged
-    raise ValueError(f"unknown kv_backend {kv_backend!r} (dense | paged)")
+    raise ValueError(
+        f"unknown kv_backend {kv_backend!r} (dense | paged | paged_int8)"
+    )
 
 
 def _spec_prefill(
@@ -434,14 +439,21 @@ def _spec_prefill(
 
     t0 = time.perf_counter()
     with trace("edgemesh/spec_prefill"):
-        if kv_backend == "paged":
+        if kv_backend in ("paged", "paged_int8"):
             from edgemesh.runtime.paged_generate import forward_prefill_paged
-            from edgemesh.runtime.paged_kv import init_paged_cache
+            from edgemesh.runtime.paged_kv import (
+                init_paged_cache,
+                init_quant_paged_cache,
+            )
 
             per_row = -(-needed // page_size)
+            init = (
+                init_quant_paged_cache if kv_backend == "paged_int8"
+                else init_paged_cache
+            )
 
             def make(cfg):
-                return init_paged_cache(
+                return init(
                     cfg, batch, total_pages=1 + batch * per_row,
                     page_size=page_size, max_pages=per_row,
                 )

@@ -182,6 +182,27 @@ def _splice_slot(
     )
 
 
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+def _splice_slot_quant(
+    pool_k, pool_v, pool_ks, pool_vs, pool_len, pool_logits, pool_mask,
+    pool_finished,
+    row_k, row_v, row_ks, row_vs, row_len, row_logits, row_mask, idx,
+):
+    """_splice_slot's int8-slab twin: the quant cache carries per-token
+    k/v scale planes alongside the int8 data, spliced under the same
+    donation contract (pool_finished stays undonated — see _splice_slot)."""
+    return (
+        pool_k.at[:, idx].set(row_k[:, 0]),
+        pool_v.at[:, idx].set(row_v[:, 0]),
+        pool_ks.at[:, idx].set(row_ks[:, 0]),
+        pool_vs.at[:, idx].set(row_vs[:, 0]),
+        pool_len.at[idx].set(row_len),
+        pool_logits.at[idx].set(row_logits.astype(pool_logits.dtype)),
+        pool_mask.at[idx].set(row_mask),
+        pool_finished.at[idx].set(False),
+    )
+
+
 def _parked_pool(init_fn, n_slots: int, total_pages: int):
     """Fresh page pool with every slot PARKED at length 1, plus its matching
     host free list. ONE definition of the load-bearing convention: a frozen
@@ -244,6 +265,7 @@ class ContinuousEngine:
         kv_backend: str = "dense",
         page_size: int = 64,
         total_pages: int | None = None,
+        admission: str = "fifo",
     ):
         self.agent = agent
         self.cfg = agent.cfg
@@ -251,12 +273,25 @@ class ContinuousEngine:
         self.n_slots = int(slots)
         if self.chunk < 1 or self.n_slots < 1:
             raise ValueError("slots and chunk must be >= 1")
-        if kv_backend not in ("dense", "paged", "paged_int8"):
+        if admission not in ("fifo", "sjf"):
+            raise ValueError(f"unknown admission policy {admission!r}")
+        # "sjf": admission picks the cheapest waiting requests first —
+        # estimated cost is (requested budget, prompt chars), both known at
+        # submit time. Cuts p50 end-to-end latency on mixed workloads (the
+        # short jobs stop queueing behind long ones) at identical aggregate
+        # throughput; long jobs pay with a fatter p99, and a sustained
+        # overload of short jobs can starve them — the classic SJF trade.
+        self.admission = admission
+        if kv_backend not in ("dense", "dense_int8", "paged", "paged_int8"):
             raise ValueError(f"unknown kv_backend {kv_backend!r}")
-        if kv_backend != "dense" and int(page_size) < 1:
+        # One flag for every host-owned-paging site: the dense/dense_int8
+        # slabs share the splice-admission path, the paged/paged_int8 pools
+        # share the page-table path.
+        self._paged = kv_backend.startswith("paged")
+        if self._paged and int(page_size) < 1:
             raise ValueError("page_size must be >= 1")
         self.kv_backend = kv_backend
-        self._queue: deque[tuple[str, Future, float]] = deque()
+        self._queue: deque[tuple[str, Future, float, int | None]] = deque()
         self._cond = threading.Condition()
         self._closed = False
         self._slots = [_Slot() for _ in range(self.n_slots)]
@@ -265,6 +300,14 @@ class ContinuousEngine:
         if kv_backend == "dense":
             self._cache = init_kv_cache(self.cfg, self.n_slots, cap)
             self._decode_fn = None  # _decode_loop default (forward_decode)
+        elif kv_backend == "dense_int8":
+            from edgemesh.runtime.quant_kv import (
+                forward_decode_quant,
+                init_quant_kv_cache,
+            )
+
+            self._cache = init_quant_kv_cache(self.cfg, self.n_slots, cap)
+            self._decode_fn = forward_decode_quant
         else:
             self.page_size = int(page_size)
             per_row = -(-cap // self.page_size)  # ceil: table slots per row
@@ -308,24 +351,31 @@ class ContinuousEngine:
         self.segments = 0
         self.admitted_mid_flight = 0
         self.max_concurrent = 0
-        self._pool_tripwire_logged = False
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
     # -- public interface (DynamicBatcher-compatible) -----------------------
 
-    def submit(self, question: str) -> Future:
+    def submit(self, question: str, max_new: int | None = None) -> Future:
+        """Enqueue one request. ``max_new`` caps THIS request's token budget
+        below the engine-wide ``sampling.max_new_tokens`` (budgets are
+        per-slot host state, so a per-request cap costs nothing); the
+        "sjf" admission policy uses it as the job-size estimate."""
+        if max_new is not None:
+            max_new = int(max_new)
+            if max_new < 1:
+                raise ValueError(f"max_new must be >= 1, got {max_new}")
         fut: Future = Future()
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine is closed")
-            self._queue.append((question, fut, time.perf_counter()))
+            self._queue.append((question, fut, time.perf_counter(), max_new))
             self.requests += 1
             self._cond.notify()
         return fut
 
-    def answer(self, question: str) -> dict[str, Any]:
-        return self.submit(question).result()
+    def answer(self, question: str, max_new: int | None = None) -> dict[str, Any]:
+        return self.submit(question, max_new=max_new).result()
 
     def close(self) -> None:
         with self._cond:
@@ -343,7 +393,7 @@ class ContinuousEngine:
             "chunk": self.chunk,
             "kv_backend": self.kv_backend,
         }
-        if self.kv_backend != "dense":
+        if self._paged:
             out["total_pages"] = self.total_pages
             out["reserved_pages"] = self._reserved_pages
             out["free_pages"] = len(self._free_pages)
@@ -378,7 +428,8 @@ class ContinuousEngine:
 
     # -- engine loop --------------------------------------------------------
 
-    def _admit(self, idx: int, question: str, fut: Future, t_submit: float, mid_flight: bool) -> bool:
+    def _admit(self, idx: int, question: str, fut: Future, t_submit: float,
+               mid_flight: bool, max_new: int | None = None) -> bool:
         """Prefill one request and splice its state into slot ``idx``.
 
         Returns False when a paged backend lacks free pages for the request's
@@ -388,22 +439,65 @@ class ContinuousEngine:
         tokens, lengths, _ = agent._prepare_batch([prompt])
         plen = int(lengths[0])
         budget = int(agent.sampling.max_new_tokens)
-        budget = min(budget, int(self.cfg.max_seq_len) - plen)
+        if max_new is not None:
+            budget = min(budget, int(max_new))
+        # Pipelined-overshoot clamp: a budget-exhausted row rides one
+        # unfrozen lag segment plus the in-segment overshoot before its
+        # length freezes, advancing up to 2*(chunk+1) tokens past
+        # plen+budget. Clamp the budget so even that worst case stays
+        # inside the model's declared position range (the spec engine
+        # freezes budget-complete rows device-side and carries its own
+        # gamma-aware margin instead).
+        over = 2 * (self.chunk + 1)
+        budget = min(budget, int(self.cfg.max_seq_len) - plen - over)
+        if budget < 1:
+            raise ValueError(
+                f"prompt ({plen} tokens) leaves no decode room inside "
+                f"max_seq_len={self.cfg.max_seq_len} after the pipeline "
+                f"overshoot margin ({over} tokens)"
+            )
 
-        if self.kv_backend == "dense":
+        if not self._paged:
             cap = self._cache.k.shape[2]
-            row_cache = init_kv_cache(self.cfg, 1, cap)
-            logits1, row_cache = forward_prefill(self.cfg, agent.params, tokens, lengths, row_cache)
             valid = jnp.arange(tokens.shape[1])[None, :] < lengths[:, None]
             mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
+            sidx = jnp.asarray(idx, jnp.int32)
+            if self.kv_backend == "dense":
+                row_cache = init_kv_cache(self.cfg, 1, cap)
+                logits1, row_cache = forward_prefill(
+                    self.cfg, agent.params, tokens, lengths, row_cache
+                )
+                k, v, ln, self._logits, self._mask, self._finished = _splice_slot(
+                    self._cache.k, self._cache.v, self._cache.lengths,
+                    self._logits, self._mask, self._finished,
+                    row_cache.k, row_cache.v, lengths[0], logits1[0], mask1[0],
+                    sidx,
+                )
+                self._cache = KVCache(k=k, v=v, lengths=ln)
+            else:  # dense_int8: the slab carries per-token scales too
+                from edgemesh.runtime.quant_kv import (
+                    QuantKVCache,
+                    forward_prefill_quant,
+                    init_quant_kv_cache,
+                )
 
-            k, v, ln, self._logits, self._mask, self._finished = _splice_slot(
-                self._cache.k, self._cache.v, self._cache.lengths,
-                self._logits, self._mask, self._finished,
-                row_cache.k, row_cache.v, lengths[0], logits1[0], mask1[0],
-                jnp.asarray(idx, jnp.int32),
-            )
-            self._cache = KVCache(k=k, v=v, lengths=ln)
+                row_cache = init_quant_kv_cache(self.cfg, 1, cap)
+                logits1, row_cache = forward_prefill_quant(
+                    self.cfg, agent.params, tokens, lengths, row_cache
+                )
+                (k, v, ks, vs, ln, self._logits, self._mask,
+                 self._finished) = _splice_slot_quant(
+                    self._cache.k, self._cache.v,
+                    self._cache.k_scale, self._cache.v_scale,
+                    self._cache.lengths, self._logits, self._mask,
+                    self._finished,
+                    row_cache.k, row_cache.v,
+                    row_cache.k_scale, row_cache.v_scale,
+                    lengths[0], logits1[0], mask1[0], sidx,
+                )
+                self._cache = QuantKVCache(
+                    k=k, v=v, k_scale=ks, v_scale=vs, lengths=ln
+                )
             pages: list[int] = []
         else:
             self._ensure_template()
@@ -426,7 +520,6 @@ class ContinuousEngine:
             # writes past the last logical slot clamp onto the row's own
             # final (garbage-region) page or the trash page, never another
             # row's (paged_kv._token_slots).
-            over = 2 * (self.chunk + 1)
             mapped = min(
                 -(-(plen + budget + over) // self.page_size),
                 int(self._cache.max_pages),
@@ -602,6 +695,12 @@ class ContinuousEngine:
         self._finished = jnp.ones((self.n_slots,), bool)
         if self.kv_backend == "dense":
             self._cache = init_kv_cache(self.cfg, self.n_slots, self.cfg.max_seq_len)
+        elif self.kv_backend == "dense_int8":
+            from edgemesh.runtime.quant_kv import init_quant_kv_cache
+
+            self._cache = init_quant_kv_cache(
+                self.cfg, self.n_slots, self.cfg.max_seq_len
+            )
         else:
             self._cache, self._free_pages = _parked_pool(
                 self._init_pool, self.n_slots, self.total_pages
@@ -634,7 +733,7 @@ class ContinuousEngine:
                 "t_end": now,
             }
         )
-        if self.kv_backend != "dense":
+        if self._paged:
             self._push_pages(slot.pages)
             self._park_slot_device(idx)
         self._slots[idx] = _Slot()
@@ -664,7 +763,7 @@ class ContinuousEngine:
         self._logits, self._cache = self._bridge(
             self.cfg, agent.params, prev, cache, fin
         )
-        if self.kv_backend != "dense":
+        if self._paged:
             # +0 detaches the tripwire snapshot from the cache buffer — the
             # cache itself is donated into the next segment/admission while
             # this handle is still awaiting its host fetch.
@@ -679,18 +778,20 @@ class ContinuousEngine:
         and run the host-side emit/retire bookkeeping."""
         fetched = jax.device_get(seg.handles)
         counts_h, out_h, fin_h = fetched[:3]
-        if self.kv_backend != "dense" and int(fetched[3]) != 1:
+        if self._paged and int(fetched[3]) != 1:
             # Host-owned-allocator tripwire: the device popped pages. A bug,
-            # not a capacity event — pages it handed out are ALSO on the
-            # host free list. Loud log once; the reservation margins keep
-            # rows from touching each other until the pool resets.
-            if not self._pool_tripwire_logged:  # pragma: no cover
-                self._pool_tripwire_logged = True
-                log.error(
-                    "paged-pool tripwire: device allocator popped pages "
-                    "(free_top=%d) despite host-owned pre-mapping",
-                    int(fetched[3]),
-                )
+            # not a capacity event — any page it handed out is ALSO on the
+            # host free list, so a later admission could double-map the same
+            # physical page across two rows (silent KV cross-contamination).
+            # Fatal for the pool: RAISE so _run's segment-failure handler
+            # resets (failing in-flight rows loudly) AND drops the already-
+            # dispatched successor segment — a reset here would leave that
+            # successor's stale pre-reset free_top snapshot to re-fire the
+            # tripwire and fail requests admitted after recovery.
+            raise RuntimeError(  # pragma: no cover
+                "paged-pool tripwire: device allocator popped pages "
+                f"(free_top={int(fetched[3])}) despite host-owned pre-mapping"
+            )
         for i, gen in seg.rows:
             slot = self._slots[i]
             if not slot.active or self._gen[i] != gen:
@@ -719,15 +820,30 @@ class ContinuousEngine:
                     if self._closed:
                         return
                     self._cond.wait()
-                pending: list[tuple[str, Future, float]] = []
                 free = [i for i, s in enumerate(self._slots) if not s.active]
+                if self.admission == "sjf" and len(self._queue) > 1 and free:
+                    # Stable sort: FIFO among equal-cost jobs, so same-size
+                    # requests keep their arrival order.
+                    default = int(self.agent.sampling.max_new_tokens)
+                    # Key on the EFFECTIVE budget (admission clamps to the
+                    # engine-wide max), not the raw request cap — a cap
+                    # above the engine budget costs the same as default.
+                    self._queue = deque(sorted(
+                        self._queue,
+                        key=lambda it: (
+                            min(it[3], default) if it[3] is not None else default,
+                            len(it[0]),
+                        ),
+                    ))
+                pending: list[tuple[str, Future, float, int | None]] = []
                 while self._queue and len(pending) < len(free):
                     pending.append(self._queue.popleft())
             free_now = [i for i, s in enumerate(self._slots) if not s.active]
             mid = any(s.active for s in self._slots) or inflight is not None
-            for pos, ((q, fut, ts), idx) in enumerate(zip(pending, free_now)):
+            for pos, ((q, fut, ts, req_max), idx) in enumerate(zip(pending, free_now)):
                 try:
-                    ok = self._admit(idx, q, fut, ts, mid_flight=mid)
+                    ok = self._admit(idx, q, fut, ts, mid_flight=mid,
+                                     max_new=req_max)
                 except Exception as exc:
                     # Fail only THIS request: already-admitted slots keep
                     # their pending futures (poisoning them would make the
@@ -815,16 +931,17 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         page_size: int = 64,
         total_pages: int | None = None,
         draft_total_pages: int | None = None,
+        admission: str = "fifo",
     ):
         if getattr(agent, "draft_cfg", None) is None:
             raise ValueError(
                 "SpeculativeContinuousEngine needs an agent with a draft "
                 "model (AgentSpec.draft)"
             )
-        if kv_backend != "paged":
+        if kv_backend not in ("paged", "paged_int8"):
             raise ValueError(
                 f"speculative continuous batching runs on kv_backend='paged' "
-                f"(got {kv_backend!r})"
+                f"or 'paged_int8' (got {kv_backend!r})"
             )
         sp = agent.sampling
         if sp.do_sample and not 0 < sp.top_k < agent.cfg.vocab_size:
@@ -847,9 +964,13 @@ class SpeculativeContinuousEngine(ContinuousEngine):
                 f"page_size must be >= spec_gamma + 3 "
                 f"(got {page_size} vs gamma {agent.spec_gamma})"
             )
+        # admission="sjf" is legal here too: with the engine's uniform
+        # budget the sort key degenerates to prompt length, which is still
+        # a valid job-size signal (prefill cost).
         super().__init__(
             agent, slots=slots, chunk=chunk, idle_wait_s=idle_wait_s,
             kv_backend=kv_backend, page_size=page_size, total_pages=total_pages,
+            admission=admission,
         )
         # The worker thread is live from here on: a failure below would
         # orphan it blocked on the condition with a half-built engine —
@@ -861,11 +982,19 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             self.max_new = int(agent.sampling.max_new_tokens)
             self.cap = self.max_new + self.gamma + 1
             self.rounds_per_segment = max(1, self.chunk // (self.gamma + 1))
-            self._verify_fn, self._spec_decode_fn = _spec_fns("paged")
+            self._verify_fn, self._spec_decode_fn = _spec_fns(kv_backend)
             per_row = self._cache.page_table.shape[1]
             self._d_total = int(draft_total_pages or self.total_pages)
             d_cfg = agent.draft_cfg
-            self._init_dpool = lambda: init_paged_cache(
+            # The draft pool matches the target pool's precision: int8
+            # everywhere is the point of the paged_int8 backend, and greedy
+            # emitted tokens stay target-argmax regardless of draft cache
+            # precision (draft quality only moves the acceptance rate).
+            d_init = (
+                init_quant_paged_cache if kv_backend == "paged_int8"
+                else init_paged_cache
+            )
+            self._init_dpool = lambda: d_init(
                 d_cfg, self.n_slots, total_pages=self._d_total,
                 page_size=self.page_size, max_pages=per_row,
             )
@@ -898,7 +1027,15 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         return
 
     def _admit(self, idx: int, question: str, fut: Future, t_submit: float,
-               mid_flight: bool) -> bool:
+               mid_flight: bool, max_new: int | None = None) -> bool:
+        if max_new is not None:
+            # The spec rounds body runs ONE static max_new for the whole
+            # pool (out-buffer capacity, freeze conditions); a per-request
+            # budget would need per-row round budgets inside the while_loop.
+            raise ValueError(
+                "the speculative engine keeps one uniform budget per pool; "
+                "per-request max_new is not supported"
+            )
         agent = self.agent
         eos_id = int(getattr(agent.tokenizer, "eos_id", -1))
         prompt = agent.format_prompt(question)
@@ -1019,11 +1156,13 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         fetched = jax.device_get(seg.handles)
         nemit_h, out_h, fin_h, acc_h, prop_h, rnds_h, ft_t, ft_d = fetched
         self._spec_counters_host = (int(acc_h), int(prop_h), int(rnds_h))
-        if (int(ft_t) != 1 or int(ft_d) != 1) and not self._pool_tripwire_logged:
-            self._pool_tripwire_logged = True  # pragma: no cover
-            log.error(
+        if int(ft_t) != 1 or int(ft_d) != 1:
+            # Same contract as the base engine: a popped page is also on a
+            # host free list → double-mapping hazard. Raise so _run resets
+            # both pools AND drops the in-flight successor segment.
+            raise RuntimeError(  # pragma: no cover
                 "spec paged-pool tripwire: device allocator popped pages "
-                "(target free_top=%d, draft free_top=%d)", int(ft_t), int(ft_d),
+                f"(target free_top={int(ft_t)}, draft free_top={int(ft_d)})"
             )
         for i, gen in seg.rows:
             slot = self._slots[i]
@@ -1077,7 +1216,7 @@ def make_engine(agent, **kwargs):
     server uses.)"""
     if (
         getattr(agent, "draft_cfg", None) is not None
-        and kwargs.get("kv_backend", "dense") == "paged"
+        and kwargs.get("kv_backend", "dense") in ("paged", "paged_int8")
     ):
         return SpeculativeContinuousEngine(agent, **kwargs)
     return ContinuousEngine(agent, **kwargs)

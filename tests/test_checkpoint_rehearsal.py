@@ -1,0 +1,125 @@
+"""One-command real-checkpoint rehearsal (VERDICT r4 item 8).
+
+The reference's flagship entry point is config-in, table-out over real
+pretrained checkpoints (``Code/C-DAC Server/combiner_fp.py:380-474``). This
+environment has no network, so the real Phi-2/Pythia/Llama snapshots can't
+exist here — but the *path* they would travel can be pinned end-to-end: this
+test materializes a tiny checkpoint directory in the exact layout
+``save_pretrained`` produces (config.json + model.safetensors + a working
+tokenizer.json/tokenizer_config.json), then drives ``edgemesh eval`` with an
+``examples/ensemble_checkpoints.yaml``-shaped config straight through
+HF-config sniffing → safetensors ingest → quantization → ensemble →
+report JSON + per-sample JSONL.
+
+When you have network, the same command runs the real thing:
+
+    python -m edgemesh.cli eval --config examples/ensemble_checkpoints.yaml
+
+with each ``model.path`` pointing at a downloaded snapshot
+(docs/QUALITY.md "Running the real-checkpoint sweep").
+"""
+
+import json
+
+import pytest
+
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _write_checkpoint(dirpath, seed=0, vocab=257):
+    """A complete tiny llama snapshot: weights the way save_pretrained lays
+    them out, plus a functioning byte-level BPE tokenizer built offline."""
+    from tokenizers import Tokenizer
+    from tokenizers.decoders import ByteLevel as ByteLevelDecoder
+    from tokenizers.models import BPE
+    from tokenizers.pre_tokenizers import ByteLevel
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, eos_token_id=vocab - 1,
+    )
+    torch.manual_seed(seed)
+    LlamaForCausalLM(hf_cfg).eval().save_pretrained(dirpath)
+
+    alphabet = sorted(ByteLevel.alphabet())  # 256 byte-level symbols
+    vocab_map = {tok: i for i, tok in enumerate(alphabet)}
+    vocab_map["<|endoftext|>"] = len(vocab_map)
+    assert len(vocab_map) == vocab
+    tok = Tokenizer(BPE(vocab=vocab_map, merges=[]))
+    tok.pre_tokenizer = ByteLevel(add_prefix_space=False, use_regex=True)
+    tok.decoder = ByteLevelDecoder()
+    tok.save(str(dirpath / "tokenizer.json"))
+    (dirpath / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "eos_token": "<|endoftext|>",
+        "model_max_length": 128,
+    }))
+    return dirpath
+
+
+def test_checkpoint_dir_to_ensemble_report(tmp_path, capsys):
+    """Real-layout checkpoint dir → `edgemesh eval` → report, one command:
+    two checkpoint-backed agents (one int8-quantized at ingest — the
+    reference's quantized combo row), family auto-sniffed from config.json,
+    HF tokenizer loaded from the snapshot, per-sample JSONL written."""
+    from edgemesh.cli import main
+
+    ck_a = _write_checkpoint(tmp_path / "model_a", seed=0)
+    ck_b = _write_checkpoint(tmp_path / "model_b", seed=1)
+
+    cfg_yaml = tmp_path / "ensemble.yaml"
+    cfg_yaml.write_text(f"""
+agents:
+  - role: qa
+    model:
+      path: {ck_a}
+      family: auto
+      precision: int8
+      max_seq_len: 128
+    sampling: {{max_new_tokens: 6, do_sample: false, repetition_penalty: 1.0}}
+  - role: qa
+    model:
+      path: {ck_b}
+      family: auto
+      precision: fp32
+      max_seq_len: 128
+    sampling: {{max_new_tokens: 6, do_sample: false, repetition_penalty: 1.0}}
+eval:
+  num_samples: 3
+""")
+    out_jsonl = tmp_path / "results.jsonl"
+    rc = main([
+        "eval", "--config", str(cfg_yaml),
+        "--eval.output_jsonl", str(out_jsonl),
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["num_samples"] == 3
+    for key in ("rouge1", "avg_rouge", "bleu", "confidence", "tps"):
+        assert key in report, key
+    rows = [json.loads(line) for line in open(out_jsonl)]
+    assert len(rows) == 3
+    assert all(isinstance(r["answer"], str) for r in rows)
+
+
+def test_checkpoint_tokenizer_round_trips(tmp_path):
+    """The offline-built tokenizer is a real HF fast tokenizer: encode and
+    decode round-trip through the snapshot directory alone (the property
+    serving/eval rely on for any downloaded checkpoint)."""
+    from edgemesh.models.tokenizer import load_tokenizer
+
+    ck = _write_checkpoint(tmp_path / "model", seed=0)
+    tok = load_tokenizer(ck)
+    ids = tok.encode("where is the eiffel tower?")
+    assert ids and all(0 <= i < 257 for i in ids)
+    assert tok.decode(ids) == "where is the eiffel tower?"
+    assert tok.eos_id == 256

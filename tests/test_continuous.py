@@ -11,6 +11,11 @@ from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
 from edgemesh.serve.continuous import ContinuousEngine
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _agent(max_new=24):
     return build_agent(
         AgentSpec(
@@ -394,5 +399,141 @@ def test_host_owned_paging_never_pops_device_pages():
         assert len(eng._free_pages) == (
             eng.total_pages - 1 - len(eng._template_pages)
         )
+    finally:
+        eng.close()
+
+
+def test_dense_int8_engine_matches_paged_int8_engine():
+    """Continuous batching over the int8 dense slab (kv_backend="dense_int8"):
+    quantize_kv's per-token scales are the same math in the slab and the
+    page pool, so greedy answers are token-identical across the two int8
+    backends — the SERVING.md matrix cell this pins."""
+    agent = _agent(max_new=12)
+    qs = [
+        "where is the eiffel tower?",
+        "name a large african animal",
+        "how many legs has a spider",
+        "what color is the sky above?",
+    ]
+    ref_eng = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged_int8",
+                               page_size=8)
+    try:
+        ref = [f.result(timeout=600) for f in [ref_eng.submit(q) for q in qs]]
+    finally:
+        ref_eng.close()
+    eng = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="dense_int8")
+    try:
+        got = [f.result(timeout=600) for f in [eng.submit(q) for q in qs]]
+        for r, g in zip(ref, got):
+            assert g["answer"] == r["answer"], (g["answer"], r["answer"])
+        assert eng.stats()["kv_backend"] == "dense_int8"
+        assert "total_pages" not in eng.stats()  # slab backend: no pool keys
+    finally:
+        eng.close()
+
+
+def test_speculative_engine_paged_int8_matches_plain_engine():
+    """Speculative continuous batching over the int8 page pools: greedy
+    answers are token-identical to the plain paged_int8 engine (the target's
+    int8 KV trajectory is draft-independent), and the factory routes a
+    draft-carrying agent on paged_int8 to the spec engine."""
+    from edgemesh.serve.continuous import (
+        SpeculativeContinuousEngine,
+        make_engine,
+    )
+
+    agent = _spec_agent()
+    qs = [f"question number {i}: where is the eiffel tower?" for i in range(4)]
+    plain = ContinuousEngine(agent, slots=4, chunk=4, kv_backend="paged_int8",
+                             page_size=16)
+    try:
+        ref = [f.result(timeout=600) for f in [plain.submit(q) for q in qs]]
+    finally:
+        plain.close()
+    spec = make_engine(agent, slots=4, chunk=6, kv_backend="paged_int8",
+                       page_size=16)
+    try:
+        assert isinstance(spec, SpeculativeContinuousEngine)
+        got = [f.result(timeout=600) for f in [spec.submit(q) for q in qs]]
+        for r, g in zip(ref, got):
+            assert g["answer"] == r["answer"], (g["answer"], r["answer"])
+            assert g["generated"] == r["generated"]
+        st = spec.stats()
+        assert st["spec_rounds"] > 0 and st["spec_proposed"] > 0
+        assert st["kv_backend"] == "paged_int8"
+    finally:
+        spec.close()
+
+
+def test_per_request_budget_caps_generation():
+    """submit(max_new=) caps one request below the engine budget; others
+    keep the full budget (slot.remaining is host state, so this is free)."""
+    agent = _agent(max_new=24)
+    eng = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged",
+                           page_size=8)
+    try:
+        short = eng.submit("short one?", max_new=3)
+        full = eng.submit("full one?")
+        assert short.result(timeout=600)["generated"] <= 3
+        assert full.result(timeout=600)["generated"] > 3
+    finally:
+        eng.close()
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="max_new"):
+        _e = ContinuousEngine(agent, slots=1, chunk=4)
+        try:
+            _e.submit("q", max_new=0)
+        finally:
+            _e.close()
+
+
+def test_sjf_admission_reorders_queue_fifo_does_not():
+    """With one busy slot, SJF admits the cheapest waiting job first even
+    when it arrived last; FIFO keeps arrival order. Start timestamps
+    (t_start) expose the admission order directly."""
+    agent = _agent(max_new=24)
+    eng = ContinuousEngine(agent, slots=1, chunk=4, kv_backend="paged",
+                           page_size=8, admission="sjf")
+    try:
+        hold = eng.submit("occupy the slot please?", max_new=24)
+        deadline = time.time() + 300
+        while eng.segments < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        long2 = eng.submit("second long job?", max_new=24)
+        short = eng.submit("short job?", max_new=2)
+        hold.result(timeout=600)
+        rs, rl = short.result(timeout=600), long2.result(timeout=600)
+        assert rs["t_start"] < rl["t_start"], "SJF did not reorder"
+        assert rs["generated"] <= 2
+    finally:
+        eng.close()
+
+    eng2 = ContinuousEngine(agent, slots=1, chunk=4, kv_backend="paged",
+                            page_size=8)  # default fifo
+    try:
+        hold = eng2.submit("occupy the slot please?", max_new=24)
+        deadline = time.time() + 300
+        while eng2.segments < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        long2 = eng2.submit("second long job?", max_new=24)
+        short = eng2.submit("short job?", max_new=2)
+        hold.result(timeout=600)
+        rl, rs = long2.result(timeout=600), short.result(timeout=600)
+        assert rl["t_start"] < rs["t_start"], "FIFO order broken"
+    finally:
+        eng2.close()
+
+
+def test_spec_engine_rejects_per_request_budget():
+    from edgemesh.serve.continuous import SpeculativeContinuousEngine
+
+    agent = _spec_agent()
+    eng = SpeculativeContinuousEngine(agent, slots=2, chunk=6,
+                                      kv_backend="paged", page_size=16)
+    try:
+        fut = eng.submit("any question?", max_new=4)
+        with pytest.raises(ValueError, match="uniform budget"):
+            fut.result(timeout=600)
     finally:
         eng.close()

@@ -14,6 +14,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 

@@ -88,6 +88,11 @@ CHILD = textwrap.dedent(
 ) % {"repo": str(REPO)}
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))

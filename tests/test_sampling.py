@@ -138,3 +138,28 @@ def test_min_p_out_of_range_rejected():
 
     with pytest.raises(ValueError, match="min_p"):
         SamplingParams(min_p=1.5)
+
+
+def test_top_p_then_min_p_matches_hf_order():
+    """Combined top_p+min_p must follow HF's warper order (TopP then MinP).
+    probs [0.5, 0.2, 0.2, 0.1], top_p=0.75, min_p=0.3: HF keeps 3 tokens
+    (top-p drops only the 0.1 tail; min-p threshold 0.15 keeps the rest).
+    The reverse order would renormalize after min-p and drop the third
+    token too (cum-exclusive 0.778 >= 0.75) — only 2 survivors."""
+    from edgemesh.ops.sampling import NEG_INF, filtered_candidates
+
+    logits = jnp.log(jnp.array([[0.5, 0.2, 0.2, 0.08, 0.02]]))
+    sp = SamplingParams(do_sample=True, top_k=4, top_p=0.75, min_p=0.3,
+                        temperature=1.0, repetition_penalty=1.0)
+    idx, probs = filtered_candidates(logits, sp)
+    p = np.asarray(probs[0])
+    assert (p > 0).sum() == 3, p
+    # Vocab-wide path agrees.
+    counts = set()
+    for seed in range(40):
+        counts.add(int(sample_token(jax.random.PRNGKey(seed), logits,
+                                    SamplingParams(do_sample=True, top_k=0,
+                                                   top_p=0.75, min_p=0.3,
+                                                   temperature=1.0,
+                                                   repetition_penalty=1.0))[0]))
+    assert counts <= {0, 1, 2} and len(counts) == 3, counts

@@ -11,6 +11,11 @@ from edgemesh.agents import build_ensemble
 from edgemesh.serve import serve_rest
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _tiny_cfg():
     def spec(role):
         return AgentSpec(

@@ -24,6 +24,11 @@ from edgemesh.runtime import generate
 from edgemesh.runtime.speculative import generate_speculative
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _models(seed_t=0, seed_d=1, vocab=64):
     cfg = tiny_config("llama", vocab_size=vocab, max_seq_len=128)
     pt = init_params(cfg, jax.random.PRNGKey(seed_t))
@@ -329,3 +334,26 @@ def test_speculative_paged_sampled_matches_dense():
         gamma=3, rng=jax.random.PRNGKey(11), kv_backend="paged", page_size=4,
     )
     np.testing.assert_array_equal(np.asarray(dense.tokens), np.asarray(paged.tokens))
+
+
+def test_speculative_paged_int8_matches_plain_paged_int8():
+    """Speculative decoding over int8 page pools emits exactly what plain
+    int8-paged decoding emits (greedy): the verify chunk's per-token
+    quantize_kv scales are identical to the decode step's, so the target's
+    int8 KV trajectory — and therefore its argmax at every position — is
+    the same with or without a draft."""
+    from edgemesh.runtime.paged_generate import generate_paged
+
+    cfg, params_t, params_d = _models()
+    tokens = jnp.array([[5, 9, 11, 42, 7], [17, 3, 50, 8, 0]], jnp.int32)
+    lengths = jnp.array([5, 4], jnp.int32)
+    s = SamplingParams(max_new_tokens=16, do_sample=False, repetition_penalty=1.0)
+    plain = generate_paged(cfg, params_t, tokens, lengths, s, eos_id=-1,
+                           kv_quant=True, page_size=4)
+    spec, stats = generate_speculative(
+        cfg, params_t, cfg, params_d, tokens, lengths, s,
+        gamma=3, eos_id=-1, rng=jax.random.PRNGKey(3),
+        kv_backend="paged_int8", page_size=4,
+    )
+    np.testing.assert_array_equal(np.asarray(plain.tokens), np.asarray(spec.tokens))
+    assert stats.proposed > 0

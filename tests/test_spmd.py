@@ -23,6 +23,11 @@ from edgemesh.parallel.spmd import (
 from edgemesh.training import causal_lm_loss, init_train_state, make_optimizer
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _tiny(family: str):
     # fp32 so the parity check is tight despite different reduction orders.
     return tiny_config(

@@ -15,6 +15,11 @@ from edgemesh.runtime.stream import generate_stream
 GREEDY = SamplingParams(max_new_tokens=24, do_sample=False, repetition_penalty=1.0)
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _model(vocab=64):
     cfg = tiny_config("llama", vocab_size=vocab, max_seq_len=128)
     return cfg, init_params(cfg, jax.random.PRNGKey(0))

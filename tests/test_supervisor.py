@@ -11,6 +11,11 @@ from edgemesh.serve.supervisor import Supervisor
 from edgemesh.utils.tracing import JsonlLogger, phase_report, reset_phases, trace
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 class FlakyBackend:
     """Fails `fail_first` calls after each construction, then succeeds."""
 

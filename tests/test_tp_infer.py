@@ -19,6 +19,11 @@ from edgemesh.parallel.mesh import build_mesh
 from edgemesh.parallel.tp_infer import TPInferenceEngine
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _cfg(family="llama", **kw):
     kw.setdefault("num_heads", 4)
     kw.setdefault("num_kv_heads", 4)
