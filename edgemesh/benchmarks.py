@@ -597,6 +597,7 @@ def speculative_benchmark(
     gamma: int = 4,
     draft_layers_frac: float = 0.25,
     kv_backend: str = "dense",
+    built: tuple | None = None,
 ) -> dict[str, Any]:
     """Speculative vs plain decode at batch 1 (the latency regime speculative
     decoding targets). The draft is a depth-truncated random-init copy —
@@ -612,7 +613,7 @@ def speculative_benchmark(
     from edgemesh.runtime.speculative import generate_speculative
 
     preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
-    cfg, params = _build(preset, "bf16", "w8a16")
+    cfg, params = built if built is not None else _build(preset, "bf16", "w8a16")
     d_layers = max(1, int(cfg.num_layers * draft_layers_frac))
     d_cfg = cfg.replace(num_layers=d_layers)
     d_params = init_params(d_cfg, jax.random.PRNGKey(7))
@@ -850,7 +851,10 @@ def headline_benchmark(
     # the acceptance rate (reported) is near-chance and the speedup is a
     # LOWER bound; trained pairs accept far more.
     def _spec():
-        r = speculative_benchmark(preset)
+        # One bf16 target build serves BOTH arms (the int8_built tree the
+        # other stages share is the wrong precision for the spec target).
+        bf16_built = _build(preset, "bf16", "w8a16")
+        r = speculative_benchmark(preset, built=bf16_built)
         out["spec_b1_tok_s"] = r["spec_tok_s"]
         out["spec_plain_b1_tok_s"] = r["plain_tok_s"]
         out["spec_speedup"] = r["spec_speedup"]
@@ -858,7 +862,8 @@ def headline_benchmark(
         out["spec_gamma"] = r["gamma"]
         emit_partial(out)
         # Composed cell: speculative over int8 page pools (both arms int8).
-        r2 = speculative_benchmark(preset, kv_backend="paged_int8")
+        r2 = speculative_benchmark(preset, kv_backend="paged_int8",
+                                   built=bf16_built)
         out["spec_paged_int8_b1_tok_s"] = r2["spec_tok_s"]
         out["spec_paged_int8_plain_b1_tok_s"] = r2["plain_tok_s"]
         out["spec_paged_int8_speedup"] = r2["spec_speedup"]

@@ -1026,6 +1026,18 @@ class SpeculativeContinuousEngine(ContinuousEngine):
     def _ensure_template(self) -> None:
         return
 
+    def submit(self, question: str, max_new: int | None = None) -> Future:
+        if max_new is not None:
+            # Fail fast on the caller's thread — the _admit guard below
+            # stays as defense in depth, but surfacing an EXPECTED
+            # validation error asynchronously via log.exception would be
+            # noise indistinguishable from real admission failures.
+            raise ValueError(
+                "the speculative engine keeps one uniform budget per pool; "
+                "per-request max_new is not supported"
+            )
+        return super().submit(question)
+
     def _admit(self, idx: int, question: str, fut: Future, t_submit: float,
                mid_flight: bool, max_new: int | None = None) -> bool:
         if max_new is not None:

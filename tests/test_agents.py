@@ -7,6 +7,11 @@ from edgemesh.config import AgentSpec, EdgeMeshConfig, ModelSpec, SamplingParams
 from edgemesh.agents import build_agent, build_ensemble
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _tiny_spec(role="qa", **model_kw):
     model_kw.setdefault("num_layers", 2)
     model_kw.setdefault("hidden_size", 32)

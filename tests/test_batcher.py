@@ -13,6 +13,11 @@ from edgemesh.serve.batcher import DynamicBatcher
 GREEDY = SamplingParams(max_new_tokens=8, do_sample=False, repetition_penalty=1.0)
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _agent():
     return build_agent(AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY))
 

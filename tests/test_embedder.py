@@ -10,6 +10,11 @@ from edgemesh.eval.harness import score_sample
 from edgemesh.eval.metrics import HashingEmbedder, bertscore, cosine_similarity
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 @pytest.fixture(scope="module")
 def model_embedder():
     emb = build_embedder("synthetic")

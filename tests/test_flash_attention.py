@@ -14,6 +14,11 @@ from edgemesh.ops.attention import LayerKV, attend
 from edgemesh.ops.flash_attention import flash_attention
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _reference(q, k, v, q_positions, kv_lens):
     max_seq = k.shape[1]
     cache = LayerKV(k, v)

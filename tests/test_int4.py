@@ -17,6 +17,11 @@ from edgemesh.ops.int4 import (
 from edgemesh.runtime import generate
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def test_quantize_roundtrip_error_bounded():
     k = jax.random.normal(jax.random.PRNGKey(0), (128, 64)) * 0.3
     for gs in (0, 32, 64):

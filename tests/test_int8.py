@@ -26,6 +26,11 @@ from edgemesh.ops.int8 import (
 from edgemesh.runtime import generate
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def test_quantize_weight_roundtrip():
     w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.1
     q, scales = quantize_weight(w)

@@ -28,6 +28,11 @@ from edgemesh.training import (
 )
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 @pytest.fixture(scope="module")
 def base():
     cfg = tiny_config("llama", num_layers=2)

@@ -16,6 +16,11 @@ from edgemesh.runtime import generate
 FAMILIES = ["llama", "neox", "phi2", "mistral", "qwen2", "gemma", "phi3", "gemma2", "gpt2", "falcon"]
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 @pytest.mark.parametrize("family", FAMILIES)
 def test_prefill_shapes(family):
     cfg = tiny_config(family)

@@ -12,6 +12,12 @@ from edgemesh.ops.moe import expert_capacity, moe_mlp
 from edgemesh.training import causal_lm_loss, init_train_state, make_optimizer, make_train_step
 
 
+import pytest
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _cfg(**kw):
     base = dict(num_heads=4, num_kv_heads=2, hidden_size=32, intermediate_size=64,
                 num_layers=2, vocab_size=64, max_seq_len=64,

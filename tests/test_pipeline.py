@@ -14,6 +14,11 @@ from edgemesh.parallel.pipeline import PipelineEngine
 from edgemesh.training import forward_train
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 @pytest.fixture(scope="module")
 def setup():
     cfg = tiny_config("llama", num_layers=4)  # 4 layers over pp=4 → 1 each

@@ -17,6 +17,12 @@ from edgemesh.runtime.prefix_cache import (
 GREEDY = SamplingParams(max_new_tokens=10, do_sample=False, repetition_penalty=1.0)
 
 
+import pytest
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _model():
     cfg = tiny_config("llama", vocab_size=128, max_seq_len=128, dtype="float32")
     return cfg, init_params(cfg, jax.random.PRNGKey(0))

@@ -29,6 +29,11 @@ from edgemesh.parallel.sharding import (
 from edgemesh.runtime import generate
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def test_build_mesh_axes(devices):
     mesh = build_mesh(dp=2, tp=4)
     assert mesh.shape == {"dp": 2, "pp": 1, "sp": 1, "ep": 1, "tp": 4}

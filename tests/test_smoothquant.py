@@ -12,6 +12,11 @@ from edgemesh.ops.smoothquant import calibrate_and_quantize, collect_activation_
 from edgemesh.training import forward_train
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _calib_batch(cfg, b=2, s=12, seed=3):
     tokens = jax.random.randint(jax.random.PRNGKey(seed), (b, s), 0, cfg.vocab_size)
     lengths = jnp.asarray([s, s - 4], jnp.int32)

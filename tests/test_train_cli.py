@@ -14,6 +14,11 @@ from edgemesh.config import (
 from edgemesh.training import run_training
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _cfg(**train_kw):
     return EdgeMeshConfig(
         agents=[AgentSpec(role="qa", model=ModelSpec(num_layers=2, hidden_size=64))],

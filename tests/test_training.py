@@ -14,6 +14,12 @@ from edgemesh.training import (
 )
 
 
+import pytest
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def test_loss_decreases_on_fixed_batch():
     cfg = tiny_config("llama")
     params = init_params(cfg, jax.random.PRNGKey(0))

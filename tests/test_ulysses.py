@@ -14,6 +14,11 @@ from edgemesh.parallel.ulysses import ulysses_attention
 from edgemesh.training import causal_lm_loss
 
 
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
 def _dense_reference(q, k, v, positions, valid):
     """Causal attention via the dense cache op (keys at slot j hold position j)."""
     return attend(q, LayerKV(k, v), positions, valid)
