@@ -1,8 +1,16 @@
 #!/bin/sh
-# Round-4 measurement sequence — run on a HEALTHY tunnel, one process at a
-# time (never two TPU processes). Each stage appends to r4_measurements.log.
+# Round-5 measurement sequence — run on a HEALTHY tunnel, one TPU process at
+# a time (never two). Each stage appends to r4_measurements.log. Any running
+# LoRA-sweep CPU training is SIGSTOPped for the duration: bench serving/
+# dispatch numbers are host-loop sensitive and must not time CPU contention.
 set -x
 cd "$(dirname "$0")/.." || exit 1
+SWEEP_PIDS=$(pgrep -f run_lora_sweep.py)
+resume_sweep() { [ -n "$SWEEP_PIDS" ] && kill -CONT $SWEEP_PIDS 2>/dev/null; }
+# ALWAYS resume the sweep, even when a stage dies or the shell is hung up —
+# a missed CONT would freeze the CPU training silently forever.
+trap resume_sweep EXIT INT TERM HUP
+[ -n "$SWEEP_PIDS" ] && kill -STOP $SWEEP_PIDS
 date >> artifacts/r4_measurements.log
 python bench.py 2>>artifacts/r4_measurements.log | tee -a artifacts/r4_measurements.log
 python artifacts/serve8b_drive.py 2>>artifacts/r4_measurements.log | tee -a artifacts/r4_measurements.log

@@ -68,13 +68,15 @@ def cmd_eval(cfg: EdgeMeshConfig) -> int:
 
 
 def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool = False,
-              kv_backend: str = "dense", kv_page_size: int = 64) -> int:
+              kv_backend: str = "dense", kv_page_size: int = 64,
+              admission: str = "fifo") -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.serve import serve_rest
 
     ensemble = build_ensemble(cfg)
     serve_rest(ensemble, port=port, batch=batch, continuous=continuous,
-               kv_backend=kv_backend, kv_page_size=kv_page_size)
+               kv_backend=kv_backend, kv_page_size=kv_page_size,
+               admission=admission)
     return 0
 
 
@@ -199,9 +201,15 @@ def main(argv: list[str] | None = None) -> int:
     )
     top.add_argument(
         "--kv-backend", default="dense",
-        choices=["dense", "paged", "paged_int8"],
+        choices=["dense", "dense_int8", "paged", "paged_int8"],
         help="serve --continuous: KV memory model (paged = shared page pool "
-        "with zero-copy admission + reclamation; paged_int8 halves KV bytes)",
+        "with zero-copy admission + reclamation; *_int8 halves KV bytes)",
+    )
+    top.add_argument(
+        "--admission", default="fifo", choices=["fifo", "sjf"],
+        help="serve --continuous: queue policy (sjf = shortest-job-first by "
+        "per-request max_new budget + prompt length; cuts short-job p50 on "
+        "mixed workloads, default fifo)",
     )
     top.add_argument(
         "--kv-page-size", type=int, default=64,
@@ -251,7 +259,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_eval(cfg)
     if cmd_args.command == "serve":
         return cmd_serve(cfg, cmd_args.port, cmd_args.batch, cmd_args.continuous,
-                         cmd_args.kv_backend, cmd_args.kv_page_size)
+                         cmd_args.kv_backend, cmd_args.kv_page_size,
+                         cmd_args.admission)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     if cmd_args.command == "train":

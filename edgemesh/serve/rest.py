@@ -118,6 +118,39 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
                 if not question:
                     self._send(400, {"error": "missing 'question' field"})
                     return
+                # Per-request "max_new" caps one request's budget. ONE
+                # validation + capability gate up front, shared by every
+                # arm: a client-input problem is always a 400 (never a
+                # silent ignore or a 500). bool is an int subtype in
+                # Python — reject it explicitly.
+                max_new = payload.get("max_new")
+                if max_new is not None and (
+                    isinstance(max_new, bool)
+                    or not isinstance(max_new, int)
+                    or max_new < 1
+                ):
+                    self._send(400, {"error": "'max_new' must be a positive int"})
+                    return
+                if max_new is not None:
+                    from edgemesh.serve.continuous import (
+                        ContinuousEngine,
+                        SpeculativeContinuousEngine,
+                    )
+
+                    # The spec engine's submit() raises on max_new (one
+                    # uniform budget per pool); the stream path never
+                    # reaches the engine submit with a budget at all.
+                    if (
+                        self.path == "/generate_stream"
+                        or not isinstance(batcher, ContinuousEngine)
+                        or isinstance(batcher, SpeculativeContinuousEngine)
+                    ):
+                        self._send(400, {
+                            "error": "'max_new' needs non-streaming "
+                            "--continuous serving with a non-speculative "
+                            "engine (uniform budget per pool)"
+                        })
+                        return
                 if self.path == "/generate_stream":
                     self._stream(question)
                     return
@@ -126,7 +159,10 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
                     # (serve/batcher.py) — the ThreadingHTTPServer gives each
                     # request its own thread, so under load the batcher sees
                     # them simultaneously.
-                    result = batcher.answer(question)
+                    if max_new is not None:
+                        result = batcher.answer(question, max_new=max_new)
+                    else:
+                        result = batcher.answer(question)
                 elif supervisor is not None:
                     result = supervisor.call(question)
                 else:
@@ -147,7 +183,7 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
 def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = True,
                supervisor=None, batch: int = 0, batch_wait_s: float = 0.02,
                continuous: bool = False, kv_backend: str = "dense",
-               kv_page_size: int = 64):
+               kv_page_size: int = 64, admission: str = "fifo"):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
@@ -163,9 +199,11 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     then-drain batcher for the chunk-granular ContinuousEngine
     (serve/continuous.py): requests join/leave the resident decode loop at
     segment boundaries; ``batch`` sizes the slot pool. ``kv_backend``
-    ("dense" | "paged" | "paged_int8") picks the engine's KV memory model —
-    the paged pool gives zero-copy admission and page reclamation
-    (serve/continuous.py module docstring)."""
+    ("dense" | "dense_int8" | "paged" | "paged_int8") picks the engine's KV
+    memory model — the paged pool gives zero-copy admission and page
+    reclamation (serve/continuous.py module docstring). ``admission``
+    ("fifo" | "sjf") picks the engine's queue policy; /generate accepts an
+    optional per-request ``max_new`` budget under continuous serving."""
     batcher = None
     if kv_backend != "dense" and not continuous:
         raise ValueError(
@@ -193,7 +231,7 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
         # engine (pool-wide draft→verify rounds); otherwise the plain one.
         batcher = make_engine(
             ensemble.qa_agents[0], slots=batch or 8, kv_backend=kv_backend,
-            page_size=kv_page_size,
+            page_size=kv_page_size, admission=admission,
         )
     elif batch > 1:
         from edgemesh.serve.batcher import DynamicBatcher

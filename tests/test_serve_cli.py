@@ -198,3 +198,82 @@ def test_rest_continuous_speculative_end_to_end():
         srv.shutdown()
         if srv.batcher is not None:
             srv.batcher.close()
+
+
+def test_rest_per_request_budget_and_sjf_admission():
+    """/generate accepts a per-request "max_new" under continuous serving
+    (engine budget cap rides the JSON body) and serve_rest forwards the
+    admission policy to the engine; non-continuous servers reject max_new
+    with a 400, not a silent ignore."""
+    from edgemesh.agents.orchestrator import Ensemble, build_agent
+
+    agent = build_agent(AgentSpec(
+        role="qa",
+        model=ModelSpec(family="llama", vocab_size=260, num_layers=1,
+                        hidden_size=32, num_heads=4, num_kv_heads=4,
+                        intermediate_size=64, max_seq_len=128),
+        sampling=SamplingParams(max_new_tokens=12, do_sample=False,
+                                repetition_penalty=1.0),
+    ))
+    srv = serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1", port=0,
+                     block=False, continuous=True, kv_backend="paged",
+                     kv_page_size=16, batch=2, admission="sjf")
+    try:
+        assert srv.batcher.admission == "sjf"
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        body = json.dumps({"question": "where is the eiffel tower?",
+                           "max_new": 3}).encode()
+        req = urllib.request.Request(
+            f"{url}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            resp = json.load(r)
+        assert 0 < resp["generated"] <= 3, resp
+        for bad_body in (
+            {"question": "q", "max_new": 0},      # out of range
+            {"question": "q", "max_new": True},   # bool is not a budget
+        ):
+            bad = urllib.request.Request(
+                f"{url}/generate",
+                data=json.dumps(bad_body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(bad, timeout=60)
+                raise AssertionError(f"accepted {bad_body}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        # Stream path: max_new is rejected, never silently ignored.
+        sreq = urllib.request.Request(
+            f"{url}/generate_stream",
+            data=json.dumps({"question": "q?", "max_new": 3}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(sreq, timeout=60)
+            raise AssertionError("stream accepted max_new")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.shutdown()
+        if srv.batcher is not None:
+            srv.batcher.close()
+
+    # Non-continuous server: max_new is a 400.
+    srv2 = serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1", port=0,
+                      block=False)
+    try:
+        url = f"http://127.0.0.1:{srv2.server_address[1]}"
+        req = urllib.request.Request(
+            f"{url}/generate",
+            data=json.dumps({"question": "q?", "max_new": 3}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=120)
+            raise AssertionError("non-continuous server accepted max_new")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv2.shutdown()
