@@ -8,8 +8,11 @@ cd "$(dirname "$0")/.." || exit 1
 SWEEP_PIDS=$(pgrep -f run_lora_sweep.py)
 resume_sweep() { [ -n "$SWEEP_PIDS" ] && kill -CONT $SWEEP_PIDS 2>/dev/null; }
 # ALWAYS resume the sweep, even when a stage dies or the shell is hung up —
-# a missed CONT would freeze the CPU training silently forever.
-trap resume_sweep EXIT INT TERM HUP
+# a missed CONT would freeze the CPU training silently forever. On a real
+# signal, resume and TERMINATE: continuing the remaining stages with the
+# sweep running again would time CPU contention into the measurements.
+trap resume_sweep EXIT
+trap 'resume_sweep; trap - EXIT; exit 130' INT TERM HUP
 [ -n "$SWEEP_PIDS" ] && kill -STOP $SWEEP_PIDS
 date >> artifacts/r4_measurements.log
 python bench.py 2>>artifacts/r4_measurements.log | tee -a artifacts/r4_measurements.log

@@ -41,7 +41,7 @@ try:
     gen = sum(r["generated"] for r in results)
     lats = [r["t_end"] - r["t_start"] + r["queue_s"] for r in results]
     med = float(np.median(wave_tok_s))
-    print(json.dumps({
+    out = {
         "metric": "serving_tok_s_llama8b_int8_paged",
         "value": round(med, 2),
         "wave_tok_s": [round(t, 2) for t in wave_tok_s],
@@ -51,6 +51,12 @@ try:
         "latency_s_p50": round(float(np.percentile(lats, 50)), 3),
         "latency_s_p95": round(float(np.percentile(lats, 95)), 3),
         "stats": eng.stats(),
-    }))
+    }
+    print(json.dumps(out))
+    from pathlib import Path
+
+    from edgemesh.utils.record import archive_result
+
+    archive_result(out, "serving8b", Path(__file__).parent)
 finally:
     eng.close()

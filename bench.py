@@ -69,6 +69,9 @@ def main() -> int:
     start_stall_watchdog()
     result = headline_benchmark()
     print(json.dumps(result))
+    from edgemesh.utils.record import archive_result
+
+    archive_result(result, "bench", Path(__file__).parent / "artifacts")
     return 0
 
 

@@ -211,6 +211,12 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
             "pool lives in the ContinuousEngine); add --continuous, or drop "
             "the flag for the dense batched paths"
         )
+    if admission != "fifo" and not continuous:
+        raise ValueError(
+            f"admission={admission!r} requires continuous=True (the queue "
+            "policy lives in the ContinuousEngine); add --continuous, or "
+            "drop the flag for the batched paths"
+        )
     if continuous:
         from edgemesh.serve.continuous import make_engine
 

@@ -1,0 +1,29 @@
+"""Measurement self-archiving: one dated on-chip artifact per completed run.
+
+Health windows on the tunneled TPU are rare and can open at any hour; every
+measurement entry point (bench.py, the 8B serving drive) archives its own
+result so the record — and bench.py's stale-fallback corpus — never depends
+on a human copying numbers out of a window by hand.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+
+def archive_result(result: dict, prefix: str, directory: str | Path) -> Path | None:
+    """Write ``result`` (with an injected ``measured_at_utc`` stamp) to
+    ``directory/<prefix>_<UTC stamp>.json``. Dated names sort
+    chronologically, and the date is the second ``_`` field — the shape
+    bench.py's stale fallback parses. Archiving must never fail the
+    measurement itself: any OSError returns None."""
+    stamp = time.strftime("%Y-%m-%d_%H%M%S", time.gmtime())
+    result["measured_at_utc"] = stamp
+    path = Path(directory) / f"{prefix}_{stamp}.json"
+    try:
+        path.write_text(json.dumps(result, indent=2))
+    except OSError:
+        return None
+    return path
