@@ -69,7 +69,45 @@ device_sync(out)
 per = (time.perf_counter() - t0) / (N * 128)
 print(f"chained loops, one sync: {1e3 * per:.3f} ms/step = {8 / per:.0f} tok/s")
 
-# C: device profile of one 512-step window.
+# C: isolate the per-step non-matmul tail. Leading hypothesis for the 49%
+# HBM util: lax.top_k(50) over the 128,256-wide vocab EVERY step (a
+# sort-based lowering on TPU) — 8B pays the same vocab cost against 4.7x
+# the weight time, which would explain its better (0.75) util. Time the
+# jitted sampling transform alone on bench-shaped logits, and the exact
+# top_k alone vs approx_max_k (the TPU-native MIPS op, sampling.py's
+# opt-in approx_top_k=True path).
+from edgemesh.ops.sampling import sample_token
+from edgemesh.config import SamplingParams as _SP
+
+lg = jax.random.normal(jax.random.PRNGKey(2), (8, cfg.vocab_size), jnp.float32)
+
+
+def _time(fn, *args, iters=50):
+    fn(*args)  # compile
+    device_sync(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    device_sync(r)
+    return (time.perf_counter() - t0) / iters
+
+
+import dataclasses
+
+sp_exact = _SP(max_new_tokens=1, temperature=0.7, top_k=50, top_p=0.9,
+               repetition_penalty=1.2, do_sample=True)
+sp_approx = dataclasses.replace(sp_exact, approx_top_k=True)
+t_samp = _time(jax.jit(lambda r, l: sample_token(r, l, sp_exact)), rng, lg)
+t_samp_a = _time(jax.jit(lambda r, l: sample_token(r, l, sp_approx)), rng, lg)
+t_topk = _time(jax.jit(lambda l: jax.lax.top_k(l, 50)[0]), lg)
+t_approx = _time(jax.jit(lambda l: jax.lax.approx_max_k(l, 50)[0]), lg)
+print(f"sampling transform alone: exact {1e3 * t_samp:.3f} ms/step vs "
+      f"approx {1e3 * t_samp_a:.3f} ms/step; "
+      f"bare exact top_k(50): {1e3 * t_topk:.3f} ms; "
+      f"bare approx_max_k(50): {1e3 * t_approx:.3f} ms "
+      f"(decode step total ~{1e3 * per:.3f} ms)")
+
+# D: device profile of one 512-step window.
 with capture_profile("artifacts/profile_1b"):
     generate(cfg, params, tokens, lengths, sampling)
 print("profile -> artifacts/profile_1b/")

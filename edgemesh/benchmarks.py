@@ -330,6 +330,7 @@ def admission_policy_benchmark(
 
 _T0 = time.perf_counter()
 LAST_PROGRESS = time.monotonic()
+_ARCHIVE_PATH = None  # per-run continuous-archive target (emit_partial)
 
 # Latest complete-so-far headline result. Updated (and re-printed to stdout)
 # after EVERY finished stage so a stall mid-run still leaves the driver a
@@ -359,10 +360,24 @@ def emit_partial(result: dict[str, Any]) -> None:
     the watchdog sees a half-built dict (or dies iterating a mutating one)."""
     import json
 
-    global _PARTIAL
+    global _PARTIAL, _ARCHIVE_PATH
     _PARTIAL = dict(result)
     if "metric" in result:
         print(json.dumps(result), flush=True)
+        # Continuous archiving (bench.py sets EDGEMESH_BENCH_ARCHIVE=1): one
+        # dated file per run, rewritten after every stage — a watchdog
+        # stall-exit or stage wedge still leaves the freshest partial on
+        # disk for the stale-fallback corpus. Env-gated so CPU tests
+        # calling emit_partial never litter artifacts/ with bogus entries.
+        if os.environ.get("EDGEMESH_BENCH_ARCHIVE") == "1":
+            from pathlib import Path
+
+            from edgemesh.utils.record import archive_result
+
+            _ARCHIVE_PATH = archive_result(
+                result, "bench", Path(__file__).parent.parent / "artifacts",
+                path=_ARCHIVE_PATH,
+            ) or _ARCHIVE_PATH
 
 
 def start_stall_watchdog(timeout_s: float | None = None) -> None:
@@ -446,6 +461,7 @@ def decode_benchmark(
     repeats: int = 3,
     built: tuple | None = None,
     kv_backend: str = "dense",
+    approx_top_k: bool = False,
 ) -> dict[str, Any]:
     """One (precision, quant_mode, batch, kv_backend) point: best-of-`repeats`
     decode tok/s with TTFT and bandwidth-utilization accounting. ``built``
@@ -467,7 +483,7 @@ def decode_benchmark(
 
     sampling = SamplingParams(
         max_new_tokens=decode_steps, temperature=0.7, top_k=50, top_p=0.9,
-        repetition_penalty=1.2, do_sample=True,
+        repetition_penalty=1.2, do_sample=True, approx_top_k=approx_top_k,
     )
     tokens = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size, jnp.int32
@@ -757,6 +773,21 @@ def headline_benchmark(
         _rebest()
 
     _stage("paged", _paged)
+
+    # ---- Stage 4b: sampler A/B — exact lax.top_k vs approx_max_k on the
+    # headline config. Tests the 49%-HBM-util hypothesis directly: if the
+    # per-step gap is the vocab-wide sort, this key jumps while everything
+    # else is held fixed (profile_1b_decode.py probe C isolates the same
+    # cost outside the loop).
+    def _sampler():
+        # Same repeats as the stage-1 exact arm: best-of-N is monotone in
+        # N, so unequal repeats would bias the A/B.
+        r = decode_benchmark(preset, "int8", quant_mode="w8a16", batch=batch,
+                             decode_steps=decode_steps,
+                             built=int8_built, approx_top_k=True)
+        out["int8_w8a16_approx_topk_tok_s"] = r["value"]
+
+    _stage("sampler", _sampler)
 
     # ---- Stage 5: batch sweep on the best path.
     def _sweep():

@@ -55,6 +55,12 @@ class SamplingParams:
     repetition_penalty: float = 1.2
     do_sample: bool = True
     seed: int = 0
+    # Opt-in: use lax.approx_max_k (the TPU-native MIPS op, recall ~0.95 at
+    # k=50) instead of exact lax.top_k's sort-based lowering for the
+    # candidate-set fast path. The kept set can differ from HF's exact
+    # top-k in the recall tail, so OFF by default — a throughput dial for
+    # serving where exact HF parity is not required.
+    approx_top_k: bool = False
 
     def __post_init__(self):
         if not 0.0 <= self.min_p <= 1.0:

@@ -13,17 +13,22 @@ import time
 from pathlib import Path
 
 
-def archive_result(result: dict, prefix: str, directory: str | Path) -> Path | None:
-    """Write ``result`` (with an injected ``measured_at_utc`` stamp) to
-    ``directory/<prefix>_<UTC stamp>.json``. Dated names sort
-    chronologically, and the date is the second ``_`` field — the shape
-    bench.py's stale fallback parses. Archiving must never fail the
-    measurement itself: any OSError returns None."""
+def archive_result(
+    result: dict, prefix: str, directory: str | Path, path: Path | None = None
+) -> Path | None:
+    """Write a stamped COPY of ``result`` (the caller's dict — often already
+    printed to stdout — is never mutated) to
+    ``directory/<prefix>_<UTC stamp>.json``, or overwrite ``path`` when
+    given (continuous per-stage archiving rewrites one file per run).
+    Dated names sort chronologically, and the date is the second ``_``
+    field — the shape bench.py's stale fallback parses. Archiving must
+    never fail the measurement itself: any OSError returns None."""
     stamp = time.strftime("%Y-%m-%d_%H%M%S", time.gmtime())
-    result["measured_at_utc"] = stamp
-    path = Path(directory) / f"{prefix}_{stamp}.json"
+    payload = {**result, "measured_at_utc": stamp}
+    if path is None:
+        path = Path(directory) / f"{prefix}_{stamp}.json"
     try:
-        path.write_text(json.dumps(result, indent=2))
+        path.write_text(json.dumps(payload, indent=2))
     except OSError:
         return None
     return path

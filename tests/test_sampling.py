@@ -163,3 +163,23 @@ def test_top_p_then_min_p_matches_hf_order():
                                                    temperature=1.0,
                                                    repetition_penalty=1.0))[0]))
     assert counts <= {0, 1, 2} and len(counts) == 3, counts
+
+
+def test_approx_top_k_candidate_path():
+    """approx_top_k=True swaps exact lax.top_k for the TPU-native
+    approx_max_k in the candidate fast path. Contract pinned here: rows
+    stay descending-sorted (aggregate_to_topk re-ranks exactly, which
+    _top_p_on_sorted requires) and the default stays EXACT (HF parity)."""
+    from edgemesh.ops.sampling import filtered_candidates
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 1024), jnp.float32)
+    sp = SamplingParams(do_sample=True, top_k=50, top_p=0.9, temperature=0.8,
+                        approx_top_k=True)
+    idx, probs = filtered_candidates(logits, sp)
+    assert idx.shape == (4, 50) and probs.shape == (4, 50)
+    p = np.asarray(probs)
+    assert (p >= 0).all() and np.allclose(p.sum(-1), 1.0, atol=1e-5)
+    # kept probs are descending where nonzero
+    nz = p[0][p[0] > 0]
+    assert (np.diff(nz) <= 1e-7).all()
+    assert SamplingParams().approx_top_k is False
