@@ -857,6 +857,19 @@ def headline_benchmark(
         # checkable from the artifact alone).
         out["serving_segments"] = r["stats"]["segments"]
         out["serving_max_concurrent"] = r["stats"]["max_concurrent"]
+        if preset == "llama1b" and r["value"] < 900:
+            # Contingency arm, measured in the SAME health window: the
+            # r4 design ceiling is 1992 tok/s at 128.5 ms segments; if the
+            # default chunk lands under the >=900 gate, the suspected cost
+            # is per-segment admission/bookkeeping — chunk=48 amortizes it
+            # over 1.5x the tokens. Recording both makes the adjudication
+            # one artifact, not two windows.
+            emit_partial(out)
+            r48 = serving_benchmark(preset, built=int8_built,
+                                    kv_backend="paged", chunk=48)
+            out["serving_paged_chunk48_tok_s"] = r48["value"]
+            out["serving_chunk48_spread_pct"] = r48["spread_pct"]
+            out["serving_chunk48_latency_s_p50"] = r48["latency_s_p50"]
 
     if os.environ.get("EDGEMESH_BENCH_SERVE", "1") == "1":
         _stage("serving", _serving)
