@@ -129,6 +129,49 @@ def fabricate_int8_params(cfg) -> dict:
     return params
 
 
+# One prompt shape for every serving-wave workload: the fixed 3-digit index
+# keeps all prompts — warmup included — in ONE length bucket regardless of
+# request count (a 2-digit format put request 100+ in a new bucket, paying
+# a 20-40s admission compile mid-measurement).
+_WAVE_QUESTION = "benchmark question number {i:03d}, please answer at length?"
+
+
+def _e2e_latency(r: dict) -> float:
+    """End-to-end request latency from submit: queue wait + decode wall."""
+    return r["t_end"] - r["t_start"] + r["queue_s"]
+
+
+def _run_waves(eng, n_requests: int, waves: int, budgets=None, label: str = "serving"):
+    """The round-4 variance protocol, in ONE place for every serving-style
+    benchmark: warm ONE request in the SAME prompt-length bucket as the
+    timed requests (admission prefill compiles per bucket; a fresh compile
+    costs 20-40s over the tunnel and must not bleed into the first timed
+    admission), then run ``waves`` independent bursts and report per-wave
+    aggregate tok/s. ``budgets`` cycles per-request ``max_new`` caps (the
+    mixed admission workload); None submits at the uniform engine budget.
+    Returns (wave_tok_s, [(budget, result)], wall_all, warmup stats)."""
+    _progress(f"{label}: warmup compile")
+    eng.answer(_WAVE_QUESTION.format(i=999),
+               max_new=min(budgets) if budgets else None)
+    warm_stats = eng.stats()
+    wave_tok_s: list[float] = []
+    results: list[tuple] = []
+    t0_all = time.perf_counter()
+    for w in range(waves):
+        _progress(f"{label} wave {w + 1}/{waves}: {n_requests} requests")
+        t0 = time.perf_counter()
+        futs = []
+        for i in range(n_requests):
+            q = _WAVE_QUESTION.format(i=w * n_requests + i)
+            b = budgets[i % len(budgets)] if budgets else None
+            futs.append((b, eng.submit(q, max_new=b)))
+        wave = [(b, f.result()) for b, f in futs]
+        wall = time.perf_counter() - t0
+        wave_tok_s.append(sum(r["generated"] for _, r in wave) / wall)
+        results.extend(wave)
+    return wave_tok_s, results, time.perf_counter() - t0_all, warm_stats
+
+
 def serving_benchmark(
     preset: str | None = None,
     precision: str = "int8",
@@ -173,42 +216,16 @@ def serving_benchmark(
         prefix_cache=False,
     )
     eng = ContinuousEngine(agent, slots=slots, chunk=chunk, kv_backend=kv_backend)
-    # Fixed 3-digit index keeps every prompt — warmup included — in ONE
-    # length bucket regardless of the request count (a 2-digit format put
-    # request 100+ in a new bucket, paying a 20-40s admission compile
-    # mid-measurement).
-    question = "benchmark question number {i:03d}, please answer at length?"
     try:
-        # Warm with the SAME prompt shape the timed requests use: admission
-        # prefill programs compile per length bucket, and a fresh compile on
-        # this platform's tunnel costs 20-40s — a warmup in a different
-        # bucket would bleed that compile into the first timed admission
-        # (the compile-vs-steady-state split the eval harness also makes).
-        _progress(f"serving/{kv_backend} slots={slots}: warmup compile")
-        eng.answer(question.format(i=999))
-        warm_stats = eng.stats()
         import numpy as np
 
-        wave_tok_s: list[float] = []
-        results: list[dict] = []
-        t0_all = time.perf_counter()
-        for w in range(waves):
-            _progress(
-                f"serving/{kv_backend} wave {w + 1}/{waves}: "
-                f"{n_requests} requests x {max_new} new tokens"
-            )
-            t0 = time.perf_counter()
-            futs = [
-                eng.submit(question.format(i=w * n_requests + i))
-                for i in range(n_requests)
-            ]
-            wave = [f.result() for f in futs]
-            wall = time.perf_counter() - t0
-            wave_tok_s.append(sum(r["generated"] for r in wave) / wall)
-            results.extend(wave)
-        wall_all = time.perf_counter() - t0_all
+        wave_tok_s, tagged, wall_all, warm_stats = _run_waves(
+            eng, n_requests, waves,
+            label=f"serving/{kv_backend} slots={slots}",
+        )
+        results = [r for _, r in tagged]
         generated = sum(r["generated"] for r in results)
-        lats = [r["t_end"] - r["t_start"] + r["queue_s"] for r in results]
+        lats = [_e2e_latency(r) for r in results]
         tok_s = float(np.median(wave_tok_s))
         spread = (
             (max(wave_tok_s) - min(wave_tok_s)) / tok_s if tok_s else 0.0
@@ -271,7 +288,6 @@ def admission_policy_benchmark(
             cfg = cfg.replace(quant_mode=quant_mode)
     else:
         cfg, params = _build(preset, precision, quant_mode)
-    question = "benchmark question number {i:03d}, please answer at length?"
     out: dict[str, Any] = {
         "budgets": list(budgets), "n_requests": n_requests, "waves": waves,
     }
@@ -289,30 +305,14 @@ def admission_policy_benchmark(
         eng = ContinuousEngine(agent, slots=slots, chunk=chunk,
                                kv_backend=kv_backend, admission=policy)
         try:
-            _progress(f"admission/{policy}: warmup compile")
-            eng.answer(question.format(i=999), max_new=min(budgets))
-            wave_tok_s: list[float] = []
-            lat_all: list[float] = []
-            lat_short: list[float] = []
-            for w in range(waves):
-                _progress(f"admission/{policy} wave {w + 1}/{waves}")
-                t0 = time.perf_counter()
-                futs = [
-                    (budgets[i % len(budgets)],
-                     eng.submit(question.format(i=w * n_requests + i),
-                                max_new=budgets[i % len(budgets)]))
-                    for i in range(n_requests)
-                ]
-                wave = [(b, f.result()) for b, f in futs]
-                wall = time.perf_counter() - t0
-                wave_tok_s.append(
-                    sum(r["generated"] for _, r in wave) / wall
-                )
-                for b, r in wave:
-                    lat = r["t_end"] - r["t_start"] + r["queue_s"]
-                    lat_all.append(lat)
-                    if b == min(budgets):
-                        lat_short.append(lat)
+            wave_tok_s, tagged, _, _ = _run_waves(
+                eng, n_requests, waves, budgets=budgets,
+                label=f"admission/{policy}",
+            )
+            lat_all = [_e2e_latency(r) for _, r in tagged]
+            lat_short = [
+                _e2e_latency(r) for b, r in tagged if b == min(budgets)
+            ]
             out[f"{policy}_tok_s"] = round(float(np.median(wave_tok_s)), 2)
             out[f"{policy}_latency_s_p50"] = round(float(np.percentile(lat_all, 50)), 4)
             out[f"{policy}_latency_s_p95"] = round(float(np.percentile(lat_all, 95)), 4)
