@@ -7,8 +7,11 @@ Run from the repo root on a healthy tunnel:
     python artifacts/profile_1b_decode.py
 Writes the trace to artifacts/profile_1b/ and prints a timing table.
 """
+import os
+import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from edgemesh.utils.platform import ensure_device_ready
 
 ensure_device_ready()

@@ -3,7 +3,8 @@ weights, 4-slot paged engine, 3 waves x 16 requests (median-of-waves, the
 round-4 variance protocol). Round-3 baseline on the synchronous engine:
 130.2 tok/s aggregate (artifacts/serving8b_2026-07-31.json). Run from the
 repo root on a healthy tunnel: python artifacts/serve8b_drive.py"""
-import json, time
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from edgemesh.utils.platform import ensure_device_ready, tree_sync
 ensure_device_ready()
 import numpy as np
