@@ -1,0 +1,43 @@
+"""edgemesh.analysis — static analysis (edgelint) + abstract contract checks.
+
+Two passes over the codebase, designed to catch the silent-wrong-numbers and
+API-drift bug classes BEFORE anything executes on a device:
+
+- **edgelint** (``edgelint.py``): an AST linter with JAX/TPU-specific rules —
+  drifted/removed JAX APIs (the ``jax.shard_map`` vs
+  ``jax.experimental.shard_map`` split that broke 7 seed tests), host syncs
+  inside jitted code, wall-clock timing without a completion fence, dead
+  parameters in public jitted signatures (the ``len_cap`` failure mode),
+  Python-loop unrolls and prints inside traced code.
+- **contracts** (``contracts.py``): drives registered public entry points
+  (ops kernels, transformer forwards, decode step) through ``jax.eval_shape``
+  on tiny abstract configs, asserting shape/dtype stability (decode's output
+  cache avals must equal its input cache avals — the recompile hazard), no
+  float64/weak-type promotion, and that every kernel exposing ``check=True``
+  wires an ``ops/checks.py`` contract.
+
+CLI: ``python -m edgemesh.analysis [paths]`` or ``edgemesh lint [paths]``.
+Grandfathered findings live in ``baseline.json`` next to this module; the
+run exits non-zero on any non-baselined finding. See docs/ANALYSIS.md.
+"""
+
+from edgemesh.analysis.findings import (  # noqa: F401
+    Baseline,
+    Finding,
+    default_baseline_path,
+)
+from edgemesh.analysis.edgelint import RULES, lint_paths  # noqa: F401
+
+
+def run_analysis(paths, *, contracts: bool = True):
+    """Lint ``paths`` and (optionally) run the abstract contract pass.
+
+    Returns a list of Findings. Import of the contract pass is deferred so
+    pure-lint callers never pay the jax import.
+    """
+    findings = lint_paths(paths)
+    if contracts:
+        from edgemesh.analysis.contracts import run_contracts
+
+        findings.extend(run_contracts())
+    return findings

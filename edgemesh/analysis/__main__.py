@@ -1,0 +1,113 @@
+"""CLI for the static-analysis pass: ``python -m edgemesh.analysis [paths]``.
+
+Also reachable as ``edgemesh lint [paths]`` (edgemesh/cli.py). Exit status is
+the CI contract: 0 when every finding is baselined (or none exist), 1 when
+any non-baselined finding remains, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from edgemesh.analysis.edgelint import lint_paths
+from edgemesh.analysis.findings import Baseline, Finding, default_baseline_path
+
+
+def _default_target() -> list[str]:
+    # The package directory itself: works from any cwd.
+    return [str(Path(__file__).resolve().parent.parent)]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m edgemesh.analysis",
+        description="edgelint (AST rules) + abstract eval_shape contract pass",
+    )
+    p.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/directories to lint (default: the edgemesh package)",
+    )
+    p.add_argument(
+        "--format", choices=["pretty", "json"], default="pretty",
+        help="pretty = one line per finding; json = machine-readable report",
+    )
+    p.add_argument(
+        "--no-contracts", action="store_true",
+        help="skip the eval_shape contract pass (pure AST lint; no jax import)",
+    )
+    p.add_argument(
+        "--severity", choices=["error", "warning"], default="warning",
+        help="minimum severity to report (default: warning = everything)",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: {default_baseline_path().name} next to "
+        "the analysis package)",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="grandfather all current findings into the baseline and exit 0 "
+        "(review the diff before committing!)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding (audit mode)",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    paths = args.paths or _default_target()
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        # A typo'd path must NOT report "clean"/exit 0 — that is a lint gate
+        # that permanently checks zero files.
+        print(
+            f"error: no such path: {', '.join(missing)}", file=sys.stderr
+        )
+        return 2
+
+    findings: list[Finding] = lint_paths(paths)
+    if not args.no_contracts:
+        from edgemesh.analysis.contracts import run_contracts
+
+        findings.extend(run_contracts())
+    if args.severity == "error":
+        findings = [f for f in findings if f.severity == "error"]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    baseline_path = Path(args.baseline) if args.baseline else default_baseline_path()
+    if args.write_baseline:
+        Baseline.from_findings(findings).save(baseline_path)
+        print(f"wrote {len(findings)} grandfathered finding(s) to {baseline_path}")
+        return 0
+
+    baseline = Baseline() if args.no_baseline else Baseline.load(baseline_path)
+    fresh = baseline.filter(findings)
+    suppressed = len(findings) - len(fresh)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in fresh],
+            "baselined": suppressed,
+            "checked_paths": [str(p) for p in paths],
+        }, indent=2))
+    else:
+        for f in fresh:
+            print(f.render())
+        counts: dict[str, int] = {}
+        for f in fresh:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        tail = ", ".join(f"{n} {sev}(s)" for sev, n in sorted(counts.items())) or "clean"
+        extra = f" ({suppressed} baselined)" if suppressed else ""
+        print(f"edgemesh.analysis: {tail}{extra}")
+
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

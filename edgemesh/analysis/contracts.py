@@ -1,0 +1,566 @@
+"""Abstract contract pass: drive public entry points through ``jax.eval_shape``.
+
+edgelint (the AST pass) catches what it can see in source; this pass catches
+what only tracing reveals, WITHOUT executing anything on a device:
+
+- **EM201 contract-trace-failure** (error): a registered entry point no longer
+  traces on its documented abstract signature — the static analog of the
+  seed's seven ring-attention failures (an API drift or shape contract break
+  shows up here before any test runs a device program).
+- **EM202 cache-instability** (error): a decode-step entry returns a KV cache
+  whose avals (shape/dtype tree) differ from its input cache. A decode loop
+  carries the cache; any aval drift either fails ``lax.while_loop`` outright
+  or — worse — silently retraces and recompiles the multi-second decode
+  program every step.
+- **EM203 dtype-promotion** (error): an entry point's outputs contain float64
+  / weakly-typed leaves. 64-bit leaves mean an accidental x64 promotion
+  (2x memory + a recompile when the flag flips); weak types make output
+  avals depend on how callers combine them — the classic cache-key
+  instability hazard.
+- **EM204 unwired-check-contract** (error): a kernel exposing ``check=True``
+  whose body does not call its registered ``ops/checks.py`` contract (the
+  contract exists but the kernel silently skips it — checks rot).
+- **EM205 contract-not-firing** (error): a registered checkify contract that
+  does NOT raise on its known-bad input (or raises on its known-good one) —
+  proves every contract is actually exercised, not just imported.
+
+Everything here runs abstractly (``jax.eval_shape``) except EM205, which
+executes the tiny checkify predicates (a handful of reductions over <1 KB
+arrays) — the whole pass is sub-second on CPU.
+"""
+
+from __future__ import annotations
+
+import inspect
+from functools import partial
+
+from edgemesh.analysis.findings import Finding
+
+CONTRACT_RULES: dict[str, dict] = {
+    "EM201": {
+        "name": "contract-trace-failure",
+        "severity": "error",
+        "summary": "public entry point fails to trace on its abstract signature",
+    },
+    "EM202": {
+        "name": "cache-instability",
+        "severity": "error",
+        "summary": "decode entry returns cache avals != input cache avals (recompile hazard)",
+    },
+    "EM203": {
+        "name": "dtype-promotion",
+        "severity": "error",
+        "summary": "float64 / weak-type leaves in entry-point outputs",
+    },
+    "EM204": {
+        "name": "unwired-check-contract",
+        "severity": "error",
+        "summary": "kernel exposes check=True but never calls its ops/checks.py contract",
+    },
+    "EM205": {
+        "name": "contract-not-firing",
+        "severity": "error",
+        "summary": "registered checkify contract does not fire on known-bad inputs",
+    },
+}
+
+
+def _avals(tree):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: (tuple(a.shape), str(a.dtype)), tree
+    )
+
+
+def _promotion_problems(tree) -> list[str]:
+    import jax
+
+    problems: list[str] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        dt = str(leaf.dtype)
+        if dt in ("float64", "int64", "complex128"):
+            problems.append(f"leaf {jax.tree_util.keystr(path)} is {dt}")
+        if getattr(leaf, "weak_type", False):
+            problems.append(f"leaf {jax.tree_util.keystr(path)} is weakly typed")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Entry-point registry
+# ---------------------------------------------------------------------------
+#
+# Each entry is (name, source-path, runner). The runner builds tiny abstract
+# arguments, eval_shapes the entry point, and returns a list of
+# (rule, message) problems; raising is reported as EM201.
+
+
+def _tiny():
+    from edgemesh.models.families import tiny_config
+
+    return tiny_config("llama")
+
+
+def _abstract_model(cfg, batch=2, max_seq=32):
+    import jax
+
+    from edgemesh.models.transformer import init_kv_cache, init_params
+
+    params = jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    cache = jax.eval_shape(lambda: init_kv_cache(cfg, batch, max_seq))
+    return params, cache
+
+
+def _check_prefill():
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.models.transformer import forward_prefill
+
+    cfg = _tiny()
+    params, cache = _abstract_model(cfg)
+    tokens = jax.ShapeDtypeStruct((2, 8), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((2,), jnp.int32)
+    logits, out_cache = jax.eval_shape(
+        partial(forward_prefill, cfg), params, tokens, lengths, cache
+    )
+    problems = [("EM203", p) for p in _promotion_problems((logits, out_cache))]
+    if logits.shape != (2, cfg.vocab_size):
+        problems.append(
+            ("EM201", f"prefill logits shape {logits.shape} != (batch, vocab)")
+        )
+    if _avals(out_cache) != _avals(cache):
+        problems.append(
+            ("EM202", "prefill returned cache avals differ from the input cache")
+        )
+    return problems
+
+
+def _check_decode():
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.models.transformer import forward_decode
+
+    cfg = _tiny()
+    params, cache = _abstract_model(cfg)
+    tokens = jax.ShapeDtypeStruct((2,), jnp.int32)
+    logits, out_cache = jax.eval_shape(
+        partial(forward_decode, cfg), params, tokens, cache
+    )
+    problems = [("EM203", p) for p in _promotion_problems((logits, out_cache))]
+    if _avals(out_cache) != _avals(cache):
+        problems.append(
+            ("EM202",
+             "decode returned cache avals differ from the input cache — a "
+             "decode while_loop would retrace/recompile per step")
+        )
+    return problems
+
+
+def _check_verify():
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.models.transformer import forward_verify
+
+    cfg = _tiny()
+    params, cache = _abstract_model(cfg)
+    tokens = jax.ShapeDtypeStruct((2, 4), jnp.int32)
+    logits, out_cache = jax.eval_shape(
+        partial(forward_verify, cfg), params, tokens, cache
+    )
+    problems = [("EM203", p) for p in _promotion_problems((logits, out_cache))]
+    if _avals(out_cache) != _avals(cache):
+        problems.append(("EM202", "verify returned cache avals differ from input"))
+    return problems
+
+
+def _check_decode_loop():
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.config import SamplingParams
+    from edgemesh.runtime.generate import _decode_loop
+
+    cfg = _tiny()
+    params, cache = _abstract_model(cfg)
+    first_logits = jax.ShapeDtypeStruct((2, cfg.vocab_size), jnp.float32)
+    token_mask = jax.ShapeDtypeStruct((2, cfg.vocab_size), jnp.bool_)
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    out = jax.eval_shape(
+        partial(_decode_loop, cfg, sampling=SamplingParams(), max_new=4, eos_id=1),
+        params, first_logits=first_logits, cache=cache,
+        token_mask=token_mask, rng=rng,
+    )
+    tokens, num_generated, out_cache = out[0], out[1], out[2]
+    problems = [("EM203", p) for p in _promotion_problems(out)]
+    if tokens.shape != (2, 4) or str(tokens.dtype) != "int32":
+        problems.append(
+            ("EM201", f"decode loop token buffer {tokens.shape}/{tokens.dtype} "
+             "!= ([b, max_new], int32)")
+        )
+    if str(num_generated.dtype) != "int32":
+        problems.append(("EM201", "num_generated must stay int32"))
+    if _avals(out_cache) != _avals(cache):
+        problems.append(
+            ("EM202", "decode loop returned cache avals differ from input — "
+             "generate_stream resubmits this cache next segment")
+        )
+    return problems
+
+
+def _check_sample_token():
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.config import SamplingParams
+    from edgemesh.ops.sampling import sample_token
+
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    logits = jax.ShapeDtypeStruct((2, 64), jnp.float32)
+    mask = jax.ShapeDtypeStruct((2, 64), jnp.bool_)
+    tok = jax.eval_shape(
+        partial(sample_token, params=SamplingParams()), rng, logits, token_mask=mask
+    )
+    problems = [("EM203", p) for p in _promotion_problems(tok)]
+    if tok.shape != (2,):
+        problems.append(("EM201", f"sample_token shape {tok.shape} != (batch,)"))
+    return problems
+
+
+def _check_attend():
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.ops.attention import LayerKV, attend
+
+    q = jax.ShapeDtypeStruct((1, 4, 4, 8), jnp.float32)
+    k = jax.ShapeDtypeStruct((1, 8, 2, 8), jnp.float32)
+    q_pos = jax.ShapeDtypeStruct((1, 4), jnp.int32)
+    kv_valid = jax.ShapeDtypeStruct((1, 8), jnp.bool_)
+    out = jax.eval_shape(attend, q, LayerKV(k, k), q_pos, kv_valid)
+    problems = [("EM203", p) for p in _promotion_problems(out)]
+    if out.shape != (1, 4, 4, 8):
+        problems.append(("EM201", f"attend output shape {out.shape} != q shape"))
+    return problems
+
+
+def _check_flash_attention():
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.ops.flash_attention import HAVE_PALLAS, flash_attention
+
+    if not HAVE_PALLAS:
+        return []
+    q = jax.ShapeDtypeStruct((1, 4, 2, 8), jnp.float32)
+    k = jax.ShapeDtypeStruct((1, 8, 1, 8), jnp.float32)
+    kv_lens = jax.ShapeDtypeStruct((1,), jnp.int32)
+    out = jax.eval_shape(partial(flash_attention, causal=True), q, k, k, kv_lens)
+    problems = [("EM203", p) for p in _promotion_problems(out)]
+    if out.shape != (1, 4, 2, 8) or str(out.dtype) != "float32":
+        problems.append(
+            ("EM201", "flash_attention output must match q's shape/dtype "
+             f"(got {out.shape}/{out.dtype})")
+        )
+    return problems
+
+
+def _check_paged_attention():
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.ops.paged_attention import paged_decode_attention
+
+    try:
+        from edgemesh.ops.paged_attention import HAVE_PALLAS
+    except ImportError:  # pragma: no cover
+        HAVE_PALLAS = True
+    if not HAVE_PALLAS:
+        return []
+    q = jax.ShapeDtypeStruct((2, 2, 8), jnp.float32)
+    pages = jax.ShapeDtypeStruct((4, 1, 8, 8), jnp.float32)
+    table = jax.ShapeDtypeStruct((2, 2), jnp.int32)
+    kv_lens = jax.ShapeDtypeStruct((2,), jnp.int32)
+    out = jax.eval_shape(paged_decode_attention, q, pages, pages, table, kv_lens)
+    problems = [("EM203", p) for p in _promotion_problems(out)]
+    if out.shape != (2, 2, 8):
+        problems.append(
+            ("EM201", f"paged_decode_attention output {out.shape} != q shape")
+        )
+    return problems
+
+
+def _check_int8_matmul():
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.ops.int8 import int8_matmul_fused
+
+    x = jax.ShapeDtypeStruct((2, 8), jnp.float32)
+    w_q = jax.ShapeDtypeStruct((8, 4), jnp.int8)
+    scales = jax.ShapeDtypeStruct((4,), jnp.float32)
+    out = jax.eval_shape(int8_matmul_fused, x, w_q, scales)
+    problems = [("EM203", p) for p in _promotion_problems(out)]
+    if out.shape != (2, 4):
+        problems.append(("EM201", f"int8_matmul_fused output {out.shape} != [M, N]"))
+    return problems
+
+
+def _check_ring_attention():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from edgemesh.parallel.ring_attention import ring_attention
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    q = jax.ShapeDtypeStruct((1, 8, 2, 8), jnp.float32)
+    k = jax.ShapeDtypeStruct((1, 8, 1, 8), jnp.float32)
+    pos = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    valid = jax.ShapeDtypeStruct((1, 8), jnp.bool_)
+    out = jax.eval_shape(partial(ring_attention, mesh=mesh), q, k, k, pos, valid)
+    problems = [("EM203", p) for p in _promotion_problems(out)]
+    if out.shape != (1, 8, 2, 8):
+        problems.append(("EM201", f"ring_attention output {out.shape} != q shape"))
+    return problems
+
+
+def _check_ulysses_attention():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from edgemesh.parallel.ulysses import ulysses_attention
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    q = jax.ShapeDtypeStruct((1, 8, 2, 8), jnp.float32)
+    k = jax.ShapeDtypeStruct((1, 8, 2, 8), jnp.float32)
+    pos = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    valid = jax.ShapeDtypeStruct((1, 8), jnp.bool_)
+    out = jax.eval_shape(partial(ulysses_attention, mesh=mesh), q, k, k, pos, valid)
+    problems = [("EM203", p) for p in _promotion_problems(out)]
+    if out.shape != (1, 8, 2, 8):
+        problems.append(("EM201", f"ulysses_attention output {out.shape} != q shape"))
+    return problems
+
+
+ENTRY_POINTS: list[tuple[str, str, callable]] = [
+    ("transformer.forward_prefill", "edgemesh/models/transformer.py", _check_prefill),
+    ("transformer.forward_decode", "edgemesh/models/transformer.py", _check_decode),
+    ("transformer.forward_verify", "edgemesh/models/transformer.py", _check_verify),
+    ("generate._decode_loop", "edgemesh/runtime/generate.py", _check_decode_loop),
+    ("sampling.sample_token", "edgemesh/ops/sampling.py", _check_sample_token),
+    ("attention.attend", "edgemesh/ops/attention.py", _check_attend),
+    ("flash_attention", "edgemesh/ops/flash_attention.py", _check_flash_attention),
+    ("paged_decode_attention", "edgemesh/ops/paged_attention.py", _check_paged_attention),
+    ("int8_matmul_fused", "edgemesh/ops/int8.py", _check_int8_matmul),
+    ("ring_attention", "edgemesh/parallel/ring_attention.py", _check_ring_attention),
+    ("ulysses_attention", "edgemesh/parallel/ulysses.py", _check_ulysses_attention),
+]
+
+
+# ---------------------------------------------------------------------------
+# check=True kernel ↔ ops/checks.py contract registry (EM204/EM205)
+# ---------------------------------------------------------------------------
+#
+# Every kernel exposing a ``check`` kwarg must appear here with the
+# ops/checks.py predicate it wires in, plus a known-good and a known-bad
+# argument builder so the pass can PROVE the contract fires. Adding a new
+# ``check=True`` kernel without registering it here is itself a finding.
+
+
+def _flash_args(good: bool):
+    import jax.numpy as jnp
+
+    q = jnp.ones((1, 4, 2, 8), jnp.float32)
+    k = jnp.ones((1, 8, 1, 8), jnp.float32)
+    kv_lens = jnp.array([4 if good else 99], jnp.int32)  # bad: beyond kv extent
+    return (q, k, kv_lens, jnp.array([0], jnp.int32))
+
+
+def _paged_args(good: bool):
+    import jax.numpy as jnp
+
+    q = jnp.ones((2, 1, 8), jnp.float32)
+    pages = jnp.ones((4, 1, 8, 8), jnp.float32)
+    table = jnp.array([[0, 1], [2, 3 if good else 99]], jnp.int32)  # bad: OOB page
+    kv_lens = jnp.array([3, 3], jnp.int32)
+    return (q, pages, table, kv_lens)
+
+
+def _int8_args(good: bool):
+    import jax.numpy as jnp
+
+    x = jnp.ones((2, 8), jnp.float32)
+    w_q = jnp.zeros((8, 4), jnp.int8)
+    scales = (
+        jnp.ones((4,), jnp.float32)
+        if good
+        else jnp.array([1.0, 0.0, 1.0, 1.0], jnp.float32)  # bad: zero scale
+    )
+    return (x, w_q, scales)
+
+
+CHECK_CONTRACTS: list[dict] = [
+    {
+        "kernel": ("edgemesh.ops.flash_attention", "flash_attention"),
+        "checker": "check_flash_inputs",
+        "args": _flash_args,
+    },
+    {
+        "kernel": ("edgemesh.ops.paged_attention", "paged_decode_attention"),
+        "checker": "check_paged_inputs",
+        "args": _paged_args,
+    },
+    {
+        "kernel": ("edgemesh.ops.int8", "int8_matmul_fused"),
+        "checker": "check_int8_inputs",
+        "args": _int8_args,
+    },
+]
+
+
+def _unwrap(fn):
+    while hasattr(fn, "__wrapped__"):
+        fn = fn.__wrapped__
+    return fn
+
+
+def _iter_check_kwarg_kernels():
+    """Every public callable under edgemesh.ops exposing a ``check`` kwarg —
+    the set EM204 requires to be covered by CHECK_CONTRACTS."""
+    import importlib
+    import pkgutil
+
+    import edgemesh.ops as ops_pkg
+
+    seen = set()
+    for info in pkgutil.iter_modules(ops_pkg.__path__):
+        if info.name == "checks":
+            continue
+        mod = importlib.import_module(f"edgemesh.ops.{info.name}")
+        for name, obj in vars(mod).items():
+            if name.startswith("_") or not callable(obj):
+                continue
+            raw = _unwrap(obj)
+            if getattr(raw, "__module__", "") != mod.__name__:
+                continue
+            try:
+                sig = inspect.signature(raw)
+            except (TypeError, ValueError):
+                continue
+            if "check" in sig.parameters and (mod.__name__, name) not in seen:
+                seen.add((mod.__name__, name))
+                yield mod.__name__, name, raw
+    return
+
+
+def _run_check_contracts() -> list[Finding]:
+    import importlib
+
+    findings: list[Finding] = []
+    registered = {c["kernel"] for c in CHECK_CONTRACTS}
+
+    for mod_name, fn_name, raw in _iter_check_kwarg_kernels():
+        rel = mod_name.replace(".", "/") + ".py"
+        if (mod_name, fn_name) not in registered:
+            findings.append(Finding(
+                "EM204", "error", rel, 1,
+                f"{fn_name} exposes check=True but has no entry in "
+                "analysis/contracts.CHECK_CONTRACTS — register its "
+                "ops/checks.py predicate plus good/bad exercise inputs",
+                context=fn_name,
+            ))
+
+    from edgemesh.ops import checks as checks_mod
+
+    for contract in CHECK_CONTRACTS:
+        mod_name, fn_name = contract["kernel"]
+        checker_name = contract["checker"]
+        rel = mod_name.replace(".", "/") + ".py"
+        try:
+            mod = importlib.import_module(mod_name)
+            raw = _unwrap(getattr(mod, fn_name))
+        except (ImportError, AttributeError) as e:
+            findings.append(Finding(
+                "EM204", "error", rel, 1,
+                f"registered kernel {mod_name}.{fn_name} does not import: {e}",
+                context=fn_name,
+            ))
+            continue
+        checker = getattr(checks_mod, checker_name, None)
+        if checker is None:
+            findings.append(Finding(
+                "EM204", "error", "edgemesh/ops/checks.py", 1,
+                f"contract {checker_name} for {fn_name} is not defined in "
+                "ops/checks.py", context=fn_name,
+            ))
+            continue
+        # Wired: the kernel body must actually call the checker when
+        # check=True (a contract that exists but is never invoked rots).
+        try:
+            src = inspect.getsource(raw)
+        except OSError:
+            src = ""
+        if checker_name not in src:
+            findings.append(Finding(
+                "EM204", "error", rel,
+                getattr(raw, "__code__", None).co_firstlineno if hasattr(raw, "__code__") else 1,
+                f"{fn_name} never calls its registered contract {checker_name} "
+                "— check=True would silently validate nothing",
+                context=fn_name,
+            ))
+            continue
+        # Exercised: good inputs pass, bad inputs raise.
+        line = raw.__code__.co_firstlineno if hasattr(raw, "__code__") else 1
+        try:
+            checks_mod.checked(checker)(*contract["args"](good=True))
+        except Exception as e:  # noqa: BLE001 — any raise on GOOD inputs is the finding
+            findings.append(Finding(
+                "EM205", "error", "edgemesh/ops/checks.py", line,
+                f"{checker_name} raised on its known-GOOD inputs: {e}",
+                context=checker_name,
+            ))
+            continue
+        fired = False
+        try:
+            checks_mod.checked(checker)(*contract["args"](good=False))
+        except Exception:  # noqa: BLE001 — firing is the success condition
+            fired = True
+        if not fired:
+            findings.append(Finding(
+                "EM205", "error", "edgemesh/ops/checks.py", line,
+                f"{checker_name} did NOT raise on its known-bad inputs — the "
+                f"contract protecting {fn_name} is dead",
+                context=checker_name,
+            ))
+    return findings
+
+
+def run_contracts() -> list[Finding]:
+    """Run the full abstract contract pass; returns findings (empty = green)."""
+    findings: list[Finding] = []
+    for name, rel, runner in ENTRY_POINTS:
+        try:
+            problems = runner()
+        except Exception as e:  # noqa: BLE001 — a trace failure IS the finding
+            findings.append(Finding(
+                "EM201", "error", rel, 1,
+                f"{name} failed to trace under eval_shape on its documented "
+                f"abstract signature: {type(e).__name__}: {e}",
+                context=name,
+            ))
+            continue
+        for rule, message in problems:
+            findings.append(Finding(
+                rule, CONTRACT_RULES[rule]["severity"], rel, 1, message,
+                context=name,
+            ))
+    findings.extend(_run_check_contracts())
+    return findings

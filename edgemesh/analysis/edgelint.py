@@ -1,0 +1,631 @@
+"""edgelint — AST linter with JAX/TPU-specific rules.
+
+Rules (see docs/ANALYSIS.md for the full rationale and examples):
+
+- EM101 jax-api-drift (error): direct use of a JAX API that moved or was
+  removed across the versions this codebase meets (``jax.experimental.
+  shard_map``/``maps``/``pjit``/``host_callback``, ``jax.shard_map``,
+  ``lax.pcast``, ``lax.axis_size``). Call sites must go through
+  ``edgemesh.utils.compat`` — the one allowlisted module.
+- EM102 host-sync-in-jit (error): ``.item()``, ``.tolist()``, ``float()``,
+  ``np.asarray``/``np.array`` inside traced code — each forces a device→host
+  readback per call (or fails at trace time), turning an async dispatch
+  pipeline into a round-trip per step.
+- EM103 unsynced-timing (warning): two or more wall-clock reads in a
+  function that dispatches device work between them with no completion
+  fence (``block_until_ready``/``device_sync``/``tree_sync``/readback) —
+  the async-dispatch measurement bug: the timed window closes before the
+  device finishes.
+- EM104 dead-jit-param (warning): a parameter of a jit-decorated function
+  never referenced in its body (the ``len_cap`` failure mode: callers pay
+  transfer + retrace keying on an argument that cannot change the result).
+- EM105 jit-loop-unroll (warning): a Python ``for``/``while`` inside traced
+  code whose body does jnp/lax work — unrolls into the XLA graph; compile
+  time and program size scale with the trip count (use ``lax.scan``/
+  ``fori_loop``, or suppress for small fixed trip counts).
+- EM106 print-in-jit (warning): ``print`` (incl. f-string payloads) inside
+  traced code — runs at TRACE time only (or leaks ``Traced<...>`` reprs);
+  use ``jax.debug.print`` for runtime values.
+
+Suppression: append ``# edgelint: disable=EM105`` (comma-separate for
+several rules) to the flagged line, or put the comment on the ``def`` line
+to suppress within that whole function.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from edgemesh.analysis.findings import Finding, repo_relative
+
+RULES: dict[str, dict] = {
+    "EM101": {
+        "name": "jax-api-drift",
+        "severity": "error",
+        "summary": "drifted/removed JAX API used directly (go through edgemesh.utils.compat)",
+    },
+    "EM102": {
+        "name": "host-sync-in-jit",
+        "severity": "error",
+        "summary": "host readback (.item()/float()/np.asarray) inside traced code",
+    },
+    "EM103": {
+        "name": "unsynced-timing",
+        "severity": "warning",
+        "summary": "wall-clock window around device work without a completion fence",
+    },
+    "EM104": {
+        "name": "dead-jit-param",
+        "severity": "warning",
+        "summary": "parameter of a jitted function never used in its body",
+    },
+    "EM105": {
+        "name": "jit-loop-unroll",
+        "severity": "warning",
+        "summary": "Python loop over jnp/lax work inside traced code (unrolls the graph)",
+    },
+    "EM106": {
+        "name": "print-in-jit",
+        "severity": "warning",
+        "summary": "print inside traced code runs at trace time (use jax.debug.print)",
+    },
+}
+
+# ---------------------------------------------------------------------------
+# EM101 tables
+# ---------------------------------------------------------------------------
+
+# Modules whose import (any form) is drift: removed upstream, or absent on
+# older jax. Values are the guidance appended to the message.
+_DRIFTED_MODULES = {
+    "jax.experimental.shard_map": "use edgemesh.utils.compat.shard_map",
+    "jax.experimental.maps": "xmap/Mesh moved; use jax.sharding.Mesh",
+    "jax.experimental.pjit": "use jax.jit with shardings",
+    "jax.experimental.host_callback": "use jax.debug.callback / jax.pure_callback",
+}
+
+# Dotted attribute accesses that only exist on one side of the drift.
+_DRIFTED_ATTRS = {
+    "jax.shard_map": "use edgemesh.utils.compat.shard_map",
+    "jax.lax.pcast": "use edgemesh.utils.compat.pcast",
+    "jax.lax.axis_size": "use edgemesh.utils.compat.axis_size",
+}
+
+# Files allowed to touch either spelling (the shim itself).
+_EM101_ALLOWED_SUFFIXES = ("edgemesh/utils/compat.py",)
+
+# EM102: attribute calls that force a device→host readback.
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_HOST_SYNC_NP_FUNCS = {"asarray", "array"}
+
+# EM103: wall-clock sources and completion fences. Fences come in two
+# spellings: method-style (``x.block_until_ready()``) and function-style
+# (``device_sync(x)``, edgemesh.utils.platform's readback fence).
+_CLOCK_FUNCS = {"time.time", "time.perf_counter", "time.monotonic"}
+_FENCE_METHODS = {"block_until_ready", "device_sync", "tree_sync", "result"}
+_FENCE_FUNCS = {"block_until_ready", "device_sync", "tree_sync"}
+
+_DISABLE_RE = re.compile(r"#\s*edgelint:\s*disable=([A-Z0-9, ]+)")
+
+
+# ---------------------------------------------------------------------------
+# Import/alias resolution
+# ---------------------------------------------------------------------------
+
+
+class _Aliases:
+    """Maps local names to the dotted module/object path they were imported
+    as, so ``from jax import lax; lax.pcast`` resolves to ``jax.lax.pcast``."""
+
+    def __init__(self) -> None:
+        self.map: dict[str, str] = {}
+
+    def visit_import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.map[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+
+    def visit_import_from(self, node: ast.ImportFrom) -> None:
+        if node.level or not node.module:
+            return  # relative imports never reach jax
+        for a in node.names:
+            self.map[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, dotted: str) -> str:
+        head, _, rest = dotted.partition(".")
+        base = self.map.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+
+def _walk_own(fn: ast.AST):
+    """Walk fn's body without descending into nested function defs (those
+    get their own per-def rule runs)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _dotted_name(node: ast.AST) -> str | None:
+    """'jax.experimental.shard_map' for nested Attribute/Name chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Traced-function discovery
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = {"jax.jit", "jax.pmap", "jax.experimental.jax2tf.convert"}
+_TRACING_HOFS = {
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop", "jax.lax.cond",
+    "jax.lax.switch", "jax.lax.map", "jax.lax.associative_scan",
+    "jax.checkpoint", "jax.remat", "jax.vmap", "jax.grad",
+    "jax.value_and_grad", "jax.eval_shape",
+}
+
+
+def _is_jit_expr(node: ast.AST, aliases: _Aliases) -> bool:
+    """True for expressions that evaluate to a jit transform: ``jax.jit``,
+    ``partial(jax.jit, ...)``, ``jax.jit(...)`` (decorator-factory form)."""
+    dotted = _dotted_name(node)
+    if dotted and aliases.resolve(dotted) in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fd = _dotted_name(node.func)
+        if fd:
+            rf = aliases.resolve(fd)
+            if rf in _JIT_NAMES:
+                return True
+            if rf in ("functools.partial", "partial") and node.args:
+                return _is_jit_expr(node.args[0], aliases)
+    return False
+
+
+class _TracedCollector(ast.NodeVisitor):
+    """Finds function defs whose bodies run under tracing: jit-decorated
+    defs, defs nested inside them, defs handed to lax control-flow HOFs, and
+    ``g = jax.jit(f)`` rebinds."""
+
+    def __init__(self, aliases: _Aliases) -> None:
+        self.aliases = aliases
+        self.jit_decorated: set[ast.AST] = set()
+        self.traced: set[ast.AST] = set()
+        self._defs_by_name: dict[str, list[ast.AST]] = {}
+        self._hof_callees: set[str] = set()
+        self._jit_wrapped: set[str] = set()
+        self._stack: list[ast.AST] = []
+
+    def _visit_def(self, node) -> None:
+        self._defs_by_name.setdefault(node.name, []).append(node)
+        if any(_is_jit_expr(d, self.aliases) for d in node.decorator_list):
+            self.jit_decorated.add(node)
+            self.traced.add(node)
+        elif any(d in self.traced for d in self._stack):
+            self.traced.add(node)
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fd = _dotted_name(node.func)
+        if fd and self.aliases.resolve(fd) in _TRACING_HOFS:
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    self._hof_callees.add(arg.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # g = jax.jit(f)  /  g = partial(jax.jit, ...)(f)
+        if isinstance(node.value, ast.Call) and _is_jit_expr(node.value.func, self.aliases):
+            for arg in node.value.args:
+                if isinstance(arg, ast.Name):
+                    self._jit_wrapped.add(arg.id)
+        self.generic_visit(node)
+
+    def finalize(self) -> None:
+        """Propagate tracedness to HOF callees / jit-wrapped names, then to
+        defs nested inside anything newly traced (fixpoint)."""
+        for name in self._hof_callees | self._jit_wrapped:
+            for d in self._defs_by_name.get(name, []):
+                self.traced.add(d)
+                if name in self._jit_wrapped:
+                    self.jit_decorated.add(d)
+        changed = True
+        while changed:
+            changed = False
+            for defs in self._defs_by_name.values():
+                for d in defs:
+                    if d in self.traced:
+                        for sub in ast.walk(d):
+                            if (
+                                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                                and sub not in self.traced
+                            ):
+                                self.traced.add(sub)
+                                changed = True
+
+
+# ---------------------------------------------------------------------------
+# The linter
+# ---------------------------------------------------------------------------
+
+
+class _FileLinter:
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.relpath = repo_relative(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.aliases = _Aliases()
+        # line -> set of disabled rules; a disable on a `def` line covers
+        # the whole function (handled in _suppressed).
+        self.disabled: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                self.disabled[i] = {r.strip() for r in m.group(1).split(",")}
+        self._scopes: list[ast.AST] = []
+
+    # -- infrastructure ----------------------------------------------------
+
+    def _suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.disabled.get(line, ()):
+            return True
+        for scope in self._scope_stack_for_line(line):
+            if rule in self.disabled.get(scope.lineno, ()):
+                return True
+        return False
+
+    def _scope_stack_for_line(self, line: int) -> list[ast.AST]:
+        return [
+            s for s in getattr(self, "_all_defs", [])
+            if s.lineno <= line <= getattr(s, "end_lineno", s.lineno)
+        ]
+
+    def _context_for_line(self, line: int) -> str:
+        best = ""
+        for s in getattr(self, "_all_defs", []):
+            if s.lineno <= line <= getattr(s, "end_lineno", s.lineno):
+                best = s.name if not best else f"{best}.{s.name}"
+        return best
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if self._suppressed(rule, line):
+            return
+        self.findings.append(
+            Finding(
+                rule=rule,
+                severity=RULES[rule]["severity"],
+                path=self.relpath,
+                line=line,
+                message=message,
+                context=self._context_for_line(line),
+                line_text=(self.lines[line - 1].strip() if line <= len(self.lines) else ""),
+            )
+        )
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source)
+        except SyntaxError as e:
+            self.findings.append(
+                Finding("EM000", "error", self.relpath, e.lineno or 1,
+                        f"syntax error: {e.msg}")
+            )
+            return self.findings
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                self.aliases.visit_import(node)
+            elif isinstance(node, ast.ImportFrom):
+                self.aliases.visit_import_from(node)
+        self._all_defs = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        collector = _TracedCollector(self.aliases)
+        collector.visit(tree)
+        collector.finalize()
+        self.traced = collector.traced
+        self.jit_decorated = collector.jit_decorated
+
+        self._rule_api_drift(tree)
+        # Traced ROOTS only: their walkers descend into traced nested defs,
+        # so running every traced def would double-report nested call sites.
+        traced_roots = [
+            fn for fn in self._all_defs
+            if fn in self.traced
+            and not any(
+                fn is not p and fn in set(ast.walk(p))
+                for p in self.traced
+            )
+        ]
+        for fn in traced_roots:
+            self._rule_host_sync(fn)
+            self._rule_loop_unroll(fn)
+            self._rule_print(fn)
+        for fn in self._all_defs:
+            if fn in self.jit_decorated:
+                self._rule_dead_param(fn)
+            self._rule_unsynced_timing(fn)
+        # One finding per (rule, line, message): nested Attribute chains and
+        # nested defs can hit the same site through more than one walk.
+        # Message stays in the key so two DISTINCT findings anchored to the
+        # same line (e.g. two dead params on one def) both survive.
+        seen: set[tuple] = set()
+        unique: list[Finding] = []
+        for f in sorted(self.findings, key=lambda f: (f.line, f.rule)):
+            key = (f.rule, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        self.findings = unique
+        return self.findings
+
+    # -- EM101 -------------------------------------------------------------
+
+    def _rule_api_drift(self, tree: ast.Module) -> None:
+        if any(self.relpath.endswith(sfx) for sfx in _EM101_ALLOWED_SUFFIXES):
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    hit = self._drifted_module(a.name)
+                    if hit:
+                        self._emit("EM101", node, f"import of drifted API {a.name!r} — {hit}")
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for a in node.names:
+                    full = f"{node.module}.{a.name}"
+                    hit = self._drifted_module(full) or self._drifted_module(node.module)
+                    if hit is None and full in _DRIFTED_ATTRS:
+                        hit = _DRIFTED_ATTRS[full]
+                    if hit:
+                        self._emit(
+                            "EM101", node,
+                            f"import of drifted API {full!r} — {hit}",
+                        )
+            elif isinstance(node, ast.Attribute):
+                dotted = _dotted_name(node)
+                if not dotted:
+                    continue
+                resolved = self.aliases.resolve(dotted)
+                if resolved in _DRIFTED_ATTRS:
+                    self._emit(
+                        "EM101", node,
+                        f"{resolved!r} does not exist across supported jax "
+                        f"versions — {_DRIFTED_ATTRS[resolved]}",
+                    )
+                else:
+                    hit = self._drifted_module(resolved)
+                    if hit:
+                        self._emit("EM101", node, f"use of drifted API {resolved!r} — {hit}")
+
+    @staticmethod
+    def _drifted_module(name: str) -> str | None:
+        for mod, why in _DRIFTED_MODULES.items():
+            if name == mod or name.startswith(mod + "."):
+                return why
+        return None
+
+    # -- EM102 -------------------------------------------------------------
+
+    def _rule_host_sync(self, fn: ast.AST) -> None:
+        for node in self._walk_own_and_nested_traced(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _HOST_SYNC_METHODS and not node.args:
+                self._emit(
+                    "EM102", node,
+                    f".{f.attr}() inside traced code forces a device→host "
+                    "readback per call (hoist it out of the jitted path)",
+                )
+                continue
+            dotted = _dotted_name(f)
+            if dotted:
+                resolved = self.aliases.resolve(dotted)
+                if resolved in {f"numpy.{n}" for n in _HOST_SYNC_NP_FUNCS}:
+                    self._emit(
+                        "EM102", node,
+                        f"{dotted}(...) inside traced code materializes on "
+                        "host (use jnp, or move outside jit)",
+                    )
+                    continue
+            if isinstance(f, ast.Name) and f.id == "float" and node.args:
+                arg = node.args[0]
+                if not isinstance(arg, ast.Constant):
+                    self._emit(
+                        "EM102", node,
+                        "float(...) on a traced value is a concretization "
+                        "error under jit (use .astype / keep it on device)",
+                    )
+
+    # -- EM103 -------------------------------------------------------------
+
+    def _rule_unsynced_timing(self, fn: ast.AST) -> None:
+        clock_lines: list[int] = []
+        has_fence = False
+        device_lines: list[int] = []
+        # Own statements only: every def gets its own EM103 run, so a window
+        # inside a nested helper is attributed to THAT def once, not also to
+        # every enclosing def.
+        for node in _walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_name(node.func)
+            resolved = self.aliases.resolve(dotted) if dotted else None
+            if resolved in _CLOCK_FUNCS:
+                clock_lines.append(node.lineno)
+            elif isinstance(node.func, ast.Attribute) and node.func.attr in _FENCE_METHODS:
+                has_fence = True
+            elif (
+                isinstance(node.func, ast.Name)
+                and (dotted or node.func.id).rsplit(".", 1)[-1] in _FENCE_FUNCS
+            ):
+                has_fence = True
+            elif resolved and resolved.split(".")[0] in ("numpy",) and (
+                resolved.rsplit(".", 1)[-1] in _HOST_SYNC_NP_FUNCS
+            ):
+                has_fence = True  # np.asarray IS a readback fence
+            elif dotted and (
+                resolved.startswith("jax.numpy.") or resolved.startswith("jax.lax.")
+                or resolved == "jax.jit" or resolved.startswith("jax.random.")
+            ):
+                device_lines.append(node.lineno)
+        if len(clock_lines) < 2 or has_fence:
+            return
+        lo, hi = min(clock_lines), max(clock_lines)
+        inside = [ln for ln in device_lines if lo <= ln <= hi]
+        if inside:
+            self._emit(
+                "EM103",
+                ast.copy_location(ast.Pass(), fn),
+                "wall-clock window (lines "
+                f"{lo}-{hi}) around device dispatch at line {inside[0]} has no "
+                "completion fence (block_until_ready/device_sync) — async "
+                "dispatch makes the measured time meaningless",
+            )
+
+    # -- EM104 -------------------------------------------------------------
+
+    def _rule_dead_param(self, fn) -> None:
+        args = fn.args
+        names = [
+            a.arg
+            for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+            if a.arg not in ("self", "cls") and not a.arg.startswith("_")
+        ]
+        if args.vararg and not args.vararg.arg.startswith("_"):
+            names.append(args.vararg.arg)
+        if args.kwarg and not args.kwarg.arg.startswith("_"):
+            names.append(args.kwarg.arg)
+        used: set[str] = set()
+        for stmt in fn.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name):
+                    used.add(node.id)
+        for name in names:
+            if name not in used:
+                self._emit(
+                    "EM104", fn,
+                    f"parameter {name!r} of jitted function {fn.name!r} is "
+                    "never used — callers pay transfer/donation and retraces "
+                    "keyed on a value that cannot affect the result "
+                    "(implement it or remove it)",
+                )
+
+    # -- EM105 -------------------------------------------------------------
+
+    def _rule_loop_unroll(self, fn: ast.AST) -> None:
+        for node in self._walk_own_and_nested_traced(fn):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            # Small constant-range unrolls are idiomatic (head groups etc.).
+            if isinstance(node, ast.For) and self._small_constant_range(node.iter):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted_name(sub.func)
+                resolved = self.aliases.resolve(dotted) if dotted else ""
+                if resolved.startswith("jax.numpy.") or resolved.startswith("jax.lax."):
+                    self._emit(
+                        "EM105", node,
+                        "Python loop over jnp/lax work inside traced code "
+                        "unrolls into the XLA graph (compile time scales "
+                        "with trip count) — use lax.scan/fori_loop, or "
+                        "suppress for a small fixed unroll",
+                    )
+                    break
+
+    @staticmethod
+    def _small_constant_range(it: ast.AST, limit: int = 8) -> bool:
+        if (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id == "range"
+            and len(it.args) == 1
+            and isinstance(it.args[0], ast.Constant)
+            and isinstance(it.args[0].value, int)
+        ):
+            return it.args[0].value <= limit
+        return False
+
+    # -- EM106 -------------------------------------------------------------
+
+    def _rule_print(self, fn: ast.AST) -> None:
+        for node in self._walk_own_and_nested_traced(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                self._emit(
+                    "EM106", node,
+                    "print() inside traced code runs at trace time only "
+                    "(f-string payloads render Traced<...> reprs) — use "
+                    "jax.debug.print for runtime values",
+                )
+
+    # -- helpers -----------------------------------------------------------
+
+    def _walk_own_and_nested_traced(self, fn: ast.AST):
+        """Walk fn's body, descending into nested defs only when they are
+        themselves traced (a non-traced local helper is host code)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node not in self.traced:
+                    continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def lint_file(path: str | Path) -> list[Finding]:
+    src = Path(path).read_text(encoding="utf-8", errors="replace")
+    return _FileLinter(str(path), src).run()
+
+
+def lint_source(source: str, path: str = "<memory>") -> list[Finding]:
+    """Lint a source string (the fixture-test entry point)."""
+    return _FileLinter(path, source).run()
+
+
+def iter_python_files(paths) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(
+                f for f in sorted(p.rglob("*.py")) if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def lint_paths(paths) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(lint_file(f))
+    return findings

@@ -1,0 +1,103 @@
+"""Finding records, fingerprints, and the grandfathering baseline.
+
+A finding's fingerprint deliberately excludes the line NUMBER: baselines
+must survive unrelated edits above the finding, so identity is
+(rule, path, enclosing scope, stripped source line text) — the same scheme
+ruff/mypy baselining tools converged on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # e.g. "EM101"
+    severity: str  # "error" | "warning"
+    path: str  # repo-relative, forward slashes
+    line: int  # 1-based
+    message: str
+    context: str = ""  # dotted name of the enclosing function/class, if any
+    line_text: str = ""  # stripped source of the flagged line
+
+    def fingerprint(self) -> str:
+        key = "\x1f".join((self.rule, self.path, self.context, self.line_text))
+        return hashlib.sha1(key.encode("utf-8", "replace")).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        ctx = f" [{self.context}]" if self.context else ""
+        return f"{where}: {self.rule} {self.severity}: {self.message}{ctx}"
+
+
+@dataclass
+class Baseline:
+    """Committed set of grandfathered finding fingerprints."""
+
+    fingerprints: set[str] = field(default_factory=set)
+    entries: list[dict] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str | Path) -> "Baseline":
+        p = Path(path)
+        if not p.exists():
+            return cls()
+        data = json.loads(p.read_text())
+        entries = data.get("findings", [])
+        return cls({e["fingerprint"] for e in entries}, entries)
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding]) -> "Baseline":
+        entries = [
+            {
+                "fingerprint": f.fingerprint(),
+                "rule": f.rule,
+                "path": f.path,
+                "context": f.context,
+                "line_text": f.line_text,
+            }
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ]
+        return cls({e["fingerprint"] for e in entries}, entries)
+
+    def save(self, path: str | Path) -> None:
+        body = {
+            "comment": (
+                "Grandfathered edgelint findings. Regenerate with "
+                "`python -m edgemesh.analysis --write-baseline` after "
+                "reviewing that every new entry is intentional."
+            ),
+            "findings": self.entries,
+        }
+        Path(path).write_text(json.dumps(body, indent=2) + "\n")
+
+    def filter(self, findings: list[Finding]) -> list[Finding]:
+        """Findings NOT covered by the baseline."""
+        return [f for f in findings if f.fingerprint() not in self.fingerprints]
+
+
+def default_baseline_path() -> Path:
+    return Path(__file__).parent / "baseline.json"
+
+
+def repo_relative(path: str | Path) -> str:
+    """Best-effort repo-relative POSIX path (fingerprints must not depend on
+    the checkout location)."""
+    p = Path(path).resolve()
+    # The repo root is the parent of the "edgemesh" package directory.
+    root = Path(__file__).resolve().parent.parent.parent
+    try:
+        return p.relative_to(root).as_posix()
+    except ValueError:
+        return p.as_posix()

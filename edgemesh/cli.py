@@ -10,6 +10,8 @@ Subcommands:
                  its roadmap's "After Finetuning" rows were never started)
 - ``compare``  — paired bootstrap comparison of two eval runs (the
                  spreadsheet the reference eyeballed, with error bars)
+- ``lint``     — static analysis: edgelint AST rules + the abstract
+                 eval_shape contract pass (python -m edgemesh.analysis)
 """
 
 from __future__ import annotations
@@ -178,6 +180,12 @@ def cmd_train(cfg: EdgeMeshConfig) -> int:
 def main(argv: list[str] | None = None) -> int:
     _honor_platform_env()
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "lint":
+        # Own argument shape (paths + lint flags) — delegate to the analysis
+        # CLI before the shared parser, like compare below.
+        from edgemesh.analysis.__main__ import main as lint_main
+
+        return lint_main(argv[1:])
     if argv and argv[0] == "compare":
         # Own argument shape (two positional JSONL paths) — handled before
         # the shared parser, whose config-mirror options don't apply.

@@ -34,6 +34,7 @@ from edgemesh.models.transformer import (
     lm_head_logits,
 )
 from edgemesh.ops.attention import LayerKV
+from edgemesh.utils.compat import pcast, shard_map
 from edgemesh.utils.platform import on_tpu
 
 Params = dict[str, Any]
@@ -136,11 +137,11 @@ def _stage_pipeline_fn(
         init = (
             k_blk,
             v_blk,
-            lax.pcast(
+            pcast(
                 jnp.zeros((mb_size, seq_len, cfg.hidden_size), x_mb.dtype),
                 "pp", to="varying",
             ),
-            lax.pcast(jnp.zeros_like(x_mb), "pp", to="varying"),
+            pcast(jnp.zeros_like(x_mb), "pp", to="varying"),
         )
         (k_blk, v_blk, _, outputs), _ = lax.scan(
             one_step, init, jnp.arange(steps)
@@ -222,7 +223,7 @@ class PipelineEngine:
             return a.reshape(num_micro, mbs, *a.shape[1:])
 
         fn = _stage_pipeline_fn(cfg, self.pp, num_micro, mbs, is_decode)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(P("pp"), P("pp"), P("pp"), P(), P(), P(), P()),

@@ -16,10 +16,11 @@ tests.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from edgemesh.utils.compat import pcast, shard_map
 
 NEG_INF = -1e30
 
@@ -100,9 +101,9 @@ def ring_attend_block(
     l0 = jnp.zeros((b, sq, kv_heads, groups), jnp.float32)
     o0 = jnp.zeros((b, sq, kv_heads, groups, head_dim), jnp.float32)
     if pcast_accumulators:
-        m0 = lax.pcast(m0, axis, to="varying")
-        l0 = lax.pcast(l0, axis, to="varying")
-        o0 = lax.pcast(o0, axis, to="varying")
+        m0 = pcast(m0, axis, to="varying")
+        l0 = pcast(l0, axis, to="varying")
+        o0 = pcast(o0, axis, to="varying")
 
     right = [(i, (i + 1) % sp) for i in range(sp)]
 
@@ -154,7 +155,7 @@ def ring_attention(
         )
 
     seq_spec = P(None, "sp")
-    return jax.shard_map(
+    return shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(

@@ -45,6 +45,7 @@ from edgemesh.models.transformer import (
 from edgemesh.ops.rope import apply_rope
 from edgemesh.parallel.ring_attention import ring_attend_block
 from edgemesh.training import TrainState
+from edgemesh.utils.compat import axis_size, shard_map
 
 Params = dict[str, Any]
 
@@ -251,7 +252,7 @@ def _spmd_moe_mlp(cfg: ModelConfig, moe: Params, x: jnp.ndarray) -> tuple[jnp.nd
     T = b * s
     C = expert_capacity(cfg, T)
     xt = x.reshape(T, h)
-    ep = lax.axis_size("ep")
+    ep = axis_size("ep")
     e_local = cfg.num_experts // ep
     e0 = lax.axis_index("ep") * e_local
 
@@ -384,10 +385,16 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int, moe_aux_weight
                 ce = optax.softmax_cross_entropy_with_integer_labels(
                     lm_head_logits(cfg, params, h_in).astype(jnp.float32), tgt_mb[idx]
                 )
-                return jnp.sum(ce * tmask_mb[idx]), jnp.sum(tmask_mb[idx])
+                # Rank-1 accumulators (here and in the carry inits below):
+                # grad-of-shard_map on pre-vma jax forwards KNOWN scalar
+                # values (this count depends only on tokens/lengths) into the
+                # backward map under an all-axes respec that requires
+                # ndim >= 1 — a rank-0 residual aborts the whole backward.
+                return (jnp.sum(ce * tmask_mb[idx])[None],
+                        jnp.sum(tmask_mb[idx])[None])
 
             def skip_branch(h_in):
-                return jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)
+                return jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.float32)
 
             dl, dc = lax.cond(active & is_last_stage, ce_branch, skip_branch, h)
             loss_sum = loss_sum + dl
@@ -396,24 +403,24 @@ def _make_device_fn(cfg: ModelConfig, mesh: Mesh, num_micro: int, moe_aux_weight
 
         init = (
             jnp.zeros((mbs, s_l, cfg.hidden_size), cfg.activation_dtype),
-            jnp.zeros((), jnp.float32),
-            jnp.zeros((), jnp.float32),
-            jnp.zeros((), jnp.float32),
+            jnp.zeros((1,), jnp.float32),
+            jnp.zeros((1,), jnp.float32),
+            jnp.zeros((1,), jnp.float32),
         )
         (_, loss_sum, cnt_sum, aux_sum), _ = lax.scan(one_step, init, jnp.arange(steps))
 
         # Loss lives on the last pp stage, sharded over dp x sp; tp members
         # already agree (activations are tp-invariant after every row psum).
-        total = lax.psum(loss_sum, ("dp", "pp", "sp"))
-        count = lax.psum(cnt_sum, ("dp", "pp", "sp"))
-        loss = total / jnp.maximum(count, 1.0)
+        total = lax.psum(loss_sum, ("dp", "pp", "sp"))  # [1]
+        count = lax.psum(cnt_sum, ("dp", "pp", "sp"))  # [1]
+        loss = (total / jnp.maximum(count, 1.0))[0]
         if cfg.num_experts > 0:
             # psum over pp sums the per-stage LAYER blocks (correct: aux is a
             # per-layer sum, matching transformer._scan_layers); dp/sp shards
             # and microbatches routed DIFFERENT tokens, so those reduce as a
             # mean. ep/tp members compute identical aux — excluded from psum.
             dp_n, sp_n = mesh.shape["dp"], mesh.shape["sp"]
-            aux = lax.psum(aux_sum, ("dp", "pp", "sp")) / (dp_n * sp_n * num_micro)
+            aux = lax.psum(aux_sum, ("dp", "pp", "sp"))[0] / (dp_n * sp_n * num_micro)
             loss = loss + moe_aux_weight * aux
         return loss
 
@@ -436,7 +443,7 @@ def make_spmd_loss(
     specs = spmd_param_specs(cfg)
 
     def loss_fn(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray):
-        return jax.shard_map(
+        return shard_map(
             device_fn,
             mesh=mesh,
             in_specs=(specs, P("dp", "sp"), P("dp")),

@@ -41,6 +41,7 @@ from edgemesh.models.transformer import (
 )
 from edgemesh.ops.int8 import is_quantized
 from edgemesh.parallel.sharding import param_pspecs, quantized_pspecs
+from edgemesh.utils.compat import shard_map
 from edgemesh.utils.platform import on_tpu
 
 Params = dict[str, Any]
@@ -212,7 +213,7 @@ class TPInferenceEngine:
             )
             return logits, new_cache.k, new_cache.v
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             local,
             mesh=self.mesh,
             in_specs=(

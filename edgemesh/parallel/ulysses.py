@@ -27,6 +27,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from edgemesh.ops.attention import LayerKV, attend
+from edgemesh.utils.compat import shard_map
 
 
 def _full_seq_attend(
@@ -129,7 +130,7 @@ def ulysses_attention(
         )
 
     seq_spec = P(None, "sp")
-    return jax.shard_map(
+    return shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(

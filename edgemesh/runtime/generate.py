@@ -79,7 +79,6 @@ def _decode_loop(
     rng: jax.Array,
     decode_fn=None,  # static: (cfg, params, tokens[b], cache) -> (logits, cache)
     finished0: jax.Array | None = None,  # [b] rows already done (streaming)
-    len_cap: jax.Array | None = None,  # [b] freeze rows at this cache length
 ) -> tuple[jax.Array, jax.Array, KVCache, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Carries the last TOKEN (not logits): the model forward for output slot
     ``i`` runs at the top of iteration ``i``, so when the loop exits (EOS

@@ -79,12 +79,12 @@ def _match_prob(idx: jnp.ndarray, probs: jnp.ndarray, token: jnp.ndarray) -> jnp
     return jnp.sum(jnp.where(idx == token[:, None], probs, 0.0), axis=-1)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 4, 5, 6, 7))
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def _spec_init(
-    cfg_t: ModelConfig,
-    cfg_d: ModelConfig,
-    params_t,
-    params_d,
+    # Slot 0 needs only the prefill logits — no configs or weights. (They
+    # used to ride along for signature symmetry with _spec_rounds; edgelint
+    # EM104 flagged the weight pytrees as dead traced args, each a full
+    # model's worth of transfer/donation keying for zero effect.)
     sampling: SamplingParams,
     gamma: int,
     max_new: int,
@@ -477,9 +477,8 @@ def _spec_prefill(
     valid = jnp.arange(prompt_len)[None, :] < lengths[:, None]
     mask = TokenMaskState.init(batch, cfg_target.vocab_size).add_sequence(tokens, valid).mask
     state = _spec_init(
-        cfg_target, cfg_draft, params_target, params_draft, sampling,
-        int(gamma), max_new, int(eos_id), first_logits, t_cache, d_cache,
-        mask, rng,
+        sampling, int(gamma), max_new, int(eos_id), first_logits,
+        t_cache, d_cache, mask, rng,
     )
     return state, t0, t1
 

@@ -1110,7 +1110,6 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
         self._rng, r0 = jax.random.split(self._rng)
         row = _spec_init(
-            self.cfg, agent.draft_cfg, agent.params, agent.draft_params,
             agent.sampling, self.gamma, self.max_new, eos_id,
             logits1, None, None, mask1, r0,
         )

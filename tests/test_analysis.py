@@ -1,0 +1,445 @@
+"""edgemesh.analysis: one known-bad fixture per lint rule (each rule
+demonstrably fires), suppression/baseline mechanics, the abstract contract
+pass, and the CLI exit-code contract. Fast tier — the contract pass is
+eval_shape-only (no device programs compiled)."""
+
+import json
+import subprocess
+import sys
+
+from edgemesh.analysis.edgelint import RULES, lint_source
+from edgemesh.analysis.findings import Baseline, Finding
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# EM101 jax-api-drift
+# ---------------------------------------------------------------------------
+
+
+def test_em101_fires_on_experimental_shard_map_import():
+    # The exact import that broke all 7 seed ring-attention tests.
+    findings = lint_source(
+        "from jax.experimental.shard_map import shard_map\n",
+        path="edgemesh/parallel/ring_attention.py",
+    )
+    assert rules_of(findings) == {"EM101"}
+    assert "compat" in findings[0].message
+
+
+def test_em101_fires_on_module_form_and_new_spelling():
+    src = (
+        "import jax\n"
+        "import jax.experimental.maps\n"
+        "f = jax.shard_map(lambda x: x, mesh=None, in_specs=(), out_specs=())\n"
+    )
+    findings = lint_source(src, path="edgemesh/parallel/x.py")
+    assert [f.rule for f in findings] == ["EM101", "EM101"]
+    # Both the removed module AND the too-new direct spelling are drift.
+    assert any("jax.experimental.maps" in f.message for f in findings)
+    assert any("jax.shard_map" in f.message for f in findings)
+
+
+def test_em101_fires_on_aliased_lax_pcast():
+    src = "from jax import lax\ny = lax.pcast(1, 'sp', to='varying')\n"
+    findings = lint_source(src, path="edgemesh/parallel/x.py")
+    assert rules_of(findings) == {"EM101"}
+
+
+def test_em101_allows_the_compat_shim_itself():
+    src = "from jax.experimental.shard_map import shard_map\n"
+    assert lint_source(src, path="edgemesh/utils/compat.py") == []
+
+
+# ---------------------------------------------------------------------------
+# EM102 host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+_EM102_SRC = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def f(x):
+    s = x.sum().item()       # readback
+    h = np.asarray(x)        # host materialization
+    t = float(x[0])          # concretization error
+    return s + t + h.sum()
+
+def host_fn(x):
+    return x.sum().item()    # fine: not traced
+"""
+
+
+def test_em102_fires_only_inside_traced_code():
+    findings = lint_source(_EM102_SRC, path="edgemesh/x.py")
+    assert [f.rule for f in findings] == ["EM102", "EM102", "EM102"]
+    assert all(f.context == "f" for f in findings)
+
+
+def test_em102_sees_through_lax_hofs():
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def body(c, x):\n"
+        "    return c + x.item(), None\n"
+        "def run(xs):\n"
+        "    return lax.scan(body, 0.0, xs)\n"
+    )
+    findings = lint_source(src, path="edgemesh/x.py")
+    assert rules_of(findings) == {"EM102"}
+
+
+# ---------------------------------------------------------------------------
+# EM103 unsynced-timing
+# ---------------------------------------------------------------------------
+
+_EM103_BAD = """
+import time
+import jax.numpy as jnp
+
+def bench(x):
+    t0 = time.perf_counter()
+    y = jnp.dot(x, x)        # dispatches async
+    t1 = time.perf_counter() # window closes before the device finishes
+    return t1 - t0, y
+"""
+
+
+def test_em103_fires_without_fence():
+    findings = lint_source(_EM103_BAD, path="edgemesh/benchmarks.py")
+    assert rules_of(findings) == {"EM103"}
+
+
+def test_em103_quiet_with_method_fence():
+    src = _EM103_BAD.replace(
+        "t1 = time.perf_counter()",
+        "y.block_until_ready()\n    t1 = time.perf_counter()",
+    )
+    assert lint_source(src, path="edgemesh/benchmarks.py") == []
+
+
+def test_em103_nested_window_reported_once():
+    # A defect inside a nested helper must be attributed to THAT def only,
+    # not once per enclosing def.
+    src = (
+        "import time\n"
+        "import jax.numpy as jnp\n"
+        "def outer(x):\n"
+        "    def bench(y):\n"
+        "        t0 = time.perf_counter()\n"
+        "        z = jnp.dot(y, y)\n"
+        "        t1 = time.perf_counter()\n"
+        "        return t1 - t0\n"
+        "    return bench(x)\n"
+    )
+    findings = lint_source(src, path="edgemesh/x.py")
+    assert [f.rule for f in findings] == ["EM103"]
+
+
+def test_em103_quiet_with_function_fence():
+    # device_sync(x) — edgemesh's own readback fence, function-call form.
+    src = _EM103_BAD.replace(
+        "t1 = time.perf_counter()",
+        "device_sync(y)\n    t1 = time.perf_counter()",
+    )
+    assert lint_source(src, path="edgemesh/benchmarks.py") == []
+
+
+# ---------------------------------------------------------------------------
+# EM104 dead-jit-param
+# ---------------------------------------------------------------------------
+
+_EM104_SRC = """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(2,))
+def decode(tokens, cache, len_cap):
+    return tokens + cache
+"""
+
+
+def test_em104_fires_on_dead_param():
+    findings = lint_source(_EM104_SRC, path="edgemesh/runtime/generate.py")
+    assert rules_of(findings) == {"EM104"}
+    assert "len_cap" in findings[0].message
+
+
+def test_em104_two_dead_params_on_one_def_both_reported():
+    src = _EM104_SRC.replace("def decode(tokens, cache, len_cap):",
+                             "def decode(tokens, cache, len_cap, other):")
+    findings = lint_source(src, path="edgemesh/x.py")
+    assert len(findings) == 2
+
+
+def test_em104_underscore_prefix_is_exempt():
+    src = _EM104_SRC.replace("len_cap", "_len_cap")
+    assert lint_source(src, path="edgemesh/x.py") == []
+
+
+def test_em104_ignores_unjitted_functions():
+    src = "def f(a, unused):\n    return a\n"
+    assert lint_source(src, path="edgemesh/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# EM105 jit-loop-unroll
+# ---------------------------------------------------------------------------
+
+_EM105_SRC = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    for i in range(64):
+        x = jnp.sin(x)
+    return x
+"""
+
+
+def test_em105_fires_on_large_unroll():
+    findings = lint_source(_EM105_SRC, path="edgemesh/x.py")
+    assert rules_of(findings) == {"EM105"}
+
+
+def test_em105_allows_small_fixed_unroll():
+    src = _EM105_SRC.replace("range(64)", "range(4)")
+    assert lint_source(src, path="edgemesh/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# EM106 print-in-jit
+# ---------------------------------------------------------------------------
+
+
+def test_em106_fires_on_print_in_traced_code():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    print(f'x is {x}')\n"
+        "    return x\n"
+    )
+    findings = lint_source(src, path="edgemesh/x.py")
+    assert rules_of(findings) == {"EM106"}
+
+
+def test_em106_quiet_outside_jit():
+    src = "def f(x):\n    print(x)\n    return x\n"
+    assert lint_source(src, path="edgemesh/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Suppression + baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_inline_disable_suppresses_one_rule():
+    src = _EM105_SRC.replace(
+        "    for i in range(64):",
+        "    for i in range(64):  # edgelint: disable=EM105",
+    )
+    assert lint_source(src, path="edgemesh/x.py") == []
+
+
+def test_disable_on_def_line_covers_function_body():
+    src = _EM105_SRC.replace(
+        "def f(x):", "def f(x):  # edgelint: disable=EM105"
+    )
+    assert lint_source(src, path="edgemesh/x.py") == []
+
+
+def test_baseline_filters_by_fingerprint_not_line_number():
+    findings = lint_source(_EM104_SRC, path="edgemesh/x.py")
+    baseline = Baseline.from_findings(findings)
+    # Same finding shifted 5 lines down must stay baselined.
+    shifted = lint_source("\n\n\n\n\n" + _EM104_SRC, path="edgemesh/x.py")
+    assert shifted[0].line != findings[0].line
+    assert baseline.filter(shifted) == []
+    # A genuinely new finding still surfaces.
+    fresh = Finding("EM104", "warning", "edgemesh/x.py", 1, "m", "g", "other src")
+    assert baseline.filter([fresh]) == [fresh]
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = lint_source(_EM104_SRC, path="edgemesh/x.py")
+    p = tmp_path / "baseline.json"
+    Baseline.from_findings(findings).save(p)
+    assert Baseline.load(p).filter(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# Abstract contract pass
+# ---------------------------------------------------------------------------
+
+
+def test_contract_pass_is_green():
+    from edgemesh.analysis.contracts import run_contracts
+
+    findings = run_contracts()
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_contract_pass_catches_cache_instability():
+    # A decode step whose output cache grows by one slot per call: EM202.
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.analysis import contracts
+
+    def bad_runner():
+        def bad_decode(cache):
+            return jnp.concatenate([cache, cache[:1]], axis=0)
+
+        cache = jax.ShapeDtypeStruct((4, 8), jnp.float32)
+        out = jax.eval_shape(bad_decode, cache)
+        problems = []
+        if contracts._avals(out) != contracts._avals(cache):
+            problems.append(("EM202", "cache avals drifted"))
+        return problems
+
+    entry = [("bad.decode", "edgemesh/x.py", bad_runner)]
+    old = contracts.ENTRY_POINTS
+    contracts.ENTRY_POINTS = entry
+    try:
+        findings = contracts.run_contracts()
+    finally:
+        contracts.ENTRY_POINTS = old
+    assert "EM202" in rules_of(findings)
+
+
+def test_contract_pass_reports_trace_failures_as_em201():
+    from edgemesh.analysis import contracts
+
+    def broken_runner():
+        raise TypeError("signature drifted")
+
+    old = contracts.ENTRY_POINTS
+    contracts.ENTRY_POINTS = [("broken.entry", "edgemesh/x.py", broken_runner)]
+    try:
+        findings = contracts.run_contracts()
+    finally:
+        contracts.ENTRY_POINTS = old
+    em201 = [f for f in findings if f.rule == "EM201"]
+    assert em201 and "signature drifted" in em201[0].message
+
+
+def test_contract_pass_flags_unregistered_check_kernel():
+    # Hide one registration: the registry-coverage check must flag the kernel.
+    from edgemesh.analysis import contracts
+
+    old = contracts.CHECK_CONTRACTS
+    contracts.CHECK_CONTRACTS = [
+        c for c in old if c["kernel"][1] != "int8_matmul_fused"
+    ]
+    try:
+        findings = contracts._run_check_contracts()
+    finally:
+        contracts.CHECK_CONTRACTS = old
+    assert any(
+        f.rule == "EM204" and "int8_matmul_fused" in f.message for f in findings
+    )
+
+
+def test_contract_pass_flags_dead_contract_as_em205():
+    # A checker that never fires on the bad inputs: EM205.
+    from edgemesh.analysis import contracts
+
+    old = contracts.CHECK_CONTRACTS
+    dead = dict(old[-1])  # int8 entry
+    dead = {**dead, "checker": "checked"}  # 'checked' exists but asserts nothing
+    contracts.CHECK_CONTRACTS = [dead]
+    try:
+        findings = contracts._run_check_contracts()
+    finally:
+        contracts.CHECK_CONTRACTS = old
+    assert any(f.rule == "EM205" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Repo gate + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean_against_baseline():
+    """The merged tree itself must stay green: AST pass over edgemesh/ with
+    the committed baseline applied (the cheap half of the CI gate; the
+    contract half is test_contract_pass_is_green)."""
+    from pathlib import Path
+
+    from edgemesh.analysis.edgelint import lint_paths
+    from edgemesh.analysis.findings import Baseline, default_baseline_path
+
+    pkg = Path(__file__).resolve().parent.parent / "edgemesh"
+    fresh = Baseline.load(default_baseline_path()).filter(lint_paths([pkg]))
+    assert fresh == [], [f.render() for f in fresh]
+
+
+def test_cli_json_output_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_EM104_SRC)
+    good = tmp_path / "good.py"
+    good.write_text("def f(a):\n    return a\n")
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(bad),
+         "--no-contracts", "--format", "json", "--no-baseline"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    assert [f["rule"] for f in report["findings"]] == ["EM104"]
+    assert report["findings"][0]["fingerprint"]
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(good),
+         "--no-contracts", "--no-baseline"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_missing_path_is_usage_error_not_clean(tmp_path):
+    # A typo'd path must not produce a permanently-green "clean"/exit 0 gate.
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis",
+         str(tmp_path / "no_such_dir"), "--no-contracts"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "no such path" in proc.stderr
+
+
+def test_cli_write_baseline_grandfathers(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_EM104_SRC)
+    bl = tmp_path / "bl.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(bad),
+         "--no-contracts", "--baseline", str(bl), "--write-baseline"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(bad),
+         "--no-contracts", "--baseline", str(bl)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "1 baselined" in proc.stdout
+
+
+def test_every_rule_has_metadata():
+    from edgemesh.analysis.contracts import CONTRACT_RULES
+
+    for table in (RULES, CONTRACT_RULES):
+        for rule, meta in table.items():
+            assert meta["severity"] in ("error", "warning"), rule
+            assert meta["name"] and meta["summary"], rule

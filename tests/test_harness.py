@@ -1,9 +1,17 @@
 """Eval harness: zero-fill policy, JSONL persistence, resume, aggregation."""
 
 import json
+import os
+
+import pytest
 
 from edgemesh.eval.data import QASample, load_qa_csv
 from edgemesh.eval.harness import aggregate, run_eval
+
+# The reference repo's golden-dataset snapshot; only present on machines that
+# checked out the reference alongside this repo. CSV *parsing* is covered by
+# test_load_csv_fixture below either way.
+REFERENCE_CSV = "/root/reference/Code/Dataset/natural_questions_1000.csv"
 
 
 def _samples(n=4):
@@ -85,12 +93,31 @@ def test_aggregate_ignores_missing_keys():
     assert rep["bleu"] == 0.5
 
 
+@pytest.mark.skipif(
+    not os.path.exists(REFERENCE_CSV),
+    reason="reference natural_questions_1000.csv snapshot not checked out "
+    "on this machine (parsing itself is covered by test_load_csv_fixture)",
+)
 def test_load_reference_csv():
-    samples = load_qa_csv(
-        "/root/reference/Code/Dataset/natural_questions_1000.csv", limit=5
-    )
+    samples = load_qa_csv(REFERENCE_CSV, limit=5)
     assert len(samples) == 5
     assert samples[0].question and samples[0].answer
+
+
+def test_load_csv_fixture(tmp_path):
+    """Same loader, committed-fixture shape: runs everywhere the reference
+    snapshot does not exist."""
+    p = tmp_path / "qa.csv"
+    p.write_text(
+        "question,answer\n"
+        '"who wrote hamlet","william shakespeare"\n'
+        '"capital of france","paris"\n',
+        encoding="utf-8",
+    )
+    samples = load_qa_csv(p, limit=2)
+    assert len(samples) == 2
+    assert samples[0].question == "who wrote hamlet"
+    assert samples[1].answer == "paris"
 
 
 def test_batched_eval_matches_sequential(tmp_path):
