@@ -26,6 +26,13 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
 - EM106 print-in-jit (warning): ``print`` (incl. f-string payloads) inside
   traced code — runs at TRACE time only (or leaks ``Traced<...>`` reprs);
   use ``jax.debug.print`` for runtime values.
+- EM107 raw-timing-in-serving (warning): a raw wall-clock read
+  (``time.time``/``perf_counter``/``monotonic``) inside ``edgemesh/serve/``
+  or ``edgemesh/runtime/`` — serving-stack timing belongs to the obs
+  substrate (``edgemesh.obs.SpanTracker`` hooks / ``utils.tracing.trace``)
+  so it lands in spans, histograms, and ``/metrics`` instead of ad-hoc
+  deltas. Pre-obs sites are grandfathered in the baseline; clocks that ARE
+  the obs instrumentation (or wait control flow) carry an inline disable.
 
 Suppression: append ``# edgelint: disable=EM105`` (comma-separate for
 several rules) to the flagged line, or put the comment on the ``def`` line
@@ -71,6 +78,11 @@ RULES: dict[str, dict] = {
         "severity": "warning",
         "summary": "print inside traced code runs at trace time (use jax.debug.print)",
     },
+    "EM107": {
+        "name": "raw-timing-in-serving",
+        "severity": "warning",
+        "summary": "raw wall-clock read in serve//runtime/ bypasses edgemesh.obs spans",
+    },
 }
 
 # ---------------------------------------------------------------------------
@@ -108,6 +120,11 @@ _FENCE_METHODS = {"block_until_ready", "device_sync", "tree_sync", "result"}
 _FENCE_FUNCS = {"block_until_ready", "device_sync", "tree_sync"}
 
 _DISABLE_RE = re.compile(r"#\s*edgelint:\s*disable=([A-Z0-9, ]+)")
+
+# EM107 scope: the serving stack, where every wall-clock read should flow
+# through the obs substrate. Path-substring match (like the EM101 allowlist)
+# so fixture tests with relative paths resolve the same everywhere.
+_EM107_DIRS = ("edgemesh/serve/", "edgemesh/runtime/")
 
 
 # ---------------------------------------------------------------------------
@@ -349,6 +366,7 @@ class _FileLinter:
         self.jit_decorated = collector.jit_decorated
 
         self._rule_api_drift(tree)
+        self._rule_raw_timing(tree)
         # Traced ROOTS only: their walkers descend into traced nested defs,
         # so running every traced def would double-report nested call sites.
         traced_roots = [
@@ -425,6 +443,25 @@ class _FileLinter:
             if name == mod or name.startswith(mod + "."):
                 return why
         return None
+
+    # -- EM107 -------------------------------------------------------------
+
+    def _rule_raw_timing(self, tree: ast.Module) -> None:
+        if not any(d in self.relpath for d in _EM107_DIRS):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_name(node.func)
+            if dotted and self.aliases.resolve(dotted) in _CLOCK_FUNCS:
+                self._emit(
+                    "EM107", node,
+                    f"raw {self.aliases.resolve(dotted)}() in the serving "
+                    "stack bypasses obs spans — record through "
+                    "edgemesh.obs.SpanTracker / utils.tracing.trace() (or "
+                    "suppress: control-flow clocks and the obs "
+                    "instrumentation itself are legitimate)",
+                )
 
     # -- EM102 -------------------------------------------------------------
 

@@ -215,7 +215,12 @@ def serving_benchmark(
         ),
         prefix_cache=False,
     )
-    eng = ContinuousEngine(agent, slots=slots, chunk=chunk, kv_backend=kv_backend)
+    # Fresh registry per run: the "obs" block below must describe THIS
+    # engine's traffic, not every serving stage sharing the process default.
+    from edgemesh.obs import Registry
+
+    eng = ContinuousEngine(agent, slots=slots, chunk=chunk,
+                           kv_backend=kv_backend, registry=Registry())
     try:
         import numpy as np
 
@@ -251,6 +256,10 @@ def serving_benchmark(
             "latency_s_p50": round(float(np.percentile(lats, 50)), 4),
             "latency_s_p95": round(float(np.percentile(lats, 95)), 4),
             "stats": stats,
+            # The obs view of the same run: TTFT/queue-wait/inter-token
+            # aggregates from the engine's span tracker (compact form — the
+            # full histograms ride /metrics, not the bench artifact).
+            "obs": eng.obs.registry.summary(prefix="edgemesh_"),
         }
     finally:
         eng.close()
@@ -293,6 +302,8 @@ def admission_policy_benchmark(
     }
     import numpy as np
 
+    from edgemesh.obs import Registry
+
     for policy in ("fifo", "sjf"):
         agent = Agent(
             role="qa", cfg=cfg, params=params, tokenizer=ByteTokenizer(),
@@ -303,7 +314,8 @@ def admission_policy_benchmark(
             prefix_cache=False,
         )
         eng = ContinuousEngine(agent, slots=slots, chunk=chunk,
-                               kv_backend=kv_backend, admission=policy)
+                               kv_backend=kv_backend, admission=policy,
+                               registry=Registry())
         try:
             wave_tok_s, tagged, _, _ = _run_waves(
                 eng, n_requests, waves, budgets=budgets,
@@ -953,4 +965,16 @@ def headline_benchmark(
 
         _stage("llama8b", _big)
 
+    # Phase breakdown + obs-registry snapshot ride the final artifact: the
+    # prefill/decode split from trace() regions and every serving aggregate
+    # the run produced, so a BENCH json is diagnosable without re-running.
+    from edgemesh.obs import get_registry
+    from edgemesh.utils.tracing import phase_report
+
+    out["phases"] = {
+        k: {kk: round(vv, 6) for kk, vv in v.items()}
+        for k, v in phase_report().items()
+    }
+    out["obs"] = get_registry().summary(prefix="edgemesh_")
+    emit_partial(out)
     return out

@@ -12,6 +12,8 @@ Subcommands:
                  spreadsheet the reference eyeballed, with error bars)
 - ``lint``     — static analysis: edgelint AST rules + the abstract
                  eval_shape contract pass (python -m edgemesh.analysis)
+- ``obs``      — tail/summarize request-span JSONL logs and dump registry
+                 snapshots (edgemesh.obs; docs/OBSERVABILITY.md)
 """
 
 from __future__ import annotations
@@ -71,14 +73,14 @@ def cmd_eval(cfg: EdgeMeshConfig) -> int:
 
 def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool = False,
               kv_backend: str = "dense", kv_page_size: int = 64,
-              admission: str = "fifo") -> int:
+              admission: str = "fifo", span_log: str | None = None) -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.serve import serve_rest
 
     ensemble = build_ensemble(cfg)
     serve_rest(ensemble, port=port, batch=batch, continuous=continuous,
                kv_backend=kv_backend, kv_page_size=kv_page_size,
-               admission=admission)
+               admission=admission, span_log=span_log)
     return 0
 
 
@@ -186,6 +188,12 @@ def main(argv: list[str] | None = None) -> int:
         from edgemesh.analysis.__main__ import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "obs":
+        # Offline span-log tooling: no config, no jax, no device — delegate
+        # before the shared parser like lint/compare.
+        from edgemesh.obs.cli import main as obs_main
+
+        return obs_main(argv[1:])
     if argv and argv[0] == "compare":
         # Own argument shape (two positional JSONL paths) — handled before
         # the shared parser, whose config-mirror options don't apply.
@@ -224,6 +232,11 @@ def main(argv: list[str] | None = None) -> int:
         help="serve --continuous --kv-backend paged*: tokens per KV page "
         "(smaller pages = finer reclamation + template prefix sharing kicks "
         "in once the template spans a full page)",
+    )
+    top.add_argument(
+        "--span-log", type=str, default=None,
+        help="serve --continuous: JSONL path for request-lifecycle span "
+        "records (inspect/replay with `edgemesh obs`)",
     )
     top.add_argument(
         "--preset", type=str, default=None,
@@ -268,7 +281,7 @@ def main(argv: list[str] | None = None) -> int:
     if cmd_args.command == "serve":
         return cmd_serve(cfg, cmd_args.port, cmd_args.batch, cmd_args.continuous,
                          cmd_args.kv_backend, cmd_args.kv_page_size,
-                         cmd_args.admission)
+                         cmd_args.admission, cmd_args.span_log)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     if cmd_args.command == "train":

@@ -1,0 +1,31 @@
+"""edgemesh.obs — unified telemetry for the serving stack.
+
+Three pieces (docs/OBSERVABILITY.md is the operator-facing reference):
+
+- ``metrics``: thread-safe labeled Counter/Gauge/Histogram registry with
+  Prometheus text exposition (``Registry.render()``) — what ``GET /metrics``
+  serves.
+- ``spans``: request-lifecycle span trees (queued → prefill → decode
+  segments → retire) recorded by the continuous engines, flushed as JSONL,
+  and replayable into the same registry aggregates offline.
+- ``device``: scrape-time gauges over ``jax.local_devices()``
+  ``memory_stats()`` and live-buffer counts.
+
+Importing this package never imports jax — device sampling defers the
+import to scrape time, so the supervisor and the ``edgemesh obs`` CLI stay
+backend-free.
+"""
+
+from edgemesh.obs.device import register_device_gauges  # noqa: F401
+from edgemesh.obs.metrics import (  # noqa: F401
+    INTER_TOKEN_BUCKETS,
+    LATENCY_BUCKETS,
+    Registry,
+    get_registry,
+    set_registry,
+)
+from edgemesh.obs.spans import (  # noqa: F401
+    RequestTrace,
+    SpanTracker,
+    replay_spans,
+)

@@ -1,0 +1,126 @@
+"""``edgemesh obs`` — offline span-log inspection and registry dumps.
+
+Subcommands (all operate on the span JSONL the engines write via
+``span_log=``, no backend or server required):
+
+- ``tail <spans.jsonl> [-n N] [--event E]``: last N records, one compact
+  human line each (rid, status, generated, queue/TTFT/latency).
+- ``summary <spans.jsonl>``: replay the log into a fresh registry and print
+  a JSON aggregate report (request counts by status, token totals, latency
+  histograms as count/sum/mean) plus percentile estimates.
+- ``prom <spans.jsonl>``: the same replay, rendered as Prometheus text
+  exposition — byte-for-byte the format a live ``/metrics`` scrape serves,
+  so offline logs and live scrapes feed the same dashboards.
+
+Exit status: 0 on success, 2 on usage errors (missing file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from edgemesh.obs.spans import SPAN_RECORD_EVENT, replay_spans
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="edgemesh obs",
+        description="tail/summarize request-span JSONL logs; dump registry "
+        "snapshots (docs/OBSERVABILITY.md)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    tail = sub.add_parser("tail", help="print the last N span records")
+    tail.add_argument("path")
+    tail.add_argument("-n", type=int, default=10, dest="count")
+    tail.add_argument("--event", default=None,
+                      help="filter by record event (default: all)")
+    summ = sub.add_parser("summary",
+                          help="replay spans into aggregate JSON")
+    summ.add_argument("path")
+    prom = sub.add_parser("prom",
+                          help="replay spans into Prometheus exposition text")
+    prom.add_argument("path")
+    return p
+
+
+def _read(path: str) -> list[dict]:
+    from edgemesh.utils.tracing import JsonlLogger
+
+    logger = JsonlLogger(path)
+    records = logger.read()
+    if logger.malformed:
+        print(f"note: skipped {logger.malformed} malformed line(s)",
+              file=sys.stderr)
+    return records
+
+
+def _fmt_s(v) -> str:
+    return "-" if v is None else f"{v * 1e3:.1f}ms"
+
+
+def cmd_tail(path: str, count: int, event: str | None) -> int:
+    records = _read(path)
+    if event:
+        records = [r for r in records if r.get("event") == event]
+    for r in records[-count:]:
+        if r.get("event") == SPAN_RECORD_EVENT:
+            names = ">".join(s["name"] for s in r.get("spans", ()))
+            print(
+                f"rid={r.get('rid')} [{r.get('engine')}] "
+                f"{r.get('status')} generated={r.get('generated')} "
+                f"queue={_fmt_s(r.get('queue_s'))} "
+                f"ttft={_fmt_s(r.get('ttft_s'))} "
+                f"latency={_fmt_s(r.get('latency_s'))} spans={names}"
+            )
+        else:
+            print(json.dumps(r))
+    return 0
+
+
+def cmd_summary(path: str) -> int:
+    records = _read(path)
+    registry = replay_spans(records)
+    spans = [r for r in records if r.get("event") == SPAN_RECORD_EVENT]
+    lats = sorted(r["latency_s"] for r in spans
+                  if r.get("latency_s") is not None)
+    ttfts = sorted(r["ttft_s"] for r in spans if r.get("ttft_s") is not None)
+
+    def pct(xs: list[float], q: float):
+        if not xs:
+            return None
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))], 6)
+
+    print(json.dumps({
+        "records": len(records),
+        "requests": len(spans),
+        "latency_s_p50": pct(lats, 0.50),
+        "latency_s_p95": pct(lats, 0.95),
+        "ttft_s_p50": pct(ttfts, 0.50),
+        "ttft_s_p95": pct(ttfts, 0.95),
+        "metrics": registry.summary(),
+    }, indent=2))
+    return 0
+
+
+def cmd_prom(path: str) -> int:
+    sys.stdout.write(replay_spans(_read(path)).render())
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if not Path(args.path).exists():
+        print(f"error: no such span log: {args.path}", file=sys.stderr)
+        return 2
+    if args.cmd == "tail":
+        return cmd_tail(args.path, args.count, args.event)
+    if args.cmd == "summary":
+        return cmd_summary(args.path)
+    return cmd_prom(args.path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

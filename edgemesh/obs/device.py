@@ -1,0 +1,65 @@
+"""Scrape-time device gauges: HBM/host memory stats and live buffers.
+
+Registered as a registry collector, so ``jax.local_devices()`` and
+``memory_stats()`` are sampled only when someone actually renders
+``/metrics`` — never on the serving hot path. jax imports stay inside the
+collector: importing ``edgemesh.obs`` must not initialize a backend (the
+supervisor and the offline ``edgemesh obs`` CLI rely on that).
+
+``memory_stats()`` availability is backend-dependent (TPU/GPU report
+``bytes_in_use``/``bytes_limit``; CPU returns ``None`` or raises) — absent
+stats simply produce no sample, the scrape itself never fails.
+"""
+
+from __future__ import annotations
+
+from edgemesh.obs.metrics import Registry, get_registry
+
+# memory_stats() key → our ``kind`` label. Only the serving-relevant subset:
+# a full dump would be ~20 allocator internals per device.
+_MEMORY_KINDS = {
+    "bytes_in_use": "in_use",
+    "bytes_limit": "limit",
+    "peak_bytes_in_use": "peak",
+    "bytes_reserved": "reserved",
+}
+
+
+def _collect_device_gauges(registry: Registry) -> None:
+    import jax
+
+    mem = registry.gauge(
+        "edgemesh_device_memory_bytes",
+        "Per-device allocator stats from memory_stats()",
+        ("device", "kind"),
+    )
+    live = registry.gauge(
+        "edgemesh_live_buffers",
+        "Live jax arrays in this process (jax.live_arrays())",
+    )
+    n_dev = registry.gauge(
+        "edgemesh_devices", "Addressable devices on this host"
+    )
+    devices = jax.local_devices()
+    n_dev.set(len(devices))
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        for key, kind in _MEMORY_KINDS.items():
+            if key in stats:
+                mem.labels(device=str(d.id), kind=kind).set(stats[key])
+    try:
+        live.set(len(jax.live_arrays()))
+    except Exception:
+        pass
+
+
+def register_device_gauges(registry: Registry | None = None) -> None:
+    """Idempotent: add the device collector to ``registry`` (default: the
+    process registry). Collectors dedupe by identity, so calling this per
+    server start is safe."""
+    (registry or get_registry()).add_collector(_collect_device_gauges)

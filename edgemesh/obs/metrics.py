@@ -1,0 +1,343 @@
+"""Labeled metrics registry with Prometheus text exposition.
+
+The serving stack's single source of aggregate truth (docs/OBSERVABILITY.md
+has the full metric catalog). Three instrument kinds, all thread-safe and
+label-aware:
+
+- ``Counter``: monotone totals (requests, tokens, restarts).
+- ``Gauge``: last-written values (KV pages free/reserved, spec acceptance).
+- ``Histogram``: serving-latency distributions with fixed bucket bounds —
+  TTFT, inter-token latency, queue wait. Buckets are cumulative (Prometheus
+  semantics), and ``observe(value, count=n)`` supports weighted observation
+  so a segment crediting n tokens costs one lock acquisition, not n.
+
+No third-party client library: exposition is the plain text format
+(``# HELP`` / ``# TYPE`` / ``name{labels} value``, histograms as
+``_bucket{le=...}``/``_sum``/``_count``), which is all a Prometheus scrape
+needs. No jax import at module scope — the supervisor and the ``edgemesh
+obs`` CLI must stay importable without a backend.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Callable, Iterable
+
+# Serving-tuned bucket bounds. End-to-end latencies (queue wait, TTFT,
+# request latency, prefill) span ~1 ms interactive to ~60 s batch-overload;
+# inter-token latency sits an order of magnitude lower.
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+INTER_TOKEN_BUCKETS = (
+    0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample-value formatting: integers without a trailing
+    ``.0``, floats via repr-shortest, infinities as ``+Inf``/``-Inf``."""
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(names: tuple[str, ...], values: tuple[str, ...],
+               extra: tuple[tuple[str, str], ...] = ()) -> str:
+    pairs = [f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)]
+    pairs += [f'{n}="{_escape_label(v)}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class _Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock: threading.RLock):
+        self.value = 0.0
+        self._lock = lock
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counters only go up (inc by {amount})")
+        with self._lock:
+            self.value += amount
+
+
+class _Gauge:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock: threading.RLock):
+        self.value = 0.0
+        self._lock = lock
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "sum", "count", "_lock")
+
+    def __init__(self, buckets: tuple[float, ...], lock: threading.RLock):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+        self._lock = lock
+
+    def observe(self, value: float, count: int = 1) -> None:
+        if count < 1:
+            return
+        with self._lock:
+            self.sum += value * count
+            self.count += count
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self.counts[i] += count
+                    return
+            self.counts[-1] += count
+
+    def cumulative(self) -> list[int]:
+        """Per-bucket cumulative counts (Prometheus ``le`` semantics),
+        +Inf last — always equal to ``count``."""
+        out, acc = [], 0
+        with self._lock:
+            for c in self.counts:
+                acc += c
+                out.append(acc)
+        return out
+
+
+class _Family:
+    """One named metric of one type, holding a child per label-value tuple."""
+
+    def __init__(self, name: str, mtype: str, help: str,
+                 labelnames: tuple[str, ...], lock: threading.RLock,
+                 buckets: tuple[float, ...] = LATENCY_BUCKETS):
+        self.name = name
+        self.type = mtype
+        self.help = help
+        self.labelnames = labelnames
+        self.buckets = buckets
+        self._lock = lock
+        self._children: dict[tuple[str, ...], Any] = {}
+
+    def labels(self, **labelvalues: str):
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, "
+                f"got {tuple(labelvalues)}"
+            )
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                if self.type == "counter":
+                    child = _Counter(self._lock)
+                elif self.type == "gauge":
+                    child = _Gauge(self._lock)
+                else:
+                    child = _Histogram(self.buckets, self._lock)
+                self._children[key] = child
+        return child
+
+    # Label-less families act as their own single child.
+    def _default(self):
+        return self.labels()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def observe(self, value: float, count: int = 1) -> None:
+        self._default().observe(value, count)
+
+    def items(self) -> list[tuple[tuple[str, ...], Any]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class Registry:
+    """Thread-safe collection of metric families + scrape-time collectors.
+
+    Collectors are callables run (best-effort) at the top of every
+    ``render()``/``snapshot()``/``summary()`` — the hook device gauges use
+    to sample ``memory_stats()`` only when someone is actually looking.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._families: dict[str, _Family] = {}
+        self._collectors: list[Callable[["Registry"], None]] = []
+
+    # -- family constructors (idempotent) -----------------------------------
+
+    def _family(self, name: str, mtype: str, help: str,
+                labelnames: Iterable[str], **kw) -> _Family:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.type != mtype or fam.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} re-registered as {mtype}"
+                        f"{labelnames} (was {fam.type}{fam.labelnames})"
+                    )
+                return fam
+            fam = _Family(name, mtype, help, labelnames, self._lock, **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Iterable[str] = ()) -> _Family:
+        return self._family(name, "counter", help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Iterable[str] = ()) -> _Family:
+        return self._family(name, "gauge", help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Iterable[str] = (),
+                  buckets: tuple[float, ...] = LATENCY_BUCKETS) -> _Family:
+        return self._family(name, "histogram", help, labelnames,
+                            buckets=tuple(buckets))
+
+    # -- collectors ----------------------------------------------------------
+
+    def add_collector(self, fn: Callable[["Registry"], None]) -> None:
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def remove_collector(self, fn: Callable[["Registry"], None]) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn(self)
+            except Exception:  # a broken collector must not kill the scrape
+                pass
+
+    # -- output --------------------------------------------------------------
+
+    def render(self) -> str:
+        """Prometheus text exposition (content type
+        ``text/plain; version=0.0.4``)."""
+        self._run_collectors()
+        lines: list[str] = []
+        with self._lock:
+            families = sorted(self._families.values(), key=lambda f: f.name)
+        for fam in families:
+            if not fam.items():
+                continue
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.type}")
+            for key, child in fam.items():
+                base = _label_str(fam.labelnames, key)
+                if fam.type in ("counter", "gauge"):
+                    lines.append(f"{fam.name}{base} {_fmt(child.value)}")
+                else:
+                    cum = child.cumulative()
+                    for b, c in zip((*fam.buckets, math.inf), cum):
+                        le = _label_str(fam.labelnames, key,
+                                        extra=(("le", _fmt(b)),))
+                        lines.append(f"{fam.name}_bucket{le} {c}")
+                    lines.append(f"{fam.name}_sum{base} {_fmt(child.sum)}")
+                    lines.append(f"{fam.name}_count{base} {child.count}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict[str, Any]:
+        """Full JSON-friendly dump: every family, every labeled child,
+        histograms with per-bucket cumulative counts."""
+        self._run_collectors()
+        out: dict[str, Any] = {}
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            samples = []
+            for key, child in fam.items():
+                labels = dict(zip(fam.labelnames, key))
+                if fam.type in ("counter", "gauge"):
+                    samples.append({"labels": labels, "value": child.value})
+                else:
+                    samples.append({
+                        "labels": labels,
+                        "sum": child.sum,
+                        "count": child.count,
+                        "buckets": dict(zip(
+                            [_fmt(b) for b in (*fam.buckets, math.inf)],
+                            child.cumulative(),
+                        )),
+                    })
+            if samples:
+                out[fam.name] = {"type": fam.type, "help": fam.help,
+                                 "samples": samples}
+        return out
+
+    def summary(self, prefix: str = "") -> dict[str, Any]:
+        """Compact flat view for result JSON: ``name{labels}`` → value for
+        counters/gauges, ``{count, sum, mean}`` for histograms."""
+        self._run_collectors()
+        out: dict[str, Any] = {}
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            if prefix and not fam.name.startswith(prefix):
+                continue
+            for key, child in fam.items():
+                k = fam.name + _label_str(fam.labelnames, key)
+                if fam.type in ("counter", "gauge"):
+                    out[k] = child.value
+                elif child.count:
+                    out[k] = {
+                        "count": child.count,
+                        "sum": round(child.sum, 6),
+                        "mean": round(child.sum / child.count, 6),
+                    }
+        return out
+
+
+_default_registry = Registry()
+_default_lock = threading.Lock()
+
+
+def get_registry() -> Registry:
+    """The process-wide default registry (what ``/metrics`` serves)."""
+    return _default_registry
+
+
+def set_registry(registry: Registry) -> Registry:
+    """Swap the process default (tests install a fresh one for isolation).
+    Returns the previous default."""
+    global _default_registry
+    with _default_lock:
+        prev, _default_registry = _default_registry, registry
+    return prev

@@ -1,0 +1,248 @@
+"""Request-lifecycle spans for the serving engines.
+
+Every request through ``ContinuousEngine``/``SpeculativeContinuousEngine``
+gets a span tree: ``queued`` (submit → admission start), ``prefill``
+(admission start → splice complete), one ``decode`` span per segment that
+credited it tokens, and a closing ``retire``. Timestamps are
+``time.perf_counter`` values — monotonic within the process, which is what
+span math needs; the flushed record carries a wall-clock ``ts`` anchor.
+
+The tracker is the ONLY clock owner on the engine's request path (edgelint
+EM107 enforces this for ``serve/``/``runtime/``): engines call the
+lifecycle hooks and read the timestamps back off the trace. Each hook both
+extends the span tree and feeds the metrics registry, and ``retire``
+flushes one JSONL record per request (the repo's one-object-per-line
+convention) carrying the raw observations — ``replay_spans`` rebuilds the
+same registry aggregates from the log alone, which is what ``edgemesh obs
+summary``/``prom`` do offline.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Iterable
+
+from edgemesh.obs.metrics import (
+    INTER_TOKEN_BUCKETS,
+    LATENCY_BUCKETS,
+    Registry,
+    get_registry,
+)
+
+SPAN_RECORD_EVENT = "request_spans"
+RESET_RECORD_EVENT = "pool_reset"
+
+
+class RequestTrace:
+    """Mutable per-request span state; owned by the engine's slot/queue."""
+
+    __slots__ = (
+        "rid", "ts_unix", "t_submit", "t_admit_start", "t_start",
+        "t_first_token", "t_last", "t_end", "generated", "segments",
+        "spans", "status", "attrs",
+    )
+
+    def __init__(self, rid: int, t_submit: float):
+        self.rid = rid
+        self.ts_unix = time.time()
+        self.t_submit = t_submit
+        self.t_admit_start: float | None = None
+        self.t_start: float | None = None  # admission (prefill) complete
+        self.t_first_token: float | None = None
+        self.t_last = t_submit  # last lifecycle event, decode-span left edge
+        self.t_end: float | None = None
+        self.generated = 0
+        self.segments = 0
+        self.spans: list[dict[str, Any]] = []
+        self.status: str | None = None
+        self.attrs: dict[str, Any] = {}
+
+    def span(self, name: str, t0: float, t1: float, **attrs: Any) -> None:
+        rec: dict[str, Any] = {"name": name, "t0": t0, "t1": t1}
+        if attrs:
+            rec.update(attrs)
+        self.spans.append(rec)
+
+
+class SpanTracker:
+    """Registry + span-log sink for one engine's request lifecycle."""
+
+    def __init__(self, registry: Registry | None = None,
+                 span_log: str | Path | None = None,
+                 engine: str = "continuous"):
+        self.registry = registry or get_registry()
+        self.engine = engine
+        self._log = None
+        if span_log is not None:
+            from edgemesh.utils.tracing import JsonlLogger
+
+            self._log = JsonlLogger(span_log)
+        reg, eng = self.registry, {"engine": engine}
+        self._submitted = reg.counter(
+            "edgemesh_requests_submitted_total",
+            "Requests accepted by submit()", ("engine",)).labels(**eng)
+        self._completed = reg.counter(
+            "edgemesh_requests_completed_total",
+            "Requests retired, by terminal status", ("engine", "status"))
+        self._tokens = reg.counter(
+            "edgemesh_tokens_generated_total",
+            "Decode tokens credited to requests", ("engine",)).labels(**eng)
+        self._segments = reg.counter(
+            "edgemesh_segments_total",
+            "Pool-wide decode segments dispatched", ("engine",)).labels(**eng)
+        self._queue_wait = reg.histogram(
+            "edgemesh_queue_wait_seconds",
+            "submit() to admission start", ("engine",),
+            buckets=LATENCY_BUCKETS).labels(**eng)
+        self._prefill = reg.histogram(
+            "edgemesh_prefill_seconds",
+            "Admission prefill dispatch + splice wall time", ("engine",),
+            buckets=LATENCY_BUCKETS).labels(**eng)
+        self._ttft = reg.histogram(
+            "edgemesh_ttft_seconds",
+            "submit() to first decoded token", ("engine",),
+            buckets=LATENCY_BUCKETS).labels(**eng)
+        self._itl = reg.histogram(
+            "edgemesh_inter_token_seconds",
+            "Mean per-token decode latency after the first token",
+            ("engine",), buckets=INTER_TOKEN_BUCKETS).labels(**eng)
+        self._latency = reg.histogram(
+            "edgemesh_request_latency_seconds",
+            "submit() to retirement", ("engine",),
+            buckets=LATENCY_BUCKETS).labels(**eng)
+        self._resets = reg.counter(
+            "edgemesh_pool_resets_total",
+            "KV pool rebuilds (failed segment/admission recovery)",
+            ("engine",)).labels(**eng)
+
+    # -- lifecycle hooks (the engine's only clock) ---------------------------
+
+    def now(self) -> float:
+        return time.perf_counter()
+
+    def submit(self, rid: int) -> RequestTrace:
+        trace = RequestTrace(rid, self.now())
+        self._submitted.inc()
+        return trace
+
+    def admit_start(self, trace: RequestTrace) -> None:
+        """Admission picked the request off the queue (re-run on paged
+        capacity re-queues — the last attempt wins the prefill span)."""
+        trace.t_admit_start = self.now()
+
+    def admitted(self, trace: RequestTrace, **attrs: Any) -> None:
+        """Prefill spliced; the request is live in a slot."""
+        now = self.now()
+        t_adm = trace.t_admit_start if trace.t_admit_start is not None else now
+        trace.span("queued", trace.t_submit, t_adm)
+        trace.span("prefill", t_adm, now, **attrs)
+        trace.t_start = now
+        trace.t_last = now
+        trace.attrs.update(attrs)
+        self._queue_wait.observe(t_adm - trace.t_submit)
+        self._prefill.observe(now - t_adm)
+
+    def segment_dispatched(self) -> None:
+        self._segments.inc()
+
+    def tokens(self, trace: RequestTrace, n: int) -> None:
+        """A drained segment credited ``n`` decode tokens to this request."""
+        now = self.now()
+        if n > 0 and trace.t_first_token is None:
+            trace.t_first_token = now
+            self._ttft.observe(now - trace.t_submit)
+        trace.span("decode", trace.t_last, now, tokens=int(n))
+        trace.segments += 1
+        trace.generated += int(n)
+        trace.t_last = now
+        if n > 0:
+            self._tokens.inc(n)
+
+    def retire(self, trace: RequestTrace, status: str = "ok") -> float:
+        """Close the trace, feed terminal aggregates, flush the JSONL record.
+        Returns the retirement timestamp (the engine's ``t_end``)."""
+        now = self.now()
+        trace.t_end = now
+        trace.status = status
+        trace.span("retire", now, now)
+        self._completed.labels(engine=self.engine, status=status).inc()
+        itl = None
+        if trace.t_first_token is not None and trace.generated > 1:
+            itl = (now - trace.t_first_token) / (trace.generated - 1)
+            self._itl.observe(itl, count=trace.generated - 1)
+        self._latency.observe(now - trace.t_submit)
+        if self._log is not None:
+            ttft = (
+                None if trace.t_first_token is None
+                else trace.t_first_token - trace.t_submit
+            )
+            self._log.log(
+                SPAN_RECORD_EVENT,
+                rid=trace.rid, engine=self.engine, status=status,
+                generated=trace.generated, segments=trace.segments,
+                queue_s=(
+                    None if trace.t_admit_start is None
+                    else trace.t_admit_start - trace.t_submit
+                ),
+                prefill_s=(
+                    None if trace.t_start is None or trace.t_admit_start is None
+                    else trace.t_start - trace.t_admit_start
+                ),
+                ttft_s=ttft, itl_s=itl, latency_s=now - trace.t_submit,
+                spans=trace.spans, **trace.attrs,
+            )
+        return now
+
+    def pool_reset(self, reason: str = "") -> None:
+        self._resets.inc()
+        if self._log is not None:
+            self._log.log(RESET_RECORD_EVENT, engine=self.engine,
+                          reason=reason)
+
+
+def replay_spans(records: Iterable[dict] | str | Path,
+                 registry: Registry | None = None) -> Registry:
+    """Rebuild request-level registry aggregates from a span JSONL log.
+
+    Accepts a path (read via ``JsonlLogger`` — torn trailing lines are
+    skipped, not fatal) or an iterable of decoded records. Segment counters
+    are pool-wide engine state and do not replay; everything observed per
+    request (queue wait, prefill, TTFT, inter-token, latency, tokens,
+    completions, pool resets) does — ``edgemesh obs summary`` and a live
+    scrape agree on those families by construction.
+    """
+    registry = registry if registry is not None else Registry()
+    trackers: dict[str, SpanTracker] = {}
+    if isinstance(records, (str, Path)):
+        from edgemesh.utils.tracing import JsonlLogger
+
+        records = JsonlLogger(records).read()
+    for rec in records:
+        engine = rec.get("engine", "continuous")
+        tr = trackers.get(engine)
+        if tr is None:
+            tr = trackers[engine] = SpanTracker(registry, engine=engine)
+        event = rec.get("event")
+        if event == RESET_RECORD_EVENT:
+            tr._resets.inc()
+            continue
+        if event != SPAN_RECORD_EVENT:
+            continue
+        tr._submitted.inc()
+        tr._completed.labels(
+            engine=engine, status=rec.get("status") or "ok").inc()
+        gen = int(rec.get("generated") or 0)
+        if gen:
+            tr._tokens.inc(gen)
+        if rec.get("queue_s") is not None:
+            tr._queue_wait.observe(rec["queue_s"])
+        if rec.get("prefill_s") is not None:
+            tr._prefill.observe(rec["prefill_s"])
+        if rec.get("ttft_s") is not None:
+            tr._ttft.observe(rec["ttft_s"])
+        if rec.get("itl_s") is not None and gen > 1:
+            tr._itl.observe(rec["itl_s"], count=gen - 1)
+        if rec.get("latency_s") is not None:
+            tr._latency.observe(rec["latency_s"])
+    return registry

@@ -88,10 +88,11 @@ class DynamicBatcher:
             if not self._queue:
                 return []
             # Linger briefly for stragglers when under-filled; requests that
-            # arrive during the linger join THIS batch.
-            deadline = time.monotonic() + self.max_wait_s
+            # arrive during the linger join THIS batch. (EM107: these clocks
+            # are wait control flow, not a latency measurement.)
+            deadline = time.monotonic() + self.max_wait_s  # edgelint: disable=EM107
             while len(self._queue) < self.max_batch:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - time.monotonic()  # edgelint: disable=EM107
                 if remaining <= 0 or self._closed:
                     break
                 self._cond.wait(timeout=remaining)

@@ -62,7 +62,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
@@ -76,6 +75,7 @@ from functools import partial
 import numpy as np
 
 from edgemesh.models.transformer import KVCache, forward_decode, forward_prefill, init_kv_cache
+from edgemesh.obs import RequestTrace, SpanTracker
 from edgemesh.ops.sampling import TokenMaskState
 from edgemesh.runtime.generate import _decode_loop
 from edgemesh.runtime.paged_generate import (
@@ -223,6 +223,7 @@ class _Slot:
     remaining: int = 0
     t_submit: float = 0.0
     t_start: float = 0.0
+    trace: Any = None  # obs.RequestTrace — the request's span tree
     pages: list[int] = field(default_factory=list)  # paged: private pages held
     # Speculative engine: how many of the row's accumulated out-tokens have
     # already been emitted (the spec state's `out` grows in place; the
@@ -256,6 +257,9 @@ def _start_host_copy(handles) -> None:
 class ContinuousEngine:
     """Chunk-granular continuous batcher over one Agent's model."""
 
+    # Low-cardinality `engine` label for every obs metric this engine feeds.
+    obs_engine_label = "continuous"
+
     def __init__(
         self,
         agent,
@@ -266,6 +270,8 @@ class ContinuousEngine:
         page_size: int = 64,
         total_pages: int | None = None,
         admission: str = "fifo",
+        span_log=None,
+        registry=None,
     ):
         self.agent = agent
         self.cfg = agent.cfg
@@ -291,7 +297,7 @@ class ContinuousEngine:
         if self._paged and int(page_size) < 1:
             raise ValueError("page_size must be >= 1")
         self.kv_backend = kv_backend
-        self._queue: deque[tuple[str, Future, float, int | None]] = deque()
+        self._queue: deque[tuple[str, Future, RequestTrace, int | None]] = deque()
         self._cond = threading.Condition()
         self._closed = False
         self._slots = [_Slot() for _ in range(self.n_slots)]
@@ -346,11 +352,25 @@ class ContinuousEngine:
         self._finished = jnp.ones((self.n_slots,), bool)  # all slots idle
         self._rng = jax.random.PRNGKey(agent.sampling.seed)
         self._bridge = _make_bridge(self._decode_fn)
-        # Stats for /metrics and tests.
+        # Stats for /stats and tests; the obs tracker feeds /metrics —
+        # request-lifecycle spans (queued→prefill→decode→retire), latency
+        # histograms, and the KV page gauges below. ``span_log`` (a JSONL
+        # path) additionally flushes one span record per retired request.
         self.requests = 0
         self.segments = 0
         self.admitted_mid_flight = 0
         self.max_concurrent = 0
+        self.obs = SpanTracker(registry, span_log, engine=self.obs_engine_label)
+        self._pages_gauge = self.obs.registry.gauge(
+            "edgemesh_kv_pages", "Paged KV pool occupancy by state",
+            ("engine", "state"),
+        )
+        self._prefix_hits_counter = self.obs.registry.counter(
+            "edgemesh_shared_prefix_hits_total",
+            "Admissions warm-started from the shared template prefix",
+            ("engine",),
+        ).labels(engine=self.obs_engine_label)
+        self._update_page_gauges()
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -369,7 +389,8 @@ class ContinuousEngine:
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine is closed")
-            self._queue.append((question, fut, time.perf_counter(), max_new))
+            trace = self.obs.submit(self.requests)  # rid = arrival index
+            self._queue.append((question, fut, trace, max_new))
             self.requests += 1
             self._cond.notify()
         return fut
@@ -384,33 +405,55 @@ class ContinuousEngine:
         self._worker.join(timeout=10)
 
     def stats(self) -> dict[str, Any]:
-        out = {
-            "requests": self.requests,
-            "segments": self.segments,
-            "admitted_mid_flight": self.admitted_mid_flight,
-            "max_concurrent": self.max_concurrent,
-            "slots": self.n_slots,
-            "chunk": self.chunk,
-            "kv_backend": self.kv_backend,
-        }
-        if self._paged:
-            out["total_pages"] = self.total_pages
-            out["reserved_pages"] = self._reserved_pages
-            out["free_pages"] = len(self._free_pages)
-            out["template_pages"] = len(self._template_pages)
-            out["shared_prefix_hits"] = self.shared_prefix_hits
-        return out
+        # Under the engine lock: the worker mutates counters and the paged
+        # free list mid-segment, and an unlocked read could pair a new
+        # reserved_pages with an old free list (torn snapshot). _cond's
+        # underlying lock is an RLock, so the subclass extending this under
+        # the same lock nests fine.
+        with self._cond:
+            out = {
+                "requests": self.requests,
+                "segments": self.segments,
+                "admitted_mid_flight": self.admitted_mid_flight,
+                "max_concurrent": self.max_concurrent,
+                "slots": self.n_slots,
+                "chunk": self.chunk,
+                "kv_backend": self.kv_backend,
+            }
+            if self._paged:
+                out["total_pages"] = self.total_pages
+                out["reserved_pages"] = self._reserved_pages
+                out["free_pages"] = len(self._free_pages)
+                out["template_pages"] = len(self._template_pages)
+                out["shared_prefix_hits"] = self.shared_prefix_hits
+            return out
+
+    def _update_page_gauges(self) -> None:
+        """Refresh the KV page-occupancy gauges (paged backends only).
+        Called wherever the free list changes: admission, retirement,
+        template install, pool reset."""
+        if not self._paged:
+            return
+        g, eng = self._pages_gauge, self.obs_engine_label
+        g.labels(engine=eng, state="total").set(self.total_pages)
+        g.labels(engine=eng, state="free").set(len(self._free_pages))
+        g.labels(engine=eng, state="reserved").set(self._reserved_pages)
+        g.labels(engine=eng, state="template").set(len(self._template_pages))
 
     # -- host-owned page accounting -----------------------------------------
 
     def _pop_pages(self, n: int) -> list[int]:
-        taken = [self._free_pages.pop() for _ in range(n)]
-        self._reserved_pages += n
+        # Under the engine lock so the (free list, reserved count) pair
+        # mutates atomically with respect to a concurrent stats() snapshot.
+        with self._cond:
+            taken = [self._free_pages.pop() for _ in range(n)]
+            self._reserved_pages += n
         return taken
 
     def _push_pages(self, pages: list[int]) -> None:
-        self._free_pages.extend(pages)
-        self._reserved_pages -= len(pages)
+        with self._cond:
+            self._free_pages.extend(pages)
+            self._reserved_pages -= len(pages)
 
     def _build_row_table(self, shared: list[int], private: list[int]) -> np.ndarray:
         """Pre-mapped table row: shared (template) pages first, then the
@@ -428,13 +471,14 @@ class ContinuousEngine:
 
     # -- engine loop --------------------------------------------------------
 
-    def _admit(self, idx: int, question: str, fut: Future, t_submit: float,
+    def _admit(self, idx: int, question: str, fut: Future, trace,
                mid_flight: bool, max_new: int | None = None) -> bool:
         """Prefill one request and splice its state into slot ``idx``.
 
         Returns False when a paged backend lacks free pages for the request's
         worst case (the caller re-queues it — capacity, not failure)."""
         agent = self.agent
+        self.obs.admit_start(trace)
         prompt = agent.format_prompt(question)
         tokens, lengths, _ = agent._prepare_batch([prompt])
         plen = int(lengths[0])
@@ -562,6 +606,7 @@ class ContinuousEngine:
                         jnp.asarray([match], jnp.int32),
                     )
                     self.shared_prefix_hits += 1
+                    self._prefix_hits_counter.inc()
                     cache = _splice_row_entries(self._cache, row, idx)
                 else:
                     row_table = self._build_row_table([], pages)
@@ -585,11 +630,17 @@ class ContinuousEngine:
             self._mask = self._mask.at[idx].set(mask1[0])
             self._finished = self._finished.at[idx].set(False)
 
+        self.obs.admitted(
+            trace, prompt_tokens=plen,
+            shared_prefix_hit=bool(self._paged and match),
+        )
         self._slots[idx] = _Slot(
             future=fut, question=question, emitted=[], remaining=budget,
-            t_submit=t_submit, t_start=time.perf_counter(), pages=pages,
+            t_submit=trace.t_submit, t_start=trace.t_start, trace=trace,
+            pages=pages,
         )
         self._gen[idx] += 1
+        self._update_page_gauges()
         if mid_flight:
             self.admitted_mid_flight += 1
         return True
@@ -633,7 +684,8 @@ class ContinuousEngine:
                 n_pages, len(self._free_pages) - n_pages, self._per_row_worst,
             )
             return
-        tpl_pages = [self._free_pages.pop() for _ in range(n_pages)]
+        with self._cond:
+            tpl_pages = [self._free_pages.pop() for _ in range(n_pages)]
         row_view = self._cache._replace(
             page_table=jnp.asarray(
                 self._build_row_table(tpl_pages, []))[None, :],
@@ -686,10 +738,13 @@ class ContinuousEngine:
         — fresh zeroed arrays for EVERY donated buffer (cache + repetition
         mask), safe even when the old ones were invalidated by a failed
         donated prefill or segment. One recovery path for both backends."""
+        self.obs.pool_reset(reason=str(exc))
         for i, s in enumerate(self._slots):
             if s.active:
                 if not s.future.done():
                     s.future.set_exception(exc)
+                if s.trace is not None:
+                    self.obs.retire(s.trace, status="preempted")
                 self._slots[i] = _Slot()
                 self._gen[i] += 1
         self._finished = jnp.ones((self.n_slots,), bool)
@@ -702,15 +757,21 @@ class ContinuousEngine:
                 self.cfg, self.n_slots, self.cfg.max_seq_len
             )
         else:
-            self._cache, self._free_pages = _parked_pool(
+            cache, free = _parked_pool(
                 self._init_pool, self.n_slots, self.total_pages
             )
-            self._reserved_pages = 0
-            # Template pages died with the pool; rebuild lazily on the next
-            # admission (the capacity bump is one-time and survives).
-            self._template_ids = None
-            self._template_pages = []
+            # Free list + reserved count swap atomically under the engine
+            # lock (device work above stays outside it).
+            with self._cond:
+                self._cache = cache
+                self._free_pages = free
+                self._reserved_pages = 0
+                # Template pages died with the pool; rebuild lazily on the
+                # next admission (the capacity bump is one-time, survives).
+                self._template_ids = None
+                self._template_pages = []
         self._mask = TokenMaskState.init(self.n_slots, self.cfg.vocab_size).mask
+        self._update_page_gauges()
 
     def _retire(self, idx: int):
         slot = self._slots[idx]
@@ -720,7 +781,7 @@ class ContinuousEngine:
         # decode's per-element int() a device readback EACH (~0.13s over the
         # tunnel): ~4s per retired request, 33s of a 36s serving wave.
         text = tokenizer.decode(slot.emitted) if slot.emitted else ""
-        now = time.perf_counter()
+        now = self.obs.retire(slot.trace, status="ok")
         wall = max(now - slot.t_start, 1e-9)
         slot.future.set_result(
             {
@@ -736,6 +797,7 @@ class ContinuousEngine:
         if self._paged:
             self._push_pages(slot.pages)
             self._park_slot_device(idx)
+            self._update_page_gauges()
         self._slots[idx] = _Slot()
         self._gen[idx] += 1
         self._finished = self._finished.at[idx].set(True)
@@ -756,6 +818,7 @@ class ContinuousEngine:
         )
         self._mask, self._finished = mask, fin
         self.segments += 1
+        self.obs.segment_dispatched()
         # Bridge into the next segment unconditionally: rows that turn out
         # to have finished get frozen lengths (finished-aware bridge) and a
         # masked garbage write. The alternative — waiting to know whether
@@ -802,6 +865,7 @@ class ContinuousEngine:
                 toks = toks[:-1]
             slot.emitted.extend(toks)
             slot.remaining -= n
+            self.obs.tokens(slot.trace, len(toks))
             if bool(fin_h[i]) or slot.remaining <= 0:
                 self._retire(i)
 
@@ -835,14 +899,14 @@ class ContinuousEngine:
                             len(it[0]),
                         ),
                     ))
-                pending: list[tuple[str, Future, float, int | None]] = []
+                pending: list[tuple[str, Future, RequestTrace, int | None]] = []
                 while self._queue and len(pending) < len(free):
                     pending.append(self._queue.popleft())
             free_now = [i for i, s in enumerate(self._slots) if not s.active]
             mid = any(s.active for s in self._slots) or inflight is not None
-            for pos, ((q, fut, ts, req_max), idx) in enumerate(zip(pending, free_now)):
+            for pos, ((q, fut, trace, req_max), idx) in enumerate(zip(pending, free_now)):
                 try:
-                    ok = self._admit(idx, q, fut, ts, mid_flight=mid,
+                    ok = self._admit(idx, q, fut, trace, mid_flight=mid,
                                      max_new=req_max)
                 except Exception as exc:
                     # Fail only THIS request: already-admitted slots keep
@@ -850,6 +914,7 @@ class ContinuousEngine:
                     # later _retire set_result raise InvalidStateError and
                     # kill the worker).
                     log.exception("admission failed for %r", q[:80])
+                    self.obs.retire(trace, status="error")
                     if not fut.done():
                         fut.set_exception(exc)
                     continue
@@ -921,6 +986,8 @@ class SpeculativeContinuousEngine(ContinuousEngine):
       is token-identical to the plain engine (pinned in tests).
     """
 
+    obs_engine_label = "speculative"
+
     def __init__(
         self,
         agent,
@@ -932,6 +999,8 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         total_pages: int | None = None,
         draft_total_pages: int | None = None,
         admission: str = "fifo",
+        span_log=None,
+        registry=None,
     ):
         if getattr(agent, "draft_cfg", None) is None:
             raise ValueError(
@@ -970,7 +1039,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         super().__init__(
             agent, slots=slots, chunk=chunk, idle_wait_s=idle_wait_s,
             kv_backend=kv_backend, page_size=page_size, total_pages=total_pages,
-            admission=admission,
+            admission=admission, span_log=span_log, registry=registry,
         )
         # The worker thread is live from here on: a failure below would
         # orphan it blocked on the condition with a half-built engine —
@@ -1019,8 +1088,37 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         # Host mirror of (accepted, proposed, rounds), refreshed by the
         # worker inside each segment's bulk fetch. stats() reads ONLY this:
         # the device counters are donated every segment, so touching them
-        # from another thread (REST /metrics) races use-after-donate.
+        # from another thread (REST /stats) races use-after-donate.
         self._spec_counters_host = (0, 0, 0)
+        self._update_spec_gauges()
+
+    def _update_spec_gauges(self) -> None:
+        """Mirror the cumulative draft→verify counters into obs gauges
+        (gauges, not counters: the device counters reset with the pool)."""
+        reg, eng = self.obs.registry, self.obs_engine_label
+        acc, prop, rnds = self._spec_counters_host
+        toks = reg.gauge(
+            "edgemesh_spec_tokens", "Cumulative speculative draft tokens",
+            ("engine", "kind"),
+        )
+        toks.labels(engine=eng, kind="accepted").set(acc)
+        toks.labels(engine=eng, kind="proposed").set(prop)
+        reg.gauge(
+            "edgemesh_spec_rounds", "Cumulative draft→verify rounds",
+            ("engine",),
+        ).labels(engine=eng).set(rnds)
+        reg.gauge(
+            "edgemesh_spec_acceptance_ratio",
+            "accepted / proposed draft tokens", ("engine",),
+        ).labels(engine=eng).set(acc / prop if prop else 0.0)
+
+    def _update_page_gauges(self) -> None:
+        super()._update_page_gauges()
+        if not hasattr(self, "_dfree"):  # base __init__ runs before spec's
+            return
+        g, eng = self._pages_gauge, self.obs_engine_label
+        g.labels(engine=eng, state="draft_total").set(self._d_total)
+        g.labels(engine=eng, state="draft_free").set(len(self._dfree))
 
     # Spec admissions are always cold — see the class docstring.
     def _ensure_template(self) -> None:
@@ -1038,7 +1136,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             )
         return super().submit(question)
 
-    def _admit(self, idx: int, question: str, fut: Future, t_submit: float,
+    def _admit(self, idx: int, question: str, fut: Future, trace,
                mid_flight: bool, max_new: int | None = None) -> bool:
         if max_new is not None:
             # The spec rounds body runs ONE static max_new for the whole
@@ -1049,6 +1147,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
                 "per-request max_new is not supported"
             )
         agent = self.agent
+        self.obs.admit_start(trace)
         eos_id = int(getattr(agent.tokenizer, "eos_id", -1))
         prompt = agent.format_prompt(question)
         tokens, lengths, _ = agent._prepare_batch([prompt])
@@ -1119,13 +1218,15 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         self._conf = self._conf.at[idx].set(row.conf_sum[0])
         self._mask = self._mask.at[idx].set(row.mask[0])
         self._finished = self._finished.at[idx].set(row.finished[0])
+        self.obs.admitted(trace, prompt_tokens=plen)
         self._slots[idx] = _Slot(
             future=fut, question=question, emitted=[], remaining=self.max_new,
-            t_submit=t_submit, t_start=time.perf_counter(),
+            t_submit=trace.t_submit, t_start=trace.t_start, trace=trace,
             pages=pages, taken=0,
         )
         self._dslot_pages[idx] = dpages
         self._gen[idx] += 1
+        self._update_page_gauges()
         if mid_flight:
             self.admitted_mid_flight += 1
         return True
@@ -1152,6 +1253,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
          self._finished, self._mask, _, self._conf, self._acc, self._prop,
          self._rnds) = state
         self.segments += 1
+        self.obs.segment_dispatched()
         # Detach every fetched handle from the state buffers: the NEXT
         # segment's _spec_rounds_donated donates the whole state, which
         # would delete these mid-fetch (+0 / double-not copy).
@@ -1167,6 +1269,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         fetched = jax.device_get(seg.handles)
         nemit_h, out_h, fin_h, acc_h, prop_h, rnds_h, ft_t, ft_d = fetched
         self._spec_counters_host = (int(acc_h), int(prop_h), int(rnds_h))
+        self._update_spec_gauges()
         if int(ft_t) != 1 or int(ft_d) != 1:
             # Same contract as the base engine: a popped page is also on a
             # host free list → double-mapping hazard. Raise so _run resets
@@ -1184,6 +1287,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             if toks and toks[-1] == eos_id:
                 toks = toks[:-1]
             slot.emitted.extend(toks)
+            self.obs.tokens(slot.trace, len(toks))
             slot.taken = total
             slot.remaining = self.max_new - total
             if bool(fin_h[i]) or total >= self.max_new:
@@ -1196,6 +1300,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             page_table=self._dcache.page_table.at[idx].set(0),
             lengths=self._dcache.lengths.at[idx].set(1),
         )
+        self._update_page_gauges()
 
     def _reset_pool(self, exc: Exception) -> None:
         super()._reset_pool(exc)
@@ -1207,6 +1312,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             )
             self._dslot_pages = {}
             self._spec_reset_arrays()
+            self._update_page_gauges()
 
     def stats(self) -> dict:
         out = super().stats()

@@ -8,6 +8,11 @@ kick-off. Stdlib ``http.server`` — zero extra dependencies, threaded.
 
 Endpoints:
 - ``GET  /``          → health + device inventory (the "edge cluster map")
+- ``GET  /metrics``   → Prometheus text exposition (edgemesh.obs registry:
+  request/TTFT/inter-token histograms, KV page + device-memory gauges)
+- ``GET  /stats``     → the legacy JSON status blob (phases, supervisor
+  health, batcher/engine stats) — what ``/metrics`` served pre-obs
+- ``GET  /statusz``   → human-readable one-page status (plain text)
 - ``POST /generate``  → {"question": str} → ensemble answer JSON
 - ``POST /generate_stream`` → Server-Sent Events: ``data: {"delta": ...}``
   per decoded chunk, then ``data: {"answer": ..., "done": true}``
@@ -24,7 +29,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 log = logging.getLogger("edgemesh.serve")
 
 
-def _make_handler(ensemble, supervisor=None, batcher=None):
+def _make_handler(ensemble, supervisor=None, batcher=None, registry=None):
+    from edgemesh.obs import get_registry
+
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict):
             body = json.dumps(payload).encode()
@@ -33,6 +40,25 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _send_text(self, code: int, text: str,
+                       content_type: str = "text/plain; charset=utf-8"):
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _stats_payload(self) -> dict:
+            from edgemesh.utils.tracing import phase_report
+
+            payload = {"phases": phase_report()}
+            if supervisor is not None:
+                payload["supervisor"] = supervisor.health()
+            if batcher is not None:
+                payload["batcher"] = batcher.stats()
+            return payload
 
         def do_GET(self):
             if self.path in ("/", "/health"):
@@ -50,14 +76,20 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
                     },
                 )
             elif self.path == "/metrics":
-                from edgemesh.utils.tracing import phase_report
-
-                payload = {"phases": phase_report()}
-                if supervisor is not None:
-                    payload["supervisor"] = supervisor.health()
-                if batcher is not None:
-                    payload["batcher"] = batcher.stats()
-                self._send(200, payload)
+                # Prometheus text exposition from the obs registry (device
+                # gauges sample inside render() via the registered
+                # collector). The pre-obs JSON blob moved to /stats.
+                self._send_text(
+                    200, (registry or get_registry()).render(),
+                    content_type="text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif self.path == "/stats":
+                self._send(200, self._stats_payload())
+            elif self.path == "/statusz":
+                self._send_text(200, _render_statusz(
+                    ensemble, self._stats_payload(),
+                    registry or get_registry(),
+                ))
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -180,10 +212,56 @@ def _make_handler(ensemble, supervisor=None, batcher=None):
     return Handler
 
 
+def _render_statusz(ensemble, stats: dict, registry) -> str:
+    """One human-readable page: who is serving, how it is doing. Plain text
+    — statusz is for a person mid-incident, not a scraper."""
+    lines = ["edgemesh statusz", "================", ""]
+    agents = [a.role for a in ensemble.qa_agents] + (
+        [ensemble.refiner.role] if ensemble.refiner else []
+    )
+    lines.append(f"agents: {', '.join(agents) or '(none)'}")
+    sup = stats.get("supervisor")
+    if sup:
+        lines.append(
+            f"supervisor: {'healthy' if sup.get('healthy') else 'DEGRADED'} "
+            f"requests={sup.get('total_requests')} "
+            f"failures={sup.get('total_failures')} "
+            f"restarts={sup.get('restarts')}"
+        )
+    eng = stats.get("batcher")
+    if eng:
+        lines.append("engine: " + " ".join(
+            f"{k}={v}" for k, v in eng.items() if not isinstance(v, dict)
+        ))
+    phases = stats.get("phases") or {}
+    if phases:
+        lines.append("")
+        lines.append("phases (trace() regions):")
+        for name, rep in sorted(phases.items()):
+            lines.append(
+                f"  {name}: n={rep['count']} total={rep['total_s']:.3f}s "
+                f"mean={rep['mean_s'] * 1e3:.1f}ms"
+            )
+    summary = registry.summary()
+    if summary:
+        lines.append("")
+        lines.append("metrics (obs registry):")
+        for key in sorted(summary):
+            v = summary[key]
+            if isinstance(v, dict):
+                lines.append(
+                    f"  {key}: count={v['count']} mean={v['mean'] * 1e3:.1f}ms"
+                )
+            else:
+                lines.append(f"  {key}: {v:g}")
+    return "\n".join(lines) + "\n"
+
+
 def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = True,
                supervisor=None, batch: int = 0, batch_wait_s: float = 0.02,
                continuous: bool = False, kv_backend: str = "dense",
-               kv_page_size: int = 64, admission: str = "fifo"):
+               kv_page_size: int = 64, admission: str = "fifo",
+               span_log=None, registry=None):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
@@ -203,8 +281,21 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     memory model — the paged pool gives zero-copy admission and page
     reclamation (serve/continuous.py module docstring). ``admission``
     ("fifo" | "sjf") picks the engine's queue policy; /generate accepts an
-    optional per-request ``max_new`` budget under continuous serving."""
+    optional per-request ``max_new`` budget under continuous serving.
+
+    ``span_log`` (a JSONL path, continuous only) flushes one request-span
+    record per retirement — replayable offline via ``edgemesh obs``.
+    ``registry`` overrides the process-default obs registry that /metrics
+    and /statusz read (tests isolate through it)."""
+    from edgemesh.obs import register_device_gauges
+
+    register_device_gauges(registry)
     batcher = None
+    if span_log is not None and not continuous:
+        raise ValueError(
+            "span_log requires continuous=True (request-lifecycle spans "
+            "live in the ContinuousEngine)"
+        )
     if kv_backend != "dense" and not continuous:
         raise ValueError(
             f"kv_backend={kv_backend!r} requires continuous=True (the paged "
@@ -237,14 +328,17 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
         # engine (pool-wide draft→verify rounds); otherwise the plain one.
         batcher = make_engine(
             ensemble.qa_agents[0], slots=batch or 8, kv_backend=kv_backend,
-            page_size=kv_page_size, admission=admission,
+            page_size=kv_page_size, admission=admission, span_log=span_log,
+            registry=registry,
         )
     elif batch > 1:
         from edgemesh.serve.batcher import DynamicBatcher
 
         backend = ensemble.answer_batch if supervisor is None else supervisor.call
         batcher = DynamicBatcher(backend, max_batch=batch, max_wait_s=batch_wait_s)
-    server = ThreadingHTTPServer((host, port), _make_handler(ensemble, supervisor, batcher))
+    server = ThreadingHTTPServer(
+        (host, port), _make_handler(ensemble, supervisor, batcher, registry)
+    )
     # Expose the batcher/engine for lifecycle management: srv.shutdown()
     # stops only the HTTP loop — an engine's resident worker thread and
     # KV pools need srv.batcher.close() (tests and embedders rely on it).

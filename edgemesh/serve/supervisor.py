@@ -49,7 +49,10 @@ class Supervisor:
         max_restarts: int = 5,
         event_log: str | Path | None = None,
         latency_window: int = 100,
+        registry=None,
     ):
+        from edgemesh.obs import get_registry
+
         self._factory = factory
         self._handler = handler
         self._max_fail = max_consecutive_failures
@@ -57,6 +60,18 @@ class Supervisor:
         self._logger = JsonlLogger(event_log) if event_log else None
         self._lock = threading.Lock()
         self._restart_in_progress = False
+        # Lifecycle events as labeled counters (start/request_failed/restart/
+        # restart_ok/restart_failed/degraded) + a request-latency histogram —
+        # the /metrics view of the health dict below.
+        reg = registry or get_registry()
+        self._events_counter = reg.counter(
+            "edgemesh_supervisor_events_total",
+            "Supervisor lifecycle events by kind", ("kind",),
+        )
+        self._latency_hist = reg.histogram(
+            "edgemesh_supervisor_request_seconds",
+            "Supervised request wall time (successes only)",
+        )
 
         self.backend = factory()
         self.consecutive_failures = 0
@@ -90,6 +105,7 @@ class Supervisor:
             }
 
     def _event(self, kind: str, **extra):
+        self._events_counter.labels(kind=kind).inc()
         if self._logger is not None:
             self._logger.log(kind, **extra)
 
@@ -106,7 +122,9 @@ class Supervisor:
         one-request handler shape (e.g. consuming a whole SSE stream)."""
         with self._lock:
             self.total_requests += 1
-        t0 = time.perf_counter()
+        # Feeds the obs request-latency histogram below (EM107: this clock
+        # IS the obs instrumentation, not a bypass of it).
+        t0 = time.perf_counter()  # edgelint: disable=EM107
         try:
             result = fn()
         except Exception as exc:
@@ -117,7 +135,7 @@ class Supervisor:
                 # request's error even if a concurrent failure overwrites
                 # self.last_error in the meantime.
                 error = self.last_error = f"{type(exc).__name__}: {exc}"
-                self.last_failure_ts = time.time()
+                self.last_failure_ts = time.time()  # edgelint: disable=EM107
                 # One restart per incident: the thread that trips the
                 # threshold claims the restart; concurrent failures while it
                 # is rebuilding must not burn extra budget.
@@ -136,10 +154,12 @@ class Supervisor:
                     with self._lock:
                         self._restart_in_progress = False
             raise
+        latency = time.perf_counter() - t0  # edgelint: disable=EM107
         with self._lock:
             self.consecutive_failures = 0
-            self.last_success_ts = time.time()
-            self._latencies.append(time.perf_counter() - t0)
+            self.last_success_ts = time.time()  # edgelint: disable=EM107
+            self._latencies.append(latency)
+        self._latency_hist.observe(latency)
         return result
 
     def restart(self, reason: str = "manual") -> bool:

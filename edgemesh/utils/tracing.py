@@ -19,12 +19,15 @@ and log lines pasted into a spreadsheet (``combiner_fp.py:336-350``,
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 from pathlib import Path
 from typing import Any
+
+log = logging.getLogger("edgemesh.obs")
 
 _lock = threading.Lock()
 _phase_totals: dict[str, float] = defaultdict(float)
@@ -33,7 +36,12 @@ _phase_counts: dict[str, int] = defaultdict(int)
 
 @contextmanager
 def trace(name: str):
-    """Annotate a region for the JAX profiler AND accumulate its wall time."""
+    """Annotate a region for the JAX profiler AND accumulate its wall time
+    (both the process-local phase registry below and the PROCESS-DEFAULT obs
+    registry's ``edgemesh_phase_seconds`` histogram — trace() regions have
+    no registry handle, so a ``serve_rest(registry=...)`` override renders
+    phases only when it IS the process default; ``/stats``'s ``phases`` key
+    always carries them)."""
     import jax
 
     t0 = time.perf_counter()
@@ -45,6 +53,12 @@ def trace(name: str):
             with _lock:
                 _phase_totals[name] += dt
                 _phase_counts[name] += 1
+            from edgemesh.obs.metrics import get_registry
+
+            get_registry().histogram(
+                "edgemesh_phase_seconds",
+                "trace() region wall time by phase", ("phase",)
+            ).labels(phase=name).observe(dt)
 
 
 def phase_report() -> dict[str, dict[str, float]]:
@@ -87,6 +101,10 @@ class JsonlLogger:
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
+        #: malformed lines skipped by the most recent ``read()`` — a torn
+        #: write from a crashed process is data loss worth surfacing, not a
+        #: reason the whole log becomes unreadable.
+        self.malformed = 0
 
     def log(self, event: str, **fields: Any) -> None:
         record = {"ts": time.time(), "event": event, **fields}
@@ -95,7 +113,24 @@ class JsonlLogger:
                 f.write(json.dumps(record) + "\n")
 
     def read(self) -> list[dict]:
+        """Every parseable record. A truncated/partial line (torn write —
+        e.g. the process died mid-``f.write``) is skipped and counted in
+        ``self.malformed`` instead of raising and losing the whole log."""
         if not self.path.exists():
+            self.malformed = 0
             return []
+        records: list[dict] = []
+        bad = 0
         with open(self.path) as f:
-            return [json.loads(line) for line in f if line.strip()]
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    bad += 1
+        self.malformed = bad
+        if bad:
+            log.warning("%s: skipped %d malformed line(s)", self.path, bad)
+        return records

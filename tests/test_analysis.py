@@ -236,6 +236,42 @@ def test_em106_quiet_outside_jit():
 
 
 # ---------------------------------------------------------------------------
+# EM107 raw-timing-in-serving
+# ---------------------------------------------------------------------------
+
+_EM107_SRC = (
+    "import time\n"
+    "def handle(req):\n"
+    "    t0 = time.perf_counter()\n"
+    "    return t0\n"
+)
+
+
+def test_em107_fires_in_serve_and_runtime_only():
+    for path in ("edgemesh/serve/engine.py", "edgemesh/runtime/loop.py"):
+        findings = lint_source(_EM107_SRC, path=path)
+        assert rules_of(findings) == {"EM107"}, path
+        assert "obs" in findings[0].message
+    # Outside the serving stack, raw clocks are fine (benchmarks, eval, ...).
+    assert lint_source(_EM107_SRC, path="edgemesh/ops/x.py") == []
+    assert lint_source(_EM107_SRC, path="edgemesh/benchmarks.py") == []
+
+
+def test_em107_sees_aliased_clocks_and_honors_disable():
+    src = (
+        "from time import monotonic\n"
+        "def wait():\n"
+        "    return monotonic()\n"
+    )
+    assert rules_of(lint_source(src, path="edgemesh/serve/x.py")) == {"EM107"}
+    quiet = _EM107_SRC.replace(
+        "    t0 = time.perf_counter()",
+        "    t0 = time.perf_counter()  # edgelint: disable=EM107",
+    )
+    assert lint_source(quiet, path="edgemesh/serve/engine.py") == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + baseline mechanics
 # ---------------------------------------------------------------------------
 

@@ -163,7 +163,7 @@ def test_rest_generate_through_batcher():
         with urllib.request.urlopen(req, timeout=120) as resp:
             body = json.loads(resp.read())
         assert isinstance(body["answer"], str)
-        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats", timeout=30) as resp:
             metrics = json.loads(resp.read())
         assert metrics["batcher"]["requests"] == 1
     finally:

@@ -1,0 +1,529 @@
+"""edgemesh.obs: metrics registry + Prometheus exposition, request-lifecycle
+spans and their JSONL replay, the `edgemesh obs` CLI, supervisor event
+counters, and the REST /metrics|/stats|/statusz surfaces.
+
+Fast tier except the live-engine end-to-end tests at the bottom (marked
+slow like the rest of the serving e2e suite)."""
+
+import json
+import math
+import re
+import threading
+
+import pytest
+
+from edgemesh.obs import Registry, SpanTracker, replay_spans
+from edgemesh.obs.spans import SPAN_RECORD_EVENT
+from edgemesh.utils.tracing import JsonlLogger
+
+# ---------------------------------------------------------------------------
+# Registry: counters / gauges / histograms / labels
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_label_mechanics():
+    reg = Registry()
+    c = reg.counter("req_total", "requests", ("engine", "status"))
+    c.labels(engine="a", status="ok").inc()
+    c.labels(engine="a", status="ok").inc(2)
+    c.labels(engine="a", status="err").inc()
+    g = reg.gauge("pages", "free pages")
+    g.set(7)
+    g.inc(3)
+    g.dec()
+    s = reg.summary()
+    assert s['req_total{engine="a",status="ok"}'] == 3
+    assert s['req_total{engine="a",status="err"}'] == 1
+    assert s["pages"] == 9
+    with pytest.raises(ValueError):
+        c.labels(engine="a").inc()  # missing label
+    with pytest.raises(ValueError):
+        reg.gauge("req_total", "type clash")  # re-register as other type
+    with pytest.raises(ValueError):
+        c.labels(engine="a", status="ok").inc(-1)  # counters go up
+
+
+def test_histogram_buckets_and_weighted_observe():
+    reg = Registry()
+    h = reg.histogram("lat", "latency", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005)
+    h.observe(0.05, count=3)
+    h.observe(5.0)  # overflow → +Inf only
+    child = h.labels()
+    assert child.count == 5
+    assert child.sum == pytest.approx(0.005 + 3 * 0.05 + 5.0)
+    assert child.cumulative() == [1, 4, 4, 5]  # cumulative, +Inf == count
+
+
+def test_registry_is_thread_safe_under_contention():
+    reg = Registry()
+    c = reg.counter("n_total", "")
+    h = reg.histogram("h", "", buckets=(0.5,))
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.labels().value == 8000
+    assert h.labels().count == 8000
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$"
+)
+
+
+def _parse_prom(text: str):
+    """Minimal exposition-format parser: every non-comment line must match
+    ``name{labels} value``; returns ({name: type}, {(name, labels): value})."""
+    types: dict[str, str] = {}
+    samples: dict[tuple[str, str], float] = {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split()
+            assert mtype in ("counter", "gauge", "histogram")
+            types[name] = mtype
+        elif line.startswith("#"):
+            assert line.startswith("# HELP "), line
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable exposition line: {line!r}"
+            v = m.group(3)
+            samples[(m.group(1), m.group(2) or "")] = (
+                math.inf if v == "+Inf" else float(v)
+            )
+    return types, samples
+
+
+def test_exposition_format_is_parseable_and_complete():
+    reg = Registry()
+    reg.counter("edge_req_total", "total requests", ("engine",)).labels(
+        engine="spec").inc(4)
+    reg.gauge("edge_pages", "pool pages", ("state",)).labels(
+        state="free").set(12)
+    h = reg.histogram("edge_ttft_seconds", "ttft", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5, count=2)
+    types, samples = _parse_prom(reg.render())
+    assert types["edge_req_total"] == "counter"
+    assert types["edge_pages"] == "gauge"
+    assert types["edge_ttft_seconds"] == "histogram"
+    assert samples[("edge_req_total", '{engine="spec"}')] == 4
+    assert samples[("edge_pages", '{state="free"}')] == 12
+    # Histogram: cumulative buckets, +Inf == _count, _sum present.
+    assert samples[("edge_ttft_seconds_bucket", '{le="0.1"}')] == 1
+    assert samples[("edge_ttft_seconds_bucket", '{le="1"}')] == 3
+    assert samples[("edge_ttft_seconds_bucket", '{le="+Inf"}')] == 3
+    assert samples[("edge_ttft_seconds_count", "")] == 3
+    assert samples[("edge_ttft_seconds_sum", "")] == pytest.approx(1.05)
+
+
+def test_exposition_escapes_label_values():
+    reg = Registry()
+    reg.counter("c_total", "", ("path",)).labels(path='a"b\\c\nd').inc()
+    text = reg.render()
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    # Still one sample line per record (the newline was escaped, not emitted).
+    assert sum(1 for l in text.splitlines() if l.startswith("c_total")) == 1
+
+
+def test_collectors_run_at_scrape_and_broken_collector_is_isolated():
+    reg = Registry()
+    calls = []
+
+    def good(r):
+        calls.append(1)
+        r.gauge("sampled", "").set(42)
+
+    def broken(r):
+        raise RuntimeError("collector exploded")
+
+    reg.add_collector(good)
+    reg.add_collector(broken)
+    reg.add_collector(good)  # dedupe by identity
+    text = reg.render()
+    assert "sampled 42" in text
+    assert calls == [1]
+    reg.snapshot()
+    assert calls == [1, 1]
+
+
+# ---------------------------------------------------------------------------
+# JsonlLogger torn-write tolerance (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_read_skips_truncated_last_line_and_counts_it(tmp_path):
+    lg = JsonlLogger(tmp_path / "log.jsonl")
+    lg.log("a", x=1)
+    lg.log("b", x=2)
+    # Torn write: the process died mid-record; no trailing newline either.
+    with open(lg.path, "a") as f:
+        f.write('{"ts": 123.0, "event": "c", "x"')
+    records = lg.read()
+    assert [r["event"] for r in records] == ["a", "b"]
+    assert lg.malformed == 1
+    # A clean re-read of an intact file reports zero malformed lines.
+    lg2 = JsonlLogger(lg.path)
+    lg2.path.write_text('{"event": "solo", "ts": 1.0}\n')
+    assert [r["event"] for r in lg2.read()] == ["solo"]
+    assert lg2.malformed == 0
+
+
+# ---------------------------------------------------------------------------
+# Span tracker lifecycle + replay
+# ---------------------------------------------------------------------------
+
+
+def _drive_tracker(tracker, rid, tokens_per_seg=(3, 2), status="ok"):
+    tr = tracker.submit(rid)
+    tracker.admit_start(tr)
+    tracker.admitted(tr, prompt_tokens=5)
+    for n in tokens_per_seg:
+        tracker.tokens(tr, n)
+    tracker.retire(tr, status=status)
+    return tr
+
+
+def test_span_lifecycle_monotonic_and_aggregated(tmp_path):
+    reg = Registry()
+    tracker = SpanTracker(reg, tmp_path / "spans.jsonl", engine="unit")
+    _drive_tracker(tracker, 0)
+    _drive_tracker(tracker, 1, tokens_per_seg=(4,))
+    records = JsonlLogger(tmp_path / "spans.jsonl").read()
+    assert len(records) == 2
+    for rec in records:
+        assert rec["event"] == SPAN_RECORD_EVENT
+        names = [s["name"] for s in rec["spans"]]
+        assert names[0] == "queued" and names[1] == "prefill"
+        assert names[-1] == "retire" and "decode" in names
+        # Monotonic, properly nested timestamps.
+        for s in rec["spans"]:
+            assert s["t1"] >= s["t0"]
+        edges = [s["t0"] for s in rec["spans"]]
+        assert edges == sorted(edges)
+        assert rec["queue_s"] >= 0 and rec["ttft_s"] >= rec["queue_s"]
+        assert rec["latency_s"] >= rec["ttft_s"]
+    s = reg.summary()
+    assert s['edgemesh_requests_submitted_total{engine="unit"}'] == 2
+    assert s['edgemesh_requests_completed_total{engine="unit",status="ok"}'] == 2
+    assert s['edgemesh_tokens_generated_total{engine="unit"}'] == 9
+    assert s['edgemesh_ttft_seconds{engine="unit"}']["count"] == 2
+    # Inter-token latency observes once per post-first token: (5-1)+(4-1).
+    assert s['edgemesh_inter_token_seconds{engine="unit"}']["count"] == 7
+
+
+def test_replay_rebuilds_the_same_request_aggregates(tmp_path):
+    reg = Registry()
+    tracker = SpanTracker(reg, tmp_path / "spans.jsonl", engine="unit")
+    _drive_tracker(tracker, 0)
+    _drive_tracker(tracker, 1, status="error")
+    tracker.pool_reset("test reset")
+    replayed = replay_spans(tmp_path / "spans.jsonl")
+    live, offline = reg.summary(), replayed.summary()
+    # Every request-level family replays to identical aggregates.
+    for key, val in offline.items():
+        if isinstance(val, dict):
+            assert val["count"] == live[key]["count"], key
+            assert val["sum"] == pytest.approx(live[key]["sum"]), key
+        else:
+            assert val == live[key], key
+    assert offline['edgemesh_pool_resets_total{engine="unit"}'] == 1
+    assert offline[
+        'edgemesh_requests_completed_total{engine="unit",status="error"}'] == 1
+
+
+# ---------------------------------------------------------------------------
+# `edgemesh obs` CLI
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def span_log(tmp_path):
+    tracker = SpanTracker(Registry(), tmp_path / "spans.jsonl", engine="cli")
+    for rid in range(3):
+        _drive_tracker(tracker, rid)
+    # A torn trailing line must not break any subcommand.
+    with open(tmp_path / "spans.jsonl", "a") as f:
+        f.write('{"event": "request_spans", "rid"')
+    return tmp_path / "spans.jsonl"
+
+
+def test_obs_cli_tail_summary_prom(span_log, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    assert obs_main(["tail", str(span_log), "-n", "2"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("rid=") == 2 and "spans=queued>prefill" in out
+
+    assert obs_main(["summary", str(span_log)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["requests"] == 3
+    assert report["latency_s_p50"] > 0 and report["ttft_s_p95"] > 0
+    assert report["metrics"][
+        'edgemesh_tokens_generated_total{engine="cli"}'] == 15
+
+    assert obs_main(["prom", str(span_log)]) == 0
+    types, samples = _parse_prom(capsys.readouterr().out)
+    assert types["edgemesh_ttft_seconds"] == "histogram"
+    assert samples[
+        ("edgemesh_requests_completed_total", '{engine="cli",status="ok"}')
+    ] == 3
+
+
+def test_obs_cli_missing_file_is_usage_error(tmp_path, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    assert obs_main(["summary", str(tmp_path / "nope.jsonl")]) == 2
+    assert "no such span log" in capsys.readouterr().err
+
+
+def test_cli_routes_obs_subcommand(span_log, capsys):
+    from edgemesh.cli import main as cli_main
+
+    assert cli_main(["obs", "tail", str(span_log), "-n", "1"]) == 0
+    assert "rid=" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Supervisor restart events as labeled counters
+# ---------------------------------------------------------------------------
+
+
+class _Flaky:
+    built = 0
+
+    def __init__(self, fail_first):
+        type(self).built += 1
+        self.remaining = fail_first
+
+    def answer(self, q):
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise RuntimeError("boom")
+        return {"answer": f"ok:{q}"}
+
+
+def test_supervisor_restart_events_become_counters():
+    from edgemesh.serve.supervisor import Supervisor
+
+    reg = Registry()
+    _Flaky.built = 0
+    sup = Supervisor(
+        factory=lambda: _Flaky(2 if _Flaky.built == 0 else 0),
+        handler=lambda b, q: b.answer(q),
+        max_consecutive_failures=2,
+        registry=reg,
+    )
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            sup.call("q")
+    assert sup.call("q2")["answer"] == "ok:q2"
+    s = reg.summary()
+    assert s['edgemesh_supervisor_events_total{kind="start"}'] == 1
+    assert s['edgemesh_supervisor_events_total{kind="request_failed"}'] == 2
+    assert s['edgemesh_supervisor_events_total{kind="restart"}'] == 1
+    assert s['edgemesh_supervisor_events_total{kind="restart_ok"}'] == 1
+    assert s["edgemesh_supervisor_request_seconds"]["count"] == 1  # success
+
+
+# ---------------------------------------------------------------------------
+# REST surfaces (no model: FakeEnsemble + supervisor)
+# ---------------------------------------------------------------------------
+
+
+def test_rest_metrics_stats_statusz_surfaces():
+    import urllib.request
+
+    from edgemesh.serve.rest import serve_rest
+    from edgemesh.serve.supervisor import Supervisor
+
+    class FakeEnsemble:
+        qa_agents = []
+        refiner = None
+
+    reg = Registry()
+    sup = Supervisor(factory=lambda: _Flaky(0),
+                     handler=lambda b, q: b.answer(q), registry=reg)
+    server = serve_rest(FakeEnsemble(), host="127.0.0.1", port=0, block=False,
+                        supervisor=sup, registry=reg)
+    port = server.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"question": "hi"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.load(resp)["answer"] == "ok:hi"
+        # /metrics: Prometheus text exposition, not JSON.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            types, samples = _parse_prom(resp.read().decode())
+        assert types["edgemesh_supervisor_events_total"] == "counter"
+        assert samples[
+            ("edgemesh_supervisor_events_total", '{kind="start"}')] == 1
+        # The device collector ran at scrape time (CPU backend still
+        # reports the device count even without memory_stats).
+        assert ("edgemesh_devices", "") in samples
+        # /stats: the legacy JSON blob.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=10
+        ) as resp:
+            stats = json.load(resp)
+        assert stats["supervisor"]["total_requests"] == 1
+        assert "phases" in stats
+        # /statusz: human text.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz", timeout=10
+        ) as resp:
+            page = resp.read().decode()
+        assert "edgemesh statusz" in page and "supervisor: healthy" in page
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Live-engine end-to-end (slow tier, like the rest of the serving e2e)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_agent(max_new=12):
+    from edgemesh.agents.orchestrator import build_agent
+    from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
+
+    return build_agent(AgentSpec(
+        role="qa", model=ModelSpec(),
+        sampling=SamplingParams(max_new_tokens=max_new, do_sample=False,
+                                repetition_penalty=1.0),
+    ))
+
+
+@pytest.mark.slow
+def test_engine_emits_spans_and_matching_metrics(tmp_path):
+    """Acceptance: a live ContinuousEngine serving concurrent requests emits
+    admit/prefill/decode/retire spans with monotonic timestamps; /metrics-
+    style exposition carries TTFT + inter-token histograms and KV page
+    gauges whose counts match the actual traffic; the span JSONL replays
+    into the same request aggregates."""
+    from edgemesh.serve.continuous import ContinuousEngine
+
+    reg = Registry()
+    agent = _tiny_agent()
+    eng = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged",
+                           page_size=8, span_log=tmp_path / "spans.jsonl",
+                           registry=reg)
+    try:
+        futs = [eng.submit(f"question number {i}?") for i in range(4)]
+        results = [f.result(timeout=600) for f in futs]
+        assert all(r["generated"] > 0 for r in results)
+    finally:
+        eng.close()
+
+    # Span records: one per request, full lifecycle, monotonic timestamps.
+    records = JsonlLogger(tmp_path / "spans.jsonl").read()
+    span_recs = [r for r in records if r["event"] == SPAN_RECORD_EVENT]
+    assert len(span_recs) == 4
+    for rec in span_recs:
+        names = [s["name"] for s in rec["spans"]]
+        assert names[0] == "queued" and names[1] == "prefill"
+        assert "decode" in names and names[-1] == "retire"
+        for s in rec["spans"]:
+            assert s["t1"] >= s["t0"]
+        edges = [s["t0"] for s in rec["spans"]]
+        assert edges == sorted(edges)
+        assert rec["status"] == "ok" and rec["generated"] > 0
+
+    # Registry aggregates match the engine's actual traffic.
+    generated = sum(r["generated"] for r in results)
+    s = reg.summary()
+    assert s['edgemesh_requests_submitted_total{engine="continuous"}'] == 4
+    assert s[
+        'edgemesh_requests_completed_total{engine="continuous",status="ok"}'
+    ] == 4
+    assert s['edgemesh_tokens_generated_total{engine="continuous"}'] == generated
+    assert s['edgemesh_segments_total{engine="continuous"}'] == eng.segments
+    assert s['edgemesh_ttft_seconds{engine="continuous"}']["count"] == 4
+
+    # Exposition: parseable, with the acceptance families present.
+    types, samples = _parse_prom(reg.render())
+    assert types["edgemesh_ttft_seconds"] == "histogram"
+    assert types["edgemesh_inter_token_seconds"] == "histogram"
+    assert types["edgemesh_kv_pages"] == "gauge"
+    assert samples[
+        ("edgemesh_requests_completed_total",
+         '{engine="continuous",status="ok"}')
+    ] == 4
+    # All requests retired: reserved drained to 0, free + template = total.
+    free = samples[("edgemesh_kv_pages", '{engine="continuous",state="free"}')]
+    total = samples[("edgemesh_kv_pages", '{engine="continuous",state="total"}')]
+    tpl = samples[
+        ("edgemesh_kv_pages", '{engine="continuous",state="template"}')]
+    assert samples[
+        ("edgemesh_kv_pages", '{engine="continuous",state="reserved"}')] == 0
+    assert free + tpl == total - 1  # -1: page 0 is the trash page
+
+    # Replay: the span log alone rebuilds the same request aggregates.
+    # (Segments are pool-wide engine state — documented as non-replayable.)
+    offline = replay_spans(tmp_path / "spans.jsonl").summary()
+    for key, val in offline.items():
+        if key.startswith("edgemesh_segments_total"):
+            continue
+        if isinstance(val, dict):
+            assert val["count"] == s[key]["count"], key
+            assert val["sum"] == pytest.approx(s[key]["sum"]), key
+        else:
+            assert val == s[key], key
+
+
+@pytest.mark.slow
+def test_rest_continuous_metrics_scrape_end_to_end(tmp_path):
+    """The full serving stack: REST --continuous with a span log; /generate
+    traffic shows up in a valid Prometheus /metrics scrape and replays via
+    the obs CLI."""
+    import urllib.request
+
+    from edgemesh.agents.orchestrator import Ensemble
+    from edgemesh.obs.cli import main as obs_main
+    from edgemesh.serve.rest import serve_rest
+
+    reg = Registry()
+    srv = serve_rest(Ensemble(qa_agents=[_tiny_agent(max_new=6)]),
+                     host="127.0.0.1", port=0, block=False, continuous=True,
+                     kv_backend="paged", kv_page_size=8, batch=2,
+                     span_log=tmp_path / "spans.jsonl", registry=reg)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        for i in range(2):
+            req = urllib.request.Request(
+                f"{url}/generate",
+                data=json.dumps({"question": f"question {i}?"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=300) as r:
+                assert json.load(r)["generated"] > 0
+        with urllib.request.urlopen(f"{url}/metrics", timeout=60) as r:
+            types, samples = _parse_prom(r.read().decode())
+        assert samples[
+            ("edgemesh_requests_completed_total",
+             '{engine="continuous",status="ok"}')
+        ] == 2
+        assert types["edgemesh_inter_token_seconds"] == "histogram"
+        assert ("edgemesh_kv_pages", '{engine="continuous",state="free"}') in samples
+    finally:
+        srv.shutdown()
+        if srv.batcher is not None:
+            srv.batcher.close()
+    assert obs_main(["summary", str(tmp_path / "spans.jsonl")]) == 0

@@ -165,7 +165,7 @@ agents:
 def test_rest_continuous_speculative_end_to_end():
     """The REST --continuous path auto-selects the speculative engine for a
     draft-carrying agent on the paged backend; /generate answers through
-    pool-wide draft→verify rounds and /metrics carries acceptance counters."""
+    pool-wide draft→verify rounds and /stats carries acceptance counters."""
     from edgemesh.agents.orchestrator import Ensemble, build_agent
 
     base = dict(family="llama", vocab_size=260, num_layers=1, hidden_size=32,
@@ -189,7 +189,7 @@ def test_rest_continuous_speculative_end_to_end():
         with urllib.request.urlopen(req, timeout=300) as r:
             resp = json.load(r)
         assert "answer" in resp and resp["generated"] > 0
-        with urllib.request.urlopen(f"{url}/metrics", timeout=60) as r:
+        with urllib.request.urlopen(f"{url}/stats", timeout=60) as r:
             metrics = json.load(r)
         stats = metrics["batcher"]
         assert stats["gamma"] == 2

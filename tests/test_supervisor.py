@@ -1,5 +1,6 @@
 """Supervisor failure detection / restart, tracing registry, and the
-/metrics + supervised-generate REST surface."""
+/stats + supervised-generate REST surface (the Prometheus /metrics surface
+is covered in tests/test_obs.py)."""
 
 import json
 import time
@@ -100,7 +101,7 @@ def test_jsonl_logger_roundtrip(tmp_path):
     assert all("ts" in r for r in records)
 
 
-def test_rest_metrics_and_supervised_generate(tmp_path):
+def test_rest_stats_and_supervised_generate(tmp_path):
     from edgemesh.serve.rest import serve_rest
 
     class FakeEnsemble:
@@ -123,7 +124,7 @@ def test_rest_metrics_and_supervised_generate(tmp_path):
         with urllib.request.urlopen(req, timeout=10) as resp:
             assert json.load(resp)["answer"] == "ok:hi"
         with urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/metrics", timeout=10
+            f"http://127.0.0.1:{port}/stats", timeout=10
         ) as resp:
             payload = json.load(resp)
         assert payload["supervisor"]["total_requests"] == 1
