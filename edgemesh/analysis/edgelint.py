@@ -33,6 +33,12 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   so it lands in spans, histograms, and ``/metrics`` instead of ad-hoc
   deltas. Pre-obs sites are grandfathered in the baseline; clocks that ARE
   the obs instrumentation (or wait control flow) carry an inline disable.
+- EM108 fleet-missing-timeout (error): an outbound HTTP/socket call inside
+  ``edgemesh/fleet/`` without an explicit timeout (bare ``urlopen``,
+  ``socket.create_connection``, ``http.client.*Connection``) — the fleet's
+  whole job is surviving stalled replicas, and one unbounded read pins a
+  router thread forever. The router's retry/hedge math only holds if every
+  attempt returns in bounded time.
 
 Suppression: append ``# edgelint: disable=EM105`` (comma-separate for
 several rules) to the flagged line, or put the comment on the ``def`` line
@@ -83,6 +89,11 @@ RULES: dict[str, dict] = {
         "severity": "warning",
         "summary": "raw wall-clock read in serve//runtime/ bypasses edgemesh.obs spans",
     },
+    "EM108": {
+        "name": "fleet-missing-timeout",
+        "severity": "error",
+        "summary": "outbound HTTP/socket call in edgemesh/fleet/ without an explicit timeout",
+    },
 }
 
 # ---------------------------------------------------------------------------
@@ -125,6 +136,21 @@ _DISABLE_RE = re.compile(r"#\s*edgelint:\s*disable=([A-Z0-9, ]+)")
 # through the obs substrate. Path-substring match (like the EM101 allowlist)
 # so fixture tests with relative paths resolve the same everywhere.
 _EM107_DIRS = ("edgemesh/serve/", "edgemesh/runtime/")
+
+# EM108 scope + call table: outbound calls that accept a timeout, mapped to
+# the 0-based POSITIONAL index where the timeout can ride (None = kwarg
+# only). A call in edgemesh/fleet/ hitting this table without a ``timeout``
+# kwarg or enough positionals is flagged.
+_EM108_DIRS = ("edgemesh/fleet/",)
+_EM108_CALLS = {
+    "urllib.request.urlopen": 2,        # urlopen(url, data, timeout)
+    "socket.create_connection": 1,      # create_connection(address, timeout)
+    "http.client.HTTPConnection": 2,    # HTTPConnection(host, port, timeout)
+    "http.client.HTTPSConnection": 2,
+    "requests.get": None,               # kwarg-only (defensive: not a dep)
+    "requests.post": None,
+    "requests.request": None,
+}
 
 
 # ---------------------------------------------------------------------------
@@ -367,6 +393,7 @@ class _FileLinter:
 
         self._rule_api_drift(tree)
         self._rule_raw_timing(tree)
+        self._rule_fleet_timeout(tree)
         # Traced ROOTS only: their walkers descend into traced nested defs,
         # so running every traced def would double-report nested call sites.
         traced_roots = [
@@ -461,6 +488,33 @@ class _FileLinter:
                     "edgemesh.obs.SpanTracker / utils.tracing.trace() (or "
                     "suppress: control-flow clocks and the obs "
                     "instrumentation itself are legitimate)",
+                )
+
+    # -- EM108 -------------------------------------------------------------
+
+    def _rule_fleet_timeout(self, tree: ast.Module) -> None:
+        if not any(d in self.relpath for d in _EM108_DIRS):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_name(node.func)
+            if not dotted:
+                continue
+            resolved = self.aliases.resolve(dotted)
+            if resolved not in _EM108_CALLS:
+                continue
+            pos = _EM108_CALLS[resolved]
+            has_timeout = any(kw.arg == "timeout" for kw in node.keywords) or (
+                pos is not None and len(node.args) > pos
+            )
+            if not has_timeout:
+                self._emit(
+                    "EM108", node,
+                    f"outbound {resolved}() without an explicit timeout — a "
+                    "stalled replica pins this fleet thread forever and the "
+                    "router's retry/hedge budget math breaks (pass "
+                    "timeout=..., or route through fleet.transport)",
                 )
 
     # -- EM102 -------------------------------------------------------------

@@ -558,6 +558,87 @@ def decode_benchmark(
     }
 
 
+def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[str, Any]:
+    """The fleet router's tax: direct-to-replica vs through-router request
+    latency (p50/p99) against ONE local replica, so the delta is purely the
+    router's own work — balancer pick, registry bookkeeping, obs recording,
+    and one extra loopback HTTP hop. No retries/hedges fire (the replica is
+    healthy), which is the point: this measures the overhead every request
+    pays, not the failure machinery. The router's obs registry summary
+    rides the result JSON like the serving benchmark's does, so the
+    artifact itself shows the routed/shed counters that produced the
+    numbers. Tiny synthetic model — the replica's decode time is the same
+    constant in both arms and cancels in the delta."""
+    from edgemesh.agents.orchestrator import Ensemble, build_agent
+    from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
+    from edgemesh.fleet import FleetRouter, HttpTransport, ReplicaRegistry, serve_fleet
+    from edgemesh.obs import Registry
+    from edgemesh.serve import serve_rest
+
+    import numpy as np
+
+    agent = build_agent(AgentSpec(
+        role="qa", model=ModelSpec(),
+        sampling=SamplingParams(max_new_tokens=max_new, do_sample=False,
+                                repetition_penalty=1.0),
+    ))
+    srv = serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1", port=0,
+                     block=False)
+    replica_url = f"http://127.0.0.1:{srv.server_address[1]}"
+    obs = Registry()
+    registry = ReplicaRegistry([("r0", replica_url)])
+    router = FleetRouter(registry, balancer="least_outstanding",
+                         obs_registry=obs)
+    front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+    transport = HttpTransport()
+
+    def measure(url: str, label: str) -> list[float]:
+        payload = {"question": "benchmark question, please answer?"}
+        _progress(f"router-overhead: warmup via {label}")
+        status, _ = transport.post_json(url, payload, timeout_s=600.0)
+        if status != 200:
+            raise RuntimeError(f"{label} warmup answered {status}")
+        lats = []
+        for _ in range(n_requests):
+            t0 = time.perf_counter()
+            status, _ = transport.post_json(url, payload, timeout_s=600.0)
+            if status != 200:
+                raise RuntimeError(f"{label} request answered {status}")
+            lats.append(time.perf_counter() - t0)
+        return lats
+
+    try:
+        direct = measure(f"{replica_url}/generate", "direct")
+        routed = measure(
+            f"http://127.0.0.1:{front.server_address[1]}/generate", "router"
+        )
+
+        def pct(xs, q):
+            return round(float(np.percentile(xs, q)), 6)
+
+        overhead_p50 = pct(routed, 50) - pct(direct, 50)
+        _progress(
+            f"router-overhead: p50 {pct(direct, 50) * 1e3:.2f}ms direct vs "
+            f"{pct(routed, 50) * 1e3:.2f}ms routed (+{overhead_p50 * 1e3:.2f}ms)"
+        )
+        return {
+            "metric": "router_overhead_p50_s",
+            "value": round(overhead_p50, 6),
+            "unit": "s",
+            "n_requests": n_requests,
+            "direct_p50_s": pct(direct, 50),
+            "direct_p99_s": pct(direct, 99),
+            "routed_p50_s": pct(routed, 50),
+            "routed_p99_s": pct(routed, 99),
+            "overhead_p99_s": round(pct(routed, 99) - pct(direct, 99), 6),
+            # The obs view of the routed arm (counters + router histogram).
+            "obs": obs.summary(prefix="edgemesh_fleet_"),
+        }
+    finally:
+        front.shutdown()
+        srv.shutdown()
+
+
 def ensemble_overlap_benchmark(n_agents: int = 2, questions: int = 3) -> dict[str, Any]:
     """Concurrent-vs-serial wall time for ensemble QA agents on disjoint
     submeshes — the measured version of the claim that edgemesh fixes the

@@ -14,6 +14,9 @@ Subcommands:
                  eval_shape contract pass (python -m edgemesh.analysis)
 - ``obs``      — tail/summarize request-span JSONL logs and dump registry
                  snapshots (edgemesh.obs; docs/OBSERVABILITY.md)
+- ``fleet``    — multi-replica serving fabric: spawn N local replicas and
+                 front them with the fault-tolerant router, or inspect a
+                 running fleet (edgemesh.fleet; docs/FLEET.md)
 """
 
 from __future__ import annotations
@@ -188,6 +191,12 @@ def main(argv: list[str] | None = None) -> int:
         from edgemesh.analysis.__main__ import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # Own argument shape (subcommands + fleet flags) and no jax at all
+        # on the router path — delegate before the shared parser.
+        from edgemesh.fleet.cli import main as fleet_main
+
+        return fleet_main(argv[1:])
     if argv and argv[0] == "obs":
         # Offline span-log tooling: no config, no jax, no device — delegate
         # before the shared parser like lint/compare.

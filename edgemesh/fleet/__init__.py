@@ -1,0 +1,33 @@
+"""edgemesh.fleet — multi-replica serving fabric.
+
+The layer that turns N independent ``serve_rest`` processes into one
+service (docs/FLEET.md is the operator-facing reference):
+
+- ``registry``: live replica membership + health state machine.
+- ``balancer``: round-robin / least-outstanding / prefix-affinity
+  (rendezvous-hashed so replica death only remaps its own prefixes).
+- ``health``: periodic ``/readyz`` probes with automatic demote/promote.
+- ``router``: deadlines, bounded jittered retries, tail-latency hedging,
+  admission control (503 + Retry-After), graceful drain.
+- ``frontend``: the HTTP listener (``/generate``, ``/fleetz``,
+  ``/metrics``, runtime ``/replicas/*`` membership).
+- ``cli``: ``edgemesh fleet serve|status`` — spawn N local replicas and
+  front them, or inspect a running fleet.
+
+Importing this package never imports jax (the router runs on hosts with no
+accelerator at all — same contract as edgemesh.obs), and every outbound
+call carries an explicit timeout (enforced by edgelint EM108).
+"""
+
+from edgemesh.fleet.balancer import (  # noqa: F401
+    BALANCERS,
+    LeastOutstandingBalancer,
+    PrefixAffinityBalancer,
+    RoundRobinBalancer,
+    make_balancer,
+)
+from edgemesh.fleet.frontend import serve_fleet  # noqa: F401
+from edgemesh.fleet.health import HealthProber  # noqa: F401
+from edgemesh.fleet.registry import Replica, ReplicaRegistry  # noqa: F401
+from edgemesh.fleet.router import FleetRouter  # noqa: F401
+from edgemesh.fleet.transport import HttpTransport, TransportError  # noqa: F401

@@ -1,0 +1,104 @@
+"""Pluggable balancers: how the router picks a replica for one request.
+
+Three policies, selected by name via ``make_balancer``:
+
+- ``round_robin``: cycle registration order. Baseline; ignores load.
+- ``least_outstanding``: fewest in-flight requests wins (ties break by
+  registration order). The sane default for decode workloads whose service
+  times vary by an order of magnitude — queue depth IS the load signal.
+- ``prefix_affinity``: requests sharing a prompt prefix land on the same
+  replica, so that replica's ``runtime/prefix_cache`` (and on the paged
+  engines, its shared template pages) already hold the prefix KV — the
+  fleet-level analog of template prefix sharing (docs/SERVING.md).
+  Placement is rendezvous (highest-random-weight) hashing of
+  ``sha256(prefix, replica-id)``: every (key, replica) pair gets a stable
+  pseudo-random score and the max score wins, so when a replica dies ONLY
+  its own keys remap — the surviving replicas keep every prefix they have
+  already warmed (plain modulo hashing would reshuffle nearly all keys).
+
+``pick`` is called under the registry lock with a non-empty candidate list
+(fleet/registry.py ``acquire``), so reading ``outstanding`` is race-free
+and balancer state needs no extra locking.
+
+No jax imports — the router stack must stay importable on a host with no
+accelerator backend at all (same contract as edgemesh.obs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Sequence
+
+
+class RoundRobinBalancer:
+    name = "round_robin"
+
+    def __init__(self) -> None:
+        self._n = 0
+
+    def pick(self, candidates: Sequence, prompt: str | None = None):
+        rep = candidates[self._n % len(candidates)]
+        self._n += 1
+        return rep
+
+
+class LeastOutstandingBalancer:
+    name = "least_outstanding"
+
+    def pick(self, candidates: Sequence, prompt: str | None = None):
+        return min(enumerate(candidates), key=lambda t: (t[1].outstanding, t[0]))[1]
+
+
+class PrefixAffinityBalancer:
+    """Rendezvous-hash the prompt prefix onto a replica.
+
+    ``prefix_chars`` bounds the key: requests that share at least the
+    template + leading question characters hash identically, which is what
+    the replica-side prefix cache keys on. ``spill_margin`` is the overload
+    escape hatch: when the affine replica already carries that many more
+    outstanding requests than the least-loaded candidate, the request
+    spills to least-outstanding instead — affinity is a cache hint, not a
+    correctness constraint, and a hot prefix must not melt one replica.
+    """
+
+    name = "prefix_affinity"
+
+    def __init__(self, prefix_chars: int = 64, spill_margin: int = 8) -> None:
+        self.prefix_chars = prefix_chars
+        self.spill_margin = spill_margin
+        self._fallback = LeastOutstandingBalancer()
+
+    @staticmethod
+    def _score(key: str, rid: str) -> int:
+        # sha256, not hash(): str hashing is PYTHONHASHSEED-randomized per
+        # process, which would break affinity across router restarts.
+        digest = hashlib.sha256(f"{key}\x1f{rid}".encode("utf-8", "replace"))
+        return int.from_bytes(digest.digest()[:8], "big")
+
+    def pick(self, candidates: Sequence, prompt: str | None = None):
+        if not prompt:
+            return self._fallback.pick(candidates, prompt)
+        key = prompt[: self.prefix_chars]
+        chosen = max(candidates, key=lambda r: self._score(key, r.rid))
+        least = min(r.outstanding for r in candidates)
+        if chosen.outstanding - least > self.spill_margin:
+            return self._fallback.pick(candidates, prompt)
+        return chosen
+
+
+BALANCERS = {
+    "round_robin": RoundRobinBalancer,
+    "least_outstanding": LeastOutstandingBalancer,
+    "prefix_affinity": PrefixAffinityBalancer,
+}
+
+
+def make_balancer(name: str, **kwargs):
+    """Build a balancer by policy name; unknown names list the choices."""
+    try:
+        cls = BALANCERS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown balancer {name!r}; choose from {sorted(BALANCERS)}"
+        ) from None
+    return cls(**kwargs)

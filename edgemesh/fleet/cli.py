@@ -1,0 +1,245 @@
+"""``edgemesh fleet`` — spawn, front, and inspect a local replica fleet.
+
+Subcommands:
+
+- ``serve``: spawn N local ``serve_rest`` replicas (each a full
+  ``edgemesh serve`` subprocess on its own port), wait for their
+  ``/readyz``, register them, start the health prober, and front them with
+  the fleet router. Ctrl-C drains every replica (in-flight requests
+  finish) before the subprocesses are stopped.
+- ``status``: query a running fleet's ``/fleetz``; ``--json`` prints the
+  raw machine-readable document (scripts parse this — the shape is
+  ``{"balancer", "replicas": [...], "metrics": {...}}``), otherwise a
+  human table.
+
+The router itself never imports jax; only the replica subprocesses own
+devices, so the frontend stays responsive while replicas compile/restart.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+log = logging.getLogger("edgemesh.fleet")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="edgemesh fleet",
+        description="multi-replica serving fabric: router + replica "
+        "registry + health probes (docs/FLEET.md)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    srv = sub.add_parser("serve", help="spawn N local replicas and front them")
+    srv.add_argument("--config", default=None, help="replica YAML config "
+                     "(passed through to each `edgemesh serve`)")
+    srv.add_argument("--replicas", type=int, default=2)
+    srv.add_argument("--host", default="0.0.0.0")
+    srv.add_argument("--port", type=int, default=8000, help="router port")
+    srv.add_argument("--replica-port-base", type=int, default=0,
+                     help="first replica port (0 = pick free ports)")
+    srv.add_argument("--balancer", default="least_outstanding",
+                     choices=["round_robin", "least_outstanding", "prefix_affinity"])
+    srv.add_argument("--max-attempts", type=int, default=3)
+    srv.add_argument("--deadline-s", type=float, default=60.0,
+                     help="default per-request deadline (clients override "
+                     "via X-Edgemesh-Deadline-S)")
+    srv.add_argument("--attempt-timeout-s", type=float, default=30.0)
+    srv.add_argument("--hedge-after-s", type=float, default=0.0,
+                     help="fixed tail-latency hedge delay (0 = off)")
+    srv.add_argument("--hedge-percentile", type=float, default=0.0,
+                     help="adaptive hedge at this observed-latency "
+                     "percentile, e.g. 0.95 (0 = off)")
+    srv.add_argument("--max-inflight", type=int, default=64)
+    srv.add_argument("--probe-interval-s", type=float, default=2.0)
+    srv.add_argument("--boot-timeout-s", type=float, default=300.0,
+                     help="per-replica readiness wait (first jit compile "
+                     "of a real checkpoint can take minutes)")
+    srv.add_argument("--replica-extra", default="",
+                     help="extra args appended to each replica's `edgemesh "
+                     "serve` command line, e.g. '--continuous --batch 8'")
+
+    st = sub.add_parser("status", help="query a running fleet's /fleetz")
+    st.add_argument("--url", default="http://127.0.0.1:8000")
+    st.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the raw machine-readable /fleetz document")
+    return p
+
+
+def _free_ports(n: int) -> list[int]:
+    """Pick n distinct free ports, holding every probe socket open until
+    all are bound — releasing between picks lets the kernel hand the same
+    port out twice. The remaining close→replica-bind window is unavoidable
+    without `--port 0` readback; a collision surfaces as a replica crash,
+    which _wait_ready reports with its exit code instead of hanging."""
+    socks: list[socket.socket] = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _spawn_replicas(args) -> list[tuple[str, int, subprocess.Popen]]:
+    if args.replica_port_base:
+        ports = [args.replica_port_base + i for i in range(args.replicas)]
+    else:
+        ports = _free_ports(args.replicas)
+    procs: list[tuple[str, int, subprocess.Popen]] = []
+    for i, port in enumerate(ports):
+        cmd = [sys.executable, "-m", "edgemesh.cli", "serve", "--port", str(port)]
+        if args.config:
+            cmd += ["--config", args.config]
+        cmd += args.replica_extra.split()
+        proc = subprocess.Popen(cmd, env=os.environ.copy())
+        procs.append((f"replica-{i}", port, proc))
+        log.info("spawned %s on port %d (pid %d)", f"replica-{i}", port, proc.pid)
+    return procs
+
+
+def _wait_ready(transport, procs, boot_timeout_s: float) -> None:
+    from edgemesh.fleet.transport import TransportError
+
+    deadline = time.monotonic() + boot_timeout_s
+    pending = {rid: port for rid, port, _ in procs}
+    by_rid = {rid: proc for rid, _, proc in procs}
+    while pending and time.monotonic() < deadline:
+        for rid, port in list(pending.items()):
+            rc = by_rid[rid].poll()
+            if rc is not None:
+                # Fail fast with the real cause (bad config, port
+                # collision, ...) instead of polling a dead port for the
+                # whole boot timeout.
+                raise RuntimeError(
+                    f"{rid} exited with rc={rc} during boot — see its log "
+                    "output above"
+                )
+            try:
+                status, _ = transport.get_json(
+                    f"http://127.0.0.1:{port}/readyz", timeout_s=2.0
+                )
+            except TransportError:
+                continue
+            if status == 200:
+                log.info("%s ready on port %d", rid, port)
+                del pending[rid]
+        if pending:
+            time.sleep(0.5)
+    if pending:
+        raise RuntimeError(
+            f"replicas never became ready within {boot_timeout_s:.0f}s: "
+            f"{sorted(pending)}"
+        )
+
+
+def cmd_serve(args) -> int:
+    from edgemesh.fleet import (
+        FleetRouter,
+        HealthProber,
+        HttpTransport,
+        ReplicaRegistry,
+        serve_fleet,
+    )
+
+    procs = _spawn_replicas(args)
+    transport = HttpTransport()
+    registry = ReplicaRegistry()
+    router = None
+    try:
+        _wait_ready(transport, procs, args.boot_timeout_s)
+        for rid, port, proc in procs:
+            registry.register(rid, f"http://127.0.0.1:{port}", pid=proc.pid)
+        router = FleetRouter(
+            registry,
+            balancer=args.balancer,
+            transport=transport,
+            max_attempts=args.max_attempts,
+            default_deadline_s=args.deadline_s,
+            attempt_timeout_s=args.attempt_timeout_s,
+            hedge_after_s=args.hedge_after_s,
+            hedge_percentile=args.hedge_percentile,
+            max_inflight=args.max_inflight,
+        )
+        prober = HealthProber(registry, transport=transport,
+                              interval_s=args.probe_interval_s).start()
+        print(
+            f"edgemesh fleet: {len(procs)} replicas behind "
+            f"http://{args.host}:{args.port} (balancer={args.balancer}); "
+            f"`edgemesh fleet status --url http://127.0.0.1:{args.port}`",
+            flush=True,
+        )
+        try:
+            serve_fleet(router, host=args.host, port=args.port, block=True)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            prober.stop()
+        return 0
+    finally:
+        for rid, _, proc in procs:
+            if router is not None and proc.poll() is None:
+                # Graceful: finish in-flight work before the process dies.
+                print(f"draining {rid} ...", flush=True)
+                router.drain_replica(rid, timeout_s=30.0)
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for _, _, proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def cmd_status(url: str, as_json: bool) -> int:
+    from edgemesh.fleet.transport import HttpTransport, TransportError
+
+    try:
+        status, body = HttpTransport().get_json(
+            url.rstrip("/") + "/fleetz", timeout_s=5.0
+        )
+    except TransportError as e:
+        print(f"error: fleet unreachable: {e}", file=sys.stderr)
+        return 2
+    if status != 200:
+        print(f"error: /fleetz answered {status}: {body}", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(body, indent=2))
+        return 0
+    print(f"balancer: {body.get('balancer')}   "
+          f"max_inflight: {body.get('max_inflight')}")
+    print(f"{'REPLICA':<12} {'STATE':<10} {'URL':<28} "
+          f"{'OUT':>4} {'ROUTED':>7} {'FAILED':>7}")
+    for r in body.get("replicas", []):
+        print(f"{r['id']:<12} {r['state']:<10} {r['url']:<28} "
+              f"{r['outstanding']:>4} {r['total_routed']:>7} "
+              f"{r['total_failures']:>7}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    args = build_parser().parse_args(argv)
+    if args.cmd == "serve":
+        return cmd_serve(args)
+    return cmd_status(args.url, args.as_json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""Fleet HTTP frontend: the one listener clients talk to.
+
+Same stdlib ``ThreadingHTTPServer`` shape as the replica gateway
+(serve/rest.py) — zero extra dependencies, one thread per request — but
+every request is answered by the router, never by a local model:
+
+- ``GET  /``, ``/healthz``  → router liveness
+- ``GET  /readyz``          → 200 only while ≥1 replica is in rotation
+- ``GET  /fleetz``          → JSON fleet status (replicas, balancer,
+  per-replica counters) — what ``edgemesh fleet status --json`` prints
+- ``GET  /metrics``         → Prometheus text exposition of the router's
+  obs registry (routed/retried/hedged/shed counters, latency histogram)
+- ``POST /generate``        → routed to a replica (retries/hedging/drain
+  semantics in fleet/router.py); optional ``X-Edgemesh-Deadline-S`` header
+  caps this request's total budget
+- ``POST /replicas/register``   {"id": ..., "url": ...}
+- ``POST /replicas/deregister`` {"id": ...}
+- ``POST /replicas/drain``      {"id": ...} → graceful drain (blocks until
+  drained or the drain timeout; the threaded server keeps routing)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from edgemesh.serve import httputil
+
+log = logging.getLogger("edgemesh.fleet")
+
+
+def _make_handler(router, request_timeout_s: float | None):
+    class Handler(BaseHTTPRequestHandler):
+        # Per-connection socket timeout (StreamRequestHandler.setup applies
+        # it): a stalled client costs one bounded read, not a pinned thread.
+        timeout = request_timeout_s
+
+        def _send(self, code: int, payload: dict, extra: dict | None = None):
+            httputil.send_json(self, code, payload, extra=extra)
+
+        def _send_text(self, code: int, text: str, content_type: str):
+            httputil.send_text(self, code, text, content_type=content_type)
+
+        def do_GET(self):
+            if self.path in ("/", "/healthz"):
+                self._send(200, {"status": "ok", "service": "edgemesh-fleet"})
+            elif self.path == "/readyz":
+                n = len(router.registry.available())
+                self._send(200 if n else 503, {"ready": n > 0, "available": n})
+            elif self.path == "/fleetz":
+                self._send(200, router.status())
+            elif self.path == "/metrics":
+                self._send_text(
+                    200, router.obs.render(),
+                    content_type="text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def _read_json(self) -> dict | None:
+            """Parse the request body; answers the 400 itself on bad input
+            (shared with the replica gateway via serve/httputil.py)."""
+            return httputil.read_json_body(self)
+
+        def do_POST(self):
+            try:
+                if self.path == "/generate":
+                    payload = self._read_json()
+                    if payload is None:
+                        return
+                    ok, deadline_s = httputil.read_deadline_header(self)
+                    if not ok:
+                        return
+                    status, body, extra = router.handle_generate(
+                        payload, deadline_s=deadline_s
+                    )
+                    self._send(status, body, extra=extra)
+                elif self.path in ("/replicas/register", "/replicas/deregister",
+                                   "/replicas/drain"):
+                    payload = self._read_json()
+                    if payload is None:
+                        return
+                    self._admin(payload)
+                else:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+            except TimeoutError:  # stalled client: drop, don't pin the thread
+                log.warning("client socket timeout on %s", self.path)
+                self.close_connection = True
+            except Exception as exc:  # the frontend must survive bad requests
+                log.exception("fleet frontend request failed")
+                try:
+                    self._send(500, {"error": str(exc)})
+                except OSError:
+                    pass
+
+        def _admin(self, payload: dict):
+            rid = payload.get("id")
+            if not rid:
+                self._send(400, {"error": "missing 'id' field"})
+                return
+            if self.path == "/replicas/register":
+                url = payload.get("url")
+                if not url:
+                    self._send(400, {"error": "missing 'url' field"})
+                    return
+                router.registry.register(rid, url)
+                self._send(200, {"registered": rid, "url": url})
+            elif self.path == "/replicas/deregister":
+                self._send(200, {"deregistered": router.registry.deregister(rid)})
+            else:  # /replicas/drain
+                self._send(200, router.drain_replica(rid))
+
+        def log_message(self, fmt, *args):
+            log.info("%s %s", self.address_string(), fmt % args)
+
+    return Handler
+
+
+def serve_fleet(router, host: str = "0.0.0.0", port: int = 8000,
+                block: bool = True, request_timeout_s: float | None = 300.0):
+    """Start the fleet frontend. ``srv.router`` exposes the router for
+    lifecycle management; non-blocking mode returns the live server (same
+    contract as serve_rest)."""
+    server = ThreadingHTTPServer((host, port), _make_handler(router, request_timeout_s))
+    server.router = router
+    log.info("edgemesh fleet frontend on %s:%d", host, port)
+    if block:
+        server.serve_forever()
+        return server
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server

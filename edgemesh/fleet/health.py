@@ -1,0 +1,119 @@
+"""Periodic health probes: active membership truth for the registry.
+
+The prober hits each replica's ``/readyz`` (NOT ``/healthz``) on a fixed
+interval: readiness is the routing question — a draining replica is alive
+(``/healthz`` 200) but must leave rotation, and ``/readyz`` is the endpoint
+that encodes that distinction (serve/rest.py). Probe outcomes feed the same
+consecutive-failure/success accounting the router's passive checks use
+(fleet/registry.py ``probe_result``):
+
+- ``unhealthy_after`` consecutive failures demote healthy → unhealthy;
+- ``healthy_after`` consecutive successes promote unhealthy → healthy —
+  recovery is automatic, a restarted/un-stalled replica rejoins rotation
+  without operator action;
+- draining/removed replicas are still probed (their inflight count rides
+  the ``/readyz`` body, which ``drain_replica`` polls) but never change
+  state from here.
+
+Per-replica obs: ``edgemesh_fleet_probes_total{replica,result}`` and an
+``edgemesh_fleet_replica_up{replica}`` gauge (1 healthy / 0 anything else)
+so a scrape shows rotation membership directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from edgemesh.fleet.transport import HttpTransport, TransportError
+
+log = logging.getLogger("edgemesh.fleet")
+
+
+class HealthProber:
+    """Background ``/readyz`` prober driving registry state transitions."""
+
+    def __init__(self, registry, transport=None, interval_s: float = 2.0,
+                 timeout_s: float = 1.0, unhealthy_after: int = 2,
+                 healthy_after: int = 1, obs_registry=None) -> None:
+        from edgemesh.obs import get_registry
+
+        self.registry = registry
+        self.transport = transport or HttpTransport()
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.unhealthy_after = unhealthy_after
+        self.healthy_after = healthy_after
+        reg = obs_registry or get_registry()
+        self._probes = reg.counter(
+            "edgemesh_fleet_probes_total",
+            "Health probes by replica and result", ("replica", "result"),
+        )
+        self._up = reg.gauge(
+            "edgemesh_fleet_replica_up",
+            "1 when the replica is in rotation (healthy), else 0",
+            ("replica",),
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- one pass (directly callable from tests) -----------------------------
+
+    def probe_once(self) -> dict[str, str]:
+        """Probe every registered replica once; returns {rid: state}."""
+        states: dict[str, str] = {}
+        for rep in self.registry.replicas():
+            ok, err = self._probe(rep)
+            self._probes.labels(replica=rep.rid, result="ok" if ok else "fail").inc()
+            state = self.registry.probe_result(
+                rep.rid, ok, healthy_after=self.healthy_after,
+                unhealthy_after=self.unhealthy_after, error=err,
+            )
+            if state is not None:
+                states[rep.rid] = state
+                self._up.labels(replica=rep.rid).set(1.0 if state == "healthy" else 0.0)
+        return states
+
+    def _probe(self, rep) -> tuple[bool, str]:
+        try:
+            status, _ = self.transport.get_json(
+                rep.url("/readyz"), timeout_s=self.timeout_s
+            )
+        except TransportError as e:
+            return False, str(e)
+        # /readyz answers 503 while draining — alive but not routable. The
+        # registry keeps its draining state either way; for healthy/unhealthy
+        # replicas only a 200 counts as ready.
+        return status == 200, "" if status == 200 else f"readyz status {status}"
+
+    # -- background loop -----------------------------------------------------
+
+    def start(self) -> "HealthProber":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-prober", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.interval_s + self.timeout_s + 1.0)
+            if t.is_alive():
+                # Mid-pass on stalled replicas: keep the handle so a
+                # subsequent start() cannot clear _stop under the old loop
+                # and leave two probers racing the same registry.
+                return
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # a probe pass must never kill the loop
+                log.exception("health probe pass failed")
+            self._stop.wait(self.interval_s)

@@ -1,0 +1,206 @@
+"""Replica registry — the fleet's single source of membership truth.
+
+The reference's "cluster map" is a static IP table in a README
+(``Code/gRPC/README.md:9-14``) baked into every client stub; a dead Jetson
+stays in the map forever. Here membership is a live, thread-safe registry:
+replicas enter via static config or runtime ``/replicas/register``, leave
+via deregister or drain, and move through an explicit state machine driven
+by the health prober (fleet/health.py) and the router's passive failure
+accounting (fleet/router.py):
+
+    healthy ──(probe/route failures ≥ threshold)──► unhealthy
+    unhealthy ──(probe successes ≥ threshold)─────► healthy
+    any ──drain_replica()──► draining ──(in-flight hits 0)──► removed
+
+Registration is fail-open: a newly registered replica is ``healthy`` and
+routable immediately (the prober demotes it within one interval if it
+isn't), matching how production balancers admit backends. ``draining`` and
+``removed`` are terminal for routing — only an explicit re-``register``
+revives a removed replica.
+
+Every mutation happens under one lock; ``acquire``/``release`` make
+balancer choice + outstanding-counter bookkeeping atomic so
+least-outstanding balancing never reads a torn counter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterable
+
+STATES = ("healthy", "unhealthy", "draining", "removed")
+
+
+@dataclass
+class Replica:
+    """One serving backend (a ``serve_rest`` process) behind the router."""
+
+    rid: str
+    base_url: str  # e.g. "http://127.0.0.1:8101", no trailing slash
+    state: str = "healthy"
+    outstanding: int = 0  # requests currently routed here, not yet finished
+    consecutive_failures: int = 0  # probe + route failures since last success
+    consecutive_successes: int = 0
+    total_routed: int = 0
+    total_failures: int = 0
+    last_probe_ts: float | None = None
+    last_error: str = ""
+    meta: dict = field(default_factory=dict)  # operator annotations (pid, ...)
+
+    def url(self, path: str) -> str:
+        return self.base_url.rstrip("/") + path
+
+    def routable(self) -> bool:
+        return self.state == "healthy"
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.rid,
+            "url": self.base_url,
+            "state": self.state,
+            "outstanding": self.outstanding,
+            "consecutive_failures": self.consecutive_failures,
+            "total_routed": self.total_routed,
+            "total_failures": self.total_failures,
+            "last_probe_ts": self.last_probe_ts,
+            "last_error": self.last_error,
+            **({"meta": self.meta} if self.meta else {}),
+        }
+
+
+class ReplicaRegistry:
+    """Thread-safe replica membership + routing bookkeeping."""
+
+    def __init__(self, replicas: Iterable[tuple[str, str]] = ()) -> None:
+        self._lock = threading.RLock()
+        self._replicas: dict[str, Replica] = {}
+        for rid, url in replicas:
+            self.register(rid, url)
+
+    # -- membership ----------------------------------------------------------
+
+    def register(self, rid: str, base_url: str, **meta) -> Replica:
+        """Add (or revive) a replica. Fail-open: immediately routable.
+
+        Re-registering a LIVE replica at the same URL is idempotent — the
+        existing object is revived in place so in-flight ``outstanding``
+        accounting survives (a fresh object at outstanding=0 would let a
+        drain declare the replica safe while requests still run on it).
+        A changed URL is a genuinely new backend and replaces the entry."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None and rep.base_url == base_url:
+                rep.state = "healthy"
+                rep.consecutive_failures = 0
+                rep.consecutive_successes = 0
+                if meta:
+                    rep.meta.update(meta)
+                return rep
+            rep = Replica(rid=rid, base_url=base_url, meta=dict(meta))
+            self._replicas[rid] = rep
+            return rep
+
+    def deregister(self, rid: str) -> bool:
+        with self._lock:
+            return self._replicas.pop(rid, None) is not None
+
+    def get(self, rid: str) -> Replica | None:
+        with self._lock:
+            return self._replicas.get(rid)
+
+    def replicas(self) -> list[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def available(self) -> list[Replica]:
+        with self._lock:
+            return [r for r in self._replicas.values() if r.routable()]
+
+    def set_state(self, rid: str, state: str) -> None:
+        if state not in STATES:
+            raise ValueError(f"unknown replica state {state!r} (one of {STATES})")
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None:
+                rep.state = state
+
+    # -- routing bookkeeping -------------------------------------------------
+
+    def acquire(self, balancer, prompt: str | None = None,
+                exclude: frozenset | set = frozenset()) -> Replica | None:
+        """Atomically pick a routable replica via ``balancer`` and check out
+        one unit of outstanding work on it. Pair with ``release``."""
+        with self._lock:
+            candidates = [
+                r for r in self._replicas.values()
+                if r.routable() and r.rid not in exclude
+            ]
+            if not candidates:
+                return None
+            rep = balancer.pick(candidates, prompt)
+            if rep is None:
+                return None
+            rep.outstanding += 1
+            return rep
+
+    def release(self, rid: str, ok: bool, demote_after: int = 2,
+                error: str = "") -> None:
+        """Check one unit of work back in, with passive health accounting:
+        ``demote_after`` consecutive failures (route OR probe) demote a
+        healthy replica to ``unhealthy`` — the prober re-promotes it."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return  # deregistered mid-flight: nothing to account
+            rep.outstanding = max(0, rep.outstanding - 1)
+            if ok:
+                rep.total_routed += 1
+                rep.consecutive_failures = 0
+                rep.consecutive_successes += 1
+            else:
+                rep.total_failures += 1
+                rep.consecutive_successes = 0
+                rep.consecutive_failures += 1
+                if error:
+                    rep.last_error = error
+                if (
+                    rep.state == "healthy"
+                    and rep.consecutive_failures >= demote_after
+                ):
+                    rep.state = "unhealthy"
+
+    def probe_result(self, rid: str, ok: bool, healthy_after: int = 1,
+                     unhealthy_after: int = 2, error: str = "") -> str | None:
+        """Record one health-probe outcome; returns the (possibly new) state.
+        Draining/removed replicas keep their state — a drain must never be
+        un-drained by a passing probe."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return None
+            rep.last_probe_ts = time.time()
+            if ok:
+                rep.consecutive_failures = 0
+                rep.consecutive_successes += 1
+                if (
+                    rep.state == "unhealthy"
+                    and rep.consecutive_successes >= healthy_after
+                ):
+                    rep.state = "healthy"
+            else:
+                rep.consecutive_successes = 0
+                rep.consecutive_failures += 1
+                if error:
+                    rep.last_error = error
+                if (
+                    rep.state == "healthy"
+                    and rep.consecutive_failures >= unhealthy_after
+                ):
+                    rep.state = "unhealthy"
+            return rep.state
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [r.to_dict() for r in self._replicas.values()]

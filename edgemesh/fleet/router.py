@@ -1,0 +1,361 @@
+"""FleetRouter — the request path that makes multiple replicas one service.
+
+TPI-LLM and the profiling-driven edge-inference line both land on the same
+conclusion: once more than one serving unit exists, the router layer — not
+the kernels — owns tail latency. This router gives the request path real
+robustness semantics on top of the replica registry:
+
+- **Deadlines.** Every request carries a deadline (client-supplied or
+  ``default_deadline_s``); the remaining budget is propagated to replicas
+  as ``X-Edgemesh-Deadline-S`` (serve/rest.py refuses expired work with a
+  504) and bounds every per-attempt timeout, backoff sleep, and hedge wait
+  — the router can never spend longer on a request than the client asked.
+- **Bounded retries.** Transport failures and replica 5xx are retried up
+  to ``max_attempts`` times with jittered exponential backoff
+  (``backoff_base_s * 2^attempt``, capped, +0..jitter fraction — the
+  standard thundering-herd dampener), each retry on a *different* replica
+  (failed ones are excluded; exclusions reset only when every replica has
+  failed once). 4xx are the client's problem and return immediately.
+- **Hedging.** With ``hedge_after_s`` (fixed) or ``hedge_percentile``
+  (adaptive over a rolling window of observed attempt latencies), an
+  attempt that outlives the hedge delay gets a second attempt fired at
+  another replica; first good answer wins, the loser is abandoned. This
+  converts a stalled replica's tail into one extra request of load.
+- **Admission control.** A bounded in-flight slot pool: past
+  ``max_inflight`` the router sheds with 503 + ``Retry-After`` instead of
+  queueing unboundedly — overload stays visible at the edge.
+- **Graceful drain.** ``drain_replica`` takes a replica out of rotation,
+  calls its ``/drain`` hook, polls ``/readyz`` until in-flight work hits
+  zero, then marks it removed — zero dropped requests by construction.
+
+Obs (per-replica labels throughout): routed/retried/hedged/hedged-won/
+shed/exhausted counters, drain events, an in-flight gauge, and the router
+latency histogram ``edgemesh_fleet_router_seconds`` alongside the engine
+spans (docs/FLEET.md has the catalog).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import threading
+import time
+from collections import deque
+
+from edgemesh.fleet.balancer import make_balancer
+from edgemesh.fleet.transport import HttpTransport, TransportError
+from edgemesh.serve.httputil import DEADLINE_HEADER
+
+log = logging.getLogger("edgemesh.fleet")
+
+
+class FleetRouter:
+    def __init__(
+        self,
+        registry,
+        balancer: str = "least_outstanding",
+        transport=None,
+        obs_registry=None,
+        max_attempts: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+        backoff_jitter: float = 0.5,
+        default_deadline_s: float = 60.0,
+        attempt_timeout_s: float = 30.0,
+        hedge_after_s: float = 0.0,
+        hedge_percentile: float = 0.0,
+        max_inflight: int = 64,
+        demote_after: int = 2,
+        rng: random.Random | None = None,
+    ) -> None:
+        from edgemesh.obs import get_registry
+
+        self.registry = registry
+        self.balancer = make_balancer(balancer) if isinstance(balancer, str) else balancer
+        self.transport = transport or HttpTransport()
+        self.max_attempts = max_attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.backoff_jitter = backoff_jitter
+        self.default_deadline_s = default_deadline_s
+        self.attempt_timeout_s = attempt_timeout_s
+        self.hedge_after_s = hedge_after_s
+        self.hedge_percentile = hedge_percentile
+        self.max_inflight = max_inflight
+        self.demote_after = demote_after
+        self._rng = rng or random.Random(0)
+        self._sleep = time.sleep  # injectable: tests pin the backoff schedule
+        self._slots = threading.BoundedSemaphore(max_inflight)
+        # Rolling successful-attempt latencies for the adaptive hedge delay.
+        # Locked: sorting the deque while another handler thread appends
+        # raises "deque mutated during iteration".
+        self._lat_lock = threading.Lock()
+        self._lat_window: deque[float] = deque(maxlen=256)
+
+        reg = obs_registry or get_registry()
+        self.obs = reg
+        self._routed = reg.counter(
+            "edgemesh_fleet_routed_total",
+            "Requests answered, by replica that answered", ("replica",),
+        )
+        self._retried = reg.counter(
+            "edgemesh_fleet_retried_total",
+            "Failed attempts that triggered a retry, by replica and reason",
+            ("replica", "reason"),
+        )
+        self._hedged = reg.counter(
+            "edgemesh_fleet_hedged_total",
+            "Hedge attempts fired, by hedge replica", ("replica",),
+        )
+        self._hedged_won = reg.counter(
+            "edgemesh_fleet_hedged_won_total",
+            "Hedge attempts that beat the primary, by replica", ("replica",),
+        )
+        self._shed = reg.counter(
+            "edgemesh_fleet_shed_total",
+            "Requests shed without reaching a replica, by reason", ("reason",),
+        )
+        self._exhausted = reg.counter(
+            "edgemesh_fleet_exhausted_total",
+            "Requests that failed every attempt",
+        )
+        self._drain_events = reg.counter(
+            "edgemesh_fleet_drain_total",
+            "Drain lifecycle events", ("replica", "event"),
+        )
+        self._inflight_gauge = reg.gauge(
+            "edgemesh_fleet_inflight", "Requests currently inside the router",
+        )
+        self._latency = reg.histogram(
+            "edgemesh_fleet_router_seconds",
+            "End-to-end router request latency (admission to answer)",
+        )
+
+    # -- request path --------------------------------------------------------
+
+    def handle_generate(self, payload: dict, deadline_s: float | None = None,
+                        path: str = "/generate"):
+        """Route one request. Returns ``(status, body, headers)`` — the
+        HTTP frontend writes them verbatim; in-process callers (tests,
+        benchmarks) read them directly."""
+        t0 = time.monotonic()
+        if not self._slots.acquire(blocking=False):
+            self._shed.labels(reason="overload").inc()
+            return 503, {"error": "router at capacity", "max_inflight": self.max_inflight}, \
+                {"Retry-After": "1"}
+        self._inflight_gauge.inc()
+        try:
+            return self._route(payload, t0, deadline_s, path)
+        finally:
+            self._inflight_gauge.dec()
+            self._slots.release()
+
+    def _route(self, payload, t0, deadline_s, path):
+        deadline = t0 + (deadline_s if deadline_s is not None else self.default_deadline_s)
+        prompt = payload.get("question") if isinstance(payload, dict) else None
+        excluded: set[str] = set()
+        last_error: str = "no attempt made"
+        for attempt in range(self.max_attempts):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._shed.labels(reason="deadline").inc()
+                return 504, {"error": "deadline exceeded", "attempts": attempt,
+                             "last_error": last_error}, {}
+            rep = self.registry.acquire(self.balancer, prompt=prompt, exclude=excluded)
+            if rep is None and excluded:
+                # Every routable replica has failed once this request:
+                # reset exclusions rather than give up with replicas alive.
+                excluded.clear()
+                rep = self.registry.acquire(self.balancer, prompt=prompt, exclude=excluded)
+            if rep is None:
+                self._shed.labels(reason="no_replica").inc()
+                return 503, {"error": "no available replica"}, {"Retry-After": "1"}
+            outcome = self._dispatch(rep, payload, path, deadline, prompt, excluded)
+            if outcome[0] == "ok":
+                _, rid, status, body = outcome
+                self._routed.labels(replica=rid).inc()
+                self._latency.observe(time.monotonic() - t0)
+                return status, body, {
+                    "X-Edgemesh-Replica": rid,
+                    "X-Edgemesh-Attempts": str(attempt + 1),
+                }
+            failures = outcome[1]  # [(rid, reason, detail), ...]
+            for rid, reason, detail in failures:
+                excluded.add(rid)
+                last_error = f"{rid}: {reason}: {detail}"
+                log.warning("attempt %d on %s failed (%s): %s",
+                            attempt + 1, rid, reason, detail)
+            if attempt + 1 < self.max_attempts:
+                for rid, reason, _ in failures:
+                    self._retried.labels(replica=rid, reason=reason).inc()
+                self._sleep(self._backoff(attempt, deadline))
+        self._exhausted.inc()
+        return 502, {"error": "all attempts failed",
+                     "attempts": self.max_attempts,
+                     "last_error": last_error}, {}
+
+    def _backoff(self, attempt: int, deadline: float) -> float:
+        delay = min(self.backoff_cap_s, self.backoff_base_s * (2 ** attempt))
+        delay *= 1.0 + self.backoff_jitter * self._rng.random()
+        return max(0.0, min(delay, deadline - time.monotonic()))
+
+    # -- attempts ------------------------------------------------------------
+
+    def _attempt_one(self, rep, payload, path, deadline):
+        """One checked-out attempt → ("ok", rid, status, body) for any
+        answered status < 500, else ("fail", rid, reason, detail)."""
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            self.registry.release(rep.rid, ok=False, demote_after=self.demote_after,
+                                  error="deadline exceeded before dispatch")
+            return ("fail", rep.rid, "deadline", "expired before dispatch")
+        timeout_s = min(self.attempt_timeout_s, remaining)
+        headers = {DEADLINE_HEADER: f"{remaining:.3f}"}
+        t0 = time.monotonic()
+        try:
+            status, body = self.transport.post_json(
+                rep.url(path), payload, timeout_s=timeout_s, headers=headers
+            )
+        except TransportError as e:
+            self.registry.release(rep.rid, ok=False, demote_after=self.demote_after,
+                                  error=str(e))
+            return ("fail", rep.rid, "connect", str(e))
+        if status >= 500:
+            self.registry.release(rep.rid, ok=False, demote_after=self.demote_after,
+                                  error=f"status {status}")
+            return ("fail", rep.rid, f"status_{status}", str(body.get("error", body))[:200])
+        self.registry.release(rep.rid, ok=True)
+        with self._lat_lock:
+            self._lat_window.append(time.monotonic() - t0)
+        return ("ok", rep.rid, status, body)
+
+    def _hedge_delay(self) -> float | None:
+        if self.hedge_after_s:
+            return self.hedge_after_s
+        if self.hedge_percentile:
+            with self._lat_lock:
+                xs = sorted(self._lat_window)
+            if len(xs) >= 16:
+                return xs[min(len(xs) - 1, int(self.hedge_percentile * len(xs)))]
+        return None
+
+    def _dispatch(self, rep, payload, path, deadline, prompt, excluded):
+        """One attempt round, hedged when configured. Returns
+        ("ok", rid, status, body) or ("fail", [(rid, reason, detail), ...])."""
+        hedge_delay = self._hedge_delay()
+        if hedge_delay is None or hedge_delay >= (deadline - time.monotonic()):
+            out = self._attempt_one(rep, payload, path, deadline)
+            return out if out[0] == "ok" else ("fail", [out[1:]])
+
+        results: queue.Queue = queue.Queue()
+
+        def run(replica, is_hedge):
+            results.put((is_hedge, self._attempt_one(replica, payload, path, deadline)))
+
+        threading.Thread(target=run, args=(rep, False), daemon=True).start()
+        try:
+            first = results.get(timeout=hedge_delay)
+        except queue.Empty:
+            first = None
+        if first is not None:
+            if first[1][0] == "ok":
+                return first[1]  # primary answered inside the hedge window
+            # A FAST failure is not a tail-latency event: hand it to the
+            # normal retry path (backoff + retried counters) instead of
+            # firing a zero-backoff failover dressed up as a hedge — the
+            # hedged metrics must mean "the primary was slow", nothing else.
+            return ("fail", [first[1][1:]])
+
+        hedge_rep = self.registry.acquire(
+            self.balancer, prompt=prompt, exclude=excluded | {rep.rid}
+        )
+        if hedge_rep is not None:
+            self._hedged.labels(replica=hedge_rep.rid).inc()
+            threading.Thread(target=run, args=(hedge_rep, True), daemon=True).start()
+
+        # Drain results until a winner or both attempts have reported. The
+        # per-attempt transport timeout bounds the usual stalls, but it is
+        # a per-socket-op bound — a replica trickling one byte per read
+        # never trips it — so the get() itself is ALSO capped by the
+        # request deadline: past it the attempts are abandoned and the
+        # router answers within the client's budget.
+        pending = 2 if hedge_rep is not None else 1
+        failures = []
+        while pending > 0:
+            try:
+                is_hedge, out = results.get(
+                    timeout=max(0.05, deadline - time.monotonic())
+                )
+            except queue.Empty:
+                failures.append(
+                    (rep.rid, "deadline", "attempt outlived the request deadline")
+                )
+                break
+            pending -= 1
+            if out[0] == "ok":
+                if is_hedge:
+                    self._hedged_won.labels(replica=out[1]).inc()
+                return out
+            failures.append(out[1:])
+        return ("fail", failures or [(rep.rid, "hedge", "no attempt completed")])
+
+    # -- drain ---------------------------------------------------------------
+
+    def drain_replica(self, rid: str, timeout_s: float = 60.0,
+                      poll_s: float = 0.2) -> dict:
+        """Gracefully remove ``rid``: out of rotation immediately, then the
+        replica's ``/drain`` hook fires and ``/readyz`` is polled until its
+        in-flight count reaches zero (or ``timeout_s``). In-flight requests
+        finish; only then is the replica safe to stop."""
+        rep = self.registry.get(rid)
+        if rep is None:
+            return {"replica": rid, "error": "unknown replica"}
+        self.registry.set_state(rid, "draining")
+        self._drain_events.labels(replica=rid, event="started").inc()
+        try:
+            self.transport.post_json(rep.url("/drain"), {},
+                                     timeout_s=self.attempt_timeout_s)
+        except TransportError as e:
+            log.warning("drain hook on %s failed: %s", rid, e)
+        deadline = time.monotonic() + timeout_s
+        inflight: int | None = None
+        fail_streak = 0
+        while time.monotonic() < deadline:
+            # Router-tracked outstanding covers requests we routed; the
+            # replica's own /readyz inflight covers direct clients too.
+            try:
+                _, body = self.transport.get_json(
+                    rep.url("/readyz"), timeout_s=self.attempt_timeout_s
+                )
+                inflight = body.get("inflight")
+                fail_streak = 0
+            except TransportError:
+                # One failed poll is indistinguishable from a GC pause; only
+                # a STREAK means the replica is actually gone (nothing left
+                # to drain). A transient error must not declare the drain
+                # complete while direct-client requests still run.
+                fail_streak += 1
+                inflight = None
+                if fail_streak >= 3:
+                    inflight = 0
+            if inflight == 0 and rep.outstanding == 0:
+                break
+            self._sleep(poll_s)
+        drained = inflight == 0 and rep.outstanding == 0
+        self.registry.set_state(rid, "removed")
+        self._drain_events.labels(
+            replica=rid, event="completed" if drained else "timeout"
+        ).inc()
+        return {"replica": rid, "drained": drained, "inflight": inflight}
+
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "balancer": getattr(self.balancer, "name", type(self.balancer).__name__),
+            "max_inflight": self.max_inflight,
+            "max_attempts": self.max_attempts,
+            "replicas": self.registry.snapshot(),
+            "metrics": self.obs.summary(prefix="edgemesh_fleet_"),
+        }

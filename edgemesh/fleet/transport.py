@@ -1,0 +1,71 @@
+"""HTTP transport for the fleet: every call carries an explicit timeout.
+
+One thin seam between the router/prober and the network, for two reasons:
+
+- **Fault semantics.** HTTP status codes are *answers* (a replica's 400 is
+  the client's 400; its 503 is load-shed signal) and come back as values;
+  only transport-level failures — refused connections, resets, timeouts,
+  DNS — raise :class:`TransportError`, which is the router's retry
+  trigger. Collapsing both into exceptions (urllib's default) would make
+  the retry loop re-send requests a replica already answered.
+- **Testability.** Fast-tier tests swap in a fake with the same two
+  methods and script failures without sockets (tests/test_fleet.py).
+
+Timeouts are mandatory by construction (no default-None parameter exists)
+and enforced by lint: edgelint EM108 flags any bare outbound call inside
+``edgemesh/fleet/`` — a stalled replica must cost one bounded attempt,
+never a pinned router thread. Caveat: urllib's timeout is per socket
+operation, not per request — a replica trickling one byte per read never
+trips it. The router layers the request DEADLINE on top (hedge waits and
+result drains are deadline-capped) so even a trickling replica cannot
+hold a client past its budget.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+
+class TransportError(RuntimeError):
+    """Connect/read-level failure (retryable); HTTP statuses are returned,
+    not raised."""
+
+
+def _parse_body(raw: bytes) -> dict:
+    try:
+        payload = json.loads(raw or b"{}")
+    except json.JSONDecodeError:
+        return {"raw": raw.decode("utf-8", "replace")}
+    return payload if isinstance(payload, dict) else {"raw": payload}
+
+
+class HttpTransport:
+    """stdlib-urllib JSON transport (zero extra dependencies, like rest.py)."""
+
+    def get_json(self, url: str, timeout_s: float,
+                 headers: dict | None = None) -> tuple[int, dict]:
+        req = urllib.request.Request(url, headers=dict(headers or {}))
+        return self._run(req, timeout_s)
+
+    def post_json(self, url: str, payload: dict, timeout_s: float,
+                  headers: dict | None = None) -> tuple[int, dict]:
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            url, data=body,
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        return self._run(req, timeout_s)
+
+    @staticmethod
+    def _run(req: urllib.request.Request, timeout_s: float) -> tuple[int, dict]:
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return resp.status, _parse_body(resp.read())
+        except urllib.error.HTTPError as e:
+            # A status line made it back: that IS the replica's answer.
+            return e.code, _parse_body(e.read())
+        except (urllib.error.URLError, TimeoutError, ConnectionError, OSError) as e:
+            reason = getattr(e, "reason", None) or e
+            raise TransportError(f"{req.full_url}: {reason}") from e

@@ -1,3 +1,3 @@
 """Serving front door: the host-side REST gateway."""
 
-from edgemesh.serve.rest import serve_rest  # noqa: F401
+from edgemesh.serve.rest import GatewayServer, serve_rest  # noqa: F401

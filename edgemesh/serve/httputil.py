@@ -1,0 +1,72 @@
+"""Shared stdlib-HTTP handler helpers.
+
+One implementation of JSON responses (with optional extra headers) and
+hardened request-body parsing for BOTH front doors — the replica gateway
+(serve/rest.py) and the fleet frontend (fleet/frontend.py) — so the
+robustness contract (a client-input problem is always a structured 400,
+never a 500) cannot silently diverge between them. Imports nothing beyond
+the stdlib: the fleet must stay importable on hosts with no accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def send_json(handler, code: int, payload: dict,
+              extra: dict | None = None) -> None:
+    body = json.dumps(payload).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    for k, v in (extra or {}).items():
+        handler.send_header(k, v)
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def send_text(handler, code: int, text: str,
+              content_type: str = "text/plain; charset=utf-8") -> None:
+    body = text.encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", content_type)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+DEADLINE_HEADER = "X-Edgemesh-Deadline-S"
+
+
+def read_deadline_header(handler) -> tuple[bool, float | None]:
+    """Parse the propagated per-request deadline header (seconds of budget
+    remaining). Returns ``(ok, seconds)`` — ``(True, None)`` when absent;
+    on a malformed value the 400 has already been answered and ``ok`` is
+    False. Both front doors speak this one contract: the fleet router sets
+    the header on every attempt, the replica gateway refuses expired ones."""
+    raw = handler.headers.get(DEADLINE_HEADER)
+    if raw is None:
+        return True, None
+    try:
+        return True, float(raw)
+    except ValueError:
+        send_json(handler, 400, {"error": f"malformed {DEADLINE_HEADER}"})
+        return False, None
+
+
+def read_json_body(handler) -> dict | None:
+    """Parse the request body; answers the 400 itself on bad input."""
+    try:
+        length = int(handler.headers.get("Content-Length") or 0)
+    except ValueError:
+        send_json(handler, 400, {"error": "malformed Content-Length header"})
+        return None
+    try:
+        payload = json.loads(handler.rfile.read(length) or b"{}")
+    except json.JSONDecodeError:
+        send_json(handler, 400, {"error": "invalid JSON body"})
+        return None
+    if not isinstance(payload, dict):
+        send_json(handler, 400, {"error": "body must be a JSON object"})
+        return None
+    return payload

@@ -8,6 +8,11 @@ kick-off. Stdlib ``http.server`` — zero extra dependencies, threaded.
 
 Endpoints:
 - ``GET  /``          → health + device inventory (the "edge cluster map")
+- ``GET  /healthz``   → liveness: 200 while the process serves at all —
+  stays 200 through a drain (the fleet must not kill a draining replica)
+- ``GET  /readyz``    → readiness: 200 only while accepting NEW work; 503
+  (with the live in-flight count) once draining — what the fleet router's
+  health prober and drain poll actually watch
 - ``GET  /metrics``   → Prometheus text exposition (edgemesh.obs registry:
   request/TTFT/inter-token histograms, KV page + device-memory gauges)
 - ``GET  /stats``     → the legacy JSON status blob (phases, supervisor
@@ -16,6 +21,15 @@ Endpoints:
 - ``POST /generate``  → {"question": str} → ensemble answer JSON
 - ``POST /generate_stream`` → Server-Sent Events: ``data: {"delta": ...}``
   per decoded chunk, then ``data: {"answer": ..., "done": true}``
+- ``POST /drain``     → flip to draining (readyz → 503, new generates →
+  503) and finish in-flight work; the fleet's pre-stop hook
+
+Robustness semantics (what the fleet router relies on): malformed bodies
+are structured 400s (never 500), overload and draining answer 503 +
+``Retry-After``, an already-expired propagated deadline
+(``X-Edgemesh-Deadline-S`` ≤ 0) is refused with 504 before any model work,
+and every connection carries a socket timeout so a stalled client costs a
+bounded read, not a pinned ThreadingHTTPServer thread.
 """
 
 from __future__ import annotations
@@ -26,29 +40,86 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from edgemesh.serve import httputil
+
 log = logging.getLogger("edgemesh.serve")
 
 
-def _make_handler(ensemble, supervisor=None, batcher=None, registry=None):
+class GatewayServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer + serving lifecycle: in-flight request tracking
+    and a ``drain()`` hook (what the fleet router calls — over ``POST
+    /drain`` — before stopping a replica).
+
+    Draining is one-way: new ``/generate*`` work is refused with 503,
+    ``/readyz`` flips to 503 so the prober removes us from rotation, and
+    in-flight requests run to completion. ``drain(wait=True)`` blocks until
+    the in-flight count reaches zero (or ``timeout_s``), after which
+    ``shutdown()`` + ``batcher.close()`` are guaranteed drop-free."""
+
+    def __init__(self, addr, handler):
+        super().__init__(addr, handler)
+        self.batcher = None
+        self.max_inflight = 0  # 0 = unbounded; serve_rest overrides
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def inflight(self) -> int:
+        with self._inflight_cv:
+            return self._inflight
+
+    def begin_request(self) -> str:
+        """Admit one generate request: ``"ok"`` admits; ``"draining"`` /
+        ``"overloaded"`` refuse (the handler answers 503 and must NOT call
+        end_request). Check-and-increment is one atomic step under the
+        lock — a burst of N+1 concurrent requests against
+        ``max_inflight=N`` must shed exactly one, not all of them."""
+        with self._inflight_cv:
+            if self._draining:
+                return "draining"
+            if self.max_inflight and self._inflight >= self.max_inflight:
+                return "overloaded"
+            self._inflight += 1
+            return "ok"
+
+    def end_request(self) -> None:
+        with self._inflight_cv:
+            self._inflight = max(0, self._inflight - 1)
+            self._inflight_cv.notify_all()
+
+    def drain(self, wait: bool = True, timeout_s: float = 60.0) -> dict:
+        with self._inflight_cv:
+            self._draining = True
+            if wait:
+                self._inflight_cv.wait_for(
+                    lambda: self._inflight == 0, timeout=timeout_s
+                )
+            inflight = self._inflight
+        log.info("gateway draining (inflight=%d)", inflight)
+        return {"draining": True, "drained": inflight == 0, "inflight": inflight}
+
+
+def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
+                  request_timeout_s=None):
     from edgemesh.obs import get_registry
 
     class Handler(BaseHTTPRequestHandler):
-        def _send(self, code: int, payload: dict):
-            body = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+        # Per-connection socket timeout (StreamRequestHandler.setup applies
+        # it to the request socket): a client that stalls mid-body or never
+        # reads its response costs one bounded read/write, not a pinned
+        # ThreadingHTTPServer thread.
+        timeout = request_timeout_s
+
+        def _send(self, code: int, payload: dict, extra: dict | None = None):
+            httputil.send_json(self, code, payload, extra=extra)
 
         def _send_text(self, code: int, text: str,
                        content_type: str = "text/plain; charset=utf-8"):
-            body = text.encode()
-            self.send_response(code)
-            self.send_header("Content-Type", content_type)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            httputil.send_text(self, code, text, content_type=content_type)
 
         def _stats_payload(self) -> dict:
             from edgemesh.utils.tracing import phase_report
@@ -74,6 +145,20 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None):
                         "agents": [a.role for a in ensemble.qa_agents]
                         + ([ensemble.refiner.role] if ensemble.refiner else []),
                     },
+                )
+            elif self.path == "/healthz":
+                # Liveness only: a DRAINING replica is still alive (it must
+                # finish in-flight work before the fleet stops it).
+                self._send(200, {"status": "ok"})
+            elif self.path == "/readyz":
+                # Readiness: what rotation membership keys on. Carries the
+                # live in-flight count — the fleet's drain poll reads it to
+                # know when this replica is safe to stop.
+                draining = self.server.draining
+                self._send(
+                    503 if draining else 200,
+                    {"ready": not draining, "draining": draining,
+                     "inflight": self.server.inflight()},
                 )
             elif self.path == "/metrics":
                 # Prometheus text exposition from the obs registry (device
@@ -139,13 +224,64 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None):
                 except OSError:
                     pass
 
+        def _read_json(self) -> dict | None:
+            """Parse the request body; answers the 400 itself on bad input —
+            a client-input problem is always a structured 400, never a 500
+            (shared with the fleet frontend via serve/httputil.py)."""
+            return httputil.read_json_body(self)
+
         def do_POST(self):
+            try:
+                self._post()
+            except TimeoutError:
+                # Stalled client mid-read/write: drop the connection — the
+                # per-connection socket timeout exists precisely so this
+                # thread is reclaimed instead of pinned forever.
+                log.warning("client socket timeout on %s", self.path)
+                self.close_connection = True
+
+        def _post(self):
+            if self.path == "/drain":
+                # The fleet's pre-stop hook: flip to draining NOW (readyz →
+                # 503, new generates → 503) without blocking the admin call
+                # on in-flight work — the caller polls /readyz for
+                # inflight == 0 (fleet/router.drain_replica).
+                self._send(200, self.server.drain(wait=False))
+                return
             if self.path not in ("/generate", "/generate_stream"):
                 self._send(404, {"error": f"unknown path {self.path}"})
                 return
+            ok, deadline_s = httputil.read_deadline_header(self)
+            if not ok:
+                return
+            if deadline_s is not None and deadline_s <= 0:
+                # The router's budget is already spent: refuse before any
+                # model work — the answer could only arrive dead.
+                self._send(504, {"error": "propagated deadline already expired"})
+                return
+            payload = self._read_json()
+            if payload is None:
+                return
+            # Bounded admission: draining and overload both shed with an
+            # honest 503 + Retry-After instead of queueing every thread on
+            # the engine (the fleet router retries elsewhere).
+            verdict = self.server.begin_request()
+            if verdict == "draining":
+                self._send(503, {"error": "draining: not accepting new requests"},
+                           extra={"Retry-After": "1"})
+                return
+            if verdict == "overloaded":
+                self._send(503, {"error": "overloaded",
+                                 "max_inflight": self.server.max_inflight},
+                           extra={"Retry-After": "1"})
+                return
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                payload = json.loads(self.rfile.read(length) or b"{}")
+                self._generate(payload)
+            finally:
+                self.server.end_request()
+
+        def _generate(self, payload: dict):
+            try:
                 question = payload.get("question")
                 if not question:
                     self._send(400, {"error": "missing 'question' field"})
@@ -200,8 +336,6 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None):
                 else:
                     result = ensemble.answer(question)
                 self._send(200, result)
-            except json.JSONDecodeError:
-                self._send(400, {"error": "invalid JSON body"})
             except Exception as exc:  # serving loop must survive bad requests
                 log.exception("generate failed")
                 self._send(500, {"error": str(exc)})
@@ -261,7 +395,8 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
                supervisor=None, batch: int = 0, batch_wait_s: float = 0.02,
                continuous: bool = False, kv_backend: str = "dense",
                kv_page_size: int = 64, admission: str = "fifo",
-               span_log=None, registry=None):
+               span_log=None, registry=None, max_inflight: int = 0,
+               request_timeout_s: float | None = 300.0):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
@@ -286,7 +421,14 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     ``span_log`` (a JSONL path, continuous only) flushes one request-span
     record per retirement — replayable offline via ``edgemesh obs``.
     ``registry`` overrides the process-default obs registry that /metrics
-    and /statusz read (tests isolate through it)."""
+    and /statusz read (tests isolate through it).
+
+    ``max_inflight`` bounds concurrently-admitted generate requests (past
+    it: 503 + Retry-After; 0 = unbounded). ``request_timeout_s`` is the
+    per-connection socket timeout (None disables). The returned server is a
+    :class:`GatewayServer`: ``srv.drain()`` (or ``POST /drain``) stops
+    admission, flips ``/readyz`` to 503, and lets in-flight work finish —
+    the fleet router's pre-stop contract (edgemesh/fleet/)."""
     from edgemesh.obs import register_device_gauges
 
     register_device_gauges(registry)
@@ -336,13 +478,16 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
 
         backend = ensemble.answer_batch if supervisor is None else supervisor.call
         batcher = DynamicBatcher(backend, max_batch=batch, max_wait_s=batch_wait_s)
-    server = ThreadingHTTPServer(
-        (host, port), _make_handler(ensemble, supervisor, batcher, registry)
+    server = GatewayServer(
+        (host, port),
+        _make_handler(ensemble, supervisor, batcher, registry,
+                      request_timeout_s=request_timeout_s),
     )
     # Expose the batcher/engine for lifecycle management: srv.shutdown()
     # stops only the HTTP loop — an engine's resident worker thread and
     # KV pools need srv.batcher.close() (tests and embedders rely on it).
     server.batcher = batcher
+    server.max_inflight = max_inflight
     log.info("edgemesh REST gateway on %s:%d", host, port)
     if block:
         server.serve_forever()

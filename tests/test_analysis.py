@@ -272,6 +272,70 @@ def test_em107_sees_aliased_clocks_and_honors_disable():
 
 
 # ---------------------------------------------------------------------------
+# EM108 fleet-missing-timeout
+# ---------------------------------------------------------------------------
+
+_EM108_SRC = (
+    "import urllib.request\n"
+    "def probe(url):\n"
+    "    return urllib.request.urlopen(url)\n"
+)
+
+
+def test_em108_fires_on_bare_urlopen_in_fleet_only():
+    findings = lint_source(_EM108_SRC, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM108"}
+    assert findings[0].severity == "error"
+    assert "timeout" in findings[0].message
+    # Outside the fleet the rule is silent (rest.py never dials out).
+    assert lint_source(_EM108_SRC, path="edgemesh/serve/rest.py") == []
+
+
+def test_em108_quiet_with_timeout_kwarg_or_positional():
+    kwarg = _EM108_SRC.replace("urlopen(url)", "urlopen(url, timeout=2.0)")
+    assert lint_source(kwarg, path="edgemesh/fleet/router.py") == []
+    # urlopen(url, data, timeout) — third positional IS the timeout.
+    positional = _EM108_SRC.replace("urlopen(url)", "urlopen(url, None, 2.0)")
+    assert lint_source(positional, path="edgemesh/fleet/router.py") == []
+
+
+def test_em108_sees_aliased_imports_and_sockets():
+    src = (
+        "from urllib.request import urlopen\n"
+        "import socket\n"
+        "def dial(url, addr):\n"
+        "    a = urlopen(url)\n"
+        "    b = socket.create_connection(addr)\n"
+        "    c = socket.create_connection(addr, 1.0)  # timeout positional\n"
+        "    return a, b, c\n"
+    )
+    findings = lint_source(src, path="edgemesh/fleet/health.py")
+    assert [f.rule for f in findings] == ["EM108", "EM108"]
+    assert findings[0].line == 4 and findings[1].line == 5
+
+
+def test_em108_honors_inline_disable():
+    quiet = _EM108_SRC.replace(
+        "    return urllib.request.urlopen(url)",
+        "    return urllib.request.urlopen(url)  # edgelint: disable=EM108",
+    )
+    assert lint_source(quiet, path="edgemesh/fleet/router.py") == []
+
+
+def test_em108_fleet_transport_is_clean():
+    # The shipped transport is the reference implementation of the rule:
+    # every outbound call it makes must carry a timeout.
+    from pathlib import Path
+
+    from edgemesh.analysis.edgelint import lint_file
+
+    transport = (
+        Path(__file__).resolve().parent.parent / "edgemesh" / "fleet" / "transport.py"
+    )
+    assert [f for f in lint_file(transport) if f.rule == "EM108"] == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + baseline mechanics
 # ---------------------------------------------------------------------------
 

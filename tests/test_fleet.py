@@ -1,0 +1,776 @@
+"""edgemesh.fleet fast tier: balancer choice, backoff schedule, deadline
+propagation, retries/hedging/admission, the drain state machine, and the
+replica gateway's healthz/readyz/drain/hardening endpoints — all against a
+fake transport (no model, no device, loopback sockets only where the HTTP
+layer itself is under test)."""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from edgemesh.fleet import (
+    FleetRouter,
+    HealthProber,
+    ReplicaRegistry,
+    TransportError,
+    make_balancer,
+    serve_fleet,
+)
+from edgemesh.fleet.registry import Replica
+from edgemesh.obs import Registry
+
+
+# ---------------------------------------------------------------------------
+# Fake transport
+# ---------------------------------------------------------------------------
+
+
+class FakeTransport:
+    """Scripted transport: first registered URL substring that matches wins.
+    Handlers return ``(status, body)`` or raise; every call is recorded."""
+
+    def __init__(self):
+        self.calls = []  # (method, url, payload, timeout_s, headers)
+        self._routes = []
+
+    def on(self, substr, handler):
+        self._routes.append((substr, handler))
+        return self
+
+    def _dispatch(self, method, url, payload, timeout_s, headers):
+        self.calls.append((method, url, payload, timeout_s, dict(headers or {})))
+        for substr, handler in self._routes:
+            if substr in url:
+                return handler(url, payload, headers or {})
+        return 200, {"answer": "ok"}
+
+    def get_json(self, url, timeout_s, headers=None):
+        return self._dispatch("GET", url, None, timeout_s, headers)
+
+    def post_json(self, url, payload, timeout_s, headers=None):
+        return self._dispatch("POST", url, payload, timeout_s, headers)
+
+
+def _registry(*rids):
+    reg = ReplicaRegistry()
+    for rid in rids:
+        reg.register(rid, f"http://{rid}")
+    return reg
+
+
+def _router(reg, transport, **kw):
+    kw.setdefault("obs_registry", Registry())
+    kw.setdefault("rng", random.Random(0))
+    return FleetRouter(reg, transport=transport, **kw)
+
+
+def _refuse(url, payload, headers):
+    raise TransportError(f"{url}: connection refused")
+
+
+# ---------------------------------------------------------------------------
+# Registry + balancers
+# ---------------------------------------------------------------------------
+
+
+def test_registry_membership_and_states():
+    reg = _registry("r1", "r2")
+    assert {r.rid for r in reg.available()} == {"r1", "r2"}
+    reg.set_state("r1", "draining")
+    assert {r.rid for r in reg.available()} == {"r2"}
+    assert reg.deregister("r2") and not reg.deregister("r2")
+    assert reg.available() == []
+    # Re-register revives a removed replica, fail-open (routable at once).
+    reg.set_state("r1", "removed")
+    reg.register("r1", "http://r1")
+    assert [r.rid for r in reg.available()] == ["r1"]
+    with pytest.raises(ValueError):
+        reg.set_state("r1", "sideways")
+
+
+def test_registry_release_demotes_after_consecutive_failures():
+    reg = _registry("r1")
+    bal = make_balancer("round_robin")
+    for i in range(2):
+        rep = reg.acquire(bal)
+        assert rep.rid == "r1" and rep.outstanding == 1
+        reg.release("r1", ok=False, demote_after=2, error=f"boom {i}")
+    rep = reg.get("r1")
+    assert rep.state == "unhealthy" and rep.outstanding == 0
+    assert rep.total_failures == 2 and "boom 1" in rep.last_error
+    assert reg.acquire(bal) is None  # unhealthy replicas leave rotation
+
+
+def test_register_same_url_is_idempotent_and_preserves_outstanding():
+    # A duplicate register (operator retry) must NOT replace the live
+    # object: outstanding accounting has to survive or a drain could
+    # declare the replica safe while requests still run on it.
+    reg = _registry("r1")
+    bal = make_balancer("round_robin")
+    rep = reg.acquire(bal)
+    assert rep.outstanding == 1
+    reg.set_state("r1", "unhealthy")
+    revived = reg.register("r1", "http://r1")
+    assert revived is rep and revived.outstanding == 1
+    assert revived.state == "healthy"
+    # A changed URL is a new backend: fresh object.
+    replaced = reg.register("r1", "http://elsewhere")
+    assert replaced is not rep and replaced.outstanding == 0
+
+
+def test_round_robin_cycles_registration_order():
+    reps = [Replica(rid=f"r{i}", base_url="http://x") for i in range(3)]
+    bal = make_balancer("round_robin")
+    picks = [bal.pick(reps).rid for _ in range(6)]
+    assert picks == ["r0", "r1", "r2", "r0", "r1", "r2"]
+
+
+def test_least_outstanding_prefers_idle():
+    reps = [Replica(rid=f"r{i}", base_url="http://x") for i in range(3)]
+    reps[0].outstanding = 3
+    reps[1].outstanding = 1
+    bal = make_balancer("least_outstanding")
+    assert bal.pick(reps).rid == "r2"
+    reps[2].outstanding = 5
+    assert bal.pick(reps).rid == "r1"
+
+
+def test_prefix_affinity_is_sticky_and_stable_under_replica_death():
+    reps = [Replica(rid=f"r{i}", base_url="http://x") for i in range(4)]
+    bal = make_balancer("prefix_affinity", prefix_chars=16)
+    prompts = [f"shared template: question {i}?" for i in range(40)]
+    # Same prefix → same replica, deterministically.
+    owner = {p: bal.pick(reps, p).rid for p in prompts}
+    assert owner == {p: bal.pick(reps, p).rid for p in prompts}
+    # The 16-char prefix is shared here, so ALL land on one replica.
+    assert len(set(owner.values())) == 1
+    # Distinct prefixes spread across replicas.
+    spread = {bal.pick(reps, f"prompt-{i:02d} asks something").rid
+              for i in range(40)}
+    assert len(spread) >= 2
+    # Rendezvous property: killing one replica remaps ONLY its own keys.
+    full = {i: bal.pick(reps, f"prompt-{i:02d} asks something").rid for i in range(40)}
+    dead = reps[1]
+    survivors = [r for r in reps if r is not dead]
+    for i, rid in full.items():
+        if rid != dead.rid:
+            assert bal.pick(survivors, f"prompt-{i:02d} asks something").rid == rid
+
+
+def test_prefix_affinity_spills_when_affine_replica_is_swamped():
+    reps = [Replica(rid=f"r{i}", base_url="http://x") for i in range(3)]
+    bal = make_balancer("prefix_affinity", spill_margin=2)
+    affine = bal.pick(reps, "hot prompt")
+    affine.outstanding = 5  # others idle: margin 5 > 2 → spill
+    spilled = bal.pick(reps, "hot prompt")
+    assert spilled.rid != affine.rid and spilled.outstanding == 0
+
+
+# ---------------------------------------------------------------------------
+# Router: retries, backoff, deadlines, admission, hedging
+# ---------------------------------------------------------------------------
+
+
+def test_router_retries_onto_surviving_replica_and_counts():
+    reg = _registry("r1", "r2")
+    ft = FakeTransport().on("r1", _refuse)
+    router = _router(reg, ft, balancer="round_robin", demote_after=1)
+    status, body, headers = router.handle_generate({"question": "q?"})
+    assert status == 200 and body == {"answer": "ok"}
+    assert headers["X-Edgemesh-Replica"] == "r2"
+    assert headers["X-Edgemesh-Attempts"] == "2"
+    assert reg.get("r1").state == "unhealthy"  # passive demotion
+    m = router.obs.summary(prefix="edgemesh_fleet_")
+    assert m['edgemesh_fleet_routed_total{replica="r2"}'] == 1
+    assert m['edgemesh_fleet_retried_total{replica="r1",reason="connect"}'] == 1
+    assert m["edgemesh_fleet_router_seconds"]["count"] == 1
+
+
+def test_router_retries_5xx_but_returns_4xx_immediately():
+    reg = _registry("r1", "r2")
+    ft = FakeTransport().on("r1", lambda u, p, h: (500, {"error": "engine died"}))
+    router = _router(reg, ft, balancer="round_robin")
+    status, _, headers = router.handle_generate({"question": "q?"})
+    assert status == 200 and headers["X-Edgemesh-Replica"] == "r2"
+
+    ft2 = FakeTransport().on("r1", lambda u, p, h: (400, {"error": "bad body"}))
+    router2 = _router(_registry("r1", "r2"), ft2, balancer="round_robin")
+    status, body, _ = router2.handle_generate({"question": "q?"})
+    assert status == 400 and body["error"] == "bad body"  # the client's 400
+    assert len(ft2.calls) == 1  # no retry on client errors
+
+
+def test_router_exhausts_attempts_with_502():
+    reg = _registry("r1", "r2")
+    ft = FakeTransport().on("http://", _refuse)
+    router = _router(reg, ft, max_attempts=3, backoff_base_s=0.001)
+    status, body, _ = router.handle_generate({"question": "q?"})
+    assert status == 502 and body["attempts"] == 3
+    assert "refused" in body["last_error"]
+    assert router.obs.summary()["edgemesh_fleet_exhausted_total"] == 1
+
+
+def test_router_shed_when_no_replica():
+    router = _router(ReplicaRegistry(), FakeTransport())
+    status, body, headers = router.handle_generate({"question": "q?"})
+    assert status == 503 and headers["Retry-After"] == "1"
+    assert router.obs.summary()['edgemesh_fleet_shed_total{reason="no_replica"}'] == 1
+
+
+def test_backoff_schedule_is_jittered_exponential_and_capped():
+    reg = _registry("r1", "r2")
+    ft = FakeTransport().on("http://", _refuse)
+    router = _router(reg, ft, max_attempts=4, backoff_base_s=0.1,
+                     backoff_cap_s=0.3, backoff_jitter=0.5,
+                     rng=random.Random(42))
+    sleeps = []
+    router._sleep = sleeps.append
+    status, _, _ = router.handle_generate({"question": "q?"})
+    assert status == 502
+    assert len(sleeps) == 3  # one per retried attempt, none after the last
+    for k, s in enumerate(sleeps):
+        base = min(0.3, 0.1 * (2 ** k))
+        assert base <= s <= base * 1.5, (k, s)
+
+
+def test_deadline_propagates_and_shrinks_across_attempts():
+    reg = _registry("r1", "r2")
+
+    def slow_refuse(url, payload, headers):
+        time.sleep(0.05)
+        raise TransportError(f"{url}: reset")
+
+    ft = FakeTransport().on("r1", slow_refuse)
+    router = _router(reg, ft, balancer="round_robin", attempt_timeout_s=100.0,
+                     backoff_base_s=0.01)
+    status, _, _ = router.handle_generate({"question": "q?"}, deadline_s=5.0)
+    assert status == 200
+    posts = [c for c in ft.calls if c[0] == "POST"]
+    assert len(posts) == 2
+    d1 = float(posts[0][4]["X-Edgemesh-Deadline-S"])
+    d2 = float(posts[1][4]["X-Edgemesh-Deadline-S"])
+    assert d1 <= 5.0 and d2 < d1  # the budget the replica sees shrinks
+    # Per-attempt transport timeout is capped by the remaining budget
+    # (the header is the same remaining value, rounded to 1 ms).
+    assert posts[0][3] <= 5.0 and posts[1][3] <= d2 + 1e-3
+
+
+def test_deadline_exhaustion_returns_504():
+    reg = _registry("r1")
+
+    def eat_budget(url, payload, headers):
+        time.sleep(0.08)
+        raise TransportError(f"{url}: reset")
+
+    ft = FakeTransport().on("r1", eat_budget)
+    router = _router(reg, ft, max_attempts=5, backoff_base_s=0.0)
+    status, body, _ = router.handle_generate({"question": "q?"}, deadline_s=0.05)
+    assert status == 504 and "deadline" in body["error"]
+    assert router.obs.summary()['edgemesh_fleet_shed_total{reason="deadline"}'] == 1
+
+
+def test_router_admission_sheds_past_max_inflight():
+    reg = _registry("r1")
+    release = threading.Event()
+
+    def block(url, payload, headers):
+        release.wait(5.0)
+        return 200, {"answer": "slow"}
+
+    ft = FakeTransport().on("r1", block)
+    router = _router(reg, ft, max_inflight=1)
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(router.handle_generate({"question": "a"}))
+    )
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while reg.get("r1").outstanding == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    status, body, headers = router.handle_generate({"question": "b"})
+    assert status == 503 and headers["Retry-After"] == "1"
+    assert router.obs.summary()['edgemesh_fleet_shed_total{reason="overload"}'] == 1
+    release.set()
+    t.join(timeout=5.0)
+    assert results and results[0][0] == 200
+
+
+def test_hedged_request_wins_on_stalled_primary():
+    reg = _registry("r1", "r2")
+    stall = threading.Event()
+
+    def stalled(url, payload, headers):
+        stall.wait(5.0)
+        return 200, {"answer": "late"}
+
+    ft = FakeTransport().on("r1", stalled)
+    router = _router(reg, ft, balancer="round_robin", hedge_after_s=0.05)
+    t0 = time.monotonic()
+    status, body, _ = router.handle_generate({"question": "q?"})
+    elapsed = time.monotonic() - t0
+    stall.set()
+    assert status == 200 and body == {"answer": "ok"}
+    assert elapsed < 2.0  # did not wait out the stalled primary
+    m = router.obs.summary(prefix="edgemesh_fleet_")
+    assert m['edgemesh_fleet_hedged_total{replica="r2"}'] == 1
+    assert m['edgemesh_fleet_hedged_won_total{replica="r2"}'] == 1
+
+
+def test_fast_failure_inside_hedge_window_takes_retry_path_not_hedge():
+    # A primary that fails in ~1ms is not a tail-latency event: it must go
+    # through the backoff/retried path, leaving the hedge counters meaning
+    # exactly "the primary was slow".
+    reg = _registry("r1", "r2")
+    ft = FakeTransport().on("r1", _refuse)
+    router = _router(reg, ft, balancer="round_robin", hedge_after_s=0.2)
+    status, _, headers = router.handle_generate({"question": "q?"})
+    assert status == 200 and headers["X-Edgemesh-Replica"] == "r2"
+    assert headers["X-Edgemesh-Attempts"] == "2"  # retry, not hedge
+    m = router.obs.summary(prefix="edgemesh_fleet_")
+    assert m['edgemesh_fleet_retried_total{replica="r1",reason="connect"}'] == 1
+    assert not any("hedged" in k for k in m)
+
+
+def test_drain_transient_poll_failure_does_not_complete_drain():
+    # One failed /readyz poll is indistinguishable from a GC pause — only
+    # a streak may conclude the replica is gone.
+    reg = _registry("r1")
+    polls = iter([
+        "refuse",                      # transient blip
+        {"inflight": 1},               # still draining in-flight work
+        {"inflight": 0},               # now actually drained
+    ])
+
+    def readyz(url, payload, headers):
+        step = next(polls, {"inflight": 0})
+        if step == "refuse":
+            raise TransportError(f"{url}: reset")
+        return 503, {"ready": False, "draining": True, **step}
+
+    ft = FakeTransport().on("r1/drain", lambda u, p, h: (200, {"draining": True}))
+    ft.on("r1/readyz", readyz)
+    router = _router(reg, ft)
+    router._sleep = lambda s: None
+    out = router.drain_replica("r1", timeout_s=5.0)
+    assert out["drained"] is True
+    # The transient failure cost one extra poll, not a premature removal.
+    assert len([c for c in ft.calls if c[1].endswith("/readyz")]) == 3
+
+
+def test_adaptive_hedge_delay_needs_a_window():
+    router = _router(_registry("r1"), FakeTransport(), hedge_percentile=0.95)
+    assert router._hedge_delay() is None  # no samples yet: no hedging
+    for _ in range(32):
+        router._lat_window.append(0.01)
+    router._lat_window.append(5.0)
+    delay = router._hedge_delay()
+    assert delay is not None and 0.01 <= delay <= 5.0
+
+
+# ---------------------------------------------------------------------------
+# Drain state machine
+# ---------------------------------------------------------------------------
+
+
+def test_drain_state_machine_zero_inflight_then_removed():
+    reg = _registry("r1", "r2")
+    inflight = {"n": 2}
+
+    def readyz(url, payload, headers):
+        n, inflight["n"] = inflight["n"], max(0, inflight["n"] - 1)
+        return 503, {"ready": False, "draining": True, "inflight": n}
+
+    ft = FakeTransport().on("r1/drain", lambda u, p, h: (200, {"draining": True}))
+    ft.on("r1/readyz", readyz)
+    router = _router(reg, ft)
+    router._sleep = lambda s: None
+    out = router.drain_replica("r1", timeout_s=5.0)
+    assert out == {"replica": "r1", "drained": True, "inflight": 0}
+    assert reg.get("r1").state == "removed"
+    # The drain hook fired before the readyz poll loop.
+    urls = [c[1] for c in ft.calls]
+    assert urls[0].endswith("/drain") and urls[1].endswith("/readyz")
+    m = router.obs.summary(prefix="edgemesh_fleet_")
+    assert m['edgemesh_fleet_drain_total{replica="r1",event="started"}'] == 1
+    assert m['edgemesh_fleet_drain_total{replica="r1",event="completed"}'] == 1
+    # Traffic keeps flowing — to the survivor only.
+    status, _, headers = router.handle_generate({"question": "q?"})
+    assert status == 200 and headers["X-Edgemesh-Replica"] == "r2"
+
+
+def test_drain_unknown_replica_and_dead_replica():
+    reg = _registry("r1")
+    assert "error" in FleetRouter(
+        reg, transport=FakeTransport(), obs_registry=Registry()
+    ).drain_replica("nope")
+    # A replica that died before the drain: unreachable readyz counts as
+    # drained (nothing left in flight to wait for).
+    ft = FakeTransport().on("r1", _refuse)
+    router = _router(reg, ft)
+    router._sleep = lambda s: None
+    out = router.drain_replica("r1", timeout_s=1.0)
+    assert out["drained"] is True and reg.get("r1").state == "removed"
+
+
+# ---------------------------------------------------------------------------
+# Health prober
+# ---------------------------------------------------------------------------
+
+
+def test_prober_demotes_and_repromotes():
+    reg = _registry("r1")
+    healthy = {"ok": False}
+
+    def readyz(url, payload, headers):
+        if healthy["ok"]:
+            return 200, {"ready": True, "inflight": 0}
+        raise TransportError(f"{url}: refused")
+
+    ft = FakeTransport().on("r1/readyz", readyz)
+    prober = HealthProber(reg, transport=ft, unhealthy_after=2,
+                          healthy_after=2, obs_registry=Registry())
+    assert prober.probe_once() == {"r1": "healthy"}  # 1 failure < threshold
+    assert prober.probe_once() == {"r1": "unhealthy"}
+    healthy["ok"] = True
+    assert prober.probe_once() == {"r1": "unhealthy"}  # 1 success < threshold
+    assert prober.probe_once() == {"r1": "healthy"}
+
+
+def test_prober_never_unrains_a_draining_replica():
+    reg = _registry("r1")
+    reg.set_state("r1", "draining")
+    ft = FakeTransport().on("r1/readyz", lambda u, p, h: (200, {"ready": True}))
+    prober = HealthProber(reg, transport=ft, obs_registry=Registry())
+    assert prober.probe_once() == {"r1": "draining"}
+
+
+def test_prober_background_loop_runs_and_stops():
+    reg = _registry("r1")
+    ft = FakeTransport().on("r1/readyz", lambda u, p, h: (200, {"ready": True}))
+    prober = HealthProber(reg, transport=ft, interval_s=0.01,
+                          obs_registry=Registry()).start()
+    deadline = time.monotonic() + 5.0
+    while not ft.calls and time.monotonic() < deadline:
+        time.sleep(0.01)
+    prober.stop()
+    assert ft.calls and reg.get("r1").last_probe_ts is not None
+
+
+# ---------------------------------------------------------------------------
+# Fleet HTTP frontend (real loopback sockets, fake replicas)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def frontend():
+    reg = _registry("r1")
+    ft = FakeTransport()
+    router = _router(reg, ft)
+    srv = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+    yield srv, router, ft
+    srv.shutdown()
+
+
+def _http(srv, path, data=None, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.server_address[1]}{path}", data=data,
+        headers=dict(headers or {}),
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.load(r), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e), dict(e.headers)
+
+
+def test_frontend_routes_and_exposes_fleet_state(frontend):
+    srv, router, ft = frontend
+    status, body, headers = _http(
+        srv, "/generate", data=json.dumps({"question": "q?"}).encode()
+    )
+    assert status == 200 and body == {"answer": "ok"}
+    assert headers["X-Edgemesh-Replica"] == "r1"
+    # Client deadline header caps the routed budget.
+    _http(srv, "/generate", data=json.dumps({"question": "q?"}).encode(),
+          headers={"X-Edgemesh-Deadline-S": "7"})
+    posts = [c for c in ft.calls if c[0] == "POST"]
+    assert float(posts[-1][4]["X-Edgemesh-Deadline-S"]) <= 7.0
+
+    status, body, _ = _http(srv, "/fleetz")
+    assert status == 200 and body["replicas"][0]["id"] == "r1"
+    assert body["metrics"]['edgemesh_fleet_routed_total{replica="r1"}'] == 2
+
+    status, body, _ = _http(srv, "/healthz")
+    assert status == 200
+    status, body, _ = _http(srv, "/readyz")
+    assert status == 200 and body["available"] == 1
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.server_address[1]}/metrics", timeout=30
+    ) as r:
+        text = r.read().decode()
+    assert 'edgemesh_fleet_routed_total{replica="r1"} 2' in text
+    assert "edgemesh_fleet_router_seconds_bucket" in text
+
+
+def test_frontend_bad_bodies_and_membership(frontend):
+    srv, router, ft = frontend
+    status, body, _ = _http(srv, "/generate", data=b"not json")
+    assert status == 400 and "JSON" in body["error"]
+    status, body, _ = _http(srv, "/generate", data=b"[1, 2]")
+    assert status == 400 and "object" in body["error"]
+    status, body, _ = _http(
+        srv, "/generate", data=json.dumps({"question": "q"}).encode(),
+        headers={"X-Edgemesh-Deadline-S": "soon"},
+    )
+    assert status == 400
+    status, _, _ = _http(srv, "/nope", data=b"{}")
+    assert status == 404
+
+    # Runtime membership: register / deregister via the API.
+    status, body, _ = _http(
+        srv, "/replicas/register",
+        data=json.dumps({"id": "r9", "url": "http://r9"}).encode(),
+    )
+    assert status == 200 and body["registered"] == "r9"
+    assert {r.rid for r in router.registry.replicas()} == {"r1", "r9"}
+    status, body, _ = _http(
+        srv, "/replicas/deregister", data=json.dumps({"id": "r9"}).encode()
+    )
+    assert status == 200 and body["deregistered"] is True
+    status, body, _ = _http(srv, "/replicas/drain", data=b"{}")
+    assert status == 400  # missing id
+
+    status, _, _ = _http(srv, "/readyz")
+    assert status == 200
+
+
+def test_router_status_shape():
+    router = _router(_registry("r1"), FakeTransport(), balancer="prefix_affinity")
+    st = router.status()
+    assert st["balancer"] == "prefix_affinity"
+    assert st["replicas"][0]["state"] == "healthy"
+    assert isinstance(st["metrics"], dict)
+
+
+def test_make_balancer_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown balancer"):
+        make_balancer("fastest_first")
+
+
+# ---------------------------------------------------------------------------
+# Replica gateway (serve/rest.py): healthz/readyz/drain + hardening.
+# A stub ensemble keeps this fast — the HTTP lifecycle is under test, not
+# the model.
+# ---------------------------------------------------------------------------
+
+
+class _StubEnsemble:
+    qa_agents = ()
+    refiner = None
+
+    def __init__(self, answer_fn=None):
+        self._answer = answer_fn
+
+    def answer(self, question):
+        if self._answer is not None:
+            return self._answer(question)
+        return {"answer": f"echo:{question}"}
+
+
+def _serve_stub(**kw):
+    from edgemesh.serve import serve_rest
+
+    kw.setdefault("registry", Registry())
+    return serve_rest(_StubEnsemble(kw.pop("answer_fn", None)),
+                      host="127.0.0.1", port=0, block=False, **kw)
+
+
+def test_gateway_healthz_readyz_and_drain_state_machine():
+    srv = _serve_stub()
+    try:
+        status, body, _ = _http(srv, "/healthz")
+        assert status == 200 and body == {"status": "ok"}
+        status, body, _ = _http(srv, "/readyz")
+        assert status == 200
+        assert body == {"ready": True, "draining": False, "inflight": 0}
+
+        status, body, _ = _http(srv, "/drain", data=b"{}")
+        assert status == 200 and body["draining"] is True
+
+        # Drain-aware readiness: alive (healthz 200) but NOT ready.
+        status, _, _ = _http(srv, "/healthz")
+        assert status == 200
+        status, body, _ = _http(srv, "/readyz")
+        assert status == 503 and body["draining"] is True
+
+        # New work is refused with 503 + Retry-After.
+        status, body, headers = _http(
+            srv, "/generate", data=json.dumps({"question": "q"}).encode()
+        )
+        assert status == 503 and "draining" in body["error"]
+        assert headers["Retry-After"] == "1"
+    finally:
+        srv.shutdown()
+
+
+def test_gateway_drain_waits_for_inflight_requests():
+    gate = threading.Event()
+    started = threading.Event()
+
+    def slow_answer(question):
+        started.set()
+        gate.wait(10.0)
+        return {"answer": "done"}
+
+    srv = _serve_stub(answer_fn=slow_answer)
+    try:
+        results = []
+        t = threading.Thread(target=lambda: results.append(
+            _http(srv, "/generate", data=json.dumps({"question": "q"}).encode())
+        ))
+        t.start()
+        assert started.wait(5.0)
+        # Drain with a request in flight: draining flips immediately...
+        out = srv.drain(wait=True, timeout_s=0.05)
+        assert out["draining"] is True and out["drained"] is False
+        assert out["inflight"] == 1
+        # ... and the in-flight request still completes (zero dropped).
+        gate.set()
+        t.join(timeout=10.0)
+        assert results and results[0][0] == 200
+        assert results[0][1]["answer"] == "done"
+        out = srv.drain(wait=True, timeout_s=5.0)
+        assert out["drained"] is True and out["inflight"] == 0
+    finally:
+        srv.shutdown()
+
+
+def test_gateway_sheds_past_max_inflight():
+    gate = threading.Event()
+    started = threading.Event()
+
+    def slow_answer(question):
+        started.set()
+        gate.wait(10.0)
+        return {"answer": "done"}
+
+    srv = _serve_stub(answer_fn=slow_answer, max_inflight=1)
+    try:
+        results = []
+        t = threading.Thread(target=lambda: results.append(
+            _http(srv, "/generate", data=json.dumps({"question": "a"}).encode())
+        ))
+        t.start()
+        assert started.wait(5.0)
+        status, body, headers = _http(
+            srv, "/generate", data=json.dumps({"question": "b"}).encode()
+        )
+        assert status == 503 and body["error"] == "overloaded"
+        assert headers["Retry-After"] == "1"
+        gate.set()
+        t.join(timeout=10.0)
+        assert results and results[0][0] == 200
+    finally:
+        srv.shutdown()
+
+
+def test_gateway_admission_check_and_increment_is_atomic():
+    # A burst of N+1 concurrent requests against max_inflight=N must shed
+    # exactly one — a split check/increment would shed all of them.
+    srv = _serve_stub(max_inflight=2)
+    try:
+        assert [srv.begin_request() for _ in range(3)] == \
+            ["ok", "ok", "overloaded"]
+        srv.end_request()
+        assert srv.begin_request() == "ok"  # freed capacity readmits
+        srv.end_request()
+        srv.end_request()
+        assert srv.inflight() == 0
+    finally:
+        srv.shutdown()
+
+
+def test_gateway_malformed_inputs_are_structured_400s():
+    srv = _serve_stub()
+    try:
+        status, body, _ = _http(srv, "/generate", data=b"not json")
+        assert status == 400 and body["error"] == "invalid JSON body"
+        status, body, _ = _http(srv, "/generate", data=b"[1, 2]")
+        assert status == 400 and "object" in body["error"]
+        status, body, _ = _http(
+            srv, "/generate", data=json.dumps({"question": "q"}).encode(),
+            headers={"X-Edgemesh-Deadline-S": "soon"},
+        )
+        assert status == 400 and "X-Edgemesh-Deadline-S" in body["error"]
+
+        # A garbage Content-Length header (hand-rolled request) is a 400,
+        # not an unhandled int() ValueError → 500.
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=30
+        )
+        try:
+            conn.putrequest("POST", "/generate")
+            conn.putheader("Content-Length", "nope")
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 400
+            assert "Content-Length" in json.load(resp)["error"]
+        finally:
+            conn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_gateway_refuses_expired_propagated_deadline():
+    calls = []
+    srv = _serve_stub(answer_fn=lambda q: calls.append(q) or {"answer": "x"})
+    try:
+        status, body, _ = _http(
+            srv, "/generate", data=json.dumps({"question": "q"}).encode(),
+            headers={"X-Edgemesh-Deadline-S": "0"},
+        )
+        assert status == 504 and "deadline" in body["error"]
+        assert calls == []  # refused BEFORE any model work
+        status, _, _ = _http(
+            srv, "/generate", data=json.dumps({"question": "q"}).encode(),
+            headers={"X-Edgemesh-Deadline-S": "30"},
+        )
+        assert status == 200 and calls == ["q"]
+    finally:
+        srv.shutdown()
+
+
+def test_gateway_socket_timeout_is_applied_per_connection():
+    srv = _serve_stub(request_timeout_s=0.2)
+    try:
+        import socket
+
+        # A client that opens a connection, sends half a request, and
+        # stalls: the handler thread must be reclaimed by the socket
+        # timeout instead of pinned forever.
+        s = socket.create_connection(
+            ("127.0.0.1", srv.server_address[1]), timeout=5.0
+        )
+        try:
+            s.sendall(b"POST /generate HTTP/1.1\r\nContent-Length: 999\r\n\r\n{")
+            t0 = time.monotonic()
+            # Server must close the connection (empty read) in bounded time.
+            s.settimeout(5.0)
+            data = s.recv(1024)
+            assert time.monotonic() - t0 < 5.0
+            assert data == b""  # dropped, no half-baked 500
+        finally:
+            s.close()
+        # The gateway still serves afterwards.
+        status, _, _ = _http(srv, "/healthz")
+        assert status == 200
+    finally:
+        srv.shutdown()
