@@ -39,6 +39,14 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   whole job is surviving stalled replicas, and one unbounded read pins a
   router thread forever. The router's retry/hedge math only holds if every
   attempt returns in bounded time.
+- EM109 fleet-missing-trace-propagation (error): an outbound HTTP call in
+  ``edgemesh/fleet/`` (``post_json``/``get_json``/``urlopen``) that BUILDS
+  a ``headers=`` dict literal without the ``X-Edgemesh-Trace`` key (the
+  ``TRACE_HEADER`` constant counts; a ``**expansion`` is assumed to
+  forward it) — one request-path call site that drops the header severs
+  the distributed trace at exactly the hop tracing exists to explain.
+  Calls with no ``headers=`` at all (probes, drain admin) are out of
+  scope, as are opaque header variables the linter cannot see into.
 
 Suppression: append ``# edgelint: disable=EM105`` (comma-separate for
 several rules) to the flagged line, or put the comment on the ``def`` line
@@ -94,6 +102,11 @@ RULES: dict[str, dict] = {
         "severity": "error",
         "summary": "outbound HTTP/socket call in edgemesh/fleet/ without an explicit timeout",
     },
+    "EM109": {
+        "name": "fleet-missing-trace-propagation",
+        "severity": "error",
+        "summary": "outbound fleet HTTP call builds headers without the X-Edgemesh-Trace header",
+    },
 }
 
 # ---------------------------------------------------------------------------
@@ -142,6 +155,15 @@ _EM107_DIRS = ("edgemesh/serve/", "edgemesh/runtime/")
 # only). A call in edgemesh/fleet/ hitting this table without a ``timeout``
 # kwarg or enough positionals is flagged.
 _EM108_DIRS = ("edgemesh/fleet/",)
+# EM109 scope + call surface: the fleet's outbound HTTP seams. The rule
+# only judges call sites it can SEE building headers — a dict literal
+# (inline, or assigned to a simple local in the same function) missing the
+# trace-header key. The key is satisfied by the literal string or any
+# name/attribute ending in TRACE_HEADER; a ``**`` expansion is assumed to
+# forward it.
+_EM109_CALLS = {"post_json", "get_json"}
+_EM109_URLOPEN = "urllib.request.urlopen"
+_EM109_HEADER = "X-Edgemesh-Trace"
 _EM108_CALLS = {
     "urllib.request.urlopen": 2,        # urlopen(url, data, timeout)
     "socket.create_connection": 1,      # create_connection(address, timeout)
@@ -394,6 +416,7 @@ class _FileLinter:
         self._rule_api_drift(tree)
         self._rule_raw_timing(tree)
         self._rule_fleet_timeout(tree)
+        self._rule_fleet_trace(tree)
         # Traced ROOTS only: their walkers descend into traced nested defs,
         # so running every traced def would double-report nested call sites.
         traced_roots = [
@@ -516,6 +539,80 @@ class _FileLinter:
                     "router's retry/hedge budget math breaks (pass "
                     "timeout=..., or route through fleet.transport)",
                 )
+
+    # -- EM109 -------------------------------------------------------------
+
+    @staticmethod
+    def _dict_has_trace_header(d: ast.Dict) -> bool:
+        for key in d.keys:
+            if key is None:  # {**expansion}: assume the source forwards it
+                return True
+            if isinstance(key, ast.Constant) and key.value == _EM109_HEADER:
+                return True
+            if isinstance(key, (ast.Name, ast.Attribute)):
+                dotted = _dotted_name(key)
+                if dotted and dotted.rsplit(".", 1)[-1] == "TRACE_HEADER":
+                    return True
+        return False
+
+    def _headers_dict_for_call(self, node: ast.Call) -> ast.Dict | None:
+        """The headers dict literal this call passes, following one level of
+        simple local assignment (``headers = {...}`` earlier in the same
+        function). Returns None when there is no headers kwarg or its value
+        is opaque (a call, an attribute, a parameter...)."""
+        value = next(
+            (kw.value for kw in node.keywords if kw.arg == "headers"), None
+        )
+        if value is None:
+            return None
+        if isinstance(value, ast.Dict):
+            return value
+        if isinstance(value, ast.Name):
+            scopes = self._scope_stack_for_line(node.lineno)
+            fn = scopes[-1] if scopes else None
+            if fn is None:
+                return None
+            best = None
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Assign)
+                    and sub.lineno < node.lineno
+                    and isinstance(sub.value, ast.Dict)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == value.id
+                        for t in sub.targets
+                    )
+                ):
+                    best = sub.value  # last assignment before the call wins
+            return best
+        return None
+
+    def _rule_fleet_trace(self, tree: ast.Module) -> None:
+        if not any(d in self.relpath for d in _EM108_DIRS):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_name(node.func)
+            is_transport = (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _EM109_CALLS
+            )
+            is_urlopen = bool(
+                dotted and self.aliases.resolve(dotted) == _EM109_URLOPEN
+            )
+            if not (is_transport or is_urlopen):
+                continue
+            headers = self._headers_dict_for_call(node)
+            if headers is None or self._dict_has_trace_header(headers):
+                continue
+            self._emit(
+                "EM109", node,
+                "outbound fleet HTTP call builds headers without "
+                f"{_EM109_HEADER!r} — the distributed trace severs at this "
+                "hop (add httputil.TRACE_HEADER: ctx.to_header(), or "
+                "forward the incoming headers)",
+            )
 
     # -- EM102 -------------------------------------------------------------
 

@@ -564,16 +564,27 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
     router's own work — balancer pick, registry bookkeeping, obs recording,
     and one extra loopback HTTP hop. No retries/hedges fire (the replica is
     healthy), which is the point: this measures the overhead every request
-    pays, not the failure machinery. The router's obs registry summary
-    rides the result JSON like the serving benchmark's does, so the
-    artifact itself shows the routed/shed counters that produced the
-    numbers. Tiny synthetic model — the replica's decode time is the same
-    constant in both arms and cancels in the delta."""
+    pays, not the failure machinery.
+
+    Three arms: ``direct`` (no router), ``routed`` (router, tracing
+    sampled OUT — zero span I/O), and ``traced`` (tracing sampled in:
+    router + replica both flush span JSONL records), so
+    ``tracing_overhead_*`` prices the trace substrate itself. The router's
+    obs registry summary and ONE fully assembled cross-process trace (the
+    last traced request, router + replica spans, skew-corrected, with its
+    critical path) ride the result JSON — the artifact shows both the
+    counters and a real trace that produced the numbers. Tiny synthetic
+    model — the replica's decode time is the same constant in every arm
+    and cancels in the deltas."""
+    import tempfile
+    from pathlib import Path
+
     from edgemesh.agents.orchestrator import Ensemble, build_agent
     from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
     from edgemesh.fleet import FleetRouter, HttpTransport, ReplicaRegistry, serve_fleet
-    from edgemesh.obs import Registry
+    from edgemesh.obs import Registry, load_trace
     from edgemesh.serve import serve_rest
+    from edgemesh.utils.tracing import JsonlLogger
 
     import numpy as np
 
@@ -582,13 +593,27 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
         sampling=SamplingParams(max_new_tokens=max_new, do_sample=False,
                                 repetition_penalty=1.0),
     ))
+    log_dir = Path(tempfile.mkdtemp(prefix="edgemesh-bench-trace-"))
+    replica_log = log_dir / "replica.jsonl"
+    router_log = log_dir / "router.jsonl"
+    # Continuous engine so the replica emits real queued/prefill/decode
+    # spans — the assembled sample trace shows the full pipeline.
+    # Local trace_sample=0: the DIRECT arm (header-less requests) must not
+    # pay span I/O the routed arm skips, or the overhead delta is biased.
+    # The traced arm still flushes — the router's header carries sampled=1,
+    # which overrides the replica's local rate.
     srv = serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1", port=0,
-                     block=False)
+                     block=False, continuous=True, batch=2,
+                     span_log=replica_log, trace_sample=0.0)
     replica_url = f"http://127.0.0.1:{srv.server_address[1]}"
     obs = Registry()
     registry = ReplicaRegistry([("r0", replica_url)])
+    # trace_sample starts at 0 (the "routed" arm measures the router with
+    # span I/O off); the "traced" arm flips it to 1.0 — the attribute is
+    # read per request, which is exactly what makes the A/B clean.
     router = FleetRouter(registry, balancer="least_outstanding",
-                         obs_registry=obs)
+                         obs_registry=obs, span_log=router_log,
+                         trace_sample=0.0)
     front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
     transport = HttpTransport()
 
@@ -608,19 +633,30 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
         return lats
 
     try:
+        routed_url = f"http://127.0.0.1:{front.server_address[1]}/generate"
         direct = measure(f"{replica_url}/generate", "direct")
-        routed = measure(
-            f"http://127.0.0.1:{front.server_address[1]}/generate", "router"
-        )
+        routed = measure(routed_url, "router")
+        router.trace_sample = 1.0
+        traced = measure(routed_url, "router+tracing")
 
         def pct(xs, q):
             return round(float(np.percentile(xs, q)), 6)
 
         overhead_p50 = pct(routed, 50) - pct(direct, 50)
+        tracing_p50 = pct(traced, 50) - pct(routed, 50)
         _progress(
             f"router-overhead: p50 {pct(direct, 50) * 1e3:.2f}ms direct vs "
-            f"{pct(routed, 50) * 1e3:.2f}ms routed (+{overhead_p50 * 1e3:.2f}ms)"
+            f"{pct(routed, 50) * 1e3:.2f}ms routed (+{overhead_p50 * 1e3:.2f}ms), "
+            f"tracing +{tracing_p50 * 1e3:.2f}ms"
         )
+        # One real assembled trace rides the artifact: the last traced
+        # request, stitched across the router and replica span logs.
+        sample_trace = None
+        router_recs = JsonlLogger(router_log).read()
+        if router_recs:
+            sample_trace = load_trace(
+                router_recs[-1]["trace_id"], [router_log, replica_log]
+            )
         return {
             "metric": "router_overhead_p50_s",
             "value": round(overhead_p50, 6),
@@ -631,12 +667,24 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
             "routed_p50_s": pct(routed, 50),
             "routed_p99_s": pct(routed, 99),
             "overhead_p99_s": round(pct(routed, 99) - pct(direct, 99), 6),
-            # The obs view of the routed arm (counters + router histogram).
+            "traced_p50_s": pct(traced, 50),
+            "traced_p99_s": pct(traced, 99),
+            "tracing_overhead_p50_s": round(tracing_p50, 6),
+            "tracing_overhead_p99_s": round(pct(traced, 99) - pct(routed, 99), 6),
+            "sample_trace": sample_trace,
+            # The obs view of the routed arms (counters + router histogram).
             "obs": obs.summary(prefix="edgemesh_fleet_"),
         }
     finally:
         front.shutdown()
         srv.shutdown()
+        if srv.batcher is not None:
+            srv.batcher.close()
+        # The sample trace is already embedded in the result JSON; the
+        # span logs themselves are scratch.
+        import shutil
+
+        shutil.rmtree(log_dir, ignore_errors=True)
 
 
 def ensemble_overlap_benchmark(n_agents: int = 2, questions: int = 3) -> dict[str, Any]:

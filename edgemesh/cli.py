@@ -76,14 +76,17 @@ def cmd_eval(cfg: EdgeMeshConfig) -> int:
 
 def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool = False,
               kv_backend: str = "dense", kv_page_size: int = 64,
-              admission: str = "fifo", span_log: str | None = None) -> int:
+              admission: str = "fifo", span_log: str | None = None,
+              trace_sample: float = 1.0,
+              profile_dir: str | None = None) -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.serve import serve_rest
 
     ensemble = build_ensemble(cfg)
     serve_rest(ensemble, port=port, batch=batch, continuous=continuous,
                kv_backend=kv_backend, kv_page_size=kv_page_size,
-               admission=admission, span_log=span_log)
+               admission=admission, span_log=span_log,
+               trace_sample=trace_sample, profile_dir=profile_dir)
     return 0
 
 
@@ -248,6 +251,19 @@ def main(argv: list[str] | None = None) -> int:
         "records (inspect/replay with `edgemesh obs`)",
     )
     top.add_argument(
+        "--trace-sample", type=float, default=1.0,
+        help="serve --continuous: span-log sampling rate in [0,1] for "
+        "locally-originated requests (fleet-routed requests carry the "
+        "router's sampling decision); sampled-out requests still count "
+        "in /metrics",
+    )
+    top.add_argument(
+        "--profile-dir", type=str, default=None,
+        help="serve: opt in GET /debug/profile?seconds=N jax.profiler "
+        "captures under this directory (disabled by default — see the "
+        "security note in docs/OBSERVABILITY.md)",
+    )
+    top.add_argument(
         "--preset", type=str, default=None,
         help="bench: model preset (validated by the bench command)",
     )
@@ -290,7 +306,8 @@ def main(argv: list[str] | None = None) -> int:
     if cmd_args.command == "serve":
         return cmd_serve(cfg, cmd_args.port, cmd_args.batch, cmd_args.continuous,
                          cmd_args.kv_backend, cmd_args.kv_page_size,
-                         cmd_args.admission, cmd_args.span_log)
+                         cmd_args.admission, cmd_args.span_log,
+                         cmd_args.trace_sample, cmd_args.profile_dir)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     if cmd_args.command == "train":

@@ -60,6 +60,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="adaptive hedge at this observed-latency "
                      "percentile, e.g. 0.95 (0 = off)")
     srv.add_argument("--max-inflight", type=int, default=64)
+    srv.add_argument("--span-log", default=None,
+                     help="router span JSONL: one router_spans record per "
+                     "sampled request, assembled across processes with "
+                     "`edgemesh obs trace` (docs/OBSERVABILITY.md)")
+    srv.add_argument("--trace-sample", type=float, default=1.0,
+                     help="trace sampling rate in [0,1]: sampled-out "
+                     "requests cost zero span I/O (here and on replicas) "
+                     "but still count in every metric")
     srv.add_argument("--probe-interval-s", type=float, default=2.0)
     srv.add_argument("--boot-timeout-s", type=float, default=300.0,
                      help="per-replica readiness wait (first jit compile "
@@ -172,6 +180,8 @@ def cmd_serve(args) -> int:
             hedge_after_s=args.hedge_after_s,
             hedge_percentile=args.hedge_percentile,
             max_inflight=args.max_inflight,
+            span_log=args.span_log,
+            trace_sample=args.trace_sample,
         )
         prober = HealthProber(registry, transport=transport,
                               interval_s=args.probe_interval_s).start()

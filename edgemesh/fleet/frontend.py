@@ -7,12 +7,17 @@ every request is answered by the router, never by a local model:
 - ``GET  /``, ``/healthz``  → router liveness
 - ``GET  /readyz``          → 200 only while ≥1 replica is in rotation
 - ``GET  /fleetz``          → JSON fleet status (replicas, balancer,
-  per-replica counters) — what ``edgemesh fleet status --json`` prints
+  per-replica counters, recent-trace summaries) — what ``edgemesh fleet
+  status --json`` prints
+- ``GET  /debug/traces/<id>`` → one recent request's assembled trace
+  (router-side view; unique id prefixes accepted; cross-process assembly
+  with replica spans is ``edgemesh obs trace``)
 - ``GET  /metrics``         → Prometheus text exposition of the router's
   obs registry (routed/retried/hedged/shed counters, latency histogram)
 - ``POST /generate``        → routed to a replica (retries/hedging/drain
   semantics in fleet/router.py); optional ``X-Edgemesh-Deadline-S`` header
-  caps this request's total budget
+  caps this request's total budget; optional ``X-Edgemesh-Trace`` joins a
+  client trace, and the response always carries the trace id back
 - ``POST /replicas/register``   {"id": ..., "url": ...}
 - ``POST /replicas/deregister`` {"id": ...}
 - ``POST /replicas/drain``      {"id": ...} → graceful drain (blocks until
@@ -55,6 +60,17 @@ def _make_handler(router, request_timeout_s: float | None):
                     200, router.obs.render(),
                     content_type="text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif self.path.startswith("/debug/traces/"):
+                trace_id = self.path.removeprefix("/debug/traces/").strip("/")
+                doc = router.get_trace(trace_id) if trace_id else None
+                if doc is None:
+                    self._send(404, {
+                        "error": f"no recent sampled trace {trace_id!r} "
+                        "(router-side ring holds the last 64; full "
+                        "cross-process assembly: `edgemesh obs trace`)",
+                    })
+                else:
+                    self._send(200, doc)
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -73,7 +89,11 @@ def _make_handler(router, request_timeout_s: float | None):
                     if not ok:
                         return
                     status, body, extra = router.handle_generate(
-                        payload, deadline_s=deadline_s
+                        payload, deadline_s=deadline_s,
+                        # A client-supplied trace context joins its trace;
+                        # otherwise the router mints one. Either way the
+                        # response carries X-Edgemesh-Trace back.
+                        trace=httputil.read_trace_header(self),
                     )
                     self._send(status, body, extra=extra)
                 elif self.path in ("/replicas/register", "/replicas/deregister",

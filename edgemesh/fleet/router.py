@@ -28,6 +28,14 @@ robustness semantics on top of the replica registry:
   calls its ``/drain`` hook, polls ``/readyz`` until in-flight work hits
   zero, then marks it removed — zero dropped requests by construction.
 
+- **Tracing.** Every request gets a W3C-style trace context
+  (``X-Edgemesh-Trace``, obs/trace.py) with one child span per
+  retry/hedge attempt, tagged with replica id and outcome and propagated
+  to the replica — whose engine spans join the same trace.
+  ``span_log=`` appends one ``router_spans`` record per sampled request
+  (``trace_sample=`` gates span I/O only, never metrics); ``edgemesh obs
+  trace <id> --logs ...`` stitches router + replica logs into one tree.
+
 Obs (per-replica labels throughout): routed/retried/hedged/hedged-won/
 shed/exhausted counters, drain events, an in-flight gauge, and the router
 latency histogram ``edgemesh_fleet_router_seconds`` alongside the engine
@@ -45,7 +53,8 @@ from collections import deque
 
 from edgemesh.fleet.balancer import make_balancer
 from edgemesh.fleet.transport import HttpTransport, TransportError
-from edgemesh.serve.httputil import DEADLINE_HEADER
+from edgemesh.obs.trace import ROUTER_RECORD_EVENT, TraceContext, sample
+from edgemesh.serve.httputil import DEADLINE_HEADER, TRACE_HEADER
 
 log = logging.getLogger("edgemesh.fleet")
 
@@ -68,6 +77,8 @@ class FleetRouter:
         max_inflight: int = 64,
         demote_after: int = 2,
         rng: random.Random | None = None,
+        span_log=None,
+        trace_sample: float = 1.0,
     ) -> None:
         from edgemesh.obs import get_registry
 
@@ -86,6 +97,20 @@ class FleetRouter:
         self.demote_after = demote_after
         self._rng = rng or random.Random(0)
         self._sleep = time.sleep  # injectable: tests pin the backoff schedule
+        # Distributed tracing (obs/trace.py): one context per request, one
+        # child span per retry/hedge attempt, propagated to replicas via
+        # X-Edgemesh-Trace. ``trace_sample`` gates span I/O only — every
+        # request still counts in every metric. Sampling uses its OWN rng:
+        # tests pin self._rng for the backoff schedule, and minting must
+        # not perturb it.
+        self.trace_sample = float(trace_sample)
+        self._trace_rng = random.Random()
+        self._trace_log = None
+        if span_log is not None:
+            from edgemesh.utils.tracing import JsonlLogger
+
+            self._trace_log = JsonlLogger(span_log)
+        self._recent_traces: deque[dict] = deque(maxlen=64)
         self._slots = threading.BoundedSemaphore(max_inflight)
         # Rolling successful-attempt latencies for the adaptive hedge delay.
         # Locked: sorting the deque while another handler thread appends
@@ -135,23 +160,70 @@ class FleetRouter:
     # -- request path --------------------------------------------------------
 
     def handle_generate(self, payload: dict, deadline_s: float | None = None,
-                        path: str = "/generate"):
+                        path: str = "/generate", trace: TraceContext | None = None):
         """Route one request. Returns ``(status, body, headers)`` — the
         HTTP frontend writes them verbatim; in-process callers (tests,
-        benchmarks) read them directly."""
+        benchmarks) read them directly. ``trace`` joins an existing trace
+        (a client-supplied ``X-Edgemesh-Trace``); otherwise this request
+        mints its own. The response always carries the trace header back,
+        so clients can fetch ``/debug/traces/<id>`` or grep their logs."""
+        ctx = trace or TraceContext.mint(
+            sampled=sample(self.trace_sample, self._trace_rng)
+        )
+        # spans[0] is the root request span; attempts append behind it.
+        # Wall clock throughout (clock: "wall" in the record): these edges
+        # are what cross-process assembly anchors replica clocks against.
+        spans: list[dict] = [{
+            "name": "request", "span_id": ctx.span_id,
+            "t0": time.time(), "t1": None,
+        }]
         t0 = time.monotonic()
         if not self._slots.acquire(blocking=False):
             self._shed.labels(reason="overload").inc()
-            return 503, {"error": "router at capacity", "max_inflight": self.max_inflight}, \
-                {"Retry-After": "1"}
-        self._inflight_gauge.inc()
-        try:
-            return self._route(payload, t0, deadline_s, path)
-        finally:
-            self._inflight_gauge.dec()
-            self._slots.release()
+            status, body, headers = 503, {
+                "error": "router at capacity", "max_inflight": self.max_inflight,
+            }, {"Retry-After": "1"}
+        else:
+            self._inflight_gauge.inc()
+            try:
+                status, body, headers = self._route(
+                    payload, t0, deadline_s, path, ctx, spans
+                )
+            finally:
+                self._inflight_gauge.dec()
+                self._slots.release()
+        headers = dict(headers)
+        headers[TRACE_HEADER] = ctx.to_header()
+        self._finish_trace(ctx, spans, status)
+        return status, body, headers
 
-    def _route(self, payload, t0, deadline_s, path):
+    def _finish_trace(self, ctx: TraceContext, spans: list[dict],
+                      status: int) -> None:
+        """Close the root span; for sampled requests, remember the record
+        (``/fleetz`` summaries, ``/debug/traces/<id>``) and append it to the
+        router span log. The in-memory record keeps the LIVE span dicts so
+        an abandoned hedge attempt that completes late still fills in its
+        outcome; the JSONL write is a point-in-time snapshot (a late loser
+        may stay "pending" there — the hedged counters still count it)."""
+        spans[0]["t1"] = time.time()
+        if not ctx.sampled:
+            return
+        record = {
+            "event": ROUTER_RECORD_EVENT, "ts": spans[0]["t1"],
+            "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+            "process": "router", "status": status, "clock": "wall",
+            "attempts": len(spans) - 1,
+            "latency_s": round(spans[0]["t1"] - spans[0]["t0"], 6),
+            "spans": spans,
+        }
+        self._recent_traces.append(record)
+        if self._trace_log is not None:
+            fields = {k: v for k, v in record.items()
+                      if k not in ("event", "ts")}
+            fields["spans"] = [dict(s) for s in spans]
+            self._trace_log.log(ROUTER_RECORD_EVENT, **fields)
+
+    def _route(self, payload, t0, deadline_s, path, ctx, spans):
         deadline = t0 + (deadline_s if deadline_s is not None else self.default_deadline_s)
         prompt = payload.get("question") if isinstance(payload, dict) else None
         excluded: set[str] = set()
@@ -171,9 +243,11 @@ class FleetRouter:
             if rep is None:
                 self._shed.labels(reason="no_replica").inc()
                 return 503, {"error": "no available replica"}, {"Retry-After": "1"}
-            outcome = self._dispatch(rep, payload, path, deadline, prompt, excluded)
+            outcome = self._dispatch(rep, payload, path, deadline, prompt,
+                                     excluded, ctx, spans)
             if outcome[0] == "ok":
-                _, rid, status, body = outcome
+                _, rid, status, body, won_span = outcome
+                won_span["won"] = True
                 self._routed.labels(replica=rid).inc()
                 self._latency.observe(time.monotonic() - t0)
                 return status, body, {
@@ -202,16 +276,38 @@ class FleetRouter:
 
     # -- attempts ------------------------------------------------------------
 
-    def _attempt_one(self, rep, payload, path, deadline):
+    def _attempt_one(self, rep, payload, path, deadline, ctx, spans,
+                     hedge: bool = False):
         """One checked-out attempt → ("ok", rid, status, body) for any
-        answered status < 500, else ("fail", rid, reason, detail)."""
+        answered status < 500, else ("fail", rid, reason, detail).
+
+        Each attempt is one child span of the request trace: the span dict
+        is appended (with every key it will ever have — concurrent JSON
+        dumps must never see a dict growing) BEFORE dispatch, so a replica
+        record can parent onto it even when the attempt is later abandoned,
+        and mutated in place as the outcome lands."""
+        span = {
+            "name": "attempt", "span_id": ctx.span_id, "replica": rep.rid,
+            "hedge": hedge, "outcome": "pending", "status": None,
+            "won": False,  # set by _route on the attempt whose answer the
+            "t0": time.time(), "t1": None,  # client actually received — an
+        }  # abandoned hedge loser can ALSO finish "ok" without having won
+        spans.append(span)
+
+        def close(outcome: str, status=None):
+            span["t1"] = time.time()
+            span["outcome"] = outcome
+            span["status"] = status
+
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             self.registry.release(rep.rid, ok=False, demote_after=self.demote_after,
                                   error="deadline exceeded before dispatch")
+            close("deadline")
             return ("fail", rep.rid, "deadline", "expired before dispatch")
         timeout_s = min(self.attempt_timeout_s, remaining)
-        headers = {DEADLINE_HEADER: f"{remaining:.3f}"}
+        headers = {DEADLINE_HEADER: f"{remaining:.3f}",
+                   TRACE_HEADER: ctx.to_header()}
         t0 = time.monotonic()
         try:
             status, body = self.transport.post_json(
@@ -220,15 +316,18 @@ class FleetRouter:
         except TransportError as e:
             self.registry.release(rep.rid, ok=False, demote_after=self.demote_after,
                                   error=str(e))
+            close("connect")
             return ("fail", rep.rid, "connect", str(e))
         if status >= 500:
             self.registry.release(rep.rid, ok=False, demote_after=self.demote_after,
                                   error=f"status {status}")
+            close(f"status_{status}", status)
             return ("fail", rep.rid, f"status_{status}", str(body.get("error", body))[:200])
         self.registry.release(rep.rid, ok=True)
         with self._lat_lock:
             self._lat_window.append(time.monotonic() - t0)
-        return ("ok", rep.rid, status, body)
+        close("ok", status)
+        return ("ok", rep.rid, status, body, span)
 
     def _hedge_delay(self) -> float | None:
         if self.hedge_after_s:
@@ -240,18 +339,26 @@ class FleetRouter:
                 return xs[min(len(xs) - 1, int(self.hedge_percentile * len(xs)))]
         return None
 
-    def _dispatch(self, rep, payload, path, deadline, prompt, excluded):
+    def _dispatch(self, rep, payload, path, deadline, prompt, excluded,
+                  ctx, spans):
         """One attempt round, hedged when configured. Returns
-        ("ok", rid, status, body) or ("fail", [(rid, reason, detail), ...])."""
+        ("ok", rid, status, body) or ("fail", [(rid, reason, detail), ...]).
+        Every attempt (primary and hedge) gets its own child trace context
+        — distinct span ids are what let the assembled tree show the hedge
+        as a sibling of the attempt it raced."""
         hedge_delay = self._hedge_delay()
         if hedge_delay is None or hedge_delay >= (deadline - time.monotonic()):
-            out = self._attempt_one(rep, payload, path, deadline)
+            out = self._attempt_one(rep, payload, path, deadline,
+                                    ctx.child(), spans)
             return out if out[0] == "ok" else ("fail", [out[1:]])
 
         results: queue.Queue = queue.Queue()
 
         def run(replica, is_hedge):
-            results.put((is_hedge, self._attempt_one(replica, payload, path, deadline)))
+            results.put((is_hedge, self._attempt_one(
+                replica, payload, path, deadline, ctx.child(), spans,
+                hedge=is_hedge,
+            )))
 
         threading.Thread(target=run, args=(rep, False), daemon=True).start()
         try:
@@ -351,6 +458,54 @@ class FleetRouter:
 
     # -- introspection -------------------------------------------------------
 
+    def recent_traces(self, limit: int = 20) -> list[dict]:
+        """Newest-first compact summaries of recently sampled traces —
+        what ``/fleetz`` shows so an operator can pick an id to assemble."""
+        out = []
+        for rec in reversed(list(self._recent_traces)):
+            out.append({
+                "trace_id": rec["trace_id"], "status": rec["status"],
+                "latency_s": rec.get("latency_s"),
+                "attempts": rec.get("attempts"),
+                "replicas": sorted({
+                    s["replica"] for s in rec["spans"]
+                    if s.get("name") == "attempt" and s.get("replica")
+                }),
+                "ts": rec.get("ts"),
+            })
+            if len(out) >= limit:
+                break
+        return out
+
+    def get_trace(self, trace_id: str) -> dict | None:
+        """Assemble one recent trace from the router's in-memory record
+        (the router-side view: request + attempt spans). Cross-process
+        assembly — replica spans stitched in with skew correction — needs
+        the span LOGS and lives in ``edgemesh obs trace``. Unique id
+        prefixes are accepted."""
+        from edgemesh.obs.trace import assemble_trace, critical_path
+
+        exact = [
+            rec for rec in self._recent_traces
+            if rec["trace_id"] == trace_id
+        ]
+        if exact:
+            # A client fanning out requests under one supplied traceparent
+            # produces several records with the same trace id — serve the
+            # newest rather than refusing an id that plainly exists.
+            match = exact[-1]
+        else:
+            prefixed = [
+                rec for rec in self._recent_traces
+                if rec["trace_id"].startswith(trace_id)
+            ]
+            if len({rec["trace_id"] for rec in prefixed}) != 1:
+                return None  # unknown, or ambiguous prefix
+            match = prefixed[-1]
+        doc = assemble_trace(match["trace_id"], [match])
+        doc["critical_path"] = critical_path(doc["tree"])
+        return doc
+
     def status(self) -> dict:
         return {
             "balancer": getattr(self.balancer, "name", type(self.balancer).__name__),
@@ -358,4 +513,5 @@ class FleetRouter:
             "max_attempts": self.max_attempts,
             "replicas": self.registry.snapshot(),
             "metrics": self.obs.summary(prefix="edgemesh_fleet_"),
+            "recent_traces": self.recent_traces(),
         }

@@ -10,6 +10,10 @@ Three pieces (docs/OBSERVABILITY.md is the operator-facing reference):
   and replayable into the same registry aggregates offline.
 - ``device``: scrape-time gauges over ``jax.local_devices()``
   ``memory_stats()`` and live-buffer counts.
+- ``trace``: distributed tracing — the ``X-Edgemesh-Trace`` context the
+  fleet router propagates to replicas, cross-process trace assembly with
+  clock-skew correction (``edgemesh obs trace``), and the JAX
+  compile-telemetry hook.
 
 Importing this package never imports jax — device sampling defers the
 import to scrape time, so the supervisor and the ``edgemesh obs`` CLI stay
@@ -28,4 +32,15 @@ from edgemesh.obs.spans import (  # noqa: F401
     RequestTrace,
     SpanTracker,
     replay_spans,
+)
+from edgemesh.obs.trace import (  # noqa: F401
+    TRACE_HEADER,
+    TraceContext,
+    assemble_trace,
+    critical_path,
+    current_trace,
+    install_compile_hook,
+    load_trace,
+    uninstall_compile_hook,
+    use_trace,
 )

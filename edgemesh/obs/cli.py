@@ -11,8 +11,19 @@ Subcommands (all operate on the span JSONL the engines write via
 - ``prom <spans.jsonl>``: the same replay, rendered as Prometheus text
   exposition — byte-for-byte the format a live ``/metrics`` scrape serves,
   so offline logs and live scrapes feed the same dashboards.
+- ``trace <trace_id> --logs router.jsonl replica0.jsonl ...``: assemble
+  ONE request's spans across every process that touched it (router record
+  + replica engine records + compile events) into a single tree with
+  clock-skew correction, plus the critical-path split (wire vs queue vs
+  prefill vs decode vs retry-wasted — obs/trace.py). Unique id prefixes
+  are accepted; ambiguous prefixes list the candidates.
 
-Exit status: 0 on success, 2 on usage errors (missing file).
+An empty or all-malformed span log is an answer, not an error: ``summary``
+prints an explicit ``"requests": 0`` report and every subcommand exits 0
+(malformed lines are counted on stderr).
+
+Exit status: 0 on success, 1 when ``trace`` finds no matching id, 2 on
+usage errors (missing file).
 """
 
 from __future__ import annotations
@@ -43,6 +54,14 @@ def build_parser() -> argparse.ArgumentParser:
     prom = sub.add_parser("prom",
                           help="replay spans into Prometheus exposition text")
     prom.add_argument("path")
+    tr = sub.add_parser(
+        "trace",
+        help="assemble one trace id across span logs (skew-corrected tree "
+        "+ critical path)")
+    tr.add_argument("trace_id", help="full trace id or a unique prefix")
+    tr.add_argument("--logs", nargs="+", required=True, metavar="JSONL",
+                    help="span logs from every process: the router's "
+                    "--span-log plus each replica's")
     return p
 
 
@@ -110,8 +129,32 @@ def cmd_prom(path: str) -> int:
     return 0
 
 
+def cmd_trace(trace_id: str, logs: list[str]) -> int:
+    from edgemesh.obs.trace import load_trace
+
+    missing = [p for p in logs if not Path(p).exists()]
+    if missing:
+        print(f"error: no such span log: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    doc = load_trace(trace_id, logs)
+    if doc["tree"] is None:
+        candidates = doc.get("candidates", [])
+        if candidates:
+            print(f"error: trace id prefix {trace_id!r} is ambiguous: "
+                  f"{', '.join(candidates)}", file=sys.stderr)
+        else:
+            print(f"error: no records for trace {trace_id!r} in "
+                  f"{len(logs)} log(s)", file=sys.stderr)
+        return 1
+    print(json.dumps(doc, indent=2))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.cmd == "trace":
+        return cmd_trace(args.trace_id, args.logs)
     if not Path(args.path).exists():
         print(f"error: no such span log: {args.path}", file=sys.stderr)
         return 2

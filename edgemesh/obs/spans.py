@@ -19,6 +19,7 @@ summary``/``prom`` do offline.
 
 from __future__ import annotations
 
+import random
 import time
 from pathlib import Path
 from typing import Any, Iterable
@@ -41,12 +42,22 @@ class RequestTrace:
         "rid", "ts_unix", "t_submit", "t_admit_start", "t_start",
         "t_first_token", "t_last", "t_end", "generated", "segments",
         "spans", "status", "attrs",
+        "trace_id", "span_id", "parent_span_id", "sampled",
     )
 
     def __init__(self, rid: int, t_submit: float):
         self.rid = rid
         self.ts_unix = time.time()
         self.t_submit = t_submit
+        # Distributed-trace identity (obs/trace.py): filled by
+        # SpanTracker.submit — propagated from the fleet router's attempt
+        # span when the request arrived with an X-Edgemesh-Trace header,
+        # minted locally otherwise. ``sampled`` gates the JSONL flush only;
+        # metrics always count.
+        self.trace_id: str | None = None
+        self.span_id: str | None = None
+        self.parent_span_id: str | None = None
+        self.sampled = True
         self.t_admit_start: float | None = None
         self.t_start: float | None = None  # admission (prefill) complete
         self.t_first_token: float | None = None
@@ -70,9 +81,16 @@ class SpanTracker:
 
     def __init__(self, registry: Registry | None = None,
                  span_log: str | Path | None = None,
-                 engine: str = "continuous"):
+                 engine: str = "continuous",
+                 trace_sample: float = 1.0):
         self.registry = registry or get_registry()
         self.engine = engine
+        # Span-I/O sampling for locally-originated requests (requests that
+        # arrive with a trace context inherit ITS sampled bit instead, so
+        # the router's decision is honored end to end). Sampled-out
+        # requests cost zero span I/O but still feed every metric.
+        self.trace_sample = float(trace_sample)
+        self._sample_rng = random.Random()
         self._log = None
         if span_log is not None:
             from edgemesh.utils.tracing import JsonlLogger
@@ -121,8 +139,25 @@ class SpanTracker:
     def now(self) -> float:
         return time.perf_counter()
 
-    def submit(self, rid: int) -> RequestTrace:
+    def submit(self, rid: int, trace_ctx=None) -> RequestTrace:
+        """``trace_ctx`` is the propagated :class:`~edgemesh.obs.trace.
+        TraceContext` from the fleet router's attempt span (None for
+        locally-originated requests, which mint their own root)."""
+        from edgemesh.obs.trace import TraceContext, sample
+
         trace = RequestTrace(rid, self.now())
+        if trace_ctx is not None:
+            trace.trace_id = trace_ctx.trace_id
+            trace.parent_span_id = trace_ctx.span_id
+            trace.sampled = trace_ctx.sampled
+            ctx = trace_ctx.child()
+        else:
+            ctx = TraceContext.mint(
+                sampled=sample(self.trace_sample, self._sample_rng)
+            )
+            trace.trace_id = ctx.trace_id
+            trace.sampled = ctx.sampled
+        trace.span_id = ctx.span_id
         self._submitted.inc()
         return trace
 
@@ -172,7 +207,7 @@ class SpanTracker:
             itl = (now - trace.t_first_token) / (trace.generated - 1)
             self._itl.observe(itl, count=trace.generated - 1)
         self._latency.observe(now - trace.t_submit)
-        if self._log is not None:
+        if self._log is not None and trace.sampled:
             ttft = (
                 None if trace.t_first_token is None
                 else trace.t_first_token - trace.t_submit
@@ -180,6 +215,12 @@ class SpanTracker:
             self._log.log(
                 SPAN_RECORD_EVENT,
                 rid=trace.rid, engine=self.engine, status=status,
+                trace_id=trace.trace_id, span_id=trace.span_id,
+                parent_span_id=trace.parent_span_id,
+                # Wall anchor for cross-process assembly: spans are
+                # perf_counter values and spans[0].t0 == t_submit, so
+                # wall(t) = ts_submit + (t - spans[0].t0) (obs/trace.py).
+                ts_submit=trace.ts_unix,
                 generated=trace.generated, segments=trace.segments,
                 queue_s=(
                     None if trace.t_admit_start is None

@@ -1,0 +1,505 @@
+"""Distributed tracing: one trace id from the fleet router to the engine.
+
+PR 2's spans stop at the process boundary and the fleet router only sees
+black-box attempt latencies — the profiling-driven placement line
+(PAPERS.md: TPI-LLM, profiling-driven edge inference) needs per-stage,
+per-device timing for a *single* request across every process it touched.
+This module is that seam, in four pieces:
+
+- **TraceContext**: a W3C ``traceparent``-compatible context
+  (``00-<trace_id:32hex>-<span_id:16hex>-<flags:2hex>``, flag bit 0 =
+  sampled) carried on the ``X-Edgemesh-Trace`` header. The router mints
+  one per request and mints a *child* context per retry/hedge attempt;
+  the replica gateway parses it and hands it to the engine's
+  ``SpanTracker``, so the engine's queued/prefill/decode spans become
+  children of the router's attempt span.
+- **Cross-process assembly**: every process appends trace-stamped records
+  to its own span JSONL (the router writes ``router_spans`` records, the
+  engines stamp trace ids into their existing ``request_spans`` records);
+  ``assemble_trace`` merges records for one trace id into a single tree,
+  correcting per-process clock skew by anchoring each replica's window on
+  the request/response edge of its parent attempt span (the symmetric
+  NTP offset: ``((send − server_start) + (recv − server_end)) / 2``).
+- **Critical path**: ``critical_path(tree)`` splits the client-observed
+  latency into wire vs queue vs prefill vs decode vs retry-wasted time
+  (plus an explicit residue) — the durations sum to the root span by
+  construction.
+- **Compile telemetry**: ``install_compile_hook`` registers a
+  ``jax.monitoring`` duration listener (via the drift shim in
+  ``utils/compat.py``) that counts compiles/recompiles as labeled
+  metrics and, with a span log, emits ``compile`` records stamped with
+  the ambient trace context (``current_trace``) so a first-request
+  compile shows up inside that request's assembled trace.
+
+No jax at module scope — the router and the ``edgemesh obs`` CLI stay
+importable on hosts with no accelerator (same contract as the rest of
+``edgemesh.obs``); only ``install_compile_hook`` touches jax, lazily.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import random
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Iterable
+
+TRACE_HEADER = "X-Edgemesh-Trace"
+#: Router-side record event (the engines keep ``request_spans``).
+ROUTER_RECORD_EVENT = "router_spans"
+#: JAX compile-duration record event.
+COMPILE_RECORD_EVENT = "compile"
+
+_VERSION = "00"
+
+
+def _hex_id(nbytes: int, rng: random.Random | None = None) -> str:
+    if rng is not None:
+        return rng.getrandbits(nbytes * 8).to_bytes(nbytes, "big").hex()
+    return os.urandom(nbytes).hex()
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One hop of a distributed trace: (trace_id, this hop's span_id)."""
+
+    trace_id: str  # 32 lowercase hex chars
+    span_id: str   # 16 lowercase hex chars
+    sampled: bool = True
+
+    @classmethod
+    def mint(cls, sampled: bool = True,
+             rng: random.Random | None = None) -> "TraceContext":
+        """A fresh root context. ``rng`` is injectable for deterministic
+        tests; production minting uses ``os.urandom`` — per-process seeded
+        PRNGs would collide trace ids across replicas."""
+        return cls(_hex_id(16, rng), _hex_id(8, rng), sampled)
+
+    def child(self, rng: random.Random | None = None) -> "TraceContext":
+        """Same trace, new span id — one per retry/hedge attempt."""
+        return TraceContext(self.trace_id, _hex_id(8, rng), self.sampled)
+
+    def to_header(self) -> str:
+        return (
+            f"{_VERSION}-{self.trace_id}-{self.span_id}-"
+            f"{'01' if self.sampled else '00'}"
+        )
+
+    @classmethod
+    def parse(cls, header: str | None) -> "TraceContext | None":
+        """Parse an ``X-Edgemesh-Trace`` value. Malformed headers return
+        ``None`` (W3C semantics: a broken context is dropped, never a 400 —
+        tracing must not be able to fail a request)."""
+        if not header:
+            return None
+        parts = header.strip().split("-")
+        if len(parts) != 4:
+            return None
+        version, trace_id, span_id, flags = parts
+        if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+            return None
+        try:
+            int(version, 16)
+            int(trace_id, 16)
+            int(span_id, 16)
+            flag_bits = int(flags, 16)
+        except ValueError:
+            return None
+        if set(trace_id) == {"0"} or set(span_id) == {"0"}:
+            return None  # all-zero ids are invalid per traceparent
+        return cls(trace_id.lower(), span_id.lower(), bool(flag_bits & 1))
+
+
+# ---------------------------------------------------------------------------
+# Ambient context (what the compile hook stamps onto its records)
+# ---------------------------------------------------------------------------
+
+_current: contextvars.ContextVar[TraceContext | None] = contextvars.ContextVar(
+    "edgemesh_trace", default=None
+)
+
+
+def current_trace() -> TraceContext | None:
+    return _current.get()
+
+
+def sample(rate: float, rng: random.Random) -> bool:
+    """One span-I/O sampling decision — THE definition, shared by the
+    router and the replica trackers so their semantics cannot diverge.
+    ``rate >= 1`` always samples without consuming the rng."""
+    return rate >= 1.0 or rng.random() < rate
+
+
+@contextmanager
+def use_trace(ctx: TraceContext | None):
+    """Bind ``ctx`` as the ambient trace for the duration of the block
+    (a no-op when ``ctx`` is None)."""
+    token = _current.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# Cross-process assembly
+# ---------------------------------------------------------------------------
+#
+# Two record clock conventions meet here:
+# - router records carry wall-clock span edges directly (``clock: "wall"``);
+# - engine records carry ``perf_counter`` edges plus a ``ts_submit`` wall
+#   anchor (the queued span's t0 IS the submit instant), so
+#   wall(t) = ts_submit + (t - spans[0].t0).
+# Wall clocks across processes still skew; ``_attach_server`` corrects each
+# replica record against its parent attempt span's request/response edge.
+
+ENGINE_RECORD_EVENT = "request_spans"  # mirrors spans.SPAN_RECORD_EVENT
+
+
+def record_wall_spans(rec: dict) -> list[dict[str, Any]]:
+    """The record's spans with wall-clock ``t0``/``t1`` (copies)."""
+    spans = [dict(s) for s in rec.get("spans", ())]
+    if rec.get("clock") == "wall" or not spans:
+        return spans
+    anchor_wall = rec.get("ts_submit", rec.get("ts"))
+    anchor = spans[0].get("t0")
+    if anchor_wall is None or anchor is None:
+        return spans
+    for s in spans:
+        for edge in ("t0", "t1"):
+            if s.get(edge) is not None:
+                s[edge] = anchor_wall + (s[edge] - anchor)
+    return spans
+
+
+def clock_offset(attempt: dict, w0: float, w1: float) -> float:
+    """Symmetric-network clock offset mapping a replica's wall window
+    ``[w0, w1]`` into the router's clock, anchored on the attempt span's
+    request/response edges: the request left the router at ``attempt.t0``
+    and the response landed at ``attempt.t1``, so under symmetric wire
+    time the replica's clock is off by the mean edge disagreement."""
+    t0, t1 = attempt.get("t0"), attempt.get("t1")
+    if t0 is None:
+        return 0.0
+    if t1 is None:  # unfinished attempt: only the request edge anchors
+        return t0 - w0
+    return ((t0 - w0) + (t1 - w1)) / 2.0
+
+
+def _node(name: str, t0, t1, **attrs: Any) -> dict[str, Any]:
+    n: dict[str, Any] = {"name": name, "t0": t0, "t1": t1}
+    n.update({k: v for k, v in attrs.items() if v is not None})
+    n["children"] = []
+    return n
+
+
+def _attach_server(parent: dict, rec: dict, offset: float | None = None) -> dict:
+    """Build a replica-side ``server`` node (queued/prefill/decode/retire
+    children) under ``parent``, skew-corrected by ``offset`` (computed from
+    the parent attempt's edges when not given)."""
+    spans = record_wall_spans(rec)
+    if not spans:
+        return parent
+    w0 = spans[0]["t0"]
+    w1 = max(s["t1"] for s in spans if s.get("t1") is not None)
+    if offset is None:
+        offset = clock_offset(parent, w0, w1)
+    server = _node(
+        "server", w0 + offset, w1 + offset,
+        process=rec.get("engine", "replica"),
+        span_id=rec.get("span_id"),
+        status=rec.get("status"),
+        generated=rec.get("generated"),
+        skew_s=round(offset, 6),
+    )
+    for s in spans:
+        child = dict(s)
+        child["t0"] = s["t0"] + offset
+        if s.get("t1") is not None:
+            child["t1"] = s["t1"] + offset
+        child.setdefault("children", [])
+        server["children"].append(child)
+    parent["children"].append(server)
+    return server
+
+
+def assemble_trace(trace_id: str, records: Iterable[dict]) -> dict[str, Any]:
+    """Merge every record stamped with ``trace_id`` into one span tree.
+
+    Returns ``{"trace_id", "processes", "tree"}``; ``tree`` is None when no
+    record matches. The router record (if present) forms the root with one
+    child per attempt; each engine record attaches under the attempt whose
+    span id it names as parent (skew-corrected), or under the root when its
+    parent attempt never made it into the router record (an abandoned hedge
+    loser can outlive the router's flush). Compile records attach to the
+    node from the same source log (``load_trace`` stamps ``_log``)."""
+    router_recs, engine_recs, compile_recs = [], [], []
+    for rec in records:
+        if rec.get("trace_id") != trace_id:
+            continue
+        ev = rec.get("event")
+        if ev == ROUTER_RECORD_EVENT:
+            router_recs.append(rec)
+        elif ev == ENGINE_RECORD_EVENT:
+            engine_recs.append(rec)
+        elif ev == COMPILE_RECORD_EVENT:
+            compile_recs.append(rec)
+    processes = len(router_recs) + len(engine_recs)
+    if processes == 0:
+        return {"trace_id": trace_id, "processes": 0, "tree": None}
+
+    by_log: dict[Any, dict] = {}
+    if router_recs:
+        rr = router_recs[0]
+        spans = record_wall_spans(rr)
+        root_span = spans[0] if spans else {"name": "request"}
+        root = _node(
+            "request", root_span.get("t0"), root_span.get("t1"),
+            process="router", span_id=rr.get("span_id"),
+            status=rr.get("status"), attempts=rr.get("attempts"),
+        )
+        attempts_by_id: dict[str, dict] = {}
+        for s in spans[1:]:
+            att = dict(s)
+            att.setdefault("children", [])
+            root["children"].append(att)
+            if att.get("span_id"):
+                attempts_by_id[att["span_id"]] = att
+        by_log[rr.get("_log")] = root
+        for rec in engine_recs:
+            parent = attempts_by_id.get(rec.get("parent_span_id"), root)
+            server = _attach_server(parent, rec)
+            by_log[rec.get("_log")] = server
+    else:
+        # Replica-only view: synthesize a root spanning the engine records.
+        first = engine_recs[0]
+        spans = record_wall_spans(first)
+        root = _node(
+            "request", spans[0]["t0"] if spans else None,
+            max((s["t1"] for s in spans if s.get("t1") is not None),
+                default=None),
+            process=first.get("engine", "replica"), synthetic=True,
+        )
+        for rec in engine_recs:
+            server = _attach_server(root, rec, offset=0.0)
+            by_log[rec.get("_log")] = server
+    for rec in compile_recs:
+        host = by_log.get(rec.get("_log"), root)
+        t1 = rec.get("ts")
+        dur = rec.get("duration_s") or 0.0
+        host["children"].append(_node(
+            "compile", None if t1 is None else t1 - dur, t1,
+            event=rec.get("name"), duration_s=dur,
+        ))
+    return {"trace_id": trace_id, "processes": processes, "tree": root}
+
+
+def critical_path(tree: dict | None) -> dict[str, Any]:
+    """Where the client-observed time went, summing to the root span.
+
+    ``retry_wasted_s`` is everything before the winning attempt started
+    (failed attempts + backoff sleeps); ``wire_s`` is the winning attempt
+    minus its server window (request + response network/parse time);
+    queue/prefill/decode come from the winning replica's spans; ``other_s``
+    is the explicit residue (span gaps, retirement → response write, router
+    bookkeeping after the answer) so the parts always sum to ``total_s``.
+    """
+    empty = {
+        "total_s": None, "retry_wasted_s": 0.0, "wire_s": 0.0,
+        "queue_s": 0.0, "prefill_s": 0.0, "decode_s": 0.0, "other_s": 0.0,
+    }
+    if not tree or tree.get("t0") is None or tree.get("t1") is None:
+        return empty
+    total = tree["t1"] - tree["t0"]
+    attempts = [c for c in tree.get("children", ()) if c.get("name") == "attempt"]
+    # The winner is the attempt whose answer the client actually received
+    # (``won``, stamped by the router) — an abandoned hedge loser can also
+    # finish with outcome "ok" later, and its window describes the wrong
+    # attempt. Records from before the marker fall back to last-ok.
+    winner = None
+    for att in attempts:
+        if att.get("won"):
+            winner = att
+    if winner is None:
+        for att in attempts:
+            if att.get("outcome") == "ok":
+                winner = att
+    if winner is None:
+        # No attempt spans (replica-only tree): treat the first server node
+        # as the winner's window so queue/prefill/decode still split out.
+        servers = [c for c in tree.get("children", ()) if c.get("name") == "server"]
+        winner = servers[0] if servers else None
+        if winner is None:
+            return {**empty, "total_s": round(total, 6),
+                    "other_s": round(total, 6)}
+    retry_wasted = max(0.0, (winner.get("t0") or tree["t0"]) - tree["t0"])
+    win_t1 = winner.get("t1") if winner.get("t1") is not None else tree["t1"]
+    win_dur = max(0.0, win_t1 - winner["t0"])
+    servers = [c for c in winner.get("children", ()) if c.get("name") == "server"]
+    if winner.get("name") == "server":
+        servers = [winner]
+    queue = prefill = decode = 0.0
+    wire = win_dur
+    if servers:
+        srv = servers[0]
+        srv_dur = max(0.0, (srv.get("t1") or win_t1) - srv["t0"])
+        wire = max(0.0, win_dur - srv_dur)
+        for s in srv.get("children", ()):
+            if s.get("t1") is None or s.get("t0") is None:
+                continue
+            d = s["t1"] - s["t0"]
+            if s.get("name") == "queued":
+                queue += d
+            elif s.get("name") == "prefill":
+                prefill += d
+            elif s.get("name") == "decode":
+                decode += d
+    out = {
+        "total_s": round(total, 6),
+        "retry_wasted_s": round(retry_wasted, 6),
+        "wire_s": round(wire, 6),
+        "queue_s": round(queue, 6),
+        "prefill_s": round(prefill, 6),
+        "decode_s": round(decode, 6),
+    }
+    # Residue computed from the ROUNDED parts, so the published numbers sum
+    # to the published total exactly — seven independently-rounded values
+    # would drift by up to ~3.5e-6 otherwise.
+    out["other_s"] = round(
+        out["total_s"] - out["retry_wasted_s"] - out["wire_s"]
+        - out["queue_s"] - out["prefill_s"] - out["decode_s"], 6,
+    )
+    return out
+
+
+def load_trace(trace_id: str, paths: Iterable) -> dict[str, Any]:
+    """Read span JSONL logs, resolve a (possibly unique-prefix) trace id,
+    and assemble. Returns the ``assemble_trace`` document plus
+    ``critical_path`` and the candidate ids when the prefix is ambiguous."""
+    from edgemesh.utils.tracing import JsonlLogger
+
+    records: list[dict] = []
+    for p in paths:
+        for rec in JsonlLogger(p).read():
+            rec["_log"] = str(p)
+            records.append(rec)
+    ids = sorted({
+        r["trace_id"] for r in records
+        if isinstance(r.get("trace_id"), str)
+    })
+    matches = [t for t in ids if t == trace_id] or [
+        t for t in ids if t.startswith(trace_id)
+    ]
+    if len(matches) != 1:
+        return {"trace_id": trace_id, "processes": 0, "tree": None,
+                "critical_path": critical_path(None),
+                "candidates": matches}
+    doc = assemble_trace(matches[0], records)
+    doc["critical_path"] = critical_path(doc["tree"])
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# JAX compile telemetry
+# ---------------------------------------------------------------------------
+
+
+class CompileEventHook:
+    """Counts jit compiles (and recompiles) into a registry and optionally
+    logs them as trace-stamped ``compile`` span records.
+
+    Fed ``jax.monitoring`` duration events; only ``/jax/core/compile/*``
+    keys count. "Recompile" is per process and per event key: the first
+    ``backend_compile`` is the expected warmup, every later one is a
+    retrace/recompile worth noticing (shape churn, cache misses)."""
+
+    #: the event key that means "XLA actually compiled a program"
+    BACKEND_COMPILE = "backend_compile_duration"
+
+    def __init__(self, registry=None, span_log=None):
+        from edgemesh.obs.metrics import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        self._compiles = reg.counter(
+            "edgemesh_jax_compiles_total",
+            "JAX compile-pipeline events observed, by event key", ("event",),
+        )
+        self._recompiles = reg.counter(
+            "edgemesh_jax_recompiles_total",
+            "backend_compile events beyond the first in this process "
+            "(retraces / shape churn)",
+        )
+        self._duration = reg.histogram(
+            "edgemesh_jax_compile_seconds",
+            "JAX compile-pipeline event durations, by event key", ("event",),
+        )
+        self._log = None
+        if span_log is not None:
+            from edgemesh.utils.tracing import JsonlLogger
+
+            self._log = JsonlLogger(span_log)
+        self._backend_compiles = 0
+        self._lock = threading.Lock()
+
+    def on_event(self, name: str, duration_s: float) -> None:
+        if "/compile/" not in name:
+            return
+        key = name.rsplit("/", 1)[-1]
+        self._compiles.labels(event=key).inc()
+        self._duration.labels(event=key).observe(duration_s)
+        if key == self.BACKEND_COMPILE:
+            with self._lock:
+                self._backend_compiles += 1
+                recompile = self._backend_compiles > 1
+            if recompile:
+                self._recompiles.inc()
+        if self._log is not None and key == self.BACKEND_COMPILE:
+            ctx = current_trace()
+            self._log.log(
+                COMPILE_RECORD_EVENT, name=key,
+                duration_s=round(duration_s, 6),
+                trace_id=ctx.trace_id if ctx is not None else None,
+                parent_span_id=ctx.span_id if ctx is not None else None,
+            )
+
+
+# One process-wide dispatcher: jax.monitoring listeners cannot be removed
+# individually, so jax sees exactly one listener and hooks attach/detach
+# from this list (engines detach on close()).
+_hook_lock = threading.Lock()
+_hooks: list[CompileEventHook] = []
+_listener_registered = False
+
+
+def _dispatch(name: str, duration_s: float) -> None:
+    for hook in list(_hooks):
+        try:
+            hook.on_event(name, duration_s)
+        except Exception:  # telemetry must never break a compile
+            pass
+
+
+def install_compile_hook(registry=None, span_log=None) -> CompileEventHook:
+    """Attach a :class:`CompileEventHook`. The first call registers the one
+    process-wide ``jax.monitoring`` listener (via the ``utils.compat`` drift
+    shim — a jax without monitoring hooks degrades to a hook that only
+    counts what ``on_event`` is fed directly). Detach with
+    :func:`uninstall_compile_hook` when the owning engine closes."""
+    global _listener_registered
+    hook = CompileEventHook(registry=registry, span_log=span_log)
+    with _hook_lock:
+        _hooks.append(hook)
+        if not _listener_registered:
+            from edgemesh.utils.compat import register_compile_event_listener
+
+            if register_compile_event_listener(_dispatch):
+                _listener_registered = True
+    return hook
+
+
+def uninstall_compile_hook(hook: CompileEventHook) -> None:
+    with _hook_lock:
+        if hook in _hooks:
+            _hooks.remove(hook)

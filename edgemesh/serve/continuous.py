@@ -76,6 +76,12 @@ import numpy as np
 
 from edgemesh.models.transformer import KVCache, forward_decode, forward_prefill, init_kv_cache
 from edgemesh.obs import RequestTrace, SpanTracker
+from edgemesh.obs.trace import (
+    TraceContext,
+    install_compile_hook,
+    uninstall_compile_hook,
+    use_trace,
+)
 from edgemesh.ops.sampling import TokenMaskState
 from edgemesh.runtime.generate import _decode_loop
 from edgemesh.runtime.paged_generate import (
@@ -272,6 +278,7 @@ class ContinuousEngine:
         admission: str = "fifo",
         span_log=None,
         registry=None,
+        trace_sample: float = 1.0,
     ):
         self.agent = agent
         self.cfg = agent.cfg
@@ -360,7 +367,13 @@ class ContinuousEngine:
         self.segments = 0
         self.admitted_mid_flight = 0
         self.max_concurrent = 0
-        self.obs = SpanTracker(registry, span_log, engine=self.obs_engine_label)
+        self.obs = SpanTracker(registry, span_log, engine=self.obs_engine_label,
+                               trace_sample=trace_sample)
+        # Compile telemetry rides the same registry/span log: recompiles
+        # mid-serve are the silent latency cliff every trace should show.
+        self._compile_hook = install_compile_hook(
+            registry=self.obs.registry, span_log=span_log
+        )
         self._pages_gauge = self.obs.registry.gauge(
             "edgemesh_kv_pages", "Paged KV pool occupancy by state",
             ("engine", "state"),
@@ -376,11 +389,15 @@ class ContinuousEngine:
 
     # -- public interface (DynamicBatcher-compatible) -----------------------
 
-    def submit(self, question: str, max_new: int | None = None) -> Future:
+    def submit(self, question: str, max_new: int | None = None,
+               trace_ctx: TraceContext | None = None) -> Future:
         """Enqueue one request. ``max_new`` caps THIS request's token budget
         below the engine-wide ``sampling.max_new_tokens`` (budgets are
         per-slot host state, so a per-request cap costs nothing); the
-        "sjf" admission policy uses it as the job-size estimate."""
+        "sjf" admission policy uses it as the job-size estimate.
+        ``trace_ctx`` is the propagated distributed-trace context (the
+        fleet router's attempt span) — the request's spans join that trace
+        instead of minting their own (obs/trace.py)."""
         if max_new is not None:
             max_new = int(max_new)
             if max_new < 1:
@@ -389,20 +406,23 @@ class ContinuousEngine:
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine is closed")
-            trace = self.obs.submit(self.requests)  # rid = arrival index
+            trace = self.obs.submit(self.requests, trace_ctx)  # rid = arrival index
             self._queue.append((question, fut, trace, max_new))
             self.requests += 1
             self._cond.notify()
         return fut
 
-    def answer(self, question: str, max_new: int | None = None) -> dict[str, Any]:
-        return self.submit(question, max_new=max_new).result()
+    def answer(self, question: str, max_new: int | None = None,
+               trace_ctx: TraceContext | None = None) -> dict[str, Any]:
+        return self.submit(question, max_new=max_new,
+                           trace_ctx=trace_ctx).result()
 
     def close(self) -> None:
         with self._cond:
             self._closed = True
             self._cond.notify()
         self._worker.join(timeout=10)
+        uninstall_compile_hook(self._compile_hook)
 
     def stats(self) -> dict[str, Any]:
         # Under the engine lock: the worker mutates counters and the paged
@@ -906,8 +926,17 @@ class ContinuousEngine:
             mid = any(s.active for s in self._slots) or inflight is not None
             for pos, ((q, fut, trace, req_max), idx) in enumerate(zip(pending, free_now)):
                 try:
-                    ok = self._admit(idx, q, fut, trace, mid_flight=mid,
-                                     max_new=req_max)
+                    # Bind the request's trace context around admission so
+                    # a prefill-triggered jit compile lands in ITS trace
+                    # (compile records are process-ambient otherwise).
+                    ctx = (
+                        TraceContext(trace.trace_id, trace.span_id,
+                                     trace.sampled)
+                        if trace.trace_id and trace.span_id else None
+                    )
+                    with use_trace(ctx):
+                        ok = self._admit(idx, q, fut, trace, mid_flight=mid,
+                                         max_new=req_max)
                 except Exception as exc:
                     # Fail only THIS request: already-admitted slots keep
                     # their pending futures (poisoning them would make the
@@ -1001,6 +1030,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         admission: str = "fifo",
         span_log=None,
         registry=None,
+        trace_sample: float = 1.0,
     ):
         if getattr(agent, "draft_cfg", None) is None:
             raise ValueError(
@@ -1040,6 +1070,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             agent, slots=slots, chunk=chunk, idle_wait_s=idle_wait_s,
             kv_backend=kv_backend, page_size=page_size, total_pages=total_pages,
             admission=admission, span_log=span_log, registry=registry,
+            trace_sample=trace_sample,
         )
         # The worker thread is live from here on: a failure below would
         # orphan it blocked on the condition with a half-built engine —
@@ -1124,7 +1155,8 @@ class SpeculativeContinuousEngine(ContinuousEngine):
     def _ensure_template(self) -> None:
         return
 
-    def submit(self, question: str, max_new: int | None = None) -> Future:
+    def submit(self, question: str, max_new: int | None = None,
+               trace_ctx: TraceContext | None = None) -> Future:
         if max_new is not None:
             # Fail fast on the caller's thread — the _admit guard below
             # stays as defense in depth, but surfacing an EXPECTED
@@ -1134,7 +1166,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
                 "the speculative engine keeps one uniform budget per pool; "
                 "per-request max_new is not supported"
             )
-        return super().submit(question)
+        return super().submit(question, trace_ctx=trace_ctx)
 
     def _admit(self, idx: int, question: str, fut: Future, trace,
                mid_flight: bool, max_new: int | None = None) -> bool:

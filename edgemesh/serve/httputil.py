@@ -54,6 +54,23 @@ def read_deadline_header(handler) -> tuple[bool, float | None]:
         return False, None
 
 
+TRACE_HEADER = "X-Edgemesh-Trace"
+
+
+def read_trace_header(handler):
+    """Parse the propagated distributed-trace context (obs/trace.py).
+    Returns a ``TraceContext`` or None; malformed values are dropped, not
+    400s — tracing must never be able to fail a request. The import is
+    deferred so this module keeps its stdlib-only surface for callers that
+    never see the header."""
+    raw = handler.headers.get(TRACE_HEADER)
+    if raw is None:
+        return None
+    from edgemesh.obs.trace import TraceContext
+
+    return TraceContext.parse(raw)
+
+
 def read_json_body(handler) -> dict | None:
     """Parse the request body; answers the 400 itself on bad input."""
     try:

@@ -23,6 +23,14 @@ Endpoints:
   per decoded chunk, then ``data: {"answer": ..., "done": true}``
 - ``POST /drain``     → flip to draining (readyz → 503, new generates →
   503) and finish in-flight work; the fleet's pre-stop hook
+- ``GET  /debug/profile?seconds=N`` → opt-in (``profile_dir=`` /
+  ``--profile-dir``) ``jax.profiler`` capture; returns the trace path
+
+Distributed tracing: ``/generate*`` honors the ``X-Edgemesh-Trace``
+context header (obs/trace.py) — the continuous engine's span record joins
+the sender's trace (its spans become children of the fleet router's
+attempt span) and compile events fired while handling the request are
+stamped with it.
 
 Robustness semantics (what the fleet router relies on): malformed bodies
 are structured 400s (never 500), overload and draining answer 503 +
@@ -60,6 +68,8 @@ class GatewayServer(ThreadingHTTPServer):
         super().__init__(addr, handler)
         self.batcher = None
         self.max_inflight = 0  # 0 = unbounded; serve_rest overrides
+        self.profile_dir = None  # opt-in /debug/profile target
+        self.profile_lock = threading.Lock()  # jax profiles cannot nest
         self._inflight = 0
         self._inflight_cv = threading.Condition()
         self._draining = False
@@ -106,6 +116,15 @@ class GatewayServer(ThreadingHTTPServer):
 def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                   request_timeout_s=None):
     from edgemesh.obs import get_registry
+
+    # Whether the batcher speaks trace contexts is fixed for the server's
+    # lifetime — decide once, not per request. Only the engines do; the
+    # DynamicBatcher coalesces requests and has no per-request span tree.
+    batcher_speaks_trace = False
+    if batcher is not None:
+        from edgemesh.serve.continuous import ContinuousEngine
+
+        batcher_speaks_trace = isinstance(batcher, ContinuousEngine)
 
     class Handler(BaseHTTPRequestHandler):
         # Per-connection socket timeout (StreamRequestHandler.setup applies
@@ -170,6 +189,9 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 )
             elif self.path == "/stats":
                 self._send(200, self._stats_payload())
+            elif (self.path == "/debug/profile"
+                  or self.path.startswith("/debug/profile?")):
+                self._profile()
             elif self.path == "/statusz":
                 self._send_text(200, _render_statusz(
                     ensemble, self._stats_payload(),
@@ -177,6 +199,48 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 ))
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
+
+        def _profile(self):
+            """Opt-in ``GET /debug/profile?seconds=N``: capture a
+            ``jax.profiler`` device/host trace under the configured
+            ``profile_dir`` and return its path. Disabled (403) unless the
+            gateway was started with a profile dir — captures cost real CPU,
+            write to disk, and expose program structure, so this must never
+            be reachable by default (docs/OBSERVABILITY.md security note).
+            One capture at a time: ``jax.profiler`` traces cannot nest."""
+            from pathlib import Path
+            from urllib.parse import parse_qs, urlparse
+
+            prof_dir = getattr(self.server, "profile_dir", None)
+            if not prof_dir:
+                self._send(403, {"error": "profiling disabled (opt in with "
+                                          "--profile-dir / profile_dir=)"})
+                return
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                seconds = float(q.get("seconds", ["2"])[0])
+            except ValueError:
+                self._send(400, {"error": "'seconds' must be a number"})
+                return
+            if not 0 < seconds <= 60:
+                self._send(400, {"error": "'seconds' must be in (0, 60]"})
+                return
+            if not self.server.profile_lock.acquire(blocking=False):
+                self._send(409, {"error": "a profile capture is already "
+                                          "running"}, extra={"Retry-After": "1"})
+                return
+            try:
+                from edgemesh.utils.tracing import capture_profile
+
+                out = Path(prof_dir) / time.strftime("profile-%Y%m%d-%H%M%S")
+                with capture_profile(out):
+                    time.sleep(seconds)
+                self._send(200, {"path": str(out), "seconds": seconds})
+            except Exception as exc:
+                log.exception("profile capture failed")
+                self._send(500, {"error": str(exc)})
+            finally:
+                self.server.profile_lock.release()
 
         def _stream(self, question: str):
             """SSE: one `data:` line per streamed item (text/event-stream).
@@ -259,6 +323,10 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 # model work — the answer could only arrive dead.
                 self._send(504, {"error": "propagated deadline already expired"})
                 return
+            # Distributed-trace context (the router's attempt span): the
+            # engine's spans join it, and compile events fired while this
+            # request is being handled get stamped with it.
+            trace_ctx = httputil.read_trace_header(self)
             payload = self._read_json()
             if payload is None:
                 return
@@ -276,11 +344,14 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                            extra={"Retry-After": "1"})
                 return
             try:
-                self._generate(payload)
+                from edgemesh.obs.trace import use_trace
+
+                with use_trace(trace_ctx):
+                    self._generate(payload, trace_ctx)
             finally:
                 self.server.end_request()
 
-        def _generate(self, payload: dict):
+        def _generate(self, payload: dict, trace_ctx=None):
             try:
                 question = payload.get("question")
                 if not question:
@@ -327,10 +398,12 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                     # (serve/batcher.py) — the ThreadingHTTPServer gives each
                     # request its own thread, so under load the batcher sees
                     # them simultaneously.
+                    kwargs = {}
+                    if batcher_speaks_trace:
+                        kwargs["trace_ctx"] = trace_ctx
                     if max_new is not None:
-                        result = batcher.answer(question, max_new=max_new)
-                    else:
-                        result = batcher.answer(question)
+                        kwargs["max_new"] = max_new
+                    result = batcher.answer(question, **kwargs)
                 elif supervisor is not None:
                     result = supervisor.call(question)
                 else:
@@ -396,7 +469,8 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
                continuous: bool = False, kv_backend: str = "dense",
                kv_page_size: int = 64, admission: str = "fifo",
                span_log=None, registry=None, max_inflight: int = 0,
-               request_timeout_s: float | None = 300.0):
+               request_timeout_s: float | None = 300.0,
+               trace_sample: float = 1.0, profile_dir=None):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
@@ -422,6 +496,13 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     record per retirement — replayable offline via ``edgemesh obs``.
     ``registry`` overrides the process-default obs registry that /metrics
     and /statusz read (tests isolate through it).
+
+    ``trace_sample`` (continuous only) is the span-I/O sampling rate for
+    locally-originated requests — sampled-out requests write no span
+    record but still count in every metric; requests carrying an
+    ``X-Edgemesh-Trace`` header use the router's sampling bit instead.
+    ``profile_dir`` opts in ``GET /debug/profile?seconds=N`` captures
+    (disabled when None — see the security note in docs/OBSERVABILITY.md).
 
     ``max_inflight`` bounds concurrently-admitted generate requests (past
     it: 503 + Retry-After; 0 = unbounded). ``request_timeout_s`` is the
@@ -471,7 +552,7 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
         batcher = make_engine(
             ensemble.qa_agents[0], slots=batch or 8, kv_backend=kv_backend,
             page_size=kv_page_size, admission=admission, span_log=span_log,
-            registry=registry,
+            registry=registry, trace_sample=trace_sample,
         )
     elif batch > 1:
         from edgemesh.serve.batcher import DynamicBatcher
@@ -488,6 +569,7 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     # KV pools need srv.batcher.close() (tests and embedders rely on it).
     server.batcher = batcher
     server.max_inflight = max_inflight
+    server.profile_dir = profile_dir
     log.info("edgemesh REST gateway on %s:%d", host, port)
     if block:
         server.serve_forever()

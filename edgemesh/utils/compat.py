@@ -56,6 +56,33 @@ def axis_size(axis_name):
     return get_axis_env().axis_size(axis_name)
 
 
+def register_compile_event_listener(fn) -> bool:
+    """Version-portable ``jax.monitoring`` duration-listener registration.
+
+    ``fn(event_name, duration_s)`` is invoked for every monitoring duration
+    event (the compile pipeline emits ``/jax/core/compile/*`` keys). The
+    listener signature has drifted — newer jax passes extra keyword
+    metadata — so the adapter swallows ``**kwargs``. Returns False when
+    this jax has no monitoring hooks at all (the caller degrades to
+    counting nothing rather than failing: telemetry is optional by
+    construction)."""
+    monitoring = getattr(jax, "monitoring", None)
+    if monitoring is None:
+        try:
+            from jax import monitoring  # older spelling: submodule only
+        except ImportError:
+            return False
+    register = getattr(monitoring, "register_event_duration_secs_listener", None)
+    if register is None:
+        return False
+
+    def _adapter(name, duration_s, **_kwargs):
+        fn(name, duration_s)
+
+    register(_adapter)
+    return True
+
+
 def pcast(x, axis_name, *, to: str = "varying"):
     """Version-portable ``lax.pcast``.
 

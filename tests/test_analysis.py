@@ -322,6 +322,106 @@ def test_em108_honors_inline_disable():
     assert lint_source(quiet, path="edgemesh/fleet/router.py") == []
 
 
+# ---------------------------------------------------------------------------
+# EM109 fleet-missing-trace-propagation
+# ---------------------------------------------------------------------------
+
+_EM109_SRC = (
+    "def attempt(transport, url, payload):\n"
+    "    return transport.post_json(url, payload, timeout_s=1.0,\n"
+    "                               headers={'X-Edgemesh-Deadline-S': '5'})\n"
+)
+
+
+def test_em109_fires_on_headers_built_without_trace_header_in_fleet_only():
+    findings = lint_source(_EM109_SRC, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM109"}
+    assert findings[0].severity == "error"
+    assert "X-Edgemesh-Trace" in findings[0].message
+    # Outside the fleet the rule is silent.
+    assert lint_source(_EM109_SRC, path="edgemesh/serve/rest.py") == []
+
+
+def test_em109_quiet_with_literal_key_constant_name_or_expansion():
+    literal = _EM109_SRC.replace(
+        "headers={'X-Edgemesh-Deadline-S': '5'}",
+        "headers={'X-Edgemesh-Trace': h}",
+    )
+    assert lint_source(literal, path="edgemesh/fleet/router.py") == []
+    # The TRACE_HEADER constant (any attribute path) counts.
+    const = _EM109_SRC.replace(
+        "headers={'X-Edgemesh-Deadline-S': '5'}",
+        "headers={TRACE_HEADER: ctx.to_header()}",
+    )
+    assert lint_source(const, path="edgemesh/fleet/router.py") == []
+    attr = _EM109_SRC.replace(
+        "headers={'X-Edgemesh-Deadline-S': '5'}",
+        "headers={httputil.TRACE_HEADER: h}",
+    )
+    assert lint_source(attr, path="edgemesh/fleet/router.py") == []
+    # A **expansion is assumed to forward the incoming headers.
+    spread = _EM109_SRC.replace(
+        "headers={'X-Edgemesh-Deadline-S': '5'}",
+        "headers={'A': 'b', **incoming}",
+    )
+    assert lint_source(spread, path="edgemesh/fleet/router.py") == []
+
+
+def test_em109_follows_local_headers_variable_and_skips_opaque():
+    via_var = (
+        "def attempt(transport, url, payload):\n"
+        "    headers = {'X-Edgemesh-Deadline-S': '5'}\n"
+        "    return transport.post_json(url, payload, timeout_s=1.0,\n"
+        "                               headers=headers)\n"
+    )
+    findings = lint_source(via_var, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM109"}
+    fixed = via_var.replace("{'X-Edgemesh-Deadline-S': '5'}",
+                            "{TRACE_HEADER: h}")
+    assert lint_source(fixed, path="edgemesh/fleet/router.py") == []
+    # No headers kwarg (probes/admin) and opaque values are out of scope.
+    bare = (
+        "def probe(transport, url):\n"
+        "    return transport.get_json(url, timeout_s=1.0)\n"
+    )
+    assert lint_source(bare, path="edgemesh/fleet/health.py") == []
+    opaque = (
+        "def attempt(transport, url, payload, headers):\n"
+        "    return transport.post_json(url, payload, timeout_s=1.0,\n"
+        "                               headers=headers)\n"
+    )
+    assert lint_source(opaque, path="edgemesh/fleet/router.py") == []
+
+
+def test_em109_sees_bare_urlopen_and_honors_disable():
+    src = (
+        "import urllib.request\n"
+        "def dial(url):\n"
+        "    return urllib.request.urlopen(url, None, 2.0,\n"
+        "                                  headers={'A': 'b'})\n"
+    )
+    findings = lint_source(src, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM109"}
+    # The disable comment anchors to the call's first line (same contract
+    # as every other rule).
+    quiet = src.replace(
+        "    return urllib.request.urlopen(url, None, 2.0,",
+        "    return urllib.request.urlopen(url, None, 2.0,  # edgelint: disable=EM109",
+    )
+    assert lint_source(quiet, path="edgemesh/fleet/router.py") == []
+
+
+def test_em109_shipped_fleet_is_clean():
+    # The real router/transport/prober must carry the header everywhere
+    # they build one — the shipped tree is the rule's reference fixture.
+    from pathlib import Path
+
+    from edgemesh.analysis.edgelint import lint_paths
+
+    fleet = Path(__file__).resolve().parent.parent / "edgemesh" / "fleet"
+    assert [f for f in lint_paths([fleet]) if f.rule == "EM109"] == []
+
+
 def test_em108_fleet_transport_is_clean():
     # The shipped transport is the reference implementation of the rule:
     # every outbound call it makes must carry a timeout.

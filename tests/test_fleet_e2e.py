@@ -316,14 +316,33 @@ def test_fleet_cli_serve_and_status_json(tmp_path):
 
 
 def test_router_overhead_benchmark_smoke():
-    """The bench CI smoke: direct vs routed percentiles with the obs
-    summary attached (full-size runs ride the TPU driver, not CI)."""
+    """The bench CI smoke: direct vs routed vs traced percentiles with the
+    obs summary and a real assembled sample trace attached (full-size runs
+    ride the TPU driver, not CI)."""
     from edgemesh.benchmarks import router_overhead_benchmark
 
     r = router_overhead_benchmark(n_requests=5, max_new=4)
     assert r["metric"] == "router_overhead_p50_s"
     assert r["direct_p50_s"] > 0 and r["routed_p50_s"] > 0
+    assert r["traced_p50_s"] > 0
+    assert "tracing_overhead_p50_s" in r and "tracing_overhead_p99_s" in r
     assert r["n_requests"] == 5
-    # 5 routed requests + 1 warmup, all through one replica.
-    assert r["obs"]['edgemesh_fleet_routed_total{replica="r0"}'] == 6
-    assert r["obs"]["edgemesh_fleet_router_seconds"]["count"] == 6
+    # Two routed arms (tracing off + on), each 5 requests + 1 warmup,
+    # all through one replica.
+    assert r["obs"]['edgemesh_fleet_routed_total{replica="r0"}'] == 12
+    assert r["obs"]["edgemesh_fleet_router_seconds"]["count"] == 12
+    # The sample trace is a real cross-process assembly: router record +
+    # the replica's engine record under the winning attempt.
+    st = r["sample_trace"]
+    assert st is not None and st["processes"] >= 2, st
+    tree = st["tree"]
+    attempts = [c for c in tree["children"] if c["name"] == "attempt"]
+    assert attempts and attempts[-1]["outcome"] == "ok"
+    servers = [c for c in attempts[-1]["children"] if c["name"] == "server"]
+    assert servers, "replica spans did not attach under the attempt"
+    names = [s["name"] for s in servers[0]["children"]]
+    assert "queued" in names and "prefill" in names and "decode" in names
+    cp = st["critical_path"]
+    parts = (cp["retry_wasted_s"] + cp["wire_s"] + cp["queue_s"]
+             + cp["prefill_s"] + cp["decode_s"] + cp["other_s"])
+    assert cp["total_s"] == pytest.approx(parts, abs=1e-6)

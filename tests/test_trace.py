@@ -1,0 +1,586 @@
+"""Distributed tracing fast tier (edgemesh/obs/trace.py + wiring): header
+mint/parse round trips, skew-correction math, cross-process assembly from
+synthetic multi-process logs, the `edgemesh obs trace` CLI, the compile
+hook's counters, SpanTracker trace propagation + sampling, and the fleet
+router's trace records over a fake transport — no model, no device."""
+
+import json
+import random
+
+import pytest
+
+from edgemesh.obs import Registry, SpanTracker
+from edgemesh.obs.trace import (
+    ROUTER_RECORD_EVENT,
+    TRACE_HEADER,
+    CompileEventHook,
+    TraceContext,
+    assemble_trace,
+    clock_offset,
+    critical_path,
+    current_trace,
+    load_trace,
+    use_trace,
+)
+from edgemesh.utils.tracing import JsonlLogger
+
+# ---------------------------------------------------------------------------
+# Header mint / parse
+# ---------------------------------------------------------------------------
+
+
+def test_header_mint_parse_round_trip():
+    rng = random.Random(11)
+    ctx = TraceContext.mint(rng=rng)
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    header = ctx.to_header()
+    assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    assert TraceContext.parse(header) == ctx
+    off = TraceContext.mint(sampled=False, rng=rng)
+    assert off.to_header().endswith("-00")
+    assert TraceContext.parse(off.to_header()) == off
+
+
+def test_header_constant_is_shared_with_httputil():
+    from edgemesh.serve import httputil
+
+    assert httputil.TRACE_HEADER == TRACE_HEADER == "X-Edgemesh-Trace"
+
+
+def test_parse_rejects_malformed_headers_quietly():
+    good = TraceContext.mint(rng=random.Random(0))
+    for bad in (
+        None, "", "junk", "00-abc-def-01",
+        good.to_header() + "-extra",
+        "00-" + "g" * 32 + "-" + "1" * 16 + "-01",   # non-hex
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # all-zero trace id
+        "00-" + good.trace_id + "-" + "0" * 16 + "-01",  # all-zero span id
+    ):
+        assert TraceContext.parse(bad) is None, bad
+
+
+def test_child_keeps_trace_id_and_sampling_mints_new_span():
+    rng = random.Random(3)
+    root = TraceContext.mint(sampled=False, rng=rng)
+    child = root.child(rng=rng)
+    assert child.trace_id == root.trace_id
+    assert child.span_id != root.span_id
+    assert child.sampled is False
+
+
+def test_ambient_context_var():
+    assert current_trace() is None
+    ctx = TraceContext.mint(rng=random.Random(5))
+    with use_trace(ctx):
+        assert current_trace() is ctx
+        with use_trace(None):
+            assert current_trace() is None
+        assert current_trace() is ctx
+    assert current_trace() is None
+
+
+# ---------------------------------------------------------------------------
+# Skew correction + assembly (synthetic multi-process logs)
+# ---------------------------------------------------------------------------
+
+
+def test_clock_offset_anchors_on_request_response_edges():
+    # Router saw the attempt span [100.0, 101.0]; the replica's own clock
+    # claims it worked [1050.1, 1050.9] — 950s ahead with 0.1s of wire
+    # each way. The symmetric-network estimate recovers exactly -950.
+    attempt = {"t0": 100.0, "t1": 101.0}
+    assert clock_offset(attempt, 1050.1, 1050.9) == pytest.approx(-950.0)
+    # Unfinished attempt (abandoned hedge): only the request edge anchors.
+    assert clock_offset({"t0": 100.0, "t1": None}, 1050.1, 1050.9) == \
+        pytest.approx(-950.1)
+
+
+def _synthetic_records(tmp_path, skew_s=300.0):
+    """Router + one failed attempt + winning attempt served by a replica
+    whose clock runs ``skew_s`` ahead. Returns (trace_id, [log paths])."""
+    rng = random.Random(42)
+    root = TraceContext.mint(rng=rng)
+    failed, winner = root.child(rng=rng), root.child(rng=rng)
+    router_log, replica_log = tmp_path / "router.jsonl", tmp_path / "rep.jsonl"
+    JsonlLogger(router_log).log(
+        ROUTER_RECORD_EVENT,
+        trace_id=root.trace_id, span_id=root.span_id, process="router",
+        status=200, attempts=2, clock="wall", latency_s=1.0,
+        spans=[
+            {"name": "request", "span_id": root.span_id, "t0": 100.0, "t1": 101.0},
+            {"name": "attempt", "span_id": failed.span_id, "replica": "r0",
+             "hedge": False, "outcome": "connect", "status": None,
+             "t0": 100.0, "t1": 100.1},
+            {"name": "attempt", "span_id": winner.span_id, "replica": "r1",
+             "hedge": False, "outcome": "ok", "status": 200,
+             "t0": 100.2, "t1": 101.0},
+        ],
+    )
+    # Engine record convention: perf_counter spans + ts_submit wall anchor.
+    # Replica wall window: [100.3+skew, 100.9+skew] — inside the winning
+    # attempt [100.2, 101.0] once the skew is corrected away.
+    JsonlLogger(replica_log).log(
+        "request_spans",
+        rid=0, engine="continuous", status="ok",
+        trace_id=root.trace_id, span_id="ab" * 8,
+        parent_span_id=winner.span_id, ts_submit=100.3 + skew_s,
+        generated=6, segments=1, latency_s=0.6,
+        spans=[
+            {"name": "queued", "t0": 7.0, "t1": 7.05},
+            {"name": "prefill", "t0": 7.05, "t1": 7.25},
+            {"name": "decode", "t0": 7.25, "t1": 7.6, "tokens": 6},
+            {"name": "retire", "t0": 7.6, "t1": 7.6},
+        ],
+    )
+    return root.trace_id, [router_log, replica_log]
+
+
+def test_assembly_stitches_processes_and_corrects_skew(tmp_path):
+    trace_id, logs = _synthetic_records(tmp_path, skew_s=300.0)
+    doc = load_trace(trace_id, logs)
+    assert doc["processes"] == 2
+    tree = doc["tree"]
+    assert tree["name"] == "request" and tree["process"] == "router"
+    attempts = [c for c in tree["children"] if c["name"] == "attempt"]
+    assert len(attempts) == 2
+    # The failed attempt is a SIBLING of the winner, tagged with outcome.
+    assert attempts[0]["outcome"] == "connect" and attempts[0]["replica"] == "r0"
+    assert attempts[1]["outcome"] == "ok"
+    server = attempts[1]["children"][0]
+    assert server["name"] == "server"
+    # Skew correction: the replica window lands inside the attempt span on
+    # the router's clock, and the offset is the injected -300s (the wire
+    # asymmetry is 0.1s front / 0.1s back, so the estimate is exact).
+    assert server["skew_s"] == pytest.approx(-300.0, abs=1e-6)
+    assert server["t0"] >= attempts[1]["t0"] - 1e-6
+    assert server["t1"] <= attempts[1]["t1"] + 1e-6
+    names = [s["name"] for s in server["children"]]
+    assert names == ["queued", "prefill", "decode", "retire"]
+    # Every corrected child edge is monotonic and inside the server window.
+    for s in server["children"]:
+        assert s["t1"] >= s["t0"] >= server["t0"] - 1e-6
+
+
+def test_critical_path_sums_to_total_and_splits_stages(tmp_path):
+    trace_id, logs = _synthetic_records(tmp_path)
+    cp = load_trace(trace_id, logs)["critical_path"]
+    assert cp["total_s"] == pytest.approx(1.0, abs=1e-6)
+    assert cp["retry_wasted_s"] == pytest.approx(0.2, abs=1e-6)
+    # wire = attempt (0.8) - server window (0.6)
+    assert cp["wire_s"] == pytest.approx(0.2, abs=1e-6)
+    assert cp["queue_s"] == pytest.approx(0.05, abs=1e-6)
+    assert cp["prefill_s"] == pytest.approx(0.2, abs=1e-6)
+    assert cp["decode_s"] == pytest.approx(0.35, abs=1e-6)
+    parts = (cp["retry_wasted_s"] + cp["wire_s"] + cp["queue_s"]
+             + cp["prefill_s"] + cp["decode_s"] + cp["other_s"])
+    assert parts == pytest.approx(cp["total_s"], abs=1e-6)
+
+
+def test_critical_path_prefers_won_attempt_over_late_ok_hedge_loser():
+    # The primary answered the client at t=100.5 (won); the abandoned hedge
+    # loser ALSO finished "ok" later. The split must describe the winner.
+    tree = {
+        "name": "request", "t0": 100.0, "t1": 100.6,
+        "children": [
+            {"name": "attempt", "outcome": "ok", "won": True,
+             "t0": 100.0, "t1": 100.5, "children": []},
+            {"name": "attempt", "outcome": "ok", "won": False, "hedge": True,
+             "t0": 100.3, "t1": 101.4, "children": []},
+        ],
+    }
+    cp = critical_path(tree)
+    assert cp["retry_wasted_s"] == pytest.approx(0.0, abs=1e-6)
+    assert cp["wire_s"] == pytest.approx(0.5, abs=1e-6)
+    # Pre-marker records (no "won" key anywhere) fall back to last-ok.
+    for att in tree["children"]:
+        del att["won"]
+    assert critical_path(tree)["retry_wasted_s"] == pytest.approx(0.3, abs=1e-6)
+
+
+def test_assembly_without_router_record_synthesizes_root(tmp_path):
+    trace_id, logs = _synthetic_records(tmp_path)
+    doc = load_trace(trace_id, logs[1:])  # replica log only
+    assert doc["processes"] == 1
+    assert doc["tree"]["synthetic"] is True
+    servers = [c for c in doc["tree"]["children"] if c["name"] == "server"]
+    assert len(servers) == 1
+    # Critical path still splits replica-side stages.
+    cp = doc["critical_path"]
+    assert cp["decode_s"] == pytest.approx(0.35, abs=1e-6)
+
+
+def test_assemble_trace_ignores_other_trace_ids():
+    doc = assemble_trace("feed" * 8, [{"event": "request_spans",
+                                       "trace_id": "beef" * 8}])
+    assert doc["processes"] == 0 and doc["tree"] is None
+    assert critical_path(doc["tree"])["total_s"] is None
+
+
+# ---------------------------------------------------------------------------
+# `edgemesh obs trace` CLI
+# ---------------------------------------------------------------------------
+
+
+def test_obs_trace_cli_assembles_and_accepts_prefix(tmp_path, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    trace_id, logs = _synthetic_records(tmp_path)
+    argv = ["trace", trace_id, "--logs"] + [str(p) for p in logs]
+    assert obs_main(argv) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["trace_id"] == trace_id and doc["processes"] == 2
+    assert doc["critical_path"]["total_s"] == pytest.approx(1.0, abs=1e-6)
+    # Unique prefix works too.
+    assert obs_main(["trace", trace_id[:8], "--logs",
+                     str(logs[0]), str(logs[1])]) == 0
+    assert json.loads(capsys.readouterr().out)["trace_id"] == trace_id
+
+
+def test_obs_trace_cli_unknown_id_and_missing_log(tmp_path, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    _, logs = _synthetic_records(tmp_path)
+    assert obs_main(["trace", "dead" * 8, "--logs", str(logs[0])]) == 1
+    assert "no records" in capsys.readouterr().err
+    assert obs_main(["trace", "dead" * 8, "--logs",
+                     str(tmp_path / "nope.jsonl")]) == 2
+    assert "no such span log" in capsys.readouterr().err
+
+
+def test_obs_summary_and_tail_on_empty_and_malformed_logs(tmp_path, capsys):
+    """Satellite: an empty or all-malformed span log is an answer, not a
+    crash — summary prints an explicit "requests": 0 report, exit 0."""
+    from edgemesh.obs.cli import main as obs_main
+
+    empty = tmp_path / "empty.jsonl"
+    empty.touch()
+    assert obs_main(["summary", str(empty)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["requests"] == 0 and report["latency_s_p50"] is None
+    assert obs_main(["tail", str(empty)]) == 0
+
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text('not json at all\n{"event": "request_spans", "rid"\n')
+    assert obs_main(["summary", str(torn)]) == 0
+    out = capsys.readouterr()
+    assert json.loads(out.out)["requests"] == 0
+    assert "malformed" in out.err
+    assert obs_main(["tail", str(torn)]) == 0
+    assert obs_main(["prom", str(torn)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# SpanTracker trace propagation + sampling
+# ---------------------------------------------------------------------------
+
+
+def _drive(tracker, rid, ctx=None):
+    tr = tracker.submit(rid, ctx)
+    tracker.admit_start(tr)
+    tracker.admitted(tr, prompt_tokens=3)
+    tracker.tokens(tr, 2)
+    tracker.retire(tr)
+    return tr
+
+
+def test_span_tracker_joins_propagated_trace(tmp_path):
+    tracker = SpanTracker(Registry(), tmp_path / "s.jsonl", engine="unit")
+    ctx = TraceContext.mint(rng=random.Random(1))
+    tr = _drive(tracker, 0, ctx)
+    assert tr.trace_id == ctx.trace_id
+    assert tr.parent_span_id == ctx.span_id
+    assert tr.span_id not in (None, ctx.span_id)
+    [rec] = JsonlLogger(tmp_path / "s.jsonl").read()
+    assert rec["trace_id"] == ctx.trace_id
+    assert rec["parent_span_id"] == ctx.span_id
+    assert rec["span_id"] == tr.span_id
+    # Wall anchor for assembly: ts_submit + spans[0].t0 is the submit edge.
+    assert rec["ts_submit"] == pytest.approx(tr.ts_unix)
+    assert rec["spans"][0]["t0"] == pytest.approx(tr.t_submit)
+
+
+def test_span_tracker_mints_local_trace_when_none_propagated(tmp_path):
+    tracker = SpanTracker(Registry(), tmp_path / "s.jsonl", engine="unit")
+    tr = _drive(tracker, 0)
+    assert tr.trace_id and tr.span_id and tr.parent_span_id is None
+    [rec] = JsonlLogger(tmp_path / "s.jsonl").read()
+    assert rec["trace_id"] == tr.trace_id and rec["parent_span_id"] is None
+
+
+def test_sampled_out_requests_skip_span_io_but_count_in_metrics(tmp_path):
+    reg = Registry()
+    tracker = SpanTracker(reg, tmp_path / "s.jsonl", engine="unit")
+    # Propagated sampled=False wins over the tracker's own rate.
+    off = TraceContext.mint(sampled=False, rng=random.Random(2))
+    _drive(tracker, 0, off)
+    assert JsonlLogger(tmp_path / "s.jsonl").read() == []
+    # Local sampling: rate 0 → no records, full metrics.
+    t2 = SpanTracker(reg, tmp_path / "s2.jsonl", engine="unit2",
+                     trace_sample=0.0)
+    for rid in range(5):
+        _drive(t2, rid)
+    assert JsonlLogger(tmp_path / "s2.jsonl").read() == []
+    s = reg.summary()
+    assert s['edgemesh_requests_submitted_total{engine="unit"}'] == 1
+    assert s['edgemesh_requests_submitted_total{engine="unit2"}'] == 5
+    assert s['edgemesh_requests_completed_total{engine="unit2",status="ok"}'] == 5
+    assert s['edgemesh_ttft_seconds{engine="unit2"}']["count"] == 5
+
+
+# ---------------------------------------------------------------------------
+# Compile hook
+# ---------------------------------------------------------------------------
+
+
+def test_compile_hook_counts_compiles_and_recompiles(tmp_path):
+    reg = Registry()
+    hook = CompileEventHook(registry=reg, span_log=tmp_path / "c.jsonl")
+    hook.on_event("/jax/core/compile/jaxpr_trace_duration", 0.01)
+    hook.on_event("/jax/core/compile/backend_compile_duration", 0.5)
+    hook.on_event("/jax/core/compile/backend_compile_duration", 0.25)
+    hook.on_event("/jax/core/unrelated_event", 9.0)  # not a compile: ignored
+    s = reg.summary()
+    assert s['edgemesh_jax_compiles_total{event="backend_compile_duration"}'] == 2
+    assert s['edgemesh_jax_compiles_total{event="jaxpr_trace_duration"}'] == 1
+    # Recompiles: backend compiles beyond the first in this process.
+    assert s["edgemesh_jax_recompiles_total"] == 1
+    assert s['edgemesh_jax_compile_seconds{event="backend_compile_duration"}'][
+        "sum"] == pytest.approx(0.75)
+    recs = JsonlLogger(tmp_path / "c.jsonl").read()
+    assert [r["event"] for r in recs] == ["compile", "compile"]
+    assert recs[0]["trace_id"] is None  # no ambient trace
+
+
+def test_compile_hook_stamps_ambient_trace_and_joins_assembly(tmp_path):
+    reg = Registry()
+    hook = CompileEventHook(registry=reg, span_log=tmp_path / "c.jsonl")
+    ctx = TraceContext.mint(rng=random.Random(9))
+    with use_trace(ctx):
+        hook.on_event("/jax/core/compile/backend_compile_duration", 0.125)
+    [rec] = JsonlLogger(tmp_path / "c.jsonl").read()
+    assert rec["trace_id"] == ctx.trace_id
+    assert rec["parent_span_id"] == ctx.span_id
+    # A compile record alone doesn't make a trace, but it attaches to one.
+    router_rec = {
+        "event": ROUTER_RECORD_EVENT, "trace_id": ctx.trace_id,
+        "span_id": ctx.span_id, "clock": "wall", "status": 200,
+        "attempts": 1,
+        "spans": [{"name": "request", "span_id": ctx.span_id,
+                   "t0": 1.0, "t1": 2.0}],
+    }
+    doc = assemble_trace(ctx.trace_id, [router_rec, rec])
+    compiles = [c for c in doc["tree"]["children"] if c["name"] == "compile"]
+    assert len(compiles) == 1
+    assert compiles[0]["duration_s"] == pytest.approx(0.125)
+
+
+def test_install_uninstall_compile_hook_dispatcher():
+    from edgemesh.obs.trace import install_compile_hook, uninstall_compile_hook
+    from edgemesh.obs.trace import _dispatch  # the process-wide fan-out
+
+    reg = Registry()
+    hook = install_compile_hook(registry=reg)
+    try:
+        _dispatch("/jax/core/compile/backend_compile_duration", 0.1)
+        assert reg.summary()[
+            'edgemesh_jax_compiles_total{event="backend_compile_duration"}'] == 1
+    finally:
+        uninstall_compile_hook(hook)
+    _dispatch("/jax/core/compile/backend_compile_duration", 0.1)
+    assert reg.summary()[
+        'edgemesh_jax_compiles_total{event="backend_compile_duration"}'] == 1
+
+
+# ---------------------------------------------------------------------------
+# Router trace records over a fake transport
+# ---------------------------------------------------------------------------
+
+
+class FakeTransport:
+    def __init__(self):
+        self.calls = []
+        self._routes = []
+
+    def on(self, substr, handler):
+        self._routes.append((substr, handler))
+        return self
+
+    def post_json(self, url, payload, timeout_s, headers=None):
+        self.calls.append((url, dict(headers or {})))
+        for substr, handler in self._routes:
+            if substr in url:
+                return handler(url, payload, headers or {})
+        return 200, {"answer": "ok"}
+
+    def get_json(self, url, timeout_s, headers=None):
+        return 200, {}
+
+
+def _router(tmp_path, transport, rids=("r0", "r1"), **kw):
+    from edgemesh.fleet import FleetRouter, ReplicaRegistry
+
+    reg = ReplicaRegistry()
+    for rid in rids:
+        reg.register(rid, f"http://{rid}")
+    kw.setdefault("obs_registry", Registry())
+    kw.setdefault("rng", random.Random(0))
+    kw.setdefault("span_log", tmp_path / "router.jsonl")
+    router = FleetRouter(reg, transport=transport, **kw)
+    router._sleep = lambda s: None
+    return router
+
+
+def test_router_mints_context_propagates_header_and_logs_record(tmp_path):
+    transport = FakeTransport()
+    router = _router(tmp_path, transport)
+    status, body, headers = router.handle_generate({"question": "q?"})
+    assert status == 200
+    ctx = TraceContext.parse(headers[TRACE_HEADER])
+    assert ctx is not None and ctx.sampled
+    # The replica saw a CHILD span of the same trace.
+    _, sent_headers = transport.calls[0]
+    sent = TraceContext.parse(sent_headers[TRACE_HEADER])
+    assert sent.trace_id == ctx.trace_id and sent.span_id != ctx.span_id
+    [rec] = JsonlLogger(tmp_path / "router.jsonl").read()
+    assert rec["event"] == ROUTER_RECORD_EVENT
+    assert rec["trace_id"] == ctx.trace_id and rec["attempts"] == 1
+    root, attempt = rec["spans"]
+    assert root["name"] == "request" and attempt["name"] == "attempt"
+    assert attempt["outcome"] == "ok" and attempt["status"] == 200
+    assert attempt["won"] is True
+    assert attempt["span_id"] == sent.span_id
+    assert root["t0"] <= attempt["t0"] <= attempt["t1"] <= root["t1"]
+    # /fleetz summaries + /debug/traces assembly from the in-memory ring.
+    recent = router.recent_traces()
+    assert recent[0]["trace_id"] == ctx.trace_id
+    assert recent[0]["replicas"] in (["r0"], ["r1"])
+    doc = router.get_trace(ctx.trace_id[:12])
+    assert doc is not None and doc["critical_path"]["total_s"] is not None
+    assert router.get_trace("ffff") is None
+
+
+def test_router_retry_emits_sibling_attempt_spans(tmp_path):
+    from edgemesh.fleet import TransportError
+
+    transport = FakeTransport()
+
+    def refuse(url, payload, headers):
+        raise TransportError(f"{url}: refused")
+
+    transport.on("r0", refuse)
+    router = _router(tmp_path, transport)
+    status, _, headers = router.handle_generate({"question": "q?"})
+    assert status == 200
+    [rec] = JsonlLogger(tmp_path / "router.jsonl").read()
+    attempts = [s for s in rec["spans"] if s["name"] == "attempt"]
+    # One request may take 1 attempt (picked r1 first) — force determinism:
+    # with round-robin starting at r0 the first attempt fails. Either way
+    # every failed attempt must appear as a closed sibling span.
+    failed = [a for a in attempts if a["outcome"] == "connect"]
+    ok = [a for a in attempts if a["outcome"] == "ok"]
+    assert len(ok) == 1
+    if failed:
+        assert failed[0]["replica"] == "r0"
+        assert failed[0]["t1"] is not None
+        assert failed[0]["span_id"] != ok[0]["span_id"]
+        assert rec["attempts"] == len(attempts)
+
+
+def test_router_joins_client_supplied_trace(tmp_path):
+    transport = FakeTransport()
+    router = _router(tmp_path, transport)
+    client_ctx = TraceContext.mint(rng=random.Random(4))
+    status, _, headers = router.handle_generate(
+        {"question": "q?"}, trace=client_ctx
+    )
+    assert status == 200
+    assert TraceContext.parse(headers[TRACE_HEADER]) == client_ctx
+    [rec] = JsonlLogger(tmp_path / "router.jsonl").read()
+    assert rec["trace_id"] == client_ctx.trace_id
+
+
+def test_router_get_trace_serves_newest_for_repeated_client_trace_id(tmp_path):
+    # A client fanning out two requests under ONE supplied traceparent must
+    # still be able to fetch /debug/traces/<that exact id>.
+    transport = FakeTransport()
+    router = _router(tmp_path, transport)
+    client_ctx = TraceContext.mint(rng=random.Random(6))
+    for _ in range(2):
+        status, _, _ = router.handle_generate({"question": "q?"},
+                                              trace=client_ctx)
+        assert status == 200
+    assert len(router.recent_traces()) == 2
+    doc = router.get_trace(client_ctx.trace_id)
+    assert doc is not None and doc["processes"] == 1
+    # An ambiguous PREFIX (matching two distinct ids) still refuses.
+    r2 = _router(tmp_path / "b", FakeTransport())
+    a = TraceContext("aa" + "0" * 29 + "1", "1" * 16)
+    b = TraceContext("aa" + "0" * 29 + "2", "2" * 16)
+    r2.handle_generate({"question": "q?"}, trace=a)
+    r2.handle_generate({"question": "q?"}, trace=b)
+    assert r2.get_trace("aa") is None
+    assert r2.get_trace(a.trace_id)["trace_id"] == a.trace_id
+
+
+def test_router_trace_sampling_gates_io_not_metrics(tmp_path):
+    transport = FakeTransport()
+    obs = Registry()
+    router = _router(tmp_path, transport, obs_registry=obs, trace_sample=0.0)
+    for _ in range(4):
+        status, _, headers = router.handle_generate({"question": "q?"})
+        assert status == 200
+        ctx = TraceContext.parse(headers[TRACE_HEADER])
+        assert ctx is not None and ctx.sampled is False
+    assert JsonlLogger(tmp_path / "router.jsonl").read() == []
+    assert router.recent_traces() == []
+    routed = sum(v for k, v in obs.summary().items()
+                 if k.startswith("edgemesh_fleet_routed_total"))
+    assert routed == 4
+    # The replicas saw sampled=False and will skip THEIR span I/O too.
+    for _, sent_headers in transport.calls:
+        assert TraceContext.parse(sent_headers[TRACE_HEADER]).sampled is False
+
+
+def test_debug_profile_endpoint_is_opt_in(tmp_path):
+    """403 without profile_dir; with it, validation answers before any
+    profiler work (the actual capture is exercised by the slow tier /
+    manual ops — a capture burns real seconds)."""
+    import urllib.error
+    import urllib.request
+
+    from edgemesh.serve.rest import serve_rest
+
+    class FakeEnsemble:
+        qa_agents = []
+        refiner = None
+
+    srv = serve_rest(FakeEnsemble(), host="127.0.0.1", port=0, block=False)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.server_address[1]}/debug/profile",
+                timeout=10)
+        assert e.value.code == 403
+    finally:
+        srv.shutdown()
+    srv = serve_rest(FakeEnsemble(), host="127.0.0.1", port=0, block=False,
+                     profile_dir=tmp_path)
+    try:
+        for q in ("seconds=999", "seconds=abc", "seconds=0"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.server_address[1]}"
+                    f"/debug/profile?{q}", timeout=10)
+            assert e.value.code == 400, q
+    finally:
+        srv.shutdown()
+
+
+def test_router_shed_paths_still_answer_with_trace_header(tmp_path):
+    transport = FakeTransport()
+    router = _router(tmp_path, transport, rids=())
+    status, body, headers = router.handle_generate({"question": "q?"})
+    assert status == 503 and "no available replica" in body["error"]
+    assert TraceContext.parse(headers[TRACE_HEADER]) is not None
+    [rec] = JsonlLogger(tmp_path / "router.jsonl").read()
+    assert rec["status"] == 503 and rec["attempts"] == 0

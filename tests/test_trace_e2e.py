@@ -1,0 +1,257 @@
+"""Distributed tracing end-to-end (slow tier): one trace id spans the REAL
+router process and two real replica subprocesses. A hedged request leaves
+spans in three span logs (router + both replicas); a retried request shows
+the failed attempt as a sibling span; `edgemesh obs trace` assembles the
+whole thing into one tree whose critical-path durations sum to within 5%
+of the client-observed latency. Same multi-minute territory as the fleet
+e2e: each replica is a full `edgemesh serve --continuous` process."""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPLICA_YAML = """
+agents:
+  - role: qa
+    model: {family: llama, num_layers: 1, hidden_size: 32, num_heads: 4,
+            num_kv_heads: 4, intermediate_size: 64}
+    sampling: {max_new_tokens: 32, do_sample: false, repetition_penalty: 1.0}
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_replica(cfg_path: Path, port: int, span_log: Path) -> subprocess.Popen:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-m", "edgemesh.cli", "serve",
+         "--config", str(cfg_path), "--port", str(port),
+         "--continuous", "--batch", "2", "--span-log", str(span_log)],
+        env=env, cwd=Path(__file__).resolve().parent.parent,
+    )
+
+
+def _wait_ready(transport, ports, timeout_s=300.0):
+    from edgemesh.fleet.transport import TransportError
+
+    deadline = time.monotonic() + timeout_s
+    pending = set(ports)
+    while pending and time.monotonic() < deadline:
+        for port in list(pending):
+            try:
+                status, _ = transport.get_json(
+                    f"http://127.0.0.1:{port}/readyz", timeout_s=2.0
+                )
+            except TransportError:
+                continue
+            if status == 200:
+                pending.discard(port)
+        time.sleep(0.25)
+    assert not pending, f"replicas on ports {sorted(pending)} never became ready"
+
+
+def _post(url: str, payload: dict, timeout_s: float = 300.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return r.status, json.load(r), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e), dict(e.headers)
+
+
+def _wait_for_trace_in(log: Path, trace_id: str, timeout_s: float = 120.0):
+    from edgemesh.utils.tracing import JsonlLogger
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if any(r.get("trace_id") == trace_id for r in JsonlLogger(log).read()):
+            return
+        time.sleep(0.5)
+    raise AssertionError(f"trace {trace_id} never appeared in {log}")
+
+
+def test_one_trace_spans_router_and_two_replicas_with_critical_path(tmp_path):
+    from edgemesh.fleet import FleetRouter, HttpTransport, ReplicaRegistry, \
+        serve_fleet
+    from edgemesh.obs import Registry, load_trace
+    from edgemesh.obs.trace import TRACE_HEADER, TraceContext
+    from edgemesh.utils.tracing import JsonlLogger
+
+    cfg = tmp_path / "replica.yaml"
+    cfg.write_text(REPLICA_YAML)
+    ports = [_free_port() for _ in range(2)]
+    rep_logs = [tmp_path / f"replica-{i}.jsonl" for i in range(2)]
+    router_log = tmp_path / "router.jsonl"
+    procs = [_spawn_replica(cfg, p, lg) for p, lg in zip(ports, rep_logs)]
+    transport = HttpTransport()
+    front = None
+    stopped_pid = None
+    try:
+        _wait_ready(transport, ports)
+        # Warm each replica's decode compile directly — and pin the compile
+        # hook e2e: the engine's span log must carry compile records.
+        for p in ports:
+            status, _, _ = _post(f"http://127.0.0.1:{p}/generate",
+                                 {"question": "warmup?"})
+            assert status == 200
+
+        obs = Registry()
+        registry = ReplicaRegistry(
+            (f"replica-{i}", f"http://127.0.0.1:{p}")
+            for i, p in enumerate(ports)
+        )
+        # round_robin: candidate order is registration order, so the FIRST
+        # routed request deterministically dials replica-0.
+        router = FleetRouter(
+            registry, balancer="round_robin", transport=transport,
+            obs_registry=obs, max_attempts=3, attempt_timeout_s=30.0,
+            default_deadline_s=240.0, backoff_base_s=0.4, demote_after=1,
+            span_log=router_log,
+        )
+        front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+        url = f"http://127.0.0.1:{front.server_address[1]}"
+
+        # ---- Phase A: a hedged request touches BOTH replicas under one
+        # trace id. SIGSTOP replica-0 (round_robin picks it first): the
+        # primary attempt stalls, the hedge fires at replica-1 and wins,
+        # then SIGCONT lets replica-0 finish the abandoned attempt and
+        # flush ITS span record too — three processes, one trace.
+        router.hedge_after_s = 0.3
+        procs[0].send_signal(signal.SIGSTOP)
+        stopped_pid = procs[0].pid
+        status, body, headers = _post(f"{url}/generate", {"question": "hedge?"})
+        assert status == 200 and "answer" in body
+        hedge_ctx = TraceContext.parse(headers[TRACE_HEADER])
+        assert hedge_ctx is not None and hedge_ctx.sampled
+        procs[0].send_signal(signal.SIGCONT)
+        stopped_pid = None
+        router.hedge_after_s = 0.0
+        for log in (router_log, *rep_logs):
+            _wait_for_trace_in(log, hedge_ctx.trace_id)
+        doc = load_trace(hedge_ctx.trace_id,
+                         [router_log, *map(str, rep_logs)])
+        assert doc["processes"] == 3, doc["processes"]
+        attempts = [c for c in doc["tree"]["children"]
+                    if c["name"] == "attempt"]
+        assert len(attempts) == 2
+        hedges = [a for a in attempts if a.get("hedge")]
+        assert len(hedges) == 1 and hedges[0]["outcome"] == "ok"
+        # Both replicas' engine spans attached somewhere in the tree.
+        engines = [a["replica"] for a in attempts]
+        assert set(engines) == {"replica-0", "replica-1"}
+        servers = [n for a in attempts for n in a["children"]
+                   if n["name"] == "server"]
+        assert len(servers) == 2, "both replicas' spans must stitch in"
+
+        # ---- Phase B: a retried request shows the failed attempt as a
+        # sibling span, and the assembled critical path matches the
+        # client-observed latency. Drain replica-0 directly (the router
+        # keeps routing to it): its 503 is a real replica-side refusal,
+        # the retry lands on replica-1.
+        status, _, _ = _post(f"http://127.0.0.1:{ports[0]}/drain", {})
+        assert status == 200
+        retry_ctx, client_s = None, None
+        for i in range(4):  # round_robin: replica-0 comes up within 2 tries
+            # Pre-opened connection: the 5% bar prices the REQUEST (what
+            # the trace can see), not TCP connect + the server's
+            # per-connection thread spawn, which happen before it is sent.
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", front.server_address[1], timeout=300
+            )
+            conn.connect()
+            payload = json.dumps({"question": f"retry {i}?"}).encode()
+            t0 = time.monotonic()
+            conn.request("POST", "/generate", payload,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.load(resp)
+            elapsed = time.monotonic() - t0
+            status, headers = resp.status, dict(resp.headers)
+            conn.close()
+            assert status == 200 and "answer" in body
+            if int(headers.get("X-Edgemesh-Attempts", "1")) >= 2:
+                retry_ctx = TraceContext.parse(headers[TRACE_HEADER])
+                client_s = elapsed
+                break
+        assert retry_ctx is not None, "no request was retried"
+        _wait_for_trace_in(router_log, retry_ctx.trace_id)
+        _wait_for_trace_in(rep_logs[1], retry_ctx.trace_id)
+        doc = load_trace(retry_ctx.trace_id,
+                         [router_log, *map(str, rep_logs)])
+        assert doc["processes"] >= 2
+        attempts = [c for c in doc["tree"]["children"]
+                    if c["name"] == "attempt"]
+        failed = [a for a in attempts if a["outcome"] == "status_503"]
+        winners = [a for a in attempts if a["outcome"] == "ok"]
+        assert len(failed) == 1 and failed[0]["replica"] == "replica-0"
+        assert len(winners) == 1 and winners[0]["replica"] == "replica-1"
+        assert failed[0]["span_id"] != winners[0]["span_id"]
+        servers = [n for n in winners[0]["children"] if n["name"] == "server"]
+        assert servers and servers[0]["process"] == "continuous"
+        names = [s["name"] for s in servers[0]["children"]]
+        assert "queued" in names and "prefill" in names and "decode" in names
+        cp = doc["critical_path"]
+        parts = (cp["retry_wasted_s"] + cp["wire_s"] + cp["queue_s"]
+                 + cp["prefill_s"] + cp["decode_s"] + cp["other_s"])
+        assert parts == pytest.approx(cp["total_s"], abs=1e-6)
+        # The acceptance bar: the assembled trace accounts for what the
+        # client actually waited (frontend + loopback wire is the slack).
+        assert cp["total_s"] == pytest.approx(client_s, rel=0.05), \
+            (cp, client_s)
+        assert cp["retry_wasted_s"] > 0  # the failed attempt + backoff
+        assert cp["decode_s"] > 0
+
+        # ---- Phase C: operator surfaces. /fleetz lists both traces,
+        # /debug/traces/<id> serves the router-side assembly, and the
+        # replica span logs carry compile records from the warmup (the
+        # compile hook rode the engine's span log).
+        with urllib.request.urlopen(f"{url}/fleetz", timeout=30) as r:
+            fleetz = json.load(r)
+        recent_ids = {t["trace_id"] for t in fleetz["recent_traces"]}
+        assert {hedge_ctx.trace_id, retry_ctx.trace_id} <= recent_ids
+        with urllib.request.urlopen(
+            f"{url}/debug/traces/{retry_ctx.trace_id}", timeout=30
+        ) as r:
+            served = json.load(r)
+        assert served["trace_id"] == retry_ctx.trace_id
+        assert served["tree"]["name"] == "request"
+        assert any(
+            rec.get("event") == "compile"
+            for lg in rep_logs for rec in JsonlLogger(lg).read()
+        ), "engine span logs should carry compile records"
+    finally:
+        if front is not None:
+            front.shutdown()
+        if stopped_pid is not None:
+            try:
+                os.kill(stopped_pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
