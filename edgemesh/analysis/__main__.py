@@ -12,8 +12,13 @@ import json
 import sys
 from pathlib import Path
 
-from edgemesh.analysis.edgelint import lint_paths
-from edgemesh.analysis.findings import Baseline, Finding, default_baseline_path
+from edgemesh.analysis.edgelint import iter_python_files, lint_paths
+from edgemesh.analysis.findings import (
+    Baseline,
+    Finding,
+    default_baseline_path,
+    repo_relative,
+)
 
 
 def _default_target() -> list[str]:
@@ -31,8 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
         help="files/directories to lint (default: the edgemesh package)",
     )
     p.add_argument(
-        "--format", choices=["pretty", "json"], default="pretty",
-        help="pretty = one line per finding; json = machine-readable report",
+        "--format", choices=["pretty", "json", "github"], default="pretty",
+        help="pretty = one line per finding; json = machine-readable report; "
+        "github = GitHub Actions ::error/::warning annotations",
     )
     p.add_argument(
         "--no-contracts", action="store_true",
@@ -56,11 +62,58 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-baseline", action="store_true",
         help="ignore the baseline: report every finding (audit mode)",
     )
+    p.add_argument(
+        "--prune-baseline", action="store_true",
+        help="drop stale baseline entries (file or finding no longer exists) "
+        "and rewrite the baseline file",
+    )
     return p
+
+
+def _stale_entries(baseline: Baseline, findings: list[Finding],
+                   paths: list[str],
+                   skipped_rule_prefixes: tuple[str, ...] = ()) -> list[dict]:
+    """Baseline entries that no longer match anything.
+
+    An entry is stale when (a) its file no longer exists at all, or (b) its
+    file WAS linted in this run and no current finding carries its
+    fingerprint. Entries for files outside the linted path set (and still
+    on disk) are left alone — a single-file lint must not condemn the rest
+    of the baseline — and so are entries from a pass that did not run this
+    invocation (``--no-contracts`` skips EM2xx, so an absent EM2xx
+    fingerprint proves nothing). Staleness matters beyond hygiene: a dead
+    entry would silently mask a FUTURE finding that lands on the same
+    fingerprint (same rule, scope, and line text — e.g. the regressed code
+    pasted back in).
+    """
+    current = {f.fingerprint() for f in findings}
+    linted = {repo_relative(p) for p in iter_python_files(paths)}
+    repo_root = Path(__file__).resolve().parent.parent.parent
+    stale = []
+    for entry in baseline.entries:
+        path = entry.get("path", "")
+        exists = (repo_root / path).exists() or Path(path).exists()
+        if not exists:
+            stale.append({**entry, "reason": "file no longer exists"})
+            continue
+        rule = entry.get("rule", "")
+        if any(rule.startswith(p) for p in skipped_rule_prefixes):
+            continue  # that pass didn't run; its findings can't be judged
+        if path in linted and entry["fingerprint"] not in current:
+            stale.append({**entry, "reason": "finding no longer present"})
+    return stale
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.prune_baseline and args.no_baseline:
+        # --no-baseline empties the in-memory baseline; pruning "against" it
+        # would rewrite the file to nothing and destroy every entry.
+        print(
+            "error: --prune-baseline operates on the baseline; drop "
+            "--no-baseline", file=sys.stderr,
+        )
+        return 2
     paths = args.paths or _default_target()
     missing = [p for p in paths if not Path(p).exists()]
     if missing:
@@ -76,9 +129,13 @@ def main(argv: list[str] | None = None) -> int:
         from edgemesh.analysis.contracts import run_contracts
 
         findings.extend(run_contracts())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # Staleness is judged against EVERY finding (before the severity filter
+    # drops warnings): a baselined warning is not stale just because the
+    # operator asked to see errors only.
+    all_findings = list(findings)
     if args.severity == "error":
         findings = [f for f in findings if f.severity == "error"]
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
 
     baseline_path = Path(args.baseline) if args.baseline else default_baseline_path()
     if args.write_baseline:
@@ -87,6 +144,27 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     baseline = Baseline() if args.no_baseline else Baseline.load(baseline_path)
+    stale = [] if args.no_baseline else _stale_entries(
+        baseline, all_findings, paths,
+        skipped_rule_prefixes=("EM2",) if args.no_contracts else (),
+    )
+    if args.prune_baseline:
+        stale_fps = {e["fingerprint"] for e in stale}
+        keep = [e for e in baseline.entries if e["fingerprint"] not in stale_fps]
+        Baseline({e["fingerprint"] for e in keep}, keep).save(baseline_path)
+        print(
+            f"pruned {len(stale)} stale entr{'y' if len(stale) == 1 else 'ies'} "
+            f"from {baseline_path} ({len(keep)} kept)"
+        )
+        return 0
+    for entry in stale:
+        print(
+            f"warning: stale baseline entry {entry['fingerprint']} "
+            f"({entry.get('rule')} {entry.get('path')}): {entry['reason']} — "
+            "it would mask a future finding at this fingerprint; run "
+            "--prune-baseline",
+            file=sys.stderr,
+        )
     fresh = baseline.filter(findings)
     suppressed = len(findings) - len(fresh)
 
@@ -94,8 +172,20 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps({
             "findings": [f.to_dict() for f in fresh],
             "baselined": suppressed,
+            "stale_baseline": stale,
             "checked_paths": [str(p) for p in paths],
         }, indent=2))
+    elif args.format == "github":
+        # GitHub Actions workflow-command annotations: findings land
+        # inline on the PR diff. Newlines must be %0A-escaped per the
+        # workflow-command spec.
+        for f in fresh:
+            kind = "error" if f.severity == "error" else "warning"
+            title = f"{f.rule} {f.severity}"
+            msg = f.message.replace("%", "%25").replace("\n", "%0A")
+            print(
+                f"::{kind} file={f.path},line={f.line},title={title}::{msg}"
+            )
     else:
         for f in fresh:
             print(f.render())

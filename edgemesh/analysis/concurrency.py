@@ -1,0 +1,826 @@
+"""Whole-class concurrency analysis for the threaded serving stack (EM3xx).
+
+The EM1xx rules are per-function pattern checks; they cannot see a data
+race, because a race is a property of a CLASS — which fields its methods
+share, which lock each field belongs to, and what runs while that lock is
+held. This pass does class-level abstract interpretation over the AST:
+
+- **Lock discovery.** Every ``self._x = threading.Lock()`` / ``RLock()`` /
+  ``Condition()`` assignment (including the dataclass
+  ``field(default_factory=threading.Lock)`` spelling) makes ``_x`` a lock
+  field of the class. Semaphores are deliberately NOT locks: a semaphore is
+  an admission token (the router's in-flight slot pool), and holding one
+  while sleeping or dialing out is the design, not a bug. Class bodies are
+  merged down single-module inheritance chains, so a subclass's methods are
+  judged against the base's locks and guard map (the speculative engine
+  rides the base engine's ``_cond``).
+
+- **EM301 unguarded-shared-state (error).** The guarded-field set is
+  INFERRED: any ``self._x`` read or written inside a ``with self._lock:``
+  block (in any method of the class or its same-module bases) is taken to
+  be guarded by that lock. A *mutation* of an inferred-guarded field
+  outside any held-lock region — assignment, augmented assignment,
+  subscript store/delete, or a mutating method call (``append``/``pop``/
+  ``update``/...) — is a race: the locked readers the inference found can
+  observe torn or stale state. ``__init__`` (and ``__post_init__``/
+  ``__new__``) are exempt — construction happens-before publication.
+  Two annotations tune the inference (docs/ANALYSIS.md):
+
+  - ``# guarded by: <lock>`` — on a field assignment: declares the guard
+    explicitly (adds the field to the lock's guard set even when inference
+    would miss it). On a ``def`` line: asserts every caller holds
+    ``<lock>``, so the whole method body is analyzed as under it (the
+    helper-called-with-lock-held pattern).
+  - ``# not shared`` — on a field assignment: the field is owned by one
+    thread (an engine worker's slot table, a donated device cache) and is
+    exempt from EM301 even when a lock block happens to touch it.
+
+- **EM302 lock-order-inversion (error).** A may-hold graph: an edge
+  ``A -> B`` whenever a method can acquire ``B`` while holding ``A``,
+  including through self-calls (``with self._a: self.helper()`` where the
+  helper takes ``self._b``). A cycle means two threads can deadlock by
+  acquiring the same locks in opposite orders. Per class (merged with
+  same-module bases); cross-object cycles (registry<->router style) are
+  out of static reach — docs/FLEET.md documents the ordering discipline.
+
+- **EM303 blocking-under-lock (warning).** A known-blocking call while a
+  lock is held: outbound HTTP (``post_json``/``get_json``/``urlopen``),
+  ``time.sleep``, ``subprocess.*``, a zero-arg ``.get()`` / no-timeout
+  ``.result()`` (queue/Future waits), ``.join()`` without timeout,
+  ``block_until_ready``/``device_sync`` device fences. One stalled callee
+  under a lock turns every other thread that needs the lock into a convoy
+  — the exact shape that turns one stalled replica into a wedged router.
+  ``Condition.wait``/``wait_for`` are NOT blocking-under-lock (they
+  release the lock). Self-calls are descended; held regions also track
+  ``lock.acquire()``/``release()`` pairs and, beyond class-constructed
+  locks, any ``with``/acquire target whose terminal name looks like a lock
+  (``*lock*``/``*cond*``/``*cv*``/``*mutex*``) so module-level locks and
+  borrowed locks (``self.server.profile_lock``) are covered too.
+
+- **EM304 thread-hygiene (warning).** ``threading.Thread(...)`` with no
+  ``daemon=`` and no ``.join()`` on the stored handle anywhere in the file
+  (an orphan thread with no shutdown path), and worker loops whose
+  ``try``'s handler is a bare ``except:``/``except Exception:`` with a
+  body of only ``pass``/``continue`` — a silently-swallowing worker loop
+  keeps "running" after its state machine died.
+
+Suppression and baselining are the standard edgelint mechanics: inline
+``# edgelint: disable=EM301`` (line, ``def`` line, or ``class`` line), and
+the fingerprint baseline (findings.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from edgemesh.analysis.edgelint import _Aliases as _EdgelintAliases
+from edgemesh.analysis.edgelint import _dotted_name as _dotted
+from edgemesh.analysis.findings import DISABLE_RE, Finding, repo_relative
+
+RULES: dict[str, dict] = {
+    "EM301": {
+        "name": "unguarded-shared-state",
+        "severity": "error",
+        "summary": "mutation of an inferred lock-guarded field outside the lock",
+    },
+    "EM302": {
+        "name": "lock-order-inversion",
+        "severity": "error",
+        "summary": "two locks acquired in opposite orders on different paths",
+    },
+    "EM303": {
+        "name": "blocking-under-lock",
+        "severity": "warning",
+        "summary": "known-blocking call while a lock is held",
+    },
+    "EM304": {
+        "name": "thread-hygiene",
+        "severity": "warning",
+        "summary": "thread without a shutdown path, or except-swallowing worker loop",
+    },
+}
+
+# Lock constructors (threading.*). Semaphores are admission tokens, not
+# mutual exclusion — holding one across blocking work is usually the point.
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+
+# Annotation vocabulary (EM301). Matched against the raw source line of a
+# field assignment or a ``def`` line.
+_GUARDED_BY_RE = re.compile(r"#\s*guarded by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_NOT_SHARED_RE = re.compile(r"#\s*not shared\b")
+
+# Heuristic: a with/acquire target whose terminal name matches this is
+# treated as a lock even when this pass never saw it constructed (module
+# globals, locks borrowed from another object).
+_LOCKISH_NAME_RE = re.compile(r"(?:^|_)(?:lock|cond|cv|mutex)", re.IGNORECASE)
+
+# Methods that mutate their receiver (list/dict/set/deque surface).
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "pop", "popleft", "popitem", "remove", "discard", "clear", "update",
+    "setdefault", "sort", "reverse", "rotate",
+}
+
+# EM303: resolved dotted calls that block.
+_BLOCKING_FUNCS = {"time.sleep", "urllib.request.urlopen", "jax.device_get"}
+_BLOCKING_PREFIXES = ("subprocess.",)
+# Attribute calls that block regardless of receiver.
+_BLOCKING_ATTRS = {"post_json", "get_json", "block_until_ready", "device_sync"}
+# Function-name spellings of the repo's device fences.
+_BLOCKING_NAME_FUNCS = {"device_sync", "tree_sync"}
+# Condition methods that RELEASE the lock while waiting — never EM303.
+_WAIT_METHODS = {"wait", "wait_for", "notify", "notify_all"}
+
+_INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+
+def _build_aliases(tree: ast.Module) -> _EdgelintAliases:
+    """edgelint's import-alias resolver, fed the whole module — ONE
+    resolution contract across both passes (``from jax import lax;
+    lax.pcast`` and ``import time as t; t.sleep`` resolve identically)."""
+    aliases = _EdgelintAliases()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            aliases.visit_import(node)
+        elif isinstance(node, ast.ImportFrom):
+            aliases.visit_import_from(node)
+    return aliases
+
+
+def _is_lock_ctor(node: ast.AST, aliases: _EdgelintAliases) -> bool:
+    """``threading.Lock()`` / aliased, or
+    ``field(default_factory=threading.Lock)`` (dataclass spelling)."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    if d and aliases.resolve(d) in _LOCK_CTORS:
+        return True
+    if d and aliases.resolve(d).rsplit(".", 1)[-1] == "field":
+        for kw in node.keywords:
+            if kw.arg == "default_factory":
+                fd = _dotted(kw.value)
+                if fd and aliases.resolve(fd) in _LOCK_CTORS:
+                    return True
+    return False
+
+
+def _flatten_targets(targets) -> list[ast.AST]:
+    """Unpack tuple/list assignment targets: ``self.a, self.b = ...``."""
+    out: list[ast.AST] = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(_flatten_targets(t.elts))
+        else:
+            out.append(t)
+    return out
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for ``self.x``; None otherwise."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _lock_key(node: ast.AST) -> str | None:
+    """Identity of a lock expression for held-set/graph purposes.
+
+    ``self._lock`` -> "self._lock"; a bare lockish Name -> its id; a
+    lockish attribute chain (``self.server.profile_lock``) -> the dotted
+    path. None when the expression does not look like a lock at all."""
+    attr = _self_attr(node)
+    if attr is not None:
+        return f"self.{attr}"
+    d = _dotted(node)
+    if d is not None:
+        tail = d.rsplit(".", 1)[-1]
+        if _LOCKISH_NAME_RE.search(tail):
+            return d
+    return None
+
+
+class _ClassInfo:
+    """Per-class facts collected in pass one."""
+
+    def __init__(self, node: ast.ClassDef) -> None:
+        self.node = node
+        self.bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
+        self.locks: set[str] = set()  # field names constructed as locks
+        self.methods: dict[str, ast.AST] = {}
+        # field -> set of lock keys it was touched under (inference)
+        self.guarded: dict[str, set[str]] = {}
+        self.not_shared: set[str] = set()
+        # field -> declared guard (from "# guarded by:" on an assignment)
+        self.declared: dict[str, str] = {}
+
+
+class _FileConcurrency:
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.relpath = repo_relative(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.disabled: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = DISABLE_RE.search(line)
+            if m:
+                self.disabled[i] = {r.strip() for r in m.group(1).split(",")}
+
+    # -- shared emit machinery ----------------------------------------------
+
+    def _scopes_for_line(self, line: int) -> list[ast.AST]:
+        return [
+            s for s in self._all_scopes
+            if s.lineno <= line <= getattr(s, "end_lineno", s.lineno)
+        ]
+
+    def _suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.disabled.get(line, ()):
+            return True
+        for scope in self._scopes_for_line(line):
+            if rule in self.disabled.get(scope.lineno, ()):
+                return True
+        return False
+
+    def _context_for_line(self, line: int) -> str:
+        best = ""
+        for s in self._scopes_for_line(line):
+            best = s.name if not best else f"{best}.{s.name}"
+        return best
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if self._suppressed(rule, line):
+            return
+        self.findings.append(
+            Finding(
+                rule=rule,
+                severity=RULES[rule]["severity"],
+                path=self.relpath,
+                line=line,
+                message=message,
+                context=self._context_for_line(line),
+                line_text=(self.lines[line - 1].strip() if line <= len(self.lines) else ""),
+            )
+        )
+
+    def _line_text(self, node: ast.AST) -> str:
+        line = getattr(node, "lineno", 0)
+        return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source)
+        except SyntaxError:
+            return []  # edgelint already reports EM000 for this file
+        self.aliases = _build_aliases(tree)
+        self._all_scopes = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+        ]
+
+        # Pass one: per-class collection, then merge same-module bases.
+        infos: dict[str, _ClassInfo] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                infos[node.name] = self._collect_class(node)
+        for info in infos.values():
+            self._merge_bases(info, infos, set())
+
+        # Pass two: judge each class.
+        for info in infos.values():
+            self._rule_unguarded(info)
+            self._rule_lock_order(info)
+        # EM303 runs over every function (methods get self-call descent via
+        # their class info); EM304 over the whole module.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                info = infos[node.name]
+                for m in info.own_methods:
+                    self._scan_blocking(
+                        info, info.methods_merged, m,
+                        self._entry_locks(m), set(),
+                    )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not any(
+                    isinstance(s, ast.ClassDef)
+                    and s.lineno <= node.lineno <= getattr(s, "end_lineno", s.lineno)
+                    for s in self._all_scopes
+                ):
+                    self._scan_blocking(None, {}, node, self._entry_locks(node), set())
+        self._rule_thread_hygiene(tree)
+
+        seen: set[tuple] = set()
+        unique: list[Finding] = []
+        for f in sorted(self.findings, key=lambda f: (f.line, f.rule)):
+            key = (f.rule, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        self.findings = unique
+        return self.findings
+
+    # -- collection ----------------------------------------------------------
+
+    def _collect_class(self, node: ast.ClassDef) -> _ClassInfo:
+        info = _ClassInfo(node)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[stmt.name] = stmt
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                # Dataclass field: ``_lock: Any = field(default_factory=...)``
+                if isinstance(stmt.target, ast.Name) and _is_lock_ctor(
+                    stmt.value, self.aliases
+                ):
+                    info.locks.add(stmt.target.id)
+        # Lock constructions + annotations on self-field assignments.
+        for sub in ast.walk(node):
+            targets: list[ast.AST] = []
+            value = None
+            if isinstance(sub, ast.Assign):
+                targets, value = _flatten_targets(sub.targets), sub.value
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                targets, value = [sub.target], sub.value
+            for t in targets:
+                f = _self_attr(t)
+                if f is None:
+                    continue
+                if value is not None and _is_lock_ctor(value, self.aliases):
+                    info.locks.add(f)
+                text = self._line_text(sub)
+                if _NOT_SHARED_RE.search(text):
+                    info.not_shared.add(f)
+                m = _GUARDED_BY_RE.search(text)
+                if m:
+                    info.declared[f] = m.group(1)
+        # Guarded-field inference: self-attr accesses inside held regions.
+        for m in info.methods.values():
+            self._infer_method(info, m)
+        return info
+
+    def _infer_method(self, info: _ClassInfo, fn: ast.AST) -> None:
+        def visit(node: ast.AST, held: frozenset[str]) -> frozenset[str]:
+            """Returns the held set AFTER this node — locked regions come
+            from with-blocks AND linear acquire()/release() pairs (the
+            try/finally idiom), same tracking as every other sub-rule."""
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                return held  # nested defs run on their own schedule
+            if isinstance(node, ast.With):
+                inner = held
+                for item in node.items:
+                    key = _lock_key(item.context_expr)
+                    if key is not None and self._is_known_lock(info, key):
+                        inner = inner | {key}
+                for child in node.body:
+                    inner = visit(child, inner)
+                return held
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                key = _lock_key(node.func.value)
+                known = key is not None and self._is_known_lock(info, key)
+                if known and node.func.attr == "acquire":
+                    return held | {key}
+                if known and node.func.attr == "release":
+                    return frozenset(k for k in held if k != key)
+            if held and isinstance(node, ast.Attribute):
+                f = _self_attr(node)
+                if f is not None and f not in info.locks:
+                    for lock in held:
+                        info.guarded.setdefault(f, set()).add(lock)
+            for child in ast.iter_child_nodes(node):
+                held = visit(child, held)
+            return held
+
+        held = self._entry_locks(fn)
+        for stmt in fn.body:
+            held = visit(stmt, held)
+
+    def _entry_locks(self, fn: ast.AST) -> frozenset[str]:
+        """Locks asserted held at method entry via ``# guarded by:`` on the
+        def line."""
+        m = _GUARDED_BY_RE.search(self._line_text(fn))
+        if m:
+            return frozenset({f"self.{m.group(1)}", m.group(1)})
+        return frozenset()
+
+    def _is_known_lock(self, info: _ClassInfo | None, key: str) -> bool:
+        if info is not None and key.startswith("self."):
+            if key[len("self."):] in info.locks:
+                return True
+        return bool(_LOCKISH_NAME_RE.search(key.rsplit(".", 1)[-1]))
+
+    def _merge_bases(self, info: _ClassInfo, infos: dict[str, _ClassInfo],
+                     seen: set[str]) -> None:
+        """Fold same-module base classes into the subclass view (locks,
+        guard inference, annotations, and the method table used for
+        self-call resolution — subclass overrides win)."""
+        if getattr(info, "_merged", False):
+            return
+        info._merged = True
+        info.own_methods = list(info.methods.values())
+        merged = dict(info.methods)
+        for base_name in info.bases:
+            base = infos.get(base_name)
+            if base is None or base_name in seen:
+                continue
+            self._merge_bases(base, infos, seen | {info.node.name})
+            info.locks |= base.locks
+            info.not_shared |= base.not_shared
+            for f, g in base.declared.items():
+                info.declared.setdefault(f, g)
+            for f, locks in base.guarded.items():
+                info.guarded.setdefault(f, set()).update(locks)
+            for name, fn in base.methods_merged.items():
+                merged.setdefault(name, fn)
+        info.methods_merged = merged
+        # Re-run inference for own methods now that base locks are known
+        # (a subclass method using an inherited lock field).
+        for m in info.own_methods:
+            self._infer_method(info, m)
+
+    # -- EM301 ---------------------------------------------------------------
+
+    def _rule_unguarded(self, info: _ClassInfo) -> None:
+        guard_of: dict[str, set[str]] = {}
+        for f, locks in info.guarded.items():
+            guard_of[f] = set(locks)
+        for f, lock in info.declared.items():
+            guard_of.setdefault(f, set()).update({f"self.{lock}", lock})
+        for f in info.not_shared:
+            guard_of.pop(f, None)
+        if not guard_of:
+            return
+
+        for fn in info.own_methods:
+            if fn.name in _INIT_METHODS:
+                continue
+            self._scan_mutations(info, fn, guard_of)
+
+    def _scan_mutations(self, info: _ClassInfo, fn: ast.AST,
+                        guard_of: dict[str, set[str]]) -> None:
+        def report(node: ast.AST, f: str, held: frozenset[str]) -> None:
+            locks = guard_of.get(f)
+            if not locks or locks & held:
+                return
+            if _NOT_SHARED_RE.search(self._line_text(node)) or _GUARDED_BY_RE.search(
+                self._line_text(node)
+            ):
+                # Site-level annotation: reviewed single-thread ownership or
+                # an externally-held guard this pass cannot see.
+                return
+            lock_names = ", ".join(sorted(k.removeprefix("self.") for k in locks))
+            self._emit(
+                "EM301", node,
+                f"'{info.node.name}.{f}' is read/written under '{lock_names}' "
+                f"elsewhere but mutated here without it — locked readers can "
+                "see torn/stale state (take the lock, or annotate the field "
+                "'# guarded by: <lock>' / '# not shared')",
+            )
+
+        def visit(node: ast.AST, held: frozenset[str]) -> frozenset[str]:
+            """Returns the held set AFTER this node — linear
+            acquire()/release() pairs extend it statement-to-statement, the
+            same tracking _scan_blocking uses (a with-block is not the only
+            correct way to hold a lock)."""
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                return held
+            if isinstance(node, ast.With):
+                inner = held
+                for item in node.items:
+                    key = _lock_key(item.context_expr)
+                    if key is not None and self._is_known_lock(info, key):
+                        inner = inner | {key}
+                for child in node.body:
+                    inner = visit(child, inner)
+                return held
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                key = _lock_key(node.func.value)
+                known = key is not None and self._is_known_lock(info, key)
+                if known and node.func.attr == "acquire":
+                    return held | {key}
+                if known and node.func.attr == "release":
+                    return frozenset(k for k in held if k != key)
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in _flatten_targets(targets):
+                    f = _self_attr(t)
+                    if f is not None:
+                        report(node, f, held)
+                    elif isinstance(t, ast.Subscript):
+                        f = _self_attr(t.value)
+                        if f is not None:
+                            report(node, f, held)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    f = _self_attr(base)
+                    if f is not None:
+                        report(node, f, held)
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr in _MUTATOR_METHODS:
+                    f = _self_attr(node.func.value)
+                    if f is not None:
+                        report(node, f, held)
+            for child in ast.iter_child_nodes(node):
+                held = visit(child, held)
+            return held
+
+        held = self._entry_locks(fn)
+        for stmt in fn.body:
+            held = visit(stmt, held)
+
+    # -- EM302 ---------------------------------------------------------------
+
+    def _rule_lock_order(self, info: _ClassInfo) -> None:
+        # edges[(A, B)] = (method name, line) sample where B is taken under A
+        edges: dict[tuple[str, str], tuple[str, int]] = {}
+
+        def walk(fn: ast.AST, entry_held: frozenset[str],
+                 stack: frozenset[str], origin: str) -> None:
+            def visit(node: ast.AST, held: frozenset[str]) -> frozenset[str]:
+                """Returns the held set AFTER this node, so a linear
+                ``a.acquire(); with b: ...`` sequence contributes its
+                a->b edge like the with-block form does."""
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                    return held
+                if isinstance(node, ast.With):
+                    inner = held
+                    for item in node.items:
+                        key = _lock_key(item.context_expr)
+                        if key is not None and self._is_known_lock(info, key):
+                            for h in inner:
+                                if h != key:
+                                    edges.setdefault((h, key), (origin, node.lineno))
+                            inner = inner | {key}
+                    for child in node.body:
+                        inner = visit(child, inner)
+                    return held
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                    key = _lock_key(node.func.value)
+                    known = key is not None and self._is_known_lock(info, key)
+                    if known and node.func.attr == "acquire":
+                        for h in held:
+                            if h != key:
+                                edges.setdefault((h, key), (origin, node.lineno))
+                        return held | {key}
+                    if known and node.func.attr == "release":
+                        return frozenset(k for k in held if k != key)
+                    if (
+                        isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and held
+                    ):
+                        callee = info.methods_merged.get(node.func.attr)
+                        if callee is not None and node.func.attr not in stack:
+                            walk(callee, held, stack | {node.func.attr},
+                                 f"{origin}->{node.func.attr}")
+                for child in ast.iter_child_nodes(node):
+                    held = visit(child, held)
+                return held
+
+            held = entry_held
+            for stmt in fn.body:
+                held = visit(stmt, held)
+
+        for fn in info.own_methods:
+            walk(fn, self._entry_locks(fn), frozenset({fn.name}), fn.name)
+
+        # Cycle detection over the acquisition digraph.
+        graph: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        reported: set[frozenset[str]] = set()
+        for start in sorted(graph):
+            path: list[str] = []
+
+            def dfs(nodekey: str) -> list[str] | None:
+                if nodekey in path:
+                    return path[path.index(nodekey):] + [nodekey]
+                path.append(nodekey)
+                for nxt in sorted(graph.get(nodekey, ())):
+                    cyc = dfs(nxt)
+                    if cyc is not None:
+                        return cyc
+                path.pop()
+                return None
+
+            cycle = dfs(start)
+            if cycle is None:
+                continue
+            key = frozenset(cycle)
+            if key in reported:
+                continue
+            reported.add(key)
+            # Anchor on one edge of the cycle, describe the whole loop.
+            origin, line = edges[(cycle[0], cycle[1])]
+            route = " -> ".join(k.removeprefix("self.") for k in cycle)
+            anchor = ast.copy_location(ast.Pass(), info.node)
+            anchor.lineno = line
+            self._emit(
+                "EM302", anchor,
+                f"lock-order inversion in '{info.node.name}': {route} "
+                f"(one edge via {origin}) — two threads taking these locks "
+                "in opposite orders deadlock; pick one global order and "
+                "release before crossing it",
+            )
+
+    # -- EM303 ---------------------------------------------------------------
+
+    def _blocking_reason(self, node: ast.Call) -> str | None:
+        d = _dotted(node.func)
+        resolved = self.aliases.resolve(d) if d else None
+        if resolved:
+            if resolved in _BLOCKING_FUNCS:
+                return f"{resolved}()"
+            if any(resolved.startswith(p) for p in _BLOCKING_PREFIXES):
+                return f"{resolved}()"
+        if isinstance(node.func, ast.Name) and node.func.id in _BLOCKING_NAME_FUNCS:
+            return f"{node.func.id}()"
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            has_timeout = any(
+                kw.arg in ("timeout", "timeout_s") for kw in node.keywords
+            )
+            if attr in _BLOCKING_ATTRS:
+                # Transport calls block for their (bounded) timeout — still
+                # a convoy while a lock is held, so a timeout kwarg does not
+                # exempt them.
+                return f".{attr}()"
+            if attr == "get" and not node.args and not node.keywords:
+                return ".get() with no timeout"
+            if attr in ("result", "join") and not has_timeout and not node.args:
+                return f".{attr}() with no timeout"
+        return None
+
+    def _scan_blocking(self, info: _ClassInfo | None,
+                       methods: dict[str, ast.AST], fn: ast.AST,
+                       entry_held: frozenset[str], stack: frozenset[str],
+                       report_node: ast.AST | None = None) -> None:
+        """Walk ``fn`` tracking held locks (with-blocks AND linear
+        acquire()/release() pairs); report blocking calls executed while
+        any lock is held. Only KNOWN locks count — class-constructed
+        Lock/RLock/Condition fields plus lockish-named targets — so a
+        semaphore slot held across dispatch is not a finding.
+        ``report_node`` anchors findings at an outer self-call site when
+        descending."""
+
+        def report(node: ast.Call, what: str, held: frozenset[str]) -> None:
+            anchor = report_node or node
+            locks = ", ".join(sorted(k.removeprefix("self.") for k in held))
+            via = "" if report_node is None else f" (via self.{fn.name}())"
+            self._emit(
+                "EM303", anchor,
+                f"blocking {what}{via} while holding '{locks}' — every "
+                "thread needing the lock convoys behind this call; move the "
+                "blocking work outside the held region or switch to a "
+                "flag-under-lock",
+            )
+
+        def visit(node: ast.AST, held: frozenset[str]) -> frozenset[str]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                return held
+            if isinstance(node, ast.With):
+                inner = held
+                for item in node.items:
+                    key = _lock_key(item.context_expr)
+                    if key is not None and self._is_known_lock(info, key):
+                        inner = inner | {key}
+                for child in node.body:
+                    inner = visit(child, inner)
+                return held
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                key = _lock_key(node.func.value)
+                known = key is not None and self._is_known_lock(info, key)
+                if known and attr == "acquire":
+                    # Linear tracking: held from this statement until a
+                    # release() on the same chain in this function.
+                    return held | {key}
+                if known and attr == "release":
+                    return frozenset(k for k in held if k != key)
+                if known and attr in _WAIT_METHODS:
+                    # Condition.wait releases the lock while blocked.
+                    for child in ast.iter_child_nodes(node):
+                        visit(child, held)
+                    return held
+            if isinstance(node, ast.Call):
+                if held:
+                    what = self._blocking_reason(node)
+                    if what is not None:
+                        report(node, what, held)
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in methods
+                        and node.func.attr not in stack
+                    ):
+                        self._scan_blocking(
+                            info, methods, methods[node.func.attr], held,
+                            stack | {node.func.attr},
+                            report_node=report_node or node,
+                        )
+            for child in ast.iter_child_nodes(node):
+                held = visit(child, held)
+            return held
+
+        held = entry_held
+        for stmt in fn.body:
+            held = visit(stmt, held)
+
+    # -- EM304 ---------------------------------------------------------------
+
+    def _rule_thread_hygiene(self, tree: ast.Module) -> None:
+        # Names/attrs .join()ed anywhere in the file.
+        joined: set[str] = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                d = _dotted(node.func.value)
+                if d:
+                    joined.add(d)
+        # Map def name -> node for target resolution (module + class level).
+        defs: dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if not d or self.aliases.resolve(d) != "threading.Thread":
+                continue
+            kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            if "daemon" not in kwargs:
+                # Find where the handle lands: x = Thread(...) / self._t = ...
+                # (annotated form included: self._t: Thread = Thread(...)).
+                handle: str | None = None
+                parent_targets = self._assign_targets(tree, node)
+                for t in parent_targets:
+                    handle = _dotted(t)
+                    break
+                if handle is None or handle not in joined:
+                    self._emit(
+                        "EM304", node,
+                        "thread has no shutdown path: neither daemon= nor a "
+                        ".join() on its handle anywhere in this file — it "
+                        "outlives close()/shutdown and leaks across restarts",
+                    )
+            target = kwargs.get("target")
+            tname = None
+            if isinstance(target, ast.Name):
+                tname = target.id
+            elif isinstance(target, ast.Attribute):
+                tname = target.attr
+            worker = defs.get(tname) if tname else None
+            if worker is not None:
+                self._check_swallowing_loop(worker)
+
+    @staticmethod
+    def _assign_targets(tree: ast.Module, call: ast.Call) -> list[ast.AST]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and node.value is call:
+                return _flatten_targets(node.targets)
+            if isinstance(node, ast.AnnAssign) and node.value is call:
+                return [node.target]
+        return []
+
+    def _check_swallowing_loop(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Try):
+                    continue
+                for handler in sub.handlers:
+                    broad = handler.type is None or (
+                        isinstance(handler.type, ast.Name)
+                        and handler.type.id in ("Exception", "BaseException")
+                    )
+                    silent = all(
+                        isinstance(s, (ast.Pass, ast.Continue)) for s in handler.body
+                    )
+                    if broad and silent:
+                        self._emit(
+                            "EM304", handler,
+                            "worker loop swallows every exception silently "
+                            "(bare except + pass/continue) — the thread keeps "
+                            "'running' after its state machine died; log it "
+                            "(log.exception) or let it crash loudly",
+                        )
+
+
+def analyze_source(source: str, path: str = "<memory>") -> list[Finding]:
+    """Concurrency-pass entry point (mirrors edgelint.lint_source)."""
+    return _FileConcurrency(path, source).run()

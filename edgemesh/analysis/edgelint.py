@@ -31,8 +31,9 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   or ``edgemesh/runtime/`` — serving-stack timing belongs to the obs
   substrate (``edgemesh.obs.SpanTracker`` hooks / ``utils.tracing.trace``)
   so it lands in spans, histograms, and ``/metrics`` instead of ad-hoc
-  deltas. Pre-obs sites are grandfathered in the baseline; clocks that ARE
-  the obs instrumentation (or wait control flow) carry an inline disable.
+  deltas. Result-payload windows use ``utils.tracing.Stopwatch`` or the
+  handle ``trace()`` yields; clocks that ARE the obs instrumentation (or
+  wait control flow) carry an inline disable.
 - EM108 fleet-missing-timeout (error): an outbound HTTP/socket call inside
   ``edgemesh/fleet/`` without an explicit timeout (bare ``urlopen``,
   ``socket.create_connection``, ``http.client.*Connection``) — the fleet's
@@ -48,6 +49,11 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   Calls with no ``headers=`` at all (probes, drain admin) are out of
   scope, as are opaque header variables the linter cannot see into.
 
+The class-level concurrency rules (EM301-EM304: lock discipline,
+lock-order cycles, blocking-under-lock, thread hygiene) live in
+``edgemesh/analysis/concurrency.py`` and ride the same entry points —
+``lint_source``/``lint_file`` return both passes' findings.
+
 Suppression: append ``# edgelint: disable=EM105`` (comma-separate for
 several rules) to the flagged line, or put the comment on the ``def`` line
 to suppress within that whole function.
@@ -56,10 +62,9 @@ to suppress within that whole function.
 from __future__ import annotations
 
 import ast
-import re
 from pathlib import Path
 
-from edgemesh.analysis.findings import Finding, repo_relative
+from edgemesh.analysis.findings import DISABLE_RE, Finding, repo_relative
 
 RULES: dict[str, dict] = {
     "EM101": {
@@ -143,7 +148,7 @@ _CLOCK_FUNCS = {"time.time", "time.perf_counter", "time.monotonic"}
 _FENCE_METHODS = {"block_until_ready", "device_sync", "tree_sync", "result"}
 _FENCE_FUNCS = {"block_until_ready", "device_sync", "tree_sync"}
 
-_DISABLE_RE = re.compile(r"#\s*edgelint:\s*disable=([A-Z0-9, ]+)")
+_DISABLE_RE = DISABLE_RE  # shared home: findings.py (concurrency.py uses it too)
 
 # EM107 scope: the serving stack, where every wall-clock read should flow
 # through the obs substrate. Path-substring match (like the EM101 allowlist)
@@ -791,12 +796,21 @@ class _FileLinter:
 
 def lint_file(path: str | Path) -> list[Finding]:
     src = Path(path).read_text(encoding="utf-8", errors="replace")
-    return _FileLinter(str(path), src).run()
+    return lint_source(src, str(path))
 
 
 def lint_source(source: str, path: str = "<memory>") -> list[Finding]:
-    """Lint a source string (the fixture-test entry point)."""
-    return _FileLinter(path, source).run()
+    """Lint a source string (the fixture-test entry point): the per-function
+    AST rules (EM1xx) plus the class-level concurrency pass (EM3xx)."""
+    # Lazy import: concurrency.py is a sibling pass, not a dependency of the
+    # EM1xx machinery, and importing it at module top would be a cycle if it
+    # ever needs linter internals.
+    from edgemesh.analysis.concurrency import analyze_source
+
+    findings = _FileLinter(path, source).run()
+    findings.extend(analyze_source(source, path))
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return findings
 
 
 def iter_python_files(paths) -> list[Path]:

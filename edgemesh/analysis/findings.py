@@ -10,10 +10,15 @@ from __future__ import annotations
 
 import hashlib
 import json
+import re
 from dataclasses import asdict, dataclass, field
 from pathlib import Path
 
 SEVERITIES = ("error", "warning")
+
+#: Inline suppression: ``# edgelint: disable=EM105`` (comma-separate for
+#: several rules). Shared by the AST linter and the concurrency pass.
+DISABLE_RE = re.compile(r"#\s*edgelint:\s*disable=([A-Z0-9, ]+)")
 
 
 @dataclass(frozen=True)

@@ -17,7 +17,6 @@ inconsistency recorded in BASELINE.md).
 
 from __future__ import annotations
 
-import time
 from functools import partial
 from typing import NamedTuple
 
@@ -215,7 +214,7 @@ def generate(
     rng = rng if rng is not None else jax.random.PRNGKey(sampling.seed)
 
     from edgemesh.utils.platform import device_sync
-    from edgemesh.utils.tracing import trace
+    from edgemesh.utils.tracing import Stopwatch, trace
 
     # Per-phase int8 path: prefill is its own compiled program, so it may
     # run a different quant_mode than decode (ModelConfig.prefill_quant_mode
@@ -226,35 +225,39 @@ def generate(
         if cfg.prefill_quant_mode and cfg.prefill_quant_mode != cfg.quant_mode
         else cfg
     )
-    t0 = time.perf_counter()
-    with trace("edgemesh/prefill"):
+    # Timing goes through the obs substrate (EM107): the trace() handles
+    # carry each phase's wall time — the same numbers that land in the
+    # edgemesh_phase_seconds histogram — and the stopwatch owns the
+    # end-to-end window.
+    wall = Stopwatch()
+    with trace("edgemesh/prefill") as prefill_t:
         first_logits, cache = prefill_fn(pcfg, params, tokens, lengths, cache)
         # NOT block_until_ready: on the tunneled TPU platform that returns
         # before the program finishes, silently shrinking the timed window
         # (utils/platform.device_sync). A 1-element readback is a real fence.
         device_sync(first_logits)
-    t1 = time.perf_counter()
 
     valid = jnp.arange(prompt_len)[None, :] < lengths[:, None]
     token_mask = (
         TokenMaskState.init(batch, cfg.vocab_size).add_sequence(tokens, valid).mask
     )
-    with trace("edgemesh/decode"):
+    with trace("edgemesh/decode") as decode_t:
         out, num_generated, cache, confidence, _, _, _ = _decode_loop(
             cfg, params, sampling, max_new, int(eos_id), first_logits, cache,
             token_mask, rng, decode_fn,
         )
         device_sync(out)
-    t2 = time.perf_counter()
+    # Snapshot the window HERE — the jnp.sum readback below is bookkeeping,
+    # not generation, and must not deflate tokens_per_sec.
+    wall_s = wall.elapsed()
 
     total_generated = int(jnp.sum(num_generated))
-    decode_s = t2 - t1
-    wall_s = t2 - t0
+    decode_s = decode_t.elapsed_s
     decode_forward_tokens = max(total_generated - batch, 0)
     return GenerateResult(
         tokens=out,
         num_generated=num_generated,
-        prefill_time_s=t1 - t0,
+        prefill_time_s=prefill_t.elapsed_s,
         decode_time_s=decode_s,
         tokens_per_sec=total_generated / wall_s if wall_s > 0 else 0.0,
         decode_tok_s=decode_forward_tokens / decode_s if decode_s > 0 else 0.0,

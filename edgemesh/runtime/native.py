@@ -68,7 +68,11 @@ def load_native() -> ctypes.CDLL | None:
         _lib_tried = True
         if not _LIB_PATH.exists() and (_NATIVE_DIR / "Makefile").exists():
             try:
-                subprocess.run(
+                # Building under _lock is the point: a second caller must
+                # WAIT for the one build (then find _lib/_lib_tried set),
+                # not race a concurrent `make` over the same .so. Reviewed
+                # blocking-under-lock, not an oversight.
+                subprocess.run(  # edgelint: disable=EM303
                     ["make", "-C", str(_NATIVE_DIR)], check=True,
                     capture_output=True, timeout=120,
                 )

@@ -29,7 +29,6 @@ the next chunk overwrites them).
 
 from __future__ import annotations
 
-import time
 from functools import partial
 from typing import Any, NamedTuple
 
@@ -340,7 +339,7 @@ def generate_speculative(
     tokenizer/vocab (standard speculative constraint). ``kv_backend="paged"``
     runs both caches as page pools (serving memory model; same tokens)."""
     verify_fn, decode_fn = _spec_fns(kv_backend)
-    state, t0, t1 = _spec_prefill(
+    state, wall_sw, prefill_s = _spec_prefill(
         cfg_target, params_target, cfg_draft, params_draft, tokens, lengths,
         sampling, gamma, eos_id, rng, kv_backend, page_size,
     )
@@ -350,7 +349,7 @@ def generate_speculative(
     batch, prompt_len = tokens.shape
     max_new = int(sampling.max_new_tokens)
     cap = max_new + gamma + 1
-    with trace("edgemesh/spec_decode"):
+    with trace("edgemesh/spec_decode") as decode_t:
         # A round commits >=1 token per active row, so max_new rounds always
         # run to completion.
         final = _spec_rounds(
@@ -359,13 +358,14 @@ def generate_speculative(
             state, jnp.asarray(max_new, jnp.int32), verify_fn, decode_fn,
         )
         device_sync(final.out)
-    t2 = time.perf_counter()
+    # Snapshot HERE — the jnp.sum readback below is bookkeeping, not
+    # generation, and must not deflate tokens_per_sec.
+    wall = wall_sw.elapsed()
 
     n_gen = jnp.minimum(final.n_emit, max_new)
     confidence = final.conf_sum / jnp.maximum(final.n_emit, 1)
     total = int(jnp.sum(n_gen))
-    decode_s = t2 - t1
-    wall = t2 - t0
+    decode_s = decode_t.elapsed_s
     stats = SpecStats(
         proposed=int(final.proposed), accepted=int(final.accepted),
         rounds=int(final.rounds),
@@ -374,7 +374,7 @@ def generate_speculative(
         GenerateResult(
             tokens=final.out[:, :max_new],
             num_generated=n_gen,
-            prefill_time_s=t1 - t0,
+            prefill_time_s=prefill_s,
             decode_time_s=decode_s,
             tokens_per_sec=total / wall if wall > 0 else 0.0,
             decode_tok_s=(total - batch) / decode_s if decode_s > 0 else 0.0,
@@ -406,12 +406,14 @@ def _spec_fns(kv_backend: str):
 def _spec_prefill(
     cfg_target, params_target, cfg_draft, params_draft, tokens, lengths,
     sampling, gamma, eos_id, rng, kv_backend="dense", page_size=64,
-) -> tuple[_SpecState, float, float]:
+):
     """Validation + both prefills + initial loop state (shared by the
-    run-to-completion and streaming entries). Returns (state, t0, t1).
-    ``kv_backend="paged"`` holds BOTH models' caches as page pools
-    (runtime/paged_kv.py) — the serving memory model under speculative
-    decoding."""
+    run-to-completion and streaming entries). Returns
+    ``(state, wall_stopwatch, prefill_s)`` — the stopwatch starts at entry
+    so callers can read the end-to-end window off it (EM107: timing flows
+    through utils.tracing, not raw clock reads). ``kv_backend="paged"``
+    holds BOTH models' caches as page pools (runtime/paged_kv.py) — the
+    serving memory model under speculative decoding."""
     if cfg_target.vocab_size != cfg_draft.vocab_size:
         raise ValueError(
             f"draft vocab {cfg_draft.vocab_size} != target vocab "
@@ -435,10 +437,10 @@ def _spec_prefill(
     rng = rng if rng is not None else jax.random.PRNGKey(sampling.seed)
 
     from edgemesh.utils.platform import device_sync
-    from edgemesh.utils.tracing import trace
+    from edgemesh.utils.tracing import Stopwatch, trace
 
-    t0 = time.perf_counter()
-    with trace("edgemesh/spec_prefill"):
+    wall_sw = Stopwatch()
+    with trace("edgemesh/spec_prefill") as prefill_t:
         if kv_backend in ("paged", "paged_int8"):
             from edgemesh.runtime.paged_generate import forward_prefill_paged
             from edgemesh.runtime.paged_kv import (
@@ -472,7 +474,6 @@ def _spec_prefill(
             first_logits, t_cache = forward_prefill(cfg_target, params_target, tokens, lengths, t_cache)
             _, d_cache = forward_prefill(cfg_draft, params_draft, tokens, lengths, d_cache)
         device_sync(first_logits)
-    t1 = time.perf_counter()
 
     valid = jnp.arange(prompt_len)[None, :] < lengths[:, None]
     mask = TokenMaskState.init(batch, cfg_target.vocab_size).add_sequence(tokens, valid).mask
@@ -480,7 +481,7 @@ def _spec_prefill(
         sampling, int(gamma), max_new, int(eos_id), first_logits,
         t_cache, d_cache, mask, rng,
     )
-    return state, t0, t1
+    return state, wall_sw, prefill_t.elapsed_s
 
 
 def generate_speculative_stream(
@@ -518,11 +519,12 @@ def generate_speculative_stream(
 
     from edgemesh.runtime.stream import StreamChunk
     from edgemesh.utils.platform import device_sync
+    from edgemesh.utils.tracing import trace
 
     if rounds_per_segment < 1:
         raise ValueError(f"rounds_per_segment must be >= 1, got {rounds_per_segment}")
     verify_fn, decode_fn = _spec_fns(kv_backend)
-    state, t0, t1 = _spec_prefill(
+    state, wall_sw, prefill_s = _spec_prefill(
         cfg_target, params_target, cfg_draft, params_draft, tokens, lengths,
         sampling, gamma, eos_id, rng, kv_backend, page_size,
     )
@@ -532,15 +534,15 @@ def generate_speculative_stream(
     emitted = np.zeros((batch,), np.int32)
     decode_s = 0.0
     while True:
-        seg_t0 = time.perf_counter()
-        state = _spec_rounds(
-            cfg_target, cfg_draft, params_target, params_draft, sampling,
-            int(gamma), max_new, int(eos_id), cfg_target.vocab_size, cap,
-            state, jnp.asarray(int(rounds_per_segment), jnp.int32),
-            verify_fn, decode_fn,
-        )
-        device_sync(state.out)
-        decode_s += time.perf_counter() - seg_t0
+        with trace("edgemesh/spec_decode") as seg_t:
+            state = _spec_rounds(
+                cfg_target, cfg_draft, params_target, params_draft, sampling,
+                int(gamma), max_new, int(eos_id), cfg_target.vocab_size, cap,
+                state, jnp.asarray(int(rounds_per_segment), jnp.int32),
+                verify_fn, decode_fn,
+            )
+            device_sync(state.out)
+        decode_s += seg_t.elapsed_s
         n_emit = np.minimum(np.asarray(state.n_emit), max_new)
         out = np.asarray(state.out)
         new = n_emit - emitted
@@ -553,7 +555,7 @@ def generate_speculative_stream(
             tokens=jnp.asarray(seg),
             counts=jnp.asarray(new),
             finished=jnp.asarray(finished),
-            elapsed_s=time.perf_counter() - t0,
+            elapsed_s=wall_sw.elapsed(),
         )
         emitted = n_emit
         if bool(finished.all()):
@@ -562,12 +564,12 @@ def generate_speculative_stream(
     n_gen = jnp.minimum(state.n_emit, max_new)
     confidence = state.conf_sum / jnp.maximum(state.n_emit, 1)
     total = int(np.sum(np.asarray(n_gen)))
-    wall = (t1 - t0) + decode_s  # device time only, not consumer stalls
+    wall = prefill_s + decode_s  # device time only, not consumer stalls
     return (
         GenerateResult(
             tokens=state.out[:, :max_new],
             num_generated=n_gen,
-            prefill_time_s=t1 - t0,
+            prefill_time_s=prefill_s,
             decode_time_s=decode_s,
             tokens_per_sec=total / wall if wall > 0 else 0.0,
             decode_tok_s=(total - batch) / decode_s if decode_s > 0 else 0.0,

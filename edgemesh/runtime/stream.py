@@ -14,7 +14,6 @@ one per ``chunk`` tokens, not one per token.
 
 from __future__ import annotations
 
-import time
 from collections.abc import Iterator
 from typing import NamedTuple
 
@@ -63,8 +62,11 @@ def generate_stream(
     rng = rng if rng is not None else jax.random.PRNGKey(sampling.seed)
 
     from edgemesh.utils.platform import device_sync
+    from edgemesh.utils.tracing import Stopwatch
 
-    t0 = time.perf_counter()
+    # EM107: the elapsed window flows through the obs substrate's stopwatch
+    # instead of raw perf_counter reads in the serving stack.
+    wall = Stopwatch()
     cache = init_kv_cache(cfg, batch, needed)
     first_logits, cache = forward_prefill(cfg, params, tokens, lengths, cache)
     valid = jnp.arange(prompt_len)[None, :] < lengths[:, None]
@@ -84,7 +86,7 @@ def generate_stream(
         device_sync(out)
         yield StreamChunk(
             tokens=out, counts=counts, finished=finished,
-            elapsed_s=time.perf_counter() - t0,
+            elapsed_s=wall.elapsed(),
         )
         remaining -= m
         if remaining <= 0 or bool(jnp.all(finished)):

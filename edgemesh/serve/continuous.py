@@ -307,11 +307,15 @@ class ContinuousEngine:
         self._queue: deque[tuple[str, Future, RequestTrace, int | None]] = deque()
         self._cond = threading.Condition()
         self._closed = False
-        self._slots = [_Slot() for _ in range(self.n_slots)]
+        # Slot table and device cache are OWNED by the engine worker thread
+        # (edgelint EM301): every post-init access happens on the worker;
+        # the under-_cond touches in _run/_reset_pool exist only to pair
+        # with _queue/_free_pages, not because these fields are shared.
+        self._slots = [_Slot() for _ in range(self.n_slots)]  # not shared
         self._gen = [0] * self.n_slots  # admission generation per slot
         cap = self.cfg.max_seq_len
         if kv_backend == "dense":
-            self._cache = init_kv_cache(self.cfg, self.n_slots, cap)
+            self._cache = init_kv_cache(self.cfg, self.n_slots, cap)  # not shared
             self._decode_fn = None  # _decode_loop default (forward_decode)
         elif kv_backend == "dense_int8":
             from edgemesh.runtime.quant_kv import (
@@ -625,7 +629,8 @@ class ContinuousEngine:
                         jnp.asarray([plen - match], jnp.int32), row_view,
                         jnp.asarray([match], jnp.int32),
                     )
-                    self.shared_prefix_hits += 1
+                    with self._cond:  # stats() reads this under the lock
+                        self.shared_prefix_hits += 1
                     self._prefix_hits_counter.inc()
                     cache = _splice_row_entries(self._cache, row, idx)
                 else:
@@ -662,7 +667,8 @@ class ContinuousEngine:
         self._gen[idx] += 1
         self._update_page_gauges()
         if mid_flight:
-            self.admitted_mid_flight += 1
+            with self._cond:  # stats() reads this under the lock
+                self.admitted_mid_flight += 1
         return True
 
     def _ensure_template(self) -> None:
@@ -672,7 +678,8 @@ class ContinuousEngine:
         these pages read-only; the boundary page copies on write."""
         if self._template_ids is not None:
             return
-        self._template_ids = np.zeros((0,), np.int32)  # default: no sharing
+        with self._cond:  # stats()/_reset_pool touch template state locked
+            self._template_ids = np.zeros((0,), np.int32)  # default: no sharing
         if not getattr(self.agent, "prefix_cache", True):
             return
         tpl = self.agent.prompt_template
@@ -687,12 +694,19 @@ class ContinuousEngine:
         if self._auto_sized and not self._template_capacity_added:
             # Grow the (still-empty) pool so the permanent template pages
             # don't eat the per-request margin the default sizing
-            # guarantees. Runs before any admission; one-time.
-            self.total_pages += n_pages
-            self._template_capacity_added = True
-            self._cache, self._free_pages = _parked_pool(
+            # guarantees. Runs before any admission; one-time. total_pages
+            # flips under the lock first (_init_pool sizes off it), the
+            # rebuild runs OUTSIDE the lock (device work), and the
+            # (cache, free list) pair swaps in under the lock so a
+            # concurrent stats() never sees a torn pair.
+            with self._cond:
+                self.total_pages += n_pages
+                self._template_capacity_added = True
+            cache, free = _parked_pool(
                 self._init_pool, self.n_slots, self.total_pages
             )
+            with self._cond:
+                self._cache, self._free_pages = cache, free
         # A user-sized pool must still be able to SERVE after the template
         # moves in permanently — including a max-context COLD request (no
         # template match gets no page discount). Otherwise sharing is a net
@@ -726,8 +740,9 @@ class ContinuousEngine:
         self._cache = row._replace(
             page_table=self._cache.page_table, lengths=self._cache.lengths
         )
-        self._template_pages = tpl_pages
-        self._template_ids = ids
+        with self._cond:  # stats() reads template state under the lock
+            self._template_pages = tpl_pages
+            self._template_ids = ids
 
     def _cow_copy(self, src: int, dst: int) -> None:
         """Copy physical page src → dst across all layers (donated, in
@@ -837,7 +852,8 @@ class ContinuousEngine:
             self._decode_fn, self._finished,
         )
         self._mask, self._finished = mask, fin
-        self.segments += 1
+        with self._cond:  # stats() reads this under the lock
+            self.segments += 1
         self.obs.segment_dispatched()
         # Bridge into the next segment unconditionally: rows that turn out
         # to have finished get frozen lengths (finished-aware bridge) and a
@@ -958,7 +974,8 @@ class ContinuousEngine:
                     break
 
             active = [i for i, s in enumerate(self._slots) if s.active]
-            self.max_concurrent = max(self.max_concurrent, len(active))
+            with self._cond:  # stats() reads this under the lock
+                self.max_concurrent = max(self.max_concurrent, len(active))
 
             # Depth-2 pipeline: dispatch the next segment BEFORE draining the
             # previous one — the fetch + bookkeeping below overlap with the
@@ -1260,7 +1277,8 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         self._gen[idx] += 1
         self._update_page_gauges()
         if mid_flight:
-            self.admitted_mid_flight += 1
+            with self._cond:  # stats() reads this under the lock
+                self.admitted_mid_flight += 1
         return True
 
     def _dispatch_segment(self, active: list[int], eos_id: int) -> _Inflight:
@@ -1284,7 +1302,8 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         (self._pending, self._cache, self._dcache, self._out, self._nemit,
          self._finished, self._mask, _, self._conf, self._acc, self._prop,
          self._rnds) = state
-        self.segments += 1
+        with self._cond:  # stats() reads this under the lock
+            self.segments += 1
         self.obs.segment_dispatched()
         # Detach every fetched handle from the state buffers: the NEXT
         # segment's _spec_rounds_donated donates the whole state, which

@@ -69,7 +69,11 @@ class GatewayServer(ThreadingHTTPServer):
         self.batcher = None
         self.max_inflight = 0  # 0 = unbounded; serve_rest overrides
         self.profile_dir = None  # opt-in /debug/profile target
-        self.profile_lock = threading.Lock()  # jax profiles cannot nest
+        # jax profiles cannot nest: the lock guards only the ACTIVE flag
+        # (edgelint EM303 — sleeping through the capture window while
+        # holding a lock would convoy every other /debug/profile thread).
+        self.profile_lock = threading.Lock()
+        self.profile_active = False
         self._inflight = 0
         self._inflight_cv = threading.Condition()
         self._draining = False
@@ -225,7 +229,17 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
             if not 0 < seconds <= 60:
                 self._send(400, {"error": "'seconds' must be in (0, 60]"})
                 return
-            if not self.server.profile_lock.acquire(blocking=False):
+            # One capture at a time, WITHOUT holding the lock through the
+            # capture window: the lock guards only the check-and-set of the
+            # active flag (EM303 — a lock held across the sleep would make
+            # every concurrent profile request convoy instead of 409ing).
+            with self.server.profile_lock:
+                busy = self.server.profile_active
+                if not busy:
+                    self.server.profile_active = True
+            if busy:
+                # Answer OUTSIDE the lock: _send is socket I/O, and a
+                # stalled client must not extend the critical section.
                 self._send(409, {"error": "a profile capture is already "
                                           "running"}, extra={"Retry-After": "1"})
                 return
@@ -240,7 +254,8 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 log.exception("profile capture failed")
                 self._send(500, {"error": str(exc)})
             finally:
-                self.server.profile_lock.release()
+                with self.server.profile_lock:
+                    self.server.profile_active = False
 
         def _stream(self, question: str):
             """SSE: one `data:` line per streamed item (text/event-stream).

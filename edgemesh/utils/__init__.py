@@ -1,5 +1,7 @@
 from edgemesh.utils.tracing import (  # noqa: F401
     JsonlLogger,
+    PhaseTimer,
+    Stopwatch,
     capture_profile,
     phase_report,
     reset_phases,

@@ -34,6 +34,40 @@ _phase_totals: dict[str, float] = defaultdict(float)
 _phase_counts: dict[str, int] = defaultdict(int)
 
 
+class PhaseTimer:
+    """Handle yielded by :func:`trace`: ``elapsed_s`` carries the region's
+    wall time once the block exits (0.0 while still inside). Lets callers
+    consume the SAME measurement the phase registry and the
+    ``edgemesh_phase_seconds`` histogram record, instead of re-deriving it
+    from raw clock reads (edgelint EM107)."""
+
+    __slots__ = ("name", "elapsed_s")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_s = 0.0
+
+
+class Stopwatch:
+    """Monotonic wall-clock stopwatch owned by the obs substrate — the
+    sanctioned way for ``serve/``/``runtime/`` code to measure an elapsed
+    window that is part of a RESULT payload (tokens/sec, stream
+    ``elapsed_s``) rather than a span (edgelint EM107 keeps raw
+    ``time.perf_counter`` reads out of the serving stack)."""
+
+    __slots__ = ("_t0",)
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+
+    def elapsed(self) -> float:
+        """Seconds since construction (or the last :meth:`restart`)."""
+        return time.perf_counter() - self._t0
+
+    def restart(self) -> None:
+        self._t0 = time.perf_counter()
+
+
 @contextmanager
 def trace(name: str):
     """Annotate a region for the JAX profiler AND accumulate its wall time
@@ -41,15 +75,18 @@ def trace(name: str):
     registry's ``edgemesh_phase_seconds`` histogram — trace() regions have
     no registry handle, so a ``serve_rest(registry=...)`` override renders
     phases only when it IS the process default; ``/stats``'s ``phases`` key
-    always carries them)."""
+    always carries them). Yields a :class:`PhaseTimer` whose ``elapsed_s``
+    is filled in on exit, so callers reuse the region's own measurement."""
     import jax
 
+    handle = PhaseTimer(name)
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         try:
-            yield
+            yield handle
         finally:
             dt = time.perf_counter() - t0
+            handle.elapsed_s = dt
             with _lock:
                 _phase_totals[name] += dt
                 _phase_counts[name] += 1

@@ -636,10 +636,142 @@ def test_cli_write_baseline_grandfathers(tmp_path):
     assert "1 baselined" in proc.stdout
 
 
+def test_cli_github_format_emits_workflow_annotations(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_EM104_SRC)
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(bad),
+         "--no-contracts", "--no-baseline", "--format", "github"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    line = proc.stdout.strip().splitlines()[0]
+    assert line.startswith("::warning file=")
+    assert ",line=" in line and "title=EM104" in line and "::parameter" in line
+
+
+def test_cli_stale_baseline_entry_is_warned_not_silently_masking(tmp_path):
+    # Grandfather a finding, then fix the code: the baseline entry is now
+    # stale and must be REPORTED (it would mask a future finding at that
+    # fingerprint), then removed by --prune-baseline.
+    bad = tmp_path / "bad.py"
+    bad.write_text(_EM104_SRC)
+    bl = tmp_path / "bl.json"
+    subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(bad),
+         "--no-contracts", "--baseline", str(bl), "--write-baseline"],
+        capture_output=True, text=True, timeout=120, check=True,
+    )
+    bad.write_text(_EM104_SRC.replace("len_cap", "len_cap2"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(bad),
+         "--no-contracts", "--baseline", str(bl)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "stale baseline entry" in proc.stderr
+    assert proc.returncode == 1  # the renamed finding is genuinely new
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(bad),
+         "--no-contracts", "--baseline", str(bl), "--prune-baseline"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "pruned 1 stale entry" in proc.stdout
+    assert json.loads(bl.read_text())["findings"] == []
+
+
+def test_cli_no_contracts_does_not_condemn_contract_baseline_entries(tmp_path):
+    # --no-contracts skips the EM2xx pass: a baselined contract finding for
+    # a linted file is ABSENT from the run, but that proves nothing — it
+    # must not be reported stale (or pruned) by a lint-only invocation.
+    target = tmp_path / "good.py"
+    target.write_text("def f(a):\n    return a\n")
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"findings": [{
+        "fingerprint": "deadbeefdeadbeef", "rule": "EM204",
+        "path": str(target), "context": "", "line_text": "x",
+    }]}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(target),
+         "--no-contracts", "--baseline", str(bl)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "stale baseline entry" not in proc.stderr
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(target),
+         "--no-contracts", "--baseline", str(bl), "--prune-baseline"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "pruned 0 stale entries" in proc.stdout
+    assert len(json.loads(bl.read_text())["findings"]) == 1
+
+
+def test_cli_prune_with_no_baseline_is_a_usage_error(tmp_path):
+    # --no-baseline empties the in-memory baseline; pruning against it
+    # would rewrite the file to nothing. Must refuse, not destroy.
+    bad = tmp_path / "bad.py"
+    bad.write_text(_EM104_SRC)
+    bl = tmp_path / "bl.json"
+    subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(bad),
+         "--no-contracts", "--baseline", str(bl), "--write-baseline"],
+        capture_output=True, text=True, timeout=120, check=True,
+    )
+    before = bl.read_text()
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(bad),
+         "--no-contracts", "--baseline", str(bl),
+         "--no-baseline", "--prune-baseline"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "--prune-baseline" in proc.stderr
+    assert bl.read_text() == before
+
+
+def test_cli_stale_baseline_missing_file_detected(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_EM104_SRC)
+    bl = tmp_path / "bl.json"
+    subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(bad),
+         "--no-contracts", "--baseline", str(bl), "--write-baseline"],
+        capture_output=True, text=True, timeout=120, check=True,
+    )
+    bad.unlink()
+    other = tmp_path / "good.py"
+    other.write_text("def f(a):\n    return a\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(other),
+         "--no-contracts", "--baseline", str(bl), "--format", "json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "file no longer exists" in proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["stale_baseline"][0]["reason"] == "file no longer exists"
+
+
+def test_cli_whole_package_gate_is_green():
+    """The tier-1 CI gate: `edgemesh lint` (AST + concurrency passes, no
+    contracts so no jax import) over the whole shipped package exits 0 —
+    any new rule regression or unbaselined finding fails the suite here."""
+    from pathlib import Path
+
+    pkg = Path(__file__).resolve().parent.parent / "edgemesh"
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", str(pkg), "--no-contracts"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
 def test_every_rule_has_metadata():
+    from edgemesh.analysis.concurrency import RULES as CONCURRENCY_RULES
     from edgemesh.analysis.contracts import CONTRACT_RULES
 
-    for table in (RULES, CONTRACT_RULES):
+    for table in (RULES, CONTRACT_RULES, CONCURRENCY_RULES):
         for rule, meta in table.items():
             assert meta["severity"] in ("error", "warning"), rule
             assert meta["name"] and meta["summary"], rule

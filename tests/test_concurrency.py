@@ -1,0 +1,588 @@
+"""edgemesh.analysis concurrency pass (EM301-EM304): one known-bad fixture
+per rule plus the negative (quiet) twin, the annotation vocabulary
+(``# guarded by:`` / ``# not shared``), inline disables, inheritance
+merging, and the shipped-tree-clean gate. Fast tier — pure AST, no jax."""
+
+from pathlib import Path
+
+from edgemesh.analysis.concurrency import RULES, analyze_source
+from edgemesh.analysis.edgelint import lint_paths, lint_source
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def em3(findings):
+    return [f for f in findings if f.rule.startswith("EM3")]
+
+
+# ---------------------------------------------------------------------------
+# EM301 unguarded-shared-state
+# ---------------------------------------------------------------------------
+
+_EM301_SRC = """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.segments = 0
+
+    def stats(self):
+        with self._lock:
+            return {"segments": self.segments}
+
+    def bump(self):
+        self.segments += 1
+"""
+
+
+def test_em301_fires_on_unlocked_mutation_of_inferred_guarded_field():
+    findings = analyze_source(_EM301_SRC, path="edgemesh/serve/x.py")
+    assert rules_of(findings) == {"EM301"}
+    f = findings[0]
+    assert f.severity == "error"
+    assert "segments" in f.message and "_lock" in f.message
+    assert f.context == "Engine.bump"
+
+
+def test_em301_quiet_when_mutation_is_under_the_lock():
+    src = _EM301_SRC.replace(
+        "        self.segments += 1",
+        "        with self._lock:\n            self.segments += 1",
+    )
+    assert analyze_source(src, path="edgemesh/serve/x.py") == []
+
+
+def test_em301_init_is_exempt_and_reads_do_not_fire():
+    # __init__ mutations are construction; unlocked READS are not flagged
+    # (the rule is about mutations racing locked readers).
+    src = _EM301_SRC.replace(
+        "        self.segments += 1", "        return self.segments"
+    )
+    assert analyze_source(src, path="edgemesh/serve/x.py") == []
+
+
+def test_em301_catches_mutator_method_calls():
+    src = """
+import threading
+
+class Q:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue = []
+
+    def drain(self):
+        with self._cond:
+            return list(self._queue)
+
+    def push(self, item):
+        self._queue.append(item)
+"""
+    findings = analyze_source(src, path="edgemesh/serve/x.py")
+    assert rules_of(findings) == {"EM301"}
+    assert "_queue" in findings[0].message
+
+
+def test_em301_not_shared_annotation_exempts_worker_owned_fields():
+    src = _EM301_SRC.replace(
+        "        self.segments = 0",
+        "        self.segments = 0  # not shared: worker-owned",
+    )
+    assert analyze_source(src, path="edgemesh/serve/x.py") == []
+
+
+def test_em301_guarded_by_on_def_line_marks_method_as_locked():
+    # The helper-called-with-the-lock-held pattern: assert the guard on the
+    # def line instead of re-acquiring (an RLock would mask the mistake).
+    src = _EM301_SRC.replace(
+        "    def bump(self):",
+        "    def bump(self):  # guarded by: _lock",
+    )
+    assert analyze_source(src, path="edgemesh/serve/x.py") == []
+
+
+def test_em301_guarded_by_declaration_fires_without_inference():
+    # No method ever touches the field under the lock — inference alone
+    # would stay silent — but the declared guard makes the contract checked.
+    src = """
+import threading
+
+class Counters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0  # guarded by: _lock
+
+    def bump(self):
+        self.total += 1
+"""
+    findings = analyze_source(src, path="edgemesh/serve/x.py")
+    assert rules_of(findings) == {"EM301"}
+    fixed = src.replace(
+        "        self.total += 1",
+        "        with self._lock:\n            self.total += 1",
+    )
+    assert analyze_source(fixed, path="edgemesh/serve/x.py") == []
+
+
+def test_em301_sees_through_same_module_inheritance():
+    # The speculative-engine shape: the base constructs the lock and reads
+    # the counter under it; the SUBCLASS mutates it unlocked.
+    src = _EM301_SRC + """
+
+class SpecEngine(Engine):
+    def dispatch(self):
+        self.segments += 1
+"""
+    findings = analyze_source(src, path="edgemesh/serve/x.py")
+    assert len(findings) == 2  # base bump + subclass dispatch
+    assert {f.context for f in findings} == {"Engine.bump", "SpecEngine.dispatch"}
+    assert any("SpecEngine.segments" in f.message for f in findings)
+
+
+def test_em301_dataclass_field_lock_is_discovered():
+    src = """
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+@dataclass
+class Agent:
+    _prefix_lock: Any = field(default_factory=threading.Lock)
+    _prefix: Any = None
+
+    def warm(self):
+        with self._prefix_lock:
+            return self._prefix
+
+    def clobber(self):
+        self._prefix = None
+"""
+    findings = analyze_source(src, path="edgemesh/agents/x.py")
+    assert rules_of(findings) == {"EM301"}
+
+
+def test_em301_tracks_linear_acquire_release():
+    # A with-block is not the only correct way to hold the lock.
+    src = _EM301_SRC.replace(
+        "        self.segments += 1",
+        "        self._lock.acquire()\n"
+        "        self.segments += 1\n"
+        "        self._lock.release()",
+    )
+    assert analyze_source(src, path="edgemesh/serve/x.py") == []
+
+
+def test_em301_inference_sees_acquire_release_readers():
+    # The READER uses the try/finally acquire idiom; the bare writer must
+    # still be caught — inference tracks linear regions too.
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def read(self):
+        self._lock.acquire()
+        try:
+            return self.count
+        finally:
+            self._lock.release()
+
+    def bump(self):
+        self.count += 1
+"""
+    findings = analyze_source(src, path="edgemesh/serve/x.py")
+    assert rules_of(findings) == {"EM301"}
+    assert findings[0].context == "C.bump"
+
+
+def test_em301_honors_inline_disable():
+    src = _EM301_SRC.replace(
+        "        self.segments += 1",
+        "        self.segments += 1  # edgelint: disable=EM301",
+    )
+    assert analyze_source(src, path="edgemesh/serve/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# EM302 lock-order-inversion
+# ---------------------------------------------------------------------------
+
+_EM302_SRC = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def two(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+"""
+
+
+def test_em302_fires_on_opposite_acquisition_orders():
+    findings = analyze_source(_EM302_SRC, path="edgemesh/fleet/x.py")
+    assert rules_of(findings) == {"EM302"}
+    f = findings[0]
+    assert f.severity == "error"
+    assert "_a_lock" in f.message and "_b_lock" in f.message
+
+
+def test_em302_quiet_on_consistent_order():
+    src = _EM302_SRC.replace(
+        "        with self._b_lock:\n            with self._a_lock:",
+        "        with self._a_lock:\n            with self._b_lock:",
+    )
+    assert analyze_source(src, path="edgemesh/fleet/x.py") == []
+
+
+def test_em302_sees_inversion_through_self_calls():
+    src = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def one(self):
+        with self._a_lock:
+            self._helper()
+
+    def _helper(self):
+        with self._b_lock:
+            pass
+
+    def two(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+"""
+    findings = analyze_source(src, path="edgemesh/fleet/x.py")
+    assert rules_of(findings) == {"EM302"}
+
+
+def test_em302_sees_linear_acquire_inversions():
+    # The try/finally acquire() idiom deadlocks just as well as with-blocks.
+    src = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def one(self):
+        self._a_lock.acquire()
+        try:
+            with self._b_lock:
+                pass
+        finally:
+            self._a_lock.release()
+
+    def two(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+"""
+    findings = analyze_source(src, path="edgemesh/fleet/x.py")
+    assert rules_of(findings) == {"EM302"}
+
+
+def test_em302_single_lock_class_is_quiet():
+    assert analyze_source(_EM301_SRC.replace(
+        "        self.segments += 1",
+        "        with self._lock:\n            self.segments += 1",
+    ), path="edgemesh/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# EM303 blocking-under-lock
+# ---------------------------------------------------------------------------
+
+_EM303_SRC = """
+import threading
+import time
+
+class Prober:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def probe(self, transport, url):
+        with self._lock:
+            status, body = transport.get_json(url, timeout_s=1.0)
+            time.sleep(0.1)
+        return status
+"""
+
+
+def test_em303_fires_on_transport_and_sleep_under_lock():
+    findings = analyze_source(_EM303_SRC, path="edgemesh/fleet/x.py")
+    assert [f.rule for f in findings] == ["EM303", "EM303"]
+    assert all(f.severity == "warning" for f in findings)
+    assert any(".get_json()" in f.message for f in findings)
+    assert any("time.sleep()" in f.message for f in findings)
+
+
+def test_em303_quiet_outside_the_lock():
+    src = """
+import threading
+import time
+
+class Prober:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def probe(self, transport, url):
+        status, body = transport.get_json(url, timeout_s=1.0)
+        with self._lock:
+            self.last = status
+        time.sleep(0.1)
+        return status
+"""
+    assert analyze_source(src, path="edgemesh/fleet/x.py") == []
+
+
+def test_em303_condition_wait_is_not_blocking_under_lock():
+    src = """
+import threading
+
+class W:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def wait_for_work(self):
+        with self._cond:
+            self._cond.wait()
+            self._cond.wait_for(lambda: True, timeout=1.0)
+"""
+    assert analyze_source(src, path="edgemesh/serve/x.py") == []
+
+
+def test_em303_queue_get_and_future_result_without_timeout():
+    src = """
+import threading
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def drain(self, q, fut):
+        with self._lock:
+            a = q.get()
+            b = fut.result()
+            c = q.get(timeout=1.0)
+            d = fut.result(1.0)
+        return a, b, c, d
+"""
+    findings = analyze_source(src, path="edgemesh/serve/x.py")
+    assert [f.rule for f in findings] == ["EM303", "EM303"]  # a and b only
+
+
+def test_em303_tracks_linear_acquire_release():
+    src = """
+import threading
+import time
+
+_lock = threading.Lock()
+
+def capture(seconds):
+    if not _lock.acquire(blocking=False):
+        return False
+    try:
+        time.sleep(seconds)
+    finally:
+        _lock.release()
+    return True
+"""
+    findings = analyze_source(src, path="edgemesh/serve/x.py")
+    assert rules_of(findings) == {"EM303"}
+
+
+def test_em303_semaphores_are_admission_tokens_not_locks():
+    # Sleeping while holding an in-flight SLOT is the router's design;
+    # only Lock/RLock/Condition (and lockish names) count.
+    src = """
+import threading
+import time
+
+class Router:
+    def __init__(self):
+        self._slots = threading.BoundedSemaphore(8)
+
+    def handle(self):
+        self._slots.acquire(blocking=False)
+        try:
+            time.sleep(0.01)
+        finally:
+            self._slots.release()
+"""
+    assert analyze_source(src, path="edgemesh/fleet/x.py") == []
+
+
+def test_em303_descends_self_calls_and_anchors_at_call_site():
+    src = """
+import threading
+import urllib.request
+
+class D:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def refresh(self):
+        with self._lock:
+            self._dial()
+
+    def _dial(self):
+        return urllib.request.urlopen("http://x", timeout=1.0)
+"""
+    findings = analyze_source(src, path="edgemesh/fleet/x.py")
+    assert rules_of(findings) == {"EM303"}
+    f = findings[0]
+    assert "via self._dial()" in f.message
+    assert f.context == "D.refresh"  # anchored at the locked call site
+
+
+def test_em303_honors_inline_disable():
+    src = _EM303_SRC.replace(
+        "            time.sleep(0.1)",
+        "            time.sleep(0.1)  # edgelint: disable=EM303",
+    ).replace(
+        "            status, body = transport.get_json(url, timeout_s=1.0)",
+        "            status, body = transport.get_json(url, timeout_s=1.0)  # edgelint: disable=EM303",
+    )
+    assert analyze_source(src, path="edgemesh/fleet/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# EM304 thread-hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_em304_thread_without_daemon_or_join():
+    src = (
+        "import threading\n"
+        "def start(fn):\n"
+        "    threading.Thread(target=fn).start()\n"
+    )
+    findings = analyze_source(src, path="edgemesh/serve/x.py")
+    assert rules_of(findings) == {"EM304"}
+    assert findings[0].severity == "warning"
+    assert "shutdown path" in findings[0].message
+
+
+def test_em304_daemon_or_joined_threads_are_quiet():
+    daemon = (
+        "import threading\n"
+        "def start(fn):\n"
+        "    threading.Thread(target=fn, daemon=True).start()\n"
+    )
+    assert analyze_source(daemon, path="edgemesh/serve/x.py") == []
+    joined = (
+        "import threading\n"
+        "def run(fn):\n"
+        "    t = threading.Thread(target=fn)\n"
+        "    t.start()\n"
+        "    t.join(timeout=5)\n"
+    )
+    assert analyze_source(joined, path="edgemesh/serve/x.py") == []
+    annotated = (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self, fn):\n"
+        "        self._t: threading.Thread = threading.Thread(target=fn)\n"
+        "    def close(self):\n"
+        "        self._t.join(timeout=5)\n"
+    )
+    assert analyze_source(annotated, path="edgemesh/serve/x.py") == []
+
+
+def test_em304_swallowing_worker_loop():
+    src = """
+import threading
+
+def _loop():
+    while True:
+        try:
+            work()
+        except Exception:
+            pass
+
+def start():
+    threading.Thread(target=_loop, daemon=True).start()
+"""
+    findings = analyze_source(src, path="edgemesh/fleet/x.py")
+    assert rules_of(findings) == {"EM304"}
+    assert "swallows" in findings[0].message
+
+
+def test_em304_logging_handler_is_quiet():
+    src = """
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+def _loop():
+    while True:
+        try:
+            work()
+        except Exception:
+            log.exception("pass failed")
+
+def start():
+    threading.Thread(target=_loop, daemon=True).start()
+"""
+    assert analyze_source(src, path="edgemesh/fleet/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Integration: the shared lint entry points + the shipped tree
+# ---------------------------------------------------------------------------
+
+
+def test_lint_source_includes_concurrency_findings():
+    # The EM3xx pass rides every edgelint entry point (CLI, repo gate).
+    findings = lint_source(_EM301_SRC, path="edgemesh/serve/x.py")
+    assert "EM301" in rules_of(findings)
+
+
+def test_em3xx_findings_fingerprint_and_baseline_like_any_other():
+    from edgemesh.analysis.findings import Baseline
+
+    findings = analyze_source(_EM301_SRC, path="edgemesh/serve/x.py")
+    baseline = Baseline.from_findings(findings)
+    shifted = analyze_source("\n\n\n" + _EM301_SRC, path="edgemesh/serve/x.py")
+    assert baseline.filter(shifted) == []
+
+
+def test_shipped_tree_has_zero_unbaselined_em3xx():
+    """The serving stack must stay concurrency-clean: zero unbaselined
+    EM301-EM304 findings across edgemesh/ (this PR fixed the real ones
+    rather than baselining them — fleet/serve hold the reference
+    discipline)."""
+    from edgemesh.analysis.findings import Baseline, default_baseline_path
+
+    pkg = Path(__file__).resolve().parent.parent / "edgemesh"
+    fresh = Baseline.load(default_baseline_path()).filter(lint_paths([pkg]))
+    bad = em3(fresh)
+    assert bad == [], [f.render() for f in bad]
+
+
+def test_every_concurrency_rule_has_metadata():
+    for rule, meta in RULES.items():
+        assert rule.startswith("EM3"), rule
+        assert meta["severity"] in ("error", "warning"), rule
+        assert meta["name"] and meta["summary"], rule
