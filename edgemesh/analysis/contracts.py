@@ -394,6 +394,18 @@ def _paged_args(good: bool):
     return (q, pages, table, kv_lens)
 
 
+def _ragged_args(good: bool):
+    import jax.numpy as jnp
+
+    q = jnp.ones((4, 1, 8), jnp.float32)
+    pages = jnp.ones((4, 1, 8, 8), jnp.float32)
+    table = jnp.array([[0, 1], [2, 3]], jnp.int32)
+    kv_lens = jnp.array([5, 6], jnp.int32)
+    # bad: segments claim more packed rows than q carries
+    cu = jnp.array([0, 1, 4 if good else 9], jnp.int32)
+    return (q, pages, table, kv_lens, cu)
+
+
 def _int8_args(good: bool):
     import jax.numpy as jnp
 
@@ -417,6 +429,11 @@ CHECK_CONTRACTS: list[dict] = [
         "kernel": ("edgemesh.ops.paged_attention", "paged_decode_attention"),
         "checker": "check_paged_inputs",
         "args": _paged_args,
+    },
+    {
+        "kernel": ("edgemesh.ops.paged_attention", "ragged_paged_attention"),
+        "checker": "check_ragged_inputs",
+        "args": _ragged_args,
     },
     {
         "kernel": ("edgemesh.ops.int8", "int8_matmul_fused"),

@@ -48,6 +48,17 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   the distributed trace at exactly the hop tracing exists to explain.
   Calls with no ``headers=`` at all (probes, drain admin) are out of
   scope, as are opaque header variables the linter cannot see into.
+- EM110 serve-per-row-dispatch (error): a HOST loop in
+  ``edgemesh/serve/`` that calls a jitted forward per iteration — a name
+  imported from edgemesh.runtime/models matching ``forward_*``/
+  ``generate*``/``_decode_loop``/``_spec_rounds``, a local ``jax.jit``
+  binding, or a jit-decorated def. Per-row dispatch is exactly the wave
+  structure the ragged boundary launch (forward_ragged_paged) deleted:
+  one launch serves admission prefill and resident decode together, and
+  a Python loop re-introducing per-segment dispatches must not creep
+  back. Loops inside traced code are EM105's beat; method-call
+  indirection (``self._admit``) is out of scope by design — the retained
+  segmented ablation path dispatches through it.
 
 The class-level concurrency rules (EM301-EM304: lock discipline,
 lock-order cycles, blocking-under-lock, thread hygiene) live in
@@ -111,6 +122,11 @@ RULES: dict[str, dict] = {
         "name": "fleet-missing-trace-propagation",
         "severity": "error",
         "summary": "outbound fleet HTTP call builds headers without the X-Edgemesh-Trace header",
+    },
+    "EM110": {
+        "name": "serve-per-row-dispatch",
+        "severity": "error",
+        "summary": "host loop in edgemesh/serve/ dispatches a jitted forward per iteration",
     },
 }
 
@@ -178,6 +194,15 @@ _EM108_CALLS = {
     "requests.post": None,
     "requests.request": None,
 }
+
+# EM110 scope + dispatch surface: host loops in the serving engine must not
+# re-grow per-row jitted dispatches (the pre-ragged wave structure). A name
+# counts as a jitted forward when imported from an edgemesh module with one
+# of these shapes, locally bound to a jax.jit expression, or defined under a
+# jit decorator in the same file.
+_EM110_DIRS = ("edgemesh/serve/",)
+_EM110_IMPORT_PREFIXES = ("forward_", "generate")
+_EM110_IMPORT_EXTRA = {"_decode_loop", "_spec_rounds"}
 
 
 # ---------------------------------------------------------------------------
@@ -422,6 +447,7 @@ class _FileLinter:
         self._rule_raw_timing(tree)
         self._rule_fleet_timeout(tree)
         self._rule_fleet_trace(tree)
+        self._rule_serve_row_dispatch(tree)
         # Traced ROOTS only: their walkers descend into traced nested defs,
         # so running every traced def would double-report nested call sites.
         traced_roots = [
@@ -618,6 +644,65 @@ class _FileLinter:
                 "hop (add httputil.TRACE_HEADER: ctx.to_header(), or "
                 "forward the incoming headers)",
             )
+
+    # -- EM110 -------------------------------------------------------------
+
+    def _rule_serve_row_dispatch(self, tree: ast.Module) -> None:
+        if not any(d in self.relpath for d in _EM110_DIRS):
+            return
+        jitted: set[str] = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.ImportFrom)
+                and node.module
+                and node.module.startswith("edgemesh.")
+            ):
+                for a in node.names:
+                    if (
+                        a.name.startswith(_EM110_IMPORT_PREFIXES)
+                        or a.name in _EM110_IMPORT_EXTRA
+                    ):
+                        jitted.add(a.asname or a.name)
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                # name = jax.jit(f) / partial(jax.jit, ...)(f)
+                if _is_jit_expr(node.value.func, self.aliases):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            jitted.add(t.id)
+        for fn in self._all_defs:
+            if fn in self.jit_decorated:
+                jitted.add(fn.name)
+        if not jitted:
+            return
+        loop_types = (
+            ast.For, ast.While, ast.ListComp, ast.SetComp, ast.GeneratorExp,
+            ast.DictComp,
+        )
+        for loop in ast.walk(tree):
+            if not isinstance(loop, loop_types):
+                continue
+            # Loops inside traced code unroll — that is EM105's beat, not a
+            # host-side dispatch-per-row problem.
+            if any(
+                d in self.traced
+                and d.lineno <= loop.lineno <= getattr(d, "end_lineno", d.lineno)
+                for d in self._all_defs
+            ):
+                continue
+            for sub in ast.walk(loop):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id in jitted
+                ):
+                    self._emit(
+                        "EM110", sub,
+                        f"jitted forward {sub.func.id!r} dispatched per loop "
+                        "iteration in serve/ — per-row dispatch is the wave "
+                        "structure the ragged boundary launch removed; batch "
+                        "the rows into ONE forward_ragged_paged launch (or "
+                        "suppress for a deliberate ablation path)",
+                    )
 
     # -- EM102 -------------------------------------------------------------
 

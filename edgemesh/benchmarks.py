@@ -141,7 +141,8 @@ def _e2e_latency(r: dict) -> float:
     return r["t_end"] - r["t_start"] + r["queue_s"]
 
 
-def _run_waves(eng, n_requests: int, waves: int, budgets=None, label: str = "serving"):
+def _run_waves(eng, n_requests: int, waves: int, budgets=None, label: str = "serving",
+               question: str | None = None):
     """The round-4 variance protocol, in ONE place for every serving-style
     benchmark: warm ONE request in the SAME prompt-length bucket as the
     timed requests (admission prefill compiles per bucket; a fresh compile
@@ -149,10 +150,27 @@ def _run_waves(eng, n_requests: int, waves: int, budgets=None, label: str = "ser
     admission), then run ``waves`` independent bursts and report per-wave
     aggregate tok/s. ``budgets`` cycles per-request ``max_new`` caps (the
     mixed admission workload); None submits at the uniform engine budget.
-    Returns (wave_tok_s, [(budget, result)], wall_all, warmup stats)."""
+    ``question`` overrides the wave prompt template (must keep the fixed
+    3-digit index so every request stays in one length bucket) — the ragged
+    ablation's prefill-heavy shape pads it. Returns (wave_tok_s,
+    [(budget, result)], wall_all, warmup stats)."""
+    question = question or _WAVE_QUESTION
     _progress(f"{label}: warmup compile")
-    eng.answer(_WAVE_QUESTION.format(i=999),
-               max_new=min(budgets) if budgets else None)
+    # Ragged engines compile the boundary launch per packed-capacity rung
+    # (a doubling ladder keyed on how many admissions share the launch) —
+    # warm the rungs a wave will actually hit (single admission, half
+    # batch, full batch) so no rung compiles mid-measurement. Segmented /
+    # dense engines compile per prompt bucket only: one request suffices.
+    sizes = [1]
+    if getattr(eng, "_ragged", False):
+        sizes = sorted({1, max(2, eng.n_slots // 2), eng.n_slots})
+    for n in sizes:
+        futs = [
+            eng.submit(question.format(i=900 + j),
+                       max_new=min(budgets) if budgets else None)
+            for j in range(n)
+        ]
+        [f.result() for f in futs]
     warm_stats = eng.stats()
     wave_tok_s: list[float] = []
     results: list[tuple] = []
@@ -162,7 +180,7 @@ def _run_waves(eng, n_requests: int, waves: int, budgets=None, label: str = "ser
         t0 = time.perf_counter()
         futs = []
         for i in range(n_requests):
-            q = _WAVE_QUESTION.format(i=w * n_requests + i)
+            q = question.format(i=w * n_requests + i)
             b = budgets[i % len(budgets)] if budgets else None
             futs.append((b, eng.submit(q, max_new=b)))
         wave = [(b, f.result()) for b, f in futs]
@@ -183,12 +201,22 @@ def serving_benchmark(
     max_new: int = 64,
     built: tuple | None = None,
     waves: int = 3,
+    ragged: bool | None = None,
+    prompt_pad: int = 0,
+    budgets: tuple[int, ...] | None = None,
 ) -> dict[str, Any]:
     """Continuous-batching serving throughput (serve/continuous.py): N
     concurrent requests stream through the resident decode loop; reports
     aggregate generated tok/s, completed requests/s, and end-to-end request
     latency percentiles (queue + decode). The reference has no serving path
     at all — its fabric never carried model traffic (SURVEY.md §2.3).
+
+    ``ragged`` passes through to the engine (None = the engine default:
+    ragged boundary launches on paged backends; False = the segmented
+    per-request-prefill arm — the ragged ablation's baseline).
+    ``prompt_pad`` appends that many filler characters to every question
+    (one fixed bucket — the prefill-heavy batch shape); ``budgets`` cycles
+    per-request max_new caps (the 50/50 mixed shape).
 
     Variance protocol (round 4): the round-3 single 24-request burst swung
     ±40% run to run — too noisy to gate optimizations. Now ``waves``
@@ -220,13 +248,17 @@ def serving_benchmark(
     from edgemesh.obs import Registry
 
     eng = ContinuousEngine(agent, slots=slots, chunk=chunk,
-                           kv_backend=kv_backend, registry=Registry())
+                           kv_backend=kv_backend, registry=Registry(),
+                           ragged=ragged)
     try:
         import numpy as np
 
+        question = _WAVE_QUESTION + ("x" * prompt_pad if prompt_pad else "")
         wave_tok_s, tagged, wall_all, warm_stats = _run_waves(
-            eng, n_requests, waves,
-            label=f"serving/{kv_backend} slots={slots}",
+            eng, n_requests, waves, budgets=list(budgets) if budgets else None,
+            label=f"serving/{kv_backend} slots={slots}"
+            + (" ragged" if getattr(eng, "_ragged", False) else ""),
+            question=question,
         )
         results = [r for _, r in tagged]
         generated = sum(r["generated"] for r in results)
@@ -236,10 +268,14 @@ def serving_benchmark(
             (max(wave_tok_s) - min(wave_tok_s)) / tok_s if tok_s else 0.0
         )
         # Engine counters accumulate from start; report the timed window's
-        # delta so the warmup request doesn't skew the diagnosis keys.
+        # delta so the warmup requests (up to three rungs' worth on ragged
+        # engines) don't skew the diagnosis keys.
         stats = eng.stats()
-        for k in ("requests", "segments", "admitted_mid_flight"):
-            stats[k] -= warm_stats[k]
+        for k in ("requests", "segments", "admitted_mid_flight",
+                  "ragged_boundaries", "ragged_prefill_tokens",
+                  "ragged_decode_tokens"):
+            if k in stats:
+                stats[k] -= warm_stats.get(k, 0)
         _progress(
             f"serving/{kv_backend}: median {tok_s:.1f} tok/s over {waves} "
             f"waves (spread {100 * spread:.0f}%), "
@@ -263,6 +299,69 @@ def serving_benchmark(
         }
     finally:
         eng.close()
+
+
+def ragged_ablation_benchmark(
+    preset: str | None = None,
+    precision: str = "int8",
+    quant_mode: str = "w8a16",
+    slots: int = 8,
+    chunk: int = 32,
+    built: tuple | None = None,
+    waves: int = 2,
+    n_requests: int = 24,
+) -> dict[str, Any]:
+    """Ragged-vs-segmented serving A/B across batch shapes (the ablation
+    for ops/paged_attention.ragged_paged_attention): the SAME engine and
+    workload, with only the boundary structure toggled — ``ragged=True``
+    runs admission prefill + resident decode as ONE launch per segment
+    boundary, ``ragged=False`` keeps the per-request donated prefills plus
+    the trailing bridge (the pre-ragged wave structure).
+
+    Three shapes bracket the mixing regimes:
+    - ``decode_heavy``: short prompts, long budgets — admissions are rare,
+      boundaries are almost pure bridge steps.
+    - ``prefill_heavy``: padded prompts, tiny budgets — requests churn, so
+      nearly every boundary carries admission chunks.
+    - ``mixed_50_50``: budgets cycle (8, 96) — half the requests retire
+      quickly and back-fill, so prefill chunks and resident decode rows
+      genuinely share launches.
+
+    Keys: ``serving_{ragged|segmented}_{shape}_tok_s`` plus the
+    ``ragged_over_segmented_{shape}`` ratio (the PERFORMANCE.md pin:
+    >= 1.0 at every shape)."""
+    preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
+    if built is None:
+        built = _build(preset, precision, quant_mode)
+    # The prefill-heavy pad scales with the model context so small presets
+    # (tiny: 512) keep decode room after the engine's overshoot margin.
+    pad = min(600, int(built[0].max_seq_len) // 4)
+    shapes: dict[str, dict[str, Any]] = {
+        "decode_heavy": dict(max_new=96, prompt_pad=0),
+        "prefill_heavy": dict(max_new=8, prompt_pad=pad),
+        "mixed_50_50": dict(max_new=96, budgets=(8, 96)),
+    }
+    out: dict[str, Any] = {"slots": slots, "chunk": chunk, "waves": waves}
+    for shape, kw in shapes.items():
+        for arm, ragged in (("ragged", True), ("segmented", False)):
+            r = serving_benchmark(
+                preset, precision, quant_mode, slots=slots, chunk=chunk,
+                kv_backend="paged", n_requests=n_requests, built=built,
+                waves=waves, ragged=ragged, **kw,
+            )
+            out[f"serving_{arm}_{shape}_tok_s"] = r["value"]
+            if ragged:
+                out[f"serving_ragged_{shape}_latency_s_p50"] = r["latency_s_p50"]
+        seg = out[f"serving_segmented_{shape}_tok_s"]
+        out[f"ragged_over_segmented_{shape}"] = (
+            round(out[f"serving_ragged_{shape}_tok_s"] / seg, 3) if seg else 0.0
+        )
+        _progress(
+            f"ragged-ablation/{shape}: ragged "
+            f"{out[f'serving_ragged_{shape}_tok_s']} vs segmented {seg} tok/s "
+            f"(x{out[f'ragged_over_segmented_{shape}']})"
+        )
+    return out
 
 
 def admission_policy_benchmark(
@@ -757,11 +856,24 @@ def speculative_benchmark(
     built: tuple | None = None,
 ) -> dict[str, Any]:
     """Speculative vs plain decode at batch 1 (the latency regime speculative
-    decoding targets). The draft is a depth-truncated random-init copy —
-    with RANDOM weights draft/target agreement is near-chance, so the
-    measured speedup is a LOWER bound and the acceptance rate is reported
-    for context (trained draft/target pairs accept far more). On by default
-    in the headline since round 4 (EDGEMESH_BENCH_SPEC=0 skips).
+    decoding targets). On by default in the headline since round 4
+    (EDGEMESH_BENCH_SPEC=0 skips).
+
+    Draft construction (the BENCH_r05 ``spec_accept_rate: 0.0`` fix): the
+    draft is the TARGET truncated to its first ``d_layers`` layers —
+    embeddings, norms, and LM head SHARED. The r05 arm built the draft as
+    an UNRELATED random init; at a 128k vocab two independent random
+    models' top-k candidate sets are essentially disjoint, so the Leviathan
+    accept test (target prob of the draft token on the target's candidate
+    support) was 0 for every proposal and the arm measured pure
+    draft-overhead — the accept-path wiring itself was never wrong
+    (draft==target accepts 100%, pinned in tests/test_spec_accept.py).
+    Truncation keeps draft and target in one representation space (the
+    early-exit-draft construction trained pairs approximate), so the
+    measured speedup is a meaningful lower bound; a ``selfcheck`` arm runs
+    draft==target for a few steps and reports its acceptance so the
+    artifact itself distinguishes "machinery broken" (selfcheck < 1) from
+    "draft weak" (accept low, selfcheck 1.0).
 
     ``kv_backend="paged_int8"`` runs BOTH arms over int8 page pools (plain =
     generate_paged kv_quant; spec = int8 target+draft pools) — the memory
@@ -773,7 +885,10 @@ def speculative_benchmark(
     cfg, params = built if built is not None else _build(preset, "bf16", "w8a16")
     d_layers = max(1, int(cfg.num_layers * draft_layers_frac))
     d_cfg = cfg.replace(num_layers=d_layers)
-    d_params = init_params(d_cfg, jax.random.PRNGKey(7))
+    d_params = {
+        **params,
+        "layers": jax.tree.map(lambda x: x[:d_layers], params["layers"]),
+    }
     sampling = SamplingParams(
         max_new_tokens=decode_steps, temperature=0.7, top_k=50, top_p=0.9,
         repetition_penalty=1.2, do_sample=True,
@@ -806,15 +921,28 @@ def speculative_benchmark(
     plain_best = plain.decode_tok_s
     for _ in range(2):
         plain_best = max(plain_best, plain_once().decode_tok_s)
+    # Selfcheck arm: draft==target for a few rounds. Acceptance here is the
+    # accept-path's own health (must be ~1.0); the throughput is discarded.
+    _, self_stats = generate_speculative(
+        cfg, params, cfg, params, tokens, lengths,
+        SamplingParams(
+            max_new_tokens=min(16, decode_steps), temperature=0.7, top_k=50,
+            top_p=0.9, repetition_penalty=1.2, do_sample=True,
+        ),
+        gamma, kv_backend=kv_backend,
+    )
     _progress(f"spec/{kv_backend} {best_spec:.1f} vs plain {plain_best:.1f} "
-              f"tok/s, accept {stats.accept_rate:.2f}")
+              f"tok/s, accept {stats.accept_rate:.2f} "
+              f"(selfcheck {self_stats.accept_rate:.2f})")
     return {
         "spec_tok_s": round(best_spec, 2),
         "plain_tok_s": round(plain_best, 2),
         "spec_speedup": round(best_spec / plain_best, 3) if plain_best else 0.0,
         "accept_rate": round(stats.accept_rate, 3),
+        "selfcheck_accept_rate": round(self_stats.accept_rate, 3),
         "gamma": gamma,
         "draft_layers": d_layers,
+        "draft_mode": "truncate",
         "kv_backend": kv_backend,
     }
 
@@ -986,11 +1114,24 @@ def headline_benchmark(
     def _serving():
         r = serving_benchmark(preset, built=int8_built, kv_backend="paged")
         out["serving_paged_tok_s"] = r["value"]
+        # The engine default is ragged boundary launches now, so the paged
+        # headline IS the ragged number; the explicit key is what
+        # PERFORMANCE.md and the ablation stage reference.
+        out["serving_ragged_tok_s"] = r["value"]
+        out["serving_ragged_boundaries"] = r["stats"].get("ragged_boundaries", 0)
+        out["serving_ragged_prefill_tokens"] = r["stats"].get("ragged_prefill_tokens", 0)
+        out["serving_ragged_decode_tokens"] = r["stats"].get("ragged_decode_tokens", 0)
         out["serving_wave_tok_s"] = r["wave_tok_s"]
         out["serving_spread_pct"] = r["spread_pct"]
         out["serving_paged_req_s"] = r["req_s"]
         out["serving_latency_s_p50"] = r["latency_s_p50"]
         out["serving_latency_s_p95"] = r["latency_s_p95"]
+        emit_partial(out)
+        # Segmented baseline at the same shape: the headline's own
+        # ragged-vs-segmented pin (the full shape sweep is stage 7c).
+        r_seg = serving_benchmark(preset, built=int8_built, kv_backend="paged",
+                                  ragged=False)
+        out["serving_segmented_tok_s"] = r_seg["value"]
         # Diagnosis keys: segments/concurrency separate engine anomalies
         # from device slowness without rerunning (r3's first measurement
         # was 15x slow from per-token host readbacks in the retire path —
@@ -1014,6 +1155,22 @@ def headline_benchmark(
 
     if os.environ.get("EDGEMESH_BENCH_SERVE", "1") == "1":
         _stage("serving", _serving)
+
+    # ---- Stage 7c: ragged-vs-segmented batch-shape sweep (decode-heavy /
+    # prefill-heavy / 50-50) — the ablation pinning paged >= dense at every
+    # batch shape via the ragged boundary launch. EDGEMESH_BENCH_RAGGED=0
+    # skips.
+    def _ragged():
+        r = ragged_ablation_benchmark(preset, built=int8_built)
+        for k, v in r.items():
+            if k.startswith(("serving_", "ragged_over_")):
+                out[k] = v
+
+    if (
+        os.environ.get("EDGEMESH_BENCH_RAGGED", "1") == "1"
+        and os.environ.get("EDGEMESH_BENCH_SERVE", "1") == "1"
+    ):
+        _stage("ragged_ablation", _ragged)
 
     # ---- Stage 7b: admission-policy A/B on a mixed-budget wave — FIFO vs
     # SJF end-to-end latency at matched throughput (docs/SERVING.md SLO
@@ -1044,6 +1201,8 @@ def headline_benchmark(
         out["spec_plain_b1_tok_s"] = r["plain_tok_s"]
         out["spec_speedup"] = r["spec_speedup"]
         out["spec_accept_rate"] = r["accept_rate"]
+        out["spec_selfcheck_accept_rate"] = r["selfcheck_accept_rate"]
+        out["spec_draft_mode"] = r["draft_mode"]
         out["spec_gamma"] = r["gamma"]
         emit_partial(out)
         # Composed cell: speculative over int8 page pools (both arms int8).

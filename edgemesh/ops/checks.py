@@ -87,6 +87,45 @@ def check_paged_inputs(q, k_pages, page_table, kv_lens) -> None:
     )
 
 
+def check_ragged_inputs(q, k_pages, page_table, kv_lens, cu_q_lens) -> None:
+    total_pages = k_pages.shape[0]
+    page_size = k_pages.shape[2]
+    max_tokens = page_table.shape[1] * page_size
+    q_lens = cu_q_lens[1:] - cu_q_lens[:-1]
+    checkify.check(
+        jnp.all((page_table >= 0) & (page_table < total_pages)),
+        "ragged_paged_attention: page-table entry outside the {n}-page "
+        "physical pool (the DMA would fetch unrelated memory)",
+        n=jnp.int32(total_pages),
+    )
+    # kv_len 0 is legal here (zero-length rows ride masked-dead, per the
+    # kernel contract) — only the capacity bound and negatives are errors.
+    checkify.check(
+        jnp.all((kv_lens >= 0) & (kv_lens <= max_tokens)),
+        "ragged_paged_attention: kv_lens outside [0, {m}] (table capacity)",
+        m=jnp.int32(max_tokens),
+    )
+    checkify.check(
+        jnp.all(q_lens >= 0) & (cu_q_lens[0] == 0),
+        "ragged_paged_attention: cu_q_lens must be non-decreasing from 0",
+    )
+    checkify.check(
+        cu_q_lens[-1] <= q.shape[0],
+        "ragged_paged_attention: cu_q_lens[-1] exceeds the packed query "
+        "rows {t} (segments would read other sequences' queries)",
+        t=jnp.int32(q.shape[0]),
+    )
+    checkify.check(
+        jnp.all(q_lens <= kv_lens),
+        "ragged_paged_attention: a segment's query count exceeds its kv_len "
+        "(queries would sit at negative positions)",
+    )
+    checkify.check(
+        jnp.all(jnp.isfinite(q.astype(jnp.float32))),
+        "ragged_paged_attention: non-finite query activations",
+    )
+
+
 def check_int8_inputs(x, w_q, scales) -> None:
     checkify.check(
         jnp.all(jnp.isfinite(scales) & (scales > 0)),

@@ -528,6 +528,417 @@ def paged_chunk_attention(
     return out.transpose(0, 2, 1, 3, 4).reshape(b, cq, nh, hd)
 
 
+# ---------------------------------------------------------------------------
+# Ragged paged attention: one launch for a mixed prefill+decode batch
+# ---------------------------------------------------------------------------
+
+# Query tokens per ragged block. Each packed segment is padded (internally —
+# callers pass real cu_q_lens) to a multiple of this, so every block's rows
+# belong to exactly ONE sequence and the Q/O BlockSpecs stay identity maps.
+# 8 matches the sublane tile and the decode kernel's virtual-page width.
+_RAGGED_BQT = 8
+
+
+def _ragged_kernel(
+    *refs,  # table, len, qlen, acu, blkseq, [layer,] (scalar prefetch) then
+    # q, k, v, [ks, vs,] [fk, fv, [fks, fvs],] o, m, l, acc
+    n_scalars: int,
+    page_size: int,
+    scale: float,
+    window: int,
+    soft_cap: float,
+    kv_heads: int,
+    groups: int,
+    npages: int,
+    nseq: int,
+    quantized: bool,
+    fold_fresh: bool,
+):
+    # q_ref   VMEM [1, kh, rq, hd] — rq = BQT*groups rows; row r is the
+    #         block's token r // groups (same convention as the chunk kernel)
+    # k_ref   VMEM [1, kh, ps, hd] — physical page table[seq, p], all kv heads
+    # fk_ref  VMEM [1, kh, BQT, hd] — one PACKED BLOCK of the chunk's own K,
+    #         not yet in any page (fresh axis of the grid; fold_fresh mode)
+    # o_ref   VMEM [1, kh, rq, hd]
+    # scratch VMEM [kh*rq, 128] f32 ×2 (m, l) + [kh*rq, hd] f32 (acc)
+    bqt = _RAGGED_BQT
+    refs = list(refs)
+    table_ref, len_ref, qlen_ref, acu_ref, blkseq_ref = refs[:5]
+    refs = refs[n_scalars:]
+    q_ref, k_ref, v_ref = refs[:3]
+    refs = refs[3:]
+    ks_ref = vs_ref = fk_ref = fv_ref = fks_ref = fvs_ref = None
+    if quantized:
+        ks_ref, vs_ref = refs[:2]
+        refs = refs[2:]
+    if fold_fresh:
+        fk_ref, fv_ref = refs[:2]
+        refs = refs[2:]
+        if quantized:
+            fks_ref, fvs_ref = refs[:2]
+            refs = refs[2:]
+    o_ref, m_scr, l_scr, acc_scr = refs
+    g = pl.program_id(0)
+    p = pl.program_id(1)
+    npg = pl.num_programs(1)
+    rq = bqt * groups
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    seq = blkseq_ref[g]
+    kvlen = len_ref[seq]
+    qlen = qlen_ref[seq]
+    qstart = kvlen - qlen  # tokens committed to pages before this chunk
+    tok0 = g * bqt - acu_ref[seq]  # block's first token index in its segment
+    live_blk = g * bqt < acu_ref[nseq]
+    # Page columns visible from the table walk: the committed prefix only in
+    # fold_fresh mode (the chunk itself rides the fresh axis), the full
+    # causal prefix when the chunk is already written to its pages.
+    limit = qstart if fold_fresh else kvlen
+
+    # Per-row segment-token index / absolute position (row r = token r//groups).
+    tseg1 = tok0 + jax.lax.broadcasted_iota(jnp.int32, (rq, 1), 0) // groups
+
+    @pl.when(live_blk & (p < npages) & (p * page_size < limit))
+    def _pages():
+        col = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rq, page_size), 1
+        )
+        pos = qstart + tseg1  # [rq, 1]
+        mask = (tseg1 < qlen) & (
+            col < limit if fold_fresh else col <= jnp.minimum(pos, kvlen - 1)
+        )
+        if window > 0:
+            mask = jnp.logical_and(mask, col > pos - window)
+        for h in range(kv_heads):
+            _flash_page_update(
+                q_ref[0, h], k_ref[0, h], v_ref[0, h], mask, scale, soft_cap,
+                m_scr, l_scr, acc_scr, slice(h * rq, (h + 1) * rq), rq,
+                ks_row=ks_ref[0, h] if quantized else None,
+                vs_row=vs_ref[0, h] if quantized else None,
+            )
+
+    if fold_fresh:
+        f = p - npages
+        fsame = blkseq_ref[jnp.clip(f, 0, pl.num_programs(0) - 1)] == seq
+
+        @pl.when(live_blk & (p >= npages) & (f <= g) & fsame)
+        def _fresh():
+            # Key token index within the segment for each fresh-block slot.
+            kseg = f * bqt - acu_ref[seq] + jax.lax.broadcasted_iota(
+                jnp.int32, (rq, bqt), 1
+            )
+            mask = (tseg1 < qlen) & (kseg >= 0) & (kseg < qlen) & (kseg <= tseg1)
+            if window > 0:
+                mask = jnp.logical_and(mask, kseg > tseg1 - window)
+            for h in range(kv_heads):
+                _flash_page_update(
+                    q_ref[0, h], fk_ref[0, h], fv_ref[0, h], mask, scale,
+                    soft_cap, m_scr, l_scr, acc_scr,
+                    slice(h * rq, (h + 1) * rq), rq,
+                    ks_row=fks_ref[0, h] if quantized else None,
+                    vs_row=fvs_ref[0, h] if quantized else None,
+                )
+
+    @pl.when(p == npg - 1)
+    def _finish():
+        for h in range(kv_heads):
+            rows = slice(h * rq, (h + 1) * rq)
+            out = acc_scr[rows, :] / jnp.maximum(l_scr[rows, :1], 1e-30)
+            o_ref[0, h] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "interpret", "check", "sliding_window", "soft_cap"),
+)
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [T, num_heads, head_dim] — packed token-major queries
+    k_pages: jnp.ndarray,  # [total_pages, kv_heads, page_size, head_dim]
+    v_pages: jnp.ndarray,  # (or [L, P, kh, ps, hd] with ``layer`` set)
+    page_table: jnp.ndarray,  # [b, max_pages] int32
+    kv_lens: jnp.ndarray,  # [b] int32 — final tokens per seq INCL. its chunk
+    cu_q_lens: jnp.ndarray,  # [b+1] int32 — cumulative query counts; seq i's
+    # queries are q rows [cu_q_lens[i], cu_q_lens[i+1]) (zero-length rows ok)
+    scale: float | None = None,
+    interpret: bool = False,
+    check: bool = False,
+    sliding_window: int = 0,
+    soft_cap: float = 0.0,
+    k_scales: jnp.ndarray | None = None,  # [P, kh, 1, ps] f32 (int8 pool)
+    v_scales: jnp.ndarray | None = None,
+    layer: jnp.ndarray | None = None,  # scalar int32: 5D full-pool mode
+    fresh_k: jnp.ndarray | None = None,  # [T, kh, hd] packed chunk K/V, NOT
+    fresh_v: jnp.ndarray | None = None,  # yet written to any page
+    fresh_ks: jnp.ndarray | None = None,  # [T, kh] f32 (quant pool fresh)
+    fresh_vs: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """ONE kernel launch for a ragged batch of mixed prefill chunks and
+    decode rows over the page table (the TPU Ragged Paged Attention design,
+    arXiv 2604.15464): ``q`` is the token-major concatenation of every
+    sequence's variable-length query segment — a 1-token decode row and a
+    512-token prefill chunk ride the same grid — and ``(kv_lens, page_table,
+    cu_q_lens)`` is the only metadata. No per-segment dispatch exists:
+    serving admission prefill and resident decode share this launch
+    (serve/continuous.py).
+
+    Per sequence ``i`` with ``ql = cu_q_lens[i+1] - cu_q_lens[i]`` queries,
+    query ``j`` sits at absolute position ``kv_lens[i] - ql + j`` and
+    attends causally over the sequence's paged prefix plus the chunk's own
+    earlier tokens. Returns [T, num_heads, head_dim] in q's dtype (rows of
+    zero-length sequences and the packed tail are garbage — callers slice
+    by cu_q_lens).
+
+    Internally segments are re-packed to 8-token-aligned blocks (two cheap
+    [T]-row gathers bracket the launch) so each grid block belongs to ONE
+    sequence and the grid is ``(q_blocks, pages [+ fresh blocks])`` — total
+    page-walk DMA is the per-sequence walk the decode kernel already does,
+    now shared by every segment shape in the batch.
+
+    ``fresh_k``/``fresh_v`` carry the chunk's OWN K/V (packed exactly like
+    q) when the caller has not yet written it to the pages (the hoisted-
+    write serving path): the page walk masks to the committed prefix and the
+    chunk attends to itself through a third grid axis of packed fresh
+    blocks. ``sliding_window``/``soft_cap``/``k_scales``/``layer`` follow
+    paged_decode_attention's contracts (the window here is mask-only: the
+    ragged grid does not shrink the page axis).
+
+    ``check=True`` emits checkify contract asserts (ops.checks.
+    check_ragged_inputs) — run through ops.checks.checked (§5.2).
+    """
+    if not HAVE_PALLAS:  # pragma: no cover
+        raise RuntimeError("pallas unavailable")
+    quantized = k_scales is not None
+    fold_fresh = fresh_k is not None
+    full_pool = k_pages.ndim == 5
+    if full_pool and layer is None:
+        raise ValueError("5D page pools need the `layer` index")
+    if not full_pool and layer is not None:
+        raise ValueError("`layer` only applies to 5D [L, P, kh, ps, hd] pools")
+    if check:
+        from edgemesh.ops.checks import check_ragged_inputs
+
+        check_ragged_inputs(
+            q, k_pages[0] if full_pool else k_pages, page_table, kv_lens,
+            cu_q_lens,
+        )
+    bqt = _RAGGED_BQT
+    T, nh, hd = q.shape
+    kh, ps = k_pages.shape[-3], k_pages.shape[-2]
+    groups = nh // kh
+    b, max_pages = page_table.shape
+    scale = scale if scale is not None else hd**-0.5
+    hp = hd if hd % 64 == 0 else _round_up(hd, 128)
+
+    cu = cu_q_lens.astype(jnp.int32)
+    q_lens = cu[1:] - cu[:-1]
+    kv_lens = kv_lens.astype(jnp.int32)
+
+    # Aligned re-pack: segment i moves to rows [acu[i], acu[i]+q_lens[i]) with
+    # acu[i] a multiple of bqt, so every block has one owner. Tp is the static
+    # worst case (each segment padded by < bqt).
+    Tp = _round_up(T, bqt) + b * bqt
+    nblk = Tp // bqt
+    acu = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(((q_lens + bqt - 1) // bqt) * bqt)]
+    ).astype(jnp.int32)
+    rows = jnp.arange(Tp, dtype=jnp.int32)
+    seq_al = jnp.clip(jnp.searchsorted(acu, rows, side="right") - 1, 0, b - 1)
+    src = jnp.clip(cu[seq_al] + rows - acu[seq_al], 0, T - 1)
+    blkseq = jnp.clip(
+        jnp.searchsorted(acu, jnp.arange(nblk, dtype=jnp.int32) * bqt,
+                         side="right") - 1,
+        0, b - 1,
+    ).astype(jnp.int32)
+
+    rq = bqt * groups
+    qg = jnp.take(q, src, axis=0).reshape(nblk, bqt, kh, groups, hd)
+    qg = qg.transpose(0, 2, 1, 3, 4).reshape(nblk, kh, rq, hd)
+    qg = jnp.pad(qg, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
+    if hp != hd:
+        pad = [(0, 0)] * (k_pages.ndim - 1) + [(0, hp - hd)]
+        k_pages = jnp.pad(k_pages, pad)
+        v_pages = jnp.pad(v_pages, pad)
+
+    # 5D pools collapse to 4D with the layer as a page offset, exactly like
+    # paged_decode_attention (free leading-dim merge).
+    if full_pool:
+        P = k_pages.shape[1]
+        k_pages = k_pages.reshape((-1,) + k_pages.shape[2:])
+        v_pages = v_pages.reshape((-1,) + v_pages.shape[2:])
+        if quantized:
+            k_scales = k_scales.reshape((-1,) + k_scales.shape[2:])
+            v_scales = v_scales.reshape((-1,) + v_scales.shape[2:])
+        off = lambda scalars: scalars[5][0] * P
+    else:
+        off = lambda scalars: 0
+
+    def q_map(g, p, *scalars):
+        return (g, 0, 0, 0)
+
+    def kv_map(g, p, *scalars):
+        table, lens, qlens, acu_s, bsq = scalars[:5]
+        seq = bsq[g]
+        live = g * bqt < acu_s[b]
+        lim = lens[seq] - (qlens[seq] if fold_fresh else 0)
+        # Clamp dead pages (and the fresh-axis steps) onto the row's last
+        # live page: consecutive duplicate indices cost one DMA, so the walk
+        # never streams trash pages.
+        pmax = jnp.maximum((lim + ps - 1) // ps - 1, 0)
+        p_eff = jnp.where(live, jnp.minimum(p, pmax), 0)
+        return (off(scalars) + table[seq, p_eff], 0, 0, 0)
+
+    def fresh_map(g, p, *scalars):
+        bsq = scalars[4]
+        f = p - max_pages
+        ok = (f >= 0) & (f <= g) & (bsq[jnp.clip(f, 0, nblk - 1)] == bsq[g])
+        return (jnp.where(ok, f, g), 0, 0, 0)
+
+    grid = (nblk, max_pages + (nblk if fold_fresh else 0))
+    kernel = functools.partial(
+        _ragged_kernel, n_scalars=6 if full_pool else 5, page_size=ps,
+        scale=scale, window=sliding_window, soft_cap=soft_cap, kv_heads=kh,
+        groups=groups, npages=max_pages, nseq=b, quantized=quantized,
+        fold_fresh=fold_fresh,
+    )
+    in_specs = [
+        pl.BlockSpec((1, kh, rq, hp), q_map),
+        pl.BlockSpec((1, kh, ps, hp), kv_map),
+        pl.BlockSpec((1, kh, ps, hp), kv_map),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quantized:
+        sc_block = (1, kh, 1, ps)
+        in_specs += [pl.BlockSpec(sc_block, kv_map), pl.BlockSpec(sc_block, kv_map)]
+        operands += [k_scales, v_scales]
+    if fold_fresh:
+        fkp = jnp.take(fresh_k, src, axis=0).reshape(nblk, bqt, kh, hd)
+        fkp = fkp.transpose(0, 2, 1, 3)
+        fvp = jnp.take(fresh_v, src, axis=0).reshape(nblk, bqt, kh, hd)
+        fvp = fvp.transpose(0, 2, 1, 3)
+        fkp = jnp.pad(fkp, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
+        fvp = jnp.pad(fvp, ((0, 0), (0, 0), (0, 0), (0, hp - hd)))
+        in_specs += [
+            pl.BlockSpec((1, kh, bqt, hp), fresh_map),
+            pl.BlockSpec((1, kh, bqt, hp), fresh_map),
+        ]
+        operands += [fkp.astype(k_pages.dtype), fvp.astype(v_pages.dtype)]
+        if quantized:
+            fksp = jnp.take(fresh_ks, src, axis=0).reshape(nblk, bqt, kh)
+            fksp = fksp.transpose(0, 2, 1)[:, :, None, :]
+            fvsp = jnp.take(fresh_vs, src, axis=0).reshape(nblk, bqt, kh)
+            fvsp = fvsp.transpose(0, 2, 1)[:, :, None, :]
+            in_specs += [
+                pl.BlockSpec((1, kh, 1, bqt), fresh_map),
+                pl.BlockSpec((1, kh, 1, bqt), fresh_map),
+            ]
+            operands += [fksp.astype(jnp.float32), fvsp.astype(jnp.float32)]
+    scalars = [
+        page_table.astype(jnp.int32), kv_lens, q_lens.astype(jnp.int32),
+        acu, blkseq,
+    ]
+    if full_pool:
+        scalars.append(jnp.reshape(layer, (1,)).astype(jnp.int32))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=len(scalars),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, kh, rq, hp), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((kh * rq, 128), jnp.float32),
+                pltpu.VMEM((kh * rq, 128), jnp.float32),
+                pltpu.VMEM((kh * rq, hp), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((nblk, kh, rq, hp), q.dtype),
+        interpret=interpret,
+    )(*scalars, *operands)
+    # Aligned → real re-pack: row t of the result is aligned row
+    # acu[seq(t)] + (t - cu[seq(t)]).
+    out = out.reshape(nblk, kh, bqt, groups, hp).transpose(0, 2, 1, 3, 4)
+    out = out.reshape(Tp, nh, hp)[:, :, :hd]
+    treal = jnp.arange(T, dtype=jnp.int32)
+    seq_re = jnp.clip(jnp.searchsorted(cu, treal, side="right") - 1, 0, b - 1)
+    src_al = jnp.clip(acu[seq_re] + treal - cu[seq_re], 0, Tp - 1)
+    return jnp.take(out, src_al, axis=0)
+
+
+def ragged_paged_attention_xla(
+    q: jnp.ndarray,  # [T, nh, hd] packed token-major
+    k_pages: jnp.ndarray,  # [P, kh, ps, hd] (one layer)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [b, max_pages]
+    kv_lens: jnp.ndarray,  # [b]
+    cu_q_lens: jnp.ndarray,  # [b+1]
+    scale: float | None = None,
+    sliding_window: int = 0,
+    soft_cap: float = 0.0,
+    k_scales: jnp.ndarray | None = None,
+    v_scales: jnp.ndarray | None = None,
+    fresh_k: jnp.ndarray | None = None,  # [T, kh, hd] packed (not yet written)
+    fresh_v: jnp.ndarray | None = None,
+    fresh_ks: jnp.ndarray | None = None,
+    fresh_vs: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """XLA fallback / oracle for :func:`ragged_paged_attention`: gather the
+    dense view per sequence, overlay the (optionally fresh) chunk, unpack the
+    ragged queries to a padded [b, T] batch, and run the reference ``attend``.
+    Same contract, gather bandwidth instead of a page walk."""
+    from edgemesh.ops.attention import LayerKV, attend
+    from edgemesh.runtime.paged_kv import gather_dense, gather_dense_scales
+
+    T, nh, hd = q.shape
+    b = page_table.shape[0]
+    cu = cu_q_lens.astype(jnp.int32)
+    q_lens = cu[1:] - cu[:-1]
+    kv_lens = kv_lens.astype(jnp.int32)
+    start = kv_lens - q_lens
+
+    dense_k = gather_dense(k_pages, page_table)  # [b, S, kh, hd]
+    dense_v = gather_dense(v_pages, page_table)
+    if k_scales is not None:
+        ks = gather_dense_scales(k_scales, page_table)
+        vs = gather_dense_scales(v_scales, page_table)
+        dense_k = (dense_k.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
+        dense_v = (dense_v.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
+    S = dense_k.shape[1]
+    cols = jnp.arange(S, dtype=jnp.int32)[None, :]  # [1, S]
+    if fresh_k is not None:
+        # Overlay the chunk region [start, kv_len) with the packed fresh
+        # rows (dequantized for int8 pools — what decode will read back).
+        if fresh_ks is not None:
+            fk = (fresh_k.astype(jnp.float32) * fresh_ks[..., None]).astype(q.dtype)
+            fv = (fresh_v.astype(jnp.float32) * fresh_vs[..., None]).astype(q.dtype)
+        else:
+            fk, fv = fresh_k.astype(q.dtype), fresh_v.astype(q.dtype)
+        in_chunk = (cols >= start[:, None]) & (cols < kv_lens[:, None])
+        fidx = jnp.clip(cu[:-1, None] + cols - start[:, None], 0, T - 1)
+        dense_k = jnp.where(in_chunk[..., None, None], fk[fidx], dense_k)
+        dense_v = jnp.where(in_chunk[..., None, None], fv[fidx], dense_v)
+
+    # Padded [b, T] query view: row i, slot j = packed row cu[i] + j.
+    offs = jnp.arange(T, dtype=jnp.int32)[None, :]
+    qidx = jnp.clip(cu[:-1, None] + offs, 0, T - 1)  # [b, T]
+    qp = jnp.take(q, qidx.reshape(-1), axis=0).reshape(b, T, nh, hd)
+    positions = start[:, None] + offs
+    kv_valid = cols < kv_lens[:, None]
+    out = attend(
+        qp, LayerKV(dense_k, dense_v), positions, kv_valid, scale,
+        sliding_window=sliding_window, soft_cap=soft_cap,
+    )
+    # Repack [b, T] → [T] token-major.
+    treal = jnp.arange(T, dtype=jnp.int32)
+    seq = jnp.clip(jnp.searchsorted(cu, treal, side="right") - 1, 0, b - 1)
+    return out[seq, treal - cu[seq]]
+
+
 def paged_decode_attention_xla(
     q: jnp.ndarray,
     k_pages: jnp.ndarray,

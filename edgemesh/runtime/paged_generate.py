@@ -36,6 +36,8 @@ from edgemesh.utils.platform import on_tpu
 from edgemesh.ops.paged_attention import (
     paged_decode_attention,
     paged_decode_attention_xla,
+    ragged_paged_attention,
+    ragged_paged_attention_xla,
 )
 from edgemesh.runtime.generate import GenerateResult, generate
 from edgemesh.runtime.paged_kv import (
@@ -678,6 +680,231 @@ def forward_decode_paged(
             is_decode=True,
         )
     return logits[:, 0], cache._replace(lengths=cache.lengths + 1)
+
+
+@partial(jax.jit, static_argnums=(0, 5))
+def forward_ragged_paged(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [T] int32 — token-major packed segments
+    cu_q_lens: jnp.ndarray,  # [b+1] int32 — segment i = rows [cu[i], cu[i+1])
+    cache: PagedKVCache,
+    s_cap: int,  # static: max segment length this compile handles
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """ONE forward for a ragged batch of mixed prefill chunks and decode
+    rows over the page pool — the serving-boundary program that replaces the
+    prefill / suffix-prefill / decode-bridge triplet (serve/continuous.py):
+    a freshly admitted prompt (or warm template suffix) and every resident
+    row's next decode token ride the same launch.
+
+    ``cache.lengths`` holds each row's committed token count; segment i
+    appends ``cu[i+1] - cu[i]`` tokens at positions ``lengths[i] + j``.
+    Returns (last-token logits [b, vocab], cache advanced per row). Rows
+    with zero-length segments pass through untouched (their logits row is
+    garbage — callers track liveness host-side).
+
+    On TPU the layer scan never touches the pool: attention is the ragged
+    Pallas kernel (ops/paged_attention.ragged_paged_attention) addressing
+    layer blocks of the stacked pool directly with the chunk's K/V folded in
+    as packed fresh blocks, and ONE aliased chunk-RMW kernel commits every
+    layer's writes after the scan (the hoisted-write discipline of
+    _paged_forward_decode_hoisted — the in-scan scatter it replaces was the
+    whole round-3 paged tax). Off-TPU the gather oracle path writes in-scan
+    and attends through ragged_paged_attention_xla. ``s_cap`` only shapes
+    the post-scan write gather ([L, b, s_cap] fresh view) — keep it at the
+    batch's max segment length, bucketed, so compile variants stay bounded.
+    """
+    b = cache.page_table.shape[0]
+    T = tokens.shape[0]
+    cu = cu_q_lens.astype(jnp.int32)
+    q_lens = cu[1:] - cu[:-1]
+    start = cache.lengths
+    kv_lens = start + q_lens
+    cache = allocate(cache, pages_needed(start, q_lens, cache.page_size))
+    t = jnp.arange(T, dtype=jnp.int32)
+    seq = jnp.clip(jnp.searchsorted(cu, t, side="right") - 1, 0, b - 1)
+    positions = jnp.clip(start[seq] + t - cu[seq], 0, cfg.max_seq_len - 1)
+    if _use_flash(cfg):
+        logits, cache = _ragged_forward_hoisted(
+            cfg, params, tokens, positions, cu, q_lens, kv_lens, cache, s_cap
+        )
+    else:
+        logits, cache = _ragged_forward_xla(
+            cfg, params, tokens, positions, seq, cu, q_lens, kv_lens, cache
+        )
+    last = logits[jnp.clip(cu[1:] - 1, 0, T - 1)]
+    return last, cache._replace(lengths=kv_lens)
+
+
+def _ragged_forward_hoisted(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [T] packed
+    positions: jnp.ndarray,  # [T] absolute positions
+    cu: jnp.ndarray,  # [b+1]
+    q_lens: jnp.ndarray,  # [b]
+    kv_lens: jnp.ndarray,  # [b] lengths AFTER this call's writes
+    cache,
+    s_cap: int,
+):
+    """Ragged forward with hoisted page writes (TPU kernel path): the scan
+    only READS the pool through the ragged kernel (layer-block addressing,
+    fresh chunk folded from packed blocks); ys are the per-layer packed
+    fresh K/V, committed by one chunk-RMW kernel after the scan."""
+    from edgemesh.ops.paged_write import write_chunk_all_layers
+
+    pool = cache
+    x = embed_tokens(cfg, params, tokens[None, :], positions[None, :])
+    quant = isinstance(pool, QuantPagedKVCache)
+    interp = cfg.attention_impl == "flash" and not on_tpu()
+    b = pool.page_table.shape[0]
+    T = tokens.shape[0]
+    nh, hd = cfg.num_heads, cfg.head_size
+
+    def attention(acfg, layer, ax, apos, cache, kv_valid, lengths, is_decode):
+        l = cache  # scalar layer index (scanned); the pool rides the closure
+        q, k, v = qkv_proj(acfg, layer, ax, apos)
+        if quant:
+            from edgemesh.runtime.quant_kv import quantize_kv
+
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            fresh = (kq[0], vq[0], ks[0], vs[0])
+            kwargs = dict(
+                zip(("fresh_k", "fresh_v", "fresh_ks", "fresh_vs"), fresh),
+                k_scales=pool.k_scale, v_scales=pool.v_scale,
+            )
+        else:
+            fresh = (k[0], v[0])
+            kwargs = dict(zip(("fresh_k", "fresh_v"), fresh))
+        out = ragged_paged_attention(
+            q[0], pool.k, pool.v, pool.page_table, kv_lens, cu,
+            scale=acfg.query_scale, interpret=interp,
+            sliding_window=acfg.sliding_window, soft_cap=acfg.attn_soft_cap,
+            layer=l, **kwargs,
+        )
+        proj = dense(layer["o"], out[None].reshape(1, T, nh * hd), acfg.quant_mode)
+        return proj, (l, fresh)
+
+    def body(layer_cfg, h, scanned):
+        layer, l = scanned
+        h, state, _aux = _layer_fn(
+            layer_cfg, h, layer, l, positions[None, :], None, pool.lengths,
+            True, attention,
+        )
+        return h, state[1]  # ys = the packed fresh K/V tuple
+
+    n_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+    x, fresh = layer_scan_alt_windows(
+        cfg, body, x, (params["layers"], jnp.arange(n_layers, dtype=jnp.int32))
+    )
+    # Packed [L, T, ...] fresh → per-row [L, b, s_cap, ...] for the chunk-RMW
+    # writer (segment i's token j = packed row cu[i]+j; pad rows clamp onto
+    # the last real token and are masked dead by valid_len).
+    idx = jnp.clip(
+        cu[:-1, None] + jnp.minimum(
+            jnp.arange(s_cap, dtype=jnp.int32)[None, :],
+            jnp.maximum(q_lens - 1, 0)[:, None],
+        ),
+        0, T - 1,
+    )  # [b, s_cap]
+
+    def unpack(a):
+        return jnp.take(a, idx.reshape(-1), axis=1).reshape(
+            a.shape[0], b, s_cap, *a.shape[2:]
+        )
+
+    start = kv_lens - q_lens
+    if quant:
+        fk, fv, fks, fvs = fresh
+        pool = write_chunk_all_layers(
+            pool, unpack(fk), unpack(fv), start, q_lens,
+            unpack(fks), unpack(fvs), interpret=interp,
+        )
+    else:
+        fk, fv = fresh
+        pool = write_chunk_all_layers(
+            pool, unpack(fk), unpack(fv), start, q_lens, interpret=interp
+        )
+    return lm_head_logits(cfg, params, x)[0], pool
+
+
+def _ragged_forward_xla(
+    cfg: ModelConfig,
+    params,
+    tokens: jnp.ndarray,  # [T] packed
+    positions: jnp.ndarray,  # [T]
+    seq: jnp.ndarray,  # [T] owning sequence per packed token
+    cu: jnp.ndarray,  # [b+1]
+    q_lens: jnp.ndarray,  # [b]
+    kv_lens: jnp.ndarray,  # [b]
+    cache,
+):
+    """Ragged forward, gather-oracle path (non-TPU / forced-XLA configs):
+    per layer, scatter the packed chunk into its pages (write-then-attend —
+    the read-back is exactly what decode sees, int8 roundtrip included),
+    then attend through ragged_paged_attention_xla's dense gather."""
+    pool = cache
+    x = embed_tokens(cfg, params, tokens[None, :], positions[None, :])
+    quant = isinstance(pool, QuantPagedKVCache)
+    T = tokens.shape[0]
+    ps = pool.page_size
+    nh, hd = cfg.num_heads, cfg.head_size
+    table = pool.page_table
+    # Per-token physical (page, slot); the packed tail past cu[b] lands on
+    # the trash page like every other invalid write.
+    logical = jnp.minimum(positions // ps, table.shape[1] - 1)
+    pp = jnp.where(
+        jnp.arange(T) < cu[-1], table[seq, logical], 0
+    )
+    ss = positions % ps
+
+    def attention(acfg, layer, ax, apos, cache, kv_valid, lengths, is_decode):
+        kv = cache  # per-layer page slices from the scan xs
+        q, k, v = qkv_proj(acfg, layer, ax, apos)
+        if quant:
+            from edgemesh.runtime.quant_kv import quantize_kv
+
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            kp = kv[0].at[pp, :, ss, :].set(kq[0])
+            vp = kv[1].at[pp, :, ss, :].set(vq[0])
+            ksp = kv[2].at[pp, :, 0, ss].set(ks[0].astype(kv[2].dtype))
+            vsp = kv[3].at[pp, :, 0, ss].set(vs[0].astype(kv[3].dtype))
+            new_kv = (kp, vp, ksp, vsp)
+            scales = dict(k_scales=ksp, v_scales=vsp)
+        else:
+            kp = kv[0].at[pp, :, ss, :].set(k[0].astype(kv[0].dtype))
+            vp = kv[1].at[pp, :, ss, :].set(v[0].astype(kv[1].dtype))
+            new_kv = (kp, vp)
+            scales = {}
+        out = ragged_paged_attention_xla(
+            q[0], kp, vp, table, kv_lens, cu, scale=acfg.query_scale,
+            sliding_window=acfg.sliding_window, soft_cap=acfg.attn_soft_cap,
+            **scales,
+        )
+        proj = dense(layer["o"], out[None].reshape(1, T, nh * hd), acfg.quant_mode)
+        return proj, new_kv
+
+    def body(layer_cfg, h, scanned):
+        layer, *kv = scanned
+        h, state, _aux = _layer_fn(
+            layer_cfg, h, layer, tuple(kv), positions[None, :], None,
+            pool.lengths, True, attention,
+        )
+        return h, tuple(state)
+
+    xs = (params["layers"], pool.k, pool.v)
+    if quant:
+        xs += (pool.k_scale, pool.v_scale)
+    x, new_kv = layer_scan_alt_windows(cfg, body, x, xs)
+    if quant:
+        pool = pool._replace(
+            k=new_kv[0], v=new_kv[1], k_scale=new_kv[2], v_scale=new_kv[3]
+        )
+    else:
+        pool = pool._replace(k=new_kv[0], v=new_kv[1])
+    return lm_head_logits(cfg, params, x)[0], pool
 
 
 def generate_paged(

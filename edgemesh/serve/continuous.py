@@ -88,6 +88,7 @@ from edgemesh.runtime.paged_generate import (
     forward_decode_paged,
     forward_prefill_paged,
     forward_prefill_paged_at,
+    forward_ragged_paged,
 )
 from edgemesh.runtime.paged_kv import init_paged_cache, init_quant_paged_cache
 
@@ -113,6 +114,36 @@ _spec_rounds_donated = partial(
     jax.jit, static_argnums=(0, 1, 4, 5, 6, 7, 8, 9, 12, 13),
     donate_argnums=(10,),
 )(_spec_rounds.__wrapped__)
+
+
+# The ragged boundary launch (serving's ONE admission+bridge program): packed
+# segment tokens for every slot — a staged admission contributes its whole
+# prompt/suffix chunk, every resident row its next decode token — run through
+# forward_ragged_paged in a single launch. Replaces the per-request admission
+# prefill dispatches AND the bridge for the ragged engine. The cache is
+# donated (it holds the shared pool); rows finished at dispatch keep frozen
+# lengths, exactly the bridge's contract.
+@partial(jax.jit, static_argnums=(0, 6), donate_argnums=(5,))
+def _ragged_boundary(cfg, params, tokens, cu_q_lens, fin, cache, s_cap):
+    start = cache.lengths
+    logits, cache = forward_ragged_paged.__wrapped__(
+        cfg, params, tokens, cu_q_lens, cache, s_cap
+    )
+    return (
+        logits.astype(jnp.float32),
+        cache._replace(lengths=jnp.where(fin, start, cache.lengths)),
+    )
+
+
+class _StagedAdmission(NamedTuple):
+    """Host-side record of an admission waiting for the next ragged boundary
+    launch (its pages are already mapped, its slot already claimed)."""
+
+    idx: int  # slot index
+    trace: Any  # obs.RequestTrace
+    plen: int  # full prompt tokens
+    ids: Any  # np.ndarray — the token ids to prefill (suffix when warm)
+    match: int  # shared-template tokens already in the row's pages
 
 
 def _make_bridge(decode_fn):
@@ -279,6 +310,7 @@ class ContinuousEngine:
         span_log=None,
         registry=None,
         trace_sample: float = 1.0,
+        ragged: bool | None = None,
     ):
         self.agent = agent
         self.cfg = agent.cfg
@@ -301,6 +333,14 @@ class ContinuousEngine:
         # slabs share the splice-admission path, the paged/paged_int8 pools
         # share the page-table path.
         self._paged = kv_backend.startswith("paged")
+        # Ragged boundary launches (DEFAULT for paged backends): admission
+        # prefill chunks and every resident row's bridge decode token ride
+        # ONE forward_ragged_paged launch per segment boundary — no
+        # per-request prefill dispatch, no trailing bridge. ``ragged=False``
+        # keeps the segmented path (per-request donated prefills + bridge):
+        # the bench's ragged-vs-segmented ablation arm, and the only mode
+        # dense slabs support.
+        self._ragged = self._paged if ragged is None else bool(ragged and self._paged)
         if self._paged and int(page_size) < 1:
             raise ValueError("page_size must be >= 1")
         self.kv_backend = kv_backend
@@ -355,6 +395,17 @@ class ContinuousEngine:
             self._template_pages: list[int] = []
             self._template_capacity_added = False
             self.shared_prefix_hits = 0
+            # Ragged boundary state (worker-owned): admissions staged for
+            # the next boundary launch, and each slot's last sampled token
+            # (the bridge input the boundary consumes).
+            self._staged: list[_StagedAdmission] = []  # not shared
+            self._prev = jnp.zeros((self.n_slots,), jnp.int32)  # not shared
+            # Per-wave prefill-vs-decode token split through the SHARED
+            # launch — what keeps the tracing critical path honest when both
+            # phases ride one kernel. stats() reads these under the lock.
+            self.ragged_boundaries = 0
+            self.ragged_prefill_tokens = 0
+            self.ragged_decode_tokens = 0
         # fp32, NOT activation dtype: sampling must see the same logits the
         # solo decode path sees, or bf16 rounding flips near-tied greedy
         # tokens versus agent.answer.
@@ -387,6 +438,11 @@ class ContinuousEngine:
             "Admissions warm-started from the shared template prefix",
             ("engine",),
         ).labels(engine=self.obs_engine_label)
+        self._ragged_tokens_counter = self.obs.registry.counter(
+            "edgemesh_ragged_tokens_total",
+            "Tokens through the shared ragged boundary launch, by phase",
+            ("engine", "phase"),
+        )
         self._update_page_gauges()
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
@@ -450,6 +506,11 @@ class ContinuousEngine:
                 out["free_pages"] = len(self._free_pages)
                 out["template_pages"] = len(self._template_pages)
                 out["shared_prefix_hits"] = self.shared_prefix_hits
+                out["ragged"] = self._ragged
+                if self._ragged:
+                    out["ragged_boundaries"] = self.ragged_boundaries
+                    out["ragged_prefill_tokens"] = self.ragged_prefill_tokens
+                    out["ragged_decode_tokens"] = self.ragged_decode_tokens
             return out
 
     def _update_page_gauges(self) -> None:
@@ -495,27 +556,16 @@ class ContinuousEngine:
 
     # -- engine loop --------------------------------------------------------
 
-    def _admit(self, idx: int, question: str, fut: Future, trace,
-               mid_flight: bool, max_new: int | None = None) -> bool:
-        """Prefill one request and splice its state into slot ``idx``.
-
-        Returns False when a paged backend lacks free pages for the request's
-        worst case (the caller re-queues it — capacity, not failure)."""
-        agent = self.agent
-        self.obs.admit_start(trace)
-        prompt = agent.format_prompt(question)
-        tokens, lengths, _ = agent._prepare_batch([prompt])
-        plen = int(lengths[0])
-        budget = int(agent.sampling.max_new_tokens)
+    def _clamp_budget(self, plen: int, max_new: int | None) -> int:
+        """Pipelined-overshoot budget clamp — ONE definition for every
+        admission path (dense, segmented paged, staged ragged): a
+        budget-exhausted row rides one unfrozen lag segment plus the
+        in-segment overshoot before its length freezes, advancing up to
+        2*(chunk+1) tokens past plen+budget, and even that worst case must
+        stay inside the model's declared position range."""
+        budget = int(self.agent.sampling.max_new_tokens)
         if max_new is not None:
             budget = min(budget, int(max_new))
-        # Pipelined-overshoot clamp: a budget-exhausted row rides one
-        # unfrozen lag segment plus the in-segment overshoot before its
-        # length freezes, advancing up to 2*(chunk+1) tokens past
-        # plen+budget. Clamp the budget so even that worst case stays
-        # inside the model's declared position range (the spec engine
-        # freezes budget-complete rows device-side and carries its own
-        # gamma-aware margin instead).
         over = 2 * (self.chunk + 1)
         budget = min(budget, int(self.cfg.max_seq_len) - plen - over)
         if budget < 1:
@@ -524,6 +574,62 @@ class ContinuousEngine:
                 f"max_seq_len={self.cfg.max_seq_len} after the pipeline "
                 f"overshoot margin ({over} tokens)"
             )
+        return budget
+
+    def _plan_paged_admission(self, prompt_row, plen: int, budget: int):
+        """Template match + worst-case page arithmetic shared by the staged
+        (ragged) and prefill-now (segmented) paged admission paths — ONE
+        definition so the ablation's A/B arms cannot silently diverge.
+        ``prompt_row`` is the prompt's token ids (host array or device
+        row). Returns ``(match, need)``: the shared-template token match
+        (0 when sharing buys nothing) and the private pages to map —
+        prompt + budget + one segment of mid-flight overshoot + one
+        segment of pipeline retirement lag (each with its bridge/boundary
+        token), capped at the table row's slot count (writes past the last
+        logical slot clamp onto the row's own garbage page or the trash
+        page, never another row's). Raises when the pool can NEVER satisfy
+        the request; ``need`` may still exceed the current free list (the
+        caller re-queues — capacity, not failure)."""
+        self._ensure_template()
+        from edgemesh.runtime.prefix_cache import common_token_prefix
+
+        match = 0
+        if self._template_ids is not None and self._template_ids.size:
+            match = common_token_prefix(self._template_ids, prompt_row)
+        if match // self.page_size == 0:
+            match = 0  # below one page: sharing buys nothing, go cold
+        over = 2 * (self.chunk + 1)
+        mapped = min(
+            -(-(plen + budget + over) // self.page_size),
+            int(self._cache.max_pages),
+        )
+        need = max(mapped - match // self.page_size, 1)
+        if need > len(self._free_pages) + self._reserved_pages:
+            raise ValueError(
+                f"request needs {need} pages (prompt {plen} + budget "
+                f"{budget} + segment overshoot); the pool holds "
+                f"{len(self._free_pages) + self._reserved_pages} beyond "
+                "the template"
+            )
+        return match, need
+
+    def _admit(self, idx: int, question: str, fut: Future, trace,
+               mid_flight: bool, max_new: int | None = None) -> bool:
+        """Prefill one request and splice its state into slot ``idx``.
+
+        Returns False when a paged backend lacks free pages for the request's
+        worst case (the caller re-queues it — capacity, not failure)."""
+        if self._paged and self._ragged:
+            return self._stage_admission(idx, question, fut, trace,
+                                         mid_flight, max_new=max_new)
+        agent = self.agent
+        self.obs.admit_start(trace)
+        prompt = agent.format_prompt(question)
+        tokens, lengths, _ = agent._prepare_batch([prompt])
+        plen = int(lengths[0])
+        # (The spec engine freezes budget-complete rows device-side and
+        # carries its own gamma-aware margin instead of this clamp.)
+        budget = self._clamp_budget(plen, max_new)
 
         if not self._paged:
             cap = self._cache.k.shape[2]
@@ -568,38 +674,14 @@ class ContinuousEngine:
                 )
             pages: list[int] = []
         else:
-            self._ensure_template()
-            # Shared-prefix match: longest common token prefix with the
-            # template pages, leaving at least one suffix token to prefill
-            # (same matcher as the dense warm path, runtime/prefix_cache.py).
-            from edgemesh.runtime.prefix_cache import common_token_prefix
-
-            match = 0
-            if self._template_ids is not None and self._template_ids.size:
-                match = common_token_prefix(self._template_ids, tokens[0, :plen])
-            shared_full = match // self.page_size  # read-only shared pages
-            if shared_full == 0:
-                match = 0  # below one page: sharing buys nothing, go cold
-
-            # Worst-case PRIVATE pages (shared pages are permanent pool
-            # residents): prompt + budget + one segment of mid-flight
-            # overshoot + one segment of pipeline retirement lag (each with
-            # its bridge token). Capped at the table row's slot count —
-            # writes past the last logical slot clamp onto the row's own
-            # final (garbage-region) page or the trash page, never another
-            # row's (paged_kv._token_slots).
-            mapped = min(
-                -(-(plen + budget + over) // self.page_size),
-                int(self._cache.max_pages),
+            # Shared-prefix match + worst-case private-page plan — the SAME
+            # arithmetic the staged ragged path runs (_plan_paged_admission;
+            # matching leaves at least one suffix token to prefill, same
+            # matcher as the dense warm path, runtime/prefix_cache.py).
+            match, need = self._plan_paged_admission(
+                tokens[0, :plen], plen, budget
             )
-            need = max(mapped - shared_full, 1)
-            if need > len(self._free_pages) + self._reserved_pages:
-                raise ValueError(
-                    f"request needs {need} pages (prompt {plen} + budget "
-                    f"{budget} + segment overshoot); the pool holds "
-                    f"{len(self._free_pages) + self._reserved_pages} beyond "
-                    "the template"
-                )
+            shared_full = match // self.page_size  # read-only shared pages
             if need > len(self._free_pages):
                 return False  # capacity — re-queue, admit at a later boundary
             pages = self._pop_pages(need)
@@ -670,6 +752,161 @@ class ContinuousEngine:
             with self._cond:  # stats() reads this under the lock
                 self.admitted_mid_flight += 1
         return True
+
+    def _stage_admission(self, idx: int, question: str, fut: Future, trace,
+                         mid_flight: bool, max_new: int | None = None) -> bool:
+        """Ragged admission: ALL of _admit's host bookkeeping — budget clamp,
+        template match, worst-case page mapping, COW boundary copy, table-row
+        splice, slot claim — with NO prefill dispatch. The prompt (or warm
+        template suffix) rides the next segment boundary's ragged launch
+        (_dispatch_boundary), where admission prefill and resident decode
+        share one kernel. Returns False on page-pool capacity, like _admit.
+        Token ids stay host-side end to end: staging never reads the device
+        (the segmented path's template matcher pays a device→host readback
+        per admission; over a tunneled TPU that is ~0.13 s each)."""
+        agent = self.agent
+        self.obs.admit_start(trace)
+        prompt = agent.format_prompt(question)
+        ids = np.asarray(
+            agent.tokenizer.encode(prompt, max_len=agent._max_prompt()),
+            np.int32,
+        )
+        plen = int(ids.size)
+        budget = self._clamp_budget(plen, max_new)
+        match, need = self._plan_paged_admission(ids, plen, budget)
+        shared_full = match // self.page_size
+        if need > len(self._free_pages):
+            return False  # capacity — re-queue, admit at a later boundary
+        pages = self._pop_pages(need)
+        try:
+            shared = list(self._template_pages[:shared_full]) if match else []
+            private = list(pages)
+            if match and match % self.page_size:
+                self._cow_copy(self._template_pages[shared_full], private[0])
+            row_table = self._build_row_table(shared, private)
+            # Table/length splice only — the KV writes happen inside the
+            # boundary launch. The row parks at ``match`` committed tokens;
+            # the ragged segment appends from there.
+            self._cache = self._cache._replace(
+                page_table=self._cache.page_table.at[idx].set(
+                    jnp.asarray(row_table)
+                ),
+                lengths=self._cache.lengths.at[idx].set(match),
+            )
+        except Exception:
+            # The donated COW copy may have invalidated pool buffers —
+            # same all-or-nothing recovery as a failed admission prefill.
+            self._reset_pool(
+                RuntimeError("page pool reset after a failed staged admission")
+            )
+            raise
+        if match:
+            with self._cond:  # stats() reads this under the lock
+                self.shared_prefix_hits += 1
+            self._prefix_hits_counter.inc()
+        valid = jnp.ones((1, plen), bool)
+        mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(
+            jnp.asarray(ids)[None, :], valid
+        ).mask
+        self._mask = self._mask.at[idx].set(mask1[0])
+        self._finished = self._finished.at[idx].set(False)
+        self._slots[idx] = _Slot(
+            future=fut, question=question, emitted=[], remaining=budget,
+            t_submit=trace.t_submit, t_start=0.0, trace=trace, pages=pages,
+        )
+        self._gen[idx] += 1
+        self._staged.append(_StagedAdmission(idx, trace, plen, ids[match:], match))
+        self._update_page_gauges()
+        if mid_flight:
+            with self._cond:  # stats() reads this under the lock
+                self.admitted_mid_flight += 1
+        return True
+
+    def _ragged_cap(self, need: int) -> int:
+        """Static packed-token capacity for a boundary launch: the
+        decode-only boundary (no staged admissions) is exactly ``n_slots``
+        — ONE compile reused every segment — and admission waves climb a
+        doubling ladder from there, so compile variants stay O(log(slots ×
+        prompt bucket)) instead of one per admission count."""
+        cap = self.n_slots
+        while cap < need:
+            cap *= 2
+        return cap
+
+    def _dispatch_boundary(self) -> None:
+        """Queue the ragged boundary launch: ONE forward_ragged_paged over
+        packed per-slot segments — a staged admission contributes its whole
+        prompt/suffix chunk, every other slot its next decode token (the
+        bridge input; parked rows ride frozen) — producing this segment's
+        seed logits and advancing the pool. This is what deletes the
+        per-request admission prefill dispatches: the wave structure is one
+        launch regardless of how many requests joined."""
+        staged = {r.idx: r for r in self._staged}
+        self._staged = []
+        q_lens = [
+            len(staged[i].ids) if i in staged else 1
+            for i in range(self.n_slots)
+        ]
+        cu_host = np.zeros((self.n_slots + 1,), np.int64)
+        np.cumsum(q_lens, out=cu_host[1:])
+        cu_host = cu_host.astype(np.int32)
+        cap = self._ragged_cap(int(cu_host[-1]))
+        # s_cap (the write-gather width) buckets to a power of two so the
+        # (cap, s_cap) compile key space stays small.
+        s_cap = 1
+        for r in staged.values():
+            s = 16
+            while s < len(r.ids):
+                s *= 2
+            s_cap = max(s_cap, s)
+        base = np.zeros((cap,), np.int32)
+        dec_mask = np.zeros((cap,), bool)
+        dec_slot = np.zeros((cap,), np.int32)
+        for i in range(self.n_slots):
+            o = int(cu_host[i])
+            if i in staged:
+                base[o : o + len(staged[i].ids)] = staged[i].ids
+            else:
+                dec_mask[o] = True
+                dec_slot[o] = i
+        # Decode slots take their row's last sampled token from the device-
+        # resident prev vector — packing never syncs on the decode loop.
+        tokens = jnp.where(
+            jnp.asarray(dec_mask), self._prev[jnp.asarray(dec_slot)],
+            jnp.asarray(base),
+        )
+        self._logits, self._cache = _ragged_boundary(
+            self.cfg, self.agent.params, tokens, jnp.asarray(cu_host),
+            self._finished, self._cache, s_cap,
+        )
+        n_prefill = sum(len(r.ids) for r in staged.values())
+        n_decode = sum(
+            1 for i, s in enumerate(self._slots)
+            if s.active and i not in staged
+        )
+        with self._cond:  # stats() reads these under the lock
+            self.ragged_boundaries += 1
+            self.ragged_prefill_tokens += n_prefill
+            self.ragged_decode_tokens += n_decode
+        eng = self.obs_engine_label
+        if n_prefill:
+            self._ragged_tokens_counter.labels(
+                engine=eng, phase="prefill").inc(n_prefill)
+        if n_decode:
+            self._ragged_tokens_counter.labels(
+                engine=eng, phase="decode").inc(n_decode)
+        for r in staged.values():
+            # The prefill span closes at boundary DISPATCH (the launch is
+            # async — same convention as the segmented path's admission),
+            # tagged with the shared-launch token split so `edgemesh obs
+            # trace` still separates prefill from decode time when both
+            # phases share a kernel.
+            self.obs.admitted(
+                r.trace, prompt_tokens=r.plen,
+                prefill_tokens=int(len(r.ids)),
+                shared_prefix_hit=bool(r.match), ragged=True,
+            )
+            self._slots[r.idx].t_start = r.trace.t_start
 
     def _ensure_template(self) -> None:
         """Lazily prefill the prompt template's shared prefix into
@@ -805,6 +1042,11 @@ class ContinuousEngine:
                 # next admission (the capacity bump is one-time, survives).
                 self._template_ids = None
                 self._template_pages = []
+            if self._ragged:
+                # Staged admissions' table rows died with the pool; their
+                # futures were failed above (the slots were active).
+                self._staged = []
+                self._prev = jnp.zeros((self.n_slots,), jnp.int32)
         self._mask = TokenMaskState.init(self.n_slots, self.cfg.vocab_size).mask
         self._update_page_gauges()
 
@@ -846,6 +1088,28 @@ class ContinuousEngine:
         draft→verify rounds."""
         agent = self.agent
         self._rng, seg_rng = jax.random.split(self._rng)
+        if self._ragged:
+            # Boundary-first pipeline: ONE launch advances every resident
+            # row by its bridge token AND prefills every staged admission,
+            # seeding this segment's logits. No trailing bridge and no
+            # per-request prefill dispatch exist in this mode. A boundary
+            # with nothing staged degenerates to q_lens == 1 everywhere —
+            # run the plain bridge program for it (the decode kernel's
+            # fold-fresh fast path); the ragged launch fires only when a
+            # prefill chunk actually rides along.
+            if self._staged:
+                self._dispatch_boundary()
+            else:
+                with self._cond:  # stats() reads this under the lock
+                    self.ragged_boundaries += 1
+                    self.ragged_decode_tokens += len(active)
+                self._ragged_tokens_counter.labels(
+                    engine=self.obs_engine_label, phase="decode"
+                ).inc(len(active))
+                self._logits, self._cache = self._bridge(
+                    self.cfg, agent.params, self._prev, self._cache,
+                    self._finished,
+                )
         out, counts, cache, _, mask, prev, fin = _decode_loop(
             self.cfg, agent.params, agent.sampling, self.chunk, eos_id,
             self._logits, self._cache, self._mask, seg_rng,
@@ -855,13 +1119,19 @@ class ContinuousEngine:
         with self._cond:  # stats() reads this under the lock
             self.segments += 1
         self.obs.segment_dispatched()
-        # Bridge into the next segment unconditionally: rows that turn out
-        # to have finished get frozen lengths (finished-aware bridge) and a
-        # masked garbage write. The alternative — waiting to know whether
-        # anyone survives — is exactly the sync this pipeline removes.
-        self._logits, self._cache = self._bridge(
-            self.cfg, agent.params, prev, cache, fin
-        )
+        if self._ragged:
+            # The NEXT boundary consumes prev; nothing else runs here.
+            self._prev = prev
+            self._cache = cache
+        else:
+            # Bridge into the next segment unconditionally: rows that turn
+            # out to have finished get frozen lengths (finished-aware
+            # bridge) and a masked garbage write. The alternative — waiting
+            # to know whether anyone survives — is exactly the sync this
+            # pipeline removes.
+            self._logits, self._cache = self._bridge(
+                self.cfg, agent.params, prev, cache, fin
+            )
         if self._paged:
             # +0 detaches the tripwire snapshot from the cache buffer — the
             # cache itself is donated into the next segment/admission while
@@ -1083,11 +1353,15 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         # admission="sjf" is legal here too: with the engine's uniform
         # budget the sort key degenerates to prompt length, which is still
         # a valid job-size signal (prefill cost).
+        # ragged=False: the spec engine's segment is the draft→verify round
+        # loop, whose rewind/advance cadence does not decompose into the
+        # one-boundary-launch shape (admissions stay per-request cold
+        # prefills of BOTH pools).
         super().__init__(
             agent, slots=slots, chunk=chunk, idle_wait_s=idle_wait_s,
             kv_backend=kv_backend, page_size=page_size, total_pages=total_pages,
             admission=admission, span_log=span_log, registry=registry,
-            trace_sample=trace_sample,
+            trace_sample=trace_sample, ragged=False,
         )
         # The worker thread is live from here on: a failure below would
         # orphan it blocked on the condition with a half-built engine —

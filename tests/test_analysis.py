@@ -436,6 +436,90 @@ def test_em108_fleet_transport_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# EM110 serve-per-row-dispatch
+# ---------------------------------------------------------------------------
+
+_EM110_SRC = (
+    "from edgemesh.runtime.paged_generate import forward_decode_paged\n"
+    "def step(rows, cfg, params, cache):\n"
+    "    outs = []\n"
+    "    for tok in rows:\n"
+    "        logits, cache = forward_decode_paged(cfg, params, tok, cache)\n"
+    "        outs.append(logits)\n"
+    "    return outs, cache\n"
+)
+
+
+def test_em110_fires_on_per_row_forward_loop_in_serve_only():
+    findings = lint_source(_EM110_SRC, path="edgemesh/serve/continuous.py")
+    assert rules_of(findings) == {"EM110"}
+    assert findings[0].severity == "error"
+    assert "ragged" in findings[0].message
+    # Outside serve/ the rule is silent — runtime code may loop deliberately.
+    assert lint_source(_EM110_SRC, path="edgemesh/runtime/stream.py") == []
+
+
+def test_em110_quiet_outside_loops_and_inside_traced_code():
+    once = (
+        "from edgemesh.runtime.paged_generate import forward_ragged_paged\n"
+        "def boundary(cfg, params, tokens, cu, cache):\n"
+        "    return forward_ragged_paged(cfg, params, tokens, cu, cache, 16)\n"
+    )
+    assert lint_source(once, path="edgemesh/serve/continuous.py") == []
+    # A loop INSIDE traced code unrolls — EM105's beat, not a host
+    # dispatch-per-row problem.
+    traced = (
+        "import jax\n"
+        "from edgemesh.runtime.paged_generate import forward_decode_paged\n"
+        "@jax.jit\n"
+        "def seg(cfg, params, toks, cache):\n"
+        "    for t in toks:\n"
+        "        _, cache = forward_decode_paged(cfg, params, t, cache)\n"
+        "    return cache\n"
+    )
+    assert [
+        f for f in lint_source(traced, path="edgemesh/serve/continuous.py")
+        if f.rule == "EM110"
+    ] == []
+
+
+def test_em110_sees_local_jit_bindings_and_comprehensions():
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "from edgemesh.runtime.paged_generate import forward_prefill_paged\n"
+        "_prefill_donated = partial(jax.jit, static_argnums=(0,),"
+        " donate_argnums=(4,))(forward_prefill_paged)\n"
+        "def admit_all(cfg, params, batch, caches):\n"
+        "    return [_prefill_donated(cfg, params, t, l, c)"
+        " for t, l, c in batch]\n"
+    )
+    findings = lint_source(src, path="edgemesh/serve/continuous.py")
+    assert rules_of(findings) == {"EM110"}
+
+
+def test_em110_disable_comment_suppresses():
+    quiet = _EM110_SRC.replace(
+        "        logits, cache = forward_decode_paged(cfg, params, tok, cache)",
+        "        logits, cache = forward_decode_paged(cfg, params, tok, cache)"
+        "  # edgelint: disable=EM110",
+    )
+    assert lint_source(quiet, path="edgemesh/serve/continuous.py") == []
+
+
+def test_em110_shipped_serve_is_clean():
+    # The rewired engine is the rule's reference fixture: the ragged
+    # boundary replaced every per-row dispatch loop, so serve/ must lint
+    # clean without suppressions.
+    from pathlib import Path
+
+    from edgemesh.analysis.edgelint import lint_paths
+
+    serve = Path(__file__).resolve().parent.parent / "edgemesh" / "serve"
+    assert [f for f in lint_paths([serve]) if f.rule == "EM110"] == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + baseline mechanics
 # ---------------------------------------------------------------------------
 

@@ -33,6 +33,100 @@ def test_emit_partial_without_metric_is_silent(capsys):
     assert capsys.readouterr().out == ""
 
 
+def test_headline_serving_schema_gains_ragged_and_spec_keys(monkeypatch, capsys):
+    """The ragged-ablation schema contract: a headline run must carry the
+    serving_ragged_tok_s headline, the segmented baseline, the
+    batch-shape-sweep keys, and the speculative selfcheck — pinned with
+    faked stages so a partial (stalled-after-serving) artifact still has
+    the keys the PERFORMANCE.md targets reference."""
+
+    def fake_build(preset, precision, quant_mode):
+        return ("cfg", "params")
+
+    def fake_decode(preset, precision, quant_mode="w8a16", batch=8, **kw):
+        return {"metric": "m", "value": 100.0, "unit": "tok/s/chip",
+                "vs_baseline": 3.9, "ttft_s": 0.01, "hbm_eff_gbs": 1.0,
+                "hbm_util": 0.1, "weight_gb": 1.0, "batch": batch,
+                "decode_steps": 8}
+
+    def fake_serving(preset, *a, built=None, kv_backend="paged", ragged=None,
+                     **kw):
+        value = 900.0 if ragged is None else 700.0  # segmented arm slower
+        return {"metric": "serving", "value": value, "wave_tok_s": [value],
+                "spread_pct": 1.0, "req_s": 2.0, "generated": 100,
+                "latency_s_p50": 0.5, "latency_s_p95": 0.9,
+                "stats": {"segments": 9, "max_concurrent": 8,
+                          "ragged_boundaries": 9, "ragged_prefill_tokens": 300,
+                          "ragged_decode_tokens": 60}, "obs": {}}
+
+    def fake_ablation(preset, built=None, **kw):
+        out = {}
+        for shape in ("decode_heavy", "prefill_heavy", "mixed_50_50"):
+            out[f"serving_ragged_{shape}_tok_s"] = 900.0
+            out[f"serving_segmented_{shape}_tok_s"] = 700.0
+            out[f"ragged_over_segmented_{shape}"] = 1.286
+        return out
+
+    def fake_spec(preset, built=None, **kw):
+        return {"spec_tok_s": 80.0, "plain_tok_s": 60.0, "spec_speedup": 1.33,
+                "accept_rate": 0.4, "selfcheck_accept_rate": 1.0,
+                "gamma": 4, "draft_layers": 4, "draft_mode": "truncate",
+                "kv_backend": kw.get("kv_backend", "dense")}
+
+    monkeypatch.setattr(benchmarks, "_build", fake_build)
+    monkeypatch.setattr(benchmarks, "decode_benchmark", fake_decode)
+    monkeypatch.setattr(benchmarks, "serving_benchmark", fake_serving)
+    monkeypatch.setattr(benchmarks, "ragged_ablation_benchmark", fake_ablation)
+    monkeypatch.setattr(benchmarks, "speculative_benchmark", fake_spec)
+    monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_ADMIT", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
+
+    out = benchmarks.headline_benchmark(preset="llama1b", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    # Headline + ablation serving keys.
+    assert out["serving_paged_tok_s"] == out["serving_ragged_tok_s"] == 900.0
+    assert out["serving_segmented_tok_s"] == 700.0
+    assert out["serving_ragged_boundaries"] == 9
+    assert out["serving_ragged_prefill_tokens"] == 300
+    for shape in ("decode_heavy", "prefill_heavy", "mixed_50_50"):
+        assert out[f"serving_ragged_{shape}_tok_s"] == 900.0
+        assert out[f"serving_segmented_{shape}_tok_s"] == 700.0
+        assert out[f"ragged_over_segmented_{shape}"] == 1.286
+    # Speculative arm: the selfcheck key distinguishes machinery-broken
+    # (selfcheck < 1) from draft-weak (accept low, selfcheck 1.0).
+    assert out["spec_selfcheck_accept_rate"] == 1.0
+    assert out["spec_draft_mode"] == "truncate"
+    assert out["spec_accept_rate"] == 0.4
+    # Every completed stage refreshed the partial line; the last line
+    # carries the full schema.
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert "serving_ragged_tok_s" in lines[-1]
+
+
+def test_ragged_ablation_benchmark_shapes(monkeypatch):
+    """ragged_ablation_benchmark sweeps all three shapes x both arms and
+    derives the ratio keys (faked serving_benchmark — no device work)."""
+    calls = []
+
+    def fake_serving(preset, *a, ragged=None, max_new=None, prompt_pad=0,
+                     budgets=None, **kw):
+        calls.append((ragged, max_new, prompt_pad, budgets))
+        return {"value": 500.0 if ragged else 400.0, "latency_s_p50": 0.4}
+
+    monkeypatch.setattr(benchmarks, "serving_benchmark", fake_serving)
+
+    class _Cfg:
+        max_seq_len = 2048
+
+    out = benchmarks.ragged_ablation_benchmark("tiny", built=(_Cfg(), "params"))
+    assert len(calls) == 6  # 3 shapes x 2 arms
+    assert any(pad == 512 for _, _, pad, _ in calls)  # prefill-heavy shape
+    assert any(b == (8, 96) for _, _, _, b in calls)  # 50/50 budget cycling
+    for shape in ("decode_heavy", "prefill_heavy", "mixed_50_50"):
+        assert out[f"ragged_over_segmented_{shape}"] == 1.25
+
+
 def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
     """The headline int8 stage must produce a parseable driver line BEFORE
     any other stage runs, and later-stage failures must keep earlier keys."""

@@ -229,6 +229,88 @@ def test_paged_prefix_sharing_maps_template_pages():
         eng.close()
 
 
+def test_ragged_engine_matches_segmented_and_direct():
+    """The ragged boundary launch (admission prefill + resident decode in
+    ONE forward_ragged_paged program) is the paged engine's default and
+    must be token-identical to both the segmented engine and the solo
+    decode path — the wave structure changed, the math did not."""
+    agent = _agent(max_new=12)
+    qs = [
+        "where is the eiffel tower?",
+        "hm?",
+        "name a large african animal",
+        "what color is the sky above?",
+        "another question to overcommit the slots?",
+    ]
+    direct = [agent.answer(q)["answer"] for q in qs]
+    eng = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged",
+                           page_size=8)
+    try:
+        assert eng._ragged  # paged default
+        got = [f.result(timeout=600) for f in [eng.submit(q) for q in qs]]
+        for g, d in zip(got, direct):
+            assert g["answer"] == d, (g["answer"], d)
+        st = eng.stats()
+        assert st["ragged"] is True
+        assert st["ragged_boundaries"] > 0
+        assert st["ragged_prefill_tokens"] > 0
+        assert _wait_drained(eng) == 0
+    finally:
+        eng.close()
+    seg = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged",
+                           page_size=8, ragged=False)
+    try:
+        assert not seg._ragged
+        got = [f.result(timeout=600) for f in [seg.submit(q) for q in qs]]
+        for g, d in zip(got, direct):
+            assert g["answer"] == d, (g["answer"], d)
+        assert seg.stats()["ragged"] is False
+        assert "ragged_boundaries" not in seg.stats()
+    finally:
+        seg.close()
+
+
+def test_ragged_obs_split_keeps_prefill_and_decode_separate(tmp_path):
+    """The shared-launch observability contract: even with admission
+    prefill and decode riding one kernel, the span tree still carries a
+    distinct prefill span (tagged with the launch's prefill-token count)
+    and decode spans, and the engine's phase counters split the boundary
+    tokens — `edgemesh obs trace`'s critical path stays honest."""
+    from edgemesh.obs import Registry
+    from edgemesh.utils.tracing import JsonlLogger
+
+    log = tmp_path / "spans.jsonl"
+    reg = Registry()
+    agent = _agent(max_new=10)
+    eng = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged",
+                           page_size=8, span_log=log, registry=reg)
+    try:
+        futs = [eng.submit(f"question number {i}?") for i in range(3)]
+        [f.result(timeout=600) for f in futs]
+        st = eng.stats()
+        assert st["ragged_prefill_tokens"] > 0
+        assert st["ragged_decode_tokens"] > 0
+    finally:
+        eng.close()
+    # Registry: the per-phase token split through the shared launch.
+    snap = reg.snapshot()
+    phases = {
+        s["labels"]["phase"]: s["value"]
+        for s in snap["edgemesh_ragged_tokens_total"]["samples"]
+    }
+    assert phases["prefill"] > 0 and phases["decode"] > 0
+    # Span records: per-request prefill span survives the shared launch,
+    # tagged with its slice of the ragged boundary.
+    recs = [r for r in JsonlLogger(log).read() if r.get("event") == "request_spans"]
+    assert len(recs) == 3
+    for rec in recs:
+        names = [s["name"] for s in rec["spans"]]
+        assert "prefill" in names and "decode" in names
+        assert rec["ragged"] is True
+        assert rec["prefill_tokens"] > 0
+        assert rec["prefill_s"] is not None and rec["prefill_s"] >= 0
+
+
 def test_engine_over_tp_sharded_params_matches_single_device():
     """The continuous engine over TP-sharded params: the jitted segment and
     admission programs ride GSPMD transparently (params carry

@@ -13,7 +13,10 @@ from jax.experimental import checkify
 from edgemesh.ops.checks import checked
 from edgemesh.ops.flash_attention import flash_attention
 from edgemesh.ops.int8 import int8_matmul_fused, quantize_weight
-from edgemesh.ops.paged_attention import paged_decode_attention
+from edgemesh.ops.paged_attention import (
+    paged_decode_attention,
+    ragged_paged_attention,
+)
 
 
 def _paged_inputs(bad_table=False, bad_lens=False):
@@ -57,6 +60,66 @@ def test_paged_check_catches_overlong_kv_lens():
     )
     with pytest.raises(checkify.JaxRuntimeError, match="kv_lens"):
         fn(q, kp, vp, table, lens)
+
+
+def _ragged_inputs(bad_table=False, bad_lens=False, bad_cu=False, long_cu=False):
+    b, kh, nh, hd, ps, pages, maxp = 2, 2, 4, 64, 8, 6, 3
+    rng = jax.random.PRNGKey(0)
+    k_pages = jax.random.normal(rng, (pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(jax.random.PRNGKey(1), (pages, kh, ps, hd), jnp.float32)
+    table = jnp.array([[1, 2, 0], [3, 4, 5]], jnp.int32)
+    if bad_table:
+        table = table.at[0, 1].set(pages + 7)
+    lens = jnp.array([12, 20], jnp.int32)
+    if bad_lens:
+        lens = lens.at[1].set(maxp * ps + 1)
+    cu = jnp.array([0, 1, 6], jnp.int32)
+    if bad_cu:
+        cu = jnp.array([0, 3, 2], jnp.int32)  # decreasing
+    if long_cu:
+        cu = jnp.array([0, 1, 9], jnp.int32)  # past the packed rows
+    q = jax.random.normal(jax.random.PRNGKey(2), (6, nh, hd), jnp.float32)
+    return q, k_pages, v_pages, table, lens, cu
+
+
+def test_ragged_check_passes_on_valid_inputs():
+    q, kp, vp, table, lens, cu = _ragged_inputs()
+    fn = checked(
+        lambda *a: ragged_paged_attention(*a, interpret=True, check=True)
+    )
+    out = fn(q, kp, vp, table, lens, cu)
+    ref = ragged_paged_attention(q, kp, vp, table, lens, cu, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_ragged_check_catches_out_of_pool_page():
+    q, kp, vp, table, lens, cu = _ragged_inputs(bad_table=True)
+    fn = checked(
+        lambda *a: ragged_paged_attention(*a, interpret=True, check=True)
+    )
+    with pytest.raises(checkify.JaxRuntimeError, match="page-table entry"):
+        fn(q, kp, vp, table, lens, cu)
+
+
+def test_ragged_check_catches_overlong_kv_lens():
+    q, kp, vp, table, lens, cu = _ragged_inputs(bad_lens=True)
+    fn = checked(
+        lambda *a: ragged_paged_attention(*a, interpret=True, check=True)
+    )
+    with pytest.raises(checkify.JaxRuntimeError, match="kv_lens"):
+        fn(q, kp, vp, table, lens, cu)
+
+
+def test_ragged_check_catches_bad_cu_q_lens():
+    q, kp, vp, table, lens, cu = _ragged_inputs(bad_cu=True)
+    fn = checked(
+        lambda *a: ragged_paged_attention(*a, interpret=True, check=True)
+    )
+    with pytest.raises(checkify.JaxRuntimeError, match="non-decreasing"):
+        fn(q, kp, vp, table, lens, cu)
+    q, kp, vp, table, lens, cu = _ragged_inputs(long_cu=True)
+    with pytest.raises(checkify.JaxRuntimeError, match="packed query"):
+        fn(q, kp, vp, table, lens, cu)
 
 
 def test_flash_check_catches_overlong_kv_lens():

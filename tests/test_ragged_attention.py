@@ -1,0 +1,233 @@
+"""Ragged paged attention (ops/paged_attention.ragged_paged_attention).
+
+CPU parity in interpret mode against the gather oracle and the dense
+reference across the edge shapes serving produces: zero-length rows,
+single-token decode rows, kv lengths landing exactly on page boundaries,
+sliding window, soft cap, int8 pools, and the fresh-fold mode the hoisted
+serving forward uses. Fast tier — everything here is interpret-mode Pallas
+plus tiny XLA programs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edgemesh.models.families import tiny_config
+from edgemesh.models.transformer import forward_prefill, init_kv_cache, init_params
+from edgemesh.ops.paged_attention import (
+    paged_decode_attention,
+    ragged_paged_attention,
+    ragged_paged_attention_xla,
+)
+from edgemesh.runtime.paged_generate import forward_prefill_paged, forward_ragged_paged
+from edgemesh.runtime.paged_kv import init_paged_cache
+
+
+def _pool(b=4, kh=2, nh=4, hd=64, ps=8, pages=20, mp=4, seed=0):
+    k_pages = jax.random.normal(jax.random.PRNGKey(seed), (pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(jax.random.PRNGKey(seed + 1), (pages, kh, ps, hd), jnp.float32)
+    table = jnp.asarray(np.arange(1, 1 + b * mp).reshape(b, mp) % pages, jnp.int32)
+    return k_pages, v_pages, table
+
+
+def _ragged(q_lens, seed=2, nh=4, hd=64):
+    cu = jnp.asarray(np.concatenate([[0], np.cumsum(q_lens)]), jnp.int32)
+    T = int(cu[-1])
+    q = jax.random.normal(jax.random.PRNGKey(seed), (T, nh, hd), jnp.float32)
+    return q, cu
+
+
+# The edge-shape battery: decode rows, chunks, a zero-length row, and kv
+# lengths landing exactly on page boundaries (seq 3: 16 = 2 full 8-pages).
+EDGE_Q = np.array([1, 5, 0, 8])
+EDGE_KV = np.array([12, 17, 9, 16])
+
+
+@pytest.mark.parametrize("window,cap", [(0, 0.0), (6, 0.0), (0, 30.0), (5, 20.0)])
+def test_ragged_kernel_matches_oracle_pages_mode(window, cap):
+    k_pages, v_pages, table = _pool()
+    q, cu = _ragged(EDGE_Q)
+    kv = jnp.asarray(EDGE_KV, jnp.int32)
+    out = ragged_paged_attention(
+        q, k_pages, v_pages, table, kv, cu, interpret=True,
+        sliding_window=window, soft_cap=cap,
+    )
+    ref = ragged_paged_attention_xla(
+        q, k_pages, v_pages, table, kv, cu, sliding_window=window, soft_cap=cap
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("window,cap", [(0, 0.0), (6, 0.0), (0, 30.0)])
+def test_ragged_kernel_matches_oracle_fresh_mode(window, cap):
+    """fold-fresh: the chunk's K/V ride packed fresh blocks, pages hold only
+    the committed prefix — the serving boundary's configuration."""
+    k_pages, v_pages, table = _pool()
+    q, cu = _ragged(EDGE_Q)
+    kv = jnp.asarray(EDGE_KV, jnp.int32)
+    T = q.shape[0]
+    fk = jax.random.normal(jax.random.PRNGKey(3), (T, 2, 64), jnp.float32)
+    fv = jax.random.normal(jax.random.PRNGKey(4), (T, 2, 64), jnp.float32)
+    out = ragged_paged_attention(
+        q, k_pages, v_pages, table, kv, cu, interpret=True,
+        sliding_window=window, soft_cap=cap, fresh_k=fk, fresh_v=fv,
+    )
+    ref = ragged_paged_attention_xla(
+        q, k_pages, v_pages, table, kv, cu, sliding_window=window,
+        soft_cap=cap, fresh_k=fk, fresh_v=fv,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ragged_decode_only_matches_decode_kernel():
+    """A batch of pure decode rows (q_lens all 1, fresh fold) must agree
+    with the dedicated decode kernel's fold-fresh mode — the two kernels'
+    shared math, pinned kernel-to-kernel."""
+    b = 4
+    k_pages, v_pages, table = _pool(b=b)
+    q_lens = np.ones(b, np.int64)
+    q, cu = _ragged(q_lens, seed=5)
+    kv = jnp.asarray([3, 9, 16, 25], jnp.int32)
+    fk = jax.random.normal(jax.random.PRNGKey(6), (b, 2, 64), jnp.float32)
+    fv = jax.random.normal(jax.random.PRNGKey(7), (b, 2, 64), jnp.float32)
+    out = ragged_paged_attention(
+        q, k_pages, v_pages, table, kv, cu, interpret=True,
+        fresh_k=fk, fresh_v=fv,
+    )
+    ref = paged_decode_attention(
+        q, k_pages, v_pages, table, kv, interpret=True, fresh_k=fk, fresh_v=fv
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ragged_kernel_quantized_pool_with_fresh():
+    b, kh, hd, ps, pages, mp = 3, 2, 64, 8, 16, 4
+    q_lens = np.array([1, 6, 3])
+    q, cu = _ragged(q_lens, seed=8)
+    T = q.shape[0]
+    kv = jnp.asarray([9, 14, 3], jnp.int32)
+    table = jnp.asarray(np.arange(1, 1 + b * mp).reshape(b, mp) % pages, jnp.int32)
+    key = jax.random.PRNGKey
+    kq = jax.random.randint(key(9), (pages, kh, ps, hd), -127, 128, jnp.int32).astype(jnp.int8)
+    vq = jax.random.randint(key(10), (pages, kh, ps, hd), -127, 128, jnp.int32).astype(jnp.int8)
+    ks = jax.random.uniform(key(11), (pages, kh, 1, ps), jnp.float32, 0.01, 0.03)
+    vs = jax.random.uniform(key(12), (pages, kh, 1, ps), jnp.float32, 0.01, 0.03)
+    fkq = jax.random.randint(key(13), (T, kh, hd), -127, 128, jnp.int32).astype(jnp.int8)
+    fvq = jax.random.randint(key(14), (T, kh, hd), -127, 128, jnp.int32).astype(jnp.int8)
+    fks = jax.random.uniform(key(15), (T, kh), jnp.float32, 0.01, 0.03)
+    fvs = jax.random.uniform(key(16), (T, kh), jnp.float32, 0.01, 0.03)
+    out = ragged_paged_attention(
+        q, kq, vq, table, kv, cu, interpret=True, k_scales=ks, v_scales=vs,
+        fresh_k=fkq, fresh_v=fvq, fresh_ks=fks, fresh_vs=fvs,
+    )
+    ref = ragged_paged_attention_xla(
+        q, kq, vq, table, kv, cu, k_scales=ks, v_scales=vs,
+        fresh_k=fkq, fresh_v=fvq, fresh_ks=fks, fresh_vs=fvs,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ragged_kernel_full_pool_layer_addressing():
+    """5D stacked pool + ``layer`` scalar: each layer's launch reads its own
+    page blocks (the layer-scan mode the hoisted serving forward drives)."""
+    b, kh, hd, ps, pages, mp, L = 3, 2, 64, 8, 16, 4, 2
+    q_lens = np.array([2, 0, 4])
+    q, cu = _ragged(q_lens, seed=17)
+    T = q.shape[0]
+    kv = jnp.asarray([8, 5, 11], jnp.int32)
+    table = jnp.asarray(np.arange(1, 1 + b * mp).reshape(b, mp) % pages, jnp.int32)
+    k5 = jax.random.normal(jax.random.PRNGKey(18), (L, pages, kh, ps, hd), jnp.float32)
+    v5 = jax.random.normal(jax.random.PRNGKey(19), (L, pages, kh, ps, hd), jnp.float32)
+    fk = jax.random.normal(jax.random.PRNGKey(20), (T, kh, hd), jnp.float32)
+    fv = jax.random.normal(jax.random.PRNGKey(21), (T, kh, hd), jnp.float32)
+    for l in range(L):
+        out = ragged_paged_attention(
+            q, k5, v5, table, kv, cu, interpret=True,
+            layer=jnp.asarray(l), fresh_k=fk, fresh_v=fv,
+        )
+        ref = ragged_paged_attention_xla(
+            q, k5[l], v5[l], table, kv, cu, fresh_k=fk, fresh_v=fv
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ragged_rejects_layer_on_4d_pool():
+    k_pages, v_pages, table = _pool()
+    q, cu = _ragged(np.array([1, 1, 1, 1]))
+    with pytest.raises(ValueError, match="5D"):
+        ragged_paged_attention(
+            q, k_pages, v_pages, table, jnp.asarray(EDGE_KV, jnp.int32), cu,
+            interpret=True, layer=jnp.asarray(0),
+        )
+
+
+@pytest.mark.parametrize("impl", ["xla", "flash"])
+def test_forward_ragged_paged_matches_dense_reference(impl):
+    """The serving-boundary forward end to end: mixed prefill chunks +
+    decode rows in ONE launch match the dense forward over each row's full
+    prefix — then a second (pure-decode) ragged step proves the hoisted
+    writes landed exactly where decode reads them."""
+    cfg = tiny_config("llama", vocab_size=128, max_seq_len=64).replace(
+        attention_impl=impl, dtype="float32"
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    b = 3
+    plens = np.array([5, 9, 3])
+    prompts = np.random.RandomState(0).randint(1, 128, (b, int(plens.max())))
+    cache = init_paged_cache(cfg, b, total_pages=1 + b * 8, page_size=8, max_pages=8)
+    _, cache = forward_prefill_paged(
+        cfg, params, jnp.asarray(prompts, jnp.int32),
+        jnp.asarray(plens, jnp.int32), cache,
+    )
+
+    q_lens = np.array([1, 4, 2])  # decode row + two chunks
+    extras = [
+        np.random.RandomState(10 + i).randint(1, 128, (n,))
+        for i, n in enumerate(q_lens)
+    ]
+    packed = jnp.asarray(np.concatenate(extras), jnp.int32)
+    cu = jnp.asarray(np.concatenate([[0], np.cumsum(q_lens)]), jnp.int32)
+    last, cache2 = forward_ragged_paged(cfg, params, packed, cu, cache, 4)
+    assert np.asarray(cache2.lengths).tolist() == (plens + q_lens).tolist()
+
+    def dense_last(rows):
+        L = max(len(r) for r in rows)
+        padded = np.zeros((b, L), np.int64)
+        for i, r in enumerate(rows):
+            padded[i, : len(r)] = r
+        ref, _ = forward_prefill(
+            cfg, params, jnp.asarray(padded, jnp.int32),
+            jnp.asarray([len(r) for r in rows], jnp.int32),
+            init_kv_cache(cfg, b, 64),
+        )
+        return np.asarray(ref)
+
+    full = [np.concatenate([prompts[i, : plens[i]], extras[i]]) for i in range(b)]
+    np.testing.assert_allclose(np.asarray(last), dense_last(full), atol=2e-4)
+
+    nxt = np.random.RandomState(99).randint(1, 128, (b,))
+    last2, _ = forward_ragged_paged(
+        cfg, params, jnp.asarray(nxt, jnp.int32),
+        jnp.asarray([0, 1, 2, 3], jnp.int32), cache2, 1,
+    )
+    full2 = [np.concatenate([f, [nxt[i]]]) for i, f in enumerate(full)]
+    np.testing.assert_allclose(np.asarray(last2), dense_last(full2), atol=2e-4)
+
+
+def test_forward_ragged_paged_pops_no_pages_when_premapped():
+    """The host-owned-allocator contract the serving tripwire checks: a
+    boundary whose rows are fully pre-mapped must leave free_top at 1."""
+    cfg = tiny_config("llama", vocab_size=64, max_seq_len=64).replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    b = 2
+    cache = init_paged_cache(cfg, b, total_pages=1 + b * 8, page_size=8, max_pages=8)
+    # Pre-map every slot host-style and park lengths at 0.
+    table = np.zeros((b, 8), np.int32)
+    table[0] = np.arange(1, 9)
+    table[1] = np.arange(9, 17)
+    cache = cache._replace(page_table=jnp.asarray(table))
+    tokens = jnp.asarray(np.random.RandomState(1).randint(1, 64, (12,)), jnp.int32)
+    cu = jnp.asarray([0, 5, 12], jnp.int32)
+    _, cache2 = forward_ragged_paged(cfg, params, tokens, cu, cache, 8)
+    assert int(cache2.free_top) == 1
