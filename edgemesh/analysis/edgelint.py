@@ -60,6 +60,13 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   indirection (``self._admit``) is out of scope by design — the retained
   segmented ablation path dispatches through it.
 
+- EM111 metric-naming (warning): a metric registered through the obs
+  registry (``.counter/.gauge/.histogram`` with a literal name, anywhere
+  under ``edgemesh/``) must carry the ``edgemesh_`` prefix; counters must
+  end ``_total`` and gauges/histograms must not — one naming convention
+  keeps dashboards, rate() queries, and scrape relabeling honest across
+  every subsystem.
+
 The class-level concurrency rules (EM301-EM304: lock discipline,
 lock-order cycles, blocking-under-lock, thread hygiene) live in
 ``edgemesh/analysis/concurrency.py`` and ride the same entry points —
@@ -127,6 +134,11 @@ RULES: dict[str, dict] = {
         "name": "serve-per-row-dispatch",
         "severity": "error",
         "summary": "host loop in edgemesh/serve/ dispatches a jitted forward per iteration",
+    },
+    "EM111": {
+        "name": "metric-naming",
+        "severity": "warning",
+        "summary": "metric name breaks the edgemesh_ prefix / _total suffix convention",
     },
 }
 
@@ -203,6 +215,19 @@ _EM108_CALLS = {
 _EM110_DIRS = ("edgemesh/serve/",)
 _EM110_IMPORT_PREFIXES = ("forward_", "generate")
 _EM110_IMPORT_EXTRA = {"_decode_loop", "_spec_rounds"}
+
+# EM111 scope + surface: registrations through the obs registry —
+# ``<anything>.counter/gauge/histogram("name", ...)`` with a LITERAL name
+# (dynamic names are out of scope; the registry call sites in this repo are
+# all literal). Shipped-package scope only: tests and docs register
+# throwaway families on purpose. The convention (docs/OBSERVABILITY.md):
+# every metric carries the ``edgemesh_`` namespace prefix, counters end
+# ``_total`` (Prometheus convention for monotone totals), and gauge/
+# histogram names must NOT — a ``_total`` gauge reads as a counter on every
+# dashboard and breaks rate() queries.
+_EM111_DIRS = ("edgemesh/",)
+_EM111_METHODS = {"counter", "gauge", "histogram"}
+_EM111_PREFIX = "edgemesh_"
 
 
 # ---------------------------------------------------------------------------
@@ -448,6 +473,7 @@ class _FileLinter:
         self._rule_fleet_timeout(tree)
         self._rule_fleet_trace(tree)
         self._rule_serve_row_dispatch(tree)
+        self._rule_metric_naming(tree)
         # Traced ROOTS only: their walkers descend into traced nested defs,
         # so running every traced def would double-report nested call sites.
         traced_roots = [
@@ -703,6 +729,46 @@ class _FileLinter:
                         "the rows into ONE forward_ragged_paged launch (or "
                         "suppress for a deliberate ablation path)",
                     )
+
+    # -- EM111 -------------------------------------------------------------
+
+    def _rule_metric_naming(self, tree: ast.Module) -> None:
+        if not any(d in self.relpath for d in _EM111_DIRS):
+            return
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _EM111_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            kind = node.func.attr
+            name = node.args[0].value
+            if not name.startswith(_EM111_PREFIX):
+                self._emit(
+                    "EM111", node,
+                    f"{kind} {name!r} registered without the "
+                    f"{_EM111_PREFIX!r} namespace prefix — every edgemesh "
+                    "metric shares one namespace so dashboards and scrape "
+                    "relabeling can select the whole family",
+                )
+            if kind == "counter" and not name.endswith("_total"):
+                self._emit(
+                    "EM111", node,
+                    f"counter {name!r} must end '_total' (the Prometheus "
+                    "convention for monotone totals; rate() tooling keys "
+                    "on it)",
+                )
+            elif kind != "counter" and name.endswith("_total"):
+                self._emit(
+                    "EM111", node,
+                    f"{kind} {name!r} must not end '_total' — that suffix "
+                    "is reserved for counters, and a non-monotone series "
+                    "named like one breaks every rate() query over it",
+                )
 
     # -- EM102 -------------------------------------------------------------
 

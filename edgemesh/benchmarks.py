@@ -786,6 +786,192 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
         shutil.rmtree(log_dir, ignore_errors=True)
 
 
+def adaptive_router_benchmark(n_requests: int = 24, concurrency: int = 6,
+                              max_new: int = 8, slow_layers: int = 6,
+                              slow_hidden: int = 128,
+                              slow_max_new: int = 32) -> dict[str, Any]:
+    """Telemetry-driven routing vs least-outstanding on a SKEWED fleet.
+
+    Three in-process continuous replicas: two fast (tiny default model) and
+    one deliberately degraded (``slow_layers``/``slow_hidden`` + a
+    ``slow_max_new`` token budget — genuinely slower prefill AND decode,
+    the "one bad edge device" scenario of the profiling-driven-placement
+    line). Two arms run the identical concurrent workload through the real
+    fleet frontend:
+
+    - ``least_outstanding``: the pre-telemetry default — queue depth is the
+      only signal, so the idle slow replica keeps winning picks and every
+      request routed there drags the tail.
+    - ``telemetry`` + ``hedge_auto``: the zero-config adaptive router —
+      replicas weighted by the load digests their ``/readyz`` bodies ship
+      (refreshed by the health prober), hedge delay auto-tuned to the live
+      decayed p95. No thresholds configured anywhere.
+
+    Reported: p50/p99 per arm, the p99 ratio (the headline —
+    ``adaptive_over_least_outstanding_p99`` > 1 means the telemetry loop
+    wins), SLO goodput per arm against a target derived from the fast
+    replicas' warmup latency, and how many requests each arm actually sent
+    to the degraded replica (the mechanism, checkable from the artifact)."""
+    import threading
+
+    import numpy as np
+
+    from edgemesh.agents.orchestrator import Ensemble, build_agent
+    from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
+    from edgemesh.fleet import (
+        FleetRouter,
+        HealthProber,
+        HttpTransport,
+        ReplicaRegistry,
+        serve_fleet,
+    )
+    from edgemesh.obs import Registry
+    from edgemesh.serve import serve_rest
+
+    transport = HttpTransport()
+
+    def _replica(model: ModelSpec, budget: int):
+        agent = build_agent(AgentSpec(
+            role="qa", model=model,
+            sampling=SamplingParams(max_new_tokens=budget, do_sample=False,
+                                    repetition_penalty=1.0),
+        ))
+        return serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1",
+                          port=0, block=False, continuous=True, batch=2,
+                          registry=Registry(), trace_sample=0.0)
+
+    _progress("adaptive-router: building 2 fast + 1 degraded replica")
+    servers = {
+        # Registered FIRST so least_outstanding's tie-break prefers it —
+        # the worst case the telemetry balancer must route around.
+        "slow": _replica(ModelSpec(num_layers=slow_layers,
+                                   hidden_size=slow_hidden), slow_max_new),
+        "fast-0": _replica(ModelSpec(), max_new),
+        "fast-1": _replica(ModelSpec(), max_new),
+    }
+    urls = {rid: f"http://127.0.0.1:{srv.server_address[1]}"
+            for rid, srv in servers.items()}
+    payload = {"question": "benchmark question, please answer?"}
+
+    def _percentile(xs, q):
+        return round(float(np.percentile(xs, q)), 6)
+
+    try:
+        # Warm every replica (compiles + seeds its digest EWMAs) and
+        # derive the SLO target from the FAST replicas' steady state.
+        fast_lats = []
+        for rid, url in urls.items():
+            for _ in range(2):
+                t0 = time.perf_counter()
+                status, _ = transport.post_json(f"{url}/generate", payload,
+                                                timeout_s=600.0)
+                if status != 200:
+                    raise RuntimeError(f"warmup on {rid} answered {status}")
+                lat = time.perf_counter() - t0
+            if rid.startswith("fast"):
+                fast_lats.append(lat)  # second (post-compile) request only
+        slo_target_s = max(4.0 * float(np.median(fast_lats)), 0.25)
+
+        def run_arm(balancer: str, hedge_auto: bool):
+            obs = Registry()
+            registry = ReplicaRegistry(list(urls.items()))
+            prober = HealthProber(registry, transport=transport,
+                                  interval_s=0.25, obs_registry=obs).start()
+            prober.probe_once()  # digests fresh before the first pick
+            router = FleetRouter(
+                registry, balancer=balancer, transport=transport,
+                obs_registry=obs, hedge_auto=hedge_auto,
+                attempt_timeout_s=300.0, default_deadline_s=600.0,
+            )
+            front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+            gen_url = f"http://127.0.0.1:{front.server_address[1]}/generate"
+            lats: list[float] = []
+            errors: list[str] = []
+            lock = threading.Lock()
+            remaining = list(range(n_requests))
+
+            def worker():
+                while True:
+                    with lock:
+                        if not remaining:
+                            return
+                        i = remaining.pop()
+                    t0 = time.perf_counter()
+                    try:
+                        status, body = transport.post_json(
+                            gen_url, payload, timeout_s=600.0)
+                    except Exception as e:
+                        # A transport-level failure must fail the ARM, not
+                        # silently shrink the sample the percentiles and
+                        # goodput are computed over.
+                        with lock:
+                            errors.append(f"request {i}: {e}")
+                        continue
+                    lat = time.perf_counter() - t0
+                    with lock:
+                        if status != 200:
+                            errors.append(f"request {i}: {status} {body}")
+                        else:
+                            lats.append(lat)
+
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(concurrency)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            prober.stop()
+            front.shutdown()
+            if errors:
+                raise RuntimeError(f"{balancer} arm failed: {errors[:3]}")
+            summary = obs.summary(prefix="edgemesh_fleet_")
+            routed_slow = summary.get(
+                'edgemesh_fleet_routed_total{replica="slow"}', 0)
+            hedged = sum(v for k, v in summary.items()
+                         if k.startswith("edgemesh_fleet_hedged_total"))
+            goodput = sum(1 for v in lats if v <= slo_target_s) / len(lats)
+            return {
+                "p50_s": _percentile(lats, 50),
+                "p99_s": _percentile(lats, 99),
+                "goodput": round(goodput, 4),
+                "routed_to_slow": routed_slow,
+                "hedged": hedged,
+            }
+
+        _progress("adaptive-router: arm 1/2 least_outstanding")
+        lo = run_arm("least_outstanding", hedge_auto=False)
+        _progress("adaptive-router: arm 2/2 telemetry + auto hedge")
+        ad = run_arm("telemetry", hedge_auto=True)
+        ratio = round(lo["p99_s"] / ad["p99_s"], 4) if ad["p99_s"] else None
+        _progress(
+            f"adaptive-router: p99 {lo['p99_s'] * 1e3:.0f}ms LO vs "
+            f"{ad['p99_s'] * 1e3:.0f}ms adaptive ({ratio}x), goodput "
+            f"{lo['goodput']:.2f} -> {ad['goodput']:.2f}"
+        )
+        return {
+            "metric": "adaptive_over_least_outstanding_p99",
+            "value": ratio,
+            "unit": "x",
+            "n_requests": n_requests,
+            "concurrency": concurrency,
+            "slo_target_s": round(slo_target_s, 6),
+            "least_outstanding_p50_s": lo["p50_s"],
+            "least_outstanding_p99_s": lo["p99_s"],
+            "least_outstanding_goodput": lo["goodput"],
+            "least_outstanding_routed_to_slow": lo["routed_to_slow"],
+            "adaptive_p50_s": ad["p50_s"],
+            "adaptive_p99_s": ad["p99_s"],
+            "adaptive_goodput": ad["goodput"],
+            "adaptive_routed_to_slow": ad["routed_to_slow"],
+            "adaptive_hedged": ad["hedged"],
+        }
+    finally:
+        for srv in servers.values():
+            srv.shutdown()
+            if srv.batcher is not None:
+                srv.batcher.close()
+
+
 def ensemble_overlap_benchmark(n_agents: int = 2, questions: int = 3) -> dict[str, Any]:
     """Concurrent-vs-serial wall time for ensemble QA agents on disjoint
     submeshes — the measured version of the claim that edgemesh fixes the
@@ -1185,6 +1371,21 @@ def headline_benchmark(
         and os.environ.get("EDGEMESH_BENCH_SERVE", "1") == "1"
     ):
         _stage("admission", _admission)
+
+    # ---- Stage 7d: telemetry-driven adaptive routing vs least-outstanding
+    # on a skewed 3-replica fleet (tiny in-process replicas — the routing
+    # layer is under test, not the kernels). Pins the telemetry-loop win:
+    # adaptive_over_least_outstanding_p99 > 1 with zero tuning config.
+    # EDGEMESH_BENCH_FLEET=0 skips.
+    def _adaptive_router():
+        r = adaptive_router_benchmark()
+        out["adaptive_over_least_outstanding_p99"] = r["value"]
+        for k, v in r.items():
+            if k.startswith(("adaptive_", "least_outstanding_", "slo_target")):
+                out[k] = v
+
+    if os.environ.get("EDGEMESH_BENCH_FLEET", "1") == "1":
+        _stage("adaptive_router", _adaptive_router)
 
     # ---- Stage 8: speculative decoding at b1 (the latency regime) — on by
     # default since round 4 (EDGEMESH_BENCH_SPEC=0 skips): the reference

@@ -5,9 +5,14 @@ service (docs/FLEET.md is the operator-facing reference):
 
 - ``registry``: live replica membership + health state machine.
 - ``balancer``: round-robin / least-outstanding / prefix-affinity
-  (rendezvous-hashed so replica death only remaps its own prefixes).
-- ``health``: periodic ``/readyz`` probes with automatic demote/promote.
-- ``router``: deadlines, bounded jittered retries, tail-latency hedging,
+  (rendezvous-hashed so replica death only remaps its own prefixes) /
+  telemetry (weights replicas by the load digests their ``/readyz``
+  bodies ship — observed queue+prefill EWMAs, decaying to
+  least-outstanding when digests go stale).
+- ``health``: periodic ``/readyz`` probes with automatic demote/promote;
+  each probe also refreshes the replica's load digest for free.
+- ``router``: deadlines, bounded jittered retries, tail-latency hedging
+  (fixed, percentile, or auto-tuned from a decayed latency histogram),
   admission control (503 + Retry-After), graceful drain.
 - ``frontend``: the HTTP listener (``/generate``, ``/fleetz``,
   ``/metrics``, runtime ``/replicas/*`` membership).
@@ -24,6 +29,7 @@ from edgemesh.fleet.balancer import (  # noqa: F401
     LeastOutstandingBalancer,
     PrefixAffinityBalancer,
     RoundRobinBalancer,
+    TelemetryBalancer,
     make_balancer,
 )
 from edgemesh.fleet.frontend import serve_fleet  # noqa: F401

@@ -1,6 +1,6 @@
 """Pluggable balancers: how the router picks a replica for one request.
 
-Three policies, selected by name via ``make_balancer``:
+Four policies, selected by name via ``make_balancer``:
 
 - ``round_robin``: cycle registration order. Baseline; ignores load.
 - ``least_outstanding``: fewest in-flight requests wins (ties break by
@@ -15,10 +15,19 @@ Three policies, selected by name via ``make_balancer``:
   pseudo-random score and the max score wins, so when a replica dies ONLY
   its own keys remap — the surviving replicas keep every prefix they have
   already warmed (plain modulo hashing would reshuffle nearly all keys).
+- ``telemetry``: weight replicas by their OBSERVED load digests (queue +
+  prefill latency EWMAs shipped on ``/readyz``, refreshed by the health
+  prober — fleet/health.py) instead of outstanding counts alone — the
+  profiling-driven-placement thesis (PAPERS.md: arXiv 2605.25682,
+  TPI-LLM). Trust in a digest decays linearly with its receiver-side age
+  and hits zero at ``stale_after_s``, where the policy degrades to exactly
+  least-outstanding: stale telemetry must never outvote live queue depth,
+  and a cold replica (no digest yet) competes on its outstanding count
+  rather than starving (docs/FLEET.md "Adaptive routing").
 
 ``pick`` is called under the registry lock with a non-empty candidate list
-(fleet/registry.py ``acquire``), so reading ``outstanding`` is race-free
-and balancer state needs no extra locking.
+(fleet/registry.py ``acquire``), so reading ``outstanding``/``load`` is
+race-free and balancer state needs no extra locking.
 
 No jax imports — the router stack must stay importable on a host with no
 accelerator backend at all (same contract as edgemesh.obs).
@@ -27,6 +36,7 @@ accelerator backend at all (same contract as edgemesh.obs).
 from __future__ import annotations
 
 import hashlib
+import time
 from typing import Sequence
 
 
@@ -86,19 +96,97 @@ class PrefixAffinityBalancer:
         return chosen
 
 
+class TelemetryBalancer:
+    """Pick the replica with the lowest *observed* expected wait.
+
+    Each candidate is scored by its expected COMPLETION time in seconds —
+    the backlog it would queue behind plus the request's own expected
+    service there (an idle-but-slow replica must not win picks just
+    because it is idle)::
+
+        telem    = ewma_queue_s + ewma_prefill_s
+                   + (outstanding + 1) * ewma_service_s
+                   [+ compile_penalty_s while recent_compile]
+        cost     = freshness * telem
+                   + (1 - freshness) * outstanding * neutral_service_s
+
+    ``freshness`` decays linearly from 1 (digest just arrived) to 0 at
+    ``stale_after_s`` of receiver-side age, so the two regimes blend:
+    fully fresh digests route on observed queue+prefill latency (a slow or
+    compiling replica is avoided even when idle), fully stale ones reduce
+    the cost to ``outstanding * neutral_service_s`` — exactly
+    least-outstanding ordering, ties broken by registration order. A cold
+    replica with no digest at all has freshness 0 by definition: it is
+    never starved, it simply competes on live queue depth until its first
+    probe lands. ``outstanding`` is read live from the registry (not the
+    digest), so the loop self-limits between probe refreshes instead of
+    herding every request at the currently-fastest replica.
+    """
+
+    name = "telemetry"
+
+    def __init__(self, stale_after_s: float = 15.0,
+                 neutral_service_s: float = 0.1,
+                 compile_penalty_s: float = 0.5,
+                 now=time.monotonic) -> None:
+        if stale_after_s <= 0:
+            raise ValueError(f"stale_after_s must be > 0, got {stale_after_s}")
+        self.stale_after_s = float(stale_after_s)
+        self.neutral_service_s = float(neutral_service_s)
+        self.compile_penalty_s = float(compile_penalty_s)
+        self._now = now  # injectable: tests pin digest aging
+
+    def _cost(self, rep) -> float:
+        age = None
+        if getattr(rep, "load_ts", None) is not None:
+            age = self._now() - rep.load_ts
+        neutral = rep.outstanding * self.neutral_service_s
+        load = getattr(rep, "load", None)
+        if age is None or age >= self.stale_after_s or not isinstance(load, dict):
+            return neutral
+        freshness = max(0.0, 1.0 - age / self.stale_after_s)
+        queue = load.get("ewma_queue_s")
+        prefill = load.get("ewma_prefill_s")
+        service = load.get("ewma_service_s")
+        if queue is None and prefill is None and service is None:
+            # A digest with no latency telemetry yet (non-continuous
+            # gateway, or a continuous replica before its first request)
+            # must score like NO digest — scoring the nulls as zero cost
+            # would herd every pick at the least-instrumented replica.
+            return neutral
+        queue = queue or 0.0
+        prefill = prefill or 0.0
+        service = service if service is not None else (queue + prefill)
+        telem = queue + prefill + (rep.outstanding + 1) * service
+        if load.get("recent_compile"):
+            telem += self.compile_penalty_s
+        return freshness * telem + (1.0 - freshness) * neutral
+
+    def pick(self, candidates: Sequence, prompt: str | None = None):
+        return min(
+            enumerate(candidates), key=lambda t: (self._cost(t[1]), t[0])
+        )[1]
+
+
 BALANCERS = {
     "round_robin": RoundRobinBalancer,
     "least_outstanding": LeastOutstandingBalancer,
     "prefix_affinity": PrefixAffinityBalancer,
+    "telemetry": TelemetryBalancer,
 }
 
 
 def make_balancer(name: str, **kwargs):
-    """Build a balancer by policy name; unknown names list the choices."""
+    """Build a balancer by policy name. Unknown names list the choices;
+    kwargs a policy does not accept surface as a ValueError naming the
+    policy (not a bare TypeError from deep inside a constructor)."""
     try:
         cls = BALANCERS[name]
     except KeyError:
         raise ValueError(
             f"unknown balancer {name!r}; choose from {sorted(BALANCERS)}"
         ) from None
-    return cls(**kwargs)
+    try:
+        return cls(**kwargs)
+    except TypeError as e:
+        raise ValueError(f"bad arguments for balancer {name!r}: {e}") from e

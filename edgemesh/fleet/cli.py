@@ -48,7 +48,8 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--replica-port-base", type=int, default=0,
                      help="first replica port (0 = pick free ports)")
     srv.add_argument("--balancer", default="least_outstanding",
-                     choices=["round_robin", "least_outstanding", "prefix_affinity"])
+                     choices=["round_robin", "least_outstanding",
+                              "prefix_affinity", "telemetry"])
     srv.add_argument("--max-attempts", type=int, default=3)
     srv.add_argument("--deadline-s", type=float, default=60.0,
                      help="default per-request deadline (clients override "
@@ -59,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--hedge-percentile", type=float, default=0.0,
                      help="adaptive hedge at this observed-latency "
                      "percentile, e.g. 0.95 (0 = off)")
+    srv.add_argument("--hedge-auto", action="store_true",
+                     help="zero-config hedging: the delay auto-tunes to the "
+                     "live p95 of a time-decayed latency histogram "
+                     "(docs/FLEET.md 'Adaptive routing')")
     srv.add_argument("--max-inflight", type=int, default=64)
     srv.add_argument("--span-log", default=None,
                      help="router span JSONL: one router_spans record per "
@@ -179,6 +184,7 @@ def cmd_serve(args) -> int:
             attempt_timeout_s=args.attempt_timeout_s,
             hedge_after_s=args.hedge_after_s,
             hedge_percentile=args.hedge_percentile,
+            hedge_auto=args.hedge_auto,
             max_inflight=args.max_inflight,
             span_log=args.span_log,
             trace_sample=args.trace_sample,

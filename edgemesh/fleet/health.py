@@ -13,7 +13,11 @@ consecutive-failure/success accounting the router's passive checks use
   without operator action;
 - draining/removed replicas are still probed (their inflight count rides
   the ``/readyz`` body, which ``drain_replica`` polls) but never change
-  state from here.
+  state from here;
+- the ``/readyz`` body also piggybacks the replica's **load digest**
+  (queue depth, latency EWMAs, SLO goodput — serve/rest.py), which each
+  probe stores via ``registry.update_load`` — the telemetry balancer's
+  signal refreshes on the probe cadence with zero extra requests.
 
 Per-replica obs: ``edgemesh_fleet_probes_total{replica,result}`` and an
 ``edgemesh_fleet_replica_up{replica}`` gauge (1 healthy / 0 anything else)
@@ -63,8 +67,13 @@ class HealthProber:
         """Probe every registered replica once; returns {rid: state}."""
         states: dict[str, str] = {}
         for rep in self.registry.replicas():
-            ok, err = self._probe(rep)
+            ok, err, load = self._probe(rep)
             self._probes.labels(replica=rep.rid, result="ok" if ok else "fail").inc()
+            if load is not None:
+                # The digest piggybacks on the /readyz body (serve/rest.py)
+                # so the telemetry balancer's signal refreshes for free on
+                # the existing probe cadence — zero extra requests.
+                self.registry.update_load(rep.rid, load)
             state = self.registry.probe_result(
                 rep.rid, ok, healthy_after=self.healthy_after,
                 unhealthy_after=self.unhealthy_after, error=err,
@@ -74,17 +83,20 @@ class HealthProber:
                 self._up.labels(replica=rep.rid).set(1.0 if state == "healthy" else 0.0)
         return states
 
-    def _probe(self, rep) -> tuple[bool, str]:
+    def _probe(self, rep) -> tuple[bool, str, dict | None]:
         try:
-            status, _ = self.transport.get_json(
+            status, body = self.transport.get_json(
                 rep.url("/readyz"), timeout_s=self.timeout_s
             )
         except TransportError as e:
-            return False, str(e)
+            return False, str(e), None
+        load = body.get("load") if isinstance(body, dict) else None
+        if not isinstance(load, dict):
+            load = None  # pre-digest replicas: probe still works, no telemetry
         # /readyz answers 503 while draining — alive but not routable. The
         # registry keeps its draining state either way; for healthy/unhealthy
         # replicas only a 200 counts as ready.
-        return status == 200, "" if status == 200 else f"readyz status {status}"
+        return status == 200, "" if status == 200 else f"readyz status {status}", load
 
     # -- background loop -----------------------------------------------------
 

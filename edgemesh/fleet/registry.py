@@ -48,6 +48,15 @@ class Replica:
     last_probe_ts: float | None = None
     last_error: str = ""
     meta: dict = field(default_factory=dict)  # operator annotations (pid, ...)
+    # Latest load digest shipped on the replica's /readyz body (queue depth,
+    # latency EWMAs, SLO goodput, recent-compile flag — serve/rest.py), and
+    # the RECEIVER-side monotonic stamp the telemetry balancer ages it by
+    # (replica wall clocks skew; arrival time is the honest freshness).
+    load: dict | None = None
+    load_ts: float | None = None
+
+    def load_age_s(self) -> float | None:
+        return None if self.load_ts is None else time.monotonic() - self.load_ts
 
     def url(self, path: str) -> str:
         return self.base_url.rstrip("/") + path
@@ -67,6 +76,10 @@ class Replica:
             "last_probe_ts": self.last_probe_ts,
             "last_error": self.last_error,
             **({"meta": self.meta} if self.meta else {}),
+            **({
+                "load": self.load,
+                "load_age_s": round(self.load_age_s(), 3),
+            } if self.load is not None else {}),
         }
 
 
@@ -170,6 +183,19 @@ class ReplicaRegistry:
                     and rep.consecutive_failures >= demote_after
                 ):
                     rep.state = "unhealthy"
+
+    def update_load(self, rid: str, digest: dict | None) -> None:
+        """Store the replica's latest load digest (shipped on its /readyz
+        body — fleet/health.py refreshes it on every probe). The freshness
+        stamp is local monotonic time: the telemetry balancer decays its
+        trust in the digest by receiver-side age, never replica clocks."""
+        if not isinstance(digest, dict):
+            return
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None:
+                rep.load = digest
+                rep.load_ts = time.monotonic()
 
     def probe_result(self, rid: str, ok: bool, healthy_after: int = 1,
                      unhealthy_after: int = 2, error: str = "") -> str | None:

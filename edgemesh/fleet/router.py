@@ -16,11 +16,13 @@ robustness semantics on top of the replica registry:
   standard thundering-herd dampener), each retry on a *different* replica
   (failed ones are excluded; exclusions reset only when every replica has
   failed once). 4xx are the client's problem and return immediately.
-- **Hedging.** With ``hedge_after_s`` (fixed) or ``hedge_percentile``
-  (adaptive over a rolling window of observed attempt latencies), an
-  attempt that outlives the hedge delay gets a second attempt fired at
-  another replica; first good answer wins, the loser is abandoned. This
-  converts a stalled replica's tail into one extra request of load.
+- **Hedging.** With ``hedge_after_s`` (fixed), ``hedge_percentile``
+  (rolling window of observed attempt latencies), or ``hedge_auto`` (the
+  zero-config mode: the live p95 of a time-DECAYED latency histogram,
+  obs/slo.DecayingQuantile, floored at ``hedge_floor_s``), an attempt
+  that outlives the hedge delay gets a second attempt fired at another
+  replica; first good answer wins, the loser is abandoned. This converts
+  a stalled replica's tail into one extra request of load.
 - **Admission control.** A bounded in-flight slot pool: past
   ``max_inflight`` the router sheds with 503 + ``Retry-After`` instead of
   queueing unboundedly — overload stays visible at the edge.
@@ -53,6 +55,7 @@ from collections import deque
 
 from edgemesh.fleet.balancer import make_balancer
 from edgemesh.fleet.transport import HttpTransport, TransportError
+from edgemesh.obs.slo import DecayingQuantile
 from edgemesh.obs.trace import ROUTER_RECORD_EVENT, TraceContext, sample
 from edgemesh.serve.httputil import DEADLINE_HEADER, TRACE_HEADER
 
@@ -74,6 +77,10 @@ class FleetRouter:
         attempt_timeout_s: float = 30.0,
         hedge_after_s: float = 0.0,
         hedge_percentile: float = 0.0,
+        hedge_auto: bool = False,
+        hedge_quantile: float = 0.95,
+        hedge_floor_s: float = 0.02,
+        latency_window: int = 256,
         max_inflight: int = 64,
         demote_after: int = 2,
         rng: random.Random | None = None,
@@ -93,6 +100,15 @@ class FleetRouter:
         self.attempt_timeout_s = attempt_timeout_s
         self.hedge_after_s = hedge_after_s
         self.hedge_percentile = hedge_percentile
+        # Auto-tuned hedging (the zero-config mode): the delay is the live
+        # hedge_quantile (default p95) of a time-DECAYED latency histogram
+        # (obs/slo.DecayingQuantile), floored at hedge_floor_s so uniformly
+        # fast fleets don't hedge every request into double load. Needs no
+        # threshold config and tracks regime changes within one half-life.
+        self.hedge_auto = bool(hedge_auto)
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_floor_s = float(hedge_floor_s)
+        self._hedge_estimator = DecayingQuantile()
         self.max_inflight = max_inflight
         self.demote_after = demote_after
         self._rng = rng or random.Random(0)
@@ -112,11 +128,13 @@ class FleetRouter:
             self._trace_log = JsonlLogger(span_log)
         self._recent_traces: deque[dict] = deque(maxlen=64)
         self._slots = threading.BoundedSemaphore(max_inflight)
-        # Rolling successful-attempt latencies for the adaptive hedge delay.
-        # Locked: sorting the deque while another handler thread appends
-        # raises "deque mutated during iteration".
+        # Rolling successful-attempt latencies: an explicit bounded ring
+        # (``latency_window``, surfaced in /fleetz) feeding the legacy
+        # ``hedge_percentile`` mode; the auto mode reads the decayed
+        # estimator instead. Locked: sorting the deque while another
+        # handler thread appends raises "deque mutated during iteration".
         self._lat_lock = threading.Lock()
-        self._lat_window: deque[float] = deque(maxlen=256)
+        self._lat_window: deque[float] = deque(maxlen=max(1, int(latency_window)))
 
         reg = obs_registry or get_registry()
         self.obs = reg
@@ -156,6 +174,16 @@ class FleetRouter:
             "edgemesh_fleet_router_seconds",
             "End-to-end router request latency (admission to answer)",
         )
+        # Outcome-labeled twin of the histogram above: failures and sheds
+        # stop being invisible in the latency distribution. The unlabeled
+        # family keeps its original successful-requests-only semantics for
+        # dashboard compatibility (a family cannot be re-registered with a
+        # new labelset); this one observes EVERY request.
+        self._latency_outcome = reg.histogram(
+            "edgemesh_fleet_router_outcome_seconds",
+            "Router request latency by outcome "
+            "(ok/retried/hedged_won/shed/exhausted)", ("outcome",),
+        )
 
     # -- request path --------------------------------------------------------
 
@@ -178,6 +206,10 @@ class FleetRouter:
             "t0": time.time(), "t1": None,
         }]
         t0 = time.monotonic()
+        # One outcome per request for the labeled latency histogram:
+        # ok / retried / hedged_won / shed / exhausted. _route/_dispatch
+        # refine it in place as the request's fate lands.
+        meta = {"outcome": "shed"}
         if not self._slots.acquire(blocking=False):
             self._shed.labels(reason="overload").inc()
             status, body, headers = 503, {
@@ -187,11 +219,14 @@ class FleetRouter:
             self._inflight_gauge.inc()
             try:
                 status, body, headers = self._route(
-                    payload, t0, deadline_s, path, ctx, spans
+                    payload, t0, deadline_s, path, ctx, spans, meta
                 )
             finally:
                 self._inflight_gauge.dec()
                 self._slots.release()
+        self._latency_outcome.labels(outcome=meta["outcome"]).observe(
+            time.monotonic() - t0
+        )
         headers = dict(headers)
         headers[TRACE_HEADER] = ctx.to_header()
         self._finish_trace(ctx, spans, status)
@@ -223,7 +258,8 @@ class FleetRouter:
             fields["spans"] = [dict(s) for s in spans]
             self._trace_log.log(ROUTER_RECORD_EVENT, **fields)
 
-    def _route(self, payload, t0, deadline_s, path, ctx, spans):
+    def _route(self, payload, t0, deadline_s, path, ctx, spans, meta=None):
+        meta = meta if meta is not None else {"outcome": "shed"}
         deadline = t0 + (deadline_s if deadline_s is not None else self.default_deadline_s)
         prompt = payload.get("question") if isinstance(payload, dict) else None
         excluded: set[str] = set()
@@ -232,6 +268,7 @@ class FleetRouter:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 self._shed.labels(reason="deadline").inc()
+                meta["outcome"] = "shed"
                 return 504, {"error": "deadline exceeded", "attempts": attempt,
                              "last_error": last_error}, {}
             rep = self.registry.acquire(self.balancer, prompt=prompt, exclude=excluded)
@@ -242,14 +279,17 @@ class FleetRouter:
                 rep = self.registry.acquire(self.balancer, prompt=prompt, exclude=excluded)
             if rep is None:
                 self._shed.labels(reason="no_replica").inc()
+                meta["outcome"] = "shed"
                 return 503, {"error": "no available replica"}, {"Retry-After": "1"}
             outcome = self._dispatch(rep, payload, path, deadline, prompt,
-                                     excluded, ctx, spans)
+                                     excluded, ctx, spans, meta)
             if outcome[0] == "ok":
                 _, rid, status, body, won_span = outcome
                 won_span["won"] = True
                 self._routed.labels(replica=rid).inc()
                 self._latency.observe(time.monotonic() - t0)
+                if meta["outcome"] != "hedged_won":
+                    meta["outcome"] = "retried" if attempt else "ok"
                 return status, body, {
                     "X-Edgemesh-Replica": rid,
                     "X-Edgemesh-Attempts": str(attempt + 1),
@@ -265,6 +305,7 @@ class FleetRouter:
                     self._retried.labels(replica=rid, reason=reason).inc()
                 self._sleep(self._backoff(attempt, deadline))
         self._exhausted.inc()
+        meta["outcome"] = "exhausted"
         return 502, {"error": "all attempts failed",
                      "attempts": self.max_attempts,
                      "last_error": last_error}, {}
@@ -324,12 +365,19 @@ class FleetRouter:
             close(f"status_{status}", status)
             return ("fail", rep.rid, f"status_{status}", str(body.get("error", body))[:200])
         self.registry.release(rep.rid, ok=True)
+        lat = time.monotonic() - t0
         with self._lat_lock:
-            self._lat_window.append(time.monotonic() - t0)
+            self._lat_window.append(lat)
+        self._hedge_estimator.observe(lat)
         close("ok", status)
         return ("ok", rep.rid, status, body, span)
 
     def _hedge_delay(self) -> float | None:
+        """The current hedge-arming delay: fixed (``hedge_after_s``) beats
+        the legacy rolling-window percentile (``hedge_percentile``) beats
+        the auto-tuned mode (``hedge_auto``: the live ``hedge_quantile`` of
+        the time-decayed latency histogram, floored at ``hedge_floor_s``).
+        None = hedging off (or the estimator has not seen enough yet)."""
         if self.hedge_after_s:
             return self.hedge_after_s
         if self.hedge_percentile:
@@ -337,15 +385,20 @@ class FleetRouter:
                 xs = sorted(self._lat_window)
             if len(xs) >= 16:
                 return xs[min(len(xs) - 1, int(self.hedge_percentile * len(xs)))]
+            return None
+        if self.hedge_auto:
+            d = self._hedge_estimator.quantile(self.hedge_quantile)
+            return None if d is None else max(d, self.hedge_floor_s)
         return None
 
     def _dispatch(self, rep, payload, path, deadline, prompt, excluded,
-                  ctx, spans):
+                  ctx, spans, meta=None):
         """One attempt round, hedged when configured. Returns
         ("ok", rid, status, body) or ("fail", [(rid, reason, detail), ...]).
         Every attempt (primary and hedge) gets its own child trace context
         — distinct span ids are what let the assembled tree show the hedge
         as a sibling of the attempt it raced."""
+        meta = meta if meta is not None else {"outcome": "shed"}
         hedge_delay = self._hedge_delay()
         if hedge_delay is None or hedge_delay >= (deadline - time.monotonic()):
             out = self._attempt_one(rep, payload, path, deadline,
@@ -403,6 +456,7 @@ class FleetRouter:
             if out[0] == "ok":
                 if is_hedge:
                     self._hedged_won.labels(replica=out[1]).inc()
+                    meta["outcome"] = "hedged_won"
                 return out
             failures.append(out[1:])
         return ("fail", failures or [(rep.rid, "hedge", "no attempt completed")])
@@ -507,10 +561,30 @@ class FleetRouter:
         return doc
 
     def status(self) -> dict:
+        with self._lat_lock:
+            window_len = len(self._lat_window)
+            window_size = self._lat_window.maxlen
+        delay = self._hedge_delay()
+        if self.hedge_after_s:
+            hedge_mode = "fixed"
+        elif self.hedge_percentile:
+            hedge_mode = "percentile"
+        elif self.hedge_auto:
+            hedge_mode = "auto"
+        else:
+            hedge_mode = "off"
         return {
             "balancer": getattr(self.balancer, "name", type(self.balancer).__name__),
             "max_inflight": self.max_inflight,
             "max_attempts": self.max_attempts,
+            # The successful-attempt latency ring backing the legacy
+            # percentile hedge: explicit bound + live fill level.
+            "latency_window": {"size": window_size, "len": window_len},
+            "hedge": {
+                "mode": hedge_mode,
+                "delay_s": None if delay is None else round(delay, 6),
+                "estimator_weight": round(self._hedge_estimator.weight(), 3),
+            },
             "replicas": self.registry.snapshot(),
             "metrics": self.obs.summary(prefix="edgemesh_fleet_"),
             "recent_traces": self.recent_traces(),

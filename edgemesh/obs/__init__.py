@@ -14,6 +14,9 @@ Three pieces (docs/OBSERVABILITY.md is the operator-facing reference):
   fleet router propagates to replicas, cross-process trace assembly with
   clock-skew correction (``edgemesh obs trace``), and the JAX
   compile-telemetry hook.
+- ``slo``: SLO goodput — TTFT/TPOT targets, per-request classification
+  (``edgemesh_slo_goodput_ratio``), and the decayed latency quantiles the
+  fleet router's hedge auto-tuner reads.
 
 Importing this package never imports jax — device sampling defers the
 import to scrape time, so the supervisor and the ``edgemesh obs`` CLI stay
@@ -28,6 +31,12 @@ from edgemesh.obs.metrics import (  # noqa: F401
     get_registry,
     set_registry,
 )
+from edgemesh.obs.slo import (  # noqa: F401
+    DecayingQuantile,
+    SloTarget,
+    SloTracker,
+    StreamMeter,
+)
 from edgemesh.obs.spans import (  # noqa: F401
     RequestTrace,
     SpanTracker,
@@ -41,6 +50,7 @@ from edgemesh.obs.trace import (  # noqa: F401
     current_trace,
     install_compile_hook,
     load_trace,
+    seconds_since_last_compile,
     uninstall_compile_hook,
     use_trace,
 )

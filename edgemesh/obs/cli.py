@@ -7,7 +7,9 @@ Subcommands (all operate on the span JSONL the engines write via
   human line each (rid, status, generated, queue/TTFT/latency).
 - ``summary <spans.jsonl>``: replay the log into a fresh registry and print
   a JSON aggregate report (request counts by status, token totals, latency
-  histograms as count/sum/mean) plus percentile estimates.
+  histograms as count/sum/mean) plus percentile estimates — including
+  TTFT/TPOT p50/p99 and the SLO goodput ratio when the log carries the
+  ``slo_result`` field (older logs report them as null, exit 0).
 - ``prom <spans.jsonl>``: the same replay, rendered as Prometheus text
   exposition — byte-for-byte the format a live ``/metrics`` scrape serves,
   so offline logs and live scrapes feed the same dashboards.
@@ -106,6 +108,16 @@ def cmd_summary(path: str) -> int:
     lats = sorted(r["latency_s"] for r in spans
                   if r.get("latency_s") is not None)
     ttfts = sorted(r["ttft_s"] for r in spans if r.get("ttft_s") is not None)
+    # TPOT = the record's mean inter-token latency (itl_s). SLO fields are
+    # None on logs that predate them — an old log is an answer, not an
+    # error, and the report shape stays stable either way.
+    tpots = sorted(r["itl_s"] for r in spans if r.get("itl_s") is not None)
+    classified = [r["slo_result"] for r in spans
+                  if r.get("slo_result") is not None]
+    goodput = (
+        round(sum(1 for c in classified if c == "good") / len(classified), 4)
+        if classified else None
+    )
 
     def pct(xs: list[float], q: float):
         if not xs:
@@ -119,6 +131,11 @@ def cmd_summary(path: str) -> int:
         "latency_s_p95": pct(lats, 0.95),
         "ttft_s_p50": pct(ttfts, 0.50),
         "ttft_s_p95": pct(ttfts, 0.95),
+        "ttft_s_p99": pct(ttfts, 0.99),
+        "tpot_s_p50": pct(tpots, 0.50),
+        "tpot_s_p99": pct(tpots, 0.99),
+        "slo_classified": len(classified),
+        "slo_goodput_ratio": goodput,
         "metrics": registry.summary(),
     }, indent=2))
     return 0

@@ -1,0 +1,304 @@
+"""SLO goodput instrumentation + streaming latency estimation.
+
+Raw tok/s is the wrong serving headline: a fleet can post a huge aggregate
+throughput while every interactive user waits three seconds for a first
+token. The serving-quality number that matters is **SLO goodput** — the
+fraction of requests that met their latency targets (ROADMAP "million-user
+load harness"; the TPI-LLM / profiling-driven-edge line reports the same
+way). Two targets define interactive quality:
+
+- **TTFT** (time to first token): submit → first decoded token.
+- **TPOT** (time per output token): mean inter-token latency after the
+  first token — the streaming "typing speed".
+
+Three pieces, all jax-free (same import contract as the rest of
+``edgemesh.obs``):
+
+- :class:`SloTarget` — the configurable targets (env:
+  ``EDGEMESH_SLO_TTFT_S`` / ``EDGEMESH_SLO_TPOT_S``).
+- :class:`SloTracker` — classifies each finished request against the
+  target and feeds ``edgemesh_slo_requests_total{engine,result}`` plus the
+  ``edgemesh_slo_goodput_ratio{engine}`` gauge. ``SpanTracker`` owns one
+  per engine (obs/spans.py) and stamps the classification into the span
+  JSONL record (``slo_result``) so ``edgemesh obs summary`` can report
+  goodput offline.
+- :class:`DecayingQuantile` — a time-decayed bucketed latency estimator
+  (counts halve every ``half_life_s``) whose ``quantile(q)`` the fleet
+  router reads to auto-tune its hedge delay from the LIVE p95 instead of a
+  fixed threshold (fleet/router.py).
+
+:class:`StreamMeter` adapts the raw streaming path
+(``runtime/generate_stream``) onto the same histograms: per-chunk elapsed
+timestamps become TTFT/TPOT observations under ``engine="stream"``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from edgemesh.obs.metrics import (
+    INTER_TOKEN_BUCKETS,
+    LATENCY_BUCKETS,
+    Registry,
+    get_registry,
+)
+
+#: Default interactive targets: a first token within 2 s and a sustained
+#: 5 tok/s typing speed. Override per deployment via env or constructor.
+DEFAULT_TTFT_S = 2.0
+DEFAULT_TPOT_S = 0.2
+
+#: Every value the ``result`` label can take: ``good`` met both targets;
+#: ``ttft``/``tpot``/``ttft_tpot`` name what was missed; ``error`` is a
+#: request that never finished cleanly (always a miss).
+SLO_RESULTS = ("good", "ttft", "tpot", "ttft_tpot", "error")
+
+
+@dataclass(frozen=True)
+class SloTarget:
+    """One serving-quality contract: TTFT and TPOT ceilings in seconds."""
+
+    ttft_s: float = DEFAULT_TTFT_S
+    tpot_s: float = DEFAULT_TPOT_S
+
+    @classmethod
+    def from_env(cls) -> "SloTarget":
+        """Targets from ``EDGEMESH_SLO_TTFT_S``/``EDGEMESH_SLO_TPOT_S``
+        (falling back to the defaults) — how a replica subprocess is
+        configured without new CLI plumbing at every call site."""
+        def _f(name: str, default: float) -> float:
+            raw = os.environ.get(name)
+            if not raw:
+                return default
+            try:
+                v = float(raw)
+            except ValueError:
+                return default
+            return v if v > 0 else default
+
+        return cls(ttft_s=_f("EDGEMESH_SLO_TTFT_S", DEFAULT_TTFT_S),
+                   tpot_s=_f("EDGEMESH_SLO_TPOT_S", DEFAULT_TPOT_S))
+
+
+class SloTracker:
+    """Classifies finished requests against an :class:`SloTarget` and
+    exposes the running goodput ratio as registry metrics."""
+
+    def __init__(self, registry: Registry | None = None,
+                 engine: str = "continuous",
+                 target: SloTarget | None = None):
+        self.registry = registry if registry is not None else get_registry()
+        self.engine = engine
+        self.target = target if target is not None else SloTarget.from_env()
+        self._requests = self.registry.counter(
+            "edgemesh_slo_requests_total",
+            "Requests classified against the TTFT/TPOT SLO target, by result",
+            ("engine", "result"))
+        # Family handle only — the labeled child is created on the first
+        # classification, so an idle engine scrapes NO goodput sample
+        # instead of a misleading 0.0.
+        self._goodput_family = self.registry.gauge(
+            "edgemesh_slo_goodput_ratio",
+            "Fraction of classified requests that met BOTH SLO targets",
+            ("engine",))
+        self._target_gauge = self.registry.gauge(
+            "edgemesh_slo_target_seconds",
+            "The active SLO target, by kind (ttft/tpot)", ("engine", "kind"))
+        self._target_gauge.labels(engine=engine, kind="ttft").set(self.target.ttft_s)
+        self._target_gauge.labels(engine=engine, kind="tpot").set(self.target.tpot_s)
+        self._lock = threading.Lock()
+        self._good = 0
+        self._classified = 0
+
+    def classify(self, status: str, ttft_s: float | None,
+                 tpot_s: float | None) -> str:
+        """Pure classification — no counting. A request that produced no
+        first token (``ttft_s`` None) missed TTFT by definition; ``tpot_s``
+        None (single-token answers) cannot miss TPOT."""
+        if status != "ok":
+            return "error"
+        miss_ttft = ttft_s is None or ttft_s > self.target.ttft_s
+        miss_tpot = tpot_s is not None and tpot_s > self.target.tpot_s
+        if miss_ttft and miss_tpot:
+            return "ttft_tpot"
+        if miss_ttft:
+            return "ttft"
+        if miss_tpot:
+            return "tpot"
+        return "good"
+
+    def record(self, status: str, ttft_s: float | None,
+               tpot_s: float | None) -> str:
+        result = self.classify(status, ttft_s, tpot_s)
+        self.count(result)
+        return result
+
+    def count(self, result: str) -> None:
+        """Feed one pre-classified result (the live path after
+        :meth:`classify`; also the replay path — ``replay_spans`` counts
+        the ``slo_result`` stamped into each span record)."""
+        self._requests.labels(engine=self.engine, result=result).inc()
+        with self._lock:
+            self._classified += 1
+            if result == "good":
+                self._good += 1
+            ratio = self._good / self._classified
+        self._goodput_family.labels(engine=self.engine).set(ratio)
+
+    def goodput_ratio(self) -> float | None:
+        with self._lock:
+            if not self._classified:
+                return None
+            return self._good / self._classified
+
+
+# ---------------------------------------------------------------------------
+# Decayed latency quantiles (the router's hedge auto-tuner)
+# ---------------------------------------------------------------------------
+
+#: Geometric bucket bounds 0.5 ms → ~100 s: fine enough that a p95 read is
+#: within ~30% of the true value, coarse enough that decay costs one array
+#: scale per observation.
+_DECAY_BOUNDS = tuple(0.0005 * (1.3 ** i) for i in range(48))
+
+
+class DecayingQuantile:
+    """Bucketed latency distribution whose counts halve every
+    ``half_life_s`` — a sliding-window percentile without storing samples.
+
+    ``quantile(q)`` answers from the decayed counts with linear
+    interpolation inside the winning bucket, or ``None`` until at least
+    ``min_weight`` worth of (decayed) observations accumulated — an
+    estimator with three samples must not arm a hedge."""
+
+    def __init__(self, half_life_s: float = 60.0,
+                 bounds: tuple[float, ...] = _DECAY_BOUNDS,
+                 min_weight: float = 16.0,
+                 now=time.monotonic):
+        self.half_life_s = float(half_life_s)
+        self.bounds = tuple(bounds)
+        self.min_weight = float(min_weight)
+        self._now = now
+        self._lock = threading.Lock()
+        self._counts = [0.0] * (len(self.bounds) + 1)  # last = overflow
+        self._last_decay = now()
+
+    def _decay_locked(self) -> None:  # guarded by: _lock
+        t = self._now()
+        dt = t - self._last_decay
+        if dt <= 0:
+            return
+        scale = 0.5 ** (dt / self.half_life_s)
+        self._counts = [c * scale for c in self._counts]
+        self._last_decay = t
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._decay_locked()
+            for i, b in enumerate(self.bounds):
+                if value <= b:
+                    self._counts[i] += 1.0
+                    return
+            self._counts[-1] += 1.0
+
+    def weight(self) -> float:
+        with self._lock:
+            self._decay_locked()
+            return sum(self._counts)
+
+    def quantile(self, q: float) -> float | None:
+        with self._lock:
+            self._decay_locked()
+            total = sum(self._counts)
+            if total < self.min_weight:
+                return None
+            target = q * total
+            acc = 0.0
+            for i, c in enumerate(self._counts):
+                if c <= 0:
+                    continue
+                if acc + c >= target:
+                    lo = self.bounds[i - 1] if i > 0 else 0.0
+                    hi = (self.bounds[i] if i < len(self.bounds)
+                          else self.bounds[-1])
+                    frac = min(1.0, max(0.0, (target - acc) / c))
+                    return lo + (hi - lo) * frac
+                acc += c
+            return self.bounds[-1]
+
+
+# ---------------------------------------------------------------------------
+# Raw streaming path → the same TTFT/TPOT histograms
+# ---------------------------------------------------------------------------
+
+# One SloTracker per (registry, engine), cached ON the registry object: a
+# StreamMeter is per-stream (it holds per-stream TTFT state), but the
+# goodput ratio is a RUNNING fraction — a fresh tracker per stream would
+# reset the gauge to the last stream's lone 0/1 verdict, contradicting the
+# slo_requests_total counters right next to it.
+_shared_slo_lock = threading.Lock()
+
+
+def _shared_slo(registry: Registry, engine: str,
+                target: SloTarget | None) -> SloTracker:
+    with _shared_slo_lock:
+        cache = registry.__dict__.setdefault("_edgemesh_slo_trackers", {})
+        tracker = cache.get(engine)
+        if tracker is None:
+            tracker = cache[engine] = SloTracker(registry, engine=engine,
+                                                 target=target)
+        return tracker
+
+
+class StreamMeter:
+    """Feeds ``generate_stream``'s per-chunk elapsed timestamps into the
+    serving TTFT/TPOT histograms (``engine="stream"``) and the SLO tracker.
+
+    One meter per stream; single-consumer (a generator is). TTFT is the
+    elapsed time at the first token-bearing chunk — for chunked streaming
+    that is the first yield the CLIENT can observe, which is the honest
+    user-facing number. TPOT observations are per-chunk
+    ``Δelapsed / tokens`` weighted by token count, so a segment costs one
+    histogram lock acquisition, not one per token."""
+
+    def __init__(self, registry: Registry | None = None,
+                 engine: str = "stream", target: SloTarget | None = None):
+        reg = registry if registry is not None else get_registry()
+        self._ttft = reg.histogram(
+            "edgemesh_ttft_seconds",
+            "submit() to first decoded token", ("engine",),
+            buckets=LATENCY_BUCKETS).labels(engine=engine)
+        self._tpot = reg.histogram(
+            "edgemesh_inter_token_seconds",
+            "Mean per-token decode latency after the first token",
+            ("engine",), buckets=INTER_TOKEN_BUCKETS).labels(engine=engine)
+        # Shared per (registry, engine): the goodput ratio must accumulate
+        # across streams, not reset with each meter. The first meter's
+        # target wins for that registry+engine.
+        self.slo = _shared_slo(reg, engine, target)
+        self._ttft_s: float | None = None
+        self._last_elapsed = 0.0
+        self._tokens = 0
+
+    def chunk(self, elapsed_s: float, new_tokens: int) -> None:
+        new_tokens = int(new_tokens)
+        if new_tokens > 0 and self._ttft_s is None:
+            # First token-bearing chunk: TTFT only. Its elapsed window mixes
+            # prefill with decode, so per-token credit starts next chunk.
+            self._ttft_s = elapsed_s
+            self._ttft.observe(elapsed_s)
+        elif new_tokens > 0:
+            per_tok = (elapsed_s - self._last_elapsed) / new_tokens
+            self._tpot.observe(per_tok, count=new_tokens)
+        if new_tokens > 0:
+            self._last_elapsed = elapsed_s
+            self._tokens += new_tokens
+
+    def finish(self, status: str = "ok") -> str:
+        tpot = None
+        if self._ttft_s is not None and self._tokens > 1:
+            tpot = (self._last_elapsed - self._ttft_s) / (self._tokens - 1)
+        return self.slo.record(status, self._ttft_s, tpot)

@@ -20,6 +20,7 @@ summary``/``prom`` do offline.
 from __future__ import annotations
 
 import random
+import threading
 import time
 from pathlib import Path
 from typing import Any, Iterable
@@ -30,9 +31,15 @@ from edgemesh.obs.metrics import (
     Registry,
     get_registry,
 )
+from edgemesh.obs.slo import SLO_RESULTS, SloTarget, SloTracker
 
 SPAN_RECORD_EVENT = "request_spans"
 RESET_RECORD_EVENT = "pool_reset"
+
+#: Load-digest EWMA smoothing: each observation carries 20% weight, so the
+#: digest tracks a regime change within ~5 requests while a single outlier
+#: moves it by at most a fifth (docs/OBSERVABILITY.md "Load digests").
+EWMA_ALPHA = 0.2
 
 
 class RequestTrace:
@@ -82,9 +89,22 @@ class SpanTracker:
     def __init__(self, registry: Registry | None = None,
                  span_log: str | Path | None = None,
                  engine: str = "continuous",
-                 trace_sample: float = 1.0):
+                 trace_sample: float = 1.0,
+                 slo_target: SloTarget | None = None):
         self.registry = registry or get_registry()
         self.engine = engine
+        # SLO classification (obs/slo.py): every retirement is judged
+        # against the TTFT/TPOT target (``slo_target``, default from env)
+        # and the verdict rides both the metrics and the span record.
+        self.slo = SloTracker(self.registry, engine=engine, target=slo_target)
+        # Latency EWMAs for the /loadz digest (load_digest): written by the
+        # engine worker via the lifecycle hooks, read by gateway HTTP
+        # threads — the lock keeps a digest read from pairing a new queue
+        # EWMA with a half-updated prefill one.
+        self._ewma_lock = threading.Lock()
+        self._ewma: dict[str, float | None] = {
+            "queue": None, "prefill": None, "decode": None, "service": None,
+        }
         # Span-I/O sampling for locally-originated requests (requests that
         # arrive with a trace context inherit ITS sampled bit instead, so
         # the router's decision is honored end to end). Sampled-out
@@ -177,6 +197,7 @@ class SpanTracker:
         trace.attrs.update(attrs)
         self._queue_wait.observe(t_adm - trace.t_submit)
         self._prefill.observe(now - t_adm)
+        self._ewma_update(queue=t_adm - trace.t_submit, prefill=now - t_adm)
 
     def segment_dispatched(self) -> None:
         self._segments.inc()
@@ -190,9 +211,10 @@ class SpanTracker:
         trace.span("decode", trace.t_last, now, tokens=int(n))
         trace.segments += 1
         trace.generated += int(n)
-        trace.t_last = now
         if n > 0:
             self._tokens.inc(n)
+            self._ewma_update(decode=(now - trace.t_last) / n)
+        trace.t_last = now
 
     def retire(self, trace: RequestTrace, status: str = "ok") -> float:
         """Close the trace, feed terminal aggregates, flush the JSONL record.
@@ -207,11 +229,14 @@ class SpanTracker:
             itl = (now - trace.t_first_token) / (trace.generated - 1)
             self._itl.observe(itl, count=trace.generated - 1)
         self._latency.observe(now - trace.t_submit)
+        self._ewma_update(service=now - trace.t_submit)
+        # SLO verdict: TTFT and TPOT (mean inter-token) against the target.
+        ttft = (
+            None if trace.t_first_token is None
+            else trace.t_first_token - trace.t_submit
+        )
+        slo_result = self.slo.record(status, ttft, itl)
         if self._log is not None and trace.sampled:
-            ttft = (
-                None if trace.t_first_token is None
-                else trace.t_first_token - trace.t_submit
-            )
             self._log.log(
                 SPAN_RECORD_EVENT,
                 rid=trace.rid, engine=self.engine, status=status,
@@ -231,6 +256,7 @@ class SpanTracker:
                     else trace.t_start - trace.t_admit_start
                 ),
                 ttft_s=ttft, itl_s=itl, latency_s=now - trace.t_submit,
+                slo_result=slo_result,
                 spans=trace.spans, **trace.attrs,
             )
         return now
@@ -240,6 +266,35 @@ class SpanTracker:
         if self._log is not None:
             self._log.log(RESET_RECORD_EVENT, engine=self.engine,
                           reason=reason)
+
+    # -- load digest (the /loadz feedback signal) ----------------------------
+
+    def _ewma_update(self, **obs: float) -> None:
+        with self._ewma_lock:
+            for key, value in obs.items():
+                prev = self._ewma[key]
+                self._ewma[key] = (
+                    value if prev is None
+                    else EWMA_ALPHA * value + (1.0 - EWMA_ALPHA) * prev
+                )
+
+    def load_digest(self) -> dict[str, Any]:
+        """The tracker's slice of the replica load digest: latency EWMAs
+        (``None`` until first observed) + the running SLO goodput. The
+        gateway merges in queue depth / inflight / the recent-compile flag
+        (serve/rest.py ``/loadz``); the fleet prober ships the result to
+        the router's :class:`~edgemesh.fleet.balancer.TelemetryBalancer`."""
+        with self._ewma_lock:
+            ew = dict(self._ewma)
+        rnd = {k: (None if v is None else round(v, 6)) for k, v in ew.items()}
+        ratio = self.slo.goodput_ratio()
+        return {
+            "ewma_queue_s": rnd["queue"],
+            "ewma_prefill_s": rnd["prefill"],
+            "ewma_decode_s": rnd["decode"],
+            "ewma_service_s": rnd["service"],
+            "slo_goodput_ratio": None if ratio is None else round(ratio, 4),
+        }
 
 
 def replay_spans(records: Iterable[dict] | str | Path,
@@ -286,4 +341,8 @@ def replay_spans(records: Iterable[dict] | str | Path,
             tr._itl.observe(rec["itl_s"], count=gen - 1)
         if rec.get("latency_s") is not None:
             tr._latency.observe(rec["latency_s"])
+        # SLO verdicts replay pre-classified (target-independent): logs
+        # from before the slo_result field simply skip the family.
+        if rec.get("slo_result") in SLO_RESULTS:
+            tr.slo.count(rec["slo_result"])
     return registry

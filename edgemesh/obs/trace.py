@@ -42,6 +42,7 @@ import contextvars
 import os
 import random
 import threading
+import time
 from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Iterable
@@ -450,6 +451,7 @@ class CompileEventHook:
         self._compiles.labels(event=key).inc()
         self._duration.labels(event=key).observe(duration_s)
         if key == self.BACKEND_COMPILE:
+            _mark_compile()
             with self._lock:
                 self._backend_compiles += 1
                 recompile = self._backend_compiles > 1
@@ -471,6 +473,26 @@ class CompileEventHook:
 _hook_lock = threading.Lock()
 _hooks: list[CompileEventHook] = []
 _listener_registered = False
+
+# Process-wide "a backend compile just happened" marker: the replica load
+# digest (serve/rest.py /loadz) flags a recent compile so the fleet's
+# telemetry balancer can treat the replica as warming up, not degraded.
+_last_compile_monotonic: float | None = None
+
+
+def _mark_compile() -> None:
+    global _last_compile_monotonic
+    with _hook_lock:
+        _last_compile_monotonic = time.monotonic()
+
+
+def seconds_since_last_compile() -> float | None:
+    """Seconds since the last observed backend compile in this process
+    (``None`` before the first one, or when the jax monitoring shim is
+    unavailable)."""
+    with _hook_lock:
+        ts = _last_compile_monotonic
+    return None if ts is None else time.monotonic() - ts
 
 
 def _dispatch(name: str, duration_s: float) -> None:

@@ -47,9 +47,16 @@ def generate_stream(
     eos_id: int = -1,
     rng: jax.Array | None = None,
     chunk: int = 16,
+    meter=None,
 ) -> Iterator[StreamChunk]:
     """Yield decode output every ``chunk`` tokens. Totals across chunks match
-    ``generate``'s budget/EOS semantics; greedy output matches it exactly."""
+    ``generate``'s budget/EOS semantics; greedy output matches it exactly.
+
+    ``meter`` is an :class:`edgemesh.obs.StreamMeter` (one fresh instance
+    per stream; default: process-default registry) — each yielded chunk
+    feeds the TTFT/TPOT histograms under ``engine="stream"`` and a normal
+    completion records the SLO verdict, so raw streaming callers report
+    serving quality through the same families the engines do."""
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     batch, prompt_len = tokens.shape
@@ -64,6 +71,10 @@ def generate_stream(
     from edgemesh.utils.platform import device_sync
     from edgemesh.utils.tracing import Stopwatch
 
+    if meter is None:
+        from edgemesh.obs import StreamMeter
+
+        meter = StreamMeter()
     # EM107: the elapsed window flows through the obs substrate's stopwatch
     # instead of raw perf_counter reads in the serving stack.
     wall = Stopwatch()
@@ -84,12 +95,15 @@ def generate_stream(
             token_mask, seg_rng, None, finished,
         )
         device_sync(out)
+        elapsed = wall.elapsed()
+        meter.chunk(elapsed, int(jnp.sum(counts)))
         yield StreamChunk(
             tokens=out, counts=counts, finished=finished,
-            elapsed_s=wall.elapsed(),
+            elapsed_s=elapsed,
         )
         remaining -= m
         if remaining <= 0 or bool(jnp.all(finished)):
+            meter.finish("ok")
             return
         # Bridge: the segment's last sampled token never had its forward run
         # (the loop stops before a wasted trailing step); run it now so the

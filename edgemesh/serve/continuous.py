@@ -513,6 +513,17 @@ class ContinuousEngine:
                     out["ragged_decode_tokens"] = self.ragged_decode_tokens
             return out
 
+    def load_digest(self) -> dict[str, Any]:
+        """The engine's slice of the replica load digest (serve/rest.py
+        ``/loadz``): admission-queue depth + the SpanTracker's latency
+        EWMAs and SLO goodput. Cheap by design — the fleet prober reads
+        this on every probe, so it must never touch the device."""
+        with self._cond:
+            queue_depth = len(self._queue)
+        digest = self.obs.load_digest()
+        digest["queue_depth"] = queue_depth
+        return digest
+
     def _update_page_gauges(self) -> None:
         """Refresh the KV page-occupancy gauges (paged backends only).
         Called wherever the free list changes: admission, retirement,

@@ -12,7 +12,12 @@ Endpoints:
   stays 200 through a drain (the fleet must not kill a draining replica)
 - ``GET  /readyz``    → readiness: 200 only while accepting NEW work; 503
   (with the live in-flight count) once draining — what the fleet router's
-  health prober and drain poll actually watch
+  health prober and drain poll actually watch; carries the load digest
+  under ``"load"`` so the prober refreshes it for free on its probe cadence
+- ``GET  /loadz``     → the load digest alone: in-flight count, engine
+  queue depth, queue/prefill/decode latency EWMAs from the span tracker,
+  SLO goodput, and a recent-compile flag — what the fleet's telemetry
+  balancer weighs replicas by (docs/OBSERVABILITY.md "Load digests")
 - ``GET  /metrics``   → Prometheus text exposition (edgemesh.obs registry:
   request/TTFT/inter-token histograms, KV page + device-memory gauges)
 - ``GET  /stats``     → the legacy JSON status blob (phases, supervisor
@@ -51,6 +56,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from edgemesh.serve import httputil
 
 log = logging.getLogger("edgemesh.serve")
+
+#: A backend compile within this window flags ``recent_compile`` in the
+#: load digest: the replica is warming up (or churning shapes), and the
+#: telemetry balancer should expect a latency cliff, not steady state.
+RECENT_COMPILE_WINDOW_S = 30.0
 
 
 class GatewayServer(ThreadingHTTPServer):
@@ -154,6 +164,29 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 payload["batcher"] = batcher.stats()
             return payload
 
+        def _load_digest(self) -> dict:
+            """The replica's live load digest (docs/OBSERVABILITY.md):
+            everything the fleet's telemetry balancer needs, cheap enough
+            to ride every health probe. Engines contribute queue depth +
+            latency EWMAs + SLO goodput; non-continuous gateways degrade
+            to in-flight count alone (the EWMA keys stay, as null)."""
+            from edgemesh.obs.trace import seconds_since_last_compile
+
+            digest: dict = {
+                "inflight": self.server.inflight(),
+                "queue_depth": None,
+                "ewma_queue_s": None, "ewma_prefill_s": None,
+                "ewma_decode_s": None, "ewma_service_s": None,
+                "slo_goodput_ratio": None,
+            }
+            if batcher is not None and hasattr(batcher, "load_digest"):
+                digest.update(batcher.load_digest())
+            since = seconds_since_last_compile()
+            digest["recent_compile"] = (
+                since is not None and since < RECENT_COMPILE_WINDOW_S
+            )
+            return digest
+
         def do_GET(self):
             if self.path in ("/", "/health"):
                 import jax
@@ -176,13 +209,18 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
             elif self.path == "/readyz":
                 # Readiness: what rotation membership keys on. Carries the
                 # live in-flight count — the fleet's drain poll reads it to
-                # know when this replica is safe to stop.
+                # know when this replica is safe to stop — and piggybacks
+                # the load digest so the prober refreshes telemetry for
+                # free on its existing probe cadence.
                 draining = self.server.draining
                 self._send(
                     503 if draining else 200,
                     {"ready": not draining, "draining": draining,
-                     "inflight": self.server.inflight()},
+                     "inflight": self.server.inflight(),
+                     "load": self._load_digest()},
                 )
+            elif self.path == "/loadz":
+                self._send(200, self._load_digest())
             elif self.path == "/metrics":
                 # Prometheus text exposition from the obs registry (device
                 # gauges sample inside render() via the registered
@@ -465,6 +503,15 @@ def _render_statusz(ensemble, stats: dict, registry) -> str:
                 f"mean={rep['mean_s'] * 1e3:.1f}ms"
             )
     summary = registry.summary()
+    goodput = sorted(
+        (k, v) for k, v in summary.items()
+        if k.startswith("edgemesh_slo_goodput_ratio") and not isinstance(v, dict)
+    )
+    if goodput:
+        lines.append("")
+        lines.append("slo goodput (fraction meeting TTFT+TPOT targets):")
+        for key, v in goodput:
+            lines.append(f"  {key}: {v:.3f}")
     if summary:
         lines.append("")
         lines.append("metrics (obs registry):")

@@ -520,6 +520,63 @@ def test_em110_shipped_serve_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# EM111 metric-naming
+# ---------------------------------------------------------------------------
+
+_EM111_SRC = (
+    "def build(reg):\n"
+    "    a = reg.counter('requests_total', 'no namespace')\n"
+    "    b = reg.counter('edgemesh_requests', 'counter missing _total')\n"
+    "    c = reg.gauge('edgemesh_pages_total', 'gauge with _total')\n"
+    "    d = reg.histogram('edgemesh_ttft_total', 'histogram with _total')\n"
+    "    e = reg.counter('edgemesh_ok_total', 'fine')\n"
+    "    f = reg.gauge('edgemesh_pages', 'fine')\n"
+    "    g = reg.histogram('edgemesh_ttft_seconds', 'fine')\n"
+    "    return a, b, c, d, e, f, g\n"
+)
+
+
+def test_em111_fires_on_prefix_and_total_suffix_violations():
+    findings = lint_source(_EM111_SRC, path="edgemesh/obs/device.py")
+    assert [f.rule for f in findings] == ["EM111"] * 4
+    assert all(f.severity == "warning" for f in findings)
+    msgs = [f.message for f in findings]
+    assert "namespace prefix" in msgs[0]
+    assert "must end '_total'" in msgs[1]
+    assert "must not end '_total'" in msgs[2]
+    assert "must not end '_total'" in msgs[3]
+    # Outside the shipped package (tests, docs snippets) the rule is
+    # silent: throwaway fixture families are deliberate.
+    assert lint_source(_EM111_SRC, path="tests/test_obs.py") == []
+
+
+def test_em111_skips_dynamic_names_and_honors_disable():
+    dynamic = (
+        "def build(reg, name):\n"
+        "    return reg.counter(name, 'dynamic: out of scope')\n"
+    )
+    assert lint_source(dynamic, path="edgemesh/obs/device.py") == []
+    quiet = (
+        "def build(reg):\n"
+        "    return reg.counter('legacy_total', 'grandfathered')"
+        "  # edgelint: disable=EM111\n"
+    )
+    assert lint_source(quiet, path="edgemesh/obs/device.py") == []
+
+
+def test_em111_shipped_tree_is_clean():
+    # Every metric the shipped package registers follows the convention —
+    # the tree is the rule's reference fixture (docs/OBSERVABILITY.md
+    # metric catalog).
+    from pathlib import Path
+
+    from edgemesh.analysis.edgelint import lint_paths
+
+    pkg = Path(__file__).resolve().parent.parent / "edgemesh"
+    assert [f for f in lint_paths([pkg]) if f.rule == "EM111"] == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + baseline mechanics
 # ---------------------------------------------------------------------------
 

@@ -77,7 +77,20 @@ def test_headline_serving_schema_gains_ragged_and_spec_keys(monkeypatch, capsys)
     monkeypatch.setattr(benchmarks, "decode_benchmark", fake_decode)
     monkeypatch.setattr(benchmarks, "serving_benchmark", fake_serving)
     monkeypatch.setattr(benchmarks, "ragged_ablation_benchmark", fake_ablation)
+    def fake_adaptive(**kw):
+        return {"metric": "adaptive_over_least_outstanding_p99",
+                "value": 1.4, "unit": "x", "n_requests": 24,
+                "concurrency": 6, "slo_target_s": 0.25,
+                "least_outstanding_p50_s": 0.1,
+                "least_outstanding_p99_s": 0.7,
+                "least_outstanding_goodput": 0.8,
+                "least_outstanding_routed_to_slow": 4,
+                "adaptive_p50_s": 0.09, "adaptive_p99_s": 0.5,
+                "adaptive_goodput": 1.0, "adaptive_routed_to_slow": 0,
+                "adaptive_hedged": 1}
+
     monkeypatch.setattr(benchmarks, "speculative_benchmark", fake_spec)
+    monkeypatch.setattr(benchmarks, "adaptive_router_benchmark", fake_adaptive)
     monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_ADMIT", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
@@ -93,6 +106,13 @@ def test_headline_serving_schema_gains_ragged_and_spec_keys(monkeypatch, capsys)
         assert out[f"serving_ragged_{shape}_tok_s"] == 900.0
         assert out[f"serving_segmented_{shape}_tok_s"] == 700.0
         assert out[f"ragged_over_segmented_{shape}"] == 1.286
+    # Telemetry-loop stage: the adaptive-vs-least-outstanding comparison
+    # rides the BENCH JSON (p99 ratio + goodput per arm + the mechanism).
+    assert out["adaptive_over_least_outstanding_p99"] == 1.4
+    assert out["least_outstanding_goodput"] == 0.8
+    assert out["adaptive_goodput"] == 1.0
+    assert out["adaptive_routed_to_slow"] == 0
+    assert out["slo_target_s"] == 0.25
     # Speculative arm: the selfcheck key distinguishes machinery-broken
     # (selfcheck < 1) from draft-weak (accept low, selfcheck 1.0).
     assert out["spec_selfcheck_accept_rate"] == 1.0
@@ -150,6 +170,9 @@ def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
     monkeypatch.setattr(benchmarks, "_build", fake_build)
     monkeypatch.setattr(benchmarks, "decode_benchmark", fake_decode)
     monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+    # Stage ordering is under test, not the fleet: the adaptive-router
+    # stage would spin real in-process replicas here.
+    monkeypatch.setenv("EDGEMESH_BENCH_FLEET", "0")
 
     out = benchmarks.headline_benchmark(preset="tiny", batch=2, decode_steps=8,
                                         sweep_batches=())

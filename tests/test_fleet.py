@@ -372,6 +372,83 @@ def test_adaptive_hedge_delay_needs_a_window():
     assert delay is not None and 0.01 <= delay <= 5.0
 
 
+def test_auto_hedge_delay_tracks_decayed_p95_with_a_floor():
+    router = _router(_registry("r1"), FakeTransport(), hedge_auto=True)
+    assert router._hedge_delay() is None  # estimator empty: no hedging yet
+    for _ in range(40):
+        router._hedge_estimator.observe(0.010)
+    # Healthy sub-floor latencies: the floor stops hedge storms.
+    assert router._hedge_delay() == router.hedge_floor_s
+    for _ in range(40):
+        router._hedge_estimator.observe(1.0)
+    delay = router._hedge_delay()
+    assert router.hedge_floor_s < delay <= 1.5  # tracked the new regime
+
+
+def test_auto_hedge_wins_on_stalled_primary_with_zero_config():
+    # The tentpole contract: no hedge_after_s, no percentile — the delay
+    # auto-tunes from observed latencies, and a stalled primary still gets
+    # hedged around within the request budget.
+    reg = _registry("r1", "r2")
+    stall = threading.Event()
+
+    def stalled(url, payload, headers):
+        stall.wait(5.0)
+        return 200, {"answer": "late"}
+
+    ft = FakeTransport().on("r1", stalled)
+    router = _router(reg, ft, balancer="round_robin", hedge_auto=True)
+    for _ in range(40):  # the live window a warm router would have
+        router._hedge_estimator.observe(0.01)
+    t0 = time.monotonic()
+    status, body, _ = router.handle_generate({"question": "q?"})
+    elapsed = time.monotonic() - t0
+    stall.set()
+    assert status == 200 and body == {"answer": "ok"}
+    assert elapsed < 2.0
+    m = router.obs.summary(prefix="edgemesh_fleet_")
+    assert m['edgemesh_fleet_hedged_won_total{replica="r2"}'] == 1
+
+
+def test_latency_window_is_bounded_and_exposed_in_status():
+    reg = _registry("r1")
+    router = _router(reg, FakeTransport(), latency_window=8)
+    for _ in range(20):
+        router.handle_generate({"question": "q?"})
+    st = router.status()
+    # Explicit ring: 20 successes, only the configured bound retained.
+    assert st["latency_window"] == {"size": 8, "len": 8}
+    assert st["hedge"]["mode"] == "off" and st["hedge"]["delay_s"] is None
+    assert st["hedge"]["estimator_weight"] > 0
+
+
+def test_router_latency_histogram_labeled_by_outcome():
+    # ok / retried / shed each land in the labeled histogram; the unlabeled
+    # total keeps its successful-requests-only semantics.
+    reg = _registry("r1", "r2")
+    ft = FakeTransport().on("r1", _refuse)
+    router = _router(reg, ft, balancer="round_robin", backoff_base_s=0.001)
+    router.handle_generate({"question": "q?"})  # r1 fails → retried onto r2
+    router.handle_generate({"question": "q?"})  # round-robin lands on r1 again
+    m = router.obs.summary(prefix="edgemesh_fleet_")
+    by_outcome = {
+        k: v["count"] for k, v in m.items()
+        if k.startswith("edgemesh_fleet_router_outcome_seconds")
+        and isinstance(v, dict)
+    }
+    assert by_outcome.get(
+        'edgemesh_fleet_router_outcome_seconds{outcome="retried"}') >= 1
+    total_labeled = sum(by_outcome.values())
+    assert total_labeled == 2
+    # Empty fleet → shed lands in the distribution too.
+    router2 = _router(ReplicaRegistry(), FakeTransport())
+    router2.handle_generate({"question": "q?"})
+    m2 = router2.obs.summary(prefix="edgemesh_fleet_")
+    assert m2['edgemesh_fleet_router_outcome_seconds{outcome="shed"}']["count"] == 1
+    # The unlabeled family saw no successful request.
+    assert "edgemesh_fleet_router_seconds" not in m2
+
+
 # ---------------------------------------------------------------------------
 # Drain state machine
 # ---------------------------------------------------------------------------
@@ -563,6 +640,132 @@ def test_make_balancer_rejects_unknown():
         make_balancer("fastest_first")
 
 
+def test_make_balancer_bad_kwargs_is_a_valueerror_naming_the_policy():
+    # Constructor kwarg typos surface as a ValueError naming the policy,
+    # not a bare TypeError from deep inside a constructor.
+    with pytest.raises(ValueError, match="telemetry"):
+        make_balancer("telemetry", staleness=5.0)
+    with pytest.raises(ValueError, match="round_robin"):
+        make_balancer("round_robin", prefix_chars=8)
+    with pytest.raises(ValueError, match="stale_after_s"):
+        make_balancer("telemetry", stale_after_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry balancer: digest-weighted picks, staleness decay, cold replicas
+# ---------------------------------------------------------------------------
+
+
+def _loaded_replica(rid, queue_s, prefill_s, service_s, now,
+                    outstanding=0, age=0.0, recent_compile=False):
+    rep = Replica(rid=rid, base_url="http://x")
+    rep.outstanding = outstanding
+    rep.load = {
+        "ewma_queue_s": queue_s, "ewma_prefill_s": prefill_s,
+        "ewma_service_s": service_s, "recent_compile": recent_compile,
+    }
+    rep.load_ts = now - age
+    return rep
+
+
+def test_telemetry_balancer_prefers_observed_fast_replica_even_when_idle():
+    # Both idle (outstanding 0): least_outstanding would tie-break to the
+    # FIRST (slow) replica; telemetry reads the digests and avoids it.
+    now = 1000.0
+    slow = _loaded_replica("slow", 0.05, 0.4, 2.0, now)
+    fast = _loaded_replica("fast", 0.001, 0.01, 0.05, now)
+    bal = make_balancer("telemetry", now=lambda: now)
+    assert bal.pick([slow, fast]).rid == "fast"
+    # A recent compile on the otherwise-fast replica tips the pick away.
+    warming = _loaded_replica("warming", 0.001, 0.01, 0.05, now,
+                              recent_compile=True)
+    steady = _loaded_replica("steady", 0.002, 0.02, 0.08, now)
+    assert bal.pick([warming, steady]).rid == "steady"
+
+
+def test_telemetry_balancer_backpressure_self_limits_between_probes():
+    # Outstanding is read LIVE from the registry, so picks spread once the
+    # fast replica queues up — no herding at the currently-fastest replica.
+    now = 1000.0
+    a = _loaded_replica("a", 0.001, 0.01, 0.5, now, outstanding=6)
+    b = _loaded_replica("b", 0.002, 0.05, 0.6, now, outstanding=0)
+    bal = make_balancer("telemetry", now=lambda: now)
+    assert bal.pick([a, b]).rid == "b"
+
+
+def test_telemetry_balancer_stale_digests_degrade_to_least_outstanding():
+    # Past stale_after_s the digest's weight decays to zero: a glowing but
+    # STALE digest must never outvote live queue depth. With every digest
+    # stale the pick IS least-outstanding (ties by registration order) —
+    # and it never throws.
+    now = 1000.0
+    bal = make_balancer("telemetry", stale_after_s=10.0, now=lambda: now)
+    fast_stale = _loaded_replica("fast_stale", 0.001, 0.01, 0.05, now,
+                                 outstanding=3, age=60.0)
+    slow_fresh_idle = _loaded_replica("busy_looking", 0.05, 0.4, 2.0, now,
+                                      outstanding=0, age=60.0)
+    assert bal.pick([fast_stale, slow_fresh_idle]).rid == "busy_looking"
+    # All stale + equal outstanding: registration order, like LO.
+    r1 = _loaded_replica("r1", 0.9, 0.9, 9.0, now, age=99.0)
+    r2 = _loaded_replica("r2", 0.001, 0.001, 0.01, now, age=99.0)
+    assert bal.pick([r1, r2]).rid == "r1"
+
+
+def test_telemetry_balancer_null_ewma_digest_scores_like_no_digest():
+    # A fresh digest whose EWMA fields are all null (non-continuous
+    # gateway, or a continuous replica before its first request) carries
+    # no telemetry: it must score on live outstanding like a cold replica,
+    # not as zero cost — or the least-instrumented replica would win
+    # every pick regardless of its queue.
+    now = 1000.0
+    empty = Replica(rid="empty", base_url="http://x")
+    empty.outstanding = 5
+    empty.load = {"ewma_queue_s": None, "ewma_prefill_s": None,
+                  "ewma_service_s": None, "recent_compile": False}
+    empty.load_ts = now
+    fast = _loaded_replica("fast", 0.001, 0.01, 0.05, now)
+    bal = make_balancer("telemetry", now=lambda: now)
+    assert bal.pick([empty, fast]).rid == "fast"
+
+
+def test_telemetry_balancer_cold_replica_is_not_starved():
+    # A just-registered replica has NO digest: it competes on live queue
+    # depth (freshness 0) instead of being frozen out by replicas with
+    # attractive telemetry.
+    now = 1000.0
+    veteran = _loaded_replica("veteran", 0.001, 0.01, 0.05, now, outstanding=2)
+    cold = Replica(rid="cold", base_url="http://x")
+    bal = make_balancer("telemetry", now=lambda: now)
+    assert bal.pick([veteran, cold]).rid == "cold"
+
+
+def test_prober_refreshes_load_digest_from_readyz_body():
+    reg = _registry("r1")
+    digest = {"inflight": 2, "queue_depth": 1, "ewma_queue_s": 0.003,
+              "ewma_prefill_s": 0.02, "ewma_decode_s": 0.004,
+              "ewma_service_s": 0.11, "recent_compile": False,
+              "slo_goodput_ratio": 0.97}
+    ft = FakeTransport().on(
+        "r1/readyz",
+        lambda u, p, h: (200, {"ready": True, "inflight": 2, "load": digest}),
+    )
+    prober = HealthProber(reg, transport=ft, obs_registry=Registry())
+    assert prober.probe_once() == {"r1": "healthy"}
+    rep = reg.get("r1")
+    assert rep.load == digest and rep.load_ts is not None
+    assert rep.load_age_s() >= 0.0
+    # The digest rides the registry snapshot → /fleetz.
+    snap = reg.snapshot()[0]
+    assert snap["load"]["ewma_prefill_s"] == 0.02
+    assert snap["load_age_s"] >= 0.0
+    # A pre-digest replica (no "load" key) still probes fine.
+    ft2 = FakeTransport().on("r1/readyz",
+                             lambda u, p, h: (200, {"ready": True}))
+    reg2 = _registry("r1")
+    HealthProber(reg2, transport=ft2, obs_registry=Registry()).probe_once()
+    assert reg2.get("r1").load is None
+
+
 # ---------------------------------------------------------------------------
 # Replica gateway (serve/rest.py): healthz/readyz/drain + hardening.
 # A stub ensemble keeps this fast — the HTTP lifecycle is under test, not
@@ -598,7 +801,11 @@ def test_gateway_healthz_readyz_and_drain_state_machine():
         assert status == 200 and body == {"status": "ok"}
         status, body, _ = _http(srv, "/readyz")
         assert status == 200
-        assert body == {"ready": True, "draining": False, "inflight": 0}
+        assert body["ready"] is True and body["draining"] is False
+        assert body["inflight"] == 0
+        # The load digest piggybacks on readiness (the prober refreshes the
+        # telemetry balancer's signal for free — docs/FLEET.md).
+        assert "load" in body and body["load"]["inflight"] == 0
 
         status, body, _ = _http(srv, "/drain", data=b"{}")
         assert status == 200 and body["draining"] is True
@@ -692,6 +899,23 @@ def test_gateway_admission_check_and_increment_is_atomic():
         srv.end_request()
         srv.end_request()
         assert srv.inflight() == 0
+    finally:
+        srv.shutdown()
+
+
+def test_gateway_loadz_digest_degrades_without_an_engine():
+    # A non-continuous gateway has no span tracker: the digest keeps its
+    # schema (the balancer parses one shape) with null telemetry and the
+    # live in-flight count.
+    srv = _serve_stub()
+    try:
+        status, body, _ = _http(srv, "/loadz")
+        assert status == 200
+        assert body["inflight"] == 0 and body["queue_depth"] is None
+        for key in ("ewma_queue_s", "ewma_prefill_s", "ewma_decode_s",
+                    "ewma_service_s", "slo_goodput_ratio"):
+            assert key in body and body[key] is None
+        assert isinstance(body["recent_compile"], bool)
     finally:
         srv.shutdown()
 

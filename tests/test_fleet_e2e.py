@@ -36,12 +36,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_replica(cfg_path: Path, port: int) -> subprocess.Popen:
+def _spawn_replica(cfg_path: Path, port: int,
+                   extra: tuple = ()) -> subprocess.Popen:
     env = os.environ.copy()
     env["JAX_PLATFORMS"] = "cpu"
     return subprocess.Popen(
         [sys.executable, "-m", "edgemesh.cli", "serve",
-         "--config", str(cfg_path), "--port", str(port)],
+         "--config", str(cfg_path), "--port", str(port), *extra],
         env=env, cwd=Path(__file__).resolve().parent.parent,
     )
 
@@ -313,6 +314,171 @@ def test_fleet_cli_serve_and_status_json(tmp_path):
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait(timeout=15)
+
+
+SLOW_REPLICA_YAML = """
+agents:
+  - role: qa
+    model: {family: llama, num_layers: 6, hidden_size: 64, num_heads: 4,
+            num_kv_heads: 4, intermediate_size: 128, max_seq_len: 512}
+    sampling: {max_new_tokens: 32, do_sample: false, repetition_penalty: 1.0}
+"""
+
+
+def test_adaptive_router_beats_least_outstanding_on_skewed_fleet(tmp_path):
+    """The telemetry-loop acceptance bar: a 3-replica fleet with one
+    artificially degraded replica (6x the layers, 8x the token budget —
+    genuinely slower prefill and decode), REAL subprocess replicas serving
+    --continuous so their /readyz bodies ship live load digests. The
+    adaptive router (TelemetryBalancer + auto-tuned hedging, ZERO hedge or
+    threshold config) must beat least_outstanding on p99 latency and SLO
+    goodput over the identical concurrent workload."""
+    from edgemesh.fleet import FleetRouter, HealthProber, HttpTransport, \
+        ReplicaRegistry, serve_fleet
+    from edgemesh.obs import Registry
+
+    fast_cfg = tmp_path / "fast.yaml"
+    fast_cfg.write_text(REPLICA_YAML)
+    slow_cfg = tmp_path / "slow.yaml"
+    slow_cfg.write_text(SLOW_REPLICA_YAML)
+    ports = [_free_port() for _ in range(3)]
+    # The degraded replica is registered FIRST so least_outstanding's
+    # registration-order tie-break prefers it — the worst case the
+    # telemetry balancer must route around.
+    procs = [
+        _spawn_replica(slow_cfg, ports[0], extra=("--continuous",)),
+        _spawn_replica(fast_cfg, ports[1], extra=("--continuous",)),
+        _spawn_replica(fast_cfg, ports[2], extra=("--continuous",)),
+    ]
+    rids = ["slow", "fast-1", "fast-2"]
+    urls = {rid: f"http://127.0.0.1:{p}" for rid, p in zip(rids, ports)}
+    transport = HttpTransport()
+    n_requests, concurrency = 18, 6
+    try:
+        _wait_ready(transport, ports)
+        # Warm every replica (decode compiles + digest EWMAs) and measure
+        # the fast replicas' steady-state latency for the SLO target.
+        fast_lats = []
+        for rid, url in urls.items():
+            for _ in range(2):
+                t0 = time.monotonic()
+                status, _ = _post(f"{url}/generate", {"question": "warm?"})
+                assert status == 200
+                lat = time.monotonic() - t0
+            if rid != "slow":
+                fast_lats.append(lat)
+        slow_t0 = time.monotonic()
+        _post(f"{urls['slow']}/generate", {"question": "warm again?"})
+        slow_lat = time.monotonic() - slow_t0
+        slo_target_s = max(4.0 * max(fast_lats), 0.5)
+        # The skew must be real, or the comparison means nothing.
+        assert slow_lat > slo_target_s, (slow_lat, slo_target_s)
+
+        # The replica side exposes the SLO instrumentation end to end.
+        import urllib.request
+
+        with urllib.request.urlopen(f"{urls['slow']}/metrics",
+                                    timeout=30) as r:
+            text = r.read().decode()
+        assert "edgemesh_slo_goodput_ratio" in text
+        assert "edgemesh_slo_requests_total" in text
+        with urllib.request.urlopen(f"{urls['slow']}/loadz", timeout=30) as r:
+            digest = json.load(r)
+        assert digest["ewma_service_s"] is not None
+        assert digest["queue_depth"] == 0
+
+        def run_arm(balancer: str, hedge_auto: bool):
+            obs = Registry()
+            registry = ReplicaRegistry(list(urls.items()))
+            prober = HealthProber(registry, transport=transport,
+                                  interval_s=0.3, timeout_s=5.0,
+                                  obs_registry=obs).start()
+            prober.probe_once()  # digests fresh before the first pick
+            router = FleetRouter(
+                registry, balancer=balancer, transport=transport,
+                obs_registry=obs, hedge_auto=hedge_auto,
+                attempt_timeout_s=120.0, default_deadline_s=240.0,
+            )
+            front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+            url = f"http://127.0.0.1:{front.server_address[1]}/generate"
+            lats, errors = [], []
+            lock = threading.Lock()
+            remaining = list(range(n_requests))
+
+            def worker():
+                while True:
+                    with lock:
+                        if not remaining:
+                            return
+                        i = remaining.pop()
+                    t0 = time.monotonic()
+                    status, body = _post(url, {"question": f"q {i}?"})
+                    lat = time.monotonic() - t0
+                    with lock:
+                        if status != 200:
+                            errors.append((i, status, body))
+                        else:
+                            lats.append(lat)
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(concurrency)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=240.0)
+            prober.stop()
+            front.shutdown()
+            assert not errors, errors
+            assert len(lats) == n_requests
+            routed_slow = obs.summary().get(
+                'edgemesh_fleet_routed_total{replica="slow"}', 0)
+            return lats, routed_slow
+
+        lo_lats, lo_slow = run_arm("least_outstanding", hedge_auto=False)
+        ad_lats, ad_slow = run_arm("telemetry", hedge_auto=True)
+
+        def p99(xs):
+            return sorted(xs)[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+        def goodput(xs):
+            return sum(1 for x in xs if x <= slo_target_s) / len(xs)
+
+        # The baseline actually exercised the degraded replica (its
+        # registration-order tie-break guarantees at least the first pick)
+        # and paid for it in the tail; the adaptive arm routed around it.
+        assert lo_slow >= 1, lo_slow
+        assert ad_slow < lo_slow, (ad_slow, lo_slow)
+        assert goodput(lo_lats) < 1.0
+        assert p99(ad_lats) < p99(lo_lats), (p99(ad_lats), p99(lo_lats))
+        assert goodput(ad_lats) > goodput(lo_lats), (
+            goodput(ad_lats), goodput(lo_lats))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def test_adaptive_router_benchmark_smoke():
+    """Bench CI smoke: the BENCH JSON schema of the adaptive-router stage
+    (the full-size comparison rides the driver bench)."""
+    from edgemesh.benchmarks import adaptive_router_benchmark
+
+    r = adaptive_router_benchmark(n_requests=6, concurrency=2, max_new=4,
+                                  slow_layers=4, slow_hidden=64,
+                                  slow_max_new=16)
+    assert r["metric"] == "adaptive_over_least_outstanding_p99"
+    assert r["value"] > 0
+    for key in ("least_outstanding_p99_s", "adaptive_p99_s",
+                "least_outstanding_goodput", "adaptive_goodput",
+                "least_outstanding_routed_to_slow", "adaptive_routed_to_slow",
+                "slo_target_s"):
+        assert key in r, key
+    assert r["n_requests"] == 6
 
 
 def test_router_overhead_benchmark_smoke():

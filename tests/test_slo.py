@@ -1,0 +1,264 @@
+"""edgemesh.obs.slo fast tier: SLO classification + goodput metrics, the
+decayed latency quantile the router's auto-hedge reads, the stream meter,
+the SpanTracker load-digest EWMAs, SLO replay, and the `edgemesh obs
+summary` SLO report (including logs that predate the fields)."""
+
+import json
+
+import pytest
+
+from edgemesh.obs import (
+    DecayingQuantile,
+    Registry,
+    SloTarget,
+    SloTracker,
+    SpanTracker,
+    StreamMeter,
+    replay_spans,
+)
+from edgemesh.obs.spans import EWMA_ALPHA
+from edgemesh.utils.tracing import JsonlLogger
+
+# ---------------------------------------------------------------------------
+# SloTracker classification
+# ---------------------------------------------------------------------------
+
+
+def test_slo_classification_table():
+    t = SloTracker(Registry(), engine="unit",
+                   target=SloTarget(ttft_s=1.0, tpot_s=0.1))
+    assert t.classify("ok", 0.5, 0.05) == "good"
+    assert t.classify("ok", 2.0, 0.05) == "ttft"
+    assert t.classify("ok", 0.5, 0.5) == "tpot"
+    assert t.classify("ok", 2.0, 0.5) == "ttft_tpot"
+    assert t.classify("error", 0.5, 0.05) == "error"
+    # No first token ever = a TTFT miss by definition; a single-token
+    # answer (tpot None) cannot miss TPOT.
+    assert t.classify("ok", None, None) == "ttft"
+    assert t.classify("ok", 0.5, None) == "good"
+
+
+def test_slo_tracker_feeds_counters_and_goodput_gauge():
+    reg = Registry()
+    t = SloTracker(reg, engine="unit", target=SloTarget(1.0, 0.1))
+    assert t.goodput_ratio() is None  # nothing classified yet
+    t.record("ok", 0.5, 0.05)
+    t.record("ok", 0.5, 0.05)
+    t.record("ok", 5.0, 0.05)
+    t.record("error", None, None)
+    s = reg.summary()
+    assert s['edgemesh_slo_requests_total{engine="unit",result="good"}'] == 2
+    assert s['edgemesh_slo_requests_total{engine="unit",result="ttft"}'] == 1
+    assert s['edgemesh_slo_requests_total{engine="unit",result="error"}'] == 1
+    assert s['edgemesh_slo_goodput_ratio{engine="unit"}'] == 0.5
+    assert t.goodput_ratio() == 0.5
+    # The active target is scrapeable alongside the verdicts.
+    assert s['edgemesh_slo_target_seconds{engine="unit",kind="ttft"}'] == 1.0
+    assert s['edgemesh_slo_target_seconds{engine="unit",kind="tpot"}'] == 0.1
+
+
+def test_slo_target_from_env(monkeypatch):
+    monkeypatch.setenv("EDGEMESH_SLO_TTFT_S", "0.75")
+    monkeypatch.setenv("EDGEMESH_SLO_TPOT_S", "0.05")
+    t = SloTarget.from_env()
+    assert t.ttft_s == 0.75 and t.tpot_s == 0.05
+    # Garbage / non-positive values fall back to defaults, never raise.
+    monkeypatch.setenv("EDGEMESH_SLO_TTFT_S", "soon")
+    monkeypatch.setenv("EDGEMESH_SLO_TPOT_S", "-1")
+    t = SloTarget.from_env()
+    assert t.ttft_s == SloTarget().ttft_s and t.tpot_s == SloTarget().tpot_s
+
+
+# ---------------------------------------------------------------------------
+# DecayingQuantile (the auto-hedge estimator)
+# ---------------------------------------------------------------------------
+
+
+def test_decaying_quantile_gates_on_min_weight_then_answers():
+    clock = {"t": 0.0}
+    dq = DecayingQuantile(half_life_s=10.0, min_weight=16.0,
+                          now=lambda: clock["t"])
+    for _ in range(10):
+        dq.observe(0.01)
+    assert dq.quantile(0.95) is None  # 10 < min_weight: not armed
+    for _ in range(30):
+        dq.observe(0.01)
+    p95 = dq.quantile(0.95)
+    assert p95 is not None and 0.005 <= p95 <= 0.02
+
+
+def test_decaying_quantile_forgets_the_old_regime():
+    clock = {"t": 0.0}
+    dq = DecayingQuantile(half_life_s=5.0, min_weight=8.0,
+                          now=lambda: clock["t"])
+    for _ in range(100):
+        dq.observe(0.01)  # fast regime
+    clock["t"] = 50.0  # 10 half-lives: the fast samples are ~0.1 weight
+    for _ in range(20):
+        dq.observe(1.0)  # slow regime
+    p50 = dq.quantile(0.50)
+    assert p50 is not None and p50 > 0.5, p50
+    # Weight reflects decay, not raw counts.
+    assert dq.weight() < 25
+
+
+def test_decaying_quantile_overflow_bucket_answers_top_bound():
+    dq = DecayingQuantile(min_weight=4.0)
+    for _ in range(10):
+        dq.observe(10_000.0)  # beyond every bound
+    assert dq.quantile(0.5) == dq.bounds[-1]
+
+
+# ---------------------------------------------------------------------------
+# StreamMeter (runtime/stream.py → the serving histograms)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_meter_records_ttft_tpot_and_slo():
+    reg = Registry()
+    m = StreamMeter(reg, engine="stream", target=SloTarget(1.0, 0.1))
+    m.chunk(0.2, 4)    # first token-bearing chunk → TTFT only
+    m.chunk(0.4, 4)    # 0.05/token
+    m.chunk(0.6, 4)
+    m.chunk(0.6, 0)    # empty chunk: no observations
+    assert m.finish("ok") == "good"
+    s = reg.summary()
+    ttft = s['edgemesh_ttft_seconds{engine="stream"}']
+    assert ttft["count"] == 1 and ttft["sum"] == pytest.approx(0.2)
+    tpot = s['edgemesh_inter_token_seconds{engine="stream"}']
+    assert tpot["count"] == 8  # two post-first chunks, weighted by tokens
+    assert tpot["sum"] / tpot["count"] == pytest.approx(0.05)
+    assert s['edgemesh_slo_goodput_ratio{engine="stream"}'] == 1.0
+
+
+def test_stream_meter_goodput_accumulates_across_streams():
+    # One SloTracker per (registry, engine): fresh meters (one per stream)
+    # must feed a RUNNING goodput ratio, not reset the gauge to the last
+    # stream's lone verdict.
+    reg = Registry()
+    target = SloTarget(ttft_s=1.0, tpot_s=10.0)
+    m1 = StreamMeter(reg, engine="stream", target=target)
+    m1.chunk(0.1, 2)
+    assert m1.finish("ok") == "good"
+    m2 = StreamMeter(reg, engine="stream", target=target)
+    m2.chunk(5.0, 2)  # late first token
+    assert m2.finish("ok") == "ttft"
+    s = reg.summary()
+    assert s['edgemesh_slo_goodput_ratio{engine="stream"}'] == 0.5
+    assert s['edgemesh_slo_requests_total{engine="stream",result="good"}'] == 1
+    assert s['edgemesh_slo_requests_total{engine="stream",result="ttft"}'] == 1
+
+
+def test_stream_meter_misses_are_classified():
+    m = StreamMeter(Registry(), engine="stream", target=SloTarget(0.1, 0.01))
+    m.chunk(0.5, 2)   # late first token
+    m.chunk(1.5, 2)   # 0.5/token
+    assert m.finish("ok") == "ttft_tpot"
+    # A stream that never produced a token misses TTFT.
+    m2 = StreamMeter(Registry(), engine="stream", target=SloTarget(0.1, 0.01))
+    assert m2.finish("ok") == "ttft"
+
+
+# ---------------------------------------------------------------------------
+# SpanTracker: EWMA load digest + slo_result in the span record + replay
+# ---------------------------------------------------------------------------
+
+
+def _drive(tracker, rid, segs=(3, 2), status="ok"):
+    tr = tracker.submit(rid)
+    tracker.admit_start(tr)
+    tracker.admitted(tr, prompt_tokens=5)
+    for n in segs:
+        tracker.tokens(tr, n)
+    tracker.retire(tr, status=status)
+
+
+def test_span_tracker_load_digest_populates_and_smooths():
+    tracker = SpanTracker(Registry(), engine="unit")
+    d0 = tracker.load_digest()
+    assert d0["ewma_queue_s"] is None and d0["slo_goodput_ratio"] is None
+    _drive(tracker, 0)
+    d1 = tracker.load_digest()
+    for key in ("ewma_queue_s", "ewma_prefill_s", "ewma_decode_s",
+                "ewma_service_s"):
+        assert d1[key] is not None and d1[key] >= 0.0
+    assert d1["slo_goodput_ratio"] == 1.0
+    # The EWMA blend rule itself: alpha*new + (1-alpha)*old.
+    tracker._ewma_update(service=1.0)
+    tracker._ewma_update(service=0.0)
+    expected = (1.0 - EWMA_ALPHA) * (
+        EWMA_ALPHA * 1.0 + (1.0 - EWMA_ALPHA) * d1["ewma_service_s"]
+    )
+    assert tracker.load_digest()["ewma_service_s"] == pytest.approx(
+        expected, abs=1e-6)
+
+
+def test_span_record_carries_slo_result_and_replays(tmp_path):
+    reg = Registry()
+    tracker = SpanTracker(reg, tmp_path / "spans.jsonl", engine="unit",
+                          slo_target=SloTarget(ttft_s=10.0, tpot_s=10.0))
+    _drive(tracker, 0)
+    _drive(tracker, 1, status="error")
+    records = JsonlLogger(tmp_path / "spans.jsonl").read()
+    assert [r["slo_result"] for r in records] == ["good", "error"]
+    offline = replay_spans(tmp_path / "spans.jsonl").summary()
+    live = reg.summary()
+    for key in (
+        'edgemesh_slo_requests_total{engine="unit",result="good"}',
+        'edgemesh_slo_requests_total{engine="unit",result="error"}',
+        'edgemesh_slo_goodput_ratio{engine="unit"}',
+    ):
+        assert offline[key] == live[key], key
+
+
+def test_replay_tolerates_pre_slo_logs(tmp_path):
+    # A log written before the slo_result field: replay simply skips the
+    # SLO family instead of guessing or crashing.
+    log = JsonlLogger(tmp_path / "old.jsonl")
+    log.log("request_spans", rid=0, engine="unit", status="ok", generated=4,
+            queue_s=0.01, prefill_s=0.02, ttft_s=0.05, itl_s=0.004,
+            latency_s=0.2, spans=[])
+    reg = replay_spans(tmp_path / "old.jsonl")
+    s = reg.summary()
+    assert s['edgemesh_requests_submitted_total{engine="unit"}'] == 1
+    # No verdicts invented: the request/goodput families stay empty (the
+    # target gauges register eagerly and are harmless).
+    assert not any(k.startswith("edgemesh_slo_requests_total") for k in s)
+    assert not any(k.startswith("edgemesh_slo_goodput_ratio") for k in s)
+
+
+# ---------------------------------------------------------------------------
+# `edgemesh obs summary` SLO report
+# ---------------------------------------------------------------------------
+
+
+def test_obs_summary_reports_ttft_tpot_and_goodput(tmp_path, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    tracker = SpanTracker(Registry(), tmp_path / "spans.jsonl", engine="cli",
+                          slo_target=SloTarget(ttft_s=10.0, tpot_s=10.0))
+    for rid in range(3):
+        _drive(tracker, rid)
+    assert obs_main(["summary", str(tmp_path / "spans.jsonl")]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["requests"] == 3
+    assert report["ttft_s_p99"] > 0
+    assert report["tpot_s_p50"] > 0 and report["tpot_s_p99"] > 0
+    assert report["slo_classified"] == 3
+    assert report["slo_goodput_ratio"] == 1.0
+    assert report["metrics"][
+        'edgemesh_slo_requests_total{engine="cli",result="good"}'] == 3
+
+
+def test_obs_summary_pre_slo_log_is_rc0_with_nulls(tmp_path, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    log = JsonlLogger(tmp_path / "old.jsonl")
+    log.log("request_spans", rid=0, engine="unit", status="ok", generated=2,
+            latency_s=0.2, ttft_s=0.05, spans=[])
+    assert obs_main(["summary", str(tmp_path / "old.jsonl")]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["requests"] == 1
+    assert report["slo_classified"] == 0
+    assert report["slo_goodput_ratio"] is None
+    assert report["tpot_s_p50"] is None

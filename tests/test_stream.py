@@ -64,6 +64,31 @@ def test_stream_stops_at_eos():
         assert n_chunks <= -(-max(int(x) for x in ref.num_generated) // 4) + 1
 
 
+def test_stream_feeds_ttft_tpot_and_slo_metrics():
+    # The raw streaming path records serving quality through the same
+    # obs families the engines use (engine="stream"): TTFT at the first
+    # token-bearing chunk, per-chunk weighted TPOT, one SLO verdict on
+    # normal completion.
+    from edgemesh.obs import Registry, SloTarget, StreamMeter
+
+    cfg, params = _model()
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0,
+                                cfg.vocab_size, jnp.int32)
+    lengths = jnp.full((2,), 8, jnp.int32)
+    reg = Registry()
+    meter = StreamMeter(reg, target=SloTarget(ttft_s=600.0, tpot_s=600.0))
+    for _ in generate_stream(cfg, params, tokens, lengths, GREEDY, chunk=8,
+                             meter=meter):
+        pass
+    s = reg.summary()
+    assert s['edgemesh_ttft_seconds{engine="stream"}']["count"] == 1
+    # 24-token budget in 8-token chunks: the two post-first chunks credit
+    # per-token latency weighted by their token counts.
+    assert s['edgemesh_inter_token_seconds{engine="stream"}']["count"] > 0
+    assert s['edgemesh_slo_goodput_ratio{engine="stream"}'] == 1.0
+    assert s['edgemesh_slo_requests_total{engine="stream",result="good"}'] == 1
+
+
 def test_agent_stream_deltas_concatenate_to_answer():
     agent = build_agent(AgentSpec(role="qa", model=ModelSpec(), sampling=GREEDY))
     q = "where is the eiffel tower"
