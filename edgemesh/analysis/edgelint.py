@@ -67,6 +67,20 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   keeps dashboards, rate() queries, and scrape relabeling honest across
   every subsystem.
 
+- EM112 unbounded-metric-label (error): a ``.labels(...)`` call under
+  ``edgemesh/`` binding a request-identity label (``tenant``/``session``/
+  ``user`` and their ``_id`` variants) to a value that does not flow
+  through ``obs.metrics.bounded_label`` — raw client-controlled strings
+  mint one time series per distinct value on EVERY family carrying the
+  label, so one abusive client can grow the scrape without bound.
+  Accepted values: string constants, direct ``bounded_label(...)`` calls,
+  and names whose function-local assignment chain ends in one of those;
+  a name with no visible local assignment (a parameter, an outer/module
+  binding) is trusted as pre-normalized — normalize at the seam where the
+  raw value enters, then pass the bounded value down. Subscripts
+  (``rec["tenant"]``) and calls other than the normalizer
+  (``payload.get("tenant")``) flag, inline or via a tainted local.
+
 The class-level concurrency rules (EM301-EM304: lock discipline,
 lock-order cycles, blocking-under-lock, thread hygiene) live in
 ``edgemesh/analysis/concurrency.py`` and ride the same entry points —
@@ -139,6 +153,11 @@ RULES: dict[str, dict] = {
         "name": "metric-naming",
         "severity": "warning",
         "summary": "metric name breaks the edgemesh_ prefix / _total suffix convention",
+    },
+    "EM112": {
+        "name": "unbounded-metric-label",
+        "severity": "error",
+        "summary": "request-derived label value bypasses obs.metrics.bounded_label",
     },
 }
 
@@ -228,6 +247,17 @@ _EM110_IMPORT_EXTRA = {"_decode_loop", "_spec_rounds"}
 _EM111_DIRS = ("edgemesh/",)
 _EM111_METHODS = {"counter", "gauge", "histogram"}
 _EM111_PREFIX = "edgemesh_"
+
+# EM112 scope + surface: ``.labels(...)`` keyword values for the
+# request-identity label names below. Shipped-package scope only (tests
+# register throwaway families with literal values on purpose; the scope
+# match also keeps docs snippets out). The one sanctioned normalizer is
+# obs.metrics.bounded_label — allowlist + first-N seen-set + the `other`
+# overflow bucket (docs/OBSERVABILITY.md "tenant label cardinality").
+_EM112_DIRS = ("edgemesh/",)
+_EM112_LABELS = {"tenant", "session", "user", "tenant_id", "session_id",
+                 "user_id"}
+_EM112_NORMALIZER = "bounded_label"
 
 
 # ---------------------------------------------------------------------------
@@ -474,6 +504,7 @@ class _FileLinter:
         self._rule_fleet_trace(tree)
         self._rule_serve_row_dispatch(tree)
         self._rule_metric_naming(tree)
+        self._rule_unbounded_label(tree)
         # Traced ROOTS only: their walkers descend into traced nested defs,
         # so running every traced def would double-report nested call sites.
         traced_roots = [
@@ -768,6 +799,77 @@ class _FileLinter:
                     f"{kind} {name!r} must not end '_total' — that suffix "
                     "is reserved for counters, and a non-monotone series "
                     "named like one breaks every rate() query over it",
+                )
+
+    # -- EM112 -------------------------------------------------------------
+
+    def _em112_value_ok(self, value: ast.AST, call_line: int,
+                        _seen: frozenset = frozenset()) -> bool:
+        """True when a label value visibly flows through bounded_label (or
+        is a constant / a trusted pre-normalized name). Mirrors EM109's
+        provenance style: one function-local assignment chain is followed;
+        anything the linter cannot see into is trusted, anything it CAN
+        see as raw (subscripts, non-normalizer calls) flags."""
+        if isinstance(value, ast.Constant):
+            return isinstance(value.value, str)
+        if isinstance(value, ast.Call):
+            fd = _dotted_name(value.func)
+            return bool(fd and fd.rsplit(".", 1)[-1] == _EM112_NORMALIZER)
+        if isinstance(value, ast.Subscript):
+            return False  # rec["tenant"] / headers[...] — visibly raw
+        if isinstance(value, ast.Name):
+            if value.id in _seen:
+                return True  # self-assignment cycle: nothing more to learn
+            scopes = self._scope_stack_for_line(call_line)
+            fn = scopes[-1] if scopes else None
+            if fn is None:
+                return True  # module level: out of provenance scope
+            rhs, rhs_line = None, -1
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Assign)
+                    and rhs_line < sub.lineno < call_line
+                    and any(
+                        isinstance(t, ast.Name) and t.id == value.id
+                        for t in sub.targets
+                    )
+                ):
+                    # Latest SOURCE LINE before the call wins — ast.walk is
+                    # breadth-first, so walk order would pick a top-level
+                    # assignment over a later nested one.
+                    rhs, rhs_line = sub.value, sub.lineno
+            if rhs is None:
+                # A parameter or outer binding: normalized at the seam
+                # where the raw value entered (the pattern the rule
+                # pushes callers toward).
+                return True
+            return self._em112_value_ok(rhs, call_line,
+                                        _seen | {value.id})
+        # Attributes and anything else opaque: provenance invisible.
+        return True
+
+    def _rule_unbounded_label(self, tree: ast.Module) -> None:
+        if not any(d in self.relpath for d in _EM112_DIRS):
+            return
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "labels"
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg not in _EM112_LABELS:
+                    continue
+                if self._em112_value_ok(kw.value, node.lineno):
+                    continue
+                self._emit(
+                    "EM112", node,
+                    f"label {kw.arg!r} bound to a raw request-derived "
+                    "value — unbounded label cardinality lets one client "
+                    "mint time series without limit; route it through "
+                    "obs.metrics.bounded_label(...) (allowlist + 'other' "
+                    "overflow bucket)",
                 )
 
     # -- EM102 -------------------------------------------------------------

@@ -972,6 +972,175 @@ def adaptive_router_benchmark(n_requests: int = 24, concurrency: int = 6,
                 srv.batcher.close()
 
 
+def load_curve_benchmark(n_replicas: int = 2, duration_s: float = 4.0,
+                         max_new: int = 8,
+                         point_factors: tuple[float, ...] = (0.5, 1.0, 2.0, 4.0),
+                         ) -> dict[str, Any]:
+    """The load observatory's bench stage: goodput vs offered load.
+
+    Boots ``n_replicas`` in-process continuous replicas (tiny synthetic
+    model — the CURVE SHAPE is under test, not the kernels) behind the
+    real fleet frontend, estimates the fleet's capacity from warm
+    latency, then drives the frontend OPEN-LOOP (edgemesh/loadgen/) at
+    ``point_factors`` multiples of that capacity with a two-tenant
+    Poisson mix (interactive + batch). Reported: one goodput point per
+    offered load (aggregate + per tenant), the saturation knee, and
+    whether the curve collapsed past it — the headline is
+    ``load_curve_knee_rps``, the offered load this stack should be run
+    at. A closed-loop driver cannot produce any of these numbers:
+    coordinated omission hides exactly the past-knee region
+    (docs/OBSERVABILITY.md "The load observatory")."""
+    from edgemesh.agents.orchestrator import Ensemble, build_agent
+    from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
+    from edgemesh.fleet import FleetRouter, HttpTransport, ReplicaRegistry, serve_fleet
+    import threading
+
+    from edgemesh.loadgen import (
+        LengthMix,
+        OpenLoopGenerator,
+        PoissonProcess,
+        TenantSpec,
+        Workload,
+        http_target,
+        run_curve,
+    )
+    from edgemesh.obs import Registry
+    from edgemesh.serve import serve_rest
+
+    transport = HttpTransport()
+
+    def _replica():
+        agent = build_agent(AgentSpec(
+            role="qa", model=ModelSpec(),
+            sampling=SamplingParams(max_new_tokens=max_new, do_sample=False,
+                                    repetition_penalty=1.0),
+        ))
+        return serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1",
+                          port=0, block=False, continuous=True, batch=2,
+                          registry=Registry(), trace_sample=0.0)
+
+    _progress(f"load-curve: building {n_replicas} in-process replicas")
+    servers = [_replica() for _ in range(n_replicas)]
+    front = None
+    try:
+        urls = [f"http://127.0.0.1:{s.server_address[1]}" for s in servers]
+        for url in urls:
+            status, _ = transport.post_json(
+                f"{url}/generate",
+                {"question": "load curve warmup question?"},
+                timeout_s=600.0)
+            if status != 200:
+                raise RuntimeError(f"warmup on {url} answered {status}")
+
+        obs = Registry()
+        registry = ReplicaRegistry(
+            (f"replica-{i}", url) for i, url in enumerate(urls)
+        )
+        router = FleetRouter(registry, balancer="least_outstanding",
+                             transport=transport, obs_registry=obs,
+                             attempt_timeout_s=300.0,
+                             default_deadline_s=600.0, max_attempts=1)
+        front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+        gen_url = f"http://127.0.0.1:{front.server_address[1]}/generate"
+        target = http_target(gen_url, timeout_s=600.0)
+
+        # Narrow prompt mix: the curve stage measures the SERVING stack's
+        # shape, and a fresh prompt-length compile bucket mid-point would
+        # masquerade as a latency cliff. (Long-tail mixes are the e2e
+        # tests' and the CLI's beat.)
+        prompt_mix = LengthMix(median=80, sigma=0.0, lo=80, hi=80)
+
+        def make_workload(rate: float, seed: int = 5) -> Workload:
+            return Workload([
+                TenantSpec(name="interactive",
+                           arrival=PoissonProcess(max(0.1, rate * 2 / 3),
+                                                  seed=11),
+                           prompt_mix=prompt_mix, lane="interactive"),
+                TenantSpec(name="batch",
+                           arrival=PoissonProcess(max(0.1, rate / 3),
+                                                  seed=13),
+                           prompt_mix=prompt_mix, lane="batch"),
+            ], seed=seed)
+
+        # Warm the compile ladder with WORKLOAD-SHAPED prompts (session
+        # prompts tokenize differently from the warmup constant), then
+        # calibrate capacity + loaded latency with a short CLOSED-loop
+        # probe — sequential warm latency overestimates capacity badly
+        # once the generator, frontend, and engines share one GIL.
+        _progress("load-curve: compile-ladder warm pass")
+        OpenLoopGenerator(target, make_workload(3.0, seed=7).build_schedule(4.0),
+                          slo_latency_s=600.0, duration_s=4.0).run()
+        _progress("load-curve: closed-loop capacity calibration")
+        cal_lats: list[float] = []
+        cal_lock = threading.Lock()
+        cal_stop = time.perf_counter() + 2.5
+        cal_prompt = make_workload(3.0, seed=7).build_schedule(4.0)[0].prompt
+
+        def cal_worker():
+            while time.perf_counter() < cal_stop:
+                t0 = time.perf_counter()
+                status, _ = target({"question": cal_prompt}, {})
+                if status == 200:
+                    with cal_lock:
+                        cal_lats.append(time.perf_counter() - t0)
+
+        cal_threads = [threading.Thread(target=cal_worker, daemon=True)
+                       for _ in range(2 * n_replicas)]
+        for t in cal_threads:
+            t.start()
+        for t in cal_threads:
+            t.join()
+        if not cal_lats:
+            raise RuntimeError("load-curve calibration produced no throughput")
+        cal_lats.sort()
+        capacity_rps = len(cal_lats) / 2.5
+        slo_latency_s = max(
+            4.0 * cal_lats[int(0.95 * (len(cal_lats) - 1))], 0.25
+        )
+
+        def make_run(rate: float) -> dict:
+            # Overload windows must span several SLOs: a saturated fleet
+            # serves ~capacity*slo good requests as a one-off transient
+            # while its queues fill, and a short window would report that
+            # transient as steady-state goodput (mis-placing the knee).
+            dur = duration_s
+            if rate > 2.0 * capacity_rps:
+                dur = max(duration_s, 4.0 * slo_latency_s)
+            _progress(f"load-curve: offered {rate:.1f} rps for {dur:.1f}s")
+            gen = OpenLoopGenerator(target,
+                                    make_workload(rate).build_schedule(dur),
+                                    slo_latency_s=slo_latency_s,
+                                    duration_s=dur)
+            return gen.run()
+
+        rates = [round(capacity_rps * f, 3) for f in point_factors]
+        curve = run_curve(make_run, rates)
+        _progress(
+            f"load-curve: knee {curve['knee_offered_rps']} rps offered -> "
+            f"{curve['knee_goodput_rps']} rps goodput "
+            f"(collapse: {curve['collapsed']})"
+        )
+        return {
+            "metric": "load_curve_knee_rps",
+            "value": curve["knee_offered_rps"],
+            "unit": "req/s",
+            "n_replicas": n_replicas,
+            "duration_s": duration_s,
+            "estimated_capacity_rps": round(capacity_rps, 3),
+            "slo_latency_s": round(slo_latency_s, 6),
+            "knee_goodput_rps": curve["knee_goodput_rps"],
+            "collapsed": curve["collapsed"],
+            "points": curve["points"],
+        }
+    finally:
+        if front is not None:
+            front.shutdown()
+        for srv in servers:
+            srv.shutdown()
+            if srv.batcher is not None:
+                srv.batcher.close()
+
+
 def ensemble_overlap_benchmark(n_agents: int = 2, questions: int = 3) -> dict[str, Any]:
     """Concurrent-vs-serial wall time for ensemble QA agents on disjoint
     submeshes — the measured version of the claim that edgemesh fixes the
@@ -1386,6 +1555,23 @@ def headline_benchmark(
 
     if os.environ.get("EDGEMESH_BENCH_FLEET", "1") == "1":
         _stage("adaptive_router", _adaptive_router)
+
+    # ---- Stage 7e: the load observatory — open-loop goodput-vs-offered-
+    # load curve over an in-process fleet (edgemesh/loadgen/). The knee is
+    # the headline: the offered load this stack should be run at; the
+    # per-point tenants split makes noisy-neighbor effects visible in the
+    # artifact. EDGEMESH_BENCH_LOADGEN=0 skips.
+    def _load_curve():
+        r = load_curve_benchmark()
+        out["load_curve_knee_rps"] = r["value"]
+        out["load_curve_knee_goodput_rps"] = r["knee_goodput_rps"]
+        out["load_curve_collapsed"] = r["collapsed"]
+        out["load_curve_slo_latency_s"] = r["slo_latency_s"]
+        out["load_curve_capacity_rps"] = r["estimated_capacity_rps"]
+        out["load_curve_points"] = r["points"]
+
+    if os.environ.get("EDGEMESH_BENCH_LOADGEN", "1") == "1":
+        _stage("load_curve", _load_curve)
 
     # ---- Stage 8: speculative decoding at b1 (the latency regime) — on by
     # default since round 4 (EDGEMESH_BENCH_SPEC=0 skips): the reference

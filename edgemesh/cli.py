@@ -17,6 +17,10 @@ Subcommands:
 - ``fleet``    — multi-replica serving fabric: spawn N local replicas and
                  front them with the fault-tolerant router, or inspect a
                  running fleet (edgemesh.fleet; docs/FLEET.md)
+- ``loadgen``  — open-loop load observatory: Poisson/diurnal workload
+                 generation against any /generate endpoint, goodput-vs-
+                 offered-load sweeps (edgemesh.loadgen; render reports
+                 with ``edgemesh obs loadreport``)
 """
 
 from __future__ import annotations
@@ -206,6 +210,12 @@ def main(argv: list[str] | None = None) -> int:
         from edgemesh.obs.cli import main as obs_main
 
         return obs_main(argv[1:])
+    if argv and argv[0] == "loadgen":
+        # The open-loop load observatory: drives any /generate endpoint
+        # over HTTP, no jax/config — delegate before the shared parser.
+        from edgemesh.loadgen.cli import main as loadgen_main
+
+        return loadgen_main(argv[1:])
     if argv and argv[0] == "compare":
         # Own argument shape (two positional JSONL paths) — handled before
         # the shared parser, whose config-mirror options don't apply.

@@ -1,0 +1,296 @@
+"""Multi-tenant admission: rate limits, weighted fairness, priority lanes.
+
+The open-loop load observatory (edgemesh/loadgen/) exposes exactly what a
+bounded-semaphore admission gate cannot express: one abusive batch tenant
+flooding the frontend starves every compliant interactive tenant long
+before the fleet itself saturates, because FIFO slot checkout serves
+whoever arrives most often. This module is the router-side answer
+(docs/FLEET.md "Admission: rate limits, weighted fairness, priority
+lanes"):
+
+- **Per-tenant token buckets** (:class:`TokenBucket`): a tenant past its
+  configured rate is refused with 429 before it costs a slot — the only
+  admission verdict that consumes zero fleet capacity.
+- **Weighted-fair queueing** across tenants (start-time fair queueing):
+  when the in-flight slot pool is full, requests wait in per-tenant FIFO
+  queues and freed slots are granted to the backlogged tenant with the
+  lowest virtual time; each grant advances that tenant's virtual time by
+  ``1/weight``, so long-run slot shares converge to the weight ratio no
+  matter how asymmetric the offered load is.
+- **Priority lanes**: ``interactive`` beats ``batch`` at every grant — an
+  arriving interactive request preempts queued batch work in the ADMISSION
+  queue, never mid-flight (a granted slot is never revoked; latency-sensitive
+  work jumps the queue, it does not kill running requests).
+
+Default construction (no policies, ``queue_cap=0``) reproduces the
+pre-admission router exactly: non-blocking slot checkout, immediate shed at
+``max_inflight`` — so single-tenant deployments keep their semantics and
+their metrics byte-for-byte.
+
+No jax imports (the router-stack contract); every clock is injectable so
+tests pin bucket refill and fairness deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+LANES = ("interactive", "batch")
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate_per_s`` sustained, ``burst`` peak.
+
+    ``try_take`` is non-blocking — admission answers 429 immediately
+    instead of queueing rate-limited work (a queue in front of a rate
+    limit is just a slower rate limit with worse latency)."""
+
+    def __init__(self, rate_per_s: float, burst: float | None = None,
+                 now=time.monotonic) -> None:
+        if rate_per_s <= 0:
+            raise ValueError(f"rate_per_s must be > 0, got {rate_per_s}")
+        self.rate_per_s = float(rate_per_s)
+        self.burst = float(burst) if burst is not None else max(1.0, rate_per_s)
+        self._now = now
+        self._lock = threading.Lock()
+        self._tokens = self.burst
+        self._last = now()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            t = self._now()
+            self._tokens = min(
+                self.burst, self._tokens + (t - self._last) * self.rate_per_s
+            )
+            self._last = t
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def tokens(self) -> float:
+        with self._lock:
+            t = self._now()
+            return min(self.burst,
+                       self._tokens + (t - self._last) * self.rate_per_s)
+
+
+@dataclass(frozen=True)
+class TenantPolicy:
+    """One tenant's admission contract. ``rate_per_s=0`` means unlimited
+    (the bucket is never built); ``weight`` is the fair-share ratio under
+    contention; ``lane`` picks the priority class."""
+
+    rate_per_s: float = 0.0
+    burst: float | None = None
+    weight: float = 1.0
+    lane: str = "interactive"
+
+    def __post_init__(self) -> None:
+        if self.lane not in LANES:
+            raise ValueError(f"lane must be one of {LANES}, got {self.lane!r}")
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+
+    @classmethod
+    def parse(cls, spec: str) -> tuple[str, "TenantPolicy"]:
+        """Parse one ``tenant=lane:weight[:rate[:burst]]`` CLI spec, e.g.
+        ``bulk=batch:1:5`` (batch lane, weight 1, 5 rps) or
+        ``chat=interactive:4`` (interactive, weight 4, unlimited)."""
+        name, _, rest = spec.partition("=")
+        if not name or not rest:
+            raise ValueError(
+                f"bad tenant policy {spec!r} (want tenant=lane:weight[:rate[:burst]])"
+            )
+        parts = rest.split(":")
+        lane = parts[0] or "interactive"
+        weight = float(parts[1]) if len(parts) > 1 and parts[1] else 1.0
+        rate = float(parts[2]) if len(parts) > 2 and parts[2] else 0.0
+        burst = float(parts[3]) if len(parts) > 3 and parts[3] else None
+        return name, cls(rate_per_s=rate, burst=burst, weight=weight, lane=lane)
+
+
+@dataclass
+class _Waiter:
+    """One queued admission request; granted under the controller lock."""
+
+    tenant: str
+    lane: str
+    granted: bool = False
+    abandoned: bool = False
+    enq_t: float = field(default=0.0)
+
+
+class AdmissionController:
+    """Slot pool + per-tenant rate limits + weighted-fair, two-lane queue.
+
+    ``acquire(tenant, wait_s)`` returns one of:
+
+    - ``"ok"``          — a slot is checked out; pair with :meth:`release`.
+    - ``"ratelimited"`` — the tenant's token bucket is empty (429).
+    - ``"overload"``    — pool full and no queue budget (the PER-TENANT
+      ``queue_cap`` hit, or ``wait_s`` ≤ 0) — the legacy shed verdict. The
+      cap is per tenant by design: a flooding tenant filling a shared
+      queue would lock everyone else out at the door.
+    - ``"queue_timeout"`` — queued but no slot freed within ``wait_s``.
+
+    Fairness state is start-time fair queueing: per-tenant virtual time,
+    advanced ``1/weight`` per grant, re-synced to the global floor when an
+    idle tenant returns (an hour of idleness must not bank an hour of
+    burst credit)."""
+
+    def __init__(self, max_inflight: int = 64,
+                 policies: dict[str, TenantPolicy] | None = None,
+                 default_policy: TenantPolicy | None = None,
+                 queue_cap: int = 0,
+                 now=time.monotonic) -> None:
+        from edgemesh.obs.metrics import bounded_label
+
+        self.max_inflight = int(max_inflight)
+        # Policy keys are normalized through the SAME bounded_label the
+        # router normalizes incoming tenants through — and doing it at
+        # construction pre-seeds the label namespace, so a configured
+        # tenant can never collapse into the 'other' overflow bucket and
+        # silently lose its rate limit / weight / lane to a flood of
+        # client-minted ids arriving first.
+        self.policies = {
+            bounded_label(name): pol for name, pol in (policies or {}).items()
+        }
+        self.default_policy = default_policy or TenantPolicy()
+        self.queue_cap = int(queue_cap)
+        self._now = now
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._buckets: dict[str, TokenBucket] = {}
+        self._vtime: dict[str, float] = {}
+        self._queues: dict[str, deque[_Waiter]] = {}
+        self._waiting = 0
+        self._ratelimit_hits: dict[str, int] = {}
+        self._queue_timeouts: dict[str, int] = {}
+
+    def policy_for(self, tenant: str) -> TenantPolicy:
+        return self.policies.get(tenant, self.default_policy)
+
+    def _bucket_for(self, tenant: str) -> TokenBucket | None:
+        pol = self.policy_for(tenant)
+        if pol.rate_per_s <= 0:
+            return None
+        with self._cond:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = self._buckets[tenant] = TokenBucket(
+                    pol.rate_per_s, pol.burst, now=self._now
+                )
+        return bucket
+
+    # -- the admission verdict ----------------------------------------------
+
+    def acquire(self, tenant: str = "default", wait_s: float = 0.0) -> str:
+        bucket = self._bucket_for(tenant)
+        if bucket is not None and not bucket.try_take():
+            with self._cond:
+                self._ratelimit_hits[tenant] = (
+                    self._ratelimit_hits.get(tenant, 0) + 1
+                )
+            return "ratelimited"
+        pol = self.policy_for(tenant)
+        with self._cond:
+            # Fast path: free capacity and nobody queued ahead — grant
+            # without touching fairness state (the uncontended case must
+            # stay as cheap as the old semaphore).
+            if self._inflight < self.max_inflight and self._waiting == 0:
+                self._inflight += 1
+                return "ok"
+            # queue_cap is PER TENANT, not global: a flooding tenant
+            # filling a shared queue would lock every other tenant out at
+            # the door — exactly the starvation the queue exists to
+            # prevent. Each tenant gets its own bounded backlog.
+            q = self._queues.setdefault(tenant, deque())
+            if self.queue_cap <= 0 or wait_s <= 0 or \
+                    sum(1 for w in q if not w.abandoned) >= self.queue_cap:
+                return "overload"
+            waiter = _Waiter(tenant=tenant, lane=pol.lane, enq_t=self._now())
+            q.append(waiter)
+            self._waiting += 1
+            # An idle tenant re-enters at the current floor: fairness is
+            # about SHARES under contention, not banked idle credit.
+            floor = min(self._vtime.values()) if self._vtime else 0.0
+            self._vtime[tenant] = max(self._vtime.get(tenant, 0.0), floor)
+            self._grant_locked()
+            deadline = self._now() + wait_s
+            while not waiter.granted:
+                remaining = deadline - self._now()
+                if remaining <= 0 or not self._cond.wait(timeout=remaining):
+                    if waiter.granted:  # granted in the race with timeout
+                        break
+                    waiter.abandoned = True
+                    self._waiting -= 1
+                    self._queue_timeouts[tenant] = (
+                        self._queue_timeouts.get(tenant, 0) + 1
+                    )
+                    return "queue_timeout"
+            return "ok"
+
+    def release(self) -> None:
+        with self._cond:
+            self._inflight = max(0, self._inflight - 1)
+            self._grant_locked()
+            self._cond.notify_all()
+
+    def _grant_locked(self) -> None:  # guarded by: _cond
+        """Hand free slots to queued waiters: interactive lane strictly
+        before batch, then lowest virtual time among backlogged tenants
+        (ties: tenant name, for determinism). Abandoned waiters (queue
+        timeouts) are garbage-collected as their queue head surfaces."""
+        while self._inflight < self.max_inflight and self._waiting > 0:
+            chosen: str | None = None
+            for lane in LANES:
+                backlog = []
+                for tenant, q in self._queues.items():
+                    while q and q[0].abandoned:
+                        q.popleft()
+                    if q and q[0].lane == lane:
+                        backlog.append((self._vtime.get(tenant, 0.0), tenant))
+                if backlog:
+                    chosen = min(backlog)[1]
+                    break
+            if chosen is None:
+                # Only abandoned entries remained; queues are now clean.
+                break
+            waiter = self._queues[chosen].popleft()
+            waiter.granted = True
+            self._inflight += 1
+            self._waiting -= 1
+            self._vtime[chosen] = (
+                self._vtime.get(chosen, 0.0)
+                + 1.0 / self.policy_for(chosen).weight
+            )
+        self._cond.notify_all()
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Live admission state for ``/fleetz``: slot occupancy, queue
+        depth per tenant, rate-limit / queue-timeout hit counts, and the
+        configured policies."""
+        with self._cond:
+            return {
+                "max_inflight": self.max_inflight,
+                "inflight": self._inflight,
+                "queue_cap": self.queue_cap,
+                "waiting": {
+                    t: sum(1 for w in q if not w.abandoned)
+                    for t, q in self._queues.items()
+                    if any(not w.abandoned for w in q)
+                },
+                "ratelimit_hits": dict(self._ratelimit_hits),
+                "queue_timeouts": dict(self._queue_timeouts),
+                "policies": {
+                    t: {"lane": p.lane, "weight": p.weight,
+                        "rate_per_s": p.rate_per_s}
+                    for t, p in sorted(self.policies.items())
+                },
+            }

@@ -65,6 +65,20 @@ def build_parser() -> argparse.ArgumentParser:
                      "live p95 of a time-decayed latency histogram "
                      "(docs/FLEET.md 'Adaptive routing')")
     srv.add_argument("--max-inflight", type=int, default=64)
+    srv.add_argument("--tenant-policy", action="append", default=[],
+                     metavar="TENANT=LANE:WEIGHT[:RATE[:BURST]]",
+                     help="per-tenant admission policy, repeatable — e.g. "
+                     "'chat=interactive:4' (weight 4, no rate limit) or "
+                     "'bulk=batch:1:5:10' (batch lane, weight 1, 5 rps, "
+                     "burst 10); unknown tenants get the default policy "
+                     "(docs/FLEET.md 'Admission')")
+    srv.add_argument("--admission-queue-cap", type=int, default=0,
+                     help="PER-TENANT admission queue slots (0 = legacy "
+                     "immediate shed at capacity); >0 enables weighted-"
+                     "fair queueing + priority lanes")
+    srv.add_argument("--admission-wait-s", type=float, default=10.0,
+                     help="max time one queued request may wait for a slot "
+                     "(always also capped by the request deadline)")
     srv.add_argument("--span-log", default=None,
                      help="router span JSONL: one router_spans record per "
                      "sampled request, assembled across processes with "
@@ -175,6 +189,17 @@ def cmd_serve(args) -> int:
         _wait_ready(transport, procs, args.boot_timeout_s)
         for rid, port, proc in procs:
             registry.register(rid, f"http://127.0.0.1:{port}", pid=proc.pid)
+        admission = None
+        if args.tenant_policy or args.admission_queue_cap:
+            from edgemesh.fleet.admission import AdmissionController, TenantPolicy
+
+            policies = dict(
+                TenantPolicy.parse(spec) for spec in args.tenant_policy
+            )
+            admission = AdmissionController(
+                max_inflight=args.max_inflight, policies=policies,
+                queue_cap=args.admission_queue_cap,
+            )
         router = FleetRouter(
             registry,
             balancer=args.balancer,
@@ -186,6 +211,8 @@ def cmd_serve(args) -> int:
             hedge_percentile=args.hedge_percentile,
             hedge_auto=args.hedge_auto,
             max_inflight=args.max_inflight,
+            admission=admission,
+            admission_wait_s=args.admission_wait_s,
             span_log=args.span_log,
             trace_sample=args.trace_sample,
         )
@@ -243,6 +270,15 @@ def cmd_status(url: str, as_json: bool) -> int:
         print(f"{r['id']:<12} {r['state']:<10} {r['url']:<28} "
               f"{r['outstanding']:>4} {r['total_routed']:>7} "
               f"{r['total_failures']:>7}")
+    tenants = body.get("tenants") or {}
+    if tenants:
+        print(f"\n{'TENANT':<16} {'REQS':>6} {'GOODPUT':>8} {'SHED':>6} "
+              f"{'RATELIM':>8}")
+        for name, cell in tenants.items():
+            gp = cell.get("goodput_ratio")
+            print(f"{name:<16} {cell.get('requests', 0):>6} "
+                  f"{'-' if gp is None else f'{gp:.3f}':>8} "
+                  f"{cell.get('shed', 0):>6} {cell.get('ratelimited', 0):>8}")
     return 0
 
 

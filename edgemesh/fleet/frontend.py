@@ -17,7 +17,10 @@ every request is answered by the router, never by a local model:
 - ``POST /generate``        → routed to a replica (retries/hedging/drain
   semantics in fleet/router.py); optional ``X-Edgemesh-Deadline-S`` header
   caps this request's total budget; optional ``X-Edgemesh-Trace`` joins a
-  client trace, and the response always carries the trace id back
+  client trace, and the response always carries the trace id back;
+  optional ``X-Edgemesh-Tenant`` selects the admission policy (rate
+  limits, fairness weight, priority lane — fleet/admission.py) and labels
+  the per-tenant counters ``/fleetz`` summarizes
 - ``POST /replicas/register``   {"id": ..., "url": ...}
 - ``POST /replicas/deregister`` {"id": ...}
 - ``POST /replicas/drain``      {"id": ...} → graceful drain (blocks until
@@ -94,6 +97,9 @@ def _make_handler(router, request_timeout_s: float | None):
                         # otherwise the router mints one. Either way the
                         # response carries X-Edgemesh-Trace back.
                         trace=httputil.read_trace_header(self),
+                        # Tenant identity: admission policy + per-tenant
+                        # telemetry (docs/FLEET.md "Admission").
+                        tenant=httputil.read_tenant_header(self),
                     )
                     self._send(status, body, extra=extra)
                 elif self.path in ("/replicas/register", "/replicas/deregister",

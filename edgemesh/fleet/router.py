@@ -23,9 +23,15 @@ robustness semantics on top of the replica registry:
   that outlives the hedge delay gets a second attempt fired at another
   replica; first good answer wins, the loser is abandoned. This converts
   a stalled replica's tail into one extra request of load.
-- **Admission control.** A bounded in-flight slot pool: past
-  ``max_inflight`` the router sheds with 503 + ``Retry-After`` instead of
-  queueing unboundedly — overload stays visible at the edge.
+- **Admission control.** A bounded in-flight slot pool fronted by the
+  multi-tenant admission controller (fleet/admission.py): per-tenant
+  token-bucket rate limits (429 before any slot is spent), weighted-fair
+  queueing across tenants and interactive-over-batch priority lanes when
+  ``queue_cap`` > 0, and past capacity the router sheds with 503 +
+  ``Retry-After`` instead of queueing unboundedly — overload stays
+  visible at the edge. Tenant identity (``X-Edgemesh-Tenant``) selects
+  the policy, is propagated to replicas, and labels per-tenant counters
+  as a BOUNDED value (obs.metrics.bounded_label).
 - **Graceful drain.** ``drain_replica`` takes a replica out of rotation,
   calls its ``/drain`` hook, polls ``/readyz`` until in-flight work hits
   zero, then marks it removed — zero dropped requests by construction.
@@ -53,11 +59,13 @@ import threading
 import time
 from collections import deque
 
+from edgemesh.fleet.admission import AdmissionController
 from edgemesh.fleet.balancer import make_balancer
 from edgemesh.fleet.transport import HttpTransport, TransportError
-from edgemesh.obs.slo import DecayingQuantile
+from edgemesh.obs.metrics import bounded_label
+from edgemesh.obs.slo import DecayingQuantile, SloTarget
 from edgemesh.obs.trace import ROUTER_RECORD_EVENT, TraceContext, sample
-from edgemesh.serve.httputil import DEADLINE_HEADER, TRACE_HEADER
+from edgemesh.serve.httputil import DEADLINE_HEADER, TENANT_HEADER, TRACE_HEADER
 
 log = logging.getLogger("edgemesh.fleet")
 
@@ -82,6 +90,8 @@ class FleetRouter:
         hedge_floor_s: float = 0.02,
         latency_window: int = 256,
         max_inflight: int = 64,
+        admission: AdmissionController | None = None,
+        admission_wait_s: float = 10.0,
         demote_after: int = 2,
         rng: random.Random | None = None,
         span_log=None,
@@ -127,7 +137,25 @@ class FleetRouter:
 
             self._trace_log = JsonlLogger(span_log)
         self._recent_traces: deque[dict] = deque(maxlen=64)
-        self._slots = threading.BoundedSemaphore(max_inflight)
+        # Multi-tenant admission (fleet/admission.py): per-tenant token
+        # buckets, weighted-fair queueing and priority lanes in front of
+        # the in-flight slot pool. The default controller (no policies,
+        # queue_cap=0) reproduces the legacy bounded-semaphore semantics
+        # exactly: non-blocking checkout, immediate shed at max_inflight.
+        # ``admission_wait_s`` caps how long a queued request may wait for
+        # a slot (always further capped by the request deadline).
+        self.admission = admission or AdmissionController(
+            max_inflight=max_inflight)
+        self.max_inflight = self.admission.max_inflight  # controller wins
+        self.admission_wait_s = float(admission_wait_s)
+        # Router-side per-tenant accounting for /fleetz: answered/good/
+        # shed/ratelimited per bounded tenant label. "good" is the
+        # router-observed response-latency SLO — status 200 within the
+        # SloTarget TTFT budget (for the non-streaming /generate contract
+        # the full response IS the first client-visible token).
+        self._slo_target = SloTarget.from_env()
+        self._tenant_lock = threading.Lock()
+        self._tenant_stats: dict[str, dict[str, int]] = {}
         # Rolling successful-attempt latencies: an explicit bounded ring
         # (``latency_window``, surfaced in /fleetz) feeding the legacy
         # ``hedge_percentile`` mode; the auto mode reads the decayed
@@ -159,6 +187,25 @@ class FleetRouter:
             "edgemesh_fleet_shed_total",
             "Requests shed without reaching a replica, by reason", ("reason",),
         )
+        # Per-tenant twins (tenant values bounded via obs.metrics.
+        # bounded_label — EM112). Separate families, not extra labels on
+        # the aggregates above: the aggregate families predate tenancy and
+        # their labelsets are pinned by existing dashboards and tests.
+        self._tenant_requests = reg.counter(
+            "edgemesh_fleet_tenant_requests_total",
+            "Router requests by tenant and outcome "
+            "(ok/retried/hedged_won/shed/exhausted)", ("tenant", "outcome"),
+        )
+        self._tenant_shed = reg.counter(
+            "edgemesh_fleet_tenant_shed_total",
+            "Requests shed before reaching a replica, by tenant and reason",
+            ("tenant", "reason"),
+        )
+        self._tenant_ratelimited = reg.counter(
+            "edgemesh_fleet_tenant_ratelimited_total",
+            "Requests refused by the tenant's token-bucket rate limit",
+            ("tenant",),
+        )
         self._exhausted = reg.counter(
             "edgemesh_fleet_exhausted_total",
             "Requests that failed every attempt",
@@ -188,13 +235,25 @@ class FleetRouter:
     # -- request path --------------------------------------------------------
 
     def handle_generate(self, payload: dict, deadline_s: float | None = None,
-                        path: str = "/generate", trace: TraceContext | None = None):
+                        path: str = "/generate", trace: TraceContext | None = None,
+                        tenant: str | None = None):
         """Route one request. Returns ``(status, body, headers)`` — the
         HTTP frontend writes them verbatim; in-process callers (tests,
         benchmarks) read them directly. ``trace`` joins an existing trace
         (a client-supplied ``X-Edgemesh-Trace``); otherwise this request
         mints its own. The response always carries the trace header back,
-        so clients can fetch ``/debug/traces/<id>`` or grep their logs."""
+        so clients can fetch ``/debug/traces/<id>`` or grep their logs.
+
+        ``tenant`` is the raw ``X-Edgemesh-Tenant`` value (None for
+        untagged traffic, which admits as the ``default`` tenant): it
+        selects the admission policy (rate limit / fairness weight /
+        priority lane), is propagated to the replica on every attempt, and
+        labels the per-tenant counters — as a BOUNDED value
+        (obs.metrics.bounded_label), so client-minted ids cannot explode
+        metric cardinality."""
+        # Normalized once at the door; every .labels(tenant=...) below
+        # uses this bounded value (edgelint EM112).
+        label = bounded_label(tenant)
         ctx = trace or TraceContext.mint(
             sampled=sample(self.trace_sample, self._trace_rng)
         )
@@ -210,30 +269,71 @@ class FleetRouter:
         # ok / retried / hedged_won / shed / exhausted. _route/_dispatch
         # refine it in place as the request's fate lands.
         meta = {"outcome": "shed"}
-        if not self._slots.acquire(blocking=False):
-            self._shed.labels(reason="overload").inc()
+        # Admission: rate limit → fairness queue → slot. Queue wait is
+        # capped by the request's own deadline budget — time spent waiting
+        # for admission comes out of the same budget _route spends, so the
+        # router still never exceeds what the client asked.
+        budget = deadline_s if deadline_s is not None else self.default_deadline_s
+        verdict = self.admission.acquire(
+            label, wait_s=min(self.admission_wait_s, budget)
+        )
+        if verdict == "ratelimited":
+            self._shed.labels(reason="ratelimit").inc()
+            self._tenant_shed.labels(tenant=label, reason="ratelimit").inc()
+            self._tenant_ratelimited.labels(tenant=label).inc()
+            status, body, headers = 429, {
+                "error": "tenant rate limit exceeded", "tenant": label,
+            }, {"Retry-After": "1"}
+        elif verdict != "ok":
+            reason = "overload" if verdict == "overload" else "queue_timeout"
+            self._shed.labels(reason=reason).inc()
+            self._tenant_shed.labels(tenant=label, reason=reason).inc()
             status, body, headers = 503, {
-                "error": "router at capacity", "max_inflight": self.max_inflight,
+                "error": "router at capacity", "reason": reason,
+                "max_inflight": self.max_inflight,
             }, {"Retry-After": "1"}
         else:
             self._inflight_gauge.inc()
             try:
                 status, body, headers = self._route(
-                    payload, t0, deadline_s, path, ctx, spans, meta
+                    payload, t0, deadline_s, path, ctx, spans, meta,
+                    tenant=tenant,
                 )
             finally:
                 self._inflight_gauge.dec()
-                self._slots.release()
-        self._latency_outcome.labels(outcome=meta["outcome"]).observe(
-            time.monotonic() - t0
-        )
+                self.admission.release()
+        latency = time.monotonic() - t0
+        self._latency_outcome.labels(outcome=meta["outcome"]).observe(latency)
+        self._tenant_requests.labels(tenant=label, outcome=meta["outcome"]).inc()
+        self._account_tenant(label, meta["outcome"], status, latency)
         headers = dict(headers)
         headers[TRACE_HEADER] = ctx.to_header()
-        self._finish_trace(ctx, spans, status)
+        self._finish_trace(ctx, spans, status, tenant=tenant)
         return status, body, headers
 
+    def _account_tenant(self, label: str, outcome: str, status: int,
+                        latency_s: float) -> None:
+        """Per-tenant /fleetz accounting: answered/good/shed/ratelimited.
+        "good" = answered 200 within the router-side response-latency
+        target (SloTarget TTFT — the non-streaming front door delivers the
+        whole answer as its first client-visible byte)."""
+        with self._tenant_lock:
+            cell = self._tenant_stats.setdefault(label, {
+                "requests": 0, "answered": 0, "good": 0,
+                "shed": 0, "ratelimited": 0,
+            })
+            cell["requests"] += 1
+            if outcome == "shed":
+                cell["shed"] += 1
+                if status == 429:
+                    cell["ratelimited"] += 1
+            elif status == 200:
+                cell["answered"] += 1
+                if latency_s <= self._slo_target.ttft_s:
+                    cell["good"] += 1
+
     def _finish_trace(self, ctx: TraceContext, spans: list[dict],
-                      status: int) -> None:
+                      status: int, tenant: str | None = None) -> None:
         """Close the root span; for sampled requests, remember the record
         (``/fleetz`` summaries, ``/debug/traces/<id>``) and append it to the
         router span log. The in-memory record keeps the LIVE span dicts so
@@ -247,6 +347,7 @@ class FleetRouter:
             "event": ROUTER_RECORD_EVENT, "ts": spans[0]["t1"],
             "trace_id": ctx.trace_id, "span_id": ctx.span_id,
             "process": "router", "status": status, "clock": "wall",
+            "tenant": tenant,
             "attempts": len(spans) - 1,
             "latency_s": round(spans[0]["t1"] - spans[0]["t0"], 6),
             "spans": spans,
@@ -258,7 +359,8 @@ class FleetRouter:
             fields["spans"] = [dict(s) for s in spans]
             self._trace_log.log(ROUTER_RECORD_EVENT, **fields)
 
-    def _route(self, payload, t0, deadline_s, path, ctx, spans, meta=None):
+    def _route(self, payload, t0, deadline_s, path, ctx, spans, meta=None,
+               tenant: str | None = None):
         meta = meta if meta is not None else {"outcome": "shed"}
         deadline = t0 + (deadline_s if deadline_s is not None else self.default_deadline_s)
         prompt = payload.get("question") if isinstance(payload, dict) else None
@@ -282,7 +384,7 @@ class FleetRouter:
                 meta["outcome"] = "shed"
                 return 503, {"error": "no available replica"}, {"Retry-After": "1"}
             outcome = self._dispatch(rep, payload, path, deadline, prompt,
-                                     excluded, ctx, spans, meta)
+                                     excluded, ctx, spans, meta, tenant=tenant)
             if outcome[0] == "ok":
                 _, rid, status, body, won_span = outcome
                 won_span["won"] = True
@@ -318,7 +420,7 @@ class FleetRouter:
     # -- attempts ------------------------------------------------------------
 
     def _attempt_one(self, rep, payload, path, deadline, ctx, spans,
-                     hedge: bool = False):
+                     hedge: bool = False, tenant: str | None = None):
         """One checked-out attempt → ("ok", rid, status, body) for any
         answered status < 500, else ("fail", rid, reason, detail).
 
@@ -349,6 +451,11 @@ class FleetRouter:
         timeout_s = min(self.attempt_timeout_s, remaining)
         headers = {DEADLINE_HEADER: f"{remaining:.3f}",
                    TRACE_HEADER: ctx.to_header()}
+        if tenant is not None:
+            # Tenant identity rides every attempt: the replica's span
+            # records and per-tenant SLO metrics attribute the work to the
+            # same tenant the router admitted (docs/OBSERVABILITY.md).
+            headers[TENANT_HEADER] = tenant
         t0 = time.monotonic()
         try:
             status, body = self.transport.post_json(
@@ -392,7 +499,7 @@ class FleetRouter:
         return None
 
     def _dispatch(self, rep, payload, path, deadline, prompt, excluded,
-                  ctx, spans, meta=None):
+                  ctx, spans, meta=None, tenant: str | None = None):
         """One attempt round, hedged when configured. Returns
         ("ok", rid, status, body) or ("fail", [(rid, reason, detail), ...]).
         Every attempt (primary and hedge) gets its own child trace context
@@ -402,7 +509,7 @@ class FleetRouter:
         hedge_delay = self._hedge_delay()
         if hedge_delay is None or hedge_delay >= (deadline - time.monotonic()):
             out = self._attempt_one(rep, payload, path, deadline,
-                                    ctx.child(), spans)
+                                    ctx.child(), spans, tenant=tenant)
             return out if out[0] == "ok" else ("fail", [out[1:]])
 
         results: queue.Queue = queue.Queue()
@@ -410,7 +517,7 @@ class FleetRouter:
         def run(replica, is_hedge):
             results.put((is_hedge, self._attempt_one(
                 replica, payload, path, deadline, ctx.child(), spans,
-                hedge=is_hedge,
+                hedge=is_hedge, tenant=tenant,
             )))
 
         threading.Thread(target=run, args=(rep, False), daemon=True).start()
@@ -573,10 +680,26 @@ class FleetRouter:
             hedge_mode = "auto"
         else:
             hedge_mode = "off"
+        with self._tenant_lock:
+            tenants = {
+                t: {
+                    **cell,
+                    "goodput_ratio": (
+                        round(cell["good"] / cell["answered"], 4)
+                        if cell["answered"] else None
+                    ),
+                }
+                for t, cell in sorted(self._tenant_stats.items())
+            }
         return {
             "balancer": getattr(self.balancer, "name", type(self.balancer).__name__),
             "max_inflight": self.max_inflight,
             "max_attempts": self.max_attempts,
+            # Multi-tenant surfaces: live admission state (queues, policy
+            # table, rate-limit hits) + per-tenant request accounting with
+            # the router-observed goodput ratio.
+            "admission": self.admission.stats(),
+            "tenants": tenants,
             # The successful-attempt latency ring backing the legacy
             # percentile hedge: explicit bound + live fill level.
             "latency_window": {"size": window_size, "len": window_len},

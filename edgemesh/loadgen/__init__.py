@@ -1,0 +1,46 @@
+"""edgemesh.loadgen — the open-loop load observatory.
+
+Every serving number this repo produced before PR 9 came from a
+closed-loop driver: N workers fire a request, WAIT for the answer, fire
+the next. Closed loops cannot see queueing collapse — when the system
+slows down, the load generator politely slows down with it (coordinated
+omission), and the measured tail is a fiction. Production traffic does
+not wait. This package drives the fleet the way users do
+(docs/OBSERVABILITY.md "The load observatory"):
+
+- ``arrivals``: Poisson and diurnal-burst arrival processes — request
+  LAUNCH times are fixed by the schedule before the run starts, and every
+  request launches on time regardless of completions, so coordinated
+  omission is structurally impossible.
+- ``workload``: long-tail prompt/output-length mixes, multi-turn sessions
+  with shared prefixes (exercising ``prefix_affinity`` routing and the
+  replica prefix caches), and configurable tenant mixes — interactive vs
+  batch, compliant vs abusive.
+- ``generator``: the open-loop driver. Latency is measured from the
+  SCHEDULED arrival (not the actual send), goodput counts good answers
+  against every SCHEDULED request, and the report splits per tenant.
+- ``curve``: offered-load sweeps → goodput-vs-offered-load points with
+  the saturation knee identified (the bench stage ``load_curve`` and
+  ``edgemesh obs loadreport`` consume this schema).
+
+No jax anywhere in the package — the observatory drives serving stacks
+over HTTP (or any in-process callable) from hosts with no accelerator.
+"""
+
+from edgemesh.loadgen.arrivals import (  # noqa: F401
+    ConstantProcess,
+    DiurnalBurstProcess,
+    PoissonProcess,
+)
+from edgemesh.loadgen.curve import find_knee, run_curve  # noqa: F401
+from edgemesh.loadgen.generator import (  # noqa: F401
+    OpenLoopGenerator,
+    http_target,
+    summarize,
+)
+from edgemesh.loadgen.workload import (  # noqa: F401
+    LengthMix,
+    ScheduledRequest,
+    TenantSpec,
+    Workload,
+)

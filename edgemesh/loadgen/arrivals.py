@@ -1,0 +1,122 @@
+"""Arrival processes: WHEN requests launch, fixed before the run starts.
+
+The whole point of open-loop generation is that the schedule is computed
+up front from the arrival process alone — the system under test cannot
+slow the generator down, so a saturated fleet accumulates an honest
+backlog instead of silently throttling the measurement (the coordinated
+omission trap; see docs/OBSERVABILITY.md).
+
+All processes are seeded and deterministic: the same spec replays the
+same schedule, which is what lets an A/B (fairness on vs off) drive two
+arms with IDENTICAL traffic.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+
+class ConstantProcess:
+    """Fixed inter-arrival gaps — the degenerate baseline (and the
+    deterministic choice for schedule-shape unit tests)."""
+
+    name = "constant"
+
+    def __init__(self, rate_rps: float) -> None:
+        if rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+        self.rate_rps = float(rate_rps)
+
+    def schedule(self, duration_s: float) -> list[float]:
+        gap = 1.0 / self.rate_rps
+        return [i * gap for i in range(int(duration_s * self.rate_rps))]
+
+
+class PoissonProcess:
+    """Memoryless arrivals: exponential inter-arrival gaps at ``rate_rps``.
+
+    The canonical open-loop model — real request streams from many
+    independent users are Poisson to first order, and the exponential
+    gaps produce the natural short bursts a constant-gap driver never
+    shows the admission queue."""
+
+    name = "poisson"
+
+    def __init__(self, rate_rps: float, seed: int = 0) -> None:
+        if rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+        self.rate_rps = float(rate_rps)
+        self.seed = int(seed)
+
+    def schedule(self, duration_s: float) -> list[float]:
+        rng = random.Random(self.seed)
+        out: list[float] = []
+        t = rng.expovariate(self.rate_rps)
+        while t < duration_s:
+            out.append(t)
+            t += rng.expovariate(self.rate_rps)
+        return out
+
+
+class DiurnalBurstProcess:
+    """Non-homogeneous Poisson: a sinusoidal "diurnal" rate swing between
+    ``base_rps`` and ``peak_rps`` over ``period_s``, plus optional square
+    bursts (``burst_rps`` extra for ``burst_len_s`` every
+    ``burst_every_s``) — the compressed model of a day of traffic with
+    top-of-the-hour spikes.
+
+    Sampled by thinning (Lewis & Shedler): draw a homogeneous Poisson
+    stream at the max rate, keep each arrival with probability
+    ``rate(t) / max_rate``. Exact for any bounded rate function, and the
+    kept arrivals are still Poisson locally — the burst edges stay sharp.
+    """
+
+    name = "diurnal"
+
+    def __init__(self, base_rps: float, peak_rps: float, period_s: float,
+                 burst_rps: float = 0.0, burst_every_s: float = 0.0,
+                 burst_len_s: float = 1.0, seed: int = 0) -> None:
+        if base_rps <= 0 or peak_rps < base_rps:
+            raise ValueError(
+                f"need 0 < base_rps <= peak_rps, got {base_rps}/{peak_rps}"
+            )
+        if period_s <= 0:
+            raise ValueError(f"period_s must be > 0, got {period_s}")
+        self.base_rps = float(base_rps)
+        self.peak_rps = float(peak_rps)
+        self.period_s = float(period_s)
+        self.burst_rps = float(burst_rps)
+        self.burst_every_s = float(burst_every_s)
+        self.burst_len_s = float(burst_len_s)
+        self.seed = int(seed)
+
+    def rate(self, t: float) -> float:
+        """The instantaneous offered rate at offset ``t`` (rps). Starts at
+        the trough (t=0 is the quiet edge of the cycle)."""
+        swing = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / self.period_s))
+        r = self.base_rps + (self.peak_rps - self.base_rps) * swing
+        if (
+            self.burst_rps > 0 and self.burst_every_s > 0
+            and (t % self.burst_every_s) < self.burst_len_s
+        ):
+            r += self.burst_rps
+        return r
+
+    def schedule(self, duration_s: float) -> list[float]:
+        max_rate = self.peak_rps + max(0.0, self.burst_rps)
+        rng = random.Random(self.seed)
+        out: list[float] = []
+        t = rng.expovariate(max_rate)
+        while t < duration_s:
+            if rng.random() < self.rate(t) / max_rate:
+                out.append(t)
+            t += rng.expovariate(max_rate)
+        return out
+
+
+ARRIVALS = {
+    "constant": ConstantProcess,
+    "poisson": PoissonProcess,
+    "diurnal": DiurnalBurstProcess,
+}

@@ -1,0 +1,150 @@
+"""``edgemesh loadgen`` — drive a serving endpoint open-loop.
+
+One shot (``--rate``) prints the open-loop report for a single offered
+load; a sweep (``--sweep r1,r2,r3``) prints the goodput-vs-offered-load
+curve document with the saturation knee identified. Render either with
+``edgemesh obs loadreport``. No jax, no device — point it at any
+``/generate`` endpoint (a replica gateway or the fleet frontend).
+
+Tenant mixes: ``--tenant name=share[:lane]`` (repeatable) splits the
+aggregate rate by share, e.g. ``--tenant chat=3:interactive --tenant
+bulk=1:batch`` sends 75%/25%. Each tenant tags its requests with
+``X-Edgemesh-Tenant`` so the router's admission policies and the
+per-tenant telemetry see exactly this traffic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from edgemesh.loadgen.arrivals import DiurnalBurstProcess, PoissonProcess
+from edgemesh.loadgen.curve import run_curve
+from edgemesh.loadgen.generator import OpenLoopGenerator, http_target
+from edgemesh.loadgen.workload import LengthMix, TenantSpec, Workload
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="edgemesh loadgen",
+        description="open-loop load observatory (docs/OBSERVABILITY.md "
+        "'The load observatory')",
+    )
+    p.add_argument("--url", required=True,
+                   help="the /generate endpoint to drive (fleet frontend "
+                   "or a single replica gateway)")
+    p.add_argument("--rate", type=float, default=2.0,
+                   help="aggregate offered load in requests/s")
+    p.add_argument("--sweep", default=None, metavar="R1,R2,...",
+                   help="sweep these aggregate rates and emit the "
+                   "goodput-vs-offered-load curve (overrides --rate)")
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="seconds of scheduled traffic per point")
+    p.add_argument("--arrival", default="poisson",
+                   choices=["poisson", "diurnal"],
+                   help="arrival process (diurnal = sinusoidal swing + "
+                   "bursts; see --period-s/--peak-factor/--burst-rps)")
+    p.add_argument("--period-s", type=float, default=60.0)
+    p.add_argument("--peak-factor", type=float, default=3.0,
+                   help="diurnal: peak rate as a multiple of the trough")
+    p.add_argument("--burst-rps", type=float, default=0.0)
+    p.add_argument("--burst-every-s", type=float, default=0.0)
+    p.add_argument("--tenant", action="append", default=[],
+                   metavar="NAME=SHARE[:LANE]",
+                   help="tenant mix entry, repeatable (shares normalized; "
+                   "lane interactive|batch, default interactive)")
+    p.add_argument("--slo-latency-s", type=float, default=None,
+                   help="client-side SLO: a request is good iff answered "
+                   "200 within this many seconds of its SCHEDULED arrival "
+                   "(default: the EDGEMESH_SLO_TTFT_S target)")
+    p.add_argument("--timeout-s", type=float, default=60.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prompt-median", type=int, default=48,
+                   help="long-tail prompt-length mix: median chars")
+    p.add_argument("--prompt-sigma", type=float, default=0.6,
+                   help="long-tail prompt-length mix: lognormal sigma")
+    p.add_argument("--sessions", type=int, default=4,
+                   help="concurrent multi-turn sessions per tenant "
+                   "(shared-prefix traffic for prefix_affinity routing)")
+    p.add_argument("--turns", type=float, default=3.0,
+                   help="mean turns per session before the prefix resets")
+    p.add_argument("--max-new", action="store_true",
+                   help="attach a sampled per-request max_new budget "
+                   "(continuous non-speculative replicas only)")
+    p.add_argument("--out", default=None,
+                   help="also write the report JSON here")
+    return p
+
+
+def _tenant_shares(specs: list[str]) -> list[tuple[str, float, str]]:
+    if not specs:
+        return [("default", 1.0, "interactive")]
+    out = []
+    for spec in specs:
+        name, _, rest = spec.partition("=")
+        if not name or not rest:
+            raise SystemExit(f"bad --tenant {spec!r} (want NAME=SHARE[:LANE])")
+        share, _, lane = rest.partition(":")
+        out.append((name, float(share), lane or "interactive"))
+    total = sum(s for _, s, _ in out)
+    if total <= 0:
+        raise SystemExit("tenant shares must sum > 0")
+    return [(n, s / total, lane) for n, s, lane in out]
+
+
+def _make_workload(args, rate: float) -> Workload:
+    shares = _tenant_shares(args.tenant)
+    prompt_mix = LengthMix(median=args.prompt_median, sigma=args.prompt_sigma)
+    tenants = []
+    for i, (name, share, lane) in enumerate(shares):
+        t_rate = max(1e-6, rate * share)
+        if args.arrival == "diurnal":
+            # The requested rate is the MEAN of the sinusoid: trough/peak
+            # placed symmetrically around it by --peak-factor.
+            trough = 2.0 * t_rate / (1.0 + args.peak_factor)
+            arrival = DiurnalBurstProcess(
+                base_rps=max(1e-6, trough),
+                peak_rps=max(trough, trough * args.peak_factor),
+                period_s=args.period_s, burst_rps=args.burst_rps,
+                burst_every_s=args.burst_every_s, seed=args.seed + i,
+            )
+        else:
+            arrival = PoissonProcess(t_rate, seed=args.seed + i)
+        tenants.append(TenantSpec(
+            name=name, arrival=arrival, lane=lane, prompt_mix=prompt_mix,
+            sessions=args.sessions, turns_mean=args.turns,
+            send_max_new=args.max_new,
+        ))
+    return Workload(tenants, seed=args.seed)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    target = http_target(args.url, timeout_s=args.timeout_s)
+
+    def run_at(rate: float) -> dict:
+        wl = _make_workload(args, rate)
+        gen = OpenLoopGenerator(
+            target, wl.build_schedule(args.duration),
+            slo_latency_s=args.slo_latency_s, duration_s=args.duration,
+        )
+        return gen.run()
+
+    if args.sweep:
+        rates = [float(r) for r in args.sweep.split(",") if r.strip()]
+        if len(rates) < 2:
+            raise SystemExit("--sweep needs at least two rates")
+        doc = run_curve(run_at, rates)
+    else:
+        doc = run_at(args.rate)
+    text = json.dumps(doc, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""Goodput-vs-offered-load curves and the saturation knee.
+
+The production-grade serving report is not one throughput number — it is
+the CURVE: sweep offered load across points, plot goodput (requests/s
+meeting the SLO) against it, and find where the curve stops following the
+diagonal. Below the knee, goodput tracks offered load (the fleet absorbs
+everything); past it, queueing delay eats the SLO budget and goodput
+flattens — then COLLAPSES as sheds and timeouts take over. Everything
+interesting about a serving stack (admission quality, fairness, hedging)
+is a statement about the shape of this curve.
+"""
+
+from __future__ import annotations
+
+
+def find_knee(points: list[dict]) -> dict:
+    """Identify the saturation knee in a sorted list of curve points
+    (each ``{"offered_rps": ..., "goodput_rps": ...}``).
+
+    The knee is the offered load with the highest goodput (ties → lowest
+    offered load: pushing harder for nothing is past the knee by
+    definition). ``collapsed`` reports whether the curve then came DOWN —
+    the highest offered point's goodput fell more than 10% below the knee
+    — which distinguishes saturation (flat) from collapse (the overload
+    regime open-loop measurement exists to expose)."""
+    if not points:
+        return {"knee_offered_rps": None, "knee_goodput_rps": None,
+                "collapsed": False}
+    pts = sorted(points, key=lambda p: p["offered_rps"])
+    knee = max(pts, key=lambda p: (p.get("goodput_rps") or 0.0,
+                                   -p["offered_rps"]))
+    last = pts[-1]
+    knee_gp = knee.get("goodput_rps") or 0.0
+    collapsed = bool(
+        last["offered_rps"] > knee["offered_rps"]
+        and (last.get("goodput_rps") or 0.0) < 0.9 * knee_gp
+    )
+    return {
+        "knee_offered_rps": knee["offered_rps"],
+        "knee_goodput_rps": knee_gp,
+        "collapsed": collapsed,
+    }
+
+
+def run_curve(make_run, rates: list[float]) -> dict:
+    """Sweep ``rates`` (aggregate offered rps) through ``make_run(rate) ->
+    report`` (an :class:`~edgemesh.loadgen.generator.OpenLoopGenerator`
+    run at that rate) and assemble the curve document: one point per
+    rate (the generator report + the requested rate) plus the knee.
+
+    ``make_run`` owns workload construction so each point can rebuild the
+    tenant mix scaled to its rate — the curve is over IDENTICALLY SHAPED
+    traffic at different intensities, not different workloads."""
+    points = []
+    for rate in rates:
+        report = make_run(rate)
+        points.append({"requested_rps": rate, **report})
+    curve = {
+        "points": [
+            {
+                "requested_rps": p["requested_rps"],
+                "offered_rps": p["offered_rps"],
+                "goodput_rps": p["goodput_rps"],
+                "goodput_ratio": p["goodput_ratio"],
+                "shed": p["shed"],
+                "errors": p["errors"],
+                "latency_s_p50": p["latency_s_p50"],
+                "latency_s_p99": p["latency_s_p99"],
+                "tenants": p["tenants"],
+            }
+            for p in points
+        ],
+        "slo_latency_s": points[0]["slo_latency_s"] if points else None,
+    }
+    curve.update(find_knee(curve["points"]))
+    return curve

@@ -1,0 +1,197 @@
+"""The open-loop driver: launch on schedule, measure from schedule.
+
+Two rules make this generator immune to coordinated omission:
+
+1. **Launches never wait for completions.** The launcher thread sleeps to
+   each scheduled arrival and hands the request to its own worker thread;
+   a saturated fleet sees the full offered backlog pile into its
+   admission queue, exactly like real traffic.
+2. **Latency is measured from the SCHEDULED arrival**, not the actual
+   send. If the launcher itself slips (GIL, thread spawn), the slip is
+   charged to the measurement — and reported separately as
+   ``max_launch_skew_s`` so a broken run is distinguishable from a slow
+   fleet.
+
+Goodput is counted against every SCHEDULED request: a shed, errored, or
+never-answered request is a goodput miss by construction. That is the
+number a closed-loop driver cannot produce.
+
+The target is any callable ``(payload, headers) -> (status, body)`` —
+:func:`http_target` adapts a URL via the fleet transport; tests pass
+in-process callables and pay zero sockets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from edgemesh.loadgen.workload import ScheduledRequest
+from edgemesh.obs.slo import SloTarget
+from edgemesh.serve.httputil import TENANT_HEADER
+
+#: Synthetic status for transport-level failures (connect refused, socket
+#: timeout): the request died below HTTP, which open-loop accounting must
+#: still count against goodput.
+TRANSPORT_ERROR_STATUS = 599
+
+
+def http_target(url: str, timeout_s: float = 60.0):
+    """Adapt a ``/generate`` URL into a generator target. Transport
+    failures become status ``TRANSPORT_ERROR_STATUS`` — never exceptions;
+    an open-loop run must account every scheduled request."""
+    from edgemesh.fleet.transport import HttpTransport, TransportError
+
+    transport = HttpTransport()
+
+    def call(payload: dict, headers: dict) -> tuple[int, dict]:
+        try:
+            return transport.post_json(url, payload, timeout_s=timeout_s,
+                                       headers=headers)
+        except TransportError as e:
+            return TRANSPORT_ERROR_STATUS, {"error": str(e)}
+
+    return call
+
+
+@dataclass
+class RequestOutcome:
+    """One launched request's fate, timed against its schedule slot."""
+
+    tenant: str
+    lane: str
+    session: str
+    scheduled_s: float        # schedule offset from run start
+    launch_skew_s: float      # actual send - scheduled (generator health)
+    latency_s: float          # completion - SCHEDULED arrival (the honest one)
+    status: int
+    ok: bool
+
+
+class OpenLoopGenerator:
+    """Drive one schedule open-loop against one target."""
+
+    def __init__(self, target, schedule: list[ScheduledRequest],
+                 slo_latency_s: float | None = None,
+                 duration_s: float | None = None,
+                 max_threads: int = 512) -> None:
+        self.target = target
+        self.schedule = sorted(schedule, key=lambda r: r.at_s)
+        # The nominal window offered_rps/goodput_rps divide by; falls back
+        # to the last scheduled arrival when the caller has no nominal.
+        self.duration_s = duration_s
+        # The client-side SLO: a request is GOOD iff it answered 200
+        # within this many seconds of its scheduled arrival. Defaults to
+        # the deployment's TTFT target (for the non-streaming front door
+        # the full answer is the first client-visible byte).
+        self.slo_latency_s = (
+            float(slo_latency_s) if slo_latency_s is not None
+            else SloTarget.from_env().ttft_s
+        )
+        self.max_threads = int(max_threads)
+
+    def run(self) -> dict:
+        """Execute the schedule; returns the report dict (see
+        :func:`summarize`). Blocks until every launched request resolves
+        (each is itself bounded by the target's timeout)."""
+        outcomes: list[RequestOutcome | None] = [None] * len(self.schedule)
+        threads: list[threading.Thread] = []
+        # Backstop against unbounded live-thread growth on a wedged
+        # target: the launcher blocks on the gate past ``max_threads``
+        # in-flight workers — the stall is visible as launch skew, never
+        # silently dropped work. A semaphore, not a liveness scan: the
+        # launch loop must stay O(1) per request or the launcher itself
+        # slips at exactly the high-rate points the knee is measured at.
+        gate = threading.BoundedSemaphore(self.max_threads)
+        t0 = time.monotonic()
+
+        def fire(i: int, req: ScheduledRequest) -> None:
+            try:
+                sent = time.monotonic()
+                headers = {TENANT_HEADER: req.tenant}
+                status, _body = self.target(req.payload(), headers)
+                done = time.monotonic()
+                sched_abs = t0 + req.at_s
+                outcomes[i] = RequestOutcome(
+                    tenant=req.tenant, lane=req.lane, session=req.session,
+                    scheduled_s=req.at_s,
+                    launch_skew_s=sent - sched_abs,
+                    latency_s=done - sched_abs,
+                    status=status, ok=status == 200,
+                )
+            finally:
+                gate.release()
+
+        for i, req in enumerate(self.schedule):
+            # Open-loop: sleep to the SCHEDULE, never to a completion.
+            delay = (t0 + req.at_s) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            gate.acquire()
+            th = threading.Thread(target=fire, args=(i, req), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        duration_s = self.duration_s or (
+            max((r.at_s for r in self.schedule), default=0.0)
+            or time.monotonic() - t0
+        )
+        return summarize([o for o in outcomes if o is not None],
+                         duration_s=max(duration_s, 1e-9),
+                         slo_latency_s=self.slo_latency_s)
+
+
+def _pct(xs: list[float], q: float) -> float | None:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(q * len(xs)))], 6)
+
+
+def _bucket(outcomes: list[RequestOutcome], duration_s: float,
+            slo_latency_s: float) -> dict:
+    lat = [o.latency_s for o in outcomes if o.ok]
+    good = sum(1 for o in outcomes
+               if o.ok and o.latency_s <= slo_latency_s)
+    n = len(outcomes)
+    return {
+        "scheduled": n,
+        "offered_rps": round(n / duration_s, 4),
+        "ok": sum(1 for o in outcomes if o.ok),
+        "shed": sum(1 for o in outcomes if o.status in (429, 503)),
+        "ratelimited": sum(1 for o in outcomes if o.status == 429),
+        "errors": sum(
+            1 for o in outcomes
+            if not o.ok and o.status not in (429, 503)
+        ),
+        "good": good,
+        "goodput_rps": round(good / duration_s, 4),
+        # Against SCHEDULED, not answered: a shed request is a goodput
+        # miss — that asymmetry is the whole observatory.
+        "goodput_ratio": round(good / n, 4) if n else None,
+        "latency_s_p50": _pct(lat, 0.50),
+        "latency_s_p99": _pct(lat, 0.99),
+    }
+
+
+def summarize(outcomes: list[RequestOutcome], duration_s: float,
+              slo_latency_s: float) -> dict:
+    """Aggregate + per-tenant open-loop report (the ``load_curve`` point
+    schema; docs/OBSERVABILITY.md documents every key)."""
+    tenants = sorted({o.tenant for o in outcomes})
+    report = {
+        "duration_s": round(duration_s, 4),
+        "slo_latency_s": slo_latency_s,
+        "max_launch_skew_s": round(
+            max((o.launch_skew_s for o in outcomes), default=0.0), 6
+        ),
+        **_bucket(outcomes, duration_s, slo_latency_s),
+        "tenants": {
+            t: _bucket([o for o in outcomes if o.tenant == t],
+                       duration_s, slo_latency_s)
+            for t in tenants
+        },
+    }
+    return report

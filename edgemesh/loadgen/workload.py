@@ -1,0 +1,165 @@
+"""WHAT the observatory sends: length mixes, sessions, tenant mixes.
+
+Three layers compose into one merged request timeline:
+
+- :class:`LengthMix` — long-tail (lognormal) prompt/output lengths. Real
+  prompt-length distributions are heavy-tailed: a mean-length constant
+  would never show the admission queue a 10x-cost straggler parked in
+  front of forty cheap requests.
+- sessions — multi-turn conversations sharing a stable prefix. Turn ``k``
+  of a session carries the session's full synthetic history, so
+  ``prefix_affinity`` routing keys identically across turns and the
+  replica-side prefix caches (``runtime/prefix_cache.py``, paged template
+  pages) actually get exercised by the generated traffic.
+- :class:`TenantSpec` / :class:`Workload` — the tenant mix: each tenant
+  owns an arrival process, a length mix, a lane (interactive/batch) and a
+  session shape; ``Workload.build_schedule`` merges every tenant's
+  timeline into one sorted open-loop schedule.
+
+Everything is seeded → a workload spec IS its traffic, replayable
+byte-for-byte across arms and runs.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+_WORDS = (
+    "mesh edge device tensor shard page cache token decode prefill route "
+    "batch stream quant fleet replica probe trace span tenant session"
+).split()
+
+
+@dataclass(frozen=True)
+class LengthMix:
+    """Long-tail length sampler: ``exp(N(log median, sigma))`` clipped to
+    ``[lo, hi]``. ``sigma=0`` degenerates to the constant ``median``."""
+
+    median: int = 48
+    sigma: float = 0.6
+    lo: int = 8
+    hi: int = 2048
+
+    def sample(self, rng: random.Random) -> int:
+        if self.sigma <= 0:
+            v = float(self.median)
+        else:
+            v = rng.lognormvariate(_ln(self.median), self.sigma)
+        return int(min(self.hi, max(self.lo, round(v))))
+
+
+def _ln(x: float) -> float:
+    import math
+
+    return math.log(max(1.0, float(x)))
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's traffic contract. ``arrival`` is any object with a
+    ``schedule(duration_s) -> list[float]`` (edgemesh.loadgen.arrivals).
+    ``sessions``/``turns_mean`` shape the multi-turn structure: arrivals
+    are dealt round-robin onto ``sessions`` concurrent conversations, and
+    a session resets (fresh prefix) after a geometric number of turns
+    around ``turns_mean``. ``max_new`` attaches a per-request decode
+    budget sampled from ``output_mix`` (only send this at continuous
+    non-speculative replicas — the gateway 400s it elsewhere)."""
+
+    name: str
+    arrival: object
+    lane: str = "interactive"
+    prompt_mix: LengthMix = field(default_factory=LengthMix)
+    output_mix: LengthMix = field(default_factory=lambda: LengthMix(
+        median=32, sigma=0.8, lo=4, hi=512))
+    sessions: int = 4
+    turns_mean: float = 3.0
+    send_max_new: bool = False
+
+
+@dataclass
+class ScheduledRequest:
+    """One open-loop launch: fixed time, fixed payload, fixed identity."""
+
+    at_s: float
+    tenant: str
+    lane: str
+    prompt: str
+    session: str
+    turn: int
+    max_new: int | None = None
+
+    def payload(self) -> dict:
+        body: dict = {"question": self.prompt}
+        if self.max_new is not None:
+            body["max_new"] = self.max_new
+        return body
+
+
+class _Session:
+    """One rolling conversation: a stable prefix plus appended turns."""
+
+    def __init__(self, sid: str, rng: random.Random, turns_mean: float):
+        self.sid = sid
+        self._rng = rng
+        self._turns_mean = max(1.0, turns_mean)
+        self._reset()
+
+    def _reset(self) -> None:
+        # The prefix is the affinity/caching key: stable across the
+        # session's turns, distinct across sessions.
+        seed_words = " ".join(self._rng.choices(_WORDS, k=6))
+        self.prefix = f"[session {self.sid}] context: {seed_words}."
+        self.turn = 0
+
+    def next_prompt(self, prompt_chars: int) -> tuple[str, int]:
+        self.turn += 1
+        turn = self.turn
+        body = f" turn {turn}:"
+        rng = self._rng
+        while len(self.prefix) + len(body) < prompt_chars:
+            body += " " + rng.choice(_WORDS)
+        prompt = self.prefix + body + "?"
+        # Geometric session length around turns_mean: each turn ends the
+        # session with probability 1/turns_mean.
+        if rng.random() < 1.0 / self._turns_mean:
+            self._reset()
+        return prompt, turn
+
+
+class Workload:
+    """A tenant mix → one merged, sorted open-loop schedule."""
+
+    def __init__(self, tenants: list[TenantSpec], seed: int = 0) -> None:
+        if not tenants:
+            raise ValueError("a workload needs at least one tenant")
+        names = [t.name for t in tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant names: {names}")
+        self.tenants = list(tenants)
+        self.seed = int(seed)
+
+    def build_schedule(self, duration_s: float) -> list[ScheduledRequest]:
+        import zlib
+
+        out: list[ScheduledRequest] = []
+        for spec in self.tenants:
+            # crc32, not hash(): str hashing is PYTHONHASHSEED-randomized
+            # per process, and a workload spec must replay identically
+            # across processes and runs.
+            rng = random.Random(zlib.crc32(f"{self.seed}:{spec.name}".encode()))
+            sessions = [
+                _Session(f"{spec.name}-{i}", rng, spec.turns_mean)
+                for i in range(max(1, spec.sessions))
+            ]
+            for i, at in enumerate(spec.arrival.schedule(duration_s)):
+                sess = sessions[i % len(sessions)]
+                prompt, turn = sess.next_prompt(spec.prompt_mix.sample(rng))
+                out.append(ScheduledRequest(
+                    at_s=at, tenant=spec.name, lane=spec.lane,
+                    prompt=prompt, session=sess.sid, turn=turn,
+                    max_new=(spec.output_mix.sample(rng)
+                             if spec.send_max_new else None),
+                ))
+        out.sort(key=lambda r: r.at_s)
+        return out

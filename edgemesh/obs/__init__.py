@@ -27,8 +27,11 @@ from edgemesh.obs.device import register_device_gauges  # noqa: F401
 from edgemesh.obs.metrics import (  # noqa: F401
     INTER_TOKEN_BUCKETS,
     LATENCY_BUCKETS,
+    OTHER_LABEL,
     Registry,
+    bounded_label,
     get_registry,
+    reset_bounded_labels,
     set_registry,
 )
 from edgemesh.obs.slo import (  # noqa: F401

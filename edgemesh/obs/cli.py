@@ -19,6 +19,9 @@ Subcommands (all operate on the span JSONL the engines write via
   clock-skew correction, plus the critical-path split (wire vs queue vs
   prefill vs decode vs retry-wasted — obs/trace.py). Unique id prefixes
   are accepted; ambiguous prefixes list the candidates.
+- ``loadreport <report.json>``: render an ``edgemesh loadgen`` report —
+  the goodput-vs-offered-load bar chart with the saturation knee marked
+  (curve documents), or the aggregate + per-tenant table (single runs).
 
 An empty or all-malformed span log is an answer, not an error: ``summary``
 prints an explicit ``"requests": 0`` report and every subcommand exits 0
@@ -64,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--logs", nargs="+", required=True, metavar="JSONL",
                     help="span logs from every process: the router's "
                     "--span-log plus each replica's")
+    lr = sub.add_parser(
+        "loadreport",
+        help="render an `edgemesh loadgen` report (single run or "
+        "goodput-vs-offered-load curve) as human text")
+    lr.add_argument("path", help="report JSON written by `edgemesh loadgen`")
     return p
 
 
@@ -118,6 +126,20 @@ def cmd_summary(path: str) -> int:
         round(sum(1 for c in classified if c == "good") / len(classified), 4)
         if classified else None
     )
+    # Per-tenant goodput from the records' tenant field. Pre-tenant logs
+    # (no such key) report null here and exit 0 — an old log is an answer,
+    # not an error, exactly like the pre-SLO fields above.
+    by_tenant: dict[str, list[int]] = {}
+    for r in spans:
+        if r.get("tenant") is not None and r.get("slo_result") is not None:
+            cell = by_tenant.setdefault(str(r["tenant"]), [0, 0])
+            cell[1] += 1
+            if r["slo_result"] == "good":
+                cell[0] += 1
+    tenants = {
+        t: {"classified": c, "good": g, "goodput_ratio": round(g / c, 4)}
+        for t, (g, c) in sorted(by_tenant.items())
+    } or None
 
     def pct(xs: list[float], q: float):
         if not xs:
@@ -136,6 +158,7 @@ def cmd_summary(path: str) -> int:
         "tpot_s_p99": pct(tpots, 0.99),
         "slo_classified": len(classified),
         "slo_goodput_ratio": goodput,
+        "tenants": tenants,
         "metrics": registry.summary(),
     }, indent=2))
     return 0
@@ -143,6 +166,78 @@ def cmd_summary(path: str) -> int:
 
 def cmd_prom(path: str) -> int:
     sys.stdout.write(replay_spans(_read(path)).render())
+    return 0
+
+
+def _fmt_tenant_rows(tenants: dict, indent: str = "  ") -> list[str]:
+    rows = [f"{indent}{'TENANT':<14} {'SCHED':>6} {'OK':>5} {'SHED':>5} "
+            f"{'RATELIM':>8} {'GOODPUT':>8} {'P99':>9}"]
+    for name, cell in sorted(tenants.items()):
+        gp = cell.get("goodput_ratio")
+        p99 = cell.get("latency_s_p99")
+        rows.append(
+            f"{indent}{name:<14} {cell.get('scheduled', 0):>6} "
+            f"{cell.get('ok', 0):>5} {cell.get('shed', 0):>5} "
+            f"{cell.get('ratelimited', 0):>8} "
+            f"{'-' if gp is None else f'{gp:.3f}':>8} "
+            f"{'-' if p99 is None else f'{p99 * 1e3:.0f}ms':>9}"
+        )
+    return rows
+
+
+def cmd_loadreport(path: str) -> int:
+    """Human rendering of a loadgen report: for a curve document, a
+    goodput-vs-offered-load bar chart with the knee marked; for a single
+    run, the aggregate + per-tenant table."""
+    with open(path) as f:
+        doc = json.load(f)
+    lines: list[str] = []
+    if "points" in doc:  # curve document (run_curve schema)
+        points = doc["points"]
+        knee = doc.get("knee_offered_rps")
+        peak = max((p.get("goodput_rps") or 0.0 for p in points),
+                   default=0.0) or 1.0
+        lines.append("goodput vs offered load "
+                     f"(SLO: answered within {doc.get('slo_latency_s')}s "
+                     "of the scheduled arrival)")
+        lines.append("")
+        for p in points:
+            gp = p.get("goodput_rps") or 0.0
+            bar = "#" * max(1, round(32 * gp / peak)) if gp > 0 else ""
+            marker = "  <-- knee" if p["offered_rps"] == knee else ""
+            lines.append(
+                f"  {p['offered_rps']:>8.2f} rps offered | "
+                f"{gp:>8.2f} rps good | {bar:<32}{marker}"
+            )
+        lines.append("")
+        lines.append(
+            f"knee: {knee} rps offered -> {doc.get('knee_goodput_rps')} rps "
+            f"goodput; past-knee collapse: "
+            f"{'YES' if doc.get('collapsed') else 'no'}"
+        )
+        last = points[-1] if points else None
+        if last and last.get("tenants"):
+            lines.append("")
+            lines.append(f"per-tenant at {last['offered_rps']} rps offered:")
+            lines.extend(_fmt_tenant_rows(last["tenants"]))
+    else:  # single-run report (summarize schema)
+        lines.append(
+            f"open-loop run: {doc.get('scheduled')} scheduled over "
+            f"{doc.get('duration_s')}s ({doc.get('offered_rps')} rps), "
+            f"goodput {doc.get('goodput_rps')} rps "
+            f"(ratio {doc.get('goodput_ratio')})"
+        )
+        lines.append(
+            f"  ok={doc.get('ok')} shed={doc.get('shed')} "
+            f"ratelimited={doc.get('ratelimited')} errors={doc.get('errors')} "
+            f"p50={_fmt_s(doc.get('latency_s_p50'))} "
+            f"p99={_fmt_s(doc.get('latency_s_p99'))} "
+            f"launch_skew={_fmt_s(doc.get('max_launch_skew_s'))}"
+        )
+        if doc.get("tenants"):
+            lines.append("")
+            lines.extend(_fmt_tenant_rows(doc["tenants"]))
+    print("\n".join(lines))
     return 0
 
 
@@ -173,8 +268,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "trace":
         return cmd_trace(args.trace_id, args.logs)
     if not Path(args.path).exists():
-        print(f"error: no such span log: {args.path}", file=sys.stderr)
+        kind = "report" if args.cmd == "loadreport" else "span log"
+        print(f"error: no such {kind}: {args.path}", file=sys.stderr)
         return 2
+    if args.cmd == "loadreport":
+        return cmd_loadreport(args.path)
     if args.cmd == "tail":
         return cmd_tail(args.path, args.count, args.event)
     if args.cmd == "summary":

@@ -325,6 +325,77 @@ class Registry:
         return out
 
 
+# ---------------------------------------------------------------------------
+# Bounded label values (the tenant-cardinality guard)
+# ---------------------------------------------------------------------------
+
+#: Per-namespace cap on distinct label values minted from request data. A
+#: metric label derived from client-controlled strings (tenant ids, session
+#: ids) is an unbounded-cardinality bomb: every new value is a new time
+#: series on every family that carries the label, and one abusive client
+#: can mint millions. 32 covers every legitimate multi-tenant deployment
+#: this repo targets; everything past the cap collapses into ``other``.
+BOUNDED_LABEL_MAX = 32
+
+#: The overflow bucket every out-of-budget value collapses into.
+OTHER_LABEL = "other"
+
+_bounded_lock = threading.Lock()
+_bounded_seen: dict[str, set[str]] = {}
+
+
+def bounded_label(value, namespace: str = "tenant",
+                  allow: Iterable[str] | None = None,
+                  max_values: int = BOUNDED_LABEL_MAX,
+                  default: str = "default") -> str:
+    """Normalize one raw request-derived string into a BOUNDED label value.
+
+    This is the only sanctioned path from client-controlled data (tenant /
+    session / user strings) to a metric label — edgelint EM112 flags
+    ``.labels(tenant=...)`` values that do not flow through it. Rules:
+
+    - ``None`` / empty / non-string → ``default`` (the single-tenant case
+      keeps one stable series instead of none).
+    - Values are sanitized to ``[a-zA-Z0-9_.:-]`` (other bytes → ``_``) and
+      truncated to 64 chars — a label value must never smuggle exposition
+      syntax or unbounded payload bytes into ``/metrics``.
+    - With ``allow``, only listed values pass; everything else is
+      ``OTHER_LABEL`` and the seen-set never grows.
+    - Without an allowlist, the first ``max_values`` distinct values per
+      ``namespace`` pass through; later ones collapse into ``OTHER_LABEL``
+      (first-come keeps the legitimate steady-state tenants, the abuser who
+      mints fresh ids per request lands in one bucket).
+    """
+    if not isinstance(value, str) or not value:
+        return default
+    cleaned = "".join(
+        ch if (ch.isalnum() and ch.isascii()) or ch in "_.:-" else "_"
+        for ch in value[:64]
+    )
+    if not cleaned:
+        return default
+    if allow is not None:
+        return cleaned if cleaned in set(allow) else OTHER_LABEL
+    with _bounded_lock:
+        seen = _bounded_seen.setdefault(namespace, set())
+        if cleaned in seen:
+            return cleaned
+        if len(seen) >= max_values:
+            return OTHER_LABEL
+        seen.add(cleaned)
+        return cleaned
+
+
+def reset_bounded_labels(namespace: str | None = None) -> None:
+    """Forget the seen-sets (tests isolate through this; production never
+    calls it — forgetting would re-admit values past the cap)."""
+    with _bounded_lock:
+        if namespace is None:
+            _bounded_seen.clear()
+        else:
+            _bounded_seen.pop(namespace, None)
+
+
 _default_registry = Registry()
 _default_lock = threading.Lock()
 

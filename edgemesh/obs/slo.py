@@ -43,6 +43,7 @@ from edgemesh.obs.metrics import (
     INTER_TOKEN_BUCKETS,
     LATENCY_BUCKETS,
     Registry,
+    bounded_label,
     get_registry,
 )
 
@@ -109,9 +110,26 @@ class SloTracker:
             "The active SLO target, by kind (ttft/tpot)", ("engine", "kind"))
         self._target_gauge.labels(engine=engine, kind="ttft").set(self.target.ttft_s)
         self._target_gauge.labels(engine=engine, kind="tpot").set(self.target.tpot_s)
+        # Per-tenant twins of the aggregate families above. A SEPARATE
+        # family (not a third label on edgemesh_slo_requests_total): the
+        # aggregate family predates tenancy and a family cannot be
+        # re-registered with a new labelset — and single-tenant deployments
+        # keep scraping exactly what they scraped before. Tenant values are
+        # bounded through obs.metrics.bounded_label (EM112) in count().
+        self._tenant_requests = self.registry.counter(
+            "edgemesh_slo_tenant_requests_total",
+            "Per-tenant SLO classifications (tenant bounded via "
+            "bounded_label)", ("engine", "tenant", "result"))
+        self._tenant_goodput = self.registry.gauge(
+            "edgemesh_slo_tenant_goodput_ratio",
+            "Per-tenant fraction of classified requests meeting BOTH SLO "
+            "targets", ("engine", "tenant"))
         self._lock = threading.Lock()
         self._good = 0
         self._classified = 0
+        # tenant -> [good, classified]; bounded because keys are
+        # bounded_label outputs.
+        self._by_tenant: dict[str, list[int]] = {}
 
     def classify(self, status: str, ttft_s: float | None,
                  tpot_s: float | None) -> str:
@@ -131,15 +149,18 @@ class SloTracker:
         return "good"
 
     def record(self, status: str, ttft_s: float | None,
-               tpot_s: float | None) -> str:
+               tpot_s: float | None, tenant: str | None = None) -> str:
         result = self.classify(status, ttft_s, tpot_s)
-        self.count(result)
+        self.count(result, tenant=tenant)
         return result
 
-    def count(self, result: str) -> None:
+    def count(self, result: str, tenant: str | None = None) -> None:
         """Feed one pre-classified result (the live path after
         :meth:`classify`; also the replay path — ``replay_spans`` counts
-        the ``slo_result`` stamped into each span record)."""
+        the ``slo_result`` stamped into each span record). ``tenant`` is
+        the raw request-derived tenant string (or None on pre-tenant
+        traffic/logs): it is normalized through ``bounded_label`` here, so
+        callers never have to worry about cardinality."""
         self._requests.labels(engine=self.engine, result=result).inc()
         with self._lock:
             self._classified += 1
@@ -147,12 +168,34 @@ class SloTracker:
                 self._good += 1
             ratio = self._good / self._classified
         self._goodput_family.labels(engine=self.engine).set(ratio)
+        if tenant is None:
+            return
+        label = bounded_label(tenant)
+        self._tenant_requests.labels(
+            engine=self.engine, tenant=label, result=result).inc()
+        with self._lock:
+            cell = self._by_tenant.setdefault(label, [0, 0])
+            cell[1] += 1
+            if result == "good":
+                cell[0] += 1
+            tratio = cell[0] / cell[1]
+        self._tenant_goodput.labels(engine=self.engine, tenant=label).set(tratio)
 
     def goodput_ratio(self) -> float | None:
         with self._lock:
             if not self._classified:
                 return None
             return self._good / self._classified
+
+    def tenant_goodput(self) -> dict[str, dict]:
+        """Per-tenant {classified, good, goodput_ratio} — what ``/fleetz``
+        and ``/statusz`` print. Empty until tenant-tagged traffic arrives."""
+        with self._lock:
+            return {
+                t: {"classified": c, "good": g,
+                    "goodput_ratio": round(g / c, 4)}
+                for t, (g, c) in sorted(self._by_tenant.items())
+            }
 
 
 # ---------------------------------------------------------------------------

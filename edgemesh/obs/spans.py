@@ -48,7 +48,7 @@ class RequestTrace:
     __slots__ = (
         "rid", "ts_unix", "t_submit", "t_admit_start", "t_start",
         "t_first_token", "t_last", "t_end", "generated", "segments",
-        "spans", "status", "attrs",
+        "spans", "status", "attrs", "tenant",
         "trace_id", "span_id", "parent_span_id", "sampled",
     )
 
@@ -65,6 +65,11 @@ class RequestTrace:
         self.span_id: str | None = None
         self.parent_span_id: str | None = None
         self.sampled = True
+        # Tenant identity (X-Edgemesh-Tenant, propagated by the fleet
+        # router): None for untagged traffic — the span record carries a
+        # null and the per-tenant metric families stay untouched, so
+        # pre-tenant logs and single-tenant deployments see zero change.
+        self.tenant: str | None = None
         self.t_admit_start: float | None = None
         self.t_start: float | None = None  # admission (prefill) complete
         self.t_first_token: float | None = None
@@ -159,13 +164,19 @@ class SpanTracker:
     def now(self) -> float:
         return time.perf_counter()
 
-    def submit(self, rid: int, trace_ctx=None) -> RequestTrace:
+    def submit(self, rid: int, trace_ctx=None,
+               tenant: str | None = None) -> RequestTrace:
         """``trace_ctx`` is the propagated :class:`~edgemesh.obs.trace.
         TraceContext` from the fleet router's attempt span (None for
-        locally-originated requests, which mint their own root)."""
+        locally-originated requests, which mint their own root).
+        ``tenant`` is the raw ``X-Edgemesh-Tenant`` value (None when the
+        request carried none) — normalization to a bounded label happens
+        at the metric seam (obs/slo.py), never here, so the span record
+        keeps the honest raw-ish string for offline attribution."""
         from edgemesh.obs.trace import TraceContext, sample
 
         trace = RequestTrace(rid, self.now())
+        trace.tenant = tenant
         if trace_ctx is not None:
             trace.trace_id = trace_ctx.trace_id
             trace.parent_span_id = trace_ctx.span_id
@@ -235,11 +246,12 @@ class SpanTracker:
             None if trace.t_first_token is None
             else trace.t_first_token - trace.t_submit
         )
-        slo_result = self.slo.record(status, ttft, itl)
+        slo_result = self.slo.record(status, ttft, itl, tenant=trace.tenant)
         if self._log is not None and trace.sampled:
             self._log.log(
                 SPAN_RECORD_EVENT,
                 rid=trace.rid, engine=self.engine, status=status,
+                tenant=trace.tenant,
                 trace_id=trace.trace_id, span_id=trace.span_id,
                 parent_span_id=trace.parent_span_id,
                 # Wall anchor for cross-process assembly: spans are
@@ -342,7 +354,12 @@ def replay_spans(records: Iterable[dict] | str | Path,
         if rec.get("latency_s") is not None:
             tr._latency.observe(rec["latency_s"])
         # SLO verdicts replay pre-classified (target-independent): logs
-        # from before the slo_result field simply skip the family.
+        # from before the slo_result field simply skip the family, and
+        # pre-tenant records (no "tenant" key, or null) feed the aggregate
+        # family only — the per-tenant twins stay untouched. Unknown keys
+        # in FUTURE records are ignored by construction (every read here
+        # is .get on a known key), which is the other half of the
+        # forward-compat contract tests/test_obs.py pins.
         if rec.get("slo_result") in SLO_RESULTS:
-            tr.slo.count(rec["slo_result"])
+            tr.slo.count(rec["slo_result"], tenant=rec.get("tenant"))
     return registry

@@ -450,14 +450,19 @@ class ContinuousEngine:
     # -- public interface (DynamicBatcher-compatible) -----------------------
 
     def submit(self, question: str, max_new: int | None = None,
-               trace_ctx: TraceContext | None = None) -> Future:
+               trace_ctx: TraceContext | None = None,
+               tenant: str | None = None) -> Future:
         """Enqueue one request. ``max_new`` caps THIS request's token budget
         below the engine-wide ``sampling.max_new_tokens`` (budgets are
         per-slot host state, so a per-request cap costs nothing); the
         "sjf" admission policy uses it as the job-size estimate.
         ``trace_ctx`` is the propagated distributed-trace context (the
         fleet router's attempt span) — the request's spans join that trace
-        instead of minting their own (obs/trace.py)."""
+        instead of minting their own (obs/trace.py). ``tenant`` is the raw
+        ``X-Edgemesh-Tenant`` identity (None for untagged traffic): it
+        rides the span record and the per-tenant SLO families
+        (obs/slo.py), never the scheduling — fairness between tenants is
+        the ROUTER's admission job, not the engine's."""
         if max_new is not None:
             max_new = int(max_new)
             if max_new < 1:
@@ -466,16 +471,18 @@ class ContinuousEngine:
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine is closed")
-            trace = self.obs.submit(self.requests, trace_ctx)  # rid = arrival index
+            trace = self.obs.submit(self.requests, trace_ctx,
+                                    tenant=tenant)  # rid = arrival index
             self._queue.append((question, fut, trace, max_new))
             self.requests += 1
             self._cond.notify()
         return fut
 
     def answer(self, question: str, max_new: int | None = None,
-               trace_ctx: TraceContext | None = None) -> dict[str, Any]:
+               trace_ctx: TraceContext | None = None,
+               tenant: str | None = None) -> dict[str, Any]:
         return self.submit(question, max_new=max_new,
-                           trace_ctx=trace_ctx).result()
+                           trace_ctx=trace_ctx, tenant=tenant).result()
 
     def close(self) -> None:
         with self._cond:
@@ -1458,7 +1465,8 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         return
 
     def submit(self, question: str, max_new: int | None = None,
-               trace_ctx: TraceContext | None = None) -> Future:
+               trace_ctx: TraceContext | None = None,
+               tenant: str | None = None) -> Future:
         if max_new is not None:
             # Fail fast on the caller's thread — the _admit guard below
             # stays as defense in depth, but surfacing an EXPECTED
@@ -1468,7 +1476,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
                 "the speculative engine keeps one uniform budget per pool; "
                 "per-request max_new is not supported"
             )
-        return super().submit(question, trace_ctx=trace_ctx)
+        return super().submit(question, trace_ctx=trace_ctx, tenant=tenant)
 
     def _admit(self, idx: int, question: str, fut: Future, trace,
                mid_flight: bool, max_new: int | None = None) -> bool:

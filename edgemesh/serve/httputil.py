@@ -71,6 +71,23 @@ def read_trace_header(handler):
     return TraceContext.parse(raw)
 
 
+TENANT_HEADER = "X-Edgemesh-Tenant"
+
+
+def read_tenant_header(handler) -> str | None:
+    """The raw tenant identity header (load observatory / per-tenant
+    telemetry — docs/OBSERVABILITY.md "The load observatory"). Returns the
+    raw string or None; normalization to a BOUNDED metric label happens at
+    the metric seam (``edgemesh.obs.metrics.bounded_label``, enforced by
+    edgelint EM112) — never here, so span logs keep the honest value. A
+    missing header is legal: untagged traffic stays single-tenant."""
+    raw = handler.headers.get(TENANT_HEADER)
+    if raw is None:
+        return None
+    raw = raw.strip()
+    return raw or None
+
+
 def read_json_body(handler) -> dict | None:
     """Parse the request body; answers the 400 itself on bad input."""
     try:

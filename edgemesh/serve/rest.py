@@ -378,8 +378,11 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 return
             # Distributed-trace context (the router's attempt span): the
             # engine's spans join it, and compile events fired while this
-            # request is being handled get stamped with it.
+            # request is being handled get stamped with it. The tenant
+            # identity propagated alongside it attributes the engine's
+            # span record and per-tenant SLO metrics (obs/slo.py).
             trace_ctx = httputil.read_trace_header(self)
+            tenant = httputil.read_tenant_header(self)
             payload = self._read_json()
             if payload is None:
                 return
@@ -400,11 +403,11 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 from edgemesh.obs.trace import use_trace
 
                 with use_trace(trace_ctx):
-                    self._generate(payload, trace_ctx)
+                    self._generate(payload, trace_ctx, tenant)
             finally:
                 self.server.end_request()
 
-        def _generate(self, payload: dict, trace_ctx=None):
+        def _generate(self, payload: dict, trace_ctx=None, tenant=None):
             try:
                 question = payload.get("question")
                 if not question:
@@ -454,6 +457,10 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                     kwargs = {}
                     if batcher_speaks_trace:
                         kwargs["trace_ctx"] = trace_ctx
+                        # Tenant rides only the engines that speak spans —
+                        # the DynamicBatcher coalesces requests and has no
+                        # per-request record to attribute.
+                        kwargs["tenant"] = tenant
                     if max_new is not None:
                         kwargs["max_new"] = max_new
                     result = batcher.answer(question, **kwargs)
@@ -511,6 +518,19 @@ def _render_statusz(ensemble, stats: dict, registry) -> str:
         lines.append("")
         lines.append("slo goodput (fraction meeting TTFT+TPOT targets):")
         for key, v in goodput:
+            lines.append(f"  {key}: {v:.3f}")
+    # Per-tenant goodput (tenant labels bounded via bounded_label): only
+    # present once tenant-tagged traffic has arrived — single-tenant
+    # deployments keep the exact pre-tenant page.
+    tenant_goodput = sorted(
+        (k, v) for k, v in summary.items()
+        if k.startswith("edgemesh_slo_tenant_goodput_ratio")
+        and not isinstance(v, dict)
+    )
+    if tenant_goodput:
+        lines.append("")
+        lines.append("per-tenant slo goodput:")
+        for key, v in tenant_goodput:
             lines.append(f"  {key}: {v:.3f}")
     if summary:
         lines.append("")

@@ -27,6 +27,17 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+@pytest.fixture(autouse=True)
+def _fresh_bounded_labels():
+    """Isolate the process-wide bounded-label seen-sets (obs/metrics.py):
+    tenant names minted by one test must not push a later test's tenants
+    into the 'other' overflow bucket."""
+    from edgemesh.obs.metrics import reset_bounded_labels
+
+    reset_bounded_labels()
+    yield
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -577,6 +577,79 @@ def test_em111_shipped_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# EM112 unbounded-metric-label
+# ---------------------------------------------------------------------------
+
+_EM112_SRC = (
+    "from edgemesh.obs.metrics import bounded_label\n"
+    "def record(reg, payload, headers, tenant_param):\n"
+    "    c = reg.counter('edgemesh_x_total', '', ('tenant',))\n"
+    "    c.labels(tenant=payload.get('tenant')).inc()\n"        # raw call
+    "    c.labels(session=headers['X-Session']).inc()\n"        # subscript
+    "    t = payload.get('tenant')\n"
+    "    c.labels(tenant=t).inc()\n"                            # tainted local
+    "    lbl = bounded_label(payload.get('tenant'))\n"
+    "    c.labels(tenant=lbl).inc()\n"                          # normalized local
+    "    c.labels(tenant=bounded_label(t)).inc()\n"             # inline normalize
+    "    c.labels(tenant='fixed').inc()\n"                      # constant
+    "    c.labels(tenant=tenant_param).inc()\n"                 # param: trusted
+    "    c.labels(engine=t).inc()\n"                            # non-identity label
+)
+
+
+def test_em112_flags_raw_request_labels_and_accepts_bounded():
+    findings = [f for f in lint_source(_EM112_SRC,
+                                       path="edgemesh/fleet/router.py")
+                if f.rule == "EM112"]
+    assert [f.line for f in findings] == [4, 5, 7]
+    assert all(f.severity == "error" for f in findings)
+    assert all("bounded_label" in f.message for f in findings)
+    # Out of the shipped package: silent (test fixtures mint labels freely).
+    assert [f for f in lint_source(_EM112_SRC, path="tests/test_obs.py")
+            if f.rule == "EM112"] == []
+
+
+def test_em112_honors_disable_and_reassignment_chain():
+    quiet = (
+        "def record(c, payload):\n"
+        "    c.labels(tenant=payload.get('t')).inc()"
+        "  # edgelint: disable=EM112\n"
+    )
+    assert [f for f in lint_source(quiet, path="edgemesh/obs/slo.py")
+            if f.rule == "EM112"] == []
+    # The LAST assignment before the call wins the taint judgment.
+    relabeled = (
+        "from edgemesh.obs.metrics import bounded_label\n"
+        "def record(c, payload):\n"
+        "    t = payload.get('tenant')\n"
+        "    t = bounded_label(t)\n"
+        "    c.labels(tenant=t).inc()\n"
+    )
+    assert [f for f in lint_source(relabeled, path="edgemesh/obs/slo.py")
+            if f.rule == "EM112"] == []
+    rebroken = (
+        "from edgemesh.obs.metrics import bounded_label\n"
+        "def record(c, payload):\n"
+        "    t = bounded_label(payload.get('tenant'))\n"
+        "    t = payload.get('tenant')\n"
+        "    c.labels(tenant=t).inc()\n"
+    )
+    assert [f.rule for f in lint_source(rebroken, path="edgemesh/obs/slo.py")
+            if f.rule == "EM112"] == ["EM112"]
+
+
+def test_em112_shipped_tree_is_clean():
+    # Every tenant/session label in the shipped package flows through
+    # bounded_label — the tree is the rule's reference fixture.
+    from pathlib import Path
+
+    from edgemesh.analysis.edgelint import lint_paths
+
+    pkg = Path(__file__).resolve().parent.parent / "edgemesh"
+    assert [f for f in lint_paths([pkg]) if f.rule == "EM112"] == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + baseline mechanics
 # ---------------------------------------------------------------------------
 
@@ -916,3 +989,31 @@ def test_every_rule_has_metadata():
         for rule, meta in table.items():
             assert meta["severity"] in ("error", "warning"), rule
             assert meta["name"] and meta["summary"], rule
+
+
+def test_em112_provenance_follows_source_order_not_walk_order():
+    from edgemesh.analysis.edgelint import lint_source
+
+    # Normalization AFTER a nested raw assignment: clean — the latest
+    # SOURCE line wins, not ast.walk (breadth-first) order.
+    normalized_last = (
+        "from edgemesh.obs.metrics import bounded_label\n"
+        "def record(c, payload, cond):\n"
+        "    if cond:\n"
+        "        t = payload.get('tenant')\n"
+        "    t = bounded_label(t)\n"
+        "    c.labels(tenant=t).inc()\n"
+    )
+    assert [f for f in lint_source(normalized_last, path="edgemesh/obs/slo.py")
+            if f.rule == "EM112"] == []
+    # The mirror: a nested RAW reassignment after normalization flags.
+    raw_last = (
+        "from edgemesh.obs.metrics import bounded_label\n"
+        "def record(c, payload, cond):\n"
+        "    t = bounded_label(payload.get('tenant'))\n"
+        "    if cond:\n"
+        "        t = payload.get('tenant')\n"
+        "    c.labels(tenant=t).inc()\n"
+    )
+    assert [f.rule for f in lint_source(raw_last, path="edgemesh/obs/slo.py")
+            if f.rule == "EM112"] == ["EM112"]

@@ -89,8 +89,35 @@ def test_headline_serving_schema_gains_ragged_and_spec_keys(monkeypatch, capsys)
                 "adaptive_goodput": 1.0, "adaptive_routed_to_slow": 0,
                 "adaptive_hedged": 1}
 
+    def fake_load_curve(**kw):
+        return {"metric": "load_curve_knee_rps", "value": 12.0,
+                "unit": "req/s", "n_replicas": 2, "duration_s": 4.0,
+                "estimated_capacity_rps": 11.5, "slo_latency_s": 0.4,
+                "knee_goodput_rps": 11.0, "collapsed": True,
+                "points": [
+                    {"requested_rps": 6.0, "offered_rps": 5.8,
+                     "goodput_rps": 5.8, "goodput_ratio": 1.0, "shed": 0,
+                     "errors": 0, "latency_s_p50": 0.1,
+                     "latency_s_p99": 0.2,
+                     "tenants": {"interactive": {"goodput_ratio": 1.0},
+                                 "batch": {"goodput_ratio": 1.0}}},
+                    {"requested_rps": 12.0, "offered_rps": 12.0,
+                     "goodput_rps": 11.0, "goodput_ratio": 0.92, "shed": 0,
+                     "errors": 0, "latency_s_p50": 0.15,
+                     "latency_s_p99": 0.39,
+                     "tenants": {"interactive": {"goodput_ratio": 0.95},
+                                 "batch": {"goodput_ratio": 0.88}}},
+                    {"requested_rps": 46.0, "offered_rps": 46.2,
+                     "goodput_rps": 3.1, "goodput_ratio": 0.07, "shed": 80,
+                     "errors": 0, "latency_s_p50": 2.5,
+                     "latency_s_p99": 4.0,
+                     "tenants": {"interactive": {"goodput_ratio": 0.07},
+                                 "batch": {"goodput_ratio": 0.06}}},
+                ]}
+
     monkeypatch.setattr(benchmarks, "speculative_benchmark", fake_spec)
     monkeypatch.setattr(benchmarks, "adaptive_router_benchmark", fake_adaptive)
+    monkeypatch.setattr(benchmarks, "load_curve_benchmark", fake_load_curve)
     monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_ADMIT", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
@@ -113,6 +140,18 @@ def test_headline_serving_schema_gains_ragged_and_spec_keys(monkeypatch, capsys)
     assert out["adaptive_goodput"] == 1.0
     assert out["adaptive_routed_to_slow"] == 0
     assert out["slo_target_s"] == 0.25
+    # Load-observatory stage: the goodput-vs-offered-load curve rides the
+    # BENCH JSON — >=3 points, per-tenant splits, the saturation knee and
+    # the collapse flag (the load_curve stage schema contract).
+    assert out["load_curve_knee_rps"] == 12.0
+    assert out["load_curve_knee_goodput_rps"] == 11.0
+    assert out["load_curve_collapsed"] is True
+    assert out["load_curve_slo_latency_s"] == 0.4
+    assert len(out["load_curve_points"]) >= 3
+    for p in out["load_curve_points"]:
+        assert {"offered_rps", "goodput_rps", "goodput_ratio", "shed",
+                "latency_s_p99", "tenants"} <= set(p)
+        assert {"interactive", "batch"} <= set(p["tenants"])
     # Speculative arm: the selfcheck key distinguishes machinery-broken
     # (selfcheck < 1) from draft-weak (accept low, selfcheck 1.0).
     assert out["spec_selfcheck_accept_rate"] == 1.0
@@ -147,6 +186,35 @@ def test_ragged_ablation_benchmark_shapes(monkeypatch):
         assert out[f"ragged_over_segmented_{shape}"] == 1.25
 
 
+def test_load_curve_stage_is_skippable_via_env(monkeypatch, capsys):
+    """EDGEMESH_BENCH_LOADGEN=0 must skip the load_curve stage entirely —
+    no replicas spun, no keys emitted, no error recorded."""
+
+    def fake_build(preset, precision, quant_mode):
+        return ("cfg", "params")
+
+    def fake_decode(preset, precision, quant_mode="w8a16", batch=8, **kw):
+        return {"metric": "m", "value": 100.0, "unit": "tok/s/chip",
+                "vs_baseline": 3.9, "ttft_s": 0.01, "hbm_eff_gbs": 1.0,
+                "hbm_util": 0.1, "weight_gb": 1.0, "batch": batch,
+                "decode_steps": 8}
+
+    def boom(**kw):
+        raise AssertionError("load_curve_benchmark ran despite the gate")
+
+    monkeypatch.setattr(benchmarks, "_build", fake_build)
+    monkeypatch.setattr(benchmarks, "decode_benchmark", fake_decode)
+    monkeypatch.setattr(benchmarks, "load_curve_benchmark", boom)
+    monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SERVE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_FLEET", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert not any(k.startswith("load_curve") for k in out)
+
+
 def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
     """The headline int8 stage must produce a parseable driver line BEFORE
     any other stage runs, and later-stage failures must keep earlier keys."""
@@ -170,9 +238,10 @@ def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
     monkeypatch.setattr(benchmarks, "_build", fake_build)
     monkeypatch.setattr(benchmarks, "decode_benchmark", fake_decode)
     monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
-    # Stage ordering is under test, not the fleet: the adaptive-router
-    # stage would spin real in-process replicas here.
+    # Stage ordering is under test, not the fleet: the adaptive-router and
+    # load-curve stages would spin real in-process replicas here.
     monkeypatch.setenv("EDGEMESH_BENCH_FLEET", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
 
     out = benchmarks.headline_benchmark(preset="tiny", batch=2, decode_steps=8,
                                         sweep_batches=())

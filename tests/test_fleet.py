@@ -998,3 +998,273 @@ def test_gateway_socket_timeout_is_applied_per_connection():
         assert status == 200
     finally:
         srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant admission: token buckets, weighted fairness, priority lanes
+# (fleet/admission.py), and the tenant context through the router
+# ---------------------------------------------------------------------------
+
+
+from edgemesh.fleet.admission import (  # noqa: E402
+    AdmissionController,
+    TenantPolicy,
+    TokenBucket,
+)
+from edgemesh.serve.httputil import TENANT_HEADER  # noqa: E402
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_token_bucket_refill_math():
+    clk = _Clock()
+    b = TokenBucket(rate_per_s=2.0, burst=4.0, now=clk)
+    assert all(b.try_take() for _ in range(4))  # the full burst
+    assert not b.try_take()
+    clk.t += 0.5  # refills 1 token
+    assert b.try_take() and not b.try_take()
+    clk.t += 10.0  # refill caps at burst, not rate*dt
+    assert b.tokens() == pytest.approx(4.0)
+    with pytest.raises(ValueError):
+        TokenBucket(rate_per_s=0.0)
+
+
+def test_tenant_policy_parse_and_validation():
+    name, p = TenantPolicy.parse("bulk=batch:1:5:10")
+    assert name == "bulk" and p.lane == "batch" and p.weight == 1.0
+    assert p.rate_per_s == 5.0 and p.burst == 10.0
+    name, p = TenantPolicy.parse("chat=interactive:4")
+    assert p.lane == "interactive" and p.weight == 4.0 and p.rate_per_s == 0.0
+    with pytest.raises(ValueError):
+        TenantPolicy.parse("nonsense")
+    with pytest.raises(ValueError):
+        TenantPolicy(lane="sideways")
+    with pytest.raises(ValueError):
+        TenantPolicy(weight=0.0)
+
+
+def test_admission_default_matches_legacy_semaphore():
+    ac = AdmissionController(max_inflight=2)
+    assert ac.acquire("a") == "ok" and ac.acquire("b") == "ok"
+    assert ac.acquire("c") == "overload"  # no queue budget: immediate shed
+    ac.release()
+    assert ac.acquire("c") == "ok"
+    st = ac.stats()
+    assert st["inflight"] == 2 and st["queue_cap"] == 0
+
+
+def test_admission_rate_limit_spends_no_slot():
+    clk = _Clock()
+    ac = AdmissionController(
+        max_inflight=8,
+        policies={"bulk": TenantPolicy(rate_per_s=1.0, burst=1.0)},
+        now=clk,
+    )
+    assert ac.acquire("bulk") == "ok"
+    assert ac.acquire("bulk") == "ratelimited"
+    assert ac.stats()["ratelimit_hits"] == {"bulk": 1}
+    # Refused requests consumed zero capacity; other tenants unaffected.
+    assert ac.stats()["inflight"] == 1
+    assert ac.acquire("other") == "ok"
+    clk.t += 1.0
+    assert ac.acquire("bulk") == "ok"
+
+
+def test_admission_weighted_fair_grants_follow_weights():
+    """4 freed slots against backlogs of tenant a (weight 3) and b
+    (weight 1): start-time fair queueing grants 3:1."""
+    ac = AdmissionController(
+        max_inflight=4, queue_cap=100,
+        policies={"a": TenantPolicy(weight=3.0), "b": TenantPolicy(weight=1.0)},
+    )
+    for _ in range(4):  # fill every slot so new arrivals queue
+        assert ac.acquire("warm") == "ok"
+    granted = {"a": 0, "b": 0}
+    done = []
+
+    def waiter(tenant):
+        if ac.acquire(tenant, wait_s=30.0) == "ok":
+            with lock:
+                granted[tenant] += 1
+                done.append(tenant)
+
+    lock = threading.Lock()
+    threads = [threading.Thread(target=waiter, args=(t,), daemon=True)
+               for t in ("a",) * 6 + ("b",) * 6]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 10.0
+    while sum(ac.stats()["waiting"].values()) < 12:
+        assert time.monotonic() < deadline, ac.stats()
+        time.sleep(0.01)
+    for _ in range(4):  # free 4 slots; grants land on the waiters
+        ac.release()
+    deadline = time.monotonic() + 10.0
+    while len(done) < 4:
+        assert time.monotonic() < deadline, (done, ac.stats())
+        time.sleep(0.01)
+    assert granted == {"a": 3, "b": 1}
+
+
+def test_admission_interactive_preempts_batch_in_queue():
+    ac = AdmissionController(
+        max_inflight=1, queue_cap=10,
+        policies={"bulk": TenantPolicy(lane="batch"),
+                  "chat": TenantPolicy(lane="interactive")},
+    )
+    assert ac.acquire("chat-warm") == "ok"
+    order = []
+    lock = threading.Lock()
+
+    def waiter(tenant):
+        if ac.acquire(tenant, wait_s=30.0) == "ok":
+            with lock:
+                order.append(tenant)
+
+    t_batch = threading.Thread(target=waiter, args=("bulk",), daemon=True)
+    t_batch.start()  # batch queues FIRST
+    deadline = time.monotonic() + 10.0
+    while sum(ac.stats()["waiting"].values()) < 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    t_inter = threading.Thread(target=waiter, args=("chat",), daemon=True)
+    t_inter.start()  # interactive arrives LATER
+    while sum(ac.stats()["waiting"].values()) < 2:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    ac.release()  # one slot frees: the interactive request jumps the queue
+    t_inter.join(timeout=10.0)
+    assert order == ["chat"]
+    ac.release()  # now the batch request gets its turn
+    t_batch.join(timeout=10.0)
+    assert order == ["chat", "bulk"]
+
+
+def test_admission_queue_timeout_sheds():
+    ac = AdmissionController(max_inflight=1, queue_cap=4)
+    assert ac.acquire("a") == "ok"
+    t0 = time.monotonic()
+    assert ac.acquire("b", wait_s=0.1) == "queue_timeout"
+    assert 0.05 < time.monotonic() - t0 < 5.0
+    assert ac.stats()["queue_timeouts"] == {"b": 1}
+    # The abandoned waiter must not absorb a later grant.
+    ac.release()
+    assert ac.acquire("c") == "ok"
+
+
+def test_router_tenant_rate_limit_answers_429_with_counters():
+    reg = _registry("r1")
+    ft = FakeTransport()
+    obs = Registry()
+    admission = AdmissionController(
+        max_inflight=8,
+        policies={"bulk": TenantPolicy(rate_per_s=0.001, burst=1.0)},
+    )
+    router = _router(reg, ft, obs_registry=obs, admission=admission)
+    status, body, headers = router.handle_generate(
+        {"question": "q?"}, tenant="bulk")
+    assert status == 200
+    status, body, headers = router.handle_generate(
+        {"question": "q?"}, tenant="bulk")
+    assert status == 429 and headers["Retry-After"] == "1"
+    assert body["tenant"] == "bulk"
+    s = obs.summary()
+    assert s['edgemesh_fleet_tenant_ratelimited_total{tenant="bulk"}'] == 1
+    assert s['edgemesh_fleet_tenant_shed_total{tenant="bulk",reason="ratelimit"}'] == 1
+    assert s['edgemesh_fleet_shed_total{reason="ratelimit"}'] == 1
+    assert s['edgemesh_fleet_tenant_requests_total{tenant="bulk",outcome="ok"}'] == 1
+    assert s['edgemesh_fleet_tenant_requests_total{tenant="bulk",outcome="shed"}'] == 1
+    # Other tenants are not rate limited.
+    status, _, _ = router.handle_generate({"question": "q?"}, tenant="chat")
+    assert status == 200
+
+
+def test_router_propagates_tenant_header_and_stamps_spans(tmp_path):
+    reg = _registry("r1")
+    ft = FakeTransport()
+    router = _router(reg, ft, span_log=tmp_path / "router.jsonl")
+    status, _, _ = router.handle_generate({"question": "q?"}, tenant="acme")
+    assert status == 200
+    posts = [c for c in ft.calls if c[0] == "POST"]
+    # The attempt carried the tenant alongside trace + deadline.
+    assert posts[-1][4][TENANT_HEADER] == "acme"
+    assert "X-Edgemesh-Trace" in posts[-1][4]
+    # Untagged traffic carries NO tenant header (single-tenant unchanged).
+    router.handle_generate({"question": "q?"})
+    posts = [c for c in ft.calls if c[0] == "POST"]
+    assert TENANT_HEADER not in posts[-1][4]
+    # The router span record is tenant-stamped (null for untagged).
+    from edgemesh.utils.tracing import JsonlLogger
+
+    recs = JsonlLogger(tmp_path / "router.jsonl").read()
+    assert [r.get("tenant") for r in recs] == ["acme", None]
+
+
+def test_router_status_surfaces_tenants_and_admission():
+    reg = _registry("r1")
+    ft = FakeTransport()
+    admission = AdmissionController(
+        max_inflight=4, queue_cap=8,
+        policies={"bulk": TenantPolicy(lane="batch", weight=1.0,
+                                       rate_per_s=0.001, burst=2.0)},
+    )
+    router = _router(reg, ft, admission=admission)
+    for _ in range(2):
+        router.handle_generate({"question": "q?"}, tenant="chat")
+    for _ in range(3):  # third one trips the bucket
+        router.handle_generate({"question": "q?"}, tenant="bulk")
+    st = router.status()
+    assert st["admission"]["queue_cap"] == 8
+    assert st["admission"]["policies"]["bulk"]["lane"] == "batch"
+    assert st["admission"]["ratelimit_hits"] == {"bulk": 1}
+    chat, bulk = st["tenants"]["chat"], st["tenants"]["bulk"]
+    assert chat["requests"] == 2 and chat["answered"] == 2
+    assert chat["goodput_ratio"] == 1.0  # fake transport answers instantly
+    assert bulk["shed"] == 1 and bulk["ratelimited"] == 1
+    # max_inflight reflects the controller's truth.
+    assert st["max_inflight"] == 4
+
+
+def test_frontend_forwards_tenant_header_to_router(frontend):
+    srv, router, ft = frontend
+    status, _, _ = _http(
+        srv, "/generate", data=json.dumps({"question": "q?"}).encode(),
+        headers={TENANT_HEADER: "acme"},
+    )
+    assert status == 200
+    posts = [c for c in ft.calls if c[0] == "POST"]
+    assert posts[-1][4][TENANT_HEADER] == "acme"
+    status, body, _ = _http(srv, "/fleetz")
+    assert status == 200
+    assert body["tenants"]["acme"]["answered"] == 1
+    assert "admission" in body
+
+
+def test_configured_policy_survives_label_namespace_flood():
+    """A tenant configured at construction must keep its policy even
+    after abusive clients mint enough fresh tenant ids to fill the
+    bounded-label namespace — construction pre-seeds the policy names,
+    so they can never collapse into 'other' and silently lose their
+    rate limit / lane."""
+    reg = _registry("r1")
+    ft = FakeTransport()
+    admission = AdmissionController(
+        max_inflight=64,
+        policies={"bulk": TenantPolicy(rate_per_s=0.001, burst=1.0,
+                                       lane="batch")},
+    )
+    router = _router(reg, ft, admission=admission)
+    # An abuser floods with fresh tenant ids until the namespace caps out.
+    for i in range(40):
+        assert router.handle_generate({"question": "q?"},
+                                      tenant=f"minted-{i}")[0] == 200
+    # The configured tenant still resolves to ITS policy: second request
+    # trips the 1-token bucket with a 429 (the default policy would not).
+    assert router.handle_generate({"question": "q?"}, tenant="bulk")[0] == 200
+    assert router.handle_generate({"question": "q?"}, tenant="bulk")[0] == 429

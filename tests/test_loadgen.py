@@ -1,0 +1,398 @@
+"""edgemesh.loadgen fast tier: arrival-process schedules, workload mixes
+(long-tail lengths, shared-prefix sessions, tenant splits), the open-loop
+generator's coordinated-omission-proof accounting, curve/knee math, and
+the loadgen + obs loadreport CLIs — all against in-process callables (one
+loopback stub server only where the HTTP adapter itself is under test)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from edgemesh.loadgen import (
+    ConstantProcess,
+    DiurnalBurstProcess,
+    OpenLoopGenerator,
+    PoissonProcess,
+    TenantSpec,
+    Workload,
+    find_knee,
+    run_curve,
+)
+from edgemesh.loadgen.generator import TRANSPORT_ERROR_STATUS, summarize
+from edgemesh.loadgen.workload import LengthMix
+from edgemesh.serve.httputil import TENANT_HEADER
+
+# ---------------------------------------------------------------------------
+# Arrival processes
+# ---------------------------------------------------------------------------
+
+
+def test_poisson_schedule_rate_and_determinism():
+    p = PoissonProcess(rate_rps=50.0, seed=3)
+    s = p.schedule(10.0)
+    # Count within 4 sigma of rate*duration; sorted; in-window.
+    assert abs(len(s) - 500) < 4 * (500 ** 0.5)
+    assert s == sorted(s) and all(0 <= t < 10.0 for t in s)
+    assert s == PoissonProcess(rate_rps=50.0, seed=3).schedule(10.0)
+    assert s != PoissonProcess(rate_rps=50.0, seed=4).schedule(10.0)
+    # Mean inter-arrival gap ~ 1/rate.
+    gaps = [b - a for a, b in zip(s, s[1:])]
+    assert 0.015 < sum(gaps) / len(gaps) < 0.025
+
+
+def test_diurnal_burst_modulates_rate():
+    d = DiurnalBurstProcess(base_rps=5.0, peak_rps=60.0, period_s=4.0,
+                            burst_rps=200.0, burst_every_s=10.0,
+                            burst_len_s=0.5, seed=1)
+    s = d.schedule(4.0)
+    # Trough window measured OUTSIDE the t<0.5 burst; peak at mid-period.
+    trough = sum(1 for t in s if 0.5 <= t < 1.0)
+    peak = sum(1 for t in s if 1.75 <= t < 2.25)
+    assert peak > 2 * trough  # the sinusoid is visible in the counts
+    # The t=0 burst window rides ON TOP of the trough rate.
+    burst = sum(1 for t in s if t < 0.5)
+    assert burst > 4 * max(1, trough)  # ~(trough + 200 rps) * 0.5 s
+    with pytest.raises(ValueError):
+        DiurnalBurstProcess(base_rps=10.0, peak_rps=5.0, period_s=4.0)
+
+
+def test_constant_process_fixed_gaps():
+    assert ConstantProcess(4.0).schedule(1.0) == [0.0, 0.25, 0.5, 0.75]
+
+
+# ---------------------------------------------------------------------------
+# Workload: mixes, sessions, tenants
+# ---------------------------------------------------------------------------
+
+
+def test_length_mix_long_tail_and_bounds():
+    import random
+
+    mix = LengthMix(median=50, sigma=0.8, lo=10, hi=400)
+    rng = random.Random(0)
+    xs = [mix.sample(rng) for _ in range(2000)]
+    assert all(10 <= x <= 400 for x in xs)
+    xs.sort()
+    median = xs[len(xs) // 2]
+    assert 35 < median < 70
+    # Long tail: p99 is several times the median (a constant mix is not).
+    assert xs[int(0.99 * len(xs))] > 3 * median
+    assert LengthMix(median=64, sigma=0.0).sample(rng) == 64
+
+
+def test_workload_schedule_merges_tenants_sorted_and_deterministic():
+    wl = Workload([
+        TenantSpec(name="chat", arrival=PoissonProcess(20, seed=1)),
+        TenantSpec(name="bulk", arrival=PoissonProcess(10, seed=2),
+                   lane="batch"),
+    ], seed=7)
+    sched = wl.build_schedule(4.0)
+    assert [r.at_s for r in sched] == sorted(r.at_s for r in sched)
+    assert {r.tenant for r in sched} == {"chat", "bulk"}
+    assert all(r.lane == "batch" for r in sched if r.tenant == "bulk")
+    # Deterministic: the spec IS the traffic (A/B arms replay it).
+    again = wl.build_schedule(4.0)
+    assert [(r.at_s, r.tenant, r.prompt) for r in sched] == \
+           [(r.at_s, r.tenant, r.prompt) for r in again]
+
+
+def test_sessions_share_prefixes_across_turns():
+    wl = Workload([TenantSpec(name="t", arrival=ConstantProcess(10.0),
+                              sessions=2, turns_mean=100.0)], seed=1)
+    sched = wl.build_schedule(2.0)
+    by_session = {}
+    for r in sched:
+        by_session.setdefault(r.session, []).append(r)
+    assert len(by_session) == 2
+    for reqs in by_session.values():
+        assert len(reqs) > 3
+        # Every turn of a session starts with the SAME prefix — the
+        # affinity/caching key prefix_affinity and the replica prefix
+        # caches key on — and turns are numbered monotonically.
+        prefix = reqs[0].prompt.split(" turn ")[0]
+        assert len(prefix) > 20
+        assert all(r.prompt.startswith(prefix) for r in reqs)
+        assert [r.turn for r in reqs] == list(range(1, len(reqs) + 1))
+    # Distinct sessions carry distinct prefixes.
+    prefixes = {reqs[0].prompt.split(" turn ")[0]
+                for reqs in by_session.values()}
+    assert len(prefixes) == 2
+
+
+def test_session_reset_rotates_prefix():
+    wl = Workload([TenantSpec(name="t", arrival=ConstantProcess(10.0),
+                              sessions=1, turns_mean=2.0)], seed=1)
+    sched = wl.build_schedule(3.0)
+    prefixes = {r.prompt.split(" turn ")[0] for r in sched}
+    assert len(prefixes) > 3  # geometric resets minted fresh conversations
+
+
+def test_max_new_budget_attaches_only_when_enabled():
+    base = dict(arrival=ConstantProcess(5.0), sessions=1)
+    on = Workload([TenantSpec(name="t", send_max_new=True, **base)], seed=0)
+    off = Workload([TenantSpec(name="t", send_max_new=False, **base)], seed=0)
+    assert all(isinstance(r.max_new, int) and r.max_new >= 4
+               for r in on.build_schedule(1.0))
+    assert all(r.max_new is None for r in off.build_schedule(1.0))
+    req = on.build_schedule(1.0)[0]
+    assert req.payload()["max_new"] == req.max_new
+    assert "max_new" not in off.build_schedule(1.0)[0].payload()
+
+
+def test_workload_rejects_duplicate_tenants_and_empty():
+    with pytest.raises(ValueError):
+        Workload([])
+    with pytest.raises(ValueError):
+        Workload([TenantSpec(name="a", arrival=ConstantProcess(1.0)),
+                  TenantSpec(name="a", arrival=ConstantProcess(1.0))])
+
+
+# ---------------------------------------------------------------------------
+# The open-loop generator
+# ---------------------------------------------------------------------------
+
+
+def _schedule(n, gap_s, tenant="t"):
+    # One long-lived session: exactly one "turn 1:" prompt in the run.
+    wl = Workload([TenantSpec(name=tenant, arrival=ConstantProcess(1.0 / gap_s),
+                              sessions=1, turns_mean=1e9)], seed=0)
+    return wl.build_schedule(n * gap_s)
+
+
+def test_open_loop_launches_do_not_wait_for_completions():
+    """The anti-coordinated-omission property itself: a stalled FIRST
+    request must not delay later launches — their launch skew stays tiny
+    while the stalled request's latency grows."""
+    release = threading.Event()
+
+    def target(payload, headers):
+        if "turn 1:" in payload["question"]:
+            release.wait(timeout=10.0)  # request 1 stalls until the end
+        return 200, {}
+
+    sched = _schedule(8, 0.05)
+    report_box = {}
+
+    def run():
+        gen = OpenLoopGenerator(target, sched, slo_latency_s=0.5,
+                                duration_s=0.4)
+        report_box["r"] = gen.run()
+
+    th = threading.Thread(target=run)
+    th.start()
+    time.sleep(1.0)  # every launch slot has passed; request 1 still stalled
+    release.set()
+    th.join(timeout=10.0)
+    r = report_box["r"]
+    assert r["scheduled"] == 8 and r["ok"] == 8
+    # Launches tracked the schedule despite the stall.
+    assert r["max_launch_skew_s"] < 0.25
+    # The stalled request blew the SLO; the other 7 met it.
+    assert r["good"] == 7
+
+
+def test_latency_measured_from_schedule_not_send():
+    """A single-capacity target serving back-to-back arrivals: measured
+    latency must grow with queue position (service time accrues from the
+    SCHEDULED arrival), even though each individual call is fast."""
+    lock = threading.Lock()
+
+    def target(payload, headers):
+        with lock:  # capacity 1: requests serialize
+            time.sleep(0.05)
+        return 200, {}
+
+    sched = _schedule(6, 0.001)  # all arrive (nearly) at once
+    gen = OpenLoopGenerator(target, sched, slo_latency_s=10.0)
+    r = gen.run()
+    assert r["ok"] == 6
+    # 6 serialized 50ms services from one arrival instant: p99 covers the
+    # LAST position's wait (~0.3s), p50 the middle — the queueing delay a
+    # closed-loop driver structurally cannot see.
+    assert r["latency_s_p99"] > 0.25
+    assert r["latency_s_p50"] > 0.12
+
+
+def test_report_accounting_and_tenant_split():
+    statuses = {"a": 200, "b": 503, "c": 429, "d": TRANSPORT_ERROR_STATUS}
+
+    def target(payload, headers):
+        return statuses[headers[TENANT_HEADER]], {}
+
+    wl = Workload([
+        TenantSpec(name=n, arrival=ConstantProcess(10.0), sessions=1)
+        for n in statuses
+    ], seed=0)
+    r = OpenLoopGenerator(target, wl.build_schedule(1.0),
+                          slo_latency_s=5.0, duration_s=1.0).run()
+    assert r["scheduled"] == 40 and r["ok"] == 10
+    assert r["shed"] == 20          # 503 + 429
+    assert r["ratelimited"] == 10   # 429 only
+    assert r["errors"] == 10        # transport failures
+    assert r["good"] == 10 and r["goodput_ratio"] == 0.25
+    t = r["tenants"]
+    assert t["a"]["goodput_ratio"] == 1.0
+    assert t["b"]["shed"] == 10 and t["b"]["goodput_ratio"] == 0.0
+    assert t["c"]["ratelimited"] == 10
+    assert t["d"]["errors"] == 10
+
+
+def test_generator_sends_tenant_header():
+    seen = []
+
+    def target(payload, headers):
+        seen.append(headers.get(TENANT_HEADER))
+        return 200, {}
+
+    OpenLoopGenerator(target, _schedule(3, 0.01, tenant="acme"),
+                      slo_latency_s=1.0).run()
+    assert seen == ["acme"] * 3
+
+
+def test_summarize_goodput_counts_against_scheduled():
+    # Direct unit pin of the open-loop asymmetry: sheds are goodput
+    # misses even though they never produced a latency sample.
+    from edgemesh.loadgen.generator import RequestOutcome
+
+    outcomes = [
+        RequestOutcome("t", "interactive", "s", 0.0, 0.0, 0.1, 200, True),
+        RequestOutcome("t", "interactive", "s", 0.1, 0.0, 9.0, 200, True),
+        RequestOutcome("t", "interactive", "s", 0.2, 0.0, 0.0, 503, False),
+    ]
+    r = summarize(outcomes, duration_s=1.0, slo_latency_s=1.0)
+    assert r["scheduled"] == 3 and r["good"] == 1
+    assert r["goodput_ratio"] == pytest.approx(1 / 3, abs=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Curve + knee
+# ---------------------------------------------------------------------------
+
+
+def test_find_knee_monotone_then_collapse():
+    pts = [
+        {"offered_rps": 5.0, "goodput_rps": 5.0},
+        {"offered_rps": 10.0, "goodput_rps": 9.5},
+        {"offered_rps": 20.0, "goodput_rps": 4.0},
+    ]
+    k = find_knee(pts)
+    assert k["knee_offered_rps"] == 10.0
+    assert k["knee_goodput_rps"] == 9.5
+    assert k["collapsed"] is True
+    # Flat past the knee (saturated, not collapsed).
+    pts[2]["goodput_rps"] = 9.4
+    assert find_knee(pts)["collapsed"] is False
+    assert find_knee([]) == {"knee_offered_rps": None,
+                             "knee_goodput_rps": None, "collapsed": False}
+
+
+def test_run_curve_schema_and_knee():
+    def make_run(rate):
+        good = min(rate, 12.0) if rate < 20 else 3.0
+        return {
+            "duration_s": 1.0, "slo_latency_s": 0.5,
+            "max_launch_skew_s": 0.001, "scheduled": int(rate),
+            "offered_rps": rate, "ok": int(good), "shed": 0,
+            "ratelimited": 0, "errors": 0, "good": int(good),
+            "goodput_rps": good, "goodput_ratio": good / rate,
+            "latency_s_p50": 0.1, "latency_s_p99": 0.4,
+            "tenants": {"t": {"scheduled": int(rate), "goodput_rps": good}},
+        }
+
+    curve = run_curve(make_run, [5.0, 10.0, 40.0])
+    assert [p["offered_rps"] for p in curve["points"]] == [5.0, 10.0, 40.0]
+    assert curve["knee_offered_rps"] == 10.0
+    assert curve["collapsed"] is True
+    assert curve["slo_latency_s"] == 0.5
+    assert curve["points"][0]["tenants"]["t"]["scheduled"] == 5
+
+
+# ---------------------------------------------------------------------------
+# CLIs: edgemesh loadgen + edgemesh obs loadreport
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def stub_gateway():
+    """A loopback /generate stub: 200 after a tiny sleep, no model."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(length)
+            time.sleep(0.005)
+            body = json.dumps({"answer": "ok"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/generate"
+    srv.shutdown()
+
+
+def test_loadgen_cli_single_run_and_loadreport(stub_gateway, tmp_path, capsys):
+    from edgemesh.cli import main as cli_main
+
+    out = tmp_path / "report.json"
+    rc = cli_main([
+        "loadgen", "--url", stub_gateway, "--rate", "30", "--duration", "1",
+        "--tenant", "chat=3:interactive", "--tenant", "bulk=1:batch",
+        "--slo-latency-s", "2.0", "--seed", "1", "--out", str(out),
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] > 0 and report["goodput_ratio"] > 0.9
+    assert set(report["tenants"]) == {"chat", "bulk"}
+    # ~3:1 share split.
+    assert report["tenants"]["chat"]["scheduled"] > \
+        2 * report["tenants"]["bulk"]["scheduled"]
+    assert json.loads(out.read_text()) == report
+
+    from edgemesh.obs.cli import main as obs_main
+
+    assert obs_main(["loadreport", str(out)]) == 0
+    text = capsys.readouterr().out
+    assert "open-loop run" in text and "chat" in text and "bulk" in text
+
+
+def test_loadgen_cli_sweep_emits_curve(stub_gateway, tmp_path, capsys):
+    from edgemesh.cli import main as cli_main
+
+    out = tmp_path / "curve.json"
+    rc = cli_main([
+        "loadgen", "--url", stub_gateway, "--sweep", "10,20",
+        "--duration", "1", "--slo-latency-s", "2.0", "--out", str(out),
+    ])
+    assert rc == 0
+    curve = json.loads(capsys.readouterr().out)
+    assert len(curve["points"]) == 2
+    assert [p["requested_rps"] for p in curve["points"]] == [10.0, 20.0]
+    # The knee is reported in ACTUAL offered rps (the Poisson draw), which
+    # must match one of the swept points.
+    assert curve["knee_offered_rps"] in {
+        p["offered_rps"] for p in curve["points"]
+    }
+    assert "collapsed" in curve
+
+    from edgemesh.obs.cli import main as obs_main
+
+    assert obs_main(["loadreport", str(out)]) == 0
+    text = capsys.readouterr().out
+    assert "goodput vs offered load" in text and "knee" in text
+
+
+def test_loadreport_missing_file_is_usage_error(tmp_path, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    assert obs_main(["loadreport", str(tmp_path / "nope.json")]) == 2
+    assert "no such report" in capsys.readouterr().err

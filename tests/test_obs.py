@@ -527,3 +527,218 @@ def test_rest_continuous_metrics_scrape_end_to_end(tmp_path):
         if srv.batcher is not None:
             srv.batcher.close()
     assert obs_main(["summary", str(tmp_path / "spans.jsonl")]) == 0
+
+
+# ---------------------------------------------------------------------------
+# bounded_label: the tenant-cardinality guard (obs/metrics.py, EM112)
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_label_defaults_sanitize_and_overflow():
+    from edgemesh.obs.metrics import OTHER_LABEL, bounded_label
+
+    assert bounded_label(None) == "default"
+    assert bounded_label("") == "default"
+    assert bounded_label(123) == "default"  # non-strings never pass through
+    assert bounded_label("acme-prod") == "acme-prod"
+    # Sanitized: exposition syntax and exotic bytes cannot ride a label.
+    assert bounded_label('x"y{z}\n') == "x_y_z__"
+    assert len(bounded_label("q" * 500, namespace="long")) == 64
+    # First-come cap per namespace, overflow collapses into OTHER_LABEL.
+    for i in range(32):
+        assert bounded_label(f"t{i}", namespace="cap") == f"t{i}"
+    assert bounded_label("t-straggler", namespace="cap") == OTHER_LABEL
+    assert bounded_label("t5", namespace="cap") == "t5"  # seen values stay
+
+
+def test_bounded_label_allowlist_never_grows_state():
+    from edgemesh.obs.metrics import OTHER_LABEL, bounded_label
+
+    allow = ("gold", "silver")
+    assert bounded_label("gold", namespace="al", allow=allow) == "gold"
+    for i in range(100):
+        assert bounded_label(f"mint-{i}", namespace="al",
+                             allow=allow) == OTHER_LABEL
+    # The allowlisted namespace banked nothing: unlisted still passes cap.
+    assert bounded_label("silver", namespace="al", allow=allow) == "silver"
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant SLO + span/replay tenant plumbing (forward-compat satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_slo_tracker_tenant_families_ride_alongside_aggregate():
+    from edgemesh.obs.slo import SloTarget, SloTracker
+
+    reg = Registry()
+    slo = SloTracker(reg, engine="unit", target=SloTarget(ttft_s=1.0,
+                                                          tpot_s=0.1))
+    slo.record("ok", 0.5, 0.05, tenant="acme")
+    slo.record("ok", 5.0, 0.05, tenant="acme")   # ttft miss
+    slo.record("ok", 0.5, 0.05)                  # untagged: aggregate only
+    s = reg.summary()
+    assert s['edgemesh_slo_requests_total{engine="unit",result="good"}'] == 2
+    assert s['edgemesh_slo_tenant_requests_total'
+             '{engine="unit",tenant="acme",result="good"}'] == 1
+    assert s['edgemesh_slo_tenant_requests_total'
+             '{engine="unit",tenant="acme",result="ttft"}'] == 1
+    assert s['edgemesh_slo_tenant_goodput_ratio'
+             '{engine="unit",tenant="acme"}'] == 0.5
+    assert slo.goodput_ratio() == pytest.approx(2 / 3)
+    assert slo.tenant_goodput() == {
+        "acme": {"classified": 2, "good": 1, "goodput_ratio": 0.5}}
+
+
+def test_span_records_carry_tenant_and_replay_per_tenant(tmp_path):
+    reg = Registry()
+    tracker = SpanTracker(reg, tmp_path / "spans.jsonl", engine="unit")
+    tr = tracker.submit(0, tenant="acme")
+    tracker.admit_start(tr)
+    tracker.admitted(tr)
+    tracker.tokens(tr, 3)
+    tracker.retire(tr)
+    _drive_tracker(tracker, 1)  # untagged request
+    recs = JsonlLogger(tmp_path / "spans.jsonl").read()
+    assert [r.get("tenant") for r in recs] == ["acme", None]
+    offline = replay_spans(tmp_path / "spans.jsonl").summary()
+    live = reg.summary()
+    for key, val in live.items():
+        if key.startswith("edgemesh_slo_tenant"):
+            assert offline[key] == val, key
+    assert offline[
+        'edgemesh_slo_tenant_requests_total'
+        '{engine="unit",tenant="acme",result="good"}'] == 1
+
+
+def test_replay_and_summary_stay_rc0_on_pre_tenant_logs(tmp_path, capsys):
+    """Forward-compat direction 1: a log written BEFORE the tenant field
+    (and before slo_result) replays cleanly — per-tenant fields null,
+    exit 0."""
+    from edgemesh.obs.cli import main as obs_main
+
+    log = tmp_path / "old.jsonl"
+    old_records = [
+        # Pre-SLO, pre-tenant era record: no slo_result, no tenant key.
+        {"ts": 1.0, "event": SPAN_RECORD_EVENT, "rid": 0, "engine": "e",
+         "status": "ok", "generated": 3, "queue_s": 0.01, "prefill_s": 0.02,
+         "ttft_s": 0.05, "itl_s": 0.01, "latency_s": 0.2, "spans": []},
+        # SLO-era but pre-tenant record.
+        {"ts": 2.0, "event": SPAN_RECORD_EVENT, "rid": 1, "engine": "e",
+         "status": "ok", "generated": 2, "latency_s": 0.1,
+         "slo_result": "good", "spans": []},
+    ]
+    with open(log, "w") as f:
+        for r in old_records:
+            f.write(json.dumps(r) + "\n")
+    reg = replay_spans(log)
+    s = reg.summary()
+    assert s['edgemesh_requests_submitted_total{engine="e"}'] == 2
+    assert s['edgemesh_slo_requests_total{engine="e",result="good"}'] == 1
+    # No per-tenant family was minted from tenant-less records.
+    assert not any(k.startswith("edgemesh_slo_tenant") for k in s)
+    assert obs_main(["summary", str(log)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["requests"] == 2
+    assert report["tenants"] is None  # null, not an error
+
+
+def test_replay_ignores_unknown_keys_in_future_records(tmp_path, capsys):
+    """Forward-compat direction 2: records written by a FUTURE version
+    (unknown keys, unknown slo_result values) replay without error and
+    the known fields still aggregate."""
+    from edgemesh.obs.cli import main as obs_main
+
+    log = tmp_path / "future.jsonl"
+    future_records = [
+        {"ts": 1.0, "event": SPAN_RECORD_EVENT, "rid": 0, "engine": "e",
+         "status": "ok", "generated": 4, "latency_s": 0.2, "ttft_s": 0.05,
+         "slo_result": "good", "tenant": "acme",
+         # Unknown future keys must be ignored, not fatal.
+         "tenant_shard": "eu-west", "qos_class": 3,
+         "spans": [], "future_blob": {"nested": [1, 2, 3]}},
+        {"ts": 2.0, "event": SPAN_RECORD_EVENT, "rid": 1, "engine": "e",
+         "status": "ok", "generated": 1, "latency_s": 0.1,
+         # An slo_result value this version does not know: skipped, the
+         # rest of the record still counts.
+         "slo_result": "good_with_asterisk", "spans": []},
+        {"ts": 3.0, "event": "future_event_kind", "engine": "e",
+         "payload": "???"},
+    ]
+    with open(log, "w") as f:
+        for r in future_records:
+            f.write(json.dumps(r) + "\n")
+    s = replay_spans(log).summary()
+    assert s['edgemesh_requests_submitted_total{engine="e"}'] == 2
+    assert s['edgemesh_slo_requests_total{engine="e",result="good"}'] == 1
+    assert s['edgemesh_slo_tenant_requests_total'
+             '{engine="e",tenant="acme",result="good"}'] == 1
+    assert obs_main(["summary", str(log)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    # Three records, two of them request spans; the unknown event kind is
+    # carried but not misread as a request.
+    assert report["records"] == 3 and report["requests"] == 2
+    assert report["tenants"]["acme"]["classified"] == 1
+
+
+# ---------------------------------------------------------------------------
+# DecayingQuantile under bursty open-loop arrival (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _decaying(**kw):
+    from edgemesh.obs.slo import DecayingQuantile
+
+    clock = {"t": 0.0}
+    dq = DecayingQuantile(now=lambda: clock["t"], **kw)
+    return dq, clock
+
+
+def test_decaying_quantile_decays_across_idle_gaps():
+    dq, clk = _decaying(half_life_s=60.0)
+    for _ in range(100):
+        dq.observe(1.0)
+    assert dq.weight() == pytest.approx(100.0)
+    clk["t"] += 120.0  # two half-lives of silence
+    assert dq.weight() == pytest.approx(25.0, rel=1e-6)
+    # The surviving mass still answers quantiles at the old regime.
+    assert dq.quantile(0.5) == pytest.approx(1.0, rel=0.4)
+
+
+def test_decaying_quantile_min_weight_gate_rearms_after_quiet_period():
+    dq, clk = _decaying(half_life_s=10.0, min_weight=16.0)
+    assert dq.quantile(0.95) is None  # empty: must not arm
+    for _ in range(20):
+        dq.observe(0.1)
+    assert dq.quantile(0.95) is not None  # armed
+    clk["t"] += 10.0  # 20 -> 10: below the gate again
+    assert dq.weight() < 16.0
+    assert dq.quantile(0.95) is None  # DISARMED: stale evidence stands down
+    # A fresh burst re-arms it (bursty open-loop traffic pattern).
+    for _ in range(12):
+        dq.observe(0.1)
+    assert dq.quantile(0.95) is not None
+
+
+def test_decaying_quantile_stable_across_interleaved_tenant_regimes():
+    """Two tenants in disjoint latency regimes (1 ms vs 1 s) interleaving
+    their observations: low quantiles answer from the fast regime, high
+    quantiles from the slow one, and the answers do not drift with the
+    interleaving order or repeated reads."""
+    dq, _ = _decaying(half_life_s=3600.0)  # no decay inside the test
+    for _ in range(100):
+        dq.observe(0.001)  # interactive tenant
+        dq.observe(1.0)    # batch tenant
+    p25 = dq.quantile(0.25)
+    p95 = dq.quantile(0.95)
+    assert p25 < 0.01           # firmly in the fast regime
+    assert 0.5 < p95 < 2.0      # firmly in the slow regime (bucket-coarse)
+    # Repeated reads are stable (no internal mutation from reading).
+    assert dq.quantile(0.25) == p25 and dq.quantile(0.95) == p95
+    # Order independence: the reversed interleave lands in the same buckets.
+    dq2, _ = _decaying(half_life_s=3600.0)
+    for _ in range(100):
+        dq2.observe(1.0)
+        dq2.observe(0.001)
+    assert dq2.quantile(0.25) == pytest.approx(p25, rel=1e-9)
+    assert dq2.quantile(0.95) == pytest.approx(p95, rel=1e-9)
