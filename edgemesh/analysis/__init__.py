@@ -1,6 +1,6 @@
 """edgemesh.analysis — static analysis (edgelint) + abstract contract checks.
 
-Two passes over the codebase, designed to catch the silent-wrong-numbers and
+Passes over the codebase designed to catch the silent-wrong-numbers and
 API-drift bug classes BEFORE anything executes on a device:
 
 - **edgelint** (``edgelint.py``): an AST linter with JAX/TPU-specific rules —
@@ -15,10 +15,18 @@ API-drift bug classes BEFORE anything executes on a device:
   cache avals must equal its input cache avals — the recompile hazard), no
   float64/weak-type promotion, and that every kernel exposing ``check=True``
   wires an ``ops/checks.py`` contract.
+- **sharding** (``sharding.py``): the parallel-stack pass — AST rules
+  EM401-EM404 (unbound collective axes, shard_map spec mismatches,
+  unreduced sharded contractions, host→jit retrace hazards) riding the
+  lint entry points, plus the ``SHARDING_CONTRACTS`` AbstractMesh dryrun
+  (EM405): every public shard_map wrapper traced under tp2/tp8/dp2×tp4/
+  pp2-style layouts on CPU, no devices required.
 
 CLI: ``python -m edgemesh.analysis [paths]`` or ``edgemesh lint [paths]``.
 Grandfathered findings live in ``baseline.json`` next to this module; the
-run exits non-zero on any non-baselined finding. See docs/ANALYSIS.md.
+run exits non-zero on any non-baselined finding. Filter rules with
+``--select``/``--ignore`` (prefix-aware: ``--select EM4xx``). See
+docs/ANALYSIS.md.
 """
 
 from edgemesh.analysis.findings import (  # noqa: F401
@@ -30,14 +38,17 @@ from edgemesh.analysis.edgelint import RULES, lint_paths  # noqa: F401
 
 
 def run_analysis(paths, *, contracts: bool = True):
-    """Lint ``paths`` and (optionally) run the abstract contract pass.
+    """Lint ``paths`` and (optionally) run the jax-importing semantic
+    passes (eval_shape contracts + the AbstractMesh sharding dryrun).
 
-    Returns a list of Findings. Import of the contract pass is deferred so
-    pure-lint callers never pay the jax import.
+    Returns a list of Findings. Imports of the semantic passes are
+    deferred so pure-lint callers never pay the jax import.
     """
     findings = lint_paths(paths)
     if contracts:
         from edgemesh.analysis.contracts import run_contracts
+        from edgemesh.analysis.sharding import run_sharding_contracts
 
         findings.extend(run_contracts())
+        findings.extend(run_sharding_contracts())
     return findings

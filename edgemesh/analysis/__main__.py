@@ -29,7 +29,8 @@ def _default_target() -> list[str]:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m edgemesh.analysis",
-        description="edgelint (AST rules) + abstract eval_shape contract pass",
+        description="edgelint (AST rules) + abstract eval_shape contracts + "
+        "AbstractMesh sharding dryrun",
     )
     p.add_argument(
         "paths", nargs="*", default=None,
@@ -42,11 +43,23 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--no-contracts", action="store_true",
-        help="skip the eval_shape contract pass (pure AST lint; no jax import)",
+        help="skip the semantic passes that import jax (the EM2xx eval_shape "
+        "contracts AND the EM405 AbstractMesh sharding dryrun); pure AST lint",
     )
     p.add_argument(
         "--severity", choices=["error", "warning"], default="warning",
         help="minimum severity to report (default: warning = everything)",
+    )
+    p.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="only report these rules — comma-separated, prefix-aware: "
+        "'EM4xx' selects every EM4 rule, 'EM301' exactly one "
+        "(e.g. --select EM4xx,EM301)",
+    )
+    p.add_argument(
+        "--ignore", default=None, metavar="RULES",
+        help="drop these rules from the report (same syntax as --select; "
+        "applied after it)",
     )
     p.add_argument(
         "--baseline", default=None,
@@ -70,9 +83,40 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_rule_patterns(arg: str | None) -> list[str] | None:
+    """Comma-separated rule patterns: exact IDs ('EM301') and prefix
+    wildcards spelled with trailing x's ('EM4xx' → every EM4 rule)."""
+    if arg is None:
+        return None
+    patterns = [p.strip().upper() for p in arg.split(",") if p.strip()]
+    return patterns or None
+
+
+def _rule_matches(rule: str, patterns: list[str]) -> bool:
+    r = rule.upper()
+    for p in patterns:
+        if p.endswith("X"):
+            if r.startswith(p.rstrip("X")):
+                return True
+        elif r == p:
+            return True
+    return False
+
+
+def _rule_selected(rule: str, select: list[str] | None,
+                   ignore: list[str] | None) -> bool:
+    if select is not None and not _rule_matches(rule, select):
+        return False
+    if ignore is not None and _rule_matches(rule, ignore):
+        return False
+    return True
+
+
 def _stale_entries(baseline: Baseline, findings: list[Finding],
                    paths: list[str],
-                   skipped_rule_prefixes: tuple[str, ...] = ()) -> list[dict]:
+                   skipped_rule_prefixes: tuple[str, ...] = (),
+                   select: list[str] | None = None,
+                   ignore: list[str] | None = None) -> list[dict]:
     """Baseline entries that no longer match anything.
 
     An entry is stale when (a) its file no longer exists at all, or (b) its
@@ -80,11 +124,13 @@ def _stale_entries(baseline: Baseline, findings: list[Finding],
     fingerprint. Entries for files outside the linted path set (and still
     on disk) are left alone — a single-file lint must not condemn the rest
     of the baseline — and so are entries from a pass that did not run this
-    invocation (``--no-contracts`` skips EM2xx, so an absent EM2xx
-    fingerprint proves nothing). Staleness matters beyond hygiene: a dead
-    entry would silently mask a FUTURE finding that lands on the same
-    fingerprint (same rule, scope, and line text — e.g. the regressed code
-    pasted back in).
+    invocation (``--no-contracts`` skips EM2xx/EM405, so an absent
+    fingerprint from those proves nothing) or a rule filtered out by
+    ``--select``/``--ignore`` (a filtered run cannot judge the rules it
+    never reported). Staleness matters beyond hygiene: a dead entry would
+    silently mask a FUTURE finding that lands on the same fingerprint
+    (same rule, scope, and line text — e.g. the regressed code pasted back
+    in).
     """
     current = {f.fingerprint() for f in findings}
     linted = {repo_relative(p) for p in iter_python_files(paths)}
@@ -99,6 +145,8 @@ def _stale_entries(baseline: Baseline, findings: list[Finding],
         rule = entry.get("rule", "")
         if any(rule.startswith(p) for p in skipped_rule_prefixes):
             continue  # that pass didn't run; its findings can't be judged
+        if not _rule_selected(rule, select, ignore):
+            continue  # rule filtered out this run; can't be judged either
         if path in linted and entry["fingerprint"] not in current:
             stale.append({**entry, "reason": "finding no longer present"})
     return stale
@@ -124,12 +172,18 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 2
 
+    select = _parse_rule_patterns(args.select)
+    ignore = _parse_rule_patterns(args.ignore)
+
     findings: list[Finding] = lint_paths(paths)
     if not args.no_contracts:
         from edgemesh.analysis.contracts import run_contracts
+        from edgemesh.analysis.sharding import run_sharding_contracts
 
         findings.extend(run_contracts())
+        findings.extend(run_sharding_contracts())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    findings = [f for f in findings if _rule_selected(f.rule, select, ignore)]
     # Staleness is judged against EVERY finding (before the severity filter
     # drops warnings): a baselined warning is not stale just because the
     # operator asked to see errors only.
@@ -139,14 +193,38 @@ def main(argv: list[str] | None = None) -> int:
 
     baseline_path = Path(args.baseline) if args.baseline else default_baseline_path()
     if args.write_baseline:
-        Baseline.from_findings(findings).save(baseline_path)
-        print(f"wrote {len(findings)} grandfathered finding(s) to {baseline_path}")
+        new_baseline = Baseline.from_findings(findings)
+        if select is not None or ignore is not None:
+            # A filtered run only saw the selected rules: rewrite THEIR
+            # entries and keep everything else — a full overwrite here
+            # would silently destroy every other rule's grandfathered debt.
+            kept = [
+                e for e in Baseline.load(baseline_path).entries
+                if not _rule_selected(e.get("rule", ""), select, ignore)
+            ]
+            seen: set[str] = set()
+            entries = []
+            for e in sorted(
+                kept + new_baseline.entries,
+                key=lambda e: (e.get("path", ""), e.get("rule", ""),
+                               e["fingerprint"]),
+            ):
+                if e["fingerprint"] not in seen:
+                    seen.add(e["fingerprint"])
+                    entries.append(e)
+            new_baseline = Baseline({e["fingerprint"] for e in entries}, entries)
+        new_baseline.save(baseline_path)
+        print(
+            f"wrote {len(new_baseline.entries)} grandfathered finding(s) to "
+            f"{baseline_path}"
+        )
         return 0
 
     baseline = Baseline() if args.no_baseline else Baseline.load(baseline_path)
     stale = [] if args.no_baseline else _stale_entries(
         baseline, all_findings, paths,
-        skipped_rule_prefixes=("EM2",) if args.no_contracts else (),
+        skipped_rule_prefixes=("EM2", "EM405") if args.no_contracts else (),
+        select=select, ignore=ignore,
     )
     if args.prune_baseline:
         stale_fps = {e["fingerprint"] for e in stale}

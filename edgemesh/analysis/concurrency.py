@@ -137,7 +137,7 @@ _INIT_METHODS = {"__init__", "__post_init__", "__new__"}
 
 def _build_aliases(tree: ast.Module) -> _EdgelintAliases:
     """edgelint's import-alias resolver, fed the whole module — ONE
-    resolution contract across both passes (``from jax import lax;
+    resolution contract across every pass (``from jax import lax;
     lax.pcast`` and ``import time as t; t.sleep`` resolve identically)."""
     aliases = _EdgelintAliases()
     for node in ast.walk(tree):

@@ -83,8 +83,11 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
 
 The class-level concurrency rules (EM301-EM304: lock discipline,
 lock-order cycles, blocking-under-lock, thread hygiene) live in
-``edgemesh/analysis/concurrency.py`` and ride the same entry points —
-``lint_source``/``lint_file`` return both passes' findings.
+``edgemesh/analysis/concurrency.py``, and the sharding/collective rules
+(EM401-EM404: unbound collective axes, spec mismatches, unreduced sharded
+contractions, retrace hazards) in ``edgemesh/analysis/sharding.py`` —
+both ride the same entry points: ``lint_source``/``lint_file`` return
+every pass's findings.
 
 Suppression: append ``# edgelint: disable=EM105`` (comma-separate for
 several rules) to the flagged line, or put the comment on the ``def`` line
@@ -1054,14 +1057,17 @@ def lint_file(path: str | Path) -> list[Finding]:
 
 def lint_source(source: str, path: str = "<memory>") -> list[Finding]:
     """Lint a source string (the fixture-test entry point): the per-function
-    AST rules (EM1xx) plus the class-level concurrency pass (EM3xx)."""
-    # Lazy import: concurrency.py is a sibling pass, not a dependency of the
-    # EM1xx machinery, and importing it at module top would be a cycle if it
-    # ever needs linter internals.
+    AST rules (EM1xx), the class-level concurrency pass (EM3xx), and the
+    sharding/collective pass (EM401-EM404)."""
+    # Lazy imports: the sibling passes are not dependencies of the EM1xx
+    # machinery, and importing them at module top would be a cycle (both
+    # reuse linter internals).
     from edgemesh.analysis.concurrency import analyze_source
+    from edgemesh.analysis.sharding import analyze_source as analyze_sharding
 
     findings = _FileLinter(path, source).run()
     findings.extend(analyze_source(source, path))
+    findings.extend(analyze_sharding(source, path))
     findings.sort(key=lambda f: (f.line, f.rule))
     return findings
 

@@ -1,0 +1,1252 @@
+"""Static sharding & collective-correctness analysis for the parallel stack.
+
+Two layers over the ``shard_map``/collective code in ``parallel/`` (and the
+host→jit seams in ``serve/``/``runtime/``), catching the bug class that
+otherwise surfaces only in scarce hardware tunnel windows:
+
+**Layer 1 — AST rules (EM401–EM404)**, riding the standard edgelint entry
+points (``lint_source``/``lint_file``, baseline, inline disables):
+
+- **EM401 unbound-collective-axis** (error): a collective
+  (``lax.psum``/``pmean``/``all_gather``/``ppermute``/``all_to_all``/
+  ``axis_index``/…, or ``compat.axis_size``/``compat.pcast``) naming a mesh
+  axis that the enclosing ``shard_map`` call site does not bind. The axis
+  environment is taken from the mesh construction when it is visible
+  (``Mesh(devs, ("sp",))``, ``build_mesh(...)``, ``AbstractMesh(...)``);
+  when the mesh is opaque but every ``in_specs``/``out_specs`` entry is a
+  literal ``P(...)``, the union of spec axes stands in for it (an axis a
+  body reduces over should appear in the specs or a visible mesh — if a
+  wider opaque mesh really binds more, carry an inline disable). Bodies are
+  resolved through locals, module-level defs, and factory functions
+  (``fn = _make_stage(...); shard_map(fn, ...)``), and the walk descends
+  into called helpers binding constant-string axis parameters
+  (``ring_attend_block(..., axis="sp")``) — the same descent trick
+  ``concurrency.py`` uses for self-calls.
+- **EM402 shard-spec-mismatch** (error): ``in_specs`` arity vs the body's
+  positional parameters AND vs the visible call sites of the mapped
+  function (the tp_infer pytree-mirroring trap: a specs tuple whose
+  structure visibly diverges from the arguments built in the same scope);
+  ``out_specs`` tuple arity vs the body's returned tuple; and any literal
+  ``P(...)`` axis name absent from a visible mesh construction's axis
+  names. A single (non-tuple) out spec is a valid pytree prefix and is
+  never an arity finding.
+- **EM403 unreduced-sharded-contraction** (error): the body contracts
+  (``@``/``jnp.dot``/``jnp.matmul``/``jnp.einsum``/``lax.dot_general``)
+  over a dimension ``in_specs`` marks sharded on axis A, then returns the
+  (partial) result without a ``psum(..., A)`` on the path while
+  ``out_specs`` claims it replicated over A — silent wrong numbers on
+  every chip. ``check_vma=False`` call sites are called out in the
+  message: with the replication checker off, nothing at trace time would
+  have caught it either.
+- **EM404 retrace-hazard** (warning): a host-computed int (``len(...)``,
+  ``.shape[i]`` arithmetic) flowing into a jitted call's arguments in
+  ``serve/``/``runtime/`` without passing through the blessed bucketing
+  vocabulary (``utils/bucketing.bucket_pow2`` — the ``s_cap`` pow2 ladder
+  the continuous engine converged on). Raw host sizes as static/jit args
+  mint one compiled program per distinct value; the engine pays the
+  retrace exactly when it is busiest.
+
+**Layer 2 — AbstractMesh dryrun contracts (EM405)**, the semantic
+companion in the style of ``analysis/contracts.py``: every public
+shard_map wrapper (tp_infer, ring_attention, ulysses, pipeline, spmd) is
+registered in ``SHARDING_CONTRACTS`` and traced under
+``jax.sharding.AbstractMesh`` layouts (tp2 / tp8 / dp2×tp4 / pp2 / sp2 /
+the 4D training mesh) via ``jax.eval_shape`` — no devices, sub-second on
+CPU — so "does tp8 even trace" is a fast-tier test, not a tunnel-window
+discovery. A failure names the wrapper AND the layout.
+
+Suppression and baselining are the standard edgelint mechanics
+(``# edgelint: disable=EM401``, fingerprint baseline). See
+docs/ANALYSIS.md for the full rule table and the dryrun workflow.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from edgemesh.analysis.edgelint import _Aliases as _EdgelintAliases
+from edgemesh.analysis.edgelint import _dotted_name as _dotted
+from edgemesh.analysis.edgelint import _is_jit_expr
+from edgemesh.analysis.findings import DISABLE_RE, Finding, repo_relative
+
+RULES: dict[str, dict] = {
+    "EM401": {
+        "name": "unbound-collective-axis",
+        "severity": "error",
+        "summary": "collective names a mesh axis the enclosing shard_map does not bind",
+    },
+    "EM402": {
+        "name": "shard-spec-mismatch",
+        "severity": "error",
+        "summary": "in_specs/out_specs arity or axis names diverge from body/mesh/call site",
+    },
+    "EM403": {
+        "name": "unreduced-sharded-contraction",
+        "severity": "error",
+        "summary": "sharded contraction returned without psum while out_specs claims replication",
+    },
+    "EM404": {
+        "name": "retrace-hazard",
+        "severity": "warning",
+        "summary": "host-computed size flows into a jitted call without blessed bucketing",
+    },
+}
+
+#: Layer-2 rule (reported by run_sharding_contracts, not the AST walk).
+SHARDING_CONTRACT_RULES: dict[str, dict] = {
+    "EM405": {
+        "name": "sharding-dryrun-failure",
+        "severity": "error",
+        "summary": "registered shard_map wrapper fails its AbstractMesh layout dryrun",
+    },
+}
+
+# ---------------------------------------------------------------------------
+# Tables
+# ---------------------------------------------------------------------------
+
+#: Collectives that take an axis name, mapped to (positional index, kwarg
+#: name) of that argument. Keyed by the LAST component; accepted only when
+#: the resolved dotted path sits under jax.lax or edgemesh.utils.compat
+#: (or is a bare import of one of those names).
+_COLLECTIVES: dict[str, tuple[int, str]] = {
+    "psum": (1, "axis_name"),
+    "pmean": (1, "axis_name"),
+    "pmax": (1, "axis_name"),
+    "pmin": (1, "axis_name"),
+    "psum_scatter": (1, "axis_name"),
+    "all_gather": (1, "axis_name"),
+    "ppermute": (1, "axis_name"),
+    "pshuffle": (1, "axis_name"),
+    "all_to_all": (1, "axis_name"),
+    "axis_index": (0, "axis_name"),
+    "axis_size": (0, "axis_name"),  # compat shim
+    "pcast": (1, "axis_name"),      # compat shim
+}
+
+_COLLECTIVE_HOMES = ("jax.lax.", "edgemesh.utils.compat.")
+#: Bare-name fallback for the compat helpers (their only legitimate homes
+#: are the compat module; fixtures import them by name).
+_COMPAT_BARE = {"axis_size", "pcast"}
+
+#: Collectives that REDUCE over the axis (clear EM403 partial-ness).
+_REDUCERS = {"psum", "pmean", "pmax", "pmin", "psum_scatter"}
+
+#: The five canonical mesh axes (parallel/mesh.py AXES) — what
+#: build_mesh/auto_mesh always bind.
+_MESH_AXES = ("dp", "pp", "sp", "ep", "tp")
+
+# EM404 scope + surfaces (mirrors EM110's jitted-name discovery).
+_EM404_DIRS = ("edgemesh/serve/", "edgemesh/runtime/")
+_EM404_IMPORT_PREFIXES = ("forward_", "generate")
+_EM404_IMPORT_EXTRA = {"_decode_loop", "_spec_rounds"}
+#: Blessed host→jit size sanitizers (utils/bucketing.py).
+_BLESSED_BUCKETING = {"bucket_pow2"}
+#: Host calls whose result is tainted iff any argument is.
+_TAINT_THROUGH = {"max", "min", "sum", "int", "round", "abs"}
+
+_DESCENT_DEPTH = 4  # callee-descent limit for EM401
+
+
+# ---------------------------------------------------------------------------
+# The per-file pass
+# ---------------------------------------------------------------------------
+
+
+class _FileSharding:
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.relpath = repo_relative(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.disabled: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = DISABLE_RE.search(line)
+            if m:
+                self.disabled[i] = {r.strip() for r in m.group(1).split(",")}
+
+    # -- shared emit machinery (same contract as concurrency.py) ------------
+
+    def _scopes_for_line(self, line: int) -> list[ast.AST]:
+        return [
+            s for s in self._all_scopes
+            if s.lineno <= line <= getattr(s, "end_lineno", s.lineno)
+        ]
+
+    def _suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.disabled.get(line, ()):
+            return True
+        for scope in self._scopes_for_line(line):
+            if rule in self.disabled.get(scope.lineno, ()):
+                return True
+        return False
+
+    def _context_for_line(self, line: int) -> str:
+        best = ""
+        for s in self._scopes_for_line(line):
+            best = s.name if not best else f"{best}.{s.name}"
+        return best
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if self._suppressed(rule, line):
+            return
+        self.findings.append(
+            Finding(
+                rule=rule,
+                severity=RULES[rule]["severity"],
+                path=self.relpath,
+                line=line,
+                message=message,
+                context=self._context_for_line(line),
+                line_text=(self.lines[line - 1].strip() if line <= len(self.lines) else ""),
+            )
+        )
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source)
+        except SyntaxError:
+            return []  # edgelint already reports EM000 for this file
+        self.tree = tree
+        self.aliases = _EdgelintAliases()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                self.aliases.visit_import(node)
+            elif isinstance(node, ast.ImportFrom):
+                self.aliases.visit_import_from(node)
+        self._all_scopes = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+        ]
+        self._all_defs = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and self._is_shard_map(node):
+                self._check_site(node)
+        self._rule_retrace(tree)
+
+        seen: set[tuple] = set()
+        unique: list[Finding] = []
+        for f in sorted(self.findings, key=lambda f: (f.line, f.rule)):
+            key = (f.rule, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        self.findings = unique
+        return self.findings
+
+    # -- resolution helpers --------------------------------------------------
+
+    def _is_shard_map(self, node: ast.Call) -> bool:
+        d = _dotted(node.func)
+        if not d:
+            return False
+        resolved = self.aliases.resolve(d)
+        return resolved.rsplit(".", 1)[-1] == "shard_map"
+
+    def _enclosing_fn(self, line: int):
+        fns = [
+            s for s in self._scopes_for_line(line)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        return fns[-1] if fns else None
+
+    def _local_assign_value(self, name: str, line: int) -> ast.AST | None:
+        """Latest ``name = <value>`` before ``line`` in the innermost
+        enclosing function chain (outer scopes searched when the innermost
+        has no binding — the make_spmd_loss closure pattern)."""
+        fns = [
+            s for s in self._scopes_for_line(line)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in reversed(fns):
+            best, best_line = None, -1
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Assign)
+                    and best_line < sub.lineno < line
+                    and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in sub.targets
+                    )
+                ):
+                    best, best_line = sub.value, sub.lineno
+            if best is not None:
+                return best
+        return None
+
+    def _deref(self, expr: ast.AST, line: int, depth: int = 0) -> ast.AST:
+        if depth < 4 and isinstance(expr, ast.Name):
+            v = self._local_assign_value(expr.id, line)
+            if v is not None:
+                return self._deref(v, line, depth + 1)
+        return expr
+
+    def _find_def(self, name: str, near_line: int | None = None):
+        """The def ``name`` resolves to: the innermost one enclosing
+        ``near_line`` if any, else a module-level (un-nested) one."""
+        candidates = [d for d in self._all_defs if d.name == name]
+        if not candidates:
+            return None
+        if near_line is not None:
+            local = [
+                d for d in candidates
+                if any(
+                    s is not d and d.lineno <= getattr(s, "end_lineno", s.lineno)
+                    and s.lineno <= d.lineno
+                    for s in self._scopes_for_line(near_line)
+                    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                )
+            ]
+            if local:
+                return local[-1]
+        toplevel = [
+            d for d in candidates
+            if not any(
+                p is not d and isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and p.lineno <= d.lineno <= getattr(p, "end_lineno", p.lineno)
+                for p in self._all_defs
+            )
+        ]
+        return toplevel[0] if toplevel else candidates[0]
+
+    def _resolve_body(self, expr: ast.AST, line: int, depth: int = 0):
+        """The function def (or Lambda) a shard_map body expression names —
+        resolved through locals, module-level defs, and one factory hop
+        (``fn = _make_stage(...)`` where ``_make_stage`` returns an inner
+        def)."""
+        if depth > 3:
+            return None
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Name):
+            d = self._find_def(expr.id, near_line=line)
+            if d is not None:
+                return d
+            v = self._local_assign_value(expr.id, line)
+            if v is not None:
+                return self._resolve_body(v, line, depth + 1)
+            return None
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+            factory = self._find_def(expr.func.id, near_line=line)
+            if factory is None:
+                return None
+            inner = {
+                n.name: n
+                for n in ast.walk(factory)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not factory
+            }
+            for node in _own_statements(factory):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    if isinstance(node.value, ast.Name) and node.value.id in inner:
+                        return inner[node.value.id]
+                    if isinstance(node.value, ast.Lambda):
+                        return node.value
+        return None
+
+    # -- mesh / spec parsing -------------------------------------------------
+
+    def _mesh_env(self, expr: ast.AST | None, line: int) -> tuple[set[str], bool]:
+        """(axis names, known) for the ``mesh=`` expression. Known only when
+        a construction with literal axis names is visible."""
+        if expr is None:
+            return set(), False
+        e = self._deref(expr, line)
+        if not isinstance(e, ast.Call):
+            return set(), False
+        d = _dotted(e.func)
+        if not d:
+            return set(), False
+        last = self.aliases.resolve(d).rsplit(".", 1)[-1]
+        if last in ("build_mesh", "auto_mesh"):
+            return set(_MESH_AXES), True
+        if last == "Mesh":
+            names_arg = e.args[1] if len(e.args) >= 2 else next(
+                (kw.value for kw in e.keywords if kw.arg == "axis_names"), None
+            )
+            names = _str_constants(names_arg)
+            if names is not None:
+                return names, True
+            return set(), False
+        if last == "AbstractMesh":
+            # shape_tuple form: (("dp", 2), ("tp", 4)) — every string
+            # constant inside it is an axis name.
+            if e.args:
+                names = {
+                    n.value for n in ast.walk(e.args[0])
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str)
+                }
+                return names, True
+        return set(), False
+
+    def _parse_specs(self, expr: ast.AST | None, line: int):
+        """Returns (axes, literal, node) where node is ('P', entries) for a
+        single spec (entries: None | str | tuple[str, ...] | '?'),
+        ('seq', [nodes]) for a tuple/list of specs, or ('opaque',).
+        ``literal`` means every entry everywhere was resolvable."""
+        if expr is None:
+            return set(), False, ("opaque",)
+        e = self._deref(expr, line)
+        if isinstance(e, ast.Constant) and e.value is None:
+            return set(), True, ("P", [])
+        if isinstance(e, ast.Call):
+            d = _dotted(e.func)
+            last = self.aliases.resolve(d).rsplit(".", 1)[-1] if d else ""
+            if last in ("P", "PartitionSpec"):
+                axes: set[str] = set()
+                entries: list = []
+                literal = not e.keywords
+                for a in e.args:
+                    if isinstance(a, ast.Constant) and a.value is None:
+                        entries.append(None)
+                    elif isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        entries.append(a.value)
+                        axes.add(a.value)
+                    elif isinstance(a, (ast.Tuple, ast.List)):
+                        names = _str_constants(a)
+                        if names is None:
+                            entries.append("?")
+                            literal = False
+                        else:
+                            entries.append(tuple(sorted(names)))
+                            axes.update(names)
+                    elif isinstance(a, ast.Starred):
+                        entries.append("?")
+                        literal = False
+                    else:
+                        entries.append("?")
+                        literal = False
+                return axes, literal, ("P", entries)
+            return set(), False, ("opaque",)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            axes_all: set[str] = set()
+            literal_all = True
+            children = []
+            for el in e.elts:
+                ax, lit, node = self._parse_specs(el, line)
+                axes_all |= ax
+                literal_all = literal_all and lit
+                children.append(node)
+            return axes_all, literal_all, ("seq", children)
+        return set(), False, ("opaque",)
+
+    # -- site checking -------------------------------------------------------
+
+    def _check_site(self, site: ast.Call) -> None:
+        body_expr = _call_arg(site, 0, "f")
+        mesh_expr = _call_arg(site, 1, "mesh")
+        in_expr = _call_arg(site, 2, "in_specs")
+        out_expr = _call_arg(site, 3, "out_specs")
+        vma_expr = _call_arg(site, 4, "check_vma")
+        vma_off = (
+            isinstance(vma_expr, ast.Constant) and vma_expr.value is False
+        )
+        line = site.lineno
+
+        mesh_axes, mesh_known = self._mesh_env(mesh_expr, line)
+        in_axes, in_lit, in_node = self._parse_specs(in_expr, line)
+        out_axes, out_lit, out_node = self._parse_specs(out_expr, line)
+        body = (
+            self._resolve_body(body_expr, line) if body_expr is not None else None
+        )
+
+        # EM402: spec axis names vs a visible mesh construction.
+        if mesh_known:
+            for ax in sorted((in_axes | out_axes) - mesh_axes):
+                self._emit(
+                    "EM402", site,
+                    f"spec axis {ax!r} is not an axis of this shard_map's "
+                    f"mesh (mesh binds: {', '.join(sorted(mesh_axes)) or 'nothing'})"
+                    " — the program fails at trace time on every layout",
+                )
+
+        # EM402: in_specs arity vs body params and vs visible call sites.
+        if in_node[0] == "seq":
+            n_in = len(in_node[1])
+            bounds = _positional_param_bounds(body)
+            if bounds is not None and not (bounds[0] <= n_in <= bounds[1]):
+                required, total = bounds
+                takes = (
+                    f"{total}" if required == total
+                    else f"{required} to {total}"
+                )
+                self._emit(
+                    "EM402", site,
+                    f"in_specs carries {n_in} spec(s) but the body takes "
+                    f"{takes} positional parameter(s) — shard_map requires "
+                    "one spec per argument (specs are per-arg pytree prefixes)",
+                )
+            n_call = self._mapped_call_argcount(site)
+            if n_call is not None and n_call != n_in:
+                self._emit(
+                    "EM402", site,
+                    f"in_specs carries {n_in} spec(s) but the mapped function "
+                    f"is called with {n_call} argument(s) in this scope — the "
+                    "specs tuple visibly diverges from the arguments it must "
+                    "mirror",
+                )
+
+        # EM402: out_specs tuple arity vs the body's returned tuple.
+        if out_node[0] == "seq" and body is not None and not isinstance(body, ast.Lambda):
+            n_out = len(out_node[1])
+            for node in _own_statements(body):
+                if isinstance(node, ast.Return) and isinstance(node.value, ast.Tuple):
+                    n_ret = len(node.value.elts)
+                    if n_ret != n_out:
+                        self._emit(
+                            "EM402", site,
+                            f"out_specs carries {n_out} spec(s) but the body "
+                            f"returns {n_ret} value(s) (line {node.lineno})",
+                        )
+                    break
+
+        # Axis environment for EM401: the mesh when visible, else the spec
+        # axes when every spec is literal.
+        if mesh_known:
+            env, closed = mesh_axes, True
+        elif in_lit and out_lit:
+            env, closed = in_axes | out_axes, True
+        else:
+            env, closed = set(), False
+
+        if closed and body is not None:
+            self._walk_collectives(body, env, site, {}, frozenset(), 0)
+
+        if body is not None and in_node[0] == "seq":
+            self._check_unreduced(site, body, in_node[1], out_node, vma_off)
+
+    def _mapped_call_argcount(self, site: ast.Call) -> int | None:
+        """Argument count at visible call sites of the mapped function:
+        the immediate ``shard_map(...)(args)`` form, or calls of the name
+        the result is assigned to, in the same function."""
+        parent = self._parents.get(site)
+        if isinstance(parent, ast.Call) and parent.func is site:
+            if any(isinstance(a, ast.Starred) for a in parent.args):
+                return None
+            return len(parent.args)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1 and isinstance(
+            parent.targets[0], ast.Name
+        ):
+            target = parent.targets[0].id
+            fn = self._enclosing_fn(site.lineno)
+            scope = fn if fn is not None else self.tree
+            for node in ast.walk(scope):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == target
+                    and node.lineno > site.lineno
+                ):
+                    if any(isinstance(a, ast.Starred) for a in node.args):
+                        return None
+                    return len(node.args)
+        return None
+
+    # -- EM401 ---------------------------------------------------------------
+
+    def _collective_name(self, node: ast.Call) -> str | None:
+        d = _dotted(node.func)
+        if not d:
+            return None
+        resolved = self.aliases.resolve(d)
+        last = resolved.rsplit(".", 1)[-1]
+        if last not in _COLLECTIVES:
+            return None
+        if any(resolved.startswith(h) for h in _COLLECTIVE_HOMES):
+            return last
+        # Bare compat helpers (axis_size/pcast) keep their names everywhere.
+        if resolved == last and last in _COMPAT_BARE:
+            return last
+        return None
+
+    def _axis_names_from(self, expr: ast.AST | None,
+                         bindings: dict[str, str]) -> list[str] | None:
+        """Constant axis name(s) of a collective's axis argument, resolved
+        through constant-string parameter bindings. None = unresolvable."""
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return [expr.value]
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = []
+            for el in expr.elts:
+                sub = self._axis_names_from(el, bindings)
+                if sub is None:
+                    return None
+                out.extend(sub)
+            return out
+        if isinstance(expr, ast.Name) and expr.id in bindings:
+            return [bindings[expr.id]]
+        return None
+
+    def _walk_collectives(self, body, env: set[str], site: ast.Call,
+                          bindings: dict[str, str], stack: frozenset,
+                          depth: int) -> None:
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = self._collective_name(node)
+            if cname is not None:
+                pos, kwname = _COLLECTIVES[cname]
+                axis_expr = _call_arg(node, pos, kwname)
+                names = self._axis_names_from(axis_expr, bindings)
+                if names is None:
+                    continue
+                for ax in names:
+                    if ax not in env:
+                        self._emit(
+                            "EM401", node,
+                            f"{cname}(...) over axis {ax!r}, but the "
+                            f"enclosing shard_map (line {site.lineno}) binds "
+                            f"only {{{', '.join(sorted(env)) or ''}}} — an "
+                            "unbound collective axis fails at trace time on "
+                            "every layout",
+                        )
+                continue
+            # Descend into called helpers, binding constant-string args to
+            # their parameters (ring_attend_block(..., axis="sp")).
+            if depth >= _DESCENT_DEPTH or not isinstance(node.func, ast.Name):
+                continue
+            callee = self._find_def(node.func.id, near_line=node.lineno)
+            if callee is None or callee.name in stack or callee is body:
+                continue
+            new_bindings = _bind_string_args(callee, node, bindings)
+            self._walk_collectives(
+                callee, env, site, new_bindings, stack | {callee.name},
+                depth + 1,
+            )
+
+    # -- EM403 ---------------------------------------------------------------
+
+    def _check_unreduced(self, site: ast.Call, body, in_specs: list,
+                         out_node, vma_off: bool) -> None:
+        if isinstance(body, ast.Lambda):
+            return
+        params = [a.arg for a in (*body.args.posonlyargs, *body.args.args)]
+        if len(params) != len(in_specs):
+            return
+        spec_of: dict[str, list] = {}
+        for name, node in zip(params, in_specs):
+            if node[0] == "P":
+                spec_of[name] = node[1]
+        if not spec_of:
+            return
+        taint: dict[str, set[str]] = {}
+
+        def entry_axes(entry) -> set[str]:
+            if isinstance(entry, str) and entry != "?":
+                return {entry}
+            if isinstance(entry, tuple):
+                return set(entry)
+            return set()
+
+        def expr_taint(e: ast.AST) -> set[str]:
+            if isinstance(e, ast.Name):
+                return set(taint.get(e.id, set()))
+            if isinstance(e, ast.BinOp):
+                t = expr_taint(e.left) | expr_taint(e.right)
+                if isinstance(e.op, ast.MatMult):
+                    t |= _contraction_axes(
+                        spec_entries(e.left), spec_entries(e.right), entry_axes
+                    )
+                return t
+            if isinstance(e, ast.UnaryOp):
+                return expr_taint(e.operand)
+            if isinstance(e, ast.Call):
+                cname = self._collective_name(e)
+                if cname in _REDUCERS:
+                    base = expr_taint(e.args[0]) if e.args else set()
+                    pos, kwname = _COLLECTIVES[cname]
+                    names = self._axis_names_from(_call_arg(e, pos, kwname), {})
+                    if names is None:
+                        return set()  # unknown reduction: assume it covers
+                    return base - set(names)
+                d = _dotted(e.func)
+                last = self.aliases.resolve(d).rsplit(".", 1)[-1] if d else ""
+                t: set[str] = set()
+                for a in e.args:
+                    t |= expr_taint(a)
+                for kw in e.keywords:
+                    t |= expr_taint(kw.value)
+                if last in ("dot", "matmul") and len(e.args) >= 2:
+                    t |= _contraction_axes(
+                        spec_entries(e.args[0]), spec_entries(e.args[1]),
+                        entry_axes,
+                    )
+                elif last == "einsum" and len(e.args) >= 3 and isinstance(
+                    e.args[0], ast.Constant
+                ) and isinstance(e.args[0].value, str):
+                    t |= _einsum_contraction_axes(
+                        e.args[0].value,
+                        [spec_entries(a) for a in e.args[1:]],
+                        entry_axes,
+                    )
+                elif last == "dot_general" and len(e.args) >= 2:
+                    dims = _call_arg(e, 2, "dimension_numbers")
+                    t |= _dot_general_contraction_axes(
+                        dims, spec_entries(e.args[0]), spec_entries(e.args[1]),
+                        entry_axes,
+                    )
+                return t
+            if isinstance(e, (ast.Attribute, ast.Subscript, ast.Starred)):
+                return expr_taint(e.value)
+            if isinstance(e, (ast.Tuple, ast.List)):
+                t = set()
+                for el in e.elts:
+                    t |= expr_taint(el)
+                return t
+            return set()
+
+        def spec_entries(e: ast.AST) -> list | None:
+            if isinstance(e, ast.Name):
+                return spec_of.get(e.id)
+            return None
+
+        out_entries: list = []
+        if out_node[0] == "seq":
+            out_entries = out_node[1]
+
+        def out_axes_at(i: int) -> set[str] | None:
+            node = out_node if out_node[0] != "seq" else (
+                out_entries[i] if i < len(out_entries) else ("opaque",)
+            )
+            if node[0] != "P":
+                return None  # opaque out spec: cannot judge replication
+            axes: set[str] = set()
+            for entry in node[1]:
+                if entry == "?":
+                    return None
+                axes |= entry_axes(entry)
+            return axes
+
+        for stmt in _own_statements(body):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and isinstance(
+                stmt.targets[0], ast.Name
+            ):
+                name = stmt.targets[0].id
+                taint[name] = expr_taint(stmt.value)
+                src = spec_entries(stmt.value)
+                if src is not None:
+                    spec_of[name] = src
+            elif isinstance(stmt, ast.AugAssign) and isinstance(stmt.target, ast.Name):
+                name = stmt.target.id
+                taint[name] = taint.get(name, set()) | expr_taint(stmt.value)
+            elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                elts = (
+                    stmt.value.elts
+                    if isinstance(stmt.value, ast.Tuple)
+                    else [stmt.value]
+                )
+                for i, el in enumerate(elts):
+                    t = expr_taint(el)
+                    if not t:
+                        continue
+                    claimed = out_axes_at(i)
+                    if claimed is None:
+                        continue
+                    for ax in sorted(t - claimed):
+                        vma_note = (
+                            " (and this call site passes check_vma=False, "
+                            "so the trace-time replication checker is off)"
+                            if vma_off else ""
+                        )
+                        self._emit(
+                            "EM403", stmt,
+                            f"returned value is a PARTIAL sum over sharded "
+                            f"axis {ax!r} (contraction over an in_specs-"
+                            f"sharded dimension) but out_specs claims it "
+                            f"replicated — add lax.psum(..., {ax!r}) before "
+                            f"returning{vma_note}",
+                        )
+
+    # -- EM404 ---------------------------------------------------------------
+
+    def _rule_retrace(self, tree: ast.Module) -> None:
+        if not any(d in self.relpath for d in _EM404_DIRS):
+            return
+        jitted: set[str] = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.ImportFrom)
+                and node.module
+                and node.module.startswith("edgemesh.")
+            ):
+                for a in node.names:
+                    if (
+                        a.name.startswith(_EM404_IMPORT_PREFIXES)
+                        or a.name in _EM404_IMPORT_EXTRA
+                    ):
+                        jitted.add(a.asname or a.name)
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _is_jit_expr(node.value.func, self.aliases):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            jitted.add(t.id)
+        for fn in self._all_defs:
+            if any(_is_jit_expr(d, self.aliases) for d in fn.decorator_list):
+                jitted.add(fn.name)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_jit_call = (
+                isinstance(node.func, ast.Name) and node.func.id in jitted
+            ) or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr.endswith("_jit")
+            )
+            if not is_jit_call:
+                continue
+            values = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in values:
+                if self._em404_tainted(arg, node.lineno, frozenset()):
+                    self._emit(
+                        "EM404", node,
+                        "host-computed size (len()/.shape arithmetic) flows "
+                        "into a jitted call — every distinct value mints a "
+                        "compile-cache entry and the engine retraces under "
+                        "load; quantize it through "
+                        "utils.bucketing.bucket_pow2 (the blessed ladder)",
+                    )
+                    break
+
+    def _em404_tainted(self, expr: ast.AST, line: int,
+                       seen: frozenset) -> bool:
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            last = (self.aliases.resolve(d).rsplit(".", 1)[-1] if d else "")
+            if last in _BLESSED_BUCKETING:
+                return False  # sanitized: the ladder bounds the key space
+            if last == "len":
+                return True
+            if last in _TAINT_THROUGH:
+                return any(
+                    self._em404_tainted(a, line, seen) for a in expr.args
+                )
+            return False
+        if isinstance(expr, ast.Subscript):
+            if (
+                isinstance(expr.value, ast.Attribute)
+                and expr.value.attr == "shape"
+            ):
+                return True
+            return self._em404_tainted(expr.value, line, seen)
+        if isinstance(expr, ast.BinOp):
+            return self._em404_tainted(expr.left, line, seen) or (
+                self._em404_tainted(expr.right, line, seen)
+            )
+        if isinstance(expr, ast.UnaryOp):
+            return self._em404_tainted(expr.operand, line, seen)
+        if isinstance(expr, ast.Name):
+            if expr.id in seen:
+                return False
+            v = self._local_assign_value(expr.id, line)
+            if v is None:
+                return False
+            return self._em404_tainted(v, line, seen | {expr.id})
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Module-level helpers
+# ---------------------------------------------------------------------------
+
+
+def _call_arg(call: ast.Call, pos: int, kwname: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == kwname:
+            return kw.value
+    if pos < len(call.args) and not isinstance(call.args[pos], ast.Starred):
+        return call.args[pos]
+    return None
+
+
+def _str_constants(node: ast.AST | None) -> set[str] | None:
+    """All-string-constant tuple/list → the set of strings; else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: set[str] = set()
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.add(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _positional_param_bounds(body) -> tuple[int, int] | None:
+    """(required, total) positional parameter counts of a body — defaulted
+    parameters are optional, so any spec arity in that range is legal."""
+    if body is None:
+        return None
+    args = body.args
+    if args.vararg is not None:
+        return None
+    total = len(args.posonlyargs) + len(args.args)
+    return total - len(args.defaults), total
+
+
+def _own_statements(fn):
+    """fn's statements in source order, descending into compound statements
+    but NOT into nested function defs (those run on their own schedule)."""
+    stack = list(reversed(getattr(fn, "body", [])))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        for field in ("body", "orelse", "finalbody"):
+            stack.extend(reversed(getattr(node, field, [])))
+        for handler in getattr(node, "handlers", []):
+            stack.extend(reversed(handler.body))
+
+
+def _bind_string_args(callee, call: ast.Call,
+                      caller_bindings: dict[str, str]) -> dict[str, str]:
+    """Constant-string argument bindings for a callee: explicit args win,
+    string-constant defaults fill the rest (the ``axis: str = "sp"``
+    idiom)."""
+    params = [a.arg for a in (*callee.args.posonlyargs, *callee.args.args)]
+    bindings: dict[str, str] = {}
+    defaults = callee.args.defaults
+    if defaults:
+        for name, d in zip(params[len(params) - len(defaults):], defaults):
+            if isinstance(d, ast.Constant) and isinstance(d.value, str):
+                bindings[name] = d.value
+    for a, d in zip(callee.args.kwonlyargs, callee.args.kw_defaults):
+        if d is not None and isinstance(d, ast.Constant) and isinstance(d.value, str):
+            bindings[a.arg] = d.value
+
+    def value_of(expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name) and expr.id in caller_bindings:
+            return caller_bindings[expr.id]
+        return None
+
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(params):
+            v = value_of(arg)
+            if v is not None:
+                bindings[params[i]] = v
+    kwonly = {a.arg for a in callee.args.kwonlyargs}
+    for kw in call.keywords:
+        if kw.arg and (kw.arg in params or kw.arg in kwonly):
+            v = value_of(kw.value)
+            if v is not None:
+                bindings[kw.arg] = v
+    return bindings
+
+
+def _contraction_axes(lhs_entries, rhs_entries, entry_axes) -> set[str]:
+    """Mesh axes a matmul contracts over: the LHS's last dim and the RHS's
+    second-to-last dim (the batched-matmul convention)."""
+    axes: set[str] = set()
+    if lhs_entries:
+        axes |= entry_axes(lhs_entries[-1])
+    if rhs_entries and len(rhs_entries) >= 2:
+        axes |= entry_axes(rhs_entries[-2])
+    elif rhs_entries and len(rhs_entries) == 1:
+        axes |= entry_axes(rhs_entries[-1])  # vector RHS: its only dim
+    return axes
+
+
+def _einsum_contraction_axes(subscript: str, operand_entries,
+                             entry_axes) -> set[str]:
+    if "->" not in subscript or "." in subscript:
+        return set()
+    ins, out = subscript.replace(" ", "").split("->", 1)
+    in_subs = ins.split(",")
+    contracted = {c for sub in in_subs for c in sub if c not in out}
+    axes: set[str] = set()
+    for sub, entries in zip(in_subs, operand_entries):
+        if entries is None or len(entries) != len(sub):
+            continue
+        for pos, letter in enumerate(sub):
+            if letter in contracted:
+                axes |= entry_axes(entries[pos])
+    return axes
+
+
+def _dot_general_contraction_axes(dims: ast.AST | None, lhs_entries,
+                                  rhs_entries, entry_axes) -> set[str]:
+    """Literal ``dimension_numbers=(((lc,), (rc,)), ...)`` → the mesh axes
+    on the contracted dims of either operand's spec."""
+    if not isinstance(dims, (ast.Tuple, ast.List)) or not dims.elts:
+        return set()
+    contract = dims.elts[0]
+    if not isinstance(contract, (ast.Tuple, ast.List)) or len(contract.elts) != 2:
+        return set()
+
+    def int_list(node: ast.AST) -> list[int]:
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            return []
+        return [
+            el.value for el in node.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, int)
+        ]
+
+    axes: set[str] = set()
+    for idx_node, entries in ((contract.elts[0], lhs_entries),
+                              (contract.elts[1], rhs_entries)):
+        if entries is None:
+            continue
+        for i in int_list(idx_node):
+            if 0 <= i < len(entries):
+                axes |= entry_axes(entries[i])
+    return axes
+
+
+def analyze_source(source: str, path: str = "<memory>") -> list[Finding]:
+    """Sharding-pass entry point (mirrors concurrency.analyze_source)."""
+    return _FileSharding(path, source).run()
+
+
+# ---------------------------------------------------------------------------
+# Layer 2 — AbstractMesh dryrun contracts (EM405)
+# ---------------------------------------------------------------------------
+#
+# Each entry registers a public shard_map wrapper with the mesh layouts it
+# must trace under. Runners build tiny ABSTRACT arguments (jax.eval_shape
+# trees) and drive the wrapper's production construction path — the same
+# spec-building code the engines use — under jax.sharding.AbstractMesh, so
+# tp8 traces on a 1-CPU box with no devices. A runner returns a list of
+# problem strings (empty = green); raising is the finding.
+
+#: Named mesh layouts: axis (name, size) tuples for AbstractMesh.
+LAYOUTS: dict[str, tuple[tuple[str, int], ...]] = {
+    "tp2": (("dp", 1), ("tp", 2)),
+    "tp8": (("dp", 1), ("tp", 8)),
+    "dp2xtp4": (("dp", 2), ("tp", 4)),
+    "pp2": (("pp", 2),),
+    "sp2": (("sp", 2),),
+    "sp4": (("sp", 4),),
+    "4d": (("dp", 2), ("pp", 2), ("sp", 2), ("ep", 1), ("tp", 2)),
+}
+
+
+def _layout_str(name: str) -> str:
+    return "×".join(f"{ax}{n}" for ax, n in LAYOUTS[name] if n > 1) or "1"
+
+
+def _abstract_mesh(name: str):
+    from jax.sharding import AbstractMesh
+
+    return AbstractMesh(tuple(LAYOUTS[name]))
+
+
+def _dryrun_cfg(num_heads: int = 8, num_kv_heads: int = 8):
+    """Tiny abstract config whose heads/FFN divide every registered tp
+    degree (8 heads, 8 kv heads, 128 FFN → tp2/tp4/tp8 all divide)."""
+    from edgemesh.models.families import tiny_config
+
+    return tiny_config("llama").replace(
+        num_heads=num_heads, num_kv_heads=num_kv_heads, attention_impl="xla"
+    )
+
+
+def _abstract_params(cfg):
+    import jax
+
+    from edgemesh.models.transformer import init_params
+
+    return jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+
+
+def _dryrun_tp_infer(mesh) -> list[str]:
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.models.transformer import init_kv_cache
+    from edgemesh.parallel.tp_infer import make_tp_mapped, tp_param_specs
+
+    cfg = _dryrun_cfg()
+    params = _abstract_params(cfg)
+    specs = tp_param_specs(cfg, params, mesh)
+    b = 2 * mesh.shape["dp"]
+    max_seq = 16
+    cache = jax.eval_shape(lambda: init_kv_cache(cfg, b, max_seq))
+    lens = jax.ShapeDtypeStruct((b,), jnp.int32)
+    kvv = jax.ShapeDtypeStruct((b, max_seq), jnp.bool_)
+    problems: list[str] = []
+    for is_decode, s in ((False, 8), (True, 1)):
+        mapped = make_tp_mapped(cfg, mesh, specs, "xla", is_decode)
+        tokens = jax.ShapeDtypeStruct((b, s), jnp.int32)
+        pos = jax.ShapeDtypeStruct((b, s), jnp.int32)
+        logits, k, v = jax.eval_shape(
+            mapped, params, tokens, pos, kvv, cache.k, cache.v, lens
+        )
+        step = "decode" if is_decode else "prefill"
+        if logits.shape != (b, s, cfg.vocab_size):
+            problems.append(
+                f"{step} logits {logits.shape} != (batch, seq, vocab)"
+            )
+        if (k.shape, k.dtype) != (cache.k.shape, cache.k.dtype):
+            problems.append(
+                f"{step} cache avals drifted: {k.shape}/{k.dtype} vs "
+                f"{cache.k.shape}/{cache.k.dtype}"
+            )
+    return problems
+
+
+def _dryrun_seq_attention(mesh, attention_fn) -> list[str]:
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    sp = mesh.shape["sp"]
+    seq = 4 * sp
+    q = jax.ShapeDtypeStruct((1, seq, 4, 8), jnp.float32)
+    k = jax.ShapeDtypeStruct((1, seq, 2, 8), jnp.float32)
+    pos = jax.ShapeDtypeStruct((1, seq), jnp.int32)
+    valid = jax.ShapeDtypeStruct((1, seq), jnp.bool_)
+    out = jax.eval_shape(partial(attention_fn, mesh=mesh), q, k, k, pos, valid)
+    if out.shape != (1, seq, 4, 8):
+        return [f"output {out.shape} != q shape (1, {seq}, 4, 8)"]
+    return []
+
+
+def _dryrun_ring(mesh) -> list[str]:
+    from edgemesh.parallel.ring_attention import ring_attention
+
+    return _dryrun_seq_attention(mesh, ring_attention)
+
+
+def _dryrun_ulysses(mesh) -> list[str]:
+    from edgemesh.parallel.ulysses import ulysses_attention
+
+    return _dryrun_seq_attention(mesh, ulysses_attention)
+
+
+def _dryrun_pipeline(mesh) -> list[str]:
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_kv_cache
+    from edgemesh.parallel.pipeline import make_pipeline_mapped
+
+    cfg = tiny_config("llama").replace(attention_impl="xla")
+    num_micro, mbs, max_seq, s = 2, 1, 16, 8
+    b = num_micro * mbs
+    params = _abstract_params(cfg)
+    cache = jax.eval_shape(lambda: init_kv_cache(cfg, b, max_seq))
+    mapped = make_pipeline_mapped(cfg, mesh, num_micro, mbs, is_decode=False)
+    x = jax.ShapeDtypeStruct((num_micro, mbs, s, cfg.hidden_size), jnp.float32)
+    pos = jax.ShapeDtypeStruct((num_micro, mbs, s), jnp.int32)
+    kvv = jax.ShapeDtypeStruct((num_micro, mbs, max_seq), jnp.bool_)
+    lens = jax.ShapeDtypeStruct((num_micro, mbs), jnp.int32)
+    k, v, out = jax.eval_shape(
+        mapped, params["layers"], cache.k, cache.v, x, pos, kvv, lens
+    )
+    problems: list[str] = []
+    if out.shape != (num_micro, mbs, s, cfg.hidden_size):
+        problems.append(f"stage output {out.shape} != microbatched hidden")
+    if k.shape != cache.k.shape:
+        problems.append(f"cache avals drifted: {k.shape} vs {cache.k.shape}")
+    return problems
+
+
+def _dryrun_spmd(mesh) -> list[str]:
+    import jax
+    import jax.numpy as jnp
+
+    from edgemesh.models.families import tiny_config
+    from edgemesh.parallel.spmd import make_spmd_loss
+
+    cfg = tiny_config("llama")
+    params = _abstract_params(cfg)
+    loss_fn = make_spmd_loss(cfg, mesh, num_micro=2)
+    B = 2 * mesh.shape["dp"]
+    S = 4 * mesh.shape["sp"]
+    tokens = jax.ShapeDtypeStruct((B, S), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((B,), jnp.int32)
+    loss = jax.eval_shape(loss_fn, params, tokens, lengths)
+    if loss.shape != () or str(loss.dtype) != "float32":
+        return [f"loss aval {loss.shape}/{loss.dtype} != scalar float32"]
+    return []
+
+
+#: The registry: every public shard_map wrapper, with the layouts it must
+#: trace under. Adding a wrapper without registering it here leaves "does
+#: tp8 even trace" to the next hardware window — don't.
+SHARDING_CONTRACTS: list[dict] = [
+    {
+        "wrapper": "tp_infer",
+        "path": "edgemesh/parallel/tp_infer.py",
+        "layouts": ("tp2", "tp8", "dp2xtp4"),
+        "runner": _dryrun_tp_infer,
+    },
+    {
+        "wrapper": "ring_attention",
+        "path": "edgemesh/parallel/ring_attention.py",
+        "layouts": ("sp2", "sp4"),
+        "runner": _dryrun_ring,
+    },
+    {
+        "wrapper": "ulysses",
+        "path": "edgemesh/parallel/ulysses.py",
+        "layouts": ("sp2", "sp4"),
+        "runner": _dryrun_ulysses,
+    },
+    {
+        "wrapper": "pipeline",
+        "path": "edgemesh/parallel/pipeline.py",
+        "layouts": ("pp2",),
+        "runner": _dryrun_pipeline,
+    },
+    {
+        "wrapper": "spmd",
+        "path": "edgemesh/parallel/spmd.py",
+        "layouts": ("4d",),
+        "runner": _dryrun_spmd,
+    },
+]
+
+
+def run_sharding_contracts() -> list[Finding]:
+    """Trace every registered shard_map wrapper under its AbstractMesh
+    layouts; returns EM405 findings (empty = green). Degrades to an empty
+    run on jax builds without AbstractMesh — the AST layer still gates."""
+    try:
+        from jax.sharding import AbstractMesh  # noqa: F401
+    except ImportError:  # pragma: no cover — modern jax always has it
+        return []
+    findings: list[Finding] = []
+    for contract in SHARDING_CONTRACTS:
+        wrapper, path = contract["wrapper"], contract["path"]
+        for layout in contract["layouts"]:
+            mesh = _abstract_mesh(layout)
+            try:
+                problems = contract["runner"](mesh)
+            except Exception as e:  # noqa: BLE001 — a trace failure IS the finding
+                findings.append(Finding(
+                    "EM405", "error", path, 1,
+                    f"{wrapper} failed to trace under layout {layout} "
+                    f"({_layout_str(layout)}): {type(e).__name__}: {e}",
+                    context=wrapper,
+                ))
+                continue
+            for msg in problems:
+                findings.append(Finding(
+                    "EM405", "error", path, 1,
+                    f"{wrapper} under layout {layout} ({_layout_str(layout)}): {msg}",
+                    context=wrapper,
+                ))
+    return findings

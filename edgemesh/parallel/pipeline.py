@@ -153,6 +153,31 @@ def _stage_pipeline_fn(
     return fn
 
 
+def make_pipeline_mapped(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    num_micro: int,
+    mb_size: int,
+    is_decode: bool,
+):
+    """The engine's core shard_map program: GPipe fill-drain over the ``pp``
+    axis (layer blocks, per-stage KV, ppermute activation hops). Exposed at
+    module level so the sharding dryrun (analysis/sharding.py
+    SHARDING_CONTRACTS) traces the EXACT production spec set under an
+    ``AbstractMesh`` — no devices required."""
+    fn = _stage_pipeline_fn(cfg, mesh.shape["pp"], num_micro, mb_size, is_decode)
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P("pp"), P("pp"), P("pp"), P(), P(), P(), P()),
+        out_specs=(P("pp"), P("pp"), P()),
+        # pallas_call outputs don't carry varying-manual-axes types, so
+        # the vma checker rejects any stage body that runs the flash
+        # kernel; the pcast inits degrade to no-ops with it off.
+        check_vma=cfg.attention_impl != "flash",
+    )
+
+
 class PipelineEngine:
     """Pipelined model executor: prefill / decode / full-sequence forward.
 
@@ -222,17 +247,7 @@ class PipelineEngine:
         def to_mb(a):  # [B, ...] -> [M, mbs, ...]
             return a.reshape(num_micro, mbs, *a.shape[1:])
 
-        fn = _stage_pipeline_fn(cfg, self.pp, num_micro, mbs, is_decode)
-        mapped = shard_map(
-            fn,
-            mesh=self.mesh,
-            in_specs=(P("pp"), P("pp"), P("pp"), P(), P(), P(), P()),
-            out_specs=(P("pp"), P("pp"), P()),
-            # pallas_call outputs don't carry varying-manual-axes types, so
-            # the vma checker rejects any stage body that runs the flash
-            # kernel; the pcast inits degrade to no-ops with it off.
-            check_vma=cfg.attention_impl != "flash",
-        )
+        mapped = make_pipeline_mapped(cfg, self.mesh, num_micro, mbs, is_decode)
         k, v, out_mb = mapped(
             params["layers"], cache.k, cache.v,
             to_mb(x), to_mb(positions), to_mb(kv_valid), to_mb(cache.lengths),

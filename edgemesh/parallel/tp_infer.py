@@ -60,6 +60,130 @@ def _mlp_psum(cfg, layer, x):
     return lax.psum(y, "tp"), lax.pmean(aux, "tp")
 
 
+# ---------------------------------------------------------------------------
+# Module-level builders — the engine's construction path, exposed so the
+# sharding dryrun (analysis/sharding.py SHARDING_CONTRACTS) can trace the
+# EXACT production shard_map program under an AbstractMesh with no devices.
+# ---------------------------------------------------------------------------
+
+
+def tp_local_config(cfg: ModelConfig, tp: int, attention_impl: str) -> ModelConfig:
+    """The per-shard view: each chip runs a model with 1/tp of the heads
+    and FFN columns. All family dials (norms, parallel_block, rope) carry
+    over untouched."""
+    if cfg.num_heads % tp or cfg.num_kv_heads % tp or cfg.intermediate_size % tp:
+        raise ValueError(
+            f"heads {cfg.num_heads}/{cfg.num_kv_heads} and FFN "
+            f"{cfg.intermediate_size} must divide tp={tp}"
+        )
+    return cfg.replace(
+        num_heads=cfg.num_heads // tp,
+        num_kv_heads=cfg.num_kv_heads // tp,
+        intermediate_size=cfg.intermediate_size // tp,
+        head_dim=cfg.head_size,
+        attention_impl=attention_impl,
+    )
+
+
+def tp_cache_specs() -> KVCache:
+    """KV cache PartitionSpecs for the tp engine: batch over dp, kv heads
+    over tp ([L, batch, max_seq, kv_heads, head_dim])."""
+    return KVCache(
+        k=P(None, "dp", None, "tp", None),
+        v=P(None, "dp", None, "tp", None),
+        lengths=P("dp"),
+    )
+
+
+def tp_param_specs(cfg: ModelConfig, params: Params, mesh: Mesh) -> Params:
+    """in_specs mirroring the param pytree EXACTLY (shard_map requires it) —
+    prune spec-only keys (e.g. the optional SmoothQuant "smooth" leaf when
+    smoothing was skipped) and replicate any param key without a spec.
+
+    Works on abstract params (``jax.eval_shape`` trees) too: only shapes
+    and key sets are consulted, so the sharding dryrun shares this path.
+    """
+    tp = mesh.shape["tp"]
+    specs = param_pspecs(cfg, mesh)
+    if is_quantized(params):
+        specs = quantized_pspecs(specs)
+    # This engine keeps the LM head replicated: sampling needs the full
+    # vocab row, and the [b, vocab] gather is cheap next to resharding
+    # logits out of a vocab split every step.
+    if "lm_head" in specs:
+        specs["lm_head"] = jax.tree.map(
+            lambda s: P(*([None] * len(s))), specs["lm_head"],
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    # Grouped int4 scales ([L, G, out], one rank above int8's) take the
+    # scales4 spec so the G axis follows the kernel's in-dim sharding —
+    # the per-shard group_size stays correct inside shard_map.
+    from edgemesh.parallel.sharding import pick_grouped_scales_spec
+
+    def align(p_node, s_node):
+        if isinstance(p_node, dict):
+            s_dict = s_node if isinstance(s_node, dict) else {}
+            out = {}
+            for k, v in p_node.items():
+                s = s_dict.get(k)
+                if (
+                    k == "scales"
+                    and isinstance(s, P)
+                    and getattr(v, "ndim", 0) == len(s) + 1
+                ):
+                    s, used4 = pick_grouped_scales_spec(s_dict, v, mesh)
+                    kernel_spec = s_dict.get("kernel_q4", P())
+                    in_sharded = len(kernel_spec) >= 2 and kernel_spec[-2] is not None
+                    if not used4 and in_sharded and v.shape[-2] > 1:
+                        # This engine computes per-shard: a row-sharded
+                        # packed kernel with replicated grouped scales
+                        # would miscompute the local group_size.
+                        raise ValueError(
+                            f"int4 group count {v.shape[-2]} does not divide "
+                            f"tp={tp}; use a group_size giving G % tp == 0 "
+                            "or per-channel scales (group_size=0)"
+                        )
+                out[k] = align(v, s)
+            return out
+        return s_node if isinstance(s_node, P) else P()
+
+    return align(params, specs)
+
+
+def make_tp_mapped(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    param_specs: Params,
+    attention_impl: str,
+    is_decode: bool,
+):
+    """The engine's core shard_map program: per-shard ``_forward`` with
+    psum-joined attention/MLP outputs. Callable under ``jax.eval_shape``
+    with an ``AbstractMesh`` — no devices required."""
+    lcfg = tp_local_config(cfg, mesh.shape["tp"], attention_impl)
+    cache_spec = tp_cache_specs()
+
+    def local(params, tokens, positions, kv_valid, k, v, lengths):
+        cache = KVCache(k, v, lengths)
+        logits, new_cache, _ = _forward(
+            lcfg, params, tokens, positions, cache, kv_valid, is_decode,
+            attention=_attention_psum, mlp=_mlp_psum,
+        )
+        return logits, new_cache.k, new_cache.v
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            param_specs, P("dp", None), P("dp", None), P("dp", None),
+            cache_spec.k, cache_spec.v, P("dp"),
+        ),
+        out_specs=(P("dp", None, None), cache_spec.k, cache_spec.v),
+        check_vma=False,
+    )
+
+
 class TPInferenceEngine:
     """Head/column-sharded single-model executor over a ``dp x tp`` mesh.
 
@@ -77,90 +201,23 @@ class TPInferenceEngine:
         mesh: Mesh,
         attention_impl: str | None = None,
     ):
-        tp = mesh.shape["tp"]
-        if cfg.num_heads % tp or cfg.num_kv_heads % tp or cfg.intermediate_size % tp:
-            raise ValueError(
-                f"heads {cfg.num_heads}/{cfg.num_kv_heads} and FFN "
-                f"{cfg.intermediate_size} must divide tp={tp}"
-            )
         if attention_impl is None:
             attention_impl = (
                 "flash" if on_tpu() else cfg.attention_impl
             )
+        tp = mesh.shape["tp"]
         self.cfg = cfg
         self.mesh = mesh
         self.tp = tp
-        # The per-shard view: each chip runs a model with 1/tp of the heads
-        # and FFN columns. All family dials (norms, parallel_block, rope)
-        # carry over untouched.
-        self.lcfg = cfg.replace(
-            num_heads=cfg.num_heads // tp,
-            num_kv_heads=cfg.num_kv_heads // tp,
-            intermediate_size=cfg.intermediate_size // tp,
-            head_dim=cfg.head_size,
-            attention_impl=attention_impl,
-        )
-        self.param_specs = self._specs(params)
+        self.lcfg = tp_local_config(cfg, tp, attention_impl)
+        self.attention_impl = attention_impl
+        self.param_specs = tp_param_specs(cfg, params, mesh)
         self.params = self._place(params)
-        self.cache_spec = KVCache(
-            k=P(None, "dp", None, "tp", None),
-            v=P(None, "dp", None, "tp", None),
-            lengths=P("dp"),
-        )
+        self.cache_spec = tp_cache_specs()
         self._prefill_jit = jax.jit(self._make_step(is_decode=False))
         self._decode_jit = jax.jit(self._make_step(is_decode=True))
 
     # -- placement ---------------------------------------------------------
-
-    def _specs(self, params: Params) -> Params:
-        specs = param_pspecs(self.cfg, self.mesh)
-        if is_quantized(params):
-            specs = quantized_pspecs(specs)
-        # This engine keeps the LM head replicated: sampling needs the full
-        # vocab row, and the [b, vocab] gather is cheap next to resharding
-        # logits out of a vocab split every step.
-        if "lm_head" in specs:
-            specs["lm_head"] = jax.tree.map(
-                lambda s: P(*([None] * len(s))), specs["lm_head"],
-                is_leaf=lambda x: isinstance(x, P),
-            )
-
-        # shard_map in_specs must mirror the param pytree EXACTLY — prune
-        # spec-only keys (e.g. the optional SmoothQuant "smooth" leaf when
-        # smoothing was skipped) and replicate any param key without a spec.
-        # Grouped int4 scales ([L, G, out], one rank above int8's) take the
-        # scales4 spec so the G axis follows the kernel's in-dim sharding —
-        # the per-shard group_size stays correct inside shard_map.
-        from edgemesh.parallel.sharding import pick_grouped_scales_spec
-
-        def align(p_node, s_node):
-            if isinstance(p_node, dict):
-                s_dict = s_node if isinstance(s_node, dict) else {}
-                out = {}
-                for k, v in p_node.items():
-                    s = s_dict.get(k)
-                    if (
-                        k == "scales"
-                        and isinstance(s, P)
-                        and getattr(v, "ndim", 0) == len(s) + 1
-                    ):
-                        s, used4 = pick_grouped_scales_spec(s_dict, v, self.mesh)
-                        kernel_spec = s_dict.get("kernel_q4", P())
-                        in_sharded = len(kernel_spec) >= 2 and kernel_spec[-2] is not None
-                        if not used4 and in_sharded and v.shape[-2] > 1:
-                            # This engine computes per-shard: a row-sharded
-                            # packed kernel with replicated grouped scales
-                            # would miscompute the local group_size.
-                            raise ValueError(
-                                f"int4 group count {v.shape[-2]} does not divide "
-                                f"tp={self.tp}; use a group_size giving G % tp == 0 "
-                                "or per-channel scales (group_size=0)"
-                            )
-                    out[k] = align(v, s)
-                return out
-            return s_node if isinstance(s_node, P) else P()
-
-        return align(params, specs)
 
     def _place(self, params: Params) -> Params:
         tp = self.tp
@@ -203,25 +260,9 @@ class TPInferenceEngine:
     # -- compiled steps ----------------------------------------------------
 
     def _make_step(self, is_decode: bool):
-        lcfg = self.lcfg
-
-        def local(params, tokens, positions, kv_valid, k, v, lengths):
-            cache = KVCache(k, v, lengths)
-            logits, new_cache, _ = _forward(
-                lcfg, params, tokens, positions, cache, kv_valid, is_decode,
-                attention=_attention_psum, mlp=_mlp_psum,
-            )
-            return logits, new_cache.k, new_cache.v
-
-        mapped = shard_map(
-            local,
-            mesh=self.mesh,
-            in_specs=(
-                self.param_specs, P("dp", None), P("dp", None), P("dp", None),
-                self.cache_spec.k, self.cache_spec.v, P("dp"),
-            ),
-            out_specs=(P("dp", None, None), self.cache_spec.k, self.cache_spec.v),
-            check_vma=False,
+        mapped = make_tp_mapped(
+            self.cfg, self.mesh, self.param_specs, self.attention_impl,
+            is_decode,
         )
 
         if is_decode:

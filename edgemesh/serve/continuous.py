@@ -91,6 +91,7 @@ from edgemesh.runtime.paged_generate import (
     forward_ragged_paged,
 )
 from edgemesh.runtime.paged_kv import init_paged_cache, init_quant_paged_cache
+from edgemesh.utils.bucketing import POW2_FLOOR, bucket_pow2
 
 log = logging.getLogger("edgemesh.serve")
 
@@ -846,10 +847,7 @@ class ContinuousEngine:
         — ONE compile reused every segment — and admission waves climb a
         doubling ladder from there, so compile variants stay O(log(slots ×
         prompt bucket)) instead of one per admission count."""
-        cap = self.n_slots
-        while cap < need:
-            cap *= 2
-        return cap
+        return bucket_pow2(need, floor=self.n_slots)
 
     def _dispatch_boundary(self) -> None:
         """Queue the ragged boundary launch: ONE forward_ragged_paged over
@@ -873,10 +871,7 @@ class ContinuousEngine:
         # (cap, s_cap) compile key space stays small.
         s_cap = 1
         for r in staged.values():
-            s = 16
-            while s < len(r.ids):
-                s *= 2
-            s_cap = max(s_cap, s)
+            s_cap = max(s_cap, bucket_pow2(len(r.ids), floor=POW2_FLOOR))
         base = np.zeros((cap,), np.int32)
         dec_mask = np.zeros((cap,), bool)
         dec_slot = np.zeros((cap,), np.int32)

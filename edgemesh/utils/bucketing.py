@@ -1,0 +1,44 @@
+"""Blessed shape-bucketing vocabulary for host→jit boundaries.
+
+Every host-computed integer that becomes a SHAPE inside a jitted program
+(a static argnum, a pad target, a packed-token capacity) keys a compile
+cache entry. Passing the raw value — ``len(prompt)``, ``cu[-1]``,
+``tokens.shape[1] + k`` — mints one compiled program per distinct value,
+and the serving engine pays a multi-second retrace exactly when it is
+busiest (a new prompt length arrives under load). The fix is always the
+same: quantize the value onto a small ladder so the compile-key space is
+O(log(max)) instead of O(distinct values).
+
+This module is that ladder — extracted from the ``s_cap`` power-of-two
+bucketing the continuous engine's ragged boundary launch converged on
+(serve/continuous.py), so every future host→jit seam spells it the same
+way. The static analyzer's EM404 rule (analysis/sharding.py) recognizes
+these helpers as sanitizers: a host-computed size flowing into a jitted
+call in serve//runtime/ must pass through one of them.
+"""
+
+from __future__ import annotations
+
+__all__ = ["bucket_pow2", "POW2_FLOOR"]
+
+#: Default smallest bucket: small enough that short prompts don't pay a
+#: large pad, large enough that the ladder has few rungs below typical
+#: prompt lengths (16 → 9 rungs to 4096).
+POW2_FLOOR = 16
+
+
+def bucket_pow2(n: int, floor: int = POW2_FLOOR) -> int:
+    """Round ``n`` up onto the doubling ladder anchored at ``floor``.
+
+    Returns the smallest ``floor * 2**k`` (k >= 0) that is >= ``n`` — the
+    compile-key ladder for shape-determining host ints. ``floor`` itself
+    need not be a power of two: the decode-only ragged boundary anchors
+    its ladder at ``n_slots`` so the steady state is exactly ONE compiled
+    program (cap == n_slots), and admission waves climb doublings of it.
+    """
+    if floor <= 0:
+        raise ValueError(f"bucket_pow2 floor must be positive, got {floor}")
+    cap = floor
+    while cap < n:
+        cap *= 2
+    return cap

@@ -31,10 +31,13 @@ def test_em101_fires_on_experimental_shard_map_import():
 
 
 def test_em101_fires_on_module_form_and_new_spelling():
+    # (in_specs carries one spec for the lambda's one arg — the sharding
+    # pass rightly flags a 0-vs-1 arity divergence as EM402 otherwise.)
     src = (
         "import jax\n"
         "import jax.experimental.maps\n"
-        "f = jax.shard_map(lambda x: x, mesh=None, in_specs=(), out_specs=())\n"
+        "f = jax.shard_map(lambda x: x, mesh=None, in_specs=(None,),\n"
+        "                  out_specs=None)\n"
     )
     findings = lint_source(src, path="edgemesh/parallel/x.py")
     assert [f.rule for f in findings] == ["EM101", "EM101"]
@@ -981,14 +984,44 @@ def test_cli_whole_package_gate_is_green():
     assert "clean" in proc.stdout
 
 
-def test_every_rule_has_metadata():
+def _all_rule_tables():
     from edgemesh.analysis.concurrency import RULES as CONCURRENCY_RULES
     from edgemesh.analysis.contracts import CONTRACT_RULES
+    from edgemesh.analysis.sharding import RULES as SHARDING_RULES
+    from edgemesh.analysis.sharding import SHARDING_CONTRACT_RULES
 
-    for table in (RULES, CONTRACT_RULES, CONCURRENCY_RULES):
+    return (RULES, CONTRACT_RULES, CONCURRENCY_RULES, SHARDING_RULES,
+            SHARDING_CONTRACT_RULES)
+
+
+def test_every_rule_has_metadata_and_unique_id():
+    # One namespace across EVERY pass: a rule id claimed twice would make
+    # baselines, --select filters, and disable comments ambiguous.
+    seen: dict[str, str] = {}
+    for table in _all_rule_tables():
         for rule, meta in table.items():
+            assert rule not in seen, f"{rule} defined in two rule tables"
+            seen[rule] = meta["name"]
             assert meta["severity"] in ("error", "warning"), rule
             assert meta["name"] and meta["summary"], rule
+
+
+def test_every_rule_documented_in_analysis_md():
+    # docs/ANALYSIS.md is the operator-facing contract: every shipped rule
+    # id must have a table row there (catches doc drift for all future
+    # rules, not just the latest pass), and the doc must not advertise
+    # rules that no longer ship.
+    import re
+    from pathlib import Path
+
+    doc = (Path(__file__).resolve().parent.parent / "docs" / "ANALYSIS.md"
+           ).read_text()
+    documented = set(re.findall(r"^\|\s*(EM\d{3})\s*\|", doc, re.MULTILINE))
+    shipped = {rule for table in _all_rule_tables() for rule in table}
+    missing = shipped - documented
+    assert not missing, f"rules missing a docs/ANALYSIS.md row: {sorted(missing)}"
+    phantom = documented - shipped
+    assert not phantom, f"docs/ANALYSIS.md rows for unshipped rules: {sorted(phantom)}"
 
 
 def test_em112_provenance_follows_source_order_not_walk_order():

@@ -1,0 +1,189 @@
+"""utils/compat.py drift shims: exercise BOTH sides of every shim via
+monkeypatched signatures, so the branch this jax doesn't take is still
+tested (the pre-drift branches were untested before — a compat bug on the
+other side of a drift would ship silently and resurface as the seed's
+seven ring-attention failures).
+
+No devices and no tracing: every fake captures its kwargs and returns a
+sentinel; what's under test is the SHIM's dispatch — which spelling it
+calls and how it maps the ``check_vma``/``check_rep`` kwarg."""
+
+import inspect
+
+import jax
+
+from edgemesh.utils import compat
+
+
+# ---------------------------------------------------------------------------
+# shard_map: jax.shard_map (check_vma) / jax.shard_map (check_rep) /
+# jax.experimental.shard_map (check_rep) — three drift states
+# ---------------------------------------------------------------------------
+
+
+def test_shard_map_modern_spelling_with_check_vma(monkeypatch):
+    calls = {}
+
+    def fake_sm(f, *, mesh, in_specs, out_specs, check_vma=True):
+        calls.update(f=f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=check_vma)
+        return "mapped"
+
+    monkeypatch.setattr(compat.jax, "shard_map", fake_sm, raising=False)
+    out = compat.shard_map(abs, mesh="m", in_specs=(1,), out_specs=(2,),
+                           check_vma=False)
+    assert out == "mapped"
+    assert calls["check_vma"] is False and calls["mesh"] == "m"
+    assert calls["f"] is abs
+
+
+def test_shard_map_modern_spelling_with_check_rep_keying(monkeypatch):
+    # The move to jax.shard_map and the kwarg rename were SEPARATE drift
+    # events: a jax.shard_map whose signature still says check_rep must be
+    # called with check_rep — keyed on the signature, not the location.
+    calls = {}
+
+    def fake_sm(f, *, mesh, in_specs, out_specs, check_rep=True):
+        calls.update(check_rep=check_rep)
+        return "mapped"
+
+    assert "check_rep" in inspect.signature(fake_sm).parameters
+    monkeypatch.setattr(compat.jax, "shard_map", fake_sm, raising=False)
+    out = compat.shard_map(abs, mesh="m", in_specs=(), out_specs=(),
+                           check_vma=False)
+    assert out == "mapped" and calls["check_rep"] is False
+
+
+def test_shard_map_experimental_fallback_maps_to_check_rep(monkeypatch):
+    # Pre-drift jax: no jax.shard_map at all — the shim falls through to
+    # the experimental module, mapping check_vma onto check_rep.
+    import jax.experimental.shard_map as exp_mod
+
+    calls = {}
+
+    def fake_sm(f, *, mesh, in_specs, out_specs, check_rep=True):
+        calls.update(check_rep=check_rep)
+        return "exp-mapped"
+
+    monkeypatch.setattr(compat.jax, "shard_map", None, raising=False)
+    monkeypatch.setattr(exp_mod, "shard_map", fake_sm)
+    out = compat.shard_map(abs, mesh="m", in_specs=(), out_specs=(),
+                           check_vma=False)
+    assert out == "exp-mapped" and calls["check_rep"] is False
+    # Default check_vma=True flows through as check_rep=True.
+    compat.shard_map(abs, mesh="m", in_specs=(), out_specs=())
+    assert calls["check_rep"] is True
+
+
+# ---------------------------------------------------------------------------
+# axis_size: lax.axis_size / axis-env fallback
+# ---------------------------------------------------------------------------
+
+
+def test_axis_size_modern_spelling(monkeypatch):
+    calls = {}
+
+    def fake_axis_size(name):
+        calls["name"] = name
+        return 8
+
+    monkeypatch.setattr(compat.lax, "axis_size", fake_axis_size,
+                        raising=False)
+    assert compat.axis_size("tp") == 8
+    assert calls["name"] == "tp"
+
+
+def test_axis_size_axis_env_fallback(monkeypatch):
+    import jax._src.core as core
+
+    class _Env:
+        def axis_size(self, name):
+            assert name == "sp"
+            return 4
+
+    monkeypatch.setattr(compat.lax, "axis_size", None, raising=False)
+    monkeypatch.setattr(core, "get_axis_env", lambda: _Env(), raising=False)
+    assert compat.axis_size("sp") == 4
+
+
+# ---------------------------------------------------------------------------
+# pcast: lax.pcast / pre-vma identity
+# ---------------------------------------------------------------------------
+
+
+def test_pcast_modern_spelling(monkeypatch):
+    calls = {}
+
+    def fake_pcast(x, axis_name, *, to):
+        calls.update(axis_name=axis_name, to=to)
+        return ("cast", x)
+
+    monkeypatch.setattr(compat.lax, "pcast", fake_pcast, raising=False)
+    out = compat.pcast(3, "sp", to="varying")
+    assert out == ("cast", 3)
+    assert calls == {"axis_name": "sp", "to": "varying"}
+
+
+def test_pcast_pre_vma_identity(monkeypatch):
+    # No vma type system → no cast exists; the identity must be EXACT
+    # (the enclosing check_rep machinery tracks replication on its own).
+    monkeypatch.setattr(compat.lax, "pcast", None, raising=False)
+    sentinel = object()
+    assert compat.pcast(sentinel, "sp") is sentinel
+
+
+# ---------------------------------------------------------------------------
+# register_compile_event_listener: present / kwarg-growing / absent
+# ---------------------------------------------------------------------------
+
+
+class _FakeMonitoring:
+    def __init__(self):
+        self.listener = None
+
+    def register_event_duration_secs_listener(self, fn):
+        self.listener = fn
+
+
+def test_compile_listener_adapter_swallows_new_kwargs(monkeypatch):
+    fake = _FakeMonitoring()
+    monkeypatch.setattr(compat.jax, "monitoring", fake, raising=False)
+    seen = []
+    assert compat.register_compile_event_listener(
+        lambda name, dur: seen.append((name, dur))
+    ) is True
+    # Newer jax passes extra keyword metadata — the adapter must drop it.
+    fake.listener("/jax/core/compile/backend_compile", 1.5, extra="meta")
+    assert seen == [("/jax/core/compile/backend_compile", 1.5)]
+
+
+def test_compile_listener_degrades_without_monitoring(monkeypatch):
+    monkeypatch.setattr(compat.jax, "monitoring", None, raising=False)
+    assert compat.register_compile_event_listener(lambda n, d: None) is False
+
+
+def test_compile_listener_degrades_without_register_hook(monkeypatch):
+    class _NoHook:
+        pass
+
+    monkeypatch.setattr(compat.jax, "monitoring", _NoHook(), raising=False)
+    assert compat.register_compile_event_listener(lambda n, d: None) is False
+
+
+# ---------------------------------------------------------------------------
+# The shims against the REAL installed jax (whichever side of each drift
+# it is on): shard_map must build a runnable program end to end.
+# ---------------------------------------------------------------------------
+
+
+def test_shard_map_real_jax_traces():
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    mapped = compat.shard_map(
+        lambda x: x * 2, mesh=mesh, in_specs=(P(),), out_specs=P()
+    )
+    out = jax.eval_shape(mapped, jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert out.shape == (4,)

@@ -1,0 +1,532 @@
+"""edgemesh.analysis.sharding: the EM401-EM404 AST rules (positive AND
+negative fixtures per rule — seeded bugs must flag, correct code must not),
+the SHARDING_CONTRACTS AbstractMesh dryrun (EM405), the shipped tree's
+EM4xx-cleanliness, and the --select/--ignore CLI filtering. Fast tier — the
+dryrun is eval_shape-only (no device programs compiled)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from edgemesh.analysis.edgelint import lint_source
+
+_PKG = Path(__file__).resolve().parent.parent / "edgemesh"
+
+
+def em4(findings):
+    return [f for f in findings if f.rule.startswith("EM4")]
+
+
+# ---------------------------------------------------------------------------
+# EM401 unbound-collective-axis
+# ---------------------------------------------------------------------------
+
+_EM401_SRC = (
+    "from jax import lax\n"
+    "from jax.sharding import Mesh, PartitionSpec as P\n"
+    "from edgemesh.utils.compat import shard_map\n"
+    "def wrap(x, devices):\n"
+    "    mesh = Mesh(devices, ('sp',))\n"
+    "    def body(xb):\n"
+    "        return lax.psum(xb, 'tp')\n"
+    "    return shard_map(body, mesh=mesh, in_specs=(P('sp'),),\n"
+    "                     out_specs=P())(x)\n"
+)
+
+
+def test_em401_fires_on_unbound_axis_with_visible_mesh():
+    findings = em4(lint_source(_EM401_SRC, path="edgemesh/parallel/x.py"))
+    assert [f.rule for f in findings] == ["EM401"]
+    assert findings[0].severity == "error"
+    assert "'tp'" in findings[0].message and "sp" in findings[0].message
+    # The message points back at the shard_map call site.
+    assert "line 8" in findings[0].message
+
+
+def test_em401_quiet_when_axis_bound():
+    ok = _EM401_SRC.replace("lax.psum(xb, 'tp')", "lax.psum(xb, 'sp')")
+    assert em4(lint_source(ok, path="edgemesh/parallel/x.py")) == []
+
+
+def test_em401_spec_derived_env_and_helper_descent():
+    # Mesh opaque (a parameter) but every spec literal: the spec axes stand
+    # in for the environment. The collective hides inside a helper whose
+    # axis parameter DEFAULTS to the wrong name — the descent binds it.
+    src = (
+        "from jax import lax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from edgemesh.utils.compat import shard_map\n"
+        "def helper(xb, axis='tp'):\n"
+        "    return lax.all_gather(xb, axis, axis=0, tiled=True)\n"
+        "def wrap(x, mesh):\n"
+        "    def body(xb):\n"
+        "        return helper(xb)\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P('sp'),),\n"
+        "                     out_specs=P('sp'))(x)\n"
+    )
+    findings = em4(lint_source(src, path="edgemesh/parallel/x.py"))
+    assert [f.rule for f in findings] == ["EM401"]
+    # A constant-string call argument rebinding the axis to a bound name
+    # silences it (ring_attend_block(..., axis='sp') style).
+    ok = src.replace("return helper(xb)", "return helper(xb, axis='sp')")
+    assert em4(lint_source(ok, path="edgemesh/parallel/x.py")) == []
+
+
+def test_em401_factory_body_and_scan_nested_collectives():
+    # The pipeline shape: shard_map's body comes from a factory, and the
+    # collective sits inside a def nested in it (a lax.scan body).
+    src = (
+        "from jax import lax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from edgemesh.utils.compat import shard_map\n"
+        "def _make_stage(n):\n"
+        "    def fn(xb):\n"
+        "        def step(c, t):\n"
+        "            return lax.ppermute(c, 'pp', [(0, 1)]), None\n"
+        "        out, _ = lax.scan(step, xb, None, length=n)\n"
+        "        return lax.psum(out, 'ep')\n"
+        "    return fn\n"
+        "def wrap(x, mesh, n):\n"
+        "    fn = _make_stage(n)\n"
+        "    mapped = shard_map(fn, mesh=mesh, in_specs=(P('pp'),),\n"
+        "                       out_specs=P())\n"
+        "    return mapped(x)\n"
+    )
+    findings = em4(lint_source(src, path="edgemesh/parallel/x.py"))
+    # ppermute over 'pp' is bound (spec-derived env); psum over 'ep' is not.
+    assert [f.rule for f in findings] == ["EM401"]
+    assert "'ep'" in findings[0].message
+
+
+def test_em401_open_environment_is_not_judged():
+    # Mesh opaque AND a spec opaque (built by a call): the pass cannot
+    # prove unboundness, so it stays silent — tp_infer/spmd's shape.
+    src = (
+        "from jax import lax\n"
+        "from edgemesh.utils.compat import shard_map\n"
+        "def wrap(x, mesh, make_specs):\n"
+        "    def body(xb):\n"
+        "        return lax.psum(xb, 'tp')\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(make_specs(),),\n"
+        "                     out_specs=None)(x)\n"
+    )
+    assert em4(lint_source(src, path="edgemesh/parallel/x.py")) == []
+
+
+def test_em401_disable_comment_suppresses():
+    quiet = _EM401_SRC.replace(
+        "        return lax.psum(xb, 'tp')",
+        "        return lax.psum(xb, 'tp')  # edgelint: disable=EM401",
+    )
+    assert em4(lint_source(quiet, path="edgemesh/parallel/x.py")) == []
+
+
+# ---------------------------------------------------------------------------
+# EM402 shard-spec-mismatch
+# ---------------------------------------------------------------------------
+
+_EM402_SRC = (
+    "from jax.sharding import Mesh, PartitionSpec as P\n"
+    "from edgemesh.utils.compat import shard_map\n"
+    "def wrap(x, y, devices):\n"
+    "    mesh = Mesh(devices, ('tp',))\n"
+    "    def body(xb, yb):\n"
+    "        return xb\n"
+    "    return shard_map(body, mesh=mesh, in_specs=(P('tp'),),\n"
+    "                     out_specs=P('sp'))(x, y)\n"
+)
+
+
+def test_em402_fires_on_arity_and_mesh_axis_mismatches():
+    findings = em4(lint_source(_EM402_SRC, path="edgemesh/parallel/x.py"))
+    assert {f.rule for f in findings} == {"EM402"}
+    msgs = "\n".join(f.message for f in findings)
+    # All three divergences: spec axis absent from the mesh, body arity,
+    # and call-site arity.
+    assert "'sp' is not an axis" in msgs
+    assert "2 positional parameter(s)" in msgs
+    assert "called with 2 argument(s)" in msgs
+
+
+def test_em402_defaulted_body_params_are_optional():
+    # A body parameter with a default is legally uncovered by in_specs
+    # (shard_map fills it from the default) — must not flag.
+    src = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "from edgemesh.utils.compat import shard_map\n"
+        "def wrap(x, mesh):\n"
+        "    def body(xb, eps=1e-6):\n"
+        "        return xb\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P('tp'),),\n"
+        "                     out_specs=P('tp'))(x)\n"
+    )
+    assert em4(lint_source(src, path="edgemesh/parallel/x.py")) == []
+    # Fewer specs than even the REQUIRED params still flags.
+    short = src.replace("def body(xb, eps=1e-6):", "def body(xb, yb, eps=1e-6):")
+    findings = em4(lint_source(short, path="edgemesh/parallel/x.py"))
+    assert any("2 to 3 positional" in f.message for f in findings)
+
+
+def test_em402_quiet_when_specs_body_and_call_agree():
+    ok = (
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "from edgemesh.utils.compat import shard_map\n"
+        "def wrap(x, y, devices):\n"
+        "    mesh = Mesh(devices, ('tp',))\n"
+        "    def body(xb, yb):\n"
+        "        return xb, yb\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P('tp'), P('tp')),\n"
+        "                     out_specs=(P('tp'), P('tp')))(x, y)\n"
+    )
+    assert em4(lint_source(ok, path="edgemesh/parallel/x.py")) == []
+
+
+def test_em402_out_specs_tuple_vs_returned_tuple():
+    src = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "from edgemesh.utils.compat import shard_map\n"
+        "def wrap(x, mesh):\n"
+        "    def body(xb):\n"
+        "        return xb, xb, xb\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P('tp'),),\n"
+        "                     out_specs=(P('tp'), P('tp')))(x)\n"
+    )
+    findings = em4(lint_source(src, path="edgemesh/parallel/x.py"))
+    assert any("returns 3 value(s)" in f.message for f in findings)
+    # A single (non-tuple) out spec is a pytree PREFIX — never an arity
+    # finding, whatever the body returns.
+    prefix = src.replace("out_specs=(P('tp'), P('tp'))", "out_specs=P('tp')")
+    assert em4(lint_source(prefix, path="edgemesh/parallel/x.py")) == []
+
+
+# ---------------------------------------------------------------------------
+# EM403 unreduced-sharded-contraction
+# ---------------------------------------------------------------------------
+
+_EM403_SRC = (
+    "import jax.numpy as jnp\n"
+    "from jax import lax\n"
+    "from jax.sharding import PartitionSpec as P\n"
+    "from edgemesh.utils.compat import shard_map\n"
+    "def row_dense(x, w, mesh):\n"
+    "    def body(xb, wb):\n"
+    "        y = xb @ wb\n"
+    "        return y\n"
+    "    return shard_map(body, mesh=mesh,\n"
+    "                     in_specs=(P(None, 'tp'), P('tp', None)),\n"
+    "                     out_specs=P(), check_vma=False)(x, w)\n"
+)
+
+
+def test_em403_fires_on_unreduced_contraction_and_names_vma_masking():
+    findings = em4(lint_source(_EM403_SRC, path="edgemesh/parallel/x.py"))
+    assert [f.rule for f in findings] == ["EM403"]
+    assert findings[0].severity == "error"
+    assert "psum" in findings[0].message and "'tp'" in findings[0].message
+    # check_vma=False at the call site would mask the runtime checker too —
+    # the message says so.
+    assert "check_vma=False" in findings[0].message
+
+
+def test_em403_quiet_with_psum_on_the_path():
+    ok = _EM403_SRC.replace("y = xb @ wb", "y = lax.psum(xb @ wb, 'tp')")
+    assert em4(lint_source(ok, path="edgemesh/parallel/x.py")) == []
+
+
+def test_em403_quiet_when_out_specs_claims_the_axis():
+    # out_specs sharding the axis is a DIFFERENT claim (not replication) —
+    # out of this rule's scope.
+    sharded = _EM403_SRC.replace("out_specs=P()", "out_specs=P('tp')")
+    assert em4(lint_source(sharded, path="edgemesh/parallel/x.py")) == []
+
+
+def test_em403_sees_einsum_contractions():
+    src = (
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "from edgemesh.utils.compat import shard_map\n"
+        "def wrap(x, w, mesh):\n"
+        "    def body(xb, wb):\n"
+        "        return jnp.einsum('th,hf->tf', xb, wb)\n"
+        "    return shard_map(body, mesh=mesh,\n"
+        "                     in_specs=(P(None, 'tp'), P('tp', None)),\n"
+        "                     out_specs=P())(x, w)\n"
+    )
+    findings = em4(lint_source(src, path="edgemesh/parallel/x.py"))
+    assert [f.rule for f in findings] == ["EM403"]
+    # Contraction over an UNSHARDED dim is fine (the 'tf->f' reduction
+    # below never crosses devices).
+    ok = src.replace(
+        "in_specs=(P(None, 'tp'), P('tp', None))",
+        "in_specs=(P('tp', None), P(None, None))",
+    )
+    assert em4(lint_source(ok, path="edgemesh/parallel/x.py")) == []
+
+
+# ---------------------------------------------------------------------------
+# EM404 retrace-hazard
+# ---------------------------------------------------------------------------
+
+_EM404_SRC = (
+    "from edgemesh.runtime.paged_generate import forward_prefill_paged\n"
+    "def admit(cfg, params, req, cache):\n"
+    "    s_cap = len(req.ids)\n"
+    "    return forward_prefill_paged(cfg, params, req.toks, s_cap, cache)\n"
+)
+
+
+def test_em404_fires_on_raw_len_into_jitted_call_in_serving_only():
+    findings = em4(lint_source(_EM404_SRC, path="edgemesh/serve/continuous.py"))
+    assert [f.rule for f in findings] == ["EM404"]
+    assert findings[0].severity == "warning"
+    assert "bucket_pow2" in findings[0].message
+    # Outside serve//runtime/ the rule is silent (bench code keys compiles
+    # deliberately).
+    assert em4(lint_source(_EM404_SRC, path="edgemesh/benchmarks.py")) == []
+
+
+def test_em404_blessed_bucketing_sanitizes():
+    ok = _EM404_SRC.replace(
+        "    s_cap = len(req.ids)\n",
+        "    from edgemesh.utils.bucketing import bucket_pow2\n"
+        "    s_cap = bucket_pow2(len(req.ids), floor=16)\n",
+    )
+    assert em4(lint_source(ok, path="edgemesh/serve/continuous.py")) == []
+
+
+def test_em404_sees_shape_arithmetic_and_jit_attr_calls():
+    src = (
+        "class Engine:\n"
+        "    def step(self, tokens, cache):\n"
+        "        pad = tokens.shape[1] + 7\n"
+        "        return self._prefill_jit(tokens, pad, cache)\n"
+    )
+    findings = em4(lint_source(src, path="edgemesh/runtime/generate.py"))
+    assert [f.rule for f in findings] == ["EM404"]
+
+
+def test_em404_disable_comment_suppresses():
+    quiet = _EM404_SRC.replace(
+        "    return forward_prefill_paged(cfg, params, req.toks, s_cap, cache)",
+        "    return forward_prefill_paged(cfg, params, req.toks, s_cap, cache)"
+        "  # edgelint: disable=EM404",
+    )
+    assert em4(lint_source(quiet, path="edgemesh/serve/continuous.py")) == []
+
+
+# ---------------------------------------------------------------------------
+# The shipped tree is the negative fixture: zero EM4xx findings, zero
+# baseline entries grandfathering any.
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_tree_is_em4xx_clean_with_no_baseline_entries():
+    from edgemesh.analysis.edgelint import lint_paths
+    from edgemesh.analysis.findings import default_baseline_path
+
+    findings = em4(lint_paths([_PKG]))
+    assert findings == [], [f.render() for f in findings]
+    entries = json.loads(default_baseline_path().read_text())["findings"]
+    assert [e for e in entries if e["rule"].startswith("EM4")] == []
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: the AbstractMesh dryrun (EM405)
+# ---------------------------------------------------------------------------
+
+
+def test_sharding_dryrun_is_green():
+    from edgemesh.analysis.sharding import run_sharding_contracts
+
+    findings = run_sharding_contracts()
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_dryrun_covers_the_required_layouts():
+    # tp2 / tp8 / dp2×tp4 / pp2 are the acceptance layouts: they must stay
+    # registered (and tp8 proves multichip-tracing without any devices).
+    from edgemesh.analysis.sharding import LAYOUTS, SHARDING_CONTRACTS
+
+    covered = {
+        layout for c in SHARDING_CONTRACTS for layout in c["layouts"]
+    }
+    for required in ("tp2", "tp8", "dp2xtp4", "pp2"):
+        assert required in LAYOUTS and required in covered, required
+    assert dict(LAYOUTS["tp8"])["tp"] == 8
+    wrappers = {c["wrapper"] for c in SHARDING_CONTRACTS}
+    assert wrappers >= {"tp_infer", "ring_attention", "ulysses", "pipeline",
+                        "spmd"}
+
+
+def test_dryrun_broken_spec_names_wrapper_and_layout(monkeypatch):
+    # A deliberately broken out_spec (axis the mesh does not bind) must
+    # fail the dryrun with an error naming the wrapper AND the layout.
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from edgemesh.analysis import sharding
+    from edgemesh.utils.compat import shard_map
+
+    def broken_runner(mesh):
+        mapped = shard_map(
+            lambda x: x, mesh=mesh,
+            in_specs=(P("tp"),), out_specs=P("nonexistent_axis"),
+        )
+        jax.eval_shape(
+            mapped, jax.ShapeDtypeStruct((mesh.shape["tp"], 4), jnp.float32)
+        )
+        return []
+
+    monkeypatch.setattr(sharding, "SHARDING_CONTRACTS", [{
+        "wrapper": "broken_fixture_wrapper",
+        "path": "edgemesh/parallel/broken.py",
+        "layouts": ("tp2",),
+        "runner": broken_runner,
+    }])
+    findings = sharding.run_sharding_contracts()
+    assert [f.rule for f in findings] == ["EM405"]
+    assert findings[0].severity == "error"
+    assert "broken_fixture_wrapper" in findings[0].message
+    assert "tp2" in findings[0].message
+
+
+def test_dryrun_shape_problem_reported_not_just_exceptions(monkeypatch):
+    from edgemesh.analysis import sharding
+
+    monkeypatch.setattr(sharding, "SHARDING_CONTRACTS", [{
+        "wrapper": "odd_shapes",
+        "path": "edgemesh/parallel/odd.py",
+        "layouts": ("tp2",),
+        "runner": lambda mesh: ["logits came out transposed"],
+    }])
+    findings = sharding.run_sharding_contracts()
+    assert [f.rule for f in findings] == ["EM405"]
+    assert "odd_shapes" in findings[0].message
+    assert "transposed" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# --select / --ignore rule filtering (prefix-aware), all formats
+# ---------------------------------------------------------------------------
+
+_MIXED_SRC = (
+    "import jax\n"
+    "from functools import partial\n"
+    "from jax.sharding import Mesh, PartitionSpec as P\n"
+    "from edgemesh.utils.compat import shard_map\n"
+    "@partial(jax.jit, static_argnums=(2,))\n"
+    "def decode(tokens, cache, len_cap):\n"
+    "    return tokens + cache\n"
+    "def wrap(x, devices):\n"
+    "    mesh = Mesh(devices, ('sp',))\n"
+    "    def body(xb, yb):\n"
+    "        return xb\n"
+    "    return shard_map(body, mesh=mesh, in_specs=(P('sp'),),\n"
+    "                     out_specs=P())(x)\n"
+)
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis", *args],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_select_is_prefix_aware(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_MIXED_SRC)
+    # Unfiltered: one EM104 (dead jit param) + EM402s (arity).
+    proc = _run_cli(str(bad), "--no-contracts", "--no-baseline",
+                    "--format", "json")
+    rules = {f["rule"] for f in json.loads(proc.stdout)["findings"]}
+    assert "EM104" in rules and "EM402" in rules
+    # --select EM4xx: the EM1xx finding disappears.
+    proc = _run_cli(str(bad), "--no-contracts", "--no-baseline",
+                    "--format", "json", "--select", "EM4xx")
+    rules = {f["rule"] for f in json.loads(proc.stdout)["findings"]}
+    assert rules and all(r.startswith("EM4") for r in rules)
+    # Exact ids and comma lists work too.
+    proc = _run_cli(str(bad), "--no-contracts", "--no-baseline",
+                    "--format", "json", "--select", "EM104,EM3xx")
+    rules = {f["rule"] for f in json.loads(proc.stdout)["findings"]}
+    assert rules == {"EM104"}
+
+
+def test_cli_ignore_drops_rules_and_exit_code_follows(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_MIXED_SRC)
+    proc = _run_cli(str(bad), "--no-contracts", "--no-baseline",
+                    "--ignore", "EM4xx", "--format", "json")
+    assert proc.returncode == 1  # EM104 remains
+    rules = {f["rule"] for f in json.loads(proc.stdout)["findings"]}
+    assert rules == {"EM104"}
+    # Ignoring everything present → clean, exit 0.
+    proc = _run_cli(str(bad), "--no-contracts", "--no-baseline",
+                    "--ignore", "EM1xx,EM4xx")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_select_honored_by_github_and_pretty_formats(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_MIXED_SRC)
+    proc = _run_cli(str(bad), "--no-contracts", "--no-baseline",
+                    "--format", "github", "--select", "EM104")
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("::")]
+    assert lines and all("EM104" in ln for ln in lines)
+    proc = _run_cli(str(bad), "--no-contracts", "--no-baseline",
+                    "--select", "EM104")
+    assert "EM402" not in proc.stdout
+
+
+def test_cli_select_does_not_condemn_filtered_baseline_entries(tmp_path):
+    # A baselined EM104 finding is invisible to a --select EM4xx run: the
+    # filtered run must not report it stale (or prune it).
+    bad = tmp_path / "bad.py"
+    bad.write_text(_MIXED_SRC)
+    bl = tmp_path / "bl.json"
+    _run_cli(str(bad), "--no-contracts", "--baseline", str(bl),
+             "--write-baseline")
+    proc = _run_cli(str(bad), "--no-contracts", "--baseline", str(bl),
+                    "--select", "EM4xx")
+    assert "stale baseline entry" not in proc.stderr
+    assert proc.returncode == 0, proc.stdout + proc.stderr  # all baselined
+
+
+def test_cli_write_baseline_under_select_keeps_other_rules(tmp_path):
+    # A filtered --write-baseline only saw the selected rules: it must
+    # rewrite THEIR entries and keep everything else — not silently
+    # destroy the other rules' grandfathered debt.
+    bad = tmp_path / "bad.py"
+    bad.write_text(_MIXED_SRC)
+    bl = tmp_path / "bl.json"
+    _run_cli(str(bad), "--no-contracts", "--baseline", str(bl),
+             "--write-baseline")
+    rules_before = {e["rule"] for e in
+                    json.loads(bl.read_text())["findings"]}
+    assert "EM104" in rules_before and "EM402" in rules_before
+    # Rewrite only the EM4xx entries (code unchanged → same set back).
+    proc = _run_cli(str(bad), "--no-contracts", "--baseline", str(bl),
+                    "--select", "EM4xx", "--write-baseline")
+    assert proc.returncode == 0
+    rules_after = {e["rule"] for e in json.loads(bl.read_text())["findings"]}
+    assert rules_after == rules_before  # EM104 entry survived
+    # And the unfiltered run is still fully baselined.
+    proc = _run_cli(str(bad), "--no-contracts", "--baseline", str(bl))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_lint_subcommand_forwards_filters(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_MIXED_SRC)
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.cli", "lint", str(bad),
+         "--no-contracts", "--no-baseline", "--format", "json",
+         "--select", "EM4xx"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    rules = {f["rule"] for f in json.loads(proc.stdout)["findings"]}
+    assert rules and all(r.startswith("EM4") for r in rules)
