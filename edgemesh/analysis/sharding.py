@@ -122,15 +122,18 @@ _COLLECTIVES: dict[str, tuple[int, str]] = {
     "axis_index": (0, "axis_name"),
     "axis_size": (0, "axis_name"),  # compat shim
     "pcast": (1, "axis_name"),      # compat shim
+    "qpsum": (1, "axis_name"),      # quantized all-reduce (parallel/collectives)
 }
 
-_COLLECTIVE_HOMES = ("jax.lax.", "edgemesh.utils.compat.")
-#: Bare-name fallback for the compat helpers (their only legitimate homes
-#: are the compat module; fixtures import them by name).
-_COMPAT_BARE = {"axis_size", "pcast"}
+_COLLECTIVE_HOMES = (
+    "jax.lax.", "edgemesh.utils.compat.", "edgemesh.parallel.collectives.",
+)
+#: Bare-name fallback for the compat/collectives helpers (their only
+#: legitimate homes are those modules; fixtures import them by name).
+_COMPAT_BARE = {"axis_size", "pcast", "qpsum"}
 
 #: Collectives that REDUCE over the axis (clear EM403 partial-ness).
-_REDUCERS = {"psum", "pmean", "pmax", "pmin", "psum_scatter"}
+_REDUCERS = {"psum", "pmean", "pmax", "pmin", "psum_scatter", "qpsum"}
 
 #: The five canonical mesh axes (parallel/mesh.py AXES) — what
 #: build_mesh/auto_mesh always bind.
@@ -1069,7 +1072,8 @@ def _abstract_params(cfg):
     return jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
 
 
-def _dryrun_tp_infer(mesh) -> list[str]:
+def _dryrun_tp_infer(mesh, collective_mode: str = "psum",
+                     comm_dtype: str = "int8") -> list[str]:
     import jax
     import jax.numpy as jnp
 
@@ -1086,7 +1090,10 @@ def _dryrun_tp_infer(mesh) -> list[str]:
     kvv = jax.ShapeDtypeStruct((b, max_seq), jnp.bool_)
     problems: list[str] = []
     for is_decode, s in ((False, 8), (True, 1)):
-        mapped = make_tp_mapped(cfg, mesh, specs, "xla", is_decode)
+        mapped = make_tp_mapped(
+            cfg, mesh, specs, "xla", is_decode,
+            collective_mode=collective_mode, comm_dtype=comm_dtype,
+        )
         tokens = jax.ShapeDtypeStruct((b, s), jnp.int32)
         pos = jax.ShapeDtypeStruct((b, s), jnp.int32)
         logits, k, v = jax.eval_shape(
@@ -1102,6 +1109,60 @@ def _dryrun_tp_infer(mesh) -> list[str]:
                 f"{step} cache avals drifted: {k.shape}/{k.dtype} vs "
                 f"{cache.k.shape}/{cache.k.dtype}"
             )
+    return problems
+
+
+def _dryrun_tp_infer_qpsum(mesh) -> list[str]:
+    """The quantized-wire tp program (collective_mode="qpsum"), both comm
+    dtypes that actually quantize. The fp8 arm is skipped ONLY when this
+    jax has no float8 type — a ValueError out of the trace itself must
+    stay a finding, not a skip."""
+    import jax.numpy as jnp
+
+    problems = _dryrun_tp_infer(mesh, collective_mode="qpsum")
+    if getattr(jnp, "float8_e4m3fn", None) is not None:
+        problems += _dryrun_tp_infer(
+            mesh, collective_mode="qpsum", comm_dtype="fp8"
+        )
+    return problems
+
+
+def _dryrun_tp_infer_qpsum_overlap(mesh) -> list[str]:
+    """The chunked comm/compute-overlap tp program."""
+    return _dryrun_tp_infer(mesh, collective_mode="qpsum_overlap")
+
+
+def _dryrun_collectives(mesh) -> list[str]:
+    """qpsum itself under shard_map: every comm dtype over the tp axis,
+    plus a non-divisible trailing dim (the plain-psum fallback path)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from edgemesh.parallel.collectives import COMM_DTYPES, qpsum
+    from edgemesh.utils.compat import shard_map
+
+    tp = mesh.shape["tp"]
+    problems: list[str] = []
+    # 48 divides tp 2/4/8; 9 divides none of them (fallback coverage).
+    for h in (48, 9):
+        for dtype in COMM_DTYPES:
+            if dtype == "fp8" and getattr(jnp, "float8_e4m3fn", None) is None:
+                continue
+            mapped = shard_map(
+                lambda xs, dtype=dtype: qpsum(xs, "tp", dtype=dtype),
+                mesh=mesh,
+                in_specs=(P("tp", None),),
+                out_specs=P("tp", None),
+                check_vma=False,
+            )
+            x = jax.ShapeDtypeStruct((tp * 2, h), jnp.float32)
+            out = jax.eval_shape(mapped, x)
+            if out.shape != (tp * 2, h) or out.dtype != jnp.float32:
+                problems.append(
+                    f"qpsum[{dtype}, h={h}] aval {out.shape}/{out.dtype} "
+                    f"!= input ({tp * 2}, {h})/float32"
+                )
     return problems
 
 
@@ -1192,6 +1253,24 @@ SHARDING_CONTRACTS: list[dict] = [
         "path": "edgemesh/parallel/tp_infer.py",
         "layouts": ("tp2", "tp8", "dp2xtp4"),
         "runner": _dryrun_tp_infer,
+    },
+    {
+        "wrapper": "tp_infer_qpsum",
+        "path": "edgemesh/parallel/tp_infer.py",
+        "layouts": ("tp2", "tp8", "dp2xtp4"),
+        "runner": _dryrun_tp_infer_qpsum,
+    },
+    {
+        "wrapper": "tp_infer_qpsum_overlap",
+        "path": "edgemesh/parallel/tp_infer.py",
+        "layouts": ("tp2", "tp8", "dp2xtp4"),
+        "runner": _dryrun_tp_infer_qpsum_overlap,
+    },
+    {
+        "wrapper": "collectives",
+        "path": "edgemesh/parallel/collectives.py",
+        "layouts": ("tp2", "tp8", "dp2xtp4"),
+        "runner": _dryrun_collectives,
     },
     {
         "wrapper": "ring_attention",

@@ -439,6 +439,189 @@ def admission_policy_benchmark(
     return out
 
 
+def _build_tp_engine(cfg, params, tp: int, collective_mode: str,
+                     collective_dtype: str):
+    """One definition of the bench's tp-engine construction: validates the
+    device budget up front (a missing-chips failure should read as capacity,
+    not a shard_map trace error) and leaves attention_impl to the engine's
+    platform default (flash on real TPU, cfg's setting on the CPU mesh)."""
+    from edgemesh.parallel.mesh import build_mesh
+    from edgemesh.parallel.tp_infer import TPInferenceEngine
+
+    have = jax.device_count()
+    if have < tp:
+        raise RuntimeError(
+            f"tp{tp} stage needs {tp} devices, have {have} (run in a "
+            f"pod-slice window, or EDGEMESH_BENCH_TP8=0 to skip)"
+        )
+    return TPInferenceEngine(
+        cfg, params, build_mesh(dp=1, tp=tp),
+        collective_mode=collective_mode, comm_dtype=collective_dtype,
+    )
+
+
+def tp_serving_benchmark(
+    preset: str | None = None,
+    precision: str = "int8",
+    quant_mode: str = "w8a16",
+    tp: int = 8,
+    collective_mode: str = "qpsum_overlap",
+    collective_dtype: str = "int8",
+    slots: int = 8,
+    chunk: int = 32,
+    n_requests: int = 35,
+    max_new: int = 64,
+    built: tuple | None = None,
+    waves: int = 3,
+) -> dict[str, Any]:
+    """Continuous-batching serving throughput THROUGH the tensor-parallel
+    shard_map engine (parallel/tp_infer.py) — the ``serving_tp8_tok_s``
+    headline. Same wave protocol as :func:`serving_benchmark`; the engine
+    runs the dense backend with the tp engine's quantized/overlapped
+    collective joins (``collective_mode``/``collective_dtype``), and the
+    artifact carries the exact wire bytes the joins shipped
+    (edgemesh_collective_bytes_total)."""
+    from edgemesh.agents.orchestrator import Agent
+    from edgemesh.models.tokenizer import ByteTokenizer
+    from edgemesh.obs import Registry
+    from edgemesh.serve.continuous import ContinuousEngine
+
+    preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
+    if built is not None:
+        cfg, params = built
+        if precision == "int8":
+            cfg = cfg.replace(quant_mode=quant_mode)
+    else:
+        cfg, params = _build(preset, precision, quant_mode)
+    tp_eng = _build_tp_engine(cfg, params, tp, collective_mode, collective_dtype)
+    agent = Agent(
+        role="qa", cfg=cfg, params=params, tokenizer=ByteTokenizer(),
+        sampling=SamplingParams(
+            max_new_tokens=max_new, temperature=0.7, top_k=50, top_p=0.9,
+            repetition_penalty=1.2, do_sample=True,
+        ),
+        prefix_cache=False,
+    )
+    registry = Registry()
+    eng = ContinuousEngine(agent, slots=slots, chunk=chunk,
+                           kv_backend="dense", registry=registry,
+                           tp_engine=tp_eng)
+    try:
+        import numpy as np
+
+        wave_tok_s, tagged, wall_all, _ = _run_waves(
+            eng, n_requests, waves,
+            label=f"serving/tp{tp} {collective_mode}/{collective_dtype}",
+        )
+        results = [r for _, r in tagged]
+        lats = [_e2e_latency(r) for r in results]
+        tok_s = float(np.median(wave_tok_s))
+        snap = registry.snapshot()
+        wire = sum(
+            s["value"]
+            for s in snap.get("edgemesh_collective_bytes_total", {}).get(
+                "samples", [])
+        )
+        _progress(
+            f"serving/tp{tp}: median {tok_s:.1f} tok/s "
+            f"({collective_mode}/{collective_dtype}, "
+            f"{wire / 1e6:.1f} MB collective wire)"
+        )
+        return {
+            "metric": f"serving_tp{tp}_tok_s",
+            "value": round(tok_s, 2),
+            "unit": "tok/s",
+            "tp": tp,
+            "collective_mode": collective_mode,
+            "collective_dtype": collective_dtype,
+            "wave_tok_s": [round(t, 2) for t in wave_tok_s],
+            "req_s": round(len(results) / wall_all, 3),
+            "latency_s_p50": round(float(np.percentile(lats, 50)), 4),
+            "latency_s_p95": round(float(np.percentile(lats, 95)), 4),
+            "collective_bytes": int(wire),
+            "stats": eng.stats(),
+        }
+    finally:
+        eng.close()
+
+
+def collective_ablation_benchmark(
+    preset: str | None = None,
+    precision: str = "int8",
+    quant_mode: str = "w8a16",
+    tp: int = 8,
+    batches: tuple[int, ...] = (8, 32),
+    decode_steps: int = 32,
+    built: tuple | None = None,
+    repeats: int = 2,
+) -> dict[str, Any]:
+    """bf16-psum vs int8-qpsum vs qpsum+overlap on the SAME tp mesh and
+    params: per-arm decode tok/s at each batch, the ratio keys the
+    PERFORMANCE.md targets pin (qpsum >= psum, overlap >= qpsum), and the
+    quality delta — greedy-token agreement of each quantized arm against
+    the bf16-psum arm's tokens (>= 0.999 is the ship gate: EQuARX-grade
+    wire quantization must be invisible to sampling)."""
+    import numpy as np
+
+    preset = preset or os.environ.get("EDGEMESH_BENCH_PRESET", "llama1b")
+    if built is not None:
+        cfg, params = built
+        if precision == "int8":
+            cfg = cfg.replace(quant_mode=quant_mode)
+    else:
+        cfg, params = _build(preset, precision, quant_mode)
+    arms = (
+        ("psum", "psum", "bf16"),
+        ("qpsum", "qpsum", "int8"),
+        ("qpsum_overlap", "qpsum_overlap", "int8"),
+    )
+    out: dict[str, Any] = {"collective_tp": tp, "collective_batches": list(batches)}
+    tokens_by_arm: dict[tuple, Any] = {}
+    for name, mode, dtype in arms:
+        eng = _build_tp_engine(cfg, params, tp, mode, dtype)
+        acct = eng.collective_accounting(batch=1)
+        out[f"collective_{name}_bytes_per_step"] = acct["bytes_per_step"]
+        for b in batches:
+            prompts = jax.random.randint(
+                jax.random.PRNGKey(7), (b, 16), 0, cfg.vocab_size
+            )
+            lengths = jnp.full((b,), 16, jnp.int32)
+            _progress(f"collective/{name} b{b}: warmup compile")
+            toks = eng.generate_greedy(prompts, lengths, max_new=decode_steps)
+            toks.block_until_ready()
+            tokens_by_arm[(name, b)] = np.asarray(toks)
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                eng.generate_greedy(prompts, lengths,
+                                    max_new=decode_steps).block_until_ready()
+                best = min(best, time.perf_counter() - t0)
+            out[f"collective_{name}_b{b}_tok_s"] = round(
+                b * decode_steps / best, 2
+            )
+        del eng
+    for b in batches:
+        base = out[f"collective_psum_b{b}_tok_s"]
+        ref = tokens_by_arm[("psum", b)]
+        for name in ("qpsum", "qpsum_overlap"):
+            v = out[f"collective_{name}_b{b}_tok_s"]
+            out[f"{name}_over_psum_b{b}"] = round(v / base, 3) if base else 0.0
+            out[f"{name}_greedy_agreement_b{b}"] = round(
+                float(np.mean(tokens_by_arm[(name, b)] == ref)), 4
+            )
+        out[f"overlap_over_qpsum_b{b}"] = round(
+            out[f"collective_qpsum_overlap_b{b}_tok_s"]
+            / out[f"collective_qpsum_b{b}_tok_s"], 3,
+        ) if out[f"collective_qpsum_b{b}_tok_s"] else 0.0
+        _progress(
+            f"collective-ablation b{b}: psum {base} / qpsum "
+            f"{out[f'collective_qpsum_b{b}_tok_s']} / overlap "
+            f"{out[f'collective_qpsum_overlap_b{b}_tok_s']} tok/s, "
+            f"agreement {out[f'qpsum_greedy_agreement_b{b}']}"
+        )
+    return out
+
+
 _T0 = time.perf_counter()
 LAST_PROGRESS = time.monotonic()
 _ARCHIVE_PATH = None  # per-run continuous-archive target (emit_partial)
@@ -1526,6 +1709,30 @@ def headline_benchmark(
         and os.environ.get("EDGEMESH_BENCH_SERVE", "1") == "1"
     ):
         _stage("ragged_ablation", _ragged)
+
+    # ---- Stage 7f: tensor-parallel serving at tp8 — the multi-chip serving
+    # headline (quantized, overlapped collectives; parallel/collectives.py)
+    # plus the collective ablation: bf16-psum vs int8-qpsum vs qpsum+overlap
+    # at b8/b32 with tok/s ratios and the greedy-agreement quality delta.
+    # Needs >= 8 devices (a pod-slice window); EDGEMESH_BENCH_TP8=0 skips.
+    def _tp8_serving():
+        r = tp_serving_benchmark(preset, built=int8_built)
+        out["serving_tp8_tok_s"] = r["value"]
+        out["serving_tp8_latency_s_p50"] = r["latency_s_p50"]
+        out["serving_tp8_collective_mode"] = r["collective_mode"]
+        out["serving_tp8_collective_dtype"] = r["collective_dtype"]
+        out["serving_tp8_collective_bytes"] = r["collective_bytes"]
+
+    def _collective_ablation():
+        r = collective_ablation_benchmark(preset, built=int8_built)
+        for k, v in r.items():
+            if k.startswith(("collective_", "qpsum_", "qpsum_overlap_",
+                             "overlap_")):
+                out[k] = v
+
+    if os.environ.get("EDGEMESH_BENCH_TP8", "1") == "1":
+        _stage("tp8_serving", _tp8_serving)
+        _stage("collective_ablation", _collective_ablation)
 
     # ---- Stage 7b: admission-policy A/B on a mixed-budget wave — FIFO vs
     # SJF end-to-end latency at matched throughput (docs/SERVING.md SLO

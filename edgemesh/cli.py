@@ -84,7 +84,9 @@ def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool =
               kv_backend: str = "dense", kv_page_size: int = 64,
               admission: str = "fifo", span_log: str | None = None,
               trace_sample: float = 1.0,
-              profile_dir: str | None = None) -> int:
+              profile_dir: str | None = None, tp: int = 0,
+              collective_mode: str = "psum",
+              collective_dtype: str = "int8") -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.serve import serve_rest
 
@@ -92,7 +94,9 @@ def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool =
     serve_rest(ensemble, port=port, batch=batch, continuous=continuous,
                kv_backend=kv_backend, kv_page_size=kv_page_size,
                admission=admission, span_log=span_log,
-               trace_sample=trace_sample, profile_dir=profile_dir)
+               trace_sample=trace_sample, profile_dir=profile_dir,
+               tp=tp, collective_mode=collective_mode,
+               collective_dtype=collective_dtype)
     return 0
 
 
@@ -258,6 +262,25 @@ def main(argv: list[str] | None = None) -> int:
         "in once the template spans a full page)",
     )
     top.add_argument(
+        "--tp", type=int, default=0,
+        help="serve --continuous: tensor-parallel degree — serve through "
+        "the shard_map engine on a dp=1 x tp mesh (parallel/tp_infer.py); "
+        "0/1 keeps the single-program path",
+    )
+    top.add_argument(
+        "--collective-mode", default="psum",
+        choices=["psum", "qpsum", "qpsum_overlap"],
+        help="serve --continuous --tp N: cross-chip join for the row-"
+        "sharded projections — qpsum halves the wire (quantized ring "
+        "all-reduce), qpsum_overlap additionally hides the ring behind "
+        "the next chunk's matmul (parallel/collectives.py)",
+    )
+    top.add_argument(
+        "--collective-dtype", default="int8", choices=["int8", "fp8", "bf16"],
+        help="serve --continuous --tp N: qpsum wire dtype (bf16 = "
+        "full-precision passthrough, the ablation baseline)",
+    )
+    top.add_argument(
         "--span-log", type=str, default=None,
         help="serve --continuous: JSONL path for request-lifecycle span "
         "records (inspect/replay with `edgemesh obs`)",
@@ -319,7 +342,9 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_serve(cfg, cmd_args.port, cmd_args.batch, cmd_args.continuous,
                          cmd_args.kv_backend, cmd_args.kv_page_size,
                          cmd_args.admission, cmd_args.span_log,
-                         cmd_args.trace_sample, cmd_args.profile_dir)
+                         cmd_args.trace_sample, cmd_args.profile_dir,
+                         cmd_args.tp, cmd_args.collective_mode,
+                         cmd_args.collective_dtype)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     if cmd_args.command == "train":

@@ -386,11 +386,18 @@ def _mlp(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> tuple[jnp.ndarray, 
 
         return moe_mlp(cfg, layer["moe"], x)
     zero = jnp.zeros((), jnp.float32)
+    return dense(layer["down"], mlp_hidden(cfg, layer, x), cfg.quant_mode), zero
+
+
+def mlp_hidden(cfg: ModelConfig, layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """The dense FFN up to (not including) the down projection — the seam
+    the tensor-parallel engine needs to decompose ``down`` into chunks whose
+    collectives overlap the next chunk's matmul (parallel/tp_infer.py).
+    MoE blocks have no single down projection and stay on :func:`_mlp`."""
     qm = cfg.quant_mode
     if cfg.gated:
-        gate = _activate(cfg, dense(layer["gate"], x, qm))
-        return dense(layer["down"], gate * dense(layer["up"], x, qm), qm), zero
-    return dense(layer["down"], _activate(cfg, dense(layer["up"], x, qm)), qm), zero
+        return _activate(cfg, dense(layer["gate"], x, qm)) * dense(layer["up"], x, qm)
+    return _activate(cfg, dense(layer["up"], x, qm))
 
 
 def _activate(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
@@ -445,6 +452,27 @@ def _attention(
     lengths: jnp.ndarray,  # [b] (write offsets for decode)
     is_decode: bool,
 ) -> tuple[jnp.ndarray, LayerKV]:
+    out, cache = attention_core(
+        cfg, layer, x, positions, cache, kv_valid, lengths, is_decode
+    )
+    return dense(layer["o"], out, cfg.quant_mode), cache
+
+
+def attention_core(
+    cfg: ModelConfig,
+    layer: Params,
+    x: jnp.ndarray,  # [b, s, h]
+    positions: jnp.ndarray,  # [b, s]
+    cache: LayerKV,
+    kv_valid: jnp.ndarray,  # [b, max_seq]
+    lengths: jnp.ndarray,  # [b] (write offsets for decode)
+    is_decode: bool,
+) -> tuple[jnp.ndarray, LayerKV]:
+    """Everything up to (not including) the output projection — returns the
+    attended heads flattened to [b, s, nh*hd] plus the cache state. The seam
+    the tensor-parallel engine uses to chunk the ``o`` projection so each
+    chunk's collective overlaps the next chunk's matmul
+    (parallel/tp_infer.py)."""
     b, s, _ = x.shape
     nh, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
     q, k, v = qkv_proj(cfg, layer, x, positions)
@@ -472,7 +500,7 @@ def _attention(
             q, cache, positions, kv_valid, scale=cfg.query_scale,
             sliding_window=cfg.sliding_window, soft_cap=cfg.attn_soft_cap,
         )
-    return dense(layer["o"], out.reshape(b, s, nh * hd), cfg.quant_mode), cache
+    return out.reshape(b, s, nh * hd), cache
 
 
 def _layer_fn(

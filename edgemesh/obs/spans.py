@@ -213,13 +213,16 @@ class SpanTracker:
     def segment_dispatched(self) -> None:
         self._segments.inc()
 
-    def tokens(self, trace: RequestTrace, n: int) -> None:
-        """A drained segment credited ``n`` decode tokens to this request."""
+    def tokens(self, trace: RequestTrace, n: int, **attrs: Any) -> None:
+        """A drained segment credited ``n`` decode tokens to this request.
+        ``attrs`` ride the decode span (e.g. ``collective_bytes`` — the tp
+        serving engine's per-segment wire accounting, rolled up by
+        ``obs.trace.critical_path``)."""
         now = self.now()
         if n > 0 and trace.t_first_token is None:
             trace.t_first_token = now
             self._ttft.observe(now - trace.t_submit)
-        trace.span("decode", trace.t_last, now, tokens=int(n))
+        trace.span("decode", trace.t_last, now, tokens=int(n), **attrs)
         trace.segments += 1
         trace.generated += int(n)
         if n > 0:

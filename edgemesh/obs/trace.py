@@ -306,10 +306,19 @@ def critical_path(tree: dict | None) -> dict[str, Any]:
     queue/prefill/decode come from the winning replica's spans; ``other_s``
     is the explicit residue (span gaps, retirement → response write, router
     bookkeeping after the answer) so the parts always sum to ``total_s``.
+
+    Collective phase (tensor-parallel serving): ``collective_bytes`` sums
+    the per-span wire accounting the tp engine stamps on decode spans
+    (exact analytic counts — parallel/collectives.py), and
+    ``collective_s`` sums spans NAMED "collective" when a backend emits
+    measured collective timings (profiling runs). ``collective_s`` is a
+    sub-phase OF decode/prefill time, reported alongside the split, not
+    added to the sum — the parts still total ``total_s`` without it.
     """
     empty = {
         "total_s": None, "retry_wasted_s": 0.0, "wire_s": 0.0,
         "queue_s": 0.0, "prefill_s": 0.0, "decode_s": 0.0, "other_s": 0.0,
+        "collective_s": 0.0, "collective_bytes": 0,
     }
     if not tree or tree.get("t0") is None or tree.get("t1") is None:
         return empty
@@ -341,13 +350,16 @@ def critical_path(tree: dict | None) -> dict[str, Any]:
     servers = [c for c in winner.get("children", ()) if c.get("name") == "server"]
     if winner.get("name") == "server":
         servers = [winner]
-    queue = prefill = decode = 0.0
+    queue = prefill = decode = collective = 0.0
+    collective_bytes = 0
     wire = win_dur
     if servers:
         srv = servers[0]
         srv_dur = max(0.0, (srv.get("t1") or win_t1) - srv["t0"])
         wire = max(0.0, win_dur - srv_dur)
         for s in srv.get("children", ()):
+            if isinstance(s.get("collective_bytes"), (int, float)):
+                collective_bytes += int(s["collective_bytes"])
             if s.get("t1") is None or s.get("t0") is None:
                 continue
             d = s["t1"] - s["t0"]
@@ -357,6 +369,8 @@ def critical_path(tree: dict | None) -> dict[str, Any]:
                 prefill += d
             elif s.get("name") == "decode":
                 decode += d
+            elif s.get("name") == "collective":
+                collective += d
     out = {
         "total_s": round(total, 6),
         "retry_wasted_s": round(retry_wasted, 6),
@@ -367,11 +381,14 @@ def critical_path(tree: dict | None) -> dict[str, Any]:
     }
     # Residue computed from the ROUNDED parts, so the published numbers sum
     # to the published total exactly — seven independently-rounded values
-    # would drift by up to ~3.5e-6 otherwise.
+    # would drift by up to ~3.5e-6 otherwise. (collective_s is a sub-phase
+    # of decode/prefill, deliberately outside the sum.)
     out["other_s"] = round(
         out["total_s"] - out["retry_wasted_s"] - out["wire_s"]
         - out["queue_s"] - out["prefill_s"] - out["decode_s"], 6,
     )
+    out["collective_s"] = round(collective, 6)
+    out["collective_bytes"] = collective_bytes
     return out
 
 

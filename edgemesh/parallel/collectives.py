@@ -1,0 +1,173 @@
+"""Quantized, overlappable collectives for the tensor-parallel serving stack.
+
+The tp engine's decode step pays two full-precision ``lax.psum``s per layer
+(attention-output and MLP down projections, parallel/tp_infer.py) — at tp8
+that is the dominant non-matmul cost of every token, and none of it shrinks
+on the wire. EQuARX (PAPERS.md: arXiv 2506.17615) shows an all-reduce can
+run its ring in int8/fp8 with per-chunk scales at negligible quality cost;
+:func:`qpsum` is that design on the shard_map shims:
+
+    quantize → ppermute ring reduce-scatter (dequant-accumulate per hop)
+             → quantized ring all-gather → dequantize
+
+Every hop moves 1-byte elements instead of 2-byte bf16 — half the wire
+bytes — and the explicit ring decomposes the all-reduce into ``world - 1``
+independent ppermute steps XLA can overlap with unrelated compute (the
+chunked-projection schedule in tp_infer exploits exactly that).
+
+Contracts:
+- ``qpsum`` is shard_map-body code: call it where ``lax.psum(x, axis)``
+  is legal. It is registered with the EM4xx sharding rules
+  (analysis/sharding.py ``_COLLECTIVES``/``_REDUCERS``) so an unbound
+  axis or an unreduced-contraction hole is a lint error, and the
+  ``collectives`` entry in ``SHARDING_CONTRACTS`` traces it under
+  tp2/tp8/dp2xtp4 AbstractMesh layouts with no devices.
+- ``dtype="bf16"`` and world size 1 fall back to plain ``lax.psum``
+  (bit-exact, zero new numerics); so does a trailing dim the world size
+  does not divide (ring chunking needs equal chunks).
+- All shards produce bit-identical results (the final all-gather
+  round-trips every chunk — including the locally-reduced one — through
+  the same quantizer), so ``out_specs`` replication claims stay honest.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from edgemesh.utils.compat import axis_size
+
+#: The serving knob's vocabulary (threaded TPInferenceEngine → engine
+#: config → CLI): "psum" is the legacy full-precision join, "qpsum"
+#: quantizes the wire, "qpsum_overlap" additionally chunks the projection
+#: so collective i rides the ring while chunk i+1's matmul computes.
+COLLECTIVE_MODES = ("psum", "qpsum", "qpsum_overlap")
+
+#: Wire dtypes qpsum can ship. "bf16" means "don't quantize" — the plain
+#: psum fallback, kept in the set so the ablation sweeps one knob.
+COMM_DTYPES = ("int8", "fp8", "bf16")
+
+_INT8_MAX = 127.0
+_FP8_MAX = 448.0  # float8_e4m3fn finite max
+_FP8 = getattr(jnp, "float8_e4m3fn", None)
+
+
+def validate_collective_mode(mode: str, dtype: str) -> None:
+    """One vocabulary check for every layer that threads the knob
+    (TPInferenceEngine, ContinuousEngine, serve_rest, CLI)."""
+    if mode not in COLLECTIVE_MODES:
+        raise ValueError(
+            f"unknown collective_mode {mode!r} (choose from {COLLECTIVE_MODES})"
+        )
+    if dtype not in COMM_DTYPES:
+        raise ValueError(
+            f"unknown comm dtype {dtype!r} (choose from {COMM_DTYPES})"
+        )
+    if dtype == "fp8" and _FP8 is None:
+        raise ValueError(
+            "comm dtype 'fp8' needs a jax with jnp.float8_e4m3fn; use 'int8'"
+        )
+
+
+def _quantize(x: jnp.ndarray, dtype: str) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row symmetric quantization over the trailing dim: ``x`` is a ring
+    chunk ``[..., c]``; the scale is one float32 per leading row — fine
+    enough that one outlier channel only poisons its own row, coarse enough
+    that the wire overhead is c:1. Near-zero chunks clamp the scale (1e-8)
+    so zeros dequantize to exact zeros."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    if dtype == "int8":
+        scale = jnp.maximum(absmax / _INT8_MAX, 1e-8)
+        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    else:  # fp8 e4m3: scale to the format's finite range, rounding is free
+        scale = jnp.maximum(absmax / _FP8_MAX, 1e-8)
+        q = (xf / scale).astype(_FP8)
+    return q, scale
+
+
+def _dequantize(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    return q.astype(jnp.float32) * scale
+
+
+def qpsum(x: jnp.ndarray, axis_name: str, *, dtype: str = "int8") -> jnp.ndarray:
+    """Quantized all-reduce over a shard_map mesh axis.
+
+    Drop-in for ``lax.psum(x, axis_name)`` with the wire in ``dtype``
+    (int8 | fp8 | bf16-passthrough). Accumulation is float32 on-chip; only
+    the inter-chip hops are narrow. Result dtype matches ``x``.
+    """
+    if dtype == "bf16":
+        return lax.psum(x, axis_name)
+    if dtype == "fp8" and _FP8 is None:
+        raise ValueError("fp8 collectives need jnp.float8_e4m3fn")
+    world = axis_size(axis_name)
+    h = x.shape[-1]
+    if world == 1 or h % world or h == 0:
+        # No ring to run (or chunks would be ragged): full-precision join.
+        return lax.psum(x, axis_name)
+
+    lead = x.shape[:-1]
+    c = h // world
+    # chunk-major view: chunks[j] is the j-th trailing-dim slice [*lead, c]
+    chunks = jnp.moveaxis(x.reshape(*lead, world, c), -2, 0)
+    idx = lax.axis_index(axis_name)
+    right = [(i, (i + 1) % world) for i in range(world)]
+
+    # Ring reduce-scatter: at step t device i ships its running partial for
+    # chunk (i - t) mod world one hop right and folds its own copy of chunk
+    # (i - t - 1) mod world into what arrives — after world-1 hops device i
+    # holds chunk i fully reduced. Each hop re-quantizes the partial (the
+    # EQuARX trade: error grows ~linearly in hops, wire bytes halve).
+    acc = jnp.take(chunks, (idx - 1) % world, axis=0).astype(jnp.float32)
+    for t in range(1, world):
+        q, scale = _quantize(acc, dtype)
+        q = lax.ppermute(q, axis_name, right)
+        scale = lax.ppermute(scale, axis_name, right)
+        local = jnp.take(chunks, (idx - t - 1) % world, axis=0)
+        acc = local.astype(jnp.float32) + _dequantize(q, scale)
+
+    # Quantized all-gather: every shard re-reads every chunk — including its
+    # own — through the same quantizer, so all shards reassemble the SAME
+    # bits (out_specs replication stays exact).
+    q, scale = _quantize(acc, dtype)
+    q_all = lax.all_gather(q, axis_name)  # [world, *lead, c]
+    s_all = lax.all_gather(scale, axis_name)
+    full = _dequantize(q_all, s_all)
+    return jnp.moveaxis(full, 0, -2).reshape(*lead, h).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Wire accounting — the analytic byte counts behind
+# edgemesh_collective_bytes_total{op,dtype} (serve/continuous.py) and the
+# bench's wire-savings columns. Shapes are static at trace time, so the
+# count is exact for what the collective ships, not an estimate.
+# ---------------------------------------------------------------------------
+
+_WIRE_ELEM_BYTES = {"bf16": 2, "int8": 1, "fp8": 1}
+
+
+def collective_wire_bytes(
+    shape: tuple[int, ...], world: int, mode: str, dtype: str = "int8"
+) -> int:
+    """Per-device wire bytes for ONE all-reduce of a ``shape`` array over a
+    ``world``-sized axis.
+
+    Both the plain psum (ring all-reduce lowering) and qpsum move each
+    element ``2*(world-1)/world`` times; qpsum ships 1-byte elements plus a
+    float32 per-row scale per hop, psum ships the activation dtype (bf16).
+    """
+    if world <= 1:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    if n == 0:
+        return 0
+    hops = 2 * (world - 1)  # reduce-scatter + all-gather, per device
+    if mode == "psum" or dtype == "bf16" or shape[-1] % world:
+        return n * _WIRE_ELEM_BYTES["bf16"] * hops // world
+    chunk_elems = n // world
+    rows = chunk_elems // (shape[-1] // world)  # leading rows per chunk
+    payload = chunk_elems * _WIRE_ELEM_BYTES[dtype] + rows * 4  # + scales
+    return payload * hops

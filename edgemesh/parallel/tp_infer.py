@@ -38,8 +38,16 @@ from edgemesh.models.transformer import (
     _attention,
     _forward,
     _mlp,
+    attention_core,
+    dense,
+    mlp_hidden,
 )
 from edgemesh.ops.int8 import is_quantized
+from edgemesh.parallel.collectives import (
+    collective_wire_bytes,
+    qpsum,
+    validate_collective_mode,
+)
 from edgemesh.parallel.sharding import param_pspecs, quantized_pspecs
 from edgemesh.utils.compat import shard_map
 from edgemesh.utils.platform import on_tpu
@@ -58,6 +66,133 @@ def _attention_psum(cfg, layer, x, positions, cache, kv_valid, lengths, is_decod
 def _mlp_psum(cfg, layer, x):
     y, aux = _mlp(cfg, layer, x)
     return lax.psum(y, "tp"), lax.pmean(aux, "tp")
+
+
+# ---------------------------------------------------------------------------
+# Quantized / overlapped collective joins (collective_mode != "psum").
+#
+# The row-sharded projections ("o", "down") produce per-shard PARTIAL sums;
+# "psum" joins them in full precision. "qpsum" joins through the quantized
+# ring all-reduce (parallel/collectives.qpsum — half the wire bytes at
+# int8/fp8). "qpsum_overlap" additionally decomposes the projection's
+# OUTPUT dim into chunks: chunk i's collective is dataflow-independent of
+# chunk i+1's matmul, so XLA's async collectives run the ring while the
+# MXU computes the next output slice — the TPI-LLM-style comm/compute
+# overlap, with the qpsum ring (explicit ppermutes) giving the scheduler
+# maximal freedom. Output-dim (not contraction-dim) slicing is load-
+# bearing: disjoint column slices are each a COMPLETE partial sum, so the
+# k chunk joins together ship exactly the monolithic payload — a
+# contraction split would all-reduce the full output k times, multiplying
+# the wire it exists to shrink. The per-shard bias convention is preserved
+# exactly: placement pre-divides "o"/"down" biases by tp (see _place), the
+# bias slices with its columns, and the concatenation carries it once.
+# ---------------------------------------------------------------------------
+
+
+def _overlap_sliceable(p: Params) -> bool:
+    """Dense params whose OUTPUT dim slices cleanly: plain kernels and
+    per-channel int8. int4 (nibble-packed) and LoRA-adapted denses fall
+    back to the monolithic qpsum join."""
+    if "lora_a" in p or "kernel_q4" in p:
+        return False
+    return "kernel" in p or "kernel_q" in p
+
+
+def _slice_dense(p: Params, lo: int, hi: int) -> Params:
+    """The [lo:hi) OUTPUT-dim slice of a dense param dict. Slicing the
+    output (not the contraction) keeps each chunk a COMPLETE partial sum
+    over disjoint output columns, so the k per-chunk all-reduces together
+    ship exactly the monolithic join's payload — chunking buys overlap, not
+    extra wire. Per-output-channel scales and the (tp-pre-divided) bias
+    slice with the columns; the SmoothQuant vector rides the (whole)
+    contraction dim."""
+    out: Params = {}
+    if "kernel" in p:
+        out["kernel"] = p["kernel"][:, lo:hi]
+    else:
+        out["kernel_q"] = p["kernel_q"][:, lo:hi]
+        out["scales"] = p["scales"][lo:hi]
+    if "smooth" in p:
+        out["smooth"] = p["smooth"]
+    if "bias" in p:
+        out["bias"] = p["bias"][lo:hi]
+    return out
+
+
+def _pick_chunks(dim: int, n_chunks: int) -> int:
+    """Largest chunk count <= n_chunks that divides the output dim
+    (static: dim is a trace-time shape)."""
+    k = max(1, min(int(n_chunks), int(dim)))
+    while dim % k:
+        k -= 1
+    return k
+
+
+def _collective_dense(
+    p: Params,
+    x: jnp.ndarray,
+    mode: str,
+    dtype: str,
+    n_chunks: int,
+    quant_mode: str,
+) -> jnp.ndarray:
+    """Row-sharded projection + tp join under the configured collective
+    mode. ``x`` is the projection input [b, s, in_local]."""
+    if mode == "qpsum" or not _overlap_sliceable(p):
+        return qpsum(dense(p, x, quant_mode), "tp", dtype=dtype)
+    kernel = p["kernel"] if "kernel" in p else p["kernel_q"]
+    out_dim = kernel.shape[-1]
+    k = _pick_chunks(out_dim, n_chunks)
+    if k <= 1:
+        return qpsum(dense(p, x, quant_mode), "tp", dtype=dtype)
+    step = out_dim // k
+    # Issue chunk i's collective before chunk i+1's matmul: the output
+    # slices are independent, so each ring hides behind the next
+    # contraction, and the concatenation reassembles the monolithic result.
+    joined = [
+        qpsum(
+            dense(_slice_dense(p, i * step, (i + 1) * step), x, quant_mode),
+            "tp", dtype=dtype,
+        )
+        for i in range(k)
+    ]
+    return jnp.concatenate(joined, axis=-1)
+
+
+def _make_collective_fns(collective_mode: str, comm_dtype: str,
+                         overlap_chunks: int):
+    """(attention, mlp) callables for ``_forward`` under the given join
+    mode. "psum" returns the module-level full-precision pair unchanged —
+    the legacy path stays bit-identical and singly defined."""
+    if collective_mode == "psum":
+        return _attention_psum, _mlp_psum
+
+    def attention_fn(cfg, layer, x, positions, cache, kv_valid, lengths,
+                     is_decode):
+        out, new_kv = attention_core(
+            cfg, layer, x, positions, cache=cache, kv_valid=kv_valid,
+            lengths=lengths, is_decode=is_decode,
+        )
+        y = _collective_dense(
+            layer["o"], out, collective_mode, comm_dtype, overlap_chunks,
+            cfg.quant_mode,
+        )
+        return y, new_kv
+
+    def mlp_fn(cfg, layer, x):
+        if cfg.num_experts > 0:
+            # MoE has no single down projection to chunk; the expert-summed
+            # output still rides the quantized wire.
+            y, aux = _mlp(cfg, layer, x)
+            return qpsum(y, "tp", dtype=comm_dtype), lax.pmean(aux, "tp")
+        h = mlp_hidden(cfg, layer, x)
+        y = _collective_dense(
+            layer["down"], h, collective_mode, comm_dtype, overlap_chunks,
+            cfg.quant_mode,
+        )
+        return y, lax.pmean(jnp.zeros((), jnp.float32), "tp")
+
+    return attention_fn, mlp_fn
 
 
 # ---------------------------------------------------------------------------
@@ -157,18 +292,26 @@ def make_tp_mapped(
     param_specs: Params,
     attention_impl: str,
     is_decode: bool,
+    collective_mode: str = "psum",
+    comm_dtype: str = "int8",
+    overlap_chunks: int = 4,
 ):
     """The engine's core shard_map program: per-shard ``_forward`` with
-    psum-joined attention/MLP outputs. Callable under ``jax.eval_shape``
-    with an ``AbstractMesh`` — no devices required."""
+    collective-joined attention/MLP outputs (``collective_mode``: psum |
+    qpsum | qpsum_overlap — see parallel/collectives.py). Callable under
+    ``jax.eval_shape`` with an ``AbstractMesh`` — no devices required."""
+    validate_collective_mode(collective_mode, comm_dtype)
     lcfg = tp_local_config(cfg, mesh.shape["tp"], attention_impl)
     cache_spec = tp_cache_specs()
+    attention_fn, mlp_fn = _make_collective_fns(
+        collective_mode, comm_dtype, overlap_chunks
+    )
 
     def local(params, tokens, positions, kv_valid, k, v, lengths):
         cache = KVCache(k, v, lengths)
         logits, new_cache, _ = _forward(
             lcfg, params, tokens, positions, cache, kv_valid, is_decode,
-            attention=_attention_psum, mlp=_mlp_psum,
+            attention=attention_fn, mlp=mlp_fn,
         )
         return logits, new_cache.k, new_cache.v
 
@@ -192,6 +335,13 @@ class TPInferenceEngine:
     multi-chip no longer disables it. Pass "flash" explicitly to exercise the
     kernel in interpret mode on a CPU mesh (the CI path), or "xla" to force
     the einsum attention.
+
+    ``collective_mode`` picks the tp join for the row-sharded projections
+    (parallel/collectives.py): "psum" (full-precision, the legacy default),
+    "qpsum" (int8/fp8 quantized ring all-reduce — half the wire bytes), or
+    "qpsum_overlap" (qpsum + chunked projections so each chunk's ring hides
+    behind the next chunk's matmul). ``comm_dtype``/``overlap_chunks``
+    parameterize the quantized modes.
     """
 
     def __init__(
@@ -200,20 +350,29 @@ class TPInferenceEngine:
         params: Params,
         mesh: Mesh,
         attention_impl: str | None = None,
+        collective_mode: str = "psum",
+        comm_dtype: str = "int8",
+        overlap_chunks: int = 4,
     ):
         if attention_impl is None:
             attention_impl = (
                 "flash" if on_tpu() else cfg.attention_impl
             )
+        validate_collective_mode(collective_mode, comm_dtype)
         tp = mesh.shape["tp"]
         self.cfg = cfg
         self.mesh = mesh
         self.tp = tp
         self.lcfg = tp_local_config(cfg, tp, attention_impl)
         self.attention_impl = attention_impl
+        self.collective_mode = collective_mode
+        self.comm_dtype = comm_dtype
+        self.overlap_chunks = int(overlap_chunks)
         self.param_specs = tp_param_specs(cfg, params, mesh)
         self.params = self._place(params)
         self.cache_spec = tp_cache_specs()
+        self._mapped_prefill = self._make_mapped(is_decode=False)
+        self._mapped_decode = self._make_mapped(is_decode=True)
         self._prefill_jit = jax.jit(self._make_step(is_decode=False))
         self._decode_jit = jax.jit(self._make_step(is_decode=True))
 
@@ -259,46 +418,92 @@ class TPInferenceEngine:
 
     # -- compiled steps ----------------------------------------------------
 
-    def _make_step(self, is_decode: bool):
-        mapped = make_tp_mapped(
+    def _make_mapped(self, is_decode: bool):
+        return make_tp_mapped(
             self.cfg, self.mesh, self.param_specs, self.attention_impl,
-            is_decode,
+            is_decode, collective_mode=self.collective_mode,
+            comm_dtype=self.comm_dtype, overlap_chunks=self.overlap_chunks,
         )
 
+    def _make_step(self, is_decode: bool):
         if is_decode:
-
             def decode_step(params, tokens, cache: KVCache):
-                max_seq = cache.k.shape[2]
-                positions = cache.lengths[:, None]
-                kv_valid = jnp.arange(max_seq)[None, :] <= cache.lengths[:, None]
-                logits, k, v = mapped(
-                    params, tokens[:, None], positions, kv_valid,
-                    cache.k, cache.v, cache.lengths,
-                )
-                return logits[:, 0], KVCache(k, v, cache.lengths + 1)
+                return self.decode_forward(self.cfg, params, tokens, cache)
 
             return decode_step
 
         def step(params, tokens, lengths, cache: KVCache):
-            b = tokens.shape[0]
-            max_seq = cache.k.shape[2]
-            s = tokens.shape[1]
-            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-            positions = jnp.minimum(positions, (lengths - 1)[:, None])
-            kv_valid = jnp.arange(max_seq)[None, :] < lengths[:, None]
-            logits, k, v = mapped(
-                params, tokens, positions, kv_valid, cache.k, cache.v, lengths
-            )
-            last = logits[jnp.arange(b), lengths - 1]
-            return last, KVCache(k, v, lengths)
+            return self.prefill_forward(self.cfg, params, tokens, lengths, cache)
 
         return step
+
+    # These two carry the transformer.forward_prefill/forward_decode
+    # CALLING CONVENTIONS exactly (the leading cfg is accepted and ignored —
+    # the engine's local config is baked into the mapped program), so the
+    # continuous engine's dense backend can serve over this engine by
+    # swapping them in for the single-chip forwards (serve/continuous.py
+    # ``tp_engine=``: ``decode_forward`` is its ``decode_fn``). Traceable
+    # inside an enclosing jit (the decode loop / bridge).
+
+    def prefill_forward(self, cfg, params, tokens, lengths, cache: KVCache):
+        b = tokens.shape[0]
+        max_seq = cache.k.shape[2]
+        s = tokens.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        positions = jnp.minimum(positions, (lengths - 1)[:, None])
+        kv_valid = jnp.arange(max_seq)[None, :] < lengths[:, None]
+        logits, k, v = self._mapped_prefill(
+            params, tokens, positions, kv_valid, cache.k, cache.v, lengths
+        )
+        last = logits[jnp.arange(b), lengths - 1]
+        return last, KVCache(k, v, lengths)
+
+    def decode_forward(self, cfg, params, tokens, cache: KVCache):
+        max_seq = cache.k.shape[2]
+        positions = cache.lengths[:, None]
+        kv_valid = jnp.arange(max_seq)[None, :] <= cache.lengths[:, None]
+        logits, k, v = self._mapped_decode(
+            params, tokens[:, None], positions, kv_valid,
+            cache.k, cache.v, cache.lengths,
+        )
+        return logits[:, 0], KVCache(k, v, cache.lengths + 1)
 
     def prefill(self, tokens: jnp.ndarray, lengths: jnp.ndarray, cache: KVCache):
         return self._prefill_jit(self.params, tokens, lengths, cache)
 
     def decode(self, tokens: jnp.ndarray, cache: KVCache):
         return self._decode_jit(self.params, tokens, cache)
+
+    def collective_accounting(self, batch: int = 1, seq: int = 1) -> dict:
+        """Analytic per-step wire accounting for THIS engine's join mode:
+        what one forward over [batch, seq] tokens ships per chip, per layer
+        and in total (parallel/collectives.collective_wire_bytes — shapes
+        are static, so these are exact counts, not estimates). Feeds
+        ``edgemesh_collective_bytes_total{op,dtype}`` and the per-request
+        span attrs in serve/continuous.py."""
+        quantized = self.collective_mode != "psum" and self.comm_dtype != "bf16"
+        op = "qpsum" if quantized else "psum"
+        wire_dtype = self.comm_dtype if quantized else "bf16"
+        mode = "qpsum" if quantized else "psum"
+        h = self.cfg.hidden_size
+        if self.collective_mode == "qpsum_overlap":
+            # Output-dim chunking: k disjoint [b, s, h/k] joins whose
+            # payloads sum to the monolithic join (plus k x the per-row
+            # scale vectors) — count what actually ships per chunk.
+            k = _pick_chunks(h, self.overlap_chunks)
+            per = k * collective_wire_bytes(
+                (batch, seq, h // k), self.tp, mode, wire_dtype,
+            )
+        else:
+            per = collective_wire_bytes(
+                (batch, seq, h), self.tp, mode, wire_dtype,
+            )
+        return {
+            "op": op,
+            "dtype": wire_dtype,
+            "per_layer": {"attn_o": per, "mlp_down": per},
+            "bytes_per_step": self.cfg.num_layers * 2 * per,
+        }
 
     def generate_greedy(
         self, tokens: jnp.ndarray, lengths: jnp.ndarray, max_new: int

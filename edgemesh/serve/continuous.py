@@ -312,11 +312,33 @@ class ContinuousEngine:
         registry=None,
         trace_sample: float = 1.0,
         ragged: bool | None = None,
+        tp_engine=None,
     ):
         self.agent = agent
         self.cfg = agent.cfg
         self.chunk = int(chunk)
         self.n_slots = int(slots)
+        # Tensor-parallel serving (parallel/tp_infer.py): with a
+        # TPInferenceEngine attached, the dense backend's prefill/decode
+        # forwards run the engine's shard_map programs — every chip holds
+        # its head/FFN shard and the only cross-chip traffic is the
+        # collective joins, quantized/overlapped per the engine's
+        # ``collective_mode``. The slab splice/bridge/decode-loop structure
+        # is untouched: GSPMD reshards the spliced rows, the loop's
+        # ``decode_fn`` is the engine's ``decode_forward``.
+        self._tp = tp_engine
+        if tp_engine is not None:
+            if kv_backend != "dense":
+                raise ValueError(
+                    "tensor-parallel serving runs on kv_backend='dense' "
+                    f"(got {kv_backend!r}); the paged pool's page tables "
+                    "are not tp-sharded yet"
+                )
+            if tp_engine.mesh.shape.get("dp", 1) != 1:
+                raise ValueError(
+                    "tensor-parallel serving needs a dp=1 mesh (one-row "
+                    "admission prefills cannot split over dp)"
+                )
         if self.chunk < 1 or self.n_slots < 1:
             raise ValueError("slots and chunk must be >= 1")
         if admission not in ("fifo", "sjf"):
@@ -355,7 +377,14 @@ class ContinuousEngine:
         self._slots = [_Slot() for _ in range(self.n_slots)]  # not shared
         self._gen = [0] * self.n_slots  # admission generation per slot
         cap = self.cfg.max_seq_len
-        if kv_backend == "dense":
+        # Forwards read params from here: the tp engine's PLACED tree (with
+        # its pre-divided o/down biases) when attached, the agent's
+        # otherwise. One seam for every dense dispatch site.
+        self._params = tp_engine.params if tp_engine is not None else agent.params
+        if tp_engine is not None:
+            self._cache = tp_engine.init_cache(self.n_slots, cap)
+            self._decode_fn = tp_engine.decode_forward
+        elif kv_backend == "dense":
             self._cache = init_kv_cache(self.cfg, self.n_slots, cap)  # not shared
             self._decode_fn = None  # _decode_loop default (forward_decode)
         elif kv_backend == "dense_int8":
@@ -444,6 +473,32 @@ class ContinuousEngine:
             "Tokens through the shared ragged boundary launch, by phase",
             ("engine", "phase"),
         )
+        # Collective wire accounting (tp serving only): analytic per-step
+        # byte counts from the tp engine (shapes are static, so the counts
+        # are exact for what the joins ship — parallel/collectives.py),
+        # credited per dispatched segment and per admission prefill. The
+        # wire savings of qpsum vs psum are a scrapeable number.
+        self._collective_counter = self.obs.registry.counter(
+            "edgemesh_collective_bytes_total",
+            "Collective wire bytes moved by serving forwards, by op and dtype",
+            ("engine", "op", "dtype"),
+        )
+        if tp_engine is not None:
+            acct = tp_engine.collective_accounting(batch=1)
+            self._collective_meta = {
+                "collective_op": acct["op"],
+                "collective_dtype": acct["dtype"],
+                "collective_per_layer_bytes": acct["per_layer"],
+            }
+            # Per decode step the WHOLE pool rides the joins ([slots, 1, H]
+            # payloads); per admission the one-row prefill ships [1, s, H].
+            self._collective_step_bytes = tp_engine.collective_accounting(
+                batch=self.n_slots
+            )["bytes_per_step"]
+            self._collective_row_bytes = acct["bytes_per_step"]
+            self._collective_labels = self._collective_counter.labels(
+                engine=self.obs_engine_label, op=acct["op"], dtype=acct["dtype"]
+            )
         self._update_page_gauges()
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
@@ -508,6 +563,10 @@ class ContinuousEngine:
                 "chunk": self.chunk,
                 "kv_backend": self.kv_backend,
             }
+            if self._tp is not None:
+                out["tp"] = self._tp.tp
+                out["collective_mode"] = self._tp.collective_mode
+                out["collective_dtype"] = self._tp.comm_dtype
             if self._paged:
                 out["total_pages"] = self.total_pages
                 out["reserved_pages"] = self._reserved_pages
@@ -656,10 +715,21 @@ class ContinuousEngine:
             mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(tokens, valid).mask
             sidx = jnp.asarray(idx, jnp.int32)
             if self.kv_backend == "dense":
-                row_cache = init_kv_cache(self.cfg, 1, cap)
-                logits1, row_cache = forward_prefill(
-                    self.cfg, agent.params, tokens, lengths, row_cache
-                )
+                if self._tp is not None:
+                    row_cache = self._tp.init_cache(1, cap)
+                    logits1, row_cache = self._tp.prefill(
+                        tokens, lengths, row_cache
+                    )
+                    self._collective_labels.inc(
+                        self._tp.collective_accounting(
+                            batch=1, seq=int(tokens.shape[1])
+                        )["bytes_per_step"]
+                    )
+                else:
+                    row_cache = init_kv_cache(self.cfg, 1, cap)
+                    logits1, row_cache = forward_prefill(
+                        self.cfg, agent.params, tokens, lengths, row_cache
+                    )
                 k, v, ln, self._logits, self._mask, self._finished = _splice_slot(
                     self._cache.k, self._cache.v, self._cache.lengths,
                     self._logits, self._mask, self._finished,
@@ -759,6 +829,7 @@ class ContinuousEngine:
         self.obs.admitted(
             trace, prompt_tokens=plen,
             shared_prefix_hit=bool(self._paged and match),
+            **(self._collective_meta if self._tp is not None else {}),
         )
         self._slots[idx] = _Slot(
             future=fut, question=question, emitted=[], remaining=budget,
@@ -1033,7 +1104,9 @@ class ContinuousEngine:
                 self._slots[i] = _Slot()
                 self._gen[i] += 1
         self._finished = jnp.ones((self.n_slots,), bool)
-        if self.kv_backend == "dense":
+        if self._tp is not None:
+            self._cache = self._tp.init_cache(self.n_slots, self.cfg.max_seq_len)
+        elif self.kv_backend == "dense":
             self._cache = init_kv_cache(self.cfg, self.n_slots, self.cfg.max_seq_len)
         elif self.kv_backend == "dense_int8":
             from edgemesh.runtime.quant_kv import init_quant_kv_cache
@@ -1124,13 +1197,19 @@ class ContinuousEngine:
                     self._finished,
                 )
         out, counts, cache, _, mask, prev, fin = _decode_loop(
-            self.cfg, agent.params, agent.sampling, self.chunk, eos_id,
+            self.cfg, self._params, agent.sampling, self.chunk, eos_id,
             self._logits, self._cache, self._mask, seg_rng,
             self._decode_fn, self._finished,
         )
         self._mask, self._finished = mask, fin
         with self._cond:  # stats() reads this under the lock
             self.segments += 1
+        if self._tp is not None:
+            # chunk decode steps + the trailing bridge, each a full-pool
+            # forward through the collective joins.
+            self._collective_labels.inc(
+                (self.chunk + 1) * self._collective_step_bytes
+            )
         self.obs.segment_dispatched()
         if self._ragged:
             # The NEXT boundary consumes prev; nothing else runs here.
@@ -1143,7 +1222,7 @@ class ContinuousEngine:
             # to know whether anyone survives — is exactly the sync this
             # pipeline removes.
             self._logits, self._cache = self._bridge(
-                self.cfg, agent.params, prev, cache, fin
+                self.cfg, self._params, prev, cache, fin
             )
         if self._paged:
             # +0 detaches the tripwire snapshot from the cache buffer — the
@@ -1184,7 +1263,14 @@ class ContinuousEngine:
                 toks = toks[:-1]
             slot.emitted.extend(toks)
             slot.remaining -= n
-            self.obs.tokens(slot.trace, len(toks))
+            # tp serving: each decode span carries its slice of the wire
+            # (tokens x per-row collective bytes) so `edgemesh obs trace`
+            # can roll the savings up per request (obs/trace.critical_path).
+            attrs = (
+                {"collective_bytes": len(toks) * self._collective_row_bytes}
+                if self._tp is not None else {}
+            )
+            self.obs.tokens(slot.trace, len(toks), **attrs)
             if bool(fin_h[i]) or slot.remaining <= 0:
                 self._retire(i)
 
@@ -1670,6 +1756,10 @@ def make_engine(agent, **kwargs):
     speculative engine; everything else gets the plain one. (An explicit
     class choice always works too — this is the convenience entry the REST
     server uses.)"""
+    if kwargs.get("tp_engine", None) is None:
+        # The speculative engine (below) has no tp path; only forward the
+        # kwarg when a tensor-parallel engine is actually attached.
+        kwargs.pop("tp_engine", None)
     if (
         getattr(agent, "draft_cfg", None) is not None
         and kwargs.get("kv_backend", "dense") in ("paged", "paged_int8")

@@ -552,7 +552,9 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
                kv_page_size: int = 64, admission: str = "fifo",
                span_log=None, registry=None, max_inflight: int = 0,
                request_timeout_s: float | None = 300.0,
-               trace_sample: float = 1.0, profile_dir=None):
+               trace_sample: float = 1.0, profile_dir=None,
+               tp: int = 0, collective_mode: str = "psum",
+               collective_dtype: str = "int8"):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
@@ -586,6 +588,13 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     ``profile_dir`` opts in ``GET /debug/profile?seconds=N`` captures
     (disabled when None — see the security note in docs/OBSERVABILITY.md).
 
+    ``tp > 1`` (continuous only) serves through the tensor-parallel
+    shard_map engine (parallel/tp_infer.py) on a dp=1 × tp mesh:
+    ``collective_mode`` ("psum" | "qpsum" | "qpsum_overlap") and
+    ``collective_dtype`` ("int8" | "fp8" | "bf16") pick the cross-chip
+    join for the row-sharded projections (parallel/collectives.py — the
+    quantized/overlapped wire is how tp8 serving earns its chips).
+
     ``max_inflight`` bounds concurrently-admitted generate requests (past
     it: 503 + Retry-After; 0 = unbounded). ``request_timeout_s`` is the
     per-connection socket timeout (None disables). The returned server is a
@@ -613,6 +622,13 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
             "policy lives in the ContinuousEngine); add --continuous, or "
             "drop the flag for the batched paths"
         )
+    if tp and int(tp) > 1 and not continuous:
+        raise ValueError(
+            f"tp={tp} requires continuous=True (tensor-parallel serving "
+            "runs through the ContinuousEngine over the shard_map engine); "
+            "add --continuous, or drop the flag — silently serving "
+            "single-chip would misreport the deployment"
+        )
     if continuous:
         from edgemesh.serve.continuous import make_engine
 
@@ -629,12 +645,34 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
                 f"{' + refiner' if ensemble.refiner else ''}); use --batch "
                 "for multi-agent ensembles"
             )
+        tp_engine = None
+        if tp and int(tp) > 1:
+            from edgemesh.parallel.mesh import build_mesh
+            from edgemesh.parallel.tp_infer import TPInferenceEngine
+
+            if kv_backend != "dense":
+                raise ValueError(
+                    f"tp={tp} serving runs on kv_backend='dense' "
+                    f"(got {kv_backend!r})"
+                )
+            agent = ensemble.qa_agents[0]
+            tp_engine = TPInferenceEngine(
+                agent.cfg, agent.params, build_mesh(dp=1, tp=int(tp)),
+                collective_mode=collective_mode, comm_dtype=collective_dtype,
+            )
+        elif collective_mode != "psum":
+            raise ValueError(
+                f"collective_mode={collective_mode!r} needs tp > 1 (the "
+                "collective joins live in the tensor-parallel engine); add "
+                "--tp N, or drop the flag"
+            )
         # A draft-carrying agent on the paged backend gets the speculative
         # engine (pool-wide draft→verify rounds); otherwise the plain one.
         batcher = make_engine(
             ensemble.qa_agents[0], slots=batch or 8, kv_backend=kv_backend,
             page_size=kv_page_size, admission=admission, span_log=span_log,
             registry=registry, trace_sample=trace_sample,
+            tp_engine=tp_engine,
         )
     elif batch > 1:
         from edgemesh.serve.batcher import DynamicBatcher

@@ -120,6 +120,7 @@ def test_headline_serving_schema_gains_ragged_and_spec_keys(monkeypatch, capsys)
     monkeypatch.setattr(benchmarks, "load_curve_benchmark", fake_load_curve)
     monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_ADMIT", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
 
     out = benchmarks.headline_benchmark(preset="llama1b", batch=2,
@@ -210,9 +211,102 @@ def test_load_curve_stage_is_skippable_via_env(monkeypatch, capsys):
     monkeypatch.setenv("EDGEMESH_BENCH_FLEET", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     out = benchmarks.headline_benchmark(preset="tiny", batch=2,
                                         decode_steps=8, sweep_batches=())
     assert not any(k.startswith("load_curve") for k in out)
+
+
+def _fake_stage1(monkeypatch):
+    """Shared stage-1 fakes: a headline int8 decode that succeeds without
+    touching a device, everything heavier gated off by callers."""
+
+    def fake_build(preset, precision, quant_mode):
+        return ("cfg", "params")
+
+    def fake_decode(preset, precision, quant_mode="w8a16", batch=8, **kw):
+        return {"metric": "m", "value": 100.0, "unit": "tok/s/chip",
+                "vs_baseline": 3.9, "ttft_s": 0.01, "hbm_eff_gbs": 1.0,
+                "hbm_util": 0.1, "weight_gb": 1.0, "batch": batch,
+                "decode_steps": 8}
+
+    monkeypatch.setattr(benchmarks, "_build", fake_build)
+    monkeypatch.setattr(benchmarks, "decode_benchmark", fake_decode)
+
+
+_TP8_GATES = ("EDGEMESH_BENCH_8B", "EDGEMESH_BENCH_SERVE",
+              "EDGEMESH_BENCH_FLEET", "EDGEMESH_BENCH_SPEC",
+              "EDGEMESH_BENCH_LOADGEN")
+
+
+def test_tp8_stage_schema_pins(monkeypatch, capsys):
+    """The quantized-collective schema contract: a headline run carries the
+    serving_tp8_tok_s headline (mode/dtype/wire bytes alongside) and the
+    collective_ablation keys — per-arm tok/s at b8/b32, the qpsum-vs-psum
+    and overlap-vs-qpsum ratios, and the greedy-agreement quality delta the
+    PERFORMANCE.md targets reference."""
+    _fake_stage1(monkeypatch)
+    for gate in _TP8_GATES:
+        monkeypatch.setenv(gate, "0")
+
+    def fake_tp_serving(preset, built=None, **kw):
+        return {"metric": "serving_tp8_tok_s", "value": 1500.0, "unit": "tok/s",
+                "tp": 8, "collective_mode": "qpsum_overlap",
+                "collective_dtype": "int8", "wave_tok_s": [1500.0],
+                "req_s": 4.0, "latency_s_p50": 0.4, "latency_s_p95": 0.8,
+                "collective_bytes": 123456, "stats": {"tp": 8}}
+
+    def fake_ablation(preset, built=None, **kw):
+        out = {"collective_tp": 8, "collective_batches": [8, 32]}
+        for b in (8, 32):
+            out[f"collective_psum_b{b}_tok_s"] = 1000.0
+            out[f"collective_qpsum_b{b}_tok_s"] = 1200.0
+            out[f"collective_qpsum_overlap_b{b}_tok_s"] = 1350.0
+            out[f"qpsum_over_psum_b{b}"] = 1.2
+            out[f"qpsum_overlap_over_psum_b{b}"] = 1.35
+            out[f"overlap_over_qpsum_b{b}"] = 1.125
+            out[f"qpsum_greedy_agreement_b{b}"] = 0.9995
+            out[f"qpsum_overlap_greedy_agreement_b{b}"] = 0.9995
+        return out
+
+    monkeypatch.setattr(benchmarks, "tp_serving_benchmark", fake_tp_serving)
+    monkeypatch.setattr(benchmarks, "collective_ablation_benchmark",
+                        fake_ablation)
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert out["serving_tp8_tok_s"] == 1500.0
+    assert out["serving_tp8_collective_mode"] == "qpsum_overlap"
+    assert out["serving_tp8_collective_dtype"] == "int8"
+    assert out["serving_tp8_collective_bytes"] == 123456
+    for b in (8, 32):
+        assert out[f"collective_psum_b{b}_tok_s"] == 1000.0
+        assert out[f"collective_qpsum_b{b}_tok_s"] == 1200.0
+        assert out[f"collective_qpsum_overlap_b{b}_tok_s"] == 1350.0
+        assert out[f"qpsum_over_psum_b{b}"] == 1.2
+        assert out[f"overlap_over_qpsum_b{b}"] == 1.125
+        # The quality-delta column must be populated.
+        assert out[f"qpsum_greedy_agreement_b{b}"] == 0.9995
+        assert out[f"qpsum_overlap_greedy_agreement_b{b}"] == 0.9995
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert "serving_tp8_tok_s" in lines[-1]
+
+
+def test_tp8_stage_is_skippable_via_env(monkeypatch, capsys):
+    """EDGEMESH_BENCH_TP8=0 must skip BOTH tp8 stages entirely — no engine
+    built, no keys, no error recorded (mirrors the loadgen gate)."""
+    _fake_stage1(monkeypatch)
+    for gate in _TP8_GATES:
+        monkeypatch.setenv(gate, "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+
+    def boom(*a, **kw):
+        raise AssertionError("tp8 stage ran despite the gate")
+
+    monkeypatch.setattr(benchmarks, "tp_serving_benchmark", boom)
+    monkeypatch.setattr(benchmarks, "collective_ablation_benchmark", boom)
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert not any("tp8" in k or k.startswith("collective_") for k in out)
 
 
 def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
@@ -242,6 +336,7 @@ def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
     # load-curve stages would spin real in-process replicas here.
     monkeypatch.setenv("EDGEMESH_BENCH_FLEET", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
 
     out = benchmarks.headline_benchmark(preset="tiny", batch=2, decode_steps=8,
                                         sweep_batches=())

@@ -1,0 +1,238 @@
+"""Quantized/overlapped collectives (parallel/collectives.py + the chunked
+projection joins in parallel/tp_infer.py).
+
+Numerics contract: qpsum must track exact ``lax.psum`` within a PINNED
+per-dtype bound on adversarial inputs — outlier channels, near-zero chunks —
+at every world size the serving stack registers (2/4/8, on the suite's
+forced-8-device CPU platform), and the chunked-overlap decomposition must
+reassemble the monolithic matmul+psum for every chunk count. A broken scale
+or ring index blows these bounds by orders of magnitude; normal quantization
+noise sits well inside them.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from edgemesh.parallel.collectives import (
+    COMM_DTYPES,
+    collective_wire_bytes,
+    qpsum,
+    validate_collective_mode,
+)
+from edgemesh.parallel.mesh import build_mesh
+from edgemesh.utils.compat import shard_map
+
+#: Pinned per-dtype error coefficients: for every per-row group,
+#: |qpsum - psum| <= C * world * absmax(inputs in that row's group). The
+#: error scales with the magnitudes QUANTIZED (the running partials, up to
+#: world x the input absmax — outliers can cancel in the exact sum, so the
+#: result magnitude is the wrong yardstick). Measured worst cases across
+#: seeds sit at 0.0457*amax (int8, w2) and 0.234*amax (fp8, w8) — these
+#: pins carry >=2.7x margin while a broken scale or ring index lands at
+#: ~1x amax and beyond.
+_BOUND_COEFF = {"int8": 1 / 16.0, "fp8": 1 / 8.0}
+
+
+def _qpsum_sharded(x, world, dtype, devices):
+    mesh = build_mesh(tp=world, devices=devices[:world])
+    f = shard_map(
+        lambda xs: qpsum(xs, "tp", dtype=dtype),
+        mesh=mesh,
+        in_specs=(P("tp", None, None),),
+        out_specs=P("tp", None, None),
+        check_vma=False,
+    )
+    return np.asarray(jax.jit(f)(x), np.float32)
+
+
+def _psum_ref(x, world):
+    xs = np.asarray(x, np.float32).reshape(world, -1, *x.shape[1:])
+    total = xs.sum(axis=0)  # one shard's worth, summed over the axis
+    return np.tile(total, (world,) + (1,) * (total.ndim - 1))
+
+
+def _adversarial(world, rows=2, h=48, seed=0):
+    """Outlier channels + near-zero chunks + ordinary noise, stacked so each
+    shard's rows carry all three regimes."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(world * rows, 3, h)).astype(np.float32)
+    x[:, 0, 0] = 1e4 * rng.choice([-1.0, 1.0], size=world * rows)  # outlier
+    x[:, 1, :] = 1e-7 * rng.normal(size=(world * rows, h))  # near-zero chunk
+    return jnp.asarray(x)
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize("dtype", ["int8", "fp8"])
+def test_qpsum_error_bound_adversarial(devices, world, dtype):
+    x = _adversarial(world)
+    got = _qpsum_sharded(x, world, dtype, devices)
+    ref = _psum_ref(x, world)
+    # Per-(row, regime) bound: scales are per leading row, so one outlier
+    # row must not get judged against — or hide behind — the quiet rows.
+    xs = np.asarray(x, np.float32).reshape(world, -1, 3, x.shape[-1])
+    err = np.abs(got - ref).reshape(world, -1, 3, x.shape[-1]).max(axis=(0, 3))
+    amax = np.abs(xs).max(axis=(0, 3))
+    bound = _BOUND_COEFF[dtype] * world * np.maximum(amax, 1e-6)
+    assert np.all(err <= bound), (err, bound)
+    # All-zero slices must dequantize to EXACT zeros (clamped scale, not
+    # 0/0 garbage).
+    zero = jnp.zeros((world * 2, 3, 48), jnp.float32)
+    assert np.all(_qpsum_sharded(zero, world, dtype, devices) == 0.0)
+
+
+def test_qpsum_bf16_mode_and_world1_are_plain_psum(devices):
+    x = _adversarial(4)
+    got = _qpsum_sharded(x, 4, "bf16", devices)
+    np.testing.assert_allclose(got, _psum_ref(x, 4), rtol=0, atol=0)
+    # world 1: identity-sum (nothing on the wire).
+    mesh = build_mesh(tp=1, devices=devices[:1])
+    f = shard_map(
+        lambda xs: qpsum(xs, "tp", dtype="int8"),
+        mesh=mesh, in_specs=(P(None, None),), out_specs=P(None, None),
+        check_vma=False,
+    )
+    y = jnp.asarray(np.random.default_rng(1).normal(size=(4, 48)), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(f(y)), np.asarray(y))
+
+
+def test_qpsum_indivisible_trailing_dim_falls_back_exact(devices):
+    # h=9 does not chunk over tp=4: the plain-psum fallback must be exact.
+    x = jnp.asarray(
+        np.random.default_rng(2).normal(size=(8, 3, 9)), jnp.float32
+    )
+    got = _qpsum_sharded(x, 4, "int8", devices)
+    np.testing.assert_allclose(got, _psum_ref(x, 4), rtol=1e-6, atol=1e-6)
+
+
+def test_qpsum_replicated_across_shards(devices):
+    """Every shard must hold bit-identical results (the all-gather
+    re-quantizes the local chunk too) — out_specs replication is a real
+    claim, not a vibe."""
+    x = _adversarial(4, seed=3)
+    got = _qpsum_sharded(x, 4, "int8", devices).reshape(4, -1, 3, 48)
+    for i in range(1, 4):
+        np.testing.assert_array_equal(got[i], got[0])
+
+
+@pytest.mark.parametrize("n_chunks", [1, 2, 3, 4, 8])
+def test_chunked_overlap_decomposition_matches_monolithic(devices, n_chunks):
+    """The qpsum_overlap projection split (tp_infer._collective_dense):
+    disjoint OUTPUT-dim slices joined per-chunk must reassemble the
+    monolithic matmul + psum for EVERY chunk count — bf16 wire makes it
+    exact, and the (tp-pre-divided) bias slices with the columns so the
+    concatenation carries it exactly once."""
+    from edgemesh.parallel.tp_infer import _collective_dense
+
+    world, in_dim, out_dim = 4, 24, 10
+    rng = np.random.default_rng(4)
+    kernel = rng.normal(size=(in_dim, out_dim)).astype(np.float32)
+    bias = rng.normal(size=(out_dim,)).astype(np.float32)
+    x = rng.normal(size=(2, 3, in_dim)).astype(np.float32)
+    mesh = build_mesh(tp=world, devices=devices[:world])
+
+    def body(k_shard, x_shard):
+        # The tp_infer convention: row-sharded kernel, replicated bias
+        # pre-divided by tp (each shard's dense adds bias/tp; the join
+        # reassembles the full bias).
+        p = {"kernel": k_shard, "bias": jnp.asarray(bias / world)}
+        return _collective_dense(
+            p, x_shard, "qpsum_overlap", "bf16", n_chunks, "w8a16"
+        )
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=(P("tp", None), P(None, None, "tp")),
+        out_specs=P(None, None, None),
+        check_vma=False,
+    )
+    got = np.asarray(jax.jit(f)(jnp.asarray(kernel), jnp.asarray(x)))
+    ref = x @ kernel + bias
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_chunked_overlap_int8_wire_stays_in_bound(devices):
+    from edgemesh.parallel.tp_infer import _collective_dense
+
+    world, in_dim, out_dim = 4, 24, 16
+    rng = np.random.default_rng(5)
+    kernel = rng.normal(size=(in_dim, out_dim)).astype(np.float32)
+    x = rng.normal(size=(2, 3, in_dim)).astype(np.float32)
+    mesh = build_mesh(tp=world, devices=devices[:world])
+    f = shard_map(
+        lambda k, xs: _collective_dense(
+            {"kernel": k}, xs, "qpsum_overlap", "int8", 4, "w8a16"
+        ),
+        mesh=mesh,
+        in_specs=(P("tp", None), P(None, None, "tp")),
+        out_specs=P(None, None, None),
+        check_vma=False,
+    )
+    got = np.asarray(jax.jit(f)(jnp.asarray(kernel), jnp.asarray(x)))
+    ref = x @ kernel
+    bound = _BOUND_COEFF["int8"] * world * np.max(np.abs(ref))
+    assert np.max(np.abs(got - ref)) <= bound
+
+
+def test_wire_accounting_and_mode_validation():
+    shape = (1, 1, 2048)
+    psum = collective_wire_bytes(shape, 8, "psum")
+    q = collective_wire_bytes(shape, 8, "qpsum", "int8")
+    assert psum > 0 and q > 0
+    # Quantization must at least approach halving the wire; the float32
+    # per-row scales are the only overhead.
+    assert q < 0.6 * psum
+    assert collective_wire_bytes(shape, 1, "qpsum", "int8") == 0
+    # Non-divisible trailing dims fall back to the full-precision wire.
+    assert collective_wire_bytes((1, 1, 9), 8, "qpsum", "int8") == \
+        collective_wire_bytes((1, 1, 9), 8, "psum")
+    for dtype in COMM_DTYPES:
+        if dtype == "fp8" and getattr(jnp, "float8_e4m3fn", None) is None:
+            continue
+        validate_collective_mode("qpsum", dtype)
+    with pytest.raises(ValueError, match="collective_mode"):
+        validate_collective_mode("ring", "int8")
+    with pytest.raises(ValueError, match="dtype"):
+        validate_collective_mode("qpsum", "int3")
+
+
+def test_tp_engine_collective_accounting():
+    """The engine-side accounting (what the serving counter and span attrs
+    consume) mirrors collective_wire_bytes: two joins per layer, quantized
+    ops report the narrow wire."""
+    from edgemesh.models import init_params
+    from edgemesh.models.families import tiny_config
+
+    from edgemesh.parallel.tp_infer import TPInferenceEngine
+
+    cfg = tiny_config("llama", num_heads=8, num_kv_heads=8, num_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(dp=1, tp=8)
+    eng_q = TPInferenceEngine(cfg, params, mesh, attention_impl="xla",
+                              collective_mode="qpsum_overlap")
+    eng_p = TPInferenceEngine(cfg, params, mesh, attention_impl="xla")
+    aq, ap = eng_q.collective_accounting(batch=4), eng_p.collective_accounting(batch=4)
+    assert aq["op"] == "qpsum" and aq["dtype"] == "int8"
+    assert ap["op"] == "psum" and ap["dtype"] == "bf16"
+    # Output-dim chunking: k disjoint [b, 1, h/k] joins per projection —
+    # the payloads sum to the monolithic join plus k x the per-row scale
+    # vectors, NEVER a multiple of the full payload (the contraction-split
+    # wire-blowup regression would read k x mono here). At this tiny
+    # hidden the scale vectors dominate, so the meaningful pin is the
+    # blowup bound, not chunked < psum (test_wire_accounting covers the
+    # halving at a production-sized hidden).
+    k = eng_q.overlap_chunks
+    per = k * collective_wire_bytes(
+        (4, 1, cfg.hidden_size // k), 8, "qpsum", "int8"
+    )
+    assert aq["per_layer"] == {"attn_o": per, "mlp_down": per}
+    assert aq["bytes_per_step"] == cfg.num_layers * 2 * per
+    mono = collective_wire_bytes((4, 1, cfg.hidden_size), 8, "qpsum", "int8")
+    # Exact decomposition: chunking adds (k-1) extra per-row float32 scale
+    # vectors per hop and NOTHING else; the contraction-split regression
+    # would read k * mono (every chunk all-reducing the full output).
+    rows, hops = 4, 2 * (8 - 1)
+    assert per == mono + (k - 1) * rows * 4 * hops
+    assert per < k * mono

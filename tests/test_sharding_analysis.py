@@ -357,6 +357,49 @@ def test_dryrun_covers_the_required_layouts():
     wrappers = {c["wrapper"] for c in SHARDING_CONTRACTS}
     assert wrappers >= {"tp_infer", "ring_attention", "ulysses", "pipeline",
                         "spmd"}
+    # The quantized-collective layer (PR 11): qpsum itself plus both
+    # non-psum tp programs trace under every tp layout — "does tp8 trace
+    # with quantized, overlapped collectives" is a fast-tier fact.
+    for wrapper in ("collectives", "tp_infer_qpsum", "tp_infer_qpsum_overlap"):
+        assert wrapper in wrappers, wrapper
+        entry = next(c for c in SHARDING_CONTRACTS if c["wrapper"] == wrapper)
+        assert set(entry["layouts"]) >= {"tp2", "tp8", "dp2xtp4"}
+
+
+def test_em401_and_em403_know_qpsum():
+    """qpsum is a registered collective: an unbound axis is EM401, and a
+    qpsum on the contraction axis CLEARS the EM403 partial-sum taint just
+    like lax.psum."""
+    from edgemesh.analysis.sharding import analyze_source
+
+    unbound = analyze_source(
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "from edgemesh.parallel.collectives import qpsum\n"
+        "from edgemesh.utils.compat import shard_map\n"
+        "def f(mesh_devs):\n"
+        "    mesh = Mesh(mesh_devs, ('sp',))\n"
+        "    def body(x):\n"
+        "        return qpsum(x, 'tp', dtype='int8')\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(P('sp'),),\n"
+        "                     out_specs=P('sp'))\n"
+    )
+    assert [f.rule for f in unbound] == ["EM401"]
+    assert "'tp'" in unbound[0].message
+
+    reduced = analyze_source(
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "from edgemesh.parallel.collectives import qpsum\n"
+        "from edgemesh.utils.compat import shard_map\n"
+        "def f(mesh_devs):\n"
+        "    mesh = Mesh(mesh_devs, ('tp',))\n"
+        "    def body(x, w):\n"
+        "        y = x @ w\n"
+        "        return qpsum(y, 'tp', dtype='int8')\n"
+        "    return shard_map(body, mesh=mesh,\n"
+        "                     in_specs=(P(None, 'tp'), P('tp', None)),\n"
+        "                     out_specs=P(None, None))\n"
+    )
+    assert [f.rule for f in reduced] == []
 
 
 def test_dryrun_broken_spec_names_wrapper_and_layout(monkeypatch):

@@ -1,0 +1,174 @@
+"""Tensor-parallel continuous serving (serve/continuous.py ``tp_engine=``):
+the acceptance gate for the quantized, overlapped collective layer — the
+continuous engine serves end-to-end on a forced-8-device CPU mesh with
+``collective_mode="qpsum_overlap"`` and emits greedy tokens matching the
+bf16-psum arm within the pinned agreement bound, with the collective wire
+accounted in metrics and spans.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from edgemesh.agents.orchestrator import build_agent
+from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
+from edgemesh.obs import Registry
+from edgemesh.parallel.mesh import build_mesh
+from edgemesh.parallel.tp_infer import TPInferenceEngine
+from edgemesh.serve.continuous import ContinuousEngine
+from edgemesh.utils.tracing import JsonlLogger
+
+# Engines compile per mode; multi-minute territory — slow tier.
+pytestmark = pytest.mark.slow
+
+#: The 0.999 ship gate PERFORMANCE.md pins applies to the bench on
+#: real-scale models, where top-2 logit gaps dwarf the int8 wire noise.
+#: This tiny RANDOM model decodes through near-ties (top-2 gaps at the
+#: quantization-noise scale), so single argmax flips are expected and
+#: deterministic on the CPU backend — the pin here is set where it still
+#: catches real breakage (a broken ring/scale lands near chance
+#: agreement, ~1/260 per token) without failing on a near-tie flip.
+TINY_MODEL_AGREEMENT_BOUND = 0.75
+
+
+def _agent():
+    return build_agent(AgentSpec(
+        role="qa",
+        model=ModelSpec(
+            family="llama", vocab_size=260, num_layers=2, hidden_size=64,
+            num_heads=8, num_kv_heads=8, intermediate_size=128,
+            max_seq_len=128,
+        ),
+        sampling=SamplingParams(max_new_tokens=8, do_sample=False,
+                                repetition_penalty=1.0),
+    ))
+
+
+def _serve(agent, mode, dtype, questions, span_log=None):
+    tp_eng = TPInferenceEngine(
+        agent.cfg, agent.params, build_mesh(dp=1, tp=8),
+        attention_impl="xla", collective_mode=mode, comm_dtype=dtype,
+    )
+    reg = Registry()
+    eng = ContinuousEngine(agent, slots=2, chunk=4, kv_backend="dense",
+                           registry=reg, tp_engine=tp_eng, span_log=span_log)
+    try:
+        futs = [eng.submit(q) for q in questions]
+        results = [f.result() for f in futs]
+        stats = eng.stats()
+    finally:
+        eng.close()
+    return results, reg, stats
+
+
+def _agreement(a: str, b: str) -> float:
+    if a == b:
+        return 1.0
+    n = max(len(a), len(b), 1)
+    return sum(x == y for x, y in zip(a, b)) / n
+
+
+def test_qpsum_overlap_serving_matches_bf16_psum_arm(devices, tmp_path):
+    """The acceptance criterion: continuous serving over tp8 with
+    qpsum_overlap produces the bf16-psum arm's greedy tokens within the
+    pinned agreement bound (see TINY_MODEL_AGREEMENT_BOUND — the 0.999
+    gate rides the bench on real models), requests joining mid-flight
+    included."""
+    agent = _agent()
+    qs = [
+        "what color is the sky on a clear day?",
+        "name a fruit that is yellow.",
+        "how many legs does a spider have?",
+    ]
+    base, _, _ = _serve(agent, "psum", "bf16", qs)
+    log = tmp_path / "spans.jsonl"
+    got, reg, stats = _serve(agent, "qpsum_overlap", "int8", qs,
+                             span_log=str(log))
+    for r_base, r_got in zip(base, got):
+        assert r_got["generated"] == r_base["generated"] > 0
+        assert _agreement(r_base["answer"], r_got["answer"]) >= \
+            TINY_MODEL_AGREEMENT_BOUND
+
+    # Engine surface: the tp knobs ride /stats.
+    assert stats["tp"] == 8
+    assert stats["collective_mode"] == "qpsum_overlap"
+    assert stats["collective_dtype"] == "int8"
+
+    # Wire accounting: the counter carries the quantized op/dtype and a
+    # byte total consistent with the segment math (chunk+1 steps per
+    # dispatched segment plus the admission prefills — all > 0).
+    snap = reg.snapshot()
+    samples = snap["edgemesh_collective_bytes_total"]["samples"]
+    assert len(samples) == 1
+    labels = samples[0]["labels"]
+    assert labels["op"] == "qpsum" and labels["dtype"] == "int8"
+    assert samples[0]["value"] > 0
+
+    # Span records: prefill carries the per-layer accounting attrs, decode
+    # spans carry their slice of the wire (critical_path rolls them up).
+    recs = [r for r in JsonlLogger(log).read()
+            if r.get("event") == "request_spans"]
+    assert len(recs) == 3
+    for rec in recs:
+        assert rec["collective_op"] == "qpsum"
+        assert rec["collective_dtype"] == "int8"
+        assert rec["collective_per_layer_bytes"]["attn_o"] > 0
+        decode_bytes = [
+            s.get("collective_bytes") for s in rec["spans"]
+            if s["name"] == "decode"
+        ]
+        assert sum(b or 0 for b in decode_bytes) > 0
+
+
+def test_tp_serving_matches_plain_single_program_engine(devices):
+    """The psum arm over tp8 must be token-identical to the unsharded
+    single-program continuous engine — tensor parallelism is an execution
+    detail, not a model change."""
+    agent = _agent()
+    q = "what color is the sky on a clear day?"
+    plain = ContinuousEngine(agent, slots=2, chunk=4, kv_backend="dense",
+                             registry=Registry())
+    try:
+        a = plain.answer(q)
+    finally:
+        plain.close()
+    got, _, _ = _serve(agent, "psum", "bf16", [q])
+    assert got[0]["answer"] == a["answer"]
+    assert got[0]["generated"] == a["generated"] > 0
+
+
+def test_tp_engine_requires_dense_backend_and_dp1(devices):
+    agent = _agent()
+    tp_eng = TPInferenceEngine(agent.cfg, agent.params, build_mesh(dp=1, tp=8),
+                               attention_impl="xla")
+    with pytest.raises(ValueError, match="dense"):
+        ContinuousEngine(agent, slots=2, kv_backend="paged", tp_engine=tp_eng)
+    dp_eng = TPInferenceEngine(agent.cfg, agent.params, build_mesh(dp=2, tp=4),
+                               attention_impl="xla")
+    with pytest.raises(ValueError, match="dp=1"):
+        ContinuousEngine(agent, slots=2, kv_backend="dense", tp_engine=dp_eng)
+
+
+def test_tp_generate_greedy_qpsum_modes_match_psum(devices):
+    """Engine-level ablation shape: generate_greedy under qpsum/
+    qpsum_overlap agrees with the psum arm within the pinned bound on a
+    tp8 mesh (the bench's quality-delta column, minus the wall clock)."""
+    from edgemesh.models import init_params
+    from edgemesh.models.families import tiny_config
+
+    cfg = tiny_config("llama", num_heads=8, num_kv_heads=8, num_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(dp=1, tp=8)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0,
+                                cfg.vocab_size)
+    lengths = np.asarray([5, 5])
+    ref = None
+    for mode in ("psum", "qpsum", "qpsum_overlap"):
+        eng = TPInferenceEngine(cfg, params, mesh, attention_impl="xla",
+                                collective_mode=mode)
+        toks = np.asarray(eng.generate_greedy(
+            tokens, jax.numpy.asarray(lengths), max_new=6))
+        if ref is None:
+            ref = toks
+        else:
+            assert float(np.mean(toks == ref)) >= TINY_MODEL_AGREEMENT_BOUND

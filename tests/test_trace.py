@@ -176,6 +176,38 @@ def test_critical_path_sums_to_total_and_splits_stages(tmp_path):
     assert parts == pytest.approx(cp["total_s"], abs=1e-6)
 
 
+def test_critical_path_collective_phase_and_byte_rollup():
+    """tp serving: decode spans carry collective_bytes attrs (exact wire
+    accounting) and backends that measure the phase emit "collective"
+    spans — critical_path rolls both up, with collective_s a sub-phase OF
+    decode (outside the sum-to-total)."""
+    tree = {
+        "name": "request", "t0": 0.0, "t1": 1.0,
+        "children": [{
+            "name": "server", "t0": 0.0, "t1": 1.0,
+            "children": [
+                {"name": "queued", "t0": 0.0, "t1": 0.1},
+                {"name": "prefill", "t0": 0.1, "t1": 0.3,
+                 "collective_bytes": 4096},
+                {"name": "decode", "t0": 0.3, "t1": 0.9, "tokens": 6,
+                 "collective_bytes": 1024},
+                {"name": "decode", "t0": 0.9, "t1": 1.0, "tokens": 2,
+                 "collective_bytes": 512},
+                {"name": "collective", "t0": 0.4, "t1": 0.55},
+            ],
+        }],
+    }
+    cp = critical_path(tree)
+    assert cp["collective_bytes"] == 4096 + 1024 + 512
+    assert cp["collective_s"] == pytest.approx(0.15, abs=1e-6)
+    # The sum-to-total contract is untouched by the sub-phase.
+    parts = (cp["retry_wasted_s"] + cp["wire_s"] + cp["queue_s"]
+             + cp["prefill_s"] + cp["decode_s"] + cp["other_s"])
+    assert parts == pytest.approx(cp["total_s"], abs=1e-6)
+    # Pre-collective trees keep zero defaults (forward compat both ways).
+    assert critical_path(None)["collective_bytes"] == 0
+
+
 def test_critical_path_prefers_won_attempt_over_late_ok_hedge_loser():
     # The primary answered the client at t=100.5 (won); the abandoned hedge
     # loser ALSO finished "ok" later. The split must describe the winner.
