@@ -81,6 +81,14 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   (``rec["tenant"]``) and calls other than the normalizer
   (``payload.get("tenant")``) flag, inline or via a tainted local.
 
+- EM113 span-schema-bypass (error): a ``json.dumps`` + file write, under
+  ``edgemesh/``, of a record carrying the span event key (``"event"`` in
+  the span vocabulary, or a ``"spans"`` list) outside the sanctioned
+  producers (``SpanTracker``/``FlightRecorder``/``JsonlLogger``) —
+  replay (`obs replay`), assembly (`obs trace`/`incident`), and the
+  offline aggregate rebuild all depend on ONE producer vocabulary, and a
+  hand-rolled writer is a second vocabulary waiting to drift.
+
 The class-level concurrency rules (EM301-EM304: lock discipline,
 lock-order cycles, blocking-under-lock, thread hygiene) live in
 ``edgemesh/analysis/concurrency.py``, and the sharding/collective rules
@@ -161,6 +169,11 @@ RULES: dict[str, dict] = {
         "name": "unbounded-metric-label",
         "severity": "error",
         "summary": "request-derived label value bypasses obs.metrics.bounded_label",
+    },
+    "EM113": {
+        "name": "span-schema-bypass",
+        "severity": "error",
+        "summary": "span-event JSONL written outside SpanTracker/FlightRecorder/JsonlLogger",
     },
 }
 
@@ -261,6 +274,27 @@ _EM112_DIRS = ("edgemesh/",)
 _EM112_LABELS = {"tenant", "session", "user", "tenant_id", "session_id",
                  "user_id"}
 _EM112_NORMALIZER = "bounded_label"
+
+# EM113 scope + surface: span-event JSONL must have ONE producer
+# vocabulary — replay (`obs replay`), assembly (`obs trace`/`incident`),
+# and the aggregate rebuild (`obs summary`) all key on the record shape
+# SpanTracker/FlightRecorder flush through JsonlLogger. A hand-rolled
+# ``json.dumps`` + file write of a record carrying the span event key
+# (an ``"event"`` in the span vocabulary, or a ``"spans"`` list) is a
+# second producer that silently drifts. Allowlisted: the sanctioned
+# producers themselves.
+_EM113_DIRS = ("edgemesh/",)
+_EM113_ALLOWED_SUFFIXES = (
+    "edgemesh/utils/tracing.py",   # JsonlLogger — THE serializer
+    "edgemesh/obs/spans.py",       # SpanTracker
+    "edgemesh/obs/flight.py",      # FlightRecorder
+)
+_EM113_EVENTS = {"request_spans", "router_spans", "pool_reset", "compile",
+                 "flight_snapshot", "flight_dump"}
+_EM113_EVENT_CONSTS = {"SPAN_RECORD_EVENT", "ROUTER_RECORD_EVENT",
+                       "RESET_RECORD_EVENT", "COMPILE_RECORD_EVENT",
+                       "ENGINE_RECORD_EVENT", "SNAPSHOT_EVENT",
+                       "DUMP_EVENT"}
 
 
 # ---------------------------------------------------------------------------
@@ -508,6 +542,7 @@ class _FileLinter:
         self._rule_serve_row_dispatch(tree)
         self._rule_metric_naming(tree)
         self._rule_unbounded_label(tree)
+        self._rule_span_schema_bypass(tree)
         # Traced ROOTS only: their walkers descend into traced nested defs,
         # so running every traced def would double-report nested call sites.
         traced_roots = [
@@ -874,6 +909,92 @@ class _FileLinter:
                     "obs.metrics.bounded_label(...) (allowlist + 'other' "
                     "overflow bucket)",
                 )
+
+    # -- EM113 -------------------------------------------------------------
+
+    def _em113_span_shaped(self, d: ast.Dict) -> bool:
+        """A dict literal carrying the span vocabulary: a ``"spans"`` key,
+        or an ``"event"`` key whose value is a span-record event — as a
+        string constant, or as a name/attribute ending in one of the
+        shared event constants (``SPAN_RECORD_EVENT`` etc.)."""
+        for key, value in zip(d.keys, d.values):
+            if not isinstance(key, ast.Constant):
+                continue
+            if key.value == "spans":
+                return True
+            if key.value != "event":
+                continue
+            if isinstance(value, ast.Constant) and value.value in _EM113_EVENTS:
+                return True
+            if isinstance(value, (ast.Name, ast.Attribute)):
+                dotted = _dotted_name(value)
+                if dotted and dotted.rsplit(".", 1)[-1] in _EM113_EVENT_CONSTS:
+                    return True
+        return False
+
+    def _em113_dict_for_arg(self, arg: ast.AST, call_line: int) -> ast.Dict | None:
+        """The dict literal behind a ``json.dumps`` argument, following one
+        level of simple local assignment (EM109's provenance style)."""
+        if isinstance(arg, ast.Dict):
+            return arg
+        if isinstance(arg, ast.Name):
+            scopes = self._scope_stack_for_line(call_line)
+            fn = scopes[-1] if scopes else None
+            if fn is None:
+                return None
+            best = None
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Assign)
+                    and sub.lineno < call_line
+                    and isinstance(sub.value, ast.Dict)
+                    and any(isinstance(t, ast.Name) and t.id == arg.id
+                            for t in sub.targets)
+                ):
+                    best = sub.value  # last assignment before the call wins
+            return best
+        return None
+
+    @staticmethod
+    def _em113_fn_writes(fn: ast.AST) -> bool:
+        """True when the function also touches a file: an ``open(...)``
+        call or a ``.write(...)`` method call — serializing a span-shaped
+        record is only a bypass once it heads for disk."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and node.func.id == "open":
+                return True
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "write":
+                return True
+        return False
+
+    def _rule_span_schema_bypass(self, tree: ast.Module) -> None:
+        if not any(d in self.relpath for d in _EM113_DIRS):
+            return
+        if any(self.relpath.endswith(sfx) for sfx in _EM113_ALLOWED_SUFFIXES):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            dotted = _dotted_name(node.func)
+            if not dotted or self.aliases.resolve(dotted) != "json.dumps":
+                continue
+            d = self._em113_dict_for_arg(node.args[0], node.lineno)
+            if d is None or not self._em113_span_shaped(d):
+                continue
+            scopes = self._scope_stack_for_line(node.lineno)
+            fn = scopes[-1] if scopes else None
+            if fn is None or not self._em113_fn_writes(fn):
+                continue
+            self._emit(
+                "EM113", node,
+                "span-event record serialized with json.dumps and written "
+                "outside the sanctioned producers — replay/assembly "
+                "correctness depends on ONE record vocabulary; flush "
+                "through SpanTracker, FlightRecorder, or "
+                "utils.tracing.JsonlLogger instead",
+            )
 
     # -- EM102 -------------------------------------------------------------
 

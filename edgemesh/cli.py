@@ -86,7 +86,9 @@ def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool =
               trace_sample: float = 1.0,
               profile_dir: str | None = None, tp: int = 0,
               collective_mode: str = "psum",
-              collective_dtype: str = "int8") -> int:
+              collective_dtype: str = "int8",
+              flight_capacity: int | None = None,
+              flight_dir: str | None = None) -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.serve import serve_rest
 
@@ -96,7 +98,8 @@ def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool =
                admission=admission, span_log=span_log,
                trace_sample=trace_sample, profile_dir=profile_dir,
                tp=tp, collective_mode=collective_mode,
-               collective_dtype=collective_dtype)
+               collective_dtype=collective_dtype,
+               flight_capacity=flight_capacity, flight_dir=flight_dir)
     return 0
 
 
@@ -293,6 +296,19 @@ def main(argv: list[str] | None = None) -> int:
         "in /metrics",
     )
     top.add_argument(
+        "--flight-capacity", type=int, default=None,
+        help="serve --continuous: flight-recorder ring capacity (records); "
+        "default keeps the always-on recorder at its standard size, 0 "
+        "disables it (docs/OBSERVABILITY.md 'The flight recorder')",
+    )
+    top.add_argument(
+        "--flight-dir", type=str, default=None,
+        help="serve --continuous: arm the anomaly triggers (SLO-miss "
+        "burst, queue collapse, error spike, compile storm) and dump the "
+        "flight ring into <dir>/<incident-id>/ when one fires; also "
+        "accepts router-propagated incident ids via POST /incident",
+    )
+    top.add_argument(
         "--profile-dir", type=str, default=None,
         help="serve: opt in GET /debug/profile?seconds=N jax.profiler "
         "captures under this directory (disabled by default — see the "
@@ -344,7 +360,8 @@ def main(argv: list[str] | None = None) -> int:
                          cmd_args.admission, cmd_args.span_log,
                          cmd_args.trace_sample, cmd_args.profile_dir,
                          cmd_args.tp, cmd_args.collective_mode,
-                         cmd_args.collective_dtype)
+                         cmd_args.collective_dtype,
+                         cmd_args.flight_capacity, cmd_args.flight_dir)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     if cmd_args.command == "train":

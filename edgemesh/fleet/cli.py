@@ -217,7 +217,10 @@ def cmd_serve(args) -> int:
             trace_sample=args.trace_sample,
         )
         prober = HealthProber(registry, transport=transport,
-                              interval_s=args.probe_interval_s).start()
+                              interval_s=args.probe_interval_s,
+                              # Replica-fired incidents (flight recorder
+                              # dumps) fan out fleet-wide via the router.
+                              on_incident=router.observe_incident).start()
         print(
             f"edgemesh fleet: {len(procs)} replicas behind "
             f"http://{args.host}:{args.port} (balancer={args.balancer}); "

@@ -7,8 +7,8 @@ every request is answered by the router, never by a local model:
 - ``GET  /``, ``/healthz``  → router liveness
 - ``GET  /readyz``          → 200 only while ≥1 replica is in rotation
 - ``GET  /fleetz``          → JSON fleet status (replicas, balancer,
-  per-replica counters, recent-trace summaries) — what ``edgemesh fleet
-  status --json`` prints
+  per-replica counters, recent-trace summaries, recent replica-fired
+  incidents) — what ``edgemesh fleet status --json`` prints
 - ``GET  /debug/traces/<id>`` → one recent request's assembled trace
   (router-side view; unique id prefixes accepted; cross-process assembly
   with replica spans is ``edgemesh obs trace``)
@@ -100,6 +100,9 @@ def _make_handler(router, request_timeout_s: float | None):
                         # Tenant identity: admission policy + per-tenant
                         # telemetry (docs/FLEET.md "Admission").
                         tenant=httputil.read_tenant_header(self),
+                        # Session identity: span-record-only (replay
+                        # grouping); forwarded to the replica verbatim.
+                        session=httputil.read_session_header(self),
                     )
                     self._send(status, body, extra=extra)
                 elif self.path in ("/replicas/register", "/replicas/deregister",

@@ -39,7 +39,8 @@ class HealthProber:
 
     def __init__(self, registry, transport=None, interval_s: float = 2.0,
                  timeout_s: float = 1.0, unhealthy_after: int = 2,
-                 healthy_after: int = 1, obs_registry=None) -> None:
+                 healthy_after: int = 1, obs_registry=None,
+                 on_incident=None) -> None:
         from edgemesh.obs import get_registry
 
         self.registry = registry
@@ -48,6 +49,13 @@ class HealthProber:
         self.timeout_s = timeout_s
         self.unhealthy_after = unhealthy_after
         self.healthy_after = healthy_after
+        #: Called ``(rid, incident_dict)`` when a probed load digest
+        #: carries an ``incident`` field (a replica's anomaly trigger
+        #: fired — obs/anomaly.py). The fleet CLI wires this to
+        #: ``FleetRouter.observe_incident`` so the id fans out to every
+        #: replica; the callback dedupes, so re-probing the same incident
+        #: on every cadence tick is free.
+        self.on_incident = on_incident
         reg = obs_registry or get_registry()
         self._probes = reg.counter(
             "edgemesh_fleet_probes_total",
@@ -74,6 +82,13 @@ class HealthProber:
                 # so the telemetry balancer's signal refreshes for free on
                 # the existing probe cadence — zero extra requests.
                 self.registry.update_load(rep.rid, load)
+                incident = load.get("incident")
+                if incident and self.on_incident is not None:
+                    try:
+                        self.on_incident(rep.rid, incident)
+                    except Exception:  # propagation must never break probing
+                        log.exception("incident callback failed for %s",
+                                      rep.rid)
             state = self.registry.probe_result(
                 rep.rid, ok, healthy_after=self.healthy_after,
                 unhealthy_after=self.unhealthy_after, error=err,

@@ -65,7 +65,12 @@ from edgemesh.fleet.transport import HttpTransport, TransportError
 from edgemesh.obs.metrics import bounded_label
 from edgemesh.obs.slo import DecayingQuantile, SloTarget
 from edgemesh.obs.trace import ROUTER_RECORD_EVENT, TraceContext, sample
-from edgemesh.serve.httputil import DEADLINE_HEADER, TENANT_HEADER, TRACE_HEADER
+from edgemesh.serve.httputil import (
+    DEADLINE_HEADER,
+    SESSION_HEADER,
+    TENANT_HEADER,
+    TRACE_HEADER,
+)
 
 log = logging.getLogger("edgemesh.fleet")
 
@@ -137,6 +142,19 @@ class FleetRouter:
 
             self._trace_log = JsonlLogger(span_log)
         self._recent_traces: deque[dict] = deque(maxlen=64)
+        # Incident propagation (obs/anomaly.py): incident ids observed in
+        # replica load digests (HealthProber ``on_incident``) are deduped
+        # here, counted, surfaced on /fleetz, and fanned out to every
+        # OTHER replica's ``POST /incident`` so the whole fleet's flight
+        # rings land in one incident directory (docs/FLEET.md).
+        self._incident_lock = threading.Lock()
+        # Dedup window: a bounded id ring + set mirror, NOT an ever-growing
+        # set — a long-lived router in a churning fleet observes incidents
+        # indefinitely. 512 ids comfortably covers every id any replica
+        # still advertises in its digest (last_incident is the newest one).
+        self._incident_id_ring: deque[str] = deque(maxlen=512)  # guarded by: _incident_lock
+        self._incident_ids: set[str] = set()  # guarded by: _incident_lock
+        self._incidents: deque[dict] = deque(maxlen=16)  # guarded by: _incident_lock
         # Multi-tenant admission (fleet/admission.py): per-tenant token
         # buckets, weighted-fair queueing and priority lanes in front of
         # the in-flight slot pool. The default controller (no policies,
@@ -210,6 +228,11 @@ class FleetRouter:
             "edgemesh_fleet_exhausted_total",
             "Requests that failed every attempt",
         )
+        self._incidents_total = reg.counter(
+            "edgemesh_fleet_incidents_total",
+            "Replica-fired incidents observed (and fanned out), by "
+            "trigger kind", ("kind",),
+        )
         self._drain_events = reg.counter(
             "edgemesh_fleet_drain_total",
             "Drain lifecycle events", ("replica", "event"),
@@ -236,7 +259,8 @@ class FleetRouter:
 
     def handle_generate(self, payload: dict, deadline_s: float | None = None,
                         path: str = "/generate", trace: TraceContext | None = None,
-                        tenant: str | None = None):
+                        tenant: str | None = None,
+                        session: str | None = None):
         """Route one request. Returns ``(status, body, headers)`` — the
         HTTP frontend writes them verbatim; in-process callers (tests,
         benchmarks) read them directly. ``trace`` joins an existing trace
@@ -297,7 +321,7 @@ class FleetRouter:
             try:
                 status, body, headers = self._route(
                     payload, t0, deadline_s, path, ctx, spans, meta,
-                    tenant=tenant,
+                    tenant=tenant, session=session,
                 )
             finally:
                 self._inflight_gauge.dec()
@@ -360,7 +384,7 @@ class FleetRouter:
             self._trace_log.log(ROUTER_RECORD_EVENT, **fields)
 
     def _route(self, payload, t0, deadline_s, path, ctx, spans, meta=None,
-               tenant: str | None = None):
+               tenant: str | None = None, session: str | None = None):
         meta = meta if meta is not None else {"outcome": "shed"}
         deadline = t0 + (deadline_s if deadline_s is not None else self.default_deadline_s)
         prompt = payload.get("question") if isinstance(payload, dict) else None
@@ -384,7 +408,8 @@ class FleetRouter:
                 meta["outcome"] = "shed"
                 return 503, {"error": "no available replica"}, {"Retry-After": "1"}
             outcome = self._dispatch(rep, payload, path, deadline, prompt,
-                                     excluded, ctx, spans, meta, tenant=tenant)
+                                     excluded, ctx, spans, meta, tenant=tenant,
+                                     session=session)
             if outcome[0] == "ok":
                 _, rid, status, body, won_span = outcome
                 won_span["won"] = True
@@ -420,7 +445,8 @@ class FleetRouter:
     # -- attempts ------------------------------------------------------------
 
     def _attempt_one(self, rep, payload, path, deadline, ctx, spans,
-                     hedge: bool = False, tenant: str | None = None):
+                     hedge: bool = False, tenant: str | None = None,
+                     session: str | None = None):
         """One checked-out attempt → ("ok", rid, status, body) for any
         answered status < 500, else ("fail", rid, reason, detail).
 
@@ -456,6 +482,11 @@ class FleetRouter:
             # records and per-tenant SLO metrics attribute the work to the
             # same tenant the router admitted (docs/OBSERVABILITY.md).
             headers[TENANT_HEADER] = tenant
+        if session is not None:
+            # Session identity rides too (span records only): it is what
+            # lets `edgemesh obs replay` rebuild recorded traffic's
+            # shared-prefix session grouping from the replica logs.
+            headers[SESSION_HEADER] = session
         t0 = time.monotonic()
         try:
             status, body = self.transport.post_json(
@@ -499,7 +530,8 @@ class FleetRouter:
         return None
 
     def _dispatch(self, rep, payload, path, deadline, prompt, excluded,
-                  ctx, spans, meta=None, tenant: str | None = None):
+                  ctx, spans, meta=None, tenant: str | None = None,
+                  session: str | None = None):
         """One attempt round, hedged when configured. Returns
         ("ok", rid, status, body) or ("fail", [(rid, reason, detail), ...]).
         Every attempt (primary and hedge) gets its own child trace context
@@ -509,7 +541,8 @@ class FleetRouter:
         hedge_delay = self._hedge_delay()
         if hedge_delay is None or hedge_delay >= (deadline - time.monotonic()):
             out = self._attempt_one(rep, payload, path, deadline,
-                                    ctx.child(), spans, tenant=tenant)
+                                    ctx.child(), spans, tenant=tenant,
+                                    session=session)
             return out if out[0] == "ok" else ("fail", [out[1:]])
 
         results: queue.Queue = queue.Queue()
@@ -517,7 +550,7 @@ class FleetRouter:
         def run(replica, is_hedge):
             results.put((is_hedge, self._attempt_one(
                 replica, payload, path, deadline, ctx.child(), spans,
-                hedge=is_hedge, tenant=tenant,
+                hedge=is_hedge, tenant=tenant, session=session,
             )))
 
         threading.Thread(target=run, args=(rep, False), daemon=True).start()
@@ -567,6 +600,64 @@ class FleetRouter:
                 return out
             failures.append(out[1:])
         return ("fail", failures or [(rep.rid, "hedge", "no attempt completed")])
+
+    # -- incidents -----------------------------------------------------------
+
+    def observe_incident(self, source_rid: str, incident: dict) -> bool:
+        """A replica's load digest carried an incident {id, kind, ts}
+        (fired by its local anomaly triggers — obs/anomaly.py). Dedupe by
+        id, count it, remember it for ``/fleetz``, append an ``incident``
+        record to the router span log (the postmortem timeline), and fan
+        the id out to every OTHER replica's ``POST /incident`` so their
+        flight rings dump into the same incident directory. The fan-out
+        runs on its own thread: the health prober's probe pass must never
+        block on N replicas' dump I/O. Returns True when the incident was
+        new."""
+        iid = incident.get("id") if isinstance(incident, dict) else None
+        if not iid:
+            return False
+        with self._incident_lock:
+            if iid in self._incident_ids:
+                return False
+            if len(self._incident_id_ring) == self._incident_id_ring.maxlen:
+                self._incident_ids.discard(self._incident_id_ring[0])
+            self._incident_id_ring.append(iid)
+            self._incident_ids.add(iid)
+            rec = {
+                "id": iid, "kind": incident.get("kind"),
+                "ts": incident.get("ts"), "source": source_rid,
+            }
+            self._incidents.append(rec)
+        self._incidents_total.labels(
+            kind=str(incident.get("kind") or "unknown")).inc()
+        log.warning("incident %s (%s) fired on %s — propagating",
+                    iid, rec["kind"], source_rid)
+        if self._trace_log is not None:
+            self._trace_log.log("incident", **rec)
+        targets = [rep for rep in self.registry.replicas()
+                   if rep.rid != source_rid]
+        threading.Thread(target=self._broadcast_incident,
+                         args=(dict(rec), targets), daemon=True).start()
+        return True
+
+    def _broadcast_incident(self, rec: dict, targets) -> None:
+        for rep in targets:
+            try:
+                self.transport.post_json(
+                    rep.url("/incident"),
+                    {"id": rec["id"], "kind": rec.get("kind"),
+                     "source": rec.get("source")},
+                    timeout_s=self.attempt_timeout_s,
+                )
+            except TransportError as e:
+                # Best-effort: a replica that cannot dump is a smaller
+                # postmortem, not a routing failure.
+                log.warning("incident fan-out to %s failed: %s", rep.rid, e)
+
+    def recent_incidents(self) -> list[dict]:
+        """Newest-first observed incidents — the /fleetz surfacing."""
+        with self._incident_lock:
+            return [dict(r) for r in reversed(self._incidents)]
 
     # -- drain ---------------------------------------------------------------
 
@@ -711,4 +802,8 @@ class FleetRouter:
             "replicas": self.registry.snapshot(),
             "metrics": self.obs.summary(prefix="edgemesh_fleet_"),
             "recent_traces": self.recent_traces(),
+            # Incident propagation: the newest replica-fired incidents
+            # (id/kind/ts/source) — what an operator greps the incident
+            # directory by (docs/FLEET.md "Incident propagation").
+            "incidents": self.recent_incidents(),
         }

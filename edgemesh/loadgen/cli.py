@@ -2,9 +2,11 @@
 
 One shot (``--rate``) prints the open-loop report for a single offered
 load; a sweep (``--sweep r1,r2,r3``) prints the goodput-vs-offered-load
-curve document with the saturation knee identified. Render either with
-``edgemesh obs loadreport``. No jax, no device — point it at any
-``/generate`` endpoint (a replica gateway or the fleet frontend).
+curve document with the saturation knee identified; ``--replay
+workload.json`` drives a recorded workload rebuilt by ``edgemesh obs
+replay`` (incident regression runs). Render reports with ``edgemesh obs
+loadreport``. No jax, no device — point it at any ``/generate`` endpoint
+(a replica gateway or the fleet frontend).
 
 Tenant mixes: ``--tenant name=share[:lane]`` (repeatable) splits the
 aggregate rate by share, e.g. ``--tenant chat=3:interactive --tenant
@@ -39,6 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sweep", default=None, metavar="R1,R2,...",
                    help="sweep these aggregate rates and emit the "
                    "goodput-vs-offered-load curve (overrides --rate)")
+    p.add_argument("--replay", default=None, metavar="WORKLOAD.JSON",
+                   help="drive a recorded workload document (written by "
+                   "`edgemesh obs replay`) instead of a synthetic mix — "
+                   "arrivals, prompts, tenants and sessions come from the "
+                   "document; --rate/--sweep/--duration and the mix flags "
+                   "are ignored")
     p.add_argument("--duration", type=float, default=10.0,
                    help="seconds of scheduled traffic per point")
     p.add_argument("--arrival", default="poisson",
@@ -122,6 +130,35 @@ def _make_workload(args, rate: float) -> Workload:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     target = http_target(args.url, timeout_s=args.timeout_s)
+
+    if args.replay:
+        # Incident replay: the recorded schedule IS the traffic — the
+        # open-loop driver, SLO accounting, and report schema are the
+        # standard ones (zero replay-specific measurement code).
+        from edgemesh.loadgen.workload import ReplayWorkload
+
+        try:
+            with open(args.replay) as f:
+                wl = ReplayWorkload.from_doc(json.load(f))
+        except FileNotFoundError:
+            print(f"error: no such workload: {args.replay}", file=sys.stderr)
+            return 2
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"error: bad workload document: {e}", file=sys.stderr)
+            return 2
+        gen = OpenLoopGenerator(
+            target, wl.build_schedule(),
+            slo_latency_s=args.slo_latency_s,
+            duration_s=wl.meta.get("duration_s") or wl.duration_s,
+        )
+        doc = gen.run()
+        doc["replayed_from"] = args.replay
+        text = json.dumps(doc, indent=2)
+        print(text)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+        return 0
 
     def run_at(rate: float) -> dict:
         wl = _make_workload(args, rate)

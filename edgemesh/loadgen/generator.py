@@ -29,7 +29,7 @@ from dataclasses import dataclass
 
 from edgemesh.loadgen.workload import ScheduledRequest
 from edgemesh.obs.slo import SloTarget
-from edgemesh.serve.httputil import TENANT_HEADER
+from edgemesh.serve.httputil import SESSION_HEADER, TENANT_HEADER
 
 #: Synthetic status for transport-level failures (connect refused, socket
 #: timeout): the request died below HTTP, which open-loop accounting must
@@ -109,7 +109,11 @@ class OpenLoopGenerator:
         def fire(i: int, req: ScheduledRequest) -> None:
             try:
                 sent = time.monotonic()
-                headers = {TENANT_HEADER: req.tenant}
+                # Tenant selects admission policy + telemetry; session is
+                # span-record identity only — it is what lets `obs replay`
+                # rebuild this schedule's session grouping from the logs.
+                headers = {TENANT_HEADER: req.tenant,
+                           SESSION_HEADER: req.session}
                 status, _body = self.target(req.payload(), headers)
                 done = time.monotonic()
                 sched_abs = t0 + req.at_s

@@ -2,6 +2,14 @@
 
 Three layers compose into one merged request timeline:
 
+(Plus the inverse: :meth:`Workload.from_spans` reconstructs a replayable
+:class:`ReplayWorkload` from recorded span logs — scheduled arrivals from
+the records' ``ts_submit`` wall anchors, prompt lengths from
+``prompt_chars``, tenant and session identity from the propagated
+headers — so a production incident replays through the same
+OpenLoopGenerator as a regression workload. ``edgemesh obs replay`` is
+the CLI over it.)
+
 - :class:`LengthMix` — long-tail (lognormal) prompt/output lengths. Real
   prompt-length distributions are heavy-tailed: a mean-length constant
   would never show the admission queue a 10x-cost straggler parked in
@@ -103,14 +111,30 @@ class _Session:
         self.sid = sid
         self._rng = rng
         self._turns_mean = max(1.0, turns_mean)
+        self._generation = 0
         self._reset()
 
     def _reset(self) -> None:
+        import zlib
+
         # The prefix is the affinity/caching key: stable across the
-        # session's turns, distinct across sessions.
-        seed_words = " ".join(self._rng.choices(_WORDS, k=6))
-        self.prefix = f"[session {self.sid}] context: {seed_words}."
+        # session's turns, distinct across sessions — and a PURE FUNCTION
+        # of (session id, generation), independent of the tenant-shared
+        # rng. That determinism is what lets `obs replay` rebuild a
+        # recorded session's prefix byte-identically from the recorded
+        # session id alone, so prefix-affinity routing pins replayed
+        # traffic to replicas exactly as the live traffic pinned. Padded
+        # past the balancer's 64-char affinity key so the (non-replayable)
+        # body words can never leak into the routing decision.
+        rng = random.Random(
+            zlib.crc32(f"{self.sid}:{self._generation}".encode()))
+        seed_words = " ".join(rng.choices(_WORDS, k=8))
+        self.prefix = f"[session {self.sid}] context: {seed_words}"
+        while len(self.prefix) < 72:
+            self.prefix += " " + rng.choice(_WORDS)
+        self.prefix += "."
         self.turn = 0
+        self._generation += 1
 
     def next_prompt(self, prompt_chars: int) -> tuple[str, int]:
         self.turn += 1
@@ -125,6 +149,68 @@ class _Session:
         if rng.random() < 1.0 / self._turns_mean:
             self._reset()
         return prompt, turn
+
+
+#: Span-record event key — mirrored from obs.spans to keep this module
+#: import-light (loadgen must not pull the obs stack for a schedule).
+_SPAN_RECORD_EVENT = "request_spans"
+
+#: Length fallback chain for pre-``prompt_chars`` records: tokens x this
+#: approximates the prompt's character cost closely enough for load shape.
+_CHARS_PER_TOKEN = 4
+
+
+class ReplayWorkload:
+    """A recorded request timeline, replayable through the open-loop
+    generator. Duck-types :class:`Workload`: ``build_schedule`` returns the
+    reconstructed :class:`ScheduledRequest` list (optionally truncated),
+    so every existing driver works unchanged."""
+
+    def __init__(self, requests: list[ScheduledRequest],
+                 meta: dict | None = None) -> None:
+        self.requests = sorted(requests, key=lambda r: r.at_s)
+        self.meta = dict(meta or {})
+
+    @property
+    def duration_s(self) -> float:
+        return max((r.at_s for r in self.requests), default=0.0)
+
+    def build_schedule(self, duration_s: float | None = None
+                       ) -> list[ScheduledRequest]:
+        if duration_s is None:
+            return list(self.requests)
+        return [r for r in self.requests if r.at_s <= duration_s]
+
+    def to_doc(self) -> dict:
+        """JSON-serializable workload document (``obs replay --out``)."""
+        return {
+            "kind": "replay_workload",
+            **self.meta,
+            "requests": [
+                {"at_s": round(r.at_s, 6), "tenant": r.tenant,
+                 "lane": r.lane, "prompt": r.prompt, "session": r.session,
+                 "turn": r.turn, "max_new": r.max_new}
+                for r in self.requests
+            ],
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "ReplayWorkload":
+        if doc.get("kind") != "replay_workload":
+            raise ValueError(
+                f"not a replay workload document (kind={doc.get('kind')!r})"
+            )
+        reqs = [
+            ScheduledRequest(
+                at_s=float(r["at_s"]), tenant=r.get("tenant", "default"),
+                lane=r.get("lane", "interactive"), prompt=r["prompt"],
+                session=r.get("session", "replay-0"),
+                turn=int(r.get("turn", 1)), max_new=r.get("max_new"),
+            )
+            for r in doc.get("requests", [])
+        ]
+        meta = {k: v for k, v in doc.items() if k not in ("kind", "requests")}
+        return cls(reqs, meta=meta)
 
 
 class Workload:
@@ -163,3 +249,85 @@ class Workload:
                 ))
         out.sort(key=lambda r: r.at_s)
         return out
+
+    @classmethod
+    def from_spans(cls, records, speed: float = 1.0,
+                   sessions_per_tenant: int = 4,
+                   include_max_new: bool = True) -> ReplayWorkload:
+        """Reconstruct a replayable workload from recorded span records.
+
+        ``records`` is an iterable of decoded span-log records (the
+        engines' ``request_spans`` vocabulary — a live ``span_log``, a
+        flight-recorder dump, or both). Per recorded request:
+
+        - **arrival**: ``ts_submit`` relative to the earliest record,
+          time-scaled by ``speed`` (2.0 = replay twice as fast);
+        - **tenant**: the recorded tenant (untagged traffic replays as
+          ``default``);
+        - **session**: the recorded session id when the traffic carried
+          ``X-Edgemesh-Session``; otherwise arrivals are dealt round-robin
+          onto ``sessions_per_tenant`` synthetic sessions per tenant — the
+          shared-prefix structure survives either way;
+        - **prompt**: synthesized at the recorded ``prompt_chars`` length
+          (``prompt_tokens`` x 4 for older logs) with the session's stable
+          prefix, so prefix-affinity routing and replica prefix caches see
+          the same key structure the original traffic produced;
+        - **max_new**: the recorded ``generated`` count (when
+          ``include_max_new`` — send only at continuous non-speculative
+          replicas, same rule as ``TenantSpec.send_max_new``).
+
+        Deterministic: prompts are seeded from the session id, so the same
+        spans always rebuild byte-identical traffic."""
+        import zlib
+
+        if speed <= 0:
+            raise ValueError(f"speed must be > 0, got {speed}")
+        spans = [
+            r for r in records
+            if r.get("event", _SPAN_RECORD_EVENT) == _SPAN_RECORD_EVENT
+            and r.get("ts_submit") is not None
+        ]
+        if not spans:
+            raise ValueError("no request_spans records with ts_submit — "
+                             "nothing to replay")
+        spans.sort(key=lambda r: r["ts_submit"])
+        t0 = spans[0]["ts_submit"]
+        sessions: dict[str, _Session] = {}
+        rr_counters: dict[str, int] = {}
+        out: list[ScheduledRequest] = []
+        for rec in spans:
+            tenant = rec.get("tenant") or "default"
+            sid = rec.get("session")
+            if not sid:
+                i = rr_counters.get(tenant, 0)
+                rr_counters[tenant] = i + 1
+                sid = f"{tenant}-r{i % max(1, int(sessions_per_tenant))}"
+            sess = sessions.get(sid)
+            if sess is None:
+                # turns_mean=inf: replay sessions never reset — the
+                # recorded arrival order IS the turn structure.
+                rng = random.Random(zlib.crc32(f"replay:{sid}".encode()))
+                sess = sessions[sid] = _Session(sid, rng,
+                                                turns_mean=float("inf"))
+            chars = rec.get("prompt_chars")
+            if chars is None:
+                toks = rec.get("prompt_tokens")
+                chars = (int(toks) * _CHARS_PER_TOKEN
+                         if toks is not None else 48)
+            prompt, turn = sess.next_prompt(int(chars))
+            max_new = None
+            if include_max_new:
+                gen = rec.get("generated")
+                if gen is not None and int(gen) >= 1:
+                    max_new = int(gen)
+            out.append(ScheduledRequest(
+                at_s=(rec["ts_submit"] - t0) / speed, tenant=tenant,
+                lane="interactive", prompt=prompt, session=sid, turn=turn,
+                max_new=max_new,
+            ))
+        out.sort(key=lambda r: r.at_s)
+        return ReplayWorkload(out, meta={
+            "source_records": len(spans), "speed": float(speed),
+            "duration_s": round(out[-1].at_s, 6) if out else 0.0,
+            "tenants": sorted({r.tenant for r in out}),
+        })

@@ -17,13 +17,30 @@ Three pieces (docs/OBSERVABILITY.md is the operator-facing reference):
 - ``slo``: SLO goodput — TTFT/TPOT targets, per-request classification
   (``edgemesh_slo_goodput_ratio``), and the decayed latency quantiles the
   fleet router's hedge auto-tuner reads.
+- ``flight``: the always-on bounded flight-recorder ring (full-fidelity
+  span records regardless of sampling) that dumps to an incident
+  directory on trigger, plus the incident postmortem assembly.
+- ``anomaly``: the triggers that fire it — SLO-miss burst vs a decayed
+  baseline, admission-queue collapse, error spike, compile storm — and
+  the fleet incident-id propagation seam.
 
 Importing this package never imports jax — device sampling defers the
 import to scrape time, so the supervisor and the ``edgemesh obs`` CLI stay
 backend-free.
 """
 
+from edgemesh.obs.anomaly import (  # noqa: F401
+    AnomalyMonitor,
+    CompileStormDetector,
+    ErrorSpikeDetector,
+    QueueCollapseDetector,
+    SloBurstDetector,
+)
 from edgemesh.obs.device import register_device_gauges  # noqa: F401
+from edgemesh.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    assemble_incident,
+)
 from edgemesh.obs.metrics import (  # noqa: F401
     INTER_TOKEN_BUCKETS,
     LATENCY_BUCKETS,

@@ -1,0 +1,367 @@
+"""Anomaly triggers: the detectors that fire the flight recorder.
+
+The flight recorder (obs/flight.py) answers *what happened*; this module
+answers *when to ask*. Four detectors, each fed by hooks the serving stack
+already has — no new measurement, only new judgment:
+
+- :class:`SloBurstDetector` — a burst of SLO misses in the recent request
+  window, judged against a :class:`~edgemesh.obs.slo.DecayingQuantile`
+  latency baseline: misses only count as a *burst* once the baseline knows
+  what healthy looks like and the missing requests are genuinely outside
+  it (or never finished). Steady-state slowness re-arms the baseline and
+  stops re-firing — an incident is a *change*, not a state.
+- :class:`QueueCollapseDetector` — the engine admission queue pinned above
+  a depth bound for consecutive observations (fed on every submit).
+- :class:`ErrorSpikeDetector` — N non-ok retirements inside a sliding
+  wall-clock window.
+- :class:`CompileStormDetector` — M distinct backend compiles inside a
+  window (fed by the engine's compile hook): mid-serve shape churn is the
+  silent latency cliff every postmortem should show.
+
+:class:`AnomalyMonitor` owns the detectors, counts
+``edgemesh_anomaly_triggers_total{kind}``, and — when armed with a dump
+directory — dumps the flight ring into ``<dir>/<incident_id>/`` with a
+cooldown so a sustained anomaly produces one incident, not a dump per
+request. ``note_incident`` is the fleet seam: the router propagates a
+sibling replica's incident id here so every ring in the fleet lands in
+the same incident directory (fleet/router.py ``observe_incident``).
+
+Thresholds read ``EDGEMESH_ANOMALY_*`` env overrides so replica
+subprocesses are configurable without new CLI plumbing at every call site
+(same pattern as ``SloTarget.from_env``). No jax, stdlib only.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from edgemesh.obs.metrics import Registry, get_registry
+from edgemesh.obs.slo import DecayingQuantile
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(_env_float(name, float(default)))
+
+
+class SloBurstDetector:
+    """SLO-miss burst vs a decayed-latency baseline.
+
+    ``observe`` feeds every retirement's classification + latency. Good
+    latencies feed the baseline quantile (counts halve every
+    ``half_life_s``); a fire needs BOTH conditions:
+
+    - at least ``min_misses`` of the last ``window`` classified requests
+      missed, and the miss fraction is >= ``miss_ratio``;
+    - the baseline has seen enough healthy traffic to judge
+      (``DecayingQuantile.min_weight``), and the median latency of the
+      recent misses exceeds ``burst_factor`` x the baseline p95 — or the
+      misses never produced a latency at all (errors/timeouts).
+
+    The baseline gate is what separates "this replica is just slow" (no
+    fire: the spans and metrics already say so) from "this replica just
+    *became* slow" (fire: the moments before are about to age out of the
+    ring)."""
+
+    kind = "slo_burst"
+
+    def __init__(self, window: int = 24, min_misses: int = 8,
+                 miss_ratio: float = 0.5, burst_factor: float = 2.0,
+                 half_life_s: float = 120.0, min_weight: float = 8.0,
+                 quantile: float = 0.95):
+        self.window = int(window)
+        self.min_misses = int(min_misses)
+        self.miss_ratio = float(miss_ratio)
+        self.burst_factor = float(burst_factor)
+        self.quantile = float(quantile)
+        self.baseline = DecayingQuantile(half_life_s=half_life_s,
+                                         min_weight=min_weight)
+        # (miss: bool, latency_s: float | None) per classified request.
+        self._recent: deque[tuple[bool, float | None]] = deque(
+            maxlen=self.window)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> "SloBurstDetector":
+        return cls(
+            window=_env_int("EDGEMESH_ANOMALY_SLO_WINDOW", 24),
+            min_misses=_env_int("EDGEMESH_ANOMALY_SLO_MISSES", 8),
+            miss_ratio=_env_float("EDGEMESH_ANOMALY_SLO_RATIO", 0.5),
+            burst_factor=_env_float("EDGEMESH_ANOMALY_SLO_FACTOR", 2.0),
+            half_life_s=_env_float("EDGEMESH_ANOMALY_SLO_HALF_LIFE_S", 120.0),
+            min_weight=_env_float("EDGEMESH_ANOMALY_SLO_MIN_WEIGHT", 8.0),
+        )
+
+    def observe(self, slo_result: str, latency_s: float | None) -> bool:
+        miss = slo_result != "good"
+        if not miss and latency_s is not None:
+            self.baseline.observe(latency_s)
+        with self._lock:
+            self._recent.append((miss, latency_s))
+            recent = list(self._recent)
+        misses = [lat for m, lat in recent if m]
+        if len(misses) < self.min_misses:
+            return False
+        if len(misses) / len(recent) < self.miss_ratio:
+            return False
+        bound = self.baseline.quantile(self.quantile)
+        if bound is None:
+            return False  # no healthy baseline yet: slow != degraded
+        timed = sorted(lat for lat in misses if lat is not None)
+        if not timed:
+            return True  # misses that never finished are past any baseline
+        return timed[len(timed) // 2] > self.burst_factor * bound
+
+
+class QueueCollapseDetector:
+    """Admission queue pinned >= ``depth`` for ``consecutive`` samples."""
+
+    kind = "queue_collapse"
+
+    def __init__(self, depth: int = 32, consecutive: int = 4):
+        self.depth = int(depth)
+        self.consecutive = int(consecutive)
+        self._streak = 0  # guarded by: _lock
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> "QueueCollapseDetector":
+        return cls(
+            depth=_env_int("EDGEMESH_ANOMALY_QUEUE_DEPTH", 32),
+            consecutive=_env_int("EDGEMESH_ANOMALY_QUEUE_CONSECUTIVE", 4),
+        )
+
+    def observe(self, queue_depth: int) -> bool:
+        with self._lock:
+            if queue_depth >= self.depth:
+                self._streak += 1
+            else:
+                self._streak = 0
+            return self._streak == self.consecutive
+
+
+class ErrorSpikeDetector:
+    """>= ``count`` non-ok retirements (errors/preemptions) within
+    ``window_s`` seconds of wall time."""
+
+    kind = "error_spike"
+
+    def __init__(self, count: int = 5, window_s: float = 30.0):
+        self.count = int(count)
+        self.window_s = float(window_s)
+        self._times: deque[float] = deque()  # guarded by: _lock
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> "ErrorSpikeDetector":
+        return cls(
+            count=_env_int("EDGEMESH_ANOMALY_ERRORS", 5),
+            window_s=_env_float("EDGEMESH_ANOMALY_ERROR_WINDOW_S", 30.0),
+        )
+
+    def observe(self, status: str, now: float | None = None) -> bool:
+        if status == "ok":
+            return False
+        t = now if now is not None else time.monotonic()
+        with self._lock:
+            self._times.append(t)
+            while self._times and t - self._times[0] > self.window_s:
+                self._times.popleft()
+            return len(self._times) == self.count
+
+
+class CompileStormDetector:
+    """>= ``count`` distinct backend compiles within ``window_s``. The
+    first compile is the expected warmup and never counts — a storm is
+    *re*compilation (shape churn, cache misses) while serving."""
+
+    kind = "compile_storm"
+
+    def __init__(self, count: int = 3, window_s: float = 60.0):
+        self.count = int(count)
+        self.window_s = float(window_s)
+        self._times: deque[float] = deque()  # guarded by: _lock
+        self._seen_first = False  # guarded by: _lock
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> "CompileStormDetector":
+        return cls(
+            count=_env_int("EDGEMESH_ANOMALY_COMPILES", 3),
+            window_s=_env_float("EDGEMESH_ANOMALY_COMPILE_WINDOW_S", 60.0),
+        )
+
+    def observe(self, now: float | None = None) -> bool:
+        t = now if now is not None else time.monotonic()
+        with self._lock:
+            if not self._seen_first:
+                self._seen_first = True
+                return False
+            self._times.append(t)
+            while self._times and t - self._times[0] > self.window_s:
+                self._times.popleft()
+            return len(self._times) == self.count
+
+
+class AnomalyMonitor:
+    """Detector fan-in → incident id → flight dump, with cooldown.
+
+    ``flight`` is the replica's :class:`~edgemesh.obs.flight.
+    FlightRecorder`; ``dump_dir`` is the (ideally fleet-shared) incident
+    directory. With ``dump_dir=None`` the monitor still counts triggers —
+    the metric is the alarm even when nothing lands on disk."""
+
+    def __init__(self, flight=None, dump_dir=None,
+                 registry: Registry | None = None,
+                 slo_burst: SloBurstDetector | None = None,
+                 queue_collapse: QueueCollapseDetector | None = None,
+                 error_spike: ErrorSpikeDetector | None = None,
+                 compile_storm: CompileStormDetector | None = None,
+                 cooldown_s: float = 30.0):
+        self.flight = flight
+        self.dump_dir = dump_dir
+        self.slo_burst = slo_burst or SloBurstDetector.from_env()
+        self.queue_collapse = queue_collapse or QueueCollapseDetector.from_env()
+        self.error_spike = error_spike or ErrorSpikeDetector.from_env()
+        self.compile_storm = compile_storm or CompileStormDetector.from_env()
+        self.cooldown_s = _env_float("EDGEMESH_ANOMALY_COOLDOWN_S",
+                                     float(cooldown_s))
+        reg = registry if registry is not None else get_registry()
+        self._triggers = reg.counter(
+            "edgemesh_anomaly_triggers_total",
+            "Anomaly detectors fired, by kind (propagated = a sibling "
+            "replica's incident id arrived via the router)", ("kind",))
+        self._lock = threading.Lock()
+        self._incidents: deque[dict] = deque(maxlen=16)  # guarded by: _lock
+        self._dumped_ids: set[str] = set()  # guarded by: _lock
+        self._last_dump_monotonic: float | None = None  # guarded by: _lock
+        self._last_compile_marker: float | None = None  # guarded by: _lock
+
+    # -- feed hooks ----------------------------------------------------------
+
+    def on_retire(self, slo_result: str, latency_s: float | None,
+                  status: str = "ok") -> None:
+        """One retirement: SLO burst + error spike + (piggybacked) compile
+        storm. Called by ``SpanTracker.retire`` — the one place every
+        terminal request already passes through."""
+        if self.slo_burst.observe(slo_result, latency_s):
+            self.trigger(self.slo_burst.kind)
+        if self.error_spike.observe(status):
+            self.trigger(self.error_spike.kind)
+        self._poll_compiles()
+
+    def on_queue_depth(self, depth: int) -> None:
+        if self.queue_collapse.observe(depth):
+            self.trigger(self.queue_collapse.kind,
+                         detail={"queue_depth": int(depth)})
+
+    def on_compile(self) -> None:
+        """Direct compile feed (when the compile hook is wired to the
+        monitor); the retire-path poll below covers engines that are not."""
+        if self.compile_storm.observe():
+            self.trigger(self.compile_storm.kind)
+
+    def _poll_compiles(self) -> None:
+        """Derive compile events from the process-wide last-compile marker
+        (obs/trace.py): a changed marker since the previous retirement is
+        one distinct compile. Coarser than the direct feed — back-to-back
+        compiles between two retirements collapse into one — but it costs
+        nothing and needs no hook rewiring."""
+        from edgemesh.obs.trace import seconds_since_last_compile
+
+        since = seconds_since_last_compile()
+        if since is None:
+            return
+        marker = time.monotonic() - since
+        with self._lock:
+            prev = self._last_compile_marker
+            self._last_compile_marker = marker
+        if prev is None or abs(marker - prev) > 1e-3:
+            self.on_compile()
+
+    # -- firing --------------------------------------------------------------
+
+    def _mint_id(self) -> str:
+        return (f"inc-{time.strftime('%Y%m%d-%H%M%S')}-"
+                f"{os.urandom(3).hex()}")
+
+    def trigger(self, kind: str, detail: dict | None = None) -> dict | None:
+        """A detector fired: count it, and (cooldown permitting) dump the
+        flight ring under a fresh incident id. Returns the incident record
+        when a dump was written, else None."""
+        self._triggers.labels(kind=kind).inc()
+        now = time.monotonic()
+        with self._lock:
+            if (
+                self._last_dump_monotonic is not None
+                and now - self._last_dump_monotonic < self.cooldown_s
+            ):
+                return None
+            self._last_dump_monotonic = now
+            incident_id = self._mint_id()
+            self._dumped_ids.add(incident_id)
+        return self._dump(incident_id, kind, detail)
+
+    def note_incident(self, incident_id: str, kind: str = "propagated",
+                      detail: dict | None = None) -> dict | None:
+        """Adopt an externally-propagated incident id (the router's
+        broadcast): dump this replica's ring into the SAME incident
+        directory. Idempotent per id; propagated dumps bypass the cooldown
+        — a sibling's incident must capture this ring even if a local
+        trigger just fired."""
+        if not incident_id:
+            return None
+        with self._lock:
+            if incident_id in self._dumped_ids:
+                return None
+            self._dumped_ids.add(incident_id)
+            self._last_dump_monotonic = time.monotonic()
+        self._triggers.labels(kind=kind).inc()
+        return self._dump(incident_id, kind, detail)
+
+    def _dump(self, incident_id: str, kind: str,
+              detail: dict | None) -> dict | None:
+        record: dict[str, Any] = {
+            "id": incident_id, "kind": kind, "ts": time.time(),
+            "detail": detail or None, "path": None,
+        }
+        if self.flight is not None and self.dump_dir is not None:
+            try:
+                path = self.flight.dump(self.dump_dir, incident_id,
+                                        kind=kind, trigger_ts=record["ts"],
+                                        detail=detail)
+                record["path"] = str(path)
+            except OSError:
+                record["path"] = None  # a full disk must not fail serving
+        with self._lock:
+            self._incidents.append(record)
+        return record
+
+    # -- introspection -------------------------------------------------------
+
+    def last_incident(self) -> dict | None:
+        """The newest incident {id, kind, ts} — what the load digest ships
+        to the fleet prober so the router can propagate it."""
+        with self._lock:
+            if not self._incidents:
+                return None
+            rec = self._incidents[-1]
+            return {"id": rec["id"], "kind": rec["kind"], "ts": rec["ts"]}
+
+    def incidents(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._incidents]

@@ -22,13 +22,26 @@ Subcommands (all operate on the span JSONL the engines write via
 - ``loadreport <report.json>``: render an ``edgemesh loadgen`` report —
   the goodput-vs-offered-load bar chart with the saturation knee marked
   (curve documents), or the aggregate + per-tenant table (single runs).
+- ``replay <spans...> --out workload.json``: reconstruct a replayable
+  open-loop workload from recorded spans (arrivals from ``ts_submit``,
+  prompt lengths, tenant mix, session grouping; ``--speed`` time-scales)
+  — drive it with ``edgemesh loadgen --replay workload.json``.
+- ``incident <dumpdir>``: join an incident directory's flight-recorder
+  dumps (every replica's ring, plus ``--logs`` router spans) into one
+  postmortem document: trigger window marked, per-tenant goodput
+  before/during/after, per-replica critical-path split in the window
+  (obs/flight.py).
+
+Wherever a span log is expected, a DIRECTORY is accepted too: it expands
+to every ``*.jsonl`` inside (one level) — incident dump directories would
+make spelling each file out untenable.
 
 An empty or all-malformed span log is an answer, not an error: ``summary``
 prints an explicit ``"requests": 0`` report and every subcommand exits 0
 (malformed lines are counted on stderr).
 
-Exit status: 0 on success, 1 when ``trace`` finds no matching id, 2 on
-usage errors (missing file).
+Exit status: 0 on success, 1 when ``trace`` finds no matching id (or
+``incident`` finds no dump header), 2 on usage errors (missing file).
 """
 
 from __future__ import annotations
@@ -72,16 +85,67 @@ def build_parser() -> argparse.ArgumentParser:
         help="render an `edgemesh loadgen` report (single run or "
         "goodput-vs-offered-load curve) as human text")
     lr.add_argument("path", help="report JSON written by `edgemesh loadgen`")
+    rp = sub.add_parser(
+        "replay",
+        help="reconstruct a replayable open-loop workload from recorded "
+        "spans (drive it: `edgemesh loadgen --replay <out>`)")
+    rp.add_argument("paths", nargs="+", metavar="SPANS",
+                    help="span JSONL logs and/or directories of them "
+                    "(flight dumps work verbatim)")
+    rp.add_argument("--out", required=True,
+                    help="write the workload document here")
+    rp.add_argument("--speed", type=float, default=1.0,
+                    help="time-scale factor: 2.0 replays twice as fast "
+                    "(default 1.0 = real time)")
+    rp.add_argument("--sessions", type=int, default=4,
+                    help="synthetic sessions per tenant for records "
+                    "without a recorded session id (default 4)")
+    rp.add_argument("--no-max-new", action="store_true",
+                    help="drop the per-request max_new budgets (required "
+                    "when replaying at non-continuous or speculative "
+                    "replicas — the gateway 400s the field there)")
+    inc = sub.add_parser(
+        "incident",
+        help="assemble an incident directory's flight dumps into one "
+        "postmortem timeline (trigger window, per-tenant goodput, "
+        "per-replica critical path)")
+    inc.add_argument("dumpdir",
+                     help="the incident directory (<flight-dir>/<id>) — or "
+                     "any mix of dump files/dirs")
+    inc.add_argument("--logs", nargs="*", default=[], metavar="JSONL",
+                     help="extra span logs to join (the router's "
+                     "--span-log adds its incident/timeline records)")
+    inc.add_argument("--window-s", type=float, default=10.0,
+                     help="half-width of the trigger window (default 10s)")
     return p
 
 
+def _expand_logs(paths) -> list[Path]:
+    """Expand each path: a directory becomes every ``*.jsonl`` directly
+    inside it (sorted); files pass through. Incident dump directories are
+    the motivating case — one dump file per replica."""
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.glob("*.jsonl")))
+        else:
+            out.append(p)
+    return out
+
+
 def _read(path: str) -> list[dict]:
+    """Read one span log — or every ``*.jsonl`` in a directory."""
     from edgemesh.utils.tracing import JsonlLogger
 
-    logger = JsonlLogger(path)
-    records = logger.read()
-    if logger.malformed:
-        print(f"note: skipped {logger.malformed} malformed line(s)",
+    records: list[dict] = []
+    malformed = 0
+    for p in _expand_logs([path]):
+        logger = JsonlLogger(p)
+        records.extend(logger.read())
+        malformed += logger.malformed
+    if malformed:
+        print(f"note: skipped {malformed} malformed line(s)",
               file=sys.stderr)
     return records
 
@@ -249,7 +313,7 @@ def cmd_trace(trace_id: str, logs: list[str]) -> int:
         print(f"error: no such span log: {', '.join(missing)}",
               file=sys.stderr)
         return 2
-    doc = load_trace(trace_id, logs)
+    doc = load_trace(trace_id, _expand_logs(logs))
     if doc["tree"] is None:
         candidates = doc.get("candidates", [])
         if candidates:
@@ -263,10 +327,65 @@ def cmd_trace(trace_id: str, logs: list[str]) -> int:
     return 0
 
 
+def cmd_replay(paths: list[str], out: str, speed: float, sessions: int,
+               include_max_new: bool) -> int:
+    from edgemesh.loadgen.workload import Workload
+
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"error: no such span log: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    records: list[dict] = []
+    from edgemesh.utils.tracing import JsonlLogger
+
+    for p in _expand_logs(paths):
+        records.extend(JsonlLogger(p).read())
+    try:
+        wl = Workload.from_spans(records, speed=speed,
+                                 sessions_per_tenant=sessions,
+                                 include_max_new=include_max_new)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    doc = wl.to_doc()
+    with open(out, "w") as f:
+        f.write(json.dumps(doc, indent=2) + "\n")
+    print(json.dumps({
+        "out": out, "requests": len(wl.requests),
+        "duration_s": wl.meta.get("duration_s"),
+        "speed": speed, "tenants": wl.meta.get("tenants"),
+    }, indent=2))
+    return 0
+
+
+def cmd_incident(dumpdir: str, logs: list[str], window_s: float) -> int:
+    from edgemesh.obs.flight import assemble_incident
+
+    missing = [p for p in [dumpdir, *logs] if not Path(p).exists()]
+    if missing:
+        print(f"error: no such dump/log: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    doc = assemble_incident(_expand_logs([dumpdir, *logs]),
+                            window_s=window_s)
+    if doc["incident_id"] is None:
+        print(f"error: no flight_dump header in {dumpdir!r} — not an "
+              "incident dump directory?", file=sys.stderr)
+        return 1
+    print(json.dumps(doc, indent=2))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "trace":
         return cmd_trace(args.trace_id, args.logs)
+    if args.cmd == "replay":
+        return cmd_replay(args.paths, args.out, args.speed, args.sessions,
+                          include_max_new=not args.no_max_new)
+    if args.cmd == "incident":
+        return cmd_incident(args.dumpdir, args.logs, args.window_s)
     if not Path(args.path).exists():
         kind = "report" if args.cmd == "loadreport" else "span log"
         print(f"error: no such {kind}: {args.path}", file=sys.stderr)

@@ -1,0 +1,337 @@
+"""The fleet flight recorder: always-on capture, dump-on-trigger.
+
+``trace_sample`` keeps steady-state span I/O sparse, which is exactly
+wrong during an incident: the requests you most want at full fidelity are
+the ones just *before* the anomaly, and by the time an operator notices,
+the sampled-out records are gone. The flight recorder resolves that
+tension the way avionics does — record everything, all the time, into a
+bounded ring that costs one deque append per retirement, and write it to
+disk only when something goes wrong:
+
+- :class:`FlightRecorder` — a per-replica in-memory ring of full-fidelity
+  span records (every retirement, sampled or not) plus periodic
+  engine/load-digest snapshots, dumped as JSONL into an incident
+  directory when an anomaly trigger (obs/anomaly.py) — or an incident id
+  propagated by the fleet router — fires.
+- :func:`assemble_incident` — the postmortem: join every replica's flight
+  dump (plus any router span logs) into one timeline with the trigger
+  window marked, per-tenant goodput before/during/after, and the
+  trigger-window critical-path split per replica (reusing the
+  ``obs.trace`` assembly + critical-path machinery).
+
+Dump records reuse the engines' span vocabulary (``request_spans`` /
+``pool_reset``) verbatim, so every existing offline tool — ``edgemesh obs
+summary``/``trace``/``replay`` — works on a flight dump unchanged; the
+recorder adds only ``flight_snapshot`` (digest samples) and one
+``flight_dump`` header per file. All writes go through
+``utils.tracing.JsonlLogger`` — one producer vocabulary, enforced by
+edgelint EM113. No jax anywhere (the standing ``edgemesh.obs`` import
+contract).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Callable, Iterable
+
+from edgemesh.obs.metrics import Registry, get_registry
+
+#: Periodic engine/load-digest sample riding the ring between spans.
+SNAPSHOT_EVENT = "flight_snapshot"
+#: One per dump file: incident identity, trigger kind, replica, ring stats.
+DUMP_EVENT = "flight_dump"
+
+#: Default ring capacity: at a healthy replica's ~1-10 req/s this holds
+#: the last ~30 s to 5 min of full-fidelity records in < 1 MB of host
+#: memory (docs/OBSERVABILITY.md "Ring sizing").
+DEFAULT_CAPACITY = 256
+
+
+def default_replica_label() -> str:
+    """The replica identity stamped on dumps: ``EDGEMESH_REPLICA_ID`` when
+    the deployment set one (the fleet e2e does), else a pid-derived label —
+    dumps from different replicas of one incident must not collide in the
+    shared incident directory."""
+    return os.environ.get("EDGEMESH_REPLICA_ID") or f"pid-{os.getpid()}"
+
+
+class FlightRecorder:
+    """Bounded always-on record ring; JSONL dump only when triggered."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 registry: Registry | None = None,
+                 replica: str | None = None,
+                 snapshot_source: Callable[[], dict] | None = None,
+                 snapshot_interval_s: float = 5.0):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.replica = replica or default_replica_label()
+        #: Called (opportunistically, on the record path) at most once per
+        #: ``snapshot_interval_s`` to sample the live load digest into the
+        #: ring — the dump then shows queue depth / EWMAs alongside the
+        #: spans they explain. Must be cheap and jax-free (load_digest is).
+        self.snapshot_source = snapshot_source
+        self.snapshot_interval_s = float(snapshot_interval_s)
+        self._lock = threading.Lock()
+        self._ring: deque[dict[str, Any]] = deque(maxlen=self.capacity)
+        self._last_snapshot = 0.0  # guarded by: _lock
+        self._dropped = 0  # guarded by: _lock
+        reg = registry if registry is not None else get_registry()
+        self._records_total = reg.counter(
+            "edgemesh_flight_records_total",
+            "Records appended to the flight ring, by event",
+            ("event",))
+        self._ring_gauge = reg.gauge(
+            "edgemesh_flight_ring_records",
+            "Records currently held in the flight ring")
+        self._dumps_total = reg.counter(
+            "edgemesh_flight_dumps_total",
+            "Flight-ring dumps written, by trigger kind", ("kind",))
+
+    def record(self, event: str, fields: dict[str, Any]) -> None:
+        """Append one record (a *copy*, stamped with a wall ``ts`` when the
+        fields carry none). Cheap enough for every retirement: one dict
+        copy + deque append under a short lock. Also takes the periodic
+        digest snapshot when the interval has elapsed — opportunistic, so
+        an idle replica's ring simply stops moving instead of needing its
+        own timer thread."""
+        rec = {"ts": time.time(), "event": event, **fields}
+        snap = None
+        now = time.monotonic()
+        with self._lock:
+            if (
+                self.snapshot_source is not None
+                and now - self._last_snapshot >= self.snapshot_interval_s
+            ):
+                self._last_snapshot = now
+                snap = True
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(rec)
+            size = len(self._ring)
+        self._records_total.labels(event=event).inc()
+        self._ring_gauge.set(size)
+        if snap:
+            # Outside the lock: snapshot_source may take the engine lock
+            # (load_digest does), and holding ours across it would pair the
+            # two in inconsistent order with the engine's own record calls.
+            try:
+                digest = dict(self.snapshot_source())
+            except Exception:  # telemetry must never break the request path
+                return
+            with self._lock:
+                self._ring.append(
+                    {"ts": time.time(), "event": SNAPSHOT_EVENT,
+                     "replica": self.replica, **digest})
+            self._records_total.labels(event=SNAPSHOT_EVENT).inc()
+
+    def snapshot_now(self, digest: dict[str, Any]) -> None:
+        """Append one digest snapshot immediately (tests; trigger-time
+        final sample before a dump)."""
+        with self._lock:
+            self._ring.append({"ts": time.time(), "event": SNAPSHOT_EVENT,
+                               "replica": self.replica, **digest})
+        self._records_total.labels(event=SNAPSHOT_EVENT).inc()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def peek(self) -> list[dict[str, Any]]:
+        """A snapshot copy of the ring, oldest first (tests/inspection)."""
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    def dump(self, out_dir: str | Path, incident_id: str,
+             kind: str = "manual", trigger_ts: float | None = None,
+             detail: dict | None = None) -> Path:
+        """Write the ring to ``<out_dir>/<incident_id>/flight-<replica>.jsonl``.
+
+        The first record is a ``flight_dump`` header (incident id, trigger
+        kind + wall timestamp, replica, ring fill/capacity/drop count);
+        every ring record follows verbatim, original timestamps preserved.
+        The ring is NOT cleared — a second trigger during the same incident
+        re-dumps the fuller picture over the same file."""
+        from edgemesh.utils.tracing import JsonlLogger
+
+        with self._lock:
+            records = [dict(r) for r in self._ring]
+            dropped = self._dropped
+        out = Path(out_dir) / incident_id / f"flight-{self.replica}.jsonl"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        if out.exists():
+            out.unlink()  # re-trigger: replace, never append duplicates
+        logger = JsonlLogger(out)
+        logger.log(
+            DUMP_EVENT, incident_id=incident_id, kind=kind,
+            replica=self.replica,
+            trigger_ts=trigger_ts if trigger_ts is not None else time.time(),
+            records=len(records), capacity=self.capacity, dropped=dropped,
+            **(detail or {}),
+        )
+        for rec in records:
+            # JsonlLogger.log stamps ts= then lets **fields override it, so
+            # the ring record's original wall timestamp survives the dump.
+            logger.log(rec.get("event", "record"),
+                       **{k: v for k, v in rec.items() if k != "event"})
+        self._dumps_total.labels(kind=kind).inc()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Postmortem assembly (`edgemesh obs incident <dumpdir>`)
+# ---------------------------------------------------------------------------
+
+
+def _phase_bucket(records: list[dict]) -> dict[str, Any]:
+    classified = [r["slo_result"] for r in records
+                  if r.get("slo_result") is not None]
+    good = sum(1 for c in classified if c == "good")
+    lats = sorted(r["latency_s"] for r in records
+                  if r.get("latency_s") is not None)
+    by_tenant: dict[str, list[int]] = {}
+    for r in records:
+        if r.get("tenant") is not None and r.get("slo_result") is not None:
+            cell = by_tenant.setdefault(str(r["tenant"]), [0, 0])
+            cell[1] += 1
+            if r["slo_result"] == "good":
+                cell[0] += 1
+    return {
+        "requests": len(records),
+        "classified": len(classified),
+        "goodput_ratio": round(good / len(classified), 4) if classified else None,
+        "latency_s_p50": (
+            round(lats[min(len(lats) - 1, len(lats) // 2)], 6) if lats else None
+        ),
+        "tenants": {
+            t: {"classified": c, "good": g,
+                "goodput_ratio": round(g / c, 4)}
+            for t, (g, c) in sorted(by_tenant.items())
+        } or None,
+    }
+
+
+def _record_critical_path(rec: dict) -> dict[str, Any]:
+    """One span record's queue/prefill/decode split through the PR 5
+    machinery: assemble the (replica-only) tree for its trace id, then run
+    the standard critical-path split over it."""
+    from edgemesh.obs.trace import assemble_trace, critical_path
+
+    doc = assemble_trace(rec.get("trace_id"), [rec])
+    return critical_path(doc["tree"])
+
+
+def assemble_incident(paths: Iterable[str | Path],
+                      window_s: float = 10.0) -> dict[str, Any]:
+    """Join flight dumps (and any extra span logs) into one incident doc.
+
+    ``paths`` are JSONL files — typically every ``flight-*.jsonl`` in one
+    incident directory, optionally plus the router's span log. Returns::
+
+        {"incident_id", "kinds", "trigger_ts", "window_s", "replicas",
+         "phases": {"before"/"during"/"after": {requests, goodput_ratio,
+                                                tenants, ...}},
+         "critical_path": {"window": {replica: {queue_s, prefill_s,
+                                                decode_s, service_s,
+                                                requests}},
+                           "slowest_replica": ...},
+         "timeline": [...]}
+
+    The trigger window is ``[trigger_ts - window_s, trigger_ts + window_s]``
+    around the earliest locally-fired trigger (propagated dumps carry the
+    origin's timestamp, so every replica's window lines up). Requests are
+    bucketed by their wall submit time (``ts_submit``); the per-replica
+    critical-path totals cover requests whose window intersects the
+    trigger window. ``tree`` is None when no dump header is present."""
+    from edgemesh.obs.spans import SPAN_RECORD_EVENT
+    from edgemesh.utils.tracing import JsonlLogger
+
+    headers: list[dict] = []
+    spans: list[dict] = []
+    timeline: list[dict] = []
+    for p in paths:
+        replica = None
+        recs = JsonlLogger(p).read()
+        for rec in recs:
+            if rec.get("event") == DUMP_EVENT:
+                headers.append(rec)
+                replica = rec.get("replica")
+        for rec in recs:
+            ev = rec.get("event")
+            if ev == SPAN_RECORD_EVENT:
+                r = dict(rec)
+                r.setdefault("_replica", replica or Path(p).stem)
+                spans.append(r)
+            elif ev in (SNAPSHOT_EVENT, "pool_reset", "incident",
+                        DUMP_EVENT):
+                timeline.append({
+                    "ts": rec.get("ts"), "event": ev,
+                    "replica": rec.get("replica", replica),
+                    **{k: rec[k] for k in
+                       ("incident_id", "kind", "queue_depth", "inflight",
+                        "reason", "detail")
+                       if k in rec},
+                })
+    if not headers:
+        return {"incident_id": None, "replicas": [], "trigger_ts": None,
+                "phases": None, "critical_path": None, "timeline": []}
+    # The earliest LOCAL trigger anchors the window; propagated dumps fall
+    # back in when no local one made it into the directory.
+    local = [h for h in headers if h.get("kind") != "propagated"]
+    anchor = min(local or headers, key=lambda h: h.get("trigger_ts") or 0)
+    trigger_ts = anchor.get("trigger_ts")
+    w0, w1 = trigger_ts - window_s, trigger_ts + window_s
+    phases = {"before": [], "during": [], "after": []}
+    for rec in spans:
+        ts = rec.get("ts_submit", rec.get("ts"))
+        if ts is None:
+            continue
+        if ts < w0:
+            phases["before"].append(rec)
+        elif ts <= w1:
+            phases["during"].append(rec)
+        else:
+            phases["after"].append(rec)
+    # Per-replica critical-path totals over requests touching the window.
+    per_replica: dict[str, dict[str, float]] = {}
+    for rec in spans:
+        t0 = rec.get("ts_submit")
+        if t0 is None:
+            continue
+        t1 = t0 + (rec.get("latency_s") or 0.0)
+        if t1 < w0 or t0 > w1:
+            continue
+        cp = _record_critical_path(rec)
+        cell = per_replica.setdefault(str(rec["_replica"]), {
+            "requests": 0, "queue_s": 0.0, "prefill_s": 0.0,
+            "decode_s": 0.0, "service_s": 0.0,
+        })
+        cell["requests"] += 1
+        for key in ("queue_s", "prefill_s", "decode_s"):
+            cell[key] = round(cell[key] + (cp.get(key) or 0.0), 6)
+        cell["service_s"] = round(
+            cell["service_s"] + (cp.get("total_s") or 0.0), 6)
+    slowest = max(per_replica,
+                  key=lambda r: per_replica[r]["service_s"],
+                  default=None)
+    timeline.sort(key=lambda e: e.get("ts") or 0)
+    return {
+        "incident_id": anchor.get("incident_id"),
+        "kinds": sorted({h.get("kind") for h in headers if h.get("kind")}),
+        "trigger_ts": trigger_ts,
+        "window_s": window_s,
+        "replicas": sorted({h.get("replica") for h in headers
+                            if h.get("replica")}),
+        "phases": {name: _phase_bucket(recs)
+                   for name, recs in phases.items()},
+        "critical_path": {
+            "window": {r: per_replica[r] for r in sorted(per_replica)},
+            "slowest_replica": slowest,
+        },
+        "timeline": timeline,
+    }

@@ -48,7 +48,7 @@ class RequestTrace:
     __slots__ = (
         "rid", "ts_unix", "t_submit", "t_admit_start", "t_start",
         "t_first_token", "t_last", "t_end", "generated", "segments",
-        "spans", "status", "attrs", "tenant",
+        "spans", "status", "attrs", "tenant", "session",
         "trace_id", "span_id", "parent_span_id", "sampled",
     )
 
@@ -70,6 +70,12 @@ class RequestTrace:
         # null and the per-tenant metric families stay untouched, so
         # pre-tenant logs and single-tenant deployments see zero change.
         self.tenant: str | None = None
+        # Session identity (X-Edgemesh-Session, sent by the load
+        # observatory's generator and propagated like the tenant): rides
+        # the span record only — it is what lets `obs replay` rebuild the
+        # shared-prefix session structure of recorded traffic. Never a
+        # metric label (EM112 cardinality).
+        self.session: str | None = None
         self.t_admit_start: float | None = None
         self.t_start: float | None = None  # admission (prefill) complete
         self.t_first_token: float | None = None
@@ -95,9 +101,19 @@ class SpanTracker:
                  span_log: str | Path | None = None,
                  engine: str = "continuous",
                  trace_sample: float = 1.0,
-                 slo_target: SloTarget | None = None):
+                 slo_target: SloTarget | None = None,
+                 flight=None):
         self.registry = registry or get_registry()
         self.engine = engine
+        # Flight recorder (obs/flight.py): when attached, EVERY retirement's
+        # full span record rides the bounded in-memory ring — including the
+        # ones trace_sample keeps out of the JSONL — so an anomaly dump has
+        # the moments before the trigger at full fidelity. ``anomaly`` is
+        # the optional AnomalyMonitor fed from retire (obs/anomaly.py);
+        # both are plain attributes so serving wiring (and the bench's
+        # recorder-on arm) can attach/detach them live.
+        self.flight = flight
+        self.anomaly = None
         # SLO classification (obs/slo.py): every retirement is judged
         # against the TTFT/TPOT target (``slo_target``, default from env)
         # and the verdict rides both the metrics and the span record.
@@ -165,18 +181,22 @@ class SpanTracker:
         return time.perf_counter()
 
     def submit(self, rid: int, trace_ctx=None,
-               tenant: str | None = None) -> RequestTrace:
+               tenant: str | None = None,
+               session: str | None = None) -> RequestTrace:
         """``trace_ctx`` is the propagated :class:`~edgemesh.obs.trace.
         TraceContext` from the fleet router's attempt span (None for
         locally-originated requests, which mint their own root).
         ``tenant`` is the raw ``X-Edgemesh-Tenant`` value (None when the
         request carried none) — normalization to a bounded label happens
         at the metric seam (obs/slo.py), never here, so the span record
-        keeps the honest raw-ish string for offline attribution."""
+        keeps the honest raw-ish string for offline attribution.
+        ``session`` is the raw ``X-Edgemesh-Session`` value: span-record
+        identity only (replay session grouping), never a metric label."""
         from edgemesh.obs.trace import TraceContext, sample
 
         trace = RequestTrace(rid, self.now())
         trace.tenant = tenant
+        trace.session = session
         if trace_ctx is not None:
             trace.trace_id = trace_ctx.trace_id
             trace.parent_span_id = trace_ctx.span_id
@@ -250,30 +270,39 @@ class SpanTracker:
             else trace.t_first_token - trace.t_submit
         )
         slo_result = self.slo.record(status, ttft, itl, tenant=trace.tenant)
+        # ONE record shape for both sinks (sampled JSONL + flight ring):
+        # replay/assembly tooling must never see two vocabularies (EM113).
+        record = dict(
+            rid=trace.rid, engine=self.engine, status=status,
+            tenant=trace.tenant, session=trace.session,
+            trace_id=trace.trace_id, span_id=trace.span_id,
+            parent_span_id=trace.parent_span_id,
+            # Wall anchor for cross-process assembly: spans are
+            # perf_counter values and spans[0].t0 == t_submit, so
+            # wall(t) = ts_submit + (t - spans[0].t0) (obs/trace.py).
+            ts_submit=trace.ts_unix,
+            generated=trace.generated, segments=trace.segments,
+            queue_s=(
+                None if trace.t_admit_start is None
+                else trace.t_admit_start - trace.t_submit
+            ),
+            prefill_s=(
+                None if trace.t_start is None or trace.t_admit_start is None
+                else trace.t_start - trace.t_admit_start
+            ),
+            ttft_s=ttft, itl_s=itl, latency_s=now - trace.t_submit,
+            slo_result=slo_result,
+            spans=trace.spans, **trace.attrs,
+        )
         if self._log is not None and trace.sampled:
-            self._log.log(
-                SPAN_RECORD_EVENT,
-                rid=trace.rid, engine=self.engine, status=status,
-                tenant=trace.tenant,
-                trace_id=trace.trace_id, span_id=trace.span_id,
-                parent_span_id=trace.parent_span_id,
-                # Wall anchor for cross-process assembly: spans are
-                # perf_counter values and spans[0].t0 == t_submit, so
-                # wall(t) = ts_submit + (t - spans[0].t0) (obs/trace.py).
-                ts_submit=trace.ts_unix,
-                generated=trace.generated, segments=trace.segments,
-                queue_s=(
-                    None if trace.t_admit_start is None
-                    else trace.t_admit_start - trace.t_submit
-                ),
-                prefill_s=(
-                    None if trace.t_start is None or trace.t_admit_start is None
-                    else trace.t_start - trace.t_admit_start
-                ),
-                ttft_s=ttft, itl_s=itl, latency_s=now - trace.t_submit,
-                slo_result=slo_result,
-                spans=trace.spans, **trace.attrs,
-            )
+            self._log.log(SPAN_RECORD_EVENT, **record)
+        if self.flight is not None:
+            # Full fidelity regardless of the sampling bit: the ring exists
+            # precisely for the records steady-state sampling drops.
+            self.flight.record(SPAN_RECORD_EVENT, record)
+        if self.anomaly is not None:
+            self.anomaly.on_retire(slo_result, now - trace.t_submit,
+                                   status=status)
         return now
 
     def pool_reset(self, reason: str = "") -> None:
@@ -281,6 +310,9 @@ class SpanTracker:
         if self._log is not None:
             self._log.log(RESET_RECORD_EVENT, engine=self.engine,
                           reason=reason)
+        if self.flight is not None:
+            self.flight.record(RESET_RECORD_EVENT,
+                               {"engine": self.engine, "reason": reason})
 
     # -- load digest (the /loadz feedback signal) ----------------------------
 

@@ -507,7 +507,8 @@ class ContinuousEngine:
 
     def submit(self, question: str, max_new: int | None = None,
                trace_ctx: TraceContext | None = None,
-               tenant: str | None = None) -> Future:
+               tenant: str | None = None,
+               session: str | None = None) -> Future:
         """Enqueue one request. ``max_new`` caps THIS request's token budget
         below the engine-wide ``sampling.max_new_tokens`` (budgets are
         per-slot host state, so a per-request cap costs nothing); the
@@ -518,7 +519,9 @@ class ContinuousEngine:
         ``X-Edgemesh-Tenant`` identity (None for untagged traffic): it
         rides the span record and the per-tenant SLO families
         (obs/slo.py), never the scheduling — fairness between tenants is
-        the ROUTER's admission job, not the engine's."""
+        the ROUTER's admission job, not the engine's. ``session`` is the
+        raw ``X-Edgemesh-Session`` identity: span-record only, so
+        ``edgemesh obs replay`` can rebuild recorded session grouping."""
         if max_new is not None:
             max_new = int(max_new)
             if max_new < 1:
@@ -528,17 +531,26 @@ class ContinuousEngine:
             if self._closed:
                 raise RuntimeError("engine is closed")
             trace = self.obs.submit(self.requests, trace_ctx,
-                                    tenant=tenant)  # rid = arrival index
+                                    tenant=tenant,  # rid = arrival index
+                                    session=session)
             self._queue.append((question, fut, trace, max_new))
             self.requests += 1
+            depth = len(self._queue)
             self._cond.notify()
+        # Outside the engine lock: the queue-collapse detector takes the
+        # monitor's own lock and a trigger dumps the flight ring to disk —
+        # neither belongs inside _cond's critical section (EM303).
+        anomaly = self.obs.anomaly
+        if anomaly is not None:
+            anomaly.on_queue_depth(depth)
         return fut
 
     def answer(self, question: str, max_new: int | None = None,
                trace_ctx: TraceContext | None = None,
-               tenant: str | None = None) -> dict[str, Any]:
-        return self.submit(question, max_new=max_new,
-                           trace_ctx=trace_ctx, tenant=tenant).result()
+               tenant: str | None = None,
+               session: str | None = None) -> dict[str, Any]:
+        return self.submit(question, max_new=max_new, trace_ctx=trace_ctx,
+                           tenant=tenant, session=session).result()
 
     def close(self) -> None:
         with self._cond:
@@ -827,7 +839,7 @@ class ContinuousEngine:
             self._finished = self._finished.at[idx].set(False)
 
         self.obs.admitted(
-            trace, prompt_tokens=plen,
+            trace, prompt_tokens=plen, prompt_chars=len(question),
             shared_prefix_hit=bool(self._paged and match),
             **(self._collective_meta if self._tp is not None else {}),
         )
@@ -987,6 +999,7 @@ class ContinuousEngine:
             # phases share a kernel.
             self.obs.admitted(
                 r.trace, prompt_tokens=r.plen,
+                prompt_chars=len(self._slots[r.idx].question),
                 prefill_tokens=int(len(r.ids)),
                 shared_prefix_hit=bool(r.match), ragged=True,
             )
@@ -1641,7 +1654,8 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         self._conf = self._conf.at[idx].set(row.conf_sum[0])
         self._mask = self._mask.at[idx].set(row.mask[0])
         self._finished = self._finished.at[idx].set(row.finished[0])
-        self.obs.admitted(trace, prompt_tokens=plen)
+        self.obs.admitted(trace, prompt_tokens=plen,
+                          prompt_chars=len(question))
         self._slots[idx] = _Slot(
             future=fut, question=question, emitted=[], remaining=self.max_new,
             t_submit=trace.t_submit, t_start=trace.t_start, trace=trace,

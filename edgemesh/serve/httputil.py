@@ -88,6 +88,23 @@ def read_tenant_header(handler) -> str | None:
     return raw or None
 
 
+SESSION_HEADER = "X-Edgemesh-Session"
+
+
+def read_session_header(handler) -> str | None:
+    """The raw session identity header (multi-turn shared-prefix sessions;
+    the load observatory's generator sends it, the fleet router forwards
+    it). Span-record identity ONLY — it exists so ``edgemesh obs replay``
+    can rebuild recorded traffic's session grouping; it must never become
+    a metric label (EM112). Missing is legal: sessionless traffic replays
+    with synthesized per-tenant sessions."""
+    raw = handler.headers.get(SESSION_HEADER)
+    if raw is None:
+        return None
+    raw = raw.strip()
+    return raw or None
+
+
 def read_json_body(handler) -> dict | None:
     """Parse the request body; answers the 400 itself on bad input."""
     try:

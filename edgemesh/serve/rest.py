@@ -28,6 +28,9 @@ Endpoints:
   per decoded chunk, then ``data: {"answer": ..., "done": true}``
 - ``POST /drain``     → flip to draining (readyz → 503, new generates →
   503) and finish in-flight work; the fleet's pre-stop hook
+- ``POST /incident``  → {"id": ...}: dump the flight-recorder ring under a
+  router-propagated incident id (obs/flight.py; the fleet's incident
+  fan-out — docs/OBSERVABILITY.md "The flight recorder")
 - ``GET  /debug/profile?seconds=N`` → opt-in (``profile_dir=`` /
   ``--profile-dir``) ``jax.profiler`` capture; returns the trace path
 
@@ -79,6 +82,7 @@ class GatewayServer(ThreadingHTTPServer):
         self.batcher = None
         self.max_inflight = 0  # 0 = unbounded; serve_rest overrides
         self.profile_dir = None  # opt-in /debug/profile target
+        self.anomaly = None  # AnomalyMonitor when the flight triggers are armed
         # jax profiles cannot nest: the lock guards only the ACTIVE flag
         # (edgelint EM303 — sleeping through the capture window while
         # holding a lock would convoy every other /debug/profile thread).
@@ -184,6 +188,15 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
             since = seconds_since_last_compile()
             digest["recent_compile"] = (
                 since is not None and since < RECENT_COMPILE_WINDOW_S
+            )
+            # Incident propagation seam (obs/anomaly.py): the newest
+            # locally-fired incident {id, kind, ts} rides the digest, so
+            # the fleet prober sees it on its existing cadence and the
+            # router can fan the id out to sibling replicas (/fleetz,
+            # docs/FLEET.md "Incident propagation").
+            anomaly = getattr(self.server, "anomaly", None)
+            digest["incident"] = (
+                anomaly.last_incident() if anomaly is not None else None
             )
             return digest
 
@@ -365,6 +378,34 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 # inflight == 0 (fleet/router.drain_replica).
                 self._send(200, self.server.drain(wait=False))
                 return
+            if self.path == "/incident":
+                # The router's incident broadcast (fleet/router.py): dump
+                # this replica's flight ring under the propagated id so the
+                # whole fleet's rings land in ONE incident directory.
+                # Idempotent per id; a replica without a recorder answers
+                # honestly instead of 404ing the fleet's fan-out.
+                payload = self._read_json()
+                if payload is None:
+                    return
+                incident_id = payload.get("id")
+                if not incident_id or not isinstance(incident_id, str):
+                    self._send(400, {"error": "missing 'id' field"})
+                    return
+                anomaly = getattr(self.server, "anomaly", None)
+                if anomaly is None:
+                    self._send(200, {"accepted": False,
+                                     "error": "no flight recorder armed"})
+                    return
+                rec = anomaly.note_incident(
+                    incident_id,
+                    detail={"origin_kind": payload.get("kind"),
+                            "source": payload.get("source")},
+                )
+                self._send(200, {
+                    "accepted": True, "dumped": rec is not None,
+                    "path": None if rec is None else rec.get("path"),
+                })
+                return
             if self.path not in ("/generate", "/generate_stream"):
                 self._send(404, {"error": f"unknown path {self.path}"})
                 return
@@ -383,6 +424,7 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
             # span record and per-tenant SLO metrics (obs/slo.py).
             trace_ctx = httputil.read_trace_header(self)
             tenant = httputil.read_tenant_header(self)
+            session = httputil.read_session_header(self)
             payload = self._read_json()
             if payload is None:
                 return
@@ -403,11 +445,12 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 from edgemesh.obs.trace import use_trace
 
                 with use_trace(trace_ctx):
-                    self._generate(payload, trace_ctx, tenant)
+                    self._generate(payload, trace_ctx, tenant, session)
             finally:
                 self.server.end_request()
 
-        def _generate(self, payload: dict, trace_ctx=None, tenant=None):
+        def _generate(self, payload: dict, trace_ctx=None, tenant=None,
+                      session=None):
             try:
                 question = payload.get("question")
                 if not question:
@@ -457,10 +500,11 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                     kwargs = {}
                     if batcher_speaks_trace:
                         kwargs["trace_ctx"] = trace_ctx
-                        # Tenant rides only the engines that speak spans —
-                        # the DynamicBatcher coalesces requests and has no
-                        # per-request record to attribute.
+                        # Tenant/session ride only the engines that speak
+                        # spans — the DynamicBatcher coalesces requests and
+                        # has no per-request record to attribute.
                         kwargs["tenant"] = tenant
+                        kwargs["session"] = session
                     if max_new is not None:
                         kwargs["max_new"] = max_new
                     result = batcher.answer(question, **kwargs)
@@ -554,7 +598,8 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
                request_timeout_s: float | None = 300.0,
                trace_sample: float = 1.0, profile_dir=None,
                tp: int = 0, collective_mode: str = "psum",
-               collective_dtype: str = "int8"):
+               collective_dtype: str = "int8",
+               flight_capacity: int | None = None, flight_dir=None):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
@@ -595,6 +640,16 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     join for the row-sharded projections (parallel/collectives.py — the
     quantized/overlapped wire is how tp8 serving earns its chips).
 
+    ``flight_capacity`` (continuous only) sizes the always-on flight
+    recorder ring — full-fidelity span records regardless of
+    ``trace_sample``, dumped as JSONL only when an anomaly trigger fires
+    (obs/flight.py; None = the default capacity, 0 disables).
+    ``flight_dir`` arms the anomaly triggers (obs/anomaly.py): SLO-miss
+    burst, queue collapse, error spike, compile storm each dump the ring
+    into ``<flight_dir>/<incident_id>/``, and ``POST /incident`` dumps
+    under a router-propagated id so a fleet's rings land in one incident
+    directory (docs/OBSERVABILITY.md "The flight recorder").
+
     ``max_inflight`` bounds concurrently-admitted generate requests (past
     it: 503 + Retry-After; 0 = unbounded). ``request_timeout_s`` is the
     per-connection socket timeout (None disables). The returned server is a
@@ -621,6 +676,16 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
             f"admission={admission!r} requires continuous=True (the queue "
             "policy lives in the ContinuousEngine); add --continuous, or "
             "drop the flag for the batched paths"
+        )
+    if (flight_dir is not None or flight_capacity is not None) and not continuous:
+        raise ValueError(
+            "flight_dir/flight_capacity require continuous=True (the "
+            "flight recorder rides the ContinuousEngine's span tracker)"
+        )
+    if flight_dir is not None and flight_capacity == 0:
+        raise ValueError(
+            "flight_dir needs a flight recorder — drop flight_capacity=0, "
+            "or drop the dump directory"
         )
     if tp and int(tp) > 1 and not continuous:
         raise ValueError(
@@ -674,6 +739,26 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
             registry=registry, trace_sample=trace_sample,
             tp_engine=tp_engine,
         )
+        # Flight recorder: always-on by default (bounded ring, one deque
+        # append per retirement — cheap enough to never turn off;
+        # recorder_overhead_* in the bench pins the claim). flight_dir
+        # additionally arms the anomaly triggers that dump it.
+        if flight_capacity is None or flight_capacity > 0:
+            from edgemesh.obs.flight import FlightRecorder
+
+            flight_kwargs = {}
+            if flight_capacity is not None:
+                flight_kwargs["capacity"] = int(flight_capacity)
+            flight = FlightRecorder(registry=batcher.obs.registry,
+                                    snapshot_source=batcher.load_digest,
+                                    **flight_kwargs)
+            batcher.obs.flight = flight
+            if flight_dir is not None:
+                from edgemesh.obs.anomaly import AnomalyMonitor
+
+                anomaly = AnomalyMonitor(flight, flight_dir,
+                                         registry=batcher.obs.registry)
+                batcher.obs.anomaly = anomaly
     elif batch > 1:
         from edgemesh.serve.batcher import DynamicBatcher
 
@@ -690,6 +775,8 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     server.batcher = batcher
     server.max_inflight = max_inflight
     server.profile_dir = profile_dir
+    if batcher is not None:
+        server.anomaly = getattr(getattr(batcher, "obs", None), "anomaly", None)
     log.info("edgemesh REST gateway on %s:%d", host, port)
     if block:
         server.serve_forever()

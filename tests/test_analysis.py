@@ -653,6 +653,110 @@ def test_em112_shipped_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# EM113 span-schema-bypass
+# ---------------------------------------------------------------------------
+
+_EM113_SRC = (
+    "import json\n"
+    "def dump_spans(records, path):\n"
+    "    with open(path, 'a') as f:\n"
+    "        for r in records:\n"
+    "            rec = {'event': 'request_spans', 'rid': r.rid,\n"
+    "                   'spans': r.spans}\n"
+    "            f.write(json.dumps(rec) + '\\n')\n"
+)
+
+
+def test_em113_fires_on_handrolled_span_jsonl_writer():
+    findings = [f for f in lint_source(_EM113_SRC,
+                                       path="edgemesh/serve/myobs.py")
+                if f.rule == "EM113"]
+    assert len(findings) == 1
+    assert findings[0].severity == "error"
+    assert "JsonlLogger" in findings[0].message
+    # Outside the shipped package (tests, fixtures) the rule is silent.
+    assert [f for f in lint_source(_EM113_SRC, path="tests/test_x.py")
+            if f.rule == "EM113"] == []
+
+
+def test_em113_sees_inline_dicts_event_constants_and_spans_key():
+    # Inline dict with the event constant name (SPAN_RECORD_EVENT et al.).
+    const = (
+        "import json\n"
+        "from edgemesh.obs.spans import SPAN_RECORD_EVENT\n"
+        "def w(f, rid):\n"
+        "    f.write(json.dumps({'event': SPAN_RECORD_EVENT, 'rid': rid}))\n"
+    )
+    assert [f.rule for f in lint_source(const, path="edgemesh/obs/extra.py")
+            if f.rule == "EM113"] == ["EM113"]
+    # A bare "spans" key counts even without the event field.
+    spans_key = (
+        "import json\n"
+        "def w(f, tree):\n"
+        "    f.write(json.dumps({'spans': tree}))\n"
+    )
+    assert [f.rule for f in lint_source(spans_key,
+                                        path="edgemesh/fleet/extra.py")
+            if f.rule == "EM113"] == ["EM113"]
+
+
+def test_em113_quiet_on_opaque_payloads_and_non_span_events():
+    # json.dumps of an opaque name: provenance invisible, out of scope.
+    opaque = (
+        "import json\n"
+        "def send(f, payload):\n"
+        "    f.write(json.dumps(payload))\n"
+    )
+    assert [f for f in lint_source(opaque, path="edgemesh/serve/rest2.py")
+            if f.rule == "EM113"] == []
+    # An event OUTSIDE the span vocabulary is someone else's log.
+    other = (
+        "import json\n"
+        "def w(f):\n"
+        "    f.write(json.dumps({'event': 'checkpoint_saved', 'step': 1}))\n"
+    )
+    assert [f for f in lint_source(other, path="edgemesh/serve/rest2.py")
+            if f.rule == "EM113"] == []
+    # Serializing without ANY file write in the function (an HTTP response
+    # body, a debug repr) is not a bypass.
+    no_write = (
+        "import json\n"
+        "def render(tree):\n"
+        "    return json.dumps({'event': 'request_spans', 'spans': tree})\n"
+    )
+    assert [f for f in lint_source(no_write, path="edgemesh/serve/rest2.py")
+            if f.rule == "EM113"] == []
+
+
+def test_em113_allows_the_sanctioned_producers_and_disable():
+    # The producers themselves are allowlisted by path.
+    assert [f for f in lint_source(_EM113_SRC,
+                                   path="edgemesh/utils/tracing.py")
+            if f.rule == "EM113"] == []
+    assert [f for f in lint_source(_EM113_SRC, path="edgemesh/obs/flight.py")
+            if f.rule == "EM113"] == []
+    quiet = _EM113_SRC.replace(
+        "            f.write(json.dumps(rec) + '\\n')",
+        "            f.write(json.dumps(rec) + '\\n')"
+        "  # edgelint: disable=EM113",
+    )
+    assert [f for f in lint_source(quiet, path="edgemesh/serve/myobs.py")
+            if f.rule == "EM113"] == []
+
+
+def test_em113_shipped_tree_is_clean():
+    # Every span-event write in the shipped package flows through
+    # SpanTracker/FlightRecorder/JsonlLogger — the tree is the rule's
+    # reference fixture (replay correctness depends on it).
+    from pathlib import Path
+
+    from edgemesh.analysis.edgelint import lint_paths
+
+    pkg = Path(__file__).resolve().parent.parent / "edgemesh"
+    assert [f for f in lint_paths([pkg]) if f.rule == "EM113"] == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + baseline mechanics
 # ---------------------------------------------------------------------------
 

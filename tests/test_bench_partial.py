@@ -187,6 +187,86 @@ def test_ragged_ablation_benchmark_shapes(monkeypatch):
         assert out[f"ragged_over_segmented_{shape}"] == 1.25
 
 
+def test_router_overhead_stage_schema_pins_recorder_arm(monkeypatch, capsys):
+    """The flight-recorder bench contract: a headline run carries the
+    router/tracing/recorder overhead split — `recorder_overhead_p50/p99`
+    alongside the absolute arm percentiles — so the 'always-on is cheap'
+    claim (recorder p50 within 2% of the recorder-off arm) is a tracked
+    number in BENCH JSON (faked stage — no replicas spun)."""
+
+    def fake_build(preset, precision, quant_mode):
+        return ("cfg", "params")
+
+    def fake_decode(preset, precision, quant_mode="w8a16", batch=8, **kw):
+        return {"metric": "m", "value": 100.0, "unit": "tok/s/chip",
+                "vs_baseline": 3.9, "ttft_s": 0.01, "hbm_eff_gbs": 1.0,
+                "hbm_util": 0.1, "weight_gb": 1.0, "batch": batch,
+                "decode_steps": 8}
+
+    def fake_overhead(**kw):
+        return {"metric": "router_overhead_p50_s", "value": 0.0021,
+                "unit": "s", "n_requests": 40,
+                "direct_p50_s": 0.010, "direct_p99_s": 0.015,
+                "routed_p50_s": 0.0121, "routed_p99_s": 0.018,
+                "overhead_p99_s": 0.003,
+                "traced_p50_s": 0.013, "traced_p99_s": 0.019,
+                "tracing_overhead_p50_s": 0.0009,
+                "tracing_overhead_p99_s": 0.001,
+                "recorder_p50_s": 0.01215, "recorder_p99_s": 0.0181,
+                "recorder_overhead_p50_s": 0.00005,
+                "recorder_overhead_p99_s": 0.0001,
+                "recorder_ring_records": 41,
+                "sample_trace": None, "obs": {}}
+
+    def fake_adaptive(**kw):
+        return {"metric": "adaptive_over_least_outstanding_p99",
+                "value": 1.4, "unit": "x", "slo_target_s": 0.25}
+
+    monkeypatch.setattr(benchmarks, "_build", fake_build)
+    monkeypatch.setattr(benchmarks, "decode_benchmark", fake_decode)
+    monkeypatch.setattr(benchmarks, "router_overhead_benchmark", fake_overhead)
+    monkeypatch.setattr(benchmarks, "adaptive_router_benchmark", fake_adaptive)
+    monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SERVE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert out["router_overhead_p50_s"] == 0.0021
+    assert out["router_overhead_p99_s"] == 0.003
+    assert out["tracing_overhead_p50_s"] == 0.0009
+    # The recorder arm keys the acceptance gate reads.
+    assert out["recorder_p50_s"] == 0.01215
+    assert out["recorder_overhead_p50_s"] == 0.00005
+    assert out["recorder_overhead_p99_s"] == 0.0001
+    assert out["recorder_ring_records"] == 41
+    # Within-2% gate is checkable from the artifact alone.
+    assert abs(out["recorder_overhead_p50_s"]) <= 0.02 * out["routed_p50_s"]
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert "recorder_overhead_p50_s" in lines[-1]
+
+
+def test_router_overhead_stage_is_skippable_via_env(monkeypatch):
+    """EDGEMESH_BENCH_FLEET=0 must skip the router_overhead stage (it
+    spins a live replica + frontend) — no keys, no error recorded."""
+    _fake_stage1(monkeypatch)
+
+    def boom(**kw):
+        raise AssertionError("router_overhead_benchmark ran despite the gate")
+
+    monkeypatch.setattr(benchmarks, "router_overhead_benchmark", boom)
+    monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SERVE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_FLEET", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert not any(k.startswith(("router_overhead", "recorder_")) for k in out)
+
+
 def test_load_curve_stage_is_skippable_via_env(monkeypatch, capsys):
     """EDGEMESH_BENCH_LOADGEN=0 must skip the load_curve stage entirely —
     no replicas spun, no keys emitted, no error recorded."""

@@ -492,11 +492,16 @@ def test_router_overhead_benchmark_smoke():
     assert r["direct_p50_s"] > 0 and r["routed_p50_s"] > 0
     assert r["traced_p50_s"] > 0
     assert "tracing_overhead_p50_s" in r and "tracing_overhead_p99_s" in r
+    # The flight-recorder arm: absolute percentiles, the delta vs the
+    # recorder-off routed arm, and proof the ring actually recorded.
+    assert r["recorder_p50_s"] > 0
+    assert "recorder_overhead_p50_s" in r and "recorder_overhead_p99_s" in r
+    assert r["recorder_ring_records"] >= 5
     assert r["n_requests"] == 5
-    # Two routed arms (tracing off + on), each 5 requests + 1 warmup,
-    # all through one replica.
-    assert r["obs"]['edgemesh_fleet_routed_total{replica="r0"}'] == 12
-    assert r["obs"]["edgemesh_fleet_router_seconds"]["count"] == 12
+    # Three routed arms (tracing off, tracing on, recorder on), each
+    # 5 requests + 1 warmup, all through one replica.
+    assert r["obs"]['edgemesh_fleet_routed_total{replica="r0"}'] == 18
+    assert r["obs"]["edgemesh_fleet_router_seconds"]["count"] == 18
     # The sample trace is a real cross-process assembly: router record +
     # the replica's engine record under the winning attempt.
     st = r["sample_trace"]

@@ -1,0 +1,451 @@
+"""The incident observatory: flight recorder ring, anomaly triggers,
+fleet incident propagation, and postmortem assembly (obs/flight.py,
+obs/anomaly.py, fleet wiring). Pure host-side — no jax, no engines; the
+live serving path rides the slow-tier incident e2e."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from edgemesh.obs.anomaly import (
+    AnomalyMonitor,
+    CompileStormDetector,
+    ErrorSpikeDetector,
+    QueueCollapseDetector,
+    SloBurstDetector,
+)
+from edgemesh.obs.flight import (
+    DUMP_EVENT,
+    SNAPSHOT_EVENT,
+    FlightRecorder,
+    assemble_incident,
+)
+from edgemesh.obs.metrics import Registry
+from edgemesh.obs.spans import SPAN_RECORD_EVENT, SpanTracker, replay_spans
+from edgemesh.utils.tracing import JsonlLogger
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder: bounded ring + dump schema
+# ---------------------------------------------------------------------------
+
+
+def test_ring_is_bounded_and_dump_header_counts_drops(tmp_path):
+    reg = Registry()
+    rec = FlightRecorder(capacity=4, registry=reg, replica="r0")
+    for i in range(10):
+        rec.record(SPAN_RECORD_EVENT, {"rid": i})
+    assert len(rec) == 4
+    assert [r["rid"] for r in rec.peek()] == [6, 7, 8, 9]
+    out = rec.dump(tmp_path, "inc-1", kind="manual")
+    records = JsonlLogger(out).read()
+    header = records[0]
+    assert header["event"] == DUMP_EVENT
+    assert header["incident_id"] == "inc-1"
+    assert header["kind"] == "manual"
+    assert header["replica"] == "r0"
+    assert header["records"] == 4 and header["capacity"] == 4
+    assert header["dropped"] == 6
+    assert [r["rid"] for r in records[1:]] == [6, 7, 8, 9]
+    # Metrics: appends counted by event, dumps by kind.
+    s = reg.summary()
+    assert s['edgemesh_flight_records_total{event="request_spans"}'] == 10
+    assert s['edgemesh_flight_dumps_total{kind="manual"}'] == 1
+    assert s["edgemesh_flight_ring_records"] == 4
+
+
+def test_dump_preserves_original_timestamps_and_redump_replaces(tmp_path):
+    rec = FlightRecorder(capacity=8, registry=Registry(), replica="r0")
+    rec.record(SPAN_RECORD_EVENT, {"ts": 123.5, "rid": 0})
+    out = rec.dump(tmp_path, "inc-1", kind="slo_burst")
+    assert JsonlLogger(out).read()[1]["ts"] == 123.5
+    # A re-trigger re-dumps the fuller ring over the same file: no dupes.
+    rec.record(SPAN_RECORD_EVENT, {"ts": 124.0, "rid": 1})
+    out2 = rec.dump(tmp_path, "inc-1", kind="slo_burst")
+    assert out2 == out
+    records = JsonlLogger(out).read()
+    assert [r.get("rid") for r in records[1:]] == [0, 1]
+
+
+def test_snapshot_rides_the_record_path_on_interval():
+    digests = iter([{"queue_depth": 3}, {"queue_depth": 7}])
+    rec = FlightRecorder(capacity=16, registry=Registry(), replica="r0",
+                         snapshot_source=lambda: next(digests),
+                         snapshot_interval_s=0.0)
+    rec.record(SPAN_RECORD_EVENT, {"rid": 0})
+    snaps = [r for r in rec.peek() if r["event"] == SNAPSHOT_EVENT]
+    assert len(snaps) == 1 and snaps[0]["queue_depth"] == 3
+
+
+def test_span_tracker_feeds_flight_even_when_sampled_out(tmp_path):
+    """trace_sample=0 writes NO span JSONL — but the flight ring still gets
+    every record at full fidelity, and a dump of the ring replays through
+    the standard offline tooling."""
+    reg = Registry()
+    flight = FlightRecorder(capacity=16, registry=reg, replica="r0")
+    tracker = SpanTracker(reg, tmp_path / "spans.jsonl",
+                          trace_sample=0.0, flight=flight)
+    for rid in range(3):
+        tr = tracker.submit(rid, tenant="chat", session=f"chat-{rid % 2}")
+        tracker.admit_start(tr)
+        tracker.admitted(tr, prompt_tokens=8, prompt_chars=30)
+        tracker.tokens(tr, 4)
+        tracker.retire(tr, status="ok")
+    assert not (tmp_path / "spans.jsonl").exists()  # sampled out
+    ring = [r for r in flight.peek() if r["event"] == SPAN_RECORD_EVENT]
+    assert len(ring) == 3
+    assert ring[0]["tenant"] == "chat" and ring[0]["session"] == "chat-0"
+    assert ring[0]["prompt_chars"] == 30
+    # The dump is a standard span log: obs summary/replay machinery works.
+    out = flight.dump(tmp_path, "inc-2", kind="manual")
+    offline = replay_spans(JsonlLogger(out).read()).summary()
+    assert offline['edgemesh_requests_submitted_total{engine="continuous"}'] == 3
+
+
+# ---------------------------------------------------------------------------
+# Detectors
+# ---------------------------------------------------------------------------
+
+
+def test_slo_burst_needs_a_healthy_baseline_before_firing():
+    det = SloBurstDetector(window=8, min_misses=4, miss_ratio=0.5,
+                           burst_factor=2.0, min_weight=4.0)
+    # Uniform misses from cold start: slow, not degraded — never fires.
+    assert not any(det.observe("ttft", 1.0) for _ in range(20))
+    det2 = SloBurstDetector(window=8, min_misses=4, miss_ratio=0.5,
+                            burst_factor=2.0, min_weight=4.0)
+    # Healthy traffic arms the baseline...
+    for _ in range(16):
+        assert not det2.observe("good", 0.05)
+    # ...then a burst of misses far outside it fires.
+    fired = [det2.observe("ttft", 1.5) for _ in range(8)]
+    assert any(fired)
+
+
+def test_slo_burst_misses_without_latency_fire_once_armed():
+    det = SloBurstDetector(window=8, min_misses=4, miss_ratio=0.5,
+                           min_weight=4.0)
+    for _ in range(16):
+        det.observe("good", 0.05)
+    assert any(det.observe("error", None) for _ in range(6))
+
+
+def test_queue_collapse_fires_once_per_streak():
+    det = QueueCollapseDetector(depth=4, consecutive=3)
+    fires = [det.observe(d) for d in (5, 5, 5, 5, 5)]
+    assert fires == [False, False, True, False, False]
+    det.observe(0)  # streak reset
+    assert [det.observe(9) for d in range(3)] == [False, False, True]
+
+
+def test_error_spike_counts_within_window_only():
+    det = ErrorSpikeDetector(count=3, window_s=10.0)
+    assert not det.observe("error", now=0.0)
+    assert not det.observe("ok", now=1.0)
+    assert not det.observe("error", now=2.0)
+    assert det.observe("error", now=3.0)
+    # Old errors age out of the window.
+    det2 = ErrorSpikeDetector(count=3, window_s=10.0)
+    det2.observe("error", now=0.0)
+    det2.observe("error", now=1.0)
+    assert not det2.observe("error", now=20.0)
+
+
+def test_compile_storm_exempts_warmup_then_fires():
+    det = CompileStormDetector(count=2, window_s=60.0)
+    assert not det.observe(now=0.0)  # warmup compile: free
+    assert not det.observe(now=1.0)
+    assert det.observe(now=2.0)
+
+
+# ---------------------------------------------------------------------------
+# AnomalyMonitor: trigger → dump, cooldown, propagation adoption
+# ---------------------------------------------------------------------------
+
+
+def test_trigger_dumps_counts_and_cooldown_dedupes(tmp_path):
+    reg = Registry()
+    flight = FlightRecorder(capacity=8, registry=reg, replica="r0")
+    flight.record(SPAN_RECORD_EVENT, {"rid": 0})
+    mon = AnomalyMonitor(flight, tmp_path, registry=reg, cooldown_s=60.0)
+    rec = mon.trigger("slo_burst", detail={"queue_depth": 9})
+    assert rec is not None and rec["kind"] == "slo_burst"
+    dump = tmp_path / rec["id"] / "flight-r0.jsonl"
+    assert dump.exists()
+    header = JsonlLogger(dump).read()[0]
+    assert header["kind"] == "slo_burst" and header["queue_depth"] == 9
+    # Cooldown: a second trigger still counts but does not dump.
+    assert mon.trigger("error_spike") is None
+    s = reg.summary()
+    assert s['edgemesh_anomaly_triggers_total{kind="slo_burst"}'] == 1
+    assert s['edgemesh_anomaly_triggers_total{kind="error_spike"}'] == 1
+    assert s['edgemesh_flight_dumps_total{kind="slo_burst"}'] == 1
+    assert mon.last_incident()["id"] == rec["id"]
+
+
+def test_note_incident_bypasses_cooldown_and_is_idempotent(tmp_path):
+    reg = Registry()
+    flight = FlightRecorder(capacity=8, registry=reg, replica="r1")
+    flight.record(SPAN_RECORD_EVENT, {"rid": 0})
+    mon = AnomalyMonitor(flight, tmp_path, registry=reg, cooldown_s=3600.0)
+    assert mon.trigger("slo_burst") is not None
+    # A sibling replica's incident arrives mid-cooldown: must still dump.
+    rec = mon.note_incident("inc-remote-1",
+                            detail={"origin_kind": "slo_burst",
+                                    "source": "replica-0"})
+    assert rec is not None
+    assert (tmp_path / "inc-remote-1" / "flight-r1.jsonl").exists()
+    # Idempotent per id: the router re-observes digests every probe tick.
+    assert mon.note_incident("inc-remote-1") is None
+    s = reg.summary()
+    assert s['edgemesh_anomaly_triggers_total{kind="propagated"}'] == 1
+
+
+def test_monitor_on_retire_wires_slo_burst_through_tracker(tmp_path):
+    """The real seam: SpanTracker.retire → monitor.on_retire → dump."""
+    reg = Registry()
+    flight = FlightRecorder(capacity=64, registry=reg, replica="r0")
+    mon = AnomalyMonitor(
+        flight, tmp_path, registry=reg,
+        slo_burst=SloBurstDetector(window=8, min_misses=4, miss_ratio=0.5,
+                                   burst_factor=1.5, min_weight=4.0),
+        cooldown_s=0.0)
+    tracker = SpanTracker(reg, engine="continuous", flight=flight)
+    tracker.anomaly = mon
+
+    def run_one(rid, slow):
+        tr = tracker.submit(rid)
+        tracker.admit_start(tr)
+        tracker.admitted(tr)
+        # Fake the timings by editing the trace edges: healthy requests
+        # retire instantly; degraded ones look seconds old at retire.
+        if slow:
+            tr.t_submit -= 30.0
+            tr.t_first_token = None
+        else:
+            tr.t_first_token = tr.t_submit + 0.01
+        tracker.tokens(tr, 2)
+        tracker.retire(tr, status="ok")
+
+    for rid in range(16):
+        run_one(rid, slow=False)
+    assert mon.incidents() == []
+    for rid in range(16, 26):
+        run_one(rid, slow=True)
+    incs = mon.incidents()
+    assert incs and incs[0]["kind"] == "slo_burst"
+    assert (tmp_path / incs[0]["id"] / "flight-r0.jsonl").exists()
+
+
+# ---------------------------------------------------------------------------
+# Fleet propagation: router fan-out + prober callback
+# ---------------------------------------------------------------------------
+
+
+class _StubTransport:
+    """Records post_json calls; answers get_json from a canned table."""
+
+    def __init__(self, readyz_body=None):
+        self.posts = []
+        self.readyz_body = readyz_body or {"ready": True, "inflight": 0}
+        self._lock = threading.Lock()
+
+    def post_json(self, url, payload, timeout_s=None, headers=None):
+        with self._lock:
+            self.posts.append((url, payload, timeout_s))
+        return 200, {"accepted": True}
+
+    def get_json(self, url, timeout_s=None, headers=None):
+        return 200, dict(self.readyz_body)
+
+
+def _wait_for(predicate, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_router_observe_incident_broadcasts_dedupes_and_surfaces(tmp_path):
+    from edgemesh.fleet import FleetRouter, ReplicaRegistry
+
+    reg = ReplicaRegistry([("r0", "http://h0"), ("r1", "http://h1"),
+                           ("r2", "http://h2")])
+    transport = _StubTransport()
+    obs = Registry()
+    router = FleetRouter(reg, transport=transport, obs_registry=obs,
+                         span_log=tmp_path / "router.jsonl")
+    incident = {"id": "inc-abc", "kind": "slo_burst", "ts": 1234.0}
+    assert router.observe_incident("r0", incident) is True
+    # Fan-out (on its own thread) reaches every OTHER replica's /incident.
+    assert _wait_for(lambda: len(transport.posts) == 2)
+    urls = sorted(u for u, _, _ in transport.posts)
+    assert urls == ["http://h1/incident", "http://h2/incident"]
+    for _, payload, timeout_s in transport.posts:
+        assert payload == {"id": "inc-abc", "kind": "slo_burst",
+                           "source": "r0"}
+        assert timeout_s is not None  # EM108 semantics, live
+    # Dedupe: the prober re-observes the same digest every tick.
+    assert router.observe_incident("r0", incident) is False
+    assert len(transport.posts) == 2
+    # Surfaced on /fleetz + counted + logged for the postmortem timeline.
+    status = router.status()
+    assert status["incidents"][0]["id"] == "inc-abc"
+    assert status["incidents"][0]["source"] == "r0"
+    assert obs.summary()[
+        'edgemesh_fleet_incidents_total{kind="slo_burst"}'] == 1
+    logged = JsonlLogger(tmp_path / "router.jsonl").read()
+    assert any(r["event"] == "incident" and r["id"] == "inc-abc"
+               for r in logged)
+
+
+def test_prober_invokes_incident_callback_from_digest():
+    from edgemesh.fleet import HealthProber, ReplicaRegistry
+
+    incident = {"id": "inc-xyz", "kind": "queue_collapse", "ts": 1.0}
+    transport = _StubTransport(readyz_body={
+        "ready": True, "inflight": 0,
+        "load": {"queue_depth": 40, "incident": incident},
+    })
+    reg = ReplicaRegistry([("r0", "http://h0")])
+    seen = []
+    prober = HealthProber(reg, transport=transport, obs_registry=Registry(),
+                          on_incident=lambda rid, inc: seen.append((rid, inc)))
+    prober.probe_once()
+    assert seen == [("r0", incident)]
+    # A digest without the field (pre-flight replicas) is simply quiet.
+    transport.readyz_body = {"ready": True, "inflight": 0,
+                             "load": {"queue_depth": 0}}
+    prober.probe_once()
+    assert len(seen) == 1
+
+
+# ---------------------------------------------------------------------------
+# Postmortem assembly
+# ---------------------------------------------------------------------------
+
+
+def _span_record(rid, replica, ts_submit, queue_s, decode_s, tenant="chat",
+                 slo_result="good", trace_id=None):
+    t0 = 100.0  # perf-counter anchor; ts_submit is the wall anchor
+    prefill_s = 0.01
+    spans = [
+        {"name": "queued", "t0": t0, "t1": t0 + queue_s},
+        {"name": "prefill", "t0": t0 + queue_s,
+         "t1": t0 + queue_s + prefill_s},
+        {"name": "decode", "t0": t0 + queue_s + prefill_s,
+         "t1": t0 + queue_s + prefill_s + decode_s, "tokens": 4},
+        {"name": "retire", "t0": t0 + queue_s + prefill_s + decode_s,
+         "t1": t0 + queue_s + prefill_s + decode_s},
+    ]
+    return {
+        "rid": rid, "engine": "continuous", "status": "ok",
+        "tenant": tenant, "session": f"{tenant}-0",
+        "trace_id": trace_id or f"{replica}-{rid:04d}",
+        "ts_submit": ts_submit, "generated": 4, "segments": 1,
+        "queue_s": queue_s, "prefill_s": prefill_s,
+        "latency_s": queue_s + prefill_s + decode_s,
+        "slo_result": slo_result, "spans": spans,
+    }
+
+
+def test_assemble_incident_marks_window_and_names_degraded_replica(tmp_path):
+    trigger_wall = 1000.0
+    reg = Registry()
+    rings = {}
+    for rid in ("fast-1", "fast-2", "slow"):
+        rings[rid] = FlightRecorder(capacity=64, registry=reg, replica=rid)
+    # Before the window: everyone healthy.
+    for i, rid in enumerate(("fast-1", "fast-2", "slow")):
+        rings[rid].record(SPAN_RECORD_EVENT, _span_record(
+            i, rid, trigger_wall - 60.0, queue_s=0.01, decode_s=0.05))
+    # During the window: the slow replica's requests drown in queue+decode.
+    for i in range(4):
+        rings["slow"].record(SPAN_RECORD_EVENT, _span_record(
+            10 + i, "slow", trigger_wall - 2.0 + i * 0.5,
+            queue_s=2.0, decode_s=3.0, slo_result="ttft"))
+        rings["fast-1"].record(SPAN_RECORD_EVENT, _span_record(
+            20 + i, "fast-1", trigger_wall - 2.0 + i * 0.5,
+            queue_s=0.01, decode_s=0.05))
+    # After: recovery.
+    rings["fast-2"].record(SPAN_RECORD_EVENT, _span_record(
+        30, "fast-2", trigger_wall + 30.0, queue_s=0.01, decode_s=0.05))
+    # The slow replica fired locally; the others dumped via propagation.
+    incident_id = "inc-test-1"
+    rings["slow"].dump(tmp_path, incident_id, kind="slo_burst",
+                       trigger_ts=trigger_wall)
+    for rid in ("fast-1", "fast-2"):
+        rings[rid].dump(tmp_path, incident_id, kind="propagated",
+                        trigger_ts=trigger_wall + 1.0)
+
+    paths = sorted((tmp_path / incident_id).glob("*.jsonl"))
+    assert len(paths) == 3
+    doc = assemble_incident(paths, window_s=10.0)
+    assert doc["incident_id"] == incident_id
+    # The LOCAL trigger anchors the window, not the propagated dumps.
+    assert doc["trigger_ts"] == trigger_wall
+    assert doc["replicas"] == ["fast-1", "fast-2", "slow"]
+    assert set(doc["kinds"]) == {"slo_burst", "propagated"}
+    # Phases: healthy before, degraded during, recovered after.
+    assert doc["phases"]["before"]["goodput_ratio"] == 1.0
+    assert doc["phases"]["during"]["goodput_ratio"] == 0.5
+    assert doc["phases"]["after"]["goodput_ratio"] == 1.0
+    assert doc["phases"]["during"]["tenants"]["chat"]["classified"] == 8
+    # The trigger-window critical path names the degraded replica.
+    cp = doc["critical_path"]
+    assert cp["slowest_replica"] == "slow"
+    assert cp["window"]["slow"]["queue_s"] > cp["window"]["fast-1"]["queue_s"]
+    assert cp["window"]["slow"]["decode_s"] > 1.0
+    assert doc["timeline"], "dump headers must land on the timeline"
+
+
+def test_obs_incident_cli_and_directory_expansion(tmp_path, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    reg = Registry()
+    ring = FlightRecorder(capacity=8, registry=reg, replica="r0")
+    ring.record(SPAN_RECORD_EVENT, _span_record(
+        0, "r0", 500.0, queue_s=0.5, decode_s=1.0, slo_result="ttft"))
+    ring.dump(tmp_path / "incident", "inc-cli", kind="error_spike",
+              trigger_ts=500.5)
+    rc = obs_main(["incident", str(tmp_path / "incident" / "inc-cli")])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["incident_id"] == "inc-cli"
+    assert doc["critical_path"]["slowest_replica"] == "r0"
+    # A directory with no dump header is a usage answer, exit 1.
+    (tmp_path / "empty").mkdir()
+    (tmp_path / "empty" / "x.jsonl").write_text("")
+    assert obs_main(["incident", str(tmp_path / "empty")]) == 1
+    capsys.readouterr()
+    # Missing path: usage error.
+    assert obs_main(["incident", str(tmp_path / "nope")]) == 2
+
+
+def test_obs_summary_and_trace_accept_directories(tmp_path, capsys):
+    """Satellite: a DIRECTORY of logs works wherever a span log did —
+    incident dump dirs make explicit file lists untenable."""
+    from edgemesh.obs.cli import main as obs_main
+
+    d = tmp_path / "logs"
+    d.mkdir()
+    for i, name in enumerate(("a.jsonl", "b.jsonl")):
+        log = JsonlLogger(d / name)
+        rec = _span_record(i, "r0", 500.0 + i, queue_s=0.1, decode_s=0.2,
+                           trace_id=f"{'ab'[i] * 32}")
+        log.log(SPAN_RECORD_EVENT, **rec)
+    assert obs_main(["summary", str(d)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["requests"] == 2
+    assert obs_main(["tail", str(d)]) == 0
+    capsys.readouterr()
+    assert obs_main(["prom", str(d)]) == 0
+    capsys.readouterr()
+    # trace --logs with the directory: assembles from the expanded files.
+    assert obs_main(["trace", "a" * 32, "--logs", str(d)]) == 0
+    tree = json.loads(capsys.readouterr().out)
+    assert tree["trace_id"] == "a" * 32 and tree["tree"] is not None
