@@ -48,6 +48,14 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   the distributed trace at exactly the hop tracing exists to explain.
   Calls with no ``headers=`` at all (probes, drain admin) are out of
   scope, as are opaque header variables the linter cannot see into.
+  KV TRANSFER calls are held to a stricter contract: a call whose URL
+  literally targets a ``/kv/`` path (``rep.url("/kv/export")``, an
+  f-string ending in ``/kv/import``) must ALSO carry the
+  ``X-Edgemesh-Deadline-S`` key (``DEADLINE_HEADER`` counts), and a
+  transfer call with no ``headers=`` at all flags — a transfer without a
+  deadline lets one slow export pin the tiered path past the client's
+  budget, and without a trace the cross-replica prefill hop vanishes
+  from the assembled tree.
 - EM110 serve-per-row-dispatch (error): a HOST loop in
   ``edgemesh/serve/`` that calls a jitted forward per iteration — a name
   imported from edgemesh.runtime/models matching ``forward_*``/
@@ -232,6 +240,11 @@ _EM108_DIRS = ("edgemesh/fleet/",)
 _EM109_CALLS = {"post_json", "get_json"}
 _EM109_URLOPEN = "urllib.request.urlopen"
 _EM109_HEADER = "X-Edgemesh-Trace"
+# KV transfer calls (URL literally targeting a /kv/ path) additionally
+# require the deadline header — and unlike probes, a transfer with no
+# headers= at all is in scope: it is provably missing both.
+_EM109_DEADLINE_HEADER = "X-Edgemesh-Deadline-S"
+_EM109_KV_MARKER = "/kv/"
 _EM108_CALLS = {
     "urllib.request.urlopen": 2,        # urlopen(url, data, timeout)
     "socket.create_connection": 1,      # create_connection(address, timeout)
@@ -669,16 +682,42 @@ class _FileLinter:
     # -- EM109 -------------------------------------------------------------
 
     @staticmethod
-    def _dict_has_trace_header(d: ast.Dict) -> bool:
+    def _dict_has_header(d: ast.Dict, literal: str, const_name: str) -> bool:
         for key in d.keys:
             if key is None:  # {**expansion}: assume the source forwards it
                 return True
-            if isinstance(key, ast.Constant) and key.value == _EM109_HEADER:
+            if isinstance(key, ast.Constant) and key.value == literal:
                 return True
             if isinstance(key, (ast.Name, ast.Attribute)):
                 dotted = _dotted_name(key)
-                if dotted and dotted.rsplit(".", 1)[-1] == "TRACE_HEADER":
+                if dotted and dotted.rsplit(".", 1)[-1] == const_name:
                     return True
+        return False
+
+    @classmethod
+    def _dict_has_trace_header(cls, d: ast.Dict) -> bool:
+        return cls._dict_has_header(d, _EM109_HEADER, "TRACE_HEADER")
+
+    @classmethod
+    def _dict_has_deadline_header(cls, d: ast.Dict) -> bool:
+        return cls._dict_has_header(d, _EM109_DEADLINE_HEADER,
+                                    "DEADLINE_HEADER")
+
+    @staticmethod
+    def _call_targets_kv_transfer(node: ast.Call) -> bool:
+        """True when the call's URL expression LITERALLY names a /kv/ path
+        — a constant, an f-string piece, or a ``rep.url("/kv/export")``
+        argument. Opaque URLs (a variable, ``rep.url(path)``) are out of
+        scope, same visibility contract as the headers-dict rule."""
+        if not node.args:
+            return False
+        for sub in ast.walk(node.args[0]):
+            if (
+                isinstance(sub, ast.Constant)
+                and isinstance(sub.value, str)
+                and _EM109_KV_MARKER in sub.value
+            ):
+                return True
         return False
 
     def _headers_dict_for_call(self, node: ast.Call) -> ast.Dict | None:
@@ -730,15 +769,37 @@ class _FileLinter:
             if not (is_transport or is_urlopen):
                 continue
             headers = self._headers_dict_for_call(node)
-            if headers is None or self._dict_has_trace_header(headers):
+            is_transfer = self._call_targets_kv_transfer(node)
+            if headers is None:
+                if is_transfer:
+                    # A KV transfer with no headers at all is provably
+                    # missing both required keys — flag it; plain probes
+                    # and admin calls stay out of scope.
+                    self._emit(
+                        "EM109", node,
+                        "KV transfer call sends no headers — every "
+                        f"/kv/ hop must carry {_EM109_HEADER!r} and "
+                        f"{_EM109_DEADLINE_HEADER!r} (trace continuity + "
+                        "the router's budget math)",
+                    )
                 continue
-            self._emit(
-                "EM109", node,
-                "outbound fleet HTTP call builds headers without "
-                f"{_EM109_HEADER!r} — the distributed trace severs at this "
-                "hop (add httputil.TRACE_HEADER: ctx.to_header(), or "
-                "forward the incoming headers)",
-            )
+            if not self._dict_has_trace_header(headers):
+                self._emit(
+                    "EM109", node,
+                    "outbound fleet HTTP call builds headers without "
+                    f"{_EM109_HEADER!r} — the distributed trace severs at "
+                    "this hop (add httputil.TRACE_HEADER: ctx.to_header(), "
+                    "or forward the incoming headers)",
+                )
+            if is_transfer and not self._dict_has_deadline_header(headers):
+                self._emit(
+                    "EM109", node,
+                    "KV transfer call builds headers without "
+                    f"{_EM109_DEADLINE_HEADER!r} — a transfer that ignores "
+                    "the request budget lets one slow export pin the "
+                    "tiered path past the client's deadline (add "
+                    "httputil.DEADLINE_HEADER)",
+                )
 
     # -- EM110 -------------------------------------------------------------
 

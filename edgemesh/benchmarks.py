@@ -1352,6 +1352,228 @@ def load_curve_benchmark(n_replicas: int = 2, duration_s: float = 4.0,
                 srv.batcher.close()
 
 
+def disagg_benchmark(n_replicas: int = 3, duration_s: float = 4.0,
+                     max_new: int = 8, prefill_threshold_chars: int = 250,
+                     long_chars: int = 350, chat_chars: int = 60,
+                     ) -> dict[str, Any]:
+    """Prefill/decode disaggregation A/B: homogeneous vs tiered fleet on a
+    mixed long-prefill/chatty open-loop workload (docs/FLEET.md "Tiered
+    serving and KV streaming").
+
+    Boots ``n_replicas`` in-process PAGED continuous replicas (tiny
+    synthetic model — the routing/transfer layer is under test, not the
+    kernels) and drives the same seeded two-tenant workload — a chatty
+    interactive tenant plus a long-prompt bulk tenant — through two router
+    arms: homogeneous least-outstanding, and tiered (long prefills to the
+    prefill tier, KV streamed to the decode tier, shared prefix cache on).
+    The headline is ``disagg_ttft_p99_ratio`` = homogeneous chat-tenant
+    p99 / tiered chat-tenant p99 (> 1 means tiering protected the chatty
+    tenant's TTFT from long-prefill stalls; the non-streaming front door's
+    response latency IS its TTFT), alongside per-arm goodput and the KV
+    wire bytes the tiered arm actually moved."""
+    import threading
+
+    from edgemesh.agents.orchestrator import Ensemble, build_agent
+    from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
+    from edgemesh.fleet import (
+        FleetRouter,
+        HealthProber,
+        HttpTransport,
+        ReplicaRegistry,
+        serve_fleet,
+    )
+    from edgemesh.loadgen import (
+        LengthMix,
+        OpenLoopGenerator,
+        PoissonProcess,
+        TenantSpec,
+        Workload,
+        http_target,
+    )
+    from edgemesh.obs import Registry
+    from edgemesh.serve import serve_rest
+
+    transport = HttpTransport()
+
+    def _replica():
+        agent = build_agent(AgentSpec(
+            role="qa", model=ModelSpec(),
+            sampling=SamplingParams(max_new_tokens=max_new, do_sample=False,
+                                    repetition_penalty=1.0),
+        ))
+        return serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1",
+                          port=0, block=False, continuous=True, batch=2,
+                          kv_backend="paged", registry=Registry(),
+                          trace_sample=0.0)
+
+    _progress(f"disagg: building {n_replicas} in-process paged replicas")
+    servers = [_replica() for _ in range(n_replicas)]
+    fronts: list = []
+    probers: list = []
+    try:
+        urls = [f"http://127.0.0.1:{s.server_address[1]}" for s in servers]
+        long_q = "why " * (long_chars // 4)
+        chat_q = "chat warmup question?"
+        for url in urls:
+            # Warm BOTH prompt-shape compile buckets per replica, plus the
+            # export gather (the tiered arm's first transfer must not pay
+            # a compile mid-measurement).
+            for q in (chat_q, long_q):
+                status, _ = transport.post_json(
+                    f"{url}/generate", {"question": q}, timeout_s=600.0)
+                if status != 200:
+                    raise RuntimeError(f"warmup on {url} answered {status}")
+            status, _ = transport.post_json(
+                f"{url}/kv/export", {"question": long_q}, timeout_s=600.0)
+            if status != 200:
+                raise RuntimeError(f"export warmup on {url} answered {status}")
+
+        # Closed-loop capacity calibration on the chat shape (the tenant
+        # whose TTFT the A/B judges) — same rationale as load_curve.
+        cal_lats: list[float] = []
+        cal_lock = threading.Lock()
+        cal_stop = time.perf_counter() + 2.0
+
+        def cal_worker(url):
+            while time.perf_counter() < cal_stop:
+                t0 = time.perf_counter()
+                status, _ = transport.post_json(
+                    f"{url}/generate", {"question": chat_q}, timeout_s=600.0)
+                if status == 200:
+                    with cal_lock:
+                        cal_lats.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=cal_worker, args=(u,), daemon=True)
+                   for u in urls for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if not cal_lats:
+            raise RuntimeError("disagg calibration produced no throughput")
+        cal_lats.sort()
+        capacity_rps = len(cal_lats) / 2.0
+        slo_latency_s = max(
+            4.0 * cal_lats[int(0.95 * (len(cal_lats) - 1))], 0.25
+        )
+        # Well below the closed-loop estimate: the A/B judges long-prefill
+        # INTERFERENCE with chatty TTFT, and an over-the-knee overload
+        # would swamp that signal with pure queueing collapse in both arms
+        # (the in-process replicas share one GIL with the generator).
+        chat_rate = max(0.5, 0.35 * capacity_rps)
+        bulk_rate = max(0.25, 0.08 * capacity_rps)
+
+        def make_workload(seed: int = 5) -> Workload:
+            return Workload([
+                TenantSpec(name="chat",
+                           arrival=PoissonProcess(chat_rate, seed=11),
+                           prompt_mix=LengthMix(median=chat_chars, sigma=0.0,
+                                                lo=chat_chars, hi=chat_chars),
+                           lane="interactive"),
+                TenantSpec(name="bulk",
+                           arrival=PoissonProcess(bulk_rate, seed=13),
+                           prompt_mix=LengthMix(median=long_chars, sigma=0.0,
+                                                lo=long_chars, hi=long_chars),
+                           lane="batch"),
+            ], seed=seed)
+
+        def run_arm(tiered: bool):
+            obs = Registry()
+            registry = ReplicaRegistry(
+                (f"replica-{i}", u) for i, u in enumerate(urls)
+            )
+            router = FleetRouter(
+                registry, balancer="least_outstanding", transport=transport,
+                obs_registry=obs, attempt_timeout_s=300.0,
+                default_deadline_s=600.0, max_attempts=2, tiered=tiered,
+                prefill_threshold_chars=prefill_threshold_chars,
+            )
+            prober = HealthProber(registry, transport=transport,
+                                  interval_s=0.5, obs_registry=obs,
+                                  on_digest=router.note_digest).start()
+            probers.append(prober)
+            front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+            fronts.append(front)
+            target = http_target(
+                f"http://127.0.0.1:{front.server_address[1]}/generate",
+                timeout_s=600.0,
+            )
+            if tiered:
+                # Prime the transfer path through THIS router (export →
+                # import compile + the tier split) outside the window.
+                target({"question": long_q}, {})
+            arm = "tiered" if tiered else "homogeneous"
+            _progress(f"disagg: {arm} arm at chat {chat_rate:.1f} + bulk "
+                      f"{bulk_rate:.1f} rps for {duration_s:.1f}s")
+            gen = OpenLoopGenerator(
+                target, make_workload().build_schedule(duration_s),
+                slo_latency_s=slo_latency_s, duration_s=duration_s,
+            )
+            report = gen.run()
+            # Tear the arm down before the next one measures: a leftover
+            # prober polling every replica (and an extra bound frontend)
+            # would be asymmetric background load on the later arm. The
+            # outer finally re-stops idempotently.
+            prober.stop()
+            front.shutdown()
+            return report, obs, router
+
+        homog, _, _ = run_arm(tiered=False)
+        tiered_rep, tiered_obs, tiered_router = run_arm(tiered=True)
+
+        def chat_p99(report):
+            cell = (report.get("tenants") or {}).get("chat") or {}
+            return cell.get("latency_s_p99")
+
+        h_p99, t_p99 = chat_p99(homog), chat_p99(tiered_rep)
+        ratio = (
+            round(h_p99 / t_p99, 4)
+            if h_p99 is not None and t_p99 not in (None, 0) else None
+        )
+        fleet = tiered_obs.summary(prefix="edgemesh_fleet_")
+        kv_bytes = int(sum(
+            v for k, v in fleet.items()
+            if k.startswith("edgemesh_fleet_kv_transfer_bytes_total")
+            and not isinstance(v, dict)
+        ))
+        tiered_outcomes = {
+            k.split('outcome="')[1].rstrip('"}'): int(v)
+            for k, v in fleet.items()
+            if k.startswith("edgemesh_fleet_tiered_total")
+            and not isinstance(v, dict)
+        }
+        _progress(f"disagg: chat p99 {h_p99} -> {t_p99} "
+                  f"(ratio {ratio}); kv bytes {kv_bytes}")
+        return {
+            "metric": "disagg_ttft_p99_ratio",
+            "value": ratio,
+            "unit": "x",
+            "n_replicas": n_replicas,
+            "duration_s": duration_s,
+            "slo_latency_s": round(slo_latency_s, 6),
+            "estimated_capacity_rps": round(capacity_rps, 3),
+            "prefill_threshold_chars": prefill_threshold_chars,
+            "homogeneous_chat_p99_s": h_p99,
+            "tiered_chat_p99_s": t_p99,
+            "homogeneous_goodput_ratio": homog.get("goodput_ratio"),
+            "tiered_goodput_ratio": tiered_rep.get("goodput_ratio"),
+            "homogeneous_tenants": homog.get("tenants"),
+            "tiered_tenants": tiered_rep.get("tenants"),
+            "kv_transfer_bytes": kv_bytes,
+            "tiered_outcomes": tiered_outcomes,
+            "tiers": tiered_router.status()["tiers"],
+        }
+    finally:
+        for prober in probers:
+            prober.stop()
+        for front in fronts:
+            front.shutdown()
+        for srv in servers:
+            srv.shutdown()
+            if srv.batcher is not None:
+                srv.batcher.close()
+
+
 def ensemble_overlap_benchmark(n_agents: int = 2, questions: int = 3) -> dict[str, Any]:
     """Concurrent-vs-serial wall time for ensemble QA agents on disjoint
     submeshes — the measured version of the claim that edgemesh fixes the
@@ -1826,6 +2048,26 @@ def headline_benchmark(
 
     if os.environ.get("EDGEMESH_BENCH_LOADGEN", "1") == "1":
         _stage("load_curve", _load_curve)
+
+    # ---- Stage 7f: prefill/decode disaggregation A/B — homogeneous vs
+    # tiered routing (KV streamed prefill→decode tier, shared prefix
+    # cache) on a mixed long-prefill/chatty workload. The headline is
+    # disagg_ttft_p99_ratio: how much tiering protects the chatty
+    # tenant's TTFT p99. EDGEMESH_BENCH_DISAGG=0 skips.
+    def _disagg():
+        r = disagg_benchmark()
+        out["disagg_ttft_p99_ratio"] = r["value"]
+        out["disagg_kv_transfer_bytes"] = r["kv_transfer_bytes"]
+        for k in ("homogeneous_chat_p99_s", "tiered_chat_p99_s",
+                  "homogeneous_goodput_ratio", "tiered_goodput_ratio",
+                  "homogeneous_tenants", "tiered_tenants",
+                  "tiered_outcomes", "slo_latency_s",
+                  "prefill_threshold_chars"):
+            out[f"disagg_{k}"] = r[k]
+        out["disagg_tiers"] = r["tiers"]
+
+    if os.environ.get("EDGEMESH_BENCH_DISAGG", "1") == "1":
+        _stage("disagg", _disagg)
 
     # ---- Stage 8: speculative decoding at b1 (the latency regime) — on by
     # default since round 4 (EDGEMESH_BENCH_SPEC=0 skips): the reference

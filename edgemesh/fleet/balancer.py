@@ -36,6 +36,7 @@ accelerator backend at all (same contract as edgemesh.obs).
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 from typing import Sequence
 
@@ -166,6 +167,110 @@ class TelemetryBalancer:
         return min(
             enumerate(candidates), key=lambda t: (self._cost(t[1]), t[0])
         )[1]
+
+
+class TierManager:
+    """Dynamic prefill/decode tier membership for disaggregated serving.
+
+    Scores every routable replica by its OBSERVED phase mix — the
+    ``ewma_prefill_tokens`` / ``ewma_decode_tokens`` split each load digest
+    ships (obs/spans.py, refreshed by the health prober) — and assigns the
+    most prefill-heavy ``prefill_fraction`` of the fleet to the prefill
+    tier, the rest to the decode tier. Membership is therefore DYNAMIC and
+    self-reinforcing: the router sends long prefills to the prefill tier,
+    which keeps those replicas' prefill share high, which keeps them in the
+    tier — while a workload shift (the longs dry up) decays the EWMAs and
+    membership follows within a few requests. A replica with no digest yet
+    scores the neutral 0.5 and ties break by replica id, so a cold fleet
+    still gets a stable, deterministic split.
+
+    Guard rails the router's graceful-degradation contract relies on:
+
+    - fewer than two routable replicas → NO prefill tier (``assign``
+      returns every replica as decode) — the router must fall back to
+      homogeneous serving rather than starve either phase;
+    - the prefill tier never exceeds n-1 replicas and never drops below 1
+      (when tiering is possible at all);
+    - ``hysteresis`` biases incumbents' scores so membership doesn't flap
+      when two replicas' shares cross by noise;
+    - assignments are cached for ``refresh_s`` (the router reads tiers on
+      every request; scoring is O(n log n)) and ``invalidate()`` — wired
+      to the prober's digest refresh — forces a recompute on fresh data.
+    """
+
+    name = "tiers"
+
+    def __init__(self, prefill_fraction: float = 1 / 3,
+                 refresh_s: float = 1.0, hysteresis: float = 0.1,
+                 now=time.monotonic) -> None:
+        if not 0.0 < prefill_fraction < 1.0:
+            raise ValueError(
+                f"prefill_fraction must be in (0, 1), got {prefill_fraction}"
+            )
+        self.prefill_fraction = float(prefill_fraction)
+        self.refresh_s = float(refresh_s)
+        self.hysteresis = float(hysteresis)
+        self._now = now  # injectable: tests pin the refresh window
+        self._lock = threading.Lock()
+        self._cached: dict | None = None  # guarded by: _lock
+        self._cached_ts: float | None = None  # guarded by: _lock
+        self._cached_rids: frozenset | None = None  # guarded by: _lock
+        self._prefill_rids: frozenset = frozenset()  # guarded by: _lock
+
+    @staticmethod
+    def _prefill_share(rep) -> float:
+        load = getattr(rep, "load", None)
+        if not isinstance(load, dict):
+            return 0.5
+        pt = load.get("ewma_prefill_tokens")
+        dt = load.get("ewma_decode_tokens")
+        if pt is None and dt is None:
+            return 0.5
+        pt, dt = float(pt or 0.0), float(dt or 0.0)
+        return pt / (pt + dt) if pt + dt > 0 else 0.5
+
+    def invalidate(self) -> None:
+        """Drop the cached assignment (fresh digests arrived)."""
+        with self._lock:
+            self._cached_ts = None
+
+    def assign(self, replicas: Sequence) -> dict:
+        """``{"prefill": [...], "decode": [...]}`` over the routable subset
+        of ``replicas``. Never raises; an un-tierable fleet comes back with
+        an empty prefill list (the caller's homogeneous-fallback signal)."""
+        healthy = [r for r in replicas if r.routable()]
+        rids = frozenset(r.rid for r in healthy)
+        now = self._now()
+        with self._lock:
+            if (
+                self._cached is not None
+                and self._cached_ts is not None
+                and now - self._cached_ts < self.refresh_s
+                and rids == self._cached_rids
+            ):
+                return self._cached
+            if len(healthy) < 2:
+                out = {"prefill": [], "decode": healthy}
+                self._cached, self._cached_ts = out, now
+                self._cached_rids = rids
+                self._prefill_rids = frozenset()
+                return out
+            prev = self._prefill_rids
+            order = sorted(
+                healthy,
+                key=lambda r: (
+                    -(self._prefill_share(r)
+                      + (self.hysteresis if r.rid in prev else 0.0)),
+                    r.rid,
+                ),
+            )
+            n_pre = max(1, min(len(healthy) - 1,
+                               round(self.prefill_fraction * len(healthy))))
+            out = {"prefill": order[:n_pre], "decode": order[n_pre:]}
+            self._cached, self._cached_ts = out, now
+            self._cached_rids = rids
+            self._prefill_rids = frozenset(r.rid for r in out["prefill"])
+            return out
 
 
 BALANCERS = {

@@ -65,6 +65,17 @@ def build_parser() -> argparse.ArgumentParser:
                      "live p95 of a time-decayed latency histogram "
                      "(docs/FLEET.md 'Adaptive routing')")
     srv.add_argument("--max-inflight", type=int, default=64)
+    srv.add_argument("--tiered", action="store_true",
+                     help="prefill/decode disaggregation: long prefills "
+                     "route to prefill-tier replicas and their KV streams "
+                     "to decode-tier ones (replicas must serve --continuous "
+                     "--kv-backend paged; docs/FLEET.md 'Tiered serving')")
+    srv.add_argument("--prefill-threshold-chars", type=int, default=512,
+                     help="prompts at/above this length count as long "
+                     "prefills for tiered routing")
+    srv.add_argument("--tier-prefill-fraction", type=float, default=1 / 3,
+                     help="share of the fleet assigned to the prefill tier "
+                     "(membership itself is dynamic, digest-EWMA-driven)")
     srv.add_argument("--tenant-policy", action="append", default=[],
                      metavar="TENANT=LANE:WEIGHT[:RATE[:BURST]]",
                      help="per-tenant admission policy, repeatable — e.g. "
@@ -200,6 +211,12 @@ def cmd_serve(args) -> int:
                 max_inflight=args.max_inflight, policies=policies,
                 queue_cap=args.admission_queue_cap,
             )
+        tier_manager = None
+        if args.tiered:
+            from edgemesh.fleet.balancer import TierManager
+
+            tier_manager = TierManager(
+                prefill_fraction=args.tier_prefill_fraction)
         router = FleetRouter(
             registry,
             balancer=args.balancer,
@@ -215,12 +232,18 @@ def cmd_serve(args) -> int:
             admission_wait_s=args.admission_wait_s,
             span_log=args.span_log,
             trace_sample=args.trace_sample,
+            tiered=args.tiered,
+            tier_manager=tier_manager,
+            prefill_threshold_chars=args.prefill_threshold_chars,
         )
         prober = HealthProber(registry, transport=transport,
                               interval_s=args.probe_interval_s,
                               # Replica-fired incidents (flight recorder
                               # dumps) fan out fleet-wide via the router.
-                              on_incident=router.observe_incident).start()
+                              on_incident=router.observe_incident,
+                              # Fresh digests re-derive tier membership on
+                              # the probe cadence (no-op untiered).
+                              on_digest=router.note_digest).start()
         print(
             f"edgemesh fleet: {len(procs)} replicas behind "
             f"http://{args.host}:{args.port} (balancer={args.balancer}); "

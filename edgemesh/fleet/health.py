@@ -40,7 +40,7 @@ class HealthProber:
     def __init__(self, registry, transport=None, interval_s: float = 2.0,
                  timeout_s: float = 1.0, unhealthy_after: int = 2,
                  healthy_after: int = 1, obs_registry=None,
-                 on_incident=None) -> None:
+                 on_incident=None, on_digest=None) -> None:
         from edgemesh.obs import get_registry
 
         self.registry = registry
@@ -56,6 +56,11 @@ class HealthProber:
         #: replica; the callback dedupes, so re-probing the same incident
         #: on every cadence tick is free.
         self.on_incident = on_incident
+        #: Called ``(rid, digest_dict)`` after every stored digest refresh.
+        #: The tiered router wires this to ``FleetRouter.note_digest`` so
+        #: prefill/decode tier membership re-derives from fresh phase
+        #: EWMAs on the probe cadence (docs/FLEET.md "Tiered serving").
+        self.on_digest = on_digest
         reg = obs_registry or get_registry()
         self._probes = reg.counter(
             "edgemesh_fleet_probes_total",
@@ -82,6 +87,12 @@ class HealthProber:
                 # so the telemetry balancer's signal refreshes for free on
                 # the existing probe cadence — zero extra requests.
                 self.registry.update_load(rep.rid, load)
+                if self.on_digest is not None:
+                    try:
+                        self.on_digest(rep.rid, load)
+                    except Exception:  # telemetry must never break probing
+                        log.exception("digest callback failed for %s",
+                                      rep.rid)
                 incident = load.get("incident")
                 if incident and self.on_incident is not None:
                     try:

@@ -32,6 +32,15 @@ robustness semantics on top of the replica registry:
   visible at the edge. Tenant identity (``X-Edgemesh-Tenant``) selects
   the policy, is propagated to replicas, and labels per-tenant counters
   as a BOUNDED value (obs.metrics.bounded_label).
+- **Tiered serving.** With ``tiered=True``, long prefills route to
+  prefill-tier replicas (membership is dynamic — TierManager scores each
+  replica's digest prefill/decode token EWMAs) and the resulting paged KV
+  streams to the least-loaded decode-tier replica via ``/kv/export`` →
+  ``/kv/import`` (runtime/paged_kv.py wire format). The router keeps a
+  bounded LRU of export payloads — the fleet's shared prefix cache: a hot
+  prefix prefills once fleet-wide. Transfer endpoints never hedge
+  (non-idempotent), and EVERY transfer failure falls back to homogeneous
+  routing with no client-visible error.
 - **Graceful drain.** ``drain_replica`` takes a replica out of rotation,
   calls its ``/drain`` hook, polls ``/readyz`` until in-flight work hits
   zero, then marks it removed — zero dropped requests by construction.
@@ -57,22 +66,53 @@ import queue
 import random
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
 from edgemesh.fleet.admission import AdmissionController
-from edgemesh.fleet.balancer import make_balancer
+from edgemesh.fleet.balancer import (
+    PrefixAffinityBalancer,
+    TierManager,
+    make_balancer,
+)
 from edgemesh.fleet.transport import HttpTransport, TransportError
 from edgemesh.obs.metrics import bounded_label
 from edgemesh.obs.slo import DecayingQuantile, SloTarget
 from edgemesh.obs.trace import ROUTER_RECORD_EVENT, TraceContext, sample
 from edgemesh.serve.httputil import (
     DEADLINE_HEADER,
+    KV_EXPORT_PATH,
+    KV_IMPORT_PATH,
     SESSION_HEADER,
     TENANT_HEADER,
     TRACE_HEADER,
 )
 
 log = logging.getLogger("edgemesh.fleet")
+
+#: Endpoints the router must NEVER hedge: a KV transfer is not idempotent
+#: from the fleet's point of view — a hedged export doubles a prefill, a
+#: hedged import can double-admit (and double-import pages for) the same
+#: request on two replicas, and "first answer wins" would leak the loser's
+#: slot until its budget ran out. Transfer tails are handled by the tiered
+#: path's FALLBACK (re-route homogeneous), not by racing a second copy.
+NON_HEDGEABLE_PATHS = frozenset({KV_EXPORT_PATH, KV_IMPORT_PATH})
+
+
+class _PinnedBalancer:
+    """Single-use balancer that picks exactly one replica id (or nothing):
+    how the tiered path checks out a SPECIFIC replica through the same
+    atomic ``registry.acquire`` bookkeeping every other attempt uses."""
+
+    name = "pinned"
+
+    def __init__(self, rid: str) -> None:
+        self.rid = rid
+
+    def pick(self, candidates, prompt: str | None = None):
+        for rep in candidates:
+            if rep.rid == self.rid:
+                return rep
+        return None
 
 
 class FleetRouter:
@@ -101,6 +141,12 @@ class FleetRouter:
         rng: random.Random | None = None,
         span_log=None,
         trace_sample: float = 1.0,
+        tiered: bool = False,
+        tier_manager: TierManager | None = None,
+        prefill_threshold_chars: int = 512,
+        prefix_chars: int = 64,
+        prefix_hot_after: int = 2,
+        kv_cache_entries: int = 32,
     ) -> None:
         from edgemesh.obs import get_registry
 
@@ -174,6 +220,30 @@ class FleetRouter:
         self._slo_target = SloTarget.from_env()
         self._tenant_lock = threading.Lock()
         self._tenant_stats: dict[str, dict[str, int]] = {}
+        # Tiered serving (prefill/decode disaggregation — docs/FLEET.md
+        # "Tiered serving and KV streaming"): prompts at or above
+        # ``prefill_threshold_chars`` are prefilled on a prefill-tier
+        # replica (rendezvous-chosen by prefix, so a hot prefix keeps
+        # hitting the replica whose export cache holds it), the KV payload
+        # streams through the router into the least-loaded decode-tier
+        # replica, and short prompts route within the decode tier. The
+        # router keeps a bounded LRU of export payloads — the fleet-level
+        # SHARED PREFIX CACHE: once ``prefix_hot_after`` requests share a
+        # prefix key, the prefix is exported once and every later request
+        # imports it instead of recomputing. EVERY transfer failure falls
+        # back to homogeneous routing — tiering is an optimization, never
+        # a correctness gate.
+        self.tiered = bool(tiered)
+        self.tiers: TierManager | None = None
+        if self.tiered:
+            self.tiers = tier_manager or TierManager()
+        self.prefill_threshold_chars = int(prefill_threshold_chars)
+        self.prefix_chars = int(prefix_chars)
+        self.prefix_hot_after = int(prefix_hot_after)
+        self.kv_cache_entries = int(kv_cache_entries)
+        self._kv_lock = threading.Lock()
+        self._kv_cache: OrderedDict[str, dict] = OrderedDict()  # guarded by: _kv_lock
+        self._prefix_seen: OrderedDict[str, int] = OrderedDict()  # guarded by: _kv_lock
         # Rolling successful-attempt latencies: an explicit bounded ring
         # (``latency_window``, surfaced in /fleetz) feeding the legacy
         # ``hedge_percentile`` mode; the auto mode reads the decayed
@@ -227,6 +297,20 @@ class FleetRouter:
         self._exhausted = reg.counter(
             "edgemesh_fleet_exhausted_total",
             "Requests that failed every attempt",
+        )
+        # Tiered-serving accounting: per-request outcome of the transfer
+        # path (tiered = answered via export→import, cache_hit = the
+        # router's shared prefix cache skipped the export hop, fallback_*
+        # = degraded to homogeneous routing — never a client error), and
+        # the KV wire bytes the router moved in each direction.
+        self._tiered_requests = reg.counter(
+            "edgemesh_fleet_tiered_total",
+            "Tiered-serving path outcomes", ("outcome",),
+        )
+        self._kv_bytes = reg.counter(
+            "edgemesh_fleet_kv_transfer_bytes_total",
+            "KV wire bytes moved by router-orchestrated transfers, "
+            "by direction", ("direction",),
         )
         self._incidents_total = reg.counter(
             "edgemesh_fleet_incidents_total",
@@ -390,6 +474,30 @@ class FleetRouter:
         prompt = payload.get("question") if isinstance(payload, dict) else None
         excluded: set[str] = set()
         last_error: str = "no attempt made"
+        # Tiered serving: long prefills (and hot shared prefixes) go
+        # export→import across the tiers; short prompts stay inside the
+        # decode tier. Every failure along the tiered path lands back here
+        # and routes homogeneously — tier_exclude is a routing HINT that
+        # the no-replica branch below clears before it could ever starve
+        # a request.
+        tier_exclude: frozenset[str] = frozenset()
+        if self.tiers is not None and prompt and path == "/generate":
+            plan = self._tier_plan(prompt)
+            if plan is not None:
+                if plan["transfer"]:
+                    out = self._tiered_generate(
+                        plan, payload, prompt, t0, deadline, ctx, spans,
+                        meta, tenant=tenant, session=session,
+                    )
+                    if out is not None:
+                        return out
+                    # A failed transfer falls back FULLY homogeneous — no
+                    # exclusion. Keeping long prompts off the prefill tier
+                    # here would concentrate every long prefill on the
+                    # decode tier (the exact interference tiering exists
+                    # to prevent) whenever the export path is down.
+                else:
+                    tier_exclude = frozenset(r.rid for r in plan["prefill"])
         for attempt in range(self.max_attempts):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -397,11 +505,14 @@ class FleetRouter:
                 meta["outcome"] = "shed"
                 return 504, {"error": "deadline exceeded", "attempts": attempt,
                              "last_error": last_error}, {}
-            rep = self.registry.acquire(self.balancer, prompt=prompt, exclude=excluded)
-            if rep is None and excluded:
-                # Every routable replica has failed once this request:
-                # reset exclusions rather than give up with replicas alive.
+            rep = self.registry.acquire(self.balancer, prompt=prompt,
+                                        exclude=excluded | tier_exclude)
+            if rep is None and (excluded or tier_exclude):
+                # Every routable replica has failed once this request (or
+                # the tier hint excluded them all): reset exclusions rather
+                # than give up with replicas alive.
                 excluded.clear()
+                tier_exclude = frozenset()
                 rep = self.registry.acquire(self.balancer, prompt=prompt, exclude=excluded)
             if rep is None:
                 self._shed.labels(reason="no_replica").inc()
@@ -437,6 +548,133 @@ class FleetRouter:
                      "attempts": self.max_attempts,
                      "last_error": last_error}, {}
 
+    # -- tiered serving (prefill/decode disaggregation) ----------------------
+
+    def _tier_plan(self, prompt: str) -> dict | None:
+        """Classify one request against the live tier assignment. Returns
+        None when the fleet cannot be tiered right now (either tier empty
+        → fully homogeneous routing), else ``{"prefill", "decode",
+        "transfer", "key", "export_q"}``: long prompts transfer under the
+        full-prompt key; short prompts transfer only once their prefix key
+        is HOT (``prefix_hot_after`` sightings), exporting just the prefix."""
+        tiers = self.tiers.assign(self.registry.replicas())
+        pre, dec = tiers["prefill"], tiers["decode"]
+        if not pre or not dec:
+            return None
+        plan = {"prefill": pre, "decode": dec}
+        if len(prompt) >= self.prefill_threshold_chars:
+            plan.update(transfer=True, key=prompt, export_q=prompt)
+            return plan
+        key = prompt[: self.prefix_chars]
+        hot = self._note_prefix(key)
+        plan.update(transfer=hot, key=key, export_q=key)
+        return plan
+
+    def _tiered_generate(self, plan, payload, prompt, t0, deadline, ctx,
+                         spans, meta, tenant=None, session=None):
+        """The transfer path: export the prompt (or its hot prefix) from a
+        prefill-tier replica — rendezvous-chosen by prefix key, the same
+        keying as ``prefix_affinity``, so repeats land on the replica whose
+        export cache is warm — then import the payload into the
+        least-loaded decode-tier replica, which answers the request with
+        no prefill recompute. Returns the final ``(status, body, headers)``
+        or None, and None ALWAYS means "route homogeneously": a transfer
+        failure is never a client-visible error."""
+        key = plan["key"]
+        cached = self._kv_cache_get(key)
+        from_cache = cached is not None
+        if cached is None:
+            owner = max(
+                plan["prefill"],
+                key=lambda r: PrefixAffinityBalancer._score(
+                    key[: self.prefix_chars], r.rid),
+            )
+            rep = self.registry.acquire(_PinnedBalancer(owner.rid),
+                                        prompt=prompt)
+            if rep is None:
+                self._tiered_requests.labels(outcome="fallback_no_replica").inc()
+                return None
+            out = self._attempt_one(
+                rep, {"question": plan["export_q"]}, KV_EXPORT_PATH,
+                deadline, ctx.child(), spans, tenant=tenant, session=session,
+                record_latency=False,
+            )
+            if (out[0] != "ok" or out[2] != 200
+                    or not isinstance(out[3], dict) or not out[3].get("kv")):
+                self._tiered_requests.labels(outcome="fallback_export").inc()
+                return None
+            body = out[3]
+            nbytes = int(body.get("bytes") or 0)
+            self._kv_bytes.labels(direction="export").inc(nbytes)
+            cached = {"kv": body["kv"], "bytes": nbytes,
+                      "tokens": body.get("tokens")}
+            self._kv_cache_put(key, cached)
+        dest = min(plan["decode"], key=lambda r: (r.outstanding, r.rid))
+        rep = self.registry.acquire(_PinnedBalancer(dest.rid), prompt=prompt)
+        if rep is None:
+            self._tiered_requests.labels(outcome="fallback_no_replica").inc()
+            return None
+        body = {"question": prompt, "kv": cached["kv"]}
+        if isinstance(payload, dict) and payload.get("max_new") is not None:
+            body["max_new"] = payload["max_new"]
+        out = self._attempt_one(
+            rep, body, KV_IMPORT_PATH, deadline, ctx.child(), spans,
+            tenant=tenant, session=session, record_latency=False,
+        )
+        if out[0] != "ok" or out[2] != 200:
+            self._tiered_requests.labels(outcome="fallback_import").inc()
+            return None
+        _, rid, _status, answer, span = out
+        span["won"] = True
+        self._routed.labels(replica=rid).inc()
+        self._latency.observe(time.monotonic() - t0)
+        self._kv_bytes.labels(direction="import").inc(int(cached["bytes"]))
+        meta["outcome"] = "ok"
+        # ONE outcome per request (the family's fates are disjoint, so
+        # fallback ratios computed over it stay honest): "cache_hit" =
+        # answered via the shared prefix cache, "tiered" = paid the
+        # export hop.
+        self._tiered_requests.labels(
+            outcome="cache_hit" if from_cache else "tiered").inc()
+        attempts = sum(1 for s in spans if s.get("name") == "attempt")
+        return 200, answer, {
+            "X-Edgemesh-Replica": rid,
+            "X-Edgemesh-Attempts": str(attempts),
+            "X-Edgemesh-Tiered": "1",
+        }
+
+    def _note_prefix(self, key: str) -> bool:
+        """Bump the prefix key's sighting count (bounded LRU — an idle key
+        eventually evicts, which is the decay) and report hotness."""
+        with self._kv_lock:
+            n = self._prefix_seen.get(key, 0) + 1
+            self._prefix_seen[key] = n
+            self._prefix_seen.move_to_end(key)
+            while len(self._prefix_seen) > 4096:
+                self._prefix_seen.popitem(last=False)
+            return n >= self.prefix_hot_after
+
+    def _kv_cache_get(self, key: str) -> dict | None:
+        with self._kv_lock:
+            hit = self._kv_cache.get(key)
+            if hit is not None:
+                self._kv_cache.move_to_end(key)
+            return hit
+
+    def _kv_cache_put(self, key: str, entry: dict) -> None:
+        with self._kv_lock:
+            self._kv_cache[key] = entry
+            self._kv_cache.move_to_end(key)
+            while len(self._kv_cache) > self.kv_cache_entries:
+                self._kv_cache.popitem(last=False)
+
+    def note_digest(self, rid: str, load: dict) -> None:
+        """Health-prober digest hook (fleet/health.py ``on_digest``): fresh
+        phase telemetry invalidates the tier manager's cached assignment so
+        membership reacts on the probe cadence, not the cache TTL."""
+        if self.tiers is not None:
+            self.tiers.invalidate()
+
     def _backoff(self, attempt: int, deadline: float) -> float:
         delay = min(self.backoff_cap_s, self.backoff_base_s * (2 ** attempt))
         delay *= 1.0 + self.backoff_jitter * self._rng.random()
@@ -446,7 +684,8 @@ class FleetRouter:
 
     def _attempt_one(self, rep, payload, path, deadline, ctx, spans,
                      hedge: bool = False, tenant: str | None = None,
-                     session: str | None = None):
+                     session: str | None = None,
+                     record_latency: bool = True):
         """One checked-out attempt → ("ok", rid, status, body) for any
         answered status < 500, else ("fail", rid, reason, detail).
 
@@ -457,6 +696,7 @@ class FleetRouter:
         and mutated in place as the outcome lands."""
         span = {
             "name": "attempt", "span_id": ctx.span_id, "replica": rep.rid,
+            "path": path,  # /generate vs the KV transfer hops
             "hedge": hedge, "outcome": "pending", "status": None,
             "won": False,  # set by _route on the attempt whose answer the
             "t0": time.time(), "t1": None,  # client actually received — an
@@ -503,10 +743,14 @@ class FleetRouter:
             close(f"status_{status}", status)
             return ("fail", rep.rid, f"status_{status}", str(body.get("error", body))[:200])
         self.registry.release(rep.rid, ok=True)
-        lat = time.monotonic() - t0
-        with self._lat_lock:
-            self._lat_window.append(lat)
-        self._hedge_estimator.observe(lat)
+        if record_latency:
+            # KV transfer hops opt out: an export's prefill wall time is
+            # not a /generate latency, and feeding it to the hedge
+            # estimator would inflate every auto-tuned hedge delay.
+            lat = time.monotonic() - t0
+            with self._lat_lock:
+                self._lat_window.append(lat)
+            self._hedge_estimator.observe(lat)
         close("ok", status)
         return ("ok", rep.rid, status, body, span)
 
@@ -539,6 +783,12 @@ class FleetRouter:
         as a sibling of the attempt it raced."""
         meta = meta if meta is not None else {"outcome": "shed"}
         hedge_delay = self._hedge_delay()
+        # KV transfers are non-idempotent fleet-side (a hedged import
+        # double-admits the request, a hedged export doubles a prefill):
+        # they NEVER hedge, regardless of configuration. Their tail story
+        # is the tiered path's homogeneous fallback instead.
+        if path in NON_HEDGEABLE_PATHS:
+            hedge_delay = None
         if hedge_delay is None or hedge_delay >= (deadline - time.monotonic()):
             out = self._attempt_one(rep, payload, path, deadline,
                                     ctx.child(), spans, tenant=tenant,
@@ -782,10 +1032,30 @@ class FleetRouter:
                 }
                 for t, cell in sorted(self._tenant_stats.items())
             }
+        tiers = None
+        if self.tiers is not None:
+            t = self.tiers.assign(self.registry.replicas())
+            with self._kv_lock:
+                cache_len = len(self._kv_cache)
+                hot_len = len(self._prefix_seen)
+            tiers = {
+                # The live, digest-EWMA-driven membership — what an
+                # operator watches move as the workload mix shifts.
+                "prefill": [r.rid for r in t["prefill"]],
+                "decode": [r.rid for r in t["decode"]],
+                "prefill_threshold_chars": self.prefill_threshold_chars,
+                "prefix_chars": self.prefix_chars,
+                "kv_cache": {"entries": cache_len,
+                             "capacity": self.kv_cache_entries,
+                             "hot_keys": hot_len},
+            }
         return {
             "balancer": getattr(self.balancer, "name", type(self.balancer).__name__),
             "max_inflight": self.max_inflight,
             "max_attempts": self.max_attempts,
+            # Tiered serving: null when disabled, else live membership +
+            # shared-prefix-cache occupancy (docs/FLEET.md).
+            "tiers": tiers,
             # Multi-tenant surfaces: live admission state (queues, policy
             # table, rate-limit hits) + per-tenant request accounting with
             # the router-observed goodput ratio.

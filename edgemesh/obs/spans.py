@@ -123,8 +123,15 @@ class SpanTracker:
         # threads — the lock keeps a digest read from pairing a new queue
         # EWMA with a half-updated prefill one.
         self._ewma_lock = threading.Lock()
+        # prefill_tokens/decode_tokens split the digest by PHASE VOLUME:
+        # the prefill tokens each admission actually computed (an imported
+        # KV handle contributes only its suffix — remote prefixes must not
+        # inflate a decode replica's prefill share) and the decode tokens
+        # each retirement generated. The fleet's TierManager scores
+        # replicas by this split (docs/FLEET.md "Tiered serving").
         self._ewma: dict[str, float | None] = {
             "queue": None, "prefill": None, "decode": None, "service": None,
+            "prefill_tokens": None, "decode_tokens": None,
         }
         # Span-I/O sampling for locally-originated requests (requests that
         # arrive with a trace context inherit ITS sampled bit instead, so
@@ -228,7 +235,13 @@ class SpanTracker:
         trace.attrs.update(attrs)
         self._queue_wait.observe(t_adm - trace.t_submit)
         self._prefill.observe(now - t_adm)
-        self._ewma_update(queue=t_adm - trace.t_submit, prefill=now - t_adm)
+        # Computed prefill volume: the ragged path reports the tokens that
+        # actually rode the boundary launch (``prefill_tokens`` — a warm or
+        # imported admission's suffix), falling back to the full prompt.
+        computed = attrs.get("prefill_tokens", attrs.get("prompt_tokens"))
+        extra = {} if computed is None else {"prefill_tokens": float(computed)}
+        self._ewma_update(queue=t_adm - trace.t_submit, prefill=now - t_adm,
+                          **extra)
 
     def segment_dispatched(self) -> None:
         self._segments.inc()
@@ -263,7 +276,8 @@ class SpanTracker:
             itl = (now - trace.t_first_token) / (trace.generated - 1)
             self._itl.observe(itl, count=trace.generated - 1)
         self._latency.observe(now - trace.t_submit)
-        self._ewma_update(service=now - trace.t_submit)
+        self._ewma_update(service=now - trace.t_submit,
+                          decode_tokens=float(trace.generated))
         # SLO verdict: TTFT and TPOT (mean inter-token) against the target.
         ttft = (
             None if trace.t_first_token is None
@@ -340,6 +354,11 @@ class SpanTracker:
             "ewma_prefill_s": rnd["prefill"],
             "ewma_decode_s": rnd["decode"],
             "ewma_service_s": rnd["service"],
+            # Phase-volume split (tokens, not seconds): what the fleet's
+            # tier manager scores replicas by. None until first observed —
+            # pre-split consumers ignore the extra keys by construction.
+            "ewma_prefill_tokens": rnd["prefill_tokens"],
+            "ewma_decode_tokens": rnd["decode_tokens"],
             "slo_goodput_ratio": None if ratio is None else round(ratio, 4),
         }
 

@@ -29,11 +29,16 @@ lets one preallocated HBM pool serve many variable-length sequences.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+import struct
+from functools import partial
+from typing import NamedTuple, Sequence
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from edgemesh.models.transformer import ModelConfig
+from edgemesh.utils.bucketing import POW2_FLOOR, bucket_pow2
 
 
 class PagedKVCache(NamedTuple):
@@ -254,6 +259,246 @@ def write_tokens_quant(
         k_scales.at[pp, :, 0, ss].set(k_s.reshape(b * s, kh).astype(k_scales.dtype)),
         v_scales.at[pp, :, 0, ss].set(v_s.reshape(b * s, kh).astype(v_scales.dtype)),
     )
+
+
+# -- cross-replica KV wire format --------------------------------------------
+#
+# A request's committed pages serialized for transfer between replicas — the
+# seam prefill/decode disaggregation and the fleet's shared prefix cache ride
+# (docs/FLEET.md "Tiered serving and KV streaming"). One opaque blob:
+#
+#   header  | ids (int32 × tokens) | k pages | v pages [| k_scale | v_scale]
+#
+# The fixed little-endian header pins the pool geometry (layers, kv heads,
+# page size, head dim) and precision kind, so an importer can refuse a
+# payload from a mismatched model BEFORE touching the device, and a version
+# bump never silently misparses old payloads. ``ids`` are the token ids whose
+# KV the pages hold: the importer matches them against ITS OWN tokenization
+# of the request (runtime/prefix_cache.common_token_prefix) and uses only the
+# matched prefix — a payload can never graft wrong-token KV onto a prompt,
+# tokenizer drift just shortens the match. Page payloads are page-major
+# [L, n, kh, ps, hd] exactly as pooled, so import is one scatter per array.
+
+KV_WIRE_MAGIC = b"EMKV"
+KV_WIRE_VERSION = 1
+_WIRE_HEADER = struct.Struct("<4sHBBHHHHII")
+#: ``kind`` byte: the pool's element precision. int8 implies the payload
+#: also carries the per-token scale planes; the float kinds cover every
+#: activation_dtype the unquantized pool is built with.
+_KIND_BF16 = 0
+_KIND_INT8 = 1
+_KIND_F32 = 2
+_KIND_F16 = 3
+_KIND_BY_DTYPE = {
+    "bfloat16": _KIND_BF16, "int8": _KIND_INT8,
+    "float32": _KIND_F32, "float16": _KIND_F16,
+}
+
+
+class KVWireError(ValueError):
+    """A KV transfer payload that cannot be imported: corrupt, truncated,
+    version-mismatched, or from an incompatible pool geometry. Gateways map
+    this to a structured 400 (client/peer input, never a 500)."""
+
+
+class KVWirePayload(NamedTuple):
+    """Decoded transfer payload: header fields + host-side page arrays."""
+
+    kind: int  # _KIND_BF16 | _KIND_INT8
+    layers: int
+    kv_heads: int
+    page_size: int
+    head_dim: int
+    n_pages: int
+    tokens: int  # committed token count the pages hold
+    ids: np.ndarray  # [tokens] int32 — the tokens' ids
+    k: np.ndarray  # [L, n_pages, kh, ps, hd]
+    v: np.ndarray
+    k_scale: np.ndarray | None  # int8 pools: [L, n_pages, kh, 1, ps] f32
+    v_scale: np.ndarray | None
+
+
+def _pool_kind(cache) -> int:
+    name = jnp.dtype(cache.k.dtype).name
+    try:
+        return _KIND_BY_DTYPE[name]
+    except KeyError:
+        raise KVWireError(f"pool dtype {name!r} has no wire encoding") from None
+
+
+def _wire_np_dtype(kind: int):
+    if kind == _KIND_INT8:
+        return np.int8
+    if kind == _KIND_F32:
+        return np.float32
+    if kind == _KIND_F16:
+        return np.float16
+    import ml_dtypes
+
+    return ml_dtypes.bfloat16
+
+
+def export_pages(cache, pages: Sequence[int], tokens: int, ids) -> bytes:
+    """Serialize ``tokens`` committed tokens living in physical ``pages`` of
+    ``cache`` (in logical order) into the wire format. ``ids`` are those
+    tokens' ids (length == tokens). Zero-token exports are legal (header +
+    empty payload). The page gather pads onto the pow2 ladder (trash page)
+    so export shapes key a bounded compile set."""
+    ids = np.asarray(ids, np.int32).reshape(-1)
+    tokens = int(tokens)
+    pages = [int(p) for p in pages]
+    if tokens < 0 or ids.size != tokens:
+        raise ValueError(
+            f"export_pages: ids carries {ids.size} tokens, header says {tokens}"
+        )
+    ps = cache.page_size
+    if tokens > len(pages) * ps:
+        raise ValueError(
+            f"export_pages: {tokens} tokens do not fit {len(pages)} pages "
+            f"of {ps}"
+        )
+    kind = _pool_kind(cache)
+    L, _, kh, _, hd = cache.k.shape
+    header = _WIRE_HEADER.pack(
+        KV_WIRE_MAGIC, KV_WIRE_VERSION, kind, 0, L, kh, ps, hd,
+        len(pages), tokens,
+    )
+    parts = [header, ids.tobytes()]
+    if pages:
+        n = len(pages)
+        padded = bucket_pow2(n, floor=POW2_FLOOR)
+        idx = np.zeros((padded,), np.int32)  # pad with the trash page
+        idx[:n] = pages
+        jidx = jnp.asarray(idx)
+        arrays = [cache.k, cache.v]
+        if kind == _KIND_INT8:
+            arrays += [cache.k_scale, cache.v_scale]
+        for arr in arrays:
+            parts.append(np.asarray(arr[:, jidx])[:, :n].tobytes())
+    return b"".join(parts)
+
+
+def decode_wire(buf: bytes) -> KVWirePayload:
+    """Parse + validate one transfer payload. Raises :class:`KVWireError`
+    on anything malformed — magic, version, kind, or a byte count that
+    disagrees with the header's geometry (truncation/corruption)."""
+    if len(buf) < _WIRE_HEADER.size:
+        raise KVWireError(
+            f"payload too short for the wire header "
+            f"({len(buf)} < {_WIRE_HEADER.size} bytes)"
+        )
+    magic, version, kind, _, L, kh, ps, hd, n_pages, tokens = (
+        _WIRE_HEADER.unpack_from(buf)
+    )
+    if magic != KV_WIRE_MAGIC:
+        raise KVWireError(f"bad magic {magic!r} (want {KV_WIRE_MAGIC!r})")
+    if version != KV_WIRE_VERSION:
+        raise KVWireError(
+            f"wire version {version} unsupported (this build speaks "
+            f"{KV_WIRE_VERSION})"
+        )
+    if kind not in _KIND_BY_DTYPE.values():
+        raise KVWireError(f"unknown pool kind {kind}")
+    if tokens > n_pages * ps:
+        raise KVWireError(
+            f"header claims {tokens} tokens in {n_pages} pages of {ps}"
+        )
+    off = _WIRE_HEADER.size
+    ids_bytes = tokens * 4
+    page_elems = L * n_pages * kh * ps * hd
+    dtype = _wire_np_dtype(kind)
+    page_bytes = page_elems * np.dtype(dtype).itemsize
+    scale_elems = L * n_pages * kh * ps
+    scale_bytes = scale_elems * 4 if kind == _KIND_INT8 else 0
+    want = off + ids_bytes + 2 * page_bytes + 2 * scale_bytes
+    if len(buf) != want:
+        raise KVWireError(
+            f"payload is {len(buf)} bytes, header geometry needs {want} "
+            "(truncated or corrupt)"
+        )
+    ids = np.frombuffer(buf, np.int32, count=tokens, offset=off)
+    off += ids_bytes
+    shape = (L, n_pages, kh, ps, hd)
+    k = np.frombuffer(buf, dtype, count=page_elems, offset=off).reshape(shape)
+    off += page_bytes
+    v = np.frombuffer(buf, dtype, count=page_elems, offset=off).reshape(shape)
+    off += page_bytes
+    k_scale = v_scale = None
+    if kind == _KIND_INT8:
+        sshape = (L, n_pages, kh, 1, ps)
+        k_scale = np.frombuffer(
+            buf, np.float32, count=scale_elems, offset=off).reshape(sshape)
+        off += scale_bytes
+        v_scale = np.frombuffer(
+            buf, np.float32, count=scale_elems, offset=off).reshape(sshape)
+    return KVWirePayload(
+        kind=kind, layers=L, kv_heads=kh, page_size=ps, head_dim=hd,
+        n_pages=n_pages, tokens=tokens, ids=ids, k=k, v=v,
+        k_scale=k_scale, v_scale=v_scale,
+    )
+
+
+def check_wire_compat(payload: KVWirePayload, cache) -> None:
+    """Raise :class:`KVWireError` unless ``payload`` matches the destination
+    pool's geometry and precision — the import-side gate that turns a
+    cross-model transfer into a structured refusal instead of silent KV
+    corruption."""
+    kind = _pool_kind(cache)
+    L, _, kh, ps, hd = cache.k.shape
+    mine = (kind, L, kh, ps, hd)
+    theirs = (payload.kind, payload.layers, payload.kv_heads,
+              payload.page_size, payload.head_dim)
+    if mine != theirs:
+        names = ("kind", "layers", "kv_heads", "page_size", "head_dim")
+        diffs = ", ".join(
+            f"{n}={t} (pool has {m})"
+            for n, t, m in zip(names, theirs, mine) if t != m
+        )
+        raise KVWireError(f"payload geometry mismatch: {diffs}")
+
+
+# Donated in-place page scatter: import must not copy the multi-GB pool per
+# transfer. Shapes bucket on the pow2 ladder (callers pad with the trash
+# page, whose writes are harmless by design), so compile variants stay
+# O(log pages).
+@partial(jax.jit, donate_argnums=(0,))
+def _splice_pages_arr(pages, phys, data):
+    return pages.at[:, phys].set(data.astype(pages.dtype))
+
+
+def splice_imported(cache, payload: KVWirePayload, phys: Sequence[int]):
+    """Write the first ``len(phys)`` payload pages into physical pages
+    ``phys`` of ``cache`` (donated, in place) and return the updated cache.
+    Callers import fewer pages than the payload carries when their token
+    match ends early — the tail pages simply stay on the free list."""
+    check_wire_compat(payload, cache)
+    n = len(phys)
+    if n == 0:
+        return cache
+    if n > payload.n_pages:
+        raise KVWireError(
+            f"import wants {n} pages, payload carries {payload.n_pages}"
+        )
+    padded = bucket_pow2(n, floor=POW2_FLOOR)
+    idx = np.zeros((padded,), np.int32)  # pad with the trash page
+    idx[:n] = [int(p) for p in phys]
+    jidx = jnp.asarray(idx)
+
+    def pad(arr):
+        out = np.zeros((arr.shape[0], padded) + arr.shape[2:], arr.dtype)
+        out[:, :n] = arr[:, :n]
+        return jnp.asarray(out)
+
+    upd = dict(
+        k=_splice_pages_arr(cache.k, jidx, pad(payload.k)),
+        v=_splice_pages_arr(cache.v, jidx, pad(payload.v)),
+    )
+    if payload.kind == _KIND_INT8:
+        upd["k_scale"] = _splice_pages_arr(
+            cache.k_scale, jidx, pad(payload.k_scale))
+        upd["v_scale"] = _splice_pages_arr(
+            cache.v_scale, jidx, pad(payload.v_scale))
+    return cache._replace(**upd)
 
 
 def gather_dense(

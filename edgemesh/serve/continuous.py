@@ -62,7 +62,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, NamedTuple
@@ -90,7 +90,15 @@ from edgemesh.runtime.paged_generate import (
     forward_prefill_paged_at,
     forward_ragged_paged,
 )
-from edgemesh.runtime.paged_kv import init_paged_cache, init_quant_paged_cache
+from edgemesh.runtime.paged_kv import (
+    KVWireError,
+    check_wire_compat,
+    decode_wire,
+    export_pages,
+    init_paged_cache,
+    init_quant_paged_cache,
+    splice_imported,
+)
 from edgemesh.utils.bucketing import POW2_FLOOR, bucket_pow2
 
 log = logging.getLogger("edgemesh.serve")
@@ -144,7 +152,17 @@ class _StagedAdmission(NamedTuple):
     trace: Any  # obs.RequestTrace
     plen: int  # full prompt tokens
     ids: Any  # np.ndarray — the token ids to prefill (suffix when warm)
-    match: int  # shared-template tokens already in the row's pages
+    match: int  # tokens already in the row's pages (template or imported)
+    imported: int = 0  # of those, tokens spliced from a remote KV payload
+
+
+class _ExportJob(NamedTuple):
+    """One queued ``/kv/export`` request: prefill the prompt's prefix into
+    scratch pages and serialize it (serve/rest.py → submit_export)."""
+
+    question: str
+    fut: Future
+    trace: Any  # obs.RequestTrace
 
 
 def _make_bridge(decode_fn):
@@ -367,7 +385,15 @@ class ContinuousEngine:
         if self._paged and int(page_size) < 1:
             raise ValueError("page_size must be >= 1")
         self.kv_backend = kv_backend
-        self._queue: deque[tuple[str, Future, RequestTrace, int | None]] = deque()
+        # Cross-replica KV transfer (docs/FLEET.md "Tiered serving and KV
+        # streaming"): paged pools can export a prompt's committed pages
+        # over the wire and admit a request whose prefill ran elsewhere.
+        # The dense slabs have no page table to splice into; the spec
+        # engine opts out (its draft pool has no remote twin).
+        self.supports_kv_transfer = self._paged
+        self._queue: deque[
+            tuple[str, Future, RequestTrace, int | None, bytes | None]
+        ] = deque()
         self._cond = threading.Condition()
         self._closed = False
         # Slot table and device cache are OWNED by the engine worker thread
@@ -436,6 +462,17 @@ class ContinuousEngine:
             self.ragged_boundaries = 0
             self.ragged_prefill_tokens = 0
             self.ragged_decode_tokens = 0
+            # KV transfer state (worker-owned except the counters stats()
+            # reads under the lock): queued export jobs, and a bounded LRU
+            # of recent export payloads keyed by question — a hot shared
+            # prefix prefills ONCE per replica no matter how many peers
+            # fetch it (the replica half of the fleet's prefix cache).
+            self._exports: deque[_ExportJob] = deque()  # guarded by: _cond
+            self._export_cache: OrderedDict[str, dict] = OrderedDict()  # not shared
+            self._export_cache_max = 16
+            self.kv_exports = 0
+            self.kv_imports = 0
+            self.kv_imported_tokens = 0
         # fp32, NOT activation dtype: sampling must see the same logits the
         # solo decode path sees, or bf16 rounding flips near-tied greedy
         # tokens versus agent.answer.
@@ -473,6 +510,19 @@ class ContinuousEngine:
             "Tokens through the shared ragged boundary launch, by phase",
             ("engine", "phase"),
         )
+        # KV transfer accounting (paged backends): wire bytes by direction,
+        # and admissions that consumed a remotely-computed prefix instead
+        # of recomputing it (docs/OBSERVABILITY.md metric catalog).
+        self._kv_transfer_counter = self.obs.registry.counter(
+            "edgemesh_kv_transfer_bytes_total",
+            "KV wire bytes moved by this engine, by direction",
+            ("engine", "direction"),
+        )
+        self._remote_prefix_counter = self.obs.registry.counter(
+            "edgemesh_prefix_remote_hits_total",
+            "Admissions warm-started from a remotely-computed KV payload",
+            ("engine",),
+        ).labels(engine=self.obs_engine_label)
         # Collective wire accounting (tp serving only): analytic per-step
         # byte counts from the tp engine (shapes are static, so the counts
         # are exact for what the joins ship — parallel/collectives.py),
@@ -508,7 +558,8 @@ class ContinuousEngine:
     def submit(self, question: str, max_new: int | None = None,
                trace_ctx: TraceContext | None = None,
                tenant: str | None = None,
-               session: str | None = None) -> Future:
+               session: str | None = None,
+               kv_import: bytes | None = None) -> Future:
         """Enqueue one request. ``max_new`` caps THIS request's token budget
         below the engine-wide ``sampling.max_new_tokens`` (budgets are
         per-slot host state, so a per-request cap costs nothing); the
@@ -521,11 +572,21 @@ class ContinuousEngine:
         (obs/slo.py), never the scheduling — fairness between tenants is
         the ROUTER's admission job, not the engine's. ``session`` is the
         raw ``X-Edgemesh-Session`` identity: span-record only, so
-        ``edgemesh obs replay`` can rebuild recorded session grouping."""
+        ``edgemesh obs replay`` can rebuild recorded session grouping.
+        ``kv_import`` is a serialized KV transfer payload (runtime/
+        paged_kv.py wire format): the request's prompt prefix was
+        prefilled on ANOTHER replica and admission splices the shipped
+        pages instead of recomputing them — the decode half of
+        prefill/decode disaggregation (paged backends only)."""
         if max_new is not None:
             max_new = int(max_new)
             if max_new < 1:
                 raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if kv_import is not None and not self.supports_kv_transfer:
+            raise ValueError(
+                "kv_import needs a paged continuous engine "
+                f"(kv_backend={self.kv_backend!r})"
+            )
         fut: Future = Future()
         with self._cond:
             if self._closed:
@@ -533,7 +594,7 @@ class ContinuousEngine:
             trace = self.obs.submit(self.requests, trace_ctx,
                                     tenant=tenant,  # rid = arrival index
                                     session=session)
-            self._queue.append((question, fut, trace, max_new))
+            self._queue.append((question, fut, trace, max_new, kv_import))
             self.requests += 1
             depth = len(self._queue)
             self._cond.notify()
@@ -548,9 +609,53 @@ class ContinuousEngine:
     def answer(self, question: str, max_new: int | None = None,
                trace_ctx: TraceContext | None = None,
                tenant: str | None = None,
-               session: str | None = None) -> dict[str, Any]:
+               session: str | None = None,
+               kv_import: bytes | None = None) -> dict[str, Any]:
         return self.submit(question, max_new=max_new, trace_ctx=trace_ctx,
-                           tenant=tenant, session=session).result()
+                           tenant=tenant, session=session,
+                           kv_import=kv_import).result()
+
+    def submit_export(self, question: str,
+                      trace_ctx: TraceContext | None = None,
+                      tenant: str | None = None,
+                      session: str | None = None) -> Future:
+        """Enqueue one KV export: prefill ``question``'s prompt prefix
+        (all but its last token — the importer's boundary launch needs at
+        least one suffix token to seed logits) into scratch pool pages and
+        resolve the future with ``{"kv_bytes", "tokens", "prompt_tokens",
+        "cached"}``. Served from the bounded per-question export cache
+        when warm — a hot prefix prefills once per replica. The prefill
+        itself runs on the engine worker between segments, so a prefill-
+        tier replica batches exports against its own decode cadence."""
+        if not self.supports_kv_transfer:
+            raise ValueError(
+                "KV export needs a paged continuous engine "
+                f"(kv_backend={self.kv_backend!r})"
+            )
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            trace = self.obs.submit(self.requests, trace_ctx,
+                                    tenant=tenant, session=session)
+            self._exports.append(_ExportJob(question, fut, trace))
+            self.requests += 1
+            self._cond.notify()
+        return fut
+
+    def check_kv_payload(self, buf: bytes) -> dict[str, int]:
+        """Cheap header-only validation for the gateway: parse + geometry
+        check against this engine's pool, no device work. Raises
+        :class:`~edgemesh.runtime.paged_kv.KVWireError` on anything the
+        import admission would refuse — the gateway turns that into a
+        structured 400 before the request ever queues."""
+        if not self.supports_kv_transfer:
+            raise KVWireError(
+                f"kv_backend={self.kv_backend!r} cannot import KV payloads"
+            )
+        payload = decode_wire(buf)
+        check_wire_compat(payload, self._cache)
+        return {"tokens": payload.tokens, "n_pages": payload.n_pages}
 
     def close(self) -> None:
         with self._cond:
@@ -585,6 +690,9 @@ class ContinuousEngine:
                 out["free_pages"] = len(self._free_pages)
                 out["template_pages"] = len(self._template_pages)
                 out["shared_prefix_hits"] = self.shared_prefix_hits
+                out["kv_exports"] = self.kv_exports
+                out["kv_imports"] = self.kv_imports
+                out["kv_imported_tokens"] = self.kv_imported_tokens
                 out["ragged"] = self._ragged
                 if self._ragged:
                     out["ragged_boundaries"] = self.ragged_boundaries
@@ -704,11 +812,17 @@ class ContinuousEngine:
         return match, need
 
     def _admit(self, idx: int, question: str, fut: Future, trace,
-               mid_flight: bool, max_new: int | None = None) -> bool:
+               mid_flight: bool, max_new: int | None = None,
+               kv: bytes | None = None) -> bool:
         """Prefill one request and splice its state into slot ``idx``.
 
         Returns False when a paged backend lacks free pages for the request's
-        worst case (the caller re-queues it — capacity, not failure)."""
+        worst case (the caller re-queues it — capacity, not failure).
+        ``kv`` is a serialized remote-prefill payload: admission splices the
+        shipped pages and prefills only the unmatched suffix."""
+        if kv is not None:
+            return self._admit_import(idx, question, fut, trace, mid_flight,
+                                      max_new=max_new, kv=kv)
         if self._paged and self._ragged:
             return self._stage_admission(idx, question, fut, trace,
                                          mid_flight, max_new=max_new)
@@ -924,6 +1038,240 @@ class ContinuousEngine:
                 self.admitted_mid_flight += 1
         return True
 
+    def _admit_import(self, idx: int, question: str, fut: Future, trace,
+                      mid_flight: bool, max_new: int | None = None,
+                      kv: bytes | None = None) -> bool:
+        """Admission from a remote-prefill KV payload: splice the shipped
+        pages into this pool and enter the decode loop with only the
+        unmatched suffix left to prefill — the decode half of
+        prefill/decode disaggregation, and the consumer side of the
+        fleet's cross-replica prefix cache.
+
+        The payload's token ids are matched against OUR tokenization of the
+        prompt (runtime/prefix_cache.common_token_prefix), so a stale or
+        partial payload degrades to a shorter match, never to wrong KV;
+        the match is capped at plen-1 so at least one suffix token prefills
+        (the boundary/suffix launch needs it to seed the row's logits).
+        All imported pages are the request's PRIVATE pages — no COW, no
+        template bookkeeping — and retire back to the free list normally.
+        Returns False on page-pool capacity, like every admission path."""
+        from edgemesh.runtime.prefix_cache import common_token_prefix
+
+        agent = self.agent
+        self.obs.admit_start(trace)
+        payload = decode_wire(kv)
+        check_wire_compat(payload, self._cache)
+        prompt = agent.format_prompt(question)
+        ids = np.asarray(
+            agent.tokenizer.encode(prompt, max_len=agent._max_prompt()),
+            np.int32,
+        )
+        plen = int(ids.size)
+        budget = self._clamp_budget(plen, max_new)
+        match = common_token_prefix(payload.ids, ids)
+        over = 2 * (self.chunk + 1)
+        need = min(
+            -(-(plen + budget + over) // self.page_size),
+            int(self._cache.max_pages),
+        )
+        if need > len(self._free_pages) + self._reserved_pages:
+            raise ValueError(
+                f"request needs {need} pages (prompt {plen} + budget "
+                f"{budget} + segment overshoot); the pool holds "
+                f"{len(self._free_pages) + self._reserved_pages} beyond "
+                "the template"
+            )
+        if need > len(self._free_pages):
+            return False  # capacity — re-queue, admit at a later boundary
+        pages = self._pop_pages(need)
+        n_imp = -(-match // self.page_size) if match else 0
+        try:
+            if n_imp:
+                # The payload's leading pages land in this row's private
+                # pages (donated scatter); positions >= match in the last
+                # page are overwritten by the suffix prefill.
+                self._cache = splice_imported(self._cache, payload,
+                                              pages[:n_imp])
+            row_table = self._build_row_table([], pages)
+            if self._ragged:
+                self._cache = self._cache._replace(
+                    page_table=self._cache.page_table.at[idx].set(
+                        jnp.asarray(row_table)
+                    ),
+                    lengths=self._cache.lengths.at[idx].set(match),
+                )
+        except Exception:
+            # Donated pool buffers may be invalidated — the same
+            # all-or-nothing recovery as every failed admission prefill.
+            self._reset_pool(
+                RuntimeError("page pool reset after a failed KV import")
+            )
+            raise
+        self._kv_transfer_counter.labels(
+            engine=self.obs_engine_label, direction="import").inc(len(kv))
+        if match:
+            self._remote_prefix_counter.inc()
+        with self._cond:  # stats() reads these under the lock
+            self.kv_imports += 1
+            self.kv_imported_tokens += match
+        valid = jnp.ones((1, plen), bool)
+        mask1 = TokenMaskState.init(1, self.cfg.vocab_size).add_sequence(
+            jnp.asarray(ids)[None, :], valid
+        ).mask
+        self._mask = self._mask.at[idx].set(mask1[0])
+        self._finished = self._finished.at[idx].set(False)
+        if self._ragged:
+            self._slots[idx] = _Slot(
+                future=fut, question=question, emitted=[], remaining=budget,
+                t_submit=trace.t_submit, t_start=0.0, trace=trace,
+                pages=pages,
+            )
+            self._gen[idx] += 1
+            self._staged.append(_StagedAdmission(
+                idx, trace, plen, ids[match:], match, imported=match,
+            ))
+        else:
+            # Segmented path: the suffix prefills NOW through the same
+            # donated one-row programs the warm-template path uses. Pad the
+            # suffix onto the pow2 ladder so import admissions key the same
+            # bounded compile set as _prepare_batch prompts.
+            suffix_len = plen - match
+            pad = bucket_pow2(suffix_len, floor=POW2_FLOOR)
+            suffix = np.zeros((1, pad), np.int32)
+            suffix[0, :suffix_len] = ids[match:]
+            try:
+                if match:
+                    row_view = self._cache._replace(
+                        page_table=jnp.asarray(row_table)[None, :],
+                        lengths=jnp.zeros((1,), jnp.int32),
+                    )
+                    logits1, row = _prefill_paged_at_donated(
+                        self.cfg, agent.params, jnp.asarray(suffix),
+                        jnp.asarray([suffix_len], jnp.int32), row_view,
+                        jnp.asarray([match], jnp.int32),
+                    )
+                    self._cache = _splice_row_entries(self._cache, row, idx)
+                else:
+                    logits1, self._cache = _prefill_into_row(
+                        self.cfg, agent.params, jnp.asarray(suffix),
+                        jnp.asarray([plen], jnp.int32), self._cache, idx,
+                        row_table,
+                    )
+            except Exception:
+                self._reset_pool(
+                    RuntimeError("page pool reset after a failed KV import")
+                )
+                raise
+            self._logits = self._logits.at[idx].set(
+                logits1[0].astype(self._logits.dtype))
+            self.obs.admitted(
+                trace, prompt_tokens=plen, prompt_chars=len(question),
+                prefill_tokens=suffix_len, kv_import_tokens=match,
+                shared_prefix_hit=False,
+            )
+            self._slots[idx] = _Slot(
+                future=fut, question=question, emitted=[], remaining=budget,
+                t_submit=trace.t_submit, t_start=trace.t_start, trace=trace,
+                pages=pages,
+            )
+            self._gen[idx] += 1
+        self._update_page_gauges()
+        if mid_flight:
+            with self._cond:  # stats() reads this under the lock
+                self.admitted_mid_flight += 1
+        return True
+
+    def _handle_export(self, job: _ExportJob) -> bool:
+        """Run one queued KV export on the worker: prefill the prompt's
+        first plen-1 tokens into scratch pages (the same donated one-row
+        program admissions use), serialize them, and hand the pages
+        straight back to the free list — the serialized BYTES are the
+        artifact, so an export never holds pool capacity past its own
+        prefill. Returns False on page capacity (the caller re-queues)."""
+        agent = self.agent
+        eng = self.obs_engine_label
+        cached = self._export_cache.get(job.question)
+        if cached is not None:
+            self._export_cache.move_to_end(job.question)
+            self.obs.admit_start(job.trace)
+            self.obs.admitted(
+                job.trace, prompt_tokens=cached["prompt_tokens"],
+                prefill_tokens=0, kv_export=True, kv_export_cache_hit=True,
+            )
+            self.obs.retire(job.trace, status="ok")
+            self._kv_transfer_counter.labels(
+                engine=eng, direction="export").inc(len(cached["kv_bytes"]))
+            with self._cond:  # stats() reads this under the lock
+                self.kv_exports += 1
+            job.fut.set_result({**cached, "cached": True})
+            return True
+        self.obs.admit_start(job.trace)
+        prompt = agent.format_prompt(job.question)
+        ids = np.asarray(
+            agent.tokenizer.encode(prompt, max_len=agent._max_prompt()),
+            np.int32,
+        )
+        plen = int(ids.size)
+        if plen < 2:
+            raise ValueError(
+                f"prompt tokenizes to {plen} tokens; KV export needs >= 2 "
+                "(the importer must keep at least one suffix token)"
+            )
+        n = plen - 1  # the exported committed prefix
+        n_pages = -(-n // self.page_size)
+        if n_pages > int(self._cache.max_pages):
+            raise ValueError(
+                f"export needs {n_pages} table slots, a row has "
+                f"{int(self._cache.max_pages)}"
+            )
+        with self._cond:
+            free_now = len(self._free_pages)
+            reserved = self._reserved_pages
+        if n_pages > free_now:
+            if n_pages > free_now + reserved:
+                raise ValueError(
+                    f"export needs {n_pages} pages; the pool holds "
+                    f"{free_now + reserved} beyond the template"
+                )
+            return False  # capacity — retirements will free pages
+        pages = self._pop_pages(n_pages)
+        try:
+            row_table = self._build_row_table([], pages)
+            row_view = self._cache._replace(
+                page_table=jnp.asarray(row_table)[None, :],
+                lengths=jnp.zeros((1,), jnp.int32),
+            )
+            _, row = _prefill_paged_donated(
+                self.cfg, agent.params, jnp.asarray(ids[:n])[None, :],
+                jnp.asarray([n], jnp.int32), row_view,
+            )
+            self._cache = row._replace(
+                page_table=self._cache.page_table, lengths=self._cache.lengths
+            )
+            buf = export_pages(self._cache, pages, n, ids[:n])
+        except Exception:
+            # The donated pool buffers may be invalidated; the reset also
+            # rebuilds the free list, so the popped pages must NOT be
+            # pushed back (they are already in the fresh list).
+            self._reset_pool(
+                RuntimeError("page pool reset after a failed export prefill")
+            )
+            raise
+        self._push_pages(pages)
+        result = {"kv_bytes": buf, "tokens": n, "prompt_tokens": plen}
+        self._export_cache[job.question] = result
+        while len(self._export_cache) > self._export_cache_max:
+            self._export_cache.popitem(last=False)
+        self._kv_transfer_counter.labels(
+            engine=eng, direction="export").inc(len(buf))
+        with self._cond:  # stats() reads this under the lock
+            self.kv_exports += 1
+        self.obs.admitted(job.trace, prompt_tokens=plen, prefill_tokens=n,
+                          kv_export=True)
+        self.obs.retire(job.trace, status="ok")
+        job.fut.set_result({**result, "cached": False})
+        return True
+
     def _ragged_cap(self, need: int) -> int:
         """Static packed-token capacity for a boundary launch: the
         decode-only boundary (no staged admissions) is exactly ``n_slots``
@@ -1001,7 +1349,12 @@ class ContinuousEngine:
                 r.trace, prompt_tokens=r.plen,
                 prompt_chars=len(self._slots[r.idx].question),
                 prefill_tokens=int(len(r.ids)),
-                shared_prefix_hit=bool(r.match), ragged=True,
+                # A template hit and a remote KV import both park the row
+                # at `match` committed tokens, but the span must say which
+                # mechanism skipped the work (the disagg e2e pins it).
+                shared_prefix_hit=bool(r.match and not r.imported),
+                ragged=True,
+                **({"kv_import_tokens": int(r.imported)} if r.imported else {}),
             )
             self._slots[r.idx].t_start = r.trace.t_start
 
@@ -1296,12 +1649,17 @@ class ContinuousEngine:
             with self._cond:
                 while (
                     not self._queue
+                    and not (self._paged and self._exports)
                     and not any(s.active for s in self._slots)
                     and inflight is None
                 ):
                     if self._closed:
                         return
                     self._cond.wait()
+                exports: list[_ExportJob] = []
+                if self._paged and self._exports:
+                    exports = list(self._exports)
+                    self._exports.clear()
                 free = [i for i, s in enumerate(self._slots) if not s.active]
                 if self.admission == "sjf" and len(self._queue) > 1 and free:
                     # Stable sort: FIFO among equal-cost jobs, so same-size
@@ -1317,12 +1675,35 @@ class ContinuousEngine:
                             len(it[0]),
                         ),
                     ))
-                pending: list[tuple[str, Future, RequestTrace, int | None]] = []
+                pending: list[
+                    tuple[str, Future, RequestTrace, int | None, bytes | None]
+                ] = []
                 while self._queue and len(pending) < len(free):
                     pending.append(self._queue.popleft())
+            # KV exports run between segments on the worker (the only
+            # thread allowed to touch the donated pool): slot-free one-row
+            # prefills whose pages return to the free list immediately.
+            for pos, job in enumerate(exports):
+                try:
+                    done = self._handle_export(job)
+                except Exception as exc:
+                    log.exception("kv export failed for %r",
+                                  job.question[:80])
+                    self.obs.retire(job.trace, status="error")
+                    if not job.fut.done():
+                        job.fut.set_exception(exc)
+                    continue
+                if not done:
+                    # Page capacity: re-queue this and the rest in order;
+                    # they run once retirements reclaim pages (held pages
+                    # imply active rows exist, so the loop cannot spin).
+                    with self._cond:
+                        for j in reversed(exports[pos:]):
+                            self._exports.appendleft(j)
+                    break
             free_now = [i for i, s in enumerate(self._slots) if not s.active]
             mid = any(s.active for s in self._slots) or inflight is not None
-            for pos, ((q, fut, trace, req_max), idx) in enumerate(zip(pending, free_now)):
+            for pos, ((q, fut, trace, req_max, kv), idx) in enumerate(zip(pending, free_now)):
                 try:
                     # Bind the request's trace context around admission so
                     # a prefill-triggered jit compile lands in ITS trace
@@ -1334,7 +1715,7 @@ class ContinuousEngine:
                     )
                     with use_trace(ctx):
                         ok = self._admit(idx, q, fut, trace, mid_flight=mid,
-                                         max_new=req_max)
+                                         max_new=req_max, kv=kv)
                 except Exception as exc:
                     # Fail only THIS request: already-admitted slots keep
                     # their pending futures (poisoning them would make the
@@ -1506,6 +1887,11 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             )
             self._dslot_pages: dict[int, list[int]] = {}
             self._spec_reset_arrays()
+            # No KV transfer: an imported target prefix has no draft-pool
+            # twin, and a warm target + cold draft would desynchronize the
+            # verify positions (same reason spec admissions are always
+            # cold).
+            self.supports_kv_transfer = False
         except Exception:
             self.close()
             raise
@@ -1560,7 +1946,9 @@ class SpeculativeContinuousEngine(ContinuousEngine):
 
     def submit(self, question: str, max_new: int | None = None,
                trace_ctx: TraceContext | None = None,
-               tenant: str | None = None) -> Future:
+               tenant: str | None = None,
+               session: str | None = None,
+               kv_import: bytes | None = None) -> Future:
         if max_new is not None:
             # Fail fast on the caller's thread — the _admit guard below
             # stays as defense in depth, but surfacing an EXPECTED
@@ -1570,10 +1958,21 @@ class SpeculativeContinuousEngine(ContinuousEngine):
                 "the speculative engine keeps one uniform budget per pool; "
                 "per-request max_new is not supported"
             )
-        return super().submit(question, trace_ctx=trace_ctx, tenant=tenant)
+        if kv_import is not None:
+            raise ValueError(
+                "the speculative engine cannot import KV (the draft pool "
+                "has no remote twin; see supports_kv_transfer)"
+            )
+        return super().submit(question, trace_ctx=trace_ctx, tenant=tenant,
+                              session=session)
 
     def _admit(self, idx: int, question: str, fut: Future, trace,
-               mid_flight: bool, max_new: int | None = None) -> bool:
+               mid_flight: bool, max_new: int | None = None,
+               kv: bytes | None = None) -> bool:
+        if kv is not None:
+            raise ValueError(
+                "the speculative engine cannot import KV payloads"
+            )
         if max_new is not None:
             # The spec rounds body runs ONE static max_new for the whole
             # pool (out-buffer capacity, freeze conditions); a per-request

@@ -105,6 +105,46 @@ def read_session_header(handler) -> str | None:
     return raw or None
 
 
+# -- KV transfer (prefill/decode disaggregation) ------------------------------
+#
+# Both sides of a cross-replica KV transfer speak these: the replica gateway
+# serves them (serve/rest.py), the fleet router orchestrates them
+# (fleet/router.py — export from a prefill-tier replica, import into a
+# decode-tier one). The binary wire payload (runtime/paged_kv.py) rides the
+# JSON body base64-encoded so the transfer reuses the one hardened HTTP
+# contract instead of growing a second content type.
+
+KV_EXPORT_PATH = "/kv/export"
+KV_IMPORT_PATH = "/kv/import"
+
+#: Decoded payload size cap: a transfer bigger than this is refused with a
+#: structured 400 before any base64 work lands on the heap. Generous — a
+#: full-context 8B-model prefix is tens of MB — while still bounding what
+#: one request can make the gateway buffer.
+KV_PAYLOAD_MAX_BYTES = 1 << 30
+
+
+def encode_kv_b64(buf: bytes) -> str:
+    import base64
+
+    return base64.b64encode(buf).decode("ascii")
+
+
+def decode_kv_b64(text: str) -> bytes:
+    """Decode a transfer payload; raises ValueError on malformed base64 or
+    an oversized payload — callers answer a structured 400."""
+    import base64
+
+    if not isinstance(text, str):
+        raise ValueError("'kv' must be a base64 string")
+    if len(text) > (KV_PAYLOAD_MAX_BYTES // 3) * 4 + 8:
+        raise ValueError("KV payload exceeds the transfer size cap")
+    try:
+        return base64.b64decode(text.encode("ascii"), validate=True)
+    except Exception as e:
+        raise ValueError(f"malformed base64 KV payload: {e}") from None
+
+
 def read_json_body(handler) -> dict | None:
     """Parse the request body; answers the 400 itself on bad input."""
     try:

@@ -31,6 +31,14 @@ Endpoints:
 - ``POST /incident``  → {"id": ...}: dump the flight-recorder ring under a
   router-propagated incident id (obs/flight.py; the fleet's incident
   fan-out — docs/OBSERVABILITY.md "The flight recorder")
+- ``POST /kv/export`` → {"question": str}: prefill the prompt's prefix and
+  return its committed KV pages serialized (base64 wire payload,
+  runtime/paged_kv.py) — the prefill half of tiered serving
+- ``POST /kv/import`` → {"question", "kv", "max_new"?}: admit a request
+  whose prefill ran on another replica by splicing the shipped pages;
+  answers like ``/generate``. Both need a paged continuous engine; a
+  corrupt/mismatched payload is a structured 400 (docs/FLEET.md "Tiered
+  serving and KV streaming")
 - ``GET  /debug/profile?seconds=N`` → opt-in (``profile_dir=`` /
   ``--profile-dir``) ``jax.profiler`` capture; returns the trace path
 
@@ -181,6 +189,10 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 "queue_depth": None,
                 "ewma_queue_s": None, "ewma_prefill_s": None,
                 "ewma_decode_s": None, "ewma_service_s": None,
+                # Phase-volume split (prefill vs decode tokens): what the
+                # fleet's tier manager scores replicas by for prefill/
+                # decode disaggregation (docs/FLEET.md "Tiered serving").
+                "ewma_prefill_tokens": None, "ewma_decode_tokens": None,
                 "slo_goodput_ratio": None,
             }
             if batcher is not None and hasattr(batcher, "load_digest"):
@@ -406,6 +418,14 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                     "path": None if rec is None else rec.get("path"),
                 })
                 return
+            if self.path in (httputil.KV_EXPORT_PATH, httputil.KV_IMPORT_PATH):
+                # Cross-replica KV transfer (docs/FLEET.md "Tiered serving
+                # and KV streaming"): export serializes a prompt prefix's
+                # committed pages, import admits a request whose prefill
+                # ran elsewhere. Deadline/trace/tenant propagation and the
+                # draining/overload admission gate match /generate.
+                self._kv_transfer()
+                return
             if self.path not in ("/generate", "/generate_stream"):
                 self._send(404, {"error": f"unknown path {self.path}"})
                 return
@@ -448,6 +468,116 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                     self._generate(payload, trace_ctx, tenant, session)
             finally:
                 self.server.end_request()
+
+        def _kv_transfer(self):
+            """``POST /kv/export`` and ``POST /kv/import`` — the replica
+            half of prefill/decode disaggregation. Capability-gated: only
+            a paged continuous engine can speak the wire format, and a
+            corrupted / version-mismatched / wrong-geometry payload is a
+            structured 400 (``kind: "kv_wire"``), never a 500 — the fleet
+            router treats any non-200 as a graceful fallback signal."""
+            if not getattr(batcher, "supports_kv_transfer", False):
+                self._send(400, {
+                    "error": "KV transfer needs --continuous with a paged "
+                    "kv_backend (and a non-speculative engine)",
+                    "kind": "kv_capability",
+                })
+                return
+            ok, deadline_s = httputil.read_deadline_header(self)
+            if not ok:
+                return
+            if deadline_s is not None and deadline_s <= 0:
+                self._send(504, {"error": "propagated deadline already expired"})
+                return
+            trace_ctx = httputil.read_trace_header(self)
+            tenant = httputil.read_tenant_header(self)
+            session = httputil.read_session_header(self)
+            payload = self._read_json()
+            if payload is None:
+                return
+            question = payload.get("question")
+            if not question or not isinstance(question, str):
+                self._send(400, {"error": "missing 'question' field"})
+                return
+            verdict = self.server.begin_request()
+            if verdict == "draining":
+                self._send(503, {"error": "draining: not accepting new requests"},
+                           extra={"Retry-After": "1"})
+                return
+            if verdict == "overloaded":
+                self._send(503, {"error": "overloaded",
+                                 "max_inflight": self.server.max_inflight},
+                           extra={"Retry-After": "1"})
+                return
+            try:
+                from edgemesh.obs.trace import use_trace
+
+                with use_trace(trace_ctx):
+                    if self.path == httputil.KV_EXPORT_PATH:
+                        self._kv_export(question, trace_ctx, tenant, session)
+                    else:
+                        self._kv_import(payload, question, trace_ctx,
+                                        tenant, session)
+            finally:
+                self.server.end_request()
+
+        def _kv_export(self, question, trace_ctx, tenant, session):
+            try:
+                result = batcher.submit_export(
+                    question, trace_ctx=trace_ctx, tenant=tenant,
+                    session=session,
+                ).result()
+            except ValueError as exc:
+                # A prompt the wire cannot carry (too short, over-capacity)
+                # is the caller's input problem, answered structurally.
+                self._send(400, {"error": str(exc), "kind": "kv_wire"})
+                return
+            except Exception as exc:
+                log.exception("kv export failed")
+                self._send(500, {"error": str(exc)})
+                return
+            self._send(200, {
+                "kv": httputil.encode_kv_b64(result["kv_bytes"]),
+                "tokens": result["tokens"],
+                "prompt_tokens": result["prompt_tokens"],
+                "bytes": len(result["kv_bytes"]),
+                "cached": result["cached"],
+            })
+
+        def _kv_import(self, payload, question, trace_ctx, tenant, session):
+            from edgemesh.runtime.paged_kv import KVWireError
+
+            max_new = payload.get("max_new")
+            if max_new is not None and (
+                isinstance(max_new, bool)
+                or not isinstance(max_new, int)
+                or max_new < 1
+            ):
+                self._send(400, {"error": "'max_new' must be a positive int"})
+                return
+            try:
+                buf = httputil.decode_kv_b64(payload.get("kv"))
+                # Header + geometry gate on THIS thread: a bad payload is
+                # refused before it ever queues behind real admissions.
+                batcher.check_kv_payload(buf)
+            except (ValueError, KVWireError) as exc:
+                self._send(400, {"error": f"bad KV payload: {exc}",
+                                 "kind": "kv_wire"})
+                return
+            try:
+                result = batcher.answer(
+                    question, max_new=max_new, trace_ctx=trace_ctx,
+                    tenant=tenant, session=session, kv_import=buf,
+                )
+            except KVWireError as exc:
+                self._send(400, {"error": f"bad KV payload: {exc}",
+                                 "kind": "kv_wire"})
+                return
+            except Exception as exc:
+                log.exception("kv import failed")
+                self._send(500, {"error": str(exc)})
+                return
+            self._send(200, result)
 
         def _generate(self, payload: dict, trace_ctx=None, tenant=None,
                       session=None):

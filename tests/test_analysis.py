@@ -414,6 +414,56 @@ def test_em109_sees_bare_urlopen_and_honors_disable():
     assert lint_source(quiet, path="edgemesh/fleet/router.py") == []
 
 
+def test_em109_kv_transfer_requires_deadline_header():
+    # A call literally targeting a /kv/ path must ALSO carry the deadline
+    # header (the tiered path's budget contract); trace-only headers flag.
+    src = (
+        "def xfer(transport, rep, payload):\n"
+        "    return transport.post_json(rep.url('/kv/export'), payload,\n"
+        "                               timeout_s=1.0,\n"
+        "                               headers={TRACE_HEADER: h})\n"
+    )
+    findings = lint_source(src, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM109"}
+    assert "X-Edgemesh-Deadline-S" in findings[0].message
+    # The DEADLINE_HEADER constant (any attribute path) or the literal
+    # string both satisfy.
+    for fix in ("{TRACE_HEADER: h, DEADLINE_HEADER: '5'}",
+                "{TRACE_HEADER: h, httputil.DEADLINE_HEADER: '5'}",
+                "{TRACE_HEADER: h, 'X-Edgemesh-Deadline-S': '5'}"):
+        ok = src.replace("{TRACE_HEADER: h}", fix)
+        assert lint_source(ok, path="edgemesh/fleet/router.py") == []
+    # f-string URLs count as literal /kv/ targets too.
+    fstr = src.replace("rep.url('/kv/export')", "f'{base}/kv/import'")
+    assert rules_of(lint_source(fstr, path="edgemesh/fleet/router.py")) == {"EM109"}
+    # Missing BOTH trace and deadline on a transfer → two findings.
+    both = src.replace("{TRACE_HEADER: h}", "{'A': 'b'}")
+    assert len(lint_source(both, path="edgemesh/fleet/router.py")) == 2
+
+
+def test_em109_kv_transfer_with_no_headers_flags_but_probes_stay_exempt():
+    bare = (
+        "def xfer(transport, rep, payload):\n"
+        "    return transport.post_json(rep.url('/kv/import'), payload,\n"
+        "                               timeout_s=1.0)\n"
+    )
+    findings = lint_source(bare, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM109"}
+    assert "no headers" in findings[0].message
+    # Non-transfer calls with no headers (probes, drain admin) keep their
+    # out-of-scope exemption, and opaque URLs stay opaque.
+    probe = (
+        "def probe(transport, url):\n"
+        "    return transport.get_json(url, timeout_s=1.0)\n"
+    )
+    assert lint_source(probe, path="edgemesh/fleet/health.py") == []
+    opaque = (
+        "def xfer(transport, rep, path, payload):\n"
+        "    return transport.post_json(rep.url(path), payload, timeout_s=1.0)\n"
+    )
+    assert lint_source(opaque, path="edgemesh/fleet/router.py") == []
+
+
 def test_em109_shipped_fleet_is_clean():
     # The real router/transport/prober must carry the header everywhere
     # they build one — the shipped tree is the rule's reference fixture.

@@ -121,6 +121,7 @@ def test_headline_serving_schema_gains_ragged_and_spec_keys(monkeypatch, capsys)
     monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_ADMIT", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
 
     out = benchmarks.headline_benchmark(preset="llama1b", batch=2,
@@ -231,6 +232,7 @@ def test_router_overhead_stage_schema_pins_recorder_arm(monkeypatch, capsys):
     monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
     out = benchmarks.headline_benchmark(preset="tiny", batch=2,
                                         decode_steps=8, sweep_batches=())
     assert out["router_overhead_p50_s"] == 0.0021
@@ -262,6 +264,7 @@ def test_router_overhead_stage_is_skippable_via_env(monkeypatch):
     monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
     out = benchmarks.headline_benchmark(preset="tiny", batch=2,
                                         decode_steps=8, sweep_batches=())
     assert not any(k.startswith(("router_overhead", "recorder_")) for k in out)
@@ -292,6 +295,7 @@ def test_load_curve_stage_is_skippable_via_env(monkeypatch, capsys):
     monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
     out = benchmarks.headline_benchmark(preset="tiny", batch=2,
                                         decode_steps=8, sweep_batches=())
     assert not any(k.startswith("load_curve") for k in out)
@@ -316,7 +320,7 @@ def _fake_stage1(monkeypatch):
 
 _TP8_GATES = ("EDGEMESH_BENCH_8B", "EDGEMESH_BENCH_SERVE",
               "EDGEMESH_BENCH_FLEET", "EDGEMESH_BENCH_SPEC",
-              "EDGEMESH_BENCH_LOADGEN")
+              "EDGEMESH_BENCH_LOADGEN", "EDGEMESH_BENCH_DISAGG")
 
 
 def test_tp8_stage_schema_pins(monkeypatch, capsys):
@@ -389,6 +393,77 @@ def test_tp8_stage_is_skippable_via_env(monkeypatch, capsys):
     assert not any("tp8" in k or k.startswith("collective_") for k in out)
 
 
+def test_disagg_stage_schema_pins(monkeypatch, capsys):
+    """The disaggregation schema contract: a headline run carries the
+    homogeneous-vs-tiered TTFT p99 ratio, per-arm goodput/tenant splits,
+    the KV wire bytes the tiered arm moved, and the live tier assignment —
+    pinned with a faked stage so a partial artifact still has the keys the
+    acceptance gate reads (no replicas spun)."""
+    _fake_stage1(monkeypatch)
+    for gate in _TP8_GATES:
+        monkeypatch.setenv(gate, "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.delenv("EDGEMESH_BENCH_DISAGG", raising=False)
+
+    def fake_disagg(**kw):
+        return {"metric": "disagg_ttft_p99_ratio", "value": 1.31,
+                "unit": "x", "n_replicas": 3, "duration_s": 4.0,
+                "slo_latency_s": 0.8, "estimated_capacity_rps": 6.0,
+                "prefill_threshold_chars": 250,
+                "homogeneous_chat_p99_s": 0.9, "tiered_chat_p99_s": 0.687,
+                "homogeneous_goodput_ratio": 0.91,
+                "tiered_goodput_ratio": 0.97,
+                "homogeneous_tenants": {
+                    "chat": {"latency_s_p99": 0.9, "goodput_ratio": 0.9},
+                    "bulk": {"latency_s_p99": 1.4, "goodput_ratio": 0.93}},
+                "tiered_tenants": {
+                    "chat": {"latency_s_p99": 0.687, "goodput_ratio": 0.99},
+                    "bulk": {"latency_s_p99": 1.5, "goodput_ratio": 0.95}},
+                "kv_transfer_bytes": 1030288,
+                "tiered_outcomes": {"tiered": 8, "cache_hit": 3},
+                "tiers": {"prefill": ["replica-0"],
+                          "decode": ["replica-1", "replica-2"],
+                          "prefill_threshold_chars": 250,
+                          "prefix_chars": 64,
+                          "kv_cache": {"entries": 5, "capacity": 32,
+                                       "hot_keys": 2}}}
+
+    monkeypatch.setattr(benchmarks, "disagg_benchmark", fake_disagg)
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    # The acceptance-gate keys: ratio > 1 at equal-or-better goodput,
+    # with real bytes on the wire.
+    assert out["disagg_ttft_p99_ratio"] == 1.31
+    assert out["disagg_kv_transfer_bytes"] == 1030288
+    assert out["disagg_tiered_goodput_ratio"] >= out["disagg_homogeneous_goodput_ratio"]
+    assert out["disagg_homogeneous_chat_p99_s"] == 0.9
+    assert out["disagg_tiered_chat_p99_s"] == 0.687
+    assert {"chat", "bulk"} <= set(out["disagg_tiered_tenants"])
+    assert out["disagg_tiered_outcomes"]["tiered"] == 8
+    # Tier membership rides the artifact (the /fleetz view at run end).
+    assert out["disagg_tiers"]["prefill"] == ["replica-0"]
+    assert len(out["disagg_tiers"]["decode"]) == 2
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert "disagg_ttft_p99_ratio" in lines[-1]
+
+
+def test_disagg_stage_is_skippable_via_env(monkeypatch):
+    """EDGEMESH_BENCH_DISAGG=0 must skip the disagg stage entirely — no
+    replicas spun, no keys emitted, no error recorded."""
+    _fake_stage1(monkeypatch)
+    for gate in _TP8_GATES:
+        monkeypatch.setenv(gate, "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+
+    def boom(**kw):
+        raise AssertionError("disagg_benchmark ran despite the gate")
+
+    monkeypatch.setattr(benchmarks, "disagg_benchmark", boom)
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert not any(k.startswith("disagg") for k in out)
+
+
 def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
     """The headline int8 stage must produce a parseable driver line BEFORE
     any other stage runs, and later-stage failures must keep earlier keys."""
@@ -412,11 +487,12 @@ def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
     monkeypatch.setattr(benchmarks, "_build", fake_build)
     monkeypatch.setattr(benchmarks, "decode_benchmark", fake_decode)
     monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
-    # Stage ordering is under test, not the fleet: the adaptive-router and
-    # load-curve stages would spin real in-process replicas here.
+    # Stage ordering is under test, not the fleet: the adaptive-router,
+    # load-curve, and disagg stages would spin real in-process replicas.
     monkeypatch.setenv("EDGEMESH_BENCH_FLEET", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
 
     out = benchmarks.headline_benchmark(preset="tiny", batch=2, decode_steps=8,
                                         sweep_batches=())

@@ -1,0 +1,731 @@
+"""KV transfer + tiered routing (fast tier): the wire format round-trip
+(bf16 and int8 pools, zero-length, page-boundary, corruption/version
+refusal), dynamic tier assignment (TierManager), the router's tiered path
+over a fake transport (export→import flow, shared prefix cache, graceful
+fallback), and the non-hedgeable-transfer regression. Engine-level and
+gateway-level round trips (real model) live in the slow tier at the bottom
+of this file; the full subprocess A/B is tests/test_disagg_e2e.py."""
+
+import random
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edgemesh.fleet import FleetRouter, ReplicaRegistry, TransportError
+from edgemesh.fleet.balancer import TierManager
+from edgemesh.models.transformer import ModelConfig
+from edgemesh.obs import Registry
+from edgemesh.runtime import paged_kv as pk
+
+
+# ---------------------------------------------------------------------------
+# Wire format round trip (no model, no engine)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(num_layers=2, hidden_size=32, num_heads=4, num_kv_heads=2,
+                intermediate_size=64, vocab_size=128, max_seq_len=256)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def _mark_pages(cache, pages, value):
+    upd = dict(
+        k=cache.k.at[:, pages].set(value),
+        v=cache.v.at[:, pages].set(value + 1),
+    )
+    if hasattr(cache, "k_scale"):
+        upd["k_scale"] = cache.k_scale.at[:, pages].set(0.5)
+        upd["v_scale"] = cache.v_scale.at[:, pages].set(0.25)
+    return cache._replace(**upd)
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+@pytest.mark.parametrize("tokens", [13, 16, 1], ids=["partial", "boundary", "one"])
+def test_wire_roundtrip_pools_and_lengths(quant, tokens):
+    cfg = _cfg()
+    init = pk.init_quant_paged_cache if quant else pk.init_paged_cache
+    kw = {} if quant else {"dtype": jnp.bfloat16}
+    src = init(cfg, 2, total_pages=9, page_size=8, **kw)
+    n_pages = -(-tokens // 8)
+    pages = list(range(3, 3 + n_pages))
+    src = _mark_pages(src, pages, 7)
+    ids = np.arange(100, 100 + tokens, dtype=np.int32)
+    buf = pk.export_pages(src, pages, tokens, ids)
+    payload = pk.decode_wire(buf)
+    assert payload.tokens == tokens and payload.n_pages == n_pages
+    assert (payload.ids == ids).all()
+    assert np.asarray(payload.k, np.float32).min() == 7
+    if quant:
+        assert payload.k_scale is not None
+        assert float(payload.k_scale.min()) == 0.5
+
+    dst = init(cfg, 2, total_pages=9, page_size=8, **kw)
+    dest_pages = list(range(6, 6 + n_pages))
+    dst = pk.splice_imported(dst, payload, dest_pages)
+    assert np.asarray(dst.k[:, dest_pages], np.float32).min() == 7
+    assert np.asarray(dst.v[:, dest_pages], np.float32).min() == 8
+    if quant:
+        assert np.asarray(dst.k_scale[:, dest_pages]).min() == 0.5
+        assert np.asarray(dst.v_scale[:, dest_pages]).min() == 0.25
+    # Pages OUTSIDE the destination set stay untouched (the trash page
+    # absorbs the pow2 padding writes harmlessly).
+    others = [p for p in range(1, 9) if p not in dest_pages]
+    assert np.asarray(dst.k[:, others], np.float32).max() == 0
+
+
+def test_wire_zero_length_export_is_legal():
+    src = pk.init_paged_cache(_cfg(), 2, total_pages=5, page_size=8)
+    buf = pk.export_pages(src, [], 0, [])
+    payload = pk.decode_wire(buf)
+    assert payload.tokens == 0 and payload.n_pages == 0
+    assert payload.ids.size == 0 and payload.k.size == 0
+    # Importing nothing is a no-op, not an error.
+    dst = pk.splice_imported(src, payload, [])
+    assert dst.k.shape == src.k.shape
+
+
+def test_wire_partial_import_uses_leading_pages_only():
+    # An importer whose token match ends early takes FEWER pages than the
+    # payload carries — the leading ones.
+    cfg = _cfg()
+    src = pk.init_paged_cache(cfg, 2, total_pages=9, page_size=8)
+    src = src._replace(k=src.k.at[:, 3].set(7).at[:, 4].set(9))
+    buf = pk.export_pages(src, [3, 4], 16, np.arange(16, dtype=np.int32))
+    payload = pk.decode_wire(buf)
+    dst = pk.init_paged_cache(cfg, 2, total_pages=9, page_size=8)
+    dst = pk.splice_imported(dst, payload, [6])
+    assert np.asarray(dst.k[:, 6], np.float32).min() == 7  # first page
+    assert np.asarray(dst.k[:, 7], np.float32).max() == 0  # second not taken
+    with pytest.raises(pk.KVWireError):
+        pk.splice_imported(dst, payload, [5, 6, 7])  # more than it carries
+
+
+def test_wire_corruption_and_version_mismatch_refused():
+    src = pk.init_paged_cache(_cfg(), 2, total_pages=5, page_size=8)
+    src = src._replace(k=src.k.at[:, 2].set(1.0))
+    buf = pk.export_pages(src, [2], 5, np.arange(5, dtype=np.int32))
+    with pytest.raises(pk.KVWireError, match="truncated or corrupt"):
+        pk.decode_wire(buf[:-3])
+    with pytest.raises(pk.KVWireError, match="too short"):
+        pk.decode_wire(b"EM")
+    bad_magic = b"NOPE" + buf[4:]
+    with pytest.raises(pk.KVWireError, match="bad magic"):
+        pk.decode_wire(bad_magic)
+    bad_version = bytearray(buf)
+    bad_version[4] = 99  # the version u16's low byte
+    with pytest.raises(pk.KVWireError, match="version"):
+        pk.decode_wire(bytes(bad_version))
+
+
+def test_wire_geometry_mismatch_refused_on_import():
+    src = pk.init_paged_cache(_cfg(), 2, total_pages=5, page_size=8)
+    buf = pk.export_pages(src, [2], 5, np.arange(5, dtype=np.int32))
+    payload = pk.decode_wire(buf)
+    # Different kv-head count → refuse with the differing fields named.
+    other = pk.init_paged_cache(_cfg(num_kv_heads=4, num_heads=4), 2,
+                                total_pages=5, page_size=8)
+    with pytest.raises(pk.KVWireError, match="kv_heads"):
+        pk.check_wire_compat(payload, other)
+    # Quant pool vs float payload → kind mismatch.
+    quant = pk.init_quant_paged_cache(_cfg(), 2, total_pages=5, page_size=8)
+    with pytest.raises(pk.KVWireError, match="kind"):
+        pk.check_wire_compat(payload, quant)
+
+
+def test_wire_ids_token_count_must_agree():
+    src = pk.init_paged_cache(_cfg(), 2, total_pages=5, page_size=8)
+    with pytest.raises(ValueError, match="ids carries"):
+        pk.export_pages(src, [2], 5, np.arange(4, dtype=np.int32))
+    with pytest.raises(ValueError, match="do not fit"):
+        pk.export_pages(src, [2], 9, np.arange(9, dtype=np.int32))
+
+
+# ---------------------------------------------------------------------------
+# TierManager: dynamic, digest-EWMA-driven membership
+# ---------------------------------------------------------------------------
+
+
+def _registry(*rids):
+    reg = ReplicaRegistry()
+    for rid in rids:
+        reg.register(rid, f"http://{rid}")
+    return reg
+
+
+def _load(reg, rid, prefill, decode):
+    reg.update_load(rid, {"ewma_prefill_tokens": prefill,
+                          "ewma_decode_tokens": decode})
+
+
+def test_tiers_need_two_replicas():
+    reg = _registry("r0")
+    t = TierManager().assign(reg.replicas())
+    assert t["prefill"] == [] and [r.rid for r in t["decode"]] == ["r0"]
+
+
+def test_tiers_follow_digest_prefill_share():
+    reg = _registry("r0", "r1", "r2")
+    _load(reg, "r2", 500.0, 10.0)   # prefill-heavy
+    _load(reg, "r0", 5.0, 100.0)
+    _load(reg, "r1", 5.0, 100.0)
+    tm = TierManager(refresh_s=0.0)
+    t = tm.assign(reg.replicas())
+    assert [r.rid for r in t["prefill"]] == ["r2"]
+    assert [r.rid for r in t["decode"]] == ["r0", "r1"]
+    # The workload mix flips → membership follows (dynamic).
+    _load(reg, "r2", 1.0, 500.0)
+    _load(reg, "r0", 400.0, 2.0)
+    t = tm.assign(reg.replicas())
+    assert [r.rid for r in t["prefill"]] == ["r0"]
+
+
+def test_tiers_cold_fleet_is_deterministic_and_bounded():
+    reg = _registry("r3", "r1", "r2", "r0")
+    t = TierManager(prefill_fraction=0.5, refresh_s=0.0).assign(reg.replicas())
+    # All scores neutral → rid order; fraction 0.5 of 4 → 2 prefill, and
+    # the bounds hold (1 <= prefill <= n-1).
+    assert [r.rid for r in t["prefill"]] == ["r0", "r1"]
+    assert [r.rid for r in t["decode"]] == ["r2", "r3"]
+
+
+def test_tiers_hysteresis_resists_flapping_and_unhealthy_excluded():
+    reg = _registry("r0", "r1", "r2")
+    tm = TierManager(refresh_s=0.0, hysteresis=0.2)
+    _load(reg, "r0", 100.0, 100.0)  # share 0.5, incumbent after first call
+    _load(reg, "r1", 90.0, 110.0)
+    _load(reg, "r2", 90.0, 110.0)
+    t = tm.assign(reg.replicas())
+    assert [r.rid for r in t["prefill"]] == ["r0"]
+    # r1 nudges slightly ahead — within the hysteresis margin, the
+    # incumbent keeps the tier (no flap).
+    _load(reg, "r1", 110.0, 100.0)
+    t = tm.assign(reg.replicas())
+    assert [r.rid for r in t["prefill"]] == ["r0"]
+    # A decisive shift does move membership.
+    _load(reg, "r1", 1000.0, 1.0)
+    t = tm.assign(reg.replicas())
+    assert [r.rid for r in t["prefill"]] == ["r1"]
+    # Unhealthy replicas leave both tiers.
+    reg.set_state("r1", "unhealthy")
+    reg.set_state("r2", "unhealthy")
+    t = tm.assign(reg.replicas())
+    assert t["prefill"] == [] and [r.rid for r in t["decode"]] == ["r0"]
+
+
+def test_tiers_assignment_caches_until_invalidated():
+    reg = _registry("r0", "r1", "r2")
+    clock = [0.0]
+    tm = TierManager(refresh_s=10.0, now=lambda: clock[0])
+    t1 = tm.assign(reg.replicas())
+    _load(reg, "r2", 900.0, 1.0)
+    # Within refresh_s and same membership: the cached split is served.
+    assert tm.assign(reg.replicas()) is t1
+    tm.invalidate()  # the prober's on_digest hook
+    t2 = tm.assign(reg.replicas())
+    assert [r.rid for r in t2["prefill"]] == ["r2"]
+
+
+# ---------------------------------------------------------------------------
+# Tiered routing over a fake transport
+# ---------------------------------------------------------------------------
+
+
+class FakeTransport:
+    def __init__(self):
+        self.calls = []
+        self._routes = []
+
+    def on(self, substr, handler):
+        self._routes.append((substr, handler))
+        return self
+
+    def _dispatch(self, method, url, payload, timeout_s, headers):
+        self.calls.append((method, url, payload, timeout_s, dict(headers or {})))
+        for substr, handler in self._routes:
+            if substr in url:
+                return handler(url, payload, headers or {})
+        return 200, {"answer": "ok"}
+
+    def get_json(self, url, timeout_s, headers=None):
+        return self._dispatch("GET", url, None, timeout_s, headers)
+
+    def post_json(self, url, payload, timeout_s, headers=None):
+        return self._dispatch("POST", url, payload, timeout_s, headers)
+
+    def urls(self, substr):
+        return [c[1] for c in self.calls if substr in c[1]]
+
+
+def _tiered_router(reg, transport, **kw):
+    kw.setdefault("obs_registry", Registry())
+    kw.setdefault("rng", random.Random(0))
+    kw.setdefault("tiered", True)
+    kw.setdefault("tier_manager", TierManager(refresh_s=0.0))
+    kw.setdefault("prefill_threshold_chars", 40)
+    return FleetRouter(reg, transport=transport, **kw)
+
+
+def _skewed_registry():
+    reg = _registry("r0", "r1", "r2")
+    _load(reg, "r2", 500.0, 10.0)  # r2 is the prefill tier
+    _load(reg, "r0", 5.0, 100.0)
+    _load(reg, "r1", 5.0, 100.0)
+    return reg
+
+
+def _export_ok(url, payload, headers):
+    # The lint contract, asserted live: every transfer hop carries the
+    # trace AND deadline headers.
+    assert "X-Edgemesh-Trace" in headers and "X-Edgemesh-Deadline-S" in headers
+    return 200, {"kv": "QUJD", "tokens": 99, "bytes": 3, "cached": False}
+
+
+def _import_ok(url, payload, headers):
+    assert "X-Edgemesh-Trace" in headers and "X-Edgemesh-Deadline-S" in headers
+    assert payload["kv"] == "QUJD"
+    return 200, {"answer": "imported", "generated": 4}
+
+
+def test_tiered_long_prompt_exports_from_prefill_tier_and_imports_to_decode():
+    reg = _skewed_registry()
+    tr = FakeTransport().on("/kv/export", _export_ok).on("/kv/import", _import_ok)
+    router = _tiered_router(reg, tr)
+    status, body, headers = router.handle_generate({"question": "x" * 100})
+    assert status == 200 and body["answer"] == "imported"
+    assert headers["X-Edgemesh-Tiered"] == "1"
+    assert "X-Edgemesh-Replica" in headers
+    exports, imports = tr.urls("/kv/export"), tr.urls("/kv/import")
+    assert len(exports) == 1 and "r2" in exports[0]  # the prefill tier
+    assert len(imports) == 1 and ("r0" in imports[0] or "r1" in imports[0])
+    # Outstanding bookkeeping balanced out through both pinned attempts.
+    assert all(r.outstanding == 0 for r in reg.replicas())
+    s = router.obs.summary(prefix="edgemesh_fleet_")
+    assert s['edgemesh_fleet_kv_transfer_bytes_total{direction="export"}'] == 3
+    assert s['edgemesh_fleet_kv_transfer_bytes_total{direction="import"}'] == 3
+    assert s['edgemesh_fleet_tiered_total{outcome="tiered"}'] == 1
+
+
+def test_tiered_repeat_prompt_hits_router_prefix_cache():
+    reg = _skewed_registry()
+    tr = FakeTransport().on("/kv/export", _export_ok).on("/kv/import", _import_ok)
+    router = _tiered_router(reg, tr)
+    q = "y" * 120
+    assert router.handle_generate({"question": q})[0] == 200
+    assert router.handle_generate({"question": q})[0] == 200
+    assert len(tr.urls("/kv/export")) == 1  # second request skipped the hop
+    assert len(tr.urls("/kv/import")) == 2
+    s = router.obs.summary(prefix="edgemesh_fleet_")
+    assert s['edgemesh_fleet_tiered_total{outcome="cache_hit"}'] == 1
+
+
+def test_tiered_transfer_failure_falls_back_homogeneous_no_client_error():
+    reg = _skewed_registry()
+    tr = FakeTransport()
+    tr.on("/kv/export", lambda u, p, h: (_ for _ in ()).throw(
+        TransportError("export down")))
+    tr.on("/generate", lambda u, p, h: (200, {"answer": "homog"}))
+    router = _tiered_router(reg, tr)
+    status, body, headers = router.handle_generate({"question": "z" * 100})
+    assert status == 200 and body["answer"] == "homog"
+    assert "X-Edgemesh-Tiered" not in headers
+    s = router.obs.summary(prefix="edgemesh_fleet_")
+    assert s['edgemesh_fleet_tiered_total{outcome="fallback_export"}'] == 1
+    # Import-side failure too: export succeeds, import 500s, still no
+    # client-visible error.
+    tr2 = FakeTransport().on("/kv/export", _export_ok)
+    tr2.on("/kv/import", lambda u, p, h: (500, {"error": "boom"}))
+    tr2.on("/generate", lambda u, p, h: (200, {"answer": "homog"}))
+    router2 = _tiered_router(_skewed_registry(), tr2)
+    status, body, _ = router2.handle_generate({"question": "z" * 100})
+    assert status == 200 and body["answer"] == "homog"
+    s2 = router2.obs.summary(prefix="edgemesh_fleet_")
+    assert s2['edgemesh_fleet_tiered_total{outcome="fallback_import"}'] == 1
+
+
+def test_tiered_long_prompt_fallback_is_fully_homogeneous():
+    # Regression: after a failed transfer the long prompt must NOT stay
+    # excluded from the prefill tier — with the decode tier down, the
+    # prefill-tier replica is the only one left and it must answer.
+    reg = _skewed_registry()
+    tr = FakeTransport()
+    tr.on("/kv/export", lambda u, p, h: (_ for _ in ()).throw(
+        TransportError("export down")))
+
+    def generate(url, payload, headers):
+        if "r2" in url:  # the prefill-tier replica
+            return 200, {"answer": "prefill-tier-answered"}
+        raise TransportError("decode tier down")
+
+    tr.on("/generate", generate)
+    router = _tiered_router(reg, tr, max_attempts=3)
+    status, body, _ = router.handle_generate({"question": "q" * 100})
+    assert status == 200 and body["answer"] == "prefill-tier-answered"
+
+
+def test_tiered_outcome_fates_are_disjoint():
+    # Every tiered-path request lands in exactly ONE outcome bucket, so
+    # fallback ratios over the family stay honest.
+    reg = _skewed_registry()
+    tr = FakeTransport().on("/kv/export", _export_ok).on("/kv/import", _import_ok)
+    router = _tiered_router(reg, tr)
+    q = "d" * 100
+    for _ in range(3):
+        assert router.handle_generate({"question": q})[0] == 200
+    s = router.obs.summary(prefix="edgemesh_fleet_")
+    outcomes = {k: v for k, v in s.items()
+                if k.startswith("edgemesh_fleet_tiered_total")}
+    assert outcomes == {
+        'edgemesh_fleet_tiered_total{outcome="tiered"}': 1.0,
+        'edgemesh_fleet_tiered_total{outcome="cache_hit"}': 2.0,
+    }
+
+
+def test_tiered_empty_tier_degrades_to_homogeneous():
+    reg = _registry("r0", "r1")
+    reg.set_state("r1", "unhealthy")  # 1 healthy → no prefill tier
+    tr = FakeTransport().on("/generate", lambda u, p, h: (200, {"answer": "homog"}))
+    router = _tiered_router(reg, tr)
+    status, body, _ = router.handle_generate({"question": "w" * 100})
+    assert status == 200 and body["answer"] == "homog"
+    assert tr.urls("/kv/export") == []
+
+
+def test_tiered_short_prompts_stay_on_decode_tier_until_prefix_is_hot():
+    reg = _skewed_registry()
+    tr = FakeTransport().on("/kv/export", _export_ok).on("/kv/import", _import_ok)
+    tr.on("/generate", lambda u, p, h: (200, {"answer": "homog"}))
+    router = _tiered_router(reg, tr, prefill_threshold_chars=1000,
+                            prefix_hot_after=2)
+    q = "short shared prefix question"
+    s1, b1, _ = router.handle_generate({"question": q})
+    assert b1["answer"] == "homog"
+    # Chatty traffic never lands on the prefill tier (routing hint).
+    assert all("r2" not in u for u in tr.urls("/generate"))
+    # Second sighting: the prefix is hot → export once, import, answer.
+    s2, b2, h2 = router.handle_generate({"question": q})
+    assert b2["answer"] == "imported" and h2.get("X-Edgemesh-Tiered") == "1"
+    assert len(tr.urls("/kv/export")) == 1
+
+
+def test_tiered_status_surfaces_membership_and_cache():
+    reg = _skewed_registry()
+    tr = FakeTransport().on("/kv/export", _export_ok).on("/kv/import", _import_ok)
+    router = _tiered_router(reg, tr)
+    router.handle_generate({"question": "x" * 100})
+    st = router.status()
+    assert st["tiers"]["prefill"] == ["r2"]
+    assert sorted(st["tiers"]["decode"]) == ["r0", "r1"]
+    assert st["tiers"]["kv_cache"]["entries"] == 1
+    # Untiered routers surface null — single-replica deployments see the
+    # pre-tiering /fleetz shape plus one explicit "off" marker.
+    plain = FleetRouter(_registry("r0"), transport=FakeTransport(),
+                        obs_registry=Registry())
+    assert plain.status()["tiers"] is None
+
+
+def test_note_digest_invalidates_tier_cache():
+    reg = _registry("r0", "r1", "r2")
+    tm = TierManager(refresh_s=1e9)  # cache would never expire on its own
+    tr = FakeTransport()
+    router = _tiered_router(reg, tr, tier_manager=tm)
+    assert [r.rid for r in tm.assign(reg.replicas())["prefill"]] == ["r0"]
+    _load(reg, "r2", 900.0, 1.0)
+    router.note_digest("r2", reg.get("r2").load)  # the prober's hook
+    assert [r.rid for r in tm.assign(reg.replicas())["prefill"]] == ["r2"]
+
+
+# ---------------------------------------------------------------------------
+# Non-hedgeable transfer endpoints (regression: hedging a transfer can
+# double-import pages)
+# ---------------------------------------------------------------------------
+
+
+def _slow_then_ok(delay_s):
+    def handler(url, payload, headers):
+        time.sleep(delay_s)
+        return 200, {"answer": "slow-ok", "kv": "QUJD", "bytes": 3}
+    return handler
+
+
+def test_kv_transfer_paths_never_hedge():
+    reg = _registry("r0", "r1", "r2")
+    tr = FakeTransport().on("/kv/", _slow_then_ok(0.15))
+    router = FleetRouter(reg, transport=tr, obs_registry=Registry(),
+                         rng=random.Random(0), hedge_after_s=0.02)
+    for path in ("/kv/import", "/kv/export"):
+        status, _, _ = router.handle_generate(
+            {"question": "q", "kv": "QUJD"}, path=path)
+        assert status == 200
+    s = router.obs.summary(prefix="edgemesh_fleet_")
+    hedged = sum(v for k, v in s.items()
+                 if k.startswith("edgemesh_fleet_hedged_total"))
+    assert hedged == 0
+    # Exactly one attempt per request — no raced twin ever dispatched.
+    assert len(tr.urls("/kv/")) == 2
+
+
+def test_generate_still_hedges_under_same_config():
+    # Control for the regression above: the SAME router/latency profile
+    # hedges /generate, so the transfer exemption is the path, not a
+    # broken hedge arm.
+    reg = _registry("r0", "r1", "r2")
+    tr = FakeTransport().on("/generate", _slow_then_ok(0.15))
+    router = FleetRouter(reg, transport=tr, obs_registry=Registry(),
+                         rng=random.Random(0), hedge_after_s=0.02)
+    status, _, _ = router.handle_generate({"question": "q"})
+    assert status == 200
+    s = router.obs.summary(prefix="edgemesh_fleet_")
+    hedged = sum(v for k, v in s.items()
+                 if k.startswith("edgemesh_fleet_hedged_total{"))
+    assert hedged >= 1
+
+
+def test_transfer_latency_stays_out_of_hedge_estimator():
+    reg = _skewed_registry()
+    tr = FakeTransport().on("/kv/export", _export_ok).on("/kv/import", _import_ok)
+    router = _tiered_router(reg, tr, hedge_auto=True)
+    before = router._hedge_estimator.weight()
+    router.handle_generate({"question": "x" * 100})
+    # Two transfer attempts completed; neither fed the estimator.
+    assert router._hedge_estimator.weight() == before
+
+
+# ---------------------------------------------------------------------------
+# Digest schema: the prefill/decode token EWMA split
+# ---------------------------------------------------------------------------
+
+
+def test_span_tracker_digest_splits_prefill_and_decode_volume():
+    from edgemesh.obs.spans import SpanTracker
+
+    tr = SpanTracker(Registry(), engine="continuous")
+    d0 = tr.load_digest()
+    assert d0["ewma_prefill_tokens"] is None
+    assert d0["ewma_decode_tokens"] is None
+    t = tr.submit(0)
+    tr.admit_start(t)
+    tr.admitted(t, prompt_tokens=100, prefill_tokens=80)
+    tr.tokens(t, 5)
+    tr.retire(t, status="ok")
+    d = tr.load_digest()
+    # The COMPUTED prefill (80, not the 100-token prompt) feeds the split:
+    # imported/warm admissions must not inflate a replica's prefill share.
+    assert d["ewma_prefill_tokens"] == 80.0
+    assert d["ewma_decode_tokens"] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# Gateway capability gate (stub — no engine, fast)
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_kv_endpoints_refuse_without_paged_engine():
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from edgemesh.serve import serve_rest
+
+    class _StubEnsemble:
+        qa_agents = ()
+        refiner = None
+
+        def answer(self, question):
+            return {"answer": "x"}
+
+    srv = serve_rest(_StubEnsemble(), host="127.0.0.1", port=0, block=False,
+                     registry=Registry())
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/kv/export"
+        req = urllib.request.Request(
+            url, data=_json.dumps({"question": "q"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 400
+        body = _json.load(exc.value)
+        assert body["kind"] == "kv_capability"
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Slow tier: real engines — export/import parity, structured 400s, and the
+# zero-prefill-recompute span contract
+# ---------------------------------------------------------------------------
+
+
+def _agent(max_new=12):
+    from edgemesh.agents.orchestrator import build_agent
+    from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
+
+    return build_agent(AgentSpec(
+        role="qa", model=ModelSpec(),
+        sampling=SamplingParams(max_new_tokens=max_new, do_sample=False,
+                                repetition_penalty=1.0),
+    ))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_backend", ["paged", "paged_int8"])
+@pytest.mark.parametrize("ragged", [True, False], ids=["ragged", "segmented"])
+def test_engine_export_import_greedy_parity(kv_backend, ragged):
+    """The whole-stack correctness pin: a request admitted from an imported
+    KV payload emits EXACTLY the tokens the same engine produces cold —
+    both pool precisions, both admission modes."""
+    from edgemesh.serve.continuous import ContinuousEngine
+
+    agent = _agent()
+    q = "where is the eiffel tower located in the city of paris exactly?"
+    src = ContinuousEngine(agent, slots=2, chunk=8, kv_backend=kv_backend,
+                           page_size=8, registry=Registry(), ragged=ragged)
+    dst = ContinuousEngine(agent, slots=2, chunk=8, kv_backend=kv_backend,
+                           page_size=8, registry=Registry(), ragged=ragged)
+    try:
+        direct = src.answer(q)
+        exp = src.submit_export(q).result(timeout=600)
+        assert exp["tokens"] == exp["prompt_tokens"] - 1
+        assert exp["cached"] is False
+        # The export cache serves repeats without re-prefilling.
+        assert src.submit_export(q).result(timeout=600)["cached"] is True
+        got = dst.answer(q, kv_import=exp["kv_bytes"])
+        assert got["answer"] == direct["answer"]
+        st_src, st_dst = src.stats(), dst.stats()
+        assert st_src["kv_exports"] == 2
+        assert st_dst["kv_imports"] == 1
+        assert st_dst["kv_imported_tokens"] == exp["tokens"]
+        s = dst.obs.registry.summary(prefix="edgemesh_")
+        assert s['edgemesh_prefix_remote_hits_total{engine="continuous"}'] == 1
+        key = 'edgemesh_kv_transfer_bytes_total{engine="continuous",direction="import"}'
+        assert s[key] == len(exp["kv_bytes"])
+    finally:
+        src.close()
+        dst.close()
+
+
+@pytest.mark.slow
+def test_engine_import_span_shows_zero_prefill_recompute(tmp_path):
+    """The disagg acceptance contract at engine level: the imported
+    request's prefill span computes exactly ONE token (the suffix) and
+    carries kv_import_tokens — the span phase split that proves no prefill
+    recompute happened."""
+    from edgemesh.serve.continuous import ContinuousEngine
+    from edgemesh.utils.tracing import JsonlLogger
+
+    agent = _agent()
+    q = "what is the tallest mountain on the european continent called?"
+    span_log = tmp_path / "spans.jsonl"
+    src = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged",
+                           page_size=8, registry=Registry())
+    dst = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged",
+                           page_size=8, registry=Registry(),
+                           span_log=span_log)
+    try:
+        exp = src.submit_export(q).result(timeout=600)
+        dst.answer(q, kv_import=exp["kv_bytes"])
+    finally:
+        src.close()
+        dst.close()
+    recs = [r for r in JsonlLogger(span_log).read()
+            if r.get("event") == "request_spans"]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["kv_import_tokens"] == exp["tokens"]
+    prefill = [s for s in rec["spans"] if s["name"] == "prefill"]
+    assert prefill and prefill[0]["prefill_tokens"] == 1
+    assert prefill[0].get("shared_prefix_hit") is False
+
+
+@pytest.mark.slow
+def test_engine_partial_match_import_still_correct():
+    """A payload exported for a DIFFERENT question still imports safely:
+    the token match stops at the divergence point and the rest prefills
+    locally — wrong-token KV can never graft onto a prompt."""
+    from edgemesh.serve.continuous import ContinuousEngine
+
+    agent = _agent()
+    q_a = "shared leading words then question number one please?"
+    q_b = "shared leading words then a different question two?"
+    src = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged",
+                           page_size=8, registry=Registry())
+    dst = ContinuousEngine(agent, slots=2, chunk=8, kv_backend="paged",
+                           page_size=8, registry=Registry())
+    try:
+        direct = dst.answer(q_b)
+        exp = src.submit_export(q_a).result(timeout=600)
+        got = dst.answer(q_b, kv_import=exp["kv_bytes"])
+        assert got["answer"] == direct["answer"]
+        st = dst.stats()
+        # A real (partial) match was consumed — more than zero, fewer than
+        # the full payload.
+        assert 0 < st["kv_imported_tokens"] < exp["tokens"]
+    finally:
+        src.close()
+        dst.close()
+
+
+@pytest.mark.slow
+def test_gateway_kv_transfer_roundtrip_and_structured_400(tmp_path):
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from edgemesh.agents.orchestrator import Ensemble
+    from edgemesh.serve import serve_rest
+
+    def post(url, payload, headers=None):
+        req = urllib.request.Request(
+            url, data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})})
+        try:
+            with urllib.request.urlopen(req, timeout=600) as r:
+                return r.status, _json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, _json.load(e)
+
+    agent = _agent(max_new=8)
+    srvA = serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1", port=0,
+                      block=False, continuous=True, batch=2,
+                      kv_backend="paged", kv_page_size=8, registry=Registry())
+    srvB = serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1", port=0,
+                      block=False, continuous=True, batch=2,
+                      kv_backend="paged", kv_page_size=8, registry=Registry())
+    try:
+        a = f"http://127.0.0.1:{srvA.server_address[1]}"
+        b = f"http://127.0.0.1:{srvB.server_address[1]}"
+        q = "what is the capital of france and where is it located?"
+        st, direct = post(f"{a}/generate", {"question": q})
+        assert st == 200
+        st, exp = post(f"{a}/kv/export", {"question": q})
+        assert st == 200 and exp["tokens"] == exp["prompt_tokens"] - 1
+        st, got = post(f"{b}/kv/import", {"question": q, "kv": exp["kv"]})
+        assert st == 200 and got["answer"] == direct["answer"]
+        # Corrupted payload → structured 400, never a 500.
+        st, err = post(f"{b}/kv/import", {"question": q, "kv": exp["kv"][:-8]})
+        assert st == 400 and err["kind"] == "kv_wire"
+        # Malformed base64 → 400.
+        st, err = post(f"{b}/kv/import", {"question": q, "kv": "!!nope!!"})
+        assert st == 400 and err["kind"] == "kv_wire"
+        # Version mismatch → 400 naming the version.
+        import base64
+        raw = bytearray(base64.b64decode(exp["kv"]))
+        raw[4] = 99
+        st, err = post(f"{b}/kv/import", {
+            "question": q, "kv": base64.b64encode(bytes(raw)).decode()})
+        assert st == 400 and "version" in err["error"]
+        # Expired propagated deadline → 504 before any model work.
+        st, _ = post(f"{a}/kv/export", {"question": q},
+                     headers={"X-Edgemesh-Deadline-S": "-1"})
+        assert st == 504
+        # Missing question → 400.
+        st, _ = post(f"{a}/kv/export", {})
+        assert st == 400
+    finally:
+        for s in (srvA, srvB):
+            s.shutdown()
+            if s.batcher is not None:
+                s.batcher.close()
