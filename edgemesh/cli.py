@@ -88,10 +88,17 @@ def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool =
               collective_mode: str = "psum",
               collective_dtype: str = "int8",
               flight_capacity: int | None = None,
-              flight_dir: str | None = None) -> int:
+              flight_dir: str | None = None,
+              compile_cache_dir: str | None = None) -> int:
     from edgemesh.agents import build_ensemble
     from edgemesh.serve import serve_rest
 
+    if compile_cache_dir is not None:
+        # Before the ensemble builds: model-construction compiles should
+        # hit the shared cache too, not just serving-path ones.
+        from edgemesh.utils.compat import enable_compilation_cache
+
+        enable_compilation_cache(compile_cache_dir)
     ensemble = build_ensemble(cfg)
     serve_rest(ensemble, port=port, batch=batch, continuous=continuous,
                kv_backend=kv_backend, kv_page_size=kv_page_size,
@@ -99,7 +106,8 @@ def cmd_serve(cfg: EdgeMeshConfig, port: int, batch: int = 0, continuous: bool =
                trace_sample=trace_sample, profile_dir=profile_dir,
                tp=tp, collective_mode=collective_mode,
                collective_dtype=collective_dtype,
-               flight_capacity=flight_capacity, flight_dir=flight_dir)
+               flight_capacity=flight_capacity, flight_dir=flight_dir,
+               compile_cache_dir=compile_cache_dir)
     return 0
 
 
@@ -309,6 +317,13 @@ def main(argv: list[str] | None = None) -> int:
         "accepts router-propagated incident ids via POST /incident",
     )
     top.add_argument(
+        "--compile-cache-dir", type=str, default=None,
+        help="serve: persistent XLA compilation cache directory shared "
+        "across replica spawns — a scale-up replica's compiles become "
+        "disk-cache hits, so cold-start-to-first-token is load time, not "
+        "compile time (docs/FLEET.md 'Autoscaling with warm starts')",
+    )
+    top.add_argument(
         "--profile-dir", type=str, default=None,
         help="serve: opt in GET /debug/profile?seconds=N jax.profiler "
         "captures under this directory (disabled by default — see the "
@@ -361,7 +376,8 @@ def main(argv: list[str] | None = None) -> int:
                          cmd_args.trace_sample, cmd_args.profile_dir,
                          cmd_args.tp, cmd_args.collective_mode,
                          cmd_args.collective_dtype,
-                         cmd_args.flight_capacity, cmd_args.flight_dir)
+                         cmd_args.flight_capacity, cmd_args.flight_dir,
+                         cmd_args.compile_cache_dir)
     if cmd_args.command == "bench":
         return cmd_bench(cfg, cmd_args.preset, cmd_args.precision)
     if cmd_args.command == "train":

@@ -14,6 +14,12 @@ service (docs/FLEET.md is the operator-facing reference):
 - ``router``: deadlines, bounded jittered retries, tail-latency hedging
   (fixed, percentile, or auto-tuned from a decayed latency histogram),
   admission control (503 + Retry-After), graceful drain.
+- ``autotune``: knee-tracking admission — an AIMD tuner that drives
+  ``max_inflight`` (and per-tenant rates) toward the live
+  goodput-vs-load knee instead of a static guess.
+- ``autoscale``: replica spawn/drain from the digests' arrival-rate vs
+  capacity-estimate split, with incidents as a scale-up signal and
+  warm starts off a shared persistent compilation cache.
 - ``frontend``: the HTTP listener (``/generate``, ``/fleetz``,
   ``/metrics``, runtime ``/replicas/*`` membership).
 - ``cli``: ``edgemesh fleet serve|status`` — spawn N local replicas and
@@ -32,6 +38,8 @@ from edgemesh.fleet.balancer import (  # noqa: F401
     TelemetryBalancer,
     make_balancer,
 )
+from edgemesh.fleet.autoscale import AutoScaler  # noqa: F401
+from edgemesh.fleet.autotune import KneeTracker  # noqa: F401
 from edgemesh.fleet.frontend import serve_fleet  # noqa: F401
 from edgemesh.fleet.health import HealthProber  # noqa: F401
 from edgemesh.fleet.registry import Replica, ReplicaRegistry  # noqa: F401

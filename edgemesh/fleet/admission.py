@@ -77,6 +77,26 @@ class TokenBucket:
             return min(self.burst,
                        self._tokens + (t - self._last) * self.rate_per_s)
 
+    def rescale(self, rate_per_s: float, burst: float | None = None) -> None:
+        """Retune the bucket IN PLACE, preserving the current token level
+        (clamped to the new burst). The knee tracker retunes on every
+        control action — rebuilding the bucket would refund a full burst
+        each time, which under a steady tuning ramp disables the rate
+        limit entirely."""
+        if rate_per_s <= 0:
+            raise ValueError(f"rate_per_s must be > 0, got {rate_per_s}")
+        with self._lock:
+            t = self._now()
+            self._tokens = min(
+                self.burst, self._tokens + (t - self._last) * self.rate_per_s
+            )
+            self._last = t
+            self.rate_per_s = float(rate_per_s)
+            self.burst = (
+                float(burst) if burst is not None else max(1.0, rate_per_s)
+            )
+            self._tokens = min(self._tokens, self.burst)
+
 
 @dataclass(frozen=True)
 class TenantPolicy:
@@ -164,6 +184,10 @@ class AdmissionController:
         self._now = now
         self._cond = threading.Condition()
         self._inflight = 0
+        # Knee-tracker seam (fleet/autotune.py): configured tenant rates
+        # scale with the tuned limit so a measured-down fleet tightens
+        # every bucket proportionally. 1.0 = rates as configured.
+        self._rate_scale = 1.0  # guarded by: _cond
         self._buckets: dict[str, TokenBucket] = {}
         self._vtime: dict[str, float] = {}
         self._queues: dict[str, deque[_Waiter]] = {}
@@ -181,10 +205,42 @@ class AdmissionController:
         with self._cond:
             bucket = self._buckets.get(tenant)
             if bucket is None:
+                scale = self._rate_scale
                 bucket = self._buckets[tenant] = TokenBucket(
-                    pol.rate_per_s, pol.burst, now=self._now
+                    pol.rate_per_s * scale,
+                    None if pol.burst is None else pol.burst * scale,
+                    now=self._now,
                 )
         return bucket
+
+    # -- knee-tracker seams (fleet/autotune.py) -------------------------------
+
+    def set_max_inflight(self, n: int) -> None:
+        """Retune the slot pool live. Growing it immediately grants queued
+        waiters (the freed-capacity path); shrinking it never revokes a
+        granted slot — in-flight work finishes, and the pool drains down to
+        the new bound as requests release."""
+        with self._cond:
+            self.max_inflight = max(1, int(n))
+            self._grant_locked()
+
+    def set_rate_scale(self, scale: float) -> None:
+        """Scale every configured tenant rate by ``scale`` (1.0 = as
+        configured). Existing buckets rescale IN PLACE — their current
+        token level survives (clamped to the new burst), so a tuner
+        adjusting every window cannot refund anyone a fresh burst per
+        action. Unlimited tenants (rate 0) stay unlimited."""
+        scale = max(1e-6, float(scale))
+        with self._cond:
+            if scale == self._rate_scale:
+                return
+            self._rate_scale = scale
+            for tenant, bucket in self._buckets.items():
+                pol = self.policy_for(tenant)
+                bucket.rescale(
+                    pol.rate_per_s * scale,
+                    None if pol.burst is None else pol.burst * scale,
+                )
 
     # -- the admission verdict ----------------------------------------------
 
@@ -280,6 +336,7 @@ class AdmissionController:
             return {
                 "max_inflight": self.max_inflight,
                 "inflight": self._inflight,
+                "rate_scale": round(self._rate_scale, 4),
                 "queue_cap": self.queue_cap,
                 "waiting": {
                     t: sum(1 for w in q if not w.abandoned)

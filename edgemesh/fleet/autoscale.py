@@ -1,0 +1,311 @@
+"""Replica autoscaling driven by the measured capacity model.
+
+The load digests now carry both sides of the scaling question
+(docs/OBSERVABILITY.md "The capacity model"): the ARRIVAL rate each
+replica is seeing (``ewma_arrival_s`` — offered load, independent of how
+service keeps up) and the live CAPACITY estimate (``capacity.est_req_s`` —
+sustainable req/s from the service EWMAs). The :class:`AutoScaler` closes
+the ROADMAP "self-driving fleet" loop on them: fleet utilization =
+observed demand / estimated supply, scaled up past ``high_watermark`` and
+down below ``low_watermark``, with streak requirements and cooldowns so a
+burst or a single noisy digest never churns processes.
+
+Two design points worth stating:
+
+- **Incidents scale UP.** A propagated incident (obs/anomaly.py → the
+  router's ``observe_incident``) means a replica is degrading: the
+  surviving fleet is about to be short its capacity, and waiting for the
+  utilization math to notice the queue growth wastes exactly the seconds
+  a warm start saves. ``note_incident`` requests an immediate spawn
+  (bounded by ``max_replicas`` and the incident's own cooldown).
+- **Cold start is the binding constraint**, so the scaler is built around
+  warm starts: the launcher it drives (fleet/cli.py
+  ``SubprocessLauncher``) spawns every replica against one persistent XLA
+  compilation cache (``--compile-cache-dir``), measures
+  spawn→ready→first-token, and pins the split as
+  ``edgemesh_cold_start_seconds{phase}`` — the number PERFORMANCE.md
+  budgets and the ``cold_start`` bench stage tracks.
+
+The launcher contract is three methods — ``spawn() -> rid`` (may complete
+registration asynchronously), ``stop(rid)``, ``pending() -> int`` (spawns
+in flight, counted toward the replica bound so one slow boot cannot
+trigger a second) — so tests drive the control law with a fake and the
+CLI provides the subprocess reality.
+
+No jax imports (the router-stack contract); the clock is injectable.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+log = logging.getLogger("edgemesh.fleet")
+
+
+class AutoScaler:
+    """Demand/supply scaling over the registry's live digests.
+
+    ``evaluate()`` is one control pass — the background loop calls it on
+    ``interval_s``, tests call it directly. Scale-down drains through the
+    router (zero dropped requests) and purges via ``forget_replica``, so
+    a scaled-down replica leaves no stale digest or tier ghost behind.
+    """
+
+    def __init__(self, registry, launcher, router=None,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 high_watermark: float = 0.8, low_watermark: float = 0.3,
+                 up_after: int = 2, down_after: int = 5,
+                 cooldown_s: float = 20.0, incident_cooldown_s: float = 60.0,
+                 interval_s: float = 2.0,
+                 neutral_service_s: float = 0.1,
+                 obs_registry=None, now=time.monotonic) -> None:
+        from edgemesh.obs import get_registry
+
+        if min_replicas < 1:
+            raise ValueError(f"min_replicas must be >= 1, got {min_replicas}")
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas must be >= min_replicas, got "
+                f"{max_replicas} < {min_replicas}")
+        if not 0.0 <= low_watermark < high_watermark:
+            raise ValueError(
+                f"need 0 <= low_watermark < high_watermark, got "
+                f"{low_watermark} / {high_watermark}")
+        self.registry = registry
+        self.launcher = launcher
+        self.router = router
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.high_watermark = float(high_watermark)
+        self.low_watermark = float(low_watermark)
+        self.up_after = int(up_after)
+        self.down_after = int(down_after)
+        self.cooldown_s = float(cooldown_s)
+        self.incident_cooldown_s = float(incident_cooldown_s)
+        self.interval_s = float(interval_s)
+        # A replica whose digest carries no capacity estimate yet (cold,
+        # or non-continuous) is credited slots/neutral_service_s — the
+        # same neutral assumption the telemetry balancer falls back to,
+        # so a cold fleet is never scored as zero supply.
+        self.neutral_service_s = float(neutral_service_s)
+        self._now = now
+        self._lock = threading.Lock()
+        self._high_streak = 0  # guarded by: _lock
+        self._low_streak = 0  # guarded by: _lock
+        self._last_action_ts: float | None = None  # guarded by: _lock
+        self._last_incident_ts: float | None = None  # guarded by: _lock
+        self._want_incident_up: dict | None = None  # guarded by: _lock
+        self._last_eval: dict | None = None  # guarded by: _lock
+        self._events: list[dict] = []  # guarded by: _lock
+        reg = obs_registry or get_registry()
+        self._events_total = reg.counter(
+            "edgemesh_autoscale_events_total",
+            "Autoscaler actions", ("action",),
+        )
+        self._replicas_gauge = reg.gauge(
+            "edgemesh_autoscale_replicas",
+            "Routable replicas + spawns in flight, as the scaler sees them",
+        )
+        self._util_gauge = reg.gauge(
+            "edgemesh_autoscale_utilization_ratio",
+            "Observed fleet demand / estimated fleet capacity",
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- signals -------------------------------------------------------------
+
+    def note_incident(self, incident: dict) -> bool:
+        """A propagated incident is a scale-up signal (ROADMAP item): flag
+        it for the next control pass (never spawn on the caller's thread —
+        this is invoked from the router, which is invoked from the
+        prober). Bounded by its own cooldown so one incident's fan-out
+        cannot spawn a replica per probe tick."""
+        with self._lock:
+            now = self._now()
+            if (self._last_incident_ts is not None
+                    and now - self._last_incident_ts < self.incident_cooldown_s):
+                return False
+            self._last_incident_ts = now
+            self._want_incident_up = dict(incident or {})
+        return True
+
+    # -- one control pass ----------------------------------------------------
+
+    def _demand_supply(self) -> tuple[float, float, int]:
+        """(demand_rps, supply_rps, routable_count) from the live digests."""
+        demand = 0.0
+        supply = 0.0
+        routable = 0
+        for rep in self.registry.replicas():
+            if not rep.routable():
+                continue
+            routable += 1
+            load = rep.load if isinstance(rep.load, dict) else {}
+            arrival = load.get("ewma_arrival_s")
+            if arrival:
+                demand += 1.0 / arrival
+            cap = load.get("capacity") if isinstance(load.get("capacity"), dict) else {}
+            est = cap.get("est_req_s")
+            if est:
+                supply += est
+            else:
+                slots = cap.get("slots") or 1
+                supply += slots / self.neutral_service_s
+        return demand, supply, routable
+
+    def evaluate(self) -> dict | None:
+        """One control pass; returns the action taken (or None). Spawns
+        and drains run inline — callers that must not block (the router's
+        incident path) go through :meth:`note_incident` instead."""
+        demand, supply, routable = self._demand_supply()
+        util = demand / supply if supply > 0 else 0.0
+        pending = self.launcher.pending()
+        live = routable + pending
+        self._replicas_gauge.set(float(live))
+        self._util_gauge.set(round(util, 4))
+        action: dict | None = None
+        with self._lock:
+            now = self._now()
+            incident = self._want_incident_up
+            self._want_incident_up = None
+            cooling = (self._last_action_ts is not None
+                       and now - self._last_action_ts < self.cooldown_s)
+            if incident is not None and live < self.max_replicas:
+                self._last_action_ts = now
+                self._high_streak = self._low_streak = 0
+                action = {"action": "incident_up",
+                          "incident": incident.get("id"),
+                          "kind": incident.get("kind")}
+            elif util >= self.high_watermark:
+                self._low_streak = 0
+                self._high_streak += 1
+                if (not cooling and self._high_streak >= self.up_after
+                        and live < self.max_replicas):
+                    self._last_action_ts = now
+                    self._high_streak = 0
+                    action = {"action": "up"}
+            elif util <= self.low_watermark:
+                self._high_streak = 0
+                self._low_streak += 1
+                if (not cooling and self._low_streak >= self.down_after
+                        and routable > self.min_replicas and pending == 0):
+                    # Confirm a reapable victim BEFORE stamping the
+                    # cooldown: a fleet of boot-time replicas the launcher
+                    # does not own yields none, and a phantom "down" that
+                    # consumed the cooldown would block a genuine
+                    # scale-up right after. (Lock order: _lock → the
+                    # registry's; nothing takes them reversed.)
+                    victim = self._pick_victim()
+                    if victim is not None:
+                        self._last_action_ts = now
+                        self._low_streak = 0
+                        action = {"action": "down", "replica": victim}
+            else:
+                self._high_streak = self._low_streak = 0
+            self._last_eval = {
+                "demand_rps": round(demand, 3),
+                "supply_rps": round(supply, 3),
+                "utilization": round(util, 4),
+                "routable": routable, "pending": pending,
+            }
+        if action is None:
+            return None
+        action.update(self._last_eval or {})
+        if action["action"] == "down":
+            self._drain_and_stop(action["replica"])
+        else:
+            try:
+                action["replica"] = self.launcher.spawn()
+            except Exception as e:
+                log.exception("autoscale spawn failed")
+                action["error"] = str(e)[:200]
+        self._events_total.labels(action=action["action"]).inc()
+        with self._lock:
+            self._events.append(action)
+            del self._events[:-16]
+        log.info("autoscale %s (util=%.2f demand=%.2f supply=%.2f)",
+                 action["action"], util, demand, supply)
+        return action
+
+    def _pick_victim(self) -> str | None:
+        """Least-loaded routable replica: fewest outstanding, then lowest
+        observed arrival rate — the drain that displaces the least work.
+        When the launcher reports ownership (``owns(rid)``), only
+        launcher-owned replicas are eligible: draining a boot-time
+        replica the launcher cannot actually STOP would leave a drained
+        zombie process holding a resident model — the scale-down would
+        free nothing."""
+        owns = getattr(self.launcher, "owns", None)
+        candidates = []
+        for rep in self.registry.replicas():
+            if not rep.routable():
+                continue
+            if owns is not None and not owns(rep.rid):
+                continue
+            load = rep.load if isinstance(rep.load, dict) else {}
+            arrival = load.get("ewma_arrival_s")
+            rate = (1.0 / arrival) if arrival else 0.0
+            candidates.append((rep.outstanding, rate, rep.rid))
+        if not candidates:
+            return None
+        return min(candidates)[2]
+
+    def _drain_and_stop(self, rid: str) -> None:
+        if self.router is not None:
+            self.router.drain_replica(rid)
+            self.router.forget_replica(rid)
+        else:
+            self.registry.deregister(rid)
+        try:
+            self.launcher.stop(rid)
+        except Exception:
+            log.exception("autoscale stop of %s failed", rid)
+
+    # -- background loop (same lifecycle shape as HealthProber) --------------
+
+    def start(self) -> "AutoScaler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.interval_s + 1.0)
+            if not t.is_alive():
+                self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.evaluate()
+            except Exception:  # a control pass must never kill the loop
+                log.exception("autoscale evaluate failed")
+            self._stop.wait(self.interval_s)
+
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> dict:
+        """Live scaler state for ``/fleetz`` (``"autoscale"``)."""
+        with self._lock:
+            return {
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "high_watermark": self.high_watermark,
+                "low_watermark": self.low_watermark,
+                "cooldown_s": self.cooldown_s,
+                "last_eval": (
+                    dict(self._last_eval)
+                    if self._last_eval is not None else None
+                ),
+                "recent_events": [dict(e) for e in self._events[-8:]],
+            }

@@ -1,0 +1,309 @@
+"""Knee-tracking admission: ``max_inflight`` tuned by measurement.
+
+PR 9's load observatory made the goodput-vs-offered-load curve and its
+saturation knee a measurable object; this module is the first consumer that
+CLOSES the loop (ROADMAP "self-driving fleet"). The router's static
+``max_inflight`` is an operator guess standing in for a measured quantity:
+the concurrency at which goodput peaks. Too high, queueing delay eats the
+SLO budget past the knee; too low, the fleet sheds work it could have
+served. The :class:`KneeTracker` replaces the guess with an online AIMD
+controller fed by the router's own per-window observations:
+
+- Every routed request reports ``(answered, good)`` — "good" is the
+  router-observed response-latency SLO the per-tenant accounting already
+  computes. Windows of ``window_s`` close into one curve point
+  ``{offered_rps, goodput_rps}``, appended to a bounded history that
+  :func:`edgemesh.loadgen.curve.find_knee` — the SAME math the offline
+  ``load_curve`` bench stage uses — turns into a live knee estimate.
+- **Additive increase**: after ``patience`` consecutive windows at or
+  above ``goodput_target``, the limit grows by ``increase`` per window
+  (up to ``ceiling``) — headroom is probed, never assumed.
+- **Multiplicative decrease**: after ``patience`` consecutive BAD windows
+  (the ANSWERED requests' SLO-good ratio below the hysteresis band —
+  queueing delay eating the budget is the limit-too-high signal; sheds
+  stay out of this ratio or sustained open-loop overload would read the
+  correct limit as a bad one — or offered load past the live knee with
+  window goodput collapsed more than ``collapse_tolerance`` below the
+  knee's), the limit cuts to ``decrease`` of itself, floored at
+  ``floor`` — the fleet must never be tuned into refusing all work.
+- The band between good and bad is a DEAD ZONE: windows there reset both
+  streaks, so oscillating arrivals straddling the target hold the limit
+  steady instead of flapping it (the hysteresis the tests pin).
+- **Incident freeze**: a propagated incident (obs/anomaly.py → the
+  router's ``observe_incident``) freezes tuning for ``freeze_s`` —
+  degraded-fleet windows are measurements of the incident, not of the
+  limit, and acting on them would chase the failure downward.
+
+Per-tenant rate limits scale WITH the limit: ``rate_scale`` =
+limit / initial limit, applied through
+:meth:`~edgemesh.fleet.admission.AdmissionController.set_rate_scale`, so a
+tuned-down fleet tightens every configured tenant bucket proportionally
+instead of letting one tenant's static rate override the measured
+capacity.
+
+No jax imports (the router-stack contract); the clock is injectable so
+tests drive synthetic curves deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from edgemesh.loadgen.curve import find_knee
+
+TUNE_RECORD_EVENT = "admission_tune"
+
+
+class KneeTracker:
+    """Online AIMD tuner for :class:`~edgemesh.fleet.admission.
+    AdmissionController.max_inflight`, tracking the live saturation knee.
+
+    ``admission`` is the controller to drive; ``log`` is an optional
+    ``JsonlLogger``-shaped sink (the router passes its span log) that gets
+    one ``admission_tune`` record per adjustment — the postmortem/`obs
+    summary` trail of what the controller did and why.
+    """
+
+    def __init__(self, admission, floor: int = 2, ceiling: int = 256,
+                 window_s: float = 2.0, increase: int = 1,
+                 decrease: float = 0.7, goodput_target: float = 0.9,
+                 bad_band: float = 0.15, collapse_tolerance: float = 0.1,
+                 patience: int = 2, history: int = 32,
+                 freeze_s: float = 30.0, min_window_requests: int = 4,
+                 obs_registry=None, log=None,
+                 now=time.monotonic) -> None:
+        from edgemesh.obs import get_registry
+
+        if floor < 1:
+            raise ValueError(f"floor must be >= 1, got {floor}")
+        if ceiling < floor:
+            raise ValueError(
+                f"ceiling must be >= floor, got {ceiling} < {floor}")
+        if not 0.0 < decrease < 1.0:
+            raise ValueError(f"decrease must be in (0, 1), got {decrease}")
+        self.admission = admission
+        self.floor = int(floor)
+        self.ceiling = int(ceiling)
+        self.window_s = float(window_s)
+        self.increase = int(increase)
+        self.decrease = float(decrease)
+        self.goodput_target = float(goodput_target)
+        self.bad_band = float(bad_band)
+        self.collapse_tolerance = float(collapse_tolerance)
+        self.patience = int(patience)
+        self.freeze_s = float(freeze_s)
+        self.min_window_requests = int(min_window_requests)
+        self._now = now
+        self._log = log
+        # The configured limit is just the controller's starting point —
+        # clamp it into [floor, ceiling] immediately (a default
+        # max_inflight above the ceiling would otherwise serve out-of-band
+        # until the first decrease).
+        start = min(self.ceiling, max(self.floor, int(admission.max_inflight)))
+        if start != admission.max_inflight:
+            admission.set_max_inflight(start)
+        # The initial limit anchors the per-tenant rate scale:
+        # scale = limit / initial, so configured tenant rates stretch and
+        # shrink with the measured capacity.
+        self._initial_limit = start
+        self._lock = threading.Lock()
+        self._window_start: float | None = None  # guarded by: _lock
+        self._requests = 0  # guarded by: _lock
+        self._answered = 0  # guarded by: _lock
+        self._good = 0  # guarded by: _lock
+        self._shed = 0  # guarded by: _lock
+        self._good_streak = 0  # guarded by: _lock
+        self._bad_streak = 0  # guarded by: _lock
+        self._frozen_until: float | None = None  # guarded by: _lock
+        self._freezes = 0  # guarded by: _lock
+        self._windows = 0  # guarded by: _lock
+        self._points: deque[dict] = deque(maxlen=max(4, int(history)))  # guarded by: _lock
+        self._knee: dict = find_knee([])  # guarded by: _lock
+        self._last_window: dict | None = None  # guarded by: _lock
+        reg = obs_registry or get_registry()
+        self._limit_gauge = reg.gauge(
+            "edgemesh_admission_limit",
+            "Live max_inflight the knee tracker has tuned to",
+        )
+        self._knee_gauge = reg.gauge(
+            "edgemesh_admission_knee_rps",
+            "Offered load at the tracker's live knee estimate",
+        )
+        self._actions = reg.counter(
+            "edgemesh_admission_tuner_total",
+            "Knee-tracker control actions", ("action",),
+        )
+        self._limit_gauge.set(float(admission.max_inflight))
+
+    # -- feeding -------------------------------------------------------------
+
+    def observe(self, answered: bool, good: bool, shed: bool = False) -> None:
+        """One routed request's fate, from the router's accounting seam:
+        ``answered`` = a replica answered 200, ``good`` = answered within
+        the SLO budget, ``shed`` = refused at admission. Closes the window
+        and acts when its span has elapsed."""
+        actions = None
+        with self._lock:
+            now = self._now()
+            if self._window_start is None:
+                self._window_start = now
+            self._requests += 1
+            if answered:
+                self._answered += 1
+            if good:
+                self._good += 1
+            if shed:
+                self._shed += 1
+            if now - self._window_start >= self.window_s:
+                actions = self._close_window_locked(now)
+        if actions:
+            self._emit(actions)
+
+    def freeze(self, reason: str = "incident") -> None:
+        """Stop tuning for ``freeze_s``: incident windows measure the
+        incident, not the limit. Observation continues (the curve history
+        stays honest); only control actions pause."""
+        with self._lock:
+            self._frozen_until = self._now() + self.freeze_s
+            self._freezes += 1
+            self._good_streak = self._bad_streak = 0
+        self._actions.labels(action="freeze").inc()
+        self._emit([{"action": "freeze", "reason": reason,
+                     "limit": self.admission.max_inflight}])
+
+    # -- the control law -----------------------------------------------------
+
+    def _close_window_locked(self, now: float) -> list[dict]:  # guarded by: _lock
+        span = max(1e-9, now - self._window_start)
+        offered = self._requests / span
+        goodput = self._good / span
+        # The control ratio judges ANSWERED requests only: it measures
+        # whether the current limit's queueing delay eats the SLO budget
+        # (limit too HIGH). Sheds deliberately stay out of it — under
+        # sustained open-loop overload the excess arrivals shed no matter
+        # where the limit sits, and counting them would read the correct
+        # limit as a bad one and slam the controller to the floor. Sheds
+        # still cost goodput_rps, so the CURVE (and its knee) stays the
+        # honest open-loop measurement.
+        ratio = (
+            self._good / self._answered if self._answered else None
+        )
+        window = {
+            "offered_rps": round(offered, 4),
+            "goodput_rps": round(goodput, 4),
+            "goodput_ratio": None if ratio is None else round(ratio, 4),
+            "requests": self._requests,
+            "answered": self._answered,
+            "shed": self._shed,
+        }
+        thin = self._requests < self.min_window_requests
+        self._requests = self._answered = self._good = self._shed = 0
+        self._window_start = now
+        self._windows += 1
+        self._last_window = window
+        if not thin:
+            self._points.append({"offered_rps": window["offered_rps"],
+                                 "goodput_rps": window["goodput_rps"]})
+            self._knee = find_knee(list(self._points))
+        if self._knee.get("knee_offered_rps") is not None:
+            self._knee_gauge.set(self._knee["knee_offered_rps"])
+        frozen = (self._frozen_until is not None
+                  and now < self._frozen_until)
+        if frozen or thin:
+            # Frozen: measured, not acted on. Thin: a near-idle window says
+            # nothing about the knee — growing the limit on silence would
+            # ratchet it to the ceiling overnight for free.
+            self._good_streak = self._bad_streak = 0
+            return []
+        # The collapse signal: offered load past the live knee with window
+        # goodput more than collapse_tolerance below the knee's is the
+        # overload regime even when the ratio alone looks tolerable.
+        knee = self._knee
+        collapsed = (
+            knee.get("knee_offered_rps") is not None
+            and offered > knee["knee_offered_rps"]
+            and goodput < (1.0 - self.collapse_tolerance) * (
+                knee.get("knee_goodput_rps") or 0.0)
+        )
+        if ratio is None:
+            # No answered requests this window: zero evidence about the
+            # limit's service quality — dead zone, like thin windows.
+            self._good_streak = self._bad_streak = 0
+            return []
+        bad = collapsed or ratio < self.goodput_target - self.bad_band
+        good_w = (not bad) and ratio >= self.goodput_target
+        actions: list[dict] = []
+        limit = self.admission.max_inflight
+        if good_w:
+            self._good_streak += 1
+            self._bad_streak = 0
+            if self._good_streak >= self.patience and limit < self.ceiling:
+                new = min(self.ceiling, limit + self.increase)
+                actions.append(self._apply_locked("increase", new, window))
+        elif bad:
+            self._bad_streak += 1
+            self._good_streak = 0
+            if self._bad_streak >= self.patience and limit > self.floor:
+                new = max(self.floor, int(limit * self.decrease))
+                if new < limit:
+                    actions.append(
+                        self._apply_locked("decrease", new, window))
+                self._bad_streak = 0  # wait for post-cut evidence
+        else:
+            # Dead zone between the target and the bad band: hysteresis.
+            # Oscillating arrivals that straddle the target park here and
+            # the limit holds instead of flapping.
+            self._good_streak = self._bad_streak = 0
+        return actions
+
+    def _apply_locked(self, action: str, new_limit: int,
+                      window: dict) -> dict:  # guarded by: _lock
+        self.admission.set_max_inflight(new_limit)
+        scale = new_limit / self._initial_limit
+        self.admission.set_rate_scale(scale)
+        self._limit_gauge.set(float(new_limit))
+        self._actions.labels(action=action).inc()
+        return {
+            "action": action, "limit": new_limit,
+            "rate_scale": round(scale, 4), "window": window,
+            "knee_offered_rps": self._knee.get("knee_offered_rps"),
+            "knee_goodput_rps": self._knee.get("knee_goodput_rps"),
+            "collapsed": self._knee.get("collapsed"),
+        }
+
+    def _emit(self, actions: list[dict]) -> None:
+        if self._log is None:
+            return
+        for rec in actions:
+            try:
+                self._log.log(TUNE_RECORD_EVENT, **rec)
+            except Exception:  # telemetry must never break routing
+                pass
+
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> dict:
+        """Live tuner state for ``/fleetz`` (under ``admission.tuner``)."""
+        with self._lock:
+            now = self._now()
+            frozen = (self._frozen_until is not None
+                      and now < self._frozen_until)
+            return {
+                "mode": "auto",
+                "limit": self.admission.max_inflight,
+                "floor": self.floor,
+                "ceiling": self.ceiling,
+                "window_s": self.window_s,
+                "windows": self._windows,
+                "frozen": frozen,
+                "freezes": self._freezes,
+                "rate_scale": round(
+                    self.admission.max_inflight / self._initial_limit, 4),
+                "knee": dict(self._knee),
+                "last_window": (
+                    dict(self._last_window)
+                    if self._last_window is not None else None
+                ),
+            }

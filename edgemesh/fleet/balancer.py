@@ -234,6 +234,16 @@ class TierManager:
         with self._lock:
             self._cached_ts = None
 
+    def forget(self, rid: str) -> None:
+        """Purge one replica's tier membership (deregister/removal —
+        fleet/router.py ``forget_replica``): its hysteresis incumbency
+        must not survive into a re-registered incarnation, and the cached
+        assignment that may still hold the dead Replica object drops."""
+        with self._lock:
+            if rid in self._prefill_rids:
+                self._prefill_rids = self._prefill_rids - {rid}
+            self._cached_ts = None
+
     def assign(self, replicas: Sequence) -> dict:
         """``{"prefill": [...], "decode": [...]}`` over the routable subset
         of ``replicas``. Never raises; an un-tierable fleet comes back with

@@ -26,6 +26,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 log = logging.getLogger("edgemesh.fleet")
@@ -65,6 +66,38 @@ def build_parser() -> argparse.ArgumentParser:
                      "live p95 of a time-decayed latency histogram "
                      "(docs/FLEET.md 'Adaptive routing')")
     srv.add_argument("--max-inflight", type=int, default=64)
+    srv.add_argument("--admission", default="static",
+                     choices=["static", "auto"],
+                     help="'auto' = knee-tracking admission: max_inflight "
+                     "(and per-tenant rates) auto-tune toward the live "
+                     "goodput-vs-load knee instead of the static "
+                     "--max-inflight guess (docs/FLEET.md 'Knee-tracking "
+                     "admission')")
+    srv.add_argument("--admission-floor", type=int, default=2,
+                     help="--admission auto: the tuner never cuts "
+                     "max_inflight below this")
+    srv.add_argument("--admission-ceiling", type=int, default=256,
+                     help="--admission auto: the tuner never grows "
+                     "max_inflight above this")
+    srv.add_argument("--autoscale", action="store_true",
+                     help="drive replica spawn/drain from the live load "
+                     "digests (arrival rate vs capacity estimate) and "
+                     "scale up on propagated incidents; spawned replicas "
+                     "warm-start from --compile-cache-dir (docs/FLEET.md "
+                     "'Autoscaling with warm starts')")
+    srv.add_argument("--min-replicas", type=int, default=0,
+                     help="--autoscale floor (default: the initial "
+                     "--replicas count)")
+    srv.add_argument("--max-replicas", type=int, default=0,
+                     help="--autoscale ceiling (default: 2x the initial "
+                     "--replicas count)")
+    srv.add_argument("--autoscale-cooldown-s", type=float, default=20.0,
+                     help="minimum seconds between autoscale actions")
+    srv.add_argument("--compile-cache-dir", default=None,
+                     help="persistent XLA compilation cache shared by "
+                     "every replica spawn (passed to each `edgemesh serve` "
+                     "subprocess): scale-up replicas compile from disk "
+                     "hits, so cold-start-to-first-token is seconds")
     srv.add_argument("--tiered", action="store_true",
                      help="prefill/decode disaggregation: long prefills "
                      "route to prefill-tier replicas and their KV streams "
@@ -131,6 +164,20 @@ def _free_ports(n: int) -> list[int]:
             s.close()
 
 
+def _replica_cmd(args, port: int) -> list[str]:
+    """One replica's `edgemesh serve` command line — shared by the boot
+    spawn and the autoscaler's launcher so a scale-up replica is
+    configured identically to the originals (including the shared
+    compilation cache, which is what makes its start warm)."""
+    cmd = [sys.executable, "-m", "edgemesh.cli", "serve", "--port", str(port)]
+    if args.config:
+        cmd += ["--config", args.config]
+    if getattr(args, "compile_cache_dir", None):
+        cmd += ["--compile-cache-dir", args.compile_cache_dir]
+    cmd += args.replica_extra.split()
+    return cmd
+
+
 def _spawn_replicas(args) -> list[tuple[str, int, subprocess.Popen]]:
     if args.replica_port_base:
         ports = [args.replica_port_base + i for i in range(args.replicas)]
@@ -138,14 +185,140 @@ def _spawn_replicas(args) -> list[tuple[str, int, subprocess.Popen]]:
         ports = _free_ports(args.replicas)
     procs: list[tuple[str, int, subprocess.Popen]] = []
     for i, port in enumerate(ports):
-        cmd = [sys.executable, "-m", "edgemesh.cli", "serve", "--port", str(port)]
-        if args.config:
-            cmd += ["--config", args.config]
-        cmd += args.replica_extra.split()
-        proc = subprocess.Popen(cmd, env=os.environ.copy())
+        proc = subprocess.Popen(_replica_cmd(args, port), env=os.environ.copy())
         procs.append((f"replica-{i}", port, proc))
         log.info("spawned %s on port %d (pid %d)", f"replica-{i}", port, proc.pid)
     return procs
+
+
+class SubprocessLauncher:
+    """The autoscaler's spawn/stop seam over real `edgemesh serve`
+    subprocesses (fleet/autoscale.py documents the contract).
+
+    ``spawn`` is NON-blocking: the subprocess starts immediately and a
+    waiter thread registers it with the registry once ``/readyz`` answers,
+    then fires one warmup ``/generate`` — stamping the spawn→ready and
+    spawn→first-token walls into ``edgemesh_cold_start_seconds{phase}``,
+    the cold-start telemetry the warm-start story is judged by. Until
+    registration lands the spawn counts in ``pending()``, which the
+    scaler adds to the replica bound so one slow boot cannot trigger a
+    second."""
+
+    def __init__(self, args, registry, transport, obs_registry=None,
+                 boot_timeout_s: float = 300.0) -> None:
+        from edgemesh.obs import get_registry
+
+        self.args = args
+        self.registry = registry
+        self.transport = transport
+        self.boot_timeout_s = boot_timeout_s
+        self._lock = threading.Lock()
+        self._n = 0  # guarded by: _lock
+        self._pending = 0  # guarded by: _lock
+        self.procs: dict[str, subprocess.Popen] = {}  # guarded by: _lock
+        reg = obs_registry or get_registry()
+        self._cold_start = reg.histogram(
+            "edgemesh_cold_start_seconds",
+            "Replica spawn wall time, by phase (ready = /readyz 200; "
+            "first_token = warmup /generate answered)", ("phase",),
+        )
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def owns(self, rid: str) -> bool:
+        """Scale-down eligibility: the scaler may only reap processes this
+        launcher spawned — boot-time replicas belong to cmd_serve's
+        lifecycle and a drain the launcher cannot follow with a stop
+        would leave a zombie out of rotation."""
+        with self._lock:
+            return rid in self.procs
+
+    def spawn(self) -> str:
+        from edgemesh.fleet.transport import TransportError
+
+        port = _free_ports(1)[0]
+        with self._lock:
+            self._n += 1
+            rid = f"replica-scale-{self._n}"
+            self._pending += 1
+        t0 = time.monotonic()
+        proc = subprocess.Popen(_replica_cmd(self.args, port),
+                                env=os.environ.copy())
+        with self._lock:
+            self.procs[rid] = proc
+        log.info("autoscale spawning %s on port %d (pid %d)", rid, port,
+                 proc.pid)
+
+        def wait_ready():
+            url = f"http://127.0.0.1:{port}"
+            deadline = time.monotonic() + self.boot_timeout_s
+            try:
+                while time.monotonic() < deadline:
+                    if proc.poll() is not None:
+                        log.error("%s exited rc=%s during boot", rid,
+                                  proc.returncode)
+                        with self._lock:
+                            self.procs.pop(rid, None)
+                        return
+                    try:
+                        status, _ = self.transport.get_json(
+                            f"{url}/readyz", timeout_s=2.0)
+                    except TransportError:
+                        time.sleep(0.25)
+                        continue
+                    if status == 200:
+                        break
+                    time.sleep(0.25)
+                else:
+                    # Reap the straggler: a replica still booting past the
+                    # timeout would otherwise live on unregistered — out
+                    # of rotation, holding a resident model — while
+                    # pending() drops and the scaler spawns another.
+                    log.error("%s never became ready — stopping it", rid)
+                    self.stop(rid)
+                    return
+                self._cold_start.labels(phase="ready").observe(
+                    time.monotonic() - t0)
+                # First token before rotation: the warmup pays any residual
+                # compile OFF the request path, and the wall it measures IS
+                # cold-start-to-first-token (docs/PERFORMANCE.md).
+                try:
+                    self.transport.post_json(
+                        f"{url}/generate", {"question": "autoscale warmup?"},
+                        timeout_s=max(60.0, self.boot_timeout_s))
+                    self._cold_start.labels(phase="first_token").observe(
+                        time.monotonic() - t0)
+                except TransportError as e:
+                    log.warning("%s warmup failed: %s", rid, e)
+                self.registry.register(rid, url, pid=proc.pid)
+                log.info("autoscale %s ready in %.1fs", rid,
+                         time.monotonic() - t0)
+            finally:
+                with self._lock:
+                    self._pending -= 1
+
+        threading.Thread(target=wait_ready, name=f"spawn-{rid}",
+                         daemon=True).start()
+        return rid
+
+    def stop(self, rid: str) -> None:
+        with self._lock:
+            proc = self.procs.pop(rid, None)
+        if proc is None or proc.poll() is not None:
+            return
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def stop_all(self) -> None:
+        with self._lock:
+            rids = list(self.procs)
+        for rid in rids:
+            self.stop(rid)
 
 
 def _wait_ready(transport, procs, boot_timeout_s: float) -> None:
@@ -229,6 +402,9 @@ def cmd_serve(args) -> int:
             hedge_auto=args.hedge_auto,
             max_inflight=args.max_inflight,
             admission=admission,
+            admission_auto=args.admission == "auto",
+            admission_floor=args.admission_floor,
+            admission_ceiling=args.admission_ceiling,
             admission_wait_s=args.admission_wait_s,
             span_log=args.span_log,
             trace_sample=args.trace_sample,
@@ -236,6 +412,25 @@ def cmd_serve(args) -> int:
             tier_manager=tier_manager,
             prefill_threshold_chars=args.prefill_threshold_chars,
         )
+        scaler = None
+        if args.autoscale:
+            from edgemesh.fleet.autoscale import AutoScaler
+
+            launcher = SubprocessLauncher(
+                args, registry, transport, obs_registry=router.obs,
+                boot_timeout_s=args.boot_timeout_s,
+            )
+            scaler = AutoScaler(
+                registry, launcher, router=router,
+                min_replicas=args.min_replicas or args.replicas,
+                max_replicas=args.max_replicas or 2 * args.replicas,
+                cooldown_s=args.autoscale_cooldown_s,
+                obs_registry=router.obs,
+            )
+            # The router forwards propagated incidents to the scaler — the
+            # scale-up-on-incident path (docs/FLEET.md "Autoscaling").
+            router.autoscaler = scaler
+            scaler.start()
         prober = HealthProber(registry, transport=transport,
                               interval_s=args.probe_interval_s,
                               # Replica-fired incidents (flight recorder
@@ -256,6 +451,14 @@ def cmd_serve(args) -> int:
             pass
         finally:
             prober.stop()
+            if scaler is not None:
+                scaler.stop()
+                # Scale-up replicas drain like the originals, then stop.
+                for rid in list(scaler.launcher.procs):
+                    if router is not None:
+                        print(f"draining {rid} ...", flush=True)
+                        router.drain_replica(rid, timeout_s=30.0)
+                scaler.launcher.stop_all()
         return 0
     finally:
         for rid, _, proc in procs:
@@ -290,6 +493,21 @@ def cmd_status(url: str, as_json: bool) -> int:
         return 0
     print(f"balancer: {body.get('balancer')}   "
           f"max_inflight: {body.get('max_inflight')}")
+    tuner = (body.get("admission") or {}).get("tuner")
+    if tuner:
+        knee = tuner.get("knee") or {}
+        print(f"admission: auto (limit={tuner.get('limit')} "
+              f"floor={tuner.get('floor')} ceiling={tuner.get('ceiling')} "
+              f"frozen={tuner.get('frozen')}) "
+              f"knee={knee.get('knee_offered_rps')} rps")
+    autoscale = body.get("autoscale")
+    if autoscale:
+        ev = autoscale.get("last_eval") or {}
+        print(f"autoscale: [{autoscale.get('min_replicas')}, "
+              f"{autoscale.get('max_replicas')}] "
+              f"util={ev.get('utilization')} "
+              f"demand={ev.get('demand_rps')} rps "
+              f"supply={ev.get('supply_rps')} rps")
     print(f"{'REPLICA':<12} {'STATE':<10} {'URL':<28} "
           f"{'OUT':>4} {'ROUTED':>7} {'FAILED':>7}")
     for r in body.get("replicas", []):

@@ -136,7 +136,10 @@ def _make_handler(router, request_timeout_s: float | None):
                 router.registry.register(rid, url)
                 self._send(200, {"registered": rid, "url": url})
             elif self.path == "/replicas/deregister":
-                self._send(200, {"deregistered": router.registry.deregister(rid)})
+                # Through the router, not the bare registry: forget_replica
+                # also purges the dead replica's tier membership and
+                # incident bookkeeping — a plain pop left those behind.
+                self._send(200, {"deregistered": router.forget_replica(rid)})
             else:  # /replicas/drain
                 self._send(200, router.drain_replica(rid))
 

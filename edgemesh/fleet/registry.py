@@ -105,6 +105,14 @@ class ReplicaRegistry:
         with self._lock:
             rep = self._replicas.get(rid)
             if rep is not None and rep.base_url == base_url:
+                if rep.state in ("draining", "removed"):
+                    # Reviving a replica that LEFT rotation: its last
+                    # digest describes the dead incarnation — the revived
+                    # process starts cold and earns a fresh one on the
+                    # first probe. (A live re-register keeps its digest:
+                    # idempotent heartbeats must not blind the balancer.)
+                    rep.load = None
+                    rep.load_ts = None
                 rep.state = "healthy"
                 rep.consecutive_failures = 0
                 rep.consecutive_successes = 0
@@ -138,6 +146,12 @@ class ReplicaRegistry:
             rep = self._replicas.get(rid)
             if rep is not None:
                 rep.state = state
+                if state == "removed":
+                    # A removed replica's digest must not linger in
+                    # /fleetz or tier scoring past its death — the stale
+                    # snapshot outliving stale_after_s was the bug.
+                    rep.load = None
+                    rep.load_ts = None
 
     # -- routing bookkeeping -------------------------------------------------
 

@@ -136,6 +136,10 @@ class FleetRouter:
         latency_window: int = 256,
         max_inflight: int = 64,
         admission: AdmissionController | None = None,
+        admission_auto: bool = False,
+        admission_floor: int = 2,
+        admission_ceiling: int = 256,
+        tuner=None,
         admission_wait_s: float = 10.0,
         demote_after: int = 2,
         rng: random.Random | None = None,
@@ -338,6 +342,24 @@ class FleetRouter:
             "Router request latency by outcome "
             "(ok/retried/hedged_won/shed/exhausted)", ("outcome",),
         )
+        # Knee-tracking admission (fleet/autotune.py, ``--admission auto``):
+        # the tuner watches every routed request's fate through the same
+        # good/answered accounting /fleetz shows, and drives
+        # admission.max_inflight (and the per-tenant rate scale) toward the
+        # live saturation knee. None = static limits (the legacy mode).
+        self.tuner = tuner
+        if admission_auto and self.tuner is None:
+            from edgemesh.fleet.autotune import KneeTracker
+
+            self.tuner = KneeTracker(
+                self.admission, floor=admission_floor,
+                ceiling=admission_ceiling, obs_registry=reg,
+                log=self._trace_log,
+            )
+        # Autoscaler seam (fleet/autoscale.py): attached by the fleet CLI
+        # after construction (the scaler needs the router for drains). The
+        # router only forwards incident signals to it.
+        self.autoscaler = None
 
     # -- request path --------------------------------------------------------
 
@@ -398,7 +420,8 @@ class FleetRouter:
             self._tenant_shed.labels(tenant=label, reason=reason).inc()
             status, body, headers = 503, {
                 "error": "router at capacity", "reason": reason,
-                "max_inflight": self.max_inflight,
+                # Live value: under --admission auto the tuner moves it.
+                "max_inflight": self.admission.max_inflight,
             }, {"Retry-After": "1"}
         else:
             self._inflight_gauge.inc()
@@ -414,6 +437,14 @@ class FleetRouter:
         self._latency_outcome.labels(outcome=meta["outcome"]).observe(latency)
         self._tenant_requests.labels(tenant=label, outcome=meta["outcome"]).inc()
         self._account_tenant(label, meta["outcome"], status, latency)
+        if self.tuner is not None:
+            # Same goodness definition as the per-tenant accounting above:
+            # answered 200 within the router-side response-latency target.
+            self.tuner.observe(
+                answered=(meta["outcome"] != "shed" and status == 200),
+                good=(status == 200 and latency <= self._slo_target.ttft_s),
+                shed=(meta["outcome"] == "shed"),
+            )
         headers = dict(headers)
         headers[TRACE_HEADER] = ctx.to_header()
         self._finish_trace(ctx, spans, status, tenant=tenant)
@@ -882,6 +913,20 @@ class FleetRouter:
             kind=str(incident.get("kind") or "unknown")).inc()
         log.warning("incident %s (%s) fired on %s — propagating",
                     iid, rec["kind"], source_rid)
+        if self.tuner is not None:
+            # Incident windows measure the incident, not the limit: tuning
+            # on them would chase the degradation downward (fleet/
+            # autotune.py). Observation continues; control pauses.
+            self.tuner.freeze(reason=f"incident:{iid}")
+        if self.autoscaler is not None:
+            # The incident IS a demand/supply signal: a degraded replica
+            # means the surviving fleet is about to be short one replica's
+            # capacity — scale up ahead of the queue growth
+            # (fleet/autoscale.py; ROADMAP "self-driving fleet").
+            try:
+                self.autoscaler.note_incident(rec)
+            except Exception:
+                log.exception("autoscaler incident hook failed")
         if self._trace_log is not None:
             self._trace_log.log("incident", **rec)
         targets = [rep for rep in self.registry.replicas()
@@ -908,6 +953,36 @@ class FleetRouter:
         """Newest-first observed incidents — the /fleetz surfacing."""
         with self._incident_lock:
             return [dict(r) for r in reversed(self._incidents)]
+
+    def forget_replica(self, rid: str) -> bool:
+        """Deregister ``rid`` AND purge every per-replica trace of it: the
+        registry entry (its load digest goes with it), the tier manager's
+        hysteresis membership (a re-registered replica must re-earn its
+        tier, not inherit a dead incarnation's bonus), and the incident
+        bookkeeping sourced from it (a restarted replica re-minting an id
+        must propagate fresh, and /fleetz must stop attributing old
+        incidents to a replica that no longer exists). The frontend's
+        ``/replicas/deregister`` and the autoscaler's drain path both come
+        through here — plain ``registry.deregister`` is the seam that left
+        stale digests and tier ghosts behind."""
+        existed = self.registry.deregister(rid)
+        if self.tiers is not None:
+            self.tiers.forget(rid)
+        with self._incident_lock:
+            stale = [r["id"] for r in self._incidents
+                     if r.get("source") == rid]
+            if stale:
+                self._incidents = deque(
+                    (r for r in self._incidents if r.get("source") != rid),
+                    maxlen=self._incidents.maxlen,
+                )
+                for iid in stale:
+                    self._incident_ids.discard(iid)
+                self._incident_id_ring = deque(
+                    (i for i in self._incident_id_ring if i not in stale),
+                    maxlen=self._incident_id_ring.maxlen,
+                )
+        return existed
 
     # -- drain ---------------------------------------------------------------
 
@@ -1049,17 +1124,60 @@ class FleetRouter:
                              "capacity": self.kv_cache_entries,
                              "hot_keys": hot_len},
             }
+        admission = self.admission.stats()
+        if self.tuner is not None:
+            admission["tuner"] = self.tuner.status()
+        # Fleet capacity rollup (docs/OBSERVABILITY.md "The capacity
+        # model"): the routable replicas' digest capacity estimates and
+        # observed arrival rates summed into the supply/demand pair the
+        # autoscaler balances. Nulls stay null — a cold fleet reports no
+        # claim, not zero.
+        cap_tok = cap_req = demand = None
+        per_replica: dict[str, dict] = {}
+        for rep in self.registry.replicas():
+            load = rep.load if isinstance(rep.load, dict) else {}
+            cap = load.get("capacity")
+            if not rep.routable() or not isinstance(cap, dict):
+                continue
+            arrival = load.get("ewma_arrival_s")
+            cell = {
+                "est_tok_s": cap.get("est_tok_s"),
+                "est_req_s": cap.get("est_req_s"),
+                "arrival_rps": (
+                    round(1.0 / arrival, 3) if arrival else None
+                ),
+            }
+            per_replica[rep.rid] = cell
+            if cell["est_tok_s"] is not None:
+                cap_tok = (cap_tok or 0.0) + cell["est_tok_s"]
+            if cell["est_req_s"] is not None:
+                cap_req = (cap_req or 0.0) + cell["est_req_s"]
+            if cell["arrival_rps"] is not None:
+                demand = (demand or 0.0) + cell["arrival_rps"]
+        capacity = {
+            "fleet_est_tok_s": None if cap_tok is None else round(cap_tok, 3),
+            "fleet_est_req_s": None if cap_req is None else round(cap_req, 3),
+            "fleet_arrival_rps": None if demand is None else round(demand, 3),
+            "replicas": per_replica,
+        }
         return {
             "balancer": getattr(self.balancer, "name", type(self.balancer).__name__),
-            "max_inflight": self.max_inflight,
+            "max_inflight": self.admission.max_inflight,
             "max_attempts": self.max_attempts,
+            # The measured capacity model + (when attached) the autoscaler
+            # closing the loop on it (docs/FLEET.md "Autoscaling").
+            "capacity": capacity,
+            "autoscale": (
+                None if self.autoscaler is None else self.autoscaler.status()
+            ),
             # Tiered serving: null when disabled, else live membership +
             # shared-prefix-cache occupancy (docs/FLEET.md).
             "tiers": tiers,
             # Multi-tenant surfaces: live admission state (queues, policy
-            # table, rate-limit hits) + per-tenant request accounting with
-            # the router-observed goodput ratio.
-            "admission": self.admission.stats(),
+            # table, rate-limit hits, and — under --admission auto — the
+            # knee tracker's live state) + per-tenant request accounting
+            # with the router-observed goodput ratio.
+            "admission": admission,
             "tenants": tenants,
             # The successful-attempt latency ring backing the legacy
             # percentile hedge: explicit bound + live fill level.

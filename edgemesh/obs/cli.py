@@ -85,6 +85,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="render an `edgemesh loadgen` report (single run or "
         "goodput-vs-offered-load curve) as human text")
     lr.add_argument("path", help="report JSON written by `edgemesh loadgen`")
+    lr.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the machine-readable report document "
+                    "(curve documents gain knee fields if absent) instead "
+                    "of the human chart")
     rp = sub.add_parser(
         "replay",
         help="reconstruct a replayable open-loop workload from recorded "
@@ -204,6 +208,27 @@ def cmd_summary(path: str) -> int:
         t: {"classified": c, "good": g, "goodput_ratio": round(g / c, 4)}
         for t, (g, c) in sorted(by_tenant.items())
     } or None
+    # Capacity-model rows (docs/OBSERVABILITY.md "The capacity model"):
+    # flight-recorder snapshots carry the full load digest (capacity +
+    # pool blocks), and the router's --admission auto log carries
+    # admission_tune records (limit + live knee). Newest wins. Logs from
+    # before the capacity model simply report null here and exit 0 — the
+    # same forward-compat contract as the pre-SLO and pre-tenant fields.
+    capacity = pool = knee = None
+    for r in records:
+        if isinstance(r.get("capacity"), dict):
+            capacity = r["capacity"]
+            if isinstance(r.get("pool"), dict):
+                pool = r["pool"]
+        if r.get("event") == "admission_tune":
+            knee = {
+                "action": r.get("action"),
+                "limit": r.get("limit"),
+                "rate_scale": r.get("rate_scale"),
+                "knee_offered_rps": r.get("knee_offered_rps"),
+                "knee_goodput_rps": r.get("knee_goodput_rps"),
+                "collapsed": r.get("collapsed"),
+            }
 
     def pct(xs: list[float], q: float):
         if not xs:
@@ -213,6 +238,9 @@ def cmd_summary(path: str) -> int:
     print(json.dumps({
         "records": len(records),
         "requests": len(spans),
+        "capacity": capacity,
+        "pool": pool,
+        "knee": knee,
         "latency_s_p50": pct(lats, 0.50),
         "latency_s_p95": pct(lats, 0.95),
         "ttft_s_p50": pct(ttfts, 0.50),
@@ -249,12 +277,22 @@ def _fmt_tenant_rows(tenants: dict, indent: str = "  ") -> list[str]:
     return rows
 
 
-def cmd_loadreport(path: str) -> int:
+def cmd_loadreport(path: str, as_json: bool = False) -> int:
     """Human rendering of a loadgen report: for a curve document, a
     goodput-vs-offered-load bar chart with the knee marked; for a single
-    run, the aggregate + per-tenant table."""
+    run, the aggregate + per-tenant table. ``--json`` instead prints the
+    machine-readable document — curve documents written before the knee
+    fields (or assembled by hand from raw points) gain them here via the
+    same ``find_knee`` the sweep uses, so scripts always see the keys."""
     with open(path) as f:
         doc = json.load(f)
+    if as_json:
+        if "points" in doc and "knee_offered_rps" not in doc:
+            from edgemesh.loadgen.curve import find_knee
+
+            doc = {**doc, **find_knee(doc["points"])}
+        print(json.dumps(doc, indent=2))
+        return 0
     lines: list[str] = []
     if "points" in doc:  # curve document (run_curve schema)
         points = doc["points"]
@@ -391,7 +429,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: no such {kind}: {args.path}", file=sys.stderr)
         return 2
     if args.cmd == "loadreport":
-        return cmd_loadreport(args.path)
+        return cmd_loadreport(args.path, as_json=args.as_json)
     if args.cmd == "tail":
         return cmd_tail(args.path, args.count, args.event)
     if args.cmd == "summary":

@@ -132,7 +132,15 @@ class SpanTracker:
         self._ewma: dict[str, float | None] = {
             "queue": None, "prefill": None, "decode": None, "service": None,
             "prefill_tokens": None, "decode_tokens": None,
+            # Inter-arrival seconds between submits: the ARRIVAL side of
+            # the digest. 1/ewma_arrival_s is the offered load this replica
+            # is seeing — what the fleet autoscaler sums into fleet demand
+            # (fleet/autoscale.py), independent of how service is keeping
+            # up. Updated in submit, so even a wedged engine whose
+            # retirements stall keeps reporting honest arrivals.
+            "arrival": None,
         }
+        self._last_submit: float | None = None  # guarded by: _ewma_lock
         # Span-I/O sampling for locally-originated requests (requests that
         # arrive with a trace context inherit ITS sampled bit instead, so
         # the router's decision is honored end to end). Sampled-out
@@ -217,6 +225,15 @@ class SpanTracker:
             trace.sampled = ctx.sampled
         trace.span_id = ctx.span_id
         self._submitted.inc()
+        with self._ewma_lock:
+            if self._last_submit is not None:
+                dt = trace.t_submit - self._last_submit
+                prev = self._ewma["arrival"]
+                self._ewma["arrival"] = (
+                    dt if prev is None
+                    else EWMA_ALPHA * dt + (1.0 - EWMA_ALPHA) * prev
+                )
+            self._last_submit = trace.t_submit
         return trace
 
     def admit_start(self, trace: RequestTrace) -> None:
@@ -347,6 +364,17 @@ class SpanTracker:
         the router's :class:`~edgemesh.fleet.balancer.TelemetryBalancer`."""
         with self._ewma_lock:
             ew = dict(self._ewma)
+            last_submit = self._last_submit
+        # The arrival EWMA only updates on submit, so after traffic stops
+        # it would report the last regime forever — and the autoscaler's
+        # scale-DOWN branch would be unreachable. The gap since the last
+        # submit is itself evidence: once it exceeds the EWMA, report the
+        # gap (the effective inter-arrival keeps growing as the replica
+        # sits idle).
+        if ew["arrival"] is not None and last_submit is not None:
+            gap = time.perf_counter() - last_submit
+            if gap > ew["arrival"]:
+                ew["arrival"] = gap
         rnd = {k: (None if v is None else round(v, 6)) for k, v in ew.items()}
         ratio = self.slo.goodput_ratio()
         return {
@@ -359,6 +387,10 @@ class SpanTracker:
             # pre-split consumers ignore the extra keys by construction.
             "ewma_prefill_tokens": rnd["prefill_tokens"],
             "ewma_decode_tokens": rnd["decode_tokens"],
+            # Arrival side: mean inter-arrival seconds (None until the
+            # second submit). The autoscaler reads offered load as
+            # 1/ewma_arrival_s per replica (docs/FLEET.md "Autoscaling").
+            "ewma_arrival_s": rnd["arrival"],
             "slo_goodput_ratio": None if ratio is None else round(ratio, 4),
         }
 

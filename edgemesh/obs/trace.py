@@ -453,6 +453,11 @@ class CompileEventHook:
             "edgemesh_jax_compile_seconds",
             "JAX compile-pipeline event durations, by event key", ("event",),
         )
+        self._cache_events = reg.counter(
+            "edgemesh_compile_cache_events_total",
+            "Persistent compilation-cache outcomes (hit = reused a shared "
+            "cache entry; request = any cache lookup)", ("event",),
+        )
         self._log = None
         if span_log is not None:
             from edgemesh.utils.tracing import JsonlLogger
@@ -483,6 +488,12 @@ class CompileEventHook:
                 parent_span_id=ctx.span_id if ctx is not None else None,
             )
 
+    def on_cache_event(self, kind: str) -> None:
+        """Persistent-compilation-cache outcome (``kind`` in hit/request):
+        counted per registry so a warm-started replica's /metrics proves
+        its compiles were disk-cache hits, not fresh XLA work."""
+        self._cache_events.labels(event=kind).inc()
+
 
 # One process-wide dispatcher: jax.monitoring listeners cannot be removed
 # individually, so jax sees exactly one listener and hooks attach/detach
@@ -495,6 +506,16 @@ _listener_registered = False
 # digest (serve/rest.py /loadz) flags a recent compile so the fleet's
 # telemetry balancer can treat the replica as warming up, not degraded.
 _last_compile_monotonic: float | None = None
+
+# Process-wide persistent-compilation-cache tally (jax.monitoring events —
+# see utils/compat.register_cache_event_listener): what the load digest's
+# ``compile_cache`` block and the autoscaler's warm-start proof read.
+_cache_hits = 0  # guarded by: _hook_lock
+_cache_requests = 0  # guarded by: _hook_lock
+
+#: monitoring event-name suffix → the bounded label the counter uses
+_CACHE_EVENT_KEYS = {"cache_hits": "hit",
+                     "compile_requests_use_cache": "request"}
 
 
 def _mark_compile() -> None:
@@ -520,6 +541,49 @@ def _dispatch(name: str, duration_s: float) -> None:
             pass
 
 
+def _dispatch_cache_event(name: str) -> None:
+    global _cache_hits, _cache_requests
+    if "/compilation_cache/" not in name:
+        return
+    kind = _CACHE_EVENT_KEYS.get(name.rsplit("/", 1)[-1])
+    if kind is None:
+        return
+    with _hook_lock:
+        if kind == "hit":
+            _cache_hits += 1
+        else:
+            _cache_requests += 1
+    for hook in list(_hooks):
+        try:
+            hook.on_cache_event(kind)
+        except Exception:  # telemetry must never break a compile
+            pass
+
+
+def compile_cache_state() -> dict:
+    """The process's persistent-compilation-cache block for the load digest
+    (serve/rest.py ``/loadz``): whether a shared cache directory is
+    configured (``utils.compat.enable_compilation_cache`` /
+    ``--compile-cache-dir``) and the live hit/miss tally. Misses are
+    derived (requests − hits) so the two monitoring event streams cannot
+    drift apart in the report. Cheap: two config reads + one lock."""
+    cache_dir = None
+    try:
+        import jax
+
+        cache_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+    except Exception:  # telemetry must survive a jax-less router process
+        pass
+    with _hook_lock:
+        hits, requests = _cache_hits, _cache_requests
+    return {
+        "enabled": bool(cache_dir),
+        "dir": cache_dir,
+        "hits": hits,
+        "misses": max(0, requests - hits),
+    }
+
+
 def install_compile_hook(registry=None, span_log=None) -> CompileEventHook:
     """Attach a :class:`CompileEventHook`. The first call registers the one
     process-wide ``jax.monitoring`` listener (via the ``utils.compat`` drift
@@ -531,9 +595,15 @@ def install_compile_hook(registry=None, span_log=None) -> CompileEventHook:
     with _hook_lock:
         _hooks.append(hook)
         if not _listener_registered:
-            from edgemesh.utils.compat import register_compile_event_listener
+            from edgemesh.utils.compat import (
+                register_cache_event_listener,
+                register_compile_event_listener,
+            )
 
             if register_compile_event_listener(_dispatch):
+                # Cache-outcome events ride the same one-listener policy;
+                # a jax without plain-event hooks just reports zero hits.
+                register_cache_event_listener(_dispatch_cache_event)
                 _listener_registered = True
     return hook
 

@@ -103,6 +103,57 @@ from edgemesh.utils.bucketing import POW2_FLOOR, bucket_pow2
 
 log = logging.getLogger("edgemesh.serve")
 
+
+def estimate_capacity(slots: int, ewma_decode_s=None, ewma_service_s=None,
+                      ewma_decode_tokens=None) -> dict[str, Any]:
+    """Sustainable-throughput estimate from the digest's service EWMAs —
+    the MEASURED capacity model (docs/OBSERVABILITY.md "The capacity
+    model"). Derivation: with every slot busy, each slot yields one token
+    per ``ewma_decode_s``, so sustainable decode throughput is
+    ``slots / ewma_decode_s``; dividing by the mean tokens a request
+    generates (``ewma_decode_tokens``) gives sustainable requests/s, with
+    ``slots / ewma_service_s`` as the fallback when the token split has
+    not been observed yet. All ``None`` until the EWMAs exist — a cold
+    replica honestly reports no capacity claim rather than a guess."""
+    tok_s = None
+    if ewma_decode_s:
+        tok_s = round(slots / ewma_decode_s, 3)
+    req_s = None
+    if tok_s is not None and ewma_decode_tokens:
+        req_s = round(tok_s / ewma_decode_tokens, 3)
+    elif ewma_service_s:
+        req_s = round(slots / ewma_service_s, 3)
+    return {"slots": slots, "est_tok_s": tok_s, "est_req_s": req_s}
+
+
+def pool_state(total: int, free: int, reserved: int, template: int,
+               page_size: int, per_row_worst: int,
+               pending_tokens: int = 0) -> dict[str, Any]:
+    """The paged pool's occupancy block for the load digest.
+
+    ``occupancy_ratio`` is the non-free share of the pool;
+    ``free_page_headroom`` counts how many more WORST-CASE admissions
+    still fit (the number the admission path actually gates on);
+    ``fragmentation_ratio`` is the worst-case allocator's internal
+    fragmentation — the share of reserved page capacity held for tokens
+    that have not been generated yet (``pending_tokens`` = the active
+    rows' remaining budgets). High right after long-budget admissions,
+    decaying toward 0 as decode fills the reserved pages."""
+    reserved_capacity = reserved * page_size
+    frag = 0.0
+    if reserved_capacity > 0:
+        frag = round(min(1.0, max(0, pending_tokens) / reserved_capacity), 4)
+    return {
+        "pages_total": total,
+        "pages_free": free,
+        "pages_reserved": reserved,
+        "pages_template": template,
+        "occupancy_ratio": round((total - free) / total, 4) if total else 0.0,
+        "fragmentation_ratio": frag,
+        "free_page_headroom": free // max(1, per_row_worst),
+    }
+
+
 # Donated variants of the paged prefills: admission runs them on a one-row
 # view of the SHARED page pool, so without donation every admission would
 # copy the whole pool to apply a few page writes.
@@ -500,6 +551,33 @@ class ContinuousEngine:
             "edgemesh_kv_pages", "Paged KV pool occupancy by state",
             ("engine", "state"),
         )
+        # The capacity model (docs/OBSERVABILITY.md): sustainable tok/s and
+        # req/s derived from the service EWMAs, plus pool occupancy as
+        # ratios. Refreshed on every load_digest read (the probe cadence),
+        # so a scrape and /loadz agree.
+        self._capacity_gauge = self.obs.registry.gauge(
+            "edgemesh_capacity_tokens_per_s",
+            "Live sustainable decode tok/s estimate (slots / decode EWMA)",
+            ("engine",),
+        )
+        self._capacity_req_gauge = self.obs.registry.gauge(
+            "edgemesh_capacity_requests_per_s",
+            "Live sustainable req/s estimate from the capacity model",
+            ("engine",),
+        )
+        self._pool_occupancy_gauge = self.obs.registry.gauge(
+            "edgemesh_pool_occupancy_ratio",
+            "Non-free share of the paged KV pool", ("engine",),
+        )
+        self._pool_frag_gauge = self.obs.registry.gauge(
+            "edgemesh_pool_fragmentation_ratio",
+            "Reserved-page capacity held for not-yet-generated tokens "
+            "(worst-case allocator internal fragmentation)", ("engine",),
+        )
+        self._pool_headroom_gauge = self.obs.registry.gauge(
+            "edgemesh_pool_free_page_headroom",
+            "Worst-case admissions that still fit the free list", ("engine",),
+        )
         self._prefix_hits_counter = self.obs.registry.counter(
             "edgemesh_shared_prefix_hits_total",
             "Admissions warm-started from the shared template prefix",
@@ -702,13 +780,47 @@ class ContinuousEngine:
 
     def load_digest(self) -> dict[str, Any]:
         """The engine's slice of the replica load digest (serve/rest.py
-        ``/loadz``): admission-queue depth + the SpanTracker's latency
-        EWMAs and SLO goodput. Cheap by design — the fleet prober reads
-        this on every probe, so it must never touch the device."""
+        ``/loadz``): admission-queue depth, the SpanTracker's latency/
+        arrival EWMAs and SLO goodput, the live capacity estimate, and
+        (paged backends) the pool occupancy block. Cheap by design — the
+        fleet prober reads this on every probe, so it must never touch
+        the device; the slot ``remaining`` reads below are advisory
+        glances at worker-owned ints (GIL-atomic), not synchronization."""
+        pool = None
         with self._cond:
             queue_depth = len(self._queue)
+            if self._paged:
+                pending = sum(
+                    max(0, s.remaining) for s in self._slots if s.active
+                )
+                pool = pool_state(
+                    self.total_pages, len(self._free_pages),
+                    self._reserved_pages, len(self._template_pages),
+                    self.page_size, self._per_row_worst,
+                    pending_tokens=pending,
+                )
         digest = self.obs.load_digest()
         digest["queue_depth"] = queue_depth
+        cap = estimate_capacity(
+            self.n_slots,
+            ewma_decode_s=digest.get("ewma_decode_s"),
+            ewma_service_s=digest.get("ewma_service_s"),
+            ewma_decode_tokens=digest.get("ewma_decode_tokens"),
+        )
+        digest["capacity"] = cap
+        digest["pool"] = pool
+        eng = self.obs_engine_label
+        if cap["est_tok_s"] is not None:
+            self._capacity_gauge.labels(engine=eng).set(cap["est_tok_s"])
+        if cap["est_req_s"] is not None:
+            self._capacity_req_gauge.labels(engine=eng).set(cap["est_req_s"])
+        if pool is not None:
+            self._pool_occupancy_gauge.labels(engine=eng).set(
+                pool["occupancy_ratio"])
+            self._pool_frag_gauge.labels(engine=eng).set(
+                pool["fragmentation_ratio"])
+            self._pool_headroom_gauge.labels(engine=eng).set(
+                pool["free_page_headroom"])
         return digest
 
     def _update_page_gauges(self) -> None:

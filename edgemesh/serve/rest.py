@@ -182,7 +182,10 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
             to ride every health probe. Engines contribute queue depth +
             latency EWMAs + SLO goodput; non-continuous gateways degrade
             to in-flight count alone (the EWMA keys stay, as null)."""
-            from edgemesh.obs.trace import seconds_since_last_compile
+            from edgemesh.obs.trace import (
+                compile_cache_state,
+                seconds_since_last_compile,
+            )
 
             digest: dict = {
                 "inflight": self.server.inflight(),
@@ -193,6 +196,11 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 # fleet's tier manager scores replicas by for prefill/
                 # decode disaggregation (docs/FLEET.md "Tiered serving").
                 "ewma_prefill_tokens": None, "ewma_decode_tokens": None,
+                # Arrival-rate side + the capacity model (docs/
+                # OBSERVABILITY.md "The capacity model"): the autoscaler's
+                # demand/supply signals. Null on non-continuous gateways.
+                "ewma_arrival_s": None,
+                "capacity": None, "pool": None,
                 "slo_goodput_ratio": None,
             }
             if batcher is not None and hasattr(batcher, "load_digest"):
@@ -201,6 +209,10 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
             digest["recent_compile"] = (
                 since is not None and since < RECENT_COMPILE_WINDOW_S
             )
+            # Persistent compilation-cache state: whether this replica was
+            # spawned against the fleet's shared cache and how its compiles
+            # resolved — the autoscaler's warm-start proof rides here.
+            digest["compile_cache"] = compile_cache_state()
             # Incident propagation seam (obs/anomaly.py): the newest
             # locally-fired incident {id, kind, ts} rides the digest, so
             # the fleet prober sees it on its existing cadence and the
@@ -729,7 +741,8 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
                trace_sample: float = 1.0, profile_dir=None,
                tp: int = 0, collective_mode: str = "psum",
                collective_dtype: str = "int8",
-               flight_capacity: int | None = None, flight_dir=None):
+               flight_capacity: int | None = None, flight_dir=None,
+               compile_cache_dir=None):
     """Start the gateway (reference binds 0.0.0.0:8000, rest_api.py:15).
 
     With a ``supervisor`` (serve/supervisor.py), /generate routes through its
@@ -785,9 +798,24 @@ def serve_rest(ensemble, host: str = "0.0.0.0", port: int = 8000, block: bool = 
     per-connection socket timeout (None disables). The returned server is a
     :class:`GatewayServer`: ``srv.drain()`` (or ``POST /drain``) stops
     admission, flips ``/readyz`` to 503, and lets in-flight work finish —
-    the fleet router's pre-stop contract (edgemesh/fleet/)."""
+    the fleet router's pre-stop contract (edgemesh/fleet/).
+
+    ``compile_cache_dir`` points jax's persistent compilation cache at a
+    directory shared across replica spawns (utils/compat.py
+    ``enable_compilation_cache``): a scale-up replica's compiles become
+    disk-cache hits and cold-start-to-first-token drops from compile time
+    to load time (docs/FLEET.md "Autoscaling with warm starts"). Must be
+    set BEFORE the engine's first compile — which this placement
+    guarantees. The ``compile_cache`` block in the load digest reports the
+    live hit/miss tally."""
     from edgemesh.obs import register_device_gauges
 
+    if compile_cache_dir is not None:
+        from edgemesh.utils.compat import enable_compilation_cache
+
+        if not enable_compilation_cache(compile_cache_dir):
+            log.warning("compile_cache_dir=%s: this jax cannot persist its "
+                        "compilation cache; serving cold", compile_cache_dir)
     register_device_gauges(registry)
     batcher = None
     if span_log is not None and not continuous:

@@ -83,6 +83,70 @@ def register_compile_event_listener(fn) -> bool:
     return True
 
 
+def register_cache_event_listener(fn) -> bool:
+    """Version-portable ``jax.monitoring`` plain-event registration.
+
+    ``fn(event_name)`` is invoked for every monitoring *event* (no
+    duration) — the persistent compilation cache emits
+    ``/jax/compilation_cache/cache_hits`` on every disk-cache hit and
+    ``/jax/compilation_cache/compile_requests_use_cache`` per lookup, which
+    is how a warm-started replica proves its compiles came from the shared
+    cache. Newer jax passes extra keyword metadata; the adapter swallows
+    it. Returns False when this jax has no monitoring hooks (the caller
+    degrades to counting nothing — telemetry is optional)."""
+    monitoring = getattr(jax, "monitoring", None)
+    if monitoring is None:
+        try:
+            from jax import monitoring  # older spelling: submodule only
+        except ImportError:
+            return False
+    register = getattr(monitoring, "register_event_listener", None)
+    if register is None:
+        return False
+
+    def _adapter(name, **_kwargs):
+        fn(name)
+
+    register(_adapter)
+    return True
+
+
+def enable_compilation_cache(cache_dir) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir`` so every
+    process sharing the directory reuses each other's XLA compiles — the
+    warm-start lever for replica scale-up (docs/FLEET.md "Autoscaling with
+    warm starts"). The gate knobs (min compile time / min entry size) have
+    drifted across jax versions, so each is applied best-effort: a missing
+    knob degrades to that version's default rather than failing the serve.
+    Returns False only when the cache directory itself cannot be set."""
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    except Exception:
+        return False
+    # Cache EVERYTHING: the default min-compile-time gate (1s) would skip
+    # exactly the small programs a CPU test fleet compiles, and scale-up
+    # replicas want every hit they can get.
+    for knob, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # knob not in this jax: its default applies
+            pass
+    # The cache initializes AT MOST ONCE, on the first compile: a compile
+    # that ran before this call (a device-readiness probe, an eagerly built
+    # model) latches it "disabled" and every later write silently no-ops.
+    # Resetting forces re-initialization against the directory just set.
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:  # private API drift: the next compile may still init
+        pass
+    return True
+
+
 def pcast(x, axis_name, *, to: str = "varying"):
     """Version-portable ``lax.pcast``.
 

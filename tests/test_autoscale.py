@@ -1,0 +1,388 @@
+"""Autoscaler control law (fleet/autoscale.py), the deregister-purge
+bugfix, and the capacity model's digest blocks — all fast-tier: the
+scaler runs against a fake launcher and hand-stamped digests, the
+capacity math against its pure helpers."""
+
+import json
+
+import pytest
+
+from edgemesh.fleet.autoscale import AutoScaler
+from edgemesh.fleet.balancer import TierManager
+from edgemesh.fleet.registry import Replica, ReplicaRegistry
+from edgemesh.fleet.router import FleetRouter
+from edgemesh.obs import Registry
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+class FakeLauncher:
+    def __init__(self):
+        self.spawned = []
+        self.stopped = []
+        self._pending = 0
+
+    def spawn(self):
+        rid = f"scale-{len(self.spawned)}"
+        self.spawned.append(rid)
+        return rid
+
+    def stop(self, rid):
+        self.stopped.append(rid)
+
+    def pending(self):
+        return self._pending
+
+
+def hot_digest(arrival_rps=20.0, est_req_s=10.0, slots=8):
+    return {"ewma_arrival_s": 1.0 / arrival_rps,
+            "capacity": {"slots": slots, "est_req_s": est_req_s,
+                         "est_tok_s": est_req_s * 8}}
+
+
+def make_scaler(n=2, arrival_rps=20.0, est_req_s=10.0, **kw):
+    reg = ReplicaRegistry((f"r{i}", f"http://x:{i}") for i in range(n))
+    for i in range(n):
+        reg.update_load(f"r{i}", hot_digest(arrival_rps, est_req_s))
+    clock = Clock()
+    launcher = FakeLauncher()
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("up_after", 2)
+    kw.setdefault("down_after", 3)
+    kw.setdefault("cooldown_s", 5.0)
+    kw.setdefault("obs_registry", Registry())
+    sc = AutoScaler(reg, launcher, now=clock, **kw)
+    return sc, reg, launcher, clock
+
+
+def test_scale_up_needs_a_streak_then_cools_down():
+    # 2 replicas at 20 rps arrivals / 10 rps capacity each: util = 2.0.
+    sc, reg, launcher, clock = make_scaler()
+    assert sc.evaluate() is None  # streak 1 of up_after=2
+    clock.tick(1.0)
+    action = sc.evaluate()
+    assert action["action"] == "up" and launcher.spawned == ["scale-0"]
+    assert action["utilization"] == pytest.approx(2.0)
+    clock.tick(1.0)
+    assert sc.evaluate() is None  # cooling down
+    clock.tick(10.0)
+    sc.evaluate()
+    assert len(launcher.spawned) == 2  # streak rebuilt after cooldown
+
+
+def test_scale_up_respects_max_and_pending():
+    sc, reg, launcher, clock = make_scaler(max_replicas=2)
+    clock.tick(1.0)
+    sc.evaluate()
+    clock.tick(1.0)
+    assert sc.evaluate() is None  # 2 routable = max: never a third
+    assert launcher.spawned == []
+    # Pending spawns count toward the bound: one slow boot cannot
+    # trigger a second.
+    sc.max_replicas = 3
+    launcher._pending = 1
+    for _ in range(4):
+        clock.tick(10.0)
+        sc.evaluate()
+    assert launcher.spawned == []
+
+
+def test_scale_down_drains_the_least_loaded_to_min():
+    sc, reg, launcher, clock = make_scaler(
+        n=3, arrival_rps=0.5, est_req_s=10.0, min_replicas=2)
+    reg.get("r1").outstanding = 3  # r0/r2 tie on outstanding; lowest rid drains
+    actions = []
+    for _ in range(10):
+        clock.tick(10.0)
+        a = sc.evaluate()
+        if a:
+            actions.append(a)
+    assert [a["action"] for a in actions] == ["down"]
+    assert actions[0]["replica"] == "r0"
+    assert launcher.stopped == ["r0"]
+    # min_replicas=2 holds: r1/r2 stay even under zero load.
+    assert {r.rid for r in reg.replicas()} == {"r1", "r2"}
+
+
+def test_incident_is_an_immediate_scale_up_with_its_own_cooldown():
+    sc, reg, launcher, clock = make_scaler(arrival_rps=0.1)  # idle fleet
+    assert sc.note_incident({"id": "inc-1", "kind": "slo_burst"}) is True
+    # Duplicate within the incident cooldown is dropped.
+    assert sc.note_incident({"id": "inc-2", "kind": "slo_burst"}) is False
+    action = sc.evaluate()
+    assert action["action"] == "incident_up"
+    assert action["incident"] == "inc-1"
+    assert launcher.spawned == ["scale-0"]
+    clock.tick(120.0)  # past incident_cooldown_s
+    assert sc.note_incident({"id": "inc-3", "kind": "error_spike"}) is True
+
+
+def test_cold_fleet_scores_neutral_supply_not_zero():
+    # No digests at all: supply falls back to slots/neutral_service_s and
+    # demand is 0 — the scaler must sit still, not divide by zero.
+    reg = ReplicaRegistry([("r0", "http://x:0")])
+    sc = AutoScaler(reg, FakeLauncher(), obs_registry=Registry(),
+                    now=Clock())
+    assert sc.evaluate() is None
+    assert sc.status()["last_eval"]["utilization"] == 0.0
+
+
+def test_autoscaler_validation_and_status():
+    reg = ReplicaRegistry()
+    with pytest.raises(ValueError):
+        AutoScaler(reg, FakeLauncher(), min_replicas=0,
+                   obs_registry=Registry())
+    with pytest.raises(ValueError):
+        AutoScaler(reg, FakeLauncher(), min_replicas=3, max_replicas=2,
+                   obs_registry=Registry())
+    with pytest.raises(ValueError):
+        AutoScaler(reg, FakeLauncher(), low_watermark=0.9,
+                   high_watermark=0.8, obs_registry=Registry())
+    sc, *_ = make_scaler()
+    st = sc.status()
+    assert {"min_replicas", "max_replicas", "high_watermark",
+            "low_watermark", "last_eval", "recent_events"} <= set(st)
+
+
+# -- the deregister/removal purge (the satellite bugfix) ---------------------
+
+
+def test_removed_replica_load_digest_is_purged():
+    reg = ReplicaRegistry([("r0", "http://x:0")])
+    reg.update_load("r0", hot_digest())
+    assert reg.get("r0").load is not None
+    reg.set_state("r0", "removed")
+    snap = reg.snapshot()[0]
+    assert "load" not in snap and reg.get("r0").load is None
+
+
+def test_revive_after_removal_starts_cold_but_live_reregister_keeps_digest():
+    reg = ReplicaRegistry([("r0", "http://x:0")])
+    reg.update_load("r0", hot_digest())
+    # Idempotent heartbeat re-register of a LIVE replica keeps its digest.
+    reg.register("r0", "http://x:0")
+    assert reg.get("r0").load is not None
+    # But reviving one that left rotation starts cold: the old digest
+    # described the dead incarnation.
+    reg.set_state("r0", "draining")
+    reg.register("r0", "http://x:0")
+    assert reg.get("r0").state == "healthy" and reg.get("r0").load is None
+
+
+def test_tier_manager_forget_purges_hysteresis_membership():
+    tm = TierManager(prefill_fraction=0.5, refresh_s=100.0, now=Clock())
+    reps = [Replica(rid=f"r{i}", base_url=f"http://x:{i}") for i in range(2)]
+    reps[0].load = {"ewma_prefill_tokens": 100.0, "ewma_decode_tokens": 1.0}
+    reps[1].load = {"ewma_prefill_tokens": 1.0, "ewma_decode_tokens": 100.0}
+    out = tm.assign(reps)
+    assert [r.rid for r in out["prefill"]] == ["r0"]
+    tm.forget("r0")
+    # The cached assignment dropped with it: the next assign recomputes
+    # and r0's incumbency bonus is gone.
+    assert "r0" not in tm._prefill_rids
+    out2 = tm.assign(reps[1:])
+    assert out2["prefill"] == [] and [r.rid for r in out2["decode"]] == ["r1"]
+
+
+def test_router_forget_replica_purges_everything():
+    reg = ReplicaRegistry([("r0", "http://x:0"), ("r1", "http://x:1")])
+    reg.update_load("r0", hot_digest())
+    router = FleetRouter(reg, obs_registry=Registry(), tiered=True)
+    router.observe_incident("r0", {"id": "inc-r0", "kind": "slo_burst"})
+    router.observe_incident("r1", {"id": "inc-r1", "kind": "slo_burst"})
+    assert {i["id"] for i in router.recent_incidents()} == \
+        {"inc-r0", "inc-r1"}
+    assert router.forget_replica("r0") is True
+    # Registry entry (and its digest) gone; r1's incident survives; the
+    # dedupe window no longer holds r0's id, so a re-registered r0 can
+    # propagate a fresh incarnation of it.
+    assert reg.get("r0") is None
+    assert {i["id"] for i in router.recent_incidents()} == {"inc-r1"}
+    reg.register("r0", "http://x:0")
+    assert router.observe_incident(
+        "r0", {"id": "inc-r0", "kind": "slo_burst"}) is True
+    # Unknown replica: False, no raise.
+    assert router.forget_replica("ghost") is False
+
+
+def test_frontend_deregister_routes_through_forget(tmp_path):
+    import urllib.request
+
+    from edgemesh.fleet import serve_fleet
+
+    reg = ReplicaRegistry([("r0", "http://x:0"), ("r1", "http://x:1")])
+    reg.update_load("r0", hot_digest())
+    router = FleetRouter(reg, obs_registry=Registry(), tiered=True)
+    router.observe_incident("r0", {"id": "inc-z", "kind": "slo_burst"})
+    front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+    try:
+        url = f"http://127.0.0.1:{front.server_address[1]}"
+        req = urllib.request.Request(
+            f"{url}/replicas/deregister",
+            data=json.dumps({"id": "r0"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.load(r)["deregistered"] is True
+        assert reg.get("r0") is None
+        assert router.recent_incidents() == []
+    finally:
+        front.shutdown()
+
+
+# -- the capacity model's digest blocks --------------------------------------
+
+
+def test_estimate_capacity_derivation_and_cold_nulls():
+    from edgemesh.serve.continuous import estimate_capacity
+
+    cap = estimate_capacity(8, ewma_decode_s=0.01, ewma_service_s=1.0,
+                            ewma_decode_tokens=16.0)
+    # 8 slots / 10ms per token = 800 tok/s; / 16 tokens per request = 50 rps.
+    assert cap == {"slots": 8, "est_tok_s": 800.0, "est_req_s": 50.0}
+    # No decode EWMA yet: req/s falls back to slots/service.
+    cap = estimate_capacity(4, ewma_service_s=2.0)
+    assert cap["est_tok_s"] is None and cap["est_req_s"] == 2.0
+    # Cold: no claims.
+    assert estimate_capacity(8) == {"slots": 8, "est_tok_s": None,
+                                    "est_req_s": None}
+
+
+def test_pool_state_occupancy_fragmentation_headroom():
+    from edgemesh.serve.continuous import pool_state
+
+    st = pool_state(total=100, free=40, reserved=50, template=10,
+                    page_size=64, per_row_worst=9, pending_tokens=640)
+    assert st["occupancy_ratio"] == 0.6
+    # 640 pending tokens over 50*64 reserved capacity = 0.2.
+    assert st["fragmentation_ratio"] == 0.2
+    assert st["free_page_headroom"] == 4  # 40 // 9
+    # Empty pool degrades to zeros, never a division error.
+    st = pool_state(total=0, free=0, reserved=0, template=0, page_size=64,
+                    per_row_worst=9)
+    assert st["occupancy_ratio"] == 0.0
+    assert st["fragmentation_ratio"] == 0.0
+
+
+def test_span_tracker_arrival_ewma_rides_the_digest():
+    from edgemesh.obs import SpanTracker
+
+    tr = SpanTracker(Registry())
+    assert tr.load_digest()["ewma_arrival_s"] is None  # < 2 submits
+    tr.submit(0)
+    assert tr.load_digest()["ewma_arrival_s"] is None
+    tr.submit(1)
+    dig = tr.load_digest()
+    assert dig["ewma_arrival_s"] is not None and dig["ewma_arrival_s"] >= 0
+
+
+def test_compile_cache_state_shape():
+    from edgemesh.obs.trace import compile_cache_state
+
+    st = compile_cache_state()
+    assert {"enabled", "dir", "hits", "misses"} <= set(st)
+    assert isinstance(st["enabled"], bool)
+    assert st["hits"] >= 0 and st["misses"] >= 0
+
+
+def test_router_status_capacity_rollup_and_autoscale_surface():
+    reg = ReplicaRegistry([("r0", "http://x:0"), ("r1", "http://x:1")])
+    reg.update_load("r0", hot_digest(arrival_rps=20.0, est_req_s=10.0))
+    # r1 cold: contributes nothing, reports nothing — never a zero claim.
+    router = FleetRouter(reg, obs_registry=Registry(), admission_auto=True)
+    st = router.status()
+    cap = st["capacity"]
+    assert cap["fleet_est_req_s"] == 10.0
+    assert cap["fleet_arrival_rps"] == pytest.approx(20.0)
+    assert set(cap["replicas"]) == {"r0"}
+    assert st["autoscale"] is None
+    assert st["admission"]["tuner"]["mode"] == "auto"
+    # Attach a scaler: its status surfaces.
+    sc, *_ = make_scaler()
+    router.autoscaler = sc
+    assert router.status()["autoscale"]["min_replicas"] == 1
+
+
+def test_subprocess_launcher_contract_without_spawning():
+    import argparse
+
+    from edgemesh.fleet import HttpTransport
+    from edgemesh.fleet.cli import SubprocessLauncher, _replica_cmd
+
+    args = argparse.Namespace(config="cfg.yaml", replica_extra="--continuous",
+                              compile_cache_dir="/tmp/cc")
+    cmd = _replica_cmd(args, 8123)
+    assert "--compile-cache-dir" in cmd and "/tmp/cc" in cmd
+    assert "--continuous" in cmd and "--config" in cmd
+    launcher = SubprocessLauncher(args, ReplicaRegistry(), HttpTransport(),
+                                  obs_registry=Registry())
+    assert launcher.pending() == 0
+    launcher.stop("never-spawned")  # no raise
+
+
+def test_arrival_ewma_grows_with_idle_gap():
+    # After traffic stops the digest must report the growing idle gap as
+    # the effective inter-arrival — otherwise demand stays at the burst
+    # era's level forever and scale-down is unreachable.
+    import time as _time
+
+    from edgemesh.obs import SpanTracker
+
+    tr = SpanTracker(Registry())
+    tr.submit(0)
+    tr.submit(1)
+    burst_arrival = tr.load_digest()["ewma_arrival_s"]
+    _time.sleep(0.05)
+    idle_arrival = tr.load_digest()["ewma_arrival_s"]
+    assert idle_arrival > burst_arrival
+    assert idle_arrival >= 0.05
+
+
+def test_scale_down_only_reaps_launcher_owned_replicas():
+    # A boot-time replica the launcher cannot stop must never be the
+    # victim — draining it would leave a zombie process out of rotation.
+    sc, reg, launcher, clock = make_scaler(
+        n=3, arrival_rps=0.5, est_req_s=10.0, min_replicas=1)
+    owned = {"r2"}
+    launcher.owns = lambda rid: rid in owned
+    for _ in range(10):
+        clock.tick(10.0)
+        a = sc.evaluate()
+        if a:
+            assert a["replica"] == "r2"
+    assert launcher.stopped == ["r2"]
+    # Nothing owned left: the down branch is a no-op, boot replicas stay.
+    for _ in range(10):
+        clock.tick(10.0)
+        sc.evaluate()
+    assert {r.rid for r in reg.replicas()} == {"r0", "r1"}
+
+
+def test_phantom_down_never_consumes_the_cooldown():
+    # Launcher owns nothing: the down branch finds no victim, and that
+    # non-action must not stamp the cooldown — a genuine scale-up right
+    # after an idle stretch has to fire on schedule.
+    sc, reg, launcher, clock = make_scaler(
+        n=2, arrival_rps=0.1, est_req_s=10.0, min_replicas=1, up_after=1)
+    launcher.owns = lambda rid: False
+    for _ in range(8):  # well past down_after: still no victim, no stamp
+        clock.tick(10.0)
+        assert sc.evaluate() is None
+    assert launcher.stopped == []
+    # Load spikes: the very next pass must scale up, not sit in a
+    # cooldown a phantom down armed.
+    for i in range(2):
+        reg.update_load(f"r{i}", hot_digest(arrival_rps=50.0, est_req_s=10.0))
+    clock.tick(1.0)
+    action = sc.evaluate()
+    assert action is not None and action["action"] == "up"

@@ -1,0 +1,390 @@
+"""Capacity-observatory end-to-end (slow tier): the closed control loop
+over REAL replica subprocesses.
+
+Three acceptance stories (ISSUE 14):
+
+1. ``--admission auto`` under a rising open-loop load converges the
+   router's ``max_inflight`` toward the knee an OFFLINE ``load_curve``
+   sweep measures — zero operator tuning, with the whole story visible in
+   ``/fleetz`` and ``obs summary``.
+2. A replica spawned against a warm persistent compilation cache reaches
+   first token by a pinned ratio faster than the cache-cold arm.
+3. A propagated incident scales the fleet up: the router hands the
+   incident to the autoscaler, which spawns a warm replica through the
+   real SubprocessLauncher.
+
+Multi-minute territory (every replica is a full `edgemesh serve` process
+compiling a tiny model on its CPU slice) — nightly slow-e2e CI only.
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPLICA_YAML = """
+agents:
+  - role: qa
+    model: {family: llama, num_layers: 1, hidden_size: 32, num_heads: 4,
+            num_kv_heads: 4, intermediate_size: 64}
+    sampling: {max_new_tokens: 4, do_sample: false, repetition_penalty: 1.0}
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_replica(cfg_path: Path, port: int,
+                   extra: tuple = ()) -> subprocess.Popen:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-m", "edgemesh.cli", "serve",
+         "--config", str(cfg_path), "--port", str(port),
+         "--continuous", "--batch", "2", *extra],
+        env=env, cwd=Path(__file__).resolve().parent.parent,
+    )
+
+
+def _wait_ready(transport, ports, timeout_s=300.0):
+    from edgemesh.fleet.transport import TransportError
+
+    deadline = time.monotonic() + timeout_s
+    pending = set(ports)
+    while pending and time.monotonic() < deadline:
+        for port in list(pending):
+            try:
+                status, _ = transport.get_json(
+                    f"http://127.0.0.1:{port}/readyz", timeout_s=2.0)
+            except TransportError:
+                continue
+            if status == 200:
+                pending.discard(port)
+        time.sleep(0.25)
+    assert not pending, f"replicas on {sorted(pending)} never became ready"
+
+
+def _stop(procs):
+    for proc in procs:
+        if proc.poll() is None:
+            proc.terminate()
+    for proc in procs:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _first_token_wall(transport, cfg, port, extra, timeout_s=600.0):
+    """Spawn one replica and return spawn→first-200-from-/generate."""
+    from edgemesh.fleet.transport import TransportError
+
+    t0 = time.monotonic()
+    proc = _spawn_replica(cfg, port, extra)
+    try:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            assert proc.poll() is None, \
+                f"replica exited rc={proc.returncode} during boot"
+            try:
+                status, _ = transport.post_json(
+                    f"http://127.0.0.1:{port}/generate",
+                    {"question": "cold start probe?"}, timeout_s=60.0)
+            except TransportError:
+                time.sleep(0.2)
+                continue
+            if status == 200:
+                return time.monotonic() - t0
+            time.sleep(0.2)
+        pytest.fail("replica never answered its first token")
+    finally:
+        _stop([proc])
+
+
+def test_warm_start_beats_cold_by_the_pinned_ratio(tmp_path):
+    """Acceptance (b): a compile-cache-hit spawn reaches first token at
+    most 0.8x the cache-cold arm's wall. The cold arm POPULATES the cache
+    the warm arm hits — same process image, same config, one variable."""
+    from edgemesh.fleet import HttpTransport
+
+    transport = HttpTransport()
+    cfg = tmp_path / "replica.yaml"
+    cfg.write_text(REPLICA_YAML)
+    cache = tmp_path / "compile-cache"
+    cache.mkdir()
+    extra = ("--compile-cache-dir", str(cache))
+    cold_s = _first_token_wall(transport, cfg, _free_port(), extra)
+    entries = [p for p in cache.iterdir() if p.name.endswith("-cache")]
+    if not entries:
+        pytest.skip("this jax cannot persist its compilation cache on CPU")
+    warm_s = _first_token_wall(transport, cfg, _free_port(), extra)
+    ratio = warm_s / cold_s
+    print(f"cold {cold_s:.1f}s -> warm {warm_s:.1f}s (ratio {ratio:.2f}, "
+          f"{len(entries)} cache entries)")
+    # The pinned ratio: warm start must beat cold by >= 20%. On this
+    # 1-layer model compile dominates boot, so real runs land far lower;
+    # 0.8 keeps the gate robust to CI noise.
+    assert ratio <= 0.8, (
+        f"warm start did not beat cold: {warm_s:.1f}s vs {cold_s:.1f}s")
+
+
+def test_admission_auto_converges_to_the_measured_knee(tmp_path):
+    """Acceptance (a): the knee tracker, fed only by the router's own
+    per-window observations, lands max_inflight in the neighborhood of the
+    knee an offline open-loop sweep measures — and the story is visible in
+    /fleetz and `obs summary`."""
+    from edgemesh.fleet import (
+        FleetRouter,
+        HealthProber,
+        HttpTransport,
+        ReplicaRegistry,
+        serve_fleet,
+    )
+    from edgemesh.loadgen import (
+        LengthMix,
+        OpenLoopGenerator,
+        PoissonProcess,
+        TenantSpec,
+        Workload,
+        http_target,
+        run_curve,
+    )
+    from edgemesh.obs import Registry
+
+    transport = HttpTransport()
+    cfg = tmp_path / "replica.yaml"
+    cfg.write_text(REPLICA_YAML)
+    ports = [_free_port() for _ in range(2)]
+    procs = [_spawn_replica(cfg, p) for p in ports]
+    front = prober = None
+    try:
+        _wait_ready(transport, ports)
+        for p in ports:
+            status, _ = transport.post_json(
+                f"http://127.0.0.1:{p}/generate", {"question": "warmup?"},
+                timeout_s=600.0)
+            assert status == 200
+
+        prompt_mix = LengthMix(median=60, sigma=0.0, lo=60, hi=60)
+
+        def make_workload(rate, seed=5):
+            return Workload([TenantSpec(
+                name="load", arrival=PoissonProcess(max(0.2, rate), seed=11),
+                prompt_mix=prompt_mix)], seed=seed)
+
+        def boot_fleet(admission_auto):
+            obs = Registry()
+            registry = ReplicaRegistry(
+                (f"replica-{i}", f"http://127.0.0.1:{p}")
+                for i, p in enumerate(ports))
+            router = FleetRouter(
+                registry, balancer="least_outstanding", transport=transport,
+                obs_registry=obs, max_attempts=1, attempt_timeout_s=120.0,
+                default_deadline_s=120.0, max_inflight=32,
+                admission_auto=admission_auto, admission_floor=2,
+                admission_ceiling=64,
+                span_log=(tmp_path / "router.jsonl") if admission_auto else None,
+            )
+            prober = HealthProber(registry, transport=transport,
+                                  interval_s=1.0,
+                                  on_incident=router.observe_incident,
+                                  on_digest=router.note_digest).start()
+            front = serve_fleet(router, host="127.0.0.1", port=0,
+                                block=False)
+            url = f"http://127.0.0.1:{front.server_address[1]}/generate"
+            return router, prober, front, http_target(url, timeout_s=120.0)
+
+        # ---- Offline sweep: the reference knee, measured open-loop.
+        router, prober, front, target = boot_fleet(admission_auto=False)
+        t_cal = time.perf_counter() + 2.5
+        served = 0
+        while time.perf_counter() < t_cal:
+            s, _ = target({"question": "calibration?"}, {})
+            served += 1 if s == 200 else 0
+        capacity_rps = max(0.5, served / 2.5)
+        slo_s = float(os.environ.get("EDGEMESH_SLO_TTFT_S", "2.0"))
+
+        def make_run(rate):
+            gen = OpenLoopGenerator(
+                target, make_workload(rate).build_schedule(4.0),
+                slo_latency_s=slo_s, duration_s=4.0)
+            return gen.run()
+
+        curve = run_curve(make_run,
+                          [round(capacity_rps * f, 3) for f in (0.5, 1.5, 3.0)])
+        offline_knee_rps = curve["knee_offered_rps"]
+        prober.stop()
+        front.shutdown()
+        assert offline_knee_rps is not None
+
+        # ---- Online: --admission auto under a RISING open-loop load.
+        router, prober, front, target = boot_fleet(admission_auto=True)
+        assert router.tuner is not None
+        for phase_rate in (0.8 * capacity_rps, 2.0 * capacity_rps,
+                           3.5 * capacity_rps):
+            gen = OpenLoopGenerator(
+                target, make_workload(phase_rate).build_schedule(6.0),
+                slo_latency_s=slo_s, duration_s=6.0)
+            gen.run()
+        tuner = router.tuner.status()
+        print("tuner:", json.dumps(tuner))
+        # Zero operator tuning: the controller observed real windows and
+        # holds a live knee estimate in the neighborhood of the offline
+        # sweep's (generous tolerance — two 1-core replicas under a GIL
+        # are a noisy instrument; the CLAIM is closed-loop consistency).
+        assert tuner["windows"] >= 5
+        knee = tuner["knee"]["knee_offered_rps"]
+        assert knee is not None
+        assert knee == pytest.approx(offline_knee_rps, rel=1.0)
+        # The limit moved off its static guess and stayed inside the
+        # configured band: the loop is CLOSED.
+        assert 2 <= tuner["limit"] <= 64
+        assert tuner["limit"] != 32 or tuner["windows"] < 3
+
+        # ---- Visible everywhere: /fleetz carries tuner + capacity,
+        # obs summary reports the knee row from the router span log.
+        status, fleetz = transport.get_json(
+            f"http://127.0.0.1:{front.server_address[1]}/fleetz",
+            timeout_s=10.0)
+        assert status == 200
+        assert fleetz["admission"]["tuner"]["mode"] == "auto"
+        assert fleetz["admission"]["tuner"]["limit"] == tuner["limit"]
+        assert fleetz["capacity"]["fleet_est_req_s"] is not None
+        assert fleetz["capacity"]["fleet_arrival_rps"] is not None
+        out = subprocess.run(
+            [sys.executable, "-m", "edgemesh.cli", "obs", "summary",
+             str(tmp_path / "router.jsonl")],
+            capture_output=True, text=True,
+            cwd=Path(__file__).resolve().parent.parent)
+        assert out.returncode == 0, out.stderr
+        report = json.loads(out.stdout)
+        assert report["knee"] is not None
+        assert report["knee"]["limit"] == tuner["limit"] or \
+            report["knee"]["action"] in ("increase", "decrease")
+    finally:
+        if prober is not None:
+            prober.stop()
+        if front is not None:
+            front.shutdown()
+        _stop(procs)
+
+
+def test_incident_scales_the_fleet_up_with_a_warm_spawn(tmp_path):
+    """Acceptance (c): a propagated incident reaches the autoscaler
+    through the router and a REAL warm replica joins rotation, with the
+    event visible in /fleetz and the cold-start metric stamped."""
+    from edgemesh.fleet import (
+        AutoScaler,
+        FleetRouter,
+        HealthProber,
+        HttpTransport,
+        ReplicaRegistry,
+        serve_fleet,
+    )
+    from edgemesh.fleet.cli import SubprocessLauncher
+    from edgemesh.obs import Registry
+
+    transport = HttpTransport()
+    cfg = tmp_path / "replica.yaml"
+    cfg.write_text(REPLICA_YAML)
+    cache = tmp_path / "compile-cache"
+    cache.mkdir()
+    port = _free_port()
+    procs = [_spawn_replica(cfg, port,
+                            ("--compile-cache-dir", str(cache)))]
+    front = prober = scaler = None
+    launcher = None
+    try:
+        _wait_ready(transport, [port])
+        obs = Registry()
+        registry = ReplicaRegistry([("replica-0", f"http://127.0.0.1:{port}")])
+        router = FleetRouter(registry, transport=transport, obs_registry=obs,
+                             max_attempts=2, attempt_timeout_s=120.0)
+        args = argparse.Namespace(config=str(cfg),
+                                  replica_extra="--continuous --batch 2",
+                                  compile_cache_dir=str(cache))
+        launcher = SubprocessLauncher(args, registry, transport,
+                                      obs_registry=obs)
+        scaler = AutoScaler(registry, launcher, router=router,
+                            min_replicas=1, max_replicas=2,
+                            # This test's fleet is idle: block the
+                            # scale-DOWN path so it cannot reap the
+                            # incident spawn mid-assertion.
+                            down_after=10**6,
+                            interval_s=0.5, obs_registry=obs)
+        router.autoscaler = scaler
+        prober = HealthProber(registry, transport=transport, interval_s=1.0,
+                              on_incident=router.observe_incident,
+                              on_digest=router.note_digest).start()
+        scaler.start()
+        front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+
+        # The incident arrives exactly as the prober would deliver it.
+        assert router.observe_incident(
+            "replica-0", {"id": "inc-e2e-1", "kind": "slo_burst"}) is True
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            if len(registry.available()) >= 2:
+                break
+            time.sleep(0.5)
+        assert len(registry.available()) >= 2, \
+            "incident did not scale the fleet up"
+
+        # The new replica actually serves through the frontend.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{front.server_address[1]}/generate",
+            data=json.dumps({"question": "post-scale question?"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+
+        # Visible: /fleetz autoscale event + metrics.
+        status, fleetz = transport.get_json(
+            f"http://127.0.0.1:{front.server_address[1]}/fleetz",
+            timeout_s=10.0)
+        assert status == 200
+        events = fleetz["autoscale"]["recent_events"]
+        assert any(e["action"] == "incident_up" and e["incident"] == "inc-e2e-1"
+                   for e in events)
+        summary = obs.summary()
+        assert summary[
+            'edgemesh_autoscale_events_total{action="incident_up"}'] == 1
+        cold = [k for k in summary
+                if k.startswith("edgemesh_cold_start_seconds")]
+        assert cold, "cold-start telemetry missing"
+        # The spawned replica's digest proves the shared cache engaged.
+        deadline = time.monotonic() + 30.0
+        cache_block = None
+        while time.monotonic() < deadline:
+            reps = {r.rid: r for r in registry.replicas()}
+            scaled = next((r for rid, r in reps.items()
+                           if rid.startswith("replica-scale")), None)
+            if scaled is not None and isinstance(scaled.load, dict):
+                cache_block = scaled.load.get("compile_cache")
+                if cache_block:
+                    break
+            time.sleep(0.5)
+        assert cache_block is not None and cache_block["enabled"] is True
+    finally:
+        if prober is not None:
+            prober.stop()
+        if scaler is not None:
+            scaler.stop()
+        if launcher is not None:
+            launcher.stop_all()
+        if front is not None:
+            front.shutdown()
+        _stop(procs)

@@ -1,0 +1,263 @@
+"""Knee-tracking admission (fleet/autotune.py) against synthetic curves.
+
+The tuner is driven with hand-built window patterns through an injected
+clock, so every control decision is deterministic: underload grows the
+limit to the ceiling, overload converges it near the knee, oscillating
+arrivals hold it steady (hysteresis), and an incident freezes tuning.
+"""
+
+import threading
+
+import pytest
+
+from edgemesh.fleet.admission import AdmissionController, TenantPolicy
+from edgemesh.fleet.autotune import TUNE_RECORD_EVENT, KneeTracker
+from edgemesh.obs import Registry
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def make_tuner(adm=None, **kw):
+    clock = Clock()
+    adm = adm or AdmissionController(max_inflight=kw.pop("max_inflight", 8))
+    kw.setdefault("floor", 2)
+    kw.setdefault("ceiling", 64)
+    kw.setdefault("window_s", 1.0)
+    kw.setdefault("patience", 2)
+    kw.setdefault("obs_registry", Registry())
+    tuner = KneeTracker(adm, now=clock, **kw)
+    return tuner, adm, clock
+
+
+def drive_window(tuner, clock, requests=20, good_frac=1.0, shed=0):
+    """One closed window: ``requests`` observations at ``good_frac``
+    goodness, then the clock steps past the window span and one more
+    observation closes it (counted into the NEXT window)."""
+    good_n = round(requests * good_frac)
+    for i in range(requests):
+        tuner.observe(answered=i >= shed, good=i < good_n,
+                      shed=i < shed)
+    clock.tick(tuner.window_s + 0.01)
+    tuner.observe(answered=True, good=True)
+
+
+def test_underload_grows_limit_to_ceiling():
+    tuner, adm, clock = make_tuner(max_inflight=4, ceiling=16, increase=4)
+    for _ in range(12):
+        drive_window(tuner, clock, good_frac=1.0)
+    assert adm.max_inflight == 16  # ceiling, never beyond
+    st = tuner.status()
+    assert st["limit"] == 16 and st["ceiling"] == 16
+    # Per-tenant rates scaled WITH the limit (16/4 = 4x).
+    assert st["rate_scale"] == pytest.approx(4.0)
+
+
+def test_overload_converges_near_the_knee():
+    # Closed-loop synthetic service with a true knee at concurrency 8:
+    # goodput ratio is 1.0 at/below the knee and degrades 8%/slot above
+    # it. The tuner must cut multiplicatively into the neighborhood of
+    # the knee and then HOLD (dead zone), not collapse to the floor.
+    knee = 8
+    tuner, adm, clock = make_tuner(max_inflight=32, floor=2, ceiling=64)
+    for _ in range(40):
+        ratio = min(1.0, max(0.0, 1.0 - 0.08 * (adm.max_inflight - knee)))
+        drive_window(tuner, clock, good_frac=ratio)
+    assert knee - 2 <= adm.max_inflight <= 2 * knee
+    # Converged, not flapping: another 10 windows move it by at most 1.
+    settled = adm.max_inflight
+    for _ in range(10):
+        ratio = min(1.0, max(0.0, 1.0 - 0.08 * (adm.max_inflight - knee)))
+        drive_window(tuner, clock, good_frac=ratio)
+    assert abs(adm.max_inflight - settled) <= 1
+
+
+def test_decrease_is_multiplicative_and_floored():
+    tuner, adm, clock = make_tuner(max_inflight=32, floor=4, decrease=0.5)
+    for _ in range(20):
+        drive_window(tuner, clock, good_frac=0.0)
+    assert adm.max_inflight == 4  # floor holds under sustained overload
+    assert tuner.status()["floor"] == 4
+
+
+def test_oscillating_windows_hold_the_limit():
+    # Alternating good/bad windows never build a patience=2 streak:
+    # hysteresis means the limit does not flap.
+    tuner, adm, clock = make_tuner(max_inflight=8)
+    for i in range(16):
+        drive_window(tuner, clock, good_frac=1.0 if i % 2 == 0 else 0.0)
+    assert adm.max_inflight == 8
+    # Dead-zone windows (between target and the bad band) also hold.
+    for _ in range(8):
+        drive_window(tuner, clock, good_frac=0.8)
+    assert adm.max_inflight == 8
+
+
+def test_incident_freeze_pauses_tuning_then_resumes():
+    tuner, adm, clock = make_tuner(max_inflight=16, freeze_s=5.0)
+    tuner.freeze(reason="incident:inc-1")
+    assert tuner.status()["frozen"] is True
+    for _ in range(4):
+        drive_window(tuner, clock, good_frac=0.0)
+    assert adm.max_inflight == 16  # bad windows measured, not acted on
+    clock.tick(10.0)  # past freeze_s
+    assert tuner.status()["frozen"] is False
+    for _ in range(4):
+        drive_window(tuner, clock, good_frac=0.0)
+    assert adm.max_inflight < 16  # control resumed
+
+
+def test_thin_windows_never_ratchet_the_limit():
+    # A near-idle window says nothing about the knee: below
+    # min_window_requests the tuner records nothing and holds.
+    tuner, adm, clock = make_tuner(max_inflight=8, min_window_requests=8)
+    for _ in range(10):
+        drive_window(tuner, clock, requests=2, good_frac=1.0)
+    assert adm.max_inflight == 8
+
+
+def test_knee_estimate_tracks_the_observed_curve():
+    # Feed two regimes: 20 req/window all good, then 40 req/window mostly
+    # bad — find_knee must put the knee at the good regime's offered load.
+    tuner, adm, clock = make_tuner(max_inflight=8)
+    for _ in range(4):
+        drive_window(tuner, clock, requests=20, good_frac=1.0)
+    for _ in range(4):
+        drive_window(tuner, clock, requests=40, good_frac=0.2)
+    knee = tuner.status()["knee"]
+    assert knee["knee_offered_rps"] == pytest.approx(20, rel=0.2)
+    assert knee["collapsed"] is True
+
+
+def test_tune_actions_land_in_the_span_log(tmp_path):
+    from edgemesh.utils.tracing import JsonlLogger
+
+    log_path = tmp_path / "router.jsonl"
+    adm = AdmissionController(max_inflight=4)
+    tuner, adm, clock = make_tuner(adm=adm, log=JsonlLogger(log_path))
+    for _ in range(4):
+        drive_window(tuner, clock, good_frac=1.0)
+    records = JsonlLogger(log_path).read()
+    tunes = [r for r in records if r.get("event") == TUNE_RECORD_EVENT]
+    assert tunes and tunes[-1]["action"] == "increase"
+    assert tunes[-1]["limit"] > 4
+    assert "window" in tunes[-1] and "knee_offered_rps" in tunes[-1]
+
+
+def test_validation():
+    adm = AdmissionController(max_inflight=8)
+    with pytest.raises(ValueError):
+        KneeTracker(adm, floor=0, obs_registry=Registry())
+    with pytest.raises(ValueError):
+        KneeTracker(adm, floor=8, ceiling=4, obs_registry=Registry())
+    with pytest.raises(ValueError):
+        KneeTracker(adm, decrease=1.5, obs_registry=Registry())
+
+
+# -- the admission seams the tuner drives -----------------------------------
+
+
+def test_set_max_inflight_grows_grant_queued_waiters():
+    adm = AdmissionController(max_inflight=1, queue_cap=4)
+    assert adm.acquire("t", wait_s=0.0) == "ok"  # pool now full
+    got = []
+
+    def waiter():
+        got.append(adm.acquire("t", wait_s=5.0))
+
+    th = threading.Thread(target=waiter, daemon=True)
+    th.start()
+    # The waiter is queued; growing the pool must grant it immediately.
+    import time
+
+    for _ in range(100):
+        if adm.stats()["waiting"]:
+            break
+        time.sleep(0.01)
+    adm.set_max_inflight(2)
+    th.join(timeout=5.0)
+    assert got == ["ok"]
+    adm.release()
+    adm.release()
+
+
+def test_set_max_inflight_shrink_never_revokes():
+    adm = AdmissionController(max_inflight=4)
+    for _ in range(4):
+        assert adm.acquire("t") == "ok"
+    adm.set_max_inflight(2)
+    assert adm.stats()["inflight"] == 4  # in-flight work finishes
+    assert adm.acquire("t") == "overload"  # but no new grants past the bound
+    for _ in range(4):
+        adm.release()
+    assert adm.acquire("t") == "ok"
+
+
+def test_set_rate_scale_rebuilds_tenant_buckets():
+    t = [0.0]
+    adm = AdmissionController(
+        max_inflight=8,
+        policies={"bulk": TenantPolicy(rate_per_s=2.0, burst=2.0)},
+        now=lambda: t[0],
+    )
+    assert adm.acquire("bulk") == "ok"
+    adm.release()
+    assert adm.acquire("bulk") == "ok"
+    adm.release()
+    assert adm.acquire("bulk") == "ratelimited"  # burst of 2 spent
+    # Halving the scale halves rate AND burst; a fresh bucket at 1 rps
+    # refills one token per second.
+    adm.set_rate_scale(0.5)
+    t[0] += 1.0
+    assert adm.acquire("bulk") == "ok"
+    adm.release()
+    assert adm.acquire("bulk") == "ratelimited"
+    assert adm.stats()["rate_scale"] == 0.5
+    # Unlimited tenants stay unlimited at any scale.
+    for _ in range(10):
+        assert adm.acquire("other") == "ok"
+        adm.release()
+
+
+def test_initial_limit_is_clamped_into_the_band():
+    # A default max_inflight above the configured ceiling must not serve
+    # out-of-band until the first decrease (found driving the fleet CLI).
+    adm = AdmissionController(max_inflight=64)
+    tuner, adm, clock = make_tuner(adm=adm, floor=2, ceiling=32)
+    assert adm.max_inflight == 32
+    assert tuner.status()["rate_scale"] == 1.0
+    adm2 = AdmissionController(max_inflight=1)
+    tuner2, adm2, _ = make_tuner(adm=adm2, floor=4, ceiling=32)
+    assert adm2.max_inflight == 4
+
+
+def test_set_rate_scale_never_refunds_a_burst():
+    # The tuner retunes every window: rebuilding buckets would hand each
+    # tenant a fresh burst per action, disabling its limit during a ramp.
+    # Rescale must preserve the current token level.
+    t = [0.0]
+    adm = AdmissionController(
+        max_inflight=8,
+        policies={"bulk": TenantPolicy(rate_per_s=1.0, burst=10.0)},
+        now=lambda: t[0],
+    )
+    for _ in range(10):  # spend the whole burst
+        assert adm.acquire("bulk") == "ok"
+        adm.release()
+    assert adm.acquire("bulk") == "ratelimited"
+    # A no-op-sized retune (scale 1.0 -> 1.01) must NOT refund tokens.
+    adm.set_rate_scale(1.01)
+    assert adm.acquire("bulk") == "ratelimited"
+    # Refill still follows the (scaled) rate.
+    t[0] += 1.0
+    assert adm.acquire("bulk") == "ok"
+    adm.release()
+    assert adm.acquire("bulk") == "ratelimited"

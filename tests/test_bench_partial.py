@@ -122,6 +122,7 @@ def test_headline_serving_schema_gains_ragged_and_spec_keys(monkeypatch, capsys)
     monkeypatch.setenv("EDGEMESH_BENCH_ADMIT", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
 
     out = benchmarks.headline_benchmark(preset="llama1b", batch=2,
@@ -233,6 +234,7 @@ def test_router_overhead_stage_schema_pins_recorder_arm(monkeypatch, capsys):
     monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
     out = benchmarks.headline_benchmark(preset="tiny", batch=2,
                                         decode_steps=8, sweep_batches=())
     assert out["router_overhead_p50_s"] == 0.0021
@@ -265,6 +267,7 @@ def test_router_overhead_stage_is_skippable_via_env(monkeypatch):
     monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
     out = benchmarks.headline_benchmark(preset="tiny", batch=2,
                                         decode_steps=8, sweep_batches=())
     assert not any(k.startswith(("router_overhead", "recorder_")) for k in out)
@@ -296,6 +299,7 @@ def test_load_curve_stage_is_skippable_via_env(monkeypatch, capsys):
     monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
     out = benchmarks.headline_benchmark(preset="tiny", batch=2,
                                         decode_steps=8, sweep_batches=())
     assert not any(k.startswith("load_curve") for k in out)
@@ -320,7 +324,8 @@ def _fake_stage1(monkeypatch):
 
 _TP8_GATES = ("EDGEMESH_BENCH_8B", "EDGEMESH_BENCH_SERVE",
               "EDGEMESH_BENCH_FLEET", "EDGEMESH_BENCH_SPEC",
-              "EDGEMESH_BENCH_LOADGEN", "EDGEMESH_BENCH_DISAGG")
+              "EDGEMESH_BENCH_LOADGEN", "EDGEMESH_BENCH_DISAGG",
+              "EDGEMESH_BENCH_AUTOSCALE")
 
 
 def test_tp8_stage_schema_pins(monkeypatch, capsys):
@@ -493,6 +498,7 @@ def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
     monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
 
     out = benchmarks.headline_benchmark(preset="tiny", batch=2, decode_steps=8,
                                         sweep_batches=())
@@ -507,3 +513,77 @@ def test_headline_stage1_emits_before_bf16(monkeypatch, capsys):
     assert out["value"] == 100.0
     assert "tunnel wedged" in out["bf16_error"]
     assert "int8_w8a8_error" in out  # later fenced stages also recorded
+
+
+def test_cold_start_and_autoscale_stage_schema_pins(monkeypatch, capsys):
+    """The capacity-observatory schema contract: a headline run carries the
+    warm cold-start-to-first-token headline with the cold/warm split and
+    cache-entry count, and the autoscale stage's time-to-scale plus the
+    knee tuner's final state — pinned with faked stages so a partial
+    artifact still has the keys PERFORMANCE.md's cold-start targets and
+    the acceptance gate read (no subprocesses spawned)."""
+    _fake_stage1(monkeypatch)
+    for gate in _TP8_GATES:
+        monkeypatch.setenv(gate, "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.delenv("EDGEMESH_BENCH_AUTOSCALE", raising=False)
+
+    def fake_cold_start(**kw):
+        return {"metric": "cold_start_first_token_s", "value": 4.2,
+                "unit": "s", "cold_start_cold_s": 21.0,
+                "cold_start_warm_s": 4.2,
+                "cold_start_warm_over_cold": 0.2,
+                "cold_start_cache_entries": 17}
+
+    def fake_autoscale(**kw):
+        return {"metric": "autoscale_time_to_scale_s", "value": 5.5,
+                "unit": "s", "autoscale_scaled": True,
+                "autoscale_replicas": 2,
+                "autoscale_events": [{"action": "up"}],
+                "autoscale_offered_rps": 12.0,
+                "autoscale_capacity_rps": 4.0,
+                "autoscale_goodput_ratio": 0.7,
+                "tuner_limit": 9,
+                "tuner_knee": {"knee_offered_rps": 4.1,
+                               "knee_goodput_rps": 3.9, "collapsed": True},
+                "tuner_windows": 12}
+
+    monkeypatch.setattr(benchmarks, "cold_start_benchmark", fake_cold_start)
+    monkeypatch.setattr(benchmarks, "autoscale_benchmark", fake_autoscale)
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert out["cold_start_first_token_s"] == 4.2
+    assert out["cold_start_cold_s"] == 21.0
+    assert out["cold_start_warm_s"] == 4.2
+    # The warm-start claim: the shared compilation cache beat cache-cold.
+    assert out["cold_start_warm_over_cold"] < 1.0
+    assert out["cold_start_cache_entries"] == 17
+    assert out["autoscale_time_to_scale_s"] == 5.5
+    assert out["autoscale_scaled"] is True
+    assert out["autoscale_replicas"] == 2
+    assert out["tuner_limit"] == 9
+    assert out["tuner_knee"]["knee_offered_rps"] == 4.1
+    assert out["tuner_windows"] == 12
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert "cold_start_first_token_s" in lines[-1]
+    assert "autoscale_time_to_scale_s" in lines[-1]
+
+
+def test_cold_start_and_autoscale_stages_are_skippable_via_env(monkeypatch):
+    """EDGEMESH_BENCH_AUTOSCALE=0 must skip BOTH capacity-observatory
+    stages entirely — no subprocess spawned, no replica booted, no keys,
+    no error recorded (mirrors the disagg gate)."""
+    _fake_stage1(monkeypatch)
+    for gate in _TP8_GATES:
+        monkeypatch.setenv(gate, "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+
+    def boom(**kw):
+        raise AssertionError("capacity stage ran despite the gate")
+
+    monkeypatch.setattr(benchmarks, "cold_start_benchmark", boom)
+    monkeypatch.setattr(benchmarks, "autoscale_benchmark", boom)
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert not any(k.startswith(("cold_start", "autoscale", "tuner_"))
+                   for k in out)

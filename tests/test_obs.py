@@ -288,6 +288,77 @@ def test_obs_cli_missing_file_is_usage_error(tmp_path, capsys):
     assert "no such span log" in capsys.readouterr().err
 
 
+def test_summary_capacity_rows_null_on_pre_capacity_logs(span_log, capsys):
+    # Forward-compat pin (like the pre-SLO/pre-tenant fields): a log from
+    # before the capacity model reports explicit nulls and exits 0.
+    from edgemesh.obs.cli import main as obs_main
+
+    assert obs_main(["summary", str(span_log)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["capacity"] is None
+    assert report["pool"] is None
+    assert report["knee"] is None
+
+
+def test_summary_reports_capacity_pool_and_knee_rows(tmp_path, capsys):
+    # A directory mixing a flight dump (digest snapshots carry the
+    # capacity/pool blocks) and a router log (admission_tune records
+    # carry the tuner's knee) — summary reports the newest of each.
+    from edgemesh.obs.cli import main as obs_main
+
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    flight = JsonlLogger(logdir / "flight-r0.jsonl")
+    flight.log("flight_snapshot", replica="r0",
+               capacity={"slots": 8, "est_tok_s": 100.0, "est_req_s": 5.0},
+               pool={"pages_total": 50, "pages_free": 10,
+                     "occupancy_ratio": 0.8, "fragmentation_ratio": 0.1,
+                     "free_page_headroom": 1})
+    flight.log("flight_snapshot", replica="r0",
+               capacity={"slots": 8, "est_tok_s": 120.0, "est_req_s": 6.0},
+               pool={"pages_total": 50, "pages_free": 30,
+                     "occupancy_ratio": 0.4, "fragmentation_ratio": 0.0,
+                     "free_page_headroom": 3})
+    router_log = JsonlLogger(logdir / "router.jsonl")
+    router_log.log("admission_tune", action="increase", limit=12,
+                   rate_scale=1.5, knee_offered_rps=9.5,
+                   knee_goodput_rps=9.1, collapsed=False)
+    assert obs_main(["summary", str(logdir)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["capacity"]["est_tok_s"] == 120.0  # newest snapshot wins
+    assert report["pool"]["occupancy_ratio"] == 0.4
+    assert report["knee"] == {
+        "action": "increase", "limit": 12, "rate_scale": 1.5,
+        "knee_offered_rps": 9.5, "knee_goodput_rps": 9.1,
+        "collapsed": False,
+    }
+
+
+def test_loadreport_json_mode(tmp_path, capsys):
+    # --json prints the machine-readable document; a curve assembled from
+    # raw points (no knee fields) gains them via the same find_knee math.
+    from edgemesh.obs.cli import main as obs_main
+
+    doc = {"points": [
+        {"offered_rps": 2.0, "goodput_rps": 2.0},
+        {"offered_rps": 4.0, "goodput_rps": 3.8},
+        {"offered_rps": 8.0, "goodput_rps": 1.0},
+    ], "slo_latency_s": 0.5}
+    path = tmp_path / "curve.json"
+    path.write_text(json.dumps(doc))
+    assert obs_main(["loadreport", str(path), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["knee_offered_rps"] == 4.0
+    assert out["collapsed"] is True
+    assert len(out["points"]) == 3
+    # Single-run reports round-trip verbatim.
+    run = {"scheduled": 10, "goodput_rps": 3.0, "tenants": None}
+    path2 = tmp_path / "run.json"
+    path2.write_text(json.dumps(run))
+    assert obs_main(["loadreport", str(path2), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out) == run
+
+
 def test_cli_routes_obs_subcommand(span_log, capsys):
     from edgemesh.cli import main as cli_main
 
