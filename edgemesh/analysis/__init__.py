@@ -21,6 +21,13 @@ API-drift bug classes BEFORE anything executes on a device:
   lint entry points, plus the ``SHARDING_CONTRACTS`` AbstractMesh dryrun
   (EM405): every public shard_map wrapper traced under tp2/tp8/dp2×tp4/
   pp2-style layouts on CPU, no devices required.
+- **wire** (``wire.py``): the protocol-contract pass over the fleet
+  fabric's hand-rolled HTTP/JSON surface — AST rules EM501-EM505
+  (unknown routes, header contracts, payload-key drift, schema
+  producer/consumer drift, response discipline) checked against the one
+  ``httputil.WIRE_CONTRACT`` table, plus the ``WIRE_CONTRACTS`` dryrun
+  (EM506): each server's SERVED_ROUTES dispatch table cross-checked
+  against the declared contract, stdlib-only, no sockets.
 
 CLI: ``python -m edgemesh.analysis [paths]`` or ``edgemesh lint [paths]``.
 Grandfathered findings live in ``baseline.json`` next to this module; the
@@ -41,10 +48,16 @@ def run_analysis(paths, *, contracts: bool = True):
     """Lint ``paths`` and (optionally) run the jax-importing semantic
     passes (eval_shape contracts + the AbstractMesh sharding dryrun).
 
-    Returns a list of Findings. Imports of the semantic passes are
-    deferred so pure-lint callers never pay the jax import.
+    The wire dryrun (EM506) imports nothing beyond the stdlib, so it runs
+    even when ``contracts=False`` — the route tables must never drift out
+    from under a pure-lint gate. Returns a list of Findings. Imports of
+    the jax-importing passes are deferred so pure-lint callers never pay
+    the jax import.
     """
     findings = lint_paths(paths)
+    from edgemesh.analysis.wire import run_wire_contracts
+
+    findings.extend(run_wire_contracts())
     if contracts:
         from edgemesh.analysis.contracts import run_contracts
         from edgemesh.analysis.sharding import run_sharding_contracts

@@ -30,7 +30,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m edgemesh.analysis",
         description="edgelint (AST rules) + abstract eval_shape contracts + "
-        "AbstractMesh sharding dryrun",
+        "AbstractMesh sharding dryrun + wire protocol-contract dryrun",
     )
     p.add_argument(
         "paths", nargs="*", default=None,
@@ -44,7 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--no-contracts", action="store_true",
         help="skip the semantic passes that import jax (the EM2xx eval_shape "
-        "contracts AND the EM405 AbstractMesh sharding dryrun); pure AST lint",
+        "contracts AND the EM405 AbstractMesh sharding dryrun); pure AST lint. "
+        "The stdlib-only wire dryrun (EM506) still runs",
     )
     p.add_argument(
         "--severity", choices=["error", "warning"], default="warning",
@@ -83,12 +84,32 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+#: Retired rule ids kept as spellable aliases: scripts that pinned the old
+#: ad-hoc fleet HTTP rules keep working, with a nudge toward the successor.
+_RETIRED_ALIASES = {"EM108": "EM502", "EM109": "EM502"}
+
+
 def _parse_rule_patterns(arg: str | None) -> list[str] | None:
     """Comma-separated rule patterns: exact IDs ('EM301') and prefix
-    wildcards spelled with trailing x's ('EM4xx' → every EM4 rule)."""
+    wildcards spelled with trailing x's ('EM4xx' → every EM4 rule).
+    Retired ids (EM108/EM109) translate to their successor with a
+    deprecation note on stderr."""
     if arg is None:
         return None
-    patterns = [p.strip().upper() for p in arg.split(",") if p.strip()]
+    patterns = []
+    for p in arg.split(","):
+        p = p.strip().upper()
+        if not p:
+            continue
+        if p in _RETIRED_ALIASES:
+            successor = _RETIRED_ALIASES[p]
+            print(
+                f"note: {p} was retired into the wire contract pass; "
+                f"selecting {successor} (see docs/ANALYSIS.md)",
+                file=sys.stderr,
+            )
+            p = successor
+        patterns.append(p)
     return patterns or None
 
 
@@ -176,6 +197,12 @@ def main(argv: list[str] | None = None) -> int:
     ignore = _parse_rule_patterns(args.ignore)
 
     findings: list[Finding] = lint_paths(paths)
+    # The wire dryrun (EM506) is stdlib-only — no jax import to skip — so
+    # it runs unconditionally: the route tables must never drift out from
+    # under a --no-contracts gate.
+    from edgemesh.analysis.wire import run_wire_contracts
+
+    findings.extend(run_wire_contracts())
     if not args.no_contracts:
         from edgemesh.analysis.contracts import run_contracts
         from edgemesh.analysis.sharding import run_sharding_contracts

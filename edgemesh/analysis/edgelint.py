@@ -34,28 +34,6 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   deltas. Result-payload windows use ``utils.tracing.Stopwatch`` or the
   handle ``trace()`` yields; clocks that ARE the obs instrumentation (or
   wait control flow) carry an inline disable.
-- EM108 fleet-missing-timeout (error): an outbound HTTP/socket call inside
-  ``edgemesh/fleet/`` without an explicit timeout (bare ``urlopen``,
-  ``socket.create_connection``, ``http.client.*Connection``) — the fleet's
-  whole job is surviving stalled replicas, and one unbounded read pins a
-  router thread forever. The router's retry/hedge math only holds if every
-  attempt returns in bounded time.
-- EM109 fleet-missing-trace-propagation (error): an outbound HTTP call in
-  ``edgemesh/fleet/`` (``post_json``/``get_json``/``urlopen``) that BUILDS
-  a ``headers=`` dict literal without the ``X-Edgemesh-Trace`` key (the
-  ``TRACE_HEADER`` constant counts; a ``**expansion`` is assumed to
-  forward it) — one request-path call site that drops the header severs
-  the distributed trace at exactly the hop tracing exists to explain.
-  Calls with no ``headers=`` at all (probes, drain admin) are out of
-  scope, as are opaque header variables the linter cannot see into.
-  KV TRANSFER calls are held to a stricter contract: a call whose URL
-  literally targets a ``/kv/`` path (``rep.url("/kv/export")``, an
-  f-string ending in ``/kv/import``) must ALSO carry the
-  ``X-Edgemesh-Deadline-S`` key (``DEADLINE_HEADER`` counts), and a
-  transfer call with no ``headers=`` at all flags — a transfer without a
-  deadline lets one slow export pin the tiered path past the client's
-  budget, and without a trace the cross-replica prefill hop vanishes
-  from the assembled tree.
 - EM110 serve-per-row-dispatch (error): a HOST loop in
   ``edgemesh/serve/`` that calls a jitted forward per iteration — a name
   imported from edgemesh.runtime/models matching ``forward_*``/
@@ -153,16 +131,6 @@ RULES: dict[str, dict] = {
         "severity": "warning",
         "summary": "raw wall-clock read in serve//runtime/ bypasses edgemesh.obs spans",
     },
-    "EM108": {
-        "name": "fleet-missing-timeout",
-        "severity": "error",
-        "summary": "outbound HTTP/socket call in edgemesh/fleet/ without an explicit timeout",
-    },
-    "EM109": {
-        "name": "fleet-missing-trace-propagation",
-        "severity": "error",
-        "summary": "outbound fleet HTTP call builds headers without the X-Edgemesh-Trace header",
-    },
     "EM110": {
         "name": "serve-per-row-dispatch",
         "severity": "error",
@@ -225,35 +193,6 @@ _DISABLE_RE = DISABLE_RE  # shared home: findings.py (concurrency.py uses it too
 # through the obs substrate. Path-substring match (like the EM101 allowlist)
 # so fixture tests with relative paths resolve the same everywhere.
 _EM107_DIRS = ("edgemesh/serve/", "edgemesh/runtime/")
-
-# EM108 scope + call table: outbound calls that accept a timeout, mapped to
-# the 0-based POSITIONAL index where the timeout can ride (None = kwarg
-# only). A call in edgemesh/fleet/ hitting this table without a ``timeout``
-# kwarg or enough positionals is flagged.
-_EM108_DIRS = ("edgemesh/fleet/",)
-# EM109 scope + call surface: the fleet's outbound HTTP seams. The rule
-# only judges call sites it can SEE building headers — a dict literal
-# (inline, or assigned to a simple local in the same function) missing the
-# trace-header key. The key is satisfied by the literal string or any
-# name/attribute ending in TRACE_HEADER; a ``**`` expansion is assumed to
-# forward it.
-_EM109_CALLS = {"post_json", "get_json"}
-_EM109_URLOPEN = "urllib.request.urlopen"
-_EM109_HEADER = "X-Edgemesh-Trace"
-# KV transfer calls (URL literally targeting a /kv/ path) additionally
-# require the deadline header — and unlike probes, a transfer with no
-# headers= at all is in scope: it is provably missing both.
-_EM109_DEADLINE_HEADER = "X-Edgemesh-Deadline-S"
-_EM109_KV_MARKER = "/kv/"
-_EM108_CALLS = {
-    "urllib.request.urlopen": 2,        # urlopen(url, data, timeout)
-    "socket.create_connection": 1,      # create_connection(address, timeout)
-    "http.client.HTTPConnection": 2,    # HTTPConnection(host, port, timeout)
-    "http.client.HTTPSConnection": 2,
-    "requests.get": None,               # kwarg-only (defensive: not a dep)
-    "requests.post": None,
-    "requests.request": None,
-}
 
 # EM110 scope + dispatch surface: host loops in the serving engine must not
 # re-grow per-row jitted dispatches (the pre-ragged wave structure). A name
@@ -550,8 +489,6 @@ class _FileLinter:
 
         self._rule_api_drift(tree)
         self._rule_raw_timing(tree)
-        self._rule_fleet_timeout(tree)
-        self._rule_fleet_trace(tree)
         self._rule_serve_row_dispatch(tree)
         self._rule_metric_naming(tree)
         self._rule_unbounded_label(tree)
@@ -650,155 +587,6 @@ class _FileLinter:
                     "edgemesh.obs.SpanTracker / utils.tracing.trace() (or "
                     "suppress: control-flow clocks and the obs "
                     "instrumentation itself are legitimate)",
-                )
-
-    # -- EM108 -------------------------------------------------------------
-
-    def _rule_fleet_timeout(self, tree: ast.Module) -> None:
-        if not any(d in self.relpath for d in _EM108_DIRS):
-            return
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            dotted = _dotted_name(node.func)
-            if not dotted:
-                continue
-            resolved = self.aliases.resolve(dotted)
-            if resolved not in _EM108_CALLS:
-                continue
-            pos = _EM108_CALLS[resolved]
-            has_timeout = any(kw.arg == "timeout" for kw in node.keywords) or (
-                pos is not None and len(node.args) > pos
-            )
-            if not has_timeout:
-                self._emit(
-                    "EM108", node,
-                    f"outbound {resolved}() without an explicit timeout — a "
-                    "stalled replica pins this fleet thread forever and the "
-                    "router's retry/hedge budget math breaks (pass "
-                    "timeout=..., or route through fleet.transport)",
-                )
-
-    # -- EM109 -------------------------------------------------------------
-
-    @staticmethod
-    def _dict_has_header(d: ast.Dict, literal: str, const_name: str) -> bool:
-        for key in d.keys:
-            if key is None:  # {**expansion}: assume the source forwards it
-                return True
-            if isinstance(key, ast.Constant) and key.value == literal:
-                return True
-            if isinstance(key, (ast.Name, ast.Attribute)):
-                dotted = _dotted_name(key)
-                if dotted and dotted.rsplit(".", 1)[-1] == const_name:
-                    return True
-        return False
-
-    @classmethod
-    def _dict_has_trace_header(cls, d: ast.Dict) -> bool:
-        return cls._dict_has_header(d, _EM109_HEADER, "TRACE_HEADER")
-
-    @classmethod
-    def _dict_has_deadline_header(cls, d: ast.Dict) -> bool:
-        return cls._dict_has_header(d, _EM109_DEADLINE_HEADER,
-                                    "DEADLINE_HEADER")
-
-    @staticmethod
-    def _call_targets_kv_transfer(node: ast.Call) -> bool:
-        """True when the call's URL expression LITERALLY names a /kv/ path
-        — a constant, an f-string piece, or a ``rep.url("/kv/export")``
-        argument. Opaque URLs (a variable, ``rep.url(path)``) are out of
-        scope, same visibility contract as the headers-dict rule."""
-        if not node.args:
-            return False
-        for sub in ast.walk(node.args[0]):
-            if (
-                isinstance(sub, ast.Constant)
-                and isinstance(sub.value, str)
-                and _EM109_KV_MARKER in sub.value
-            ):
-                return True
-        return False
-
-    def _headers_dict_for_call(self, node: ast.Call) -> ast.Dict | None:
-        """The headers dict literal this call passes, following one level of
-        simple local assignment (``headers = {...}`` earlier in the same
-        function). Returns None when there is no headers kwarg or its value
-        is opaque (a call, an attribute, a parameter...)."""
-        value = next(
-            (kw.value for kw in node.keywords if kw.arg == "headers"), None
-        )
-        if value is None:
-            return None
-        if isinstance(value, ast.Dict):
-            return value
-        if isinstance(value, ast.Name):
-            scopes = self._scope_stack_for_line(node.lineno)
-            fn = scopes[-1] if scopes else None
-            if fn is None:
-                return None
-            best = None
-            for sub in ast.walk(fn):
-                if (
-                    isinstance(sub, ast.Assign)
-                    and sub.lineno < node.lineno
-                    and isinstance(sub.value, ast.Dict)
-                    and any(
-                        isinstance(t, ast.Name) and t.id == value.id
-                        for t in sub.targets
-                    )
-                ):
-                    best = sub.value  # last assignment before the call wins
-            return best
-        return None
-
-    def _rule_fleet_trace(self, tree: ast.Module) -> None:
-        if not any(d in self.relpath for d in _EM108_DIRS):
-            return
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            dotted = _dotted_name(node.func)
-            is_transport = (
-                isinstance(node.func, ast.Attribute)
-                and node.func.attr in _EM109_CALLS
-            )
-            is_urlopen = bool(
-                dotted and self.aliases.resolve(dotted) == _EM109_URLOPEN
-            )
-            if not (is_transport or is_urlopen):
-                continue
-            headers = self._headers_dict_for_call(node)
-            is_transfer = self._call_targets_kv_transfer(node)
-            if headers is None:
-                if is_transfer:
-                    # A KV transfer with no headers at all is provably
-                    # missing both required keys — flag it; plain probes
-                    # and admin calls stay out of scope.
-                    self._emit(
-                        "EM109", node,
-                        "KV transfer call sends no headers — every "
-                        f"/kv/ hop must carry {_EM109_HEADER!r} and "
-                        f"{_EM109_DEADLINE_HEADER!r} (trace continuity + "
-                        "the router's budget math)",
-                    )
-                continue
-            if not self._dict_has_trace_header(headers):
-                self._emit(
-                    "EM109", node,
-                    "outbound fleet HTTP call builds headers without "
-                    f"{_EM109_HEADER!r} — the distributed trace severs at "
-                    "this hop (add httputil.TRACE_HEADER: ctx.to_header(), "
-                    "or forward the incoming headers)",
-                )
-            if is_transfer and not self._dict_has_deadline_header(headers):
-                self._emit(
-                    "EM109", node,
-                    "KV transfer call builds headers without "
-                    f"{_EM109_DEADLINE_HEADER!r} — a transfer that ignores "
-                    "the request budget lets one slow export pin the "
-                    "tiered path past the client's deadline (add "
-                    "httputil.DEADLINE_HEADER)",
                 )
 
     # -- EM110 -------------------------------------------------------------
@@ -905,8 +693,8 @@ class _FileLinter:
     def _em112_value_ok(self, value: ast.AST, call_line: int,
                         _seen: frozenset = frozenset()) -> bool:
         """True when a label value visibly flows through bounded_label (or
-        is a constant / a trusted pre-normalized name). Mirrors EM109's
-        provenance style: one function-local assignment chain is followed;
+        is a constant / a trusted pre-normalized name). Mirrors the wire
+        pass's (EM502) provenance style: one function-local assignment chain is followed;
         anything the linter cannot see into is trusted, anything it CAN
         see as raw (subscripts, non-normalizer calls) flags."""
         if isinstance(value, ast.Constant):
@@ -995,7 +783,7 @@ class _FileLinter:
 
     def _em113_dict_for_arg(self, arg: ast.AST, call_line: int) -> ast.Dict | None:
         """The dict literal behind a ``json.dumps`` argument, following one
-        level of simple local assignment (EM109's provenance style)."""
+        level of simple local assignment (the wire pass's provenance style)."""
         if isinstance(arg, ast.Dict):
             return arg
         if isinstance(arg, ast.Name):
@@ -1240,16 +1028,19 @@ def lint_file(path: str | Path) -> list[Finding]:
 def lint_source(source: str, path: str = "<memory>") -> list[Finding]:
     """Lint a source string (the fixture-test entry point): the per-function
     AST rules (EM1xx), the class-level concurrency pass (EM3xx), and the
-    sharding/collective pass (EM401-EM404)."""
+    sharding/collective pass (EM401-EM404), and the wire protocol-contract
+    pass (EM501-EM505)."""
     # Lazy imports: the sibling passes are not dependencies of the EM1xx
     # machinery, and importing them at module top would be a cycle (both
     # reuse linter internals).
     from edgemesh.analysis.concurrency import analyze_source
     from edgemesh.analysis.sharding import analyze_source as analyze_sharding
+    from edgemesh.analysis.wire import analyze_source as analyze_wire
 
     findings = _FileLinter(path, source).run()
     findings.extend(analyze_source(source, path))
     findings.extend(analyze_sharding(source, path))
+    findings.extend(analyze_wire(source, path))
     findings.sort(key=lambda f: (f.line, f.rule))
     return findings
 

@@ -1,0 +1,1052 @@
+"""Static HTTP/JSON protocol-contract analysis for the fleet fabric (EM5xx).
+
+The fleet fabric is a hand-rolled HTTP/JSON surface: ~15 routes
+string-dispatched in serve/rest.py and fleet/frontend.py, a few dozen
+client call sites across fleet/, loadgen/, and benchmarks.py, and schema'd
+dicts (load digests, /fleetz, span records) produced in one process and
+consumed in another. Every historical bug class here — a typo'd digest key
+silently ``.get()``-defaulting in the balancer, a header dropped on one of
+five propagation paths — ships past the fast tier and only dies in
+slow-tier e2e. This pass is the wire's equivalent of the sharding pass
+(analysis/sharding.py): the protocol is declared ONCE, in
+``httputil.WIRE_CONTRACT``, and everything else is checked against it.
+
+**Layer 1 — AST rules** (standard ``lint_source``/baseline/disable/
+``--select`` machinery; same suppression comments):
+
+- **EM501 unknown-route (error).** A client call — ``post_json`` /
+  ``get_json`` / ``urlopen`` / connection ``.request`` — whose URL path
+  the pass can resolve (a literal, the trailing constant of an f-string,
+  a ``base + "/path"`` concatenation, a ``rep.url("/path")`` argument, or
+  a ``httputil`` path constant, through one level of local assignment)
+  that matches no declared route, or a route served under a different
+  method. Opaque URLs (a parameter, a config value) are out of scope —
+  same visibility contract as the old header rule.
+
+- **EM502 header-contract (error).** The per-route required/forwarded
+  header sets live in WIRE_CONTRACT — this rule SUBSUMES the retired
+  ad-hoc EM108 (fleet-dial-timeout) and EM109 (fleet-trace-header), whose
+  hardcoded requirements became contract rows. Client side (fleet/ only,
+  like its ancestors): a call that builds a headers mapping for a route
+  must include each required header (the literal, any name ending in the
+  ``httputil`` constant's name, or a ``**`` expansion); a route marked
+  ``strict_headers`` (the KV transfer hops) flags even with no headers
+  mapping at all; raw dials (``urlopen``/``HTTPConnection``/...) without
+  a timeout keep the EM108 check under this id. Handler side (the two
+  server files): the dispatch scope serving the route — the functions
+  containing its path literal plus their self-call closure — must read
+  each required/forwarded header via the matching ``httputil.read_*``
+  helper.
+
+- **EM503 payload-key-drift (error).** Client side: keys of a dict
+  literal POSTed to a resolved route must be declared in the route's
+  ``request_keys``. Handler side (server files): every ``payload.get()``/
+  subscript read of a request body must be a key some declared route for
+  that server carries — the classic typo'd-key bug, caught from both
+  ends. Handler reads are checked against the union of the server's
+  declared keys because dispatch helpers are shared across routes.
+
+- **EM504 schema-drift (error).** For the registered cross-process dict
+  schemas (``WIRE_SCHEMAS``: load digest + capacity model, the /readyz
+  body, /fleetz, router trace records): every consumer-side key read must
+  appear in some producer-side write (dict literal, subscript store,
+  ``setdefault``, ``dict(k=...)``). Consumers are named functions with
+  seed receiver names; derivation follows ``.get()`` chains, subscripts,
+  ``or {}`` guards, local rebinding, and loop targets — the same
+  descend-through-helpers pragmatics the concurrency pass uses.
+
+- **EM505 response-discipline (warning).** A handler answering 5xx with a
+  dict literal that lacks the structured ``"kind"`` vocabulary (a bare
+  500 tells the fleet router nothing), and a client function that makes
+  transport calls and branches on 503 without ever mentioning
+  ``Retry-After`` (the shed contract: 503 always carries it).
+
+**Layer 2 — the wire dryrun** (EM506, like the sharding pass's EM405):
+``WIRE_CONTRACTS`` registers each server's live dispatch table
+(``SERVED_ROUTES`` in serve/rest.py and fleet/frontend.py — the table the
+404 branch actually consults, so it cannot go stale), and
+``run_wire_contracts()`` imports it and cross-checks against the static
+contract: a route registered but undeclared, declared but unserved, or
+served under a different method fails in seconds with no sockets. Both
+server modules are stdlib-only at import time, so the dryrun runs even
+under ``--no-contracts``.
+
+``edgemesh obs routes`` renders the contract table; docs/ANALYSIS.md
+documents the rules and docs/FLEET.md the protocol they guard.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from edgemesh.analysis.edgelint import _Aliases as _EdgelintAliases
+from edgemesh.analysis.edgelint import _dotted_name as _dotted
+from edgemesh.analysis.findings import DISABLE_RE, Finding, repo_relative
+from edgemesh.serve import httputil
+
+WIRE_RULES: dict[str, dict] = {
+    "EM501": {
+        "name": "unknown-route",
+        "severity": "error",
+        "summary": "client call targets a path or method no WIRE_CONTRACT route declares",
+    },
+    "EM502": {
+        "name": "header-contract",
+        "severity": "error",
+        "summary": "required wire header missing at a client site or never read by the handler",
+    },
+    "EM503": {
+        "name": "payload-key-drift",
+        "severity": "error",
+        "summary": "POSTed payload key or handler body read outside the route's declared keys",
+    },
+    "EM504": {
+        "name": "schema-drift",
+        "severity": "error",
+        "summary": "consumer reads a schema key no registered producer writes",
+    },
+    "EM505": {
+        "name": "response-discipline",
+        "severity": "warning",
+        "summary": "bare 5xx without the structured error vocabulary, or 503 handled without Retry-After",
+    },
+}
+
+#: The Layer-2 dryrun rule — separate table, like SHARDING_CONTRACT_RULES,
+#: because its findings come from ``run_wire_contracts()``, not from
+#: ``analyze_source``.
+WIRE_CONTRACT_RULES: dict[str, dict] = {
+    "EM506": {
+        "name": "wire-dryrun-failure",
+        "severity": "error",
+        "summary": "a server's live dispatch table disagrees with WIRE_CONTRACT",
+    },
+}
+
+# -- contract plumbing shared by the rules -----------------------------------
+
+#: Which repo file implements each server named in WIRE_CONTRACT rows.
+#: Path-substring matched (like the EM107 dirs) so fixture tests with
+#: relative paths resolve the same everywhere.
+WIRE_SERVERS: dict[str, str] = {
+    "gateway": "edgemesh/serve/rest.py",
+    "frontend": "edgemesh/fleet/frontend.py",
+}
+
+#: Client-side header/timeout obligations apply here (the fleet's outbound
+#: seams — the scope the retired EM108/EM109 judged). EM501/EM503/EM505
+#: client checks run package-wide.
+WIRE_CLIENT_DIRS = ("edgemesh/fleet/",)
+
+#: header value -> the httputil read helper a handler must call for it.
+READ_HELPERS: dict[str, str] = {
+    httputil.DEADLINE_HEADER: "read_deadline_header",
+    httputil.TRACE_HEADER: "read_trace_header",
+    httputil.TENANT_HEADER: "read_tenant_header",
+    httputil.SESSION_HEADER: "read_session_header",
+}
+
+#: header value -> the exported constant name (a headers-dict key written
+#: as ``httputil.TRACE_HEADER`` or a local ``TRACE_HEADER`` import counts).
+HEADER_CONSTS: dict[str, str] = {
+    httputil.DEADLINE_HEADER: "DEADLINE_HEADER",
+    httputil.TRACE_HEADER: "TRACE_HEADER",
+    httputil.TENANT_HEADER: "TENANT_HEADER",
+    httputil.SESSION_HEADER: "SESSION_HEADER",
+}
+
+#: httputil path-constant names, so ``rep.url(KV_EXPORT_PATH)`` resolves.
+PATH_CONSTS: dict[str, str] = {
+    "KV_EXPORT_PATH": httputil.KV_EXPORT_PATH,
+    "KV_IMPORT_PATH": httputil.KV_IMPORT_PATH,
+}
+
+# The EM108 dial table, now a contract policy under EM502: outbound calls
+# that accept a timeout, mapped to the 0-based positional index where the
+# timeout can ride (None = kwarg only).
+_DIAL_CALLS = {
+    "urllib.request.urlopen": 2,        # urlopen(url, data, timeout)
+    "socket.create_connection": 1,      # create_connection(address, timeout)
+    "http.client.HTTPConnection": 2,    # HTTPConnection(host, port, timeout)
+    "http.client.HTTPSConnection": 2,
+    "requests.get": None,               # kwarg-only (defensive: not a dep)
+    "requests.post": None,
+    "requests.request": None,
+}
+
+_TRANSPORT_CALLS = {"post_json": "POST", "get_json": "GET"}
+_URLOPEN = "urllib.request.urlopen"
+
+# -- EM504 schema registry ----------------------------------------------------
+#
+# Each schema names the functions that PRODUCE its dict shape (keys are
+# collected from dict literals, subscript stores, ``setdefault``, and
+# ``dict(k=...)`` anywhere in those functions) and the functions that
+# CONSUME it (with the local names the schema document is bound to — reads
+# derived from those names are checked against the produced key set).
+# Producer files are parsed lazily from the repo root and cached.
+
+WIRE_SCHEMAS: dict[str, dict] = {
+    "load_digest": {
+        "doc": "per-replica load digest (+ capacity model) — GET /loadz, "
+               "piggybacked on /readyz; what the telemetry balancer and "
+               "autoscaler weigh replicas by",
+        "producers": (
+            ("edgemesh/serve/rest.py", "_load_digest"),
+            ("edgemesh/serve/continuous.py", "load_digest"),
+            ("edgemesh/serve/continuous.py", "estimate_capacity"),
+        ),
+        "consumers": (
+            ("edgemesh/fleet/balancer.py", "_cost", ("load",)),
+            ("edgemesh/fleet/balancer.py", "_prefill_share", ("load",)),
+            ("edgemesh/fleet/autoscale.py", "_demand_supply", ("load",)),
+            ("edgemesh/fleet/autoscale.py", "evaluate", ("load",)),
+            ("edgemesh/fleet/health.py", "probe_once", ("load",)),
+        ),
+    },
+    "readyz_body": {
+        "doc": "GET /readyz response — readiness + live inflight count "
+               "(the drain poll) + the piggybacked digest",
+        "producers": (
+            ("edgemesh/serve/rest.py", "do_GET"),
+        ),
+        "consumers": (
+            ("edgemesh/fleet/health.py", "_probe", ("body",)),
+            ("edgemesh/fleet/router.py", "drain_replica", ("body",)),
+        ),
+    },
+    "fleet_status": {
+        "doc": "GET /fleetz document (FleetRouter.status) — what "
+               "`edgemesh fleet status` renders",
+        "producers": (
+            ("edgemesh/fleet/router.py", "status"),
+            ("edgemesh/fleet/router.py", "_account_tenant"),
+            ("edgemesh/fleet/registry.py", "to_dict"),
+            ("edgemesh/fleet/autoscale.py", "status"),
+            ("edgemesh/fleet/autoscale.py", "evaluate"),
+            ("edgemesh/fleet/autotune.py", "status"),
+            ("edgemesh/fleet/admission.py", "stats"),
+            ("edgemesh/loadgen/curve.py", "find_knee"),
+        ),
+        "consumers": (
+            ("edgemesh/fleet/cli.py", "cmd_status", ("body",)),
+        ),
+    },
+    "trace_record": {
+        "doc": "router-side sampled trace record (request span + attempt "
+               "spans) — /fleetz summaries and /debug/traces/<id>",
+        "producers": (
+            ("edgemesh/fleet/router.py", "_finish_trace"),
+            ("edgemesh/fleet/router.py", "_attempt_one"),
+            ("edgemesh/fleet/router.py", "_route"),
+        ),
+        "consumers": (
+            ("edgemesh/fleet/router.py", "recent_traces", ("rec", "s")),
+            ("edgemesh/fleet/router.py", "get_trace", ("rec", "match")),
+        ),
+    },
+}
+
+#: Repo root for resolving producer files (tests repoint this at a tmp
+#: tree when exercising EM504 fixtures).
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+
+#: produced-key cache: (schema, repo_root) -> frozenset of keys, or None
+#: when no producer file was readable (the check then stays silent rather
+#: than flagging everything).
+_SCHEMA_CACHE: dict[tuple[str, str], frozenset | None] = {}
+
+
+def _schema_produced_keys(schema: str) -> frozenset | None:
+    cache_key = (schema, str(_REPO_ROOT))
+    if cache_key in _SCHEMA_CACHE:
+        return _SCHEMA_CACHE[cache_key]
+    keys: set[str] = set()
+    saw_producer = False
+    for relpath, func in WIRE_SCHEMAS[schema]["producers"]:
+        p = _REPO_ROOT / relpath
+        try:
+            tree = ast.parse(p.read_text())
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == func):
+                saw_producer = True
+                keys |= _produced_keys(node)
+    result = frozenset(keys) if saw_producer else None
+    _SCHEMA_CACHE[cache_key] = result
+    return result
+
+
+def _produced_keys(fn: ast.AST) -> set[str]:
+    """Every string key this function writes into a dict shape: literal
+    dict keys, ``x["k"] = ...`` stores, ``.setdefault("k", ...)``, and
+    ``dict(k=...)`` keywords."""
+    keys: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Store):
+            if (isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                keys.add(node.slice.value)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "setdefault":
+                if (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    keys.add(node.args[0].value)
+            elif isinstance(f, ast.Name) and f.id == "dict":
+                keys.update(kw.arg for kw in node.keywords if kw.arg)
+    return keys
+
+
+# -- route resolution ---------------------------------------------------------
+
+
+def _path_from_string(s: str) -> str | None:
+    """The request path inside a URL-ish string constant."""
+    if s.startswith("/"):
+        return s
+    if "://" in s:
+        rest = s.split("://", 1)[1]
+        return "/" + rest.split("/", 1)[1] if "/" in rest else None
+    return None
+
+
+def _contract_route(method: str, path: str):
+    """The (key, row) for a resolved request path, honoring prefix routes
+    (``/debug/traces/<id>``). None when nothing matches under any method;
+    the second element of the miss is the set of methods that DO serve the
+    path, so EM501 can say "wrong method" instead of "unknown"."""
+    base = httputil.route_base(path)
+    hit = httputil.WIRE_CONTRACT.get((method, base))
+    if hit is not None:
+        return (method, base), hit
+    for (m, p), row in httputil.WIRE_CONTRACT.items():
+        if row.get("prefix") and base.startswith(p):
+            if m == method:
+                return (m, p), row
+    other = {m for (m, p), row in httputil.WIRE_CONTRACT.items()
+             if p == base or (row.get("prefix") and base.startswith(p))}
+    return None, other
+
+
+class _FileWire:
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.relpath = repo_relative(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.disabled: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = DISABLE_RE.search(line)
+            if m:
+                self.disabled[i] = {r.strip() for r in m.group(1).split(",")}
+
+    # -- shared emit machinery (the concurrency pass's shape) ----------------
+
+    def _scopes_for_line(self, line: int) -> list[ast.AST]:
+        return [
+            s for s in self._all_scopes
+            if s.lineno <= line <= getattr(s, "end_lineno", s.lineno)
+        ]
+
+    def _suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.disabled.get(line, ()):
+            return True
+        for scope in self._scopes_for_line(line):
+            if rule in self.disabled.get(scope.lineno, ()):
+                return True
+        return False
+
+    def _context_for_line(self, line: int) -> str:
+        best = ""
+        for s in self._scopes_for_line(line):
+            best = s.name if not best else f"{best}.{s.name}"
+        return best
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if self._suppressed(rule, line):
+            return
+        self.findings.append(
+            Finding(
+                rule=rule,
+                severity=WIRE_RULES[rule]["severity"],
+                path=self.relpath,
+                line=line,
+                message=message,
+                context=self._context_for_line(line),
+                line_text=(self.lines[line - 1].strip()
+                           if line <= len(self.lines) else ""),
+            )
+        )
+
+    def _enclosing_fn(self, line: int):
+        fns = [s for s in self._scopes_for_line(line)
+               if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        return fns[-1] if fns else None
+
+    def _fn_text(self, fn: ast.AST) -> str:
+        end = getattr(fn, "end_lineno", fn.lineno)
+        return "\n".join(self.lines[fn.lineno - 1:end])
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source)
+        except SyntaxError:
+            return []  # edgelint already reports EM000 for this file
+        self.tree = tree
+        self.aliases = _EdgelintAliases()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                self.aliases.visit_import(node)
+            elif isinstance(node, ast.ImportFrom):
+                self.aliases.visit_import_from(node)
+        self._all_scopes = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef))
+        ]
+        self._functions: dict[str, list[ast.AST]] = {}
+        for n in self._all_scopes:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._functions.setdefault(n.name, []).append(n)
+
+        in_client_dirs = any(d in self.relpath for d in WIRE_CLIENT_DIRS)
+        self._check_client_calls(tree, in_client_dirs)
+        if in_client_dirs:
+            self._check_dial_timeouts(tree)
+        self._check_response_discipline(tree)
+
+        server = next(
+            (name for name, f in WIRE_SERVERS.items() if f in self.relpath),
+            None,
+        )
+        if server is not None:
+            self._check_handlers(server)
+
+        self._check_schemas(tree)
+
+        seen: set[tuple] = set()
+        unique: list[Finding] = []
+        for f in sorted(self.findings, key=lambda f: (f.line, f.rule)):
+            key = (f.rule, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        self.findings = unique
+        return self.findings
+
+    # -- client side: EM501, EM502, EM503 ------------------------------------
+
+    def _resolve_path_expr(self, expr: ast.AST, call_line: int,
+                           depth: int = 0) -> str | None:
+        """Best-effort request path of a URL expression (see module
+        docstring: literal, trailing f-string constant, concatenation,
+        ``rep.url("/path")``, httputil path constant, one level of local
+        assignment)."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return _path_from_string(expr.value)
+        if isinstance(expr, ast.JoinedStr):
+            consts = [v.value for v in expr.values
+                      if isinstance(v, ast.Constant)
+                      and isinstance(v.value, str) and "/" in v.value]
+            if consts:
+                last = consts[-1]
+                return last[last.index("/"):]
+            return None
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            right = self._resolve_path_expr(expr.right, call_line, depth)
+            if right is not None:
+                return right
+            return None
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            dotted = _dotted(expr)
+            if dotted:
+                tail = dotted.rsplit(".", 1)[-1]
+                if tail in PATH_CONSTS:
+                    return PATH_CONSTS[tail]
+            if isinstance(expr, ast.Name) and depth < 2:
+                fn = self._enclosing_fn(call_line)
+                if fn is None:
+                    return None
+                best = None
+                for sub in ast.walk(fn):
+                    if (isinstance(sub, ast.Assign)
+                            and sub.lineno < call_line
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == expr.id
+                                    for t in sub.targets)):
+                        best = sub.value  # last assignment before the call
+                if best is not None:
+                    return self._resolve_path_expr(best, call_line, depth + 1)
+            return None
+        if isinstance(expr, ast.Call):
+            # ``rep.url("/drain")`` / ``rep.url(KV_EXPORT_PATH)``: any call
+            # whose first argument resolves to a path.
+            if expr.args:
+                return self._resolve_path_expr(expr.args[0], call_line,
+                                               depth + 1)
+            return None
+        return None
+
+    def _classify_transport_call(self, node: ast.Call):
+        """(method, url_expr, payload_expr) for a recognized outbound HTTP
+        call, else None."""
+        if isinstance(node.func, ast.Attribute):
+            verb = _TRANSPORT_CALLS.get(node.func.attr)
+            if verb is not None and node.args:
+                payload = node.args[1] if (verb == "POST"
+                                           and len(node.args) > 1) else None
+                return verb, node.args[0], payload
+            if node.func.attr == "request" and len(node.args) >= 2:
+                m = node.args[0]
+                if isinstance(m, ast.Constant) and isinstance(m.value, str):
+                    return m.value.upper(), node.args[1], None
+        dotted = _dotted(node.func)
+        if dotted and self.aliases.resolve(dotted) == _URLOPEN:
+            has_data = len(node.args) > 1 or any(
+                kw.arg == "data" for kw in node.keywords)
+            if node.args:
+                return ("POST" if has_data else "GET"), node.args[0], None
+        return None
+
+    def _headers_dict_for_call(self, node: ast.Call) -> ast.Dict | None:
+        """The headers dict literal this call passes, following one level
+        of simple local assignment — same visibility contract the retired
+        EM109 had."""
+        value = next(
+            (kw.value for kw in node.keywords if kw.arg == "headers"), None
+        )
+        if value is None:
+            return None
+        if isinstance(value, ast.Dict):
+            return value
+        if isinstance(value, ast.Name):
+            fn = self._enclosing_fn(node.lineno)
+            if fn is None:
+                return None
+            best = None
+            for sub in ast.walk(fn):
+                if (isinstance(sub, ast.Assign)
+                        and sub.lineno < node.lineno
+                        and isinstance(sub.value, ast.Dict)
+                        and any(isinstance(t, ast.Name) and t.id == value.id
+                                for t in sub.targets)):
+                    best = sub.value  # last assignment before the call wins
+            return best
+        return None
+
+    @staticmethod
+    def _dict_has_header(d: ast.Dict, literal: str, const_name: str) -> bool:
+        for key in d.keys:
+            if key is None:  # {**expansion}: assume the source forwards it
+                return True
+            if isinstance(key, ast.Constant) and key.value == literal:
+                return True
+            if isinstance(key, (ast.Name, ast.Attribute)):
+                dotted = _dotted(key)
+                if dotted and dotted.rsplit(".", 1)[-1] == const_name:
+                    return True
+        return False
+
+    def _check_client_calls(self, tree: ast.Module,
+                            in_client_dirs: bool) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = self._classify_transport_call(node)
+            if hit is None:
+                continue
+            method, url_expr, payload_expr = hit
+            path = self._resolve_path_expr(url_expr, node.lineno)
+            if path is None:
+                continue  # opaque URL: out of static reach
+            key, row = _contract_route(method, path)
+            if key is None:
+                served_as = row  # methods that do serve the path
+                if served_as:
+                    self._emit(
+                        "EM501", node,
+                        f"{httputil.route_base(path)!r} is served as "
+                        f"{'/'.join(sorted(served_as))}, not {method} — "
+                        "this call can only 404/405 (httputil.WIRE_CONTRACT)",
+                    )
+                else:
+                    self._emit(
+                        "EM501", node,
+                        f"{method} {httputil.route_base(path)!r} matches no "
+                        "route in httputil.WIRE_CONTRACT — declare the "
+                        "route (and serve it) or fix the path",
+                    )
+                continue
+            if in_client_dirs:
+                self._check_client_headers(node, key, row)
+            if payload_expr is not None:
+                self._check_client_payload(node, payload_expr, key, row)
+
+    def _check_client_headers(self, node: ast.Call, key, row: dict) -> None:
+        required = row.get("required_headers", ())
+        if not required:
+            return
+        has_kwarg = any(kw.arg == "headers" for kw in node.keywords)
+        headers = self._headers_dict_for_call(node)
+        route = f"{key[0]} {key[1]}"
+        if headers is None:
+            if has_kwarg:
+                return  # opaque headers variable: trusted, like EM109 did
+            if row.get("strict_headers"):
+                self._emit(
+                    "EM502", node,
+                    f"{route} call sends no headers mapping — the contract "
+                    f"marks this route strict: every hop must carry "
+                    f"{', '.join(repr(h) for h in required)} "
+                    "(trace continuity + the router's budget math)",
+                )
+            return
+        for header in required:
+            if not self._dict_has_header(headers, header,
+                                         HEADER_CONSTS.get(header, header)):
+                self._emit(
+                    "EM502", node,
+                    f"{route} call builds headers without {header!r} — "
+                    "required by its httputil.WIRE_CONTRACT row (add "
+                    f"httputil.{HEADER_CONSTS.get(header, header)}, or "
+                    "forward the incoming headers)",
+                )
+
+    def _check_client_payload(self, node: ast.Call, payload_expr: ast.AST,
+                              key, row: dict) -> None:
+        d = payload_expr
+        if isinstance(d, ast.Name):
+            fn = self._enclosing_fn(node.lineno)
+            if fn is None:
+                return
+            best = None
+            for sub in ast.walk(fn):
+                if (isinstance(sub, ast.Assign)
+                        and sub.lineno < node.lineno
+                        and isinstance(sub.value, ast.Dict)
+                        and any(isinstance(t, ast.Name) and t.id == d.id
+                                for t in sub.targets)):
+                    best = sub.value
+            d = best
+        if not isinstance(d, ast.Dict):
+            return  # opaque payload: out of static reach
+        declared = set(row.get("request_keys", ()))
+        for k in d.keys:
+            if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and k.value not in declared):
+                self._emit(
+                    "EM503", node,
+                    f"payload key {k.value!r} POSTed to {key[1]} is not in "
+                    "the route's declared request_keys "
+                    f"({sorted(declared) or 'none'}) — the handler will "
+                    "never read it (httputil.WIRE_CONTRACT)",
+                )
+
+    def _check_dial_timeouts(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if not dotted:
+                continue
+            resolved = self.aliases.resolve(dotted)
+            if resolved not in _DIAL_CALLS:
+                continue
+            pos = _DIAL_CALLS[resolved]
+            has_timeout = any(kw.arg == "timeout" for kw in node.keywords) or (
+                pos is not None and len(node.args) > pos
+            )
+            if not has_timeout:
+                self._emit(
+                    "EM502", node,
+                    f"outbound {resolved}() without an explicit timeout — a "
+                    "stalled replica pins this fleet thread forever and the "
+                    "router's retry/hedge budget math breaks (pass "
+                    "timeout=..., or route through fleet.transport)",
+                )
+
+    # -- EM505: response discipline ------------------------------------------
+
+    def _check_response_discipline(self, tree: ast.Module) -> None:
+        # Server half: 5xx answered with a dict literal lacking "kind".
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            code_arg = payload_arg = None
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_send" and len(node.args) >= 2):
+                code_arg, payload_arg = node.args[0], node.args[1]
+            else:
+                dotted = _dotted(node.func)
+                if (dotted and dotted.rsplit(".", 1)[-1] == "send_json"
+                        and len(node.args) >= 3):
+                    code_arg, payload_arg = node.args[1], node.args[2]
+            if not (isinstance(code_arg, ast.Constant)
+                    and isinstance(code_arg.value, int)
+                    and code_arg.value >= 500):
+                continue
+            if not isinstance(payload_arg, ast.Dict):
+                continue
+            if any(isinstance(k, ast.Constant) and k.value == "kind"
+                   for k in payload_arg.keys):
+                continue
+            self._emit(
+                "EM505", node,
+                f"bare {code_arg.value} without the structured error "
+                "vocabulary — add a \"kind\" field (e.g. \"internal\", "
+                "\"kv_wire\") so clients can branch on failure class "
+                "instead of parsing messages",
+            )
+        # Client half: a function that dials out and branches on 503 must
+        # mention Retry-After somewhere (the shed contract carries it).
+        for fn in self._functions_with_transport_calls(tree):
+            text = self._fn_text(fn)
+            if "Retry-After" in text or "RETRY_AFTER" in text:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Constant) and node.value == 503:
+                    self._emit(
+                        "EM505", node,
+                        "this function treats 503 responses but never "
+                        "honors Retry-After — shed replies always carry it "
+                        "(httputil.RETRY_AFTER_HEADER); back off by it "
+                        "before retrying",
+                    )
+                    break
+
+    def _functions_with_transport_calls(self, tree: ast.Module):
+        for fn in self._all_scopes:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and self._classify_transport_call(node) is not None):
+                    yield fn
+                    break
+
+    # -- handler side: EM502 + EM503 on the server files ---------------------
+
+    def _called_names(self, fn: ast.AST) -> set[str]:
+        names: set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                names.add(node.func.attr)
+            elif isinstance(node.func, ast.Name):
+                names.add(node.func.id)
+        return names
+
+    def _dispatch_closure(self, roots: list[ast.AST]) -> list[ast.AST]:
+        """roots + every file-local function reachable through self-calls
+        and bare calls — the concurrency pass's descent, flattened."""
+        closure: list[ast.AST] = []
+        seen: set[int] = set()
+        stack = list(roots)
+        while stack:
+            fn = stack.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            closure.append(fn)
+            for name in self._called_names(fn):
+                stack.extend(self._functions.get(name, ()))
+        return closure
+
+    def _fns_with_path_literal(self, path: str) -> list[ast.AST]:
+        out = []
+        for fns in self._functions.values():
+            for fn in fns:
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Constant)
+                            and node.value == path):
+                        out.append(fn)
+                        break
+                    if isinstance(node, (ast.Name, ast.Attribute)):
+                        dotted = _dotted(node)
+                        tail = dotted.rsplit(".", 1)[-1] if dotted else ""
+                        if PATH_CONSTS.get(tail) == path:
+                            out.append(fn)
+                            break
+        return out
+
+    def _check_handlers(self, server: str) -> None:
+        rows = [(key, row) for key, row in httputil.WIRE_CONTRACT.items()
+                if server in row.get("servers", ())]
+        all_dispatch: list[ast.AST] = []
+        for (method, path), row in rows:
+            roots = self._fns_with_path_literal(path)
+            if not roots:
+                continue  # declared-but-unserved is the dryrun's call (EM506)
+            closure = self._dispatch_closure(roots)
+            all_dispatch.extend(roots)
+            helpers_called = set()
+            for fn in closure:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        dotted = _dotted(node.func)
+                        if dotted:
+                            helpers_called.add(dotted.rsplit(".", 1)[-1])
+            for header in (tuple(row.get("required_headers", ()))
+                           + tuple(row.get("forwarded_headers", ()))):
+                helper = READ_HELPERS.get(header)
+                if helper and helper not in helpers_called:
+                    self._emit(
+                        "EM502", roots[0],
+                        f"handler for {method} {path} never reads "
+                        f"{header!r} — the contract requires "
+                        f"httputil.{helper}() somewhere in its dispatch "
+                        "path (propagation severs at this server)",
+                    )
+        # EM503 handler half: every body read must be a declared key.
+        declared_keys = set()
+        for _key, row in rows:
+            declared_keys |= set(row.get("request_keys", ()))
+        for fn in self._dispatch_closure(all_dispatch):
+            self._check_handler_payload_reads(fn, declared_keys)
+
+    def _payload_names(self, fn: ast.AST) -> set[str]:
+        """Local names bound to a parsed request body in this function: a
+        parameter literally named ``payload``, or a local assigned from
+        ``self._read_json()`` / ``read_json_body(...)``."""
+        names = {a.arg for a in fn.args.args if a.arg == "payload"}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            dotted = _dotted(node.value.func)
+            tail = dotted.rsplit(".", 1)[-1] if dotted else ""
+            if tail in ("_read_json", "read_json_body"):
+                names.update(t.id for t in node.targets
+                             if isinstance(t, ast.Name))
+        return names
+
+    def _check_handler_payload_reads(self, fn: ast.AST,
+                                     declared: set[str]) -> None:
+        names = self._payload_names(fn)
+        if not names:
+            return
+        for node in ast.walk(fn):
+            key = None
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in names
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                key = node.args[0].value
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in names
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                key = node.slice.value
+            if key is not None and key not in declared:
+                self._emit(
+                    "EM503", node,
+                    f"handler reads payload key {key!r} that no declared "
+                    "route for this server carries — a typo here "
+                    "silently .get()-defaults forever "
+                    "(httputil.WIRE_CONTRACT request_keys)",
+                )
+
+    # -- EM504: schema producer/consumer drift -------------------------------
+
+    def _check_schemas(self, tree: ast.Module) -> None:
+        for schema, spec in WIRE_SCHEMAS.items():
+            for entry in spec["consumers"]:
+                relpath, func, seeds = entry
+                if relpath not in self.relpath:
+                    continue
+                produced = _schema_produced_keys(schema)
+                if produced is None:
+                    continue  # no producer readable: stay silent, not wrong
+                for fn in self._functions.get(func, ()):
+                    self._check_consumer_fn(fn, schema, set(seeds),
+                                            produced, spec)
+
+    def _check_consumer_fn(self, fn: ast.AST, schema: str, seeds: set[str],
+                           produced: frozenset, spec: dict) -> None:
+        derived = set(seeds)
+
+        def derives(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Name):
+                return expr.id in derived
+            if isinstance(expr, ast.Subscript):
+                return derives(expr.value)
+            if isinstance(expr, ast.Call):
+                f = expr.func
+                if isinstance(f, ast.Attribute) and f.attr in (
+                        "get", "items", "values", "pop", "setdefault"):
+                    return derives(f.value)
+                return False
+            if isinstance(expr, ast.BoolOp):
+                return any(derives(v) for v in expr.values)
+            if isinstance(expr, ast.IfExp):
+                return derives(expr.body) or derives(expr.orelse)
+            return False
+
+        def bind(target: ast.AST) -> None:
+            if isinstance(target, ast.Name):
+                derived.add(target.id)
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for el in target.elts:
+                    bind(el)
+
+        # Fixed point: derivation flows through rebinding and loop targets
+        # in any statement order.
+        for _ in range(4):
+            before = len(derived)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and derives(node.value):
+                    for t in node.targets:
+                        bind(t)
+                elif isinstance(node, ast.For) and derives(node.iter):
+                    bind(node.target)
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp, ast.DictComp)):
+                    for gen in node.generators:
+                        if derives(gen.iter):
+                            bind(gen.target)
+            if len(derived) == before:
+                break
+
+        for node in ast.walk(fn):
+            key = None
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and derives(node.func.value)):
+                key = node.args[0].value
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                    and derives(node.value)):
+                key = node.slice.value
+            if key is not None and key not in produced:
+                producers = ", ".join(
+                    f"{f}:{fname}" for f, fname in spec["producers"])
+                self._emit(
+                    "EM504", node,
+                    f"reads {key!r} from the {schema!r} schema, but no "
+                    f"registered producer writes it ({producers}) — "
+                    "drifted key or dead read (analysis/wire.py "
+                    "WIRE_SCHEMAS)",
+                )
+
+
+def analyze_source(source: str, path: str = "<memory>") -> list[Finding]:
+    """Wire-pass entry point (mirrors edgelint.lint_source)."""
+    return _FileWire(path, source).run()
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: the wire dryrun (EM506)
+# ---------------------------------------------------------------------------
+#
+# Same shape as the sharding pass's EM405 AbstractMesh dryrun: a registry
+# of contracts, each checked by importing the LIVE artifact and
+# cross-checking it against the static declaration. Both server modules
+# are stdlib-only at import time (no accelerator, no sockets), so this
+# runs in the fast tier — and even under --no-contracts.
+
+WIRE_CONTRACTS: list[dict] = [
+    {
+        "server": "gateway",
+        "module": "edgemesh.serve.rest",
+        "table": "SERVED_ROUTES",
+        "path": "edgemesh/serve/rest.py",
+    },
+    {
+        "server": "frontend",
+        "module": "edgemesh.fleet.frontend",
+        "table": "SERVED_ROUTES",
+        "path": "edgemesh/fleet/frontend.py",
+    },
+]
+
+
+def _declared_routes(server: str) -> dict[str, set[str]]:
+    declared: dict[str, set[str]] = {}
+    for (method, path), row in httputil.WIRE_CONTRACT.items():
+        if server in row.get("servers", ()):
+            declared.setdefault(method, set()).add(path)
+    return declared
+
+
+def _check_wire_contract(entry: dict) -> list[Finding]:
+    import importlib
+
+    server, relpath = entry["server"], entry["path"]
+    findings: list[Finding] = []
+
+    def fail(msg: str) -> None:
+        findings.append(Finding(
+            rule="EM506",
+            severity=WIRE_CONTRACT_RULES["EM506"]["severity"],
+            path=relpath,
+            line=1,
+            message=f"wire contract {server!r}: {msg}",
+            context=server,
+        ))
+
+    try:
+        mod = importlib.import_module(entry["module"])
+        served_table = getattr(mod, entry.get("table", "SERVED_ROUTES"))
+        served = {m: set(paths) for m, paths in served_table.items()}
+    except Exception as exc:  # the exception IS the finding, like EM405
+        fail(f"dispatch table unimportable: {type(exc).__name__}: {exc}")
+        return findings
+
+    declared = _declared_routes(server)
+    for method in sorted(set(served) | set(declared)):
+        s = served.get(method, set())
+        d = declared.get(method, set())
+        for p in sorted(s - d):
+            others = sorted(m for m, paths in declared.items()
+                            if p in paths and m != method)
+            if others:
+                fail(f"{method} {p} is served but WIRE_CONTRACT declares it "
+                     f"under {'/'.join(others)} — method mismatch")
+                for m in others:
+                    declared[m].discard(p)  # consumed: not also "unserved"
+            else:
+                fail(f"{method} {p} is served but undeclared — add its "
+                     "httputil.WIRE_CONTRACT row")
+        for p in sorted(d - s):
+            others = sorted(m for m, paths in served.items()
+                            if p in paths and m != method)
+            if not others:  # method mismatch already reported above
+                fail(f"{method} {p} is declared but this server never "
+                     "serves it — dead contract row or missing handler")
+    return findings
+
+
+def run_wire_contracts(contracts: list[dict] | None = None) -> list[Finding]:
+    """Cross-check every registered server dispatch table against
+    ``httputil.WIRE_CONTRACT``. Seconds, no sockets, no accelerator."""
+    findings: list[Finding] = []
+    for entry in (WIRE_CONTRACTS if contracts is None else contracts):
+        findings.extend(_check_wire_contract(entry))
+    return findings

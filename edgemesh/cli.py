@@ -10,9 +10,10 @@ Subcommands:
                  its roadmap's "After Finetuning" rows were never started)
 - ``compare``  — paired bootstrap comparison of two eval runs (the
                  spreadsheet the reference eyeballed, with error bars)
-- ``lint``     — static analysis: edgelint AST rules (EM1xx/EM3xx/EM4xx),
-                 the abstract eval_shape contract pass (EM2xx), and the
-                 AbstractMesh sharding dryrun (EM405); filter with
+- ``lint``     — static analysis: edgelint AST rules (EM1xx/EM3xx/EM4xx/
+                 EM5xx), the abstract eval_shape contract pass (EM2xx),
+                 the AbstractMesh sharding dryrun (EM405), and the wire
+                 protocol-contract dryrun (EM506); filter with
                  --select/--ignore (python -m edgemesh.analysis)
 - ``obs``      — tail/summarize request-span JSONL logs and dump registry
                  snapshots (edgemesh.obs; docs/OBSERVABILITY.md)

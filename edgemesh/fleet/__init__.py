@@ -27,7 +27,7 @@ service (docs/FLEET.md is the operator-facing reference):
 
 Importing this package never imports jax (the router runs on hosts with no
 accelerator at all — same contract as edgemesh.obs), and every outbound
-call carries an explicit timeout (enforced by edgelint EM108).
+call carries an explicit timeout (enforced by the wire pass, EM502).
 """
 
 from edgemesh.fleet.balancer import (  # noqa: F401

@@ -37,6 +37,17 @@ from edgemesh.serve import httputil
 
 log = logging.getLogger("edgemesh.fleet")
 
+#: Every route this frontend answers, by method — consulted for the
+#: unknown-path 404 and cross-checked against ``httputil.WIRE_CONTRACT``
+#: by the wire dryrun (analysis/wire.py, EM506). The trailing-``/`` entry
+#: is a prefix route: ``/debug/traces/<id>``.
+SERVED_ROUTES: dict[str, tuple[str, ...]] = {
+    "GET": ("/", "/healthz", "/readyz", "/fleetz", "/metrics",
+            "/debug/traces/"),
+    "POST": ("/generate", "/replicas/register", "/replicas/deregister",
+             "/replicas/drain"),
+}
+
 
 def _make_handler(router, request_timeout_s: float | None):
     class Handler(BaseHTTPRequestHandler):
@@ -51,6 +62,12 @@ def _make_handler(router, request_timeout_s: float | None):
             httputil.send_text(self, code, text, content_type=content_type)
 
         def do_GET(self):
+            # Unknown paths 404 through the declared dispatch table (the
+            # wire dryrun's inventory) — same shape as serve/rest.py.
+            if not httputil.route_matches(httputil.route_base(self.path),
+                                          SERVED_ROUTES["GET"]):
+                self._send(404, {"error": f"unknown path {self.path}"})
+                return
             if self.path in ("/", "/healthz"):
                 self._send(200, {"status": "ok", "service": "edgemesh-fleet"})
             elif self.path == "/readyz":
@@ -84,6 +101,11 @@ def _make_handler(router, request_timeout_s: float | None):
 
         def do_POST(self):
             try:
+                if not httputil.route_matches(
+                        httputil.route_base(self.path),
+                        SERVED_ROUTES["POST"]):
+                    self._send(404, {"error": f"unknown path {self.path}"})
+                    return
                 if self.path == "/generate":
                     payload = self._read_json()
                     if payload is None:
@@ -119,7 +141,7 @@ def _make_handler(router, request_timeout_s: float | None):
             except Exception as exc:  # the frontend must survive bad requests
                 log.exception("fleet frontend request failed")
                 try:
-                    self._send(500, {"error": str(exc)})
+                    self._send(500, {"error": str(exc), "kind": "internal"})
                 except OSError:
                     pass
 

@@ -79,11 +79,15 @@ from edgemesh.obs.metrics import bounded_label
 from edgemesh.obs.slo import DecayingQuantile, SloTarget
 from edgemesh.obs.trace import ROUTER_RECORD_EVENT, TraceContext, sample
 from edgemesh.serve.httputil import (
+    ATTEMPTS_HEADER,
     DEADLINE_HEADER,
     KV_EXPORT_PATH,
     KV_IMPORT_PATH,
+    REPLICA_HEADER,
+    RETRY_AFTER_HEADER,
     SESSION_HEADER,
     TENANT_HEADER,
+    TIERED_HEADER,
     TRACE_HEADER,
 )
 
@@ -413,7 +417,7 @@ class FleetRouter:
             self._tenant_ratelimited.labels(tenant=label).inc()
             status, body, headers = 429, {
                 "error": "tenant rate limit exceeded", "tenant": label,
-            }, {"Retry-After": "1"}
+            }, {RETRY_AFTER_HEADER: "1"}
         elif verdict != "ok":
             reason = "overload" if verdict == "overload" else "queue_timeout"
             self._shed.labels(reason=reason).inc()
@@ -422,7 +426,7 @@ class FleetRouter:
                 "error": "router at capacity", "reason": reason,
                 # Live value: under --admission auto the tuner moves it.
                 "max_inflight": self.admission.max_inflight,
-            }, {"Retry-After": "1"}
+            }, {RETRY_AFTER_HEADER: "1"}
         else:
             self._inflight_gauge.inc()
             try:
@@ -548,7 +552,7 @@ class FleetRouter:
             if rep is None:
                 self._shed.labels(reason="no_replica").inc()
                 meta["outcome"] = "shed"
-                return 503, {"error": "no available replica"}, {"Retry-After": "1"}
+                return 503, {"error": "no available replica"}, {RETRY_AFTER_HEADER: "1"}
             outcome = self._dispatch(rep, payload, path, deadline, prompt,
                                      excluded, ctx, spans, meta, tenant=tenant,
                                      session=session)
@@ -560,8 +564,8 @@ class FleetRouter:
                 if meta["outcome"] != "hedged_won":
                     meta["outcome"] = "retried" if attempt else "ok"
                 return status, body, {
-                    "X-Edgemesh-Replica": rid,
-                    "X-Edgemesh-Attempts": str(attempt + 1),
+                    REPLICA_HEADER: rid,
+                    ATTEMPTS_HEADER: str(attempt + 1),
                 }
             failures = outcome[1]  # [(rid, reason, detail), ...]
             for rid, reason, detail in failures:
@@ -669,9 +673,9 @@ class FleetRouter:
             outcome="cache_hit" if from_cache else "tiered").inc()
         attempts = sum(1 for s in spans if s.get("name") == "attempt")
         return 200, answer, {
-            "X-Edgemesh-Replica": rid,
-            "X-Edgemesh-Attempts": str(attempts),
-            "X-Edgemesh-Tiered": "1",
+            REPLICA_HEADER: rid,
+            ATTEMPTS_HEADER: str(attempts),
+            TIERED_HEADER: "1",
         }
 
     def _note_prefix(self, key: str) -> bool:

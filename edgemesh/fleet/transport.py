@@ -12,7 +12,7 @@ One thin seam between the router/prober and the network, for two reasons:
   methods and script failures without sockets (tests/test_fleet.py).
 
 Timeouts are mandatory by construction (no default-None parameter exists)
-and enforced by lint: edgelint EM108 flags any bare outbound call inside
+and enforced by lint: the wire pass (EM502) flags any bare outbound call inside
 ``edgemesh/fleet/`` — a stalled replica must cost one bounded attempt,
 never a pinned router thread. Caveat: urllib's timeout is per socket
 operation, not per request — a replica trickling one byte per read never

@@ -26,6 +26,12 @@ Subcommands (all operate on the span JSONL the engines write via
   open-loop workload from recorded spans (arrivals from ``ts_submit``,
   prompt lengths, tenant mix, session grouping; ``--speed`` time-scales)
   — drive it with ``edgemesh loadgen --replay workload.json``.
+- ``routes [--json]``: render the live wire contract
+  (``serve/httputil.WIRE_CONTRACT``) — every HTTP route the fleet fabric
+  speaks, with method, servers, required/forwarded headers, payload keys,
+  and the structured error-kind vocabulary. The same table the wire
+  analysis pass (EM501-EM506, docs/ANALYSIS.md) enforces statically, so
+  this printout IS the protocol doc, generated-verifiable.
 - ``incident <dumpdir>``: join an incident directory's flight-recorder
   dumps (every replica's ring, plus ``--logs`` router spans) into one
   postmortem document: trigger window marked, per-tenant goodput
@@ -108,6 +114,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="drop the per-request max_new budgets (required "
                     "when replaying at non-continuous or speculative "
                     "replicas — the gateway 400s the field there)")
+    rt = sub.add_parser(
+        "routes",
+        help="render the wire contract table (every fleet-fabric HTTP "
+        "route: method, servers, headers, payload keys, error kinds)")
+    rt.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the machine-readable contract rows "
+                    "(httputil.contract_rows()) instead of the table")
     inc = sub.add_parser(
         "incident",
         help="assemble an incident directory's flight dumps into one "
@@ -397,6 +410,36 @@ def cmd_replay(paths: list[str], out: str, speed: float, sessions: int,
     return 0
 
 
+def cmd_routes(as_json: bool = False) -> int:
+    """Render the wire contract — the one declaration of every HTTP route
+    the fleet fabric speaks (serve/httputil.WIRE_CONTRACT). ``--json``
+    prints the same rows ``httputil.contract_rows()`` returns, so scripts
+    and docs consume the identical shape the lint pass enforces."""
+    from edgemesh.serve import httputil
+
+    rows = httputil.contract_rows()
+    if as_json:
+        print(json.dumps({"routes": rows}, indent=2))
+        return 0
+    for row in rows:
+        path = row["path"] + ("…" if row["prefix"] else "")
+        print(f"{row['method']:4s} {path:20s} [{', '.join(row['servers'])}]")
+        if row["required_headers"]:
+            strict = "  (strict: a call with no headers at all flags)" \
+                if row["strict_headers"] else ""
+            print(f"       requires:  {', '.join(row['required_headers'])}"
+                  f"{strict}")
+        if row["forwarded_headers"]:
+            print(f"       forwards:  {', '.join(row['forwarded_headers'])}")
+        if row["request_keys"]:
+            print(f"       body keys: {', '.join(row['request_keys'])}")
+        if row["error_kinds"]:
+            print(f"       err kinds: {', '.join(row['error_kinds'])}")
+    print(f"{len(rows)} routes — enforced by `edgemesh lint --select EM5xx` "
+          "(docs/ANALYSIS.md)")
+    return 0
+
+
 def cmd_incident(dumpdir: str, logs: list[str], window_s: float) -> int:
     from edgemesh.obs.flight import assemble_incident
 
@@ -424,6 +467,8 @@ def main(argv: list[str] | None = None) -> int:
                           include_max_new=not args.no_max_new)
     if args.cmd == "incident":
         return cmd_incident(args.dumpdir, args.logs, args.window_s)
+    if args.cmd == "routes":
+        return cmd_routes(as_json=args.as_json)
     if not Path(args.path).exists():
         kind = "report" if args.cmd == "loadreport" else "span log"
         print(f"error: no such {kind}: {args.path}", file=sys.stderr)

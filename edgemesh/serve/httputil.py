@@ -145,6 +145,148 @@ def decode_kv_b64(text: str) -> bytes:
         raise ValueError(f"malformed base64 KV payload: {e}") from None
 
 
+# -- The wire contract ---------------------------------------------------------
+#
+# ONE declaration of every HTTP route the fleet fabric speaks — replica
+# gateway (serve/rest.py, server name "gateway") and fleet frontend
+# (fleet/frontend.py, "frontend") — keyed by (method, path). This table is
+# the protocol's source of truth, consumed from three directions:
+#
+# - the wire lint pass (analysis/wire.py, EM501-EM505) checks client call
+#   sites and handler bodies against it statically;
+# - the wire dryrun (EM506) cross-checks each server's SERVED_ROUTES
+#   dispatch table against it at fast-tier speed, no sockets;
+# - ``edgemesh obs routes`` renders it, so docs/FLEET.md's protocol section
+#   is generated-verifiable instead of hand-maintained.
+#
+# Row fields (all optional; absent means "empty"):
+#   servers           which front doors answer the route
+#   required_headers  a fleet-side client that builds a headers dict for
+#                     this route must include these, and the handler must
+#                     read each via the matching ``read_*`` helper
+#   forwarded_headers identity headers the handler must read (and forward)
+#                     when present; clients send them opportunistically
+#   strict_headers    True: a client call with NO headers mapping at all is
+#                     itself a contract violation (KV transfer hops — the
+#                     deadline/trace plumbing is load-bearing there)
+#   request_keys      the JSON body keys the route carries (POST only)
+#   error_kinds       structured ``{"kind": ...}`` error vocabulary the
+#                     route can answer with (besides plain 400 ``error``)
+#   prefix            True: the path is a prefix route (trailing segment
+#                     varies per request, e.g. a trace id)
+
+REPLICA_HEADER = "X-Edgemesh-Replica"
+ATTEMPTS_HEADER = "X-Edgemesh-Attempts"
+TIERED_HEADER = "X-Edgemesh-Tiered"
+RETRY_AFTER_HEADER = "Retry-After"
+
+WIRE_CONTRACT: dict[tuple[str, str], dict] = {
+    # -- probes / introspection (no headers, no body) ------------------------
+    ("GET", "/"): {"servers": ("gateway", "frontend")},
+    ("GET", "/health"): {"servers": ("gateway",)},
+    ("GET", "/healthz"): {"servers": ("gateway", "frontend")},
+    ("GET", "/readyz"): {"servers": ("gateway", "frontend")},
+    ("GET", "/loadz"): {"servers": ("gateway",)},
+    ("GET", "/metrics"): {"servers": ("gateway", "frontend")},
+    ("GET", "/stats"): {"servers": ("gateway",)},
+    ("GET", "/statusz"): {"servers": ("gateway",)},
+    ("GET", "/debug/profile"): {"servers": ("gateway",)},
+    ("GET", "/fleetz"): {"servers": ("frontend",)},
+    ("GET", "/debug/traces/"): {"servers": ("frontend",), "prefix": True},
+    # -- inference -----------------------------------------------------------
+    ("POST", "/generate"): {
+        "servers": ("gateway", "frontend"),
+        "required_headers": (TRACE_HEADER,),
+        "forwarded_headers": (DEADLINE_HEADER, TENANT_HEADER, SESSION_HEADER),
+        "request_keys": ("question", "max_new"),
+        "error_kinds": ("draining", "overloaded", "deadline", "internal"),
+    },
+    ("POST", "/generate_stream"): {
+        "servers": ("gateway",),
+        "required_headers": (TRACE_HEADER,),
+        "forwarded_headers": (DEADLINE_HEADER, TENANT_HEADER, SESSION_HEADER),
+        "request_keys": ("question", "max_new"),
+        "error_kinds": ("draining", "overloaded", "deadline", "internal"),
+    },
+    ("POST", KV_EXPORT_PATH): {
+        "servers": ("gateway",),
+        "required_headers": (DEADLINE_HEADER, TRACE_HEADER),
+        "forwarded_headers": (TENANT_HEADER, SESSION_HEADER),
+        "strict_headers": True,
+        "request_keys": ("question",),
+        "error_kinds": ("kv_capability", "kv_wire", "draining",
+                        "overloaded", "deadline", "internal"),
+    },
+    ("POST", KV_IMPORT_PATH): {
+        "servers": ("gateway",),
+        "required_headers": (DEADLINE_HEADER, TRACE_HEADER),
+        "forwarded_headers": (TENANT_HEADER, SESSION_HEADER),
+        "strict_headers": True,
+        "request_keys": ("question", "kv", "max_new"),
+        "error_kinds": ("kv_capability", "kv_wire", "draining",
+                        "overloaded", "deadline", "internal"),
+    },
+    # -- fleet control plane -------------------------------------------------
+    ("POST", "/drain"): {"servers": ("gateway",)},
+    ("POST", "/incident"): {
+        "servers": ("gateway",),
+        "request_keys": ("id", "kind", "source"),
+    },
+    ("POST", "/replicas/register"): {
+        "servers": ("frontend",),
+        "request_keys": ("id", "url"),
+    },
+    ("POST", "/replicas/deregister"): {
+        "servers": ("frontend",),
+        "request_keys": ("id",),
+    },
+    ("POST", "/replicas/drain"): {
+        "servers": ("frontend",),
+        "request_keys": ("id",),
+    },
+}
+
+
+def route_base(path: str) -> str:
+    """The dispatchable part of a request path: the query string is per
+    request, the contract speaks in bases."""
+    return path.split("?", 1)[0]
+
+
+def route_matches(path: str, routes: tuple[str, ...]) -> bool:
+    """True when ``path`` (already a :func:`route_base`) is one of
+    ``routes``. An entry other than ``"/"`` that ends with ``/`` is a
+    prefix route (``/debug/traces/<id>``) and matches by prefix — same
+    convention WIRE_CONTRACT marks with ``prefix: True``."""
+    for r in routes:
+        if r != "/" and r.endswith("/"):
+            if path.startswith(r):
+                return True
+        elif path == r:
+            return True
+    return False
+
+
+def contract_rows() -> list[dict]:
+    """WIRE_CONTRACT flattened to sorted row dicts — the shape
+    ``edgemesh obs routes --json`` prints and tests assert on."""
+    rows = []
+    for (method, path), row in sorted(WIRE_CONTRACT.items(),
+                                      key=lambda kv: (kv[0][1], kv[0][0])):
+        rows.append({
+            "method": method,
+            "path": path,
+            "servers": list(row.get("servers", ())),
+            "required_headers": list(row.get("required_headers", ())),
+            "forwarded_headers": list(row.get("forwarded_headers", ())),
+            "strict_headers": bool(row.get("strict_headers", False)),
+            "request_keys": list(row.get("request_keys", ())),
+            "error_kinds": list(row.get("error_kinds", ())),
+            "prefix": bool(row.get("prefix", False)),
+        })
+    return rows
+
+
 def read_json_body(handler) -> dict | None:
     """Parse the request body; answers the 400 itself on bad input."""
     try:

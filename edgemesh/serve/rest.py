@@ -74,6 +74,19 @@ log = logging.getLogger("edgemesh.serve")
 RECENT_COMPILE_WINDOW_S = 30.0
 
 
+#: Every route this gateway answers, by method — the dispatch tables the
+#: handlers consult for the unknown-path 404, and what the wire dryrun
+#: (analysis/wire.py, EM506) cross-checks against ``httputil.WIRE_CONTRACT``
+#: in the fast tier: a route added here without a contract row (or vice
+#: versa) fails in seconds, no sockets.
+SERVED_ROUTES: dict[str, tuple[str, ...]] = {
+    "GET": ("/", "/health", "/healthz", "/readyz", "/loadz", "/metrics",
+            "/stats", "/statusz", "/debug/profile"),
+    "POST": ("/drain", "/incident", httputil.KV_EXPORT_PATH,
+             httputil.KV_IMPORT_PATH, "/generate", "/generate_stream"),
+}
+
+
 class GatewayServer(ThreadingHTTPServer):
     """ThreadingHTTPServer + serving lifecycle: in-flight request tracking
     and a ``drain()`` hook (what the fleet router calls — over ``POST
@@ -225,6 +238,13 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
             return digest
 
         def do_GET(self):
+            # Unknown paths 404 through the declared dispatch table, so the
+            # table (what the wire dryrun checks) is load-bearing: a handler
+            # branch added without a SERVED_ROUTES entry is immediately 404.
+            if not httputil.route_matches(httputil.route_base(self.path),
+                                          SERVED_ROUTES["GET"]):
+                self._send(404, {"error": f"unknown path {self.path}"})
+                return
             if self.path in ("/", "/health"):
                 import jax
 
@@ -316,7 +336,7 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 # Answer OUTSIDE the lock: _send is socket I/O, and a
                 # stalled client must not extend the critical section.
                 self._send(409, {"error": "a profile capture is already "
-                                          "running"}, extra={"Retry-After": "1"})
+                                          "running"}, extra={httputil.RETRY_AFTER_HEADER: "1"})
                 return
             try:
                 from edgemesh.utils.tracing import capture_profile
@@ -327,7 +347,7 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 self._send(200, {"path": str(out), "seconds": seconds})
             except Exception as exc:
                 log.exception("profile capture failed")
-                self._send(500, {"error": str(exc)})
+                self._send(500, {"error": str(exc), "kind": "internal"})
             finally:
                 with self.server.profile_lock:
                     self.server.profile_active = False
@@ -395,6 +415,12 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 self.close_connection = True
 
         def _post(self):
+            # Same table-driven 404 as do_GET: SERVED_ROUTES is the one
+            # dispatch inventory the wire dryrun cross-checks.
+            if not httputil.route_matches(httputil.route_base(self.path),
+                                          SERVED_ROUTES["POST"]):
+                self._send(404, {"error": f"unknown path {self.path}"})
+                return
             if self.path == "/drain":
                 # The fleet's pre-stop hook: flip to draining NOW (readyz →
                 # 503, new generates → 503) without blocking the admin call
@@ -447,7 +473,8 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
             if deadline_s is not None and deadline_s <= 0:
                 # The router's budget is already spent: refuse before any
                 # model work — the answer could only arrive dead.
-                self._send(504, {"error": "propagated deadline already expired"})
+                self._send(504, {"error": "propagated deadline already expired",
+                                 "kind": "deadline"})
                 return
             # Distributed-trace context (the router's attempt span): the
             # engine's spans join it, and compile events fired while this
@@ -465,13 +492,14 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
             # the engine (the fleet router retries elsewhere).
             verdict = self.server.begin_request()
             if verdict == "draining":
-                self._send(503, {"error": "draining: not accepting new requests"},
-                           extra={"Retry-After": "1"})
+                self._send(503, {"error": "draining: not accepting new requests",
+                                 "kind": "draining"},
+                           extra={httputil.RETRY_AFTER_HEADER: "1"})
                 return
             if verdict == "overloaded":
-                self._send(503, {"error": "overloaded",
+                self._send(503, {"error": "overloaded", "kind": "overloaded",
                                  "max_inflight": self.server.max_inflight},
-                           extra={"Retry-After": "1"})
+                           extra={httputil.RETRY_AFTER_HEADER: "1"})
                 return
             try:
                 from edgemesh.obs.trace import use_trace
@@ -499,7 +527,8 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
             if not ok:
                 return
             if deadline_s is not None and deadline_s <= 0:
-                self._send(504, {"error": "propagated deadline already expired"})
+                self._send(504, {"error": "propagated deadline already expired",
+                                 "kind": "deadline"})
                 return
             trace_ctx = httputil.read_trace_header(self)
             tenant = httputil.read_tenant_header(self)
@@ -513,13 +542,14 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 return
             verdict = self.server.begin_request()
             if verdict == "draining":
-                self._send(503, {"error": "draining: not accepting new requests"},
-                           extra={"Retry-After": "1"})
+                self._send(503, {"error": "draining: not accepting new requests",
+                                 "kind": "draining"},
+                           extra={httputil.RETRY_AFTER_HEADER: "1"})
                 return
             if verdict == "overloaded":
-                self._send(503, {"error": "overloaded",
+                self._send(503, {"error": "overloaded", "kind": "overloaded",
                                  "max_inflight": self.server.max_inflight},
-                           extra={"Retry-After": "1"})
+                           extra={httputil.RETRY_AFTER_HEADER: "1"})
                 return
             try:
                 from edgemesh.obs.trace import use_trace
@@ -546,7 +576,7 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 return
             except Exception as exc:
                 log.exception("kv export failed")
-                self._send(500, {"error": str(exc)})
+                self._send(500, {"error": str(exc), "kind": "internal"})
                 return
             self._send(200, {
                 "kv": httputil.encode_kv_b64(result["kv_bytes"]),
@@ -587,7 +617,7 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 return
             except Exception as exc:
                 log.exception("kv import failed")
-                self._send(500, {"error": str(exc)})
+                self._send(500, {"error": str(exc), "kind": "internal"})
                 return
             self._send(200, result)
 
@@ -657,7 +687,7 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 self._send(200, result)
             except Exception as exc:  # serving loop must survive bad requests
                 log.exception("generate failed")
-                self._send(500, {"error": str(exc)})
+                self._send(500, {"error": str(exc), "kind": "internal"})
 
         def log_message(self, fmt, *args):  # route through logging, not stderr
             log.info("%s %s", self.address_string(), fmt % args)

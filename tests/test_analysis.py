@@ -275,220 +275,6 @@ def test_em107_sees_aliased_clocks_and_honors_disable():
 
 
 # ---------------------------------------------------------------------------
-# EM108 fleet-missing-timeout
-# ---------------------------------------------------------------------------
-
-_EM108_SRC = (
-    "import urllib.request\n"
-    "def probe(url):\n"
-    "    return urllib.request.urlopen(url)\n"
-)
-
-
-def test_em108_fires_on_bare_urlopen_in_fleet_only():
-    findings = lint_source(_EM108_SRC, path="edgemesh/fleet/router.py")
-    assert rules_of(findings) == {"EM108"}
-    assert findings[0].severity == "error"
-    assert "timeout" in findings[0].message
-    # Outside the fleet the rule is silent (rest.py never dials out).
-    assert lint_source(_EM108_SRC, path="edgemesh/serve/rest.py") == []
-
-
-def test_em108_quiet_with_timeout_kwarg_or_positional():
-    kwarg = _EM108_SRC.replace("urlopen(url)", "urlopen(url, timeout=2.0)")
-    assert lint_source(kwarg, path="edgemesh/fleet/router.py") == []
-    # urlopen(url, data, timeout) — third positional IS the timeout.
-    positional = _EM108_SRC.replace("urlopen(url)", "urlopen(url, None, 2.0)")
-    assert lint_source(positional, path="edgemesh/fleet/router.py") == []
-
-
-def test_em108_sees_aliased_imports_and_sockets():
-    src = (
-        "from urllib.request import urlopen\n"
-        "import socket\n"
-        "def dial(url, addr):\n"
-        "    a = urlopen(url)\n"
-        "    b = socket.create_connection(addr)\n"
-        "    c = socket.create_connection(addr, 1.0)  # timeout positional\n"
-        "    return a, b, c\n"
-    )
-    findings = lint_source(src, path="edgemesh/fleet/health.py")
-    assert [f.rule for f in findings] == ["EM108", "EM108"]
-    assert findings[0].line == 4 and findings[1].line == 5
-
-
-def test_em108_honors_inline_disable():
-    quiet = _EM108_SRC.replace(
-        "    return urllib.request.urlopen(url)",
-        "    return urllib.request.urlopen(url)  # edgelint: disable=EM108",
-    )
-    assert lint_source(quiet, path="edgemesh/fleet/router.py") == []
-
-
-# ---------------------------------------------------------------------------
-# EM109 fleet-missing-trace-propagation
-# ---------------------------------------------------------------------------
-
-_EM109_SRC = (
-    "def attempt(transport, url, payload):\n"
-    "    return transport.post_json(url, payload, timeout_s=1.0,\n"
-    "                               headers={'X-Edgemesh-Deadline-S': '5'})\n"
-)
-
-
-def test_em109_fires_on_headers_built_without_trace_header_in_fleet_only():
-    findings = lint_source(_EM109_SRC, path="edgemesh/fleet/router.py")
-    assert rules_of(findings) == {"EM109"}
-    assert findings[0].severity == "error"
-    assert "X-Edgemesh-Trace" in findings[0].message
-    # Outside the fleet the rule is silent.
-    assert lint_source(_EM109_SRC, path="edgemesh/serve/rest.py") == []
-
-
-def test_em109_quiet_with_literal_key_constant_name_or_expansion():
-    literal = _EM109_SRC.replace(
-        "headers={'X-Edgemesh-Deadline-S': '5'}",
-        "headers={'X-Edgemesh-Trace': h}",
-    )
-    assert lint_source(literal, path="edgemesh/fleet/router.py") == []
-    # The TRACE_HEADER constant (any attribute path) counts.
-    const = _EM109_SRC.replace(
-        "headers={'X-Edgemesh-Deadline-S': '5'}",
-        "headers={TRACE_HEADER: ctx.to_header()}",
-    )
-    assert lint_source(const, path="edgemesh/fleet/router.py") == []
-    attr = _EM109_SRC.replace(
-        "headers={'X-Edgemesh-Deadline-S': '5'}",
-        "headers={httputil.TRACE_HEADER: h}",
-    )
-    assert lint_source(attr, path="edgemesh/fleet/router.py") == []
-    # A **expansion is assumed to forward the incoming headers.
-    spread = _EM109_SRC.replace(
-        "headers={'X-Edgemesh-Deadline-S': '5'}",
-        "headers={'A': 'b', **incoming}",
-    )
-    assert lint_source(spread, path="edgemesh/fleet/router.py") == []
-
-
-def test_em109_follows_local_headers_variable_and_skips_opaque():
-    via_var = (
-        "def attempt(transport, url, payload):\n"
-        "    headers = {'X-Edgemesh-Deadline-S': '5'}\n"
-        "    return transport.post_json(url, payload, timeout_s=1.0,\n"
-        "                               headers=headers)\n"
-    )
-    findings = lint_source(via_var, path="edgemesh/fleet/router.py")
-    assert rules_of(findings) == {"EM109"}
-    fixed = via_var.replace("{'X-Edgemesh-Deadline-S': '5'}",
-                            "{TRACE_HEADER: h}")
-    assert lint_source(fixed, path="edgemesh/fleet/router.py") == []
-    # No headers kwarg (probes/admin) and opaque values are out of scope.
-    bare = (
-        "def probe(transport, url):\n"
-        "    return transport.get_json(url, timeout_s=1.0)\n"
-    )
-    assert lint_source(bare, path="edgemesh/fleet/health.py") == []
-    opaque = (
-        "def attempt(transport, url, payload, headers):\n"
-        "    return transport.post_json(url, payload, timeout_s=1.0,\n"
-        "                               headers=headers)\n"
-    )
-    assert lint_source(opaque, path="edgemesh/fleet/router.py") == []
-
-
-def test_em109_sees_bare_urlopen_and_honors_disable():
-    src = (
-        "import urllib.request\n"
-        "def dial(url):\n"
-        "    return urllib.request.urlopen(url, None, 2.0,\n"
-        "                                  headers={'A': 'b'})\n"
-    )
-    findings = lint_source(src, path="edgemesh/fleet/router.py")
-    assert rules_of(findings) == {"EM109"}
-    # The disable comment anchors to the call's first line (same contract
-    # as every other rule).
-    quiet = src.replace(
-        "    return urllib.request.urlopen(url, None, 2.0,",
-        "    return urllib.request.urlopen(url, None, 2.0,  # edgelint: disable=EM109",
-    )
-    assert lint_source(quiet, path="edgemesh/fleet/router.py") == []
-
-
-def test_em109_kv_transfer_requires_deadline_header():
-    # A call literally targeting a /kv/ path must ALSO carry the deadline
-    # header (the tiered path's budget contract); trace-only headers flag.
-    src = (
-        "def xfer(transport, rep, payload):\n"
-        "    return transport.post_json(rep.url('/kv/export'), payload,\n"
-        "                               timeout_s=1.0,\n"
-        "                               headers={TRACE_HEADER: h})\n"
-    )
-    findings = lint_source(src, path="edgemesh/fleet/router.py")
-    assert rules_of(findings) == {"EM109"}
-    assert "X-Edgemesh-Deadline-S" in findings[0].message
-    # The DEADLINE_HEADER constant (any attribute path) or the literal
-    # string both satisfy.
-    for fix in ("{TRACE_HEADER: h, DEADLINE_HEADER: '5'}",
-                "{TRACE_HEADER: h, httputil.DEADLINE_HEADER: '5'}",
-                "{TRACE_HEADER: h, 'X-Edgemesh-Deadline-S': '5'}"):
-        ok = src.replace("{TRACE_HEADER: h}", fix)
-        assert lint_source(ok, path="edgemesh/fleet/router.py") == []
-    # f-string URLs count as literal /kv/ targets too.
-    fstr = src.replace("rep.url('/kv/export')", "f'{base}/kv/import'")
-    assert rules_of(lint_source(fstr, path="edgemesh/fleet/router.py")) == {"EM109"}
-    # Missing BOTH trace and deadline on a transfer → two findings.
-    both = src.replace("{TRACE_HEADER: h}", "{'A': 'b'}")
-    assert len(lint_source(both, path="edgemesh/fleet/router.py")) == 2
-
-
-def test_em109_kv_transfer_with_no_headers_flags_but_probes_stay_exempt():
-    bare = (
-        "def xfer(transport, rep, payload):\n"
-        "    return transport.post_json(rep.url('/kv/import'), payload,\n"
-        "                               timeout_s=1.0)\n"
-    )
-    findings = lint_source(bare, path="edgemesh/fleet/router.py")
-    assert rules_of(findings) == {"EM109"}
-    assert "no headers" in findings[0].message
-    # Non-transfer calls with no headers (probes, drain admin) keep their
-    # out-of-scope exemption, and opaque URLs stay opaque.
-    probe = (
-        "def probe(transport, url):\n"
-        "    return transport.get_json(url, timeout_s=1.0)\n"
-    )
-    assert lint_source(probe, path="edgemesh/fleet/health.py") == []
-    opaque = (
-        "def xfer(transport, rep, path, payload):\n"
-        "    return transport.post_json(rep.url(path), payload, timeout_s=1.0)\n"
-    )
-    assert lint_source(opaque, path="edgemesh/fleet/router.py") == []
-
-
-def test_em109_shipped_fleet_is_clean():
-    # The real router/transport/prober must carry the header everywhere
-    # they build one — the shipped tree is the rule's reference fixture.
-    from pathlib import Path
-
-    from edgemesh.analysis.edgelint import lint_paths
-
-    fleet = Path(__file__).resolve().parent.parent / "edgemesh" / "fleet"
-    assert [f for f in lint_paths([fleet]) if f.rule == "EM109"] == []
-
-
-def test_em108_fleet_transport_is_clean():
-    # The shipped transport is the reference implementation of the rule:
-    # every outbound call it makes must carry a timeout.
-    from pathlib import Path
-
-    from edgemesh.analysis.edgelint import lint_file
-
-    transport = (
-        Path(__file__).resolve().parent.parent / "edgemesh" / "fleet" / "transport.py"
-    )
-    assert [f for f in lint_file(transport) if f.rule == "EM108"] == []
-
-
-# ---------------------------------------------------------------------------
 # EM110 serve-per-row-dispatch
 # ---------------------------------------------------------------------------
 
@@ -1143,9 +929,10 @@ def _all_rule_tables():
     from edgemesh.analysis.contracts import CONTRACT_RULES
     from edgemesh.analysis.sharding import RULES as SHARDING_RULES
     from edgemesh.analysis.sharding import SHARDING_CONTRACT_RULES
+    from edgemesh.analysis.wire import WIRE_CONTRACT_RULES, WIRE_RULES
 
     return (RULES, CONTRACT_RULES, CONCURRENCY_RULES, SHARDING_RULES,
-            SHARDING_CONTRACT_RULES)
+            SHARDING_CONTRACT_RULES, WIRE_RULES, WIRE_CONTRACT_RULES)
 
 
 def test_every_rule_has_metadata_and_unique_id():

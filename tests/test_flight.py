@@ -288,7 +288,7 @@ def test_router_observe_incident_broadcasts_dedupes_and_surfaces(tmp_path):
     for _, payload, timeout_s in transport.posts:
         assert payload == {"id": "inc-abc", "kind": "slo_burst",
                            "source": "r0"}
-        assert timeout_s is not None  # EM108 semantics, live
+        assert timeout_s is not None  # EM502 dial-timeout semantics, live
     # Dedupe: the prober re-observes the same digest every tick.
     assert router.observe_incident("r0", incident) is False
     assert len(transport.posts) == 2

@@ -1,0 +1,509 @@
+"""The wire protocol-contract pass (analysis/wire.py, EM501-EM506): one
+known-bad fixture per rule (each demonstrably fires), the negative twins,
+helper-descent and constant-resolution cases, the Layer-2 dryrun (green on
+the shipped tree; a broken contract names the route), the `obs routes`
+renderer, and the shipped-tree zero-unbaselined-EM5xx gate. Fast tier —
+pure AST + stdlib imports, no sockets, no accelerator."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from edgemesh.analysis.edgelint import lint_source
+from edgemesh.analysis.findings import Baseline, default_baseline_path
+from edgemesh.analysis.wire import analyze_source, run_wire_contracts
+from edgemesh.serve import httputil
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# EM501 unknown-route
+# ---------------------------------------------------------------------------
+
+
+def test_em501_fires_on_typoed_route():
+    src = (
+        "def call(t, url):\n"
+        "    return t.post_json(url + '/generaet', {'question': 'q'},\n"
+        "                       timeout_s=1.0)\n"
+    )
+    findings = analyze_source(src, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM501"}
+    assert "/generaet" in findings[0].message
+    assert "WIRE_CONTRACT" in findings[0].message
+
+
+def test_em501_fires_on_wrong_method_and_names_the_right_one():
+    src = (
+        "def call(t, url):\n"
+        "    return t.get_json(f'{url}/drain', timeout_s=1.0)\n"
+    )
+    findings = analyze_source(src, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM501"}
+    assert "POST" in findings[0].message and "not GET" in findings[0].message
+
+
+def test_em501_resolution_forms_and_opaque_urls():
+    # f-string, concatenation, one-level local provenance, and the
+    # httputil path constant all resolve; an opaque parameter does not.
+    for url in ("f'{base}/loadz'", "base + '/loadz'", "'http://h:1/loadz'"):
+        src = (
+            "def probe(t, base):\n"
+            f"    return t.get_json({url}, timeout_s=1.0)\n"
+        )
+        assert analyze_source(src, path="edgemesh/fleet/health.py") == [], url
+    local = (
+        "def probe(t, base):\n"
+        "    u = f'{base}/laodz'\n"
+        "    return t.get_json(u, timeout_s=1.0)\n"
+    )
+    assert rules_of(analyze_source(local, path="edgemesh/fleet/health.py")) \
+        == {"EM501"}
+    opaque = (
+        "def probe(t, url):\n"
+        "    return t.get_json(url, timeout_s=1.0)\n"
+    )
+    assert analyze_source(opaque, path="edgemesh/fleet/health.py") == []
+
+
+def test_em501_resolves_httputil_path_constants():
+    src = (
+        "from edgemesh.serve.httputil import KV_EXPORT_PATH\n"
+        "def xfer(t, rep, h):\n"
+        "    return t.post_json(rep.url(KV_EXPORT_PATH), {'question': 'q'},\n"
+        "                       timeout_s=1.0, headers=h)\n"
+    )
+    # The constant resolves to a declared route: no EM501. (The opaque
+    # headers parameter is trusted — not a dict literal the pass can see.)
+    assert analyze_source(src, path="edgemesh/fleet/router.py") == []
+
+
+def test_em501_rides_lint_source_and_honors_disable():
+    src = (
+        "def call(t, url):\n"
+        "    return t.post_json(url + '/generaet', {'question': 'q'},\n"
+        "                       timeout_s=1.0)\n"
+    )
+    assert "EM501" in rules_of(lint_source(src, path="edgemesh/fleet/x.py"))
+    quiet = src.replace(
+        "def call(t, url):",
+        "def call(t, url):  # edgelint: disable=EM501",
+    )
+    assert analyze_source(quiet, path="edgemesh/fleet/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# EM502 header-contract
+# ---------------------------------------------------------------------------
+
+
+def test_em502_client_fires_when_headers_lack_required_trace():
+    src = (
+        "def call(t, url):\n"
+        "    headers = {'X-Edgemesh-Tenant': 'a'}\n"
+        "    return t.post_json(f'{url}/generate', {'question': 'q'},\n"
+        "                       timeout_s=1.0, headers=headers)\n"
+    )
+    findings = analyze_source(src, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM502"}
+    assert "X-Edgemesh-Trace" in findings[0].message
+    # Outside the fleet the client header obligation does not apply.
+    assert analyze_source(src, path="edgemesh/loadgen/driver.py") == []
+
+
+def test_em502_satisfied_by_literal_constant_or_expansion():
+    base = (
+        "from edgemesh.serve.httputil import TRACE_HEADER\n"
+        "def call(t, url, h):\n"
+        "    return t.post_json(f'{url}/generate', {'question': 'q'},\n"
+        "                       timeout_s=1.0, headers=HEADERS)\n"
+    )
+    for headers in ("{'X-Edgemesh-Trace': h}", "{TRACE_HEADER: h}",
+                    "{httputil.TRACE_HEADER: h}", "{**h}"):
+        src = base.replace("HEADERS", headers)
+        assert analyze_source(src, path="edgemesh/fleet/router.py") == [], \
+            headers
+
+
+def test_em502_strict_route_flags_call_with_no_headers_at_all():
+    src = (
+        "from edgemesh.serve.httputil import KV_EXPORT_PATH\n"
+        "def xfer(t, rep):\n"
+        "    return t.post_json(rep.url(KV_EXPORT_PATH), {'question': 'q'},\n"
+        "                       timeout_s=1.0)\n"
+    )
+    findings = analyze_source(src, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM502"}
+    assert "strict" in findings[0].message
+    # /generate is NOT strict: no headers at all stays out of scope (probes
+    # and admin calls have no obligation to build a headers dict).
+    probe = (
+        "def call(t, url):\n"
+        "    return t.post_json(f'{url}/generate', {'question': 'q'},\n"
+        "                       timeout_s=1.0)\n"
+    )
+    assert analyze_source(probe, path="edgemesh/fleet/router.py") == []
+
+
+def test_em502_strict_route_satisfied_with_both_headers():
+    src = (
+        "from edgemesh.serve.httputil import (DEADLINE_HEADER, TRACE_HEADER,\n"
+        "                                     KV_EXPORT_PATH)\n"
+        "def xfer(t, rep, ctx):\n"
+        "    return t.post_json(rep.url(KV_EXPORT_PATH), {'question': 'q'},\n"
+        "                       timeout_s=1.0,\n"
+        "                       headers={TRACE_HEADER: ctx,\n"
+        "                                DEADLINE_HEADER: '1.0'})\n"
+    )
+    assert analyze_source(src, path="edgemesh/fleet/router.py") == []
+    # Dropping the deadline from a KV hop flags — the retired EM109's
+    # transfer contract, now a WIRE_CONTRACT row.
+    broken = src.replace("DEADLINE_HEADER: '1.0'", "'X-Other': '1'")
+    findings = analyze_source(broken, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM502"}
+    assert "X-Edgemesh-Deadline-S" in findings[0].message
+
+
+def test_em502_bare_dial_without_timeout_fleet_only():
+    src = (
+        "import urllib.request\n"
+        "def probe(url):\n"
+        "    return urllib.request.urlopen(url)\n"
+    )
+    findings = analyze_source(src, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM502"}
+    assert "timeout" in findings[0].message
+    assert analyze_source(src, path="edgemesh/obs/cli.py") == []
+    kwarg = src.replace("urlopen(url)", "urlopen(url, timeout=2.0)")
+    assert analyze_source(kwarg, path="edgemesh/fleet/router.py") == []
+    # Third positional IS urlopen's timeout; aliased imports still resolve.
+    pos = src.replace("urlopen(url)", "urlopen(url, None, 2.0)")
+    assert analyze_source(pos, path="edgemesh/fleet/router.py") == []
+    aliased = (
+        "from urllib.request import urlopen as uo\n"
+        "def probe(url):\n"
+        "    return uo(url)\n"
+    )
+    assert rules_of(analyze_source(aliased, path="edgemesh/fleet/x.py")) \
+        == {"EM502"}
+
+
+def test_em502_handler_missing_read_helper_fires():
+    src = (
+        "from edgemesh.serve import httputil\n"
+        "class H:\n"
+        "    def do_POST(self):\n"
+        "        if self.path == '/generate':\n"
+        "            payload = self._read_json()\n"
+        "            q = payload.get('question')\n"
+        "            httputil.read_deadline_header(self)\n"
+        "            httputil.read_tenant_header(self)\n"
+        "            httputil.read_session_header(self)\n"
+    )
+    findings = analyze_source(src, path="edgemesh/serve/rest.py")
+    assert rules_of(findings) == {"EM502"}
+    assert "read_trace_header" in findings[0].message
+
+
+def test_em502_handler_helper_descent_through_self_calls():
+    # The header read lives two self-calls below the dispatch branch: the
+    # closure descent must find it (the shipped gateway's real shape).
+    src = (
+        "from edgemesh.serve import httputil\n"
+        "class H:\n"
+        "    def do_POST(self):\n"
+        "        if self.path == '/generate':\n"
+        "            self._generate()\n"
+        "    def _generate(self):\n"
+        "        payload = self._read_json()\n"
+        "        q = payload.get('question')\n"
+        "        self._common_headers()\n"
+        "    def _common_headers(self):\n"
+        "        httputil.read_trace_header(self)\n"
+        "        httputil.read_deadline_header(self)\n"
+        "        httputil.read_tenant_header(self)\n"
+        "        httputil.read_session_header(self)\n"
+    )
+    assert analyze_source(src, path="edgemesh/serve/rest.py") == []
+
+
+# ---------------------------------------------------------------------------
+# EM503 payload-key-drift
+# ---------------------------------------------------------------------------
+
+
+def test_em503_client_fires_on_typoed_payload_key():
+    src = (
+        "def call(t, url):\n"
+        "    return t.post_json(f'{url}/generate', {'qestion': 'q'},\n"
+        "                       timeout_s=1.0)\n"
+    )
+    findings = analyze_source(src, path="edgemesh/loadgen/driver.py")
+    assert rules_of(findings) == {"EM503"}
+    assert "'qestion'" in findings[0].message
+    ok = src.replace("qestion", "question")
+    assert analyze_source(ok, path="edgemesh/loadgen/driver.py") == []
+
+
+def test_em503_client_follows_local_payload_variable():
+    src = (
+        "def call(t, url):\n"
+        "    payload = {'question': 'q', 'max_mew': 8}\n"
+        "    return t.post_json(f'{url}/generate', payload, timeout_s=1.0)\n"
+    )
+    findings = analyze_source(src, path="edgemesh/fleet/router.py")
+    assert rules_of(findings) == {"EM503"}
+    assert "'max_mew'" in findings[0].message
+
+
+def test_em503_handler_fires_on_undeclared_body_read():
+    src = (
+        "class H:\n"
+        "    def do_POST(self):\n"
+        "        if self.path == '/generate':\n"
+        "            payload = self._read_json()\n"
+        "            return payload.get('qestion')\n"
+    )
+    findings = analyze_source(src, path="edgemesh/serve/rest.py")
+    # The fixture handler also reads no headers (EM502); the EM503 finding
+    # is the one under test here.
+    em503 = [f for f in findings if f.rule == "EM503"]
+    assert len(em503) == 1 and "'qestion'" in em503[0].message
+    # A declared key (any route of this server) is quiet — dispatch
+    # helpers are shared, so the union is the contract.
+    ok = src.replace("qestion", "question")
+    assert [f for f in analyze_source(ok, path="edgemesh/serve/rest.py")
+            if f.rule == "EM503"] == []
+
+
+# ---------------------------------------------------------------------------
+# EM504 schema-drift
+# ---------------------------------------------------------------------------
+
+
+def test_em504_fires_on_typoed_digest_key_in_balancer():
+    src = (
+        "def _cost(self, load):\n"
+        "    return load.get('ewma_queu_s') or 0.0\n"
+    )
+    findings = analyze_source(src, path="edgemesh/fleet/balancer.py")
+    assert rules_of(findings) == {"EM504"}
+    assert "'ewma_queu_s'" in findings[0].message
+    assert "load_digest" in findings[0].message
+    ok = src.replace("ewma_queu_s", "ewma_queue_s")
+    assert analyze_source(ok, path="edgemesh/fleet/balancer.py") == []
+
+
+def test_em504_registered_schema_against_tmp_producer_tree(tmp_path,
+                                                           monkeypatch):
+    from edgemesh.analysis import wire
+
+    (tmp_path / "prod.py").write_text(
+        "def make():\n"
+        "    out = {'alpha': 1}\n"
+        "    out['beta'] = 2\n"
+        "    out.setdefault('gamma', 3)\n"
+        "    return dict(delta=4), out\n"
+    )
+    monkeypatch.setattr(wire, "_REPO_ROOT", tmp_path)
+    monkeypatch.setattr(wire, "WIRE_SCHEMAS", {
+        "toy": {
+            "doc": "test schema",
+            "producers": (("prod.py", "make"),),
+            "consumers": (("cons.py", "use", ("doc",)),),
+        },
+    })
+    wire._SCHEMA_CACHE.clear()
+    # Derivation flows through `or {}`, rebinding, and loop targets.
+    src = (
+        "def use(doc):\n"
+        "    d = doc or {}\n"
+        "    for k in (d.get('alpha'), d['beta'], d.get('gamma'),\n"
+        "              d.get('delta')):\n"
+        "        pass\n"
+        "    return d.get('epsilon')\n"
+    )
+    findings = wire.analyze_source(src, path="cons.py")
+    assert rules_of(findings) == {"EM504"}
+    assert "'epsilon'" in findings[0].message
+    # An unrelated local dict is NOT the schema document: quiet.
+    other = (
+        "def use(doc):\n"
+        "    mine = {'epsilon': 1}\n"
+        "    return mine.get('epsilon'), doc.get('alpha')\n"
+    )
+    assert wire.analyze_source(other, path="cons.py") == []
+    # No producer file readable → the check stays silent, not wrong.
+    monkeypatch.setattr(wire, "_REPO_ROOT", tmp_path / "nope")
+    wire._SCHEMA_CACHE.clear()
+    assert wire.analyze_source(src, path="cons.py") == []
+    wire._SCHEMA_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# EM505 response-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_em505_fires_on_bare_500_and_send_json_form():
+    src = (
+        "class H:\n"
+        "    def _handle(self, exc):\n"
+        "        self._send(500, {'error': str(exc)})\n"
+    )
+    findings = analyze_source(src, path="edgemesh/serve/rest.py")
+    assert rules_of(findings) == {"EM505"}
+    assert findings[0].severity == "warning"
+    assert '"kind"' in findings[0].message
+    direct = (
+        "from edgemesh.serve import httputil\n"
+        "def answer(h, exc):\n"
+        "    httputil.send_json(h, 500, {'error': str(exc)})\n"
+    )
+    assert rules_of(analyze_source(direct, path="edgemesh/fleet/frontend.py")) \
+        == {"EM505"}
+    # The structured vocabulary satisfies; non-5xx dicts are out of scope.
+    ok = src.replace("{'error': str(exc)}",
+                     "{'error': str(exc), 'kind': 'internal'}")
+    assert analyze_source(ok, path="edgemesh/serve/rest.py") == []
+    notfound = src.replace("500", "404")
+    assert analyze_source(notfound, path="edgemesh/serve/rest.py") == []
+
+
+def test_em505_fires_on_503_branch_without_retry_after():
+    src = (
+        "def call(t, url):\n"
+        "    status, body = t.get_json(f'{url}/readyz', timeout_s=1.0)\n"
+        "    if status == 503:\n"
+        "        return None\n"
+        "    return body\n"
+    )
+    findings = analyze_source(src, path="edgemesh/fleet/health.py")
+    assert rules_of(findings) == {"EM505"}
+    assert "Retry-After" in findings[0].message
+    ok = src.replace(
+        "        return None\n",
+        "        backoff(headers.get(httputil.RETRY_AFTER_HEADER))\n"
+        "        return None\n",
+    )
+    assert analyze_source(ok, path="edgemesh/fleet/health.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: the wire dryrun (EM506)
+# ---------------------------------------------------------------------------
+
+
+def test_wire_dryrun_green_on_shipped_tree():
+    assert run_wire_contracts() == []
+
+
+def test_wire_dryrun_names_declared_but_unserved_route(monkeypatch):
+    monkeypatch.setitem(httputil.WIRE_CONTRACT, ("POST", "/ghost"),
+                        {"servers": ("gateway",)})
+    findings = run_wire_contracts()
+    assert rules_of(findings) == {"EM506"}
+    assert len(findings) == 1
+    assert "POST /ghost" in findings[0].message
+    assert "never serves it" in findings[0].message
+    assert findings[0].context == "gateway"
+    assert findings[0].path == "edgemesh/serve/rest.py"
+
+
+def test_wire_dryrun_names_served_but_undeclared_route(monkeypatch):
+    monkeypatch.delitem(httputil.WIRE_CONTRACT, ("POST", "/drain"))
+    findings = run_wire_contracts()
+    assert rules_of(findings) == {"EM506"}
+    assert "POST /drain" in findings[0].message
+    assert "undeclared" in findings[0].message
+
+
+def test_wire_dryrun_reports_method_mismatch_once(monkeypatch):
+    row = httputil.WIRE_CONTRACT[("POST", "/drain")]
+    monkeypatch.delitem(httputil.WIRE_CONTRACT, ("POST", "/drain"))
+    monkeypatch.setitem(httputil.WIRE_CONTRACT, ("GET", "/drain"), row)
+    findings = run_wire_contracts()
+    assert len(findings) == 1, [f.message for f in findings]
+    assert "method mismatch" in findings[0].message
+    assert "GET" in findings[0].message
+
+
+def test_wire_dryrun_unimportable_module_is_the_finding():
+    findings = run_wire_contracts([{
+        "server": "ghost",
+        "module": "edgemesh.no_such_module",
+        "table": "SERVED_ROUTES",
+        "path": "edgemesh/ghost.py",
+    }])
+    assert rules_of(findings) == {"EM506"}
+    assert "unimportable" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# `edgemesh obs routes` renders the live contract
+# ---------------------------------------------------------------------------
+
+
+def test_obs_routes_json_matches_contract_rows(capsys):
+    from edgemesh.obs import cli as obs_cli
+
+    assert obs_cli.main(["routes", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["routes"] == httputil.contract_rows()
+    assert len(doc["routes"]) == len(httputil.WIRE_CONTRACT)
+
+
+def test_obs_routes_human_table_lists_every_route(capsys):
+    from edgemesh.obs import cli as obs_cli
+
+    assert obs_cli.main(["routes"]) == 0
+    out = capsys.readouterr().out
+    for (_method, path) in httputil.WIRE_CONTRACT:
+        assert path in out
+    assert "X-Edgemesh-Trace" in out
+    assert "EM5xx" in out  # the enforcement cross-reference
+
+
+# ---------------------------------------------------------------------------
+# Retired-id aliases and the shipped-tree gate
+# ---------------------------------------------------------------------------
+
+
+def test_select_em109_aliases_to_em502_with_deprecation_note():
+    proc = subprocess.run(
+        [sys.executable, "-m", "edgemesh.analysis",
+         str(REPO / "edgemesh" / "fleet" / "transport.py"),
+         "--select", "EM109", "--no-contracts"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "retired" in proc.stderr
+    assert "EM502" in proc.stderr
+
+
+def test_trace_header_constant_agrees_across_layers():
+    # obs/trace.py keeps its own TRACE_HEADER definition (obs imports
+    # nothing from serve/); the wire contract is the tie-breaker if the
+    # two ever drift.
+    from edgemesh.obs.trace import TRACE_HEADER
+
+    assert TRACE_HEADER == httputil.TRACE_HEADER
+
+
+def test_shipped_tree_has_zero_unbaselined_em5xx():
+    # The acceptance gate: the whole package is wire-clean with an EMPTY
+    # baseline — every real finding was fixed in-tree, never grandfathered.
+    findings = []
+    for py in sorted((REPO / "edgemesh").rglob("*.py")):
+        findings.extend(analyze_source(py.read_text(), path=str(py)))
+    assert [f"{f.path}:{f.line} {f.rule} {f.message}" for f in findings] == []
+    assert run_wire_contracts() == []
+    base = Baseline.load(default_baseline_path())
+    assert [e for e in base.entries
+            if e.get("rule", "").startswith("EM5")] == []
