@@ -75,6 +75,18 @@ Rules (see docs/ANALYSIS.md for the full rationale and examples):
   offline aggregate rebuild all depend on ONE producer vocabulary, and a
   hand-rolled writer is a second vocabulary waiting to drift.
 
+- EM114 ungated-device-sync (error): a ``.block_until_ready()`` or
+  ``jax.device_get`` call inside ``edgemesh/serve/`` or
+  ``edgemesh/runtime/``. An ungated sync stalls the pipelined dispatch
+  worker for the full program — and on the tunneled TPU platform
+  ``block_until_ready`` returns before the program finishes, so it is
+  not even a fence (``utils/platform.py``). Measured syncs belong to the
+  compute ledger's SAMPLED launch seam (``obs.compute.ComputeLedger`` —
+  1-in-N, using the real ``device_sync`` readback); ``device_sync``
+  itself stays legal everywhere (it IS the fence primitive), and the
+  segment-result fetch of already-complete handles carries an inline
+  disable.
+
 The class-level concurrency rules (EM301-EM304: lock discipline,
 lock-order cycles, blocking-under-lock, thread hygiene) live in
 ``edgemesh/analysis/concurrency.py``, and the sharding/collective rules
@@ -150,6 +162,11 @@ RULES: dict[str, dict] = {
         "name": "span-schema-bypass",
         "severity": "error",
         "summary": "span-event JSONL written outside SpanTracker/FlightRecorder/JsonlLogger",
+    },
+    "EM114": {
+        "name": "ungated-device-sync",
+        "severity": "error",
+        "summary": "block_until_ready/device_get in serve//runtime/ outside the ledger's sampled seam",
     },
 }
 
@@ -240,13 +257,28 @@ _EM113_ALLOWED_SUFFIXES = (
     "edgemesh/utils/tracing.py",   # JsonlLogger — THE serializer
     "edgemesh/obs/spans.py",       # SpanTracker
     "edgemesh/obs/flight.py",      # FlightRecorder
+    "edgemesh/obs/compute.py",     # ComputeLedger / SpecRoundLedger
 )
 _EM113_EVENTS = {"request_spans", "router_spans", "pool_reset", "compile",
-                 "flight_snapshot", "flight_dump"}
+                 "flight_snapshot", "flight_dump", "launch", "spec_rounds"}
 _EM113_EVENT_CONSTS = {"SPAN_RECORD_EVENT", "ROUTER_RECORD_EVENT",
                        "RESET_RECORD_EVENT", "COMPILE_RECORD_EVENT",
                        "ENGINE_RECORD_EVENT", "SNAPSHOT_EVENT",
-                       "DUMP_EVENT"}
+                       "DUMP_EVENT", "LAUNCH_RECORD_EVENT",
+                       "SPEC_ROUND_RECORD_EVENT"}
+
+# EM114 scope + surface: synchronous device readbacks in the serving
+# stack. An ungated ``.block_until_ready()`` / ``jax.device_get`` stalls
+# the pipelined dispatch worker for the full program (and on the tunneled
+# TPU platform block_until_ready returns EARLY — it is not even a fence;
+# utils/platform.py). The sanctioned seams: the compute ledger's SAMPLED
+# launch fence (obs/compute.py — 1-in-N by design, and it uses the real
+# ``device_sync`` readback), ``utils.platform.device_sync`` itself (stays
+# legal: it IS the fence primitive), and the segment-result fetch of
+# already-complete handles, which carries an inline disable.
+_EM114_DIRS = ("edgemesh/serve/", "edgemesh/runtime/")
+_EM114_METHOD = "block_until_ready"
+_EM114_FUNCS = {"jax.device_get", "jax.block_until_ready"}
 
 
 # ---------------------------------------------------------------------------
@@ -493,6 +525,7 @@ class _FileLinter:
         self._rule_metric_naming(tree)
         self._rule_unbounded_label(tree)
         self._rule_span_schema_bypass(tree)
+        self._rule_ungated_sync(tree)
         # Traced ROOTS only: their walkers descend into traced nested defs,
         # so running every traced def would double-report nested call sites.
         traced_roots = [
@@ -588,6 +621,36 @@ class _FileLinter:
                     "suppress: control-flow clocks and the obs "
                     "instrumentation itself are legitimate)",
                 )
+
+    # -- EM114 -------------------------------------------------------------
+
+    def _rule_ungated_sync(self, tree: ast.Module) -> None:
+        if not any(d in self.relpath for d in _EM114_DIRS):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_name(node.func)
+            if not dotted:
+                continue
+            resolved = self.aliases.resolve(dotted)
+            method_style = dotted.endswith("." + _EM114_METHOD)
+            if not method_style and resolved not in _EM114_FUNCS:
+                continue
+            what = (_EM114_METHOD if method_style
+                    else resolved.rsplit(".", 1)[-1])
+            self._emit(
+                "EM114", node,
+                f"ungated {what}() in the serving stack stalls the "
+                "pipelined dispatch worker (and block_until_ready is not "
+                "even a fence on the tunneled TPU platform — "
+                "utils/platform.py). Route measured syncs through the "
+                "compute ledger's sampled launch seam "
+                "(obs.compute.ComputeLedger.launch) or "
+                "utils.platform.device_sync at a structured readback "
+                "point (suppress: fetching ALREADY-complete segment "
+                "handles is legitimate)",
+            )
 
     # -- EM110 -------------------------------------------------------------
 

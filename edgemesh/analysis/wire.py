@@ -196,9 +196,13 @@ WIRE_SCHEMAS: dict[str, dict] = {
             ("edgemesh/serve/rest.py", "_load_digest"),
             ("edgemesh/serve/continuous.py", "load_digest"),
             ("edgemesh/serve/continuous.py", "estimate_capacity"),
+            # per-boundary cost block (digest["costs"]) — measured launch
+            # EWMAs from the compute ledger (obs/compute.py)
+            ("edgemesh/obs/compute.py", "digest_costs"),
         ),
         "consumers": (
             ("edgemesh/fleet/balancer.py", "_cost", ("load",)),
+            ("edgemesh/fleet/balancer.py", "_cost_service_s", ("load",)),
             ("edgemesh/fleet/balancer.py", "_prefill_share", ("load",)),
             ("edgemesh/fleet/autoscale.py", "_demand_supply", ("load",)),
             ("edgemesh/fleet/autoscale.py", "evaluate", ("load",)),

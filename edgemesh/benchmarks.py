@@ -296,6 +296,11 @@ def serving_benchmark(
             # aggregates from the engine's span tracker (compact form — the
             # full histograms ride /metrics, not the bench artifact).
             "obs": eng.obs.registry.summary(prefix="edgemesh_"),
+            # Compute-ledger rollup (obs/compute.py): per-boundary device
+            # time, cost-model flops/bytes, and roofline for THIS run's
+            # launches. None when the ledger is disabled
+            # (EDGEMESH_COMPUTE_SAMPLE=0 — the overhead-gate off arm).
+            "compute": eng.compute.rollup() or None,
         }
     finally:
         eng.close()
@@ -803,11 +808,19 @@ def decode_benchmark(
     _progress(f"{precision}/{quant_mode}/{kv_backend} b{batch}: warmup compile")
     run(cfg, params, tokens, lengths, sampling)
     _progress("warmup done; timing")
+    # Ambient compute ledger: the runtime paths route their prefill/decode
+    # launches through it, so the artifact carries cost_analysis-backed
+    # flops/bytes + measured launch times for the exact boundaries timed.
+    from edgemesh.obs import ComputeLedger, Registry, ledger_scope
+
+    ledger = ComputeLedger(registry=Registry(), engine="bench-decode",
+                           sample=1)
     best_tps, best_ttft = 0.0, float("inf")
-    for _ in range(repeats):
-        r = run(cfg, params, tokens, lengths, sampling)
-        best_tps = max(best_tps, r.decode_tok_s)
-        best_ttft = min(best_ttft, r.prefill_time_s)
+    with ledger_scope(ledger):
+        for _ in range(repeats):
+            r = run(cfg, params, tokens, lengths, sampling)
+            best_tps = max(best_tps, r.decode_tok_s)
+            best_ttft = min(best_ttft, r.prefill_time_s)
     # Pop (not get): a headline run hits this 7+ times and traces are large —
     # capture exactly one representative decode (tracing.py's own contract).
     profile_dir = os.environ.pop("EDGEMESH_BENCH_PROFILE", None)
@@ -837,6 +850,7 @@ def decode_benchmark(
         "weight_gb": round(weight_bytes / 1e9, 3),
         "hbm_eff_gbs": round(eff_gbs, 1),
         "hbm_util": round(eff_gbs / HBM_PEAK_GBS, 3),
+        "compute": ledger.rollup() or None,
     }
 
 
@@ -926,6 +940,16 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
         routed_url = f"http://127.0.0.1:{front.server_address[1]}/generate"
         direct = measure(f"{replica_url}/generate", "direct")
         routed = measure(routed_url, "router")
+        # Ledger-off arm: the replica engine's compute ledger disabled
+        # (the EDGEMESH_COMPUTE_SAMPLE=0 configuration) under otherwise
+        # identical conditions — the delta vs `routed` is the ledger's
+        # whole steady-state cost (two counter bumps per launch plus one
+        # sampled fence in N). Acceptance gate (PERFORMANCE.md): routed
+        # p50 within 2% of this arm.
+        eng = srv.batcher
+        eng.compute.enabled = False
+        ledgeroff = measure(routed_url, "router, ledger off")
+        eng.compute.enabled = True
         router.trace_sample = 1.0
         traced = measure(routed_url, "router+tracing")
         # Recorder arm: tracing back OFF, the flight ring attached live —
@@ -933,7 +957,6 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
         from edgemesh.obs.flight import FlightRecorder
 
         router.trace_sample = 0.0
-        eng = srv.batcher
         eng.obs.flight = FlightRecorder(registry=eng.obs.registry,
                                         snapshot_source=eng.load_digest)
         recorded = measure(routed_url, "router+recorder")
@@ -945,11 +968,16 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
         overhead_p50 = pct(routed, 50) - pct(direct, 50)
         tracing_p50 = pct(traced, 50) - pct(routed, 50)
         recorder_p50 = pct(recorded, 50) - pct(routed, 50)
+        ledger_ratio = (
+            round(pct(routed, 50) / pct(ledgeroff, 50), 4)
+            if pct(ledgeroff, 50) else None
+        )
         _progress(
             f"router-overhead: p50 {pct(direct, 50) * 1e3:.2f}ms direct vs "
             f"{pct(routed, 50) * 1e3:.2f}ms routed (+{overhead_p50 * 1e3:.2f}ms), "
             f"tracing +{tracing_p50 * 1e3:.2f}ms, "
-            f"recorder +{recorder_p50 * 1e3:.2f}ms"
+            f"recorder +{recorder_p50 * 1e3:.2f}ms, "
+            f"ledger ratio {ledger_ratio}"
         )
         # One real assembled trace rides the artifact: the last traced
         # request, stitched across the router and replica span logs.
@@ -981,6 +1009,14 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
             "recorder_overhead_p50_s": round(recorder_p50, 6),
             "recorder_overhead_p99_s": round(pct(recorded, 99) - pct(routed, 99), 6),
             "recorder_ring_records": ring_records,
+            # The compute-ledger arm: routed (ledger on, the default) vs
+            # the same path with the ledger disabled. The gate
+            # (PERFORMANCE.md "The compute observatory"): ratio <= 1.02.
+            "ledgeroff_p50_s": pct(ledgeroff, 50),
+            "ledgeroff_p99_s": pct(ledgeroff, 99),
+            "ledger_overhead_p50_s": round(pct(routed, 50) - pct(ledgeroff, 50), 6),
+            "ledger_overhead_ratio": ledger_ratio,
+            "compute": eng.compute.rollup() or None,
             "sample_trace": sample_trace,
             # The obs view of the routed arms (counters + router histogram).
             "obs": obs.summary(prefix="edgemesh_fleet_"),
@@ -1990,11 +2026,31 @@ def speculative_benchmark(
     _progress(f"spec b{batch} gamma={gamma} kv={kv_backend}: warmup")
     spec_once()
     plain = plain_once()
+    # Ambient compute ledger over the timed spec arms: the runtime spec
+    # path launches its fused round loop as the "spec_rounds" boundary, so
+    # the artifact carries a measured round time — the spec round ledger
+    # below decomposes it into draft/verify by the analytic flops split
+    # (obs/compute.py SpecRoundLedger; the instrument for the BENCH_r05
+    # 2.8x spec loss).
+    from edgemesh.obs import (
+        ComputeLedger, Registry, SpecRoundLedger, ledger_scope,
+        spec_draft_frac,
+    )
+
+    ledger = ComputeLedger(registry=Registry(), engine="bench-spec",
+                           sample=1)
+    rounds_ledger = SpecRoundLedger(
+        ledger=ledger, engine="bench-spec",
+        draft_frac=spec_draft_frac(params, d_params, gamma))
     best_spec, stats = 0.0, None
-    for _ in range(2):
-        r, s = spec_once()
-        if r.decode_tok_s > best_spec:
-            best_spec, stats = r.decode_tok_s, s
+    with ledger_scope(ledger):
+        for _ in range(2):
+            r, s = spec_once()
+            rounds_ledger.on_segment(
+                s.rounds, s.accepted, s.proposed,
+                measured_s=ledger.consume_measured("spec_rounds"))
+            if r.decode_tok_s > best_spec:
+                best_spec, stats = r.decode_tok_s, s
     plain_best = plain.decode_tok_s
     for _ in range(2):
         plain_best = max(plain_best, plain_once().decode_tok_s)
@@ -2021,6 +2077,13 @@ def speculative_benchmark(
         "draft_layers": d_layers,
         "draft_mode": "truncate",
         "kv_backend": kv_backend,
+        # Round-structure attribution over the timed arms: measured round
+        # time split draft-vs-verify by the analytic flops ratio (labeled
+        # in the block itself), plus accept/reject accounting — the
+        # decomposition of WHERE a spec slowdown goes (draft overhead vs
+        # verify vs rejected work).
+        "spec_round_ledger": rounds_ledger.summary(),
+        "compute": ledger.rollup() or None,
     }
 
 
@@ -2203,6 +2266,9 @@ def headline_benchmark(
         out["serving_paged_req_s"] = r["req_s"]
         out["serving_latency_s_p50"] = r["latency_s_p50"]
         out["serving_latency_s_p95"] = r["latency_s_p95"]
+        # The compute observatory's view of the headline serving run:
+        # per-boundary device time + roofline (docs/OBSERVABILITY.md).
+        out["serving_compute"] = r.get("compute")
         emit_partial(out)
         # Segmented baseline at the same shape: the headline's own
         # ragged-vs-segmented pin (the full shape sweep is stage 7c).
@@ -2317,6 +2383,12 @@ def headline_benchmark(
                   "recorder_overhead_p50_s", "recorder_overhead_p99_s",
                   "recorder_ring_records"):
             out[k] = r[k]
+        # The compute-ledger overhead arm (ledger on vs off): the <=1.02
+        # ratio gate PERFORMANCE.md pins. .get(): a faked stage from an
+        # older schema must not fail the whole headline.
+        for k in ("ledgeroff_p50_s", "ledger_overhead_p50_s",
+                  "ledger_overhead_ratio"):
+            out[k] = r.get(k)
 
     if os.environ.get("EDGEMESH_BENCH_FLEET", "1") == "1":
         _stage("router_overhead", _router_overhead)
@@ -2400,6 +2472,11 @@ def headline_benchmark(
         out["spec_selfcheck_accept_rate"] = r["selfcheck_accept_rate"]
         out["spec_draft_mode"] = r["draft_mode"]
         out["spec_gamma"] = r["gamma"]
+        # Round-structure attribution (obs/compute.py SpecRoundLedger):
+        # measured round time, draft/verify split (analytic flops,
+        # labeled), accept/reject accounting — the decomposition of the
+        # spec arm's win or loss.
+        out["spec_round_ledger"] = r.get("spec_round_ledger")
         emit_partial(out)
         # Composed cell: speculative over int8 page pools (both arms int8).
         r2 = speculative_benchmark(preset, kv_backend="paged_int8",

@@ -149,6 +149,15 @@ class TelemetryBalancer:
         queue = load.get("ewma_queue_s")
         prefill = load.get("ewma_prefill_s")
         service = load.get("ewma_service_s")
+        if service is None:
+            # Before the first request completes, the span-level service
+            # EWMA is null — but the compute ledger may already have
+            # measured decode launches (digest["costs"]). A launch EWMA
+            # is a per-segment time, not a per-request one, so it
+            # underestimates — still far better directionally than the
+            # queue+prefill fallback below. Digests WITHOUT a cost block
+            # (older replicas, ledger disabled) score exactly as before.
+            service = self._cost_service_s(load)
         if queue is None and prefill is None and service is None:
             # A digest with no latency telemetry yet (non-continuous
             # gateway, or a continuous replica before its first request)
@@ -162,6 +171,24 @@ class TelemetryBalancer:
         if load.get("recent_compile"):
             telem += self.compile_penalty_s
         return freshness * telem + (1.0 - freshness) * neutral
+
+    @staticmethod
+    def _cost_service_s(load: dict) -> float | None:
+        """Measured decode-launch EWMA from the digest's per-boundary
+        cost block (obs/compute.py ``digest_costs``), or None when the
+        digest carries no cost block or no decode boundary measured yet."""
+        costs = load.get("costs")
+        if not isinstance(costs, dict):
+            return None
+        for boundary, cell in costs.items():
+            if boundary not in ("decode_loop", "spec_rounds"):
+                continue
+            if not isinstance(cell, dict):
+                continue
+            v = cell.get("ewma_launch_s")
+            if isinstance(v, (int, float)) and v > 0:
+                return float(v)
+        return None
 
     def pick(self, candidates: Sequence, prompt: str | None = None):
         return min(

@@ -1138,6 +1138,11 @@ class FleetRouter:
         # claim, not zero.
         cap_tok = cap_req = demand = None
         per_replica: dict[str, dict] = {}
+        # Fleet compute rollup (docs/OBSERVABILITY.md "The compute
+        # observatory"): per-boundary measured launch EWMAs from each
+        # replica's digest cost block, aggregated across the routable
+        # fleet. Null until some replica's ledger has measured something.
+        fleet_costs: dict[str, dict] = {}
         for rep in self.registry.replicas():
             load = rep.load if isinstance(rep.load, dict) else {}
             cap = load.get("capacity")
@@ -1147,6 +1152,7 @@ class FleetRouter:
             cell = {
                 "est_tok_s": cap.get("est_tok_s"),
                 "est_req_s": cap.get("est_req_s"),
+                "measured_tok_s": cap.get("measured_tok_s"),
                 "arrival_rps": (
                     round(1.0 / arrival, 3) if arrival else None
                 ),
@@ -1158,11 +1164,41 @@ class FleetRouter:
                 cap_req = (cap_req or 0.0) + cell["est_req_s"]
             if cell["arrival_rps"] is not None:
                 demand = (demand or 0.0) + cell["arrival_rps"]
+            costs = load.get("costs")
+            if isinstance(costs, dict):
+                for boundary, c in costs.items():
+                    if not isinstance(c, dict):
+                        continue
+                    agg = fleet_costs.setdefault(
+                        str(boundary),
+                        {"replicas": 0, "launches": 0,
+                         "ewma_launch_s": [], "roofline": []})
+                    agg["replicas"] += 1
+                    if isinstance(c.get("launches"), int):
+                        agg["launches"] += c["launches"]
+                    if isinstance(c.get("ewma_launch_s"), (int, float)):
+                        agg["ewma_launch_s"].append(float(c["ewma_launch_s"]))
+                    if isinstance(c.get("roofline"), (int, float)):
+                        agg["roofline"].append(float(c["roofline"]))
         capacity = {
             "fleet_est_tok_s": None if cap_tok is None else round(cap_tok, 3),
             "fleet_est_req_s": None if cap_req is None else round(cap_req, 3),
             "fleet_arrival_rps": None if demand is None else round(demand, 3),
             "replicas": per_replica,
+            "costs": {
+                b: {
+                    "replicas": a["replicas"],
+                    "launches": a["launches"],
+                    "ewma_launch_s": (
+                        round(sum(a["ewma_launch_s"])
+                              / len(a["ewma_launch_s"]), 6)
+                        if a["ewma_launch_s"] else None),
+                    "roofline": (
+                        round(sum(a["roofline"]) / len(a["roofline"]), 4)
+                        if a["roofline"] else None),
+                }
+                for b, a in sorted(fleet_costs.items())
+            } or None,
         }
         return {
             "balancer": getattr(self.balancer, "name", type(self.balancer).__name__),

@@ -23,6 +23,10 @@ Three pieces (docs/OBSERVABILITY.md is the operator-facing reference):
 - ``anomaly``: the triggers that fire it — SLO-miss burst vs a decayed
   baseline, admission-queue collapse, error spike, compile storm — and
   the fleet incident-id propagation seam.
+- ``compute``: the compute observatory — per-launch device-time
+  attribution over every jitted serving boundary (sampled fenced
+  timings, once-per-compile cost_analysis capture, roofline scoring)
+  plus the speculative round ledger.
 
 Importing this package never imports jax — device sampling defers the
 import to scrape time, so the supervisor and the ``edgemesh obs`` CLI stay
@@ -35,6 +39,19 @@ from edgemesh.obs.anomaly import (  # noqa: F401
     ErrorSpikeDetector,
     QueueCollapseDetector,
     SloBurstDetector,
+)
+from edgemesh.obs.compute import (  # noqa: F401
+    LAUNCH_RECORD_EVENT,
+    SPEC_ROUND_RECORD_EVENT,
+    ComputeLedger,
+    SpecRoundLedger,
+    ambient_ledger,
+    device_peaks,
+    diff_compute,
+    ledger_scope,
+    roofline_fraction,
+    spec_draft_frac,
+    summarize_compute,
 )
 from edgemesh.obs.device import register_device_gauges  # noqa: F401
 from edgemesh.obs.flight import (  # noqa: F401

@@ -32,6 +32,13 @@ Subcommands (all operate on the span JSONL the engines write via
   and the structured error-kind vocabulary. The same table the wire
   analysis pass (EM501-EM506, docs/ANALYSIS.md) enforces statically, so
   this printout IS the protocol doc, generated-verifiable.
+- ``compute <spans.jsonl> [--diff B] [--json]``: the compute observatory
+  table (obs/compute.py) — per-boundary sampled device time with share,
+  mean/p50 launch time, roofline fraction, cost-model flops rate, and top
+  shape buckets, plus the speculative round-attribution block when the
+  log carries ``spec_rounds`` records. ``--diff B`` compares two logs
+  boundary-by-boundary (B/A mean ratio). A log with no launch records
+  prints an explicit empty report and exits 0.
 - ``incident <dumpdir>``: join an incident directory's flight-recorder
   dumps (every replica's ring, plus ``--logs`` router spans) into one
   postmortem document: trigger window marked, per-tenant goodput
@@ -134,6 +141,18 @@ def build_parser() -> argparse.ArgumentParser:
                      "--span-log adds its incident/timeline records)")
     inc.add_argument("--window-s", type=float, default=10.0,
                      help="half-width of the trigger window (default 10s)")
+    comp = sub.add_parser(
+        "compute",
+        help="per-boundary device-time ledger table from launch records "
+        "(obs/compute.py): share of device time, roofline fraction, "
+        "cost-model flops/bytes, speculative round attribution")
+    comp.add_argument("path", help="span JSONL log or directory of them")
+    comp.add_argument("--diff", default=None, metavar="SPANS",
+                      help="second span log: print per-boundary deltas "
+                      "(the second log vs the first)")
+    comp.add_argument("--json", action="store_true", dest="as_json",
+                      help="print the machine-readable rollup "
+                      "(compute.summarize_compute) instead of the table")
     return p
 
 
@@ -248,6 +267,13 @@ def cmd_summary(path: str) -> int:
             return None
         return round(xs[min(len(xs) - 1, int(q * len(xs)))], 6)
 
+    # Compute-ledger rollup (obs/compute.py): per-boundary device time /
+    # roofline + speculative round attribution. Null on pre-compute logs
+    # and exit 0 — the same old-log contract as every block above.
+    from edgemesh.obs.compute import summarize_compute
+
+    compute = summarize_compute(records)
+
     print(json.dumps({
         "records": len(records),
         "requests": len(spans),
@@ -264,8 +290,109 @@ def cmd_summary(path: str) -> int:
         "slo_classified": len(classified),
         "slo_goodput_ratio": goodput,
         "tenants": tenants,
+        "compute": compute,
         "metrics": registry.summary(),
     }, indent=2))
+    return 0
+
+
+def _fmt_frac(v) -> str:
+    return "-" if v is None else f"{v:.2f}"
+
+
+def _fmt_flops(v) -> str:
+    if v is None:
+        return "-"
+    for unit, scale in (("T", 1e12), ("G", 1e9), ("M", 1e6)):
+        if v >= scale:
+            return f"{v / scale:.1f}{unit}"
+    return f"{v:.0f}"
+
+
+def _compute_table(summ: dict) -> list[str]:
+    lines = [f"{'BOUNDARY':<16} {'LAUNCH':>7} {'MEAS':>5} {'DEVICE':>9} "
+             f"{'SHARE':>6} {'MEAN':>9} {'P50':>9} {'ROOFL':>5} "
+             f"{'FLOP/S':>7}  KEYS"]
+    for name, c in sorted(
+            summ["boundaries"].items(),
+            key=lambda kv: -(kv[1].get("device_s") or 0.0)):
+        share = c.get("share")
+        keys = ",".join(list(c.get("top_keys") or ())[:3])
+        lines.append(
+            f"{name:<16} "
+            f"{'-' if c.get('launches') is None else c['launches']:>7} "
+            f"{c.get('measured', 0):>5} "
+            f"{c.get('device_s', 0.0):>8.3f}s "
+            f"{'-' if share is None else f'{share * 100:.1f}%':>6} "
+            f"{_fmt_s(c.get('mean_s')):>9} {_fmt_s(c.get('p50_s')):>9} "
+            f"{_fmt_frac(c.get('roofline_fraction')):>5} "
+            f"{_fmt_flops(c.get('achieved_flops_s')):>7}  {keys}"
+        )
+    lines.append(
+        f"total: {summ['total_device_s']:.3f}s sampled device time over "
+        f"{summ['launch_records']} launch record(s)")
+    spec = summ.get("spec_rounds")
+    if spec:
+        lines.append("")
+        lines.append(
+            f"spec rounds: {spec.get('rounds')} rounds, "
+            f"accepted {spec.get('accepted')}/{spec.get('proposed')} "
+            f"(rate {_fmt_frac(spec.get('accept_rate'))}, "
+            f"{spec.get('accepted_per_round')} tok/round)"
+        )
+        lines.append(
+            f"  round={_fmt_s(spec.get('round_s'))} "
+            f"draft={_fmt_s(spec.get('draft_s'))} "
+            f"verify={_fmt_s(spec.get('verify_s'))} "
+            f"(draft_frac={spec.get('draft_frac')}, "
+            f"split: {spec.get('split')})"
+        )
+    return lines
+
+
+def cmd_compute(path: str, diff: str | None = None,
+                as_json: bool = False) -> int:
+    """Per-boundary device-time table from a span log's launch records.
+    A log with no compute records is an answer, not an error: prints an
+    explicit empty report and exits 0 (pre-compute logs — same contract
+    as summary's pre-SLO fields)."""
+    from edgemesh.obs.compute import diff_compute, summarize_compute
+
+    if diff is not None and not Path(diff).exists():
+        print(f"error: no such span log: {diff}", file=sys.stderr)
+        return 2
+    summ = summarize_compute(_read(path))
+    if diff is not None:
+        other = summarize_compute(_read(diff))
+        doc = diff_compute(summ, other)
+        if as_json:
+            print(json.dumps(doc, indent=2))
+            return 0
+        if not doc["boundaries"]:
+            print("no launch records in either log — nothing to diff")
+            return 0
+        print(f"{'BOUNDARY':<16} {'A MEAN':>9} {'B MEAN':>9} {'B/A':>6} "
+              f"{'A SHARE':>8} {'B SHARE':>8} {'A ROOFL':>7} {'B ROOFL':>7}")
+        for name, c in doc["boundaries"].items():
+            ratio = c.get("ratio")
+            print(
+                f"{name:<16} {_fmt_s(c.get('a_mean_s')):>9} "
+                f"{_fmt_s(c.get('b_mean_s')):>9} "
+                f"{'-' if ratio is None else f'{ratio:.2f}x':>6} "
+                f"{_fmt_frac(c.get('a_share')):>8} "
+                f"{_fmt_frac(c.get('b_share')):>8} "
+                f"{_fmt_frac(c.get('a_roofline')):>7} "
+                f"{_fmt_frac(c.get('b_roofline')):>7}"
+            )
+        return 0
+    if as_json:
+        print(json.dumps(summ, indent=2))
+        return 0
+    if summ is None:
+        print("no launch records — a pre-compute log, or the ledger was "
+              "disabled (EDGEMESH_COMPUTE_SAMPLE=0)")
+        return 0
+    print("\n".join(_compute_table(summ)))
     return 0
 
 
@@ -479,6 +606,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_tail(args.path, args.count, args.event)
     if args.cmd == "summary":
         return cmd_summary(args.path)
+    if args.cmd == "compute":
+        return cmd_compute(args.path, diff=args.diff, as_json=args.as_json)
     return cmd_prom(args.path)
 
 

@@ -1,0 +1,727 @@
+"""The compute observatory: per-launch device-time attribution.
+
+The serving stack is a handful of jitted boundaries (the dense decode
+loop, the ragged boundary launch, the paged prefill/splice programs, the
+speculative draft→verify round loop, the tp shard_map programs). Spans
+see *requests* and the flight ring sees *events*, but nothing attributes
+wall time to a specific launch, or prices a launch against an analytic
+FLOP/byte budget — which is exactly why the 2.8x speculative loss and
+the unpinned on-chip numbers stall on scarce hardware windows
+(docs/PERFORMANCE.md). :class:`ComputeLedger` closes that gap with two
+ingredients per boundary:
+
+- a static **cost model**, captured once per compile key from
+  ``jitted.lower(...).compile().cost_analysis()`` via the
+  ``utils/compat.aot_cost_analysis`` shim (flops / bytes accessed /
+  output bytes, each degrading to None where XLA withholds it). The key
+  is the same identity the compile cache uses — the call-site's shape
+  bucket — so a new key means a new compile, and ``compiles`` in the
+  rollup counts exactly the distinct programs a boundary paid for.
+- **measured device time** from a *sampled* sync: 1-in-N launches (the
+  first post-compile launch, then every Nth) pay one
+  ``utils/platform.device_sync`` fence — a real completion fence on the
+  tunneled TPU platform, where ``block_until_ready`` returns early —
+  and the measured seconds feed per-boundary EWMAs, the
+  ``edgemesh_launch_seconds`` histogram, a ``launch`` span record, and
+  (when attached) the flight ring. Steady-state dispatch stays async:
+  the other N-1 launches cost two counter bumps. ``N`` comes from
+  ``EDGEMESH_COMPUTE_SAMPLE`` (default 16; ``0`` disables the ledger
+  entirely — the overhead-gate arm benchmarks.py flips).
+
+Roofline: with a device peak model (``device_peaks``), a measured
+launch's ``achieved_flops_s = flops / measured_s`` is scored against
+``min(peak_flops_s, intensity * peak_bytes_s)`` where ``intensity =
+flops / bytes_accessed`` — the classic roofline attainable. The
+fraction is None wherever any input is unknown (CPU has no peak model;
+XLA may withhold the cost table): the ledger never guesses.
+
+Importing this module never imports jax (the obs package contract);
+every device touch lives inside ``launch()`` and runs lazily.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Any, Callable
+
+from edgemesh.obs.metrics import Registry, get_registry
+from edgemesh.obs.spans import EWMA_ALPHA
+
+#: Span-log event names (the obs JSONL one-record-vocabulary — edgelint
+#: EM113): one ``launch`` record per *measured* launch, one
+#: ``spec_rounds`` record per measured speculative segment.
+LAUNCH_RECORD_EVENT = "launch"
+SPEC_ROUND_RECORD_EVENT = "spec_rounds"
+
+#: 1-in-N launch sampling rate (see module docstring). 0 disables.
+SAMPLE_ENV = "EDGEMESH_COMPUTE_SAMPLE"
+DEFAULT_SAMPLE = 16
+
+#: Launch durations sit well under the request-latency buckets: a CPU
+#: test segment is ~1-100ms, an on-chip decode segment ~1-10ms, a cold
+#: ragged boundary can reach seconds.
+LAUNCH_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+# Peak (flops/s, HBM bytes/s) per accelerator generation, keyed on a
+# substring of jax's device_kind. bf16 dense peaks — the serving
+# forwards' unit of account. Absent kinds (CPU first among them) get no
+# peak model and therefore no roofline fractions; the env overrides let
+# a hardware window calibrate without a code change.
+PEAK_FLOPS_ENV = "EDGEMESH_PEAK_FLOPS"
+PEAK_BYTES_ENV = "EDGEMESH_PEAK_BYTES"
+_DEVICE_PEAKS = (
+    ("v6e", (918e12, 1.64e12)),
+    ("v5p", (459e12, 2.765e12)),
+    ("v5e", (197e12, 0.82e12)),
+    ("v5 lite", (197e12, 0.82e12)),
+    ("v4", (275e12, 1.23e12)),
+)
+
+
+def device_peaks() -> tuple[float, float] | None:
+    """(peak_flops_s, peak_bytes_s) for the default device, or None when
+    unknown (CPU, unrecognized kinds). Env overrides win; any probe
+    failure degrades to None — the roofline column goes blank, the
+    ledger keeps measuring."""
+    try:
+        env_f, env_b = os.environ.get(PEAK_FLOPS_ENV), os.environ.get(PEAK_BYTES_ENV)
+        if env_f and env_b:
+            return float(env_f), float(env_b)
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+        for needle, peaks in _DEVICE_PEAKS:
+            if needle in kind:
+                return peaks
+    except Exception:
+        return None
+    return None
+
+
+def roofline_fraction(flops, bytes_accessed, measured_s,
+                      peaks: tuple[float, float] | None) -> float | None:
+    """achieved / attainable under the roofline model; None when any
+    input is unknown or degenerate (the ledger reports no claim rather
+    than a guess — same convention as the capacity model)."""
+    if not peaks or not flops or not bytes_accessed or not measured_s:
+        return None
+    peak_flops_s, peak_bytes_s = peaks
+    attainable = min(peak_flops_s, (flops / bytes_accessed) * peak_bytes_s)
+    if attainable <= 0:
+        return None
+    return min(1.0, (flops / measured_s) / attainable)
+
+
+class _Boundary:
+    """Per-boundary ledger cell. Owned by the dispatching thread (the
+    engine worker); the lock in ComputeLedger guards only cross-thread
+    *reads* (rollup / digest from gateway threads)."""
+
+    __slots__ = (
+        "launches", "measured", "since_measure", "device_s", "ewma_s",
+        "ewma_tok_s", "tokens", "costs", "key_counts", "last_measured_s",
+        "roofline", "last_key",
+    )
+
+    def __init__(self) -> None:
+        self.launches = 0
+        self.measured = 0
+        self.since_measure = 0
+        self.device_s = 0.0
+        self.ewma_s: float | None = None
+        self.ewma_tok_s: float | None = None
+        self.tokens = 0
+        self.costs: dict[str, dict | None] = {}
+        self.key_counts: dict[str, int] = {}
+        self.last_measured_s: float | None = None
+        self.last_key = "static"
+        self.roofline: float | None = None
+
+
+def _ewma(prev: float | None, x: float) -> float:
+    return x if prev is None else prev + EWMA_ALPHA * (x - prev)
+
+
+class ComputeLedger:
+    """Launch ledger for one engine's jitted boundaries.
+
+    ``launch(boundary, fn, *args, key=..., tokens=...)`` dispatches
+    ``fn(*args)`` and does the ledger work around it; ``wrap`` curries a
+    call-site into a drop-in callable. ``key`` is the call-site's shape
+    bucket — the compile-cache identity (e.g. ``"c64s16"`` for a ragged
+    boundary at cap 64 / s_cap 16); omitted means the boundary compiles
+    once (``"static"``).
+    """
+
+    def __init__(self, registry: Registry | None = None,
+                 engine: str = "continuous",
+                 span_log: str | Path | None = None,
+                 sample: int | None = None,
+                 peaks: tuple[float, float] | None = None,
+                 flight_source: Callable[[], Any] | None = None):
+        self.registry = registry or get_registry()
+        self.engine = engine
+        if sample is None:
+            sample = int(os.environ.get(SAMPLE_ENV, str(DEFAULT_SAMPLE)))
+        self.sample = int(sample)
+        self.enabled = self.sample > 0
+        self._peaks = peaks if peaks is not None else device_peaks()
+        self._flight_source = flight_source
+        self._lock = threading.Lock()
+        self._boundaries: dict[str, _Boundary] = {}
+        self._log = None
+        if span_log is not None and self.enabled:
+            from edgemesh.utils.tracing import JsonlLogger
+
+            self._log = JsonlLogger(span_log)
+        reg = self.registry
+        self._launches_total = reg.counter(
+            "edgemesh_launches_total",
+            "Jitted boundary launches dispatched", ("engine", "boundary"))
+        self._launch_seconds = reg.histogram(
+            "edgemesh_launch_seconds",
+            "Sampled fenced launch wall time per boundary",
+            ("engine", "boundary"), buckets=LAUNCH_BUCKETS)
+        self._roofline_gauge = reg.gauge(
+            "edgemesh_launch_roofline_ratio",
+            "Last sampled achieved/attainable roofline fraction",
+            ("engine", "boundary"))
+
+    # -- dispatch seam ------------------------------------------------------
+
+    def launch(self, boundary: str, fn, *args,
+               key: str | None = None, tokens: int = 0,
+               measure: bool | None = None):
+        """Dispatch ``fn(*args)`` through the ledger. ``tokens`` credits
+        generated/processed tokens to the boundary's throughput EWMA;
+        ``measure=True`` forces the fence (standalone paths that sync
+        anyway), ``None`` applies the 1-in-N sampling rule."""
+        if not self.enabled:
+            return fn(*args)
+        st = self._boundaries.get(boundary)
+        if st is None:
+            with self._lock:
+                st = self._boundaries.setdefault(boundary, _Boundary())
+        k = key or "static"
+        first_key = k not in st.costs
+        specs = None
+        if first_key:
+            # Claim the key BEFORE dispatch and snapshot abstract shapes:
+            # donated args are deleted by the launch itself, and a
+            # concurrent rollup must never see a half-captured cost row.
+            st.costs[k] = None
+            specs = _arg_specs(args)
+        st.launches += 1
+        st.since_measure += 1
+        st.key_counts[k] = st.key_counts.get(k, 0) + 1
+        st.last_key = k
+        if tokens:
+            st.tokens += tokens
+        self._launches_total.labels(engine=self.engine, boundary=boundary).inc()
+        # Never time a first-key launch: it pays the compile, which would
+        # poison the EWMA by orders of magnitude. The compile hook
+        # (obs/trace.py) already owns compile-time attribution.
+        do_measure = (not first_key) and (
+            measure if measure is not None
+            else (st.measured == 0 or st.since_measure >= self.sample)
+        )
+        t0 = time.perf_counter() if do_measure else 0.0
+        out = fn(*args)
+        if first_key:
+            from edgemesh.utils.compat import aot_cost_analysis
+
+            st.costs[k] = aot_cost_analysis(fn, specs)
+        if do_measure:
+            _fence(out)
+            dt = time.perf_counter() - t0
+            self._record(boundary, st, k, dt, tokens)
+        return out
+
+    def wrap(self, boundary: str, fn, key: str | None = None,
+             key_fn: Callable[..., str] | None = None):
+        """Drop-in instrumented callable for a fixed boundary.
+        ``key_fn(*args)`` derives the shape bucket per call when the
+        call-site's shapes vary (tp prefill pads per prompt bucket)."""
+        if not self.enabled:
+            return fn
+
+        def wrapped(*args):
+            k = key_fn(*args) if key_fn is not None else key
+            return self.launch(boundary, fn, *args, key=k)
+
+        return wrapped
+
+    def _record(self, boundary: str, st: _Boundary, key: str,
+                dt: float, tokens: int) -> None:
+        st.measured += 1
+        st.since_measure = 0
+        st.device_s += dt
+        st.ewma_s = _ewma(st.ewma_s, dt)
+        if tokens and dt > 0:
+            st.ewma_tok_s = _ewma(st.ewma_tok_s, tokens / dt)
+        st.last_measured_s = dt
+        cost = st.costs.get(key) or {}
+        flops = cost.get("flops")
+        achieved = flops / dt if flops and dt > 0 else None
+        frac = roofline_fraction(
+            flops, cost.get("bytes_accessed"), dt, self._peaks)
+        if frac is not None:
+            st.roofline = frac
+            self._roofline_gauge.labels(
+                engine=self.engine, boundary=boundary).set(frac)
+        self._launch_seconds.labels(
+            engine=self.engine, boundary=boundary).observe(dt)
+        rec = {
+            "engine": self.engine,
+            "boundary": boundary,
+            "key": key,
+            "measured_s": round(dt, 6),
+            "flops": flops,
+            "bytes": cost.get("bytes_accessed"),
+            "output_bytes": cost.get("output_bytes"),
+            "achieved_flops_s": None if achieved is None else round(achieved, 1),
+            "roofline_fraction": None if frac is None else round(frac, 4),
+            "tokens": tokens,
+            "launches": st.launches,
+        }
+        if self._log is not None:
+            self._log.log(LAUNCH_RECORD_EVENT, **rec)
+        self._flight(LAUNCH_RECORD_EVENT, rec)
+
+    def _flight(self, event: str, rec: dict) -> None:
+        if self._flight_source is None:
+            return
+        try:
+            fl = self._flight_source()
+            if fl is not None:
+                fl.record(event, rec)
+        except Exception:  # flight is best-effort by contract
+            pass
+
+    def consume_measured(self, boundary: str) -> float | None:
+        """Pop the newest sampled measurement for ``boundary`` (None when
+        no launch was measured since the last call). The speculative
+        round ledger associates segment deltas with segment timings
+        through this — both run on the engine worker."""
+        st = self._boundaries.get(boundary)
+        if st is None or st.last_measured_s is None:
+            return None
+        dt, st.last_measured_s = st.last_measured_s, None
+        return dt
+
+    # -- read side ----------------------------------------------------------
+
+    def rollup(self) -> dict[str, dict]:
+        """Per-boundary aggregate — what benchmarks attach to BENCH JSON
+        and ``edgemesh obs compute`` renders from live state."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            items = list(self._boundaries.items())
+        for b, st in items:
+            cost = st.costs.get(st.last_key) or {}
+            out[b] = {
+                "launches": st.launches,
+                "measured": st.measured,
+                "compiles": len(st.costs),
+                "device_s": round(st.device_s, 6),
+                "ewma_launch_s": (
+                    None if st.ewma_s is None else round(st.ewma_s, 6)),
+                "roofline_fraction": st.roofline,
+                "flops": cost.get("flops"),
+                "bytes": cost.get("bytes_accessed"),
+                "shape_buckets": dict(st.key_counts),
+            }
+        return out
+
+    def digest_costs(self) -> dict[str, dict] | None:
+        """The load digest's per-boundary cost block: measured launch
+        EWMAs + throughput, compact enough to ship on every probe. None
+        until something was measured — pre-compute consumers (and old
+        routers) see exactly the digest they always did."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            items = list(self._boundaries.items())
+        for b, st in items:
+            if st.ewma_s is None:
+                continue
+            out[b] = {
+                "ewma_launch_s": round(st.ewma_s, 6),
+                "launches": st.launches,
+                "tok_s": (
+                    None if st.ewma_tok_s is None
+                    else round(st.ewma_tok_s, 3)),
+                "roofline": st.roofline,
+            }
+        return out or None
+
+    def measured_tok_s(
+            self, boundaries: tuple[str, ...] = ("decode_loop",),
+    ) -> float | None:
+        """Measured decode throughput (tok/s from fenced launch time)
+        over the named DECODE boundaries — the capacity model's measured
+        replacement for the host-EWMA-derived ``est_tok_s``. Explicitly
+        scoped: prefill boundaries also credit tokens, at an order of
+        magnitude higher tok/s, and must never inflate a decode
+        capacity claim."""
+        best = None
+        with self._lock:
+            for b in boundaries:
+                st = self._boundaries.get(b)
+                if st is None or st.ewma_tok_s is None:
+                    continue
+                if best is None or st.ewma_tok_s > best:
+                    best = st.ewma_tok_s
+        return None if best is None else round(best, 3)
+
+
+class SpecRoundLedger:
+    """Round-structure attribution for speculative decoding.
+
+    The serving engine's draft→verify rounds run fused in ONE jitted
+    while_loop (``runtime/speculative._spec_rounds``) — a host timer
+    cannot split draft from verify inside it. The ledger therefore
+    attributes at the granularity that is measurable without breaking
+    the fusion: per-segment deltas of the device round/accept/propose
+    counters, paired with the compute ledger's sampled launch time for
+    that segment, split draft-vs-verify by the **analytic flops ratio**
+    (``draft_frac``: gamma draft decode steps against one gamma+1-token
+    verify, priced at 2·params flops/token — the standard dense decode
+    estimate). The split is labeled, not hidden: ``summary()["split"]``
+    says ``analytic-flops`` so a reader knows which numbers are measured
+    (round counts, acceptance, segment seconds) and which are modeled
+    (the draft/verify partition)."""
+
+    def __init__(self, ledger: ComputeLedger | None = None,
+                 engine: str = "speculative",
+                 draft_frac: float | None = None):
+        self._ledger = ledger
+        self.engine = engine
+        self.draft_frac = draft_frac
+        self.rounds = 0
+        self.accepted = 0
+        self.proposed = 0
+        self.segments = 0
+        self.measured_segments = 0
+        self.measured_s = 0.0
+        self.measured_rounds = 0
+
+    def on_segment(self, rounds: int, accepted: int, proposed: int,
+                   measured_s: float | None = None) -> None:
+        """Credit one processed segment's counter deltas. Negative deltas
+        mean the pool (and its device counters) reset mid-flight — skip
+        the segment rather than corrupt the ledger."""
+        if rounds < 0 or accepted < 0 or proposed < 0:
+            return
+        self.segments += 1
+        self.rounds += rounds
+        self.accepted += accepted
+        self.proposed += proposed
+        if measured_s is None or rounds <= 0:
+            return
+        self.measured_segments += 1
+        self.measured_s += measured_s
+        self.measured_rounds += rounds
+        ledger = self._ledger
+        if ledger is not None and ledger._log is not None:
+            df = self.draft_frac
+            ledger._log.log(
+                SPEC_ROUND_RECORD_EVENT,
+                engine=self.engine, rounds=rounds, accepted=accepted,
+                proposed=proposed, measured_s=round(measured_s, 6),
+                round_s=round(measured_s / rounds, 6),
+                draft_s=(None if df is None else round(measured_s * df, 6)),
+                verify_s=(None if df is None else round(measured_s * (1 - df), 6)),
+                draft_frac=df, split="analytic-flops",
+            )
+
+    def summary(self) -> dict[str, Any] | None:
+        """The ``spec_round_ledger`` block (stats(), BENCH JSON). None
+        before any round ran."""
+        if self.rounds <= 0:
+            return None
+        df = self.draft_frac
+        round_s = (
+            self.measured_s / self.measured_rounds
+            if self.measured_rounds else None
+        )
+        return {
+            "rounds": self.rounds,
+            "accepted": self.accepted,
+            "proposed": self.proposed,
+            "rejected": max(self.proposed - self.accepted, 0),
+            "accept_rate": (
+                round(self.accepted / self.proposed, 4) if self.proposed else None),
+            "accepted_per_round": round(self.accepted / self.rounds, 3),
+            "segments": self.segments,
+            "measured_segments": self.measured_segments,
+            "measured_s": round(self.measured_s, 6),
+            "round_s": None if round_s is None else round(round_s, 6),
+            "draft_s": (
+                None if round_s is None or df is None
+                else round(self.measured_s * df, 6)),
+            "verify_s": (
+                None if round_s is None or df is None
+                else round(self.measured_s * (1 - df), 6)),
+            "draft_frac": df,
+            "split": "analytic-flops",
+        }
+
+
+def spec_draft_frac(target_params, draft_params, gamma: int) -> float | None:
+    """Analytic draft share of one round's flops: gamma draft decode
+    steps vs one (gamma+1)-token target verify, each priced at the dense
+    2·params flops/token estimate. Param counts come from the live trees
+    so quantized/tied variants price what they actually carry."""
+    try:
+        import jax
+
+        def count(tree) -> float:
+            return float(sum(
+                x.size for x in jax.tree_util.tree_leaves(tree)
+                if hasattr(x, "size")
+            ))
+
+        pt, pd = count(target_params), count(draft_params)
+        draft = gamma * 2.0 * pd
+        verify = (gamma + 1) * 2.0 * pt
+        if draft + verify <= 0:
+            return None
+        return round(draft / (draft + verify), 4)
+    except Exception:
+        return None
+
+
+# -- offline analysis (span logs → rollup) ----------------------------------
+
+
+def _mean(xs: list[float]) -> float | None:
+    return round(sum(xs) / len(xs), 6) if xs else None
+
+
+def summarize_compute(records) -> dict | None:
+    """Per-boundary rollup from span-log records — the offline twin of
+    :meth:`ComputeLedger.rollup`, consumed by ``edgemesh obs compute``
+    and the ``compute`` block of ``edgemesh obs summary``.
+
+    Returns None when the log carries no compute records at all: a
+    pre-compute log is an answer, not an error (the CLI prints null and
+    exits 0 — same forward-compat contract as the pre-SLO span fields).
+    Unknown keys on launch records are ignored and known-but-missing keys
+    read as None, so logs written by NEWER builds summarize fine too —
+    both directions are pinned in tests/test_compute.py.
+    """
+    bounds: dict[str, dict] = {}
+    spec: dict | None = None
+    n_launch = 0
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        event = rec.get("event")
+        if event == LAUNCH_RECORD_EVENT:
+            n_launch += 1
+            b = str(rec.get("boundary") or "?")
+            c = bounds.setdefault(b, {
+                "engines": set(), "measured": 0, "device_s": 0.0,
+                "samples": [], "launches": {}, "keys": {},
+                "flops": None, "bytes": None, "output_bytes": None,
+                "achieved": [], "roofline": [], "tokens": 0,
+            })
+            if rec.get("engine") is not None:
+                c["engines"].add(str(rec["engine"]))
+            dt = rec.get("measured_s")
+            if isinstance(dt, (int, float)):
+                c["measured"] += 1
+                c["device_s"] += float(dt)
+                c["samples"].append(float(dt))
+            # ``launches`` is the cumulative dispatch counter at record
+            # time — newest wins, summed across engines sharing a name.
+            if isinstance(rec.get("launches"), int):
+                c["launches"][rec.get("engine")] = rec["launches"]
+            if rec.get("key") is not None:
+                k = str(rec["key"])
+                c["keys"][k] = c["keys"].get(k, 0) + 1
+            for field in ("flops", "bytes", "output_bytes"):
+                if isinstance(rec.get(field), (int, float)):
+                    c[field] = float(rec[field])
+            if isinstance(rec.get("achieved_flops_s"), (int, float)):
+                c["achieved"].append(float(rec["achieved_flops_s"]))
+            if isinstance(rec.get("roofline_fraction"), (int, float)):
+                c["roofline"].append(float(rec["roofline_fraction"]))
+            if isinstance(rec.get("tokens"), int):
+                c["tokens"] += rec["tokens"]
+        elif event == SPEC_ROUND_RECORD_EVENT:
+            if spec is None:
+                spec = {"records": 0, "rounds": 0, "accepted": 0,
+                        "proposed": 0, "measured_s": 0.0, "draft_s": 0.0,
+                        "verify_s": 0.0, "split_s": 0,
+                        "draft_frac": None, "split": None}
+            spec["records"] += 1
+            for field in ("rounds", "accepted", "proposed"):
+                if isinstance(rec.get(field), int):
+                    spec[field] += rec[field]
+            if isinstance(rec.get("measured_s"), (int, float)):
+                spec["measured_s"] += float(rec["measured_s"])
+            if isinstance(rec.get("draft_s"), (int, float)) and \
+                    isinstance(rec.get("verify_s"), (int, float)):
+                spec["draft_s"] += float(rec["draft_s"])
+                spec["verify_s"] += float(rec["verify_s"])
+                spec["split_s"] += 1
+            if rec.get("draft_frac") is not None:
+                spec["draft_frac"] = rec["draft_frac"]
+            if rec.get("split") is not None:
+                spec["split"] = rec["split"]
+    if n_launch == 0 and spec is None:
+        return None
+    total = sum(c["device_s"] for c in bounds.values())
+    out: dict[str, dict] = {}
+    for b, c in sorted(bounds.items()):
+        xs = sorted(c["samples"])
+        launches = sum(c["launches"].values()) or None
+        out[b] = {
+            "engines": sorted(c["engines"]),
+            "launches": launches,
+            "measured": c["measured"],
+            "device_s": round(c["device_s"], 6),
+            "share": round(c["device_s"] / total, 4) if total > 0 else None,
+            "mean_s": (round(c["device_s"] / c["measured"], 6)
+                       if c["measured"] else None),
+            "p50_s": xs[len(xs) // 2] if xs else None,
+            "max_s": xs[-1] if xs else None,
+            "flops": c["flops"],
+            "bytes": c["bytes"],
+            "achieved_flops_s": _mean(c["achieved"]),
+            "roofline_fraction": _mean(c["roofline"]),
+            "tokens": c["tokens"] or None,
+            "top_keys": dict(sorted(c["keys"].items(),
+                                    key=lambda kv: -kv[1])[:3]),
+        }
+    spec_out = None
+    if spec is not None:
+        rounds, prop = spec["rounds"], spec["proposed"]
+        spec_out = {
+            "records": spec["records"],
+            "rounds": rounds,
+            "accepted": spec["accepted"],
+            "proposed": prop,
+            "rejected": max(prop - spec["accepted"], 0),
+            "accept_rate": round(spec["accepted"] / prop, 4) if prop else None,
+            "accepted_per_round": (
+                round(spec["accepted"] / rounds, 3) if rounds else None),
+            "measured_s": round(spec["measured_s"], 6),
+            "round_s": (round(spec["measured_s"] / rounds, 6)
+                        if rounds and spec["measured_s"] else None),
+            "draft_s": (round(spec["draft_s"], 6)
+                        if spec["split_s"] else None),
+            "verify_s": (round(spec["verify_s"], 6)
+                         if spec["split_s"] else None),
+            "draft_frac": spec["draft_frac"],
+            "split": spec["split"],
+        }
+    return {
+        "launch_records": n_launch,
+        "total_device_s": round(total, 6),
+        "boundaries": out,
+        "spec_rounds": spec_out,
+    }
+
+
+def diff_compute(a: dict | None, b: dict | None) -> dict:
+    """Per-boundary comparison of two :func:`summarize_compute` results
+    (``edgemesh obs compute A --diff B``): mean launch time, share of
+    device time, and roofline fraction side by side, with the B/A mean
+    ratio where both sides measured. Boundaries present on only one side
+    still get a row — a boundary appearing or vanishing between two runs
+    IS the finding."""
+    ab = (a or {}).get("boundaries") or {}
+    bb = (b or {}).get("boundaries") or {}
+    out: dict[str, dict] = {}
+    for name in sorted(set(ab) | set(bb)):
+        ca, cb = ab.get(name), bb.get(name)
+        am = (ca or {}).get("mean_s")
+        bm = (cb or {}).get("mean_s")
+        out[name] = {
+            "a_mean_s": am,
+            "b_mean_s": bm,
+            "ratio": (round(bm / am, 4)
+                      if am and bm and am > 0 else None),
+            "a_share": (ca or {}).get("share"),
+            "b_share": (cb or {}).get("share"),
+            "a_roofline": (ca or {}).get("roofline_fraction"),
+            "b_roofline": (cb or {}).get("roofline_fraction"),
+        }
+    return {
+        "boundaries": out,
+        "a_total_device_s": (a or {}).get("total_device_s"),
+        "b_total_device_s": (b or {}).get("total_device_s"),
+    }
+
+
+# -- ambient ledger (standalone runtime paths) ------------------------------
+
+_AMBIENT: list[ComputeLedger] = []
+
+
+@contextmanager
+def ledger_scope(ledger: ComputeLedger):
+    """Install ``ledger`` as the ambient ledger for standalone runtime
+    paths (runtime/generate.py, runtime/speculative.py route their
+    launches through :func:`ambient_ledger` when one is installed —
+    benchmarks wrap whole stages in this)."""
+    _AMBIENT.append(ledger)
+    try:
+        yield ledger
+    finally:
+        _AMBIENT.remove(ledger)
+
+
+def ambient_ledger() -> ComputeLedger | None:
+    return _AMBIENT[-1] if _AMBIENT else None
+
+
+# -- lazy jax helpers -------------------------------------------------------
+
+def _arg_specs(args):
+    """Abstract (shape, dtype) snapshot of a call's arguments for the
+    AOT cost capture — jax array leaves become ShapeDtypeStructs, static
+    leaves pass through. Must run BEFORE dispatch: donation deletes the
+    concrete buffers."""
+    try:
+        import jax
+
+        def spec(x):
+            if isinstance(x, jax.Array):
+                try:  # keep shardings: a tp program's cost is per-shard
+                    return jax.ShapeDtypeStruct(
+                        x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+                except Exception:  # pre-sharding ShapeDtypeStruct signature
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+            return x
+
+        return jax.tree_util.tree_map(spec, args)
+    except Exception:
+        return args
+
+
+def _fence(out) -> None:
+    """Completion fence on a launch's first array output leaf.
+    ``device_sync`` (a 1-element readback), NOT ``block_until_ready``:
+    the tunneled TPU platform returns from the latter before the program
+    finishes (utils/platform.py)."""
+    try:
+        import jax
+
+        from edgemesh.utils.platform import device_sync
+
+        for leaf in jax.tree_util.tree_leaves(out):
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                device_sync(leaf)
+                return
+    except Exception:
+        pass

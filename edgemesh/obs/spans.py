@@ -408,6 +408,7 @@ def replay_spans(records: Iterable[dict] | str | Path,
     """
     registry = registry if registry is not None else Registry()
     trackers: dict[str, SpanTracker] = {}
+    launch_cum: dict[tuple[str, str], int] = {}
     if isinstance(records, (str, Path)):
         from edgemesh.utils.tracing import JsonlLogger
 
@@ -420,6 +421,43 @@ def replay_spans(records: Iterable[dict] | str | Path,
         event = rec.get("event")
         if event == RESET_RECORD_EVENT:
             tr._resets.inc()
+            continue
+        if event == "launch":
+            # Per-launch ledger records (obs/compute.py) replay into the
+            # same families a live scrape serves. Deferred import: compute
+            # imports EWMA_ALPHA from this module. Null-safe throughout —
+            # a record missing any field (or carrying unknown extras from
+            # a newer build) still replays what it has.
+            from edgemesh.obs.compute import LAUNCH_BUCKETS
+
+            boundary = str(rec.get("boundary") or "?")
+            # Records are 1-in-N sampled but carry the cumulative dispatch
+            # counter — replaying the deltas (not the record count) keeps
+            # the offline counter equal to what a live scrape would show.
+            cum = rec.get("launches")
+            prev = launch_cum.get((engine, boundary), 0)
+            inc = (cum - prev if isinstance(cum, int) and cum > prev else 1)
+            if isinstance(cum, int):
+                launch_cum[(engine, boundary)] = max(cum, prev)
+            registry.counter(
+                "edgemesh_launches_total",
+                "Jitted boundary launches dispatched",
+                ("engine", "boundary"),
+            ).labels(engine=engine, boundary=boundary).inc(inc)
+            if isinstance(rec.get("measured_s"), (int, float)):
+                registry.histogram(
+                    "edgemesh_launch_seconds",
+                    "Sampled fenced launch wall time per boundary",
+                    ("engine", "boundary"), buckets=LAUNCH_BUCKETS,
+                ).labels(engine=engine, boundary=boundary).observe(
+                    float(rec["measured_s"]))
+            if isinstance(rec.get("roofline_fraction"), (int, float)):
+                registry.gauge(
+                    "edgemesh_launch_roofline_ratio",
+                    "Last sampled achieved/attainable roofline fraction",
+                    ("engine", "boundary"),
+                ).labels(engine=engine, boundary=boundary).set(
+                    float(rec["roofline_fraction"]))
             continue
         if event != SPAN_RECORD_EVENT:
             continue

@@ -474,6 +474,22 @@ class TPInferenceEngine:
     def decode(self, tokens: jnp.ndarray, cache: KVCache):
         return self._decode_jit(self.params, tokens, cache)
 
+    def instrument(self, ledger) -> None:
+        """Route this engine's two jitted shard_map programs through the
+        compute ledger (obs/compute.ComputeLedger) as the ``tp_prefill``
+        / ``tp_decode`` boundaries. Prefill keys by the padded prompt
+        bucket (its compile identity); the decode step compiles once.
+        The serving engine calls this when it attaches a tp engine, so
+        tp prefills land in the same launch ledger as every other
+        boundary; standalone callers (generate_greedy, benches) can call
+        it themselves. Idempotent enough for one ledger: re-wrapping
+        with a second ledger would double-count, so instrument once."""
+        self._prefill_jit = ledger.wrap(
+            "tp_prefill", self._prefill_jit,
+            key_fn=lambda params, tokens, lengths, cache: f"p{tokens.shape[1]}",
+        )
+        self._decode_jit = ledger.wrap("tp_decode", self._decode_jit)
+
     def collective_accounting(self, batch: int = 1, seq: int = 1) -> dict:
         """Analytic per-step wire accounting for THIS engine's join mode:
         what one forward over [batch, seq] tokens ships per chip, per layer

@@ -229,9 +229,23 @@ def generate(
     # carry each phase's wall time — the same numbers that land in the
     # edgemesh_phase_seconds histogram — and the stopwatch owns the
     # end-to-end window.
+    # The compute observatory (obs/compute.py): when a caller installed an
+    # ambient ledger (ledger_scope — the benches do), both launches run
+    # through it with measure=True: this path fences each phase anyway, so
+    # the ledger's cost capture + attribution ride the sync already paid.
+    from edgemesh.obs.compute import ambient_ledger
+
+    led = ambient_ledger()
     wall = Stopwatch()
     with trace("edgemesh/prefill") as prefill_t:
-        first_logits, cache = prefill_fn(pcfg, params, tokens, lengths, cache)
+        if led is not None:
+            first_logits, cache = led.launch(
+                "prefill", prefill_fn, pcfg, params, tokens, lengths, cache,
+                key=f"b{batch}p{prompt_len}", tokens=batch * prompt_len,
+                measure=True,
+            )
+        else:
+            first_logits, cache = prefill_fn(pcfg, params, tokens, lengths, cache)
         # NOT block_until_ready: on the tunneled TPU platform that returns
         # before the program finishes, silently shrinking the timed window
         # (utils/platform.device_sync). A 1-element readback is a real fence.
@@ -242,10 +256,19 @@ def generate(
         TokenMaskState.init(batch, cfg.vocab_size).add_sequence(tokens, valid).mask
     )
     with trace("edgemesh/decode") as decode_t:
-        out, num_generated, cache, confidence, _, _, _ = _decode_loop(
-            cfg, params, sampling, max_new, int(eos_id), first_logits, cache,
-            token_mask, rng, decode_fn,
-        )
+        if led is not None:
+            out, num_generated, cache, confidence, _, _, _ = led.launch(
+                "decode_loop", _decode_loop,
+                cfg, params, sampling, max_new, int(eos_id), first_logits,
+                cache, token_mask, rng, decode_fn,
+                key=f"b{batch}c{max_new}", tokens=batch * max_new,
+                measure=True,
+            )
+        else:
+            out, num_generated, cache, confidence, _, _, _ = _decode_loop(
+                cfg, params, sampling, max_new, int(eos_id), first_logits,
+                cache, token_mask, rng, decode_fn,
+            )
         device_sync(out)
     # Snapshot the window HERE — the jnp.sum readback below is bookkeeping,
     # not generation, and must not deflate tokens_per_sec.

@@ -961,3 +961,17 @@ def generate_paged(
         decode_fn=forward_decode_paged, make_cache=make_cache,
         check_cache=check_cache,
     )
+
+
+# Boundary catalog: the jitted entry points the serving stack dispatches for
+# paged attention, keyed by the ledger boundary name each one is launched
+# under (see edgemesh.obs.compute).  Tests use these handles to pin that
+# ``aot_cost_analysis`` yields flops/bytes for the real paged boundaries on
+# CPU, without standing up an engine.
+LEDGER_BOUNDARIES = {
+    "paged_prefill": forward_prefill_paged,
+    "paged_splice": forward_prefill_paged_at,
+    "paged_decode": forward_decode_paged,
+    "ragged_boundary": forward_ragged_paged,
+    "paged_verify": forward_verify_paged,
+}

@@ -349,14 +349,29 @@ def generate_speculative(
     batch, prompt_len = tokens.shape
     max_new = int(sampling.max_new_tokens)
     cap = max_new + gamma + 1
+    # Ambient compute ledger (obs/compute.py): the benches wrap this call
+    # in a ledger_scope so the fused round loop lands in the launch ledger
+    # as the spec_rounds boundary — measure=True because the fence below
+    # is paid regardless.
+    from edgemesh.obs.compute import ambient_ledger
+
+    led = ambient_ledger()
     with trace("edgemesh/spec_decode") as decode_t:
         # A round commits >=1 token per active row, so max_new rounds always
         # run to completion.
-        final = _spec_rounds(
+        spec_args = (
             cfg_target, cfg_draft, params_target, params_draft, sampling,
             int(gamma), max_new, int(eos_id), cfg_target.vocab_size, cap,
             state, jnp.asarray(max_new, jnp.int32), verify_fn, decode_fn,
         )
+        if led is not None:
+            final = led.launch(
+                "spec_rounds", _spec_rounds, *spec_args,
+                key=f"b{batch}n{max_new}", tokens=batch * max_new,
+                measure=True,
+            )
+        else:
+            final = _spec_rounds(*spec_args)
         device_sync(final.out)
     # Snapshot HERE — the jnp.sum readback below is bookkeeping, not
     # generation, and must not deflate tokens_per_sec.

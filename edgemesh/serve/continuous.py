@@ -76,6 +76,7 @@ import numpy as np
 
 from edgemesh.models.transformer import KVCache, forward_decode, forward_prefill, init_kv_cache
 from edgemesh.obs import RequestTrace, SpanTracker
+from edgemesh.obs.compute import ComputeLedger, SpecRoundLedger, spec_draft_frac
 from edgemesh.obs.trace import (
     TraceContext,
     install_compile_hook,
@@ -105,7 +106,8 @@ log = logging.getLogger("edgemesh.serve")
 
 
 def estimate_capacity(slots: int, ewma_decode_s=None, ewma_service_s=None,
-                      ewma_decode_tokens=None) -> dict[str, Any]:
+                      ewma_decode_tokens=None,
+                      measured_tok_s=None) -> dict[str, Any]:
     """Sustainable-throughput estimate from the digest's service EWMAs —
     the MEASURED capacity model (docs/OBSERVABILITY.md "The capacity
     model"). Derivation: with every slot busy, each slot yields one token
@@ -114,16 +116,28 @@ def estimate_capacity(slots: int, ewma_decode_s=None, ewma_service_s=None,
     generates (``ewma_decode_tokens``) gives sustainable requests/s, with
     ``slots / ewma_service_s`` as the fallback when the token split has
     not been observed yet. All ``None`` until the EWMAs exist — a cold
-    replica honestly reports no capacity claim rather than a guess."""
+    replica honestly reports no capacity claim rather than a guess.
+
+    ``measured_tok_s`` is the compute ledger's fenced-launch throughput
+    (obs/compute.py): when present it REPLACES the host-EWMA-derived
+    tok/s as ``est_tok_s`` — the host decode EWMA conflates device time
+    with worker bookkeeping and pipeline lag, while the ledger's number
+    is a true device-completion fence over the same launches. The raw
+    value also ships as its own key so consumers can tell which model
+    produced the estimate."""
     tok_s = None
-    if ewma_decode_s:
+    if measured_tok_s:
+        tok_s = round(measured_tok_s, 3)
+    elif ewma_decode_s:
         tok_s = round(slots / ewma_decode_s, 3)
     req_s = None
     if tok_s is not None and ewma_decode_tokens:
         req_s = round(tok_s / ewma_decode_tokens, 3)
     elif ewma_service_s:
         req_s = round(slots / ewma_service_s, 3)
-    return {"slots": slots, "est_tok_s": tok_s, "est_req_s": req_s}
+    return {"slots": slots, "est_tok_s": tok_s, "est_req_s": req_s,
+            "measured_tok_s": (
+                None if not measured_tok_s else round(measured_tok_s, 3))}
 
 
 def pool_state(total: int, free: int, reserved: int, template: int,
@@ -245,19 +259,31 @@ def _splice_row_entries(cache, row, idx: int):
     )
 
 
-def _prefill_into_row(cfg, params, tokens, lengths, cache, idx: int, row_table):
+def _prefill_into_row(cfg, params, tokens, lengths, cache, idx: int, row_table,
+                      ledger=None):
     """Cold zero-copy paged admission: prefill through a donated one-row
     VIEW of the shared pool (the host-built pre-mapped table row + the
     shared pages, donated in place) and splice the resulting table/length
     entries back. Used by the base engine's cold path and by BOTH of the
     speculative engine's pools — one definition of the donation/splice
     contract. Every page the prompt touches is already mapped in
-    ``row_table``, so the in-program allocator pops nothing."""
+    ``row_table``, so the in-program allocator pops nothing. ``ledger``
+    (obs/compute.ComputeLedger) attributes the launch as the
+    ``paged_prefill`` boundary, keyed by the padded prompt bucket (the
+    compile identity)."""
     row_view = cache._replace(
         page_table=jnp.asarray(row_table, jnp.int32)[None, :],
         lengths=jnp.zeros((1,), jnp.int32),
     )
-    logits1, row = _prefill_paged_donated(cfg, params, tokens, lengths, row_view)
+    if ledger is not None:
+        logits1, row = ledger.launch(
+            "paged_prefill", _prefill_paged_donated,
+            cfg, params, tokens, lengths, row_view,
+            key=f"p{tokens.shape[1]}", tokens=int(tokens.shape[1]),
+        )
+    else:
+        logits1, row = _prefill_paged_donated(
+            cfg, params, tokens, lengths, row_view)
     return logits1, _splice_row_entries(cache, row, idx)
 
 
@@ -547,6 +573,21 @@ class ContinuousEngine:
         self._compile_hook = install_compile_hook(
             registry=self.obs.registry, span_log=span_log
         )
+        # The compute observatory (obs/compute.py): every jitted boundary
+        # this engine dispatches goes through the ledger — once-per-compile
+        # cost_analysis capture plus 1-in-N fenced launch timings feeding
+        # the launch metrics, the span log, the flight ring (read live via
+        # the tracker's attachment point), and the load digest's cost
+        # block. EDGEMESH_COMPUTE_SAMPLE=0 turns the whole seam off.
+        self.compute = ComputeLedger(
+            registry=self.obs.registry, engine=self.obs_engine_label,
+            span_log=span_log, flight_source=lambda: self.obs.flight,
+        )
+        # Compile-identity key strings for the statically-shaped
+        # boundaries (one compile per engine lifetime each).
+        self._ck_decode = f"b{self.n_slots}c{self.chunk}"
+        if tp_engine is not None:
+            tp_engine.instrument(self.compute)
         self._pages_gauge = self.obs.registry.gauge(
             "edgemesh_kv_pages", "Paged KV pool occupancy by state",
             ("engine", "state"),
@@ -776,6 +817,9 @@ class ContinuousEngine:
                     out["ragged_boundaries"] = self.ragged_boundaries
                     out["ragged_prefill_tokens"] = self.ragged_prefill_tokens
                     out["ragged_decode_tokens"] = self.ragged_decode_tokens
+            # Live per-boundary ledger rollup (obs/compute.py); None when
+            # the ledger is disabled or nothing launched yet.
+            out["compute"] = self.compute.rollup() or None
             return out
 
     def load_digest(self) -> dict[str, Any]:
@@ -806,9 +850,15 @@ class ContinuousEngine:
             ewma_decode_s=digest.get("ewma_decode_s"),
             ewma_service_s=digest.get("ewma_service_s"),
             ewma_decode_tokens=digest.get("ewma_decode_tokens"),
+            measured_tok_s=self.compute.measured_tok_s(
+                boundaries=("decode_loop", "spec_rounds")),
         )
         digest["capacity"] = cap
         digest["pool"] = pool
+        # Per-boundary measured launch costs (obs/compute.py): None until
+        # the ledger has fenced something — a pre-compute consumer (or an
+        # old router) sees exactly the digest it always did.
+        digest["costs"] = self.compute.digest_costs()
         eng = self.obs_engine_label
         if cap["est_tok_s"] is not None:
             self._capacity_gauge.labels(engine=eng).set(cap["est_tok_s"])
@@ -965,8 +1015,10 @@ class ContinuousEngine:
                     )
                 else:
                     row_cache = init_kv_cache(self.cfg, 1, cap)
-                    logits1, row_cache = forward_prefill(
-                        self.cfg, agent.params, tokens, lengths, row_cache
+                    logits1, row_cache = self.compute.launch(
+                        "dense_prefill", forward_prefill,
+                        self.cfg, agent.params, tokens, lengths, row_cache,
+                        key=f"p{tokens.shape[1]}", tokens=plen,
                     )
                 k, v, ln, self._logits, self._mask, self._finished = _splice_slot(
                     self._cache.k, self._cache.v, self._cache.lengths,
@@ -983,8 +1035,10 @@ class ContinuousEngine:
                 )
 
                 row_cache = init_quant_kv_cache(self.cfg, 1, cap)
-                logits1, row_cache = forward_prefill_quant(
-                    self.cfg, agent.params, tokens, lengths, row_cache
+                logits1, row_cache = self.compute.launch(
+                    "dense_prefill", forward_prefill_quant,
+                    self.cfg, agent.params, tokens, lengths, row_cache,
+                    key=f"p{tokens.shape[1]}", tokens=plen,
                 )
                 (k, v, ks, vs, ln, self._logits, self._mask,
                  self._finished) = _splice_slot_quant(
@@ -1033,10 +1087,12 @@ class ContinuousEngine:
                         lengths=jnp.zeros((1,), jnp.int32),
                     )
                     suffix = tokens[:, match:]
-                    logits1, row = _prefill_paged_at_donated(
+                    logits1, row = self.compute.launch(
+                        "paged_splice", _prefill_paged_at_donated,
                         self.cfg, agent.params, suffix,
                         jnp.asarray([plen - match], jnp.int32), row_view,
                         jnp.asarray([match], jnp.int32),
+                        key=f"p{suffix.shape[1]}", tokens=plen - match,
                     )
                     with self._cond:  # stats() reads this under the lock
                         self.shared_prefix_hits += 1
@@ -1046,7 +1102,7 @@ class ContinuousEngine:
                     row_table = self._build_row_table([], pages)
                     logits1, cache = _prefill_into_row(
                         self.cfg, agent.params, tokens, lengths, self._cache,
-                        idx, row_table,
+                        idx, row_table, ledger=self.compute,
                     )
             except Exception:
                 # The donated pool buffers may already be invalidated — a
@@ -1257,17 +1313,19 @@ class ContinuousEngine:
                         page_table=jnp.asarray(row_table)[None, :],
                         lengths=jnp.zeros((1,), jnp.int32),
                     )
-                    logits1, row = _prefill_paged_at_donated(
+                    logits1, row = self.compute.launch(
+                        "paged_splice", _prefill_paged_at_donated,
                         self.cfg, agent.params, jnp.asarray(suffix),
                         jnp.asarray([suffix_len], jnp.int32), row_view,
                         jnp.asarray([match], jnp.int32),
+                        key=f"p{pad}", tokens=suffix_len,
                     )
                     self._cache = _splice_row_entries(self._cache, row, idx)
                 else:
                     logits1, self._cache = _prefill_into_row(
                         self.cfg, agent.params, jnp.asarray(suffix),
                         jnp.asarray([plen], jnp.int32), self._cache, idx,
-                        row_table,
+                        row_table, ledger=self.compute,
                     )
             except Exception:
                 self._reset_pool(
@@ -1431,9 +1489,11 @@ class ContinuousEngine:
             jnp.asarray(dec_mask), self._prev[jnp.asarray(dec_slot)],
             jnp.asarray(base),
         )
-        self._logits, self._cache = _ragged_boundary(
+        self._logits, self._cache = self.compute.launch(
+            "ragged_boundary", _ragged_boundary,
             self.cfg, self.agent.params, tokens, jnp.asarray(cu_host),
             self._finished, self._cache, s_cap,
+            key=f"c{cap}s{s_cap}", tokens=int(cu_host[-1]),
         )
         n_prefill = sum(len(r.ids) for r in staged.values())
         n_decode = sum(
@@ -1670,14 +1730,18 @@ class ContinuousEngine:
                 self._ragged_tokens_counter.labels(
                     engine=self.obs_engine_label, phase="decode"
                 ).inc(len(active))
-                self._logits, self._cache = self._bridge(
+                self._logits, self._cache = self.compute.launch(
+                    "bridge", self._bridge,
                     self.cfg, agent.params, self._prev, self._cache,
                     self._finished,
+                    key=self._ck_decode, tokens=len(active),
                 )
-        out, counts, cache, _, mask, prev, fin = _decode_loop(
+        out, counts, cache, _, mask, prev, fin = self.compute.launch(
+            "decode_loop", _decode_loop,
             self.cfg, self._params, agent.sampling, self.chunk, eos_id,
             self._logits, self._cache, self._mask, seg_rng,
             self._decode_fn, self._finished,
+            key=self._ck_decode, tokens=self.chunk * max(len(active), 1),
         )
         self._mask, self._finished = mask, fin
         with self._cond:  # stats() reads this under the lock
@@ -1699,8 +1763,10 @@ class ContinuousEngine:
             # bridge) and a masked garbage write. The alternative — waiting
             # to know whether anyone survives — is exactly the sync this
             # pipeline removes.
-            self._logits, self._cache = self._bridge(
-                self.cfg, self._params, prev, cache, fin
+            self._logits, self._cache = self.compute.launch(
+                "bridge", self._bridge,
+                self.cfg, self._params, prev, cache, fin,
+                key=self._ck_decode, tokens=len(active),
             )
         if self._paged:
             # +0 detaches the tripwire snapshot from the cache buffer — the
@@ -1715,7 +1781,9 @@ class ContinuousEngine:
     def _process_segment(self, seg: _Inflight, eos_id: int) -> None:
         """Drain one segment's results (its successor is already executing)
         and run the host-side emit/retire bookkeeping."""
-        fetched = jax.device_get(seg.handles)
+        # Already-complete handles: the successor segment is executing,
+        # so this readback gates nothing.
+        fetched = jax.device_get(seg.handles)  # edgelint: disable=EM114
         counts_h, out_h, fin_h = fetched[:3]
         if self._paged and int(fetched[3]) != 1:
             # Host-owned-allocator tripwire: the device popped pages. A bug,
@@ -1998,6 +2066,17 @@ class SpeculativeContinuousEngine(ContinuousEngine):
                 self._init_dpool, self.n_slots, self._d_total
             )
             self._dslot_pages: dict[int, list[int]] = {}
+            # The speculative round ledger (obs/compute.py): segment-level
+            # counter deltas + the compute ledger's sampled launch timings,
+            # split draft-vs-verify by the analytic flops ratio of gamma
+            # draft steps against one gamma+1-token verify. This is the
+            # instrument that decomposes the spec-vs-plain loss
+            # (docs/PERFORMANCE.md) into its round structure.
+            self._round_ledger = SpecRoundLedger(
+                ledger=self.compute, engine=self.obs_engine_label,
+                draft_frac=spec_draft_frac(
+                    agent.params, agent.draft_params, int(agent.spec_gamma)),
+            )
             self._spec_reset_arrays()
             # No KV transfer: an imported target prefix has no draft-pool
             # twin, and a warm target + cold draft would desynchronize the
@@ -2022,6 +2101,9 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         # the device counters are donated every segment, so touching them
         # from another thread (REST /stats) races use-after-donate.
         self._spec_counters_host = (0, 0, 0)
+        # The round ledger diffs successive host-counter snapshots; a pool
+        # reset zeroes the device counters, so the baseline resets with it.
+        self._spec_counters_prev = (0, 0, 0)
         self._update_spec_gauges()
 
     def _update_spec_gauges(self) -> None:
@@ -2191,12 +2273,17 @@ class SpeculativeContinuousEngine(ContinuousEngine):
             mask=self._mask, rng=seg_rng, conf_sum=self._conf,
             accepted=self._acc, proposed=self._prop, rounds=self._rnds,
         )
-        state = _spec_rounds_donated(
+        state = self.compute.launch(
+            "spec_rounds", _spec_rounds_donated,
             self.cfg, agent.draft_cfg, agent.params, agent.draft_params,
             agent.sampling, self.gamma, self.max_new, eos_id,
             self.cfg.vocab_size, self.cap, state,
             jnp.asarray(self.rounds_per_segment, jnp.int32),
             self._verify_fn, self._spec_decode_fn,
+            key=self._ck_decode,
+            # Guaranteed token floor: every round emits >= 1 token per
+            # active row (the verify bonus); accepted drafts only add.
+            tokens=self.rounds_per_segment * max(len(active), 1),
         )
         (self._pending, self._cache, self._dcache, self._out, self._nemit,
          self._finished, self._mask, _, self._conf, self._acc, self._prop,
@@ -2216,9 +2303,21 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         return _Inflight([(i, self._gen[i]) for i in active], handles)
 
     def _process_segment(self, seg: _Inflight, eos_id: int) -> None:
-        fetched = jax.device_get(seg.handles)
+        # Already-complete handles: the successor segment is executing,
+        # so this readback gates nothing.
+        fetched = jax.device_get(seg.handles)  # edgelint: disable=EM114
         nemit_h, out_h, fin_h, acc_h, prop_h, rnds_h, ft_t, ft_d = fetched
         self._spec_counters_host = (int(acc_h), int(prop_h), int(rnds_h))
+        # Round-structure attribution: this segment's counter deltas,
+        # paired with the compute ledger's sampled launch time when this
+        # segment's spec_rounds dispatch was the measured one (both run
+        # on the worker, so consume_measured pairs them race-free).
+        pa, pp, pr = self._spec_counters_prev
+        self._spec_counters_prev = self._spec_counters_host
+        self._round_ledger.on_segment(
+            int(rnds_h) - pr, int(acc_h) - pa, int(prop_h) - pp,
+            measured_s=self.compute.consume_measured("spec_rounds"),
+        )
         self._update_spec_gauges()
         if int(ft_t) != 1 or int(ft_d) != 1:
             # Same contract as the base engine: a popped page is also on a
@@ -2273,6 +2372,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         out["spec_accepted"] = acc
         out["spec_rounds"] = rnds
         out["draft_total_pages"] = self._d_total
+        out["spec_round_ledger"] = self._round_ledger.summary()
         return out
 
 

@@ -147,6 +147,53 @@ def enable_compilation_cache(cache_dir) -> bool:
     return True
 
 
+def aot_cost_analysis(fn, args) -> dict | None:
+    """Version-portable AOT cost capture: ``fn.lower(*args).compile()
+    .cost_analysis()`` normalized to ``{"flops", "bytes_accessed",
+    "output_bytes"}`` (floats, each None where XLA withholds it).
+
+    Every layer here has drifted: ``lower`` is absent on plain functions,
+    ``cost_analysis`` has returned a per-device list, a bare dict, and
+    None across versions, and its keys are free-text ("flops", "bytes
+    accessed", "bytes accessedout{}" / "bytes accessed output") that
+    backends populate inconsistently — TPU runtimes may withhold the
+    whole table. Callers (the compute observatory, obs/compute.py) treat
+    None as "cost model unavailable" and keep serving, so this NEVER
+    raises: any failure — tracing, compilation, analysis — degrades to
+    None. ``args`` should be the call's arguments with array leaves
+    replaced by ``jax.ShapeDtypeStruct`` (capture them BEFORE dispatch:
+    donated buffers are deleted by the launch itself)."""
+    try:
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            return None
+        ca = lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return None
+
+        def _num(value):
+            try:
+                v = float(value)
+            except (TypeError, ValueError):
+                return None
+            return v if v >= 0.0 else None
+
+        out_bytes = None
+        for key, value in ca.items():
+            if "bytes accessed" in key and "out" in key:
+                out_bytes = _num(value)
+                break
+        return {
+            "flops": _num(ca.get("flops")),
+            "bytes_accessed": _num(ca.get("bytes accessed")),
+            "output_bytes": out_bytes,
+        }
+    except Exception:
+        return None
+
+
 def pcast(x, axis_name, *, to: str = "varying"):
     """Version-portable ``lax.pcast``.
 

@@ -593,6 +593,81 @@ def test_em113_shipped_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# EM114: ungated device sync in the serving stack
+# ---------------------------------------------------------------------------
+
+
+_EM114_SRC = (
+    "import jax\n"
+    "def drain(handles, out):\n"
+    "    out.block_until_ready()\n"
+    "    return jax.device_get(handles)\n"
+)
+
+
+def test_em114_fires_on_ungated_sync_in_serving_stack():
+    for path in ("edgemesh/serve/batcher2.py", "edgemesh/runtime/gen2.py"):
+        findings = [f for f in lint_source(_EM114_SRC, path=path)
+                    if f.rule == "EM114"]
+        # Both the method-style fence and the jax.device_get readback flag.
+        assert len(findings) == 2, path
+        assert all(f.severity == "error" for f in findings)
+        assert "device_sync" in findings[0].message
+
+
+def test_em114_resolves_import_aliases():
+    aliased = (
+        "from jax import device_get as fetch\n"
+        "def drain(h):\n"
+        "    return fetch(h)\n"
+    )
+    assert [f.rule for f in lint_source(aliased,
+                                        path="edgemesh/serve/x.py")
+            if f.rule == "EM114"] == ["EM114"]
+
+
+def test_em114_quiet_outside_scope_and_for_device_sync():
+    # Outside serve//runtime/ the fence is somebody's benchmark harness.
+    assert [f for f in lint_source(_EM114_SRC, path="edgemesh/obs/probe.py")
+            if f.rule == "EM114"] == []
+    assert [f for f in lint_source(_EM114_SRC, path="tests/test_x.py")
+            if f.rule == "EM114"] == []
+    # The sanctioned fence: device_sync (tunnel-aware, sampled by the
+    # ledger) stays legal everywhere.
+    gated = (
+        "from edgemesh.utils.compat import device_sync\n"
+        "def measure(out):\n"
+        "    device_sync(out)\n"
+    )
+    assert [f for f in lint_source(gated, path="edgemesh/serve/x.py")
+            if f.rule == "EM114"] == []
+
+
+def test_em114_inline_disable_suppresses():
+    quiet = _EM114_SRC.replace(
+        "    return jax.device_get(handles)",
+        "    return jax.device_get(handles)  # edgelint: disable=EM114",
+    ).replace(
+        "    out.block_until_ready()",
+        "    out.block_until_ready()  # edgelint: disable=EM114",
+    )
+    assert [f for f in lint_source(quiet, path="edgemesh/serve/x.py")
+            if f.rule == "EM114"] == []
+
+
+def test_em114_shipped_tree_is_clean():
+    # Every host sync in serve//runtime/ is either the ledger's sampled
+    # device_sync fence or an annotated already-complete readback — the
+    # dispatch pipeline never stalls on an unannotated sync.
+    from pathlib import Path
+
+    from edgemesh.analysis.edgelint import lint_paths
+
+    pkg = Path(__file__).resolve().parent.parent / "edgemesh"
+    assert [f for f in lint_paths([pkg]) if f.rule == "EM114"] == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + baseline mechanics
 # ---------------------------------------------------------------------------
 

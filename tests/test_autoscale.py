@@ -249,13 +249,22 @@ def test_estimate_capacity_derivation_and_cold_nulls():
     cap = estimate_capacity(8, ewma_decode_s=0.01, ewma_service_s=1.0,
                             ewma_decode_tokens=16.0)
     # 8 slots / 10ms per token = 800 tok/s; / 16 tokens per request = 50 rps.
-    assert cap == {"slots": 8, "est_tok_s": 800.0, "est_req_s": 50.0}
+    assert cap == {"slots": 8, "est_tok_s": 800.0, "est_req_s": 50.0,
+                   "measured_tok_s": None}
+    # The compute ledger's fenced-launch tok/s REPLACES the host-EWMA
+    # derivation when present — and ships raw so consumers can tell
+    # which model produced the estimate.
+    cap = estimate_capacity(8, ewma_decode_s=0.01, ewma_decode_tokens=16.0,
+                            measured_tok_s=640.0)
+    assert cap["est_tok_s"] == 640.0 and cap["measured_tok_s"] == 640.0
+    assert cap["est_req_s"] == 40.0
     # No decode EWMA yet: req/s falls back to slots/service.
     cap = estimate_capacity(4, ewma_service_s=2.0)
     assert cap["est_tok_s"] is None and cap["est_req_s"] == 2.0
     # Cold: no claims.
     assert estimate_capacity(8) == {"slots": 8, "est_tok_s": None,
-                                    "est_req_s": None}
+                                    "est_req_s": None,
+                                    "measured_tok_s": None}
 
 
 def test_pool_state_occupancy_fragmentation_headroom():

@@ -587,3 +587,132 @@ def test_cold_start_and_autoscale_stages_are_skippable_via_env(monkeypatch):
                                         decode_steps=8, sweep_batches=())
     assert not any(k.startswith(("cold_start", "autoscale", "tuner_"))
                    for k in out)
+
+
+def test_compute_ledger_keys_ride_bench_json(monkeypatch, capsys):
+    """The compute-observatory schema contract: the serving stage carries
+    the per-boundary ledger rollup (`serving_compute`), the spec stage the
+    round-attribution block (`spec_round_ledger`, split labeled), and the
+    router_overhead stage the ledger-on/off arm (`ledger_overhead_ratio`
+    <= 1.02 — the gate PERFORMANCE.md pins). Faked stages: the schema must
+    survive a partial artifact, and the keys must vanish under the same
+    env skip-gates the stages already honor."""
+    _fake_stage1(monkeypatch)
+
+    compute_block = {
+        "decode_loop": {"launches": 40, "measured": 3, "compiles": 1,
+                        "device_s": 0.12, "ewma_launch_s": 0.04,
+                        "roofline_fraction": 0.41, "flops": 1e9,
+                        "bytes": 2e8, "shape_buckets": {"b8c32": 40}},
+    }
+    round_block = {"rounds": 12, "accepted": 30, "proposed": 48,
+                   "rejected": 18, "accept_rate": 0.625,
+                   "accepted_per_round": 2.5, "segments": 2,
+                   "measured_segments": 2, "measured_s": 0.5,
+                   "round_s": 0.0417, "draft_s": 0.15, "verify_s": 0.35,
+                   "draft_frac": 0.3, "split": "analytic-flops"}
+
+    def fake_serving(preset, *a, built=None, kv_backend="paged", ragged=None,
+                     **kw):
+        value = 900.0 if ragged is None else 700.0
+        return {"metric": "serving", "value": value, "wave_tok_s": [value],
+                "spread_pct": 1.0, "req_s": 2.0, "generated": 100,
+                "latency_s_p50": 0.5, "latency_s_p95": 0.9,
+                "stats": {"segments": 9, "max_concurrent": 8,
+                          "ragged_boundaries": 9,
+                          "ragged_prefill_tokens": 300,
+                          "ragged_decode_tokens": 60},
+                "obs": {}, "compute": compute_block}
+
+    def fake_ablation(preset, built=None, **kw):
+        out = {}
+        for shape in ("decode_heavy", "prefill_heavy", "mixed_50_50"):
+            out[f"serving_ragged_{shape}_tok_s"] = 900.0
+            out[f"serving_segmented_{shape}_tok_s"] = 700.0
+            out[f"ragged_over_segmented_{shape}"] = 1.286
+        return out
+
+    def fake_spec(preset, built=None, **kw):
+        return {"spec_tok_s": 80.0, "plain_tok_s": 60.0,
+                "spec_speedup": 1.33, "accept_rate": 0.4,
+                "selfcheck_accept_rate": 1.0, "gamma": 4, "draft_layers": 4,
+                "draft_mode": "truncate",
+                "kv_backend": kw.get("kv_backend", "dense"),
+                "spec_round_ledger": round_block,
+                "compute": compute_block}
+
+    def fake_overhead(**kw):
+        return {"metric": "router_overhead_p50_s", "value": 0.0021,
+                "unit": "s", "n_requests": 40,
+                "direct_p50_s": 0.010, "direct_p99_s": 0.015,
+                "routed_p50_s": 0.0121, "routed_p99_s": 0.018,
+                "overhead_p99_s": 0.003,
+                "traced_p50_s": 0.013, "traced_p99_s": 0.019,
+                "tracing_overhead_p50_s": 0.0009,
+                "tracing_overhead_p99_s": 0.001,
+                "recorder_p50_s": 0.01215, "recorder_p99_s": 0.0181,
+                "recorder_overhead_p50_s": 0.00005,
+                "recorder_overhead_p99_s": 0.0001,
+                "recorder_ring_records": 41,
+                "ledgeroff_p50_s": 0.0120,
+                "ledger_overhead_p50_s": 0.0001,
+                "ledger_overhead_ratio": 1.0083,
+                "compute": compute_block,
+                "sample_trace": None, "obs": {}}
+
+    def fake_adaptive(**kw):
+        return {"metric": "adaptive_over_least_outstanding_p99",
+                "value": 1.4, "unit": "x", "slo_target_s": 0.25}
+
+    monkeypatch.setattr(benchmarks, "serving_benchmark", fake_serving)
+    monkeypatch.setattr(benchmarks, "ragged_ablation_benchmark",
+                        fake_ablation)
+    monkeypatch.setattr(benchmarks, "speculative_benchmark", fake_spec)
+    monkeypatch.setattr(benchmarks, "router_overhead_benchmark",
+                        fake_overhead)
+    monkeypatch.setattr(benchmarks, "adaptive_router_benchmark",
+                        fake_adaptive)
+    monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_ADMIT", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
+
+    out = benchmarks.headline_benchmark(preset="llama1b", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    # Serving stage: per-boundary rollup rides the artifact.
+    assert out["serving_compute"] == compute_block
+    assert out["serving_compute"]["decode_loop"]["roofline_fraction"] == 0.41
+    # Spec stage: the round-attribution block, split explicitly labeled so
+    # the modeled draft/verify partition is never mistaken for a measured
+    # quantity.
+    assert out["spec_round_ledger"] == round_block
+    assert out["spec_round_ledger"]["split"] == "analytic-flops"
+    # Router-overhead stage: the ledger arm + the <=1.02 gate, checkable
+    # from the artifact alone.
+    assert out["ledgeroff_p50_s"] == 0.0120
+    assert out["ledger_overhead_ratio"] == 1.0083
+    assert out["ledger_overhead_ratio"] <= 1.02
+    lines = [json.loads(l)
+             for l in capsys.readouterr().out.strip().splitlines()]
+    assert "serving_compute" in lines[-1]
+    assert "spec_round_ledger" in lines[-1]
+    assert "ledger_overhead_ratio" in lines[-1]
+
+
+def test_compute_ledger_keys_honor_stage_skip_gates(monkeypatch):
+    """With the serving/spec/fleet stages env-gated off, none of the
+    compute-observatory keys appear — the same no-keys-no-error contract
+    every other skippable stage pins."""
+    _fake_stage1(monkeypatch)
+    for gate in _TP8_GATES:
+        monkeypatch.setenv(gate, "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert not any(
+        k in ("serving_compute", "spec_round_ledger", "ledgeroff_p50_s",
+              "ledger_overhead_p50_s", "ledger_overhead_ratio")
+        for k in out)

@@ -1,0 +1,442 @@
+"""The compute observatory: launch ledger, roofline attribution, the
+speculative round ledger, and the offline span-log twin.
+
+Pins the contracts the serving stack and the CLI depend on:
+
+- sampling rule: first-key launches are NEVER timed (they pay the
+  compile), the fence fires 1-in-N afterwards;
+- CPU cost capture: ``aot_cost_analysis`` yields flops/bytes for the
+  dense decode loop AND the paged decode boundary (the acceptance pin —
+  the roofline column is real, not always-None);
+- ``summarize_compute`` forward-compat in BOTH directions: unknown keys
+  ignored, missing keys read as None, pre-compute logs return None;
+- the CLI renders tables / ``--diff`` / ``--json`` and exits 0 on a
+  pre-compute log;
+- ``replay_spans`` reconstructs the launch counter from the cumulative
+  ``launches`` field so an offline scrape matches the live one despite
+  1-in-N sampling.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from edgemesh.obs import (
+    ComputeLedger,
+    Registry,
+    SpecRoundLedger,
+    diff_compute,
+    ledger_scope,
+    replay_spans,
+    spec_draft_frac,
+    summarize_compute,
+)
+from edgemesh.obs.compute import roofline_fraction
+from edgemesh.utils.tracing import JsonlLogger
+
+PEAKS = (1e12, 1e11)  # flops/s, bytes/s — a fixed synthetic device
+
+
+def _ledger(tmp_path=None, sample=1, **kw):
+    return ComputeLedger(
+        registry=Registry(), engine="t", sample=sample, peaks=PEAKS,
+        span_log=None if tmp_path is None else tmp_path / "spans.jsonl",
+        **kw)
+
+
+@jax.jit
+def _axpy(a, x, y):
+    return a * x + y
+
+
+# ---------------------------------------------------------------------------
+# Roofline math
+# ---------------------------------------------------------------------------
+
+
+def test_roofline_fraction_math():
+    # Memory-bound: intensity 1 flop/byte → attainable = 1e11 flops/s.
+    # Achieved 5e10 → fraction 0.5.
+    assert roofline_fraction(1e9, 1e9, 0.02, PEAKS) == pytest.approx(0.5)
+    # Compute-bound: intensity 100 → attainable = peak flops. Achieved
+    # 5e11 → 0.5 again, through the other roof.
+    assert roofline_fraction(1e10, 1e8, 0.02, PEAKS) == pytest.approx(0.5)
+    # Capped at 1.0 (timer jitter can overshoot the model).
+    assert roofline_fraction(1e12, 1e10, 0.5, PEAKS) == 1.0
+    # Any unknown input → no claim.
+    assert roofline_fraction(None, 1e9, 0.02, PEAKS) is None
+    assert roofline_fraction(1e9, None, 0.02, PEAKS) is None
+    assert roofline_fraction(1e9, 1e9, 0.02, None) is None
+
+
+# ---------------------------------------------------------------------------
+# Ledger mechanics: sampling, cost capture, digests
+# ---------------------------------------------------------------------------
+
+
+def test_first_key_launch_is_never_timed():
+    led = _ledger(sample=1)
+    x = jnp.ones((8,), jnp.float32)
+    led.launch("axpy", _axpy, 2.0, x, x, key="b8")
+    roll = led.rollup()["axpy"]
+    # The compile launch dispatched but was not fenced/timed...
+    assert roll["launches"] == 1 and roll["measured"] == 0
+    # ...while its cost table WAS captured (pre-dispatch spec snapshot).
+    assert roll["compiles"] == 1
+    led.launch("axpy", _axpy, 2.0, x, x, key="b8")
+    roll = led.rollup()["axpy"]
+    assert roll["launches"] == 2 and roll["measured"] == 1
+    assert roll["ewma_launch_s"] > 0
+    # A NEW shape bucket compiles again — and again is not timed.
+    y = jnp.ones((16,), jnp.float32)
+    led.launch("axpy", _axpy, 2.0, y, y, key="b16")
+    roll = led.rollup()["axpy"]
+    assert roll["compiles"] == 2 and roll["measured"] == 1
+    assert roll["shape_buckets"] == {"b8": 2, "b16": 1}
+
+
+def test_sampling_rate_gates_the_fence():
+    led = _ledger(sample=4)
+    x = jnp.ones((4,), jnp.float32)
+    for _ in range(13):
+        led.launch("axpy", _axpy, 2.0, x, x, key="b4")
+    roll = led.rollup()["axpy"]
+    # Launch 1 compiles (never timed), launch 2 seeds the EWMA (measured
+    # == 0 forces one early sample), then 1-in-4 fences at launches 6 and
+    # 10: 13 launches → 3 measurements.
+    assert roll["launches"] == 13
+    assert roll["measured"] == 3
+
+
+def test_disabled_ledger_is_pure_passthrough():
+    led = _ledger(sample=0)
+    assert led.enabled is False
+    x = jnp.ones((4,), jnp.float32)
+    out = led.launch("axpy", _axpy, 2.0, x, x, key="b4")
+    assert out.shape == (4,)
+    assert led.rollup() == {}
+    # wrap() returns the bare fn — zero per-call overhead when off.
+    assert led.wrap("axpy", _axpy) is _axpy
+    # Runtime toggle (the bench ledger-off arm): enabled=False on a live
+    # ledger short-circuits the launch path.
+    led2 = _ledger(sample=1)
+    led2.enabled = False
+    led2.launch("axpy", _axpy, 2.0, x, x, key="b4")
+    assert led2.rollup() == {}
+
+
+def test_cost_capture_dense_and_paged_decode_on_cpu():
+    """Acceptance pin: cost_analysis-backed flops/bytes present for the
+    dense decode loop and the paged decode boundary on CPU."""
+    import numpy as np
+
+    from edgemesh.config import SamplingParams
+    from edgemesh.models.families import tiny_config
+    from edgemesh.models.transformer import init_params
+    from edgemesh.runtime.generate import generate
+    from edgemesh.runtime.paged_generate import LEDGER_BOUNDARIES
+    from edgemesh.runtime.paged_kv import init_paged_cache
+    from edgemesh.utils.compat import aot_cost_analysis
+
+    cfg = tiny_config(
+        "llama", num_heads=2, num_kv_heads=2, hidden_size=16,
+        intermediate_size=32, num_layers=1, vocab_size=32, max_seq_len=32,
+    ).replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    # Dense: the ambient ledger instruments generate()'s jitted
+    # boundaries; the decode loop must carry a cost row.
+    led = _ledger(sample=1)
+    prompts = jnp.array([[5, 9, 11, 0]], jnp.int32)
+    lengths = jnp.array([3], jnp.int32)
+    # Twice: the first pass per key compiles (never timed), the second
+    # hits the cache and gets fenced — the roofline column needs both a
+    # cost row and a measurement.
+    with ledger_scope(led):
+        for _ in range(2):
+            generate(cfg, params, prompts, lengths,
+                     SamplingParams(max_new_tokens=3, temperature=0.0),
+                     rng=jax.random.PRNGKey(1))
+    roll = led.rollup()
+    for boundary in ("prefill", "decode_loop"):
+        assert roll[boundary]["flops"] and roll[boundary]["flops"] > 0
+        assert roll[boundary]["bytes"] and roll[boundary]["bytes"] > 0
+    # Measured + cost + synthetic peaks → the roofline column is live.
+    assert 0 < roll["decode_loop"]["roofline_fraction"] <= 1.0
+
+    # Paged: the boundary catalog's decode entry, costed directly via the
+    # compat shim (same path the ledger's first-key capture takes).
+    cache = init_paged_cache(cfg, 1, total_pages=5, page_size=4, max_pages=4)
+    cost = aot_cost_analysis(
+        LEDGER_BOUNDARIES["paged_decode"],
+        (cfg, params, jnp.array([7], jnp.int32), cache))
+    assert cost["flops"] and cost["flops"] > 0
+    assert cost["bytes_accessed"] and cost["bytes_accessed"] > 0
+    # An un-lowerable fn degrades to None, never raises.
+    assert aot_cost_analysis(lambda x: x, (np.zeros(2),)) is None
+
+
+def test_consume_measured_pops_once():
+    led = _ledger(sample=1)
+    x = jnp.ones((4,), jnp.float32)
+    assert led.consume_measured("axpy") is None
+    led.launch("axpy", _axpy, 2.0, x, x, key="b4")  # compile, untimed
+    assert led.consume_measured("axpy") is None
+    led.launch("axpy", _axpy, 2.0, x, x, key="b4")
+    dt = led.consume_measured("axpy")
+    assert dt is not None and dt > 0
+    assert led.consume_measured("axpy") is None  # popped
+
+
+def test_digest_costs_and_measured_tok_s():
+    led = _ledger(sample=1)
+    x = jnp.ones((4,), jnp.float32)
+    assert led.digest_costs() is None  # nothing measured yet
+    led.launch("decode_loop", _axpy, 2.0, x, x, key="b4", tokens=32)
+    assert led.digest_costs() is None  # compile launch: still unmeasured
+    led.launch("decode_loop", _axpy, 2.0, x, x, key="b4", tokens=32)
+    digest = led.digest_costs()
+    assert digest["decode_loop"]["ewma_launch_s"] > 0
+    assert digest["decode_loop"]["tok_s"] > 0
+    assert digest["decode_loop"]["launches"] == 2
+    assert led.measured_tok_s() == digest["decode_loop"]["tok_s"]
+    # Scoping: a prefill boundary's (much higher) tok/s must not leak
+    # into the decode capacity claim.
+    big = jnp.ones((256,), jnp.float32)
+    led.launch("prefill", _axpy, 2.0, big, big, key="b256", tokens=4096)
+    led.launch("prefill", _axpy, 2.0, big, big, key="b256", tokens=4096)
+    assert led.measured_tok_s() == digest["decode_loop"]["tok_s"]
+
+
+# ---------------------------------------------------------------------------
+# Speculative round ledger
+# ---------------------------------------------------------------------------
+
+
+def test_spec_round_ledger_accounting_and_split():
+    rl = SpecRoundLedger(engine="t", draft_frac=0.25)
+    assert rl.summary() is None  # no rounds yet
+    rl.on_segment(-1, 2, 3)  # pool reset mid-flight: skipped whole
+    assert rl.summary() is None
+    rl.on_segment(4, 10, 16, measured_s=0.8)
+    rl.on_segment(2, 4, 8)  # unmeasured segment still counts rounds
+    s = rl.summary()
+    assert s["rounds"] == 6 and s["accepted"] == 14 and s["proposed"] == 24
+    assert s["rejected"] == 10
+    assert s["accept_rate"] == pytest.approx(14 / 24, abs=1e-4)
+    assert s["segments"] == 2 and s["measured_segments"] == 1
+    assert s["round_s"] == pytest.approx(0.2)
+    # The analytic split is labeled, and partitions measured_s exactly.
+    assert s["split"] == "analytic-flops"
+    assert s["draft_s"] == pytest.approx(0.2)
+    assert s["verify_s"] == pytest.approx(0.6)
+    assert s["draft_s"] + s["verify_s"] == pytest.approx(s["measured_s"])
+
+
+def test_spec_round_ledger_writes_span_records(tmp_path):
+    led = _ledger(tmp_path, sample=1)
+    rl = SpecRoundLedger(ledger=led, engine="t", draft_frac=0.5)
+    rl.on_segment(2, 3, 4, measured_s=0.1)
+    rl.on_segment(1, 1, 2)  # unmeasured: counted, not logged
+    recs = [r for r in JsonlLogger(tmp_path / "spans.jsonl").read()
+            if r.get("event") == "spec_rounds"]
+    assert len(recs) == 1
+    assert recs[0]["rounds"] == 2 and recs[0]["split"] == "analytic-flops"
+    assert recs[0]["draft_s"] == pytest.approx(0.05)
+
+
+def test_spec_draft_frac_prices_live_trees():
+    pt = {"w": jnp.ones((100,)), "b": jnp.ones((10,))}
+    pd = {"w": jnp.ones((40,))}
+    # gamma=2: draft = 2*2*40 = 160, verify = 3*2*110 = 660.
+    assert spec_draft_frac(pt, pd, 2) == pytest.approx(160 / 820, abs=1e-4)
+    assert spec_draft_frac({}, {}, 2) is None
+
+
+# ---------------------------------------------------------------------------
+# Offline twin: summarize_compute / diff_compute
+# ---------------------------------------------------------------------------
+
+
+def _launch_rec(**kw):
+    base = {"event": "launch", "engine": "e1", "boundary": "decode_loop",
+            "key": "b8", "measured_s": 0.01, "flops": 1e9, "bytes": 1e8,
+            "output_bytes": 1e6, "achieved_flops_s": 1e11,
+            "roofline_fraction": 0.4, "tokens": 32, "launches": 16}
+    base.update(kw)
+    return base
+
+
+def test_summarize_compute_aggregates_per_boundary():
+    recs = [
+        _launch_rec(measured_s=0.01, launches=16),
+        _launch_rec(measured_s=0.03, launches=32, roofline_fraction=0.6),
+        _launch_rec(boundary="prefill", key="b8p64", measured_s=0.06,
+                    launches=4, tokens=512),
+        {"event": "spec_rounds", "engine": "e1", "rounds": 4, "accepted": 10,
+         "proposed": 16, "measured_s": 0.8, "draft_s": 0.2, "verify_s": 0.6,
+         "draft_frac": 0.25, "split": "analytic-flops"},
+    ]
+    s = summarize_compute(recs)
+    assert s["launch_records"] == 3
+    assert s["total_device_s"] == pytest.approx(0.1)
+    dl = s["boundaries"]["decode_loop"]
+    # ``launches`` is cumulative at record time: newest wins (32), NOT
+    # the record count — that keeps 1-in-N-sampled logs honest.
+    assert dl["launches"] == 32 and dl["measured"] == 2
+    assert dl["mean_s"] == pytest.approx(0.02)
+    assert dl["share"] == pytest.approx(0.4)
+    assert dl["roofline_fraction"] == pytest.approx(0.5)
+    assert dl["top_keys"] == {"b8": 2}
+    assert s["boundaries"]["prefill"]["share"] == pytest.approx(0.6)
+    sp = s["spec_rounds"]
+    assert sp["rounds"] == 4 and sp["accept_rate"] == 0.625
+    assert sp["draft_s"] == pytest.approx(0.2)
+    assert sp["split"] == "analytic-flops"
+
+
+def test_summarize_compute_forward_compat_both_directions():
+    # A NEWER build's record: unknown keys ignored, the record counts.
+    newer = _launch_rec(dma_stall_s=0.001, hbm_residency=0.9)
+    # An OLDER build's record: cost fields absent read as None.
+    older = {"event": "launch", "engine": "e0", "boundary": "bridge",
+             "measured_s": 0.005}
+    s = summarize_compute([newer, older])
+    assert s["launch_records"] == 2
+    assert s["boundaries"]["decode_loop"]["flops"] == 1e9
+    br = s["boundaries"]["bridge"]
+    assert br["flops"] is None and br["roofline_fraction"] is None
+    assert br["launches"] is None  # pre-cumulative-counter log
+    assert br["device_s"] == pytest.approx(0.005)
+
+
+def test_summarize_compute_pre_compute_log_is_none():
+    spans_only = [
+        {"event": "request_spans", "rid": "r1", "spans": []},
+        {"event": "checkpoint_saved", "step": 3},
+        "torn line",
+    ]
+    assert summarize_compute(spans_only) is None
+    assert summarize_compute([]) is None
+
+
+def test_diff_compute_rows_and_one_sided_boundaries():
+    a = summarize_compute([_launch_rec(measured_s=0.02)])
+    b = summarize_compute([
+        _launch_rec(measured_s=0.01),
+        _launch_rec(boundary="paged_splice", key="s16", measured_s=0.004),
+    ])
+    d = diff_compute(a, b)
+    dl = d["boundaries"]["decode_loop"]
+    assert dl["ratio"] == pytest.approx(0.5)
+    assert dl["a_share"] == 1.0
+    # A boundary present only on one side still gets a row — appearing
+    # or vanishing between two runs IS the finding.
+    ps = d["boundaries"]["paged_splice"]
+    assert ps["a_mean_s"] is None and ps["b_mean_s"] == pytest.approx(0.004)
+    assert ps["ratio"] is None
+    assert d["a_total_device_s"] == pytest.approx(0.02)
+
+
+# ---------------------------------------------------------------------------
+# CLI: edgemesh obs compute / summary integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def compute_log(tmp_path):
+    lg = JsonlLogger(tmp_path / "spans.jsonl")
+    lg.log("launch", **{k: v for k, v in _launch_rec().items()
+                        if k != "event"})
+    lg.log("launch", **{k: v for k, v in
+                        _launch_rec(measured_s=0.03, launches=32).items()
+                        if k != "event"})
+    lg.log("spec_rounds", engine="e1", rounds=4, accepted=10, proposed=16,
+           measured_s=0.8, draft_s=0.2, verify_s=0.6, draft_frac=0.25,
+           split="analytic-flops")
+    return lg.path
+
+
+def test_obs_compute_cli_table_and_json(compute_log, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    assert obs_main(["compute", str(compute_log)]) == 0
+    out = capsys.readouterr().out
+    assert "decode_loop" in out and "BOUNDARY" in out
+    assert "spec rounds" in out and "analytic-flops" in out
+
+    assert obs_main(["compute", str(compute_log), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["boundaries"]["decode_loop"]["measured"] == 2
+    assert report["spec_rounds"]["accept_rate"] == 0.625
+
+
+def test_obs_compute_cli_diff(compute_log, tmp_path, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    other = JsonlLogger(tmp_path / "b.jsonl")
+    other.log("launch", **{k: v for k, v in
+                           _launch_rec(measured_s=0.02).items()
+                           if k != "event"})
+    assert obs_main(["compute", str(compute_log),
+                     "--diff", str(other.path)]) == 0
+    out = capsys.readouterr().out
+    assert "decode_loop" in out and "B/A" in out
+    # Missing diff file is a usage error, same as a missing log.
+    assert obs_main(["compute", str(compute_log),
+                     "--diff", str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_obs_compute_cli_pre_compute_log_rc0(tmp_path, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    lg = JsonlLogger(tmp_path / "old.jsonl")
+    lg.log("request_spans", rid="r1", spans=[])
+    assert obs_main(["compute", str(lg.path)]) == 0
+    assert "no launch records" in capsys.readouterr().out
+    # And the summary's compute block reads null — never a crash.
+    assert obs_main(["summary", str(lg.path)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["compute"] is None
+
+
+def test_obs_summary_carries_compute_block(compute_log, capsys):
+    from edgemesh.obs.cli import main as obs_main
+
+    assert obs_main(["summary", str(compute_log)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["compute"]["launch_records"] == 2
+    assert report["compute"]["spec_rounds"]["rounds"] == 4
+
+
+# ---------------------------------------------------------------------------
+# replay_spans: offline scrape == live scrape
+# ---------------------------------------------------------------------------
+
+
+def test_replay_reconstructs_launch_counter_from_cumulative(compute_log):
+    registry = Registry()
+    replay_spans(JsonlLogger(compute_log).read(), registry)
+    prom = registry.render()
+    # Two sampled records, but the cumulative counter says 32 dispatches:
+    # the replayed counter must match what a live scrape showed.
+    assert ('edgemesh_launches_total{engine="e1",boundary="decode_loop"}'
+            ' 32') in prom
+    assert ('edgemesh_launch_seconds_count'
+            '{engine="e1",boundary="decode_loop"} 2') in prom
+    assert ('edgemesh_launch_roofline_ratio'
+            '{engine="e1",boundary="decode_loop"} 0.4') in prom
+
+
+def test_replay_tolerates_cumulative_less_records(tmp_path):
+    # Pre-cumulative logs (no ``launches`` field) fall back to one inc
+    # per record; the families still register idempotently.
+    lg = JsonlLogger(tmp_path / "spans.jsonl")
+    for _ in range(3):
+        lg.log("launch", engine="e1", boundary="bridge", measured_s=0.001)
+    registry = Registry()
+    replay_spans(lg.read(), registry)
+    assert ('edgemesh_launches_total{engine="e1",boundary="bridge"} 3'
+            in registry.render())

@@ -1,0 +1,83 @@
+"""Compute-observatory e2e: a real speculative serving run writes launch
+and spec-round records that ``edgemesh obs compute`` attributes.
+
+The acceptance pin for the observatory: over a slow-tier engine run's
+span log, the CLI names the speculative verify round as a DISTINCT
+boundary (``spec_rounds``, not folded into ``decode_loop``) and reports
+round-level attribution — rounds, acceptance, per-round seconds, and the
+labeled analytic draft/verify split.
+"""
+
+import json
+
+import pytest
+
+from edgemesh.agents.orchestrator import build_agent
+from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
+
+# Fast/slow tiers (pyproject markers): this whole file is multi-minute
+# territory - deselect with `pytest -m "not slow"`.
+pytestmark = pytest.mark.slow
+
+
+def _spec_agent(max_new=8, gamma=2):
+    return build_agent(AgentSpec(
+        role="qa",
+        model=ModelSpec(family="llama", vocab_size=260, num_layers=2,
+                        hidden_size=64, num_heads=4, num_kv_heads=2,
+                        intermediate_size=128, max_seq_len=128),
+        draft=ModelSpec(family="llama", vocab_size=260, num_layers=1,
+                        hidden_size=64, num_heads=4, num_kv_heads=2,
+                        intermediate_size=128, max_seq_len=128),
+        spec_gamma=gamma,
+        sampling=SamplingParams(max_new_tokens=max_new, do_sample=False,
+                                repetition_penalty=1.0),
+    ))
+
+
+def test_obs_compute_names_spec_round_boundary_e2e(tmp_path, monkeypatch,
+                                                   capsys):
+    from edgemesh.serve.continuous import SpeculativeContinuousEngine
+
+    # Fence every post-compile launch: the run is short, and the e2e pin
+    # needs measured records, not a sampling lottery.
+    monkeypatch.setenv("EDGEMESH_COMPUTE_SAMPLE", "1")
+    span_log = tmp_path / "spans.jsonl"
+    eng = SpeculativeContinuousEngine(
+        _spec_agent(), slots=4, chunk=6, kv_backend="paged", page_size=16,
+        span_log=span_log)
+    try:
+        qs = [f"question number {i}: where is the eiffel tower?"
+              for i in range(4)]
+        results = [f.result() for f in [eng.submit(q) for q in qs]]
+        assert all(r["generated"] > 0 for r in results)
+        live = eng.compute.rollup()
+    finally:
+        eng.close()
+
+    # The engine's own rollup names the round boundary distinctly.
+    assert "spec_rounds" in live
+    assert live["spec_rounds"]["launches"] > 0
+
+    # The CLI over the span log agrees — and attributes rounds.
+    from edgemesh.obs.cli import main as obs_main
+
+    assert obs_main(["compute", str(span_log), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert "spec_rounds" in report["boundaries"]
+    b = report["boundaries"]["spec_rounds"]
+    assert b["measured"] > 0 and b["device_s"] > 0
+
+    rounds = report["spec_rounds"]
+    assert rounds is not None
+    assert rounds["rounds"] > 0 and rounds["proposed"] > 0
+    assert 0 <= rounds["accept_rate"] <= 1
+    assert rounds["round_s"] > 0
+    # The draft/verify partition is present and labeled as modeled.
+    assert rounds["split"] == "analytic-flops"
+    assert rounds["draft_s"] > 0 and rounds["verify_s"] > 0
+
+    # Human rendering names the boundary and the split too.
+    assert obs_main(["compute", str(span_log)]) == 0
+    out = capsys.readouterr().out
+    assert "spec_rounds" in out and "analytic-flops" in out
