@@ -168,6 +168,11 @@ RULES: dict[str, dict] = {
         "severity": "error",
         "summary": "block_until_ready/device_get in serve//runtime/ outside the ledger's sampled seam",
     },
+    "EM115": {
+        "name": "pool-mutation-outside-ledger",
+        "severity": "error",
+        "summary": "page-pool free list mutated in serve//runtime/ outside the PoolLedger seam",
+    },
 }
 
 # ---------------------------------------------------------------------------
@@ -258,14 +263,16 @@ _EM113_ALLOWED_SUFFIXES = (
     "edgemesh/obs/spans.py",       # SpanTracker
     "edgemesh/obs/flight.py",      # FlightRecorder
     "edgemesh/obs/compute.py",     # ComputeLedger / SpecRoundLedger
+    "edgemesh/obs/memory.py",      # PoolLedger
 )
 _EM113_EVENTS = {"request_spans", "router_spans", "pool_reset", "compile",
-                 "flight_snapshot", "flight_dump", "launch", "spec_rounds"}
+                 "flight_snapshot", "flight_dump", "launch", "spec_rounds",
+                 "pool_mem"}
 _EM113_EVENT_CONSTS = {"SPAN_RECORD_EVENT", "ROUTER_RECORD_EVENT",
                        "RESET_RECORD_EVENT", "COMPILE_RECORD_EVENT",
                        "ENGINE_RECORD_EVENT", "SNAPSHOT_EVENT",
                        "DUMP_EVENT", "LAUNCH_RECORD_EVENT",
-                       "SPEC_ROUND_RECORD_EVENT"}
+                       "SPEC_ROUND_RECORD_EVENT", "POOL_RECORD_EVENT"}
 
 # EM114 scope + surface: synchronous device readbacks in the serving
 # stack. An ungated ``.block_until_ready()`` / ``jax.device_get`` stalls
@@ -279,6 +286,23 @@ _EM113_EVENT_CONSTS = {"SPAN_RECORD_EVENT", "ROUTER_RECORD_EVENT",
 _EM114_DIRS = ("edgemesh/serve/", "edgemesh/runtime/")
 _EM114_METHOD = "block_until_ready"
 _EM114_FUNCS = {"jax.device_get", "jax.block_until_ready"}
+
+# EM115 scope + surface: host-side page-pool mutations in the serving
+# stack. The memory observatory's conservation invariant (obs/memory.py:
+# ``free + resident + overhead == total`` at every quiesce) only holds if
+# EVERY pool transition reports to the PoolLedger — a free list popped or
+# extended behind its back is the exact leak-shaped bug the ledger exists
+# to catch, planted in the accounting itself. A function is on the seam
+# when it references the ledger (``.mem`` / ``.dmem`` / ``PoolLedger``)
+# or routes through the engine's ``_pop_pages`` / ``_push_pages``
+# helpers; mutations anywhere else are flagged.
+_EM115_DIRS = ("edgemesh/serve/", "edgemesh/runtime/")
+_EM115_POOLS = ("_free_pages", "_dfree", "_template_pages")
+_EM115_MUTATORS = {"pop", "popleft", "append", "extend", "clear",
+                   "remove", "insert"}
+_EM115_SEAM_ATTRS = ("mem", "dmem")
+_EM115_SEAM_CALLS = ("_pop_pages", "_push_pages")
+_EM115_SEAM_NAME = "PoolLedger"
 
 
 # ---------------------------------------------------------------------------
@@ -526,6 +550,7 @@ class _FileLinter:
         self._rule_unbounded_label(tree)
         self._rule_span_schema_bypass(tree)
         self._rule_ungated_sync(tree)
+        self._rule_pool_mutation(tree)
         # Traced ROOTS only: their walkers descend into traced nested defs,
         # so running every traced def would double-report nested call sites.
         traced_roots = [
@@ -651,6 +676,78 @@ class _FileLinter:
                 "point (suppress: fetching ALREADY-complete segment "
                 "handles is legitimate)",
             )
+
+    # -- EM115 -------------------------------------------------------------
+
+    @staticmethod
+    def _em115_terminal(node: ast.AST) -> str | None:
+        """The rightmost name of an Attribute/Name chain (``self._free_pages``
+        → ``_free_pages``), or None for anything else."""
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    def _em115_on_seam(self, fn: ast.AST) -> bool:
+        for node in _walk_own(fn):
+            if isinstance(node, ast.Attribute):
+                if node.attr in _EM115_SEAM_ATTRS:
+                    return True
+                if node.attr in _EM115_SEAM_CALLS:
+                    return True
+            elif isinstance(node, ast.Name) and node.id == _EM115_SEAM_NAME:
+                return True
+        return False
+
+    def _rule_pool_mutation(self, tree: ast.Module) -> None:
+        if not any(d in self.relpath for d in _EM115_DIRS):
+            return
+        for fn in self._all_defs:
+            if self._em115_on_seam(fn):
+                continue
+            for node in _walk_own(fn):
+                hit = self._em115_mutation(node)
+                if hit is None:
+                    continue
+                pool, how = hit
+                self._emit(
+                    "EM115", node,
+                    f"direct {how} of pool {pool!r} outside the PoolLedger "
+                    "seam — every page-pool transition must route through "
+                    "the engine's _pop_pages/_push_pages (or report to the "
+                    "ledger via engine.mem/.dmem), or the memory "
+                    "observatory's conservation invariant silently breaks "
+                    "(docs/OBSERVABILITY.md 'The memory observatory'; "
+                    "suppress: pool construction before the ledger exists "
+                    "is legitimate)",
+                )
+
+    def _em115_mutation(self, node: ast.AST) -> tuple[str, str] | None:
+        """(pool_name, description) when ``node`` mutates a guarded pool:
+        a mutator method call, or a (aug/ann/tuple) assignment targeting
+        the pool or one of its elements."""
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _EM115_MUTATORS:
+                name = self._em115_terminal(node.func.value)
+                if name in _EM115_POOLS:
+                    return name, f".{node.func.attr}() call"
+            return None
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(t.elts)
+                continue
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            name = self._em115_terminal(t)
+            if name in _EM115_POOLS:
+                return name, "assignment"
+        return None
 
     # -- EM110 -------------------------------------------------------------
 

@@ -199,13 +199,19 @@ WIRE_SCHEMAS: dict[str, dict] = {
             # per-boundary cost block (digest["costs"]) — measured launch
             # EWMAs from the compute ledger (obs/compute.py)
             ("edgemesh/obs/compute.py", "digest_costs"),
+            # pool-memory block (digest["mem"]) — occupancy, fragmentation,
+            # leak counters, and the exhaustion forecast from the pool
+            # ledger (obs/memory.py)
+            ("edgemesh/obs/memory.py", "digest_mem"),
         ),
         "consumers": (
             ("edgemesh/fleet/balancer.py", "_cost", ("load",)),
             ("edgemesh/fleet/balancer.py", "_cost_service_s", ("load",)),
+            ("edgemesh/fleet/balancer.py", "_mem_penalty", ("load",)),
             ("edgemesh/fleet/balancer.py", "_prefill_share", ("load",)),
             ("edgemesh/fleet/autoscale.py", "_demand_supply", ("load",)),
             ("edgemesh/fleet/autoscale.py", "evaluate", ("load",)),
+            ("edgemesh/fleet/admission.py", "note_mem_forecast", ("load",)),
             ("edgemesh/fleet/health.py", "probe_once", ("load",)),
         ),
     },

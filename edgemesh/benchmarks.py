@@ -301,6 +301,11 @@ def serving_benchmark(
             # launches. None when the ledger is disabled
             # (EDGEMESH_COMPUTE_SAMPLE=0 — the overhead-gate off arm).
             "compute": eng.compute.rollup() or None,
+            # Pool-ledger rollup (obs/memory.py): peak occupancy, the
+            # per-tenant split, and leak/conservation counters for THIS
+            # run. None on dense backends or with the ledger disabled
+            # (EDGEMESH_MEM_LEDGER=0 — the overhead-gate off arm).
+            "mem": eng.mem.rollup() or None,
         }
     finally:
         eng.close()
@@ -950,6 +955,14 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
         eng.compute.enabled = False
         ledgeroff = measure(routed_url, "router, ledger off")
         eng.compute.enabled = True
+        # Mem-ledger-off arm (EDGEMESH_MEM_LEDGER=0 configuration): the
+        # delta vs `routed` is the pool ledger's whole steady-state cost —
+        # one attributed dict update per pool transition, all under the
+        # engine lock the transition already holds. Gate (PERFORMANCE.md
+        # "The memory observatory"): routed p50 within 2% of this arm.
+        eng.mem.enabled = False
+        memledgeroff = measure(routed_url, "router, mem ledger off")
+        eng.mem.enabled = True
         router.trace_sample = 1.0
         traced = measure(routed_url, "router+tracing")
         # Recorder arm: tracing back OFF, the flight ring attached live —
@@ -971,6 +984,10 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
         ledger_ratio = (
             round(pct(routed, 50) / pct(ledgeroff, 50), 4)
             if pct(ledgeroff, 50) else None
+        )
+        mem_ledger_ratio = (
+            round(pct(routed, 50) / pct(memledgeroff, 50), 4)
+            if pct(memledgeroff, 50) else None
         )
         _progress(
             f"router-overhead: p50 {pct(direct, 50) * 1e3:.2f}ms direct vs "
@@ -1016,7 +1033,16 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
             "ledgeroff_p99_s": pct(ledgeroff, 99),
             "ledger_overhead_p50_s": round(pct(routed, 50) - pct(ledgeroff, 50), 6),
             "ledger_overhead_ratio": ledger_ratio,
+            # The pool-ledger arm: routed (mem ledger on, the default) vs
+            # the same path with it disabled. The gate (PERFORMANCE.md
+            # "The memory observatory"): ratio <= 1.02.
+            "memledgeroff_p50_s": pct(memledgeroff, 50),
+            "memledgeroff_p99_s": pct(memledgeroff, 99),
+            "mem_ledger_overhead_p50_s": round(
+                pct(routed, 50) - pct(memledgeroff, 50), 6),
+            "mem_ledger_overhead_ratio": mem_ledger_ratio,
             "compute": eng.compute.rollup() or None,
+            "mem": eng.mem.rollup() or None,
             "sample_trace": sample_trace,
             # The obs view of the routed arms (counters + router histogram).
             "obs": obs.summary(prefix="edgemesh_fleet_"),
@@ -1262,9 +1288,13 @@ def load_curve_benchmark(n_replicas: int = 2, duration_s: float = 4.0,
             sampling=SamplingParams(max_new_tokens=max_new, do_sample=False,
                                     repetition_penalty=1.0),
         ))
+        # Paged backend so the memory observatory has a pool to attribute:
+        # the curve then carries occupancy + exhaustion forecast per point
+        # (the forecast AT the knee is the capacity-planning number).
         return serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1",
                           port=0, block=False, continuous=True, batch=2,
-                          registry=Registry(), trace_sample=0.0)
+                          kv_backend="paged", registry=Registry(),
+                          trace_sample=0.0)
 
     _progress(f"load-curve: building {n_replicas} in-process replicas")
     servers = [_replica() for _ in range(n_replicas)]
@@ -1345,6 +1375,8 @@ def load_curve_benchmark(n_replicas: int = 2, duration_s: float = 4.0,
             4.0 * cal_lats[int(0.95 * (len(cal_lats) - 1))], 0.25
         )
 
+        mem_points: list[dict] = []
+
         def make_run(rate: float) -> dict:
             # Overload windows must span several SLOs: a saturated fleet
             # serves ~capacity*slo good requests as a one-off transient
@@ -1358,10 +1390,40 @@ def load_curve_benchmark(n_replicas: int = 2, duration_s: float = 4.0,
                                     make_workload(rate).build_schedule(dur),
                                     slo_latency_s=slo_latency_s,
                                     duration_s=dur)
-            return gen.run()
+            report = gen.run()
+            # Snapshot the memory observatory at each point: the tightest
+            # exhaustion forecast across the fleet and the cumulative peak
+            # occupancy, in rate order (run_curve projects a fixed point
+            # schema, so mem rides beside the curve, not inside it).
+            cell: dict[str, Any] = {"requested_rps": rate,
+                                    "min_forecast_s": None,
+                                    "peak_resident_pages": None}
+            for s in servers:
+                eng = s.batcher
+                if eng is None:
+                    continue
+                m = (eng.load_digest() or {}).get("mem")
+                if isinstance(m, dict):
+                    f = m.get("forecast_s")
+                    if isinstance(f, (int, float)) and (
+                            cell["min_forecast_s"] is None
+                            or f < cell["min_forecast_s"]):
+                        cell["min_forecast_s"] = f
+                peak = (eng.mem.rollup() or {}).get("peak_resident_pages")
+                if isinstance(peak, int):
+                    cell["peak_resident_pages"] = (
+                        (cell["peak_resident_pages"] or 0) + peak
+                    )
+            mem_points.append(cell)
+            return report
 
         rates = [round(capacity_rps * f, 3) for f in point_factors]
         curve = run_curve(make_run, rates)
+        knee_mem = next(
+            (c for c, p in zip(mem_points, curve["points"])
+             if p.get("offered_rps") == curve.get("knee_offered_rps")),
+            None,
+        )
         _progress(
             f"load-curve: knee {curve['knee_offered_rps']} rps offered -> "
             f"{curve['knee_goodput_rps']} rps goodput "
@@ -1378,6 +1440,19 @@ def load_curve_benchmark(n_replicas: int = 2, duration_s: float = 4.0,
             "knee_goodput_rps": curve["knee_goodput_rps"],
             "collapsed": curve["collapsed"],
             "points": curve["points"],
+            # The memory observatory beside the curve: per-point pool
+            # snapshots (rate order matches points) and the forecast AT
+            # the knee — how close to pool exhaustion the recommended
+            # operating point runs (docs/OBSERVABILITY.md).
+            "mem_points": mem_points,
+            "mem_forecast_at_knee_s": (
+                knee_mem.get("min_forecast_s") if knee_mem else None
+            ),
+            "mem_peak_resident_pages": max(
+                (c["peak_resident_pages"] for c in mem_points
+                 if c["peak_resident_pages"] is not None),
+                default=None,
+            ),
         }
     finally:
         if front is not None:
@@ -1598,6 +1673,15 @@ def disagg_benchmark(n_replicas: int = 3, duration_s: float = 4.0,
             "kv_transfer_bytes": kv_bytes,
             "tiered_outcomes": tiered_outcomes,
             "tiers": tiered_router.status()["tiers"],
+            # Per-replica pool-ledger rollups across BOTH arms (the
+            # replicas persist between them): peak occupancy, per-tenant
+            # split, and leak/conservation counters for the paged pools
+            # the KV transfers spliced into (obs/memory.py).
+            "mem": {
+                f"replica-{i}": (s.batcher.mem.rollup() or None)
+                for i, s in enumerate(servers)
+                if s.batcher is not None
+            } or None,
         }
     finally:
         for prober in probers:
@@ -2269,6 +2353,9 @@ def headline_benchmark(
         # The compute observatory's view of the headline serving run:
         # per-boundary device time + roofline (docs/OBSERVABILITY.md).
         out["serving_compute"] = r.get("compute")
+        # The memory observatory's view of the same run: peak pool
+        # occupancy, per-tenant split, leak/conservation counters.
+        out["serving_mem"] = r.get("mem")
         emit_partial(out)
         # Segmented baseline at the same shape: the headline's own
         # ragged-vs-segmented pin (the full shape sweep is stage 7c).
@@ -2389,6 +2476,11 @@ def headline_benchmark(
         for k in ("ledgeroff_p50_s", "ledger_overhead_p50_s",
                   "ledger_overhead_ratio"):
             out[k] = r.get(k)
+        # The pool-ledger overhead arm (mem ledger on vs off): the same
+        # <=1.02 ratio gate, for the memory observatory.
+        for k in ("memledgeroff_p50_s", "mem_ledger_overhead_p50_s",
+                  "mem_ledger_overhead_ratio"):
+            out[k] = r.get(k)
 
     if os.environ.get("EDGEMESH_BENCH_FLEET", "1") == "1":
         _stage("router_overhead", _router_overhead)
@@ -2406,6 +2498,12 @@ def headline_benchmark(
         out["load_curve_slo_latency_s"] = r["slo_latency_s"]
         out["load_curve_capacity_rps"] = r["estimated_capacity_rps"]
         out["load_curve_points"] = r["points"]
+        # The memory observatory beside the curve: pool snapshot per
+        # point + the exhaustion forecast at the knee. .get(): a faked
+        # stage from an older schema must not fail the headline.
+        for k in ("mem_points", "mem_forecast_at_knee_s",
+                  "mem_peak_resident_pages"):
+            out[f"load_curve_{k}"] = r.get(k)
 
     if os.environ.get("EDGEMESH_BENCH_LOADGEN", "1") == "1":
         _stage("load_curve", _load_curve)
@@ -2426,6 +2524,9 @@ def headline_benchmark(
                   "prefill_threshold_chars"):
             out[f"disagg_{k}"] = r[k]
         out["disagg_tiers"] = r["tiers"]
+        # Per-replica pool-ledger rollups (KV import splices land as
+        # 'import'-cause events in the receiving replica's ledger).
+        out["disagg_mem"] = r.get("mem")
 
     if os.environ.get("EDGEMESH_BENCH_DISAGG", "1") == "1":
         _stage("disagg", _disagg)

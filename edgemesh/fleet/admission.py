@@ -33,6 +33,7 @@ tests pin bucket refill and fairness deterministically.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -166,6 +167,7 @@ class AdmissionController:
                  policies: dict[str, TenantPolicy] | None = None,
                  default_policy: TenantPolicy | None = None,
                  queue_cap: int = 0,
+                 mem_horizon_s: float | None = None,
                  now=time.monotonic) -> None:
         from edgemesh.obs.metrics import bounded_label
 
@@ -194,6 +196,21 @@ class AdmissionController:
         self._waiting = 0
         self._ratelimit_hits: dict[str, int] = {}
         self._queue_timeouts: dict[str, int] = {}
+        # Exhaustion-aware admission (docs/FLEET.md): when any routable
+        # replica's pool-exhaustion forecast (obs/memory.py, riding the
+        # load digest's ``mem`` block) drops below this horizon, batch-lane
+        # admissions defer — queued behind interactive work, never granted
+        # while the forecast stays short — so bulk tenants cannot wedge
+        # the page pool that interactive traffic needs to keep flowing.
+        # 0 disables (the default: single-replica deployments without the
+        # digest feed keep legacy verdicts byte-for-byte).
+        if mem_horizon_s is None:
+            mem_horizon_s = float(
+                os.environ.get("EDGEMESH_ADMIT_MEM_HORIZON_S", "0") or 0
+            )
+        self.mem_horizon_s = max(0.0, float(mem_horizon_s))
+        self._mem_forecast: dict[str, float] = {}  # guarded by: _cond
+        self._mem_deferrals = 0  # guarded by: _cond
 
     def policy_for(self, tenant: str) -> TenantPolicy:
         return self.policies.get(tenant, self.default_policy)
@@ -242,6 +259,34 @@ class AdmissionController:
                     None if pol.burst is None else pol.burst * scale,
                 )
 
+    # -- memory-observatory seam (obs/memory.py → load digest ``mem``) -------
+
+    def note_mem_forecast(self, load: dict | None,
+                          replica: str = "default") -> None:
+        """Feed one replica's load digest. Reads ``mem.forecast_s`` (the
+        pool time-to-empty from :meth:`PoolLedger.digest_mem`); a digest
+        without a usable forecast CLEARS the replica's entry — stale
+        pressure from a replica that stopped reporting must not defer
+        batch work forever. Waking the queue on every update lets deferred
+        batch waiters proceed the moment the forecast recovers."""
+        forecast = None
+        mem = (load or {}).get("mem")
+        if isinstance(mem, dict):
+            raw = mem.get("forecast_s")
+            if isinstance(raw, (int, float)) and raw >= 0:
+                forecast = float(raw)
+        with self._cond:
+            if forecast is None:
+                self._mem_forecast.pop(replica, None)
+            else:
+                self._mem_forecast[replica] = forecast
+            self._grant_locked()
+
+    def _mem_pressure_locked(self) -> bool:  # guarded by: _cond
+        if self.mem_horizon_s <= 0 or not self._mem_forecast:
+            return False
+        return min(self._mem_forecast.values()) < self.mem_horizon_s
+
     # -- the admission verdict ----------------------------------------------
 
     def acquire(self, tenant: str = "default", wait_s: float = 0.0) -> str:
@@ -256,8 +301,15 @@ class AdmissionController:
         with self._cond:
             # Fast path: free capacity and nobody queued ahead — grant
             # without touching fairness state (the uncontended case must
-            # stay as cheap as the old semaphore).
-            if self._inflight < self.max_inflight and self._waiting == 0:
+            # stay as cheap as the old semaphore). Batch work under memory
+            # pressure skips the fast path and defers into the queue: a
+            # granted slot is a promise of pool pages the exhaustion
+            # forecast says the fleet is about to run out of.
+            deferred = pol.lane == "batch" and self._mem_pressure_locked()
+            if deferred:
+                self._mem_deferrals += 1
+            if not deferred and self._inflight < self.max_inflight \
+                    and self._waiting == 0:
                 self._inflight += 1
                 return "ok"
             # queue_cap is PER TENANT, not global: a flooding tenant
@@ -304,6 +356,11 @@ class AdmissionController:
         while self._inflight < self.max_inflight and self._waiting > 0:
             chosen: str | None = None
             for lane in LANES:
+                # Deferral: batch grants pause while any replica's pool
+                # forecast is under the horizon; interactive grants (and
+                # queue-timeout expiry on the waiters themselves) proceed.
+                if lane == "batch" and self._mem_pressure_locked():
+                    continue
                 backlog = []
                 for tenant, q in self._queues.items():
                     while q and q[0].abandoned:
@@ -345,6 +402,12 @@ class AdmissionController:
                 },
                 "ratelimit_hits": dict(self._ratelimit_hits),
                 "queue_timeouts": dict(self._queue_timeouts),
+                "mem_horizon_s": self.mem_horizon_s,
+                "mem_forecast_s": (
+                    round(min(self._mem_forecast.values()), 3)
+                    if self._mem_forecast else None
+                ),
+                "mem_deferrals": self._mem_deferrals,
                 "policies": {
                     t: {"lane": p.lane, "weight": p.weight,
                         "rate_per_s": p.rate_per_s}

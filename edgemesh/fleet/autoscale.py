@@ -60,7 +60,10 @@ class AutoScaler:
                  cooldown_s: float = 20.0, incident_cooldown_s: float = 60.0,
                  interval_s: float = 2.0,
                  neutral_service_s: float = 0.1,
+                 mem_pressure_s: float | None = None,
                  obs_registry=None, now=time.monotonic) -> None:
+        import os
+
         from edgemesh.obs import get_registry
 
         if min_replicas < 1:
@@ -90,6 +93,17 @@ class AutoScaler:
         # same neutral assumption the telemetry balancer falls back to,
         # so a cold fleet is never scored as zero supply.
         self.neutral_service_s = float(neutral_service_s)
+        # Memory-pressure scale-up (docs/FLEET.md): when any routable
+        # replica's pool-exhaustion forecast (the load digest's
+        # ``mem.forecast_s``, obs/memory.py) drops below this horizon,
+        # the pass votes high-watermark regardless of the demand/supply
+        # ratio — a pool about to wedge is a capacity shortage the req/s
+        # math cannot see. 0 disables (the default).
+        if mem_pressure_s is None:
+            mem_pressure_s = float(
+                os.environ.get("EDGEMESH_SCALE_MEM_PRESSURE_S", "0") or 0
+            )
+        self.mem_pressure_s = max(0.0, float(mem_pressure_s))
         self._now = now
         self._lock = threading.Lock()
         self._high_streak = 0  # guarded by: _lock
@@ -134,11 +148,15 @@ class AutoScaler:
 
     # -- one control pass ----------------------------------------------------
 
-    def _demand_supply(self) -> tuple[float, float, int]:
-        """(demand_rps, supply_rps, routable_count) from the live digests."""
+    def _demand_supply(self) -> tuple[float, float, int, float | None]:
+        """(demand_rps, supply_rps, routable_count, min_mem_forecast_s)
+        from the live digests. The mem forecast is the fleet-wide minimum
+        of each digest's ``mem.forecast_s`` (None when no replica reports
+        one — pre-mem digests and dense backends stay pressure-neutral)."""
         demand = 0.0
         supply = 0.0
         routable = 0
+        mem_min: float | None = None
         for rep in self.registry.replicas():
             if not rep.routable():
                 continue
@@ -154,14 +172,21 @@ class AutoScaler:
             else:
                 slots = cap.get("slots") or 1
                 supply += slots / self.neutral_service_s
-        return demand, supply, routable
+            mem = load.get("mem")
+            if isinstance(mem, dict):
+                f = mem.get("forecast_s")
+                if isinstance(f, (int, float)) and f >= 0:
+                    mem_min = f if mem_min is None else min(mem_min, float(f))
+        return demand, supply, routable, mem_min
 
     def evaluate(self) -> dict | None:
         """One control pass; returns the action taken (or None). Spawns
         and drains run inline — callers that must not block (the router's
         incident path) go through :meth:`note_incident` instead."""
-        demand, supply, routable = self._demand_supply()
+        demand, supply, routable, mem_min = self._demand_supply()
         util = demand / supply if supply > 0 else 0.0
+        mem_pressure = (self.mem_pressure_s > 0 and mem_min is not None
+                        and mem_min < self.mem_pressure_s)
         pending = self.launcher.pending()
         live = routable + pending
         self._replicas_gauge.set(float(live))
@@ -179,7 +204,11 @@ class AutoScaler:
                 action = {"action": "incident_up",
                           "incident": incident.get("id"),
                           "kind": incident.get("kind")}
-            elif util >= self.high_watermark:
+            elif util >= self.high_watermark or mem_pressure:
+                # Memory pressure is a high-watermark vote: the same
+                # streak/cooldown discipline applies, so a single noisy
+                # forecast cannot spawn a replica any faster than a
+                # single hot utilization sample can.
                 self._low_streak = 0
                 self._high_streak += 1
                 if (not cooling and self._high_streak >= self.up_after
@@ -187,6 +216,8 @@ class AutoScaler:
                     self._last_action_ts = now
                     self._high_streak = 0
                     action = {"action": "up"}
+                    if mem_pressure and util < self.high_watermark:
+                        action["reason"] = "mem_pressure"
             elif util <= self.low_watermark:
                 self._high_streak = 0
                 self._low_streak += 1
@@ -210,6 +241,10 @@ class AutoScaler:
                 "supply_rps": round(supply, 3),
                 "utilization": round(util, 4),
                 "routable": routable, "pending": pending,
+                "mem_forecast_s": (
+                    round(mem_min, 3) if mem_min is not None else None
+                ),
+                "mem_pressure": mem_pressure,
             }
         if action is None:
             return None

@@ -170,7 +170,28 @@ class TelemetryBalancer:
         telem = queue + prefill + (rep.outstanding + 1) * service
         if load.get("recent_compile"):
             telem += self.compile_penalty_s
+        telem += self._mem_penalty(load)
         return freshness * telem + (1.0 - freshness) * neutral
+
+    @staticmethod
+    def _mem_penalty(load: dict) -> float:
+        """Seconds of penalty for a replica whose page pool is nearly
+        exhausted, from the digest's ``mem`` block (obs/memory.py
+        ``digest_mem``). Scales inversely with the exhaustion forecast
+        below a 10 s horizon — a replica about to wedge its pool should
+        lose ties to one with headroom, without ever being hard-excluded
+        (under fleet-wide pressure SOMEONE still has to serve). Digests
+        without a mem block (dense backends, pre-mem replicas, ledger
+        disabled) cost exactly 0.0 — scoring unchanged."""
+        mem = load.get("mem")
+        if not isinstance(mem, dict):
+            return 0.0
+        forecast = mem.get("forecast_s")
+        if not isinstance(forecast, (int, float)) or forecast < 0:
+            return 0.0
+        if forecast >= 10.0:
+            return 0.0
+        return (10.0 - float(forecast)) / 10.0
 
     @staticmethod
     def _cost_service_s(load: dict) -> float | None:

@@ -706,9 +706,13 @@ class FleetRouter:
     def note_digest(self, rid: str, load: dict) -> None:
         """Health-prober digest hook (fleet/health.py ``on_digest``): fresh
         phase telemetry invalidates the tier manager's cached assignment so
-        membership reacts on the probe cadence, not the cache TTL."""
+        membership reacts on the probe cadence, not the cache TTL. The
+        digest's ``mem`` block (obs/memory.py) also feeds the admission
+        controller's exhaustion-aware deferral, keyed by replica so one
+        recovering pool does not mask another's pressure."""
         if self.tiers is not None:
             self.tiers.invalidate()
+        self.admission.note_mem_forecast(load, replica=rid)
 
     def _backoff(self, attempt: int, deadline: float) -> float:
         delay = min(self.backoff_cap_s, self.backoff_base_s * (2 ** attempt))
@@ -972,6 +976,9 @@ class FleetRouter:
         existed = self.registry.deregister(rid)
         if self.tiers is not None:
             self.tiers.forget(rid)
+        # A forgotten replica's pool forecast must not keep deferring
+        # batch admissions — passing no digest clears its entry.
+        self.admission.note_mem_forecast(None, replica=rid)
         with self._incident_lock:
             stale = [r["id"] for r in self._incidents
                      if r.get("source") == rid]
@@ -1143,10 +1150,27 @@ class FleetRouter:
         # replica's digest cost block, aggregated across the routable
         # fleet. Null until some replica's ledger has measured something.
         fleet_costs: dict[str, dict] = {}
+        # Fleet memory rollup (docs/OBSERVABILITY.md "The memory
+        # observatory"): each routable replica's pool occupancy and
+        # exhaustion forecast from the digest ``mem`` block. Null until
+        # some replica ships one (dense backends never do).
+        mem_replicas: dict[str, dict] = {}
         for rep in self.registry.replicas():
+            if not rep.routable():
+                continue
             load = rep.load if isinstance(rep.load, dict) else {}
+            m = load.get("mem")
+            if isinstance(m, dict):
+                mem_replicas[rep.rid] = {
+                    "total_pages": m.get("total_pages"),
+                    "free_pages": m.get("free_pages"),
+                    "resident_pages": m.get("resident_pages"),
+                    "forecast_s": m.get("forecast_s"),
+                    "leaked_pages": (m.get("leak") or {}).get("pages"),
+                    "conservation_breaks": m.get("conservation_breaks"),
+                }
             cap = load.get("capacity")
-            if not rep.routable() or not isinstance(cap, dict):
+            if not isinstance(cap, dict):
                 continue
             arrival = load.get("ewma_arrival_s")
             cell = {
@@ -1200,6 +1224,27 @@ class FleetRouter:
                 for b, a in sorted(fleet_costs.items())
             } or None,
         }
+        mem = None
+        if mem_replicas:
+            forecasts = [c["forecast_s"] for c in mem_replicas.values()
+                         if isinstance(c["forecast_s"], (int, float))]
+
+            def _tot(key):
+                vals = [c[key] for c in mem_replicas.values()
+                        if isinstance(c[key], int)]
+                return sum(vals) if vals else None
+
+            mem = {
+                "fleet_free_pages": _tot("free_pages"),
+                "fleet_resident_pages": _tot("resident_pages"),
+                "fleet_leaked_pages": _tot("leaked_pages"),
+                "fleet_conservation_breaks": _tot("conservation_breaks"),
+                # The MINIMUM across replicas, not the mean: exhaustion is
+                # per-pool, and the tightest pool is the one admission and
+                # the autoscaler act on.
+                "min_forecast_s": min(forecasts) if forecasts else None,
+                "replicas": mem_replicas,
+            }
         return {
             "balancer": getattr(self.balancer, "name", type(self.balancer).__name__),
             "max_inflight": self.admission.max_inflight,
@@ -1207,6 +1252,10 @@ class FleetRouter:
             # The measured capacity model + (when attached) the autoscaler
             # closing the loop on it (docs/FLEET.md "Autoscaling").
             "capacity": capacity,
+            # The memory observatory's fleet view: per-replica pool
+            # occupancy, leak/conservation counters, and the tightest
+            # exhaustion forecast (docs/OBSERVABILITY.md).
+            "mem": mem,
             "autoscale": (
                 None if self.autoscaler is None else self.autoscaler.status()
             ),

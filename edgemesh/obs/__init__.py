@@ -27,6 +27,10 @@ Three pieces (docs/OBSERVABILITY.md is the operator-facing reference):
   attribution over every jitted serving boundary (sampled fenced
   timings, once-per-compile cost_analysis capture, roofline scoring)
   plus the speculative round ledger.
+- ``memory``: the memory observatory — the page-lifecycle PoolLedger
+  every KV-pool transition reports through (per-tenant attribution,
+  conservation invariant, leak tripwires, exhaustion forecast) plus the
+  offline span-log twins.
 
 Importing this package never imports jax — device sampling defers the
 import to scrape time, so the supervisor and the ``edgemesh obs`` CLI stay
@@ -37,6 +41,7 @@ from edgemesh.obs.anomaly import (  # noqa: F401
     AnomalyMonitor,
     CompileStormDetector,
     ErrorSpikeDetector,
+    PoolLeakDetector,
     QueueCollapseDetector,
     SloBurstDetector,
 )
@@ -54,6 +59,13 @@ from edgemesh.obs.compute import (  # noqa: F401
     summarize_compute,
 )
 from edgemesh.obs.device import register_device_gauges  # noqa: F401
+from edgemesh.obs.memory import (  # noqa: F401
+    POOL_RECORD_EVENT,
+    PoolLedger,
+    diff_mem,
+    replay_pool_record,
+    summarize_mem,
+)
 from edgemesh.obs.flight import (  # noqa: F401
     FlightRecorder,
     assemble_incident,

@@ -1,7 +1,7 @@
 """Anomaly triggers: the detectors that fire the flight recorder.
 
 The flight recorder (obs/flight.py) answers *what happened*; this module
-answers *when to ask*. Four detectors, each fed by hooks the serving stack
+answers *when to ask*. Five detectors, each fed by hooks the serving stack
 already has — no new measurement, only new judgment:
 
 - :class:`SloBurstDetector` — a burst of SLO misses in the recent request
@@ -17,6 +17,10 @@ already has — no new measurement, only new judgment:
 - :class:`CompileStormDetector` — M distinct backend compiles inside a
   window (fed by the engine's compile hook): mid-serve shape churn is the
   silent latency cliff every postmortem should show.
+- :class:`PoolLeakDetector` — KV pool pages still resident >= N seconds
+  after their owning request retired (fed by the memory observatory's
+  quiesce scan, obs/memory.py): the one failure the conservation counter
+  alone cannot localize to a request.
 
 :class:`AnomalyMonitor` owns the detectors, counts
 ``edgemesh_anomaly_triggers_total{kind}``, and — when armed with a dump
@@ -217,6 +221,35 @@ class CompileStormDetector:
             return len(self._times) == self.count
 
 
+class PoolLeakDetector:
+    """Pages still resident after their owning request retired >= ``age_s``
+    seconds ago (fed by the memory observatory's ``leak_scan``,
+    obs/memory.py). Fires once per leaking request id: a leak is a
+    permanent condition, and re-dumping the ring on every scan would bury
+    the incident that matters — the first one, whose ring still holds the
+    leaking request's spans."""
+
+    kind = "pool_leak"
+
+    def __init__(self, age_s: float = 30.0):
+        self.age_s = float(age_s)
+        self._fired: set[str] = set()  # guarded by: _lock
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> "PoolLeakDetector":
+        return cls(age_s=_env_float("EDGEMESH_ANOMALY_POOL_LEAK_S", 30.0))
+
+    def observe(self, rid: str, retired_age_s: float) -> bool:
+        if retired_age_s < self.age_s:
+            return False
+        with self._lock:
+            if rid in self._fired:
+                return False
+            self._fired.add(rid)
+            return True
+
+
 class AnomalyMonitor:
     """Detector fan-in → incident id → flight dump, with cooldown.
 
@@ -231,6 +264,7 @@ class AnomalyMonitor:
                  queue_collapse: QueueCollapseDetector | None = None,
                  error_spike: ErrorSpikeDetector | None = None,
                  compile_storm: CompileStormDetector | None = None,
+                 pool_leak: PoolLeakDetector | None = None,
                  cooldown_s: float = 30.0):
         self.flight = flight
         self.dump_dir = dump_dir
@@ -238,6 +272,7 @@ class AnomalyMonitor:
         self.queue_collapse = queue_collapse or QueueCollapseDetector.from_env()
         self.error_spike = error_spike or ErrorSpikeDetector.from_env()
         self.compile_storm = compile_storm or CompileStormDetector.from_env()
+        self.pool_leak = pool_leak or PoolLeakDetector.from_env()
         self.cooldown_s = _env_float("EDGEMESH_ANOMALY_COOLDOWN_S",
                                      float(cooldown_s))
         reg = registry if registry is not None else get_registry()
@@ -268,6 +303,23 @@ class AnomalyMonitor:
         if self.queue_collapse.observe(depth):
             self.trigger(self.queue_collapse.kind,
                          detail={"queue_depth": int(depth)})
+
+    def on_pool_leak(self, rid: str, retired_age_s: float,
+                     detail: dict | None = None) -> bool:
+        """One leak candidate from the memory observatory's quiesce scan
+        (obs/memory.py ``leak_scan``): pages whose owner retired
+        ``retired_age_s`` ago and never came home. Fires the ``pool_leak``
+        kind once per request id; the incident id propagates fleet-wide
+        through the standard digest path, so the dump names the leaking
+        replica and every sibling's ring lands beside it. Returns whether
+        this candidate fired (the ledger logs fired leaks as records)."""
+        if self.pool_leak.observe(rid, retired_age_s):
+            self.trigger(self.pool_leak.kind,
+                         detail={"rid": rid,
+                                 "retired_age_s": round(retired_age_s, 3),
+                                 **(detail or {})})
+            return True
+        return False
 
     def on_compile(self) -> None:
         """Direct compile feed (when the compile hook is wired to the

@@ -153,6 +153,19 @@ def build_parser() -> argparse.ArgumentParser:
     comp.add_argument("--json", action="store_true", dest="as_json",
                       help="print the machine-readable rollup "
                       "(compute.summarize_compute) instead of the table")
+    mem = sub.add_parser(
+        "mem",
+        help="page-lifecycle ledger table from pool_mem records "
+        "(obs/memory.py): per-tenant residency and peaks, internal/"
+        "external fragmentation, conservation breaks, leaks, and the "
+        "last digest's exhaustion forecast / HBM drift")
+    mem.add_argument("path", help="span JSONL log or directory of them")
+    mem.add_argument("--diff", default=None, metavar="SPANS",
+                     help="second span log: print per-tenant/per-cause "
+                     "deltas (the second log vs the first)")
+    mem.add_argument("--json", action="store_true", dest="as_json",
+                     help="print the machine-readable rollup "
+                     "(memory.summarize_mem) instead of the table")
     return p
 
 
@@ -273,6 +286,11 @@ def cmd_summary(path: str) -> int:
     from edgemesh.obs.compute import summarize_compute
 
     compute = summarize_compute(records)
+    # Memory-observatory rollup (obs/memory.py): per-tenant residency,
+    # fragmentation, conservation/leak tripwires. Null on pre-mem logs.
+    from edgemesh.obs.memory import summarize_mem
+
+    mem = summarize_mem(records)
 
     print(json.dumps({
         "records": len(records),
@@ -291,6 +309,7 @@ def cmd_summary(path: str) -> int:
         "slo_goodput_ratio": goodput,
         "tenants": tenants,
         "compute": compute,
+        "mem": mem,
         "metrics": registry.summary(),
     }, indent=2))
     return 0
@@ -393,6 +412,109 @@ def cmd_compute(path: str, diff: str | None = None,
               "disabled (EDGEMESH_COMPUTE_SAMPLE=0)")
         return 0
     print("\n".join(_compute_table(summ)))
+    return 0
+
+
+def _last_mem_digest(records: list[dict]) -> dict | None:
+    """The newest flight-snapshot digest ``mem`` block in the log — where
+    the live-only rows (exhaustion forecast, HBM drift) ride, since the
+    per-transition records deliberately do not recompute them."""
+    mem = None
+    for r in records:
+        if isinstance(r.get("mem"), dict):
+            mem = r["mem"]
+    return mem
+
+
+def _mem_table(summ: dict, digest: dict | None) -> list[str]:
+    lines = [
+        f"pool: total={summ.get('total_pages') or '-'} pages  "
+        f"peak_resident={summ.get('peak_resident_pages')}  "
+        f"last_free={summ.get('last_free_pages')}  "
+        f"conservation_breaks={summ.get('conservation_breaks')}"
+    ]
+    tenants = summ.get("tenants") or {}
+    if tenants:
+        lines.append(f"{'TENANT':<16} {'PAGES':>7} {'PEAK':>7}")
+        for name, cell in tenants.items():
+            lines.append(f"{name:<16} {cell.get('pages'):>7} "
+                         f"{cell.get('peak_pages'):>7}")
+    events = summ.get("events") or {}
+    if events:
+        lines.append(f"{'CAUSE':<16} {'EVENTS':>7} {'PAGES':>7}")
+        for name, cell in events.items():
+            lines.append(f"{name:<16} {cell.get('count'):>7} "
+                         f"{cell.get('pages'):>7}")
+    for leak in summ.get("leaks") or []:
+        lines.append(
+            f"LEAK rid={leak.get('rid')} tenant={leak.get('tenant')} "
+            f"pages={leak.get('pages')} age={_fmt_s(leak.get('age_s'))}"
+        )
+    if digest is not None:
+        frag = digest.get("frag") or {}
+        lines.append(
+            f"frag: internal={frag.get('internal_pages')} pages "
+            f"(by cause: {frag.get('internal_by_cause')}) "
+            f"external={frag.get('external_pages')}"
+        )
+        lines.append(
+            f"forecast: {_fmt_s(digest.get('forecast_s'))} to exhaustion "
+            f"(per_row_worst={digest.get('per_row_worst')}, "
+            f"free={digest.get('free_pages')})"
+        )
+        drift = digest.get("drift")
+        if drift is not None:
+            lines.append(
+                f"hbm drift: {drift.get('drift_bytes')} bytes vs ledger "
+                f"(in_use={drift.get('hbm_bytes_in_use')}, "
+                f"page={drift.get('page_bytes')} B)"
+            )
+    return lines
+
+
+def cmd_mem(path: str, diff: str | None = None, as_json: bool = False) -> int:
+    """Page-lifecycle table from a span log's pool_mem records. A log with
+    no pool records is an answer, not an error: prints an explicit empty
+    report and exits 0 (pre-mem logs — the same contract as compute's
+    pre-ledger logs)."""
+    from edgemesh.obs.memory import diff_mem, summarize_mem
+
+    if diff is not None and not Path(diff).exists():
+        print(f"error: no such span log: {diff}", file=sys.stderr)
+        return 2
+    records = _read(path)
+    summ = summarize_mem(records)
+    if diff is not None:
+        other = summarize_mem(_read(diff))
+        doc = diff_mem(summ, other)
+        if as_json:
+            print(json.dumps(doc, indent=2))
+            return 0
+        if summ is None and other is None:
+            print("no pool records in either log — nothing to diff")
+            return 0
+        print(f"peak resident: {doc['a_peak_resident_pages']} → "
+              f"{doc['b_peak_resident_pages']} "
+              f"({doc['peak_ratio'] or '-'}x)")
+        print(f"{'TENANT':<16} {'A PEAK':>7} {'B PEAK':>7}")
+        for name, cell in doc["tenants"].items():
+            print(f"{name:<16} {cell.get('a_peak_pages') or '-':>7} "
+                  f"{cell.get('b_peak_pages') or '-':>7}")
+        print(f"{'CAUSE':<16} {'A PAGES':>8} {'B PAGES':>8}")
+        for name, cell in doc["events"].items():
+            print(f"{name:<16} {cell.get('a_pages') or '-':>8} "
+                  f"{cell.get('b_pages') or '-':>8}")
+        print(f"conservation breaks: {doc['a_conservation_breaks']} → "
+              f"{doc['b_conservation_breaks']}")
+        return 0
+    if as_json:
+        print(json.dumps(summ, indent=2))
+        return 0
+    if summ is None:
+        print("no pool records — a pre-mem log, a dense backend, or the "
+              "ledger was disabled (EDGEMESH_MEM_LEDGER=0)")
+        return 0
+    print("\n".join(_mem_table(summ, _last_mem_digest(records))))
     return 0
 
 
@@ -608,6 +730,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_summary(args.path)
     if args.cmd == "compute":
         return cmd_compute(args.path, diff=args.diff, as_json=args.as_json)
+    if args.cmd == "mem":
+        return cmd_mem(args.path, diff=args.diff, as_json=args.as_json)
     return cmd_prom(args.path)
 
 

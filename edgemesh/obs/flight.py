@@ -267,14 +267,15 @@ def assemble_incident(paths: Iterable[str | Path],
                 r = dict(rec)
                 r.setdefault("_replica", replica or Path(p).stem)
                 spans.append(r)
-            elif ev in (SNAPSHOT_EVENT, "pool_reset", "incident",
-                        DUMP_EVENT):
+            elif ev in (SNAPSHOT_EVENT, "pool_reset", "pool_mem",
+                        "incident", DUMP_EVENT):
                 timeline.append({
                     "ts": rec.get("ts"), "event": ev,
                     "replica": rec.get("replica", replica),
                     **{k: rec[k] for k in
                        ("incident_id", "kind", "queue_depth", "inflight",
-                        "reason", "detail")
+                        "reason", "detail", "cause", "rid", "tenant",
+                        "delta", "resident", "free")
                        if k in rec},
                 })
     if not headers:

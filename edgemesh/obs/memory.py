@@ -1,0 +1,711 @@
+"""The memory observatory: page-lifecycle ledger for the paged KV pool.
+
+The pool is the scarcest resource in the serving stack, yet until this
+module it was observed as three coarse gauges (``pool_state``'s
+occupancy / fragmentation / headroom). Nobody could answer *which tenant
+holds the pool*, *is this occupancy a leak or load*, or *how many
+seconds until exhaustion*. :class:`PoolLedger` closes that gap from ONE
+seam: every page-pool transition the engine performs — worst-case
+reserve at admission, per-boundary commit, COW template split,
+``/kv/import`` splice, export scratch, trash-page padding, free at
+retire/abort, pool rebuild — arrives as an attributed event
+``{engine, request, tenant, cause}``, recorded beside the existing
+stats under the engine lock (edgelint EM115 makes the seam load-bearing:
+direct free-list mutation outside it is an error). From that stream the
+ledger derives:
+
+- **per-tenant residency**: ``edgemesh_pool_tenant_pages{engine,tenant}``
+  gauges plus peak watermarks, every label minted through
+  ``bounded_label`` (the EM112 cardinality contract);
+- **fragmentation, decomposed**: *internal* = reserved-minus-committed
+  pages (the worst-case admission head-room each live request is sitting
+  on, split by originating cause) vs *external* = free pages that cannot
+  form another worst-case admission (the admission-granularity
+  remainder — a paged pool has no placement fragmentation, but admission
+  quantizes in ``per_row_worst`` units);
+- **a conservation invariant**: ``free + resident + reserved_overhead ==
+  total`` checked at every engine quiesce; a violation increments the
+  ``edgemesh_pool_conservation_breaks_total`` tripwire and logs a
+  ``pool_mem`` record — the ledger never "fixes" the books;
+- **a leak detector**: pages whose owning request retired ≥ N seconds
+  ago. Fires the ``pool_leak`` anomaly kind (obs/anomaly.py), which
+  dumps flight rings fleet-wide through the standard incident
+  propagation path;
+- **an exhaustion forecast**: time-to-empty from the arrival EWMA ×
+  per-request worst-case pages, published in the load digest's ``mem``
+  block and consumed by the admission controller (batch-lane deferral —
+  fleet/admission.py) and the autoscaler (memory-pressure scale-up —
+  fleet/autoscale.py). The forecast is reconciled against the device's
+  own ``memory_stats`` so ledger-vs-HBM drift is itself a reported
+  number rather than a silent assumption.
+
+Offline twins :func:`summarize_mem` / :func:`diff_mem` rebuild the same
+views from span logs (``edgemesh obs mem``), with the standing
+forward/backward compatibility contract: logs without ``pool_mem``
+records summarize to None (rc 0), unknown keys on future records are
+ignored.
+
+Importing this module never imports jax (the obs package contract); the
+only device touch is the lazy ``memory_stats`` probe inside
+:meth:`PoolLedger.digest_mem`, which degrades to None on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterable
+
+from edgemesh.obs.metrics import Registry, bounded_label, get_registry
+
+#: Span-log event name (the obs JSONL one-record-vocabulary — edgelint
+#: EM113): one ``pool_mem`` record per attributed pool transition.
+POOL_RECORD_EVENT = "pool_mem"
+
+#: ``EDGEMESH_MEM_LEDGER=0`` disables the ledger entirely — the
+#: overhead-gate off arm benchmarks.py flips (PERFORMANCE.md pins the
+#: on/off p50 ratio at <= 1.02, same contract as the compute ledger).
+ENABLE_ENV = "EDGEMESH_MEM_LEDGER"
+
+#: The transition vocabulary. Every event names the cause that moved the
+#: pages; ``conservation_break`` and ``leak`` are derived findings that
+#: ride the same record stream so offline replay sees them in order.
+CAUSES = (
+    "admit",      # worst-case reserve at (cold or staged) admission
+    "cow",        # COW template split: pages popped to back a warm admit
+    "import",     # /kv/import splice (donated scatter, trash-padded)
+    "export",     # export scratch prefill (popped, walked, freed)
+    "template",   # shared prefix template installation
+    "retire",     # free at normal retirement
+    "abort",      # free on failed/aborted admission or preemption
+    "reset",      # pool rebuild: every resident page returns at once
+)
+
+#: Reserved request id for pages the engine itself holds (the shared
+#: prefix template) — attributed to the ``system`` tenant.
+TEMPLATE_RID = "__template__"
+SYSTEM_TENANT = "system"
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENABLE_ENV, "1") != "0"
+
+
+class _Holding:
+    """One owner's live page count (a request, or the template)."""
+
+    __slots__ = ("rid", "tenant", "pages", "committed", "committed_tokens",
+                 "cause", "retired_at")
+
+    def __init__(self, rid, tenant: str, cause: str) -> None:
+        self.rid = rid
+        self.tenant = tenant
+        self.pages = 0
+        self.committed = 0
+        self.committed_tokens = 0
+        self.cause = cause
+        self.retired_at: float | None = None
+
+
+class PoolLedger:
+    """Attributed page-lifecycle ledger for one engine's KV pool.
+
+    The engine calls the ``on_*`` hooks from inside its own lock (the
+    transitions and the free list must agree), but the ledger carries its
+    own lock too: the read side (``digest_mem`` / ``rollup`` / CLI) runs
+    on gateway threads, and the speculative engine's draft pool feeds a
+    sibling ledger outside the main engine lock.
+    """
+
+    def __init__(self, registry: Registry | None = None,
+                 engine: str = "continuous",
+                 total_pages: int = 0,
+                 page_size: int = 0,
+                 per_row_worst: int = 0,
+                 page_bytes: int = 0,
+                 reserved_overhead: int = 1,
+                 span_log: str | Path | None = None,
+                 flight_source: Callable[[], Any] | None = None,
+                 anomaly_source: Callable[[], Any] | None = None,
+                 enabled: bool | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.registry = registry or get_registry()
+        self.engine = engine
+        self.total_pages = int(total_pages or 0)
+        self.page_size = int(page_size or 0)
+        self.per_row_worst = int(per_row_worst or 0)
+        #: Device bytes one pool page occupies (runtime/paged_kv.py
+        #: ``page_nbytes``) — what prices the ledger against HBM.
+        self.page_bytes = int(page_bytes or 0)
+        #: Pages the pool holds back by construction (the trash page the
+        #: free list never contains) — part of the conservation equation.
+        self.reserved_overhead = int(reserved_overhead)
+        self.enabled = _env_enabled() if enabled is None else bool(enabled)
+        self._flight_source = flight_source
+        self._anomaly_source = anomaly_source
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._holdings: dict[Any, _Holding] = {}
+        self._tenant_pages: dict[str, int] = {}
+        self._tenant_peaks: dict[str, int] = {}
+        self._resident = 0
+        self._peak_resident = 0
+        self._events: dict[str, list[int]] = {}  # cause -> [count, pages]
+        self._breaks = 0
+        self._resets = 0
+        self._seen = False
+        self._hbm_base: tuple[float, int] | None = None
+        self._last_free: int | None = None
+        self._log = None
+        if span_log is not None and self.enabled:
+            from edgemesh.utils.tracing import JsonlLogger
+
+            self._log = JsonlLogger(span_log)
+        reg = self.registry
+        self._tenant_gauge = reg.gauge(
+            "edgemesh_pool_tenant_pages",
+            "Pool pages currently resident, attributed per tenant",
+            ("engine", "tenant"))
+        self._tenant_peak_gauge = reg.gauge(
+            "edgemesh_pool_tenant_peak_pages",
+            "Peak resident-page watermark per tenant",
+            ("engine", "tenant"))
+        self._events_total = reg.counter(
+            "edgemesh_pool_events_total",
+            "Attributed page-pool transitions, by cause",
+            ("engine", "cause"))
+        self._pages_total = reg.counter(
+            "edgemesh_pool_pages_moved_total",
+            "Pages moved through the ledger seam, by cause",
+            ("engine", "cause"))
+        self._breaks_total = reg.counter(
+            "edgemesh_pool_conservation_breaks_total",
+            "Conservation-invariant violations (allocated + free != total)",
+            ("engine",))
+        self._leaked_gauge = reg.gauge(
+            "edgemesh_pool_leaked_pages",
+            "Pages still resident past the leak age bound, owner retired",
+            ("engine",))
+        self._forecast_gauge = reg.gauge(
+            "edgemesh_pool_forecast_seconds",
+            "Exhaustion forecast: seconds until the free list empties at "
+            "the observed arrival rate × worst-case pages per request",
+            ("engine",))
+
+    # -- the transition seam -------------------------------------------------
+
+    def _label(self, tenant: str | None) -> str:
+        return bounded_label(tenant)
+
+    def on_reserve(self, n: int, rid=None, tenant: str | None = None,
+                   cause: str = "admit", free: int | None = None) -> None:
+        """``n`` pages left the free list for ``rid`` (cause: admit / cow /
+        import / export / template). ``free`` is the free-list length
+        AFTER the pop when the caller has it at hand — it makes the span
+        record self-contained for offline occupancy replay."""
+        if not self.enabled or n <= 0:
+            return
+        label = self._label(tenant)
+        with self._lock:
+            self._seen = True
+            h = self._holdings.get(rid)
+            if h is None:
+                h = self._holdings[rid] = _Holding(rid, label, cause)
+            h.pages += n
+            h.retired_at = None
+            self._resident += n
+            self._peak_resident = max(self._peak_resident, self._resident)
+            t = self._tenant_pages.get(h.tenant, 0) + n
+            self._tenant_pages[h.tenant] = t
+            self._tenant_peaks[h.tenant] = max(
+                self._tenant_peaks.get(h.tenant, 0), t)
+            resident = self._resident
+            if free is not None:
+                self._last_free = int(free)
+            cell = self._events.setdefault(cause, [0, 0])
+            cell[0] += 1
+            cell[1] += n
+        self._events_total.labels(engine=self.engine, cause=cause).inc()
+        self._pages_moved(cause, n)
+        self._tenant_gauge.labels(engine=self.engine, tenant=h.tenant).set(t)
+        self._tenant_peak_gauge.labels(
+            engine=self.engine, tenant=h.tenant).set(self._tenant_peaks[h.tenant])
+        self._emit(cause, n, rid, h.tenant, resident, free)
+
+    def on_commit(self, rid, committed_pages: int | None = None,
+                  add_tokens: int | None = None) -> None:
+        """Per-boundary commit: ``rid``'s row has actually written into its
+        private pages. ``add_tokens`` accumulates host-observed tokens
+        (admission suffix, then each drained segment's emit count) and the
+        ledger converts to pages; ``committed_pages`` sets an absolute
+        floor directly. Pure dict update — cheap enough for every drained
+        segment; the reserved-minus-committed remainder is the
+        internal-fragmentation number the digest splits out."""
+        if not self.enabled:
+            return
+        with self._lock:
+            h = self._holdings.get(rid)
+            if h is None:
+                return
+            if add_tokens is not None and self.page_size > 0:
+                h.committed_tokens += max(0, int(add_tokens))
+                committed_pages = -(-h.committed_tokens // self.page_size)
+            if committed_pages is not None:
+                h.committed = max(h.committed,
+                                  min(int(committed_pages), h.pages))
+
+    def on_free(self, n: int, rid=None, cause: str = "retire",
+                free: int | None = None) -> None:
+        """``n`` pages returned to the free list (cause: retire / abort /
+        export). The owner's holding drains; a holding that empties is
+        dropped (its leak clock never starts)."""
+        if not self.enabled or n <= 0:
+            return
+        with self._lock:
+            self._seen = True
+            h = self._holdings.get(rid)
+            label = h.tenant if h is not None else self._label(None)
+            if h is not None:
+                h.pages = max(0, h.pages - n)
+                h.committed = min(h.committed, h.pages)
+                if h.pages == 0:
+                    self._holdings.pop(rid, None)
+            self._resident = max(0, self._resident - n)
+            t = max(0, self._tenant_pages.get(label, 0) - n)
+            self._tenant_pages[label] = t
+            resident = self._resident
+            if free is not None:
+                self._last_free = int(free)
+            cell = self._events.setdefault(cause, [0, 0])
+            cell[0] += 1
+            cell[1] += n
+        self._events_total.labels(engine=self.engine, cause=cause).inc()
+        self._pages_moved(cause, n)
+        self._tenant_gauge.labels(engine=self.engine, tenant=label).set(t)
+        self._emit(cause, -n, rid, label, resident, free)
+
+    def on_retired(self, rid) -> None:
+        """The owning request retired. Pages still held start the leak
+        clock; a clean retirement (pages already freed) is a no-op."""
+        if not self.enabled:
+            return
+        with self._lock:
+            h = self._holdings.get(rid)
+            if h is not None and h.pages > 0 and h.retired_at is None:
+                h.retired_at = self._clock()
+
+    def on_reset(self, reason: str = "") -> None:
+        """The pool was rebuilt (failed segment/admission recovery, cap
+        regrow): every resident page returned at once. The books zero;
+        the event records how many pages the reset reclaimed."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._seen = True
+            reclaimed = self._resident
+            self._holdings.clear()
+            tenants = list(self._tenant_pages)
+            self._tenant_pages = {t: 0 for t in tenants}
+            self._resident = 0
+            self._resets += 1
+            self._last_free = None
+            cell = self._events.setdefault("reset", [0, 0])
+            cell[0] += 1
+            cell[1] += reclaimed
+        self._events_total.labels(engine=self.engine, cause="reset").inc()
+        self._pages_moved("reset", reclaimed)
+        for t in tenants:
+            self._tenant_gauge.labels(engine=self.engine, tenant=t).set(0)
+        self._emit("reset", -reclaimed, None, None, 0, None,
+                   extra={"reason": reason})
+
+    # -- derived findings ----------------------------------------------------
+
+    def check_conservation(self, free_pages: int) -> bool:
+        """The invariant, checked at quiesce: ``free + resident +
+        reserved_overhead == total``. A break increments the tripwire
+        counter and logs a ``pool_mem`` record carrying the discrepancy —
+        the ledger reports the broken books, it never rebalances them."""
+        if not self.enabled or self.total_pages <= 0:
+            return True
+        with self._lock:
+            if not self._seen:
+                return True
+            resident = self._resident
+            expected = self.total_pages - self.reserved_overhead
+            diff = (int(free_pages) + resident) - expected
+            self._last_free = int(free_pages)
+            if diff == 0:
+                return True
+            self._breaks += 1
+        self._breaks_total.labels(engine=self.engine).inc()
+        self._emit("conservation_break", diff, None, None, resident,
+                   int(free_pages),
+                   extra={"expected": expected,
+                          "total": self.total_pages})
+        return False
+
+    def leak_scan(self, now: float | None = None) -> list[dict]:
+        """Holdings whose owner retired and whose pages are still
+        resident. The age judgment (and the fire-once dedup) lives in the
+        anomaly monitor's ``pool_leak`` detector; the ledger reports
+        every candidate with its age and lets the monitor decide."""
+        if not self.enabled:
+            return []
+        t = self._clock() if now is None else now
+        leaks: list[dict] = []
+        with self._lock:
+            for h in self._holdings.values():
+                if h.retired_at is None or h.pages <= 0:
+                    continue
+                leaks.append({
+                    "rid": h.rid, "tenant": h.tenant, "pages": h.pages,
+                    "age_s": round(max(0.0, t - h.retired_at), 3),
+                    "cause": h.cause,
+                })
+        self._leaked_gauge.labels(engine=self.engine).set(
+            sum(rec["pages"] for rec in leaks))
+        if leaks and self._anomaly_source is not None:
+            try:
+                monitor = self._anomaly_source()
+            except Exception:
+                monitor = None
+            if monitor is not None:
+                for rec in leaks:
+                    fired = monitor.on_pool_leak(
+                        str(rec["rid"]), rec["age_s"],
+                        detail={"engine": self.engine, **rec})
+                    if fired:
+                        self._emit("leak", rec["pages"], rec["rid"],
+                                   rec["tenant"], None, None,
+                                   extra={"age_s": rec["age_s"]})
+        return leaks
+
+    # -- read side -----------------------------------------------------------
+
+    def forecast(self, free_pages: int,
+                 arrival_ewma_s: float | None) -> float | None:
+        """Seconds until the free list empties: each arriving request
+        reserves ``per_row_worst`` pages, requests arrive every
+        ``arrival_ewma_s`` seconds. None when either input is unknown —
+        the forecast never guesses (capacity-model convention)."""
+        if (not arrival_ewma_s or arrival_ewma_s <= 0
+                or self.per_row_worst <= 0):
+            return None
+        pages_per_s = self.per_row_worst / float(arrival_ewma_s)
+        return round(max(0, int(free_pages)) / pages_per_s, 3)
+
+    def _frag_locked(self) -> dict:
+        internal_by_cause: dict[str, int] = {}
+        internal = 0
+        for h in self._holdings.values():
+            over = max(0, h.pages - h.committed)
+            if over:
+                internal += over
+                internal_by_cause[h.cause] = (
+                    internal_by_cause.get(h.cause, 0) + over)
+        free = self._last_free
+        external = (
+            free % self.per_row_worst
+            if free is not None and self.per_row_worst > 0 else None
+        )
+        return {
+            "internal_pages": internal,
+            "internal_by_cause": internal_by_cause,
+            "external_pages": external,
+        }
+
+    def digest_mem(self, free_pages: int | None = None,
+                   arrival_ewma_s: float | None = None) -> dict | None:
+        """The load digest's ``mem`` block. None until the ledger has
+        seen a transition — pre-mem consumers (and old routers) see
+        exactly the digest they always did, and a dense-backend engine
+        (no pool) never grows the key."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            if not self._seen:
+                return None
+            if free_pages is not None:
+                self._last_free = int(free_pages)
+            free = self._last_free
+            resident = self._resident
+            committed = sum(h.committed for h in self._holdings.values())
+            tenants = {t: p for t, p in sorted(self._tenant_pages.items())
+                       if p > 0}
+            leak_pages = sum(h.pages for h in self._holdings.values()
+                             if h.retired_at is not None)
+            leak_reqs = sum(1 for h in self._holdings.values()
+                            if h.retired_at is not None and h.pages > 0)
+            frag = self._frag_locked()
+            breaks = self._breaks
+        fc = None if free is None else self.forecast(free, arrival_ewma_s)
+        if fc is not None:
+            self._forecast_gauge.labels(engine=self.engine).set(fc)
+        return {
+            "total_pages": self.total_pages or None,
+            "free_pages": free,
+            "resident_pages": resident,
+            "committed_pages": committed,
+            "per_row_worst": self.per_row_worst or None,
+            "tenants": tenants or None,
+            "frag": frag,
+            "leak": {"requests": leak_reqs, "pages": leak_pages},
+            "forecast_s": fc,
+            "drift": self._drift(resident),
+            "conservation_breaks": breaks,
+        }
+
+    def rollup(self) -> dict:
+        """Cumulative aggregate for ``stats()`` / BENCH JSON / ``edgemesh
+        obs mem`` on live state. Falsy ({}) before the first transition."""
+        with self._lock:
+            if not self._seen:
+                return {}
+            frag = self._frag_locked()
+            return {
+                "engine": self.engine,
+                "total_pages": self.total_pages or None,
+                "free_pages": self._last_free,
+                "resident_pages": self._resident,
+                "peak_resident_pages": self._peak_resident,
+                "events": {c: {"count": n, "pages": p}
+                           for c, (n, p) in sorted(self._events.items())},
+                "tenants": {
+                    t: {"pages": self._tenant_pages.get(t, 0),
+                        "peak_pages": pk}
+                    for t, pk in sorted(self._tenant_peaks.items())
+                },
+                "frag": frag,
+                "leaked_pages": sum(
+                    h.pages for h in self._holdings.values()
+                    if h.retired_at is not None),
+                "conservation_breaks": self._breaks,
+                "resets": self._resets,
+            }
+
+    # -- reconciliation ------------------------------------------------------
+
+    def _drift(self, resident: int) -> dict | None:
+        """Ledger-vs-HBM reconciliation: from a baseline captured at the
+        first probe, device bytes-in-use should move by exactly
+        ``delta_resident × page_bytes``. The residual IS the drift
+        number. None wherever the device withholds ``memory_stats``
+        (CPU) or the page size is unknown — reported, never guessed."""
+        if self.page_bytes <= 0:
+            return None
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            return None
+        if not isinstance(stats, dict):
+            return None
+        in_use = stats.get("bytes_in_use")
+        if not isinstance(in_use, (int, float)):
+            return None
+        with self._lock:
+            if self._hbm_base is None:
+                self._hbm_base = (float(in_use), int(resident))
+            base_bytes, base_resident = self._hbm_base
+        expected = base_bytes + (resident - base_resident) * self.page_bytes
+        return {
+            "hbm_bytes_in_use": int(in_use),
+            "expected_bytes": int(expected),
+            "drift_bytes": int(in_use - expected),
+            "page_bytes": self.page_bytes,
+        }
+
+    # -- sinks ---------------------------------------------------------------
+
+    def _pages_moved(self, cause: str, n: int) -> None:
+        if n > 0:
+            self._pages_total.labels(engine=self.engine, cause=cause).inc(n)
+
+    def _emit(self, cause: str, delta: int, rid, tenant: str | None,
+              resident: int | None, free: int | None,
+              extra: dict | None = None) -> None:
+        rec = {
+            "engine": self.engine,
+            "cause": cause,
+            "delta": int(delta),
+            "rid": rid,
+            "tenant": tenant,
+            "resident": resident,
+            "free": free,
+            "total": self.total_pages or None,
+        }
+        if extra:
+            rec.update(extra)
+        if self._log is not None:
+            self._log.log(POOL_RECORD_EVENT, **rec)
+        if self._flight_source is not None:
+            try:
+                fl = self._flight_source()
+                if fl is not None:
+                    fl.record(POOL_RECORD_EVENT, rec)
+            except Exception:  # flight is best-effort by contract
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Offline analysis (span logs → rollup) — `edgemesh obs mem`
+# ---------------------------------------------------------------------------
+
+
+def summarize_mem(records: Iterable[dict]) -> dict | None:
+    """Pool rollup from span-log records — the offline twin of
+    :meth:`PoolLedger.rollup`, consumed by ``edgemesh obs mem`` and the
+    ``mem`` block of ``edgemesh obs summary``.
+
+    Returns None when the log carries no ``pool_mem`` records at all: a
+    pre-mem log is an answer, not an error (the CLI prints null and
+    exits 0). Unknown keys on future records are ignored and
+    known-but-missing keys read as None — both compatibility directions
+    are pinned in tests/test_memory.py.
+    """
+    n = 0
+    events: dict[str, list[int]] = {}
+    tenant_pages: dict[str, int] = {}
+    tenant_peaks: dict[str, int] = {}
+    engines: set[str] = set()
+    peak_resident = 0
+    last_resident = None
+    last_free = None
+    total = None
+    breaks = 0
+    leaks: list[dict] = []
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("event") != POOL_RECORD_EVENT:
+            continue
+        n += 1
+        cause = str(rec.get("cause") or "?")
+        delta = rec.get("delta")
+        delta = int(delta) if isinstance(delta, int) else 0
+        if rec.get("engine") is not None:
+            engines.add(str(rec["engine"]))
+        if cause == "conservation_break":
+            breaks += 1
+            continue
+        if cause == "leak":
+            leaks.append({"rid": rec.get("rid"),
+                          "tenant": rec.get("tenant"),
+                          "pages": abs(delta),
+                          "age_s": rec.get("age_s")})
+            continue
+        cell = events.setdefault(cause, [0, 0])
+        cell[0] += 1
+        cell[1] += abs(delta)
+        tenant = rec.get("tenant")
+        if cause == "reset":
+            tenant_pages = {t: 0 for t in tenant_pages}
+        elif tenant is not None:
+            t = str(tenant)
+            cur = max(0, tenant_pages.get(t, 0) + delta)
+            tenant_pages[t] = cur
+            tenant_peaks[t] = max(tenant_peaks.get(t, 0), cur)
+        if isinstance(rec.get("resident"), int):
+            last_resident = rec["resident"]
+            peak_resident = max(peak_resident, last_resident)
+        if isinstance(rec.get("free"), int):
+            last_free = rec["free"]
+        if isinstance(rec.get("total"), int):
+            total = rec["total"]
+    if n == 0:
+        return None
+    return {
+        "pool_records": n,
+        "engines": sorted(engines),
+        "total_pages": total,
+        "peak_resident_pages": peak_resident,
+        "last_resident_pages": last_resident,
+        "last_free_pages": last_free,
+        "events": {c: {"count": cnt, "pages": pages}
+                   for c, (cnt, pages) in sorted(events.items())},
+        "tenants": {
+            t: {"pages": tenant_pages.get(t, 0), "peak_pages": pk}
+            for t, pk in sorted(tenant_peaks.items())
+        } or None,
+        "conservation_breaks": breaks,
+        "leaks": leaks or None,
+    }
+
+
+def diff_mem(a: dict | None, b: dict | None) -> dict:
+    """Side-by-side comparison of two :func:`summarize_mem` results
+    (``edgemesh obs mem A --diff B``): peak residency, per-tenant peaks,
+    per-cause page volume, and the tripwire counters. A tenant or cause
+    present on only one side still gets a row — residency appearing or
+    vanishing between two runs IS the finding."""
+    def cell(side: dict | None, *path):
+        cur: Any = side or {}
+        for key in path:
+            if not isinstance(cur, dict):
+                return None
+            cur = cur.get(key)
+        return cur
+
+    tenants = sorted(set((cell(a, "tenants") or {}))
+                     | set((cell(b, "tenants") or {})))
+    causes = sorted(set((cell(a, "events") or {}))
+                    | set((cell(b, "events") or {})))
+    ap, bp = cell(a, "peak_resident_pages"), cell(b, "peak_resident_pages")
+    return {
+        "a_peak_resident_pages": ap,
+        "b_peak_resident_pages": bp,
+        "peak_ratio": (round(bp / ap, 4) if ap and bp else None),
+        "tenants": {
+            t: {"a_peak_pages": cell(a, "tenants", t, "peak_pages"),
+                "b_peak_pages": cell(b, "tenants", t, "peak_pages")}
+            for t in tenants
+        },
+        "events": {
+            c: {"a_pages": cell(a, "events", c, "pages"),
+                "b_pages": cell(b, "events", c, "pages")}
+            for c in causes
+        },
+        "a_conservation_breaks": cell(a, "conservation_breaks"),
+        "b_conservation_breaks": cell(b, "conservation_breaks"),
+        "a_leaks": cell(a, "leaks"),
+        "b_leaks": cell(b, "leaks"),
+    }
+
+
+def replay_pool_record(registry: Registry, rec: dict,
+                       state: dict | None = None) -> dict:
+    """Replay one ``pool_mem`` record into registry families — the seam
+    ``obs/spans.replay_spans`` routes pool records through, so ``edgemesh
+    obs summary``/``prom`` rebuild the same pool families a live scrape
+    serves. ``state`` threads per-tenant residency between calls (the
+    caller owns it; pass the returned dict back in)."""
+    state = state if state is not None else {}
+    engine = str(rec.get("engine") or "continuous")
+    cause = str(rec.get("cause") or "?")
+    registry.counter(
+        "edgemesh_pool_events_total",
+        "Attributed page-pool transitions, by cause",
+        ("engine", "cause")).labels(engine=engine, cause=cause).inc()
+    if cause == "conservation_break":
+        registry.counter(
+            "edgemesh_pool_conservation_breaks_total",
+            "Conservation-invariant violations (allocated + free != total)",
+            ("engine",)).labels(engine=engine).inc()
+        return state
+    delta = rec.get("delta")
+    tenant = rec.get("tenant")
+    if isinstance(delta, int) and tenant is not None and cause != "leak":
+        # Records carry the already-bounded tenant, but a hand-edited or
+        # foreign log must not mint unbounded label values on replay.
+        label = bounded_label(str(tenant))
+        key = (engine, label)
+        cur = max(0, state.get(key, 0) + delta)
+        state[key] = cur
+        registry.gauge(
+            "edgemesh_pool_tenant_pages",
+            "Pool pages currently resident, attributed per tenant",
+            ("engine", "tenant")).labels(engine=engine,
+                                         tenant=label).set(cur)
+    return state

@@ -409,6 +409,7 @@ def replay_spans(records: Iterable[dict] | str | Path,
     registry = registry if registry is not None else Registry()
     trackers: dict[str, SpanTracker] = {}
     launch_cum: dict[tuple[str, str], int] = {}
+    pool_state: dict = {}
     if isinstance(records, (str, Path)):
         from edgemesh.utils.tracing import JsonlLogger
 
@@ -458,6 +459,16 @@ def replay_spans(records: Iterable[dict] | str | Path,
                     ("engine", "boundary"),
                 ).labels(engine=engine, boundary=boundary).set(
                     float(rec["roofline_fraction"]))
+            continue
+        if event == "pool_mem":
+            # Page-lifecycle records (obs/memory.py) replay into the pool
+            # families a live scrape serves — event counters, the
+            # conservation tripwire, per-tenant residency gauges. Deferred
+            # import: memory imports bounded_label from metrics only, but
+            # the lazy pattern keeps this module jax-free-cheap to load.
+            from edgemesh.obs.memory import replay_pool_record
+
+            pool_state = replay_pool_record(registry, rec, pool_state)
             continue
         if event != SPAN_RECORD_EVENT:
             continue

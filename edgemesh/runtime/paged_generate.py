@@ -46,6 +46,7 @@ from edgemesh.runtime.paged_kv import (
     allocate,
     init_paged_cache,
     init_quant_paged_cache,
+    page_nbytes,
     pages_needed,
     write_tokens,
     write_tokens_quant,
@@ -951,8 +952,9 @@ def generate_paged(
         )))
         if want > free:
             raise ValueError(
-                f"page pool exhausted: need {want} pages, {free} free — "
-                "size total_pages for prompt+max_new across the batch"
+                f"page pool exhausted: need {want} pages, {free} free "
+                f"({page_nbytes(cache)} bytes/page) — size total_pages "
+                "for prompt+max_new across the batch"
             )
 
     return generate(

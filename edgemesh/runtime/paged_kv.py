@@ -140,6 +140,26 @@ def init_quant_paged_cache(
     )
 
 
+def page_nbytes(cache) -> int:
+    """Device bytes ONE pool page occupies across every pool-shaped array
+    in the cache — k/v (and the quant pools' scale planes), summed over
+    layers. This is the price the memory observatory (obs/memory.py) uses
+    to reconcile its page ledger against the device's own ``memory_stats``
+    bytes-in-use, so ledger-vs-HBM drift is a reported number.
+
+    Works on any paged cache NamedTuple: a field counts as pool-shaped
+    when its second axis is the pool axis (``k.shape[1]`` pages);
+    per-row bookkeeping (tables, lengths, free stack) is excluded.
+    """
+    total_pages = int(cache.k.shape[1])
+    nbytes = 0
+    for arr in cache:
+        shape = getattr(arr, "shape", ())
+        if len(shape) >= 4 and int(shape[1]) == total_pages:
+            nbytes += (int(arr.size) // total_pages) * int(arr.dtype.itemsize)
+    return nbytes
+
+
 def pool_overflowed(cache: PagedKVCache) -> bool:
     """Host-side overflow check: True if any allocate() ran past the free
     stack. Those rows were handed the trash page — their KV beyond the

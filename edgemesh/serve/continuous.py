@@ -77,6 +77,7 @@ import numpy as np
 from edgemesh.models.transformer import KVCache, forward_decode, forward_prefill, init_kv_cache
 from edgemesh.obs import RequestTrace, SpanTracker
 from edgemesh.obs.compute import ComputeLedger, SpecRoundLedger, spec_draft_frac
+from edgemesh.obs.memory import SYSTEM_TENANT, TEMPLATE_RID, PoolLedger
 from edgemesh.obs.trace import (
     TraceContext,
     install_compile_hook,
@@ -98,6 +99,7 @@ from edgemesh.runtime.paged_kv import (
     export_pages,
     init_paged_cache,
     init_quant_paged_cache,
+    page_nbytes,
     splice_imported,
 )
 from edgemesh.utils.bucketing import POW2_FLOOR, bucket_pow2
@@ -588,6 +590,23 @@ class ContinuousEngine:
         self._ck_decode = f"b{self.n_slots}c{self.chunk}"
         if tp_engine is not None:
             tp_engine.instrument(self.compute)
+        # The memory observatory (obs/memory.py): every page-pool
+        # transition flows through the _pop_pages/_push_pages seam (plus
+        # the template/reset notifications) into an attributed ledger —
+        # per-tenant residency, internal/external fragmentation, the
+        # conservation tripwire checked at quiesce, leak detection (the
+        # pool_leak anomaly kind), and the exhaustion forecast the
+        # admission controller and autoscaler consume from the digest's
+        # mem block. EDGEMESH_MEM_LEDGER=0 turns the whole seam off.
+        self.mem = PoolLedger(
+            registry=self.obs.registry, engine=self.obs_engine_label,
+            total_pages=self.total_pages if self._paged else 0,
+            page_size=self.page_size if self._paged else 0,
+            per_row_worst=self._per_row_worst if self._paged else 0,
+            page_bytes=page_nbytes(self._cache) if self._paged else 0,
+            span_log=span_log, flight_source=lambda: self.obs.flight,
+            anomaly_source=lambda: self.obs.anomaly,
+        )
         self._pages_gauge = self.obs.registry.gauge(
             "edgemesh_kv_pages", "Paged KV pool occupancy by state",
             ("engine", "state"),
@@ -820,6 +839,8 @@ class ContinuousEngine:
             # Live per-boundary ledger rollup (obs/compute.py); None when
             # the ledger is disabled or nothing launched yet.
             out["compute"] = self.compute.rollup() or None
+            # Memory-observatory rollup (obs/memory.py), same contract.
+            out["mem"] = self.mem.rollup() or None
             return out
 
     def load_digest(self) -> dict[str, Any]:
@@ -831,9 +852,11 @@ class ContinuousEngine:
         the device; the slot ``remaining`` reads below are advisory
         glances at worker-owned ints (GIL-atomic), not synchronization."""
         pool = None
+        free_n = None
         with self._cond:
             queue_depth = len(self._queue)
             if self._paged:
+                free_n = len(self._free_pages)
                 pending = sum(
                     max(0, s.remaining) for s in self._slots if s.active
                 )
@@ -859,6 +882,14 @@ class ContinuousEngine:
         # the ledger has fenced something — a pre-compute consumer (or an
         # old router) sees exactly the digest it always did.
         digest["costs"] = self.compute.digest_costs()
+        # The memory observatory's digest block (obs/memory.py): per-tenant
+        # residency, fragmentation split, leak/forecast rows, HBM drift.
+        # None until the ledger has seen a transition — a pre-mem consumer
+        # (or an old router) sees exactly the digest it always did.
+        digest["mem"] = self.mem.digest_mem(
+            free_pages=free_n,
+            arrival_ewma_s=digest.get("ewma_arrival_s"),
+        )
         eng = self.obs_engine_label
         if cap["est_tok_s"] is not None:
             self._capacity_gauge.labels(engine=eng).set(cap["est_tok_s"])
@@ -887,18 +918,27 @@ class ContinuousEngine:
 
     # -- host-owned page accounting -----------------------------------------
 
-    def _pop_pages(self, n: int) -> list[int]:
-        # Under the engine lock so the (free list, reserved count) pair
-        # mutates atomically with respect to a concurrent stats() snapshot.
+    def _pop_pages(self, n: int, rid=None, tenant: str | None = None,
+                   cause: str = "admit") -> list[int]:
+        # Under the engine lock so the (free list, reserved count, ledger)
+        # triple mutates atomically with respect to a concurrent stats()
+        # snapshot. This is THE page-lifecycle seam (edgelint EM115): the
+        # attributed transition lands in the memory observatory beside the
+        # existing counters, never as a side channel.
         with self._cond:
             taken = [self._free_pages.pop() for _ in range(n)]
             self._reserved_pages += n
+            self.mem.on_reserve(n, rid=rid, tenant=tenant, cause=cause,
+                                free=len(self._free_pages))
         return taken
 
-    def _push_pages(self, pages: list[int]) -> None:
+    def _push_pages(self, pages: list[int], rid=None,
+                    cause: str = "retire") -> None:
         with self._cond:
             self._free_pages.extend(pages)
             self._reserved_pages -= len(pages)
+            self.mem.on_free(len(pages), rid=rid, cause=cause,
+                             free=len(self._free_pages))
 
     def _build_row_table(self, shared: list[int], private: list[int]) -> np.ndarray:
         """Pre-mapped table row: shared (template) pages first, then the
@@ -1065,7 +1105,13 @@ class ContinuousEngine:
             shared_full = match // self.page_size  # read-only shared pages
             if need > len(self._free_pages):
                 return False  # capacity — re-queue, admit at a later boundary
-            pages = self._pop_pages(need)
+            pages = self._pop_pages(need, rid=trace.rid, tenant=trace.tenant,
+                                    cause="cow" if match else "admit")
+            # Tokens landing in PRIVATE pages (the suffix plus the COW
+            # boundary page's shared tail) — the ledger's committed floor;
+            # reserved-minus-committed is the internal-fragmentation split.
+            self.mem.on_commit(
+                trace.rid, add_tokens=plen - shared_full * self.page_size)
             # Zero-copy KV admission: prefill through a one-row VIEW of the
             # shared pool (the host-built pre-mapped table + shared pages,
             # donated). Only the slot's own page-table/length entries change
@@ -1161,7 +1207,10 @@ class ContinuousEngine:
         shared_full = match // self.page_size
         if need > len(self._free_pages):
             return False  # capacity — re-queue, admit at a later boundary
-        pages = self._pop_pages(need)
+        pages = self._pop_pages(need, rid=trace.rid, tenant=trace.tenant,
+                                cause="cow" if match else "admit")
+        self.mem.on_commit(
+            trace.rid, add_tokens=plen - shared_full * self.page_size)
         try:
             shared = list(self._template_pages[:shared_full]) if match else []
             private = list(pages)
@@ -1251,7 +1300,9 @@ class ContinuousEngine:
             )
         if need > len(self._free_pages):
             return False  # capacity — re-queue, admit at a later boundary
-        pages = self._pop_pages(need)
+        pages = self._pop_pages(need, rid=trace.rid, tenant=trace.tenant,
+                                cause="import")
+        self.mem.on_commit(trace.rid, add_tokens=plen)
         n_imp = -(-match // self.page_size) if match else 0
         try:
             if n_imp:
@@ -1404,7 +1455,9 @@ class ContinuousEngine:
                     f"{free_now + reserved} beyond the template"
                 )
             return False  # capacity — retirements will free pages
-        pages = self._pop_pages(n_pages)
+        pages = self._pop_pages(
+            n_pages, rid=job.trace.rid if job.trace is not None else None,
+            tenant=SYSTEM_TENANT, cause="export")
         try:
             row_table = self._build_row_table([], pages)
             row_view = self._cache._replace(
@@ -1427,7 +1480,9 @@ class ContinuousEngine:
                 RuntimeError("page pool reset after a failed export prefill")
             )
             raise
-        self._push_pages(pages)
+        self._push_pages(
+            pages, rid=job.trace.rid if job.trace is not None else None,
+            cause="export")
         result = {"kv_bytes": buf, "tokens": n, "prompt_tokens": plen}
         self._export_cache[job.question] = result
         while len(self._export_cache) > self._export_cache_max:
@@ -1566,6 +1621,11 @@ class ContinuousEngine:
             )
             with self._cond:
                 self._cache, self._free_pages = cache, free
+            # The regrown pool re-prices the books: a fresh total (the
+            # conservation target) and a fresh page size in bytes. Runs
+            # before any admission, so no holdings need migrating.
+            self.mem.total_pages = self.total_pages
+            self.mem.page_bytes = page_nbytes(self._cache)
         # A user-sized pool must still be able to SERVE after the template
         # moves in permanently — including a max-context COLD request (no
         # template match gets no page discount). Otherwise sharing is a net
@@ -1579,6 +1639,15 @@ class ContinuousEngine:
             return
         with self._cond:
             tpl_pages = [self._free_pages.pop() for _ in range(n_pages)]
+            # Permanent pages the engine itself holds: attributed to the
+            # system tenant under the template's reserved rid, fully
+            # committed (the prefix KV fills every slot it maps). Direct
+            # pop (not _pop_pages): template pages are template state,
+            # not _reserved_pages — but the ledger still sees them.
+            self.mem.on_reserve(n_pages, rid=TEMPLATE_RID,
+                                tenant=SYSTEM_TENANT, cause="template",
+                                free=len(self._free_pages))
+            self.mem.on_commit(TEMPLATE_RID, committed_pages=n_pages)
         row_view = self._cache._replace(
             page_table=jnp.asarray(
                 self._build_row_table(tpl_pages, []))[None, :],
@@ -1666,6 +1735,9 @@ class ContinuousEngine:
                 # next admission (the capacity bump is one-time, survives).
                 self._template_ids = None
                 self._template_pages = []
+                # Every resident page returned at once — the ledger's
+                # books zero with the pool, recorded as one reset event.
+                self.mem.on_reset(str(exc))
             if self._ragged:
                 # Staged admissions' table rows died with the pool; their
                 # futures were failed above (the slots were active).
@@ -1696,7 +1768,12 @@ class ContinuousEngine:
             }
         )
         if self._paged:
-            self._push_pages(slot.pages)
+            rid = slot.trace.rid if slot.trace is not None else None
+            self._push_pages(slot.pages, rid=rid, cause="retire")
+            # Start the leak clock: a holding that still has pages after
+            # its owner retired is exactly what the pool_leak tripwire
+            # hunts (a clean retirement just dropped the holding above).
+            self.mem.on_retired(rid)
             self._park_slot_device(idx)
             self._update_page_gauges()
         self._slots[idx] = _Slot()
@@ -1809,6 +1886,10 @@ class ContinuousEngine:
                 toks = toks[:-1]
             slot.emitted.extend(toks)
             slot.remaining -= n
+            if self._paged and slot.trace is not None:
+                # Per-boundary commit: the row advanced n tokens into its
+                # private pages (internal-fragmentation bookkeeping).
+                self.mem.on_commit(slot.trace.rid, add_tokens=n)
             # tp serving: each decode span carries its slice of the wire
             # (tokens x per-row collective bytes) so `edgemesh obs trace`
             # can roll the savings up per request (obs/trace.critical_path).
@@ -1835,6 +1916,14 @@ class ContinuousEngine:
                 ):
                     if self._closed:
                         return
+                    if self._paged:
+                        # Quiesce: no queue, no active slot, no in-flight
+                        # segment — every page must be home. The tripwire
+                        # counter (not an exception) records a break;
+                        # pages whose owner retired long ago fire the
+                        # pool_leak anomaly (fleet-wide flight dump).
+                        self.mem.check_conservation(len(self._free_pages))
+                        self.mem.leak_scan()
                     self._cond.wait()
                 exports: list[_ExportJob] = []
                 if self._paged and self._exports:
@@ -2066,6 +2155,20 @@ class SpeculativeContinuousEngine(ContinuousEngine):
                 self._init_dpool, self.n_slots, self._d_total
             )
             self._dslot_pages: dict[int, list[int]] = {}
+            # The draft pool keeps its own books (obs/memory.py): separate
+            # conservation target, separate per-tenant attribution, under
+            # a distinct engine label. No span log — the target ledger's
+            # records already carry the request lifecycle; the draft twin
+            # exists so draft-pool leaks and occupancy are visible.
+            self.dmem = PoolLedger(
+                registry=self.obs.registry,
+                engine=self.obs_engine_label + "_draft",
+                total_pages=self._d_total, page_size=self.page_size,
+                per_row_worst=self._per_row_worst,
+                page_bytes=page_nbytes(self._dcache),
+                flight_source=lambda: self.obs.flight,
+                anomaly_source=lambda: self.obs.anomaly,
+            )
             # The speculative round ledger (obs/compute.py): segment-level
             # counter deltas + the compute ledger's sampled launch timings,
             # split draft-vs-verify by the analytic flops ratio of gamma
@@ -2209,8 +2312,13 @@ class SpeculativeContinuousEngine(ContinuousEngine):
         if need > len(self._free_pages) or need > len(self._dfree):
             return False  # capacity — re-queue, admit at a later boundary
 
-        pages = self._pop_pages(need)
+        pages = self._pop_pages(need, rid=trace.rid, tenant=trace.tenant,
+                                cause="admit")
+        self.mem.on_commit(trace.rid, add_tokens=plen)
         dpages = [self._dfree.pop() for _ in range(need)]
+        self.dmem.on_reserve(need, rid=trace.rid, tenant=trace.tenant,
+                             cause="admit", free=len(self._dfree))
+        self.dmem.on_commit(trace.rid, add_tokens=plen)
         row_table = self._build_row_table([], pages)
         drow_table = self._build_row_table([], dpages)
         try:
@@ -2337,14 +2445,25 @@ class SpeculativeContinuousEngine(ContinuousEngine):
                 toks = toks[:-1]
             slot.emitted.extend(toks)
             self.obs.tokens(slot.trace, len(toks))
+            if slot.trace is not None:
+                # Both pools advanced by the segment's accepted tokens.
+                adv = max(0, total - slot.taken)
+                self.mem.on_commit(slot.trace.rid, add_tokens=adv)
+                self.dmem.on_commit(slot.trace.rid, add_tokens=adv)
             slot.taken = total
             slot.remaining = self.max_new - total
             if bool(fin_h[i]) or total >= self.max_new:
                 self._retire(i)
 
     def _retire(self, idx: int) -> None:
+        slot = self._slots[idx]
+        rid = slot.trace.rid if slot.trace is not None else None
         super()._retire(idx)
-        self._dfree.extend(self._dslot_pages.pop(idx, []))
+        dp = self._dslot_pages.pop(idx, [])
+        self._dfree.extend(dp)
+        self.dmem.on_free(len(dp), rid=rid, cause="retire",
+                          free=len(self._dfree))
+        self.dmem.on_retired(rid)
         self._dcache = self._dcache._replace(
             page_table=self._dcache.page_table.at[idx].set(0),
             lengths=self._dcache.lengths.at[idx].set(1),
@@ -2360,6 +2479,7 @@ class SpeculativeContinuousEngine(ContinuousEngine):
                 self._init_dpool, self.n_slots, self._d_total
             )
             self._dslot_pages = {}
+            self.dmem.on_reset(str(exc))
             self._spec_reset_arrays()
             self._update_page_gauges()
 

@@ -213,7 +213,11 @@ def _make_handler(ensemble, supervisor=None, batcher=None, registry=None,
                 # OBSERVABILITY.md "The capacity model"): the autoscaler's
                 # demand/supply signals. Null on non-continuous gateways.
                 "ewma_arrival_s": None,
-                "capacity": None, "pool": None,
+                # capacity/pool/mem: the capacity model, the coarse pool
+                # gauges, and the memory observatory's attributed block
+                # (obs/memory.py — tenants, fragmentation, leak rows, the
+                # exhaustion forecast). Null on non-paged gateways.
+                "capacity": None, "pool": None, "mem": None,
                 "slo_goodput_ratio": None,
             }
             if batcher is not None and hasattr(batcher, "load_digest"):

@@ -668,6 +668,93 @@ def test_em114_shipped_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# EM115: page-pool mutation outside the PoolLedger seam
+# ---------------------------------------------------------------------------
+
+
+_EM115_SRC = (
+    "class Engine:\n"
+    "    def steal(self):\n"
+    "        return self._free_pages.pop()\n"
+    "\n"
+    "    def rebuild(self):\n"
+    "        self._dfree = list(range(8))\n"
+)
+
+
+def test_em115_fires_on_unledgered_pool_mutation():
+    for path in ("edgemesh/serve/engine2.py", "edgemesh/runtime/gen2.py"):
+        findings = [f for f in lint_source(_EM115_SRC, path=path)
+                    if f.rule == "EM115"]
+        # Both the mutator call and the wholesale reassignment flag.
+        assert len(findings) == 2, path
+        assert all(f.severity == "error" for f in findings)
+        assert "_pop_pages" in findings[0].message
+
+
+def test_em115_seam_functions_are_exempt():
+    # The seam itself (references .mem/.dmem), callers routing through
+    # _pop_pages/_push_pages, and ledger construction all stay legal.
+    seam = (
+        "class Engine:\n"
+        "    def _pop_pages(self, n):\n"
+        "        taken = [self._free_pages.pop() for _ in range(n)]\n"
+        "        self.mem.on_reserve(n)\n"
+        "        return taken\n"
+        "\n"
+        "    def _retire(self, slot):\n"
+        "        self._dfree.extend(slot.pages)\n"
+        "        self.dmem.on_free(len(slot.pages))\n"
+        "\n"
+        "    def _admit(self, need):\n"
+        "        return self._pop_pages(need)\n"
+        "\n"
+        "    def boot(self):\n"
+        "        self._free_pages = list(range(1, 64))\n"
+        "        self.mem = PoolLedger(total_pages=64)\n"
+    )
+    assert [f for f in lint_source(seam, path="edgemesh/serve/x.py")
+            if f.rule == "EM115"] == []
+
+
+def test_em115_quiet_outside_scope_and_for_other_lists():
+    assert [f for f in lint_source(_EM115_SRC, path="edgemesh/obs/x.py")
+            if f.rule == "EM115"] == []
+    assert [f for f in lint_source(_EM115_SRC, path="tests/test_x.py")
+            if f.rule == "EM115"] == []
+    other = (
+        "def drain(q):\n"
+        "    q.pending.pop()\n"
+        "    q.slots = []\n"
+    )
+    assert [f for f in lint_source(other, path="edgemesh/serve/x.py")
+            if f.rule == "EM115"] == []
+
+
+def test_em115_inline_disable_suppresses():
+    quiet = _EM115_SRC.replace(
+        "        return self._free_pages.pop()",
+        "        return self._free_pages.pop()  # edgelint: disable=EM115",
+    ).replace(
+        "        self._dfree = list(range(8))",
+        "        self._dfree = list(range(8))  # edgelint: disable=EM115",
+    )
+    assert [f for f in lint_source(quiet, path="edgemesh/serve/x.py")
+            if f.rule == "EM115"] == []
+
+
+def test_em115_shipped_tree_is_clean():
+    # Every pool transition in serve//runtime/ reports to the PoolLedger —
+    # the conservation invariant has no blind spots in the shipped engine.
+    from pathlib import Path
+
+    from edgemesh.analysis.edgelint import lint_paths
+
+    pkg = Path(__file__).resolve().parent.parent / "edgemesh"
+    assert [f for f in lint_paths([pkg]) if f.rule == "EM115"] == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression + baseline mechanics
 # ---------------------------------------------------------------------------
 

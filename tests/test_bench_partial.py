@@ -702,6 +702,154 @@ def test_compute_ledger_keys_ride_bench_json(monkeypatch, capsys):
     assert "ledger_overhead_ratio" in lines[-1]
 
 
+def test_mem_ledger_keys_ride_bench_json(monkeypatch, capsys):
+    """The memory-observatory schema contract: the serving stage carries
+    the pool-ledger rollup (`serving_mem`), router_overhead the mem-ledger
+    on/off arm (`mem_ledger_overhead_ratio` <= 1.02 — the PERFORMANCE.md
+    gate), load_curve the per-point pool snapshots + forecast-at-knee, and
+    disagg the per-replica rollups. Faked stages: the schema must survive
+    a partial artifact and vanish under the existing env skip-gates."""
+    _fake_stage1(monkeypatch)
+
+    mem_block = {
+        "engine": "continuous", "total_pages": 64, "free_pages": 40,
+        "resident_pages": 23, "peak_resident_pages": 31,
+        "events": {"admit": {"count": 9, "pages": 27}},
+        "tenants": {"default": {"pages": 20, "peak_pages": 28}},
+        "frag": {"internal_pages": 3, "internal_by_cause": {"admit": 3},
+                 "external_pages": 1},
+        "leaked_pages": 0, "conservation_breaks": 0, "resets": 0,
+    }
+    mem_points = [
+        {"requested_rps": 2.0, "min_forecast_s": 44.0,
+         "peak_resident_pages": 30},
+        {"requested_rps": 4.0, "min_forecast_s": 6.5,
+         "peak_resident_pages": 55},
+    ]
+
+    def fake_serving(preset, *a, built=None, kv_backend="paged", ragged=None,
+                     **kw):
+        value = 900.0 if ragged is None else 700.0
+        return {"metric": "serving", "value": value, "wave_tok_s": [value],
+                "spread_pct": 1.0, "req_s": 2.0, "generated": 100,
+                "latency_s_p50": 0.5, "latency_s_p95": 0.9,
+                "stats": {"segments": 9, "max_concurrent": 8,
+                          "ragged_boundaries": 9,
+                          "ragged_prefill_tokens": 300,
+                          "ragged_decode_tokens": 60},
+                "obs": {}, "compute": None, "mem": mem_block}
+
+    def fake_ablation(preset, built=None, **kw):
+        out = {}
+        for shape in ("decode_heavy", "prefill_heavy", "mixed_50_50"):
+            out[f"serving_ragged_{shape}_tok_s"] = 900.0
+            out[f"serving_segmented_{shape}_tok_s"] = 700.0
+            out[f"ragged_over_segmented_{shape}"] = 1.286
+        return out
+
+    def fake_overhead(**kw):
+        return {"metric": "router_overhead_p50_s", "value": 0.0021,
+                "unit": "s", "n_requests": 40,
+                "direct_p50_s": 0.010, "direct_p99_s": 0.015,
+                "routed_p50_s": 0.0121, "routed_p99_s": 0.018,
+                "overhead_p99_s": 0.003,
+                "traced_p50_s": 0.013, "traced_p99_s": 0.019,
+                "tracing_overhead_p50_s": 0.0009,
+                "tracing_overhead_p99_s": 0.001,
+                "recorder_p50_s": 0.01215, "recorder_p99_s": 0.0181,
+                "recorder_overhead_p50_s": 0.00005,
+                "recorder_overhead_p99_s": 0.0001,
+                "recorder_ring_records": 41,
+                "ledgeroff_p50_s": 0.0120,
+                "ledger_overhead_p50_s": 0.0001,
+                "ledger_overhead_ratio": 1.0083,
+                "memledgeroff_p50_s": 0.01205,
+                "mem_ledger_overhead_p50_s": 0.00005,
+                "mem_ledger_overhead_ratio": 1.0041,
+                "compute": None, "mem": mem_block,
+                "sample_trace": None, "obs": {}}
+
+    def fake_adaptive(**kw):
+        return {"metric": "adaptive_over_least_outstanding_p99",
+                "value": 1.4, "unit": "x", "slo_target_s": 0.25}
+
+    def fake_load_curve(**kw):
+        return {"metric": "load_curve_knee_rps", "value": 4.0,
+                "unit": "req/s", "knee_goodput_rps": 3.6, "collapsed": False,
+                "slo_latency_s": 0.5, "estimated_capacity_rps": 4.2,
+                "points": [], "mem_points": mem_points,
+                "mem_forecast_at_knee_s": 6.5,
+                "mem_peak_resident_pages": 55}
+
+    def fake_disagg(**kw):
+        return {"metric": "disagg_ttft_p99_ratio", "value": 1.3, "unit": "x",
+                "kv_transfer_bytes": 4096,
+                "homogeneous_chat_p99_s": 0.9, "tiered_chat_p99_s": 0.7,
+                "homogeneous_goodput_ratio": 0.95,
+                "tiered_goodput_ratio": 0.97,
+                "homogeneous_tenants": {}, "tiered_tenants": {},
+                "tiered_outcomes": {}, "slo_latency_s": 0.5,
+                "prefill_threshold_chars": 250, "tiers": None,
+                "mem": {"replica-0": mem_block}}
+
+    monkeypatch.setattr(benchmarks, "serving_benchmark", fake_serving)
+    monkeypatch.setattr(benchmarks, "ragged_ablation_benchmark",
+                        fake_ablation)
+    monkeypatch.setattr(benchmarks, "router_overhead_benchmark",
+                        fake_overhead)
+    monkeypatch.setattr(benchmarks, "adaptive_router_benchmark",
+                        fake_adaptive)
+    monkeypatch.setattr(benchmarks, "load_curve_benchmark", fake_load_curve)
+    monkeypatch.setattr(benchmarks, "disagg_benchmark", fake_disagg)
+    monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_ADMIT", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
+
+    out = benchmarks.headline_benchmark(preset="llama1b", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    # Serving stage: the pool rollup rides the artifact.
+    assert out["serving_mem"] == mem_block
+    assert out["serving_mem"]["peak_resident_pages"] == 31
+    # Router-overhead stage: the mem-ledger arm + the <=1.02 gate,
+    # checkable from the artifact alone.
+    assert out["memledgeroff_p50_s"] == 0.01205
+    assert out["mem_ledger_overhead_ratio"] == 1.0041
+    assert out["mem_ledger_overhead_ratio"] <= 1.02
+    # Load-curve stage: per-point snapshots + the knee forecast.
+    assert out["load_curve_mem_points"] == mem_points
+    assert out["load_curve_mem_forecast_at_knee_s"] == 6.5
+    assert out["load_curve_mem_peak_resident_pages"] == 55
+    # Disagg stage: per-replica rollups.
+    assert out["disagg_mem"]["replica-0"] == mem_block
+    lines = [json.loads(l)
+             for l in capsys.readouterr().out.strip().splitlines()]
+    assert "serving_mem" in lines[-1]
+    assert "mem_ledger_overhead_ratio" in lines[-1]
+    assert "load_curve_mem_forecast_at_knee_s" in lines[-1]
+    assert "disagg_mem" in lines[-1]
+
+
+def test_mem_ledger_keys_honor_stage_skip_gates(monkeypatch):
+    """With the serving/fleet/loadgen/disagg stages env-gated off, none of
+    the memory-observatory keys appear — the same no-keys-no-error
+    contract every other skippable stage pins."""
+    _fake_stage1(monkeypatch)
+    for gate in _TP8_GATES:
+        monkeypatch.setenv(gate, "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert not any(
+        k in ("serving_mem", "memledgeroff_p50_s",
+              "mem_ledger_overhead_p50_s", "mem_ledger_overhead_ratio",
+              "disagg_mem")
+        or k.startswith("load_curve_mem")
+        for k in out)
+
+
 def test_compute_ledger_keys_honor_stage_skip_gates(monkeypatch):
     """With the serving/spec/fleet stages env-gated off, none of the
     compute-observatory keys appear — the same no-keys-no-error contract

@@ -1,0 +1,514 @@
+"""The memory observatory (obs/memory.py) — fast tier.
+
+Pins the contracts the serving stack, the fleet, and the CLI depend on:
+
+- the ledger books: reserve/commit/free lifecycle, per-tenant residency
+  + peak watermarks through ``bounded_label``, the frag decomposition
+  (internal = reserved-minus-committed by cause, external = admission
+  remainder);
+- the conservation invariant: quiet when the books balance, tripwire
+  counter + ``pool_mem`` record when they do not — never "fixed";
+- the leak path: ``on_retired`` starts the clock, ``leak_scan`` hands
+  candidates to the anomaly monitor, ``pool_leak`` fires once per rid;
+- the exhaustion forecast and its digest ``mem`` block (None until the
+  first transition — pre-mem digests stay byte-identical);
+- the kill switch: ``EDGEMESH_MEM_LEDGER=0`` turns every hook into a
+  no-op (the overhead-gate off arm);
+- offline twins: ``summarize_mem`` / ``diff_mem`` forward-compat in
+  BOTH directions (pre-mem logs → None rc 0, unknown keys ignored),
+  ``replay_spans`` routing pool records into the same registry families;
+- the fleet consumers: batch-lane deferral under a short forecast
+  (fleet/admission.py), the autoscaler's memory-pressure vote
+  (fleet/autoscale.py), the balancer's soft penalty, the /fleetz rollup;
+- the ``edgemesh obs mem`` CLI: table / --json / --diff, rc 0 on a
+  pre-mem log.
+"""
+
+import json
+
+import pytest
+
+from edgemesh.fleet.admission import AdmissionController, TenantPolicy
+from edgemesh.fleet.autoscale import AutoScaler
+from edgemesh.fleet.balancer import TelemetryBalancer
+from edgemesh.fleet.registry import ReplicaRegistry
+from edgemesh.obs import (
+    AnomalyMonitor,
+    PoolLedger,
+    Registry,
+    diff_mem,
+    replay_spans,
+    summarize_mem,
+)
+from edgemesh.obs.memory import POOL_RECORD_EVENT, replay_pool_record
+from edgemesh.utils.tracing import JsonlLogger
+
+
+class Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def _ledger(tmp_path=None, clock=None, **kw):
+    kw.setdefault("total_pages", 65)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("per_row_worst", 8)
+    kw.setdefault("reserved_overhead", 1)
+    kw.setdefault("enabled", True)
+    return PoolLedger(
+        registry=Registry(), engine="t",
+        span_log=None if tmp_path is None else tmp_path / "spans.jsonl",
+        clock=clock or Clock(), **kw)
+
+
+def _gauge(reg, name, labelnames, **labels):
+    return reg.gauge(name, "", labelnames).labels(**labels).value
+
+
+def _counter(reg, name, labelnames, **labels):
+    return reg.counter(name, "", labelnames).labels(**labels).value
+
+
+# ---------------------------------------------------------------------------
+# The books: lifecycle, tenants, fragmentation
+# ---------------------------------------------------------------------------
+
+
+def test_reserve_commit_free_lifecycle_and_tenant_attribution():
+    led = _ledger()
+    led.on_reserve(8, rid="r1", tenant="acme", cause="admit", free=56)
+    led.on_reserve(8, rid="r2", tenant="globex", cause="admit", free=48)
+    led.on_commit("r1", add_tokens=20)  # ceil(20/16) = 2 pages committed
+    roll = led.rollup()
+    assert roll["resident_pages"] == 16
+    assert roll["peak_resident_pages"] == 16
+    assert roll["free_pages"] == 48
+    assert roll["tenants"]["acme"] == {"pages": 8, "peak_pages": 8}
+    assert roll["tenants"]["globex"] == {"pages": 8, "peak_pages": 8}
+    assert roll["events"]["admit"] == {"count": 2, "pages": 16}
+    # Internal frag: r1 sits on 8-2=6 uncommitted pages, r2 on all 8.
+    assert roll["frag"]["internal_pages"] == 14
+    assert roll["frag"]["internal_by_cause"] == {"admit": 14}
+    # External: 48 free % 8 per-row-worst = 0 (whole admissions fit).
+    assert roll["frag"]["external_pages"] == 0
+    assert _gauge(led.registry, "edgemesh_pool_tenant_pages",
+                  ("engine", "tenant"), engine="t", tenant="acme") == 8
+    led.on_free(8, rid="r1", cause="retire", free=56)
+    roll = led.rollup()
+    assert roll["resident_pages"] == 8
+    assert roll["peak_resident_pages"] == 16  # watermark survives the free
+    assert roll["tenants"]["acme"] == {"pages": 0, "peak_pages": 8}
+    assert _gauge(led.registry, "edgemesh_pool_tenant_pages",
+                  ("engine", "tenant"), engine="t", tenant="acme") == 0
+
+
+def test_commit_is_floored_capped_and_monotonic():
+    led = _ledger()
+    led.on_reserve(4, rid="r", tenant="a", cause="admit")
+    led.on_commit("r", add_tokens=16)  # 1 page
+    led.on_commit("r", add_tokens=16)  # accumulates to 2
+    assert led.rollup()["frag"]["internal_pages"] == 2
+    led.on_commit("r", committed_pages=1)  # never regresses
+    assert led.rollup()["frag"]["internal_pages"] == 2
+    led.on_commit("r", add_tokens=10_000)  # capped at the holding's pages
+    assert led.rollup()["frag"]["internal_pages"] == 0
+    led.on_commit("missing")  # unknown rid: no-op, no crash
+
+
+def test_external_frag_is_the_admission_remainder():
+    led = _ledger(per_row_worst=8)
+    led.on_reserve(3, rid="r", tenant="a", cause="admit", free=13)
+    # 13 free pages = one whole worst-case admission + 5 stranded.
+    assert led.rollup()["frag"]["external_pages"] == 5
+
+
+def test_reset_zeroes_the_books_and_records_reclaimed_pages():
+    led = _ledger()
+    led.on_reserve(8, rid="r1", tenant="acme", cause="admit")
+    led.on_reserve(4, rid="r2", tenant="globex", cause="cow")
+    led.on_reset(reason="regrow")
+    roll = led.rollup()
+    assert roll["resident_pages"] == 0
+    assert roll["resets"] == 1
+    assert roll["events"]["reset"] == {"count": 1, "pages": 12}
+    assert roll["tenants"]["acme"]["pages"] == 0
+    assert roll["tenants"]["acme"]["peak_pages"] == 8  # history survives
+
+
+def test_disabled_ledger_is_inert():
+    led = _ledger(enabled=False)
+    led.enabled = False
+    led.on_reserve(8, rid="r", tenant="a", cause="admit")
+    led.on_retired("r")
+    led.on_reset()
+    assert led.rollup() == {}
+    assert led.digest_mem(free_pages=10, arrival_ewma_s=1.0) is None
+    assert led.check_conservation(0) is True
+    assert led.leak_scan() == []
+
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("EDGEMESH_MEM_LEDGER", "0")
+    led = PoolLedger(registry=Registry(), engine="t", total_pages=10)
+    assert led.enabled is False
+    monkeypatch.setenv("EDGEMESH_MEM_LEDGER", "1")
+    assert PoolLedger(registry=Registry(), engine="t").enabled is True
+
+
+# ---------------------------------------------------------------------------
+# Conservation + tripwire
+# ---------------------------------------------------------------------------
+
+
+def test_conservation_holds_then_breaks_then_counts(tmp_path):
+    led = _ledger(tmp_path, total_pages=65, reserved_overhead=1)
+    led.on_reserve(8, rid="r", tenant="a", cause="admit", free=56)
+    # 56 free + 8 resident + 1 trash page == 65 total: books balance.
+    assert led.check_conservation(56) is True
+    assert led.rollup()["conservation_breaks"] == 0
+    # Two pages vanish (the failure EM115 exists to prevent).
+    assert led.check_conservation(54) is False
+    assert led.rollup()["conservation_breaks"] == 1
+    assert _counter(led.registry, "edgemesh_pool_conservation_breaks_total",
+                    ("engine",), engine="t") == 1
+    recs = JsonlLogger(tmp_path / "spans.jsonl").read()
+    brk = [r for r in recs if r.get("cause") == "conservation_break"]
+    assert len(brk) == 1 and brk[0]["delta"] == -2
+    assert brk[0]["expected"] == 64 and brk[0]["total"] == 65
+
+
+def test_conservation_is_silent_before_first_transition():
+    led = _ledger(total_pages=65)
+    # A cold pool (free list not even counted yet) must not false-alarm.
+    assert led.check_conservation(0) is True
+    assert led.rollup() == {}
+
+
+# ---------------------------------------------------------------------------
+# Leak detection → pool_leak anomaly
+# ---------------------------------------------------------------------------
+
+
+def test_injected_leak_fires_pool_leak_once(tmp_path):
+    clock = Clock()
+    monitor = AnomalyMonitor(registry=Registry())
+    led = _ledger(tmp_path, clock=clock, anomaly_source=lambda: monitor)
+    led.on_reserve(8, rid="leaky", tenant="acme", cause="admit")
+    led.on_retired("leaky")  # retires WITHOUT freeing: the injected leak
+    clock.tick(5.0)
+    assert led.leak_scan() != []  # candidate reported...
+    assert monitor.incidents() == []  # ...but too young to fire (30s bound)
+    clock.tick(60.0)
+    leaks = led.leak_scan()
+    assert leaks == [{"rid": "leaky", "tenant": "acme", "pages": 8,
+                      "age_s": 65.0, "cause": "admit"}]
+    incidents = monitor.incidents()
+    assert len(incidents) == 1
+    assert incidents[0]["kind"] == "pool_leak"
+    assert incidents[0]["detail"]["rid"] == "leaky"
+    assert incidents[0]["detail"]["engine"] == "t"
+    # Fire-once per rid: the next scan still reports, never re-triggers.
+    clock.tick(60.0)
+    assert led.leak_scan() != []
+    assert len(monitor.incidents()) == 1
+    # The fired leak left a replayable record.
+    recs = JsonlLogger(tmp_path / "spans.jsonl").read()
+    assert [r for r in recs if r.get("cause") == "leak"]
+    assert led.digest_mem()["leak"] == {"requests": 1, "pages": 8}
+
+
+def test_clean_retirement_never_starts_the_leak_clock():
+    clock = Clock()
+    monitor = AnomalyMonitor(registry=Registry())
+    led = _ledger(clock=clock, anomaly_source=lambda: monitor)
+    led.on_reserve(8, rid="r", tenant="a", cause="admit")
+    led.on_free(8, rid="r", cause="retire")
+    led.on_retired("r")
+    clock.tick(1000.0)
+    assert led.leak_scan() == []
+    assert monitor.incidents() == []
+
+
+# ---------------------------------------------------------------------------
+# Forecast + digest
+# ---------------------------------------------------------------------------
+
+
+def test_forecast_math_and_unknowns():
+    led = _ledger(per_row_worst=8)
+    # 40 free pages / (8 pages per request / 0.5 s per arrival) = 2.5 s.
+    assert led.forecast(40, 0.5) == pytest.approx(2.5)
+    assert led.forecast(0, 0.5) == 0.0
+    assert led.forecast(40, None) is None  # no arrivals observed yet
+    assert led.forecast(40, 0.0) is None
+    assert _ledger(per_row_worst=0).forecast(40, 0.5) is None
+
+
+def test_digest_mem_is_none_until_first_transition_then_complete():
+    led = _ledger()
+    assert led.digest_mem(free_pages=64, arrival_ewma_s=1.0) is None
+    led.on_reserve(8, rid="r", tenant="acme", cause="admit", free=56)
+    led.on_commit("r", committed_pages=3)
+    d = led.digest_mem(free_pages=56, arrival_ewma_s=0.5)
+    assert d["total_pages"] == 65
+    assert d["free_pages"] == 56
+    assert d["resident_pages"] == 8
+    assert d["committed_pages"] == 3
+    assert d["per_row_worst"] == 8
+    assert d["tenants"] == {"acme": 8}
+    assert d["frag"]["internal_pages"] == 5
+    assert d["leak"] == {"requests": 0, "pages": 0}
+    assert d["forecast_s"] == pytest.approx(3.5)
+    assert d["conservation_breaks"] == 0
+    # drift is None on CPU (memory_stats withheld) — reported, not guessed.
+    assert d["drift"] is None
+
+
+# ---------------------------------------------------------------------------
+# Offline twins: summarize / diff / replay
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_mem_rebuilds_the_rollup_from_the_log(tmp_path):
+    led = _ledger(tmp_path)
+    led.on_reserve(8, rid="r1", tenant="acme", cause="admit", free=56)
+    led.on_reserve(4, rid="r2", tenant="globex", cause="cow", free=52)
+    led.on_free(8, rid="r1", cause="retire", free=60)
+    led.check_conservation(50)  # a deliberate break, for the counter
+    summ = summarize_mem(JsonlLogger(tmp_path / "spans.jsonl").read())
+    assert summ["pool_records"] == 4
+    assert summ["engines"] == ["t"]
+    assert summ["total_pages"] == 65
+    assert summ["peak_resident_pages"] == 12
+    assert summ["last_resident_pages"] == 4
+    assert summ["last_free_pages"] == 60
+    assert summ["events"]["admit"] == {"count": 1, "pages": 8}
+    assert summ["events"]["cow"] == {"count": 1, "pages": 4}
+    assert summ["events"]["retire"] == {"count": 1, "pages": 8}
+    assert summ["tenants"]["acme"] == {"pages": 0, "peak_pages": 8}
+    assert summ["tenants"]["globex"] == {"pages": 4, "peak_pages": 4}
+    assert summ["conservation_breaks"] == 1
+
+
+def test_summarize_mem_compat_both_directions():
+    # A pre-mem log is an answer, not an error.
+    assert summarize_mem([]) is None
+    assert summarize_mem([{"event": "span", "rid": "r"}]) is None
+    # Forward: unknown keys on future records are ignored; known-but-
+    # missing keys read as None/0 — the record never KeyErrors.
+    summ = summarize_mem([
+        {"event": POOL_RECORD_EVENT, "cause": "admit", "delta": 4,
+         "tenant": "a", "future_key": {"nested": True}},
+        {"event": POOL_RECORD_EVENT},  # everything missing
+    ])
+    assert summ["pool_records"] == 2
+    assert summ["tenants"]["a"]["peak_pages"] == 4
+    assert summ["total_pages"] is None
+
+
+def test_diff_mem_rows_survive_one_sided_tenants():
+    a = summarize_mem([
+        {"event": POOL_RECORD_EVENT, "cause": "admit", "delta": 8,
+         "tenant": "acme", "resident": 8},
+    ])
+    b = summarize_mem([
+        {"event": POOL_RECORD_EVENT, "cause": "import", "delta": 4,
+         "tenant": "globex", "resident": 4},
+    ])
+    doc = diff_mem(a, b)
+    assert doc["peak_ratio"] == pytest.approx(0.5)
+    assert doc["tenants"]["acme"] == {"a_peak_pages": 8, "b_peak_pages": None}
+    assert doc["tenants"]["globex"]["b_peak_pages"] == 4
+    assert doc["events"]["admit"]["a_pages"] == 8
+    assert doc["events"]["import"]["b_pages"] == 4
+    # Null-safe on both sides (two pre-mem logs).
+    assert diff_mem(None, None)["peak_ratio"] is None
+
+
+def test_replay_spans_routes_pool_records_into_registry(tmp_path):
+    led = _ledger(tmp_path)
+    led.on_reserve(8, rid="r1", tenant="acme", cause="admit", free=56)
+    led.on_free(3, rid="r1", cause="abort", free=59)
+    led.check_conservation(0)  # break → tripwire on replay too
+    reg = replay_spans(tmp_path / "spans.jsonl", registry=Registry())
+    assert _gauge(reg, "edgemesh_pool_tenant_pages", ("engine", "tenant"),
+                  engine="t", tenant="acme") == 5
+    assert _counter(reg, "edgemesh_pool_events_total", ("engine", "cause"),
+                    engine="t", cause="admit") == 1
+    assert _counter(reg, "edgemesh_pool_conservation_breaks_total",
+                    ("engine",), engine="t") == 1
+
+
+def test_replay_pool_record_bounds_foreign_tenant_labels():
+    reg = Registry()
+    state = {}
+    for i in range(200):  # a hand-edited log minting hostile cardinality
+        state = replay_pool_record(reg, {
+            "event": POOL_RECORD_EVENT, "engine": "t", "cause": "admit",
+            "delta": 1, "tenant": f"hostile-{i}"}, state)
+    fam = reg.gauge("edgemesh_pool_tenant_pages", "", ("engine", "tenant"))
+    labels = {key[1] for key, _ in fam.items()}
+    assert len(labels) <= 33  # bounded_label's 32-cap + the overflow bucket
+
+
+# ---------------------------------------------------------------------------
+# Fleet consumers: admission deferral, autoscaler vote, balancer penalty
+# ---------------------------------------------------------------------------
+
+
+def _mem_load(forecast_s):
+    return {"mem": {"forecast_s": forecast_s, "free_pages": 4,
+                    "resident_pages": 60}}
+
+
+def test_admission_defers_batch_lane_under_short_forecast():
+    adm = AdmissionController(
+        max_inflight=4, mem_horizon_s=10.0,
+        policies={"bulk": TenantPolicy(lane="batch")})
+    assert adm.acquire("bulk") == "ok"  # no forecast yet: legacy verdicts
+    adm.release()
+    adm.note_mem_forecast(_mem_load(3.0), replica="r0")
+    # Batch defers (no queue budget → sheds); interactive is untouched.
+    assert adm.acquire("bulk") == "overload"
+    assert adm.acquire("alice") == "ok"
+    st = adm.stats()
+    assert st["mem_horizon_s"] == 10.0
+    assert st["mem_forecast_s"] == 3.0
+    assert st["mem_deferrals"] == 1
+    # Recovery clears the pressure; batch flows again.
+    adm.note_mem_forecast(_mem_load(60.0), replica="r0")
+    assert adm.acquire("bulk") == "ok"
+
+
+def test_admission_pressure_is_fleet_minimum_and_clears_per_replica():
+    adm = AdmissionController(
+        max_inflight=4, mem_horizon_s=10.0,
+        policies={"bulk": TenantPolicy(lane="batch")})
+    adm.note_mem_forecast(_mem_load(60.0), replica="r0")
+    adm.note_mem_forecast(_mem_load(2.0), replica="r1")
+    assert adm.acquire("bulk") == "overload"  # the tightest pool rules
+    # A forgotten/recovered replica clears ITS entry (None load).
+    adm.note_mem_forecast(None, replica="r1")
+    assert adm.acquire("bulk") == "ok"
+
+
+def test_admission_mem_horizon_zero_is_legacy():
+    adm = AdmissionController(
+        max_inflight=4, policies={"bulk": TenantPolicy(lane="batch")})
+    adm.note_mem_forecast(_mem_load(0.1), replica="r0")
+    assert adm.acquire("bulk") == "ok"  # horizon 0 = feature off
+    assert adm.stats()["mem_deferrals"] == 0
+
+
+def test_admission_ignores_malformed_mem_blocks():
+    adm = AdmissionController(
+        max_inflight=4, mem_horizon_s=10.0,
+        policies={"bulk": TenantPolicy(lane="batch")})
+    for load in ({}, {"mem": None}, {"mem": {"forecast_s": "soon"}},
+                 {"mem": {"forecast_s": -1}}):
+        adm.note_mem_forecast(load, replica="r0")
+        assert adm.acquire("bulk") == "ok"
+        adm.release()
+
+
+def test_autoscaler_mem_pressure_votes_scale_up():
+    # Calm demand (util well under the watermark) but a 2 s forecast.
+    class FakeLauncher:
+        def __init__(self):
+            self.spawned = []
+
+        def spawn(self):
+            self.spawned.append(f"scale-{len(self.spawned)}")
+            return self.spawned[-1]
+
+        def stop(self, rid):
+            pass
+
+        def pending(self):
+            return 0
+
+    clock = Clock(0.0)
+    reg = ReplicaRegistry([("r0", "http://x:0")])
+    reg.update_load("r0", {
+        "ewma_arrival_s": 1.0,  # 1 rps demand
+        "capacity": {"slots": 8, "est_req_s": 10.0},  # util 0.1
+        "mem": {"forecast_s": 2.0},
+    })
+    launcher = FakeLauncher()
+    sc = AutoScaler(reg, launcher, min_replicas=1, max_replicas=4,
+                    up_after=2, cooldown_s=5.0, mem_pressure_s=30.0,
+                    obs_registry=Registry(), now=clock)
+    assert sc.evaluate() is None  # streak 1 of 2: same discipline as util
+    clock.tick(1.0)
+    action = sc.evaluate()
+    assert action["action"] == "up"
+    assert action["reason"] == "mem_pressure"  # util alone wouldn't vote
+    assert action["mem_forecast_s"] == 2.0
+    assert launcher.spawned == ["scale-0"]
+    # Forecast recovers → pressure off → no further votes.
+    reg.update_load("r0", {
+        "ewma_arrival_s": 1.0,
+        "capacity": {"slots": 8, "est_req_s": 10.0},
+        "mem": {"forecast_s": 600.0},
+    })
+    clock.tick(10.0)
+    assert sc.evaluate() is None
+    assert sc.evaluate() is None
+
+
+def test_balancer_mem_penalty_is_soft_and_null_safe():
+    pen = TelemetryBalancer._mem_penalty
+    assert pen({}) == 0.0
+    assert pen({"mem": None}) == 0.0
+    assert pen({"mem": {"forecast_s": None}}) == 0.0
+    assert pen({"mem": {"forecast_s": 60.0}}) == 0.0  # roomy pool: free
+    assert pen({"mem": {"forecast_s": 5.0}}) == pytest.approx(0.5)
+    assert pen({"mem": {"forecast_s": 0.0}}) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# CLI: edgemesh obs mem
+# ---------------------------------------------------------------------------
+
+
+def _write_pool_log(tmp_path, name="mem.jsonl", tenant="acme"):
+    led = PoolLedger(registry=Registry(), engine="t", enabled=True,
+                     total_pages=65, page_size=16, per_row_worst=8,
+                     span_log=tmp_path / name, clock=Clock())
+    led.on_reserve(8, rid="r1", tenant=tenant, cause="admit", free=56)
+    led.on_free(8, rid="r1", cause="retire", free=64)
+    return tmp_path / name
+
+
+def test_cli_mem_table_json_and_diff(tmp_path, capsys):
+    from edgemesh.obs.cli import cmd_mem
+
+    log_a = _write_pool_log(tmp_path, "a.jsonl", tenant="acme")
+    log_b = _write_pool_log(tmp_path, "b.jsonl", tenant="globex")
+    assert cmd_mem(str(log_a)) == 0
+    out = capsys.readouterr().out
+    assert "acme" in out and "admit" in out and "peak_resident=8" in out
+    assert cmd_mem(str(log_a), as_json=True) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["peak_resident_pages"] == 8
+    assert cmd_mem(str(log_a), diff=str(log_b)) == 0
+    out = capsys.readouterr().out
+    assert "acme" in out and "globex" in out
+    assert cmd_mem(str(log_a), diff=str(tmp_path / "missing.jsonl")) == 2
+
+
+def test_cli_mem_pre_mem_log_is_rc_zero(tmp_path, capsys):
+    from edgemesh.obs.cli import cmd_mem
+
+    empty = tmp_path / "empty.jsonl"
+    JsonlLogger(empty).log("span", rid="r")  # a log, but no pool records
+    assert cmd_mem(str(empty)) == 0
+    assert "no pool records" in capsys.readouterr().out
+    assert cmd_mem(str(empty), as_json=True) == 0
+    assert json.loads(capsys.readouterr().out) is None
