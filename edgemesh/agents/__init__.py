@@ -1,8 +1,20 @@
-"""Multi-agent orchestration (the reference's L4 / core contribution)."""
+"""Multi-agent orchestration (the reference's L4 / core contribution).
 
-from edgemesh.agents.orchestrator import (  # noqa: F401
-    Agent,
-    Ensemble,
-    build_agent,
-    build_ensemble,
-)
+The orchestrator imports jax at module scope, but the prompt templates it
+shares with the fleet-side ensemble coordinator live in the stdlib-only
+``edgemesh.agents.prompts`` — so the package init resolves the orchestrator
+names lazily (PEP 562) instead of eagerly importing jax onto every host
+that merely wants the templates.
+"""
+
+_ORCHESTRATOR_NAMES = ("Agent", "Ensemble", "build_agent", "build_ensemble")
+
+__all__ = list(_ORCHESTRATOR_NAMES)
+
+
+def __getattr__(name):
+    if name in _ORCHESTRATOR_NAMES:
+        from edgemesh.agents import orchestrator
+
+        return getattr(orchestrator, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -31,6 +31,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from edgemesh.agents.prompts import (
+    DEFAULT_QA_TEMPLATE,
+    REFINER_ROLE,
+    REFINER_TEMPLATE,
+    format_refiner_prompt,
+)
 from edgemesh.config import AgentSpec, EdgeMeshConfig, ModelSpec, SamplingParams
 from edgemesh.models.families import config_for_family, tiny_config
 from edgemesh.models.hf_ingest import load_params
@@ -43,16 +49,17 @@ from edgemesh.runtime import generate
 
 log = logging.getLogger("edgemesh.agents")
 
-REFINER_ROLE = "refiner"
-
-DEFAULT_QA_TEMPLATE = "Question: {question}\nGive a short, factual answer.\nAnswer:"
-REFINER_TEMPLATE = (
-    "Two assistants answered the same question. Merge their answers into one "
-    "clear, accurate response.\n"
-    "Question: {question}\n"
-    "{candidates}"
-    "Merged answer:"
-)
+# Template strings live in edgemesh.agents.prompts (jax-free, shared with
+# the fleet ensemble coordinator); re-exported here for back-compat.
+__all__ = [
+    "Agent",
+    "Ensemble",
+    "build_agent",
+    "build_ensemble",
+    "REFINER_ROLE",
+    "DEFAULT_QA_TEMPLATE",
+    "REFINER_TEMPLATE",
+]
 
 
 @dataclass
@@ -335,11 +342,10 @@ class Ensemble:
         return self.answer_batch([question])[0]
 
     def _refiner_prompt(self, question: str, drafts) -> str:
-        candidates = "".join(
-            f"Answer {i + 1}: {d['answer']}\n" for i, d in enumerate(drafts)
-        )
-        return self.refiner.prompt_template.format(
-            question=question, candidates=candidates
+        return format_refiner_prompt(
+            question,
+            [d["answer"] for d in drafts],
+            template=self.refiner.prompt_template,
         )
 
     def answer_stream(self, question: str, chunk: int = 16):

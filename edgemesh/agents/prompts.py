@@ -1,0 +1,42 @@
+"""Prompt templates for the QA + refiner ensemble — the ONE declaration.
+
+These lived inline in ``agents/orchestrator.py``; the fleet-side ensemble
+coordinator (fleet/ensemble.py) composes the same refiner prompt from
+candidates gathered over HTTP, and forking the strings would let the
+in-process and over-the-fleet ensembles drift apart silently. This module
+is stdlib-only on purpose: the fleet package must stay importable on hosts
+with no accelerator, so it cannot reach through ``agents.orchestrator``
+(which imports jax at module scope).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+REFINER_ROLE = "refiner"
+
+DEFAULT_QA_TEMPLATE = "Question: {question}\nGive a short, factual answer.\nAnswer:"
+REFINER_TEMPLATE = (
+    "Two assistants answered the same question. Merge their answers into one "
+    "clear, accurate response.\n"
+    "Question: {question}\n"
+    "{candidates}"
+    "Merged answer:"
+)
+
+#: The replica-side passthrough template: a gateway whose coordinator
+#: composes the full prompt fleet-side (the refiner pool behind
+#: ``POST /ensemble``) serves the question verbatim instead of wrapping it
+#: in a role template a second time.
+PASSTHROUGH_TEMPLATE = "{question}"
+
+
+def format_refiner_prompt(question: str, answers: Sequence[str],
+                          template: str = REFINER_TEMPLATE) -> str:
+    """The refiner's merge prompt over candidate answers — the reference's
+    per-question block (combiner_fp.py:436-442), shared by the in-process
+    ``Ensemble`` and the fleet ensemble coordinator."""
+    candidates = "".join(
+        f"Answer {i + 1}: {a}\n" for i, a in enumerate(answers)
+    )
+    return template.format(question=question, candidates=candidates)

@@ -160,6 +160,7 @@ HEADER_CONSTS: dict[str, str] = {
 PATH_CONSTS: dict[str, str] = {
     "KV_EXPORT_PATH": httputil.KV_EXPORT_PATH,
     "KV_IMPORT_PATH": httputil.KV_IMPORT_PATH,
+    "ENSEMBLE_PATH": httputil.ENSEMBLE_PATH,
 }
 
 # The EM108 dial table, now a contract policy under EM502: outbound calls
@@ -254,6 +255,18 @@ WIRE_SCHEMAS: dict[str, dict] = {
         "consumers": (
             ("edgemesh/fleet/router.py", "recent_traces", ("rec", "s")),
             ("edgemesh/fleet/router.py", "get_trace", ("rec", "match")),
+        ),
+    },
+    "pool_view": {
+        "doc": "registry pools() entry ({replicas, role, routable}) — the "
+               "/fleetz 'pools' block and what the ensemble coordinator's "
+               "topology discovery routes by (the model descriptor itself "
+               "rides POST /replicas/register's 'model' key, WIRE_CONTRACT)",
+        "producers": (
+            ("edgemesh/fleet/registry.py", "pools"),
+        ),
+        "consumers": (
+            ("edgemesh/fleet/ensemble.py", "topology", ("e",)),
         ),
     },
 }

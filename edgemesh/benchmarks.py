@@ -2043,6 +2043,146 @@ def ensemble_overlap_benchmark(n_agents: int = 2, questions: int = 3) -> dict[st
     }
 
 
+def fleet_ensemble_benchmark(
+    n_requests: int = 12, max_new: int = 8, eval_limit: int = 8
+) -> dict[str, Any]:
+    """Ensemble-over-the-fleet vs single-model serving on the same tiny
+    in-process replicas: 2 QA pools (qa-a/qa-b) + a refiner pool behind one
+    ``FleetRouter``, ``POST /ensemble`` against ``POST /generate`` through
+    the same frontend. The headline is ``ensemble_latency_p99_ratio``
+    (ensemble p99 / single p99 — the latency price of fan-out + refine);
+    the per-outcome degradation counts and the eval-scored quality delta
+    ride beside it. Random synthetic weights ⇒ the quality delta is a
+    machinery check (both arms score near-noise), not a model claim —
+    trained checkpoints give the real tradeoff; the schema is what this
+    stage pins. Questions (and rouge references) come from the eval
+    dataset when the CSV is present; otherwise one synthetic question and
+    null quality keys — the latency ratio never depends on the dataset."""
+    from edgemesh.agents.orchestrator import Ensemble, build_agent
+    from edgemesh.config import AgentSpec, ModelSpec, SamplingParams
+    from edgemesh.fleet import FleetRouter, HttpTransport, ReplicaRegistry, serve_fleet
+    from edgemesh.obs import Registry
+    from edgemesh.serve import serve_rest
+
+    import numpy as np
+
+    sampling = SamplingParams(max_new_tokens=max_new, do_sample=False,
+                              repetition_penalty=1.0)
+
+    def replica(template: str = ""):
+        agent = build_agent(AgentSpec(role="qa", model=ModelSpec(),
+                                      sampling=sampling,
+                                      prompt_template=template))
+        return serve_rest(Ensemble(qa_agents=[agent]), host="127.0.0.1",
+                          port=0, block=False)
+
+    # The refiner pool serves the passthrough template: the coordinator
+    # composes the full refiner prompt fleet-side (agents/prompts.py) and
+    # the replica must not wrap it again.
+    servers = [
+        ("qa-a-0", replica(), {"pool": "qa-a", "role": "qa"}),
+        ("qa-b-0", replica(), {"pool": "qa-b", "role": "qa"}),
+        ("refiner-0", replica("{question}"),
+         {"pool": "refiner", "role": "refiner"}),
+    ]
+    obs = Registry()
+    registry = ReplicaRegistry()
+    for rid, srv, model in servers:
+        registry.register(rid, f"http://127.0.0.1:{srv.server_address[1]}",
+                          model=model)
+    router = FleetRouter(registry, balancer="least_outstanding",
+                         obs_registry=obs, trace_sample=0.0)
+    front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+    transport = HttpTransport()
+    base = f"http://127.0.0.1:{front.server_address[1]}"
+
+    try:
+        from edgemesh.eval.data import load_qa, resolve_dataset_path
+
+        samples = load_qa(resolve_dataset_path(), limit=eval_limit)
+    except (FileNotFoundError, ValueError):
+        samples = []
+    qa_pairs = (
+        [(s.question, s.answer) for s in samples]
+        if samples else [("Where is the Eiffel Tower located?", None)]
+    )
+
+    def drive(path: str, label: str) -> tuple[list[float], list[tuple]]:
+        _progress(f"fleet-ensemble: warmup via {label}")
+        status, _ = transport.post_json(
+            base + path, {"question": qa_pairs[0][0]}, timeout_s=600.0)
+        if status != 200:
+            raise RuntimeError(f"{label} warmup answered {status}")
+        lats, scored = [], []
+        for i in range(n_requests):
+            q, ref = qa_pairs[i % len(qa_pairs)]
+            t0 = time.perf_counter()
+            status, body = transport.post_json(
+                base + path, {"question": q}, timeout_s=600.0)
+            if status != 200:
+                raise RuntimeError(f"{label} request answered {status}")
+            lats.append(time.perf_counter() - t0)
+            if ref is not None:
+                scored.append((body.get("answer") or "", ref))
+        return lats, scored
+
+    def quality(scored: list[tuple]) -> float | None:
+        if not scored:
+            return None
+        from edgemesh.eval.harness import score_sample
+
+        rows = [score_sample(pred, ref, metrics=["avg_rouge"])
+                for pred, ref in scored]
+        return round(sum(r["avg_rouge"] for r in rows) / len(rows), 4)
+
+    try:
+        # The single arm routes pool-less through the same frontend, so
+        # both arms pay the identical router hop and the ratio isolates
+        # the fan-out + refine work.
+        single_lats, single_scored = drive("/generate", "single")
+        ens_lats, ens_scored = drive("/ensemble", "ensemble")
+
+        def pct(xs, q):
+            return round(float(np.percentile(xs, q)), 6)
+
+        stats = router.ensemble.stats()
+        ens_q, single_q = quality(ens_scored), quality(single_scored)
+        ratio = (round(pct(ens_lats, 99) / pct(single_lats, 99), 3)
+                 if pct(single_lats, 99) else None)
+        _progress(
+            f"fleet-ensemble: p99 {pct(ens_lats, 99) * 1e3:.1f}ms ensemble "
+            f"vs {pct(single_lats, 99) * 1e3:.1f}ms single (ratio {ratio}), "
+            f"outcomes {stats['outcomes']}"
+        )
+        return {
+            "metric": "ensemble_latency_p99_ratio",
+            "value": ratio,
+            "unit": "ratio",
+            "n_requests": n_requests,
+            "ensemble_p50_s": pct(ens_lats, 50),
+            "ensemble_p99_s": pct(ens_lats, 99),
+            "single_p50_s": pct(single_lats, 50),
+            "single_p99_s": pct(single_lats, 99),
+            "outcomes": stats["outcomes"],
+            "qa_pools": stats["qa_pools"],
+            "refiner_pool": stats["refiner_pool"],
+            "ensemble_quality": ens_q,
+            "single_quality": single_q,
+            "quality_delta": (
+                round(ens_q - single_q, 4)
+                if ens_q is not None and single_q is not None else None
+            ),
+            "eval_samples": len(samples),
+            "obs": obs.summary(prefix="edgemesh_ensemble_"),
+        }
+    finally:
+        front.shutdown()
+        for _, srv, _ in servers:
+            srv.shutdown()
+            if srv.batcher is not None:
+                srv.batcher.close()
+
+
 def speculative_benchmark(
     preset: str | None = None,
     batch: int = 1,
@@ -2530,6 +2670,31 @@ def headline_benchmark(
 
     if os.environ.get("EDGEMESH_BENCH_DISAGG", "1") == "1":
         _stage("disagg", _disagg)
+
+    # ---- Stage 7i: ensemble-over-the-fleet — 2 QA pools + the refiner
+    # pipeline vs single-model serving through the same frontend (tiny
+    # in-process replicas; the coordinator is under test, not the
+    # kernels). The headline is the latency price of fan-out + refine;
+    # the degradation-outcome counts and the eval quality delta ride
+    # beside it. EDGEMESH_BENCH_ENSEMBLE=0 skips.
+    def _ensemble():
+        r = fleet_ensemble_benchmark()
+        out["ensemble_latency_p99_ratio"] = r["value"]
+        out["ensemble_p50_s"] = r["ensemble_p50_s"]
+        out["ensemble_p99_s"] = r["ensemble_p99_s"]
+        out["ensemble_single_p50_s"] = r["single_p50_s"]
+        out["ensemble_single_p99_s"] = r["single_p99_s"]
+        out["ensemble_outcomes"] = r["outcomes"]
+        out["ensemble_quality_delta"] = r["quality_delta"]
+        out["ensemble_eval_samples"] = r["eval_samples"]
+
+    # Rides the fleet gate too: EDGEMESH_BENCH_FLEET=0 means "spin no
+    # in-process fleet", and this stage spins three replicas + a frontend.
+    if (
+        os.environ.get("EDGEMESH_BENCH_ENSEMBLE", "1") == "1"
+        and os.environ.get("EDGEMESH_BENCH_FLEET", "1") == "1"
+    ):
+        _stage("ensemble", _ensemble)
 
     # ---- Stage 7h: the capacity observatory's control loop —
     # cold-start-to-first-token with a shared compilation cache (warm vs

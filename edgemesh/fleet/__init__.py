@@ -20,8 +20,11 @@ service (docs/FLEET.md is the operator-facing reference):
 - ``autoscale``: replica spawn/drain from the digests' arrival-rate vs
   capacity-estimate split, with incidents as a scale-up signal and
   warm starts off a shared persistent compilation cache.
-- ``frontend``: the HTTP listener (``/generate``, ``/fleetz``,
-  ``/metrics``, runtime ``/replicas/*`` membership).
+- ``ensemble``: the ``POST /ensemble`` coordinator — parallel QA fan-out
+  across model-keyed pools + the refiner pipeline, with graceful
+  degradation as a first-class state machine.
+- ``frontend``: the HTTP listener (``/generate``, ``/ensemble``,
+  ``/fleetz``, ``/metrics``, runtime ``/replicas/*`` membership).
 - ``cli``: ``edgemesh fleet serve|status`` — spawn N local replicas and
   front them, or inspect a running fleet.
 
@@ -40,6 +43,7 @@ from edgemesh.fleet.balancer import (  # noqa: F401
 )
 from edgemesh.fleet.autoscale import AutoScaler  # noqa: F401
 from edgemesh.fleet.autotune import KneeTracker  # noqa: F401
+from edgemesh.fleet.ensemble import EnsembleCoordinator  # noqa: F401
 from edgemesh.fleet.frontend import serve_fleet  # noqa: F401
 from edgemesh.fleet.health import HealthProber  # noqa: F401
 from edgemesh.fleet.registry import Replica, ReplicaRegistry  # noqa: F401

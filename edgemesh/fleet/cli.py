@@ -44,6 +44,16 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--config", default=None, help="replica YAML config "
                      "(passed through to each `edgemesh serve`)")
     srv.add_argument("--replicas", type=int, default=2)
+    srv.add_argument("--pool", action="append", default=[],
+                     metavar="NAME=COUNT[:CONFIG]",
+                     help="heterogeneous model pool, repeatable — e.g. "
+                     "'--pool qa-a=2 --pool qa-b=1:other.yaml --pool "
+                     "refiner=1'. Each pool spawns COUNT replicas (with "
+                     "CONFIG overriding --config) registered under a model "
+                     "descriptor {pool, role}; the pool named 'refiner' "
+                     "takes the refiner role, everything else is a QA pool "
+                     "fanned out by POST /ensemble. When given, --replicas "
+                     "is ignored (docs/FLEET.md 'Ensemble serving')")
     srv.add_argument("--host", default="0.0.0.0")
     srv.add_argument("--port", type=int, default=8000, help="router port")
     srv.add_argument("--replica-port-base", type=int, default=0,
@@ -164,30 +174,63 @@ def _free_ports(n: int) -> list[int]:
             s.close()
 
 
-def _replica_cmd(args, port: int) -> list[str]:
+def _replica_cmd(args, port: int, config: str | None = None) -> list[str]:
     """One replica's `edgemesh serve` command line — shared by the boot
     spawn and the autoscaler's launcher so a scale-up replica is
     configured identically to the originals (including the shared
-    compilation cache, which is what makes its start warm)."""
+    compilation cache, which is what makes its start warm). ``config``
+    overrides ``--config`` for a pool with its own model YAML."""
     cmd = [sys.executable, "-m", "edgemesh.cli", "serve", "--port", str(port)]
-    if args.config:
-        cmd += ["--config", args.config]
+    config = config or args.config
+    if config:
+        cmd += ["--config", config]
     if getattr(args, "compile_cache_dir", None):
         cmd += ["--compile-cache-dir", args.compile_cache_dir]
     cmd += args.replica_extra.split()
     return cmd
 
 
-def _spawn_replicas(args) -> list[tuple[str, int, subprocess.Popen]]:
-    if args.replica_port_base:
-        ports = [args.replica_port_base + i for i in range(args.replicas)]
+def _parse_pools(specs: list[str]) -> list[tuple[str, int, str | None]]:
+    """``NAME=COUNT[:CONFIG]`` pool specs → (name, count, config) rows.
+    The pool named ``refiner`` carries the refiner role (matching
+    agents/prompts.REFINER_ROLE); every other pool is a QA pool."""
+    pools = []
+    for spec in specs:
+        name, eq, rest = spec.partition("=")
+        count, _, config = rest.partition(":")
+        if not name or not eq or not count.isdigit() or int(count) < 1:
+            raise SystemExit(
+                f"error: malformed --pool {spec!r} (want NAME=COUNT[:CONFIG])"
+            )
+        pools.append((name, int(count), config or None))
+    return pools
+
+
+def _spawn_replicas(args) -> list[tuple[str, int, subprocess.Popen, dict | None]]:
+    """Spawn the fleet's replica subprocesses. Homogeneous mode
+    (``--replicas N``) yields no model descriptors; ``--pool`` mode yields
+    one descriptor per replica, which registration ships to the registry's
+    model-keyed pools."""
+    if args.pool:
+        plan = []
+        for name, count, config in _parse_pools(args.pool):
+            role = "refiner" if name == "refiner" else "qa"
+            for i in range(count):
+                plan.append((f"{name}-{i}", config,
+                             {"pool": name, "role": role}))
     else:
-        ports = _free_ports(args.replicas)
-    procs: list[tuple[str, int, subprocess.Popen]] = []
-    for i, port in enumerate(ports):
-        proc = subprocess.Popen(_replica_cmd(args, port), env=os.environ.copy())
-        procs.append((f"replica-{i}", port, proc))
-        log.info("spawned %s on port %d (pid %d)", f"replica-{i}", port, proc.pid)
+        plan = [(f"replica-{i}", None, None) for i in range(args.replicas)]
+    if args.replica_port_base:
+        ports = [args.replica_port_base + i for i in range(len(plan))]
+    else:
+        ports = _free_ports(len(plan))
+    procs: list[tuple[str, int, subprocess.Popen, dict | None]] = []
+    for (rid, config, model), port in zip(plan, ports):
+        proc = subprocess.Popen(_replica_cmd(args, port, config=config),
+                                env=os.environ.copy())
+        procs.append((rid, port, proc, model))
+        log.info("spawned %s on port %d (pid %d)%s", rid, port, proc.pid,
+                 f" pool={model['pool']}" if model else "")
     return procs
 
 
@@ -325,8 +368,8 @@ def _wait_ready(transport, procs, boot_timeout_s: float) -> None:
     from edgemesh.fleet.transport import TransportError
 
     deadline = time.monotonic() + boot_timeout_s
-    pending = {rid: port for rid, port, _ in procs}
-    by_rid = {rid: proc for rid, _, proc in procs}
+    pending = {rid: port for rid, port, *_ in procs}
+    by_rid = {rid: proc for rid, _, proc, *_ in procs}
     while pending and time.monotonic() < deadline:
         for rid, port in list(pending.items()):
             rc = by_rid[rid].poll()
@@ -371,8 +414,9 @@ def cmd_serve(args) -> int:
     router = None
     try:
         _wait_ready(transport, procs, args.boot_timeout_s)
-        for rid, port, proc in procs:
-            registry.register(rid, f"http://127.0.0.1:{port}", pid=proc.pid)
+        for rid, port, proc, model in procs:
+            registry.register(rid, f"http://127.0.0.1:{port}", model=model,
+                              pid=proc.pid)
         admission = None
         if args.tenant_policy or args.admission_queue_cap:
             from edgemesh.fleet.admission import AdmissionController, TenantPolicy
@@ -461,14 +505,14 @@ def cmd_serve(args) -> int:
                 scaler.launcher.stop_all()
         return 0
     finally:
-        for rid, _, proc in procs:
+        for rid, _, proc, _model in procs:
             if router is not None and proc.poll() is None:
                 # Graceful: finish in-flight work before the process dies.
                 print(f"draining {rid} ...", flush=True)
                 router.drain_replica(rid, timeout_s=30.0)
             if proc.poll() is None:
                 proc.send_signal(signal.SIGTERM)
-        for _, _, proc in procs:
+        for _, _, proc, _model in procs:
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:

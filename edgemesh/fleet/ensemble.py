@@ -1,0 +1,351 @@
+"""Ensemble-over-the-fleet: parallel QA fan-out + the refiner pipeline.
+
+The source paper's headline capability — two QA models answer independently
+and a refiner merges their answers — exists in-process as
+``agents/orchestrator.Ensemble`` (one host, submeshes). This module serves
+the same pipeline THROUGH the fleet: ``POST /ensemble`` fans the question
+out to every QA model pool in parallel (one routed branch per pool, each
+with its own child trace span and the pool's own hedging/tiering via
+``FleetRouter._route``), then drives the refiner pool with the candidate
+answers. The refiner prompt is composed fleet-side from the SAME template
+the in-process ensemble uses (``agents/prompts.py`` — reused, not forked);
+refiner-pool replicas therefore serve a passthrough template so the prompt
+is not wrapped twice.
+
+Graceful degradation is a first-class state machine, not an error path:
+
+    every branch ok, refiner ok          → outcome "ok"
+    some branch failed/timed out,        → outcome "degraded_qa"
+      refiner ok over the survivors        (single-candidate refine included)
+    refiner failed/timed out             → outcome "refiner_fallback"
+      → best QA candidate wins
+    no refiner pool registered           → outcome "no_refiner"
+      → best QA candidate wins
+    every branch failed                  → outcome "failed" (502, the only
+                                           client-visible ensemble failure)
+
+Every outcome lands in ``edgemesh_ensemble_total{outcome}`` and on the
+request's span tree (branch spans carry the pool and fate; overlapping
+branch intervals are the concurrency proof ``edgemesh obs trace`` renders).
+
+One trace record: branches share the request's span list and the request
+finishes through the router's ``_finish_trace``, so cross-process assembly
+sees a single router record whose children are the fan-out tree.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from edgemesh.agents.prompts import REFINER_ROLE, format_refiner_prompt
+from edgemesh.obs.metrics import bounded_label
+from edgemesh.obs.trace import TraceContext, sample
+from edgemesh.serve.httputil import RETRY_AFTER_HEADER, TRACE_HEADER
+
+#: Terminal request outcomes — the degradation ladder, best to worst.
+OUTCOMES = ("ok", "degraded_qa", "refiner_fallback", "no_refiner", "failed")
+
+
+class EnsembleCoordinator:
+    """Fans one question across the QA pools and refines the candidates.
+
+    Pool discovery is live by default: every registered pool whose role is
+    not ``refiner`` is a QA pool; the first refiner-role pool (sorted) is
+    the refiner. Explicit ``qa_pools``/``refiner_pool`` pin the topology
+    instead. A fleet with NO model descriptors degenerates to a single
+    branch over the whole fleet (pool None) with no refiner — ``/ensemble``
+    then behaves like ``/generate`` with ensemble accounting.
+    """
+
+    def __init__(self, router, qa_pools: list[str] | None = None,
+                 refiner_pool: str | None = None,
+                 qa_budget_fraction: float = 0.7,
+                 obs_registry=None) -> None:
+        from edgemesh.obs import get_registry
+
+        self.router = router
+        self.qa_pools = list(qa_pools) if qa_pools else None
+        self.refiner_pool = refiner_pool
+        # QA branches get this fraction of the request budget; the rest is
+        # reserved for the refiner hop (the whole remaining budget when a
+        # branch finishes early). With no refiner the branches get it all.
+        self.qa_budget_fraction = float(qa_budget_fraction)
+        reg = obs_registry or get_registry()
+        self._total = reg.counter(
+            "edgemesh_ensemble_total",
+            "Ensemble requests by terminal outcome "
+            "(ok/degraded_qa/refiner_fallback/no_refiner/failed — plus "
+            "admission sheds as shed/ratelimited)", ("outcome",),
+        )
+        self._branches = reg.counter(
+            "edgemesh_ensemble_branch_total",
+            "QA fan-out branches by pool and fate", ("pool", "outcome"),
+        )
+        self._latency = reg.histogram(
+            "edgemesh_ensemble_seconds",
+            "End-to-end ensemble latency by terminal outcome", ("outcome",),
+        )
+        self._stats_lock = threading.Lock()
+        self._outcome_counts: dict[str, int] = {}  # guarded by: _stats_lock
+
+    # -- topology ------------------------------------------------------------
+
+    def topology(self) -> tuple[list[str | None], str | None]:
+        """(qa_pools, refiner_pool) for this request — pinned config wins,
+        else discovered from the registry's live model descriptors."""
+        qa = list(self.qa_pools) if self.qa_pools else None
+        refiner = self.refiner_pool
+        if qa is None or refiner is None:
+            pools = self.router.registry.pools()
+            if qa is None:
+                qa = sorted(
+                    n for n, e in pools.items()
+                    if e.get("role") != REFINER_ROLE
+                )
+            if refiner is None:
+                refiners = sorted(
+                    n for n, e in pools.items()
+                    if e.get("role") == REFINER_ROLE
+                )
+                refiner = refiners[0] if refiners else None
+        if not qa:
+            qa = [None]
+        return qa, refiner
+
+    # -- request path --------------------------------------------------------
+
+    def handle(self, payload, deadline_s: float | None = None,
+               trace: TraceContext | None = None,
+               tenant: str | None = None,
+               session: str | None = None):
+        """Serve one ``POST /ensemble``. Returns ``(status, body,
+        headers)`` exactly like ``FleetRouter.handle_generate`` — the
+        frontend writes them verbatim. One admission slot covers the whole
+        fan-out: the ensemble is one request's worth of client demand, and
+        admitting each branch separately would let N-pool requests starve
+        single-pool tenants N-to-one."""
+        router = self.router
+        question = payload.get("question") if isinstance(payload, dict) else None
+        if not isinstance(question, str) or not question:
+            return 400, {"error": "missing question"}, {}
+        label = bounded_label(tenant)
+        ctx = trace or TraceContext.mint(
+            sampled=sample(router.trace_sample, router._trace_rng)
+        )
+        spans: list[dict] = [{
+            "name": "ensemble", "span_id": ctx.span_id,
+            "outcome": "pending", "t0": time.time(), "t1": None,
+        }]
+        t0 = time.monotonic()
+        budget = deadline_s if deadline_s is not None else router.default_deadline_s
+        verdict = router.admission.acquire(
+            label, wait_s=min(router.admission_wait_s, budget)
+        )
+        if verdict == "ratelimited":
+            self._total.labels(outcome="ratelimited").inc()
+            router._tenant_ratelimited.labels(tenant=label).inc()
+            router._account_tenant(label, "shed", 429, time.monotonic() - t0)
+            return 429, {
+                "error": "tenant rate limit exceeded", "tenant": label,
+            }, {RETRY_AFTER_HEADER: "1"}
+        if verdict != "ok":
+            reason = "overload" if verdict == "overload" else "queue_timeout"
+            self._total.labels(outcome="shed").inc()
+            router._account_tenant(label, "shed", 503, time.monotonic() - t0)
+            return 503, {
+                "error": "router at capacity", "kind": "overloaded",
+                "reason": reason,
+                "max_inflight": router.admission.max_inflight,
+            }, {RETRY_AFTER_HEADER: "1"}
+        router._inflight_gauge.inc()
+        try:
+            status, body, outcome = self._fan_out(
+                payload, question, t0, budget, ctx, spans,
+                tenant=tenant, session=session,
+            )
+        finally:
+            router._inflight_gauge.dec()
+            router.admission.release()
+        latency = time.monotonic() - t0
+        self._total.labels(outcome=outcome).inc()
+        self._latency.labels(outcome=outcome).observe(latency)
+        with self._stats_lock:
+            self._outcome_counts[outcome] = (
+                self._outcome_counts.get(outcome, 0) + 1
+            )
+        router._account_tenant(label, outcome, status, latency)
+        spans[0]["outcome"] = outcome
+        headers = {TRACE_HEADER: ctx.to_header()}
+        router._finish_trace(ctx, spans, status, tenant=tenant)
+        return status, body, headers
+
+    def _fan_out(self, payload, question, t0, budget, ctx, spans,
+                 tenant=None, session=None):
+        """The fan-out + refine pipeline under an already-acquired
+        admission slot. Returns ``(status, body, outcome)``."""
+        router = self.router
+        qa_pools, refiner_pool = self.topology()
+        deadline = t0 + budget
+        qa_budget = (
+            budget * self.qa_budget_fraction
+            if refiner_pool is not None else budget
+        )
+        branch_payload = {"question": question}
+        if isinstance(payload, dict) and payload.get("max_new") is not None:
+            branch_payload["max_new"] = payload["max_new"]
+
+        # One span per branch, appended with EVERY key it will ever have
+        # BEFORE its thread starts (concurrent JSON dumps must never see a
+        # dict growing), closed exactly once under span_lock — the worker
+        # and the timeout sweep below race for it.
+        span_lock = threading.Lock()
+        results: list[tuple[int, dict] | None] = [None] * len(qa_pools)
+        branch_spans: list[dict] = []
+
+        def close_span(span, outcome, status=None):
+            with span_lock:
+                if span["outcome"] != "pending":
+                    return
+                span["t1"] = time.time()
+                span["outcome"] = outcome
+                span["status"] = status
+
+        def run_branch(i, pool, bctx, span):
+            status, body, _hdrs = router._route(
+                branch_payload, t0, qa_budget, "/generate", bctx, spans,
+                meta={"outcome": "shed"}, tenant=tenant, session=session,
+                pool=pool,
+            )
+            results[i] = (status, body)  # distinct slots: no lock needed
+            close_span(span, "ok" if status == 200 else "failed", status)
+
+        threads = []
+        for i, pool in enumerate(qa_pools):
+            bctx = ctx.child()
+            span = {
+                "name": "branch", "span_id": bctx.span_id,
+                "pool": pool, "outcome": "pending", "status": None,
+                "t0": time.time(), "t1": None,
+            }
+            spans.append(span)
+            branch_spans.append(span)
+            th = threading.Thread(
+                target=run_branch, args=(i, pool, bctx, span),
+                name=f"ensemble-branch-{pool or 'fleet'}", daemon=True,
+            )
+            threads.append(th)
+            th.start()
+        qa_deadline = t0 + qa_budget
+        for th in threads:
+            # Small slack past the branch budget: _route answers within its
+            # own deadline, so a join expiring here means a genuinely
+            # wedged branch — abandon it (daemon thread) like a lost hedge.
+            th.join(timeout=max(0.0, qa_deadline - time.monotonic()) + 0.25)
+        for span in branch_spans:
+            close_span(span, "timeout")
+
+        candidates = []
+        branches = []
+        for pool, span, res in zip(qa_pools, branch_spans, results):
+            pool_label = pool or "fleet"
+            outcome = span["outcome"]
+            status = None
+            if res is not None:
+                status, body = res
+                if (status == 200 and isinstance(body, dict)
+                        and body.get("answer") is not None):
+                    candidates.append({
+                        "pool": pool_label,
+                        "answer": body["answer"],
+                        "confidence": float(body.get("confidence") or 0.0),
+                    })
+                else:
+                    outcome = "failed"
+            self._branches.labels(pool=pool_label, outcome=outcome).inc()
+            branches.append(
+                {"pool": pool_label, "outcome": outcome, "status": status}
+            )
+        degraded = any(b["outcome"] != "ok" for b in branches)
+
+        if not candidates:
+            # The ONLY client-visible ensemble failure: nothing to refine,
+            # nothing to fall back on.
+            return 502, {
+                "error": "every QA branch failed", "kind": "ensemble_failed",
+                "branches": branches,
+            }, "failed"
+
+        best = max(candidates, key=lambda c: c["confidence"])
+        base_body = {
+            "candidates": candidates, "branches": branches,
+        }
+        if refiner_pool is None:
+            return 200, {
+                **base_body, "answer": best["answer"],
+                "confidence": best["confidence"],
+                "outcome": "no_refiner", "refined": False,
+            }, "no_refiner"
+
+        # Refine over the survivors — a single-candidate refine is the
+        # degraded-QA path, not an error. The refiner pool's replicas
+        # serve a passthrough template, so the composed prompt (the SAME
+        # agents/prompts.py template the in-process ensemble uses) rides
+        # the wire as the question.
+        refine_payload = {
+            "question": format_refiner_prompt(
+                question, [c["answer"] for c in candidates]
+            ),
+        }
+        if "max_new" in branch_payload:
+            refine_payload["max_new"] = branch_payload["max_new"]
+        rctx = ctx.child()
+        rspan = {
+            "name": "refine", "span_id": rctx.span_id,
+            "pool": refiner_pool, "outcome": "pending", "status": None,
+            "t0": time.time(), "t1": None,
+        }
+        spans.append(rspan)
+        if deadline - time.monotonic() <= 0:
+            close_span(rspan, "timeout")
+            return 200, {
+                **base_body, "answer": best["answer"],
+                "confidence": best["confidence"],
+                "outcome": "refiner_fallback", "refined": False,
+            }, "refiner_fallback"
+        status, body, _hdrs = router._route(
+            refine_payload, t0, budget, "/generate", rctx, spans,
+            meta={"outcome": "shed"}, tenant=tenant, session=session,
+            pool=refiner_pool,
+        )
+        if (status == 200 and isinstance(body, dict)
+                and body.get("answer") is not None):
+            close_span(rspan, "ok", status)
+            outcome = "degraded_qa" if degraded else "ok"
+            return 200, {
+                **base_body, "answer": body["answer"],
+                "confidence": float(
+                    body.get("confidence") or best["confidence"]
+                ),
+                "outcome": outcome, "refined": True,
+            }, outcome
+        close_span(rspan, "failed", status)
+        return 200, {
+            **base_body, "answer": best["answer"],
+            "confidence": best["confidence"],
+            "outcome": "refiner_fallback", "refined": False,
+        }, "refiner_fallback"
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The /fleetz view: live topology + per-outcome request counts."""
+        qa_pools, refiner_pool = self.topology()
+        with self._stats_lock:
+            outcomes = dict(sorted(self._outcome_counts.items()))
+        return {
+            "qa_pools": [p or "fleet" for p in qa_pools],
+            "refiner_pool": refiner_pool,
+            "qa_budget_fraction": self.qa_budget_fraction,
+            "outcomes": outcomes or None,
+        }

@@ -21,7 +21,11 @@ every request is answered by the router, never by a local model:
   optional ``X-Edgemesh-Tenant`` selects the admission policy (rate
   limits, fairness weight, priority lane — fleet/admission.py) and labels
   the per-tenant counters ``/fleetz`` summarizes
-- ``POST /replicas/register``   {"id": ..., "url": ...}
+- ``POST /ensemble``        → parallel QA fan-out across the model pools +
+  the refiner pipeline (fleet/ensemble.py), with graceful degradation —
+  same deadline/trace/tenant/session header plumbing as ``/generate``
+- ``POST /replicas/register``   {"id": ..., "url": ..., "model": {...}?}
+  — the optional model descriptor enrolls the replica in a model pool
 - ``POST /replicas/deregister`` {"id": ...}
 - ``POST /replicas/drain``      {"id": ...} → graceful drain (blocks until
   drained or the drain timeout; the threaded server keeps routing)
@@ -44,8 +48,8 @@ log = logging.getLogger("edgemesh.fleet")
 SERVED_ROUTES: dict[str, tuple[str, ...]] = {
     "GET": ("/", "/healthz", "/readyz", "/fleetz", "/metrics",
             "/debug/traces/"),
-    "POST": ("/generate", "/replicas/register", "/replicas/deregister",
-             "/replicas/drain"),
+    "POST": ("/generate", "/ensemble", "/replicas/register",
+             "/replicas/deregister", "/replicas/drain"),
 }
 
 
@@ -127,6 +131,23 @@ def _make_handler(router, request_timeout_s: float | None):
                         session=httputil.read_session_header(self),
                     )
                     self._send(status, body, extra=extra)
+                elif self.path == "/ensemble":
+                    # Parallel QA fan-out + refiner pipeline over the model
+                    # pools (fleet/ensemble.py) — same header plumbing as
+                    # /generate, one admission slot for the whole fan-out.
+                    payload = self._read_json()
+                    if payload is None:
+                        return
+                    ok, deadline_s = httputil.read_deadline_header(self)
+                    if not ok:
+                        return
+                    status, body, extra = router.ensemble.handle(
+                        payload, deadline_s=deadline_s,
+                        trace=httputil.read_trace_header(self),
+                        tenant=httputil.read_tenant_header(self),
+                        session=httputil.read_session_header(self),
+                    )
+                    self._send(status, body, extra=extra)
                 elif self.path in ("/replicas/register", "/replicas/deregister",
                                    "/replicas/drain"):
                     payload = self._read_json()
@@ -155,7 +176,14 @@ def _make_handler(router, request_timeout_s: float | None):
                 if not url:
                     self._send(400, {"error": "missing 'url' field"})
                     return
-                router.registry.register(rid, url)
+                # The optional model descriptor ({"pool", "role", ...})
+                # enrolls the replica in a model-keyed pool; absent, the
+                # replica serves the homogeneous fleet (docs/FLEET.md
+                # "Ensemble serving").
+                model = payload.get("model")
+                router.registry.register(
+                    rid, url, model=model if isinstance(model, dict) else None,
+                )
                 self._send(200, {"registered": rid, "url": url})
             elif self.path == "/replicas/deregister":
                 # Through the router, not the bare registry: forget_replica

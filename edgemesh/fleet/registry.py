@@ -48,6 +48,11 @@ class Replica:
     last_probe_ts: float | None = None
     last_error: str = ""
     meta: dict = field(default_factory=dict)  # operator annotations (pid, ...)
+    # Model descriptor shipped at registration ({"pool", "role", "family",
+    # "size", ...} — serve/httputil.py WIRE_CONTRACT): which model this
+    # backend serves and therefore which pool it routes in. None = the
+    # homogeneous fleet (pre-descriptor replicas belong to no named pool).
+    model: dict | None = None
     # Latest load digest shipped on the replica's /readyz body (queue depth,
     # latency EWMAs, SLO goodput, recent-compile flag — serve/rest.py), and
     # the RECEIVER-side monotonic stamp the telemetry balancer ages it by
@@ -57,6 +62,10 @@ class Replica:
 
     def load_age_s(self) -> float | None:
         return None if self.load_ts is None else time.monotonic() - self.load_ts
+
+    @property
+    def pool(self) -> str | None:
+        return (self.model or {}).get("pool")
 
     def url(self, path: str) -> str:
         return self.base_url.rstrip("/") + path
@@ -76,6 +85,8 @@ class Replica:
             "last_probe_ts": self.last_probe_ts,
             "last_error": self.last_error,
             **({"meta": self.meta} if self.meta else {}),
+            **({"model": self.model, "pool": self.pool}
+               if self.model is not None else {}),
             **({
                 "load": self.load,
                 "load_age_s": round(self.load_age_s(), 3),
@@ -94,7 +105,8 @@ class ReplicaRegistry:
 
     # -- membership ----------------------------------------------------------
 
-    def register(self, rid: str, base_url: str, **meta) -> Replica:
+    def register(self, rid: str, base_url: str,
+                 model: dict | None = None, **meta) -> Replica:
         """Add (or revive) a replica. Fail-open: immediately routable.
 
         Re-registering a LIVE replica at the same URL is idempotent — the
@@ -113,13 +125,26 @@ class ReplicaRegistry:
                     # idempotent heartbeats must not blind the balancer.)
                     rep.load = None
                     rep.load_ts = None
+                    # Same for the model descriptor: the revived process
+                    # declares what it serves NOW; the dead incarnation's
+                    # pool membership must not route model-keyed traffic
+                    # to a backend that may have come back with a
+                    # different checkpoint.
+                    rep.model = None
                 rep.state = "healthy"
                 rep.consecutive_failures = 0
                 rep.consecutive_successes = 0
+                if isinstance(model, dict):
+                    # A live heartbeat without a descriptor keeps the
+                    # existing one (idempotence, like meta).
+                    rep.model = dict(model)
                 if meta:
                     rep.meta.update(meta)
                 return rep
-            rep = Replica(rid=rid, base_url=base_url, meta=dict(meta))
+            rep = Replica(
+                rid=rid, base_url=base_url, meta=dict(meta),
+                model=dict(model) if isinstance(model, dict) else None,
+            )
             self._replicas[rid] = rep
             return rep
 
@@ -135,9 +160,33 @@ class ReplicaRegistry:
         with self._lock:
             return list(self._replicas.values())
 
-    def available(self) -> list[Replica]:
+    def available(self, pool: str | None = None) -> list[Replica]:
         with self._lock:
-            return [r for r in self._replicas.values() if r.routable()]
+            return [
+                r for r in self._replicas.values()
+                if r.routable() and (pool is None or r.pool == pool)
+            ]
+
+    def pools(self) -> dict[str, dict]:
+        """Per-pool membership view for /fleetz and the ensemble
+        coordinator: rids, the pool's role (first declared wins), and how
+        many members are currently routable. Replicas without a model
+        descriptor belong to no named pool and do not appear here."""
+        with self._lock:
+            out: dict[str, dict] = {}
+            for r in self._replicas.values():
+                name = r.pool
+                if name is None:
+                    continue
+                entry = out.setdefault(
+                    name, {"replicas": [], "role": None, "routable": 0}
+                )
+                entry["replicas"].append(r.rid)
+                if entry["role"] is None:
+                    entry["role"] = (r.model or {}).get("role")
+                if r.routable():
+                    entry["routable"] += 1
+            return out
 
     def set_state(self, rid: str, state: str) -> None:
         if state not in STATES:
@@ -152,17 +201,25 @@ class ReplicaRegistry:
                     # snapshot outliving stale_after_s was the bug.
                     rep.load = None
                     rep.load_ts = None
+                    # Pool membership dies with the backend for the same
+                    # reason: a removed replica must fall out of every
+                    # model-keyed pool immediately, not when it is
+                    # eventually deregistered.
+                    rep.model = None
 
     # -- routing bookkeeping -------------------------------------------------
 
     def acquire(self, balancer, prompt: str | None = None,
-                exclude: frozenset | set = frozenset()) -> Replica | None:
+                exclude: frozenset | set = frozenset(),
+                pool: str | None = None) -> Replica | None:
         """Atomically pick a routable replica via ``balancer`` and check out
-        one unit of outstanding work on it. Pair with ``release``."""
+        one unit of outstanding work on it. Pair with ``release``. With
+        ``pool`` set, only members of that model pool are candidates."""
         with self._lock:
             candidates = [
                 r for r in self._replicas.values()
                 if r.routable() and r.rid not in exclude
+                and (pool is None or r.pool == pool)
             ]
             if not candidates:
                 return None

@@ -245,6 +245,15 @@ class FleetRouter:
         self.tiers: TierManager | None = None
         if self.tiered:
             self.tiers = tier_manager or TierManager()
+        # Model-keyed pools (docs/FLEET.md "Ensemble serving"): replicas
+        # registered with a model descriptor route per pool. Tier
+        # membership and the auto-hedge estimator are PER POOL — a shared
+        # TierManager's cached assignment would leak one pool's split into
+        # another's requests, and one slow pool's p95 would arm hedges
+        # fleet-wide. Pool None keeps the legacy homogeneous instances.
+        self._pool_lock = threading.Lock()
+        self._pool_tiers: dict[str, TierManager] = {}  # guarded by: _pool_lock
+        self._pool_hedge: dict[str, DecayingQuantile] = {}  # guarded by: _pool_lock
         self.prefill_threshold_chars = int(prefill_threshold_chars)
         self.prefix_chars = int(prefix_chars)
         self.prefix_hot_after = int(prefix_hot_after)
@@ -364,13 +373,53 @@ class FleetRouter:
         # after construction (the scaler needs the router for drains). The
         # router only forwards incident signals to it.
         self.autoscaler = None
+        # The ensemble coordinator (fleet/ensemble.py): fans POST /ensemble
+        # out across the QA pools and drives the refiner pool, all through
+        # this router's _route — so branches inherit per-pool hedging,
+        # tiering, and the shared trace machinery.
+        from edgemesh.fleet.ensemble import EnsembleCoordinator
+
+        self.ensemble = EnsembleCoordinator(self, obs_registry=reg)
+
+    # -- model-keyed pools ---------------------------------------------------
+
+    def _tiers_for(self, pool: str | None) -> TierManager | None:
+        """The tier manager scoped to ``pool`` (lazily created; pool None =
+        the legacy fleet-wide instance). Per-pool because TierManager
+        caches its assignment: alternating calls over different replica
+        subsets would serve one pool the other's cached split."""
+        if self.tiers is None:
+            return None
+        if pool is None:
+            return self.tiers
+        with self._pool_lock:
+            tm = self._pool_tiers.get(pool)
+            if tm is None:
+                tm = TierManager(
+                    prefill_fraction=self.tiers.prefill_fraction,
+                    refresh_s=self.tiers.refresh_s,
+                    hysteresis=self.tiers.hysteresis,
+                )
+                self._pool_tiers[pool] = tm
+            return tm
+
+    def _hedge_estimator_for(self, pool: str | None) -> DecayingQuantile:
+        if pool is None:
+            return self._hedge_estimator
+        with self._pool_lock:
+            est = self._pool_hedge.get(pool)
+            if est is None:
+                est = DecayingQuantile()
+                self._pool_hedge[pool] = est
+            return est
 
     # -- request path --------------------------------------------------------
 
     def handle_generate(self, payload: dict, deadline_s: float | None = None,
                         path: str = "/generate", trace: TraceContext | None = None,
                         tenant: str | None = None,
-                        session: str | None = None):
+                        session: str | None = None,
+                        pool: str | None = None):
         """Route one request. Returns ``(status, body, headers)`` — the
         HTTP frontend writes them verbatim; in-process callers (tests,
         benchmarks) read them directly. ``trace`` joins an existing trace
@@ -432,7 +481,7 @@ class FleetRouter:
             try:
                 status, body, headers = self._route(
                     payload, t0, deadline_s, path, ctx, spans, meta,
-                    tenant=tenant, session=session,
+                    tenant=tenant, session=session, pool=pool,
                 )
             finally:
                 self._inflight_gauge.dec()
@@ -503,7 +552,8 @@ class FleetRouter:
             self._trace_log.log(ROUTER_RECORD_EVENT, **fields)
 
     def _route(self, payload, t0, deadline_s, path, ctx, spans, meta=None,
-               tenant: str | None = None, session: str | None = None):
+               tenant: str | None = None, session: str | None = None,
+               pool: str | None = None):
         meta = meta if meta is not None else {"outcome": "shed"}
         deadline = t0 + (deadline_s if deadline_s is not None else self.default_deadline_s)
         prompt = payload.get("question") if isinstance(payload, dict) else None
@@ -517,12 +567,12 @@ class FleetRouter:
         # a request.
         tier_exclude: frozenset[str] = frozenset()
         if self.tiers is not None and prompt and path == "/generate":
-            plan = self._tier_plan(prompt)
+            plan = self._tier_plan(prompt, pool=pool)
             if plan is not None:
                 if plan["transfer"]:
                     out = self._tiered_generate(
                         plan, payload, prompt, t0, deadline, ctx, spans,
-                        meta, tenant=tenant, session=session,
+                        meta, tenant=tenant, session=session, pool=pool,
                     )
                     if out is not None:
                         return out
@@ -541,21 +591,23 @@ class FleetRouter:
                 return 504, {"error": "deadline exceeded", "attempts": attempt,
                              "last_error": last_error}, {}
             rep = self.registry.acquire(self.balancer, prompt=prompt,
-                                        exclude=excluded | tier_exclude)
+                                        exclude=excluded | tier_exclude,
+                                        pool=pool)
             if rep is None and (excluded or tier_exclude):
                 # Every routable replica has failed once this request (or
                 # the tier hint excluded them all): reset exclusions rather
                 # than give up with replicas alive.
                 excluded.clear()
                 tier_exclude = frozenset()
-                rep = self.registry.acquire(self.balancer, prompt=prompt, exclude=excluded)
+                rep = self.registry.acquire(self.balancer, prompt=prompt,
+                                            exclude=excluded, pool=pool)
             if rep is None:
                 self._shed.labels(reason="no_replica").inc()
                 meta["outcome"] = "shed"
                 return 503, {"error": "no available replica"}, {RETRY_AFTER_HEADER: "1"}
             outcome = self._dispatch(rep, payload, path, deadline, prompt,
                                      excluded, ctx, spans, meta, tenant=tenant,
-                                     session=session)
+                                     session=session, pool=pool)
             if outcome[0] == "ok":
                 _, rid, status, body, won_span = outcome
                 won_span["won"] = True
@@ -585,28 +637,35 @@ class FleetRouter:
 
     # -- tiered serving (prefill/decode disaggregation) ----------------------
 
-    def _tier_plan(self, prompt: str) -> dict | None:
+    def _tier_plan(self, prompt: str, pool: str | None = None) -> dict | None:
         """Classify one request against the live tier assignment. Returns
         None when the fleet cannot be tiered right now (either tier empty
         → fully homogeneous routing), else ``{"prefill", "decode",
         "transfer", "key", "export_q"}``: long prompts transfer under the
         full-prompt key; short prompts transfer only once their prefix key
-        is HOT (``prefix_hot_after`` sightings), exporting just the prefix."""
-        tiers = self.tiers.assign(self.registry.replicas())
+        is HOT (``prefix_hot_after`` sightings), exporting just the prefix.
+        With a pool, tiering happens WITHIN the pool's members and every
+        cache/hotness key is pool-namespaced — a KV payload prefillled by
+        one model must never import into another model's cache."""
+        reps = self.registry.replicas()
+        if pool is not None:
+            reps = [r for r in reps if r.pool == pool]
+        tiers = self._tiers_for(pool).assign(reps)
         pre, dec = tiers["prefill"], tiers["decode"]
         if not pre or not dec:
             return None
         plan = {"prefill": pre, "decode": dec}
+        ns = "" if pool is None else pool + "\x00"
         if len(prompt) >= self.prefill_threshold_chars:
-            plan.update(transfer=True, key=prompt, export_q=prompt)
+            plan.update(transfer=True, key=ns + prompt, export_q=prompt)
             return plan
-        key = prompt[: self.prefix_chars]
-        hot = self._note_prefix(key)
-        plan.update(transfer=hot, key=key, export_q=key)
+        prefix = prompt[: self.prefix_chars]
+        hot = self._note_prefix(ns + prefix)
+        plan.update(transfer=hot, key=ns + prefix, export_q=prefix)
         return plan
 
     def _tiered_generate(self, plan, payload, prompt, t0, deadline, ctx,
-                         spans, meta, tenant=None, session=None):
+                         spans, meta, tenant=None, session=None, pool=None):
         """The transfer path: export the prompt (or its hot prefix) from a
         prefill-tier replica — rendezvous-chosen by prefix key, the same
         keying as ``prefix_affinity``, so repeats land on the replica whose
@@ -625,7 +684,7 @@ class FleetRouter:
                     key[: self.prefix_chars], r.rid),
             )
             rep = self.registry.acquire(_PinnedBalancer(owner.rid),
-                                        prompt=prompt)
+                                        prompt=prompt, pool=pool)
             if rep is None:
                 self._tiered_requests.labels(outcome="fallback_no_replica").inc()
                 return None
@@ -645,7 +704,8 @@ class FleetRouter:
                       "tokens": body.get("tokens")}
             self._kv_cache_put(key, cached)
         dest = min(plan["decode"], key=lambda r: (r.outstanding, r.rid))
-        rep = self.registry.acquire(_PinnedBalancer(dest.rid), prompt=prompt)
+        rep = self.registry.acquire(_PinnedBalancer(dest.rid), prompt=prompt,
+                                    pool=pool)
         if rep is None:
             self._tiered_requests.labels(outcome="fallback_no_replica").inc()
             return None
@@ -712,6 +772,10 @@ class FleetRouter:
         recovering pool does not mask another's pressure."""
         if self.tiers is not None:
             self.tiers.invalidate()
+            with self._pool_lock:
+                pool_tiers = list(self._pool_tiers.values())
+            for tm in pool_tiers:
+                tm.invalidate()
         self.admission.note_mem_forecast(load, replica=rid)
 
     def _backoff(self, attempt: int, deadline: float) -> float:
@@ -724,7 +788,8 @@ class FleetRouter:
     def _attempt_one(self, rep, payload, path, deadline, ctx, spans,
                      hedge: bool = False, tenant: str | None = None,
                      session: str | None = None,
-                     record_latency: bool = True):
+                     record_latency: bool = True,
+                     pool: str | None = None):
         """One checked-out attempt → ("ok", rid, status, body) for any
         answered status < 500, else ("fail", rid, reason, detail).
 
@@ -789,11 +854,14 @@ class FleetRouter:
             lat = time.monotonic() - t0
             with self._lat_lock:
                 self._lat_window.append(lat)
-            self._hedge_estimator.observe(lat)
+            # Auto-hedge learns per pool: one pool's latency regime must
+            # not arm (or suppress) hedges in another's. (_lat_window —
+            # the legacy percentile mode — stays fleet-wide.)
+            self._hedge_estimator_for(pool).observe(lat)
         close("ok", status)
         return ("ok", rep.rid, status, body, span)
 
-    def _hedge_delay(self) -> float | None:
+    def _hedge_delay(self, pool: str | None = None) -> float | None:
         """The current hedge-arming delay: fixed (``hedge_after_s``) beats
         the legacy rolling-window percentile (``hedge_percentile``) beats
         the auto-tuned mode (``hedge_auto``: the live ``hedge_quantile`` of
@@ -808,20 +876,20 @@ class FleetRouter:
                 return xs[min(len(xs) - 1, int(self.hedge_percentile * len(xs)))]
             return None
         if self.hedge_auto:
-            d = self._hedge_estimator.quantile(self.hedge_quantile)
+            d = self._hedge_estimator_for(pool).quantile(self.hedge_quantile)
             return None if d is None else max(d, self.hedge_floor_s)
         return None
 
     def _dispatch(self, rep, payload, path, deadline, prompt, excluded,
                   ctx, spans, meta=None, tenant: str | None = None,
-                  session: str | None = None):
+                  session: str | None = None, pool: str | None = None):
         """One attempt round, hedged when configured. Returns
         ("ok", rid, status, body) or ("fail", [(rid, reason, detail), ...]).
         Every attempt (primary and hedge) gets its own child trace context
         — distinct span ids are what let the assembled tree show the hedge
         as a sibling of the attempt it raced."""
         meta = meta if meta is not None else {"outcome": "shed"}
-        hedge_delay = self._hedge_delay()
+        hedge_delay = self._hedge_delay(pool)
         # KV transfers are non-idempotent fleet-side (a hedged import
         # double-admits the request, a hedged export doubles a prefill):
         # they NEVER hedge, regardless of configuration. Their tail story
@@ -831,7 +899,7 @@ class FleetRouter:
         if hedge_delay is None or hedge_delay >= (deadline - time.monotonic()):
             out = self._attempt_one(rep, payload, path, deadline,
                                     ctx.child(), spans, tenant=tenant,
-                                    session=session)
+                                    session=session, pool=pool)
             return out if out[0] == "ok" else ("fail", [out[1:]])
 
         results: queue.Queue = queue.Queue()
@@ -839,7 +907,7 @@ class FleetRouter:
         def run(replica, is_hedge):
             results.put((is_hedge, self._attempt_one(
                 replica, payload, path, deadline, ctx.child(), spans,
-                hedge=is_hedge, tenant=tenant, session=session,
+                hedge=is_hedge, tenant=tenant, session=session, pool=pool,
             )))
 
         threading.Thread(target=run, args=(rep, False), daemon=True).start()
@@ -857,7 +925,8 @@ class FleetRouter:
             return ("fail", [first[1][1:]])
 
         hedge_rep = self.registry.acquire(
-            self.balancer, prompt=prompt, exclude=excluded | {rep.rid}
+            self.balancer, prompt=prompt, exclude=excluded | {rep.rid},
+            pool=pool,
         )
         if hedge_rep is not None:
             self._hedged.labels(replica=hedge_rep.rid).inc()
@@ -976,6 +1045,10 @@ class FleetRouter:
         existed = self.registry.deregister(rid)
         if self.tiers is not None:
             self.tiers.forget(rid)
+            with self._pool_lock:
+                pool_tiers = list(self._pool_tiers.values())
+            for tm in pool_tiers:
+                tm.forget(rid)
         # A forgotten replica's pool forecast must not keep deferring
         # batch admissions — passing no digest clears its entry.
         self.admission.note_mem_forecast(None, replica=rid)
@@ -1276,6 +1349,12 @@ class FleetRouter:
                 "delay_s": None if delay is None else round(delay, 6),
                 "estimator_weight": round(self._hedge_estimator.weight(), 3),
             },
+            # Model-keyed pools: per-pool membership/role/routable counts
+            # plus the ensemble coordinator's discovery + outcome view
+            # (docs/FLEET.md "Ensemble serving"). Null when the fleet is
+            # homogeneous (no replica shipped a model descriptor).
+            "pools": self.registry.pools() or None,
+            "ensemble": self.ensemble.stats(),
             "replicas": self.registry.snapshot(),
             "metrics": self.obs.summary(prefix="edgemesh_fleet_"),
             "recent_traces": self.recent_traces(),

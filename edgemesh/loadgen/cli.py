@@ -36,6 +36,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--url", required=True,
                    help="the /generate endpoint to drive (fleet frontend "
                    "or a single replica gateway)")
+    p.add_argument("--target", default="generate",
+                   choices=["generate", "ensemble"],
+                   help="which serving route the traffic drives: "
+                   "'ensemble' rewrites the URL's path to the fleet "
+                   "frontend's POST /ensemble fan-out (same tenant/"
+                   "session/SLO accounting — docs/FLEET.md 'Ensemble "
+                   "serving')")
     p.add_argument("--rate", type=float, default=2.0,
                    help="aggregate offered load in requests/s")
     p.add_argument("--sweep", default=None, metavar="R1,R2,...",
@@ -85,6 +92,19 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def resolve_target_url(url: str, target: str) -> str:
+    """Point ``url`` at the requested serving route: a bare base URL gets
+    the route appended; a URL already ending in ``/generate`` or
+    ``/ensemble`` is rewritten, so existing ``--url .../generate`` command
+    lines switch routes with nothing but ``--target ensemble``."""
+    base = url.rstrip("/")
+    for suffix in ("/generate", "/ensemble"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+            break
+    return base + "/" + target
+
+
 def _tenant_shares(specs: list[str]) -> list[tuple[str, float, str]]:
     if not specs:
         return [("default", 1.0, "interactive")]
@@ -129,7 +149,8 @@ def _make_workload(args, rate: float) -> Workload:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    target = http_target(args.url, timeout_s=args.timeout_s)
+    target = http_target(resolve_target_url(args.url, args.target),
+                         timeout_s=args.timeout_s)
 
     if args.replay:
         # Incident replay: the recorded schedule IS the traffic — the

@@ -117,6 +117,10 @@ def read_session_header(handler) -> str | None:
 KV_EXPORT_PATH = "/kv/export"
 KV_IMPORT_PATH = "/kv/import"
 
+#: The fleet frontend's ensemble fan-out route (fleet/ensemble.py): one
+#: question, N parallel QA pool branches, one refiner pass.
+ENSEMBLE_PATH = "/ensemble"
+
 #: Decoded payload size cap: a transfer bigger than this is refused with a
 #: structured 400 before any base64 work lands on the heap. Generous — a
 #: full-context 8B-model prefix is tens of MB — while still bounding what
@@ -201,6 +205,14 @@ WIRE_CONTRACT: dict[tuple[str, str], dict] = {
         "request_keys": ("question", "max_new"),
         "error_kinds": ("draining", "overloaded", "deadline", "internal"),
     },
+    ("POST", ENSEMBLE_PATH): {
+        "servers": ("frontend",),
+        "required_headers": (TRACE_HEADER,),
+        "forwarded_headers": (DEADLINE_HEADER, TENANT_HEADER, SESSION_HEADER),
+        "request_keys": ("question", "max_new"),
+        "error_kinds": ("ensemble_failed", "overloaded", "deadline",
+                        "internal"),
+    },
     ("POST", "/generate_stream"): {
         "servers": ("gateway",),
         "required_headers": (TRACE_HEADER,),
@@ -234,7 +246,10 @@ WIRE_CONTRACT: dict[tuple[str, str], dict] = {
     },
     ("POST", "/replicas/register"): {
         "servers": ("frontend",),
-        "request_keys": ("id", "url"),
+        # "model" is the optional model descriptor ({"pool", "role",
+        # "family", "size", ...}) that enrolls the replica in a model-keyed
+        # pool (fleet/registry.py, docs/FLEET.md "Ensemble serving").
+        "request_keys": ("id", "url", "model"),
     },
     ("POST", "/replicas/deregister"): {
         "servers": ("frontend",),

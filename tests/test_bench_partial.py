@@ -123,6 +123,7 @@ def test_headline_serving_schema_gains_ragged_and_spec_keys(monkeypatch, capsys)
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_ENSEMBLE", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
 
     out = benchmarks.headline_benchmark(preset="llama1b", batch=2,
@@ -235,6 +236,7 @@ def test_router_overhead_stage_schema_pins_recorder_arm(monkeypatch, capsys):
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_ENSEMBLE", "0")
     out = benchmarks.headline_benchmark(preset="tiny", batch=2,
                                         decode_steps=8, sweep_batches=())
     assert out["router_overhead_p50_s"] == 0.0021
@@ -678,6 +680,7 @@ def test_compute_ledger_keys_ride_bench_json(monkeypatch, capsys):
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_ENSEMBLE", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
 
     out = benchmarks.headline_benchmark(preset="llama1b", batch=2,
@@ -806,6 +809,7 @@ def test_mem_ledger_keys_ride_bench_json(monkeypatch, capsys):
     monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_ENSEMBLE", "0")
     monkeypatch.setenv("EDGEMESH_BENCH_PRESET", "llama1b")
 
     out = benchmarks.headline_benchmark(preset="llama1b", batch=2,
@@ -864,3 +868,109 @@ def test_compute_ledger_keys_honor_stage_skip_gates(monkeypatch):
         k in ("serving_compute", "spec_round_ledger", "ledgeroff_p50_s",
               "ledger_overhead_p50_s", "ledger_overhead_ratio")
         for k in out)
+
+
+def _fake_fleet_side_stages(monkeypatch):
+    """Fakes for the OTHER two stages riding EDGEMESH_BENCH_FLEET, so a
+    test can leave the fleet gate on without spinning real replicas."""
+
+    def fake_overhead(**kw):
+        return {"metric": "router_overhead_p50_s", "value": 0.0021,
+                "unit": "s", "n_requests": 40,
+                "direct_p50_s": 0.010, "direct_p99_s": 0.015,
+                "routed_p50_s": 0.0121, "routed_p99_s": 0.018,
+                "overhead_p99_s": 0.003,
+                "traced_p50_s": 0.013, "traced_p99_s": 0.019,
+                "tracing_overhead_p50_s": 0.0009,
+                "tracing_overhead_p99_s": 0.001,
+                "recorder_p50_s": 0.01215, "recorder_p99_s": 0.0181,
+                "recorder_overhead_p50_s": 0.00005,
+                "recorder_overhead_p99_s": 0.0001,
+                "recorder_ring_records": 41,
+                "sample_trace": None, "obs": {}}
+
+    def fake_adaptive(**kw):
+        return {"metric": "adaptive_over_least_outstanding_p99",
+                "value": 1.4, "unit": "x", "slo_target_s": 0.25}
+
+    monkeypatch.setattr(benchmarks, "router_overhead_benchmark",
+                        fake_overhead)
+    monkeypatch.setattr(benchmarks, "adaptive_router_benchmark",
+                        fake_adaptive)
+
+
+def test_ensemble_stage_schema_pins(monkeypatch, capsys):
+    """The ensemble-serving schema contract: a headline run carries the
+    ensemble-vs-single p99 latency ratio, the per-arm percentiles, the
+    degradation-outcome counts, and the eval quality delta — pinned with
+    a faked stage so a partial artifact still has the keys docs/FLEET.md
+    'Ensemble serving' references (no replicas spun)."""
+    _fake_stage1(monkeypatch)
+    _fake_fleet_side_stages(monkeypatch)
+    monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SERVE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
+    monkeypatch.delenv("EDGEMESH_BENCH_ENSEMBLE", raising=False)
+
+    def fake_ensemble(**kw):
+        return {"metric": "ensemble_latency_p99_ratio", "value": 1.8,
+                "unit": "ratio", "n_requests": 12,
+                "ensemble_p50_s": 0.041, "ensemble_p99_s": 0.09,
+                "single_p50_s": 0.02, "single_p99_s": 0.05,
+                "outcomes": {"degraded_qa": 1, "ok": 10,
+                             "refiner_fallback": 1},
+                "qa_pools": ["qa-a", "qa-b"], "refiner_pool": "refiner",
+                "ensemble_quality": 0.31, "single_quality": 0.27,
+                "quality_delta": 0.04, "eval_samples": 8, "obs": {}}
+
+    monkeypatch.setattr(benchmarks, "fleet_ensemble_benchmark",
+                        fake_ensemble)
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert out["ensemble_latency_p99_ratio"] == 1.8
+    assert out["ensemble_p99_s"] == 0.09
+    assert out["ensemble_single_p99_s"] == 0.05
+    # Every degradation outcome the coordinator counted rides the artifact.
+    assert out["ensemble_outcomes"]["ok"] == 10
+    assert out["ensemble_outcomes"]["degraded_qa"] == 1
+    assert out["ensemble_outcomes"]["refiner_fallback"] == 1
+    assert out["ensemble_quality_delta"] == 0.04
+    assert out["ensemble_eval_samples"] == 8
+    lines = [json.loads(l)
+             for l in capsys.readouterr().out.strip().splitlines()]
+    assert "ensemble_latency_p99_ratio" in lines[-1]
+
+
+def test_ensemble_stage_is_skippable_via_env(monkeypatch):
+    """EDGEMESH_BENCH_ENSEMBLE=0 must skip the ensemble stage even with
+    the fleet gate on — no replicas spun, no keys emitted, no error
+    recorded — and EDGEMESH_BENCH_FLEET=0 skips it too (the stage spins
+    an in-process fleet, so it rides both gates)."""
+    _fake_stage1(monkeypatch)
+    _fake_fleet_side_stages(monkeypatch)
+    monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SERVE", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
+
+    def boom(**kw):
+        raise AssertionError("fleet_ensemble_benchmark ran despite the gate")
+
+    monkeypatch.setattr(benchmarks, "fleet_ensemble_benchmark", boom)
+    monkeypatch.setenv("EDGEMESH_BENCH_ENSEMBLE", "0")
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert not any(k.startswith("ensemble") for k in out)
+
+    monkeypatch.delenv("EDGEMESH_BENCH_ENSEMBLE", raising=False)
+    monkeypatch.setenv("EDGEMESH_BENCH_FLEET", "0")
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    assert not any(k.startswith("ensemble") for k in out)

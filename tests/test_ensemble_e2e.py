@@ -1,0 +1,309 @@
+"""Ensemble-over-the-fleet end-to-end (slow tier): a REAL heterogeneous
+subprocess fleet — two QA pools (qa-a is 2 replicas, paged KV, so the pool
+tiers and the shared question prefix rides the fleet prefix cache; qa-b is
+1 replica) plus a passthrough-template refiner pool — behind the real
+router and frontend, answering ``POST /ensemble``.
+
+The acceptance pins (ISSUE 19 / ROADMAP "Ensemble serving"):
+
+- both QA branches are provably CONCURRENT: their branch spans in the
+  assembled cross-process trace have overlapping wall intervals;
+- the shared question prefix hits the fleet prefix cache
+  (``edgemesh_fleet_tiered_total{outcome="cache_hit"}``) once repeated
+  ensembles make it hot;
+- SIGKILLing a QA replica mid-load yields ZERO client-visible ensemble
+  failures (retries absorb it inside the branch);
+- killing an entire QA pool degrades to single-candidate refine
+  (outcome ``degraded_qa``), killing the refiner falls back to the best
+  QA candidate (outcome ``refiner_fallback``) — both counted AND
+  span-labeled;
+- ``edgemesh obs trace`` assembles the full fan-out tree across the
+  router's and every replica's span logs.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+QA_YAML = """
+agents:
+  - role: qa
+    model: {family: llama, num_layers: 1, hidden_size: 32, num_heads: 4,
+            num_kv_heads: 4, intermediate_size: 64}
+    sampling: {max_new_tokens: 4, do_sample: false, repetition_penalty: 1.0}
+"""
+
+# The refiner-pool replica serves a PASSTHROUGH template: the coordinator
+# composes the full refiner prompt fleet-side (agents/prompts.py), so the
+# replica must not wrap it again. Role stays "qa" — the refiner ROLE lives
+# in the registry's model descriptor, not in the replica process.
+REFINER_YAML = """
+agents:
+  - role: qa
+    prompt_template: "{question}"
+    model: {family: llama, num_layers: 1, hidden_size: 32, num_heads: 4,
+            num_kv_heads: 4, intermediate_size: 64}
+    sampling: {max_new_tokens: 4, do_sample: false, repetition_penalty: 1.0}
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_replica(cfg_path: Path, port: int, span_log: Path) -> subprocess.Popen:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-m", "edgemesh.cli", "serve",
+         "--config", str(cfg_path), "--port", str(port),
+         "--continuous", "--batch", "2", "--kv-backend", "paged",
+         "--span-log", str(span_log)],
+        env=env, cwd=Path(__file__).resolve().parent.parent,
+    )
+
+
+def _wait_ready(transport, ports, timeout_s=300.0):
+    from edgemesh.fleet.transport import TransportError
+
+    deadline = time.monotonic() + timeout_s
+    pending = set(ports)
+    while pending and time.monotonic() < deadline:
+        for port in list(pending):
+            try:
+                status, _ = transport.get_json(
+                    f"http://127.0.0.1:{port}/readyz", timeout_s=2.0)
+            except TransportError:
+                continue
+            if status == 200:
+                pending.discard(port)
+        time.sleep(0.25)
+    assert not pending, f"replicas on ports {sorted(pending)} never became ready"
+
+
+def _post(url: str, payload: dict, timeout_s: float = 300.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return r.status, json.load(r), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e), dict(e.headers)
+
+
+def _branch_children(tree: dict) -> list[dict]:
+    return [c for c in tree["children"] if c.get("name") == "branch"]
+
+
+def test_ensemble_fleet_fanout_degradation_and_trace(tmp_path):
+    from edgemesh.fleet import FleetRouter, HttpTransport, ReplicaRegistry, \
+        serve_fleet
+    from edgemesh.obs import Registry
+    from edgemesh.obs.trace import load_trace
+    from edgemesh.serve.httputil import TRACE_HEADER
+
+    qa_cfg = tmp_path / "qa.yaml"
+    qa_cfg.write_text(QA_YAML)
+    ref_cfg = tmp_path / "refiner.yaml"
+    ref_cfg.write_text(REFINER_YAML)
+
+    # qa-a is the 2-replica pool: big enough to tier (prefill + decode),
+    # so the shared question prefix can ride the pool's KV cache.
+    fleet = [
+        ("qa-a-0", qa_cfg, {"pool": "qa-a", "role": "qa"}),
+        ("qa-a-1", qa_cfg, {"pool": "qa-a", "role": "qa"}),
+        ("qa-b-0", qa_cfg, {"pool": "qa-b", "role": "qa"}),
+        ("refiner-0", ref_cfg, {"pool": "refiner", "role": "refiner"}),
+    ]
+    ports = {rid: _free_port() for rid, _, _ in fleet}
+    span_logs = {rid: tmp_path / f"spans-{rid}.jsonl" for rid, _, _ in fleet}
+    procs = {rid: _spawn_replica(cfg, ports[rid], span_logs[rid])
+             for rid, cfg, _ in fleet}
+    router_spans = tmp_path / "router-spans.jsonl"
+    transport = HttpTransport()
+    front = None
+    try:
+        _wait_ready(transport, list(ports.values()))
+        # Warm every replica's decode compile (and qa-a's export gather)
+        # outside any measured or fault window.
+        for rid, _, _ in fleet:
+            status, _ = transport.post_json(
+                f"http://127.0.0.1:{ports[rid]}/generate",
+                {"question": "warmup?"}, timeout_s=300.0)
+            assert status == 200
+        for rid in ("qa-a-0", "qa-a-1"):
+            status, body = transport.post_json(
+                f"http://127.0.0.1:{ports[rid]}/kv/export",
+                {"question": "warm the export path, please?"},
+                timeout_s=300.0)
+            assert status == 200 and body.get("kv")
+
+        obs = Registry()
+        registry = ReplicaRegistry()
+        for rid, _, model in fleet:
+            registry.register(rid, f"http://127.0.0.1:{ports[rid]}",
+                              model=model)
+        router = FleetRouter(
+            registry, balancer="least_outstanding", transport=transport,
+            obs_registry=obs, max_attempts=3, attempt_timeout_s=60.0,
+            default_deadline_s=240.0, backoff_base_s=0.05, demote_after=1,
+            tiered=True, prefix_hot_after=2,
+            span_log=router_spans, trace_sample=1.0,
+        )
+        front = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+        url = f"http://127.0.0.1:{front.server_address[1]}"
+
+        # ---- Phase A: one ensemble request — full pipeline, one trace.
+        status, body, headers = _post(f"{url}/ensemble",
+                                      {"question": "what is the answer?"})
+        assert status == 200, body
+        assert body["outcome"] == "ok" and body["refined"] is True
+        assert sorted(c["pool"] for c in body["candidates"]) == ["qa-a", "qa-b"]
+        assert isinstance(body["answer"], str) and body["answer"]
+        trace_header = headers[TRACE_HEADER]
+        trace_id = trace_header.split("-")[1]
+
+        # Cross-process assembly: router record + engine records from the
+        # QA branches and the refiner, one tree.
+        logs = [str(router_spans)] + [str(p) for p in span_logs.values()]
+        doc = load_trace(trace_id, logs)
+        tree = doc["tree"]
+        assert tree is not None and doc["processes"] >= 3, doc
+        branches = _branch_children(tree)
+        assert sorted(b["pool"] for b in branches) == ["qa-a", "qa-b"]
+        assert all(b["outcome"] == "ok" for b in branches)
+        # The concurrency proof: both branches' wall intervals OVERLAP —
+        # each starts before either finishes.
+        assert max(b["t0"] for b in branches) < min(b["t1"] for b in branches), \
+            branches
+        refines = [c for c in tree["children"] if c.get("name") == "refine"]
+        assert refines and refines[0]["outcome"] == "ok"
+        # Replica engine records attached under the winning attempts.
+        servers = [g for c in tree["children"]
+                   for g in c.get("children", ()) if g.get("name") == "server"]
+        assert len(servers) >= 3, tree
+
+        # The CLI renders the same assembly (scripts' entry point).
+        out = subprocess.run(
+            [sys.executable, "-m", "edgemesh.cli", "obs", "trace",
+             trace_id, "--logs", *logs],
+            capture_output=True, text=True, timeout=120,
+            cwd=Path(__file__).resolve().parent.parent,
+        )
+        assert out.returncode == 0, out.stderr
+        cli_doc = json.loads(out.stdout)
+        assert cli_doc["processes"] == doc["processes"]
+        assert sorted(b["pool"] for b in _branch_children(cli_doc["tree"])) \
+            == ["qa-a", "qa-b"]
+
+        # ---- Phase B: the shared question prefix rides the fleet prefix
+        # cache. Repeats of one question make its prefix key hot inside
+        # the qa-a pool (2 sightings), the prefix exports ONCE, and later
+        # requests import the cached payload: cache_hit.
+        hot_q = "which prefix does every ensemble request share, again?"
+        for _ in range(4):
+            status, body, _ = _post(f"{url}/ensemble", {"question": hot_q})
+            assert status == 200 and body["outcome"] == "ok", body
+        m = obs.summary(prefix="edgemesh_fleet_")
+        hits = sum(v for k, v in m.items()
+                   if k.startswith("edgemesh_fleet_tiered_total")
+                   and 'outcome="cache_hit"' in k)
+        assert hits >= 1, m
+
+        # ---- Phase C: SIGKILL one qa-a replica mid-load. The bar: ZERO
+        # client-visible ensemble failures — the branch retries onto the
+        # pool's survivor inside its own budget.
+        results, errors = [], []
+
+        def client(i):
+            try:
+                results.append(_post(f"{url}/ensemble",
+                                     {"question": f"fan-out under fire {i}?"}))
+            except Exception as e:  # a transport-level failure IS a failure
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(10)]
+        for i, t in enumerate(threads):
+            t.start()
+            if i == 3:
+                procs["qa-a-1"].kill()  # SIGKILL mid-load
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=240.0)
+        assert not errors, errors
+        assert len(results) == 10
+        assert all(status == 200 for status, _, _ in results), results
+        assert all("answer" in body for _, body, _ in results)
+        assert all(body["outcome"] in ("ok", "degraded_qa")
+                   for _, body, _ in results)
+
+        # ---- Phase D: kill the WHOLE qa-b pool → that branch fails, the
+        # refiner runs over the single surviving candidate: degraded_qa,
+        # still 200, counted and span-labeled.
+        procs["qa-b-0"].kill()
+        procs["qa-b-0"].wait(timeout=15)
+        status, body, headers = _post(f"{url}/ensemble",
+                                      {"question": "who survives the cull?"})
+        assert status == 200, body
+        assert body["outcome"] == "degraded_qa" and body["refined"] is True
+        assert [c["pool"] for c in body["candidates"]] == ["qa-a"]
+        fates = {b["pool"]: b["outcome"] for b in body["branches"]}
+        assert fates["qa-b"] == "failed" and fates["qa-a"] == "ok"
+        em = obs.summary(prefix="edgemesh_ensemble_")
+        assert em.get('edgemesh_ensemble_total{outcome="degraded_qa"}', 0) >= 1
+        assert sum(v for k, v in em.items()
+                   if k.startswith("edgemesh_ensemble_branch_total")
+                   and 'pool="qa-b"' in k and 'outcome="failed"' in k) >= 1
+        d_trace = headers[TRACE_HEADER].split("-")[1]
+        d_tree = load_trace(d_trace, logs)["tree"]
+        d_fates = {b["pool"]: b["outcome"] for b in _branch_children(d_tree)}
+        assert d_fates["qa-b"] == "failed" and d_fates["qa-a"] == "ok"
+
+        # ---- Phase E: kill the refiner → best-QA-candidate fallback:
+        # refiner_fallback, still 200.
+        procs["refiner-0"].kill()
+        procs["refiner-0"].wait(timeout=15)
+        status, body, _ = _post(f"{url}/ensemble",
+                                {"question": "and without a refiner?"})
+        assert status == 200, body
+        assert body["outcome"] == "refiner_fallback" and body["refined"] is False
+        assert body["answer"] == body["candidates"][0]["answer"]
+        em = obs.summary(prefix="edgemesh_ensemble_")
+        assert em.get(
+            'edgemesh_ensemble_total{outcome="refiner_fallback"}', 0) >= 1
+
+        # /fleetz carries the live ensemble stats block end-to-end.
+        with urllib.request.urlopen(f"{url}/fleetz", timeout=30) as r:
+            fleetz = json.load(r)
+        ens = fleetz["ensemble"]
+        assert ens["qa_pools"] == ["qa-a", "qa-b"]
+        assert ens["refiner_pool"] == "refiner"
+        assert ens["outcomes"]["degraded_qa"] >= 1
+        assert ens["outcomes"]["refiner_fallback"] >= 1
+    finally:
+        if front is not None:
+            front.shutdown()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()

@@ -1,0 +1,417 @@
+"""Ensemble-over-the-fleet fast tier: model descriptors + pool membership
+in the registry (purged on deregister, reset on revive — beside the PR 14
+stale-digest purge contract), pool-filtered routing, the EnsembleCoordinator
+degradation ladder against a fake transport, the /ensemble wire contract,
+and the loadgen --target URL rewrite. No model, no device; loopback sockets
+only where the frontend HTTP layer itself is under test."""
+
+import json
+import random
+import time
+import urllib.request
+
+import pytest
+
+from edgemesh.agents.prompts import (
+    DEFAULT_QA_TEMPLATE,
+    PASSTHROUGH_TEMPLATE,
+    REFINER_TEMPLATE,
+    format_refiner_prompt,
+)
+from edgemesh.fleet import (
+    EnsembleCoordinator,
+    FleetRouter,
+    ReplicaRegistry,
+    make_balancer,
+    serve_fleet,
+)
+from edgemesh.fleet.ensemble import OUTCOMES
+from edgemesh.obs import Registry
+from edgemesh.serve.httputil import ENSEMBLE_PATH, TRACE_HEADER, WIRE_CONTRACT
+from edgemesh.utils.tracing import JsonlLogger
+
+
+class FakeTransport:
+    """Scripted transport: first registered URL substring that matches wins.
+    Handlers return ``(status, body)``; every call is recorded."""
+
+    def __init__(self):
+        self.calls = []
+        self._routes = []
+
+    def on(self, substr, handler):
+        self._routes.append((substr, handler))
+        return self
+
+    def _dispatch(self, method, url, payload, timeout_s, headers):
+        self.calls.append((method, url, payload, timeout_s, dict(headers or {})))
+        for substr, handler in self._routes:
+            if substr in url:
+                return handler(url, payload, headers or {})
+        return 200, {"answer": "ok"}
+
+    def get_json(self, url, timeout_s, headers=None):
+        return self._dispatch("GET", url, None, timeout_s, headers)
+
+    def post_json(self, url, payload, timeout_s, headers=None):
+        return self._dispatch("POST", url, payload, timeout_s, headers)
+
+
+def _pool_registry():
+    reg = ReplicaRegistry()
+    reg.register("qa-a-0", "http://qa-a-0", model={"pool": "qa-a", "role": "qa"})
+    reg.register("qa-b-0", "http://qa-b-0", model={"pool": "qa-b", "role": "qa"})
+    reg.register("ref-0", "http://ref-0",
+                 model={"pool": "refiner", "role": "refiner"})
+    return reg
+
+
+def _router(reg, transport, **kw):
+    kw.setdefault("obs_registry", Registry())
+    kw.setdefault("rng", random.Random(0))
+    return FleetRouter(reg, transport=transport, **kw)
+
+
+def _answer(text, confidence=0.5):
+    return lambda u, p, h: (200, {"answer": text, "confidence": confidence})
+
+
+# ---------------------------------------------------------------------------
+# Registry: model descriptors, pool views, purge-on-deregister hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_registry_model_descriptor_and_pools_view():
+    reg = _pool_registry()
+    reg.register("plain", "http://plain")  # descriptor-less: no named pool
+    assert reg.get("qa-a-0").pool == "qa-a"
+    assert reg.get("plain").pool is None
+    pools = reg.pools()
+    assert set(pools) == {"qa-a", "qa-b", "refiner"}
+    assert pools["refiner"]["role"] == "refiner"
+    assert pools["qa-a"]["replicas"] == ["qa-a-0"]
+    assert pools["qa-a"]["routable"] == 1
+    # Unroutable members stay listed but don't count as routable.
+    reg.set_state("qa-b-0", "unhealthy")
+    pools = reg.pools()
+    assert pools["qa-b"]["replicas"] == ["qa-b-0"]
+    assert pools["qa-b"]["routable"] == 0
+    # The descriptor rides the snapshot → /fleetz.
+    snap = {s["id"]: s for s in reg.snapshot()}
+    assert snap["qa-a-0"]["model"] == {"pool": "qa-a", "role": "qa"}
+    assert snap["qa-a-0"]["pool"] == "qa-a"
+    assert "model" not in snap["plain"]
+
+
+def test_registry_purges_model_on_remove_and_resets_on_revive():
+    # Mirrors the stale-digest purge contract: pool membership dies with
+    # the backend — a deregistered replica must vanish from pools() and a
+    # revived one must NOT inherit the old descriptor (the re-registered
+    # checkpoint may be a different model).
+    reg = _pool_registry()
+    reg.set_state("qa-b-0", "removed")
+    assert "qa-b" not in reg.pools()
+    assert reg.get("qa-b-0").model is None
+    # Revive WITHOUT a descriptor: no pool (fresh registration decides).
+    reg.register("qa-b-0", "http://qa-b-0")
+    assert reg.get("qa-b-0").pool is None
+    # Revive WITH a new descriptor: the new pool wins.
+    reg.set_state("qa-b-0", "removed")
+    reg.register("qa-b-0", "http://qa-b-0",
+                 model={"pool": "qa-c", "role": "qa"})
+    assert reg.get("qa-b-0").pool == "qa-c"
+    # A live heartbeat re-register without a descriptor keeps the existing
+    # one (idempotence — same contract as outstanding accounting).
+    reg.register("qa-a-0", "http://qa-a-0")
+    assert reg.get("qa-a-0").pool == "qa-a"
+    # deregister purges outright.
+    reg.deregister("ref-0")
+    assert "refiner" not in reg.pools()
+
+
+def test_router_forget_replica_purges_pool_tiers():
+    reg = _pool_registry()
+    router = _router(reg, FakeTransport(), tiered=True)
+    tm = router._tiers_for("qa-a")
+    assert tm is not router.tiers
+    assert router._tiers_for("qa-a") is tm  # cached per pool
+    assert router._tiers_for(None) is router.tiers
+    tm._prefill_rids = frozenset({"qa-a-0"})
+    router.forget_replica("qa-a-0")
+    assert "qa-a-0" not in tm._prefill_rids
+    assert reg.get("qa-a-0") is None
+
+
+def test_available_and_acquire_filter_by_pool():
+    reg = _pool_registry()
+    assert {r.rid for r in reg.available()} == {"qa-a-0", "qa-b-0", "ref-0"}
+    assert [r.rid for r in reg.available(pool="qa-a")] == ["qa-a-0"]
+    bal = make_balancer("round_robin")
+    for _ in range(3):  # never leaks outside the pool
+        rep = reg.acquire(bal, pool="refiner")
+        assert rep.rid == "ref-0"
+        reg.release("ref-0", ok=True)
+    assert reg.acquire(bal, pool="nope") is None
+
+
+def test_per_pool_hedge_estimators_are_distinct():
+    router = _router(_pool_registry(), FakeTransport())
+    a = router._hedge_estimator_for("qa-a")
+    b = router._hedge_estimator_for("qa-b")
+    assert a is not b
+    assert router._hedge_estimator_for("qa-a") is a
+    assert router._hedge_estimator_for(None) is router._hedge_estimator
+
+
+# ---------------------------------------------------------------------------
+# EnsembleCoordinator: parallel fan-out + the degradation ladder
+# ---------------------------------------------------------------------------
+
+
+def test_ensemble_branches_overlap_and_share_one_trace(tmp_path):
+    log = tmp_path / "router.jsonl"
+    ft = FakeTransport()
+
+    def slow_answer(url, payload, headers):
+        time.sleep(0.3)
+        return 200, {"answer": "draft", "confidence": 0.5}
+
+    ft.on("qa-a-0/generate", slow_answer)
+    ft.on("qa-b-0/generate", slow_answer)
+    ft.on("ref-0/generate", _answer("refined", 0.9))
+    router = _router(_pool_registry(), ft, span_log=log, trace_sample=1.0)
+
+    t0 = time.monotonic()
+    status, body, headers = router.ensemble.handle({"question": "q?"})
+    elapsed = time.monotonic() - t0
+    assert status == 200
+    assert body["answer"] == "refined" and body["refined"] is True
+    assert body["outcome"] == "ok"
+    assert {c["pool"] for c in body["candidates"]} == {"qa-a", "qa-b"}
+    # Two 0.3 s branches serially would be >= 0.6 s.
+    assert elapsed < 0.55
+    # ONE router record carries the whole fan-out tree; the branch spans
+    # provably overlap (the property the e2e asserts cross-process).
+    recs = JsonlLogger(log).read()
+    assert len(recs) == 1
+    spans = recs[0]["spans"]
+    assert spans[0]["name"] == "ensemble"
+    branch = [s for s in spans if s["name"] == "branch"]
+    assert {s["pool"] for s in branch} == {"qa-a", "qa-b"}
+    assert all(s["outcome"] == "ok" for s in branch)
+    assert max(s["t0"] for s in branch) < min(s["t1"] for s in branch)
+    refine = [s for s in spans if s["name"] == "refine"]
+    assert len(refine) == 1 and refine[0]["pool"] == "refiner"
+    # The response header joins the same trace the record carries.
+    assert recs[0]["trace_id"] in headers[TRACE_HEADER]
+    # The refiner saw the COMPOSED prompt (both drafts in the template),
+    # not the raw question — composed fleet-side, passthrough on the wire.
+    refiner_calls = [p for m, u, p, t, h in ft.calls if "ref-0" in u]
+    assert refiner_calls[0]["question"] == format_refiner_prompt(
+        "q?", ["draft", "draft"])
+
+
+def test_ensemble_degradation_ladder(tmp_path):
+    def run(handlers, refiner=True):
+        reg = ReplicaRegistry()
+        reg.register("qa-a-0", "http://qa-a-0",
+                     model={"pool": "qa-a", "role": "qa"})
+        reg.register("qa-b-0", "http://qa-b-0",
+                     model={"pool": "qa-b", "role": "qa"})
+        if refiner:
+            reg.register("ref-0", "http://ref-0",
+                         model={"pool": "refiner", "role": "refiner"})
+        ft = FakeTransport()
+        for substr, handler in handlers.items():
+            ft.on(substr, handler)
+        obs = Registry()
+        router = _router(reg, ft, obs_registry=obs)
+        status, body, _ = router.ensemble.handle({"question": "q?"},
+                                                 deadline_s=5.0)
+        return status, body, router.ensemble, obs
+
+    no_answer = lambda u, p, h: (200, {"note": "no answer key"})
+
+    # Rung 1: everything healthy → "ok", refiner's answer wins.
+    status, body, ens, obs = run({
+        "qa-a-0": _answer("a", 0.2), "qa-b-0": _answer("b", 0.8),
+        "ref-0": _answer("merged", 0.9),
+    })
+    assert (status, body["outcome"], body["answer"]) == (200, "ok", "merged")
+    assert ens.stats()["outcomes"] == {"ok": 1}
+
+    # Rung 2: one QA branch dead → single-candidate refine, "degraded_qa".
+    status, body, ens, obs = run({
+        "qa-a-0": _answer("a", 0.2), "qa-b-0": no_answer,
+        "ref-0": _answer("merged", 0.9),
+    })
+    assert (status, body["outcome"]) == (200, "degraded_qa")
+    assert body["answer"] == "merged" and body["refined"] is True
+    assert len(body["candidates"]) == 1
+    fates = {b["pool"]: b["outcome"] for b in body["branches"]}
+    assert fates == {"qa-a": "ok", "qa-b": "failed"}
+    summary = obs.summary(prefix="edgemesh_ensemble_")
+    assert summary['edgemesh_ensemble_total{outcome="degraded_qa"}'] == 1
+    assert summary[
+        'edgemesh_ensemble_branch_total{pool="qa-b",outcome="failed"}'] == 1
+
+    # Rung 3: refiner dead → best-confidence QA candidate, still 200.
+    status, body, ens, obs = run({
+        "qa-a-0": _answer("a", 0.2), "qa-b-0": _answer("b", 0.8),
+        "ref-0": no_answer,
+    })
+    assert (status, body["outcome"]) == (200, "refiner_fallback")
+    assert body["answer"] == "b" and body["refined"] is False
+
+    # Rung 4: no refiner pool registered at all.
+    status, body, ens, obs = run(
+        {"qa-a-0": _answer("a", 0.9), "qa-b-0": _answer("b", 0.1)},
+        refiner=False,
+    )
+    assert (status, body["outcome"]) == (200, "no_refiner")
+    assert body["answer"] == "a"
+
+    # Rung 5 (the only client-visible failure): every branch dead.
+    status, body, ens, obs = run({
+        "qa-a-0": no_answer, "qa-b-0": no_answer,
+        "ref-0": _answer("merged", 0.9),
+    })
+    assert status == 502
+    assert body["kind"] == "ensemble_failed"
+    assert all(b["outcome"] == "failed" for b in body["branches"])
+    assert ens.stats()["outcomes"] == {"failed": 1}
+    # Every ladder rung is a declared outcome.
+    assert {"ok", "degraded_qa", "refiner_fallback", "no_refiner",
+            "failed"} == set(OUTCOMES)
+
+
+def test_ensemble_without_descriptors_degenerates_to_single_branch():
+    reg = ReplicaRegistry([("r0", "http://r0")])
+    ft = FakeTransport().on("r0/generate", _answer("plain", 0.4))
+    router = _router(reg, ft)
+    status, body, _ = router.ensemble.handle({"question": "q?"})
+    assert (status, body["outcome"]) == (200, "no_refiner")
+    assert body["answer"] == "plain"
+    assert [b["pool"] for b in body["branches"]] == ["fleet"]
+
+
+def test_ensemble_missing_question_is_400():
+    router = _router(_pool_registry(), FakeTransport())
+    for payload in ({}, {"question": ""}, {"question": 3}, None):
+        status, body, _ = router.ensemble.handle(payload)
+        assert status == 400 and body == {"error": "missing question"}
+
+
+def test_pinned_topology_overrides_discovery():
+    reg = _pool_registry()
+    ens = EnsembleCoordinator(_router(reg, FakeTransport()),
+                              qa_pools=["qa-b"], refiner_pool=None,
+                              obs_registry=Registry())
+    qa, refiner = ens.topology()
+    assert qa == ["qa-b"]
+    # Pinned QA pools + discovered refiner (refiner_pool stays live).
+    assert refiner == "refiner"
+
+
+def test_router_status_carries_pools_and_ensemble_stats():
+    router = _router(_pool_registry(), FakeTransport())
+    st = router.status()
+    assert set(st["pools"]) == {"qa-a", "qa-b", "refiner"}
+    assert st["ensemble"]["qa_pools"] == ["qa-a", "qa-b"]
+    assert st["ensemble"]["refiner_pool"] == "refiner"
+    assert st["ensemble"]["outcomes"] is None  # no traffic yet
+
+
+# ---------------------------------------------------------------------------
+# Frontend: POST /ensemble route + model descriptors over /replicas/register
+# ---------------------------------------------------------------------------
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}"), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), e.headers
+
+
+def test_frontend_serves_ensemble_and_registers_model_descriptors():
+    import urllib.error  # noqa: F401 — _post's except path
+
+    ft = FakeTransport()
+    ft.on("qa-a-0/generate", _answer("a", 0.3))
+    ft.on("qa-b-0/generate", _answer("b", 0.6))
+    ft.on("ref-0/generate", _answer("merged", 0.9))
+    reg = ReplicaRegistry()
+    reg.register("qa-a-0", "http://qa-a-0",
+                 model={"pool": "qa-a", "role": "qa"})
+    reg.register("ref-0", "http://ref-0",
+                 model={"pool": "refiner", "role": "refiner"})
+    router = _router(reg, ft)
+    srv = serve_fleet(router, host="127.0.0.1", port=0, block=False)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        # Runtime registration carries the model descriptor.
+        status, body, _ = _post(base + "/replicas/register", {
+            "id": "qa-b-0", "url": "http://qa-b-0",
+            "model": {"pool": "qa-b", "role": "qa"},
+        })
+        assert status == 200
+        assert reg.get("qa-b-0").pool == "qa-b"
+
+        status, body, headers = _post(base + "/ensemble", {"question": "q?"})
+        assert status == 200
+        assert body["answer"] == "merged" and body["outcome"] == "ok"
+        assert headers[TRACE_HEADER]
+
+        # Deregister purges the pool; the next ensemble degrades, never 5xx.
+        status, _, _ = _post(base + "/replicas/deregister", {"id": "qa-b-0"})
+        assert status == 200
+        assert "qa-b" not in reg.pools()
+        status, body, _ = _post(base + "/ensemble", {"question": "q?"})
+        assert status == 200 and body["outcome"] == "ok"
+        assert {c["pool"] for c in body["candidates"]} == {"qa-a"}
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Wire contract + prompt templates + loadgen target rewrite
+# ---------------------------------------------------------------------------
+
+
+def test_wire_contract_declares_ensemble_and_model_keys():
+    row = WIRE_CONTRACT[("POST", ENSEMBLE_PATH)]
+    assert ENSEMBLE_PATH == "/ensemble"
+    assert "frontend" in row["servers"]
+    assert "question" in row["request_keys"]
+    assert "ensemble_failed" in row["error_kinds"]
+    assert "model" in WIRE_CONTRACT[("POST", "/replicas/register")]["request_keys"]
+    from edgemesh.fleet.frontend import SERVED_ROUTES
+
+    assert "/ensemble" in SERVED_ROUTES["POST"]
+
+
+def test_refiner_prompt_is_the_shared_template():
+    got = format_refiner_prompt("Q?", ["a1", "a2"])
+    assert got == REFINER_TEMPLATE.format(
+        question="Q?", candidates="Answer 1: a1\nAnswer 2: a2\n")
+    assert PASSTHROUGH_TEMPLATE.format(question=got) == got
+    assert "{question}" in DEFAULT_QA_TEMPLATE
+
+
+def test_loadgen_resolve_target_url():
+    from edgemesh.loadgen.cli import resolve_target_url
+
+    assert resolve_target_url("http://h:1/generate", "ensemble") == \
+        "http://h:1/ensemble"
+    assert resolve_target_url("http://h:1", "ensemble") == "http://h:1/ensemble"
+    assert resolve_target_url("http://h:1/", "generate") == "http://h:1/generate"
+    assert resolve_target_url("http://h:1/ensemble", "generate") == \
+        "http://h:1/generate"
+    # Idempotent for the default flow.
+    assert resolve_target_url("http://h:1/generate", "generate") == \
+        "http://h:1/generate"
