@@ -21,6 +21,7 @@ answers: :356-363) with original wording.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -595,6 +596,30 @@ def _materialize(ms: ModelSpec, role_seed: str, mesh=None) -> tuple[ModelConfig,
         from edgemesh.ops.int8 import quantize_embedding
 
         params = quantize_embedding(params)
+    noise = float(os.environ.get("EDGEMESH_QUALITY_NOISE", "0") or "0")
+    if noise > 0.0:
+        # Fault injection for the quality observatory's e2e
+        # (tests/test_quality_e2e.py): gaussian noise on the output head
+        # makes answers garbage while latency, /readyz, and memory
+        # behavior stay normal — the degraded-but-healthy replica the
+        # canary prober and drift detector exist to catch. Gated on an
+        # env var so only a process launched with it set is degraded.
+        target = "lm_head" if "lm_head" in params else "embed"
+        key = jax.random.PRNGKey(0)
+        params = {
+            **params,
+            target: jax.tree.map(
+                lambda x: (
+                    x + (noise * jax.random.normal(
+                        key, x.shape, jnp.float32)).astype(x.dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x
+                ),
+                params[target],
+            ),
+        }
+        log.warning("%s: EDGEMESH_QUALITY_NOISE=%g — %s perturbed "
+                    "(answers will be garbage by design)",
+                    role_seed, noise, target)
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
     return cfg, params, tokenizer

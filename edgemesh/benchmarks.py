@@ -141,6 +141,32 @@ def _e2e_latency(r: dict) -> float:
     return r["t_end"] - r["t_start"] + r["queue_s"]
 
 
+#: ``EDGEMESH_BENCH_QUALITY=0`` drops the quality blocks from bench
+#: artifacts (the stages still run — only the block is skipped).
+QUALITY_GATE_ENV = "EDGEMESH_BENCH_QUALITY"
+
+
+def bench_quality_block(rollup: dict | None,
+                        agreement: float | None = None) -> dict | None:
+    """The bench stages' shared ``quality`` block (docs/OBSERVABILITY.md
+    "The quality observatory"): a fixed-schema projection of an engine's
+    :class:`~edgemesh.obs.quality.QualityTracker` rollup plus the
+    ensemble agreement EWMA, so artifacts diff across rounds even as the
+    rollup grows keys. Returns None when ``EDGEMESH_BENCH_QUALITY=0`` —
+    the schema and the skip gate are pinned in tests/test_bench_partial.py."""
+    if os.environ.get(QUALITY_GATE_ENV, "1") == "0":
+        return None
+    rollup = rollup if isinstance(rollup, dict) else {}
+    return {
+        "requests": rollup.get("requests", 0),
+        "low_confidence_requests": rollup.get("low_confidence_requests", 0),
+        "confidence_ewma": rollup.get("confidence_ewma"),
+        "confidence_min_seen": rollup.get("confidence_min_seen"),
+        "entropy_ewma": rollup.get("entropy_ewma"),
+        "agreement_ewma": agreement,
+    }
+
+
 def _run_waves(eng, n_requests: int, waves: int, budgets=None, label: str = "serving",
                question: str | None = None):
     """The round-4 variance protocol, in ONE place for every serving-style
@@ -306,6 +332,10 @@ def serving_benchmark(
             # run. None on dense backends or with the ledger disabled
             # (EDGEMESH_MEM_LEDGER=0 — the overhead-gate off arm).
             "mem": eng.mem.rollup() or None,
+            # Quality-tracker rollup (obs/quality.py): per-request answer
+            # confidence/entropy EWMAs for THIS run's traffic. None with
+            # EDGEMESH_BENCH_QUALITY=0.
+            "quality": bench_quality_block(eng.quality.rollup()),
         }
     finally:
         eng.close()
@@ -963,6 +993,16 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
         eng.mem.enabled = False
         memledgeroff = measure(routed_url, "router, mem ledger off")
         eng.mem.enabled = True
+        # Quality-off arm (EDGEMESH_QUALITY=0 configuration): the device
+        # tail rides the decode loop either way (it is fused into the
+        # sampler's softmax and cannot be toggled without a recompile), so
+        # this arm prices exactly what the flag controls — the host-side
+        # sink: four float accumulations per segment row plus the retire
+        # bookkeeping. Gate (PERFORMANCE.md "The quality observatory"):
+        # routed p50 within 2% of this arm.
+        eng.quality.enabled = False
+        qualityoff = measure(routed_url, "router, quality off")
+        eng.quality.enabled = True
         router.trace_sample = 1.0
         traced = measure(routed_url, "router+tracing")
         # Recorder arm: tracing back OFF, the flight ring attached live —
@@ -988,6 +1028,10 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
         mem_ledger_ratio = (
             round(pct(routed, 50) / pct(memledgeroff, 50), 4)
             if pct(memledgeroff, 50) else None
+        )
+        quality_ratio = (
+            round(pct(routed, 50) / pct(qualityoff, 50), 4)
+            if pct(qualityoff, 50) else None
         )
         _progress(
             f"router-overhead: p50 {pct(direct, 50) * 1e3:.2f}ms direct vs "
@@ -1041,8 +1085,17 @@ def router_overhead_benchmark(n_requests: int = 40, max_new: int = 8) -> dict[st
             "mem_ledger_overhead_p50_s": round(
                 pct(routed, 50) - pct(memledgeroff, 50), 6),
             "mem_ledger_overhead_ratio": mem_ledger_ratio,
+            # The quality-tracker arm: routed (tracker on, the default) vs
+            # the same path with the host-side sink disabled. The gate
+            # (PERFORMANCE.md "The quality observatory"): ratio <= 1.02.
+            "qualityoff_p50_s": pct(qualityoff, 50),
+            "qualityoff_p99_s": pct(qualityoff, 99),
+            "quality_overhead_p50_s": round(
+                pct(routed, 50) - pct(qualityoff, 50), 6),
+            "quality_overhead_ratio": quality_ratio,
             "compute": eng.compute.rollup() or None,
             "mem": eng.mem.rollup() or None,
+            "quality": bench_quality_block(eng.quality.rollup()),
             "sample_trace": sample_trace,
             # The obs view of the routed arms (counters + router histogram).
             "obs": obs.summary(prefix="edgemesh_fleet_"),
@@ -2173,6 +2226,12 @@ def fleet_ensemble_benchmark(
                 if ens_q is not None and single_q is not None else None
             ),
             "eval_samples": len(samples),
+            # The coordinator's cross-branch agreement EWMA (obs/quality.py
+            # pairwise token-F1): the replicas here are non-continuous (no
+            # engine tracker), so the block carries the ensemble signal
+            # only. None with EDGEMESH_BENCH_QUALITY=0.
+            "quality": bench_quality_block(
+                None, agreement=stats.get("agreement_ewma")),
             "obs": obs.summary(prefix="edgemesh_ensemble_"),
         }
     finally:
@@ -2496,6 +2555,9 @@ def headline_benchmark(
         # The memory observatory's view of the same run: peak pool
         # occupancy, per-tenant split, leak/conservation counters.
         out["serving_mem"] = r.get("mem")
+        # The quality observatory's view: confidence/entropy EWMAs +
+        # low-confidence counts (None when EDGEMESH_BENCH_QUALITY=0).
+        out["serving_quality"] = r.get("quality")
         emit_partial(out)
         # Segmented baseline at the same shape: the headline's own
         # ragged-vs-segmented pin (the full shape sweep is stage 7c).
@@ -2621,6 +2683,11 @@ def headline_benchmark(
         for k in ("memledgeroff_p50_s", "mem_ledger_overhead_p50_s",
                   "mem_ledger_overhead_ratio"):
             out[k] = r.get(k)
+        # The quality-tracker overhead arm (tracker on vs off): the same
+        # <=1.02 ratio gate, for the quality observatory.
+        for k in ("qualityoff_p50_s", "quality_overhead_p50_s",
+                  "quality_overhead_ratio"):
+            out[k] = r.get(k)
 
     if os.environ.get("EDGEMESH_BENCH_FLEET", "1") == "1":
         _stage("router_overhead", _router_overhead)
@@ -2687,6 +2754,10 @@ def headline_benchmark(
         out["ensemble_outcomes"] = r["outcomes"]
         out["ensemble_quality_delta"] = r["quality_delta"]
         out["ensemble_eval_samples"] = r["eval_samples"]
+        # The quality observatory's online view of the same run — the
+        # coordinator's cross-branch agreement EWMA ("ensemble_quality"
+        # above is the offline eval score, a different animal).
+        out["ensemble_quality_signals"] = r.get("quality")
 
     # Rides the fleet gate too: EDGEMESH_BENCH_FLEET=0 means "spin no
     # in-process fleet", and this stage spins three replicas + a frontend.

@@ -11,6 +11,10 @@ service (docs/FLEET.md is the operator-facing reference):
   least-outstanding when digests go stale).
 - ``health``: periodic ``/readyz`` probes with automatic demote/promote;
   each probe also refreshes the replica's load digest for free.
+- ``canary``: golden-set answer-quality probes — per-replica token-F1
+  scores the telemetry balancer down-weights on, collapsing scores
+  minting fleet-wide ``quality_drift`` incidents (docs/OBSERVABILITY.md
+  "The quality observatory").
 - ``router``: deadlines, bounded jittered retries, tail-latency hedging
   (fixed, percentile, or auto-tuned from a decayed latency histogram),
   admission control (503 + Retry-After), graceful drain.
@@ -42,6 +46,7 @@ from edgemesh.fleet.balancer import (  # noqa: F401
     make_balancer,
 )
 from edgemesh.fleet.autoscale import AutoScaler  # noqa: F401
+from edgemesh.fleet.canary import CanaryProber, load_golden_set  # noqa: F401
 from edgemesh.fleet.autotune import KneeTracker  # noqa: F401
 from edgemesh.fleet.ensemble import EnsembleCoordinator  # noqa: F401
 from edgemesh.fleet.frontend import serve_fleet  # noqa: F401

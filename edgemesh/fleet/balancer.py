@@ -129,22 +129,33 @@ class TelemetryBalancer:
     def __init__(self, stale_after_s: float = 15.0,
                  neutral_service_s: float = 0.1,
                  compile_penalty_s: float = 0.5,
+                 quality_penalty_s: float = 2.0,
+                 canary_floor: float = 0.3,
+                 canary_stale_after_s: float = 120.0,
                  now=time.monotonic) -> None:
         if stale_after_s <= 0:
             raise ValueError(f"stale_after_s must be > 0, got {stale_after_s}")
         self.stale_after_s = float(stale_after_s)
         self.neutral_service_s = float(neutral_service_s)
         self.compile_penalty_s = float(compile_penalty_s)
+        self.quality_penalty_s = float(quality_penalty_s)
+        self.canary_floor = float(canary_floor)
+        self.canary_stale_after_s = float(canary_stale_after_s)
         self._now = now  # injectable: tests pin digest aging
 
     def _cost(self, rep) -> float:
+        # The quality penalty rides OUTSIDE the digest-freshness blend:
+        # the canary score is an independent registry-side signal with its
+        # own freshness, and a degraded replica must lose picks even when
+        # its load digest is stale or missing.
+        quality = self._quality_penalty(rep)
         age = None
         if getattr(rep, "load_ts", None) is not None:
             age = self._now() - rep.load_ts
         neutral = rep.outstanding * self.neutral_service_s
         load = getattr(rep, "load", None)
         if age is None or age >= self.stale_after_s or not isinstance(load, dict):
-            return neutral
+            return neutral + quality
         freshness = max(0.0, 1.0 - age / self.stale_after_s)
         queue = load.get("ewma_queue_s")
         prefill = load.get("ewma_prefill_s")
@@ -163,7 +174,7 @@ class TelemetryBalancer:
             # gateway, or a continuous replica before its first request)
             # must score like NO digest — scoring the nulls as zero cost
             # would herd every pick at the least-instrumented replica.
-            return neutral
+            return neutral + quality
         queue = queue or 0.0
         prefill = prefill or 0.0
         service = service if service is not None else (queue + prefill)
@@ -171,7 +182,33 @@ class TelemetryBalancer:
         if load.get("recent_compile"):
             telem += self.compile_penalty_s
         telem += self._mem_penalty(load)
-        return freshness * telem + (1.0 - freshness) * neutral
+        return freshness * telem + (1.0 - freshness) * neutral + quality
+
+    def _quality_penalty(self, rep) -> float:
+        """Seconds of penalty for a replica whose golden-set canary score
+        (fleet/canary.py, registry ``update_canary``) sits below the
+        floor. Scales with the deficit and decays with canary age — the
+        prober's cadence bounds how long a recovered replica stays
+        penalized. A replica with no canary result (prober off, replica
+        never probed, malformed entry) costs exactly 0.0 — scoring
+        unchanged, same contract as ``_mem_penalty``. Down-weighting, not
+        exclusion: the drift incident, not the balancer, is what takes a
+        degraded replica out of a human's rotation."""
+        canary = getattr(rep, "canary", None)
+        ts = getattr(rep, "canary_ts", None)
+        if not isinstance(canary, dict) or ts is None:
+            return 0.0
+        age = self._now() - ts
+        if age >= self.canary_stale_after_s:
+            return 0.0
+        score = canary.get("score")
+        if not isinstance(score, (int, float)):
+            return 0.0
+        deficit = self.canary_floor - min(1.0, max(0.0, float(score)))
+        if deficit <= 0 or self.canary_floor <= 0:
+            return 0.0
+        freshness = max(0.0, 1.0 - age / self.canary_stale_after_s)
+        return freshness * self.quality_penalty_s * deficit / self.canary_floor
 
     @staticmethod
     def _mem_penalty(load: dict) -> float:

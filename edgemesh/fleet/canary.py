@@ -1,0 +1,324 @@
+"""Golden-set canary probing: active answer-quality truth for the fleet.
+
+The health prober (fleet/health.py) answers "is the replica up?"; the
+telemetry balancer's digests answer "is it fast?". Neither catches the
+failure the quality observatory exists for: a replica serving a corrupted
+checkpoint passes ``/readyz``, meets its latency SLOs, and answers
+garbage. The canary prober closes that gap actively — on a fixed
+interval it drives a small **pinned golden set** (question → reference
+answer) through every routable replica's ``POST /generate`` and scores
+each answer with the eval harness's token-F1 (optionally blended with
+embedding cosine), exactly the agreement metric the offline tables use
+(obs/quality.py).
+
+The golden set is a JSONL file of ``{"question": ..., "reference": ...}``
+pairs, typically pinned from a known-good build's own answers — greedy
+decoding is deterministic, so a healthy replica reproduces its reference
+exactly (score 1.0) and a degraded one diverges. Without a file a small
+built-in fallback set keeps the prober running, but pinned references
+are what make the score sharp.
+
+Per replica the prober keeps an EWMA score and publishes it three ways:
+
+- ``registry.update_canary(rid, {...})`` — rides ``/fleetz`` (replica
+  rows + the router's fleet ``quality`` rollup) and is what the
+  telemetry balancer's ``_quality_penalty`` reads to down-weight a
+  degraded replica while it is still technically healthy;
+- gauge ``edgemesh_fleet_canary_score{replica}`` (same label convention
+  as ``edgemesh_fleet_replica_up``), self-pruned when a replica leaves
+  the registry or is removed — the PR 14 leak class;
+- a ``canary`` span-log record per scored round (obs JSONL vocabulary),
+  which ``edgemesh obs quality`` folds into the offline canary table.
+
+**Collapse → incident.** When a replica's EWMA falls below
+``collapse_below`` (after ``min_probes`` rounds), the prober mints a
+``quality_drift`` incident and fires it the same way a replica-local
+anomaly trigger would: one direct ``POST /incident`` to the degraded
+replica (the router's broadcast excludes the source, but that replica's
+flight ring is the most interesting one), then
+``router.observe_incident`` to fan the id out fleet-wide, freeze the
+tuner, and record the source in ``/fleetz``. The collapse fires once per
+healthy→collapsed transition and re-arms on recovery, mirroring
+:class:`~edgemesh.obs.anomaly.QualityDriftDetector`.
+
+Importing this module never imports jax (the fleet package contract),
+and every outbound call carries an explicit timeout (EM502).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+from edgemesh.fleet.transport import HttpTransport, TransportError
+from edgemesh.obs.quality import CANARY_RECORD_EVENT, token_f1
+
+log = logging.getLogger("edgemesh.fleet")
+
+#: Built-in golden set used when no ``--canary-golden`` file is given:
+#: keeps the prober (and its relative healthy-vs-degraded comparison)
+#: running with zero config. Pinned per-deployment references are what
+#: make the absolute score meaningful.
+FALLBACK_GOLDEN: tuple[dict, ...] = (
+    {"question": "What is the capital of France?",
+     "reference": "The capital of France is Paris."},
+    {"question": "How many days are there in a week?",
+     "reference": "There are seven days in a week."},
+    {"question": "What color is the sky on a clear day?",
+     "reference": "On a clear day the sky is blue."},
+)
+
+
+def load_golden_set(path: str) -> list[dict]:
+    """Load a golden-set JSONL file: one ``{"question", "reference"}``
+    object per line (``"prompt"``/``"answer"`` accepted as aliases).
+    Blank lines and comment lines (``#``) are skipped; a line that is
+    valid JSON but missing either field is a hard error — a silently
+    half-loaded canary set would score replicas against the wrong bar."""
+    items: list[dict] = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, start=1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            obj = json.loads(line)
+            question = obj.get("question") or obj.get("prompt")
+            reference = obj.get("reference") or obj.get("answer")
+            if not isinstance(question, str) or not isinstance(reference, str):
+                raise ValueError(
+                    f"{path}:{lineno}: golden-set entries need string "
+                    "'question' and 'reference' fields"
+                )
+            items.append({"question": question, "reference": reference})
+    if not items:
+        raise ValueError(f"{path}: golden set is empty")
+    return items
+
+
+class CanaryProber:
+    """Background golden-set prober scoring every routable replica."""
+
+    def __init__(self, registry, transport=None, router=None,
+                 golden: list[dict] | None = None,
+                 golden_path: str | None = None,
+                 interval_s: float = 30.0, timeout_s: float = 15.0,
+                 ewma_alpha: float = 0.5, collapse_below: float = 0.2,
+                 min_probes: int = 2, embedder=None,
+                 obs_registry=None, trace_log=None,
+                 on_collapse=None) -> None:
+        from edgemesh.obs import get_registry
+
+        self.registry = registry
+        self.transport = transport or HttpTransport()
+        #: Optional FleetRouter: collapse incidents fan out through its
+        #: ``observe_incident`` (dedupe, /fleetz, tuner freeze, broadcast).
+        self.router = router
+        if golden is not None:
+            self.golden = list(golden)
+        elif golden_path:
+            self.golden = load_golden_set(golden_path)
+        else:
+            self.golden = list(FALLBACK_GOLDEN)
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.ewma_alpha = ewma_alpha
+        self.collapse_below = collapse_below
+        self.min_probes = min_probes
+        #: Optional text embedder (eval/metrics.py HashingEmbedder): when
+        #: set, each probe scores 0.5*token_f1 + 0.5*cosine — cosine
+        #: forgives word-order drift token-F1 punishes.
+        self.embedder = embedder
+        self.trace_log = trace_log
+        #: Called ``(rid, incident_dict)`` after a collapse fires —
+        #: a test seam beside the router path.
+        self.on_collapse = on_collapse
+        reg = obs_registry or get_registry()
+        self._score_gauge = reg.gauge(
+            "edgemesh_fleet_canary_score",
+            "Golden-set canary score EWMA per replica (1 = matches "
+            "references exactly)", ("replica",),
+        )
+        self._collapses = reg.counter(
+            "edgemesh_fleet_canary_collapses_total",
+            "Canary collapses (quality_drift incidents minted) by replica",
+            ("replica",),
+        )
+        # Per-replica prober state: {"score", "probes", "armed"}. "armed"
+        # implements fire-once-per-transition, like QualityDriftDetector.
+        self._state: dict[str, dict] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- one pass (directly callable from tests) -----------------------------
+
+    def probe_once(self) -> dict[str, dict]:
+        """Score every routable replica against the golden set once;
+        returns {rid: canary_record}. Replicas that were unreachable for
+        the whole round keep their previous score (the health prober owns
+        liveness; a dead replica must not read as "quality collapsed")."""
+        self._prune()
+        results: dict[str, dict] = {}
+        for rep in self.registry.replicas():
+            if not rep.routable():
+                continue
+            rec = self._probe_replica(rep)
+            if rec is not None:
+                results[rep.rid] = rec
+        return results
+
+    def _probe_replica(self, rep) -> dict | None:
+        scores: list[float] = []
+        failures = 0
+        for item in self.golden:
+            score = self._probe_one(rep, item)
+            if score is None:
+                failures += 1
+            else:
+                scores.append(score)
+        if not scores:
+            # Whole round unreachable/unanswerable: no quality evidence
+            # either way — leave the EWMA (and the balancer's view) alone.
+            return None
+        round_score = sum(scores) / len(scores)
+        st = self._state.get(rep.rid)
+        if st is None:
+            st = {"score": round_score, "probes": 0, "armed": True}
+            self._state[rep.rid] = st
+        else:
+            st["score"] = (self.ewma_alpha * round_score
+                           + (1.0 - self.ewma_alpha) * st["score"])
+        st["probes"] += 1
+        collapsed = (st["probes"] >= self.min_probes
+                     and st["score"] < self.collapse_below)
+        rec = {
+            "score": round(st["score"], 4),
+            "last": round(round_score, 4),
+            "probes": st["probes"],
+            "set_size": len(self.golden),
+            "failures": failures,
+            "collapsed": collapsed,
+        }
+        self.registry.update_canary(rep.rid, rec)
+        self._score_gauge.labels(replica=rep.rid).set(rec["score"])
+        if self.trace_log is not None:
+            self.trace_log.log(CANARY_RECORD_EVENT, replica=rep.rid,
+                               pool=rep.pool, **{k: rec[k] for k in
+                                                 ("score", "last", "probes",
+                                                  "set_size", "failures")})
+        if collapsed:
+            if st["armed"]:
+                st["armed"] = False
+                self._fire_collapse(rep, rec)
+        elif st["probes"] >= self.min_probes:
+            # Recovery (a rolled-back checkpoint, a restarted process)
+            # re-arms the trigger for the next collapse.
+            st["armed"] = True
+        return rec
+
+    def _probe_one(self, rep, item: dict) -> float | None:
+        try:
+            status, body = self.transport.post_json(
+                rep.url("/generate"), {"question": item["question"]},
+                timeout_s=self.timeout_s,
+            )
+        except TransportError as e:
+            log.debug("canary probe transport failure for %s: %s", rep.rid, e)
+            return None
+        if status != 200 or not isinstance(body, dict):
+            return None
+        answer = body.get("answer")
+        if not isinstance(answer, str):
+            return None
+        score = token_f1(answer, item["reference"])
+        if self.embedder is not None:
+            from edgemesh.eval.metrics import cosine_similarity
+
+            cos = cosine_similarity(answer, item["reference"],
+                                    embedder=self.embedder)
+            score = 0.5 * score + 0.5 * max(0.0, cos)
+        return score
+
+    # -- collapse → incident -------------------------------------------------
+
+    def _fire_collapse(self, rep, rec: dict) -> None:
+        incident = {
+            "id": (f"inc-{time.strftime('%Y%m%d-%H%M%S')}-"
+                   f"{os.urandom(3).hex()}"),
+            "kind": "quality_drift",
+            "ts": time.time(),
+        }
+        log.warning("canary collapse on %s (score %.3f < %.3f): %s",
+                    rep.rid, rec["score"], self.collapse_below,
+                    incident["id"])
+        self._collapses.labels(replica=rep.rid).inc()
+        # The router's broadcast excludes the source replica, but the
+        # degraded replica's flight ring is the most interesting one —
+        # POST to it directly first, then fan out through the router.
+        try:
+            self.transport.post_json(
+                rep.url("/incident"),
+                {"id": incident["id"], "kind": incident["kind"],
+                 "source": rep.rid},
+                timeout_s=self.timeout_s,
+            )
+        except TransportError as e:
+            log.warning("canary incident POST to %s failed: %s", rep.rid, e)
+        if self.router is not None:
+            try:
+                self.router.observe_incident(rep.rid, incident)
+            except Exception:  # incident fan-out must never kill the prober
+                log.exception("canary incident fan-out failed for %s",
+                              rep.rid)
+        if self.on_collapse is not None:
+            try:
+                self.on_collapse(rep.rid, incident)
+            except Exception:
+                log.exception("canary collapse callback failed for %s",
+                              rep.rid)
+
+    # -- registry hygiene ----------------------------------------------------
+
+    def _prune(self) -> None:
+        """Drop prober state and the per-replica gauge child for replicas
+        that left the registry or were removed — a dead backend's canary
+        score must not linger in /metrics (the PR 14 digest leak class;
+        the registry purges its own ``rep.canary`` on removal)."""
+        live = {rep.rid for rep in self.registry.replicas()
+                if rep.state != "removed"}
+        for rid in [r for r in self._state if r not in live]:
+            del self._state[rid]
+            self._score_gauge.remove(replica=rid)
+
+    # -- background loop -----------------------------------------------------
+
+    def start(self) -> "CanaryProber":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-canary", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.interval_s + self.timeout_s + 1.0)
+            if t.is_alive():
+                # Mid-round on a stalled replica: keep the handle so a
+                # later start() cannot race two probers (health.py rule).
+                return
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # a probe round must never kill the loop
+                log.exception("canary probe round failed")
+            self._stop.wait(self.interval_s)

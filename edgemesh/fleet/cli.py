@@ -142,6 +142,18 @@ def build_parser() -> argparse.ArgumentParser:
                      "requests cost zero span I/O (here and on replicas) "
                      "but still count in every metric")
     srv.add_argument("--probe-interval-s", type=float, default=2.0)
+    srv.add_argument("--canary", action="store_true",
+                     help="start the golden-set canary prober "
+                     "(fleet/canary.py) with the built-in fallback set; "
+                     "implied by --canary-golden")
+    srv.add_argument("--canary-golden", default=None,
+                     help="golden-set JSONL path ({'question','reference'} "
+                     "per line), typically pinned from a known-good "
+                     "build's own answers")
+    srv.add_argument("--canary-interval-s", type=float, default=30.0)
+    srv.add_argument("--canary-collapse-below", type=float, default=0.2,
+                     help="canary EWMA below this mints a quality_drift "
+                     "incident for that replica")
     srv.add_argument("--boot-timeout-s", type=float, default=300.0,
                      help="per-replica readiness wait (first jit compile "
                      "of a real checkpoint can take minutes)")
@@ -483,6 +495,20 @@ def cmd_serve(args) -> int:
                               # Fresh digests re-derive tier membership on
                               # the probe cadence (no-op untiered).
                               on_digest=router.note_digest).start()
+        canary = None
+        if args.canary or args.canary_golden:
+            from edgemesh.fleet.canary import CanaryProber
+
+            canary = CanaryProber(
+                registry, transport=transport, router=router,
+                golden_path=args.canary_golden,
+                interval_s=args.canary_interval_s,
+                collapse_below=args.canary_collapse_below,
+                obs_registry=router.obs,
+                # Canary rounds join the router's span log so `edgemesh
+                # obs quality` sees the probe timeline beside the spans.
+                trace_log=router._trace_log,
+            ).start()
         print(
             f"edgemesh fleet: {len(procs)} replicas behind "
             f"http://{args.host}:{args.port} (balancer={args.balancer}); "
@@ -495,6 +521,8 @@ def cmd_serve(args) -> int:
             pass
         finally:
             prober.stop()
+            if canary is not None:
+                canary.stop()
             if scaler is not None:
                 scaler.stop()
                 # Scale-up replicas drain like the originals, then stop.

@@ -40,6 +40,7 @@ import time
 
 from edgemesh.agents.prompts import REFINER_ROLE, format_refiner_prompt
 from edgemesh.obs.metrics import bounded_label
+from edgemesh.obs.quality import UNIT_BUCKETS, pairwise_agreement, token_f1
 from edgemesh.obs.trace import TraceContext, sample
 from edgemesh.serve.httputil import RETRY_AFTER_HEADER, TRACE_HEADER
 
@@ -61,6 +62,7 @@ class EnsembleCoordinator:
     def __init__(self, router, qa_pools: list[str] | None = None,
                  refiner_pool: str | None = None,
                  qa_budget_fraction: float = 0.7,
+                 low_agreement: float = 0.3,
                  obs_registry=None) -> None:
         from edgemesh.obs import get_registry
 
@@ -86,8 +88,25 @@ class EnsembleCoordinator:
             "edgemesh_ensemble_seconds",
             "End-to-end ensemble latency by terminal outcome", ("outcome",),
         )
+        # The quality observatory's ensemble signals (obs/quality.py):
+        # pairwise token-F1 between independent QA drafts of the SAME
+        # question — a free consistency probe no single-replica signal
+        # gives — and which pools were party to low-agreement requests.
+        self.low_agreement = float(low_agreement)
+        self._agreement = reg.histogram(
+            "edgemesh_ensemble_agreement",
+            "Pairwise token-F1 agreement between QA branch answers "
+            "(requests with >= 2 surviving branches)", (),
+            buckets=UNIT_BUCKETS,
+        )
+        self._low_agreement = reg.counter(
+            "edgemesh_ensemble_low_agreement_total",
+            "Low-agreement ensemble requests attributed to each "
+            "participating QA pool", ("pool",),
+        )
         self._stats_lock = threading.Lock()
         self._outcome_counts: dict[str, int] = {}  # guarded by: _stats_lock
+        self._agreement_ewma: float | None = None  # guarded by: _stats_lock
 
     # -- topology ------------------------------------------------------------
 
@@ -136,6 +155,10 @@ class EnsembleCoordinator:
         spans: list[dict] = [{
             "name": "ensemble", "span_id": ctx.span_id,
             "outcome": "pending", "t0": time.time(), "t1": None,
+            # Quality attrs, pre-seeded so the dict never grows while a
+            # concurrent dump iterates it: cross-branch answer agreement
+            # and how far the refiner moved off the best draft.
+            "agreement": None, "refiner_divergence": None,
         }]
         t0 = time.monotonic()
         budget = deadline_s if deadline_s is not None else router.default_deadline_s
@@ -218,6 +241,14 @@ class EnsembleCoordinator:
                 pool=pool,
             )
             results[i] = (status, body)  # distinct slots: no lock needed
+            if status == 200 and isinstance(body, dict):
+                answer = body.get("answer")
+                conf = body.get("confidence")
+                with span_lock:
+                    if isinstance(answer, str):
+                        span["answer_len"] = len(answer)
+                    if isinstance(conf, (int, float)):
+                        span["confidence"] = round(float(conf), 4)
             close_span(span, "ok" if status == 200 else "failed", status)
 
         threads = []
@@ -227,6 +258,10 @@ class EnsembleCoordinator:
                 "name": "branch", "span_id": bctx.span_id,
                 "pool": pool, "outcome": "pending", "status": None,
                 "t0": time.time(), "t1": None,
+                # Quality attrs the worker fills on success (pre-seeded —
+                # see the growth rule above): the draft's length and the
+                # engine's device-side confidence for it.
+                "answer_len": None, "confidence": None,
             }
             spans.append(span)
             branch_spans.append(span)
@@ -268,6 +303,29 @@ class EnsembleCoordinator:
             )
         degraded = any(b["outcome"] != "ok" for b in branches)
 
+        # Cross-branch agreement (obs/quality.py): independent drafts of
+        # the SAME question disagreeing is a quality signal no single
+        # replica can emit — a pool serving a corrupted checkpoint drags
+        # this down while its own latency and confidence look plausible.
+        agreement = pairwise_agreement(
+            [c["answer"] for c in candidates if isinstance(c["answer"], str)]
+        )
+        if agreement is not None:
+            spans[0]["agreement"] = agreement
+            self._agreement.labels().observe(agreement)
+            with self._stats_lock:
+                prev = self._agreement_ewma
+                self._agreement_ewma = (
+                    agreement if prev is None
+                    else round(0.2 * agreement + 0.8 * prev, 4)
+                )
+            if agreement < self.low_agreement:
+                # Attributed to EVERY participating pool: agreement is a
+                # property of the set, and which member lies is exactly
+                # what the canary prober exists to disambiguate.
+                for c in candidates:
+                    self._low_agreement.labels(pool=c["pool"]).inc()
+
         if not candidates:
             # The ONLY client-visible ensemble failure: nothing to refine,
             # nothing to fall back on.
@@ -279,6 +337,7 @@ class EnsembleCoordinator:
         best = max(candidates, key=lambda c: c["confidence"])
         base_body = {
             "candidates": candidates, "branches": branches,
+            "agreement": agreement, "refiner_divergence": None,
         }
         if refiner_pool is None:
             return 200, {
@@ -322,12 +381,22 @@ class EnsembleCoordinator:
                 and body.get("answer") is not None):
             close_span(rspan, "ok", status)
             outcome = "degraded_qa" if degraded else "ok"
+            # How far the refiner moved off the best draft (1 - token-F1):
+            # near 0 means it echoed a candidate, near 1 it went its own
+            # way — either extreme sustained fleet-wide is worth a look.
+            divergence = None
+            if isinstance(body["answer"], str) and isinstance(
+                    best["answer"], str):
+                divergence = round(
+                    1.0 - token_f1(body["answer"], best["answer"]), 4)
+            spans[0]["refiner_divergence"] = divergence
             return 200, {
                 **base_body, "answer": body["answer"],
                 "confidence": float(
                     body.get("confidence") or best["confidence"]
                 ),
                 "outcome": outcome, "refined": True,
+                "refiner_divergence": divergence,
             }, outcome
         close_span(rspan, "failed", status)
         return 200, {
@@ -343,9 +412,13 @@ class EnsembleCoordinator:
         qa_pools, refiner_pool = self.topology()
         with self._stats_lock:
             outcomes = dict(sorted(self._outcome_counts.items()))
+            agreement = self._agreement_ewma
         return {
             "qa_pools": [p or "fleet" for p in qa_pools],
             "refiner_pool": refiner_pool,
             "qa_budget_fraction": self.qa_budget_fraction,
             "outcomes": outcomes or None,
+            # None until a multi-branch request has been served — the
+            # single-pool fleet has no agreement signal to report.
+            "agreement_ewma": agreement,
         }

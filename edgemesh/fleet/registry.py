@@ -59,9 +59,19 @@ class Replica:
     # (replica wall clocks skew; arrival time is the honest freshness).
     load: dict | None = None
     load_ts: float | None = None
+    # Latest golden-set canary result ({"score", "probes", ...} —
+    # fleet/canary.py CanaryProber), with its own receiver-side freshness
+    # stamp. None until the prober has scored this replica; the telemetry
+    # balancer down-weights on a fresh low score only.
+    canary: dict | None = None
+    canary_ts: float | None = None
 
     def load_age_s(self) -> float | None:
         return None if self.load_ts is None else time.monotonic() - self.load_ts
+
+    def canary_age_s(self) -> float | None:
+        return (None if self.canary_ts is None
+                else time.monotonic() - self.canary_ts)
 
     @property
     def pool(self) -> str | None:
@@ -91,6 +101,10 @@ class Replica:
                 "load": self.load,
                 "load_age_s": round(self.load_age_s(), 3),
             } if self.load is not None else {}),
+            **({
+                "canary": self.canary,
+                "canary_age_s": round(self.canary_age_s(), 3),
+            } if self.canary is not None else {}),
         }
 
 
@@ -125,6 +139,11 @@ class ReplicaRegistry:
                     # idempotent heartbeats must not blind the balancer.)
                     rep.load = None
                     rep.load_ts = None
+                    # And its canary score: the revived process serves a
+                    # possibly-different checkpoint and must re-earn its
+                    # quality standing from a fresh probe.
+                    rep.canary = None
+                    rep.canary_ts = None
                     # Same for the model descriptor: the revived process
                     # declares what it serves NOW; the dead incarnation's
                     # pool membership must not route model-keyed traffic
@@ -201,6 +220,12 @@ class ReplicaRegistry:
                     # snapshot outliving stale_after_s was the bug.
                     rep.load = None
                     rep.load_ts = None
+                    # The canary score dies with the backend too — same
+                    # leak class as the digest (PR 14): a removed
+                    # replica's quality standing must not linger in
+                    # /fleetz or balancer scoring.
+                    rep.canary = None
+                    rep.canary_ts = None
                     # Pool membership dies with the backend for the same
                     # reason: a removed replica must fall out of every
                     # model-keyed pool immediately, not when it is
@@ -267,6 +292,22 @@ class ReplicaRegistry:
             if rep is not None:
                 rep.load = digest
                 rep.load_ts = time.monotonic()
+
+    def update_canary(self, rid: str, result: dict | None) -> None:
+        """Store the replica's latest golden-set canary result
+        (fleet/canary.py refreshes it on every probe round). Same
+        freshness convention as ``update_load``: receiver-side monotonic
+        time, never replica clocks. ``None`` clears the entry (purge)."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return
+            if result is None:
+                rep.canary = None
+                rep.canary_ts = None
+            elif isinstance(result, dict):
+                rep.canary = result
+                rep.canary_ts = time.monotonic()
 
     def probe_result(self, rid: str, ok: bool, healthy_after: int = 1,
                      unhealthy_after: int = 2, error: str = "") -> str | None:

@@ -1228,6 +1228,11 @@ class FleetRouter:
         # exhaustion forecast from the digest ``mem`` block. Null until
         # some replica ships one (dense backends never do).
         mem_replicas: dict[str, dict] = {}
+        # Fleet quality rollup (docs/OBSERVABILITY.md "The quality
+        # observatory"): each replica's digest quality block beside its
+        # latest canary score — what /fleetz shows an operator hunting a
+        # replica that answers fast and wrong.
+        quality_replicas: dict[str, dict] = {}
         for rep in self.registry.replicas():
             if not rep.routable():
                 continue
@@ -1242,6 +1247,15 @@ class FleetRouter:
                     "leaked_pages": (m.get("leak") or {}).get("pages"),
                     "conservation_breaks": m.get("conservation_breaks"),
                 }
+            qcell: dict = {}
+            q = load.get("quality")
+            if isinstance(q, dict):
+                qcell["confidence_ewma"] = q.get("confidence_ewma")
+                qcell["low_fraction"] = q.get("low_fraction")
+            if isinstance(rep.canary, dict):
+                qcell["canary"] = dict(rep.canary)
+            if qcell:
+                quality_replicas[rep.rid] = qcell
             cap = load.get("capacity")
             if not isinstance(cap, dict):
                 continue
@@ -1318,6 +1332,24 @@ class FleetRouter:
                 "min_forecast_s": min(forecasts) if forecasts else None,
                 "replicas": mem_replicas,
             }
+        quality = None
+        if quality_replicas:
+            scores = [
+                (c["canary"].get("score"), rid)
+                for rid, c in quality_replicas.items()
+                if isinstance(c.get("canary"), dict)
+                and isinstance(c["canary"].get("score"), (int, float))
+            ]
+            worst = min(scores) if scores else None
+            quality = {
+                # The MINIMUM canary score and who holds it, mirroring
+                # mem's tightest-pool convention: quality collapse is
+                # per-replica, and the worst one is the one the balancer
+                # penalty and the drift incident act on.
+                "min_canary_score": None if worst is None else worst[0],
+                "min_canary_replica": None if worst is None else worst[1],
+                "replicas": quality_replicas,
+            }
         return {
             "balancer": getattr(self.balancer, "name", type(self.balancer).__name__),
             "max_inflight": self.admission.max_inflight,
@@ -1329,6 +1361,10 @@ class FleetRouter:
             # occupancy, leak/conservation counters, and the tightest
             # exhaustion forecast (docs/OBSERVABILITY.md).
             "mem": mem,
+            # The quality observatory's fleet view: per-replica digest
+            # confidence + latest canary score, with the worst canary
+            # called out (docs/OBSERVABILITY.md "The quality observatory").
+            "quality": quality,
             "autoscale": (
                 None if self.autoscaler is None else self.autoscaler.status()
             ),

@@ -31,6 +31,10 @@ Three pieces (docs/OBSERVABILITY.md is the operator-facing reference):
   every KV-pool transition reports through (per-tenant attribution,
   conservation invariant, leak tripwires, exhaustion forecast) plus the
   offline span-log twins.
+- ``quality``: the quality observatory — per-request confidence/entropy
+  from the decode loop, pairwise token-F1 agreement, per-tenant goodness
+  gauges, the quality-drift incident feed, and the offline span-log
+  twin (``edgemesh obs quality``).
 
 Importing this package never imports jax — device sampling defers the
 import to scrape time, so the supervisor and the ``edgemesh obs`` CLI stay
@@ -42,6 +46,7 @@ from edgemesh.obs.anomaly import (  # noqa: F401
     CompileStormDetector,
     ErrorSpikeDetector,
     PoolLeakDetector,
+    QualityDriftDetector,
     QueueCollapseDetector,
     SloBurstDetector,
 )
@@ -79,6 +84,13 @@ from edgemesh.obs.metrics import (  # noqa: F401
     get_registry,
     reset_bounded_labels,
     set_registry,
+)
+from edgemesh.obs.quality import (  # noqa: F401
+    CANARY_RECORD_EVENT,
+    QualityTracker,
+    pairwise_agreement,
+    summarize_quality,
+    token_f1,
 )
 from edgemesh.obs.slo import (  # noqa: F401
     DecayingQuantile,

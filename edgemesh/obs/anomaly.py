@@ -1,7 +1,7 @@
 """Anomaly triggers: the detectors that fire the flight recorder.
 
 The flight recorder (obs/flight.py) answers *what happened*; this module
-answers *when to ask*. Five detectors, each fed by hooks the serving stack
+answers *when to ask*. Six detectors, each fed by hooks the serving stack
 already has — no new measurement, only new judgment:
 
 - :class:`SloBurstDetector` — a burst of SLO misses in the recent request
@@ -21,6 +21,13 @@ already has — no new measurement, only new judgment:
   after their owning request retired (fed by the memory observatory's
   quiesce scan, obs/memory.py): the one failure the conservation counter
   alone cannot localize to a request.
+- :class:`QualityDriftDetector` — the recent window of per-request
+  confidence (quality observatory, obs/quality.py) collapsed relative to
+  a decayed healthy baseline: the replica whose answers went bad while
+  its latency stayed green. Same change-not-level philosophy as the SLO
+  burst — degraded samples never feed the baseline, and a fire needs a
+  healthy→degraded *transition*, so a replica that has always been
+  mediocre is a dashboard fact, not an incident.
 
 :class:`AnomalyMonitor` owns the detectors, counts
 ``edgemesh_anomaly_triggers_total{kind}``, and — when armed with a dump
@@ -250,6 +257,78 @@ class PoolLeakDetector:
             return True
 
 
+#: Quantile bounds for signals on [0, 1] (confidence/agreement): the
+#: latency-scale defaults in obs/slo.py top out at ~0.17, useless here.
+QUALITY_BOUNDS = tuple(i / 64 for i in range(1, 65))
+
+
+class QualityDriftDetector:
+    """Recent-confidence collapse vs a decayed healthy baseline.
+
+    ``observe`` feeds every retirement's mean confidence (the quality
+    observatory's ``on_retire`` hook). A fire needs ALL of:
+
+    - the baseline quantile has seen enough healthy traffic to judge
+      (``DecayingQuantile.min_weight`` — counts halve every
+      ``half_life_s``, so the notion of "healthy" tracks deploys);
+    - at least ``min_count`` of the last ``window`` requests observed,
+      and their mean confidence < ``drop_factor`` x the baseline median;
+    - the detector is *armed*: it fires once per healthy→degraded
+      transition and re-arms only after the window recovers. Sustained
+      low quality is one incident, not a dump per cooldown.
+
+    Degraded samples (below the drop line) never feed the baseline —
+    otherwise the baseline would decay toward the degradation and
+    declare it the new healthy.
+    """
+
+    kind = "quality_drift"
+
+    def __init__(self, window: int = 16, min_count: int = 8,
+                 drop_factor: float = 0.6, half_life_s: float = 300.0,
+                 min_weight: float = 16.0):
+        self.window = int(window)
+        self.min_count = int(min_count)
+        self.drop_factor = float(drop_factor)
+        self.baseline = DecayingQuantile(half_life_s=half_life_s,
+                                         bounds=QUALITY_BOUNDS,
+                                         min_weight=min_weight)
+        self._recent: deque[float] = deque(maxlen=self.window)
+        self._armed = True  # guarded by: _lock
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> "QualityDriftDetector":
+        return cls(
+            window=_env_int("EDGEMESH_ANOMALY_QUALITY_WINDOW", 16),
+            min_count=_env_int("EDGEMESH_ANOMALY_QUALITY_COUNT", 8),
+            drop_factor=_env_float("EDGEMESH_ANOMALY_QUALITY_DROP", 0.6),
+            half_life_s=_env_float(
+                "EDGEMESH_ANOMALY_QUALITY_HALF_LIFE_S", 300.0),
+            min_weight=_env_float(
+                "EDGEMESH_ANOMALY_QUALITY_MIN_WEIGHT", 16.0),
+        )
+
+    def observe(self, confidence: float) -> bool:
+        c = float(confidence)
+        bound = self.baseline.quantile(0.5)
+        threshold = None if bound is None else self.drop_factor * bound
+        if threshold is None or c >= threshold:
+            self.baseline.observe(c)
+        with self._lock:
+            self._recent.append(c)
+            if threshold is None or len(self._recent) < self.min_count:
+                return False
+            mean = sum(self._recent) / len(self._recent)
+            if mean >= threshold:
+                self._armed = True
+                return False
+            if not self._armed:
+                return False
+            self._armed = False
+            return True
+
+
 class AnomalyMonitor:
     """Detector fan-in → incident id → flight dump, with cooldown.
 
@@ -265,6 +344,7 @@ class AnomalyMonitor:
                  error_spike: ErrorSpikeDetector | None = None,
                  compile_storm: CompileStormDetector | None = None,
                  pool_leak: PoolLeakDetector | None = None,
+                 quality_drift: QualityDriftDetector | None = None,
                  cooldown_s: float = 30.0):
         self.flight = flight
         self.dump_dir = dump_dir
@@ -273,6 +353,7 @@ class AnomalyMonitor:
         self.error_spike = error_spike or ErrorSpikeDetector.from_env()
         self.compile_storm = compile_storm or CompileStormDetector.from_env()
         self.pool_leak = pool_leak or PoolLeakDetector.from_env()
+        self.quality_drift = quality_drift or QualityDriftDetector.from_env()
         self.cooldown_s = _env_float("EDGEMESH_ANOMALY_COOLDOWN_S",
                                      float(cooldown_s))
         reg = registry if registry is not None else get_registry()
@@ -318,6 +399,22 @@ class AnomalyMonitor:
                          detail={"rid": rid,
                                  "retired_age_s": round(retired_age_s, 3),
                                  **(detail or {})})
+            return True
+        return False
+
+    def on_quality(self, confidence: float | None,
+                   detail: dict | None = None) -> bool:
+        """One terminal request's mean confidence from the quality
+        observatory (obs/quality.py ``QualityTracker.on_retire``). Fires
+        the ``quality_drift`` kind on a healthy→degraded transition; the
+        incident id rides the load digest to the router like every other
+        kind, so the fleet's rings land in one directory and the
+        postmortem names the low-quality replica. Returns whether this
+        sample fired."""
+        if confidence is None:
+            return False
+        if self.quality_drift.observe(float(confidence)):
+            self.trigger(self.quality_drift.kind, detail=detail)
             return True
         return False
 

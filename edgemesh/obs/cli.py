@@ -39,6 +39,12 @@ Subcommands (all operate on the span JSONL the engines write via
   log carries ``spec_rounds`` records. ``--diff B`` compares two logs
   boundary-by-boundary (B/A mean ratio). A log with no launch records
   prints an explicit empty report and exits 0.
+- ``quality <spans.jsonl>``: the quality observatory table
+  (obs/quality.py) — per-engine/tenant/replica answer-confidence
+  distributions, cross-branch agreement, the golden-set canary table,
+  and the quality-drift incident timeline with the degraded replicas
+  named. A log with no quality signal prints an explicit empty report
+  and exits 0 (pre-quality logs — same contract as ``compute``/``mem``).
 - ``incident <dumpdir>``: join an incident directory's flight-recorder
   dumps (every replica's ring, plus ``--logs`` router spans) into one
   postmortem document: trigger window marked, per-tenant goodput
@@ -166,6 +172,16 @@ def build_parser() -> argparse.ArgumentParser:
     mem.add_argument("--json", action="store_true", dest="as_json",
                      help="print the machine-readable rollup "
                      "(memory.summarize_mem) instead of the table")
+    qual = sub.add_parser(
+        "quality",
+        help="answer-quality table from span/flight records "
+        "(obs/quality.py): confidence distributions per engine/tenant/"
+        "replica, branch agreement, the canary table, and the "
+        "quality-drift incident timeline")
+    qual.add_argument("path", help="span JSONL log or directory of them")
+    qual.add_argument("--json", action="store_true", dest="as_json",
+                      help="print the machine-readable rollup "
+                      "(quality.summarize_quality) instead of the table")
     return p
 
 
@@ -291,6 +307,12 @@ def cmd_summary(path: str) -> int:
     from edgemesh.obs.memory import summarize_mem
 
     mem = summarize_mem(records)
+    # Quality-observatory rollup (obs/quality.py): confidence/agreement
+    # distributions, canary table, drift timeline. Null on pre-quality
+    # logs.
+    from edgemesh.obs.quality import summarize_quality
+
+    quality = summarize_quality(records)
 
     print(json.dumps({
         "records": len(records),
@@ -310,6 +332,7 @@ def cmd_summary(path: str) -> int:
         "tenants": tenants,
         "compute": compute,
         "mem": mem,
+        "quality": quality,
         "metrics": registry.summary(),
     }, indent=2))
     return 0
@@ -515,6 +538,73 @@ def cmd_mem(path: str, diff: str | None = None, as_json: bool = False) -> int:
               "ledger was disabled (EDGEMESH_MEM_LEDGER=0)")
         return 0
     print("\n".join(_mem_table(summ, _last_mem_digest(records))))
+    return 0
+
+
+def _quality_table(summ: dict) -> list[str]:
+    lines = [f"quality records: {summ['quality_records']}"]
+
+    def dist_rows(title: str, cells: dict | None) -> None:
+        if not cells:
+            return
+        lines.append(f"{title:<16} {'N':>6} {'MEAN':>6} {'MIN':>6} "
+                     f"{'P50':>6} {'P95':>6}")
+        for name, c in cells.items():
+            lines.append(
+                f"{name:<16} {c['n']:>6} {c['mean']:>6.3f} {c['min']:>6.3f} "
+                f"{c['p50']:>6.3f} {c['p95']:>6.3f}"
+            )
+
+    conf = summ.get("confidence") or {}
+    dist_rows("ENGINE", conf.get("engines"))
+    dist_rows("TENANT", conf.get("tenants"))
+    dist_rows("REPLICA", conf.get("replicas"))
+    agreement = summ.get("agreement")
+    if agreement:
+        lines.append(
+            f"agreement: n={agreement['n']} mean={agreement['mean']:.3f} "
+            f"min={agreement['min']:.3f} p50={agreement['p50']:.3f}"
+        )
+    canary = summ.get("canary")
+    if canary:
+        lines.append(f"{'CANARY':<16} {'PROBES':>7} {'MEAN':>6} {'MIN':>6} "
+                     f"{'LAST':>6}  POOL")
+        for rid, c in canary.items():
+            smin = c["score_min"]
+            slast = c["score_last"]
+            lines.append(
+                f"{rid:<16} {c['probes']:>7} {c['score_mean']:>6.3f} "
+                f"{'-' if smin is None else format(smin, '.3f'):>6} "
+                f"{'-' if slast is None else format(slast, '.3f'):>6}"
+                f"  {c.get('pool') or '-'}"
+            )
+    for d in summ.get("drift_incidents") or []:
+        lines.append(
+            f"DRIFT {d.get('incident_id') or '?'} "
+            f"replica={d.get('replica') or '?'} ts={d.get('ts')}"
+        )
+    degraded = summ.get("degraded_replicas")
+    if degraded:
+        lines.append(f"degraded replicas: {', '.join(degraded)}")
+    return lines
+
+
+def cmd_quality(path: str, as_json: bool = False) -> int:
+    """Quality-observatory table from a span log's quality/canary/drift
+    records. A log with no quality signal is an answer, not an error:
+    prints an explicit empty report and exits 0 (pre-quality logs — the
+    same contract as compute's and mem's pre-ledger logs)."""
+    from edgemesh.obs.quality import summarize_quality
+
+    summ = summarize_quality(_read(path))
+    if as_json:
+        print(json.dumps(summ, indent=2))
+        return 0
+    if summ is None:
+        print("no quality records — a pre-quality log, or the tracker was "
+              "disabled (EDGEMESH_QUALITY=0)")
+        return 0
+    print("\n".join(_quality_table(summ)))
     return 0
 
 
@@ -732,6 +822,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_compute(args.path, diff=args.diff, as_json=args.as_json)
     if args.cmd == "mem":
         return cmd_mem(args.path, diff=args.diff, as_json=args.as_json)
+    if args.cmd == "quality":
+        return cmd_quality(args.path, as_json=args.as_json)
     return cmd_prom(args.path)
 
 

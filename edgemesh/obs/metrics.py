@@ -174,6 +174,21 @@ class _Family:
     def observe(self, value: float, count: int = 1) -> None:
         self._default().observe(value, count)
 
+    def remove(self, **labelvalues: str) -> bool:
+        """Drop the child for one label-value tuple (idempotent). The
+        registry hygiene seam: per-replica gauges (canary scores) must die
+        with the replica or /metrics accretes series for every replica
+        that ever registered. Counters/histograms are cumulative by
+        contract — only call this for gauges keyed by entity identity."""
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, "
+                f"got {tuple(labelvalues)}"
+            )
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            return self._children.pop(key, None) is not None
+
     def items(self) -> list[tuple[tuple[str, ...], Any]]:
         with self._lock:
             return sorted(self._children.items())

@@ -1,0 +1,395 @@
+"""The quality observatory: answer quality as a live serving signal.
+
+Every observatory before this one (latency, bytes, pages, FLOPs) watches
+*how fast* the fleet answers; none watches *how well*. A replica serving
+a corrupted checkpoint — noise in the head, a truncated weight file —
+passes /readyz, meets its latency SLOs, and looks healthy to every
+anomaly detector while answering garbage. This module closes that blind
+spot with signals the serving path already has in hand:
+
+- **confidence** — the paper's own metric (mean per-token max softmax),
+  plus the per-request *minimum* step confidence: a single collapsed
+  step in an otherwise-confident answer is a finding the mean hides.
+  Computed device-side inside the decode loop (runtime/generate.py) as
+  one elementwise tail on the softmax the sampler already materializes —
+  no extra launch, no second forward.
+- **entropy** — mean per-token distribution entropy (nats): the dual of
+  confidence, separating "confidently wrong vocabulary" (low entropy,
+  low confidence is impossible) from "head is noise" (entropy near
+  ``log(vocab)``).
+- **agreement** — pairwise token-F1 between independent answers to the
+  SAME question (the ensemble coordinator's QA drafts, the canary
+  prober's reference answers), via the eval harness's tokenizer so the
+  number is comparable to the offline ROUGE/BLEU tables.
+
+:class:`QualityTracker` is the engine-side sink (one per engine, same
+shape as the compute/memory ledgers): histograms + per-tenant goodness
+gauges under the EM111/EM112 naming rules, EWMAs for ``stats()`` and the
+load digest's ``quality`` block, and the feed into the anomaly monitor's
+:class:`~edgemesh.obs.anomaly.QualityDriftDetector` (the ``quality_drift``
+incident). ``EDGEMESH_QUALITY=0`` disables it — the overhead-gate off
+arm benchmarks.py flips (same <= 1.02 bar as the flight recorder).
+
+Offline, :func:`summarize_quality` rebuilds the same views from span
+logs / flight dumps (``edgemesh obs quality``, the ``quality`` block of
+``obs summary``) with the standing compatibility contract: pre-quality
+logs summarize to None (rc 0), unknown keys on future records are
+ignored.
+
+Importing this module never imports jax (the obs package contract).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Any, Callable, Iterable
+
+from edgemesh.obs.metrics import Registry, bounded_label, get_registry
+
+#: Span-log event name for canary probe results (obs JSONL vocabulary —
+#: EM113): one record per golden-set probe, written by the fleet's
+#: :class:`~edgemesh.fleet.canary.CanaryProber`.
+CANARY_RECORD_EVENT = "canary"
+
+#: ``EDGEMESH_QUALITY=0`` disables the tracker entirely.
+ENABLE_ENV = "EDGEMESH_QUALITY"
+
+#: Histogram buckets for signals living on [0, 1] (confidence, agreement,
+#: canary scores) — the latency defaults would put everything in one bin.
+UNIT_BUCKETS = tuple(round(i / 20, 2) for i in range(1, 21))
+
+#: Token-entropy buckets (nats): log(vocab) for a 32k vocab is ~10.4, so
+#: a geometric ladder to ~12 covers greedy-certain through uniform-noise.
+ENTROPY_BUCKETS = (0.02, 0.05, 0.1, 0.2, 0.4, 0.8, 1.5, 3.0, 6.0, 12.0)
+
+#: EWMA smoothing for the digest-facing aggregates (matches the span
+#: tracker's load-digest convention: recent-weighted, cheap to update).
+EWMA_ALPHA = 0.2
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENABLE_ENV, "1") != "0"
+
+
+class QualityTracker:
+    """Per-engine sink for the decode loop's quality signals.
+
+    The engine calls :meth:`on_retire` once per terminal request (from
+    ``_retire``, inside its own lock is fine — the tracker carries its
+    own so the read side can run on gateway threads). Everything here is
+    host-side float math on numbers the device already reduced.
+    """
+
+    def __init__(self, registry: Registry | None = None,
+                 engine: str = "continuous",
+                 low_confidence: float = 0.2,
+                 anomaly_source: Callable[[], Any] | None = None,
+                 enabled: bool | None = None):
+        self.registry = registry or get_registry()
+        self.engine = engine
+        #: Below this mean confidence a request counts as "low" — the
+        #: per-tenant goodness denominator (not the drift rule: drift is
+        #: judged against the replica's own baseline, not a constant).
+        self.low_confidence = float(low_confidence)
+        self.enabled = _env_enabled() if enabled is None else bool(enabled)
+        self._anomaly_source = anomaly_source
+        self._lock = threading.Lock()
+        self._seen = False
+        self._requests = 0
+        self._low = 0
+        self._conf_ewma: float | None = None
+        self._conf_min_seen: float | None = None
+        self._ent_ewma: float | None = None
+        self._tenant: dict[str, list[float]] = {}  # label -> [n, low, ewma]
+        reg = self.registry
+        self._conf_hist = reg.histogram(
+            "edgemesh_quality_confidence",
+            "Per-request mean max-softmax confidence (decode loop)",
+            ("engine",), buckets=UNIT_BUCKETS)
+        self._ent_hist = reg.histogram(
+            "edgemesh_quality_entropy",
+            "Per-request mean token entropy in nats (decode loop)",
+            ("engine",), buckets=ENTROPY_BUCKETS)
+        self._requests_total = reg.counter(
+            "edgemesh_quality_requests_total",
+            "Requests with quality signals, split by goodness band",
+            ("engine", "band"))
+        self._tenant_gauge = reg.gauge(
+            "edgemesh_quality_tenant_confidence",
+            "Recent-weighted mean confidence per tenant (bounded labels)",
+            ("engine", "tenant"))
+
+    # -- the feed ------------------------------------------------------------
+
+    def on_retire(self, quality: dict | None,
+                  tenant: str | None = None) -> None:
+        """One terminal request's quality block (the dict ``_retire``
+        stamps on the span: ``{confidence_mean, confidence_min,
+        entropy_mean, tokens}``). None (aborted before any decode step,
+        quality disabled device-side) is a no-op."""
+        if not self.enabled or not isinstance(quality, dict):
+            return
+        conf = quality.get("confidence_mean")
+        if not isinstance(conf, (int, float)) or not math.isfinite(conf):
+            return
+        conf = float(conf)
+        conf_min = quality.get("confidence_min")
+        ent = quality.get("entropy_mean")
+        label = bounded_label(tenant)
+        low = conf < self.low_confidence
+        with self._lock:
+            self._seen = True
+            self._requests += 1
+            self._low += int(low)
+            self._conf_ewma = (
+                conf if self._conf_ewma is None
+                else EWMA_ALPHA * conf + (1 - EWMA_ALPHA) * self._conf_ewma
+            )
+            if isinstance(conf_min, (int, float)) and math.isfinite(conf_min):
+                self._conf_min_seen = (
+                    float(conf_min) if self._conf_min_seen is None
+                    else min(self._conf_min_seen, float(conf_min))
+                )
+            if isinstance(ent, (int, float)) and math.isfinite(ent):
+                self._ent_ewma = (
+                    float(ent) if self._ent_ewma is None
+                    else EWMA_ALPHA * float(ent)
+                    + (1 - EWMA_ALPHA) * self._ent_ewma
+                )
+            cell = self._tenant.setdefault(label, [0.0, 0.0, conf])
+            cell[0] += 1
+            cell[1] += int(low)
+            cell[2] = EWMA_ALPHA * conf + (1 - EWMA_ALPHA) * cell[2]
+            tenant_ewma = cell[2]
+        self._conf_hist.labels(engine=self.engine).observe(conf)
+        if isinstance(ent, (int, float)) and math.isfinite(ent):
+            self._ent_hist.labels(engine=self.engine).observe(float(ent))
+        self._requests_total.labels(
+            engine=self.engine, band="low" if low else "ok").inc()
+        self._tenant_gauge.labels(
+            engine=self.engine, tenant=label).set(tenant_ewma)
+        if self._anomaly_source is not None:
+            try:
+                monitor = self._anomaly_source()
+            except Exception:
+                monitor = None
+            if monitor is not None:
+                monitor.on_quality(conf, detail={
+                    "engine": self.engine, "tenant": label,
+                    "confidence": round(conf, 4),
+                })
+
+    # -- read side -----------------------------------------------------------
+
+    def rollup(self) -> dict:
+        """Cumulative aggregate for ``stats()`` / bench JSON. Falsy ({})
+        before the first signal — a spec engine (no quality feed) or a
+        disabled tracker never grows the key."""
+        with self._lock:
+            if not self._seen:
+                return {}
+            return {
+                "engine": self.engine,
+                "requests": self._requests,
+                "low_confidence_requests": self._low,
+                "confidence_ewma": round(self._conf_ewma, 4),
+                "confidence_min_seen": (
+                    None if self._conf_min_seen is None
+                    else round(self._conf_min_seen, 4)),
+                "entropy_ewma": (
+                    None if self._ent_ewma is None
+                    else round(self._ent_ewma, 4)),
+                "tenants": {
+                    t: {"requests": int(n), "low": int(low),
+                        "confidence_ewma": round(ewma, 4)}
+                    for t, (n, low, ewma) in sorted(self._tenant.items())
+                },
+            }
+
+    def digest_quality(self) -> dict | None:
+        """The load digest's ``quality`` block. None until a signal has
+        been seen — pre-quality consumers (and old routers) read exactly
+        the digest they always did."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            if not self._seen:
+                return None
+            return {
+                "requests": self._requests,
+                "confidence_ewma": round(self._conf_ewma, 4),
+                "entropy_ewma": (
+                    None if self._ent_ewma is None
+                    else round(self._ent_ewma, 4)),
+                "low_fraction": round(self._low / max(1, self._requests), 4),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Agreement (pairwise token-F1 over the eval harness's tokenizer)
+# ---------------------------------------------------------------------------
+
+
+def token_f1(prediction: str, reference: str) -> float:
+    """Unigram token F1 between two answers — the agreement/canary score.
+
+    Rides :func:`edgemesh.eval.metrics.tokenize` (Porter-stemmed, same as
+    the offline ROUGE path) so online canary scores and offline eval
+    tables speak one vocabulary. Two empty answers agree (1.0): an
+    ensemble whose branches all said nothing is unanimous, not broken —
+    the *length* attr on the branch span carries that finding.
+    """
+    from collections import Counter
+
+    from edgemesh.eval.metrics import _f1, tokenize
+
+    pred = Counter(tokenize(prediction or ""))
+    ref = Counter(tokenize(reference or ""))
+    if not pred and not ref:
+        return 1.0
+    matches = sum((pred & ref).values())
+    return _f1(matches, sum(pred.values()), sum(ref.values()))
+
+
+def pairwise_agreement(answers: Iterable[str]) -> float | None:
+    """Mean pairwise :func:`token_f1` over >= 2 answers; None otherwise
+    (one branch has nobody to agree with — never fabricate a 1.0)."""
+    texts = [a if isinstance(a, str) else "" for a in answers]
+    if len(texts) < 2:
+        return None
+    total, pairs = 0.0, 0
+    for i in range(len(texts)):
+        for j in range(i + 1, len(texts)):
+            total += token_f1(texts[i], texts[j])
+            pairs += 1
+    return round(total / pairs, 4)
+
+
+# ---------------------------------------------------------------------------
+# Offline analysis (span logs / flight dumps) — `edgemesh obs quality`
+# ---------------------------------------------------------------------------
+
+
+def _quantiles(values: list[float]) -> dict | None:
+    if not values:
+        return None
+    vs = sorted(values)
+
+    def q(p: float) -> float:
+        return round(vs[min(len(vs) - 1, int(p * len(vs)))], 4)
+
+    return {"n": len(vs), "mean": round(sum(vs) / len(vs), 4),
+            "min": round(vs[0], 4), "p50": q(0.5), "p95": q(0.95)}
+
+
+def summarize_quality(records: Iterable[dict]) -> dict | None:
+    """Quality rollup from span-log / flight-dump records — the offline
+    twin of :meth:`QualityTracker.rollup` plus the fleet views only a log
+    can hold: per-replica confidence (flight dumps carry the replica on
+    their header), the canary table, and the quality-drift timeline.
+
+    Returns None when no record carries a quality signal: a pre-quality
+    log is an answer, not an error (the CLI prints null and exits 0).
+    Unknown keys on future records are ignored; known-but-missing keys
+    read as None — both directions pinned in tests/test_obs.py.
+    """
+    per_engine: dict[str, list[float]] = {}
+    per_tenant: dict[str, list[float]] = {}
+    per_replica: dict[str, list[float]] = {}
+    agreements: list[float] = []
+    canary: dict[str, dict] = {}
+    drift: list[dict] = []
+    n = 0
+    replica = None  # set by flight_dump headers, stamps following records
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        event = rec.get("event")
+        if event == "flight_dump":
+            replica = rec.get("replica") or replica
+            kind = rec.get("kind")
+            origin = rec.get("origin_kind")
+            if kind == "quality_drift" or origin == "quality_drift":
+                drift.append({
+                    "ts": rec.get("trigger_ts") or rec.get("ts"),
+                    "incident_id": rec.get("incident_id"),
+                    "replica": (rec.get("source") or rec.get("replica")),
+                    "kind": origin or kind,
+                })
+            continue
+        if event == "incident":
+            if rec.get("kind") == "quality_drift":
+                drift.append({
+                    "ts": rec.get("ts"), "incident_id": rec.get("id"),
+                    "replica": rec.get("source"), "kind": "quality_drift",
+                })
+            continue
+        if event == CANARY_RECORD_EVENT:
+            rid = str(rec.get("replica") or "?")
+            score = rec.get("score")
+            if not isinstance(score, (int, float)):
+                continue
+            n += 1
+            cell = canary.setdefault(rid, {
+                "probes": 0, "sum": 0.0, "min": None,
+                "last": None, "pool": rec.get("pool")})
+            cell["probes"] += 1
+            cell["sum"] += float(score)
+            cell["min"] = (float(score) if cell["min"] is None
+                           else min(cell["min"], float(score)))
+            cell["last"] = round(float(score), 4)
+            continue
+        quality = rec.get("quality")
+        if isinstance(quality, dict):
+            conf = quality.get("confidence_mean")
+            if isinstance(conf, (int, float)) and math.isfinite(conf):
+                n += 1
+                conf = float(conf)
+                per_engine.setdefault(
+                    str(rec.get("engine") or "?"), []).append(conf)
+                per_tenant.setdefault(
+                    str(rec.get("tenant") or "default"), []).append(conf)
+                rep = rec.get("_replica") or replica
+                if rep is not None:
+                    per_replica.setdefault(str(rep), []).append(conf)
+        agreement = rec.get("agreement")
+        if isinstance(agreement, (int, float)) and math.isfinite(agreement):
+            n += 1
+            agreements.append(float(agreement))
+        # Router/ensemble records carry agreement inside span attrs too.
+        for span in rec.get("spans") or []:
+            if not isinstance(span, dict):
+                continue
+            sa = span.get("agreement")
+            if isinstance(sa, (int, float)) and math.isfinite(sa):
+                n += 1
+                agreements.append(float(sa))
+    if n == 0:
+        return None
+    return {
+        "quality_records": n,
+        "confidence": {
+            "engines": {e: _quantiles(v)
+                        for e, v in sorted(per_engine.items())} or None,
+            "tenants": {t: _quantiles(v)
+                        for t, v in sorted(per_tenant.items())} or None,
+            "replicas": {r: _quantiles(v)
+                         for r, v in sorted(per_replica.items())} or None,
+        },
+        "agreement": _quantiles(agreements),
+        "canary": {
+            rid: {"probes": c["probes"],
+                  "score_mean": round(c["sum"] / c["probes"], 4),
+                  "score_min": (None if c["min"] is None
+                                else round(c["min"], 4)),
+                  "score_last": c["last"],
+                  "pool": c["pool"]}
+            for rid, c in sorted(canary.items())
+        } or None,
+        "drift_incidents": sorted(
+            drift, key=lambda d: d.get("ts") or 0) or None,
+        "degraded_replicas": sorted(
+            {str(d["replica"]) for d in drift if d.get("replica")}) or None,
+    }

@@ -63,6 +63,8 @@ class _LoopState(NamedTuple):
     num_generated: jax.Array  # [b]
     token_mask: jax.Array  # [b, vocab] repetition-penalty presence mask
     conf_sum: jax.Array  # [b] running sum of per-step max softmax prob
+    conf_min: jax.Array  # [b] running min of per-step max softmax prob
+    ent_sum: jax.Array  # [b] running sum of per-step token entropy (nats)
 
 
 @partial(jax.jit, static_argnums=(0, 2, 3, 4, 9), donate_argnums=(6, 7))
@@ -84,9 +86,14 @@ def _decode_loop(
     everywhere or budget reached) no trailing forward is wasted — the naive
     sample-then-forward ordering burns one full transformer step per call.
 
-    Returns (out, num_generated, cache, confidence, token_mask, prev_token,
+    Returns (out, num_generated, cache, quality, token_mask, prev_token,
     finished) — the trailing three let ``generate_stream`` continue decoding
-    in a later segment exactly where this one stopped.
+    in a later segment exactly where this one stopped. ``quality`` is the
+    [b, 3] per-row quality accumulator (sum of max-softmax confidence, min
+    max-softmax confidence, sum of token entropy in nats) over the tokens
+    THIS call generated — raw sums/min, not means, so segment callers (the
+    continuous engine) can fold segments together host-side and one-shot
+    callers (``generate``) divide by ``num_generated`` once.
 
     ``cache`` and ``token_mask`` are DONATED: the loop-carry copy at entry
     (the whole multi-GB cache, once per serving segment) reuses the input
@@ -96,16 +103,26 @@ def _decode_loop(
     batch, vocab = first_logits.shape
     decode_fn = decode_fn or forward_decode
 
-    def sample_and_record(logits, step_rng, s_out, idx, finished, num_generated, token_mask, conf_sum):
+    def sample_and_record(logits, step_rng, s_out, idx, finished,
+                          num_generated, token_mask, conf_sum, conf_min,
+                          ent_sum):
         token = sample_token(step_rng, logits, sampling, token_mask)
         token = jnp.where(finished, eos_id, token).astype(jnp.int32)
         s_out = s_out.at[:, idx].set(jnp.where(finished, s_out[:, idx], token))
-        step_conf = jnp.max(jax.nn.softmax(logits.astype(jnp.float32), axis=-1), axis=-1)
+        # One softmax feeds both quality signals — a [b, vocab] elementwise
+        # tail riding the forward's output, never a separate launch.
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        step_conf = jnp.max(probs, axis=-1)
+        step_ent = -jnp.sum(probs * jnp.log(probs + 1e-9), axis=-1)
         conf_sum = conf_sum + jnp.where(finished, 0.0, step_conf)
+        conf_min = jnp.where(finished, conf_min,
+                             jnp.minimum(conf_min, step_conf))
+        ent_sum = ent_sum + jnp.where(finished, 0.0, step_ent)
         num_generated = num_generated + jnp.where(finished, 0, 1)
         finished = finished | (token == eos_id)
         token_mask = TokenMaskState(token_mask).add(token).mask
-        return token, s_out, finished, num_generated, token_mask, conf_sum
+        return (token, s_out, finished, num_generated, token_mask, conf_sum,
+                conf_min, ent_sum)
 
     # Slot 0 comes straight from the prefill logits — no decode forward yet.
     rng, step_rng = jax.random.split(rng)
@@ -113,10 +130,12 @@ def _decode_loop(
     finished_init = (
         jnp.zeros((batch,), bool) if finished0 is None else finished0
     )
-    token0, out, finished, num_generated, token_mask, conf_sum = sample_and_record(
+    (token0, out, finished, num_generated, token_mask, conf_sum, conf_min,
+     ent_sum) = sample_and_record(
         first_logits, step_rng, out, 0,
         finished_init, jnp.zeros((batch,), jnp.int32),
         token_mask, jnp.zeros((batch,), jnp.float32),
+        jnp.ones((batch,), jnp.float32), jnp.zeros((batch,), jnp.float32),
     )
 
     def cond(s: _LoopState):
@@ -134,13 +153,14 @@ def _decode_loop(
             lengths=jnp.where(s.finished, s.cache.lengths, cache.lengths)
         )
         rng, step_rng = jax.random.split(s.rng)
-        token, out, finished, num_generated, token_mask, conf_sum = sample_and_record(
+        (token, out, finished, num_generated, token_mask, conf_sum, conf_min,
+         ent_sum) = sample_and_record(
             logits, step_rng, s.out, s.step, s.finished, s.num_generated,
-            s.token_mask, s.conf_sum,
+            s.token_mask, s.conf_sum, s.conf_min, s.ent_sum,
         )
         return _LoopState(
             s.step + 1, token, cache, rng, out, finished, num_generated,
-            token_mask, conf_sum,
+            token_mask, conf_sum, conf_min, ent_sum,
         )
 
     init = _LoopState(
@@ -153,11 +173,14 @@ def _decode_loop(
         num_generated=num_generated,
         token_mask=token_mask,
         conf_sum=conf_sum,
+        conf_min=conf_min,
+        ent_sum=ent_sum,
     )
     final = jax.lax.while_loop(cond, body, init)
-    confidence = final.conf_sum / jnp.maximum(final.num_generated, 1)
+    quality = jnp.stack(
+        [final.conf_sum, final.conf_min, final.ent_sum], axis=-1)
     return (
-        final.out, final.num_generated, final.cache, confidence,
+        final.out, final.num_generated, final.cache, quality,
         final.token_mask, final.prev_token, final.finished,
     )
 
@@ -257,7 +280,7 @@ def generate(
     )
     with trace("edgemesh/decode") as decode_t:
         if led is not None:
-            out, num_generated, cache, confidence, _, _, _ = led.launch(
+            out, num_generated, cache, quality, _, _, _ = led.launch(
                 "decode_loop", _decode_loop,
                 cfg, params, sampling, max_new, int(eos_id), first_logits,
                 cache, token_mask, rng, decode_fn,
@@ -265,10 +288,13 @@ def generate(
                 measure=True,
             )
         else:
-            out, num_generated, cache, confidence, _, _, _ = _decode_loop(
+            out, num_generated, cache, quality, _, _, _ = _decode_loop(
                 cfg, params, sampling, max_new, int(eos_id), first_logits,
                 cache, token_mask, rng, decode_fn,
             )
+        # The quality slot ships raw per-row sums; the public result keeps
+        # the reference's confidence convention (mean max softmax).
+        confidence = quality[:, 0] / jnp.maximum(num_generated, 1)
         device_sync(out)
     # Snapshot the window HERE — the jnp.sum readback below is bookkeeping,
     # not generation, and must not deflate tokens_per_sec.

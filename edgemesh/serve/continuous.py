@@ -78,6 +78,7 @@ from edgemesh.models.transformer import KVCache, forward_decode, forward_prefill
 from edgemesh.obs import RequestTrace, SpanTracker
 from edgemesh.obs.compute import ComputeLedger, SpecRoundLedger, spec_draft_frac
 from edgemesh.obs.memory import SYSTEM_TENANT, TEMPLATE_RID, PoolLedger
+from edgemesh.obs.quality import QualityTracker
 from edgemesh.obs.trace import (
     TraceContext,
     install_compile_hook,
@@ -360,6 +361,14 @@ class _Slot:
     t_start: float = 0.0
     trace: Any = None  # obs.RequestTrace — the request's span tree
     pages: list[int] = field(default_factory=list)  # paged: private pages held
+    # Quality accumulators (obs/quality.py): running sums/min of the decode
+    # loop's per-segment [b, 3] quality slot, folded host-side per drained
+    # segment. q_tokens counts the DEVICE-side steps (un-trimmed), matching
+    # what the sums cover.
+    q_conf_sum: float = 0.0
+    q_conf_min: float = 1.0
+    q_ent_sum: float = 0.0
+    q_tokens: int = 0
     # Speculative engine: how many of the row's accumulated out-tokens have
     # already been emitted (the spec state's `out` grows in place; the
     # dense loop's per-segment buffers need no such cursor).
@@ -607,6 +616,17 @@ class ContinuousEngine:
             span_log=span_log, flight_source=lambda: self.obs.flight,
             anomaly_source=lambda: self.obs.anomaly,
         )
+        # The quality observatory (obs/quality.py): the decode loop's
+        # per-request confidence/entropy reductions land here at retire —
+        # histograms, per-tenant goodness gauges, the stats()/digest
+        # quality blocks, and the quality_drift anomaly feed. The device
+        # computes the signals unconditionally (an elementwise tail on the
+        # sampler's softmax); EDGEMESH_QUALITY=0 disables the host-side
+        # sink — the overhead-gate off arm benchmarks.py flips.
+        self.quality = QualityTracker(
+            registry=self.obs.registry, engine=self.obs_engine_label,
+            anomaly_source=lambda: self.obs.anomaly,
+        )
         self._pages_gauge = self.obs.registry.gauge(
             "edgemesh_kv_pages", "Paged KV pool occupancy by state",
             ("engine", "state"),
@@ -841,6 +861,8 @@ class ContinuousEngine:
             out["compute"] = self.compute.rollup() or None
             # Memory-observatory rollup (obs/memory.py), same contract.
             out["mem"] = self.mem.rollup() or None
+            # Quality-observatory rollup (obs/quality.py), same contract.
+            out["quality"] = self.quality.rollup() or None
             return out
 
     def load_digest(self) -> dict[str, Any]:
@@ -890,6 +912,11 @@ class ContinuousEngine:
             free_pages=free_n,
             arrival_ewma_s=digest.get("ewma_arrival_s"),
         )
+        # The quality observatory's digest block (obs/quality.py):
+        # recent-weighted confidence/entropy and the low-quality fraction.
+        # None until a signal has been seen — a pre-quality consumer (or
+        # an old router) sees exactly the digest it always did.
+        digest["quality"] = self.quality.digest_quality()
         eng = self.obs_engine_label
         if cap["est_tok_s"] is not None:
             self._capacity_gauge.labels(engine=eng).set(cap["est_tok_s"])
@@ -1754,7 +1781,22 @@ class ContinuousEngine:
         # decode's per-element int() a device readback EACH (~0.13s over the
         # tunnel): ~4s per retired request, 33s of a 36s serving wave.
         text = tokenizer.decode(slot.emitted) if slot.emitted else ""
+        # Fold the segment-accumulated device signals into the request's
+        # quality block BEFORE the span record flushes: the record is built
+        # from trace.attrs, so the block rides JSONL + flight ring for free.
+        quality = None
+        if slot.q_tokens > 0:
+            quality = {
+                "confidence_mean": round(slot.q_conf_sum / slot.q_tokens, 4),
+                "confidence_min": round(slot.q_conf_min, 4),
+                "entropy_mean": round(slot.q_ent_sum / slot.q_tokens, 4),
+                "tokens": slot.q_tokens,
+            }
+            if slot.trace is not None:
+                slot.trace.attrs["quality"] = quality
         now = self.obs.retire(slot.trace, status="ok")
+        tenant = slot.trace.tenant if slot.trace is not None else None
+        self.quality.on_retire(quality, tenant=tenant)
         wall = max(now - slot.t_start, 1e-9)
         slot.future.set_result(
             {
@@ -1765,6 +1807,10 @@ class ContinuousEngine:
                 "queue_s": slot.t_start - slot.t_submit,
                 "t_start": slot.t_start,
                 "t_end": now,
+                # The ensemble coordinator scores branch candidates by this
+                # (fleet/ensemble.py) — None when no decode step landed.
+                "confidence": (
+                    None if quality is None else quality["confidence_mean"]),
             }
         )
         if self._paged:
@@ -1813,7 +1859,7 @@ class ContinuousEngine:
                     self._finished,
                     key=self._ck_decode, tokens=len(active),
                 )
-        out, counts, cache, _, mask, prev, fin = self.compute.launch(
+        out, counts, cache, qual, mask, prev, fin = self.compute.launch(
             "decode_loop", _decode_loop,
             self.cfg, self._params, agent.sampling, self.chunk, eos_id,
             self._logits, self._cache, self._mask, seg_rng,
@@ -1849,9 +1895,11 @@ class ContinuousEngine:
             # +0 detaches the tripwire snapshot from the cache buffer — the
             # cache itself is donated into the next segment/admission while
             # this handle is still awaiting its host fetch.
-            handles = (counts, out, fin, self._cache.free_top + 0)
+            # The quality slot rides LAST: fetched[:3] and the paged
+            # tripwire's fetched[3] keep their positions either way.
+            handles = (counts, out, fin, self._cache.free_top + 0, qual)
         else:
-            handles = (counts, out, fin)
+            handles = (counts, out, fin, qual)
         _start_host_copy(handles)
         return _Inflight([(i, self._gen[i]) for i in active], handles)
 
@@ -1876,10 +1924,20 @@ class ContinuousEngine:
                 "paged-pool tripwire: device allocator popped pages "
                 f"(free_top={int(fetched[3])}) despite host-owned pre-mapping"
             )
+        qual_h = fetched[-1]
         for i, gen in seg.rows:
             slot = self._slots[i]
             if not slot.active or self._gen[i] != gen:
                 continue  # retired earlier and possibly re-admitted
+            # Fold this segment's device-side quality reductions into the
+            # slot BEFORE trimming: the device accumulated over every step
+            # it actually sampled (raw count), including budget overshoot.
+            raw = int(counts_h[i])
+            if raw > 0:
+                slot.q_conf_sum += float(qual_h[i][0])
+                slot.q_conf_min = min(slot.q_conf_min, float(qual_h[i][1]))
+                slot.q_ent_sum += float(qual_h[i][2])
+                slot.q_tokens += raw
             n = min(int(counts_h[i]), max(slot.remaining, 0))
             toks = [int(t) for t in out_h[i][:n]]
             if toks and toks[-1] == eos_id:

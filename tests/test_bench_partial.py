@@ -974,3 +974,127 @@ def test_ensemble_stage_is_skippable_via_env(monkeypatch):
     out = benchmarks.headline_benchmark(preset="tiny", batch=2,
                                         decode_steps=8, sweep_batches=())
     assert not any(k.startswith("ensemble") for k in out)
+
+
+def test_bench_quality_block_schema_and_skip_gate(monkeypatch):
+    """The quality observatory's bench block, pinned at the source: a
+    fixed six-key schema projected from a QualityTracker rollup (extra
+    rollup keys dropped, missing ones null), and EDGEMESH_BENCH_QUALITY=0
+    drops the whole block (None) — the same no-keys-no-error convention
+    as every other skippable bench dimension."""
+    monkeypatch.delenv(benchmarks.QUALITY_GATE_ENV, raising=False)
+    block = benchmarks.bench_quality_block(
+        {"requests": 3, "low_confidence_requests": 1,
+         "confidence_ewma": 0.51, "confidence_min_seen": 0.12,
+         "entropy_ewma": 2.1, "tenants": {"a": {}},  # dropped: not schema
+         "future_key": "ignored"},
+        agreement=0.9)
+    assert block == {"requests": 3, "low_confidence_requests": 1,
+                     "confidence_ewma": 0.51, "confidence_min_seen": 0.12,
+                     "entropy_ewma": 2.1, "agreement_ewma": 0.9}
+    # An empty rollup (spec engine, tracker disabled) still yields the
+    # schema — zero requests, null signals.
+    empty = benchmarks.bench_quality_block({})
+    assert empty == {"requests": 0, "low_confidence_requests": 0,
+                     "confidence_ewma": None, "confidence_min_seen": None,
+                     "entropy_ewma": None, "agreement_ewma": None}
+    assert benchmarks.bench_quality_block(None) == empty
+    monkeypatch.setenv(benchmarks.QUALITY_GATE_ENV, "0")
+    assert benchmarks.bench_quality_block({"requests": 3}) is None
+    assert benchmarks.bench_quality_block(None, agreement=0.9) is None
+
+
+def test_quality_block_keys_ride_bench_json(monkeypatch, capsys):
+    """The quality observatory's bench schema contract: the serving stage
+    carries its tracker rollup (`serving_quality`), router_overhead the
+    tracker on/off arm (`quality_overhead_ratio` <= 1.02 — the
+    PERFORMANCE.md gate), and the ensemble stage its agreement block —
+    pinned with faked stages so a partial artifact still has the keys
+    docs/OBSERVABILITY.md references. A stage faked from an older schema
+    (no quality key) folds to null, never an error."""
+    _fake_stage1(monkeypatch)
+
+    quality_block = {"requests": 40, "low_confidence_requests": 2,
+                     "confidence_ewma": 0.81, "confidence_min_seen": 0.12,
+                     "entropy_ewma": 1.4, "agreement_ewma": None}
+
+    def fake_serving(preset, *a, built=None, kv_backend="paged", ragged=None,
+                     **kw):
+        value = 900.0 if ragged is None else 700.0
+        return {"metric": "serving", "value": value, "wave_tok_s": [value],
+                "spread_pct": 1.0, "req_s": 2.0, "generated": 100,
+                "latency_s_p50": 0.5, "latency_s_p95": 0.9,
+                "stats": {"segments": 9, "max_concurrent": 8,
+                          "ragged_boundaries": 9,
+                          "ragged_prefill_tokens": 300,
+                          "ragged_decode_tokens": 60},
+                "obs": {}, "quality": dict(quality_block)}
+
+    def fake_ablation(preset, built=None, **kw):
+        return {}
+
+    def fake_overhead(**kw):
+        return {"metric": "router_overhead_p50_s", "value": 0.0021,
+                "unit": "s", "n_requests": 40,
+                "direct_p50_s": 0.010, "direct_p99_s": 0.015,
+                "routed_p50_s": 0.0121, "routed_p99_s": 0.018,
+                "overhead_p99_s": 0.003,
+                "traced_p50_s": 0.013, "traced_p99_s": 0.019,
+                "tracing_overhead_p50_s": 0.0009,
+                "tracing_overhead_p99_s": 0.001,
+                "recorder_p50_s": 0.01215, "recorder_p99_s": 0.0181,
+                "recorder_overhead_p50_s": 0.00005,
+                "recorder_overhead_p99_s": 0.0001,
+                "recorder_ring_records": 41,
+                "qualityoff_p50_s": 0.01205,
+                "quality_overhead_p50_s": 0.00005,
+                "quality_overhead_ratio": 1.0041,
+                "sample_trace": None, "obs": {}}
+
+    def fake_adaptive(**kw):
+        return {"metric": "adaptive_over_least_outstanding_p99",
+                "value": 1.4, "unit": "x", "slo_target_s": 0.25}
+
+    def fake_ensemble(**kw):
+        # An OLDER-schema ensemble fake: no quality key → folds to null.
+        return {"metric": "ensemble_latency_p99_ratio", "value": 1.8,
+                "unit": "ratio", "n_requests": 12,
+                "ensemble_p50_s": 0.041, "ensemble_p99_s": 0.09,
+                "single_p50_s": 0.02, "single_p99_s": 0.05,
+                "outcomes": {"ok": 12}, "qa_pools": ["qa-a", "qa-b"],
+                "refiner_pool": "refiner", "ensemble_quality": 0.31,
+                "single_quality": 0.27, "quality_delta": 0.04,
+                "eval_samples": 8, "obs": {}}
+
+    monkeypatch.setattr(benchmarks, "serving_benchmark", fake_serving)
+    monkeypatch.setattr(benchmarks, "ragged_ablation_benchmark",
+                        fake_ablation)
+    monkeypatch.setattr(benchmarks, "router_overhead_benchmark",
+                        fake_overhead)
+    monkeypatch.setattr(benchmarks, "adaptive_router_benchmark",
+                        fake_adaptive)
+    monkeypatch.setattr(benchmarks, "fleet_ensemble_benchmark",
+                        fake_ensemble)
+    monkeypatch.setenv("EDGEMESH_BENCH_8B", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_SPEC", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_TP8", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_LOADGEN", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_DISAGG", "0")
+    monkeypatch.setenv("EDGEMESH_BENCH_AUTOSCALE", "0")
+    monkeypatch.delenv("EDGEMESH_BENCH_ENSEMBLE", raising=False)
+
+    out = benchmarks.headline_benchmark(preset="tiny", batch=2,
+                                        decode_steps=8, sweep_batches=())
+    # Serving stage: the tracker rollup rides the artifact.
+    assert out["serving_quality"] == quality_block
+    # Router-overhead stage: the tracker arm + the <=1.02 gate,
+    # checkable from the artifact alone.
+    assert out["qualityoff_p50_s"] == 0.01205
+    assert out["quality_overhead_ratio"] == 1.0041
+    assert out["quality_overhead_ratio"] <= 1.02
+    # Ensemble stage from the older fake: null block, not a KeyError.
+    assert out["ensemble_quality_signals"] is None
+    lines = [json.loads(l)
+             for l in capsys.readouterr().out.strip().splitlines()]
+    assert "serving_quality" in lines[-1]
+    assert "quality_overhead_ratio" in lines[-1]

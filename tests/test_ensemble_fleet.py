@@ -322,6 +322,81 @@ def test_router_status_carries_pools_and_ensemble_stats():
     assert st["ensemble"]["outcomes"] is None  # no traffic yet
 
 
+def test_ensemble_spans_carry_quality_attrs(tmp_path):
+    """Satellite (quality observatory): branch spans carry answer_len +
+    confidence, the ensemble span and response body carry agreement +
+    refiner_divergence, and the agreement EWMA rides stats()."""
+    log = tmp_path / "spans.jsonl"
+    ft = FakeTransport()
+    ft.on("qa-a-0/generate", _answer("the sky is blue", 0.9))
+    ft.on("qa-b-0/generate", _answer("the sky is blue today", 0.4))
+    ft.on("ref-0/generate", _answer("the sky is blue", 0.8))
+    obs = Registry()
+    router = _router(_pool_registry(), ft, span_log=log, trace_sample=1.0,
+                     obs_registry=obs)
+    status, body, _ = router.ensemble.handle({"question": "sky?"})
+    assert status == 200 and body["outcome"] == "ok"
+
+    spans = JsonlLogger(log).read()[0]["spans"]
+    branch = {s["pool"]: s for s in spans if s["name"] == "branch"}
+    assert branch["qa-a"]["answer_len"] == len("the sky is blue")
+    assert branch["qa-a"]["confidence"] == 0.9
+    assert branch["qa-b"]["confidence"] == 0.4
+    # 4/5 tokens shared both ways → F1 = 2*0.8*1.0/1.8 ≈ 0.8889.
+    agreement = spans[0]["agreement"]
+    assert agreement == pytest.approx(0.8889, abs=1e-3)
+    assert body["agreement"] == agreement
+    # Refiner echoed the best draft verbatim → zero divergence.
+    assert spans[0]["refiner_divergence"] == 0.0
+    assert body["refiner_divergence"] == 0.0
+    # First observation seeds the EWMA directly.
+    assert router.ensemble.stats()["agreement_ewma"] == pytest.approx(
+        agreement, abs=1e-3)
+    summary = obs.summary(prefix="edgemesh_ensemble_agreement")
+    assert summary["edgemesh_ensemble_agreement"]["count"] == 1
+
+
+def test_ensemble_low_agreement_counter_and_null_attrs():
+    """Disagreeing branches trip the low-agreement counter per pool; a
+    failed branch keeps its quality attrs at the pre-seeded nulls."""
+    ft = FakeTransport()
+    ft.on("qa-a-0/generate", _answer("alpha beta gamma", 0.9))
+    ft.on("qa-b-0/generate", _answer("delta epsilon zeta", 0.4))
+    ft.on("ref-0/generate", lambda u, p, h: (200, {"note": "no answer"}))
+    obs = Registry()
+    router = _router(_pool_registry(), ft, obs_registry=obs)
+    status, body, _ = router.ensemble.handle({"question": "q?"})
+    assert status == 200
+    # Zero token overlap → agreement 0.0 < low_agreement default 0.3.
+    assert body["agreement"] == 0.0
+    summary = obs.summary(prefix="edgemesh_ensemble_low_agreement")
+    assert summary[
+        'edgemesh_ensemble_low_agreement_total{pool="qa-a"}'] == 1
+    assert summary[
+        'edgemesh_ensemble_low_agreement_total{pool="qa-b"}'] == 1
+    # Refiner failed → fallback answer, divergence stays null.
+    assert body["outcome"] == "refiner_fallback"
+    assert body["refiner_divergence"] is None
+
+    # Single surviving branch: agreement needs >= 2 answers → null, and the
+    # dead branch's span keeps the pre-seeded null quality attrs.
+    ft2 = FakeTransport()
+    ft2.on("qa-a-0/generate", _answer("solo", 0.7))
+    ft2.on("qa-b-0/generate", lambda u, p, h: (200, {"note": "dead"}))
+    ft2.on("ref-0/generate", _answer("refined", 0.9))
+    import tempfile, pathlib
+    with tempfile.TemporaryDirectory() as td:
+        log = pathlib.Path(td) / "spans.jsonl"
+        router2 = _router(_pool_registry(), ft2, span_log=log,
+                          trace_sample=1.0)
+        status, body, _ = router2.ensemble.handle({"question": "q?"})
+        assert status == 200 and body["agreement"] is None
+        spans = JsonlLogger(log).read()[0]["spans"]
+        dead = [s for s in spans
+                if s["name"] == "branch" and s["pool"] == "qa-b"][0]
+        assert dead["answer_len"] is None and dead["confidence"] is None
+
+
 # ---------------------------------------------------------------------------
 # Frontend: POST /ensemble route + model descriptors over /replicas/register
 # ---------------------------------------------------------------------------

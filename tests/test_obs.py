@@ -752,6 +752,57 @@ def test_replay_ignores_unknown_keys_in_future_records(tmp_path, capsys):
     assert report["tenants"]["acme"]["classified"] == 1
 
 
+def test_quality_block_rides_span_records_both_compat_directions(
+        tmp_path, capsys):
+    """Quality-observatory schema compat, both directions: a record WITH
+    a quality block replays + summarizes cleanly (the block aggregates
+    into the summary's quality view), and a pre-quality log answers null
+    quality at rc 0 — never an error."""
+    from edgemesh.obs.cli import main as obs_main
+
+    new_log = tmp_path / "quality.jsonl"
+    records = [
+        {"ts": 1.0, "event": SPAN_RECORD_EVENT, "rid": 0, "engine": "e",
+         "status": "ok", "generated": 4, "latency_s": 0.2,
+         "slo_result": "good", "tenant": "acme", "spans": [],
+         "quality": {"confidence_mean": 0.91, "confidence_min": 0.4,
+                     "entropy_mean": 1.1, "tokens": 4,
+                     # A future build's extra key must be ignored.
+                     "calibration_temp": 0.7}},
+        {"ts": 2.0, "event": SPAN_RECORD_EVENT, "rid": 1, "engine": "e",
+         "status": "ok", "generated": 2, "latency_s": 0.1,
+         "slo_result": "good", "spans": []},  # quality-less sibling: fine
+    ]
+    with open(new_log, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    # Metric replay is quality-agnostic (the block rides as data, not
+    # state) — the known families still aggregate both records.
+    s = replay_spans(new_log).summary()
+    assert s['edgemesh_requests_submitted_total{engine="e"}'] == 2
+    assert obs_main(["summary", str(new_log)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["quality"]["quality_records"] == 1
+    assert report["quality"]["confidence"]["engines"]["e"]["mean"] == 0.91
+    assert report["quality"]["confidence"]["tenants"]["acme"]["n"] == 1
+    assert obs_main(["quality", str(new_log), "--json"]) == 0
+    view = json.loads(capsys.readouterr().out)
+    assert view["quality_records"] == 1
+
+    # Backward direction: a pre-quality log (no quality key anywhere).
+    old_log = tmp_path / "old.jsonl"
+    with open(old_log, "w") as f:
+        f.write(json.dumps({
+            "ts": 1.0, "event": SPAN_RECORD_EVENT, "rid": 0, "engine": "e",
+            "status": "ok", "generated": 3, "latency_s": 0.2,
+            "slo_result": "good", "spans": []}) + "\n")
+    assert obs_main(["summary", str(old_log)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["quality"] is None  # null, not an error
+    assert obs_main(["quality", str(old_log), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out) is None
+
+
 # ---------------------------------------------------------------------------
 # DecayingQuantile under bursty open-loop arrival (satellite)
 # ---------------------------------------------------------------------------

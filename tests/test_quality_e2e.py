@@ -1,0 +1,261 @@
+"""The quality observatory end-to-end (slow tier): a REAL 2-replica fleet
+where one replica serves a corrupted checkpoint (``EDGEMESH_QUALITY_NOISE``
+perturbs the output head at load time) — it passes ``/readyz``, answers
+``/generate`` with 200s at normal latency, and is undetectable to every
+latency-side monitor. The acceptance chain:
+
+1. a golden set is pinned from the HEALTHY replica's own greedy answers
+   (greedy decoding is deterministic, so healthy reproduces its references
+   exactly and the degraded replica diverges);
+2. the canary prober catches the degraded replica mid-load: its score
+   collapses, the healthy replica's does not, and the collapse mints a
+   ``quality_drift`` incident whose flight dumps land fleet-wide in ONE
+   incident directory;
+3. the engine-side quality signals ride the wire: span records carry the
+   ``quality`` block, ``/loadz`` digests carry confidence EWMAs, and the
+   router's ``/fleetz`` quality rollup names the worst canary replica;
+4. ``edgemesh obs quality`` and ``obs incident`` name the degraded
+   replica from the logs alone.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPLICA_YAML = """
+agents:
+  - role: qa
+    model: {family: llama, num_layers: 1, hidden_size: 32, num_heads: 4,
+            num_kv_heads: 4, intermediate_size: 64, max_seq_len: 512}
+    sampling: {max_new_tokens: 24, do_sample: false, repetition_penalty: 1.0}
+"""
+
+GOLDEN_QUESTIONS = [
+    "What is the capital of France?",
+    "How many days are there in a week?",
+    "What color is the sky on a clear day?",
+]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_replica(cfg_path, port, rid, span_log, flight_dir, noise=0.0):
+    env = os.environ.copy()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "EDGEMESH_REPLICA_ID": rid,
+        # Disarm every latency-side detector: the point of the test is
+        # that ONLY the quality path (canary → quality_drift) can catch
+        # this failure — a corrupted head serves garbage at full speed.
+        "EDGEMESH_ANOMALY_SLO_MIN_WEIGHT": "1000000",
+        "EDGEMESH_ANOMALY_QUEUE_DEPTH": "10000",
+        "EDGEMESH_ANOMALY_ERRORS": "10000",
+        "EDGEMESH_ANOMALY_COMPILES": "10000",
+        # And the replica-local drift detector: the degraded replica is
+        # corrupted from boot, so it has no healthy baseline to drift
+        # from — the CANARY is what must catch it.
+        "EDGEMESH_ANOMALY_QUALITY_MIN_WEIGHT": "1000000",
+        "EDGEMESH_ANOMALY_COOLDOWN_S": "5",
+    })
+    if noise:
+        env["EDGEMESH_QUALITY_NOISE"] = str(noise)
+    return subprocess.Popen(
+        [sys.executable, "-m", "edgemesh.cli", "serve",
+         "--config", str(cfg_path), "--port", str(port),
+         "--continuous", "--batch", "2",
+         "--span-log", str(span_log),
+         "--flight-dir", str(flight_dir), "--flight-capacity", "256"],
+        env=env, cwd=Path(__file__).resolve().parent.parent,
+    )
+
+
+def _wait_ready(transport, ports, timeout_s=300.0):
+    from edgemesh.fleet.transport import TransportError
+
+    deadline = time.monotonic() + timeout_s
+    pending = set(ports)
+    while pending and time.monotonic() < deadline:
+        for port in list(pending):
+            try:
+                status, _ = transport.get_json(
+                    f"http://127.0.0.1:{port}/readyz", timeout_s=2.0)
+            except TransportError:
+                continue
+            if status == 200:
+                pending.discard(port)
+        time.sleep(0.25)
+    assert not pending, f"replicas on ports {sorted(pending)} never ready"
+
+
+def test_canary_catches_degraded_replica_and_fires_quality_drift(tmp_path):
+    from edgemesh.fleet import CanaryProber, FleetRouter, HttpTransport, \
+        ReplicaRegistry
+    from edgemesh.obs import Registry
+    from edgemesh.obs.cli import main as obs_main
+    from edgemesh.obs.flight import DUMP_EVENT
+    from edgemesh.utils.tracing import JsonlLogger
+
+    cfg = tmp_path / "replica.yaml"
+    cfg.write_text(REPLICA_YAML)
+    flight_dir = tmp_path / "incidents"
+    span_dir = tmp_path / "spans"
+    span_dir.mkdir()
+    good_port, bad_port = _free_port(), _free_port()
+    procs = [
+        _spawn_replica(cfg, good_port, "r-good",
+                       span_dir / "spans-r-good.jsonl", flight_dir),
+        _spawn_replica(cfg, bad_port, "r-bad",
+                       span_dir / "spans-r-bad.jsonl", flight_dir,
+                       noise=0.8),
+    ]
+    transport = HttpTransport()
+    try:
+        _wait_ready(transport, [good_port, bad_port])
+
+        def generate(port, question):
+            status, body = transport.post_json(
+                f"http://127.0.0.1:{port}/generate",
+                {"question": question}, timeout_s=240.0)
+            assert status == 200, body
+            assert isinstance(body.get("answer"), str)
+            return body
+
+        # ---- 1: pin the golden set from the healthy replica's own
+        # greedy answers (warming its compile cache in the same pass).
+        golden_path = tmp_path / "golden.jsonl"
+        with open(golden_path, "w") as f:
+            for q in GOLDEN_QUESTIONS:
+                f.write(json.dumps({
+                    "question": q,
+                    "reference": generate(good_port, q)["answer"]}) + "\n")
+        # The degraded replica is indistinguishable on the health axis:
+        # ready, 200s, a string answer — just the WRONG string.
+        bad_body = generate(bad_port, GOLDEN_QUESTIONS[0])
+        golden = [json.loads(l) for l in golden_path.read_text().splitlines()]
+        assert bad_body["answer"] != golden[0]["reference"]
+        # The serving result carries the decode loop's confidence signal.
+        assert "confidence" in bad_body
+
+        # ---- 2: the canary prober catches it. In-process router +
+        # prober, probe rounds driven explicitly (deterministic timing).
+        obs = Registry()
+        registry = ReplicaRegistry([
+            ("r-good", f"http://127.0.0.1:{good_port}"),
+            ("r-bad", f"http://127.0.0.1:{bad_port}"),
+        ])
+        router = FleetRouter(registry, transport=transport, obs_registry=obs,
+                             span_log=span_dir / "router.jsonl",
+                             attempt_timeout_s=120.0)
+        collapses = []
+        prober = CanaryProber(
+            registry, transport=transport, router=router,
+            golden_path=str(golden_path), timeout_s=240.0,
+            min_probes=2, collapse_below=0.3, obs_registry=obs,
+            trace_log=router._trace_log,
+            on_collapse=lambda rid, inc: collapses.append((rid, inc)))
+        # Mid-load: interleave live traffic with the probe rounds — the
+        # fleet keeps serving while the canary closes in.
+        for i in range(3):
+            generate(good_port, f"live question {i}?")
+            generate(bad_port, f"live question {i}?")
+            prober.probe_once()
+
+        good, bad = registry.get("r-good"), registry.get("r-bad")
+        # Healthy reproduces its own references exactly; degraded diverges.
+        assert good.canary["score"] > 0.9, good.canary
+        assert bad.canary["score"] < 0.3, bad.canary
+        assert good.canary["collapsed"] is False
+        assert bad.canary["collapsed"] is True
+        # The collapse fired exactly once, for the degraded replica only.
+        assert [rid for rid, _ in collapses] == ["r-bad"]
+        incident_id = collapses[0][1]["id"]
+        assert collapses[0][1]["kind"] == "quality_drift"
+
+        # The incident propagated fleet-wide: BOTH replicas' flight rings
+        # land in the one incident directory (direct POST to the degraded
+        # source + router broadcast to the rest).
+        incident_dir = flight_dir / incident_id
+
+        def dump_files():
+            if not incident_dir.exists():
+                return []
+            return sorted(incident_dir.glob("flight-*.jsonl"))
+
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and len(dump_files()) < 2:
+            time.sleep(0.5)
+        dumps = dump_files()
+        assert len(dumps) == 2, list(flight_dir.glob("**/*"))
+        headers = {JsonlLogger(f).read()[0]["replica"]:
+                   JsonlLogger(f).read()[0] for f in dumps}
+        assert sorted(headers) == ["r-bad", "r-good"]
+        for h in headers.values():
+            assert h["event"] == DUMP_EVENT
+            assert h["origin_kind"] == "quality_drift"
+        # The router surfaced it (status + the incident span-log record).
+        status = router.status()
+        assert any(i["id"] == incident_id for i in status["incidents"])
+
+        # ---- 3: the quality signals ride the wire end to end.
+        # /loadz: the engine's digest quality block (confidence EWMAs).
+        for port in (good_port, bad_port):
+            st, digest = transport.get_json(
+                f"http://127.0.0.1:{port}/loadz", timeout_s=10.0)
+            assert st == 200
+            q = digest["quality"]
+            assert q["requests"] >= 1
+            assert 0.0 <= q["confidence_ewma"] <= 1.0
+        # /fleetz rollup: the worst canary replica is named.
+        assert status["quality"]["min_canary_replica"] == "r-bad"
+        assert status["quality"]["min_canary_score"] < 0.3
+        # Span records: the quality block rides each replica's span log.
+        recs = JsonlLogger(span_dir / "spans-r-bad.jsonl").read()
+        quality_recs = [r for r in recs
+                        if isinstance(r.get("quality"), dict)]
+        assert quality_recs, "no quality block on the degraded span log"
+        assert all(isinstance(r["quality"]["confidence_mean"], float)
+                   for r in quality_recs)
+
+        # ---- 4: the offline lens names the degraded replica. The span
+        # dir holds the router's log (canary records + the incident
+        # record) and both replicas' span logs (quality blocks).
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert obs_main(["quality", str(span_dir), "--json"]) == 0
+        view = json.loads(buf.getvalue())
+        assert view["canary"]["r-bad"]["score_last"] < 0.3
+        assert view["canary"]["r-good"]["score_last"] > 0.9
+        assert view["degraded_replicas"] == ["r-bad"]
+        assert [d["incident_id"] for d in view["drift_incidents"]] == [
+            incident_id]
+        assert view["confidence"]["engines"]  # engine-side signals folded
+        # The human table renders without error too.
+        with redirect_stdout(io.StringIO()):
+            assert obs_main(["quality", str(span_dir)]) == 0
+        # And the incident postmortem assembles from the dump directory.
+        with redirect_stdout(io.StringIO()):
+            assert obs_main(["incident", str(incident_dir)]) == 0
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
